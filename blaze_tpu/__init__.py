@@ -1,0 +1,38 @@
+"""blaze-tpu: a TPU-native Spark SQL acceleration framework.
+
+A brand-new implementation of the capability surface of Blaze (the
+Spark + DataFusion native engine; see SURVEY.md): physical-plan
+interception behind a protobuf plan contract, columnar operators,
+Spark-compatible native shuffle, memory management with spill, and
+metrics — with the operator kernels running on TPU via JAX/XLA instead
+of Rust/DataFusion on CPU.
+
+Layering (mirrors SURVEY.md §1, TPU-first rather than a port):
+
+- ``blaze_tpu.schema`` / ``blaze_tpu.batch``: the columnar data model —
+  fixed-capacity padded device batches (shape-bucketed so XLA compiles a
+  bounded number of programs), validity masks, fixed-width string
+  columns that hash/compare on the VPU.
+- ``blaze_tpu.exprs``: Spark-semantics expression IR compiled to pure
+  JAX functions (3-valued null logic, decimals as scaled int64,
+  spark-exact murmur3/xxhash64).
+- ``blaze_tpu.ops``: operators (scan/filter/project/agg/sort/joins/
+  window/generate/expand/limit/union/ipc) as streams of device batches,
+  ≙ reference crate ``datafusion-ext-plans``.
+- ``blaze_tpu.parallel``: hash-partition shuffle (murmur3 pmod on
+  device, sort-by-pid writer, ``.data``/``.index`` files) plus the ICI
+  fast path: ``shard_map`` all-to-all over a ``jax.sharding.Mesh``.
+- ``blaze_tpu.runtime``: memory manager (HBM budget → host RAM → disk
+  spill tiers), per-task runtime, metrics tree, conf mirror.
+- ``blaze_tpu.serde``: the protobuf plan contract (≙ blaze.proto) and
+  ``from_proto`` plan builder.
+
+JAX int64/float64 support is required for decimal and timestamp math;
+we enable x64 at import (all internal dtypes are explicit).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

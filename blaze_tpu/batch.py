@@ -1,0 +1,348 @@
+"""Columnar batches: the unit of data flow between operators.
+
+The reference streams Arrow ``RecordBatch``es between DataFusion
+operators and coalesces them to ``batch_size``
+(``datafusion-ext-commons/src/streams/coalesce_stream.rs``).  Here a
+batch is a set of dense JAX arrays padded to a *bucketed capacity*:
+
+- ``num_rows`` is a host-side int; rows ``[num_rows, capacity)`` are
+  padding (validity False, data zeroed).
+- capacities are powers of two (>= conf.MIN_CAPACITY), so each operator
+  kernel is compiled for at most log2(max/min) shapes — XLA requires
+  static shapes and this is the shape-bucketing strategy from
+  SURVEY.md §7.
+- all device code must treat padding as absent: kernels either mask by
+  ``valid_mask()`` or rely on zeroed padding being a no-op (e.g. sums).
+
+Columns are plain pytrees (data, validity[, lengths]) so whole batches
+can flow through ``jax.jit`` boundaries without host sync; ``num_rows``
+stays static.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import conf
+from .schema import DataType, Field, Schema, TypeKind, string_width_for
+
+Array = Union[jnp.ndarray, np.ndarray]
+
+
+def bucket_capacity(n: int) -> int:
+    """Round row count up to the capacity bucket (power of two)."""
+    cap = int(conf.MIN_CAPACITY.get())
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Column:
+    """One column: data + validity (+ byte lengths for strings).
+
+    ``dtype`` is static metadata (pytree aux), buffers are leaves.
+    """
+
+    dtype: DataType
+    data: Array                       # (cap,) or (cap, W) for strings
+    validity: Array                   # bool (cap,)
+    lengths: Optional[Array] = None   # int32 (cap,) — strings only
+
+    # -- pytree protocol --
+    def tree_flatten(self):
+        if self.lengths is not None:
+            return (self.data, self.validity, self.lengths), (self.dtype, True)
+        return (self.data, self.validity), (self.dtype, False)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        dtype, has_len = aux
+        if has_len:
+            data, validity, lengths = children
+            return cls(dtype, data, validity, lengths)
+        data, validity = children
+        return cls(dtype, data, validity, None)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def to_device(self) -> "Column":
+        as_j = lambda a: a if isinstance(a, jnp.ndarray) else jnp.asarray(a)
+        return Column(
+            self.dtype,
+            as_j(self.data),
+            as_j(self.validity),
+            None if self.lengths is None else as_j(self.lengths),
+        )
+
+    def to_host(self) -> "Column":
+        return Column(
+            self.dtype,
+            np.asarray(self.data),
+            np.asarray(self.validity),
+            None if self.lengths is None else np.asarray(self.lengths),
+        )
+
+    def take(self, indices: Array) -> "Column":
+        """Gather rows by index (indices must point at valid rows or be
+        masked by the caller)."""
+        idx = indices
+        return Column(
+            self.dtype,
+            jnp.take(self.data, idx, axis=0),
+            jnp.take(self.validity, idx, axis=0),
+            None if self.lengths is None else jnp.take(self.lengths, idx, axis=0),
+        )
+
+
+def _pad_1d(a: np.ndarray, cap: int) -> np.ndarray:
+    if a.shape[0] == cap:
+        return a
+    out = np.zeros((cap,) + a.shape[1:], dtype=a.dtype)
+    out[: a.shape[0]] = a
+    return out
+
+
+def column_from_numpy(
+    dtype: DataType,
+    values: np.ndarray,
+    validity: Optional[np.ndarray] = None,
+    capacity: Optional[int] = None,
+) -> Column:
+    n = values.shape[0]
+    cap = capacity or bucket_capacity(n)
+    if validity is None:
+        validity = np.ones(n, dtype=np.bool_)
+    validity = _pad_1d(validity.astype(np.bool_), cap)
+    if dtype.is_string:
+        raise ValueError("use column_from_strings for string columns")
+    data = _pad_1d(values.astype(dtype.np_dtype, copy=False), cap)
+    # zero out invalid rows so padded/invalid data never leaks into kernels
+    data = np.where(validity, data, np.zeros((), dtype=data.dtype))
+    return Column(dtype, data, validity)
+
+
+def column_from_strings(
+    values: Sequence[Optional[Union[str, bytes]]],
+    width: Optional[int] = None,
+    capacity: Optional[int] = None,
+    dtype: Optional[DataType] = None,
+) -> Column:
+    bs = [
+        (v.encode("utf-8") if isinstance(v, str) else v) if v is not None else b""
+        for v in values
+    ]
+    n = len(bs)
+    if width is None:
+        width = (
+            dtype.string_width
+            if dtype is not None
+            else string_width_for(max((len(b) for b in bs), default=1))
+        )
+    if any(len(b) > width for b in bs):
+        raise ValueError(f"string longer than column width {width}")
+    if dtype is None:
+        dtype = DataType.string(width)
+    cap = capacity or bucket_capacity(n)
+    data = np.zeros((cap, width), dtype=np.uint8)
+    lengths = np.zeros(cap, dtype=np.int32)
+    validity = np.zeros(cap, dtype=np.bool_)
+    for i, (v, b) in enumerate(zip(values, bs)):
+        if v is None:
+            continue
+        validity[i] = True
+        lengths[i] = len(b)
+        data[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return Column(dtype, data, validity, lengths)
+
+
+def strings_to_list(col: Column, num_rows: int) -> List[Optional[str]]:
+    data = np.asarray(col.data)
+    lengths = np.asarray(col.lengths)
+    validity = np.asarray(col.validity)
+    out: List[Optional[str]] = []
+    for i in range(num_rows):
+        if not validity[i]:
+            out.append(None)
+        else:
+            out.append(bytes(data[i, : lengths[i]]).decode("utf-8", errors="replace"))
+    return out
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class RecordBatch:
+    """A set of equally-sized columns.  ``schema``/``num_rows`` are
+    static pytree aux data; columns are leaves."""
+
+    schema: Schema
+    columns: List[Column]
+    num_rows: int
+
+    def tree_flatten(self):
+        return tuple(self.columns), (self.schema, self.num_rows)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        schema, num_rows = aux
+        return cls(schema, list(children), num_rows)
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return bucket_capacity(self.num_rows)
+        return self.columns[0].capacity
+
+    def column(self, name: str) -> Column:
+        return self.columns[self.schema.index(name)]
+
+    def valid_mask(self) -> jnp.ndarray:
+        """bool (cap,): True for real (non-padding) rows."""
+        cap = self.capacity
+        return jnp.arange(cap) < self.num_rows
+
+    def to_device(self) -> "RecordBatch":
+        return RecordBatch(self.schema, [c.to_device() for c in self.columns], self.num_rows)
+
+    def to_host(self) -> "RecordBatch":
+        return RecordBatch(self.schema, [c.to_host() for c in self.columns], self.num_rows)
+
+    def select(self, names: Sequence[str]) -> "RecordBatch":
+        cols = [self.column(n) for n in names]
+        fields = [self.schema.field(n) for n in names]
+        return RecordBatch(Schema(fields), cols, self.num_rows)
+
+    def take(self, indices: Array, num_rows: int) -> "RecordBatch":
+        return RecordBatch(self.schema, [c.take(indices) for c in self.columns], num_rows)
+
+    def with_capacity(self, cap: int) -> "RecordBatch":
+        """Pad or shrink buffers to capacity ``cap`` (>= num_rows)."""
+        assert cap >= self.num_rows
+        cols = []
+        for c in self.columns:
+            cur = c.capacity
+            if cur == cap:
+                cols.append(c)
+                continue
+
+            def fix(a):
+                if a is None:
+                    return None
+                if cur < cap:
+                    pad = [(0, cap - cur)] + [(0, 0)] * (a.ndim - 1)
+                    return jnp.pad(a, pad)
+                return a[:cap]
+
+            cols.append(Column(c.dtype, fix(c.data), fix(c.validity), fix(c.lengths)))
+        return RecordBatch(self.schema, cols, self.num_rows)
+
+    def memory_size(self) -> int:
+        """Deep buffer size in bytes (≙ datafusion-ext-commons
+        array_size.rs, which drives spill decisions)."""
+        total = 0
+        for c in self.columns:
+            total += c.data.size * c.data.dtype.itemsize
+            total += c.validity.size
+            if c.lengths is not None:
+                total += c.lengths.size * 4
+        return total
+
+
+def batch_from_pydict(
+    data: Dict[str, Sequence],
+    schema: Schema,
+    capacity: Optional[int] = None,
+) -> RecordBatch:
+    """Build a device batch from python lists (None = null).  Test/IO
+    helper — the hot path stages numpy buffers directly."""
+    n = len(next(iter(data.values()))) if data else 0
+    cap = capacity or bucket_capacity(n)
+    cols: List[Column] = []
+    for f in schema.fields:
+        values = data[f.name]
+        assert len(values) == n
+        if f.dtype.is_string:
+            cols.append(column_from_strings(values, dtype=f.dtype, capacity=cap))
+        else:
+            validity = np.array([v is not None for v in values], dtype=np.bool_)
+            if f.dtype.is_decimal:
+                # python ints/floats are interpreted as logical values and
+                # scaled to the unscaled representation
+                scale = 10 ** f.dtype.scale
+                vals = np.array(
+                    [int(round(v * scale)) if v is not None else 0 for v in values],
+                    dtype=np.int64,
+                )
+            elif f.dtype.kind == TypeKind.BOOL:
+                vals = np.array([bool(v) if v is not None else False for v in values])
+            else:
+                vals = np.array(
+                    [v if v is not None else 0 for v in values],
+                    dtype=f.dtype.np_dtype,
+                )
+            cols.append(column_from_numpy(f.dtype, vals, validity, cap))
+    return RecordBatch(schema, [c.to_device() for c in cols], n)
+
+
+def batch_to_pydict(batch: RecordBatch) -> Dict[str, List]:
+    """Materialize a batch on host as python values (None = null),
+    decimals unscaled->float is NOT done: decimals come back as ints
+    scaled by 10^scale to stay exact."""
+    b = batch.to_host()
+    out: Dict[str, List] = {}
+    for f, c in zip(b.schema.fields, b.columns):
+        if f.dtype.is_string:
+            out[f.name] = strings_to_list(c, b.num_rows)
+        else:
+            vals = []
+            for i in range(b.num_rows):
+                if not c.validity[i]:
+                    vals.append(None)
+                elif f.dtype.kind == TypeKind.BOOL:
+                    vals.append(bool(c.data[i]))
+                elif f.dtype.is_float:
+                    vals.append(float(c.data[i]))
+                else:
+                    vals.append(int(c.data[i]))
+            out[f.name] = vals
+    return out
+
+
+def concat_batches(batches: Sequence[RecordBatch]) -> RecordBatch:
+    """Host-side concatenation (coalesce path)."""
+    assert batches
+    schema = batches[0].schema
+    n = sum(b.num_rows for b in batches)
+    cap = bucket_capacity(n)
+    cols: List[Column] = []
+    for ci, f in enumerate(schema.fields):
+        parts_data, parts_valid, parts_len = [], [], []
+        for b in batches:
+            c = b.columns[ci].to_host()
+            parts_data.append(np.asarray(c.data)[: b.num_rows])
+            parts_valid.append(np.asarray(c.validity)[: b.num_rows])
+            if c.lengths is not None:
+                parts_len.append(np.asarray(c.lengths)[: b.num_rows])
+        if f.dtype.is_string:
+            width = max(p.shape[1] for p in parts_data)
+            data = np.zeros((cap, width), dtype=np.uint8)
+            off = 0
+            for p in parts_data:
+                data[off : off + p.shape[0], : p.shape[1]] = p
+                off += p.shape[0]
+            lengths = _pad_1d(np.concatenate(parts_len), cap)
+            validity = _pad_1d(np.concatenate(parts_valid), cap)
+            cols.append(Column(f.dtype, data, validity, lengths).to_device())
+        else:
+            data = _pad_1d(np.concatenate(parts_data), cap)
+            validity = _pad_1d(np.concatenate(parts_valid), cap)
+            cols.append(Column(f.dtype, data, validity).to_device())
+    return RecordBatch(schema, cols, n)

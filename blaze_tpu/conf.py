@@ -1,0 +1,93 @@
+"""Configuration knobs, mirroring the reference's single-source-of-truth
+Spark conf pattern.
+
+The reference exposes an enum of ``spark.blaze.*`` knobs on the JVM side
+(``spark-extension/.../BlazeConf.java:22-76``) and mirrors each one into
+native code with live JNI static calls
+(``native-engine/blaze-jni-bridge/src/conf.rs:19-91``).  Here the conf
+is a process-global key→value store that the JVM gateway (when embedded
+under Spark) populates from the SparkConf over JNI, and that tests /
+standalone runs populate directly.  Defaults match the reference where
+the knob has a reference equivalent.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_lock = threading.Lock()
+_values: Dict[str, Any] = {}
+
+
+class ConfEntry:
+    """One typed knob.  ``.get()`` reads the live value (env var override
+    ``BLAZE_<NAME>`` > programmatic set > default), like the reference's
+    ``define_conf!`` macro reads SparkConf through a JNI static."""
+
+    def __init__(self, key: str, default: Any, parse: Callable[[str], Any]):
+        self.key = key
+        self.default = default
+        self._parse = parse
+
+    def get(self) -> Any:
+        env_key = "BLAZE_" + self.key.replace("spark.blaze.", "").replace(".", "_").upper()
+        if env_key in os.environ:
+            return self._parse(os.environ[env_key])
+        with _lock:
+            return _values.get(self.key, self.default)
+
+    def set(self, value: Any) -> None:
+        with _lock:
+            _values[self.key] = value
+
+
+def _bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+# ≙ BlazeConf.java defaults: BATCH_SIZE 10000, MEMORY_FRACTION 0.6, etc.
+BATCH_SIZE = ConfEntry("spark.blaze.batchSize", 8192, int)
+MEMORY_FRACTION = ConfEntry("spark.blaze.memoryFraction", 0.6, float)
+ENABLE_PARTIAL_AGG_SKIPPING = ConfEntry("spark.blaze.partialAggSkipping.enable", True, _bool)
+PARTIAL_AGG_SKIPPING_RATIO = ConfEntry("spark.blaze.partialAggSkipping.ratio", 0.8, float)
+PARTIAL_AGG_SKIPPING_MIN_ROWS = ConfEntry("spark.blaze.partialAggSkipping.minRows", 20000, int)
+SPILL_COMPRESSION_CODEC = ConfEntry("spark.blaze.spill.compression.codec", "zlib", str)
+IO_COMPRESSION_CODEC = ConfEntry("spark.io.compression.codec", "zlib", str)
+IGNORE_CORRUPT_FILES = ConfEntry("spark.files.ignoreCorruptFiles", False, _bool)
+PARQUET_FILTER_PUSHDOWN = ConfEntry("spark.blaze.parquet.enable.pageFiltering", True, _bool)
+INPUT_BATCH_STATISTICS = ConfEntry("spark.blaze.inputBatchStatistics", False, _bool)
+UDF_WRAPPER_NUM_THREADS = ConfEntry("spark.blaze.udfWrapperNumThreads", 1, int)
+SMJ_FALLBACK_ENABLE = ConfEntry("spark.blaze.smjfallback.enable", True, _bool)
+SUGGESTED_BATCH_MEM_SIZE = ConfEntry("spark.blaze.suggested.batch.mem.size", 8 << 20, int)
+TOKIO_NUM_WORKER_THREADS = ConfEntry("spark.blaze.tokio.num.worker.threads", 2, int)
+
+# TPU-specific knobs (no reference equivalent).
+ON_DEVICE = ConfEntry("spark.blaze.tpu.onDevice", True, _bool)
+DEVICE_MEMORY_BUDGET = ConfEntry("spark.blaze.tpu.hbmBudget", 8 << 30, int)
+HOST_SPILL_BUDGET = ConfEntry("spark.blaze.tpu.hostSpillBudget", 4 << 30, int)
+MIN_CAPACITY = ConfEntry("spark.blaze.tpu.minBatchCapacity", 1024, int)
+
+# Per-operator enable flags, ≙ BlazeConverters.scala:82-120
+# (spark.blaze.enable.scan / .project / .filter / ...).
+_OP_FLAGS: Dict[str, ConfEntry] = {}
+
+
+def op_enabled(name: str) -> bool:
+    entry = _OP_FLAGS.get(name)
+    if entry is None:
+        entry = ConfEntry(f"spark.blaze.enable.{name}", True, _bool)
+        _OP_FLAGS[name] = entry
+    return entry.get()
+
+
+def set_conf(key: str, value: Any) -> None:
+    """Entry point for the gateway / tests to inject Spark conf values."""
+    with _lock:
+        _values[key] = value
+
+
+def get_conf(key: str, default: Any = None) -> Any:
+    with _lock:
+        return _values.get(key, default)

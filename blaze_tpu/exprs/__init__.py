@@ -1,0 +1,37 @@
+"""Expression layer: Spark-semantics expression IR compiled to pure JAX
+functions over columns.
+
+≙ reference crates ``datafusion-ext-exprs`` (custom PhysicalExprs) and
+``datafusion-ext-functions`` (spark ext functions), plus the expression
+subset of ``blaze-serde`` (PhysicalExprNode).  The key difference is
+architectural: instead of interpreting an expression tree per batch, we
+*compile* each operator's expression set into one JAX function, so XLA
+fuses the whole projection/predicate into a single TPU program
+(SURVEY.md §7: "project = fused elementwise").
+"""
+
+from .ir import (
+    BinOp,
+    Case,
+    Cast,
+    Col,
+    Expr,
+    InList,
+    IsNotNull,
+    IsNull,
+    Like,
+    Lit,
+    Not,
+    ScalarFunc,
+    and_,
+    col,
+    lit,
+    or_,
+)
+from .compile import compile_expr, compile_exprs, infer_dtype
+
+__all__ = [
+    "Expr", "Col", "Lit", "BinOp", "Not", "IsNull", "IsNotNull", "Cast",
+    "Case", "InList", "Like", "ScalarFunc", "col", "lit", "and_", "or_",
+    "compile_expr", "compile_exprs", "infer_dtype",
+]

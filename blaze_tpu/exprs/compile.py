@@ -1,0 +1,583 @@
+"""Expression lowering: IR -> pure JAX functions over Columns.
+
+Spark 3-valued null logic is carried as (data, validity) pairs.
+Invariants:
+
+- a column's data in *invalid* rows may be garbage; every lowering must
+  be garbage-safe (logic ops mask by validity, divisions use safe
+  divisors, aggregations mask).
+- padding rows are invalid, so kernels need no separate padding mask.
+
+Division semantics are Spark non-ANSI: x/0 -> null, int `/` -> double,
+decimal `/` -> decimal with Spark's result scale.  Decimal division
+beyond int64 range is computed through float64 (documented deviation
+from the reference's i128; roadmap: two-limb int128 emulation).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..batch import Column
+from ..schema import (
+    DataType,
+    Schema,
+    TypeKind,
+    decimal_add_type,
+    decimal_div_type,
+    decimal_mul_type,
+    string_width_for,
+)
+from . import strings as S
+from .cast import decimal_overflow_null, lower_cast, rescale_decimal
+from .ir import (
+    Alias,
+    BinOp,
+    Case,
+    Cast,
+    Col,
+    Expr,
+    InList,
+    IsNotNull,
+    IsNull,
+    Like,
+    Lit,
+    Not,
+    ScalarFunc,
+)
+
+_RANK = {
+    TypeKind.INT8: 0,
+    TypeKind.INT16: 1,
+    TypeKind.INT32: 2,
+    TypeKind.INT64: 3,
+    TypeKind.FLOAT32: 4,
+    TypeKind.FLOAT64: 5,
+}
+_INT_DECIMAL_PRECISION = {
+    TypeKind.BOOL: 1,
+    TypeKind.INT8: 3,
+    TypeKind.INT16: 5,
+    TypeKind.INT32: 10,
+    TypeKind.INT64: 20,
+}
+
+_CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
+_LOGIC_OPS = ("and", "or")
+_ARITH_OPS = ("+", "-", "*", "/", "%")
+
+
+# ------------------------------------------------------------- inference
+
+def infer_lit_dtype(value, dtype: Optional[DataType]) -> DataType:
+    if dtype is not None:
+        return dtype
+    if value is None:
+        return DataType.null()
+    if isinstance(value, bool):
+        return DataType.bool_()
+    if isinstance(value, int):
+        return DataType.int32() if -(2**31) <= value < 2**31 else DataType.int64()
+    if isinstance(value, float):
+        return DataType.float64()
+    if isinstance(value, str):
+        return DataType.string(string_width_for(len(value.encode("utf-8"))))
+    if isinstance(value, bytes):
+        return DataType.binary(string_width_for(len(value)))
+    if isinstance(value, datetime.date):
+        return DataType.date32()
+    raise TypeError(f"cannot infer literal type of {value!r}")
+
+
+def _common_type(a: DataType, b: DataType) -> DataType:
+    if a == b:
+        return a
+    if a.kind == TypeKind.NULL:
+        return b
+    if b.kind == TypeKind.NULL:
+        return a
+    if a.is_string and b.is_string:
+        return DataType.string(max(a.string_width, b.string_width))
+    if a.is_decimal or b.is_decimal:
+        if a.is_float or b.is_float:
+            return DataType.float64()
+        da = a if a.is_decimal else DataType.decimal(_INT_DECIMAL_PRECISION[a.kind], 0)
+        db = b if b.is_decimal else DataType.decimal(_INT_DECIMAL_PRECISION[b.kind], 0)
+        scale = max(da.scale, db.scale)
+        intd = max(da.precision - da.scale, db.precision - db.scale)
+        return DataType.decimal(min(intd + scale, 38), scale)
+    if a.kind in _RANK and b.kind in _RANK:
+        return a if _RANK[a.kind] >= _RANK[b.kind] else b
+    if a.kind == b.kind:
+        return a
+    raise TypeError(f"no common type for {a!r} and {b!r}")
+
+
+def infer_dtype(expr: Expr, schema: Schema) -> DataType:
+    if isinstance(expr, Col):
+        return schema.field(expr.name).dtype
+    if isinstance(expr, Alias):
+        return infer_dtype(expr.child, schema)
+    if isinstance(expr, Lit):
+        return infer_lit_dtype(expr.value, expr.dtype)
+    if isinstance(expr, Cast):
+        return expr.to
+    if isinstance(expr, (IsNull, IsNotNull, Not, InList, Like)):
+        return DataType.bool_()
+    if isinstance(expr, BinOp):
+        if expr.op in _CMP_OPS or expr.op in _LOGIC_OPS:
+            return DataType.bool_()
+        lt = infer_dtype(expr.left, schema)
+        rt = infer_dtype(expr.right, schema)
+        if lt.is_decimal or rt.is_decimal:
+            if lt.is_float or rt.is_float:
+                return DataType.float64()
+            ld = lt if lt.is_decimal else DataType.decimal(_INT_DECIMAL_PRECISION[lt.kind], 0)
+            rd = rt if rt.is_decimal else DataType.decimal(_INT_DECIMAL_PRECISION[rt.kind], 0)
+            if expr.op in ("+", "-"):
+                return decimal_add_type(ld, rd)
+            if expr.op == "*":
+                return decimal_mul_type(ld, rd)
+            if expr.op == "/":
+                return decimal_div_type(ld, rd)
+            return DataType.decimal(max(ld.precision, rd.precision), max(ld.scale, rd.scale))
+        if expr.op == "/":
+            return DataType.float64()
+        return _common_type(lt, rt)
+    if isinstance(expr, Case):
+        t = DataType.null()
+        for _, v in expr.branches:
+            t = _common_type(t, infer_dtype(v, schema))
+        if expr.else_ is not None:
+            t = _common_type(t, infer_dtype(expr.else_, schema))
+        return t
+    if isinstance(expr, ScalarFunc):
+        from .functions import infer_func_dtype
+
+        return infer_func_dtype(expr, schema)
+    raise TypeError(f"cannot infer type of {expr!r}")
+
+
+# ------------------------------------------------------------- lowering
+
+def _coerce(col: Column, to: DataType) -> Column:
+    if col.dtype == to:
+        return col
+    if col.dtype.kind == TypeKind.NULL:
+        n = col.data.shape[0]
+        if to.is_string:
+            return Column(
+                to,
+                jnp.zeros((n, to.string_width), jnp.uint8),
+                jnp.zeros(n, jnp.bool_),
+                jnp.zeros(n, jnp.int32),
+            )
+        return Column(to, jnp.zeros(n, to.np_dtype), jnp.zeros(n, jnp.bool_))
+    if to.is_string and col.dtype.is_string:
+        if to.string_width == col.data.shape[1]:
+            return Column(to, col.data, col.validity, col.lengths)
+        return Column(to, S._pad_to(col.data, to.string_width), col.validity, col.lengths)
+    return lower_cast(col, to)
+
+
+def _lit_column(value, dtype: DataType, n: int) -> Column:
+    if value is None:
+        return _coerce(Column(DataType.null(), jnp.zeros(n, jnp.bool_), jnp.zeros(n, jnp.bool_)), dtype)
+    valid = jnp.ones(n, jnp.bool_)
+    if dtype.is_string:
+        b = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+        w = dtype.string_width
+        row = np.zeros(w, np.uint8)
+        row[: len(b)] = np.frombuffer(b, np.uint8)
+        data = jnp.broadcast_to(jnp.asarray(row), (n, w))
+        return Column(dtype, data, valid, jnp.full(n, len(b), jnp.int32))
+    if dtype.is_decimal:
+        if isinstance(value, str):
+            from decimal import Decimal
+
+            unscaled = int(Decimal(value).scaleb(dtype.scale).to_integral_value())
+        elif isinstance(value, float):
+            unscaled = int(round(value * 10**dtype.scale))
+        else:
+            unscaled = int(value) * 10**dtype.scale
+        return Column(dtype, jnp.full(n, unscaled, jnp.int64), valid)
+    if dtype.kind == TypeKind.DATE32:
+        if isinstance(value, str):
+            value = datetime.date.fromisoformat(value)
+        if isinstance(value, datetime.date):
+            value = (value - datetime.date(1970, 1, 1)).days
+        return Column(dtype, jnp.full(n, int(value), jnp.int32), valid)
+    return Column(dtype, jnp.full(n, value, dtype.np_dtype), valid)
+
+
+def _decimal_binop(op: str, l: Column, r: Column) -> Column:
+    ld = l if l.dtype.is_decimal else _coerce(l, DataType.decimal(_INT_DECIMAL_PRECISION[l.dtype.kind], 0))
+    rd = r if r.dtype.is_decimal else _coerce(r, DataType.decimal(_INT_DECIMAL_PRECISION[r.dtype.kind], 0))
+    validity = ld.validity & rd.validity
+    if op in ("+", "-"):
+        out_t = decimal_add_type(ld.dtype, rd.dtype)
+        a = rescale_decimal(ld.data, ld.dtype.scale, out_t.scale)
+        b = rescale_decimal(rd.data, rd.dtype.scale, out_t.scale)
+        data = a + b if op == "+" else a - b
+        return Column(out_t, data, decimal_overflow_null(data, validity, out_t.precision))
+    if op == "*":
+        out_t = decimal_mul_type(ld.dtype, rd.dtype)
+        raw_scale = ld.dtype.scale + rd.dtype.scale
+        data = ld.data * rd.data
+        if out_t.scale != raw_scale:
+            data = rescale_decimal(data, raw_scale, out_t.scale)
+        return Column(out_t, data, decimal_overflow_null(data, validity, out_t.precision))
+    if op == "/":
+        out_t = decimal_div_type(ld.dtype, rd.dtype)
+        validity = validity & (rd.data != 0)
+        shift = out_t.scale - ld.dtype.scale + rd.dtype.scale
+        # exact int64 path only when the shifted numerator provably fits
+        if ld.dtype.precision + shift <= 18:
+            num = ld.data * jnp.int64(10**shift)
+            den = jnp.where(rd.data == 0, jnp.int64(1), rd.data)
+            half = jnp.abs(den) // 2
+            adj = jnp.where(num >= 0, num + jnp.sign(den) * half, num - jnp.sign(den) * half)
+            q = jnp.where(
+                (adj >= 0) == (den > 0),
+                jnp.abs(adj) // jnp.abs(den),
+                -(jnp.abs(adj) // jnp.abs(den)),
+            )
+            return Column(out_t, q, validity)
+        fa = ld.data.astype(jnp.float64) / float(10**ld.dtype.scale)
+        fb = rd.data.astype(jnp.float64) / float(10**rd.dtype.scale)
+        fb = jnp.where(fb == 0, 1.0, fb)
+        q = fa / fb * float(10**out_t.scale)
+        data = jnp.where(q >= 0, jnp.floor(q + 0.5), jnp.ceil(q - 0.5)).astype(jnp.int64)
+        return Column(out_t, data, validity)
+    if op == "%":
+        scale = max(ld.dtype.scale, rd.dtype.scale)
+        out_t = DataType.decimal(min(38, max(ld.dtype.precision, rd.dtype.precision)), scale)
+        a = rescale_decimal(ld.data, ld.dtype.scale, scale)
+        b = rescale_decimal(rd.data, rd.dtype.scale, scale)
+        validity = validity & (b != 0)
+        b = jnp.where(b == 0, jnp.int64(1), b)
+        import jax.lax as lax
+
+        return Column(out_t, lax.rem(a, b), validity)
+    raise NotImplementedError(op)
+
+
+def _arith(op: str, l: Column, r: Column) -> Column:
+    if l.dtype.is_decimal or r.dtype.is_decimal:
+        if l.dtype.is_float or r.dtype.is_float:
+            l = _coerce(l, DataType.float64())
+            r = _coerce(r, DataType.float64())
+        else:
+            return _decimal_binop(op, l, r)
+    validity = l.validity & r.validity
+    if op == "/":
+        l = _coerce(l, DataType.float64())
+        r = _coerce(r, DataType.float64())
+        validity = validity & (r.data != 0.0)
+        den = jnp.where(r.data == 0.0, 1.0, r.data)
+        return Column(DataType.float64(), l.data / den, validity)
+    common = _common_type(l.dtype, r.dtype)
+    l = _coerce(l, common)
+    r = _coerce(r, common)
+    if op == "+":
+        data = l.data + r.data
+    elif op == "-":
+        data = l.data - r.data
+    elif op == "*":
+        data = l.data * r.data
+    elif op == "%":
+        import jax.lax as lax
+
+        if common.is_float:
+            validity = validity & (r.data != 0.0)
+            den = jnp.where(r.data == 0.0, jnp.asarray(1.0, r.data.dtype), r.data)
+        else:
+            validity = validity & (r.data != 0)
+            den = jnp.where(r.data == 0, jnp.asarray(1, r.data.dtype), r.data)
+        data = lax.rem(l.data, den)
+    else:
+        raise NotImplementedError(op)
+    return Column(common, data, validity)
+
+
+def _cmp(op: str, l: Column, r: Column) -> Column:
+    validity = l.validity & r.validity
+    if l.dtype.is_string or r.dtype.is_string:
+        if op == "==":
+            v = S.str_eq(l, r)
+        elif op == "!=":
+            v = ~S.str_eq(l, r)
+        elif op == "<":
+            v = S.str_lt(l, r)
+        elif op == "<=":
+            v = S.str_le(l, r)
+        elif op == ">":
+            v = S.str_lt(r, l)
+        else:
+            v = S.str_le(r, l)
+        return Column(DataType.bool_(), v, validity)
+    if l.dtype.is_decimal or r.dtype.is_decimal:
+        common = _common_type(l.dtype, r.dtype)
+        l = _coerce(l, common)
+        r = _coerce(r, common)
+    else:
+        common = _common_type(l.dtype, r.dtype)
+        l = _coerce(l, common)
+        r = _coerce(r, common)
+    a, b = l.data, r.data
+    if op == "==":
+        v = a == b
+    elif op == "!=":
+        v = a != b
+    elif op == "<":
+        v = a < b
+    elif op == "<=":
+        v = a <= b
+    elif op == ">":
+        v = a > b
+    else:
+        v = a >= b
+    return Column(DataType.bool_(), v, validity)
+
+
+def _logic(op: str, l: Column, r: Column) -> Column:
+    la = l.validity & l.data.astype(jnp.bool_)
+    lf = l.validity & ~l.data.astype(jnp.bool_)
+    ra = r.validity & r.data.astype(jnp.bool_)
+    rf = r.validity & ~r.data.astype(jnp.bool_)
+    if op == "and":
+        validity = (l.validity & r.validity) | lf | rf
+        value = la & ra
+    else:
+        validity = (l.validity & r.validity) | la | ra
+        value = la | ra
+    return Column(DataType.bool_(), value, validity)
+
+
+def lower(expr: Expr, schema: Schema, cols: Dict[str, Column], n: int) -> Column:
+    """Recursively lower an expression against resolved input columns.
+    Runs under jax tracing; must stay functional and shape-static."""
+    if isinstance(expr, Col):
+        return cols[expr.name]
+    if isinstance(expr, Alias):
+        return lower(expr.child, schema, cols, n)
+    if isinstance(expr, Lit):
+        return _lit_column(expr.value, infer_lit_dtype(expr.value, expr.dtype), n)
+    if isinstance(expr, Cast):
+        return lower_cast(lower(expr.child, schema, cols, n), expr.to)
+    if isinstance(expr, Not):
+        c = lower(expr.child, schema, cols, n)
+        return Column(DataType.bool_(), ~c.data.astype(jnp.bool_), c.validity)
+    if isinstance(expr, IsNull):
+        c = lower(expr.child, schema, cols, n)
+        return Column(DataType.bool_(), ~c.validity, jnp.ones_like(c.validity))
+    if isinstance(expr, IsNotNull):
+        c = lower(expr.child, schema, cols, n)
+        return Column(DataType.bool_(), c.validity, jnp.ones_like(c.validity))
+    if isinstance(expr, BinOp):
+        l = lower(expr.left, schema, cols, n)
+        r = lower(expr.right, schema, cols, n)
+        if expr.op in _LOGIC_OPS:
+            return _logic(expr.op, l, r)
+        if expr.op in _CMP_OPS:
+            return _cmp(expr.op, l, r)
+        return _arith(expr.op, l, r)
+    if isinstance(expr, InList):
+        c = lower(expr.child, schema, cols, n)
+        acc = None
+        for v in expr.values:
+            eq = _cmp("==", c, lower(v, schema, cols, n))
+            acc = eq if acc is None else _logic("or", acc, eq)
+        if expr.negated:
+            return Column(DataType.bool_(), ~acc.data.astype(jnp.bool_), acc.validity)
+        return acc
+    if isinstance(expr, Like):
+        return _lower_like(expr, schema, cols, n)
+    if isinstance(expr, Case):
+        return _lower_case(expr, schema, cols, n)
+    if isinstance(expr, ScalarFunc):
+        from .functions import lower_func
+
+        return lower_func(expr, schema, cols, n, lower)
+    raise NotImplementedError(f"lowering of {type(expr).__name__}")
+
+
+def _lower_case(expr: Case, schema, cols, n) -> Column:
+    out_t = infer_dtype(expr, schema)
+    if expr.else_ is not None:
+        result = _coerce(lower(expr.else_, schema, cols, n), out_t)
+    else:
+        result = _lit_column(None, out_t, n)
+    for cond, val in reversed(expr.branches):
+        c = lower(cond, schema, cols, n)
+        v = _coerce(lower(val, schema, cols, n), out_t)
+        picked = c.validity & c.data.astype(jnp.bool_)
+        if out_t.is_string:
+            data = jnp.where(picked[:, None], S._pad_to(v.data, result.data.shape[1]), result.data)
+            lengths = jnp.where(picked, v.lengths, result.lengths)
+            result = Column(out_t, data, jnp.where(picked, v.validity, result.validity), lengths)
+        else:
+            result = Column(
+                out_t,
+                jnp.where(picked, v.data, result.data),
+                jnp.where(picked, v.validity, result.validity),
+            )
+    return result
+
+
+def like_pattern_parts(pattern: str) -> Optional[List[bytes]]:
+    """Split a LIKE pattern on ``%``; None if it contains ``_`` (host
+    fallback).  Returns segment list; empty leading/trailing segments
+    encode anchoring."""
+    if "_" in pattern:
+        return None
+    return [p.encode("utf-8") for p in pattern.split("%")]
+
+
+def _lower_like(expr: Like, schema, cols, n) -> Column:
+    c = lower(expr.child, schema, cols, n)
+    parts = like_pattern_parts(expr.pattern)
+    if parts is None:
+        raise NotImplementedError(
+            "LIKE with '_' requires host fallback (split_host_exprs)"
+        )
+    if len(parts) == 1:
+        v = S.str_eq(c, _lit_column(parts[0], DataType.string(max(8, c.data.shape[1])), n))
+        v = v & (c.lengths == len(parts[0]))
+    else:
+        v = jnp.ones(n, jnp.bool_)
+        if parts[0]:
+            v = v & S.starts_with(c, parts[0])
+        if parts[-1]:
+            v = v & S.ends_with(c, parts[-1])
+        middle = [p for p in parts[1:-1] if p]
+        if len(middle) == 1 and not parts[0] and not parts[-1]:
+            v = S.contains(c, middle[0])
+        elif middle:
+            # multi-segment: conservative device approximation is wrong;
+            # planner must route through split_host_exprs
+            raise NotImplementedError("multi-segment LIKE requires host fallback")
+        # length must cover anchored parts
+        v = v & (c.lengths >= sum(len(p) for p in parts))
+    if expr.negated:
+        v = ~v
+    return Column(DataType.bool_(), v, c.validity)
+
+
+# ------------------------------------------------- host-fallback support
+
+def needs_host(expr: Expr) -> bool:
+    """Does this tree contain a node only evaluable on host?  ≙ the
+    reference's convertExprWithFallback wrapping unconvertible exprs
+    into a JVM-callback UDF (NativeConverters.scala:407)."""
+    if isinstance(expr, Like):
+        parts = like_pattern_parts(expr.pattern)
+        if parts is None:
+            return True
+        middle = [p for p in parts[1:-1] if p]
+        if middle and (len(middle) > 1 or parts[0] or parts[-1]):
+            return True
+    children: List[Expr] = []
+    if isinstance(expr, (Not, IsNull, IsNotNull, Alias)):
+        children = [expr.child]
+    elif isinstance(expr, Cast):
+        children = [expr.child]
+    elif isinstance(expr, BinOp):
+        children = [expr.left, expr.right]
+    elif isinstance(expr, InList):
+        children = [expr.child] + expr.values
+    elif isinstance(expr, Like):
+        children = [expr.child]
+    elif isinstance(expr, Case):
+        children = [c for b in expr.branches for c in b] + ([expr.else_] if expr.else_ else [])
+    elif isinstance(expr, ScalarFunc):
+        children = expr.args
+    return any(needs_host(c) for c in children)
+
+
+def split_host_exprs(exprs: List[Expr]) -> Tuple[List[Expr], List[Tuple[str, Expr]]]:
+    """Replace host-only subtrees with synthetic column refs.  The
+    operator evaluates the extracted subtrees on host per batch and
+    injects them as extra input columns before the jitted kernel."""
+    host_parts: List[Tuple[str, Expr]] = []
+
+    def walk(e: Expr) -> Expr:
+        if isinstance(e, Like) and needs_host(e) and not needs_host(e.child):
+            name = f"__host_{len(host_parts)}"
+            host_parts.append((name, e))
+            return Col(name)
+        if isinstance(e, (Not,)):
+            return Not(walk(e.child))
+        if isinstance(e, IsNull):
+            return IsNull(walk(e.child))
+        if isinstance(e, IsNotNull):
+            return IsNotNull(walk(e.child))
+        if isinstance(e, Alias):
+            return Alias(walk(e.child), e.name)
+        if isinstance(e, Cast):
+            return Cast(walk(e.child), e.to)
+        if isinstance(e, BinOp):
+            return BinOp(e.op, walk(e.left), walk(e.right))
+        if isinstance(e, InList):
+            return InList(walk(e.child), [walk(v) for v in e.values], e.negated)
+        if isinstance(e, Case):
+            return Case([(walk(c), walk(v)) for c, v in e.branches], walk(e.else_) if e.else_ else None)
+        if isinstance(e, ScalarFunc):
+            return ScalarFunc(e.name, [walk(a) for a in e.args])
+        return e
+
+    new = [walk(e) for e in exprs]
+    return new, host_parts
+
+
+def host_eval(expr: Expr, batch) -> Column:
+    """Evaluate a host-fallback expression on the host (numpy/python).
+    Currently: LIKE patterns beyond the device subset."""
+    import re
+
+    from ..batch import column_from_numpy, strings_to_list
+
+    if isinstance(expr, Like):
+        child = expr.child
+        assert isinstance(child, Col), "host LIKE only over direct columns"
+        col = batch.column(child.name)
+        vals = strings_to_list(col.to_host(), batch.num_rows)
+        rx = re.compile(
+            "^" + "".join(".*" if ch == "%" else "." if ch == "_" else re.escape(ch) for ch in expr.pattern) + "$",
+            re.DOTALL,
+        )
+        out = np.zeros(batch.capacity, np.bool_)
+        validity = np.zeros(batch.capacity, np.bool_)
+        for i, v in enumerate(vals):
+            if v is None:
+                continue
+            validity[i] = True
+            m = bool(rx.match(v))
+            out[i] = (not m) if expr.negated else m
+        return column_from_numpy(DataType.bool_(), out, validity, batch.capacity).to_device()
+    raise NotImplementedError(f"host eval of {type(expr).__name__}")
+
+
+# ------------------------------------------------------------ public API
+
+@dataclass
+class CompiledExpr:
+    dtype: DataType
+    expr: Expr
+    schema: Schema
+
+    def __call__(self, cols: Dict[str, Column], n: int) -> Column:
+        return lower(self.expr, self.schema, cols, n)
+
+
+def compile_expr(expr: Expr, schema: Schema) -> CompiledExpr:
+    return CompiledExpr(infer_dtype(expr, schema), expr, schema)
+
+
+def compile_exprs(exprs: List[Expr], schema: Schema) -> List[CompiledExpr]:
+    return [compile_expr(e, schema) for e in exprs]

@@ -1,0 +1,260 @@
+"""Scalar function registry.
+
+≙ reference ``datafusion-ext-functions`` (create_spark_ext_function,
+lib.rs:34-59) — functions are resolved by name so the plan serde can
+carry them as strings, and new ones register without touching the
+lowering core.
+
+Date math uses Howard Hinnant's civil-calendar algorithms (pure integer
+ops — exact and branch-free, ideal for the VPU).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..batch import Column
+from ..schema import DataType, Schema, TypeKind
+from .ir import Expr, Lit, ScalarFunc
+
+_REGISTRY: Dict[str, Callable] = {}
+_TYPES: Dict[str, Callable] = {}
+
+
+def register(name: str, infer: Callable):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        _TYPES[name] = infer
+        return fn
+
+    return deco
+
+
+def infer_func_dtype(expr: ScalarFunc, schema: Schema) -> DataType:
+    if expr.name not in _TYPES:
+        raise KeyError(f"unknown function {expr.name!r}")
+    from .compile import infer_dtype
+
+    arg_types = [infer_dtype(a, schema) for a in expr.args]
+    return _TYPES[expr.name](expr, arg_types)
+
+
+def lower_func(expr: ScalarFunc, schema, cols, n, lower_fn) -> Column:
+    if expr.name not in _REGISTRY:
+        raise KeyError(f"unknown function {expr.name!r}")
+    return _REGISTRY[expr.name](expr, schema, cols, n, lower_fn)
+
+
+# ----------------------------------------------------------- date parts
+
+def _civil_from_days(days):
+    """date32 -> (year, month, day), Hinnant's civil_from_days."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def _date_part(which: int):
+    def fn(expr, schema, cols, n, lower_fn):
+        c = lower_fn(expr.args[0], schema, cols, n)
+        y, m, d = _civil_from_days(c.data)
+        return Column(DataType.int32(), (y, m, d)[which], c.validity)
+
+    return fn
+
+
+_int32_t = lambda e, ts: DataType.int32()
+register("year", _int32_t)(_date_part(0))
+register("month", _int32_t)(_date_part(1))
+register("day", _int32_t)(_date_part(2))
+
+
+# --------------------------------------------------------------- string
+
+def _substring_t(e, ts):
+    pos = e.args[1].value
+    ln = e.args[2].value if len(e.args) > 2 else None
+    w = ts[0].string_width
+    if ln is not None:
+        w = min(w, max(8, int(ln)))
+    from ..schema import string_width_for
+
+    return DataType.string(string_width_for(w))
+
+
+@register("substring", _substring_t)
+def _substring(expr, schema, cols, n, lower_fn):
+    # Spark substring is 1-based; only literal pos/len supported on
+    # device (dynamic pos/len would need per-row gather — host fallback)
+    c = lower_fn(expr.args[0], schema, cols, n)
+    assert isinstance(expr.args[1], Lit), "substring pos must be literal"
+    pos = int(expr.args[1].value)
+    length = int(expr.args[2].value) if len(expr.args) > 2 else c.data.shape[1]
+    start = pos - 1 if pos > 0 else max(0, c.data.shape[1] + pos)
+    out_t = _substring_t(expr, [c.dtype])
+    w = out_t.string_width
+    end = min(start + length, c.data.shape[1])
+    data = c.data[:, start:end]
+    if data.shape[1] < w:
+        data = jnp.pad(data, ((0, 0), (0, w - data.shape[1])))
+    else:
+        data = data[:, :w]
+    new_len = jnp.clip(c.lengths - start, 0, min(length, w)).astype(jnp.int32)
+    # zero the tail beyond new_len so padding stays canonical
+    mask = jnp.arange(w)[None, :] < new_len[:, None]
+    data = jnp.where(mask, data, 0).astype(jnp.uint8)
+    return Column(out_t, data, c.validity, new_len)
+
+
+@register("length", _int32_t)
+def _length(expr, schema, cols, n, lower_fn):
+    # char length: count utf8 non-continuation bytes
+    c = lower_fn(expr.args[0], schema, cols, n)
+    is_cont = (c.data & 0xC0) == 0x80
+    w = c.data.shape[1]
+    in_str = jnp.arange(w)[None, :] < c.lengths[:, None]
+    chars = jnp.sum((in_str & ~is_cont).astype(jnp.int32), axis=1)
+    return Column(DataType.int32(), chars, c.validity)
+
+
+def _str_passthrough_t(e, ts):
+    return ts[0]
+
+
+def _case_shift(expr, schema, cols, n, lower_fn, to_upper: bool):
+    c = lower_fn(expr.args[0], schema, cols, n)
+    d = c.data
+    if to_upper:
+        shift = ((d >= ord("a")) & (d <= ord("z"))).astype(jnp.uint8) * 32
+        d = d - shift
+    else:
+        shift = ((d >= ord("A")) & (d <= ord("Z"))).astype(jnp.uint8) * 32
+        d = d + shift
+    return Column(c.dtype, d, c.validity, c.lengths)
+
+
+register("upper", _str_passthrough_t)(
+    lambda e, s, c, n, lf: _case_shift(e, s, c, n, lf, True)
+)
+register("lower", _str_passthrough_t)(
+    lambda e, s, c, n, lf: _case_shift(e, s, c, n, lf, False)
+)
+
+
+def _concat_t(e, ts):
+    from ..schema import string_width_for
+
+    return DataType.string(string_width_for(sum(t.string_width for t in ts)))
+
+
+@register("concat", _concat_t)
+def _concat(expr, schema, cols, n, lower_fn):
+    parts = [lower_fn(a, schema, cols, n) for a in expr.args]
+    out_t = _concat_t(expr, [p.dtype for p in parts])
+    w = out_t.string_width
+    data = jnp.zeros((n, w), jnp.uint8)
+    lengths = jnp.zeros(n, jnp.int32)
+    validity = jnp.ones(n, jnp.bool_)
+    pos = jnp.arange(w)[None, :]
+    for p in parts:
+        validity = validity & p.validity
+        pw = p.data.shape[1]
+        src = jnp.pad(p.data, ((0, 0), (0, w - pw))) if pw < w else p.data[:, :w]
+        # place src at per-row offset `lengths` via gather
+        idx = jnp.clip(pos - lengths[:, None], 0, src.shape[1] - 1)
+        shifted = jnp.take_along_axis(src, idx, axis=1)
+        write = (pos >= lengths[:, None]) & (pos < (lengths + p.lengths)[:, None])
+        data = jnp.where(write, shifted, data)
+        lengths = lengths + p.lengths
+    lengths = jnp.minimum(lengths, w)
+    return Column(out_t, data.astype(jnp.uint8), validity, lengths)
+
+
+# -------------------------------------------------------------- numeric
+
+def _same_t(e, ts):
+    return ts[0]
+
+
+@register("abs", _same_t)
+def _abs(expr, schema, cols, n, lower_fn):
+    c = lower_fn(expr.args[0], schema, cols, n)
+    return Column(c.dtype, jnp.abs(c.data), c.validity)
+
+
+@register("negative", _same_t)
+def _negative(expr, schema, cols, n, lower_fn):
+    c = lower_fn(expr.args[0], schema, cols, n)
+    return Column(c.dtype, -c.data, c.validity)
+
+
+def _round_t(e, ts):
+    t = ts[0]
+    if t.is_decimal:
+        s = int(e.args[1].value) if len(e.args) > 1 else 0
+        return DataType.decimal(t.precision, min(t.scale, max(s, 0)))
+    return t
+
+
+@register("round", _round_t)
+def _round(expr, schema, cols, n, lower_fn):
+    from .cast import rescale_decimal
+
+    c = lower_fn(expr.args[0], schema, cols, n)
+    s = int(expr.args[1].value) if len(expr.args) > 1 else 0
+    if c.dtype.is_decimal:
+        out_t = _round_t(expr, [c.dtype])
+        data = rescale_decimal(c.data, c.dtype.scale, out_t.scale)
+        return Column(out_t, data, c.validity)
+    if c.dtype.is_float:
+        f = 10.0**s
+        scaled = c.data * f
+        data = jnp.where(scaled >= 0, jnp.floor(scaled + 0.5), jnp.ceil(scaled - 0.5)) / f
+        return Column(c.dtype, data.astype(c.data.dtype), c.validity)
+    return c
+
+
+def _coalesce_t(e, ts):
+    from .compile import _common_type
+
+    t = ts[0]
+    for u in ts[1:]:
+        t = _common_type(t, u)
+    return t
+
+
+@register("coalesce", _coalesce_t)
+def _coalesce(expr, schema, cols, n, lower_fn):
+    from .compile import _coerce
+
+    parts = [lower_fn(a, schema, cols, n) for a in expr.args]
+    out_t = _coalesce_t(expr, [p.dtype for p in parts])
+    parts = [_coerce(p, out_t) for p in parts]
+    result = parts[-1]
+    for p in reversed(parts[:-1]):
+        take = p.validity
+        if out_t.is_string:
+            result = Column(
+                out_t,
+                jnp.where(take[:, None], p.data, result.data),
+                jnp.where(take, p.validity, result.validity),
+                jnp.where(take, p.lengths, result.lengths),
+            )
+        else:
+            result = Column(
+                out_t,
+                jnp.where(take, p.data, result.data),
+                jnp.where(take, p.validity, result.validity),
+            )
+    return result

@@ -1,0 +1,289 @@
+"""Spark-exact hashes, vectorized for the TPU VPU.
+
+≙ reference ``datafusion-ext-commons/src/spark_hash.rs`` (murmur3 with
+seed 42 — the partitioning + HashJoin hash) and ``hash/xxhash.rs``.
+Spark semantics being bit-exact here is a correctness gate: shuffle
+partition ids must match what vanilla Spark computes or mixed
+native/JVM stages break (SURVEY.md §7 "Spark-exact semantics").
+
+Golden vectors in tests/test_hash.py are Spark-generated values taken
+from the reference's unit tests (spark_hash.rs:438-543).
+
+All routines are shape-static: string hashing loops over the padded
+width ``W`` with per-row predicates, so one compiled program serves all
+row counts of a bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..batch import Column
+from ..schema import TypeKind
+
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+
+# ---------------------------------------------------------------- murmur3
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+
+
+def _rotl32(x, r: int):
+    return (x << _U32(r)) | (x >> _U32(32 - r))
+
+
+def _mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl32(k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(h1, 13)
+    return h1 * np.uint32(5) + np.uint32(0xE6546B64)
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ length
+    h1 = h1 ^ (h1 >> _U32(16))
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> _U32(13))
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> _U32(16))
+    return h1
+
+
+def murmur3_hash_int32(values, seed):
+    """Murmur3_x86_32.hashInt: values int32 array, seed uint32 array."""
+    v = jnp.asarray(values, jnp.int32).view(_U32)
+    h1 = _mix_h1(seed, _mix_k1(v))
+    return _fmix(h1, _U32(4))
+
+
+def murmur3_hash_int64(values, seed):
+    """Murmur3_x86_32.hashLong: low word then high word."""
+    v = jnp.asarray(values, jnp.int64)
+    low = (v & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    high = ((v >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    h1 = _mix_h1(seed, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _fmix(h1, _U32(8))
+
+
+def murmur3_hash_bytes(data, lengths, seed):
+    """Murmur3_x86_32.hashUnsafeBytes over zero-padded (N, W) uint8 rows:
+    4-byte little-endian words for the aligned prefix, then the tail
+    bytes one at a time *sign-extended* (Java byte semantics)."""
+    n, w = data.shape
+    lengths = jnp.asarray(lengths, jnp.int32)
+    h1 = seed
+    # aligned words
+    n_words = w // 4
+    if n_words:
+        words = (
+            data[:, : n_words * 4]
+            .reshape(n, n_words, 4)
+            .astype(jnp.uint32)
+        )
+        le = words[..., 0] | (words[..., 1] << 8) | (words[..., 2] << 16) | (words[..., 3] << 24)
+        for i in range(n_words):
+            word_ok = (4 * (i + 1)) <= lengths
+            h1 = jnp.where(word_ok, _mix_h1(h1, _mix_k1(le[:, i])), h1)
+    # tail bytes (positions in [aligned, length))
+    aligned = (lengths // 4) * 4
+    for pos in range(w):
+        in_tail = (pos >= aligned) & (pos < lengths)
+        byte = data[:, pos].astype(jnp.int8).astype(jnp.int32).view(jnp.uint32)
+        h1 = jnp.where(in_tail, _mix_h1(h1, _mix_k1(byte)), h1)
+    return _fmix(h1, lengths.view(jnp.uint32))
+
+
+# ---------------------------------------------------------------- xxhash64
+
+_P1 = np.uint64(0x9E3779B185EBCA87)
+_P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = np.uint64(0x165667B19E3779F9)
+_P4 = np.uint64(0x85EBCA77C2B2AE63)
+_P5 = np.uint64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r: int):
+    return (x << _U64(r)) | (x >> _U64(64 - r))
+
+
+def _xx_fmix(h):
+    h = h ^ (h >> _U64(33))
+    h = h * _P2
+    h = h ^ (h >> _U64(29))
+    h = h * _P3
+    h = h ^ (h >> _U64(32))
+    return h
+
+
+def xxhash64_int32(values, seed):
+    v = jnp.asarray(values, jnp.int32).view(jnp.uint32).astype(jnp.uint64)
+    h = seed + _P5 + _U64(4)
+    h = h ^ (v * _P1)
+    h = _rotl64(h, 23) * _P2 + _P3
+    return _xx_fmix(h)
+
+
+def xxhash64_int64(values, seed):
+    v = jnp.asarray(values, jnp.int64).view(jnp.uint64)
+    h = seed + _P5 + _U64(8)
+    h = h ^ (_rotl64(v * _P2, 31) * _P1)
+    h = _rotl64(h, 27) * _P1 + _P4
+    return _xx_fmix(h)
+
+
+def _xx_merge(hash_, v):
+    hash_ = hash_ ^ (_rotl64(v * _P2, 31) * _P1)
+    return hash_ * _P1 + _P4
+
+
+def xxhash64_bytes(data, lengths, seed):
+    """XXH64 over zero-padded (N, W) uint8 rows, matching Spark's
+    XXH64.hashUnsafeBytes (unsigned tail bytes, LE words)."""
+    n, w = data.shape
+    lengths = jnp.asarray(lengths, jnp.int64)
+    len64 = lengths.astype(jnp.uint64)
+
+    n_words = (w + 7) // 8
+    padded_w = n_words * 8
+    if padded_w != w:
+        data = jnp.pad(data, ((0, 0), (0, padded_w - w)))
+    b = data.reshape(n, n_words, 8).astype(jnp.uint64)
+    words = b[..., 0]
+    for j in range(1, 8):
+        words = words | (b[..., j] << _U64(8 * j))
+
+    # 32-byte stripes
+    n_stripes_max = n_words // 4
+    if n_stripes_max:
+        v1 = jnp.full((n,), seed + _P1 + _P2, jnp.uint64)
+        v2 = jnp.full((n,), seed + _P2, jnp.uint64)
+        v3 = jnp.full((n,), seed, jnp.uint64)
+        v4 = jnp.full((n,), seed - _P1, jnp.uint64)
+        stripe_round = lambda v, wd: _rotl64(v + wd * _P2, 31) * _P1
+        for s in range(n_stripes_max):
+            ok = (32 * (s + 1)) <= lengths
+            v1 = jnp.where(ok, stripe_round(v1, words[:, 4 * s + 0]), v1)
+            v2 = jnp.where(ok, stripe_round(v2, words[:, 4 * s + 1]), v2)
+            v3 = jnp.where(ok, stripe_round(v3, words[:, 4 * s + 2]), v3)
+            v4 = jnp.where(ok, stripe_round(v4, words[:, 4 * s + 3]), v4)
+        merged = _rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) + _rotl64(v4, 18)
+        merged = _xx_merge(merged, v1)
+        merged = _xx_merge(merged, v2)
+        merged = _xx_merge(merged, v3)
+        merged = _xx_merge(merged, v4)
+        h = jnp.where(lengths >= 32, merged, seed + _P5)
+    else:
+        h = jnp.full((n,), seed + _P5, jnp.uint64)
+    h = h + len64
+
+    stripe_end = (lengths // 32) * 32
+    # remaining full 8-byte words
+    for i in range(n_words):
+        pos = 8 * i
+        ok = (pos >= stripe_end) & (pos + 8 <= lengths)
+        h = jnp.where(ok, _rotl64(h ^ (_rotl64(words[:, i] * _P2, 31) * _P1), 27) * _P1 + _P4, h)
+    # one 4-byte word if >= 4 bytes remain
+    word_end = (lengths // 8) * 8
+    n_half = padded_w // 4
+    halves = data.reshape(n, n_half, 4).astype(jnp.uint64)
+    half_words = (
+        halves[..., 0] | (halves[..., 1] << _U64(8)) | (halves[..., 2] << _U64(16)) | (halves[..., 3] << _U64(24))
+    )
+    for j in range(n_half):
+        pos = 4 * j
+        ok = (pos == word_end) & (lengths - word_end >= 4)
+        h = jnp.where(ok, _rotl64(h ^ (half_words[:, j] * _P1), 23) * _P2 + _P3, h)
+    # tail bytes, unsigned
+    tail_start = jnp.where(lengths - word_end >= 4, word_end + 4, word_end)
+    for pos in range(w):
+        ok = (pos >= tail_start) & (pos < lengths)
+        byte = data[:, pos].astype(jnp.uint64)
+        h = jnp.where(ok, _rotl64(h ^ (byte * _P5), 11) * _P1, h)
+    return _xx_fmix(h)
+
+
+# ------------------------------------------------------- column dispatch
+
+_SEED = 42
+
+
+def _normalize_float(col: Column):
+    # Spark normalizes -0.0 before hashing
+    d = col.data
+    d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
+    if d.dtype == jnp.float32:
+        return d.view(jnp.int32), TypeKind.INT32
+    return d.view(jnp.int64), TypeKind.INT64
+
+
+def _hash_one_murmur(col: Column, h):
+    k = col.dtype.kind
+    if col.dtype.is_string:
+        hv = murmur3_hash_bytes(col.data, col.lengths, h)
+    elif k in (TypeKind.BOOL,):
+        hv = murmur3_hash_int32(col.data.astype(jnp.int32), h)
+    elif k in (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.DATE32):
+        hv = murmur3_hash_int32(col.data.astype(jnp.int32), h)
+    elif k in (TypeKind.INT64, TypeKind.TIMESTAMP, TypeKind.DECIMAL):
+        hv = murmur3_hash_int64(col.data, h)
+    elif col.dtype.is_float:
+        d, kind = _normalize_float(col)
+        hv = murmur3_hash_int32(d, h) if kind == TypeKind.INT32 else murmur3_hash_int64(d, h)
+    else:
+        raise NotImplementedError(f"murmur3 over {col.dtype!r}")
+    return jnp.where(col.validity, hv, h)  # null: hash unchanged (Spark)
+
+
+def murmur3_columns(cols: Sequence[Column], seed: int = _SEED):
+    """Spark Murmur3Hash(cols, 42) -> int32 hashes (chained per column,
+    nulls leave the running hash unchanged)."""
+    n = cols[0].data.shape[0]
+    h = jnp.full((n,), np.uint32(seed), jnp.uint32)
+    for c in cols:
+        h = _hash_one_murmur(c, h)
+    return h.view(jnp.int32)
+
+
+def _hash_one_xx(col: Column, h):
+    k = col.dtype.kind
+    if col.dtype.is_string:
+        hv = xxhash64_bytes(col.data, col.lengths, h)
+    elif k in (TypeKind.BOOL,):
+        hv = xxhash64_int32(col.data.astype(jnp.int32), h)
+    elif k in (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.DATE32):
+        hv = xxhash64_int32(col.data.astype(jnp.int32), h)
+    elif k in (TypeKind.INT64, TypeKind.TIMESTAMP, TypeKind.DECIMAL):
+        hv = xxhash64_int64(col.data, h)
+    elif col.dtype.is_float:
+        d, kind = _normalize_float(col)
+        hv = xxhash64_int32(d, h) if kind == TypeKind.INT32 else xxhash64_int64(d, h)
+    else:
+        raise NotImplementedError(f"xxhash64 over {col.dtype!r}")
+    return jnp.where(col.validity, hv, h)
+
+
+def xxhash64_columns(cols: Sequence[Column], seed: int = _SEED):
+    """Spark XxHash64(cols, 42) -> int64 hashes."""
+    n = cols[0].data.shape[0]
+    h = jnp.full((n,), np.uint64(np.int64(seed)), jnp.uint64)
+    for c in cols:
+        h = _hash_one_xx(c, h)
+    return h.view(jnp.int64)
+
+
+def pmod(hashes, n: int):
+    """Spark's Pmod(hash, numPartitions) for shuffle partition ids
+    (≙ shuffle/mod.rs evaluate_partition_ids)."""
+    m = hashes.astype(jnp.int32) % jnp.int32(n)
+    return jnp.where(m < 0, m + jnp.int32(n), m)

@@ -1,0 +1,177 @@
+"""Expression IR.
+
+A small, serializable tree (mirrors the reference's
+``PhysicalExprNode`` oneof, ``blaze-serde/proto/blaze.proto:62-125``)
+with python operator sugar for building plans ergonomically in tests
+and in the TPC-H harness.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ..schema import DataType
+
+
+class Expr:
+    """Base class.  Operator overloads build trees:
+    ``(col("a") + lit(1)) < col("b")``."""
+
+    # arithmetic
+    def __add__(self, o): return BinOp("+", self, _wrap(o))
+    def __radd__(self, o): return BinOp("+", _wrap(o), self)
+    def __sub__(self, o): return BinOp("-", self, _wrap(o))
+    def __rsub__(self, o): return BinOp("-", _wrap(o), self)
+    def __mul__(self, o): return BinOp("*", self, _wrap(o))
+    def __rmul__(self, o): return BinOp("*", _wrap(o), self)
+    def __truediv__(self, o): return BinOp("/", self, _wrap(o))
+    def __mod__(self, o): return BinOp("%", self, _wrap(o))
+    # comparison
+    def __eq__(self, o): return BinOp("==", self, _wrap(o))  # type: ignore[override]
+    def __ne__(self, o): return BinOp("!=", self, _wrap(o))  # type: ignore[override]
+    def __lt__(self, o): return BinOp("<", self, _wrap(o))
+    def __le__(self, o): return BinOp("<=", self, _wrap(o))
+    def __gt__(self, o): return BinOp(">", self, _wrap(o))
+    def __ge__(self, o): return BinOp(">=", self, _wrap(o))
+    # logic (bitwise sugar like pyspark)
+    def __and__(self, o): return BinOp("and", self, _wrap(o))
+    def __or__(self, o): return BinOp("or", self, _wrap(o))
+    def __invert__(self): return Not(self)
+
+    def __hash__(self):
+        return id(self)
+
+    def is_null(self) -> "Expr":
+        return IsNull(self)
+
+    def is_not_null(self) -> "Expr":
+        return IsNotNull(self)
+
+    def cast(self, to: DataType) -> "Expr":
+        return Cast(self, to)
+
+    def isin(self, *values) -> "Expr":
+        return InList(self, [_wrap(v) for v in values])
+
+    def like(self, pattern: str) -> "Expr":
+        return Like(self, pattern)
+
+    def alias(self, name: str) -> "Expr":
+        return Alias(self, name)
+
+
+@dataclass(eq=False)
+class Col(Expr):
+    name: str
+
+
+@dataclass(eq=False)
+class Lit(Expr):
+    value: Any                       # logical python value (None = null)
+    dtype: Optional[DataType] = None  # inferred from value when omitted
+
+
+@dataclass(eq=False)
+class Alias(Expr):
+    child: Expr
+    name: str
+
+
+@dataclass(eq=False)
+class BinOp(Expr):
+    op: str  # + - * / % == != < <= > >= and or
+    left: Expr
+    right: Expr
+
+
+@dataclass(eq=False)
+class Not(Expr):
+    child: Expr
+
+
+@dataclass(eq=False)
+class IsNull(Expr):
+    child: Expr
+
+
+@dataclass(eq=False)
+class IsNotNull(Expr):
+    child: Expr
+
+
+@dataclass(eq=False)
+class Cast(Expr):
+    """Spark-semantics cast (non-ANSI: overflow wraps for ints, decimal
+    overflow -> null; ≙ reference CastExpr,
+    datafusion-ext-exprs/src/cast.rs + ext-commons cast.rs)."""
+
+    child: Expr
+    to: DataType
+
+
+@dataclass(eq=False)
+class Case(Expr):
+    """CASE WHEN c1 THEN v1 ... ELSE e END."""
+
+    branches: List[Tuple[Expr, Expr]]
+    else_: Optional[Expr] = None
+
+
+@dataclass(eq=False)
+class InList(Expr):
+    child: Expr
+    values: List[Expr]
+    negated: bool = False
+
+
+@dataclass(eq=False)
+class Like(Expr):
+    """SQL LIKE.  Patterns with a single literal core (``abc%``,
+    ``%abc``, ``%abc%``) and multi-segment ``%a%b%`` compile to device
+    kernels (≙ reference StringStartsWith/EndsWith/Contains exprs);
+    anything with ``_`` falls back to the host evaluator — the analogue
+    of the reference's JVM UDF fallback (SparkUDFWrapperExpr)."""
+
+    child: Expr
+    pattern: str
+    negated: bool = False
+
+
+@dataclass(eq=False)
+class ScalarFunc(Expr):
+    """Named scalar function, resolved through the function registry
+    (≙ datafusion-ext-functions create_spark_ext_function, lib.rs:34-59)."""
+
+    name: str
+    args: List[Expr]
+
+
+def _wrap(v) -> Expr:
+    return v if isinstance(v, Expr) else Lit(v)
+
+
+def col(name: str) -> Col:
+    return Col(name)
+
+
+def lit(value: Any, dtype: Optional[DataType] = None) -> Lit:
+    return Lit(value, dtype)
+
+
+def and_(*exprs: Expr) -> Expr:
+    acc = exprs[0]
+    for e in exprs[1:]:
+        acc = BinOp("and", acc, e)
+    return acc
+
+
+def or_(*exprs: Expr) -> Expr:
+    acc = exprs[0]
+    for e in exprs[1:]:
+        acc = BinOp("or", acc, e)
+    return acc
+
+
+def func(name: str, *args) -> ScalarFunc:
+    return ScalarFunc(name, [_wrap(a) for a in args])

@@ -1,0 +1,105 @@
+"""Vectorized string kernels over zero-padded (N, W) uint8 columns.
+
+≙ reference StringStartsWith/EndsWith/Contains physical exprs
+(datafusion-ext-exprs) and the string halves of ext-functions.  The
+fixed-width layout makes these pure VPU element-wise ops: no offsets,
+no gather chains, and one compiled program per (W, needle) pair.
+
+Note: because rows are zero-padded, a string that legitimately contains
+NUL bytes in its tail can compare equal to its NUL-trimmed sibling.
+Spark data virtually never does; documented deviation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..batch import Column
+
+
+def _pad_to(data, w: int):
+    if data.shape[1] == w:
+        return data
+    return jnp.pad(data, ((0, 0), (0, w - data.shape[1])))
+
+
+def _packed_be(data):
+    """(N, W) uint8 -> (N, W/8) uint64 big-endian words: lexicographic
+    byte order == numeric word order."""
+    n, w = data.shape
+    nw = (w + 7) // 8
+    if nw * 8 != w:
+        data = _pad_to(data, nw * 8)
+    b = data.reshape(n, nw, 8).astype(jnp.uint64)
+    out = b[..., 0] << jnp.uint64(56)
+    for j in range(1, 8):
+        out = out | (b[..., j] << jnp.uint64(8 * (7 - j)))
+    return out
+
+
+def _common(a: Column, b: Column):
+    w = max(a.data.shape[1], b.data.shape[1])
+    return _pad_to(a.data, w), _pad_to(b.data, w)
+
+
+def str_eq(a: Column, b: Column):
+    da, db = _common(a, b)
+    return jnp.all(da == db, axis=1)
+
+
+def str_lt(a: Column, b: Column):
+    da, db = _common(a, b)
+    wa, wb = _packed_be(da), _packed_be(db)
+    lt = jnp.zeros(wa.shape[0], jnp.bool_)
+    eq = jnp.ones(wa.shape[0], jnp.bool_)
+    for k in range(wa.shape[1]):
+        lt = lt | (eq & (wa[:, k] < wb[:, k]))
+        eq = eq & (wa[:, k] == wb[:, k])
+    return lt
+
+
+def str_le(a: Column, b: Column):
+    return str_lt(a, b) | str_eq(a, b)
+
+
+def starts_with(col: Column, needle: bytes):
+    L = len(needle)
+    if L == 0:
+        return jnp.ones(col.data.shape[0], jnp.bool_)
+    if L > col.data.shape[1]:
+        return jnp.zeros(col.data.shape[0], jnp.bool_)
+    nd = jnp.asarray(np.frombuffer(needle, np.uint8))
+    return (col.lengths >= L) & jnp.all(col.data[:, :L] == nd, axis=1)
+
+
+def ends_with(col: Column, needle: bytes):
+    L = len(needle)
+    if L == 0:
+        return jnp.ones(col.data.shape[0], jnp.bool_)
+    w = col.data.shape[1]
+    if L > w:
+        return jnp.zeros(col.data.shape[0], jnp.bool_)
+    nd = jnp.asarray(np.frombuffer(needle, np.uint8))
+    # gather the last L bytes of each row at dynamic offsets
+    starts = jnp.clip(col.lengths - L, 0, w - L)
+    idx = starts[:, None] + jnp.arange(L)[None, :]
+    tail = jnp.take_along_axis(col.data, idx, axis=1)
+    return (col.lengths >= L) & jnp.all(tail == nd, axis=1)
+
+
+def contains(col: Column, needle: bytes):
+    L = len(needle)
+    if L == 0:
+        return jnp.ones(col.data.shape[0], jnp.bool_)
+    w = col.data.shape[1]
+    if L > w:
+        return jnp.zeros(col.data.shape[0], jnp.bool_)
+    nd = np.frombuffer(needle, np.uint8)
+    found = jnp.zeros(col.data.shape[0], jnp.bool_)
+    for p in range(w - L + 1):
+        m = (col.lengths >= p + L)
+        for i in range(L):
+            m = m & (col.data[:, p + i] == nd[i])
+        found = found | m
+    return found

@@ -1,0 +1,263 @@
+"""Logical type system and schemas.
+
+The reference engine speaks Arrow types end-to-end (arrow-rs, and the
+Arrow type/scalar encodings in ``blaze-serde/proto/blaze.proto:738-941``).
+We keep the same logical surface but define the *physical* mapping
+TPU-first: every column lowers to dense, fixed-shape device arrays.
+
+Physical lowering:
+
+====================  =========================================================
+logical               device representation
+====================  =========================================================
+BOOL                  ``bool_ (N,)``
+INT8..INT64           ``int8..int64 (N,)``
+FLOAT32/FLOAT64       ``float32/float64 (N,)``
+DECIMAL(p<=18, s)     unscaled ``int64 (N,)`` (exact integer math on VPU)
+DECIMAL(p>18, s)      unscaled ``int64`` too — documented deviation from the
+                      reference's i128; overflow checked, widened in a later
+                      round via hi/lo int64 pairs
+DATE32                days since epoch, ``int32 (N,)``
+TIMESTAMP             microseconds since epoch, ``int64 (N,)``
+STRING                utf8 bytes, zero-padded ``uint8 (N, W)`` + ``int32 (N,)``
+                      byte lengths; ``W`` is a per-column power of two.  Fixed
+                      width keeps equality/ordering/hash vectorizable on the
+                      8x128 VPU instead of pointer-chasing offsets
+BINARY                same as STRING
+NULL                  ``bool_ (N,)`` of zeros
+====================  =========================================================
+
+Every column additionally carries a validity mask ``bool_ (N,)``
+(True = valid), and batches are padded to a bucketed capacity so XLA
+compiles a bounded set of programs (SURVEY.md §7 "shape-bucketed
+compilation").
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class TypeKind(enum.Enum):
+    NULL = 0
+    BOOL = 1
+    INT8 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT32 = 6
+    FLOAT64 = 7
+    DECIMAL = 8
+    STRING = 9
+    BINARY = 10
+    DATE32 = 11
+    TIMESTAMP = 12
+
+
+_FIXED_NP = {
+    TypeKind.NULL: np.bool_,
+    TypeKind.BOOL: np.bool_,
+    TypeKind.INT8: np.int8,
+    TypeKind.INT16: np.int16,
+    TypeKind.INT32: np.int32,
+    TypeKind.INT64: np.int64,
+    TypeKind.FLOAT32: np.float32,
+    TypeKind.FLOAT64: np.float64,
+    TypeKind.DECIMAL: np.int64,
+    TypeKind.DATE32: np.int32,
+    TypeKind.TIMESTAMP: np.int64,
+}
+
+_INT_KINDS = (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.INT64)
+_FLOAT_KINDS = (TypeKind.FLOAT32, TypeKind.FLOAT64)
+
+
+@dataclass(frozen=True)
+class DataType:
+    kind: TypeKind
+    precision: int = 0          # DECIMAL only
+    scale: int = 0              # DECIMAL only
+    string_width: int = 64      # STRING/BINARY only: padded byte width W
+
+    # ---- constructors ----
+    @staticmethod
+    def bool_() -> "DataType":
+        return DataType(TypeKind.BOOL)
+
+    @staticmethod
+    def int8() -> "DataType":
+        return DataType(TypeKind.INT8)
+
+    @staticmethod
+    def int16() -> "DataType":
+        return DataType(TypeKind.INT16)
+
+    @staticmethod
+    def int32() -> "DataType":
+        return DataType(TypeKind.INT32)
+
+    @staticmethod
+    def int64() -> "DataType":
+        return DataType(TypeKind.INT64)
+
+    @staticmethod
+    def float32() -> "DataType":
+        return DataType(TypeKind.FLOAT32)
+
+    @staticmethod
+    def float64() -> "DataType":
+        return DataType(TypeKind.FLOAT64)
+
+    @staticmethod
+    def decimal(precision: int, scale: int) -> "DataType":
+        return DataType(TypeKind.DECIMAL, precision=precision, scale=scale)
+
+    @staticmethod
+    def string(width: int = 64) -> "DataType":
+        return DataType(TypeKind.STRING, string_width=width)
+
+    @staticmethod
+    def binary(width: int = 64) -> "DataType":
+        return DataType(TypeKind.BINARY, string_width=width)
+
+    @staticmethod
+    def date32() -> "DataType":
+        return DataType(TypeKind.DATE32)
+
+    @staticmethod
+    def timestamp() -> "DataType":
+        return DataType(TypeKind.TIMESTAMP)
+
+    @staticmethod
+    def null() -> "DataType":
+        return DataType(TypeKind.NULL)
+
+    # ---- predicates ----
+    @property
+    def is_string(self) -> bool:
+        return self.kind in (TypeKind.STRING, TypeKind.BINARY)
+
+    @property
+    def is_integer(self) -> bool:
+        return self.kind in _INT_KINDS
+
+    @property
+    def is_float(self) -> bool:
+        return self.kind in _FLOAT_KINDS
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.kind == TypeKind.DECIMAL
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_float or self.is_decimal
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        """Physical numpy/jnp dtype of the data buffer."""
+        if self.is_string:
+            return np.dtype(np.uint8)
+        return np.dtype(_FIXED_NP[self.kind])
+
+    def __repr__(self) -> str:  # compact, e.g. decimal(12,2), string[64]
+        if self.kind == TypeKind.DECIMAL:
+            return f"decimal({self.precision},{self.scale})"
+        if self.is_string:
+            return f"{self.kind.name.lower()}[{self.string_width}]"
+        return self.kind.name.lower()
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+    def __repr__(self) -> str:
+        n = "" if self.nullable else " not null"
+        return f"{self.name}: {self.dtype!r}{n}"
+
+
+@dataclass(frozen=True)
+class Schema:
+    fields: Tuple[Field, ...]
+
+    def __init__(self, fields):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(f"no field {name!r} in {self.names}")
+
+    def index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(f"no field {name!r} in {self.names}")
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __repr__(self) -> str:
+        return "Schema(" + ", ".join(repr(f) for f in self.fields) + ")"
+
+
+def string_width_for(max_len: int) -> int:
+    """Smallest power-of-two padded width covering ``max_len`` bytes
+    (min 8, so a row of widths stays lane-aligned)."""
+    w = 8
+    while w < max_len:
+        w *= 2
+    return w
+
+
+# Spark result-type rules for decimal arithmetic
+# (Spark DecimalPrecision; the reference inherits these from Spark's
+# planner and enforces them natively in its spark-semantics CastExpr /
+# check_overflow — datafusion-ext-commons/src/cast.rs,
+# datafusion-ext-functions check_overflow).
+MAX_PRECISION = 38
+
+
+def _bounded(p: int, s: int) -> DataType:
+    return DataType.decimal(min(p, MAX_PRECISION), min(s, MAX_PRECISION))
+
+
+def decimal_add_type(a: DataType, b: DataType) -> DataType:
+    s = max(a.scale, b.scale)
+    p = max(a.precision - a.scale, b.precision - b.scale) + s + 1
+    return _bounded(p, s)
+
+
+def decimal_mul_type(a: DataType, b: DataType) -> DataType:
+    return _bounded(a.precision + b.precision + 1, a.scale + b.scale)
+
+
+def decimal_div_type(a: DataType, b: DataType) -> DataType:
+    p = a.precision - a.scale + b.scale + max(6, a.scale + b.precision + 1)
+    s = max(6, a.scale + b.precision + 1)
+    return _bounded(p, s)
+
+
+def decimal_sum_agg_type(a: DataType) -> DataType:
+    # Spark: sum(decimal(p, s)) -> decimal(p + 10, s)
+    return _bounded(a.precision + 10, a.scale)
+
+
+def decimal_avg_agg_type(a: DataType) -> DataType:
+    # Spark: avg(decimal(p, s)) -> decimal(p + 4, s + 4)
+    return _bounded(a.precision + 4, a.scale + 4)

@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Per the build contract, all tests run on a virtual 8-device CPU mesh so
+multi-chip sharding is exercised without TPU hardware; the driver
+separately dry-runs the multi-chip path and benches on a real chip.
+
+This mirrors the reference's test strategy (SURVEY.md §4): unit tests
+run the operators "pure native" with the JVM bridge stubbed by absence;
+here kernels run pure-JAX with the gateway absent.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-selects jax_platforms="axon,cpu"; the
+# config (not the env var) is authoritative, so override it here or
+# every test run dials the TPU tunnel.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,110 @@
+"""Spark-exact hash golden tests.
+
+Expected values are Spark-generated vectors recorded in the reference's
+unit suite (datafusion-ext-commons/src/spark_hash.rs:438-543, themselves
+generated with Spark's Murmur3Hash/XxHash64 expressions) — behavioral
+parity targets, independently reimplemented here.
+"""
+
+import numpy as np
+import pytest
+
+from blaze_tpu.batch import column_from_numpy, column_from_strings
+from blaze_tpu.exprs.hash import murmur3_columns, pmod, xxhash64_columns
+from blaze_tpu.schema import DataType
+
+
+def _u(x):
+    return np.int32(np.uint32(x))
+
+
+def test_murmur3_i32():
+    col = column_from_numpy(DataType.int32(), np.array([1, 2, 3, 4], np.int32))
+    h = np.asarray(murmur3_columns([col]))[:4]
+    assert h.tolist() == [-559580957, 1765031574, -1823081949, -397064898]
+
+
+def test_murmur3_i8():
+    vals = np.array([1, 0, -1, 127, -128], np.int8)
+    col = column_from_numpy(DataType.int8(), vals)
+    h = np.asarray(murmur3_columns([col]))[:5]
+    expected = [_u(0xDEA578E3), _u(0x379FAE8F), _u(0xA0590E3D), _u(0x43B4D8ED), _u(0x422A1365)]
+    assert h.tolist() == expected
+
+
+def test_murmur3_i64():
+    vals = np.array([1, 0, -1, np.iinfo(np.int64).max, np.iinfo(np.int64).min], np.int64)
+    col = column_from_numpy(DataType.int64(), vals)
+    h = np.asarray(murmur3_columns([col]))[:5]
+    expected = [_u(0x99F0149D), _u(0x9C67B85D), _u(0xC8008529), _u(0xA05B5D7B), _u(0xCD1E64FB)]
+    assert h.tolist() == expected
+
+
+def test_murmur3_str():
+    col = column_from_strings(["hello", "bar", "", "😁", "天地"])
+    h = np.asarray(murmur3_columns([col]))[:5]
+    expected = [_u(3286402344), _u(2486176763), _u(142593372), _u(885025535), _u(2395000894)]
+    assert h.tolist() == expected
+
+
+def test_xxhash64_i64():
+    vals = np.array([1, 0, -1, np.iinfo(np.int64).max], np.int64)
+    col = column_from_numpy(DataType.int64(), vals)
+    h = np.asarray(xxhash64_columns([col]))[:4]
+    assert h.tolist() == [
+        -7001672635703045582,
+        -5252525462095825812,
+        3858142552250413010,
+        -3246596055638297850,
+    ]
+
+
+def test_xxhash64_str():
+    col = column_from_strings(["hello", "bar", "", "😁", "天地"])
+    h = np.asarray(xxhash64_columns([col]))[:5]
+    assert h.tolist() == [
+        -4367754540140381902,
+        -1798770879548125814,
+        -7444071767201028348,
+        -6337236088984028203,
+        -235771157374669727,
+    ]
+
+
+def test_null_leaves_hash_unchanged():
+    vals = np.array([1, 1], np.int32)
+    validity = np.array([True, False])
+    col = column_from_numpy(DataType.int32(), vals, validity)
+    h = np.asarray(murmur3_columns([col]))[:2]
+    assert h[0] == -559580957
+    assert h[1] == 42  # seed passes through for null
+
+
+def test_multi_column_chaining():
+    a = column_from_numpy(DataType.int32(), np.array([1], np.int32))
+    b = column_from_numpy(DataType.int64(), np.array([7], np.int64))
+    h2 = np.asarray(murmur3_columns([a, b]))[:1]
+    # chained = hashLong(7, seed=hashInt(1, 42)); verify vs a direct
+    # recomputation through the same primitives but unchained semantics
+    h_a = np.asarray(murmur3_columns([a]))[0]
+    assert h2[0] != h_a  # chaining must change the hash
+
+
+def test_pmod_negative():
+    import jax.numpy as jnp
+
+    pids = np.asarray(pmod(jnp.array([-3, 3, -200], jnp.int32), 7))
+    assert (pids >= 0).all() and (pids < 7).all()
+    assert pids[1] == 3
+
+
+def test_long_string_stripes():
+    # >32 bytes exercises the xxhash64 stripe path; equal prefixes with
+    # different tails must differ
+    s1 = "a" * 40
+    s2 = "a" * 39 + "b"
+    col = column_from_strings([s1, s2])
+    h = np.asarray(xxhash64_columns([col]))[:2]
+    assert h[0] != h[1]
+    m = np.asarray(murmur3_columns([col]))[:2]
+    assert m[0] != m[1]
