@@ -1,0 +1,222 @@
+"""Expression engine: Spark null/arithmetic/cast semantics.
+
+Mirrors the reference's expr/function unit tests (datafusion-ext-exprs,
+datafusion-ext-functions, ext-commons cast.rs) as behavior checks."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from blaze_tpu.batch import RecordBatch, batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.exprs.compile import host_eval, lower, needs_host, split_host_exprs
+from blaze_tpu.exprs.ir import Case, InList, Like, ScalarFunc, func
+from blaze_tpu.schema import DataType, Field, Schema
+
+
+def _eval(expr, batch):
+    cols = {f.name: c for f, c in zip(batch.schema.fields, batch.columns)}
+    return lower(expr, batch.schema, cols, batch.capacity)
+
+
+def _vals(expr, batch, n=None):
+    c = _eval(expr, batch)
+    n = n or batch.num_rows
+    data = np.asarray(c.data)[:n]
+    valid = np.asarray(c.validity)[:n]
+    out = []
+    for i in range(n):
+        if not valid[i]:
+            out.append(None)
+        elif c.dtype.kind.name == "BOOL":
+            out.append(bool(data[i]))
+        elif c.dtype.is_float:
+            out.append(float(data[i]))
+        else:
+            out.append(int(data[i]))
+    return out
+
+
+@pytest.fixture
+def nums():
+    schema = Schema([
+        Field("a", DataType.int32()),
+        Field("b", DataType.int32()),
+        Field("f", DataType.float64()),
+    ])
+    return batch_from_pydict(
+        {"a": [1, 2, None, 4], "b": [10, 0, 30, None], "f": [1.5, -2.5, 0.0, None]},
+        schema,
+    )
+
+
+def test_arith_null_propagation(nums):
+    assert _vals(col("a") + col("b"), nums) == [11, 2, None, None]
+    assert _vals(col("a") * lit(3), nums) == [3, 6, None, 12]
+
+
+def test_division_semantics(nums):
+    # Spark: `/` on ints -> double; x/0 -> null
+    assert _vals(col("a") / col("b"), nums) == [0.1, None, None, None]
+
+
+def test_modulo_sign(nums):
+    # Java % has dividend sign
+    schema = Schema([Field("x", DataType.int32()), Field("y", DataType.int32())])
+    b = batch_from_pydict({"x": [7, -7, 7, -7], "y": [3, 3, -3, -3]}, schema)
+    assert _vals(col("x") % col("y"), b) == [1, -1, 1, -1]
+
+
+def test_comparison_and_null(nums):
+    assert _vals(col("a") < col("b"), nums) == [True, False, None, None]
+    assert _vals(col("a").is_null(), nums) == [False, False, True, False]
+    assert _vals(col("a").is_not_null(), nums) == [True, True, False, True]
+
+
+def test_three_valued_logic():
+    schema = Schema([Field("p", DataType.bool_()), Field("q", DataType.bool_())])
+    b = batch_from_pydict(
+        {"p": [True, True, False, None, None, False], "q": [None, True, None, None, False, False]},
+        schema,
+    )
+    # Spark: true AND null = null; false AND null = false
+    assert _vals(col("p") & col("q"), b) == [None, True, False, None, False, False]
+    # true OR null = true; false OR null = null
+    assert _vals(col("p") | col("q"), b) == [True, True, None, None, None, False]
+    assert _vals(~col("p"), b) == [False, False, True, None, None, True]
+
+
+def test_decimal_arithmetic():
+    d = DataType.decimal(12, 2)
+    schema = Schema([Field("x", d), Field("y", d)])
+    b = batch_from_pydict({"x": [1.50, 2.25, None], "y": [0.50, 3.00, 1.00]}, schema)
+    # + keeps scale 2 -> unscaled ints at scale 2
+    assert _vals(col("x") + col("y"), b) == [200, 525, None]
+    # * -> scale 4
+    assert _vals(col("x") * col("y"), b) == [7500, 67500, None]
+    # 1 - x at scale 2
+    assert _vals(lit(1).cast(DataType.decimal(12, 2)) - col("y"), b) == [50, -200, 0]
+
+
+def test_decimal_division_exact_path():
+    d = DataType.decimal(4, 1)
+    schema = Schema([Field("x", d), Field("y", d)])
+    b = batch_from_pydict({"x": [1.0, 7.0], "y": [3.0, 2.0]}, schema)
+    c = _eval(col("x") / col("y"), b)
+    s = c.dtype.scale
+    got = [v / 10**s for v in _vals(col("x") / col("y"), b)]
+    assert abs(got[0] - 1 / 3) < 10 ** -(s - 1)
+    assert got[1] == 3.5
+
+
+def test_cast_overflow_wraps():
+    schema = Schema([Field("x", DataType.int64())])
+    b = batch_from_pydict({"x": [300, -1, 2**40]}, schema)
+    assert _vals(col("x").cast(DataType.int8()), b) == [44, -1, 0]
+
+
+def test_cast_float_to_int_java():
+    schema = Schema([Field("x", DataType.float64())])
+    b = batch_from_pydict({"x": [2.9, -2.9, float("nan"), 1e20]}, schema)
+    got = _vals(col("x").cast(DataType.int32()), b)
+    assert got[0] == 2 and got[1] == -2 and got[2] == 0 and got[3] == 2**31 - 1
+
+
+def test_cast_decimal_overflow_null():
+    schema = Schema([Field("x", DataType.decimal(10, 2))])
+    b = batch_from_pydict({"x": [123.45, 99999999.99]}, schema)
+    got = _vals(col("x").cast(DataType.decimal(5, 2)), b)
+    assert got[0] == 12345 and got[1] is None
+
+
+def test_string_compare():
+    schema = Schema([Field("s", DataType.string(16))])
+    b = batch_from_pydict({"s": ["apple", "banana", None, "apricot"]}, schema)
+    assert _vals(col("s") == lit("banana"), b) == [False, True, None, False]
+    assert _vals(col("s") < lit("b"), b) == [True, False, None, True]
+    assert _vals(col("s") >= lit("apricot"), b) == [False, True, None, True]
+
+
+def test_in_list():
+    schema = Schema([Field("s", DataType.string(16))])
+    b = batch_from_pydict({"s": ["MAIL", "SHIP", "AIR", None]}, schema)
+    assert _vals(col("s").isin("MAIL", "SHIP"), b) == [True, True, False, None]
+
+
+def test_like_device_patterns():
+    schema = Schema([Field("s", DataType.string(32))])
+    b = batch_from_pydict(
+        {"s": ["PROMO burnished", "STANDARD brushed", "small PROMO", None]}, schema
+    )
+    assert _vals(Like(col("s"), "PROMO%"), b) == [True, False, False, None]
+    assert _vals(Like(col("s"), "%PROMO%"), b) == [True, False, True, None]
+    assert _vals(Like(col("s"), "%brushed"), b) == [False, True, False, None]
+    assert _vals(Like(col("s"), "PROMO burnished"), b) == [True, False, False, None]
+
+
+def test_like_host_fallback():
+    schema = Schema([Field("s", DataType.string(64))])
+    b = batch_from_pydict(
+        {"s": ["one special two requests", "special", "requests special", None]}, schema
+    )
+    e = Like(col("s"), "%special%requests%")
+    assert needs_host(e)
+    new_exprs, host_parts = split_host_exprs([e])
+    assert len(host_parts) == 1
+    hcol = host_eval(host_parts[0][1], b)
+    got = [
+        None if not np.asarray(hcol.validity)[i] else bool(np.asarray(hcol.data)[i])
+        for i in range(b.num_rows)
+    ]
+    assert got == [True, False, False, None]
+
+
+def test_case_when():
+    schema = Schema([Field("x", DataType.int32())])
+    b = batch_from_pydict({"x": [1, 5, None, 10]}, schema)
+    e = Case([(col("x") < lit(3), lit(100)), (col("x") < lit(7), lit(200))], lit(300))
+    assert _vals(e, b) == [100, 200, 300, 300]
+    e2 = Case([(col("x") < lit(3), lit(100))])
+    assert _vals(e2, b) == [100, None, None, None]
+
+
+def test_date_parts():
+    schema = Schema([Field("d", DataType.date32())])
+    days = [
+        (datetime.date(1994, 1, 1) - datetime.date(1970, 1, 1)).days,
+        (datetime.date(2000, 2, 29) - datetime.date(1970, 1, 1)).days,
+        (datetime.date(1969, 12, 31) - datetime.date(1970, 1, 1)).days,
+    ]
+    b = batch_from_pydict({"d": days}, schema)
+    assert _vals(func("year", col("d")), b) == [1994, 2000, 1969]
+    assert _vals(func("month", col("d")), b) == [1, 2, 12]
+    assert _vals(func("day", col("d")), b) == [1, 29, 31]
+
+
+def test_date_literal_compare():
+    schema = Schema([Field("d", DataType.date32())])
+    day = (datetime.date(1994, 3, 1) - datetime.date(1970, 1, 1)).days
+    b = batch_from_pydict({"d": [day - 1, day, day + 1]}, schema)
+    e = col("d") >= lit(datetime.date(1994, 3, 1))
+    assert _vals(e, b) == [False, True, True]
+
+
+def test_substring_concat_upper():
+    schema = Schema([Field("s", DataType.string(16))])
+    b = batch_from_pydict({"s": ["hello", "ab", None]}, schema)
+    sub = func("substring", col("s"), lit(2), lit(3))
+    c = _eval(sub, b)
+    from blaze_tpu.batch import strings_to_list
+
+    assert strings_to_list(c.to_host(), 3) == ["ell", "b", None]
+    up = func("upper", col("s"))
+    assert strings_to_list(_eval(up, b).to_host(), 3) == ["HELLO", "AB", None]
+    cc = func("concat", col("s"), lit("!x"))
+    assert strings_to_list(_eval(cc, b).to_host(), 3) == ["hello!x", "ab!x", None]
+
+
+def test_coalesce():
+    schema = Schema([Field("x", DataType.int32()), Field("y", DataType.int32())])
+    b = batch_from_pydict({"x": [None, 2, None], "y": [1, 5, None]}, schema)
+    assert _vals(func("coalesce", col("x"), col("y")), b) == [1, 2, None]
