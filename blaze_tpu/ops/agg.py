@@ -1,0 +1,631 @@
+"""Hash aggregation, TPU-style.
+
+≙ reference AggExec + agg/ (agg_exec.rs:59, agg_table.rs, acc.rs —
+~5,600 LoC of hash-table aggregation with radix buckets and spill).
+The TPU design replaces the hash table with an **exact sort+segment
+reduce**: XLA has no efficient scatter-with-collision-resolution, but
+``lax.sort`` over multiple key operands is fast and
+collision-free:
+
+1. encode group keys into equality-preserving uint64 words
+2. ``lax.sort`` rows lexicographically by those words (row idx payload)
+3. segment boundaries where any word changes; seg_id = cumsum
+4. per-agg ``segment_sum/min/max`` with ``indices_are_sorted=True``
+5. compact boundary rows -> one output row per distinct group
+
+The same kernel shape serves Partial (raw inputs), PartialMerge/Final
+(state inputs) — only the reduce ops differ.  Cross-batch state lives
+in ONE device-resident accumulator batch, re-reduced with amortized
+doubling (pending list merges when pending rows >= accumulated rows),
+so per-input-batch cost stays O(batch log batch) amortized.
+
+Modes mirror agg/mod.rs:58-82 (Partial/PartialMerge/Final); partial-agg
+skipping mirrors agg_table.rs:147 + BlazeConf partialAggSkipping: when
+the observed group/row ratio stays above the threshold past minRows,
+Partial stops aggregating and emits row-wise states directly.
+
+Spill: when the memory manager asks, the accumulator is staged to a
+Spill and merged back chunk-wise at finish (associative re-reduce).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import conf
+from ..batch import Column, RecordBatch, bucket_capacity, concat_batches
+from ..exprs.compile import infer_dtype, lower
+from ..exprs.ir import Expr
+from ..runtime.context import TaskContext
+from ..runtime.memmgr import MemConsumer, MemManager
+from ..schema import (
+    DataType,
+    Field,
+    Schema,
+    TypeKind,
+    decimal_avg_agg_type,
+    decimal_sum_agg_type,
+)
+from .base import BatchStream, ExecNode
+from .filter import compact_columns
+
+
+class AggMode(enum.Enum):
+    PARTIAL = 0
+    PARTIAL_MERGE = 1
+    FINAL = 2
+
+
+@dataclass
+class GroupingExpr:
+    expr: Expr
+    name: str
+
+
+@dataclass
+class AggFunction:
+    """One aggregate call.  ``fn`` in sum/count/count_star/avg/min/max/
+    first/first_ignores_null (≙ agg/mod.rs:84-97 create_agg)."""
+
+    fn: str
+    expr: Optional[Expr]
+    name: str
+
+
+# ---------------------------------------------------------------- typing
+
+def sum_result_type(t: DataType) -> DataType:
+    if t.is_decimal:
+        return decimal_sum_agg_type(t)
+    if t.is_float:
+        return DataType.float64()
+    return DataType.int64()
+
+
+def agg_result_type(fn: str, in_t: Optional[DataType]) -> DataType:
+    if fn in ("count", "count_star"):
+        return DataType.int64()
+    if fn == "sum":
+        return sum_result_type(in_t)
+    if fn == "avg":
+        if in_t.is_decimal:
+            return decimal_avg_agg_type(in_t)
+        return DataType.float64()
+    return in_t  # min/max/first
+
+
+def agg_state_fields(fn: str, in_t: Optional[DataType], name: str) -> List[Field]:
+    if fn in ("count", "count_star"):
+        return [Field(f"{name}#count", DataType.int64())]
+    if fn == "sum":
+        return [
+            Field(f"{name}#sum", sum_result_type(in_t)),
+            Field(f"{name}#nonnull", DataType.int64()),
+        ]
+    if fn == "avg":
+        return [
+            Field(f"{name}#sum", sum_result_type(in_t)),
+            Field(f"{name}#count", DataType.int64()),
+        ]
+    if fn in ("min", "max", "first", "first_ignores_null"):
+        return [Field(f"{name}#value", in_t)]
+    raise NotImplementedError(f"agg fn {fn}")
+
+
+# ------------------------------------------------------- key word encode
+
+def encode_key_words(cols: Sequence[Column]) -> List[jnp.ndarray]:
+    """Equality-preserving uint64 words per group column: a null word,
+    then the value words (strings: zero-padded bytes as words +
+    length)."""
+    words: List[jnp.ndarray] = []
+    for c in cols:
+        words.append((~c.validity).astype(jnp.uint64))
+        if c.dtype.is_string:
+            n, w = c.data.shape
+            words.append(c.lengths.astype(jnp.uint64))
+            nw = (w + 7) // 8
+            data = c.data if nw * 8 == w else jnp.pad(c.data, ((0, 0), (0, nw * 8 - w)))
+            b = data.reshape(n, nw, 8).astype(jnp.uint64)
+            for k in range(nw):
+                word = b[:, k, 0] << jnp.uint64(56)
+                for j in range(1, 8):
+                    word = word | (b[:, k, j] << jnp.uint64(8 * (7 - j)))
+                words.append(jnp.where(c.validity, word, jnp.uint64(0)))
+        elif c.dtype.is_float:
+            d = jnp.where(c.data == 0, jnp.zeros((), c.data.dtype), c.data)  # -0.0 -> 0.0
+            d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, c.data.dtype), d)  # canonical NaN
+            bits = d.view(jnp.int32) if c.data.dtype == jnp.float32 else d.view(jnp.int64)
+            words.append(jnp.where(c.validity, bits.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0)))
+        else:
+            words.append(
+                jnp.where(c.validity, c.data.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0))
+            )
+    return words
+
+
+# ------------------------------------------------------- segment reduces
+
+def _seg_sum(values, valid, seg, cap):
+    z = jnp.where(valid, values, jnp.zeros((), values.dtype))
+    return jax.ops.segment_sum(z, seg, num_segments=cap, indices_are_sorted=True)
+
+
+def _seg_count(valid, seg, cap):
+    return jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=cap, indices_are_sorted=True)
+
+
+def _seg_minmax(values, valid, seg, cap, is_min: bool):
+    dt = values.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        sentinel = jnp.array(jnp.inf if is_min else -jnp.inf, dt)
+    else:
+        info = jnp.iinfo(dt)
+        sentinel = jnp.array(info.max if is_min else info.min, dt)
+    z = jnp.where(valid, values, sentinel)
+    f = jax.ops.segment_min if is_min else jax.ops.segment_max
+    return f(z, seg, num_segments=cap, indices_are_sorted=True)
+
+
+def _seg_first(values, valid, seg, cap, ignore_nulls: bool):
+    n = values.shape[0]
+    pick = valid if ignore_nulls else jnp.ones_like(valid)
+    idx = jnp.where(pick, jnp.arange(n), n)
+    first_idx = jax.ops.segment_min(idx, seg, num_segments=cap, indices_are_sorted=True)
+    safe = jnp.clip(first_idx, 0, n - 1)
+    has = first_idx < n
+    return jnp.take(values, safe, axis=0), jnp.take(valid, safe) & has, has
+
+
+# ---------------------------------------------------------------- AggExec
+
+class AggExec(ExecNode):
+    def __init__(
+        self,
+        child: ExecNode,
+        mode: AggMode,
+        groupings: Sequence[GroupingExpr],
+        aggs: Sequence[AggFunction],
+        initial_input_buffer_offset: int = 0,
+        supports_partial_skipping: bool = False,
+    ):
+        super().__init__([child])
+        self.mode = mode
+        self.groupings = list(groupings)
+        self.aggs = list(aggs)
+        self.supports_partial_skipping = supports_partial_skipping
+
+        in_schema = child.schema
+        # input value types of each agg (for PARTIAL: from expr; for
+        # merge modes: recover from the state columns in in_schema)
+        self._in_types: List[Optional[DataType]] = []
+        for a in self.aggs:
+            if mode == AggMode.PARTIAL:
+                self._in_types.append(None if a.expr is None else infer_dtype(a.expr, in_schema))
+            else:
+                if a.fn in ("count", "count_star"):
+                    self._in_types.append(None)
+                elif a.fn in ("sum", "avg"):
+                    # state sum column carries the sum type; recover in_t
+                    st = in_schema.field(f"{a.name}#sum").dtype
+                    if st.is_decimal:
+                        self._in_types.append(DataType.decimal(max(1, st.precision - (10 if a.fn == "sum" else 0)), st.scale))
+                    else:
+                        self._in_types.append(st)
+                else:
+                    self._in_types.append(in_schema.field(f"{a.name}#value").dtype)
+
+        group_fields = [
+            Field(g.name, infer_dtype(g.expr, in_schema)) for g in self.groupings
+        ]
+        state_fields: List[Field] = []
+        for a, t in zip(self.aggs, self._in_types):
+            state_fields.extend(agg_state_fields(a.fn, t, a.name))
+        self._state_schema = Schema(group_fields + state_fields)
+
+        if mode == AggMode.FINAL:
+            out_fields = group_fields + [
+                Field(a.name, agg_result_type(a.fn, t)) for a, t in zip(self.aggs, self._in_types)
+            ]
+            self._schema = Schema(out_fields)
+        else:
+            self._schema = self._state_schema
+
+        self._build_kernels(in_schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    # -------------------------------------------------------- kernels
+
+    def _build_kernels(self, in_schema: Schema):
+        groupings = self.groupings
+        aggs = self.aggs
+        mode = self.mode
+        n_groups_cols = len(groupings)
+        state_schema = self._state_schema
+
+        def eval_inputs(cols: Tuple[Column, ...], schema: Schema):
+            env = {f.name: c for f, c in zip(schema.fields, cols)}
+            n = cols[0].data.shape[0] if cols else 0
+            key_cols = [lower(g.expr, schema, env, n) for g in groupings]
+            return env, key_cols, n
+
+        def partial_inputs(env, schema, n) -> List[List[Column]]:
+            """Per-agg list of raw input columns (PARTIAL mode).
+            count(*) gets a synthetic all-valid bool column so the
+            liveness masking applied to sorted inputs covers it too."""
+            out = []
+            for a in aggs:
+                if a.expr is None:
+                    ones = jnp.ones(n, jnp.bool_)
+                    out.append([Column(DataType.bool_(), ones, ones)])
+                else:
+                    out.append([lower(a.expr, schema, env, n)])
+            return out
+
+        def state_inputs(env) -> List[List[Column]]:
+            out = []
+            for a, t in zip(aggs, self._in_types):
+                fields = agg_state_fields(a.fn, t, a.name)
+                out.append([env[f.name] for f in fields])
+            return out
+
+        def reduce_one(
+            a: AggFunction,
+            in_t: Optional[DataType],
+            inputs: List[Column],
+            seg,
+            cap: int,
+            merging: bool,
+        ) -> List[Column]:
+            """Produce the state columns (length cap, indexed by seg id)."""
+            if a.fn in ("count", "count_star"):
+                c = inputs[0]
+                if merging:
+                    s = _seg_sum(c.data, c.validity, seg, cap)
+                else:
+                    s = _seg_count(c.validity, seg, cap)
+                return [Column(DataType.int64(), s, jnp.ones(cap, jnp.bool_))]
+            if a.fn in ("sum", "avg"):
+                sum_t = sum_result_type(in_t)
+                if merging:
+                    sc, cc = inputs
+                    s = _seg_sum(sc.data, sc.validity, seg, cap)
+                    c = _seg_sum(cc.data, cc.validity, seg, cap)
+                else:
+                    v = inputs[0]
+                    vv = v.data.astype(sum_t.np_dtype)
+                    s = _seg_sum(vv, v.validity, seg, cap)
+                    c = _seg_count(v.validity, seg, cap)
+                return [
+                    Column(sum_t, s, jnp.ones(cap, jnp.bool_)),
+                    Column(DataType.int64(), c, jnp.ones(cap, jnp.bool_)),
+                ]
+            if a.fn in ("min", "max"):
+                v = inputs[0]
+                if v.dtype.is_string:
+                    raise NotImplementedError("min/max over strings (roadmap)")
+                vals = _seg_minmax(v.data, v.validity, seg, cap, a.fn == "min")
+                has = jax.ops.segment_max(
+                    v.validity.astype(jnp.int32), seg, num_segments=cap, indices_are_sorted=True
+                ).astype(jnp.bool_)
+                return [Column(v.dtype, jnp.where(has, vals, jnp.zeros((), vals.dtype)), has)]
+            if a.fn in ("first", "first_ignores_null"):
+                v = inputs[0]
+                if v.dtype.is_string:
+                    raise NotImplementedError("first over strings (roadmap)")
+                vals, valid, has = _seg_first(
+                    v.data, v.validity, seg, cap, a.fn == "first_ignores_null" or mode != AggMode.PARTIAL
+                )
+                return [Column(v.dtype, jnp.where(valid, vals, jnp.zeros((), vals.dtype)), valid)]
+            raise NotImplementedError(a.fn)
+
+        merging = mode != AggMode.PARTIAL
+
+        @jax.jit
+        def grouped_kernel(cols: Tuple[Column, ...], num_rows):
+            schema = in_schema
+            env, key_cols, _ = eval_inputs(cols, schema)
+            cap = cols[0].data.shape[0]
+            live = jnp.arange(cap) < num_rows
+            words = [live.astype(jnp.uint64) ^ jnp.uint64(1)] + [
+                jnp.where(live, w, jnp.uint64(0)) for w in encode_key_words(key_cols)
+            ]
+            row_idx = jnp.arange(cap, dtype=jnp.int32)
+            sorted_ops = jax.lax.sort(tuple(words) + (row_idx,), num_keys=len(words))
+            s_words, s_idx = sorted_ops[:-1], sorted_ops[-1]
+            s_live = jnp.take(live, s_idx)
+            changed = jnp.zeros(cap, jnp.bool_)
+            for w in s_words:
+                changed = changed | (w != jnp.roll(w, 1))
+            changed = changed.at[0].set(True)
+            boundary = s_live & (changed | ~jnp.roll(s_live, 1))
+            boundary = boundary.at[0].set(s_live[0])
+            seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+            seg = jnp.clip(seg, 0, cap - 1)
+            n_out = jnp.sum(boundary.astype(jnp.int32))
+
+            # gather agg inputs in sorted order
+            inputs = partial_inputs(env, schema, cap) if not merging else state_inputs(env)
+            sorted_inputs = [
+                [Column(c.dtype, jnp.take(c.data, s_idx, axis=0),
+                        jnp.take(c.validity, s_idx) & s_live,
+                        None if c.lengths is None else jnp.take(c.lengths, s_idx))
+                 for c in ins]
+                for ins in inputs
+            ]
+            state_cols: List[Column] = []
+            for a, t, ins in zip(aggs, self._in_types, sorted_inputs):
+                state_cols.extend(reduce_one(a, t, ins, seg, cap, merging))
+
+            # group key columns: gather at boundary positions
+            b_idx = jnp.nonzero(boundary, size=cap, fill_value=0)[0]
+            out_live = jnp.arange(cap) < n_out
+            group_out: List[Column] = []
+            for kc in key_cols:
+                skc = Column(
+                    kc.dtype,
+                    jnp.take(kc.data, s_idx, axis=0),
+                    jnp.take(kc.validity, s_idx),
+                    None if kc.lengths is None else jnp.take(kc.lengths, s_idx),
+                )
+                g = skc.take(b_idx)
+                group_out.append(
+                    Column(g.dtype, g.data, g.validity & out_live,
+                           None if g.lengths is None else jnp.where(out_live, g.lengths, 0))
+                )
+            # state columns: indexed by seg id == output row already
+            state_out = [
+                Column(c.dtype, c.data, c.validity & out_live,
+                       None if c.lengths is None else jnp.where(out_live, c.lengths, 0))
+                for c in state_cols
+            ]
+            return tuple(group_out + state_out), n_out
+
+        self._grouped_kernel = grouped_kernel
+
+        @jax.jit
+        def scalar_kernel(cols: Tuple[Column, ...], num_rows):
+            """No-groups fast path: one jitted masked reduction, state
+            is a 1-row batch."""
+            schema = in_schema
+            env, _, _ = eval_inputs(cols, schema)
+            cap = cols[0].data.shape[0]
+            live = jnp.arange(cap) < num_rows
+            seg = jnp.zeros(cap, jnp.int32)
+            inputs = partial_inputs(env, schema, cap) if not merging else state_inputs(env)
+            masked = [
+                [Column(c.dtype, c.data, c.validity & live, c.lengths) for c in ins]
+                for ins in inputs
+            ]
+            state_cols: List[Column] = []
+            for a, t, ins in zip(aggs, self._in_types, masked):
+                state_cols.extend(reduce_one(a, t, ins, seg, 1, merging))
+            return tuple(state_cols)
+
+        self._scalar_kernel = scalar_kernel
+
+        # finalization: state batch -> output batch (FINAL mode)
+        in_types = self._in_types
+
+        @jax.jit
+        def finalize_kernel(cols: Tuple[Column, ...]):
+            env = {f.name: c for f, c in zip(state_schema.fields, cols)}
+            out: List[Column] = [env[g.name] for g in groupings]
+            for a, t in zip(aggs, in_types):
+                if a.fn in ("count", "count_star"):
+                    out.append(env[f"{a.name}#count"])
+                elif a.fn == "sum":
+                    s = env[f"{a.name}#sum"]
+                    nn = env[f"{a.name}#nonnull"]
+                    out.append(Column(s.dtype, s.data, s.validity & (nn.data > 0)))
+                elif a.fn == "avg":
+                    s = env[f"{a.name}#sum"]
+                    c = env[f"{a.name}#count"]
+                    res_t = agg_result_type("avg", t)
+                    valid = s.validity & (c.data > 0)
+                    den = jnp.where(c.data == 0, jnp.int64(1), c.data)
+                    if res_t.is_decimal:
+                        shift = res_t.scale - s.dtype.scale
+                        if s.dtype.precision + shift <= 18:
+                            num = s.data * jnp.int64(10**shift)
+                            half = den // 2
+                            adj = jnp.where(num >= 0, num + half, num - half)
+                            q = jnp.where(adj >= 0, adj // den, -((-adj) // den))
+                        else:
+                            f = s.data.astype(jnp.float64) * float(10**shift) / den.astype(jnp.float64)
+                            q = jnp.where(f >= 0, jnp.floor(f + 0.5), jnp.ceil(f - 0.5)).astype(jnp.int64)
+                        out.append(Column(res_t, q, valid))
+                    else:
+                        out.append(
+                            Column(res_t, s.data.astype(jnp.float64) / den.astype(jnp.float64), valid)
+                        )
+                else:
+                    out.append(env[f"{a.name}#value"])
+            return tuple(out)
+
+        self._finalize_kernel = finalize_kernel
+
+    # ------------------------------------------------------ execution
+
+    def _reduce_batch(self, batch: RecordBatch, in_schema: Schema) -> RecordBatch:
+        """One device reduce of a batch against schema -> state batch."""
+        if self.groupings:
+            cols, n_out = self._grouped_kernel(tuple(batch.columns), batch.num_rows)
+            return RecordBatch(self._state_schema, list(cols), int(n_out))
+        cols = self._scalar_kernel(tuple(batch.columns), batch.num_rows)
+        return RecordBatch(self._state_schema, list(cols), 1)
+
+    def _merge_states(self, states: List[RecordBatch]) -> Optional[RecordBatch]:
+        """Associative re-reduce of state batches (merge mode kernel on
+        the state schema)."""
+        if not states:
+            return None
+        if len(states) == 1:
+            return states[0]
+        merged_input = concat_batches(states)
+        merger = _StateMerger.for_agg(self)
+        return merger.reduce(merged_input)
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child_stream = self.children[0].execute(partition, ctx)
+        in_schema = self.children[0].schema
+
+        def stream():
+            merger = _StateMerger.for_agg(self)
+            acc: Optional[RecordBatch] = None
+            pending: List[RecordBatch] = []
+            pending_rows = 0
+            consumer = _AggConsumer(self, ctx)
+            ctx.mem.register_consumer(consumer)
+            in_rows = 0
+            skipping = False
+            try:
+                for batch in child_stream:
+                    if not ctx.is_task_running():
+                        return
+                    with self.metrics.timer("elapsed_compute"):
+                        part = self._reduce_batch(batch, in_schema)
+                    in_rows += batch.num_rows
+                    if (
+                        self.mode == AggMode.PARTIAL
+                        and self.supports_partial_skipping
+                        and self.groupings
+                        and not skipping
+                        and bool(conf.ENABLE_PARTIAL_AGG_SKIPPING.get())
+                        and in_rows >= int(conf.PARTIAL_AGG_SKIPPING_MIN_ROWS.get())
+                    ):
+                        acc_rows = (acc.num_rows if acc else 0) + pending_rows + part.num_rows
+                        if acc_rows / max(1, in_rows) > float(conf.PARTIAL_AGG_SKIPPING_RATIO.get()):
+                            skipping = True
+                            self.metrics.add("partial_skipped", 1)
+                    if skipping:
+                        # stream states through; downstream merge finishes
+                        self.metrics.add("output_rows", part.num_rows)
+                        yield part
+                        continue
+                    pending.append(part)
+                    pending_rows += part.num_rows
+                    if acc is None or pending_rows >= max(acc.num_rows, 4096):
+                        group = ([acc] if acc else []) + pending
+                        with self.metrics.timer("elapsed_compute"):
+                            acc = self._merge_states(group) if len(group) > 1 else group[0]
+                        pending, pending_rows = [], 0
+                        consumer.set_state(acc)
+                # finish: merge residue + spills
+                tail = ([acc] if acc else []) + pending
+                tail += consumer.drain_spills()
+                final_state = self._merge_states(tail) if tail else None
+                if final_state is not None and final_state.num_rows > 0:
+                    out = self._finish(final_state)
+                    self.metrics.add("output_rows", out.num_rows)
+                    yield out
+                elif not self.groupings:
+                    # empty input, global agg still emits one row
+                    empty = RecordBatch(
+                        in_schema,
+                        list(_empty_batch(in_schema).columns),
+                        0,
+                    )
+                    part = self._reduce_batch(empty.to_device(), in_schema)
+                    out = self._finish(part)
+                    self.metrics.add("output_rows", out.num_rows)
+                    yield out
+            finally:
+                ctx.mem.unregister_consumer(consumer)
+
+        return stream()
+
+    def _finish(self, state: RecordBatch) -> RecordBatch:
+        if self.mode == AggMode.FINAL:
+            cols = self._finalize_kernel(tuple(state.columns))
+            return RecordBatch(self._schema, list(cols), state.num_rows)
+        return state
+
+
+def _empty_batch(schema: Schema) -> RecordBatch:
+    from ..batch import batch_from_pydict
+
+    return batch_from_pydict({f.name: [] for f in schema.fields}, schema, capacity=int(conf.MIN_CAPACITY.get()))
+
+
+class _StateMerger:
+    """Merge-mode reducer over the state schema (sum of sums etc.).
+    Built lazily per AggExec; the merge AggExec shares kernels via a
+    PARTIAL_MERGE-mode twin on the state schema."""
+
+    _cache: Dict[int, "_StateMerger"] = {}
+
+    def __init__(self, agg: "AggExec"):
+        class _Src(ExecNode):
+            def __init__(self, schema):
+                super().__init__([])
+                self._s = schema
+
+            @property
+            def schema(self):
+                return self._s
+
+        self._twin = AggExec(
+            _Src(agg._state_schema),
+            AggMode.PARTIAL_MERGE,
+            [GroupingExpr(_col(g.name), g.name) for g in agg.groupings],
+            agg.aggs,
+        )
+
+    @classmethod
+    def for_agg(cls, agg: "AggExec") -> "_StateMerger":
+        key = id(agg)
+        if key not in cls._cache:
+            cls._cache[key] = cls(agg)
+        return cls._cache[key]
+
+    def reduce(self, state_batch: RecordBatch) -> RecordBatch:
+        return self._twin._reduce_batch(state_batch.to_device(), state_batch.schema)
+
+
+def _col(name):
+    from ..exprs.ir import Col
+
+    return Col(name)
+
+
+class _AggConsumer(MemConsumer):
+    """Tracks the accumulator size; on pressure, stages it to a Spill
+    (≙ agg spill path agg_table.rs:343-375, simplified: whole-state
+    chunks re-reduced at finish)."""
+
+    name = "agg"
+
+    def __init__(self, agg: AggExec, ctx: TaskContext):
+        super().__init__()
+        self._agg = agg
+        self._state: Optional[RecordBatch] = None
+        self._spills: List[RecordBatch] = []
+
+    def set_state(self, state: RecordBatch) -> None:
+        self._state = state
+        self.update_mem_used(state.memory_size())
+
+    def spill(self) -> int:
+        if self._state is None:
+            return 0
+        freed = self._state.memory_size()
+        # stage to host RAM (serialization-to-Spill arrives with the io
+        # layer; host numpy already frees device HBM)
+        self._spills.append(self._state.to_host())
+        self._state = None
+        self.update_mem_used(0)
+        return freed
+
+    def drain_spills(self) -> List[RecordBatch]:
+        out, self._spills = self._spills, []
+        return [b.to_device() for b in out]

@@ -1,0 +1,68 @@
+"""ExecNode: the operator interface.
+
+≙ DataFusion's ``ExecutionPlan`` as used by the reference
+(from_proto.rs builds ``Arc<dyn ExecutionPlan>`` trees;
+datafusion-ext-plans implements them).  Differences, TPU-first:
+
+- ``execute`` returns a plain python iterator of RecordBatches; the
+  task runtime (runtime/task.py) drives it through a bounded channel
+  on a worker thread (≙ tokio + sync_channel(1), rt.rs:100-133).
+- the hot math lives in jitted per-batch kernels; the iterator layer
+  only sequences device calls and host IO.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+from ..batch import RecordBatch
+from ..runtime.context import TaskContext
+from ..runtime.metrics import MetricsSet
+from ..schema import Schema
+
+BatchStream = Iterator[RecordBatch]
+
+
+class ExecNode:
+    """Base physical operator."""
+
+    def __init__(self, children: Sequence["ExecNode"]):
+        self.children: List[ExecNode] = list(children)
+        self.metrics = MetricsSet()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        raise NotImplementedError
+
+    def num_partitions(self) -> int:
+        """Output partitioning degree (propagates from children by
+        default)."""
+        if self.children:
+            return self.children[0].num_partitions()
+        return 1
+
+    def _count_output(self, stream: BatchStream) -> BatchStream:
+        for b in stream:
+            self.metrics.add("output_rows", b.num_rows)
+            yield b
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + self.name() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def collect(self, ctx: Optional[TaskContext] = None) -> List[RecordBatch]:
+        """Run all partitions serially and collect (test helper)."""
+        out: List[RecordBatch] = []
+        n = self.num_partitions()
+        for p in range(n):
+            c = ctx or TaskContext(p, n)
+            out.extend(self.execute(p, c))
+        return out

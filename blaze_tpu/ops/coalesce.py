@@ -1,0 +1,52 @@
+"""CoalesceBatches: re-bucket small batches up to batch_size.
+
+≙ reference coalesce stream (streams/coalesce_stream.rs), which wraps
+every operator output.  Here operators keep their natural output size
+and the planner inserts this node where small fragments hurt (shuffle
+read, filter-heavy chains): fewer, larger device launches.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import conf
+from ..batch import RecordBatch, concat_batches
+from ..runtime.context import TaskContext
+from ..schema import Schema
+from .base import BatchStream, ExecNode
+
+
+class CoalesceBatchesExec(ExecNode):
+    def __init__(self, child: ExecNode, target_rows: int = 0):
+        super().__init__([child])
+        self.target_rows = target_rows or int(conf.BATCH_SIZE.get())
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child_stream = self.children[0].execute(partition, ctx)
+
+        def stream():
+            buf: List[RecordBatch] = []
+            buffered = 0
+            for b in child_stream:
+                if b.num_rows >= self.target_rows and not buf:
+                    self.metrics.add("output_rows", b.num_rows)
+                    yield b
+                    continue
+                buf.append(b)
+                buffered += b.num_rows
+                if buffered >= self.target_rows:
+                    out = concat_batches(buf)
+                    buf, buffered = [], 0
+                    self.metrics.add("output_rows", out.num_rows)
+                    yield out
+            if buf:
+                out = concat_batches(buf) if len(buf) > 1 else buf[0]
+                self.metrics.add("output_rows", out.num_rows)
+                yield out
+
+        return stream()

@@ -1,0 +1,24 @@
+"""EmptyPartitions — ≙ empty_partitions_exec.rs:39."""
+
+from __future__ import annotations
+
+from ..runtime.context import TaskContext
+from ..schema import Schema
+from .base import BatchStream, ExecNode
+
+
+class EmptyPartitionsExec(ExecNode):
+    def __init__(self, schema: Schema, num_partitions: int):
+        super().__init__([])
+        self._schema = schema
+        self._num_partitions = num_partitions
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        return iter(())

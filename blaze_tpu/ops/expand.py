@@ -1,0 +1,44 @@
+"""Expand: each input row -> one output row per projection list
+(rollup/cube/grouping sets).
+
+≙ reference ExpandExec (expand_exec.rs:39-503).  Emitted as one batch
+per projection (row multiset identical to the reference's row-major
+interleave; downstream aggregation is order-insensitive).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..exprs.ir import Expr
+from ..runtime.context import TaskContext
+from ..schema import Schema
+from .base import BatchStream, ExecNode
+from .project import ProjectExec
+
+
+class ExpandExec(ExecNode):
+    def __init__(self, child: ExecNode, projections: Sequence[Sequence[Expr]], names: Sequence[str]):
+        super().__init__([child])
+        self._projects = [ProjectExec(child, list(p), list(names)) for p in projections]
+        self._schema = self._projects[0].schema
+        for p in self._projects[1:]:
+            assert [f.dtype for f in p.schema.fields] == [
+                f.dtype for f in self._schema.fields
+            ], "expand projections must agree on types"
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            # one pass per projection (child streams re-executed; fine
+            # for the usual Expand-over-cheap-child shape emitted by
+            # rollup/cube plans)
+            for proj in self._projects:
+                for b in proj.execute(partition, ctx):
+                    self.metrics.add("output_rows", b.num_rows)
+                    yield b
+
+        return stream()

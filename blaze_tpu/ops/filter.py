@@ -1,0 +1,90 @@
+"""Filter: predicate -> mask -> compact.
+
+≙ reference FilterExec (filter_exec.rs:45).  Dynamic output size under
+XLA's static shapes uses the two-phase pattern (SURVEY.md §7): the
+kernel computes keep-mask, compacts survivors to the front of the same
+capacity buffer, and returns the survivor count as a device scalar; the
+host syncs only that one scalar to set ``num_rows``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..batch import Column, RecordBatch
+from ..exprs.compile import host_eval, infer_dtype, lower, split_host_exprs
+from ..exprs.ir import Expr
+from ..runtime.context import TaskContext
+from ..schema import DataType, Field, Schema
+from .base import BatchStream, ExecNode
+
+
+def compact_columns(cols, keep):
+    """Move rows where ``keep`` to the front; invalidate the rest.
+    Returns (new_cols, count)."""
+    cap = keep.shape[0]
+    count = jnp.sum(keep.astype(jnp.int32))
+    idx = jnp.nonzero(keep, size=cap, fill_value=0)[0]
+    live = jnp.arange(cap) < count
+    out = []
+    for c in cols:
+        taken = c.take(idx)
+        out.append(
+            Column(
+                c.dtype,
+                taken.data,
+                taken.validity & live,
+                None if taken.lengths is None else jnp.where(live, taken.lengths, 0),
+            )
+        )
+    return tuple(out), count
+
+
+class FilterExec(ExecNode):
+    def __init__(self, child: ExecNode, predicate: Expr):
+        super().__init__([child])
+        self.predicate = predicate
+        in_schema = child.schema
+        (self._device_pred,), self._host_parts = split_host_exprs([predicate])
+        self._in_schema_aug = Schema(
+            list(in_schema.fields)
+            + [Field(name, DataType.bool_()) for name, _ in self._host_parts]
+        )
+        schema_aug = self._in_schema_aug
+        pred = self._device_pred
+
+        @jax.jit
+        def kernel(cols: Tuple[Column, ...]):
+            n = cols[0].data.shape[0]
+            env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
+            p = lower(pred, schema_aug, env, n)
+            keep = p.validity & p.data.astype(jnp.bool_)
+            return compact_columns(cols[: len(in_schema.fields)], keep)
+
+        self._kernel = kernel
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child_stream = self.children[0].execute(partition, ctx)
+
+        def stream():
+            for batch in child_stream:
+                with self.metrics.timer("elapsed_compute"):
+                    cols = list(batch.columns)
+                    for _, sub in self._host_parts:
+                        cols.append(host_eval(sub, batch))
+                    out_cols, count = self._kernel(tuple(cols))
+                    n = int(count)  # one-scalar device->host sync
+                if n == 0:
+                    continue
+                out = RecordBatch(self.schema, list(out_cols), n)
+                self.metrics.add("output_rows", n)
+                yield out
+
+        return stream()

@@ -1,0 +1,121 @@
+"""Generate (table-generating functions).
+
+≙ reference GenerateExec (generate_exec.rs:54-586; explode/pos_explode/
+json_tuple native, arbitrary UDTF via the JVM wrapper).  Until the
+nested ARRAY/MAP column layout lands (fixed max-elements padded arrays,
+roadmap), generators run through the host-generator interface — the
+same architecture slot as the reference's SparkUDTFWrapperContext JNI
+round trip, with json_tuple provided as a built-in host generator.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..batch import RecordBatch, batch_from_pydict, batch_to_pydict
+from ..exprs.compile import infer_dtype
+from ..exprs.ir import Expr
+from ..runtime.context import TaskContext
+from ..schema import DataType, Field, Schema
+from .base import BatchStream, ExecNode
+
+# generator: (row tuple of python values) -> list of output tuples
+Generator = Callable[[Tuple], List[Tuple]]
+
+
+def json_tuple_generator(fields: Sequence[str]) -> Generator:
+    """≙ generate/json_tuple.rs: extract top-level keys from a JSON
+    string column."""
+
+    def gen(row: Tuple) -> List[Tuple]:
+        (s,) = row
+        if s is None:
+            return [tuple(None for _ in fields)]
+        try:
+            obj = json.loads(s)
+        except (ValueError, TypeError):
+            return [tuple(None for _ in fields)]
+        if not isinstance(obj, dict):
+            return [tuple(None for _ in fields)]
+        out = []
+        vals = []
+        for f in fields:
+            v = obj.get(f)
+            if v is None:
+                vals.append(None)
+            elif isinstance(v, str):
+                vals.append(v)
+            else:
+                vals.append(json.dumps(v, separators=(",", ":")))
+        return [tuple(vals)]
+
+    return gen
+
+
+class GenerateExec(ExecNode):
+    def __init__(
+        self,
+        child: ExecNode,
+        generator: Generator,
+        input_exprs: Sequence[Expr],
+        gen_fields: Sequence[Field],
+        outer: bool = False,
+        keep_input: bool = True,
+    ):
+        super().__init__([child])
+        self.generator = generator
+        self.input_exprs = list(input_exprs)
+        self.gen_fields = list(gen_fields)
+        self.outer = outer
+        self.keep_input = keep_input
+        base = list(child.schema.fields) if keep_input else []
+        self._schema = Schema(base + self.gen_fields)
+        from .project import ProjectExec
+
+        self._input_proj = ProjectExec(
+            child, self.input_exprs, [f"__gen_in_{i}" for i in range(len(self.input_exprs))]
+        )
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child = self.children[0]
+
+        def stream():
+            child_batches = child.execute(partition, ctx)
+            for batch in child_batches:
+                # host round trip (≙ the reference's UDTF FFI round trip)
+                in_rows = batch_to_pydict(
+                    RecordBatch(
+                        self._input_proj.schema,
+                        list(self._input_proj._kernel(self._input_proj._augmented_cols(batch))),
+                        batch.num_rows,
+                    )
+                )
+                keys = list(in_rows.keys())
+                out_rows: Dict[str, List] = {f.name: [] for f in self._schema.fields}
+                base = batch_to_pydict(batch) if self.keep_input else {}
+                for i in range(batch.num_rows):
+                    row = tuple(in_rows[k][i] for k in keys)
+                    produced = self.generator(row)
+                    if not produced and self.outer:
+                        produced = [tuple(None for _ in self.gen_fields)]
+                    for tup in produced:
+                        if self.keep_input:
+                            for f in child.schema.fields:
+                                out_rows[f.name].append(base[f.name][i])
+                        for f, v in zip(self.gen_fields, tup):
+                            out_rows[f.name].append(v)
+                n = len(next(iter(out_rows.values()))) if out_rows else 0
+                if n == 0:
+                    continue
+                out = batch_from_pydict(out_rows, self._schema)
+                self.metrics.add("output_rows", out.num_rows)
+                yield out
+
+        return stream()

@@ -1,0 +1,101 @@
+"""Broadcast hash join.
+
+≙ reference BroadcastJoinExec (broadcast_join_exec.rs:76-567) +
+BroadcastJoinBuildHashMapExec: the build side arrives replicated (via
+BroadcastExchange), the JoinMap is built once per executor and cached
+(≙ get_cached_join_hash_map, broadcast_join_exec.rs:456-560), and every
+probe partition streams against it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ...batch import RecordBatch, concat_batches
+from ...exprs.ir import Expr
+from ...runtime.context import TaskContext
+from ...schema import Schema
+from ..base import BatchStream, ExecNode
+from .core import Joiner, JoinMap, JoinType
+
+_map_cache: Dict[int, JoinMap] = {}
+_map_lock = threading.Lock()
+
+
+class BroadcastJoinExec(ExecNode):
+    def __init__(
+        self,
+        build: ExecNode,
+        probe: ExecNode,
+        build_keys: Sequence[Expr],
+        probe_keys: Sequence[Expr],
+        join_type: JoinType,
+        build_is_left: bool,
+    ):
+        super().__init__([build, probe])
+        self.build_keys = list(build_keys)
+        self.probe_keys = list(probe_keys)
+        self.join_type = join_type
+        self.build_is_left = build_is_left
+        self._joiner_proto = Joiner(
+            probe.schema, build.schema, probe_keys, build_keys, join_type,
+            probe_is_left=not build_is_left,
+        )
+
+    @property
+    def schema(self) -> Schema:
+        return self._joiner_proto.out_schema
+
+    def num_partitions(self) -> int:
+        return self.children[1].num_partitions()
+
+    def _get_map(self, ctx: TaskContext) -> JoinMap:
+        key = id(self)
+        with _map_lock:
+            m = _map_cache.get(key)
+        if m is not None:
+            return m
+        with self.metrics.timer("build_hash_map_time"):
+            build = self.children[0]
+            batches: List[RecordBatch] = []
+            # broadcast child is replicated: read partition 0
+            for b in build.execute(0, ctx):
+                batches.append(b)
+            if batches:
+                data = concat_batches(batches).to_device()
+            else:
+                from ...batch import batch_from_pydict
+
+                data = batch_from_pydict({f.name: [] for f in build.schema.fields}, build.schema)
+            m = JoinMap.build(data, self.build_keys)
+        with _map_lock:
+            _map_cache[key] = m
+        return m
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            jmap = self._get_map(ctx)
+            joiner = Joiner(
+                self.children[1].schema, self.children[0].schema,
+                self.probe_keys, self.build_keys, self.join_type,
+                probe_is_left=not self.build_is_left,
+            )
+            for batch in self.children[1].execute(partition, ctx):
+                if not ctx.is_task_running():
+                    return
+                with self.metrics.timer("probe_time"):
+                    out = joiner.probe_batch(jmap, batch)
+                if out is not None and out.num_rows:
+                    self.metrics.add("output_rows", out.num_rows)
+                    yield out
+            # build-preserved sides are only correct when this executor
+            # sees every probe partition (standalone runs); Spark-mode
+            # planning must route such joins to the shuffled-hash path
+            if partition == self.num_partitions() - 1 or self.num_partitions() == 1:
+                tail = joiner.finish(jmap)
+                if tail is not None:
+                    self.metrics.add("output_rows", tail.num_rows)
+                    yield tail
+
+        return stream()

@@ -1,0 +1,376 @@
+"""Join core: sorted-key-table build/probe with exact verification.
+
+≙ reference join_hash_map.rs (open-addressing u32 map with raw-bytes
+serialization for broadcast) — rebuilt for XLA: no pointer chasing, no
+data-dependent probe loops; everything is sort, searchsorted, cumsum,
+gather.  The map itself is a pytree of three device arrays, trivially
+serializable/broadcastable like the reference's raw-bytes map.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...batch import Column, RecordBatch, bucket_capacity, concat_batches
+from ...exprs.compile import lower
+from ...exprs.hash import xxhash64_columns
+from ...exprs.ir import Expr
+from ...schema import DataType, Field, Schema
+from ..filter import compact_columns
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    FULL = "full"
+    LEFT_SEMI = "left_semi"
+    LEFT_ANTI = "left_anti"
+    RIGHT_SEMI = "right_semi"
+    RIGHT_ANTI = "right_anti"
+    EXISTENCE = "existence"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class JoinMap:
+    """Sorted build-side key table + the build batch it indexes."""
+
+    sorted_keys: jnp.ndarray   # uint64 (cap,) sorted
+    sorted_rows: jnp.ndarray   # int32 (cap,) original row per key
+    num_rows: int              # live build rows (static)
+    batch: RecordBatch         # build-side data
+
+    def tree_flatten(self):
+        return (self.sorted_keys, self.sorted_rows, self.batch), (self.num_rows,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        sk, sr, batch = children
+        return cls(sk, sr, aux[0], batch)
+
+    @staticmethod
+    def build(batch: RecordBatch, key_exprs: Sequence[Expr]) -> "JoinMap":
+        """Device build (jitted per schema/capacity)."""
+        sk, sr = _build_kernel(tuple(batch.columns), batch.schema, tuple(key_exprs), batch.num_rows)
+        return JoinMap(sk, sr, batch.num_rows, batch)
+
+
+_SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _key_hash(cols: Sequence[Column], n: int) -> jnp.ndarray:
+    """uint64 key hash; rows with ANY null key get the sentinel (null
+    never equals null in join equality)."""
+    h = xxhash64_columns(cols).view(jnp.uint64)
+    all_valid = cols[0].validity
+    for c in cols[1:]:
+        all_valid = all_valid & c.validity
+    return jnp.where(all_valid, h, _SENTINEL)
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("schema", "key_exprs"))
+def _build_kernel(cols, schema, key_exprs, num_rows):
+    cap = cols[0].data.shape[0]
+    env = {f.name: c for f, c in zip(schema.fields, cols)}
+    key_cols = [lower(e, schema, env, cap) for e in key_exprs]
+    live = jnp.arange(cap) < num_rows
+    keys = jnp.where(live, _key_hash(key_cols, cap), _SENTINEL)
+    rows = jnp.arange(cap, dtype=jnp.int32)
+    sk, sr = jax.lax.sort((keys, rows), num_keys=1)
+    return sk, sr
+
+
+def probe_counts(jmap: JoinMap, probe_keys: jnp.ndarray):
+    """(lo, counts) of candidate ranges per probe row."""
+    lo = jnp.searchsorted(jmap.sorted_keys, probe_keys, side="left")
+    hi = jnp.searchsorted(jmap.sorted_keys, probe_keys, side="right")
+    is_sent = probe_keys == _SENTINEL
+    counts = jnp.where(is_sent, 0, hi - lo)
+    return lo, counts
+
+
+def expand_pairs(lo, counts, out_cap: int):
+    """Two-phase expansion: (probe_row, build_pos) pairs for all
+    candidate matches, padded to out_cap."""
+    offsets = jnp.cumsum(counts)
+    total = offsets[-1] if counts.shape[0] else jnp.int64(0)
+    out_i = jnp.arange(out_cap)
+    probe_row = jnp.searchsorted(offsets, out_i, side="right")
+    probe_row = jnp.clip(probe_row, 0, counts.shape[0] - 1)
+    prev_off = offsets[probe_row] - counts[probe_row]
+    build_pos = lo[probe_row] + (out_i - prev_off)
+    live = out_i < total
+    return probe_row.astype(jnp.int32), build_pos.astype(jnp.int32), live
+
+
+def _eq_col(a: Column, b: Column):
+    """Join-key equality (null != null)."""
+    from ...exprs import strings as S
+
+    if a.dtype.is_string:
+        v = S.str_eq(a, b)
+    else:
+        ca, cb = a.data, b.data
+        if ca.dtype != cb.dtype:
+            wide = jnp.promote_types(ca.dtype, cb.dtype)
+            ca, cb = ca.astype(wide), cb.astype(wide)
+        v = ca == cb
+    return v & a.validity & b.validity
+
+
+def _null_columns(schema: Schema, cap: int) -> List[Column]:
+    cols = []
+    for f in schema.fields:
+        if f.dtype.is_string:
+            cols.append(
+                Column(
+                    f.dtype,
+                    jnp.zeros((cap, f.dtype.string_width), jnp.uint8),
+                    jnp.zeros(cap, jnp.bool_),
+                    jnp.zeros(cap, jnp.int32),
+                )
+            )
+        else:
+            cols.append(Column(f.dtype, jnp.zeros(cap, f.dtype.np_dtype), jnp.zeros(cap, jnp.bool_)))
+    return cols
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "probe_schema", "probe_key_exprs", "build_key_exprs", "out_cap",
+        "emit_probe_nulls_for_unmatched", "probe_preserved", "build_schema",
+    ),
+)
+def _probe_kernel(
+    probe_cols,
+    probe_schema,
+    probe_key_exprs,
+    build_key_exprs,
+    jmap: JoinMap,
+    probe_rows,
+    out_cap: int,
+    probe_preserved: bool,
+    emit_probe_nulls_for_unmatched: bool,
+    build_schema,
+):
+    """Returns (pair probe idx, pair build idx, keep mask, verified
+    per-probe match counts, matched-build scatter flags)."""
+    cap = probe_cols[0].data.shape[0]
+    env = {f.name: c for f, c in zip(probe_schema.fields, probe_cols)}
+    probe_keys_cols = [lower(e, probe_schema, env, cap) for e in probe_key_exprs]
+    live = jnp.arange(cap) < probe_rows
+    pkeys = jnp.where(live, _key_hash(probe_keys_cols, cap), _SENTINEL)
+
+    lo, counts = probe_counts(jmap, pkeys)
+    p_idx, b_pos, pair_live = expand_pairs(lo, counts, out_cap)
+    b_idx = jnp.take(jmap.sorted_rows, jnp.clip(b_pos, 0, jmap.sorted_rows.shape[0] - 1))
+
+    # verification against real key columns (collision + null safety)
+    benv = {f.name: c for f, c in zip(jmap.batch.schema.fields, jmap.batch.columns)}
+    bcap = jmap.batch.capacity
+    build_keys_cols = [lower(e, jmap.batch.schema, benv, bcap) for e in build_key_exprs]
+    keep = pair_live
+    for pk, bk in zip(probe_keys_cols, build_keys_cols):
+        pk_g = pk.take(p_idx)
+        bk_g = bk.take(b_idx)
+        keep = keep & _eq_col(pk_g, bk_g)
+
+    # verified per-probe-row counts and per-build-row matched flags
+    vcounts = jax.ops.segment_sum(
+        keep.astype(jnp.int32), p_idx, num_segments=cap, indices_are_sorted=True
+    )
+    matched_build = jnp.zeros(bcap, jnp.bool_).at[b_idx].max(keep)
+    return p_idx, b_idx, keep, vcounts, matched_build
+
+
+class Joiner:
+    """Drives probe batches against a JoinMap and materializes output
+    per join type.  The host syncs one scalar per batch (candidate
+    total) for output bucketing."""
+
+    def __init__(
+        self,
+        probe_schema: Schema,
+        build_schema: Schema,
+        probe_key_exprs: Sequence[Expr],
+        build_key_exprs: Sequence[Expr],
+        join_type: JoinType,
+        probe_is_left: bool,
+        existence_col: str = "exists#0",
+    ):
+        self.probe_schema = probe_schema
+        self.build_schema = build_schema
+        self.probe_keys = tuple(probe_key_exprs)
+        self.build_keys = tuple(build_key_exprs)
+        self.join_type = join_type
+        self.probe_is_left = probe_is_left
+        self.existence_col = existence_col
+        self._matched_build = None  # accumulated across probe batches
+
+        jt = join_type
+        build_outer = (
+            jt == JoinType.FULL
+            or (jt == JoinType.RIGHT and probe_is_left)
+            or (jt == JoinType.LEFT and not probe_is_left)
+        )
+        self._need_matched = build_outer or jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI)
+        if jt == JoinType.EXISTENCE:
+            self.out_schema = Schema(
+                list(probe_schema.fields) + [Field(existence_col, DataType.bool_())]
+            )
+        elif jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            self.out_schema = probe_schema if probe_is_left else build_schema
+        elif jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
+            self.out_schema = build_schema if probe_is_left else probe_schema
+        else:
+            left = probe_schema if probe_is_left else build_schema
+            right = build_schema if probe_is_left else probe_schema
+            self.out_schema = Schema(list(left.fields) + list(right.fields))
+
+    # candidate total estimation: max candidate pairs before verification
+    def _count_candidates(self, jmap: JoinMap, batch: RecordBatch) -> int:
+        total = _candidate_total(
+            tuple(batch.columns), batch.schema, self.probe_keys, jmap, batch.num_rows
+        )
+        return int(total)
+
+    def probe_batch(self, jmap: JoinMap, batch: RecordBatch) -> Optional[RecordBatch]:
+        jt = self.join_type
+        cand = self._count_candidates(jmap, batch)
+        semi_like = jt in (
+            JoinType.LEFT_SEMI, JoinType.LEFT_ANTI, JoinType.RIGHT_SEMI,
+            JoinType.RIGHT_ANTI, JoinType.EXISTENCE,
+        )
+        out_cap = bucket_capacity(max(1, cand))
+        p_idx, b_idx, keep, vcounts, matched = _probe_kernel(
+            tuple(batch.columns),
+            batch.schema,
+            self.probe_keys,
+            self.build_keys,
+            jmap,
+            batch.num_rows,
+            out_cap,
+            True,
+            False,
+            jmap.batch.schema,
+        )
+        # accumulate matched-build flags for build-preserved emission
+        if self._need_matched:
+            self._matched_build = (
+                matched if self._matched_build is None else (self._matched_build | matched)
+            )
+
+        if semi_like:
+            has = vcounts > 0
+            live = jnp.arange(batch.capacity) < batch.num_rows
+            if jt == JoinType.EXISTENCE:
+                cols = list(batch.columns) + [
+                    Column(DataType.bool_(), has, jnp.ones_like(has))
+                ]
+                return RecordBatch(self.out_schema, cols, batch.num_rows)
+            if jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
+                return None  # emitted from build side at finish
+            want = has if jt == JoinType.LEFT_SEMI else ~has
+            out_cols, count = _compact_jit(tuple(batch.columns), want & live)
+            n = int(count)
+            return RecordBatch(self.out_schema, list(out_cols), n) if n else None
+
+        # inner/outer: gather pair columns, compact by keep
+        probe_g = [c.take(p_idx) for c in batch.columns]
+        build_g = [c.take(b_idx) for c in jmap.batch.columns]
+        out_cols, count = _pair_output(
+            tuple(probe_g), tuple(build_g), keep,
+        )
+        n = int(count)
+        parts: List[RecordBatch] = []
+        if n:
+            cols = list(out_cols[0]) + list(out_cols[1])
+            if not self.probe_is_left:
+                cols = list(out_cols[1]) + list(out_cols[0])
+            parts.append(RecordBatch(self.out_schema, cols, n))
+        probe_outer = (
+            jt == JoinType.FULL
+            or (jt == JoinType.LEFT and self.probe_is_left)
+            or (jt == JoinType.RIGHT and not self.probe_is_left)
+        )
+        if probe_outer:
+            live = jnp.arange(batch.capacity) < batch.num_rows
+            un_cols, un_count = _compact_jit(tuple(batch.columns), (vcounts == 0) & live)
+            un = int(un_count)
+            if un:
+                nulls = _null_columns(self.build_schema, batch.capacity)
+                cols = list(un_cols) + nulls
+                if not self.probe_is_left:
+                    cols = nulls + list(un_cols)
+                parts.append(RecordBatch(self.out_schema, cols, un))
+        if not parts:
+            return None
+        return parts[0] if len(parts) == 1 else concat_batches(parts)
+
+    def finish(self, jmap: JoinMap) -> Optional[RecordBatch]:
+        """Emit build-side rows for right/full outer and right semi/anti
+        (probe side exhausted)."""
+        jt = self.join_type
+        build_outer = (
+            jt == JoinType.FULL
+            or (jt == JoinType.RIGHT and self.probe_is_left)
+            or (jt == JoinType.LEFT and not self.probe_is_left)
+        )
+        if not (build_outer or jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI)):
+            return None
+        matched = self._matched_build
+        if matched is None:
+            matched = jnp.zeros(jmap.batch.capacity, jnp.bool_)
+        live = jnp.arange(jmap.batch.capacity) < jmap.num_rows
+        if jt in (JoinType.RIGHT_SEMI,):
+            want = matched & live
+        elif jt in (JoinType.RIGHT_ANTI,):
+            want = ~matched & live
+        else:
+            want = ~matched & live
+        out_cols, count = _compact_jit(tuple(jmap.batch.columns), want)
+        n = int(count)
+        if not n:
+            return None
+        if jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
+            return RecordBatch(self.out_schema, list(out_cols), n)
+        nulls = _null_columns(self.probe_schema, jmap.batch.capacity)
+        cols = (nulls + list(out_cols)) if self.probe_is_left else (list(out_cols) + nulls)
+        return RecordBatch(self.out_schema, cols, n)
+
+
+@partial(jax.jit, static_argnames=("schema", "key_exprs"))
+def _candidate_total(cols, schema, key_exprs, jmap, num_rows):
+    cap = cols[0].data.shape[0]
+    env = {f.name: c for f, c in zip(schema.fields, cols)}
+    key_cols = [lower(e, schema, env, cap) for e in key_exprs]
+    live = jnp.arange(cap) < num_rows
+    pkeys = jnp.where(live, _key_hash(key_cols, cap), _SENTINEL)
+    _, counts = probe_counts(jmap, pkeys)
+    return jnp.sum(counts)
+
+
+@jax.jit
+def _compact_jit(cols, keep):
+    return compact_columns(cols, keep)
+
+
+@jax.jit
+def _pair_output(probe_g, build_g, keep):
+    """Compact candidate pairs by keep; returns ((probe cols, build
+    cols), count)."""
+    all_cols = tuple(probe_g) + tuple(build_g)
+    out, count = compact_columns(all_cols, keep)
+    np_ = len(probe_g)
+    return (out[:np_], out[np_:]), count

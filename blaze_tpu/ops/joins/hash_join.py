@@ -1,0 +1,79 @@
+"""Shuffled hash join: build side is THIS partition's stream (both
+sides hash-partitioned on the join keys by an upstream exchange).
+
+≙ the reference's shuffled-hash-join path (forced-SHJ injector +
+broadcast_join_exec.rs reused with partition-local build).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ...batch import RecordBatch, concat_batches
+from ...exprs.ir import Expr
+from ...runtime.context import TaskContext
+from ...schema import Schema
+from ..base import BatchStream, ExecNode
+from .core import Joiner, JoinMap, JoinType
+
+
+class HashJoinExec(ExecNode):
+    def __init__(
+        self,
+        build: ExecNode,
+        probe: ExecNode,
+        build_keys: Sequence[Expr],
+        probe_keys: Sequence[Expr],
+        join_type: JoinType,
+        build_is_left: bool,
+    ):
+        super().__init__([build, probe])
+        self.build_keys = list(build_keys)
+        self.probe_keys = list(probe_keys)
+        self.join_type = join_type
+        self.build_is_left = build_is_left
+        self._joiner_proto = Joiner(
+            probe.schema, build.schema, probe_keys, build_keys, join_type,
+            probe_is_left=not build_is_left,
+        )
+
+    @property
+    def schema(self) -> Schema:
+        return self._joiner_proto.out_schema
+
+    def num_partitions(self) -> int:
+        return self.children[1].num_partitions()
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            build = self.children[0]
+            with self.metrics.timer("build_hash_map_time"):
+                batches: List[RecordBatch] = [b for b in build.execute(partition, ctx)]
+                if batches:
+                    data = concat_batches(batches).to_device()
+                else:
+                    from ...batch import batch_from_pydict
+
+                    data = batch_from_pydict(
+                        {f.name: [] for f in build.schema.fields}, build.schema
+                    )
+                jmap = JoinMap.build(data, self.build_keys)
+            joiner = Joiner(
+                self.children[1].schema, build.schema,
+                self.probe_keys, self.build_keys, self.join_type,
+                probe_is_left=not self.build_is_left,
+            )
+            for batch in self.children[1].execute(partition, ctx):
+                if not ctx.is_task_running():
+                    return
+                with self.metrics.timer("probe_time"):
+                    out = joiner.probe_batch(jmap, batch)
+                if out is not None and out.num_rows:
+                    self.metrics.add("output_rows", out.num_rows)
+                    yield out
+            tail = joiner.finish(jmap)
+            if tail is not None:
+                self.metrics.add("output_rows", tail.num_rows)
+                yield tail
+
+        return stream()

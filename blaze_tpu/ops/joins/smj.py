@@ -1,0 +1,85 @@
+"""Sort-merge join.
+
+≙ reference SortMergeJoinExec (sort_merge_join_exec.rs:58-309,
+joins/smj/ full/semi/existence cursors).  Current implementation
+buffers the (already sorted) streamed side per partition and reuses the
+verified sorted-key-table core — key-order output is preserved because
+probes emit in probe-row order and the probe side arrives key-sorted.
+A cursor-windowed streaming merge (bounded memory for huge sides) is
+on the native-runtime roadmap.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ...batch import RecordBatch, concat_batches
+from ...exprs.ir import Expr
+from ...runtime.context import TaskContext
+from ...schema import Schema
+from ..base import BatchStream, ExecNode
+from .core import Joiner, JoinMap, JoinType
+
+
+class SortMergeJoinExec(ExecNode):
+    """children = [left, right]; both key-sorted upstream (the planner
+    inserts SortExec like Spark's EnsureRequirements)."""
+
+    def __init__(
+        self,
+        left: ExecNode,
+        right: ExecNode,
+        left_keys: Sequence[Expr],
+        right_keys: Sequence[Expr],
+        join_type: JoinType,
+    ):
+        super().__init__([left, right])
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        # probe = left (preserves left order); build = right
+        self._joiner_proto = Joiner(
+            left.schema, right.schema, left_keys, right_keys, join_type,
+            probe_is_left=True,
+        )
+
+    @property
+    def schema(self) -> Schema:
+        return self._joiner_proto.out_schema
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions()
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            right = self.children[1]
+            with self.metrics.timer("build_time"):
+                batches: List[RecordBatch] = [b for b in right.execute(partition, ctx)]
+                if batches:
+                    data = concat_batches(batches).to_device()
+                else:
+                    from ...batch import batch_from_pydict
+
+                    data = batch_from_pydict(
+                        {f.name: [] for f in right.schema.fields}, right.schema
+                    )
+                jmap = JoinMap.build(data, self.right_keys)
+            joiner = Joiner(
+                self.children[0].schema, right.schema,
+                self.left_keys, self.right_keys, self.join_type,
+                probe_is_left=True,
+            )
+            for batch in self.children[0].execute(partition, ctx):
+                if not ctx.is_task_running():
+                    return
+                with self.metrics.timer("probe_time"):
+                    out = joiner.probe_batch(jmap, batch)
+                if out is not None and out.num_rows:
+                    self.metrics.add("output_rows", out.num_rows)
+                    yield out
+            tail = joiner.finish(jmap)
+            if tail is not None:
+                self.metrics.add("output_rows", tail.num_rows)
+                yield tail
+
+        return stream()

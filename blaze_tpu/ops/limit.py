@@ -1,0 +1,41 @@
+"""Limit — ≙ reference LimitExec (limit_exec.rs:24)."""
+
+from __future__ import annotations
+
+from ..batch import RecordBatch
+from ..runtime.context import TaskContext
+from ..schema import Schema
+from .base import BatchStream, ExecNode
+
+
+class LimitExec(ExecNode):
+    def __init__(self, child: ExecNode, limit: int):
+        super().__init__([child])
+        self.limit = limit
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child_stream = self.children[0].execute(partition, ctx)
+
+        def stream():
+            remaining = self.limit
+            for batch in child_stream:
+                if remaining <= 0:
+                    return
+                if batch.num_rows <= remaining:
+                    remaining -= batch.num_rows
+                    self.metrics.add("output_rows", batch.num_rows)
+                    yield batch
+                else:
+                    # truncating num_rows is enough: rows past num_rows
+                    # are padding by the batch invariant
+                    out = RecordBatch(batch.schema, batch.columns, remaining)
+                    self.metrics.add("output_rows", remaining)
+                    remaining = 0
+                    yield out
+                    return
+
+        return stream()

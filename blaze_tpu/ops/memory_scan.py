@@ -1,0 +1,43 @@
+"""In-memory table source.
+
+≙ DataFusion's MemoryExec, which the reference uses as its unit-test
+fixture source (SURVEY.md §4: "operator tests with MemoryExec
+fixtures"); also the execution-side of ConvertToNative/FFIReaderExec
+when batches are handed over pre-staged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..batch import RecordBatch
+from ..runtime.context import TaskContext
+from ..schema import Schema
+from .base import BatchStream, ExecNode
+
+
+class MemoryScanExec(ExecNode):
+    def __init__(self, partitions: Sequence[Sequence[RecordBatch]], schema: Optional[Schema] = None):
+        super().__init__([])
+        self._partitions: List[List[RecordBatch]] = [list(p) for p in partitions]
+        if schema is None:
+            first = next((b for p in self._partitions for b in p), None)
+            assert first is not None, "schema required for empty MemoryScanExec"
+            schema = first.schema
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return max(1, len(self._partitions))
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            if partition < len(self._partitions):
+                for b in self._partitions[partition]:
+                    self.metrics.add("output_rows", b.num_rows)
+                    yield b.to_device()
+
+        return stream()

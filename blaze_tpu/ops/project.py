@@ -1,0 +1,85 @@
+"""Projection.
+
+≙ reference ProjectExec (project_exec.rs:48) over CachedExprsEvaluator.
+The TPU twist: the whole projection lowers into ONE jitted function per
+(input schema, capacity) — XLA's CSE + fusion subsumes the reference's
+common-subexpression cache and short-circuit evaluation
+(common/cached_exprs_evaluator.rs).
+
+Kernels take bare Column tuples, never RecordBatch: num_rows is pytree
+aux and would key the jit cache per row count; capacity (the array
+shape) is the only shape key.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+from ..batch import Column, RecordBatch
+from ..exprs.compile import host_eval, infer_dtype, lower, split_host_exprs
+from ..exprs.ir import Alias, Col, Expr
+from ..runtime.context import TaskContext
+from ..schema import Field, Schema
+from .base import BatchStream, ExecNode
+
+
+def _expr_name(e: Expr, i: int) -> str:
+    if isinstance(e, Alias):
+        return e.name
+    if isinstance(e, Col):
+        return e.name
+    return f"#{i}"
+
+
+class ProjectExec(ExecNode):
+    def __init__(self, child: ExecNode, exprs: Sequence[Expr], names: Optional[Sequence[str]] = None):
+        super().__init__([child])
+        self.exprs = list(exprs)
+        in_schema = child.schema
+        self.names = list(names) if names else [_expr_name(e, i) for i, e in enumerate(self.exprs)]
+        self._schema = Schema(
+            [Field(n, infer_dtype(e, in_schema)) for n, e in zip(self.names, self.exprs)]
+        )
+        # host-fallback subtrees get evaluated per batch outside jit and
+        # injected as synthetic columns (≙ SparkUDFWrapperExpr round trip)
+        self._device_exprs, self._host_parts = split_host_exprs(self.exprs)
+        self._in_schema_aug = Schema(
+            list(in_schema.fields)
+            + [Field(name, infer_dtype(sub, in_schema)) for name, sub in self._host_parts]
+        )
+
+        schema_aug = self._in_schema_aug
+        device_exprs = self._device_exprs
+
+        @jax.jit
+        def kernel(cols: Tuple[Column, ...]) -> Tuple[Column, ...]:
+            n = cols[0].data.shape[0]
+            env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
+            return tuple(lower(e, schema_aug, env, n) for e in device_exprs)
+
+        self._kernel = kernel
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _augmented_cols(self, batch: RecordBatch) -> Tuple[Column, ...]:
+        cols = list(batch.columns)
+        for _, sub in self._host_parts:
+            cols.append(host_eval(sub, batch))
+        return tuple(cols)
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child_stream = self.children[0].execute(partition, ctx)
+
+        def stream():
+            for batch in child_stream:
+                with self.metrics.timer("elapsed_compute"):
+                    out_cols = self._kernel(self._augmented_cols(batch))
+                out = RecordBatch(self._schema, list(out_cols), batch.num_rows)
+                self.metrics.add("output_rows", out.num_rows)
+                yield out
+
+        return stream()

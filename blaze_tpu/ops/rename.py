@@ -1,0 +1,33 @@
+"""RenameColumns — ≙ rename_columns_exec.rs:44 (the reference inserts
+it around unconvertible subtrees to normalize attribute names)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..batch import RecordBatch
+from ..runtime.context import TaskContext
+from ..schema import Field, Schema
+from .base import BatchStream, ExecNode
+
+
+class RenameColumnsExec(ExecNode):
+    def __init__(self, child: ExecNode, names: Sequence[str]):
+        super().__init__([child])
+        assert len(names) == len(child.schema.fields)
+        self._schema = Schema(
+            [Field(n, f.dtype, f.nullable) for n, f in zip(names, child.schema.fields)]
+        )
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child_stream = self.children[0].execute(partition, ctx)
+
+        def stream():
+            for b in child_stream:
+                yield RecordBatch(self._schema, b.columns, b.num_rows)
+
+        return stream()

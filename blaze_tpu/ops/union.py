@@ -1,0 +1,37 @@
+"""Union / EmptyPartitions / Rename / Debug / CoalesceBatches plumbing
+operators — ≙ reference union, empty_partitions_exec.rs:39,
+rename_columns_exec.rs:44, debug_exec.rs:39, coalesce stream."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..batch import RecordBatch
+from ..runtime.context import TaskContext
+from ..schema import Schema
+from .base import BatchStream, ExecNode
+
+
+class UnionExec(ExecNode):
+    """Concatenation of children streams (same schema, same partition
+    count)."""
+
+    def __init__(self, children: Sequence[ExecNode]):
+        super().__init__(children)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def num_partitions(self) -> int:
+        return max(c.num_partitions() for c in self.children)
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            for child in self.children:
+                if partition < child.num_partitions():
+                    for b in child.execute(partition, ctx):
+                        self.metrics.add("output_rows", b.num_rows)
+                        yield b
+
+        return stream()

@@ -1,0 +1,215 @@
+"""Window functions.
+
+≙ reference WindowExec (window_exec.rs:44-370, window/processors/:
+RankLike row_number/rank/dense_rank + Agg processors over
+partition-by/order-by).  TPU design: buffer the partition's input
+(planner pre-sorts by partition+order keys, like Spark's
+EnsureRequirements), then ONE device kernel computes every window
+column via segmented prefix ops:
+
+- partition segments from key-word boundaries (as in agg)
+- row_number = position - segment start
+- rank/dense_rank from order-key-change boundaries inside segments
+- running aggregates with Spark's default frame (RANGE UNBOUNDED
+  PRECEDING .. CURRENT ROW: peers share the value at their last row)
+  via global cumsum minus segment-start offset, gathered at peer-group
+  end; whole-partition aggregates via segment reduce + gather.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..batch import Column, RecordBatch, concat_batches
+from ..exprs.compile import infer_dtype, lower
+from ..exprs.ir import Expr
+from ..runtime.context import TaskContext
+from ..schema import DataType, Field, Schema
+from .agg import encode_key_words, sum_result_type
+from .base import BatchStream, ExecNode
+from .sort import SortField, order_words
+
+
+@dataclass
+class WindowFunction:
+    """kind: row_number | rank | dense_rank | sum | count | avg |
+    min | max (agg kinds use ``expr``)."""
+
+    kind: str
+    name: str
+    expr: Optional[Expr] = None
+    whole_partition: bool = False  # True: unbounded..unbounded frame
+
+
+class WindowExec(ExecNode):
+    def __init__(
+        self,
+        child: ExecNode,
+        functions: Sequence[WindowFunction],
+        partition_by: Sequence[Expr],
+        order_by: Sequence[SortField],
+    ):
+        super().__init__([child])
+        self.functions = list(functions)
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        in_schema = child.schema
+        out_fields = list(in_schema.fields)
+        for f in self.functions:
+            if f.kind in ("row_number", "rank", "dense_rank", "count"):
+                out_fields.append(Field(f.name, DataType.int64()))
+            elif f.kind == "sum":
+                out_fields.append(Field(f.name, sum_result_type(infer_dtype(f.expr, in_schema))))
+            elif f.kind == "avg":
+                t = infer_dtype(f.expr, in_schema)
+                from ..schema import decimal_avg_agg_type
+
+                out_fields.append(
+                    Field(f.name, decimal_avg_agg_type(t) if t.is_decimal else DataType.float64())
+                )
+            else:
+                out_fields.append(Field(f.name, infer_dtype(f.expr, in_schema)))
+        self._schema = Schema(out_fields)
+
+        functions_ = self.functions
+        part_by = self.partition_by
+        ord_by = self.order_by
+
+        @jax.jit
+        def kernel(cols: Tuple[Column, ...], num_rows):
+            cap = cols[0].data.shape[0]
+            env = {f.name: c for f, c in zip(in_schema.fields, cols)}
+            live = jnp.arange(cap) < num_rows
+
+            def boundaries(words):
+                ch = jnp.zeros(cap, jnp.bool_)
+                for w in words:
+                    w = jnp.where(live, w, jnp.uint64(0))
+                    ch = ch | (w != jnp.roll(w, 1))
+                return ch.at[0].set(True)
+
+            pwords = encode_key_words([lower(e, in_schema, env, cap) for e in part_by]) if part_by else []
+            part_b = boundaries(pwords) if part_by else jnp.zeros(cap, jnp.bool_).at[0].set(True)
+            owords: List = []
+            for f in ord_by:
+                owords.extend(order_words(lower(f.expr, in_schema, env, cap), f.ascending, f.nulls_first))
+            peer_b = boundaries(pwords + owords) if ord_by else part_b
+
+            pos = jnp.arange(cap, dtype=jnp.int64)
+            seg = jnp.cumsum(part_b.astype(jnp.int64)) - 1
+            n_segs = cap  # upper bound
+            seg_start = jax.ops.segment_min(pos, seg, num_segments=n_segs, indices_are_sorted=True)
+            start_of_row = jnp.take(seg_start, seg)
+
+            # peer-group end index per row (last row of equal order keys
+            # within the partition): next peer boundary - 1
+            nxt = jnp.where(peer_b, pos, jnp.int64(cap))
+            # for each row, the smallest boundary position > pos:
+            rev_min = jax.lax.associative_scan(jnp.minimum, nxt[::-1])[::-1]
+            shifted = jnp.concatenate([rev_min[1:], jnp.array([cap], jnp.int64)])
+            peer_end = jnp.minimum(shifted - 1, jnp.take(
+                jax.ops.segment_max(pos * live, seg, num_segments=n_segs, indices_are_sorted=True), seg
+            ))
+
+            out_cols: List[Column] = list(cols)
+            ones = jnp.ones(cap, jnp.bool_) & live
+            for f in functions_:
+                if f.kind == "row_number":
+                    v = pos - start_of_row + 1
+                    out_cols.append(Column(DataType.int64(), v, ones))
+                elif f.kind == "rank":
+                    last_peer_start = jax.lax.associative_scan(
+                        jnp.maximum, jnp.where(peer_b, pos, jnp.int64(0))
+                    )
+                    v = last_peer_start - start_of_row + 1
+                    out_cols.append(Column(DataType.int64(), v, ones))
+                elif f.kind == "dense_rank":
+                    peers_seen = jnp.cumsum(peer_b.astype(jnp.int64))
+                    peers_at_start = jnp.take(peers_seen, start_of_row)
+                    v = peers_seen - peers_at_start + 1
+                    out_cols.append(Column(DataType.int64(), v, ones))
+                else:
+                    c = lower(f.expr, in_schema, env, cap)
+                    valid = c.validity & live
+                    if f.kind in ("sum", "avg", "count"):
+                        st = sum_result_type(c.dtype) if f.kind != "count" else DataType.int64()
+                        vals = (
+                            jnp.where(valid, c.data, jnp.zeros((), c.data.dtype)).astype(st.np_dtype)
+                            if f.kind != "count"
+                            else valid.astype(jnp.int64)
+                        )
+                        csum = jnp.cumsum(vals)
+                        cnt = jnp.cumsum(valid.astype(jnp.int64))
+                        if f.whole_partition:
+                            seg_sum = jax.ops.segment_sum(vals, seg, num_segments=n_segs, indices_are_sorted=True)
+                            seg_cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=n_segs, indices_are_sorted=True)
+                            run_sum = jnp.take(seg_sum, seg)
+                            run_cnt = jnp.take(seg_cnt, seg)
+                        else:
+                            base_sum = jnp.where(start_of_row > 0, jnp.take(csum, jnp.maximum(start_of_row - 1, 0)), 0)
+                            base_cnt = jnp.where(start_of_row > 0, jnp.take(cnt, jnp.maximum(start_of_row - 1, 0)), 0)
+                            run_sum = jnp.take(csum, peer_end) - base_sum
+                            run_cnt = jnp.take(cnt, peer_end) - base_cnt
+                        if f.kind == "count":
+                            out_cols.append(Column(DataType.int64(), run_cnt, ones))
+                        elif f.kind == "sum":
+                            out_cols.append(Column(st, run_sum, ones & (run_cnt > 0)))
+                        else:
+                            den = jnp.maximum(run_cnt, 1)
+                            from ..schema import decimal_avg_agg_type
+
+                            if c.dtype.is_decimal:
+                                rt = decimal_avg_agg_type(c.dtype)
+                                shift = rt.scale - c.dtype.scale
+                                num = run_sum * jnp.int64(10**shift)
+                                half = den // 2
+                                adj = jnp.where(num >= 0, num + half, num - half)
+                                q = jnp.where(adj >= 0, adj // den, -((-adj) // den))
+                                out_cols.append(Column(rt, q, ones & (run_cnt > 0)))
+                            else:
+                                out_cols.append(
+                                    Column(
+                                        DataType.float64(),
+                                        run_sum.astype(jnp.float64) / den.astype(jnp.float64),
+                                        ones & (run_cnt > 0),
+                                    )
+                                )
+                    elif f.kind in ("min", "max"):
+                        # whole-partition frame only (running min/max:
+                        # segmented-scan, roadmap)
+                        from .agg import _seg_minmax
+
+                        red = _seg_minmax(c.data, valid, seg, n_segs, f.kind == "min")
+                        has = jax.ops.segment_max(valid.astype(jnp.int32), seg, num_segments=n_segs, indices_are_sorted=True).astype(jnp.bool_)
+                        out_cols.append(
+                            Column(c.dtype, jnp.take(red, seg), jnp.take(has, seg) & ones)
+                        )
+                    else:
+                        raise NotImplementedError(f.kind)
+            return tuple(out_cols)
+
+        self._kernel = kernel
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child_stream = self.children[0].execute(partition, ctx)
+
+        def stream():
+            buffered = [b.to_host() for b in child_stream]
+            if not buffered:
+                return
+            merged = concat_batches(buffered).to_device()
+            with self.metrics.timer("elapsed_compute"):
+                cols = self._kernel(tuple(merged.columns), merged.num_rows)
+            out = RecordBatch(self._schema, list(cols), merged.num_rows)
+            self.metrics.add("output_rows", out.num_rows)
+            yield out
+
+        return stream()

@@ -1,0 +1,3 @@
+"""Runtime: per-task execution context, metrics tree, memory manager
+with spill tiers — ≙ reference crate ``blaze`` (NativeExecutionRuntime,
+rt.rs) + ``memmgr`` in datafusion-ext-plans."""

@@ -1,0 +1,78 @@
+"""Per-task execution context.
+
+≙ the reference's task plumbing: Spark TaskContext exposed to native
+via JNI callbacks (JniBridge.java isTaskRunning/getTaskContext) plus
+the per-task NativeExecutionRuntime state (blaze/src/rt.rs:48-98).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from .memmgr import MemManager
+from .metrics import MetricNode
+
+
+class ResourcesMap:
+    """Process-global rendezvous for handles passed between planner and
+    operators (shuffle block iterators, FFI exports, broadcast buffers)
+    — ≙ JniBridge.resourcesMap (JniBridge.java:30-50)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._map: Dict[str, Any] = {}
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._map[key] = value
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            if key not in self._map:
+                raise KeyError(f"resource {key!r} not found")
+            return self._map.pop(key)
+
+    def peek(self, key: str) -> Any:
+        with self._lock:
+            return self._map[key]
+
+
+RESOURCES = ResourcesMap()
+
+
+class TaskContext:
+    """One executing task = one partition of one stage."""
+
+    def __init__(
+        self,
+        partition: int,
+        num_partitions: int = 1,
+        metrics: Optional[MetricNode] = None,
+        stage_id: int = 0,
+        task_attempt_id: int = 0,
+    ):
+        self.partition = partition
+        self.num_partitions = num_partitions
+        self.metrics = metrics or MetricNode()
+        self.stage_id = stage_id
+        self.task_attempt_id = task_attempt_id
+        self.mem = MemManager.get()
+        self.resources = RESOURCES
+        self._cancelled = threading.Event()
+        self._on_complete: list[Callable[[], None]] = []
+
+    def is_task_running(self) -> bool:
+        """≙ JniBridge.isTaskRunning — cancelled tasks exit quietly."""
+        return not self._cancelled.is_set()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    def add_on_complete(self, fn: Callable[[], None]) -> None:
+        self._on_complete.append(fn)
+
+    def complete(self) -> None:
+        for fn in reversed(self._on_complete):
+            fn()
+        self._on_complete.clear()

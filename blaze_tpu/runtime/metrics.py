@@ -1,0 +1,69 @@
+"""Metrics: per-operator counters/timers mirrored into a tree that the
+JVM side walks into Spark SQL UI metrics.
+
+≙ reference MetricNode (spark-extension MetricNode.scala:21-41) and the
+native mirror walk (blaze/src/metrics.rs:21-57).  The default metric
+set matches NativeHelper.getDefaultNativeMetrics (NativeHelper.scala:
+92-122): elapsed_compute, output_rows, spill counts/sizes, io times.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+
+class MetricsSet:
+    """Counters + timers for one operator instance."""
+
+    def __init__(self):
+        self.values: Dict[str, int] = {}
+
+    def add(self, name: str, v: int = 1) -> None:
+        self.values[name] = self.values.get(name, 0) + int(v)
+
+    def set(self, name: str, v: int) -> None:
+        self.values[name] = int(v)
+
+    def get(self, name: str) -> int:
+        return self.values.get(name, 0)
+
+    @contextmanager
+    def timer(self, name: str):
+        """Accumulates nanoseconds under ``name`` (elapsed_compute etc.)."""
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter_ns() - t0)
+
+
+class MetricNode:
+    """Tree mirroring the plan tree; ``child(i)`` descends.  The JVM
+    gateway registers a callback per node to push values into
+    SQLMetrics; standalone runs just read the tree."""
+
+    def __init__(self, metrics: Optional[MetricsSet] = None, children: Optional[List["MetricNode"]] = None):
+        self.metrics = metrics or MetricsSet()
+        self.children = children or []
+
+    def child(self, i: int) -> "MetricNode":
+        while len(self.children) <= i:
+            self.children.append(MetricNode())
+        return self.children[i]
+
+    def foreach(self, fn, path=()):
+        fn(path, self.metrics)
+        for i, c in enumerate(self.children):
+            c.foreach(fn, path + (i,))
+
+    def flatten(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+
+        def visit(path, ms):
+            for k, v in ms.values.items():
+                out[".".join(map(str, path)) + ":" + k] = v
+
+        self.foreach(visit)
+        return out

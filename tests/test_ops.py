@@ -1,0 +1,308 @@
+"""Operator unit tests with in-memory sources.
+
+≙ reference operator tests (datafusion-ext-plans: joins/test.rs matrix,
+sort_exec.rs test_sort_i32, window_exec.rs:259, expand/limit/agg acc
+tests) — same strategy: MemoryExec-style fixtures + sorted result
+comparison (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.exprs.ir import func
+from blaze_tpu.ops import (
+    AggExec,
+    AggFunction,
+    AggMode,
+    BroadcastJoinExec,
+    CoalesceBatchesExec,
+    ExpandExec,
+    FilterExec,
+    GenerateExec,
+    GroupingExpr,
+    HashJoinExec,
+    LimitExec,
+    MemoryScanExec,
+    ProjectExec,
+    RenameColumnsExec,
+    SortExec,
+    SortField,
+    SortMergeJoinExec,
+    UnionExec,
+    WindowExec,
+    WindowFunction,
+)
+from blaze_tpu.ops.joins import JoinType
+from blaze_tpu.ops.generate import json_tuple_generator
+from blaze_tpu.schema import DataType, Field, Schema
+
+
+def mem(data, schema, n_parts=1):
+    """Split dict-of-lists into n_parts single-batch partitions."""
+    n = len(next(iter(data.values())))
+    parts = []
+    for p in range(n_parts):
+        lo = p * n // n_parts
+        hi = (p + 1) * n // n_parts
+        chunk = {k: v[lo:hi] for k, v in data.items()}
+        parts.append([batch_from_pydict(chunk, schema)] if hi > lo else [])
+    return MemoryScanExec(parts, schema)
+
+
+def collect_dict(node):
+    batches = node.collect()
+    if not batches:
+        return {f.name: [] for f in node.schema.fields}
+    out = {f.name: [] for f in node.schema.fields}
+    for b in batches:
+        d = batch_to_pydict(b)
+        for k in out:
+            out[k].extend(d[k])
+    return out
+
+
+def sorted_rows(d):
+    keys = list(d.keys())
+    rows = list(zip(*[d[k] for k in keys]))
+    return sorted(rows, key=lambda r: tuple((v is None, v) for v in r))
+
+
+INT_SCHEMA = Schema([Field("a", DataType.int32()), Field("b", DataType.int64())])
+
+
+def test_project_filter_pipeline():
+    src = mem({"a": [1, 2, 3, 4, 5], "b": [10, 20, 30, 40, 50]}, INT_SCHEMA)
+    f = FilterExec(src, col("a") % lit(2) == lit(1))
+    p = ProjectExec(f, [col("a"), (col("b") + col("a")).alias("c")])
+    got = collect_dict(p)
+    assert got == {"a": [1, 3, 5], "c": [11, 33, 55]}
+
+
+def test_filter_all_and_none():
+    src = mem({"a": [1, 2], "b": [1, 2]}, INT_SCHEMA)
+    assert collect_dict(FilterExec(src, col("a") > lit(100))) == {"a": [], "b": []}
+    src2 = mem({"a": [1, 2], "b": [1, 2]}, INT_SCHEMA)
+    assert collect_dict(FilterExec(src2, col("a") > lit(0)))["a"] == [1, 2]
+
+
+def test_agg_scalar_no_groups():
+    src = mem({"a": [1, 2, None, 4], "b": [10, 20, 30, 40]}, INT_SCHEMA)
+    agg = AggExec(
+        src,
+        AggMode.PARTIAL,
+        [],
+        [
+            AggFunction("sum", col("a"), "s"),
+            AggFunction("count", col("a"), "c"),
+            AggFunction("count_star", None, "cs"),
+            AggFunction("min", col("b"), "mn"),
+            AggFunction("max", col("b"), "mx"),
+            AggFunction("avg", col("b"), "av"),
+        ],
+    )
+    final = AggExec(agg, AggMode.FINAL, [], agg.aggs)
+    got = collect_dict(final)
+    assert got["s"] == [7] and got["c"] == [3] and got["cs"] == [4]
+    assert got["mn"] == [10] and got["mx"] == [40] and got["av"] == [25.0]
+
+
+def test_agg_grouped():
+    schema = Schema([Field("g", DataType.string(8)), Field("v", DataType.int64())])
+    src = mem(
+        {"g": ["x", "y", "x", None, "y", None], "v": [1, 2, 3, 4, None, 6]},
+        schema,
+        n_parts=2,
+    )
+    part = AggExec(
+        src, AggMode.PARTIAL,
+        [GroupingExpr(col("g"), "g")],
+        [AggFunction("sum", col("v"), "s"), AggFunction("count_star", None, "n")],
+    )
+    final = AggExec(
+        part, AggMode.FINAL,
+        [GroupingExpr(col("g"), "g")],
+        part.aggs,
+    )
+    # run each source partition through partial, then merge via a
+    # single-partition final (simulates the exchange)
+    batches = part.collect()
+    merged_src = MemoryScanExec([batches], part.schema)
+    final = AggExec(
+        merged_src, AggMode.FINAL,
+        [GroupingExpr(col("g"), "g")],
+        part.aggs,
+    )
+    got = collect_dict(final)
+    rows = sorted_rows(got)
+    assert rows == sorted_rows({"g": ["x", "y", None], "s": [4, 2, 10], "n": [2, 2, 2]})
+
+
+def test_agg_empty_input_global():
+    src = mem({"a": [], "b": []}, INT_SCHEMA)
+    agg = AggExec(src, AggMode.PARTIAL, [], [AggFunction("count_star", None, "n"), AggFunction("sum", col("a"), "s")])
+    final = AggExec(MemoryScanExec([agg.collect()], agg.schema), AggMode.FINAL, [], agg.aggs)
+    got = collect_dict(final)
+    assert got["n"] == [0] and got["s"] == [None]
+
+
+def test_sort_multi_key_nulls():
+    schema = Schema([Field("a", DataType.int32()), Field("b", DataType.float64())])
+    src = mem({"a": [3, 1, None, 1, 2], "b": [1.0, 5.0, 2.0, -1.0, None]}, schema)
+    s = SortExec(src, [SortField(col("a"), True, True), SortField(col("b"), False, False)])
+    got = collect_dict(s)
+    assert got["a"] == [None, 1, 1, 2, 3]
+    assert got["b"] == [2.0, 5.0, -1.0, None, 1.0]
+
+
+def test_sort_desc_strings():
+    schema = Schema([Field("s", DataType.string(8))])
+    src = mem({"s": ["pear", "apple", "fig", None]}, schema)
+    got = collect_dict(SortExec(src, [SortField(col("s"), False, False)]))
+    assert got["s"] == ["pear", "fig", "apple", None]
+
+
+def test_sort_fetch_topk():
+    schema = Schema([Field("a", DataType.int64())])
+    src = mem({"a": list(range(100, 0, -1))}, schema, n_parts=3)
+    got = collect_dict(SortExec(src, [SortField(col("a"))], fetch=5))
+    # collect() concatenates per-partition top-5s; single-partition check:
+    one = SortExec(mem({"a": list(range(100, 0, -1))}, schema), [SortField(col("a"))], fetch=5)
+    assert collect_dict(one)["a"] == [1, 2, 3, 4, 5]
+
+
+def test_limit_union_rename_coalesce():
+    src1 = mem({"a": [1, 2, 3], "b": [1, 2, 3]}, INT_SCHEMA)
+    src2 = mem({"a": [4, 5], "b": [4, 5]}, INT_SCHEMA)
+    u = UnionExec([src1, src2])
+    got = collect_dict(LimitExec(u, 4))
+    assert len(got["a"]) == 4
+    r = RenameColumnsExec(mem({"a": [1], "b": [2]}, INT_SCHEMA), ["x", "y"])
+    assert collect_dict(r) == {"x": [1], "y": [2]}
+    c = CoalesceBatchesExec(UnionExec([mem({"a": [1], "b": [1]}, INT_SCHEMA), mem({"a": [2], "b": [2]}, INT_SCHEMA)]))
+    batches = c.collect()
+    assert sum(b.num_rows for b in batches) == 2
+
+
+def test_expand():
+    src = mem({"a": [1, 2], "b": [10, 20]}, INT_SCHEMA)
+    e = ExpandExec(
+        src,
+        [[col("a"), lit(0).cast(DataType.int64())], [col("a"), col("b")]],
+        ["a", "tag"],
+    )
+    got = collect_dict(e)
+    assert sorted_rows(got) == sorted_rows({"a": [1, 2, 1, 2], "tag": [0, 0, 10, 20]})
+
+
+LEFT = {"k": [1, 2, 2, 3, None], "lv": [10, 20, 21, 30, 99]}
+RIGHT = {"k2": [2, 2, 3, 4, None], "rv": [200, 201, 300, 400, 999]}
+L_SCHEMA = Schema([Field("k", DataType.int64()), Field("lv", DataType.int64())])
+R_SCHEMA = Schema([Field("k2", DataType.int64()), Field("rv", DataType.int64())])
+
+
+def _join(jt, cls=HashJoinExec, build_left=False):
+    left = mem(LEFT, L_SCHEMA)
+    right = mem(RIGHT, R_SCHEMA)
+    if cls is SortMergeJoinExec:
+        left = SortExec(left, [SortField(col("k"))])
+        right = SortExec(right, [SortField(col("k2"))])
+        return SortMergeJoinExec(left, right, [col("k")], [col("k2")], jt)
+    if build_left:
+        return cls(left, right, [col("k")], [col("k2")], jt, build_is_left=True)
+    return cls(right, left, [col("k2")], [col("k")], jt, build_is_left=False)
+
+
+INNER_EXPECTED = sorted_rows(
+    {"k": [2, 2, 2, 2, 3], "lv": [20, 20, 21, 21, 30], "k2": [2, 2, 2, 2, 3], "rv": [200, 201, 200, 201, 300]}
+)
+
+
+def _rows(node):
+    d = collect_dict(node)
+    names = list(d.keys())
+    return sorted_rows(d), names
+
+
+@pytest.mark.parametrize("cls,build_left", [
+    (HashJoinExec, False), (HashJoinExec, True),
+    (BroadcastJoinExec, False), (BroadcastJoinExec, True),
+    (SortMergeJoinExec, False),
+])
+def test_join_inner(cls, build_left):
+    rows, _ = _rows(_join(JoinType.INNER, cls, build_left))
+    assert len(rows) == 5
+    ks = sorted(r[0] for r in rows)
+    assert ks == [2, 2, 2, 2, 3]
+
+
+@pytest.mark.parametrize("cls", [HashJoinExec, SortMergeJoinExec])
+def test_join_left_outer(cls):
+    rows, _ = _rows(_join(JoinType.LEFT, cls))
+    # 5 matched + unmatched lv 10 (k=1) and 99 (k=None)
+    assert len(rows) == 7
+    unmatched = [r for r in rows if r[3] is None]
+    assert sorted(r[1] for r in unmatched) == [10, 99]
+
+
+def test_join_right_outer():
+    rows, _ = _rows(_join(JoinType.RIGHT, HashJoinExec))
+    assert len(rows) == 7
+    unmatched = [r for r in rows if r[0] is None and r[1] is None]
+    assert sorted(r[3] for r in unmatched) == [400, 999]
+
+
+def test_join_full_outer():
+    rows, _ = _rows(_join(JoinType.FULL, HashJoinExec))
+    assert len(rows) == 9
+
+
+def test_join_semi_anti():
+    rows, _ = _rows(_join(JoinType.LEFT_SEMI, HashJoinExec))
+    assert sorted(r[1] for r in rows) == [20, 21, 30]
+    rows, _ = _rows(_join(JoinType.LEFT_ANTI, HashJoinExec))
+    assert sorted(r[1] for r in rows) == [10, 99]
+
+
+def test_join_existence():
+    rows, names = _rows(_join(JoinType.EXISTENCE, HashJoinExec))
+    assert len(rows) == 5
+    by_lv = {r[1]: r[2] for r in rows}
+    assert by_lv[10] is False and by_lv[20] is True and by_lv[30] is True and by_lv[99] is False
+
+
+def test_window_rank_rownumber():
+    schema = Schema([Field("g", DataType.int32()), Field("v", DataType.int64())])
+    src = mem({"g": [1, 1, 1, 2, 2], "v": [5, 5, 7, 1, 2]}, schema)
+    pre = SortExec(src, [SortField(col("g")), SortField(col("v"))])
+    w = WindowExec(
+        pre,
+        [
+            WindowFunction("row_number", "rn"),
+            WindowFunction("rank", "rk"),
+            WindowFunction("dense_rank", "dr"),
+            WindowFunction("sum", "rs", col("v")),
+        ],
+        [col("g")],
+        [SortField(col("v"))],
+    )
+    got = collect_dict(w)
+    assert got["rn"] == [1, 2, 3, 1, 2]
+    assert got["rk"] == [1, 1, 3, 1, 2]
+    assert got["dr"] == [1, 1, 2, 1, 2]
+    # default RANGE frame: peers (5,5) share the running sum 10
+    assert got["rs"] == [10, 10, 17, 1, 3]
+
+
+def test_generate_json_tuple():
+    schema = Schema([Field("j", DataType.string(64))])
+    src = mem({"j": ['{"a":"1","b":"x"}', '{"a":"2"}', "oops", None]}, schema)
+    g = GenerateExec(
+        src,
+        json_tuple_generator(["a", "b"]),
+        [col("j")],
+        [Field("a", DataType.string(16)), Field("b", DataType.string(16))],
+    )
+    got = collect_dict(g)
+    assert got["a"] == ["1", "2", None, None]
+    assert got["b"] == ["x", None, None, None]
