@@ -1,0 +1,13 @@
+"""IO: columnar batch wire format + framed compression.
+
+≙ reference ``datafusion-ext-commons``: io/batch_serde.rs (the shuffle/
+spill wire format) and common/ipc_compression.rs (framed compressed
+blocks)."""
+
+from .batch_serde import deserialize_batch, serialize_batch
+from .ipc_compression import IpcFrameReader, IpcFrameWriter, compress_frame, decompress_frame
+
+__all__ = [
+    "serialize_batch", "deserialize_batch",
+    "IpcFrameWriter", "IpcFrameReader", "compress_frame", "decompress_frame",
+]

@@ -1,0 +1,93 @@
+"""Columnar batch (de)serialization — the shuffle and spill wire format.
+
+≙ reference io/batch_serde.rs:34-97 (schemaless length-prefixed
+columnar serde; the reader recovers the schema from plan context).
+Layout per batch (little-endian):
+
+    u32 num_rows
+    per column:
+        u8  has_lengths (string column)
+        u32 data_nbytes      | raw data buffer (trimmed to num_rows)
+        [u32 width]          | strings only: padded byte width
+        bitmap               | validity, ceil(num_rows/8) bytes
+        [lengths]            | strings only: num_rows * i32
+
+Buffers are trimmed to ``num_rows`` (padding never crosses the wire)
+and re-bucketed on read.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List
+
+import numpy as np
+
+from ..batch import Column, RecordBatch, bucket_capacity, _pad_1d
+from ..schema import Schema
+
+
+def serialize_batch(batch: RecordBatch) -> bytes:
+    b = batch.to_host()
+    n = b.num_rows
+    out: List[bytes] = [struct.pack("<I", n)]
+    for c in b.columns:
+        data = np.asarray(c.data)[:n]
+        validity = np.packbits(np.asarray(c.validity)[:n], bitorder="little").tobytes()
+        if c.lengths is not None:
+            raw = np.ascontiguousarray(data).tobytes()
+            out.append(struct.pack("<BI", 1, len(raw)))
+            out.append(struct.pack("<I", data.shape[1] if data.ndim == 2 else 0))
+            out.append(raw)
+            out.append(validity)
+            out.append(np.asarray(c.lengths)[:n].astype(np.int32).tobytes())
+        else:
+            raw = np.ascontiguousarray(data).tobytes()
+            out.append(struct.pack("<BI", 0, len(raw)))
+            out.append(raw)
+            out.append(validity)
+    return b"".join(out)
+
+
+def deserialize_batch(data: bytes, schema: Schema) -> RecordBatch:
+    off = 0
+    (n,) = struct.unpack_from("<I", data, off)
+    off += 4
+    cap = bucket_capacity(max(n, 1))
+    cols: List[Column] = []
+    vbytes = (n + 7) // 8
+    for f in schema.fields:
+        has_len, nbytes = struct.unpack_from("<BI", data, off)
+        off += 5
+        if has_len:
+            (width,) = struct.unpack_from("<I", data, off)
+            off += 4
+            raw = np.frombuffer(data, np.uint8, count=nbytes, offset=off).reshape(n, width) if n else np.zeros((0, width), np.uint8)
+            off += nbytes
+            validity = np.unpackbits(
+                np.frombuffer(data, np.uint8, count=vbytes, offset=off), bitorder="little"
+            )[:n].astype(np.bool_)
+            off += vbytes
+            lengths = np.frombuffer(data, np.int32, count=n, offset=off)
+            off += 4 * n
+            d = np.zeros((cap, width), np.uint8)
+            d[:n] = raw
+            cols.append(
+                Column(
+                    f.dtype,
+                    d,
+                    _pad_1d(validity, cap),
+                    _pad_1d(lengths.copy(), cap),
+                )
+            )
+        else:
+            dt = f.dtype.np_dtype
+            count = nbytes // dt.itemsize
+            raw = np.frombuffer(data, dt, count=count, offset=off)
+            off += nbytes
+            validity = np.unpackbits(
+                np.frombuffer(data, np.uint8, count=vbytes, offset=off), bitorder="little"
+            )[:n].astype(np.bool_)
+            off += vbytes
+            cols.append(Column(f.dtype, _pad_1d(raw.copy(), cap), _pad_1d(validity, cap)))
+    return RecordBatch(schema, cols, n)

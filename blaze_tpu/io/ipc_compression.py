@@ -1,0 +1,81 @@
+"""Framed compressed IPC blocks: ``[u32 len][u8 codec][payload]``.
+
+≙ reference common/ipc_compression.rs:30-335 (same framing idea; the
+reference speaks zstd(1)/lz4 per spark.io.compression.codec with 4 MiB
+target blocks).  Codecs here: 0=raw, 1=zlib(1) (zstd/lz4 libs are not
+in the image; the codec byte keeps the format extensible and the C++
+runtime can add them).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import BinaryIO, Iterator, Optional
+
+from .. import conf
+
+TARGET_BLOCK = 4 << 20
+
+CODEC_RAW = 0
+CODEC_ZLIB = 1
+
+
+def _codec_id(name: str) -> int:
+    return CODEC_ZLIB if name in ("zlib", "zstd", "lz4") else CODEC_RAW
+
+
+def compress_frame(payload: bytes, codec: Optional[str] = None) -> bytes:
+    cid = _codec_id(codec or str(conf.IO_COMPRESSION_CODEC.get()))
+    if cid == CODEC_ZLIB:
+        comp = zlib.compress(payload, 1)
+        if len(comp) < len(payload):
+            return struct.pack("<IB", len(comp), CODEC_ZLIB) + comp
+    return struct.pack("<IB", len(payload), CODEC_RAW) + payload
+
+
+def decompress_frame(frame: bytes) -> bytes:
+    ln, cid = struct.unpack_from("<IB", frame, 0)
+    payload = frame[5 : 5 + ln]
+    if cid == CODEC_ZLIB:
+        return zlib.decompress(payload)
+    return payload
+
+
+class IpcFrameWriter:
+    """Accumulates payloads into frames on a binary stream."""
+
+    def __init__(self, f: BinaryIO, codec: Optional[str] = None):
+        self._f = f
+        self._codec = codec
+        self.bytes_written = 0
+
+    def write(self, payload: bytes) -> int:
+        frame = compress_frame(payload, self._codec)
+        self._f.write(frame)
+        self.bytes_written += len(frame)
+        return len(frame)
+
+
+class IpcFrameReader:
+    """Iterates frames from a binary stream (bounded by ``limit`` bytes
+    when reading a file segment)."""
+
+    def __init__(self, f: BinaryIO, limit: Optional[int] = None):
+        self._f = f
+        self._remaining = limit
+
+    def __iter__(self) -> Iterator[bytes]:
+        while True:
+            if self._remaining is not None and self._remaining <= 0:
+                return
+            hdr = self._f.read(5)
+            if len(hdr) < 5:
+                return
+            ln, cid = struct.unpack("<IB", hdr)
+            payload = self._f.read(ln)
+            if self._remaining is not None:
+                self._remaining -= 5 + ln
+            if cid == CODEC_ZLIB:
+                payload = zlib.decompress(payload)
+            yield payload

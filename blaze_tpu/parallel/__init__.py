@@ -1,0 +1,31 @@
+"""Parallelism & exchange — ≙ SURVEY.md §2.3.
+
+- shuffle: Spark-compatible hash-partition exchange (murmur3 pmod pid
+  computed ON DEVICE, sort-by-pid repartitioner, ``.data``/``.index``
+  files, framed compressed IPC) ≙ reference shuffle/ +
+  shuffle_writer_exec.rs + ipc_reader_exec.rs + BlazeShuffleManager
+- broadcast: collect-to-IPC-bytes exchange ≙
+  NativeBroadcastExchangeBase.collectNative
+- ici: the TPU fast path — all-to-all over a jax.sharding.Mesh for
+  executors co-located on one slice (DCN/disk shuffle remains the
+  cross-host path, exactly as SURVEY.md §5 prescribes)
+"""
+
+from .shuffle import (
+    HashPartitioning,
+    IpcReaderExec,
+    LocalShuffleManager,
+    Partitioning,
+    RoundRobinPartitioning,
+    ShuffleWriterExec,
+    SinglePartitioning,
+)
+from .broadcast import BroadcastExchangeExec, IpcWriterExec
+from .exchange import NativeShuffleExchangeExec, default_shuffle_manager
+
+__all__ = [
+    "Partitioning", "HashPartitioning", "SinglePartitioning",
+    "RoundRobinPartitioning", "ShuffleWriterExec", "IpcReaderExec",
+    "LocalShuffleManager", "BroadcastExchangeExec", "IpcWriterExec",
+    "NativeShuffleExchangeExec", "default_shuffle_manager",
+]

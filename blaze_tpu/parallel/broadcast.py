@@ -1,0 +1,87 @@
+"""Broadcast exchange.
+
+≙ reference NativeBroadcastExchangeBase (doExecuteBroadcastNative /
+collectNative, NativeBroadcastExchangeBase.scala:138-230) +
+IpcWriterExec (ipc_writer_exec.rs): the child's partitions are drained
+into framed IPC bytes, the bytes are the broadcast payload, and
+downstream BroadcastJoin partitions re-read them replicated.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+from ..batch import RecordBatch
+from ..io.batch_serde import deserialize_batch, serialize_batch
+from ..io.ipc_compression import compress_frame, decompress_frame
+from ..ops.base import BatchStream, ExecNode
+from ..runtime.context import RESOURCES, TaskContext
+from ..schema import Schema
+
+
+class IpcWriterExec(ExecNode):
+    """Drains the child into IPC frames registered under a resource id
+    (the broadcast collect path)."""
+
+    def __init__(self, child: ExecNode, resource_id: str):
+        super().__init__([child])
+        self.resource_id = resource_id
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            frames: List[bytes] = []
+            for b in self.children[0].execute(partition, ctx):
+                frames.append(compress_frame(serialize_batch(b)))
+            ctx.resources.put(f"{self.resource_id}.{partition}", b"".join(frames))
+            return
+            yield  # pragma: no cover
+
+        return stream()
+
+
+class BroadcastExchangeExec(ExecNode):
+    """Collects ALL child partitions once into IPC bytes; every output
+    partition replays the full payload (replicated)."""
+
+    def __init__(self, child: ExecNode):
+        super().__init__([child])
+        self._payload: Optional[List[bytes]] = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def collect_ipc(self, ctx: Optional[TaskContext] = None) -> List[bytes]:
+        """≙ collectNative: one IPC byte-blob per child partition."""
+        if self._payload is None:
+            child = self.children[0]
+            out: List[bytes] = []
+            for p in range(child.num_partitions()):
+                c = ctx or TaskContext(p, child.num_partitions())
+                frames = [compress_frame(serialize_batch(b)) for b in child.execute(p, c)]
+                out.append(b"".join(frames))
+            self._payload = out
+        return self._payload
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            for blob in self.collect_ipc(ctx):
+                off = 0
+                while off < len(blob):
+                    ln, _ = struct.unpack_from("<IB", blob, off)
+                    payload = decompress_frame(blob[off : off + 5 + ln])
+                    off += 5 + ln
+                    b = deserialize_batch(payload, self.schema)
+                    if b.num_rows:
+                        self.metrics.add("output_rows", b.num_rows)
+                        yield b.to_device()
+
+        return stream()

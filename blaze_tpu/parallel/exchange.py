@@ -1,0 +1,101 @@
+"""Shuffle exchange plan node.
+
+≙ reference NativeShuffleExchangeBase.doExecuteNative
+(NativeShuffleExchangeBase.scala:100-156): the map side runs
+ShuffleWriterExec per upstream partition (one "task" each, writing
+.data/.index through the shuffle manager), the reduce side registers
+block iterators in the resources map and reads them back through
+IpcReaderExec — the exact JNI rendezvous pattern, minus the JVM.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+from ..ops.base import BatchStream, ExecNode
+from ..runtime.context import RESOURCES, TaskContext
+from ..runtime.metrics import MetricNode
+from ..schema import Schema
+from .shuffle import (
+    HashPartitioning,
+    IpcReaderExec,
+    LocalShuffleManager,
+    Partitioning,
+    ShuffleWriterExec,
+)
+
+_shuffle_ids = itertools.count()
+_default_manager: Optional[LocalShuffleManager] = None
+_mgr_lock = threading.Lock()
+
+
+def default_shuffle_manager() -> LocalShuffleManager:
+    global _default_manager
+    with _mgr_lock:
+        if _default_manager is None:
+            _default_manager = LocalShuffleManager()
+        return _default_manager
+
+
+class NativeShuffleExchangeExec(ExecNode):
+    def __init__(
+        self,
+        child: ExecNode,
+        partitioning: Partitioning,
+        manager: Optional[LocalShuffleManager] = None,
+        parallel_map_tasks: int = 4,
+    ):
+        super().__init__([child])
+        self.partitioning = partitioning
+        self.manager = manager or default_shuffle_manager()
+        self.shuffle_id = next(_shuffle_ids)
+        self.parallel_map_tasks = parallel_map_tasks
+        self._materialized = False
+        self._lock = threading.Lock()
+        self._reader = IpcReaderExec(
+            child.schema,
+            f"shuffle_{self.shuffle_id}",
+            partitioning.num_partitions,
+        )
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    def _run_map_task(self, map_id: int) -> None:
+        data, index = self.manager.map_output_paths(self.shuffle_id, map_id)
+        writer = ShuffleWriterExec(self.children[0], self.partitioning, data, index)
+        writer.metrics = self.metrics  # share metric set across map tasks
+        ctx = TaskContext(map_id, self.children[0].num_partitions())
+        for _ in writer.execute(map_id, ctx):
+            pass
+
+    def materialize(self) -> None:
+        """Run all map tasks once (the stage boundary)."""
+        with self._lock:
+            if self._materialized:
+                return
+            n_maps = self.children[0].num_partitions()
+            if self.parallel_map_tasks > 1 and n_maps > 1:
+                with ThreadPoolExecutor(max_workers=self.parallel_map_tasks) as pool:
+                    list(pool.map(self._run_map_task, range(n_maps)))
+            else:
+                for m in range(n_maps):
+                    self._run_map_task(m)
+            self._materialized = True
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            self.materialize()
+            n_maps = self.children[0].num_partitions()
+            blocks = self.manager.reduce_blocks(self.shuffle_id, n_maps, partition)
+            ctx.resources.put(f"shuffle_{self.shuffle_id}.{partition}", blocks)
+            yield from self._reader.execute(partition, ctx)
+
+        return stream()

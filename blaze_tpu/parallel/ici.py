@@ -1,0 +1,125 @@
+"""ICI all-to-all shuffle: the TPU fast path for the hash-partition
+exchange when the exchanging tasks are devices of one slice.
+
+≙ SURVEY.md §2.3/§5: "partition-id computation is a pure function of
+murmur3(seed 42) pmod N, so it can run as a TPU kernel and feed either
+path" — here it feeds ``lax.all_to_all`` over a ``jax.sharding.Mesh``
+(XLA inserts the ICI collective), while parallel/shuffle.py remains the
+disk/DCN path across hosts.
+
+Shape strategy: each device routes its rows into ``n_dev`` fixed-size
+buckets (count-then-compact per destination), all_to_all swaps the
+buckets, and receivers compact the concatenation.  Fixed bucket
+capacity keeps everything shape-static for XLA; the padding traded for
+that is pure ICI bandwidth, which is exactly the resource the fast path
+has in abundance.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..batch import Column, RecordBatch
+from ..exprs.compile import lower
+from ..exprs.hash import murmur3_columns, pmod
+from ..exprs.ir import Expr
+from ..schema import Schema
+from .mesh import DATA_AXIS
+
+
+def _bucketize(cols: Tuple[Column, ...], pids, live, n_dev: int):
+    """Route local rows into n_dev fixed-capacity buckets."""
+    cap = pids.shape[0]
+    out_data = []
+    counts = []
+    for d in range(n_dev):
+        keep = live & (pids == d)
+        cnt = jnp.sum(keep.astype(jnp.int32))
+        idx = jnp.nonzero(keep, size=cap, fill_value=0)[0]
+        bucket_live = jnp.arange(cap) < cnt
+        bcols = []
+        for c in cols:
+            t = c.take(idx)
+            bcols.append(
+                Column(
+                    c.dtype,
+                    t.data,
+                    t.validity & bucket_live,
+                    None if t.lengths is None else jnp.where(bucket_live, t.lengths, 0),
+                )
+            )
+        out_data.append(tuple(bcols))
+        counts.append(cnt)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *out_data)
+    return stacked, jnp.stack(counts)
+
+
+def ici_exchange_fn(schema: Schema, key_exprs: Sequence[Expr], n_dev: int):
+    """Builds the per-device shard_map body: (local cols, num_rows) ->
+    (received cols [n_dev*cap], received counts [n_dev])."""
+
+    def body(cols: Tuple[Column, ...], num_rows):
+        cap = cols[0].data.shape[0]
+        env = {f.name: c for f, c in zip(schema.fields, cols)}
+        key_cols = [lower(e, schema, env, cap) for e in key_exprs]
+        pids = pmod(murmur3_columns(key_cols), n_dev)
+        live = jnp.arange(cap) < num_rows
+        buckets, counts = _bucketize(cols, pids, live, n_dev)
+
+        a2a = lambda x: jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=True)
+        received = jax.tree.map(a2a, buckets)
+        recv_counts = jax.lax.all_to_all(counts, DATA_AXIS, 0, 0, tiled=True)
+
+        # flatten (n_dev, cap, ...) -> (n_dev*cap, ...) and compact
+        def flat(x):
+            return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+        flat_cols = []
+        for i in range(len(cols)):
+            c = received.columns[i] if isinstance(received, RecordBatch) else received[i]
+            flat_cols.append(Column(c.dtype, flat(c.data), flat(c.validity),
+                                    None if c.lengths is None else flat(c.lengths)))
+        # compact: received rows are bucket-padded; keep = index-within-
+        # bucket < sender count
+        within = jnp.tile(jnp.arange(cap), n_dev)
+        sender = jnp.repeat(jnp.arange(n_dev), cap)
+        keep = within < jnp.take(recv_counts, sender)
+        from ..ops.filter import compact_columns
+
+        out_cols, total = compact_columns(tuple(flat_cols), keep)
+        return out_cols, total
+
+    return body
+
+
+def ici_shuffle(
+    mesh: Mesh,
+    batch: RecordBatch,
+    num_rows_per_shard,
+    key_exprs: Sequence[Expr],
+):
+    """Run one all-to-all hash exchange over the mesh.  ``batch`` holds
+    the global arrays sharded on axis 0 (each device: cap rows);
+    ``num_rows_per_shard`` is an int32[n_dev] of live counts."""
+    n_dev = mesh.devices.size
+    schema = batch.schema
+    body = ici_exchange_fn(schema, key_exprs, n_dev)
+
+    def wrapped(cols, nr):
+        out_cols, total = body(cols, nr[0])
+        return out_cols, total[None]  # scalar -> (1,) per device for P("data")
+
+    smapped = jax.shard_map(
+        wrapped,
+        mesh=mesh,
+        in_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(DATA_AXIS)),
+        out_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(DATA_AXIS)),
+    )
+    out_cols, totals = jax.jit(smapped)(tuple(batch.columns), num_rows_per_shard)
+    return out_cols, totals

@@ -1,0 +1,34 @@
+"""Device mesh helpers.
+
+The executor model (SURVEY.md §2.3): Spark tasks are the outer data
+parallelism; when one executor owns a TPU slice, the devices of that
+slice form a mesh and the shuffle between them rides ICI collectives
+instead of disk (ici.py).  Cross-host exchange stays on the Spark
+shuffle / Celeborn path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        assert len(devs) >= n_devices, f"need {n_devices} devices, have {len(devs)}"
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (DATA_AXIS,))
+
+
+def sharded(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
