@@ -494,7 +494,7 @@ def needs_host(expr: Expr) -> bool:
     elif isinstance(expr, Like):
         children = [expr.child]
     elif isinstance(expr, Case):
-        children = [c for b in expr.branches for c in b] + ([expr.else_] if expr.else_ else [])
+        children = [c for b in expr.branches for c in b] + ([expr.else_] if expr.else_ is not None else [])
     elif isinstance(expr, ScalarFunc):
         children = expr.args
     return any(needs_host(c) for c in children)
@@ -526,7 +526,7 @@ def split_host_exprs(exprs: List[Expr]) -> Tuple[List[Expr], List[Tuple[str, Exp
         if isinstance(e, InList):
             return InList(walk(e.child), [walk(v) for v in e.values], e.negated)
         if isinstance(e, Case):
-            return Case([(walk(c), walk(v)) for c, v in e.branches], walk(e.else_) if e.else_ else None)
+            return Case([(walk(c), walk(v)) for c, v in e.branches], walk(e.else_) if e.else_ is not None else None)
         if isinstance(e, ScalarFunc):
             return ScalarFunc(e.name, [walk(a) for a in e.args])
         return e

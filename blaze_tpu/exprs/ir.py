@@ -42,6 +42,14 @@ class Expr:
     def __hash__(self):
         return id(self)
 
+    def __bool__(self):
+        # `==` builds a BinOp, so truthiness of an Expr is always a
+        # bug (e.g. a container equality check silently passing).
+        raise TypeError(
+            "Expr has no truth value (did you mean `is not None`, or are "
+            "Exprs being compared with `==` inside a container/cache?)"
+        )
+
     def is_null(self) -> "Expr":
         return IsNull(self)
 

@@ -237,6 +237,7 @@ class AggExec(ExecNode):
         else:
             self._schema = self._state_schema
 
+        self._merger: Optional["_StateMerger"] = None
         self._build_kernels(in_schema)
 
     @property
@@ -559,10 +560,10 @@ def _empty_batch(schema: Schema) -> RecordBatch:
 
 class _StateMerger:
     """Merge-mode reducer over the state schema (sum of sums etc.).
-    Built lazily per AggExec; the merge AggExec shares kernels via a
-    PARTIAL_MERGE-mode twin on the state schema."""
-
-    _cache: Dict[int, "_StateMerger"] = {}
+    Built lazily per AggExec INSTANCE (never keyed by id(): ids recycle
+    after GC and a stale twin silently merges with the wrong schema);
+    the merge kernels live in a PARTIAL_MERGE-mode twin on the state
+    schema."""
 
     def __init__(self, agg: "AggExec"):
         class _Src(ExecNode):
@@ -583,10 +584,9 @@ class _StateMerger:
 
     @classmethod
     def for_agg(cls, agg: "AggExec") -> "_StateMerger":
-        key = id(agg)
-        if key not in cls._cache:
-            cls._cache[key] = cls(agg)
-        return cls._cache[key]
+        if agg._merger is None:
+            agg._merger = cls(agg)
+        return agg._merger
 
     def reduce(self, state_batch: RecordBatch) -> RecordBatch:
         return self._twin._reduce_batch(state_batch.to_device(), state_batch.schema)

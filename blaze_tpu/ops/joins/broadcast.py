@@ -10,17 +10,14 @@ probe partition streams against it.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 from ...batch import RecordBatch, concat_batches
 from ...exprs.ir import Expr
 from ...runtime.context import TaskContext
 from ...schema import Schema
 from ..base import BatchStream, ExecNode
-from .core import Joiner, JoinMap, JoinType
-
-_map_cache: Dict[int, JoinMap] = {}
-_map_lock = threading.Lock()
+from .core import Joiner, JoinerState, JoinMap, JoinType
 
 
 class BroadcastJoinExec(ExecNode):
@@ -38,24 +35,25 @@ class BroadcastJoinExec(ExecNode):
         self.probe_keys = list(probe_keys)
         self.join_type = join_type
         self.build_is_left = build_is_left
-        self._joiner_proto = Joiner(
+        self._joiner = Joiner(
             probe.schema, build.schema, probe_keys, build_keys, join_type,
             probe_is_left=not build_is_left,
         )
+        # per-executor cached map, built once across all probe partitions
+        self._cached_map: Optional[JoinMap] = None
+        self._map_lock = threading.Lock()
 
     @property
     def schema(self) -> Schema:
-        return self._joiner_proto.out_schema
+        return self._joiner.out_schema
 
     def num_partitions(self) -> int:
         return self.children[1].num_partitions()
 
     def _get_map(self, ctx: TaskContext) -> JoinMap:
-        key = id(self)
-        with _map_lock:
-            m = _map_cache.get(key)
-        if m is not None:
-            return m
+        with self._map_lock:
+            if self._cached_map is not None:
+                return self._cached_map
         with self.metrics.timer("build_hash_map_time"):
             build = self.children[0]
             batches: List[RecordBatch] = []
@@ -68,24 +66,20 @@ class BroadcastJoinExec(ExecNode):
                 from ...batch import batch_from_pydict
 
                 data = batch_from_pydict({f.name: [] for f in build.schema.fields}, build.schema)
-            m = JoinMap.build(data, self.build_keys)
-        with _map_lock:
-            _map_cache[key] = m
+            m = self._joiner.build_map(data)
+        with self._map_lock:
+            self._cached_map = m
         return m
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         def stream():
             jmap = self._get_map(ctx)
-            joiner = Joiner(
-                self.children[1].schema, self.children[0].schema,
-                self.probe_keys, self.build_keys, self.join_type,
-                probe_is_left=not self.build_is_left,
-            )
+            state = JoinerState()
             for batch in self.children[1].execute(partition, ctx):
                 if not ctx.is_task_running():
                     return
                 with self.metrics.timer("probe_time"):
-                    out = joiner.probe_batch(jmap, batch)
+                    out = self._joiner.probe_batch(jmap, batch, state)
                 if out is not None and out.num_rows:
                     self.metrics.add("output_rows", out.num_rows)
                     yield out
@@ -93,7 +87,7 @@ class BroadcastJoinExec(ExecNode):
             # sees every probe partition (standalone runs); Spark-mode
             # planning must route such joins to the shuffled-hash path
             if partition == self.num_partitions() - 1 or self.num_partitions() == 1:
-                tail = joiner.finish(jmap)
+                tail = self._joiner.finish(jmap, state)
                 if tail is not None:
                     self.metrics.add("output_rows", tail.num_rows)
                     yield tail

@@ -5,6 +5,10 @@ serialization for broadcast) — rebuilt for XLA: no pointer chasing, no
 data-dependent probe loops; everything is sort, searchsorted, cumsum,
 gather.  The map itself is a pytree of three device arrays, trivially
 serializable/broadcastable like the reference's raw-bytes map.
+
+All kernels are per-Joiner jitted closures — Exprs never appear as jit
+static arguments (Expr.__eq__ builds IR nodes, which poisons any
+hash-keyed cache comparison).
 """
 
 from __future__ import annotations
@@ -55,17 +59,11 @@ class JoinMap:
         sk, sr, batch = children
         return cls(sk, sr, aux[0], batch)
 
-    @staticmethod
-    def build(batch: RecordBatch, key_exprs: Sequence[Expr]) -> "JoinMap":
-        """Device build (jitted per schema/capacity)."""
-        sk, sr = _build_kernel(tuple(batch.columns), batch.schema, tuple(key_exprs), batch.num_rows)
-        return JoinMap(sk, sr, batch.num_rows, batch)
-
 
 _SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
-def _key_hash(cols: Sequence[Column], n: int) -> jnp.ndarray:
+def _key_hash(cols: Sequence[Column]) -> jnp.ndarray:
     """uint64 key hash; rows with ANY null key get the sentinel (null
     never equals null in join equality)."""
     h = xxhash64_columns(cols).view(jnp.uint64)
@@ -75,25 +73,10 @@ def _key_hash(cols: Sequence[Column], n: int) -> jnp.ndarray:
     return jnp.where(all_valid, h, _SENTINEL)
 
 
-from functools import partial
-
-
-@partial(jax.jit, static_argnames=("schema", "key_exprs"))
-def _build_kernel(cols, schema, key_exprs, num_rows):
-    cap = cols[0].data.shape[0]
-    env = {f.name: c for f, c in zip(schema.fields, cols)}
-    key_cols = [lower(e, schema, env, cap) for e in key_exprs]
-    live = jnp.arange(cap) < num_rows
-    keys = jnp.where(live, _key_hash(key_cols, cap), _SENTINEL)
-    rows = jnp.arange(cap, dtype=jnp.int32)
-    sk, sr = jax.lax.sort((keys, rows), num_keys=1)
-    return sk, sr
-
-
-def probe_counts(jmap: JoinMap, probe_keys: jnp.ndarray):
+def probe_counts(jmap_keys, probe_keys):
     """(lo, counts) of candidate ranges per probe row."""
-    lo = jnp.searchsorted(jmap.sorted_keys, probe_keys, side="left")
-    hi = jnp.searchsorted(jmap.sorted_keys, probe_keys, side="right")
+    lo = jnp.searchsorted(jmap_keys, probe_keys, side="left")
+    hi = jnp.searchsorted(jmap_keys, probe_keys, side="right")
     is_sent = probe_keys == _SENTINEL
     counts = jnp.where(is_sent, 0, hi - lo)
     return lo, counts
@@ -145,59 +128,19 @@ def _null_columns(schema: Schema, cap: int) -> List[Column]:
     return cols
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "probe_schema", "probe_key_exprs", "build_key_exprs", "out_cap",
-        "emit_probe_nulls_for_unmatched", "probe_preserved", "build_schema",
-    ),
-)
-def _probe_kernel(
-    probe_cols,
-    probe_schema,
-    probe_key_exprs,
-    build_key_exprs,
-    jmap: JoinMap,
-    probe_rows,
-    out_cap: int,
-    probe_preserved: bool,
-    emit_probe_nulls_for_unmatched: bool,
-    build_schema,
-):
-    """Returns (pair probe idx, pair build idx, keep mask, verified
-    per-probe match counts, matched-build scatter flags)."""
-    cap = probe_cols[0].data.shape[0]
-    env = {f.name: c for f, c in zip(probe_schema.fields, probe_cols)}
-    probe_keys_cols = [lower(e, probe_schema, env, cap) for e in probe_key_exprs]
-    live = jnp.arange(cap) < probe_rows
-    pkeys = jnp.where(live, _key_hash(probe_keys_cols, cap), _SENTINEL)
+class JoinerState:
+    """Per-execution mutable state (matched-build flags accumulate
+    across probe batches)."""
 
-    lo, counts = probe_counts(jmap, pkeys)
-    p_idx, b_pos, pair_live = expand_pairs(lo, counts, out_cap)
-    b_idx = jnp.take(jmap.sorted_rows, jnp.clip(b_pos, 0, jmap.sorted_rows.shape[0] - 1))
-
-    # verification against real key columns (collision + null safety)
-    benv = {f.name: c for f, c in zip(jmap.batch.schema.fields, jmap.batch.columns)}
-    bcap = jmap.batch.capacity
-    build_keys_cols = [lower(e, jmap.batch.schema, benv, bcap) for e in build_key_exprs]
-    keep = pair_live
-    for pk, bk in zip(probe_keys_cols, build_keys_cols):
-        pk_g = pk.take(p_idx)
-        bk_g = bk.take(b_idx)
-        keep = keep & _eq_col(pk_g, bk_g)
-
-    # verified per-probe-row counts and per-build-row matched flags
-    vcounts = jax.ops.segment_sum(
-        keep.astype(jnp.int32), p_idx, num_segments=cap, indices_are_sorted=True
-    )
-    matched_build = jnp.zeros(bcap, jnp.bool_).at[b_idx].max(keep)
-    return p_idx, b_idx, keep, vcounts, matched_build
+    def __init__(self):
+        self.matched_build = None
 
 
 class Joiner:
-    """Drives probe batches against a JoinMap and materializes output
-    per join type.  The host syncs one scalar per batch (candidate
-    total) for output bucketing."""
+    """Build/probe driver for one join exec instance.  Kernels compile
+    once per (schema, capacity) via instance-owned jitted closures; the
+    host syncs one scalar per probe batch (candidate total) for output
+    bucketing."""
 
     def __init__(
         self,
@@ -211,12 +154,11 @@ class Joiner:
     ):
         self.probe_schema = probe_schema
         self.build_schema = build_schema
-        self.probe_keys = tuple(probe_key_exprs)
-        self.build_keys = tuple(build_key_exprs)
+        self.probe_keys = list(probe_key_exprs)
+        self.build_keys = list(build_key_exprs)
         self.join_type = join_type
         self.probe_is_left = probe_is_left
         self.existence_col = existence_col
-        self._matched_build = None  # accumulated across probe batches
 
         jt = join_type
         build_outer = (
@@ -224,53 +166,119 @@ class Joiner:
             or (jt == JoinType.RIGHT and probe_is_left)
             or (jt == JoinType.LEFT and not probe_is_left)
         )
+        self._build_outer = build_outer
         self._need_matched = build_outer or jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI)
+        self._probe_outer = (
+            jt == JoinType.FULL
+            or (jt == JoinType.LEFT and probe_is_left)
+            or (jt == JoinType.RIGHT and not probe_is_left)
+        )
+
         if jt == JoinType.EXISTENCE:
             self.out_schema = Schema(
                 list(probe_schema.fields) + [Field(existence_col, DataType.bool_())]
             )
         elif jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
-            self.out_schema = probe_schema if probe_is_left else build_schema
+            self.out_schema = probe_schema
         elif jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
-            self.out_schema = build_schema if probe_is_left else probe_schema
+            self.out_schema = build_schema
         else:
             left = probe_schema if probe_is_left else build_schema
             right = build_schema if probe_is_left else probe_schema
             self.out_schema = Schema(list(left.fields) + list(right.fields))
 
-    # candidate total estimation: max candidate pairs before verification
-    def _count_candidates(self, jmap: JoinMap, batch: RecordBatch) -> int:
-        total = _candidate_total(
-            tuple(batch.columns), batch.schema, self.probe_keys, jmap, batch.num_rows
-        )
-        return int(total)
+        build_keys = self.build_keys
+        probe_keys = self.probe_keys
 
-    def probe_batch(self, jmap: JoinMap, batch: RecordBatch) -> Optional[RecordBatch]:
+        @jax.jit
+        def build_kernel(cols: Tuple[Column, ...], num_rows):
+            cap = cols[0].data.shape[0]
+            env = {f.name: c for f, c in zip(build_schema.fields, cols)}
+            key_cols = [lower(e, build_schema, env, cap) for e in build_keys]
+            live = jnp.arange(cap) < num_rows
+            keys = jnp.where(live, _key_hash(key_cols), _SENTINEL)
+            rows = jnp.arange(cap, dtype=jnp.int32)
+            return jax.lax.sort((keys, rows), num_keys=1)
+
+        self._build_kernel = build_kernel
+
+        @jax.jit
+        def candidate_kernel(cols, jmap_keys, num_rows):
+            cap = cols[0].data.shape[0]
+            env = {f.name: c for f, c in zip(probe_schema.fields, cols)}
+            key_cols = [lower(e, probe_schema, env, cap) for e in probe_keys]
+            live = jnp.arange(cap) < num_rows
+            pkeys = jnp.where(live, _key_hash(key_cols), _SENTINEL)
+            _, counts = probe_counts(jmap_keys, pkeys)
+            return jnp.sum(counts)
+
+        self._candidate_kernel = candidate_kernel
+
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("out_cap",))
+        def probe_kernel(probe_cols, jmap: JoinMap, probe_rows, out_cap: int):
+            cap = probe_cols[0].data.shape[0]
+            env = {f.name: c for f, c in zip(probe_schema.fields, probe_cols)}
+            probe_key_cols = [lower(e, probe_schema, env, cap) for e in probe_keys]
+            live = jnp.arange(cap) < probe_rows
+            pkeys = jnp.where(live, _key_hash(probe_key_cols), _SENTINEL)
+
+            lo, counts = probe_counts(jmap.sorted_keys, pkeys)
+            p_idx, b_pos, pair_live = expand_pairs(lo, counts, out_cap)
+            b_idx = jnp.take(jmap.sorted_rows, jnp.clip(b_pos, 0, jmap.sorted_rows.shape[0] - 1))
+
+            benv = {f.name: c for f, c in zip(jmap.batch.schema.fields, jmap.batch.columns)}
+            bcap = jmap.batch.capacity
+            build_key_cols = [lower(e, build_schema, benv, bcap) for e in build_keys]
+            keep = pair_live
+            for pk, bk in zip(probe_key_cols, build_key_cols):
+                keep = keep & _eq_col(pk.take(p_idx), bk.take(b_idx))
+
+            vcounts = jax.ops.segment_sum(
+                keep.astype(jnp.int32), p_idx, num_segments=cap, indices_are_sorted=True
+            )
+            matched_build = jnp.zeros(bcap, jnp.bool_).at[b_idx].max(keep)
+
+            probe_g = tuple(c.take(p_idx) for c in probe_cols)
+            build_g = tuple(c.take(b_idx) for c in jmap.batch.columns)
+            all_cols, pair_count = compact_columns(probe_g + build_g, keep)
+            return all_cols, pair_count, vcounts, matched_build
+
+        self._probe_kernel = probe_kernel
+
+        @jax.jit
+        def compact_kernel(cols, keep):
+            return compact_columns(cols, keep)
+
+        self._compact_kernel = compact_kernel
+
+    # ------------------------------------------------------------ build
+
+    def build_map(self, batch: RecordBatch) -> JoinMap:
+        sk, sr = self._build_kernel(tuple(batch.columns), batch.num_rows)
+        return JoinMap(sk, sr, batch.num_rows, batch)
+
+    # ------------------------------------------------------------ probe
+
+    def probe_batch(
+        self, jmap: JoinMap, batch: RecordBatch, state: JoinerState
+    ) -> Optional[RecordBatch]:
         jt = self.join_type
-        cand = self._count_candidates(jmap, batch)
+        cand = int(self._candidate_kernel(tuple(batch.columns), jmap.sorted_keys, batch.num_rows))
+        out_cap = bucket_capacity(max(1, cand))
+        pair_cols, pair_count, vcounts, matched = self._probe_kernel(
+            tuple(batch.columns), jmap, batch.num_rows, out_cap
+        )
+        if self._need_matched:
+            state.matched_build = (
+                matched if state.matched_build is None else (state.matched_build | matched)
+            )
+
         semi_like = jt in (
             JoinType.LEFT_SEMI, JoinType.LEFT_ANTI, JoinType.RIGHT_SEMI,
             JoinType.RIGHT_ANTI, JoinType.EXISTENCE,
         )
-        out_cap = bucket_capacity(max(1, cand))
-        p_idx, b_idx, keep, vcounts, matched = _probe_kernel(
-            tuple(batch.columns),
-            batch.schema,
-            self.probe_keys,
-            self.build_keys,
-            jmap,
-            batch.num_rows,
-            out_cap,
-            True,
-            False,
-            jmap.batch.schema,
-        )
-        # accumulate matched-build flags for build-preserved emission
-        if self._need_matched:
-            self._matched_build = (
-                matched if self._matched_build is None else (self._matched_build | matched)
-            )
-
         if semi_like:
             has = vcounts > 0
             live = jnp.arange(batch.capacity) < batch.num_rows
@@ -282,64 +290,45 @@ class Joiner:
             if jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
                 return None  # emitted from build side at finish
             want = has if jt == JoinType.LEFT_SEMI else ~has
-            out_cols, count = _compact_jit(tuple(batch.columns), want & live)
+            out_cols, count = self._compact_kernel(tuple(batch.columns), want & live)
             n = int(count)
             return RecordBatch(self.out_schema, list(out_cols), n) if n else None
 
-        # inner/outer: gather pair columns, compact by keep
-        probe_g = [c.take(p_idx) for c in batch.columns]
-        build_g = [c.take(b_idx) for c in jmap.batch.columns]
-        out_cols, count = _pair_output(
-            tuple(probe_g), tuple(build_g), keep,
-        )
-        n = int(count)
+        n = int(pair_count)
         parts: List[RecordBatch] = []
         if n:
-            cols = list(out_cols[0]) + list(out_cols[1])
-            if not self.probe_is_left:
-                cols = list(out_cols[1]) + list(out_cols[0])
+            np_ = len(batch.columns)
+            probe_side = list(pair_cols[:np_])
+            build_side = list(pair_cols[np_:])
+            cols = probe_side + build_side if self.probe_is_left else build_side + probe_side
             parts.append(RecordBatch(self.out_schema, cols, n))
-        probe_outer = (
-            jt == JoinType.FULL
-            or (jt == JoinType.LEFT and self.probe_is_left)
-            or (jt == JoinType.RIGHT and not self.probe_is_left)
-        )
-        if probe_outer:
+        if self._probe_outer:
             live = jnp.arange(batch.capacity) < batch.num_rows
-            un_cols, un_count = _compact_jit(tuple(batch.columns), (vcounts == 0) & live)
+            un_cols, un_count = self._compact_kernel(tuple(batch.columns), (vcounts == 0) & live)
             un = int(un_count)
             if un:
                 nulls = _null_columns(self.build_schema, batch.capacity)
-                cols = list(un_cols) + nulls
-                if not self.probe_is_left:
-                    cols = nulls + list(un_cols)
+                cols = (list(un_cols) + nulls) if self.probe_is_left else (nulls + list(un_cols))
                 parts.append(RecordBatch(self.out_schema, cols, un))
         if not parts:
             return None
         return parts[0] if len(parts) == 1 else concat_batches(parts)
 
-    def finish(self, jmap: JoinMap) -> Optional[RecordBatch]:
-        """Emit build-side rows for right/full outer and right semi/anti
-        (probe side exhausted)."""
+    def finish(self, jmap: JoinMap, state: JoinerState) -> Optional[RecordBatch]:
+        """Emit build-side rows for right/full outer and build-side
+        semi/anti (probe side exhausted)."""
         jt = self.join_type
-        build_outer = (
-            jt == JoinType.FULL
-            or (jt == JoinType.RIGHT and self.probe_is_left)
-            or (jt == JoinType.LEFT and not self.probe_is_left)
-        )
-        if not (build_outer or jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI)):
+        if not (self._build_outer or jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI)):
             return None
-        matched = self._matched_build
+        matched = state.matched_build
         if matched is None:
             matched = jnp.zeros(jmap.batch.capacity, jnp.bool_)
         live = jnp.arange(jmap.batch.capacity) < jmap.num_rows
-        if jt in (JoinType.RIGHT_SEMI,):
+        if jt == JoinType.RIGHT_SEMI:
             want = matched & live
-        elif jt in (JoinType.RIGHT_ANTI,):
+        else:  # RIGHT_ANTI or build-preserved outer
             want = ~matched & live
-        else:
-            want = ~matched & live
-        out_cols, count = _compact_jit(tuple(jmap.batch.columns), want)
+        out_cols, count = self._compact_kernel(tuple(jmap.batch.columns), want)
         n = int(count)
         if not n:
             return None
@@ -348,29 +337,3 @@ class Joiner:
         nulls = _null_columns(self.probe_schema, jmap.batch.capacity)
         cols = (nulls + list(out_cols)) if self.probe_is_left else (list(out_cols) + nulls)
         return RecordBatch(self.out_schema, cols, n)
-
-
-@partial(jax.jit, static_argnames=("schema", "key_exprs"))
-def _candidate_total(cols, schema, key_exprs, jmap, num_rows):
-    cap = cols[0].data.shape[0]
-    env = {f.name: c for f, c in zip(schema.fields, cols)}
-    key_cols = [lower(e, schema, env, cap) for e in key_exprs]
-    live = jnp.arange(cap) < num_rows
-    pkeys = jnp.where(live, _key_hash(key_cols, cap), _SENTINEL)
-    _, counts = probe_counts(jmap, pkeys)
-    return jnp.sum(counts)
-
-
-@jax.jit
-def _compact_jit(cols, keep):
-    return compact_columns(cols, keep)
-
-
-@jax.jit
-def _pair_output(probe_g, build_g, keep):
-    """Compact candidate pairs by keep; returns ((probe cols, build
-    cols), count)."""
-    all_cols = tuple(probe_g) + tuple(build_g)
-    out, count = compact_columns(all_cols, keep)
-    np_ = len(probe_g)
-    return (out[:np_], out[np_:]), count

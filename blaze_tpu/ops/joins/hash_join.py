@@ -14,7 +14,7 @@ from ...exprs.ir import Expr
 from ...runtime.context import TaskContext
 from ...schema import Schema
 from ..base import BatchStream, ExecNode
-from .core import Joiner, JoinMap, JoinType
+from .core import Joiner, JoinerState, JoinType
 
 
 class HashJoinExec(ExecNode):
@@ -32,14 +32,14 @@ class HashJoinExec(ExecNode):
         self.probe_keys = list(probe_keys)
         self.join_type = join_type
         self.build_is_left = build_is_left
-        self._joiner_proto = Joiner(
+        self._joiner = Joiner(
             probe.schema, build.schema, probe_keys, build_keys, join_type,
             probe_is_left=not build_is_left,
         )
 
     @property
     def schema(self) -> Schema:
-        return self._joiner_proto.out_schema
+        return self._joiner.out_schema
 
     def num_partitions(self) -> int:
         return self.children[1].num_partitions()
@@ -57,21 +57,17 @@ class HashJoinExec(ExecNode):
                     data = batch_from_pydict(
                         {f.name: [] for f in build.schema.fields}, build.schema
                     )
-                jmap = JoinMap.build(data, self.build_keys)
-            joiner = Joiner(
-                self.children[1].schema, build.schema,
-                self.probe_keys, self.build_keys, self.join_type,
-                probe_is_left=not self.build_is_left,
-            )
+                jmap = self._joiner.build_map(data)
+            state = JoinerState()
             for batch in self.children[1].execute(partition, ctx):
                 if not ctx.is_task_running():
                     return
                 with self.metrics.timer("probe_time"):
-                    out = joiner.probe_batch(jmap, batch)
+                    out = self._joiner.probe_batch(jmap, batch, state)
                 if out is not None and out.num_rows:
                     self.metrics.add("output_rows", out.num_rows)
                     yield out
-            tail = joiner.finish(jmap)
+            tail = self._joiner.finish(jmap, state)
             if tail is not None:
                 self.metrics.add("output_rows", tail.num_rows)
                 yield tail

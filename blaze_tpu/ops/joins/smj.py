@@ -18,7 +18,7 @@ from ...exprs.ir import Expr
 from ...runtime.context import TaskContext
 from ...schema import Schema
 from ..base import BatchStream, ExecNode
-from .core import Joiner, JoinMap, JoinType
+from .core import Joiner, JoinerState, JoinType
 
 
 class SortMergeJoinExec(ExecNode):
@@ -38,14 +38,14 @@ class SortMergeJoinExec(ExecNode):
         self.right_keys = list(right_keys)
         self.join_type = join_type
         # probe = left (preserves left order); build = right
-        self._joiner_proto = Joiner(
+        self._joiner = Joiner(
             left.schema, right.schema, left_keys, right_keys, join_type,
             probe_is_left=True,
         )
 
     @property
     def schema(self) -> Schema:
-        return self._joiner_proto.out_schema
+        return self._joiner.out_schema
 
     def num_partitions(self) -> int:
         return self.children[0].num_partitions()
@@ -63,21 +63,17 @@ class SortMergeJoinExec(ExecNode):
                     data = batch_from_pydict(
                         {f.name: [] for f in right.schema.fields}, right.schema
                     )
-                jmap = JoinMap.build(data, self.right_keys)
-            joiner = Joiner(
-                self.children[0].schema, right.schema,
-                self.left_keys, self.right_keys, self.join_type,
-                probe_is_left=True,
-            )
+                jmap = self._joiner.build_map(data)
+            state = JoinerState()
             for batch in self.children[0].execute(partition, ctx):
                 if not ctx.is_task_running():
                     return
                 with self.metrics.timer("probe_time"):
-                    out = joiner.probe_batch(jmap, batch)
+                    out = self._joiner.probe_batch(jmap, batch, state)
                 if out is not None and out.num_rows:
                     self.metrics.add("output_rows", out.num_rows)
                     yield out
-            tail = joiner.finish(jmap)
+            tail = self._joiner.finish(jmap, state)
             if tail is not None:
                 self.metrics.add("output_rows", tail.num_rows)
                 yield tail

@@ -71,14 +71,6 @@ class RoundRobinPartitioning(Partitioning):
     num_partitions: int = 1
 
 
-@partial(jax.jit, static_argnames=("schema", "exprs", "n_out"))
-def _hash_pids(cols, schema, exprs, n_out, num_rows):
-    cap = cols[0].data.shape[0]
-    env = {f.name: c for f, c in zip(schema.fields, cols)}
-    key_cols = [lower(e, schema, env, cap) for e in exprs]
-    return pmod(murmur3_columns(key_cols), n_out)
-
-
 @partial(jax.jit, static_argnames=("n_out",))
 def _sort_by_pid(cols, pids, n_out, num_rows):
     """Sort rows by partition id; returns (sorted cols, counts[n_out])."""
@@ -210,6 +202,19 @@ class ShuffleWriterExec(ExecNode):
         self.data_path = data_path
         self.index_path = index_path
         self.partition_lengths: Optional[List[int]] = None
+        if isinstance(partitioning, HashPartitioning):
+            schema = child.schema
+            exprs = list(partitioning.exprs)
+            n_out = partitioning.num_partitions
+
+            @jax.jit
+            def hash_pids(cols, num_rows):
+                cap = cols[0].data.shape[0]
+                env = {f.name: c for f, c in zip(schema.fields, cols)}
+                key_cols = [lower(e, schema, env, cap) for e in exprs]
+                return pmod(murmur3_columns(key_cols), n_out)
+
+            self._hash_pids = hash_pids
 
     @property
     def schema(self) -> Schema:
@@ -227,10 +232,7 @@ class ShuffleWriterExec(ExecNode):
                         return
                     with self.metrics.timer("elapsed_compute"):
                         if isinstance(self.partitioning, HashPartitioning) and n_out > 1:
-                            pids = _hash_pids(
-                                tuple(batch.columns), batch.schema,
-                                tuple(self.partitioning.exprs), n_out, batch.num_rows,
-                            )
+                            pids = self._hash_pids(tuple(batch.columns), batch.num_rows)
                         elif isinstance(self.partitioning, RoundRobinPartitioning) and n_out > 1:
                             pids = (jnp.arange(batch.capacity, dtype=jnp.int32) + rr) % n_out
                             rr = (rr + batch.num_rows) % n_out

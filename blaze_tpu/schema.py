@@ -234,7 +234,15 @@ MAX_PRECISION = 38
 
 
 def _bounded(p: int, s: int) -> DataType:
-    return DataType.decimal(min(p, MAX_PRECISION), min(s, MAX_PRECISION))
+    """Spark's DecimalType.adjustPrecisionScale (allowPrecisionLoss):
+    when the ideal precision exceeds 38, keep the integral digits and
+    shrink the scale, but never below min(s, 6)."""
+    if p <= MAX_PRECISION:
+        return DataType.decimal(p, s)
+    digits = p - s
+    min_scale = min(s, 6)
+    adj_scale = max(MAX_PRECISION - digits, min_scale)
+    return DataType.decimal(MAX_PRECISION, adj_scale)
 
 
 def decimal_add_type(a: DataType, b: DataType) -> DataType:

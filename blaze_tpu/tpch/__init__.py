@@ -1,0 +1,12 @@
+"""TPC-H harness: deterministic data generation, query plans built on
+the operator layer, and a standalone session.
+
+≙ reference benchmark tooling (tpcds/datagen + benchmark-runner,
+SURVEY.md §4.4) and the differential validation strategy: tests compare
+engine results against independent numpy oracles per query, mirroring
+the reference's per-query TPC-DS validator against vanilla Spark.
+"""
+
+from .schema import TPCH_SCHEMAS
+from .datagen import generate_table, generate_all
+from .queries import QUERIES, build_query

@@ -1,0 +1,129 @@
+"""TPC-H differential validation: engine plans vs independent numpy
+oracles on generated data.
+
+≙ the reference's end-to-end correctness gate (SURVEY.md §4: per-query
+differential TPC-DS validation against vanilla Spark)."""
+
+import numpy as np
+import pytest
+
+from blaze_tpu.batch import batch_to_pydict
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.tpch import TPCH_SCHEMAS, build_query
+from blaze_tpu.tpch.datagen import generate_all, table_to_batches
+from blaze_tpu.tpch import oracle as O
+
+SCALE = 0.002
+N_PARTS = 2
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate_all(SCALE)
+
+
+@pytest.fixture(scope="module")
+def scans(data):
+    return {
+        name: MemoryScanExec(
+            table_to_batches(data[name], TPCH_SCHEMAS[name], N_PARTS, batch_rows=4096),
+            TPCH_SCHEMAS[name],
+        )
+        for name in TPCH_SCHEMAS
+    }
+
+
+def run(plan):
+    out = {f.name: [] for f in plan.schema.fields}
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            for k in out:
+                out[k].extend(d[k])
+    return out
+
+
+def test_q1(data, scans):
+    got = run(build_query("q1", scans, N_PARTS))
+    exp = O.oracle_q1(data)
+    keys = list(zip(got["l_returnflag"], got["l_linestatus"]))
+    assert keys == sorted(keys), "q1 must be ordered by returnflag, linestatus"
+    assert set(keys) == set(exp)
+    for i, k in enumerate(keys):
+        e = exp[k]
+        for m in ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge", "count_order"):
+            assert got[m][i] == e[m], (k, m)
+        for m in ("avg_qty", "avg_price", "avg_disc"):
+            assert abs(got[m][i] - e[m]) <= 1, (k, m)
+
+
+def test_q3(data, scans):
+    got = run(build_query("q3", scans, N_PARTS))
+    exp = O.oracle_q3(data)
+    rows = list(zip(got["l_orderkey"], got["revenue"], got["o_orderdate"], got["o_shippriority"]))
+    assert len(rows) == len(exp)
+    # compare as sets of (key, revenue): order ties on equal revenue+date
+    # may break differently between engine and oracle
+    assert set((r[0], r[1]) for r in rows) == set((r[0], r[1]) for r in exp)
+    assert [r[1] for r in rows] == sorted([r[1] for r in rows], reverse=True)
+
+
+def test_q4(data, scans):
+    got = run(build_query("q4", scans, N_PARTS))
+    exp = O.oracle_q4(data)
+    assert dict(zip(got["o_orderpriority"], got["order_count"])) == exp
+    assert got["o_orderpriority"] == sorted(got["o_orderpriority"])
+
+
+def test_q5(data, scans):
+    got = run(build_query("q5", scans, N_PARTS))
+    exp = O.oracle_q5(data)
+    assert dict(zip(got["n_name"], got["revenue"])) == exp
+    assert got["revenue"] == sorted(got["revenue"], reverse=True)
+
+
+def test_q6(data, scans):
+    got = run(build_query("q6", scans, N_PARTS))
+    assert len(got["revenue"]) == 1
+    assert got["revenue"][0] == O.oracle_q6(data)
+
+
+def test_q10(data, scans):
+    got = run(build_query("q10", scans, N_PARTS))
+    exp = O.oracle_q10(data)
+    rows = list(zip(got["c_custkey"], got["c_name"], got["c_acctbal"], got["n_name"], got["revenue"]))
+    assert len(rows) == len(exp)
+    assert set((r[0], r[4]) for r in rows) == set((r[0], r[4]) for r in exp)
+    assert [r[4] for r in rows] == sorted([r[4] for r in rows], reverse=True)
+    # grouped string columns survive the exchange intact
+    for r in rows:
+        match = [e for e in exp if e[0] == r[0]][0]
+        assert r[1] == match[1] and r[2] == match[2] and r[3] == match[3]
+
+
+def test_q12(data, scans):
+    got = run(build_query("q12", scans, N_PARTS))
+    exp = O.oracle_q12(data)
+    assert got["l_shipmode"] == sorted(exp.keys())
+    for i, m in enumerate(got["l_shipmode"]):
+        assert got["high_line_count"][i] == exp[m][0]
+        assert got["low_line_count"][i] == exp[m][1]
+
+
+def test_q14(data, scans):
+    got = run(build_query("q14", scans, N_PARTS))
+    exp_pct, sp, sr = O.oracle_q14(data)
+    assert len(got["promo_revenue"]) == 1
+    assert abs(got["promo_revenue"][0] - exp_pct) <= 1
+
+
+def test_q19(data, scans):
+    got = run(build_query("q19", scans, N_PARTS))
+    exp = O.oracle_q19(data)
+    assert len(got["revenue"]) == 1
+    got_v = got["revenue"][0]
+    if exp == 0:
+        assert got_v is None or got_v == 0
+    else:
+        assert got_v == exp
