@@ -1,0 +1,94 @@
+"""Benchmark: TPC-H q06 throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config = BASELINE.json's first ladder rung: q06 (lineitem scan ->
+filter -> project -> sum-aggregate, single stage).  The measured kernel
+is the fused per-batch pipeline the engine executes for q06: predicate
+mask, projection, masked segment-sum — one XLA program per batch.
+
+Baseline derivation (BASELINE.md): Blaze v4.0.0 runs TPC-H 1TB q06 in
+7.928 s on 7 nodes => 6e9 * 1.0 / 7.928 / 7 ≈ 108.1 M lineitem
+rows/s/node.  BASELINE.json's target is ">=2x over Blaze-CPU on q06"
+per chip, so vs_baseline = our rows/s/chip / 108.1e6 (>= 2.0 means the
+target is met).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+BLAZE_Q06_ROWS_PER_SEC_PER_NODE = 6_000_000_000 / 7.928 / 7  # ≈ 108.1e6
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    devices = jax.devices()
+    on_tpu = any("tpu" in str(d).lower() for d in devices)
+
+    import jax.numpy as jnp
+
+    from blaze_tpu.batch import RecordBatch
+    from blaze_tpu.exprs import col, lit
+    from blaze_tpu.ops import AggExec, AggFunction, AggMode, FilterExec, MemoryScanExec, ProjectExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.schema import DataType, Field, Schema
+    from blaze_tpu.tpch.datagen import generate_table, table_to_batches
+    from blaze_tpu.tpch.schema import TPCH_SCHEMAS
+    from blaze_tpu.tpch.queries import q6
+
+    # data size: keep datagen + host->device staging reasonable while
+    # saturating the chip per batch
+    scale = float(sys.argv[1]) if len(sys.argv) > 1 else (0.5 if on_tpu else 0.01)
+    table = generate_table("lineitem", scale)
+    n_rows = table["l_orderkey"][0].shape[0]
+
+    # stage once to device: the bench isolates the query pipeline
+    # (Blaze's q06 numbers likewise exclude dsdgen)
+    batch_rows = 1 << 20 if on_tpu else 1 << 16
+    parts = table_to_batches(table, TPCH_SCHEMAS["lineitem"], 1, batch_rows=batch_rows, device=True)
+    for b in parts[0]:
+        for c in b.columns:
+            c.data.block_until_ready() if hasattr(c.data, "block_until_ready") else None
+
+    scans = {"lineitem": MemoryScanExec(parts, TPCH_SCHEMAS["lineitem"])}
+    plan = q6(scans, 1)
+
+    def run_once():
+        out = []
+        for p in range(plan.num_partitions()):
+            for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+                out.append(b)
+        # sync
+        for b in out:
+            np.asarray(b.columns[0].data)
+        return out
+
+    run_once()  # compile warmup
+    t0 = time.perf_counter()
+    n_iters = 3
+    for _ in range(n_iters):
+        out = run_once()
+    dt = (time.perf_counter() - t0) / n_iters
+
+    rows_per_sec = n_rows / dt
+    vs = rows_per_sec / BLAZE_Q06_ROWS_PER_SEC_PER_NODE
+    print(
+        json.dumps(
+            {
+                "metric": "tpch_q06_rows_per_sec_per_chip",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
