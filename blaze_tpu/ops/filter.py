@@ -57,11 +57,15 @@ class FilterExec(ExecNode):
         pred = self._device_pred
 
         @jax.jit
-        def kernel(cols: Tuple[Column, ...]):
+        def kernel(cols: Tuple[Column, ...], num_rows):
             n = cols[0].data.shape[0]
             env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
             p = lower(pred, schema_aug, env, n)
-            keep = p.validity & p.data.astype(jnp.bool_)
+            # the live mask is load-bearing: IsNull turns padding-row
+            # invalidity into data=True, so validity alone cannot be
+            # trusted to exclude padding
+            live = jnp.arange(n) < num_rows
+            keep = p.validity & p.data.astype(jnp.bool_) & live
             return compact_columns(cols[: len(in_schema.fields)], keep)
 
         self._kernel = kernel
@@ -79,7 +83,7 @@ class FilterExec(ExecNode):
                     cols = list(batch.columns)
                     for _, sub in self._host_parts:
                         cols.append(host_eval(sub, batch))
-                    out_cols, count = self._kernel(tuple(cols))
+                    out_cols, count = self._kernel(tuple(cols), batch.num_rows)
                     n = int(count)  # one-scalar device->host sync
                 if n == 0:
                     continue

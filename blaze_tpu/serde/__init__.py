@@ -1,0 +1,13 @@
+"""Plan serde: the protobuf contract between the (JVM) planner and the
+native TPU engine — ≙ reference crate blaze-serde.
+
+Regenerate plan_pb2.py with:  protoc --python_out=. blaze_tpu/serde/plan.proto
+"""
+
+from .to_proto import expr_to_proto, plan_to_proto, task_definition
+from .from_proto import expr_from_proto, plan_from_proto, run_task
+
+__all__ = [
+    "expr_to_proto", "plan_to_proto", "task_definition",
+    "expr_from_proto", "plan_from_proto", "run_task",
+]

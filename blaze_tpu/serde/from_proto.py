@@ -1,0 +1,254 @@
+"""protobuf -> ExecNode/Expr trees + task runner.
+
+≙ reference blaze-serde/src/from_proto.rs:125-1283 (recursive
+ExecutionPlan builder) plus the task entry half of blaze/src/exec.rs
+(decode TaskDefinition -> build plan -> run).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+from ..exprs.ir import (
+    Alias, BinOp, Case, Cast, Col, Expr, InList, IsNotNull, IsNull, Like,
+    Lit, Not, ScalarFunc,
+)
+from ..schema import DataType, Field, Schema, TypeKind
+from . import plan_pb2 as pb
+
+
+def dtype_from_proto(t: pb.DataTypeProto) -> DataType:
+    kind = TypeKind(t.kind)
+    if kind == TypeKind.DECIMAL:
+        return DataType.decimal(t.precision, t.scale)
+    if kind in (TypeKind.STRING, TypeKind.BINARY):
+        return DataType(kind, string_width=t.string_width or 64)
+    return DataType(kind)
+
+
+def schema_from_proto(s: pb.SchemaProto) -> Schema:
+    return Schema(
+        [Field(f.name, dtype_from_proto(f.dtype), f.nullable) for f in s.fields]
+    )
+
+
+def _lit_from_proto(l: pb.LiteralValue) -> Lit:
+    t = dtype_from_proto(l.dtype)
+    if l.is_null:
+        return Lit(None, t)
+    kind = l.WhichOneof("value")
+    if kind == "bool_value":
+        return Lit(l.bool_value, t)
+    if kind == "float_value":
+        return Lit(l.float_value, t)
+    if kind == "bytes_value":
+        v = l.bytes_value
+        return Lit(v.decode("utf-8") if t.kind == TypeKind.STRING else v, t)
+    # int_value: decimals arrive unscaled; Lit stores logical values, so
+    # wrap through a raw-int constructor
+    if t.is_decimal:
+        lit = Lit(0, t)
+        lit.value = _RawUnscaled(l.int_value)
+        return lit
+    return Lit(l.int_value, t)
+
+
+class _RawUnscaled(int):
+    """Marker: the literal int is ALREADY the unscaled decimal value."""
+
+
+# teach the lowering about _RawUnscaled without touching its fast path
+def _patch_lit_lowering():
+    from ..exprs import compile as C
+
+    orig = C._lit_column
+
+    def lit_column(value, dtype, n):
+        if isinstance(value, _RawUnscaled) and dtype.is_decimal:
+            import jax.numpy as jnp
+
+            return C.Column(dtype, jnp.full(n, int(value), jnp.int64), jnp.ones(n, jnp.bool_))
+        return orig(value, dtype, n)
+
+    if orig.__name__ != "lit_column":
+        C._lit_column = lit_column
+
+
+_patch_lit_lowering()
+
+
+def expr_from_proto(n: pb.ExprNode) -> Expr:
+    kind = n.WhichOneof("expr")
+    if kind == "column":
+        return Col(n.column)
+    if kind == "literal":
+        return _lit_from_proto(n.literal)
+    if kind == "alias":
+        return Alias(expr_from_proto(n.alias.child), n.alias.name)
+    if kind == "binary":
+        return BinOp(n.binary.op, expr_from_proto(n.binary.left), expr_from_proto(n.binary.right))
+    if kind == "not":
+        return Not(expr_from_proto(getattr(n, "not")))
+    if kind == "is_null":
+        return IsNull(expr_from_proto(n.is_null))
+    if kind == "is_not_null":
+        return IsNotNull(expr_from_proto(n.is_not_null))
+    if kind == "cast":
+        return Cast(expr_from_proto(n.cast.child), dtype_from_proto(n.cast.to))
+    if kind == "case":
+        branches = [
+            (expr_from_proto(b.condition), expr_from_proto(b.value)) for b in n.case.branches
+        ]
+        else_ = expr_from_proto(n.case.else_expr) if n.case.has_else else None
+        return Case(branches, else_)
+    if kind == "in_list":
+        return InList(
+            expr_from_proto(n.in_list.child),
+            [expr_from_proto(v) for v in n.in_list.values],
+            n.in_list.negated,
+        )
+    if kind == "like":
+        return Like(expr_from_proto(n.like.child), n.like.pattern, n.like.negated)
+    if kind == "scalar_func":
+        return ScalarFunc(n.scalar_func.name, [expr_from_proto(a) for a in n.scalar_func.args])
+    raise NotImplementedError(f"from_proto expr {kind}")
+
+
+def _partitioning_from_proto(p: pb.PartitioningProto):
+    from ..parallel.shuffle import HashPartitioning, RoundRobinPartitioning, SinglePartitioning
+
+    if p.kind == pb.PartitioningProto.HASH:
+        return HashPartitioning([expr_from_proto(e) for e in p.exprs], p.num_partitions)
+    if p.kind == pb.PartitioningProto.ROUND_ROBIN:
+        return RoundRobinPartitioning(p.num_partitions)
+    return SinglePartitioning(p.num_partitions)
+
+
+def plan_from_proto(n: pb.PhysicalPlanNode):
+    from ..ops import (
+        AggExec, AggFunction, AggMode, CoalesceBatchesExec, DebugExec,
+        EmptyPartitionsExec, ExpandExec, FilterExec, GenerateExec, GroupingExpr,
+        LimitExec, MemoryScanExec, ProjectExec, RenameColumnsExec, SortExec,
+        SortField, UnionExec, WindowExec, WindowFunction,
+    )
+    from ..ops.joins import BroadcastJoinExec, HashJoinExec, JoinType, SortMergeJoinExec
+    from ..parallel.broadcast import IpcWriterExec
+    from ..parallel.shuffle import IpcReaderExec, ShuffleWriterExec
+    from ..runtime.context import RESOURCES
+
+    kind = n.WhichOneof("node")
+    if kind == "memory_scan":
+        parts = RESOURCES.get(n.memory_scan.resource_id)
+        return MemoryScanExec(parts, schema_from_proto(n.memory_scan.schema))
+    if kind == "project":
+        p = n.project
+        return ProjectExec(plan_from_proto(p.input), [expr_from_proto(e) for e in p.exprs], list(p.names))
+    if kind == "filter":
+        return FilterExec(plan_from_proto(n.filter.input), expr_from_proto(n.filter.predicate))
+    if kind == "agg":
+        a = n.agg
+        return AggExec(
+            plan_from_proto(a.input),
+            AggMode(a.mode),
+            [GroupingExpr(expr_from_proto(g.expr), g.name) for g in a.groupings],
+            [
+                AggFunction(f.fn, expr_from_proto(f.expr) if f.has_expr else None, f.name)
+                for f in a.aggs
+            ],
+            supports_partial_skipping=a.supports_partial_skipping,
+        )
+    if kind == "sort":
+        s = n.sort
+        return SortExec(
+            plan_from_proto(s.input),
+            [SortField(expr_from_proto(f.expr), f.ascending, f.nulls_first) for f in s.fields],
+            fetch=s.fetch if s.has_fetch else None,
+        )
+    if kind == "limit":
+        return LimitExec(plan_from_proto(n.limit.input), n.limit.limit)
+    if kind == "union":
+        return UnionExec([plan_from_proto(c) for c in n.union.inputs])
+    if kind == "rename_columns":
+        return RenameColumnsExec(plan_from_proto(n.rename_columns.input), list(n.rename_columns.names))
+    if kind == "empty_partitions":
+        return EmptyPartitionsExec(
+            schema_from_proto(n.empty_partitions.schema), n.empty_partitions.num_partitions
+        )
+    if kind == "debug":
+        return DebugExec(plan_from_proto(n.debug.input), n.debug.tag, n.debug.verbose)
+    if kind == "coalesce_batches":
+        return CoalesceBatchesExec(
+            plan_from_proto(n.coalesce_batches.input), n.coalesce_batches.target_rows
+        )
+    if kind == "shuffle_writer":
+        w = n.shuffle_writer
+        return ShuffleWriterExec(
+            plan_from_proto(w.input), _partitioning_from_proto(w.partitioning),
+            w.output_data_file, w.output_index_file,
+        )
+    if kind == "ipc_reader":
+        r = n.ipc_reader
+        return IpcReaderExec(schema_from_proto(r.schema), r.ipc_provider_resource_id, r.num_partitions)
+    if kind == "ipc_writer":
+        return IpcWriterExec(plan_from_proto(n.ipc_writer.input), n.ipc_writer.ipc_consumer_resource_id)
+    if kind in ("broadcast_join", "hash_join"):
+        j = n.broadcast_join if kind == "broadcast_join" else n.hash_join
+        cls = BroadcastJoinExec if kind == "broadcast_join" else HashJoinExec
+        return cls(
+            plan_from_proto(j.build), plan_from_proto(j.probe),
+            [expr_from_proto(e) for e in j.build_keys],
+            [expr_from_proto(e) for e in j.probe_keys],
+            JoinType[pb.JoinTypeProto.Name(j.join_type)],
+            j.build_is_left,
+        )
+    if kind == "sort_merge_join":
+        j = n.sort_merge_join
+        return SortMergeJoinExec(
+            plan_from_proto(j.left), plan_from_proto(j.right),
+            [expr_from_proto(e) for e in j.left_keys],
+            [expr_from_proto(e) for e in j.right_keys],
+            JoinType[pb.JoinTypeProto.Name(j.join_type)],
+        )
+    if kind == "window":
+        w = n.window
+        return WindowExec(
+            plan_from_proto(w.input),
+            [
+                WindowFunction(f.kind, f.name, expr_from_proto(f.expr) if f.has_expr else None, f.whole_partition)
+                for f in w.functions
+            ],
+            [expr_from_proto(e) for e in w.partition_by],
+            [SortField(expr_from_proto(f.expr), f.ascending, f.nulls_first) for f in w.order_by],
+        )
+    if kind == "expand":
+        e = n.expand
+        return ExpandExec(
+            plan_from_proto(e.input),
+            [[expr_from_proto(x) for x in p.exprs] for p in e.projections],
+            list(e.names),
+        )
+    if kind == "generate":
+        g = n.generate
+        return GenerateExec(
+            plan_from_proto(g.input),
+            pickle.loads(g.generator_payload),
+            [expr_from_proto(e) for e in g.input_exprs],
+            [Field(f.name, dtype_from_proto(f.dtype), f.nullable) for f in g.gen_fields],
+            g.outer,
+            g.keep_input,
+        )
+    raise NotImplementedError(f"from_proto node {kind}")
+
+
+def run_task(task_def_bytes: bytes):
+    """Decode a TaskDefinition and drive its plan for its partition —
+    the python mirror of the gateway's callNative entry
+    (≙ blaze/src/exec.rs:46-142)."""
+    from ..runtime.context import TaskContext
+
+    td = pb.TaskDefinition()
+    td.ParseFromString(task_def_bytes)
+    plan = plan_from_proto(td.plan)
+    ctx = TaskContext(td.partition, max(plan.num_partitions(), td.partition + 1))
+    return plan.execute(td.partition, ctx)

@@ -1,0 +1,284 @@
+"""ExecNode/Expr trees -> protobuf.
+
+≙ the JVM side of the reference's serde (NativeConverters.scala
+convertExpr/convertDataType + the per-plan-node proto builders in
+spark-extension/.../blaze/plan/*.scala).  In-process this is used by
+tests (roundtrip) and by the standalone scheduler when shipping task
+plans to worker processes.
+"""
+
+from __future__ import annotations
+
+import datetime
+import pickle
+from typing import Optional
+
+from ..exprs.ir import (
+    Alias, BinOp, Case, Cast, Col, Expr, InList, IsNotNull, IsNull, Like,
+    Lit, Not, ScalarFunc,
+)
+from ..schema import DataType, Field, Schema, TypeKind
+from . import plan_pb2 as pb
+
+
+def dtype_to_proto(t: DataType) -> pb.DataTypeProto:
+    return pb.DataTypeProto(
+        kind=t.kind.value, precision=t.precision, scale=t.scale,
+        string_width=t.string_width,
+    )
+
+
+def schema_to_proto(s: Schema) -> pb.SchemaProto:
+    return pb.SchemaProto(
+        fields=[
+            pb.FieldProto(name=f.name, dtype=dtype_to_proto(f.dtype), nullable=f.nullable)
+            for f in s.fields
+        ]
+    )
+
+
+def _lit_to_proto(e: Lit) -> pb.LiteralValue:
+    from ..exprs.compile import infer_lit_dtype
+
+    t = infer_lit_dtype(e.value, e.dtype)
+    out = pb.LiteralValue(dtype=dtype_to_proto(t))
+    v = e.value
+    if v is None:
+        out.is_null = True
+    elif t.kind == TypeKind.BOOL:
+        out.bool_value = bool(v)
+    elif t.is_string:
+        out.bytes_value = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+    elif t.is_float:
+        out.float_value = float(v)
+    elif t.is_decimal:
+        if isinstance(v, str):
+            from decimal import Decimal
+
+            out.int_value = int(Decimal(v).scaleb(t.scale).to_integral_value())
+        elif isinstance(v, float):
+            out.int_value = int(round(v * 10**t.scale))
+        else:
+            out.int_value = int(v) * 10**t.scale
+    elif t.kind == TypeKind.DATE32:
+        if isinstance(v, str):
+            v = datetime.date.fromisoformat(v)
+        if isinstance(v, datetime.date):
+            v = (v - datetime.date(1970, 1, 1)).days
+        out.int_value = int(v)
+    else:
+        out.int_value = int(v)
+    return out
+
+
+def expr_to_proto(e: Expr) -> pb.ExprNode:
+    n = pb.ExprNode()
+    if isinstance(e, Col):
+        n.column = e.name
+    elif isinstance(e, Lit):
+        n.literal.CopyFrom(_lit_to_proto(e))
+    elif isinstance(e, Alias):
+        n.alias.child.CopyFrom(expr_to_proto(e.child))
+        n.alias.name = e.name
+    elif isinstance(e, BinOp):
+        n.binary.op = e.op
+        n.binary.left.CopyFrom(expr_to_proto(e.left))
+        n.binary.right.CopyFrom(expr_to_proto(e.right))
+    elif isinstance(e, Not):
+        getattr(n, "not").CopyFrom(expr_to_proto(e.child))
+    elif isinstance(e, IsNull):
+        n.is_null.CopyFrom(expr_to_proto(e.child))
+    elif isinstance(e, IsNotNull):
+        n.is_not_null.CopyFrom(expr_to_proto(e.child))
+    elif isinstance(e, Cast):
+        n.cast.child.CopyFrom(expr_to_proto(e.child))
+        n.cast.to.CopyFrom(dtype_to_proto(e.to))
+    elif isinstance(e, Case):
+        for c, v in e.branches:
+            b = n.case.branches.add()
+            b.condition.CopyFrom(expr_to_proto(c))
+            b.value.CopyFrom(expr_to_proto(v))
+        if e.else_ is not None:
+            n.case.has_else = True
+            n.case.else_expr.CopyFrom(expr_to_proto(e.else_))
+    elif isinstance(e, InList):
+        n.in_list.child.CopyFrom(expr_to_proto(e.child))
+        for v in e.values:
+            n.in_list.values.add().CopyFrom(expr_to_proto(v))
+        n.in_list.negated = e.negated
+    elif isinstance(e, Like):
+        n.like.child.CopyFrom(expr_to_proto(e.child))
+        n.like.pattern = e.pattern
+        n.like.negated = e.negated
+    elif isinstance(e, ScalarFunc):
+        n.scalar_func.name = e.name
+        for a in e.args:
+            n.scalar_func.args.add().CopyFrom(expr_to_proto(a))
+    else:
+        raise NotImplementedError(f"to_proto for {type(e).__name__}")
+    return n
+
+
+def _partitioning_to_proto(p) -> pb.PartitioningProto:
+    from ..parallel.shuffle import HashPartitioning, RoundRobinPartitioning, SinglePartitioning
+
+    out = pb.PartitioningProto(num_partitions=p.num_partitions)
+    if isinstance(p, HashPartitioning):
+        out.kind = pb.PartitioningProto.HASH
+        for e in p.exprs:
+            out.exprs.add().CopyFrom(expr_to_proto(e))
+    elif isinstance(p, RoundRobinPartitioning):
+        out.kind = pb.PartitioningProto.ROUND_ROBIN
+    else:
+        out.kind = pb.PartitioningProto.SINGLE
+    return out
+
+
+def plan_to_proto(node) -> pb.PhysicalPlanNode:
+    from ..ops import (
+        AggExec, CoalesceBatchesExec, DebugExec, EmptyPartitionsExec, ExpandExec,
+        FilterExec, GenerateExec, LimitExec, MemoryScanExec, ProjectExec,
+        RenameColumnsExec, SortExec, UnionExec, WindowExec,
+    )
+    from ..ops.joins import BroadcastJoinExec, HashJoinExec, SortMergeJoinExec
+    from ..parallel.broadcast import IpcWriterExec
+    from ..parallel.shuffle import IpcReaderExec, ShuffleWriterExec
+    from ..runtime.context import RESOURCES
+
+    out = pb.PhysicalPlanNode()
+    if isinstance(node, MemoryScanExec):
+        # stage partitions under a resources-map id so the decoded plan
+        # finds them (≙ FFIReader export)
+        rid = f"memscan_{id(node)}"
+        RESOURCES.put(rid, node._partitions)
+        out.memory_scan.resource_id = rid
+        out.memory_scan.schema.CopyFrom(schema_to_proto(node.schema))
+        out.memory_scan.num_partitions = node.num_partitions()
+    elif isinstance(node, ProjectExec):
+        out.project.input.CopyFrom(plan_to_proto(node.children[0]))
+        for e in node.exprs:
+            out.project.exprs.add().CopyFrom(expr_to_proto(e))
+        out.project.names.extend(node.names)
+    elif isinstance(node, FilterExec):
+        out.filter.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.filter.predicate.CopyFrom(expr_to_proto(node.predicate))
+    elif isinstance(node, AggExec):
+        out.agg.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.agg.mode = node.mode.value
+        for g in node.groupings:
+            ge = out.agg.groupings.add()
+            ge.expr.CopyFrom(expr_to_proto(g.expr))
+            ge.name = g.name
+        for a in node.aggs:
+            ap = out.agg.aggs.add()
+            ap.fn = a.fn
+            ap.name = a.name
+            if a.expr is not None:
+                ap.has_expr = True
+                ap.expr.CopyFrom(expr_to_proto(a.expr))
+        out.agg.supports_partial_skipping = node.supports_partial_skipping
+    elif isinstance(node, SortExec):
+        out.sort.input.CopyFrom(plan_to_proto(node.children[0]))
+        for f in node.fields:
+            fp = out.sort.fields.add()
+            fp.expr.CopyFrom(expr_to_proto(f.expr))
+            fp.ascending = f.ascending
+            fp.nulls_first = f.nulls_first
+        if node.fetch is not None:
+            out.sort.has_fetch = True
+            out.sort.fetch = node.fetch
+    elif isinstance(node, LimitExec):
+        out.limit.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.limit.limit = node.limit
+    elif isinstance(node, UnionExec):
+        for c in node.children:
+            out.union.inputs.add().CopyFrom(plan_to_proto(c))
+    elif isinstance(node, RenameColumnsExec):
+        out.rename_columns.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.rename_columns.names.extend(node.schema.names)
+    elif isinstance(node, EmptyPartitionsExec):
+        out.empty_partitions.schema.CopyFrom(schema_to_proto(node.schema))
+        out.empty_partitions.num_partitions = node.num_partitions()
+    elif isinstance(node, DebugExec):
+        out.debug.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.debug.tag = node.tag
+        out.debug.verbose = node.verbose
+    elif isinstance(node, CoalesceBatchesExec):
+        out.coalesce_batches.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.coalesce_batches.target_rows = node.target_rows
+    elif isinstance(node, ShuffleWriterExec):
+        out.shuffle_writer.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.shuffle_writer.partitioning.CopyFrom(_partitioning_to_proto(node.partitioning))
+        out.shuffle_writer.output_data_file = node.data_path
+        out.shuffle_writer.output_index_file = node.index_path
+    elif isinstance(node, IpcReaderExec):
+        out.ipc_reader.schema.CopyFrom(schema_to_proto(node.schema))
+        out.ipc_reader.ipc_provider_resource_id = node.resource_id
+        out.ipc_reader.num_partitions = node.num_partitions()
+    elif isinstance(node, IpcWriterExec):
+        out.ipc_writer.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.ipc_writer.ipc_consumer_resource_id = node.resource_id
+    elif isinstance(node, (BroadcastJoinExec, HashJoinExec)):
+        dst = out.broadcast_join if isinstance(node, BroadcastJoinExec) else out.hash_join
+        dst.build.CopyFrom(plan_to_proto(node.children[0]))
+        dst.probe.CopyFrom(plan_to_proto(node.children[1]))
+        for e in node.build_keys:
+            dst.build_keys.add().CopyFrom(expr_to_proto(e))
+        for e in node.probe_keys:
+            dst.probe_keys.add().CopyFrom(expr_to_proto(e))
+        dst.join_type = pb.JoinTypeProto.Value(node.join_type.name)
+        dst.build_is_left = node.build_is_left
+    elif isinstance(node, SortMergeJoinExec):
+        out.sort_merge_join.left.CopyFrom(plan_to_proto(node.children[0]))
+        out.sort_merge_join.right.CopyFrom(plan_to_proto(node.children[1]))
+        for e in node.left_keys:
+            out.sort_merge_join.left_keys.add().CopyFrom(expr_to_proto(e))
+        for e in node.right_keys:
+            out.sort_merge_join.right_keys.add().CopyFrom(expr_to_proto(e))
+        out.sort_merge_join.join_type = pb.JoinTypeProto.Value(node.join_type.name)
+    elif isinstance(node, WindowExec):
+        out.window.input.CopyFrom(plan_to_proto(node.children[0]))
+        for f in node.functions:
+            fp = out.window.functions.add()
+            fp.kind = f.kind
+            fp.name = f.name
+            if f.expr is not None:
+                fp.has_expr = True
+                fp.expr.CopyFrom(expr_to_proto(f.expr))
+            fp.whole_partition = f.whole_partition
+        for e in node.partition_by:
+            out.window.partition_by.add().CopyFrom(expr_to_proto(e))
+        for f in node.order_by:
+            fp = out.window.order_by.add()
+            fp.expr.CopyFrom(expr_to_proto(f.expr))
+            fp.ascending = f.ascending
+            fp.nulls_first = f.nulls_first
+    elif isinstance(node, ExpandExec):
+        out.expand.input.CopyFrom(plan_to_proto(node.children[0]))
+        for proj in node._projects:
+            ep = out.expand.projections.add()
+            for e in proj.exprs:
+                ep.exprs.add().CopyFrom(expr_to_proto(e))
+        out.expand.names.extend(node.schema.names)
+    elif isinstance(node, GenerateExec):
+        out.generate.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.generate.generator_payload = pickle.dumps(node.generator)
+        for e in node.input_exprs:
+            out.generate.input_exprs.add().CopyFrom(expr_to_proto(e))
+        for f in node.gen_fields:
+            out.generate.gen_fields.add().CopyFrom(
+                pb.FieldProto(name=f.name, dtype=dtype_to_proto(f.dtype), nullable=f.nullable)
+            )
+        out.generate.outer = node.outer
+        out.generate.keep_input = node.keep_input
+    else:
+        raise NotImplementedError(f"to_proto for {type(node).__name__}")
+    return out
+
+
+def task_definition(plan, task_id: str, stage_id: int, partition: int) -> bytes:
+    td = pb.TaskDefinition(
+        task_id=task_id, stage_id=stage_id, partition=partition,
+        plan=plan_to_proto(plan),
+    )
+    return td.SerializeToString()
