@@ -1,0 +1,54 @@
+"""Gateway-side batch export: host batches -> Arrow C ABI structs.
+
+≙ the native half of the JVM data plane: rt.rs batch_to_ffi +
+wrapper.importBatch (rt.rs:181-184).  The JNI gateway
+(native/jni/blaze_jni.cc) calls :func:`export_batch_ffi` per batch and
+hands the returned struct address to the JVM, which imports it through
+Arrow-Java's C Data interface.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+from typing import Dict, List, Tuple
+
+from . import native
+from .batch import RecordBatch
+
+
+class _FfiBatch(C.Structure):
+    _fields_ = [
+        ("n_cols", C.c_int64),
+        ("schemas", C.POINTER(native.ArrowSchema)),
+        ("arrays", C.POINTER(native.ArrowArray)),
+    ]
+
+
+# keep exported structs alive until the JVM releases them; keyed by addr
+_live: Dict[int, Tuple] = {}
+
+
+def export_batch_ffi(batch: RecordBatch) -> int:
+    """Export a batch's primitive columns through the Arrow C ABI;
+    returns the address of an _FfiBatch struct."""
+    lib = native._load()
+    assert lib is not None, "native runtime required for FFI export"
+    b = batch.to_host()
+    n = len(b.columns)
+    schemas = (native.ArrowSchema * n)()
+    arrays = (native.ArrowArray * n)()
+    cols, keep = native._make_cols(b.columns, b.num_rows)
+    for i in range(n):
+        rc = lib.bt_arrow_export_primitive(
+            C.byref(cols[i]), b.num_rows, C.byref(schemas[i]), C.byref(arrays[i])
+        )
+        if rc != 0:
+            raise RuntimeError(f"FFI export failed for column {i}")
+    fb = _FfiBatch(n, schemas, arrays)
+    addr = C.addressof(fb)
+    _live[addr] = (fb, schemas, arrays, keep)
+    return addr
+
+
+def release_batch_ffi(addr: int) -> None:
+    _live.pop(addr, None)

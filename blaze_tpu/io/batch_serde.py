@@ -28,6 +28,12 @@ from ..schema import Schema
 
 
 def serialize_batch(batch: RecordBatch) -> bytes:
+    from .. import native
+
+    if native.available():
+        out = native.serialize_batch_native(batch)
+        if out is not None:
+            return out
     b = batch.to_host()
     n = b.num_rows
     out: List[bytes] = [struct.pack("<I", n)]

@@ -1,0 +1,264 @@
+"""ctypes binding to the C++ native runtime (libblaze_tpu_native.so).
+
+≙ the reference's in-process .so boundary (libblaze.so loaded by
+BlazeCallNativeWrapper.loadLibBlaze:187-208).  Pure-python fallbacks
+exist for every entry point, so the engine degrades gracefully when the
+library isn't built (the reference's "JNI bridge stubbed by absence"
+test trick, SURVEY.md §4); `available()` reports which path is live.
+
+Build:  cmake -S native -B native/build -G Ninja && cmake --build native/build
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..schema import DataType, TypeKind
+
+_KIND_MAP = {
+    TypeKind.BOOL: 0,
+    TypeKind.INT8: 1,
+    TypeKind.INT16: 2,
+    TypeKind.INT32: 3,
+    TypeKind.INT64: 4,
+    TypeKind.FLOAT32: 5,
+    TypeKind.FLOAT64: 6,
+    TypeKind.DATE32: 3,
+    TypeKind.TIMESTAMP: 4,
+    TypeKind.DECIMAL: 4,
+    TypeKind.STRING: 7,
+    TypeKind.BINARY: 7,
+}
+
+
+class _BtCol(C.Structure):
+    _fields_ = [
+        ("kind", C.c_int32),
+        ("data", C.c_void_p),
+        ("validity", C.c_void_p),
+        ("lengths", C.c_void_p),
+        ("width", C.c_int32),
+    ]
+
+
+class ArrowSchema(C.Structure):
+    pass
+
+
+class ArrowArray(C.Structure):
+    pass
+
+
+ArrowSchema._fields_ = [
+    ("format", C.c_char_p),
+    ("name", C.c_char_p),
+    ("metadata", C.c_char_p),
+    ("flags", C.c_int64),
+    ("n_children", C.c_int64),
+    ("children", C.POINTER(C.POINTER(ArrowSchema))),
+    ("dictionary", C.POINTER(ArrowSchema)),
+    ("release", C.c_void_p),
+    ("private_data", C.c_void_p),
+]
+ArrowArray._fields_ = [
+    ("length", C.c_int64),
+    ("null_count", C.c_int64),
+    ("offset", C.c_int64),
+    ("n_buffers", C.c_int64),
+    ("n_children", C.c_int64),
+    ("buffers", C.POINTER(C.c_void_p)),
+    ("children", C.POINTER(C.POINTER(ArrowArray))),
+    ("dictionary", C.POINTER(ArrowArray)),
+    ("release", C.c_void_p),
+    ("private_data", C.c_void_p),
+]
+
+_lib = None
+
+
+def _find_lib() -> Optional[str]:
+    env = os.environ.get("BLAZE_TPU_NATIVE_LIB")
+    if env and os.path.exists(env):
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for cand in (
+        os.path.join(here, "native", "build", "libblaze_tpu_native.so"),
+        os.path.join(here, "libblaze_tpu_native.so"),
+    ):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = _find_lib()
+    if path is None:
+        return None
+    lib = C.CDLL(path)
+    lib.bt_murmur3.argtypes = [C.POINTER(_BtCol), C.c_int32, C.c_int64, C.c_int32, C.c_void_p]
+    lib.bt_xxhash64.argtypes = [C.POINTER(_BtCol), C.c_int32, C.c_int64, C.c_int64, C.c_void_p]
+    lib.bt_pmod.argtypes = [C.c_void_p, C.c_int64, C.c_int32, C.c_void_p]
+    lib.bt_serialized_size.argtypes = [C.POINTER(_BtCol), C.c_int32, C.c_int64]
+    lib.bt_serialized_size.restype = C.c_int64
+    lib.bt_serialize_batch.argtypes = [C.POINTER(_BtCol), C.c_int32, C.c_int64, C.c_void_p, C.c_int64]
+    lib.bt_serialize_batch.restype = C.c_int64
+    lib.bt_max_frame_size.argtypes = [C.c_int64]
+    lib.bt_max_frame_size.restype = C.c_int64
+    lib.bt_compress_frame.argtypes = [C.c_void_p, C.c_int64, C.c_void_p, C.c_int64, C.c_int32]
+    lib.bt_compress_frame.restype = C.c_int64
+    lib.bt_decompress_frame.argtypes = [C.c_void_p, C.c_int64, C.c_void_p, C.c_int64]
+    lib.bt_decompress_frame.restype = C.c_int64
+    lib.bt_loser_tree_merge.argtypes = [
+        C.POINTER(C.c_void_p), C.c_void_p, C.c_int32, C.c_void_p, C.c_void_p, C.c_int64,
+    ]
+    lib.bt_loser_tree_merge.restype = C.c_int64
+    lib.bt_arrow_export_primitive.argtypes = [
+        C.POINTER(_BtCol), C.c_int64, C.POINTER(ArrowSchema), C.POINTER(ArrowArray),
+    ]
+    lib.bt_arrow_export_primitive.restype = C.c_int32
+    lib.bt_arrow_import_primitive.argtypes = [
+        C.POINTER(ArrowSchema), C.POINTER(ArrowArray), C.c_void_p, C.c_void_p, C.c_int64,
+    ]
+    lib.bt_arrow_import_primitive.restype = C.c_int32
+    lib.bt_version.restype = C.c_char_p
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def version() -> Optional[str]:
+    lib = _load()
+    return lib.bt_version().decode() if lib else None
+
+
+def _np_ptr(a: np.ndarray) -> C.c_void_p:
+    return C.c_void_p(a.ctypes.data)
+
+
+def _make_cols(cols, num_rows: int) -> Tuple[C.Array, List[np.ndarray]]:
+    """Build bt_col descriptors for host Columns (keeps buffer refs
+    alive via the returned list)."""
+    keep: List[np.ndarray] = []
+    arr = (_BtCol * len(cols))()
+    for i, c in enumerate(cols):
+        data = np.ascontiguousarray(np.asarray(c.data)[:num_rows])
+        validity = np.ascontiguousarray(np.asarray(c.validity)[:num_rows].astype(np.uint8))
+        keep += [data, validity]
+        arr[i].kind = _KIND_MAP[c.dtype.kind]
+        arr[i].data = data.ctypes.data
+        arr[i].validity = validity.ctypes.data
+        if c.lengths is not None:
+            lengths = np.ascontiguousarray(np.asarray(c.lengths)[:num_rows].astype(np.int32))
+            keep.append(lengths)
+            arr[i].lengths = lengths.ctypes.data
+            arr[i].width = data.shape[1]
+        else:
+            arr[i].lengths = None
+            arr[i].width = 0
+    return arr, keep
+
+
+def murmur3_host(cols, num_rows: int, seed: int = 42) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    arr, keep = _make_cols(cols, num_rows)
+    out = np.empty(num_rows, np.int32)
+    lib.bt_murmur3(arr, len(cols), num_rows, seed, _np_ptr(out))
+    return out
+
+
+def xxhash64_host(cols, num_rows: int, seed: int = 42) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    arr, keep = _make_cols(cols, num_rows)
+    out = np.empty(num_rows, np.int64)
+    lib.bt_xxhash64(arr, len(cols), num_rows, seed, _np_ptr(out))
+    return out
+
+
+def serialize_batch_native(batch) -> Optional[bytes]:
+    """Native serialization of a host RecordBatch; None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    b = batch.to_host()
+    arr, keep = _make_cols(b.columns, b.num_rows)
+    size = lib.bt_serialized_size(arr, len(b.columns), b.num_rows)
+    out = np.empty(size, np.uint8)
+    written = lib.bt_serialize_batch(arr, len(b.columns), b.num_rows, _np_ptr(out), size)
+    if written < 0:
+        return None
+    return out[:written].tobytes()
+
+
+def compress_frame_native(payload: bytes, use_zlib: bool = True) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    cap = lib.bt_max_frame_size(len(payload))
+    out = np.empty(cap, np.uint8)
+    n = lib.bt_compress_frame(payload, len(payload), _np_ptr(out), cap, 1 if use_zlib else 0)
+    if n < 0:
+        return None
+    return out[:n].tobytes()
+
+
+def decompress_frame_native(frame: bytes, expected_max: int) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(max(expected_max, 1), np.uint8)
+    n = lib.bt_decompress_frame(frame, len(frame), _np_ptr(out), out.size)
+    if n < 0:
+        return None
+    return out[:n].tobytes()
+
+
+def loser_tree_merge(run_keys: Sequence[np.ndarray]) -> Tuple[np.ndarray, np.ndarray]:
+    """Merge k ascending uint64 runs; returns (run_idx, offset) arrays
+    in globally sorted order."""
+    lib = _load()
+    assert lib is not None
+    k = len(run_keys)
+    runs = [np.ascontiguousarray(r, dtype=np.uint64) for r in run_keys]
+    ptrs = (C.c_void_p * k)(*[r.ctypes.data for r in runs])
+    lens = np.array([len(r) for r in runs], np.int64)
+    total = int(lens.sum())
+    out_run = np.empty(total, np.uint32)
+    out_off = np.empty(total, np.uint32)
+    n = lib.bt_loser_tree_merge(ptrs, _np_ptr(lens), k, _np_ptr(out_run), _np_ptr(out_off), total)
+    assert n == total, (n, total)
+    return out_run, out_off
+
+
+def arrow_roundtrip(col, num_rows: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Export a primitive host Column through the Arrow C ABI and
+    import it back (FFI data-plane self test)."""
+    lib = _load()
+    assert lib is not None
+    arr, keep = _make_cols([col], num_rows)
+    schema = ArrowSchema()
+    array = ArrowArray()
+    rc = lib.bt_arrow_export_primitive(C.byref(arr[0]), num_rows, C.byref(schema), C.byref(array))
+    assert rc == 0
+    data = np.asarray(col.data)[:num_rows]
+    out_data = np.empty_like(np.ascontiguousarray(data))
+    out_valid = np.empty(num_rows, np.uint8)
+    rc = lib.bt_arrow_import_primitive(
+        C.byref(schema), C.byref(array), _np_ptr(out_data), _np_ptr(out_valid), num_rows
+    )
+    assert rc == 0
+    # release through the Arrow callback contract
+    rel = C.CFUNCTYPE(None, C.POINTER(ArrowArray))(array.release)
+    rel(C.byref(array))
+    return out_data, out_valid.astype(bool)

@@ -1,0 +1,130 @@
+// Arrow C Data Interface export/import for primitive columns.
+// ≙ the reference's JVM↔native Arrow FFI data plane
+// (BlazeCallNativeWrapper.importBatch / importSchema over
+// org.apache.arrow.c.Data; native side ffi_helper.rs batch_to_ffi).
+// The structs follow the Arrow spec ABI, so any Arrow implementation
+// (Arrow-Java in the Spark executor) can consume/produce them.
+
+#include "blaze_native.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+namespace {
+
+const char* format_for(int32_t kind) {
+  switch (kind) {
+    case 0: return "b";   // boolean (bitmap in arrow; we export uint8 as c)
+    case 1: return "c";   // int8
+    case 2: return "s";   // int16
+    case 3: return "i";   // int32
+    case 4: return "l";   // int64
+    case 5: return "f";   // float32
+    case 6: return "g";   // float64
+    default: return nullptr;
+  }
+}
+
+int64_t width_for_format(const char* f) {
+  switch (f[0]) {
+    case 'c': return 1;
+    case 's': return 2;
+    case 'i': case 'f': return 4;
+    case 'l': case 'g': return 8;
+    default: return -1;
+  }
+}
+
+struct Holder {
+  uint8_t* validity_bitmap;
+  uint8_t* data;
+  const void* buffers[2];
+};
+
+void release_array(struct ArrowArray* a) {
+  if (!a || !a->release) return;
+  Holder* h = (Holder*)a->private_data;
+  std::free(h->validity_bitmap);
+  std::free(h->data);
+  delete h;
+  a->release = nullptr;
+}
+
+void release_schema(struct ArrowSchema* s) {
+  if (!s || !s->release) return;
+  s->release = nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t bt_arrow_export_primitive(const bt_col* col, int64_t n,
+                                  struct ArrowSchema* out_schema,
+                                  struct ArrowArray* out_array) {
+  const char* fmt = format_for(col->kind);
+  if (!fmt || col->kind == 0) {
+    // bool export as int8 ("c"): arrow bool is bit-packed; keep the
+    // byte layout and let the consumer widen
+    if (col->kind == 0) fmt = "c";
+    else return -1;
+  }
+  int64_t isz = col->kind == 0 ? 1 : width_for_format(fmt);
+
+  std::memset(out_schema, 0, sizeof(*out_schema));
+  out_schema->format = fmt;
+  out_schema->name = "";
+  out_schema->flags = 2;  // ARROW_FLAG_NULLABLE
+  out_schema->release = release_schema;
+
+  Holder* h = new (std::nothrow) Holder();
+  if (!h) return -1;
+  int64_t bb = (n + 7) / 8;
+  h->validity_bitmap = (uint8_t*)std::malloc((size_t)(bb ? bb : 1));
+  h->data = (uint8_t*)std::malloc((size_t)(isz * (n ? n : 1)));
+  if (!h->validity_bitmap || !h->data) {
+    std::free(h->validity_bitmap);
+    std::free(h->data);
+    delete h;
+    return -1;
+  }
+  std::memset(h->validity_bitmap, 0, (size_t)bb);
+  int64_t null_count = 0;
+  for (int64_t i = 0; i < n; i++) {
+    bool valid = !col->validity || col->validity[i];
+    if (valid) h->validity_bitmap[i >> 3] |= (uint8_t)(1 << (i & 7));
+    else null_count++;
+  }
+  std::memcpy(h->data, col->data, (size_t)(isz * n));
+  h->buffers[0] = h->validity_bitmap;
+  h->buffers[1] = h->data;
+
+  std::memset(out_array, 0, sizeof(*out_array));
+  out_array->length = n;
+  out_array->null_count = null_count;
+  out_array->n_buffers = 2;
+  out_array->buffers = h->buffers;
+  out_array->private_data = h;
+  out_array->release = release_array;
+  return 0;
+}
+
+int32_t bt_arrow_import_primitive(const struct ArrowSchema* schema,
+                                  const struct ArrowArray* array,
+                                  void* data_out, uint8_t* validity_out,
+                                  int64_t cap) {
+  int64_t isz = width_for_format(schema->format);
+  if (isz < 0 || array->length > cap || array->n_buffers < 2) return -1;
+  const uint8_t* bitmap = (const uint8_t*)array->buffers[0];
+  const uint8_t* data = (const uint8_t*)array->buffers[1];
+  int64_t off = array->offset;
+  for (int64_t i = 0; i < array->length; i++) {
+    int64_t j = i + off;
+    validity_out[i] = bitmap ? ((bitmap[j >> 3] >> (j & 7)) & 1) : 1;
+  }
+  std::memcpy(data_out, data + off * isz, (size_t)(array->length * isz));
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,108 @@
+// blaze-tpu native runtime: C ABI surface.
+//
+// ≙ the data-plane half of the reference's native engine commons
+// (datafusion-ext-commons): spark_hash.rs (murmur3 seed-42 / xxhash64),
+// io/batch_serde.rs (columnar wire format), ipc_compression.rs (framed
+// blocks), ds/loser_tree.rs (k-way merge), plus the Arrow C Data
+// Interface structs used on the JVM↔native boundary
+// (BlazeCallNativeWrapper.importBatch / ffi_helper.rs).
+//
+// The TPU compute path stays in XLA; this library carries the host
+// runtime work around it (shuffle/spill serde, compression, merges,
+// FFI) exactly where the reference uses Rust.
+
+#ifndef BLAZE_NATIVE_H
+#define BLAZE_NATIVE_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---- column descriptor (host buffers) ------------------------------------
+// kind: 0=bool 1=int8 2=int16 3=int32 4=int64 5=float32 6=float64
+//       7=string(fixed-width bytes)
+typedef struct {
+  int32_t kind;
+  const void* data;          // (n,) scalar or (n, width) bytes
+  const uint8_t* validity;   // per-row 0/1, NULL = all valid
+  const int32_t* lengths;    // strings only
+  int32_t width;             // strings only
+} bt_col;
+
+// ---- spark-exact hashing (≙ spark_hash.rs) -------------------------------
+void bt_murmur3(const bt_col* cols, int32_t ncols, int64_t n, int32_t seed,
+                int32_t* out);
+void bt_xxhash64(const bt_col* cols, int32_t ncols, int64_t n, int64_t seed,
+                 int64_t* out);
+void bt_pmod(const int32_t* hashes, int64_t n, int32_t nparts, int32_t* out);
+
+// ---- batch serde (wire format identical to io/batch_serde.py) ------------
+int64_t bt_serialized_size(const bt_col* cols, int32_t ncols, int64_t num_rows);
+// returns bytes written, or -1 if cap too small
+int64_t bt_serialize_batch(const bt_col* cols, int32_t ncols, int64_t num_rows,
+                           uint8_t* out, int64_t cap);
+
+// ---- framed compression (≙ ipc_compression.rs; codec 0=raw 1=zlib) -------
+int64_t bt_max_frame_size(int64_t payload_len);
+int64_t bt_compress_frame(const uint8_t* payload, int64_t n, uint8_t* out,
+                          int64_t cap, int32_t use_zlib);
+// returns decompressed size, or -1 on error
+int64_t bt_decompress_frame(const uint8_t* frame, int64_t frame_len,
+                            uint8_t* out, int64_t cap);
+
+// ---- loser-tree k-way merge (≙ ds/loser_tree.rs) -------------------------
+// merge k ascending uint64-key runs; emits (run, offset) pairs in global
+// key order. total must equal sum(run_lens). returns rows emitted.
+int64_t bt_loser_tree_merge(const uint64_t* const* run_keys,
+                            const int64_t* run_lens, int32_t k,
+                            uint32_t* out_run, uint32_t* out_off,
+                            int64_t total);
+
+// ---- Arrow C Data Interface (spec-defined ABI) ---------------------------
+struct ArrowSchema {
+  const char* format;
+  const char* name;
+  const char* metadata;
+  int64_t flags;
+  int64_t n_children;
+  struct ArrowSchema** children;
+  struct ArrowSchema* dictionary;
+  void (*release)(struct ArrowSchema*);
+  void* private_data;
+};
+
+struct ArrowArray {
+  int64_t length;
+  int64_t null_count;
+  int64_t offset;
+  int64_t n_buffers;
+  int64_t n_children;
+  const void** buffers;
+  struct ArrowArray** children;
+  struct ArrowArray* dictionary;
+  void (*release)(struct ArrowArray*);
+  void* private_data;
+};
+
+// export one primitive column (kinds 0-6) as an Arrow array; copies the
+// buffers into private storage released via the Arrow release callback
+int32_t bt_arrow_export_primitive(const bt_col* col, int64_t n,
+                                  struct ArrowSchema* out_schema,
+                                  struct ArrowArray* out_array);
+// import a primitive Arrow array into caller buffers (validity decoded
+// from the bitmap). returns 0 on success.
+int32_t bt_arrow_import_primitive(const struct ArrowSchema* schema,
+                                  const struct ArrowArray* array,
+                                  void* data_out, uint8_t* validity_out,
+                                  int64_t cap);
+
+const char* bt_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // BLAZE_NATIVE_H

@@ -1,0 +1,225 @@
+// Spark-exact murmur3 (seed 42) and xxhash64 over host column buffers.
+// ≙ datafusion-ext-commons/src/spark_hash.rs + hash/xxhash.rs —
+// independent implementation from the Spark algorithm definitions; the
+// golden vectors in tests/test_native.py pin bit-exactness against the
+// (already Spark-golden-tested) device kernels.
+
+#include "blaze_native.h"
+
+#include <cstring>
+#include <initializer_list>
+
+namespace {
+
+// ---------------------------------------------------------------- murmur3
+
+inline uint32_t rotl32(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+
+inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= 0xcc9e2d51u;
+  k1 = rotl32(k1, 15);
+  k1 *= 0x1b873593u;
+  return k1;
+}
+
+inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5u + 0xe6546b64u;
+}
+
+inline uint32_t fmix(uint32_t h1, uint32_t len) {
+  h1 ^= len;
+  h1 ^= h1 >> 16;
+  h1 *= 0x85ebca6bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35u;
+  h1 ^= h1 >> 16;
+  return h1;
+}
+
+inline uint32_t mm3_int(uint32_t v, uint32_t seed) {
+  return fmix(mix_h1(seed, mix_k1(v)), 4);
+}
+
+inline uint32_t mm3_long(uint64_t v, uint32_t seed) {
+  uint32_t h1 = mix_h1(seed, mix_k1((uint32_t)v));
+  h1 = mix_h1(h1, mix_k1((uint32_t)(v >> 32)));
+  return fmix(h1, 8);
+}
+
+inline uint32_t mm3_bytes(const uint8_t* p, int32_t len, uint32_t seed) {
+  uint32_t h1 = seed;
+  int32_t aligned = len - (len % 4);
+  for (int32_t i = 0; i < aligned; i += 4) {
+    uint32_t w;
+    std::memcpy(&w, p + i, 4);
+    h1 = mix_h1(h1, mix_k1(w));
+  }
+  for (int32_t i = aligned; i < len; i++) {
+    // java byte semantics: sign-extended
+    int32_t b = (int8_t)p[i];
+    h1 = mix_h1(h1, mix_k1((uint32_t)b));
+  }
+  return fmix(h1, (uint32_t)len);
+}
+
+// ---------------------------------------------------------------- xxhash64
+
+constexpr uint64_t P1 = 0x9E3779B185EBCA87ull;
+constexpr uint64_t P2 = 0xC2B2AE3D27D4EB4Full;
+constexpr uint64_t P3 = 0x165667B19E3779F9ull;
+constexpr uint64_t P4 = 0x85EBCA77C2B2AE63ull;
+constexpr uint64_t P5 = 0x27D4EB2F165667C5ull;
+
+inline uint64_t rotl64(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+
+inline uint64_t xx_fmix(uint64_t h) {
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+inline uint64_t xx_int(uint32_t v, uint64_t seed) {
+  uint64_t h = seed + P5 + 4;
+  h ^= (uint64_t)v * P1;
+  h = rotl64(h, 23) * P2 + P3;
+  return xx_fmix(h);
+}
+
+inline uint64_t xx_long(uint64_t v, uint64_t seed) {
+  uint64_t h = seed + P5 + 8;
+  h ^= rotl64(v * P2, 31) * P1;
+  h = rotl64(h, 27) * P1 + P4;
+  return xx_fmix(h);
+}
+
+inline uint64_t xx_bytes(const uint8_t* p, int64_t len, uint64_t seed) {
+  uint64_t h;
+  int64_t i = 0;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    for (; i + 32 <= len; i += 32) {
+      uint64_t w[4];
+      std::memcpy(w, p + i, 32);
+      v1 = rotl64(v1 + w[0] * P2, 31) * P1;
+      v2 = rotl64(v2 + w[1] * P2, 31) * P1;
+      v3 = rotl64(v3 + w[2] * P2, 31) * P1;
+      v4 = rotl64(v4 + w[3] * P2, 31) * P1;
+    }
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    for (uint64_t v : {v1, v2, v3, v4}) {
+      h ^= rotl64(v * P2, 31) * P1;
+      h = h * P1 + P4;
+    }
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  for (; i + 8 <= len; i += 8) {
+    uint64_t w;
+    std::memcpy(&w, p + i, 8);
+    h = rotl64(h ^ (rotl64(w * P2, 31) * P1), 27) * P1 + P4;
+  }
+  if (i + 4 <= len) {
+    uint32_t w;
+    std::memcpy(&w, p + i, 4);
+    h = rotl64(h ^ ((uint64_t)w * P1), 23) * P2 + P3;
+    i += 4;
+  }
+  for (; i < len; i++) {
+    h = rotl64(h ^ ((uint64_t)p[i] * P5), 11) * P1;
+  }
+  return xx_fmix(h);
+}
+
+template <typename T>
+inline T load(const void* data, int64_t i) {
+  T v;
+  std::memcpy(&v, (const uint8_t*)data + i * sizeof(T), sizeof(T));
+  return v;
+}
+
+// -0.0 normalization (Spark hashes -0.0 as 0.0)
+inline uint32_t float_bits(float f) {
+  if (f == 0.0f) f = 0.0f;
+  uint32_t b;
+  std::memcpy(&b, &f, 4);
+  return b;
+}
+inline uint64_t double_bits(double d) {
+  if (d == 0.0) d = 0.0;
+  uint64_t b;
+  std::memcpy(&b, &d, 8);
+  return b;
+}
+
+}  // namespace
+
+extern "C" {
+
+void bt_murmur3(const bt_col* cols, int32_t ncols, int64_t n, int32_t seed,
+                int32_t* out) {
+  for (int64_t i = 0; i < n; i++) out[i] = seed;
+  for (int32_t c = 0; c < ncols; c++) {
+    const bt_col& col = cols[c];
+    for (int64_t i = 0; i < n; i++) {
+      if (col.validity && !col.validity[i]) continue;  // null: unchanged
+      uint32_t h = (uint32_t)out[i];
+      switch (col.kind) {
+        case 0:  h = mm3_int((uint32_t)(int32_t)load<uint8_t>(col.data, i), h); break;
+        case 1:  h = mm3_int((uint32_t)(int32_t)load<int8_t>(col.data, i), h); break;
+        case 2:  h = mm3_int((uint32_t)(int32_t)load<int16_t>(col.data, i), h); break;
+        case 3:  h = mm3_int((uint32_t)load<int32_t>(col.data, i), h); break;
+        case 4:  h = mm3_long((uint64_t)load<int64_t>(col.data, i), h); break;
+        case 5:  h = mm3_int(float_bits(load<float>(col.data, i)), h); break;
+        case 6:  h = mm3_long(double_bits(load<double>(col.data, i)), h); break;
+        case 7:
+          h = mm3_bytes((const uint8_t*)col.data + (int64_t)col.width * i,
+                        col.lengths[i], h);
+          break;
+      }
+      out[i] = (int32_t)h;
+    }
+  }
+}
+
+void bt_xxhash64(const bt_col* cols, int32_t ncols, int64_t n, int64_t seed,
+                 int64_t* out) {
+  for (int64_t i = 0; i < n; i++) out[i] = seed;
+  for (int32_t c = 0; c < ncols; c++) {
+    const bt_col& col = cols[c];
+    for (int64_t i = 0; i < n; i++) {
+      if (col.validity && !col.validity[i]) continue;
+      uint64_t h = (uint64_t)out[i];
+      switch (col.kind) {
+        case 0:  h = xx_int((uint32_t)(int32_t)load<uint8_t>(col.data, i), h); break;
+        case 1:  h = xx_int((uint32_t)(int32_t)load<int8_t>(col.data, i), h); break;
+        case 2:  h = xx_int((uint32_t)(int32_t)load<int16_t>(col.data, i), h); break;
+        case 3:  h = xx_int((uint32_t)load<int32_t>(col.data, i), h); break;
+        case 4:  h = xx_long((uint64_t)load<int64_t>(col.data, i), h); break;
+        case 5:  h = xx_int(float_bits(load<float>(col.data, i)), h); break;
+        case 6:  h = xx_long(double_bits(load<double>(col.data, i)), h); break;
+        case 7:
+          h = xx_bytes((const uint8_t*)col.data + (int64_t)col.width * i,
+                       col.lengths[i], h);
+          break;
+      }
+      out[i] = (int64_t)h;
+    }
+  }
+}
+
+void bt_pmod(const int32_t* hashes, int64_t n, int32_t nparts, int32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    int32_t m = hashes[i] % nparts;
+    out[i] = m < 0 ? m + nparts : m;
+  }
+}
+
+const char* bt_version(void) { return "blaze-tpu-native 0.1.0"; }
+
+}  // extern "C"

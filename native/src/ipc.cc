@@ -1,0 +1,55 @@
+// Framed compression [u32 len][u8 codec][payload], codec 0=raw 1=zlib —
+// binary-compatible with blaze_tpu/io/ipc_compression.py
+// (≙ common/ipc_compression.rs framing).
+
+#include "blaze_native.h"
+
+#include <cstring>
+#include <zlib.h>
+
+extern "C" {
+
+int64_t bt_max_frame_size(int64_t payload_len) {
+  return 5 + compressBound((uLong)payload_len);
+}
+
+int64_t bt_compress_frame(const uint8_t* payload, int64_t n, uint8_t* out,
+                          int64_t cap, int32_t use_zlib) {
+  if (cap < 5) return -1;
+  if (use_zlib) {
+    uLongf dest_len = (uLongf)(cap - 5);
+    int rc = compress2(out + 5, &dest_len, payload, (uLong)n, 1);
+    if (rc == Z_OK && (int64_t)dest_len < n) {
+      uint32_t ln = (uint32_t)dest_len;
+      std::memcpy(out, &ln, 4);
+      out[4] = 1;
+      return 5 + (int64_t)dest_len;
+    }
+  }
+  if (cap < 5 + n) return -1;
+  uint32_t ln = (uint32_t)n;
+  std::memcpy(out, &ln, 4);
+  out[4] = 0;
+  std::memcpy(out + 5, payload, n);
+  return 5 + n;
+}
+
+int64_t bt_decompress_frame(const uint8_t* frame, int64_t frame_len,
+                            uint8_t* out, int64_t cap) {
+  if (frame_len < 5) return -1;
+  uint32_t ln;
+  std::memcpy(&ln, frame, 4);
+  uint8_t codec = frame[4];
+  if ((int64_t)ln + 5 > frame_len) return -1;
+  if (codec == 0) {
+    if ((int64_t)ln > cap) return -1;
+    std::memcpy(out, frame + 5, ln);
+    return ln;
+  }
+  uLongf dest_len = (uLongf)cap;
+  int rc = uncompress(out, &dest_len, frame + 5, ln);
+  if (rc != Z_OK) return -1;
+  return (int64_t)dest_len;
+}
+
+}  // extern "C"

@@ -1,0 +1,90 @@
+// Loser-tree k-way merge over sorted uint64-key runs.
+// ≙ datafusion-ext-commons/src/ds/loser_tree.rs — the merge primitive
+// behind external sort (sort_exec.rs LoserTree merge); the shuffle
+// spill merge (RadixTournamentTree over partition-id runs) is the
+// nparts-ary special case with partition ids as keys.
+
+#include "blaze_native.h"
+
+#include <vector>
+
+namespace {
+
+struct Cursor {
+  const uint64_t* keys;
+  int64_t len;
+  int64_t pos;
+  bool exhausted() const { return pos >= len; }
+  uint64_t key() const { return keys[pos]; }
+};
+
+}  // namespace
+
+extern "C" {
+
+int64_t bt_loser_tree_merge(const uint64_t* const* run_keys,
+                            const int64_t* run_lens, int32_t k,
+                            uint32_t* out_run, uint32_t* out_off,
+                            int64_t total) {
+  if (k <= 0) return 0;
+  std::vector<Cursor> cur((size_t)k);
+  for (int32_t i = 0; i < k; i++) cur[(size_t)i] = {run_keys[i], run_lens[i], 0};
+
+  int32_t m = 1;
+  while (m < k) m <<= 1;
+
+  // wins_full(a, b): does run a beat run b?  smaller key wins,
+  // exhausted runs lose, ties break toward the lower run index
+  // (stable merge)
+  auto wins_full = [&](int32_t a, int32_t b) {
+    if (a < 0) return false;
+    if (b < 0) return true;
+    bool ea = cur[(size_t)a].exhausted(), eb = cur[(size_t)b].exhausted();
+    if (ea != eb) return eb;          // non-exhausted beats exhausted
+    if (ea) return a < b;
+    if (cur[(size_t)a].key() != cur[(size_t)b].key())
+      return cur[(size_t)a].key() < cur[(size_t)b].key();
+    return a < b;
+  };
+
+  // init: full bottom-up tournament; internal nodes 1..m-1 keep the
+  // LOSER of their match, the champion pops out the top
+  std::vector<int32_t> losers((size_t)m, -1);
+  std::vector<int32_t> winners((size_t)(2 * m), -1);
+  for (int32_t i = 0; i < m; i++) winners[(size_t)(m + i)] = i < k ? i : -1;
+  for (int32_t node = m - 1; node >= 1; node--) {
+    int32_t a = winners[(size_t)(2 * node)], b = winners[(size_t)(2 * node + 1)];
+    if (wins_full(a, b)) {
+      winners[(size_t)node] = a;
+      losers[(size_t)node] = b;
+    } else {
+      winners[(size_t)node] = b;
+      losers[(size_t)node] = a;
+    }
+  }
+  int32_t winner = winners[1];
+
+  auto replay = [&](int32_t leaf_run) {
+    int32_t w = leaf_run;
+    for (int32_t node = (m + leaf_run) >> 1; node >= 1; node >>= 1) {
+      if (wins_full(losers[(size_t)node], w)) {
+        int32_t t = losers[(size_t)node];
+        losers[(size_t)node] = w;
+        w = t;
+      }
+    }
+    return w;
+  };
+
+  int64_t emitted = 0;
+  while (emitted < total && winner >= 0 && !cur[(size_t)winner].exhausted()) {
+    out_run[emitted] = (uint32_t)winner;
+    out_off[emitted] = (uint32_t)cur[(size_t)winner].pos;
+    emitted++;
+    cur[(size_t)winner].pos++;
+    winner = replay(winner);
+  }
+  return emitted;
+}
+
+}  // extern "C"

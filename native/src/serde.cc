@@ -1,0 +1,87 @@
+// Columnar batch serialization — binary-compatible with
+// blaze_tpu/io/batch_serde.py (≙ io/batch_serde.rs wire format):
+//   u32 num_rows
+//   per column: u8 has_lengths, u32 data_nbytes, [u32 width],
+//               data, validity bitmap (LSB-first), [lengths i32]
+
+#include "blaze_native.h"
+
+#include <cstring>
+
+namespace {
+
+inline int64_t item_size(int32_t kind) {
+  switch (kind) {
+    case 0: case 1: return 1;
+    case 2: return 2;
+    case 3: case 5: return 4;
+    default: return 8;
+  }
+}
+
+inline int64_t bitmap_bytes(int64_t n) { return (n + 7) / 8; }
+
+}  // namespace
+
+extern "C" {
+
+int64_t bt_serialized_size(const bt_col* cols, int32_t ncols, int64_t num_rows) {
+  int64_t total = 4;
+  for (int32_t c = 0; c < ncols; c++) {
+    total += 5;  // has_lengths + data_nbytes
+    if (cols[c].kind == 7) {
+      total += 4;                                   // width
+      total += (int64_t)cols[c].width * num_rows;   // data
+      total += bitmap_bytes(num_rows);
+      total += 4 * num_rows;                        // lengths
+    } else {
+      total += item_size(cols[c].kind) * num_rows;
+      total += bitmap_bytes(num_rows);
+    }
+  }
+  return total;
+}
+
+int64_t bt_serialize_batch(const bt_col* cols, int32_t ncols, int64_t num_rows,
+                           uint8_t* out, int64_t cap) {
+  if (bt_serialized_size(cols, ncols, num_rows) > cap) return -1;
+  uint8_t* p = out;
+  uint32_t n32 = (uint32_t)num_rows;
+  std::memcpy(p, &n32, 4);
+  p += 4;
+  for (int32_t c = 0; c < ncols; c++) {
+    const bt_col& col = cols[c];
+    uint8_t has_len = col.kind == 7 ? 1 : 0;
+    int64_t nbytes = has_len ? (int64_t)col.width * num_rows
+                             : item_size(col.kind) * num_rows;
+    *p++ = has_len;
+    uint32_t nb32 = (uint32_t)nbytes;
+    std::memcpy(p, &nb32, 4);
+    p += 4;
+    if (has_len) {
+      uint32_t w = (uint32_t)col.width;
+      std::memcpy(p, &w, 4);
+      p += 4;
+    }
+    std::memcpy(p, col.data, nbytes);
+    p += nbytes;
+    // validity bitmap, LSB-first (numpy packbits bitorder="little")
+    int64_t bb = bitmap_bytes(num_rows);
+    std::memset(p, 0, bb);
+    if (col.validity) {
+      for (int64_t i = 0; i < num_rows; i++) {
+        if (col.validity[i]) p[i >> 3] |= (uint8_t)(1 << (i & 7));
+      }
+    } else {
+      for (int64_t i = 0; i < num_rows; i++) p[i >> 3] |= (uint8_t)(1 << (i & 7));
+    }
+    p += bb;
+    if (has_len) {
+      std::memcpy(p, col.lengths, 4 * num_rows);
+      p += 4 * num_rows;
+    }
+  }
+  return p - out;
+}
+
+}  // extern "C"
