@@ -1,0 +1,442 @@
+"""Self-contained Parquet subset writer/reader.
+
+≙ the file-format half of the reference's ParquetExec/ParquetSinkExec
+(parquet_exec.rs:65-418, parquet_sink_exec.rs) — implemented from the
+public parquet-format spec (no pyarrow in the image):
+
+- written files: PAR1 magic, one DATA_PAGE v1 per column chunk per row
+  group, PLAIN encoding, RLE/bit-packed definition levels for OPTIONAL
+  columns, UNCOMPRESSED or GZIP pages, thrift-compact FileMetaData with
+  min/max statistics per chunk.
+- reader: decodes that subset (plus dictionary-free files other writers
+  produce with the same encodings) and prunes row groups with the
+  pushed-down predicate over chunk statistics — the row-group
+  granularity of the reference's page filtering
+  (spark.blaze.parquet.enable.pageFiltering).
+
+Physical mapping: BOOLEAN (bit-packed) <- bool; INT32 <- int8/16/32 +
+DATE; INT64 <- int64/timestamp/decimal(<=18) [ConvertedType DECIMAL];
+FLOAT/DOUBLE; BYTE_ARRAY(UTF8) <- string.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..schema import DataType, Field, Schema, TypeKind
+from .thrift_compact import (
+    CT_BINARY, CT_I32, CT_I64, CT_STRUCT, CompactReader, CompactWriter,
+)
+
+MAGIC = b"PAR1"
+
+# parquet physical types
+T_BOOLEAN, T_INT32, T_INT64, T_INT96, T_FLOAT, T_DOUBLE, T_BYTE_ARRAY, T_FLBA = range(8)
+# converted types
+CONV_UTF8, CONV_DECIMAL, CONV_DATE, CONV_TS_MICROS = 0, 5, 6, 10
+# codecs
+CODEC_UNCOMPRESSED, CODEC_SNAPPY, CODEC_GZIP = 0, 1, 2
+
+
+def _physical(dtype: DataType) -> int:
+    k = dtype.kind
+    if k == TypeKind.BOOL:
+        return T_BOOLEAN
+    if k in (TypeKind.INT8, TypeKind.INT16, TypeKind.INT32, TypeKind.DATE32):
+        return T_INT32
+    if k in (TypeKind.INT64, TypeKind.TIMESTAMP, TypeKind.DECIMAL):
+        return T_INT64
+    if k == TypeKind.FLOAT32:
+        return T_FLOAT
+    if k == TypeKind.FLOAT64:
+        return T_DOUBLE
+    if dtype.is_string:
+        return T_BYTE_ARRAY
+    raise NotImplementedError(f"parquet type for {dtype!r}")
+
+
+def _rle_encode_defs(validity: np.ndarray) -> bytes:
+    """RLE runs of the 1-bit definition levels (bit width 1)."""
+    out = bytearray()
+    n = len(validity)
+    i = 0
+    while i < n:
+        v = validity[i]
+        j = i
+        while j < n and validity[j] == v:
+            j += 1
+        run = j - i
+        # RLE run: varint(count << 1), then the value in 1 byte (bit width 1)
+        hdr = run << 1
+        while True:
+            byte = hdr & 0x7F
+            hdr >>= 7
+            if hdr:
+                out.append(byte | 0x80)
+            else:
+                out.append(byte)
+                break
+        out.append(1 if v else 0)
+        i = j
+    return bytes(out)
+
+
+def _rle_decode_defs(data: bytes, num_values: int) -> Tuple[np.ndarray, int]:
+    """Decode 1-bit RLE/bit-packed hybrid definition levels."""
+    out = np.zeros(num_values, np.bool_)
+    pos = 0
+    filled = 0
+    while filled < num_values:
+        hdr = 0
+        shift = 0
+        while True:
+            b = data[pos]
+            pos += 1
+            hdr |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        if hdr & 1:
+            # bit-packed: groups of 8 values, 1 bit each
+            groups = hdr >> 1
+            nvals = groups * 8
+            for g in range(groups):
+                byte = data[pos]
+                pos += 1
+                for bit in range(8):
+                    if filled < num_values:
+                        out[filled] = (byte >> bit) & 1
+                        filled += 1
+        else:
+            run = hdr >> 1
+            v = data[pos]
+            pos += 1
+            out[filled : filled + run] = bool(v)
+            filled += run
+    return out, pos
+
+
+def _plain_encode(dtype: DataType, data: np.ndarray, validity: np.ndarray,
+                  lengths: Optional[np.ndarray]) -> bytes:
+    """PLAIN values for non-null rows only."""
+    phys = _physical(dtype)
+    nn = validity.astype(bool)
+    if phys == T_BOOLEAN:
+        vals = data[nn].astype(np.bool_)
+        return np.packbits(vals, bitorder="little").tobytes()
+    if phys == T_INT32:
+        return data[nn].astype("<i4").tobytes()
+    if phys == T_INT64:
+        return data[nn].astype("<i8").tobytes()
+    if phys == T_FLOAT:
+        return data[nn].astype("<f4").tobytes()
+    if phys == T_DOUBLE:
+        return data[nn].astype("<f8").tobytes()
+    # byte array: u32 length + bytes per value
+    out = bytearray()
+    idx = np.nonzero(nn)[0]
+    for i in idx:
+        ln = int(lengths[i])
+        out += struct.pack("<I", ln)
+        out += data[i, :ln].tobytes()
+    return bytes(out)
+
+
+def _plain_decode(dtype: DataType, raw: bytes, validity: np.ndarray, width: int):
+    phys = _physical(dtype)
+    n = len(validity)
+    nn = int(validity.sum())
+    if phys == T_BOOLEAN:
+        bits = np.unpackbits(np.frombuffer(raw, np.uint8), bitorder="little")[:nn].astype(np.bool_)
+        out = np.zeros(n, np.bool_)
+        out[validity] = bits
+        return out, None
+    np_map = {T_INT32: "<i4", T_INT64: "<i8", T_FLOAT: "<f4", T_DOUBLE: "<f8"}
+    if phys in np_map:
+        vals = np.frombuffer(raw, np_map[phys], count=nn)
+        out = np.zeros(n, dtype=dtype.np_dtype)
+        out[validity] = vals.astype(dtype.np_dtype)
+        return out, None
+    # byte array
+    data = np.zeros((n, width), np.uint8)
+    lengths = np.zeros(n, np.int32)
+    pos = 0
+    for i in np.nonzero(validity)[0]:
+        (ln,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        lengths[i] = min(ln, width)
+        data[i, : lengths[i]] = np.frombuffer(raw, np.uint8, count=lengths[i], offset=pos)
+        pos += ln
+    return data, lengths
+
+
+def _stat_bytes(dtype: DataType, v) -> bytes:
+    phys = _physical(dtype)
+    if phys == T_INT32:
+        return struct.pack("<i", int(v))
+    if phys == T_INT64:
+        return struct.pack("<q", int(v))
+    if phys == T_FLOAT:
+        return struct.pack("<f", float(v))
+    if phys == T_DOUBLE:
+        return struct.pack("<d", float(v))
+    if phys == T_BOOLEAN:
+        return struct.pack("<?", bool(v))
+    return bytes(v)  # byte array: raw bytes
+
+
+def _stat_value(dtype: DataType, b: bytes):
+    phys = _physical(dtype)
+    if phys == T_INT32:
+        return struct.unpack("<i", b)[0]
+    if phys == T_INT64:
+        return struct.unpack("<q", b)[0]
+    if phys == T_FLOAT:
+        return struct.unpack("<f", b)[0]
+    if phys == T_DOUBLE:
+        return struct.unpack("<d", b)[0]
+    if phys == T_BOOLEAN:
+        return b[0] != 0
+    return bytes(b)
+
+
+# ------------------------------------------------------------------ writer
+
+def write_parquet(
+    path: str,
+    schema: Schema,
+    columns: Dict[str, Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]],
+    row_group_rows: int = 1 << 20,
+    codec: int = CODEC_GZIP,
+):
+    """columns: name -> (data, validity|None, lengths|None) host arrays."""
+    n = next(iter(columns.values()))[0].shape[0]
+    f = open(path, "wb")
+    f.write(MAGIC)
+    row_groups: List[dict] = []
+    for rg_start in range(0, max(n, 1), row_group_rows):
+        rg_end = min(rg_start + row_group_rows, n)
+        rg_rows = rg_end - rg_start
+        chunks = []
+        total_bytes = 0
+        for fld in schema.fields:
+            data, validity, lengths = columns[fld.name]
+            v = (
+                validity[rg_start:rg_end].astype(bool)
+                if validity is not None
+                else np.ones(rg_rows, bool)
+            )
+            d = data[rg_start:rg_end]
+            l = lengths[rg_start:rg_end] if lengths is not None else None
+            defs = _rle_encode_defs(v)
+            values = _plain_encode(fld.dtype, d, v, l)
+            payload = struct.pack("<I", len(defs)) + defs + values
+            comp = gzip.compress(payload, 1) if codec == CODEC_GZIP else payload
+            # min/max over non-null rows
+            stats = None
+            if v.any():
+                if fld.dtype.is_string:
+                    vals = [d[i, : l[i]].tobytes() for i in np.nonzero(v)[0]]
+                    stats = (min(vals), max(vals))
+                else:
+                    nn = d[v]
+                    stats = (nn.min(), nn.max())
+            ph = CompactWriter()
+            ph.write_i(1, 0)                        # type = DATA_PAGE
+            ph.write_i(2, len(payload))             # uncompressed size
+            ph.write_i(3, len(comp))                # compressed size
+            ph.begin_struct(5)                      # data_page_header
+            ph.write_i(1, rg_rows)                  # num_values
+            ph.write_i(2, 0)                        # encoding PLAIN
+            ph.write_i(3, 3)                        # def levels RLE
+            ph.write_i(4, 3)                        # rep levels RLE
+            ph.end_struct()
+            ph.buf.append(0)                        # end PageHeader struct
+            header = ph.getvalue()
+            offset = f.tell()
+            f.write(header)
+            f.write(comp)
+            chunk_bytes = len(header) + len(comp)
+            total_bytes += chunk_bytes
+            chunks.append(
+                dict(
+                    field=fld, offset=offset, num_values=rg_rows,
+                    total_comp=chunk_bytes, total_uncomp=len(header) + len(payload),
+                    stats=stats, null_count=int((~v).sum()), codec=codec,
+                )
+            )
+        row_groups.append(dict(chunks=chunks, rows=rg_rows, bytes=total_bytes))
+        if n == 0:
+            break
+
+    # FileMetaData
+    w = CompactWriter()
+    w.write_i(1, 1)  # version
+    # schema: root element + one per field
+    w.begin_list(2, CT_STRUCT, len(schema.fields) + 1)
+    w.list_elem_struct_begin()
+    _w_string(w, 4, "schema")
+    w.write_i(5, len(schema.fields))  # num_children
+    w.list_elem_struct_end()
+    for fld in schema.fields:
+        w.list_elem_struct_begin()
+        w.write_i(1, _physical(fld.dtype))
+        w.write_i(3, 1)  # always OPTIONAL: def levels are always written
+        _w_string(w, 4, fld.name)
+        conv = None
+        if fld.dtype.kind == TypeKind.STRING:
+            conv = CONV_UTF8
+        elif fld.dtype.is_decimal:
+            conv = CONV_DECIMAL
+        elif fld.dtype.kind == TypeKind.DATE32:
+            conv = CONV_DATE
+        elif fld.dtype.kind == TypeKind.TIMESTAMP:
+            conv = CONV_TS_MICROS
+        if conv is not None:
+            w.write_i(6, conv)
+        if fld.dtype.is_decimal:
+            w.write_i(7, fld.dtype.scale)
+            w.write_i(8, fld.dtype.precision)
+        w.list_elem_struct_end()
+    w.write_i64(3, n)  # num_rows
+    w.begin_list(4, CT_STRUCT, len(row_groups))
+    for rg in row_groups:
+        w.list_elem_struct_begin()
+        w.begin_list(1, CT_STRUCT, len(rg["chunks"]))
+        for ch in rg["chunks"]:
+            w.list_elem_struct_begin()
+            w.write_i64(2, ch["offset"])  # file_offset
+            w.begin_struct(3)             # ColumnMetaData
+            w.write_i(1, _physical(ch["field"].dtype))
+            w.begin_list(2, CT_I32, 2)
+            w.list_elem_varint(0)  # PLAIN
+            w.list_elem_varint(3)  # RLE
+            w.begin_list(3, CT_BINARY, 1)
+            w.list_elem_binary(ch["field"].name.encode())
+            w.write_i(4, ch["codec"])
+            w.write_i64(5, ch["num_values"])
+            w.write_i64(6, ch["total_uncomp"])
+            w.write_i64(7, ch["total_comp"])
+            w.write_i64(9, ch["offset"])  # data_page_offset
+            if ch["stats"] is not None:
+                w.begin_struct(12)
+                w.write_binary(3, struct.pack("<q", ch["null_count"]))
+                # use modern min_value/max_value fields
+                w.write_binary(5, _stat_bytes(ch["field"].dtype, ch["stats"][1]))
+                w.write_binary(6, _stat_bytes(ch["field"].dtype, ch["stats"][0]))
+                w.end_struct()
+            w.end_struct()
+            w.list_elem_struct_end()
+        w.write_i64(2, rg["bytes"])
+        w.write_i64(3, rg["rows"])
+        w.list_elem_struct_end()
+    _w_string(w, 6, "blaze-tpu parquet 0.1")
+    w.buf.append(0)  # FileMetaData stop
+
+    meta = w.getvalue()
+    f.write(meta)
+    f.write(struct.pack("<I", len(meta)))
+    f.write(MAGIC)
+    f.close()
+
+
+def _w_string(w: CompactWriter, fid: int, s: str):
+    w.write_binary(fid, s.encode("utf-8"))
+
+
+# ------------------------------------------------------------------ reader
+
+@dataclass
+class ChunkMeta:
+    name: str
+    phys: int
+    codec: int
+    num_values: int
+    offset: int
+    total_comp: int
+    min_value: Optional[bytes] = None
+    max_value: Optional[bytes] = None
+    null_count: Optional[int] = None
+
+
+@dataclass
+class RowGroupMeta:
+    rows: int
+    chunks: Dict[str, ChunkMeta]
+
+
+@dataclass
+class ParquetFileMeta:
+    num_rows: int
+    schema_elements: List[dict]
+    row_groups: List[RowGroupMeta]
+
+
+def read_metadata(path: str) -> ParquetFileMeta:
+    with open(path, "rb") as f:
+        f.seek(-8, os.SEEK_END)
+        tail = f.read(8)
+        assert tail[4:] == MAGIC, "not a parquet file"
+        meta_len = struct.unpack("<I", tail[:4])[0]
+        f.seek(-8 - meta_len, os.SEEK_END)
+        meta = f.read(meta_len)
+    r = CompactReader(meta)
+    fm = r.read_struct()
+    schema_elems = [dict(e) for e in fm.get(2, [])]
+    rgs: List[RowGroupMeta] = []
+    for rg in fm.get(4, []):
+        chunks: Dict[str, ChunkMeta] = {}
+        for ch in rg.get(1, []):
+            md = ch.get(3, {})
+            name = b"/".join(md.get(3, [b"?"])).decode()
+            stats = md.get(12, {})
+            chunks[name] = ChunkMeta(
+                name=name,
+                phys=md.get(1, 0),
+                codec=md.get(4, 0),
+                num_values=md.get(5, 0),
+                offset=md.get(9, md.get(2, ch.get(2, 0))),
+                total_comp=md.get(7, 0),
+                min_value=bytes(stats[6]) if 6 in stats else None,
+                max_value=bytes(stats[5]) if 5 in stats else None,
+                null_count=struct.unpack("<q", bytes(stats[3]))[0]
+                if 3 in stats and len(stats.get(3, b"")) == 8
+                else None,
+            )
+        rgs.append(RowGroupMeta(rows=rg.get(3, 0), chunks=chunks))
+    return ParquetFileMeta(num_rows=fm.get(3, 0), schema_elements=schema_elems, row_groups=rgs)
+
+
+def read_column_chunk(path: str, chunk: ChunkMeta, dtype: DataType, nullable: bool = True):
+    """Returns (data, validity, lengths|None) numpy arrays."""
+    with open(path, "rb") as f:
+        f.seek(chunk.offset)
+        blob = f.read(chunk.total_comp if chunk.total_comp else None)
+    r = CompactReader(blob)
+    ph = r.read_struct()
+    uncomp_size = ph.get(2, 0)
+    comp_size = ph.get(3, 0)
+    dph = ph.get(5, {})
+    num_values = dph.get(1, chunk.num_values)
+    payload = blob[r.pos : r.pos + comp_size]
+    if chunk.codec == CODEC_GZIP:
+        payload = gzip.decompress(payload)
+    elif chunk.codec != CODEC_UNCOMPRESSED:
+        raise NotImplementedError(f"codec {chunk.codec}")
+    if nullable:
+        (def_len,) = struct.unpack_from("<I", payload, 0)
+        defs = payload[4 : 4 + def_len]
+        validity, _ = _rle_decode_defs(defs, num_values)
+        values = payload[4 + def_len :]
+    else:
+        validity = np.ones(num_values, bool)
+        values = payload
+    width = dtype.string_width if dtype.is_string else 0
+    data, lengths = _plain_decode(dtype, values, validity, width)
+    return data, validity, lengths

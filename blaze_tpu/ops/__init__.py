@@ -22,6 +22,8 @@ from .joins import BroadcastJoinExec, HashJoinExec, SortMergeJoinExec
 from .window import WindowExec, WindowFunction
 from .expand import ExpandExec
 from .generate import GenerateExec
+from .parquet_scan import ParquetScanExec
+from .parquet_sink import ParquetSinkExec
 
 __all__ = [
     "ExecNode", "MemoryScanExec", "ProjectExec", "FilterExec", "AggExec",
@@ -29,5 +31,5 @@ __all__ = [
     "LimitExec", "UnionExec", "RenameColumnsExec", "EmptyPartitionsExec",
     "DebugExec", "CoalesceBatchesExec", "BroadcastJoinExec", "HashJoinExec",
     "SortMergeJoinExec", "WindowExec", "WindowFunction", "ExpandExec",
-    "GenerateExec",
+    "GenerateExec", "ParquetScanExec", "ParquetSinkExec",
 ]

@@ -1,0 +1,145 @@
+"""Parquet sink.
+
+≙ reference ParquetSinkExec (parquet_sink_exec.rs:55-573): drains the
+child stream into parquet files, one per partition, with hive-style
+``col=value`` subdirectories when partition columns are set (dynamic
+partitioning).  Output paths/committing belong to the caller (the JVM
+side's NativeParquetSinkUtils / committer in Spark mode; the standalone
+scheduler here).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..batch import RecordBatch, strings_to_list
+from ..io import parquet as pq
+from ..runtime.context import TaskContext
+from ..schema import Field, Schema
+from .base import BatchStream, ExecNode
+
+
+class ParquetSinkExec(ExecNode):
+    def __init__(
+        self,
+        child: ExecNode,
+        output_path: str,
+        partition_columns: Sequence[str] = (),
+    ):
+        super().__init__([child])
+        self.output_path = output_path
+        self.partition_columns = list(partition_columns)
+        self.written_files: List[str] = []
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def _accumulate(self, target: Dict[str, list], batch: RecordBatch):
+        b = batch.to_host()
+        for f, c in zip(b.schema.fields, b.columns):
+            data = np.asarray(c.data)[: b.num_rows]
+            validity = np.asarray(c.validity)[: b.num_rows]
+            entry = target.setdefault(f.name, [[], [], []])
+            entry[0].append(data)
+            entry[1].append(validity)
+            if c.lengths is not None:
+                entry[2].append(np.asarray(c.lengths)[: b.num_rows])
+
+    def _write(self, path: str, cols: Dict[str, list], schema: Schema):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        arrays = {}
+        for f in schema.fields:
+            data_parts, valid_parts, len_parts = cols[f.name]
+            if f.dtype.is_string:
+                w = f.dtype.string_width
+                n = sum(p.shape[0] for p in data_parts)
+                data = np.zeros((n, w), np.uint8)
+                off = 0
+                for p in data_parts:
+                    data[off : off + p.shape[0], : p.shape[1]] = p[:, :w]
+                    off += p.shape[0]
+                arrays[f.name] = (
+                    data,
+                    np.concatenate(valid_parts) if valid_parts else np.zeros(0, bool),
+                    np.concatenate(len_parts) if len_parts else np.zeros(0, np.int32),
+                )
+            else:
+                arrays[f.name] = (
+                    np.concatenate(data_parts) if data_parts else np.zeros(0, f.dtype.np_dtype),
+                    np.concatenate(valid_parts) if valid_parts else np.zeros(0, bool),
+                    None,
+                )
+        pq.write_parquet(path, schema, arrays)
+        self.written_files.append(path)
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            out_schema = Schema(
+                [f for f in self.schema.fields if f.name not in self.partition_columns]
+            )
+            if not self.partition_columns:
+                acc: Dict[str, list] = {}
+                rows = 0
+                for batch in self.children[0].execute(partition, ctx):
+                    self._accumulate(acc, batch)
+                    rows += batch.num_rows
+                if rows or partition == 0:
+                    path = os.path.join(self.output_path, f"part-{partition:05d}.parquet")
+                    with self.metrics.timer("output_io_time"):
+                        if not acc:
+                            acc = {f.name: [[], [], []] for f in self.schema.fields}
+                        self._write(path, acc, self.schema)
+                    self.metrics.add("output_rows", rows)
+                return
+            # dynamic hive partitioning: group rows by partition values
+            buckets: Dict[Tuple, Dict[str, list]] = {}
+            for batch in self.children[0].execute(partition, ctx):
+                b = batch.to_host()
+                keys_per_row = []
+                for pc in self.partition_columns:
+                    c = b.column(pc)
+                    if c.dtype.is_string:
+                        keys_per_row.append(strings_to_list(c, b.num_rows))
+                    else:
+                        keys_per_row.append(
+                            [
+                                None if not np.asarray(c.validity)[i] else np.asarray(c.data)[i]
+                                for i in range(b.num_rows)
+                            ]
+                        )
+                row_keys = list(zip(*keys_per_row)) if keys_per_row else []
+                distinct = sorted(set(row_keys), key=lambda t: tuple(str(x) for x in t))
+                for key in distinct:
+                    mask = np.array([rk == key for rk in row_keys], bool)
+                    idx = np.nonzero(mask)[0]
+                    sub_cols = []
+                    for f in out_schema.fields:
+                        c = b.column(f.name)
+                        sub_cols.append(
+                            type(c)(
+                                c.dtype,
+                                np.asarray(c.data)[idx],
+                                np.asarray(c.validity)[idx],
+                                None if c.lengths is None else np.asarray(c.lengths)[idx],
+                            )
+                        )
+                    sub = RecordBatch(out_schema, sub_cols, len(idx))
+                    self._accumulate(buckets.setdefault(key, {}), sub)
+            with self.metrics.timer("output_io_time"):
+                for key, acc in buckets.items():
+                    parts = "/".join(
+                        f"{pc}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
+                        for pc, v in zip(self.partition_columns, key)
+                    )
+                    path = os.path.join(
+                        self.output_path, parts, f"part-{partition:05d}.parquet"
+                    )
+                    self._write(path, acc, out_schema)
+            return
+            yield  # pragma: no cover
+
+        return stream()
