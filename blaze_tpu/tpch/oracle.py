@@ -267,3 +267,158 @@ def oracle_q19(tables: Dict[str, HostTable]):
         if ok:
             total += int(rev[i])
     return total
+
+
+def oracle_q2(tables: Dict[str, HostTable]):
+    re_, na, su, ps, part = (
+        tables["region"], tables["nation"], tables["supplier"],
+        tables["partsupp"], tables["part"],
+    )
+    europe = int(re_["r_regionkey"][0][_s_eq(re_, "r_name", "EUROPE")][0])
+    nname = {
+        int(k): v
+        for k, v, r in zip(na["n_nationkey"][0], _sv(na, "n_name"), na["n_regionkey"][0])
+        if int(r) == europe
+    }
+    s_info = {}
+    snames = _sv(su, "s_name")
+    saddr = _sv(su, "s_address")
+    sphone = _sv(su, "s_phone")
+    scom = _sv(su, "s_comment")
+    for i in range(su["s_suppkey"][0].shape[0]):
+        nk = int(su["s_nationkey"][0][i])
+        if nk in nname:
+            s_info[int(su["s_suppkey"][0][i])] = (
+                int(su["s_acctbal"][0][i]), snames[i], nname[nk], saddr[i], sphone[i], scom[i]
+            )
+    ptype = _sv(part, "p_type")
+    pmfgr = _sv(part, "p_mfgr")
+    eligible_parts = {
+        int(k): pmfgr[i]
+        for i, k in enumerate(part["p_partkey"][0])
+        if int(part["p_size"][0][i]) == 15 and ptype[i].endswith("BRASS")
+    }
+    # min cost per eligible part over european suppliers
+    rows = []
+    mincost: Dict[int, int] = {}
+    for i in range(ps["ps_partkey"][0].shape[0]):
+        pk = int(ps["ps_partkey"][0][i])
+        sk = int(ps["ps_suppkey"][0][i])
+        if pk in eligible_parts and sk in s_info:
+            c = int(ps["ps_supplycost"][0][i])
+            if pk not in mincost or c < mincost[pk]:
+                mincost[pk] = c
+    for i in range(ps["ps_partkey"][0].shape[0]):
+        pk = int(ps["ps_partkey"][0][i])
+        sk = int(ps["ps_suppkey"][0][i])
+        if pk in eligible_parts and sk in s_info and int(ps["ps_supplycost"][0][i]) == mincost[pk]:
+            bal, sn, nn, addr, ph, com = s_info[sk]
+            rows.append((bal, sn, nn, pk, eligible_parts[pk]))
+    rows.sort(key=lambda t: (-t[0], t[2], t[1], t[3]))
+    return rows[:100]
+
+
+def oracle_q7(tables: Dict[str, HostTable]):
+    na, su, cu, orders, li = (
+        tables["nation"], tables["supplier"], tables["customer"],
+        tables["orders"], tables["lineitem"],
+    )
+    nname = dict(zip(na["n_nationkey"][0].tolist(), _sv(na, "n_name")))
+    fr_ge = {k: v for k, v in nname.items() if v in ("FRANCE", "GERMANY")}
+    s_nat = {int(s): fr_ge[int(n)] for s, n in zip(su["s_suppkey"][0], su["s_nationkey"][0]) if int(n) in fr_ge}
+    c_nat = {int(c): fr_ge[int(n)] for c, n in zip(cu["c_custkey"][0], cu["c_nationkey"][0]) if int(n) in fr_ge}
+    o_cust = dict(zip(orders["o_orderkey"][0].tolist(), orders["o_custkey"][0].tolist()))
+    lm = (li["l_shipdate"][0] >= _days(1995, 1, 1)) & (li["l_shipdate"][0] <= _days(1996, 12, 31))
+    rev = li["l_extendedprice"][0] * (100 - li["l_discount"][0])
+    import datetime as _dt
+
+    out: Dict[Tuple, int] = {}
+    for i in np.nonzero(lm)[0]:
+        sk = int(li["l_suppkey"][0][i])
+        if sk not in s_nat:
+            continue
+        ok = int(li["l_orderkey"][0][i])
+        ck = o_cust.get(ok)
+        cn = c_nat.get(int(ck)) if ck is not None else None
+        if cn is None:
+            continue
+        sn = s_nat[sk]
+        if not ((sn == "FRANCE" and cn == "GERMANY") or (sn == "GERMANY" and cn == "FRANCE")):
+            continue
+        year = (_dt.date(1970, 1, 1) + _dt.timedelta(days=int(li["l_shipdate"][0][i]))).year
+        k = (sn, cn, year)
+        out[k] = out.get(k, 0) + int(rev[i])
+    return dict(sorted(out.items()))
+
+
+def oracle_q9(tables: Dict[str, HostTable]):
+    part, su, li, ps, orders, na = (
+        tables["part"], tables["supplier"], tables["lineitem"],
+        tables["partsupp"], tables["orders"], tables["nation"],
+    )
+    green = {int(k) for k, nm in zip(part["p_partkey"][0], _sv(part, "p_name")) if "green" in nm}
+    nname = dict(zip(na["n_nationkey"][0].tolist(), _sv(na, "n_name")))
+    s_nat = {int(s): nname[int(n)] for s, n in zip(su["s_suppkey"][0], su["s_nationkey"][0])}
+    cost = {}
+    for i in range(ps["ps_partkey"][0].shape[0]):
+        cost[(int(ps["ps_partkey"][0][i]), int(ps["ps_suppkey"][0][i]))] = int(ps["ps_supplycost"][0][i])
+    o_date = dict(zip(orders["o_orderkey"][0].tolist(), orders["o_orderdate"][0].tolist()))
+    import datetime as _dt
+
+    rev = li["l_extendedprice"][0] * (100 - li["l_discount"][0])
+    out: Dict[Tuple, int] = {}
+    for i in range(li["l_orderkey"][0].shape[0]):
+        pk = int(li["l_partkey"][0][i])
+        if pk not in green:
+            continue
+        sk = int(li["l_suppkey"][0][i])
+        key = (pk, sk)
+        if key not in cost:
+            continue
+        ok = int(li["l_orderkey"][0][i])
+        if ok not in o_date:
+            continue
+        nation = s_nat.get(sk)
+        if nation is None:
+            continue
+        year = (_dt.date(1970, 1, 1) + _dt.timedelta(days=int(o_date[ok]))).year
+        # amount = rev(scale4) - supplycost(scale2)*quantity(scale2) -> scale 4
+        amount = int(rev[i]) - cost[key] * int(li["l_quantity"][0][i])
+        k = (nation, year)
+        out[k] = out.get(k, 0) + amount
+    return out
+
+
+def oracle_q11(tables: Dict[str, HostTable]):
+    na, su, ps = tables["nation"], tables["supplier"], tables["partsupp"]
+    germany = {int(k) for k, v in zip(na["n_nationkey"][0], _sv(na, "n_name")) if v == "GERMANY"}
+    sk_ok = {int(s) for s, n in zip(su["s_suppkey"][0], su["s_nationkey"][0]) if int(n) in germany}
+    by_part: Dict[int, int] = {}
+    total = 0
+    for i in range(ps["ps_partkey"][0].shape[0]):
+        if int(ps["ps_suppkey"][0][i]) not in sk_ok:
+            continue
+        v = int(ps["ps_supplycost"][0][i]) * int(ps["ps_availqty"][0][i])  # scale 2
+        pk = int(ps["ps_partkey"][0][i])
+        by_part[pk] = by_part.get(pk, 0) + v
+        total += v
+    thr = (total / 10**2) * 0.0001
+    out = {pk: v for pk, v in by_part.items() if v / 10**2 > thr}
+    return out
+
+
+def oracle_q13(tables: Dict[str, HostTable]):
+    import re as _re
+
+    cu, orders = tables["customer"], tables["orders"]
+    rx = _re.compile("special.*requests")
+    keep = [not rx.search(c) for c in _sv(orders, "o_comment")]
+    per_cust: Dict[int, int] = {int(c): 0 for c in cu["c_custkey"][0]}
+    for i in np.nonzero(np.array(keep))[0]:
+        ck = int(orders["o_custkey"][0][i])
+        if ck in per_cust:
+            per_cust[ck] += 1
+    hist: Dict[int, int] = {}
+    for n in per_cust.values():
+        hist[n] = hist.get(n, 0) + 1
+    return hist

@@ -99,6 +99,35 @@ def revenue_expr() -> Expr:
     return col("l_extendedprice") * (dec12(1) - col("l_discount"))
 
 
+def scalar_subquery(plan: ExecNode, column: str) -> Expr:
+    """Evaluate a 1-row subplan eagerly and inject the value as a typed
+    literal — ≙ the reference's SparkScalarSubqueryWrapperExpr (the JVM
+    evaluates the subquery and the native side sees a literal)."""
+    from ..batch import batch_to_pydict
+    from ..runtime.context import TaskContext
+
+    value = None
+    found = False
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            if d[column]:
+                value = d[column][0]
+                found = True
+                break
+        if found:
+            break
+    t = plan.schema.field(column).dtype
+    if t.is_decimal and value is not None:
+        # batch_to_pydict returns decimals unscaled; Lit takes logical
+        from ..serde.from_proto import _RawUnscaled
+
+        lit_ = lit(0, t)
+        lit_.value = _RawUnscaled(value)
+        return lit_
+    return lit(value, t)
+
+
 def q1(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     f = FilterExec(t["lineitem"], col("l_shipdate") <= lit(D(1998, 9, 2)))
     disc_price = revenue_expr()
@@ -350,9 +379,222 @@ def q19(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     return two_stage_agg(proj, [], [AggFunction("sum", col("rev"), "revenue")], n_parts)
 
 
+def q2(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    region = FilterExec(t["region"], col("r_name") == lit("EUROPE"))
+    nation = broadcast_join(
+        ProjectExec(region, [col("r_regionkey")]), t["nation"],
+        [col("r_regionkey")], [col("n_regionkey")], JoinType.INNER, build_is_left=True,
+    )
+    nation_p = ProjectExec(nation, [col("n_nationkey"), col("n_name")])
+    supp = broadcast_join(
+        nation_p, t["supplier"], [col("n_nationkey")], [col("s_nationkey")],
+        JoinType.INNER, build_is_left=True,
+    )
+    supp_p = ProjectExec(
+        supp,
+        [col("s_suppkey"), col("s_name"), col("s_address"), col("s_phone"),
+         col("s_acctbal"), col("s_comment"), col("n_name")],
+    )
+    ps = broadcast_join(
+        supp_p, t["partsupp"], [col("s_suppkey")], [col("ps_suppkey")],
+        JoinType.INNER, build_is_left=True,
+    )
+    part_f = FilterExec(
+        t["part"], (col("p_size") == lit(15)) & Like(col("p_type"), "%BRASS")
+    )
+    part_p = ProjectExec(part_f, [col("p_partkey"), col("p_mfgr")])
+    joined = broadcast_join(
+        part_p, ps, [col("p_partkey")], [col("ps_partkey")], JoinType.INNER,
+        build_is_left=True,
+    )
+    mincost = two_stage_agg(
+        joined,
+        [GroupingExpr(col("p_partkey"), "mk")],
+        [AggFunction("min", col("ps_supplycost"), "mc")],
+        n_parts,
+    )
+    withmin = shuffle_join(
+        joined, mincost, [col("p_partkey")], [col("mk")], JoinType.INNER, n_parts
+    )
+    best = FilterExec(withmin, col("ps_supplycost") == col("mc"))
+    proj = ProjectExec(
+        best,
+        [col("s_acctbal"), col("s_name"), col("n_name"), col("p_partkey"),
+         col("p_mfgr"), col("s_address"), col("s_phone"), col("s_comment")],
+    )
+    return single_sorted(
+        proj,
+        [SortField(col("s_acctbal"), ascending=False), SortField(col("n_name")),
+         SortField(col("s_name")), SortField(col("p_partkey"))],
+        fetch=100,
+    )
+
+
+def q7(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    nations = FilterExec(t["nation"], col("n_name").isin("FRANCE", "GERMANY"))
+    nations_p = ProjectExec(nations, [col("n_nationkey"), col("n_name")])
+    supp = broadcast_join(
+        nations_p, t["supplier"], [col("n_nationkey")], [col("s_nationkey")],
+        JoinType.INNER, build_is_left=True,
+    )
+    supp_p = ProjectExec(supp, [col("s_suppkey"), col("n_name").alias("supp_nation")])
+    cust = broadcast_join(
+        ProjectExec(nations, [col("n_nationkey"), col("n_name").alias("cust_nation")]),
+        t["customer"], [col("n_nationkey")], [col("c_nationkey")],
+        JoinType.INNER, build_is_left=True,
+    )
+    cust_p = ProjectExec(cust, [col("c_custkey"), col("cust_nation")])
+    orders_p = ProjectExec(t["orders"], [col("o_orderkey"), col("o_custkey")])
+    co = shuffle_join(cust_p, orders_p, [col("c_custkey")], [col("o_custkey")], JoinType.INNER, n_parts)
+    co_p = ProjectExec(co, [col("o_orderkey"), col("cust_nation")])
+    line = FilterExec(
+        t["lineitem"],
+        (col("l_shipdate") >= lit(D(1995, 1, 1))) & (col("l_shipdate") <= lit(D(1996, 12, 31))),
+    )
+    line_p = ProjectExec(
+        line,
+        [col("l_orderkey"), col("l_suppkey"), col("l_shipdate"), revenue_expr().alias("volume")],
+    )
+    lco = shuffle_join(co_p, line_p, [col("o_orderkey")], [col("l_orderkey")], JoinType.INNER, n_parts)
+    full = broadcast_join(
+        supp_p, lco, [col("s_suppkey")], [col("l_suppkey")], JoinType.INNER, build_is_left=True
+    )
+    pair = FilterExec(
+        full,
+        ((col("supp_nation") == lit("FRANCE")) & (col("cust_nation") == lit("GERMANY")))
+        | ((col("supp_nation") == lit("GERMANY")) & (col("cust_nation") == lit("FRANCE"))),
+    )
+    proj = ProjectExec(
+        pair,
+        [col("supp_nation"), col("cust_nation"),
+         func("year", col("l_shipdate")).alias("l_year"), col("volume")],
+    )
+    agg = two_stage_agg(
+        proj,
+        [GroupingExpr(col("supp_nation"), "supp_nation"),
+         GroupingExpr(col("cust_nation"), "cust_nation"),
+         GroupingExpr(col("l_year"), "l_year")],
+        [AggFunction("sum", col("volume"), "revenue")],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("supp_nation")), SortField(col("cust_nation")), SortField(col("l_year"))],
+    )
+
+
+def q9(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    part_f = FilterExec(t["part"], Like(col("p_name"), "%green%"))
+    part_p = ProjectExec(part_f, [col("p_partkey")])
+    line_p = ProjectExec(
+        t["lineitem"],
+        [col("l_orderkey"), col("l_partkey"), col("l_suppkey"), col("l_quantity"),
+         revenue_expr().alias("gross")],
+    )
+    lp = broadcast_join(
+        part_p, line_p, [col("p_partkey")], [col("l_partkey")], JoinType.INNER,
+        build_is_left=True,
+    )
+    ps_p = ProjectExec(
+        t["partsupp"], [col("ps_partkey"), col("ps_suppkey"), col("ps_supplycost")]
+    )
+    lps = shuffle_join(
+        lp, ps_p,
+        [col("l_partkey"), col("l_suppkey")], [col("ps_partkey"), col("ps_suppkey")],
+        JoinType.INNER, n_parts,
+    )
+    orders_p = ProjectExec(t["orders"], [col("o_orderkey"), col("o_orderdate")])
+    lo = shuffle_join(lps, orders_p, [col("l_orderkey")], [col("o_orderkey")], JoinType.INNER, n_parts)
+    supp_n = broadcast_join(
+        ProjectExec(t["nation"], [col("n_nationkey"), col("n_name")]), t["supplier"],
+        [col("n_nationkey")], [col("s_nationkey")], JoinType.INNER, build_is_left=True,
+    )
+    supp_p = ProjectExec(supp_n, [col("s_suppkey"), col("n_name")])
+    full = broadcast_join(
+        supp_p, lo, [col("s_suppkey")], [col("l_suppkey")], JoinType.INNER, build_is_left=True
+    )
+    amount = col("gross") - col("ps_supplycost") * col("l_quantity")
+    proj = ProjectExec(
+        full,
+        [col("n_name").alias("nation"), func("year", col("o_orderdate")).alias("o_year"),
+         amount.alias("amount")],
+    )
+    agg = two_stage_agg(
+        proj,
+        [GroupingExpr(col("nation"), "nation"), GroupingExpr(col("o_year"), "o_year")],
+        [AggFunction("sum", col("amount"), "sum_profit")],
+        n_parts,
+    )
+    return single_sorted(
+        agg, [SortField(col("nation")), SortField(col("o_year"), ascending=False)]
+    )
+
+
+def q11(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    nation = FilterExec(t["nation"], col("n_name") == lit("GERMANY"))
+    supp = broadcast_join(
+        ProjectExec(nation, [col("n_nationkey")]), t["supplier"],
+        [col("n_nationkey")], [col("s_nationkey")], JoinType.INNER, build_is_left=True,
+    )
+    supp_p = ProjectExec(supp, [col("s_suppkey")])
+    ps = broadcast_join(
+        supp_p, t["partsupp"], [col("s_suppkey")], [col("ps_suppkey")],
+        JoinType.INNER, build_is_left=True,
+    )
+    value = col("ps_supplycost") * col("ps_availqty").cast(DataType.decimal(10, 0))
+    proj = ProjectExec(ps, [col("ps_partkey"), value.alias("v")])
+    agg = two_stage_agg(
+        proj,
+        [GroupingExpr(col("ps_partkey"), "ps_partkey")],
+        [AggFunction("sum", col("v"), "value")],
+        n_parts,
+    )
+    total = two_stage_agg(
+        ProjectExec(ps, [value.alias("v")]), [],
+        [AggFunction("sum", col("v"), "tv")], n_parts,
+    )
+    threshold_plan = ProjectExec(
+        total, [(col("tv").cast(DataType.float64()) * lit(0.0001)).alias("thr")]
+    )
+    thr = scalar_subquery(threshold_plan, "thr")
+    having = FilterExec(agg, col("value").cast(DataType.float64()) > thr)
+    return single_sorted(having, [SortField(col("value"), ascending=False)])
+
+
+def q13(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    orders = FilterExec(
+        t["orders"], Like(col("o_comment"), "%special%requests%", negated=True)
+    )
+    orders_p = ProjectExec(orders, [col("o_orderkey"), col("o_custkey")])
+    cust_p = ProjectExec(t["customer"], [col("c_custkey")])
+    cex = NativeShuffleExchangeExec(cust_p, HashPartitioning([col("c_custkey")], n_parts))
+    oex = NativeShuffleExchangeExec(orders_p, HashPartitioning([col("o_custkey")], n_parts))
+    # LEFT outer preserving customer (probe side)
+    from ..ops.joins import HashJoinExec
+
+    j = HashJoinExec(oex, cex, [col("o_custkey")], [col("c_custkey")], JoinType.LEFT, build_is_left=False)
+    counts = two_stage_agg(
+        j,
+        [GroupingExpr(col("c_custkey"), "c_custkey")],
+        [AggFunction("count", col("o_orderkey"), "c_count")],
+        n_parts,
+    )
+    hist = two_stage_agg(
+        counts,
+        [GroupingExpr(col("c_count"), "c_count")],
+        [AggFunction("count_star", None, "custdist")],
+        n_parts,
+    )
+    return single_sorted(
+        hist,
+        [SortField(col("custdist"), ascending=False), SortField(col("c_count"), ascending=False)],
+    )
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
-    "q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
-    "q10": q10, "q12": q12, "q14": q14, "q19": q19,
+    "q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q7": q7,
+    "q9": q9, "q10": q10, "q11": q11, "q12": q12, "q13": q13, "q14": q14,
+    "q19": q19,
 }
 
 

@@ -127,3 +127,48 @@ def test_q19(data, scans):
         assert got_v is None or got_v == 0
     else:
         assert got_v == exp
+
+
+def test_q2(data, scans):
+    got = run(build_query("q2", scans, N_PARTS))
+    exp = O.oracle_q2(data)
+    rows = list(zip(got["s_acctbal"], got["s_name"], got["n_name"], got["p_partkey"], got["p_mfgr"]))
+    assert len(rows) == len(exp)
+    assert set((r[0], r[3]) for r in rows) == set((e[0], e[3]) for e in exp)
+    assert [r[0] for r in rows] == sorted([r[0] for r in rows], reverse=True)
+
+
+def test_q7(data, scans):
+    got = run(build_query("q7", scans, N_PARTS))
+    exp = O.oracle_q7(data)
+    rows = {
+        (sn, cn, y): r
+        for sn, cn, y, r in zip(got["supp_nation"], got["cust_nation"], got["l_year"], got["revenue"])
+    }
+    assert rows == exp
+
+
+def test_q9(data, scans):
+    got = run(build_query("q9", scans, N_PARTS))
+    exp = O.oracle_q9(data)
+    rows = {
+        (n, y): v for n, y, v in zip(got["nation"], got["o_year"], got["sum_profit"])
+    }
+    assert rows == exp
+    keys = list(zip(got["nation"], got["o_year"]))
+    assert keys == sorted(keys, key=lambda t: (t[0], -t[1]))
+
+
+def test_q11(data, scans):
+    got = run(build_query("q11", scans, N_PARTS))
+    exp = O.oracle_q11(data)
+    rows = dict(zip(got["ps_partkey"], got["value"]))
+    assert rows == exp
+    assert got["value"] == sorted(got["value"], reverse=True)
+
+
+def test_q13(data, scans):
+    got = run(build_query("q13", scans, N_PARTS))
+    exp = O.oracle_q13(data)
+    rows = dict(zip(got["c_count"], got["custdist"]))
+    assert rows == exp
