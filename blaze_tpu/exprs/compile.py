@@ -160,6 +160,10 @@ def infer_dtype(expr: Expr, schema: Schema) -> DataType:
         from .functions import infer_func_dtype
 
         return infer_func_dtype(expr, schema)
+    from .ir import PythonUdf
+
+    if isinstance(expr, PythonUdf):
+        return expr.dtype
     raise TypeError(f"cannot infer type of {expr!r}")
 
 
@@ -475,6 +479,10 @@ def needs_host(expr: Expr) -> bool:
     """Does this tree contain a node only evaluable on host?  ≙ the
     reference's convertExprWithFallback wrapping unconvertible exprs
     into a JVM-callback UDF (NativeConverters.scala:407)."""
+    from .ir import PythonUdf
+
+    if isinstance(expr, PythonUdf):
+        return True
     if isinstance(expr, Like):
         parts = like_pattern_parts(expr.pattern)
         if parts is None:
@@ -507,6 +515,12 @@ def split_host_exprs(exprs: List[Expr]) -> Tuple[List[Expr], List[Tuple[str, Exp
     host_parts: List[Tuple[str, Expr]] = []
 
     def walk(e: Expr) -> Expr:
+        from .ir import PythonUdf
+
+        if isinstance(e, PythonUdf):
+            name = f"__host_{len(host_parts)}"
+            host_parts.append((name, e))
+            return Col(name)
         if isinstance(e, Like) and needs_host(e) and not needs_host(e.child):
             name = f"__host_{len(host_parts)}"
             host_parts.append((name, e))
@@ -536,11 +550,43 @@ def split_host_exprs(exprs: List[Expr]) -> Tuple[List[Expr], List[Tuple[str, Exp
 
 
 def host_eval(expr: Expr, batch) -> Column:
-    """Evaluate a host-fallback expression on the host (numpy/python).
-    Currently: LIKE patterns beyond the device subset."""
+    """Evaluate a host-fallback expression on the host (numpy/python):
+    LIKE patterns beyond the device subset, and PythonUdf (the
+    SparkUDFWrapperExpr round-trip analogue)."""
     import re
 
-    from ..batch import column_from_numpy, strings_to_list
+    from ..batch import column_from_numpy, column_from_strings, strings_to_list
+    from .ir import PythonUdf
+
+    if isinstance(expr, PythonUdf):
+        from ..batch import batch_to_pydict
+
+        arg_cols = {}
+        for i, a in enumerate(expr.args):
+            assert isinstance(a, Col), "PythonUdf args must be direct columns"
+            arg_cols[a.name] = batch.column(a.name)
+        d = batch_to_pydict(batch.select([a.name for a in expr.args]))
+        names = [a.name for a in expr.args]
+        out_vals = []
+        for i in range(batch.num_rows):
+            out_vals.append(expr.fn(*[d[nm][i] for nm in names]))
+        if expr.dtype.is_string:
+            return column_from_strings(out_vals, dtype=expr.dtype, capacity=batch.capacity).to_device()
+        validity = np.array([v is not None for v in out_vals] + [False] * (batch.capacity - batch.num_rows))
+        if expr.dtype.is_decimal:
+            scale = 10 ** expr.dtype.scale
+            vals = np.array(
+                [int(round(v * scale)) if v is not None else 0 for v in out_vals]
+                + [0] * (batch.capacity - batch.num_rows),
+                np.int64,
+            )
+        else:
+            vals = np.array(
+                [v if v is not None else 0 for v in out_vals]
+                + [0] * (batch.capacity - batch.num_rows),
+                expr.dtype.np_dtype,
+            )
+        return column_from_numpy(expr.dtype, vals, validity, batch.capacity).to_device()
 
     if isinstance(expr, Like):
         child = expr.child

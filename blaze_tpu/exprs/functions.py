@@ -258,3 +258,36 @@ def _coalesce(expr, schema, cols, n, lower_fn):
                 jnp.where(take, p.validity, result.validity),
             )
     return result
+
+
+# ----------------------------------------------------- bloom might_contain
+
+def _might_contain_t(e, ts):
+    return DataType.bool_()
+
+
+_bloom_cache: Dict[bytes, "object"] = {}
+
+
+@register("might_contain", _might_contain_t)
+def _might_contain(expr, schema, cols, n, lower_fn):
+    """might_contain(serialized_filter_literal, expr) — ≙ reference
+    BloomFilterMightContainExpr (datafusion-ext-exprs) probing a
+    Spark-format bloom filter; probe vectorized on device."""
+    from .bloom import SparkBloomFilter
+    from .ir import Lit
+
+    filt_lit = expr.args[0]
+    assert isinstance(filt_lit, Lit) and isinstance(filt_lit.value, (bytes, bytearray)), (
+        "might_contain filter must be a binary literal"
+    )
+    key = bytes(filt_lit.value)
+    filt = _bloom_cache.get(key)
+    if filt is None:
+        filt = SparkBloomFilter.deserialize(key)
+        _bloom_cache[key] = filt
+    c = lower_fn(expr.args[1], schema, cols, n)
+    v = filt.might_contain_device(c)
+    import jax.numpy as jnp
+
+    return Column(DataType.bool_(), v, jnp.ones(n, jnp.bool_))

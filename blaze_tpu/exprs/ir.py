@@ -155,6 +155,21 @@ class ScalarFunc(Expr):
     args: List[Expr]
 
 
+@dataclass(eq=False)
+class PythonUdf(Expr):
+    """Host-evaluated python UDF over column args.
+
+    ≙ reference SparkUDFWrapperExpr (spark_udf_wrapper.rs:45-229): the
+    unconvertible expression ships as an opaque serialized payload, the
+    engine round-trips the argument batch to the host runtime per
+    batch, and the result re-enters the device pipeline as a column."""
+
+    fn: Any                    # callable(*row_values) -> value (picklable)
+    args: List[Expr]
+    dtype: "DataType"
+    name: str = "pyudf"
+
+
 def _wrap(v) -> Expr:
     return v if isinstance(v, Expr) else Lit(v)
 
