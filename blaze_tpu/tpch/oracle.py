@@ -422,3 +422,219 @@ def oracle_q13(tables: Dict[str, HostTable]):
     for n in per_cust.values():
         hist[n] = hist.get(n, 0) + 1
     return hist
+
+
+def oracle_q8(tables: Dict[str, HostTable]):
+    re_, na, cu, orders, li, part, su = (
+        tables["region"], tables["nation"], tables["customer"], tables["orders"],
+        tables["lineitem"], tables["part"], tables["supplier"],
+    )
+    import datetime as _dt
+
+    america = int(re_["r_regionkey"][0][_s_eq(re_, "r_name", "AMERICA")][0])
+    am_nk = {int(k) for k, r in zip(na["n_nationkey"][0], na["n_regionkey"][0]) if int(r) == america}
+    am_cust = {int(c) for c, n in zip(cu["c_custkey"][0], cu["c_nationkey"][0]) if int(n) in am_nk}
+    om = (orders["o_orderdate"][0] >= _days(1995, 1, 1)) & (orders["o_orderdate"][0] <= _days(1996, 12, 31))
+    o_info = {
+        int(k): int(d)
+        for k, c, d in zip(orders["o_orderkey"][0][om], orders["o_custkey"][0][om], orders["o_orderdate"][0][om])
+        if int(c) in am_cust
+    }
+    steel = {int(k) for k, t in zip(part["p_partkey"][0], _sv(part, "p_type")) if t == "ECONOMY ANODIZED STEEL"}
+    nname = dict(zip(na["n_nationkey"][0].tolist(), _sv(na, "n_name")))
+    s_nat = {int(s): nname[int(n)] for s, n in zip(su["s_suppkey"][0], su["s_nationkey"][0])}
+    rev = li["l_extendedprice"][0] * (100 - li["l_discount"][0])
+    by_year: Dict[int, List[int]] = {}
+    for i in range(li["l_orderkey"][0].shape[0]):
+        if int(li["l_partkey"][0][i]) not in steel:
+            continue
+        ok = int(li["l_orderkey"][0][i])
+        if ok not in o_info:
+            continue
+        year = (_dt.date(1970, 1, 1) + _dt.timedelta(days=o_info[ok])).year
+        nat = s_nat[int(li["l_suppkey"][0][i])]
+        e = by_year.setdefault(year, [0, 0])
+        e[1] += int(rev[i])
+        if nat == "BRAZIL":
+            e[0] += int(rev[i])
+    return {y: (b / t if t else 0.0) for y, (b, t) in sorted(by_year.items())}
+
+
+def oracle_q15(tables: Dict[str, HostTable]):
+    li, su = tables["lineitem"], tables["supplier"]
+    m = (li["l_shipdate"][0] >= _days(1996, 1, 1)) & (li["l_shipdate"][0] < _days(1996, 4, 1))
+    rev = li["l_extendedprice"][0] * (100 - li["l_discount"][0])
+    by_supp: Dict[int, int] = {}
+    for i in np.nonzero(m)[0]:
+        sk = int(li["l_suppkey"][0][i])
+        by_supp[sk] = by_supp.get(sk, 0) + int(rev[i])
+    if not by_supp:
+        return []
+    mx = max(by_supp.values())
+    snames = dict(zip(su["s_suppkey"][0].tolist(), _sv(su, "s_name")))
+    rows = [(sk, snames.get(sk), v) for sk, v in by_supp.items() if v == mx and sk in snames]
+    rows.sort()
+    return rows
+
+
+def oracle_q16(tables: Dict[str, HostTable]):
+    import re as _re
+
+    part, su, ps = tables["part"], tables["supplier"], tables["partsupp"]
+    sizes = {49, 14, 23, 45, 19, 3, 36, 9}
+    ptype = _sv(part, "p_type")
+    pbrand = _sv(part, "p_brand")
+    keep_part = {}
+    for i, k in enumerate(part["p_partkey"][0]):
+        if pbrand[i] != "Brand#45" and not ptype[i].startswith("MEDIUM POLISHED") and int(part["p_size"][0][i]) in sizes:
+            keep_part[int(k)] = (pbrand[i], ptype[i], int(part["p_size"][0][i]))
+    rx = _re.compile("special.*requests")
+    bad = {int(s) for s, c in zip(su["s_suppkey"][0], _sv(su, "s_comment")) if rx.search(c)}
+    groups: Dict[Tuple, set] = {}
+    for i in range(ps["ps_partkey"][0].shape[0]):
+        pk = int(ps["ps_partkey"][0][i])
+        sk = int(ps["ps_suppkey"][0][i])
+        if pk in keep_part and sk not in bad:
+            groups.setdefault(keep_part[pk], set()).add(sk)
+    return {k: len(v) for k, v in groups.items()}
+
+
+def oracle_q17(tables: Dict[str, HostTable]):
+    part, li = tables["part"], tables["lineitem"]
+    pb = _sv(part, "p_brand")
+    pc = _sv(part, "p_container")
+    keys = {
+        int(k)
+        for i, k in enumerate(part["p_partkey"][0])
+        if pb[i] == "Brand#23" and pc[i] == "MED BOX"
+    }
+    qty_by_part: Dict[int, List[int]] = {}
+    rows = []
+    for i in range(li["l_partkey"][0].shape[0]):
+        pk = int(li["l_partkey"][0][i])
+        if pk in keys:
+            qty_by_part.setdefault(pk, []).append(i)
+    total = 0
+    for pk, idxs in qty_by_part.items():
+        qs = [int(li["l_quantity"][0][i]) for i in idxs]
+        # engine avg: exact int path or float; avg dec(16,6): shift 4
+        s = sum(qs)
+        n = len(qs)
+        avg_unscaled = s * 10**4 // n if (s * 10**4) % n * 2 < n else -(-s * 10**4 // n)
+        # replicate HALF_UP: use same float path as engine (dec(22,2)+4>18)
+        f = float(s) * 1e4 / n
+        avg_unscaled = int(np.where(f >= 0, np.floor(f + 0.5), np.ceil(f - 0.5)))
+        threshold = 0.2 * (avg_unscaled / 10**6)
+        for i in idxs:
+            if int(li["l_quantity"][0][i]) / 10**2 < threshold:
+                total += int(li["l_extendedprice"][0][i])
+    return total / 10**2 / 7.0
+
+
+def oracle_q18(tables: Dict[str, HostTable]):
+    li, orders, cu = tables["lineitem"], tables["orders"], tables["customer"]
+    qsum: Dict[int, int] = {}
+    for i in range(li["l_orderkey"][0].shape[0]):
+        ok = int(li["l_orderkey"][0][i])
+        qsum[ok] = qsum.get(ok, 0) + int(li["l_quantity"][0][i])
+    big = {ok: q for ok, q in qsum.items() if q > 300 * 100}
+    cname = dict(zip(cu["c_custkey"][0].tolist(), _sv(cu, "c_name")))
+    rows = []
+    for i in range(orders["o_orderkey"][0].shape[0]):
+        ok = int(orders["o_orderkey"][0][i])
+        if ok in big:
+            ck = int(orders["o_custkey"][0][i])
+            rows.append((
+                cname.get(ck), ck, ok, int(orders["o_orderdate"][0][i]),
+                int(orders["o_totalprice"][0][i]), big[ok],
+            ))
+    rows.sort(key=lambda t: (-t[4], t[3], t[2]))
+    return rows[:100]
+
+
+def oracle_q20(tables: Dict[str, HostTable]):
+    part, li, ps, su, na = (
+        tables["part"], tables["lineitem"], tables["partsupp"],
+        tables["supplier"], tables["nation"],
+    )
+    forest = {int(k) for k, nm in zip(part["p_partkey"][0], _sv(part, "p_name")) if nm.startswith("forest")}
+    m = (li["l_shipdate"][0] >= _days(1994, 1, 1)) & (li["l_shipdate"][0] < _days(1995, 1, 1))
+    used: Dict[Tuple[int, int], int] = {}
+    for i in np.nonzero(m)[0]:
+        k = (int(li["l_partkey"][0][i]), int(li["l_suppkey"][0][i]))
+        used[k] = used.get(k, 0) + int(li["l_quantity"][0][i])
+    qualified = set()
+    for i in range(ps["ps_partkey"][0].shape[0]):
+        pk, sk = int(ps["ps_partkey"][0][i]), int(ps["ps_suppkey"][0][i])
+        if pk not in forest:
+            continue
+        u = used.get((pk, sk))
+        if u is None:
+            continue
+        if int(ps["ps_availqty"][0][i]) > 0.5 * (u / 100):
+            qualified.add(sk)
+    canada = {int(k) for k, v in zip(na["n_nationkey"][0], _sv(na, "n_name")) if v == "CANADA"}
+    rows = []
+    snames = _sv(su, "s_name")
+    saddr = _sv(su, "s_address")
+    for i in range(su["s_suppkey"][0].shape[0]):
+        sk = int(su["s_suppkey"][0][i])
+        if sk in qualified and int(su["s_nationkey"][0][i]) in canada:
+            rows.append((snames[i], saddr[i]))
+    rows.sort()
+    return rows
+
+
+def oracle_q21(tables: Dict[str, HostTable]):
+    li, orders, su, na = (
+        tables["lineitem"], tables["orders"], tables["supplier"], tables["nation"],
+    )
+    saudi = {int(k) for k, v in zip(na["n_nationkey"][0], _sv(na, "n_name")) if v == "SAUDI ARABIA"}
+    s_saudi = {int(s) for s, n in zip(su["s_suppkey"][0], su["s_nationkey"][0]) if int(n) in saudi}
+    snames = dict(zip(su["s_suppkey"][0].tolist(), _sv(su, "s_name")))
+    status_f = {int(k) for k, st in zip(orders["o_orderkey"][0], _sv(orders, "o_orderstatus")) if st == "F"}
+    all_supp: Dict[int, set] = {}
+    late_supp: Dict[int, set] = {}
+    late_rows = []
+    lat = li["l_receiptdate"][0] > li["l_commitdate"][0]
+    for i in range(li["l_orderkey"][0].shape[0]):
+        ok = int(li["l_orderkey"][0][i])
+        sk = int(li["l_suppkey"][0][i])
+        all_supp.setdefault(ok, set()).add(sk)
+        if lat[i]:
+            late_supp.setdefault(ok, set()).add(sk)
+            late_rows.append((ok, sk))
+    out: Dict[str, int] = {}
+    for ok, sk in late_rows:
+        if sk not in s_saudi or ok not in status_f:
+            continue
+        if len(all_supp[ok]) > 1 and len(late_supp[ok]) == 1:
+            nm = snames[sk]
+            out[nm] = out.get(nm, 0) + 1
+    return out
+
+
+def oracle_q22(tables: Dict[str, HostTable]):
+    cu, orders = tables["customer"], tables["orders"]
+    codes = {"13", "31", "23", "29", "30", "18", "17"}
+    phones = _sv(cu, "c_phone")
+    sel = [i for i in range(len(phones)) if phones[i][:2] in codes]
+    pos = [i for i in sel if int(cu["c_acctbal"][0][i]) > 0]
+    if not pos:
+        return {}
+    s = sum(int(cu["c_acctbal"][0][i]) for i in pos)
+    f = float(s) * 1e4 / len(pos)
+    avg_unscaled = int(np.where(f >= 0, np.floor(f + 0.5), np.ceil(f - 0.5)))  # scale 6
+    thr = avg_unscaled / 10**6
+    has_orders = set(orders["o_custkey"][0].tolist())
+    out: Dict[str, List[int]] = {}
+    for i in sel:
+        bal = int(cu["c_acctbal"][0][i])
+        if bal / 10**2 <= thr:
+            continue
+        if int(cu["c_custkey"][0][i]) in has_orders:
+            continue
+        e = out.setdefault(phones[i][:2], [0, 0])
+        e[0] += 1
+        e[1] += bal
+    return out

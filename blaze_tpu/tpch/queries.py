@@ -591,10 +591,348 @@ def q13(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+def distinct_rows(child: ExecNode, names: List[str], n_parts: int) -> ExecNode:
+    """DISTINCT via group-by-all-columns (the Spark rewrite)."""
+    return two_stage_agg(
+        child, [GroupingExpr(col(nm), nm) for nm in names], [], n_parts
+    )
+
+
+def q8(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    region = FilterExec(t["region"], col("r_name") == lit("AMERICA"))
+    am_nations = broadcast_join(
+        ProjectExec(region, [col("r_regionkey")]), t["nation"],
+        [col("r_regionkey")], [col("n_regionkey")], JoinType.INNER, build_is_left=True,
+    )
+    am_cust = broadcast_join(
+        ProjectExec(am_nations, [col("n_nationkey")]), t["customer"],
+        [col("n_nationkey")], [col("c_nationkey")], JoinType.INNER, build_is_left=True,
+    )
+    cust_p = ProjectExec(am_cust, [col("c_custkey")])
+    orders = FilterExec(
+        t["orders"],
+        (col("o_orderdate") >= lit(D(1995, 1, 1))) & (col("o_orderdate") <= lit(D(1996, 12, 31))),
+    )
+    orders_p = ProjectExec(orders, [col("o_orderkey"), col("o_custkey"), col("o_orderdate")])
+    co = broadcast_join(
+        cust_p, orders_p, [col("c_custkey")], [col("o_custkey")], JoinType.INNER,
+        build_is_left=True,
+    )
+    co_p = ProjectExec(co, [col("o_orderkey"), col("o_orderdate")])
+    part_f = FilterExec(t["part"], col("p_type") == lit("ECONOMY ANODIZED STEEL"))
+    line_p = ProjectExec(
+        t["lineitem"],
+        [col("l_orderkey"), col("l_partkey"), col("l_suppkey"), revenue_expr().alias("volume")],
+    )
+    lp = broadcast_join(
+        ProjectExec(part_f, [col("p_partkey")]), line_p,
+        [col("p_partkey")], [col("l_partkey")], JoinType.INNER, build_is_left=True,
+    )
+    lo = shuffle_join(co_p, lp, [col("o_orderkey")], [col("l_orderkey")], JoinType.INNER, n_parts)
+    supp_n = broadcast_join(
+        ProjectExec(t["nation"], [col("n_nationkey"), col("n_name")]), t["supplier"],
+        [col("n_nationkey")], [col("s_nationkey")], JoinType.INNER, build_is_left=True,
+    )
+    supp_p = ProjectExec(supp_n, [col("s_suppkey"), col("n_name")])
+    full = broadcast_join(
+        supp_p, lo, [col("s_suppkey")], [col("l_suppkey")], JoinType.INNER, build_is_left=True
+    )
+    brazil_vol = Case([(col("n_name") == lit("BRAZIL"), col("volume"))], lit(0))
+    proj = ProjectExec(
+        full,
+        [func("year", col("o_orderdate")).alias("o_year"),
+         col("volume"), brazil_vol.alias("brazil_volume")],
+    )
+    agg = two_stage_agg(
+        proj,
+        [GroupingExpr(col("o_year"), "o_year")],
+        [AggFunction("sum", col("brazil_volume"), "sb"),
+         AggFunction("sum", col("volume"), "sv")],
+        n_parts,
+    )
+    share = col("sb").cast(DataType.float64()) / col("sv").cast(DataType.float64())
+    proj2 = ProjectExec(agg, [col("o_year"), share.alias("mkt_share")])
+    return single_sorted(proj2, [SortField(col("o_year"))])
+
+
+def q15(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    line = FilterExec(
+        t["lineitem"],
+        (col("l_shipdate") >= lit(D(1996, 1, 1))) & (col("l_shipdate") < lit(D(1996, 4, 1))),
+    )
+    line_p = ProjectExec(line, [col("l_suppkey"), revenue_expr().alias("rev")])
+    revenue = two_stage_agg(
+        line_p,
+        [GroupingExpr(col("l_suppkey"), "supplier_no")],
+        [AggFunction("sum", col("rev"), "total_revenue")],
+        n_parts,
+    )
+    max_plan = two_stage_agg(
+        revenue, [], [AggFunction("max", col("total_revenue"), "m")], n_parts
+    )
+    m = scalar_subquery(max_plan, "m")
+    best = FilterExec(revenue, col("total_revenue") == m)
+    supp_p = ProjectExec(
+        t["supplier"], [col("s_suppkey"), col("s_name"), col("s_address"), col("s_phone")]
+    )
+    j = broadcast_join(
+        best, supp_p, [col("supplier_no")], [col("s_suppkey")], JoinType.INNER,
+        build_is_left=False,
+    )
+    proj = ProjectExec(
+        j, [col("s_suppkey"), col("s_name"), col("s_address"), col("s_phone"), col("total_revenue")]
+    )
+    return single_sorted(proj, [SortField(col("s_suppkey"))])
+
+
+def q16(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    part_f = FilterExec(
+        t["part"],
+        (col("p_brand") != lit("Brand#45"))
+        & Like(col("p_type"), "MEDIUM POLISHED%", negated=True)
+        & col("p_size").isin(49, 14, 23, 45, 19, 3, 36, 9),
+    )
+    part_p = ProjectExec(part_f, [col("p_partkey"), col("p_brand"), col("p_type"), col("p_size")])
+    bad_supp = FilterExec(t["supplier"], Like(col("s_comment"), "%special%requests%"))
+    bad_supp_p = ProjectExec(bad_supp, [col("s_suppkey")])
+    ps_p = ProjectExec(t["partsupp"], [col("ps_partkey"), col("ps_suppkey")])
+    # NOT IN (bad suppliers) -> anti join
+    psx = NativeShuffleExchangeExec(ps_p, HashPartitioning([col("ps_suppkey")], n_parts))
+    bsx = NativeShuffleExchangeExec(bad_supp_p, HashPartitioning([col("s_suppkey")], n_parts))
+    from ..ops.joins import HashJoinExec
+
+    good_ps = HashJoinExec(
+        bsx, psx, [col("s_suppkey")], [col("ps_suppkey")], JoinType.LEFT_ANTI, build_is_left=False
+    )
+    j = broadcast_join(
+        part_p, good_ps, [col("p_partkey")], [col("ps_partkey")], JoinType.INNER,
+        build_is_left=True,
+    )
+    # count(distinct ps_suppkey) = distinct (group keys + suppkey) then count
+    dedup = distinct_rows(
+        ProjectExec(j, [col("p_brand"), col("p_type"), col("p_size"), col("ps_suppkey")]),
+        ["p_brand", "p_type", "p_size", "ps_suppkey"],
+        n_parts,
+    )
+    agg = two_stage_agg(
+        dedup,
+        [GroupingExpr(col("p_brand"), "p_brand"), GroupingExpr(col("p_type"), "p_type"),
+         GroupingExpr(col("p_size"), "p_size")],
+        [AggFunction("count_star", None, "supplier_cnt")],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("supplier_cnt"), ascending=False), SortField(col("p_brand")),
+         SortField(col("p_type")), SortField(col("p_size"))],
+    )
+
+
+def q17(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    part_f = FilterExec(
+        t["part"],
+        (col("p_brand") == lit("Brand#23")) & (col("p_container") == lit("MED BOX")),
+    )
+    part_p = ProjectExec(part_f, [col("p_partkey")])
+    line_p = ProjectExec(
+        t["lineitem"], [col("l_partkey"), col("l_quantity"), col("l_extendedprice")]
+    )
+    lp = broadcast_join(
+        part_p, line_p, [col("p_partkey")], [col("l_partkey")], JoinType.INNER,
+        build_is_left=True,
+    )
+    avgq = two_stage_agg(
+        lp,
+        [GroupingExpr(col("p_partkey"), "ak")],
+        [AggFunction("avg", col("l_quantity"), "aq")],
+        n_parts,
+    )
+    j = shuffle_join(lp, avgq, [col("p_partkey")], [col("ak")], JoinType.INNER, n_parts)
+    # l_quantity < 0.2 * avg(l_quantity): avg is decimal(16,6); compare at
+    # common scale via floats (documented float-division semantics)
+    keep = FilterExec(
+        j,
+        col("l_quantity").cast(DataType.float64())
+        < lit(0.2) * col("aq").cast(DataType.float64()),
+    )
+    agg = two_stage_agg(
+        ProjectExec(keep, [col("l_extendedprice")]), [],
+        [AggFunction("sum", col("l_extendedprice"), "s")],
+        n_parts,
+    )
+    yearly = (col("s").cast(DataType.float64()) / lit(7.0)).alias("avg_yearly")
+    return ProjectExec(agg, [yearly])
+
+
+def q18(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    per_order = two_stage_agg(
+        ProjectExec(t["lineitem"], [col("l_orderkey"), col("l_quantity")]),
+        [GroupingExpr(col("l_orderkey"), "qk")],
+        [AggFunction("sum", col("l_quantity"), "qsum")],
+        n_parts,
+    )
+    big = FilterExec(per_order, col("qsum") > lit(300, DataType.decimal(22, 2)))
+    big_keys = ProjectExec(big, [col("qk"), col("qsum")])
+    orders_p = ProjectExec(
+        t["orders"], [col("o_orderkey"), col("o_custkey"), col("o_orderdate"), col("o_totalprice")]
+    )
+    j = shuffle_join(
+        big_keys, orders_p, [col("qk")], [col("o_orderkey")], JoinType.INNER, n_parts
+    )
+    cust_p = ProjectExec(t["customer"], [col("c_custkey"), col("c_name")])
+    full = shuffle_join(cust_p, j, [col("c_custkey")], [col("o_custkey")], JoinType.INNER, n_parts)
+    proj = ProjectExec(
+        full,
+        [col("c_name"), col("c_custkey"), col("o_orderkey"), col("o_orderdate"),
+         col("o_totalprice"), col("qsum")],
+    )
+    return single_sorted(
+        proj,
+        [SortField(col("o_totalprice"), ascending=False), SortField(col("o_orderdate"))],
+        fetch=100,
+    )
+
+
+def q20(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    part_f = FilterExec(t["part"], Like(col("p_name"), "forest%"))
+    part_p = ProjectExec(part_f, [col("p_partkey")])
+    line = FilterExec(
+        t["lineitem"],
+        (col("l_shipdate") >= lit(D(1994, 1, 1))) & (col("l_shipdate") < lit(D(1995, 1, 1))),
+    )
+    line_p = ProjectExec(line, [col("l_partkey"), col("l_suppkey"), col("l_quantity")])
+    usage = two_stage_agg(
+        line_p,
+        [GroupingExpr(col("l_partkey"), "uk_part"), GroupingExpr(col("l_suppkey"), "uk_supp")],
+        [AggFunction("sum", col("l_quantity"), "used")],
+        n_parts,
+    )
+    ps_p = ProjectExec(t["partsupp"], [col("ps_partkey"), col("ps_suppkey"), col("ps_availqty")])
+    ps_forest = broadcast_join(
+        part_p, ps_p, [col("p_partkey")], [col("ps_partkey")], JoinType.INNER, build_is_left=True
+    )
+    jo = shuffle_join(
+        ProjectExec(ps_forest, [col("ps_partkey"), col("ps_suppkey"), col("ps_availqty")]),
+        usage,
+        [col("ps_partkey"), col("ps_suppkey")], [col("uk_part"), col("uk_supp")],
+        JoinType.INNER, n_parts,
+    )
+    qualified = FilterExec(
+        jo,
+        col("ps_availqty").cast(DataType.float64())
+        > lit(0.5) * col("used").cast(DataType.float64()),
+    )
+    supp_keys = distinct_rows(ProjectExec(qualified, [col("ps_suppkey")]), ["ps_suppkey"], n_parts)
+    supp_p = ProjectExec(t["supplier"], [col("s_suppkey"), col("s_name"), col("s_address"), col("s_nationkey")])
+    js = broadcast_join(
+        supp_keys, supp_p, [col("ps_suppkey")], [col("s_suppkey")], JoinType.INNER,
+        build_is_left=True,
+    )
+    nat = FilterExec(t["nation"], col("n_name") == lit("CANADA"))
+    full = broadcast_join(
+        ProjectExec(nat, [col("n_nationkey")]), js,
+        [col("n_nationkey")], [col("s_nationkey")], JoinType.INNER, build_is_left=True,
+    )
+    proj = ProjectExec(full, [col("s_name"), col("s_address")])
+    return single_sorted(proj, [SortField(col("s_name"))])
+
+
+def q21(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """EXISTS/NOT-EXISTS with <> rewritten through per-order distinct
+    supplier counts (equivalent because l1 itself is a late line)."""
+    line_all = ProjectExec(t["lineitem"], [col("l_orderkey"), col("l_suppkey")])
+    n_supp = two_stage_agg(
+        distinct_rows(line_all, ["l_orderkey", "l_suppkey"], n_parts),
+        [GroupingExpr(col("l_orderkey"), "ok_all")],
+        [AggFunction("count_star", None, "n_supp")],
+        n_parts,
+    )
+    late = FilterExec(t["lineitem"], col("l_receiptdate") > col("l_commitdate"))
+    late_p = ProjectExec(late, [col("l_orderkey"), col("l_suppkey")])
+    n_late = two_stage_agg(
+        distinct_rows(late_p, ["l_orderkey", "l_suppkey"], n_parts),
+        [GroupingExpr(col("l_orderkey"), "ok_late")],
+        [AggFunction("count_star", None, "n_late")],
+        n_parts,
+    )
+    saudi_supp = broadcast_join(
+        ProjectExec(FilterExec(t["nation"], col("n_name") == lit("SAUDI ARABIA")), [col("n_nationkey")]),
+        t["supplier"],
+        [col("n_nationkey")], [col("s_nationkey")], JoinType.INNER, build_is_left=True,
+    )
+    saudi_p = ProjectExec(saudi_supp, [col("s_suppkey"), col("s_name")])
+    l1 = broadcast_join(
+        saudi_p,
+        ProjectExec(late, [col("l_orderkey"), col("l_suppkey")]),
+        [col("s_suppkey")], [col("l_suppkey")], JoinType.INNER, build_is_left=True,
+    )
+    orders_f = FilterExec(t["orders"], col("o_orderstatus") == lit("F"))
+    lo = shuffle_join(
+        ProjectExec(l1, [col("l_orderkey"), col("s_name")]),
+        ProjectExec(orders_f, [col("o_orderkey")]),
+        [col("l_orderkey")], [col("o_orderkey")], JoinType.INNER, n_parts,
+    )
+    with_nsupp = shuffle_join(
+        lo, n_supp, [col("l_orderkey")], [col("ok_all")], JoinType.INNER, n_parts
+    )
+    with_nlate = shuffle_join(
+        with_nsupp, n_late, [col("l_orderkey")], [col("ok_late")], JoinType.INNER, n_parts
+    )
+    keep = FilterExec(with_nlate, (col("n_supp") > lit(1)) & (col("n_late") == lit(1)))
+    agg = two_stage_agg(
+        ProjectExec(keep, [col("s_name")]),
+        [GroupingExpr(col("s_name"), "s_name")],
+        [AggFunction("count_star", None, "numwait")],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("numwait"), ascending=False), SortField(col("s_name"))],
+        fetch=100,
+    )
+
+
+def q22(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    cc = func("substring", col("c_phone"), lit(1), lit(2))
+    in_codes = cc.isin("13", "31", "23", "29", "30", "18", "17")
+    cust = FilterExec(t["customer"], in_codes)
+    cust_p = ProjectExec(
+        cust, [col("c_custkey"), col("c_acctbal"), cc.alias("cntrycode")]
+    )
+    pos = FilterExec(cust_p, col("c_acctbal") > lit(0, DataType.decimal(12, 2)))
+    avg_plan = two_stage_agg(
+        ProjectExec(pos, [col("c_acctbal")]), [],
+        [AggFunction("avg", col("c_acctbal"), "ab")],
+        n_parts,
+    )
+    avg_bal = scalar_subquery(avg_plan, "ab")
+    rich = FilterExec(
+        cust_p,
+        col("c_acctbal").cast(DataType.float64()) > avg_bal.cast(DataType.float64()),
+    )
+    orders_keys = ProjectExec(t["orders"], [col("o_custkey")])
+    rex = NativeShuffleExchangeExec(rich, HashPartitioning([col("c_custkey")], n_parts))
+    oex = NativeShuffleExchangeExec(orders_keys, HashPartitioning([col("o_custkey")], n_parts))
+    from ..ops.joins import HashJoinExec
+
+    no_orders = HashJoinExec(
+        oex, rex, [col("o_custkey")], [col("c_custkey")], JoinType.LEFT_ANTI, build_is_left=False
+    )
+    agg = two_stage_agg(
+        no_orders,
+        [GroupingExpr(col("cntrycode"), "cntrycode")],
+        [AggFunction("count_star", None, "numcust"),
+         AggFunction("sum", col("c_acctbal"), "totacctbal")],
+        n_parts,
+    )
+    return single_sorted(agg, [SortField(col("cntrycode"))])
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q7": q7,
-    "q9": q9, "q10": q10, "q11": q11, "q12": q12, "q13": q13, "q14": q14,
-    "q19": q19,
+    "q8": q8, "q9": q9, "q10": q10, "q11": q11, "q12": q12, "q13": q13,
+    "q14": q14, "q15": q15, "q16": q16, "q17": q17, "q18": q18, "q19": q19,
+    "q20": q20, "q21": q21, "q22": q22,
 }
 
 

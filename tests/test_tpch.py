@@ -172,3 +172,71 @@ def test_q13(data, scans):
     exp = O.oracle_q13(data)
     rows = dict(zip(got["c_count"], got["custdist"]))
     assert rows == exp
+
+
+def test_q8(data, scans):
+    got = run(build_query("q8", scans, N_PARTS))
+    exp = O.oracle_q8(data)
+    assert got["o_year"] == sorted(exp.keys())
+    for y, share in zip(got["o_year"], got["mkt_share"]):
+        assert abs(share - exp[y]) < 1e-9
+
+
+def test_q15(data, scans):
+    got = run(build_query("q15", scans, N_PARTS))
+    exp = O.oracle_q15(data)
+    rows = list(zip(got["s_suppkey"], got["s_name"], got["total_revenue"]))
+    assert rows == exp
+
+
+def test_q16(data, scans):
+    got = run(build_query("q16", scans, N_PARTS))
+    exp = O.oracle_q16(data)
+    rows = {
+        (b, t, s): c
+        for b, t, s, c in zip(got["p_brand"], got["p_type"], got["p_size"], got["supplier_cnt"])
+    }
+    assert rows == exp
+    assert got["supplier_cnt"] == sorted(got["supplier_cnt"], reverse=True)
+
+
+def test_q17(data, scans):
+    got = run(build_query("q17", scans, N_PARTS))
+    exp = O.oracle_q17(data)
+    v = got["avg_yearly"][0]
+    if exp == 0:
+        assert v is None or v == 0
+    else:
+        assert abs(v - exp) / max(abs(exp), 1e-9) < 1e-9
+
+
+def test_q18(data, scans):
+    got = run(build_query("q18", scans, N_PARTS))
+    exp = O.oracle_q18(data)
+    rows = list(zip(got["c_name"], got["c_custkey"], got["o_orderkey"], got["o_orderdate"], got["o_totalprice"], got["qsum"]))
+    assert len(rows) == len(exp)
+    assert set(r[2] for r in rows) == set(e[2] for e in exp)
+    assert [r[4] for r in rows] == sorted([r[4] for r in rows], reverse=True)
+
+
+def test_q20(data, scans):
+    got = run(build_query("q20", scans, N_PARTS))
+    exp = O.oracle_q20(data)
+    rows = list(zip(got["s_name"], got["s_address"]))
+    assert rows == exp
+
+
+def test_q21(data, scans):
+    got = run(build_query("q21", scans, N_PARTS))
+    exp = O.oracle_q21(data)
+    rows = dict(zip(got["s_name"], got["numwait"]))
+    assert rows == exp
+
+
+def test_q22(data, scans):
+    got = run(build_query("q22", scans, N_PARTS))
+    exp = O.oracle_q22(data)
+    assert got["cntrycode"] == sorted(exp.keys())
+    for i, c in enumerate(got["cntrycode"]):
+        assert got["numcust"][i] == exp[c][0]
+        assert got["totacctbal"][i] == exp[c][1]
