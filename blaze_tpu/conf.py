@@ -57,6 +57,9 @@ SPILL_COMPRESSION_CODEC = ConfEntry("spark.blaze.spill.compression.codec", "zlib
 IO_COMPRESSION_CODEC = ConfEntry("spark.io.compression.codec", "zlib", str)
 IGNORE_CORRUPT_FILES = ConfEntry("spark.files.ignoreCorruptFiles", False, _bool)
 PARQUET_FILTER_PUSHDOWN = ConfEntry("spark.blaze.parquet.enable.pageFiltering", True, _bool)
+# TPU-only: hand-written pallas kernels for hot loops (kernels/); the
+# pure-XLA path is always kept as fallback
+PALLAS_ENABLE = ConfEntry("spark.blaze.tpu.pallas.enable", True, _bool)
 INPUT_BATCH_STATISTICS = ConfEntry("spark.blaze.inputBatchStatistics", False, _bool)
 UDF_WRAPPER_NUM_THREADS = ConfEntry("spark.blaze.udfWrapperNumThreads", 1, int)
 SMJ_FALLBACK_ENABLE = ConfEntry("spark.blaze.smjfallback.enable", True, _bool)

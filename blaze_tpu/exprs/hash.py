@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -218,13 +219,88 @@ def xxhash64_bytes(data, lengths, seed):
 _SEED = 42
 
 
+def _f64_bits(x):
+    """IEEE754 bit pattern of float64 as int64, computed with exact
+    power-of-two arithmetic only.
+
+    TPU's x64-rewrite pass has no lowering for f64<->i64
+    bitcast-convert (nor frexp/signbit, which use it), so ``.view``
+    cannot run on device; this is the TPU fallback (CPU keeps the
+    exact bitcast).  Every step is exact power-of-two scaling,
+    compares, and f64->s64 converts of integers < 2^53.
+
+    Caveats (double keys for partitioning/joins are rare in SQL):
+    - XLA flushes subnormals (DAZ/FTZ), so subnormal inputs hash as
+      zero here.
+    - TPU emulates f64 as a float32 pair (~49-bit mantissa, f32
+      exponent range), so values outside ~2^+-127 or differing only
+      in the lowest mantissa bits already collapsed when staged to
+      HBM.  Hashes are self-consistent on-device but not guaranteed
+      Spark-bit-exact for such extremes — f64-keyed exchanges that
+      must interoperate with JVM stages should run the CPU path.
+    - Callers normalize -0.0 to +0.0 first (Spark does before
+      hashing).  NaNs map to canonical quiet-NaN bits (Java
+      Double.doubleToLongBits); non-canonical NaN payloads are not
+      preserved.
+    """
+    ax = jnp.abs(x)
+    neg = x < 0
+
+    # e = floor(log2(ax)) by binary search with exact 2^k factors.
+    # ax >= 1: ascending search, e in [0, 1023].
+    up_e = jnp.zeros(x.shape, jnp.int64)
+    up_p = jnp.ones(x.shape, jnp.float64)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        c = np.float64(2.0) ** k
+        cond = ax >= up_p * c  # overflow to inf -> False, self-guarding
+        up_p = jnp.where(cond, up_p * c, up_p)
+        up_e = up_e + jnp.where(cond, k, 0)
+    # ax < 1: find max s with 2^-s > ax, then e = -(s+1), down to -1074.
+    dn_s = jnp.zeros(x.shape, jnp.int64)
+    dn_q = jnp.ones(x.shape, jnp.float64)
+    for k in (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        cand = dn_q * (np.float64(2.0) ** -k)
+        cond = cand > ax  # underflow to 0 -> False, self-guarding
+        dn_q = jnp.where(cond, cand, dn_q)
+        dn_s = dn_s + jnp.where(cond, k, 0)
+    small = ax < 1.0
+    e = jnp.where(small, -(dn_s + 1), up_e)
+    p = jnp.where(small, dn_q * np.float64(0.5), up_p)  # p = 2^e, exact
+
+    normal = e >= -1022
+    # normal: 53-bit significand = ax/2^e * 2^52, an exact integer
+    m53 = (ax / jnp.where(normal, p, jnp.ones((), jnp.float64)) * np.float64(2.0**52)).astype(jnp.int64)
+    # denormal: mantissa = ax * 2^1074 (exact, two in-range steps)
+    mant_dn = (ax * np.float64(2.0**537) * np.float64(2.0**537)).astype(jnp.int64)
+    mant = jnp.where(normal, m53 - jnp.int64(2**52), mant_dn)
+    exp_field = jnp.where(normal, e + jnp.int64(1023), jnp.int64(0))
+
+    bits = (exp_field << jnp.int64(52)) | mant
+    bits = jnp.where(neg, bits | jnp.int64(-(2**63)), bits)
+    bits = jnp.where(ax == 0, jnp.int64(0), bits)  # -0.0 pre-normalized
+    inf_bits = jnp.where(neg, jnp.int64(-(2**63)) | jnp.int64(0x7FF0 << 48), jnp.int64(0x7FF0 << 48))
+    bits = jnp.where(ax == jnp.inf, inf_bits, bits)
+    bits = jnp.where(x != x, jnp.int64(0x7FF8 << 48), bits)
+    return bits
+
+
+def f64_raw_bits(d):
+    """float64 -> int64 bit pattern for any backend: a plain bitcast
+    off-TPU, the arithmetic decomposition (see _f64_bits caveats) on
+    TPU.  Shared by hashing, sort key encoding and agg group-key
+    packing — every site that needs double bits on device."""
+    if jax.default_backend() == "tpu":
+        return _f64_bits(d)
+    return d.view(jnp.int64)
+
+
 def _normalize_float(col: Column):
     # Spark normalizes -0.0 before hashing
     d = col.data
     d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
     if d.dtype == jnp.float32:
         return d.view(jnp.int32), TypeKind.INT32
-    return d.view(jnp.int64), TypeKind.INT64
+    return f64_raw_bits(d), TypeKind.INT64
 
 
 def _hash_one_murmur(col: Column, h):

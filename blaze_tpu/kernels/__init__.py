@@ -1,0 +1,37 @@
+"""Pallas TPU kernels for the engine's hot loops.
+
+The XLA operator kernels (ops/, exprs/) are the portable path; this
+package holds hand-written Pallas kernels for the few loops where
+hand-scheduling beats the XLA default on TPU:
+
+- murmur3_pids — shuffle partition-id computation (murmur3 seed-42 +
+  pmod) fused over key columns, one HBM pass, no intermediate hash
+  array (≙ reference shuffle/mod.rs evaluate_hashes/
+  evaluate_partition_ids).  Wired into ShuffleWriterExec as the TPU
+  fast path for fixed-width keys.
+- pid_histogram — per-partition row counts; XLA lowers the equivalent
+  scatter as sort+segsum, the kernel accumulates one-hot counts in
+  VMEM instead.  Building block for repartitioner layouts.
+- fused_group_sums — small-cardinality grouped aggregation (one-hot ×
+  values, the TPC-H q01 shape): predicate mask, projection and
+  segment-sum in a single pass (≙ agg_table.rs update path).
+  float32 accumulation; the exact int64 (decimal) variant that AggExec
+  can adopt wholesale is the planned follow-up.
+
+Everything degrades gracefully: `available()` is False off-TPU unless
+interpret mode is forced, and callers keep their pure-XLA fallback.
+"""
+
+from .pallas_ops import (
+    available,
+    fused_group_sums,
+    murmur3_pids,
+    pid_histogram,
+)
+
+__all__ = [
+    "available",
+    "fused_group_sums",
+    "murmur3_pids",
+    "pid_histogram",
+]

@@ -139,9 +139,11 @@ def encode_key_words(cols: Sequence[Column]) -> List[jnp.ndarray]:
                     word = word | (b[:, k, j] << jnp.uint64(8 * (7 - j)))
                 words.append(jnp.where(c.validity, word, jnp.uint64(0)))
         elif c.dtype.is_float:
+            from ..exprs.hash import f64_raw_bits
+
             d = jnp.where(c.data == 0, jnp.zeros((), c.data.dtype), c.data)  # -0.0 -> 0.0
             d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, c.data.dtype), d)  # canonical NaN
-            bits = d.view(jnp.int32) if c.data.dtype == jnp.float32 else d.view(jnp.int64)
+            bits = d.view(jnp.int32) if c.data.dtype == jnp.float32 else f64_raw_bits(d)
             words.append(jnp.where(c.validity, bits.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0)))
         else:
             words.append(

@@ -58,10 +58,12 @@ def order_words(c: Column, ascending: bool, nulls_first: bool) -> List[jnp.ndarr
                 word = word | (b[:, k, j] << jnp.uint64(8 * (7 - j)))
             vals.append(word)
     elif c.dtype.is_float:
+        from ..exprs.hash import f64_raw_bits
+
         bits = (
             c.data.view(jnp.int32).astype(jnp.int64)
             if c.data.dtype == jnp.float32
-            else c.data.view(jnp.int64)
+            else f64_raw_bits(c.data)  # TPU has no f64 bitcast lowering
         )
         u = bits.view(jnp.uint64)
         flipped = jnp.where(

@@ -214,7 +214,48 @@ class ShuffleWriterExec(ExecNode):
                 key_cols = [lower(e, schema, env, cap) for e in exprs]
                 return pmod(murmur3_columns(key_cols), n_out)
 
-            self._hash_pids = hash_pids
+            self._hash_pids_xla = hash_pids
+
+            @jax.jit
+            def hash_pids_pallas(cols, num_rows):
+                # whole pipeline (expr lowering, word-plane split, fused
+                # kernel) traced once per shape bucket, like the XLA path
+                from ..kernels import pallas_ops
+
+                cap = cols[0].data.shape[0]
+                env = {f.name: c for f, c in zip(schema.fields, cols)}
+                planes, widths, valids = [], [], []
+                for e in exprs:
+                    c = lower(e, schema, env, cap)
+                    p, w = pallas_ops.column_word_planes(c)
+                    planes += p
+                    widths.append(w)
+                    valids.append(c.validity)
+                return pallas_ops.murmur3_pids(planes, widths, valids, n_out)
+
+            self._hash_pids_pallas = hash_pids_pallas
+            # pallas fast path decided on the first batch (key dtypes
+            # are static); falls back to XLA for string/unsupported keys
+            self._pallas_pids = conf.PALLAS_ENABLE.get()
+
+    def _hash_pids(self, cols, num_rows):
+        if self._pallas_pids:
+            try:
+                from ..kernels import pallas_ops
+
+                if pallas_ops.available():
+                    return self._hash_pids_pallas(cols, num_rows)
+                self._pallas_pids = False
+            except NotImplementedError:
+                self._pallas_pids = False  # e.g. string keys: expected, quiet
+            except Exception as e:  # import/lowering failures: warn once
+                self._pallas_pids = False
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pallas pid path failed (%s); using XLA path", e
+                )
+        return self._hash_pids_xla(cols, num_rows)
 
     @property
     def schema(self) -> Schema:

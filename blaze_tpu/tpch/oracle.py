@@ -520,7 +520,6 @@ def oracle_q17(tables: Dict[str, HostTable]):
         # engine avg: exact int path or float; avg dec(16,6): shift 4
         s = sum(qs)
         n = len(qs)
-        avg_unscaled = s * 10**4 // n if (s * 10**4) % n * 2 < n else -(-s * 10**4 // n)
         # replicate HALF_UP: use same float path as engine (dec(22,2)+4>18)
         f = float(s) * 1e4 / n
         avg_unscaled = int(np.where(f >= 0, np.floor(f + 0.5), np.ceil(f - 0.5)))

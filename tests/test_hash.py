@@ -108,3 +108,31 @@ def test_long_string_stripes():
     assert h[0] != h[1]
     m = np.asarray(murmur3_columns([col]))[:2]
     assert m[0] != m[1]
+
+
+def test_f64_bits_arithmetic_equals_view():
+    """_f64_bits (the bitcast-free path TPU requires) must reproduce
+    numpy's raw bit view for every f64 class except non-canonical NaN."""
+    import jax.numpy as jnp
+    from blaze_tpu.exprs.hash import _f64_bits
+
+    rng = np.random.default_rng(11)
+    vals = np.concatenate([
+        np.array([0.0, 1.0, -1.0, 2.0, 0.5, 1.5, np.pi, -np.pi, 1e300, -1e300,
+                  1e-300, 2.2250738585072014e-308,          # min normal
+                  1.7976931348623157e308,                    # max finite
+                  np.inf, -np.inf]),
+        (rng.random(500) * 2 - 1) * 1.7e308,
+        rng.random(500) * 2e-300 + 1e-305,
+        2.0 ** rng.integers(-1022, 1023, 500) * (1 + rng.random(500)),
+        np.nextafter(2.0 ** rng.integers(-1000, 1000, 200).astype(np.float64), np.inf),
+        np.nextafter(2.0 ** rng.integers(-1000, 1000, 200).astype(np.float64), -np.inf),
+    ])
+    got = np.asarray(_f64_bits(jnp.asarray(vals)))
+    want = vals.view(np.int64)
+    np.testing.assert_array_equal(got, want)
+    # canonical NaN
+    assert int(np.asarray(_f64_bits(jnp.asarray(np.array([np.nan]))))[0]) == 0x7FF8 << 48
+    # subnormals: XLA flushes denormals (DAZ/FTZ) — they hash as zero
+    sub = np.asarray(_f64_bits(jnp.asarray(np.array([5e-324, -5e-324]))))
+    assert set(sub.tolist()) <= {0, 1, -(2**63), -(2**63) | 1}
