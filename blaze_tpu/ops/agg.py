@@ -31,6 +31,7 @@ Spill and merged back chunk-wise at finish (associative re-reduce).
 from __future__ import annotations
 
 import enum
+import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -42,8 +43,9 @@ from .. import conf
 from ..batch import Column, RecordBatch, bucket_capacity, concat_batches
 from ..exprs.compile import infer_dtype, lower
 from ..exprs.ir import Expr
+from ..io.batch_serde import deserialize_batch, serialize_batch
 from ..runtime.context import TaskContext
-from ..runtime.memmgr import MemConsumer, MemManager
+from ..runtime.memmgr import MemConsumer, MemManager, Spill, try_new_spill
 from ..schema import (
     DataType,
     Field,
@@ -484,7 +486,6 @@ class AggExec(ExecNode):
 
         def stream():
             merger = _StateMerger.for_agg(self)
-            acc: Optional[RecordBatch] = None
             pending: List[RecordBatch] = []
             pending_rows = 0
             consumer = _AggConsumer(self, ctx)
@@ -498,6 +499,11 @@ class AggExec(ExecNode):
                     with self.metrics.timer("elapsed_compute"):
                         part = self._reduce_batch(batch, in_schema)
                     in_rows += batch.num_rows
+                    # the consumer OWNS the accumulator: a spill() from
+                    # the memory manager atomically moves it out, and a
+                    # take_state() here starts a fresh accumulation
+                    # (re-merging a spilled state would double-count it)
+                    acc_rows_hint = consumer.state_rows
                     if (
                         self.mode == AggMode.PARTIAL
                         and self.supports_partial_skipping
@@ -506,7 +512,7 @@ class AggExec(ExecNode):
                         and bool(conf.ENABLE_PARTIAL_AGG_SKIPPING.get())
                         and in_rows >= int(conf.PARTIAL_AGG_SKIPPING_MIN_ROWS.get())
                     ):
-                        acc_rows = (acc.num_rows if acc else 0) + pending_rows + part.num_rows
+                        acc_rows = acc_rows_hint + pending_rows + part.num_rows
                         if acc_rows / max(1, in_rows) > float(conf.PARTIAL_AGG_SKIPPING_RATIO.get()):
                             skipping = True
                             self.metrics.add("partial_skipped", 1)
@@ -517,14 +523,16 @@ class AggExec(ExecNode):
                         continue
                     pending.append(part)
                     pending_rows += part.num_rows
-                    if acc is None or pending_rows >= max(acc.num_rows, 4096):
+                    if acc_rows_hint == 0 or pending_rows >= max(acc_rows_hint, 4096):
+                        acc = consumer.take_state()
                         group = ([acc] if acc else []) + pending
                         with self.metrics.timer("elapsed_compute"):
                             acc = self._merge_states(group) if len(group) > 1 else group[0]
                         pending, pending_rows = [], 0
                         consumer.set_state(acc)
                 # finish: merge residue + spills
-                tail = ([acc] if acc else []) + pending
+                final_acc = consumer.take_state()
+                tail = ([final_acc] if final_acc else []) + pending
                 tail += consumer.drain_spills()
                 final_state = self._merge_states(tail) if tail else None
                 if final_state is not None and final_state.num_rows > 0:
@@ -601,8 +609,10 @@ def _col(name):
 
 
 class _AggConsumer(MemConsumer):
-    """Tracks the accumulator size; on pressure, stages it to a Spill
-    (≙ agg spill path agg_table.rs:343-375, simplified: whole-state
+    """OWNS the in-flight accumulator state; on pressure, serializes it
+    to a Spill (host-RAM or disk tier) and clears it, so the exec
+    restarts accumulation — never re-merging a spilled state
+    (≙ agg spill path agg_table.rs:343-375, flattened: whole-state
     chunks re-reduced at finish)."""
 
     name = "agg"
@@ -611,23 +621,52 @@ class _AggConsumer(MemConsumer):
         super().__init__()
         self._agg = agg
         self._state: Optional[RecordBatch] = None
-        self._spills: List[RecordBatch] = []
+        self._spills: List[Spill] = []
+        self._lock = threading.Lock()
+
+    @property
+    def state_rows(self) -> int:
+        s = self._state
+        return s.num_rows if s is not None else 0
+
+    def take_state(self) -> Optional[RecordBatch]:
+        """Atomically claim the accumulator for merging.  A concurrent
+        spill() (MemManager serving another thread's pressure) either
+        runs before (state already spilled, returns None here) or after
+        set_state() — never both paths on the same state, which would
+        double-count it."""
+        with self._lock:
+            s, self._state = self._state, None
+            return s
 
     def set_state(self, state: RecordBatch) -> None:
-        self._state = state
+        with self._lock:
+            self._state = state
         self.update_mem_used(state.memory_size())
 
     def spill(self) -> int:
-        if self._state is None:
+        with self._lock:
+            state, self._state = self._state, None
+        if state is None:
             return 0
-        freed = self._state.memory_size()
-        # stage to host RAM (serialization-to-Spill arrives with the io
-        # layer; host numpy already frees device HBM)
-        self._spills.append(self._state.to_host())
-        self._state = None
+        freed = state.memory_size()
+        sp = try_new_spill()
+        sp.write_frame(serialize_batch(state))
+        sp.complete()
+        self._spills.append(sp)
+        self._agg.metrics.add("spill_count", 1)
+        self._agg.metrics.add("spilled_bytes", sp.size)
         self.update_mem_used(0)
         return freed
 
     def drain_spills(self) -> List[RecordBatch]:
-        out, self._spills = self._spills, []
-        return [b.to_device() for b in out]
+        out: List[RecordBatch] = []
+        for sp in self._spills:
+            while True:
+                payload = sp.read_frame()
+                if payload is None:
+                    break
+                out.append(deserialize_batch(payload, self._agg._state_schema).to_device())
+            sp.release()
+        self._spills = []
+        return out

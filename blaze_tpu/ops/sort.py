@@ -6,28 +6,38 @@ into **order-preserving uint64 words** (sign-flip ints, IEEE trick for
 floats, big-endian packed strings, per-key null-rank word honoring
 asc/desc × nulls first/last), and ``lax.sort`` does a lexicographic
 multi-operand sort on device.  Buffered input stays on host (staging
-RAM, tracked by the memory manager); the final sort runs on device over
-the concatenated buffer.  fetch=k (TakeOrdered) prunes each buffered
-batch to its top-k before staging, bounding memory at k rows.
+RAM, tracked by the memory manager); the in-budget case is one device
+sort over the concatenated buffer.
 
-Multi-level spill merge with a loser tree arrives with the native IO
-layer (roadmap; the associative device sort already handles the
-in-budget case end to end).
+Out-of-core path (≙ sort_exec.rs spills + LoserTree merge): when the
+memory manager calls ``spill()``, the buffered batches are sorted on
+device into a run, and the run's batches are written to a Spill frame
+by frame **together with their already-encoded key words** — the merge
+then never re-stages spilled data to the device.  Output is a k-way
+streaming merge (heap over (key words, run index); ties break toward
+the earlier run, keeping the sort stable).  fetch=k (TakeOrdered)
+prunes batches and runs to k rows, bounding memory at k rows per run.
 """
 
 from __future__ import annotations
 
+import heapq
+import struct
+import threading
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ..batch import Column, RecordBatch, concat_batches
-from ..exprs.compile import infer_dtype, lower
+from .. import conf
+from ..batch import Column, RecordBatch, _pad_1d, bucket_capacity, concat_batches
+from ..exprs.compile import lower
 from ..exprs.ir import Expr
+from ..io.batch_serde import deserialize_batch, serialize_batch
 from ..runtime.context import TaskContext
-from ..runtime.memmgr import MemConsumer
+from ..runtime.memmgr import MemConsumer, Spill, try_new_spill
 from ..schema import Schema
 from .base import BatchStream, ExecNode
 
@@ -98,6 +108,75 @@ def sort_indices(
     return out[-1]
 
 
+def _slice_host_batch(b: RecordBatch, start: int, n: int) -> RecordBatch:
+    """Host-side row slice [start, start+n) of a host batch."""
+    cap = bucket_capacity(n)
+    cols = []
+    for c in b.columns:
+        data = _pad_1d(np.asarray(c.data)[start : start + n], cap)
+        val = _pad_1d(np.asarray(c.validity)[start : start + n], cap)
+        ln = None if c.lengths is None else _pad_1d(np.asarray(c.lengths)[start : start + n], cap)
+        cols.append(Column(c.dtype, data, val, ln))
+    return RecordBatch(b.schema, cols, n)
+
+
+# One spilled chunk: [u32 batch_nbytes][batch][u32 n][u32 W][words n*W u64]
+def _encode_chunk(batch: RecordBatch, words: np.ndarray) -> bytes:
+    bb = serialize_batch(batch)
+    n, w = words.shape
+    return struct.pack("<I", len(bb)) + bb + struct.pack("<II", n, w) + words.tobytes()
+
+
+def _decode_chunk(payload: bytes, schema: Schema) -> Tuple[RecordBatch, np.ndarray]:
+    (bn,) = struct.unpack_from("<I", payload, 0)
+    batch = deserialize_batch(payload[4 : 4 + bn], schema)
+    n, w = struct.unpack_from("<II", payload, 4 + bn)
+    words = np.frombuffer(payload, np.uint64, n * w, 4 + bn + 8).reshape(n, w)
+    return batch, words
+
+
+class _SortState(MemConsumer):
+    """Buffered input batches + spilled sorted runs; the memory manager
+    triggers ``spill()`` under pressure (≙ sort_exec.rs:173 LevelSpill,
+    flattened to one level — runs merge in a single k-way pass)."""
+
+    name = "sort"
+
+    def __init__(self, exec_: "SortExec"):
+        super().__init__()
+        self.exec = exec_
+        self.buffered: List[RecordBatch] = []
+        self.spills: List[Spill] = []
+        self._lock = threading.Lock()
+        self._frozen = False
+
+    def add(self, batch: RecordBatch) -> None:
+        with self._lock:
+            self.buffered.append(batch)
+            total = sum(b.memory_size() for b in self.buffered)
+        self.update_mem_used(total)
+
+    def freeze(self) -> Tuple[List[RecordBatch], List[Spill]]:
+        """Snapshot state for the output merge and stop accepting
+        spills — a spill landing after the merge sources are built
+        would create a run the merge never reads."""
+        with self._lock:
+            self._frozen = True
+            return list(self.buffered), list(self.spills)
+
+    def spill(self) -> int:
+        with self._lock:
+            if self._frozen or not self.buffered:
+                return 0
+            batches, self.buffered = self.buffered, []
+        freed = sum(b.memory_size() for b in batches)
+        sp = self.exec._write_run(batches)
+        with self._lock:
+            self.spills.append(sp)
+        self.update_mem_used(0)
+        return freed
+
+
 class SortExec(ExecNode):
     def __init__(self, child: ExecNode, fields: Sequence[SortField], fetch: Optional[int] = None):
         super().__init__([child])
@@ -114,7 +193,18 @@ class SortExec(ExecNode):
             idx = sort_indices(key_cols, fields_, num_rows)
             return tuple(c.take(idx) for c in cols)
 
+        @jax.jit
+        def key_words(cols: Tuple[Column, ...], num_rows):
+            env = {f.name: c for f, c in zip(in_schema.fields, cols)}
+            cap = cols[0].data.shape[0]
+            key_cols = [lower(f.expr, in_schema, env, cap) for f in fields_]
+            words: List[jnp.ndarray] = []
+            for c, f in zip(key_cols, fields_):
+                words.extend(order_words(c, f.ascending, f.nulls_first))
+            return jnp.stack(words, axis=1)  # (cap, W)
+
         self._kernel = kernel
+        self._key_words = key_words
 
     @property
     def schema(self) -> Schema:
@@ -129,41 +219,180 @@ class SortExec(ExecNode):
         n = batch.num_rows if limit is None else min(batch.num_rows, limit)
         return RecordBatch(batch.schema, list(cols), n)
 
+    # ------------------------------------------------------ run spilling
+
+    def _write_run(self, batches: List[RecordBatch]) -> Spill:
+        """Sort the given batches into one run and spill it with its
+        key words."""
+        with self.metrics.timer("sort_time"):
+            merged = concat_batches(batches)
+            run = self._sorted_batch(merged.to_device(), self.fetch)
+            words_all = np.asarray(self._key_words(tuple(run.columns), run.num_rows))
+        host = run.to_host()
+        sp = try_new_spill()
+        bs = int(conf.BATCH_SIZE.get())
+        for start in range(0, run.num_rows, bs):
+            n = min(bs, run.num_rows - start)
+            chunk = _slice_host_batch(host, start, n)
+            sp.write_frame(_encode_chunk(chunk, words_all[start : start + n]))
+        sp.complete()
+        self.metrics.add("spill_count", 1)
+        self.metrics.add("spilled_bytes", sp.size)
+        return sp
+
+    def _mem_run_chunks(
+        self, batches: List[RecordBatch]
+    ) -> Iterator[Tuple[RecordBatch, np.ndarray]]:
+        merged = concat_batches(batches)
+        run = self._sorted_batch(merged.to_device(), self.fetch)
+        words_all = np.asarray(self._key_words(tuple(run.columns), run.num_rows))
+        host = run.to_host()
+        bs = int(conf.BATCH_SIZE.get())
+        for start in range(0, run.num_rows, bs):
+            n = min(bs, run.num_rows - start)
+            yield _slice_host_batch(host, start, n), words_all[start : start + n]
+
+    @staticmethod
+    def _spill_chunks(sp: Spill, schema: Schema) -> Iterator[Tuple[RecordBatch, np.ndarray]]:
+        while True:
+            payload = sp.read_frame()
+            if payload is None:
+                return
+            yield _decode_chunk(payload, schema)
+
+    # --------------------------------------------------------- k-way merge
+
+    def _merge(
+        self,
+        sources: List[Iterator[Tuple[RecordBatch, np.ndarray]]],
+        limit: Optional[int],
+        ctx: TaskContext,
+    ) -> BatchStream:
+        """Streaming merge: heap of (key-word tuple, source index);
+        stable because ties pop the earlier source first (runs are
+        created in input order)."""
+        cursors: List[Optional[Tuple[Iterator, RecordBatch, np.ndarray, int]]] = []
+        heap: List[Tuple[tuple, int]] = []
+
+        def advance(i: int, it, batch, words, pos) -> None:
+            if batch is not None and pos < batch.num_rows:
+                cursors[i] = (it, batch, words, pos)
+                heapq.heappush(heap, (tuple(words[pos]), i))
+                return
+            nxt = next(it, None)
+            if nxt is None:
+                cursors[i] = None
+                return
+            b, w = nxt
+            cursors[i] = (it, b, w, 0)
+            heapq.heappush(heap, (tuple(w[0]), i))
+
+        for i, src in enumerate(sources):
+            cursors.append(None)
+            advance(i, src, None, None, 0)
+
+        bs = int(conf.BATCH_SIZE.get())
+        picks: List[Tuple[RecordBatch, int]] = []
+        emitted = 0
+
+        def flush() -> RecordBatch:
+            nonlocal picks
+            out = self._materialize(picks)
+            picks = []
+            return out
+
+        while heap:
+            if not ctx.is_task_running():
+                return
+            _, i = heapq.heappop(heap)
+            it, batch, words, pos = cursors[i]
+            picks.append((batch, pos))
+            emitted += 1
+            advance(i, it, batch, words, pos + 1)
+            if limit is not None and emitted >= limit:
+                break
+            if len(picks) >= bs:
+                yield flush()
+        if picks:
+            yield flush()
+
+    def _materialize(self, picks: List[Tuple[RecordBatch, int]]) -> RecordBatch:
+        """Gather picked rows (in order) into one batch — vectorized
+        per source batch."""
+        n = len(picks)
+        cap = bucket_capacity(n)
+        by_src: Dict[int, Tuple[RecordBatch, List[int], List[int]]] = {}
+        for pos, (batch, row) in enumerate(picks):
+            entry = by_src.get(id(batch))
+            if entry is None:
+                entry = (batch, [], [])
+                by_src[id(batch)] = entry
+            entry[1].append(pos)
+            entry[2].append(row)
+
+        schema = self.schema
+        cols: List[Column] = []
+        for ci, f in enumerate(schema.fields):
+            if f.dtype.is_string:
+                width = max(
+                    np.asarray(b.columns[ci].data).shape[1] for b, _, _ in by_src.values()
+                )
+                data = np.zeros((cap, width), np.uint8)
+                lens = np.zeros(cap, np.int32)
+            else:
+                data = np.zeros(cap, f.dtype.np_dtype)
+                lens = None
+            val = np.zeros(cap, np.bool_)
+            for b, positions, rows in by_src.values():
+                src = b.columns[ci]
+                pos_a = np.asarray(positions)
+                row_a = np.asarray(rows)
+                d = np.asarray(src.data)[row_a]
+                if f.dtype.is_string:
+                    data[pos_a, : d.shape[1]] = d
+                    lens[pos_a] = np.asarray(src.lengths)[row_a]
+                else:
+                    data[pos_a] = d
+                val[pos_a] = np.asarray(src.validity)[row_a]
+            cols.append(Column(f.dtype, data, val, lens).to_device())
+        return RecordBatch(schema, cols, n)
+
+    # ------------------------------------------------------------ execute
+
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)
 
         def stream():
-            consumer = _SortConsumer()
-            ctx.mem.register_consumer(consumer)
+            state = _SortState(self)
+            ctx.mem.register_consumer(state)
             try:
-                buffered: List[RecordBatch] = []
-                total = 0
                 for batch in child_stream:
                     if not ctx.is_task_running():
                         return
                     if self.fetch is not None and batch.num_rows > self.fetch:
                         with self.metrics.timer("sort_time"):
                             batch = self._sorted_batch(batch, self.fetch)
-                    buffered.append(batch.to_host())
-                    total += batch.num_rows
-                    consumer.update_mem_used(sum(b.memory_size() for b in buffered))
-                if not buffered:
+                    state.add(batch.to_host())
+                buffered, spills = state.freeze()
+                if not buffered and not spills:
                     return
-                with self.metrics.timer("sort_time"):
-                    merged = concat_batches(buffered)
-                    out = self._sorted_batch(merged.to_device(), self.fetch)
-                self.metrics.add("output_rows", out.num_rows)
-                yield out
+                if not spills:
+                    # in-budget: one device sort over the whole buffer
+                    with self.metrics.timer("sort_time"):
+                        merged = concat_batches(buffered)
+                        out = self._sorted_batch(merged.to_device(), self.fetch)
+                    self.metrics.add("output_rows", out.num_rows)
+                    yield out
+                    return
+                sources = [self._spill_chunks(sp, self.schema) for sp in spills]
+                if buffered:
+                    sources.append(self._mem_run_chunks(buffered))
+                for out in self._merge(sources, self.fetch, ctx):
+                    self.metrics.add("output_rows", out.num_rows)
+                    yield out
             finally:
-                ctx.mem.unregister_consumer(consumer)
+                for sp in state.freeze()[1]:
+                    sp.release()
+                ctx.mem.unregister_consumer(state)
 
         return stream()
-
-
-class _SortConsumer(MemConsumer):
-    name = "sort"
-
-    def spill(self) -> int:
-        # buffered batches are already host-staged; nothing device-side
-        # to free. Disk spill tier lands with the native IO layer.
-        return 0

@@ -306,3 +306,48 @@ def test_generate_json_tuple():
     got = collect_dict(g)
     assert got["a"] == ["1", "2", None, None]
     assert got["b"] == ["x", None, None, None]
+
+
+def test_agg_spill_under_memory_pressure():
+    """Regression: a spilled accumulator must not also stay merged in
+    the live state (double counting).  ≙ agg_table.rs spill+merge."""
+    from blaze_tpu import conf as _conf
+    from blaze_tpu.parallel import HashPartitioning, NativeShuffleExchangeExec
+    from blaze_tpu.runtime.memmgr import MemManager
+
+    rng = np.random.RandomState(0)
+    schema = Schema([Field("k", DataType.int64()), Field("v", DataType.int64())])
+    batches = [[
+        batch_from_pydict(
+            {"k": [int(x) for x in rng.randint(0, 50, 400)],
+             "v": [int(x) for x in rng.randint(0, 100, 400)]},
+            schema,
+        )
+        for _ in range(3)
+    ] for _ in range(2)]
+
+    def q():
+        src = MemoryScanExec(batches, schema)
+        part = AggExec(src, AggMode.PARTIAL, [GroupingExpr(col("k"), "k")],
+                       [AggFunction("sum", col("v"), "s")])
+        ex = NativeShuffleExchangeExec(part, HashPartitioning([col("k")], 2))
+        return AggExec(ex, AggMode.FINAL, [GroupingExpr(col("k"), "k")], part.aggs)
+
+    from blaze_tpu.runtime.context import TaskContext
+
+    def run_q(plan):
+        out = {}
+        for p in range(2):
+            for b in plan.execute(p, TaskContext(p, 2)):
+                d = batch_to_pydict(b)
+                out.update(zip(d["k"], d["s"]))
+        return out
+
+    want = run_q(q())
+    MemManager.init(20_000)
+    try:
+        starved = q()
+        got = run_q(starved)
+    finally:
+        MemManager.init(int(_conf.HOST_SPILL_BUDGET.get()))
+    assert got == want
