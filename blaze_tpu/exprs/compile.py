@@ -475,6 +475,12 @@ def _lower_like(expr: Like, schema, cols, n) -> Column:
 
 # ------------------------------------------------- host-fallback support
 
+# scalar functions with data-dependent work no fixed-shape device
+# kernel can express; evaluated per batch on host (≙ the reference
+# keeps these native-CPU-side too: spark_get_json_object.rs)
+HOST_SCALAR_FUNCS = frozenset({"get_json_object", "get_parsed_json_object", "parse_json"})
+
+
 def needs_host(expr: Expr) -> bool:
     """Does this tree contain a node only evaluable on host?  ≙ the
     reference's convertExprWithFallback wrapping unconvertible exprs
@@ -482,6 +488,8 @@ def needs_host(expr: Expr) -> bool:
     from .ir import PythonUdf
 
     if isinstance(expr, PythonUdf):
+        return True
+    if isinstance(expr, ScalarFunc) and expr.name in HOST_SCALAR_FUNCS:
         return True
     if isinstance(expr, Like):
         parts = like_pattern_parts(expr.pattern)
@@ -522,6 +530,12 @@ def split_host_exprs(exprs: List[Expr]) -> Tuple[List[Expr], List[Tuple[str, Exp
             host_parts.append((name, e))
             return Col(name)
         if isinstance(e, Like) and needs_host(e) and not needs_host(e.child):
+            name = f"__host_{len(host_parts)}"
+            host_parts.append((name, e))
+            return Col(name)
+        if isinstance(e, ScalarFunc) and e.name in HOST_SCALAR_FUNCS:
+            # hoist the OUTERMOST host call; host_eval recursively
+            # evaluates nested host funcs and device-lowers other args
             name = f"__host_{len(host_parts)}"
             host_parts.append((name, e))
             return Col(name)
@@ -587,6 +601,39 @@ def host_eval(expr: Expr, batch) -> Column:
                 expr.dtype.np_dtype,
             )
         return column_from_numpy(expr.dtype, vals, validity, batch.capacity).to_device()
+
+    if isinstance(expr, ScalarFunc) and expr.name in HOST_SCALAR_FUNCS:
+        from .json_path import get_json_object, parse_json
+
+        def arg_strings(a: Expr) -> List:
+            if isinstance(a, Lit):
+                return [a.value] * batch.num_rows
+            if isinstance(a, Col):
+                return strings_to_list(batch.column(a.name).to_host(), batch.num_rows)
+            if isinstance(a, ScalarFunc) and a.name in HOST_SCALAR_FUNCS:
+                c = host_eval(a, batch)  # nested host call
+            else:
+                # device-computable subtree (cast/concat/...): lower it
+                # eagerly against this batch
+                env = {f.name: c for f, c in zip(batch.schema.fields, batch.columns)}
+                c = lower(a, batch.schema, env, batch.capacity)
+            return strings_to_list(c.to_host(), batch.num_rows)
+
+        src = arg_strings(expr.args[0])
+        if expr.name == "parse_json":
+            out_vals = [parse_json(s) for s in src]
+        else:
+            paths = arg_strings(expr.args[1])
+            cache: dict = {}
+            out_vals = [get_json_object(s, p, cache) for s, p in zip(src, paths)]
+        out_dt = infer_dtype(expr, batch.schema)
+        w = out_dt.string_width
+        # fixed-width columns: a result longer than the declared width
+        # cannot be stored — degrade to NULL rather than corrupt
+        out_vals = [
+            v if v is None or len(v.encode("utf-8")) <= w else None for v in out_vals
+        ]
+        return column_from_strings(out_vals, dtype=out_dt, capacity=batch.capacity).to_device()
 
     if isinstance(expr, Like):
         child = expr.child

@@ -158,6 +158,22 @@ def _concat_t(e, ts):
     return DataType.string(string_width_for(sum(t.string_width for t in ts)))
 
 
+def _place_at_offsets(data, lengths, src_col: Column, w: int, live=None):
+    """Write src_col's bytes at each row's current offset ``lengths``
+    (per-row gather shift + masked write); returns (data, lengths).
+    ``live`` masks rows that take part (concat_ws skips null args)."""
+    pos = jnp.arange(w)[None, :]
+    pw = src_col.data.shape[1]
+    src = jnp.pad(src_col.data, ((0, 0), (0, w - pw))) if pw < w else src_col.data[:, :w]
+    idx = jnp.clip(pos - lengths[:, None], 0, src.shape[1] - 1)
+    shifted = jnp.take_along_axis(src, idx, axis=1)
+    ln = src_col.lengths if live is None else jnp.where(live, src_col.lengths, 0)
+    write = (pos >= lengths[:, None]) & (pos < (lengths + ln)[:, None])
+    if live is not None:
+        write = write & live[:, None]
+    return jnp.where(write, shifted, data), lengths + ln
+
+
 @register("concat", _concat_t)
 def _concat(expr, schema, cols, n, lower_fn):
     parts = [lower_fn(a, schema, cols, n) for a in expr.args]
@@ -166,17 +182,9 @@ def _concat(expr, schema, cols, n, lower_fn):
     data = jnp.zeros((n, w), jnp.uint8)
     lengths = jnp.zeros(n, jnp.int32)
     validity = jnp.ones(n, jnp.bool_)
-    pos = jnp.arange(w)[None, :]
     for p in parts:
         validity = validity & p.validity
-        pw = p.data.shape[1]
-        src = jnp.pad(p.data, ((0, 0), (0, w - pw))) if pw < w else p.data[:, :w]
-        # place src at per-row offset `lengths` via gather
-        idx = jnp.clip(pos - lengths[:, None], 0, src.shape[1] - 1)
-        shifted = jnp.take_along_axis(src, idx, axis=1)
-        write = (pos >= lengths[:, None]) & (pos < (lengths + p.lengths)[:, None])
-        data = jnp.where(write, shifted, data)
-        lengths = lengths + p.lengths
+        data, lengths = _place_at_offsets(data, lengths, p, w)
     lengths = jnp.minimum(lengths, w)
     return Column(out_t, data.astype(jnp.uint8), validity, lengths)
 
@@ -291,3 +299,211 @@ def _might_contain(expr, schema, cols, n, lower_fn):
     import jax.numpy as jnp
 
     return Column(DataType.bool_(), v, jnp.ones(n, jnp.bool_))
+
+
+# ------------------------------------------------- JSON (host-evaluated)
+
+def _json_out_t(e, ts):
+    """get_json_object/parse_json output: a string wide enough for any
+    extraction from the input plus re-serialization overhead (brackets,
+    commas, re-quoting for multi-match arrays)."""
+    from ..schema import string_width_for
+
+    in_w = ts[0].string_width if ts and ts[0].is_string else 64
+    return DataType.string(string_width_for(in_w + 32))
+
+
+@register("get_json_object", _json_out_t)
+@register("get_parsed_json_object", _json_out_t)
+@register("parse_json", _json_out_t)
+def _json_host_only(expr, schema, cols, n, lower_fn):
+    # routed through split_host_exprs/host_eval (compile.py); a device
+    # lowering request means the planner failed to hoist it
+    raise NotImplementedError(
+        f"{expr.name} is host-evaluated; route via split_host_exprs"
+    )
+
+
+# ------------------------------------------- decimal interop + hashes
+# ≙ reference datafusion-ext-functions: null_if, unscaled_value,
+# make_decimal, check_overflow, murmur3_hash, xxhash64, space, repeat
+# (lib.rs:34-59 name registry)
+
+def _unscaled_value_t(e, ts):
+    return DataType.int64()
+
+
+@register("unscaled_value", _unscaled_value_t)
+def _unscaled_value(expr, schema, cols, n, lower_fn):
+    """decimal -> its unscaled int64 (≙ spark UnscaledValue)."""
+    c = lower_fn(expr.args[0], schema, cols, n)
+    assert c.dtype.is_decimal, "unscaled_value takes a decimal"
+    return Column(DataType.int64(), c.data, c.validity)
+
+
+def _make_decimal_t(e, ts):
+    p = int(e.args[1].value)
+    s = int(e.args[2].value)
+    return DataType.decimal(p, s)
+
+
+@register("make_decimal", _make_decimal_t)
+def _make_decimal(expr, schema, cols, n, lower_fn):
+    """int64 unscaled -> decimal(p, s) (≙ spark MakeDecimal)."""
+    c = lower_fn(expr.args[0], schema, cols, n)
+    out_t = _make_decimal_t(expr, None)
+    return Column(out_t, c.data.astype(jnp.int64), c.validity)
+
+
+def _check_overflow_t(e, ts):
+    p = int(e.args[1].value)
+    s = int(e.args[2].value)
+    return DataType.decimal(p, s)
+
+
+@register("check_overflow", _check_overflow_t)
+def _check_overflow(expr, schema, cols, n, lower_fn):
+    """Rescale a decimal to (p, s); null where |value| overflows p
+    digits (≙ spark CheckOverflow with nullOnOverflow)."""
+    from .cast import rescale_decimal
+
+    c = lower_fn(expr.args[0], schema, cols, n)
+    out_t = _check_overflow_t(expr, None)
+    assert c.dtype.is_decimal
+    data = rescale_decimal(c.data, c.dtype.scale, out_t.scale)
+    limit = jnp.int64(10 ** min(out_t.precision, 18))
+    ok = (data < limit) & (data > -limit)
+    return Column(out_t, jnp.where(ok, data, jnp.int64(0)), c.validity & ok)
+
+
+def _nullif_t(e, ts):
+    return ts[0]
+
+
+@register("nullif", _nullif_t)
+@register("null_if", _nullif_t)
+def _nullif(expr, schema, cols, n, lower_fn):
+    """a unless a == b, else null (≙ spark NullIf / reference null_if)."""
+    from .strings import str_eq
+
+    a = lower_fn(expr.args[0], schema, cols, n)
+    b = lower_fn(expr.args[1], schema, cols, n)
+    if a.dtype.is_string:
+        eq = str_eq(a, b)
+    else:
+        eq = a.data == b.data
+    both_valid = a.validity & b.validity
+    return Column(a.dtype, a.data, a.validity & ~(both_valid & eq), a.lengths)
+
+
+def _murmur3_t(e, ts):
+    return DataType.int32()
+
+
+@register("murmur3_hash", _murmur3_t)
+def _murmur3_hash(expr, schema, cols, n, lower_fn):
+    """Spark Murmur3Hash(args, seed 42) (≙ spark_murmur3_hash.rs)."""
+    from .hash import murmur3_columns
+
+    parts = [lower_fn(a, schema, cols, n) for a in expr.args]
+    return Column(DataType.int32(), murmur3_columns(parts), jnp.ones(n, jnp.bool_))
+
+
+def _xxhash64_t(e, ts):
+    return DataType.int64()
+
+
+@register("xxhash64", _xxhash64_t)
+def _xxhash64(expr, schema, cols, n, lower_fn):
+    """Spark XxHash64(args, seed 42) (≙ spark_xxhash64.rs)."""
+    from .hash import xxhash64_columns
+
+    parts = [lower_fn(a, schema, cols, n) for a in expr.args]
+    return Column(DataType.int64(), xxhash64_columns(parts), jnp.ones(n, jnp.bool_))
+
+
+# -------------------------------------------------- string constructors
+
+_DYNAMIC_STR_CAP = 128  # width when the repeat count is not a literal
+
+
+def _space_t(e, ts):
+    from ..schema import string_width_for
+    from .ir import Lit
+
+    a = e.args[0]
+    if isinstance(a, Lit) and a.value is not None:
+        return DataType.string(string_width_for(max(int(a.value), 1)))
+    return DataType.string(_DYNAMIC_STR_CAP)
+
+
+@register("space", _space_t)
+def _space(expr, schema, cols, n, lower_fn):
+    """space(n): n spaces (≙ spark_strings.rs string_space); a dynamic
+    n clips at the declared column width."""
+    c = lower_fn(expr.args[0], schema, cols, n)
+    out_t = _space_t(expr, None)
+    w = out_t.string_width
+    lengths = jnp.clip(c.data.astype(jnp.int32), 0, w)
+    pos = jnp.arange(w)[None, :]
+    data = jnp.where(pos < lengths[:, None], jnp.uint8(0x20), jnp.uint8(0))
+    return Column(out_t, data, c.validity, lengths)
+
+
+def _repeat_t(e, ts):
+    from ..schema import string_width_for
+    from .ir import Lit
+
+    w = ts[0].string_width
+    a = e.args[1]
+    if isinstance(a, Lit) and a.value is not None:
+        return DataType.string(string_width_for(max(w * int(a.value), 1)))
+    return DataType.string(max(_DYNAMIC_STR_CAP, w))
+
+
+@register("repeat", _repeat_t)
+def _repeat(expr, schema, cols, n, lower_fn):
+    """repeat(s, n) (≙ spark_strings.rs string_repeat); a dynamic n
+    clips at the declared column width."""
+    s = lower_fn(expr.args[0], schema, cols, n)
+    cnt = lower_fn(expr.args[1], schema, cols, n)
+    out_t = _repeat_t(expr, [s.dtype])
+    w = out_t.string_width
+    reps = jnp.maximum(cnt.data.astype(jnp.int32), 0)
+    lengths = jnp.clip(s.lengths * reps, 0, w)
+    pos = jnp.arange(w)[None, :]
+    src_len = jnp.maximum(s.lengths, 1)[:, None]
+    sw = s.data.shape[1]
+    src = jnp.pad(s.data, ((0, 0), (0, w - sw))) if sw < w else s.data[:, :w]
+    idx = jnp.minimum(pos % src_len, w - 1)  # clamp: out width may be < source width (e.g. repeat(s, 0))
+    tiled = jnp.take_along_axis(src, idx, axis=1)
+    data = jnp.where(pos < lengths[:, None], tiled, jnp.uint8(0))
+    return Column(out_t, data.astype(jnp.uint8), cnt.validity & s.validity, lengths)
+
+
+def _concat_ws_t(e, ts):
+    from ..schema import string_width_for
+
+    sep_w = ts[0].string_width
+    total = sum(t.string_width for t in ts[1:]) + sep_w * max(len(ts) - 2, 0)
+    return DataType.string(string_width_for(max(total, 1)))
+
+
+@register("concat_ws", _concat_ws_t)
+def _concat_ws(expr, schema, cols, n, lower_fn):
+    """concat_ws(sep, s1, s2, ...): null args are SKIPPED (Spark), not
+    nulling the result (≙ spark_strings.rs string_concat_ws)."""
+    parts = [lower_fn(a, schema, cols, n) for a in expr.args]
+    sep, rest = parts[0], parts[1:]
+    out_t = _concat_ws_t(expr, [p.dtype for p in parts])
+    w = out_t.string_width
+    data = jnp.zeros((n, w), jnp.uint8)
+    lengths = jnp.zeros(n, jnp.int32)
+    first = jnp.ones(n, jnp.bool_)
+    for p in rest:
+        live = p.validity
+        data, lengths = _place_at_offsets(data, lengths, sep, w, live & ~first)
+        data, lengths = _place_at_offsets(data, lengths, p, w, live)
+        first = first & ~live
+    lengths = jnp.minimum(lengths, w)
+    return Column(out_t, data.astype(jnp.uint8), sep.validity, lengths)

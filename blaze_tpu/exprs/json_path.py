@@ -1,0 +1,146 @@
+"""Spark-compatible JSON path evaluation (get_json_object).
+
+≙ reference ``datafusion-ext-functions/src/spark_get_json_object.rs``
+(701 LoC): Hive/Spark's GetJsonObject semantics — `$` root, `.name` /
+`['name']` member access, `[n]` index, `[*]` wildcard, implicit
+flatten-through-arrays for member access, single matches unwrapped,
+multiple matches re-serialized as a JSON array (strings re-quoted),
+null for any miss/parse failure.  The reference parses with a forked
+serde_json preserving map order; here the host evaluator uses python's
+json with compact re-serialization.
+
+JSON parsing is irreducibly data-dependent (no fixed-shape device
+kernel), so these run through the host-fallback expression slot
+(split_host_exprs / host_eval in compile.py) — the same architecture
+position as the reference's native-side parse on the CPU.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Step = Tuple  # ("key", name) | ("index", i) | ("wild",)
+
+
+def parse_path(path: str) -> Optional[List[Step]]:
+    """Parse a JSON path; None if malformed (Spark yields NULL)."""
+    if not path or path[0] != "$":
+        return None
+    steps: List[Step] = []
+    i = 1
+    n = len(path)
+    while i < n:
+        c = path[i]
+        if c == ".":
+            i += 1
+            j = i
+            while j < n and path[j] not in ".[":
+                j += 1
+            name = path[i:j]
+            if not name:
+                return None
+            steps.append(("wild",) if name == "*" else ("key", name))
+            i = j
+        elif c == "[":
+            j = path.find("]", i)
+            if j < 0:
+                return None
+            inner = path[i + 1 : j].strip()
+            if inner == "*":
+                steps.append(("wild",))
+            elif len(inner) >= 2 and inner[0] == "'" and inner[-1] == "'":
+                steps.append(("key", inner[1:-1]))
+            else:
+                try:
+                    steps.append(("index", int(inner)))
+                except ValueError:
+                    return None
+            i = j + 1
+        else:
+            return None
+    return steps
+
+
+def _eval(obj, steps: Sequence[Step]) -> List:
+    if not steps:
+        return [obj]
+    step, rest = steps[0], steps[1:]
+    kind = step[0]
+    if kind == "key":
+        name = step[1]
+        if isinstance(obj, dict):
+            return _eval(obj[name], rest) if name in obj else []
+        if isinstance(obj, list):
+            # Spark flattens member access through arrays:
+            # $.a.b over {"a":[{"b":1},{"b":2}]} -> [1,2]
+            out: List = []
+            for el in obj:
+                if isinstance(el, dict) and name in el:
+                    out.extend(_eval(el[name], rest))
+            return out
+        return []
+    if kind == "index":
+        i = step[1]
+        if isinstance(obj, list) and 0 <= i < len(obj):
+            return _eval(obj[i], rest)
+        return []
+    # wildcard
+    if isinstance(obj, list):
+        out = []
+        for el in obj:
+            out.extend(_eval(el, rest))
+        return out
+    return []
+
+
+def _render_single(v) -> Optional[str]:
+    if v is None:
+        return None  # JSON null -> SQL NULL
+    if isinstance(v, str):
+        return v  # unquoted
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return json.dumps(v, separators=(",", ":"))
+
+
+def get_json_object(
+    json_str: Optional[str],
+    path: Optional[str],
+    path_cache: Optional[Dict[str, Optional[List[Step]]]] = None,
+) -> Optional[str]:
+    """One row of Spark's get_json_object."""
+    if json_str is None or path is None:
+        return None
+    if path_cache is not None and path in path_cache:
+        steps = path_cache[path]
+    else:
+        steps = parse_path(path)
+        if path_cache is not None:
+            path_cache[path] = steps
+    if steps is None:
+        return None
+    try:
+        obj = json.loads(json_str)
+    except (ValueError, TypeError):
+        return None
+    matches = _eval(obj, steps)
+    if not matches:
+        return None
+    if len(matches) == 1:
+        return _render_single(matches[0])
+    return json.dumps(matches, separators=(",", ":"))
+
+
+def parse_json(json_str: Optional[str]) -> Optional[str]:
+    """≙ reference parse_json: validate + normalize.  The reference
+    caches the parsed document as an opaque UserDefinedArray for
+    repeated get_parsed_json_object calls; here normalization (compact
+    re-serialization) is the cacheable form, and get_parsed_json_object
+    == get_json_object over it."""
+    if json_str is None:
+        return None
+    try:
+        return json.dumps(json.loads(json_str), separators=(",", ":"))
+    except (ValueError, TypeError):
+        return None

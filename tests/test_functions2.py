@@ -1,0 +1,124 @@
+"""Scalar functions round 2: decimal interop (unscaled_value,
+make_decimal, check_overflow), nullif, hash exprs, string constructors
+(space, repeat, concat_ws).
+
+≙ reference datafusion-ext-functions unit tests for the same names.
+"""
+
+import numpy as np
+
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.exprs.ir import Lit, ScalarFunc
+from blaze_tpu.ops import MemoryScanExec, ProjectExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+
+
+def run_project(data, schema, exprs):
+    b = batch_from_pydict(data, schema)
+    p = ProjectExec(MemoryScanExec([[b]], schema), exprs)
+    return batch_to_pydict(list(p.execute(0, TaskContext(0, 1)))[0])
+
+
+def test_unscaled_value_and_make_decimal_roundtrip():
+    schema = Schema([Field("d", DataType.decimal(10, 2))])
+    d = run_project(
+        {"d": [1.25, -3.5, None]},
+        schema,
+        [
+            ScalarFunc("unscaled_value", [col("d")]).alias("u"),
+            ScalarFunc(
+                "make_decimal",
+                [ScalarFunc("unscaled_value", [col("d")]), Lit(10), Lit(2)],
+            ).alias("rt"),
+        ],
+    )
+    assert d["u"] == [125, -350, None]
+    assert d["rt"] == [125, -350, None]  # decimals come back unscaled
+
+
+def test_check_overflow_nulls_on_overflow():
+    schema = Schema([Field("d", DataType.decimal(12, 2))])
+    # target decimal(4, 2): |v| must be < 10^4 unscaled (i.e. < 100.00)
+    d = run_project(
+        {"d": [99.99, 100.00, -99.99, -100.01, None]},
+        schema,
+        [ScalarFunc("check_overflow", [col("d"), Lit(4), Lit(2)]).alias("c")],
+    )
+    assert d["c"] == [9999, None, -9999, None, None]
+
+
+def test_nullif():
+    schema = Schema([Field("a", DataType.int64()), Field("b", DataType.int64())])
+    d = run_project(
+        {"a": [1, 2, None, 4], "b": [1, 3, 1, None]},
+        schema,
+        [ScalarFunc("nullif", [col("a"), col("b")]).alias("n")],
+    )
+    assert d["n"] == [None, 2, None, 4]
+
+
+def test_nullif_strings():
+    schema = Schema([Field("a", DataType.string(8)), Field("b", DataType.string(8))])
+    d = run_project(
+        {"a": ["x", "y", None], "b": ["x", "z", "x"]},
+        schema,
+        [ScalarFunc("nullif", [col("a"), col("b")]).alias("n")],
+    )
+    assert d["n"] == [None, "y", None]
+
+
+def test_hash_exprs_match_hash_module():
+    from blaze_tpu.batch import column_from_numpy
+    from blaze_tpu.exprs.hash import murmur3_columns, xxhash64_columns
+
+    schema = Schema([Field("k", DataType.int64())])
+    vals = [12345, -7, None, 2**40]
+    d = run_project(
+        {"k": vals},
+        schema,
+        [
+            ScalarFunc("murmur3_hash", [col("k")]).alias("m"),
+            ScalarFunc("xxhash64", [col("k")]).alias("x"),
+        ],
+    )
+    kcol = column_from_numpy(
+        DataType.int64(),
+        np.array([v if v is not None else 0 for v in vals], np.int64),
+        np.array([v is not None for v in vals]),
+        capacity=4,
+    ).to_device()
+    assert d["m"] == [int(v) for v in np.asarray(murmur3_columns([kcol]))[:4]]
+    assert d["x"] == [int(v) for v in np.asarray(xxhash64_columns([kcol]))[:4]]
+
+
+def test_space_and_repeat():
+    schema = Schema([Field("n", DataType.int32()), Field("s", DataType.string(8))])
+    d = run_project(
+        {"n": [0, 3, None], "s": ["ab", "xyz", "q"]},
+        schema,
+        [
+            ScalarFunc("space", [col("n")]).alias("sp"),
+            ScalarFunc("repeat", [col("s"), Lit(3)]).alias("r3"),
+            ScalarFunc("repeat", [col("s"), col("n")]).alias("rn"),
+        ],
+    )
+    assert d["sp"] == ["", "   ", None]
+    assert d["r3"] == ["ababab", "xyzxyzxyz", "qqq"]
+    assert d["rn"] == ["", "xyzxyzxyz", None]
+
+
+def test_concat_ws_skips_nulls():
+    schema = Schema([
+        Field("a", DataType.string(8)),
+        Field("b", DataType.string(8)),
+        Field("c", DataType.string(8)),
+    ])
+    d = run_project(
+        {"a": ["x", None, None], "b": ["y", "m", None], "c": [None, "n", None]},
+        schema,
+        [ScalarFunc("concat_ws", [Lit(","), col("a"), col("b"), col("c")]).alias("j")],
+    )
+    # Spark: null args skipped entirely; all-null -> empty string
+    assert d["j"] == ["x,y", "m,n", ""]
