@@ -1,0 +1,21 @@
+"""TPC-DS support: star schemas, deterministic datagen, query plans,
+and numpy oracles for differential validation.
+
+≙ the reference's TPC-DS end-to-end matrix (SURVEY.md §4) — its CI
+runs ~103 queries against vanilla-Spark answers; this package carries
+the same differential strategy for the TPU engine, growing query by
+query (tpch/ covers all 22 TPC-H; this covers the q3/q7 BASELINE
+configs plus the classic reporting-join shapes).
+"""
+
+from .datagen import generate_all, generate_table
+from .queries import QUERIES, build_query
+from .schema import TPCDS_SCHEMAS
+
+__all__ = [
+    "QUERIES",
+    "TPCDS_SCHEMAS",
+    "build_query",
+    "generate_all",
+    "generate_table",
+]

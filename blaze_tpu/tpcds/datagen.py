@@ -1,0 +1,190 @@
+"""TPC-DS data generation (star-schema subset, deterministic).
+
+Shares the host-table format and helpers with the TPC-H generator
+(tpch/datagen.py).  Foreign keys that TPC-DS leaves NULL are generated
+as -1 here (no dimension row matches): identical behavior for the
+inner-join query set, without per-column validity plumbing.
+
+≙ the reference's dsdgen-produced datasets (tpcds/datagen wrapper,
+tpcds-reusable.yml checks out a pregenerated 1 GB set).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict
+
+import numpy as np
+
+from ..tpch.datagen import (
+    HostTable,
+    _days,
+    _encode_options,
+    table_to_batches,  # noqa: F401  (re-export: tests build batches the same way)
+)
+
+CATEGORIES = [
+    "Books", "Children", "Electronics", "Home", "Jewelry",
+    "Men", "Music", "Shoes", "Sports", "Women",
+]
+EDUCATIONS = [
+    "Primary", "Secondary", "College", "2 yr Degree",
+    "4 yr Degree", "Advanced Degree", "Unknown",
+]
+MARITALS = ["M", "S", "D", "W", "U"]
+GENDERS = ["M", "F"]
+STORE_NAMES = ["ese", "ought", "able", "pri", "bar", "anti"]
+
+DATE_SK_BASE = 2450815  # arbitrary julian-like base, spec-style
+D_FIRST = (1998, 1, 1)
+D_LAST = (2002, 12, 31)
+
+
+def _money(rng, n, lo, hi):
+    """decimal(7,2) unscaled int64."""
+    return rng.randint(int(lo * 100), int(hi * 100) + 1, n).astype(np.int64)
+
+
+def _date_dim() -> HostTable:
+    first = _days(*D_FIRST)
+    last = _days(*D_LAST)
+    days = np.arange(first, last + 1, dtype=np.int32)
+    # civil calendar split (vectorized Hinnant)
+    z = days.astype(np.int64) + 719468
+    era = np.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    y = np.where(m <= 2, y + 1, y)
+    return {
+        "d_date_sk": ((days - first + DATE_SK_BASE).astype(np.int64), None),
+        "d_date": (days, None),
+        "d_year": (y.astype(np.int32), None),
+        "d_moy": (m.astype(np.int32), None),
+        "d_dom": (d.astype(np.int32), None),
+        "d_qoy": (((m - 1) // 3 + 1).astype(np.int32), None),
+    }
+
+
+def _time_dim() -> HostTable:
+    mins = np.arange(1440, dtype=np.int64)
+    return {
+        "t_time_sk": (mins, None),
+        "t_hour": ((mins // 60).astype(np.int32), None),
+        "t_minute": ((mins % 60).astype(np.int32), None),
+    }
+
+
+def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
+    rng = np.random.RandomState((seed + zlib.crc32(name.encode())) % (2**31))
+    if name == "date_dim":
+        return _date_dim()
+    if name == "time_dim":
+        return _time_dim()
+    if name == "store":
+        n = len(STORE_NAMES)
+        data, lengths = _encode_options(STORE_NAMES, 16)
+        return {
+            "s_store_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "s_store_name": (data, lengths),
+        }
+    if name == "promotion":
+        n = max(5, int(300 * scale))
+        yn = lambda: _encode_options([("Y" if v else "N") for v in rng.randint(0, 2, n)], 8)
+        e_data, e_len = yn()
+        v_data, v_len = yn()
+        return {
+            "p_promo_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "p_channel_email": (e_data, e_len),
+            "p_channel_event": (v_data, v_len),
+        }
+    if name == "customer_demographics":
+        # full cross product, spec-style smallest-cycle dimension
+        combos = [
+            (g, m, e)
+            for e in EDUCATIONS
+            for m in MARITALS
+            for g in GENDERS
+        ]
+        reps = 4
+        combos = combos * reps
+        g_data, g_len = _encode_options([c[0] for c in combos], 8)
+        m_data, m_len = _encode_options([c[1] for c in combos], 8)
+        e_data, e_len = _encode_options([c[2] for c in combos], 24)
+        return {
+            "cd_demo_sk": (np.arange(1, len(combos) + 1, dtype=np.int64), None),
+            "cd_gender": (g_data, g_len),
+            "cd_marital_status": (m_data, m_len),
+            "cd_education_status": (e_data, e_len),
+        }
+    if name == "household_demographics":
+        n = 720
+        return {
+            "hd_demo_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "hd_dep_count": ((np.arange(n) % 10).astype(np.int32), None),
+        }
+    if name == "item":
+        n = max(60, int(18000 * scale))
+        sk = np.arange(1, n + 1, dtype=np.int64)
+        ids = [f"ITEM{k:012d}" for k in range(1, n + 1)]
+        id_data, id_len = _encode_options(ids, 16)
+        brand_id = (rng.randint(1, 10, n) * 1000000 + rng.randint(1, 200, n)).astype(np.int32)
+        brands = [f"brand#{b}" for b in brand_id]
+        b_data, b_len = _encode_options(brands, 32)
+        cat_id = rng.randint(1, len(CATEGORIES) + 1, n).astype(np.int32)
+        c_data, c_len = _encode_options([CATEGORIES[c - 1] for c in cat_id], 16)
+        return {
+            "i_item_sk": (sk, None),
+            "i_item_id": (id_data, id_len),
+            "i_brand_id": (brand_id, None),
+            "i_brand": (b_data, b_len),
+            "i_category_id": (cat_id, None),
+            "i_category": (c_data, c_len),
+            "i_manufact_id": (rng.randint(1, 200, n).astype(np.int32), None),
+            "i_manager_id": (rng.randint(1, 40, n).astype(np.int32), None),
+            "i_current_price": (_money(rng, n, 1, 99), None),
+        }
+    if name == "store_sales":
+        n = max(200, int(2_880_000 * scale))
+        n_date = _days(*D_LAST) - _days(*D_FIRST) + 1
+        n_item = max(60, int(18000 * scale))
+        n_cd = len(EDUCATIONS) * len(MARITALS) * len(GENDERS) * 4
+        n_promo = max(5, int(300 * scale))
+
+        def fk(upper, null_frac=0.04):
+            v = rng.randint(1, upper + 1, n).astype(np.int64)
+            nulls = rng.rand(n) < null_frac
+            return np.where(nulls, np.int64(-1), v)
+
+        return {
+            "ss_sold_date_sk": (
+                np.where(rng.rand(n) < 0.02, np.int64(-1),
+                         rng.randint(0, n_date, n) + DATE_SK_BASE).astype(np.int64), None),
+            "ss_sold_time_sk": (
+                np.where(rng.rand(n) < 0.02, np.int64(-1),
+                         rng.randint(0, 1440, n)).astype(np.int64), None),
+            "ss_item_sk": (rng.randint(1, n_item + 1, n).astype(np.int64), None),
+            "ss_customer_sk": (fk(100000), None),
+            "ss_cdemo_sk": (fk(n_cd), None),
+            "ss_hdemo_sk": (fk(720), None),
+            "ss_store_sk": (fk(len(STORE_NAMES)), None),
+            "ss_promo_sk": (fk(n_promo), None),
+            "ss_quantity": (rng.randint(1, 101, n).astype(np.int32), None),
+            "ss_list_price": (_money(rng, n, 1, 200), None),
+            "ss_sales_price": (_money(rng, n, 0, 200), None),
+            "ss_ext_discount_amt": (_money(rng, n, 0, 1000), None),
+            "ss_ext_sales_price": (_money(rng, n, 0, 2000), None),
+            "ss_coupon_amt": (_money(rng, n, 0, 100), None),
+            "ss_net_profit": (_money(rng, n, -1000, 1000), None),
+        }
+    raise KeyError(f"unknown tpcds table {name!r}")
+
+
+def generate_all(scale: float, seed: int = 20011129) -> Dict[str, HostTable]:
+    from .schema import TPCDS_SCHEMAS
+
+    return {name: generate_table(name, scale, seed) for name in TPCDS_SCHEMAS}

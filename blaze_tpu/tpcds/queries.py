@@ -1,0 +1,177 @@
+"""TPC-DS query plans over the operator layer (star-join subset:
+q3 q7 q42 q52 q55 q96 — the BASELINE.json TPC-DS configs plus the
+classic reporting-join shapes).
+
+Same architecture slot as tpch/queries.py: each builder plays Spark
+planner + BlazeConverters for its query, wiring scans through
+filters/broadcast star joins/two-stage aggregations/exchanges.
+
+≙ reference end-to-end TPC-DS differential matrix
+(.github/workflows/tpcds-reusable.yml:83-143).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from ..exprs import col, lit
+from ..ops import (
+    AggExec,
+    AggFunction,
+    AggMode,
+    ExecNode,
+    FilterExec,
+    GroupingExpr,
+    ProjectExec,
+    SortField,
+)
+from ..ops.joins import JoinType
+from ..tpch.queries import broadcast_join, single_sorted, two_stage_agg
+
+
+def q3(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    dt = FilterExec(t["date_dim"], col("d_moy") == lit(11))
+    dt_p = ProjectExec(dt, [col("d_date_sk"), col("d_year")])
+    sales = ProjectExec(t["store_sales"], [col("ss_sold_date_sk"), col("ss_item_sk"), col("ss_ext_sales_price")])
+    j1 = broadcast_join(dt_p, sales, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    it = FilterExec(t["item"], col("i_manufact_id") == lit(128))
+    it_p = ProjectExec(it, [col("i_item_sk"), col("i_brand_id"), col("i_brand")])
+    j2 = broadcast_join(it_p, j1, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j2,
+        [GroupingExpr(col("d_year"), "d_year"),
+         GroupingExpr(col("i_brand_id"), "brand_id"),
+         GroupingExpr(col("i_brand"), "brand")],
+        [AggFunction("sum", col("ss_ext_sales_price"), "sum_agg")],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("d_year")), SortField(col("sum_agg"), ascending=False), SortField(col("brand_id"))],
+        fetch=100,
+    )
+
+
+def q7(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    cd = FilterExec(
+        t["customer_demographics"],
+        (col("cd_gender") == lit("M"))
+        & (col("cd_marital_status") == lit("S"))
+        & (col("cd_education_status") == lit("College")),
+    )
+    cd_p = ProjectExec(cd, [col("cd_demo_sk")])
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2000))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    pr = FilterExec(
+        t["promotion"],
+        (col("p_channel_email") == lit("N")) | (col("p_channel_event") == lit("N")),
+    )
+    pr_p = ProjectExec(pr, [col("p_promo_sk")])
+    sales = t["store_sales"]
+    j = broadcast_join(cd_p, sales, [col("cd_demo_sk")], [col("ss_cdemo_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(dt_p, j, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(pr_p, j, [col("p_promo_sk")], [col("ss_promo_sk")], JoinType.INNER, build_is_left=True)
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id")])
+    j = broadcast_join(it, j, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("i_item_id"), "i_item_id")],
+        [
+            AggFunction("avg", col("ss_quantity"), "agg1"),
+            AggFunction("avg", col("ss_list_price"), "agg2"),
+            AggFunction("avg", col("ss_coupon_amt"), "agg3"),
+            AggFunction("avg", col("ss_sales_price"), "agg4"),
+        ],
+        n_parts,
+    )
+    return single_sorted(agg, [SortField(col("i_item_id"))], fetch=100)
+
+
+def _brand_report(t, n_parts, *, year, moy, manager, order_year_first):
+    """Shared shape of q52/q55 (and near-q3): month+year slice of
+    store_sales by brand."""
+    dt = FilterExec(t["date_dim"], (col("d_moy") == lit(moy)) & (col("d_year") == lit(year)))
+    dt_p = ProjectExec(dt, [col("d_date_sk"), col("d_year")])
+    sales = ProjectExec(t["store_sales"], [col("ss_sold_date_sk"), col("ss_item_sk"), col("ss_ext_sales_price")])
+    j1 = broadcast_join(dt_p, sales, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    it = FilterExec(t["item"], col("i_manager_id") == lit(manager))
+    it_p = ProjectExec(it, [col("i_item_sk"), col("i_brand_id"), col("i_brand")])
+    j2 = broadcast_join(it_p, j1, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j2,
+        [GroupingExpr(col("d_year"), "d_year"),
+         GroupingExpr(col("i_brand_id"), "brand_id"),
+         GroupingExpr(col("i_brand"), "brand")],
+        [AggFunction("sum", col("ss_ext_sales_price"), "ext_price")],
+        n_parts,
+    )
+    sort = (
+        [SortField(col("d_year")), SortField(col("ext_price"), ascending=False), SortField(col("brand_id"))]
+        if order_year_first
+        else [SortField(col("ext_price"), ascending=False), SortField(col("brand_id"))]
+    )
+    return single_sorted(agg, sort, fetch=100)
+
+
+def q52(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    return _brand_report(t, n_parts, year=2000, moy=11, manager=1, order_year_first=True)
+
+
+def q55(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    return _brand_report(t, n_parts, year=1999, moy=11, manager=28, order_year_first=False)
+
+
+def q42(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    dt = FilterExec(t["date_dim"], (col("d_moy") == lit(11)) & (col("d_year") == lit(2000)))
+    dt_p = ProjectExec(dt, [col("d_date_sk"), col("d_year")])
+    sales = ProjectExec(t["store_sales"], [col("ss_sold_date_sk"), col("ss_item_sk"), col("ss_ext_sales_price")])
+    j1 = broadcast_join(dt_p, sales, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    it = FilterExec(t["item"], col("i_manager_id") == lit(1))
+    it_p = ProjectExec(it, [col("i_item_sk"), col("i_category_id"), col("i_category")])
+    j2 = broadcast_join(it_p, j1, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j2,
+        [GroupingExpr(col("d_year"), "d_year"),
+         GroupingExpr(col("i_category_id"), "category_id"),
+         GroupingExpr(col("i_category"), "category")],
+        [AggFunction("sum", col("ss_ext_sales_price"), "sum_agg")],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("sum_agg"), ascending=False),
+         SortField(col("d_year")),
+         SortField(col("category_id")),
+         SortField(col("category"))],
+        fetch=100,
+    )
+
+
+def q96(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    td = FilterExec(t["time_dim"], (col("t_hour") == lit(20)) & (col("t_minute") >= lit(30)))
+    td_p = ProjectExec(td, [col("t_time_sk")])
+    hd = FilterExec(t["household_demographics"], col("hd_dep_count") == lit(7))
+    hd_p = ProjectExec(hd, [col("hd_demo_sk")])
+    st = FilterExec(t["store"], col("s_store_name") == lit("ese"))
+    st_p = ProjectExec(st, [col("s_store_sk")])
+    sales = ProjectExec(
+        t["store_sales"], [col("ss_sold_time_sk"), col("ss_hdemo_sk"), col("ss_store_sk")]
+    )
+    j = broadcast_join(td_p, sales, [col("t_time_sk")], [col("ss_sold_time_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(hd_p, j, [col("hd_demo_sk")], [col("ss_hdemo_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    return two_stage_agg(j, [], [AggFunction("count_star", None, "cnt")], n_parts)
+
+
+QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
+    "q3": q3,
+    "q7": q7,
+    "q42": q42,
+    "q52": q52,
+    "q55": q55,
+    "q96": q96,
+}
+
+
+def build_query(name: str, scans: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    return QUERIES[name](scans, n_parts)

@@ -1,0 +1,73 @@
+"""TPC-DS table schemas (the star-schema subset the query set uses;
+column types as Spark reads them — decimal(7,2) money columns).
+
+≙ the reference's TPC-DS differential CI (SURVEY.md §4,
+tpcds-reusable.yml): the same tables back its 103-query matrix.
+"""
+
+from ..schema import DataType as T, Field, Schema
+
+_m = lambda: T.decimal(7, 2)
+
+TPCDS_SCHEMAS = {
+    "date_dim": Schema([
+        Field("d_date_sk", T.int64()),
+        Field("d_date", T.date32()),
+        Field("d_year", T.int32()),
+        Field("d_moy", T.int32()),
+        Field("d_dom", T.int32()),
+        Field("d_qoy", T.int32()),
+    ]),
+    "time_dim": Schema([
+        Field("t_time_sk", T.int64()),
+        Field("t_hour", T.int32()),
+        Field("t_minute", T.int32()),
+    ]),
+    "item": Schema([
+        Field("i_item_sk", T.int64()),
+        Field("i_item_id", T.string(16)),
+        Field("i_brand_id", T.int32()),
+        Field("i_brand", T.string(32)),
+        Field("i_category_id", T.int32()),
+        Field("i_category", T.string(16)),
+        Field("i_manufact_id", T.int32()),
+        Field("i_manager_id", T.int32()),
+        Field("i_current_price", _m()),
+    ]),
+    "store": Schema([
+        Field("s_store_sk", T.int64()),
+        Field("s_store_name", T.string(16)),
+    ]),
+    "promotion": Schema([
+        Field("p_promo_sk", T.int64()),
+        Field("p_channel_email", T.string(8)),
+        Field("p_channel_event", T.string(8)),
+    ]),
+    "customer_demographics": Schema([
+        Field("cd_demo_sk", T.int64()),
+        Field("cd_gender", T.string(8)),
+        Field("cd_marital_status", T.string(8)),
+        Field("cd_education_status", T.string(24)),
+    ]),
+    "household_demographics": Schema([
+        Field("hd_demo_sk", T.int64()),
+        Field("hd_dep_count", T.int32()),
+    ]),
+    "store_sales": Schema([
+        Field("ss_sold_date_sk", T.int64()),
+        Field("ss_sold_time_sk", T.int64()),
+        Field("ss_item_sk", T.int64()),
+        Field("ss_customer_sk", T.int64()),
+        Field("ss_cdemo_sk", T.int64()),
+        Field("ss_hdemo_sk", T.int64()),
+        Field("ss_store_sk", T.int64()),
+        Field("ss_promo_sk", T.int64()),
+        Field("ss_quantity", T.int32()),
+        Field("ss_list_price", _m()),
+        Field("ss_sales_price", _m()),
+        Field("ss_ext_discount_amt", _m()),
+        Field("ss_ext_sales_price", _m()),
+        Field("ss_coupon_amt", _m()),
+        Field("ss_net_profit", _m()),
+    ]),
+}

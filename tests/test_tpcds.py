@@ -1,0 +1,107 @@
+"""TPC-DS differential validation: engine plans vs independent numpy
+oracles on generated data (≙ the reference's TPC-DS CI matrix,
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from blaze_tpu.batch import batch_to_pydict
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.tpcds import TPCDS_SCHEMAS, build_query, generate_all
+from blaze_tpu.tpcds import oracle as O
+from blaze_tpu.tpch.datagen import table_to_batches
+
+SCALE = 0.002
+N_PARTS = 2
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate_all(SCALE)
+
+
+@pytest.fixture(scope="module")
+def scans(data):
+    return {
+        name: MemoryScanExec(
+            table_to_batches(data[name], TPCDS_SCHEMAS[name], N_PARTS, batch_rows=4096),
+            TPCDS_SCHEMAS[name],
+        )
+        for name in TPCDS_SCHEMAS
+    }
+
+
+def run(plan):
+    out = {f.name: [] for f in plan.schema.fields}
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            for k in out:
+                out[k].extend(d[k])
+    return out
+
+
+def _check_brand_report(got, exp, sum_col, id_col="brand_id", name_col="brand"):
+    rows = {
+        (y, bid, bname): s
+        for y, bid, bname, s in zip(got["d_year"], got[id_col], got[name_col], got[sum_col])
+    }
+    top = dict(sorted(exp.items(), key=lambda kv: -kv[1])[:100])
+    # engine output is limited to 100; every returned row must be exact
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+    # the returned set must be the top-100 by the sum
+    if len(exp) > 100:
+        assert min(rows.values()) >= sorted(exp.values(), reverse=True)[99] or set(rows) == set(top)
+
+
+def test_q3(data, scans):
+    got = run(build_query("q3", scans, N_PARTS))
+    exp = O.oracle_q3(data)
+    _check_brand_report(got, exp, "sum_agg")
+    assert got["d_year"] == sorted(got["d_year"])  # primary order key
+
+
+def test_q52(data, scans):
+    got = run(build_query("q52", scans, N_PARTS))
+    exp = O.oracle_q52(data)
+    _check_brand_report(got, exp, "ext_price")
+
+
+def test_q55(data, scans):
+    got = run(build_query("q55", scans, N_PARTS))
+    exp = O.oracle_q55(data)
+    rows = {
+        (y, bid, bname): s
+        for y, bid, bname, s in zip(got["d_year"], got["brand_id"], got["brand"], got["ext_price"])
+    }
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+    assert got["ext_price"] == sorted(got["ext_price"], reverse=True)
+
+
+def test_q42(data, scans):
+    got = run(build_query("q42", scans, N_PARTS))
+    exp = O.oracle_q42(data)
+    _check_brand_report(got, exp, "sum_agg", id_col="category_id", name_col="category")
+    assert got["sum_agg"] == sorted(got["sum_agg"], reverse=True)
+
+
+def test_q7(data, scans):
+    got = run(build_query("q7", scans, N_PARTS))
+    exp = O.oracle_q7(data)
+    assert got["i_item_id"] == sorted(got["i_item_id"])
+    assert len(got["i_item_id"]) == min(len(exp), 100)
+    for i, iid in enumerate(got["i_item_id"]):
+        e = exp[iid]
+        assert abs(got["agg1"][i] - e[0]) < 1e-9, (iid, got["agg1"][i], e[0])
+        for gi, m in enumerate(("agg2", "agg3", "agg4"), start=1):
+            assert abs(got[m][i] - e[gi]) <= 1, (iid, m, got[m][i], e[gi])
+
+
+def test_q96(data, scans):
+    got = run(build_query("q96", scans, N_PARTS))
+    assert got["cnt"] == [O.oracle_q96(data)]
