@@ -22,6 +22,7 @@ from .joins import BroadcastJoinExec, HashJoinExec, SortMergeJoinExec
 from .window import WindowExec, WindowFunction
 from .expand import ExpandExec
 from .generate import GenerateExec
+from .orc_scan import OrcScanExec
 from .parquet_scan import ParquetScanExec
 from .parquet_sink import ParquetSinkExec
 
@@ -31,5 +32,5 @@ __all__ = [
     "LimitExec", "UnionExec", "RenameColumnsExec", "EmptyPartitionsExec",
     "DebugExec", "CoalesceBatchesExec", "BroadcastJoinExec", "HashJoinExec",
     "SortMergeJoinExec", "WindowExec", "WindowFunction", "ExpandExec",
-    "GenerateExec", "ParquetScanExec", "ParquetSinkExec",
+    "GenerateExec", "OrcScanExec", "ParquetScanExec", "ParquetSinkExec",
 ]

@@ -1,0 +1,163 @@
+"""ORC scan.
+
+≙ reference OrcExec (orc_exec.rs:53-285): per-partition file groups,
+projected read schema with by-name adaption (missing columns -> null),
+and stripe pruning from the file's stripe-level column statistics —
+the ORC analogue of ParquetScanExec's row-group pruning.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import conf
+from ..batch import Column, RecordBatch, _pad_1d, bucket_capacity
+from ..exprs.ir import Expr
+from ..io import orc
+from ..runtime.context import TaskContext
+from ..schema import DataType, Schema, TypeKind
+from .base import BatchStream, ExecNode
+from .parquet_scan import _prune_conjuncts
+
+
+def _stat_comparable(dtype: DataType, v):
+    if v is None:
+        return None
+    if dtype.is_string and isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    return v
+
+
+def _stripe_maybe_match(stats, dtype: DataType, op: str, lit_v) -> bool:
+    mn, mx, _ = stats
+    lo = _stat_comparable(dtype, mn)
+    hi = _stat_comparable(dtype, mx)
+    if lo is None or hi is None:
+        return True
+    try:
+        if op == "<":
+            return lo < lit_v
+        if op == "<=":
+            return lo <= lit_v
+        if op == ">":
+            return hi > lit_v
+        if op == ">=":
+            return hi >= lit_v
+        if op == "==":
+            return lo <= lit_v <= hi
+    except TypeError:
+        return True
+    return True
+
+
+class OrcScanExec(ExecNode):
+    def __init__(
+        self,
+        file_groups: Sequence[Sequence[str]],
+        schema: Schema,
+        predicate: Optional[Expr] = None,
+        batch_rows: int = 0,
+    ):
+        super().__init__([])
+        self.file_groups = [list(g) for g in file_groups]
+        self._schema = schema
+        self.predicate = predicate
+        self.batch_rows = batch_rows or int(conf.BATCH_SIZE.get())
+        self._conjuncts = _prune_conjuncts(predicate)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return max(1, len(self.file_groups))
+
+    def _null_column(self, dtype: DataType, cap: int) -> Column:
+        if dtype.is_string:
+            return Column(
+                dtype,
+                np.zeros((cap, dtype.string_width), np.uint8),
+                np.zeros(cap, np.bool_),
+                np.zeros(cap, np.int32),
+            )
+        return Column(dtype, np.zeros(cap, dtype.np_dtype), np.zeros(cap, np.bool_))
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        files = self.file_groups[partition] if partition < len(self.file_groups) else []
+
+        def stream():
+            max_w = max(
+                [f.dtype.string_width for f in self._schema.fields if f.dtype.is_string],
+                default=64,
+            )
+            for path in files:
+                try:
+                    meta = orc.read_metadata(path, string_width=max_w)
+                except Exception:
+                    if bool(conf.IGNORE_CORRUPT_FILES.get()):
+                        self.metrics.add("skipped_corrupt_files", 1)
+                        continue
+                    raise
+                file_fields = {f.name: f for f in meta.schema.fields}
+                for stripe in meta.stripes:
+                    if stripe.rows == 0:
+                        continue
+                    pruned = False
+                    for name, op, lit_v in self._conjuncts:
+                        st = stripe.stats.get(name)
+                        if st is None or name not in file_fields:
+                            continue
+                        if not _stripe_maybe_match(
+                            st, self._schema.field(name).dtype, op, lit_v
+                        ):
+                            pruned = True
+                            break
+                    if pruned:
+                        self.metrics.add("pruned_stripes", 1)
+                        self.metrics.add("pruned_rows", stripe.rows)
+                        continue
+                    with self.metrics.timer("input_io_time"):
+                        raw = orc.read_stripe(path, meta, stripe)
+                    rows = stripe.rows
+                    for s in range(0, rows, self.batch_rows):
+                        e = min(s + self.batch_rows, rows)
+                        cap = bucket_capacity(e - s)
+                        cols: List[Column] = []
+                        for f in self._schema.fields:
+                            if f.name not in raw:
+                                cols.append(self._null_column(f.dtype, cap))
+                                continue
+                            data, validity, lengths = raw[f.name]
+                            if f.dtype.is_string:
+                                d = np.zeros((cap, f.dtype.string_width), np.uint8)
+                                seg = data[s:e]
+                                d[: e - s, : min(seg.shape[1], f.dtype.string_width)] = seg[
+                                    :, : f.dtype.string_width
+                                ]
+                                cols.append(
+                                    Column(
+                                        f.dtype,
+                                        d,
+                                        _pad_1d(validity[s:e], cap),
+                                        _pad_1d(
+                                            np.minimum(lengths[s:e], f.dtype.string_width), cap
+                                        ),
+                                    )
+                                )
+                            else:
+                                cols.append(
+                                    Column(
+                                        f.dtype,
+                                        _pad_1d(
+                                            data[s:e].astype(f.dtype.np_dtype, copy=False), cap
+                                        ),
+                                        _pad_1d(validity[s:e], cap),
+                                    )
+                                )
+                        b = RecordBatch(self._schema, cols, e - s)
+                        self.metrics.add("output_rows", b.num_rows)
+                        yield b.to_device()
+
+        return stream()

@@ -141,6 +141,20 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
     if kind == "memory_scan":
         parts = RESOURCES.get(n.memory_scan.resource_id)
         return MemoryScanExec(parts, schema_from_proto(n.memory_scan.schema))
+    if kind in ("parquet_scan", "orc_scan"):
+        s = n.parquet_scan if kind == "parquet_scan" else n.orc_scan
+        pred = None
+        for e in s.predicate:
+            sub = expr_from_proto(e)
+            pred = sub if pred is None else (pred & sub)
+        groups = [g.split(";") if g else [] for g in s.file_groups]
+        if kind == "parquet_scan":
+            from ..ops import ParquetScanExec
+
+            return ParquetScanExec(groups, schema_from_proto(s.schema), pred)
+        from ..ops.orc_scan import OrcScanExec
+
+        return OrcScanExec(groups, schema_from_proto(s.schema), pred)
     if kind == "project":
         p = n.project
         return ProjectExec(plan_from_proto(p.input), [expr_from_proto(e) for e in p.exprs], list(p.names))

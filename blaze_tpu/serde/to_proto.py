@@ -137,8 +137,9 @@ def _partitioning_to_proto(p) -> pb.PartitioningProto:
 def plan_to_proto(node) -> pb.PhysicalPlanNode:
     from ..ops import (
         AggExec, CoalesceBatchesExec, DebugExec, EmptyPartitionsExec, ExpandExec,
-        FilterExec, GenerateExec, LimitExec, MemoryScanExec, ProjectExec,
-        RenameColumnsExec, SortExec, UnionExec, WindowExec,
+        FilterExec, GenerateExec, LimitExec, MemoryScanExec, OrcScanExec,
+        ParquetScanExec, ProjectExec, RenameColumnsExec, SortExec, UnionExec,
+        WindowExec,
     )
     from ..ops.joins import BroadcastJoinExec, HashJoinExec, SortMergeJoinExec
     from ..parallel.broadcast import IpcWriterExec
@@ -154,6 +155,13 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
         out.memory_scan.resource_id = rid
         out.memory_scan.schema.CopyFrom(schema_to_proto(node.schema))
         out.memory_scan.num_partitions = node.num_partitions()
+    elif isinstance(node, (ParquetScanExec, OrcScanExec)):
+        sub = out.parquet_scan if isinstance(node, ParquetScanExec) else out.orc_scan
+        sub.schema.CopyFrom(schema_to_proto(node.schema))
+        for g in node.file_groups:
+            sub.file_groups.append(";".join(g))
+        if node.predicate is not None:
+            sub.predicate.add().CopyFrom(expr_to_proto(node.predicate))
     elif isinstance(node, ProjectExec):
         out.project.input.CopyFrom(plan_to_proto(node.children[0]))
         for e in node.exprs:
