@@ -24,12 +24,41 @@ import numpy as np
 BLAZE_Q06_ROWS_PER_SEC_PER_NODE = 6_000_000_000 / 7.928 / 7  # ≈ 108.1e6
 
 
-def main():
+def _init_devices():
+    """Initialize a JAX backend, preferring the real TPU.
+
+    Round-1 failure mode: the axon TPU plugin can be transiently
+    UNAVAILABLE; ``jax.devices()`` then raised and the bench died before
+    printing its JSON line.  Retry a few times, then fall back to CPU so
+    a number is always produced (tagged with the backend used).
+    """
+    import time as _time
+
     import jax
 
-    jax.config.update("jax_enable_x64", True)
+    last_err = None
+    for attempt in range(3):
+        try:
+            devices = jax.devices()
+            return jax, devices, None
+        except RuntimeError as e:  # backend init failure
+            last_err = e
+            print(
+                f"# bench: backend init attempt {attempt + 1} failed: {e}",
+                file=sys.stderr,
+            )
+            _time.sleep(10 * (attempt + 1))
+    # fall back to CPU explicitly (the config, not the env var, is
+    # authoritative under the axon sitecustomize)
+    jax.config.update("jax_platforms", "cpu")
     devices = jax.devices()
-    on_tpu = any("tpu" in str(d).lower() for d in devices)
+    return jax, devices, f"tpu_unavailable: {last_err}"
+
+
+def main():
+    jax, devices, fallback_note = _init_devices()
+    jax.config.update("jax_enable_x64", True)
+    on_tpu = any("tpu" in str(d).lower() or "axon" in str(d).lower() for d in devices)
 
     import jax.numpy as jnp
 
@@ -78,17 +107,39 @@ def main():
 
     rows_per_sec = n_rows / dt
     vs = rows_per_sec / BLAZE_Q06_ROWS_PER_SEC_PER_NODE
-    print(
-        json.dumps(
-            {
-                "metric": "tpch_q06_rows_per_sec_per_chip",
-                "value": round(rows_per_sec, 1),
-                "unit": "rows/s",
-                "vs_baseline": round(vs, 3),
-            }
-        )
-    )
+    # bytes actually touched by the q06 pipeline: the 5 referenced
+    # lineitem columns (shipdate i32, discount/quantity/extendedprice
+    # i64) + validity bytes — lets MFU/bandwidth be judged vs rows/s
+    bytes_per_row = 4 + 8 + 8 + 8 + 4
+    result = {
+        "metric": "tpch_q06_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 3),
+        "bytes_per_sec": round(rows_per_sec * bytes_per_row, 1),
+        "backend": "tpu" if on_tpu else "cpu",
+    }
+    if fallback_note:
+        result["note"] = fallback_note[:500]
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # never die silently: emit a structured line
+        import traceback
+
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": "tpch_q06_rows_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "rows/s",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:500],
+                }
+            )
+        )
+        sys.exit(1)
