@@ -17,6 +17,7 @@ from the reference's i128; roadmap: two-limb int128 emulation).
 from __future__ import annotations
 
 import datetime
+import logging
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -630,6 +631,14 @@ def host_eval(expr: Expr, batch) -> Column:
         w = out_dt.string_width
         # fixed-width columns: a result longer than the declared width
         # cannot be stored — degrade to NULL rather than corrupt
+        n_truncated = sum(
+            1 for v in out_vals if v is not None and len(v.encode("utf-8")) > w
+        )
+        if n_truncated:
+            logging.getLogger(__name__).warning(
+                "%s: %d result(s) exceeded string width %d and were nulled",
+                expr.name, n_truncated, w,
+            )
         out_vals = [
             v if v is None or len(v.encode("utf-8")) <= w else None for v in out_vals
         ]

@@ -371,7 +371,10 @@ def _check_overflow(expr, schema, cols, n, lower_fn):
     out_t = _check_overflow_t(expr, None)
     assert c.dtype.is_decimal
     data = rescale_decimal(c.data, c.dtype.scale, out_t.scale)
-    limit = jnp.int64(10 ** min(out_t.precision, 18))
+    if out_t.precision >= 19:
+        # any int64 fits 19 digits: no magnitude check (10**19 > 2**63-1)
+        return Column(out_t, data, c.validity)
+    limit = jnp.int64(10**out_t.precision)
     ok = (data < limit) & (data > -limit)
     return Column(out_t, jnp.where(ok, data, jnp.int64(0)), c.validity & ok)
 

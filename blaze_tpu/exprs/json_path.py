@@ -24,7 +24,15 @@ Step = Tuple  # ("key", name) | ("index", i) | ("wild",)
 
 
 def parse_path(path: str) -> Optional[List[Step]]:
-    """Parse a JSON path; None if malformed (Spark yields NULL)."""
+    """Parse a JSON path; None if malformed (Spark yields NULL).
+
+    Mirrors the reference matcher parser
+    (``spark_get_json_object.rs:300-380``): ``.`` immediately followed
+    by ``[`` is skipped (``$.a.[0].x`` is valid), ``[]``/``[*]`` is
+    SubscriptAll, bracket subscripts must parse as unsigned integers
+    (no quoted keys, no whitespace), and ``.*`` is the literal child
+    key ``"*"`` — Hive UDFJson has no dot-wildcard.
+    """
     if not path or path[0] != "$":
         return None
     steps: List[Step] = []
@@ -34,64 +42,74 @@ def parse_path(path: str) -> Optional[List[Step]]:
         c = path[i]
         if c == ".":
             i += 1
+            if i < n and path[i] == "[":
+                continue  # $.a.[0] — dot before bracket is skipped
             j = i
             while j < n and path[j] not in ".[":
                 j += 1
             name = path[i:j]
             if not name:
                 return None
-            steps.append(("wild",) if name == "*" else ("key", name))
+            steps.append(("key", name))
             i = j
         elif c == "[":
             j = path.find("]", i)
             if j < 0:
                 return None
-            inner = path[i + 1 : j].strip()
-            if inner == "*":
+            inner = path[i + 1 : j]
+            if inner == "*" or inner == "":
                 steps.append(("wild",))
-            elif len(inner) >= 2 and inner[0] == "'" and inner[-1] == "'":
-                steps.append(("key", inner[1:-1]))
+            elif inner.isdigit():
+                steps.append(("index", int(inner)))
             else:
-                try:
-                    steps.append(("index", int(inner)))
-                except ValueError:
-                    return None
+                return None
             i = j + 1
         else:
             return None
     return steps
 
 
-def _eval(obj, steps: Sequence[Step]) -> List:
-    if not steps:
-        return [obj]
-    step, rest = steps[0], steps[1:]
-    kind = step[0]
-    if kind == "key":
-        name = step[1]
-        if isinstance(obj, dict):
-            return _eval(obj[name], rest) if name in obj else []
-        if isinstance(obj, list):
-            # Spark flattens member access through arrays:
-            # $.a.b over {"a":[{"b":1},{"b":2}]} -> [1,2]
-            out: List = []
-            for el in obj:
-                if isinstance(el, dict) and name in el:
-                    out.extend(_eval(el[name], rest))
-            return out
-        return []
-    if kind == "index":
-        i = step[1]
-        if isinstance(obj, list) and 0 <= i < len(obj):
-            return _eval(obj[i], rest)
-        return []
-    # wildcard
-    if isinstance(obj, list):
-        out = []
-        for el in obj:
-            out.extend(_eval(el, rest))
-        return out
-    return []
+def _fold(value, steps: Sequence[Step]):
+    """Fold the matcher chain over one JSON value.
+
+    ≙ ``HiveGetJsonObjectMatcher::evaluate`` (spark_get_json_object.rs:
+    382-437): each step maps one value to one value, with ``None``
+    standing for both JSON null and a miss.  Child over an array maps
+    each object element, drops nulls, flattens nested arrays ONE level,
+    and always yields a JSON array (even for a single match);
+    SubscriptAll is the identity on arrays.
+    """
+    for step in steps:
+        kind = step[0]
+        if kind == "key":
+            name = step[1]
+            if isinstance(value, dict):
+                value = value.get(name)
+            elif isinstance(value, list):
+                vs: List = []
+                for item in value:
+                    v = item.get(name) if isinstance(item, dict) else None
+                    if v is None:
+                        continue
+                    if isinstance(v, list):
+                        vs.extend(v)  # flat_map one level (hive UDFJson)
+                    else:
+                        vs.append(v)
+                value = vs if vs else None
+            else:
+                value = None
+        elif kind == "index":
+            i = step[1]
+            if isinstance(value, list) and i < len(value):
+                value = value[i]
+            else:
+                value = None
+        else:  # wild: identity on arrays, null otherwise
+            if not isinstance(value, list):
+                value = None
+        if value is None:
+            return None
+    return value
 
 
 def _render_single(v) -> Optional[str]:
@@ -101,7 +119,7 @@ def _render_single(v) -> Optional[str]:
         return v  # unquoted
     if isinstance(v, bool):
         return "true" if v else "false"
-    return json.dumps(v, separators=(",", ":"))
+    return json.dumps(v, separators=(",", ":"), ensure_ascii=False)
 
 
 def get_json_object(
@@ -124,12 +142,7 @@ def get_json_object(
         obj = json.loads(json_str)
     except (ValueError, TypeError):
         return None
-    matches = _eval(obj, steps)
-    if not matches:
-        return None
-    if len(matches) == 1:
-        return _render_single(matches[0])
-    return json.dumps(matches, separators=(",", ":"))
+    return _render_single(_fold(obj, steps))
 
 
 def parse_json(json_str: Optional[str]) -> Optional[str]:
@@ -141,6 +154,6 @@ def parse_json(json_str: Optional[str]) -> Optional[str]:
     if json_str is None:
         return None
     try:
-        return json.dumps(json.loads(json_str), separators=(",", ":"))
+        return json.dumps(json.loads(json_str), separators=(",", ":"), ensure_ascii=False)
     except (ValueError, TypeError):
         return None

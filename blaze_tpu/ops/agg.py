@@ -640,23 +640,28 @@ class _AggConsumer(MemConsumer):
             return s
 
     def set_state(self, state: RecordBatch) -> None:
+        # state handoff and accounting are atomic w.r.t. spill(): a
+        # spill landing between them would otherwise leave mem_used
+        # reporting phantom memory after the state was already cleared
         with self._lock:
             self._state = state
-        self.update_mem_used(state.memory_size())
+            self.set_mem_used_no_trigger(state.memory_size())
+        self.trigger_spill_check()
 
     def spill(self) -> int:
         with self._lock:
             state, self._state = self._state, None
-        if state is None:
-            return 0
-        freed = state.memory_size()
+            if state is None:
+                return 0
+            freed = state.memory_size()
+            self.set_mem_used_no_trigger(0)
+        # serialize outside the lock: this thread owns `state` now
         sp = try_new_spill()
         sp.write_frame(serialize_batch(state))
         sp.complete()
         self._spills.append(sp)
         self._agg.metrics.add("spill_count", 1)
         self._agg.metrics.add("spilled_bytes", sp.size)
-        self.update_mem_used(0)
         return freed
 
     def drain_spills(self) -> List[RecordBatch]:

@@ -26,7 +26,9 @@ def _stat_comparable(dtype: DataType, v):
     if v is None:
         return None
     if dtype.is_string and isinstance(v, (bytes, bytearray)):
-        return bytes(v)
+        # predicate literals are python str: decode so comparisons in
+        # _stripe_maybe_match actually fire instead of raising TypeError
+        return bytes(v).decode("utf-8", "surrogateescape")
     return v
 
 

@@ -144,6 +144,23 @@ class MemConsumer:
         else:
             self._mem_used = new_used
 
+    def set_mem_used_no_trigger(self, new_used: int) -> None:
+        """Record usage WITHOUT running the watermark check.  Safe to
+        call while holding the consumer's own state lock: it never
+        calls back into any consumer's spill().  Pair with
+        trigger_spill_check() once the state lock is released."""
+        mgr = self._manager
+        if mgr is not None:
+            with mgr._lock:
+                self._mem_used = new_used
+        else:
+            self._mem_used = new_used
+
+    def trigger_spill_check(self) -> None:
+        mgr = self._manager
+        if mgr is not None:
+            mgr._maybe_spill()
+
     def spill(self) -> int:
         """Spill buffered state; return bytes freed."""
         raise NotImplementedError
@@ -195,19 +212,27 @@ class MemManager:
     def _update(self, consumer: MemConsumer, new_used: int) -> None:
         with self._lock:
             consumer._mem_used = new_used
+        self._maybe_spill()
+
+    def _maybe_spill(self) -> None:
+        with self._lock:
             over = self._total_used() - int(self.total * self.watermark)
             if over <= 0:
                 return
             victims = sorted(self._consumers, key=lambda c: -c._mem_used)
-        # spill outside the lock: consumers re-enter update_mem_used
+        # spill outside the lock: consumers re-enter accounting; a
+        # concurrent spill of the same victim is benign (its spill()
+        # finds no state and returns 0, which we don't count)
         for v in victims:
             if over <= 0:
                 break
             if v._mem_used == 0:
                 continue
             freed = v.spill()
-            self.spill_count += 1
-            self.spilled_bytes += freed
+            if freed > 0:
+                with self._lock:
+                    self.spill_count += 1
+                    self.spilled_bytes += freed
             over -= freed
 
 

@@ -49,6 +49,19 @@ def test_check_overflow_nulls_on_overflow():
     assert d["c"] == [9999, None, -9999, None, None]
 
 
+def test_check_overflow_wide_precision_keeps_large_values():
+    # decimal(22,2): any int64 unscaled value fits 22 digits; values in
+    # [10^18, 2^63) must NOT be nulled (Spark CheckOverflow keeps them)
+    schema = Schema([Field("d", DataType.decimal(20, 2))])
+    big = 2_500_000_000_000_000_000  # 2.5e18 unscaled, > 10**18
+    d = run_project(
+        {"d": [big / 100.0]},  # 2.5e16 == 2^15 * 5^17: exact in float64
+        schema,
+        [ScalarFunc("check_overflow", [col("d"), Lit(22), Lit(2)]).alias("c")],
+    )
+    assert d["c"] == [big]
+
+
 def test_nullif():
     schema = Schema([Field("a", DataType.int64()), Field("b", DataType.int64())])
     d = run_project(

@@ -25,8 +25,15 @@ from blaze_tpu.schema import DataType, Field, Schema
         ('{"a":[1,2,3]}', "$.a[1]", "2"),
         ('{"a":[1,2,3]}', "$.a[*]", "[1,2,3]"),
         ('{"a":[{"b":1},{"b":2}]}', "$.a[*].b", "[1,2]"),
-        ('{"a":[{"b":1}]}', "$.a[*].b", "1"),       # single match unwrapped
+        ('{"a":[{"b":1}]}', "$.a[*].b", "[1]"),     # child-over-array stays an array
+        ('{"a":[{"b":1}]}', "$.a.b", "[1]"),        # ditto without [*]
         ('{"a":[{"b":1},{"b":2}]}', "$.a.b", "[1,2]"),  # flatten through array
+        ('{"a":[{"b":[1,2]},{"b":3}]}', "$.a.b", "[1,2,3]"),  # nested arrays flat_mapped one level
+        ('{"a":[{"b":[[1],2]}]}', "$.a.b", "[[1],2]"),  # ...exactly one level
+        ('{"a":[1,2]}', "$.a.[0]", "1"),            # dot-before-bracket accepted
+        ('{"a":[1,2]}', "$.a[]", "[1,2]"),          # [] == [*]
+        ('{"*":7}', "$.*", "7"),                    # .* is a literal key, not a wildcard
+        ('{"名":"ü"}', "$", '{"名":"ü"}'),           # raw UTF-8, not \\uXXXX escapes
         ('{"a":"b"}', "$", '{"a":"b"}'),
         ('{"a":1.5}', "$.a", "1.5"),
         ('{"a":true}', "$.a", "true"),
@@ -34,7 +41,7 @@ from blaze_tpu.schema import DataType, Field, Schema
         ('{"a":1}', "$.b", None),
         ("not json", "$.a", None),
         ('{"a":["x","y"]}', "$.a[*]", '["x","y"]'),  # strings requoted in arrays
-        ('{"a":{"b":2}}', "$['a']['b']", "2"),
+        ('{"a":{"b":2}}', "$['a']['b']", None),      # quoted keys rejected (hive UDFJson)
         ('{"a":[1,2]}', "$.a[5]", None),
         ('{"a":1}', "a.b", None),                    # malformed path
         ('{"a":1}', "$.", None),
@@ -53,9 +60,15 @@ def test_parse_json_normalizes():
 
 
 def test_parse_path_forms():
-    assert parse_path("$.a[0]['b c'].d[*]") == [
-        ("key", "a"), ("index", 0), ("key", "b c"), ("key", "d"), ("wild",),
+    assert parse_path("$.a[0].d[*]") == [
+        ("key", "a"), ("index", 0), ("key", "d"), ("wild",),
     ]
+    assert parse_path("$.a.[3].b[]") == [
+        ("key", "a"), ("index", 3), ("key", "b"), ("wild",),
+    ]
+    assert parse_path("$['a']") is None  # no quoted keys (hive UDFJson)
+    assert parse_path("$.a[-1]") is None
+    assert parse_path("$.a[ 1 ]") is None
     assert parse_path("") is None
     assert parse_path("$x") is None
 
