@@ -45,61 +45,78 @@ def bucket_capacity(n: int) -> int:
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class Column:
-    """One column: data + validity (+ byte lengths for strings).
+    """One column: data + validity (+ byte lengths for strings;
+    + children for nested types).
 
     ``dtype`` is static metadata (pytree aux), buffers are leaves.
+
+    Nested layouts (fixed max-elements ``M = dtype.max_elems``, padded —
+    the TPU-first re-design of Arrow's variable-length List/Map/Struct,
+    ≙ the reference's nested Arrow columns in blaze.proto:738-941):
+
+    - ARRAY(T, M):  ``data=None``, ``validity (cap,)`` row validity,
+      ``lengths (cap,)`` element counts, ``children=(elem,)`` where
+      ``elem`` is a Column of T whose buffers carry a leading element
+      axis: data ``(cap, M)`` (strings ``(cap, M, W)``), validity
+      ``(cap, M)`` element validity, lengths ``(cap, M)`` for strings.
+    - MAP(K, V, M): like ARRAY with ``children=(keys, values)`` sharing
+      ``lengths``; keys are never null per Spark map semantics.
+    - STRUCT(fields): ``data=None``, ``validity (cap,)``,
+      ``children`` = one regular Column per field.
     """
 
     dtype: DataType
-    data: Array                       # (cap,) or (cap, W) for strings
+    data: Optional[Array]             # (cap,) / (cap, W) strings / None nested
     validity: Array                   # bool (cap,)
-    lengths: Optional[Array] = None   # int32 (cap,) — strings only
+    lengths: Optional[Array] = None   # int32: (cap,) strings+array/map counts
+    children: Optional[Tuple["Column", ...]] = None  # nested types only
 
-    # -- pytree protocol --
+    # -- pytree protocol (None slots are empty subtrees; child Columns
+    # flatten recursively) --
     def tree_flatten(self):
-        if self.lengths is not None:
-            return (self.data, self.validity, self.lengths), (self.dtype, True)
-        return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.lengths, self.children), self.dtype
 
     @classmethod
-    def tree_unflatten(cls, aux, children):
-        dtype, has_len = aux
-        if has_len:
-            data, validity, lengths = children
-            return cls(dtype, data, validity, lengths)
-        data, validity = children
-        return cls(dtype, data, validity, None)
+    def tree_unflatten(cls, aux, leaves):
+        data, validity, lengths, children = leaves
+        return cls(aux, data, validity, lengths, children)
 
     @property
     def capacity(self) -> int:
-        return int(self.data.shape[0])
+        return int(self.validity.shape[0])
 
     def to_device(self) -> "Column":
-        as_j = lambda a: a if isinstance(a, jnp.ndarray) else jnp.asarray(a)
+        as_j = lambda a: None if a is None else (a if isinstance(a, jnp.ndarray) else jnp.asarray(a))
         return Column(
             self.dtype,
             as_j(self.data),
             as_j(self.validity),
-            None if self.lengths is None else as_j(self.lengths),
+            as_j(self.lengths),
+            None if self.children is None else tuple(c.to_device() for c in self.children),
         )
 
     def to_host(self) -> "Column":
+        as_n = lambda a: None if a is None else np.asarray(a)
         return Column(
             self.dtype,
-            np.asarray(self.data),
-            np.asarray(self.validity),
-            None if self.lengths is None else np.asarray(self.lengths),
+            as_n(self.data),
+            as_n(self.validity),
+            as_n(self.lengths),
+            None if self.children is None else tuple(c.to_host() for c in self.children),
         )
 
     def take(self, indices: Array) -> "Column":
         """Gather rows by index (indices must point at valid rows or be
-        masked by the caller)."""
+        masked by the caller).  Nested children carry a leading row
+        axis, so the same axis-0 gather applies recursively."""
         idx = indices
+        g = lambda a: None if a is None else jnp.take(a, idx, axis=0)
         return Column(
             self.dtype,
-            jnp.take(self.data, idx, axis=0),
-            jnp.take(self.validity, idx, axis=0),
-            None if self.lengths is None else jnp.take(self.lengths, idx, axis=0),
+            g(self.data),
+            g(self.validity),
+            g(self.lengths),
+            None if self.children is None else tuple(c.take(idx) for c in self.children),
         )
 
 
@@ -164,6 +181,153 @@ def column_from_strings(
     return Column(dtype, data, validity, lengths)
 
 
+def _reshape_leading(col: Column, cap: int, m: int) -> Column:
+    """Reshape a flat (cap*m, ...) column into element layout (cap, m, ...)."""
+    rs = lambda a: None if a is None else np.asarray(a).reshape((cap, m) + a.shape[1:])
+    return Column(
+        col.dtype,
+        rs(col.data),
+        rs(col.validity),
+        rs(col.lengths),
+        None if col.children is None else tuple(_reshape_leading(c, cap, m) for c in col.children),
+    )
+
+
+def _flatten_leading(col: Column) -> Column:
+    """Inverse of _reshape_leading: (cap, m, ...) -> (cap*m, ...)."""
+    fl = lambda a: None if a is None else np.asarray(a).reshape((-1,) + a.shape[2:])
+    return Column(
+        col.dtype,
+        fl(col.data),
+        fl(col.validity),
+        fl(col.lengths),
+        None if col.children is None else tuple(_flatten_leading(c) for c in col.children),
+    )
+
+
+def _scalar_to_physical(dtype: DataType, v):
+    if v is None:
+        return 0
+    if dtype.is_decimal:
+        return int(round(v * 10**dtype.scale))
+    if dtype.kind == TypeKind.BOOL:
+        return bool(v)
+    return v
+
+
+def column_from_pylist(dtype: DataType, values: Sequence, capacity: Optional[int] = None) -> Column:
+    """Build a host column of any type (nested included) from python
+    values.  None = null; arrays are python lists, maps are dicts
+    (insertion-ordered), structs are dicts keyed by field name."""
+    n = len(values)
+    cap = capacity or bucket_capacity(n)
+    k = dtype.kind
+    if k == TypeKind.ARRAY:
+        m = dtype.max_elems
+        validity = np.zeros(cap, np.bool_)
+        lengths = np.zeros(cap, np.int32)
+        flat: List = [None] * (cap * m)
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            if len(v) > m:
+                raise ValueError(f"array of {len(v)} elements exceeds max_elems {m}")
+            validity[i] = True
+            lengths[i] = len(v)
+            for j, e in enumerate(v):
+                flat[i * m + j] = e
+        elem = _reshape_leading(column_from_pylist(dtype.elem, flat, capacity=cap * m), cap, m)
+        return Column(dtype, None, validity, lengths, (elem,))
+    if k == TypeKind.MAP:
+        m = dtype.max_elems
+        validity = np.zeros(cap, np.bool_)
+        lengths = np.zeros(cap, np.int32)
+        fkeys: List = [None] * (cap * m)
+        fvals: List = [None] * (cap * m)
+        for i, v in enumerate(values):
+            if v is None:
+                continue
+            items = list(v.items()) if isinstance(v, dict) else list(v)
+            if len(items) > m:
+                raise ValueError(f"map of {len(items)} entries exceeds max_elems {m}")
+            validity[i] = True
+            lengths[i] = len(items)
+            for j, (kk, vv) in enumerate(items):
+                fkeys[i * m + j] = kk
+                fvals[i * m + j] = vv
+        keys = _reshape_leading(column_from_pylist(dtype.key, fkeys, capacity=cap * m), cap, m)
+        vals = _reshape_leading(column_from_pylist(dtype.value, fvals, capacity=cap * m), cap, m)
+        return Column(dtype, None, validity, lengths, (keys, vals))
+    if k == TypeKind.STRUCT:
+        validity = np.array([v is not None for v in values] + [False] * (cap - n), np.bool_)
+        children = []
+        for f in dtype.struct_fields:
+            child_vals = [None if v is None else v.get(f.name) for v in values]
+            children.append(column_from_pylist(f.dtype, child_vals, capacity=cap))
+        return Column(dtype, None, validity, None, tuple(children))
+    if dtype.is_string:
+        return column_from_strings(values, dtype=dtype, capacity=cap)
+    validity = np.array([v is not None for v in values] + [False] * (cap - n), np.bool_)
+    vals = np.array(
+        [_scalar_to_physical(dtype, v) for v in values] + [0] * (cap - n),
+        dtype=dtype.np_dtype,
+    )
+    return column_from_numpy(dtype, vals[:n], validity[:n], cap)
+
+
+def column_to_pylist(col: Column, num_rows: int) -> List:
+    """Materialize any column (nested included) as python values.
+    Decimals come back unscaled (exact ints), same as batch_to_pydict."""
+    c = col.to_host()
+    dtype = c.dtype
+    k = dtype.kind
+    if k == TypeKind.ARRAY:
+        m = dtype.max_elems
+        elems = column_to_pylist(_flatten_leading(c.children[0]), num_rows * m)
+        out: List = []
+        for i in range(num_rows):
+            if not c.validity[i]:
+                out.append(None)
+            else:
+                out.append([elems[i * m + j] for j in range(int(c.lengths[i]))])
+        return out
+    if k == TypeKind.MAP:
+        m = dtype.max_elems
+        keys = column_to_pylist(_flatten_leading(c.children[0]), num_rows * m)
+        vals = column_to_pylist(_flatten_leading(c.children[1]), num_rows * m)
+        out = []
+        for i in range(num_rows):
+            if not c.validity[i]:
+                out.append(None)
+            else:
+                out.append(
+                    {keys[i * m + j]: vals[i * m + j] for j in range(int(c.lengths[i]))}
+                )
+        return out
+    if k == TypeKind.STRUCT:
+        kids = [column_to_pylist(ch, num_rows) for ch in c.children]
+        out = []
+        for i in range(num_rows):
+            if not c.validity[i]:
+                out.append(None)
+            else:
+                out.append({f.name: kids[fi][i] for fi, f in enumerate(dtype.struct_fields)})
+        return out
+    if dtype.is_string:
+        return strings_to_list(c, num_rows)
+    out = []
+    for i in range(num_rows):
+        if not c.validity[i]:
+            out.append(None)
+        elif dtype.kind == TypeKind.BOOL:
+            out.append(bool(c.data[i]))
+        elif dtype.is_float:
+            out.append(float(c.data[i]))
+        else:
+            out.append(int(c.data[i]))
+    return out
+
+
 def strings_to_list(col: Column, num_rows: int) -> List[Optional[str]]:
     data = np.asarray(col.data)
     lengths = np.asarray(col.lengths)
@@ -226,12 +390,12 @@ class RecordBatch:
     def with_capacity(self, cap: int) -> "RecordBatch":
         """Pad or shrink buffers to capacity ``cap`` (>= num_rows)."""
         assert cap >= self.num_rows
-        cols = []
-        for c in self.columns:
+
+        def fix_col(c: Column) -> Column:
+            # every buffer (children's included) shares the leading row axis
             cur = c.capacity
             if cur == cap:
-                cols.append(c)
-                continue
+                return c
 
             def fix(a):
                 if a is None:
@@ -241,19 +405,27 @@ class RecordBatch:
                     return jnp.pad(a, pad)
                 return a[:cap]
 
-            cols.append(Column(c.dtype, fix(c.data), fix(c.validity), fix(c.lengths)))
-        return RecordBatch(self.schema, cols, self.num_rows)
+            return Column(c.dtype, fix(c.data), fix(c.validity), fix(c.lengths),
+                          None if c.children is None else tuple(fix_col(k) for k in c.children))
+
+        return RecordBatch(self.schema, [fix_col(c) for c in self.columns], self.num_rows)
 
     def memory_size(self) -> int:
         """Deep buffer size in bytes (≙ datafusion-ext-commons
         array_size.rs, which drives spill decisions)."""
-        total = 0
-        for c in self.columns:
-            total += c.data.size * c.data.dtype.itemsize
-            total += c.validity.size
+
+        def col_size(c: Column) -> int:
+            s = 0
+            if c.data is not None:
+                s += c.data.size * c.data.dtype.itemsize
+            s += c.validity.size
             if c.lengths is not None:
-                total += c.lengths.size * 4
-        return total
+                s += c.lengths.size * 4
+            if c.children is not None:
+                s += sum(col_size(k) for k in c.children)
+            return s
+
+        return sum(col_size(c) for c in self.columns)
 
 
 def batch_from_pydict(
@@ -269,26 +441,7 @@ def batch_from_pydict(
     for f in schema.fields:
         values = data[f.name]
         assert len(values) == n
-        if f.dtype.is_string:
-            cols.append(column_from_strings(values, dtype=f.dtype, capacity=cap))
-        else:
-            validity = np.array([v is not None for v in values], dtype=np.bool_)
-            if f.dtype.is_decimal:
-                # python ints/floats are interpreted as logical values and
-                # scaled to the unscaled representation
-                scale = 10 ** f.dtype.scale
-                vals = np.array(
-                    [int(round(v * scale)) if v is not None else 0 for v in values],
-                    dtype=np.int64,
-                )
-            elif f.dtype.kind == TypeKind.BOOL:
-                vals = np.array([bool(v) if v is not None else False for v in values])
-            else:
-                vals = np.array(
-                    [v if v is not None else 0 for v in values],
-                    dtype=f.dtype.np_dtype,
-                )
-            cols.append(column_from_numpy(f.dtype, vals, validity, cap))
+        cols.append(column_from_pylist(f.dtype, values, capacity=cap))
     return RecordBatch(schema, [c.to_device() for c in cols], n)
 
 
@@ -299,21 +452,54 @@ def batch_to_pydict(batch: RecordBatch) -> Dict[str, List]:
     b = batch.to_host()
     out: Dict[str, List] = {}
     for f, c in zip(b.schema.fields, b.columns):
-        if f.dtype.is_string:
-            out[f.name] = strings_to_list(c, b.num_rows)
-        else:
-            vals = []
-            for i in range(b.num_rows):
-                if not c.validity[i]:
-                    vals.append(None)
-                elif f.dtype.kind == TypeKind.BOOL:
-                    vals.append(bool(c.data[i]))
-                elif f.dtype.is_float:
-                    vals.append(float(c.data[i]))
-                else:
-                    vals.append(int(c.data[i]))
-            out[f.name] = vals
+        out[f.name] = column_to_pylist(c, b.num_rows)
     return out
+
+
+def _concat_host_cols(
+    dtype: DataType, parts: List[Column], ns: List[int], cap: int
+) -> Column:
+    """Concatenate column parts (host) along the row axis, padding to
+    ``cap``.  Nested children share the leading row axis, so recursion
+    is uniform; top-level strings additionally merge differing padded
+    widths (element strings have dtype-fixed width)."""
+    validity = _pad_1d(
+        np.concatenate([np.asarray(c.validity)[:n] for c, n in zip(parts, ns)]), cap
+    )
+    lengths = None
+    if parts[0].lengths is not None:
+        lengths = _pad_1d(
+            np.concatenate([np.asarray(c.lengths)[:n] for c, n in zip(parts, ns)]), cap
+        )
+    if dtype.is_nested:
+        if dtype.kind == TypeKind.ARRAY:
+            kid_types = [dtype.elem]
+        elif dtype.kind == TypeKind.MAP:
+            kid_types = [dtype.key, dtype.value]
+        else:
+            kid_types = [f.dtype for f in dtype.struct_fields]
+        children = tuple(
+            _concat_host_cols(kt, [c.children[ki] for c in parts], ns, cap)
+            for ki, kt in enumerate(kid_types)
+        )
+        return Column(dtype, None, validity, lengths, children)
+    if dtype.is_string:
+        # padded widths can differ per batch at ANY nesting depth (a
+        # runtime-width string column survives as a struct child or
+        # array element): merge into the max width along the last axis
+        parts_data = [np.asarray(c.data)[:n] for c, n in zip(parts, ns)]
+        width = max(p.shape[-1] for p in parts_data)
+        mid = parts_data[0].shape[1:-1]
+        data = np.zeros((cap,) + mid + (width,), dtype=np.uint8)
+        off = 0
+        for p in parts_data:
+            data[off : off + p.shape[0], ..., : p.shape[-1]] = p
+            off += p.shape[0]
+        return Column(dtype, data, validity, lengths)
+    data = _pad_1d(
+        np.concatenate([np.asarray(c.data)[:n] for c, n in zip(parts, ns)]), cap
+    )
+    return Column(dtype, data, validity, lengths)
 
 
 def concat_batches(batches: Sequence[RecordBatch]) -> RecordBatch:
@@ -322,27 +508,9 @@ def concat_batches(batches: Sequence[RecordBatch]) -> RecordBatch:
     schema = batches[0].schema
     n = sum(b.num_rows for b in batches)
     cap = bucket_capacity(n)
+    ns = [b.num_rows for b in batches]
     cols: List[Column] = []
     for ci, f in enumerate(schema.fields):
-        parts_data, parts_valid, parts_len = [], [], []
-        for b in batches:
-            c = b.columns[ci].to_host()
-            parts_data.append(np.asarray(c.data)[: b.num_rows])
-            parts_valid.append(np.asarray(c.validity)[: b.num_rows])
-            if c.lengths is not None:
-                parts_len.append(np.asarray(c.lengths)[: b.num_rows])
-        if f.dtype.is_string:
-            width = max(p.shape[1] for p in parts_data)
-            data = np.zeros((cap, width), dtype=np.uint8)
-            off = 0
-            for p in parts_data:
-                data[off : off + p.shape[0], : p.shape[1]] = p
-                off += p.shape[0]
-            lengths = _pad_1d(np.concatenate(parts_len), cap)
-            validity = _pad_1d(np.concatenate(parts_valid), cap)
-            cols.append(Column(f.dtype, data, validity, lengths).to_device())
-        else:
-            data = _pad_1d(np.concatenate(parts_data), cap)
-            validity = _pad_1d(np.concatenate(parts_valid), cap)
-            cols.append(Column(f.dtype, data, validity).to_device())
+        parts = [b.columns[ci].to_host() for b in batches]
+        cols.append(_concat_host_cols(f.dtype, parts, ns, cap).to_device())
     return RecordBatch(schema, cols, n)

@@ -63,6 +63,11 @@ PALLAS_ENABLE = ConfEntry("spark.blaze.tpu.pallas.enable", True, _bool)
 INPUT_BATCH_STATISTICS = ConfEntry("spark.blaze.inputBatchStatistics", False, _bool)
 UDF_WRAPPER_NUM_THREADS = ConfEntry("spark.blaze.udfWrapperNumThreads", 1, int)
 SMJ_FALLBACK_ENABLE = ConfEntry("spark.blaze.smjfallback.enable", True, _bool)
+# fixed per-group element budget for collect_list/collect_set results
+# (the reference's lists are unbounded; the padded device layout is not —
+# elements past the budget are SILENTLY DROPPED: raise this knob when a
+# query's groups can exceed it)
+COLLECT_MAX_ELEMS = ConfEntry("spark.blaze.collect.maxElems", 64, int)
 SUGGESTED_BATCH_MEM_SIZE = ConfEntry("spark.blaze.suggested.batch.mem.size", 8 << 20, int)
 TOKIO_NUM_WORKER_THREADS = ConfEntry("spark.blaze.tokio.num.worker.threads", 2, int)
 

@@ -43,11 +43,15 @@ from .ir import (
     Cast,
     Col,
     Expr,
+    GetIndexedField,
+    GetMapValue,
+    GetStructField,
     InList,
     IsNotNull,
     IsNull,
     Like,
     Lit,
+    NamedStruct,
     Not,
     ScalarFunc,
 )
@@ -161,6 +165,27 @@ def infer_dtype(expr: Expr, schema: Schema) -> DataType:
         from .functions import infer_func_dtype
 
         return infer_func_dtype(expr, schema)
+    if isinstance(expr, GetIndexedField):
+        t = infer_dtype(expr.child, schema)
+        assert t.kind == TypeKind.ARRAY, f"get_item over {t!r}"
+        return t.elem
+    if isinstance(expr, GetMapValue):
+        t = infer_dtype(expr.child, schema)
+        assert t.kind == TypeKind.MAP, f"map_value over {t!r}"
+        return t.value
+    if isinstance(expr, GetStructField):
+        t = infer_dtype(expr.child, schema)
+        assert t.kind == TypeKind.STRUCT, f"get_field over {t!r}"
+        for f in t.struct_fields:
+            if f.name == expr.name:
+                return f.dtype
+        raise KeyError(f"no struct field {expr.name!r} in {t!r}")
+    if isinstance(expr, NamedStruct):
+        from ..schema import Field as _Field
+
+        return DataType.struct(
+            [_Field(nm, infer_dtype(e, schema)) for nm, e in zip(expr.names, expr.exprs)]
+        )
     from .ir import PythonUdf
 
     if isinstance(expr, PythonUdf):
@@ -190,8 +215,34 @@ def _coerce(col: Column, to: DataType) -> Column:
     return lower_cast(col, to)
 
 
+def null_nested_column(dtype: DataType, shape: Tuple[int, ...]) -> Column:
+    """All-null device column of any dtype with leading dims ``shape``
+    (element layouts recurse with an extra axis)."""
+    zeros_b = jnp.zeros(shape, jnp.bool_)
+    if dtype.kind == TypeKind.ARRAY:
+        kid = null_nested_column(dtype.elem, shape + (dtype.max_elems,))
+        return Column(dtype, None, zeros_b, jnp.zeros(shape, jnp.int32), (kid,))
+    if dtype.kind == TypeKind.MAP:
+        k = null_nested_column(dtype.key, shape + (dtype.max_elems,))
+        v = null_nested_column(dtype.value, shape + (dtype.max_elems,))
+        return Column(dtype, None, zeros_b, jnp.zeros(shape, jnp.int32), (k, v))
+    if dtype.kind == TypeKind.STRUCT:
+        kids = tuple(null_nested_column(f.dtype, shape) for f in dtype.struct_fields)
+        return Column(dtype, None, zeros_b, None, kids)
+    if dtype.is_string:
+        return Column(
+            dtype,
+            jnp.zeros(shape + (dtype.string_width,), jnp.uint8),
+            zeros_b,
+            jnp.zeros(shape, jnp.int32),
+        )
+    return Column(dtype, jnp.zeros(shape, dtype.np_dtype), zeros_b)
+
+
 def _lit_column(value, dtype: DataType, n: int) -> Column:
     if value is None:
+        if dtype.is_nested:
+            return null_nested_column(dtype, (n,))
         return _coerce(Column(DataType.null(), jnp.zeros(n, jnp.bool_), jnp.zeros(n, jnp.bool_)), dtype)
     valid = jnp.ones(n, jnp.bool_)
     if dtype.is_string:
@@ -409,7 +460,88 @@ def lower(expr: Expr, schema: Schema, cols: Dict[str, Column], n: int) -> Column
         from .functions import lower_func
 
         return lower_func(expr, schema, cols, n, lower)
+    if isinstance(expr, GetIndexedField):
+        return _lower_get_indexed(expr, schema, cols, n)
+    if isinstance(expr, GetMapValue):
+        return _lower_get_map_value(expr, schema, cols, n)
+    if isinstance(expr, GetStructField):
+        c = lower(expr.child, schema, cols, n)
+        fi = [f.name for f in c.dtype.struct_fields].index(expr.name)
+        kid = c.children[fi]
+        return Column(kid.dtype, kid.data, kid.validity & c.validity, kid.lengths, kid.children)
+    if isinstance(expr, NamedStruct):
+        kids = tuple(lower(e, schema, cols, n) for e in expr.exprs)
+        out_t = infer_dtype(expr, schema)
+        return Column(out_t, None, jnp.ones(n, jnp.bool_), None, kids)
     raise NotImplementedError(f"lowering of {type(expr).__name__}")
+
+
+def elem_at(elem: Column, i: int) -> Column:
+    """Slice element ``i`` out of an element-layout column
+    ((cap, M, ...) buffers -> (cap, ...))."""
+    s = lambda a: None if a is None else a[:, i]
+    return Column(
+        elem.dtype, s(elem.data), s(elem.validity), s(elem.lengths),
+        None if elem.children is None else tuple(elem_at(k, i) for k in elem.children),
+    )
+
+
+def elem_gather(elem: Column, idx) -> Column:
+    """Per-row element gather: pick element ``idx[r]`` from row ``r`` of
+    an element-layout column."""
+
+    def g(a):
+        if a is None:
+            return None
+        ix = idx.astype(jnp.int32).reshape((idx.shape[0],) + (1,) * (a.ndim - 1))
+        return jnp.take_along_axis(a, ix, axis=1)[:, 0]
+
+    return Column(
+        elem.dtype, g(elem.data), g(elem.validity), g(elem.lengths),
+        None if elem.children is None else tuple(elem_gather(k, idx) for k in elem.children),
+    )
+
+
+def _lower_get_indexed(expr: GetIndexedField, schema, cols, n) -> Column:
+    c = lower(expr.child, schema, cols, n)
+    assert c.dtype.kind == TypeKind.ARRAY
+    i, m = expr.index, c.dtype.max_elems
+    if i < 0 or i >= m:
+        return _lit_column(None, c.dtype.elem, n)
+    out = elem_at(c.children[0], i)
+    valid = c.validity & (c.lengths > i) & out.validity
+    return Column(out.dtype, out.data, valid, out.lengths, out.children)
+
+
+def _lower_get_map_value(expr: GetMapValue, schema, cols, n) -> Column:
+    from ..batch import _scalar_to_physical
+
+    c = lower(expr.child, schema, cols, n)
+    assert c.dtype.kind == TypeKind.MAP
+    keys, vals = c.children
+    m = c.dtype.max_elems
+    within = (jnp.arange(m)[None, :] < c.lengths[:, None]) & keys.validity
+    if c.dtype.key.is_string:
+        kb = expr.key.encode("utf-8") if isinstance(expr.key, str) else bytes(expr.key)
+        w = keys.data.shape[-1]
+        if len(kb) > w:
+            eq = jnp.zeros_like(within)
+        else:
+            pat = jnp.asarray(
+                np.frombuffer(kb.ljust(w, b"\x00"), dtype=np.uint8)
+            )
+            eq = jnp.all(keys.data == pat[None, None, :], axis=-1) & (
+                keys.lengths == len(kb)
+            )
+    else:
+        phys = _scalar_to_physical(c.dtype.key, expr.key)
+        eq = keys.data == jnp.asarray(phys, keys.data.dtype)
+    hit = eq & within
+    found = jnp.any(hit, axis=1)
+    idx = jnp.argmax(hit, axis=1)
+    out = elem_gather(vals, idx)
+    valid = c.validity & found & out.validity
+    return Column(out.dtype, out.data, valid, out.lengths, out.children)
 
 
 def _lower_case(expr: Case, schema, cols, n) -> Column:

@@ -510,3 +510,125 @@ def _concat_ws(expr, schema, cols, n, lower_fn):
         first = first & ~live
     lengths = jnp.minimum(lengths, w)
     return Column(out_t, data.astype(jnp.uint8), sep.validity, lengths)
+
+
+# ------------------------------------------------------------- nested
+
+def _make_array_t(e, ts):
+    from .compile import _common_type
+
+    t = DataType.null()
+    for a in ts:
+        t = _common_type(t, a)
+    if t.kind == TypeKind.NULL:
+        t = DataType.int32()
+    return DataType.array(t, max(1, len(ts)))
+
+
+@register("make_array", _make_array_t)
+def _make_array(expr, schema, cols, n, lower_fn):
+    """make_array(e1, ..., ek): fixed k-element arrays; null args stay
+    null ELEMENTS, the array itself is never null (Spark CreateArray;
+    ≙ reference spark_make_array.rs)."""
+    from .compile import _coerce, infer_dtype
+
+    out_t = _make_array_t(expr, [infer_dtype(a, schema) for a in expr.args])
+    elem_t = out_t.elem
+    k = len(expr.args)
+    elems = [_coerce(lower_fn(a, schema, cols, n), elem_t) for a in expr.args]
+    data = lengths = None
+    if elem_t.is_string:
+        w = elem_t.string_width
+        pads = [
+            jnp.pad(e.data, ((0, 0), (0, w - e.data.shape[1])))
+            if e.data.shape[1] < w else e.data[:, :w]
+            for e in elems
+        ]
+        data = jnp.stack(pads, axis=1)                      # (n, k, w)
+        lengths = jnp.stack([e.lengths for e in elems], axis=1)
+    else:
+        data = jnp.stack([e.data for e in elems], axis=1)   # (n, k)
+    evalid = jnp.stack([e.validity for e in elems], axis=1)
+    elem_col = Column(elem_t, data, evalid, lengths)
+    return Column(
+        out_t,
+        None,
+        jnp.ones(n, jnp.bool_),
+        jnp.full(n, k, jnp.int32),
+        (elem_col,),
+    )
+
+
+def _size_t(e, ts):
+    return DataType.int32()
+
+
+@register("size", _size_t)
+@register("cardinality", _size_t)
+def _size(expr, schema, cols, n, lower_fn):
+    """size(array|map) -> element count; null input -> -1 (Spark 3
+    default: spark.sql.legacy.sizeOfNull=true unless ANSI mode)."""
+    c = lower_fn(expr.args[0], schema, cols, n)
+    assert c.dtype.kind in (TypeKind.ARRAY, TypeKind.MAP), c.dtype
+    data = jnp.where(c.validity, c.lengths.astype(jnp.int32), jnp.int32(-1))
+    return Column(DataType.int32(), data, jnp.ones(n, jnp.bool_))
+
+
+def _map_keys_t(e, ts):
+    t = ts[0]
+    return DataType.array(t.key, t.max_elems)
+
+
+def _map_values_t(e, ts):
+    t = ts[0]
+    return DataType.array(t.value, t.max_elems)
+
+
+@register("map_keys", _map_keys_t)
+def _map_keys(expr, schema, cols, n, lower_fn):
+    c = lower_fn(expr.args[0], schema, cols, n)
+    assert c.dtype.kind == TypeKind.MAP
+    return Column(_map_keys_t(expr, [c.dtype]), None, c.validity, c.lengths, (c.children[0],))
+
+
+@register("map_values", _map_values_t)
+def _map_values(expr, schema, cols, n, lower_fn):
+    c = lower_fn(expr.args[0], schema, cols, n)
+    assert c.dtype.kind == TypeKind.MAP
+    return Column(_map_values_t(expr, [c.dtype]), None, c.validity, c.lengths, (c.children[1],))
+
+
+def _array_contains_t(e, ts):
+    return DataType.bool_()
+
+
+@register("array_contains", _array_contains_t)
+def _array_contains(expr, schema, cols, n, lower_fn):
+    """array_contains(arr, value): true if any element equals value;
+    NULL if not found but the array has null elements, NULL for null
+    array/value (Spark ArrayContains three-valued logic)."""
+    from .compile import _coerce
+    from .strings import str_eq
+
+    c = lower_fn(expr.args[0], schema, cols, n)
+    assert c.dtype.kind == TypeKind.ARRAY
+    elem = c.children[0]
+    m = c.dtype.max_elems
+    needle = _coerce(lower_fn(expr.args[1], schema, cols, n), c.dtype.elem)
+    in_bounds = jnp.arange(m)[None, :] < c.lengths[:, None]
+    has_null_elem = jnp.any(in_bounds & ~elem.validity, axis=1)
+    within = in_bounds & elem.validity
+    if c.dtype.elem.is_string:
+        w = max(elem.data.shape[-1], needle.data.shape[-1])
+        ed = elem.data if elem.data.shape[-1] == w else jnp.pad(
+            elem.data, ((0, 0), (0, 0), (0, w - elem.data.shape[-1]))
+        )
+        nd = needle.data if needle.data.shape[-1] == w else jnp.pad(
+            needle.data, ((0, 0), (0, w - needle.data.shape[-1]))
+        )
+        eq = jnp.all(ed == nd[:, None, :], axis=-1) & (elem.lengths == needle.lengths[:, None])
+    else:
+        eq = elem.data == needle.data[:, None]
+    hit = jnp.any(eq & within, axis=1)
+    valid = c.validity & needle.validity & (hit | ~has_null_elem)
+    return Column(DataType.bool_(), hit, valid)

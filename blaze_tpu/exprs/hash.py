@@ -324,7 +324,7 @@ def _hash_one_murmur(col: Column, h):
 def murmur3_columns(cols: Sequence[Column], seed: int = _SEED):
     """Spark Murmur3Hash(cols, 42) -> int32 hashes (chained per column,
     nulls leave the running hash unchanged)."""
-    n = cols[0].data.shape[0]
+    n = cols[0].validity.shape[0]
     h = jnp.full((n,), np.uint32(seed), jnp.uint32)
     for c in cols:
         h = _hash_one_murmur(c, h)
@@ -351,7 +351,7 @@ def _hash_one_xx(col: Column, h):
 
 def xxhash64_columns(cols: Sequence[Column], seed: int = _SEED):
     """Spark XxHash64(cols, 42) -> int64 hashes."""
-    n = cols[0].data.shape[0]
+    n = cols[0].validity.shape[0]
     h = jnp.full((n,), np.uint64(np.int64(seed)), jnp.uint64)
     for c in cols:
         h = _hash_one_xx(c, h)

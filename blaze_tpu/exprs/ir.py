@@ -68,6 +68,15 @@ class Expr:
     def alias(self, name: str) -> "Expr":
         return Alias(self, name)
 
+    def get_item(self, index: int) -> "Expr":
+        return GetIndexedField(self, index)
+
+    def map_value(self, key) -> "Expr":
+        return GetMapValue(self, key)
+
+    def get_field(self, name: str) -> "Expr":
+        return GetStructField(self, name)
+
 
 @dataclass(eq=False)
 class Col(Expr):
@@ -153,6 +162,40 @@ class ScalarFunc(Expr):
 
     name: str
     args: List[Expr]
+
+
+@dataclass(eq=False)
+class GetIndexedField(Expr):
+    """array[ordinal], 0-based literal ordinal (Spark GetArrayItem;
+    ≙ reference GetIndexedFieldExpr, datafusion-ext-exprs)."""
+
+    child: Expr
+    index: int
+
+
+@dataclass(eq=False)
+class GetMapValue(Expr):
+    """map[key] for a literal key (≙ reference GetMapValueExpr)."""
+
+    child: Expr
+    key: Any
+
+
+@dataclass(eq=False)
+class GetStructField(Expr):
+    """struct.field by name (Spark GetStructField; the reference routes
+    this through GetIndexedFieldExpr with a field ordinal)."""
+
+    child: Expr
+    name: str
+
+
+@dataclass(eq=False)
+class NamedStruct(Expr):
+    """named_struct(n1, e1, ...) (≙ reference NamedStructExpr)."""
+
+    names: List[str]
+    exprs: List[Expr]
 
 
 @dataclass(eq=False)

@@ -5,54 +5,161 @@ columnar serde; the reader recovers the schema from plan context).
 Layout per batch (little-endian):
 
     u32 num_rows
-    per column:
-        u8  has_lengths (string column)
-        u32 data_nbytes      | raw data buffer (trimmed to num_rows)
-        [u32 width]          | strings only: padded byte width
-        bitmap               | validity, ceil(num_rows/8) bytes
-        [lengths]            | strings only: num_rows * i32
+    per column (schema-driven, recursive):
+      flat:   u8 tag (0=fixed, 1=string)
+              u32 data_nbytes | raw data buffer (trimmed to num_rows)
+              [u32 width]     | strings only: padded byte width
+              bitmap          | validity, ceil(rows/8) bytes
+              [lengths]       | strings only: rows * i32
+      nested: u8 tag (2)
+              bitmap          | row validity
+              [counts]        | ARRAY/MAP: rows * i32 element counts
+              children        | recursively; ARRAY/MAP element children
+                              | are serialized flattened to rows*M rows
 
 Buffers are trimmed to ``num_rows`` (padding never crosses the wire)
-and re-bucketed on read.
+and re-bucketed on read.  The native (C++) fast path covers flat-only
+batches; nested columns take the python path.
 """
 
 from __future__ import annotations
 
 import struct
-from typing import List
+from typing import List, Tuple
 
 import numpy as np
 
-from ..batch import Column, RecordBatch, bucket_capacity, _pad_1d
-from ..schema import Schema
+from ..batch import (
+    Column,
+    RecordBatch,
+    _flatten_leading,
+    _pad_1d,
+    _reshape_leading,
+    bucket_capacity,
+)
+from ..schema import DataType, Schema, TypeKind
+
+
+def _kid_types(dtype: DataType) -> List[DataType]:
+    if dtype.kind == TypeKind.ARRAY:
+        return [dtype.elem]
+    if dtype.kind == TypeKind.MAP:
+        return [dtype.key, dtype.value]
+    return [f.dtype for f in dtype.struct_fields]
+
+
+def _slice_rows(c: Column, n: int) -> Column:
+    s = lambda a: None if a is None else np.asarray(a)[:n]
+    return Column(
+        c.dtype, s(c.data), s(c.validity), s(c.lengths),
+        None if c.children is None else tuple(_slice_rows(k, n) for k in c.children),
+    )
+
+
+def _ser_col(out: List[bytes], c: Column, n: int) -> None:
+    dtype = c.dtype
+    validity = np.packbits(
+        np.asarray(c.validity)[:n].astype(np.bool_), bitorder="little"
+    ).tobytes()
+    if dtype.is_nested:
+        out.append(struct.pack("<B", 2))
+        out.append(validity)
+        if dtype.kind in (TypeKind.ARRAY, TypeKind.MAP):
+            out.append(np.asarray(c.lengths)[:n].astype(np.int32).tobytes())
+            m = dtype.max_elems
+            for kid in c.children:
+                _ser_col(out, _flatten_leading(_slice_rows(kid, n)), n * m)
+        else:
+            for kid in c.children:
+                _ser_col(out, kid, n)
+        return
+    data = np.asarray(c.data)[:n]
+    raw = np.ascontiguousarray(data).tobytes()
+    if c.lengths is not None:
+        out.append(struct.pack("<BI", 1, len(raw)))
+        out.append(struct.pack("<I", data.shape[-1] if data.ndim >= 2 else 0))
+        out.append(raw)
+        out.append(validity)
+        out.append(np.asarray(c.lengths)[:n].astype(np.int32).tobytes())
+    else:
+        out.append(struct.pack("<BI", 0, len(raw)))
+        out.append(raw)
+        out.append(validity)
 
 
 def serialize_batch(batch: RecordBatch) -> bytes:
     from .. import native
 
-    if native.available():
+    if native.available() and not any(f.dtype.is_nested for f in batch.schema.fields):
         out = native.serialize_batch_native(batch)
         if out is not None:
             return out
     b = batch.to_host()
     n = b.num_rows
-    out: List[bytes] = [struct.pack("<I", n)]
+    parts: List[bytes] = [struct.pack("<I", n)]
     for c in b.columns:
-        data = np.asarray(c.data)[:n]
-        validity = np.packbits(np.asarray(c.validity)[:n], bitorder="little").tobytes()
-        if c.lengths is not None:
-            raw = np.ascontiguousarray(data).tobytes()
-            out.append(struct.pack("<BI", 1, len(raw)))
-            out.append(struct.pack("<I", data.shape[1] if data.ndim == 2 else 0))
-            out.append(raw)
-            out.append(validity)
-            out.append(np.asarray(c.lengths)[:n].astype(np.int32).tobytes())
-        else:
-            raw = np.ascontiguousarray(data).tobytes()
-            out.append(struct.pack("<BI", 0, len(raw)))
-            out.append(raw)
-            out.append(validity)
-    return b"".join(out)
+        _ser_col(parts, c, n)
+    return b"".join(parts)
+
+
+def _read_bitmap(data: bytes, off: int, n: int) -> Tuple[np.ndarray, int]:
+    vbytes = (n + 7) // 8
+    bits = np.unpackbits(
+        np.frombuffer(data, np.uint8, count=vbytes, offset=off), bitorder="little"
+    )[:n].astype(np.bool_)
+    return bits, off + vbytes
+
+
+def _de_col(dtype: DataType, data: bytes, off: int, n: int) -> Tuple[Column, int]:
+    """Deserialize one column at EXACT n rows (caller pads)."""
+    (tag,) = struct.unpack_from("<B", data, off)
+    off += 1
+    if tag == 2:
+        assert dtype.is_nested, f"wire tag 2 for non-nested {dtype!r}"
+        validity, off = _read_bitmap(data, off, n)
+        if dtype.kind in (TypeKind.ARRAY, TypeKind.MAP):
+            lengths = np.frombuffer(data, np.int32, count=n, offset=off).copy()
+            off += 4 * n
+            m = dtype.max_elems
+            kids = []
+            for kt in _kid_types(dtype):
+                flat, off = _de_col(kt, data, off, n * m)
+                kids.append(_reshape_leading(flat, n, m))
+            return Column(dtype, None, validity, lengths, tuple(kids)), off
+        kids = []
+        for kt in _kid_types(dtype):
+            kid, off = _de_col(kt, data, off, n)
+            kids.append(kid)
+        return Column(dtype, None, validity, None, tuple(kids)), off
+    (nbytes,) = struct.unpack_from("<I", data, off)
+    off += 4
+    if tag == 1:
+        (width,) = struct.unpack_from("<I", data, off)
+        off += 4
+        raw = (
+            np.frombuffer(data, np.uint8, count=nbytes, offset=off).reshape(n, width)
+            if n
+            else np.zeros((0, width), np.uint8)
+        )
+        off += nbytes
+        validity, off = _read_bitmap(data, off, n)
+        lengths = np.frombuffer(data, np.int32, count=n, offset=off).copy()
+        off += 4 * n
+        return Column(dtype, raw.copy(), validity, lengths), off
+    dt = dtype.np_dtype
+    count = nbytes // dt.itemsize
+    raw = np.frombuffer(data, dt, count=count, offset=off).copy()
+    off += nbytes
+    validity, off = _read_bitmap(data, off, n)
+    return Column(dtype, raw, validity), off
+
+
+def _pad_col(c: Column, cap: int) -> Column:
+    p = lambda a: None if a is None else _pad_1d(np.ascontiguousarray(a), cap)
+    return Column(
+        c.dtype, p(c.data), p(c.validity), p(c.lengths),
+        None if c.children is None else tuple(_pad_col(k, cap) for k in c.children),
+    )
 
 
 def deserialize_batch(data: bytes, schema: Schema) -> RecordBatch:
@@ -61,39 +168,7 @@ def deserialize_batch(data: bytes, schema: Schema) -> RecordBatch:
     off += 4
     cap = bucket_capacity(max(n, 1))
     cols: List[Column] = []
-    vbytes = (n + 7) // 8
     for f in schema.fields:
-        has_len, nbytes = struct.unpack_from("<BI", data, off)
-        off += 5
-        if has_len:
-            (width,) = struct.unpack_from("<I", data, off)
-            off += 4
-            raw = np.frombuffer(data, np.uint8, count=nbytes, offset=off).reshape(n, width) if n else np.zeros((0, width), np.uint8)
-            off += nbytes
-            validity = np.unpackbits(
-                np.frombuffer(data, np.uint8, count=vbytes, offset=off), bitorder="little"
-            )[:n].astype(np.bool_)
-            off += vbytes
-            lengths = np.frombuffer(data, np.int32, count=n, offset=off)
-            off += 4 * n
-            d = np.zeros((cap, width), np.uint8)
-            d[:n] = raw
-            cols.append(
-                Column(
-                    f.dtype,
-                    d,
-                    _pad_1d(validity, cap),
-                    _pad_1d(lengths.copy(), cap),
-                )
-            )
-        else:
-            dt = f.dtype.np_dtype
-            count = nbytes // dt.itemsize
-            raw = np.frombuffer(data, dt, count=count, offset=off)
-            off += nbytes
-            validity = np.unpackbits(
-                np.frombuffer(data, np.uint8, count=vbytes, offset=off), bitorder="little"
-            )[:n].astype(np.bool_)
-            off += vbytes
-            cols.append(Column(f.dtype, _pad_1d(raw.copy(), cap), _pad_1d(validity, cap)))
+        c, off = _de_col(f.dtype, data, off, n)
+        cols.append(_pad_col(c, cap))
     return RecordBatch(schema, cols, n)

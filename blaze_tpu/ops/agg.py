@@ -99,6 +99,10 @@ def agg_result_type(fn: str, in_t: Optional[DataType]) -> DataType:
         if in_t.is_decimal:
             return decimal_avg_agg_type(in_t)
         return DataType.float64()
+    if fn in ("collect_list", "collect_set"):
+        if in_t.is_nested:
+            raise NotImplementedError("collect over nested element types (roadmap)")
+        return DataType.array(in_t, int(conf.COLLECT_MAX_ELEMS.get()))
     return in_t  # min/max/first
 
 
@@ -117,6 +121,8 @@ def agg_state_fields(fn: str, in_t: Optional[DataType], name: str) -> List[Field
         ]
     if fn in ("min", "max", "first", "first_ignores_null"):
         return [Field(f"{name}#value", in_t)]
+    if fn in ("collect_list", "collect_set"):
+        return [Field(f"{name}#list", agg_result_type(fn, in_t))]
     raise NotImplementedError(f"agg fn {fn}")
 
 
@@ -187,6 +193,139 @@ def _seg_first(values, valid, seg, cap, ignore_nulls: bool):
     return jnp.take(values, safe, axis=0), jnp.take(valid, safe) & has, has
 
 
+# ------------------------------------------------- collect_list/set
+
+def _seg_first_row(seg, cap, n):
+    """Index of each segment's first row, mapped back per row."""
+    arange = jnp.arange(n, dtype=jnp.int32)
+    first = jax.ops.segment_min(arange, seg, num_segments=cap, indices_are_sorted=True)
+    return jnp.clip(jnp.take(first, seg), 0, n - 1)
+
+
+def _collect_reduce(v: Column, arr_t: DataType, seg, cap: int, merging: bool) -> Column:
+    """Segment-collect into the fixed max-elements ARRAY layout
+    (≙ reference agg/collect.rs collect_list/collect_set accs).  Nulls
+    are skipped (Spark semantics); elements past ``max_elems`` are
+    DROPPED — the padded layout's documented deviation from the
+    reference's unbounded lists."""
+    elem_t = arr_t.elem
+    m = arr_t.max_elems
+    n = v.validity.shape[0]
+    if not merging:
+        valid = v.validity
+        cv = jnp.cumsum(valid.astype(jnp.int32))
+        prefix = cv - valid.astype(jnp.int32)  # exclusive count of valid rows
+        base = jnp.take(prefix, _seg_first_row(seg, cap, n))
+        pos = prefix - base                    # within-segment rank among valid
+        emit = valid & (pos < m)
+        tgt = jnp.where(emit, seg, cap)        # cap = dropped (out of bounds)
+        counts = jnp.clip(_seg_count(valid, seg, cap), 0, m).astype(jnp.int32)
+        if elem_t.is_string:
+            w = v.data.shape[-1]
+            data = jnp.zeros((cap, m, w), jnp.uint8).at[tgt, pos].set(v.data, mode="drop")
+            lengths = jnp.zeros((cap, m), jnp.int32).at[tgt, pos].set(v.lengths, mode="drop")
+            ev = jnp.arange(m)[None, :] < counts[:, None]
+            elem = Column(elem_t, data, ev, lengths)
+        else:
+            data = jnp.zeros((cap, m), v.data.dtype).at[tgt, pos].set(v.data, mode="drop")
+            ev = jnp.arange(m)[None, :] < counts[:, None]
+            elem = Column(elem_t, data, ev)
+        return Column(arr_t, None, jnp.ones(cap, jnp.bool_), counts, (elem,))
+    # merging: v is an ARRAY state column (rows sorted by group)
+    rc = jnp.where(v.validity, v.lengths, 0).astype(jnp.int32)
+    cum = jnp.cumsum(rc)
+    excl = cum - rc
+    base = jnp.take(excl, _seg_first_row(seg, cap, n))
+    start = excl - base                        # offset of this row's elems in its group
+    elem = v.children[0]
+    within = jnp.arange(m)[None, :] < rc[:, None]
+    pos2 = start[:, None] + jnp.arange(m, dtype=jnp.int32)[None, :]
+    seg2 = jnp.broadcast_to(seg[:, None], (n, m))
+    tgt = jnp.where(within & (pos2 < m), seg2, cap)
+    counts = jnp.clip(
+        jax.ops.segment_sum(rc, seg, num_segments=cap, indices_are_sorted=True), 0, m
+    ).astype(jnp.int32)
+    ev = jnp.arange(m)[None, :] < counts[:, None]
+    if elem_t.is_string:
+        w = elem.data.shape[-1]
+        data = jnp.zeros((cap, m, w), jnp.uint8).at[tgt, pos2].set(elem.data, mode="drop")
+        lengths = jnp.zeros((cap, m), jnp.int32).at[tgt, pos2].set(elem.lengths, mode="drop")
+        out_elem = Column(elem_t, data, ev, lengths)
+    else:
+        data = jnp.zeros((cap, m), elem.data.dtype).at[tgt, pos2].set(elem.data, mode="drop")
+        out_elem = Column(elem_t, data, ev)
+    return Column(arr_t, None, jnp.ones(cap, jnp.bool_), counts, (out_elem,))
+
+
+def _elem_sort_words(elem: Column, within) -> List[jnp.ndarray]:
+    """Equality-preserving uint64 sort words along the element axis
+    (dead slots first key = 1 so they sort last)."""
+    words: List[jnp.ndarray] = [(~within).astype(jnp.uint64)]
+    if elem.dtype.is_string:
+        cap, m, w = elem.data.shape
+        words.append(jnp.where(within, elem.lengths, 0).astype(jnp.uint64))
+        nw = (w + 7) // 8
+        d = elem.data if nw * 8 == w else jnp.pad(elem.data, ((0, 0), (0, 0), (0, nw * 8 - w)))
+        b = d.reshape(cap, m, nw, 8).astype(jnp.uint64)
+        for k in range(nw):
+            word = b[:, :, k, 0] << jnp.uint64(56)
+            for j in range(1, 8):
+                word = word | (b[:, :, k, j] << jnp.uint64(8 * (7 - j)))
+            words.append(jnp.where(within, word, jnp.uint64(0)))
+    elif elem.dtype.is_float:
+        from ..exprs.hash import f64_raw_bits
+
+        d = jnp.where(elem.data == 0, jnp.zeros((), elem.data.dtype), elem.data)
+        d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, elem.data.dtype), d)
+        bits = d.view(jnp.int32) if elem.data.dtype == jnp.float32 else f64_raw_bits(d)
+        words.append(
+            jnp.where(within, bits.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0))
+        )
+    else:
+        words.append(
+            jnp.where(within, elem.data.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0))
+        )
+    return words
+
+
+def _dedup_array_state(col: Column) -> Column:
+    """Per-row element dedup (collect_set): sort elements within each
+    row, drop adjacent duplicates, recompact."""
+    arr_t = col.dtype
+    elem_t = arr_t.elem
+    elem = col.children[0]
+    m = arr_t.max_elems
+    cap = col.validity.shape[0]
+    within = jnp.arange(m)[None, :] < col.lengths[:, None]
+    words = _elem_sort_words(elem, within)
+    payload = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32)[None, :], (cap, m))
+    sorted_ = jax.lax.sort(tuple(words) + (payload,), dimension=1, num_keys=len(words))
+    s_words, s_idx = sorted_[:-1], sorted_[-1]
+    s_within = jnp.take_along_axis(within, s_idx, axis=1)
+    changed = jnp.zeros((cap, m), jnp.bool_)
+    for wv in s_words:
+        changed = changed | (wv != jnp.roll(wv, 1, axis=1))
+    changed = changed.at[:, 0].set(True)
+    keep = s_within & changed
+    new_pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    counts = jnp.sum(keep.astype(jnp.int32), axis=1)
+    rows2 = jnp.broadcast_to(jnp.arange(cap, dtype=jnp.int32)[:, None], (cap, m))
+    tgt = jnp.where(keep, rows2, cap)
+    ev = jnp.arange(m)[None, :] < counts[:, None]
+    if elem_t.is_string:
+        w = elem.data.shape[-1]
+        g_data = jnp.take_along_axis(elem.data, s_idx[:, :, None], axis=1)
+        g_len = jnp.take_along_axis(elem.lengths, s_idx, axis=1)
+        data = jnp.zeros((cap, m, w), jnp.uint8).at[tgt, new_pos].set(g_data, mode="drop")
+        lengths = jnp.zeros((cap, m), jnp.int32).at[tgt, new_pos].set(g_len, mode="drop")
+        out_elem = Column(elem_t, data, ev, lengths)
+    else:
+        g_data = jnp.take_along_axis(elem.data, s_idx, axis=1)
+        data = jnp.zeros((cap, m), elem.data.dtype).at[tgt, new_pos].set(g_data, mode="drop")
+        out_elem = Column(elem_t, data, ev)
+    return Column(arr_t, None, col.validity, counts, (out_elem,))
+
+
 # ---------------------------------------------------------------- AggExec
 
 class AggExec(ExecNode):
@@ -222,6 +361,8 @@ class AggExec(ExecNode):
                         self._in_types.append(DataType.decimal(max(1, st.precision - (10 if a.fn == "sum" else 0)), st.scale))
                     else:
                         self._in_types.append(st)
+                elif a.fn in ("collect_list", "collect_set"):
+                    self._in_types.append(in_schema.field(f"{a.name}#list").dtype.elem)
                 else:
                     self._in_types.append(in_schema.field(f"{a.name}#value").dtype)
 
@@ -230,12 +371,23 @@ class AggExec(ExecNode):
         ]
         state_fields: List[Field] = []
         for a, t in zip(self.aggs, self._in_types):
-            state_fields.extend(agg_state_fields(a.fn, t, a.name))
+            fields = agg_state_fields(a.fn, t, a.name)
+            if mode != AggMode.PARTIAL and a.fn in ("collect_list", "collect_set"):
+                # preserve the incoming state's element budget exactly
+                # (conf may differ between stages)
+                fields = [Field(f"{a.name}#list", in_schema.field(f"{a.name}#list").dtype)]
+            state_fields.extend(fields)
         self._state_schema = Schema(group_fields + state_fields)
 
         if mode == AggMode.FINAL:
             out_fields = group_fields + [
-                Field(a.name, agg_result_type(a.fn, t)) for a, t in zip(self.aggs, self._in_types)
+                Field(
+                    a.name,
+                    self._state_schema.field(f"{a.name}#list").dtype
+                    if a.fn in ("collect_list", "collect_set")
+                    else agg_result_type(a.fn, t),
+                )
+                for a, t in zip(self.aggs, self._in_types)
             ]
             self._schema = Schema(out_fields)
         else:
@@ -259,7 +411,7 @@ class AggExec(ExecNode):
 
         def eval_inputs(cols: Tuple[Column, ...], schema: Schema):
             env = {f.name: c for f, c in zip(schema.fields, cols)}
-            n = cols[0].data.shape[0] if cols else 0
+            n = cols[0].validity.shape[0] if cols else 0
             key_cols = [lower(g.expr, schema, env, n) for g in groupings]
             return env, key_cols, n
 
@@ -331,6 +483,12 @@ class AggExec(ExecNode):
                     v.data, v.validity, seg, cap, a.fn == "first_ignores_null" or mode != AggMode.PARTIAL
                 )
                 return [Column(v.dtype, jnp.where(valid, vals, jnp.zeros((), vals.dtype)), valid)]
+            if a.fn in ("collect_list", "collect_set"):
+                arr_t = state_schema.field(f"{a.name}#list").dtype
+                out = _collect_reduce(inputs[0], arr_t, seg, cap, merging)
+                if a.fn == "collect_set":
+                    out = _dedup_array_state(out)
+                return [out]
             raise NotImplementedError(a.fn)
 
         merging = mode != AggMode.PARTIAL
@@ -339,7 +497,7 @@ class AggExec(ExecNode):
         def grouped_kernel(cols: Tuple[Column, ...], num_rows):
             schema = in_schema
             env, key_cols, _ = eval_inputs(cols, schema)
-            cap = cols[0].data.shape[0]
+            cap = cols[0].validity.shape[0]
             live = jnp.arange(cap) < num_rows
             words = [live.astype(jnp.uint64) ^ jnp.uint64(1)] + [
                 jnp.where(live, w, jnp.uint64(0)) for w in encode_key_words(key_cols)
@@ -358,15 +516,15 @@ class AggExec(ExecNode):
             seg = jnp.clip(seg, 0, cap - 1)
             n_out = jnp.sum(boundary.astype(jnp.int32))
 
-            # gather agg inputs in sorted order
+            # gather agg inputs in sorted order (Column.take recurses
+            # into nested children, e.g. collect ARRAY states)
             inputs = partial_inputs(env, schema, cap) if not merging else state_inputs(env)
-            sorted_inputs = [
-                [Column(c.dtype, jnp.take(c.data, s_idx, axis=0),
-                        jnp.take(c.validity, s_idx) & s_live,
-                        None if c.lengths is None else jnp.take(c.lengths, s_idx))
-                 for c in ins]
-                for ins in inputs
-            ]
+
+            def sort_col(c: Column) -> Column:
+                g = c.take(s_idx)
+                return Column(g.dtype, g.data, g.validity & s_live, g.lengths, g.children)
+
+            sorted_inputs = [[sort_col(c) for c in ins] for ins in inputs]
             state_cols: List[Column] = []
             for a, t, ins in zip(aggs, self._in_types, sorted_inputs):
                 state_cols.extend(reduce_one(a, t, ins, seg, cap, merging))
@@ -390,7 +548,8 @@ class AggExec(ExecNode):
             # state columns: indexed by seg id == output row already
             state_out = [
                 Column(c.dtype, c.data, c.validity & out_live,
-                       None if c.lengths is None else jnp.where(out_live, c.lengths, 0))
+                       None if c.lengths is None else jnp.where(out_live, c.lengths, 0),
+                       c.children)
                 for c in state_cols
             ]
             return tuple(group_out + state_out), n_out
@@ -403,12 +562,12 @@ class AggExec(ExecNode):
             is a 1-row batch."""
             schema = in_schema
             env, _, _ = eval_inputs(cols, schema)
-            cap = cols[0].data.shape[0]
+            cap = cols[0].validity.shape[0]
             live = jnp.arange(cap) < num_rows
             seg = jnp.zeros(cap, jnp.int32)
             inputs = partial_inputs(env, schema, cap) if not merging else state_inputs(env)
             masked = [
-                [Column(c.dtype, c.data, c.validity & live, c.lengths) for c in ins]
+                [Column(c.dtype, c.data, c.validity & live, c.lengths, c.children) for c in ins]
                 for ins in inputs
             ]
             state_cols: List[Column] = []
@@ -453,6 +612,8 @@ class AggExec(ExecNode):
                         out.append(
                             Column(res_t, s.data.astype(jnp.float64) / den.astype(jnp.float64), valid)
                         )
+                elif a.fn in ("collect_list", "collect_set"):
+                    out.append(env[f"{a.name}#list"])
                 else:
                     out.append(env[f"{a.name}#value"])
             return tuple(out)

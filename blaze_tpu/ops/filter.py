@@ -58,7 +58,7 @@ class FilterExec(ExecNode):
 
         @jax.jit
         def kernel(cols: Tuple[Column, ...], num_rows):
-            n = cols[0].data.shape[0]
+            n = cols[0].validity.shape[0]
             env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
             p = lower(pred, schema_aug, env, n)
             # the live mask is load-bearing: IsNull turns padding-row

@@ -1,29 +1,65 @@
 """Generate (table-generating functions).
 
-≙ reference GenerateExec (generate_exec.rs:54-586; explode/pos_explode/
-json_tuple native, arbitrary UDTF via the JVM wrapper).  Until the
-nested ARRAY/MAP column layout lands (fixed max-elements padded arrays,
-roadmap), generators run through the host-generator interface — the
-same architecture slot as the reference's SparkUDTFWrapperContext JNI
-round trip, with json_tuple provided as a built-in host generator.
+≙ reference GenerateExec (generate_exec.rs:54-586) and the Generator
+enum (generate/mod.rs:39-65): explode/pos_explode over ARRAY and MAP
+run **natively on device** via a flat-mask -> cumsum -> scatter compact
+kernel over the fixed max-elements layout; json_tuple and arbitrary
+UDTFs run through the host-generator interface — the same architecture
+slot as the reference's SparkUDTFWrapperContext JNI round trip.
 """
 
 from __future__ import annotations
 
 import json
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
-from ..batch import RecordBatch, batch_from_pydict, batch_to_pydict
-from ..exprs.compile import infer_dtype
+from ..batch import Column, RecordBatch, batch_from_pydict, batch_to_pydict, bucket_capacity
+from ..exprs.compile import infer_dtype, lower
 from ..exprs.ir import Expr
 from ..runtime.context import TaskContext
-from ..schema import DataType, Field, Schema
+from ..schema import DataType, Field, Schema, TypeKind
 from .base import BatchStream, ExecNode
 
 # generator: (row tuple of python values) -> list of output tuples
 Generator = Callable[[Tuple], List[Tuple]]
+
+
+@dataclass
+class NativeGenerator:
+    """Device-native generator spec (≙ generate/mod.rs Generator enum:
+    Explode / PosExplode over array or map).
+
+    kind: "explode" | "pos_explode"; expr must lower to ARRAY or MAP.
+    """
+
+    kind: str
+    expr: Expr
+
+    def gen_fields(self, schema: Schema) -> List[Field]:
+        """Default output fields per Spark naming (col / key,value [+pos])."""
+        t = infer_dtype(self.expr, schema)
+        if t.kind == TypeKind.ARRAY:
+            fields = [Field("col", t.elem)]
+        else:
+            assert t.kind == TypeKind.MAP, t
+            fields = [Field("key", t.key), Field("value", t.value)]
+        if self.kind == "pos_explode":
+            fields = [Field("pos", DataType.int32())] + fields
+        return fields
+
+
+def _flatten_elem_dev(c: Column) -> Column:
+    """Device-side (cap, M, ...) -> (cap*M, ...) element flatten."""
+    fl = lambda a: None if a is None else a.reshape((-1,) + a.shape[2:])
+    return Column(
+        c.dtype, fl(c.data), fl(c.validity), fl(c.lengths),
+        None if c.children is None else tuple(_flatten_elem_dev(k) for k in c.children),
+    )
 
 
 def json_tuple_generator(fields: Sequence[str]) -> Generator:
@@ -59,31 +95,129 @@ class GenerateExec(ExecNode):
     def __init__(
         self,
         child: ExecNode,
-        generator: Generator,
+        generator,
         input_exprs: Sequence[Expr],
-        gen_fields: Sequence[Field],
+        gen_fields: Optional[Sequence[Field]] = None,
         outer: bool = False,
         keep_input: bool = True,
     ):
         super().__init__([child])
         self.generator = generator
         self.input_exprs = list(input_exprs)
+        if gen_fields is None:
+            assert isinstance(generator, NativeGenerator)
+            gen_fields = generator.gen_fields(child.schema)
         self.gen_fields = list(gen_fields)
         self.outer = outer
         self.keep_input = keep_input
         base = list(child.schema.fields) if keep_input else []
         self._schema = Schema(base + self.gen_fields)
-        from .project import ProjectExec
+        if isinstance(generator, NativeGenerator):
+            self._build_native_kernel()
+        else:
+            from .project import ProjectExec
 
-        self._input_proj = ProjectExec(
-            child, self.input_exprs, [f"__gen_in_{i}" for i in range(len(self.input_exprs))]
-        )
+            self._input_proj = ProjectExec(
+                child, self.input_exprs, [f"__gen_in_{i}" for i in range(len(self.input_exprs))]
+            )
 
     @property
     def schema(self) -> Schema:
         return self._schema
 
+    # --------------------------------------------- native explode path
+
+    def _build_native_kernel(self):
+        """Explode kernel: flat emit mask over (rows, M), cumsum ->
+        output slot, scatter flat index, gather everything.
+        ≙ generate/explode.rs, re-shaped for fixed-width device layout."""
+        child_schema = self.children[0].schema
+        spec: NativeGenerator = self.generator
+        outer = self.outer
+        keep_input = self.keep_input
+        with_pos = spec.kind == "pos_explode"
+
+        @jax.jit
+        def kernel(cols: Tuple[Column, ...], num_rows):
+            cap = cols[0].validity.shape[0]
+            env = {f.name: c for f, c in zip(child_schema.fields, cols)}
+            gc = lower(spec.expr, child_schema, env, cap)
+            m = gc.dtype.max_elems
+            live = jnp.arange(cap) < num_rows
+            within = jnp.arange(m)[None, :] < gc.lengths[:, None]
+            emit = within & gc.validity[:, None] & live[:, None]
+            if outer:
+                empty = live & (~gc.validity | (gc.lengths == 0))
+                emit = emit.at[:, 0].set(emit[:, 0] | empty)
+            flat = emit.reshape(-1)                       # (cap*m,) row-major
+            out_cap = cap * m
+            pos = jnp.cumsum(flat.astype(jnp.int32)) - 1
+            total = jnp.sum(flat.astype(jnp.int32))
+            flat_idx = jnp.arange(out_cap, dtype=jnp.int32)
+            src = (
+                jnp.zeros(out_cap, jnp.int32)
+                .at[jnp.where(flat, pos, out_cap)]
+                .set(flat_idx, mode="drop")
+            )
+            out_live = jnp.arange(out_cap) < total
+            out_row = src // m
+            out_elem = src % m
+
+            out_cols: List[Column] = []
+            if keep_input:
+                for c in cols:
+                    g = c.take(out_row)
+                    out_cols.append(
+                        Column(g.dtype, g.data, g.validity & out_live, g.lengths, g.children)
+                    )
+            elem_within = within.reshape(-1)
+            if with_pos:
+                # pos is NULL for outer-emitted placeholder rows
+                pos_valid = out_live & jnp.take(elem_within, src)
+                out_cols.append(
+                    Column(DataType.int32(), jnp.where(pos_valid, out_elem, 0), pos_valid)
+                )
+            for kid in gc.children:  # ARRAY: (elem,); MAP: (keys, values)
+                fk = _flatten_elem_dev(kid).take(src)
+                out_cols.append(
+                    Column(
+                        fk.dtype,
+                        fk.data,
+                        fk.validity & out_live & jnp.take(elem_within, src),
+                        fk.lengths,
+                        fk.children,
+                    )
+                )
+            return tuple(out_cols), total
+
+        self._native_kernel = kernel
+
+    def _native_stream(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child = self.children[0]
+
+        def stream():
+            for batch in child.execute(partition, ctx):
+                if not ctx.is_task_running():
+                    return
+                with self.metrics.timer("elapsed_compute"):
+                    cols, total = self._native_kernel(tuple(batch.columns), batch.num_rows)
+                n = int(total)
+                if n == 0:
+                    continue
+                out = RecordBatch(self._schema, list(cols), n)
+                # cap*M is rarely a power-of-two bucket: renormalize so
+                # downstream kernels keep the shape-bucketing invariant
+                tight = bucket_capacity(n)
+                if tight != out.capacity:
+                    out = out.with_capacity(tight)
+                self.metrics.add("output_rows", n)
+                yield out
+
+        return stream()
+
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        if isinstance(self.generator, NativeGenerator):
+            return self._native_stream(partition, ctx)
         child = self.children[0]
 
         def stream():

@@ -192,7 +192,7 @@ class Joiner:
 
         @jax.jit
         def build_kernel(cols: Tuple[Column, ...], num_rows):
-            cap = cols[0].data.shape[0]
+            cap = cols[0].validity.shape[0]
             env = {f.name: c for f, c in zip(build_schema.fields, cols)}
             key_cols = [lower(e, build_schema, env, cap) for e in build_keys]
             live = jnp.arange(cap) < num_rows
@@ -204,7 +204,7 @@ class Joiner:
 
         @jax.jit
         def candidate_kernel(cols, jmap_keys, num_rows):
-            cap = cols[0].data.shape[0]
+            cap = cols[0].validity.shape[0]
             env = {f.name: c for f, c in zip(probe_schema.fields, cols)}
             key_cols = [lower(e, probe_schema, env, cap) for e in probe_keys]
             live = jnp.arange(cap) < num_rows
@@ -218,7 +218,7 @@ class Joiner:
 
         @partial(jax.jit, static_argnames=("out_cap",))
         def probe_kernel(probe_cols, jmap: JoinMap, probe_rows, out_cap: int):
-            cap = probe_cols[0].data.shape[0]
+            cap = probe_cols[0].validity.shape[0]
             env = {f.name: c for f, c in zip(probe_schema.fields, probe_cols)}
             probe_key_cols = [lower(e, probe_schema, env, cap) for e in probe_keys]
             live = jnp.arange(cap) < probe_rows

@@ -55,7 +55,7 @@ class ProjectExec(ExecNode):
 
         @jax.jit
         def kernel(cols: Tuple[Column, ...]) -> Tuple[Column, ...]:
-            n = cols[0].data.shape[0]
+            n = cols[0].validity.shape[0]
             env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
             return tuple(lower(e, schema_aug, env, n) for e in device_exprs)
 

@@ -97,7 +97,7 @@ def sort_indices(
     num_rows,
 ) -> jnp.ndarray:
     """Stable sorted row order (padding rows sort last)."""
-    cap = key_cols[0].data.shape[0]
+    cap = key_cols[0].validity.shape[0]
     live = jnp.arange(cap) < num_rows
     words: List[jnp.ndarray] = [live.astype(jnp.uint64) ^ jnp.uint64(1)]
     for c, f in zip(key_cols, fields):
@@ -188,7 +188,7 @@ class SortExec(ExecNode):
         @jax.jit
         def kernel(cols: Tuple[Column, ...], num_rows):
             env = {f.name: c for f, c in zip(in_schema.fields, cols)}
-            cap = cols[0].data.shape[0]
+            cap = cols[0].validity.shape[0]
             key_cols = [lower(f.expr, in_schema, env, cap) for f in fields_]
             idx = sort_indices(key_cols, fields_, num_rows)
             return tuple(c.take(idx) for c in cols)
@@ -196,7 +196,7 @@ class SortExec(ExecNode):
         @jax.jit
         def key_words(cols: Tuple[Column, ...], num_rows):
             env = {f.name: c for f, c in zip(in_schema.fields, cols)}
-            cap = cols[0].data.shape[0]
+            cap = cols[0].validity.shape[0]
             key_cols = [lower(f.expr, in_schema, env, cap) for f in fields_]
             words: List[jnp.ndarray] = []
             for c, f in zip(key_cols, fields_):

@@ -81,7 +81,7 @@ class WindowExec(ExecNode):
 
         @jax.jit
         def kernel(cols: Tuple[Column, ...], num_rows):
-            cap = cols[0].data.shape[0]
+            cap = cols[0].validity.shape[0]
             env = {f.name: c for f, c in zip(in_schema.fields, cols)}
             live = jnp.arange(cap) < num_rows
 

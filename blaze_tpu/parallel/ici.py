@@ -65,7 +65,7 @@ def ici_exchange_fn(schema: Schema, key_exprs: Sequence[Expr], n_dev: int):
     (received cols [n_dev*cap], received counts [n_dev])."""
 
     def body(cols: Tuple[Column, ...], num_rows):
-        cap = cols[0].data.shape[0]
+        cap = cols[0].validity.shape[0]
         env = {f.name: c for f, c in zip(schema.fields, cols)}
         key_cols = [lower(e, schema, env, cap) for e in key_exprs]
         pids = pmod(murmur3_columns(key_cols), n_dev)

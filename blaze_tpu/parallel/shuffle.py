@@ -107,23 +107,22 @@ class ShuffleRepartitioner(MemConsumer):
 
     def insert_sorted(self, sorted_batch_host: RecordBatch, counts: np.ndarray) -> None:
         """Append per-pid slices of a pid-sorted host batch."""
+
+        def slice_col(c: Column, lo: int, hi: int) -> Column:
+            s = lambda a: None if a is None else np.asarray(a)[lo:hi]
+            return Column(
+                c.dtype, s(c.data), s(c.validity), s(c.lengths),
+                None if c.children is None
+                else tuple(slice_col(k, lo, hi) for k in c.children),
+            )
+
         offsets = np.concatenate([[0], np.cumsum(counts)])
         cols = sorted_batch_host.columns
         for pid in range(self.n_out):
             lo, hi = int(offsets[pid]), int(offsets[pid + 1])
             if hi == lo:
                 continue
-            sl_cols = []
-            for c in cols:
-                sl_cols.append(
-                    Column(
-                        c.dtype,
-                        np.asarray(c.data)[lo:hi],
-                        np.asarray(c.validity)[lo:hi],
-                        None if c.lengths is None else np.asarray(c.lengths)[lo:hi],
-                    )
-                )
-            b = RecordBatch(self.schema, sl_cols, hi - lo)
+            b = RecordBatch(self.schema, [slice_col(c, lo, hi) for c in cols], hi - lo)
             self._buffers[pid].append(b)
             self._buffered_bytes += b.memory_size()
         self.update_mem_used(self._buffered_bytes)
@@ -209,7 +208,7 @@ class ShuffleWriterExec(ExecNode):
 
             @jax.jit
             def hash_pids(cols, num_rows):
-                cap = cols[0].data.shape[0]
+                cap = cols[0].validity.shape[0]
                 env = {f.name: c for f, c in zip(schema.fields, cols)}
                 key_cols = [lower(e, schema, env, cap) for e in exprs]
                 return pmod(murmur3_columns(key_cols), n_out)
@@ -222,7 +221,7 @@ class ShuffleWriterExec(ExecNode):
                 # kernel) traced once per shape bucket, like the XLA path
                 from ..kernels import pallas_ops
 
-                cap = cols[0].data.shape[0]
+                cap = cols[0].validity.shape[0]
                 env = {f.name: c for f, c in zip(schema.fields, cols)}
                 planes, widths, valids = [], [], []
                 for e in exprs:

@@ -56,6 +56,10 @@ class TypeKind(enum.Enum):
     BINARY = 10
     DATE32 = 11
     TIMESTAMP = 12
+    # nested types (fixed max-elements padded device layout; see Column)
+    ARRAY = 13
+    MAP = 14
+    STRUCT = 15
 
 
 _FIXED_NP = {
@@ -78,10 +82,21 @@ _FLOAT_KINDS = (TypeKind.FLOAT32, TypeKind.FLOAT64)
 
 @dataclass(frozen=True)
 class DataType:
+    """Logical type.  Nested kinds (ARRAY/MAP/STRUCT — ≙ the Arrow
+    List/Map/Struct encodings in the reference's blaze.proto:738-941)
+    carry their child types and, for ARRAY/MAP, the fixed per-row
+    element budget ``max_elems`` that sets the padded device layout
+    width (elements beyond it cannot be stored)."""
+
     kind: TypeKind
     precision: int = 0          # DECIMAL only
     scale: int = 0              # DECIMAL only
     string_width: int = 64      # STRING/BINARY only: padded byte width W
+    elem: Optional["DataType"] = None         # ARRAY element type
+    key: Optional["DataType"] = None          # MAP key type
+    value: Optional["DataType"] = None        # MAP value type
+    struct_fields: Optional[Tuple["Field", ...]] = None  # STRUCT
+    max_elems: int = 0          # ARRAY/MAP padded element count M
 
     # ---- constructors ----
     @staticmethod
@@ -136,6 +151,18 @@ class DataType:
     def null() -> "DataType":
         return DataType(TypeKind.NULL)
 
+    @staticmethod
+    def array(elem: "DataType", max_elems: int = 16) -> "DataType":
+        return DataType(TypeKind.ARRAY, elem=elem, max_elems=max_elems)
+
+    @staticmethod
+    def map(key: "DataType", value: "DataType", max_elems: int = 16) -> "DataType":
+        return DataType(TypeKind.MAP, key=key, value=value, max_elems=max_elems)
+
+    @staticmethod
+    def struct(fields) -> "DataType":
+        return DataType(TypeKind.STRUCT, struct_fields=tuple(fields))
+
     # ---- predicates ----
     @property
     def is_string(self) -> bool:
@@ -158,8 +185,14 @@ class DataType:
         return self.is_integer or self.is_float or self.is_decimal
 
     @property
+    def is_nested(self) -> bool:
+        return self.kind in (TypeKind.ARRAY, TypeKind.MAP, TypeKind.STRUCT)
+
+    @property
     def np_dtype(self) -> np.dtype:
         """Physical numpy/jnp dtype of the data buffer."""
+        if self.is_nested:
+            raise TypeError(f"nested type {self!r} has no single buffer dtype")
         if self.is_string:
             return np.dtype(np.uint8)
         return np.dtype(_FIXED_NP[self.kind])
@@ -169,6 +202,13 @@ class DataType:
             return f"decimal({self.precision},{self.scale})"
         if self.is_string:
             return f"{self.kind.name.lower()}[{self.string_width}]"
+        if self.kind == TypeKind.ARRAY:
+            return f"array<{self.elem!r}>[{self.max_elems}]"
+        if self.kind == TypeKind.MAP:
+            return f"map<{self.key!r},{self.value!r}>[{self.max_elems}]"
+        if self.kind == TypeKind.STRUCT:
+            inner = ", ".join(repr(f) for f in self.struct_fields)
+            return f"struct<{inner}>"
         return self.kind.name.lower()
 
 

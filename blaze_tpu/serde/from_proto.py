@@ -11,8 +11,9 @@ import pickle
 from typing import List, Optional
 
 from ..exprs.ir import (
-    Alias, BinOp, Case, Cast, Col, Expr, InList, IsNotNull, IsNull, Like,
-    Lit, Not, ScalarFunc,
+    Alias, BinOp, Case, Cast, Col, Expr, GetIndexedField, GetMapValue,
+    GetStructField, InList, IsNotNull, IsNull, Like, Lit, NamedStruct, Not,
+    ScalarFunc,
 )
 from ..schema import DataType, Field, Schema, TypeKind
 from . import plan_pb2 as pb
@@ -24,6 +25,14 @@ def dtype_from_proto(t: pb.DataTypeProto) -> DataType:
         return DataType.decimal(t.precision, t.scale)
     if kind in (TypeKind.STRING, TypeKind.BINARY):
         return DataType(kind, string_width=t.string_width or 64)
+    if kind == TypeKind.ARRAY:
+        return DataType.array(dtype_from_proto(t.elem), t.max_elems)
+    if kind == TypeKind.MAP:
+        return DataType.map(dtype_from_proto(t.key), dtype_from_proto(t.value), t.max_elems)
+    if kind == TypeKind.STRUCT:
+        return DataType.struct(
+            [Field(f.name, dtype_from_proto(f.dtype), f.nullable) for f in t.struct_fields]
+        )
     return DataType(kind)
 
 
@@ -112,6 +121,17 @@ def expr_from_proto(n: pb.ExprNode) -> Expr:
         return Like(expr_from_proto(n.like.child), n.like.pattern, n.like.negated)
     if kind == "scalar_func":
         return ScalarFunc(n.scalar_func.name, [expr_from_proto(a) for a in n.scalar_func.args])
+    if kind == "get_indexed_field":
+        return GetIndexedField(expr_from_proto(n.get_indexed_field.child), n.get_indexed_field.index)
+    if kind == "get_map_value":
+        key = _lit_from_proto(n.get_map_value.key).value
+        return GetMapValue(expr_from_proto(n.get_map_value.child), key)
+    if kind == "get_struct_field":
+        return GetStructField(expr_from_proto(n.get_struct_field.child), n.get_struct_field.name)
+    if kind == "named_struct":
+        return NamedStruct(
+            list(n.named_struct.names), [expr_from_proto(e) for e in n.named_struct.exprs]
+        )
     raise NotImplementedError(f"from_proto expr {kind}")
 
 
@@ -244,9 +264,15 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
         )
     if kind == "generate":
         g = n.generate
+        if g.native_kind:
+            from ..ops.generate import NativeGenerator
+
+            gen = NativeGenerator(g.native_kind, expr_from_proto(g.native_expr))
+        else:
+            gen = pickle.loads(g.generator_payload)
         return GenerateExec(
             plan_from_proto(g.input),
-            pickle.loads(g.generator_payload),
+            gen,
             [expr_from_proto(e) for e in g.input_exprs],
             [Field(f.name, dtype_from_proto(f.dtype), f.nullable) for f in g.gen_fields],
             g.outer,

@@ -14,18 +14,31 @@ import pickle
 from typing import Optional
 
 from ..exprs.ir import (
-    Alias, BinOp, Case, Cast, Col, Expr, InList, IsNotNull, IsNull, Like,
-    Lit, Not, ScalarFunc,
+    Alias, BinOp, Case, Cast, Col, Expr, GetIndexedField, GetMapValue,
+    GetStructField, InList, IsNotNull, IsNull, Like, Lit, NamedStruct, Not,
+    ScalarFunc,
 )
 from ..schema import DataType, Field, Schema, TypeKind
 from . import plan_pb2 as pb
 
 
 def dtype_to_proto(t: DataType) -> pb.DataTypeProto:
-    return pb.DataTypeProto(
+    out = pb.DataTypeProto(
         kind=t.kind.value, precision=t.precision, scale=t.scale,
-        string_width=t.string_width,
+        string_width=t.string_width, max_elems=t.max_elems,
     )
+    if t.elem is not None:
+        out.elem.CopyFrom(dtype_to_proto(t.elem))
+    if t.key is not None:
+        out.key.CopyFrom(dtype_to_proto(t.key))
+    if t.value is not None:
+        out.value.CopyFrom(dtype_to_proto(t.value))
+    if t.struct_fields is not None:
+        for f in t.struct_fields:
+            out.struct_fields.append(
+                pb.FieldProto(name=f.name, dtype=dtype_to_proto(f.dtype), nullable=f.nullable)
+            )
+    return out
 
 
 def schema_to_proto(s: Schema) -> pb.SchemaProto:
@@ -114,6 +127,19 @@ def expr_to_proto(e: Expr) -> pb.ExprNode:
         n.scalar_func.name = e.name
         for a in e.args:
             n.scalar_func.args.add().CopyFrom(expr_to_proto(a))
+    elif isinstance(e, GetIndexedField):
+        n.get_indexed_field.child.CopyFrom(expr_to_proto(e.child))
+        n.get_indexed_field.index = e.index
+    elif isinstance(e, GetMapValue):
+        n.get_map_value.child.CopyFrom(expr_to_proto(e.child))
+        n.get_map_value.key.CopyFrom(_lit_to_proto(Lit(e.key)))
+    elif isinstance(e, GetStructField):
+        n.get_struct_field.child.CopyFrom(expr_to_proto(e.child))
+        n.get_struct_field.name = e.name
+    elif isinstance(e, NamedStruct):
+        n.named_struct.names.extend(e.names)
+        for a in e.exprs:
+            n.named_struct.exprs.add().CopyFrom(expr_to_proto(a))
     else:
         raise NotImplementedError(f"to_proto for {type(e).__name__}")
     return n
@@ -269,8 +295,14 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
                 ep.exprs.add().CopyFrom(expr_to_proto(e))
         out.expand.names.extend(node.schema.names)
     elif isinstance(node, GenerateExec):
+        from ..ops.generate import NativeGenerator
+
         out.generate.input.CopyFrom(plan_to_proto(node.children[0]))
-        out.generate.generator_payload = pickle.dumps(node.generator)
+        if isinstance(node.generator, NativeGenerator):
+            out.generate.native_kind = node.generator.kind
+            out.generate.native_expr.CopyFrom(expr_to_proto(node.generator.expr))
+        else:
+            out.generate.generator_payload = pickle.dumps(node.generator)
         for e in node.input_exprs:
             out.generate.input_exprs.add().CopyFrom(expr_to_proto(e))
         for f in node.gen_fields:
