@@ -11,8 +11,16 @@ produce wrong matches — exactness does not rest on the hash).
 """
 
 from .core import JoinMap, JoinType
-from .broadcast import BroadcastJoinExec
+from .broadcast import BroadcastJoinBuildHashMapExec, BroadcastJoinExec, clear_join_map_cache
 from .hash_join import HashJoinExec
 from .smj import SortMergeJoinExec
 
-__all__ = ["JoinMap", "JoinType", "BroadcastJoinExec", "HashJoinExec", "SortMergeJoinExec"]
+__all__ = [
+    "JoinMap",
+    "JoinType",
+    "BroadcastJoinBuildHashMapExec",
+    "BroadcastJoinExec",
+    "HashJoinExec",
+    "SortMergeJoinExec",
+    "clear_join_map_cache",
+]

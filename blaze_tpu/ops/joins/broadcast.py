@@ -1,23 +1,127 @@
 """Broadcast hash join.
 
 ≙ reference BroadcastJoinExec (broadcast_join_exec.rs:76-567) +
-BroadcastJoinBuildHashMapExec: the build side arrives replicated (via
-BroadcastExchange), the JoinMap is built once per executor and cached
-(≙ get_cached_join_hash_map, broadcast_join_exec.rs:456-560), and every
-probe partition streams against it.
+BroadcastJoinBuildHashMapExec (broadcast_join_build_hash_map_exec.rs:41):
+the build side is either raw replicated batches (map built locally) or a
+pre-built SERIALIZED JoinMap riding the broadcast IPC path as a one-row
+binary batch; probe executors rebuild it with buffer copies only and
+cache it per executor keyed by the broadcast id
+(≙ get_cached_join_hash_map, broadcast_join_exec.rs:456-560).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
 
-from ...batch import RecordBatch, concat_batches
+from ...batch import RecordBatch, column_from_strings, concat_batches
 from ...exprs.ir import Expr
 from ...runtime.context import TaskContext
-from ...schema import Schema
+from ...schema import DataType, Field, Schema
 from ..base import BatchStream, ExecNode
-from .core import Joiner, JoinerState, JoinMap, JoinType
+from .core import Joiner, JoinerState, JoinMap, JoinType, build_join_map, make_build_kernel
+
+MAP_COL = "join_map#bytes"
+
+
+def _is_map_schema(s: Schema) -> bool:
+    return len(s.fields) == 1 and s.fields[0].name == MAP_COL
+
+
+def _collect_child_batch(child: ExecNode, partitions) -> RecordBatch:
+    """Drain the given partitions of ``child`` into one device batch
+    (empty-schema batch when nothing arrives)."""
+    batches: List[RecordBatch] = []
+    for p in partitions:
+        for b in child.execute(p, TaskContext(p, child.num_partitions())):
+            batches.append(b)
+    if batches:
+        return concat_batches(batches).to_device()
+    from ...batch import batch_from_pydict
+
+    return batch_from_pydict({f.name: [] for f in child.schema.fields}, child.schema)
+
+
+class BroadcastJoinBuildHashMapExec(ExecNode):
+    """Drains its child (the broadcast build side), builds the
+    serializable JoinMap ONCE, and emits it as a single-row binary
+    batch — so the *map*, not the raw rows, is what gets broadcast
+    (≙ broadcast_join_build_hash_map_exec.rs:41 + the raw-bytes map
+    serde in join_hash_map.rs:290)."""
+
+    def __init__(self, child: ExecNode, keys: Sequence[Expr]):
+        super().__init__([child])
+        self.keys = list(keys)
+        self._build_kernel = make_build_kernel(child.schema, self.keys)
+        self._payload: Optional[bytes] = None
+        self._lock = threading.Lock()
+
+    @property
+    def data_schema(self) -> Schema:
+        return self.children[0].schema
+
+    @property
+    def schema(self) -> Schema:
+        return Schema([Field(MAP_COL, DataType.binary(8))])
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def _build_payload(self, ctx: TaskContext) -> bytes:
+        # hold the lock across the build: concurrent first callers must
+        # not each drain the child and build the map redundantly
+        with self._lock:
+            if self._payload is None:
+                child = self.children[0]
+                data = _collect_child_batch(child, range(child.num_partitions()))
+                with self.metrics.timer("build_hash_map_time"):
+                    self._payload = build_join_map(data, self._build_kernel).serialize()
+            return self._payload
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        def stream():
+            payload = self._build_payload(ctx)
+            # exact width: the payload is opaque bytes, power-of-two
+            # padding would waste up to ~2x on multi-MB maps
+            w = max(len(payload), 1)
+            col = column_from_strings([payload], width=w, capacity=1,
+                                      dtype=DataType.binary(w))
+            self.metrics.add("output_rows", 1)
+            yield RecordBatch(self.schema, [col], 1)
+
+        return stream()
+
+
+# per-executor (process-wide) map cache keyed by broadcast id — survives
+# plan re-instantiation and task retries within the executor lifetime
+# (≙ broadcast_join_exec.rs:456-560 per-executor cache keyed by the
+# broadcast's unique id).  Bounded LRU: each entry pins a full
+# device-resident build batch, so old broadcasts must age out.
+_MAP_CACHE: "OrderedDict[str, JoinMap]" = OrderedDict()
+_MAP_CACHE_LOCK = threading.Lock()
+_MAP_CACHE_MAX = 8
+
+
+def _cache_get(key: str) -> Optional[JoinMap]:
+    with _MAP_CACHE_LOCK:
+        m = _MAP_CACHE.get(key)
+        if m is not None:
+            _MAP_CACHE.move_to_end(key)
+        return m
+
+
+def _cache_put(key: str, m: JoinMap) -> None:
+    with _MAP_CACHE_LOCK:
+        _MAP_CACHE[key] = m
+        _MAP_CACHE.move_to_end(key)
+        while len(_MAP_CACHE) > _MAP_CACHE_MAX:
+            _MAP_CACHE.popitem(last=False)
+
+
+def clear_join_map_cache() -> None:
+    with _MAP_CACHE_LOCK:
+        _MAP_CACHE.clear()
 
 
 class BroadcastJoinExec(ExecNode):
@@ -29,17 +133,31 @@ class BroadcastJoinExec(ExecNode):
         probe_keys: Sequence[Expr],
         join_type: JoinType,
         build_is_left: bool,
+        build_data_schema: Optional[Schema] = None,
+        cached_build_id: Optional[str] = None,
     ):
         super().__init__([build, probe])
         self.build_keys = list(build_keys)
         self.probe_keys = list(probe_keys)
         self.join_type = join_type
         self.build_is_left = build_is_left
+        self._map_mode = _is_map_schema(build.schema)
+        if self._map_mode and build_data_schema is None:
+            # recover the data schema from a BuildHashMap node in the
+            # build subtree (it may sit under a BroadcastExchange)
+            node = build
+            while node is not None and not isinstance(node, BroadcastJoinBuildHashMapExec):
+                node = node.children[0] if node.children else None
+            if node is None:
+                raise ValueError("map-mode build side requires build_data_schema")
+            build_data_schema = node.data_schema
+        self.build_data_schema = build_data_schema or build.schema
+        self.cached_build_id = cached_build_id
         self._joiner = Joiner(
-            probe.schema, build.schema, probe_keys, build_keys, join_type,
+            probe.schema, self.build_data_schema, probe_keys, build_keys, join_type,
             probe_is_left=not build_is_left,
         )
-        # per-executor cached map, built once across all probe partitions
+        # per-instance cached map, built once across all probe partitions
         self._cached_map: Optional[JoinMap] = None
         self._map_lock = threading.Lock()
 
@@ -50,25 +168,38 @@ class BroadcastJoinExec(ExecNode):
     def num_partitions(self) -> int:
         return self.children[1].num_partitions()
 
+    def _read_map_payload(self, ctx: TaskContext) -> bytes:
+        parts: List[bytes] = []
+        for b in self.children[0].execute(0, ctx):
+            c = b.columns[0].to_host()
+            for i in range(b.num_rows):
+                parts.append(bytes(c.data[i, : int(c.lengths[i])]))
+        assert parts, "broadcast build produced no join-map payload"
+        return b"".join(parts)
+
     def _get_map(self, ctx: TaskContext) -> JoinMap:
         with self._map_lock:
             if self._cached_map is not None:
                 return self._cached_map
+        if self.cached_build_id is not None:
+            m = _cache_get(self.cached_build_id)
+            if m is not None:
+                self.metrics.add("hashmap_cache_hit", 1)
+                with self._map_lock:
+                    self._cached_map = m
+                return m
         with self.metrics.timer("build_hash_map_time"):
-            build = self.children[0]
-            batches: List[RecordBatch] = []
-            # broadcast child is replicated: read partition 0
-            for b in build.execute(0, ctx):
-                batches.append(b)
-            if batches:
-                data = concat_batches(batches).to_device()
+            if self._map_mode:
+                # O(1) rebuild: buffer copies only, no re-sort/re-hash
+                m = JoinMap.deserialize(self._read_map_payload(ctx), self.build_data_schema)
             else:
-                from ...batch import batch_from_pydict
-
-                data = batch_from_pydict({f.name: [] for f in build.schema.fields}, build.schema)
-            m = self._joiner.build_map(data)
+                # broadcast child is replicated: read partition 0
+                data = _collect_child_batch(self.children[0], [0])
+                m = self._joiner.build_map(data)
         with self._map_lock:
             self._cached_map = m
+        if self.cached_build_id is not None:
+            _cache_put(self.cached_build_id, m)
         return m
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:

@@ -44,7 +44,12 @@ class JoinType(enum.Enum):
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class JoinMap:
-    """Sorted build-side key table + the build batch it indexes."""
+    """Sorted build-side key table + the build batch it indexes.
+
+    Raw-bytes serializable (≙ join_hash_map.rs:290-454): the serialized
+    form carries the sorted table AND the data batch, so a probe-side
+    executor rebuilds it with buffer copies only — no re-sort, no key
+    re-hash."""
 
     sorted_keys: jnp.ndarray   # uint64 (cap,) sorted
     sorted_rows: jnp.ndarray   # int32 (cap,) original row per key
@@ -58,6 +63,59 @@ class JoinMap:
     def tree_unflatten(cls, aux, children):
         sk, sr, batch = children
         return cls(sk, sr, aux[0], batch)
+
+    def serialize(self) -> bytes:
+        import struct
+
+        from ...io.batch_serde import serialize_batch
+
+        sk = np.asarray(self.sorted_keys, dtype=np.uint64)
+        sr = np.asarray(self.sorted_rows, dtype=np.int32)
+        head = struct.pack("<II", self.num_rows, sk.shape[0])
+        return head + sk.tobytes() + sr.tobytes() + serialize_batch(self.batch)
+
+    @classmethod
+    def deserialize(cls, data: bytes, build_schema: Schema) -> "JoinMap":
+        import struct
+
+        from ...io.batch_serde import deserialize_batch
+
+        num_rows, cap = struct.unpack_from("<II", data, 0)
+        off = 8
+        sk = np.frombuffer(data, np.uint64, cap, off).copy()
+        off += 8 * cap
+        sr = np.frombuffer(data, np.int32, cap, off).copy()
+        off += 4 * cap
+        # memoryview slice: no second full-payload copy
+        batch = (
+            deserialize_batch(memoryview(data)[off:], build_schema)
+            .with_capacity(cap)
+            .to_device()
+        )
+        return cls(jnp.asarray(sk), jnp.asarray(sr), num_rows, batch)
+
+
+def make_build_kernel(build_schema: Schema, build_keys: Sequence[Expr]):
+    """Jitted sorted-key-table builder over the build schema (shared by
+    Joiner and BroadcastJoinBuildHashMapExec)."""
+    build_keys = list(build_keys)
+
+    @jax.jit
+    def build_kernel(cols: Tuple[Column, ...], num_rows):
+        cap = cols[0].validity.shape[0]
+        env = {f.name: c for f, c in zip(build_schema.fields, cols)}
+        key_cols = [lower(e, build_schema, env, cap) for e in build_keys]
+        live = jnp.arange(cap) < num_rows
+        keys = jnp.where(live, _key_hash(key_cols), _SENTINEL)
+        rows = jnp.arange(cap, dtype=jnp.int32)
+        return jax.lax.sort((keys, rows), num_keys=1)
+
+    return build_kernel
+
+
+def build_join_map(batch: RecordBatch, build_kernel) -> JoinMap:
+    sk, sr = build_kernel(tuple(batch.columns), batch.num_rows)
+    return JoinMap(sk, sr, batch.num_rows, batch)
 
 
 _SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -190,17 +248,7 @@ class Joiner:
         build_keys = self.build_keys
         probe_keys = self.probe_keys
 
-        @jax.jit
-        def build_kernel(cols: Tuple[Column, ...], num_rows):
-            cap = cols[0].validity.shape[0]
-            env = {f.name: c for f, c in zip(build_schema.fields, cols)}
-            key_cols = [lower(e, build_schema, env, cap) for e in build_keys]
-            live = jnp.arange(cap) < num_rows
-            keys = jnp.where(live, _key_hash(key_cols), _SENTINEL)
-            rows = jnp.arange(cap, dtype=jnp.int32)
-            return jax.lax.sort((keys, rows), num_keys=1)
-
-        self._build_kernel = build_kernel
+        self._build_kernel = make_build_kernel(build_schema, build_keys)
 
         @jax.jit
         def candidate_kernel(cols, jmap_keys, num_rows):
@@ -256,8 +304,7 @@ class Joiner:
     # ------------------------------------------------------------ build
 
     def build_map(self, batch: RecordBatch) -> JoinMap:
-        sk, sr = self._build_kernel(tuple(batch.columns), batch.num_rows)
-        return JoinMap(sk, sr, batch.num_rows, batch)
+        return build_join_map(batch, self._build_kernel)
 
     # ------------------------------------------------------------ probe
 

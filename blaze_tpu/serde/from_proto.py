@@ -229,12 +229,26 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
     if kind in ("broadcast_join", "hash_join"):
         j = n.broadcast_join if kind == "broadcast_join" else n.hash_join
         cls = BroadcastJoinExec if kind == "broadcast_join" else HashJoinExec
+        extra = {}
+        if kind == "broadcast_join":
+            if j.build_data_schema.fields:
+                extra["build_data_schema"] = schema_from_proto(j.build_data_schema)
+            if j.cached_build_id:
+                extra["cached_build_id"] = j.cached_build_id
         return cls(
             plan_from_proto(j.build), plan_from_proto(j.probe),
             [expr_from_proto(e) for e in j.build_keys],
             [expr_from_proto(e) for e in j.probe_keys],
             JoinType[pb.JoinTypeProto.Name(j.join_type)],
             j.build_is_left,
+            **extra,
+        )
+    if kind == "broadcast_join_build_hash_map":
+        from ..ops.joins import BroadcastJoinBuildHashMapExec
+
+        b = n.broadcast_join_build_hash_map
+        return BroadcastJoinBuildHashMapExec(
+            plan_from_proto(b.input), [expr_from_proto(e) for e in b.keys]
         )
     if kind == "sort_merge_join":
         j = n.sort_merge_join

@@ -167,7 +167,12 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
         ParquetScanExec, ProjectExec, RenameColumnsExec, SortExec, UnionExec,
         WindowExec,
     )
-    from ..ops.joins import BroadcastJoinExec, HashJoinExec, SortMergeJoinExec
+    from ..ops.joins import (
+        BroadcastJoinBuildHashMapExec,
+        BroadcastJoinExec,
+        HashJoinExec,
+        SortMergeJoinExec,
+    )
     from ..parallel.broadcast import IpcWriterExec
     from ..parallel.shuffle import IpcReaderExec, ShuffleWriterExec
     from ..runtime.context import RESOURCES
@@ -262,6 +267,14 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
             dst.probe_keys.add().CopyFrom(expr_to_proto(e))
         dst.join_type = pb.JoinTypeProto.Value(node.join_type.name)
         dst.build_is_left = node.build_is_left
+        if isinstance(node, BroadcastJoinExec):
+            dst.build_data_schema.CopyFrom(schema_to_proto(node.build_data_schema))
+            if node.cached_build_id:
+                dst.cached_build_id = node.cached_build_id
+    elif isinstance(node, BroadcastJoinBuildHashMapExec):
+        out.broadcast_join_build_hash_map.input.CopyFrom(plan_to_proto(node.children[0]))
+        for e in node.keys:
+            out.broadcast_join_build_hash_map.keys.add().CopyFrom(expr_to_proto(e))
     elif isinstance(node, SortMergeJoinExec):
         out.sort_merge_join.left.CopyFrom(plan_to_proto(node.children[0]))
         out.sort_merge_join.right.CopyFrom(plan_to_proto(node.children[1]))
