@@ -29,12 +29,15 @@ def _is_map_schema(s: Schema) -> bool:
     return len(s.fields) == 1 and s.fields[0].name == MAP_COL
 
 
-def _collect_child_batch(child: ExecNode, partitions) -> RecordBatch:
+def _collect_child_batch(child: ExecNode, partitions, ctx: TaskContext) -> RecordBatch:
     """Drain the given partitions of ``child`` into one device batch
-    (empty-schema batch when nothing arrives)."""
+    (empty-schema batch when nothing arrives).  The caller's ctx
+    propagates task cancellation into the drain."""
     batches: List[RecordBatch] = []
     for p in partitions:
         for b in child.execute(p, TaskContext(p, child.num_partitions())):
+            if not ctx.is_task_running():
+                break
             batches.append(b)
     if batches:
         return concat_batches(batches).to_device()
@@ -63,6 +66,9 @@ class BroadcastJoinBuildHashMapExec(ExecNode):
 
     @property
     def schema(self) -> Schema:
+        # NOMINAL width: the payload column's true width is chosen per
+        # batch at emit time (the serde wire format carries it); nothing
+        # may size buffers from this declared dtype
         return Schema([Field(MAP_COL, DataType.binary(8))])
 
     def num_partitions(self) -> int:
@@ -74,7 +80,7 @@ class BroadcastJoinBuildHashMapExec(ExecNode):
         with self._lock:
             if self._payload is None:
                 child = self.children[0]
-                data = _collect_child_batch(child, range(child.num_partitions()))
+                data = _collect_child_batch(child, range(child.num_partitions()), ctx)
                 with self.metrics.timer("build_hash_map_time"):
                     self._payload = build_join_map(data, self._build_kernel).serialize()
             return self._payload
@@ -82,13 +88,19 @@ class BroadcastJoinBuildHashMapExec(ExecNode):
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         def stream():
             payload = self._build_payload(ctx)
-            # exact width: the payload is opaque bytes, power-of-two
-            # padding would waste up to ~2x on multi-MB maps
-            w = max(len(payload), 1)
-            col = column_from_strings([payload], width=w, capacity=1,
+            # chunk the payload over MIN_CAPACITY rows: a one-row batch
+            # would be bucket-padded to MIN_CAPACITY rows downstream,
+            # inflating a w-byte map to 1024*w; chunked, padding waste
+            # is bounded by one row's width
+            from ... import conf
+
+            n_rows = int(conf.MIN_CAPACITY.get())
+            w = max(8, -(-len(payload) // n_rows))
+            chunks = [payload[i * w : (i + 1) * w] for i in range(n_rows)]
+            col = column_from_strings(chunks, width=w, capacity=n_rows,
                                       dtype=DataType.binary(w))
-            self.metrics.add("output_rows", 1)
-            yield RecordBatch(self.schema, [col], 1)
+            self.metrics.add("output_rows", n_rows)
+            yield RecordBatch(self.schema, [col], n_rows)
 
         return stream()
 
@@ -194,7 +206,7 @@ class BroadcastJoinExec(ExecNode):
                 m = JoinMap.deserialize(self._read_map_payload(ctx), self.build_data_schema)
             else:
                 # broadcast child is replicated: read partition 0
-                data = _collect_child_batch(self.children[0], [0])
+                data = _collect_child_batch(self.children[0], [0], ctx)
                 m = self._joiner.build_map(data)
         with self._map_lock:
             self._cached_map = m

@@ -257,6 +257,7 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
             [expr_from_proto(e) for e in j.left_keys],
             [expr_from_proto(e) for e in j.right_keys],
             JoinType[pb.JoinTypeProto.Name(j.join_type)],
+            nulls_first=not j.nulls_last,
         )
     if kind == "window":
         w = n.window

@@ -283,6 +283,7 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
         for e in node.right_keys:
             out.sort_merge_join.right_keys.add().CopyFrom(expr_to_proto(e))
         out.sort_merge_join.join_type = pb.JoinTypeProto.Value(node.join_type.name)
+        out.sort_merge_join.nulls_last = not node.nulls_first
     elif isinstance(node, WindowExec):
         out.window.input.CopyFrom(plan_to_proto(node.children[0]))
         for f in node.functions:

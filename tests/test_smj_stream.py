@@ -1,0 +1,193 @@
+"""Streaming sort-merge join: cursor window + bounded memory + spill.
+
+≙ reference sort_merge_join_exec.rs:58-309 + joins/stream_cursor.rs:38.
+Differential oracle: the shuffled-hash join over the same inputs.
+"""
+
+import numpy as np
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.joins import HashJoinExec, JoinType, SortMergeJoinExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.runtime.memmgr import MemManager
+from blaze_tpu.schema import DataType, Field, Schema
+
+L_SCHEMA = Schema([Field("k", DataType.int64()), Field("l", DataType.int32())])
+R_SCHEMA = Schema([Field("k", DataType.int64()), Field("r", DataType.string(8))])
+
+
+def _sorted_batches(schema, rows, batch_rows):
+    """Split key-sorted rows into batches."""
+    out = []
+    for i in range(0, len(rows[schema.names[0]]), batch_rows):
+        out.append(
+            batch_from_pydict({k: v[i : i + batch_rows] for k, v in rows.items()}, schema)
+        )
+    return out
+
+
+def _mk_inputs(n_left=40, n_right=60, batch_rows=8, skew_key=None):
+    rng = np.random.RandomState(7)
+    lkeys = sorted(rng.randint(0, 20, n_left).tolist())
+    rkeys = sorted(rng.randint(0, 20, n_right).tolist())
+    if skew_key is not None:
+        rkeys = sorted(rkeys + [skew_key] * 30)
+    left = {"k": [k if k != 13 else None for k in lkeys], "l": list(range(len(lkeys)))}
+    right = {"k": [k if k != 17 else None for k in rkeys],
+             "r": [f"r{i}" for i in range(len(rkeys))]}
+    lb = _sorted_batches(L_SCHEMA, left, batch_rows)
+    rb = _sorted_batches(R_SCHEMA, right, batch_rows)
+    return lb, rb
+
+
+def _run(join):
+    rows = []
+    for p in range(join.num_partitions()):
+        for b in join.execute(p, TaskContext(p, join.num_partitions())):
+            d = batch_to_pydict(b)
+            rows += list(zip(*[d[f.name] for f in join.schema.fields]))
+    return sorted(rows, key=repr)
+
+
+@pytest.mark.parametrize(
+    "jt",
+    [JoinType.INNER, JoinType.LEFT, JoinType.RIGHT, JoinType.FULL,
+     JoinType.LEFT_SEMI, JoinType.LEFT_ANTI, JoinType.RIGHT_SEMI,
+     JoinType.RIGHT_ANTI, JoinType.EXISTENCE],
+)
+def test_smj_matches_hash_join(jt):
+    lb, rb = _mk_inputs()
+    smj = SortMergeJoinExec(
+        MemoryScanExec([lb], L_SCHEMA), MemoryScanExec([rb], R_SCHEMA),
+        [col("k")], [col("k")], jt,
+    )
+    # oracle: hash join (build = right, probe = left, left output order)
+    hj = HashJoinExec(
+        MemoryScanExec([rb], R_SCHEMA), MemoryScanExec([lb], L_SCHEMA),
+        [col("k")], [col("k")], jt, build_is_left=False,
+    )
+    assert _run(smj) == _run(hj)
+
+
+def test_smj_window_stays_bounded(monkeypatch):
+    """The window holds only key-overlapping batches: with disjoint key
+    ranges per batch it never exceeds a few entries of the 8-batch side."""
+    from blaze_tpu.ops.joins import smj as smj_mod
+
+    left = {"k": list(range(0, 64)), "l": list(range(64))}
+    right = {"k": list(range(0, 64)), "r": [f"r{i}" for i in range(64)]}
+    lb = _sorted_batches(L_SCHEMA, left, 8)
+    rb = _sorted_batches(R_SCHEMA, right, 8)
+    smj = SortMergeJoinExec(
+        MemoryScanExec([lb], L_SCHEMA), MemoryScanExec([rb], R_SCHEMA),
+        [col("k")], [col("k")], JoinType.INNER,
+    )
+    peak = {"n": 0}
+    orig_add = smj_mod._Window.add
+
+    def spy_add(self, entry):
+        orig_add(self, entry)
+        peak["n"] = max(peak["n"], len(self.entries))
+
+    monkeypatch.setattr(smj_mod._Window, "add", spy_add)
+    out = list(smj.execute(0, TaskContext(0, 1)))
+    total = sum(b.num_rows for b in out)
+    assert total == 64
+    assert 0 < peak["n"] <= 3, peak  # never the whole 8-batch side
+
+
+def test_smj_spills_under_capped_budget():
+    """A build side far larger than the memory budget passes via spill,
+    not OOM (VERDICT round-1 item #5)."""
+    n = 4096
+    left = {"k": sorted(np.random.RandomState(3).randint(0, 500, 600).tolist()),
+            "l": list(range(600))}
+    right = {"k": sorted(np.random.RandomState(4).randint(0, 500, n).tolist()),
+             "r": [f"r{i}" for i in range(n)]}
+    lb = _sorted_batches(L_SCHEMA, left, 64)
+    rb = _sorted_batches(R_SCHEMA, right, 256)
+    try:
+        MemManager._global = None
+        MemManager.init(20_000)  # ~20 KB budget; right side is much bigger
+        smj = SortMergeJoinExec(
+            MemoryScanExec([lb], L_SCHEMA), MemoryScanExec([rb], R_SCHEMA),
+            [col("k")], [col("k")], JoinType.INNER,
+        )
+        hj = HashJoinExec(
+            MemoryScanExec([rb], R_SCHEMA), MemoryScanExec([lb], L_SCHEMA),
+            [col("k")], [col("k")], JoinType.INNER, build_is_left=False,
+        )
+        got = _run(smj)
+        MemManager._global = None
+        MemManager.init(int(conf.HOST_SPILL_BUDGET.get()))
+        want = _run(hj)
+        assert got == want
+        assert smj.metrics.get("spill_count") >= 1
+    finally:
+        MemManager._global = None
+        MemManager.init(int(conf.HOST_SPILL_BUDGET.get()))
+
+
+def test_smj_right_join_spills_under_capped_budget():
+    """Build-preserved join under memory pressure: the final flush and
+    eviction emission must survive entries being spilled."""
+    n = 2048
+    left = {"k": sorted(np.random.RandomState(5).randint(0, 300, 300).tolist()),
+            "l": list(range(300))}
+    right = {"k": sorted(np.random.RandomState(6).randint(0, 300, n).tolist()),
+             "r": [f"r{i}" for i in range(n)]}
+    lb = _sorted_batches(L_SCHEMA, left, 64)
+    rb = _sorted_batches(R_SCHEMA, right, 256)
+    try:
+        MemManager._global = None
+        MemManager.init(20_000)
+        smj = SortMergeJoinExec(
+            MemoryScanExec([lb], L_SCHEMA), MemoryScanExec([rb], R_SCHEMA),
+            [col("k")], [col("k")], JoinType.RIGHT,
+        )
+        got = _run(smj)
+        MemManager._global = None
+        MemManager.init(int(conf.HOST_SPILL_BUDGET.get()))
+        hj = HashJoinExec(
+            MemoryScanExec([rb], R_SCHEMA), MemoryScanExec([lb], L_SCHEMA),
+            [col("k")], [col("k")], JoinType.RIGHT, build_is_left=False,
+        )
+        assert got == _run(hj)
+    finally:
+        MemManager._global = None
+        MemManager.init(int(conf.HOST_SPILL_BUDGET.get()))
+
+
+def test_smj_nulls_first_proto_roundtrip():
+    from blaze_tpu.serde.from_proto import plan_from_proto
+    from blaze_tpu.serde.to_proto import plan_to_proto
+
+    lb = _sorted_batches(L_SCHEMA, {"k": [1, 2], "l": [0, 1]}, 2)
+    rb = _sorted_batches(R_SCHEMA, {"k": [1, 2], "r": ["a", "b"]}, 2)
+    smj = SortMergeJoinExec(
+        MemoryScanExec([lb], L_SCHEMA), MemoryScanExec([rb], R_SCHEMA),
+        [col("k")], [col("k")], JoinType.INNER, nulls_first=False,
+    )
+    rt = plan_from_proto(plan_to_proto(smj))
+    assert rt.nulls_first is False
+    assert _run(rt) == _run(smj)
+
+
+def test_smj_nulls_last_ordering():
+    left = {"k": [1, 2, None, None], "l": [0, 1, 2, 3]}
+    right = {"k": [1, 1, 2, None], "r": ["a", "b", "c", "d"]}
+    lb = _sorted_batches(L_SCHEMA, left, 2)
+    rb = _sorted_batches(R_SCHEMA, right, 2)
+    smj = SortMergeJoinExec(
+        MemoryScanExec([lb], L_SCHEMA), MemoryScanExec([rb], R_SCHEMA),
+        [col("k")], [col("k")], JoinType.FULL, nulls_first=False,
+    )
+    hj = HashJoinExec(
+        MemoryScanExec([rb], R_SCHEMA), MemoryScanExec([lb], L_SCHEMA),
+        [col("k")], [col("k")], JoinType.FULL, build_is_left=False,
+    )
+    assert _run(smj) == _run(hj)
