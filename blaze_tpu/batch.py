@@ -212,6 +212,12 @@ def _scalar_to_physical(dtype: DataType, v):
         return int(round(v * 10**dtype.scale))
     if dtype.kind == TypeKind.BOOL:
         return bool(v)
+    if dtype.kind == TypeKind.DATE32 and not isinstance(v, (int, np.integer)):
+        import datetime
+
+        if isinstance(v, str):
+            v = datetime.date.fromisoformat(v)
+        return (v - datetime.date(1970, 1, 1)).days
     return v
 
 

@@ -609,9 +609,23 @@ def _lower_like(expr: Like, schema, cols, n) -> Column:
 # ------------------------------------------------- host-fallback support
 
 # scalar functions with data-dependent work no fixed-shape device
-# kernel can express; evaluated per batch on host (≙ the reference
-# keeps these native-CPU-side too: spark_get_json_object.rs)
-HOST_SCALAR_FUNCS = frozenset({"get_json_object", "get_parsed_json_object", "parse_json"})
+# kernel can express; evaluated per batch on host.  This matches the
+# reference's architecture: ALL its scalar functions run on native CPU
+# (datafusion-ext-functions) — here only the hot-path ones get device
+# kernels, the long tail runs on host via functions.HOST_IMPLS.
+_JSON_HOST_FUNCS = frozenset({"get_json_object", "get_parsed_json_object", "parse_json"})
+
+
+class _HostFuncNames:
+    """Set-like view over json host funcs + the registered HOST_IMPLS."""
+
+    def __contains__(self, name) -> bool:
+        from .functions import HOST_IMPLS
+
+        return name in _JSON_HOST_FUNCS or name in HOST_IMPLS
+
+
+HOST_SCALAR_FUNCS = _HostFuncNames()
 
 
 def needs_host(expr: Expr) -> bool:
@@ -734,6 +748,52 @@ def host_eval(expr: Expr, batch) -> Column:
                 expr.dtype.np_dtype,
             )
         return column_from_numpy(expr.dtype, vals, validity, batch.capacity).to_device()
+
+    if isinstance(expr, ScalarFunc) and expr.name in HOST_SCALAR_FUNCS and (
+        expr.name not in _JSON_HOST_FUNCS
+    ):
+        # generic host function (functions.HOST_IMPLS): evaluate args
+        # (device subtrees lowered eagerly, nested host calls recursed),
+        # apply the python impl per row, rebuild a device column
+        from ..batch import column_from_pylist, column_to_pylist
+        from .functions import HOST_IMPLS
+
+        impl, null_prop, wants_types = HOST_IMPLS[expr.name]
+        out_dt = infer_dtype(expr, batch.schema)
+        arg_types = [infer_dtype(a, batch.schema) for a in expr.args]
+
+        def arg_values(a: Expr) -> List:
+            if isinstance(a, Lit):
+                return [a.value] * batch.num_rows
+            if isinstance(a, ScalarFunc) and a.name in HOST_SCALAR_FUNCS:
+                c = host_eval(a, batch)
+            else:
+                env = {f.name: c for f, c in zip(batch.schema.fields, batch.columns)}
+                c = lower(a, batch.schema, env, batch.capacity)
+            return column_to_pylist(c, batch.num_rows)
+
+        args = [arg_values(a) for a in expr.args]
+        out_vals: List = []
+        for row in zip(*args) if args else [()] * batch.num_rows:
+            if null_prop and any(v is None for v in row):
+                out_vals.append(None)
+            else:
+                out_vals.append(impl(arg_types, *row) if wants_types else impl(*row))
+        if out_dt.is_string:
+            w = out_dt.string_width
+            long = sum(
+                1 for v in out_vals if v is not None and len(v.encode("utf-8")) > w
+            )
+            if long:
+                logging.getLogger(__name__).warning(
+                    "%s: %d result(s) exceeded string width %d and were nulled",
+                    expr.name, long, w,
+                )
+                out_vals = [
+                    v if v is None or len(v.encode("utf-8")) <= w else None
+                    for v in out_vals
+                ]
+        return column_from_pylist(out_dt, out_vals, capacity=batch.capacity).to_device()
 
     if isinstance(expr, ScalarFunc) and expr.name in HOST_SCALAR_FUNCS:
         from .json_path import get_json_object, parse_json

@@ -31,13 +31,16 @@ def _is_map_schema(s: Schema) -> bool:
 
 def _collect_child_batch(child: ExecNode, partitions, ctx: TaskContext) -> RecordBatch:
     """Drain the given partitions of ``child`` into one device batch
-    (empty-schema batch when nothing arrives).  The caller's ctx
-    propagates task cancellation into the drain."""
+    (empty-schema batch when nothing arrives).  Cancellation RAISES —
+    a silently truncated build side would be memoized into the payload
+    / per-executor map caches and poison every later task."""
+    from ...runtime.context import TaskCancelled
+
     batches: List[RecordBatch] = []
     for p in partitions:
         for b in child.execute(p, TaskContext(p, child.num_partitions())):
             if not ctx.is_task_running():
-                break
+                raise TaskCancelled("broadcast build drain cancelled")
             batches.append(b)
     if batches:
         return concat_batches(batches).to_device()

@@ -62,14 +62,17 @@ def _cmp_key(a: Key, b: Key, nulls_first: bool) -> int:
 
 def _boundary_keys(batch: RecordBatch, schema: Schema, keys: Sequence[Expr]) -> Tuple[Key, Key]:
     """(first_row_key, last_row_key) of a non-empty batch as python
-    tuples (None = null) — drives the host-side cursor comparisons."""
+    tuples (None = null) — drives the host-side cursor comparisons.
+    Only the two boundary rows cross device->host (key exprs evaluate
+    once over the batch, then a 2-row gather precedes the sync)."""
     env = {f.name: c for f, c in zip(schema.fields, batch.columns)}
-    cols = [lower(e, schema, env, batch.capacity) for e in keys]
+    edge = jnp.asarray([0, batch.num_rows - 1], jnp.int32)
+    cols = [lower(e, schema, env, batch.capacity).take(edge) for e in keys]
     first: List = []
     last: List = []
     for c in cols:
         ch = c.to_host()
-        for idx, out in ((0, first), (batch.num_rows - 1, last)):
+        for idx, out in ((0, first), (1, last)):
             if not ch.validity[idx]:
                 out.append(None)
             elif ch.dtype.is_string:
@@ -172,6 +175,25 @@ class _Window(MemConsumer):
             for e in self.entries:
                 e.matched |= matched[off : off + e.rows]
                 off += e.rows
+
+    def take_all(self, reload: bool) -> List[Tuple[RecordBatch, np.ndarray]]:
+        """Atomically drain the window (final flush): reload spilled
+        entries if requested, clear accounting, return (batch, matched)
+        pairs.  Done under the lock so a concurrent manager-driven
+        spill() cannot interleave and leak fresh Spill objects."""
+        with self._lock:
+            out = []
+            for e in self.entries:
+                if e.batch is None and reload:
+                    payload = e.spill.read_frame()
+                    e.batch = deserialize_batch(payload, self.schema).to_device()
+                if e.spill is not None:
+                    e.spill.release()
+                    e.spill = None
+                out.append((e.batch, e.matched))
+            self.entries = []
+            self.set_mem_used_no_trigger(0)
+            return out
 
 
 class SortMergeJoinExec(ExecNode):
@@ -287,23 +309,14 @@ class SortMergeJoinExec(ExecNode):
                     if out is not None and out.num_rows:
                         self.metrics.add("output_rows", out.num_rows)
                         yield out
-                # probe exhausted: flush the window...  (use the batch
-                # list materialize() returns — a spill landing after the
-                # reload sets e.batch back to None, but these references
-                # stay alive)
-                if self._build_preserved:
-                    batches = window.materialize()
-                    matched = [e.matched for e in window.entries]
-                    window.entries.clear()
-                    window.set_mem_used_no_trigger(0)
-                    for b, m in zip(batches, matched):
-                        tail = self._emit_entry(b, m)
-                        if tail is not None and tail.num_rows:
-                            self.metrics.add("output_rows", tail.num_rows)
-                            yield tail
-                else:
-                    window.entries.clear()
-                    window.set_mem_used_no_trigger(0)
+                # probe exhausted: flush the window atomically
+                for b, m in window.take_all(reload=self._build_preserved):
+                    if not self._build_preserved:
+                        continue
+                    tail = self._emit_entry(b, m)
+                    if tail is not None and tail.num_rows:
+                        self.metrics.add("output_rows", tail.num_rows)
+                        yield tail
                 # ...and every never-pulled right batch (all unmatched)
                 if self._build_preserved:
                     while True:

@@ -41,6 +41,11 @@ class ResourcesMap:
 RESOURCES = ResourcesMap()
 
 
+class TaskCancelled(Exception):
+    """Raised where silent early-exit would poison a cached/partial
+    result (e.g. a broadcast build drain)."""
+
+
 class TaskContext:
     """One executing task = one partition of one stage."""
 
