@@ -40,8 +40,180 @@ MAGIC = b"PAR1"
 T_BOOLEAN, T_INT32, T_INT64, T_INT96, T_FLOAT, T_DOUBLE, T_BYTE_ARRAY, T_FLBA = range(8)
 # converted types
 CONV_UTF8, CONV_DECIMAL, CONV_DATE, CONV_TS_MICROS = 0, 5, 6, 10
-# codecs
+# codecs (parquet CompressionCodec enum)
 CODEC_UNCOMPRESSED, CODEC_SNAPPY, CODEC_GZIP = 0, 1, 2
+CODEC_LZO, CODEC_BROTLI, CODEC_LZ4, CODEC_ZSTD, CODEC_LZ4_RAW = 3, 4, 5, 6, 7
+# page types
+PAGE_DATA, PAGE_INDEX, PAGE_DICT, PAGE_DATA_V2 = 0, 1, 2, 3
+# encodings
+ENC_PLAIN, ENC_PLAIN_DICT, ENC_RLE, ENC_RLE_DICT = 0, 2, 3, 8
+
+
+def _snappy_decompress(src: bytes) -> bytes:
+    """Pure-python snappy raw-block decode (no external lib in image)."""
+    # uvarint: uncompressed length
+    pos = 0
+    total = 0
+    shift = 0
+    while True:
+        b = src[pos]
+        pos += 1
+        total |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    out = bytearray()
+    n = len(src)
+    while pos < n:
+        tag = src[pos]
+        pos += 1
+        t = tag & 3
+        if t == 0:  # literal
+            ln = (tag >> 2) + 1
+            if ln > 60:
+                extra = ln - 60
+                ln = int.from_bytes(src[pos : pos + extra], "little") + 1
+                pos += extra
+            out += src[pos : pos + ln]
+            pos += ln
+            continue
+        if t == 1:
+            ln = ((tag >> 2) & 7) + 4
+            off = ((tag >> 5) << 8) | src[pos]
+            pos += 1
+        elif t == 2:
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(src[pos : pos + 2], "little")
+            pos += 2
+        else:
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(src[pos : pos + 4], "little")
+            pos += 4
+        start = len(out) - off
+        if off >= ln:
+            out += out[start : start + ln]
+        else:  # overlapping copy
+            for i in range(ln):
+                out.append(out[start + i])
+    if len(out) != total:
+        raise ValueError(f"snappy: decoded {len(out)} bytes, expected {total}")
+    return bytes(out)
+
+
+def _lz4_block_decompress(src: bytes) -> bytes:
+    """Pure-python LZ4 raw-block decode."""
+    pos = 0
+    n = len(src)
+    out = bytearray()
+    while pos < n:
+        token = src[pos]
+        pos += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                b = src[pos]
+                pos += 1
+                lit += b
+                if b != 255:
+                    break
+        out += src[pos : pos + lit]
+        pos += lit
+        if pos >= n:
+            break  # final literal run has no match part
+        off = src[pos] | (src[pos + 1] << 8)
+        pos += 2
+        mlen = token & 15
+        if mlen == 15:
+            while True:
+                b = src[pos]
+                pos += 1
+                mlen += b
+                if b != 255:
+                    break
+        mlen += 4
+        start = len(out) - off
+        if off >= mlen:
+            out += out[start : start + mlen]
+        else:
+            for i in range(mlen):
+                out.append(out[start + i])
+    return bytes(out)
+
+
+def _decompress(payload: bytes, codec: int, uncompressed_size: int) -> bytes:
+    if codec == CODEC_UNCOMPRESSED:
+        return payload
+    if codec == CODEC_GZIP:
+        return gzip.decompress(payload)
+    if codec == CODEC_SNAPPY:
+        return _snappy_decompress(payload)
+    if codec == CODEC_ZSTD:
+        import zstandard
+
+        return zstandard.ZstdDecompressor().decompress(
+            payload, max_output_size=max(uncompressed_size, 1)
+        )
+    if codec == CODEC_LZ4_RAW:
+        return _lz4_block_decompress(payload)
+    if codec == CODEC_LZ4:
+        # hadoop framing: [u32be total][u32be block_len][block]...
+        out = bytearray()
+        pos = 0
+        while pos < len(payload):
+            total = int.from_bytes(payload[pos : pos + 4], "big")
+            pos += 4
+            got = 0
+            while got < total:
+                blen = int.from_bytes(payload[pos : pos + 4], "big")
+                pos += 4
+                piece = _lz4_block_decompress(payload[pos : pos + blen])
+                pos += blen
+                got += len(piece)
+                out += piece
+        return bytes(out)
+    raise NotImplementedError(f"parquet codec {codec}")
+
+
+def _rle_bp_decode(data: bytes, bit_width: int, num_values: int) -> np.ndarray:
+    """General RLE / bit-packed hybrid decode -> int32 values."""
+    out = np.zeros(num_values, np.int32)
+    if bit_width == 0:
+        return out
+    pos = 0
+    filled = 0
+    mask = (1 << bit_width) - 1
+    byte_w = (bit_width + 7) // 8
+    n = len(data)
+    while filled < num_values and pos < n:
+        hdr = 0
+        shift = 0
+        while True:
+            b = data[pos]
+            pos += 1
+            hdr |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        if hdr & 1:  # bit-packed groups of 8
+            groups = hdr >> 1
+            nbytes = groups * bit_width
+            chunk = data[pos : pos + nbytes]
+            pos += nbytes
+            bits = np.unpackbits(
+                np.frombuffer(chunk, np.uint8), bitorder="little"
+            ).reshape(-1, bit_width)
+            vals = (bits.astype(np.int64) << np.arange(bit_width)).sum(axis=1)
+            take = min(len(vals), num_values - filled)
+            out[filled : filled + take] = vals[:take]
+            filled += take
+        else:
+            run = hdr >> 1
+            v = int.from_bytes(data[pos : pos + byte_w], "little") & mask
+            pos += byte_w
+            take = min(run, num_values - filled)
+            out[filled : filled + take] = v
+            filled += take
+    return out
 
 
 def _physical(dtype: DataType) -> int:
@@ -148,8 +320,20 @@ def _plain_encode(dtype: DataType, data: np.ndarray, validity: np.ndarray,
     return bytes(out)
 
 
-def _plain_decode(dtype: DataType, raw: bytes, validity: np.ndarray, width: int):
-    phys = _physical(dtype)
+def _flba_to_int64(raw: bytes, count: int, type_length: int) -> np.ndarray:
+    """FIXED_LEN_BYTE_ARRAY big-endian two's-complement -> int64 (the
+    Spark/pyarrow decimal physical encoding)."""
+    out = np.zeros(count, np.int64)
+    for i in range(count):
+        b = raw[i * type_length : (i + 1) * type_length]
+        out[i] = int.from_bytes(b, "big", signed=True)
+    return out
+
+
+def _plain_decode_phys(phys: int, raw: bytes, validity: np.ndarray, width: int,
+                       type_length: int = 0):
+    """PLAIN decode by the FILE's physical type; caller converts to the
+    requested logical dtype (schema adaption)."""
     n = len(validity)
     nn = int(validity.sum())
     if phys == T_BOOLEAN:
@@ -160,8 +344,22 @@ def _plain_decode(dtype: DataType, raw: bytes, validity: np.ndarray, width: int)
     np_map = {T_INT32: "<i4", T_INT64: "<i8", T_FLOAT: "<f4", T_DOUBLE: "<f8"}
     if phys in np_map:
         vals = np.frombuffer(raw, np_map[phys], count=nn)
-        out = np.zeros(n, dtype=dtype.np_dtype)
-        out[validity] = vals.astype(dtype.np_dtype)
+        out = np.zeros(n, vals.dtype)
+        out[validity] = vals
+        return out, None
+    if phys == T_FLBA:
+        vals = _flba_to_int64(raw, nn, type_length)
+        out = np.zeros(n, np.int64)
+        out[validity] = vals
+        return out, None
+    if phys == T_INT96:
+        # legacy Spark timestamps: 8B nanos-of-day LE + 4B julian day
+        out = np.zeros(n, np.int64)
+        idx = np.nonzero(validity)[0]
+        for j, i in enumerate(idx):
+            nanos = int.from_bytes(raw[j * 12 : j * 12 + 8], "little")
+            julian = int.from_bytes(raw[j * 12 + 8 : j * 12 + 12], "little")
+            out[i] = (julian - 2440588) * 86_400_000_000 + nanos // 1000
         return out, None
     # byte array
     data = np.zeros((n, width), np.uint8)
@@ -326,7 +524,7 @@ def write_parquet(
             w.write_i64(9, ch["offset"])  # data_page_offset
             if ch["stats"] is not None:
                 w.begin_struct(12)
-                w.write_binary(3, struct.pack("<q", ch["null_count"]))
+                w.write_i(3, ch["null_count"], CT_I64)  # null_count: i64 per spec
                 # use modern min_value/max_value fields
                 w.write_binary(5, _stat_bytes(ch["field"].dtype, ch["stats"][1]))
                 w.write_binary(6, _stat_bytes(ch["field"].dtype, ch["stats"][0]))
@@ -358,11 +556,13 @@ class ChunkMeta:
     phys: int
     codec: int
     num_values: int
-    offset: int
+    offset: int                      # first page (dict page if present)
     total_comp: int
     min_value: Optional[bytes] = None
     max_value: Optional[bytes] = None
     null_count: Optional[int] = None
+    max_def: int = 1                 # 0 = REQUIRED column (no def levels)
+    type_length: int = 0             # FLBA byte width
 
 
 @dataclass
@@ -389,6 +589,16 @@ def read_metadata(path: str) -> ParquetFileMeta:
     r = CompactReader(meta)
     fm = r.read_struct()
     schema_elems = [dict(e) for e in fm.get(2, [])]
+    # leaf nullability + FLBA width by name
+    repetition: Dict[str, int] = {}
+    type_lengths: Dict[str, int] = {}
+    for e in schema_elems:
+        if e.get(5):  # has children -> group node (root)
+            continue
+        nm = e.get(4, b"?")
+        nm = nm.decode() if isinstance(nm, (bytes, bytearray)) else str(nm)
+        repetition[nm] = e.get(3, 1)
+        type_lengths[nm] = e.get(2, 0)
     rgs: List[RowGroupMeta] = []
     for rg in fm.get(4, []):
         chunks: Dict[str, ChunkMeta] = {}
@@ -396,47 +606,176 @@ def read_metadata(path: str) -> ParquetFileMeta:
             md = ch.get(3, {})
             name = b"/".join(md.get(3, [b"?"])).decode()
             stats = md.get(12, {})
+            data_off = md.get(9, md.get(2, ch.get(2, 0)))
+            dict_off = md.get(11)  # dictionary_page_offset
+            first = min(data_off, dict_off) if dict_off else data_off
+            nc = stats.get(3)  # null_count: i64 (spec); old subset files: 8B binary
+            if isinstance(nc, (bytes, bytearray)) and len(nc) == 8:
+                nc = struct.unpack("<q", bytes(nc))[0]
+            elif not isinstance(nc, int):
+                nc = None
+            # min/max: prefer modern min_value/max_value (5/6), fall
+            # back to deprecated max/min (1/2)
+            mx = stats.get(5, stats.get(1))
+            mn = stats.get(6, stats.get(2))
             chunks[name] = ChunkMeta(
                 name=name,
                 phys=md.get(1, 0),
                 codec=md.get(4, 0),
                 num_values=md.get(5, 0),
-                offset=md.get(9, md.get(2, ch.get(2, 0))),
+                offset=first,
                 total_comp=md.get(7, 0),
-                min_value=bytes(stats[6]) if 6 in stats else None,
-                max_value=bytes(stats[5]) if 5 in stats else None,
-                null_count=struct.unpack("<q", bytes(stats[3]))[0]
-                if 3 in stats and len(stats.get(3, b"")) == 8
-                else None,
+                min_value=bytes(mn) if mn is not None else None,
+                max_value=bytes(mx) if mx is not None else None,
+                null_count=nc,
+                max_def=0 if repetition.get(name) == 0 else 1,
+                type_length=type_lengths.get(name, 0),
             )
         rgs.append(RowGroupMeta(rows=rg.get(3, 0), chunks=chunks))
     return ParquetFileMeta(num_rows=fm.get(3, 0), schema_elements=schema_elems, row_groups=rgs)
 
 
-def read_column_chunk(path: str, chunk: ChunkMeta, dtype: DataType, nullable: bool = True):
-    """Returns (data, validity, lengths|None) numpy arrays."""
+def _plain_decode_dict_values(phys: int, raw: bytes, count: int, width: int,
+                              type_length: int = 0):
+    """Decode a PLAIN dictionary page into a lookup table."""
+    if phys == T_FLBA:
+        return _flba_to_int64(raw, count, type_length)
+    if phys == T_INT32:
+        return np.frombuffer(raw, "<i4", count=count)
+    if phys == T_INT64:
+        return np.frombuffer(raw, "<i8", count=count)
+    if phys == T_FLOAT:
+        return np.frombuffer(raw, "<f4", count=count)
+    if phys == T_DOUBLE:
+        return np.frombuffer(raw, "<f8", count=count)
+    if phys == T_BOOLEAN:
+        return np.unpackbits(np.frombuffer(raw, np.uint8), bitorder="little")[:count].astype(bool)
+    # byte arrays: (data (count, width), lengths)
+    data = np.zeros((count, width), np.uint8)
+    lengths = np.zeros(count, np.int32)
+    pos = 0
+    for i in range(count):
+        (ln,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        lengths[i] = min(ln, width)
+        data[i, : lengths[i]] = np.frombuffer(raw, np.uint8, count=lengths[i], offset=pos)
+        pos += ln
+    return data, lengths
+
+
+def read_column_chunk(path: str, chunk: ChunkMeta, dtype: DataType):
+    """Decode a full column chunk: every page (v1/v2), PLAIN or
+    dictionary encodings, all supported codecs.  Returns
+    (data, validity, lengths|None) numpy arrays of chunk.num_values
+    rows.  ≙ the arrow-rs page machinery behind parquet_exec.rs:65-418."""
     with open(path, "rb") as f:
         f.seek(chunk.offset)
         blob = f.read(chunk.total_comp if chunk.total_comp else None)
-    r = CompactReader(blob)
-    ph = r.read_struct()
-    uncomp_size = ph.get(2, 0)
-    comp_size = ph.get(3, 0)
-    dph = ph.get(5, {})
-    num_values = dph.get(1, chunk.num_values)
-    payload = blob[r.pos : r.pos + comp_size]
-    if chunk.codec == CODEC_GZIP:
-        payload = gzip.decompress(payload)
-    elif chunk.codec != CODEC_UNCOMPRESSED:
-        raise NotImplementedError(f"codec {chunk.codec}")
-    if nullable:
-        (def_len,) = struct.unpack_from("<I", payload, 0)
-        defs = payload[4 : 4 + def_len]
-        validity, _ = _rle_decode_defs(defs, num_values)
-        values = payload[4 + def_len :]
-    else:
-        validity = np.ones(num_values, bool)
-        values = payload
+
+    n_total = chunk.num_values
     width = dtype.string_width if dtype.is_string else 0
-    data, lengths = _plain_decode(dtype, values, validity, width)
+    validity = np.zeros(n_total, np.bool_)
+    if dtype.is_string:
+        data = np.zeros((n_total, width), np.uint8)
+        lengths = np.zeros(n_total, np.int32)
+    else:
+        data = np.zeros(n_total, dtype.np_dtype)
+        lengths = None
+    dict_table = None  # (values[, lengths]) from the dictionary page
+
+    def emit_values(encoding: int, values: bytes, page_valid: np.ndarray, row0: int):
+        nv = page_valid.shape[0]
+        nn = int(page_valid.sum())
+        sl = slice(row0, row0 + nv)
+        validity[sl] = page_valid
+        if nn == 0:
+            return
+        if encoding in (ENC_PLAIN_DICT, ENC_RLE_DICT):
+            bit_width = values[0]
+            idx = _rle_bp_decode(values[1:], bit_width, nn)
+            if dtype.is_string:
+                dvals, dlens = dict_table
+                rows = row0 + np.nonzero(page_valid)[0]
+                data[rows] = dvals[idx]
+                lengths[rows] = dlens[idx]
+            else:
+                out = np.zeros(nv, dtype.np_dtype)
+                out[page_valid] = dict_table[idx].astype(dtype.np_dtype, copy=False)
+                data[sl] = out
+        elif encoding == ENC_RLE and chunk.phys == T_BOOLEAN:
+            # v2 booleans: u32 length + RLE/bit-packed hybrid, width 1
+            (rl,) = struct.unpack_from("<I", values, 0)
+            bits = _rle_bp_decode(values[4 : 4 + rl], 1, nn).astype(bool)
+            out = np.zeros(nv, np.bool_)
+            out[page_valid] = bits
+            data[sl] = out
+        elif encoding != ENC_PLAIN:
+            # gated, not silently wrong: DELTA_* / BYTE_STREAM_SPLIT
+            raise NotImplementedError(f"parquet page encoding {encoding}")
+        else:  # PLAIN — decode by the file's physical type, then adapt
+            d, l = _plain_decode_phys(chunk.phys, values, page_valid, width,
+                                      chunk.type_length)
+            if dtype.is_string:
+                data[sl, : d.shape[1]] = d[:, :width]
+                lengths[sl] = l
+            else:
+                data[sl] = d.astype(dtype.np_dtype, copy=False)
+
+    pos = 0
+    decoded = 0
+    blob_len = len(blob)
+    view = memoryview(blob)
+    while decoded < n_total and pos < blob_len:
+        r = CompactReader(view[pos:])
+        ph = r.read_struct()
+        header_len = r.pos
+        ptype = ph.get(1, PAGE_DATA)
+        uncomp_size = ph.get(2, 0)
+        comp_size = ph.get(3, uncomp_size)
+        page_raw = blob[pos + header_len : pos + header_len + comp_size]
+        pos += header_len + comp_size
+        if ptype == PAGE_DICT:
+            dh = ph.get(7, {})
+            count = dh.get(1, 0)
+            payload = _decompress(page_raw, chunk.codec, uncomp_size)
+            dict_table = _plain_decode_dict_values(
+                chunk.phys, payload, count, width or 64, chunk.type_length
+            )
+            continue
+        if ptype == PAGE_DATA:
+            dph = ph.get(5, {})
+            nv = dph.get(1, 0)
+            encoding = dph.get(2, ENC_PLAIN)
+            payload = _decompress(page_raw, chunk.codec, uncomp_size)
+            if chunk.max_def > 0:
+                (def_len,) = struct.unpack_from("<I", payload, 0)
+                page_valid, _ = _rle_decode_defs(payload[4 : 4 + def_len], nv)
+                values = payload[4 + def_len :]
+            else:
+                page_valid = np.ones(nv, np.bool_)
+                values = payload
+            emit_values(encoding, values, page_valid, decoded)
+            decoded += nv
+            continue
+        if ptype == PAGE_DATA_V2:
+            dph = ph.get(8, {})
+            nv = dph.get(1, 0)
+            num_nulls = dph.get(2, 0)
+            encoding = dph.get(4, ENC_PLAIN)
+            def_len = dph.get(5, 0)
+            rep_len = dph.get(6, 0)
+            is_compressed = dph.get(7, True)
+            levels = page_raw[: rep_len + def_len]  # NEVER compressed
+            rest = page_raw[rep_len + def_len :]
+            if is_compressed:
+                rest = _decompress(rest, chunk.codec, max(uncomp_size - rep_len - def_len, 1))
+            if chunk.max_def > 0 and def_len:
+                # v2 def levels: RLE hybrid WITHOUT the u32 length prefix
+                page_valid = _rle_bp_decode(levels[rep_len:], 1, nv).astype(bool)
+            else:
+                page_valid = np.ones(nv, np.bool_)
+            emit_values(encoding, rest, page_valid, decoded)
+            decoded += nv
+            continue
+        # index or unknown page: skip
     return data, validity, lengths

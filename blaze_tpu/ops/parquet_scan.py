@@ -11,6 +11,7 @@ Spark-compatible schema adaption (scan/mod.rs:28-187).
 from __future__ import annotations
 
 import datetime
+import struct
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -76,8 +77,16 @@ def _prune_conjuncts(predicate: Optional[Expr]) -> List:
 def _maybe_match(chunk: pq.ChunkMeta, dtype: DataType, op: str, lit_v) -> bool:
     if chunk.min_value is None or chunk.max_value is None:
         return True
-    lo = pq._stat_value(dtype, chunk.min_value)
-    hi = pq._stat_value(dtype, chunk.max_value)
+    try:
+        if chunk.phys == pq.T_FLBA:
+            # FLBA stats (decimal): big-endian signed
+            lo = int.from_bytes(chunk.min_value, "big", signed=True)
+            hi = int.from_bytes(chunk.max_value, "big", signed=True)
+        else:
+            lo = pq._stat_value(dtype, chunk.min_value)
+            hi = pq._stat_value(dtype, chunk.max_value)
+    except (struct.error, ValueError):
+        return True
     try:
         if op == "<":
             return lo < lit_v

@@ -1,0 +1,142 @@
+"""Real-world ORC decode: files written by pyarrow's ORC writer (ORC
+C++ — the same library Spark uses): RLEv2 integers, DIRECT_V2 strings,
+compressed streams (zlib/snappy/lz4/zstd), PRESENT streams, row-index
+streams to skip.
+
+≙ reference orc_exec.rs:53-285 (orc-rust handles these natively;
+round-1 VERDICT item #7 flagged our RLEv1/uncompressed-only subset).
+"""
+
+import datetime
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+from pyarrow import orc as paorc
+
+from blaze_tpu.batch import batch_to_pydict, concat_batches
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.ops.orc_scan import OrcScanExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+
+N = 400
+
+
+def _table():
+    rng = np.random.RandomState(23)
+    ints = rng.randint(-1000, 1000, N)
+    return pa.table(
+        {
+            "i32": pa.array(
+                [None if i % 7 == 0 else int(ints[i]) for i in range(N)], pa.int32()
+            ),
+            "i64": pa.array([int(x) * 1_000_000_007 for x in ints], pa.int64()),
+            "f64": pa.array(
+                [None if i % 11 == 0 else float(ints[i]) / 3 for i in range(N)],
+                pa.float64(),
+            ),
+            "s": pa.array(
+                [None if i % 5 == 0 else f"v_{ints[i] % 41}" for i in range(N)],
+                pa.string(),
+            ),
+            "b": pa.array([bool(ints[i] % 2) for i in range(N)], pa.bool_()),
+            "d": pa.array(
+                [datetime.date(2021, 6, 1) + datetime.timedelta(days=int(x) % 200) for x in ints],
+                pa.date32(),
+            ),
+        }
+    )
+
+
+SCHEMA = Schema(
+    [
+        Field("i32", DataType.int32()),
+        Field("i64", DataType.int64()),
+        Field("f64", DataType.float64()),
+        Field("s", DataType.string(16)),
+        Field("b", DataType.bool_()),
+        Field("d", DataType.date32()),
+    ]
+)
+
+
+def _expected(table):
+    d = table.to_pydict()
+    exp = dict(d)
+    exp["d"] = [None if v is None else (v - datetime.date(1970, 1, 1)).days for v in d["d"]]
+    return exp
+
+
+def _read_ours(path, schema=SCHEMA, predicate=None):
+    scan = OrcScanExec([[str(path)]], schema, predicate)
+    out = list(scan.execute(0, TaskContext(0, 1)))
+    return (
+        batch_to_pydict(concat_batches(out)) if out else {f.name: [] for f in schema.fields}
+    ), scan
+
+
+@pytest.mark.parametrize("codec", ["uncompressed", "zlib", "snappy", "lz4", "zstd"])
+def test_pyarrow_orc_roundtrip(tmp_path, codec):
+    table = _table()
+    path = tmp_path / f"t_{codec}.orc"
+    paorc.write_table(table, path, compression=codec)
+    got, _ = _read_ours(path)
+    exp = _expected(table)
+    for k, want in exp.items():
+        g = got[k]
+        if k == "f64":
+            for a, b in zip(g, want):
+                assert (a is None) == (b is None) and (a is None or abs(a - b) < 1e-9), k
+        else:
+            assert g == want, f"column {k}"
+
+
+def test_multiple_stripes_and_pruning(tmp_path):
+    # sorted + incompressible noise in a second column defeats the
+    # writer's memory-estimate batching so multiple stripes are flushed
+    n = 400_000
+    rng = np.random.RandomState(1)
+    noise = rng.randint(-(2**60), 2**60, n)
+    path = tmp_path / "stripes.orc"
+    w = paorc.ORCWriter(path, compression="zlib", stripe_size=1024 * 1024)
+    w.write(pa.table({"x": pa.array(list(range(n)), pa.int64()),
+                      "pad": pa.array(noise, pa.int64())}))
+    w.close()
+    from blaze_tpu.io import orc as orc_io
+
+    assert len(orc_io.read_metadata(str(path)).stripes) >= 2
+    schema = Schema([Field("x", DataType.int64())])
+    got, scan = _read_ours(path, schema)
+    assert got["x"] == list(range(n))
+    # pruned read: only stripes whose max >= threshold survive
+    threshold = n - 1000
+    got2, scan2 = _read_ours(path, schema, col("x") >= lit(threshold))
+    assert set(range(threshold, n)).issubset(set(got2["x"]))
+    assert len(got2["x"]) < n
+    assert scan2.metrics.get("pruned_stripes") >= 1
+
+
+def test_rlev2_patterns(tmp_path):
+    """Exercise RLEv2 sub-encodings: short-repeat (constants), delta
+    (monotonic), direct (random), patched base (outliers)."""
+    n = 5000
+    rng = np.random.RandomState(5)
+    outliers = rng.randint(0, 1000, n).astype(np.int64)
+    outliers[::501] = 2**45  # forces patched base
+    table = pa.table(
+        {
+            "const": pa.array([7] * n, pa.int64()),
+            "mono": pa.array(list(range(n)), pa.int64()),
+            "rand": pa.array(rng.randint(-(2**30), 2**30, n), pa.int64()),
+            "patched": pa.array(outliers, pa.int64()),
+            "neg_mono": pa.array(list(range(n, 0, -1)), pa.int64()),
+        }
+    )
+    path = tmp_path / "rlev2.orc"
+    paorc.write_table(table, path, compression="zlib")
+    schema = Schema([Field(nm, DataType.int64()) for nm in table.column_names])
+    got, _ = _read_ours(path, schema)
+    for nm in table.column_names:
+        assert got[nm] == table[nm].to_pylist(), nm
