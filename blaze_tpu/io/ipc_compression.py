@@ -1,17 +1,22 @@
 """Framed compressed IPC blocks: ``[u32 len][u8 codec][payload]``.
 
-≙ reference common/ipc_compression.rs:30-335 (same framing idea; the
-reference speaks zstd(1)/lz4 per spark.io.compression.codec with 4 MiB
-target blocks).  Codecs here: 0=raw, 1=zlib(1) (zstd/lz4 libs are not
-in the image; the codec byte keeps the format extensible and the C++
-runtime can add them).
+≙ reference common/ipc_compression.rs:30-335: the reference frames
+``[u32 block_len][codec stream]`` where the stream is a ZSTD frame
+(level 1) or an LZ4 FRAME, per ``spark.io.compression.codec``.  Here
+the same codecs are spoken — zstd via the zstandard package (standard
+zstd frames, byte-interoperable), lz4 via a self-contained LZ4 Frame
+codec (reader handles compressed + linked blocks; writer emits
+store-mode blocks, spec-valid and readable by any lz4 tool) — plus
+zlib and raw for internal spill frames.  One codec byte after the
+length keeps frames self-describing (the reference relies on both
+sides reading the same conf instead).
 """
 
 from __future__ import annotations
 
 import struct
 import zlib
-from typing import BinaryIO, Iterator, Optional
+from typing import BinaryIO, Dict, Iterator, Optional
 
 from .. import conf
 
@@ -19,27 +24,265 @@ TARGET_BLOCK = 4 << 20
 
 CODEC_RAW = 0
 CODEC_ZLIB = 1
+CODEC_ZSTD = 2
+CODEC_LZ4 = 3
+
+ZSTD_LEVEL = 1  # ≙ reference ZSTD_LEVEL
+
+_LZ4_MAGIC = 0x184D2204
+
+
+def lz4_block_compress(src: bytes) -> bytes:
+    """Greedy hash-match LZ4 block compressor (spec-compliant output:
+    any LZ4 decoder reads it)."""
+    n = len(src)
+    out = bytearray()
+
+    def emit(lit: bytes, off: int = 0, mlen: int = 0):
+        ll = len(lit)
+        ml = mlen - 4 if mlen else 0
+        out.append((min(ll, 15) << 4) | (min(ml, 15) if mlen else 0))
+        if ll >= 15:
+            rest = ll - 15
+            while rest >= 255:
+                out.append(255)
+                rest -= 255
+            out.append(rest)
+        out.extend(lit)
+        if mlen:
+            out.append(off & 0xFF)
+            out.append(off >> 8)
+            if ml >= 15:
+                rest = ml - 15
+                while rest >= 255:
+                    out.append(255)
+                    rest -= 255
+                out.append(rest)
+
+    if n < 13:  # too short for any match (spec end constraints)
+        emit(src)
+        return bytes(out)
+    table: Dict[bytes, int] = {}
+    anchor = 0
+    i = 0
+    limit = n - 12  # last match must start >= 12 bytes before end
+    while i <= limit:
+        key = src[i : i + 4]
+        j = table.get(key, -1)
+        table[key] = i
+        if j >= 0 and i - j <= 0xFFFF and src[j : j + 4] == key:
+            mlen = 4
+            end = n - 5  # last 5 bytes must be literals
+            while i + mlen < end and src[j + mlen] == src[i + mlen]:
+                mlen += 1
+            emit(src[anchor:i], i - j, mlen)
+            i += mlen
+            anchor = i
+        else:
+            i += 1
+    emit(src[anchor:])
+    return bytes(out)
+
+
+def lz4_frame_compress(payload: bytes) -> bytes:
+    """LZ4 Frame writer: independent blocks, greedy-compressed (stored
+    verbatim when compression does not help), no checksums.  Readable
+    by any LZ4 frame reader (lz4_flex, pyarrow, lz4 CLI)."""
+    out = bytearray()
+    out += struct.pack("<I", _LZ4_MAGIC)
+    # FLG: version=01, block independence=1, no checksums/content size
+    out.append(0b0110_0000)
+    # BD: block max size 4MB (code 7)
+    out.append(7 << 4)
+    # HC byte: (xxh32(FLG..BD) >> 8) & 0xFF
+    out.append((_xxh32(bytes(out[4:6])) >> 8) & 0xFF)
+    block_max = 4 << 20
+    for off in range(0, max(len(payload), 1), block_max):
+        chunk = payload[off : off + block_max]
+        if not chunk:
+            break
+        comp = lz4_block_compress(chunk)
+        if len(comp) < len(chunk):
+            out += struct.pack("<I", len(comp))
+            out += comp
+        else:
+            out += struct.pack("<I", len(chunk) | 0x80000000)  # stored
+            out += chunk
+    out += struct.pack("<I", 0)  # EndMark
+    return bytes(out)
+
+
+def _xxh32(data: bytes, seed: int = 0) -> int:
+    """xxHash32 (LZ4 frame header checksum)."""
+    P1, P2, P3, P4, P5 = 2654435761, 2246822519, 3266489917, 668265263, 374761393
+    M = 0xFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & M
+
+    n = len(data)
+    pos = 0
+    if n >= 16:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed
+        v4 = (seed - P1) & M
+        while pos + 16 <= n:
+            k1, k2, k3, k4 = struct.unpack_from("<IIII", data, pos)
+            v1 = (rotl((v1 + k1 * P2) & M, 13) * P1) & M
+            v2 = (rotl((v2 + k2 * P2) & M, 13) * P1) & M
+            v3 = (rotl((v3 + k3 * P2) & M, 13) * P1) & M
+            v4 = (rotl((v4 + k4 * P2) & M, 13) * P1) & M
+            pos += 16
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while pos + 4 <= n:
+        (k,) = struct.unpack_from("<I", data, pos)
+        h = (rotl((h + k * P3) & M, 17) * P4) & M
+        pos += 4
+    while pos < n:
+        h = (rotl((h + data[pos] * P5) & M, 11) * P1) & M
+        pos += 1
+    h ^= h >> 15
+    h = (h * P2) & M
+    h ^= h >> 13
+    h = (h * P3) & M
+    h ^= h >> 16
+    return h
+
+
+def lz4_block_decompress(src: bytes, history: Optional[bytearray] = None) -> bytes:
+    """Canonical LZ4 block decode.  With ``history``, matches may reach
+    back into it (linked-block frames) and output is appended IN PLACE
+    (returns b"" then); without, returns the decoded bytes.  The single
+    implementation shared by parquet/orc codecs and the LZ4 frame
+    reader."""
+    out = history if history is not None else bytearray()
+    pos = 0
+    n = len(src)
+    while pos < n:
+        token = src[pos]
+        pos += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                b = src[pos]
+                pos += 1
+                lit += b
+                if b != 255:
+                    break
+        out += src[pos : pos + lit]
+        pos += lit
+        if pos >= n:
+            break  # final literal run has no match part
+        off = src[pos] | (src[pos + 1] << 8)
+        pos += 2
+        mlen = token & 15
+        if mlen == 15:
+            while True:
+                b = src[pos]
+                pos += 1
+                mlen += b
+                if b != 255:
+                    break
+        mlen += 4
+        start = len(out) - off
+        if off >= mlen:
+            out += out[start : start + mlen]
+        else:
+            for i in range(mlen):
+                out.append(out[start + i])
+    return b"" if history is not None else bytes(out)
+
+
+def lz4_frame_decompress(src: bytes) -> bytes:
+    """LZ4 Frame reader: compressed + uncompressed blocks, linked or
+    independent, dictionary-ID header skipped, checksums not verified."""
+    (magic,) = struct.unpack_from("<I", src, 0)
+    if magic != _LZ4_MAGIC:
+        raise ValueError("not an LZ4 frame")
+    flg = src[4]
+    pos = 6  # magic + FLG + BD
+    block_checksum = (flg >> 4) & 1
+    content_size = (flg >> 3) & 1
+    dict_id = flg & 1
+    if content_size:
+        pos += 8
+    if dict_id:
+        pos += 4
+    pos += 1  # HC byte
+    out = bytearray()
+    while True:
+        (bsize,) = struct.unpack_from("<I", src, pos)
+        pos += 4
+        if bsize == 0:  # EndMark
+            break
+        uncompressed = bool(bsize & 0x80000000)
+        bsize &= 0x7FFFFFFF
+        block = src[pos : pos + bsize]
+        pos += bsize
+        if block_checksum:
+            pos += 4
+        if uncompressed:
+            out += block
+        else:
+            # linked blocks reference previous output: decode with the
+            # running buffer as history (appended in place)
+            lz4_block_decompress(block, history=out)
+    return bytes(out)
 
 
 def _codec_id(name: str) -> int:
-    return CODEC_ZLIB if name in ("zlib", "zstd", "lz4") else CODEC_RAW
+    return {
+        "zlib": CODEC_ZLIB,
+        "zstd": CODEC_ZSTD,
+        "lz4": CODEC_LZ4,
+        "raw": CODEC_RAW,
+        "none": CODEC_RAW,
+    }.get(name, CODEC_ZLIB)
 
 
 def compress_frame(payload: bytes, codec: Optional[str] = None) -> bytes:
     cid = _codec_id(codec or str(conf.IO_COMPRESSION_CODEC.get()))
-    if cid == CODEC_ZLIB:
+    if cid == CODEC_ZSTD:
+        import zstandard
+
+        comp = zstandard.ZstdCompressor(level=ZSTD_LEVEL).compress(payload)
+        if len(comp) < len(payload):
+            return struct.pack("<IB", len(comp), CODEC_ZSTD) + comp
+    elif cid == CODEC_LZ4:
+        comp = lz4_frame_compress(payload)
+        if len(comp) < len(payload):
+            return struct.pack("<IB", len(comp), CODEC_LZ4) + comp
+    elif cid == CODEC_ZLIB:
         comp = zlib.compress(payload, 1)
         if len(comp) < len(payload):
             return struct.pack("<IB", len(comp), CODEC_ZLIB) + comp
     return struct.pack("<IB", len(payload), CODEC_RAW) + payload
 
 
-def decompress_frame(frame: bytes) -> bytes:
-    ln, cid = struct.unpack_from("<IB", frame, 0)
-    payload = frame[5 : 5 + ln]
+def _decode(cid: int, payload: bytes) -> bytes:
     if cid == CODEC_ZLIB:
         return zlib.decompress(payload)
+    if cid == CODEC_ZSTD:
+        import zstandard
+
+        try:
+            return zstandard.ZstdDecompressor().decompress(payload)
+        except zstandard.ZstdError:
+            # frame without embedded content size: stream-decompress
+            d = zstandard.ZstdDecompressor().decompressobj()
+            return d.decompress(payload)
+    if cid == CODEC_LZ4:
+        return lz4_frame_decompress(payload)
     return payload
+
+
+def decompress_frame(frame: bytes) -> bytes:
+    ln, cid = struct.unpack_from("<IB", frame, 0)
+    return _decode(cid, frame[5 : 5 + ln])
 
 
 class IpcFrameWriter:
@@ -76,6 +319,4 @@ class IpcFrameReader:
             payload = self._f.read(ln)
             if self._remaining is not None:
                 self._remaining -= 5 + ln
-            if cid == CODEC_ZLIB:
-                payload = zlib.decompress(payload)
-            yield payload
+            yield _decode(cid, payload)

@@ -539,7 +539,9 @@ def write_orc(
     """columns: name -> (data, validity|None, lengths|None for strings)."""
     any_col = next(iter(columns.values()))
     n = any_col[0].shape[0]
-    with open(path, "wb") as f:
+    from .fs import get_fs
+
+    with get_fs(path).create(path) as f:
         f.write(MAGIC)
         stripe_infos: List[Tuple[int, int, int, int]] = []  # offset, dataLen, footLen, rows
         stripe_stats: List[List[bytes]] = []
@@ -735,7 +737,9 @@ def _decode_col_stats(b: bytes):
 
 
 def read_metadata(path: str, string_width: int = 64) -> OrcFileMeta:
-    with open(path, "rb") as f:
+    from .fs import get_fs
+
+    with get_fs(path).open(path) as f:
         f.seek(0, 2)
         size = f.tell()
         f.seek(size - 1)
@@ -845,8 +849,10 @@ def read_stripe(
 
     Handles DIRECT (RLEv1) and DIRECT_V2 (RLEv2) integer encodings,
     DICTIONARY(_V2) strings, and per-stream compressed framing."""
+    from .fs import get_fs
+
     comp = meta.compression
-    with open(path, "rb") as f:
+    with get_fs(path).open(path) as f:
         f.seek(stripe.offset)
         blob = f.read(stripe.data_length)
         foot = orc_decompress(f.read(stripe.footer_length), comp)

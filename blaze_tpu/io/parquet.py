@@ -101,43 +101,10 @@ def _snappy_decompress(src: bytes) -> bytes:
 
 
 def _lz4_block_decompress(src: bytes) -> bytes:
-    """Pure-python LZ4 raw-block decode."""
-    pos = 0
-    n = len(src)
-    out = bytearray()
-    while pos < n:
-        token = src[pos]
-        pos += 1
-        lit = token >> 4
-        if lit == 15:
-            while True:
-                b = src[pos]
-                pos += 1
-                lit += b
-                if b != 255:
-                    break
-        out += src[pos : pos + lit]
-        pos += lit
-        if pos >= n:
-            break  # final literal run has no match part
-        off = src[pos] | (src[pos + 1] << 8)
-        pos += 2
-        mlen = token & 15
-        if mlen == 15:
-            while True:
-                b = src[pos]
-                pos += 1
-                mlen += b
-                if b != 255:
-                    break
-        mlen += 4
-        start = len(out) - off
-        if off >= mlen:
-            out += out[start : start + mlen]
-        else:
-            for i in range(mlen):
-                out.append(out[start + i])
-    return bytes(out)
+    """LZ4 raw-block decode (canonical impl in io.ipc_compression)."""
+    from .ipc_compression import lz4_block_decompress
+
+    return lz4_block_decompress(src)
 
 
 def _decompress(payload: bytes, codec: int, uncompressed_size: int) -> bytes:
@@ -414,8 +381,10 @@ def write_parquet(
     codec: int = CODEC_GZIP,
 ):
     """columns: name -> (data, validity|None, lengths|None) host arrays."""
+    from .fs import get_fs
+
     n = next(iter(columns.values()))[0].shape[0]
-    f = open(path, "wb")
+    f = get_fs(path).create(path)
     f.write(MAGIC)
     row_groups: List[dict] = []
     for rg_start in range(0, max(n, 1), row_group_rows):
@@ -579,7 +548,9 @@ class ParquetFileMeta:
 
 
 def read_metadata(path: str) -> ParquetFileMeta:
-    with open(path, "rb") as f:
+    from .fs import get_fs
+
+    with get_fs(path).open(path) as f:
         f.seek(-8, os.SEEK_END)
         tail = f.read(8)
         assert tail[4:] == MAGIC, "not a parquet file"
@@ -668,7 +639,9 @@ def read_column_chunk(path: str, chunk: ChunkMeta, dtype: DataType):
     dictionary encodings, all supported codecs.  Returns
     (data, validity, lengths|None) numpy arrays of chunk.num_values
     rows.  ≙ the arrow-rs page machinery behind parquet_exec.rs:65-418."""
-    with open(path, "rb") as f:
+    from .fs import get_fs
+
+    with get_fs(path).open(path) as f:
         f.seek(chunk.offset)
         blob = f.read(chunk.total_comp if chunk.total_comp else None)
 
