@@ -269,12 +269,10 @@ def _decode(cid: int, payload: bytes) -> bytes:
     if cid == CODEC_ZSTD:
         import zstandard
 
-        try:
-            return zstandard.ZstdDecompressor().decompress(payload)
-        except zstandard.ZstdError:
-            # frame without embedded content size: stream-decompress
-            d = zstandard.ZstdDecompressor().decompressobj()
-            return d.decompress(payload)
+        # decompressobj handles frames with AND without embedded
+        # content size (streaming writers like the reference's
+        # zstd::Encoder omit it) — no exception-driven fallback
+        return zstandard.ZstdDecompressor().decompressobj().decompress(payload)
     if cid == CODEC_LZ4:
         return lz4_frame_decompress(payload)
     return payload

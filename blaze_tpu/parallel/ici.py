@@ -98,6 +98,164 @@ def ici_exchange_fn(schema: Schema, key_exprs: Sequence[Expr], n_dev: int):
     return body
 
 
+from ..ops.base import ExecNode
+
+
+class IciShuffleExchangeExec(ExecNode):
+    """Drop-in replacement for NativeShuffleExchangeExec whose exchange
+    rides ``lax.all_to_all`` over a device mesh instead of shuffle
+    files — the ICI fast path for executors co-located on one slice
+    (SURVEY.md §2.3).  Output partition p = device p's received rows.
+
+    Use ``use_ici_exchanges(plan, mesh)`` to rewrite a built plan's
+    hash exchanges onto this path.
+    """
+
+    def __init__(self, child, partitioning, mesh: Mesh):
+        import threading
+
+        from .shuffle import HashPartitioning
+
+        super().__init__([child])
+        assert isinstance(partitioning, HashPartitioning), "ICI path needs hash partitioning"
+        n_dev = int(mesh.devices.size)
+        assert partitioning.num_partitions == n_dev, (
+            f"ICI exchange: {partitioning.num_partitions} partitions != {n_dev} devices"
+        )
+        self.partitioning = partitioning
+        self.mesh = mesh
+        self._result = None
+        self._lock = threading.Lock()
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    def _materialize(self, ctx) -> None:
+        from ..batch import bucket_capacity, concat_batches
+        from ..runtime.context import TaskContext
+
+        with self._lock:
+            if self._result is not None:
+                return
+            child = self.children[0]
+            batches = []
+            for p in range(child.num_partitions()):
+                batches.extend(child.execute(p, TaskContext(p, child.num_partitions())))
+            n_dev = int(self.mesh.devices.size)
+            if batches:
+                g = concat_batches(batches)
+            else:
+                from ..batch import batch_from_pydict
+
+                g = batch_from_pydict({f.name: [] for f in self.schema.fields}, self.schema)
+            n = g.num_rows
+            per = -(-max(n, 1) // n_dev)
+            cap = bucket_capacity(per)
+            # lay rows contiguously per device shard: shard d holds rows
+            # [d*per, min((d+1)*per, n)) at offset d*cap
+            gh = g.to_host()
+            import numpy as np_
+
+            counts = np_.zeros(n_dev, np_.int32)
+            shard_cols = []
+            for c in gh.columns:
+                def placed(a):
+                    out = np_.zeros((n_dev * cap,) + a.shape[1:], a.dtype)
+                    for d in range(n_dev):
+                        lo, hi = d * per, min((d + 1) * per, n)
+                        if hi > lo:
+                            out[d * cap : d * cap + (hi - lo)] = a[lo:hi]
+                    return out
+
+                shard_cols.append(
+                    Column(
+                        c.dtype,
+                        None if c.data is None else placed(np_.asarray(c.data)),
+                        placed(np_.asarray(c.validity)),
+                        None if c.lengths is None else placed(np_.asarray(c.lengths)),
+                    )
+                )
+            for d in range(n_dev):
+                lo, hi = d * per, min((d + 1) * per, n)
+                counts[d] = max(0, hi - lo)
+            gbatch = RecordBatch(self.schema, [c.to_device() for c in shard_cols], n)
+            with self.metrics.timer("exchange_time"):
+                out_cols, totals = ici_shuffle(self.mesh, gbatch, counts, self.partitioning.exprs)
+            self._result = (
+                tuple(c.to_host() for c in out_cols),
+                np_.asarray(totals),
+                n_dev * cap,  # received rows per device
+            )
+
+    def execute(self, partition: int, ctx):
+        def stream():
+            self._materialize(ctx)
+            out_cols, totals, per_dev = self._result
+            total = int(totals[partition])
+            if total == 0:
+                return
+            from ..batch import bucket_capacity as _bc
+
+            lo = partition * per_dev
+            cap = _bc(total)
+
+            def sl(a):
+                if a is None:
+                    return None
+                import numpy as np_
+
+                out = np_.zeros((cap,) + a.shape[1:], a.dtype)
+                out[:total] = np_.asarray(a)[lo : lo + total]
+                return out
+
+            cols = [
+                Column(c.dtype, sl(c.data), sl(c.validity), sl(c.lengths)).to_device()
+                for c in out_cols
+            ]
+            self.metrics.add("output_rows", total)
+            yield RecordBatch(self.schema, cols, total)
+
+        return stream()
+
+
+def use_ici_exchanges(plan, mesh: Mesh):
+    """Rewrite a built plan: every hash-partitioned
+    NativeShuffleExchangeExec whose partition count matches the mesh
+    becomes an IciShuffleExchangeExec (the planner decision from
+    SURVEY.md §2.3: ICI within a slice, shuffle files across hosts);
+    non-matching exchanges stay on the file path.  Inner nodes are
+    swapped in place; USE THE RETURN VALUE (a root exchange is
+    returned replaced, not mutated)."""
+    from .exchange import NativeShuffleExchangeExec
+    from .shuffle import HashPartitioning
+
+    n_dev = int(mesh.devices.size)
+
+    def eligible(node) -> bool:
+        return (
+            isinstance(node, NativeShuffleExchangeExec)
+            and isinstance(node.partitioning, HashPartitioning)
+            and node.partitioning.num_partitions == n_dev
+        )
+
+    def walk(node):
+        for i, child in enumerate(list(node.children)):
+            walk(child)
+            if eligible(child):
+                node.children[i] = IciShuffleExchangeExec(
+                    child.children[0], child.partitioning, mesh
+                )
+
+    walk(plan)
+    if eligible(plan):
+        return IciShuffleExchangeExec(plan.children[0], plan.partitioning, mesh)
+    return plan
+
+
 def ici_shuffle(
     mesh: Mesh,
     batch: RecordBatch,
