@@ -70,6 +70,9 @@ SMJ_FALLBACK_ENABLE = ConfEntry("spark.blaze.smjfallback.enable", True, _bool)
 COLLECT_MAX_ELEMS = ConfEntry("spark.blaze.collect.maxElems", 64, int)
 SUGGESTED_BATCH_MEM_SIZE = ConfEntry("spark.blaze.suggested.batch.mem.size", 8 << 20, int)
 TOKIO_NUM_WORKER_THREADS = ConfEntry("spark.blaze.tokio.num.worker.threads", 2, int)
+# bounded producer queue depth between host staging and device compute
+# (≙ rt.rs sync_channel(1) + tokio stream drive); 0 = synchronous
+PIPELINE_DEPTH = ConfEntry("spark.blaze.pipeline.depth", 2, int)
 
 # TPU-specific knobs (no reference equivalent).
 ON_DEVICE = ConfEntry("spark.blaze.tpu.onDevice", True, _bool)

@@ -162,4 +162,7 @@ class OrcScanExec(ExecNode):
                         self.metrics.add("output_rows", b.num_rows)
                         yield b.to_device()
 
-        return stream()
+        from ..runtime.pipeline import maybe_pipelined
+
+        # file decode overlaps downstream device compute (≙ rt.rs:100-133)
+        return maybe_pipelined(stream(), ctx, "orc_scan")

@@ -217,7 +217,10 @@ class ParquetScanExec(ExecNode):
                             self.metrics.add("output_rows", b.num_rows)
                             yield b.to_device()
 
-        return stream()
+        from ..runtime.pipeline import maybe_pipelined
+
+        # file decode overlaps downstream device compute (≙ rt.rs:100-133)
+        return maybe_pipelined(stream(), ctx, "parquet_scan")
 
 
 from ..batch import _pad_1d  # noqa: E402  (used in stream closures)

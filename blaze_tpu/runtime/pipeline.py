@@ -1,0 +1,78 @@
+"""Pipelined batch streams: a producer thread drives the upstream
+generator into a bounded queue so host staging (file decode, serde,
+slicing) overlaps downstream device compute.
+
+≙ reference NativeExecutionRuntime (blaze/src/rt.rs:100-133): a tokio
+task drives the plan stream into a ``sync_channel(1)`` while the
+consumer pulls — same bounded-channel shape, with the same error and
+cancellation contract (producer errors surface at the consumer;
+consumer teardown or task cancellation stops the producer promptly).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+from .. import conf
+
+_DONE = object()
+
+
+def pipelined(stream: Iterable, ctx, depth: int = 2, name: str = "pipeline") -> Iterator:
+    """Run ``stream`` in a producer thread behind a ``depth``-bounded
+    queue.  Ordering is preserved; exceptions re-raise at the consumer;
+    closing the consumer (or cancelling the task) stops the producer
+    within one poll interval."""
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while True:
+            if stop.is_set() or not ctx.is_task_running():
+                return False
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+
+    def produce():
+        try:
+            for item in stream:
+                if not put(item):
+                    return
+            put(_DONE)
+        except BaseException as e:  # noqa: BLE001 — forwarded, not swallowed
+            put(e)
+
+    t = threading.Thread(target=produce, name=f"blaze-{name}", daemon=True)
+    t.start()
+
+    def consume():
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=0.05)
+                except queue.Empty:
+                    if not ctx.is_task_running():
+                        return
+                    continue
+                if item is _DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    return consume()
+
+
+def maybe_pipelined(stream: Iterable, ctx, name: str = "pipeline") -> Iterator:
+    """Pipeline behind ``spark.blaze.pipeline.depth`` (0 disables)."""
+    depth = int(conf.PIPELINE_DEPTH.get())
+    if depth <= 0:
+        return iter(stream)
+    return pipelined(stream, ctx, depth, name)
