@@ -415,62 +415,203 @@ def _logic(op: str, l: Column, r: Column) -> Column:
     return Column(DataType.bool_(), value, validity)
 
 
-def lower(expr: Expr, schema: Schema, cols: Dict[str, Column], n: int) -> Column:
+def expr_key(e: Expr):
+    """Structural identity key for common-subexpression caching
+    (≙ CachedExprsEvaluator, common/cached_exprs_evaluator.rs:48-506).
+    Aliases are transparent; PythonUdf nodes never share."""
+    if isinstance(e, Col):
+        return ("col", e.name)
+    if isinstance(e, Lit):
+        return ("lit", repr(e.value), e.dtype)
+    if isinstance(e, Alias):
+        return expr_key(e.child)
+    if isinstance(e, BinOp):
+        return ("bin", e.op, expr_key(e.left), expr_key(e.right))
+    if isinstance(e, Not):
+        return ("not", expr_key(e.child))
+    if isinstance(e, IsNull):
+        return ("isnull", expr_key(e.child))
+    if isinstance(e, IsNotNull):
+        return ("isnotnull", expr_key(e.child))
+    if isinstance(e, Cast):
+        return ("cast", e.to, expr_key(e.child))
+    if isinstance(e, Case):
+        return (
+            "case",
+            tuple((expr_key(c), expr_key(v)) for c, v in e.branches),
+            None if e.else_ is None else expr_key(e.else_),
+        )
+    if isinstance(e, InList):
+        return ("inlist", expr_key(e.child), tuple(expr_key(v) for v in e.values), e.negated)
+    if isinstance(e, Like):
+        return ("like", expr_key(e.child), e.pattern, e.negated)
+    if isinstance(e, ScalarFunc):
+        return ("fn", e.name, tuple(expr_key(a) for a in e.args))
+    if isinstance(e, GetIndexedField):
+        return ("gidx", expr_key(e.child), e.index)
+    if isinstance(e, GetMapValue):
+        return ("gmap", expr_key(e.child), repr(e.key))
+    if isinstance(e, GetStructField):
+        return ("gfield", expr_key(e.child), e.name)
+    if isinstance(e, NamedStruct):
+        return ("nstruct", tuple(e.names), tuple(expr_key(x) for x in e.exprs))
+    return ("opaque", id(e))  # PythonUdf etc: never shared
+
+
+def _lit_bool(e: Expr):
+    """True/False if e is a non-null boolean literal, else None."""
+    if isinstance(e, Alias):
+        return _lit_bool(e.child)
+    if isinstance(e, Lit) and isinstance(e.value, bool):
+        return e.value
+    return None
+
+
+def fold_literals(e: Expr) -> Expr:
+    """PLAN-TIME boolean constant folding: false AND x == false,
+    true OR x == true, true AND x == x, false OR x == x.  Applied
+    before host-fallback extraction (split_host_exprs), so a dead side
+    containing host-only functions (regex/hash/json) is never
+    evaluated at all — the full short-circuit contract the reference's
+    SC and/or provides (cached_exprs_evaluator.rs)."""
+    if isinstance(e, Alias):
+        return Alias(fold_literals(e.child), e.name)
+    if isinstance(e, Not):
+        return Not(fold_literals(e.child))
+    if isinstance(e, BinOp):
+        l = fold_literals(e.left)
+        r = fold_literals(e.right)
+        if e.op in ("and", "or"):
+            for a, b in ((l, r), (r, l)):
+                lb = _lit_bool(a)
+                if lb is None:
+                    continue
+                if e.op == "and" and lb is False:
+                    return Lit(False)
+                if e.op == "or" and lb is True:
+                    return Lit(True)
+                if (e.op == "and" and lb is True) or (e.op == "or" and lb is False):
+                    return b
+        return BinOp(e.op, l, r)
+    if isinstance(e, Case):
+        branches = [(fold_literals(c), fold_literals(v)) for c, v in e.branches]
+        kept = [(c, v) for c, v in branches if _lit_bool(c) is not False]
+        else_ = None if e.else_ is None else fold_literals(e.else_)
+        if kept and _lit_bool(kept[0][0]) is True:
+            return kept[0][1]
+        return Case(kept, else_)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.name, [fold_literals(a) for a in e.args])
+    if isinstance(e, InList):
+        return InList(fold_literals(e.child), [fold_literals(v) for v in e.values], e.negated)
+    if isinstance(e, Cast):
+        return Cast(fold_literals(e.child), e.to)
+    return e
+
+
+# counts _lower_node invocations (CSE effectiveness; tests assert on it)
+LOWER_STATS = {"nodes": 0}
+
+
+def lower(
+    expr: Expr, schema: Schema, cols: Dict[str, Column], n: int,
+    memo: Optional[Dict] = None,
+) -> Column:
     """Recursively lower an expression against resolved input columns.
-    Runs under jax tracing; must stay functional and shape-static."""
+    Runs under jax tracing; must stay functional and shape-static.
+
+    ``memo`` caches lowered subtrees by structural key — pass ONE dict
+    across sibling expressions evaluated against the same columns (a
+    projection's output list) to lower each distinct subtree once
+    (≙ the reference's CachedExprsEvaluator; here the win is trace/
+    compile time, XLA already CSEs the runtime ops)."""
+    if memo is None:
+        memo = {}
+    # key binds the column environment + capacity, so a memo shared
+    # across different inputs can never alias wrong columns
+    key = (id(cols), n, expr_key(expr))
+    hit = memo.get(key)
+    if hit is not None:
+        return hit
+    out = _lower_node(expr, schema, cols, n, memo)
+    memo[key] = out
+    return out
+
+
+def _lower_node(expr: Expr, schema: Schema, cols: Dict[str, Column], n: int, memo) -> Column:
+    LOWER_STATS["nodes"] += 1
     if isinstance(expr, Col):
         return cols[expr.name]
     if isinstance(expr, Alias):
-        return lower(expr.child, schema, cols, n)
+        return lower(expr.child, schema, cols, n, memo)
     if isinstance(expr, Lit):
         return _lit_column(expr.value, infer_lit_dtype(expr.value, expr.dtype), n)
     if isinstance(expr, Cast):
-        return lower_cast(lower(expr.child, schema, cols, n), expr.to)
+        return lower_cast(lower(expr.child, schema, cols, n, memo), expr.to)
     if isinstance(expr, Not):
-        c = lower(expr.child, schema, cols, n)
+        c = lower(expr.child, schema, cols, n, memo)
         return Column(DataType.bool_(), ~c.data.astype(jnp.bool_), c.validity)
     if isinstance(expr, IsNull):
-        c = lower(expr.child, schema, cols, n)
+        c = lower(expr.child, schema, cols, n, memo)
         return Column(DataType.bool_(), ~c.validity, jnp.ones_like(c.validity))
     if isinstance(expr, IsNotNull):
-        c = lower(expr.child, schema, cols, n)
+        c = lower(expr.child, schema, cols, n, memo)
         return Column(DataType.bool_(), c.validity, jnp.ones_like(c.validity))
     if isinstance(expr, BinOp):
-        l = lower(expr.left, schema, cols, n)
-        r = lower(expr.right, schema, cols, n)
         if expr.op in _LOGIC_OPS:
+            # trace-time short-circuit on literal operands (≙ the
+            # reference's SC and/or): false AND x == false, true OR x
+            # == true — the other side is never lowered at all
+            for a, b in ((expr.left, expr.right), (expr.right, expr.left)):
+                lb = _lit_bool(a)
+                if lb is None:
+                    continue
+                if expr.op == "and" and lb is False:
+                    return _lit_column(False, DataType.bool_(), n)
+                if expr.op == "or" and lb is True:
+                    return _lit_column(True, DataType.bool_(), n)
+                if (expr.op == "and" and lb is True) or (expr.op == "or" and lb is False):
+                    other = lower(b, schema, cols, n, memo)
+                    return _coerce(other, DataType.bool_())
+            l = lower(expr.left, schema, cols, n, memo)
+            r = lower(expr.right, schema, cols, n, memo)
             return _logic(expr.op, l, r)
+        l = lower(expr.left, schema, cols, n, memo)
+        r = lower(expr.right, schema, cols, n, memo)
         if expr.op in _CMP_OPS:
             return _cmp(expr.op, l, r)
         return _arith(expr.op, l, r)
     if isinstance(expr, InList):
-        c = lower(expr.child, schema, cols, n)
+        c = lower(expr.child, schema, cols, n, memo)
         acc = None
         for v in expr.values:
-            eq = _cmp("==", c, lower(v, schema, cols, n))
+            eq = _cmp("==", c, lower(v, schema, cols, n, memo))
             acc = eq if acc is None else _logic("or", acc, eq)
         if expr.negated:
             return Column(DataType.bool_(), ~acc.data.astype(jnp.bool_), acc.validity)
         return acc
     if isinstance(expr, Like):
-        return _lower_like(expr, schema, cols, n)
+        return _lower_like(expr, schema, cols, n, memo)
     if isinstance(expr, Case):
-        return _lower_case(expr, schema, cols, n)
+        return _lower_case(expr, schema, cols, n, memo)
     if isinstance(expr, ScalarFunc):
         from .functions import lower_func
 
-        return lower_func(expr, schema, cols, n, lower)
+        def lf(e, s, c, nn):
+            return lower(e, s, c, nn, memo)
+
+        return lower_func(expr, schema, cols, n, lf)
     if isinstance(expr, GetIndexedField):
-        return _lower_get_indexed(expr, schema, cols, n)
+        return _lower_get_indexed(expr, schema, cols, n, memo)
     if isinstance(expr, GetMapValue):
-        return _lower_get_map_value(expr, schema, cols, n)
+        return _lower_get_map_value(expr, schema, cols, n, memo)
     if isinstance(expr, GetStructField):
-        c = lower(expr.child, schema, cols, n)
+        c = lower(expr.child, schema, cols, n, memo)
         fi = [f.name for f in c.dtype.struct_fields].index(expr.name)
         kid = c.children[fi]
         return Column(kid.dtype, kid.data, kid.validity & c.validity, kid.lengths, kid.children)
     if isinstance(expr, NamedStruct):
-        kids = tuple(lower(e, schema, cols, n) for e in expr.exprs)
+        kids = tuple(lower(e, schema, cols, n, memo) for e in expr.exprs)
         out_t = infer_dtype(expr, schema)
         return Column(out_t, None, jnp.ones(n, jnp.bool_), None, kids)
     raise NotImplementedError(f"lowering of {type(expr).__name__}")
@@ -502,8 +643,8 @@ def elem_gather(elem: Column, idx) -> Column:
     )
 
 
-def _lower_get_indexed(expr: GetIndexedField, schema, cols, n) -> Column:
-    c = lower(expr.child, schema, cols, n)
+def _lower_get_indexed(expr: GetIndexedField, schema, cols, n, memo=None) -> Column:
+    c = lower(expr.child, schema, cols, n, memo)
     assert c.dtype.kind == TypeKind.ARRAY
     i, m = expr.index, c.dtype.max_elems
     if i < 0 or i >= m:
@@ -513,10 +654,10 @@ def _lower_get_indexed(expr: GetIndexedField, schema, cols, n) -> Column:
     return Column(out.dtype, out.data, valid, out.lengths, out.children)
 
 
-def _lower_get_map_value(expr: GetMapValue, schema, cols, n) -> Column:
+def _lower_get_map_value(expr: GetMapValue, schema, cols, n, memo=None) -> Column:
     from ..batch import _scalar_to_physical
 
-    c = lower(expr.child, schema, cols, n)
+    c = lower(expr.child, schema, cols, n, memo)
     assert c.dtype.kind == TypeKind.MAP
     keys, vals = c.children
     m = c.dtype.max_elems
@@ -544,15 +685,15 @@ def _lower_get_map_value(expr: GetMapValue, schema, cols, n) -> Column:
     return Column(out.dtype, out.data, valid, out.lengths, out.children)
 
 
-def _lower_case(expr: Case, schema, cols, n) -> Column:
+def _lower_case(expr: Case, schema, cols, n, memo=None) -> Column:
     out_t = infer_dtype(expr, schema)
     if expr.else_ is not None:
-        result = _coerce(lower(expr.else_, schema, cols, n), out_t)
+        result = _coerce(lower(expr.else_, schema, cols, n, memo), out_t)
     else:
         result = _lit_column(None, out_t, n)
     for cond, val in reversed(expr.branches):
-        c = lower(cond, schema, cols, n)
-        v = _coerce(lower(val, schema, cols, n), out_t)
+        c = lower(cond, schema, cols, n, memo)
+        v = _coerce(lower(val, schema, cols, n, memo), out_t)
         picked = c.validity & c.data.astype(jnp.bool_)
         if out_t.is_string:
             data = jnp.where(picked[:, None], S._pad_to(v.data, result.data.shape[1]), result.data)
@@ -576,8 +717,8 @@ def like_pattern_parts(pattern: str) -> Optional[List[bytes]]:
     return [p.encode("utf-8") for p in pattern.split("%")]
 
 
-def _lower_like(expr: Like, schema, cols, n) -> Column:
-    c = lower(expr.child, schema, cols, n)
+def _lower_like(expr: Like, schema, cols, n, memo=None) -> Column:
+    c = lower(expr.child, schema, cols, n, memo)
     parts = like_pattern_parts(expr.pattern)
     if parts is None:
         raise NotImplementedError(

@@ -45,10 +45,12 @@ def compact_columns(cols, keep):
 
 class FilterExec(ExecNode):
     def __init__(self, child: ExecNode, predicate: Expr):
+        from ..exprs.compile import fold_literals
+
         super().__init__([child])
-        self.predicate = predicate
+        self.predicate = fold_literals(predicate)
         in_schema = child.schema
-        (self._device_pred,), self._host_parts = split_host_exprs([predicate])
+        (self._device_pred,), self._host_parts = split_host_exprs([self.predicate])
         self._in_schema_aug = Schema(
             list(in_schema.fields)
             + [Field(name, DataType.bool_()) for name, _ in self._host_parts]

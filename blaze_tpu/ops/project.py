@@ -35,8 +35,10 @@ def _expr_name(e: Expr, i: int) -> str:
 
 class ProjectExec(ExecNode):
     def __init__(self, child: ExecNode, exprs: Sequence[Expr], names: Optional[Sequence[str]] = None):
+        from ..exprs.compile import fold_literals
+
         super().__init__([child])
-        self.exprs = list(exprs)
+        self.exprs = [fold_literals(e) for e in exprs]
         in_schema = child.schema
         self.names = list(names) if names else [_expr_name(e, i) for i, e in enumerate(self.exprs)]
         self._schema = Schema(
@@ -57,7 +59,10 @@ class ProjectExec(ExecNode):
         def kernel(cols: Tuple[Column, ...]) -> Tuple[Column, ...]:
             n = cols[0].validity.shape[0]
             env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
-            return tuple(lower(e, schema_aug, env, n) for e in device_exprs)
+            # ONE memo across the output list: each distinct subtree
+            # lowers once (≙ CachedExprsEvaluator)
+            memo: dict = {}
+            return tuple(lower(e, schema_aug, env, n, memo) for e in device_exprs)
 
         self._kernel = kernel
 

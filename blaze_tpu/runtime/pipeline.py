@@ -48,9 +48,11 @@ def pipelined(stream: Iterable, ctx, depth: int = 2, name: str = "pipeline") -> 
             put(e)
 
     t = threading.Thread(target=produce, name=f"blaze-{name}", daemon=True)
-    t.start()
 
     def consume():
+        # start lazily: a stream that is never iterated must not leak a
+        # producer thread (its finally below would never run)
+        t.start()
         try:
             while True:
                 try:

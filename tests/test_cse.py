@@ -1,0 +1,119 @@
+"""Expression CSE + trace-time short-circuit.
+
+≙ reference CachedExprsEvaluator (common/cached_exprs_evaluator.rs:
+48-506): common subexpressions lower once per projection, and literal
+and/or operands short-circuit so the dead side is never lowered.
+"""
+
+import jax
+import numpy as np
+
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.exprs.compile import LOWER_STATS, lower
+from blaze_tpu.exprs.ir import ScalarFunc
+from blaze_tpu.ops import MemoryScanExec, ProjectExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+
+SCHEMA = Schema([Field("a", DataType.int64()), Field("b", DataType.int64())])
+
+
+def _count_nodes(fn):
+    before = LOWER_STATS["nodes"]
+    out = fn()
+    return out, LOWER_STATS["nodes"] - before
+
+
+def _env(b):
+    return {f.name: c for f, c in zip(b.schema.fields, b.columns)}
+
+
+def test_shared_subtree_lowers_once():
+    b = batch_from_pydict({"a": [1, 2, 3], "b": [4, 5, 6]}, SCHEMA)
+    env = _env(b)
+    shared = (col("a") + col("b")) * (col("a") + col("b"))
+
+    # fresh memo per call: within one tree the repeated (a+b) subtree
+    # still lowers once
+    _, n1 = _count_nodes(lambda: lower(shared, SCHEMA, env, b.capacity))
+    # nodes: mul, add, a, b  (the second add is a cache hit)
+    assert n1 == 4, n1
+
+    # one memo across sibling expressions
+    memo = {}
+    _, n2 = _count_nodes(
+        lambda: [
+            lower(col("a") + col("b"), SCHEMA, env, b.capacity, memo),
+            lower((col("a") + col("b")) * lit(2), SCHEMA, env, b.capacity, memo),
+        ]
+    )
+    # add+a+b, then mul+lit only (add is a hit)
+    assert n2 == 5, n2
+
+
+def test_short_circuit_skips_dead_side():
+    b = batch_from_pydict({"a": [1, 2, 3], "b": [4, 5, 6]}, SCHEMA)
+    env = _env(b)
+    # md5 is host-only: lowering it on device RAISES — the dead operand
+    # proves the side is truly never lowered
+    expensive = ScalarFunc("md5", [col("a").cast(DataType.string(16))])
+
+    out, n = _count_nodes(
+        lambda: lower(lit(False) & expensive, SCHEMA, env, b.capacity)
+    )
+    assert n == 1, n
+    assert not bool(np.asarray(out.data)[:3].any())
+
+    out, n = _count_nodes(
+        lambda: lower(expensive | lit(True), SCHEMA, env, b.capacity)
+    )
+    assert n == 1, n
+    assert bool(np.asarray(out.data)[:3].all())
+
+    # true AND x == x (x still lowers)
+    out, _ = _count_nodes(
+        lambda: lower(lit(True) & (col("a") > col("b")), SCHEMA, env, b.capacity)
+    )
+    assert list(np.asarray(out.data)[:3]) == [False, False, False]
+
+
+def test_plan_time_fold_covers_host_subtrees():
+    """false AND <host-only md5> never reaches host_eval either: the
+    fold happens BEFORE split_host_exprs at plan build."""
+    from blaze_tpu.exprs.compile import fold_literals
+    from blaze_tpu.exprs.ir import BinOp, Lit
+
+    dead = lit(False) & ScalarFunc("md5", [col("a").cast(DataType.string(16))])
+    folded = fold_literals(dead)
+    assert isinstance(folded, Lit) and folded.value is False
+    # end-to-end: a projection with the dead side evaluates without
+    # ever running the host function
+    b = batch_from_pydict({"a": [1], "b": [2]}, SCHEMA)
+    p = ProjectExec(MemoryScanExec([[b]], SCHEMA), [dead.alias("x")])
+    assert p._host_parts == []  # md5 was folded away before extraction
+    d = batch_to_pydict(list(p.execute(0, TaskContext(0, 1)))[0])
+    assert d["x"] == [False]
+
+
+def test_projection_results_unchanged():
+    """q1-shaped projection: disc_price shared by two outputs — results
+    identical, and correct."""
+    schema = Schema([
+        Field("price", DataType.decimal(12, 2)),
+        Field("disc", DataType.decimal(12, 2)),
+        Field("tax", DataType.decimal(12, 2)),
+    ])
+    data = {"price": [10.0, 20.0], "disc": [0.1, 0.2], "tax": [0.05, 0.08]}
+    b = batch_from_pydict(data, schema)
+    disc_price = col("price") * (lit(1, DataType.decimal(12, 2)) - col("disc"))
+    p = ProjectExec(
+        MemoryScanExec([[b]], schema),
+        [
+            disc_price.alias("disc_price"),
+            (disc_price * (lit(1, DataType.decimal(12, 2)) + col("tax"))).alias("charge"),
+        ],
+    )
+    d = batch_to_pydict(list(p.execute(0, TaskContext(0, 1)))[0])
+    assert d["disc_price"] == [90000, 160000]  # decimal(p, 4)-scaled unscaled ints
+    assert len(d["charge"]) == 2

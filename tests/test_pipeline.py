@@ -56,23 +56,38 @@ def test_bounded_queue_limits_producer():
 
 def test_consumer_close_stops_producer():
     ctx = TaskContext(0, 1)
-    stopped = threading.Event()
+    produced = []
 
     def gen():
-        try:
-            for i in range(10_000):
-                yield i
-        finally:
-            stopped.set()
+        for i in range(10_000):
+            produced.append(i)
+            yield i
 
     it = pipelined(gen(), ctx, depth=1)
     assert next(it) == 0
     it.close()
-    # producer notices the stop flag within a poll interval or two; its
-    # generator is GC'd/abandoned — what matters is no deadlock and no
-    # further progress
     time.sleep(0.3)
-    assert True  # reaching here without hanging is the assertion
+    snapshot = len(produced)
+    time.sleep(0.3)
+    # production has STALLED after close (stop flag observed)
+    assert len(produced) == snapshot
+    assert snapshot < 10_000
+
+
+def test_never_iterated_stream_starts_no_producer():
+    """A pipelined stream that is never consumed must not leak a
+    producer thread (lazy start)."""
+    ctx = TaskContext(0, 1)
+    produced = []
+
+    def gen():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    _ = pipelined(gen(), ctx, depth=1)
+    time.sleep(0.2)
+    assert produced == []  # producer never started
 
 
 def test_task_cancellation_stops_both_sides():
