@@ -127,6 +127,15 @@ def _load():
         C.POINTER(ArrowSchema), C.POINTER(ArrowArray), C.c_void_p, C.c_void_p, C.c_int64,
     ]
     lib.bt_arrow_import_primitive.restype = C.c_int32
+    lib.bt_arrow_export_string.argtypes = [
+        C.POINTER(_BtCol), C.c_int64, C.POINTER(ArrowSchema), C.POINTER(ArrowArray),
+    ]
+    lib.bt_arrow_export_string.restype = C.c_int32
+    lib.bt_arrow_import_string.argtypes = [
+        C.POINTER(ArrowSchema), C.POINTER(ArrowArray), C.c_void_p, C.c_void_p,
+        C.c_void_p, C.c_int64, C.c_int32,
+    ]
+    lib.bt_arrow_import_string.restype = C.c_int32
     lib.bt_version.restype = C.c_char_p
     _lib = lib
     return lib

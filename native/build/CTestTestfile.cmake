@@ -1,0 +1,8 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/native
+# Build directory: /root/repo/native/build
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(gateway "/root/repo/native/build/gateway_test")
+set_tests_properties(gateway PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;47;add_test;/root/repo/native/CMakeLists.txt;0;")

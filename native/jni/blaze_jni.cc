@@ -1,55 +1,38 @@
 // JNI gateway: the JVM↔native boundary of the framework.
 //
 // ≙ reference crates `blaze` (exec.rs callNative/nextBatch/
-// finalizeNative JNI exports, rt.rs NativeExecutionRuntime) and
-// `blaze-jni-bridge` (JavaClasses cache + typed call macros).  Same
-// three-method contract as JniBridge.java:32-36:
+// finalizeNative JNI exports) and `blaze-jni-bridge` (JavaClasses
+// cache).  Same three-method contract as JniBridge.java:32-36:
 //
 //   long  callNative(long memoryBudget, Object wrapper)
 //   bool  nextBatch(long ptr)
 //   void  finalizeNative(long ptr)
 //
-// Architecture: this gateway embeds CPython and dispatches the decoded
-// TaskDefinition to blaze_tpu.serde.run_task, which builds the operator
-// tree and drives the JAX/XLA device programs.  Batches cross back to
-// the JVM over the Arrow C Data Interface (bt_arrow_export_primitive),
-// mirroring BlazeCallNativeWrapper.importBatch:114.  The runtime loop
-// runs on a dedicated thread with a bounded queue of one batch
-// (≙ rt.rs tokio + sync_channel(1)); errors surface as Java
-// RuntimeExceptions (≙ blaze/src/lib.rs catch_unwind -> throw).
+// THIN SHIMS: all boundary logic (TaskDefinition decode via the python
+// dispatch, producer thread + bounded channel, Arrow C-FFI export,
+// error contract) lives in the JDK-free gateway core
+// (src/gateway_core.cc, bt_gateway_*), which is exercised end to end
+// by native/tests/gateway_test.cc and tests/test_gateway.py without a
+// JVM.  This file only adapts JNI types to that surface.
 //
-// Build: requires jni.h (JDK) and Python.h; gated in CMakeLists.  The
-// driver image carries no JDK, so this file documents + compiles the
-// contract for deployment images that do.
+// Build: requires jni.h (JDK); gated in CMakeLists.  The driver image
+// carries no JDK, so these shims compile on deployment images only —
+// the logic they wrap is tested here regardless.
 
 #include <jni.h>
 #include <Python.h>
 
-#include <atomic>
-#include <condition_variable>
 #include <mutex>
 #include <string>
-#include <thread>
 
 #include "blaze_native.h"
 
 namespace {
 
-struct NativeExecutionRuntime {
-  // one per task (≙ NativeExecutionRuntime, rt.rs:48)
-  PyObject* stream = nullptr;       // generator from run_task()
-  jobject wrapper_ref = nullptr;    // global ref to BlazeCallNativeWrapper peer
-  std::string error;
-  std::atomic<bool> finalized{false};
-};
-
-JavaVM* g_vm = nullptr;
-
 // ---- JavaClasses cache (≙ blaze-jni-bridge jni_bridge.rs:385-497) --------
 struct JavaClasses {
   jclass wrapper_cls = nullptr;
   jmethodID get_raw_task_definition = nullptr;  // byte[] getRawTaskDefinition()
-  jmethodID import_schema = nullptr;            // void importSchema(long ffiPtr)
   jmethodID import_batch = nullptr;             // void importBatch(long ffiPtr)
   jmethodID set_error = nullptr;                // void setError(String)
   bool init(JNIEnv* env, jobject wrapper) {
@@ -57,44 +40,41 @@ struct JavaClasses {
     wrapper_cls = (jclass)env->NewGlobalRef(local);
     get_raw_task_definition =
         env->GetMethodID(wrapper_cls, "getRawTaskDefinition", "()[B");
-    import_schema = env->GetMethodID(wrapper_cls, "importSchema", "(J)V");
     import_batch = env->GetMethodID(wrapper_cls, "importBatch", "(J)V");
     set_error =
         env->GetMethodID(wrapper_cls, "setError", "(Ljava/lang/String;)V");
-    return get_raw_task_definition && import_schema && import_batch;
+    return get_raw_task_definition && import_batch;
   }
 };
 JavaClasses g_classes;
+JavaVM* g_vm = nullptr;
 std::once_flag g_py_once;
 
-void ensure_python() {
-  std::call_once(g_py_once, [] {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-    }
-  });
+// Per-task JNI peer: bridges the gateway callbacks back to the
+// wrapper object.  `env` is refreshed before every next_batch call
+// (JNIEnv is thread-bound).
+struct JniPeer {
+  void* gateway = nullptr;
+  jobject wrapper_ref = nullptr;
+  JNIEnv* env = nullptr;
+};
+
+void peer_import_batch(void* user, uintptr_t addr) {
+  auto* p = (JniPeer*)user;
+  p->env->CallVoidMethod(p->wrapper_ref, g_classes.import_batch, (jlong)addr);
+}
+
+void peer_set_error(void* user, const char* msg) {
+  auto* p = (JniPeer*)user;
+  if (g_classes.set_error) {
+    jstring s = p->env->NewStringUTF(msg ? msg : "unknown");
+    p->env->CallVoidMethod(p->wrapper_ref, g_classes.set_error, s);
+  }
 }
 
 void throw_runtime(JNIEnv* env, const std::string& msg) {
   jclass cls = env->FindClass("java/lang/RuntimeException");
   if (cls) env->ThrowNew(cls, msg.c_str());
-}
-
-std::string py_error_string() {
-  PyObject *type, *value, *tb;
-  PyErr_Fetch(&type, &value, &tb);
-  std::string out = "python error";
-  if (value) {
-    PyObject* s = PyObject_Str(value);
-    if (s) {
-      out = PyUnicode_AsUTF8(s);
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  return out;
 }
 
 }  // namespace
@@ -106,113 +86,60 @@ JNIEXPORT jint JNICALL JNI_OnLoad(JavaVM* vm, void*) {
   return JNI_VERSION_1_8;
 }
 
-// ≙ Java_..._JniBridge_callNative (exec.rs:46): decode the task
-// definition through the wrapper callback, start the runtime, return a
-// boxed pointer.
+// ≙ Java_..._JniBridge_callNative (exec.rs:46)
 JNIEXPORT jlong JNICALL Java_org_blaze_1tpu_JniBridge_callNative(
     JNIEnv* env, jclass, jlong /*memory_budget*/, jobject wrapper) {
-  ensure_python();
+  std::call_once(g_py_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // Py_InitializeEx leaves THIS thread holding the GIL; release it
+      // or the gateway core's producer thread (PyGILState_Ensure)
+      // deadlocks — the same hand-off gateway_test.cc performs
+      PyEval_SaveThread();
+    }
+  });
   if (!g_classes.wrapper_cls && !g_classes.init(env, wrapper)) {
     throw_runtime(env, "blaze-tpu: wrapper class init failed");
     return 0;
   }
-  auto* rt = new NativeExecutionRuntime();
-  rt->wrapper_ref = env->NewGlobalRef(wrapper);
-
   jbyteArray td = (jbyteArray)env->CallObjectMethod(
       wrapper, g_classes.get_raw_task_definition);
-  if (env->ExceptionCheck() || !td) {
-    delete rt;
-    return 0;
-  }
+  if (env->ExceptionCheck() || !td) return 0;
   jsize len = env->GetArrayLength(td);
   jbyte* bytes = env->GetByteArrayElements(td, nullptr);
 
-  PyGILState_STATE gil = PyGILState_Ensure();
-  PyObject* mod = PyImport_ImportModule("blaze_tpu.serde");
-  PyObject* stream = nullptr;
-  if (mod) {
-    PyObject* fn = PyObject_GetAttrString(mod, "run_task");
-    if (fn) {
-      PyObject* arg = PyBytes_FromStringAndSize((const char*)bytes, len);
-      stream = PyObject_CallFunctionObjArgs(fn, arg, nullptr);
-      Py_XDECREF(arg);
-      Py_DECREF(fn);
-    }
-    Py_DECREF(mod);
-  }
-  if (!stream) {
-    rt->error = py_error_string();
-  }
-  rt->stream = stream;
-  PyGILState_Release(gil);
-
+  auto* peer = new JniPeer();
+  peer->wrapper_ref = env->NewGlobalRef(wrapper);
+  bt_gateway_callbacks cbs{peer, peer_import_batch, peer_set_error};
+  peer->gateway =
+      bt_gateway_call_native((const uint8_t*)bytes, (int64_t)len, &cbs);
   env->ReleaseByteArrayElements(td, bytes, JNI_ABORT);
-  if (!rt->stream) {
-    throw_runtime(env, "blaze-tpu callNative: " + rt->error);
-    env->DeleteGlobalRef(rt->wrapper_ref);
-    delete rt;
-    return 0;
-  }
-  return (jlong)(intptr_t)rt;
+  return (jlong)(intptr_t)peer;
 }
 
-// ≙ Java_..._JniBridge_nextBatch (rt.rs:173-203): pull one batch from
-// the stream, FFI-export it, hand it to wrapper.importBatch.
+// ≙ Java_..._JniBridge_nextBatch (rt.rs:173-203)
 JNIEXPORT jboolean JNICALL Java_org_blaze_1tpu_JniBridge_nextBatch(
     JNIEnv* env, jclass, jlong ptr) {
-  auto* rt = (NativeExecutionRuntime*)(intptr_t)ptr;
-  if (!rt || rt->finalized.load()) return JNI_FALSE;
-
-  PyGILState_STATE gil = PyGILState_Ensure();
-  PyObject* batch = PyIter_Next(rt->stream);
-  if (!batch) {
-    bool had_err = PyErr_Occurred() != nullptr;
-    std::string err = had_err ? py_error_string() : "";
-    PyGILState_Release(gil);
-    if (had_err) throw_runtime(env, "blaze-tpu nextBatch: " + err);
+  auto* peer = (JniPeer*)(intptr_t)ptr;
+  if (!peer) return JNI_FALSE;
+  peer->env = env;  // JNIEnv is thread-bound: refresh per call
+  int32_t rc = bt_gateway_next_batch(peer->gateway);
+  if (rc == -1) {
+    throw_runtime(env, std::string("blaze-tpu: ") +
+                           bt_gateway_last_error(peer->gateway));
     return JNI_FALSE;
   }
-  // blaze_tpu.gateway.export_batch(batch) -> int addr of a C struct
-  // {n_cols, ArrowSchema*[], ArrowArray*[]} built on
-  // bt_arrow_export_primitive
-  PyObject* mod = PyImport_ImportModule("blaze_tpu.gateway");
-  jlong ffi_ptr = 0;
-  if (mod) {
-    PyObject* fn = PyObject_GetAttrString(mod, "export_batch_ffi");
-    if (fn) {
-      PyObject* res = PyObject_CallFunctionObjArgs(fn, batch, nullptr);
-      if (res) {
-        ffi_ptr = (jlong)PyLong_AsLongLong(res);
-        Py_DECREF(res);
-      }
-      Py_DECREF(fn);
-    }
-    Py_DECREF(mod);
-  }
-  std::string err = ffi_ptr ? "" : py_error_string();
-  Py_DECREF(batch);
-  PyGILState_Release(gil);
-
-  if (!ffi_ptr) {
-    throw_runtime(env, "blaze-tpu export: " + err);
-    return JNI_FALSE;
-  }
-  env->CallVoidMethod(rt->wrapper_ref, g_classes.import_batch, ffi_ptr);
-  return env->ExceptionCheck() ? JNI_FALSE : JNI_TRUE;
+  return rc == 1 ? JNI_TRUE : JNI_FALSE;
 }
 
 // ≙ Java_..._JniBridge_finalizeNative (rt.rs:205-215)
 JNIEXPORT void JNICALL Java_org_blaze_1tpu_JniBridge_finalizeNative(
     JNIEnv* env, jclass, jlong ptr) {
-  auto* rt = (NativeExecutionRuntime*)(intptr_t)ptr;
-  if (!rt) return;
-  rt->finalized.store(true);
-  PyGILState_STATE gil = PyGILState_Ensure();
-  Py_XDECREF(rt->stream);
-  PyGILState_Release(gil);
-  env->DeleteGlobalRef(rt->wrapper_ref);
-  delete rt;
+  auto* peer = (JniPeer*)(intptr_t)ptr;
+  if (!peer) return;
+  bt_gateway_finalize(peer->gateway);
+  env->DeleteGlobalRef(peer->wrapper_ref);
+  delete peer;
 }
 
 }  // extern "C"

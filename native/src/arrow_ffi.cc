@@ -110,6 +110,109 @@ int32_t bt_arrow_export_primitive(const bt_col* col, int64_t n,
   return 0;
 }
 
+int32_t bt_arrow_export_string(const bt_col* col, int64_t n,
+                               struct ArrowSchema* out_schema,
+                               struct ArrowArray* out_array) {
+  // kind 7 = utf8 string ("u"), kind 8 = binary ("z") — same layout,
+  // different Arrow format tag (binary must not claim utf8)
+  if ((col->kind != 7 && col->kind != 8) || !col->lengths) return -1;
+  std::memset(out_schema, 0, sizeof(*out_schema));
+  out_schema->format = col->kind == 8 ? "z" : "u";
+  out_schema->name = "";
+  out_schema->flags = 2;  // ARROW_FLAG_NULLABLE
+  out_schema->release = release_schema;
+
+  struct StrHolder {
+    uint8_t* validity_bitmap;
+    int32_t* offsets;
+    uint8_t* data;
+    const void* buffers[3];
+  };
+  auto release = [](struct ArrowArray* a) {
+    if (!a || !a->release) return;
+    StrHolder* h = (StrHolder*)a->private_data;
+    std::free(h->validity_bitmap);
+    std::free(h->offsets);
+    std::free(h->data);
+    delete h;
+    a->release = nullptr;
+  };
+
+  StrHolder* h = new (std::nothrow) StrHolder();
+  if (!h) return -1;
+  int64_t bb = (n + 7) / 8;
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; i++) total += col->lengths[i];
+  if (total > INT32_MAX) {  // arrow "u"/"z" offsets are int32
+    delete h;
+    return -1;
+  }
+  h->validity_bitmap = (uint8_t*)std::malloc((size_t)(bb ? bb : 1));
+  h->offsets = (int32_t*)std::malloc(sizeof(int32_t) * (size_t)(n + 1));
+  h->data = (uint8_t*)std::malloc((size_t)(total ? total : 1));
+  if (!h->validity_bitmap || !h->offsets || !h->data) {
+    std::free(h->validity_bitmap);
+    std::free(h->offsets);
+    std::free(h->data);
+    delete h;
+    return -1;
+  }
+  std::memset(h->validity_bitmap, 0, (size_t)bb);
+  const uint8_t* src = (const uint8_t*)col->data;
+  int64_t null_count = 0;
+  int32_t off = 0;
+  for (int64_t i = 0; i < n; i++) {
+    h->offsets[i] = off;
+    bool valid = !col->validity || col->validity[i];
+    if (valid) {
+      h->validity_bitmap[i >> 3] |= (uint8_t)(1 << (i & 7));
+      std::memcpy(h->data + off, src + i * col->width, (size_t)col->lengths[i]);
+      off += col->lengths[i];
+    } else {
+      null_count++;
+    }
+  }
+  h->offsets[n] = off;
+  h->buffers[0] = h->validity_bitmap;
+  h->buffers[1] = h->offsets;
+  h->buffers[2] = h->data;
+
+  std::memset(out_array, 0, sizeof(*out_array));
+  out_array->length = n;
+  out_array->null_count = null_count;
+  out_array->n_buffers = 3;
+  out_array->buffers = h->buffers;
+  out_array->private_data = h;
+  out_array->release = release;
+  return 0;
+}
+
+int32_t bt_arrow_import_string(const struct ArrowSchema* schema,
+                               const struct ArrowArray* array,
+                               uint8_t* data_out, int32_t* lengths_out,
+                               uint8_t* validity_out, int64_t cap,
+                               int32_t width) {
+  if ((schema->format[0] != 'u' && schema->format[0] != 'z') ||
+      array->length > cap || array->n_buffers < 3)
+    return -1;
+  const uint8_t* bitmap = (const uint8_t*)array->buffers[0];
+  const int32_t* offsets = (const int32_t*)array->buffers[1];
+  const uint8_t* data = (const uint8_t*)array->buffers[2];
+  int64_t off = array->offset;
+  std::memset(data_out, 0, (size_t)(array->length * width));
+  for (int64_t i = 0; i < array->length; i++) {
+    int64_t j = i + off;
+    uint8_t valid = bitmap ? ((bitmap[j >> 3] >> (j & 7)) & 1) : 1;
+    validity_out[i] = valid;
+    int32_t ln = offsets[j + 1] - offsets[j];
+    if (ln > width) ln = width;
+    lengths_out[i] = valid ? ln : 0;
+    if (valid && ln > 0)
+      std::memcpy(data_out + i * width, data + offsets[j], (size_t)ln);
+  }
+  return 0;
+}
+
 int32_t bt_arrow_import_primitive(const struct ArrowSchema* schema,
                                   const struct ArrowArray* array,
                                   void* data_out, uint8_t* validity_out,

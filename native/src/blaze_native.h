@@ -99,6 +99,41 @@ int32_t bt_arrow_import_primitive(const struct ArrowSchema* schema,
                                   void* data_out, uint8_t* validity_out,
                                   int64_t cap);
 
+// export a fixed-width string column (kind 7) as an Arrow utf8 ("u")
+// array: validity bitmap + int32 offsets + packed data
+int32_t bt_arrow_export_string(const bt_col* col, int64_t n,
+                               struct ArrowSchema* out_schema,
+                               struct ArrowArray* out_array);
+// import an Arrow utf8 array into fixed-width buffers: data_out is
+// (cap, width) bytes, lengths_out int32 per row (clamped to width)
+int32_t bt_arrow_import_string(const struct ArrowSchema* schema,
+                               const struct ArrowArray* array,
+                               uint8_t* data_out, int32_t* lengths_out,
+                               uint8_t* validity_out, int64_t cap,
+                               int32_t width);
+
+// ---- JDK-free gateway core (≙ blaze/src/exec.rs:46-142 + rt.rs:57-215) ----
+// The JNI shims and the test harnesses both drive THIS surface; the
+// "JVM" is whatever registers the callbacks.
+typedef struct {
+  void* user;
+  // receives the address of a gateway FFI batch struct
+  // {int64 n_cols; ArrowSchema* schemas; ArrowArray* arrays}
+  // (blaze_tpu.gateway._FfiBatch) — ≙ wrapper.importBatch(ffiPtr)
+  void (*import_batch)(void* user, uintptr_t ffi_batch_addr);
+  void (*set_error)(void* user, const char* msg);  // ≙ wrapper.setError
+} bt_gateway_callbacks;
+
+// decode TaskDefinition bytes, start the runtime (producer thread +
+// bounded channel, ≙ rt.rs:100-133); returns an opaque runtime ptr
+void* bt_gateway_call_native(const uint8_t* task_def, int64_t len,
+                             const bt_gateway_callbacks* cbs);
+// pull one batch: 1 = delivered via import_batch, 0 = end of stream,
+// -1 = error (see bt_gateway_last_error; set_error also fired)
+int32_t bt_gateway_next_batch(void* rt);
+const char* bt_gateway_last_error(void* rt);
+void bt_gateway_finalize(void* rt);
+
 const char* bt_version(void);
 
 #ifdef __cplusplus

@@ -1,0 +1,124 @@
+"""C++ gateway driven from pytest over ctypes — the same JDK-free
+boundary path as native/tests/gateway_test.cc:
+
+TaskDefinition bytes -> bt_gateway_call_native (producer thread +
+bounded channel, ≙ exec.rs:46-142 / rt.rs:57-215) -> per-batch Arrow
+C-FFI export -> callback imports (strings included) -> compare against
+direct plan execution.
+"""
+
+import ctypes as C
+import os
+
+import numpy as np
+import pytest
+
+from blaze_tpu import native
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict, concat_batches
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.exprs.ir import ScalarFunc
+from blaze_tpu.gateway import import_batch_ffi
+from blaze_tpu.ops import MemoryScanExec, ProjectExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+from blaze_tpu.serde.to_proto import task_definition
+
+_GW_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "build", "libblaze_gateway.so",
+)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(_GW_PATH) or native._load() is None,
+    reason="native gateway not built (cmake -S native -B native/build)",
+)
+
+
+class _Callbacks(C.Structure):
+    _fields_ = [
+        ("user", C.c_void_p),
+        ("import_batch", C.CFUNCTYPE(None, C.c_void_p, C.c_size_t)),
+        ("set_error", C.CFUNCTYPE(None, C.c_void_p, C.c_char_p)),
+    ]
+
+
+def _gateway():
+    lib = C.CDLL(_GW_PATH)  # CDLL releases the GIL during calls
+    lib.bt_gateway_call_native.argtypes = [C.c_char_p, C.c_int64, C.POINTER(_Callbacks)]
+    lib.bt_gateway_call_native.restype = C.c_void_p
+    lib.bt_gateway_next_batch.argtypes = [C.c_void_p]
+    lib.bt_gateway_next_batch.restype = C.c_int32
+    lib.bt_gateway_last_error.argtypes = [C.c_void_p]
+    lib.bt_gateway_last_error.restype = C.c_char_p
+    lib.bt_gateway_finalize.argtypes = [C.c_void_p]
+    return lib
+
+
+def _drive(lib, td: bytes, out_schema):
+    batches = []
+    errors = []
+
+    @C.CFUNCTYPE(None, C.c_void_p, C.c_size_t)
+    def on_import(_user, addr):
+        batches.append(import_batch_ffi(addr, out_schema))
+
+    @C.CFUNCTYPE(None, C.c_void_p, C.c_char_p)
+    def on_error(_user, msg):
+        errors.append((msg or b"").decode())
+
+    cbs = _Callbacks(None, on_import, on_error)
+    rt = lib.bt_gateway_call_native(td, len(td), C.byref(cbs))
+    try:
+        while True:
+            rc = lib.bt_gateway_next_batch(rt)
+            if rc == 1:
+                continue
+            return batches, errors, rc
+    finally:
+        lib.bt_gateway_finalize(rt)
+
+
+def test_gateway_end_to_end_with_strings():
+    schema = Schema([Field("x", DataType.int64()), Field("s", DataType.string(8))])
+    b = batch_from_pydict(
+        {"x": [1, 2, None, 4, 5], "s": ["ab", "cd", None, "ef", "gh"]}, schema
+    )
+    plan = ProjectExec(
+        MemoryScanExec([[b]], schema),
+        [(col("x") + lit(10)).alias("y"), ScalarFunc("upper", [col("s")]).alias("u")],
+    )
+    td = task_definition(plan, "pytest", 0, 0)
+
+    expected = batch_to_pydict(list(plan.execute(0, TaskContext(0, 1)))[0])
+
+    lib = _gateway()
+    batches, errors, rc = _drive(lib, td, plan.schema)
+    assert rc == 0 and not errors
+    got = batch_to_pydict(concat_batches(batches))
+    assert got["y"] == expected["y"] == [11, 12, None, 14, 15]
+    assert got["u"] == expected["u"] == ["AB", "CD", None, "EF", "GH"]
+
+
+def test_gateway_error_contract():
+    lib = _gateway()
+    batches, errors, rc = _drive(
+        lib, b"\xde\xad\xbe\xef", Schema([Field("x", DataType.int64())])
+    )
+    assert rc == -1
+    assert batches == []
+    assert errors and errors[0]
+
+
+def test_gateway_multi_batch_ordering():
+    schema = Schema([Field("x", DataType.int64())])
+    bs = [
+        batch_from_pydict({"x": list(range(i * 10, i * 10 + 10))}, schema)
+        for i in range(5)
+    ]
+    plan = ProjectExec(MemoryScanExec([bs], schema), [(col("x") * lit(2)).alias("d")])
+    td = task_definition(plan, "pytest", 0, 0)
+    lib = _gateway()
+    batches, errors, rc = _drive(lib, td, plan.schema)
+    assert rc == 0 and not errors
+    got = [v for b in batches for v in batch_to_pydict(b)["d"]]
+    assert got == [2 * v for v in range(50)]
