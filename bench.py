@@ -85,10 +85,16 @@ def main():
         for c in b.columns:
             c.data.block_until_ready() if hasattr(c.data, "block_until_ready") else None
 
-    scans = {"lineitem": MemoryScanExec(parts, TPCH_SCHEMAS["lineitem"])}
-    plan = q6(scans, 1)
-
     def run_once():
+        # REBUILD the plan each iteration: exchanges memoize their map
+        # side per exec instance, so a reused plan would only re-time
+        # the reduce side — the full scan->filter->project->agg->
+        # exchange->final-agg pipeline must run every iteration
+        from blaze_tpu.ops.fusion import fuse_stages
+        from blaze_tpu.ops.pruning import prune_columns
+
+        scans = {"lineitem": MemoryScanExec(parts, TPCH_SCHEMAS["lineitem"])}
+        plan = prune_columns(fuse_stages(q6(scans, 1)))
         out = []
         for p in range(plan.num_partitions()):
             for b in plan.execute(p, TaskContext(p, plan.num_partitions())):

@@ -337,11 +337,15 @@ class AggExec(ExecNode):
         aggs: Sequence[AggFunction],
         initial_input_buffer_offset: int = 0,
         supports_partial_skipping: bool = False,
+        pre_filter: Optional[Expr] = None,
     ):
         super().__init__([child])
         self.mode = mode
         self.groupings = list(groupings)
         self.aggs = list(aggs)
+        # fused pre-aggregation predicate (stage fusion: a FilterExec
+        # collapsed into this kernel; rows failing it never aggregate)
+        self.pre_filter = pre_filter
         self.supports_partial_skipping = supports_partial_skipping
 
         in_schema = child.schema
@@ -394,7 +398,19 @@ class AggExec(ExecNode):
             self._schema = self._state_schema
 
         self._merger: Optional["_StateMerger"] = None
-        self._build_kernels(in_schema)
+        from ..exprs.compile import expr_key
+        from ..runtime.kernel_cache import cached_kernel, schema_key
+
+        kernel_key = (
+            "agg", mode.value, schema_key(in_schema), schema_key(self._state_schema),
+            None if self.pre_filter is None else expr_key(self.pre_filter),
+            tuple((expr_key(g.expr), g.name) for g in self.groupings),
+            tuple((a.fn, None if a.expr is None else expr_key(a.expr), a.name)
+                  for a in self.aggs),
+        )
+        self._grouped_kernel, self._scalar_kernel, self._finalize_kernel = cached_kernel(
+            kernel_key, lambda: self._build_kernels(in_schema)
+        )
 
     @property
     def schema(self) -> Schema:
@@ -406,8 +422,12 @@ class AggExec(ExecNode):
         groupings = self.groupings
         aggs = self.aggs
         mode = self.mode
+        pre_filter = self.pre_filter
         n_groups_cols = len(groupings)
         state_schema = self._state_schema
+        in_types = list(self._in_types)  # NEVER capture self below: the
+        # kernels are cached process-wide and must not pin this exec's
+        # child subtree (scanned data) alive
 
         def eval_inputs(cols: Tuple[Column, ...], schema: Schema):
             env = {f.name: c for f, c in zip(schema.fields, cols)}
@@ -430,7 +450,7 @@ class AggExec(ExecNode):
 
         def state_inputs(env) -> List[List[Column]]:
             out = []
-            for a, t in zip(aggs, self._in_types):
+            for a, t in zip(aggs, in_types):
                 fields = agg_state_fields(a.fn, t, a.name)
                 out.append([env[f.name] for f in fields])
             return out
@@ -499,6 +519,9 @@ class AggExec(ExecNode):
             env, key_cols, _ = eval_inputs(cols, schema)
             cap = cols[0].validity.shape[0]
             live = jnp.arange(cap) < num_rows
+            if pre_filter is not None:
+                pf = lower(pre_filter, schema, env, cap)
+                live = live & pf.validity & pf.data.astype(jnp.bool_)
             words = [live.astype(jnp.uint64) ^ jnp.uint64(1)] + [
                 jnp.where(live, w, jnp.uint64(0)) for w in encode_key_words(key_cols)
             ]
@@ -526,7 +549,7 @@ class AggExec(ExecNode):
 
             sorted_inputs = [[sort_col(c) for c in ins] for ins in inputs]
             state_cols: List[Column] = []
-            for a, t, ins in zip(aggs, self._in_types, sorted_inputs):
+            for a, t, ins in zip(aggs, in_types, sorted_inputs):
                 state_cols.extend(reduce_one(a, t, ins, seg, cap, merging))
 
             # group key columns: gather at boundary positions
@@ -554,7 +577,6 @@ class AggExec(ExecNode):
             ]
             return tuple(group_out + state_out), n_out
 
-        self._grouped_kernel = grouped_kernel
 
         @jax.jit
         def scalar_kernel(cols: Tuple[Column, ...], num_rows):
@@ -564,6 +586,9 @@ class AggExec(ExecNode):
             env, _, _ = eval_inputs(cols, schema)
             cap = cols[0].validity.shape[0]
             live = jnp.arange(cap) < num_rows
+            if pre_filter is not None:
+                pf = lower(pre_filter, schema, env, cap)
+                live = live & pf.validity & pf.data.astype(jnp.bool_)
             seg = jnp.zeros(cap, jnp.int32)
             inputs = partial_inputs(env, schema, cap) if not merging else state_inputs(env)
             masked = [
@@ -571,14 +596,12 @@ class AggExec(ExecNode):
                 for ins in inputs
             ]
             state_cols: List[Column] = []
-            for a, t, ins in zip(aggs, self._in_types, masked):
+            for a, t, ins in zip(aggs, in_types, masked):
                 state_cols.extend(reduce_one(a, t, ins, seg, 1, merging))
             return tuple(state_cols)
 
-        self._scalar_kernel = scalar_kernel
 
         # finalization: state batch -> output batch (FINAL mode)
-        in_types = self._in_types
 
         @jax.jit
         def finalize_kernel(cols: Tuple[Column, ...]):
@@ -618,7 +641,7 @@ class AggExec(ExecNode):
                     out.append(env[f"{a.name}#value"])
             return tuple(out)
 
-        self._finalize_kernel = finalize_kernel
+        return grouped_kernel, scalar_kernel, finalize_kernel
 
     # ------------------------------------------------------ execution
 

@@ -57,20 +57,29 @@ class FilterExec(ExecNode):
         )
         schema_aug = self._in_schema_aug
         pred = self._device_pred
+        n_in_fields = len(in_schema.fields)
 
-        @jax.jit
-        def kernel(cols: Tuple[Column, ...], num_rows):
-            n = cols[0].validity.shape[0]
-            env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
-            p = lower(pred, schema_aug, env, n)
-            # the live mask is load-bearing: IsNull turns padding-row
-            # invalidity into data=True, so validity alone cannot be
-            # trusted to exclude padding
-            live = jnp.arange(n) < num_rows
-            keep = p.validity & p.data.astype(jnp.bool_) & live
-            return compact_columns(cols[: len(in_schema.fields)], keep)
+        def build():
+            @jax.jit
+            def kernel(cols: Tuple[Column, ...], num_rows):
+                n = cols[0].validity.shape[0]
+                env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
+                p = lower(pred, schema_aug, env, n)
+                # the live mask is load-bearing: IsNull turns padding-row
+                # invalidity into data=True, so validity alone cannot be
+                # trusted to exclude padding
+                live = jnp.arange(n) < num_rows
+                keep = p.validity & p.data.astype(jnp.bool_) & live
+                return compact_columns(cols[:n_in_fields], keep)
 
-        self._kernel = kernel
+            return kernel
+
+        from ..exprs.compile import expr_key
+        from ..runtime.kernel_cache import cached_kernel, schema_key
+
+        self._kernel = cached_kernel(
+            ("filter", schema_key(schema_aug), expr_key(pred)), build
+        )
 
     @property
     def schema(self) -> Schema:

@@ -1,0 +1,142 @@
+"""Stage fusion: collapse Filter/Project chains into the partial-agg
+kernel so a map stage runs as ONE XLA program.
+
+≙ SURVEY.md §7 "hard parts": "ours depends on keeping a stage's
+operator chain fused on-device".  The reference gets per-operator
+streams fused by its CPU pipeline; on TPU every operator boundary is a
+dispatch + a materialized intermediate, so q06's
+scan->filter->project->partial-agg collapses to scan->partial-agg with
+the predicate applied as the kernel's liveness mask (AggExec
+pre_filter) and the projection substituted into the aggregate
+expressions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..exprs.ir import (
+    Alias,
+    BinOp,
+    Case,
+    Cast,
+    Col,
+    Expr,
+    GetIndexedField,
+    GetMapValue,
+    GetStructField,
+    InList,
+    IsNotNull,
+    IsNull,
+    Like,
+    NamedStruct,
+    Not,
+    ScalarFunc,
+)
+
+
+def substitute(e: Expr, mapping: Dict[str, Expr]) -> Expr:
+    """Replace column references per ``mapping``, rebuilding the tree."""
+    if isinstance(e, Col):
+        return mapping.get(e.name, e)
+    if isinstance(e, Alias):
+        return Alias(substitute(e.child, mapping), e.name)
+    if isinstance(e, BinOp):
+        return BinOp(e.op, substitute(e.left, mapping), substitute(e.right, mapping))
+    if isinstance(e, Not):
+        return Not(substitute(e.child, mapping))
+    if isinstance(e, IsNull):
+        return IsNull(substitute(e.child, mapping))
+    if isinstance(e, IsNotNull):
+        return IsNotNull(substitute(e.child, mapping))
+    if isinstance(e, Cast):
+        return Cast(substitute(e.child, mapping), e.to)
+    if isinstance(e, Case):
+        return Case(
+            [(substitute(c, mapping), substitute(v, mapping)) for c, v in e.branches],
+            None if e.else_ is None else substitute(e.else_, mapping),
+        )
+    if isinstance(e, InList):
+        return InList(
+            substitute(e.child, mapping), [substitute(v, mapping) for v in e.values],
+            e.negated,
+        )
+    if isinstance(e, Like):
+        return Like(substitute(e.child, mapping), e.pattern, e.negated)
+    if isinstance(e, ScalarFunc):
+        return ScalarFunc(e.name, [substitute(a, mapping) for a in e.args])
+    if isinstance(e, GetIndexedField):
+        return GetIndexedField(substitute(e.child, mapping), e.index)
+    if isinstance(e, GetMapValue):
+        return GetMapValue(substitute(e.child, mapping), e.key)
+    if isinstance(e, GetStructField):
+        return GetStructField(substitute(e.child, mapping), e.name)
+    if isinstance(e, NamedStruct):
+        return NamedStruct(list(e.names), [substitute(x, mapping) for x in e.exprs])
+    return e  # literals, opaque nodes
+
+
+def fuse_stages(plan):
+    """Rewrite (in place below the root): PARTIAL AggExec over pure
+    device Filter/Project chains absorbs them.  Returns the root."""
+    from .agg import AggExec, AggFunction, AggMode, GroupingExpr
+    from .filter import FilterExec
+    from .project import ProjectExec
+
+    def try_fuse(agg: "AggExec"):
+        if agg.mode != AggMode.PARTIAL:
+            return agg
+        groupings = list(agg.groupings)
+        aggs = list(agg.aggs)
+        pre = agg.pre_filter
+        child = agg.children[0]
+        changed = False
+        while True:
+            if isinstance(child, ProjectExec) and not child._host_parts:
+                mapping = {
+                    n: (e.child if isinstance(e, Alias) else e)
+                    for n, e in zip(child.names, child.exprs)
+                }
+                groupings = [
+                    GroupingExpr(substitute(g.expr, mapping), g.name) for g in groupings
+                ]
+                aggs = [
+                    AggFunction(
+                        a.fn,
+                        None if a.expr is None else substitute(a.expr, mapping),
+                        a.name,
+                    )
+                    for a in aggs
+                ]
+                if pre is not None:
+                    pre = substitute(pre, mapping)
+                child = child.children[0]
+                changed = True
+                continue
+            if isinstance(child, FilterExec) and not child._host_parts:
+                pred = child.predicate
+                pre = pred if pre is None else BinOp("and", pred, pre)
+                child = child.children[0]
+                changed = True
+                continue
+            break
+        if not changed:
+            return agg
+        return AggExec(
+            child, AggMode.PARTIAL, groupings, aggs,
+            supports_partial_skipping=agg.supports_partial_skipping,
+            pre_filter=pre,
+        )
+
+    def walk(node):
+        for i, c in enumerate(list(node.children)):
+            walk(c)
+            if isinstance(c, AggExec):
+                node.children[i] = try_fuse(c)
+
+    from .agg import AggExec
+
+    walk(plan)
+    if isinstance(plan, AggExec):
+        return try_fuse(plan)
+    return plan

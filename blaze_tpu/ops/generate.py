@@ -91,6 +91,63 @@ def json_tuple_generator(fields: Sequence[str]) -> Generator:
     return gen
 
 
+def _build_explode_kernel(child_schema, spec, outer, keep_input, with_pos):
+    @jax.jit
+    def kernel(cols: Tuple[Column, ...], num_rows):
+        cap = cols[0].validity.shape[0]
+        env = {f.name: c for f, c in zip(child_schema.fields, cols)}
+        gc = lower(spec.expr, child_schema, env, cap)
+        m = gc.dtype.max_elems
+        live = jnp.arange(cap) < num_rows
+        within = jnp.arange(m)[None, :] < gc.lengths[:, None]
+        emit = within & gc.validity[:, None] & live[:, None]
+        if outer:
+            empty = live & (~gc.validity | (gc.lengths == 0))
+            emit = emit.at[:, 0].set(emit[:, 0] | empty)
+        flat = emit.reshape(-1)                       # (cap*m,) row-major
+        out_cap = cap * m
+        pos = jnp.cumsum(flat.astype(jnp.int32)) - 1
+        total = jnp.sum(flat.astype(jnp.int32))
+        flat_idx = jnp.arange(out_cap, dtype=jnp.int32)
+        src = (
+            jnp.zeros(out_cap, jnp.int32)
+            .at[jnp.where(flat, pos, out_cap)]
+            .set(flat_idx, mode="drop")
+        )
+        out_live = jnp.arange(out_cap) < total
+        out_row = src // m
+        out_elem = src % m
+
+        out_cols: List[Column] = []
+        if keep_input:
+            for c in cols:
+                g = c.take(out_row)
+                out_cols.append(
+                    Column(g.dtype, g.data, g.validity & out_live, g.lengths, g.children)
+                )
+        elem_within = within.reshape(-1)
+        if with_pos:
+            # pos is NULL for outer-emitted placeholder rows
+            pos_valid = out_live & jnp.take(elem_within, src)
+            out_cols.append(
+                Column(DataType.int32(), jnp.where(pos_valid, out_elem, 0), pos_valid)
+            )
+        for kid in gc.children:  # ARRAY: (elem,); MAP: (keys, values)
+            fk = _flatten_elem_dev(kid).take(src)
+            out_cols.append(
+                Column(
+                    fk.dtype,
+                    fk.data,
+                    fk.validity & out_live & jnp.take(elem_within, src),
+                    fk.lengths,
+                    fk.children,
+                )
+            )
+        return tuple(out_cols), total
+
+    return kernel
+
+
 class GenerateExec(ExecNode):
     def __init__(
         self,
@@ -137,60 +194,17 @@ class GenerateExec(ExecNode):
         keep_input = self.keep_input
         with_pos = spec.kind == "pos_explode"
 
-        @jax.jit
-        def kernel(cols: Tuple[Column, ...], num_rows):
-            cap = cols[0].validity.shape[0]
-            env = {f.name: c for f, c in zip(child_schema.fields, cols)}
-            gc = lower(spec.expr, child_schema, env, cap)
-            m = gc.dtype.max_elems
-            live = jnp.arange(cap) < num_rows
-            within = jnp.arange(m)[None, :] < gc.lengths[:, None]
-            emit = within & gc.validity[:, None] & live[:, None]
-            if outer:
-                empty = live & (~gc.validity | (gc.lengths == 0))
-                emit = emit.at[:, 0].set(emit[:, 0] | empty)
-            flat = emit.reshape(-1)                       # (cap*m,) row-major
-            out_cap = cap * m
-            pos = jnp.cumsum(flat.astype(jnp.int32)) - 1
-            total = jnp.sum(flat.astype(jnp.int32))
-            flat_idx = jnp.arange(out_cap, dtype=jnp.int32)
-            src = (
-                jnp.zeros(out_cap, jnp.int32)
-                .at[jnp.where(flat, pos, out_cap)]
-                .set(flat_idx, mode="drop")
-            )
-            out_live = jnp.arange(out_cap) < total
-            out_row = src // m
-            out_elem = src % m
+        from ..exprs.compile import expr_key
+        from ..runtime.kernel_cache import cached_kernel, schema_key
 
-            out_cols: List[Column] = []
-            if keep_input:
-                for c in cols:
-                    g = c.take(out_row)
-                    out_cols.append(
-                        Column(g.dtype, g.data, g.validity & out_live, g.lengths, g.children)
-                    )
-            elem_within = within.reshape(-1)
-            if with_pos:
-                # pos is NULL for outer-emitted placeholder rows
-                pos_valid = out_live & jnp.take(elem_within, src)
-                out_cols.append(
-                    Column(DataType.int32(), jnp.where(pos_valid, out_elem, 0), pos_valid)
-                )
-            for kid in gc.children:  # ARRAY: (elem,); MAP: (keys, values)
-                fk = _flatten_elem_dev(kid).take(src)
-                out_cols.append(
-                    Column(
-                        fk.dtype,
-                        fk.data,
-                        fk.validity & out_live & jnp.take(elem_within, src),
-                        fk.lengths,
-                        fk.children,
-                    )
-                )
-            return tuple(out_cols), total
+        def build():
+            return _build_explode_kernel(child_schema, spec, outer, keep_input, with_pos)
 
-        self._native_kernel = kernel
+        self._native_kernel = cached_kernel(
+            ("generate", schema_key(child_schema), spec.kind, expr_key(spec.expr),
+             outer, keep_input),
+            build,
+        )
 
     def _native_stream(self, partition: int, ctx: TaskContext) -> BatchStream:
         child = self.children[0]
@@ -224,13 +238,7 @@ class GenerateExec(ExecNode):
             child_batches = child.execute(partition, ctx)
             for batch in child_batches:
                 # host round trip (≙ the reference's UDTF FFI round trip)
-                in_rows = batch_to_pydict(
-                    RecordBatch(
-                        self._input_proj.schema,
-                        list(self._input_proj._kernel(self._input_proj._augmented_cols(batch))),
-                        batch.num_rows,
-                    )
-                )
+                in_rows = batch_to_pydict(self._input_proj.project_batch(batch))
                 keys = list(in_rows.keys())
                 out_rows: Dict[str, List] = {f.name: [] for f in self._schema.fields}
                 base = batch_to_pydict(batch) if self.keep_input else {}

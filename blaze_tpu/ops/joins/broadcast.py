@@ -20,7 +20,7 @@ from ...exprs.ir import Expr
 from ...runtime.context import TaskContext
 from ...schema import DataType, Field, Schema
 from ..base import BatchStream, ExecNode
-from .core import Joiner, JoinerState, JoinMap, JoinType, build_join_map, make_build_kernel
+from .core import JoinerState, JoinMap, JoinType, build_join_map, cached_joiner, make_build_kernel
 
 MAP_COL = "join_map#bytes"
 
@@ -168,7 +168,7 @@ class BroadcastJoinExec(ExecNode):
             build_data_schema = node.data_schema
         self.build_data_schema = build_data_schema or build.schema
         self.cached_build_id = cached_build_id
-        self._joiner = Joiner(
+        self._joiner = cached_joiner(
             probe.schema, self.build_data_schema, probe_keys, build_keys, join_type,
             probe_is_left=not build_is_left,
         )
@@ -196,8 +196,14 @@ class BroadcastJoinExec(ExecNode):
         with self._map_lock:
             if self._cached_map is not None:
                 return self._cached_map
+        cache_key = None
         if self.cached_build_id is not None:
-            m = _cache_get(self.cached_build_id)
+            # the build schema is part of the key: two joins sharing a
+            # broadcast id may have been column-pruned differently
+            from ...runtime.kernel_cache import schema_key as _sk
+
+            cache_key = f"{self.cached_build_id}|{hash(_sk(self.build_data_schema))}"
+            m = _cache_get(cache_key)
             if m is not None:
                 self.metrics.add("hashmap_cache_hit", 1)
                 with self._map_lock:
@@ -214,7 +220,7 @@ class BroadcastJoinExec(ExecNode):
         with self._map_lock:
             self._cached_map = m
         if self.cached_build_id is not None:
-            _cache_put(self.cached_build_id, m)
+            _cache_put(cache_key, m)
         return m
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:

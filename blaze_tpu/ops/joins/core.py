@@ -97,8 +97,17 @@ class JoinMap:
 
 def make_build_kernel(build_schema: Schema, build_keys: Sequence[Expr]):
     """Jitted sorted-key-table builder over the build schema (shared by
-    Joiner and BroadcastJoinBuildHashMapExec)."""
+    Joiner and BroadcastJoinBuildHashMapExec); cached process-wide."""
+    from ...exprs.compile import expr_key
+    from ...runtime.kernel_cache import cached_kernel, schema_key
+
     build_keys = list(build_keys)
+    key = ("join_build_kernel", schema_key(build_schema),
+           tuple(expr_key(e) for e in build_keys))
+    return cached_kernel(key, lambda: _make_build_kernel_impl(build_schema, build_keys))
+
+
+def _make_build_kernel_impl(build_schema: Schema, build_keys):
 
     @jax.jit
     def build_kernel(cols: Tuple[Column, ...], num_rows):
@@ -184,6 +193,33 @@ def _null_columns(schema: Schema, cap: int) -> List[Column]:
         else:
             cols.append(Column(f.dtype, jnp.zeros(cap, f.dtype.np_dtype), jnp.zeros(cap, jnp.bool_)))
     return cols
+
+
+def cached_joiner(
+    probe_schema: Schema,
+    build_schema: Schema,
+    probe_key_exprs: Sequence[Expr],
+    build_key_exprs: Sequence[Expr],
+    join_type: "JoinType",
+    probe_is_left: bool,
+    existence_col: str = "exists#0",
+) -> "Joiner":
+    """Process-wide Joiner cache: a Joiner owns 4 jitted kernels and no
+    data, and plans are rebuilt per task — sharing avoids a full XLA
+    recompile of build/probe kernels for every task."""
+    from ...exprs.compile import expr_key
+    from ...runtime.kernel_cache import cached_kernel, schema_key
+
+    key = (
+        "joiner", schema_key(probe_schema), schema_key(build_schema),
+        tuple(expr_key(e) for e in probe_key_exprs),
+        tuple(expr_key(e) for e in build_key_exprs),
+        join_type.value, probe_is_left, existence_col,
+    )
+    return cached_kernel(key, lambda: Joiner(
+        probe_schema, build_schema, probe_key_exprs, build_key_exprs,
+        join_type, probe_is_left, existence_col,
+    ))
 
 
 class JoinerState:

@@ -14,7 +14,7 @@ from ...exprs.ir import Expr
 from ...runtime.context import TaskContext
 from ...schema import Schema
 from ..base import BatchStream, ExecNode
-from .core import Joiner, JoinerState, JoinType
+from .core import JoinerState, JoinType, cached_joiner
 
 
 class HashJoinExec(ExecNode):
@@ -32,7 +32,7 @@ class HashJoinExec(ExecNode):
         self.probe_keys = list(probe_keys)
         self.join_type = join_type
         self.build_is_left = build_is_left
-        self._joiner = Joiner(
+        self._joiner = cached_joiner(
             probe.schema, build.schema, probe_keys, build_keys, join_type,
             probe_is_left=not build_is_left,
         )

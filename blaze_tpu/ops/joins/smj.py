@@ -33,7 +33,7 @@ from ...runtime.context import TaskContext
 from ...runtime.memmgr import MemConsumer, Spill, try_new_spill
 from ...schema import Schema
 from ..base import BatchStream, ExecNode
-from .core import Joiner, JoinerState, JoinMap, JoinType
+from .core import JoinerState, JoinMap, JoinType, cached_joiner
 
 Key = Tuple
 
@@ -214,7 +214,7 @@ class SortMergeJoinExec(ExecNode):
         self.join_type = join_type
         self.nulls_first = nulls_first
         # probe = left (preserves left order); build = right
-        self._joiner = Joiner(
+        self._joiner = cached_joiner(
             left.schema, right.schema, left_keys, right_keys, join_type,
             probe_is_left=True,
         )

@@ -111,9 +111,10 @@ class OrcScanExec(ExecNode):
                         st = stripe.stats.get(name)
                         if st is None or name not in file_fields:
                             continue
-                        if not _stripe_maybe_match(
-                            st, self._schema.field(name).dtype, op, lit_v
-                        ):
+                        fld = next((f for f in self._schema.fields if f.name == name), None)
+                        if fld is None:
+                            continue  # predicate column pruned from read schema
+                        if not _stripe_maybe_match(st, fld.dtype, op, lit_v):
                             pruned = True
                             break
                     if pruned:

@@ -157,7 +157,12 @@ class ParquetScanExec(ExecNode):
                         ch = rg.chunks.get(name)
                         if ch is None:
                             continue
-                        if not _maybe_match(ch, self._schema.field(name).dtype, op, lit_v):
+                        fld = next((f for f in self._schema.fields if f.name == name), None)
+                        if fld is None:
+                            # predicate column pruned from the read
+                            # schema: stats pruning just skips it
+                            continue
+                        if not _maybe_match(ch, fld.dtype, op, lit_v):
                             pruned = True
                             break
                     if pruned:

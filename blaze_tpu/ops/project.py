@@ -44,6 +44,24 @@ class ProjectExec(ExecNode):
         self._schema = Schema(
             [Field(n, infer_dtype(e, in_schema)) for n, e in zip(self.names, self.exprs)]
         )
+        # pure column selection (all exprs are bare Col/Alias(Col)) is a
+        # host-side list pick: no kernel, no dispatch — the cheap select
+        # the column-pruning pass inserts
+        self._select_names: Optional[List[str]] = None
+        picked = []
+        for e in self.exprs:
+            inner = e.child if isinstance(e, Alias) else e
+            if isinstance(inner, Col):
+                picked.append(inner.name)
+            else:
+                picked = None
+                break
+        if picked is not None:
+            self._select_names = picked
+            self._select_idx = [in_schema.index(n) for n in picked]
+            self._device_exprs, self._host_parts = [], []
+            self._in_schema_aug = in_schema
+            return
         # host-fallback subtrees get evaluated per batch outside jit and
         # injected as synthetic columns (≙ SparkUDFWrapperExpr round trip)
         self._device_exprs, self._host_parts = split_host_exprs(self.exprs)
@@ -55,16 +73,27 @@ class ProjectExec(ExecNode):
         schema_aug = self._in_schema_aug
         device_exprs = self._device_exprs
 
-        @jax.jit
-        def kernel(cols: Tuple[Column, ...]) -> Tuple[Column, ...]:
-            n = cols[0].validity.shape[0]
-            env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
-            # ONE memo across the output list: each distinct subtree
-            # lowers once (≙ CachedExprsEvaluator)
-            memo: dict = {}
-            return tuple(lower(e, schema_aug, env, n, memo) for e in device_exprs)
+        def build():
+            @jax.jit
+            def kernel(cols: Tuple[Column, ...]) -> Tuple[Column, ...]:
+                n = cols[0].validity.shape[0]
+                env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
+                # ONE memo across the output list: each distinct subtree
+                # lowers once (≙ CachedExprsEvaluator)
+                memo: dict = {}
+                return tuple(lower(e, schema_aug, env, n, memo) for e in device_exprs)
 
-        self._kernel = kernel
+            return kernel
+
+        from ..exprs.compile import expr_key
+        from ..runtime.kernel_cache import cached_kernel, schema_key
+
+        # plans are rebuilt per task (from_proto): the kernel must be
+        # shared process-wide or every task pays a full XLA recompile
+        self._kernel = cached_kernel(
+            ("project", schema_key(schema_aug), tuple(expr_key(e) for e in device_exprs)),
+            build,
+        )
 
     @property
     def schema(self) -> Schema:
@@ -76,14 +105,22 @@ class ProjectExec(ExecNode):
             cols.append(host_eval(sub, batch))
         return tuple(cols)
 
+    def project_batch(self, batch: RecordBatch) -> RecordBatch:
+        """Project one batch (select fast path or jitted kernel)."""
+        if self._select_names is not None:
+            return RecordBatch(
+                self._schema, [batch.columns[i] for i in self._select_idx], batch.num_rows
+            )
+        out_cols = self._kernel(self._augmented_cols(batch))
+        return RecordBatch(self._schema, list(out_cols), batch.num_rows)
+
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)
 
         def stream():
             for batch in child_stream:
                 with self.metrics.timer("elapsed_compute"):
-                    out_cols = self._kernel(self._augmented_cols(batch))
-                out = RecordBatch(self._schema, list(out_cols), batch.num_rows)
+                    out = self.project_batch(batch)
                 self.metrics.add("output_rows", out.num_rows)
                 yield out
 

@@ -185,26 +185,35 @@ class SortExec(ExecNode):
         in_schema = child.schema
         fields_ = self.fields
 
-        @jax.jit
-        def kernel(cols: Tuple[Column, ...], num_rows):
-            env = {f.name: c for f, c in zip(in_schema.fields, cols)}
-            cap = cols[0].validity.shape[0]
-            key_cols = [lower(f.expr, in_schema, env, cap) for f in fields_]
-            idx = sort_indices(key_cols, fields_, num_rows)
-            return tuple(c.take(idx) for c in cols)
+        def build():
+            @jax.jit
+            def kernel(cols: Tuple[Column, ...], num_rows):
+                env = {f.name: c for f, c in zip(in_schema.fields, cols)}
+                cap = cols[0].validity.shape[0]
+                key_cols = [lower(f.expr, in_schema, env, cap) for f in fields_]
+                idx = sort_indices(key_cols, fields_, num_rows)
+                return tuple(c.take(idx) for c in cols)
 
-        @jax.jit
-        def key_words(cols: Tuple[Column, ...], num_rows):
-            env = {f.name: c for f, c in zip(in_schema.fields, cols)}
-            cap = cols[0].validity.shape[0]
-            key_cols = [lower(f.expr, in_schema, env, cap) for f in fields_]
-            words: List[jnp.ndarray] = []
-            for c, f in zip(key_cols, fields_):
-                words.extend(order_words(c, f.ascending, f.nulls_first))
-            return jnp.stack(words, axis=1)  # (cap, W)
+            @jax.jit
+            def key_words(cols: Tuple[Column, ...], num_rows):
+                env = {f.name: c for f, c in zip(in_schema.fields, cols)}
+                cap = cols[0].validity.shape[0]
+                key_cols = [lower(f.expr, in_schema, env, cap) for f in fields_]
+                words: List[jnp.ndarray] = []
+                for c, f in zip(key_cols, fields_):
+                    words.extend(order_words(c, f.ascending, f.nulls_first))
+                return jnp.stack(words, axis=1)  # (cap, W)
 
-        self._kernel = kernel
-        self._key_words = key_words
+            return kernel, key_words
+
+        from ..exprs.compile import expr_key
+        from ..runtime.kernel_cache import cached_kernel, schema_key
+
+        self._kernel, self._key_words = cached_kernel(
+            ("sort", schema_key(in_schema),
+             tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in fields_)),
+            build,
+        )
 
     @property
     def schema(self) -> Schema:

@@ -45,6 +45,123 @@ class WindowFunction:
     whole_partition: bool = False  # True: unbounded..unbounded frame
 
 
+def _build_window_kernel(in_schema, functions_, part_by, ord_by):
+    @jax.jit
+    def kernel(cols: Tuple[Column, ...], num_rows):
+        cap = cols[0].validity.shape[0]
+        env = {f.name: c for f, c in zip(in_schema.fields, cols)}
+        live = jnp.arange(cap) < num_rows
+
+        def boundaries(words):
+            ch = jnp.zeros(cap, jnp.bool_)
+            for w in words:
+                w = jnp.where(live, w, jnp.uint64(0))
+                ch = ch | (w != jnp.roll(w, 1))
+            return ch.at[0].set(True)
+
+        pwords = encode_key_words([lower(e, in_schema, env, cap) for e in part_by]) if part_by else []
+        part_b = boundaries(pwords) if part_by else jnp.zeros(cap, jnp.bool_).at[0].set(True)
+        owords: List = []
+        for f in ord_by:
+            owords.extend(order_words(lower(f.expr, in_schema, env, cap), f.ascending, f.nulls_first))
+        peer_b = boundaries(pwords + owords) if ord_by else part_b
+
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        seg = jnp.cumsum(part_b.astype(jnp.int64)) - 1
+        n_segs = cap  # upper bound
+        seg_start = jax.ops.segment_min(pos, seg, num_segments=n_segs, indices_are_sorted=True)
+        start_of_row = jnp.take(seg_start, seg)
+
+        # peer-group end index per row (last row of equal order keys
+        # within the partition): next peer boundary - 1
+        nxt = jnp.where(peer_b, pos, jnp.int64(cap))
+        # for each row, the smallest boundary position > pos:
+        rev_min = jax.lax.associative_scan(jnp.minimum, nxt[::-1])[::-1]
+        shifted = jnp.concatenate([rev_min[1:], jnp.array([cap], jnp.int64)])
+        peer_end = jnp.minimum(shifted - 1, jnp.take(
+            jax.ops.segment_max(pos * live, seg, num_segments=n_segs, indices_are_sorted=True), seg
+        ))
+
+        out_cols: List[Column] = list(cols)
+        ones = jnp.ones(cap, jnp.bool_) & live
+        for f in functions_:
+            if f.kind == "row_number":
+                v = pos - start_of_row + 1
+                out_cols.append(Column(DataType.int64(), v, ones))
+            elif f.kind == "rank":
+                last_peer_start = jax.lax.associative_scan(
+                    jnp.maximum, jnp.where(peer_b, pos, jnp.int64(0))
+                )
+                v = last_peer_start - start_of_row + 1
+                out_cols.append(Column(DataType.int64(), v, ones))
+            elif f.kind == "dense_rank":
+                peers_seen = jnp.cumsum(peer_b.astype(jnp.int64))
+                peers_at_start = jnp.take(peers_seen, start_of_row)
+                v = peers_seen - peers_at_start + 1
+                out_cols.append(Column(DataType.int64(), v, ones))
+            else:
+                c = lower(f.expr, in_schema, env, cap)
+                valid = c.validity & live
+                if f.kind in ("sum", "avg", "count"):
+                    st = sum_result_type(c.dtype) if f.kind != "count" else DataType.int64()
+                    vals = (
+                        jnp.where(valid, c.data, jnp.zeros((), c.data.dtype)).astype(st.np_dtype)
+                        if f.kind != "count"
+                        else valid.astype(jnp.int64)
+                    )
+                    csum = jnp.cumsum(vals)
+                    cnt = jnp.cumsum(valid.astype(jnp.int64))
+                    if f.whole_partition:
+                        seg_sum = jax.ops.segment_sum(vals, seg, num_segments=n_segs, indices_are_sorted=True)
+                        seg_cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=n_segs, indices_are_sorted=True)
+                        run_sum = jnp.take(seg_sum, seg)
+                        run_cnt = jnp.take(seg_cnt, seg)
+                    else:
+                        base_sum = jnp.where(start_of_row > 0, jnp.take(csum, jnp.maximum(start_of_row - 1, 0)), 0)
+                        base_cnt = jnp.where(start_of_row > 0, jnp.take(cnt, jnp.maximum(start_of_row - 1, 0)), 0)
+                        run_sum = jnp.take(csum, peer_end) - base_sum
+                        run_cnt = jnp.take(cnt, peer_end) - base_cnt
+                    if f.kind == "count":
+                        out_cols.append(Column(DataType.int64(), run_cnt, ones))
+                    elif f.kind == "sum":
+                        out_cols.append(Column(st, run_sum, ones & (run_cnt > 0)))
+                    else:
+                        den = jnp.maximum(run_cnt, 1)
+                        from ..schema import decimal_avg_agg_type
+
+                        if c.dtype.is_decimal:
+                            rt = decimal_avg_agg_type(c.dtype)
+                            shift = rt.scale - c.dtype.scale
+                            num = run_sum * jnp.int64(10**shift)
+                            half = den // 2
+                            adj = jnp.where(num >= 0, num + half, num - half)
+                            q = jnp.where(adj >= 0, adj // den, -((-adj) // den))
+                            out_cols.append(Column(rt, q, ones & (run_cnt > 0)))
+                        else:
+                            out_cols.append(
+                                Column(
+                                    DataType.float64(),
+                                    run_sum.astype(jnp.float64) / den.astype(jnp.float64),
+                                    ones & (run_cnt > 0),
+                                )
+                            )
+                elif f.kind in ("min", "max"):
+                    # whole-partition frame only (running min/max:
+                    # segmented-scan, roadmap)
+                    from .agg import _seg_minmax
+
+                    red = _seg_minmax(c.data, valid, seg, n_segs, f.kind == "min")
+                    has = jax.ops.segment_max(valid.astype(jnp.int32), seg, num_segments=n_segs, indices_are_sorted=True).astype(jnp.bool_)
+                    out_cols.append(
+                        Column(c.dtype, jnp.take(red, seg), jnp.take(has, seg) & ones)
+                    )
+                else:
+                    raise NotImplementedError(f.kind)
+        return tuple(out_cols)
+
+    return kernel
+
+
 class WindowExec(ExecNode):
     def __init__(
         self,
@@ -79,120 +196,20 @@ class WindowExec(ExecNode):
         part_by = self.partition_by
         ord_by = self.order_by
 
-        @jax.jit
-        def kernel(cols: Tuple[Column, ...], num_rows):
-            cap = cols[0].validity.shape[0]
-            env = {f.name: c for f, c in zip(in_schema.fields, cols)}
-            live = jnp.arange(cap) < num_rows
+        def build():
+            return _build_window_kernel(in_schema, functions_, part_by, ord_by)
 
-            def boundaries(words):
-                ch = jnp.zeros(cap, jnp.bool_)
-                for w in words:
-                    w = jnp.where(live, w, jnp.uint64(0))
-                    ch = ch | (w != jnp.roll(w, 1))
-                return ch.at[0].set(True)
+        from ..exprs.compile import expr_key
+        from ..runtime.kernel_cache import cached_kernel, schema_key
 
-            pwords = encode_key_words([lower(e, in_schema, env, cap) for e in part_by]) if part_by else []
-            part_b = boundaries(pwords) if part_by else jnp.zeros(cap, jnp.bool_).at[0].set(True)
-            owords: List = []
-            for f in ord_by:
-                owords.extend(order_words(lower(f.expr, in_schema, env, cap), f.ascending, f.nulls_first))
-            peer_b = boundaries(pwords + owords) if ord_by else part_b
-
-            pos = jnp.arange(cap, dtype=jnp.int64)
-            seg = jnp.cumsum(part_b.astype(jnp.int64)) - 1
-            n_segs = cap  # upper bound
-            seg_start = jax.ops.segment_min(pos, seg, num_segments=n_segs, indices_are_sorted=True)
-            start_of_row = jnp.take(seg_start, seg)
-
-            # peer-group end index per row (last row of equal order keys
-            # within the partition): next peer boundary - 1
-            nxt = jnp.where(peer_b, pos, jnp.int64(cap))
-            # for each row, the smallest boundary position > pos:
-            rev_min = jax.lax.associative_scan(jnp.minimum, nxt[::-1])[::-1]
-            shifted = jnp.concatenate([rev_min[1:], jnp.array([cap], jnp.int64)])
-            peer_end = jnp.minimum(shifted - 1, jnp.take(
-                jax.ops.segment_max(pos * live, seg, num_segments=n_segs, indices_are_sorted=True), seg
-            ))
-
-            out_cols: List[Column] = list(cols)
-            ones = jnp.ones(cap, jnp.bool_) & live
-            for f in functions_:
-                if f.kind == "row_number":
-                    v = pos - start_of_row + 1
-                    out_cols.append(Column(DataType.int64(), v, ones))
-                elif f.kind == "rank":
-                    last_peer_start = jax.lax.associative_scan(
-                        jnp.maximum, jnp.where(peer_b, pos, jnp.int64(0))
-                    )
-                    v = last_peer_start - start_of_row + 1
-                    out_cols.append(Column(DataType.int64(), v, ones))
-                elif f.kind == "dense_rank":
-                    peers_seen = jnp.cumsum(peer_b.astype(jnp.int64))
-                    peers_at_start = jnp.take(peers_seen, start_of_row)
-                    v = peers_seen - peers_at_start + 1
-                    out_cols.append(Column(DataType.int64(), v, ones))
-                else:
-                    c = lower(f.expr, in_schema, env, cap)
-                    valid = c.validity & live
-                    if f.kind in ("sum", "avg", "count"):
-                        st = sum_result_type(c.dtype) if f.kind != "count" else DataType.int64()
-                        vals = (
-                            jnp.where(valid, c.data, jnp.zeros((), c.data.dtype)).astype(st.np_dtype)
-                            if f.kind != "count"
-                            else valid.astype(jnp.int64)
-                        )
-                        csum = jnp.cumsum(vals)
-                        cnt = jnp.cumsum(valid.astype(jnp.int64))
-                        if f.whole_partition:
-                            seg_sum = jax.ops.segment_sum(vals, seg, num_segments=n_segs, indices_are_sorted=True)
-                            seg_cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=n_segs, indices_are_sorted=True)
-                            run_sum = jnp.take(seg_sum, seg)
-                            run_cnt = jnp.take(seg_cnt, seg)
-                        else:
-                            base_sum = jnp.where(start_of_row > 0, jnp.take(csum, jnp.maximum(start_of_row - 1, 0)), 0)
-                            base_cnt = jnp.where(start_of_row > 0, jnp.take(cnt, jnp.maximum(start_of_row - 1, 0)), 0)
-                            run_sum = jnp.take(csum, peer_end) - base_sum
-                            run_cnt = jnp.take(cnt, peer_end) - base_cnt
-                        if f.kind == "count":
-                            out_cols.append(Column(DataType.int64(), run_cnt, ones))
-                        elif f.kind == "sum":
-                            out_cols.append(Column(st, run_sum, ones & (run_cnt > 0)))
-                        else:
-                            den = jnp.maximum(run_cnt, 1)
-                            from ..schema import decimal_avg_agg_type
-
-                            if c.dtype.is_decimal:
-                                rt = decimal_avg_agg_type(c.dtype)
-                                shift = rt.scale - c.dtype.scale
-                                num = run_sum * jnp.int64(10**shift)
-                                half = den // 2
-                                adj = jnp.where(num >= 0, num + half, num - half)
-                                q = jnp.where(adj >= 0, adj // den, -((-adj) // den))
-                                out_cols.append(Column(rt, q, ones & (run_cnt > 0)))
-                            else:
-                                out_cols.append(
-                                    Column(
-                                        DataType.float64(),
-                                        run_sum.astype(jnp.float64) / den.astype(jnp.float64),
-                                        ones & (run_cnt > 0),
-                                    )
-                                )
-                    elif f.kind in ("min", "max"):
-                        # whole-partition frame only (running min/max:
-                        # segmented-scan, roadmap)
-                        from .agg import _seg_minmax
-
-                        red = _seg_minmax(c.data, valid, seg, n_segs, f.kind == "min")
-                        has = jax.ops.segment_max(valid.astype(jnp.int32), seg, num_segments=n_segs, indices_are_sorted=True).astype(jnp.bool_)
-                        out_cols.append(
-                            Column(c.dtype, jnp.take(red, seg), jnp.take(has, seg) & ones)
-                        )
-                    else:
-                        raise NotImplementedError(f.kind)
-            return tuple(out_cols)
-
-        self._kernel = kernel
+        self._kernel = cached_kernel(
+            ("window", schema_key(in_schema),
+             tuple((f.kind, f.name, None if f.expr is None else expr_key(f.expr),
+                    f.whole_partition) for f in functions_),
+             tuple(expr_key(e) for e in part_by),
+             tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in ord_by)),
+            build,
+        )
 
     @property
     def schema(self) -> Schema:

@@ -189,6 +189,35 @@ def _host_concat(batches: List[RecordBatch], schema: Schema) -> RecordBatch:
 
 # ------------------------------------------------------------------- execs
 
+def _build_pid_kernels(schema, exprs, n_out):
+    @jax.jit
+    def hash_pids(cols, num_rows):
+        cap = cols[0].validity.shape[0]
+        env = {f.name: c for f, c in zip(schema.fields, cols)}
+        key_cols = [lower(e, schema, env, cap) for e in exprs]
+        return pmod(murmur3_columns(key_cols), n_out)
+
+    
+    @jax.jit
+    def hash_pids_pallas(cols, num_rows):
+        # whole pipeline (expr lowering, word-plane split, fused
+        # kernel) traced once per shape bucket, like the XLA path
+        from ..kernels import pallas_ops
+
+        cap = cols[0].validity.shape[0]
+        env = {f.name: c for f, c in zip(schema.fields, cols)}
+        planes, widths, valids = [], [], []
+        for e in exprs:
+            c = lower(e, schema, env, cap)
+            p, w = pallas_ops.column_word_planes(c)
+            planes += p
+            widths.append(w)
+            valids.append(c.validity)
+        return pallas_ops.murmur3_pids(planes, widths, valids, n_out)
+
+    return hash_pids, hash_pids_pallas
+
+
 class ShuffleWriterExec(ExecNode):
     """Runs the child and writes this map task's partitioned output.
     ≙ shuffle_writer_exec.rs:52-186 (Single vs Sort repartitioner
@@ -206,33 +235,14 @@ class ShuffleWriterExec(ExecNode):
             exprs = list(partitioning.exprs)
             n_out = partitioning.num_partitions
 
-            @jax.jit
-            def hash_pids(cols, num_rows):
-                cap = cols[0].validity.shape[0]
-                env = {f.name: c for f, c in zip(schema.fields, cols)}
-                key_cols = [lower(e, schema, env, cap) for e in exprs]
-                return pmod(murmur3_columns(key_cols), n_out)
+            from ..exprs.compile import expr_key
+            from ..runtime.kernel_cache import cached_kernel, schema_key
 
-            self._hash_pids_xla = hash_pids
-
-            @jax.jit
-            def hash_pids_pallas(cols, num_rows):
-                # whole pipeline (expr lowering, word-plane split, fused
-                # kernel) traced once per shape bucket, like the XLA path
-                from ..kernels import pallas_ops
-
-                cap = cols[0].validity.shape[0]
-                env = {f.name: c for f, c in zip(schema.fields, cols)}
-                planes, widths, valids = [], [], []
-                for e in exprs:
-                    c = lower(e, schema, env, cap)
-                    p, w = pallas_ops.column_word_planes(c)
-                    planes += p
-                    widths.append(w)
-                    valids.append(c.validity)
-                return pallas_ops.murmur3_pids(planes, widths, valids, n_out)
-
-            self._hash_pids_pallas = hash_pids_pallas
+            self._hash_pids_xla, self._hash_pids_pallas = cached_kernel(
+                ("shuffle_pids", schema_key(schema),
+                 tuple(expr_key(e) for e in exprs), n_out),
+                lambda: _build_pid_kernels(schema, exprs, n_out),
+            )
             # pallas fast path decided on the first batch (key dtypes
             # are static); falls back to XLA for string/unsupported keys
             self._pallas_pids = conf.PALLAS_ENABLE.get()

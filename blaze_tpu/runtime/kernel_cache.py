@@ -1,0 +1,62 @@
+"""Process-wide operator-kernel cache.
+
+≙ SURVEY.md §7: kernels are "compiled per (operator, schema,
+batch-shape-bucket) and cached".  Exec nodes are rebuilt per task (the
+gateway decodes a fresh plan from TaskDefinition bytes, exactly like
+the reference's from_proto per task), so jitted kernels must NOT live
+on exec instances — a per-instance ``@jax.jit`` closure means a full
+XLA recompile for every task.  Builders register here under a
+structural key (operator name + schema signature + expression keys);
+the shape-bucket dimension is jax's own jit cache on the shared
+function object.
+
+Builders must close over NOTHING reachable from an exec node's
+children (that would pin scanned data for the process lifetime) —
+only schemas, expression IR, and static parameters.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Tuple
+
+from ..schema import Schema
+
+_CACHE: Dict[tuple, Any] = {}
+_LOCK = threading.Lock()
+
+
+def schema_key(schema: Schema) -> Tuple:
+    return tuple((f.name, f.dtype) for f in schema.fields)
+
+
+def key_cacheable(key) -> bool:
+    """False when the key embeds an opaque (identity-keyed) expression
+    — e.g. a PythonUdf — which would grow the cache per instance."""
+    if isinstance(key, tuple):
+        return all(key_cacheable(k) for k in key)
+    return key != "opaque"
+
+
+def cached_kernel(key: tuple, builder: Callable[[], Any]) -> Any:
+    """Return the kernel(s) registered under ``key``, building once.
+    Keys containing opaque expressions bypass the cache."""
+    if not key_cacheable(key):
+        return builder()
+    with _LOCK:
+        hit = _CACHE.get(key)
+        if hit is not None:
+            return hit
+    built = builder()
+    with _LOCK:
+        return _CACHE.setdefault(key, built)
+
+
+def cache_stats() -> Dict[str, int]:
+    with _LOCK:
+        return {"entries": len(_CACHE)}
+
+
+def clear_kernel_cache() -> None:
+    with _LOCK:
+        _CACHE.clear()

@@ -304,6 +304,9 @@ def run_task(task_def_bytes: bytes):
 
     td = pb.TaskDefinition()
     td.ParseFromString(task_def_bytes)
-    plan = plan_from_proto(td.plan)
+    from ..ops.fusion import fuse_stages
+    from ..ops.pruning import prune_columns
+
+    plan = prune_columns(fuse_stages(plan_from_proto(td.plan)))
     ctx = TaskContext(td.partition, max(plan.num_partitions(), td.partition + 1))
     return plan.execute(td.partition, ctx)

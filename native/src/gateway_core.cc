@@ -193,6 +193,7 @@ void* bt_gateway_call_native(const uint8_t* task_def, int64_t len,
 int32_t bt_gateway_next_batch(void* p) {
   auto* rt = (GatewayRuntime*)p;
   uintptr_t addr = 0;
+  std::string err;
   {
     std::unique_lock<std::mutex> lk(rt->mu);
     rt->cv.wait(lk, [&] { return !rt->queue.empty() || rt->done; });
@@ -200,11 +201,14 @@ int32_t bt_gateway_next_batch(void* p) {
       addr = rt->queue.front();
       rt->queue.pop_front();
     } else if (!rt->error.empty()) {
-      if (rt->cbs.set_error) rt->cbs.set_error(rt->cbs.user, rt->error.c_str());
-      return -1;
-    } else {
-      return 0;  // clean end of stream
+      err = rt->error;  // fire the callback OUTSIDE the lock: a
+    } else {            // consumer may re-enter bt_gateway_last_error
+      return 0;         // (clean end of stream)
     }
+  }
+  if (!err.empty()) {
+    if (rt->cbs.set_error) rt->cbs.set_error(rt->cbs.user, err.c_str());
+    return -1;
   }
   rt->cv.notify_all();
   if (rt->cbs.import_batch) rt->cbs.import_batch(rt->cbs.user, addr);

@@ -1,0 +1,107 @@
+"""Stage fusion + column pruning: optimized plans produce IDENTICAL
+results to the naive plans on TPC-H q1/q6/q19, and run_task applies
+both to every decoded task plan.
+"""
+
+import numpy as np
+import pytest
+
+from blaze_tpu.batch import batch_to_pydict
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.agg import AggExec
+from blaze_tpu.ops.fusion import fuse_stages
+from blaze_tpu.ops.pruning import prune_columns
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.tpch import TPCH_SCHEMAS, build_query
+from blaze_tpu.tpch.datagen import generate_all, table_to_batches
+
+SCALE = 0.002
+N_PARTS = 2
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate_all(SCALE)
+
+
+def _scans(data):
+    return {
+        name: MemoryScanExec(
+            table_to_batches(data[name], TPCH_SCHEMAS[name], N_PARTS, batch_rows=2048),
+            TPCH_SCHEMAS[name],
+        )
+        for name in TPCH_SCHEMAS
+    }
+
+
+def run(plan):
+    out = {f.name: [] for f in plan.schema.fields}
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            for k in out:
+                out[k].extend(d[k])
+    return out
+
+
+def _rows(d):
+    return sorted(zip(*d.values()), key=repr)
+
+
+@pytest.mark.parametrize("q", ["q1", "q6", "q19", "q3"])
+def test_fused_pruned_matches_naive(data, q):
+    naive = run(build_query(q, _scans(data), N_PARTS))
+    opt = run(prune_columns(fuse_stages(build_query(q, _scans(data), N_PARTS))))
+    assert _rows(opt) == _rows(naive)
+
+
+def test_fusion_collapses_q6_map_stage(data):
+    """q6's filter+project+partial-agg become ONE AggExec with a fused
+    pre_filter directly over the scan."""
+    plan = fuse_stages(build_query("q6", _scans(data), N_PARTS))
+
+    partials = []
+
+    def walk(n):
+        if isinstance(n, AggExec) and n.pre_filter is not None:
+            partials.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    assert partials, "no fused partial agg found"
+    fused = partials[0]
+    assert type(fused.children[0]).__name__ == "MemoryScanExec"
+
+
+def test_run_task_applies_optimizations(data):
+    """run_task fuses+prunes every decoded task plan (TaskDefinitions
+    never contain an exchange — the map side of q6 is exactly
+    filter->project->partial-agg, the fusable chain)."""
+    from blaze_tpu.exprs import col, lit
+    from blaze_tpu.ops import AggExec as _Agg, AggFunction, AggMode, FilterExec, ProjectExec
+    from blaze_tpu.schema import DataType
+    from blaze_tpu.serde.from_proto import run_task
+    from blaze_tpu.serde.to_proto import task_definition
+    import datetime
+
+    def map_side():
+        scan = _scans(data)["lineitem"]
+        dec12 = lambda v: lit(v, DataType.decimal(12, 2))
+        f = FilterExec(
+            scan,
+            (col("l_shipdate") >= lit(datetime.date(1994, 1, 1)))
+            & (col("l_discount") >= dec12("0.05")),
+        )
+        proj = ProjectExec(f, [(col("l_extendedprice") * col("l_discount")).alias("rev")])
+        return _Agg(proj, AggMode.PARTIAL, [], [AggFunction("sum", col("rev"), "revenue")])
+
+    naive = run(map_side())
+    td = task_definition(map_side(), "t", 0, 0)
+    got = {"revenue#sum": [], "revenue#nonnull": []}
+    for b in run_task(td):
+        d = batch_to_pydict(b)
+        for k in got:
+            got[k].extend(d[k])
+    # run_task drives partition 0 only; naive ran both partitions
+    assert got["revenue#sum"] == naive["revenue#sum"][:1]
