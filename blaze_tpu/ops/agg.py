@@ -193,6 +193,33 @@ def _seg_first(values, valid, seg, cap, ignore_nulls: bool):
     return jnp.take(values, safe, axis=0), jnp.take(valid, safe) & has, has
 
 
+def _seg_gather_first(v: Column, pick, seg, cap: int) -> Column:
+    """Gather the first row per segment where ``pick`` holds."""
+    n = v.validity.shape[0]
+    idx = jnp.where(pick, jnp.arange(n), n)
+    first = jax.ops.segment_min(idx, seg, num_segments=cap, indices_are_sorted=True)
+    has = first < n
+    out = v.take(jnp.clip(first, 0, n - 1))
+    return Column(v.dtype, out.data, out.validity & has,
+                  None if out.lengths is None else jnp.where(has, out.lengths, 0))
+
+
+def _seg_string_minmax(v: Column, seg, cap: int, is_min: bool) -> Column:
+    """Lexicographic per-segment min/max over a string column: W/8
+    tie-break passes of segment_min over order-preserving words, then a
+    first-candidate gather (rows arrive segment-sorted)."""
+    from .sort import order_words
+
+    words = order_words(v, ascending=is_min, nulls_first=False)[1:]  # value words
+    cand = v.validity
+    sentinel = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    for word in words:
+        masked = jnp.where(cand, word, sentinel)
+        m = jax.ops.segment_min(masked, seg, num_segments=cap, indices_are_sorted=True)
+        cand = cand & (word == jnp.take(m, seg))
+    return _seg_gather_first(v, cand, seg, cap)
+
+
 # ------------------------------------------------- collect_list/set
 
 def _seg_first_row(seg, cap, n):
@@ -489,7 +516,7 @@ class AggExec(ExecNode):
             if a.fn in ("min", "max"):
                 v = inputs[0]
                 if v.dtype.is_string:
-                    raise NotImplementedError("min/max over strings (roadmap)")
+                    return [_seg_string_minmax(v, seg, cap, a.fn == "min")]
                 vals = _seg_minmax(v.data, v.validity, seg, cap, a.fn == "min")
                 has = jax.ops.segment_max(
                     v.validity.astype(jnp.int32), seg, num_segments=cap, indices_are_sorted=True
@@ -497,11 +524,11 @@ class AggExec(ExecNode):
                 return [Column(v.dtype, jnp.where(has, vals, jnp.zeros((), vals.dtype)), has)]
             if a.fn in ("first", "first_ignores_null"):
                 v = inputs[0]
+                ignore = a.fn == "first_ignores_null" or mode != AggMode.PARTIAL
                 if v.dtype.is_string:
-                    raise NotImplementedError("first over strings (roadmap)")
-                vals, valid, has = _seg_first(
-                    v.data, v.validity, seg, cap, a.fn == "first_ignores_null" or mode != AggMode.PARTIAL
-                )
+                    pick = v.validity if ignore else jnp.ones_like(v.validity)
+                    return [_seg_gather_first(v, pick, seg, cap)]
+                vals, valid, has = _seg_first(v.data, v.validity, seg, cap, ignore)
                 return [Column(v.dtype, jnp.where(valid, vals, jnp.zeros((), vals.dtype)), valid)]
             if a.fn in ("collect_list", "collect_set"):
                 arr_t = state_schema.field(f"{a.name}#list").dtype

@@ -1,0 +1,61 @@
+"""min/max/first over STRING columns in the sort-segment agg
+(round-1 roadmap item): lexicographic per-segment min/max via
+order-word tie-break passes; verified against python semantics across
+partial -> final merges.
+"""
+
+import numpy as np
+
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.agg import AggExec, AggFunction, AggMode, GroupingExpr
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+
+SCHEMA = Schema([Field("g", DataType.int32()), Field("s", DataType.string(16))])
+
+
+def _run(batches, fns):
+    plan = AggExec(MemoryScanExec([batches], SCHEMA), AggMode.PARTIAL,
+                   [GroupingExpr(col("g"), "g")], fns)
+    plan = AggExec(plan, AggMode.FINAL, [GroupingExpr(col("g"), "g")], fns)
+    out = list(plan.execute(0, TaskContext(0, 1)))
+    return batch_to_pydict(out[0])
+
+
+def test_string_min_max_first():
+    data = {"g": [1, 1, 1, 2, 2, 3],
+            "s": ["banana", "apple", "ab", None, "zz", None]}
+    b = batch_from_pydict(data, SCHEMA)
+    fns = [AggFunction("min", col("s"), "mn"), AggFunction("max", col("s"), "mx"),
+           AggFunction("first_ignores_null", col("s"), "fi")]
+    d = _run([b], fns)
+    got = {g: (mn, mx, fi) for g, mn, mx, fi in zip(d["g"], d["mn"], d["mx"], d["fi"])}
+    assert got[1] == ("ab", "banana", "banana")
+    assert got[2] == ("zz", "zz", "zz")
+    assert got[3] == (None, None, None)
+
+
+def test_string_minmax_randomized_multi_batch():
+    rng = np.random.RandomState(3)
+    words = ["", "a", "ab", "abc", "b", "ba", "zz", "zzz", "m", "mm"]
+    gs, ss = [], []
+    for _ in range(300):
+        gs.append(int(rng.randint(0, 10)))
+        ss.append(None if rng.rand() < 0.2 else words[rng.randint(len(words))])
+    batches = [
+        batch_from_pydict({"g": gs[i : i + 64], "s": ss[i : i + 64]}, SCHEMA)
+        for i in range(0, 300, 64)
+    ]
+    fns = [AggFunction("min", col("s"), "mn"), AggFunction("max", col("s"), "mx")]
+    d = _run(batches, fns)
+    exp_min, exp_max = {}, {}
+    for g, s in zip(gs, ss):
+        if s is None:
+            continue
+        exp_min[g] = min(exp_min.get(g, s), s)
+        exp_max[g] = max(exp_max.get(g, s), s)
+    for g, mn, mx in zip(d["g"], d["mn"], d["mx"]):
+        assert mn == exp_min.get(g), (g, mn, exp_min.get(g))
+        assert mx == exp_max.get(g), (g, mx, exp_max.get(g))
