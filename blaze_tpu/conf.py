@@ -62,6 +62,10 @@ PARQUET_FILTER_PUSHDOWN = ConfEntry("spark.blaze.parquet.enable.pageFiltering", 
 PALLAS_ENABLE = ConfEntry("spark.blaze.tpu.pallas.enable", True, _bool)
 INPUT_BATCH_STATISTICS = ConfEntry("spark.blaze.inputBatchStatistics", False, _bool)
 UDF_WRAPPER_NUM_THREADS = ConfEntry("spark.blaze.udfWrapperNumThreads", 1, int)
+# pickled UDF/UDTF payloads in TaskDefinitions execute arbitrary code at
+# deserialization (round-1 advisor finding): a gateway deployed across a
+# trust boundary must run with this OFF and register generators by name
+ALLOW_PICKLED_UDFS = ConfEntry("spark.blaze.udf.allowPickled", True, _bool)
 SMJ_FALLBACK_ENABLE = ConfEntry("spark.blaze.smjfallback.enable", True, _bool)
 # fixed per-group element budget for collect_list/collect_set results
 # (the reference's lists are unbounded; the padded device layout is not —

@@ -284,6 +284,13 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
 
             gen = NativeGenerator(g.native_kind, expr_from_proto(g.native_expr))
         else:
+            from .. import conf
+
+            if not bool(conf.ALLOW_PICKLED_UDFS.get()):
+                raise PermissionError(
+                    "pickled generator payload rejected: "
+                    "spark.blaze.udf.allowPickled is false"
+                )
             gen = pickle.loads(g.generator_payload)
         return GenerateExec(
             plan_from_proto(g.input),

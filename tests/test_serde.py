@@ -93,3 +93,39 @@ def test_task_definition_entry():
     td = task_definition(plan, task_id="t-0", stage_id=1, partition=0)
     batches = list(run_task(td))
     assert batch_to_pydict(batches[0])["k1"] == [6, 7]
+
+
+def _identity_generator(row):
+    return [row]
+
+
+def test_pickled_generator_gate():
+    """spark.blaze.udf.allowPickled=false rejects pickled payloads at
+    decode (the gateway's trust-boundary hardening)."""
+    import pytest
+
+    from blaze_tpu import conf
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.ops import MemoryScanExec
+    from blaze_tpu.ops.generate import GenerateExec
+    from blaze_tpu.schema import DataType, Field, Schema
+    from blaze_tpu.serde.from_proto import plan_from_proto
+    from blaze_tpu.serde.to_proto import plan_to_proto
+
+    schema = Schema([Field("j", DataType.string(32))])
+    b = batch_from_pydict({"j": ['{"a":1}']}, schema)
+    g = GenerateExec(
+        MemoryScanExec([[b]], schema), _identity_generator,
+        [__import__("blaze_tpu.exprs", fromlist=["col"]).col("j")],
+        [Field("a", DataType.string(16))],
+    )
+    proto = plan_to_proto(g)
+    old = conf.ALLOW_PICKLED_UDFS.get()
+    try:
+        conf.ALLOW_PICKLED_UDFS.set(False)
+        with pytest.raises(PermissionError, match="allowPickled"):
+            plan_from_proto(proto)
+        conf.ALLOW_PICKLED_UDFS.set(True)
+        assert plan_from_proto(proto) is not None
+    finally:
+        conf.ALLOW_PICKLED_UDFS.set(old)
