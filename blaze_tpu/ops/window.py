@@ -146,15 +146,48 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
                                 )
                             )
                 elif f.kind in ("min", "max"):
-                    # whole-partition frame only (running min/max:
-                    # segmented-scan, roadmap)
                     from .agg import _seg_minmax
 
-                    red = _seg_minmax(c.data, valid, seg, n_segs, f.kind == "min")
-                    has = jax.ops.segment_max(valid.astype(jnp.int32), seg, num_segments=n_segs, indices_are_sorted=True).astype(jnp.bool_)
-                    out_cols.append(
-                        Column(c.dtype, jnp.take(red, seg), jnp.take(has, seg) & ones)
-                    )
+                    if f.whole_partition:
+                        red = _seg_minmax(c.data, valid, seg, n_segs, f.kind == "min")
+                        has = jax.ops.segment_max(valid.astype(jnp.int32), seg, num_segments=n_segs, indices_are_sorted=True).astype(jnp.bool_)
+                        out_cols.append(
+                            Column(c.dtype, jnp.take(red, seg), jnp.take(has, seg) & ones)
+                        )
+                    else:
+                        # running frame (unbounded preceding .. current
+                        # peer): SEGMENTED prefix min/max — an
+                        # associative scan carrying partition-boundary
+                        # flags, then gathered at each row's peer end
+                        dt = c.data.dtype
+                        if jnp.issubdtype(dt, jnp.floating):
+                            sentinel = jnp.array(
+                                jnp.inf if f.kind == "min" else -jnp.inf, dt
+                            )
+                        else:
+                            info = jnp.iinfo(dt)
+                            sentinel = jnp.array(
+                                info.max if f.kind == "min" else info.min, dt
+                            )
+                        vals = jnp.where(valid, c.data, sentinel)
+                        pick = jnp.minimum if f.kind == "min" else jnp.maximum
+
+                        def seg_scan_op(a, b, _pick=pick):
+                            m = jnp.where(b[1], b[0], _pick(a[0], b[0]))
+                            return m, a[1] | b[1]
+
+                        m, _ = jax.lax.associative_scan(seg_scan_op, (vals, part_b))
+                        run = jnp.take(m, peer_end)
+                        cv = jnp.cumsum(valid.astype(jnp.int64))
+                        base_cnt = jnp.where(
+                            start_of_row > 0,
+                            jnp.take(cv, jnp.maximum(start_of_row - 1, 0)), 0,
+                        )
+                        run_cnt = jnp.take(cv, peer_end) - base_cnt
+                        has = ones & (run_cnt > 0)
+                        out_cols.append(
+                            Column(c.dtype, jnp.where(has, run, jnp.zeros((), dt)), has)
+                        )
                 else:
                     raise NotImplementedError(f.kind)
         return tuple(out_cols)

@@ -59,3 +59,37 @@ def test_string_minmax_randomized_multi_batch():
     for g, mn, mx in zip(d["g"], d["mn"], d["mx"]):
         assert mn == exp_min.get(g), (g, mn, exp_min.get(g))
         assert mx == exp_max.get(g), (g, mx, exp_max.get(g))
+
+
+def test_window_running_min_max():
+    """Running (unbounded-preceding..current-peer) min/max frames via
+    segmented associative scan, vs a python oracle with peers+nulls."""
+    from blaze_tpu.ops.sort import SortField
+    from blaze_tpu.ops.window import WindowExec, WindowFunction
+
+    rng = np.random.RandomState(9)
+    n = 200
+    ps = sorted(int(rng.randint(0, 6)) for _ in range(n))
+    os_, vs = [], []
+    for _ in range(n):
+        os_.append(int(rng.randint(0, 8)))
+        vs.append(None if rng.rand() < 0.25 else int(rng.randint(-50, 50)))
+    rows = sorted(zip(ps, os_, vs), key=lambda r: (r[0], r[1]))
+    ps, os_, vs = (list(x) for x in zip(*rows))
+    schema = Schema([Field("p", DataType.int32()), Field("o", DataType.int32()),
+                     Field("v", DataType.int64())])
+    b = batch_from_pydict({"p": ps, "o": os_, "v": vs}, schema)
+    w = WindowExec(
+        MemoryScanExec([[b]], schema),
+        [WindowFunction("min", "rmin", col("v")), WindowFunction("max", "rmax", col("v"))],
+        [col("p")], [SortField(col("o"), True, True)],
+    )
+    d = batch_to_pydict(list(w.execute(0, TaskContext(0, 1)))[0])
+    # oracle: range frame includes peers (rows with equal (p, o))
+    for i in range(n):
+        frame = [vs[j] for j in range(n)
+                 if ps[j] == ps[i] and os_[j] <= os_[i] and vs[j] is not None]
+        exp_min = min(frame) if frame else None
+        exp_max = max(frame) if frame else None
+        assert d["rmin"][i] == exp_min, (i, d["rmin"][i], exp_min)
+        assert d["rmax"][i] == exp_max, (i, d["rmax"][i], exp_max)
