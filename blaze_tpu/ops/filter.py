@@ -9,7 +9,7 @@ host syncs only that one scalar to set ``num_rows``.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,11 +44,18 @@ def compact_columns(cols, keep):
 
 
 class FilterExec(ExecNode):
-    def __init__(self, child: ExecNode, predicate: Expr):
-        from ..exprs.compile import fold_literals
+    """Filter, optionally FUSED with a following projection (stage
+    fusion rewrites Project(Filter(x)) into one kernel: predicate mask,
+    projection over the raw batch, one compact of only the projected
+    columns — masked-out rows compute garbage that compaction drops)."""
+
+    def __init__(self, child: ExecNode, predicate: Expr,
+                 project: Optional[Tuple[List[Expr], List[str]]] = None):
+        from ..exprs.compile import fold_literals, infer_dtype
 
         super().__init__([child])
         self.predicate = fold_literals(predicate)
+        self.project = project
         in_schema = child.schema
         (self._device_pred,), self._host_parts = split_host_exprs([self.predicate])
         self._in_schema_aug = Schema(
@@ -58,19 +65,32 @@ class FilterExec(ExecNode):
         schema_aug = self._in_schema_aug
         pred = self._device_pred
         n_in_fields = len(in_schema.fields)
+        if project is not None:
+            proj_exprs, proj_names = project
+            self._schema = Schema(
+                [Field(n, infer_dtype(e, in_schema)) for e, n in zip(proj_exprs, proj_names)]
+            )
+        else:
+            proj_exprs = None
+            self._schema = in_schema
 
         def build():
             @jax.jit
             def kernel(cols: Tuple[Column, ...], num_rows):
                 n = cols[0].validity.shape[0]
                 env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
-                p = lower(pred, schema_aug, env, n)
+                memo: dict = {}
+                p = lower(pred, schema_aug, env, n, memo)
                 # the live mask is load-bearing: IsNull turns padding-row
                 # invalidity into data=True, so validity alone cannot be
                 # trusted to exclude padding
                 live = jnp.arange(n) < num_rows
                 keep = p.validity & p.data.astype(jnp.bool_) & live
-                return compact_columns(cols[:n_in_fields], keep)
+                if proj_exprs is not None:
+                    out = tuple(lower(e, schema_aug, env, n, memo) for e in proj_exprs)
+                else:
+                    out = cols[:n_in_fields]
+                return compact_columns(out, keep)
 
             return kernel
 
@@ -78,12 +98,14 @@ class FilterExec(ExecNode):
         from ..runtime.kernel_cache import cached_kernel, schema_key
 
         self._kernel = cached_kernel(
-            ("filter", schema_key(schema_aug), expr_key(pred)), build
+            ("filter", schema_key(schema_aug), expr_key(pred),
+             None if proj_exprs is None else tuple(expr_key(e) for e in proj_exprs)),
+            build,
         )
 
     @property
     def schema(self) -> Schema:
-        return self.children[0].schema
+        return self._schema
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)

@@ -76,6 +76,24 @@ def substitute(e: Expr, mapping: Dict[str, Expr]) -> Expr:
     return e  # literals, opaque nodes
 
 
+def projection_mapping(names, exprs) -> Dict[str, Expr]:
+    """name -> (Alias-stripped) expr for inlining a projection."""
+    return {n: (e.child if isinstance(e, Alias) else e) for n, e in zip(names, exprs)}
+
+
+def _apply_mapping(groupings, aggs, pre, mapping):
+    from .agg import AggFunction, GroupingExpr
+
+    groupings = [GroupingExpr(substitute(g.expr, mapping), g.name) for g in groupings]
+    aggs = [
+        AggFunction(a.fn, None if a.expr is None else substitute(a.expr, mapping), a.name)
+        for a in aggs
+    ]
+    if pre is not None:
+        pre = substitute(pre, mapping)
+    return groupings, aggs, pre
+
+
 def fuse_stages(plan):
     """Rewrite (in place below the root): PARTIAL AggExec over pure
     device Filter/Project chains absorbs them.  Returns the root."""
@@ -93,27 +111,20 @@ def fuse_stages(plan):
         changed = False
         while True:
             if isinstance(child, ProjectExec) and not child._host_parts:
-                mapping = {
-                    n: (e.child if isinstance(e, Alias) else e)
-                    for n, e in zip(child.names, child.exprs)
-                }
-                groupings = [
-                    GroupingExpr(substitute(g.expr, mapping), g.name) for g in groupings
-                ]
-                aggs = [
-                    AggFunction(
-                        a.fn,
-                        None if a.expr is None else substitute(a.expr, mapping),
-                        a.name,
-                    )
-                    for a in aggs
-                ]
-                if pre is not None:
-                    pre = substitute(pre, mapping)
+                mapping = projection_mapping(child.names, child.exprs)
+                groupings, aggs, pre = _apply_mapping(groupings, aggs, pre, mapping)
                 child = child.children[0]
                 changed = True
                 continue
             if isinstance(child, FilterExec) and not child._host_parts:
+                if child.project is not None:
+                    # a filter already fused with a projection: inline
+                    # the projection first (pre/groupings/aggs reference
+                    # its OUTPUT names), then AND the predicate (which
+                    # references the filter's INPUT schema)
+                    proj_exprs, proj_names = child.project
+                    mapping = projection_mapping(proj_names, proj_exprs)
+                    groupings, aggs, pre = _apply_mapping(groupings, aggs, pre, mapping)
                 pred = child.predicate
                 pre = pred if pre is None else BinOp("and", pred, pre)
                 child = child.children[0]
@@ -128,15 +139,47 @@ def fuse_stages(plan):
             pre_filter=pre,
         )
 
+    def try_fuse_fp(node):
+        """Project(Filter(x)) / Filter(Project(x)) -> one FilterExec
+        with a fused projection (single kernel, compacts only the
+        projected columns)."""
+        if (
+            isinstance(node, ProjectExec)
+            and not node._host_parts
+            and node._select_names is None
+            and isinstance(node.children[0], FilterExec)
+            and not node.children[0]._host_parts
+            and node.children[0].project is None
+        ):
+            f = node.children[0]
+            return FilterExec(f.children[0], f.predicate,
+                              project=(list(node.exprs), list(node.names)))
+        if (
+            isinstance(node, FilterExec)
+            and node.project is None
+            and not node._host_parts
+            and isinstance(node.children[0], ProjectExec)
+            and not node.children[0]._host_parts
+        ):
+            proj = node.children[0]
+            mapping = projection_mapping(proj.names, proj.exprs)
+            return FilterExec(
+                proj.children[0], substitute(node.predicate, mapping),
+                project=(list(proj.exprs), list(proj.names)),
+            )
+        return node
+
     def walk(node):
         for i, c in enumerate(list(node.children)):
             walk(c)
             if isinstance(c, AggExec):
                 node.children[i] = try_fuse(c)
+            else:
+                node.children[i] = try_fuse_fp(node.children[i])
 
     from .agg import AggExec
 
     walk(plan)
     if isinstance(plan, AggExec):
         return try_fuse(plan)
-    return plan
+    return try_fuse_fp(plan)

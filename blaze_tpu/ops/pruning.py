@@ -137,9 +137,21 @@ def prune_columns(plan, required: Optional[Set[str]] = None):
         )
 
     if isinstance(plan, FilterExec):
-        child_req = req | expr_columns(plan.predicate)
+        child_req = expr_columns(plan.predicate)
+        project = plan.project
+        if project is not None:
+            proj_exprs, proj_names = project
+            kept = [
+                (e, n) for e, n in zip(proj_exprs, proj_names)
+                if required is None or n in req
+            ] or list(zip(proj_exprs, proj_names))[:1]
+            project = ([e for e, _ in kept], [n for _, n in kept])
+            for e, _ in kept:
+                child_req |= expr_columns(e)
+        else:
+            child_req |= req
         child = prune_columns(plan.children[0], child_req)
-        return FilterExec(_narrow(child, child_req), plan.predicate)
+        return FilterExec(_narrow(child, child_req), plan.predicate, project)
 
     if isinstance(plan, AggExec):
         if plan.mode != AggMode.PARTIAL:

@@ -179,7 +179,15 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
         p = n.project
         return ProjectExec(plan_from_proto(p.input), [expr_from_proto(e) for e in p.exprs], list(p.names))
     if kind == "filter":
-        return FilterExec(plan_from_proto(n.filter.input), expr_from_proto(n.filter.predicate))
+        project = None
+        if n.filter.project_exprs:
+            project = (
+                [expr_from_proto(e) for e in n.filter.project_exprs],
+                list(n.filter.project_names),
+            )
+        return FilterExec(
+            plan_from_proto(n.filter.input), expr_from_proto(n.filter.predicate), project
+        )
     if kind == "agg":
         a = n.agg
         return AggExec(

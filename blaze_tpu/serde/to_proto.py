@@ -201,6 +201,11 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
     elif isinstance(node, FilterExec):
         out.filter.input.CopyFrom(plan_to_proto(node.children[0]))
         out.filter.predicate.CopyFrom(expr_to_proto(node.predicate))
+        if node.project is not None:
+            proj_exprs, proj_names = node.project
+            for e in proj_exprs:
+                out.filter.project_exprs.add().CopyFrom(expr_to_proto(e))
+            out.filter.project_names.extend(proj_names)
     elif isinstance(node, AggExec):
         out.agg.input.CopyFrom(plan_to_proto(node.children[0]))
         out.agg.mode = node.mode.value
