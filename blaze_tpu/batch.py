@@ -319,6 +319,15 @@ def column_to_pylist(col: Column, num_rows: int) -> List:
             else:
                 out.append({f.name: kids[fi][i] for fi, f in enumerate(dtype.struct_fields)})
         return out
+    if dtype.kind == TypeKind.BINARY:
+        # raw bytes — utf-8 decoding would corrupt binary payloads
+        out = []
+        for i in range(num_rows):
+            if not c.validity[i]:
+                out.append(None)
+            else:
+                out.append(bytes(np.asarray(c.data)[i, : int(c.lengths[i])]))
+        return out
     if dtype.is_string:
         return strings_to_list(c, num_rows)
     out = []

@@ -1,0 +1,100 @@
+"""Bloom-filter aggregation.
+
+≙ reference agg ``bloom_filter`` (agg/bloom_filter.rs, used by Spark
+3.5's InjectRuntimeFilter): a GLOBAL aggregation that builds a
+Spark-binary-compatible bloom filter over a long-typed child
+expression.  Partial builds one filter per partition (host-vectorized
+murmur inserts — the reference builds on CPU too), merge ORs the word
+arrays, Final emits the serialized payload that ``might_contain``
+(BloomFilterMightContainExpr) consumes on device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..batch import Column, RecordBatch, bucket_capacity
+from ..exprs.bloom import SparkBloomFilter, optimal_num_bits, optimal_num_hashes
+from ..exprs.compile import lower
+from ..exprs.ir import Expr
+from ..runtime.context import TaskContext
+from ..schema import DataType, Field, Schema, string_width_for
+from .agg import AggMode
+from .base import BatchStream, ExecNode
+
+
+class BloomFilterAggExec(ExecNode):
+    def __init__(
+        self,
+        child: ExecNode,
+        expr: Optional[Expr],
+        name: str,
+        mode: AggMode,
+        expected_items: int = 1_000_000,
+        num_bits: Optional[int] = None,
+    ):
+        super().__init__([child])
+        self.expr = expr
+        self.agg_name = name
+        self.mode = mode
+        self.expected_items = expected_items
+        self.num_bits = num_bits or optimal_num_bits(expected_items)
+        self.num_hashes = optimal_num_hashes(expected_items, self.num_bits)
+        payload = 12 + self.num_bits // 8  # spark stream header + words
+        self._schema = Schema(
+            [Field(name, DataType.binary(string_width_for(payload)))]
+        )
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions()
+
+    def _emit(self, filt: SparkBloomFilter) -> RecordBatch:
+        from ..batch import column_from_strings
+
+        payload = filt.serialize()
+        w = self._schema.fields[0].dtype.string_width
+        col = column_from_strings(
+            [payload], width=w, capacity=bucket_capacity(1),
+            dtype=self._schema.fields[0].dtype,
+        )
+        return RecordBatch(self._schema, [col], 1)
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child = self.children[0]
+        in_schema = child.schema
+
+        def stream():
+            filt = SparkBloomFilter(self.num_bits, self.num_hashes)
+            if self.mode == AggMode.PARTIAL:
+                for batch in child.execute(partition, ctx):
+                    if not ctx.is_task_running():
+                        return
+                    env = {f.name: c for f, c in zip(in_schema.fields, batch.columns)}
+                    with self.metrics.timer("elapsed_compute"):
+                        c = lower(self.expr, in_schema, env, batch.capacity)
+                        host = c.to_host()
+                        live = np.asarray(host.validity)[: batch.num_rows]
+                        vals = np.asarray(host.data)[: batch.num_rows][live]
+                        if vals.size:
+                            filt.put_longs(vals.astype(np.int64))
+            else:  # merge modes: OR the incoming serialized filters
+                state_col = in_schema.fields[0].name
+                for batch in child.execute(partition, ctx):
+                    b = batch.to_host()
+                    c = b.columns[b.schema.index(state_col)]
+                    for i in range(b.num_rows):
+                        ln = int(c.lengths[i])
+                        other = SparkBloomFilter.deserialize(bytes(c.data[i, :ln]))
+                        assert other.num_bits == filt.num_bits, "bloom size mismatch"
+                        filt.words |= other.words
+                        filt.num_hashes = other.num_hashes
+            self.metrics.add("output_rows", 1)
+            yield self._emit(filt)
+
+        return stream()

@@ -308,6 +308,15 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
             g.outer,
             g.keep_input,
         )
+    if kind == "bloom_filter_agg":
+        from ..ops.bloom_agg import BloomFilterAggExec
+
+        b = n.bloom_filter_agg
+        return BloomFilterAggExec(
+            plan_from_proto(b.input),
+            expr_from_proto(b.expr) if b.has_expr else None,
+            b.name, AggMode(b.mode), b.expected_items, b.num_bits or None,
+        )
     raise NotImplementedError(f"from_proto node {kind}")
 
 

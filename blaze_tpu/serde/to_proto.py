@@ -280,6 +280,15 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
         out.broadcast_join_build_hash_map.input.CopyFrom(plan_to_proto(node.children[0]))
         for e in node.keys:
             out.broadcast_join_build_hash_map.keys.add().CopyFrom(expr_to_proto(e))
+    elif type(node).__name__ == "BloomFilterAggExec":
+        out.bloom_filter_agg.input.CopyFrom(plan_to_proto(node.children[0]))
+        if node.expr is not None:
+            out.bloom_filter_agg.has_expr = True
+            out.bloom_filter_agg.expr.CopyFrom(expr_to_proto(node.expr))
+        out.bloom_filter_agg.name = node.agg_name
+        out.bloom_filter_agg.mode = node.mode.value
+        out.bloom_filter_agg.expected_items = node.expected_items
+        out.bloom_filter_agg.num_bits = node.num_bits
     elif isinstance(node, SortMergeJoinExec):
         out.sort_merge_join.left.CopyFrom(plan_to_proto(node.children[0]))
         out.sort_merge_join.right.CopyFrom(plan_to_proto(node.children[1]))

@@ -111,3 +111,64 @@ def test_python_udf_wrapper():
     )
     got2 = batch_to_pydict(list(plan2.execute(0, TaskContext(0, 1)))[0])
     assert got2["a"] == [2]
+
+
+def test_bloom_filter_agg_two_stage():
+    """bloom_filter agg (≙ agg/bloom_filter.rs): partial per partition,
+    OR-merge, final payload probed by might_contain on device."""
+    import numpy as np
+
+    from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+    from blaze_tpu.exprs import col, lit
+    from blaze_tpu.exprs.ir import Lit, ScalarFunc
+    from blaze_tpu.ops import MemoryScanExec, ProjectExec
+    from blaze_tpu.ops.agg import AggMode
+    from blaze_tpu.ops.bloom_agg import BloomFilterAggExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.schema import DataType, Field, Schema
+    from blaze_tpu.serde.from_proto import plan_from_proto
+    from blaze_tpu.serde.to_proto import plan_to_proto
+
+    schema = Schema([Field("k", DataType.int64())])
+    members = list(range(0, 2000, 2))
+    parts = [
+        [batch_from_pydict({"k": members[:500]}, schema)],
+        [batch_from_pydict({"k": members[500:]}, schema)],
+    ]
+    scan = MemoryScanExec(parts, schema)
+    partial = BloomFilterAggExec(scan, col("k"), "bf", AggMode.PARTIAL,
+                                 expected_items=2000)
+    # collect partial states from both partitions into one input
+    states = []
+    for p in range(2):
+        states.extend(partial.execute(p, TaskContext(p, 2)))
+    merged_in = MemoryScanExec([states], partial.schema)
+    final = BloomFilterAggExec(merged_in, None, "bf", AggMode.FINAL,
+                               expected_items=2000)
+    out = list(final.execute(0, TaskContext(0, 1)))[0]
+    from blaze_tpu.batch import column_to_pylist
+
+    payload = column_to_pylist(out.columns[0], 1)[0]
+    assert isinstance(payload, bytes)
+
+    # probe: every member true; non-members mostly false (fpp ~3%)
+    probe_schema = Schema([Field("x", DataType.int64())])
+    xs = members + list(range(1, 4001, 2))  # odds are non-members
+    pb = batch_from_pydict({"x": xs}, probe_schema)
+    proj = ProjectExec(
+        MemoryScanExec([[pb]], probe_schema),
+        [ScalarFunc("might_contain", [Lit(payload), col("x")]).alias("hit")],
+    )
+    d = batch_to_pydict(list(proj.execute(0, TaskContext(0, 1)))[0])
+    hits = d["hit"]
+    assert all(hits[: len(members)]), "false negative in bloom filter"
+    fp = sum(1 for h in hits[len(members):] if h) / 2000
+    assert fp < 0.1, f"false-positive rate too high: {fp}"
+
+    # proto roundtrip of the partial node
+    rt = plan_from_proto(plan_to_proto(
+        BloomFilterAggExec(MemoryScanExec(parts, schema), col("k"), "bf",
+                           AggMode.PARTIAL, expected_items=2000)
+    ))
+    s2 = list(rt.execute(0, TaskContext(0, 2)))
+    assert s2 and s2[0].num_rows == 1
