@@ -369,7 +369,13 @@ class AggExec(ExecNode):
         super().__init__([child])
         self.mode = mode
         self.groupings = list(groupings)
-        self.aggs = list(aggs)
+        # brickhouse names are aliases (≙ agg/mod.rs:84-97 create_agg
+        # mapping BrickhouseCollect/BrickhouseCombineUnique)
+        _ALIAS = {"count0": "count_star", "brickhouse_collect": "collect_list",
+                  "brickhouse_combine_unique": "collect_set"}
+        self.aggs = [
+            AggFunction(_ALIAS.get(a.fn, a.fn), a.expr, a.name) for a in aggs
+        ]
         # fused pre-aggregation predicate (stage fusion: a FilterExec
         # collapsed into this kernel; rows failing it never aggregate)
         self.pre_filter = pre_filter
