@@ -15,6 +15,8 @@ from blaze_tpu.runtime.context import TaskContext
 from blaze_tpu.tpch import TPCH_SCHEMAS, build_query
 from blaze_tpu.tpch.datagen import generate_all, table_to_batches
 
+pytestmark = pytest.mark.slow
+
 SCALE = 0.002
 N_PARTS = 2
 

@@ -12,6 +12,8 @@ from blaze_tpu.tpcds import TPCDS_SCHEMAS, build_query, generate_all
 from blaze_tpu.tpcds import oracle as O
 from blaze_tpu.tpch.datagen import table_to_batches
 
+pytestmark = pytest.mark.slow
+
 SCALE = 0.002
 N_PARTS = 2
 

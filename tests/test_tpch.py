@@ -14,6 +14,8 @@ from blaze_tpu.tpch import TPCH_SCHEMAS, build_query
 from blaze_tpu.tpch.datagen import generate_all, table_to_batches
 from blaze_tpu.tpch import oracle as O
 
+pytestmark = pytest.mark.slow
+
 SCALE = 0.002
 N_PARTS = 2
 
