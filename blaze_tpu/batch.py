@@ -471,6 +471,15 @@ def batch_to_pydict(batch: RecordBatch) -> Dict[str, List]:
     return out
 
 
+def _child_types(dtype: DataType) -> List[DataType]:
+    """Nested child column types in children-tuple order."""
+    if dtype.kind == TypeKind.ARRAY:
+        return [dtype.elem]
+    if dtype.kind == TypeKind.MAP:
+        return [dtype.key, dtype.value]
+    return [f.dtype for f in dtype.struct_fields]
+
+
 def _concat_host_cols(
     dtype: DataType, parts: List[Column], ns: List[int], cap: int
 ) -> Column:
@@ -487,15 +496,9 @@ def _concat_host_cols(
             np.concatenate([np.asarray(c.lengths)[:n] for c, n in zip(parts, ns)]), cap
         )
     if dtype.is_nested:
-        if dtype.kind == TypeKind.ARRAY:
-            kid_types = [dtype.elem]
-        elif dtype.kind == TypeKind.MAP:
-            kid_types = [dtype.key, dtype.value]
-        else:
-            kid_types = [f.dtype for f in dtype.struct_fields]
         children = tuple(
             _concat_host_cols(kt, [c.children[ki] for c in parts], ns, cap)
-            for ki, kt in enumerate(kid_types)
+            for ki, kt in enumerate(_child_types(dtype))
         )
         return Column(dtype, None, validity, lengths, children)
     if dtype.is_string:
@@ -517,15 +520,99 @@ def _concat_host_cols(
     return Column(dtype, data, validity, lengths)
 
 
+def _col_on_device(c: Column) -> bool:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(c)
+    return all(isinstance(a, jax.Array) for a in leaves)
+
+
+def _concat_device_cols(
+    dtype: DataType, parts: List[Column], ns: List[int], cap: int
+) -> Column:
+    """Device-side concatenation along the row axis, padded to ``cap``.
+
+    Stays fully async (no host sync): over a remote/tunneled chip each
+    host roundtrip costs a full RTT, so merge cascades (agg state
+    re-reduce, coalesce) must never leave HBM."""
+
+    def cat(arrs, pad_width=None):
+        sliced = []
+        for a, n in zip(arrs, ns):
+            s = a[:n]
+            if pad_width is not None and s.shape[-1] < pad_width:
+                padding = [(0, 0)] * (s.ndim - 1) + [(0, pad_width - s.shape[-1])]
+                s = jnp.pad(s, padding)
+            sliced.append(s)
+        out = jnp.concatenate(sliced, axis=0)
+        total = out.shape[0]
+        if total < cap:
+            padding = [(0, cap - total)] + [(0, 0)] * (out.ndim - 1)
+            out = jnp.pad(out, padding)
+        return out
+
+    validity = cat([c.validity for c in parts])
+    lengths = None if parts[0].lengths is None else cat([c.lengths for c in parts])
+    if dtype.is_nested:
+        children = tuple(
+            _concat_device_cols(kt, [c.children[ki] for c in parts], ns, cap)
+            for ki, kt in enumerate(_child_types(dtype))
+        )
+        return Column(dtype, None, validity, lengths, children)
+    if dtype.is_string:
+        width = max(c.data.shape[-1] for c in parts)
+        return Column(dtype, cat([c.data for c in parts], pad_width=width), validity, lengths)
+    return Column(dtype, cat([c.data for c in parts]), validity, lengths)
+
+
+def _mask_dead_rows(c: Column, live) -> Column:
+    """Enforce the padding invariant on rows where ``live`` is False:
+    validity False, lengths zero — fully recursive (every nested
+    child's buffers lead with the row axis, so ``live`` broadcasts
+    across the trailing element axes).  Mirrors
+    ops/filter.compact_columns' treatment at the top level."""
+
+    def live_as(arr):
+        """``live`` broadcast over ``arr``'s trailing element axes."""
+        return live.reshape(live.shape + (1,) * (arr.ndim - 1))
+
+    return Column(
+        c.dtype,
+        c.data,
+        c.validity & live_as(c.validity),
+        None if c.lengths is None else jnp.where(live_as(c.lengths), c.lengths, 0),
+        None
+        if c.children is None
+        else tuple(_mask_dead_rows(k, live) for k in c.children),
+    )
+
+
+def slice_rows_device(batch: RecordBatch, lo: int, n: int) -> RecordBatch:
+    """Device-side row-range slice ``[lo, lo+n)`` re-padded to its own
+    bucket capacity (async — no host transfer).  Used by the in-process
+    exchange to split a pid-sorted batch into per-partition batches."""
+    cap = bucket_capacity(max(n, 1))
+    in_cap = batch.capacity
+    idx = jnp.minimum(jnp.arange(cap, dtype=jnp.int32) + lo, in_cap - 1)
+    live = jnp.arange(cap) < n
+    cols = [_mask_dead_rows(c.take(idx), live) for c in batch.columns]
+    return RecordBatch(batch.schema, cols, n)
+
+
 def concat_batches(batches: Sequence[RecordBatch]) -> RecordBatch:
-    """Host-side concatenation (coalesce path)."""
+    """Concatenation (coalesce path): device-side when every input
+    buffer is already a device array (no sync), host-side otherwise."""
     assert batches
     schema = batches[0].schema
     n = sum(b.num_rows for b in batches)
     cap = bucket_capacity(n)
     ns = [b.num_rows for b in batches]
+    on_device = all(_col_on_device(c) for b in batches for c in b.columns)
     cols: List[Column] = []
     for ci, f in enumerate(schema.fields):
-        parts = [b.columns[ci].to_host() for b in batches]
-        cols.append(_concat_host_cols(f.dtype, parts, ns, cap).to_device())
+        if on_device:
+            cols.append(_concat_device_cols(f.dtype, [b.columns[ci] for b in batches], ns, cap))
+        else:
+            parts = [b.columns[ci].to_host() for b in batches]
+            cols.append(_concat_host_cols(f.dtype, parts, ns, cap).to_device())
     return RecordBatch(schema, cols, n)

@@ -80,6 +80,12 @@ PIPELINE_DEPTH = ConfEntry("spark.blaze.pipeline.depth", 2, int)
 
 # TPU-specific knobs (no reference equivalent).
 ON_DEVICE = ConfEntry("spark.blaze.tpu.onDevice", True, _bool)
+# In-process exchanges keep partition buffers device-resident (HBM)
+# instead of round-tripping IPC files through the host — over a
+# remote/tunneled chip every host sync costs a full RTT.  The file
+# shuffle remains the cross-process / spill path (turn this off to
+# force it, e.g. when a stage's output exceeds HBM).
+EXCHANGE_IN_PROCESS = ConfEntry("spark.blaze.exchange.inProcess", True, _bool)
 DEVICE_MEMORY_BUDGET = ConfEntry("spark.blaze.tpu.hbmBudget", 8 << 30, int)
 HOST_SPILL_BUDGET = ConfEntry("spark.blaze.tpu.hostSpillBudget", 4 << 30, int)
 MIN_CAPACITY = ConfEntry("spark.blaze.tpu.minBatchCapacity", 1024, int)

@@ -56,10 +56,24 @@ def test_frame_roundtrip():
     assert decompress_frame(compress_frame(raw)) == raw
 
 
-def test_shuffle_exchange_end_to_end():
+@pytest.mark.parametrize("in_process", [True, False])
+def test_shuffle_exchange_end_to_end(in_process):
+    """Both exchange data planes: the device-resident in-process fast
+    path and the .data/.index file shuffle (the cross-process tier)."""
+    from blaze_tpu import conf
+
     n_parts_in, n_parts_out = 3, 4
     batches = [[make_batch(50, seed=i)] for i in range(n_parts_in)]
     src = MemoryScanExec(batches, SCHEMA)
+    old = conf.EXCHANGE_IN_PROCESS.get()
+    conf.EXCHANGE_IN_PROCESS.set(in_process)
+    try:
+        _run_exchange_end_to_end(batches, src, n_parts_out)
+    finally:
+        conf.EXCHANGE_IN_PROCESS.set(old)
+
+
+def _run_exchange_end_to_end(batches, src, n_parts_out):
     ex = NativeShuffleExchangeExec(src, HashPartitioning([col("k")], n_parts_out))
 
     all_rows = []
