@@ -1,0 +1,163 @@
+"""Standalone stage scheduler: split a multi-stage plan at its
+exchanges and run it as TaskDefinition-per-task stages.
+
+≙ the Spark-side plumbing the reference delegates to Spark itself:
+stage splitting at ``ShuffleExchange`` boundaries (DAGScheduler), map
+tasks running ``ShuffleWriterExec`` plans with per-task output files
+(``BlazeShuffleWriterBase.nativeShuffleWrite:52-110`` — clone proto,
+set ``.data``/``.index`` paths, execute, commit), and reduce tasks
+whose plans read ``IpcReaderExec`` blocks registered in the resources
+map (``BlazeBlockStoreShuffleReaderBase.readIpc:47``,
+``NativeShuffleExchangeBase.doExecuteNative:100-156``).
+
+Every task crosses the protobuf boundary: the scheduler serializes one
+``TaskDefinition`` per task and drives them through
+``serde.from_proto.run_task`` — the same bytes a multi-host deployment
+would ship to gateway workers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..ops import ExecNode
+from ..parallel.exchange import NativeShuffleExchangeExec
+from ..parallel.shuffle import IpcReaderExec, LocalShuffleManager, ShuffleWriterExec
+from .context import RESOURCES, TaskContext
+
+
+@dataclass
+class Stage:
+    """One stage = one plan template + task count.  Map stages write a
+    shuffle; the result stage yields batches to the caller."""
+
+    stage_id: int
+    kind: str                      # "map" | "result"
+    plan: ExecNode                 # stage-local plan (no exchanges)
+    n_tasks: int
+    shuffle_id: Optional[int] = None   # map stages
+    n_out: int = 1                     # map stages: reduce partition count
+    depends_on: List[int] = field(default_factory=list)
+
+
+class _StageRoot(ExecNode):
+    """Mutable wrapper so the root exchange (if any) can be swapped."""
+
+    def __init__(self, child: ExecNode):
+        super().__init__([child])
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+def split_stages(
+    root: ExecNode, manager: Optional[LocalShuffleManager] = None
+) -> Tuple[List[Stage], LocalShuffleManager]:
+    """Replace every NativeShuffleExchangeExec with an IpcReaderExec and
+    emit a map Stage for its child.  Returns stages in dependency order
+    (result stage last)."""
+    manager = manager or LocalShuffleManager()
+    stages: List[Stage] = []
+    wrapper = _StageRoot(root)
+
+    def walk(node: ExecNode) -> List[int]:
+        deps: List[int] = []
+        for i, c in enumerate(list(node.children)):
+            if isinstance(c, NativeShuffleExchangeExec):
+                child_deps = walk(c.children[0])
+                sid = c.shuffle_id
+                st = Stage(
+                    stage_id=len(stages),
+                    kind="map",
+                    plan=c.children[0],
+                    n_tasks=c.children[0].num_partitions(),
+                    shuffle_id=sid,
+                    n_out=c.partitioning.num_partitions,
+                    depends_on=child_deps,
+                )
+                stages.append(st)
+                node.children[i] = IpcReaderExec(
+                    c.schema, f"shuffle_{sid}", c.partitioning.num_partitions
+                )
+                # keep the partitioning object reachable for the map
+                # task builder
+                st._partitioning = c.partitioning  # type: ignore[attr-defined]
+                deps.append(st.stage_id)
+            else:
+                deps.extend(walk(c))
+        return deps
+
+    result_deps = walk(wrapper)
+    stages.append(
+        Stage(
+            stage_id=len(stages),
+            kind="result",
+            plan=wrapper.children[0],
+            n_tasks=wrapper.children[0].num_partitions(),
+            depends_on=result_deps,
+        )
+    )
+    return stages, manager
+
+
+def stage_task_definitions(
+    stage: Stage, manager: LocalShuffleManager
+) -> List[bytes]:
+    """One TaskDefinition per task.  Map-stage tasks wrap the plan in a
+    ShuffleWriterExec with this task's output paths (≙ the per-task
+    proto clone in BlazeShuffleWriterBase:66-75)."""
+    from ..serde.to_proto import task_definition
+
+    out = []
+    for t in range(stage.n_tasks):
+        if stage.kind == "map":
+            data, index = manager.map_output_paths(stage.shuffle_id, t)
+            plan = ShuffleWriterExec(
+                stage.plan, stage._partitioning, data, index  # type: ignore[attr-defined]
+            )
+        else:
+            plan = stage.plan
+        out.append(task_definition(plan, f"task_{stage.stage_id}_{t}", stage.stage_id, t))
+    return out
+
+
+def run_stages(stages: List[Stage], manager: LocalShuffleManager):
+    """Execute all stages in order over the serde boundary; yields the
+    result stage's batches.  Before each stage that reads a shuffle,
+    register its reduce blocks in the resources map (the
+    shuffle-reader half: readIpc -> resourcesMap.put)."""
+    from ..serde.from_proto import run_task
+
+    n_maps: Dict[int, int] = {}
+
+    def register(node: ExecNode, seen: set):
+        """Register reduce blocks for every shuffle IpcReader in the
+        stage plan (each consumed once by its reading task)."""
+        for c in node.children:
+            register(c, seen)
+        if (
+            isinstance(node, IpcReaderExec)
+            and node.resource_id.startswith("shuffle_")
+            and id(node) not in seen
+        ):
+            seen.add(id(node))
+            sid = int(node.resource_id.split("_")[1])
+            for p in range(node.num_partitions()):
+                RESOURCES.put(
+                    f"{node.resource_id}.{p}",
+                    manager.reduce_blocks(sid, n_maps[sid], p),
+                )
+
+    for stage in stages:
+        register(stage.plan, set())
+        defs = stage_task_definitions(stage, manager)
+        if stage.kind == "map":
+            for td in defs:
+                for _ in run_task(td):
+                    pass
+            n_maps[stage.shuffle_id] = stage.n_tasks
+        else:
+            for td in defs:
+                yield from run_task(td)

@@ -22,6 +22,14 @@ from ..schema import DataType, Field, Schema, TypeKind
 from . import plan_pb2 as pb
 
 
+import itertools
+
+# itertools.count.__next__ is atomic under the GIL, so concurrent
+# serializations (exchange map threads, parallel task-def building)
+# never mint the same resource id
+_memscan_rids = itertools.count()
+
+
 def dtype_to_proto(t: DataType) -> pb.DataTypeProto:
     out = pb.DataTypeProto(
         kind=t.kind.value, precision=t.precision, scale=t.scale,
@@ -180,8 +188,12 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
     out = pb.PhysicalPlanNode()
     if isinstance(node, MemoryScanExec):
         # stage partitions under a resources-map id so the decoded plan
-        # finds them (≙ FFIReader export)
-        rid = f"memscan_{id(node)}"
+        # finds them (≙ FFIReader export).  The id must be unique PER
+        # SERIALIZATION: resources pop on read, and one plan node is
+        # serialized once per task (N tasks = N gets).  A serialized
+        # plan that is never executed strands its entry until process
+        # exit — callers (scheduler) serialize exactly what they run.
+        rid = f"memscan_{id(node)}_{next(_memscan_rids)}"
         RESOURCES.put(rid, node._partitions)
         out.memory_scan.resource_id = rid
         out.memory_scan.schema.CopyFrom(schema_to_proto(node.schema))

@@ -1,0 +1,33 @@
+"""Spark-side plan interception — the TPU analogue of the reference's
+JVM extension layer (SURVEY §1 L6-L4).
+
+The reference hooks Spark via ``BlazeSparkSessionExtension``
+(``BlazeSparkSessionExtension.scala:29-95``), tags + trial-converts the
+physical plan (``BlazeConvertStrategy.scala:46-250``), rewrites each
+supported operator (``BlazeConverters.scala:126-850``) and serializes
+expressions to protobuf (``NativeConverters.scala:305-1119``).
+
+This package is the same contract over a process boundary instead of a
+JNI boundary: Spark serializes its executed physical plan with the
+stock catalyst ``TreeNode.toJSON`` (no Blaze jar needed on the Spark
+side), and this package parses that JSON, applies the convert strategy
+(per-op enable flags, bottom-up trial conversion, inefficient-convert
+removal), converts the supported subtrees into the engine's ExecNode
+operators, and executes them on TPU.  Unconvertible subtrees fall back
+to a host-side executor callback (the ``ConvertToNative`` /
+``resourcesMap`` rendezvous pattern, ``BlazeConverters.scala:850``).
+"""
+
+from .plan_json import SparkNode, parse_plan_json
+from .expr_converter import convert_expr, convert_data_type, UnsupportedSparkExpr
+from .converters import ConversionContext, convert_exec, UnsupportedSparkExec
+from .strategy import ConvertTag, apply_strategy, convert_spark_plan
+from .session import BlazeSparkSession
+
+__all__ = [
+    "SparkNode", "parse_plan_json",
+    "convert_expr", "convert_data_type", "UnsupportedSparkExpr",
+    "ConversionContext", "convert_exec", "UnsupportedSparkExec",
+    "ConvertTag", "apply_strategy", "convert_spark_plan",
+    "BlazeSparkSession",
+]

@@ -1,0 +1,519 @@
+"""Per-operator Spark physical plan -> ExecNode conversion.
+
+≙ reference ``BlazeConverters.scala:126-850`` (``convertSparkPlan`` +
+one ``convertXxxExec`` per operator, each gated by its
+``spark.blaze.enable.<op>`` flag) and the proto-building plan bases in
+``spark-extension/.../blaze/plan/*.scala``.
+
+Naming discipline: every intermediate column is ``#<exprId>`` (the
+reference binds attributes by exprId the same way); the session layer
+renames the root back to user-facing names.  Scans resolve through the
+:class:`ConversionContext` catalog — the analogue of the JVM reading
+``HadoopFsRelation`` file listings at plan time, which catalyst's
+``toJSON`` cannot carry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .. import conf
+from ..exprs.ir import Alias, Col, Expr
+from ..ops import (
+    AggExec, AggFunction, AggMode, ExecNode, ExpandExec, FilterExec,
+    GenerateExec, GroupingExpr, LimitExec, MemoryScanExec, ProjectExec,
+    RenameColumnsExec, SortExec, SortField, UnionExec, WindowExec,
+    WindowFunction,
+)
+from ..ops.generate import NativeGenerator
+from ..ops.joins import BroadcastJoinExec, HashJoinExec, JoinType, SortMergeJoinExec
+from ..parallel import (
+    BroadcastExchangeExec, HashPartitioning, NativeShuffleExchangeExec,
+    RoundRobinPartitioning, SinglePartitioning,
+)
+from ..schema import Schema
+from .expr_converter import UnsupportedSparkExpr, convert_expr
+from .plan_json import SparkNode, expr_id
+
+
+class UnsupportedSparkExec(Exception):
+    """Raised when a plan node cannot be converted; the strategy layer
+    catches it and falls back for the subtree (≙ the reference's
+    ``NeverConvert`` tagging + ``convertToNative`` wrapping)."""
+
+
+class ConversionContext:
+    """State threaded through conversion.
+
+    - ``catalog``: table name -> ExecNode producing the table (built by
+      the session from parquet/orc paths or staged memory batches)
+    - ``default_parallelism``: partition count for exchanges whose
+      JSON lacks one
+    - ``host_fallback``: optional callback ``(SparkNode) -> ExecNode``
+      executing an unconvertible subtree host-side (the ConvertToNative
+      seam; tests stub it the way testenv stubs the JVM)
+    """
+
+    def __init__(
+        self,
+        catalog: Optional[Dict[str, ExecNode]] = None,
+        default_parallelism: int = 4,
+        host_fallback: Optional[Callable[[SparkNode], ExecNode]] = None,
+    ):
+        self.catalog = catalog or {}
+        self.default_parallelism = default_parallelism
+        self.host_fallback = host_fallback
+
+    def convert(self, node: SparkNode) -> ExecNode:
+        """Child-conversion hook.  The plain context recurses directly;
+        the strategy layer overrides this to consult its tags and
+        insert fallback boundaries (≙ convertSparkPlan's per-child
+        dispatch in BlazeConverters.scala:149)."""
+        return convert_exec(node, self)
+
+
+# ----------------------------------------------------------------- helpers
+
+def _named_expr(n: SparkNode) -> Tuple[Expr, str]:
+    """NamedExpression -> (expr, #id name)."""
+    if n.name == "AttributeReference":
+        eid = expr_id(n.fields.get("exprId"))
+        name = f"#{eid}" if eid is not None else n.fields.get("name", "?")
+        return Col(name), name
+    if n.name == "Alias":
+        eid = expr_id(n.fields.get("exprId"))
+        name = f"#{eid}" if eid is not None else n.fields.get("name", "?")
+        return convert_expr(n.children[0]), name
+    e = convert_expr(n)
+    return e, f"_c{id(n) & 0xffff}"
+
+
+def _attr_user_name(n: SparkNode) -> str:
+    return str(n.fields.get("name", "?"))
+
+
+_PASS_THROUGH = {
+    "WholeStageCodegenExec", "InputAdapter", "AdaptiveSparkPlanExec",
+    "ShuffleQueryStageExec", "BroadcastQueryStageExec", "ReusedExchangeExec",
+    "ResultQueryStageExec",
+}
+
+
+def output_attrs(node: SparkNode) -> List[Tuple[str, str]]:
+    """Best-effort [(#id, user name)] for a plan node's output — used
+    for the root rename back to user-facing names."""
+    while node.name in _PASS_THROUGH and node.children:
+        node = node.child(0)
+    key = {
+        "ProjectExec": "projectList",
+        "HashAggregateExec": "resultExpressions",
+        "SortAggregateExec": "resultExpressions",
+        "ObjectHashAggregateExec": "resultExpressions",
+        "TakeOrderedAndProjectExec": "projectList",
+        "FileSourceScanExec": "output",
+    }.get(node.name)
+    attrs = node.expr_list(key) if key else []
+    out = []
+    for a in attrs:
+        eid = expr_id(a.fields.get("exprId"))
+        out.append((f"#{eid}" if eid is not None else a.fields.get("name", "?"),
+                    _attr_user_name(a)))
+    return out
+
+
+_JOIN_TYPES = {
+    "Inner": JoinType.INNER,
+    "LeftOuter": JoinType.LEFT,
+    "RightOuter": JoinType.RIGHT,
+    "FullOuter": JoinType.FULL,
+    "LeftSemi": JoinType.LEFT_SEMI,
+    "LeftAnti": JoinType.LEFT_ANTI,
+    "Cross": JoinType.INNER,
+}
+
+
+def _join_type(node: SparkNode) -> JoinType:
+    v = node.fields.get("joinType")
+    s = v if isinstance(v, str) else node.string("joinType")
+    if s in _JOIN_TYPES:
+        return _JOIN_TYPES[s]
+    if s.startswith("ExistenceJoin"):
+        return JoinType.EXISTENCE
+    raise UnsupportedSparkExec(f"join type {s!r}")
+
+
+def _sort_fields(orders: Sequence[SparkNode]) -> List[SortField]:
+    out = []
+    for o in orders:
+        if o.name != "SortOrder":
+            raise UnsupportedSparkExec(f"expected SortOrder, got {o.name}")
+        asc = o.string("direction", "Ascending") == "Ascending"
+        nulls_first = o.string("nullOrdering", "") == "NullsFirst" or (
+            "nullOrdering" not in o.fields and asc  # Spark default: nulls first iff asc
+        )
+        out.append(SortField(convert_expr(o.children[0]), asc, nulls_first))
+    return out
+
+
+_AGG_FNS = {
+    "Sum": "sum", "Average": "avg", "Min": "min", "Max": "max",
+    "First": "first", "CollectList": "collect_list",
+    "CollectSet": "collect_set",
+}
+
+
+def _agg_function(agg_expr: SparkNode) -> AggFunction:
+    """AggregateExpression -> engine AggFunction named #<resultId>
+    (resultIds are stable across the partial/final split, which keeps
+    the state-column names aligned between the two stages)."""
+    fn_node = agg_expr.children[0]
+    rid = expr_id(agg_expr.fields.get("resultId"))
+    name = f"#{rid}" if rid is not None else f"agg_{fn_node.name.lower()}"
+    cls = fn_node.name
+    if cls == "Count":
+        kids = fn_node.children
+        if not kids or (len(kids) == 1 and kids[0].name == "Literal"):
+            return AggFunction("count_star", None, name)
+        return AggFunction("count", convert_expr(kids[0]), name)
+    if cls == "First":
+        ignore = fn_node.fields.get("ignoreNulls")
+        if ignore is None and len(fn_node.children) > 1:
+            lit = fn_node.children[1]
+            ignore = str(lit.fields.get("value", "false")).lower() == "true"
+        fn = "first_ignores_null" if ignore else "first"
+        return AggFunction(fn, convert_expr(fn_node.children[0]), name)
+    if cls in _AGG_FNS:
+        return AggFunction(_AGG_FNS[cls], convert_expr(fn_node.children[0]), name)
+    raise UnsupportedSparkExec(f"aggregate function {cls}")
+
+
+def _agg_mode(agg_exprs: Sequence[SparkNode]) -> AggMode:
+    modes = {a.string("mode", "Partial") for a in agg_exprs}
+    if modes <= {"Partial"}:
+        return AggMode.PARTIAL
+    if modes <= {"PartialMerge"}:
+        return AggMode.PARTIAL_MERGE
+    if modes <= {"Final", "Complete"}:
+        # Complete-mode aggs see raw input like Partial but emit final
+        # values; the engine runs them as PARTIAL+FINAL fused, which a
+        # single-exchange plan satisfies
+        return AggMode.FINAL if "Final" in modes else AggMode.PARTIAL
+    raise UnsupportedSparkExec(f"mixed aggregate modes {modes}")
+
+
+# --------------------------------------------------------------- converters
+
+def convert_exec(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    """Recursive conversion; raises UnsupportedSparkExec/-Expr upward
+    so the strategy can tag the subtree NeverConvert."""
+    name = node.name
+    # pass-through wrappers (codegen/AQE adapters have no native analogue)
+    if name in (
+        "WholeStageCodegenExec", "InputAdapter", "AdaptiveSparkPlanExec",
+        "ShuffleQueryStageExec", "BroadcastQueryStageExec", "ReusedExchangeExec",
+        "CollectLimitExec",  # limit handled via child below when possible
+        "ResultQueryStageExec",
+    ):
+        if name == "CollectLimitExec":
+            child = ctx.convert(node.child(0))
+            limit = int(node.fields.get("limit", 0) or 0)
+            single = NativeShuffleExchangeExec(child, SinglePartitioning())
+            return LimitExec(single, limit) if limit > 0 else single
+        return ctx.convert(node.child(0))
+
+    op_flag = {
+        "FileSourceScanExec": "scan", "ProjectExec": "project",
+        "FilterExec": "filter", "SortExec": "sort",
+        "HashAggregateExec": "aggr", "SortAggregateExec": "aggr",
+        "ObjectHashAggregateExec": "aggr",
+        "ShuffleExchangeExec": "shuffle", "BroadcastExchangeExec": "broadcast",
+        "BroadcastHashJoinExec": "bhj", "ShuffledHashJoinExec": "shj",
+        "SortMergeJoinExec": "smj", "WindowExec": "window",
+        "GenerateExec": "generate", "ExpandExec": "expand",
+        "UnionExec": "union", "GlobalLimitExec": "limit",
+        "LocalLimitExec": "limit", "TakeOrderedAndProjectExec": "takeOrdered",
+    }.get(name)
+    if op_flag is not None and not conf.op_enabled(op_flag):
+        raise UnsupportedSparkExec(f"{name} disabled by spark.blaze.enable.{op_flag}")
+
+    fn = _CONVERTERS.get(name)
+    if fn is None:
+        raise UnsupportedSparkExec(f"no converter for {name}")
+    return fn(node, ctx)
+
+
+def _convert_scan(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    """FileSourceScanExec: resolve the relation through the catalog
+    (≙ NativeParquetScanBase building FileGroups from the relation),
+    project/rename to the scan's output attributes."""
+    ident = node.fields.get("tableIdentifier")
+    table = None
+    if isinstance(ident, dict):
+        table = ident.get("table")
+    elif isinstance(ident, str) and ident:
+        table = ident.split(".")[-1]
+    if table is None or table not in ctx.catalog:
+        raise UnsupportedSparkExec(f"scan relation {ident!r} not in catalog")
+    scan = ctx.catalog[table]
+    attrs = node.expr_list("output")
+    exprs, names = [], []
+    for a in attrs:
+        user = _attr_user_name(a)
+        eid = expr_id(a.fields.get("exprId"))
+        if user not in scan.schema.names:
+            raise UnsupportedSparkExec(f"column {user!r} not in table {table!r}")
+        exprs.append(Col(user))
+        names.append(f"#{eid}" if eid is not None else user)
+    return ProjectExec(scan, exprs, names)
+
+
+def _convert_project(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    exprs, names = [], []
+    for p in node.expr_list("projectList"):
+        e, n = _named_expr(p)
+        exprs.append(e)
+        names.append(n)
+    return ProjectExec(child, exprs, names)
+
+
+def _convert_filter(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    cond = node.expr("condition")
+    if cond is None:
+        raise UnsupportedSparkExec("FilterExec without condition")
+    return FilterExec(child, convert_expr(cond))
+
+
+def _convert_agg(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    agg_exprs = node.expr_list("aggregateExpressions")
+    mode = _agg_mode(agg_exprs)
+    groupings = []
+    for g in node.expr_list("groupingExpressions"):
+        e, n = _named_expr(g)
+        groupings.append(GroupingExpr(e, n))
+    aggs = [_agg_function(a) for a in agg_exprs]
+    out: ExecNode = AggExec(
+        child, mode, groupings, aggs,
+        initial_input_buffer_offset=int(node.fields.get("initialInputBufferOffset", 0) or 0),
+        supports_partial_skipping=(mode == AggMode.PARTIAL),
+    )
+    if mode in (AggMode.FINAL,):
+        res = node.expr_list("resultExpressions")
+        if res:
+            exprs, names = [], []
+            for p in res:
+                e, n = _named_expr(p)
+                exprs.append(e)
+                names.append(n)
+            out = ProjectExec(out, exprs, names)
+    return out
+
+
+def _convert_sort(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    fields = _sort_fields(node.expr_list("sortOrder"))
+    return SortExec(child, fields)
+
+
+def _partitioning(node: SparkNode, ctx: ConversionContext):
+    v = node.fields.get("outputPartitioning")
+    if v is None:
+        return SinglePartitioning()
+    if isinstance(v, list):  # HashPartitioning is an Expression tree
+        p = node.expr("outputPartitioning")
+        if p.name == "HashPartitioning":
+            n_out = int(p.fields.get("numPartitions", ctx.default_parallelism))
+            return HashPartitioning([convert_expr(k) for k in p.children], n_out)
+        if p.name == "RangePartitioning":
+            raise UnsupportedSparkExec("RangePartitioning")
+        raise UnsupportedSparkExec(f"partitioning {p.name}")
+    if isinstance(v, dict):
+        cls = v.get("product-class", "")
+        if cls.endswith("SinglePartition$") or cls.endswith("SinglePartition"):
+            return SinglePartitioning()
+        if "RoundRobinPartitioning" in cls:
+            return RoundRobinPartitioning(int(v.get("numPartitions", ctx.default_parallelism)))
+    if isinstance(v, str) and "SinglePartition" in v:
+        return SinglePartitioning()
+    raise UnsupportedSparkExec(f"partitioning {v!r}")
+
+
+def _convert_shuffle(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    return NativeShuffleExchangeExec(child, _partitioning(node, ctx))
+
+
+def _convert_broadcast(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    return BroadcastExchangeExec(child)
+
+
+def _join_sides(node: SparkNode, ctx: ConversionContext):
+    left = ctx.convert(node.child(0))
+    right = ctx.convert(node.child(1))
+    lkeys = [convert_expr(k) for k in node.expr_list("leftKeys")]
+    rkeys = [convert_expr(k) for k in node.expr_list("rightKeys")]
+    cond = node.fields.get("condition")
+    cond_e = convert_expr(node.expr("condition")) if cond else None
+    return left, right, lkeys, rkeys, cond_e
+
+
+def _wrap_condition(out: ExecNode, cond_e) -> ExecNode:
+    # non-equi residual: post-join filter (the reference compiles the
+    # condition into the joiners; a filter is semantically equal for
+    # inner joins, which is the only place Spark plans put residuals
+    # for hash joins)
+    return FilterExec(out, cond_e) if cond_e is not None else out
+
+
+def _convert_bhj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    left, right, lkeys, rkeys, cond_e = _join_sides(node, ctx)
+    jt = _join_type(node)
+    build_left = node.string("buildSide", "BuildRight") == "BuildLeft"
+    if build_left:
+        out = BroadcastJoinExec(left, right, lkeys, rkeys, jt, build_is_left=True)
+    else:
+        out = BroadcastJoinExec(right, left, rkeys, lkeys, jt, build_is_left=False)
+    return _wrap_condition(out, cond_e)
+
+
+def _convert_shj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    left, right, lkeys, rkeys, cond_e = _join_sides(node, ctx)
+    jt = _join_type(node)
+    build_left = node.string("buildSide", "BuildLeft") == "BuildLeft"
+    if build_left:
+        out = HashJoinExec(left, right, lkeys, rkeys, jt, build_is_left=True)
+    else:
+        out = HashJoinExec(right, left, rkeys, lkeys, jt, build_is_left=False)
+    return _wrap_condition(out, cond_e)
+
+
+def _convert_smj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    left, right, lkeys, rkeys, cond_e = _join_sides(node, ctx)
+    jt = _join_type(node)
+    out = SortMergeJoinExec(left, right, lkeys, rkeys, jt)
+    return _wrap_condition(out, cond_e)
+
+
+def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    part_by = [convert_expr(p) for p in node.expr_list("partitionSpec")]
+    order_by = _sort_fields(node.expr_list("orderSpec"))
+    functions: List[WindowFunction] = []
+    for w in node.expr_list("windowExpression"):
+        if w.name != "Alias" or w.children[0].name != "WindowExpression":
+            raise UnsupportedSparkExec("window expression shape")
+        eid = expr_id(w.fields.get("exprId"))
+        out_name = f"#{eid}" if eid is not None else w.fields.get("name", "w")
+        wf = w.children[0].children[0]
+        cls = wf.name
+        if cls == "RowNumber":
+            functions.append(WindowFunction("row_number", out_name))
+        elif cls == "Rank":
+            functions.append(WindowFunction("rank", out_name))
+        elif cls == "DenseRank":
+            functions.append(WindowFunction("dense_rank", out_name))
+        elif cls == "AggregateExpression":
+            a = _agg_function(wf)
+            kind = {"count_star": "count"}.get(a.fn, a.fn)
+            functions.append(WindowFunction(kind, out_name, a.expr))
+        else:
+            raise UnsupportedSparkExec(f"window function {cls}")
+    return WindowExec(child, functions, part_by, order_by)
+
+
+def _convert_generate(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    gen = node.expr("generator")
+    if gen is None:
+        raise UnsupportedSparkExec("GenerateExec without generator")
+    outer = bool(node.fields.get("outer", False))
+    if gen.name in ("Explode", "PosExplode"):
+        kind = "explode" if gen.name == "Explode" else "pos_explode"
+        spec = NativeGenerator(kind, convert_expr(gen.children[0]))
+        out = GenerateExec(child, spec, [], outer=outer)
+        # rename generator outputs to their #ids
+        gout = node.expr_list("generatorOutput")
+        if gout:
+            base = [f.name for f in child.schema.fields]
+            gen_names = []
+            for a in gout:
+                eid = expr_id(a.fields.get("exprId"))
+                gen_names.append(f"#{eid}" if eid is not None else _attr_user_name(a))
+            out = RenameColumnsExec(out, base + gen_names)
+        return out
+    raise UnsupportedSparkExec(f"generator {gen.name}")
+
+
+def _convert_expand(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    raw = node.fields.get("projections")
+    if not isinstance(raw, list):
+        raise UnsupportedSparkExec("ExpandExec projections missing")
+    projections = []
+    for proj in raw:
+        projections.append([convert_expr(_parse_sub(e)) for e in proj])
+    names = []
+    for a in node.expr_list("output"):
+        eid = expr_id(a.fields.get("exprId"))
+        names.append(f"#{eid}" if eid is not None else _attr_user_name(a))
+    return ExpandExec(child, projections, names)
+
+
+def _parse_sub(e):
+    from .plan_json import _parse_tree
+
+    return _parse_tree(e)
+
+
+def _convert_union(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    return UnionExec([ctx.convert(c) for c in node.children])
+
+
+def _convert_limit(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    limit = int(node.fields.get("limit", 0) or 0)
+    return LimitExec(child, limit)
+
+
+def _convert_take_ordered(node: SparkNode, ctx: ConversionContext) -> ExecNode:
+    child = ctx.convert(node.child(0))
+    limit = int(node.fields.get("limit", 0) or 0)
+    fields = _sort_fields(node.expr_list("sortOrder"))
+    single = NativeShuffleExchangeExec(child, SinglePartitioning())
+    out: ExecNode = SortExec(single, fields, fetch=limit)
+    out = LimitExec(out, limit)
+    proj = node.expr_list("projectList")
+    if proj:
+        exprs, names = [], []
+        for p in proj:
+            e, n = _named_expr(p)
+            exprs.append(e)
+            names.append(n)
+        out = ProjectExec(out, exprs, names)
+    return out
+
+
+_CONVERTERS: Dict[str, Callable[[SparkNode, ConversionContext], ExecNode]] = {
+    "FileSourceScanExec": _convert_scan,
+    "ProjectExec": _convert_project,
+    "FilterExec": _convert_filter,
+    "HashAggregateExec": _convert_agg,
+    "SortAggregateExec": _convert_agg,
+    "ObjectHashAggregateExec": _convert_agg,
+    "SortExec": _convert_sort,
+    "ShuffleExchangeExec": _convert_shuffle,
+    "BroadcastExchangeExec": _convert_broadcast,
+    "BroadcastHashJoinExec": _convert_bhj,
+    "ShuffledHashJoinExec": _convert_shj,
+    "SortMergeJoinExec": _convert_smj,
+    "WindowExec": _convert_window,
+    "GenerateExec": _convert_generate,
+    "ExpandExec": _convert_expand,
+    "UnionExec": _convert_union,
+    "GlobalLimitExec": _convert_limit,
+    "LocalLimitExec": _convert_limit,
+    "TakeOrderedAndProjectExec": _convert_take_ordered,
+}

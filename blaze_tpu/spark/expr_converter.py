@@ -1,0 +1,292 @@
+"""Spark catalyst Expression (toJSON) -> engine IR.
+
+≙ reference ``NativeConverters.scala`` (``convertDataType:123``,
+``convertValue:205``, ``convertExpr:305``, ``convertExprWithFallback:407``):
+the same per-class match, producing this engine's ``exprs.ir`` nodes.
+Attributes are addressed by exprId — column names in converted plans
+are ``#<id>`` exactly like the reference's bound references, with a
+final rename back to user-facing names at the plan root.
+
+Unconvertible expressions raise :class:`UnsupportedSparkExpr`; the
+strategy layer (``strategy.py``) turns that into per-subtree fallback
+the way ``convertExprWithFallback`` wraps into a JVM-callback UDF.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from ..exprs.ir import (
+    Alias, BinOp, Case, Cast, Col, Expr, GetIndexedField, GetMapValue,
+    GetStructField, InList, IsNotNull, IsNull, Like, Lit, NamedStruct, Not,
+    ScalarFunc,
+)
+from ..schema import DataType
+from .plan_json import SparkNode, expr_id
+
+
+class UnsupportedSparkExpr(Exception):
+    """Raised for an expression class this converter cannot map."""
+
+
+# --------------------------------------------------------------- data types
+
+_ATOMIC_TYPES = {
+    "boolean": DataType.bool_,
+    "byte": DataType.int8,
+    "short": DataType.int16,
+    "integer": DataType.int32,
+    "long": DataType.int64,
+    "float": DataType.float32,
+    "double": DataType.float64,
+    "date": DataType.date32,
+    "timestamp": DataType.timestamp,
+    "null": DataType.null,
+}
+
+_DECIMAL_RE = re.compile(r"decimal\((\d+),\s*(-?\d+)\)")
+
+
+def convert_data_type(t: Any, string_width: int = 64) -> DataType:
+    """Catalyst ``DataType.jsonValue``: atomic types are strings
+    (``"integer"``, ``"decimal(12,2)"``); complex types are objects
+    with ``"type"`` in array/map/struct."""
+    if isinstance(t, str):
+        if t in _ATOMIC_TYPES:
+            return _ATOMIC_TYPES[t]()
+        m = _DECIMAL_RE.fullmatch(t)
+        if m:
+            return DataType.decimal(int(m.group(1)), int(m.group(2)))
+        if t == "string":
+            return DataType.string(string_width)
+        if t == "binary":
+            return DataType.binary(string_width)
+        raise UnsupportedSparkExpr(f"data type {t!r}")
+    if isinstance(t, dict):
+        kind = t.get("type")
+        if kind == "array":
+            return DataType.array(convert_data_type(t["elementType"], string_width))
+        if kind == "map":
+            return DataType.map(
+                convert_data_type(t["keyType"], string_width),
+                convert_data_type(t["valueType"], string_width),
+            )
+        if kind == "struct":
+            from ..schema import Field
+
+            return DataType.struct(
+                [
+                    Field(
+                        f["name"],
+                        convert_data_type(f["type"], string_width),
+                        bool(f.get("nullable", True)),
+                    )
+                    for f in t.get("fields", [])
+                ]
+            )
+        if kind == "udt":
+            raise UnsupportedSparkExpr("user-defined type")
+    raise UnsupportedSparkExpr(f"data type {t!r}")
+
+
+# -------------------------------------------------------------- literals
+
+def _convert_literal(node: SparkNode) -> Lit:
+    t = convert_data_type(node.fields.get("dataType", "null"))
+    v = node.fields.get("value")
+    if v is None:
+        return Lit(None, t)
+    # catalyst serializes literal values as strings (Literal.jsonFields
+    # uses toString); be liberal and accept native JSON scalars too
+    from ..schema import TypeKind
+
+    if t.kind == TypeKind.BOOL:
+        v = v if isinstance(v, bool) else str(v).lower() == "true"
+    elif t.is_decimal:
+        v = str(v)
+    elif t.is_integer:
+        v = int(v)
+    elif t.is_float:
+        v = float(v)
+    elif t.kind == TypeKind.DATE32:
+        # days-since-epoch int or ISO string
+        try:
+            v = int(v)
+        except (TypeError, ValueError):
+            import datetime
+
+            v = datetime.date.fromisoformat(str(v))
+    elif t.kind == TypeKind.TIMESTAMP:
+        v = int(v)
+    else:
+        v = str(v)
+    return Lit(v, t)
+
+
+# ---------------------------------------------------------- expression map
+
+_BINARY_OPS = {
+    "Add": "+", "Subtract": "-", "Multiply": "*", "Divide": "/",
+    "Remainder": "%", "EqualTo": "==", "LessThan": "<",
+    "LessThanOrEqual": "<=", "GreaterThan": ">", "GreaterThanOrEqual": ">=",
+    "And": "and", "Or": "or", "IntegralDivide": "//",
+}
+
+# Spark expression class -> engine function-registry name, for
+# fixed-arity expressions whose children map positionally
+# (≙ the ScalarFunction enum + SparkExtFunctions names the reference
+# serializes in NativeConverters.scala:305-1119)
+_FUNC_CLASSES = {
+    "Abs": "abs", "Sqrt": "sqrt", "Cbrt": "cbrt", "Exp": "exp",
+    "Expm1": "expm1", "Floor": "floor", "Ceil": "ceil", "Log": "ln",
+    "Log2": "log2", "Log10": "log10", "Log1p": "log1p", "Pow": "pow",
+    "Round": "round", "Signum": "signum", "Sin": "sin", "Cos": "cos",
+    "Tan": "tan", "Asin": "asin", "Acos": "acos", "Atan": "atan",
+    "Atan2": "atan2", "Sinh": "sinh", "Cosh": "cosh", "Tanh": "tanh",
+    "ToDegrees": "degrees", "ToRadians": "radians", "UnaryMinus": "negative",
+    "Upper": "upper", "Lower": "lower", "Length": "length",
+    "BitLength": "bit_length", "OctetLength": "octet_length",
+    "Ascii": "ascii", "Chr": "chr", "InitCap": "initcap",
+    "StringTrim": "trim", "StringTrimLeft": "ltrim",
+    "StringTrimRight": "rtrim", "Concat": "concat", "ConcatWs": "concat_ws",
+    "StringSplit": "split", "Substring": "substring",
+    "StringInstr": "instr", "StringLocate": "locate",
+    "StringLPad": "lpad", "StringRPad": "rpad",
+    "StringTranslate": "translate", "StringRepeat": "repeat",
+    "StringReverse": "reverse", "StringSpace": "space",
+    "StringReplace": "replace", "Left": "left", "Right": "right",
+    "Coalesce": "coalesce", "NullIf": "nullif",
+    "Md5": "md5", "Sha1": "sha1", "Sha2": "sha2", "Crc32": "crc32",
+    "Murmur3Hash": "murmur3_hash", "XxHash64": "xxhash64",
+    "Year": "year", "Month": "month", "DayOfMonth": "day",
+    "Quarter": "quarter", "DayOfWeek": "dayofweek",
+    "DayOfYear": "dayofyear", "WeekOfYear": "weekofyear",
+    "WeekDay": "weekday", "LastDay": "last_day", "Hour": "hour",
+    "Minute": "minute", "Second": "second",
+    "DateAdd": "date_add", "DateSub": "date_sub", "DateDiff": "datediff",
+    "AddMonths": "add_months", "FromUnixTime": "from_unixtime",
+    "UnixTimestamp": "unix_timestamp", "ToUnixTimestamp": "unix_timestamp",
+    "DateFormatClass": "date_format",
+    "GetJsonObject": "get_json_object",
+    "RegExpReplace": "regexp_replace", "RegExpExtract": "regexp_extract",
+    "RLike": "rlike", "StartsWith": "starts_with", "EndsWith": "ends_with",
+    "StringPosition": "strpos",
+    "Size": "size", "ArrayContains": "array_contains",
+    "MapKeys": "map_keys", "MapValues": "map_values",
+    "CreateArray": "make_array",
+    "UnscaledValue": "unscaled_value", "MakeDecimal": "make_decimal",
+    "CheckOverflow": "check_overflow", "ToHex": "to_hex",
+    "BloomFilterMightContain": "might_contain",
+    "SplitPart": "split_part", "StringTrimBoth": "btrim",
+    "TruncDate": "trunc",
+}
+
+
+def _attr_name(node: SparkNode) -> str:
+    eid = expr_id(node.fields.get("exprId"))
+    if eid is None:
+        # tolerate dumps without exprIds (hand-reduced fixtures)
+        return node.fields.get("name", "?")
+    return f"#{eid}"
+
+
+def convert_expr(node: SparkNode) -> Expr:
+    """One catalyst expression node -> engine IR (recursive)."""
+    name = node.name
+    kids = node.children
+
+    if name == "AttributeReference":
+        return Col(_attr_name(node))
+    if name == "BoundReference":
+        # ordinal-bound reference: the converters always work on named
+        # attributes, but accept it for robustness
+        return Col(f"@{node.fields.get('ordinal', 0)}")
+    if name == "Literal":
+        return _convert_literal(node)
+    if name == "Alias":
+        return Alias(convert_expr(kids[0]), _attr_name(node))
+    if name in _BINARY_OPS:
+        return BinOp(_BINARY_OPS[name], convert_expr(kids[0]), convert_expr(kids[1]))
+    if name == "Not":
+        # Not(EqualTo) -> != (the reference does the same collapse)
+        if kids and kids[0].name == "EqualTo":
+            inner = kids[0]
+            return BinOp(
+                "!=", convert_expr(inner.children[0]), convert_expr(inner.children[1])
+            )
+        return Not(convert_expr(kids[0]))
+    if name == "IsNull":
+        return IsNull(convert_expr(kids[0]))
+    if name == "IsNotNull":
+        return IsNotNull(convert_expr(kids[0]))
+    if name in ("Cast", "AnsiCast", "TryCast"):
+        to = convert_data_type(node.fields.get("dataType", "null"))
+        # Spark-semantics Cast and TryCast both null out invalid input;
+        # ANSI-mode errors degrade to null (documented divergence)
+        return Cast(convert_expr(kids[0]), to)
+    if name == "CaseWhen":
+        # children = [cond1, val1, cond2, val2, ..., else?]; the
+        # `branches` field degrades to null in toJSON (Seq of tuples),
+        # so reconstruct from arity: odd child count means trailing else
+        exprs = [convert_expr(k) for k in kids]
+        has_else = len(exprs) % 2 == 1
+        else_e = exprs[-1] if has_else else None
+        pairs = list(zip(exprs[0::2], exprs[1::2])) if not has_else else list(
+            zip(exprs[:-1][0::2], exprs[:-1][1::2])
+        )
+        return Case(pairs, else_e)
+    if name == "If":
+        return Case([(convert_expr(kids[0]), convert_expr(kids[1]))], convert_expr(kids[2]))
+    if name == "In":
+        return InList(convert_expr(kids[0]), [convert_expr(k) for k in kids[1:]])
+    if name == "InSet":
+        # hset field holds plain values; type from the child
+        child = convert_expr(kids[0])
+        vals = node.fields.get("hset") or []
+        return InList(child, [Lit(v) for v in vals])
+    if name == "Like":
+        pat = node.child(1) if len(kids) > 1 else None
+        if pat is not None and pat.name == "Literal":
+            return Like(convert_expr(kids[0]), str(pat.fields.get("value", "")))
+        raise UnsupportedSparkExpr("Like with non-literal pattern")
+    if name in ("Contains", "StringContains"):
+        return BinOp(
+            ">",
+            ScalarFunc("instr", [convert_expr(kids[0]), convert_expr(kids[1])]),
+            Lit(0),
+        )
+    if name == "GetArrayItem":
+        idx = kids[1]
+        if idx.name == "Literal":
+            return GetIndexedField(convert_expr(kids[0]), int(idx.fields["value"]))
+        raise UnsupportedSparkExpr("GetArrayItem with non-literal ordinal")
+    if name == "GetMapValue":
+        key = kids[1]
+        if key.name == "Literal":
+            return GetMapValue(convert_expr(kids[0]), _convert_literal(key).value)
+        raise UnsupportedSparkExpr("GetMapValue with non-literal key")
+    if name == "GetStructField":
+        fname = node.fields.get("name")
+        if fname is None:
+            fname = str(node.fields.get("ordinal", 0))
+        return GetStructField(convert_expr(kids[0]), str(fname))
+    if name == "CreateNamedStruct":
+        # children alternate name-literal, value
+        names, exprs = [], []
+        for i in range(0, len(kids), 2):
+            names.append(str(kids[i].fields.get("value")))
+            exprs.append(convert_expr(kids[i + 1]))
+        return NamedStruct(names, exprs)
+    if name == "ScalarSubquery":
+        raise UnsupportedSparkExpr(
+            "ScalarSubquery must be pre-evaluated by the driver "
+            "(≙ SparkScalarSubqueryWrapperExpr)"
+        )
+    if name == "PromotePrecision":
+        return convert_expr(kids[0])
+    if name == "KnownFloatingPointNormalized" or name == "NormalizeNaNAndZero":
+        return convert_expr(kids[0])
+    if name in _FUNC_CLASSES:
+        return ScalarFunc(_FUNC_CLASSES[name], [convert_expr(k) for k in kids])
+    raise UnsupportedSparkExpr(f"expression class {node.cls}")

@@ -1,0 +1,127 @@
+"""Parser for Spark catalyst ``TreeNode.toJSON`` plan dumps.
+
+Catalyst serializes a tree as ONE flat JSON array in preorder: each
+element is an object with ``"class"`` (fully-qualified class name),
+``"num-children"``, and one entry per constructor parameter
+(``TreeNode.jsonValue`` / ``parseToJson`` in
+``sql/catalyst/src/main/scala/org/apache/spark/sql/catalyst/trees/TreeNode.scala``).
+A node's children follow it immediately in the array; the tree is
+rebuilt from the ``num-children`` counts.
+
+Field value encodings (what catalyst's ``parseToJson`` emits):
+
+- atomic values -> JSON scalars
+- a ``TreeNode`` that is NOT one of the node's children (e.g. an
+  expression inside a SparkPlan) -> a nested flat array (its own
+  ``jsonValue``)
+- ``Seq[TreeNode]`` -> array of nested flat arrays
+- ``Option`` -> the value or ``null``
+- case classes (``ExprId``, ...) -> object with ``"product-class"``
+- unsupported types (e.g. ``HadoopFsRelation``) -> ``null``
+
+The parser is deliberately tolerant: where catalyst degrades a field to
+``null`` the converters reconstruct from children instead (the same
+information loss the reference's Scala converters never face because
+they pattern-match live objects — this layer's contract is the JSON
+dump a vanilla Spark session can produce with
+``df.queryExecution.executedPlan.toJSON`` and ship to the TPU service).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+
+@dataclass
+class SparkNode:
+    """One catalyst tree node: plan operator or expression."""
+
+    cls: str                      # fully-qualified class name
+    fields: Dict[str, Any]        # raw constructor-param fields
+    children: List["SparkNode"] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        """Short class name, e.g. ``FilterExec``."""
+        return self.cls.rsplit(".", 1)[-1]
+
+    def child(self, i: int = 0) -> "SparkNode":
+        return self.children[i]
+
+    # -- typed field accessors -------------------------------------------
+
+    def expr(self, key: str) -> Optional["SparkNode"]:
+        """Field holding a single serialized expression tree."""
+        v = self.fields.get(key)
+        if v is None:
+            return None
+        return _parse_tree(v)
+
+    def expr_list(self, key: str) -> List["SparkNode"]:
+        """Field holding ``Seq[Expression]`` (array of flat arrays)."""
+        v = self.fields.get(key)
+        if not v:
+            return []
+        # A single expression tree is itself a flat list of dicts; a
+        # Seq is a list of such lists.
+        if v and isinstance(v[0], dict):
+            return [_parse_tree(v)]
+        return [_parse_tree(e) for e in v]
+
+    def string(self, key: str, default: str = "") -> str:
+        v = self.fields.get(key, default)
+        if isinstance(v, dict):  # case-object serialized as product
+            return v.get("product-class", default).rsplit(".", 1)[-1].rstrip("$")
+        return v if isinstance(v, str) else default
+
+    def __repr__(self) -> str:
+        return f"SparkNode({self.name}, children={len(self.children)})"
+
+
+def expr_id(v: Any) -> Optional[int]:
+    """Decode an ``ExprId`` field: catalyst emits a product object
+    ``{"product-class": "...ExprId", "id": N, "jvmId": ...}``; accept a
+    bare int too."""
+    if isinstance(v, int):
+        return v
+    if isinstance(v, dict) and "id" in v:
+        return int(v["id"])
+    return None
+
+
+def _parse_tree(flat: List[Dict[str, Any]]) -> SparkNode:
+    """Rebuild a preorder-flattened catalyst array into a tree."""
+    pos = 0
+
+    def build() -> SparkNode:
+        nonlocal pos
+        if pos >= len(flat):
+            raise ValueError("malformed catalyst JSON: truncated node array")
+        obj = flat[pos]
+        pos += 1
+        n_children = int(obj.get("num-children", 0))
+        fields = {
+            k: v for k, v in obj.items() if k not in ("class", "num-children")
+        }
+        node = SparkNode(cls=obj["class"], fields=fields)
+        for _ in range(n_children):
+            node.children.append(build())
+        return node
+
+    root = build()
+    if pos != len(flat):
+        raise ValueError(
+            f"malformed catalyst JSON: consumed {pos} of {len(flat)} nodes"
+        )
+    return root
+
+
+def parse_plan_json(text: Union[str, List[Dict[str, Any]]]) -> SparkNode:
+    """Parse a ``TreeNode.toJSON`` dump (string or already-loaded list)
+    into a :class:`SparkNode` tree."""
+    flat = json.loads(text) if isinstance(text, str) else text
+    if not isinstance(flat, list) or not flat:
+        raise ValueError("catalyst toJSON must be a non-empty JSON array")
+    return _parse_tree(flat)

@@ -1,0 +1,137 @@
+"""Convert strategy: tagging, trial conversion, fallback boundaries.
+
+≙ reference ``BlazeConvertStrategy.scala:46-250``:
+
+- bottom-up **trial conversion** decides convertibility per subtree
+  (``convertibleTag``, ``:62-80``);
+- unconvertible nodes fall back for their whole subtree through the
+  session's ``host_fallback`` (the ``ConvertToNative`` seam,
+  ``BlazeConverters.scala:850``);
+- **removeInefficientConverts** (``:182-243``): a cheap native op
+  (Filter/Project) sandwiched between non-native parent and non-native
+  child wastes two boundary crossings, so it is re-tagged NeverConvert
+  to a fixpoint.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Dict, Optional, Set
+
+from ..ops import ExecNode, RenameColumnsExec
+from .converters import (
+    ConversionContext, UnsupportedSparkExec, convert_exec, output_attrs,
+)
+from .expr_converter import UnsupportedSparkExpr
+from .plan_json import SparkNode
+
+logger = logging.getLogger(__name__)
+
+
+class ConvertTag(enum.Enum):
+    """≙ convertStrategyTag values in BlazeConvertStrategy.scala:46."""
+
+    DEFAULT = "default"
+    ALWAYS = "always_convert"
+    NEVER = "never_convert"
+
+
+# ops cheap enough that converting them under a non-native neighbor
+# costs more in boundary crossings than it saves
+# (≙ BlazeConvertStrategy.isInefficientConvert)
+_CHEAP_OPS = {"FilterExec", "ProjectExec", "LocalLimitExec", "GlobalLimitExec"}
+
+
+class _StrategyContext(ConversionContext):
+    """ConversionContext whose child dispatch consults strategy tags and
+    absorbs unsupported subtrees into fallback boundaries."""
+
+    def __init__(self, base: ConversionContext, forced_never: Set[int]):
+        super().__init__(base.catalog, base.default_parallelism, base.host_fallback)
+        self.forced_never = forced_never
+        self.tags: Dict[int, ConvertTag] = {}
+
+    def convert(self, node: SparkNode) -> ExecNode:
+        if id(node) in self.forced_never:
+            self.tags[id(node)] = ConvertTag.NEVER
+            return self._fallback(node)
+        try:
+            out = convert_exec(node, self)
+            self.tags[id(node)] = ConvertTag.ALWAYS
+            return out
+        except (UnsupportedSparkExec, UnsupportedSparkExpr) as e:
+            self.tags[id(node)] = ConvertTag.NEVER
+            logger.info("falling back for %s: %s", node.name, e)
+            return self._fallback(node)
+
+    def _fallback(self, node: SparkNode) -> ExecNode:
+        if self.host_fallback is None:
+            raise UnsupportedSparkExec(
+                f"{node.name} is unconvertible and no host_fallback is "
+                f"registered (≙ running without the JVM side)"
+            )
+        return self.host_fallback(node)
+
+
+def apply_strategy(
+    root: SparkNode, ctx: ConversionContext
+) -> Dict[int, ConvertTag]:
+    """Tag-only pass (diagnostics / tests): run a trial conversion and
+    return the per-node tags, without keeping the converted plan."""
+    sctx = _StrategyContext(ctx, set())
+    try:
+        sctx.convert(root)
+    except UnsupportedSparkExec:
+        pass
+    return sctx.tags
+
+
+def convert_spark_plan(
+    root: SparkNode, ctx: ConversionContext, rename_root: bool = True
+) -> ExecNode:
+    """Full conversion: trial-convert with fallback boundaries, then
+    remove inefficient converts to a fixpoint and rebuild."""
+    forced: Set[int] = set()
+    for _ in range(16):  # fixpoint ≙ removeInefficientConverts loop
+        sctx = _StrategyContext(ctx, forced)
+        plan = sctx.convert(root)
+        added = _inefficient_converts(root, sctx.tags, forced)
+        if not added:
+            break
+        forced |= added
+    if rename_root:
+        attrs = output_attrs(root)
+        if attrs and len(attrs) == len(plan.schema.fields):
+            internal = [a for a, _ in attrs]
+            if internal == plan.schema.names:
+                plan = RenameColumnsExec(plan, [u for _, u in attrs])
+    return plan
+
+
+def _inefficient_converts(
+    root: SparkNode, tags: Dict[int, ConvertTag], already: Set[int]
+) -> Set[int]:
+    """Find cheap native ops sandwiched by non-native parent AND child:
+    converting them buys nothing but two extra boundary crossings."""
+    out: Set[int] = set()
+
+    def walk(node: SparkNode, parent_tag: Optional[ConvertTag]):
+        tag = tags.get(id(node), ConvertTag.NEVER)
+        if (
+            tag == ConvertTag.ALWAYS
+            and id(node) not in already
+            and node.name in _CHEAP_OPS
+            and parent_tag == ConvertTag.NEVER
+            and node.children
+            and all(
+                tags.get(id(c), ConvertTag.NEVER) == ConvertTag.NEVER
+                for c in node.children
+            )
+        ):
+            out.add(id(node))
+        for c in node.children:
+            walk(c, tag)
+
+    walk(root, None)
+    return out

@@ -1,0 +1,285 @@
+"""Builders emitting Spark catalyst ``TreeNode.toJSON``-format plan
+dumps for the interception-layer tests.
+
+The encoding mirrors catalyst's ``TreeNode.jsonValue``: ONE flat
+preorder array per tree, ``class``/``num-children`` per node,
+expression-valued fields as nested flat arrays, ``ExprId``s as
+product-class objects (see ``blaze_tpu/spark/plan_json.py``).  Class
+names are the real Spark ones so the converters exercise the exact
+match arms the reference's ``BlazeConverters.scala`` has.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+X = "org.apache.spark.sql.catalyst.expressions."
+A = "org.apache.spark.sql.catalyst.expressions.aggregate."
+P = "org.apache.spark.sql.execution."
+PHYS = "org.apache.spark.sql.catalyst.plans.physical."
+
+
+def T(cls: str, children: Sequence[dict] = (), **fields) -> dict:
+    """One tree node (nested form; flatten() converts to catalyst's
+    preorder array)."""
+    return {"_cls": cls, "_children": list(children), **fields}
+
+
+def flatten(t: dict) -> List[dict]:
+    out: List[dict] = []
+
+    def go(n: dict):
+        fields = {k: v for k, v in n.items() if k not in ("_cls", "_children")}
+        out.append(
+            {"class": n["_cls"], "num-children": len(n["_children"]), **fields}
+        )
+        for c in n["_children"]:
+            go(c)
+
+    go(t)
+    return out
+
+
+def eid(i: int) -> dict:
+    return {
+        "product-class": X + "ExprId",
+        "id": i,
+        "jvmId": "00000000-0000-0000-0000-000000000000",
+    }
+
+
+# ------------------------------------------------------------- expressions
+
+def attr(name: str, i: int, dtype: Any = "long", nullable: bool = True) -> dict:
+    return T(
+        X + "AttributeReference",
+        name=name,
+        dataType=dtype,
+        nullable=nullable,
+        metadata={},
+        exprId=eid(i),
+        qualifier=[],
+    )
+
+
+def lit(value: Any, dtype: Any) -> dict:
+    return T(X + "Literal", value=value, dataType=dtype)
+
+
+def alias(child: dict, name: str, i: int) -> dict:
+    return T(X + "Alias", [child], name=name, exprId=eid(i), qualifier=[])
+
+
+def binop(cls: str, left: dict, right: dict) -> dict:
+    return T(X + cls, [left, right])
+
+
+def un(cls: str, child: dict, **fields) -> dict:
+    return T(X + cls, [child], **fields)
+
+
+def cast(child: dict, to: Any) -> dict:
+    return T(X + "Cast", [child], dataType=to, timeZoneId=None)
+
+
+def sort_order(child: dict, asc: bool = True, nulls_first: Optional[bool] = None) -> dict:
+    if nulls_first is None:
+        nulls_first = asc
+    return T(
+        X + "SortOrder",
+        [child],
+        direction="Ascending" if asc else "Descending",
+        nullOrdering="NullsFirst" if nulls_first else "NullsLast",
+    )
+
+
+def agg_expr(fn: dict, mode: str, result_id: int, distinct: bool = False) -> dict:
+    return T(
+        A + "AggregateExpression",
+        [fn],
+        mode=mode,
+        isDistinct=distinct,
+        resultId=eid(result_id),
+    )
+
+
+def sum_(child: dict) -> dict:
+    return T(A + "Sum", [child])
+
+
+def avg(child: dict) -> dict:
+    return T(A + "Average", [child])
+
+
+def count(child: Optional[dict] = None) -> dict:
+    return T(A + "Count", [child or lit(1, "integer")])
+
+
+def min_(child: dict) -> dict:
+    return T(A + "Min", [child])
+
+
+def max_(child: dict) -> dict:
+    return T(A + "Max", [child])
+
+
+# ------------------------------------------------------------------ plans
+
+def scan(table: str, attrs: Sequence[dict]) -> dict:
+    return T(
+        P + "FileSourceScanExec",
+        relation=None,  # catalyst degrades HadoopFsRelation to null
+        output=[flatten(a) for a in attrs],
+        requiredSchema={"type": "struct", "fields": []},
+        partitionFilters=[],
+        optionalBucketSet=None,
+        optionalNumCoalescedBuckets=None,
+        dataFilters=[],
+        tableIdentifier={
+            "product-class": "org.apache.spark.sql.catalyst.TableIdentifier",
+            "table": table,
+        },
+        disableBucketedScan=False,
+    )
+
+
+def filter_(condition: dict, child: dict) -> dict:
+    return T(P + "FilterExec", [child], condition=flatten(condition))
+
+
+def project(plist: Sequence[dict], child: dict) -> dict:
+    return T(P + "ProjectExec", [child], projectList=[flatten(p) for p in plist])
+
+
+def hash_agg(
+    groupings: Sequence[dict],
+    aggs: Sequence[dict],
+    child: dict,
+    result: Optional[Sequence[dict]] = None,
+    initial_input_buffer_offset: int = 0,
+) -> dict:
+    return T(
+        P + "aggregate.HashAggregateExec",
+        [child],
+        requiredChildDistributionExpressions=None,
+        groupingExpressions=[flatten(g) for g in groupings],
+        aggregateExpressions=[flatten(a) for a in aggs],
+        aggregateAttributes=[],
+        initialInputBufferOffset=initial_input_buffer_offset,
+        resultExpressions=[flatten(r) for r in (result or [])],
+    )
+
+
+def single_partition() -> dict:
+    return {"product-class": PHYS + "SinglePartition$"}
+
+
+def hash_partitioning(keys: Sequence[dict], n: int) -> list:
+    return flatten(T(PHYS + "HashPartitioning", list(keys), numPartitions=n))
+
+
+def shuffle(partitioning: Any, child: dict) -> dict:
+    return T(
+        P + "exchange.ShuffleExchangeExec",
+        [child],
+        outputPartitioning=partitioning,
+        shuffleOrigin={"product-class": P + "exchange.ENSURE_REQUIREMENTS$"},
+    )
+
+
+def broadcast(child: dict) -> dict:
+    return T(P + "exchange.BroadcastExchangeExec", [child], mode=None)
+
+
+def bhj(
+    left_keys: Sequence[dict],
+    right_keys: Sequence[dict],
+    join_type: str,
+    build_side: str,
+    left: dict,
+    right: dict,
+    condition: Optional[dict] = None,
+) -> dict:
+    return T(
+        P + "joins.BroadcastHashJoinExec",
+        [left, right],
+        leftKeys=[flatten(k) for k in left_keys],
+        rightKeys=[flatten(k) for k in right_keys],
+        joinType=join_type,
+        buildSide="BuildLeft" if build_side == "left" else "BuildRight",
+        condition=flatten(condition) if condition else None,
+        isNullAwareAntiJoin=False,
+    )
+
+
+def shj(
+    left_keys: Sequence[dict],
+    right_keys: Sequence[dict],
+    join_type: str,
+    build_side: str,
+    left: dict,
+    right: dict,
+    condition: Optional[dict] = None,
+) -> dict:
+    return T(
+        P + "joins.ShuffledHashJoinExec",
+        [left, right],
+        leftKeys=[flatten(k) for k in left_keys],
+        rightKeys=[flatten(k) for k in right_keys],
+        joinType=join_type,
+        buildSide="BuildLeft" if build_side == "left" else "BuildRight",
+        condition=flatten(condition) if condition else None,
+    )
+
+
+def smj(
+    left_keys: Sequence[dict],
+    right_keys: Sequence[dict],
+    join_type: str,
+    left: dict,
+    right: dict,
+    condition: Optional[dict] = None,
+) -> dict:
+    return T(
+        P + "joins.SortMergeJoinExec",
+        [left, right],
+        leftKeys=[flatten(k) for k in left_keys],
+        rightKeys=[flatten(k) for k in right_keys],
+        joinType=join_type,
+        condition=flatten(condition) if condition else None,
+        isSkewJoin=False,
+    )
+
+
+def sort(orders: Sequence[dict], child: dict, global_: bool = True) -> dict:
+    return T(
+        P + "SortExec",
+        [child],
+        sortOrder=[flatten(o) for o in orders],
+        **{"global": global_},
+    )
+
+
+def global_limit(n: int, child: dict) -> dict:
+    return T(P + "GlobalLimitExec", [child], limit=n)
+
+
+def take_ordered(
+    n: int, orders: Sequence[dict], plist: Sequence[dict], child: dict
+) -> dict:
+    return T(
+        P + "TakeOrderedAndProjectExec",
+        [child],
+        limit=n,
+        sortOrder=[flatten(o) for o in orders],
+        projectList=[flatten(p) for p in plist],
+    )
+
+
+def union(children: Sequence[dict]) -> dict:
+    return T(P + "UnionExec", list(children))
+
+
+def wscg(child: dict) -> dict:
+    """WholeStageCodegenExec wrapper (pass-through in conversion)."""
+    return T(P + "WholeStageCodegenExec", [child], codegenStageId=1)

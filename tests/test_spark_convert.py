@@ -1,0 +1,400 @@
+"""Spark plan interception layer: catalyst toJSON parsing, expression
+conversion, convert strategy (trial conversion + fallback +
+inefficient-convert removal), end-to-end execution of converted plans.
+
+≙ the reference's JVM-side conversion stack
+(BlazeConvertStrategy.scala, BlazeConverters.scala,
+NativeConverters.scala) exercised the way its TPC-DS differential
+suite exercises converted plans — here against in-memory oracles.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from blaze_tpu.schema import DataType, Field, Schema
+from blaze_tpu.spark import (
+    BlazeSparkSession, ConversionContext, ConvertTag, UnsupportedSparkExpr,
+    apply_strategy, convert_expr, convert_spark_plan, parse_plan_json,
+)
+from blaze_tpu.spark.plan_json import _parse_tree
+
+import spark_fixtures as F
+
+
+def parse_expr(tree):
+    return _parse_tree(F.flatten(tree))
+
+
+# ------------------------------------------------------------ plan parsing
+
+def test_parse_plan_json_rebuilds_tree():
+    plan = F.filter_(
+        F.binop("GreaterThan", F.attr("x", 1), F.lit(5, "long")),
+        F.scan("t", [F.attr("x", 1)]),
+    )
+    root = parse_plan_json(json.dumps(F.flatten(plan)))
+    assert root.name == "FilterExec"
+    assert root.child(0).name == "FileSourceScanExec"
+    cond = root.expr("condition")
+    assert cond.name == "GreaterThan"
+    assert cond.child(0).name == "AttributeReference"
+
+
+def test_parse_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_plan_json(json.dumps([{"class": "X", "num-children": 2}]))
+
+
+# ------------------------------------------------------- expr conversion
+
+def test_convert_exprs_basic():
+    e = convert_expr(parse_expr(
+        F.binop("Add", F.attr("x", 1), F.lit(3, "long"))
+    ))
+    from blaze_tpu.exprs.ir import BinOp, Col, Lit
+
+    assert isinstance(e, BinOp) and e.op == "+"
+    assert isinstance(e.left, Col) and e.left.name == "#1"
+    assert isinstance(e.right, Lit) and e.right.value == 3
+
+
+def test_convert_case_when_reconstructs_branches():
+    # CaseWhen serializes branches as tuples catalyst degrades to null;
+    # the converter rebuilds from child arity (with and without else)
+    cw_else = F.T(
+        F.X + "CaseWhen",
+        [
+            F.binop("LessThan", F.attr("x", 1), F.lit(0, "long")),
+            F.lit(-1, "long"),
+            F.lit(1, "long"),
+        ],
+    )
+    from blaze_tpu.exprs.ir import Case
+
+    e = convert_expr(parse_expr(cw_else))
+    assert isinstance(e, Case) and len(e.branches) == 1 and e.else_ is not None
+    cw_no_else = F.T(
+        F.X + "CaseWhen",
+        [
+            F.binop("LessThan", F.attr("x", 1), F.lit(0, "long")),
+            F.lit(-1, "long"),
+            F.binop("GreaterThan", F.attr("x", 1), F.lit(10, "long")),
+            F.lit(10, "long"),
+        ],
+    )
+    e = convert_expr(parse_expr(cw_no_else))
+    assert isinstance(e, Case) and len(e.branches) == 2 and e.else_ is None
+
+
+def test_convert_cast_and_try_cast():
+    from blaze_tpu.exprs.ir import Cast
+
+    e = convert_expr(parse_expr(F.cast(F.attr("x", 1), "integer")))
+    assert isinstance(e, Cast) and e.to.kind.name == "INT32"
+    t = parse_expr(F.T(F.X + "TryCast", [F.attr("x", 1)], dataType="decimal(10,2)"))
+    e = convert_expr(t)
+    assert isinstance(e, Cast) and e.to.is_decimal
+
+
+def test_convert_function_classes():
+    from blaze_tpu.exprs.ir import ScalarFunc
+
+    e = convert_expr(parse_expr(F.un("Year", F.attr("d", 2, "date"))))
+    assert isinstance(e, ScalarFunc) and e.name == "year"
+    e = convert_expr(parse_expr(
+        F.T(F.X + "Substring", [F.attr("s", 3, "string"), F.lit(1, "integer"), F.lit(2, "integer")])
+    ))
+    assert isinstance(e, ScalarFunc) and e.name == "substring" and len(e.args) == 3
+
+
+def test_unknown_expr_raises():
+    with pytest.raises(UnsupportedSparkExpr):
+        convert_expr(parse_expr(F.T(F.X + "MadeUpExpr", [F.attr("x", 1)])))
+
+
+# ---------------------------------------------------- end-to-end execution
+
+LINEITEM_SCHEMA = Schema([
+    Field("l_quantity", DataType.int64()),
+    Field("l_extendedprice", DataType.int64()),
+    Field("l_discount", DataType.int64()),
+])
+
+
+def make_session(n_rows=400, partitions=3):
+    rng = np.random.RandomState(7)
+    data = {
+        "l_quantity": [int(v) for v in rng.randint(1, 50, n_rows)],
+        "l_extendedprice": [int(v) for v in rng.randint(100, 10000, n_rows)],
+        "l_discount": [int(v) for v in rng.randint(0, 10, n_rows)],
+    }
+    sess = BlazeSparkSession()
+    sess.register_table("lineitem", data, LINEITEM_SCHEMA, partitions=partitions)
+    return sess, data
+
+
+def q6_like_plan():
+    """scan -> filter -> project -> partial agg -> exchange(single) ->
+    final agg, the canonical two-stage global aggregation."""
+    s = F.scan(
+        "lineitem",
+        [F.attr("l_quantity", 1), F.attr("l_extendedprice", 2), F.attr("l_discount", 3)],
+    )
+    f = F.filter_(
+        F.binop(
+            "And",
+            F.binop("LessThan", F.attr("l_quantity", 1), F.lit(24, "long")),
+            F.binop("GreaterThanOrEqual", F.attr("l_discount", 3), F.lit(5, "long")),
+        ),
+        s,
+    )
+    pr = F.project(
+        [F.alias(F.binop("Multiply", F.attr("l_extendedprice", 2), F.attr("l_discount", 3)), "rev", 10)],
+        f,
+    )
+    partial = F.hash_agg([], [F.agg_expr(F.sum_(F.attr("rev", 10)), "Partial", 20)], pr)
+    ex = F.shuffle(F.single_partition(), partial)
+    final = F.hash_agg(
+        [],
+        [F.agg_expr(F.sum_(F.attr("rev", 10)), "Final", 20)],
+        ex,
+        result=[F.alias(F.attr("sum(rev)", 20), "revenue", 21)],
+    )
+    return F.wscg(final)
+
+
+def test_q6_like_plan_end_to_end():
+    sess, data = make_session()
+    out = sess.execute(F.flatten(q6_like_plan()))
+    expected = sum(
+        p * d
+        for q, p, d in zip(data["l_quantity"], data["l_extendedprice"], data["l_discount"])
+        if q < 24 and d >= 5
+    )
+    assert list(out.keys()) == ["revenue"]
+    assert out["revenue"] == [expected]
+
+
+def test_group_by_plan_with_hash_exchange():
+    """scan -> partial group-agg -> hash exchange -> final -> sort."""
+    s = F.scan("lineitem", [F.attr("l_quantity", 1), F.attr("l_discount", 3)])
+    partial = F.hash_agg(
+        [F.attr("l_discount", 3)],
+        [
+            F.agg_expr(F.sum_(F.attr("l_quantity", 1)), "Partial", 20),
+            F.agg_expr(F.count(), "Partial", 21),
+        ],
+        s,
+    )
+    ex = F.shuffle(F.hash_partitioning([F.attr("l_discount", 3)], 4), partial)
+    final = F.hash_agg(
+        [F.attr("l_discount", 3)],
+        [
+            F.agg_expr(F.sum_(F.attr("l_quantity", 1)), "Final", 20),
+            F.agg_expr(F.count(), "Final", 21),
+        ],
+        ex,
+        result=[
+            F.attr("l_discount", 3),
+            F.alias(F.attr("sum", 20), "total_qty", 30),
+            F.alias(F.attr("cnt", 21), "n", 31),
+        ],
+    )
+    sess, data = make_session()
+    out = sess.execute(F.flatten(final))
+    exp = {}
+    for q, d in zip(data["l_quantity"], data["l_discount"]):
+        t = exp.setdefault(d, [0, 0])
+        t[0] += q
+        t[1] += 1
+    got = {
+        d: (s, n)
+        for d, s, n in zip(out["l_discount"], out["total_qty"], out["n"])
+    }
+    assert got == {d: (s, n) for d, (s, n) in exp.items()}
+
+
+def test_broadcast_join_plan():
+    """BHJ: dim table broadcast-joined to fact table."""
+    sess, data = make_session()
+    dim_schema = Schema([
+        Field("d_key", DataType.int64()),
+        Field("d_name", DataType.string(16)),
+    ])
+    sess.register_table(
+        "dim",
+        {"d_key": list(range(10)), "d_name": [f"name{i}" for i in range(10)]},
+        dim_schema,
+    )
+    fact = F.scan("lineitem", [F.attr("l_quantity", 1), F.attr("l_discount", 3)])
+    dim = F.broadcast(F.scan("dim", [F.attr("d_key", 5), F.attr("d_name", 6)]))
+    join = F.bhj(
+        [F.attr("l_discount", 3)], [F.attr("d_key", 5)],
+        "Inner", "right", fact, dim,
+    )
+    pr = F.project(
+        [F.attr("l_quantity", 1), F.attr("d_name", 6)],
+        join,
+    )
+    out = sess.execute(F.flatten(pr))
+    # every discount value 0..9 matches dim key
+    assert len(out["l_quantity"]) == len(data["l_quantity"])
+    for q, name in zip(out["l_quantity"], out["d_name"]):
+        assert name.startswith("name")
+
+
+def test_take_ordered_and_project():
+    sess, data = make_session()
+    s = F.scan("lineitem", [F.attr("l_quantity", 1), F.attr("l_extendedprice", 2)])
+    plan = F.take_ordered(
+        5,
+        [F.sort_order(F.attr("l_extendedprice", 2), asc=False)],
+        [F.attr("l_quantity", 1), F.attr("l_extendedprice", 2)],
+        s,
+    )
+    out = sess.execute(F.flatten(plan))
+    exp = sorted(data["l_extendedprice"], reverse=True)[:5]
+    assert out["l_extendedprice"] == exp
+
+
+# ---------------------------------------------------------- task defs
+
+def test_task_definitions_roundtrip():
+    """Converted plan -> stage split at exchanges -> per-task
+    TaskDefinition bytes -> scheduler execution over shuffle files
+    matches the in-process run (the NativeRDD + DAGScheduler contract
+    end-to-end over the serde boundary)."""
+    sess, data = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    expected = sess.execute(plan_json)
+
+    stages = sess.task_definitions(plan_json)
+    assert len(stages) == 2  # map stage + result stage
+    assert len(stages[0]) == 3  # one map task per input partition
+    got = sess.execute_distributed(plan_json)
+    assert got == expected
+
+
+def test_distributed_group_by_matches_inprocess():
+    sess, data = make_session()
+    s = F.scan("lineitem", [F.attr("l_quantity", 1), F.attr("l_discount", 3)])
+    partial = F.hash_agg(
+        [F.attr("l_discount", 3)],
+        [F.agg_expr(F.sum_(F.attr("l_quantity", 1)), "Partial", 20)],
+        s,
+    )
+    ex = F.shuffle(F.hash_partitioning([F.attr("l_discount", 3)], 4), partial)
+    final = F.hash_agg(
+        [F.attr("l_discount", 3)],
+        [F.agg_expr(F.sum_(F.attr("l_quantity", 1)), "Final", 20)],
+        ex,
+        result=[
+            F.attr("l_discount", 3),
+            F.alias(F.attr("sum", 20), "total_qty", 30),
+        ],
+    )
+    plan_json = F.flatten(final)
+    a = sess.execute(plan_json)
+    b = sess.execute_distributed(plan_json)
+    assert dict(zip(a["l_discount"], a["total_qty"])) == dict(
+        zip(b["l_discount"], b["total_qty"])
+    )
+
+
+# ------------------------------------------------------------- strategy
+
+def test_strategy_tags_and_fallback():
+    sess, data = make_session()
+    # plan with an unconvertible exec in the middle
+    s = F.scan("lineitem", [F.attr("l_quantity", 1)])
+    weird = F.T(F.P + "MadeUpExec", [s])
+    f = F.filter_(
+        F.binop("LessThan", F.attr("l_quantity", 1), F.lit(10, "long")), weird
+    )
+    node = parse_plan_json(json.dumps(F.flatten(f)))
+    ctx = ConversionContext(catalog=sess.catalog)
+    tags = apply_strategy(node, ctx)
+    # filter itself is convertible but MadeUp falls back; without a
+    # host_fallback the conversion of MadeUp raises inside apply (tag NEVER)
+    by_name = {}
+    def walk(n):
+        by_name.setdefault(n.name, tags.get(id(n)))
+        for c in n.children:
+            walk(c)
+    walk(node)
+    assert by_name["MadeUpExec"] == ConvertTag.NEVER
+
+
+def test_strategy_host_fallback_executes():
+    """Unconvertible subtree runs through the registered host fallback
+    (the ConvertToNative / resourcesMap seam) and the convertible
+    parent consumes its output natively."""
+    from blaze_tpu.ops import MemoryScanExec
+    from blaze_tpu.batch import batch_from_pydict
+
+    schema = Schema([Field("#1", DataType.int64())])
+
+    def fallback(node):
+        # the "JVM" executes the subtree and stages the result
+        return MemoryScanExec(
+            [[batch_from_pydict({"#1": [1, 5, 20, 30]}, schema)]], schema
+        )
+
+    sess = BlazeSparkSession(host_fallback=fallback)
+    weird = F.T(F.P + "MadeUpExec", [])
+    f = F.filter_(F.binop("GreaterThan", F.attr("x", 1), F.lit(4, "long")), weird)
+    out = sess.execute(F.flatten(f))
+    assert out["#1"] == [5, 20, 30]
+
+
+def test_inefficient_convert_removed():
+    """A cheap native Filter sandwiched between non-native parent and
+    non-native child re-tags NeverConvert (≙ removeInefficientConverts,
+    BlazeConvertStrategy.scala:182-243)."""
+    from blaze_tpu.ops import MemoryScanExec
+    from blaze_tpu.batch import batch_from_pydict
+
+    schema = Schema([Field("#1", DataType.int64())])
+    fallback_calls = []
+
+    def fallback(node):
+        fallback_calls.append(node.name)
+        return MemoryScanExec(
+            [[batch_from_pydict({"#1": [1, 5]}, schema)]], schema
+        )
+
+    inner = F.T(F.P + "MadeUpExec", [])
+    filt = F.filter_(F.binop("GreaterThan", F.attr("x", 1), F.lit(0, "long")), inner)
+    outer = F.T(F.P + "MadeUpOuterExec", [filt])
+    node = parse_plan_json(json.dumps(F.flatten(outer)))
+    ctx = ConversionContext(catalog={}, host_fallback=fallback)
+    plan = convert_spark_plan(node, ctx, rename_root=False)
+    # after fixpoint, the filter is part of the fallen-back subtree:
+    # the final fallback call covers MadeUpOuterExec (whole sandwich)
+    assert "MadeUpOuterExec" in fallback_calls
+
+
+def test_op_disable_flag_forces_fallback():
+    from blaze_tpu import conf
+
+    sess, data = make_session()
+    s = F.scan("lineitem", [F.attr("l_quantity", 1)])
+    f = F.filter_(
+        F.binop("LessThan", F.attr("l_quantity", 1), F.lit(10, "long")), s
+    )
+    node = parse_plan_json(json.dumps(F.flatten(f)))
+    ctx = ConversionContext(catalog=sess.catalog)
+    conf.set_conf("spark.blaze.enable.filter", False)
+    try:
+        tags = apply_strategy(node, ctx)
+        by_name = {}
+        def walk(n):
+            by_name[n.name] = tags.get(id(n))
+            for c in n.children:
+                walk(c)
+        walk(node)
+        assert by_name["FilterExec"] == ConvertTag.NEVER
+    finally:
+        conf.set_conf("spark.blaze.enable.filter", True)
