@@ -528,27 +528,39 @@ def _col_on_device(c: Column) -> bool:
 
 
 def _concat_device_cols(
-    dtype: DataType, parts: List[Column], ns: List[int], cap: int
+    dtype: DataType, parts: List[Column], ns, cap: int
 ) -> Column:
     """Device-side concatenation along the row axis, padded to ``cap``.
 
     Stays fully async (no host sync): over a remote/tunneled chip each
     host roundtrip costs a full RTT, so merge cascades (agg state
-    re-reduce, coalesce) must never leave HBM."""
+    re-reduce, coalesce) must never leave HBM.  ``ns`` entries may be
+    TRACED scalars (row counts are data-dependent after a shuffle):
+    concatenation is a masked gather over traced offsets, so one
+    compiled program covers every row-count combination of the same
+    capacities."""
+    offs = [jnp.int32(0)]
+    for n in ns:
+        offs.append(offs[-1] + jnp.int32(n))
+    r = jnp.arange(cap, dtype=jnp.int32)
 
     def cat(arrs, pad_width=None):
-        sliced = []
-        for a, n in zip(arrs, ns):
-            s = a[:n]
-            if pad_width is not None and s.shape[-1] < pad_width:
-                padding = [(0, 0)] * (s.ndim - 1) + [(0, pad_width - s.shape[-1])]
-                s = jnp.pad(s, padding)
-            sliced.append(s)
-        out = jnp.concatenate(sliced, axis=0)
-        total = out.shape[0]
-        if total < cap:
-            padding = [(0, cap - total)] + [(0, 0)] * (out.ndim - 1)
-            out = jnp.pad(out, padding)
+        out = None
+        for j, a in enumerate(arrs):
+            if pad_width is not None and a.shape[-1] < pad_width:
+                padding = [(0, 0)] * (a.ndim - 1) + [(0, pad_width - a.shape[-1])]
+                a = jnp.pad(a, padding)
+            in_mask = (r >= offs[j]) & (r < offs[j + 1])
+            src = jnp.clip(r - offs[j], 0, a.shape[0] - 1)
+            g = jnp.take(a, src, axis=0)
+            mask = in_mask.reshape((cap,) + (1,) * (a.ndim - 1))
+            contrib = jnp.where(mask, g, jnp.zeros((), a.dtype))
+            if out is None:
+                out = contrib
+            elif a.dtype == jnp.bool_:
+                out = out | contrib
+            else:
+                out = out + contrib
         return out
 
     validity = cat([c.validity for c in parts])
@@ -590,29 +602,82 @@ def _mask_dead_rows(c: Column, live) -> Column:
 def slice_rows_device(batch: RecordBatch, lo: int, n: int) -> RecordBatch:
     """Device-side row-range slice ``[lo, lo+n)`` re-padded to its own
     bucket capacity (async — no host transfer).  Used by the in-process
-    exchange to split a pid-sorted batch into per-partition batches."""
+    exchange to split a pid-sorted batch into per-partition batches.
+    One cached executable per (schema, in-cap, out-cap) bucket; lo and
+    n ride as traced scalars so every partition slice of every batch
+    reuses the same program."""
+    from .runtime.kernel_cache import cached_kernel, schema_key
+
     cap = bucket_capacity(max(n, 1))
     in_cap = batch.capacity
-    idx = jnp.minimum(jnp.arange(cap, dtype=jnp.int32) + lo, in_cap - 1)
-    live = jnp.arange(cap) < n
-    cols = [_mask_dead_rows(c.take(idx), live) for c in batch.columns]
+    widths = tuple(c.data.shape[1:] for c in batch.columns if c.data is not None)
+
+    def build():
+        @jax.jit
+        def kernel(cols, lo_, n_):
+            idx = jnp.minimum(jnp.arange(cap, dtype=jnp.int32) + lo_, in_cap - 1)
+            live = jnp.arange(cap) < n_
+            return tuple(_mask_dead_rows(c.take(idx), live) for c in cols)
+
+        return kernel
+
+    kernel = cached_kernel(
+        ("slice_rows", schema_key(batch.schema), in_cap, cap, widths), build
+    )
+    cols = list(kernel(tuple(batch.columns), lo, n))
     return RecordBatch(batch.schema, cols, n)
 
 
 def concat_batches(batches: Sequence[RecordBatch]) -> RecordBatch:
     """Concatenation (coalesce path): device-side when every input
-    buffer is already a device array (no sync), host-side otherwise."""
+    buffer is already a device array (no sync), host-side otherwise.
+
+    The device path compiles ONE cached XLA executable per (schema,
+    input shapes) bucket: a chain of eager slice/pad/concat ops would
+    cost a dispatch each, and over a remote/tunneled chip per-dispatch
+    latency dominates merge cascades."""
     assert batches
     schema = batches[0].schema
     n = sum(b.num_rows for b in batches)
     cap = bucket_capacity(n)
     ns = [b.num_rows for b in batches]
     on_device = all(_col_on_device(c) for b in batches for c in b.columns)
+    if on_device:
+        from .runtime.kernel_cache import cached_kernel, schema_key
+
+        caps = tuple(b.capacity for b in batches)
+        widths = tuple(
+            tuple(c.data.shape[1:] for c in b.columns if c.data is not None)
+            for b in batches
+        )
+        dtypes = tuple(f.dtype for f in schema.fields)
+
+        def build():
+            @jax.jit
+            def kernel(cols_per_batch, ns_traced):
+                out = []
+                for ci, t in enumerate(dtypes):
+                    parts = [cols[ci] for cols in cols_per_batch]
+                    out.append(_concat_device_cols(t, parts, list(ns_traced), cap))
+                return tuple(out)
+
+            return kernel
+
+        # row counts ride as TRACED scalars: shuffle partition sizes
+        # are data-dependent, and a key per (ns) combination would
+        # compile (and cache forever) a fresh executable per call
+        kernel = cached_kernel(
+            ("concat", schema_key(schema), caps, cap, widths), build
+        )
+        cols = list(
+            kernel(
+                tuple(tuple(b.columns) for b in batches),
+                tuple(jnp.int32(x) for x in ns),
+            )
+        )
+        return RecordBatch(schema, cols, n)
     cols: List[Column] = []
     for ci, f in enumerate(schema.fields):
-        if on_device:
-            cols.append(_concat_device_cols(f.dtype, [b.columns[ci] for b in batches], ns, cap))
-        else:
-            parts = [b.columns[ci].to_host() for b in batches]
-            cols.append(_concat_host_cols(f.dtype, parts, ns, cap).to_device())
+        parts = [b.columns[ci].to_host() for b in batches]
+        cols.append(_concat_host_cols(f.dtype, parts, ns, cap).to_device())
     return RecordBatch(schema, cols, n)

@@ -162,12 +162,36 @@ def encode_key_words(cols: Sequence[Column]) -> List[jnp.ndarray]:
 
 # ------------------------------------------------------- segment reduces
 
+# ``seg is None`` selects the GLOBAL (single-segment) fast path: a
+# plain tree reduction.  segment_* with num_segments=1 lowers to a
+# scatter, which XLA:TPU executes orders of magnitude slower than a
+# reduce — the no-groupings agg was 70x off the chip's reduce speed.
+
+def _seg_min_reduce(values, seg, cap):
+    """Raw per-segment min with the global fast path — use THIS (or
+    _seg_max_reduce) for any new reduce; never call jax.ops.segment_*
+    directly (seg=None must stay a tree reduce, not a scatter)."""
+    if seg is None:
+        return jnp.min(values, keepdims=True)
+    return jax.ops.segment_min(values, seg, num_segments=cap, indices_are_sorted=True)
+
+
+def _seg_max_reduce(values, seg, cap):
+    if seg is None:
+        return jnp.max(values, keepdims=True)
+    return jax.ops.segment_max(values, seg, num_segments=cap, indices_are_sorted=True)
+
+
 def _seg_sum(values, valid, seg, cap):
     z = jnp.where(valid, values, jnp.zeros((), values.dtype))
+    if seg is None:
+        return jnp.sum(z, keepdims=True)
     return jax.ops.segment_sum(z, seg, num_segments=cap, indices_are_sorted=True)
 
 
 def _seg_count(valid, seg, cap):
+    if seg is None:
+        return jnp.sum(valid.astype(jnp.int64), keepdims=True)
     return jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=cap, indices_are_sorted=True)
 
 
@@ -179,15 +203,14 @@ def _seg_minmax(values, valid, seg, cap, is_min: bool):
         info = jnp.iinfo(dt)
         sentinel = jnp.array(info.max if is_min else info.min, dt)
     z = jnp.where(valid, values, sentinel)
-    f = jax.ops.segment_min if is_min else jax.ops.segment_max
-    return f(z, seg, num_segments=cap, indices_are_sorted=True)
+    return (_seg_min_reduce if is_min else _seg_max_reduce)(z, seg, cap)
 
 
 def _seg_first(values, valid, seg, cap, ignore_nulls: bool):
     n = values.shape[0]
     pick = valid if ignore_nulls else jnp.ones_like(valid)
     idx = jnp.where(pick, jnp.arange(n), n)
-    first_idx = jax.ops.segment_min(idx, seg, num_segments=cap, indices_are_sorted=True)
+    first_idx = _seg_min_reduce(idx, seg, cap)
     safe = jnp.clip(first_idx, 0, n - 1)
     has = first_idx < n
     return jnp.take(values, safe, axis=0), jnp.take(valid, safe) & has, has
@@ -197,7 +220,7 @@ def _seg_gather_first(v: Column, pick, seg, cap: int) -> Column:
     """Gather the first row per segment where ``pick`` holds."""
     n = v.validity.shape[0]
     idx = jnp.where(pick, jnp.arange(n), n)
-    first = jax.ops.segment_min(idx, seg, num_segments=cap, indices_are_sorted=True)
+    first = _seg_min_reduce(idx, seg, cap)
     has = first < n
     out = v.take(jnp.clip(first, 0, n - 1))
     return Column(v.dtype, out.data, out.validity & has,
@@ -215,8 +238,8 @@ def _seg_string_minmax(v: Column, seg, cap: int, is_min: bool) -> Column:
     sentinel = jnp.uint64(0xFFFFFFFFFFFFFFFF)
     for word in words:
         masked = jnp.where(cand, word, sentinel)
-        m = jax.ops.segment_min(masked, seg, num_segments=cap, indices_are_sorted=True)
-        cand = cand & (word == jnp.take(m, seg))
+        m = _seg_min_reduce(masked, seg, cap)
+        cand = cand & (word == (m[0] if seg is None else jnp.take(m, seg)))
     return _seg_gather_first(v, cand, seg, cap)
 
 
@@ -524,9 +547,7 @@ class AggExec(ExecNode):
                 if v.dtype.is_string:
                     return [_seg_string_minmax(v, seg, cap, a.fn == "min")]
                 vals = _seg_minmax(v.data, v.validity, seg, cap, a.fn == "min")
-                has = jax.ops.segment_max(
-                    v.validity.astype(jnp.int32), seg, num_segments=cap, indices_are_sorted=True
-                ).astype(jnp.bool_)
+                has = _seg_max_reduce(v.validity.astype(jnp.int32), seg, cap).astype(jnp.bool_)
                 return [Column(v.dtype, jnp.where(has, vals, jnp.zeros((), vals.dtype)), has)]
             if a.fn in ("first", "first_ignores_null"):
                 v = inputs[0]
@@ -538,6 +559,8 @@ class AggExec(ExecNode):
                 return [Column(v.dtype, jnp.where(valid, vals, jnp.zeros((), vals.dtype)), valid)]
             if a.fn in ("collect_list", "collect_set"):
                 arr_t = state_schema.field(f"{a.name}#list").dtype
+                if seg is None:  # collect keeps the segment machinery
+                    seg = jnp.zeros(inputs[0].validity.shape[0], jnp.int32)
                 out = _collect_reduce(inputs[0], arr_t, seg, cap, merging)
                 if a.fn == "collect_set":
                     out = _dedup_array_state(out)
@@ -622,7 +645,7 @@ class AggExec(ExecNode):
             if pre_filter is not None:
                 pf = lower(pre_filter, schema, env, cap)
                 live = live & pf.validity & pf.data.astype(jnp.bool_)
-            seg = jnp.zeros(cap, jnp.int32)
+            seg = None  # global reduce fast path (no scatter)
             inputs = partial_inputs(env, schema, cap) if not merging else state_inputs(env)
             masked = [
                 [Column(c.dtype, c.data, c.validity & live, c.lengths, c.children) for c in ins]

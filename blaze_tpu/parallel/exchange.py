@@ -174,6 +174,17 @@ class NativeShuffleExchangeExec(ExecNode):
                     lo, hi = int(offs[pid]), int(offs[pid + 1])
                     if hi > lo:
                         out[pid].append(slice_rows_device(sorted_batch, lo, hi - lo))
+        # coalesce each partition to one batch: downstream operators
+        # run per batch and each program execution pays a dispatch
+        # turnaround (a full RTT over a tunneled chip), so fewer,
+        # larger batches win — one concat program replaces per-batch
+        # downstream programs (≙ the reference wrapping every operator
+        # in a coalesce stream, streams/coalesce_stream.rs)
+        from ..batch import concat_batches
+
+        for pid in range(n_out):
+            if len(out[pid]) > 1:
+                out[pid] = [concat_batches(out[pid])]
         self._inproc_outputs = out
 
     def materialize(self) -> None:

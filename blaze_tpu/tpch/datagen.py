@@ -106,7 +106,7 @@ HostTable = Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]
 # column -> (data, lengths|None); validity implied all-true (TPC-H has no nulls)
 
 
-def generate_table(name: str, scale: float, seed: int = 19940204) -> HostTable:
+def generate_table(name: str, scale: float, seed: int = 19940204, columns=None) -> HostTable:
     import zlib as _z
 
     rng = np.random.RandomState((seed + _z.crc32(name.encode())) % (2**31))
@@ -216,7 +216,7 @@ def generate_table(name: str, scale: float, seed: int = 19940204) -> HostTable:
     if name == "orders":
         return _gen_orders(rng, scale)[0]
     if name == "lineitem":
-        return _gen_lineitem(rng, scale)
+        return _gen_lineitem(rng, scale, columns)
     raise KeyError(name)
 
 
@@ -244,7 +244,10 @@ def _gen_orders(rng, scale: float):
     return table, (keys, orderdate)
 
 
-def _gen_lineitem(rng, scale: float) -> HostTable:
+def _gen_lineitem(rng, scale: float, columns=None) -> HostTable:
+    """``columns``: optional subset to materialize — benchmarks at big
+    scale factors skip the string columns their query never reads
+    (string synthesis dominates datagen wall time)."""
     orders, (okeys, odates) = _gen_orders(np.random.RandomState(rng.randint(2**31)), scale)
     n_orders = okeys.shape[0]
     lines_per = rng.randint(1, 8, n_orders)
@@ -265,15 +268,12 @@ def _gen_lineitem(rng, scale: float) -> HostTable:
     shipdate = (odate + rng.randint(1, 122, n)).astype(np.int32)
     commitdate = (odate + rng.randint(30, 91, n)).astype(np.int32)
     receiptdate = (shipdate + rng.randint(1, 31, n)).astype(np.int32)
-    # returnflag: R/A for receipts before current date else N (spec-ish)
-    rf_idx = np.where(receiptdate < _days(1995, 6, 17), rng.randint(0, 2, n), 2)
-    rf_opts, rf_len = _encode_options(RETURNFLAGS, 8)
-    ls_idx = (shipdate > _days(1995, 6, 17)).astype(np.int64)
-    ls_opts, ls_len = _encode_options(LINESTATUS, 8)
-    si_data, si_len = str_choice(rng, SHIPINSTRUCT, n, 32)
-    sm_data, sm_len = str_choice(rng, SHIPMODES, n, 8)
-    com, comlen = word_sentence(rng, n, 64, 3)
-    return {
+    want = lambda c: columns is None or c in columns
+    # optional columns draw from INDEPENDENT child streams so the same
+    # seed yields identical values regardless of which other columns
+    # are requested (the subset must be a projection of the full table)
+    child_seeds = rng.randint(2**31, size=4)
+    out: HostTable = {
         "l_orderkey": (okey, None),
         "l_partkey": (partkey, None),
         "l_suppkey": (suppkey, None),
@@ -282,15 +282,32 @@ def _gen_lineitem(rng, scale: float) -> HostTable:
         "l_extendedprice": (extendedprice, None),
         "l_discount": (discount, None),
         "l_tax": (tax, None),
-        "l_returnflag": (rf_opts[rf_idx], rf_len[rf_idx]),
-        "l_linestatus": (ls_opts[ls_idx], ls_len[ls_idx]),
         "l_shipdate": (shipdate, None),
         "l_commitdate": (commitdate, None),
         "l_receiptdate": (receiptdate, None),
-        "l_shipinstruct": (si_data, si_len),
-        "l_shipmode": (sm_data, sm_len),
-        "l_comment": (com, comlen),
     }
+    if want("l_returnflag"):
+        # returnflag: R/A for receipts before current date else N (spec-ish)
+        crng = np.random.RandomState(child_seeds[0])
+        rf_idx = np.where(receiptdate < _days(1995, 6, 17), crng.randint(0, 2, n), 2)
+        rf_opts, rf_len = _encode_options(RETURNFLAGS, 8)
+        out["l_returnflag"] = (rf_opts[rf_idx], rf_len[rf_idx])
+    if want("l_linestatus"):
+        ls_idx = (shipdate > _days(1995, 6, 17)).astype(np.int64)
+        ls_opts, ls_len = _encode_options(LINESTATUS, 8)
+        out["l_linestatus"] = (ls_opts[ls_idx], ls_len[ls_idx])
+    if want("l_shipinstruct"):
+        si_data, si_len = str_choice(np.random.RandomState(child_seeds[1]), SHIPINSTRUCT, n, 32)
+        out["l_shipinstruct"] = (si_data, si_len)
+    if want("l_shipmode"):
+        sm_data, sm_len = str_choice(np.random.RandomState(child_seeds[2]), SHIPMODES, n, 8)
+        out["l_shipmode"] = (sm_data, sm_len)
+    if want("l_comment"):
+        com, comlen = word_sentence(np.random.RandomState(child_seeds[3]), n, 64, 3)
+        out["l_comment"] = (com, comlen)
+    if columns is not None:
+        out = {k: v for k, v in out.items() if k in columns}
+    return out
 
 
 def generate_all(scale: float, seed: int = 19940204) -> Dict[str, HostTable]:
