@@ -80,6 +80,14 @@ PIPELINE_DEPTH = ConfEntry("spark.blaze.pipeline.depth", 2, int)
 
 # TPU-specific knobs (no reference equivalent).
 ON_DEVICE = ConfEntry("spark.blaze.tpu.onDevice", True, _bool)
+# Grouped-agg segment reduces via segmented associative scans + cumsum
+# differences + gathers (scatter-free).  Off = jax.ops.segment_* +
+# jnp.nonzero (scatter-based — a cliff on XLA:TPU).
+SEG_SCAN_REDUCE = ConfEntry("spark.blaze.tpu.segScanReduce", True, _bool)
+# PARTIAL grouped aggs sort ONE u32 key hash instead of every 64-bit
+# key word (boundaries still compare full words; hash-collision
+# duplicate groups are re-merged downstream)
+AGG_HASH_SORT_PARTIAL = ConfEntry("spark.blaze.tpu.aggHashSortPartial", True, _bool)
 # In-process exchanges keep partition buffers device-resident (HBM)
 # instead of round-tripping IPC files through the host — over a
 # remote/tunneled chip every host sync costs a full RTT.  The file

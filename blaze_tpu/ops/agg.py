@@ -166,6 +166,65 @@ def encode_key_words(cols: Sequence[Column]) -> List[jnp.ndarray]:
 # plain tree reduction.  segment_* with num_segments=1 lowers to a
 # scatter, which XLA:TPU executes orders of magnitude slower than a
 # reduce — the no-groupings agg was 70x off the chip's reduce speed.
+#
+# ``seg`` may also be a :class:`SortedSegs`: rows sorted by group with
+# known boundary structure.  Reduces then run as segmented
+# associative scans + cumsum-difference + gathers — NO scatter at all
+# (jax.ops.segment_* and jnp.nonzero's bincount both lower to scatter,
+# the other TPU cliff).
+
+
+@dataclass
+class SortedSegs:
+    """Segment structure of a group-sorted row block.
+
+    - ``seg``: (cap,) int32 group id per row (0..n_out-1, clipped)
+    - ``boundary``: (cap,) bool, True at each segment's first row
+    - ``starts``: (cap,) int32, row index of group g's first row
+    - ``ends``: (cap,) int32, row index of group g's last row
+    (entries past n_out are garbage; callers mask with out_live)
+    """
+
+    seg: jnp.ndarray
+    boundary: jnp.ndarray
+    starts: jnp.ndarray
+    ends: jnp.ndarray
+
+
+def _segscan(op, vals, flags):
+    """Segmented inclusive scan: at row i, reduce of ``vals`` from i's
+    segment start through i.  Standard segmented-scan monoid over
+    (value, boundary-flag) pairs — an associative_scan, so it lowers to
+    a log-depth tree of vector ops (TPU-fast), not a scatter."""
+
+    def comb(a, b):
+        v1, f1 = a
+        v2, f2 = b
+        return jnp.where(f2, v2, op(v1, v2)), f1 | f2
+
+    v, _ = jax.lax.associative_scan(comb, (vals, flags))
+    return v
+
+
+def build_sorted_segs(boundary, s_live) -> SortedSegs:
+    """Derive SortedSegs from boundary flags over group-sorted rows
+    (dead rows sort AFTER live ones).  Uses one single-operand u32 sort
+    for end-position compaction instead of jnp.nonzero (whose bincount
+    is a scatter)."""
+    cap = boundary.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    seg = jnp.clip(jnp.cumsum(boundary.astype(jnp.int32)) - 1, 0, cap - 1)
+    nxt_boundary = jnp.roll(boundary, -1).at[-1].set(True)
+    nxt_dead = jnp.roll(~s_live, -1).at[-1].set(True)
+    ends_mask = s_live & (nxt_boundary | nxt_dead)
+    ends_pos = jnp.where(ends_mask, idx, jnp.int32(cap))
+    ends = jnp.clip(jax.lax.sort((ends_pos,), num_keys=1)[0], 0, cap - 1)
+    start_at_row = _segscan(
+        jnp.maximum, jnp.where(boundary, idx, jnp.int32(-1)), boundary
+    )
+    starts = jnp.clip(jnp.take(start_at_row, ends), 0, cap - 1)
+    return SortedSegs(seg=seg, boundary=boundary, starts=starts, ends=ends)
+
 
 def _seg_min_reduce(values, seg, cap):
     """Raw per-segment min with the global fast path — use THIS (or
@@ -173,12 +232,16 @@ def _seg_min_reduce(values, seg, cap):
     directly (seg=None must stay a tree reduce, not a scatter)."""
     if seg is None:
         return jnp.min(values, keepdims=True)
+    if isinstance(seg, SortedSegs):
+        return jnp.take(_segscan(jnp.minimum, values, seg.boundary), seg.ends)
     return jax.ops.segment_min(values, seg, num_segments=cap, indices_are_sorted=True)
 
 
 def _seg_max_reduce(values, seg, cap):
     if seg is None:
         return jnp.max(values, keepdims=True)
+    if isinstance(seg, SortedSegs):
+        return jnp.take(_segscan(jnp.maximum, values, seg.boundary), seg.ends)
     return jax.ops.segment_max(values, seg, num_segments=cap, indices_are_sorted=True)
 
 
@@ -186,13 +249,26 @@ def _seg_sum(values, valid, seg, cap):
     z = jnp.where(valid, values, jnp.zeros((), values.dtype))
     if seg is None:
         return jnp.sum(z, keepdims=True)
+    if isinstance(seg, SortedSegs):
+        if jnp.issubdtype(z.dtype, jnp.floating):
+            # floats: a global-cumsum difference catastrophically
+            # cancels when a small group follows a large prefix, so
+            # accumulate WITHIN each segment (error scales with the
+            # group's own magnitude, matching segment_sum)
+            return jnp.take(_segscan(jnp.add, z, seg.boundary), seg.ends)
+        # ints/decimals: cumsum difference is exact (wraparound
+        # cancels in the subtraction) — gathers only
+        incl = jnp.cumsum(z)
+        return (
+            jnp.take(incl, seg.ends)
+            - jnp.take(incl, seg.starts)
+            + jnp.take(z, seg.starts)
+        )
     return jax.ops.segment_sum(z, seg, num_segments=cap, indices_are_sorted=True)
 
 
 def _seg_count(valid, seg, cap):
-    if seg is None:
-        return jnp.sum(valid.astype(jnp.int64), keepdims=True)
-    return jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=cap, indices_are_sorted=True)
+    return _seg_sum(valid.astype(jnp.int64), jnp.ones_like(valid), seg, cap)
 
 
 def _seg_minmax(values, valid, seg, cap, is_min: bool):
@@ -239,7 +315,13 @@ def _seg_string_minmax(v: Column, seg, cap: int, is_min: bool) -> Column:
     for word in words:
         masked = jnp.where(cand, word, sentinel)
         m = _seg_min_reduce(masked, seg, cap)
-        cand = cand & (word == (m[0] if seg is None else jnp.take(m, seg)))
+        if seg is None:
+            per_row = m[0]
+        elif isinstance(seg, SortedSegs):
+            per_row = jnp.take(m, seg.seg)
+        else:
+            per_row = jnp.take(m, seg)
+        cand = cand & (word == per_row)
     return _seg_gather_first(v, cand, seg, cap)
 
 
@@ -463,6 +545,8 @@ class AggExec(ExecNode):
             tuple((expr_key(g.expr), g.name) for g in self.groupings),
             tuple((a.fn, None if a.expr is None else expr_key(a.expr), a.name)
                   for a in self.aggs),
+            bool(conf.SEG_SCAN_REDUCE.get()),
+            bool(conf.AGG_HASH_SORT_PARTIAL.get()),
         )
         self._grouped_kernel, self._scalar_kernel, self._finalize_kernel = cached_kernel(
             kernel_key, lambda: self._build_kernels(in_schema)
@@ -484,6 +568,13 @@ class AggExec(ExecNode):
         in_types = list(self._in_types)  # NEVER capture self below: the
         # kernels are cached process-wide and must not pin this exec's
         # child subtree (scanned data) alive
+        use_segscan = bool(conf.SEG_SCAN_REDUCE.get())  # in kernel_key
+        # exactness: only PARTIAL may emit hash-split duplicate groups
+        # (every later stage re-merges); FINAL/PARTIAL_MERGE sort the
+        # full key words
+        use_hash_sort = (
+            bool(conf.AGG_HASH_SORT_PARTIAL.get()) and self.mode == AggMode.PARTIAL
+        )
 
         def eval_inputs(cols: Tuple[Column, ...], schema: Schema):
             env = {f.name: c for f, c in zip(schema.fields, cols)}
@@ -561,6 +652,8 @@ class AggExec(ExecNode):
                 arr_t = state_schema.field(f"{a.name}#list").dtype
                 if seg is None:  # collect keeps the segment machinery
                     seg = jnp.zeros(inputs[0].validity.shape[0], jnp.int32)
+                elif isinstance(seg, SortedSegs):
+                    seg = seg.seg
                 out = _collect_reduce(inputs[0], arr_t, seg, cap, merging)
                 if a.fn == "collect_set":
                     out = _dedup_array_state(out)
@@ -578,22 +671,45 @@ class AggExec(ExecNode):
             if pre_filter is not None:
                 pf = lower(pre_filter, schema, env, cap)
                 live = live & pf.validity & pf.data.astype(jnp.bool_)
-            words = [live.astype(jnp.uint64) ^ jnp.uint64(1)] + [
+            key_words = [
                 jnp.where(live, w, jnp.uint64(0)) for w in encode_key_words(key_cols)
             ]
             row_idx = jnp.arange(cap, dtype=jnp.int32)
-            sorted_ops = jax.lax.sort(tuple(words) + (row_idx,), num_keys=len(words))
-            s_words, s_idx = sorted_ops[:-1], sorted_ops[-1]
-            s_live = jnp.take(live, s_idx)
-            changed = jnp.zeros(cap, jnp.bool_)
-            for w in s_words:
-                changed = changed | (w != jnp.roll(w, 1))
-            changed = changed.at[0].set(True)
+            if use_hash_sort:
+                # PARTIAL-mode fast path: sort ONE u32 hash key instead
+                # of every 64-bit key word.  Hash collisions between
+                # distinct keys may split a group into multiple
+                # segments (boundaries compare the FULL words, so
+                # distinct keys never merge); duplicate partial states
+                # are legal — the merge stage re-reduces them.
+                h = jnp.full(cap, 2166136261, jnp.uint32)
+                for w in key_words:
+                    for half in (w.astype(jnp.uint32), (w >> jnp.uint64(32)).astype(jnp.uint32)):
+                        h = (h ^ half) * jnp.uint32(16777619)
+                key = jnp.where(live, h & jnp.uint32(0x7FFFFFFF), jnp.uint32(0xFFFFFFFF))
+                _, s_idx = jax.lax.sort((key, row_idx), num_keys=1)
+                s_live = jnp.take(live, s_idx)
+                prev_idx = jnp.roll(s_idx, 1)
+                changed = jnp.zeros(cap, jnp.bool_)
+                for w in key_words:
+                    changed = changed | (jnp.take(w, s_idx) != jnp.take(w, prev_idx))
+                changed = changed.at[0].set(True)
+            else:
+                words = [live.astype(jnp.uint64) ^ jnp.uint64(1)] + key_words
+                sorted_ops = jax.lax.sort(tuple(words) + (row_idx,), num_keys=len(words))
+                s_words, s_idx = sorted_ops[:-1], sorted_ops[-1]
+                s_live = jnp.take(live, s_idx)
+                changed = jnp.zeros(cap, jnp.bool_)
+                for w in s_words:
+                    changed = changed | (w != jnp.roll(w, 1))
+                changed = changed.at[0].set(True)
             boundary = s_live & (changed | ~jnp.roll(s_live, 1))
             boundary = boundary.at[0].set(s_live[0])
-            seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-            seg = jnp.clip(seg, 0, cap - 1)
             n_out = jnp.sum(boundary.astype(jnp.int32))
+            if use_segscan:
+                seg = build_sorted_segs(boundary, s_live)
+            else:
+                seg = jnp.clip(jnp.cumsum(boundary.astype(jnp.int32)) - 1, 0, cap - 1)
 
             # gather agg inputs in sorted order (Column.take recurses
             # into nested children, e.g. collect ARRAY states)
@@ -609,7 +725,10 @@ class AggExec(ExecNode):
                 state_cols.extend(reduce_one(a, t, ins, seg, cap, merging))
 
             # group key columns: gather at boundary positions
-            b_idx = jnp.nonzero(boundary, size=cap, fill_value=0)[0]
+            if use_segscan:
+                b_idx = seg.starts
+            else:
+                b_idx = jnp.nonzero(boundary, size=cap, fill_value=0)[0]
             out_live = jnp.arange(cap) < n_out
             group_out: List[Column] = []
             for kc in key_cols:

@@ -351,3 +351,142 @@ def test_agg_spill_under_memory_pressure():
     finally:
         MemManager.init(int(_conf.HOST_SPILL_BUDGET.get()))
     assert got == want
+
+
+def test_grouped_agg_segscan_vs_scatter_paths():
+    """The scan/gather-based sorted-segment reduce (TPU fast path) and
+    the legacy jax.ops.segment_* path produce identical states."""
+    import numpy as np
+
+    from blaze_tpu import conf
+    from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggExec, AggFunction, AggMode, GroupingExpr, MemoryScanExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.runtime.kernel_cache import clear_kernel_cache
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([
+        Field("k", DataType.int64()),
+        Field("s", DataType.string(8)),
+        Field("v", DataType.int64()),
+        Field("f", DataType.float64()),
+    ])
+    rng = np.random.RandomState(3)
+    n = 500
+    data = {
+        "k": [int(x) if x % 5 else None for x in rng.randint(0, 17, n)],
+        "s": [f"s{x}" if x % 4 else None for x in rng.randint(0, 9, n)],
+        "v": [int(x) for x in rng.randint(-50, 50, n)],
+        "f": [float(x) for x in rng.uniform(-5, 5, n)],
+    }
+
+    def run(flag):
+        old = conf.SEG_SCAN_REDUCE.get()
+        conf.SEG_SCAN_REDUCE.set(flag)
+        clear_kernel_cache()
+        try:
+            src = MemoryScanExec([[batch_from_pydict(data, schema)]], schema)
+            agg = AggExec(
+                src, AggMode.PARTIAL,
+                [GroupingExpr(col("k"), "k")],
+                [
+                    AggFunction("sum", col("v"), "sv"),
+                    AggFunction("count", col("f"), "cf"),
+                    AggFunction("min", col("v"), "mv"),
+                    AggFunction("max", col("f"), "xf"),
+                    AggFunction("first_ignores_null", col("v"), "fv"),
+                    AggFunction("min", col("s"), "ms"),
+                ],
+            )
+            rows = {}
+            for b in agg.execute(0, TaskContext(0, 1)):
+                d = batch_to_pydict(b)
+                for i, k in enumerate(d["k"]):
+                    rows[k] = tuple(d[c][i] for c in d if c != "k")
+            return rows
+        finally:
+            conf.SEG_SCAN_REDUCE.set(old)
+            clear_kernel_cache()
+    assert run(True) == run(False)
+
+
+def test_partial_hash_sort_two_stage_differential():
+    """PARTIAL hash-keyed sort (possible duplicate partial groups) must
+    be invisible after the FINAL merge — differential vs the exact-sort
+    path across the full two-stage pipeline."""
+    import numpy as np
+
+    from blaze_tpu import conf
+    from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggExec, AggFunction, AggMode, GroupingExpr, MemoryScanExec
+    from blaze_tpu.parallel import HashPartitioning, NativeShuffleExchangeExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.runtime.kernel_cache import clear_kernel_cache
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("k", DataType.int64()), Field("v", DataType.int64())])
+    rng = np.random.RandomState(11)
+    parts = []
+    for p in range(3):
+        n = 200
+        parts.append([batch_from_pydict({
+            "k": [int(x) if x % 6 else None for x in rng.randint(0, 40, n)],
+            "v": [int(x) for x in rng.randint(-20, 20, n)],
+        }, schema)])
+
+    def run(flag):
+        old = conf.AGG_HASH_SORT_PARTIAL.get()
+        conf.AGG_HASH_SORT_PARTIAL.set(flag)
+        clear_kernel_cache()
+        try:
+            src = MemoryScanExec(parts, schema)
+            partial = AggExec(src, AggMode.PARTIAL, [GroupingExpr(col("k"), "k")],
+                              [AggFunction("sum", col("v"), "sv"),
+                               AggFunction("count_star", None, "n")])
+            ex = NativeShuffleExchangeExec(partial, HashPartitioning([col("k")], 2))
+            final = AggExec(ex, AggMode.FINAL, [GroupingExpr(col("k"), "k")], partial.aggs)
+            rows = {}
+            for p in range(2):
+                for b in final.execute(p, TaskContext(p, 2)):
+                    d = batch_to_pydict(b)
+                    for k, sv, n in zip(d["k"], d["sv"], d["n"]):
+                        assert k not in rows, f"duplicate group {k} survived final"
+                        rows[k] = (sv, n)
+            return rows
+        finally:
+            conf.AGG_HASH_SORT_PARTIAL.set(old)
+            clear_kernel_cache()
+
+    assert run(True) == run(False)
+
+
+def test_segscan_float_sum_no_cancellation():
+    """Float group sums must accumulate within each segment: a small
+    group after a huge prefix must not cancel (regression for the
+    global-cumsum-difference pitfall)."""
+    from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggExec, AggFunction, AggMode, GroupingExpr, MemoryScanExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("k", DataType.int64()), Field("v", DataType.float64())])
+    # group 0 sums to ~1e15, group 1 sums to 0.001
+    data = {
+        "k": [0] * 10 + [1] * 4,
+        "v": [1e14] * 10 + [0.00025] * 4,
+    }
+    src = MemoryScanExec([[batch_from_pydict(data, schema)]], schema)
+    agg = AggExec(
+        src, AggMode.PARTIAL, [GroupingExpr(col("k"), "k")],
+        [AggFunction("sum", col("v"), "sv")],
+    )
+    out = {}
+    for b in agg.execute(0, TaskContext(0, 1)):
+        d = batch_to_pydict(b)
+        for k, s in zip(d["k"], d["sv#sum"]):
+            out[k] = s
+    assert out[0] == 1e15
+    assert abs(out[1] - 0.001) < 1e-12, out[1]
