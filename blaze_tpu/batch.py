@@ -86,6 +86,10 @@ class Column:
         return int(self.validity.shape[0])
 
     def to_device(self) -> "Column":
+        if self.dtype.kind == TypeKind.OPAQUE:
+            # opaque python objects never leave the host
+            # (≙ UserDefinedArray's JVM-object storage, uda.rs:25)
+            return self
         as_j = lambda a: None if a is None else (a if isinstance(a, jnp.ndarray) else jnp.asarray(a))
         return Column(
             self.dtype,
@@ -109,6 +113,13 @@ class Column:
         """Gather rows by index (indices must point at valid rows or be
         masked by the caller).  Nested children carry a leading row
         axis, so the same axis-0 gather applies recursively."""
+        if self.dtype.kind == TypeKind.OPAQUE:
+            h = np.asarray(indices)
+            return Column(
+                self.dtype,
+                np.take(self.data, h, axis=0),
+                np.take(np.asarray(self.validity), h),
+            )
         idx = indices
         g = lambda a: None if a is None else jnp.take(a, idx, axis=0)
         return Column(
@@ -273,6 +284,12 @@ def column_from_pylist(dtype: DataType, values: Sequence, capacity: Optional[int
         return Column(dtype, None, validity, None, tuple(children))
     if dtype.is_string:
         return column_from_strings(values, dtype=dtype, capacity=cap)
+    if k == TypeKind.OPAQUE:
+        validity = np.array([v is not None for v in values] + [False] * (cap - n), np.bool_)
+        objs = np.empty(cap, dtype=object)
+        for i, v in enumerate(values):
+            objs[i] = v
+        return Column(dtype, objs, validity)
     validity = np.array([v is not None for v in values] + [False] * (cap - n), np.bool_)
     vals = np.array(
         [_scalar_to_physical(dtype, v) for v in values] + [0] * (cap - n),
@@ -330,6 +347,10 @@ def column_to_pylist(col: Column, num_rows: int) -> List:
         return out
     if dtype.is_string:
         return strings_to_list(c, num_rows)
+    if k == TypeKind.OPAQUE:
+        return [
+            (c.data[i] if c.validity[i] else None) for i in range(num_rows)
+        ]
     out = []
     for i in range(num_rows):
         if not c.validity[i]:
@@ -520,6 +541,16 @@ def _concat_host_cols(
     return Column(dtype, data, validity, lengths)
 
 
+def split_opaque_indexes(schema: Schema):
+    """(device-capable indexes, opaque indexes) for a schema — OPAQUE
+    python-object columns are host-only and must bypass every jitted
+    kernel (≙ UserDefinedArray, uda.rs)."""
+    opq = [i for i, f in enumerate(schema.fields) if f.dtype.kind == TypeKind.OPAQUE]
+    opq_set = set(opq)
+    dev = [i for i in range(len(schema.fields)) if i not in opq_set]
+    return dev, opq
+
+
 def _col_on_device(c: Column) -> bool:
     import jax
 
@@ -610,7 +641,10 @@ def slice_rows_device(batch: RecordBatch, lo: int, n: int) -> RecordBatch:
 
     cap = bucket_capacity(max(n, 1))
     in_cap = batch.capacity
-    widths = tuple(c.data.shape[1:] for c in batch.columns if c.data is not None)
+    dev_idx, opq = split_opaque_indexes(batch.schema)
+    dev_fields = [batch.schema.fields[i] for i in dev_idx]
+    dev_cols_in = tuple(batch.columns[i] for i in dev_idx)
+    widths = tuple(c.data.shape[1:] for c in dev_cols_in if c.data is not None)
 
     def build():
         @jax.jit
@@ -622,9 +656,19 @@ def slice_rows_device(batch: RecordBatch, lo: int, n: int) -> RecordBatch:
         return kernel
 
     kernel = cached_kernel(
-        ("slice_rows", schema_key(batch.schema), in_cap, cap, widths), build
+        ("slice_rows", schema_key(Schema(dev_fields)), in_cap, cap, widths), build
     )
-    cols = list(kernel(tuple(batch.columns), lo, n))
+    dev_out = list(kernel(dev_cols_in, lo, n))
+    cols: List[Optional[Column]] = [None] * len(batch.columns)
+    for j, i in enumerate(dev_idx):
+        cols[i] = dev_out[j]
+    for i in opq:  # host-side slice+pad of opaque object columns
+        c = batch.columns[i]
+        data = np.empty(cap, dtype=object)
+        validity = np.zeros(cap, np.bool_)
+        data[:n] = np.asarray(c.data)[lo : lo + n]
+        validity[:n] = np.asarray(c.validity)[lo : lo + n]
+        cols[i] = Column(c.dtype, data, validity)
     return RecordBatch(batch.schema, cols, n)
 
 

@@ -61,6 +61,16 @@ def _ser_col(out: List[bytes], c: Column, n: int) -> None:
     validity = np.packbits(
         np.asarray(c.validity)[:n].astype(np.bool_), bitorder="little"
     ).tobytes()
+    if dtype.kind == TypeKind.OPAQUE:
+        # opaque UDAF states ride as pickle (≙ UserDefinedArray's
+        # kryo-serialized JVM objects crossing the shuffle, uda.rs)
+        import pickle
+
+        payload = pickle.dumps([c.data[i] if c.validity[i] else None for i in range(n)])
+        out.append(struct.pack("<BI", 3, len(payload)))
+        out.append(payload)
+        out.append(validity)
+        return
     if dtype.is_nested:
         out.append(struct.pack("<B", 2))
         out.append(validity)
@@ -90,7 +100,10 @@ def _ser_col(out: List[bytes], c: Column, n: int) -> None:
 def serialize_batch(batch: RecordBatch) -> bytes:
     from .. import native
 
-    if native.available() and not any(f.dtype.is_nested for f in batch.schema.fields):
+    if native.available() and not any(
+        f.dtype.is_nested or f.dtype.kind == TypeKind.OPAQUE
+        for f in batch.schema.fields
+    ):
         out = native.serialize_batch_native(batch)
         if out is not None:
             return out
@@ -114,6 +127,25 @@ def _de_col(dtype: DataType, data: bytes, off: int, n: int) -> Tuple[Column, int
     """Deserialize one column at EXACT n rows (caller pads)."""
     (tag,) = struct.unpack_from("<B", data, off)
     off += 1
+    if tag == 3:
+        assert dtype.kind == TypeKind.OPAQUE, f"wire tag 3 for {dtype!r}"
+        from .. import conf
+
+        (nbytes,) = struct.unpack_from("<I", data, off)
+        off += 4
+        if not bool(conf.ALLOW_PICKLED_UDFS.get()):
+            raise PermissionError(
+                "opaque column deserialization requires spark.blaze.udf.allowPickled"
+            )
+        import pickle
+
+        objs_list = pickle.loads(data[off : off + nbytes])
+        off += nbytes
+        validity, off = _read_bitmap(data, off, n)
+        objs = np.empty(n, dtype=object)
+        for i, v in enumerate(objs_list):
+            objs[i] = v
+        return Column(dtype, objs, validity), off
     if tag == 2:
         assert dtype.is_nested, f"wire tag 2 for non-nested {dtype!r}"
         validity, off = _read_bitmap(data, off, n)

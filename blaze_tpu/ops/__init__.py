@@ -22,6 +22,7 @@ from .joins import BroadcastJoinExec, HashJoinExec, SortMergeJoinExec
 from .window import WindowExec, WindowFunction
 from .expand import ExpandExec
 from .generate import GenerateExec
+from .object_agg import ObjectAggExec, Udaf
 from .orc_scan import OrcScanExec
 from .parquet_scan import ParquetScanExec
 from .parquet_sink import ParquetSinkExec
@@ -32,5 +33,6 @@ __all__ = [
     "LimitExec", "UnionExec", "RenameColumnsExec", "EmptyPartitionsExec",
     "DebugExec", "CoalesceBatchesExec", "BroadcastJoinExec", "HashJoinExec",
     "SortMergeJoinExec", "WindowExec", "WindowFunction", "ExpandExec",
+    "ObjectAggExec", "Udaf",
     "GenerateExec", "OrcScanExec", "ParquetScanExec", "ParquetSinkExec",
 ]

@@ -1,0 +1,161 @@
+"""Object aggregation: user-defined aggregates over opaque host states.
+
+≙ the reference's partial ``ObjectHashAggregate`` support: arbitrary
+JVM ``TypedImperativeAggregate`` states ride the native engine as
+``UserDefinedArray`` columns of opaque objects
+(``datafusion-ext-commons/src/uda.rs:25``), aggregated JVM-side, with
+the native side carrying/merging them through shuffle.  Here the host
+side is Python: a :class:`Udaf` supplies init/update/merge/finish, the
+engine evaluates group keys + inputs on device, aggregates states in a
+host dict, and OPAQUE state columns cross exchanges via the batch wire
+format (pickled, gated by ``spark.blaze.udf.allowPickled``).
+
+This is the designed fallback tier for aggregates the device layout
+cannot express (sketches, HLL, custom accumulators) — row-at-a-time on
+the host, like every UDF fallback in the reference
+(``SparkUDFWrapperContext.scala``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..batch import RecordBatch, batch_from_pydict, column_to_pylist
+from ..exprs.compile import infer_dtype, lower
+from ..exprs.ir import Expr
+from ..runtime.context import TaskContext
+from ..schema import DataType, Field, Schema
+from .agg import AggMode
+from .base import BatchStream, ExecNode
+
+
+@dataclass
+class Udaf:
+    """User-defined aggregate over opaque python states.
+
+    - ``init()`` -> state
+    - ``update(state, *arg_values)`` -> state   (None args = SQL null)
+    - ``merge(a, b)`` -> state
+    - ``finish(state)`` -> final value (matching ``result_dtype``)
+    States must be picklable to cross exchanges.
+    """
+
+    name: str
+    init: Callable[[], Any]
+    update: Callable[..., Any]
+    merge: Callable[[Any, Any], Any]
+    finish: Callable[[Any], Any]
+    args: List[Expr]
+    result_dtype: DataType
+
+
+class ObjectAggExec(ExecNode):
+    """Group-by aggregation carrying opaque states host-side.
+
+    PARTIAL: raw inputs -> (group keys, OPAQUE state) batches.
+    PARTIAL_MERGE: state batches -> merged state batches.
+    FINAL: state batches -> (group keys, finished values).
+    """
+
+    def __init__(
+        self,
+        child: ExecNode,
+        mode: AggMode,
+        groupings: Sequence,  # GroupingExpr
+        udafs: Sequence[Udaf],
+    ):
+        super().__init__([child])
+        self.mode = mode
+        self.groupings = list(groupings)
+        self.udafs = list(udafs)
+        in_schema = child.schema
+        key_fields = []
+        for g in self.groupings:
+            if mode == AggMode.PARTIAL:
+                key_fields.append(Field(g.name, infer_dtype(g.expr, in_schema)))
+            else:
+                key_fields.append(in_schema.field(g.name))
+        if mode == AggMode.FINAL:
+            out_fields = key_fields + [
+                Field(u.name, u.result_dtype) for u in self.udafs
+            ]
+        else:
+            out_fields = key_fields + [
+                Field(f"{u.name}#state", DataType.opaque()) for u in self.udafs
+            ]
+        self._schema = Schema(out_fields)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions()
+
+    def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        child = self.children[0]
+        in_schema = child.schema
+        merging = self.mode != AggMode.PARTIAL
+
+        def eval_columns(batch: RecordBatch, exprs: List[Expr]) -> List[List]:
+            cap = batch.capacity
+            env = {f.name: c for f, c in zip(in_schema.fields, batch.columns)}
+            out = []
+            for e in exprs:
+                col = lower(e, in_schema, env, cap)
+                out.append(column_to_pylist(col, batch.num_rows))
+            return out
+
+        def stream():
+            groups = {}  # key tuple -> [state, ...]
+            for batch in child.execute(partition, ctx):
+                if not ctx.is_task_running():
+                    return
+                with self.metrics.timer("elapsed_compute"):
+                    key_vals = eval_columns(batch, [g.expr for g in self.groupings])
+                    if merging:
+                        state_cols = [
+                            column_to_pylist(
+                                batch.columns[in_schema.index(f"{u.name}#state")],
+                                batch.num_rows,
+                            )
+                            for u in self.udafs
+                        ]
+                        for i in range(batch.num_rows):
+                            key = tuple(kv[i] for kv in key_vals)
+                            accs = groups.get(key)
+                            if accs is None:
+                                groups[key] = [sc[i] for sc in state_cols]
+                            else:
+                                for ui, u in enumerate(self.udafs):
+                                    accs[ui] = u.merge(accs[ui], state_cols[ui][i])
+                    else:
+                        arg_cols = [eval_columns(batch, u.args) for u in self.udafs]
+                        for i in range(batch.num_rows):
+                            key = tuple(kv[i] for kv in key_vals)
+                            accs = groups.get(key)
+                            if accs is None:
+                                accs = [u.init() for u in self.udafs]
+                                groups[key] = accs
+                            for ui, u in enumerate(self.udafs):
+                                args = [c[i] for c in arg_cols[ui]]
+                                accs[ui] = u.update(accs[ui], *args)
+            if not groups and self.groupings:
+                return
+            if not groups:  # global agg: one empty-state row
+                groups[()] = [u.init() for u in self.udafs]
+            data = {f.name: [] for f in self._schema.fields}
+            for key, accs in groups.items():
+                for g, kv in zip(self.groupings, key):
+                    data[g.name].append(kv)
+                for u, acc in zip(self.udafs, accs):
+                    if self.mode == AggMode.FINAL:
+                        data[u.name].append(u.finish(acc))
+                    else:
+                        data[f"{u.name}#state"].append(acc)
+            out = batch_from_pydict(data, self._schema)
+            self.metrics.add("output_rows", out.num_rows)
+            yield out
+
+        return stream()

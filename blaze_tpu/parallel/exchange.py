@@ -98,7 +98,9 @@ class NativeShuffleExchangeExec(ExecNode):
         import numpy as np
 
         from ..batch import RecordBatch, slice_rows_device
-        from .shuffle import RoundRobinPartitioning, _sort_by_pid
+        from .shuffle import (
+            RoundRobinPartitioning, non_opaque_cols, sort_cols_by_pid,
+        )
 
         child = self.children[0]
         n_out = self.partitioning.num_partitions
@@ -133,14 +135,16 @@ class NativeShuffleExchangeExec(ExecNode):
                     continue
                 with self.metrics.timer("elapsed_compute"):
                     if is_hash:
-                        pids = writer._hash_pids(tuple(b.columns), b.num_rows)
+                        pids = writer._hash_pids(
+                            non_opaque_cols(self.schema, b.columns), b.num_rows
+                        )
                     elif is_rr:
                         pids = (jnp.arange(b.capacity, dtype=jnp.int32) + rr) % n_out
                         rr = (rr + b.num_rows) % n_out
                     else:
                         pids = jnp.zeros(b.capacity, jnp.int32)
-                    sorted_cols, counts = _sort_by_pid(
-                        tuple(b.columns), pids, n_out, b.num_rows
+                    sorted_cols, counts = sort_cols_by_pid(
+                        self.schema, b.columns, pids, n_out, b.num_rows
                     )
                 local.append(
                     (RecordBatch(self.schema, list(sorted_cols), b.num_rows), counts)

@@ -26,7 +26,8 @@ from .shuffle import (
     Partitioning,
     RoundRobinPartitioning,
     ShuffleWriterExec,
-    _sort_by_pid,
+    non_opaque_cols,
+    sort_cols_by_pid,
 )
 
 
@@ -86,14 +87,17 @@ class RssShuffleWriterExec(ExecNode):
                         return
                     with self.metrics.timer("elapsed_compute"):
                         if isinstance(self.partitioning, HashPartitioning) and n_out > 1:
-                            pids = self._file_twin._hash_pids(tuple(batch.columns), batch.num_rows)
+                            pids = self._file_twin._hash_pids(
+                                non_opaque_cols(self.schema, batch.columns),
+                                batch.num_rows,
+                            )
                         elif isinstance(self.partitioning, RoundRobinPartitioning) and n_out > 1:
                             pids = (jnp.arange(batch.capacity, dtype=jnp.int32) + rr) % n_out
                             rr = (rr + batch.num_rows) % n_out
                         else:
                             pids = jnp.zeros(batch.capacity, jnp.int32)
-                        sorted_cols, counts = _sort_by_pid(
-                            tuple(batch.columns), pids, n_out, batch.num_rows
+                        sorted_cols, counts = sort_cols_by_pid(
+                            self.schema, batch.columns, pids, n_out, batch.num_rows
                         )
                     host = RecordBatch(self.schema, list(sorted_cols), batch.num_rows).to_host()
                     counts_np = np.asarray(counts)

@@ -73,7 +73,8 @@ class RoundRobinPartitioning(Partitioning):
 
 @partial(jax.jit, static_argnames=("n_out",))
 def _sort_by_pid(cols, pids, n_out, num_rows):
-    """Sort rows by partition id; returns (sorted cols, counts[n_out])."""
+    """Sort rows by partition id; returns (sorted cols, counts[n_out],
+    sort permutation)."""
     cap = pids.shape[0]
     live = jnp.arange(cap) < num_rows
     key = jnp.where(live, pids.astype(jnp.uint32), jnp.uint32(n_out))
@@ -84,7 +85,38 @@ def _sort_by_pid(cols, pids, n_out, num_rows):
         live.astype(jnp.int64), jnp.clip(pids, 0, n_out - 1).astype(jnp.int32),
         num_segments=n_out,
     )
-    return sorted_cols, counts
+    return sorted_cols, counts, sidx
+
+
+def non_opaque_cols(schema: Schema, cols) -> tuple:
+    """Subset of columns that can enter jitted kernels (opaque python
+    object columns are host-only — ≙ UserDefinedArray, uda.rs)."""
+    from ..batch import split_opaque_indexes
+
+    dev_idx, _ = split_opaque_indexes(schema)
+    return tuple(cols[i] for i in dev_idx)
+
+
+def sort_cols_by_pid(schema: Schema, cols, pids, n_out: int, num_rows: int):
+    """Pid-sort a batch's columns, routing OPAQUE columns host-side
+    around the jitted kernel (one sidx sync when any are present).
+    Returns (sorted cols in schema order, counts)."""
+    from ..batch import split_opaque_indexes
+
+    dev_idx, opq = split_opaque_indexes(schema)
+    if not opq:
+        s, counts, _ = _sort_by_pid(tuple(cols), pids, n_out, num_rows)
+        return list(s), counts
+    s_dev, counts, sidx = _sort_by_pid(
+        tuple(cols[i] for i in dev_idx), pids, n_out, num_rows
+    )
+    h = np.asarray(sidx)
+    out: List = [None] * len(cols)
+    for j, i in enumerate(dev_idx):
+        out[i] = s_dev[j]
+    for i in opq:
+        out[i] = cols[i].take(h)
+    return out, counts
 
 
 # ------------------------------------------------------------- repartition
@@ -231,7 +263,12 @@ class ShuffleWriterExec(ExecNode):
         self.index_path = index_path
         self.partition_lengths: Optional[List[int]] = None
         if isinstance(partitioning, HashPartitioning):
-            schema = child.schema
+            from ..batch import split_opaque_indexes
+
+            # pid kernels see only the non-opaque columns (keys are
+            # never opaque; opaque columns bypass jit entirely)
+            dev_idx, _ = split_opaque_indexes(child.schema)
+            schema = Schema([child.schema.fields[i] for i in dev_idx])
             exprs = list(partitioning.exprs)
             n_out = partitioning.num_partitions
 
@@ -282,14 +319,17 @@ class ShuffleWriterExec(ExecNode):
                         return
                     with self.metrics.timer("elapsed_compute"):
                         if isinstance(self.partitioning, HashPartitioning) and n_out > 1:
-                            pids = self._hash_pids(tuple(batch.columns), batch.num_rows)
+                            pids = self._hash_pids(
+                                non_opaque_cols(self.schema, batch.columns),
+                                batch.num_rows,
+                            )
                         elif isinstance(self.partitioning, RoundRobinPartitioning) and n_out > 1:
                             pids = (jnp.arange(batch.capacity, dtype=jnp.int32) + rr) % n_out
                             rr = (rr + batch.num_rows) % n_out
                         else:
                             pids = jnp.zeros(batch.capacity, jnp.int32)
-                        sorted_cols, counts = _sort_by_pid(
-                            tuple(batch.columns), pids, n_out, batch.num_rows
+                        sorted_cols, counts = sort_cols_by_pid(
+                            self.schema, batch.columns, pids, n_out, batch.num_rows
                         )
                     host = RecordBatch(self.schema, list(sorted_cols), batch.num_rows).to_host()
                     rep.insert_sorted(host, np.asarray(counts))

@@ -60,6 +60,10 @@ class TypeKind(enum.Enum):
     ARRAY = 13
     MAP = 14
     STRUCT = 15
+    # host-only opaque python objects (≙ reference UserDefinedArray,
+    # datafusion-ext-commons/src/uda.rs:25 — an Arrow array of opaque
+    # JVM objects carrying partial ObjectHashAggregate states)
+    OPAQUE = 16
 
 
 _FIXED_NP = {
@@ -152,6 +156,12 @@ class DataType:
         return DataType(TypeKind.NULL)
 
     @staticmethod
+    def opaque() -> "DataType":
+        """Host-only opaque python objects (UDAF partial states;
+        ≙ UserDefinedArray, uda.rs:25)."""
+        return DataType(TypeKind.OPAQUE)
+
+    @staticmethod
     def array(elem: "DataType", max_elems: int = 16) -> "DataType":
         return DataType(TypeKind.ARRAY, elem=elem, max_elems=max_elems)
 
@@ -195,6 +205,8 @@ class DataType:
             raise TypeError(f"nested type {self!r} has no single buffer dtype")
         if self.is_string:
             return np.dtype(np.uint8)
+        if self.kind == TypeKind.OPAQUE:
+            return np.dtype(object)
         return np.dtype(_FIXED_NP[self.kind])
 
     def __repr__(self) -> str:  # compact, e.g. decimal(12,2), string[64]

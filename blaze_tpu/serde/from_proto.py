@@ -308,6 +308,22 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
             g.outer,
             g.keep_input,
         )
+    if kind == "object_agg":
+        from .. import conf
+        from ..ops.agg import GroupingExpr
+        from ..ops.object_agg import ObjectAggExec
+
+        o = n.object_agg
+        if not bool(conf.ALLOW_PICKLED_UDFS.get()):
+            raise PermissionError(
+                "pickled UDAF payload rejected: set spark.blaze.udf.allowPickled"
+            )
+        return ObjectAggExec(
+            plan_from_proto(o.input),
+            AggMode(o.mode),
+            [GroupingExpr(expr_from_proto(g.expr), g.name) for g in o.groupings],
+            pickle.loads(o.udafs_payload),
+        )
     if kind == "bloom_filter_agg":
         from ..ops.bloom_agg import BloomFilterAggExec
 

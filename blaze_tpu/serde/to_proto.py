@@ -292,6 +292,14 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
         out.broadcast_join_build_hash_map.input.CopyFrom(plan_to_proto(node.children[0]))
         for e in node.keys:
             out.broadcast_join_build_hash_map.keys.add().CopyFrom(expr_to_proto(e))
+    elif type(node).__name__ == "ObjectAggExec":
+        out.object_agg.input.CopyFrom(plan_to_proto(node.children[0]))
+        out.object_agg.mode = node.mode.value
+        for g in node.groupings:
+            ne = out.object_agg.groupings.add()
+            ne.expr.CopyFrom(expr_to_proto(g.expr))
+            ne.name = g.name
+        out.object_agg.udafs_payload = pickle.dumps(node.udafs)
     elif type(node).__name__ == "BloomFilterAggExec":
         out.bloom_filter_agg.input.CopyFrom(plan_to_proto(node.children[0]))
         if node.expr is not None:

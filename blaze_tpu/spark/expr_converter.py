@@ -14,6 +14,7 @@ the way ``convertExprWithFallback`` wraps into a JVM-callback UDF.
 
 from __future__ import annotations
 
+import contextvars
 import re
 from typing import Any, Dict, List, Optional
 
@@ -28,6 +29,16 @@ from .plan_json import SparkNode, expr_id
 
 class UnsupportedSparkExpr(Exception):
     """Raised for an expression class this converter cannot map."""
+
+
+# Set by the strategy layer during plan conversion: called with a
+# ScalarSubquery's embedded plan (SparkNode) and expected DataType,
+# returns the evaluated scalar as a typed Lit.  ≙ the reference's
+# SparkScalarSubqueryWrapperExpr: the driver evaluates the subquery and
+# the native side sees a literal (blaze.proto:10001).
+SUBQUERY_RESOLVER: contextvars.ContextVar[Optional[Any]] = contextvars.ContextVar(
+    "blaze_subquery_resolver", default=None
+)
 
 
 # --------------------------------------------------------------- data types
@@ -279,8 +290,17 @@ def convert_expr(node: SparkNode) -> Expr:
             exprs.append(convert_expr(kids[i + 1]))
         return NamedStruct(names, exprs)
     if name == "ScalarSubquery":
+        resolver = SUBQUERY_RESOLVER.get()
+        sub_plan = node.fields.get("plan")
+        if resolver is not None and sub_plan:
+            from .plan_json import _parse_tree
+
+            dtype = None
+            if "dataType" in node.fields:
+                dtype = convert_data_type(node.fields["dataType"])
+            return resolver(_parse_tree(sub_plan), dtype)
         raise UnsupportedSparkExpr(
-            "ScalarSubquery must be pre-evaluated by the driver "
+            "ScalarSubquery without a driver-side resolver "
             "(≙ SparkScalarSubqueryWrapperExpr)"
         )
     if name == "PromotePrecision":

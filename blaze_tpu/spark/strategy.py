@@ -56,6 +56,9 @@ class _StrategyContext(ConversionContext):
         if id(node) in self.forced_never:
             self.tags[id(node)] = ConvertTag.NEVER
             return self._fallback(node)
+        from .expr_converter import SUBQUERY_RESOLVER
+
+        token = SUBQUERY_RESOLVER.set(self._resolve_subquery)
         try:
             out = convert_exec(node, self)
             self.tags[id(node)] = ConvertTag.ALWAYS
@@ -64,6 +67,30 @@ class _StrategyContext(ConversionContext):
             self.tags[id(node)] = ConvertTag.NEVER
             logger.info("falling back for %s: %s", node.name, e)
             return self._fallback(node)
+        finally:
+            SUBQUERY_RESOLVER.reset(token)
+
+    def _resolve_subquery(self, sub_plan: SparkNode, dtype):
+        """Eagerly run a scalar subquery's plan and inject the value as
+        a typed literal (≙ SparkScalarSubqueryWrapperExpr: the JVM
+        evaluates, the engine sees a literal)."""
+        from ..batch import batch_to_pydict
+        from ..exprs.ir import Lit
+        from ..runtime.context import TaskContext
+
+        plan = _StrategyContext(self, set()).convert(sub_plan)
+        value = None
+        for p in range(plan.num_partitions()):
+            for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+                d = batch_to_pydict(b)
+                col = next(iter(d.values()))
+                if col:
+                    value = col[0]
+                    break
+            if value is not None:
+                break
+        t = dtype or plan.schema.fields[0].dtype
+        return Lit(value, t)
 
     def _fallback(self, node: SparkNode) -> ExecNode:
         if self.host_fallback is None:

@@ -1,0 +1,135 @@
+"""OPAQUE columns + ObjectAggExec: the UserDefinedArray analogue
+(≙ datafusion-ext-commons/src/uda.rs + partial ObjectHashAggregate).
+
+Opaque python UDAF states must survive batch serde, shuffle exchanges,
+and the TaskDefinition boundary, and two-stage aggregation must match
+a host oracle."""
+
+import numpy as np
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col
+from blaze_tpu.io import deserialize_batch, serialize_batch
+from blaze_tpu.ops import AggMode, GroupingExpr, MemoryScanExec, ObjectAggExec, Udaf
+from blaze_tpu.parallel import HashPartitioning, NativeShuffleExchangeExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+
+
+# module-level functions: UDAF callables must be picklable to cross
+# the TaskDefinition boundary (the Udaf docstring's contract)
+def _set_init():
+    return set()
+
+
+def _set_update(s, v):
+    return s if v is None else (s | {v})
+
+
+def _set_merge(a, b):
+    return a | (b or set())
+
+
+def _set_finish(s):
+    return len(s)
+
+
+def _sketch_udaf():
+    """A set-union 'sketch' (stand-in for HLL/TDigest-class states)."""
+    return Udaf(
+        name="distinct_set",
+        init=_set_init,
+        update=_set_update,
+        merge=_set_merge,
+        finish=_set_finish,
+        args=[col("v")],
+        result_dtype=DataType.int64(),
+    )
+
+
+SCHEMA = Schema([Field("k", DataType.int64()), Field("v", DataType.int64())])
+
+
+def make_parts(n_parts=3, n=150, seed=5):
+    rng = np.random.RandomState(seed)
+    parts, raw = [], []
+    for _ in range(n_parts):
+        d = {
+            "k": [int(x) for x in rng.randint(0, 6, n)],
+            "v": [int(x) if x % 9 else None for x in rng.randint(0, 25, n)],
+        }
+        raw.append(d)
+        parts.append([batch_from_pydict(d, SCHEMA)])
+    return parts, raw
+
+
+def test_opaque_column_serde_roundtrip():
+    schema = Schema([Field("s", DataType.opaque())])
+    b = batch_from_pydict({"s": [{1, 2}, None, {"x": [3]}, (4, 5)]}, schema)
+    b2 = deserialize_batch(serialize_batch(b), schema)
+    assert batch_to_pydict(b2) == {"s": [{1, 2}, None, {"x": [3]}, (4, 5)]}
+
+
+def test_opaque_deser_gated_by_conf():
+    schema = Schema([Field("s", DataType.opaque())])
+    data = serialize_batch(batch_from_pydict({"s": [{1}]}, schema))
+    conf.ALLOW_PICKLED_UDFS.set(False)
+    try:
+        with pytest.raises(PermissionError):
+            deserialize_batch(data, schema)
+    finally:
+        conf.ALLOW_PICKLED_UDFS.set(True)
+
+
+def test_object_agg_two_stage_matches_oracle():
+    """partial(object states) -> hash exchange -> final(finish) ==
+    exact distinct counts per group."""
+    parts, raw = make_parts()
+    src = MemoryScanExec(parts, SCHEMA)
+    partial = ObjectAggExec(
+        src, AggMode.PARTIAL, [GroupingExpr(col("k"), "k")], [_sketch_udaf()]
+    )
+    ex = NativeShuffleExchangeExec(partial, HashPartitioning([col("k")], 2))
+    final = ObjectAggExec(
+        ex, AggMode.FINAL, [GroupingExpr(col("k"), "k")], [_sketch_udaf()]
+    )
+    got = {}
+    for p in range(2):
+        for b in final.execute(p, TaskContext(p, 2)):
+            d = batch_to_pydict(b)
+            for k, n in zip(d["k"], d["distinct_set"]):
+                assert k not in got
+                got[k] = n
+    oracle = {}
+    for d in raw:
+        for k, v in zip(d["k"], d["v"]):
+            if v is not None:
+                oracle.setdefault(k, set()).add(v)
+    assert got == {k: len(s) for k, s in oracle.items()}
+
+
+def test_object_agg_over_task_definition():
+    """The pickled-UDAF plan node crosses the protobuf boundary."""
+    from blaze_tpu.serde.from_proto import run_task
+    from blaze_tpu.serde.to_proto import task_definition
+
+    parts, raw = make_parts(n_parts=1)
+    src = MemoryScanExec(parts, SCHEMA)
+    partial = ObjectAggExec(
+        src, AggMode.PARTIAL, [GroupingExpr(col("k"), "k")], [_sketch_udaf()]
+    )
+    final = ObjectAggExec(
+        partial, AggMode.FINAL, [GroupingExpr(col("k"), "k")], [_sketch_udaf()]
+    )
+    td = task_definition(final, "t", 0, 0)
+    got = {}
+    for b in run_task(td):
+        d = batch_to_pydict(b)
+        got.update(zip(d["k"], d["distinct_set"]))
+    oracle = {}
+    for k, v in zip(raw[0]["k"], raw[0]["v"]):
+        if v is not None:
+            oracle.setdefault(k, set()).add(v)
+    assert got == {k: len(s) for k, s in oracle.items()}

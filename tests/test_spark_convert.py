@@ -376,6 +376,34 @@ def test_inefficient_convert_removed():
     assert "MadeUpOuterExec" in fallback_calls
 
 
+def test_scalar_subquery_evaluated_driver_side():
+    """ScalarSubquery's embedded plan runs eagerly at conversion and
+    its value enters the main plan as a typed literal
+    (≙ SparkScalarSubqueryWrapperExpr, blaze.proto:10001)."""
+    sess, data = make_session()
+    # subquery: max(l_extendedprice) over the same table
+    sub = F.hash_agg(
+        [],
+        [F.agg_expr(F.max_(F.attr("l_extendedprice", 2)), "Complete", 50)],
+        F.scan("lineitem", [F.attr("l_extendedprice", 2)]),
+        result=[F.alias(F.attr("mx", 50), "mx", 51)],
+    )
+    subquery = F.T(
+        F.X + "ScalarSubquery",
+        plan=F.flatten(sub),
+        exprId=F.eid(60),
+        dataType="long",
+    )
+    # main: rows where extendedprice == (select max(...))
+    main = F.filter_(
+        F.binop("EqualTo", F.attr("l_extendedprice", 2), subquery),
+        F.scan("lineitem", [F.attr("l_quantity", 1), F.attr("l_extendedprice", 2)]),
+    )
+    out = sess.execute(F.flatten(main))
+    mx = max(data["l_extendedprice"])
+    assert out["#2"] and all(v == mx for v in out["#2"])
+
+
 def test_op_disable_flag_forces_fallback():
     from blaze_tpu import conf
 
