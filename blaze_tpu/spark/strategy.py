@@ -51,14 +51,16 @@ class _StrategyContext(ConversionContext):
         super().__init__(base.catalog, base.default_parallelism, base.host_fallback)
         self.forced_never = forced_never
         self.tags: Dict[int, ConvertTag] = {}
+        # share the subquery memo across fixpoint iterations: each
+        # rebuild (and each trial conversion that later falls back)
+        # must not re-execute subquery plans
+        self._subquery_memo = getattr(base, "_subquery_memo", {})
+        base._subquery_memo = self._subquery_memo
 
     def convert(self, node: SparkNode) -> ExecNode:
         if id(node) in self.forced_never:
             self.tags[id(node)] = ConvertTag.NEVER
             return self._fallback(node)
-        from .expr_converter import SUBQUERY_RESOLVER
-
-        token = SUBQUERY_RESOLVER.set(self._resolve_subquery)
         try:
             out = convert_exec(node, self)
             self.tags[id(node)] = ConvertTag.ALWAYS
@@ -67,13 +69,14 @@ class _StrategyContext(ConversionContext):
             self.tags[id(node)] = ConvertTag.NEVER
             logger.info("falling back for %s: %s", node.name, e)
             return self._fallback(node)
-        finally:
-            SUBQUERY_RESOLVER.reset(token)
 
     def _resolve_subquery(self, sub_plan: SparkNode, dtype):
         """Eagerly run a scalar subquery's plan and inject the value as
         a typed literal (≙ SparkScalarSubqueryWrapperExpr: the JVM
-        evaluates, the engine sees a literal)."""
+        evaluates, the engine sees a literal).  Memoized per subquery
+        node across fixpoint rebuilds."""
+        if id(sub_plan) in self._subquery_memo:
+            return self._subquery_memo[id(sub_plan)]
         from ..batch import batch_to_pydict
         from ..exprs.ir import Lit
         from ..runtime.context import TaskContext
@@ -90,7 +93,9 @@ class _StrategyContext(ConversionContext):
             if value is not None:
                 break
         t = dtype or plan.schema.fields[0].dtype
-        return Lit(value, t)
+        out = Lit(value, t)
+        self._subquery_memo[id(sub_plan)] = out
+        return out
 
     def _fallback(self, node: SparkNode) -> ExecNode:
         if self.host_fallback is None:
@@ -106,11 +111,16 @@ def apply_strategy(
 ) -> Dict[int, ConvertTag]:
     """Tag-only pass (diagnostics / tests): run a trial conversion and
     return the per-node tags, without keeping the converted plan."""
+    from .expr_converter import SUBQUERY_RESOLVER
+
     sctx = _StrategyContext(ctx, set())
+    token = SUBQUERY_RESOLVER.set(sctx._resolve_subquery)
     try:
         sctx.convert(root)
     except UnsupportedSparkExec:
         pass
+    finally:
+        SUBQUERY_RESOLVER.reset(token)
     return sctx.tags
 
 
@@ -118,11 +128,19 @@ def convert_spark_plan(
     root: SparkNode, ctx: ConversionContext, rename_root: bool = True
 ) -> ExecNode:
     """Full conversion: trial-convert with fallback boundaries, then
-    remove inefficient converts to a fixpoint and rebuild."""
+    remove inefficient converts to a fixpoint and rebuild.  The
+    subquery resolver installs ONCE around the whole conversion (not
+    per node) and memoizes per subquery plan."""
+    from .expr_converter import SUBQUERY_RESOLVER
+
     forced: Set[int] = set()
     for _ in range(16):  # fixpoint ≙ removeInefficientConverts loop
         sctx = _StrategyContext(ctx, forced)
-        plan = sctx.convert(root)
+        token = SUBQUERY_RESOLVER.set(sctx._resolve_subquery)
+        try:
+            plan = sctx.convert(root)
+        finally:
+            SUBQUERY_RESOLVER.reset(token)
         added = _inefficient_converts(root, sctx.tags, forced)
         if not added:
             break

@@ -34,6 +34,12 @@ EDUCATIONS = [
 MARITALS = ["M", "S", "D", "W", "U"]
 GENDERS = ["M", "F"]
 STORE_NAMES = ["ese", "ought", "able", "pri", "bar", "anti"]
+STATES = ["TN", "SD", "AL", "GA", "OH"]
+CLASSES = [
+    "accessories", "classical", "fiction", "shirts", "birdal",
+    "dresses", "football", "fragrances", "pants", "pop",
+    "reference", "romance", "self-help", "wallpaper", "personal", "maternity",
+]
 
 DATE_SK_BASE = 2450815  # arbitrary julian-like base, spec-style
 D_FIRST = (1998, 1, 1)
@@ -88,9 +94,13 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
     if name == "store":
         n = len(STORE_NAMES)
         data, lengths = _encode_options(STORE_NAMES, 16)
+        st_data, st_len = _encode_options([STATES[i % len(STATES)] for i in range(n)], 8)
+        co_data, co_len = _encode_options(["Unknown"] * n, 16)
         return {
             "s_store_sk": (np.arange(1, n + 1, dtype=np.int64), None),
             "s_store_name": (data, lengths),
+            "s_state": (st_data, st_len),
+            "s_company_name": (co_data, co_len),
         }
     if name == "promotion":
         n = max(5, int(300 * scale))
@@ -137,11 +147,17 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         b_data, b_len = _encode_options(brands, 32)
         cat_id = rng.randint(1, len(CATEGORIES) + 1, n).astype(np.int32)
         c_data, c_len = _encode_options([CATEGORIES[c - 1] for c in cat_id], 16)
+        class_id = rng.randint(1, len(CLASSES) + 1, n).astype(np.int32)
+        cl_data, cl_len = _encode_options([CLASSES[c - 1] for c in class_id], 16)
+        desc_data, desc_len = _encode_options([f"desc of item {k % 97}" for k in range(n)], 32)
         return {
             "i_item_sk": (sk, None),
             "i_item_id": (id_data, id_len),
+            "i_item_desc": (desc_data, desc_len),
             "i_brand_id": (brand_id, None),
             "i_brand": (b_data, b_len),
+            "i_class_id": (class_id, None),
+            "i_class": (cl_data, cl_len),
             "i_category_id": (cat_id, None),
             "i_category": (c_data, c_len),
             "i_manufact_id": (rng.randint(1, 200, n).astype(np.int32), None),

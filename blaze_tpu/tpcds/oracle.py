@@ -163,3 +163,160 @@ def oracle_q96(tables):
         if int(t_sk[i]) in t_set and int(h_sk[i]) in h_set and int(s_sk[i]) in s_set:
             cnt += 1
     return cnt
+
+
+def oracle_q27(tables):
+    """ROLLUP(i_item_id, s_state): returns {(item_id|None, state|None,
+    g_id): (avg_qty, avg_list, avg_coupon, avg_sales)} with decimal
+    averages as unscaled ints (scale+4, HALF_UP)."""
+    cd = tables["customer_demographics"]
+    cd_ok = (
+        _s_eq(cd, "cd_gender", "M")
+        & _s_eq(cd, "cd_marital_status", "S")
+        & _s_eq(cd, "cd_education_status", "College")
+    )
+    cd_set = set(cd["cd_demo_sk"][0][cd_ok].tolist())
+    dd = tables["date_dim"]
+    d_set = set(dd["d_date_sk"][0][dd["d_year"][0] == 2002].tolist())
+    st = tables["store"]
+    states = _sv(st, "s_state")
+    state_by_sk = {
+        int(sk): states[i]
+        for i, sk in enumerate(st["s_store_sk"][0])
+        if states[i] in ("TN", "SD", "AL", "GA", "OH")
+    }
+    it = tables["item"]
+    item_id_by_sk = dict(zip(it["i_item_sk"][0].tolist(), _sv(it, "i_item_id")))
+
+    ss = tables["store_sales"]
+    cols = [ss[c][0] for c in (
+        "ss_cdemo_sk", "ss_sold_date_sk", "ss_store_sk", "ss_item_sk",
+        "ss_quantity", "ss_list_price", "ss_coupon_amt", "ss_sales_price",
+    )]
+    acc: Dict[tuple, list] = {}
+    for i in range(cols[0].shape[0]):
+        if int(cols[0][i]) not in cd_set or int(cols[1][i]) not in d_set:
+            continue
+        state = state_by_sk.get(int(cols[2][i]))
+        if state is None:
+            continue
+        iid = item_id_by_sk.get(int(cols[3][i]))
+        if iid is None:
+            continue
+        row = tuple(int(c[i]) for c in cols[4:])
+        for key in ((iid, state, 0), (iid, None, 1), (None, None, 3)):
+            acc.setdefault(key, []).append(row)
+
+    out = {}
+    for key, rows in acc.items():
+        n = len(rows)
+        avg_qty = float(sum(r[0] for r in rows)) / n
+
+        def avg_dec(idx):
+            f = float(sum(r[idx] for r in rows)) * float(10**4) / n
+            return int(_round_half_up(np.array([f]))[0])
+
+        out[key] = (avg_qty, avg_dec(1), avg_dec(2), avg_dec(3))
+    return out
+
+
+def oracle_q89(tables):
+    """{(cat, cls, brand, store, company, moy): (sum, avg)} for rows
+    passing the |sum-avg|/avg > 0.1 filter; sums unscaled ints, avg as
+    unscaled int at scale+4."""
+    it = tables["item"]
+    cats = _sv(it, "i_category")
+    clss = _sv(it, "i_class")
+    brands = _sv(it, "i_brand")
+    a = {("Books", "accessories"), ("Books", "reference"), ("Books", "football"),
+         ("Electronics", "accessories"), ("Electronics", "reference"), ("Electronics", "football"),
+         ("Sports", "accessories"), ("Sports", "reference"), ("Sports", "football")}
+    b = {(c, k) for c in ("Men", "Jewelry", "Women") for k in ("shirts", "birdal", "dresses")}
+    keep = a | b
+    item_by_sk = {}
+    for i, sk in enumerate(it["i_item_sk"][0]):
+        if (cats[i], clss[i]) in keep:
+            item_by_sk[int(sk)] = (cats[i], clss[i], brands[i])
+    dd = tables["date_dim"]
+    moy_by_sk = {
+        int(sk): int(m)
+        for sk, m, y in zip(dd["d_date_sk"][0], dd["d_moy"][0], dd["d_year"][0])
+        if y == 1999
+    }
+    st = tables["store"]
+    store_by_sk = dict(zip(
+        st["s_store_sk"][0].tolist(),
+        zip(_sv(st, "s_store_name"), _sv(st, "s_company_name")),
+    ))
+    ss = tables["store_sales"]
+    sums: Dict[tuple, int] = {}
+    i_sk = ss["ss_item_sk"][0]; d_sk = ss["ss_sold_date_sk"][0]
+    s_sk = ss["ss_store_sk"][0]; price = ss["ss_sales_price"][0]
+    for i in range(i_sk.shape[0]):
+        itm = item_by_sk.get(int(i_sk[i]))
+        if itm is None:
+            continue
+        moy = moy_by_sk.get(int(d_sk[i]))
+        if moy is None:
+            continue
+        stn = store_by_sk.get(int(s_sk[i]))
+        if stn is None:
+            continue
+        key = itm + stn + (moy,)
+        sums[key] = sums.get(key, 0) + int(price[i])
+    # window avg over (cat, brand, store, company)
+    parts: Dict[tuple, list] = {}
+    for key, s in sums.items():
+        cat, cls, brand, stn, co, moy = key
+        parts.setdefault((cat, brand, stn, co), []).append(s)
+    out = {}
+    for key, s in sums.items():
+        cat, cls, brand, stn, co, moy = key
+        vals = parts[(cat, brand, stn, co)]
+        # engine: avg of decimal(7,2) sums -> scale+4 unscaled, HALF_UP
+        avg_unscaled = int(_round_half_up(np.array(
+            [float(sum(vals)) * float(10**4) / len(vals)]
+        ))[0])
+        sum_f = float(s) / 100.0
+        avg_f = avg_unscaled / 1e6
+        if avg_f != 0 and abs(sum_f - avg_f) / avg_f > 0.1:
+            out[key] = (s, avg_unscaled)
+    return out
+
+
+def oracle_q98(tables):
+    """{(item_id, desc, cat, cls, price): (revenue, ratio)} over the
+    1999-02-22..1999-03-24 date window and 3 categories."""
+    import datetime as _dt
+
+    dd = tables["date_dim"]
+    epoch = _dt.date(1970, 1, 1)
+    lo = (_dt.date(1999, 2, 22) - epoch).days
+    hi = (_dt.date(1999, 3, 24) - epoch).days
+    d_ok = (dd["d_date"][0] >= lo) & (dd["d_date"][0] <= hi)
+    d_set = set(dd["d_date_sk"][0][d_ok].tolist())
+    it = tables["item"]
+    cats = _sv(it, "i_category")
+    item_by_sk = {}
+    for i, sk in enumerate(it["i_item_sk"][0]):
+        if cats[i] in ("Sports", "Books", "Home"):
+            item_by_sk[int(sk)] = (
+                _sv(it, "i_item_id")[i], _sv(it, "i_item_desc")[i],
+                cats[i], _sv(it, "i_class")[i], int(it["i_current_price"][0][i]),
+            )
+    ss = tables["store_sales"]
+    sums: Dict[tuple, int] = {}
+    i_sk = ss["ss_item_sk"][0]; d_sk = ss["ss_sold_date_sk"][0]
+    price = ss["ss_ext_sales_price"][0]
+    for i in range(i_sk.shape[0]):
+        itm = item_by_sk.get(int(i_sk[i]))
+        if itm is None or int(d_sk[i]) not in d_set:
+            continue
+        sums[itm] = sums.get(itm, 0) + int(price[i])
+    class_total: Dict[str, int] = {}
+    for itm, s in sums.items():
+        class_total[itm[3]] = class_total.get(itm[3], 0) + s
+    return {
+        itm: (s, (float(s) * 100.0) / float(class_total[itm[3]]))
+        for itm, s in sums.items()
+    }

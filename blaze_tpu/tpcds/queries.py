@@ -163,13 +163,194 @@ def q96(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     return two_stage_agg(j, [], [AggFunction("count_star", None, "cnt")], n_parts)
 
 
+def q27(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """ROLLUP(i_item_id, s_state) — exercises ExpandExec + grouping-id
+    the way Spark plans rollups (Expand with null-filled projections)."""
+    from ..exprs.ir import Lit
+    from ..ops import ExpandExec
+    from ..schema import DataType
+
+    cd = FilterExec(
+        t["customer_demographics"],
+        (col("cd_gender") == lit("M"))
+        & (col("cd_marital_status") == lit("S"))
+        & (col("cd_education_status") == lit("College")),
+    )
+    cd_p = ProjectExec(cd, [col("cd_demo_sk")])
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2002))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    st = FilterExec(
+        t["store"],
+        col("s_state").isin(lit("TN"), lit("SD"), lit("AL"), lit("GA"), lit("OH")),
+    )
+    st_p = ProjectExec(st, [col("s_store_sk"), col("s_state")])
+    j = broadcast_join(cd_p, t["store_sales"], [col("cd_demo_sk")], [col("ss_cdemo_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(dt_p, j, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id")])
+    j = broadcast_join(it, j, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    # rollup = Expand with (item,state,0) (item,null,1) (null,null,3)
+    passthrough = [col("ss_quantity"), col("ss_list_price"), col("ss_coupon_amt"), col("ss_sales_price")]
+    null_s16 = Lit(None, DataType.string(16))
+    null_s8 = Lit(None, DataType.string(8))
+    expand = ExpandExec(
+        j,
+        [
+            passthrough + [col("i_item_id"), col("s_state"), lit(0)],
+            passthrough + [col("i_item_id"), null_s8, lit(1)],
+            passthrough + [null_s16, null_s8, lit(3)],
+        ],
+        ["ss_quantity", "ss_list_price", "ss_coupon_amt", "ss_sales_price",
+         "i_item_id", "s_state", "g_id"],
+    )
+    agg = two_stage_agg(
+        expand,
+        [GroupingExpr(col("i_item_id"), "i_item_id"),
+         GroupingExpr(col("s_state"), "s_state"),
+         GroupingExpr(col("g_id"), "g_id")],
+        [
+            AggFunction("avg", col("ss_quantity"), "agg1"),
+            AggFunction("avg", col("ss_list_price"), "agg2"),
+            AggFunction("avg", col("ss_coupon_amt"), "agg3"),
+            AggFunction("avg", col("ss_sales_price"), "agg4"),
+        ],
+        n_parts,
+    )
+    return single_sorted(
+        agg, [SortField(col("i_item_id")), SortField(col("s_state"))], fetch=100
+    )
+
+
+def q89(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Monthly brand sales vs yearly store average — WindowExec avg
+    over the whole partition + CASE-guarded ratio filter."""
+    from ..exprs.ir import Case, func
+    from ..ops import WindowExec, WindowFunction
+    from ..parallel import NativeShuffleExchangeExec, SinglePartitioning
+    from ..schema import DataType
+
+    cat_a = col("i_category").isin(lit("Books"), lit("Electronics"), lit("Sports"))
+    cls_a = col("i_class").isin(lit("accessories"), lit("reference"), lit("football"))
+    cat_b = col("i_category").isin(lit("Men"), lit("Jewelry"), lit("Women"))
+    cls_b = col("i_class").isin(lit("shirts"), lit("birdal"), lit("dresses"))
+    it = FilterExec(t["item"], (cat_a & cls_a) | (cat_b & cls_b))
+    it_p = ProjectExec(it, [col("i_item_sk"), col("i_category"), col("i_class"), col("i_brand")])
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(1999))
+    dt_p = ProjectExec(dt, [col("d_date_sk"), col("d_moy")])
+    st_p = ProjectExec(t["store"], [col("s_store_sk"), col("s_store_name"), col("s_company_name")])
+    j = broadcast_join(it_p, t["store_sales"], [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(dt_p, j, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("i_category"), "i_category"),
+         GroupingExpr(col("i_class"), "i_class"),
+         GroupingExpr(col("i_brand"), "i_brand"),
+         GroupingExpr(col("s_store_name"), "s_store_name"),
+         GroupingExpr(col("s_company_name"), "s_company_name"),
+         GroupingExpr(col("d_moy"), "d_moy")],
+        [AggFunction("sum", col("ss_sales_price"), "sum_sales")],
+        n_parts,
+    )
+    single = NativeShuffleExchangeExec(agg, SinglePartitioning())
+    from ..ops import SortExec
+
+    pre = SortExec(single, [
+        SortField(col("i_category")), SortField(col("i_brand")),
+        SortField(col("s_store_name")), SortField(col("s_company_name")),
+    ])
+    w = WindowExec(
+        pre,
+        [WindowFunction("avg", "avg_monthly_sales", col("sum_sales"), whole_partition=True)],
+        [col("i_category"), col("i_brand"), col("s_store_name"), col("s_company_name")],
+        [],
+    )
+    f64 = DataType.float64()
+    sum_f = col("sum_sales").cast(f64)
+    avg_f = col("avg_monthly_sales").cast(f64)
+    ratio = Case(
+        [( avg_f != lit(0.0), func("abs", sum_f - avg_f) / avg_f )], None
+    )
+    filt = FilterExec(w, ratio > lit(0.1))
+    proj = ProjectExec(
+        filt,
+        [col("i_category"), col("i_class"), col("i_brand"), col("s_store_name"),
+         col("s_company_name"), col("d_moy"), col("sum_sales"), col("avg_monthly_sales"),
+         (sum_f - avg_f)],
+        ["i_category", "i_class", "i_brand", "s_store_name",
+         "s_company_name", "d_moy", "sum_sales", "avg_monthly_sales", "delta"],
+    )
+    out = single_sorted(proj, [SortField(col("delta")), SortField(col("s_store_name"))], fetch=100)
+    return out
+
+
+def q98(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Item revenue share of its class — windowed sum over i_class."""
+    import datetime
+
+    from ..ops import SortExec, WindowExec, WindowFunction
+    from ..parallel import NativeShuffleExchangeExec, SinglePartitioning
+    from ..schema import DataType
+
+    D = datetime.date
+    dt = FilterExec(
+        t["date_dim"],
+        (col("d_date") >= lit(D(1999, 2, 22))) & (col("d_date") <= lit(D(1999, 3, 24))),
+    )
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    it = FilterExec(
+        t["item"],
+        col("i_category").isin(lit("Sports"), lit("Books"), lit("Home")),
+    )
+    it_p = ProjectExec(it, [col("i_item_sk"), col("i_item_id"), col("i_item_desc"),
+                            col("i_category"), col("i_class"), col("i_current_price")])
+    j = broadcast_join(dt_p, t["store_sales"], [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(it_p, j, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("i_item_id"), "i_item_id"),
+         GroupingExpr(col("i_item_desc"), "i_item_desc"),
+         GroupingExpr(col("i_category"), "i_category"),
+         GroupingExpr(col("i_class"), "i_class"),
+         GroupingExpr(col("i_current_price"), "i_current_price")],
+        [AggFunction("sum", col("ss_ext_sales_price"), "itemrevenue")],
+        n_parts,
+    )
+    single = NativeShuffleExchangeExec(agg, SinglePartitioning())
+    pre = SortExec(single, [SortField(col("i_class"))])
+    w = WindowExec(
+        pre,
+        [WindowFunction("sum", "class_revenue", col("itemrevenue"), whole_partition=True)],
+        [col("i_class")],
+        [],
+    )
+    f64 = DataType.float64()
+    ratio = (col("itemrevenue").cast(f64) * lit(100.0)) / col("class_revenue").cast(f64)
+    proj = ProjectExec(
+        w,
+        [col("i_item_id"), col("i_item_desc"), col("i_category"), col("i_class"),
+         col("i_current_price"), col("itemrevenue"), ratio],
+        ["i_item_id", "i_item_desc", "i_category", "i_class",
+         "i_current_price", "itemrevenue", "revenueratio"],
+    )
+    return single_sorted(
+        proj,
+        [SortField(col("i_category")), SortField(col("i_class")),
+         SortField(col("i_item_id")), SortField(col("i_item_desc")),
+         SortField(col("revenueratio"))],
+    )
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q3": q3,
     "q7": q7,
+    "q27": q27,
     "q42": q42,
     "q52": q52,
     "q55": q55,
+    "q89": q89,
     "q96": q96,
+    "q98": q98,
 }
 
 

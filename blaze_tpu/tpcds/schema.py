@@ -26,8 +26,11 @@ TPCDS_SCHEMAS = {
     "item": Schema([
         Field("i_item_sk", T.int64()),
         Field("i_item_id", T.string(16)),
+        Field("i_item_desc", T.string(32)),
         Field("i_brand_id", T.int32()),
         Field("i_brand", T.string(32)),
+        Field("i_class_id", T.int32()),
+        Field("i_class", T.string(16)),
         Field("i_category_id", T.int32()),
         Field("i_category", T.string(16)),
         Field("i_manufact_id", T.int32()),
@@ -37,6 +40,8 @@ TPCDS_SCHEMAS = {
     "store": Schema([
         Field("s_store_sk", T.int64()),
         Field("s_store_name", T.string(16)),
+        Field("s_state", T.string(8)),
+        Field("s_company_name", T.string(16)),
     ]),
     "promotion": Schema([
         Field("p_promo_sk", T.int64()),

@@ -75,12 +75,13 @@ def test_opaque_column_serde_roundtrip():
 def test_opaque_deser_gated_by_conf():
     schema = Schema([Field("s", DataType.opaque())])
     data = serialize_batch(batch_from_pydict({"s": [{1}]}, schema))
+    prev = conf.ALLOW_PICKLED_UDFS.get()
     conf.ALLOW_PICKLED_UDFS.set(False)
     try:
         with pytest.raises(PermissionError):
             deserialize_batch(data, schema)
     finally:
-        conf.ALLOW_PICKLED_UDFS.set(True)
+        conf.ALLOW_PICKLED_UDFS.set(prev)
 
 
 def test_object_agg_two_stage_matches_oracle():
