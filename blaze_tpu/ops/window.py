@@ -37,12 +37,20 @@ from .sort import SortField, order_words
 @dataclass
 class WindowFunction:
     """kind: row_number | rank | dense_rank | sum | count | avg |
-    min | max (agg kinds use ``expr``)."""
+    min | max (agg kinds use ``expr``).
+
+    Frames: default = RANGE unbounded-preceding..current-peer;
+    ``whole_partition`` = unbounded..unbounded; ``rows_frame`` =
+    ROWS BETWEEN p PRECEDING AND f FOLLOWING (None bound = unbounded
+    on that side) — sum/count/avg only, computed as prefix-sum
+    differences clamped to the partition (≙ the reference's sliding
+    window processors, window/processors/)."""
 
     kind: str
     name: str
     expr: Optional[Expr] = None
     whole_partition: bool = False  # True: unbounded..unbounded frame
+    rows_frame: Optional[Tuple[Optional[int], Optional[int]]] = None
 
 
 def _build_window_kernel(in_schema, functions_, part_by, ord_by):
@@ -72,15 +80,18 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
         seg_start = jax.ops.segment_min(pos, seg, num_segments=n_segs, indices_are_sorted=True)
         start_of_row = jnp.take(seg_start, seg)
 
+        # last live row index of each row's partition (frame clamp)
+        part_end = jnp.take(
+            jax.ops.segment_max(pos * live, seg, num_segments=n_segs, indices_are_sorted=True),
+            seg,
+        )
         # peer-group end index per row (last row of equal order keys
         # within the partition): next peer boundary - 1
         nxt = jnp.where(peer_b, pos, jnp.int64(cap))
         # for each row, the smallest boundary position > pos:
         rev_min = jax.lax.associative_scan(jnp.minimum, nxt[::-1])[::-1]
         shifted = jnp.concatenate([rev_min[1:], jnp.array([cap], jnp.int64)])
-        peer_end = jnp.minimum(shifted - 1, jnp.take(
-            jax.ops.segment_max(pos * live, seg, num_segments=n_segs, indices_are_sorted=True), seg
-        ))
+        peer_end = jnp.minimum(shifted - 1, part_end)
 
         out_cols: List[Column] = list(cols)
         ones = jnp.ones(cap, jnp.bool_) & live
@@ -111,7 +122,21 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
                     )
                     csum = jnp.cumsum(vals)
                     cnt = jnp.cumsum(valid.astype(jnp.int64))
-                    if f.whole_partition:
+                    if f.rows_frame is not None:
+                        # ROWS BETWEEN p PRECEDING AND q FOLLOWING:
+                        # prefix-sum difference over [lo, hi] clamped
+                        # to the partition
+                        p_, q_ = f.rows_frame
+                        lo = start_of_row if p_ is None else jnp.maximum(pos - p_, start_of_row)
+                        hi = part_end if q_ is None else jnp.minimum(pos + q_, part_end)
+                        base_sum = jnp.where(lo > 0, jnp.take(csum, jnp.maximum(lo - 1, 0)), 0)
+                        base_cnt = jnp.where(lo > 0, jnp.take(cnt, jnp.maximum(lo - 1, 0)), 0)
+                        run_sum = jnp.take(csum, hi) - base_sum
+                        run_cnt = jnp.take(cnt, hi) - base_cnt
+                        empty = hi < lo  # e.g. 0 PRECEDING..0 FOLLOWING off-range
+                        run_sum = jnp.where(empty, 0, run_sum)
+                        run_cnt = jnp.where(empty, 0, run_cnt)
+                    elif f.whole_partition:
                         seg_sum = jax.ops.segment_sum(vals, seg, num_segments=n_segs, indices_are_sorted=True)
                         seg_cnt = jax.ops.segment_sum(valid.astype(jnp.int64), seg, num_segments=n_segs, indices_are_sorted=True)
                         run_sum = jnp.take(seg_sum, seg)
@@ -207,6 +232,12 @@ class WindowExec(ExecNode):
         self.functions = list(functions)
         self.partition_by = list(partition_by)
         self.order_by = list(order_by)
+        for f in self.functions:
+            if f.rows_frame is not None and f.kind not in ("sum", "count", "avg"):
+                raise NotImplementedError(
+                    f"ROWS frame for window kind {f.kind!r} (sliding min/max "
+                    f"needs a monotonic-deque design — roadmap)"
+                )
         in_schema = child.schema
         out_fields = list(in_schema.fields)
         for f in self.functions:
@@ -238,7 +269,7 @@ class WindowExec(ExecNode):
         self._kernel = cached_kernel(
             ("window", schema_key(in_schema),
              tuple((f.kind, f.name, None if f.expr is None else expr_key(f.expr),
-                    f.whole_partition) for f in functions_),
+                    f.whole_partition, f.rows_frame) for f in functions_),
              tuple(expr_key(e) for e in part_by),
              tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in ord_by)),
             build,

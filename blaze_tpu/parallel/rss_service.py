@@ -1,0 +1,163 @@
+"""Remote shuffle service: a push/fetch block server + socket client.
+
+≙ the reference's Celeborn integration
+(``BlazeRssShuffleWriterBase.scala`` / ``CelebornPartitionWriter.write:39`` /
+``BlazeRssShuffleReaderBase``): map tasks PUSH partition-framed
+compressed batches to the service as they repartition (the RSS takes
+over durability from local ``.data``/``.index`` files); reduce tasks
+FETCH their partition's blocks and stream them through
+``IpcReaderExec`` like any other shuffle read.
+
+Wire protocol (length-prefixed, one request per connection state):
+
+    PUSH : u8=1, u32 shuffle_id, u32 partition, u32 len, bytes
+           -> u8 ack (1)
+    FETCH: u8=2, u32 shuffle_id, u32 partition
+           -> u32 count, count x (u32 len, bytes)
+    COMMIT: u8=3, u32 shuffle_id -> u8 ack  (one per MAP TASK;
+           ≙ the Spark-side mapStatus commit — the barrier holds when
+           the commit count reaches the expected map count)
+
+The server is a plain threaded TCP server (host runtime concern — the
+TPU never sees RSS traffic; this is the DCN tier of SURVEY §2.3's
+communication inventory, next to the ICI fast path).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .rss import RssPartitionWriterBase
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rss peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+class RssServer:
+    """In-memory block store behind a TCP endpoint."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        store: Dict[Tuple[int, int], List[bytes]] = {}
+        committed: Dict[int, int] = {}  # shuffle_id -> map-commit count
+        lock = threading.Lock()
+        self._store = store
+        self._committed = committed
+        self._lock = lock
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                try:
+                    while True:
+                        op_raw = sock.recv(1)
+                        if not op_raw:
+                            return
+                        op = op_raw[0]
+                        if op == 1:  # PUSH
+                            sid, pid, ln = struct.unpack(
+                                "<III", _recv_exact(sock, 12)
+                            )
+                            data = _recv_exact(sock, ln)
+                            with lock:
+                                store.setdefault((sid, pid), []).append(data)
+                            sock.sendall(b"\x01")
+                        elif op == 2:  # FETCH
+                            sid, pid = struct.unpack("<II", _recv_exact(sock, 8))
+                            with lock:
+                                blocks = list(store.get((sid, pid), []))
+                            sock.sendall(struct.pack("<I", len(blocks)))
+                            for b in blocks:
+                                sock.sendall(struct.pack("<I", len(b)))
+                                sock.sendall(b)
+                        elif op == 3:  # COMMIT (one per map task)
+                            (sid,) = struct.unpack("<I", _recv_exact(sock, 4))
+                            with lock:
+                                committed[sid] = committed.get(sid, 0) + 1
+                            sock.sendall(b"\x01")
+                        else:
+                            raise ConnectionError(f"bad rss opcode {op}")
+                except ConnectionError:
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    def start(self) -> "RssServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def is_committed(self, shuffle_id: int, expected_maps: int = 1) -> bool:
+        """True once ``expected_maps`` map tasks have committed — only
+        then is a reducer's fetch complete (fetching earlier can miss
+        in-flight map output)."""
+        with self._lock:
+            return self._committed.get(shuffle_id, 0) >= expected_maps
+
+    def __enter__(self) -> "RssServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class SocketRssWriter(RssPartitionWriterBase):
+    """Client half of the push path — what the engine sees behind the
+    resources map (≙ CelebornPartitionWriter)."""
+
+    def __init__(self, host: str, port: int, shuffle_id: int):
+        self.shuffle_id = shuffle_id
+        self._sock = socket.create_connection((host, port))
+
+    def write(self, partition_id: int, data: bytes) -> None:
+        self._sock.sendall(
+            b"\x01" + struct.pack("<III", self.shuffle_id, partition_id, len(data))
+        )
+        self._sock.sendall(data)
+        ack = _recv_exact(self._sock, 1)
+        if ack != b"\x01":
+            raise ConnectionError("rss push not acknowledged")
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(b"\x03" + struct.pack("<I", self.shuffle_id))
+            _recv_exact(self._sock, 1)
+        finally:
+            self._sock.close()
+
+
+def rss_fetch_blocks(
+    host: str, port: int, shuffle_id: int, partition: int
+) -> List[bytes]:
+    """Reduce-side fetch: the blocks feed ``IpcReaderExec`` through the
+    resources map exactly like local shuffle file segments
+    (≙ BlazeRssShuffleReaderBase.readIpc)."""
+    with socket.create_connection((host, port)) as sock:
+        sock.sendall(b"\x02" + struct.pack("<II", shuffle_id, partition))
+        (count,) = struct.unpack("<I", _recv_exact(sock, 4))
+        out = []
+        for _ in range(count):
+            (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+            out.append(_recv_exact(sock, ln))
+        return out

@@ -272,7 +272,16 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
         return WindowExec(
             plan_from_proto(w.input),
             [
-                WindowFunction(f.kind, f.name, expr_from_proto(f.expr) if f.has_expr else None, f.whole_partition)
+                WindowFunction(
+                    f.kind, f.name,
+                    expr_from_proto(f.expr) if f.has_expr else None,
+                    f.whole_partition,
+                    rows_frame=(
+                        (None if f.frame_preceding < 0 else f.frame_preceding,
+                         None if f.frame_following < 0 else f.frame_following)
+                        if f.has_rows_frame else None
+                    ),
+                )
                 for f in w.functions
             ],
             [expr_from_proto(e) for e in w.partition_by],

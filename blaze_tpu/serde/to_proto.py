@@ -328,6 +328,11 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
                 fp.has_expr = True
                 fp.expr.CopyFrom(expr_to_proto(f.expr))
             fp.whole_partition = f.whole_partition
+            if f.rows_frame is not None:
+                fp.has_rows_frame = True
+                p_, q_ = f.rows_frame
+                fp.frame_preceding = -1 if p_ is None else p_
+                fp.frame_following = -1 if q_ is None else q_
         for e in node.partition_by:
             out.window.partition_by.add().CopyFrom(expr_to_proto(e))
         for f in node.order_by:

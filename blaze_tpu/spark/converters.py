@@ -407,7 +407,9 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
             raise UnsupportedSparkExec("window expression shape")
         eid = expr_id(w.fields.get("exprId"))
         out_name = f"#{eid}" if eid is not None else w.fields.get("name", "w")
-        wf = w.children[0].children[0]
+        wexpr = w.children[0]
+        wf = wexpr.children[0]
+        whole, rows_frame = _window_frame(wexpr)
         cls = wf.name
         if cls == "RowNumber":
             functions.append(WindowFunction("row_number", out_name))
@@ -418,10 +420,62 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
         elif cls == "AggregateExpression":
             a = _agg_function(wf)
             kind = {"count_star": "count"}.get(a.fn, a.fn)
-            functions.append(WindowFunction(kind, out_name, a.expr))
+            if rows_frame is not None and kind not in ("sum", "count", "avg"):
+                # raise the FALLBACK exception, not the engine's
+                # NotImplementedError, so the strategy tags NEVER
+                # instead of aborting the conversion
+                raise UnsupportedSparkExec(
+                    f"ROWS frame for window aggregate {kind!r}"
+                )
+            functions.append(
+                WindowFunction(kind, out_name, a.expr,
+                               whole_partition=whole, rows_frame=rows_frame)
+            )
         else:
             raise UnsupportedSparkExec(f"window function {cls}")
     return WindowExec(child, functions, part_by, order_by)
+
+
+def _window_frame(wexpr: SparkNode):
+    """(whole_partition, rows_frame) from a WindowExpression's
+    WindowSpecDefinition -> SpecifiedWindowFrame (catalyst encodes
+    bounds as UnboundedPreceding/Following/CurrentRow case objects or
+    row-count literals; preceding bounds are negative)."""
+    if len(wexpr.children) < 2:
+        return False, None
+    spec = wexpr.children[1]
+    frame = next((c for c in spec.children if c.name == "SpecifiedWindowFrame"), None)
+    if frame is None:
+        return False, None
+
+    def bound(b: SparkNode):
+        if b.name in ("UnboundedPreceding", "UnboundedFollowing"):
+            return "unbounded"
+        if b.name == "CurrentRow":
+            return 0
+        if b.name == "Literal":
+            return int(b.fields.get("value", 0))
+        if b.name == "UnaryMinus" and b.children and b.children[0].name == "Literal":
+            return -int(b.children[0].fields.get("value", 0))
+        raise UnsupportedSparkExec(f"window frame bound {b.name}")
+
+    lower = bound(frame.children[0])
+    upper = bound(frame.children[1])
+    ftype = frame.string("frameType", "RangeFrame")
+    if lower == "unbounded" and upper == "unbounded":
+        return True, None
+    if ftype.startswith("Range"):
+        if lower == "unbounded" and upper == 0:
+            return False, None  # the engine's default running frame
+        raise UnsupportedSparkExec("RANGE frame with offset bounds")
+    # RowFrame: engine bounds are (preceding, following), non-negative
+    p_ = None if lower == "unbounded" else max(-lower, 0)
+    q_ = None if upper == "unbounded" else max(upper, 0)
+    if isinstance(lower, int) and lower > 0:
+        raise UnsupportedSparkExec("ROWS frame starting after current row")
+    if isinstance(upper, int) and upper < 0:
+        raise UnsupportedSparkExec("ROWS frame ending before current row")
+    return False, (p_, q_)
 
 
 def _convert_generate(node: SparkNode, ctx: ConversionContext) -> ExecNode:

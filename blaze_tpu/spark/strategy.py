@@ -75,8 +75,12 @@ class _StrategyContext(ConversionContext):
         a typed literal (≙ SparkScalarSubqueryWrapperExpr: the JVM
         evaluates, the engine sees a literal).  Memoized per subquery
         node across fixpoint rebuilds."""
-        if id(sub_plan) in self._subquery_memo:
-            return self._subquery_memo[id(sub_plan)]
+        hit = self._subquery_memo.get(id(sub_plan))
+        # the entry pins the node object, so an id() can never be
+        # recycled while its memo entry lives; the identity check
+        # guards the cross-query case regardless
+        if hit is not None and hit[0] is sub_plan:
+            return hit[1]
         from ..batch import batch_to_pydict
         from ..exprs.ir import Lit
         from ..runtime.context import TaskContext
@@ -94,7 +98,7 @@ class _StrategyContext(ConversionContext):
                 break
         t = dtype or plan.schema.fields[0].dtype
         out = Lit(value, t)
-        self._subquery_memo[id(sub_plan)] = out
+        self._subquery_memo[id(sub_plan)] = (sub_plan, out)
         return out
 
     def _fallback(self, node: SparkNode) -> ExecNode:
