@@ -399,6 +399,7 @@ class LocalShuffleManager:
 
     def __init__(self, root: Optional[str] = None):
         self.root = root or tempfile.mkdtemp(prefix="blaze_shuffle_")
+        os.makedirs(self.root, exist_ok=True)
 
     def map_output_paths(self, shuffle_id: int, map_id: int) -> Tuple[str, str]:
         base = os.path.join(self.root, f"shuffle_{shuffle_id}_{map_id}")

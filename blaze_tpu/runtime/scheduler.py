@@ -123,41 +123,76 @@ def stage_task_definitions(
     return out
 
 
-def run_stages(stages: List[Stage], manager: LocalShuffleManager):
+def run_stages(
+    stages: List[Stage], manager: LocalShuffleManager, max_task_attempts: int = 1
+):
     """Execute all stages in order over the serde boundary; yields the
     result stage's batches.  Before each stage that reads a shuffle,
     register its reduce blocks in the resources map (the
-    shuffle-reader half: readIpc -> resourcesMap.put)."""
+    shuffle-reader half: readIpc -> resourcesMap.put).
+
+    ``max_task_attempts`` > 1 enables task retry (≙ Spark's
+    spark.task.maxFailures — the reference delegates ALL fault
+    recovery to Spark task retry, SURVEY §5): a failed task re-runs
+    from a fresh TaskDefinition decode; shuffle files on disk and
+    re-registered reduce blocks make retries idempotent."""
     from ..serde.from_proto import run_task
+
+    from ..serde.to_proto import task_definition
 
     n_maps: Dict[int, int] = {}
 
-    def register(node: ExecNode, seen: set):
-        """Register reduce blocks for every shuffle IpcReader in the
-        stage plan (each consumed once by its reading task)."""
-        for c in node.children:
-            register(c, seen)
-        if (
-            isinstance(node, IpcReaderExec)
-            and node.resource_id.startswith("shuffle_")
-            and id(node) not in seen
-        ):
-            seen.add(id(node))
-            sid = int(node.resource_id.split("_")[1])
-            for p in range(node.num_partitions()):
-                RESOURCES.put(
-                    f"{node.resource_id}.{p}",
-                    manager.reduce_blocks(sid, n_maps[sid], p),
-                )
+    def shuffle_readers(plan: ExecNode) -> List[IpcReaderExec]:
+        out: List[IpcReaderExec] = []
+        seen: set = set()
+
+        def walk(node: ExecNode):
+            for c in node.children:
+                walk(c)
+            if (
+                isinstance(node, IpcReaderExec)
+                and node.resource_id.startswith("shuffle_")
+                and id(node) not in seen
+            ):
+                seen.add(id(node))
+                out.append(node)
+
+        walk(plan)
+        return out
 
     for stage in stages:
-        register(stage.plan, set())
-        defs = stage_task_definitions(stage, manager)
+        readers = shuffle_readers(stage.plan)
+        for t in range(stage.n_tasks):
+            attempt = 0
+            while True:
+                # (re)register this task's reduce blocks — pops on
+                # read, so every attempt gets a fresh registration
+                for node in readers:
+                    sid = int(node.resource_id.split("_")[1])
+                    RESOURCES.put(
+                        f"{node.resource_id}.{t}",
+                        manager.reduce_blocks(sid, n_maps[sid], t),
+                    )
+                if stage.kind == "map":
+                    data, index = manager.map_output_paths(stage.shuffle_id, t)
+                    plan = ShuffleWriterExec(
+                        stage.plan, stage._partitioning, data, index  # type: ignore[attr-defined]
+                    )
+                else:
+                    plan = stage.plan
+                # fresh TaskDefinition per attempt: serialization stages
+                # fresh one-shot resources (memscan ids pop on decode)
+                td = task_definition(
+                    plan, f"task_{stage.stage_id}_{t}_a{attempt}", stage.stage_id, t
+                )
+                try:
+                    batches = list(run_task(td))
+                    break
+                except Exception:
+                    attempt += 1
+                    if attempt >= max_task_attempts:
+                        raise
+            if stage.kind == "result":
+                yield from batches
         if stage.kind == "map":
-            for td in defs:
-                for _ in run_task(td):
-                    pass
             n_maps[stage.shuffle_id] = stage.n_tasks
-        else:
-            for td in defs:
-                yield from run_task(td)

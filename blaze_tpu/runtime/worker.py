@@ -1,0 +1,68 @@
+"""Standalone task worker: one process = one task attempt.
+
+≙ a Spark executor running one task of a Blaze stage
+(``BlazeCallNativeWrapper`` decoding TaskDefinition bytes +
+``BlazeBlockStoreShuffleReaderBase`` registering fetched blocks): the
+worker re-creates the shuffle manager over the SHARED shuffle root,
+registers its partition's reduce blocks in the resources map, decodes
+the TaskDefinition, drives the plan, and (for result stages) writes
+output batches as length-prefixed serde frames for the driver.
+
+Job spec (JSON file, path in argv[1]):
+
+    {"task_def": "<base64 TaskDefinition bytes>",
+     "partition": N,
+     "shuffle_root": "/dir/shared/across/workers",
+     "readers": [{"resource_id": "shuffle_7", "shuffle_id": 7, "n_maps": 3}],
+     "output": "/path/result.frames" | null}
+
+Used by the multi-process testenv suite (tests/test_testenv.py) — the
+repo's analogue of the reference's ``dev/testenv`` pseudo-distributed
+sandbox (SURVEY §4 tier 3).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+import sys
+
+
+def main(spec_path: str) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from ..io.batch_serde import serialize_batch
+    from ..parallel.shuffle import LocalShuffleManager
+    from ..serde.from_proto import run_task
+    from .context import RESOURCES
+
+    with open(spec_path) as f:
+        spec = json.load(f)
+    partition = int(spec["partition"])
+    if spec.get("readers"):
+        mgr = LocalShuffleManager(spec["shuffle_root"])
+        for r in spec["readers"]:
+            RESOURCES.put(
+                f"{r['resource_id']}.{partition}",
+                mgr.reduce_blocks(int(r["shuffle_id"]), int(r["n_maps"]), partition),
+            )
+    td = base64.b64decode(spec["task_def"])
+    out_path = spec.get("output")
+    if out_path:
+        with open(out_path, "wb") as f:
+            for batch in run_task(td):
+                frame = serialize_batch(batch)
+                f.write(struct.pack("<I", len(frame)))
+                f.write(frame)
+    else:
+        for _ in run_task(td):
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))
