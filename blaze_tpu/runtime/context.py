@@ -37,6 +37,11 @@ class ResourcesMap:
         with self._lock:
             return self._map[key]
 
+    def discard(self, key: str) -> None:
+        """Drop a staged resource if present (failed-attempt cleanup)."""
+        with self._lock:
+            self._map.pop(key, None)
+
 
 RESOURCES = ResourcesMap()
 

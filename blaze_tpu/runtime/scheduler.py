@@ -102,25 +102,34 @@ def split_stages(
     return stages, manager
 
 
+def build_task(
+    stage: Stage, manager: LocalShuffleManager, t: int, attempt: int = 0
+) -> Tuple[ExecNode, bytes]:
+    """Per-task plan + TaskDefinition bytes.  Map-stage tasks wrap the
+    plan in a ShuffleWriterExec with this task's output paths (≙ the
+    per-task proto clone in BlazeShuffleWriterBase:66-75); serializing
+    stages fresh one-shot resources, so every attempt builds anew."""
+    from ..serde.to_proto import task_definition
+
+    if stage.kind == "map":
+        data, index = manager.map_output_paths(stage.shuffle_id, t)
+        plan: ExecNode = ShuffleWriterExec(
+            stage.plan, stage._partitioning, data, index  # type: ignore[attr-defined]
+        )
+    else:
+        plan = stage.plan
+    suffix = f"_a{attempt}" if attempt else ""
+    td = task_definition(
+        plan, f"task_{stage.stage_id}_{t}{suffix}", stage.stage_id, t
+    )
+    return plan, td
+
+
 def stage_task_definitions(
     stage: Stage, manager: LocalShuffleManager
 ) -> List[bytes]:
-    """One TaskDefinition per task.  Map-stage tasks wrap the plan in a
-    ShuffleWriterExec with this task's output paths (≙ the per-task
-    proto clone in BlazeShuffleWriterBase:66-75)."""
-    from ..serde.to_proto import task_definition
-
-    out = []
-    for t in range(stage.n_tasks):
-        if stage.kind == "map":
-            data, index = manager.map_output_paths(stage.shuffle_id, t)
-            plan = ShuffleWriterExec(
-                stage.plan, stage._partitioning, data, index  # type: ignore[attr-defined]
-            )
-        else:
-            plan = stage.plan
-        out.append(task_definition(plan, f"task_{stage.stage_id}_{t}", stage.stage_id, t))
-    return out
+    """One TaskDefinition per task (see :func:`build_task`)."""
+    return [build_task(stage, manager, t)[1] for t in range(stage.n_tasks)]
 
 
 def run_stages(
@@ -137,8 +146,6 @@ def run_stages(
     from a fresh TaskDefinition decode; shuffle files on disk and
     re-registered reduce blocks make retries idempotent."""
     from ..serde.from_proto import run_task
-
-    from ..serde.to_proto import task_definition
 
     n_maps: Dict[int, int] = {}
 
@@ -160,6 +167,8 @@ def run_stages(
         walk(plan)
         return out
 
+    from ..serde.to_proto import STAGED_RIDS
+
     for stage in stages:
         readers = shuffle_readers(stage.plan)
         for t in range(stage.n_tasks):
@@ -167,32 +176,37 @@ def run_stages(
             while True:
                 # (re)register this task's reduce blocks — pops on
                 # read, so every attempt gets a fresh registration
+                block_keys = []
                 for node in readers:
                     sid = int(node.resource_id.split("_")[1])
-                    RESOURCES.put(
-                        f"{node.resource_id}.{t}",
-                        manager.reduce_blocks(sid, n_maps[sid], t),
-                    )
-                if stage.kind == "map":
-                    data, index = manager.map_output_paths(stage.shuffle_id, t)
-                    plan = ShuffleWriterExec(
-                        stage.plan, stage._partitioning, data, index  # type: ignore[attr-defined]
-                    )
-                else:
-                    plan = stage.plan
-                # fresh TaskDefinition per attempt: serialization stages
-                # fresh one-shot resources (memscan ids pop on decode)
-                td = task_definition(
-                    plan, f"task_{stage.stage_id}_{t}_a{attempt}", stage.stage_id, t
-                )
+                    key = f"{node.resource_id}.{t}"
+                    RESOURCES.put(key, manager.reduce_blocks(sid, n_maps[sid], t))
+                    block_keys.append(key)
+                # fresh TaskDefinition per attempt (serialization
+                # stages fresh one-shot resources); track the staged
+                # ids so a failed attempt doesn't leak them
+                staged: List[str] = []
+                token = STAGED_RIDS.set(staged)
                 try:
-                    batches = list(run_task(td))
+                    _, td = build_task(stage, manager, t, attempt)
+                finally:
+                    STAGED_RIDS.reset(token)
+                try:
+                    if stage.kind == "result" and max_task_attempts <= 1:
+                        # no-retry default: stream straight through
+                        # (buffering would pin the whole partition)
+                        yield from run_task(td)
+                        batches = None
+                    else:
+                        batches = list(run_task(td))
                     break
                 except Exception:
+                    for key in staged + block_keys:
+                        RESOURCES.discard(key)
                     attempt += 1
                     if attempt >= max_task_attempts:
                         raise
-            if stage.kind == "result":
+            if stage.kind == "result" and batches:
                 yield from batches
         if stage.kind == "map":
             n_maps[stage.shuffle_id] = stage.n_tasks

@@ -30,9 +30,16 @@ import sys
 
 
 def main(spec_path: str) -> int:
+    import os
+
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    # honor the launcher's JAX_PLATFORMS (default cpu).  The config
+    # call is required either way: a sitecustomize (e.g. the axon TPU
+    # plugin) may force its own platform over the env var
+    jax.config.update(
+        "jax_platforms", os.environ.get("JAX_PLATFORMS") or "cpu"
+    )
     jax.config.update("jax_enable_x64", True)
 
     from ..io.batch_serde import serialize_batch

@@ -22,12 +22,20 @@ from ..schema import DataType, Field, Schema, TypeKind
 from . import plan_pb2 as pb
 
 
+import contextvars
 import itertools
 
 # itertools.count.__next__ is atomic under the GIL, so concurrent
 # serializations (exchange map threads, parallel task-def building)
 # never mint the same resource id
 _memscan_rids = itertools.count()
+
+# When set (scheduler retry path), every resource id staged during
+# serialization is appended here so a failed attempt can discard its
+# one-shot resources instead of leaking them in the process-global map.
+STAGED_RIDS: contextvars.ContextVar = contextvars.ContextVar(
+    "blaze_staged_rids", default=None
+)
 
 
 def dtype_to_proto(t: DataType) -> pb.DataTypeProto:
@@ -195,6 +203,9 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
         # exit — callers (scheduler) serialize exactly what they run.
         rid = f"memscan_{id(node)}_{next(_memscan_rids)}"
         RESOURCES.put(rid, node._partitions)
+        staged = STAGED_RIDS.get()
+        if staged is not None:
+            staged.append(rid)
         out.memory_scan.resource_id = rid
         out.memory_scan.schema.CopyFrom(schema_to_proto(node.schema))
         out.memory_scan.num_partitions = node.num_partitions()

@@ -21,10 +21,9 @@ from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
 from blaze_tpu.io.batch_serde import deserialize_batch
 from blaze_tpu.ops import MemoryScanExec, ParquetScanExec, ParquetSinkExec
 from blaze_tpu.runtime.context import TaskContext
-from blaze_tpu.runtime.scheduler import split_stages
-from blaze_tpu.parallel.shuffle import LocalShuffleManager, ShuffleWriterExec
+from blaze_tpu.runtime.scheduler import build_task, split_stages
+from blaze_tpu.parallel.shuffle import LocalShuffleManager
 from blaze_tpu.schema import DataType, Field, Schema
-from blaze_tpu.serde.to_proto import task_definition
 from blaze_tpu.spark import BlazeSparkSession
 
 import spark_fixtures as F
@@ -115,14 +114,12 @@ def test_multi_process_two_stage_query(tmp_path):
     results = []
     for stage in stages:
         for t in range(stage.n_tasks):
-            if stage.kind == "map":
-                dpath, ipath = manager.map_output_paths(stage.shuffle_id, t)
-                task_plan = ShuffleWriterExec(stage.plan, stage._partitioning, dpath, ipath)
-                output = None
-            else:
-                task_plan = stage.plan
-                output = str(tmp_path / f"result_{stage.stage_id}_{t}.frames")
-            td = task_definition(task_plan, f"t{stage.stage_id}_{t}", stage.stage_id, t)
+            output = (
+                None
+                if stage.kind == "map"
+                else str(tmp_path / f"result_{stage.stage_id}_{t}.frames")
+            )
+            _, td = build_task(stage, manager, t)
             readers = [
                 {"resource_id": f"shuffle_{sid}", "shuffle_id": sid, "n_maps": nm}
                 for sid, nm in n_maps.items()
