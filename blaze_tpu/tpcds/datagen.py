@@ -35,6 +35,14 @@ MARITALS = ["M", "S", "D", "W", "U"]
 GENDERS = ["M", "F"]
 STORE_NAMES = ["ese", "ought", "able", "pri", "bar", "anti"]
 STATES = ["TN", "SD", "AL", "GA", "OH"]
+# incl. values OUTSIDE the q34/q73 filter set so the IN predicate
+# actually filters rows
+COUNTIES = ["Williamson County", "Franklin Parish", "Bronx County",
+            "Orange County", "Salem County", "Kern County"]
+BUY_POTENTIALS = ["1001-5000", "0-500", ">10000", "Unknown", "501-1000", "5001-10000"]
+SALUTATIONS = ["Mr.", "Mrs.", "Ms.", "Dr.", "Sir"]
+FIRST_NAMES = ["James", "Mary", "John", "Linda", "Robert", "Susan", "David", "Karen"]
+LAST_NAMES = ["Smith", "Jones", "Brown", "Davis", "Miller", "Wilson", "Moore", "Taylor"]
 CLASSES = [
     "accessories", "classical", "fiction", "shirts", "birdal",
     "dresses", "football", "fragrances", "pants", "pop",
@@ -96,11 +104,13 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         data, lengths = _encode_options(STORE_NAMES, 16)
         st_data, st_len = _encode_options([STATES[i % len(STATES)] for i in range(n)], 8)
         co_data, co_len = _encode_options(["Unknown"] * n, 16)
+        cty_data, cty_len = _encode_options([COUNTIES[i % len(COUNTIES)] for i in range(n)], 24)
         return {
             "s_store_sk": (np.arange(1, n + 1, dtype=np.int64), None),
             "s_store_name": (data, lengths),
             "s_state": (st_data, st_len),
             "s_company_name": (co_data, co_len),
+            "s_county": (cty_data, cty_len),
         }
     if name == "promotion":
         n = max(5, int(300 * scale))
@@ -133,9 +143,27 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         }
     if name == "household_demographics":
         n = 720
+        bp_data, bp_len = _encode_options(
+            [BUY_POTENTIALS[i % len(BUY_POTENTIALS)] for i in range(n)], 16
+        )
         return {
             "hd_demo_sk": (np.arange(1, n + 1, dtype=np.int64), None),
             "hd_dep_count": ((np.arange(n) % 10).astype(np.int32), None),
+            "hd_buy_potential": (bp_data, bp_len),
+            "hd_vehicle_count": (((np.arange(n) % 5) - 1).astype(np.int32), None),
+        }
+    if name == "customer":
+        n = max(50, int(100000 * scale))
+        sal, sal_len = _encode_options([SALUTATIONS[i % len(SALUTATIONS)] for i in range(n)], 8)
+        fn_, fn_len = _encode_options([FIRST_NAMES[i % len(FIRST_NAMES)] for i in range(n)], 16)
+        ln_, ln_len = _encode_options([LAST_NAMES[(i * 3) % len(LAST_NAMES)] for i in range(n)], 16)
+        pf, pf_len = _encode_options([("Y" if i % 2 else "N") for i in range(n)], 8)
+        return {
+            "c_customer_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "c_salutation": (sal, sal_len),
+            "c_first_name": (fn_, fn_len),
+            "c_last_name": (ln_, ln_len),
+            "c_preferred_cust_flag": (pf, pf_len),
         }
     if name == "item":
         n = max(60, int(18000 * scale))
@@ -165,30 +193,47 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
             "i_current_price": (_money(rng, n, 1, 99), None),
         }
     if name == "store_sales":
-        n = max(200, int(2_880_000 * scale))
+        # dsdgen's basket model: a TICKET (1..25 lines, ~13 avg) shares
+        # one date/time/store/customer/demographics draw; per-LINE
+        # attributes (item, quantity, prices) vary within the basket.
+        # Ticket-level HAVING queries (q34/q73) depend on this shape.
+        n_target = max(200, int(2_880_000 * scale))
+        n_tickets = max(2, n_target // 13)
         n_date = _days(*D_LAST) - _days(*D_FIRST) + 1
         n_item = max(60, int(18000 * scale))
         n_cd = len(EDUCATIONS) * len(MARITALS) * len(GENDERS) * 4
         n_promo = max(5, int(300 * scale))
+        n_cust = max(50, int(100000 * scale))
 
-        def fk(upper, null_frac=0.04):
-            v = rng.randint(1, upper + 1, n).astype(np.int64)
-            nulls = rng.rand(n) < null_frac
-            return np.where(nulls, np.int64(-1), v)
+        lines_per = rng.randint(1, 26, n_tickets)
+        n = int(lines_per.sum())
+        tidx = np.repeat(np.arange(n_tickets), lines_per)
 
+        def ticket_fk(upper, null_frac=0.04):
+            v = rng.randint(1, upper + 1, n_tickets).astype(np.int64)
+            nulls = rng.rand(n_tickets) < null_frac
+            return np.where(nulls, np.int64(-1), v)[tidx]
+
+        t_date = np.where(
+            rng.rand(n_tickets) < 0.02, np.int64(-1),
+            rng.randint(0, n_date, n_tickets) + DATE_SK_BASE,
+        ).astype(np.int64)[tidx]
+        t_time = np.where(
+            rng.rand(n_tickets) < 0.02, np.int64(-1),
+            rng.randint(0, 1440, n_tickets),
+        ).astype(np.int64)[tidx]
         return {
-            "ss_sold_date_sk": (
-                np.where(rng.rand(n) < 0.02, np.int64(-1),
-                         rng.randint(0, n_date, n) + DATE_SK_BASE).astype(np.int64), None),
-            "ss_sold_time_sk": (
-                np.where(rng.rand(n) < 0.02, np.int64(-1),
-                         rng.randint(0, 1440, n)).astype(np.int64), None),
+            "ss_sold_date_sk": (t_date, None),
+            "ss_sold_time_sk": (t_time, None),
             "ss_item_sk": (rng.randint(1, n_item + 1, n).astype(np.int64), None),
-            "ss_customer_sk": (fk(100000), None),
-            "ss_cdemo_sk": (fk(n_cd), None),
-            "ss_hdemo_sk": (fk(720), None),
-            "ss_store_sk": (fk(len(STORE_NAMES)), None),
-            "ss_promo_sk": (fk(n_promo), None),
+            "ss_customer_sk": (ticket_fk(n_cust), None),
+            "ss_cdemo_sk": (ticket_fk(n_cd), None),
+            "ss_hdemo_sk": (ticket_fk(720), None),
+            "ss_store_sk": (ticket_fk(len(STORE_NAMES)), None),
+            "ss_promo_sk": (
+                np.where(rng.rand(n) < 0.04, np.int64(-1),
+                         rng.randint(1, n_promo + 1, n)).astype(np.int64), None),
+            "ss_ticket_number": ((tidx + 1).astype(np.int64), None),
             "ss_quantity": (rng.randint(1, 101, n).astype(np.int32), None),
             "ss_list_price": (_money(rng, n, 1, 200), None),
             "ss_sales_price": (_money(rng, n, 0, 200), None),

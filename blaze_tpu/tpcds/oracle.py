@@ -320,3 +320,75 @@ def oracle_q98(tables):
         itm: (s, (float(s) * 100.0) / float(class_total[itm[3]]))
         for itm, s in sums.items()
     }
+
+
+def _oracle_ticket_report(tables, *, dom_ranges, buy_potentials, cnt_lo, cnt_hi,
+                          dep_vehicle_ratio=None):
+    dd = tables["date_dim"]
+    d_ok = np.zeros(dd["d_dom"][0].shape[0], bool)
+    for lo, hi in dom_ranges:
+        d_ok |= (dd["d_dom"][0] >= lo) & (dd["d_dom"][0] <= hi)
+    d_ok &= np.isin(dd["d_year"][0], (1999, 2000, 2001))
+    d_set = set(dd["d_date_sk"][0][d_ok].tolist())
+
+    hd = tables["household_demographics"]
+    bps = _sv(hd, "hd_buy_potential")
+    h_ok = np.array([b in buy_potentials for b in bps])
+    h_ok &= hd["hd_vehicle_count"][0] > 0
+    with np.errstate(divide="ignore"):
+        ratio = hd["hd_dep_count"][0] / np.maximum(hd["hd_vehicle_count"][0], 1)
+    h_ok &= np.where(hd["hd_vehicle_count"][0] > 0, ratio > dep_vehicle_ratio, False)
+    h_set = set(hd["hd_demo_sk"][0][h_ok].tolist())
+
+    st = tables["store"]
+    counties = _sv(st, "s_county")
+    s_set = {
+        int(sk) for i, sk in enumerate(st["s_store_sk"][0])
+        if counties[i] in ("Williamson County", "Franklin Parish",
+                           "Bronx County", "Orange County")
+    }
+
+    ss = tables["store_sales"]
+    counts = {}
+    d_sk = ss["ss_sold_date_sk"][0]; h_sk = ss["ss_hdemo_sk"][0]
+    s_sk = ss["ss_store_sk"][0]; tick = ss["ss_ticket_number"][0]
+    cust = ss["ss_customer_sk"][0]
+    for i in range(d_sk.shape[0]):
+        if int(d_sk[i]) in d_set and int(h_sk[i]) in h_set and int(s_sk[i]) in s_set:
+            key = (int(tick[i]), int(cust[i]))
+            counts[key] = counts.get(key, 0) + 1
+
+    c = tables["customer"]
+    sal = _sv(c, "c_salutation")
+    fn_ = _sv(c, "c_first_name")
+    ln_ = _sv(c, "c_last_name")
+    pf = _sv(c, "c_preferred_cust_flag")
+    cust_by_sk = {
+        int(sk): (sal[i], fn_[i], ln_[i], pf[i])
+        for i, sk in enumerate(c["c_customer_sk"][0])
+    }
+    out = {}
+    for (tick_no, csk), n in counts.items():
+        if not (cnt_lo <= n <= cnt_hi):
+            continue
+        info = cust_by_sk.get(csk)
+        if info is None:
+            continue
+        out[(tick_no, csk)] = info + (n,)
+    return out
+
+
+def oracle_q34(tables):
+    return _oracle_ticket_report(
+        tables, dom_ranges=[(1, 3), (25, 28)],
+        buy_potentials={">10000", "Unknown"}, cnt_lo=15, cnt_hi=20,
+        dep_vehicle_ratio=1.2,
+    )
+
+
+def oracle_q73(tables):
+    return _oracle_ticket_report(
+        tables, dom_ranges=[(1, 2)],
+        buy_potentials={">10000", "Unknown"}, cnt_lo=1, cnt_hi=5,
+        dep_vehicle_ratio=1.0,
+    )

@@ -26,6 +26,7 @@ from ..ops import (
     SortField,
 )
 from ..ops.joins import JoinType
+from ..schema import DataType
 from ..tpch.queries import broadcast_join, single_sorted, two_stage_agg
 
 
@@ -341,13 +342,98 @@ def q98(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+def _ticket_report(t, n_parts, *, dom_ranges, buy_potentials, cnt_lo, cnt_hi,
+                   dep_vehicle_ratio, order_by):
+    """Shared q34/q73 shape: per-(ticket, customer) line counts with a
+    HAVING range, then join customer for the report — aggregation
+    BELOW a join, with a post-agg filter."""
+    dt_pred = None
+    for lo, hi in dom_ranges:
+        rng_p = (col("d_dom") >= lit(lo)) & (col("d_dom") <= lit(hi))
+        dt_pred = rng_p if dt_pred is None else (dt_pred | rng_p)
+    dt = FilterExec(
+        t["date_dim"],
+        dt_pred & col("d_year").isin(lit(1999), lit(2000), lit(2001)),
+    )
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    hd_pred = None
+    for bp in buy_potentials:
+        p = col("hd_buy_potential") == lit(bp)
+        hd_pred = p if hd_pred is None else (hd_pred | p)
+    hd_pred = hd_pred & (col("hd_vehicle_count") > lit(0))
+    # spec CASE WHEN vehicle_count > 0 THEN dep/vehicle END > ratio
+    # (the > 0 guard above makes the CASE arm unconditional here)
+    f64 = DataType.float64()
+    hd_pred = hd_pred & (
+        col("hd_dep_count").cast(f64) / col("hd_vehicle_count").cast(f64)
+        > lit(dep_vehicle_ratio)
+    )
+    hd = FilterExec(t["household_demographics"], hd_pred)
+    hd_p = ProjectExec(hd, [col("hd_demo_sk")])
+    st = FilterExec(
+        t["store"],
+        col("s_county").isin(
+            lit("Williamson County"), lit("Franklin Parish"),
+            lit("Bronx County"), lit("Orange County"),
+        ),
+    )
+    st_p = ProjectExec(st, [col("s_store_sk")])
+    j = broadcast_join(dt_p, t["store_sales"], [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(hd_p, j, [col("hd_demo_sk")], [col("ss_hdemo_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("ss_ticket_number"), "ss_ticket_number"),
+         GroupingExpr(col("ss_customer_sk"), "ss_customer_sk")],
+        [AggFunction("count_star", None, "cnt")],
+        n_parts,
+    )
+    having = FilterExec(agg, (col("cnt") >= lit(cnt_lo)) & (col("cnt") <= lit(cnt_hi)))
+    cust = ProjectExec(
+        t["customer"],
+        [col("c_customer_sk"), col("c_salutation"), col("c_first_name"),
+         col("c_last_name"), col("c_preferred_cust_flag")],
+    )
+    j2 = broadcast_join(cust, having, [col("c_customer_sk")], [col("ss_customer_sk")], JoinType.INNER, build_is_left=True)
+    return single_sorted(j2, order_by)
+
+
+def q34(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    return _ticket_report(
+        t, n_parts,
+        dom_ranges=[(1, 3), (25, 28)],
+        buy_potentials=[">10000", "Unknown"],
+        cnt_lo=15, cnt_hi=20,
+        dep_vehicle_ratio=1.2,
+        order_by=[  # spec q34 ordering
+            SortField(col("c_last_name")), SortField(col("c_first_name")),
+            SortField(col("c_salutation")),
+            SortField(col("c_preferred_cust_flag"), ascending=False),
+            SortField(col("ss_ticket_number")),
+        ],
+    )
+
+
+def q73(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    return _ticket_report(
+        t, n_parts,
+        dom_ranges=[(1, 2)],
+        buy_potentials=[">10000", "Unknown"],
+        cnt_lo=1, cnt_hi=5,
+        dep_vehicle_ratio=1.0,
+        order_by=[SortField(col("cnt"), ascending=False), SortField(col("c_last_name"))],
+    )
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q3": q3,
     "q7": q7,
     "q27": q27,
+    "q34": q34,
     "q42": q42,
     "q52": q52,
     "q55": q55,
+    "q73": q73,
     "q89": q89,
     "q96": q96,
     "q98": q98,

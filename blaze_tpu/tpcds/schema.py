@@ -42,6 +42,7 @@ TPCDS_SCHEMAS = {
         Field("s_store_name", T.string(16)),
         Field("s_state", T.string(8)),
         Field("s_company_name", T.string(16)),
+        Field("s_county", T.string(24)),
     ]),
     "promotion": Schema([
         Field("p_promo_sk", T.int64()),
@@ -57,6 +58,15 @@ TPCDS_SCHEMAS = {
     "household_demographics": Schema([
         Field("hd_demo_sk", T.int64()),
         Field("hd_dep_count", T.int32()),
+        Field("hd_buy_potential", T.string(16)),
+        Field("hd_vehicle_count", T.int32()),
+    ]),
+    "customer": Schema([
+        Field("c_customer_sk", T.int64()),
+        Field("c_salutation", T.string(8)),
+        Field("c_first_name", T.string(16)),
+        Field("c_last_name", T.string(16)),
+        Field("c_preferred_cust_flag", T.string(8)),
     ]),
     "store_sales": Schema([
         Field("ss_sold_date_sk", T.int64()),
@@ -67,6 +77,7 @@ TPCDS_SCHEMAS = {
         Field("ss_hdemo_sk", T.int64()),
         Field("ss_store_sk", T.int64()),
         Field("ss_promo_sk", T.int64()),
+        Field("ss_ticket_number", T.int64()),
         Field("ss_quantity", T.int32()),
         Field("ss_list_price", _m()),
         Field("ss_sales_price", _m()),

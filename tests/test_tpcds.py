@@ -157,3 +157,50 @@ def test_q98(data, scans):
     # spec ordering: category then class
     cats = got["i_category"]
     assert cats == sorted(cats)
+
+
+def _check_ticket_report(got, exp):
+    assert got["ss_ticket_number"], "query returned no rows"
+    keys = list(zip(got["ss_ticket_number"], got["ss_customer_sk"]))
+    assert len(set(keys)) == len(keys), "duplicate (ticket, customer) rows"
+    assert set(keys) == set(exp)
+    for tick, csk, sal, fn_, ln_, pf, cnt in zip(
+        got["ss_ticket_number"], got["ss_customer_sk"], got["c_salutation"],
+        got["c_first_name"], got["c_last_name"], got["c_preferred_cust_flag"],
+        got["cnt"],
+    ):
+        key = (tick, csk)
+        assert key in exp, key
+        assert exp[key] == (sal, fn_, ln_, pf, cnt), key
+    assert len(got["ss_ticket_number"]) == len(exp)
+
+
+@pytest.fixture(scope="module")
+def ticket_data():
+    # the q34/q73 HAVING windows are sparse; a larger slice keeps the
+    # differential non-trivial at test time
+    return generate_all(0.01)
+
+
+@pytest.fixture(scope="module")
+def ticket_scans(ticket_data):
+    return {
+        name: MemoryScanExec(
+            table_to_batches(ticket_data[name], TPCDS_SCHEMAS[name], N_PARTS, batch_rows=8192),
+            TPCDS_SCHEMAS[name],
+        )
+        for name in TPCDS_SCHEMAS
+    }
+
+
+def test_q73(ticket_data, ticket_scans):
+    got = run(build_query("q73", ticket_scans, N_PARTS))
+    _check_ticket_report(got, O.oracle_q73(ticket_data))
+    # q73 spec ordering: cnt desc primary
+    assert got["cnt"] == sorted(got["cnt"], reverse=True)
+
+
+def test_q34(ticket_data, ticket_scans):
+    _check_ticket_report(
+        run(build_query("q34", ticket_scans, N_PARTS)), O.oracle_q34(ticket_data)
+    )
