@@ -16,6 +16,7 @@ from .shuffle import (
     IpcReaderExec,
     LocalShuffleManager,
     Partitioning,
+    RangePartitioning,
     RoundRobinPartitioning,
     ShuffleWriterExec,
     SinglePartitioning,
@@ -25,7 +26,7 @@ from .exchange import NativeShuffleExchangeExec, default_shuffle_manager
 
 __all__ = [
     "Partitioning", "HashPartitioning", "SinglePartitioning",
-    "RoundRobinPartitioning", "ShuffleWriterExec", "IpcReaderExec",
+    "RangePartitioning", "RoundRobinPartitioning", "ShuffleWriterExec", "IpcReaderExec",
     "LocalShuffleManager", "BroadcastExchangeExec", "IpcWriterExec",
     "NativeShuffleExchangeExec", "default_shuffle_manager",
 ]

@@ -40,6 +40,76 @@ def default_shuffle_manager() -> LocalShuffleManager:
         return _default_manager
 
 
+def _split_pending(pending, n_out: int, schema: Schema):
+    """Shared tail of the in-process materializations: ONE host sync
+    for all pid counts, device slices per partition, then coalesce each
+    partition to a single batch (per-program turnaround over a tunneled
+    chip makes fewer, larger batches win)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..batch import concat_batches, slice_rows_device
+
+    out = [[] for _ in range(n_out)]
+    if pending:
+        all_counts = np.asarray(jnp.stack([c for _, c in pending]))
+        for i, counts in enumerate(all_counts):
+            sorted_batch, _ = pending[i]
+            pending[i] = None  # release the pre-slice copy eagerly
+            offs = np.concatenate([[0], np.cumsum(counts)])
+            for pid in range(n_out):
+                lo, hi = int(offs[pid]), int(offs[pid + 1])
+                if hi > lo:
+                    out[pid].append(slice_rows_device(sorted_batch, lo, hi - lo))
+        for pid in range(n_out):
+            if len(out[pid]) > 1:
+                out[pid] = [concat_batches(out[pid])]
+    return out
+
+
+def _build_range_kernels(schema: Schema, fields, n_out: int):
+    """Device kernels for range partitioning: order-word extraction,
+    exact order-statistic boundaries, lexicographic pid assignment."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..exprs.compile import lower
+    from ..ops.sort import order_words
+
+    @jax.jit
+    def key_words(cols, num_rows):
+        cap = cols[0].validity.shape[0]
+        env = {f.name: c for f, c in zip(schema.fields, cols)}
+        words = []
+        for f in fields:
+            c = lower(f.expr, schema, env, cap)
+            words.extend(order_words(c, f.ascending, f.nulls_first))
+        live = jnp.arange(cap) < num_rows
+        # dead padding rows sort AFTER every live row
+        return tuple(jnp.where(live, w, ~jnp.uint64(0)) for w in words)
+
+    @jax.jit
+    def boundaries_at(cat_words, positions):
+        s = jax.lax.sort(cat_words, num_keys=len(cat_words))
+        return tuple(jnp.take(w, positions) for w in s)
+
+    @jax.jit
+    def pids(words, boundaries):
+        cap = words[0].shape[0]
+        pid = jnp.zeros(cap, jnp.int32)
+        for bi in range(n_out - 1):
+            ge = jnp.zeros(cap, jnp.bool_)   # row > boundary so far
+            eq = jnp.ones(cap, jnp.bool_)    # equal prefix so far
+            for w, bw in zip(words, boundaries):
+                b = bw[bi]
+                ge = ge | (eq & (w > b))
+                eq = eq & (w == b)
+            pid = pid + (ge | eq).astype(jnp.int32)
+        return pid
+
+    return key_words, boundaries_at, pids
+
+
 class NativeShuffleExchangeExec(ExecNode):
     def __init__(
         self,
@@ -99,7 +169,8 @@ class NativeShuffleExchangeExec(ExecNode):
 
         from ..batch import RecordBatch, slice_rows_device
         from .shuffle import (
-            RoundRobinPartitioning, non_opaque_cols, sort_cols_by_pid,
+            RangePartitioning, RoundRobinPartitioning, non_opaque_cols,
+            sort_cols_by_pid,
         )
 
         child = self.children[0]
@@ -107,6 +178,10 @@ class NativeShuffleExchangeExec(ExecNode):
         n_maps = child.num_partitions()
         is_hash = isinstance(self.partitioning, HashPartitioning) and n_out > 1
         is_rr = isinstance(self.partitioning, RoundRobinPartitioning) and n_out > 1
+        is_range = isinstance(self.partitioning, RangePartitioning) and n_out > 1
+        if is_range:
+            self._materialize_range(caller_ctx)
+            return
         writer = None
         if is_hash:
             # reuse the writer's cached pid kernels (murmur3 pmod)
@@ -162,33 +237,13 @@ class NativeShuffleExchangeExec(ExecNode):
             # re-materialize from scratch
             return
 
-        out: List[List] = [[] for _ in range(n_out)]
         pending = [pair for chunk in per_map for pair in chunk]
         del per_map
         if n_out == 1:
+            out: List[List] = [[] for _ in range(n_out)]
             out[0] = [b for b, _ in pending]
-        elif pending:
-            # ONE host transfer for all counts
-            all_counts = np.asarray(jnp.stack([c for _, c in pending]))
-            for i, counts in enumerate(all_counts):
-                sorted_batch, _ = pending[i]
-                pending[i] = None  # release the pre-slice copy eagerly
-                offs = np.concatenate([[0], np.cumsum(counts)])
-                for pid in range(n_out):
-                    lo, hi = int(offs[pid]), int(offs[pid + 1])
-                    if hi > lo:
-                        out[pid].append(slice_rows_device(sorted_batch, lo, hi - lo))
-        # coalesce each partition to one batch: downstream operators
-        # run per batch and each program execution pays a dispatch
-        # turnaround (a full RTT over a tunneled chip), so fewer,
-        # larger batches win — one concat program replaces per-batch
-        # downstream programs (≙ the reference wrapping every operator
-        # in a coalesce stream, streams/coalesce_stream.rs)
-        from ..batch import concat_batches
-
-        for pid in range(n_out):
-            if len(out[pid]) > 1:
-                out[pid] = [concat_batches(out[pid])]
+        else:
+            out = _split_pending(pending, n_out, self.schema)
         self._inproc_outputs = out
 
     def materialize(self) -> None:
@@ -204,6 +259,102 @@ class NativeShuffleExchangeExec(ExecNode):
                 for m in range(n_maps):
                     self._run_map_task(m)
             self._materialized = True
+
+    def _materialize_range(self, caller_ctx: TaskContext) -> None:
+        """Range repartition (global-sort exchange): collect the map
+        output device-resident, compute exact order-statistic boundary
+        rows from the full key distribution (ONE multi-word sort), then
+        assign pids by lexicographic comparison against the boundaries
+        and split like the hash path.  Reduce partitions hold disjoint
+        key ranges in partition order, so per-partition sorts compose
+        into a total order."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..batch import RecordBatch, slice_rows_device
+        from ..exprs.compile import expr_key
+        from ..runtime.kernel_cache import cached_kernel, schema_key
+
+        from ..batch import split_opaque_indexes
+        from .shuffle import sort_cols_by_pid
+
+        child = self.children[0]
+        n_out = self.partitioning.num_partitions
+        n_maps = child.num_partitions()
+        fields = list(self.partitioning.fields)
+        # kernels see only jit-capable columns (sort keys never opaque)
+        dev_idx, _ = split_opaque_indexes(child.schema)
+        schema = Schema([child.schema.fields[i] for i in dev_idx])
+
+        key_words, boundaries_at, pids_fn = cached_kernel(
+            (
+                "range_pids", schema_key(schema), n_out,
+                tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in fields),
+            ),
+            lambda: _build_range_kernels(schema, fields, n_out),
+        )
+
+        cancelled = False
+
+        def collect_map(m: int):
+            nonlocal cancelled
+            ctx = TaskContext(m, n_maps)
+            local = []
+            for batch in child.execute(m, ctx):
+                if not caller_ctx.is_task_running():
+                    cancelled = True
+                    return local
+                b = batch.to_device()
+                local.append(
+                    (b, key_words(tuple(b.columns[i] for i in dev_idx), b.num_rows))
+                )
+            return local
+
+        if self.parallel_map_tasks > 1 and n_maps > 1:
+            with ThreadPoolExecutor(max_workers=self.parallel_map_tasks) as pool:
+                per_map = list(pool.map(collect_map, range(n_maps)))
+        else:
+            per_map = [collect_map(m) for m in range(n_maps)]
+        if cancelled:
+            return
+        batches = [b for chunk in per_map for b, _ in chunk]
+        per_batch_words = [w for chunk in per_map for _, w in chunk]
+        del per_map
+        out: List[List] = [[] for _ in range(n_out)]
+        if batches:
+            n_words = len(per_batch_words[0])
+            cat = tuple(
+                jnp.concatenate([w[k] for w in per_batch_words])
+                for k in range(n_words)
+            )
+            total_live = sum(b.num_rows for b in batches)
+            # boundary b_i = first row of partition i+1 (rows >= b_i go
+            # right), so position is (total*(i+1))//n_out — NOT -1,
+            # which would push every partition's last row rightward
+            positions = jnp.asarray(
+                [
+                    min(total_live - 1, (total_live * (i + 1)) // n_out)
+                    for i in range(n_out - 1)
+                ],
+                dtype=jnp.int32,
+            )
+            boundaries = boundaries_at(cat, positions)
+            del cat
+            pending = []
+            for b, words in zip(batches, per_batch_words):
+                with self.metrics.timer("elapsed_compute"):
+                    pids = pids_fn(words, boundaries)
+                    sorted_cols, counts = sort_cols_by_pid(
+                        self.schema, b.columns, pids, n_out, b.num_rows
+                    )
+                pending.append(
+                    (RecordBatch(self.schema, list(sorted_cols), b.num_rows), counts)
+                )
+            # originals and key words are consumed; release before the
+            # sliced copies materialize (halves peak HBM)
+            del batches, per_batch_words
+            out = _split_pending(pending, n_out, self.schema)
+        self._inproc_outputs = out
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         from .. import conf

@@ -71,6 +71,19 @@ class RoundRobinPartitioning(Partitioning):
     num_partitions: int = 1
 
 
+@dataclass
+class RangePartitioning(Partitioning):
+    """Range partitioning on sort keys (Spark's global-sort exchange):
+    partition p holds rows in [boundary_{p-1}, boundary_p) of the key
+    order, so per-partition sorts + ordered partition reads give a
+    total order.  Boundaries are exact order-statistic rows computed
+    device-side by the in-process exchange (Spark samples; with the
+    map output already in HBM the exact quantiles are as cheap)."""
+
+    fields: Sequence  # SortField
+    num_partitions: int
+
+
 @partial(jax.jit, static_argnames=("n_out",))
 def _sort_by_pid(cols, pids, n_out, num_rows):
     """Sort rows by partition id; returns (sorted cols, counts[n_out],
@@ -308,6 +321,12 @@ class ShuffleWriterExec(ExecNode):
         return self.children[0].schema
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        if isinstance(self.partitioning, RangePartitioning):
+            raise NotImplementedError(
+                "range partitioning needs global boundaries: use the "
+                "in-process exchange (spark.blaze.exchange.inProcess)"
+            )
+
         def stream():
             n_out = self.partitioning.num_partitions
             rep = ShuffleRepartitioner(self.schema, n_out, self.metrics)

@@ -162,7 +162,9 @@ def expr_to_proto(e: Expr) -> pb.ExprNode:
 
 
 def _partitioning_to_proto(p) -> pb.PartitioningProto:
-    from ..parallel.shuffle import HashPartitioning, RoundRobinPartitioning, SinglePartitioning
+    from ..parallel.shuffle import (
+        HashPartitioning, RangePartitioning, RoundRobinPartitioning,
+    )
 
     out = pb.PartitioningProto(num_partitions=p.num_partitions)
     if isinstance(p, HashPartitioning):
@@ -171,6 +173,13 @@ def _partitioning_to_proto(p) -> pb.PartitioningProto:
             out.exprs.add().CopyFrom(expr_to_proto(e))
     elif isinstance(p, RoundRobinPartitioning):
         out.kind = pb.PartitioningProto.ROUND_ROBIN
+    elif isinstance(p, RangePartitioning):
+        # the file-shuffle writer has no global-boundary pass: refuse
+        # loudly rather than silently degrading to SINGLE
+        raise NotImplementedError(
+            "range partitioning crosses the serde boundary only via the "
+            "in-process exchange (no distributed boundary pass yet)"
+        )
     else:
         out.kind = pb.PartitioningProto.SINGLE
     return out

@@ -327,7 +327,16 @@ def _partitioning(node: SparkNode, ctx: ConversionContext):
             n_out = int(p.fields.get("numPartitions", ctx.default_parallelism))
             return HashPartitioning([convert_expr(k) for k in p.children], n_out)
         if p.name == "RangePartitioning":
-            raise UnsupportedSparkExec("RangePartitioning")
+            from ..parallel import RangePartitioning
+
+            if not bool(conf.EXCHANGE_IN_PROCESS.get()):
+                # the file-shuffle tier has no global-boundary pass yet;
+                # fall back rather than fail at runtime
+                raise UnsupportedSparkExec(
+                    "RangePartitioning requires the in-process exchange"
+                )
+            n_out = int(p.fields.get("numPartitions", ctx.default_parallelism))
+            return RangePartitioning(_sort_fields(p.children), n_out)
         raise UnsupportedSparkExec(f"partitioning {p.name}")
     if isinstance(v, dict):
         cls = v.get("product-class", "")

@@ -283,3 +283,7 @@ def union(children: Sequence[dict]) -> dict:
 def wscg(child: dict) -> dict:
     """WholeStageCodegenExec wrapper (pass-through in conversion)."""
     return T(P + "WholeStageCodegenExec", [child], codegenStageId=1)
+
+
+def range_partitioning(orders: Sequence[dict], n: int) -> list:
+    return flatten(T(PHYS + "RangePartitioning", list(orders), numPartitions=n))

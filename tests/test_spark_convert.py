@@ -479,3 +479,16 @@ def test_scheduler_exhausted_retries_raise():
             list(run_stages(stages, manager, max_task_attempts=2))
     finally:
         from_proto.run_task = real_run_task
+
+
+def test_range_partitioning_plan_global_sort():
+    """Spark RangePartitioning exchange + SortExec converts and yields
+    a total order across partitions (≙ Spark global ORDER BY)."""
+    sess, data = make_session()
+    s = F.scan("lineitem", [F.attr("l_extendedprice", 2)])
+    ex = F.shuffle(
+        F.range_partitioning([F.sort_order(F.attr("l_extendedprice", 2))], 3), s
+    )
+    srt = F.sort([F.sort_order(F.attr("l_extendedprice", 2))], ex)
+    out = sess.execute(F.flatten(srt))
+    assert out["#2"] == sorted(data["l_extendedprice"])
