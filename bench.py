@@ -37,7 +37,10 @@ def _init_devices():
     import jax
 
     last_err = None
-    for attempt in range(3):
+    # the axon chip lease can be transiently held (a killed process
+    # wedges it for a while); be patient before settling for CPU —
+    # ~4 minutes of backoff across attempts
+    for attempt in range(6):
         try:
             devices = jax.devices()
             return jax, devices, None
@@ -47,7 +50,8 @@ def _init_devices():
                 f"# bench: backend init attempt {attempt + 1} failed: {e}",
                 file=sys.stderr,
             )
-            _time.sleep(10 * (attempt + 1))
+            if attempt < 5:  # no sleep after the final attempt
+                _time.sleep(15 * (attempt + 1))
     # fall back to CPU explicitly (the config, not the env var, is
     # authoritative under the axon sitecustomize)
     jax.config.update("jax_platforms", "cpu")

@@ -23,6 +23,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..batch import Column, RecordBatch, concat_batches
 from ..exprs.compile import infer_dtype, lower
@@ -173,7 +174,66 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
                 elif f.kind in ("min", "max"):
                     from .agg import _seg_minmax
 
-                    if f.whole_partition:
+                    if f.rows_frame is not None:
+                        # sliding min/max over ROWS BETWEEN p..q via a
+                        # SPARSE TABLE: L = ceil(log2(maxW)) doubling
+                        # levels T_j[i] = op(T_{j-1}[i], T_{j-1}[i+2^(j-1)])
+                        # (static L from the frame spec), then each
+                        # row's clamped window [l, r] is op of two
+                        # overlapping power-of-2 spans — gathers only,
+                        # no data-dependent loop
+                        p_, q_ = f.rows_frame
+                        if p_ is None or q_ is None:
+                            raise NotImplementedError(
+                                "unbounded ROWS min/max frame (use the "
+                                "running/whole-partition frames)"
+                            )
+                        dt = c.data.dtype
+                        if jnp.issubdtype(dt, jnp.floating):
+                            sentinel = jnp.array(
+                                jnp.inf if f.kind == "min" else -jnp.inf, dt
+                            )
+                        else:
+                            info = jnp.iinfo(dt)
+                            sentinel = jnp.array(
+                                info.max if f.kind == "min" else info.min, dt
+                            )
+                        op = jnp.minimum if f.kind == "min" else jnp.maximum
+                        max_w = p_ + q_ + 1
+                        levels = max(1, int(np.ceil(np.log2(max_w))) + 1)
+                        # window spans never exceed the batch, so the
+                        # table never needs spans beyond cap
+                        levels = min(levels, max(1, int(np.ceil(np.log2(cap))) + 1))
+                        t = jnp.where(valid, c.data, sentinel)
+                        table = [t]
+                        for j in range(1, levels):
+                            half = 1 << (j - 1)
+                            prev = table[-1]
+                            shifted = jnp.concatenate(
+                                [prev[half:], jnp.full(half, sentinel, dt)]
+                            )
+                            table.append(op(prev, shifted))
+                        tbl = jnp.stack(table)  # (L, cap)
+                        part_end_i = part_end.astype(jnp.int64)
+                        l = jnp.maximum(pos - p_, start_of_row)
+                        r = jnp.minimum(pos + q_, part_end_i)
+                        ln = jnp.maximum(r - l + 1, 1)
+                        # floor(log2(ln)) with static level count
+                        jlev = jnp.zeros(cap, jnp.int32)
+                        for k in range(1, levels):
+                            jlev = jlev + (ln >= (1 << k)).astype(jnp.int32)
+                        a = tbl[jlev, jnp.clip(l, 0, cap - 1)]
+                        b_end = jnp.clip(r - (1 << jlev.astype(jnp.int64)) + 1, 0, cap - 1)
+                        b_val = tbl[jlev, b_end]
+                        run = op(a, b_val)
+                        cv = jnp.cumsum(valid.astype(jnp.int64))
+                        base_cnt = jnp.where(l > 0, jnp.take(cv, jnp.maximum(l - 1, 0)), 0)
+                        run_cnt = jnp.take(cv, jnp.clip(r, 0, cap - 1)) - base_cnt
+                        has = ones & (run_cnt > 0) & (r >= l)
+                        out_cols.append(
+                            Column(c.dtype, jnp.where(has, run, jnp.zeros((), dt)), has)
+                        )
+                    elif f.whole_partition:
                         red = _seg_minmax(c.data, valid, seg, n_segs, f.kind == "min")
                         has = jax.ops.segment_max(valid.astype(jnp.int32), seg, num_segments=n_segs, indices_are_sorted=True).astype(jnp.bool_)
                         out_cols.append(
@@ -233,11 +293,19 @@ class WindowExec(ExecNode):
         self.partition_by = list(partition_by)
         self.order_by = list(order_by)
         for f in self.functions:
-            if f.rows_frame is not None and f.kind not in ("sum", "count", "avg"):
-                raise NotImplementedError(
-                    f"ROWS frame for window kind {f.kind!r} (sliding min/max "
-                    f"needs a monotonic-deque design — roadmap)"
-                )
+            if f.rows_frame is None:
+                continue
+            if f.kind in ("sum", "count", "avg"):
+                continue
+            if f.kind in ("min", "max"):
+                p_, q_ = f.rows_frame
+                if p_ is None or q_ is None:
+                    raise NotImplementedError(
+                        "unbounded ROWS min/max frame (running and "
+                        "whole-partition frames cover those bounds)"
+                    )
+                continue
+            raise NotImplementedError(f"ROWS frame for window kind {f.kind!r}")
         in_schema = child.schema
         out_fields = list(in_schema.fields)
         for f in self.functions:

@@ -40,7 +40,7 @@ def default_shuffle_manager() -> LocalShuffleManager:
         return _default_manager
 
 
-def _split_pending(pending, n_out: int, schema: Schema):
+def _split_pending(pending, n_out: int):
     """Shared tail of the in-process materializations: ONE host sync
     for all pid counts, device slices per partition, then coalesce each
     partition to a single batch (per-program turnaround over a tunneled
@@ -78,15 +78,21 @@ def _build_range_kernels(schema: Schema, fields, n_out: int):
 
     @jax.jit
     def key_words(cols, num_rows):
+        """Per-FIELD word tuples: string keys emit a width-dependent
+        word count that can differ between batches (per-batch padding),
+        so the caller aligns each field's words across batches by
+        zero-padding the shorter lists (zero word == the zero padding
+        bytes already compare correctly)."""
         cap = cols[0].validity.shape[0]
         env = {f.name: c for f, c in zip(schema.fields, cols)}
-        words = []
+        live = jnp.arange(cap) < num_rows
+        per_field = []
         for f in fields:
             c = lower(f.expr, schema, env, cap)
-            words.extend(order_words(c, f.ascending, f.nulls_first))
-        live = jnp.arange(cap) < num_rows
-        # dead padding rows sort AFTER every live row
-        return tuple(jnp.where(live, w, ~jnp.uint64(0)) for w in words)
+            ws = order_words(c, f.ascending, f.nulls_first)
+            # dead padding rows sort AFTER every live row
+            per_field.append(tuple(jnp.where(live, w, ~jnp.uint64(0)) for w in ws))
+        return tuple(per_field)
 
     @jax.jit
     def boundaries_at(cat_words, positions):
@@ -165,9 +171,8 @@ class NativeShuffleExchangeExec(ExecNode):
         the whole stage output device-resident and does NOT spill.
         """
         import jax.numpy as jnp
-        import numpy as np
 
-        from ..batch import RecordBatch, slice_rows_device
+        from ..batch import RecordBatch
         from .shuffle import (
             RangePartitioning, RoundRobinPartitioning, non_opaque_cols,
             sort_cols_by_pid,
@@ -240,10 +245,13 @@ class NativeShuffleExchangeExec(ExecNode):
         pending = [pair for chunk in per_map for pair in chunk]
         del per_map
         if n_out == 1:
-            out: List[List] = [[] for _ in range(n_out)]
-            out[0] = [b for b, _ in pending]
+            from ..batch import concat_batches
+
+            out: List[List] = [[b for b, _ in pending]]
+            if len(out[0]) > 1:  # coalesce: one downstream program, not N
+                out[0] = [concat_batches(out[0])]
         else:
-            out = _split_pending(pending, n_out, self.schema)
+            out = _split_pending(pending, n_out)
         self._inproc_outputs = out
 
     def materialize(self) -> None:
@@ -269,9 +277,8 @@ class NativeShuffleExchangeExec(ExecNode):
         key ranges in partition order, so per-partition sorts compose
         into a total order."""
         import jax.numpy as jnp
-        import numpy as np
 
-        from ..batch import RecordBatch, slice_rows_device
+        from ..batch import RecordBatch
         from ..exprs.compile import expr_key
         from ..runtime.kernel_cache import cached_kernel, schema_key
 
@@ -322,6 +329,23 @@ class NativeShuffleExchangeExec(ExecNode):
         del per_map
         out: List[List] = [[] for _ in range(n_out)]
         if batches:
+            # align each FIELD's word count across batches (string
+            # widths are per-batch): pad shorter lists with zero words
+            n_fields = len(per_batch_words[0])
+            want = [
+                max(len(bw[fi]) for bw in per_batch_words)
+                for fi in range(n_fields)
+            ]
+            aligned = []
+            for bw, b in zip(per_batch_words, batches):
+                flat = []
+                for fi in range(n_fields):
+                    ws = list(bw[fi])
+                    while len(ws) < want[fi]:
+                        ws.append(jnp.zeros(b.capacity, jnp.uint64))
+                    flat.extend(ws)
+                aligned.append(tuple(flat))
+            per_batch_words = aligned
             n_words = len(per_batch_words[0])
             cat = tuple(
                 jnp.concatenate([w[k] for w in per_batch_words])
@@ -353,7 +377,7 @@ class NativeShuffleExchangeExec(ExecNode):
             # originals and key words are consumed; release before the
             # sliced copies materialize (halves peak HBM)
             del batches, per_batch_words
-            out = _split_pending(pending, n_out, self.schema)
+            out = _split_pending(pending, n_out)
         self._inproc_outputs = out
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:

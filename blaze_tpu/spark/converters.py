@@ -429,13 +429,18 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
         elif cls == "AggregateExpression":
             a = _agg_function(wf)
             kind = {"count_star": "count"}.get(a.fn, a.fn)
-            if rows_frame is not None and kind not in ("sum", "count", "avg"):
+            if rows_frame is not None:
                 # raise the FALLBACK exception, not the engine's
                 # NotImplementedError, so the strategy tags NEVER
                 # instead of aborting the conversion
-                raise UnsupportedSparkExec(
-                    f"ROWS frame for window aggregate {kind!r}"
-                )
+                if kind in ("min", "max") and None in rows_frame:
+                    raise UnsupportedSparkExec(
+                        "unbounded ROWS min/max window frame"
+                    )
+                if kind not in ("sum", "count", "avg", "min", "max"):
+                    raise UnsupportedSparkExec(
+                        f"ROWS frame for window aggregate {kind!r}"
+                    )
             functions.append(
                 WindowFunction(kind, out_name, a.expr,
                                whole_partition=whole, rows_frame=rows_frame)

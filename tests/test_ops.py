@@ -555,3 +555,55 @@ def test_window_rows_frame_serde_roundtrip():
     w2 = plan_from_proto(plan_to_proto(w))
     assert w2.functions[0].rows_frame == (3, None)
     assert collect_dict(w2) == collect_dict(w)
+
+
+def test_window_rows_frame_sliding_minmax():
+    """Sparse-table sliding min/max over ROWS frames vs python oracle
+    (partition clamps, nulls, float and int)."""
+    import numpy as np
+
+    from blaze_tpu.batch import batch_to_pydict
+    from blaze_tpu.ops import SortExec, WindowExec, WindowFunction
+
+    schema = Schema([
+        Field("g", DataType.int32()),
+        Field("v", DataType.int64()),
+        Field("f", DataType.float64()),
+    ])
+    rng = np.random.RandomState(9)
+    n = 60
+    rows = [
+        (int(g), int(v) if v % 6 else None, float(x) if v % 4 else None)
+        for g, v, x in zip(
+            rng.randint(0, 3, n), rng.randint(0, 90, n), rng.uniform(-5, 5, n)
+        )
+    ]
+    src = mem(
+        {"g": [r[0] for r in rows], "v": [r[1] for r in rows], "f": [r[2] for r in rows]},
+        schema,
+    )
+    pre = SortExec(src, [SortField(col("g")), SortField(col("v"))])
+    w = WindowExec(
+        pre,
+        [
+            WindowFunction("min", "mn", col("v"), rows_frame=(3, 2)),
+            WindowFunction("max", "mx", col("f"), rows_frame=(0, 4)),
+        ],
+        [col("g")],
+        [SortField(col("v"))],
+    )
+    got = collect_dict(w)
+    by_g = {}
+    srt = sorted(rows, key=lambda r: (r[0], r[1] is not None, r[1] or 0))
+    for g, v, x in srt:
+        by_g.setdefault(g, []).append((v, x))
+    exp_mn, exp_mx = [], []
+    for g in sorted(by_g):
+        vs = by_g[g]
+        for i in range(len(vs)):
+            w1 = [t[0] for t in vs[max(0, i - 3): i + 3] if t[0] is not None]
+            exp_mn.append(min(w1) if w1 else None)
+            w2 = [t[1] for t in vs[i: i + 5] if t[1] is not None]
+            exp_mx.append(max(w2) if w2 else None)
+    assert got["mn"] == exp_mn
+    assert got["mx"] == exp_mx

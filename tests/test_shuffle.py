@@ -218,3 +218,36 @@ def test_range_partitioned_global_sort():
     assert ks == exp_ks
     key_of = lambda r: tuple((v is None, v) for v in r)
     assert sorted(rows, key=key_of) == sorted(allrows, key=key_of)
+
+
+def test_range_partitioning_mixed_string_widths():
+    """Range keys over string columns whose physical padded widths
+    differ per batch (runtime-width strings): word counts are aligned
+    per field, so ordering stays correct."""
+    from blaze_tpu.batch import Column, RecordBatch
+    from blaze_tpu.ops import SortExec, SortField
+    from blaze_tpu.parallel import RangePartitioning
+
+    schema = Schema([Field("s", DataType.string(16))])
+
+    def batch_with_width(strings, width):
+        n = len(strings)
+        data = np.zeros((n, width), np.uint8)
+        lengths = np.zeros(n, np.int32)
+        for i, t in enumerate(strings):
+            b = t.encode()
+            data[i, : len(b)] = np.frombuffer(b, np.uint8)
+            lengths[i] = len(b)
+        col_ = Column(DataType.string(16), data, np.ones(n, bool), lengths)
+        return RecordBatch(schema, [col_], n)
+
+    b1 = batch_with_width(["apple", "zebra", "mango"], 8)       # 1 data word
+    b2 = batch_with_width(["banana", "cherry", "apricots"], 16)  # 2 data words
+    src = MemoryScanExec([[b1], [b2]], schema)
+    ex = NativeShuffleExchangeExec(src, RangePartitioning([SortField(col("s"))], 2))
+    srt = SortExec(ex, [SortField(col("s"))])
+    got = []
+    for p in range(2):
+        for b in srt.execute(p, TaskContext(p, 2)):
+            got.extend(batch_to_pydict(b)["s"])
+    assert got == sorted(["apple", "zebra", "mango", "banana", "cherry", "apricots"])
