@@ -54,6 +54,14 @@ class WindowFunction:
     rows_frame: Optional[Tuple[Optional[int], Optional[int]]] = None
 
 
+def _minmax_sentinel(dt, kind: str):
+    """Identity element for a min/max reduce of dtype ``dt``."""
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.array(jnp.inf if kind == "min" else -jnp.inf, dt)
+    info = jnp.iinfo(dt)
+    return jnp.array(info.max if kind == "min" else info.min, dt)
+
+
 def _build_window_kernel(in_schema, functions_, part_by, ord_by):
     @jax.jit
     def kernel(cols: Tuple[Column, ...], num_rows):
@@ -189,15 +197,7 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
                                 "running/whole-partition frames)"
                             )
                         dt = c.data.dtype
-                        if jnp.issubdtype(dt, jnp.floating):
-                            sentinel = jnp.array(
-                                jnp.inf if f.kind == "min" else -jnp.inf, dt
-                            )
-                        else:
-                            info = jnp.iinfo(dt)
-                            sentinel = jnp.array(
-                                info.max if f.kind == "min" else info.min, dt
-                            )
+                        sentinel = _minmax_sentinel(dt, f.kind)
                         op = jnp.minimum if f.kind == "min" else jnp.maximum
                         max_w = p_ + q_ + 1
                         levels = max(1, int(np.ceil(np.log2(max_w))) + 1)
@@ -245,15 +245,7 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
                         # associative scan carrying partition-boundary
                         # flags, then gathered at each row's peer end
                         dt = c.data.dtype
-                        if jnp.issubdtype(dt, jnp.floating):
-                            sentinel = jnp.array(
-                                jnp.inf if f.kind == "min" else -jnp.inf, dt
-                            )
-                        else:
-                            info = jnp.iinfo(dt)
-                            sentinel = jnp.array(
-                                info.max if f.kind == "min" else info.min, dt
-                            )
+                        sentinel = _minmax_sentinel(dt, f.kind)
                         vals = jnp.where(valid, c.data, sentinel)
                         pick = jnp.minimum if f.kind == "min" else jnp.maximum
 

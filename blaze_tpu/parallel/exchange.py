@@ -78,21 +78,35 @@ def _build_range_kernels(schema: Schema, fields, n_out: int):
 
     @jax.jit
     def key_words(cols, num_rows):
-        """Per-FIELD word tuples: string keys emit a width-dependent
-        word count that can differ between batches (per-batch padding),
-        so the caller aligns each field's words across batches by
-        zero-padding the shorter lists (zero word == the zero padding
-        bytes already compare correctly)."""
+        """Order words with a SCHEMA-STATIC count: string key columns
+        normalize to their dtype width before word extraction (physical
+        padded widths vary per batch; naive cross-batch alignment with
+        zero words breaks DESCENDING keys, whose padding bytes invert
+        to ~0)."""
+        from ..batch import Column
+
         cap = cols[0].validity.shape[0]
         env = {f.name: c for f, c in zip(schema.fields, cols)}
         live = jnp.arange(cap) < num_rows
-        per_field = []
+        words = []
         for f in fields:
             c = lower(f.expr, schema, env, cap)
+            if c.dtype.is_string:
+                w_phys, w_decl = c.data.shape[-1], c.dtype.string_width
+                assert w_phys <= w_decl, (
+                    f"string key physical width {w_phys} exceeds dtype "
+                    f"width {w_decl}"
+                )
+                if w_phys < w_decl:
+                    c = Column(
+                        c.dtype,
+                        jnp.pad(c.data, ((0, 0), (0, w_decl - w_phys))),
+                        c.validity, c.lengths,
+                    )
             ws = order_words(c, f.ascending, f.nulls_first)
             # dead padding rows sort AFTER every live row
-            per_field.append(tuple(jnp.where(live, w, ~jnp.uint64(0)) for w in ws))
-        return tuple(per_field)
+            words.extend(jnp.where(live, w, ~jnp.uint64(0)) for w in ws)
+        return tuple(words)
 
     @jax.jit
     def boundaries_at(cat_words, positions):
@@ -329,23 +343,6 @@ class NativeShuffleExchangeExec(ExecNode):
         del per_map
         out: List[List] = [[] for _ in range(n_out)]
         if batches:
-            # align each FIELD's word count across batches (string
-            # widths are per-batch): pad shorter lists with zero words
-            n_fields = len(per_batch_words[0])
-            want = [
-                max(len(bw[fi]) for bw in per_batch_words)
-                for fi in range(n_fields)
-            ]
-            aligned = []
-            for bw, b in zip(per_batch_words, batches):
-                flat = []
-                for fi in range(n_fields):
-                    ws = list(bw[fi])
-                    while len(ws) < want[fi]:
-                        ws.append(jnp.zeros(b.capacity, jnp.uint64))
-                    flat.extend(ws)
-                aligned.append(tuple(flat))
-            per_batch_words = aligned
             n_words = len(per_batch_words[0])
             cat = tuple(
                 jnp.concatenate([w[k] for w in per_batch_words])

@@ -50,6 +50,14 @@ CLASSES = [
 ]
 
 DATE_SK_BASE = 2450815  # arbitrary julian-like base, spec-style
+
+
+def _n_customers(scale: float) -> int:
+    return max(50, int(100000 * scale))
+
+
+def _n_addresses(scale: float) -> int:
+    return max(25, _n_customers(scale) // 2)
 D_FIRST = (1998, 1, 1)
 D_LAST = (2002, 12, 31)
 
@@ -105,12 +113,14 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         st_data, st_len = _encode_options([STATES[i % len(STATES)] for i in range(n)], 8)
         co_data, co_len = _encode_options(["Unknown"] * n, 16)
         cty_data, cty_len = _encode_options([COUNTIES[i % len(COUNTIES)] for i in range(n)], 24)
+        zip_data, zip_len = _encode_options([f"{35000 + 137 * i:05d}" for i in range(n)], 16)
         return {
             "s_store_sk": (np.arange(1, n + 1, dtype=np.int64), None),
             "s_store_name": (data, lengths),
             "s_state": (st_data, st_len),
             "s_company_name": (co_data, co_len),
             "s_county": (cty_data, cty_len),
+            "s_zip": (zip_data, zip_len),
         }
     if name == "promotion":
         n = max(5, int(300 * scale))
@@ -153,17 +163,32 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
             "hd_vehicle_count": (((np.arange(n) % 5) - 1).astype(np.int32), None),
         }
     if name == "customer":
-        n = max(50, int(100000 * scale))
+        n = _n_customers(scale)
         sal, sal_len = _encode_options([SALUTATIONS[i % len(SALUTATIONS)] for i in range(n)], 8)
         fn_, fn_len = _encode_options([FIRST_NAMES[i % len(FIRST_NAMES)] for i in range(n)], 16)
         ln_, ln_len = _encode_options([LAST_NAMES[(i * 3) % len(LAST_NAMES)] for i in range(n)], 16)
         pf, pf_len = _encode_options([("Y" if i % 2 else "N") for i in range(n)], 8)
+        n_addr = _n_addresses(scale)
         return {
             "c_customer_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "c_current_addr_sk": (rng.randint(1, n_addr + 1, n).astype(np.int64), None),
             "c_salutation": (sal, sal_len),
             "c_first_name": (fn_, fn_len),
             "c_last_name": (ln_, ln_len),
             "c_preferred_cust_flag": (pf, pf_len),
+        }
+    if name == "customer_address":
+        n = _n_addresses(scale)
+        # ~10% of addresses share a store's 5-digit zip prefix so the
+        # q19 "customer zip != store zip" predicate filters real rows
+        zips = [
+            (f"{35000 + 137 * (i % 6):05d}" if i % 10 == 0 else f"{60000 + 31 * i:05d}")
+            for i in range(n)
+        ]
+        z_data, z_len = _encode_options([z[:5] + "-" + z[:4] for z in zips], 16)
+        return {
+            "ca_address_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "ca_zip": (z_data, z_len),
         }
     if name == "item":
         n = max(60, int(18000 * scale))
@@ -178,6 +203,8 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         class_id = rng.randint(1, len(CLASSES) + 1, n).astype(np.int32)
         cl_data, cl_len = _encode_options([CLASSES[c - 1] for c in class_id], 16)
         desc_data, desc_len = _encode_options([f"desc of item {k % 97}" for k in range(n)], 32)
+        mfi = rng.randint(1, 200, n).astype(np.int32)
+        mf_data, mf_len = _encode_options([f"manufact#{m}" for m in mfi], 24)
         return {
             "i_item_sk": (sk, None),
             "i_item_id": (id_data, id_len),
@@ -188,7 +215,8 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
             "i_class": (cl_data, cl_len),
             "i_category_id": (cat_id, None),
             "i_category": (c_data, c_len),
-            "i_manufact_id": (rng.randint(1, 200, n).astype(np.int32), None),
+            "i_manufact_id": (mfi, None),
+            "i_manufact": (mf_data, mf_len),
             "i_manager_id": (rng.randint(1, 40, n).astype(np.int32), None),
             "i_current_price": (_money(rng, n, 1, 99), None),
         }
@@ -203,7 +231,7 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         n_item = max(60, int(18000 * scale))
         n_cd = len(EDUCATIONS) * len(MARITALS) * len(GENDERS) * 4
         n_promo = max(5, int(300 * scale))
-        n_cust = max(50, int(100000 * scale))
+        n_cust = _n_customers(scale)
 
         lines_per = rng.randint(1, 26, n_tickets)
         n = int(lines_per.sum())

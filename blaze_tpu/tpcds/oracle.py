@@ -392,3 +392,52 @@ def oracle_q73(tables):
         buy_potentials={">10000", "Unknown"}, cnt_lo=1, cnt_hi=5,
         dep_vehicle_ratio=1.0,
     )
+
+
+def oracle_q19(tables):
+    """{(brand_id, brand, manufact_id, manufact): ext_price} for
+    out-of-zip sales in 1998-11 by manager-8 items."""
+    dd = tables["date_dim"]
+    d_set = set(
+        dd["d_date_sk"][0][(dd["d_moy"][0] == 11) & (dd["d_year"][0] == 1998)].tolist()
+    )
+    it = tables["item"]
+    i_ok = it["i_manager_id"][0] == 8
+    brands = _sv(it, "i_brand")
+    manufs = _sv(it, "i_manufact")
+    item_by_sk = {
+        int(sk): (int(it["i_brand_id"][0][i]), brands[i],
+                  int(it["i_manufact_id"][0][i]), manufs[i])
+        for i, sk in enumerate(it["i_item_sk"][0]) if i_ok[i]
+    }
+    c = tables["customer"]
+    addr_by_cust = dict(zip(
+        c["c_customer_sk"][0].tolist(), c["c_current_addr_sk"][0].tolist()
+    ))
+    ca = tables["customer_address"]
+    zips = _sv(ca, "ca_zip")
+    zip_by_addr = {int(sk): zips[i][:5] for i, sk in enumerate(ca["ca_address_sk"][0])}
+    st = tables["store"]
+    szips = _sv(st, "s_zip")
+    zip_by_store = {int(sk): szips[i][:5] for i, sk in enumerate(st["s_store_sk"][0])}
+
+    ss = tables["store_sales"]
+    sums = {}
+    d_sk = ss["ss_sold_date_sk"][0]; i_sk = ss["ss_item_sk"][0]
+    c_sk = ss["ss_customer_sk"][0]; s_sk = ss["ss_store_sk"][0]
+    price = ss["ss_ext_sales_price"][0]
+    for i in range(d_sk.shape[0]):
+        if int(d_sk[i]) not in d_set:
+            continue
+        itm = item_by_sk.get(int(i_sk[i]))
+        if itm is None:
+            continue
+        a_sk = addr_by_cust.get(int(c_sk[i]))
+        if a_sk is None:
+            continue
+        czip = zip_by_addr.get(int(a_sk))
+        szip = zip_by_store.get(int(s_sk[i]))
+        if czip is None or szip is None or czip == szip:
+            continue
+        sums[itm] = sums.get(itm, 0) + int(price[i])
+    return sums

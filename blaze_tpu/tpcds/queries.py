@@ -425,9 +425,51 @@ def q73(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+def q19(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Brand revenue from out-of-zip customers: 5-way star join with a
+    NON-EQUI residual (substr(ca_zip,1,5) <> substr(s_zip,1,5))."""
+    from ..exprs.ir import func
+
+    dt = FilterExec(t["date_dim"], (col("d_moy") == lit(11)) & (col("d_year") == lit(1998)))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    it = FilterExec(t["item"], col("i_manager_id") == lit(8))
+    it_p = ProjectExec(it, [col("i_item_sk"), col("i_brand_id"), col("i_brand"),
+                            col("i_manufact_id"), col("i_manufact")])
+    cust = ProjectExec(t["customer"], [col("c_customer_sk"), col("c_current_addr_sk")])
+    addr = ProjectExec(t["customer_address"], [col("ca_address_sk"), col("ca_zip")])
+    st = ProjectExec(t["store"], [col("s_store_sk"), col("s_zip")])
+    j = broadcast_join(dt_p, t["store_sales"], [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(it_p, j, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(cust, j, [col("c_customer_sk")], [col("ss_customer_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(addr, j, [col("ca_address_sk")], [col("c_current_addr_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    j = FilterExec(
+        j,
+        func("substring", col("ca_zip"), lit(1), lit(5))
+        != func("substring", col("s_zip"), lit(1), lit(5)),
+    )
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("i_brand_id"), "brand_id"),
+         GroupingExpr(col("i_brand"), "brand"),
+         GroupingExpr(col("i_manufact_id"), "manufact_id"),
+         GroupingExpr(col("i_manufact"), "manufact")],
+        [AggFunction("sum", col("ss_ext_sales_price"), "ext_price")],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("ext_price"), ascending=False), SortField(col("brand")),
+         SortField(col("brand_id")), SortField(col("manufact_id")),
+         SortField(col("manufact"))],
+        fetch=100,
+    )
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q3": q3,
     "q7": q7,
+    "q19": q19,
     "q27": q27,
     "q34": q34,
     "q42": q42,

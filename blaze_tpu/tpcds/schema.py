@@ -34,6 +34,7 @@ TPCDS_SCHEMAS = {
         Field("i_category_id", T.int32()),
         Field("i_category", T.string(16)),
         Field("i_manufact_id", T.int32()),
+        Field("i_manufact", T.string(24)),
         Field("i_manager_id", T.int32()),
         Field("i_current_price", _m()),
     ]),
@@ -43,6 +44,7 @@ TPCDS_SCHEMAS = {
         Field("s_state", T.string(8)),
         Field("s_company_name", T.string(16)),
         Field("s_county", T.string(24)),
+        Field("s_zip", T.string(16)),
     ]),
     "promotion": Schema([
         Field("p_promo_sk", T.int64()),
@@ -63,10 +65,15 @@ TPCDS_SCHEMAS = {
     ]),
     "customer": Schema([
         Field("c_customer_sk", T.int64()),
+        Field("c_current_addr_sk", T.int64()),
         Field("c_salutation", T.string(8)),
         Field("c_first_name", T.string(16)),
         Field("c_last_name", T.string(16)),
         Field("c_preferred_cust_flag", T.string(8)),
+    ]),
+    "customer_address": Schema([
+        Field("ca_address_sk", T.int64()),
+        Field("ca_zip", T.string(16)),
     ]),
     "store_sales": Schema([
         Field("ss_sold_date_sk", T.int64()),

@@ -251,3 +251,20 @@ def test_range_partitioning_mixed_string_widths():
         for b in srt.execute(p, TaskContext(p, 2)):
             got.extend(batch_to_pydict(b)["s"])
     assert got == sorted(["apple", "zebra", "mango", "banana", "cherry", "apricots"])
+
+    # DESCENDING with prefix-related keys across widths: inverted
+    # padding words (~0) must not disagree with a narrower batch's
+    # normalized words (regression: zero-word alignment broke this)
+    b1 = batch_with_width(["applepie", "zebra", "aaa"], 8)
+    b2 = batch_with_width(["applepieX", "applepie", "mango"], 16)
+    src = MemoryScanExec([[b1], [b2]], schema)
+    fields_d = [SortField(col("s"), ascending=False)]
+    ex = NativeShuffleExchangeExec(src, RangePartitioning(fields_d, 2))
+    srt = SortExec(ex, fields_d)
+    got = []
+    for p in range(2):
+        for b in srt.execute(p, TaskContext(p, 2)):
+            got.extend(batch_to_pydict(b)["s"])
+    assert got == sorted(
+        ["applepie", "zebra", "aaa", "applepieX", "applepie", "mango"], reverse=True
+    )

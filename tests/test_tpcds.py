@@ -204,3 +204,16 @@ def test_q34(ticket_data, ticket_scans):
     _check_ticket_report(
         run(build_query("q34", ticket_scans, N_PARTS)), O.oracle_q34(ticket_data)
     )
+
+
+def test_q19(data, scans):
+    got = run(build_query("q19", scans, N_PARTS))
+    exp = O.oracle_q19(data)
+    assert got["brand_id"], "q19 returned no rows"
+    keys = list(zip(got["brand_id"], got["brand"], got["manufact_id"], got["manufact"]))
+    assert len(set(keys)) == len(keys)
+    for key, price in zip(keys, got["ext_price"]):
+        assert exp.get(key) == price, key
+    if len(exp) <= 100:
+        assert set(keys) == set(exp)
+    assert got["ext_price"] == sorted(got["ext_price"], reverse=True)
