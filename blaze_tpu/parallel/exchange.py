@@ -40,6 +40,49 @@ def default_shuffle_manager() -> LocalShuffleManager:
         return _default_manager
 
 
+class _HbmBudgetExceeded(Exception):
+    """In-process materialization would exceed the HBM budget; the
+    caller falls back to the spillable file shuffle."""
+
+
+class _BudgetTracker:
+    """Thread-safe device-memory estimate for an in-process
+    materialization.  ``multiplier`` accounts for the path's resident
+    copies (sorted copy = 2x; range also holds key words ~= 3x).
+    ``strict=False`` logs instead of raising (paths with no fallback
+    tier)."""
+
+    def __init__(self, budget: int, multiplier: int, strict: bool):
+        self._budget = budget
+        self._multiplier = multiplier
+        self._strict = strict
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._warned = False
+
+    def add(self, nbytes: int) -> None:
+        with self._lock:
+            self._bytes += nbytes
+            over = self._bytes * self._multiplier > self._budget
+            warned = self._warned
+            if over:
+                self._warned = True
+        if over:
+            if self._strict:
+                raise _HbmBudgetExceeded
+            if not warned:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "range exchange exceeds the HBM budget (%d bytes "
+                    "buffered, x%d resident); no spill tier for range "
+                    "partitioning yet — raise spark.blaze.tpu.hbmBudget "
+                    "or reduce the stage output",
+                    self._bytes, self._multiplier,
+                )
+
+
+
 def _split_pending(pending, n_out: int):
     """Shared tail of the in-process materializations: ONE host sync
     for all pid counts, device slices per partition, then coalesce each
@@ -144,6 +187,7 @@ class NativeShuffleExchangeExec(ExecNode):
         self.shuffle_id = next(_shuffle_ids)
         self.parallel_map_tasks = parallel_map_tasks
         self._materialized = False
+        self._hbm_fallback = False
         self._lock = threading.Lock()
         self._reader = IpcReaderExec(
             child.schema,
@@ -186,6 +230,7 @@ class NativeShuffleExchangeExec(ExecNode):
         """
         import jax.numpy as jnp
 
+        from .. import conf
         from ..batch import RecordBatch
         from .shuffle import (
             RangePartitioning, RoundRobinPartitioning, non_opaque_cols,
@@ -210,6 +255,9 @@ class NativeShuffleExchangeExec(ExecNode):
             writer.metrics = self.metrics
 
         cancelled = False
+        tracker = _BudgetTracker(
+            int(conf.DEVICE_MEMORY_BUDGET.get()), multiplier=2, strict=True
+        )
 
         def run_map(m: int):
             """One map task: returns [(sorted device batch, counts)] or
@@ -223,6 +271,7 @@ class NativeShuffleExchangeExec(ExecNode):
                 if not caller_ctx.is_task_running():
                     cancelled = True
                     return local
+                tracker.add(batch.memory_size())
                 b = batch.to_device()
                 if n_out == 1:
                     local.append((b, None))
@@ -292,6 +341,7 @@ class NativeShuffleExchangeExec(ExecNode):
         into a total order."""
         import jax.numpy as jnp
 
+        from .. import conf
         from ..batch import RecordBatch
         from ..exprs.compile import expr_key
         from ..runtime.kernel_cache import cached_kernel, schema_key
@@ -317,6 +367,12 @@ class NativeShuffleExchangeExec(ExecNode):
 
         cancelled = False
 
+        # no strict raise: the file shuffle cannot do range
+        # partitioning, so there is no fallback tier — warn instead
+        tracker = _BudgetTracker(
+            int(conf.DEVICE_MEMORY_BUDGET.get()), multiplier=3, strict=False
+        )
+
         def collect_map(m: int):
             nonlocal cancelled
             ctx = TaskContext(m, n_maps)
@@ -325,6 +381,7 @@ class NativeShuffleExchangeExec(ExecNode):
                 if not caller_ctx.is_task_running():
                     cancelled = True
                     return local
+                tracker.add(batch.memory_size())
                 b = batch.to_device()
                 local.append(
                     (b, key_words(tuple(b.columns[i] for i in dev_idx), b.num_rows))
@@ -380,12 +437,35 @@ class NativeShuffleExchangeExec(ExecNode):
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         from .. import conf
 
-        if bool(conf.EXCHANGE_IN_PROCESS.get()):
+        def file_stream():
+            self.materialize()
+            n_maps = self.children[0].num_partitions()
+            blocks = self.manager.reduce_blocks(self.shuffle_id, n_maps, partition)
+            ctx.resources.put(f"shuffle_{self.shuffle_id}.{partition}", blocks)
+            yield from self._reader.execute(partition, ctx)
+
+        if bool(conf.EXCHANGE_IN_PROCESS.get()) and not self._hbm_fallback:
             def inproc_stream():
                 with self._lock:
-                    if getattr(self, "_inproc_outputs", None) is None:
-                        self._materialize_inprocess(ctx)
+                    if (
+                        getattr(self, "_inproc_outputs", None) is None
+                        and not self._hbm_fallback
+                    ):
+                        try:
+                            self._materialize_inprocess(ctx)
+                        except _HbmBudgetExceeded:
+                            import logging
+
+                            logging.getLogger(__name__).info(
+                                "exchange %s: stage output exceeds the HBM "
+                                "budget; falling back to the file shuffle",
+                                self.shuffle_id,
+                            )
+                            self._hbm_fallback = True
                     outputs = getattr(self, "_inproc_outputs", None)
+                if self._hbm_fallback:
+                    yield from file_stream()
+                    return
                 if outputs is None:  # materialization cancelled
                     return
                 # non-destructive read: a task retry can re-execute the
@@ -398,11 +478,4 @@ class NativeShuffleExchangeExec(ExecNode):
 
             return inproc_stream()
 
-        def stream():
-            self.materialize()
-            n_maps = self.children[0].num_partitions()
-            blocks = self.manager.reduce_blocks(self.shuffle_id, n_maps, partition)
-            ctx.resources.put(f"shuffle_{self.shuffle_id}.{partition}", blocks)
-            yield from self._reader.execute(partition, ctx)
-
-        return stream()
+        return file_stream()
