@@ -52,6 +52,7 @@ class WindowFunction:
     expr: Optional[Expr] = None
     whole_partition: bool = False  # True: unbounded..unbounded frame
     rows_frame: Optional[Tuple[Optional[int], Optional[int]]] = None
+    offset: int = 1  # lead/lag row offset
 
 
 def _minmax_sentinel(dt, kind: str):
@@ -119,6 +120,33 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
                 peers_at_start = jnp.take(peers_seen, start_of_row)
                 v = peers_seen - peers_at_start + 1
                 out_cols.append(Column(DataType.int64(), v, ones))
+            elif f.kind in ("lead", "lag"):
+                # offset row within the partition; NULL past the edge
+                c = lower(f.expr, in_schema, env, cap)
+                k = f.offset if f.kind == "lead" else -f.offset
+                src = pos + k
+                in_part = (src >= start_of_row) & (src <= part_end)
+                idx = jnp.clip(src, 0, cap - 1).astype(jnp.int32)
+                g = c.take(idx)
+                out_cols.append(
+                    Column(c.dtype, g.data, g.validity & in_part & ones,
+                           g.lengths, g.children)
+                )
+            elif f.kind in ("first_value", "last_value"):
+                # default frame: first over the partition start..peer
+                # end window == value at partition start; last == value
+                # at peer end (Spark's default RANGE frame semantics);
+                # whole_partition: last over the full partition
+                c = lower(f.expr, in_schema, env, cap)
+                if f.kind == "first_value":
+                    src = start_of_row
+                else:
+                    src = part_end if f.whole_partition else peer_end
+                idx = jnp.clip(src, 0, cap - 1).astype(jnp.int32)
+                g = c.take(idx)
+                out_cols.append(
+                    Column(c.dtype, g.data, g.validity & ones, g.lengths, g.children)
+                )
             else:
                 c = lower(f.expr, in_schema, env, cap)
                 valid = c.validity & live
@@ -303,6 +331,8 @@ class WindowExec(ExecNode):
         for f in self.functions:
             if f.kind in ("row_number", "rank", "dense_rank", "count"):
                 out_fields.append(Field(f.name, DataType.int64()))
+            elif f.kind in ("lead", "lag", "first_value", "last_value"):
+                out_fields.append(Field(f.name, infer_dtype(f.expr, in_schema)))
             elif f.kind == "sum":
                 out_fields.append(Field(f.name, sum_result_type(infer_dtype(f.expr, in_schema))))
             elif f.kind == "avg":
@@ -329,7 +359,7 @@ class WindowExec(ExecNode):
         self._kernel = cached_kernel(
             ("window", schema_key(in_schema),
              tuple((f.kind, f.name, None if f.expr is None else expr_key(f.expr),
-                    f.whole_partition, f.rows_frame) for f in functions_),
+                    f.whole_partition, f.rows_frame, f.offset) for f in functions_),
              tuple(expr_key(e) for e in part_by),
              tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in ord_by)),
             build,

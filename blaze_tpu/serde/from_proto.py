@@ -276,6 +276,9 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
                     f.kind, f.name,
                     expr_from_proto(f.expr) if f.has_expr else None,
                     f.whole_partition,
+                    # lead/lag: 0 is a legal offset (current row);
+                    # other kinds never read it (default 1)
+                    offset=f.offset if f.kind in ("lead", "lag") else (f.offset or 1),
                     rows_frame=(
                         (None if f.frame_preceding < 0 else f.frame_preceding,
                          None if f.frame_following < 0 else f.frame_following)

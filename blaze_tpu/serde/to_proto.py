@@ -348,6 +348,7 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
                 fp.has_expr = True
                 fp.expr.CopyFrom(expr_to_proto(f.expr))
             fp.whole_partition = f.whole_partition
+            fp.offset = f.offset
             if f.rows_frame is not None:
                 fp.has_rows_frame = True
                 p_, q_ = f.rows_frame

@@ -426,9 +426,30 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
             functions.append(WindowFunction("rank", out_name))
         elif cls == "DenseRank":
             functions.append(WindowFunction("dense_rank", out_name))
+        elif cls in ("Lead", "Lag"):
+            if wf.fields.get("ignoreNulls"):
+                raise UnsupportedSparkExec(f"{cls} IGNORE NULLS")
+            off_node = wf.children[1] if len(wf.children) > 1 else None
+            if off_node is None or off_node.name != "Literal":
+                raise UnsupportedSparkExec(f"{cls} with non-literal offset")
+            default = wf.children[2] if len(wf.children) > 2 else None
+            if default is not None and not (
+                default.name == "Literal" and default.fields.get("value") is None
+            ):
+                raise UnsupportedSparkExec(f"{cls} with non-null default")
+            functions.append(
+                WindowFunction(
+                    cls.lower(), out_name, convert_expr(wf.children[0]),
+                    offset=int(off_node.fields.get("value", 1)),
+                )
+            )
+        elif cls == "NthValue":
+            raise UnsupportedSparkExec("NthValue window function")
         elif cls == "AggregateExpression":
             a = _agg_function(wf)
-            kind = {"count_star": "count"}.get(a.fn, a.fn)
+            if a.fn == "first_ignores_null":
+                raise UnsupportedSparkExec("first(ignoreNulls) over a window")
+            kind = {"count_star": "count", "first": "first_value"}.get(a.fn, a.fn)
             if rows_frame is not None:
                 # raise the FALLBACK exception, not the engine's
                 # NotImplementedError, so the strategy tags NEVER

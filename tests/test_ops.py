@@ -549,11 +549,13 @@ def test_window_rows_frame_serde_roundtrip():
     pre = SortExec(src, [SortField(col("g")), SortField(col("v"))])
     w = WindowExec(
         pre,
-        [WindowFunction("sum", "s", col("v"), rows_frame=(3, None))],
+        [WindowFunction("sum", "s", col("v"), rows_frame=(3, None)),
+         WindowFunction("lag", "lg", col("v"), offset=2)],
         [col("g")], [SortField(col("v"))],
     )
     w2 = plan_from_proto(plan_to_proto(w))
     assert w2.functions[0].rows_frame == (3, None)
+    assert w2.functions[1].offset == 2
     assert collect_dict(w2) == collect_dict(w)
 
 
@@ -607,3 +609,46 @@ def test_window_rows_frame_sliding_minmax():
             exp_mx.append(max(w2) if w2 else None)
     assert got["mn"] == exp_mn
     assert got["mx"] == exp_mx
+
+
+def test_window_lead_lag_first_last():
+    from blaze_tpu.batch import batch_to_pydict
+    from blaze_tpu.ops import SortExec, WindowExec, WindowFunction
+
+    schema = Schema([Field("g", DataType.int32()), Field("v", DataType.int64())])
+    src = mem({"g": [1, 1, 1, 2, 2], "v": [5, 6, 7, 1, 2]}, schema)
+    pre = SortExec(src, [SortField(col("g")), SortField(col("v"))])
+    w = WindowExec(
+        pre,
+        [
+            WindowFunction("lead", "ld", col("v"), offset=1),
+            WindowFunction("lag", "lg", col("v"), offset=2),
+            WindowFunction("first_value", "fv", col("v")),
+            WindowFunction("last_value", "lv", col("v")),
+        ],
+        [col("g")],
+        [SortField(col("v"))],
+    )
+    got = collect_dict(w)
+    assert got["ld"] == [6, 7, None, 2, None]
+    assert got["lg"] == [None, None, 5, None, None]
+    assert got["fv"] == [5, 5, 5, 1, 1]
+    # default frame last_value = current peer-group end (no ties here)
+    assert got["lv"] == [5, 6, 7, 1, 2]
+
+
+def test_window_last_value_whole_partition():
+    from blaze_tpu.ops import SortExec, WindowExec, WindowFunction
+
+    schema = Schema([Field("g", DataType.int32()), Field("v", DataType.int64())])
+    src = mem({"g": [1, 1, 2], "v": [5, 7, 1]}, schema)
+    pre = SortExec(src, [SortField(col("g")), SortField(col("v"))])
+    w = WindowExec(
+        pre,
+        [WindowFunction("last_value", "lv", col("v"), whole_partition=True),
+         WindowFunction("lead", "l0", col("v"), offset=0)],
+        [col("g")], [SortField(col("v"))],
+    )
+    got = collect_dict(w)
+    assert got["lv"] == [7, 7, 1]
+    assert got["l0"] == [5, 7, 1]  # offset 0 = current row
