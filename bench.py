@@ -95,7 +95,7 @@ def main():
 
     # data size: keep datagen + host->device staging reasonable while
     # saturating the chip per batch
-    scale = float(sys.argv[1]) if len(sys.argv) > 1 else (8.0 if on_tpu else 0.01)
+    scale = float(sys.argv[1]) if len(sys.argv) > 1 else (8.0 if on_tpu else 0.1)
     # generate only the columns q06 reads (string synthesis dominates
     # datagen wall time at big scale factors; the query never sees them)
     q6_cols = ("l_quantity", "l_extendedprice", "l_discount", "l_shipdate")
@@ -109,7 +109,7 @@ def main():
     # (Blaze's q06 numbers likewise exclude dsdgen).  On TPU use ONE
     # batch: program-execution turnaround over the chip tunnel is ~70ms
     # regardless of size, so rows/s scales with rows-per-program
-    batch_rows = max(n_rows, 1 << 20) if on_tpu else 1 << 16
+    batch_rows = max(n_rows, 1 << 20) if on_tpu else 1 << 20
     parts = table_to_batches(table, lineitem_schema, 1, batch_rows=batch_rows, device=True)
     for b in parts[0]:
         for c in b.columns:
