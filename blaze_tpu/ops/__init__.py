@@ -23,6 +23,7 @@ from .window import WindowExec, WindowFunction
 from .expand import ExpandExec
 from .generate import GenerateExec
 from .object_agg import ObjectAggExec, Udaf
+from .udafs import approx_count_distinct, approx_percentile
 from .orc_scan import OrcScanExec
 from .parquet_scan import ParquetScanExec
 from .parquet_sink import ParquetSinkExec
@@ -33,6 +34,6 @@ __all__ = [
     "LimitExec", "UnionExec", "RenameColumnsExec", "EmptyPartitionsExec",
     "DebugExec", "CoalesceBatchesExec", "BroadcastJoinExec", "HashJoinExec",
     "SortMergeJoinExec", "WindowExec", "WindowFunction", "ExpandExec",
-    "ObjectAggExec", "Udaf",
+    "ObjectAggExec", "Udaf", "approx_count_distinct", "approx_percentile",
     "GenerateExec", "OrcScanExec", "ParquetScanExec", "ParquetSinkExec",
 ]

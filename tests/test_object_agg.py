@@ -134,3 +134,94 @@ def test_object_agg_over_task_definition():
         if v is not None:
             oracle.setdefault(k, set()).add(v)
     assert got == {k: len(s) for k, s in oracle.items()}
+
+
+def test_hll_approx_count_distinct():
+    """HLL++ distinct count within ~3% across a two-stage pipeline."""
+    import numpy as np
+
+    from blaze_tpu.ops import ObjectAggExec
+    from blaze_tpu.ops.udafs import approx_count_distinct
+
+    rng = np.random.RandomState(4)
+    n_parts, per = 3, 6000
+    true_distinct = 20000
+    parts = []
+    for p in range(n_parts):
+        vals = rng.randint(0, true_distinct, per)
+        parts.append([batch_from_pydict(
+            {"k": [0] * per, "v": [int(x) for x in vals]}, SCHEMA
+        )])
+    src = MemoryScanExec(parts, SCHEMA)
+    partial = ObjectAggExec(
+        src, AggMode.PARTIAL, [GroupingExpr(col("k"), "k")],
+        [approx_count_distinct(col("v"), "acd")],
+    )
+    ex = NativeShuffleExchangeExec(partial, HashPartitioning([col("k")], 2))
+    final = ObjectAggExec(
+        ex, AggMode.FINAL, [GroupingExpr(col("k"), "k")],
+        [approx_count_distinct(col("v"), "acd")],
+    )
+    got = {}
+    for p in range(2):
+        for b in final.execute(p, TaskContext(p, 2)):
+            d = batch_to_pydict(b)
+            got.update(zip(d["k"], d["acd"]))
+    exact = len({v for part in parts for b in part
+                 for v in batch_to_pydict(b)["v"]})
+    assert abs(got[0] - exact) / exact < 0.03, (got[0], exact)
+
+
+def test_tdigest_approx_percentile():
+    """t-digest median/p90 within 2% of exact across partitions +
+    TaskDefinition roundtrip (pickle-able partial finish)."""
+    import numpy as np
+
+    from blaze_tpu.ops import ObjectAggExec
+    from blaze_tpu.ops.udafs import approx_percentile
+    from blaze_tpu.serde.from_proto import run_task
+    from blaze_tpu.serde.to_proto import task_definition
+
+    rng = np.random.RandomState(8)
+    all_vals = []
+    parts = []
+    for p in range(3):
+        vals = rng.gamma(3.0, 100.0, 4000)
+        all_vals.extend(vals)
+        parts.append([batch_from_pydict(
+            {"k": [0] * len(vals), "v": [int(x) for x in vals]}, SCHEMA
+        )])
+    src = MemoryScanExec(parts, SCHEMA)
+    partial = ObjectAggExec(
+        src, AggMode.PARTIAL, [GroupingExpr(col("k"), "k")],
+        [approx_percentile(col("v"), 0.5, "p50"),
+         approx_percentile(col("v"), 0.9, "p90")],
+    )
+    final = ObjectAggExec(
+        partial, AggMode.FINAL, [GroupingExpr(col("k"), "k")],
+        [approx_percentile(col("v"), 0.5, "p50"),
+         approx_percentile(col("v"), 0.9, "p90")],
+    )
+    td = task_definition(final, "t", 0, 0)
+    got = {}
+    for b in run_task(td):
+        d = batch_to_pydict(b)
+        got["p50"] = d["p50"][0]
+        got["p90"] = d["p90"][0]
+    exact50 = float(np.percentile([int(x) for x in all_vals], 50))
+    exact90 = float(np.percentile([int(x) for x in all_vals], 90))
+    assert abs(got["p50"] - exact50) / exact50 < 0.02, (got["p50"], exact50)
+    assert abs(got["p90"] - exact90) / exact90 < 0.02, (got["p90"], exact90)
+
+
+def test_hash64_process_stable():
+    """_hash64 must NOT inherit PYTHONHASHSEED randomization (sketches
+    merge across processes): golden values pin the encoding."""
+    from blaze_tpu.ops.udafs import _hash64
+
+    assert _hash64(42) == 1617879888388836812
+    assert _hash64("abc") == 379167468994990588
+    assert _hash64(2.5) == 6632595409814502509
+    assert _hash64(2.0) == _hash64(2)      # numeric equality
+    assert _hash64(float("nan")) == _hash64(float("nan"))
+    assert _hash64(True) != _hash64(1)     # bool is its own domain
