@@ -25,13 +25,13 @@ from ..ops import (
     RenameColumnsExec, SortExec, SortField, UnionExec, WindowExec,
     WindowFunction,
 )
-from ..ops.generate import NativeGenerator
+from ..ops.generate import NativeGenerator, json_tuple_generator
 from ..ops.joins import BroadcastJoinExec, HashJoinExec, JoinType, SortMergeJoinExec
 from ..parallel import (
     BroadcastExchangeExec, HashPartitioning, NativeShuffleExchangeExec,
     RoundRobinPartitioning, SinglePartitioning,
 )
-from ..schema import Schema
+from ..schema import DataType, Field, Schema
 from .expr_converter import UnsupportedSparkExpr, convert_expr
 from .plan_json import SparkNode, expr_id
 
@@ -519,11 +519,7 @@ def _convert_generate(node: SparkNode, ctx: ConversionContext) -> ExecNode:
     if gen is None:
         raise UnsupportedSparkExec("GenerateExec without generator")
     outer = bool(node.fields.get("outer", False))
-    if gen.name in ("Explode", "PosExplode"):
-        kind = "explode" if gen.name == "Explode" else "pos_explode"
-        spec = NativeGenerator(kind, convert_expr(gen.children[0]))
-        out = GenerateExec(child, spec, [], outer=outer)
-        # rename generator outputs to their #ids
+    def rename_gen_outputs(out: ExecNode) -> ExecNode:
         gout = node.expr_list("generatorOutput")
         if gout:
             base = [f.name for f in child.schema.fields]
@@ -533,6 +529,33 @@ def _convert_generate(node: SparkNode, ctx: ConversionContext) -> ExecNode:
                 gen_names.append(f"#{eid}" if eid is not None else _attr_user_name(a))
             out = RenameColumnsExec(out, base + gen_names)
         return out
+
+    if gen.name in ("Explode", "PosExplode"):
+        kind = "explode" if gen.name == "Explode" else "pos_explode"
+        spec = NativeGenerator(kind, convert_expr(gen.children[0]))
+        return rename_gen_outputs(GenerateExec(child, spec, [], outer=outer))
+    if gen.name == "JsonTuple":
+        # children = [json expr, field-name literals...]
+        names = []
+        for k in gen.children[1:]:
+            if k.name != "Literal":
+                raise UnsupportedSparkExec("json_tuple with non-literal field")
+            names.append(str(k.fields.get("value")))
+        json_expr = convert_expr(gen.children[0])
+        # extracted values are substrings of the input document, so its
+        # width bounds the field width
+        from ..exprs.compile import infer_dtype
+
+        in_t = infer_dtype(json_expr, child.schema)
+        width = in_t.string_width if in_t.is_string else 64
+        out = GenerateExec(
+            child,
+            json_tuple_generator(names),
+            [json_expr],
+            [Field(f"c{i}", DataType.string(width)) for i in range(len(names))],
+            outer=outer,
+        )
+        return rename_gen_outputs(out)
     raise UnsupportedSparkExec(f"generator {gen.name}")
 
 

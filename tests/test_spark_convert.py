@@ -492,3 +492,29 @@ def test_range_partitioning_plan_global_sort():
     srt = F.sort([F.sort_order(F.attr("l_extendedprice", 2))], ex)
     out = sess.execute(F.flatten(srt))
     assert out["#2"] == sorted(data["l_extendedprice"])
+
+
+def test_generate_json_tuple_conversion():
+    """Spark GenerateExec(JsonTuple) converts to the host json_tuple
+    generator (≙ generate/json_tuple.rs via the UDTF seam)."""
+    sess = BlazeSparkSession()
+    schema = Schema([Field("j", DataType.string(64))])
+    sess.register_table(
+        "t", {"j": ['{"a":"1","b":"x"}', '{"a":"2"}', None]}, schema
+    )
+    s = F.scan("t", [F.attr("j", 1, "string")])
+    g = F.T(
+        F.P + "GenerateExec",
+        [s],
+        generator=F.flatten(F.T(
+            F.X + "JsonTuple",
+            [F.attr("j", 1, "string"), F.lit("a", "string"), F.lit("b", "string")],
+        )),
+        requiredChildOutput=[],
+        outer=False,
+        generatorOutput=[F.flatten(F.attr("a", 10, "string")),
+                         F.flatten(F.attr("b", 11, "string"))],
+    )
+    out = sess.execute(F.flatten(g))
+    assert out["#10"] == ["1", "2", None]
+    assert out["#11"] == ["x", None, None]
