@@ -441,3 +441,62 @@ def oracle_q19(tables):
             continue
         sums[itm] = sums.get(itm, 0) + int(price[i])
     return sums
+
+
+def _oracle_manufact_window(tables, group_col):
+    """{(manufact_id, qoy_or_moy): (sum, avg_unscaled)} rows passing
+    the |sum-avg|/avg > 0.1 filter (avg at scale+4 HALF_UP)."""
+    it = tables["item"]
+    cats = _sv(it, "i_category")
+    clss = _sv(it, "i_class")
+    a = {(c, k) for c in ("Books", "Children", "Electronics")
+         for k in ("personal", "self-help", "reference")}
+    b = {(c, k) for c in ("Women", "Music", "Men")
+         for k in ("accessories", "classical", "fragrances")}
+    keep = a | b
+    manu_by_sk = {
+        int(sk): int(it["i_manufact_id"][0][i])
+        for i, sk in enumerate(it["i_item_sk"][0])
+        if (cats[i], clss[i]) in keep
+    }
+    dd = tables["date_dim"]
+    grp_by_sk = {
+        int(sk): int(g)
+        for sk, g, y in zip(dd["d_date_sk"][0], dd[group_col][0], dd["d_year"][0])
+        if y in (1999, 2000)
+    }
+    st_set = set(tables["store"]["s_store_sk"][0].tolist())
+    ss = tables["store_sales"]
+    sums = {}
+    i_sk = ss["ss_item_sk"][0]; d_sk = ss["ss_sold_date_sk"][0]
+    s_sk = ss["ss_store_sk"][0]; price = ss["ss_sales_price"][0]
+    for i in range(i_sk.shape[0]):
+        m = manu_by_sk.get(int(i_sk[i]))
+        if m is None:
+            continue
+        g = grp_by_sk.get(int(d_sk[i]))
+        if g is None or int(s_sk[i]) not in st_set:
+            continue
+        sums[(m, g)] = sums.get((m, g), 0) + int(price[i])
+    parts = {}
+    for (m, g), sv in sums.items():
+        parts.setdefault(m, []).append(sv)
+    out = {}
+    for (m, g), sv in sums.items():
+        vals = parts[m]
+        avg_unscaled = int(_round_half_up(np.array(
+            [float(sum(vals)) * float(10**4) / len(vals)]
+        ))[0])
+        sum_f = float(sv) / 100.0
+        avg_f = avg_unscaled / 1e6
+        if avg_f > 0 and abs(sum_f - avg_f) / avg_f > 0.1:
+            out[(m, g)] = (sv, avg_unscaled)
+    return out
+
+
+def oracle_q53(tables):
+    return _oracle_manufact_window(tables, "d_qoy")
+
+
+def oracle_q63(tables):
+    return _oracle_manufact_window(tables, "d_moy")

@@ -425,6 +425,76 @@ def q73(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+def _manufact_window_report(t, n_parts, *, group_col, avg_name, order_first):
+    """Shared q53/q63 shape: quarterly/monthly manufacturer sales vs
+    the manufacturer's window average, CASE-guarded ratio filter."""
+    from ..exprs.ir import Case, func
+    from ..ops import SortExec, WindowExec, WindowFunction
+    from ..parallel import NativeShuffleExchangeExec, SinglePartitioning
+
+    cat_a = col("i_category").isin(lit("Books"), lit("Children"), lit("Electronics"))
+    cls_a = col("i_class").isin(lit("personal"), lit("self-help"), lit("reference"))
+    cat_b = col("i_category").isin(lit("Women"), lit("Music"), lit("Men"))
+    cls_b = col("i_class").isin(lit("accessories"), lit("classical"), lit("fragrances"))
+    it = FilterExec(t["item"], (cat_a & cls_a) | (cat_b & cls_b))
+    it_p = ProjectExec(it, [col("i_item_sk"), col("i_manufact_id")])
+    dt = FilterExec(t["date_dim"], col("d_year").isin(lit(1999), lit(2000)))
+    dt_p = ProjectExec(dt, [col("d_date_sk"), col(group_col)])
+    st_p = ProjectExec(t["store"], [col("s_store_sk")])
+    j = broadcast_join(it_p, t["store_sales"], [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(dt_p, j, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("i_manufact_id"), "i_manufact_id"),
+         GroupingExpr(col(group_col), group_col)],
+        [AggFunction("sum", col("ss_sales_price"), "sum_sales")],
+        n_parts,
+    )
+    single = NativeShuffleExchangeExec(agg, SinglePartitioning())
+    pre = SortExec(single, [SortField(col("i_manufact_id"))])
+    w = WindowExec(
+        pre,
+        [WindowFunction("avg", avg_name, col("sum_sales"), whole_partition=True)],
+        [col("i_manufact_id")],
+        [],
+    )
+    f64 = DataType.float64()
+    sum_f = col("sum_sales").cast(f64)
+    avg_f = col(avg_name).cast(f64)
+    ratio = Case([(avg_f > lit(0.0), func("abs", sum_f - avg_f) / avg_f)], None)
+    filt = FilterExec(w, ratio > lit(0.1))
+    # spec orderings (ascending): q53 avg, sum, manufact;
+    # q63 manufact, avg, sum
+    order = (
+        [SortField(col(avg_name)), SortField(col("sum_sales")),
+         SortField(col("i_manufact_id"))]
+        if order_first == "avg"
+        else [SortField(col("i_manufact_id")), SortField(col(avg_name)),
+              SortField(col("sum_sales"))]
+    )
+    proj = ProjectExec(
+        filt,
+        [col("i_manufact_id"), col(group_col), col("sum_sales"), col(avg_name)],
+        ["i_manufact_id", group_col, "sum_sales", avg_name],
+    )
+    return single_sorted(proj, order, fetch=100)
+
+
+def q53(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    return _manufact_window_report(
+        t, n_parts, group_col="d_qoy", avg_name="avg_quarterly_sales",
+        order_first="avg",
+    )
+
+
+def q63(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    return _manufact_window_report(
+        t, n_parts, group_col="d_moy", avg_name="avg_monthly_sales",
+        order_first="manufact",
+    )
+
+
 def q19(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     """Brand revenue from out-of-zip customers: 5-way star join with a
     NON-EQUI residual (substr(ca_zip,1,5) <> substr(s_zip,1,5))."""
@@ -473,8 +543,10 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q27": q27,
     "q34": q34,
     "q42": q42,
+    "q53": q53,
     "q52": q52,
     "q55": q55,
+    "q63": q63,
     "q73": q73,
     "q89": q89,
     "q96": q96,

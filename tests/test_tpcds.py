@@ -217,3 +217,38 @@ def test_q19(data, scans):
     if len(exp) <= 100:
         assert set(keys) == set(exp)
     assert got["ext_price"] == sorted(got["ext_price"], reverse=True)
+
+
+def _check_manufact_window(got, exp, group_col, avg_name, order_cols):
+    assert got["i_manufact_id"], "query returned no rows"
+    seen = set()
+    for m, g, sv, av in zip(
+        got["i_manufact_id"], got[group_col], got["sum_sales"], got[avg_name],
+    ):
+        key = (m, g)
+        assert key in exp, key
+        assert exp[key] == (sv, av), key
+        seen.add(key)
+    assert len(seen) == len(got["i_manufact_id"]), "duplicate rows"
+    assert len(seen) == min(len(exp), 100)
+    if len(exp) <= 100:
+        assert seen == set(exp)
+    # spec ordering (ascending lexicographic over order_cols)
+    rows = list(zip(*(got[c] for c in order_cols)))
+    assert rows == sorted(rows)
+
+
+def test_q53(data, scans):
+    _check_manufact_window(
+        run(build_query("q53", scans, N_PARTS)), O.oracle_q53(data), "d_qoy",
+        "avg_quarterly_sales",
+        ["avg_quarterly_sales", "sum_sales", "i_manufact_id"],
+    )
+
+
+def test_q63(data, scans):
+    _check_manufact_window(
+        run(build_query("q63", scans, N_PARTS)), O.oracle_q63(data), "d_moy",
+        "avg_monthly_sales",
+        ["i_manufact_id", "avg_monthly_sales", "sum_sales"],
+    )
