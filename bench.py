@@ -54,13 +54,15 @@ def _init_devices():
     import time as _time
 
     ok = False
-    for attempt in range(4):
-        if _probe_tpu():
+    # worst case ~3.5 min of probing: leave headroom under the
+    # driver's run timeout for datagen + the CPU-fallback bench
+    for attempt in range(3):
+        if _probe_tpu(timeout_s=60):
             ok = True
             break
         print(f"# bench: TPU probe {attempt + 1} failed", file=sys.stderr)
-        if attempt < 3:
-            _time.sleep(30)
+        if attempt < 2:
+            _time.sleep(20)
     import jax
 
     if ok:
