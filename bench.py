@@ -1,36 +1,154 @@
-"""Benchmark: TPC-H q06 throughput on one chip.
+"""Benchmark: TPC-H q06 + q01 throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line with the q06 metric as primary and q01 alongside:
+{"metric", "value", "unit", "vs_baseline", "q01_rows_per_sec",
+ "q01_vs_baseline", "backend", ...}.
 
-Config = BASELINE.json's first ladder rung: q06 (lineitem scan ->
-filter -> project -> sum-aggregate, single stage).  The measured kernel
-is the fused per-batch pipeline the engine executes for q06: predicate
-mask, projection, masked segment-sum — one XLA program per batch.
+Config = BASELINE.json's target ladder: q06 (scan -> filter -> project
+-> global sum) and q01 (scan -> filter -> project -> 4-group agg, 8
+aggregates) through the real engine plans (`tpch.queries.q6/q1`),
+rebuilt per iteration, fused + pruned exactly as `run_task` would.
 
-Baseline derivation (BASELINE.md): Blaze v4.0.0 runs TPC-H 1TB q06 in
-7.928 s on 7 nodes => 6e9 * 1.0 / 7.928 / 7 ≈ 108.1 M lineitem
-rows/s/node.  BASELINE.json's target is ">=2x over Blaze-CPU on q06"
-per chip, so vs_baseline = our rows/s/chip / 108.1e6 (>= 2.0 means the
-target is met).
+Baseline derivation (BASELINE.md, reference benchmark-results/tpch.md):
+Blaze v4.0.0 TPC-H 1TB on 7 nodes: q06 7.928 s => 108.1M rows/s/node;
+q01 40.473 s => 21.18M rows/s/node.  Target: >=2x per chip on both.
+
+Driver-window engineering (round-2 postmortem): the axon chip lease
+can be wedged, and backend init then HANGS rather than raising.  So:
+
+- the chip is probed in EXPENDABLE SUBPROCESSES, concurrently with
+  everything else, for most of the window (a lease can free at any
+  moment) — never in-process;
+- the CPU fallback number is computed EARLY in a subprocess, so a
+  JSON line exists no matter what happens later;
+- on a successful probe, the TPU measurement runs in a DETACHED child
+  (its own session: the driver's timeout-kill of this parent must not
+  kill a process holding the chip — that wedges the lease for hours).
+  The parent waits until its deadline, then prints the TPU line if the
+  child delivered, else the CPU line.
+
+Usage:
+  python bench.py             # driver mode: probe + fallback schedule
+  python bench.py SCALE       # smoke: current backend, tiny scale
+  python bench.py --cpu-child / --tpu-child OUT  (internal)
 """
 
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 
 
 BLAZE_Q06_ROWS_PER_SEC_PER_NODE = 6_000_000_000 / 7.928 / 7  # ≈ 108.1e6
+BLAZE_Q01_ROWS_PER_SEC_PER_NODE = 6_000_000_000 / 40.473 / 7  # ≈ 21.18e6
+
+# parent wall-clock budget before it must print a line (the driver's
+# run timeout bounds us from above; round-2's schedule fit ~10 min)
+BUDGET_S = float(os.environ.get("BLAZE_BENCH_BUDGET", "540"))
+SCALE_Q6 = float(os.environ.get("BLAZE_BENCH_SCALE_Q6", "8"))
+SCALE_Q1 = float(os.environ.get("BLAZE_BENCH_SCALE_Q1", "2"))
+CPU_SCALE = float(os.environ.get("BLAZE_BENCH_CPU_SCALE", "0.05"))
 
 
-def _probe_tpu(timeout_s: int = 90) -> bool:
-    """Probe TPU availability in a SUBPROCESS: a wedged chip lease
-    makes axon backend init HANG (not raise), and a hang in this
-    process would eat the driver's whole timeout with no JSON line.
-    The child is expendable; the parent stays clean."""
-    import subprocess
+def _measure(scale_q6: float, scale_q1: float, on_tpu: bool) -> dict:
+    """Run q06 + q01 through the engine on the already-initialized
+    backend; returns the result dict (no printing)."""
+    import jax
 
+    jax.config.update("jax_enable_x64", True)
+
+    from blaze_tpu.ops import MemoryScanExec
+    from blaze_tpu.ops.fusion import fuse_stages
+    from blaze_tpu.ops.pruning import prune_columns
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.schema import Schema
+    from blaze_tpu.tpch.datagen import generate_table, table_to_batches
+    from blaze_tpu.tpch.queries import q1, q6
+    from blaze_tpu.tpch.schema import TPCH_SCHEMAS
+
+    def stage(columns, scale):
+        # generate only the referenced columns (string synthesis
+        # dominates datagen at big scale factors) and stage ONE device
+        # batch: per-program turnaround through the chip tunnel is
+        # ~70ms regardless of size, so rows/s scales with
+        # rows-per-program
+        table = generate_table("lineitem", scale, columns=columns)
+        n_rows = table[columns[0]][0].shape[0]
+        schema = Schema([TPCH_SCHEMAS["lineitem"].field(c) for c in columns])
+        batch_rows = max(n_rows, 1 << 20) if on_tpu else 1 << 20
+        parts = table_to_batches(table, schema, 1, batch_rows=batch_rows, device=True)
+        # force H2D completion so staging stays outside the timed region
+        for b in parts[0]:
+            for c in b.columns:
+                np.asarray(c.data[:1])
+        return parts, schema, n_rows
+
+    def run_query(build, parts, schema, n_iters=3):
+        def once():
+            # REBUILD the plan each iteration: exchanges memoize their
+            # map side per exec instance
+            scans = {"lineitem": MemoryScanExec(parts, schema)}
+            plan = prune_columns(fuse_stages(build(scans, 1)))
+            out = []
+            for p in range(plan.num_partitions()):
+                for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+                    out.append(b)
+            # a D2H transfer is the only TRUE sync through the tunnel
+            # (block_until_ready returns without draining)
+            for b in out:
+                np.asarray(b.columns[0].data)
+            return out
+
+        once()  # compile warmup
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            once()
+        return (time.perf_counter() - t0) / n_iters
+
+    q6_cols = ("l_quantity", "l_extendedprice", "l_discount", "l_shipdate")
+    parts6, schema6, rows6 = stage(q6_cols, scale_q6)
+    dt6 = run_query(q6, parts6, schema6)
+    del parts6
+
+    q1_cols = ("l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+               "l_discount", "l_tax", "l_shipdate")
+    parts1, schema1, rows1 = stage(q1_cols, scale_q1)
+    dt1 = run_query(q1, parts1, schema1)
+
+    r6 = rows6 / dt6
+    r1 = rows1 / dt1
+    # bytes actually touched by the q06 pipeline per row (5 referenced
+    # columns + validity) — lets bandwidth be judged vs rows/s
+    return {
+        "metric": "tpch_q06_rows_per_sec_per_chip",
+        "value": round(r6, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(r6 / BLAZE_Q06_ROWS_PER_SEC_PER_NODE, 3),
+        "bytes_per_sec": round(r6 * (4 + 8 + 8 + 8 + 4), 1),
+        "q01_rows_per_sec": round(r1, 1),
+        "q01_vs_baseline": round(r1 / BLAZE_Q01_ROWS_PER_SEC_PER_NODE, 3),
+        "scale_q06": scale_q6,
+        "scale_q01": scale_q1,
+        "backend": "tpu" if on_tpu else "cpu",
+    }
+
+
+def _is_tpu_backend() -> bool:
+    import jax
+
+    return any(
+        "tpu" in str(d).lower() or "axon" in str(d).lower() for d in jax.devices()
+    )
+
+
+def _probe_once(timeout_s: float) -> bool:
+    """One expendable-subprocess probe: a wedged lease HANGS backend
+    init, and killing a probe stuck in register() is safe (it holds no
+    lease yet)."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
@@ -42,129 +160,124 @@ def _probe_tpu(timeout_s: int = 90) -> bool:
         return False
 
 
-def _init_devices():
-    """Initialize a JAX backend, preferring the real TPU.
-
-    Round-1 failure mode: axon init raised and the bench died before
-    printing its JSON line.  Round-2 failure mode: a wedged chip lease
-    makes init HANG.  Probe via expendable subprocesses (the lease can
-    free at any moment — retry for a few minutes), then init in-process
-    only on a successful probe; otherwise fall back to CPU so a number
-    is always produced (tagged with the backend used)."""
-    import time as _time
-
-    ok = False
-    # worst case ~3.5 min of probing: leave headroom under the
-    # driver's run timeout for datagen + the CPU-fallback bench
-    for attempt in range(3):
-        if _probe_tpu(timeout_s=60):
-            ok = True
-            break
-        print(f"# bench: TPU probe {attempt + 1} failed", file=sys.stderr)
-        if attempt < 2:
-            _time.sleep(20)
+def _cpu_child() -> None:
     import jax
 
-    if ok:
-        try:
-            return jax, jax.devices(), None
-        except RuntimeError as e:
-            print(f"# bench: init failed after probe: {e}", file=sys.stderr)
-            note = f"tpu_unavailable: {e}"
-    else:
-        note = "tpu_unavailable: probe timeout (wedged chip lease?)"
-    # fall back to CPU explicitly (the config, not the env var, is
-    # authoritative under the axon sitecustomize)
     jax.config.update("jax_platforms", "cpu")
-    return jax, jax.devices(), note
+    print(json.dumps(_measure(CPU_SCALE, CPU_SCALE, on_tpu=False)))
 
 
-def main():
-    jax, devices, fallback_note = _init_devices()
-    jax.config.update("jax_enable_x64", True)
-    on_tpu = any("tpu" in str(d).lower() or "axon" in str(d).lower() for d in devices)
+def _tpu_child(out_path: str) -> None:
+    # init the real backend in-process (only launched after a probe
+    # succeeded); write the result file atomically
+    import jax
 
-    import jax.numpy as jnp
+    result = _measure(SCALE_Q6, SCALE_Q1, on_tpu=_is_tpu_backend())
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(result))
+    os.replace(tmp, out_path)
 
-    from blaze_tpu.batch import RecordBatch
-    from blaze_tpu.exprs import col, lit
-    from blaze_tpu.ops import AggExec, AggFunction, AggMode, FilterExec, MemoryScanExec, ProjectExec
-    from blaze_tpu.runtime.context import TaskContext
-    from blaze_tpu.schema import DataType, Field, Schema
-    from blaze_tpu.tpch.datagen import generate_table, table_to_batches
-    from blaze_tpu.tpch.schema import TPCH_SCHEMAS
-    from blaze_tpu.tpch.queries import q6
 
-    # data size: keep datagen + host->device staging reasonable while
-    # saturating the chip per batch
-    scale = float(sys.argv[1]) if len(sys.argv) > 1 else (8.0 if on_tpu else 0.1)
-    # generate only the columns q06 reads (string synthesis dominates
-    # datagen wall time at big scale factors; the query never sees them)
-    q6_cols = ("l_quantity", "l_extendedprice", "l_discount", "l_shipdate")
-    table = generate_table("lineitem", scale, columns=q6_cols)
-    n_rows = table["l_quantity"][0].shape[0]
-    lineitem_schema = Schema(
-        [TPCH_SCHEMAS["lineitem"].field(c) for c in q6_cols]
+def _smoke(scale: float) -> None:
+    print(json.dumps(_measure(scale, scale, on_tpu=_is_tpu_backend())))
+
+
+def main() -> None:
+    t0 = time.time()
+    deadline = t0 + BUDGET_S
+
+    # --- CPU fallback line, computed early and concurrently
+    cpu_proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--cpu-child"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
     )
 
-    # stage once to device: the bench isolates the query pipeline
-    # (Blaze's q06 numbers likewise exclude dsdgen).  On TPU use ONE
-    # batch: program-execution turnaround over the chip tunnel is ~70ms
-    # regardless of size, so rows/s scales with rows-per-program
-    batch_rows = max(n_rows, 1 << 20) if on_tpu else 1 << 20
-    parts = table_to_batches(table, lineitem_schema, 1, batch_rows=batch_rows, device=True)
-    for b in parts[0]:
-        for c in b.columns:
-            c.data.block_until_ready() if hasattr(c.data, "block_until_ready") else None
+    # --- probe loop: the lease can free at ANY moment in the window
+    probe_ok = threading.Event()
+    stop = threading.Event()
 
-    def run_once():
-        # REBUILD the plan each iteration: exchanges memoize their map
-        # side per exec instance, so a reused plan would only re-time
-        # the reduce side — the full scan->filter->project->agg->
-        # exchange->final-agg pipeline must run every iteration
-        from blaze_tpu.ops.fusion import fuse_stages
-        from blaze_tpu.ops.pruning import prune_columns
+    def probe_loop():
+        while not stop.is_set() and time.time() < deadline - 60:
+            if _probe_once(timeout_s=min(75, max(15, deadline - 60 - time.time()))):
+                probe_ok.set()
+                return
+            stop.wait(10)
 
-        scans = {"lineitem": MemoryScanExec(parts, lineitem_schema)}
-        plan = prune_columns(fuse_stages(q6(scans, 1)))
-        out = []
-        for p in range(plan.num_partitions()):
-            for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
-                out.append(b)
-        # sync
-        for b in out:
-            np.asarray(b.columns[0].data)
-        return out
+    prober = threading.Thread(target=probe_loop, daemon=True)
+    prober.start()
 
-    run_once()  # compile warmup
-    t0 = time.perf_counter()
-    n_iters = 3
-    for _ in range(n_iters):
-        out = run_once()
-    dt = (time.perf_counter() - t0) / n_iters
+    # --- wait for a successful probe; hand the chip to a DETACHED child
+    # per-run path: a detached child from a PREVIOUS run may still be
+    # alive (by design — it is never killed) and must not be able to
+    # publish its stale result into this run's slot
+    tpu_result_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f".bench_tpu_result.{os.getpid()}.json",
+    )
+    tpu_child = None
+    while time.time() < deadline:
+        if tpu_child is None and probe_ok.is_set():
+            print("# bench: TPU probe ok, launching measurement child", file=sys.stderr)
+            tpu_child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--tpu-child", tpu_result_path],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True,  # NEVER killed with this parent:
+                # killing a chip-holding process wedges the lease for hours
+            )
+        if os.path.exists(tpu_result_path):
+            break
+        if tpu_child is not None and tpu_child.poll() not in (None, 0):
+            print(f"# bench: TPU child died rc={tpu_child.returncode}", file=sys.stderr)
+            break
+        time.sleep(2)
+    stop.set()
 
-    rows_per_sec = n_rows / dt
-    vs = rows_per_sec / BLAZE_Q06_ROWS_PER_SEC_PER_NODE
-    # bytes actually touched by the q06 pipeline: the 5 referenced
-    # lineitem columns (shipdate i32, discount/quantity/extendedprice
-    # i64) + validity bytes — lets MFU/bandwidth be judged vs rows/s
-    bytes_per_row = 4 + 8 + 8 + 8 + 4
-    result = {
-        "metric": "tpch_q06_rows_per_sec_per_chip",
-        "value": round(rows_per_sec, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(vs, 3),
-        "bytes_per_sec": round(rows_per_sec * bytes_per_row, 1),
-        "backend": "tpu" if on_tpu else "cpu",
-    }
-    if fallback_note:
-        result["note"] = fallback_note[:500]
+    tpu_line = None
+    if os.path.exists(tpu_result_path):
+        with open(tpu_result_path) as f:
+            tpu_line = json.load(f)
+
+    if tpu_line is not None and tpu_line.get("backend") == "tpu":
+        print(json.dumps(tpu_line))
+        return
+
+    # fall back to the CPU child's line (never killed: it holds no chip
+    # and should long be done; bounded wait for safety)
+    try:
+        out, _ = cpu_proc.communicate(timeout=max(5, deadline + 60 - time.time()))
+        line = out.decode().strip().splitlines()[-1]
+        result = json.loads(line)
+    except Exception as e:  # noqa: BLE001 — always emit a line
+        result = {
+            "metric": "tpch_q06_rows_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "rows/s",
+            "vs_baseline": 0.0,
+            "error": f"cpu fallback failed: {type(e).__name__}: {e}"[:300],
+        }
+    if tpu_line is not None:
+        result["note"] = "tpu child returned non-tpu backend"
+    elif probe_ok.is_set():
+        result["note"] = "tpu probe ok but measurement missed the deadline"
+    else:
+        result["note"] = "tpu_unavailable: all probes failed (wedged chip lease?)"
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
     try:
-        main()
+        if len(sys.argv) > 1 and sys.argv[1] == "--cpu-child":
+            _cpu_child()
+        elif len(sys.argv) > 1 and sys.argv[1] == "--tpu-child":
+            _tpu_child(sys.argv[2])
+        elif len(sys.argv) > 1:
+            _smoke(float(sys.argv[1]))
+        else:
+            main()
     except Exception as e:  # never die silently: emit a structured line
         import traceback
 

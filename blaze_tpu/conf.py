@@ -77,6 +77,7 @@ TOKIO_NUM_WORKER_THREADS = ConfEntry("spark.blaze.tokio.num.worker.threads", 2, 
 # bounded producer queue depth between host staging and device compute
 # (≙ rt.rs sync_channel(1) + tokio stream drive); 0 = synchronous
 PIPELINE_DEPTH = ConfEntry("spark.blaze.pipeline.depth", 2, int)
+RSS_FETCH_BARRIER_TIMEOUT = ConfEntry("spark.blaze.rss.fetchBarrierTimeout", 120.0, float)
 
 # TPU-specific knobs (no reference equivalent).
 ON_DEVICE = ConfEntry("spark.blaze.tpu.onDevice", True, _bool)

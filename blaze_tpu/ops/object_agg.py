@@ -18,6 +18,7 @@ the host, like every UDF fallback in the reference
 
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -36,7 +37,12 @@ class Udaf:
 
     - ``init()`` -> state
     - ``update(state, *arg_values)`` -> state   (None args = SQL null)
-    - ``merge(a, b)`` -> state
+    - ``merge(a, b)`` -> state.  ``a`` MAY be mutated in place and
+      returned (the executor deep-copies seeds before merging), but
+      ``b`` must be treated as READ-ONLY and must not be captured by
+      reference into the result: incoming states alias the exchange's
+      re-readable output, which a retried task will read again —
+      mutating or aliasing ``b`` silently corrupts retries.
     - ``finish(state)`` -> final value (matching ``result_dtype``)
     States must be picklable to cross exchanges.
     """
@@ -126,7 +132,15 @@ class ObjectAggExec(ExecNode):
                             key = tuple(kv[i] for kv in key_vals)
                             accs = groups.get(key)
                             if accs is None:
-                                groups[key] = [sc[i] for sc in state_cols]
+                                # COPY the seed: merge() mutates its
+                                # left arg in place, and these state
+                                # objects are shared with the in-process
+                                # exchange's re-readable output — a
+                                # retried task must see pristine states,
+                                # not ones we already merged into
+                                groups[key] = [
+                                    copy.deepcopy(sc[i]) for sc in state_cols
+                                ]
                             else:
                                 for ui, u in enumerate(self.udafs):
                                     accs[ui] = u.merge(accs[ui], state_cols[ui][i])

@@ -41,7 +41,14 @@ class RssPartitionWriterBase:
         pass
 
     def close(self) -> None:
-        pass
+        """Successful completion: commit this map task's pushes."""
+
+    def abort(self) -> None:
+        """Failure/cancellation: release resources WITHOUT committing —
+        a failed attempt must not count toward the reduce barrier.
+        Abstract on purpose: defaulting to close() would silently
+        commit failed attempts for writers where close() commits."""
+        raise NotImplementedError
 
 
 class LocalRssWriter(RssPartitionWriterBase):
@@ -55,6 +62,12 @@ class LocalRssWriter(RssPartitionWriterBase):
         self.partitions.setdefault(partition_id, []).append(data)
 
     def close(self) -> None:
+        self.closed = True
+
+    def abort(self) -> None:
+        # discard the attempt's partial pushes so a retry against the
+        # same writer does not stack duplicates on top of them
+        self.partitions.clear()
         self.closed = True
 
 
@@ -84,6 +97,8 @@ class RssShuffleWriterExec(ExecNode):
             try:
                 for batch in self.children[0].execute(partition, ctx):
                     if not ctx.is_task_running():
+                        # cancelled: do NOT commit a partial push set
+                        writer.abort()
                         return
                     with self.metrics.timer("elapsed_compute"):
                         if isinstance(self.partitioning, HashPartitioning) and n_out > 1:
@@ -123,7 +138,13 @@ class RssShuffleWriterExec(ExecNode):
                         with self.metrics.timer("output_io_time"):
                             writer.write(pid, payload)
                         self.metrics.add("data_size", len(payload))
-            finally:
+            except BaseException:
+                # failed attempt: close without committing (its retry
+                # will re-push and commit; committing here would let a
+                # reducer's barrier pass on missing/partial output)
+                writer.abort()
+                raise
+            else:
                 writer.flush()
                 writer.close()
             return

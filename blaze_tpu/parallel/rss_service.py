@@ -8,15 +8,26 @@ over durability from local ``.data``/``.index`` files); reduce tasks
 FETCH their partition's blocks and stream them through
 ``IpcReaderExec`` like any other shuffle read.
 
+Commit semantics (≙ Celeborn's mapper-end + commit-files barrier):
+pushes land in a per-(shuffle, map) STAGING area; COMMIT atomically
+publishes that map's staged blocks, REPLACING any earlier publication
+by the same map id — so a retried map task's re-push wins and a failed
+attempt's partial pushes are never double-served.  Reducers only ever
+see published blocks, and the FETCH barrier holds until the distinct
+committed map ids reach the expected map count.
+
 Wire protocol (length-prefixed, one request per connection state):
 
-    PUSH : u8=1, u32 shuffle_id, u32 partition, u32 len, bytes
-           -> u8 ack (1)
-    FETCH: u8=2, u32 shuffle_id, u32 partition
+    PUSH : u8=1, u32 shuffle_id, u32 map_id, u32 partition,
+           u32 len, bytes -> u8 ack (1)
+    FETCH: u8=2, u32 shuffle_id, u32 partition, u32 expected_maps
            -> u32 count, count x (u32 len, bytes)
-    COMMIT: u8=3, u32 shuffle_id -> u8 ack  (one per MAP TASK;
-           ≙ the Spark-side mapStatus commit — the barrier holds when
-           the commit count reaches the expected map count)
+           (blocks server-side until ``expected_maps`` DISTINCT map ids
+           have COMMITted; 0 = no barrier.  On barrier timeout the
+           reply is count=0xFFFFFFFF, u32 len, error message bytes, so
+           the client sees WHY.)
+    COMMIT: u8=3, u32 shuffle_id, u32 map_id -> u8 ack
+           (one per successful MAP TASK; publishes its staged blocks)
 
 The server is a plain threaded TCP server (host runtime concern — the
 TPU never sees RSS traffic; this is the DCN tier of SURVEY §2.3's
@@ -29,8 +40,9 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Set, Tuple
 
+from .. import conf
 from .rss import RssPartitionWriterBase
 
 
@@ -48,42 +60,87 @@ class RssServer:
     """In-memory block store behind a TCP endpoint."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        store: Dict[Tuple[int, int], List[bytes]] = {}
-        committed: Dict[int, int] = {}  # shuffle_id -> map-commit count
+        # published: (sid, map_id) -> {pid: [bytes]} (committed, immutable)
+        # committed: sid -> set of committed map ids
+        # (staging is CONNECTION-local: one connection = one map
+        # attempt, so a dropped/aborted attempt's pushes vanish with
+        # its socket and can never mix into another attempt's commit)
+        published: Dict[Tuple[int, int], Dict[int, List[bytes]]] = {}
+        committed: Dict[int, Set[int]] = {}
         lock = threading.Lock()
-        self._store = store
+        commit_cv = threading.Condition(lock)
+        self._published = published
         self._committed = committed
         self._lock = lock
+        self._commit_cv = commit_cv
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
+                # this attempt's staged pushes: (sid, mid) -> {pid: [bytes]}
+                staged: Dict[Tuple[int, int], Dict[int, List[bytes]]] = {}
                 try:
                     while True:
                         op_raw = sock.recv(1)
                         if not op_raw:
                             return
                         op = op_raw[0]
-                        if op == 1:  # PUSH
-                            sid, pid, ln = struct.unpack(
-                                "<III", _recv_exact(sock, 12)
+                        if op == 1:  # PUSH (staged until COMMIT)
+                            sid, mid, pid, ln = struct.unpack(
+                                "<IIII", _recv_exact(sock, 16)
                             )
                             data = _recv_exact(sock, ln)
-                            with lock:
-                                store.setdefault((sid, pid), []).append(data)
+                            staged.setdefault((sid, mid), {}).setdefault(
+                                pid, []
+                            ).append(data)
                             sock.sendall(b"\x01")
                         elif op == 2:  # FETCH
-                            sid, pid = struct.unpack("<II", _recv_exact(sock, 8))
-                            with lock:
-                                blocks = list(store.get((sid, pid), []))
+                            sid, pid, want = struct.unpack(
+                                "<III", _recv_exact(sock, 12)
+                            )
+                            with commit_cv:
+                                # mapStatus barrier: a reducer fetching
+                                # before every map committed would miss
+                                # in-flight blocks (≙ Celeborn gating
+                                # reads on the commit barrier)
+                                ok = commit_cv.wait_for(
+                                    lambda: len(committed.get(sid, ())) >= want,
+                                    timeout=float(conf.RSS_FETCH_BARRIER_TIMEOUT.get()),
+                                )
+                                have = len(committed.get(sid, ()))
+                                blocks = []
+                                if ok:
+                                    for mid in sorted(committed.get(sid, ())):
+                                        blocks.extend(
+                                            published.get((sid, mid), {}).get(pid, ())
+                                        )
+                            if not ok:
+                                # error frame: the diagnostic must reach
+                                # the CLIENT (a raise here would just
+                                # close the socket and read as a crash)
+                                msg = (
+                                    f"rss fetch barrier timeout: shuffle "
+                                    f"{sid} has {have}/{want} map commits"
+                                ).encode()
+                                sock.sendall(struct.pack("<I", 0xFFFFFFFF))
+                                sock.sendall(struct.pack("<I", len(msg)) + msg)
+                                continue
                             sock.sendall(struct.pack("<I", len(blocks)))
                             for b in blocks:
                                 sock.sendall(struct.pack("<I", len(b)))
                                 sock.sendall(b)
-                        elif op == 3:  # COMMIT (one per map task)
-                            (sid,) = struct.unpack("<I", _recv_exact(sock, 4))
-                            with lock:
-                                committed[sid] = committed.get(sid, 0) + 1
+                        elif op == 3:  # COMMIT (one per successful map task)
+                            sid, mid = struct.unpack("<II", _recv_exact(sock, 8))
+                            with commit_cv:
+                                # last attempt wins: REPLACE any earlier
+                                # publication by this map id (a retry's
+                                # blocks must not stack on a failed
+                                # attempt's partial ones)
+                                published[(sid, mid)] = staged.pop(
+                                    (sid, mid), {}
+                                )
+                                committed.setdefault(sid, set()).add(mid)
+                                commit_cv.notify_all()
                             sock.sendall(b"\x01")
                         else:
                             raise ConnectionError(f"bad rss opcode {op}")
@@ -109,11 +166,11 @@ class RssServer:
         self._server.server_close()
 
     def is_committed(self, shuffle_id: int, expected_maps: int = 1) -> bool:
-        """True once ``expected_maps`` map tasks have committed — only
-        then is a reducer's fetch complete (fetching earlier can miss
-        in-flight map output)."""
+        """True once ``expected_maps`` distinct map tasks have committed
+        — only then is a reducer's fetch complete (fetching earlier can
+        miss in-flight map output)."""
         with self._lock:
-            return self._committed.get(shuffle_id, 0) >= expected_maps
+            return len(self._committed.get(shuffle_id, ())) >= expected_maps
 
     def __enter__(self) -> "RssServer":
         return self.start()
@@ -124,15 +181,20 @@ class RssServer:
 
 class SocketRssWriter(RssPartitionWriterBase):
     """Client half of the push path — what the engine sees behind the
-    resources map (≙ CelebornPartitionWriter)."""
+    resources map (≙ CelebornPartitionWriter).  ``close()`` commits;
+    ``abort()`` closes WITHOUT committing (failed/cancelled attempts
+    must not count toward the reducers' barrier)."""
 
-    def __init__(self, host: str, port: int, shuffle_id: int):
+    def __init__(self, host: str, port: int, shuffle_id: int, map_id: int):
         self.shuffle_id = shuffle_id
+        self.map_id = map_id
         self._sock = socket.create_connection((host, port))
 
     def write(self, partition_id: int, data: bytes) -> None:
         self._sock.sendall(
-            b"\x01" + struct.pack("<III", self.shuffle_id, partition_id, len(data))
+            b"\x01" + struct.pack(
+                "<IIII", self.shuffle_id, self.map_id, partition_id, len(data)
+            )
         )
         self._sock.sendall(data)
         ack = _recv_exact(self._sock, 1)
@@ -141,21 +203,36 @@ class SocketRssWriter(RssPartitionWriterBase):
 
     def close(self) -> None:
         try:
-            self._sock.sendall(b"\x03" + struct.pack("<I", self.shuffle_id))
+            self._sock.sendall(
+                b"\x03" + struct.pack("<II", self.shuffle_id, self.map_id)
+            )
             _recv_exact(self._sock, 1)
         finally:
             self._sock.close()
 
+    def abort(self) -> None:
+        self._sock.close()
+
 
 def rss_fetch_blocks(
-    host: str, port: int, shuffle_id: int, partition: int
+    host: str, port: int, shuffle_id: int, partition: int,
+    expected_maps: int,
 ) -> List[bytes]:
     """Reduce-side fetch: the blocks feed ``IpcReaderExec`` through the
     resources map exactly like local shuffle file segments
-    (≙ BlazeRssShuffleReaderBase.readIpc)."""
+    (≙ BlazeRssShuffleReaderBase.readIpc).  The server holds the reply
+    until ``expected_maps`` distinct map tasks have committed, so a fast
+    reducer cannot observe a partial shuffle; REQUIRED (a default would
+    silently under-wait on multi-map shuffles) — pass 0 to skip the
+    barrier."""
     with socket.create_connection((host, port)) as sock:
-        sock.sendall(b"\x02" + struct.pack("<II", shuffle_id, partition))
+        sock.sendall(
+            b"\x02" + struct.pack("<III", shuffle_id, partition, expected_maps)
+        )
         (count,) = struct.unpack("<I", _recv_exact(sock, 4))
+        if count == 0xFFFFFFFF:  # server-side error frame
+            (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+            raise ConnectionError(_recv_exact(sock, ln).decode())
         out = []
         for _ in range(count):
             (ln,) = struct.unpack("<I", _recv_exact(sock, 4))

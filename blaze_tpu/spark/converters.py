@@ -187,17 +187,30 @@ def _agg_function(agg_expr: SparkNode) -> AggFunction:
     raise UnsupportedSparkExec(f"aggregate function {cls}")
 
 
-def _agg_mode(agg_exprs: Sequence[SparkNode]) -> AggMode:
+# sentinel for Spark's Complete mode, which has no engine AggMode —
+# _convert_agg lowers it to an in-partition PARTIAL->FINAL stack
+_COMPLETE = object()
+
+
+def _agg_mode(agg_exprs: Sequence[SparkNode]):
     modes = {a.string("mode", "Partial") for a in agg_exprs}
     if modes <= {"Partial"}:
         return AggMode.PARTIAL
     if modes <= {"PartialMerge"}:
         return AggMode.PARTIAL_MERGE
-    if modes <= {"Final", "Complete"}:
-        # Complete-mode aggs see raw input like Partial but emit final
-        # values; the engine runs them as PARTIAL+FINAL fused, which a
-        # single-exchange plan satisfies
-        return AggMode.FINAL if "Final" in modes else AggMode.PARTIAL
+    if modes == {"Complete"}:
+        # Complete = raw rows in, final values out, single stage.  The
+        # converter lowers it as an in-partition PARTIAL->FINAL stack
+        # (sound because Spark only plans Complete where the child
+        # already satisfies the group-by distribution requirement).
+        # The reference instead refuses (NativeAggBase.scala:126).
+        return _COMPLETE
+    if "Complete" in modes:
+        # mixed Final+Complete (AQE distinct rewrites): the Complete
+        # functions would be treated as state-merging over raw rows
+        raise UnsupportedSparkExec(f"mixed aggregate modes {modes}")
+    if modes <= {"Final"}:
+        return AggMode.FINAL
     raise UnsupportedSparkExec(f"mixed aggregate modes {modes}")
 
 
@@ -254,6 +267,14 @@ def _convert_scan(node: SparkNode, ctx: ConversionContext) -> ExecNode:
         table = ident.split(".")[-1]
     if table is None or table not in ctx.catalog:
         raise UnsupportedSparkExec(f"scan relation {ident!r} not in catalog")
+    # partition filters are enforced at the scan in Spark (FilterExec
+    # above the scan re-applies only the data filters) — dropping them
+    # silently returns rows from pruned partitions, so fall back
+    pf = node.fields.get("partitionFilters")
+    if isinstance(pf, list) and pf:
+        raise UnsupportedSparkExec(
+            f"FileSourceScanExec with {len(pf)} partitionFilters"
+        )
     scan = ctx.catalog[table]
     attrs = node.expr_list("output")
     exprs, names = [], []
@@ -294,11 +315,25 @@ def _convert_agg(node: SparkNode, ctx: ConversionContext) -> ExecNode:
         e, n = _named_expr(g)
         groupings.append(GroupingExpr(e, n))
     aggs = [_agg_function(a) for a in agg_exprs]
-    out: ExecNode = AggExec(
-        child, mode, groupings, aggs,
-        initial_input_buffer_offset=int(node.fields.get("initialInputBufferOffset", 0) or 0),
-        supports_partial_skipping=(mode == AggMode.PARTIAL),
-    )
+    if mode is _COMPLETE:
+        partial = AggExec(child, AggMode.PARTIAL, groupings, aggs)
+        out: ExecNode = AggExec(
+            partial, AggMode.FINAL,
+            [GroupingExpr(Col(g.name), g.name) for g in groupings], aggs,
+        )
+        mode = AggMode.FINAL
+    else:
+        # DISTINCT plans carry NO aggregateExpressions on either stage,
+        # so both classify as PARTIAL (no mode field to read).  That is
+        # value-correct — grouping-only PARTIAL and FINAL both emit the
+        # deduped keys — but partial-agg SKIPPING must stay off: the
+        # post-shuffle stage skipping would stream batch-local rows and
+        # leak cross-batch duplicates into the DISTINCT result.
+        out = AggExec(
+            child, mode, groupings, aggs,
+            initial_input_buffer_offset=int(node.fields.get("initialInputBufferOffset", 0) or 0),
+            supports_partial_skipping=(mode == AggMode.PARTIAL and bool(aggs)),
+        )
     if mode in (AggMode.FINAL,):
         res = node.expr_list("resultExpressions")
         if res:
@@ -369,12 +404,19 @@ def _join_sides(node: SparkNode, ctx: ConversionContext):
     return left, right, lkeys, rkeys, cond_e
 
 
-def _wrap_condition(out: ExecNode, cond_e) -> ExecNode:
-    # non-equi residual: post-join filter (the reference compiles the
-    # condition into the joiners; a filter is semantically equal for
-    # inner joins, which is the only place Spark plans put residuals
-    # for hash joins)
-    return FilterExec(out, cond_e) if cond_e is not None else out
+def _wrap_condition(out: ExecNode, cond_e, jt: JoinType) -> ExecNode:
+    # non-equi residual: post-join filter.  Sound ONLY for inner joins
+    # — for outer joins the condition decides matching (failed matches
+    # must still emit null-extended), and for semi/anti/existence the
+    # join output can't even reference the probe side's filter columns.
+    # The reference refuses any condition outright
+    # (BlazeConverters.scala `assert condition.isEmpty`); we accept the
+    # inner case and fall back otherwise.
+    if cond_e is None:
+        return out
+    if jt != JoinType.INNER:
+        raise UnsupportedSparkExec(f"join condition on {jt.name} join")
+    return FilterExec(out, cond_e)
 
 
 def _convert_bhj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
@@ -385,7 +427,7 @@ def _convert_bhj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
         out = BroadcastJoinExec(left, right, lkeys, rkeys, jt, build_is_left=True)
     else:
         out = BroadcastJoinExec(right, left, rkeys, lkeys, jt, build_is_left=False)
-    return _wrap_condition(out, cond_e)
+    return _wrap_condition(out, cond_e, jt)
 
 
 def _convert_shj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
@@ -396,14 +438,14 @@ def _convert_shj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
         out = HashJoinExec(left, right, lkeys, rkeys, jt, build_is_left=True)
     else:
         out = HashJoinExec(right, left, rkeys, lkeys, jt, build_is_left=False)
-    return _wrap_condition(out, cond_e)
+    return _wrap_condition(out, cond_e, jt)
 
 
 def _convert_smj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
     left, right, lkeys, rkeys, cond_e = _join_sides(node, ctx)
     jt = _join_type(node)
     out = SortMergeJoinExec(left, right, lkeys, rkeys, jt)
-    return _wrap_condition(out, cond_e)
+    return _wrap_condition(out, cond_e, jt)
 
 
 def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:

@@ -199,9 +199,9 @@ def test_rss_service_end_to_end():
     with RssServer() as server:
         src = MemoryScanExec(parts, schema)
         for m in range(n_maps):
-            writer = SocketRssWriter(server.host, server.port, shuffle_id=7)
+            writer = SocketRssWriter(server.host, server.port, shuffle_id=7, map_id=m)
             RESOURCES.put(f"rss_e2e.{m}", writer)
-            ex = RssShuffleWriterExec(src, HashPartitioning([col("k")], n_out), f"rss_e2e")
+            ex = RssShuffleWriterExec(src, HashPartitioning([col("k")], n_out), "rss_e2e")
             list(ex.execute(m, TaskContext(m, n_maps)))
             # barrier semantics: committed only once ALL maps report
             assert server.is_committed(7, expected_maps=m + 1)
@@ -211,7 +211,9 @@ def test_rss_service_end_to_end():
         got = []
         per_part_keys = []
         for p in range(n_out):
-            blocks = rss_fetch_blocks(server.host, server.port, 7, p)
+            blocks = rss_fetch_blocks(
+                server.host, server.port, 7, p, expected_maps=n_maps
+            )
             RESOURCES.put(f"rss_read.{p}", blocks)
             reader = IpcReaderExec(schema, "rss_read", n_out)
             keys = set()
@@ -224,3 +226,60 @@ def test_rss_service_end_to_end():
     for i in range(n_out):
         for j in range(i + 1, n_out):
             assert not (per_part_keys[i] & per_part_keys[j])
+
+
+def test_rss_retry_and_barrier_semantics():
+    """Map-attempt retry + fetch barrier: a failed attempt's partial
+    pushes are never served (its retry's publication replaces them),
+    an early fetch blocks until the commit lands, and a barrier
+    timeout surfaces the commit counts to the client."""
+    import threading
+    import time
+
+    from blaze_tpu import conf
+    from blaze_tpu.parallel.rss_service import (
+        RssServer, SocketRssWriter, rss_fetch_blocks,
+    )
+
+    with RssServer() as server:
+        # attempt 1 of map 0 pushes one block, then dies (abort)
+        w = SocketRssWriter(server.host, server.port, shuffle_id=11, map_id=0)
+        w.write(0, b"stale-partial")
+        w.abort()
+        assert not server.is_committed(11, expected_maps=1)
+
+        # early fetch blocks on the barrier until the retry commits
+        got = {}
+
+        def fetch():
+            t0 = time.time()
+            got["blocks"] = rss_fetch_blocks(
+                server.host, server.port, 11, 0, expected_maps=1
+            )
+            got["dt"] = time.time() - t0
+
+        th = threading.Thread(target=fetch)
+        th.start()
+        time.sleep(0.5)
+        assert th.is_alive(), "fetch must wait for the map commit"
+
+        # retry (same map id) re-pushes and commits: last attempt wins
+        w2 = SocketRssWriter(server.host, server.port, shuffle_id=11, map_id=0)
+        w2.write(0, b"good-1")
+        w2.write(0, b"good-2")
+        w2.close()
+        th.join(10)
+        assert not th.is_alive()
+        assert got["blocks"] == [b"good-1", b"good-2"], got
+        assert got["dt"] >= 0.5
+
+        # barrier timeout carries the commit counts to the client
+        conf.RSS_FETCH_BARRIER_TIMEOUT.set(0.3)
+        try:
+            try:
+                rss_fetch_blocks(server.host, server.port, 11, 0, expected_maps=5)
+                assert False, "expected barrier timeout"
+            except ConnectionError as e:
+                assert "1/5 map commits" in str(e)
+        finally:
+            conf.RSS_FETCH_BARRIER_TIMEOUT.set(120.0)
