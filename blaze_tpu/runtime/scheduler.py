@@ -30,14 +30,17 @@ from .context import RESOURCES, TaskContext
 @dataclass
 class Stage:
     """One stage = one plan template + task count.  Map stages write a
-    shuffle; the result stage yields batches to the caller."""
+    shuffle; broadcast stages collect IPC blobs every downstream task
+    re-reads replicated; the result stage yields batches to the
+    caller."""
 
     stage_id: int
-    kind: str                      # "map" | "result"
+    kind: str                      # "map" | "broadcast" | "result"
     plan: ExecNode                 # stage-local plan (no exchanges)
     n_tasks: int
     shuffle_id: Optional[int] = None   # map stages
     n_out: int = 1                     # map stages: reduce partition count
+    broadcast_id: Optional[int] = None  # broadcast stages
     depends_on: List[int] = field(default_factory=list)
 
 
@@ -58,14 +61,46 @@ def split_stages(
     """Replace every NativeShuffleExchangeExec with an IpcReaderExec and
     emit a map Stage for its child.  Returns stages in dependency order
     (result stage last)."""
+    from ..parallel.broadcast import BroadcastExchangeExec, IpcWriterExec
+
     manager = manager or LocalShuffleManager()
     stages: List[Stage] = []
     wrapper = _StageRoot(root)
+    next_bid = [0]
 
     def walk(node: ExecNode) -> List[int]:
         deps: List[int] = []
         for i, c in enumerate(list(node.children)):
-            if isinstance(c, NativeShuffleExchangeExec):
+            if isinstance(c, BroadcastExchangeExec):
+                # broadcast = its own collect stage: child partitions
+                # drain into IPC blobs (IpcWriterExec ≙ the reference's
+                # collectNative, NativeBroadcastExchangeBase.scala:138),
+                # and the consumer re-reads them replicated through an
+                # IpcReaderExec the scheduler re-registers per task
+                child_deps = walk(c.children[0])
+                bid = next_bid[0]
+                next_bid[0] += 1
+                src = c.children[0]
+                st = Stage(
+                    stage_id=len(stages),
+                    kind="broadcast",
+                    plan=IpcWriterExec(src, f"broadcast_{bid}"),
+                    n_tasks=src.num_partitions(),
+                    broadcast_id=bid,
+                    depends_on=child_deps,
+                )
+                stages.append(st)
+                node.children[i] = IpcReaderExec(c.schema, f"broadcast_{bid}", 1)
+                # build the join hash map ONCE per executor across this
+                # stage's tasks (≙ the reference's per-executor cached
+                # build, join_hash_map.rs:43): key by manager identity
+                # so concurrent schedulers never share maps
+                from ..ops.joins import BroadcastJoinExec
+
+                if isinstance(node, BroadcastJoinExec) and node.cached_build_id is None:
+                    node.cached_build_id = f"sched_bcast_{id(manager)}_{bid}"
+                deps.append(st.stage_id)
+            elif isinstance(c, NativeShuffleExchangeExec):
                 child_deps = walk(c.children[0])
                 sid = c.shuffle_id
                 st = Stage(
@@ -148,8 +183,9 @@ def run_stages(
     from ..serde.from_proto import run_task
 
     n_maps: Dict[int, int] = {}
+    bcast_blobs: Dict[int, List[bytes]] = {}
 
-    def shuffle_readers(plan: ExecNode) -> List[IpcReaderExec]:
+    def ipc_readers(plan: ExecNode, prefix: str) -> List[IpcReaderExec]:
         out: List[IpcReaderExec] = []
         seen: set = set()
 
@@ -158,7 +194,7 @@ def run_stages(
                 walk(c)
             if (
                 isinstance(node, IpcReaderExec)
-                and node.resource_id.startswith("shuffle_")
+                and node.resource_id.startswith(prefix)
                 and id(node) not in seen
             ):
                 seen.add(id(node))
@@ -170,7 +206,8 @@ def run_stages(
     from ..serde.to_proto import STAGED_RIDS
 
     for stage in stages:
-        readers = shuffle_readers(stage.plan)
+        readers = ipc_readers(stage.plan, "shuffle_")
+        breaders = ipc_readers(stage.plan, "broadcast_")
         for t in range(stage.n_tasks):
             attempt = 0
             while True:
@@ -181,6 +218,13 @@ def run_stages(
                     sid = int(node.resource_id.split("_")[1])
                     key = f"{node.resource_id}.{t}"
                     RESOURCES.put(key, manager.reduce_blocks(sid, n_maps[sid], t))
+                    block_keys.append(key)
+                for node in breaders:
+                    # broadcast: every task re-reads ALL source blobs
+                    # (the consumer executes build partition 0)
+                    bid = int(node.resource_id.split("_")[1])
+                    key = f"{node.resource_id}.0"
+                    RESOURCES.put(key, list(bcast_blobs[bid]))
                     block_keys.append(key)
                 # fresh TaskDefinition per attempt (serialization
                 # stages fresh one-shot resources); track the staged
@@ -210,3 +254,10 @@ def run_stages(
                 yield from batches
         if stage.kind == "map":
             n_maps[stage.shuffle_id] = stage.n_tasks
+        elif stage.kind == "broadcast":
+            # collect the per-partition blobs the IpcWriterExec tasks
+            # registered; downstream tasks get them re-registered each
+            bcast_blobs[stage.broadcast_id] = [
+                RESOURCES.get(f"broadcast_{stage.broadcast_id}.{p}")
+                for p in range(stage.n_tasks)
+            ]

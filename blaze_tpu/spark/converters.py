@@ -95,7 +95,7 @@ def _attr_user_name(n: SparkNode) -> str:
 _PASS_THROUGH = {
     "WholeStageCodegenExec", "InputAdapter", "AdaptiveSparkPlanExec",
     "ShuffleQueryStageExec", "BroadcastQueryStageExec", "ReusedExchangeExec",
-    "ResultQueryStageExec",
+    "ResultQueryStageExec", "ColumnarToRowExec",
 }
 
 
@@ -220,18 +220,15 @@ def convert_exec(node: SparkNode, ctx: ConversionContext) -> ExecNode:
     """Recursive conversion; raises UnsupportedSparkExec/-Expr upward
     so the strategy can tag the subtree NeverConvert."""
     name = node.name
-    # pass-through wrappers (codegen/AQE adapters have no native analogue)
-    if name in (
-        "WholeStageCodegenExec", "InputAdapter", "AdaptiveSparkPlanExec",
-        "ShuffleQueryStageExec", "BroadcastQueryStageExec", "ReusedExchangeExec",
-        "CollectLimitExec",  # limit handled via child below when possible
-        "ResultQueryStageExec",
-    ):
-        if name == "CollectLimitExec":
-            child = ctx.convert(node.child(0))
-            limit = int(node.fields.get("limit", 0) or 0)
-            single = NativeShuffleExchangeExec(child, SinglePartitioning())
-            return LimitExec(single, limit) if limit > 0 else single
+    # pass-through wrappers (codegen/AQE adapters have no native
+    # analogue); _PASS_THROUGH is the single authoritative list, shared
+    # with output_attrs' root-rename walk
+    if name == "CollectLimitExec":
+        child = ctx.convert(node.child(0))
+        limit = int(node.fields.get("limit", 0) or 0)
+        single = NativeShuffleExchangeExec(child, SinglePartitioning())
+        return LimitExec(single, limit) if limit > 0 else single
+    if name in _PASS_THROUGH:
         return ctx.convert(node.child(0))
 
     op_flag = {
