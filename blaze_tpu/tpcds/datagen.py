@@ -142,14 +142,22 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         ]
         reps = 4
         combos = combos * reps
+        nc = len(combos)
         g_data, g_len = _encode_options([c[0] for c in combos], 8)
         m_data, m_len = _encode_options([c[1] for c in combos], 8)
         e_data, e_len = _encode_options([c[2] for c in combos], 24)
+        ratings = ["Low Risk", "Good", "High Risk", "Unknown"]
+        cr_data, cr_len = _encode_options([ratings[i % 4] for i in range(nc)], 16)
         return {
-            "cd_demo_sk": (np.arange(1, len(combos) + 1, dtype=np.int64), None),
+            "cd_demo_sk": (np.arange(1, nc + 1, dtype=np.int64), None),
             "cd_gender": (g_data, g_len),
             "cd_marital_status": (m_data, m_len),
             "cd_education_status": (e_data, e_len),
+            "cd_purchase_estimate": (((np.arange(nc) % 10 + 1) * 500).astype(np.int32), None),
+            "cd_credit_rating": (cr_data, cr_len),
+            "cd_dep_count": ((np.arange(nc) % 7).astype(np.int32), None),
+            "cd_dep_employed_count": ((np.arange(nc) % 5).astype(np.int32), None),
+            "cd_dep_college_count": ((np.arange(nc) % 4).astype(np.int32), None),
         }
     if name == "household_demographics":
         n = 720
@@ -169,9 +177,11 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         ln_, ln_len = _encode_options([LAST_NAMES[(i * 3) % len(LAST_NAMES)] for i in range(n)], 16)
         pf, pf_len = _encode_options([("Y" if i % 2 else "N") for i in range(n)], 8)
         n_addr = _n_addresses(scale)
+        n_cd = len(EDUCATIONS) * len(MARITALS) * len(GENDERS) * 4
         return {
             "c_customer_sk": (np.arange(1, n + 1, dtype=np.int64), None),
             "c_current_addr_sk": (rng.randint(1, n_addr + 1, n).astype(np.int64), None),
+            "c_current_cdemo_sk": (rng.randint(1, n_cd + 1, n).astype(np.int64), None),
             "c_salutation": (sal, sal_len),
             "c_first_name": (fn_, fn_len),
             "c_last_name": (ln_, ln_len),
@@ -186,9 +196,75 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
             for i in range(n)
         ]
         z_data, z_len = _encode_options([z[:5] + "-" + z[:4] for z in zips], 16)
+        co_data, co_len = _encode_options(
+            [COUNTIES[i % len(COUNTIES)] for i in range(n)], 24
+        )
+        st_data, st_len = _encode_options(
+            [STATES[(i * 7) % len(STATES)] for i in range(n)], 8
+        )
+        # gmt offsets from the dsdgen domain; ~40% at -5 so the
+        # q33/q56/q60 filter keeps a real subset (decimal(5,2) unscaled)
+        gmt = np.array([(-500 if i % 5 < 2 else -600 - 100 * (i % 3)) for i in range(n)],
+                       np.int64)
         return {
             "ca_address_sk": (np.arange(1, n + 1, dtype=np.int64), None),
             "ca_zip": (z_data, z_len),
+            "ca_county": (co_data, co_len),
+            "ca_state": (st_data, st_len),
+            "ca_gmt_offset": (gmt, None),
+        }
+    if name == "call_center":
+        names = ["NY Metro", "Mid Atlantic", "North Midwest", "Pacific Northwest"]
+        d, ln = _encode_options(names, 24)
+        return {
+            "cc_call_center_sk": (np.arange(1, len(names) + 1, dtype=np.int64), None),
+            "cc_name": (d, ln),
+        }
+    if name == "reason":
+        descs = ["Package was damaged", "Stopped working", "Did not get it on time",
+                 "Not the product that was ordred", "Parts missing"]
+        d, ln = _encode_options(descs, 40)
+        return {
+            "r_reason_sk": (np.arange(1, len(descs) + 1, dtype=np.int64), None),
+            "r_reason_desc": (d, ln),
+        }
+    if name == "catalog_sales":
+        n = max(150, int(1_440_000 * scale))
+        n_date = _days(*D_LAST) - _days(*D_FIRST) + 1
+        n_item = max(60, int(18000 * scale))
+        n_cust = _n_customers(scale)
+        n_addr = _n_addresses(scale)
+        date_sk = np.where(
+            rng.rand(n) < 0.02, np.int64(-1),
+            rng.randint(0, n_date, n) + DATE_SK_BASE,
+        ).astype(np.int64)
+        return {
+            "cs_sold_date_sk": (date_sk, None),
+            "cs_item_sk": (rng.randint(1, n_item + 1, n).astype(np.int64), None),
+            "cs_bill_customer_sk": (rng.randint(1, n_cust + 1, n).astype(np.int64), None),
+            "cs_ship_customer_sk": (rng.randint(1, n_cust + 1, n).astype(np.int64), None),
+            "cs_bill_addr_sk": (rng.randint(1, n_addr + 1, n).astype(np.int64), None),
+            "cs_call_center_sk": (rng.randint(1, 5, n).astype(np.int64), None),
+            "cs_sales_price": (_money(rng, n, 0, 300), None),
+            "cs_ext_sales_price": (_money(rng, n, 0, 2000), None),
+        }
+    if name == "web_sales":
+        n = max(100, int(720_000 * scale))
+        n_date = _days(*D_LAST) - _days(*D_FIRST) + 1
+        n_item = max(60, int(18000 * scale))
+        n_cust = _n_customers(scale)
+        n_addr = _n_addresses(scale)
+        date_sk = np.where(
+            rng.rand(n) < 0.02, np.int64(-1),
+            rng.randint(0, n_date, n) + DATE_SK_BASE,
+        ).astype(np.int64)
+        return {
+            "ws_sold_date_sk": (date_sk, None),
+            "ws_item_sk": (rng.randint(1, n_item + 1, n).astype(np.int64), None),
+            "ws_bill_customer_sk": (rng.randint(1, n_cust + 1, n).astype(np.int64), None),
+            "ws_bill_addr_sk": (rng.randint(1, n_addr + 1, n).astype(np.int64), None),
+            "ws_ext_sales_price": (_money(rng, n, 0, 2000), None),
+            "ws_net_paid": (_money(rng, n, 0, 2000), None),
         }
     if name == "item":
         n = max(60, int(18000 * scale))
@@ -205,8 +281,12 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         desc_data, desc_len = _encode_options([f"desc of item {k % 97}" for k in range(n)], 32)
         mfi = rng.randint(1, 200, n).astype(np.int32)
         mf_data, mf_len = _encode_options([f"manufact#{m}" for m in mfi], 24)
+        colors = ["slate", "blanched", "burnished", "peach", "saddle",
+                  "powder", "navy", "chiffon", "ivory", "plum"]
+        col_data, col_len = _encode_options([colors[int(v)] for v in rng.randint(0, len(colors), n)], 16)
         return {
             "i_item_sk": (sk, None),
+            "i_color": (col_data, col_len),
             "i_item_id": (id_data, id_len),
             "i_item_desc": (desc_data, desc_len),
             "i_brand_id": (brand_id, None),
@@ -261,6 +341,7 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
             "ss_promo_sk": (
                 np.where(rng.rand(n) < 0.04, np.int64(-1),
                          rng.randint(1, n_promo + 1, n)).astype(np.int64), None),
+            "ss_addr_sk": (ticket_fk(_n_addresses(scale)), None),
             "ss_ticket_number": ((tidx + 1).astype(np.int64), None),
             "ss_quantity": (rng.randint(1, 101, n).astype(np.int32), None),
             "ss_list_price": (_money(rng, n, 1, 200), None),

@@ -500,3 +500,434 @@ def oracle_q53(tables):
 
 def oracle_q63(tables):
     return _oracle_manufact_window(tables, "d_moy")
+
+
+def _channel_customer_set(tables, sales, date_col, cust_col, year):
+    """Distinct (last, first, d_date) triples of one channel in a year
+    (q38/q87 building block)."""
+    dd = tables["date_dim"]
+    cu = tables["customer"]
+    sl = tables[sales]
+    d_mask = dd["d_year"][0] == year
+    date_by_sk = dict(zip(dd["d_date_sk"][0][d_mask].tolist(),
+                          dd["d_date"][0][d_mask].tolist()))
+    last = _sv(cu, "c_last_name")
+    first = _sv(cu, "c_first_name")
+    by_sk = {int(k): i for i, k in enumerate(cu["c_customer_sk"][0])}
+    out = set()
+    ds = sl[date_col][0]
+    cs = sl[cust_col][0]
+    for i in range(ds.shape[0]):
+        d = date_by_sk.get(int(ds[i]))
+        ci = by_sk.get(int(cs[i]))
+        if d is None or ci is None:
+            continue
+        out.add((last[ci], first[ci], int(d)))
+    return out
+
+
+def oracle_q38(tables):
+    ss = _channel_customer_set(tables, "store_sales", "ss_sold_date_sk", "ss_customer_sk", 2000)
+    cs = _channel_customer_set(tables, "catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk", 2000)
+    ws = _channel_customer_set(tables, "web_sales", "ws_sold_date_sk", "ws_bill_customer_sk", 2000)
+    return len(ss & cs & ws)
+
+
+def oracle_q87(tables):
+    ss = _channel_customer_set(tables, "store_sales", "ss_sold_date_sk", "ss_customer_sk", 2000)
+    cs = _channel_customer_set(tables, "catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk", 2000)
+    ws = _channel_customer_set(tables, "web_sales", "ws_sold_date_sk", "ws_bill_customer_sk", 2000)
+    return len(ss - cs - ws)
+
+
+def _channel_union_sums(tables, *, group_col, item_mask_fn, year, moy):
+    """q33/q56/q60 oracle: per-group total across the three channels,
+    restricted to -5 GMT buyer addresses."""
+    dd = tables["date_dim"]
+    it = tables["item"]
+    ca = tables["customer_address"]
+    d_mask = (dd["d_year"][0] == year) & (dd["d_moy"][0] == moy)
+    d_sks = set(dd["d_date_sk"][0][d_mask].tolist())
+    ca_ok = set(ca["ca_address_sk"][0][ca["ca_gmt_offset"][0] == -500].tolist())
+    gv = (_sv(it, group_col) if it[group_col][1] is not None
+          else [int(v) for v in it[group_col][0]])
+    id_set = {gv[i] for i in np.flatnonzero(item_mask_fn(it))}
+    grp_by_sk = {
+        int(sk): gv[i]
+        for i, sk in enumerate(it["i_item_sk"][0])
+        if gv[i] in id_set
+    }
+    sums = {}
+    for sales, date_col, item_col, addr_col, price_col in [
+        ("store_sales", "ss_sold_date_sk", "ss_item_sk", "ss_addr_sk", "ss_ext_sales_price"),
+        ("catalog_sales", "cs_sold_date_sk", "cs_item_sk", "cs_bill_addr_sk", "cs_ext_sales_price"),
+        ("web_sales", "ws_sold_date_sk", "ws_item_sk", "ws_bill_addr_sk", "ws_ext_sales_price"),
+    ]:
+        sl = tables[sales]
+        ds, its, ads, pr = (sl[date_col][0], sl[item_col][0],
+                            sl[addr_col][0], sl[price_col][0])
+        for i in range(ds.shape[0]):
+            if int(ds[i]) not in d_sks or int(ads[i]) not in ca_ok:
+                continue
+            g = grp_by_sk.get(int(its[i]))
+            if g is None:
+                continue
+            sums[g] = sums.get(g, 0) + int(pr[i])
+    return sums
+
+
+def oracle_q33(tables):
+    return _channel_union_sums(
+        tables, group_col="i_manufact_id",
+        item_mask_fn=lambda it: np.array(_sv(it, "i_category")) == "Electronics",
+        year=1998, moy=5,
+    )
+
+
+def oracle_q56(tables):
+    return _channel_union_sums(
+        tables, group_col="i_item_id",
+        item_mask_fn=lambda it: np.isin(np.array(_sv(it, "i_color")),
+                                        ["slate", "blanched", "burnished"]),
+        year=2000, moy=2,
+    )
+
+
+def oracle_q60(tables):
+    return _channel_union_sums(
+        tables, group_col="i_item_id",
+        item_mask_fn=lambda it: np.array(_sv(it, "i_category")) == "Music",
+        year=1999, moy=9,
+    )
+
+
+def _rollup_margin_oracle(tables, *, sales, date_col, item_col, num_col,
+                          den_col, year, store_filter=False, ratio_desc=False):
+    """q36/q86 oracle: rollup sums, lochierarchy, rank within parent."""
+    dd = tables["date_dim"]
+    it = tables["item"]
+    sl = tables[sales]
+    d_sks = set(dd["d_date_sk"][0][dd["d_year"][0] == year].tolist())
+    cats = _sv(it, "i_category")
+    clss = _sv(it, "i_class")
+    by_item = {int(sk): (cats[i], clss[i]) for i, sk in enumerate(it["i_item_sk"][0])}
+    ok_stores = None
+    if store_filter:
+        st = tables["store"]
+        states = _sv(st, "s_state")
+        ok_stores = {int(sk) for i, sk in enumerate(st["s_store_sk"][0])
+                     if states[i] in ("TN", "SD", "AL", "GA", "OH")}
+    sums = {}  # (cat|None, cls|None, gid) -> [num, den]
+    ds = sl[date_col][0]
+    its = sl[item_col][0]
+    num = sl[num_col][0]
+    den = sl[den_col][0] if den_col else None
+    store_sk = sl["ss_store_sk"][0] if store_filter else None
+    for i in range(ds.shape[0]):
+        if int(ds[i]) not in d_sks:
+            continue
+        if ok_stores is not None and int(store_sk[i]) not in ok_stores:
+            continue
+        ic = by_item.get(int(its[i]))
+        if ic is None:
+            continue
+        cat, cls = ic
+        for key in [(cat, cls, 0), (cat, None, 1), (None, None, 3)]:
+            acc = sums.setdefault(key, [0, 0])
+            acc[0] += int(num[i])
+            if den is not None:
+                acc[1] += int(den[i])
+    rows = []
+    for (cat, cls, gid), (n, d) in sums.items():
+        loch = {0: 0, 1: 1, 3: 2}[gid]
+        # money sums are decimal(17,2): the engine's float cast yields
+        # dollars, so divide unscaled by 100 (ratio measures cancel)
+        measure = (n / d) if den_col else (n / 100.0)
+        rows.append([cat, cls, loch, measure])
+    # rank within (lochierarchy, parent category)
+    out = {}
+    from collections import defaultdict
+    parts = defaultdict(list)
+    for r in rows:
+        parent = r[0] if r[2] == 0 else None
+        parts[(r[2], parent)].append(r)
+    for plist in parts.values():
+        plist.sort(key=lambda r: -r[3] if ratio_desc else r[3])
+        rank, prev = 0, None
+        for i, r in enumerate(plist, 1):
+            if prev is None or r[3] != prev:
+                rank = i
+            prev = r[3]
+            out[(r[0], r[1], r[2])] = (r[3], rank)
+    return out
+
+
+def oracle_q36(tables):
+    return _rollup_margin_oracle(
+        tables, sales="store_sales", date_col="ss_sold_date_sk",
+        item_col="ss_item_sk", num_col="ss_net_profit",
+        den_col="ss_ext_sales_price", year=2001, store_filter=True,
+    )
+
+
+def oracle_q86(tables):
+    return _rollup_margin_oracle(
+        tables, sales="web_sales", date_col="ws_sold_date_sk",
+        item_col="ws_item_sk", num_col="ws_net_paid", den_col=None,
+        year=2000, ratio_desc=True,
+    )
+
+
+def _yoy_oracle(tables, *, sales, date_col, item_col, price_col,
+                entity, year):
+    """q47/q57 oracle: monthly sums, year-partition avg, lag/lead over
+    the month sequence, filtered to the target year + ratio > 0.1.
+
+    ``entity``: (table, sk_col in sales, entity sk col, [entity cols])
+    """
+    dd = tables["date_dim"]
+    it = tables["item"]
+    sl = tables[sales]
+    etab, fk_col, esk_col, ecols = entity
+    et = tables[etab]
+    d_ok = {}
+    for i in range(dd["d_date_sk"][0].shape[0]):
+        y = int(dd["d_year"][0][i]); m = int(dd["d_moy"][0][i])
+        if y == year or (y == year - 1 and m == 12) or (y == year + 1 and m == 1):
+            d_ok[int(dd["d_date_sk"][0][i])] = (y, m)
+    cats = _sv(it, "i_category")
+    brands = _sv(it, "i_brand")
+    by_item = {int(sk): (cats[i], brands[i]) for i, sk in enumerate(it["i_item_sk"][0])}
+    evals = [ _sv(et, c) for c in ecols ]
+    by_ent = {int(sk): tuple(ev[i] for ev in evals)
+              for i, sk in enumerate(et[esk_col][0])}
+    sums = {}
+    ds = sl[date_col][0]; its = sl[item_col][0]
+    eks = sl[fk_col][0]; pr = sl[price_col][0]
+    for i in range(ds.shape[0]):
+        ym = d_ok.get(int(ds[i]))
+        ic = by_item.get(int(its[i]))
+        ev = by_ent.get(int(eks[i]))
+        if ym is None or ic is None or ev is None:
+            continue
+        key = ic + ev + ym
+        sums[key] = sums.get(key, 0) + int(pr[i])
+    # avg over (entity-part incl year), lag/lead over month order
+    from collections import defaultdict
+    by_year_part = defaultdict(list)
+    by_part = defaultdict(list)
+    for key, s in sums.items():
+        part, y, m = key[:-2], key[-2], key[-1]
+        by_year_part[part + (y,)].append(s)
+        by_part[part].append((y, m, s))
+    out = {}
+    for part, rows in by_part.items():
+        rows.sort()
+        for i, (y, m, s) in enumerate(rows):
+            if y != year:
+                continue
+            vals = by_year_part[part + (y,)]
+            avg = sum(vals) / len(vals)
+            if avg <= 0 or abs(s - avg) / avg <= 0.1:
+                continue
+            # engine avg(decimal(17,2)) carries scale 6: unscaled*10^4
+            avg = int(_round_half_up(np.array([avg * 10**4]))[0])
+            psum = rows[i - 1][2] if i > 0 else None
+            nsum = rows[i + 1][2] if i + 1 < len(rows) else None
+            out[part + (y, m)] = (s, avg, psum, nsum)
+    return out
+
+
+def oracle_q47(tables):
+    return _yoy_oracle(
+        tables, sales="store_sales", date_col="ss_sold_date_sk",
+        item_col="ss_item_sk", price_col="ss_sales_price",
+        entity=("store", "ss_store_sk", "s_store_sk",
+                ["s_store_name", "s_company_name"]),
+        year=1999,
+    )
+
+
+def oracle_q57(tables):
+    return _yoy_oracle(
+        tables, sales="catalog_sales", date_col="cs_sold_date_sk",
+        item_col="cs_item_sk", price_col="cs_sales_price",
+        entity=("call_center", "cs_call_center_sk", "cc_call_center_sk",
+                ["cc_name"]),
+        year=1999,
+    )
+
+
+def _active_set(tables, sales, date_col, cust_col, *, year, moys):
+    dd = tables["date_dim"]
+    sl = tables[sales]
+    m = (dd["d_year"][0] == year) & (dd["d_moy"][0] >= moys[0]) & (dd["d_moy"][0] <= moys[1])
+    d_sks = set(dd["d_date_sk"][0][m].tolist())
+    ds = sl[date_col][0]
+    cs = sl[cust_col][0]
+    return {int(cs[i]) for i in range(ds.shape[0]) if int(ds[i]) in d_sks}
+
+
+def _q10_customers(tables, *, year=2002, moys=(1, 4)):
+    """c_customer_sk of customers with in-store activity AND (web OR
+    catalog) activity in the window."""
+    ss = _active_set(tables, "store_sales", "ss_sold_date_sk", "ss_customer_sk",
+                     year=year, moys=moys)
+    ws = _active_set(tables, "web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+                     year=year, moys=moys)
+    cs = _active_set(tables, "catalog_sales", "cs_sold_date_sk", "cs_ship_customer_sk",
+                     year=year, moys=moys)
+    return ss & (ws | cs)
+
+
+def oracle_q10(tables):
+    cu = tables["customer"]
+    ca = tables["customer_address"]
+    cd = tables["customer_demographics"]
+    counties = {"Williamson County", "Franklin Parish", "Bronx County"}
+    co = _sv(ca, "ca_county")
+    ok_addr = {int(sk) for i, sk in enumerate(ca["ca_address_sk"][0]) if co[i] in counties}
+    active = _q10_customers(tables)
+    cd_cols = [
+        _sv(cd, "cd_gender"), _sv(cd, "cd_marital_status"),
+        _sv(cd, "cd_education_status"),
+        [int(v) for v in cd["cd_purchase_estimate"][0]],
+        _sv(cd, "cd_credit_rating"),
+        [int(v) for v in cd["cd_dep_count"][0]],
+        [int(v) for v in cd["cd_dep_employed_count"][0]],
+        [int(v) for v in cd["cd_dep_college_count"][0]],
+    ]
+    cd_by_sk = {int(sk): tuple(c[i] for c in cd_cols)
+                for i, sk in enumerate(cd["cd_demo_sk"][0])}
+    counts = {}
+    for i, csk in enumerate(cu["c_customer_sk"][0]):
+        if int(csk) not in active:
+            continue
+        if int(cu["c_current_addr_sk"][0][i]) not in ok_addr:
+            continue
+        key = cd_by_sk.get(int(cu["c_current_cdemo_sk"][0][i]))
+        if key is None:
+            continue
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def oracle_q35(tables):
+    cu = tables["customer"]
+    ca = tables["customer_address"]
+    cd = tables["customer_demographics"]
+    states = _sv(ca, "ca_state")
+    state_by_addr = {int(sk): states[i] for i, sk in enumerate(ca["ca_address_sk"][0])}
+    active = _q10_customers(tables)
+    gd = _sv(cd, "cd_gender")
+    ms = _sv(cd, "cd_marital_status")
+    dep = [int(v) for v in cd["cd_dep_count"][0]]
+    emp = [int(v) for v in cd["cd_dep_employed_count"][0]]
+    colg = [int(v) for v in cd["cd_dep_college_count"][0]]
+    cd_by_sk = {int(sk): i for i, sk in enumerate(cd["cd_demo_sk"][0])}
+    rows = {}
+    for i, csk in enumerate(cu["c_customer_sk"][0]):
+        if int(csk) not in active:
+            continue
+        st = state_by_addr.get(int(cu["c_current_addr_sk"][0][i]))
+        ci = cd_by_sk.get(int(cu["c_current_cdemo_sk"][0][i]))
+        if st is None or ci is None:
+            continue
+        key = (st, gd[ci], ms[ci], dep[ci], emp[ci], colg[ci])
+        rows.setdefault(key, []).append((dep[ci], emp[ci], colg[ci]))
+    out = {}
+    for key, vals in rows.items():
+        n = len(vals)
+        aggs = [n]
+        for j in range(3):
+            vs = [v[j] for v in vals]
+            # engine avg(int) is float64
+            aggs += [sum(vs) / n, max(vs), sum(vs)]
+        out[key] = tuple(aggs)
+    return out
+
+
+def oracle_q9(tables, thresholds):
+    ss = tables["store_sales"]
+    q = ss["ss_quantity"][0]
+    disc = ss["ss_ext_discount_amt"][0]
+    prof = ss["ss_net_profit"][0]
+    out = []
+    for b, thresh in enumerate(thresholds):
+        lo, hi = 20 * b + 1, 20 * (b + 1)
+        m = (q >= lo) & (q <= hi)
+        n = int(m.sum())
+        vals = disc[m] if n > thresh else prof[m]
+        # engine avg(decimal(7,2)) carries scale 6: unscaled*10^4
+        avg = int(_round_half_up(np.array([float(vals.sum()) * 10**4 / max(n, 1)]))[0])
+        out.append(avg if n else None)
+    return out
+
+
+def oracle_q88(tables):
+    ss = tables["store_sales"]
+    hd = tables["household_demographics"]
+    td = tables["time_dim"]
+    st = tables["store"]
+    dep = hd["hd_dep_count"][0]
+    veh = hd["hd_vehicle_count"][0]
+    hd_ok = set(hd["hd_demo_sk"][0][
+        ((dep == 4) & (veh <= 6)) | ((dep == 2) & (veh <= 4)) | ((dep == 0) & (veh <= 2))
+    ].tolist())
+    names = _sv(st, "s_store_name")
+    st_ok = {int(sk) for i, sk in enumerate(st["s_store_sk"][0]) if names[i] == "ese"}
+    out = []
+    for k in range(8):
+        h, half = divmod(k + 17, 2)
+        tm = (td["t_hour"][0] == h) & (
+            (td["t_minute"][0] >= 30) if half else (td["t_minute"][0] < 30)
+        )
+        t_ok = set(td["t_time_sk"][0][tm].tolist())
+        cnt = 0
+        ts = ss["ss_sold_time_sk"][0]
+        hs = ss["ss_hdemo_sk"][0]
+        sts = ss["ss_store_sk"][0]
+        for i in range(ts.shape[0]):
+            if int(ts[i]) in t_ok and int(hs[i]) in hd_ok and int(sts[i]) in st_ok:
+                cnt += 1
+        out.append(cnt)
+    return out
+
+
+def oracle_q8(tables, zips, min_preferred):
+    ca = tables["customer_address"]
+    cu = tables["customer"]
+    st = tables["store"]
+    dd = tables["date_dim"]
+    ss = tables["store_sales"]
+    zip5s = [z[:5] for z in _sv(ca, "ca_zip")]
+    a1 = {z for z in zip5s if z in set(zips)}
+    pf = _sv(cu, "c_preferred_cust_flag")
+    zip_by_addr = {int(sk): zip5s[i] for i, sk in enumerate(ca["ca_address_sk"][0])}
+    counts = {}
+    for i in range(cu["c_customer_sk"][0].shape[0]):
+        if pf[i] != "Y":
+            continue
+        z = zip_by_addr.get(int(cu["c_current_addr_sk"][0][i]))
+        if z is not None:
+            counts[z] = counts.get(z, 0) + 1
+    a2 = {z for z, c in counts.items() if c >= min_preferred}
+    prefixes = {z[:2] for z in (a1 & a2)}
+    names = _sv(st, "s_store_name")
+    szips = _sv(st, "s_zip")
+    name_by_sk = {int(sk): names[i] for i, sk in enumerate(st["s_store_sk"][0])
+                  if szips[i][:2] in prefixes}
+    dm = (dd["d_year"][0] == 1998) & (dd["d_qoy"][0] == 2)
+    d_sks = set(dd["d_date_sk"][0][dm].tolist())
+    sums = {}
+    ds = ss["ss_sold_date_sk"][0]
+    sts = ss["ss_store_sk"][0]
+    np_ = ss["ss_net_profit"][0]
+    for i in range(ds.shape[0]):
+        if int(ds[i]) not in d_sks:
+            continue
+        nm = name_by_sk.get(int(sts[i]))
+        if nm is None:
+            continue
+        sums[nm] = sums.get(nm, 0) + int(np_[i])
+    return sums

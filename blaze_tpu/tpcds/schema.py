@@ -25,6 +25,7 @@ TPCDS_SCHEMAS = {
     ]),
     "item": Schema([
         Field("i_item_sk", T.int64()),
+        Field("i_color", T.string(16)),
         Field("i_item_id", T.string(16)),
         Field("i_item_desc", T.string(32)),
         Field("i_brand_id", T.int32()),
@@ -56,6 +57,11 @@ TPCDS_SCHEMAS = {
         Field("cd_gender", T.string(8)),
         Field("cd_marital_status", T.string(8)),
         Field("cd_education_status", T.string(24)),
+        Field("cd_purchase_estimate", T.int32()),
+        Field("cd_credit_rating", T.string(16)),
+        Field("cd_dep_count", T.int32()),
+        Field("cd_dep_employed_count", T.int32()),
+        Field("cd_dep_college_count", T.int32()),
     ]),
     "household_demographics": Schema([
         Field("hd_demo_sk", T.int64()),
@@ -66,6 +72,7 @@ TPCDS_SCHEMAS = {
     "customer": Schema([
         Field("c_customer_sk", T.int64()),
         Field("c_current_addr_sk", T.int64()),
+        Field("c_current_cdemo_sk", T.int64()),
         Field("c_salutation", T.string(8)),
         Field("c_first_name", T.string(16)),
         Field("c_last_name", T.string(16)),
@@ -74,6 +81,17 @@ TPCDS_SCHEMAS = {
     "customer_address": Schema([
         Field("ca_address_sk", T.int64()),
         Field("ca_zip", T.string(16)),
+        Field("ca_county", T.string(24)),
+        Field("ca_state", T.string(8)),
+        Field("ca_gmt_offset", T.decimal(5, 2)),
+    ]),
+    "call_center": Schema([
+        Field("cc_call_center_sk", T.int64()),
+        Field("cc_name", T.string(24)),
+    ]),
+    "reason": Schema([
+        Field("r_reason_sk", T.int64()),
+        Field("r_reason_desc", T.string(40)),
     ]),
     "store_sales": Schema([
         Field("ss_sold_date_sk", T.int64()),
@@ -84,6 +102,7 @@ TPCDS_SCHEMAS = {
         Field("ss_hdemo_sk", T.int64()),
         Field("ss_store_sk", T.int64()),
         Field("ss_promo_sk", T.int64()),
+        Field("ss_addr_sk", T.int64()),
         Field("ss_ticket_number", T.int64()),
         Field("ss_quantity", T.int32()),
         Field("ss_list_price", _m()),
@@ -92,5 +111,23 @@ TPCDS_SCHEMAS = {
         Field("ss_ext_sales_price", _m()),
         Field("ss_coupon_amt", _m()),
         Field("ss_net_profit", _m()),
+    ]),
+    "catalog_sales": Schema([
+        Field("cs_sold_date_sk", T.int64()),
+        Field("cs_item_sk", T.int64()),
+        Field("cs_bill_customer_sk", T.int64()),
+        Field("cs_ship_customer_sk", T.int64()),
+        Field("cs_bill_addr_sk", T.int64()),
+        Field("cs_call_center_sk", T.int64()),
+        Field("cs_sales_price", _m()),
+        Field("cs_ext_sales_price", _m()),
+    ]),
+    "web_sales": Schema([
+        Field("ws_sold_date_sk", T.int64()),
+        Field("ws_item_sk", T.int64()),
+        Field("ws_bill_customer_sk", T.int64()),
+        Field("ws_bill_addr_sk", T.int64()),
+        Field("ws_ext_sales_price", _m()),
+        Field("ws_net_paid", _m()),
     ]),
 }

@@ -252,3 +252,153 @@ def test_q63(data, scans):
         "avg_monthly_sales",
         ["i_manufact_id", "avg_monthly_sales", "sum_sales"],
     )
+
+
+def test_q38(data, scans):
+    got = run(build_query("q38", scans, N_PARTS))
+    assert got["cnt"] == [O.oracle_q38(data)]
+
+
+def test_q87(data, scans):
+    got = run(build_query("q87", scans, N_PARTS))
+    assert got["cnt"] == [O.oracle_q87(data)]
+
+
+def _check_channel_union(got, exp, group_col):
+    assert got[group_col], "query returned no rows"
+    rows = dict(zip(got[group_col], got["total_sales"]))
+    assert len(rows) == len(got[group_col]), "duplicate groups"
+    for k, v in rows.items():
+        assert exp.get(k) == v, (k, v, exp.get(k))
+    assert len(rows) == min(len(exp), 100)
+    # spec order: total_sales ascending
+    assert got["total_sales"] == sorted(got["total_sales"])
+
+
+def test_q33(data, scans):
+    _check_channel_union(run(build_query("q33", scans, N_PARTS)),
+                         O.oracle_q33(data), "i_manufact_id")
+
+
+def test_q56(data, scans):
+    _check_channel_union(run(build_query("q56", scans, N_PARTS)),
+                         O.oracle_q56(data), "i_item_id")
+
+
+def test_q60(data, scans):
+    _check_channel_union(run(build_query("q60", scans, N_PARTS)),
+                         O.oracle_q60(data), "i_item_id")
+
+
+def _check_rollup_margin(got, exp):
+    assert got["lochierarchy"], "query returned no rows"
+    for cat, cls, loch, meas, rank in zip(
+        got["i_category"], got["i_class"], got["lochierarchy"],
+        got["measure"], got["rank_within_parent"],
+    ):
+        key = (cat, cls, loch)
+        assert key in exp, key
+        emeas, erank = exp[key]
+        assert abs(meas - emeas) < 1e-9 and rank == erank, (key, meas, rank, exp[key])
+    # rollup must produce all three levels when <=100 rows
+    if len(exp) <= 100:
+        assert set(got["lochierarchy"]) == {0, 1, 2}
+        assert len(got["lochierarchy"]) == len(exp)
+    # spec order: lochierarchy desc first
+    assert got["lochierarchy"] == sorted(got["lochierarchy"], reverse=True)
+
+
+def test_q36(data, scans):
+    _check_rollup_margin(run(build_query("q36", scans, N_PARTS)), O.oracle_q36(data))
+
+
+def test_q86(data, scans):
+    _check_rollup_margin(run(build_query("q86", scans, N_PARTS)), O.oracle_q86(data))
+
+
+def _check_yoy(got, exp, entity_cols):
+    assert got["d_moy"], "query returned no rows"
+    for i in range(len(got["d_moy"])):
+        key = (got["i_category"][i], got["i_brand"][i]) + tuple(
+            got[c][i] for c in entity_cols
+        ) + (got["d_year"][i], got["d_moy"][i])
+        assert key in exp, key
+        s, avg, psum, nsum = exp[key]
+        assert got["sum_sales"][i] == s, key
+        assert abs(got["avg_monthly_sales"][i] - avg) <= 1, key
+        assert got["psum"][i] == psum and got["nsum"][i] == nsum, (
+            key, got["psum"][i], got["nsum"][i], psum, nsum)
+    if len(exp) <= 100:
+        assert len(got["d_moy"]) == len(exp)
+
+
+def test_q47(data, scans):
+    _check_yoy(run(build_query("q47", scans, N_PARTS)), O.oracle_q47(data),
+               ("s_store_name", "s_company_name"))
+
+
+def test_q57(data, scans):
+    _check_yoy(run(build_query("q57", scans, N_PARTS)), O.oracle_q57(data),
+               ("cc_name",))
+
+
+def test_q10(data, scans):
+    got = run(build_query("q10", scans, N_PARTS))
+    exp = O.oracle_q10(data)
+    keys = list(zip(got["cd_gender"], got["cd_marital_status"],
+                    got["cd_education_status"], got["cd_purchase_estimate"],
+                    got["cd_credit_rating"], got["cd_dep_count"],
+                    got["cd_dep_employed_count"], got["cd_dep_college_count"]))
+    assert keys and len(set(keys)) == len(keys)
+    for k, c in zip(keys, got["cnt"]):
+        assert exp.get(k) == c, k
+    assert len(keys) == min(len(exp), 100)
+    assert keys == sorted(keys)
+
+
+def test_q35(data, scans):
+    got = run(build_query("q35", scans, N_PARTS))
+    exp = O.oracle_q35(data)
+    keys = list(zip(got["ca_state"], got["cd_gender"], got["cd_marital_status"],
+                    got["cd_dep_count"], got["cd_dep_employed_count"],
+                    got["cd_dep_college_count"]))
+    assert keys and len(set(keys)) == len(keys)
+    for i, k in enumerate(keys):
+        assert k in exp, k
+        e = exp[k]
+        assert got["cnt1"][i] == e[0], k
+        for j in range(3):
+            assert abs(got[f"avg{j+1}"][i] - e[1 + 3*j]) < 1e-9, k
+            assert got[f"max{j+1}"][i] == e[2 + 3*j], k
+            assert got[f"sum{j+1}"][i] == e[3 + 3*j], k
+    if len(exp) <= 100:
+        assert set(keys) == set(exp)
+
+
+def test_q9(data, scans):
+    from blaze_tpu.tpcds.queries import Q9_THRESHOLDS
+
+    got = run(build_query("q9", scans, N_PARTS))
+    exp = O.oracle_q9(data, Q9_THRESHOLDS)
+    assert len(got["bucket1"]) == 1
+    for b in range(5):
+        g = got[f"bucket{b+1}"][0]
+        assert abs(g - exp[b]) <= 1, (b, g, exp[b])
+
+
+def test_q88(data, scans):
+    got = run(build_query("q88", scans, N_PARTS))
+    exp = O.oracle_q88(data)
+    row = [got[k][0] for k in got]
+    assert row == exp, (row, exp)
+    assert sum(exp) > 0, "q88 slice matched no rows (datagen too sparse)"
+
+
+def test_q8(data, scans):
+    from blaze_tpu.tpcds.queries import Q8_MIN_PREFERRED, Q8_ZIPS
+
+    got = run(build_query("q8", scans, N_PARTS))
+    exp = O.oracle_q8(data, Q8_ZIPS, Q8_MIN_PREFERRED)
+    assert exp, "q8 oracle matched no stores (datagen too sparse)"
+    assert dict(zip(got["s_store_name"], got["net_profit"])) == exp
+    assert got["s_store_name"] == sorted(got["s_store_name"])
