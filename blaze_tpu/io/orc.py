@@ -22,7 +22,7 @@ public ORC v1 spec (no pyorc/pyarrow in the image):
   Stripe statistics drive predicate pruning (the stripe granularity
   of the reference's ORC scan pushdown).
 
-Unsupported (gated, not silently wrong): TIMESTAMP, compound types.
+Unsupported (gated, not silently wrong): compound types.
 """
 
 from __future__ import annotations
@@ -40,9 +40,15 @@ MAGIC = b"ORC"
 
 # Type.kind enum
 K_BOOLEAN, K_BYTE, K_SHORT, K_INT, K_LONG, K_FLOAT, K_DOUBLE, K_STRING = range(8)
+K_BINARY = 8
+K_TIMESTAMP = 9
 K_STRUCT = 12
 K_DECIMAL = 14
 K_DATE = 15
+
+# ORC timestamps are seconds relative to 2015-01-01 00:00:00 UTC plus
+# a nanosecond stream with decimal-trailing-zero packing
+ORC_TS_EPOCH = 1420070400
 
 # Stream.kind enum
 S_PRESENT, S_DATA, S_LENGTH = 0, 1, 2
@@ -244,6 +250,8 @@ def _orc_kind(dtype: DataType) -> int:
         return K_DATE
     if k == TypeKind.DECIMAL:
         return K_DECIMAL
+    if k == TypeKind.TIMESTAMP:
+        return K_TIMESTAMP
     if dtype.is_string:
         return K_STRING
     raise NotImplementedError(f"ORC subset: unsupported type {dtype!r}")
@@ -457,6 +465,35 @@ class _Stream:
     data: bytes
 
 
+def _pack_nanos(nanos: np.ndarray) -> np.ndarray:
+    """ORC nanosecond packing (java formatNanos): values divisible by
+    100 are divided down and the low 3 bits store zeros-1 (so c=1 means
+    100 removed, c=7 means 10^8); c=0 means nothing removed."""
+    out = np.zeros(nanos.shape[0], np.int64)
+    for i, n in enumerate(np.asarray(nanos, np.int64)):
+        n = int(n)
+        if n == 0:
+            continue
+        if n % 100 != 0:
+            out[i] = n << 3
+            continue
+        n //= 100
+        c = 1
+        while n % 10 == 0 and c < 7:
+            n //= 10
+            c += 1
+        out[i] = (n << 3) | c
+    return out
+
+
+def _unpack_nanos(packed: np.ndarray) -> np.ndarray:
+    """Inverse (java parseNanos): multiply by 10^(c+1) when c != 0."""
+    c = packed & 7
+    base = packed >> 3
+    mult = np.where(c == 0, 1, 10 ** (c + 1)).astype(np.int64)
+    return (base * mult).astype(np.int64)
+
+
 def _encode_column(
     col_id: int, dtype: DataType, data: np.ndarray, validity: np.ndarray,
     lengths: Optional[np.ndarray],
@@ -483,6 +520,18 @@ def _encode_column(
         streams.append(_Stream(S_DATA, col_id, bytes(body)))
         streams.append(_Stream(S_SECONDARY, col_id, _rlev1_encode(
             np.full(int(live.sum()), dtype.scale, np.int64), signed=True)))
+    elif k == TypeKind.TIMESTAMP:
+        micros = data[live].astype(np.int64)
+        floor_sec = np.floor_divide(micros, 1_000_000)
+        nanos = (micros - floor_sec * 1_000_000) * 1000
+        # ORC stores trunc-toward-zero UNIX seconds (the reader's
+        # "seconds < 0 and nanos" rule re-floors them); the shift to
+        # the 2015 epoch happens after
+        tz_sec = np.where((floor_sec < 0) & (nanos > 999_999), floor_sec + 1, floor_sec)
+        streams.append(_Stream(S_DATA, col_id, _rlev1_encode(
+            tz_sec - ORC_TS_EPOCH, signed=True)))
+        streams.append(_Stream(S_SECONDARY, col_id, _rlev1_encode(
+            _pack_nanos(nanos), signed=False)))
     elif dtype.is_string:
         ln = lengths[live]
         streams.append(_Stream(S_LENGTH, col_id, _rlev1_encode(ln, signed=False)))
@@ -706,6 +755,7 @@ _KIND_TO_DTYPE = {
     K_FLOAT: DataType.float32(),
     K_DOUBLE: DataType.float64(),
     K_DATE: DataType.date32(),
+    K_TIMESTAMP: DataType.timestamp(),
 }
 
 
@@ -926,6 +976,14 @@ def read_stripe(
                 vals = int_decode(dec(ci, S_DATA), nvals, True, enc)
             data = np.zeros(rows, fld.dtype.np_dtype)
             data[validity] = vals.astype(fld.dtype.np_dtype)
+        elif k == TypeKind.TIMESTAMP:
+            rel = int_decode(dec(ci, S_DATA), nvals, True, enc)
+            nanos = _unpack_nanos(int_decode(dec(ci, S_SECONDARY), nvals, False, enc))
+            secs = rel + ORC_TS_EPOCH  # unix seconds, trunc-toward-zero
+            secs = np.where((secs < 0) & (nanos > 999_999), secs - 1, secs)
+            vals = secs * 1_000_000 + nanos // 1000
+            data = np.zeros(rows, np.int64)
+            data[validity] = vals
         elif k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
             vals = np.frombuffer(dec(ci, S_DATA), fld.dtype.np_dtype, nvals)
             data = np.zeros(rows, fld.dtype.np_dtype)

@@ -149,3 +149,57 @@ def test_orc_scan_proto_roundtrip(tmp_path):
     d = batch_to_pydict(list(rebuilt.execute(0, TaskContext(0, 1)))[0])
     assert d["k"] == list(range(20))  # pruning keeps the stripe; filter is a separate op
     assert rebuilt._conjuncts == [("k", "<", 5)]
+
+
+def test_orc_timestamp_roundtrip(tmp_path):
+    """TIMESTAMP columns (micros) through our writer/reader: positive,
+    negative (pre-2015 ORC epoch), sub-second fractions, and nulls."""
+    import numpy as np
+
+    from blaze_tpu.io.orc import read_metadata, read_stripe, write_orc
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("ts", DataType.timestamp())])
+    vals = np.array([
+        0,                       # unix epoch (pre-2015: negative rel)
+        1420070400_000_000,      # exactly the ORC epoch
+        1700000000_123_456,      # recent with sub-ms fraction
+        1420070399_000_000,      # one second before the ORC epoch
+        -123_456_789,            # pre-1970 fractional (trunc-zero secs)
+        981_173_106_987_000,     # 2001 with trailing-zero nanos
+        -7_000_000,              # null slot
+    ], np.int64)
+    validity = np.array([1, 1, 1, 1, 1, 1, 0], bool)
+    path = str(tmp_path / "ts.orc")
+    write_orc(path, schema, {"ts": (vals, validity, None)})
+    meta = read_metadata(path)
+    got = read_stripe(path, meta, meta.stripes[0])
+    data, val, _ = got["ts"]
+    assert (val == validity).all()
+    assert (data[validity] == vals[validity]).all()
+
+
+def test_orc_timestamp_pyarrow_differential(tmp_path):
+    """Timestamps written by pyarrow's real ORC writer decode to the
+    same microsecond values."""
+    import numpy as np
+
+    pa = pytest.importorskip("pyarrow")
+    paorc = pytest.importorskip("pyarrow.orc")
+
+    from blaze_tpu.io.orc import read_metadata, read_stripe
+
+    micros = [1700000000_000_000, 1500000000_500_000, None,
+              1420070400_000_000, 981_173_106_987_654]
+    table = pa.table({"ts": pa.array(
+        [None if m is None else m for m in micros], pa.timestamp("us"))})
+    path = str(tmp_path / "pa_ts.orc")
+    paorc.write_table(table, path, compression="zlib")
+    meta = read_metadata(path)
+    got = read_stripe(path, meta, meta.stripes[0])
+    data, val, _ = got["ts"]
+    for i, m in enumerate(micros):
+        if m is None:
+            assert not val[i]
+        else:
+            assert val[i] and int(data[i]) == m, (i, int(data[i]), m)
