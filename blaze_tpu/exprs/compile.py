@@ -9,9 +9,10 @@ Invariants:
 - padding rows are invalid, so kernels need no separate padding mask.
 
 Division semantics are Spark non-ANSI: x/0 -> null, int `/` -> double,
-decimal `/` -> decimal with Spark's result scale.  Decimal division
-beyond int64 range is computed through float64 (documented deviation
-from the reference's i128; roadmap: two-limb int128 emulation).
+decimal `/` -> decimal with Spark's result scale.  Decimal multiply /
+divide / rescale beyond int64 range run on exact two-limb int128
+(``exprs/int128.py``) with HALF_UP rounding — the same arithmetic the
+reference gets from Arrow decimal128 (cast.rs, check_overflow).
 """
 
 from __future__ import annotations
@@ -284,18 +285,40 @@ def _decimal_binop(op: str, l: Column, r: Column) -> Column:
     if op == "*":
         out_t = decimal_mul_type(ld.dtype, rd.dtype)
         raw_scale = ld.dtype.scale + rd.dtype.scale
-        data = ld.data * rd.data
-        if out_t.scale != raw_scale:
-            data = rescale_decimal(data, raw_scale, out_t.scale)
+        if ld.dtype.precision + rd.dtype.precision + 1 <= 18:
+            # the raw product provably fits int64
+            data = ld.data * rd.data
+            if out_t.scale != raw_scale:
+                data = rescale_decimal(data, raw_scale, out_t.scale)
+            return Column(out_t, data, decimal_overflow_null(data, validity, out_t.precision))
+        # wide multiply: exact int128 product + HALF_UP rescale
+        # (≙ reference decimal128 with check_overflow, cast.rs)
+        from . import int128 as I
+
+        hi, lo = I.mul_i64(ld.data, rd.data)
+        if out_t.scale < raw_scale:
+            data, fits = I.rescale_down(hi, lo, raw_scale - out_t.scale)
+        else:
+            if out_t.scale > raw_scale:
+                # guard the up-shift against int128 wrap (float64
+                # magnitude estimate errs toward NULL at the boundary,
+                # where Spark overflows to NULL anyway)
+                k = out_t.scale - raw_scale
+                lim = float((2**127 - 1) // (10**k))
+                est = jnp.abs(ld.data.astype(jnp.float64) * rd.data.astype(jnp.float64))
+                validity = validity & (est <= lim * 0.999)
+                hi, lo = I.mul_pow10(hi, lo, k)
+            data, fits = I.to_i64(hi, lo)
+        validity = validity & fits
         return Column(out_t, data, decimal_overflow_null(data, validity, out_t.precision))
     if op == "/":
         out_t = decimal_div_type(ld.dtype, rd.dtype)
         validity = validity & (rd.data != 0)
         shift = out_t.scale - ld.dtype.scale + rd.dtype.scale
+        den = jnp.where(rd.data == 0, jnp.int64(1), rd.data)
         # exact int64 path only when the shifted numerator provably fits
         if ld.dtype.precision + shift <= 18:
             num = ld.data * jnp.int64(10**shift)
-            den = jnp.where(rd.data == 0, jnp.int64(1), rd.data)
             half = jnp.abs(den) // 2
             adj = jnp.where(num >= 0, num + jnp.sign(den) * half, num - jnp.sign(den) * half)
             q = jnp.where(
@@ -304,12 +327,31 @@ def _decimal_binop(op: str, l: Column, r: Column) -> Column:
                 -(jnp.abs(adj) // jnp.abs(den)),
             )
             return Column(out_t, q, validity)
-        fa = ld.data.astype(jnp.float64) / float(10**ld.dtype.scale)
-        fb = rd.data.astype(jnp.float64) / float(10**rd.dtype.scale)
-        fb = jnp.where(fb == 0, 1.0, fb)
-        q = fa / fb * float(10**out_t.scale)
-        data = jnp.where(q >= 0, jnp.floor(q + 0.5), jnp.ceil(q - 0.5)).astype(jnp.int64)
-        return Column(out_t, data, validity)
+        # wide divide: int128 shifted numerator, exact HALF_UP quotient
+        from . import int128 as I
+
+        hi, lo = I.from_i64(ld.data)
+        if shift >= 0:
+            # mul_pow10 wraps silently past 2^127: numerators whose
+            # shifted magnitude cannot fit int128 overflow to NULL
+            # (their true quotients exceed 38 digits in Spark too)
+            lim = (2**127 - 1) // (10**shift)
+            if lim < 2**63:
+                fits_num = jnp.abs(ld.data) <= jnp.int64(lim)
+                validity = validity & fits_num
+                hi = jnp.where(fits_num, hi, jnp.int64(0))
+                lo = jnp.where(fits_num, lo, jnp.uint64(0))
+            hi, lo = I.mul_pow10(hi, lo, shift)
+        else:
+            # fold the down-shift into the divisor (single rounding);
+            # divisors that overflow int64 null out (|q| < 1 anyway)
+            k = 10 ** (-shift)
+            fits_den = jnp.abs(den) <= (2**63 - 1) // k
+            validity = validity & fits_den
+            den = jnp.where(fits_den, den * jnp.int64(k), jnp.int64(1))
+        q, fits = I.div_round_half_up(hi, lo, den)
+        validity = validity & fits
+        return Column(out_t, q, decimal_overflow_null(q, validity, out_t.precision))
     if op == "%":
         scale = max(ld.dtype.scale, rd.dtype.scale)
         out_t = DataType.decimal(min(38, max(ld.dtype.precision, rd.dtype.precision)), scale)

@@ -106,15 +106,38 @@ def agg_result_type(fn: str, in_t: Optional[DataType]) -> DataType:
     return in_t  # min/max/first
 
 
+def sum_is_wide(in_t: Optional[DataType]) -> bool:
+    """True when the sum accumulator can exceed int64 (decimal sums
+    with result precision > 18): accumulate in TWO radix-2^32 limbs —
+    value = hi*2^32 + lo, both int64, summed independently (redundant
+    representation: no carry propagation until finalize), exactly the
+    int128 accumulation the reference gets from Arrow decimal128."""
+    return in_t is not None and in_t.is_decimal and sum_result_type(in_t).precision > 18
+
+
 def agg_state_fields(fn: str, in_t: Optional[DataType], name: str) -> List[Field]:
     if fn in ("count", "count_star"):
         return [Field(f"{name}#count", DataType.int64())]
     if fn == "sum":
+        if sum_is_wide(in_t):
+            # hi limb carries the decimal (p,s) metadata for merge-mode
+            # input-type recovery; lo is a plain non-negative int64
+            return [
+                Field(f"{name}#sum_hi", sum_result_type(in_t)),
+                Field(f"{name}#sum_lo", DataType.int64()),
+                Field(f"{name}#nonnull", DataType.int64()),
+            ]
         return [
             Field(f"{name}#sum", sum_result_type(in_t)),
             Field(f"{name}#nonnull", DataType.int64()),
         ]
     if fn == "avg":
+        if sum_is_wide(in_t):
+            return [
+                Field(f"{name}#sum_hi", sum_result_type(in_t)),
+                Field(f"{name}#sum_lo", DataType.int64()),
+                Field(f"{name}#count", DataType.int64()),
+            ]
         return [
             Field(f"{name}#sum", sum_result_type(in_t)),
             Field(f"{name}#count", DataType.int64()),
@@ -498,9 +521,17 @@ class AggExec(ExecNode):
                     self._in_types.append(None)
                 elif a.fn in ("sum", "avg"):
                     # state sum column carries the sum type; recover in_t
-                    st = in_schema.field(f"{a.name}#sum").dtype
+                    # (wide decimal sums split into #sum_hi/#sum_lo limbs).
+                    # BOTH sum and avg states carry decimal(p+10, s), so
+                    # both subtract 10 — recovering p+10 as the input
+                    # precision would flip sum_is_wide() against the
+                    # partial stage's state layout and miss its columns
+                    if f"{a.name}#sum" in in_schema.names:
+                        st = in_schema.field(f"{a.name}#sum").dtype
+                    else:
+                        st = in_schema.field(f"{a.name}#sum_hi").dtype
                     if st.is_decimal:
-                        self._in_types.append(DataType.decimal(max(1, st.precision - (10 if a.fn == "sum" else 0)), st.scale))
+                        self._in_types.append(DataType.decimal(max(1, st.precision - 10), st.scale))
                     else:
                         self._in_types.append(st)
                 elif a.fn in ("collect_list", "collect_set"):
@@ -620,6 +651,31 @@ class AggExec(ExecNode):
                 return [Column(DataType.int64(), s, jnp.ones(cap, jnp.bool_))]
             if a.fn in ("sum", "avg"):
                 sum_t = sum_result_type(in_t)
+                ones = jnp.ones(cap, jnp.bool_)
+                if sum_is_wide(in_t):
+                    # radix-2^32 limbs summed independently (redundant
+                    # carry-free int128 accumulation; finalize combines)
+                    if merging:
+                        hc, lc, cc = inputs
+                        hi_in, lo_in, hval = hc.data, lc.data, hc.validity
+                        cval, cdata = cc.validity, cc.data
+                    else:
+                        v = inputs[0]
+                        hi_in = v.data >> jnp.int64(32)
+                        lo_in = v.data & jnp.int64(0xFFFFFFFF)
+                        hval = v.validity
+                        cval, cdata = v.validity, None
+                    s_hi = _seg_sum(hi_in, hval, seg, cap)
+                    s_lo = _seg_sum(lo_in, hval, seg, cap)
+                    c = (
+                        _seg_sum(cdata, cval, seg, cap)
+                        if merging else _seg_count(cval, seg, cap)
+                    )
+                    return [
+                        Column(sum_t, s_hi, ones),
+                        Column(DataType.int64(), s_lo, ones),
+                        Column(DataType.int64(), c, ones),
+                    ]
                 if merging:
                     sc, cc = inputs
                     s = _seg_sum(sc.data, sc.validity, seg, cap)
@@ -630,8 +686,8 @@ class AggExec(ExecNode):
                     s = _seg_sum(vv, v.validity, seg, cap)
                     c = _seg_count(v.validity, seg, cap)
                 return [
-                    Column(sum_t, s, jnp.ones(cap, jnp.bool_)),
-                    Column(DataType.int64(), c, jnp.ones(cap, jnp.bool_)),
+                    Column(sum_t, s, ones),
+                    Column(DataType.int64(), c, ones),
                 ]
             if a.fn in ("min", "max"):
                 v = inputs[0]
@@ -778,21 +834,52 @@ class AggExec(ExecNode):
 
         # finalization: state batch -> output batch (FINAL mode)
 
+        def combine_limbs(hi, lo):
+            """(hi*2^32 + lo) limbs -> int128 (hi64, lo64)."""
+            from ..exprs import int128 as I
+
+            h128 = (hi >> jnp.int64(32), (hi << jnp.int64(32)).view(jnp.uint64))
+            return I.add(*h128, *I.from_i64(lo))
+
         @jax.jit
         def finalize_kernel(cols: Tuple[Column, ...]):
+            from ..exprs import int128 as I
+
             env = {f.name: c for f, c in zip(state_schema.fields, cols)}
             out: List[Column] = [env[g.name] for g in groupings]
             for a, t in zip(aggs, in_types):
                 if a.fn in ("count", "count_star"):
                     out.append(env[f"{a.name}#count"])
                 elif a.fn == "sum":
-                    s = env[f"{a.name}#sum"]
-                    nn = env[f"{a.name}#nonnull"]
-                    out.append(Column(s.dtype, s.data, s.validity & (nn.data > 0)))
+                    if sum_is_wide(t):
+                        hc = env[f"{a.name}#sum_hi"]
+                        lc = env[f"{a.name}#sum_lo"]
+                        nn = env[f"{a.name}#nonnull"]
+                        vh, vl = combine_limbs(hc.data, lc.data)
+                        data, fits = I.to_i64(vh, vl)
+                        # values beyond int64 overflow to NULL (Spark
+                        # nulls beyond precision 38; our representable
+                        # domain ends at 2^63-1 ≈ 19 digits)
+                        out.append(Column(hc.dtype, data, hc.validity & fits & (nn.data > 0)))
+                    else:
+                        s = env[f"{a.name}#sum"]
+                        nn = env[f"{a.name}#nonnull"]
+                        out.append(Column(s.dtype, s.data, s.validity & (nn.data > 0)))
                 elif a.fn == "avg":
+                    res_t = agg_result_type("avg", t)
+                    if sum_is_wide(t):
+                        hc = env[f"{a.name}#sum_hi"]
+                        lc = env[f"{a.name}#sum_lo"]
+                        c = env[f"{a.name}#count"]
+                        valid = hc.validity & (c.data > 0)
+                        den = jnp.where(c.data == 0, jnp.int64(1), c.data)
+                        vh, vl = combine_limbs(hc.data, lc.data)
+                        vh, vl = I.mul_pow10(vh, vl, res_t.scale - hc.dtype.scale)
+                        q, fits = I.div_round_half_up(vh, vl, den)
+                        out.append(Column(res_t, q, valid & fits))
+                        continue
                     s = env[f"{a.name}#sum"]
                     c = env[f"{a.name}#count"]
-                    res_t = agg_result_type("avg", t)
                     valid = s.validity & (c.data > 0)
                     den = jnp.where(c.data == 0, jnp.int64(1), c.data)
                     if res_t.is_decimal:
@@ -803,8 +890,10 @@ class AggExec(ExecNode):
                             adj = jnp.where(num >= 0, num + half, num - half)
                             q = jnp.where(adj >= 0, adj // den, -((-adj) // den))
                         else:
-                            f = s.data.astype(jnp.float64) * float(10**shift) / den.astype(jnp.float64)
-                            q = jnp.where(f >= 0, jnp.floor(f + 0.5), jnp.ceil(f - 0.5)).astype(jnp.int64)
+                            # shifted sum may exceed int64: exact int128
+                            vh, vl = I.mul_pow10(*I.from_i64(s.data), shift)
+                            q, fits = I.div_round_half_up(vh, vl, den)
+                            valid = valid & fits
                         out.append(Column(res_t, q, valid))
                     else:
                         out.append(

@@ -54,25 +54,27 @@ def oracle_q1(tables: Dict[str, HostTable]):
     ext = li["l_extendedprice"][0]
     disc = li["l_discount"][0]
     tax = li["l_tax"][0]
-    disc_price = ext * (100 - disc)                 # scale 4
-    charge = disc_price * (100 + tax)               # scale 6
+    disc_price = (ext * (100 - disc)).astype(object)          # scale 4
+    charge = disc_price * (100 + tax).astype(object)          # scale 6
     out = {}
     for key in sorted(set(zip(rf[mask], ls[mask]))):
         m = mask & (rf == key[0]) & (ls == key[1])
         n = int(m.sum())
-        # avg: sum(dec(22,2)) -> avg dec(16,6): engine float64 path
-        def avg(vals, in_scale):
-            s = int(vals[m].sum())
-            f = float(s) * float(10 ** 4) / n
-            return int(_round_half_up(np.array([f]))[0])
+        # avg: sum(dec(22,2)) -> avg dec(16,6), EXACT integer HALF_UP
+        # (the engine accumulates on two-limb int128 — bignum is the
+        # matching oracle; a float64 detour here would drift at scale)
+        def avg(vals):
+            # q1 measures are non-negative; HALF_UP == floor(x + n/2)
+            s = int(vals[m].astype(object).sum())
+            return (s * 10**4 + n // 2) // n
         out[key] = dict(
             sum_qty=int(qty[m].sum()),
             sum_base_price=int(ext[m].sum()),
             sum_disc_price=int(disc_price[m].sum()),
             sum_charge=int(charge[m].sum()),
-            avg_qty=avg(qty, 2),
-            avg_price=avg(ext, 2),
-            avg_disc=avg(disc, 2),
+            avg_qty=avg(qty),
+            avg_price=avg(ext),
+            avg_disc=avg(disc),
             count_order=n,
         )
     return out

@@ -1,0 +1,245 @@
+"""Two-limb int128 decimal semantics: exact wide multiply/divide/
+rescale and sum/avg accumulation beyond int64, differentially tested
+against Python bignum/Decimal (≙ the reference's Arrow decimal128 +
+check_overflow arithmetic, datafusion-ext-commons/src/cast.rs)."""
+
+import decimal
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict, column_from_numpy
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.exprs import int128 as I
+from blaze_tpu.ops import (
+    AggExec, AggFunction, AggMode, FilterExec, MemoryScanExec, ProjectExec,
+)
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+from blaze_tpu.tpch.queries import two_stage_agg
+
+RNG = np.random.RandomState(1234)
+
+
+def rand_i64(n, bits=63):
+    m = RNG.randint(1, bits + 1, n)
+    return np.array([RNG.randint(-(2 ** (b - 1)), 2 ** (b - 1)) for b in m],
+                    np.int64)
+
+
+def as_bignum(hi, lo):
+    return np.asarray(hi).astype(object) * 2**64 + np.asarray(lo).astype(object)
+
+
+# ------------------------------------------------------------ int128 core
+
+def test_mul_i64_exact():
+    a, b = rand_i64(2000), rand_i64(2000)
+    hi, lo = I.mul_i64(jnp.asarray(a), jnp.asarray(b))
+    assert (as_bignum(hi, lo) == a.astype(object) * b.astype(object)).all()
+
+
+def test_add_sub_neg_roundtrip():
+    a, b = rand_i64(2000), rand_i64(2000)
+    ah, al = I.from_i64(jnp.asarray(a))
+    bh, bl = I.from_i64(jnp.asarray(b))
+    sh, sl = I.add(ah, al, bh, bl)
+    assert (as_bignum(sh, sl) == a.astype(object) + b.astype(object)).all()
+    dh, dl = I.sub(sh, sl, bh, bl)
+    assert (as_bignum(dh, dl) == a.astype(object)).all()
+
+
+@pytest.mark.parametrize("k", [1, 2, 4, 9, 13, 18])
+def test_mul_pow10_rescale_roundtrip(k):
+    v = rand_i64(800, bits=60)
+    hi, lo = I.mul_pow10(*I.from_i64(jnp.asarray(v)), k)
+    assert (as_bignum(hi, lo) == v.astype(object) * 10**k).all()
+    q, ok = I.rescale_down(hi, lo, k)
+    assert np.asarray(ok).all()
+    assert (np.asarray(q) == v).all()
+
+
+def _py_half_up(n, d):
+    s = -1 if (n < 0) ^ (d < 0) else 1
+    n, d = abs(n), abs(d)
+    return s * ((n + d // 2) // d)
+
+
+def test_div_round_half_up_vs_bignum():
+    n = 3000
+    ah = RNG.randint(-2**40, 2**40, n).astype(np.int64)
+    al = (RNG.randint(0, 2**62, n).astype(np.uint64) << np.uint64(1)) | RNG.randint(0, 2, n).astype(np.uint64)
+    den = rand_i64(n, bits=62)
+    den[den == 0] = 7
+    q, ok = I.div_round_half_up(jnp.asarray(ah), jnp.asarray(al), jnp.asarray(den))
+    num = ah.astype(object) * 2**64 + al.astype(object)
+    q_np, ok_np = np.asarray(q), np.asarray(ok)
+    for i in range(n):
+        exp = _py_half_up(int(num[i]), int(den[i]))
+        if -(2**63) <= exp < 2**63:
+            assert bool(ok_np[i]) and int(q_np[i]) == exp, (
+                i, int(q_np[i]), exp, int(num[i]), int(den[i]))
+
+
+def test_half_up_boundary_cases():
+    cases = [(5, 10, 1), (-5, 10, -1), (15, 10, 2), (-15, 10, -2),
+             (25, 10, 3), (5, 2, 3), (-5, 2, -3), (1, 3, 0), (2, 3, 1)]
+    for n_, d_, e_ in cases:
+        hi, lo = I.from_i64(jnp.asarray(np.array([n_], np.int64)))
+        q, _ = I.div_round_half_up(hi, lo, jnp.asarray(np.array([d_], np.int64)))
+        assert int(np.asarray(q)[0]) == e_, (n_, d_)
+
+
+# ----------------------------------------------------- engine expressions
+
+def _dec_col(unscaled, p, s):
+    return column_from_numpy(DataType.decimal(p, s), np.asarray(unscaled, np.int64))
+
+
+def _run_binop(op, a_unscaled, pa, sa, b_unscaled, pb, sb):
+    schema = Schema([Field("a", DataType.decimal(pa, sa)),
+                     Field("b", DataType.decimal(pb, sb))])
+    batch = batch_from_pydict({}, Schema([]))  # placeholder
+    from blaze_tpu.batch import RecordBatch
+
+    cols = [_dec_col(a_unscaled, pa, sa), _dec_col(b_unscaled, pb, sb)]
+    rb = RecordBatch(schema, cols, len(a_unscaled))
+    src = MemoryScanExec([[rb]], schema)
+    e = {"*": col("a") * col("b"), "/": col("a") / col("b")}[op]
+    plan = ProjectExec(src, [e.alias("r")])
+    out = list(plan.execute(0, TaskContext(0, 1)))[0]
+    return plan.schema.field("r").dtype, batch_to_pydict(out)["r"]
+
+
+def test_wide_decimal_multiply_vs_bignum():
+    """decimal(15,2) * decimal(15,2): raw products overflow int64; the
+    engine must match bignum HALF_UP rescale exactly (or null when the
+    result exceeds the representable domain)."""
+    n = 500
+    a = rand_i64(n, bits=49)  # up to ~5.6e14 unscaled
+    b = rand_i64(n, bits=49)
+    res_t, got = _run_binop("*", a, 15, 2, b, 15, 2)
+    assert res_t.is_decimal
+    k = 2 + 2 - res_t.scale
+    for i in range(n):
+        raw = int(a[i]) * int(b[i])
+        exp = _py_half_up(raw, 10**k) if k > 0 else raw * 10**(-k)
+        if -(2**63) <= exp < 2**63:
+            assert got[i] == exp, (i, got[i], exp)
+        else:
+            assert got[i] is None, (i, got[i], exp)
+
+
+def test_wide_decimal_divide_vs_bignum():
+    """decimal(18,4) / decimal(18,4): the shifted numerator exceeds
+    int64; engine quotient must equal bignum HALF_UP exactly."""
+    n = 500
+    a = rand_i64(n, bits=59)
+    b = rand_i64(n, bits=40)
+    b[b == 0] = 123
+    res_t, got = _run_binop("/", a, 18, 4, b, 18, 4)
+    shift = res_t.scale - 4 + 4
+    for i in range(n):
+        exp = _py_half_up(int(a[i]) * 10**shift, int(b[i]))
+        if -(2**63) <= exp < 2**63:
+            assert got[i] == exp, (i, got[i], exp)
+
+
+# ------------------------------------------------------- agg accumulation
+
+def _agg_once(values_unscaled, p, s, fns, n_parts=2, batch_rows=64):
+    schema = Schema([Field("v", DataType.decimal(p, s))])
+    from blaze_tpu.batch import RecordBatch
+
+    parts = []
+    vs = np.asarray(values_unscaled, np.int64)
+    per = (len(vs) + n_parts - 1) // n_parts
+    for pi in range(n_parts):
+        sl = vs[pi * per:(pi + 1) * per]
+        batches = []
+        for off in range(0, len(sl), batch_rows):
+            chunk = sl[off:off + batch_rows]
+            batches.append(RecordBatch(schema, [_dec_col(chunk, p, s)], len(chunk)))
+        parts.append(batches)
+    src = MemoryScanExec(parts, schema)
+    aggs = [AggFunction(fn, col("v"), f"r_{fn}") for fn in fns]
+    plan = two_stage_agg(src, [], aggs, n_parts)
+    out = {}
+    for pi in range(plan.num_partitions()):
+        for b in plan.execute(pi, TaskContext(pi, plan.num_partitions())):
+            out.update(batch_to_pydict(b))
+    return out
+
+
+def test_wide_sum_avg_exact_vs_bignum():
+    """sum/avg over decimal(12,2) (sum type decimal(22,2) > 18 digits):
+    two-limb accumulation must match bignum exactly, including the
+    scale-4 avg rescale that previously went through float64 and
+    dropped low-order digits."""
+    n = 4000
+    # values whose low bits float64 cannot carry once shifted by 10^4
+    vs = (RNG.randint(0, 2**37, n).astype(np.int64) * 8192
+          + RNG.randint(0, 8192, n).astype(np.int64))  # ≤ ~1.1e15 each
+    vs = np.where(RNG.rand(n) < 0.3, -vs, vs)
+    out = _agg_once(vs, 12, 2, ["sum", "avg"])
+    total = int(vs.astype(object).sum())
+    assert out["r_sum"] == [total]
+    # avg result scale = 2 + 4 = 6 -> unscaled * 10^4 / n, HALF_UP
+    assert out["r_avg"] == [_py_half_up(total * 10**4, n)]
+
+
+def test_wide_sum_overflow_nulls_not_wraps():
+    """A sum whose true value exceeds int64 must produce NULL (the
+    documented overflow domain), never a silently wrapped value."""
+    vs = np.full(10, 4 * 10**18, np.int64)  # Σ = 4e19 > 2^63-1
+    out = _agg_once(vs, 18, 0, ["sum"])
+    assert out["r_sum"] == [None]
+
+
+def test_wide_sum_near_max_exact():
+    vs = np.full(9, 10**18, np.int64)  # Σ = 9e18, just under 2^63-1
+    out = _agg_once(vs, 18, 0, ["sum"])
+    assert out["r_sum"] == [9 * 10**18]
+
+
+def test_grouped_wide_sum_exact():
+    """Grouped (segment) path: per-group exact limbs."""
+    n = 3000
+    keys = RNG.randint(0, 7, n).astype(np.int64)
+    vs = (RNG.randint(0, 2**33, n).astype(np.int64) * 2048
+          + RNG.randint(0, 2048, n).astype(np.int64))
+    schema = Schema([Field("k", DataType.int64()), Field("v", DataType.decimal(12, 2))])
+    from blaze_tpu.batch import RecordBatch
+
+    cols = [column_from_numpy(DataType.int64(), keys), _dec_col(vs, 12, 2)]
+    src = MemoryScanExec([[RecordBatch(schema, cols, n)]], schema)
+    from blaze_tpu.ops import GroupingExpr
+
+    plan = two_stage_agg(src, [GroupingExpr(col("k"), "k")],
+                         [AggFunction("sum", col("v"), "s"),
+                          AggFunction("avg", col("v"), "a")], 2)
+    got = {}
+    for pi in range(plan.num_partitions()):
+        for b in plan.execute(pi, TaskContext(pi, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            for k, s, a in zip(d["k"], d["s"], d["a"]):
+                got[k] = (s, a)
+    for k in set(keys.tolist()):
+        m = keys == k
+        total = int(vs[m].astype(object).sum())
+        cnt = int(m.sum())
+        assert got[k] == (total, _py_half_up(total * 10**4, cnt)), k
+
+
+def test_narrow_decimal_avg_two_stage():
+    """avg over decimal(7,2) (sum type decimal(17,2), NOT wide): the
+    FINAL stage's input-type recovery must agree with the partial
+    stage's state layout — regression for a KeyError on #sum_hi when
+    recovery misclassified narrow avgs as wide."""
+    n = 500
+    vs = RNG.randint(-10**6, 10**6, n).astype(np.int64)
+    out = _agg_once(vs, 7, 2, ["sum", "avg"])
+    total = int(vs.sum())
+    assert out["r_sum"] == [total]
+    assert out["r_avg"] == [_py_half_up(total * 10**4, n)]
