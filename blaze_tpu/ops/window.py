@@ -52,7 +52,12 @@ class WindowFunction:
     expr: Optional[Expr] = None
     whole_partition: bool = False  # True: unbounded..unbounded frame
     rows_frame: Optional[Tuple[Optional[int], Optional[int]]] = None
-    offset: int = 1  # lead/lag row offset
+    offset: int = 1  # lead/lag row offset; ntile bucket count; nth_value n
+    ignore_nulls: bool = False  # lead/lag: skip nulls when offsetting
+    # RANGE BETWEEN x PRECEDING AND y FOLLOWING on a single numeric
+    # ORDER BY key: logical value offsets (None bound = unbounded).
+    # Frame rows found by per-partition binary search on the sorted key.
+    range_frame: Optional[Tuple[Optional[int], Optional[int]]] = None
 
 
 def _minmax_sentinel(dt, kind: str):
@@ -105,6 +110,77 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
 
         out_cols: List[Column] = list(cols)
         ones = jnp.ones(cap, jnp.bool_) & live
+
+        def range_bounds(f):
+            """[lo, hi] row indices of a RANGE offset frame: binary
+            search over the partition's sorted single ORDER BY key
+            (static log2(cap) steps, vectorized).
+
+            NULL order keys follow Spark's semantics: a null row's
+            frame is its null PEER GROUP (all nulls sort together), and
+            non-null rows search only the non-null region — nulls would
+            otherwise break the sorted-key invariant with garbage data
+            lanes."""
+            assert len(ord_by) == 1, "RANGE offset frame needs ONE order key"
+            kc = lower(ord_by[0].expr, in_schema, env, cap)
+            key = kc.data.astype(jnp.int64)
+            kvalid = kc.validity & live
+            x, y = f.range_frame
+            if kc.dtype.is_decimal:
+                # frame offsets are LOGICAL values; the key column is
+                # unscaled ints
+                sc = 10 ** kc.dtype.scale
+                x = None if x is None else x * sc
+                y = None if y is None else y * sc
+            if not ord_by[0].ascending:
+                # descending order: negate so the partition region is
+                # ascending and the offsets swap roles
+                key = -key
+            # nulls are contiguous at the partition's head or tail (the
+            # upstream sort honours nulls_first); exclude them from the
+            # searched region
+            cnulls = jnp.cumsum((~kvalid & live).astype(jnp.int64))
+            base_n = jnp.where(
+                start_of_row > 0,
+                jnp.take(cnulls, jnp.maximum(start_of_row - 1, 0)), 0,
+            )
+            n_nulls = jnp.take(cnulls, jnp.clip(part_end, 0, cap - 1)) - base_n
+            if ord_by[0].nulls_first:
+                region_lo = start_of_row + n_nulls
+                region_hi = part_end
+            else:
+                region_lo = start_of_row
+                region_hi = part_end - n_nulls
+            steps = max(1, int(np.ceil(np.log2(max(cap, 2)))) + 1)
+
+            def bsearch(target, side_left: bool):
+                # first index in [region_lo, region_hi] with
+                # key >= target (left) / key > target (right edge+1)
+                lo_b = region_lo
+                hi_b = region_hi + 1
+                for _ in range(steps):
+                    mid = (lo_b + hi_b) // 2
+                    kv = jnp.take(key, jnp.clip(mid, 0, cap - 1))
+                    go_right = (kv < target) if side_left else (kv <= target)
+                    lo_b = jnp.where((mid < hi_b) & go_right, mid + 1, lo_b)
+                    hi_b = jnp.where((mid < hi_b) & go_right, hi_b, mid)
+                return lo_b
+
+            v = jnp.take(key, jnp.clip(pos, 0, cap - 1))
+            lo = region_lo if x is None else bsearch(v - x, True)
+            hi = region_hi if y is None else bsearch(v + y, False) - 1
+            # null rows: the frame is the null peer group itself
+            null_lo = jnp.where(
+                ord_by[0].nulls_first, start_of_row, region_hi + 1
+            )
+            null_hi = jnp.where(
+                ord_by[0].nulls_first, region_lo - 1, part_end
+            )
+            row_is_null = ~jnp.take(kvalid, jnp.clip(pos, 0, cap - 1))
+            lo = jnp.where(row_is_null, null_lo, lo)
+            hi = jnp.where(row_is_null, null_hi, hi)
+            return lo, hi
+
         for f in functions_:
             if f.kind == "row_number":
                 v = pos - start_of_row + 1
@@ -120,18 +196,80 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
                 peers_at_start = jnp.take(peers_seen, start_of_row)
                 v = peers_seen - peers_at_start + 1
                 out_cols.append(Column(DataType.int64(), v, ones))
-            elif f.kind in ("lead", "lag"):
-                # offset row within the partition; NULL past the edge
+            elif f.kind == "ntile":
+                n_buckets = f.offset
+                rn0 = pos - start_of_row
+                count = part_end - start_of_row + 1
+                base = count // n_buckets
+                rem = count % n_buckets
+                # first `rem` buckets take base+1 rows (Spark NTile)
+                fat = rem * (base + 1)
+                in_fat = rn0 < fat
+                v = jnp.where(
+                    in_fat,
+                    rn0 // jnp.maximum(base + 1, 1),
+                    rem + (rn0 - fat) // jnp.maximum(base, 1),
+                ) + 1
+                out_cols.append(Column(DataType.int64(), v, ones))
+            elif f.kind == "nth_value":
+                # value of the frame's n-th row; NULL until the default
+                # running frame has grown to n rows (Spark NthValue)
                 c = lower(f.expr, in_schema, env, cap)
-                k = f.offset if f.kind == "lead" else -f.offset
-                src = pos + k
-                in_part = (src >= start_of_row) & (src <= part_end)
+                src = start_of_row + (f.offset - 1)
+                frame_end = part_end if f.whole_partition else peer_end
+                in_frame = src <= frame_end
                 idx = jnp.clip(src, 0, cap - 1).astype(jnp.int32)
                 g = c.take(idx)
                 out_cols.append(
-                    Column(c.dtype, g.data, g.validity & in_part & ones,
+                    Column(c.dtype, g.data, g.validity & in_frame & ones,
                            g.lengths, g.children)
                 )
+            elif f.kind in ("lead", "lag"):
+                c = lower(f.expr, in_schema, env, cap)
+                if f.ignore_nulls:
+                    # k-th NON-NULL neighbour: map valid-ranks to row
+                    # indexes once, then gather each row's target rank
+                    valid = c.validity & live
+                    cv = jnp.cumsum(valid.astype(jnp.int64))  # inclusive
+                    rank_slot = jnp.where(valid, cv, jnp.int64(0))
+                    idx_of_rank = (
+                        jnp.zeros(cap + 1, jnp.int64)
+                        .at[rank_slot].set(jnp.where(valid, pos, jnp.int64(0)))
+                    )
+                    base = jnp.where(
+                        start_of_row > 0,
+                        jnp.take(cv, jnp.maximum(start_of_row - 1, 0)),
+                        jnp.int64(0),
+                    )
+                    if f.kind == "lag":
+                        # k-th valid strictly BEFORE pos, within part
+                        before = cv - valid.astype(jnp.int64)
+                        target = before - (f.offset - 1)
+                        in_part = target > base
+                    else:
+                        # k-th valid strictly AFTER pos
+                        target = cv + f.offset
+                        end_cv = jnp.take(cv, jnp.clip(part_end, 0, cap - 1))
+                        in_part = target <= end_cv
+                    src = jnp.take(
+                        idx_of_rank, jnp.clip(target, 0, cap).astype(jnp.int32)
+                    )
+                    g = c.take(jnp.clip(src, 0, cap - 1).astype(jnp.int32))
+                    out_cols.append(
+                        Column(c.dtype, g.data, g.validity & in_part & ones,
+                               g.lengths, g.children)
+                    )
+                else:
+                    # offset row within the partition; NULL past the edge
+                    k = f.offset if f.kind == "lead" else -f.offset
+                    src = pos + k
+                    in_part = (src >= start_of_row) & (src <= part_end)
+                    idx = jnp.clip(src, 0, cap - 1).astype(jnp.int32)
+                    g = c.take(idx)
+                    out_cols.append(
+                        Column(c.dtype, g.data, g.validity & in_part & ones,
+                               g.lengths, g.children)
+                    )
             elif f.kind in ("first_value", "last_value"):
                 # default frame: first over the partition start..peer
                 # end window == value at partition start; last == value
@@ -159,17 +297,21 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
                     )
                     csum = jnp.cumsum(vals)
                     cnt = jnp.cumsum(valid.astype(jnp.int64))
-                    if f.rows_frame is not None:
-                        # ROWS BETWEEN p PRECEDING AND q FOLLOWING:
+                    if f.rows_frame is not None or f.range_frame is not None:
+                        # ROWS BETWEEN p..q / RANGE BETWEEN x..y:
                         # prefix-sum difference over [lo, hi] clamped
                         # to the partition
-                        p_, q_ = f.rows_frame
-                        lo = start_of_row if p_ is None else jnp.maximum(pos - p_, start_of_row)
-                        hi = part_end if q_ is None else jnp.minimum(pos + q_, part_end)
+                        if f.rows_frame is not None:
+                            p_, q_ = f.rows_frame
+                            lo = start_of_row if p_ is None else jnp.maximum(pos - p_, start_of_row)
+                            hi = part_end if q_ is None else jnp.minimum(pos + q_, part_end)
+                        else:
+                            lo, hi = range_bounds(f)
                         base_sum = jnp.where(lo > 0, jnp.take(csum, jnp.maximum(lo - 1, 0)), 0)
                         base_cnt = jnp.where(lo > 0, jnp.take(cnt, jnp.maximum(lo - 1, 0)), 0)
-                        run_sum = jnp.take(csum, hi) - base_sum
-                        run_cnt = jnp.take(cnt, hi) - base_cnt
+                        hi_c = jnp.clip(hi, 0, cap - 1)
+                        run_sum = jnp.take(csum, hi_c) - base_sum
+                        run_cnt = jnp.take(cnt, hi_c) - base_cnt
                         empty = hi < lo  # e.g. 0 PRECEDING..0 FOLLOWING off-range
                         run_sum = jnp.where(empty, 0, run_sum)
                         run_cnt = jnp.where(empty, 0, run_cnt)
@@ -210,7 +352,40 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
                 elif f.kind in ("min", "max"):
                     from .agg import _seg_minmax
 
-                    if f.rows_frame is not None:
+                    if f.range_frame is not None:
+                        # sparse table over the full column (window
+                        # width is value-dependent), bounds from the
+                        # per-partition binary search
+                        dt = c.data.dtype
+                        sentinel = _minmax_sentinel(dt, f.kind)
+                        op = jnp.minimum if f.kind == "min" else jnp.maximum
+                        levels = max(1, int(np.ceil(np.log2(max(cap, 2)))) + 1)
+                        t = jnp.where(valid, c.data, sentinel)
+                        table = [t]
+                        for j in range(1, levels):
+                            half = 1 << (j - 1)
+                            prev = table[-1]
+                            shifted = jnp.concatenate(
+                                [prev[half:], jnp.full(half, sentinel, dt)]
+                            )
+                            table.append(op(prev, shifted))
+                        tbl = jnp.stack(table)
+                        l, r = range_bounds(f)
+                        ln = jnp.maximum(r - l + 1, 1)
+                        jlev = jnp.zeros(cap, jnp.int32)
+                        for k in range(1, levels):
+                            jlev = jlev + (ln >= (1 << k)).astype(jnp.int32)
+                        a = tbl[jlev, jnp.clip(l, 0, cap - 1)]
+                        b_end = jnp.clip(r - (1 << jlev.astype(jnp.int64)) + 1, 0, cap - 1)
+                        run = op(a, tbl[jlev, b_end])
+                        cv = jnp.cumsum(valid.astype(jnp.int64))
+                        base_cnt = jnp.where(l > 0, jnp.take(cv, jnp.maximum(l - 1, 0)), 0)
+                        run_cnt = jnp.take(cv, jnp.clip(r, 0, cap - 1)) - base_cnt
+                        has = ones & (run_cnt > 0) & (r >= l)
+                        out_cols.append(
+                            Column(c.dtype, jnp.where(has, run, jnp.zeros((), dt)), has)
+                        )
+                    elif f.rows_frame is not None:
                         # sliding min/max over ROWS BETWEEN p..q via a
                         # SPARSE TABLE: L = ceil(log2(maxW)) doubling
                         # levels T_j[i] = op(T_{j-1}[i], T_{j-1}[i+2^(j-1)])
@@ -313,6 +488,21 @@ class WindowExec(ExecNode):
         self.partition_by = list(partition_by)
         self.order_by = list(order_by)
         for f in self.functions:
+            if f.range_frame is not None:
+                if f.kind not in ("sum", "count", "avg", "min", "max"):
+                    raise NotImplementedError(
+                        f"RANGE frame for window kind {f.kind!r}"
+                    )
+                if len(self.order_by) != 1:
+                    raise NotImplementedError(
+                        "RANGE offset frame requires exactly one ORDER BY key"
+                    )
+                kt = infer_dtype(self.order_by[0].expr, child.schema)
+                if not (kt.is_integer or kt.is_decimal or kt.kind.name == "DATE32"):
+                    raise NotImplementedError(
+                        "RANGE offset frame requires an integral order key"
+                    )
+                continue
             if f.rows_frame is None:
                 continue
             if f.kind in ("sum", "count", "avg"):
@@ -329,8 +519,10 @@ class WindowExec(ExecNode):
         in_schema = child.schema
         out_fields = list(in_schema.fields)
         for f in self.functions:
-            if f.kind in ("row_number", "rank", "dense_rank", "count"):
+            if f.kind in ("row_number", "rank", "dense_rank", "count", "ntile"):
                 out_fields.append(Field(f.name, DataType.int64()))
+            elif f.kind == "nth_value":
+                out_fields.append(Field(f.name, infer_dtype(f.expr, in_schema)))
             elif f.kind in ("lead", "lag", "first_value", "last_value"):
                 out_fields.append(Field(f.name, infer_dtype(f.expr, in_schema)))
             elif f.kind == "sum":
@@ -359,7 +551,8 @@ class WindowExec(ExecNode):
         self._kernel = cached_kernel(
             ("window", schema_key(in_schema),
              tuple((f.kind, f.name, None if f.expr is None else expr_key(f.expr),
-                    f.whole_partition, f.rows_frame, f.offset) for f in functions_),
+                    f.whole_partition, f.rows_frame, f.offset,
+                    f.ignore_nulls, f.range_frame) for f in functions_),
              tuple(expr_key(e) for e in part_by),
              tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in ord_by)),
             build,

@@ -78,10 +78,17 @@ class RangePartitioning(Partitioning):
     order, so per-partition sorts + ordered partition reads give a
     total order.  Boundaries are exact order-statistic rows computed
     device-side by the in-process exchange (Spark samples; with the
-    map output already in HBM the exact quantiles are as cheap)."""
+    map output already in HBM the exact quantiles are as cheap).
+
+    ``boundaries``: optional precomputed boundary ORDER WORDS (tuple of
+    uint64 arrays, one per key word, each (num_partitions-1,)) — the
+    scheduler's driver-side sampling pass fills this in so map tasks on
+    the file-shuffle/serde path can assign pids locally (≙ Spark's
+    RangePartitioner sample job shipped inside the ShuffleDependency)."""
 
     fields: Sequence  # SortField
     num_partitions: int
+    boundaries: Optional[tuple] = None
 
 
 @partial(jax.jit, static_argnames=("n_out",))
@@ -151,7 +158,13 @@ class ShuffleRepartitioner(MemConsumer):
         self._lock = threading.Lock()
 
     def insert_sorted(self, sorted_batch_host: RecordBatch, counts: np.ndarray) -> None:
-        """Append per-pid slices of a pid-sorted host batch."""
+        """Append per-pid slices of a pid-sorted host batch.
+
+        Holds the consumer lock: the memory manager may invoke
+        ``spill()`` from ANOTHER map task's thread at any moment, and
+        an append racing the spill's read-then-clear silently DROPS the
+        batch (observed as wrong counts at SF0.1 under a capped
+        budget)."""
 
         def slice_col(c: Column, lo: int, hi: int) -> Column:
             s = lambda a: None if a is None else np.asarray(a)[lo:hi]
@@ -163,14 +176,16 @@ class ShuffleRepartitioner(MemConsumer):
 
         offsets = np.concatenate([[0], np.cumsum(counts)])
         cols = sorted_batch_host.columns
-        for pid in range(self.n_out):
-            lo, hi = int(offsets[pid]), int(offsets[pid + 1])
-            if hi == lo:
-                continue
-            b = RecordBatch(self.schema, [slice_col(c, lo, hi) for c in cols], hi - lo)
-            self._buffers[pid].append(b)
-            self._buffered_bytes += b.memory_size()
-        self.update_mem_used(self._buffered_bytes)
+        with self._lock:
+            for pid in range(self.n_out):
+                lo, hi = int(offsets[pid]), int(offsets[pid + 1])
+                if hi == lo:
+                    continue
+                b = RecordBatch(self.schema, [slice_col(c, lo, hi) for c in cols], hi - lo)
+                self._buffers[pid].append(b)
+                self._buffered_bytes += b.memory_size()
+            buffered = self._buffered_bytes
+        self.update_mem_used(buffered)
 
     def spill(self) -> int:
         with self._lock:
@@ -196,7 +211,12 @@ class ShuffleRepartitioner(MemConsumer):
 
     def write_output(self, data_path: str, index_path: str) -> List[int]:
         """Merge memory + spills per pid into .data/.index.  Returns
-        partition lengths."""
+        partition lengths.  Holds the lock across the whole drain so a
+        late memory-manager spill cannot move buffers out mid-write."""
+        with self._lock:
+            return self._write_output_locked(data_path, index_path)
+
+    def _write_output_locked(self, data_path: str, index_path: str) -> List[int]:
         # decode spills back per pid (read once, in insertion order)
         spilled: Dict[int, List[RecordBatch]] = {}
         for sp, manifest in self._spills:
@@ -296,6 +316,26 @@ class ShuffleWriterExec(ExecNode):
             # pallas fast path decided on the first batch (key dtypes
             # are static); falls back to XLA for string/unsupported keys
             self._pallas_pids = conf.PALLAS_ENABLE.get()
+        elif isinstance(partitioning, RangePartitioning):
+            from ..exprs.compile import expr_key
+            from ..runtime.kernel_cache import cached_kernel, schema_key
+            from .exchange import _build_range_kernels
+
+            self._range_kernels = cached_kernel(
+                ("shuffle_range", schema_key(child.schema),
+                 tuple((expr_key(f.expr), f.ascending, f.nulls_first)
+                       for f in partitioning.fields),
+                 partitioning.num_partitions),
+                lambda: _build_range_kernels(
+                    child.schema, partitioning.fields, partitioning.num_partitions
+                ),
+            )
+
+    def _range_pids(self, cols, num_rows):
+        key_words, _, pids_fn = self._range_kernels
+        words = key_words(tuple(cols), num_rows)
+        boundaries = tuple(jnp.asarray(b) for b in self.partitioning.boundaries)
+        return pids_fn(words, boundaries)
 
     def _hash_pids(self, cols, num_rows):
         if self._pallas_pids:
@@ -321,10 +361,13 @@ class ShuffleWriterExec(ExecNode):
         return self.children[0].schema
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
-        if isinstance(self.partitioning, RangePartitioning):
+        if (
+            isinstance(self.partitioning, RangePartitioning)
+            and self.partitioning.boundaries is None
+        ):
             raise NotImplementedError(
-                "range partitioning needs global boundaries: use the "
-                "in-process exchange (spark.blaze.exchange.inProcess)"
+                "range partitioning needs global boundaries: run the "
+                "scheduler's boundary pass (or the in-process exchange)"
             )
 
         def stream():
@@ -342,6 +385,8 @@ class ShuffleWriterExec(ExecNode):
                                 non_opaque_cols(self.schema, batch.columns),
                                 batch.num_rows,
                             )
+                        elif isinstance(self.partitioning, RangePartitioning) and n_out > 1:
+                            pids = self._range_pids(batch.columns, batch.num_rows)
                         elif isinstance(self.partitioning, RoundRobinPartitioning) and n_out > 1:
                             pids = (jnp.arange(batch.capacity, dtype=jnp.int32) + rr) % n_out
                             rr = (rr + batch.num_rows) % n_out

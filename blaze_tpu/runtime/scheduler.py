@@ -167,6 +167,63 @@ def stage_task_definitions(
     return [build_task(stage, manager, t)[1] for t in range(stage.n_tasks)]
 
 
+def _compute_range_boundaries(stage: Stage, register_readers, max_rows: int = 1 << 16):
+    """Driver-side boundary pass for a range-partitioned map stage
+    (≙ Spark's RangePartitioner sample job): run the stage's plan once,
+    extract sort-key ORDER WORDS, and pick the (n_out-1) lexicographic
+    split points.  Any consistent split preserves global sort order, so
+    stride-subsampling above ``max_rows`` only affects balance."""
+    import numpy as np
+
+    from ..parallel.exchange import _build_range_kernels
+
+    part = stage._partitioning  # type: ignore[attr-defined]
+    key_words, _, _ = _build_range_kernels(
+        stage.plan.schema, part.fields, part.num_partitions
+    )
+    # bounded accumulation: sample per batch and re-stride the pool
+    # whenever it doubles past the target, so driver memory stays
+    # O(max_rows) regardless of input size (split points only affect
+    # balance, never sort correctness)
+    per_word: List[List] = []
+    pool_rows = 0
+    stride = 1
+    for t in range(stage.n_tasks):
+        register_readers(t)
+        ctx = TaskContext(t, stage.n_tasks)
+        for b in stage.plan.execute(t, ctx):
+            words = key_words(tuple(b.columns), b.num_rows)
+            for i, w in enumerate(words):
+                if len(per_word) <= i:
+                    per_word.append([])
+                per_word[i].append(np.asarray(w)[: b.num_rows : stride])
+            pool_rows += len(per_word[0][-1])
+            if pool_rows > 2 * max_rows:
+                per_word = [[np.concatenate(chunks)[::2]] for chunks in per_word]
+                pool_rows = len(per_word[0][0])
+                stride *= 2
+    if not per_word or not per_word[0]:
+        # empty input: no batch will ever reach the pid kernel, any
+        # consistent boundary set satisfies the contract
+        return (np.zeros(part.num_partitions - 1, np.uint64),)
+    cat = [np.concatenate(chunks) for chunks in per_word]
+    n = cat[0].shape[0]
+    if n == 0:
+        # batches existed but every one was zero-row: same empty case
+        return tuple(
+            np.zeros(part.num_partitions - 1, np.uint64) for _ in cat
+        )
+    if n > max_rows:
+        s = (n + max_rows - 1) // max_rows
+        cat = [c[::s] for c in cat]
+        n = cat[0].shape[0]
+    order = np.lexsort(tuple(cat[::-1]))  # first word = primary key
+    n_out = part.num_partitions
+    positions = [min(n - 1, (i * n) // n_out) for i in range(1, n_out)]
+    idx = order[positions]
+    return tuple(c[idx] for c in cat)
+
+
 def run_stages(
     stages: List[Stage], manager: LocalShuffleManager, max_task_attempts: int = 1
 ):
@@ -208,24 +265,38 @@ def run_stages(
     for stage in stages:
         readers = ipc_readers(stage.plan, "shuffle_")
         breaders = ipc_readers(stage.plan, "broadcast_")
+
+        def register_stage_readers(t: int) -> List[str]:
+            keys = []
+            for node in readers:
+                sid = int(node.resource_id.split("_")[1])
+                key = f"{node.resource_id}.{t}"
+                RESOURCES.put(key, manager.reduce_blocks(sid, n_maps[sid], t))
+                keys.append(key)
+            for node in breaders:
+                bid = int(node.resource_id.split("_")[1])
+                key = f"{node.resource_id}.0"
+                RESOURCES.put(key, list(bcast_blobs[bid]))
+                keys.append(key)
+            return keys
+
+        from ..parallel.shuffle import RangePartitioning
+
+        part = getattr(stage, "_partitioning", None)
+        if (
+            stage.kind == "map"
+            and isinstance(part, RangePartitioning)
+            and part.boundaries is None
+        ):
+            part.boundaries = _compute_range_boundaries(stage, register_stage_readers)
         for t in range(stage.n_tasks):
             attempt = 0
             while True:
                 # (re)register this task's reduce blocks — pops on
                 # read, so every attempt gets a fresh registration
-                block_keys = []
-                for node in readers:
-                    sid = int(node.resource_id.split("_")[1])
-                    key = f"{node.resource_id}.{t}"
-                    RESOURCES.put(key, manager.reduce_blocks(sid, n_maps[sid], t))
-                    block_keys.append(key)
-                for node in breaders:
-                    # broadcast: every task re-reads ALL source blobs
-                    # (the consumer executes build partition 0)
-                    bid = int(node.resource_id.split("_")[1])
-                    key = f"{node.resource_id}.0"
-                    RESOURCES.put(key, list(bcast_blobs[bid]))
-                    block_keys.append(key)
+                # (broadcast blobs re-register too: every task re-reads
+                # all source blobs via build partition 0)
+                block_keys = register_stage_readers(t)
                 # fresh TaskDefinition per attempt (serialization
                 # stages fresh one-shot resources); track the staged
                 # ids so a failed attempt doesn't leak them

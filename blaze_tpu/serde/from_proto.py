@@ -136,12 +136,29 @@ def expr_from_proto(n: pb.ExprNode) -> Expr:
 
 
 def _partitioning_from_proto(p: pb.PartitioningProto):
-    from ..parallel.shuffle import HashPartitioning, RoundRobinPartitioning, SinglePartitioning
+    from ..parallel.shuffle import (
+        HashPartitioning, RangePartitioning, RoundRobinPartitioning,
+        SinglePartitioning,
+    )
 
     if p.kind == pb.PartitioningProto.HASH:
         return HashPartitioning([expr_from_proto(e) for e in p.exprs], p.num_partitions)
     if p.kind == pb.PartitioningProto.ROUND_ROBIN:
         return RoundRobinPartitioning(p.num_partitions)
+    if p.kind == pb.PartitioningProto.RANGE:
+        import numpy as np
+
+        from ..ops import SortField
+
+        fields = [
+            SortField(expr_from_proto(f.expr), f.ascending, f.nulls_first)
+            for f in p.sort_fields
+        ]
+        nw = int(p.num_boundary_words)
+        flat = np.array(list(p.boundary_words), np.uint64)
+        per = len(flat) // nw if nw else 0
+        boundaries = tuple(flat[i * per:(i + 1) * per] for i in range(nw))
+        return RangePartitioning(fields, p.num_partitions, boundaries=boundaries)
     return SinglePartitioning(p.num_partitions)
 
 
@@ -283,6 +300,12 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
                         (None if f.frame_preceding < 0 else f.frame_preceding,
                          None if f.frame_following < 0 else f.frame_following)
                         if f.has_rows_frame else None
+                    ),
+                    ignore_nulls=f.ignore_nulls,
+                    range_frame=(
+                        (None if f.range_preceding < 0 else f.range_preceding,
+                         None if f.range_following < 0 else f.range_following)
+                        if f.has_range_frame else None
                     ),
                 )
                 for f in w.functions

@@ -174,12 +174,25 @@ def _partitioning_to_proto(p) -> pb.PartitioningProto:
     elif isinstance(p, RoundRobinPartitioning):
         out.kind = pb.PartitioningProto.ROUND_ROBIN
     elif isinstance(p, RangePartitioning):
-        # the file-shuffle writer has no global-boundary pass: refuse
-        # loudly rather than silently degrading to SINGLE
-        raise NotImplementedError(
-            "range partitioning crosses the serde boundary only via the "
-            "in-process exchange (no distributed boundary pass yet)"
-        )
+        if p.boundaries is None:
+            # boundaries come from the scheduler's driver-side sampling
+            # pass (≙ Spark's RangePartitioner sample job); a map task
+            # cannot compute global boundaries alone
+            raise NotImplementedError(
+                "range partitioning crosses the serde boundary only "
+                "with precomputed boundaries (scheduler boundary pass)"
+            )
+        out.kind = pb.PartitioningProto.RANGE
+        for f in p.fields:
+            fp = out.sort_fields.add()
+            fp.expr.CopyFrom(expr_to_proto(f.expr))
+            fp.ascending = f.ascending
+            fp.nulls_first = f.nulls_first
+        out.num_boundary_words = len(p.boundaries)
+        import numpy as _np
+
+        for w in p.boundaries:
+            out.boundary_words.extend(int(v) for v in _np.asarray(w, _np.uint64))
     else:
         out.kind = pb.PartitioningProto.SINGLE
     return out
@@ -349,11 +362,17 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
                 fp.expr.CopyFrom(expr_to_proto(f.expr))
             fp.whole_partition = f.whole_partition
             fp.offset = f.offset
+            fp.ignore_nulls = f.ignore_nulls
             if f.rows_frame is not None:
                 fp.has_rows_frame = True
                 p_, q_ = f.rows_frame
                 fp.frame_preceding = -1 if p_ is None else p_
                 fp.frame_following = -1 if q_ is None else q_
+            if f.range_frame is not None:
+                fp.has_range_frame = True
+                x_, y_ = f.range_frame
+                fp.range_preceding = -1 if x_ is None else x_
+                fp.range_following = -1 if y_ is None else y_
         for e in node.partition_by:
             out.window.partition_by.add().CopyFrom(expr_to_proto(e))
         for f in node.order_by:

@@ -361,12 +361,9 @@ def _partitioning(node: SparkNode, ctx: ConversionContext):
         if p.name == "RangePartitioning":
             from ..parallel import RangePartitioning
 
-            if not bool(conf.EXCHANGE_IN_PROCESS.get()):
-                # the file-shuffle tier has no global-boundary pass yet;
-                # fall back rather than fail at runtime
-                raise UnsupportedSparkExec(
-                    "RangePartitioning requires the in-process exchange"
-                )
+            # in-process exchanges compute exact boundaries on device;
+            # the file-shuffle path gets them from the scheduler's
+            # driver-side sampling pass (run_stages boundary pass)
             n_out = int(p.fields.get("numPartitions", ctx.default_parallelism))
             return RangePartitioning(_sort_fields(p.children), n_out)
         raise UnsupportedSparkExec(f"partitioning {p.name}")
@@ -457,7 +454,7 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
         out_name = f"#{eid}" if eid is not None else w.fields.get("name", "w")
         wexpr = w.children[0]
         wf = wexpr.children[0]
-        whole, rows_frame = _window_frame(wexpr)
+        whole, rows_frame, range_frame = _window_frame(wexpr)
         cls = wf.name
         if cls == "RowNumber":
             functions.append(WindowFunction("row_number", out_name))
@@ -465,9 +462,15 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
             functions.append(WindowFunction("rank", out_name))
         elif cls == "DenseRank":
             functions.append(WindowFunction("dense_rank", out_name))
+        elif cls == "NTile":
+            b = wf.children[0] if wf.children else None
+            if b is None or b.name != "Literal":
+                raise UnsupportedSparkExec("ntile with non-literal buckets")
+            functions.append(
+                WindowFunction("ntile", out_name, offset=int(b.fields.get("value", 1)))
+            )
         elif cls in ("Lead", "Lag"):
-            if wf.fields.get("ignoreNulls"):
-                raise UnsupportedSparkExec(f"{cls} IGNORE NULLS")
+            ignore = bool(wf.fields.get("ignoreNulls"))
             off_node = wf.children[1] if len(wf.children) > 1 else None
             if off_node is None or off_node.name != "Literal":
                 raise UnsupportedSparkExec(f"{cls} with non-literal offset")
@@ -480,10 +483,27 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
                 WindowFunction(
                     cls.lower(), out_name, convert_expr(wf.children[0]),
                     offset=int(off_node.fields.get("value", 1)),
+                    ignore_nulls=ignore,
                 )
             )
         elif cls == "NthValue":
-            raise UnsupportedSparkExec("NthValue window function")
+            if wf.fields.get("ignoreNulls"):
+                raise UnsupportedSparkExec("nth_value IGNORE NULLS")
+            if rows_frame is not None or range_frame is not None:
+                # the engine evaluates nth_value over the running /
+                # whole-partition frames only; silently dropping an
+                # explicit frame would return plausible wrong values
+                raise UnsupportedSparkExec("nth_value with an explicit frame")
+            k = wf.children[1] if len(wf.children) > 1 else None
+            if k is None or k.name != "Literal":
+                raise UnsupportedSparkExec("nth_value with non-literal n")
+            functions.append(
+                WindowFunction(
+                    "nth_value", out_name, convert_expr(wf.children[0]),
+                    offset=int(k.fields.get("value", 1)),
+                    whole_partition=whole,
+                )
+            )
         elif cls == "AggregateExpression":
             a = _agg_function(wf)
             if a.fn == "first_ignores_null":
@@ -501,47 +521,82 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
                     raise UnsupportedSparkExec(
                         f"ROWS frame for window aggregate {kind!r}"
                     )
+            if range_frame is not None:
+                if kind not in ("sum", "count", "avg", "min", "max"):
+                    raise UnsupportedSparkExec(
+                        f"RANGE frame for window aggregate {kind!r}"
+                    )
+                if len(node.expr_list("orderSpec")) != 1:
+                    raise UnsupportedSparkExec(
+                        "RANGE offset frame with multiple order keys"
+                    )
             functions.append(
                 WindowFunction(kind, out_name, a.expr,
-                               whole_partition=whole, rows_frame=rows_frame)
+                               whole_partition=whole, rows_frame=rows_frame,
+                               range_frame=range_frame)
             )
         else:
             raise UnsupportedSparkExec(f"window function {cls}")
-    return WindowExec(child, functions, part_by, order_by)
+    try:
+        return WindowExec(child, functions, part_by, order_by)
+    except NotImplementedError as e:
+        # engine-side refusals (e.g. RANGE frame over a non-integral
+        # order key) must become strategy fallbacks, not crashes
+        raise UnsupportedSparkExec(str(e))
 
 
 def _window_frame(wexpr: SparkNode):
-    """(whole_partition, rows_frame) from a WindowExpression's
-    WindowSpecDefinition -> SpecifiedWindowFrame (catalyst encodes
-    bounds as UnboundedPreceding/Following/CurrentRow case objects or
-    row-count literals; preceding bounds are negative)."""
+    """(whole_partition, rows_frame, range_frame) from a
+    WindowExpression's WindowSpecDefinition -> SpecifiedWindowFrame
+    (catalyst encodes bounds as UnboundedPreceding/Following/CurrentRow
+    case objects or count/value literals; preceding bounds are
+    negative)."""
     if len(wexpr.children) < 2:
-        return False, None
+        return False, None, None
     spec = wexpr.children[1]
     frame = next((c for c in spec.children if c.name == "SpecifiedWindowFrame"), None)
     if frame is None:
-        return False, None
+        return False, None, None
 
     def bound(b: SparkNode):
         if b.name in ("UnboundedPreceding", "UnboundedFollowing"):
             return "unbounded"
         if b.name == "CurrentRow":
             return 0
+        # only INTEGRAL literal bounds convert: decimal-string values
+        # ("10.50") and interval bounds would either crash int() or be
+        # silently misread in unscaled units — fall back instead
         if b.name == "Literal":
-            return int(b.fields.get("value", 0))
+            try:
+                return int(str(b.fields.get("value", 0)))
+            except (TypeError, ValueError):
+                raise UnsupportedSparkExec(
+                    f"non-integral window frame bound {b.fields.get('value')!r}"
+                )
         if b.name == "UnaryMinus" and b.children and b.children[0].name == "Literal":
-            return -int(b.children[0].fields.get("value", 0))
+            try:
+                return -int(str(b.children[0].fields.get("value", 0)))
+            except (TypeError, ValueError):
+                raise UnsupportedSparkExec("non-integral window frame bound")
         raise UnsupportedSparkExec(f"window frame bound {b.name}")
 
     lower = bound(frame.children[0])
     upper = bound(frame.children[1])
     ftype = frame.string("frameType", "RangeFrame")
     if lower == "unbounded" and upper == "unbounded":
-        return True, None
+        return True, None, None
     if ftype.startswith("Range"):
         if lower == "unbounded" and upper == 0:
-            return False, None  # the engine's default running frame
-        raise UnsupportedSparkExec("RANGE frame with offset bounds")
+            return False, None, None  # the engine's default running frame
+        # RANGE with value offsets: (preceding, following), None =
+        # unbounded side (engine: per-partition binary search)
+        x_ = None if lower == "unbounded" else max(-lower, 0)
+        y_ = None if upper == "unbounded" else max(upper, 0)
+        if isinstance(lower, int) and lower > 0:
+            raise UnsupportedSparkExec("RANGE frame starting after current row")
+        if isinstance(upper, int) and upper < 0:
+            raise UnsupportedSparkExec("RANGE frame ending before current row")
+        return False, None, (x_, y_)
     # RowFrame: engine bounds are (preceding, following), non-negative
     p_ = None if lower == "unbounded" else max(-lower, 0)
     q_ = None if upper == "unbounded" else max(upper, 0)
@@ -549,7 +604,7 @@ def _window_frame(wexpr: SparkNode):
         raise UnsupportedSparkExec("ROWS frame starting after current row")
     if isinstance(upper, int) and upper < 0:
         raise UnsupportedSparkExec("ROWS frame ending before current row")
-    return False, (p_, q_)
+    return False, (p_, q_), None
 
 
 def _convert_generate(node: SparkNode, ctx: ConversionContext) -> ExecNode:

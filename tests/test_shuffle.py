@@ -293,3 +293,45 @@ def test_inprocess_exchange_hbm_budget_fallback():
         assert rows == sum(b.num_rows for part in batches for b in part)
     finally:
         conf.DEVICE_MEMORY_BUDGET.set(old)
+
+
+def test_range_partitioning_across_serde_file_shuffle():
+    """Range-partitioned global sort through the STAGE SCHEDULER: the
+    scheduler's driver-side boundary pass fills the partitioning's
+    boundary words, every map task crosses the TaskDefinition protobuf
+    boundary, and the shuffle rides real .data/.index files — the
+    distributed path the in-process exchange cannot cover
+    (≙ Spark's RangePartitioner sample job + ShuffleDependency)."""
+    from blaze_tpu import conf
+    from blaze_tpu.ops import SortExec, SortField
+    from blaze_tpu.parallel import RangePartitioning
+    from blaze_tpu.runtime.scheduler import run_stages, split_stages
+
+    old = conf.EXCHANGE_IN_PROCESS.get()
+    conf.EXCHANGE_IN_PROCESS.set(False)  # force the file-shuffle tier
+    try:
+        n_parts_in, n_out = 3, 4
+        batches = [[make_batch(60, seed=40 + i)] for i in range(n_parts_in)]
+        src = MemoryScanExec(batches, SCHEMA)
+        fields = [SortField(col("k"), ascending=True, nulls_first=True)]
+        ex = NativeShuffleExchangeExec(src, RangePartitioning(fields, n_out))
+        plan = SortExec(ex, fields)
+        stages, manager = split_stages(plan)
+        rows = []
+        for b in run_stages(stages, manager):
+            d = batch_to_pydict(b)
+            rows.extend(zip(d["k"], d["s"], d["d"]))
+        allrows = []
+        for part in batches:
+            for b in part:
+                d = batch_to_pydict(b)
+                allrows.extend(zip(d["k"], d["s"], d["d"]))
+        ks = [r[0] for r in rows]
+        exp_ks = sorted((r[0] for r in allrows), key=lambda v: (v is not None, v))
+        assert ks == exp_ks, "global order broken across the serde boundary"
+        key_of = lambda r: tuple((v is None, v) for v in r)
+        assert sorted(rows, key=key_of) == sorted(allrows, key=key_of)
+        # the boundary pass must have filled serializable boundaries
+        assert ex.partitioning.boundaries is not None
+    finally:
+        conf.EXCHANGE_IN_PROCESS.set(old)
