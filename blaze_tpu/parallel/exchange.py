@@ -31,6 +31,40 @@ _shuffle_ids = itertools.count()
 _default_manager: Optional[LocalShuffleManager] = None
 _mgr_lock = threading.Lock()
 
+# set ONCE, process-wide, never restored: XLA/LLVM compile recursion
+# can overflow the 8 MB default thread stack, and a set/restore pair
+# around each pool races sibling exchanges (stacks are virtual memory,
+# so the cost of the deep default is address space only)
+_STACK_DEEPENED = False
+_STACK_LOCK = threading.Lock()
+
+
+def _ensure_deep_thread_stacks() -> None:
+    global _STACK_DEEPENED
+    with _STACK_LOCK:
+        if not _STACK_DEEPENED:
+            try:
+                threading.stack_size(64 << 20)
+            except (ValueError, RuntimeError):
+                pass
+            _STACK_DEEPENED = True
+
+
+def _warm_then_map(fn, n_maps: int, max_workers: int):
+    """Run map task 0 to completion INLINE, then the rest in a pool.
+
+    Two pool threads cache-missing the same jitted kernel compile it
+    concurrently, and jaxlib's CPU backend_compile_and_load races
+    itself into a segfault (observed deterministically 44 tests into
+    the combined differential suites, two threads inside the same
+    probe_batch compile).  Task 0 compiles every kernel on this plan's
+    path once; the remaining tasks then hit jax's executable cache."""
+    _ensure_deep_thread_stacks()
+    first = fn(0)
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        rest = list(pool.map(fn, range(1, n_maps)))
+    return [first] + rest
+
 
 def default_shuffle_manager() -> LocalShuffleManager:
     global _default_manager
@@ -295,8 +329,7 @@ class NativeShuffleExchangeExec(ExecNode):
             return local
 
         if self.parallel_map_tasks > 1 and n_maps > 1:
-            with ThreadPoolExecutor(max_workers=self.parallel_map_tasks) as pool:
-                per_map = list(pool.map(run_map, range(n_maps)))
+            per_map = _warm_then_map(run_map, n_maps, self.parallel_map_tasks)
         else:
             per_map = [run_map(m) for m in range(n_maps)]
         if cancelled:
@@ -324,8 +357,7 @@ class NativeShuffleExchangeExec(ExecNode):
                 return
             n_maps = self.children[0].num_partitions()
             if self.parallel_map_tasks > 1 and n_maps > 1:
-                with ThreadPoolExecutor(max_workers=self.parallel_map_tasks) as pool:
-                    list(pool.map(self._run_map_task, range(n_maps)))
+                _warm_then_map(self._run_map_task, n_maps, self.parallel_map_tasks)
             else:
                 for m in range(n_maps):
                     self._run_map_task(m)
@@ -389,8 +421,7 @@ class NativeShuffleExchangeExec(ExecNode):
             return local
 
         if self.parallel_map_tasks > 1 and n_maps > 1:
-            with ThreadPoolExecutor(max_workers=self.parallel_map_tasks) as pool:
-                per_map = list(pool.map(collect_map, range(n_maps)))
+            per_map = _warm_then_map(collect_map, n_maps, self.parallel_map_tasks)
         else:
             per_map = [collect_map(m) for m in range(n_maps)]
         if cancelled:

@@ -931,3 +931,79 @@ def oracle_q8(tables, zips, min_preferred):
             continue
         sums[nm] = sums.get(nm, 0) + int(np_[i])
     return sums
+
+
+def _q13_mask(tables):
+    """Row mask over store_sales for the q13/q48 band predicates."""
+    from .queries import Q13_BANDS, Q13_STATE_BANDS
+
+    ss = tables["store_sales"]
+    dd = tables["date_dim"]
+    cd = tables["customer_demographics"]
+    hd = tables["household_demographics"]
+    ca = tables["customer_address"]
+    st = tables["store"]
+    n = ss["ss_sold_date_sk"][0].shape[0]
+    d_ok = set(dd["d_date_sk"][0][dd["d_year"][0] == 2001].tolist())
+    st_ok = set(st["s_store_sk"][0].tolist())
+    ms = _sv(cd, "cd_marital_status")
+    ed = _sv(cd, "cd_education_status")
+    cd_row = {int(sk): i for i, sk in enumerate(cd["cd_demo_sk"][0])}
+    dep = hd["hd_dep_count"][0]
+    hd_row = {int(sk): i for i, sk in enumerate(hd["hd_demo_sk"][0])}
+    states = _sv(ca, "ca_state")
+    ca_row = {int(sk): i for i, sk in enumerate(ca["ca_address_sk"][0])}
+    mask = np.zeros(n, bool)
+    sp = ss["ss_sales_price"][0]
+    npf = ss["ss_net_profit"][0]
+    geo_bands = [(frozenset(b_states), b_lo, b_hi)
+                 for b_states, b_lo, b_hi in Q13_STATE_BANDS]
+    for i in range(n):
+        if int(ss["ss_sold_date_sk"][0][i]) not in d_ok:
+            continue
+        if int(ss["ss_store_sk"][0][i]) not in st_ok:
+            continue
+        ci = cd_row.get(int(ss["ss_cdemo_sk"][0][i]))
+        hi = hd_row.get(int(ss["ss_hdemo_sk"][0][i]))
+        ai = ca_row.get(int(ss["ss_addr_sk"][0][i]))
+        if ci is None or hi is None or ai is None:
+            continue
+        demo = any(
+            ms[ci] == b_ms and ed[ci] == b_ed
+            and b_lo * 100 <= int(sp[i]) <= b_hi * 100
+            and int(dep[hi]) == b_dep
+            for b_ms, b_ed, b_lo, b_hi, b_dep in Q13_BANDS
+        )
+        if not demo:
+            continue
+        mask[i] = any(
+            states[ai] in b_states
+            and b_lo * 100 <= int(npf[i]) <= b_hi * 100
+            for b_states, b_lo, b_hi in geo_bands
+        )
+    return mask
+
+
+def oracle_q13(tables):
+    ss = tables["store_sales"]
+    m = _q13_mask(tables)
+    n = int(m.sum())
+    if n == 0:
+        return None
+    def avg(col, scale4):
+        s = int(ss[col][0][m].astype(object).sum())
+        if scale4:
+            return (s * 10**4 + n // 2) // n
+        return s / n
+    return dict(
+        avg_qty=avg("ss_quantity", False),
+        avg_ext_sales=avg("ss_ext_sales_price", True),
+        avg_ext_disc=avg("ss_ext_discount_amt", True),
+        cnt=n,
+    )
+
+
+def oracle_q48(tables):
+    ss = tables["store_sales"]
+    m = _q13_mask(tables)
+    return int(ss["ss_quantity"][0][m].sum())

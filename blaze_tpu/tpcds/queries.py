@@ -1123,12 +1123,98 @@ def q88(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     return ProjectExec(src, exprs, names)
 
 
+# q13/q48 band constants, shared with the oracles
+Q13_BANDS = [
+    # (marital, education, sales_price_lo, sales_price_hi, dep_count)
+    # ranges sized so each band keeps a real slice of this generator's
+    # price distribution (the spec's dollar windows against dsdgen's)
+    ("M", "Advanced Degree", 0, 150, 3),
+    ("S", "College", 0, 100, 1),
+    ("W", "2 yr Degree", 50, 200, 1),
+]
+Q13_STATE_BANDS = [
+    # (states, net_profit_lo, net_profit_hi)
+    (("TN", "SD", "AL"), 0, 1000),
+    (("GA", "OH", "TN"), -500, 500),
+    (("SD", "AL", "GA"), -1000, 250),
+]
+
+
+def _band_preds(*, price_col):
+    """The OR-of-ANDs demographic and address bands shared by q13/q48:
+    (cd band AND price range AND hd dep) OR ... , and
+    (ca state set AND net profit range) OR ..."""
+    demo = None
+    for ms, ed, lo, hi, dep in Q13_BANDS:
+        p = (
+            (col("cd_marital_status") == lit(ms))
+            & (col("cd_education_status") == lit(ed))
+            & (col(price_col) >= lit(str(lo), DataType.decimal(7, 2)))
+            & (col(price_col) <= lit(str(hi), DataType.decimal(7, 2)))
+            & (col("hd_dep_count") == lit(dep))
+        )
+        demo = p if demo is None else (demo | p)
+    geo = None
+    for states, lo, hi in Q13_STATE_BANDS:
+        p = (
+            col("ca_state").isin(*[lit(s) for s in states])
+            & (col("ss_net_profit") >= lit(str(lo), DataType.decimal(7, 2)))
+            & (col("ss_net_profit") <= lit(str(hi), DataType.decimal(7, 2)))
+        )
+        geo = p if geo is None else (geo | p)
+    return demo & geo
+
+
+def _q13_source(t) -> ExecNode:
+    """The shared q13/q48 source: 5-way demographic/address star join
+    over store_sales, filtered by the OR-ed bands."""
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2001))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    st_p = ProjectExec(t["store"], [col("s_store_sk")])
+    cd_p = ProjectExec(
+        t["customer_demographics"],
+        [col("cd_demo_sk"), col("cd_marital_status"), col("cd_education_status")],
+    )
+    hd_p = ProjectExec(t["household_demographics"],
+                       [col("hd_demo_sk"), col("hd_dep_count")])
+    ca_p = ProjectExec(t["customer_address"],
+                       [col("ca_address_sk"), col("ca_state")])
+    j = broadcast_join(dt_p, t["store_sales"], [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(cd_p, j, [col("cd_demo_sk")], [col("ss_cdemo_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(hd_p, j, [col("hd_demo_sk")], [col("ss_hdemo_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(ca_p, j, [col("ca_address_sk")], [col("ss_addr_sk")], JoinType.INNER, build_is_left=True)
+    return FilterExec(j, _band_preds(price_col="ss_sales_price"))
+
+
+def q13(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Average store-sales measures under OR-ed demographic x address
+    bands — the wide-predicate star join."""
+    return two_stage_agg(
+        _q13_source(t), [],
+        [AggFunction("avg", col("ss_quantity"), "avg_qty"),
+         AggFunction("avg", col("ss_ext_sales_price"), "avg_ext_sales"),
+         AggFunction("avg", col("ss_ext_discount_amt"), "avg_ext_disc"),
+         AggFunction("count_star", None, "cnt")],
+        n_parts,
+    )
+
+
+def q48(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """sum(ss_quantity) under the same band structure (q13's sibling
+    shape without the averages)."""
+    return two_stage_agg(
+        _q13_source(t), [], [AggFunction("sum", col("ss_quantity"), "qty_sum")], n_parts
+    )
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q3": q3,
     "q33": q33,
     "q36": q36,
     "q38": q38,
     "q47": q47,
+    "q48": q48,
     "q56": q56,
     "q57": q57,
     "q60": q60,
@@ -1138,6 +1224,7 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q8": q8,
     "q9": q9,
     "q10": q10,
+    "q13": q13,
     "q35": q35,
     "q88": q88,
     "q19": q19,

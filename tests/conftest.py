@@ -22,3 +22,27 @@ import jax  # noqa: E402
 # every test run dials the TPU tunnel.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_compiled_caches_between_modules():
+    """Free compiled XLA executables between test MODULES.
+
+    jaxlib's CPU backend segfaults inside backend_compile_and_load
+    once enough compiled programs accumulate in one process (~44 slow
+    differential tests in; deterministic, single-threaded, independent
+    of thread stack size).  Per-module cache clearing keeps the full
+    single-process `pytest tests/` run under that ceiling at the cost
+    of recompiling shared kernels per module."""
+    yield
+    import jax
+
+    from blaze_tpu.ops.joins.broadcast import clear_join_map_cache
+    from blaze_tpu.runtime.kernel_cache import clear_kernel_cache
+
+    clear_kernel_cache()
+    clear_join_map_cache()
+    jax.clear_caches()

@@ -402,3 +402,18 @@ def test_q8(data, scans):
     assert exp, "q8 oracle matched no stores (datagen too sparse)"
     assert dict(zip(got["s_store_name"], got["net_profit"])) == exp
     assert got["s_store_name"] == sorted(got["s_store_name"])
+
+
+def test_q13(ticket_data, ticket_scans):
+    got = run(build_query("q13", ticket_scans, N_PARTS))
+    exp = O.oracle_q13(ticket_data)
+    assert exp is not None, "q13 bands matched no rows (datagen too sparse)"
+    assert got["cnt"] == [exp["cnt"]]
+    assert abs(got["avg_qty"][0] - exp["avg_qty"]) < 1e-9
+    assert got["avg_ext_sales"] == [exp["avg_ext_sales"]]
+    assert got["avg_ext_disc"] == [exp["avg_ext_disc"]]
+
+
+def test_q48(ticket_data, ticket_scans):
+    got = run(build_query("q48", ticket_scans, N_PARTS))
+    assert got["qty_sum"] == [O.oracle_q48(ticket_data)]
