@@ -768,15 +768,22 @@ def _active_set(tables, sales, date_col, cust_col, *, year, moys):
     return {int(cs[i]) for i in range(ds.shape[0]) if int(ds[i]) in d_sks}
 
 
-def _q10_customers(tables, *, year=2002, moys=(1, 4)):
-    """c_customer_sk of customers with in-store activity AND (web OR
-    catalog) activity in the window."""
+def _channel_sets(tables, *, year, moys):
+    """(store, web, catalog) active-customer sets for a window — the
+    shared wiring of the q10/q35/q69 oracles."""
     ss = _active_set(tables, "store_sales", "ss_sold_date_sk", "ss_customer_sk",
                      year=year, moys=moys)
     ws = _active_set(tables, "web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
                      year=year, moys=moys)
     cs = _active_set(tables, "catalog_sales", "cs_sold_date_sk", "cs_ship_customer_sk",
                      year=year, moys=moys)
+    return ss, ws, cs
+
+
+def _q10_customers(tables, *, year=2002, moys=(1, 4)):
+    """c_customer_sk of customers with in-store activity AND (web OR
+    catalog) activity in the window."""
+    ss, ws, cs = _channel_sets(tables, year=year, moys=moys)
     return ss & (ws | cs)
 
 
@@ -1007,3 +1014,73 @@ def oracle_q48(tables):
     ss = tables["store_sales"]
     m = _q13_mask(tables)
     return int(ss["ss_quantity"][0][m].sum())
+
+
+def oracle_q69(tables):
+    cu = tables["customer"]
+    ca = tables["customer_address"]
+    cd = tables["customer_demographics"]
+    states = _sv(ca, "ca_state")
+    ok_addr = {int(sk) for i, sk in enumerate(ca["ca_address_sk"][0])
+               if states[i] in ("TN", "SD", "AL")}
+    ss, ws, cs = _channel_sets(tables, year=2002, moys=(1, 3))
+    active = ss - ws - cs
+    gd = _sv(cd, "cd_gender")
+    ms = _sv(cd, "cd_marital_status")
+    ed = _sv(cd, "cd_education_status")
+    pe = [int(v) for v in cd["cd_purchase_estimate"][0]]
+    cr = _sv(cd, "cd_credit_rating")
+    cd_by_sk = {int(sk): i for i, sk in enumerate(cd["cd_demo_sk"][0])}
+    counts = {}
+    for i, csk in enumerate(cu["c_customer_sk"][0]):
+        if int(csk) not in active:
+            continue
+        if int(cu["c_current_addr_sk"][0][i]) not in ok_addr:
+            continue
+        ci = cd_by_sk.get(int(cu["c_current_cdemo_sk"][0][i]))
+        if ci is None:
+            continue
+        key = (gd[ci], ms[ci], ed[ci], pe[ci], cr[ci])
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def oracle_q65(tables):
+    """{(store_name, item_desc): (revenue, current_price, brand)} for
+    items at <= 10% of their store's average item revenue."""
+    ss = tables["store_sales"]
+    dd = tables["date_dim"]
+    st = tables["store"]
+    it = tables["item"]
+    d_ok = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    rev = {}
+    for i in range(ss["ss_sold_date_sk"][0].shape[0]):
+        if int(ss["ss_sold_date_sk"][0][i]) not in d_ok:
+            continue
+        key = (int(ss["ss_store_sk"][0][i]), int(ss["ss_item_sk"][0][i]))
+        rev[key] = rev.get(key, 0) + int(ss["ss_sales_price"][0][i])
+    from collections import defaultdict
+    per_store = defaultdict(list)
+    for (sk, _), r in rev.items():
+        per_store[sk].append(r)
+    # engine avg(decimal(17,2)) carries scale 6: unscaled * 10^4
+    ave = {sk: (sum(v) * 10**4 + len(v) // 2) // len(v)
+           for sk, v in per_store.items()}
+    names = _sv(st, "s_store_name")
+    name_by_sk = {int(sk): names[i] for i, sk in enumerate(st["s_store_sk"][0])}
+    descs = _sv(it, "i_item_desc")
+    brands = _sv(it, "i_brand")
+    prices = it["i_current_price"][0]
+    item_by_sk = {int(sk): i for i, sk in enumerate(it["i_item_sk"][0])}
+    # keyed by (store_sk, item_sk): distinct items may share a
+    # description, and the engine emits one row per ITEM
+    out = {}
+    for (sk, ik), r in rev.items():
+        if sk not in name_by_sk or ik not in item_by_sk:
+            continue
+        # engine: revenue/100 (float dollars) <= (ave/1e6) * 0.1
+        if (r / 100.0) > (ave[sk] / 10**6) * 0.1:
+            continue
+        ii = item_by_sk[ik]
+        out[(sk, ik)] = (name_by_sk[sk], descs[ii], r, int(prices[ii]), brands[ii])
+    return out

@@ -891,12 +891,12 @@ def _active_customer_set(t, n_parts, sales, date_col, cust_col, *, year, moys):
     )
 
 
-def _exists_or_channels(t, n_parts, cust, *, year, moys):
-    """cust + EXISTS(store) required, (EXISTS(web) OR EXISTS(catalog))
-    — the LEFT_SEMI + two EXISTENCE joins + OR-filter shape Spark plans
-    for q10/q35's correlated EXISTS."""
+def _exists_or_channels(t, n_parts, cust, *, year, moys, combine=None):
+    """cust + EXISTS(store) required, then web/catalog EXISTENCE flags
+    combined by ``combine(ws, cs)`` (default: OR — q10/q35's correlated
+    EXISTS; q69 negates both) — the LEFT_SEMI + two EXISTENCE joins +
+    filter shape Spark plans for correlated (NOT) EXISTS."""
     from ..ops import RenameColumnsExec
-    from ..ops.joins import HashJoinExec
 
     ss_set = _active_customer_set(t, n_parts, "store_sales", "ss_sold_date_sk",
                                   "ss_customer_sk", year=year, moys=moys)
@@ -914,7 +914,9 @@ def _exists_or_channels(t, n_parts, cust, *, year, moys):
     names = [f.name for f in j.schema.fields]
     names[names.index("exists#0")] = "exists_cs"
     j = RenameColumnsExec(j, names)
-    return FilterExec(j, col("exists_ws") | col("exists_cs"))
+    if combine is None:
+        combine = lambda ws, cs: ws | cs
+    return FilterExec(j, combine(col("exists_ws"), col("exists_cs")))
 
 
 def q10(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
@@ -1165,6 +1167,81 @@ def _band_preds(*, price_col):
     return demo & geo
 
 
+def q69(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Demographics of state-resident customers active in-store but on
+    NEITHER web NOR catalog — q10's shape with the existence flags
+    NEGATED (NOT EXISTS via the same existence joins)."""
+    ca = FilterExec(
+        t["customer_address"],
+        col("ca_state").isin(lit("TN"), lit("SD"), lit("AL")),
+    )
+    ca_p = ProjectExec(ca, [col("ca_address_sk")])
+    cust = ProjectExec(
+        t["customer"],
+        [col("c_customer_sk"), col("c_current_addr_sk"), col("c_current_cdemo_sk")],
+    )
+    cust = broadcast_join(ca_p, cust, [col("ca_address_sk")], [col("c_current_addr_sk")], JoinType.LEFT_SEMI, build_is_left=False)
+    act = _exists_or_channels(t, n_parts, cust, year=2002, moys=(1, 3),
+                              combine=lambda ws, cs: ~ws & ~cs)
+    cd = ProjectExec(
+        t["customer_demographics"],
+        [col("cd_demo_sk"), col("cd_gender"), col("cd_marital_status"),
+         col("cd_education_status"), col("cd_purchase_estimate"),
+         col("cd_credit_rating")],
+    )
+    j2 = broadcast_join(cd, act, [col("cd_demo_sk")], [col("c_current_cdemo_sk")], JoinType.INNER, build_is_left=True)
+    group_cols = ["cd_gender", "cd_marital_status", "cd_education_status",
+                  "cd_purchase_estimate", "cd_credit_rating"]
+    agg = two_stage_agg(
+        j2,
+        [GroupingExpr(col(c), c) for c in group_cols],
+        [AggFunction("count_star", None, "cnt")],
+        n_parts,
+    )
+    return single_sorted(agg, [SortField(col(c)) for c in group_cols], fetch=100)
+
+
+def q65(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Under-performing items: per-(store, item) revenue joined against
+    10% of the store's average item revenue — aggregation OVER an
+    aggregation, then a filtered join between the two levels."""
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2000))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_sold_date_sk"), col("ss_store_sk"),
+                      col("ss_item_sk"), col("ss_sales_price")])
+    j = broadcast_join(dt_p, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    per_item = two_stage_agg(
+        j,
+        [GroupingExpr(col("ss_store_sk"), "ss_store_sk"),
+         GroupingExpr(col("ss_item_sk"), "ss_item_sk")],
+        [AggFunction("sum", col("ss_sales_price"), "revenue")],
+        n_parts,
+    )
+    per_store = two_stage_agg(
+        per_item,
+        [GroupingExpr(col("ss_store_sk"), "sb_store_sk")],
+        [AggFunction("avg", col("revenue"), "ave")],
+        n_parts,
+    )
+    jj = broadcast_join(per_store, per_item, [col("sb_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    f64 = DataType.float64()
+    low = FilterExec(
+        jj, col("revenue").cast(f64) <= col("ave").cast(f64) * lit(0.1)
+    )
+    st_p = ProjectExec(t["store"], [col("s_store_sk"), col("s_store_name")])
+    it_p = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_desc"),
+                                   col("i_current_price"), col("i_brand")])
+    out = broadcast_join(st_p, low, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    out = broadcast_join(it_p, out, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    proj = ProjectExec(out, [col("s_store_name"), col("i_item_desc"),
+                             col("revenue"), col("i_current_price"), col("i_brand")])
+    return single_sorted(
+        proj, [SortField(col("s_store_name")), SortField(col("i_item_desc"))],
+        fetch=100,
+    )
+
+
 def _q13_source(t) -> ExecNode:
     """The shared q13/q48 source: 5-way demographic/address star join
     over store_sales, filtered by the OR-ed bands."""
@@ -1235,6 +1312,8 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q52": q52,
     "q55": q55,
     "q63": q63,
+    "q65": q65,
+    "q69": q69,
     "q73": q73,
     "q89": q89,
     "q96": q96,

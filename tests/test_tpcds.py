@@ -417,3 +417,33 @@ def test_q13(ticket_data, ticket_scans):
 def test_q48(ticket_data, ticket_scans):
     got = run(build_query("q48", ticket_scans, N_PARTS))
     assert got["qty_sum"] == [O.oracle_q48(ticket_data)]
+
+
+def test_q69(data, scans):
+    got = run(build_query("q69", scans, N_PARTS))
+    exp = O.oracle_q69(data)
+    keys = list(zip(got["cd_gender"], got["cd_marital_status"],
+                    got["cd_education_status"], got["cd_purchase_estimate"],
+                    got["cd_credit_rating"]))
+    assert keys and len(set(keys)) == len(keys)
+    for k, c in zip(keys, got["cnt"]):
+        assert exp.get(k) == c, k
+    assert len(keys) == min(len(exp), 100)
+    assert keys == sorted(keys)
+
+
+def test_q65(data, scans):
+    got = run(build_query("q65", scans, N_PARTS))
+    exp = O.oracle_q65(data)
+    rows = list(zip(got["s_store_name"], got["i_item_desc"], got["revenue"],
+                    got["i_current_price"], got["i_brand"]))
+    assert rows, "q65 returned no rows"
+    # one row per (store, item); descriptions may collide — compare the
+    # full row multiset and the (name, desc) ordering
+    import collections
+    if len(exp) <= 100:
+        assert collections.Counter(rows) == collections.Counter(exp.values())
+    else:
+        assert not (collections.Counter(rows) - collections.Counter(exp.values()))
+    keys = [(r[0], r[1]) for r in rows]
+    assert keys == sorted(keys)
