@@ -56,14 +56,290 @@ def decimal_overflow_null(data, validity, precision: int):
     return validity & (data < bound) & (data > -bound)
 
 
+def _string_scan(col: Column):
+    """Shared trim/sign/digit scan over a (n, w) byte matrix: returns
+    (w, length, per-char class masks, trimmed start/end indices)."""
+    data = col.data
+    n, w = data.shape
+    ln = col.lengths.astype(jnp.int32)
+    idx = jnp.arange(w, dtype=jnp.int32)
+    in_range = idx[None, :] < ln[:, None]
+    is_space = (
+        (data == 32) | (data == 9) | (data == 10) | (data == 13)
+    ) & in_range
+    nonspace = in_range & ~is_space
+    # trimmed [start, end] inclusive
+    start = jnp.min(jnp.where(nonspace, idx[None, :], w), axis=1)
+    end = jnp.max(jnp.where(nonspace, idx[None, :], -1), axis=1)
+    return data, n, w, idx, in_range, nonspace, start, end
+
+
+def _string_to_unscaled(col: Column, scale: int, truncate: bool = False):
+    """Parse ``[sign][digits][.digits]`` into unscaled int64 at
+    ``scale`` with HALF_UP truncation of extra fraction digits.
+    Returns (value, ok) — ok False on malformed input or overflow
+    (Spark non-ANSI string casts null out instead of erroring;
+    exponent forms are not parsed and null out, a documented subset)."""
+    data, n, w, idx, in_range, nonspace, start, end = _string_scan(col)
+    first = jnp.take_along_axis(
+        data, jnp.clip(start, 0, w - 1)[:, None], axis=1
+    )[:, 0]
+    neg = first == 45  # '-'
+    has_sign = neg | (first == 43)
+    dstart = start + has_sign.astype(jnp.int32)
+
+    is_digit = (data >= 48) & (data <= 57)
+    is_dot = data == 46
+
+    # accumulate the NEGATED magnitude: int64's negative range is one
+    # wider, so "-9223372036854775808" parses without tripping the
+    # overflow check (Spark's toLong accepts Long.MIN_VALUE)
+    value = jnp.zeros(n, jnp.int64)
+    frac_seen = jnp.zeros(n, jnp.int32)   # fraction digits consumed
+    seen_dot = jnp.zeros(n, jnp.bool_)
+    seen_digit = jnp.zeros(n, jnp.bool_)
+    bad = jnp.zeros(n, jnp.bool_)
+    overflow = jnp.zeros(n, jnp.bool_)
+    lim = jnp.int64(-(2**63 // 10))  # == -922337203685477580 (trunc)
+    round_up = jnp.zeros(n, jnp.bool_)
+    for j in range(w):
+        c = data[:, j]
+        active = (idx[j] >= dstart) & (idx[j] <= end)
+        digit = is_digit[:, j] & active
+        dot = is_dot[:, j] & active
+        # ANY interior non-digit/non-dot char is malformed — including
+        # embedded whitespace ("1 2"), which only leading/trailing trim
+        # may remove (Spark UTF8String.toLong)
+        other = active & ~digit & ~dot
+        bad = bad | other | (dot & seen_dot)
+        # keep only the first `scale` fraction digits; the next one
+        # decides HALF_UP rounding
+        take = digit & (~seen_dot | (frac_seen < scale))
+        d = (c - 48).astype(jnp.int64)
+        will_of = take & ((value < lim) | ((value == lim) & (d > 8)))
+        overflow = overflow | will_of
+        value = jnp.where(take & ~will_of, value * 10 - d, value)
+        if not truncate:
+            round_up = jnp.where(
+                digit & seen_dot & (frac_seen == scale), d >= 5, round_up
+            )
+        frac_seen = frac_seen + (digit & seen_dot).astype(jnp.int32)
+        seen_dot = seen_dot | dot
+        seen_digit = seen_digit | digit
+    # pad missing fraction digits up to `scale`
+    pad = jnp.clip(scale - frac_seen, 0, scale)
+    for _ in range(scale):
+        grow = pad > 0
+        will_of = grow & (value < lim)
+        overflow = overflow | will_of
+        value = jnp.where(grow & ~will_of, value * 10, value)
+        pad = pad - grow.astype(jnp.int32)
+    value = value - round_up.astype(jnp.int64)
+    # positive results must fit int64 (|min| exceeds max by one)
+    overflow = overflow | (~neg & (value == jnp.int64(-(2**63))))
+    ok = seen_digit & ~bad & ~overflow & (end >= dstart)
+    return jnp.where(neg, value, -value), ok
+
+
+def _int_to_string(values, to: DataType, scale: int = 0) -> Column:
+    """int64 (optionally unscaled decimal) -> ASCII bytes column."""
+    w = to.string_width
+    n = values.shape[0]
+    neg = values < 0
+    mag = jnp.where(neg, -values, values).view(jnp.uint64)
+    # extract up to 20 digits, least-significant first
+    digs = []
+    rem = mag
+    for _ in range(20):
+        digs.append((rem % 10).astype(jnp.uint8) + 48)
+        rem = rem // 10
+    digits = jnp.stack(digs, axis=1)  # (n, 20) LSB-first
+    ndig = jnp.maximum(
+        20 - jnp.sum(jnp.cumprod((digits == 48)[:, ::-1], axis=1), axis=1).astype(jnp.int32),
+        1,
+    )
+    if scale:
+        ndig = jnp.maximum(ndig, scale + 1)  # "0.xx" keeps a lead zero
+    total = ndig + neg.astype(jnp.int32) + (1 if scale else 0)
+    out = jnp.zeros((n, w), jnp.uint8)
+    pos = jnp.arange(w, dtype=jnp.int32)
+    # char at output position p: '-' at 0 when neg; then MSB-first
+    # digits with a '.' inserted before the last `scale` digits
+    for p in range(min(w, 22)):
+        # index into the MSB-first digit sequence for position p
+        di = pos[p] - neg.astype(jnp.int32)          # digit slot
+        if scale:
+            dot_at = total - scale - 1               # '.' output index
+            is_dot = (pos[p] == dot_at) & (total > pos[p])
+            di = di - (pos[p] > dot_at).astype(jnp.int32)
+        else:
+            is_dot = jnp.zeros(n, jnp.bool_)
+        msb_index = ndig - 1 - di                    # into LSB-first stack
+        ch = jnp.take_along_axis(
+            digits, jnp.clip(msb_index, 0, 19)[:, None], axis=1
+        )[:, 0]
+        ch = jnp.where(is_dot, jnp.uint8(46), ch)
+        ch = jnp.where((pos[p] == 0) & neg, jnp.uint8(45), ch)
+        valid_here = pos[p] < total
+        out = out.at[:, p].set(jnp.where(valid_here, ch, jnp.uint8(0)))
+    # values wider than the target string width NULL out (matching the
+    # host string paths' convention) rather than truncating digits
+    fits = total <= w
+    lengths = jnp.minimum(total, w).astype(jnp.int32)
+    return out, lengths, fits
+
+
+def _cast_from_string(col: Column, to: DataType) -> Column:
+    validity = col.validity
+    if to.kind == TypeKind.BOOL:
+        data, n, w, idx, in_range, nonspace, start, end = _string_scan(col)
+        # Spark StringUtils: t/true/y/yes/1 -> true, f/false/n/no/0 ->
+        # false (case-insensitive), else null
+        lower = jnp.where((col.data >= 65) & (col.data <= 90), col.data + 32, col.data)
+        tl = end - start + 1
+
+        def word(s: bytes):
+            m = tl == len(s)
+            for k, ch in enumerate(s):
+                at = jnp.clip(start + k, 0, w - 1)
+                m = m & (jnp.take_along_axis(lower, at[:, None], axis=1)[:, 0] == ch)
+            return m
+
+        t = word(b"t") | word(b"true") | word(b"y") | word(b"yes") | word(b"1")
+        f = word(b"f") | word(b"false") | word(b"n") | word(b"no") | word(b"0")
+        return Column(to, t, validity & (t | f))
+    if to.is_integer:
+        # Spark UTF8String.toLong: a single decimal point is allowed,
+        # the fraction is validated but TRUNCATED ("3.7" -> 3)
+        v, ok = _string_to_unscaled(col, 0, truncate=True)
+        if to.kind != TypeKind.INT64:
+            lo, hi = _INT_BOUNDS[to.kind]
+            ok = ok & (v >= lo) & (v <= hi)
+        return Column(to, v.astype(to.np_dtype), validity & ok)
+    if to.is_decimal:
+        v, ok = _string_to_unscaled(col, to.scale)
+        ok = decimal_overflow_null(v, ok, to.precision)
+        return Column(to, v, validity & ok)
+    if to.is_float or to.kind == TypeKind.TIMESTAMP:
+        # float parsing (exponents, strtod rounding) and timestamp
+        # format parsing stay host-side: a device subset would silently
+        # diverge from Spark on valid inputs
+        raise NotImplementedError(f"cast string -> {to!r} (host fallback)")
+    if to.kind == TypeKind.DATE32:
+        # strict yyyy-MM-dd (Spark accepts more forms; others null out)
+        data, n, w, idx, in_range, nonspace, start, end = _string_scan(col)
+        tl = end - start + 1
+        ok = tl == 10
+
+        def ch(k):
+            at = jnp.clip(start + k, 0, w - 1)
+            return jnp.take_along_axis(data, at[:, None], axis=1)[:, 0]
+
+        def num(k0, k1):
+            v = jnp.zeros(n, jnp.int64)
+            good = jnp.ones(n, jnp.bool_)
+            for k in range(k0, k1 + 1):
+                c = ch(k)
+                good = good & (c >= 48) & (c <= 57)
+                v = v * 10 + (c - 48).astype(jnp.int64)
+            return v, good
+
+        y, gy = num(0, 3)
+        m, gm = num(5, 6)
+        d, gd = num(8, 9)
+        ok = ok & gy & gm & gd & (ch(4) == 45) & (ch(7) == 45)
+        ok = ok & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+        # civil-to-days (Hinnant)
+        yy = y - (m <= 2)
+        era = jnp.where(yy >= 0, yy, yy - 399) // 400
+        yoe = yy - era * 400
+        mp = jnp.where(m > 2, m - 3, m + 9)
+        doy = (153 * mp + 2) // 5 + d - 1
+        doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+        days = era * 146097 + doe - 719468
+        # calendar-invalid days (Feb 30, Apr 31, non-leap Feb 29) pass
+        # the 1..31 gate but extrapolate; the inverse conversion
+        # disagrees for exactly those -> null
+        z2 = days + 719468
+        era2 = jnp.where(z2 >= 0, z2, z2 - 146096) // 146097
+        doe2 = z2 - era2 * 146097
+        yoe2 = (doe2 - doe2 // 1460 + doe2 // 36524 - doe2 // 146096) // 365
+        doy2 = doe2 - (365 * yoe2 + yoe2 // 4 - yoe2 // 100)
+        mp2 = (5 * doy2 + 2) // 153
+        d2 = doy2 - (153 * mp2 + 2) // 5 + 1
+        m2 = jnp.where(mp2 < 10, mp2 + 3, mp2 - 9)
+        ok = ok & (m2 == m) & (d2 == d)
+        return Column(to, days.astype(jnp.int32), validity & ok)
+    raise NotImplementedError(f"cast string -> {to!r}")
+
+
+def _cast_to_string(col: Column, to: DataType) -> Column:
+    src = col.dtype
+    if src.kind == TypeKind.BOOL:
+        n = col.data.shape[0]
+        w = to.string_width
+        out = jnp.zeros((n, w), jnp.uint8)
+        for k, ch in enumerate(b"false"):
+            out = out.at[:, k].set(jnp.uint8(ch))
+        for k, ch in enumerate(b"true"):
+            out = out.at[:, k].set(
+                jnp.where(col.data.astype(jnp.bool_), jnp.uint8(ch), out[:, k])
+            )
+        out = out.at[:, 4].set(
+            jnp.where(col.data.astype(jnp.bool_), jnp.uint8(0), out[:, 4])
+        )
+        lengths = jnp.where(col.data.astype(jnp.bool_), 4, 5).astype(jnp.int32)
+        return Column(to, out, col.validity, lengths)
+    if src.is_integer:
+        out, lengths, fits = _int_to_string(col.data.astype(jnp.int64), to)
+        return Column(to, out, col.validity & fits, lengths)
+    if src.is_decimal:
+        out, lengths, fits = _int_to_string(col.data, to, scale=src.scale)
+        return Column(to, out, col.validity & fits, lengths)
+    if src.kind == TypeKind.DATE32:
+        n = col.data.shape[0]
+        w = to.string_width
+        z = col.data.astype(jnp.int64) + 719468
+        era = jnp.where(z >= 0, z, z - 146096) // 146097
+        doe = z - era * 146097
+        yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+        y = yoe + era * 400
+        doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+        mp = (5 * doy + 2) // 153
+        d = doy - (153 * mp + 2) // 5 + 1
+        m = jnp.where(mp < 10, mp + 3, mp - 9)
+        y = jnp.where(m <= 2, y + 1, y)
+        # 4-digit rendering only: years outside 0..9999 null out
+        # (Spark renders +/- expanded years; documented subset)
+        in_era = (y >= 0) & (y <= 9999)
+        out = jnp.zeros((n, w), jnp.uint8)
+        for k, (val, div) in enumerate([
+            (y, 1000), (y, 100), (y, 10), (y, 1)
+        ]):
+            out = out.at[:, k].set((val // div % 10).astype(jnp.uint8) + 48)
+        out = out.at[:, 4].set(jnp.uint8(45))
+        out = out.at[:, 5].set((m // 10).astype(jnp.uint8) + 48)
+        out = out.at[:, 6].set((m % 10).astype(jnp.uint8) + 48)
+        out = out.at[:, 7].set(jnp.uint8(45))
+        out = out.at[:, 8].set((d // 10).astype(jnp.uint8) + 48)
+        out = out.at[:, 9].set((d % 10).astype(jnp.uint8) + 48)
+        lengths = jnp.full(n, 10, jnp.int32)
+        return Column(to, out, col.validity & in_era, lengths)
+    raise NotImplementedError(f"cast {src!r} -> string (float formatting is host)")
+
+
 def lower_cast(col: Column, to: DataType) -> Column:
     src = col.dtype
     if src == to:
         return col
     data, validity = col.data, col.validity
 
+    if src.is_string and not to.is_string:
+        return _cast_from_string(col, to)
+    if to.is_string and not src.is_string:
+        return _cast_to_string(col, to)
     if src.is_string or to.is_string:
-        raise NotImplementedError(f"cast {src!r} -> {to!r} (string casts are host-fallback)")
+        raise NotImplementedError(f"cast {src!r} -> {to!r}")
 
     # decimal source
     if src.is_decimal:

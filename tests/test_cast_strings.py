@@ -1,0 +1,162 @@
+"""Device string<->numeric/date casts, differential vs python oracles
+(Spark non-ANSI semantics: malformed/overflowing input -> NULL).
+Closes the cast tier's host-fallback gap (≙ cast.rs string paths)."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col
+from blaze_tpu.ops import MemoryScanExec, ProjectExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+
+RNG = np.random.RandomState(11)
+
+
+def _cast_strings(values, to, width=32):
+    schema = Schema([Field("s", DataType.string(width))])
+    src = MemoryScanExec([[batch_from_pydict({"s": values}, schema)]], schema)
+    plan = ProjectExec(src, [col("s").cast(to).alias("r")])
+    out = list(plan.execute(0, TaskContext(0, 1)))[0]
+    return batch_to_pydict(out)["r"]
+
+
+def _cast_to_string(values, src_t, width=32):
+    """values are PHYSICAL (unscaled ints for decimals)."""
+    from blaze_tpu.batch import RecordBatch, column_from_numpy
+
+    n = len(values)
+    valid = np.array([v is not None for v in values])
+    phys = np.array([0 if v is None else v for v in values],
+                    src_t.np_dtype if not src_t.is_decimal else np.int64)
+    c = column_from_numpy(src_t, phys, validity=valid)
+    src = MemoryScanExec([[RecordBatch(Schema([Field("v", src_t)]), [c], n)]],
+                         Schema([Field("v", src_t)]))
+    plan = ProjectExec(src, [col("v").cast(DataType.string(width)).alias("r")])
+    out = list(plan.execute(0, TaskContext(0, 1)))[0]
+    return batch_to_pydict(out)["r"]
+
+
+def test_string_to_int_vs_python():
+    vals = ["42", " -17 ", "+8", "0", "9223372036854775807",
+            "-9223372036854775808", "9223372036854775808",   # overflow
+            "3.7", "-3.7", "abc", "", "  ", "1e3", "--5", "12a",
+            "1 2", "- 5", None, "00042", "-0"]
+    got = _cast_strings(vals, DataType.int64())
+    # Spark UTF8String.toLong: trims, single dot truncates the
+    # validated fraction, interior junk/spaces null
+    exp = [42, -17, 8, 0, 2**63 - 1, -(2**63), None,
+           3, -3, None, None, None, None, None, None,
+           None, None, None, 42, 0]
+    assert got == exp
+
+
+def test_string_to_int32_range_nulls():
+    vals = ["2147483647", "2147483648", "-2147483648", "-2147483649"]
+    got = _cast_strings(vals, DataType.int32())
+    assert got == [2147483647, None, -2147483648, None]
+
+
+def test_string_to_decimal_half_up():
+    to = DataType.decimal(10, 2)
+    vals = ["1.005", "-1.005", "3", "3.1", "3.14159", ".5", "-.25",
+            "12345678.90", "99999999999", "x", "", None, "1.2.3"]
+    got = _cast_strings(vals, to)
+    import decimal as D
+    def py(s):
+        if s is None or s.strip() == "":
+            return None
+        try:
+            d = D.Decimal(s.strip())
+        except D.InvalidOperation:
+            return None
+        u = int(d.scaleb(2).quantize(D.Decimal(1), rounding=D.ROUND_HALF_UP))
+        return u if abs(u) < 10**10 else None
+    assert got == [py(v) for v in vals]
+
+
+def test_string_to_bool():
+    vals = ["true", "FALSE", " t ", "no", "Y", "1", "0", "maybe", "", None]
+    got = _cast_strings(vals, DataType.bool_())
+    assert got == [True, False, True, False, True, True, False, None, None, None]
+
+
+def test_string_to_date_strict_iso():
+    vals = ["1994-01-01", "2000-02-29", "1970-01-01", "1969-12-31",
+            "2015-13-01", "2015-00-10", "20150101", "2015-1-1", "garbage", None]
+    got = _cast_strings(vals, DataType.date32())
+    def py(s):
+        if s is None:
+            return None
+        try:
+            d = datetime.date.fromisoformat(s)
+        except ValueError:
+            return None
+        if len(s) != 10:
+            return None
+        return (d - datetime.date(1970, 1, 1)).days
+    exp = [py(v) for v in vals]
+    # out-of-range month/day null out (python raises too)
+    assert got == exp
+
+
+def test_string_to_date_calendar_invalid_nulls():
+    vals = ["2021-02-28", "2021-02-29", "2020-02-29", "2021-02-30",
+            "2000-04-31", "1900-02-29"]
+    got = _cast_strings(vals, DataType.date32())
+    def py(s):
+        try:
+            return (datetime.date.fromisoformat(s)
+                    - datetime.date(1970, 1, 1)).days
+        except ValueError:
+            return None
+    assert got == [py(v) for v in vals]
+
+
+def test_int_to_string_width_overflow_nulls():
+    got = _cast_to_string([123456789, 123], DataType.int64(), width=8)
+    assert got == [None, "123"]
+
+
+def test_int_to_string_roundtrip():
+    vals = [0, 1, -1, 42, -9999, 2**62, -(2**63), 2**63 - 1,
+            1234567890123456789]
+    got = _cast_to_string(vals, DataType.int64())
+    assert got == [str(v) for v in vals]
+
+
+def test_decimal_to_string_keeps_scale():
+    t = DataType.decimal(12, 2)
+    unscaled = [0, 5, 50, 150, -5, -150, 123456, -1, 100]
+    got = _cast_to_string(unscaled, t)
+    exp = ["0.00", "0.05", "0.50", "1.50", "-0.05", "-1.50",
+           "1234.56", "-0.01", "1.00"]
+    assert got == exp
+
+
+def test_bool_and_date_to_string():
+    got = _cast_to_string([True, False, None], DataType.bool_())
+    assert got == ["true", "false", None]
+    days = [(datetime.date(1994, 1, 1) - datetime.date(1970, 1, 1)).days,
+            0,
+            (datetime.date(2024, 2, 29) - datetime.date(1970, 1, 1)).days]
+    got = _cast_to_string(days, DataType.date32())
+    assert got == ["1994-01-01", "1970-01-01", "2024-02-29"]
+
+
+def test_randomized_int_roundtrip():
+    vals = RNG.randint(-(2**62), 2**62, 300).tolist()
+    strs = [str(v) for v in vals]
+    assert _cast_strings(strs, DataType.int64()) == vals
+    assert _cast_to_string(vals, DataType.int64()) == strs
+
+
+def test_randomized_decimal_roundtrip():
+    t = DataType.decimal(15, 3)
+    unscaled = RNG.randint(-(10**12), 10**12, 300).tolist()
+    strs = _cast_to_string(unscaled, t)
+    back = _cast_strings(strs, t)
+    assert back == unscaled
