@@ -134,6 +134,8 @@ def _string_to_unscaled(col: Column, scale: int, truncate: bool = False):
         overflow = overflow | will_of
         value = jnp.where(grow & ~will_of, value * 10, value)
         pad = pad - grow.astype(jnp.int32)
+    # rounding past |INT64_MIN| would wrap the negated magnitude
+    overflow = overflow | (round_up & (value == jnp.int64(-(2**63))))
     value = value - round_up.astype(jnp.int64)
     # positive results must fit int64 (|min| exceeds max by one)
     overflow = overflow | (~neg & (value == jnp.int64(-(2**63))))
@@ -249,25 +251,13 @@ def _cast_from_string(col: Column, to: DataType) -> Column:
         d, gd = num(8, 9)
         ok = ok & gy & gm & gd & (ch(4) == 45) & (ch(7) == 45)
         ok = ok & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
-        # civil-to-days (Hinnant)
-        yy = y - (m <= 2)
-        era = jnp.where(yy >= 0, yy, yy - 399) // 400
-        yoe = yy - era * 400
-        mp = jnp.where(m > 2, m - 3, m + 9)
-        doy = (153 * mp + 2) // 5 + d - 1
-        doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
-        days = era * 146097 + doe - 719468
+        from .functions import _civil_from_days, _days_from_civil
+
+        days = _days_from_civil(y, m, d)
         # calendar-invalid days (Feb 30, Apr 31, non-leap Feb 29) pass
         # the 1..31 gate but extrapolate; the inverse conversion
         # disagrees for exactly those -> null
-        z2 = days + 719468
-        era2 = jnp.where(z2 >= 0, z2, z2 - 146096) // 146097
-        doe2 = z2 - era2 * 146097
-        yoe2 = (doe2 - doe2 // 1460 + doe2 // 36524 - doe2 // 146096) // 365
-        doy2 = doe2 - (365 * yoe2 + yoe2 // 4 - yoe2 // 100)
-        mp2 = (5 * doy2 + 2) // 153
-        d2 = doy2 - (153 * mp2 + 2) // 5 + 1
-        m2 = jnp.where(mp2 < 10, mp2 + 3, mp2 - 9)
+        y2, m2, d2 = _civil_from_days(days)
         ok = ok & (m2 == m) & (d2 == d)
         return Column(to, days.astype(jnp.int32), validity & ok)
     raise NotImplementedError(f"cast string -> {to!r}")
@@ -297,18 +287,14 @@ def _cast_to_string(col: Column, to: DataType) -> Column:
         out, lengths, fits = _int_to_string(col.data, to, scale=src.scale)
         return Column(to, out, col.validity & fits, lengths)
     if src.kind == TypeKind.DATE32:
+        from .functions import _civil_from_days
+
         n = col.data.shape[0]
         w = to.string_width
-        z = col.data.astype(jnp.int64) + 719468
-        era = jnp.where(z >= 0, z, z - 146096) // 146097
-        doe = z - era * 146097
-        yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
-        y = yoe + era * 400
-        doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
-        mp = (5 * doy + 2) // 153
-        d = doy - (153 * mp + 2) // 5 + 1
-        m = jnp.where(mp < 10, mp + 3, mp - 9)
-        y = jnp.where(m <= 2, y + 1, y)
+        y, m, d = _civil_from_days(col.data)
+        y = y.astype(jnp.int64)
+        m = m.astype(jnp.int64)
+        d = d.astype(jnp.int64)
         # 4-digit rendering only: years outside 0..9999 null out
         # (Spark renders +/- expanded years; documented subset)
         in_era = (y >= 0) & (y <= 9999)
@@ -334,9 +320,11 @@ def lower_cast(col: Column, to: DataType) -> Column:
         return col
     data, validity = col.data, col.validity
 
-    if src.is_string and not to.is_string:
+    # BINARY shares the byte layout but NOT these semantics (Spark
+    # casts ints to big-endian bytes): only true STRING converts here
+    if src.kind == TypeKind.STRING and to.kind != TypeKind.STRING:
         return _cast_from_string(col, to)
-    if to.is_string and not src.is_string:
+    if to.kind == TypeKind.STRING and src.kind != TypeKind.STRING:
         return _cast_to_string(col, to)
     if src.is_string or to.is_string:
         raise NotImplementedError(f"cast {src!r} -> {to!r}")

@@ -56,6 +56,16 @@ def _n_customers(scale: float) -> int:
     return max(50, int(100000 * scale))
 
 
+def _n_cdemo() -> int:
+    """customer_demographics row count — MUST match that generator's
+    cross-product x reps."""
+    return len(EDUCATIONS) * len(MARITALS) * len(GENDERS) * 4
+
+
+def _n_promos(scale: float) -> int:
+    return max(5, int(300 * scale))
+
+
 def _n_addresses(scale: float) -> int:
     return max(25, _n_customers(scale) // 2)
 D_FIRST = (1998, 1, 1)
@@ -123,7 +133,7 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
             "s_zip": (zip_data, zip_len),
         }
     if name == "promotion":
-        n = max(5, int(300 * scale))
+        n = _n_promos(scale)
         yn = lambda: _encode_options([("Y" if v else "N") for v in rng.randint(0, 2, n)], 8)
         e_data, e_len = yn()
         v_data, v_len = yn()
@@ -143,6 +153,7 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         reps = 4
         combos = combos * reps
         nc = len(combos)
+        assert nc == _n_cdemo()
         g_data, g_len = _encode_options([c[0] for c in combos], 8)
         m_data, m_len = _encode_options([c[1] for c in combos], 8)
         e_data, e_len = _encode_options([c[2] for c in combos], 24)
@@ -177,7 +188,7 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         ln_, ln_len = _encode_options([LAST_NAMES[(i * 3) % len(LAST_NAMES)] for i in range(n)], 16)
         pf, pf_len = _encode_options([("Y" if i % 2 else "N") for i in range(n)], 8)
         n_addr = _n_addresses(scale)
-        n_cd = len(EDUCATIONS) * len(MARITALS) * len(GENDERS) * 4
+        n_cd = _n_cdemo()
         return {
             "c_customer_sk": (np.arange(1, n + 1, dtype=np.int64), None),
             "c_current_addr_sk": (rng.randint(1, n_addr + 1, n).astype(np.int64), None),
@@ -238,13 +249,20 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
             rng.rand(n) < 0.02, np.int64(-1),
             rng.randint(0, n_date, n) + DATE_SK_BASE,
         ).astype(np.int64)
+        n_cd = _n_cdemo()
+        n_promo = _n_promos(scale)
         return {
             "cs_sold_date_sk": (date_sk, None),
             "cs_item_sk": (rng.randint(1, n_item + 1, n).astype(np.int64), None),
             "cs_bill_customer_sk": (rng.randint(1, n_cust + 1, n).astype(np.int64), None),
             "cs_ship_customer_sk": (rng.randint(1, n_cust + 1, n).astype(np.int64), None),
             "cs_bill_addr_sk": (rng.randint(1, n_addr + 1, n).astype(np.int64), None),
+            "cs_bill_cdemo_sk": (rng.randint(1, n_cd + 1, n).astype(np.int64), None),
+            "cs_promo_sk": (rng.randint(1, n_promo + 1, n).astype(np.int64), None),
             "cs_call_center_sk": (rng.randint(1, 5, n).astype(np.int64), None),
+            "cs_quantity": (rng.randint(1, 101, n).astype(np.int32), None),
+            "cs_list_price": (_money(rng, n, 1, 200), None),
+            "cs_coupon_amt": (_money(rng, n, 0, 100), None),
             "cs_sales_price": (_money(rng, n, 0, 300), None),
             "cs_ext_sales_price": (_money(rng, n, 0, 2000), None),
         }
@@ -309,8 +327,8 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
         n_tickets = max(2, n_target // 13)
         n_date = _days(*D_LAST) - _days(*D_FIRST) + 1
         n_item = max(60, int(18000 * scale))
-        n_cd = len(EDUCATIONS) * len(MARITALS) * len(GENDERS) * 4
-        n_promo = max(5, int(300 * scale))
+        n_cd = _n_cdemo()
+        n_promo = _n_promos(scale)
         n_cust = _n_customers(scale)
 
         lines_per = rng.randint(1, 26, n_tickets)

@@ -1084,3 +1084,44 @@ def oracle_q65(tables):
         ii = item_by_sk[ik]
         out[(sk, ik)] = (name_by_sk[sk], descs[ii], r, int(prices[ii]), brands[ii])
     return out
+
+
+def oracle_q26(tables):
+    """{item_id: (avg_qty_float, avg_list, avg_coupon, avg_sales)} —
+    decimal avgs in engine scale-6 unscaled units (q7's oracle shape
+    over the catalog channel)."""
+    cd = tables["customer_demographics"]
+    dd = tables["date_dim"]
+    pr = tables["promotion"]
+    it = tables["item"]
+    cs = tables["catalog_sales"]
+    g = _sv(cd, "cd_gender"); m = _sv(cd, "cd_marital_status"); e = _sv(cd, "cd_education_status")
+    cd_ok = {int(sk) for i, sk in enumerate(cd["cd_demo_sk"][0])
+             if g[i] == "M" and m[i] == "S" and e[i] == "College"}
+    d_ok = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    pe = _sv(pr, "p_channel_email"); pv = _sv(pr, "p_channel_event")
+    p_ok = {int(sk) for i, sk in enumerate(pr["p_promo_sk"][0])
+            if pe[i] == "N" or pv[i] == "N"}
+    iid = _sv(it, "i_item_id")
+    id_by_sk = {int(sk): iid[i] for i, sk in enumerate(it["i_item_sk"][0])}
+    groups = {}
+    for i in range(cs["cs_sold_date_sk"][0].shape[0]):
+        if int(cs["cs_bill_cdemo_sk"][0][i]) not in cd_ok: continue
+        if int(cs["cs_sold_date_sk"][0][i]) not in d_ok: continue
+        if int(cs["cs_promo_sk"][0][i]) not in p_ok: continue
+        key = id_by_sk.get(int(cs["cs_item_sk"][0][i]))
+        if key is None: continue
+        groups.setdefault(key, []).append((
+            int(cs["cs_quantity"][0][i]), int(cs["cs_list_price"][0][i]),
+            int(cs["cs_coupon_amt"][0][i]), int(cs["cs_sales_price"][0][i]),
+        ))
+    out = {}
+    for key, rows in groups.items():
+        n = len(rows)
+        qty = sum(r[0] for r in rows) / n
+        mids = []
+        for j in range(1, 4):
+            s = sum(r[j] for r in rows)
+            mids.append((s * 10**4 + n // 2) // n)
+        out[key] = (qty, *mids)
+    return out

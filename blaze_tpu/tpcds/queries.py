@@ -164,6 +164,43 @@ def q96(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     return two_stage_agg(j, [], [AggFunction("count_star", None, "cnt")], n_parts)
 
 
+def q26(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Catalog-channel demographic averages — q7's star-join shape over
+    catalog_sales (cd x date x promotion x item)."""
+    cd = FilterExec(
+        t["customer_demographics"],
+        (col("cd_gender") == lit("M"))
+        & (col("cd_marital_status") == lit("S"))
+        & (col("cd_education_status") == lit("College")),
+    )
+    cd_p = ProjectExec(cd, [col("cd_demo_sk")])
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2000))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    pr = FilterExec(
+        t["promotion"],
+        (col("p_channel_email") == lit("N")) | (col("p_channel_event") == lit("N")),
+    )
+    pr_p = ProjectExec(pr, [col("p_promo_sk")])
+    sales = t["catalog_sales"]
+    j = broadcast_join(cd_p, sales, [col("cd_demo_sk")], [col("cs_bill_cdemo_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(dt_p, j, [col("d_date_sk")], [col("cs_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(pr_p, j, [col("p_promo_sk")], [col("cs_promo_sk")], JoinType.INNER, build_is_left=True)
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id")])
+    j = broadcast_join(it, j, [col("i_item_sk")], [col("cs_item_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("i_item_id"), "i_item_id")],
+        [
+            AggFunction("avg", col("cs_quantity"), "agg1"),
+            AggFunction("avg", col("cs_list_price"), "agg2"),
+            AggFunction("avg", col("cs_coupon_amt"), "agg3"),
+            AggFunction("avg", col("cs_sales_price"), "agg4"),
+        ],
+        n_parts,
+    )
+    return single_sorted(agg, [SortField(col("i_item_id"))], fetch=100)
+
+
 def q27(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     """ROLLUP(i_item_id, s_state) — exercises ExpandExec + grouping-id
     the way Spark plans rollups (Expand with null-filled projections)."""
@@ -1305,6 +1342,7 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q35": q35,
     "q88": q88,
     "q19": q19,
+    "q26": q26,
     "q27": q27,
     "q34": q34,
     "q42": q42,

@@ -447,3 +447,15 @@ def test_q65(data, scans):
         assert not (collections.Counter(rows) - collections.Counter(exp.values()))
     keys = [(r[0], r[1]) for r in rows]
     assert keys == sorted(keys)
+
+
+def test_q26(data, scans):
+    got = run(build_query("q26", scans, N_PARTS))
+    exp = O.oracle_q26(data)
+    assert got["i_item_id"] == sorted(got["i_item_id"])
+    assert len(got["i_item_id"]) == min(len(exp), 100)
+    for i, iid in enumerate(got["i_item_id"]):
+        e = exp[iid]
+        assert abs(got["agg1"][i] - e[0]) < 1e-9, iid
+        for gi, mname in enumerate(("agg2", "agg3", "agg4"), start=1):
+            assert got[mname][i] == e[gi], (iid, mname)
