@@ -49,6 +49,9 @@ CLASSES = [
     "reference", "romance", "self-help", "wallpaper", "personal", "maternity",
 ]
 
+REASON_DESCS = ["Package was damaged", "Stopped working", "Did not get it on time",
+                "Not the product that was ordred", "Parts missing"]
+
 DATE_SK_BASE = 2450815  # arbitrary julian-like base, spec-style
 
 
@@ -111,7 +114,8 @@ def _time_dim() -> HostTable:
     }
 
 
-def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
+def generate_table(name: str, scale: float, seed: int = 20011129,
+                   _ss_base: "HostTable" = None) -> HostTable:
     rng = np.random.RandomState((seed + zlib.crc32(name.encode())) % (2**31))
     if name == "date_dim":
         return _date_dim()
@@ -232,12 +236,28 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
             "cc_name": (d, ln),
         }
     if name == "reason":
-        descs = ["Package was damaged", "Stopped working", "Did not get it on time",
-                 "Not the product that was ordred", "Parts missing"]
-        d, ln = _encode_options(descs, 40)
+        d, ln = _encode_options(REASON_DESCS, 40)
         return {
-            "r_reason_sk": (np.arange(1, len(descs) + 1, dtype=np.int64), None),
+            "r_reason_sk": (np.arange(1, len(REASON_DESCS) + 1, dtype=np.int64), None),
             "r_reason_desc": (d, ln),
+        }
+    if name == "store_returns":
+        # ~8% of store_sales lines come back; keys reference the SAME
+        # deterministic store_sales draw (callers may pass it via
+        # _ss_base to avoid regenerating the largest fact table)
+        ss = _ss_base if _ss_base is not None else generate_table("store_sales", scale, seed)
+        n_ss = ss["ss_item_sk"][0].shape[0]
+        take = rng.rand(n_ss) < 0.08
+        idx = np.flatnonzero(take)
+        n = idx.shape[0]
+        qty = ss["ss_quantity"][0][idx]
+        ret_q = np.minimum(rng.randint(1, 101, n), qty).astype(np.int32)
+        return {
+            "sr_item_sk": (ss["ss_item_sk"][0][idx], None),
+            "sr_ticket_number": (ss["ss_ticket_number"][0][idx], None),
+            "sr_reason_sk": (rng.randint(1, len(REASON_DESCS) + 1, n).astype(np.int64), None),
+            "sr_return_quantity": (ret_q, None),
+            "sr_return_amt": (_money(rng, n, 0, 300), None),
         }
     if name == "catalog_sales":
         n = max(150, int(1_440_000 * scale))
@@ -375,4 +395,9 @@ def generate_table(name: str, scale: float, seed: int = 20011129) -> HostTable:
 def generate_all(scale: float, seed: int = 20011129) -> Dict[str, HostTable]:
     from .schema import TPCDS_SCHEMAS
 
-    return {name: generate_table(name, scale, seed) for name in TPCDS_SCHEMAS}
+    out: Dict[str, HostTable] = {}
+    for name in TPCDS_SCHEMAS:
+        out[name] = generate_table(
+            name, scale, seed, _ss_base=out.get("store_sales")
+        )
+    return out

@@ -1125,3 +1125,33 @@ def oracle_q26(tables):
             mids.append((s * 10**4 + n // 2) // n)
         out[key] = (qty, *mids)
     return out
+
+
+def oracle_q93(tables):
+    """{customer_sk: sumsales} for returns with reason 'Stopped
+    working' (unscaled scale-2 sums; LEFT-join + reason filter keeps
+    only returned rows, matching the spec's comma-join semantics)."""
+    ss = tables["store_sales"]
+    sr = tables["store_returns"]
+    rs = tables["reason"]
+    descs = _sv(rs, "r_reason_desc")
+    r_ok = {int(sk) for i, sk in enumerate(rs["r_reason_sk"][0])
+            if descs[i] == "Stopped working"}
+    ret = {}
+    for i in range(sr["sr_item_sk"][0].shape[0]):
+        if int(sr["sr_reason_sk"][0][i]) not in r_ok:
+            continue
+        key = (int(sr["sr_item_sk"][0][i]), int(sr["sr_ticket_number"][0][i]))
+        # multiple returns for one line: both join-multiply (the engine
+        # LEFT join emits one row per match)
+        ret.setdefault(key, []).append(int(sr["sr_return_quantity"][0][i]))
+    out = {}
+    for i in range(ss["ss_item_sk"][0].shape[0]):
+        key = (int(ss["ss_item_sk"][0][i]), int(ss["ss_ticket_number"][0][i]))
+        if key not in ret:
+            continue
+        c = int(ss["ss_customer_sk"][0][i])
+        for rq in ret[key]:
+            act = (int(ss["ss_quantity"][0][i]) - rq) * int(ss["ss_sales_price"][0][i])
+            out[c] = out.get(c, 0) + act
+    return out

@@ -1238,6 +1238,50 @@ def q69(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     return single_sorted(agg, [SortField(col(c)) for c in group_cols], fetch=100)
 
 
+def q93(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Actual sales net of returns for one return reason — LEFT OUTER
+    join on a COMPOSITE key (item, ticket) whose unmatched side feeds a
+    CASE, then the reason filter (per the spec's comma-join, effectively
+    keeping returned rows of that reason)."""
+    from ..exprs.ir import Case
+
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_item_sk"), col("ss_ticket_number"),
+                      col("ss_customer_sk"), col("ss_quantity"),
+                      col("ss_sales_price")])
+    sr = ProjectExec(t["store_returns"],
+                     [col("sr_item_sk"), col("sr_ticket_number"),
+                      col("sr_reason_sk"), col("sr_return_quantity")])
+    lkeys = [col("ss_item_sk"), col("ss_ticket_number")]
+    rkeys = [col("sr_item_sk"), col("sr_ticket_number")]
+    from ..tpch.queries import shuffle_join
+    j = shuffle_join(sl, sr, lkeys, rkeys, JoinType.LEFT, n_parts,
+                     build_left=False)
+    reason = FilterExec(t["reason"],
+                        col("r_reason_desc") == lit("Stopped working"))
+    reason_p = ProjectExec(reason, [col("r_reason_sk")])
+    j = broadcast_join(reason_p, j, [col("r_reason_sk")], [col("sr_reason_sk")],
+                       JoinType.INNER, build_is_left=True)
+    qty32 = col("ss_quantity")
+    act = Case(
+        [(col("sr_return_quantity").is_not_null(),
+          (qty32 - col("sr_return_quantity")).cast(DataType.int64())
+          * col("ss_sales_price"))],
+        qty32.cast(DataType.int64()) * col("ss_sales_price"),
+    )
+    proj = ProjectExec(j, [col("ss_customer_sk"), act.alias("act_sales")])
+    agg = two_stage_agg(
+        proj,
+        [GroupingExpr(col("ss_customer_sk"), "ss_customer_sk")],
+        [AggFunction("sum", col("act_sales"), "sumsales")],
+        n_parts,
+    )
+    return single_sorted(
+        agg, [SortField(col("sumsales")), SortField(col("ss_customer_sk"))],
+        fetch=100,
+    )
+
+
 def q65(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     """Under-performing items: per-(store, item) revenue joined against
     10% of the store's average item revenue — aggregation OVER an
@@ -1354,6 +1398,7 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q69": q69,
     "q73": q73,
     "q89": q89,
+    "q93": q93,
     "q96": q96,
     "q98": q98,
 }

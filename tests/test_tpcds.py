@@ -462,3 +462,15 @@ def test_q26(data, scans):
         assert abs(got["agg1"][i] - e[0]) < 1e-9, iid
         for gi, mname in enumerate(("agg2", "agg3", "agg4"), start=1):
             assert got[mname][i] == e[gi], (iid, mname)
+
+
+def test_q93(data, scans):
+    got = run(build_query("q93", scans, N_PARTS))
+    exp = O.oracle_q93(data)
+    assert exp, "q93 oracle matched no rows"
+    rows = dict(zip(got["ss_customer_sk"], got["sumsales"]))
+    assert len(rows) == len(got["ss_customer_sk"]), "duplicate customers"
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+    assert got["sumsales"] == sorted(got["sumsales"])
