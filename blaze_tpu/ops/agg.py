@@ -1071,6 +1071,9 @@ class _AggConsumer(MemConsumer):
         self._state: Optional[RecordBatch] = None
         self._spills: List[Spill] = []
         self._lock = threading.Lock()
+        self._quiesced = threading.Condition(self._lock)
+        self._inflight = 0      # spills serializing outside the lock
+        self._closed = False    # drain started: no further spills
 
     @property
     def state_rows(self) -> int:
@@ -1098,28 +1101,49 @@ class _AggConsumer(MemConsumer):
 
     def spill(self) -> int:
         with self._lock:
+            if self._closed:
+                # finish() is draining: a spill landing now would
+                # append AFTER the drain cleared the list and the
+                # state would be silently LOST (observed as missing
+                # distinct rows at SF0.1 under a capped budget)
+                return 0
             state, self._state = self._state, None
             if state is None:
                 return 0
             freed = state.memory_size()
             self.set_mem_used_no_trigger(0)
+            self._inflight += 1
         # serialize outside the lock: this thread owns `state` now
-        sp = try_new_spill()
-        sp.write_frame(serialize_batch(state))
-        sp.complete()
-        self._spills.append(sp)
+        try:
+            sp = try_new_spill()
+            sp.write_frame(serialize_batch(state))
+            sp.complete()
+            with self._quiesced:
+                self._spills.append(sp)
+        finally:
+            # ALWAYS release the in-flight slot, or a spill error
+            # would leave drain_spills() waiting forever
+            with self._quiesced:
+                self._inflight -= 1
+                self._quiesced.notify_all()
         self._agg.metrics.add("spill_count", 1)
         self._agg.metrics.add("spilled_bytes", sp.size)
         return freed
 
     def drain_spills(self) -> List[RecordBatch]:
+        # close the consumer to new spills, then wait out any spill
+        # already past the state-claim (it still owns an accumulator
+        # chunk that MUST reach the final merge)
+        with self._quiesced:
+            self._closed = True
+            self._quiesced.wait_for(lambda: self._inflight == 0)
+            spills, self._spills = self._spills, []
         out: List[RecordBatch] = []
-        for sp in self._spills:
+        for sp in spills:
             while True:
                 payload = sp.read_frame()
                 if payload is None:
                     break
                 out.append(deserialize_batch(payload, self._agg._state_schema).to_device())
             sp.release()
-        self._spills = []
         return out

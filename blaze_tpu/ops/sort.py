@@ -148,7 +148,9 @@ class _SortState(MemConsumer):
         self.buffered: List[RecordBatch] = []
         self.spills: List[Spill] = []
         self._lock = threading.Lock()
+        self._quiesced = threading.Condition(self._lock)
         self._frozen = False
+        self._inflight = 0  # spills writing runs outside the lock
 
     def add(self, batch: RecordBatch) -> None:
         with self._lock:
@@ -159,9 +161,11 @@ class _SortState(MemConsumer):
     def freeze(self) -> Tuple[List[RecordBatch], List[Spill]]:
         """Snapshot state for the output merge and stop accepting
         spills — a spill landing after the merge sources are built
-        would create a run the merge never reads."""
-        with self._lock:
+        would create a run the merge never reads.  Waits out any spill
+        already past the buffer claim (its run MUST reach the merge)."""
+        with self._quiesced:
             self._frozen = True
+            self._quiesced.wait_for(lambda: self._inflight == 0)
             return list(self.buffered), list(self.spills)
 
     def spill(self) -> int:
@@ -169,10 +173,16 @@ class _SortState(MemConsumer):
             if self._frozen or not self.buffered:
                 return 0
             batches, self.buffered = self.buffered, []
+            self._inflight += 1
         freed = sum(b.memory_size() for b in batches)
-        sp = self.exec._write_run(batches)
-        with self._lock:
-            self.spills.append(sp)
+        try:
+            sp = self.exec._write_run(batches)
+            with self._quiesced:
+                self.spills.append(sp)
+        finally:
+            with self._quiesced:
+                self._inflight -= 1
+                self._quiesced.notify_all()
         self.update_mem_used(0)
         return freed
 
