@@ -601,6 +601,25 @@ def oracle_q60(tables):
     )
 
 
+def _rank_within_parent(rows, *, parent_of, measure_of, descending):
+    """Competition rank within (lochierarchy, parent) partitions —
+    shared by the rollup oracles (q36/q86/q70)."""
+    from collections import defaultdict
+    parts = defaultdict(list)
+    for r in rows:
+        parts[(r[2], parent_of(r))].append(r)
+    out = {}
+    for plist in parts.values():
+        plist.sort(key=lambda r: -measure_of(r) if descending else measure_of(r))
+        rank, prev = 0, None
+        for i, r in enumerate(plist, 1):
+            if prev is None or measure_of(r) != prev:
+                rank = i
+            prev = measure_of(r)
+            out[(r[0], r[1], r[2])] = (measure_of(r), rank)
+    return out
+
+
 def _rollup_margin_oracle(tables, *, sales, date_col, item_col, num_col,
                           den_col, year, store_filter=False, ratio_desc=False):
     """q36/q86 oracle: rollup sums, lochierarchy, rank within parent."""
@@ -644,22 +663,10 @@ def _rollup_margin_oracle(tables, *, sales, date_col, item_col, num_col,
         # dollars, so divide unscaled by 100 (ratio measures cancel)
         measure = (n / d) if den_col else (n / 100.0)
         rows.append([cat, cls, loch, measure])
-    # rank within (lochierarchy, parent category)
-    out = {}
-    from collections import defaultdict
-    parts = defaultdict(list)
-    for r in rows:
-        parent = r[0] if r[2] == 0 else None
-        parts[(r[2], parent)].append(r)
-    for plist in parts.values():
-        plist.sort(key=lambda r: -r[3] if ratio_desc else r[3])
-        rank, prev = 0, None
-        for i, r in enumerate(plist, 1):
-            if prev is None or r[3] != prev:
-                rank = i
-            prev = r[3]
-            out[(r[0], r[1], r[2])] = (r[3], rank)
-    return out
+    return _rank_within_parent(
+        rows, parent_of=lambda r: r[0] if r[2] == 0 else None,
+        measure_of=lambda r: r[3], descending=ratio_desc,
+    )
 
 
 def oracle_q36(tables):
@@ -1155,3 +1162,35 @@ def oracle_q93(tables):
             act = (int(ss["ss_quantity"][0][i]) - rq) * int(ss["ss_sales_price"][0][i])
             out[c] = out.get(c, 0) + act
     return out
+
+
+def oracle_q70(tables):
+    """{(state|None, county|None, loch): (total, rank)} — q36's rollup
+    oracle shape over store geography (rank by total desc)."""
+    dd = tables["date_dim"]
+    st = tables["store"]
+    ss = tables["store_sales"]
+    d_sks = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    states = _sv(st, "s_state")
+    counties = _sv(st, "s_county")
+    geo_by_sk = {int(sk): (states[i], counties[i])
+                 for i, sk in enumerate(st["s_store_sk"][0])}
+    sums = {}
+    ds = ss["ss_sold_date_sk"][0]
+    sts = ss["ss_store_sk"][0]
+    np_ = ss["ss_net_profit"][0]
+    for i in range(ds.shape[0]):
+        if int(ds[i]) not in d_sks:
+            continue
+        geo = geo_by_sk.get(int(sts[i]))
+        if geo is None:
+            continue
+        state, county = geo
+        v = int(np_[i])
+        for key in [(state, county, 0), (state, None, 1), (None, None, 2)]:
+            sums[key] = sums.get(key, 0) + v
+    rows = [(state, county, loch, v) for (state, county, loch), v in sums.items()]
+    return _rank_within_parent(
+        rows, parent_of=lambda r: r[0] if r[2] == 0 else None,
+        measure_of=lambda r: r[3], descending=True,
+    )

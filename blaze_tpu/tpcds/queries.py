@@ -813,6 +813,72 @@ def q86(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+def q70(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Net-profit ROLLUP over store GEOGRAPHY (state, county) with
+    rank-within-parent — the q36/q86 shape grouped on the store
+    dimension instead of the item hierarchy.  (The rollup pipeline
+    mirrors _rollup_margin_report, which is item-dimension-bound; keep
+    shape fixes in sync or generalize that helper.)"""
+    from ..exprs.ir import Case, Lit
+    from ..ops import ExpandExec, LimitExec, SortExec, WindowExec, WindowFunction
+    from ..parallel import NativeShuffleExchangeExec, SinglePartitioning
+
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2000))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    st_p = ProjectExec(t["store"], [col("s_store_sk"), col("s_state"), col("s_county")])
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_sold_date_sk"), col("ss_store_sk"), col("ss_net_profit")])
+    j = broadcast_join(dt_p, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    null_state = Lit(None, DataType.string(8))
+    null_county = Lit(None, DataType.string(24))
+    expand = ExpandExec(
+        j,
+        [
+            [col("ss_net_profit"), col("s_state"), col("s_county"), lit(0)],
+            [col("ss_net_profit"), col("s_state"), null_county, lit(1)],
+            [col("ss_net_profit"), null_state, null_county, lit(3)],
+        ],
+        ["ss_net_profit", "s_state", "s_county", "g_id"],
+    )
+    agg = two_stage_agg(
+        expand,
+        [GroupingExpr(col("s_state"), "s_state"),
+         GroupingExpr(col("s_county"), "s_county"),
+         GroupingExpr(col("g_id"), "g_id")],
+        [AggFunction("sum", col("ss_net_profit"), "total_sum")],
+        n_parts,
+    )
+    loch = Case(
+        [(col("g_id") == lit(0), lit(0)), (col("g_id") == lit(1), lit(1))],
+        lit(2),
+    )
+    proj = ProjectExec(
+        agg,
+        [col("s_state"), col("s_county"), loch, col("total_sum")],
+        ["s_state", "s_county", "lochierarchy", "total_sum"],
+    )
+    single = NativeShuffleExchangeExec(proj, SinglePartitioning())
+    parent_state = Case([(col("lochierarchy") == lit(0), col("s_state"))], None)
+    pre = SortExec(single, [
+        SortField(col("lochierarchy")),
+        SortField(parent_state),
+        SortField(col("total_sum"), ascending=False),
+    ])
+    w = WindowExec(
+        pre,
+        [WindowFunction("rank", "rank_within_parent")],
+        [col("lochierarchy"), parent_state],
+        [SortField(col("total_sum"), ascending=False)],
+    )
+    out = SortExec(w, [
+        SortField(col("lochierarchy"), ascending=False),
+        SortField(Case([(col("lochierarchy") == lit(0), col("s_state"))], None)),
+        SortField(col("rank_within_parent")),
+    ], fetch=100)
+    return LimitExec(out, 100)
+
+
 def _yoy_window_report(t, n_parts, *, sales, date_col, item_col, price_col,
                        entity_build, entity_cols, year):
     """Shared q47/q57 shape: monthly sums per (brand, entity), a
@@ -1396,6 +1462,7 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q63": q63,
     "q65": q65,
     "q69": q69,
+    "q70": q70,
     "q73": q73,
     "q89": q89,
     "q93": q93,

@@ -474,3 +474,21 @@ def test_q93(data, scans):
         assert exp.get(k) == v, k
     assert len(rows) == min(len(exp), 100)
     assert got["sumsales"] == sorted(got["sumsales"])
+
+
+def test_q70(data, scans):
+    got = run(build_query("q70", scans, N_PARTS))
+    exp = O.oracle_q70(data)
+    assert got["lochierarchy"], "q70 returned no rows"
+    for st, co, loch, total, rank in zip(
+        got["s_state"], got["s_county"], got["lochierarchy"],
+        got["total_sum"], got["rank_within_parent"],
+    ):
+        key = (st, co, loch)
+        assert key in exp, key
+        et, er = exp[key]
+        assert (total, rank) == (et, er), (key, total, rank, exp[key])
+    if len(exp) <= 100:
+        assert len(got["lochierarchy"]) == len(exp)
+        assert set(got["lochierarchy"]) == {0, 1, 2}
+    assert got["lochierarchy"] == sorted(got["lochierarchy"], reverse=True)
