@@ -1194,3 +1194,71 @@ def oracle_q70(tables):
         rows, parent_of=lambda r: r[0] if r[2] == 0 else None,
         measure_of=lambda r: r[3], descending=True,
     )
+
+
+def oracle_q15(tables):
+    from .queries import Q15_ZIPS
+
+    dd = tables["date_dim"]
+    cu = tables["customer"]
+    ca = tables["customer_address"]
+    cs = tables["catalog_sales"]
+    d_ok = set(dd["d_date_sk"][0][
+        (dd["d_qoy"][0] == 2) & (dd["d_year"][0] == 2001)].tolist())
+    zips = _sv(ca, "ca_zip")
+    states = _sv(ca, "ca_state")
+    addr_row = {int(sk): i for i, sk in enumerate(ca["ca_address_sk"][0])}
+    addr_of_cust = {int(c): int(a) for c, a in
+                    zip(cu["c_customer_sk"][0], cu["c_current_addr_sk"][0])}
+    zipset = set(Q15_ZIPS)
+    stateset = {"TN", "GA", "OH"}
+    sums = {}
+    for i in range(cs["cs_sold_date_sk"][0].shape[0]):
+        if int(cs["cs_sold_date_sk"][0][i]) not in d_ok:
+            continue
+        a = addr_of_cust.get(int(cs["cs_bill_customer_sk"][0][i]))
+        ai = addr_row.get(a) if a is not None else None
+        if ai is None:
+            continue
+        price = int(cs["cs_sales_price"][0][i])
+        if not (zips[ai][:5] in zipset or states[ai] in stateset
+                or price > 250 * 100):
+            continue
+        sums[zips[ai]] = sums.get(zips[ai], 0) + price
+    return sums
+
+
+def oracle_q61(tables):
+    """(promo_rev, total_rev) unscaled for the q61 slice."""
+    dd = tables["date_dim"]
+    st = tables["store"]
+    it = tables["item"]
+    ca = tables["customer_address"]
+    cu = tables["customer"]
+    pr = tables["promotion"]
+    ss = tables["store_sales"]
+    d_ok = set(dd["d_date_sk"][0][
+        (dd["d_year"][0] == 1998) & (dd["d_moy"][0] == 11)].tolist())
+    st_ok = set(st["s_store_sk"][0].tolist())
+    cats = _sv(it, "i_category")
+    it_ok = {int(sk) for i, sk in enumerate(it["i_item_sk"][0])
+             if cats[i] == "Jewelry"}
+    ca_ok = set(ca["ca_address_sk"][0][ca["ca_gmt_offset"][0] == -500].tolist())
+    cust_ok = {int(c) for c, a in zip(cu["c_customer_sk"][0],
+                                      cu["c_current_addr_sk"][0])
+               if int(a) in ca_ok}
+    pe = _sv(pr, "p_channel_email")
+    pv = _sv(pr, "p_channel_event")
+    promo_ok = {int(sk) for i, sk in enumerate(pr["p_promo_sk"][0])
+                if pe[i] == "Y" or pv[i] == "Y"}
+    promo = total = 0
+    for i in range(ss["ss_sold_date_sk"][0].shape[0]):
+        if int(ss["ss_sold_date_sk"][0][i]) not in d_ok: continue
+        if int(ss["ss_store_sk"][0][i]) not in st_ok: continue
+        if int(ss["ss_item_sk"][0][i]) not in it_ok: continue
+        if int(ss["ss_customer_sk"][0][i]) not in cust_ok: continue
+        v = int(ss["ss_ext_sales_price"][0][i])
+        total += v
+        if int(ss["ss_promo_sk"][0][i]) in promo_ok:
+            promo += v
+    return promo, total

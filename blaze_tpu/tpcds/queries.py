@@ -706,14 +706,84 @@ def q60(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+def _rollup_rank_tail(j, n_parts, *, dims, num_col, den_col, measure_name,
+                      measure_desc, measure_as_float=True):
+    """Shared q36/q86/q70 tail: ROLLUP over two dimension columns with
+    lochierarchy + rank-within-parent window + the spec's final sort.
+
+    ``dims``: [(col_name, null_literal_dtype)] for the two rollup
+    levels; ``den_col`` None = plain sum measure, else num/den ratio."""
+    from ..exprs.ir import Case, Lit
+    from ..ops import ExpandExec, LimitExec, SortExec, WindowExec, WindowFunction
+    from ..parallel import NativeShuffleExchangeExec, SinglePartitioning
+
+    (d0, t0), (d1, t1) = dims
+    null0 = Lit(None, t0)
+    null1 = Lit(None, t1)
+    vals = [col(num_col)] + ([col(den_col)] if den_col else [])
+    val_names = [num_col] + ([den_col] if den_col else [])
+    expand = ExpandExec(
+        j,
+        [
+            vals + [col(d0), col(d1), lit(0)],
+            vals + [col(d0), null1, lit(1)],
+            vals + [null0, null1, lit(3)],
+        ],
+        val_names + [d0, d1, "g_id"],
+    )
+    aggs = [AggFunction("sum", col(num_col), "num_sum")] + (
+        [AggFunction("sum", col(den_col), "den_sum")] if den_col else []
+    )
+    agg = two_stage_agg(
+        expand,
+        [GroupingExpr(col(d0), d0), GroupingExpr(col(d1), d1),
+         GroupingExpr(col("g_id"), "g_id")],
+        aggs,
+        n_parts,
+    )
+    f64 = DataType.float64()
+    # lochierarchy = grouping(d0)+grouping(d1): 0, 1, 2
+    loch = Case(
+        [(col("g_id") == lit(0), lit(0)), (col("g_id") == lit(1), lit(1))],
+        lit(2),
+    )
+    if den_col:
+        measure = col("num_sum").cast(f64) / col("den_sum").cast(f64)
+    elif measure_as_float:
+        measure = col("num_sum").cast(f64)
+    else:
+        measure = col("num_sum")
+    proj = ProjectExec(
+        agg,
+        [col(d0), col(d1), loch, measure],
+        [d0, d1, "lochierarchy", measure_name],
+    )
+    single = NativeShuffleExchangeExec(proj, SinglePartitioning())
+    # rank within parent: partition (lochierarchy, parent level-0 dim)
+    parent = Case([(col("lochierarchy") == lit(0), col(d0))], None)
+    pre = SortExec(single, [
+        SortField(col("lochierarchy")),
+        SortField(parent),
+        SortField(col(measure_name), ascending=not measure_desc),
+    ])
+    w = WindowExec(
+        pre,
+        [WindowFunction("rank", "rank_within_parent")],
+        [col("lochierarchy"), parent],
+        [SortField(col(measure_name), ascending=not measure_desc)],
+    )
+    out = SortExec(w, [
+        SortField(col("lochierarchy"), ascending=False),
+        SortField(Case([(col("lochierarchy") == lit(0), col(d0))], None)),
+        SortField(col("rank_within_parent")),
+    ], fetch=100)
+    return LimitExec(out, 100)
+
+
 def _rollup_margin_report(t, n_parts, *, sales, date_col, item_col, num_col,
                           den_col, year, extra_build=None, ratio_desc=False):
     """Shared q36/q86 shape: ROLLUP(i_category, i_class) over a channel
     with lochierarchy + rank-within-parent window."""
-    from ..exprs.ir import Case, Lit
-    from ..ops import ExpandExec, SortExec, WindowExec, WindowFunction
-    from ..parallel import NativeShuffleExchangeExec, SinglePartitioning
-
     dt = FilterExec(t["date_dim"], col("d_year") == lit(year))
     dt_p = ProjectExec(dt, [col("d_date_sk")])
     it = ProjectExec(t["item"], [col("i_item_sk"), col("i_category"), col("i_class")])
@@ -726,67 +796,12 @@ def _rollup_margin_report(t, n_parts, *, sales, date_col, item_col, num_col,
         build, bkey, pkey = extra_build
         j = broadcast_join(build, j, [bkey], [pkey], JoinType.INNER, build_is_left=True)
     j = broadcast_join(it, j, [col("i_item_sk")], [col(item_col)], JoinType.INNER, build_is_left=True)
-    null_cat = Lit(None, DataType.string(16))
-    null_cls = Lit(None, DataType.string(16))
-    vals = [col(num_col)] + ([col(den_col)] if den_col else [])
-    val_names = [num_col] + ([den_col] if den_col else [])
-    expand = ExpandExec(
-        j,
-        [
-            vals + [col("i_category"), col("i_class"), lit(0)],
-            vals + [col("i_category"), null_cls, lit(1)],
-            vals + [null_cat, null_cls, lit(3)],
-        ],
-        val_names + ["i_category", "i_class", "g_id"],
+    return _rollup_rank_tail(
+        j, n_parts,
+        dims=[("i_category", DataType.string(16)), ("i_class", DataType.string(16))],
+        num_col=num_col, den_col=den_col, measure_name="measure",
+        measure_desc=ratio_desc,
     )
-    aggs = [AggFunction("sum", col(num_col), "num_sum")] + (
-        [AggFunction("sum", col(den_col), "den_sum")] if den_col else []
-    )
-    agg = two_stage_agg(
-        expand,
-        [GroupingExpr(col("i_category"), "i_category"),
-         GroupingExpr(col("i_class"), "i_class"),
-         GroupingExpr(col("g_id"), "g_id")],
-        aggs,
-        n_parts,
-    )
-    f64 = DataType.float64()
-    # lochierarchy = grouping(i_category)+grouping(i_class): 0, 1, 2
-    loch = Case(
-        [(col("g_id") == lit(0), lit(0)), (col("g_id") == lit(1), lit(1))],
-        lit(2),
-    )
-    measure = (
-        (col("num_sum").cast(f64) / col("den_sum").cast(f64))
-        if den_col else col("num_sum").cast(f64)
-    )
-    proj = ProjectExec(
-        agg,
-        [col("i_category"), col("i_class"), loch, measure],
-        ["i_category", "i_class", "lochierarchy", "measure"],
-    )
-    single = NativeShuffleExchangeExec(proj, SinglePartitioning())
-    # rank within parent: partition (lochierarchy, parent category)
-    parent_cat = Case([(col("lochierarchy") == lit(0), col("i_category"))], None)
-    pre = SortExec(single, [
-        SortField(col("lochierarchy")),
-        SortField(parent_cat),
-        SortField(col("measure"), ascending=not ratio_desc),
-    ])
-    w = WindowExec(
-        pre,
-        [WindowFunction("rank", "rank_within_parent")],
-        [col("lochierarchy"), parent_cat],
-        [SortField(col("measure"), ascending=not ratio_desc)],
-    )
-    out = SortExec(w, [
-        SortField(col("lochierarchy"), ascending=False),
-        SortField(Case([(col("lochierarchy") == lit(0), col("i_category"))], None)),
-        SortField(col("rank_within_parent")),
-    ], fetch=100)
-    from ..ops import LimitExec
-
-    return LimitExec(out, 100)
 
 
 def q36(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
@@ -813,16 +828,99 @@ def q86(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+def q61(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Promotional vs total store revenue for -5 GMT buyers of one
+    category — TWO scalar-subquery aggregates cross-joined into one
+    row with their ratio (the spec's promotions/total shape; channel
+    flags restricted to this generator's email/event columns)."""
+    from ..tpch.queries import scalar_subquery
+
+    def revenue(with_promo: bool):
+        dt = FilterExec(t["date_dim"],
+                        (col("d_year") == lit(1998)) & (col("d_moy") == lit(11)))
+        dt_p = ProjectExec(dt, [col("d_date_sk")])
+        st_p = ProjectExec(t["store"], [col("s_store_sk")])
+        it = FilterExec(t["item"], col("i_category") == lit("Jewelry"))
+        it_p = ProjectExec(it, [col("i_item_sk")])
+        ca = FilterExec(t["customer_address"],
+                        col("ca_gmt_offset") == lit("-5", DataType.decimal(5, 2)))
+        ca_p = ProjectExec(ca, [col("ca_address_sk")])
+        cust = ProjectExec(t["customer"],
+                           [col("c_customer_sk"), col("c_current_addr_sk")])
+        cust = broadcast_join(ca_p, cust, [col("ca_address_sk")], [col("c_current_addr_sk")], JoinType.LEFT_SEMI, build_is_left=False)
+        sl = ProjectExec(t["store_sales"],
+                         [col("ss_sold_date_sk"), col("ss_store_sk"),
+                          col("ss_item_sk"), col("ss_customer_sk"),
+                          col("ss_promo_sk"), col("ss_ext_sales_price")])
+        j = broadcast_join(dt_p, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+        j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+        j = broadcast_join(it_p, j, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+        j = broadcast_join(cust, j, [col("c_customer_sk")], [col("ss_customer_sk")], JoinType.INNER, build_is_left=True)
+        if with_promo:
+            pr = FilterExec(
+                t["promotion"],
+                (col("p_channel_email") == lit("Y"))
+                | (col("p_channel_event") == lit("Y")),
+            )
+            pr_p = ProjectExec(pr, [col("p_promo_sk")])
+            j = broadcast_join(pr_p, j, [col("p_promo_sk")], [col("ss_promo_sk")], JoinType.INNER, build_is_left=True)
+        return two_stage_agg(
+            j, [], [AggFunction("sum", col("ss_ext_sales_price"), "rev")], n_parts
+        )
+
+    promo = scalar_subquery(revenue(True), "rev")
+    total = scalar_subquery(revenue(False), "rev")
+    f64 = DataType.float64()
+    ratio = promo.cast(f64) * lit(100.0) / total.cast(f64)
+    src = FilterExec(t["reason"], col("r_reason_sk") == lit(1))
+    return ProjectExec(src, [promo, total, ratio],
+                       ["promotions", "total", "promo_pct"])
+
+
+# q15's literal zip prefixes (the spec's 5-digit list, sized to this
+# generator's distribution); shared with the oracle
+Q15_ZIPS = ("85669", "86197", "88274", "83405", "86475",
+            "35000", "35137", "60031", "60062", "60093")
+
+
+def q15(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Catalog sales by buyer zip for a quarter, kept when ANY of: zip
+    prefix in a literal list, state in a set, or a high-ticket sale —
+    the OR-of-unlike-predicates family."""
+    from ..exprs.ir import func
+
+    dt = FilterExec(t["date_dim"],
+                    (col("d_qoy") == lit(2)) & (col("d_year") == lit(2001)))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    cust = ProjectExec(t["customer"], [col("c_customer_sk"), col("c_current_addr_sk")])
+    ca_p = ProjectExec(t["customer_address"],
+                       [col("ca_address_sk"), col("ca_zip"), col("ca_state")])
+    sl = ProjectExec(t["catalog_sales"],
+                     [col("cs_sold_date_sk"), col("cs_bill_customer_sk"),
+                      col("cs_sales_price")])
+    j = broadcast_join(dt_p, sl, [col("d_date_sk")], [col("cs_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(cust, j, [col("c_customer_sk")], [col("cs_bill_customer_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(ca_p, j, [col("ca_address_sk")], [col("c_current_addr_sk")], JoinType.INNER, build_is_left=True)
+    zip5 = func("substring", col("ca_zip"), lit(1), lit(5))
+    keep = (
+        zip5.isin(*[lit(z) for z in Q15_ZIPS])
+        | col("ca_state").isin(lit("TN"), lit("GA"), lit("OH"))
+        | (col("cs_sales_price") > lit("250", DataType.decimal(7, 2)))
+    )
+    f = FilterExec(j, keep)
+    agg = two_stage_agg(
+        f,
+        [GroupingExpr(col("ca_zip"), "ca_zip")],
+        [AggFunction("sum", col("cs_sales_price"), "sum_price")],
+        n_parts,
+    )
+    return single_sorted(agg, [SortField(col("ca_zip"))], fetch=100)
+
+
 def q70(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     """Net-profit ROLLUP over store GEOGRAPHY (state, county) with
     rank-within-parent — the q36/q86 shape grouped on the store
-    dimension instead of the item hierarchy.  (The rollup pipeline
-    mirrors _rollup_margin_report, which is item-dimension-bound; keep
-    shape fixes in sync or generalize that helper.)"""
-    from ..exprs.ir import Case, Lit
-    from ..ops import ExpandExec, LimitExec, SortExec, WindowExec, WindowFunction
-    from ..parallel import NativeShuffleExchangeExec, SinglePartitioning
-
+    dimension instead of the item hierarchy."""
     dt = FilterExec(t["date_dim"], col("d_year") == lit(2000))
     dt_p = ProjectExec(dt, [col("d_date_sk")])
     st_p = ProjectExec(t["store"], [col("s_store_sk"), col("s_state"), col("s_county")])
@@ -830,53 +928,12 @@ def q70(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
                      [col("ss_sold_date_sk"), col("ss_store_sk"), col("ss_net_profit")])
     j = broadcast_join(dt_p, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
     j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
-    null_state = Lit(None, DataType.string(8))
-    null_county = Lit(None, DataType.string(24))
-    expand = ExpandExec(
-        j,
-        [
-            [col("ss_net_profit"), col("s_state"), col("s_county"), lit(0)],
-            [col("ss_net_profit"), col("s_state"), null_county, lit(1)],
-            [col("ss_net_profit"), null_state, null_county, lit(3)],
-        ],
-        ["ss_net_profit", "s_state", "s_county", "g_id"],
+    return _rollup_rank_tail(
+        j, n_parts,
+        dims=[("s_state", DataType.string(8)), ("s_county", DataType.string(24))],
+        num_col="ss_net_profit", den_col=None, measure_name="total_sum",
+        measure_desc=True, measure_as_float=False,
     )
-    agg = two_stage_agg(
-        expand,
-        [GroupingExpr(col("s_state"), "s_state"),
-         GroupingExpr(col("s_county"), "s_county"),
-         GroupingExpr(col("g_id"), "g_id")],
-        [AggFunction("sum", col("ss_net_profit"), "total_sum")],
-        n_parts,
-    )
-    loch = Case(
-        [(col("g_id") == lit(0), lit(0)), (col("g_id") == lit(1), lit(1))],
-        lit(2),
-    )
-    proj = ProjectExec(
-        agg,
-        [col("s_state"), col("s_county"), loch, col("total_sum")],
-        ["s_state", "s_county", "lochierarchy", "total_sum"],
-    )
-    single = NativeShuffleExchangeExec(proj, SinglePartitioning())
-    parent_state = Case([(col("lochierarchy") == lit(0), col("s_state"))], None)
-    pre = SortExec(single, [
-        SortField(col("lochierarchy")),
-        SortField(parent_state),
-        SortField(col("total_sum"), ascending=False),
-    ])
-    w = WindowExec(
-        pre,
-        [WindowFunction("rank", "rank_within_parent")],
-        [col("lochierarchy"), parent_state],
-        [SortField(col("total_sum"), ascending=False)],
-    )
-    out = SortExec(w, [
-        SortField(col("lochierarchy"), ascending=False),
-        SortField(Case([(col("lochierarchy") == lit(0), col("s_state"))], None)),
-        SortField(col("rank_within_parent")),
-    ], fetch=100)
-    return LimitExec(out, 100)
 
 
 def _yoy_window_report(t, n_parts, *, sales, date_col, item_col, price_col,
@@ -1442,6 +1499,7 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q56": q56,
     "q57": q57,
     "q60": q60,
+    "q61": q61,
     "q86": q86,
     "q87": q87,
     "q7": q7,
@@ -1449,6 +1507,7 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q9": q9,
     "q10": q10,
     "q13": q13,
+    "q15": q15,
     "q35": q35,
     "q88": q88,
     "q19": q19,

@@ -492,3 +492,24 @@ def test_q70(data, scans):
         assert len(got["lochierarchy"]) == len(exp)
         assert set(got["lochierarchy"]) == {0, 1, 2}
     assert got["lochierarchy"] == sorted(got["lochierarchy"], reverse=True)
+
+
+def test_q15(data, scans):
+    got = run(build_query("q15", scans, N_PARTS))
+    exp = O.oracle_q15(data)
+    assert exp, "q15 oracle matched no rows"
+    rows = dict(zip(got["ca_zip"], got["sum_price"]))
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+    assert got["ca_zip"] == sorted(got["ca_zip"])
+
+
+def test_q61(ticket_data, ticket_scans):
+    got = run(build_query("q61", ticket_scans, N_PARTS))
+    promo, total = O.oracle_q61(ticket_data)
+    assert total > 0, "q61 slice matched no rows"
+    assert got["promotions"] == [promo]
+    assert got["total"] == [total]
+    exp_pct = (promo / 100.0) * 100.0 / (total / 100.0)
+    assert abs(got["promo_pct"][0] - exp_pct) < 1e-9
