@@ -100,8 +100,9 @@ def agg_result_type(fn: str, in_t: Optional[DataType]) -> DataType:
             return decimal_avg_agg_type(in_t)
         return DataType.float64()
     if fn in ("collect_list", "collect_set"):
-        if in_t.is_nested:
-            raise NotImplementedError("collect over nested element types (roadmap)")
+        if fn == "collect_set" and in_t.is_nested:
+            # set-dedup needs element sort words, undefined for nested
+            raise NotImplementedError("collect_set over nested element types")
         return DataType.array(in_t, int(conf.COLLECT_MAX_ELEMS.get()))
     return in_t  # min/max/first
 
@@ -357,12 +358,44 @@ def _seg_first_row(seg, cap, n):
     return jnp.clip(jnp.take(first, seg), 0, n - 1)
 
 
+def _scatter_elem_col(c: Column, tgt, pos, cap: int, m: int, n_lead: int,
+                      top_validity=None) -> Column:
+    """Scatter a column's rows/elements into a (cap, m)-leading output
+    at ``[tgt, pos]`` — recursive over nested children, so any element
+    dtype collects (arrays of arrays/maps/structs included).
+
+    ``n_lead``: leading axes of the SOURCE arrays (1 = one entry per
+    input row, 2 = per (row, element) in merge mode)."""
+
+    def sc(arr, dtype):
+        if arr is None:
+            return None
+        out = jnp.zeros((cap, m) + arr.shape[n_lead:], dtype)
+        return out.at[tgt, pos].set(arr, mode="drop")
+
+    validity = (
+        top_validity
+        if top_validity is not None
+        else sc(c.validity, jnp.bool_)
+    )
+    return Column(
+        c.dtype,
+        sc(c.data, c.data.dtype) if c.data is not None else None,
+        validity,
+        sc(c.lengths, jnp.int32) if c.lengths is not None else None,
+        None if c.children is None else tuple(
+            _scatter_elem_col(k, tgt, pos, cap, m, n_lead) for k in c.children
+        ),
+    )
+
+
 def _collect_reduce(v: Column, arr_t: DataType, seg, cap: int, merging: bool) -> Column:
     """Segment-collect into the fixed max-elements ARRAY layout
     (≙ reference agg/collect.rs collect_list/collect_set accs).  Nulls
     are skipped (Spark semantics); elements past ``max_elems`` are
     DROPPED — the padded layout's documented deviation from the
-    reference's unbounded lists."""
+    reference's unbounded lists.  Element scatter recurses over nested
+    children, so nested element types collect too."""
     elem_t = arr_t.elem
     m = arr_t.max_elems
     n = v.validity.shape[0]
@@ -375,16 +408,8 @@ def _collect_reduce(v: Column, arr_t: DataType, seg, cap: int, merging: bool) ->
         emit = valid & (pos < m)
         tgt = jnp.where(emit, seg, cap)        # cap = dropped (out of bounds)
         counts = jnp.clip(_seg_count(valid, seg, cap), 0, m).astype(jnp.int32)
-        if elem_t.is_string:
-            w = v.data.shape[-1]
-            data = jnp.zeros((cap, m, w), jnp.uint8).at[tgt, pos].set(v.data, mode="drop")
-            lengths = jnp.zeros((cap, m), jnp.int32).at[tgt, pos].set(v.lengths, mode="drop")
-            ev = jnp.arange(m)[None, :] < counts[:, None]
-            elem = Column(elem_t, data, ev, lengths)
-        else:
-            data = jnp.zeros((cap, m), v.data.dtype).at[tgt, pos].set(v.data, mode="drop")
-            ev = jnp.arange(m)[None, :] < counts[:, None]
-            elem = Column(elem_t, data, ev)
+        ev = jnp.arange(m)[None, :] < counts[:, None]
+        elem = _scatter_elem_col(v, tgt, pos, cap, m, 1, top_validity=ev)
         return Column(arr_t, None, jnp.ones(cap, jnp.bool_), counts, (elem,))
     # merging: v is an ARRAY state column (rows sorted by group)
     rc = jnp.where(v.validity, v.lengths, 0).astype(jnp.int32)
@@ -401,14 +426,7 @@ def _collect_reduce(v: Column, arr_t: DataType, seg, cap: int, merging: bool) ->
         jax.ops.segment_sum(rc, seg, num_segments=cap, indices_are_sorted=True), 0, m
     ).astype(jnp.int32)
     ev = jnp.arange(m)[None, :] < counts[:, None]
-    if elem_t.is_string:
-        w = elem.data.shape[-1]
-        data = jnp.zeros((cap, m, w), jnp.uint8).at[tgt, pos2].set(elem.data, mode="drop")
-        lengths = jnp.zeros((cap, m), jnp.int32).at[tgt, pos2].set(elem.lengths, mode="drop")
-        out_elem = Column(elem_t, data, ev, lengths)
-    else:
-        data = jnp.zeros((cap, m), elem.data.dtype).at[tgt, pos2].set(elem.data, mode="drop")
-        out_elem = Column(elem_t, data, ev)
+    out_elem = _scatter_elem_col(elem, tgt, pos2, cap, m, 2, top_validity=ev)
     return Column(arr_t, None, jnp.ones(cap, jnp.bool_), counts, (out_elem,))
 
 

@@ -280,3 +280,50 @@ def test_nested_through_shuffle():
             rows += list(zip(d["id"], [repr(x) for x in d["a"]], [repr(x) for x in d["nn"]]))
     want = list(zip(DATA["id"], [repr(x) for x in DATA["a"]], [repr(x) for x in DATA["nn"]]))
     assert sorted(rows) == sorted(want)
+
+
+def test_collect_list_over_array_elements():
+    """collect_list of ARRAY-typed values: two-stage aggregation whose
+    state is an array-of-arrays column (nested element scatter + serde
+    across the exchange)."""
+    import numpy as np
+
+    from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggFunction, GroupingExpr, MemoryScanExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.schema import DataType, Field, Schema
+    from blaze_tpu.tpch.queries import two_stage_agg
+
+    arr_t = DataType.array(DataType.int64(), 4)
+    schema = Schema([Field("g", DataType.int64()), Field("v", arr_t)])
+    rows = [
+        (0, [1, 2]), (1, [3]), (0, [4, 5, 6]), (1, []), (0, None),
+        (2, [7]), (1, [8, 9]), (2, [10, None, 12]),
+    ]
+    data = {"g": [r[0] for r in rows], "v": [r[1] for r in rows]}
+    parts = [[batch_from_pydict({k: v[:4] for k, v in data.items()}, schema)],
+             [batch_from_pydict({k: v[4:] for k, v in data.items()}, schema)]]
+    src = MemoryScanExec(parts, schema)
+    plan = two_stage_agg(
+        src,
+        [GroupingExpr(col("g"), "g")],
+        [AggFunction("collect_list", col("v"), "lists")],
+        2,
+    )
+    got = {}
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            for g, ls in zip(d["g"], d["lists"]):
+                got[g] = ls
+    exp = {}
+    for g, v in rows:
+        if v is not None:  # collect skips NULL rows (Spark)
+            exp.setdefault(g, []).append(v)
+    assert set(got) == set(exp)
+    for g in exp:
+        # order within a group is partition-order dependent; compare
+        # as multisets of tuples (inner nulls preserved)
+        canon = lambda ls: sorted(tuple(x) for x in ls)
+        assert canon(got[g]) == canon(exp[g]), g
