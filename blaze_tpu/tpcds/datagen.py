@@ -285,6 +285,7 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "cs_coupon_amt": (_money(rng, n, 0, 100), None),
             "cs_sales_price": (_money(rng, n, 0, 300), None),
             "cs_ext_sales_price": (_money(rng, n, 0, 2000), None),
+            "cs_ext_discount_amt": (_money(rng, n, 0, 1000), None),
         }
     if name == "web_sales":
         n = max(100, int(720_000 * scale))
@@ -303,6 +304,7 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "ws_bill_addr_sk": (rng.randint(1, n_addr + 1, n).astype(np.int64), None),
             "ws_ext_sales_price": (_money(rng, n, 0, 2000), None),
             "ws_net_paid": (_money(rng, n, 0, 2000), None),
+            "ws_ext_discount_amt": (_money(rng, n, 0, 1000), None),
         }
     if name == "item":
         n = max(60, int(18000 * scale))

@@ -1262,3 +1262,52 @@ def oracle_q61(tables):
         if int(ss["ss_promo_sk"][0][i]) in promo_ok:
             promo += v
     return promo, total
+
+
+def _excess_discount_oracle(tables, *, sales, date_col, item_col, amt_col):
+    from .queries import Q32_MFG_MAX
+
+    import datetime as _dt
+    dd = tables["date_dim"]
+    it = tables["item"]
+    sl = tables[sales]
+    lo = (_dt.date(2000, 1, 27) - _dt.date(1970, 1, 1)).days
+    hi = (_dt.date(2000, 4, 26) - _dt.date(1970, 1, 1)).days
+    dm = (dd["d_date"][0] >= lo) & (dd["d_date"][0] <= hi)
+    d_ok = set(dd["d_date_sk"][0][dm].tolist())
+    mfg_ok = {int(sk) for sk, m in zip(it["i_item_sk"][0], it["i_manufact_id"][0])
+              if int(m) <= Q32_MFG_MAX}
+    rows = []
+    per_item = {}
+    for i in range(sl[date_col][0].shape[0]):
+        if int(sl[date_col][0][i]) not in d_ok:
+            continue
+        ik = int(sl[item_col][0][i])
+        amt = int(sl[amt_col][0][i])
+        rows.append((ik, amt))
+        per_item.setdefault(ik, []).append(amt)
+    # engine avg carries scale 6 (unscaled*10^4, HALF_UP)
+    avg_u = {ik: (sum(v) * 10**4 + len(v) // 2) // len(v)
+             for ik, v in per_item.items()}
+    total = 0
+    matched = False
+    for ik, amt in rows:
+        if ik not in mfg_ok:
+            continue
+        # engine compares float dollars: amt/100 > (avg_u/1e6)*1.3
+        if amt / 100.0 > (avg_u[ik] / 10**6) * 1.3:
+            total += amt
+            matched = True
+    return total if matched else None
+
+
+def oracle_q32(tables):
+    return _excess_discount_oracle(
+        tables, sales="catalog_sales", date_col="cs_sold_date_sk",
+        item_col="cs_item_sk", amt_col="cs_ext_discount_amt")
+
+
+def oracle_q92(tables):
+    return _excess_discount_oracle(
+        tables, sales="web_sales", date_col="ws_sold_date_sk",
+        item_col="ws_item_sk", amt_col="ws_ext_discount_amt")

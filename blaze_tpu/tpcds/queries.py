@@ -828,6 +828,60 @@ def q86(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+def _excess_discount(t, n_parts, *, sales, date_col, item_col, amt_col):
+    """Shared q32/q92 shape: sum of discounts exceeding 1.3x the
+    ITEM'S OWN average over the window — the correlated scalar
+    subquery decorrelated into a per-item aggregate join."""
+    import datetime as _dt
+
+    lo = _dt.date(2000, 1, 27)
+    hi = _dt.date(2000, 4, 26)
+    dt = FilterExec(t["date_dim"],
+                    (col("d_date") >= lit(lo)) & (col("d_date") <= lit(hi)))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    sl = ProjectExec(t[sales], [col(date_col), col(item_col), col(amt_col)])
+    j = broadcast_join(dt_p, sl, [col("d_date_sk")], [col(date_col)], JoinType.INNER, build_is_left=True)
+    per_item = two_stage_agg(
+        j,
+        [GroupingExpr(col(item_col), "avg_item_sk")],
+        [AggFunction("avg", col(amt_col), "avg_amt")],
+        n_parts,
+    )
+    jj = broadcast_join(per_item, j, [col("avg_item_sk")], [col(item_col)], JoinType.INNER, build_is_left=True)
+    f64 = DataType.float64()
+    # avg_amt is decimal(11,6) (scale+4): compare in float dollars
+    keep = col(amt_col).cast(f64) > col("avg_amt").cast(f64) * lit(1.3)
+    it = FilterExec(t["item"], col("i_manufact_id") <= lit(Q32_MFG_MAX))
+    it_p = ProjectExec(it, [col("i_item_sk")])
+    f = FilterExec(jj, keep)
+    f = broadcast_join(it_p, f, [col("i_item_sk")], [col(item_col)], JoinType.LEFT_SEMI, build_is_left=False)
+    return two_stage_agg(
+        f, [], [AggFunction("sum", col(amt_col), "excess_discount")], n_parts
+    )
+
+
+# the spec filters one manufacturer (977/356); at tiny scales a single
+# id may be absent, so this subset uses a low-id RANGE that always
+# keeps a real item slice — shared with the oracle
+Q32_MFG_MAX = 40
+
+
+def q32(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Catalog excess-discount sum (correlated per-item average)."""
+    return _excess_discount(
+        t, n_parts, sales="catalog_sales", date_col="cs_sold_date_sk",
+        item_col="cs_item_sk", amt_col="cs_ext_discount_amt",
+    )
+
+
+def q92(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Web excess-discount sum — q32's shape over web_sales."""
+    return _excess_discount(
+        t, n_parts, sales="web_sales", date_col="ws_sold_date_sk",
+        item_col="ws_item_sk", amt_col="ws_ext_discount_amt",
+    )
+
+
 def q61(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     """Promotional vs total store revenue for -5 GMT buyers of one
     category — TWO scalar-subquery aggregates cross-joined into one
@@ -1491,6 +1545,7 @@ def q48(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
 
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q3": q3,
+    "q32": q32,
     "q33": q33,
     "q36": q36,
     "q38": q38,
@@ -1524,6 +1579,7 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q70": q70,
     "q73": q73,
     "q89": q89,
+    "q92": q92,
     "q93": q93,
     "q96": q96,
     "q98": q98,

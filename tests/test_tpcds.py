@@ -513,3 +513,17 @@ def test_q61(ticket_data, ticket_scans):
     assert got["total"] == [total]
     exp_pct = (promo / 100.0) * 100.0 / (total / 100.0)
     assert abs(got["promo_pct"][0] - exp_pct) < 1e-9
+
+
+def test_q32(data, scans):
+    got = run(build_query("q32", scans, N_PARTS))
+    exp = O.oracle_q32(data)
+    assert exp is not None, "q32 slice matched no rows"
+    assert got["excess_discount"] == [exp]
+
+
+def test_q92(data, scans):
+    got = run(build_query("q92", scans, N_PARTS))
+    exp = O.oracle_q92(data)
+    assert exp is not None, "q92 slice matched no rows"
+    assert got["excess_discount"] == [exp]
