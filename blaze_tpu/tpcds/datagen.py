@@ -102,6 +102,8 @@ def _date_dim() -> HostTable:
         "d_moy": (m.astype(np.int32), None),
         "d_dom": (d.astype(np.int32), None),
         "d_qoy": (((m - 1) // 3 + 1).astype(np.int32), None),
+        # 0 = Sunday (dsdgen convention); 1970-01-01 was a Thursday
+        "d_dow": (((days.astype(np.int64) + 4) % 7).astype(np.int32), None),
     }
 
 

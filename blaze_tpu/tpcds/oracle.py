@@ -1311,3 +1311,23 @@ def oracle_q92(tables):
     return _excess_discount_oracle(
         tables, sales="web_sales", date_col="ws_sold_date_sk",
         item_col="ws_item_sk", amt_col="ws_ext_discount_amt")
+
+
+def oracle_q43(tables):
+    """{store_name: [sun..sat unscaled sums]} for d_year 2000."""
+    dd = tables["date_dim"]
+    st = tables["store"]
+    ss = tables["store_sales"]
+    m = dd["d_year"][0] == 2000
+    dow_by_sk = dict(zip(dd["d_date_sk"][0][m].tolist(),
+                         dd["d_dow"][0][m].tolist()))
+    names = _sv(st, "s_store_name")
+    name_by_sk = {int(sk): names[i] for i, sk in enumerate(st["s_store_sk"][0])}
+    out = {}
+    for i in range(ss["ss_sold_date_sk"][0].shape[0]):
+        dow = dow_by_sk.get(int(ss["ss_sold_date_sk"][0][i]))
+        nm = name_by_sk.get(int(ss["ss_store_sk"][0][i]))
+        if dow is None or nm is None:
+            continue
+        out.setdefault(nm, [0] * 7)[int(dow)] += int(ss["ss_sales_price"][0][i])
+    return out

@@ -828,6 +828,38 @@ def q86(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+_DOW_NAMES = ("sun", "mon", "tue", "wed", "thu", "fri", "sat")
+
+
+def q43(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Per-store weekly sales PIVOT: seven sum(CASE WHEN d_dow = k
+    THEN price END) aggregates in one pass (the day-of-week report)."""
+    from ..exprs.ir import Case
+
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2000))
+    dt_p = ProjectExec(dt, [col("d_date_sk"), col("d_dow")])
+    st_p = ProjectExec(t["store"], [col("s_store_sk"), col("s_store_name")])
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_sold_date_sk"), col("ss_store_sk"),
+                      col("ss_sales_price")])
+    j = broadcast_join(dt_p, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st_p, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    pivots = [
+        Case([(col("d_dow") == lit(k), col("ss_sales_price"))], None)
+        .alias(f"{name}_v")
+        for k, name in enumerate(_DOW_NAMES)
+    ]
+    proj = ProjectExec(j, [col("s_store_name")] + pivots)
+    agg = two_stage_agg(
+        proj,
+        [GroupingExpr(col("s_store_name"), "s_store_name")],
+        [AggFunction("sum", col(f"{name}_v"), f"{name}_sales")
+         for name in _DOW_NAMES],
+        n_parts,
+    )
+    return single_sorted(agg, [SortField(col("s_store_name"))], fetch=100)
+
+
 def _excess_discount(t, n_parts, *, sales, date_col, item_col, amt_col):
     """Shared q32/q92 shape: sum of discounts exceeding 1.3x the
     ITEM'S OWN average over the window — the correlated scalar
@@ -1570,6 +1602,7 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q27": q27,
     "q34": q34,
     "q42": q42,
+    "q43": q43,
     "q53": q53,
     "q52": q52,
     "q55": q55,

@@ -527,3 +527,16 @@ def test_q92(data, scans):
     exp = O.oracle_q92(data)
     assert exp is not None, "q92 slice matched no rows"
     assert got["excess_discount"] == [exp]
+
+
+def test_q43(data, scans):
+    got = run(build_query("q43", scans, N_PARTS))
+    exp = O.oracle_q43(data)
+    assert exp, "q43 oracle matched no rows"
+    assert got["s_store_name"] == sorted(got["s_store_name"])
+    assert len(got["s_store_name"]) == min(len(exp), 100)
+    days = ("sun", "mon", "tue", "wed", "thu", "fri", "sat")
+    for i, nm in enumerate(got["s_store_name"]):
+        for k, d in enumerate(days):
+            v = got[f"{d}_sales"][i]
+            assert (v or 0) == exp[nm][k], (nm, d)
