@@ -285,42 +285,10 @@ def oracle_q89(tables):
 
 
 def oracle_q98(tables):
-    """{(item_id, desc, cat, cls, price): (revenue, ratio)} over the
-    1999-02-22..1999-03-24 date window and 3 categories."""
-    import datetime as _dt
-
-    dd = tables["date_dim"]
-    epoch = _dt.date(1970, 1, 1)
-    lo = (_dt.date(1999, 2, 22) - epoch).days
-    hi = (_dt.date(1999, 3, 24) - epoch).days
-    d_ok = (dd["d_date"][0] >= lo) & (dd["d_date"][0] <= hi)
-    d_set = set(dd["d_date_sk"][0][d_ok].tolist())
-    it = tables["item"]
-    cats = _sv(it, "i_category")
-    item_by_sk = {}
-    for i, sk in enumerate(it["i_item_sk"][0]):
-        if cats[i] in ("Sports", "Books", "Home"):
-            item_by_sk[int(sk)] = (
-                _sv(it, "i_item_id")[i], _sv(it, "i_item_desc")[i],
-                cats[i], _sv(it, "i_class")[i], int(it["i_current_price"][0][i]),
-            )
-    ss = tables["store_sales"]
-    sums: Dict[tuple, int] = {}
-    i_sk = ss["ss_item_sk"][0]; d_sk = ss["ss_sold_date_sk"][0]
-    price = ss["ss_ext_sales_price"][0]
-    for i in range(i_sk.shape[0]):
-        itm = item_by_sk.get(int(i_sk[i]))
-        if itm is None or int(d_sk[i]) not in d_set:
-            continue
-        sums[itm] = sums.get(itm, 0) + int(price[i])
-    class_total: Dict[str, int] = {}
-    for itm, s in sums.items():
-        class_total[itm[3]] = class_total.get(itm[3], 0) + s
-    return {
-        itm: (s, (float(s) * 100.0) / float(class_total[itm[3]]))
-        for itm, s in sums.items()
-    }
-
+    return _class_share_oracle(tables, sales="store_sales",
+                               date_col="ss_sold_date_sk",
+                               item_col="ss_item_sk",
+                               price_col="ss_ext_sales_price")
 
 def _oracle_ticket_report(tables, *, dom_ranges, buy_potentials, cnt_lo, cnt_hi,
                           dep_vehicle_ratio=None):
@@ -1331,3 +1299,50 @@ def oracle_q43(tables):
             continue
         out.setdefault(nm, [0] * 7)[int(dow)] += int(ss["ss_sales_price"][0][i])
     return out
+
+
+def _class_share_oracle(tables, *, sales, date_col, item_col, price_col):
+    """q98/q20/q12 oracle: {(id, desc, cat, cls, price): (rev, ratio)}."""
+    import datetime as _dt
+    dd = tables["date_dim"]
+    it = tables["item"]
+    sl = tables[sales]
+    lo = (_dt.date(1999, 2, 22) - _dt.date(1970, 1, 1)).days
+    hi = (_dt.date(1999, 3, 24) - _dt.date(1970, 1, 1)).days
+    dm = (dd["d_date"][0] >= lo) & (dd["d_date"][0] <= hi)
+    d_ok = set(dd["d_date_sk"][0][dm].tolist())
+    cats = _sv(it, "i_category")
+    ids = _sv(it, "i_item_id")
+    descs = _sv(it, "i_item_desc")
+    clss = _sv(it, "i_class")
+    prices = it["i_current_price"][0]
+    keep = {"Sports", "Books", "Home"}
+    meta = {int(sk): (ids[i], descs[i], cats[i], clss[i], int(prices[i]))
+            for i, sk in enumerate(it["i_item_sk"][0]) if cats[i] in keep}
+    rev = {}
+    for i in range(sl[date_col][0].shape[0]):
+        if int(sl[date_col][0][i]) not in d_ok:
+            continue
+        m = meta.get(int(sl[item_col][0][i]))
+        if m is None:
+            continue
+        rev[m] = rev.get(m, 0) + int(sl[price_col][0][i])
+    by_class = {}
+    for m, r in rev.items():
+        by_class[m[3]] = by_class.get(m[3], 0) + r
+    return {m: (r, float(r) * 100.0 / float(by_class[m[3]]))
+            for m, r in rev.items()}
+
+
+def oracle_q20(tables):
+    return _class_share_oracle(tables, sales="catalog_sales",
+                               date_col="cs_sold_date_sk",
+                               item_col="cs_item_sk",
+                               price_col="cs_ext_sales_price")
+
+
+def oracle_q12(tables):
+    return _class_share_oracle(tables, sales="web_sales",
+                               date_col="ws_sold_date_sk",
+                               item_col="ws_item_sk",
+                               price_col="ws_ext_sales_price")

@@ -322,8 +322,9 @@ def q89(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     return out
 
 
-def q98(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
-    """Item revenue share of its class — windowed sum over i_class."""
+def _class_share_report(t, n_parts, *, sales, date_col, item_col, price_col):
+    """Shared q98/q20/q12 shape: item revenue share of its class —
+    windowed sum over i_class, per channel."""
     import datetime
 
     from ..ops import SortExec, WindowExec, WindowFunction
@@ -342,8 +343,10 @@ def q98(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
     it_p = ProjectExec(it, [col("i_item_sk"), col("i_item_id"), col("i_item_desc"),
                             col("i_category"), col("i_class"), col("i_current_price")])
-    j = broadcast_join(dt_p, t["store_sales"], [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
-    j = broadcast_join(it_p, j, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    sl = ProjectExec(t[sales], [col(date_col), col(item_col), col(price_col)],
+                     [date_col, item_col, "ss_ext_sales_price"])
+    j = broadcast_join(dt_p, sl, [col("d_date_sk")], [col(date_col)], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(it_p, j, [col("i_item_sk")], [col(item_col)], JoinType.INNER, build_is_left=True)
     agg = two_stage_agg(
         j,
         [GroupingExpr(col("i_item_id"), "i_item_id"),
@@ -376,6 +379,30 @@ def q98(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
         [SortField(col("i_category")), SortField(col("i_class")),
          SortField(col("i_item_id")), SortField(col("i_item_desc")),
          SortField(col("revenueratio"))],
+    )
+
+
+def q98(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Item revenue share of its class (store channel)."""
+    return _class_share_report(
+        t, n_parts, sales="store_sales", date_col="ss_sold_date_sk",
+        item_col="ss_item_sk", price_col="ss_ext_sales_price",
+    )
+
+
+def q20(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """q98's class-share report over the CATALOG channel."""
+    return _class_share_report(
+        t, n_parts, sales="catalog_sales", date_col="cs_sold_date_sk",
+        item_col="cs_item_sk", price_col="cs_ext_sales_price",
+    )
+
+
+def q12(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """q98's class-share report over the WEB channel."""
+    return _class_share_report(
+        t, n_parts, sales="web_sales", date_col="ws_sold_date_sk",
+        item_col="ws_item_sk", price_col="ws_ext_sales_price",
     )
 
 
@@ -1593,11 +1620,13 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q8": q8,
     "q9": q9,
     "q10": q10,
+    "q12": q12,
     "q13": q13,
     "q15": q15,
     "q35": q35,
     "q88": q88,
     "q19": q19,
+    "q20": q20,
     "q26": q26,
     "q27": q27,
     "q34": q34,

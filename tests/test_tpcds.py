@@ -146,20 +146,7 @@ def test_q89(data, scans):
 
 
 def test_q98(data, scans):
-    got = run(build_query("q98", scans, N_PARTS))
-    exp = O.oracle_q98(data)
-    assert len(got["i_item_id"]) == len(exp)
-    for iid, desc, cat, cls, price, rev, ratio in zip(
-        got["i_item_id"], got["i_item_desc"], got["i_category"], got["i_class"],
-        got["i_current_price"], got["itemrevenue"], got["revenueratio"],
-    ):
-        key = (iid, desc, cat, cls, price)
-        assert key in exp, key
-        erev, eratio = exp[key]
-        assert rev == erev and abs(ratio - eratio) < 1e-9, key
-    # spec ordering: category then class
-    cats = got["i_category"]
-    assert cats == sorted(cats)
+    _check_class_share(run(build_query("q98", scans, N_PARTS)), O.oracle_q98(data))
 
 
 def _check_ticket_report(got, exp):
@@ -540,3 +527,24 @@ def test_q43(data, scans):
         for k, d in enumerate(days):
             v = got[f"{d}_sales"][i]
             assert (v or 0) == exp[nm][k], (nm, d)
+
+
+def _check_class_share(got, exp):
+    assert len(got["i_item_id"]) == len(exp)
+    for iid, desc, cat, cls, price, rev, ratio in zip(
+        got["i_item_id"], got["i_item_desc"], got["i_category"], got["i_class"],
+        got["i_current_price"], got["itemrevenue"], got["revenueratio"],
+    ):
+        key = (iid, desc, cat, cls, price)
+        assert key in exp, key
+        erev, eratio = exp[key]
+        assert rev == erev and abs(ratio - eratio) < 1e-9, key
+    assert got["i_category"] == sorted(got["i_category"])
+
+
+def test_q20(data, scans):
+    _check_class_share(run(build_query("q20", scans, N_PARTS)), O.oracle_q20(data))
+
+
+def test_q12(data, scans):
+    _check_class_share(run(build_query("q12", scans, N_PARTS)), O.oracle_q12(data))
