@@ -26,6 +26,14 @@ can be wedged, and backend init then HANGS rather than raising.  So:
   kill a process holding the chip — that wedges the lease for hours).
   The parent waits until its deadline, then prints the TPU line if the
   child delivered, else the CPU line.
+- a CACHED result is honored: any time during the round the chip was
+  up, `python bench.py --tpu-child .bench_tpu_cached.json` records a
+  measurement; the driver-window run emits it (marked "cached": true
+  with its measured_at timestamp) when the window itself can't land a
+  fresh one (round-3 postmortem: the lease was wedged for the entire
+  driver window 3 rounds running).
+- every probe attempt is timestamped into the emitted line
+  (`probe_log`) so a wedged lease is provable, not asserted.
 
 Usage:
   python bench.py             # driver mode: probe + fallback schedule
@@ -51,7 +59,14 @@ BLAZE_Q01_ROWS_PER_SEC_PER_NODE = 6_000_000_000 / 40.473 / 7  # ≈ 21.18e6
 BUDGET_S = float(os.environ.get("BLAZE_BENCH_BUDGET", "540"))
 SCALE_Q6 = float(os.environ.get("BLAZE_BENCH_SCALE_Q6", "8"))
 SCALE_Q1 = float(os.environ.get("BLAZE_BENCH_SCALE_Q1", "2"))
-CPU_SCALE = float(os.environ.get("BLAZE_BENCH_CPU_SCALE", "0.05"))
+# CPU fallback scale: the largest SF whose datagen + 4 runs of q06/q01
+# fit the subprocess budget on this image's single core with headroom
+# (raised from 0.05 after round 3: fixed per-program costs swamped
+# throughput there and the line undersold the engine)
+CPU_SCALE = float(os.environ.get("BLAZE_BENCH_CPU_SCALE", "0.5"))
+CACHED_RESULT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_tpu_cached.json"
+)
 
 
 def _measure(scale_q6: float, scale_q1: float, on_tpu: bool) -> dict:
@@ -133,6 +148,7 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool) -> dict:
         "q01_vs_baseline": round(r1 / BLAZE_Q01_ROWS_PER_SEC_PER_NODE, 3),
         "scale_q06": scale_q6,
         "scale_q01": scale_q1,
+        "iterations": 3,
         "backend": "tpu" if on_tpu else "cpu",
     }
 
@@ -170,13 +186,17 @@ def _cpu_child() -> None:
 def _tpu_child(out_path: str) -> None:
     # init the real backend in-process (only launched after a probe
     # succeeded); write the result file atomically
-    import jax
-
     result = _measure(SCALE_Q6, SCALE_Q1, on_tpu=_is_tpu_backend())
+    result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:
         f.write(json.dumps(result))
     os.replace(tmp, out_path)
+    # also refresh the round-level cache (unless we ARE the cache run)
+    if os.path.abspath(out_path) != CACHED_RESULT_PATH and result.get("backend") == "tpu":
+        with open(CACHED_RESULT_PATH + ".tmp", "w") as f:
+            f.write(json.dumps(result))
+        os.replace(CACHED_RESULT_PATH + ".tmp", CACHED_RESULT_PATH)
 
 
 def _smoke(scale: float) -> None:
@@ -195,13 +215,21 @@ def main() -> None:
         env={**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
     )
 
-    # --- probe loop: the lease can free at ANY moment in the window
+    # --- probe loop: the lease can free at ANY moment in the window;
+    # every attempt is timestamped so a wedged lease is provable
     probe_ok = threading.Event()
     stop = threading.Event()
+    probe_log = []
 
     def probe_loop():
         while not stop.is_set() and time.time() < deadline - 60:
-            if _probe_once(timeout_s=min(75, max(15, deadline - 60 - time.time()))):
+            started = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+            timeout_s = min(75, max(15, deadline - 60 - time.time()))
+            ok = _probe_once(timeout_s=timeout_s)
+            probe_log.append(
+                {"t": started, "ok": ok, "timeout_s": round(timeout_s, 1)}
+            )
+            if ok:
                 probe_ok.set()
                 return
             stop.wait(10)
@@ -242,8 +270,40 @@ def main() -> None:
             tpu_line = json.load(f)
 
     if tpu_line is not None and tpu_line.get("backend") == "tpu":
+        tpu_line["probe_log"] = probe_log
         print(json.dumps(tpu_line))
         return
+
+    # --- cached measurement from earlier in the round (recorded the
+    # moment the chip was seen up, outside the driver window); bounded
+    # by file mtime so a stale cache from a PREVIOUS round is never
+    # passed off as this round's measurement
+    max_age_s = float(os.environ.get("BLAZE_BENCH_CACHE_MAX_AGE_H", "14")) * 3600
+    if os.path.exists(CACHED_RESULT_PATH):
+        cached = None
+        age_s = None
+        try:
+            age_s = time.time() - os.path.getmtime(CACHED_RESULT_PATH)
+            if age_s <= max_age_s:
+                with open(CACHED_RESULT_PATH) as f:
+                    cached = json.load(f)
+        except Exception:  # noqa: BLE001 — a torn cache must not kill the line
+            cached = None
+        if cached is not None and cached.get("backend") == "tpu":
+            cached["cached"] = True
+            cached["cache_age_s"] = round(age_s, 1)
+            cached["probe_log"] = probe_log
+            cached["note"] = (
+                f"measured {round(age_s / 3600, 1)}h ago (within this round) "
+                "when the chip lease was live; driver-window probes: "
+                + (
+                    "none succeeded"
+                    if not probe_ok.is_set()
+                    else "succeeded but fresh measurement missed the deadline"
+                )
+            )
+            print(json.dumps(cached))
+            return
 
     # fall back to the CPU child's line (never killed: it holds no chip
     # and should long be done; bounded wait for safety)
@@ -265,6 +325,7 @@ def main() -> None:
         result["note"] = "tpu probe ok but measurement missed the deadline"
     else:
         result["note"] = "tpu_unavailable: all probes failed (wedged chip lease?)"
+    result["probe_log"] = probe_log
     print(json.dumps(result))
 
 

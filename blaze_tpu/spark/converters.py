@@ -142,6 +142,40 @@ def _join_type(node: SparkNode) -> JoinType:
     raise UnsupportedSparkExec(f"join type {s!r}")
 
 
+def _existence_name(node: SparkNode) -> Optional[str]:
+    """``#id`` of the exists attribute an ``ExistenceJoin(exists)``
+    appends — catalyst serializes the join type as a product object
+    carrying the attribute (``plans/joinTypes.scala``); downstream
+    expressions reference it by that exprId."""
+    v = node.fields.get("joinType")
+    if isinstance(v, dict) and v.get("exists") is not None:
+        try:
+            a = _parse_sub(v["exists"])
+        except Exception:
+            return None
+        eid = expr_id(a.fields.get("exprId"))
+        if eid is not None:
+            return f"#{eid}"
+    return None
+
+
+def _wrap_existence(out: ExecNode, node: SparkNode, jt: JoinType) -> ExecNode:
+    """Rename the appended existence column (engine default
+    ``exists#0``) to the catalyst exprId name so downstream filters
+    resolve it."""
+    if jt != JoinType.EXISTENCE:
+        return out
+    name = _existence_name(node)
+    if name is None:
+        # without the exprId, downstream references to the exists flag
+        # cannot resolve — fall back via the strategy seam rather than
+        # emit a plan that fails at execution
+        raise UnsupportedSparkExec("ExistenceJoin without exists attribute")
+    names = [f.name for f in out.schema.fields]
+    names[-1] = name
+    return RenameColumnsExec(out, names)
+
+
 def _sort_fields(orders: Sequence[SparkNode]) -> List[SortField]:
     out = []
     for o in orders:
@@ -421,7 +455,7 @@ def _convert_bhj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
         out = BroadcastJoinExec(left, right, lkeys, rkeys, jt, build_is_left=True)
     else:
         out = BroadcastJoinExec(right, left, rkeys, lkeys, jt, build_is_left=False)
-    return _wrap_condition(out, cond_e, jt)
+    return _wrap_condition(_wrap_existence(out, node, jt), cond_e, jt)
 
 
 def _convert_shj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
@@ -432,14 +466,14 @@ def _convert_shj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
         out = HashJoinExec(left, right, lkeys, rkeys, jt, build_is_left=True)
     else:
         out = HashJoinExec(right, left, rkeys, lkeys, jt, build_is_left=False)
-    return _wrap_condition(out, cond_e, jt)
+    return _wrap_condition(_wrap_existence(out, node, jt), cond_e, jt)
 
 
 def _convert_smj(node: SparkNode, ctx: ConversionContext) -> ExecNode:
     left, right, lkeys, rkeys, cond_e = _join_sides(node, ctx)
     jt = _join_type(node)
     out = SortMergeJoinExec(left, right, lkeys, rkeys, jt)
-    return _wrap_condition(out, cond_e, jt)
+    return _wrap_condition(_wrap_existence(out, node, jt), cond_e, jt)
 
 
 def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
@@ -559,9 +593,12 @@ def _window_frame(wexpr: SparkNode):
         return False, None, None
 
     def bound(b: SparkNode):
-        if b.name in ("UnboundedPreceding", "UnboundedFollowing"):
+        # catalyst case objects serialize with a trailing "$"
+        # (``UnboundedPreceding$``) — accept both spellings
+        nm = b.name.rstrip("$")
+        if nm in ("UnboundedPreceding", "UnboundedFollowing"):
             return "unbounded"
-        if b.name == "CurrentRow":
+        if nm == "CurrentRow":
             return 0
         # only INTEGRAL literal bounds convert: decimal-string values
         # ("10.50") and interval bounds would either crash int() or be
@@ -661,6 +698,20 @@ def _convert_expand(node: SparkNode, ctx: ConversionContext) -> ExecNode:
     projections = []
     for proj in raw:
         projections.append([convert_expr(_parse_sub(e)) for e in proj])
+    # Spark's rollup/cube projections null out grouped-away columns
+    # with bare untyped nulls (StringType has no width, DecimalType may
+    # be widened); the engine's ExpandExec requires every projection to
+    # agree on physical dtypes, so retype null literals to the column
+    # type the first (full) projection implies.
+    from ..exprs.compile import infer_dtype
+    from ..exprs.ir import Lit as _Lit
+
+    if projections:
+        base_types = [infer_dtype(e, child.schema) for e in projections[0]]
+        for proj in projections[1:]:
+            for i, e in enumerate(proj):
+                if isinstance(e, _Lit) and e.value is None and i < len(base_types):
+                    proj[i] = _Lit(None, base_types[i])
     names = []
     for a in node.expr_list("output"):
         eid = expr_id(a.fields.get("exprId"))

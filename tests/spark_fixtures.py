@@ -123,6 +123,66 @@ def max_(child: dict) -> dict:
     return T(A + "Max", [child])
 
 
+# ------------------------------------------------------------- windows
+
+def frame_bound(kind) -> dict:
+    """Frame bound: ``"up"``/``"uf"``/``"cr"`` case objects (with the
+    trailing ``$`` catalyst's ``getClass.getName`` emits) or an int
+    literal offset."""
+    if isinstance(kind, int):
+        return lit(kind, "integer")
+    cls = {"up": "UnboundedPreceding$", "uf": "UnboundedFollowing$",
+           "cr": "CurrentRow$"}[kind]
+    return T(X + cls)
+
+
+def window_frame(lower, upper, row: bool = True) -> dict:
+    return T(
+        X + "SpecifiedWindowFrame",
+        [frame_bound(lower), frame_bound(upper)],
+        frameType={"product-class": X + ("RowFrame$" if row else "RangeFrame$")},
+    )
+
+
+def window_spec(part: Sequence[dict], order: Sequence[dict], frame=None) -> dict:
+    ch = list(part) + list(order) + ([frame] if frame is not None else [])
+    return T(X + "WindowSpecDefinition", ch)
+
+
+def window_expr(fn: dict, spec: dict, name: str, i: int) -> dict:
+    return alias(T(X + "WindowExpression", [fn, spec]), name, i)
+
+
+def rank_fn(order: Sequence[dict] = ()) -> dict:
+    return T(X + "Rank", list(order))
+
+
+def row_number_fn() -> dict:
+    return T(X + "RowNumber")
+
+
+def lag_fn(child: dict, offset: int = 1) -> dict:
+    return T(X + "Lag", [child, lit(offset, "integer"), lit(None, "null")],
+             ignoreNulls=False)
+
+
+def lead_fn(child: dict, offset: int = 1) -> dict:
+    return T(X + "Lead", [child, lit(offset, "integer"), lit(None, "null")],
+             ignoreNulls=False)
+
+
+def window_agg(fn: dict) -> dict:
+    """Window aggregate: catalyst wraps the function in a Complete-mode
+    AggregateExpression inside the WindowExpression."""
+    return T(
+        A + "AggregateExpression",
+        [fn],
+        mode={"product-class": A + "Complete$"},
+        isDistinct=False,
+        resultId=eid(0),
+    )
+
+
 # ------------------------------------------------------------------ plans
 
 def scan(table: str, attrs: Sequence[dict]) -> dict:
@@ -283,6 +343,36 @@ def union(children: Sequence[dict]) -> dict:
 def wscg(child: dict) -> dict:
     """WholeStageCodegenExec wrapper (pass-through in conversion)."""
     return T(P + "WholeStageCodegenExec", [child], codegenStageId=1)
+
+
+def window(wexprs: Sequence[dict], part: Sequence[dict], order: Sequence[dict],
+           child: dict) -> dict:
+    return T(
+        P + "window.WindowExec",
+        [child],
+        windowExpression=[flatten(w) for w in wexprs],
+        partitionSpec=[flatten(p) for p in part],
+        orderSpec=[flatten(o) for o in order],
+    )
+
+
+def expand(projections: Sequence[Sequence[dict]], output: Sequence[dict],
+           child: dict) -> dict:
+    return T(
+        P + "ExpandExec",
+        [child],
+        projections=[[flatten(e) for e in proj] for proj in projections],
+        output=[flatten(a) for a in output],
+    )
+
+
+def existence_join_type(exists_attr: dict) -> dict:
+    """``ExistenceJoin(exists)`` as catalyst serializes it: a product
+    object carrying the appended bool attribute."""
+    return {
+        "product-class": "org.apache.spark.sql.catalyst.plans.ExistenceJoin",
+        "exists": flatten(exists_attr),
+    }
 
 
 def range_partitioning(orders: Sequence[dict], n: int) -> list:

@@ -1,0 +1,1006 @@
+"""TPC-DS differentials THROUGH the full Spark interception layer.
+
+Round-3 gap: 38 of 42 TPC-DS differentials executed hand-built
+ExecNode trees in-process, bypassing ``spark/converters.py`` and the
+TaskDefinition serde.  Here a representative slice — star joins
+(q42/q52), rollup/Expand (q27/q36), windows (q47/q89/q98), INTERSECT
+(q8/q38), correlated EXISTS (q10/q35) — is expressed as catalyst
+``toJSON`` physical-plan dumps, crosses strategy + expression
+conversion, runs via BOTH the in-process collect path and the stage
+scheduler (every task crossing TaskDefinition protobuf bytes), and is
+validated against the same independent numpy oracles the ExecNode
+suite uses: the shape of the reference's differential gate, which
+always runs full conversion (``tpcds-reusable.yml:83-143``).
+
+Plans are authored from the TPC-DS query text with the real catalyst
+encodings (Expand null-filled projections, WindowSpecDefinition +
+SpecifiedWindowFrame with ``$``-suffixed case objects, ExistenceJoin
+product objects carrying the exists attribute) — not emitted from the
+engine's own IR, so the loop stays open.
+"""
+
+import json
+
+import pytest
+
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.spark import BlazeSparkSession
+from blaze_tpu.tpcds import TPCDS_SCHEMAS
+from blaze_tpu.tpcds import oracle as O
+from blaze_tpu.tpcds.datagen import generate_all
+from blaze_tpu.tpch.datagen import table_to_batches
+
+import spark_fixtures as F
+from test_tpcds import (
+    _check_brand_report,
+    _check_class_share,
+    _check_rollup_margin,
+    _check_yoy,
+)
+
+pytestmark = pytest.mark.slow
+
+SCALE = 0.002
+N_PARTS = 2
+
+# stable exprId blocks per table (column order = TPCDS_SCHEMAS order)
+_DTYPES = {}
+_IDS = {}
+for _ti, (_t, _schema) in enumerate(TPCDS_SCHEMAS.items()):
+    for _i, _f in enumerate(_schema.fields):
+        _IDS[_f.name] = _ti * 40 + _i + 1
+        dt = _f.dtype
+        if dt.is_decimal:
+            _DTYPES[_f.name] = f"decimal({dt.precision},{dt.scale})"
+        elif dt.is_string:
+            _DTYPES[_f.name] = "string"
+        elif dt.kind.name == "DATE32":
+            _DTYPES[_f.name] = "date"
+        elif dt.kind.name == "INT32":
+            _DTYPES[_f.name] = "integer"
+        else:
+            _DTYPES[_f.name] = "long"
+
+
+def a(name: str) -> dict:
+    """AttributeReference for a base-table column."""
+    return F.attr(name, _IDS[name], _DTYPES[name])
+
+
+def ar(name: str, i: int, dtype: str = "long") -> dict:
+    return F.attr(name, i, dtype)
+
+
+def and_(*es):
+    out = es[0]
+    for e in es[1:]:
+        out = F.binop("And", out, e)
+    return out
+
+
+def or_(*es):
+    out = es[0]
+    for e in es[1:]:
+        out = F.binop("Or", out, e)
+    return out
+
+
+def in_(child, *vals, dtype="string"):
+    return F.T(F.X + "In", [child] + [F.lit(v, dtype) for v in vals])
+
+
+def ne(l, r):
+    return F.un("Not", F.binop("EqualTo", l, r))
+
+
+def i32(v):
+    return F.lit(v, "integer")
+
+
+def s(v):
+    return F.lit(v, "string")
+
+
+def two_stage(groupings, aggs_fns, child, n_parts=N_PARTS, result=None):
+    partial = F.hash_agg(
+        groupings,
+        [F.agg_expr(fn, "Partial", rid) for fn, rid in aggs_fns],
+        child,
+    )
+    part = (
+        F.hash_partitioning(groupings, n_parts)
+        if groupings
+        else F.single_partition()
+    )
+    ex = F.shuffle(part, partial)
+    return F.hash_agg(
+        groupings,
+        [F.agg_expr(fn, "Final", rid) for fn, rid in aggs_fns],
+        ex,
+        result=result,
+    )
+
+
+def distinct(groupings, child, n_parts=N_PARTS):
+    """Grouping-only two-stage aggregation (Spark's DISTINCT plan)."""
+    return two_stage(groupings, [], child, n_parts)
+
+
+def bhj_build_left(build, probe, bkeys, pkeys, jt="Inner"):
+    """BroadcastHashJoin with the (broadcast) build side on the left —
+    the common dimension-table shape."""
+    return F.bhj(bkeys, pkeys, jt, "left", F.broadcast(build), probe)
+
+
+def semi_right(probe, build, pkeys, bkeys, jt="LeftSemi"):
+    """probe LEFT SEMI JOIN broadcast(build) — output = probe columns."""
+    return F.bhj(pkeys, bkeys, jt, "right", probe, F.broadcast(build))
+
+
+def existence_right(probe, build, pkeys, bkeys, exists_attr):
+    """probe ExistenceJoin broadcast(build): appends the exists flag."""
+    return F.bhj(
+        pkeys, bkeys, F.existence_join_type(exists_attr), "right",
+        probe, F.broadcast(build),
+    )
+
+
+# ----------------------------------------------------------------- fixtures
+
+@pytest.fixture(scope="module")
+def data():
+    return generate_all(SCALE)
+
+
+@pytest.fixture(scope="module")
+def sess(data):
+    sess = BlazeSparkSession(default_parallelism=N_PARTS)
+    for name in TPCDS_SCHEMAS:
+        sess.register_table(
+            name,
+            MemoryScanExec(
+                table_to_batches(data[name], TPCDS_SCHEMAS[name], N_PARTS,
+                                 batch_rows=4096),
+                TPCDS_SCHEMAS[name],
+            ),
+        )
+    return sess
+
+
+def _execute_both(sess, plan):
+    """In-process collect AND the stage scheduler (TaskDefinition
+    protobuf boundary + shuffle files) must agree."""
+    js = json.dumps(F.flatten(plan))
+    got = sess.execute(js)
+    got_sched = sess.execute_distributed(js)
+    rows = sorted(
+        zip(*got.values()), key=lambda r: tuple((v is None, v) for v in r)
+    ) if got else []
+    rows_sched = sorted(
+        zip(*got_sched.values()), key=lambda r: tuple((v is None, v) for v in r)
+    ) if got_sched else []
+    assert rows == rows_sched, "in-process vs scheduler mismatch"
+    return got
+
+
+# ------------------------------------------------------- star joins (q42/q52)
+
+def _brand_report_plan(*, year, moy, manager, order_year_first):
+    dt = F.project(
+        [a("d_date_sk"), a("d_year")],
+        F.filter_(
+            and_(F.binop("EqualTo", a("d_moy"), i32(moy)),
+                 F.binop("EqualTo", a("d_year"), i32(year))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")]),
+        ),
+    )
+    sales = F.scan(
+        "store_sales", [a("ss_sold_date_sk"), a("ss_item_sk"), a("ss_ext_sales_price")]
+    )
+    j1 = bhj_build_left(dt, sales, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    it = F.project(
+        [a("i_item_sk"), a("i_brand_id"), a("i_brand")],
+        F.filter_(
+            F.binop("EqualTo", a("i_manager_id"), i32(manager)),
+            F.scan("item", [a("i_item_sk"), a("i_brand_id"), a("i_brand"),
+                            a("i_manager_id")]),
+        ),
+    )
+    j2 = bhj_build_left(it, j1, [a("i_item_sk")], [a("ss_item_sk")])
+    agg = two_stage(
+        [a("d_year"), a("i_brand_id"), a("i_brand")],
+        [(F.sum_(a("ss_ext_sales_price")), 501)],
+        j2,
+    )
+    price = ar("ext_price", 501, "decimal(17,2)")
+    orders = (
+        [F.sort_order(a("d_year")), F.sort_order(price, asc=False),
+         F.sort_order(a("i_brand_id"))]
+        if order_year_first
+        else [F.sort_order(price, asc=False), F.sort_order(a("i_brand_id"))]
+    )
+    return F.take_ordered(
+        100, orders,
+        [F.alias(a("d_year"), "d_year", 510),
+         F.alias(a("i_brand_id"), "brand_id", 511),
+         F.alias(a("i_brand"), "brand", 512),
+         F.alias(price, "ext_price", 513)],
+        agg,
+    )
+
+
+def test_spark_q52(sess, data):
+    got = _execute_both(
+        sess, _brand_report_plan(year=2000, moy=11, manager=1, order_year_first=True)
+    )
+    _check_brand_report(got, O.oracle_q52(data), "ext_price")
+
+
+def test_spark_q55(sess, data):
+    got = _execute_both(
+        sess, _brand_report_plan(year=1999, moy=11, manager=28, order_year_first=False)
+    )
+    exp = O.oracle_q55(data)
+    rows = {
+        (y, bid, bname): v
+        for y, bid, bname, v in zip(got["d_year"], got["brand_id"],
+                                    got["brand"], got["ext_price"])
+    }
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+    assert got["ext_price"] == sorted(got["ext_price"], reverse=True)
+
+
+def test_spark_q42(sess, data):
+    dt = F.project(
+        [a("d_date_sk"), a("d_year")],
+        F.filter_(
+            and_(F.binop("EqualTo", a("d_moy"), i32(11)),
+                 F.binop("EqualTo", a("d_year"), i32(2000))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")]),
+        ),
+    )
+    sales = F.scan(
+        "store_sales", [a("ss_sold_date_sk"), a("ss_item_sk"), a("ss_ext_sales_price")]
+    )
+    j1 = bhj_build_left(dt, sales, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    it = F.project(
+        [a("i_item_sk"), a("i_category_id"), a("i_category")],
+        F.filter_(
+            F.binop("EqualTo", a("i_manager_id"), i32(1)),
+            F.scan("item", [a("i_item_sk"), a("i_category_id"), a("i_category"),
+                            a("i_manager_id")]),
+        ),
+    )
+    j2 = bhj_build_left(it, j1, [a("i_item_sk")], [a("ss_item_sk")])
+    agg = two_stage(
+        [a("d_year"), a("i_category_id"), a("i_category")],
+        [(F.sum_(a("ss_ext_sales_price")), 501)],
+        j2,
+    )
+    sum_agg = ar("sum_agg", 501, "decimal(17,2)")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(sum_agg, asc=False), F.sort_order(a("d_year")),
+         F.sort_order(a("i_category_id")), F.sort_order(a("i_category"))],
+        [F.alias(a("d_year"), "d_year", 510),
+         F.alias(a("i_category_id"), "category_id", 511),
+         F.alias(a("i_category"), "category", 512),
+         F.alias(sum_agg, "sum_agg", 513)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    _check_brand_report(got, O.oracle_q42(data), "sum_agg",
+                        id_col="category_id", name_col="category")
+    assert got["sum_agg"] == sorted(got["sum_agg"], reverse=True)
+
+
+# --------------------------------------------------- rollup / Expand (q27/q36)
+
+def test_spark_q27(sess, data):
+    cd = F.project(
+        [a("cd_demo_sk")],
+        F.filter_(
+            and_(F.binop("EqualTo", a("cd_gender"), s("M")),
+                 F.binop("EqualTo", a("cd_marital_status"), s("S")),
+                 F.binop("EqualTo", a("cd_education_status"), s("College"))),
+            F.scan("customer_demographics",
+                   [a("cd_demo_sk"), a("cd_gender"), a("cd_marital_status"),
+                    a("cd_education_status")]),
+        ),
+    )
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2002)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    st = F.project(
+        [a("s_store_sk"), a("s_state")],
+        F.filter_(in_(a("s_state"), "TN", "SD", "AL", "GA", "OH"),
+                  F.scan("store", [a("s_store_sk"), a("s_state")])),
+    )
+    sales = F.scan(
+        "store_sales",
+        [a("ss_sold_date_sk"), a("ss_item_sk"), a("ss_cdemo_sk"), a("ss_store_sk"),
+         a("ss_quantity"), a("ss_list_price"), a("ss_sales_price"),
+         a("ss_coupon_amt")],
+    )
+    j = bhj_build_left(cd, sales, [a("cd_demo_sk")], [a("ss_cdemo_sk")])
+    j = bhj_build_left(dt, j, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = bhj_build_left(st, j, [a("s_store_sk")], [a("ss_store_sk")])
+    it = F.scan("item", [a("i_item_sk"), a("i_item_id")])
+    j = bhj_build_left(it, j, [a("i_item_sk")], [a("ss_item_sk")])
+
+    # ROLLUP(i_item_id, s_state): Expand with null-filled projections
+    # and fresh output ids for the rollup dims + grouping id
+    vals = [a("ss_quantity"), a("ss_list_price"), a("ss_coupon_amt"),
+            a("ss_sales_price")]
+    null_s = F.lit(None, "string")
+    exp_item = ar("i_item_id", 520, "string")
+    exp_state = ar("s_state", 521, "string")
+    exp_gid = ar("spark_grouping_id", 522, "integer")
+    expand = F.expand(
+        [
+            vals + [a("i_item_id"), a("s_state"), F.lit(0, "integer")],
+            vals + [a("i_item_id"), null_s, F.lit(1, "integer")],
+            vals + [null_s, null_s, F.lit(3, "integer")],
+        ],
+        vals + [exp_item, exp_state, exp_gid],
+        j,
+    )
+    agg = two_stage(
+        [exp_item, exp_state, exp_gid],
+        [(F.avg(a("ss_quantity")), 501), (F.avg(a("ss_list_price")), 502),
+         (F.avg(a("ss_coupon_amt")), 503), (F.avg(a("ss_sales_price")), 504)],
+        expand,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(exp_item), F.sort_order(exp_state)],
+        [F.alias(exp_item, "i_item_id", 530),
+         F.alias(exp_state, "s_state", 531),
+         F.alias(exp_gid, "g_id", 532),
+         F.alias(ar("agg1", 501, "double"), "agg1", 533),
+         F.alias(ar("agg2", 502, "decimal(11,6)"), "agg2", 534),
+         F.alias(ar("agg3", 503, "decimal(11,6)"), "agg3", 535),
+         F.alias(ar("agg4", 504, "decimal(11,6)"), "agg4", 536)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q27(data)
+    assert got["i_item_id"], "q27 returned no rows"
+    for iid, state, gid, a1, a2, a3, a4 in zip(
+        got["i_item_id"], got["s_state"], got["g_id"],
+        got["agg1"], got["agg2"], got["agg3"], got["agg4"],
+    ):
+        key = (iid, state, gid)
+        assert key in exp, key
+        ea1, ea2, ea3, ea4 = exp[key]
+        assert abs(a1 - ea1) < 1e-9 and (a2, a3, a4) == (ea2, ea3, ea4), key
+    assert set(got["g_id"]) <= {0, 1, 3}
+
+
+def test_spark_q36(sess, data):
+    st = F.project(
+        [a("s_store_sk")],
+        F.filter_(in_(a("s_state"), "TN", "SD", "AL", "GA", "OH"),
+                  F.scan("store", [a("s_store_sk"), a("s_state")])),
+    )
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2001)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    it = F.scan("item", [a("i_item_sk"), a("i_class"), a("i_category")])
+    sales = F.scan(
+        "store_sales",
+        [a("ss_sold_date_sk"), a("ss_item_sk"), a("ss_store_sk"),
+         a("ss_ext_sales_price"), a("ss_net_profit")],
+    )
+    j = bhj_build_left(dt, sales, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = bhj_build_left(st, j, [a("s_store_sk")], [a("ss_store_sk")])
+    j = bhj_build_left(it, j, [a("i_item_sk")], [a("ss_item_sk")])
+
+    null_s = F.lit(None, "string")
+    exp_cat = ar("i_category", 520, "string")
+    exp_cls = ar("i_class", 521, "string")
+    exp_gid = ar("spark_grouping_id", 522, "integer")
+    vals = [a("ss_net_profit"), a("ss_ext_sales_price")]
+    expand = F.expand(
+        [
+            vals + [a("i_category"), a("i_class"), F.lit(0, "integer")],
+            vals + [a("i_category"), null_s, F.lit(1, "integer")],
+            vals + [null_s, null_s, F.lit(3, "integer")],
+        ],
+        vals + [exp_cat, exp_cls, exp_gid],
+        j,
+    )
+    agg = two_stage(
+        [exp_cat, exp_cls, exp_gid],
+        [(F.sum_(a("ss_net_profit")), 501),
+         (F.sum_(a("ss_ext_sales_price")), 502)],
+        expand,
+    )
+    # lochierarchy + gross-margin measure
+    loch = F.T(
+        F.X + "CaseWhen",
+        [F.binop("EqualTo", exp_gid, i32(0)), i32(0),
+         F.binop("EqualTo", exp_gid, i32(1)), i32(1),
+         i32(2)],
+    )
+    num = ar("num_sum", 501, "decimal(17,2)")
+    den = ar("den_sum", 502, "decimal(17,2)")
+    measure = F.binop("Divide", F.cast(num, "double"), F.cast(den, "double"))
+    proj = F.project(
+        [F.alias(exp_cat, "i_category", 540), F.alias(exp_cls, "i_class", 541),
+         F.alias(loch, "lochierarchy", 542), F.alias(measure, "measure", 543)],
+        agg,
+    )
+    cat_o = ar("i_category", 540, "string")
+    cls_o = ar("i_class", 541, "string")
+    loch_o = ar("lochierarchy", 542, "integer")
+    meas_o = ar("measure", 543, "double")
+    parent = F.T(F.X + "CaseWhen",
+                 [F.binop("EqualTo", loch_o, i32(0)), cat_o])
+    single = F.shuffle(F.single_partition(), proj)
+    pre = F.sort(
+        [F.sort_order(loch_o), F.sort_order(parent), F.sort_order(meas_o)],
+        single,
+    )
+    w = F.window(
+        [F.window_expr(F.rank_fn([meas_o]),
+                       F.window_spec([loch_o, parent], [F.sort_order(meas_o)]),
+                       "rank_within_parent", 550)],
+        [loch_o, parent],
+        [F.sort_order(meas_o)],
+        pre,
+    )
+    rank_o = ar("rank_within_parent", 550, "integer")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(loch_o, asc=False), F.sort_order(parent),
+         F.sort_order(rank_o)],
+        [F.alias(cat_o, "i_category", 560), F.alias(cls_o, "i_class", 561),
+         F.alias(loch_o, "lochierarchy", 562), F.alias(meas_o, "measure", 563),
+         F.alias(rank_o, "rank_within_parent", 564)],
+        w,
+    )
+    got = _execute_both(sess, plan)
+    _check_rollup_margin(got, O.oracle_q36(data))
+
+
+# -------------------------------------------------- windows (q47/q89/q98)
+
+def test_spark_q47(sess, data):
+    year = 1999
+    dt = F.project(
+        [a("d_date_sk"), a("d_year"), a("d_moy")],
+        F.filter_(
+            or_(
+                F.binop("EqualTo", a("d_year"), i32(year)),
+                and_(F.binop("EqualTo", a("d_year"), i32(year - 1)),
+                     F.binop("EqualTo", a("d_moy"), i32(12))),
+                and_(F.binop("EqualTo", a("d_year"), i32(year + 1)),
+                     F.binop("EqualTo", a("d_moy"), i32(1))),
+            ),
+            F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")]),
+        ),
+    )
+    st = F.scan("store", [a("s_store_sk"), a("s_store_name"), a("s_company_name")])
+    it = F.scan("item", [a("i_item_sk"), a("i_brand"), a("i_category")])
+    sales = F.scan(
+        "store_sales",
+        [a("ss_sold_date_sk"), a("ss_item_sk"), a("ss_store_sk"),
+         a("ss_sales_price")],
+    )
+    j = bhj_build_left(dt, sales, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = bhj_build_left(st, j, [a("s_store_sk")], [a("ss_store_sk")])
+    j = bhj_build_left(it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    part = [a("i_category"), a("i_brand"), a("s_store_name"), a("s_company_name")]
+    agg = two_stage(
+        part + [a("d_year"), a("d_moy")],
+        [(F.sum_(a("ss_sales_price")), 501)],
+        j,
+    )
+    sum_sales = ar("sum_sales", 501, "decimal(17,2)")
+    single = F.shuffle(F.single_partition(), agg)
+    pre = F.sort(
+        [F.sort_order(p) for p in part]
+        + [F.sort_order(a("d_year")), F.sort_order(a("d_moy"))],
+        single,
+    )
+    # avg within (entity, year): whole-partition frame
+    w_avg = F.window(
+        [F.window_expr(
+            F.window_agg(F.avg(sum_sales)),
+            F.window_spec(part + [a("d_year")], [],
+                          F.window_frame("up", "uf", row=True)),
+            "avg_monthly_sales", 502)],
+        part + [a("d_year")],
+        [],
+        pre,
+    )
+    # lag/lead across the month sequence (year NOT in the partition)
+    orders = [F.sort_order(a("d_year")), F.sort_order(a("d_moy"))]
+    w = F.window(
+        [F.window_expr(F.lag_fn(sum_sales), F.window_spec(part, orders), "psum", 503),
+         F.window_expr(F.lead_fn(sum_sales), F.window_spec(part, orders), "nsum", 504)],
+        part,
+        orders,
+        w_avg,
+    )
+    avg_m = ar("avg_monthly_sales", 502, "decimal(11,6)")
+    sum_f = F.cast(sum_sales, "double")
+    avg_f = F.cast(avg_m, "double")
+    filt = F.filter_(
+        and_(
+            F.binop("EqualTo", a("d_year"), i32(year)),
+            F.binop("GreaterThan", avg_m, i32(0)),
+            F.binop(
+                "GreaterThan",
+                F.binop("Divide", F.un("Abs", F.binop("Subtract", sum_f, avg_f)),
+                        avg_f),
+                F.lit(0.1, "double"),
+            ),
+        ),
+        w,
+    )
+    proj = F.project(
+        [a("i_category"), a("i_brand"), a("s_store_name"), a("s_company_name"),
+         a("d_year"), a("d_moy"), sum_sales, avg_m,
+         ar("psum", 503, "decimal(17,2)"), ar("nsum", 504, "decimal(17,2)"),
+         F.alias(F.binop("Subtract", sum_f, avg_f), "delta", 510)],
+        filt,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(ar("delta", 510, "double")), F.sort_order(a("d_moy"))],
+        [F.alias(a("i_category"), "i_category", 520),
+         F.alias(a("i_brand"), "i_brand", 521),
+         F.alias(a("s_store_name"), "s_store_name", 522),
+         F.alias(a("s_company_name"), "s_company_name", 523),
+         F.alias(a("d_year"), "d_year", 524),
+         F.alias(a("d_moy"), "d_moy", 525),
+         F.alias(sum_sales, "sum_sales", 526),
+         F.alias(avg_m, "avg_monthly_sales", 527),
+         F.alias(ar("psum", 503, "decimal(17,2)"), "psum", 528),
+         F.alias(ar("nsum", 504, "decimal(17,2)"), "nsum", 529)],
+        proj,
+    )
+    got = _execute_both(sess, plan)
+    _check_yoy(got, O.oracle_q47(data), ("s_store_name", "s_company_name"))
+
+
+def test_spark_q89(sess, data):
+    it = F.project(
+        [a("i_item_sk"), a("i_category"), a("i_class"), a("i_brand")],
+        F.filter_(
+            or_(
+                and_(in_(a("i_category"), "Books", "Electronics", "Sports"),
+                     in_(a("i_class"), "accessories", "reference", "football")),
+                and_(in_(a("i_category"), "Men", "Jewelry", "Women"),
+                     in_(a("i_class"), "shirts", "birdal", "dresses")),
+            ),
+            F.scan("item", [a("i_item_sk"), a("i_class"), a("i_category"),
+                            a("i_brand")]),
+        ),
+    )
+    dt = F.project(
+        [a("d_date_sk"), a("d_moy")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(1999)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")])),
+    )
+    st = F.scan("store", [a("s_store_sk"), a("s_store_name"), a("s_company_name")])
+    sales = F.scan(
+        "store_sales",
+        [a("ss_sold_date_sk"), a("ss_item_sk"), a("ss_store_sk"),
+         a("ss_sales_price")],
+    )
+    j = bhj_build_left(it, sales, [a("i_item_sk")], [a("ss_item_sk")])
+    j = bhj_build_left(dt, j, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = bhj_build_left(st, j, [a("s_store_sk")], [a("ss_store_sk")])
+    agg = two_stage(
+        [a("i_category"), a("i_class"), a("i_brand"), a("s_store_name"),
+         a("s_company_name"), a("d_moy")],
+        [(F.sum_(a("ss_sales_price")), 501)],
+        j,
+    )
+    sum_sales = ar("sum_sales", 501, "decimal(17,2)")
+    part = [a("i_category"), a("i_brand"), a("s_store_name"), a("s_company_name")]
+    single = F.shuffle(F.single_partition(), agg)
+    pre = F.sort([F.sort_order(p) for p in part], single)
+    w = F.window(
+        [F.window_expr(
+            F.window_agg(F.avg(sum_sales)),
+            F.window_spec(part, [], F.window_frame("up", "uf", row=True)),
+            "avg_monthly_sales", 502)],
+        part,
+        [],
+        pre,
+    )
+    avg_m = ar("avg_monthly_sales", 502, "decimal(11,6)")
+    sum_f = F.cast(sum_sales, "double")
+    avg_f = F.cast(avg_m, "double")
+    ratio = F.T(
+        F.X + "CaseWhen",
+        [ne(avg_f, F.lit(0.0, "double")),
+         F.binop("Divide", F.un("Abs", F.binop("Subtract", sum_f, avg_f)), avg_f)],
+    )
+    filt = F.filter_(F.binop("GreaterThan", ratio, F.lit(0.1, "double")), w)
+    proj = F.project(
+        [a("i_category"), a("i_class"), a("i_brand"), a("s_store_name"),
+         a("s_company_name"), a("d_moy"), sum_sales, avg_m,
+         F.alias(F.binop("Subtract", sum_f, avg_f), "delta", 510)],
+        filt,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(ar("delta", 510, "double")),
+         F.sort_order(a("s_store_name"))],
+        [F.alias(a("i_category"), "i_category", 520),
+         F.alias(a("i_class"), "i_class", 521),
+         F.alias(a("i_brand"), "i_brand", 522),
+         F.alias(a("s_store_name"), "s_store_name", 523),
+         F.alias(a("s_company_name"), "s_company_name", 524),
+         F.alias(a("d_moy"), "d_moy", 525),
+         F.alias(sum_sales, "sum_sales", 526),
+         F.alias(avg_m, "avg_monthly_sales", 527)],
+        proj,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q89(data)
+    seen = set()
+    for cat, cls, brand, stn, co, moy, sm, avg in zip(
+        got["i_category"], got["i_class"], got["i_brand"], got["s_store_name"],
+        got["s_company_name"], got["d_moy"], got["sum_sales"],
+        got["avg_monthly_sales"],
+    ):
+        key = (cat, cls, brand, stn, co, moy)
+        assert key in exp, key
+        assert exp[key] == (sm, avg), key
+        seen.add(key)
+    if len(exp) <= 100:
+        assert seen == set(exp)
+
+
+def test_spark_q98(sess, data):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"), F.lit("1999-02-22", "date")),
+                 F.binop("LessThanOrEqual", a("d_date"), F.lit("1999-03-24", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    it = F.project(
+        [a("i_item_sk"), a("i_item_id"), a("i_item_desc"), a("i_category"),
+         a("i_class"), a("i_current_price")],
+        F.filter_(
+            in_(a("i_category"), "Sports", "Books", "Home"),
+            F.scan("item", [a("i_item_sk"), a("i_item_id"), a("i_item_desc"),
+                            a("i_class"), a("i_category"), a("i_current_price")]),
+        ),
+    )
+    sales = F.scan(
+        "store_sales",
+        [a("ss_sold_date_sk"), a("ss_item_sk"), a("ss_ext_sales_price")],
+    )
+    j = bhj_build_left(dt, sales, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = bhj_build_left(it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    agg = two_stage(
+        [a("i_item_id"), a("i_item_desc"), a("i_category"), a("i_class"),
+         a("i_current_price")],
+        [(F.sum_(a("ss_ext_sales_price")), 501)],
+        j,
+    )
+    itemrev = ar("itemrevenue", 501, "decimal(17,2)")
+    single = F.shuffle(F.single_partition(), agg)
+    pre = F.sort([F.sort_order(a("i_class"))], single)
+    w = F.window(
+        [F.window_expr(
+            F.window_agg(F.sum_(itemrev)),
+            F.window_spec([a("i_class")], [], F.window_frame("up", "uf", row=True)),
+            "class_revenue", 502)],
+        [a("i_class")],
+        [],
+        pre,
+    )
+    class_rev = ar("class_revenue", 502, "decimal(27,2)")
+    ratio = F.binop(
+        "Divide",
+        F.binop("Multiply", F.cast(itemrev, "double"), F.lit(100.0, "double")),
+        F.cast(class_rev, "double"),
+    )
+    proj = F.project(
+        [a("i_item_id"), a("i_item_desc"), a("i_category"), a("i_class"),
+         a("i_current_price"), itemrev,
+         F.alias(ratio, "revenueratio", 510)],
+        w,
+    )
+    ratio_o = ar("revenueratio", 510, "double")
+    sorted_ = F.sort(
+        [F.sort_order(a("i_category")), F.sort_order(a("i_class")),
+         F.sort_order(a("i_item_id")), F.sort_order(a("i_item_desc")),
+         F.sort_order(ratio_o)],
+        F.shuffle(F.single_partition(), proj),
+    )
+    plan = F.project(
+        [F.alias(a("i_item_id"), "i_item_id", 520),
+         F.alias(a("i_item_desc"), "i_item_desc", 521),
+         F.alias(a("i_category"), "i_category", 522),
+         F.alias(a("i_class"), "i_class", 523),
+         F.alias(a("i_current_price"), "i_current_price", 524),
+         F.alias(itemrev, "itemrevenue", 525),
+         F.alias(ratio_o, "revenueratio", 526)],
+        sorted_,
+    )
+    got = _execute_both(sess, plan)
+    _check_class_share(got, O.oracle_q98(data))
+
+
+# ------------------------------------------------ INTERSECT family (q8/q38)
+
+def test_spark_q38(sess, data):
+    def channel(sales, date_col, cust_col):
+        dt = F.project(
+            [a("d_date_sk"), a("d_date")],
+            F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                      F.scan("date_dim", [a("d_date_sk"), a("d_date"), a("d_year")])),
+        )
+        cust = F.scan(
+            "customer", [a("c_customer_sk"), a("c_first_name"), a("c_last_name")]
+        )
+        sl = F.scan(sales, [a(date_col), a(cust_col)])
+        j = bhj_build_left(dt, sl, [a("d_date_sk")], [a(date_col)])
+        j = bhj_build_left(cust, j, [a("c_customer_sk")], [a(cust_col)])
+        return distinct([a("c_last_name"), a("c_first_name"), a("d_date")], j)
+
+    ss = channel("store_sales", "ss_sold_date_sk", "ss_customer_sk")
+    cs = channel("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk")
+    ws = channel("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk")
+    keys = [a("c_last_name"), a("c_first_name"), a("d_date")]
+    inter = semi_right(ss, cs, keys, keys)
+    inter = semi_right(inter, ws, keys, keys)
+    plan = two_stage(
+        [], [(F.count(), 501)], inter,
+        result=[F.alias(ar("count(1)", 501, "long"), "cnt", 510)],
+    )
+    got = _execute_both(sess, plan)
+    assert got["cnt"] == [O.oracle_q38(data)]
+
+
+def test_spark_q8(sess, data):
+    from blaze_tpu.tpcds.queries import Q8_MIN_PREFERRED, Q8_ZIPS
+
+    def zip5(child):
+        return F.T(F.X + "Substring", [child, i32(1), i32(5)])
+
+    # A1: literal-list zips, DISTINCT
+    ca1 = F.scan("customer_address", [a("ca_address_sk"), a("ca_zip")])
+    a1 = distinct(
+        [ar("zip5", 601, "string")],
+        F.project(
+            [F.alias(zip5(a("ca_zip")), "zip5", 601)],
+            F.filter_(in_(zip5(a("ca_zip")), *Q8_ZIPS), ca1),
+        ),
+    )
+    # A2: zips with >= N preferred customers (HAVING over a count)
+    cust = F.project(
+        [a("c_current_addr_sk")],
+        F.filter_(F.binop("EqualTo", a("c_preferred_cust_flag"), s("Y")),
+                  F.scan("customer", [a("c_customer_sk"), a("c_current_addr_sk"),
+                                      a("c_preferred_cust_flag")])),
+    )
+    ca2 = F.scan("customer_address", [a("ca_address_sk"), a("ca_zip")])
+    cj = bhj_build_left(ca2, cust, [a("ca_address_sk")], [a("c_current_addr_sk")])
+    a2_agg = two_stage(
+        [ar("zip5", 602, "string")],
+        [(F.count(), 603)],
+        F.project([F.alias(zip5(a("ca_zip")), "zip5", 602)], cj),
+    )
+    a2 = F.project(
+        [ar("zip5", 602, "string")],
+        F.filter_(
+            F.binop("GreaterThanOrEqual", ar("cnt", 603, "long"),
+                    F.lit(Q8_MIN_PREFERRED, "long")),
+            a2_agg,
+        ),
+    )
+    inter = semi_right(a1, a2, [ar("zip5", 601, "string")],
+                       [ar("zip5", 602, "string")])
+    prefixes = distinct(
+        [ar("zip2", 604, "string")],
+        F.project(
+            [F.alias(F.T(F.X + "Substring",
+                         [ar("zip5", 601, "string"), i32(1), i32(2)]),
+                     "zip2", 604)],
+            inter,
+        ),
+    )
+    st = semi_right(
+        F.scan("store", [a("s_store_sk"), a("s_store_name"), a("s_zip")]),
+        prefixes,
+        [F.T(F.X + "Substring", [a("s_zip"), i32(1), i32(2)])],
+        [ar("zip2", 604, "string")],
+    )
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_year"), i32(1998)),
+                       F.binop("EqualTo", a("d_qoy"), i32(2))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_qoy")])),
+    )
+    sl = F.scan("store_sales",
+                [a("ss_sold_date_sk"), a("ss_store_sk"), a("ss_net_profit")])
+    j = bhj_build_left(dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = bhj_build_left(
+        F.project([a("s_store_sk"), a("s_store_name")], st), j,
+        [a("s_store_sk")], [a("ss_store_sk")],
+    )
+    agg = two_stage(
+        [a("s_store_name")],
+        [(F.sum_(a("ss_net_profit")), 605)],
+        j,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("s_store_name"))],
+        [F.alias(a("s_store_name"), "s_store_name", 610),
+         F.alias(ar("net_profit", 605, "decimal(17,2)"), "net_profit", 611)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q8(data, Q8_ZIPS, Q8_MIN_PREFERRED)
+    assert exp, "q8 oracle matched no stores (datagen too sparse)"
+    assert dict(zip(got["s_store_name"], got["net_profit"])) == exp
+    assert got["s_store_name"] == sorted(got["s_store_name"])
+
+
+# --------------------------------------- correlated EXISTS family (q10/q35)
+
+def _active_set_plan(sales, date_col, cust_col, out_id):
+    """DISTINCT customer sks of a channel in the (2002, moy 1-4) window."""
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(F.binop("EqualTo", a("d_year"), i32(2002)),
+                 F.binop("GreaterThanOrEqual", a("d_moy"), i32(1)),
+                 F.binop("LessThanOrEqual", a("d_moy"), i32(4))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")]),
+        ),
+    )
+    sl = F.scan(sales, [a(date_col), a(cust_col)])
+    j = bhj_build_left(dt, sl, [a("d_date_sk")], [a(date_col)])
+    return distinct(
+        [ar("cust_sk", out_id, "long")],
+        F.project([F.alias(a(cust_col), "cust_sk", out_id)], j),
+    )
+
+
+def _exists_or_channels_plan(cust, *, negate=False):
+    """cust + EXISTS(store) + (web OR catalog) existence flags — the
+    LEFT_SEMI + two ExistenceJoin shape Spark plans for correlated
+    EXISTS (catalyst appends the exists attrs carried in the join
+    type)."""
+    ss = _active_set_plan("store_sales", "ss_sold_date_sk", "ss_customer_sk", 601)
+    ws = _active_set_plan("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk", 602)
+    cs = _active_set_plan("catalog_sales", "cs_sold_date_sk", "cs_ship_customer_sk", 603)
+    ck = [a("c_customer_sk")]
+    j = semi_right(cust, ss, ck, [ar("cust_sk", 601, "long")])
+    ex_ws = F.attr("exists", 611, "boolean")
+    ex_cs = F.attr("exists", 612, "boolean")
+    j = existence_right(j, ws, ck, [ar("cust_sk", 602, "long")], ex_ws)
+    j = existence_right(j, cs, ck, [ar("cust_sk", 603, "long")], ex_cs)
+    if negate:
+        cond = and_(F.un("Not", ex_ws), F.un("Not", ex_cs))
+    else:
+        cond = or_(ex_ws, ex_cs)
+    return F.filter_(cond, j)
+
+
+def test_spark_q10(sess, data):
+    ca = F.project(
+        [a("ca_address_sk")],
+        F.filter_(
+            in_(a("ca_county"), "Williamson County", "Franklin Parish",
+                "Bronx County"),
+            F.scan("customer_address", [a("ca_address_sk"), a("ca_county")]),
+        ),
+    )
+    cust = F.scan(
+        "customer",
+        [a("c_customer_sk"), a("c_current_addr_sk"), a("c_current_cdemo_sk")],
+    )
+    cust = semi_right(cust, ca, [a("c_current_addr_sk")], [a("ca_address_sk")])
+    act = _exists_or_channels_plan(cust)
+    cd = F.scan(
+        "customer_demographics",
+        [a("cd_demo_sk"), a("cd_gender"), a("cd_marital_status"),
+         a("cd_education_status"), a("cd_purchase_estimate"),
+         a("cd_credit_rating"), a("cd_dep_count"), a("cd_dep_employed_count"),
+         a("cd_dep_college_count")],
+    )
+    j = bhj_build_left(cd, act, [a("cd_demo_sk")], [a("c_current_cdemo_sk")])
+    group_cols = ["cd_gender", "cd_marital_status", "cd_education_status",
+                  "cd_purchase_estimate", "cd_credit_rating", "cd_dep_count",
+                  "cd_dep_employed_count", "cd_dep_college_count"]
+    agg = two_stage(
+        [a(c) for c in group_cols],
+        [(F.count(), 620)],
+        j,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a(c)) for c in group_cols],
+        [F.alias(a(c), c, 630 + i) for i, c in enumerate(group_cols)]
+        + [F.alias(ar("cnt", 620, "long"), "cnt", 640)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q10(data)
+    keys = list(zip(got["cd_gender"], got["cd_marital_status"],
+                    got["cd_education_status"], got["cd_purchase_estimate"],
+                    got["cd_credit_rating"], got["cd_dep_count"],
+                    got["cd_dep_employed_count"], got["cd_dep_college_count"]))
+    assert keys and len(set(keys)) == len(keys)
+    for k, c in zip(keys, got["cnt"]):
+        assert exp.get(k) == c, k
+    assert len(keys) == min(len(exp), 100)
+    assert keys == sorted(keys)
+
+
+def test_spark_q35(sess, data):
+    ca = F.scan("customer_address", [a("ca_address_sk"), a("ca_state")])
+    cust = F.scan(
+        "customer",
+        [a("c_customer_sk"), a("c_current_addr_sk"), a("c_current_cdemo_sk")],
+    )
+    cust = bhj_build_left(ca, cust, [a("ca_address_sk")], [a("c_current_addr_sk")])
+    act = _exists_or_channels_plan(cust)
+    cd = F.scan(
+        "customer_demographics",
+        [a("cd_demo_sk"), a("cd_gender"), a("cd_marital_status"),
+         a("cd_dep_count"), a("cd_dep_employed_count"), a("cd_dep_college_count")],
+    )
+    j = bhj_build_left(cd, act, [a("cd_demo_sk")], [a("c_current_cdemo_sk")])
+    group_cols = ["ca_state", "cd_gender", "cd_marital_status", "cd_dep_count",
+                  "cd_dep_employed_count", "cd_dep_college_count"]
+    aggs = [(F.count(), 650)]
+    rid = 651
+    dep_cols = ("cd_dep_count", "cd_dep_employed_count", "cd_dep_college_count")
+    for c in dep_cols:
+        aggs += [(F.avg(a(c)), rid), (F.max_(a(c)), rid + 1), (F.sum_(a(c)), rid + 2)]
+        rid += 3
+    agg = two_stage([a(c) for c in group_cols], aggs, j)
+    out_aliases = [F.alias(a(c), c, 700 + i) for i, c in enumerate(group_cols)]
+    out_aliases.append(F.alias(ar("cnt1", 650, "long"), "cnt1", 710))
+    rid = 651
+    for i in range(1, 4):
+        out_aliases += [
+            F.alias(ar(f"avg{i}", rid, "double"), f"avg{i}", 710 + 3 * i - 2),
+            F.alias(ar(f"max{i}", rid + 1, "integer"), f"max{i}", 710 + 3 * i - 1),
+            F.alias(ar(f"sum{i}", rid + 2, "long"), f"sum{i}", 710 + 3 * i),
+        ]
+        rid += 3
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a(c)) for c in group_cols],
+        out_aliases,
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q35(data)
+    keys = list(zip(got["ca_state"], got["cd_gender"], got["cd_marital_status"],
+                    got["cd_dep_count"], got["cd_dep_employed_count"],
+                    got["cd_dep_college_count"]))
+    assert keys and len(set(keys)) == len(keys)
+    for i, k in enumerate(keys):
+        assert k in exp, k
+        e = exp[k]
+        assert got["cnt1"][i] == e[0], k
+        for j_ in range(3):
+            assert abs(got[f"avg{j_+1}"][i] - e[1 + 3 * j_]) < 1e-9, k
+            assert got[f"max{j_+1}"][i] == e[2 + 3 * j_], k
+            assert got[f"sum{j_+1}"][i] == e[3 + 3 * j_], k
+    if len(exp) <= 100:
+        assert set(keys) == set(exp)
