@@ -69,9 +69,16 @@ CACHED_RESULT_PATH = os.path.join(
 )
 
 
-def _measure(scale_q6: float, scale_q1: float, on_tpu: bool) -> dict:
+def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
+             partial_sink=None) -> dict:
     """Run q06 + q01 through the engine on the already-initialized
-    backend; returns the result dict (no printing)."""
+    backend; returns the result dict (no printing).
+
+    ``partial_sink(dict)``: called with the q06-only result BEFORE q01
+    starts — the remote-compile tunnel can drop mid-run (round-4
+    postmortem: q06 measured fine, then q01's fresh compile died with
+    'Unexpected EOF' and the whole measurement was lost), so each
+    query's numbers are persisted the moment they exist."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -129,28 +136,31 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool) -> dict:
     dt6 = run_query(q6, parts6, schema6)
     del parts6
 
-    q1_cols = ("l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
-               "l_discount", "l_tax", "l_shipdate")
-    parts1, schema1, rows1 = stage(q1_cols, scale_q1)
-    dt1 = run_query(q1, parts1, schema1)
-
     r6 = rows6 / dt6
-    r1 = rows1 / dt1
     # bytes actually touched by the q06 pipeline per row (5 referenced
     # columns + validity) — lets bandwidth be judged vs rows/s
-    return {
+    result = {
         "metric": "tpch_q06_rows_per_sec_per_chip",
         "value": round(r6, 1),
         "unit": "rows/s",
         "vs_baseline": round(r6 / BLAZE_Q06_ROWS_PER_SEC_PER_NODE, 3),
         "bytes_per_sec": round(r6 * (4 + 8 + 8 + 8 + 4), 1),
-        "q01_rows_per_sec": round(r1, 1),
-        "q01_vs_baseline": round(r1 / BLAZE_Q01_ROWS_PER_SEC_PER_NODE, 3),
         "scale_q06": scale_q6,
         "scale_q01": scale_q1,
         "iterations": 3,
         "backend": "tpu" if on_tpu else "cpu",
     }
+    if partial_sink is not None:
+        partial_sink(dict(result))
+
+    q1_cols = ("l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+               "l_discount", "l_tax", "l_shipdate")
+    parts1, schema1, rows1 = stage(q1_cols, scale_q1)
+    dt1 = run_query(q1, parts1, schema1)
+    r1 = rows1 / dt1
+    result["q01_rows_per_sec"] = round(r1, 1)
+    result["q01_vs_baseline"] = round(r1 / BLAZE_Q01_ROWS_PER_SEC_PER_NODE, 3)
+    return result
 
 
 def _is_tpu_backend() -> bool:
@@ -185,22 +195,88 @@ def _cpu_child() -> None:
 
 def _tpu_child(out_path: str) -> None:
     # init the real backend in-process (only launched after a probe
-    # succeeded); write the result file atomically
-    result = _measure(SCALE_Q6, SCALE_Q1, on_tpu=_is_tpu_backend())
-    result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    tmp = out_path + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(json.dumps(result))
-    os.replace(tmp, out_path)
-    # also refresh the round-level cache (unless we ARE the cache run)
-    if os.path.abspath(out_path) != CACHED_RESULT_PATH and result.get("backend") == "tpu":
-        with open(CACHED_RESULT_PATH + ".tmp", "w") as f:
+    # succeeded); write the result file atomically.  The q06-only
+    # partial is published IMMEDIATELY (tunnel drops mid-run lose the
+    # rest, not what's already measured); a prior cached q01 number is
+    # merged into a q06-only result rather than dropped.
+    def publish(result: dict) -> None:
+        result = dict(result)
+        result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        if "q01_rows_per_sec" not in result and os.path.exists(CACHED_RESULT_PATH):
+            try:
+                with open(CACHED_RESULT_PATH) as f:
+                    prev = json.load(f)
+                if prev.get("q01_rows_per_sec") is not None:
+                    result["q01_rows_per_sec"] = prev["q01_rows_per_sec"]
+                    result["q01_vs_baseline"] = prev["q01_vs_baseline"]
+                    result["q01_measured_at"] = prev.get(
+                        "q01_measured_at", prev.get("measured_at"))
+            except Exception:  # noqa: BLE001 — torn cache never kills a publish
+                pass
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
             f.write(json.dumps(result))
-        os.replace(CACHED_RESULT_PATH + ".tmp", CACHED_RESULT_PATH)
+        os.replace(tmp, out_path)
+        if os.path.abspath(out_path) != CACHED_RESULT_PATH and result.get("backend") == "tpu":
+            with open(CACHED_RESULT_PATH + ".tmp", "w") as f:
+                f.write(json.dumps(result))
+            os.replace(CACHED_RESULT_PATH + ".tmp", CACHED_RESULT_PATH)
+
+    publish(_measure(SCALE_Q6, SCALE_Q1, on_tpu=_is_tpu_backend(),
+                     partial_sink=publish))
 
 
 def _smoke(scale: float) -> None:
     print(json.dumps(_measure(scale, scale, on_tpu=_is_tpu_backend())))
+
+
+def _watchdog() -> None:
+    """Round-long babysitter (VERDICT r03 item 1): probe the chip in
+    expendable subprocesses for the WHOLE round, and the moment a
+    probe lands, run the measurement child; keep going until the
+    cached result carries both q06 and q01 on the tpu backend.  Every
+    attempt is appended to .bench_probe_log.jsonl so a wedged lease is
+    provable from the artifact."""
+    log_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_probe_log.jsonl"
+    )
+    deadline = time.time() + float(os.environ.get("BLAZE_WATCHDOG_HOURS", "11")) * 3600
+
+    started = time.time()
+
+    def done() -> bool:
+        # a complete cache counts only if written SINCE this watchdog
+        # started (a previous round's cache must not satisfy it)
+        try:
+            if os.path.getmtime(CACHED_RESULT_PATH) < started - 60:
+                return False
+            with open(CACHED_RESULT_PATH) as f:
+                c = json.load(f)
+            return c.get("backend") == "tpu" and c.get("q01_rows_per_sec") is not None
+        except Exception:  # noqa: BLE001
+            return False
+
+    def note(event: str, **kw) -> None:
+        with open(log_path, "a") as f:
+            f.write(json.dumps(
+                {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 "event": event, **kw}) + "\n")
+
+    while time.time() < deadline and not done():
+        ok = _probe_once(timeout_s=75)
+        note("probe", ok=ok)
+        if not ok:
+            time.sleep(120)
+            continue
+        rc = subprocess.call(
+            [sys.executable, os.path.abspath(__file__), "--tpu-child",
+             CACHED_RESULT_PATH],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        note("measure", rc=rc, complete=done())
+        if not done():
+            time.sleep(60)
+    note("exit", complete=done())
 
 
 def main() -> None:
@@ -257,12 +333,37 @@ def main() -> None:
                 # killing a chip-holding process wedges the lease for hours
             )
         if os.path.exists(tpu_result_path):
-            break
-        if tpu_child is not None and tpu_child.poll() not in (None, 0):
+            # the child publishes a q06-only PARTIAL first; keep
+            # waiting for the q01 half while the child is alive
+            try:
+                with open(tpu_result_path) as f:
+                    cur = json.load(f)
+            except Exception:  # noqa: BLE001 — mid-replace read
+                cur = None
+            if cur is not None and (
+                cur.get("q01_rows_per_sec") is not None
+                or tpu_child is None
+                or tpu_child.poll() is not None
+            ):
+                break
+        elif tpu_child is not None and tpu_child.poll() not in (None, 0):
             print(f"# bench: TPU child died rc={tpu_child.returncode}", file=sys.stderr)
             break
         time.sleep(2)
     stop.set()
+
+    # round-long watchdog history (bench.py --watchdog appends here):
+    # makes a wedged lease PROVABLE from the emitted artifact
+    wd_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_probe_log.jsonl"
+    )
+    wd_log = []
+    if os.path.exists(wd_path):
+        try:
+            with open(wd_path) as f:
+                wd_log = [json.loads(l) for l in f if l.strip()][-60:]
+        except Exception:  # noqa: BLE001
+            wd_log = []
 
     tpu_line = None
     if os.path.exists(tpu_result_path):
@@ -271,6 +372,7 @@ def main() -> None:
 
     if tpu_line is not None and tpu_line.get("backend") == "tpu":
         tpu_line["probe_log"] = probe_log
+        tpu_line["watchdog_log"] = wd_log
         print(json.dumps(tpu_line))
         return
 
@@ -293,6 +395,7 @@ def main() -> None:
             cached["cached"] = True
             cached["cache_age_s"] = round(age_s, 1)
             cached["probe_log"] = probe_log
+            cached["watchdog_log"] = wd_log
             cached["note"] = (
                 f"measured {round(age_s / 3600, 1)}h ago (within this round) "
                 "when the chip lease was live; driver-window probes: "
@@ -326,6 +429,7 @@ def main() -> None:
     else:
         result["note"] = "tpu_unavailable: all probes failed (wedged chip lease?)"
     result["probe_log"] = probe_log
+    result["watchdog_log"] = wd_log
     print(json.dumps(result))
 
 
@@ -335,6 +439,8 @@ if __name__ == "__main__":
             _cpu_child()
         elif len(sys.argv) > 1 and sys.argv[1] == "--tpu-child":
             _tpu_child(sys.argv[2])
+        elif len(sys.argv) > 1 and sys.argv[1] == "--watchdog":
+            _watchdog()
         elif len(sys.argv) > 1:
             _smoke(float(sys.argv[1]))
         else:

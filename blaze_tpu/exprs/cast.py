@@ -64,9 +64,10 @@ def _string_scan(col: Column):
     ln = col.lengths.astype(jnp.int32)
     idx = jnp.arange(w, dtype=jnp.int32)
     in_range = idx[None, :] < ln[:, None]
-    is_space = (
-        (data == 32) | (data == 9) | (data == 10) | (data == 13)
-    ) & in_range
+    # UTF8String.trimAll strips EVERY ASCII control char <= 0x20 plus
+    # DEL (isISOControl covers 0x7F): "\x0c42\x7f" parses as 42 in
+    # Spark (0x80-0x9F are multi-byte in UTF-8, never a lone byte)
+    is_space = ((data <= 32) | (data == 127)) & in_range
     nonspace = in_range & ~is_space
     # trimmed [start, end] inclusive
     start = jnp.min(jnp.where(nonspace, idx[None, :], w), axis=1)

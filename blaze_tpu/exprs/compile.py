@@ -344,12 +344,34 @@ def _decimal_binop(op: str, l: Column, r: Column) -> Column:
             hi, lo = I.mul_pow10(hi, lo, shift)
         else:
             # fold the down-shift into the divisor (single rounding);
-            # divisors that overflow int64 null out (|q| < 1 anyway)
-            k = 10 ** (-shift)
-            fits_den = jnp.abs(den) <= (2**63 - 1) // k
-            validity = validity & fits_den
-            den = jnp.where(fits_den, den * jnp.int64(k), jnp.int64(1))
+            # folded divisors past int64 imply |quotient| <= 1: HALF_UP
+            # gives ±1 iff 2|num| >= |den|*10^k (int128 compare), else 0
+            k10 = -shift
+            if k10 <= 18:
+                k = 10**k10
+                fits_den = jnp.abs(den) <= (2**63 - 1) // k
+                den = jnp.where(fits_den, den * jnp.int64(k), jnp.int64(1))
+            else:
+                fits_den = jnp.zeros(den.shape, jnp.bool_)
+                den = jnp.ones_like(den)
+            if k10 <= 19:
+                # |den|*10^19 < 9.3e37 < 2^127: the int128 product is exact
+                dh, dl = I.abs128(*I.from_i64(rd.data))
+                dh, dl = I.mul_pow10(dh, dl, k10)
+                nh2, nl2 = I.abs128(*I.from_i64(ld.data))
+                nh2, nl2 = I.add(nh2, nl2, nh2, nl2)  # 2|num|
+                ge_half = (dh < nh2) | ((dh == nh2) & (dl <= nl2))
+            else:
+                # k >= 20: |den|*10^k >= 10^20 > max 2|num| ≈ 1.85e19
+                ge_half = jnp.zeros(den.shape, jnp.bool_)
+            sign_q = (ld.data < 0) ^ (rd.data < 0)
+            tiny = jnp.where(
+                ge_half, jnp.where(sign_q, jnp.int64(-1), jnp.int64(1)), jnp.int64(0)
+            )
         q, fits = I.div_round_half_up(hi, lo, den)
+        if shift < 0:
+            q = jnp.where(fits_den, q, tiny)
+            fits = fits | ~fits_den
         validity = validity & fits
         return Column(out_t, q, decimal_overflow_null(q, validity, out_t.precision))
     if op == "%":

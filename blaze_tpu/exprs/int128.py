@@ -157,7 +157,11 @@ def div_round_half_up(hi, lo, den):
     too_big = (rh > 0) | ((rh == 0) & (rl >= uden.view(jnp.uint64)))
     q = q + jnp.where(too_big, jnp.uint64(1), jnp.uint64(0))
 
-    ok = q <= jnp.uint64(0x7FFFFFFFFFFFFFFF)
+    # -2^63 is representable: magnitude 2^63 is ok when negative
+    # (q.view(int64) is already -2^63 and -(-2^63) wraps back to it)
+    ok = (q <= jnp.uint64(0x7FFFFFFFFFFFFFFF)) | (
+        sign & (q == jnp.uint64(0x8000000000000000))
+    )
     qi = q.view(jnp.int64)
     return jnp.where(sign, -qi, qi), ok
 

@@ -121,11 +121,13 @@ def agg_state_fields(fn: str, in_t: Optional[DataType], name: str) -> List[Field
         return [Field(f"{name}#count", DataType.int64())]
     if fn == "sum":
         if sum_is_wide(in_t):
-            # hi limb carries the decimal (p,s) metadata for merge-mode
-            # input-type recovery; lo is a plain non-negative int64
+            # hi limb carries the state decimal scale; the LO limb name
+            # carries the true input precision (the hi precision
+            # saturates at 38 for inputs >= p29, so "-10" recovery
+            # alone would be lossy there)
             return [
                 Field(f"{name}#sum_hi", sum_result_type(in_t)),
-                Field(f"{name}#sum_lo", DataType.int64()),
+                Field(f"{name}#sum_lo{in_t.precision}", DataType.int64()),
                 Field(f"{name}#nonnull", DataType.int64()),
             ]
         return [
@@ -136,7 +138,7 @@ def agg_state_fields(fn: str, in_t: Optional[DataType], name: str) -> List[Field
         if sum_is_wide(in_t):
             return [
                 Field(f"{name}#sum_hi", sum_result_type(in_t)),
-                Field(f"{name}#sum_lo", DataType.int64()),
+                Field(f"{name}#sum_lo{in_t.precision}", DataType.int64()),
                 Field(f"{name}#count", DataType.int64()),
             ]
         return [
@@ -539,17 +541,31 @@ class AggExec(ExecNode):
                     self._in_types.append(None)
                 elif a.fn in ("sum", "avg"):
                     # state sum column carries the sum type; recover in_t
-                    # (wide decimal sums split into #sum_hi/#sum_lo limbs).
-                    # BOTH sum and avg states carry decimal(p+10, s), so
-                    # both subtract 10 — recovering p+10 as the input
-                    # precision would flip sum_is_wide() against the
-                    # partial stage's state layout and miss its columns
+                    # (wide decimal sums split into #sum_hi/#sum_loP limbs,
+                    # P = the TRUE input precision: the hi precision
+                    # saturates at 38 for inputs >= p29, so the plain
+                    # "-10" inversion is lossy there and would skew the
+                    # final avg result type vs Spark's).  BOTH sum and avg
+                    # states carry decimal(p+10, s), so both subtract 10 —
+                    # recovering p+10 as the input precision would flip
+                    # sum_is_wide() against the partial stage's layout
                     if f"{a.name}#sum" in in_schema.names:
                         st = in_schema.field(f"{a.name}#sum").dtype
+                        true_p = max(1, st.precision - 10)
                     else:
                         st = in_schema.field(f"{a.name}#sum_hi").dtype
+                        lo_prefix = f"{a.name}#sum_lo"
+                        true_p = next(
+                            (
+                                int(nm[len(lo_prefix):])
+                                for nm in in_schema.names
+                                if nm.startswith(lo_prefix)
+                                and nm[len(lo_prefix):].isdigit()
+                            ),
+                            max(1, st.precision - 10),
+                        )
                     if st.is_decimal:
-                        self._in_types.append(DataType.decimal(max(1, st.precision - 10), st.scale))
+                        self._in_types.append(DataType.decimal(true_p, st.scale))
                     else:
                         self._in_types.append(st)
                 elif a.fn in ("collect_list", "collect_set"):
@@ -871,7 +887,7 @@ class AggExec(ExecNode):
                 elif a.fn == "sum":
                     if sum_is_wide(t):
                         hc = env[f"{a.name}#sum_hi"]
-                        lc = env[f"{a.name}#sum_lo"]
+                        lc = env[f"{a.name}#sum_lo{t.precision}"]
                         nn = env[f"{a.name}#nonnull"]
                         vh, vl = combine_limbs(hc.data, lc.data)
                         data, fits = I.to_i64(vh, vl)
@@ -887,7 +903,7 @@ class AggExec(ExecNode):
                     res_t = agg_result_type("avg", t)
                     if sum_is_wide(t):
                         hc = env[f"{a.name}#sum_hi"]
-                        lc = env[f"{a.name}#sum_lo"]
+                        lc = env[f"{a.name}#sum_lo{t.precision}"]
                         c = env[f"{a.name}#count"]
                         valid = hc.validity & (c.data > 0)
                         den = jnp.where(c.data == 0, jnp.int64(1), c.data)

@@ -184,24 +184,34 @@ def _compute_range_boundaries(stage: Stage, register_readers, max_rows: int = 1 
     # bounded accumulation: sample per batch and re-stride the pool
     # whenever it doubles past the target, so driver memory stays
     # O(max_rows) regardless of input size (split points only affect
-    # balance, never sort correctness)
+    # balance, never sort correctness).  Each task's stream is
+    # abandoned once its per-task quota is met (Spark's
+    # RangePartitioner likewise runs a CHEAP sample job, not the full
+    # map stage): any consistent boundary set preserves order, so
+    # sampling only stream prefixes costs balance, not correctness
     per_word: List[List] = []
     pool_rows = 0
     stride = 1
+    task_quota = max(1024, max_rows // max(1, stage.n_tasks))
     for t in range(stage.n_tasks):
         register_readers(t)
         ctx = TaskContext(t, stage.n_tasks)
+        task_rows = 0
         for b in stage.plan.execute(t, ctx):
             words = key_words(tuple(b.columns), b.num_rows)
             for i, w in enumerate(words):
                 if len(per_word) <= i:
                     per_word.append([])
                 per_word[i].append(np.asarray(w)[: b.num_rows : stride])
-            pool_rows += len(per_word[0][-1])
+            got = len(per_word[0][-1])
+            pool_rows += got
+            task_rows += got * stride
             if pool_rows > 2 * max_rows:
                 per_word = [[np.concatenate(chunks)[::2]] for chunks in per_word]
                 pool_rows = len(per_word[0][0])
                 stride *= 2
+            if task_rows >= task_quota:
+                break
     if not per_word or not per_word[0]:
         # empty input: no batch will ever reach the pid kernel, any
         # consistent boundary set satisfies the contract

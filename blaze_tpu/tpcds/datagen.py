@@ -52,11 +52,26 @@ CLASSES = [
 REASON_DESCS = ["Package was damaged", "Stopped working", "Did not get it on time",
                 "Not the product that was ordred", "Parts missing"]
 
+CITIES = ["Midway", "Fairview", "Oak Grove", "Five Points", "Centerville", "Riverside"]
+UNITS = ["Each", "Dozen", "Case", "Pallet", "Gross", "Box"]
+SIZES = ["small", "medium", "large", "extra large", "petite", "N/A"]
+SHIP_MODE_TYPES = ["EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY", "LIBRARY"]
+SHIP_CARRIERS = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL"]
+WAREHOUSE_NAMES = ["Conventional childr", "Important issues liv",
+                   "Doors canno", "Bad cards must make", "Rooms cook"]
+WEB_SITE_NAMES = ["site_0", "site_1", "site_2", "site_3"]
+
 DATE_SK_BASE = 2450815  # arbitrary julian-like base, spec-style
 
 
 def _n_customers(scale: float) -> int:
     return max(50, int(100000 * scale))
+
+
+def _n_items(scale: float) -> int:
+    """item row count — inventory/fact generators MUST use this same
+    formula or their item_sk draws desync from the item table."""
+    return max(60, int(18000 * scale))
 
 
 def _n_cdemo() -> int:
@@ -104,6 +119,13 @@ def _date_dim() -> HostTable:
         "d_qoy": (((m - 1) // 3 + 1).astype(np.int32), None),
         # 0 = Sunday (dsdgen convention); 1970-01-01 was a Thursday
         "d_dow": (((days.astype(np.int64) + 4) % 7).astype(np.int32), None),
+        # monotone sequences for the year-over-year window families
+        # (q2/q59 join week_seq±53; q67/q14 slice month_seq ranges);
+        # anchored at the dataset's first day, spec-shaped not
+        # spec-identical — oracles compute from the same columns
+        "d_week_seq": (((days - first) // 7 + 1).astype(np.int32), None),
+        "d_month_seq": (
+            (((y - 1998) * 12 + m - 1) + 1176).astype(np.int32), None),
     }
 
 
@@ -117,8 +139,9 @@ def _time_dim() -> HostTable:
 
 
 def generate_table(name: str, scale: float, seed: int = 20011129,
-                   _ss_base: "HostTable" = None) -> HostTable:
+                   _base: Dict[str, "HostTable"] = None) -> HostTable:
     rng = np.random.RandomState((seed + zlib.crc32(name.encode())) % (2**31))
+    base = _base or {}
     if name == "date_dim":
         return _date_dim()
     if name == "time_dim":
@@ -137,6 +160,9 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "s_company_name": (co_data, co_len),
             "s_county": (cty_data, cty_len),
             "s_zip": (zip_data, zip_len),
+            # market 8 ≈ a third of stores so the q24 filter keeps rows
+            "s_market_id": ((np.arange(n) % 3 * 2 + 6).astype(np.int32), None),
+            "s_city": (*_encode_options([CITIES[i % len(CITIES)] for i in range(n)], 16),),
         }
     if name == "promotion":
         n = _n_promos(scale)
@@ -203,6 +229,8 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "c_first_name": (fn_, fn_len),
             "c_last_name": (ln_, ln_len),
             "c_preferred_cust_flag": (pf, pf_len),
+            "c_customer_id": (*_encode_options([f"CUST{k:012d}" for k in range(1, n + 1)], 16),),
+            "c_birth_year": ((1930 + np.arange(n) % 63).astype(np.int32), None),
         }
     if name == "customer_address":
         n = _n_addresses(scale)
@@ -229,6 +257,9 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "ca_county": (co_data, co_len),
             "ca_state": (st_data, st_len),
             "ca_gmt_offset": (gmt, None),
+            # ~1/6 of addresses share each store city so the q46/q68
+            # "bought in another city" predicate splits rows both ways
+            "ca_city": (*_encode_options([CITIES[(i * 5) % len(CITIES)] for i in range(n)], 16),),
         }
     if name == "call_center":
         names = ["NY Metro", "Mid Atlantic", "North Midwest", "Pacific Northwest"]
@@ -246,25 +277,44 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
     if name == "store_returns":
         # ~8% of store_sales lines come back; keys reference the SAME
         # deterministic store_sales draw (callers may pass it via
-        # _ss_base to avoid regenerating the largest fact table)
-        ss = _ss_base if _ss_base is not None else generate_table("store_sales", scale, seed)
+        # _base to avoid regenerating the largest fact table)
+        ss = base.get("store_sales") or generate_table("store_sales", scale, seed)
         n_ss = ss["ss_item_sk"][0].shape[0]
         take = rng.rand(n_ss) < 0.08
         idx = np.flatnonzero(take)
         n = idx.shape[0]
         qty = ss["ss_quantity"][0][idx]
         ret_q = np.minimum(rng.randint(1, 101, n), qty).astype(np.int32)
-        return {
+        out = {
             "sr_item_sk": (ss["ss_item_sk"][0][idx], None),
             "sr_ticket_number": (ss["ss_ticket_number"][0][idx], None),
             "sr_reason_sk": (rng.randint(1, len(REASON_DESCS) + 1, n).astype(np.int64), None),
             "sr_return_quantity": (ret_q, None),
             "sr_return_amt": (_money(rng, n, 0, 300), None),
         }
+        # round-4 columns: all NEW rng draws stay strictly AFTER the
+        # original ones so the pre-existing columns are byte-identical
+        # across rounds (oracle seeds/filters were tuned against them).
+        # Return-side keys mirror the originating ticket line so
+        # (item, ticket) joins recover the full provenance.
+        sold = ss["ss_sold_date_sk"][0][idx]
+        last_sk = _days(*D_LAST) - _days(*D_FIRST) + DATE_SK_BASE
+        ret_date = np.where(
+            sold < 0, np.int64(-1),
+            np.minimum(sold + rng.randint(1, 91, n), last_sk),
+        ).astype(np.int64)
+        out.update({
+            "sr_returned_date_sk": (ret_date, None),
+            "sr_customer_sk": (ss["ss_customer_sk"][0][idx], None),
+            "sr_store_sk": (ss["ss_store_sk"][0][idx], None),
+            "sr_cdemo_sk": (ss["ss_cdemo_sk"][0][idx], None),
+            "sr_net_loss": (_money(rng, n, 0, 500), None),
+        })
+        return out
     if name == "catalog_sales":
         n = max(150, int(1_440_000 * scale))
         n_date = _days(*D_LAST) - _days(*D_FIRST) + 1
-        n_item = max(60, int(18000 * scale))
+        n_item = _n_items(scale)
         n_cust = _n_customers(scale)
         n_addr = _n_addresses(scale)
         date_sk = np.where(
@@ -273,7 +323,7 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
         ).astype(np.int64)
         n_cd = _n_cdemo()
         n_promo = _n_promos(scale)
-        return {
+        out = {
             "cs_sold_date_sk": (date_sk, None),
             "cs_item_sk": (rng.randint(1, n_item + 1, n).astype(np.int64), None),
             "cs_bill_customer_sk": (rng.randint(1, n_cust + 1, n).astype(np.int64), None),
@@ -289,17 +339,42 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "cs_ext_sales_price": (_money(rng, n, 0, 2000), None),
             "cs_ext_discount_amt": (_money(rng, n, 0, 1000), None),
         }
+        # round-4 columns (new draws strictly after the original ones;
+        # see store_returns note).  Orders group 1-6 consecutive lines
+        # (dsdgen's order model) — per-line warehouses/dates still vary
+        # within an order, which the q16/q94 EXISTS shapes require.
+        order = np.repeat(np.arange(1, n + 1), rng.randint(1, 7, n))[:n].astype(np.int64)
+        ship_lag = rng.randint(2, 121, n)
+        last_sk = _days(*D_LAST) - _days(*D_FIRST) + DATE_SK_BASE
+        ship_date = np.where(
+            date_sk < 0, np.int64(-1), np.minimum(date_sk + ship_lag, last_sk)
+        ).astype(np.int64)
+        out.update({
+            "cs_order_number": (order, None),
+            "cs_ship_date_sk": (ship_date, None),
+            "cs_warehouse_sk": (rng.randint(1, len(WAREHOUSE_NAMES) + 1, n).astype(np.int64), None),
+            "cs_ship_mode_sk": (rng.randint(1, len(SHIP_MODE_TYPES) + 1, n).astype(np.int64), None),
+            "cs_ship_addr_sk": (rng.randint(1, n_addr + 1, n).astype(np.int64), None),
+            "cs_bill_hdemo_sk": (rng.randint(1, 721, n).astype(np.int64), None),
+            "cs_catalog_page_sk": (rng.randint(1, 21, n).astype(np.int64), None),
+            "cs_net_profit": (_money(rng, n, -1000, 1500), None),
+            "cs_ext_ship_cost": (_money(rng, n, 0, 500), None),
+            "cs_wholesale_cost": (_money(rng, n, 1, 100), None),
+            "cs_ext_list_price": (_money(rng, n, 1, 3000), None),
+            "cs_net_paid": (_money(rng, n, 0, 2000), None),
+        })
+        return out
     if name == "web_sales":
         n = max(100, int(720_000 * scale))
         n_date = _days(*D_LAST) - _days(*D_FIRST) + 1
-        n_item = max(60, int(18000 * scale))
+        n_item = _n_items(scale)
         n_cust = _n_customers(scale)
         n_addr = _n_addresses(scale)
         date_sk = np.where(
             rng.rand(n) < 0.02, np.int64(-1),
             rng.randint(0, n_date, n) + DATE_SK_BASE,
         ).astype(np.int64)
-        return {
+        out = {
             "ws_sold_date_sk": (date_sk, None),
             "ws_item_sk": (rng.randint(1, n_item + 1, n).astype(np.int64), None),
             "ws_bill_customer_sk": (rng.randint(1, n_cust + 1, n).astype(np.int64), None),
@@ -308,8 +383,34 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "ws_net_paid": (_money(rng, n, 0, 2000), None),
             "ws_ext_discount_amt": (_money(rng, n, 0, 1000), None),
         }
+        # round-4 columns (new draws strictly after the original ones)
+        order = np.repeat(np.arange(1, n + 1), rng.randint(1, 7, n))[:n].astype(np.int64)
+        ship_lag = rng.randint(2, 121, n)
+        last_sk = _days(*D_LAST) - _days(*D_FIRST) + DATE_SK_BASE
+        ship_date = np.where(
+            date_sk < 0, np.int64(-1), np.minimum(date_sk + ship_lag, last_sk)
+        ).astype(np.int64)
+        out.update({
+            "ws_order_number": (order, None),
+            "ws_ship_date_sk": (ship_date, None),
+            "ws_warehouse_sk": (rng.randint(1, len(WAREHOUSE_NAMES) + 1, n).astype(np.int64), None),
+            "ws_ship_mode_sk": (rng.randint(1, len(SHIP_MODE_TYPES) + 1, n).astype(np.int64), None),
+            "ws_ship_addr_sk": (rng.randint(1, n_addr + 1, n).astype(np.int64), None),
+            "ws_web_site_sk": (rng.randint(1, len(WEB_SITE_NAMES) + 1, n).astype(np.int64), None),
+            "ws_web_page_sk": (rng.randint(1, 11, n).astype(np.int64), None),
+            "ws_sold_time_sk": (rng.randint(0, 1440, n).astype(np.int64), None),
+            "ws_quantity": (rng.randint(1, 101, n).astype(np.int32), None),
+            "ws_list_price": (_money(rng, n, 1, 200), None),
+            "ws_sales_price": (_money(rng, n, 0, 300), None),
+            "ws_net_profit": (_money(rng, n, -1000, 1500), None),
+            "ws_ext_ship_cost": (_money(rng, n, 0, 500), None),
+            "ws_wholesale_cost": (_money(rng, n, 1, 100), None),
+            "ws_ext_list_price": (_money(rng, n, 1, 3000), None),
+            "ws_promo_sk": (rng.randint(1, _n_promos(scale) + 1, n).astype(np.int64), None),
+        })
+        return out
     if name == "item":
-        n = max(60, int(18000 * scale))
+        n = _n_items(scale)
         sk = np.arange(1, n + 1, dtype=np.int64)
         ids = [f"ITEM{k:012d}" for k in range(1, n + 1)]
         id_data, id_len = _encode_options(ids, 16)
@@ -341,6 +442,9 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "i_manufact": (mf_data, mf_len),
             "i_manager_id": (rng.randint(1, 40, n).astype(np.int32), None),
             "i_current_price": (_money(rng, n, 1, 99), None),
+            "i_units": (*_encode_options([UNITS[int(v)] for v in rng.randint(0, len(UNITS), n)], 8),),
+            "i_size": (*_encode_options([SIZES[int(v)] for v in rng.randint(0, len(SIZES), n)], 16),),
+            "i_wholesale_cost": (_money(rng, n, 1, 80), None),
         }
     if name == "store_sales":
         # dsdgen's basket model: a TICKET (1..25 lines, ~13 avg) shares
@@ -350,7 +454,7 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
         n_target = max(200, int(2_880_000 * scale))
         n_tickets = max(2, n_target // 13)
         n_date = _days(*D_LAST) - _days(*D_FIRST) + 1
-        n_item = max(60, int(18000 * scale))
+        n_item = _n_items(scale)
         n_cd = _n_cdemo()
         n_promo = _n_promos(scale)
         n_cust = _n_customers(scale)
@@ -392,6 +496,110 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "ss_ext_sales_price": (_money(rng, n, 0, 2000), None),
             "ss_coupon_amt": (_money(rng, n, 0, 100), None),
             "ss_net_profit": (_money(rng, n, -1000, 1000), None),
+            "ss_net_paid": (_money(rng, n, 0, 2000), None),
+            "ss_wholesale_cost": (_money(rng, n, 1, 100), None),
+            "ss_ext_list_price": (_money(rng, n, 1, 3000), None),
+            "ss_ext_wholesale_cost": (_money(rng, n, 1, 5000), None),
+        }
+    if name == "warehouse":
+        n = len(WAREHOUSE_NAMES)
+        return {
+            "w_warehouse_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "w_warehouse_name": (*_encode_options(WAREHOUSE_NAMES, 24),),
+            "w_state": (*_encode_options([STATES[i % len(STATES)] for i in range(n)], 8),),
+            "w_county": (*_encode_options([COUNTIES[i % len(COUNTIES)] for i in range(n)], 24),),
+        }
+    if name == "web_site":
+        n = len(WEB_SITE_NAMES)
+        return {
+            "web_site_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "web_name": (*_encode_options(WEB_SITE_NAMES, 16),),
+            "web_company_name": (*_encode_options(["pri", "ought", "able", "ese"], 16),),
+        }
+    if name == "ship_mode":
+        n = len(SHIP_MODE_TYPES)
+        return {
+            "sm_ship_mode_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "sm_type": (*_encode_options(SHIP_MODE_TYPES, 16),),
+            "sm_carrier": (*_encode_options(SHIP_CARRIERS, 16),),
+        }
+    if name == "catalog_page":
+        n = 20
+        return {
+            "cp_catalog_page_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "cp_catalog_page_id": (*_encode_options([f"CPAG{k:08d}" for k in range(1, n + 1)], 16),),
+        }
+    if name == "web_page":
+        n = 10
+        return {
+            "wp_web_page_sk": (np.arange(1, n + 1, dtype=np.int64), None),
+            "wp_char_count": ((np.arange(n) * 800 + 400).astype(np.int32), None),
+        }
+    if name == "inventory":
+        # weekly snapshots x item x warehouse, dsdgen-style full cross
+        # (row count scales with the item dimension only)
+        n_item = _n_items(scale)
+        n_wh = len(WAREHOUSE_NAMES)
+        first = _days(*D_FIRST)
+        last = _days(*D_LAST)
+        week_days = np.arange(first, last + 1, 7, dtype=np.int64) - first + DATE_SK_BASE
+        dd, ii, ww = np.meshgrid(
+            week_days, np.arange(1, n_item + 1, dtype=np.int64),
+            np.arange(1, n_wh + 1, dtype=np.int64), indexing="ij",
+        )
+        n = dd.size
+        return {
+            "inv_date_sk": (dd.ravel(), None),
+            "inv_item_sk": (ii.ravel(), None),
+            "inv_warehouse_sk": (ww.ravel(), None),
+            "inv_quantity_on_hand": (rng.randint(0, 1001, n).astype(np.int32), None),
+        }
+    if name == "catalog_returns":
+        cs = base.get("catalog_sales") or generate_table("catalog_sales", scale, seed)
+        n_cs = cs["cs_item_sk"][0].shape[0]
+        take = rng.rand(n_cs) < 0.08
+        idx = np.flatnonzero(take)
+        n = idx.shape[0]
+        ship = cs["cs_ship_date_sk"][0][idx]
+        last_sk = _days(*D_LAST) - _days(*D_FIRST) + DATE_SK_BASE
+        ret_date = np.where(
+            ship < 0, np.int64(-1), np.minimum(ship + rng.randint(1, 61, n), last_sk)
+        ).astype(np.int64)
+        ret_q = np.minimum(rng.randint(1, 101, n), cs["cs_quantity"][0][idx]).astype(np.int32)
+        return {
+            "cr_item_sk": (cs["cs_item_sk"][0][idx], None),
+            "cr_order_number": (cs["cs_order_number"][0][idx], None),
+            "cr_returned_date_sk": (ret_date, None),
+            "cr_return_quantity": (ret_q, None),
+            "cr_return_amount": (_money(rng, n, 0, 300), None),
+            "cr_net_loss": (_money(rng, n, 0, 500), None),
+            "cr_catalog_page_sk": (cs["cs_catalog_page_sk"][0][idx], None),
+            "cr_returning_customer_sk": (cs["cs_bill_customer_sk"][0][idx], None),
+            "cr_call_center_sk": (cs["cs_call_center_sk"][0][idx], None),
+            "cr_refunded_cash": (_money(rng, n, 0, 250), None),
+        }
+    if name == "web_returns":
+        ws = base.get("web_sales") or generate_table("web_sales", scale, seed)
+        n_ws = ws["ws_item_sk"][0].shape[0]
+        take = rng.rand(n_ws) < 0.08
+        idx = np.flatnonzero(take)
+        n = idx.shape[0]
+        ship = ws["ws_ship_date_sk"][0][idx]
+        last_sk = _days(*D_LAST) - _days(*D_FIRST) + DATE_SK_BASE
+        ret_date = np.where(
+            ship < 0, np.int64(-1), np.minimum(ship + rng.randint(1, 61, n), last_sk)
+        ).astype(np.int64)
+        ret_q = np.minimum(rng.randint(1, 101, n), ws["ws_quantity"][0][idx]).astype(np.int32)
+        return {
+            "wr_item_sk": (ws["ws_item_sk"][0][idx], None),
+            "wr_order_number": (ws["ws_order_number"][0][idx], None),
+            "wr_returned_date_sk": (ret_date, None),
+            "wr_return_quantity": (ret_q, None),
+            "wr_return_amt": (_money(rng, n, 0, 300), None),
+            "wr_net_loss": (_money(rng, n, 0, 500), None),
+            "wr_web_page_sk": (ws["ws_web_page_sk"][0][idx], None),
+            "wr_returning_customer_sk": (ws["ws_bill_customer_sk"][0][idx], None),
+            "wr_refunded_cash": (_money(rng, n, 0, 250), None),
         }
     raise KeyError(f"unknown tpcds table {name!r}")
 
@@ -401,7 +609,5 @@ def generate_all(scale: float, seed: int = 20011129) -> Dict[str, HostTable]:
 
     out: Dict[str, HostTable] = {}
     for name in TPCDS_SCHEMAS:
-        out[name] = generate_table(
-            name, scale, seed, _ss_base=out.get("store_sales")
-        )
+        out[name] = generate_table(name, scale, seed, _base=out)
     return out

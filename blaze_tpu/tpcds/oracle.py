@@ -10,7 +10,7 @@ from typing import Dict
 
 import numpy as np
 
-from ..tpch.datagen import HostTable
+from ..tpch.datagen import HostTable, _days
 from ..tpch.oracle import _round_half_up, _s_eq, _sv
 
 
@@ -1346,3 +1346,220 @@ def oracle_q12(tables):
                                date_col="ws_sold_date_sk",
                                item_col="ws_item_sk",
                                price_col="ws_ext_sales_price")
+
+
+# --------------------------------------------------- channel reports
+
+
+def _win_sks(tables, lo, hi):
+    """date_sks whose d_date lies in [lo, hi] (python dates)."""
+    dd = tables["date_dim"]
+    lo_d, hi_d = _days(*lo), _days(*hi)
+    m = (dd["d_date"][0] >= lo_d) & (dd["d_date"][0] <= hi_d)
+    return set(dd["d_date_sk"][0][m].tolist())
+
+
+def _rollup2(detail):
+    """detail {(ch, id): [s, r, p]} -> + (ch, None) + (None, None)."""
+    out = {}
+    for (ch, i), v in detail.items():
+        for key in ((ch, i), (ch, None), (None, None)):
+            acc = out.setdefault(key, [0, 0, 0])
+            for k in range(3):
+                acc[k] += v[k]
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def oracle_q5(tables):
+    win = _win_sks(tables, (2000, 8, 23), (2000, 9, 5))
+    detail = {}
+
+    def add(ch, ident, s, r, p):
+        acc = detail.setdefault((ch, ident), [0, 0, 0])
+        acc[0] += s
+        acc[1] += r
+        acc[2] += p
+
+    st = tables["store"]
+    sname = {int(k): v for k, v in zip(st["s_store_sk"][0], _sv(st, "s_store_name"))}
+    ss = tables["store_sales"]
+    for d, sk, pr, np_ in zip(ss["ss_sold_date_sk"][0], ss["ss_store_sk"][0],
+                              ss["ss_ext_sales_price"][0], ss["ss_net_profit"][0]):
+        if int(d) in win and int(sk) in sname:
+            add("store channel", sname[int(sk)], int(pr), 0, int(np_))
+    sr = tables["store_returns"]
+    for d, sk, amt, loss in zip(sr["sr_returned_date_sk"][0], sr["sr_store_sk"][0],
+                                sr["sr_return_amt"][0], sr["sr_net_loss"][0]):
+        if int(d) in win and int(sk) in sname:
+            add("store channel", sname[int(sk)], 0, int(amt), -int(loss))
+
+    cp = tables["catalog_page"]
+    cpid = {int(k): v for k, v in zip(cp["cp_catalog_page_sk"][0],
+                                      _sv(cp, "cp_catalog_page_id"))}
+    cs = tables["catalog_sales"]
+    for d, pg, pr, np_ in zip(cs["cs_sold_date_sk"][0], cs["cs_catalog_page_sk"][0],
+                              cs["cs_ext_sales_price"][0], cs["cs_net_profit"][0]):
+        if int(d) in win and int(pg) in cpid:
+            add("catalog channel", cpid[int(pg)], int(pr), 0, int(np_))
+    cr = tables["catalog_returns"]
+    for d, pg, amt, loss in zip(cr["cr_returned_date_sk"][0], cr["cr_catalog_page_sk"][0],
+                                cr["cr_return_amount"][0], cr["cr_net_loss"][0]):
+        if int(d) in win and int(pg) in cpid:
+            add("catalog channel", cpid[int(pg)], 0, int(amt), -int(loss))
+
+    wsite = tables["web_site"]
+    wname = {int(k): v for k, v in zip(wsite["web_site_sk"][0], _sv(wsite, "web_name"))}
+    ws = tables["web_sales"]
+    for d, sk, pr, np_ in zip(ws["ws_sold_date_sk"][0], ws["ws_web_site_sk"][0],
+                              ws["ws_ext_sales_price"][0], ws["ws_net_profit"][0]):
+        if int(d) in win and int(sk) in wname:
+            add("web channel", wname[int(sk)], int(pr), 0, int(np_))
+    # web returns: (item, order) join back to web_sales (WITH the
+    # engine join's fan-out multiplicity)
+    by_io = {}
+    for i, o, sk in zip(ws["ws_item_sk"][0], ws["ws_order_number"][0],
+                        ws["ws_web_site_sk"][0]):
+        by_io.setdefault((int(i), int(o)), []).append(int(sk))
+    wr = tables["web_returns"]
+    for d, i, o, amt, loss in zip(wr["wr_returned_date_sk"][0], wr["wr_item_sk"][0],
+                                  wr["wr_order_number"][0], wr["wr_return_amt"][0],
+                                  wr["wr_net_loss"][0]):
+        if int(d) in win:
+            for sk in by_io.get((int(i), int(o)), ()):
+                if sk in wname:
+                    add("web channel", wname[sk], 0, int(amt), -int(loss))
+    return _rollup2(detail)
+
+
+def oracle_q77(tables):
+    win = _win_sks(tables, (2000, 8, 3), (2000, 9, 1))
+    detail = {}
+
+    st_sks = set(tables["store"]["s_store_sk"][0].tolist())
+    ss = tables["store_sales"]
+    sales = {}
+    for d, sk, pr, np_ in zip(ss["ss_sold_date_sk"][0], ss["ss_store_sk"][0],
+                              ss["ss_ext_sales_price"][0], ss["ss_net_profit"][0]):
+        if int(d) in win and int(sk) in st_sks:
+            a = sales.setdefault(int(sk), [0, 0])
+            a[0] += int(pr)
+            a[1] += int(np_)
+    sr = tables["store_returns"]
+    rets = {}
+    for d, sk, amt, loss in zip(sr["sr_returned_date_sk"][0], sr["sr_store_sk"][0],
+                                sr["sr_return_amt"][0], sr["sr_net_loss"][0]):
+        if int(d) in win and int(sk) in st_sks:
+            a = rets.setdefault(int(sk), [0, 0])
+            a[0] += int(amt)
+            a[1] += int(loss)
+    for sk, (s, p) in sales.items():
+        r, l = rets.get(sk, (0, 0))
+        detail[("store channel", sk)] = [s, r, p - l]
+
+    cs = tables["catalog_sales"]
+    csales = {}
+    for d, cc, pr, np_ in zip(cs["cs_sold_date_sk"][0], cs["cs_call_center_sk"][0],
+                              cs["cs_ext_sales_price"][0], cs["cs_net_profit"][0]):
+        if int(d) in win:
+            a = csales.setdefault(int(cc), [0, 0])
+            a[0] += int(pr)
+            a[1] += int(np_)
+    cr = tables["catalog_returns"]
+    rtot = ltot = 0
+    for d, amt, loss in zip(cr["cr_returned_date_sk"][0], cr["cr_return_amount"][0],
+                            cr["cr_net_loss"][0]):
+        if int(d) in win:
+            rtot += int(amt)
+            ltot += int(loss)
+    for cc, (s, p) in csales.items():
+        detail[("catalog channel", cc)] = [s, rtot, p - ltot]
+
+    ws = tables["web_sales"]
+    wsales = {}
+    for d, pg, pr, np_ in zip(ws["ws_sold_date_sk"][0], ws["ws_web_page_sk"][0],
+                              ws["ws_ext_sales_price"][0], ws["ws_net_profit"][0]):
+        if int(d) in win:
+            a = wsales.setdefault(int(pg), [0, 0])
+            a[0] += int(pr)
+            a[1] += int(np_)
+    wr = tables["web_returns"]
+    wrets = {}
+    for d, pg, amt, loss in zip(wr["wr_returned_date_sk"][0], wr["wr_web_page_sk"][0],
+                                wr["wr_return_amt"][0], wr["wr_net_loss"][0]):
+        if int(d) in win:
+            a = wrets.setdefault(int(pg), [0, 0])
+            a[0] += int(amt)
+            a[1] += int(loss)
+    for pg, (s, p) in wsales.items():
+        r, l = wrets.get(pg, (0, 0))
+        detail[("web channel", pg)] = [s, r, p - l]
+    return _rollup2(detail)
+
+
+def oracle_q80(tables):
+    win = _win_sks(tables, (2000, 8, 3), (2000, 9, 1))
+    it = tables["item"]
+    iid = {}
+    for sk, price, ident in zip(it["i_item_sk"][0], it["i_current_price"][0],
+                                _sv(it, "i_item_id")):
+        if int(price) > 5000:
+            iid[int(sk)] = ident
+    pm = tables["promotion"]
+    promo_ok = {
+        int(sk)
+        for sk, v in zip(pm["p_promo_sk"][0], _sv(pm, "p_channel_email"))
+        if v == "N"
+    }
+    detail = {}
+
+    def add(ch, ident, s, r, p):
+        acc = detail.setdefault((ch, ident), [0, 0, 0])
+        acc[0] += s
+        acc[1] += r
+        acc[2] += p
+
+    def channel(ch, sales_cols, ret_cols):
+        d_c, i_c, promo_c, key2_c, price_c, profit_c, tab = sales_cols
+        ri_c, rkey2_c, ramt_c, rloss_c, rtab = ret_cols
+        rt = tables[rtab]
+        matches = {}
+        for i, k2, amt, loss in zip(rt[ri_c][0], rt[rkey2_c][0],
+                                    rt[ramt_c][0], rt[rloss_c][0]):
+            matches.setdefault((int(i), int(k2)), []).append((int(amt), int(loss)))
+        t = tables[tab]
+        for d, i, pr_sk, k2, price, profit in zip(
+            t[d_c][0], t[i_c][0], t[promo_c][0], t[key2_c][0],
+            t[price_c][0], t[profit_c][0],
+        ):
+            if int(d) not in win or int(i) not in iid or int(pr_sk) not in promo_ok:
+                continue
+            ident = iid[int(i)]
+            ms = matches.get((int(i), int(k2)))
+            if not ms:
+                add(ch, ident, int(price), 0, int(profit))
+            else:
+                for amt, loss in ms:
+                    add(ch, ident, int(price), amt, int(profit) - loss)
+
+    channel(
+        "store channel",
+        ("ss_sold_date_sk", "ss_item_sk", "ss_promo_sk", "ss_ticket_number",
+         "ss_ext_sales_price", "ss_net_profit", "store_sales"),
+        ("sr_item_sk", "sr_ticket_number", "sr_return_amt", "sr_net_loss",
+         "store_returns"),
+    )
+    channel(
+        "catalog channel",
+        ("cs_sold_date_sk", "cs_item_sk", "cs_promo_sk", "cs_order_number",
+         "cs_ext_sales_price", "cs_net_profit", "catalog_sales"),
+        ("cr_item_sk", "cr_order_number", "cr_return_amount", "cr_net_loss",
+         "catalog_returns"),
+    )
+    channel(
+        "web channel",
+        ("ws_sold_date_sk", "ws_item_sk", "ws_promo_sk", "ws_order_number",
+         "ws_ext_sales_price", "ws_net_profit", "web_sales"),
+        ("wr_item_sk", "wr_order_number", "wr_return_amt", "wr_net_loss",
+         "web_returns"),
+    )
+    return _rollup2(detail)

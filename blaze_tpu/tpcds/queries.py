@@ -24,10 +24,11 @@ from ..ops import (
     GroupingExpr,
     ProjectExec,
     SortField,
+    UnionExec,
 )
 from ..ops.joins import JoinType
 from ..schema import DataType
-from ..tpch.queries import broadcast_join, single_sorted, two_stage_agg
+from ..tpch.queries import broadcast_join, shuffle_join, single_sorted, two_stage_agg
 
 
 def q3(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
@@ -1602,8 +1603,367 @@ def q48(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+
+# --------------------------------------------------- channel reports
+
+_DEC72 = DataType.decimal(7, 2)
+
+
+def _dz():
+    """decimal(7,2) zero literal."""
+    return lit("0", _DEC72)
+
+
+def _d8(e):
+    """Widen a decimal(7,2) expr to the union-wide decimal(8,2)."""
+    return e + _dz()
+
+
+def _coalesce0(e):
+    """COALESCE(e, 0) at decimal(8,2) for the outer-join null side."""
+    from ..exprs.ir import Case
+
+    return Case([(e.is_not_null(), _d8(e))], _d8(_dz()))
+
+
+def _date_window(t, lo, hi, *, extra=()):
+    """date_dim slice d_date BETWEEN lo AND hi projected to d_date_sk
+    (+extras) — the q5/q77/q80 family's n-day report window."""
+    dt = FilterExec(
+        t["date_dim"], (col("d_date") >= lit(lo)) & (col("d_date") <= lit(hi))
+    )
+    return ProjectExec(dt, [col("d_date_sk")] + [col(c) for c in extra])
+
+
+def _channel_report_tail(union_plan, n_parts, id_t):
+    """Shared q5/q77/q80 tail: ROLLUP(channel, id) over
+    (sales, returns, profit) + ORDER BY channel, id LIMIT 100
+    (≙ the reference runs these through ExpandExec + two-phase agg,
+    expand_exec.rs:39, agg_exec.rs)."""
+    from ..exprs.ir import Lit
+    from ..ops import ExpandExec
+
+    ch_t = DataType.string(16)
+    vals = [col("sales"), col("returns"), col("profit")]
+    expand = ExpandExec(
+        union_plan,
+        [
+            vals + [col("channel"), col("id"), lit(0)],
+            vals + [col("channel"), Lit(None, id_t), lit(1)],
+            vals + [Lit(None, ch_t), Lit(None, id_t), lit(3)],
+        ],
+        ["sales", "returns", "profit", "channel", "id", "g_id"],
+    )
+    agg = two_stage_agg(
+        expand,
+        [GroupingExpr(col("channel"), "channel"), GroupingExpr(col("id"), "id"),
+         GroupingExpr(col("g_id"), "g_id")],
+        [AggFunction("sum", col("sales"), "sales"),
+         AggFunction("sum", col("returns"), "returns"),
+         AggFunction("sum", col("profit"), "profit")],
+        n_parts,
+    )
+    proj = ProjectExec(
+        agg, [col("channel"), col("id"), col("sales"), col("returns"), col("profit")]
+    )
+    return single_sorted(
+        proj, [SortField(col("channel")), SortField(col("id"))], fetch=100
+    )
+
+
+def q5(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Per-channel sales/returns/profit ROLLUP over a 14-day window:
+    each channel UNIONs sales rows with returns rows before the
+    aggregate, web returns recover their site via the (item, order)
+    join back to web_sales."""
+    import datetime
+
+    lo, hi = datetime.date(2000, 8, 23), datetime.date(2000, 9, 5)
+    dt = _date_window(t, lo, hi)
+    dz = _dz
+
+    def tag(plan, channel):
+        return ProjectExec(
+            plan,
+            [lit(channel, DataType.string(16)), col("id"), col("sales"),
+             col("returns"), col("profit")],
+            ["channel", "id", "sales", "returns", "profit"],
+        )
+
+    # --- store: sales rows + returns rows keyed by s_store_name
+    st = ProjectExec(t["store"], [col("s_store_sk"), col("s_store_name")])
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_sold_date_sk"), col("ss_store_sk"),
+                      col("ss_ext_sales_price"), col("ss_net_profit")])
+    j = broadcast_join(dt, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    s_sales = ProjectExec(
+        j,
+        [col("s_store_name"), _d8(col("ss_ext_sales_price")), _d8(dz()),
+         _d8(col("ss_net_profit"))],
+        ["id", "sales", "returns", "profit"],
+    )
+    sr = ProjectExec(t["store_returns"],
+                     [col("sr_returned_date_sk"), col("sr_store_sk"),
+                      col("sr_return_amt"), col("sr_net_loss")])
+    jr = broadcast_join(dt, sr, [col("d_date_sk")], [col("sr_returned_date_sk")], JoinType.INNER, build_is_left=True)
+    jr = broadcast_join(st, jr, [col("s_store_sk")], [col("sr_store_sk")], JoinType.INNER, build_is_left=True)
+    s_ret = ProjectExec(
+        jr,
+        [col("s_store_name"), _d8(dz()), _d8(col("sr_return_amt")),
+         dz() - col("sr_net_loss")],
+        ["id", "sales", "returns", "profit"],
+    )
+    store_rows = tag(UnionExec([s_sales, s_ret]), "store channel")
+
+    # --- catalog: keyed by cp_catalog_page_id
+    cp = ProjectExec(t["catalog_page"], [col("cp_catalog_page_sk"), col("cp_catalog_page_id")])
+    cl = ProjectExec(t["catalog_sales"],
+                     [col("cs_sold_date_sk"), col("cs_catalog_page_sk"),
+                      col("cs_ext_sales_price"), col("cs_net_profit")])
+    j = broadcast_join(dt, cl, [col("d_date_sk")], [col("cs_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(cp, j, [col("cp_catalog_page_sk")], [col("cs_catalog_page_sk")], JoinType.INNER, build_is_left=True)
+    c_sales = ProjectExec(
+        j,
+        [col("cp_catalog_page_id"), _d8(col("cs_ext_sales_price")), _d8(dz()),
+         _d8(col("cs_net_profit"))],
+        ["id", "sales", "returns", "profit"],
+    )
+    cr = ProjectExec(t["catalog_returns"],
+                     [col("cr_returned_date_sk"), col("cr_catalog_page_sk"),
+                      col("cr_return_amount"), col("cr_net_loss")])
+    jr = broadcast_join(dt, cr, [col("d_date_sk")], [col("cr_returned_date_sk")], JoinType.INNER, build_is_left=True)
+    jr = broadcast_join(cp, jr, [col("cp_catalog_page_sk")], [col("cr_catalog_page_sk")], JoinType.INNER, build_is_left=True)
+    c_ret = ProjectExec(
+        jr,
+        [col("cp_catalog_page_id"), _d8(dz()), _d8(col("cr_return_amount")),
+         dz() - col("cr_net_loss")],
+        ["id", "sales", "returns", "profit"],
+    )
+    cat_rows = tag(UnionExec([c_sales, c_ret]), "catalog channel")
+
+    # --- web: keyed by web_name; returns recover the site via the
+    # (item, order) join back to web_sales (the spec's LEFT JOIN whose
+    # null-site rows the web_site inner join then drops)
+    wsit = ProjectExec(t["web_site"], [col("web_site_sk"), col("web_name")])
+    wl = ProjectExec(t["web_sales"],
+                     [col("ws_sold_date_sk"), col("ws_web_site_sk"),
+                      col("ws_ext_sales_price"), col("ws_net_profit")])
+    j = broadcast_join(dt, wl, [col("d_date_sk")], [col("ws_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(wsit, j, [col("web_site_sk")], [col("ws_web_site_sk")], JoinType.INNER, build_is_left=True)
+    w_sales = ProjectExec(
+        j,
+        [col("web_name"), _d8(col("ws_ext_sales_price")), _d8(dz()),
+         _d8(col("ws_net_profit"))],
+        ["id", "sales", "returns", "profit"],
+    )
+    wr = ProjectExec(t["web_returns"],
+                     [col("wr_returned_date_sk"), col("wr_item_sk"),
+                      col("wr_order_number"), col("wr_return_amt"), col("wr_net_loss")])
+    jr = broadcast_join(dt, wr, [col("d_date_sk")], [col("wr_returned_date_sk")], JoinType.INNER, build_is_left=True)
+    ws_keys = ProjectExec(t["web_sales"],
+                          [col("ws_item_sk"), col("ws_order_number"), col("ws_web_site_sk")])
+    jr = shuffle_join(jr, ws_keys,
+                      [col("wr_item_sk"), col("wr_order_number")],
+                      [col("ws_item_sk"), col("ws_order_number")],
+                      JoinType.INNER, n_parts, build_left=False)
+    jr = broadcast_join(wsit, jr, [col("web_site_sk")], [col("ws_web_site_sk")], JoinType.INNER, build_is_left=True)
+    w_ret = ProjectExec(
+        jr,
+        [col("web_name"), _d8(dz()), _d8(col("wr_return_amt")),
+         dz() - col("wr_net_loss")],
+        ["id", "sales", "returns", "profit"],
+    )
+    web_rows = tag(UnionExec([w_sales, w_ret]), "web channel")
+
+    return _channel_report_tail(
+        UnionExec([store_rows, cat_rows, web_rows]), n_parts, DataType.string(16)
+    )
+
+
+def q77(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Per-location channel totals over a 30-day window: each channel
+    aggregates sales and returns SEPARATELY, then outer-joins them
+    (catalog's ungrouped returns total rides a scalar subquery, the
+    reference's SparkScalarSubqueryWrapperExpr seam)."""
+    import datetime
+
+    from ..tpch.queries import scalar_subquery_row
+
+    lo, hi = datetime.date(2000, 8, 3), datetime.date(2000, 9, 1)
+    dt = _date_window(t, lo, hi)
+
+    def agg_by(plan, key, sums, names):
+        return two_stage_agg(
+            plan, [GroupingExpr(col(key), key)],
+            [AggFunction("sum", e, n) for e, n in zip(sums, names)],
+            n_parts,
+        )
+
+    def tag(plan, channel, idc, sales, returns, profit):
+        return ProjectExec(
+            plan,
+            [lit(channel, DataType.string(16)), col(idc), sales, returns, profit],
+            ["channel", "id", "sales", "returns", "profit"],
+        )
+
+    # --- store
+    st = ProjectExec(t["store"], [col("s_store_sk")])
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_sold_date_sk"), col("ss_store_sk"),
+                      col("ss_ext_sales_price"), col("ss_net_profit")])
+    j = broadcast_join(dt, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(st, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    ss_agg = agg_by(j, "s_store_sk", [col("ss_ext_sales_price"), col("ss_net_profit")],
+                    ["sales", "profit"])
+    sret = ProjectExec(t["store_returns"],
+                       [col("sr_returned_date_sk"), col("sr_store_sk"),
+                        col("sr_return_amt"), col("sr_net_loss")])
+    jr = broadcast_join(dt, sret, [col("d_date_sk")], [col("sr_returned_date_sk")], JoinType.INNER, build_is_left=True)
+    jr = broadcast_join(st, jr, [col("s_store_sk")], [col("sr_store_sk")], JoinType.INNER, build_is_left=True)
+    jr = ProjectExec(jr, [col("s_store_sk").alias("r_store_sk"),
+                          col("sr_return_amt"), col("sr_net_loss")])
+    sr_agg = agg_by(jr, "r_store_sk", [col("sr_return_amt"), col("sr_net_loss")],
+                    ["returns", "profit_loss"])
+    sj = broadcast_join(sr_agg, ss_agg, [col("r_store_sk")], [col("s_store_sk")],
+                        JoinType.LEFT, build_is_left=False)
+    store_rows = tag(
+        sj, "store channel", "s_store_sk",
+        _d8(col("sales")), _coalesce0(col("returns")),
+        _d8(col("profit")) - _coalesce0(col("profit_loss")),
+    )
+
+    # --- catalog (returns total is ungrouped: scalar subquery x2)
+    cl = ProjectExec(t["catalog_sales"],
+                     [col("cs_sold_date_sk"), col("cs_call_center_sk"),
+                      col("cs_ext_sales_price"), col("cs_net_profit")])
+    j = broadcast_join(dt, cl, [col("d_date_sk")], [col("cs_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    cs_agg = agg_by(j, "cs_call_center_sk",
+                    [col("cs_ext_sales_price"), col("cs_net_profit")],
+                    ["sales", "profit"])
+    cret = ProjectExec(t["catalog_returns"],
+                       [col("cr_returned_date_sk"), col("cr_return_amount"),
+                        col("cr_net_loss")])
+    jr = broadcast_join(dt, cret, [col("d_date_sk")], [col("cr_returned_date_sk")], JoinType.INNER, build_is_left=True)
+    cr_tot = two_stage_agg(
+        jr, [],
+        [AggFunction("sum", col("cr_return_amount"), "returns"),
+         AggFunction("sum", col("cr_net_loss"), "profit_loss")],
+        n_parts,
+    )
+    ret_lit, loss_lit = scalar_subquery_row(cr_tot, ["returns", "profit_loss"])
+    cat_rows = tag(
+        cs_agg, "catalog channel", "cs_call_center_sk",
+        _d8(col("sales")), _coalesce0(ret_lit),
+        _d8(col("profit")) - _coalesce0(loss_lit),
+    )
+
+    # --- web
+    wl = ProjectExec(t["web_sales"],
+                     [col("ws_sold_date_sk"), col("ws_web_page_sk"),
+                      col("ws_ext_sales_price"), col("ws_net_profit")])
+    j = broadcast_join(dt, wl, [col("d_date_sk")], [col("ws_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    ws_agg = agg_by(j, "ws_web_page_sk",
+                    [col("ws_ext_sales_price"), col("ws_net_profit")],
+                    ["sales", "profit"])
+    wret = ProjectExec(t["web_returns"],
+                       [col("wr_returned_date_sk"), col("wr_web_page_sk"),
+                        col("wr_return_amt"), col("wr_net_loss")])
+    jr = broadcast_join(dt, wret, [col("d_date_sk")], [col("wr_returned_date_sk")], JoinType.INNER, build_is_left=True)
+    wr_agg = agg_by(jr, "wr_web_page_sk", [col("wr_return_amt"), col("wr_net_loss")],
+                    ["returns", "profit_loss"])
+    wj = broadcast_join(wr_agg, ws_agg, [col("wr_web_page_sk")], [col("ws_web_page_sk")],
+                        JoinType.LEFT, build_is_left=False)
+    web_rows = tag(
+        wj, "web channel", "ws_web_page_sk",
+        _d8(col("sales")), _coalesce0(col("returns")),
+        _d8(col("profit")) - _coalesce0(col("profit_loss")),
+    )
+
+    return _channel_report_tail(
+        UnionExec([store_rows, cat_rows, web_rows]), n_parts, DataType.int64()
+    )
+
+
+def q80(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Per-item channel totals net of returns: line-level LEFT joins
+    sales->returns on the (item, ticket/order) composite key, with
+    date window + i_current_price > 50 + promo filters.
+    (Deviation: the promo predicate is p_channel_email = 'N'; this
+    datagen carries no p_channel_tv column.)"""
+    import datetime
+
+    lo, hi = datetime.date(2000, 8, 3), datetime.date(2000, 9, 1)
+    dt = _date_window(t, lo, hi)
+    it = FilterExec(t["item"], col("i_current_price") > lit("50", _DEC72))
+    it_p = ProjectExec(it, [col("i_item_sk"), col("i_item_id")])
+    pr = FilterExec(t["promotion"], col("p_channel_email") == lit("N"))
+    pr_p = ProjectExec(pr, [col("p_promo_sk")])
+
+    def channel(sales, ret, skeys, rkeys, date_c, item_c, promo_c, price_c,
+                profit_c, ramt_c, rloss_c, channel_name):
+        j = broadcast_join(dt, sales, [col("d_date_sk")], [col(date_c)], JoinType.INNER, build_is_left=True)
+        j = broadcast_join(it_p, j, [col("i_item_sk")], [col(item_c)], JoinType.INNER, build_is_left=True)
+        j = broadcast_join(pr_p, j, [col("p_promo_sk")], [col(promo_c)], JoinType.INNER, build_is_left=True)
+        j = shuffle_join(j, ret, [col(k) for k in skeys], [col(k) for k in rkeys],
+                         JoinType.LEFT, n_parts, build_left=False)
+        return ProjectExec(
+            j,
+            [lit(channel_name, DataType.string(16)), col("i_item_id"),
+             _d8(col(price_c)), _coalesce0(col(ramt_c)),
+             _d8(col(profit_c)) - _coalesce0(col(rloss_c))],
+            ["channel", "id", "sales", "returns", "profit"],
+        )
+
+    store_rows = channel(
+        ProjectExec(t["store_sales"],
+                    [col("ss_sold_date_sk"), col("ss_item_sk"), col("ss_promo_sk"),
+                     col("ss_ticket_number"), col("ss_ext_sales_price"),
+                     col("ss_net_profit")]),
+        ProjectExec(t["store_returns"],
+                    [col("sr_item_sk"), col("sr_ticket_number"),
+                     col("sr_return_amt"), col("sr_net_loss")]),
+        ["ss_item_sk", "ss_ticket_number"], ["sr_item_sk", "sr_ticket_number"],
+        "ss_sold_date_sk", "ss_item_sk", "ss_promo_sk",
+        "ss_ext_sales_price", "ss_net_profit", "sr_return_amt", "sr_net_loss",
+        "store channel",
+    )
+    cat_rows = channel(
+        ProjectExec(t["catalog_sales"],
+                    [col("cs_sold_date_sk"), col("cs_item_sk"), col("cs_promo_sk"),
+                     col("cs_order_number"), col("cs_ext_sales_price"),
+                     col("cs_net_profit")]),
+        ProjectExec(t["catalog_returns"],
+                    [col("cr_item_sk"), col("cr_order_number"),
+                     col("cr_return_amount"), col("cr_net_loss")]),
+        ["cs_item_sk", "cs_order_number"], ["cr_item_sk", "cr_order_number"],
+        "cs_sold_date_sk", "cs_item_sk", "cs_promo_sk",
+        "cs_ext_sales_price", "cs_net_profit", "cr_return_amount", "cr_net_loss",
+        "catalog channel",
+    )
+    web_rows = channel(
+        ProjectExec(t["web_sales"],
+                    [col("ws_sold_date_sk"), col("ws_item_sk"), col("ws_promo_sk"),
+                     col("ws_order_number"), col("ws_ext_sales_price"),
+                     col("ws_net_profit")]),
+        ProjectExec(t["web_returns"],
+                    [col("wr_item_sk"), col("wr_order_number"),
+                     col("wr_return_amt"), col("wr_net_loss")]),
+        ["ws_item_sk", "ws_order_number"], ["wr_item_sk", "wr_order_number"],
+        "ws_sold_date_sk", "ws_item_sk", "ws_promo_sk",
+        "ws_ext_sales_price", "ws_net_profit", "wr_return_amt", "wr_net_loss",
+        "web channel",
+    )
+    return _channel_report_tail(
+        UnionExec([store_rows, cat_rows, web_rows]), n_parts, DataType.string(16)
+    )
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q3": q3,
+    "q5": q5,
+    "q77": q77,
+    "q80": q80,
     "q32": q32,
     "q33": q33,
     "q36": q36,

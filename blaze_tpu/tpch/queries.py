@@ -99,33 +99,44 @@ def revenue_expr() -> Expr:
     return col("l_extendedprice") * (dec12(1) - col("l_discount"))
 
 
-def scalar_subquery(plan: ExecNode, column: str) -> Expr:
-    """Evaluate a 1-row subplan eagerly and inject the value as a typed
-    literal — ≙ the reference's SparkScalarSubqueryWrapperExpr (the JVM
-    evaluates the subquery and the native side sees a literal)."""
+def scalar_subquery_row(plan: ExecNode, columns: List[str]) -> List[Expr]:
+    """Evaluate a 1-row subplan eagerly ONCE and inject each requested
+    column as a typed literal — ≙ the reference's
+    SparkScalarSubqueryWrapperExpr (the JVM evaluates the subquery and
+    the native side sees a literal)."""
     from ..batch import batch_to_pydict
     from ..runtime.context import TaskContext
 
-    value = None
+    values = {c: None for c in columns}
     found = False
     for p in range(plan.num_partitions()):
         for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
             d = batch_to_pydict(b)
-            if d[column]:
-                value = d[column][0]
+            if d[columns[0]]:
+                for c in columns:
+                    values[c] = d[c][0]
                 found = True
                 break
         if found:
             break
-    t = plan.schema.field(column).dtype
-    if t.is_decimal and value is not None:
-        # batch_to_pydict returns decimals unscaled; Lit takes logical
-        from ..serde.from_proto import _RawUnscaled
+    out: List[Expr] = []
+    for c in columns:
+        t = plan.schema.field(c).dtype
+        value = values[c]
+        if t.is_decimal and value is not None:
+            # batch_to_pydict returns decimals unscaled; Lit is logical
+            from ..serde.from_proto import _RawUnscaled
 
-        lit_ = lit(0, t)
-        lit_.value = _RawUnscaled(value)
-        return lit_
-    return lit(value, t)
+            lit_ = lit(0, t)
+            lit_.value = _RawUnscaled(value)
+            out.append(lit_)
+        else:
+            out.append(lit(value, t))
+    return out
+
+
+def scalar_subquery(plan: ExecNode, column: str) -> Expr:
+    return scalar_subquery_row(plan, [column])[0]
 
 
 def q1(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:

@@ -101,11 +101,11 @@ def test_run_task_applies_optimizations(data):
     naive = run(map_side())
     td = task_definition(map_side(), "t", 0, 0)
     # rev is decimal(25,4): the sum state is the wide two-limb layout
-    got = {"revenue#sum_hi": [], "revenue#sum_lo": [], "revenue#nonnull": []}
+    got = {"revenue#sum_hi": [], "revenue#sum_lo25": [], "revenue#nonnull": []}
     for b in run_task(td):
         d = batch_to_pydict(b)
         for k in got:
             got[k].extend(d[k])
     # run_task drives partition 0 only; naive ran both partitions
     assert got["revenue#sum_hi"] == naive["revenue#sum_hi"][:1]
-    assert got["revenue#sum_lo"] == naive["revenue#sum_lo"][:1]
+    assert got["revenue#sum_lo25"] == naive["revenue#sum_lo25"][:1]

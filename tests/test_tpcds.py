@@ -548,3 +548,34 @@ def test_q20(data, scans):
 
 def test_q12(data, scans):
     _check_class_share(run(build_query("q12", scans, N_PARTS)), O.oracle_q12(data))
+
+
+def _check_channel_report(got, exp):
+    """rollup(channel, id) reports: every engine row exact, count
+    matches (<=100), output ordered by (channel, id) nulls-first."""
+    n = len(got["channel"])
+    assert n, "query returned no rows"
+    rows = {}
+    for i in range(n):
+        rows[(got["channel"][i], got["id"][i])] = (
+            got["sales"][i], got["returns"][i], got["profit"][i])
+    assert len(rows) == n  # rollup keys are unique
+    for k, v in rows.items():
+        assert exp.get(k) == v, (k, v, exp.get(k))
+    assert len(rows) == min(len(exp), 100)
+    keys = [((0, "") if got["channel"][i] is None else (1, got["channel"][i]),
+             (0, 0) if got["id"][i] is None else (1, got["id"][i]))
+            for i in range(n)]
+    assert keys == sorted(keys)
+
+
+def test_q5(data, scans):
+    _check_channel_report(run(build_query("q5", scans, N_PARTS)), O.oracle_q5(data))
+
+
+def test_q77(data, scans):
+    _check_channel_report(run(build_query("q77", scans, N_PARTS)), O.oracle_q77(data))
+
+
+def test_q80(data, scans):
+    _check_channel_report(run(build_query("q80", scans, N_PARTS)), O.oracle_q80(data))
