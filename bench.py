@@ -160,6 +160,9 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
     r1 = rows1 / dt1
     result["q01_rows_per_sec"] = round(r1, 1)
     result["q01_vs_baseline"] = round(r1 / BLAZE_Q01_ROWS_PER_SEC_PER_NODE, 3)
+    # freshness marker: measured in THIS run (a cache-merged q01 keeps
+    # its ORIGINAL stamp so consumers can tell fresh from carried-over)
+    result["q01_measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     return result
 
 
@@ -213,14 +216,18 @@ def _tpu_child(out_path: str) -> None:
                         "q01_measured_at", prev.get("measured_at"))
             except Exception:  # noqa: BLE001 — torn cache never kills a publish
                 pass
-        tmp = out_path + ".tmp"
+        # per-pid tmp names: a watchdog child and a main-window child
+        # may publish concurrently, and a shared .tmp path would let
+        # one replace() lose the race and crash mid-publish
+        tmp = f"{out_path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(json.dumps(result))
         os.replace(tmp, out_path)
         if os.path.abspath(out_path) != CACHED_RESULT_PATH and result.get("backend") == "tpu":
-            with open(CACHED_RESULT_PATH + ".tmp", "w") as f:
+            ctmp = f"{CACHED_RESULT_PATH}.tmp.{os.getpid()}"
+            with open(ctmp, "w") as f:
                 f.write(json.dumps(result))
-            os.replace(CACHED_RESULT_PATH + ".tmp", CACHED_RESULT_PATH)
+            os.replace(ctmp, CACHED_RESULT_PATH)
 
     publish(_measure(SCALE_Q6, SCALE_Q1, on_tpu=_is_tpu_backend(),
                      partial_sink=publish))
@@ -244,15 +251,22 @@ def _watchdog() -> None:
 
     started = time.time()
 
+    started_iso = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
     def done() -> bool:
-        # a complete cache counts only if written SINCE this watchdog
-        # started (a previous round's cache must not satisfy it)
+        # complete = BOTH halves measured SINCE this watchdog started
+        # (neither a previous round's cache nor a carried-over q01
+        # merged into a fresh q06 partial may satisfy it)
         try:
             if os.path.getmtime(CACHED_RESULT_PATH) < started - 60:
                 return False
             with open(CACHED_RESULT_PATH) as f:
                 c = json.load(f)
-            return c.get("backend") == "tpu" and c.get("q01_rows_per_sec") is not None
+            return (
+                c.get("backend") == "tpu"
+                and c.get("q01_rows_per_sec") is not None
+                and c.get("q01_measured_at", "") >= started_iso
+            )
         except Exception:  # noqa: BLE001
             return False
 
@@ -268,12 +282,17 @@ def _watchdog() -> None:
         if not ok:
             time.sleep(120)
             continue
-        rc = subprocess.call(
+        child = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--tpu-child",
              CACHED_RESULT_PATH],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,  # NEVER killed: killing a
+            # chip-holding process wedges the lease for hours
         )
-        note("measure", rc=rc, complete=done())
+        while child.poll() is None and time.time() < deadline:
+            note("measuring", complete=done())
+            time.sleep(120)
+        note("measure", rc=child.poll(), complete=done())
         if not done():
             time.sleep(60)
     note("exit", complete=done())
@@ -340,8 +359,13 @@ def main() -> None:
                     cur = json.load(f)
             except Exception:  # noqa: BLE001 — mid-replace read
                 cur = None
+            fresh_q01 = cur is not None and cur.get(
+                "q01_rows_per_sec"
+            ) is not None and cur.get("q01_measured_at", "") >= time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)
+            )
             if cur is not None and (
-                cur.get("q01_rows_per_sec") is not None
+                fresh_q01
                 or tpu_child is None
                 or tpu_child.poll() is not None
             ):

@@ -33,12 +33,14 @@ class ExpandExec(ExecNode):
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         def stream():
-            # one pass per projection (child streams re-executed; fine
-            # for the usual Expand-over-cheap-child shape emitted by
-            # rollup/cube plans)
-            for proj in self._projects:
-                for b in proj.execute(partition, ctx):
-                    self.metrics.add("output_rows", b.num_rows)
-                    yield b
+            # SINGLE child pass, all projections applied per batch:
+            # re-executing the child once per projection would re-read
+            # pop-on-read shuffle resources (and triple the work) when
+            # the rollup sits above a join/exchange (q80's shape)
+            for b in self.children[0].execute(partition, ctx):
+                for proj in self._projects:
+                    out = proj.project_batch(b)
+                    self.metrics.add("output_rows", out.num_rows)
+                    yield out
 
         return stream()

@@ -81,7 +81,14 @@ def _lit_to_proto(e: Lit) -> pb.LiteralValue:
     elif t.is_float:
         out.float_value = float(v)
     elif t.is_decimal:
-        if isinstance(v, str):
+        from .from_proto import _RawUnscaled
+
+        if isinstance(v, _RawUnscaled):
+            # already the unscaled representation (a scalar-subquery
+            # result round-tripping back out) — scaling it again would
+            # inflate the literal 10^scale-fold
+            out.int_value = int(v)
+        elif isinstance(v, str):
             from decimal import Decimal
 
             out.int_value = int(Decimal(v).scaleb(t.scale).to_integral_value())

@@ -267,6 +267,8 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
         return {
             "cc_call_center_sk": (np.arange(1, len(names) + 1, dtype=np.int64), None),
             "cc_name": (d, ln),
+            "cc_county": (*_encode_options(
+                [COUNTIES[i % len(COUNTIES)] for i in range(len(names))], 24),),
         }
     if name == "reason":
         d, ln = _encode_options(REASON_DESCS, 40)

@@ -1563,3 +1563,667 @@ def oracle_q80(tables):
          "web_returns"),
     )
     return _rollup2(detail)
+
+
+# ------------------------------------------- distinct-count EXISTS
+
+
+def _oracle_ship_report(tables, *, fact, order_c, wh_c, ship_date_c, addr_c,
+                        dim_join, ship_c, profit_c, ret_tab, r_order_c,
+                        lo, hi, state, returned):
+    """Shared q16/q94/q95: filtered fact lines restricted to
+    multi-warehouse orders, anti/semi returns, then
+    (count distinct order, sum ship, sum profit)."""
+    win = _win_sks(tables, lo, hi)
+    ca = tables["customer_address"]
+    ok_addr = set(
+        ca["ca_address_sk"][0][np.array(_s_eq(ca, "ca_state", state))].tolist()
+    )
+    dim_ok = dim_join(tables)
+    f = tables[fact]
+    # multi-warehouse orders over the WHOLE fact table
+    wh_by_order = {}
+    for o, w in zip(f[order_c][0], f[wh_c][0]):
+        wh_by_order.setdefault(int(o), set()).add(int(w))
+    multi = {o for o, ws in wh_by_order.items() if len(ws) >= 2}
+    returned_orders = {int(o) for o in tables[ret_tab][r_order_c][0]}
+    orders = set()
+    ship_tot = profit_tot = 0
+    for d, a, dim, o, sc, pr in zip(
+        f[ship_date_c][0], f[addr_c][0], f[dim_join.col][0],
+        f[order_c][0], f[ship_c][0], f[profit_c][0],
+    ):
+        o = int(o)
+        if int(d) not in win or int(a) not in ok_addr or int(dim) not in dim_ok:
+            continue
+        if o not in multi:
+            continue
+        if (o in returned_orders) != returned:
+            continue
+        orders.add(o)
+        ship_tot += int(sc)
+        profit_tot += int(pr)
+    return len(orders), ship_tot, profit_tot
+
+
+class _DimFilter:
+    def __init__(self, col, fn):
+        self.col = col
+        self._fn = fn
+
+    def __call__(self, tables):
+        return self._fn(tables)
+
+
+def oracle_q94(tables):
+    dim = _DimFilter("ws_web_site_sk", lambda t: set(
+        t["web_site"]["web_site_sk"][0][
+            np.array(_s_eq(t["web_site"], "web_company_name", "pri"))
+        ].tolist()))
+    return _oracle_ship_report(
+        tables, fact="web_sales", order_c="ws_order_number",
+        wh_c="ws_warehouse_sk", ship_date_c="ws_ship_date_sk",
+        addr_c="ws_ship_addr_sk", dim_join=dim,
+        ship_c="ws_ext_ship_cost", profit_c="ws_net_profit",
+        ret_tab="web_returns", r_order_c="wr_order_number",
+        lo=(1999, 2, 1), hi=(1999, 12, 31), state="TN", returned=False,
+    )
+
+
+def oracle_q95(tables):
+    dim = _DimFilter("ws_web_site_sk", lambda t: set(
+        t["web_site"]["web_site_sk"][0][
+            np.array(_s_eq(t["web_site"], "web_company_name", "pri"))
+        ].tolist()))
+    return _oracle_ship_report(
+        tables, fact="web_sales", order_c="ws_order_number",
+        wh_c="ws_warehouse_sk", ship_date_c="ws_ship_date_sk",
+        addr_c="ws_ship_addr_sk", dim_join=dim,
+        ship_c="ws_ext_ship_cost", profit_c="ws_net_profit",
+        ret_tab="web_returns", r_order_c="wr_order_number",
+        lo=(1999, 2, 1), hi=(1999, 12, 31), state="TN", returned=True,
+    )
+
+
+def oracle_q16(tables):
+    dim = _DimFilter("cs_call_center_sk", lambda t: set(
+        t["call_center"]["cc_call_center_sk"][0][
+            np.array(_s_eq(t["call_center"], "cc_county", "Williamson County"))
+        ].tolist()))
+    return _oracle_ship_report(
+        tables, fact="catalog_sales", order_c="cs_order_number",
+        wh_c="cs_warehouse_sk", ship_date_c="cs_ship_date_sk",
+        addr_c="cs_ship_addr_sk", dim_join=dim,
+        ship_c="cs_ext_ship_cost", profit_c="cs_net_profit",
+        ret_tab="catalog_returns", r_order_c="cr_order_number",
+        lo=(2002, 2, 1), hi=(2002, 12, 31), state="GA", returned=False,
+    )
+
+
+# ------------------------------------------- year-over-year customers
+
+
+def _oracle_yoy_customer(tables, *, store_m, web_m, y1, y2, out_cols):
+    dd = tables["date_dim"]
+    yr_by_sk = dict(zip(dd["d_date_sk"][0].tolist(), dd["d_year"][0].tolist()))
+    cu = tables["customer"]
+    n_cust = cu["c_customer_sk"][0].shape[0]
+    attrs = {}
+    cols = {c: (_sv(cu, c) if cu[c][1] is not None else cu[c][0]) for c in out_cols}
+    for i in range(n_cust):
+        sk = int(cu["c_customer_sk"][0][i])
+        attrs[sk] = tuple(
+            cols[c][i] if isinstance(cols[c], list) else int(cols[c][i])
+            for c in out_cols
+        )
+
+    def totals(fact, date_c, cust_c, measure):
+        f = tables[fact]
+        out = {y1: {}, y2: {}}
+        m = measure(f)
+        for d, c, v in zip(f[date_c][0], f[cust_c][0], m):
+            y = yr_by_sk.get(int(d))
+            if y in out:
+                out[y][int(c)] = out[y].get(int(c), 0) + int(v)
+        return out
+
+    st = totals("store_sales", "ss_sold_date_sk", "ss_customer_sk", store_m)
+    wb = totals("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk", web_m)
+    rows = {}
+    for sk in attrs:
+        if sk not in st[y1] or sk not in st[y2] or sk not in wb[y1] or sk not in wb[y2]:
+            continue
+        s1, s2, w1, w2 = st[y1][sk], st[y2][sk], wb[y1][sk], wb[y2][sk]
+        if not (s1 > 0 and w1 > 0):
+            continue
+        # the plan casts decimal(17,2) -> float64 (unscaled/100.0)
+        # before dividing; mirror that float path bit-for-bit
+        if (w2 / 100.0) / (w1 / 100.0) > (s2 / 100.0) / (s1 / 100.0):
+            rows[sk] = attrs[sk]
+    return set(rows.values())
+
+
+def oracle_q74(tables):
+    return _oracle_yoy_customer(
+        tables,
+        store_m=lambda f: f["ss_net_paid"][0],
+        web_m=lambda f: f["ws_net_paid"][0],
+        y1=1999, y2=2000,
+        out_cols=["c_customer_id", "c_first_name", "c_last_name"],
+    )
+
+
+def oracle_q11(tables):
+    return _oracle_yoy_customer(
+        tables,
+        store_m=lambda f: f["ss_ext_list_price"][0] - f["ss_ext_discount_amt"][0],
+        web_m=lambda f: f["ws_ext_list_price"][0] - f["ws_ext_discount_amt"][0],
+        y1=2000, y2=2001,
+        out_cols=["c_customer_id", "c_preferred_cust_flag",
+                  "c_first_name", "c_last_name"],
+    )
+
+
+# ------------------------------------------- q23 frequent/best CTEs
+
+
+def _oracle_q23_sets(tables):
+    dd = tables["date_dim"]
+    info = {int(k): (int(y), int(m)) for k, y, m in
+            zip(dd["d_date_sk"][0], dd["d_year"][0], dd["d_moy"][0])}
+    ss = tables["store_sales"]
+    cells = {}
+    for d, i in zip(ss["ss_sold_date_sk"][0], ss["ss_item_sk"][0]):
+        ym = info.get(int(d))
+        if ym is None:
+            continue
+        key = (int(i), ym[0] * 12 + ym[1])
+        cells[key] = cells.get(key, 0) + 1
+    hot_items = {i for (i, _), c in cells.items() if c > 4}
+
+    spend = {}
+    for c, q, p in zip(ss["ss_customer_sk"][0], ss["ss_quantity"][0],
+                       ss["ss_sales_price"][0]):
+        spend[int(c)] = spend.get(int(c), 0) + int(q) * int(p)
+    cmax = max(spend.values())
+    # mirror the plan: float64 compare of decimal-cast values.  BOTH
+    # sides share scale 2 so the /100.0 cancels only in exact math —
+    # reproduce the engine's exact operand order
+    best = {c for c, v in spend.items() if v / 100.0 > 0.5 * (cmax / 100.0)}
+    return hot_items, best, info
+
+
+def _oracle_q23_rows(tables, hot, best, info):
+    out = []
+    for fact, d_c, i_c, c_c, q_c, p_c in (
+        ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+         "cs_bill_customer_sk", "cs_quantity", "cs_list_price"),
+        ("web_sales", "ws_sold_date_sk", "ws_item_sk",
+         "ws_bill_customer_sk", "ws_quantity", "ws_list_price"),
+    ):
+        f = tables[fact]
+        for d, i, c, q, p in zip(f[d_c][0], f[i_c][0], f[c_c][0],
+                                 f[q_c][0], f[p_c][0]):
+            if info.get(int(d)) != (2000, 5):
+                continue
+            if int(i) in hot and int(c) in best:
+                out.append((int(c), int(q) * int(p)))
+    return out
+
+
+def oracle_q23a(tables):
+    hot, best, info = _oracle_q23_sets(tables)
+    rows = _oracle_q23_rows(tables, hot, best, info)
+    return sum(v for _, v in rows) if rows else None
+
+
+def oracle_q23b(tables):
+    hot, best, info = _oracle_q23_sets(tables)
+    rows = _oracle_q23_rows(tables, hot, best, info)
+    cu = tables["customer"]
+    names = {int(sk): (l, f) for sk, l, f in
+             zip(cu["c_customer_sk"][0], _sv(cu, "c_last_name"),
+                 _sv(cu, "c_first_name"))}
+    out = {}
+    for c, v in rows:
+        key = names[c]
+        out[key] = out.get(key, 0) + v
+    return out
+
+
+# ------------------------------------------- q24 returned-sales netpaid
+
+
+def _oracle_q24_cells(tables):
+    ss = tables["store_sales"]
+    sr = tables["store_returns"]
+    returned = {}
+    for i, tk in zip(sr["sr_item_sk"][0], sr["sr_ticket_number"][0]):
+        k = (int(i), int(tk))
+        returned[k] = returned.get(k, 0) + 1
+    st = tables["store"]
+    stores = {}
+    for sk, mid, nm, co in zip(st["s_store_sk"][0], st["s_market_id"][0],
+                               _sv(st, "s_store_name"), _sv(st, "s_county")):
+        if int(mid) == 8:
+            stores[int(sk)] = (nm, co)
+    cu = tables["customer"]
+    custs = {int(sk): (l, f, int(a)) for sk, l, f, a in
+             zip(cu["c_customer_sk"][0], _sv(cu, "c_last_name"),
+                 _sv(cu, "c_first_name"), cu["c_current_addr_sk"][0])}
+    ca = tables["customer_address"]
+    county = {int(sk): c for sk, c in
+              zip(ca["ca_address_sk"][0], _sv(ca, "ca_county"))}
+    it = tables["item"]
+    color = {int(sk): c for sk, c in
+             zip(it["i_item_sk"][0], _sv(it, "i_color"))}
+    cells = {}
+    for i, tk, stk, csk, paid in zip(
+        ss["ss_item_sk"][0], ss["ss_ticket_number"][0], ss["ss_store_sk"][0],
+        ss["ss_customer_sk"][0], ss["ss_net_paid"][0],
+    ):
+        mult = returned.get((int(i), int(tk)), 0)
+        if not mult or int(stk) not in stores or int(csk) not in custs:
+            continue
+        nm, sco = stores[int(stk)]
+        last, first, addr = custs[int(csk)]
+        if county.get(addr) != sco:
+            continue
+        key = (last, first, nm, color[int(i)])
+        cells[key] = cells.get(key, 0) + int(paid) * mult
+    return cells
+
+
+def _oracle_q24(tables, c):
+    cells = _oracle_q24_cells(tables)
+    if not cells:
+        return {}, None
+    total = sum(cells.values())
+    n = len(cells)
+    # engine avg: decimal(17,2) state -> avg result decimal(21,6),
+    # HALF_UP; mirror its unscaled arithmetic then the float compare
+    num = total * 10_000
+    q, r = divmod(num, n)
+    avg_unscaled = q + (1 if 2 * r >= n else 0)
+    out = {}
+    for (last, first, store, color), v in cells.items():
+        if color != c:
+            continue
+        key = (last, first, store)
+        out[key] = out.get(key, 0) + v
+    thr = 0.05 * (avg_unscaled / 1_000_000.0)
+    return {k: v for k, v in out.items() if v / 100.0 > thr}, avg_unscaled
+
+
+def oracle_q24a(tables):
+    return _oracle_q24(tables, "peach")[0]
+
+
+def oracle_q24b(tables):
+    return _oracle_q24(tables, "saddle")[0]
+
+
+# ------------------------------------------- cross-channel item YoY
+
+
+def oracle_q75(tables):
+    dd = tables["date_dim"]
+    yr = dict(zip(dd["d_date_sk"][0].tolist(), dd["d_year"][0].tolist()))
+    it = tables["item"]
+    cats = _sv(it, "i_category")
+    ids = {}
+    for i in range(it["i_item_sk"][0].shape[0]):
+        if cats[i] == "Books":
+            ids[int(it["i_item_sk"][0][i])] = (
+                int(it["i_brand_id"][0][i]), int(it["i_class_id"][0][i]),
+                int(it["i_category_id"][0][i]), int(it["i_manufact_id"][0][i]))
+    agg = {}
+
+    def channel(fact, d_c, i_c, k2_c, q_c, a_c, rtab, ri_c, rk2_c, rq_c, ra_c):
+        rt = tables[rtab]
+        matches = {}
+        for i, k2, q, a in zip(rt[ri_c][0], rt[rk2_c][0], rt[rq_c][0], rt[ra_c][0]):
+            matches.setdefault((int(i), int(k2)), []).append((int(q), int(a)))
+        f = tables[fact]
+        for d, i, k2, q, a in zip(f[d_c][0], f[i_c][0], f[k2_c][0],
+                                  f[q_c][0], f[a_c][0]):
+            y = yr.get(int(d))
+            if y is None or int(i) not in ids:
+                continue
+            key = (y,) + ids[int(i)]
+            ms = matches.get((int(i), int(k2)))
+            acc = agg.setdefault(key, [0, 0])
+            if not ms:
+                acc[0] += int(q)
+                acc[1] += int(a)
+            else:
+                for rq, ra in ms:
+                    acc[0] += int(q) - rq
+                    acc[1] += int(a) - ra
+
+    channel("store_sales", "ss_sold_date_sk", "ss_item_sk", "ss_ticket_number",
+            "ss_quantity", "ss_ext_sales_price", "store_returns", "sr_item_sk",
+            "sr_ticket_number", "sr_return_quantity", "sr_return_amt")
+    channel("catalog_sales", "cs_sold_date_sk", "cs_item_sk", "cs_order_number",
+            "cs_quantity", "cs_ext_sales_price", "catalog_returns", "cr_item_sk",
+            "cr_order_number", "cr_return_quantity", "cr_return_amount")
+    channel("web_sales", "ws_sold_date_sk", "ws_item_sk", "ws_order_number",
+            "ws_quantity", "ws_ext_sales_price", "web_returns", "wr_item_sk",
+            "wr_order_number", "wr_return_quantity", "wr_return_amt")
+    out = {}
+    for key, (cnt, amt) in agg.items():
+        if key[0] != 2002:
+            continue
+        pkey = (2001,) + key[1:]
+        if pkey not in agg:
+            continue
+        pcnt, pamt = agg[pkey]
+        if not (pcnt > 0 and cnt / pcnt < 0.9):
+            continue
+        out[key[1:]] = (cnt - pcnt, amt - pamt)
+    return out
+
+
+def oracle_q78(tables):
+    dd = tables["date_dim"]
+    y2000 = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+
+    def channel(fact, d_c, i_c, c_c, k2_c, q_c, w_c, s_c, rtab, ri_c, rk2_c):
+        rt = tables[rtab]
+        returned = {(int(i), int(k)) for i, k in zip(rt[ri_c][0], rt[rk2_c][0])}
+        f = tables[fact]
+        out = {}
+        for d, i, c, k2, q, w, sp in zip(f[d_c][0], f[i_c][0], f[c_c][0],
+                                         f[k2_c][0], f[q_c][0], f[w_c][0],
+                                         f[s_c][0]):
+            if int(d) not in y2000 or (int(i), int(k2)) in returned:
+                continue
+            acc = out.setdefault((int(i), int(c)), [0, 0, 0])
+            acc[0] += int(q)
+            acc[1] += int(w)
+            acc[2] += int(sp)
+        return out
+
+    ss = channel("store_sales", "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+                 "ss_ticket_number", "ss_quantity", "ss_wholesale_cost",
+                 "ss_sales_price", "store_returns", "sr_item_sk", "sr_ticket_number")
+    ws = channel("web_sales", "ws_sold_date_sk", "ws_item_sk", "ws_bill_customer_sk",
+                 "ws_order_number", "ws_quantity", "ws_wholesale_cost",
+                 "ws_sales_price", "web_returns", "wr_item_sk", "wr_order_number")
+    cs = channel("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                 "cs_bill_customer_sk", "cs_order_number", "cs_quantity",
+                 "cs_wholesale_cost", "cs_sales_price", "catalog_returns",
+                 "cr_item_sk", "cr_order_number")
+    out = {}
+    for key, (q, w, sp) in ss.items():
+        wq = ws.get(key, (0, 0, 0))[0]
+        cq = cs.get(key, (0, 0, 0))[0]
+        if not (wq > 0 or cq > 0):
+            continue
+        other = float(wq + cq)
+        ratio = q / (other if other > 0 else 1.0)
+        out[key] = (q, w, sp, ratio, wq + cq)
+    return out
+
+
+# ------------------------------------------- cumulative-window pair
+
+
+def oracle_q51(tables):
+    dd = tables["date_dim"]
+    y2000 = {int(k): int(dv) for k, y, dv in
+             zip(dd["d_date_sk"][0], dd["d_year"][0], dd["d_date"][0])
+             if int(y) == 2000}
+
+    def cume(fact, d_c, i_c, p_c):
+        f = tables[fact]
+        daily = {}
+        for d, i, p in zip(f[d_c][0], f[i_c][0], f[p_c][0]):
+            dv = y2000.get(int(d))
+            if dv is None:
+                continue
+            daily[(int(i), dv)] = daily.get((int(i), dv), 0) + int(p)
+        out = {}
+        by_item = {}
+        for (i, dv), v in daily.items():
+            by_item.setdefault(i, []).append((dv, v))
+        for i, lst in by_item.items():
+            lst.sort()
+            run = 0
+            for dv, v in lst:
+                run += v
+                out[(i, dv)] = run
+        return out
+
+    web = cume("web_sales", "ws_sold_date_sk", "ws_item_sk", "ws_sales_price")
+    store = cume("store_sales", "ss_sold_date_sk", "ss_item_sk", "ss_sales_price")
+    items = {i for i, _ in web} | {i for i, _ in store}
+    out = {}
+    for i in items:
+        dates = sorted({d for (ii, d) in web if ii == i}
+                       | {d for (ii, d) in store if ii == i})
+        wmax = smax = None
+        for d in dates:
+            if (i, d) in web:
+                wmax = web[(i, d)] if wmax is None else max(wmax, web[(i, d)])
+            if (i, d) in store:
+                smax = store[(i, d)] if smax is None else max(smax, store[(i, d)])
+            if wmax is not None and smax is not None and wmax > smax:
+                out[(i, d)] = (wmax, smax)
+    return out
+
+
+def oracle_q67(tables):
+    dd = tables["date_dim"]
+    dinfo = {int(k): (int(y), int(q), int(m)) for k, y, q, m in
+             zip(dd["d_date_sk"][0], dd["d_year"][0], dd["d_qoy"][0],
+                 dd["d_moy"][0]) if int(y) == 2000}
+    st = tables["store"]
+    sname = {int(k): v for k, v in zip(st["s_store_sk"][0], _sv(st, "s_store_name"))}
+    it = tables["item"]
+    iinfo = {int(sk): (c, cl, b, iid) for sk, c, cl, b, iid in
+             zip(it["i_item_sk"][0], _sv(it, "i_category"), _sv(it, "i_class"),
+                 _sv(it, "i_brand"), _sv(it, "i_item_id"))}
+    ss = tables["store_sales"]
+    cells = {}
+    for d, stk, i, q, p in zip(ss["ss_sold_date_sk"][0], ss["ss_store_sk"][0],
+                               ss["ss_item_sk"][0], ss["ss_quantity"][0],
+                               ss["ss_sales_price"][0]):
+        dv = dinfo.get(int(d))
+        if dv is None or int(stk) not in sname or int(i) not in iinfo:
+            continue
+        cat, cl, b, iid = iinfo[int(i)]
+        dims = (cat, cl, b, iid, dv[0], dv[1], dv[2], sname[int(stk)])
+        val = int(q) * int(p)
+        for level in range(8, -1, -1):
+            key = tuple(dims[k] if k < level else None for k in range(8)) + (8 - level,)
+            cells[key] = cells.get(key, 0) + val
+    # rank within category (competition ranking by sumsales desc)
+    by_cat = {}
+    for key, v in cells.items():
+        by_cat.setdefault(key[0], []).append((v, key))
+    out = {}
+    for cat, lst in by_cat.items():
+        lst.sort(key=lambda t: -t[0])
+        for pos, (v, key) in enumerate(lst):
+            rk = 1 + sum(1 for w, _ in lst if w > v)
+            if rk <= 100:
+                out[key] = (v, rk)
+    return out
+
+
+# ------------------------------------------- q14 cross-channel INTERSECT
+
+
+def _oracle_q14_base(tables):
+    dd = tables["date_dim"]
+    info = {int(k): (int(y), int(m)) for k, y, m in
+            zip(dd["d_date_sk"][0], dd["d_year"][0], dd["d_moy"][0])}
+    it = tables["item"]
+    triple = {int(sk): (int(b), int(c), int(cat)) for sk, b, c, cat in
+              zip(it["i_item_sk"][0], it["i_brand_id"][0], it["i_class_id"][0],
+                  it["i_category_id"][0])}
+    chans = [
+        ("store_sales", "ss_sold_date_sk", "ss_item_sk", "ss_quantity", "ss_list_price"),
+        ("catalog_sales", "cs_sold_date_sk", "cs_item_sk", "cs_quantity", "cs_list_price"),
+        ("web_sales", "ws_sold_date_sk", "ws_item_sk", "ws_quantity", "ws_list_price"),
+    ]
+    sets = []
+    total = 0
+    cnt = 0
+    for fact, d_c, i_c, q_c, p_c in chans:
+        f = tables[fact]
+        seen = set()
+        for d, i, q, p in zip(f[d_c][0], f[i_c][0], f[q_c][0], f[p_c][0]):
+            y_m = info.get(int(d))
+            if y_m is None or not (1998 <= y_m[0] <= 2000):
+                continue
+            if int(i) in triple:
+                seen.add(triple[int(i)])
+            total += int(q) * int(p)
+            cnt += 1
+        sets.append(seen)
+    inter = sets[0] & sets[1] & sets[2]
+    cross_items = {sk for sk, tr in triple.items() if tr in inter}
+    # engine avg: decimal HALF_UP at scale+4 (v is decimal(30,2) ->
+    # avg decimal(34,6))
+    num = total * 10_000
+    q_, r_ = divmod(num, cnt)
+    avg_unscaled = q_ + (1 if 2 * r_ >= cnt else 0)
+    return info, triple, cross_items, avg_unscaled, chans
+
+
+def _oracle_q14_cells(tables, info, triple, cross_items, avg_unscaled, chan,
+                      year):
+    fact, d_c, i_c, q_c, p_c = chan
+    f = tables[fact]
+    cells = {}
+    for d, i, q, p in zip(f[d_c][0], f[i_c][0], f[q_c][0], f[p_c][0]):
+        if info.get(int(d)) != (year, 11) or int(i) not in cross_items:
+            continue
+        key = triple[int(i)]
+        acc = cells.setdefault(key, [0, 0])
+        acc[0] += int(q) * int(p)
+        acc[1] += 1
+    thr = avg_unscaled / 1_000_000.0
+    return {k: tuple(v) for k, v in cells.items() if v[0] / 100.0 > thr}
+
+
+def oracle_q14a(tables):
+    info, triple, cross_items, avg_u, chans = _oracle_q14_base(tables)
+    out = {}
+    for chan, name in zip(chans, ("store", "catalog", "web")):
+        cells = _oracle_q14_cells(tables, info, triple, cross_items, avg_u,
+                                  chan, 2002)
+        for (b, c, cat), (s, n) in cells.items():
+            for key in ((name, b, c, cat), (name, b, c, None),
+                        (name, b, None, None), (name, None, None, None),
+                        (None, None, None, None)):
+                acc = out.setdefault(key, [0, 0])
+                acc[0] += s
+                acc[1] += n
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def oracle_q14b(tables):
+    info, triple, cross_items, avg_u, chans = _oracle_q14_base(tables)
+    ty = _oracle_q14_cells(tables, info, triple, cross_items, avg_u, chans[0], 2002)
+    ly = _oracle_q14_cells(tables, info, triple, cross_items, avg_u, chans[0], 2001)
+    out = {}
+    for key, (s, n) in ty.items():
+        if key in ly and s / 100.0 > ly[key][0] / 100.0:
+            out[key] = (s, n, ly[key][0], ly[key][1])
+    return out
+
+
+# ------------------------------------------- inventory / first-sale giants
+
+
+def oracle_q72(tables):
+    hd = tables["household_demographics"]
+    hd_ok = set(hd["hd_demo_sk"][0][
+        np.array(_s_eq(hd, "hd_buy_potential", ">10000"))].tolist())
+    cd = tables["customer_demographics"]
+    cd_ok = set(cd["cd_demo_sk"][0][
+        np.array(_s_eq(cd, "cd_marital_status", "D"))].tolist())
+    dd = tables["date_dim"]
+    dinfo = {int(k): (int(dv), int(w)) for k, dv, w in
+             zip(dd["d_date_sk"][0], dd["d_date"][0], dd["d_week_seq"][0])}
+    it = tables["item"]
+    desc = {int(k): v for k, v in zip(it["i_item_sk"][0], _sv(it, "i_item_desc"))}
+    wh = tables["warehouse"]
+    wname = {int(k): v for k, v in
+             zip(wh["w_warehouse_sk"][0], _sv(wh, "w_warehouse_name"))}
+    inv = tables["inventory"]
+    by_item = {}
+    for d, i, w, q in zip(inv["inv_date_sk"][0], inv["inv_item_sk"][0],
+                          inv["inv_warehouse_sk"][0],
+                          inv["inv_quantity_on_hand"][0]):
+        by_item.setdefault(int(i), []).append((int(d), int(w), int(q)))
+    cs = tables["catalog_sales"]
+    out = {}
+    for sd, shd, i, cdsk, hdsk, q in zip(
+        cs["cs_sold_date_sk"][0], cs["cs_ship_date_sk"][0], cs["cs_item_sk"][0],
+        cs["cs_bill_cdemo_sk"][0], cs["cs_bill_hdemo_sk"][0], cs["cs_quantity"][0],
+    ):
+        if int(hdsk) not in hd_ok or int(cdsk) not in cd_ok:
+            continue
+        d1 = dinfo.get(int(sd))
+        d3 = dinfo.get(int(shd))
+        if d1 is None or d3 is None or not (d3[0] > d1[0] + 5):
+            continue
+        for invd, w, onhand in by_item.get(int(i), ()):
+            d2 = dinfo.get(invd)
+            if d2 is None or d2[1] != d1[1] or not (onhand < int(q)):
+                continue
+            key = (desc[int(i)], wname[w], d1[1])
+            out[key] = out.get(key, 0) + 1
+    return out
+
+
+def _oracle_q64_cells(tables, year):
+    dd = tables["date_dim"]
+    y_sks = set(dd["d_date_sk"][0][dd["d_year"][0] == year].tolist())
+    sr = tables["store_returns"]
+    mult = {}
+    for i, tk in zip(sr["sr_item_sk"][0], sr["sr_ticket_number"][0]):
+        k = (int(i), int(tk))
+        mult[k] = mult.get(k, 0) + 1
+    it = tables["item"]
+    colors = _sv(it, "i_color")
+    ok_colors = {"purple", "burlywood", "indian", "spring", "floral",
+                 "medium", "peach", "saddle", "navy", "slate"}
+    iid = {int(sk): i_id for sk, c, i_id in
+           zip(it["i_item_sk"][0], colors, _sv(it, "i_item_id")) if c in ok_colors}
+    st = tables["store"]
+    sinfo = {int(k): (nm, z) for k, nm, z in
+             zip(st["s_store_sk"][0], _sv(st, "s_store_name"), _sv(st, "s_zip"))}
+    ss = tables["store_sales"]
+    cells = {}
+    for i, tk, stk, d, wc, lp, cp in zip(
+        ss["ss_item_sk"][0], ss["ss_ticket_number"][0], ss["ss_store_sk"][0],
+        ss["ss_sold_date_sk"][0], ss["ss_wholesale_cost"][0],
+        ss["ss_list_price"][0], ss["ss_coupon_amt"][0],
+    ):
+        m = mult.get((int(i), int(tk)), 0)
+        if not m or int(d) not in y_sks or int(i) not in iid or int(stk) not in sinfo:
+            continue
+        nm, z = sinfo[int(stk)]
+        key = (iid[int(i)], nm, z)
+        acc = cells.setdefault(key, [0, 0, 0, 0])
+        acc[0] += m
+        acc[1] += int(wc) * m
+        acc[2] += int(lp) * m
+        acc[3] += int(cp) * m
+    return {k: tuple(v) for k, v in cells.items()}
+
+
+def oracle_q64(tables):
+    c1 = _oracle_q64_cells(tables, 2001)
+    c2 = _oracle_q64_cells(tables, 2002)
+    out = {}
+    for key, v1 in c1.items():
+        v2 = c2.get(key)
+        if v2 is not None and v2[0] <= v1[0]:
+            out[key] = v1 + v2
+    return out

@@ -17,6 +17,27 @@ pytestmark = pytest.mark.slow
 SCALE = 0.002
 N_PARTS = 2
 
+_SINCE_CLEAR = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _clear_caches_every_few_tests():
+    """jaxlib's CPU backend segfaults once enough compiled programs
+    accumulate in one process (see conftest's per-module clear).  This
+    module alone now exceeds that ceiling (58 differential queries),
+    so ALSO clear every 10 tests within it."""
+    yield
+    _SINCE_CLEAR["n"] += 1
+    if _SINCE_CLEAR["n"] % 10 == 0:
+        import jax
+
+        from blaze_tpu.ops.joins.broadcast import clear_join_map_cache
+        from blaze_tpu.runtime.kernel_cache import clear_kernel_cache
+
+        clear_kernel_cache()
+        clear_join_map_cache()
+        jax.clear_caches()
+
 
 @pytest.fixture(scope="module")
 def data():
@@ -579,3 +600,215 @@ def test_q77(data, scans):
 
 def test_q80(data, scans):
     _check_channel_report(run(build_query("q80", scans, N_PARTS)), O.oracle_q80(data))
+
+
+def _check_ship_report(got, exp):
+    cnt, ship, profit = exp
+    assert cnt > 0, "oracle matched no orders"
+    assert got["order_count"] == [cnt]
+    assert got["total_shipping_cost"] == [ship]
+    assert got["total_net_profit"] == [profit]
+
+
+def test_q94(data, scans):
+    _check_ship_report(run(build_query("q94", scans, N_PARTS)), O.oracle_q94(data))
+
+
+def test_q95(data, scans):
+    _check_ship_report(run(build_query("q95", scans, N_PARTS)), O.oracle_q95(data))
+
+
+def test_q16(data, scans):
+    _check_ship_report(run(build_query("q16", scans, N_PARTS)), O.oracle_q16(data))
+
+
+def _check_yoy_customer(got, exp, cols):
+    n = len(got[cols[0]])
+    assert n, "query returned no rows"
+    rows = {tuple(got[c][i] for c in cols) for i in range(n)}
+    assert rows == exp if len(exp) <= 100 else rows <= exp
+    assert got[cols[0]] == sorted(got[cols[0]])
+
+
+def test_q74(data, scans):
+    _check_yoy_customer(
+        run(build_query("q74", scans, N_PARTS)), O.oracle_q74(data),
+        ["c_customer_id", "c_first_name", "c_last_name"],
+    )
+
+
+def test_q11(data, scans):
+    _check_yoy_customer(
+        run(build_query("q11", scans, N_PARTS)), O.oracle_q11(data),
+        ["c_customer_id", "c_preferred_cust_flag", "c_first_name", "c_last_name"],
+    )
+
+
+def test_q23a(data, scans):
+    got = run(build_query("q23a", scans, N_PARTS))
+    exp = O.oracle_q23a(data)
+    assert exp is not None, "q23a oracle empty"
+    assert got["sum_sales"] == [exp]
+
+
+def test_q23b(data, scans):
+    got = run(build_query("q23b", scans, N_PARTS))
+    exp = O.oracle_q23b(data)
+    assert exp, "q23b oracle empty"
+    rows = {
+        (l, f): v for l, f, v in
+        zip(got["c_last_name"], got["c_first_name"], got["sales"])
+    }
+    assert rows == exp if len(exp) <= 100 else all(exp.get(k) == v for k, v in rows.items())
+    assert got["sales"] == sorted(got["sales"], reverse=True)
+
+
+def _check_q24(got, exp):
+    assert exp, "q24 oracle empty"
+    rows = {
+        (l, f, st): v for l, f, st, v in
+        zip(got["c_last_name"], got["c_first_name"], got["s_store_name"],
+            got["paid"])
+    }
+    assert rows == exp
+    keys = list(zip(got["c_last_name"], got["c_first_name"], got["s_store_name"]))
+    assert keys == sorted(keys)
+
+
+def test_q24a(ticket_data, ticket_scans):
+    _check_q24(run(build_query("q24a", ticket_scans, N_PARTS)),
+               O.oracle_q24a(ticket_data))
+
+
+def test_q24b(ticket_data, ticket_scans):
+    _check_q24(run(build_query("q24b", ticket_scans, N_PARTS)),
+               O.oracle_q24b(ticket_data))
+
+
+def test_q75(ticket_data, ticket_scans):
+    got = run(build_query("q75", ticket_scans, N_PARTS))
+    exp = O.oracle_q75(ticket_data)
+    assert exp, "q75 oracle empty"
+    rows = {
+        (b, c, cat, m): (cd, ad) for b, c, cat, m, cd, ad in
+        zip(got["i_brand_id"], got["i_class_id"], got["i_category_id"],
+            got["i_manufact_id"], got["sales_cnt_diff"], got["sales_amt_diff"])
+    }
+    assert rows == exp if len(exp) <= 100 else all(exp.get(k) == v for k, v in rows.items())
+    assert got["sales_cnt_diff"] == sorted(got["sales_cnt_diff"])
+    assert all(y == 2002 for y in got["year"])
+
+
+def test_q78(data, scans):
+    got = run(build_query("q78", scans, N_PARTS))
+    exp = O.oracle_q78(data)
+    assert exp, "q78 oracle empty"
+    n = len(got["ss_item_sk"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["ss_item_sk"][i], got["ss_customer_sk"][i])
+        assert key in exp, key
+        q, w, sp, ratio, other = exp[key]
+        assert (got["ss_qty"][i], got["ss_wc"][i], got["ss_sp"][i]) == (q, w, sp), key
+        assert abs(got["ratio"][i] - ratio) < 1e-12, key
+        assert got["other_chan_qty"][i] == other, key
+    assert got["ss_qty"] == sorted(got["ss_qty"], reverse=True)
+
+
+def test_q51(data, scans):
+    got = run(build_query("q51", scans, N_PARTS))
+    exp = O.oracle_q51(data)
+    assert exp, "q51 oracle empty"
+    n = len(got["item_sk"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["item_sk"][i], got["d_date"][i])
+        assert key in exp, key
+        assert (got["web_cumulative"][i], got["store_cumulative"][i]) == exp[key], key
+    keys = list(zip(got["item_sk"], got["d_date"]))
+    assert keys == sorted(keys)
+    if len(exp) > 100:
+        assert keys == sorted(exp)[:100]
+
+
+def test_q67(data, scans):
+    got = run(build_query("q67", scans, N_PARTS))
+    exp = O.oracle_q67(data)
+    assert exp, "q67 oracle empty"
+    n = len(got["i_category"])
+    assert n == min(len(exp), 100)
+    dims = ["i_category", "i_class", "i_brand", "i_item_id",
+            "d_year", "d_qoy", "d_moy", "s_store_name"]
+    for i in range(n):
+        key = tuple(got[d][i] for d in dims) + (got["g_id"][i],)
+        assert key in exp, key
+        v, rk = exp[key]
+        assert (got["sumsales"][i], got["rk"][i]) == (v, rk), (key, got["sumsales"][i], got["rk"][i], v, rk)
+    order = [((0, "") if got["i_category"][i] is None else (1, got["i_category"][i]), got["rk"][i]) for i in range(n)]
+    assert order == sorted(order)
+
+
+def _nf(v):
+    return (0, 0) if v is None else (1, v)
+
+
+def test_q14a(data, scans):
+    got = run(build_query("q14a", scans, N_PARTS))
+    exp = O.oracle_q14a(data)
+    assert exp, "q14a oracle empty"
+    n = len(got["channel"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["channel"][i], got["i_brand_id"][i], got["i_class_id"][i],
+               got["i_category_id"][i])
+        assert key in exp, key
+        assert (got["sum_sales"][i], got["sum_number_sales"][i]) == exp[key], key
+    order = [tuple(_nf(got[c][i]) for c in
+                   ("channel", "i_brand_id", "i_class_id", "i_category_id"))
+             for i in range(n)]
+    assert order == sorted(order)
+    if len(exp) > 100:
+        full = sorted(tuple(_nf(x) for x in k) for k in exp)
+        assert order == full[:100]
+
+
+def test_q14b(data, scans):
+    got = run(build_query("q14b", scans, N_PARTS))
+    exp = O.oracle_q14b(data)
+    assert exp, "q14b oracle empty"
+    rows = {
+        (b, c, cat): (s, ns, ls, lns) for b, c, cat, s, ns, ls, lns in
+        zip(got["i_brand_id"], got["i_class_id"], got["i_category_id"],
+            got["sales"], got["number_sales"], got["last_sales"],
+            got["last_number_sales"])
+    }
+    assert rows == exp if len(exp) <= 100 else all(exp.get(k) == v for k, v in rows.items())
+
+
+def test_q72(data, scans):
+    got = run(build_query("q72", scans, N_PARTS))
+    exp = O.oracle_q72(data)
+    assert exp, "q72 oracle empty"
+    rows = {
+        (d, w, wk): c for d, w, wk, c in
+        zip(got["i_item_desc"], got["w_warehouse_name"], got["d_week_seq"],
+            got["no_promo"])
+    }
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+    assert got["no_promo"] == sorted(got["no_promo"], reverse=True)
+
+
+def test_q64(data, scans):
+    got = run(build_query("q64", scans, N_PARTS))
+    exp = O.oracle_q64(data)
+    assert exp, "q64 oracle empty"
+    rows = {
+        (i, st, z): (c1, a, b, c, c2, d, e, f) for i, st, z, c1, a, b, c, c2, d, e, f in
+        zip(got["i_item_id"], got["s_store_name"], got["s_zip"], got["cnt"],
+            got["s1"], got["s2"], got["s3"], got["cnt2"], got["s1_2"],
+            got["s2_2"], got["s3_2"])
+    }
+    assert rows == exp if len(exp) <= 100 else all(exp.get(k) == v for k, v in rows.items())
+    assert got["s1"] == sorted(got["s1"], reverse=True)
