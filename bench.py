@@ -361,7 +361,7 @@ def main() -> None:
                 cur = None
             fresh_q01 = cur is not None and cur.get(
                 "q01_rows_per_sec"
-            ) is not None and cur.get("q01_measured_at", "") >= time.strftime(
+            ) is not None and (cur.get("q01_measured_at") or "") >= time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime(t0)
             )
             if cur is not None and (

@@ -101,8 +101,19 @@ def agg_result_type(fn: str, in_t: Optional[DataType]) -> DataType:
         return DataType.float64()
     if fn in ("collect_list", "collect_set"):
         if fn == "collect_set" and in_t.is_nested:
-            # set-dedup needs element sort words, undefined for nested
-            raise NotImplementedError("collect_set over nested element types")
+            # sets of LISTS dedup via (length, validity-flags, value)
+            # words (_elem_sort_words); deeper nesting has no word
+            # encoding yet
+            if not (
+                in_t.kind == TypeKind.ARRAY
+                and not in_t.elem.is_nested
+                and not in_t.elem.is_string
+                and in_t.max_elems <= 64
+            ):
+                raise NotImplementedError(
+                    "collect_set over nested elements beyond "
+                    "array-of-primitive (inner arity <= 64)"
+                )
         return DataType.array(in_t, int(conf.COLLECT_MAX_ELEMS.get()))
     return in_t  # min/max/first
 
@@ -456,6 +467,35 @@ def _elem_sort_words(elem: Column, within) -> List[jnp.ndarray]:
         words.append(
             jnp.where(within, bits.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0))
         )
+    elif elem.dtype.is_nested:
+        # ARRAY-of-primitive elements (set of lists): equality =
+        # (length, inner validity flags, zero-masked inner values).
+        # Deeper nesting/structs stay gated at agg_result_type.
+        inner = elem.children[0]
+        im = elem.dtype.max_elems
+        assert im <= 64, "nested collect_set: inner arity beyond flag word"
+        words.append(jnp.where(within, elem.lengths, 0).astype(jnp.uint64))
+        inner_live = (
+            jnp.arange(im)[None, None, :] < elem.lengths[:, :, None]
+        ) & within[:, :, None]
+        live_valid = inner_live & inner.validity
+        flags = jnp.zeros(within.shape, jnp.uint64)
+        for j in range(im):
+            flags = flags | (live_valid[:, :, j].astype(jnp.uint64) << jnp.uint64(j))
+        words.append(flags)
+        if inner.dtype.is_float:
+            from ..exprs.hash import f64_raw_bits
+
+            d = jnp.where(inner.data == 0, jnp.zeros((), inner.data.dtype), inner.data)
+            d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, inner.data.dtype), d)
+            bits = (
+                d.view(jnp.int32) if inner.data.dtype == jnp.float32 else f64_raw_bits(d)
+            )
+        else:
+            bits = inner.data
+        bits = bits.astype(jnp.int64).view(jnp.uint64)
+        for j in range(im):
+            words.append(jnp.where(live_valid[:, :, j], bits[:, :, j], jnp.uint64(0)))
     else:
         words.append(
             jnp.where(within, elem.data.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0))
@@ -494,6 +534,21 @@ def _dedup_array_state(col: Column) -> Column:
         data = jnp.zeros((cap, m, w), jnp.uint8).at[tgt, new_pos].set(g_data, mode="drop")
         lengths = jnp.zeros((cap, m), jnp.int32).at[tgt, new_pos].set(g_len, mode="drop")
         out_elem = Column(elem_t, data, ev, lengths)
+    elif elem_t.is_nested:
+        # ARRAY-of-primitive elements: permute + scatter the inner
+        # child alongside the per-element lengths/validity
+        inner = elem.children[0]
+        im = elem_t.max_elems
+        g_len = jnp.take_along_axis(elem.lengths, s_idx, axis=1)
+        g_inner = jnp.take_along_axis(inner.data, s_idx[:, :, None], axis=1)
+        g_ival = jnp.take_along_axis(inner.validity, s_idx[:, :, None], axis=1)
+        lengths = jnp.zeros((cap, m), jnp.int32).at[tgt, new_pos].set(g_len, mode="drop")
+        i_data = jnp.zeros((cap, m, im), inner.data.dtype).at[tgt, new_pos].set(
+            g_inner, mode="drop")
+        i_val = jnp.zeros((cap, m, im), jnp.bool_).at[tgt, new_pos].set(
+            g_ival, mode="drop")
+        out_inner = Column(elem_t.elem, i_data, i_val)
+        out_elem = Column(elem_t, None, ev, lengths, (out_inner,))
     else:
         g_data = jnp.take_along_axis(elem.data, s_idx, axis=1)
         data = jnp.zeros((cap, m), elem.data.dtype).at[tgt, new_pos].set(g_data, mode="drop")

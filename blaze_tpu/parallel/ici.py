@@ -60,6 +60,31 @@ def _bucketize(cols: Tuple[Column, ...], pids, live, n_dev: int):
     return stacked, jnp.stack(counts)
 
 
+def _a2a_tail(cols, buckets, counts, n_dev: int, cap: int):
+    """Shared all_to_all + compact tail of every ICI exchange body."""
+    a2a = lambda x: jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=True)
+    received = jax.tree.map(a2a, buckets)
+    recv_counts = jax.lax.all_to_all(counts, DATA_AXIS, 0, 0, tiled=True)
+
+    # flatten (n_dev, cap, ...) -> (n_dev*cap, ...) and compact
+    def flat(x):
+        return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+    flat_cols = []
+    for i in range(len(cols)):
+        c = received.columns[i] if isinstance(received, RecordBatch) else received[i]
+        flat_cols.append(Column(c.dtype, flat(c.data), flat(c.validity),
+                                None if c.lengths is None else flat(c.lengths)))
+    # compact: received rows are bucket-padded; keep = index-within-
+    # bucket < sender count
+    within = jnp.tile(jnp.arange(cap), n_dev)
+    sender = jnp.repeat(jnp.arange(n_dev), cap)
+    keep = within < jnp.take(recv_counts, sender)
+    from ..ops.filter import compact_columns
+
+    return compact_columns(tuple(flat_cols), keep)
+
+
 def ici_exchange_fn(schema: Schema, key_exprs: Sequence[Expr], n_dev: int):
     """Builds the per-device shard_map body: (local cols, num_rows) ->
     (received cols [n_dev*cap], received counts [n_dev])."""
@@ -71,29 +96,27 @@ def ici_exchange_fn(schema: Schema, key_exprs: Sequence[Expr], n_dev: int):
         pids = pmod(murmur3_columns(key_cols), n_dev)
         live = jnp.arange(cap) < num_rows
         buckets, counts = _bucketize(cols, pids, live, n_dev)
+        return _a2a_tail(cols, buckets, counts, n_dev, cap)
 
-        a2a = lambda x: jax.lax.all_to_all(x, DATA_AXIS, 0, 0, tiled=True)
-        received = jax.tree.map(a2a, buckets)
-        recv_counts = jax.lax.all_to_all(counts, DATA_AXIS, 0, 0, tiled=True)
+    return body
 
-        # flatten (n_dev, cap, ...) -> (n_dev*cap, ...) and compact
-        def flat(x):
-            return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
 
-        flat_cols = []
-        for i in range(len(cols)):
-            c = received.columns[i] if isinstance(received, RecordBatch) else received[i]
-            flat_cols.append(Column(c.dtype, flat(c.data), flat(c.validity),
-                                    None if c.lengths is None else flat(c.lengths)))
-        # compact: received rows are bucket-padded; keep = index-within-
-        # bucket < sender count
-        within = jnp.tile(jnp.arange(cap), n_dev)
-        sender = jnp.repeat(jnp.arange(n_dev), cap)
-        keep = within < jnp.take(recv_counts, sender)
-        from ..ops.filter import compact_columns
+def ici_range_exchange_fn(schema: Schema, fields, n_dev: int):
+    """Range-partitioned ICI body: rows route by lexicographic compare
+    of their sort-key ORDER WORDS against replicated boundary words —
+    the global-sort exchange riding the same all_to_all as the hash
+    path (SURVEY §2.3's last mechanism to cross ICI)."""
+    from .exchange import _build_range_kernels
 
-        out_cols, total = compact_columns(tuple(flat_cols), keep)
-        return out_cols, total
+    key_words, _, pid_fn = _build_range_kernels(schema, fields, n_dev)
+
+    def body(cols: Tuple[Column, ...], num_rows, bounds):
+        cap = cols[0].validity.shape[0]
+        live = jnp.arange(cap) < num_rows
+        words = key_words(cols, num_rows)
+        pids = pid_fn(words, bounds)
+        buckets, counts = _bucketize(cols, pids, live, n_dev)
+        return _a2a_tail(cols, buckets, counts, n_dev, cap)
 
     return body
 
@@ -114,10 +137,12 @@ class IciShuffleExchangeExec(ExecNode):
     def __init__(self, child, partitioning, mesh: Mesh):
         import threading
 
-        from .shuffle import HashPartitioning
+        from .shuffle import HashPartitioning, RangePartitioning
 
         super().__init__([child])
-        assert isinstance(partitioning, HashPartitioning), "ICI path needs hash partitioning"
+        assert isinstance(partitioning, (HashPartitioning, RangePartitioning)), (
+            "ICI path needs hash or range partitioning"
+        )
         n_dev = int(mesh.devices.size)
         assert partitioning.num_partitions == n_dev, (
             f"ICI exchange: {partitioning.num_partitions} partitions != {n_dev} devices"
@@ -183,8 +208,17 @@ class IciShuffleExchangeExec(ExecNode):
                 lo, hi = d * per, min((d + 1) * per, n)
                 counts[d] = max(0, hi - lo)
             gbatch = RecordBatch(self.schema, [c.to_device() for c in shard_cols], n)
+            from .shuffle import RangePartitioning
+
             with self.metrics.timer("exchange_time"):
-                out_cols, totals = ici_shuffle(self.mesh, gbatch, counts, self.partitioning.exprs)
+                if isinstance(self.partitioning, RangePartitioning):
+                    out_cols, totals = ici_range_shuffle(
+                        self.mesh, gbatch, counts, self.partitioning.fields, g
+                    )
+                else:
+                    out_cols, totals = ici_shuffle(
+                        self.mesh, gbatch, counts, self.partitioning.exprs
+                    )
             self._result = (
                 tuple(c.to_host() for c in out_cols),
                 np_.asarray(totals),
@@ -235,10 +269,12 @@ def use_ici_exchanges(plan, mesh: Mesh):
 
     n_dev = int(mesh.devices.size)
 
+    from .shuffle import RangePartitioning
+
     def eligible(node) -> bool:
         return (
             isinstance(node, NativeShuffleExchangeExec)
-            and isinstance(node.partitioning, HashPartitioning)
+            and isinstance(node.partitioning, (HashPartitioning, RangePartitioning))
             and node.partitioning.num_partitions == n_dev
         )
 
@@ -280,4 +316,45 @@ def ici_shuffle(
         out_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(DATA_AXIS)),
     )
     out_cols, totals = jax.jit(smapped)(tuple(batch.columns), num_rows_per_shard)
+    return out_cols, totals
+
+
+def ici_range_shuffle(
+    mesh: Mesh,
+    batch: RecordBatch,
+    num_rows_per_shard,
+    fields,
+    global_batch: RecordBatch,
+):
+    """One all-to-all RANGE exchange over the mesh.  Boundary order
+    words are exact order statistics of the whole input (computed once
+    on the contiguous pre-shard batch, then replicated into every
+    device's shard_map body)."""
+    from .exchange import _build_range_kernels
+
+    n_dev = int(mesh.devices.size)
+    schema = batch.schema
+    key_words, boundaries_at, _ = _build_range_kernels(schema, fields, n_dev)
+    n = global_batch.num_rows
+    gdev = tuple(c.to_device() for c in global_batch.columns)
+    words = key_words(gdev, n)
+    positions = jnp.array(
+        [min(max(n - 1, 0), (i * max(n, 1)) // n_dev) for i in range(1, n_dev)],
+        jnp.int32,
+    )
+    bounds = boundaries_at(words, positions)
+
+    body = ici_range_exchange_fn(schema, fields, n_dev)
+
+    def wrapped(cols, nr, bw):
+        out_cols, total = body(cols, nr[0], bw)
+        return out_cols, total[None]
+
+    smapped = jax.shard_map(
+        wrapped,
+        mesh=mesh,
+        in_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(DATA_AXIS), PartitionSpec()),
+        out_specs=(PartitionSpec(DATA_AXIS), PartitionSpec(DATA_AXIS)),
+    )
+    out_cols, totals = jax.jit(smapped)(tuple(batch.columns), num_rows_per_shard, bounds)
     return out_cols, totals

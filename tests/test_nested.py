@@ -327,3 +327,51 @@ def test_collect_list_over_array_elements():
         # as multisets of tuples (inner nulls preserved)
         canon = lambda ls: sorted(tuple(x) for x in ls)
         assert canon(got[g]) == canon(exp[g]), g
+
+
+def test_collect_set_over_array_elements():
+    """collect_set of ARRAY-typed values: element dedup via the
+    (length, validity-flags, value) word encoding — [1,2] == [1,2]
+    across batches, [] != [1], inner nulls distinguish."""
+    from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggFunction, GroupingExpr, MemoryScanExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.schema import DataType, Field, Schema
+    from blaze_tpu.tpch.queries import two_stage_agg
+
+    arr_t = DataType.array(DataType.int64(), 4)
+    schema = Schema([Field("g", DataType.int64()), Field("v", arr_t)])
+    rows = [
+        (0, [1, 2]), (0, [1, 2]), (0, [2, 1]), (0, []),
+        (1, [3]), (1, [3, None]), (1, [3, None]), (1, None),
+        (2, [1, 2]), (2, []), (2, [1, 2, 3]),
+    ]
+    data = {"g": [r[0] for r in rows], "v": [r[1] for r in rows]}
+    parts = [[batch_from_pydict({k: v[:6] for k, v in data.items()}, schema)],
+             [batch_from_pydict({k: v[6:] for k, v in data.items()}, schema)]]
+    src = MemoryScanExec(parts, schema)
+    plan = two_stage_agg(
+        src,
+        [GroupingExpr(col("g"), "g")],
+        [AggFunction("collect_set", col("v"), "sets")],
+        2,
+    )
+    got = {}
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            for g, ls in zip(d["g"], d["sets"]):
+                got[g] = ls
+    exp = {}
+    for g, v in rows:
+        if v is not None:
+            exp.setdefault(g, set()).add(tuple(v))
+    assert set(got) == set(exp)
+    for g in exp:
+        canon = lambda ls: sorted(
+            (tuple(-1 if x is None else x for x in e) for e in ls),
+        )
+        assert canon(got[g]) == canon(
+            [list(e) for e in exp[g]]
+        ), (g, got[g], exp[g])
