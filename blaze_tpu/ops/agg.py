@@ -443,6 +443,16 @@ def _collect_reduce(v: Column, arr_t: DataType, seg, cap: int, merging: bool) ->
     return Column(arr_t, None, jnp.ones(cap, jnp.bool_), counts, (out_elem,))
 
 
+def _canon_float_bits(data):
+    """Equality-canonical float bits: -0.0 -> 0.0, all NaNs -> one
+    payload; f32 views as i32, f64 through the raw-bits helper."""
+    from ..exprs.hash import f64_raw_bits
+
+    d = jnp.where(data == 0, jnp.zeros((), data.dtype), data)
+    d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, data.dtype), d)
+    return d.view(jnp.int32) if data.dtype == jnp.float32 else f64_raw_bits(d)
+
+
 def _elem_sort_words(elem: Column, within) -> List[jnp.ndarray]:
     """Equality-preserving uint64 sort words along the element axis
     (dead slots first key = 1 so they sort last)."""
@@ -459,11 +469,7 @@ def _elem_sort_words(elem: Column, within) -> List[jnp.ndarray]:
                 word = word | (b[:, :, k, j] << jnp.uint64(8 * (7 - j)))
             words.append(jnp.where(within, word, jnp.uint64(0)))
     elif elem.dtype.is_float:
-        from ..exprs.hash import f64_raw_bits
-
-        d = jnp.where(elem.data == 0, jnp.zeros((), elem.data.dtype), elem.data)
-        d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, elem.data.dtype), d)
-        bits = d.view(jnp.int32) if elem.data.dtype == jnp.float32 else f64_raw_bits(d)
+        bits = _canon_float_bits(elem.data)
         words.append(
             jnp.where(within, bits.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0))
         )
@@ -483,16 +489,8 @@ def _elem_sort_words(elem: Column, within) -> List[jnp.ndarray]:
         for j in range(im):
             flags = flags | (live_valid[:, :, j].astype(jnp.uint64) << jnp.uint64(j))
         words.append(flags)
-        if inner.dtype.is_float:
-            from ..exprs.hash import f64_raw_bits
-
-            d = jnp.where(inner.data == 0, jnp.zeros((), inner.data.dtype), inner.data)
-            d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, inner.data.dtype), d)
-            bits = (
-                d.view(jnp.int32) if inner.data.dtype == jnp.float32 else f64_raw_bits(d)
-            )
-        else:
-            bits = inner.data
+        bits = (_canon_float_bits(inner.data) if inner.dtype.is_float
+                else inner.data)
         bits = bits.astype(jnp.int64).view(jnp.uint64)
         for j in range(im):
             words.append(jnp.where(live_valid[:, :, j], bits[:, :, j], jnp.uint64(0)))

@@ -1,5 +1,6 @@
-"""ICI all-to-all shuffle: the TPU fast path for the hash-partition
-exchange when the exchanging tasks are devices of one slice.
+"""ICI all-to-all shuffle: the TPU fast path for the hash- and
+range-partition exchanges when the exchanging tasks are devices of one
+slice.
 
 ≙ SURVEY.md §2.3/§5: "partition-id computation is a pure function of
 murmur3(seed 42) pmod N, so it can run as a TPU kernel and feed either
@@ -213,7 +214,8 @@ class IciShuffleExchangeExec(ExecNode):
             with self.metrics.timer("exchange_time"):
                 if isinstance(self.partitioning, RangePartitioning):
                     out_cols, totals = ici_range_shuffle(
-                        self.mesh, gbatch, counts, self.partitioning.fields, g
+                        self.mesh, gbatch, counts, self.partitioning.fields,
+                        g, n
                     )
                 else:
                     out_cols, totals = ici_shuffle(
@@ -257,7 +259,7 @@ class IciShuffleExchangeExec(ExecNode):
 
 
 def use_ici_exchanges(plan, mesh: Mesh):
-    """Rewrite a built plan: every hash-partitioned
+    """Rewrite a built plan: every hash- or range-partitioned
     NativeShuffleExchangeExec whose partition count matches the mesh
     becomes an IciShuffleExchangeExec (the planner decision from
     SURVEY.md §2.3: ICI within a slice, shuffle files across hosts);
@@ -265,11 +267,9 @@ def use_ici_exchanges(plan, mesh: Mesh):
     swapped in place; USE THE RETURN VALUE (a root exchange is
     returned replaced, not mutated)."""
     from .exchange import NativeShuffleExchangeExec
-    from .shuffle import HashPartitioning
+    from .shuffle import HashPartitioning, RangePartitioning
 
     n_dev = int(mesh.devices.size)
-
-    from .shuffle import RangePartitioning
 
     def eligible(node) -> bool:
         return (
@@ -325,19 +325,34 @@ def ici_range_shuffle(
     num_rows_per_shard,
     fields,
     global_batch: RecordBatch,
+    n: int,
 ):
     """One all-to-all RANGE exchange over the mesh.  Boundary order
-    words are exact order statistics of the whole input (computed once
-    on the contiguous pre-shard batch, then replicated into every
-    device's shard_map body)."""
+    words are exact order statistics of the whole input — computed
+    from the SHARDED device batch already staged for the exchange
+    (dead padded rows sort last as ~0 words, so order-statistic
+    positions < n are unaffected; no second host-to-device copy)."""
     from .exchange import _build_range_kernels
 
     n_dev = int(mesh.devices.size)
     schema = batch.schema
     key_words, boundaries_at, _ = _build_range_kernels(schema, fields, n_dev)
-    n = global_batch.num_rows
-    gdev = tuple(c.to_device() for c in global_batch.columns)
-    words = key_words(gdev, n)
+    cap_total = batch.columns[0].validity.shape[0]
+    per_shard_cap = cap_total // n_dev
+
+    @jax.jit
+    def sharded_words(cols, counts):
+        # liveness of the PADDED shard layout: row r live iff its
+        # within-shard index < that shard's count
+        within = jnp.arange(cap_total) % per_shard_cap
+        shard = jnp.arange(cap_total) // per_shard_cap
+        live = within < jnp.take(counts, shard)
+        words = key_words(cols, cap_total)
+        # key_words masked nothing (num_rows=cap); re-mask dead rows
+        # to sort last
+        return tuple(jnp.where(live, w, ~jnp.uint64(0)) for w in words)
+
+    words = sharded_words(tuple(batch.columns), jnp.asarray(num_rows_per_shard))
     positions = jnp.array(
         [min(max(n - 1, 0), (i * max(n, 1)) // n_dev) for i in range(1, n_dev)],
         jnp.int32,

@@ -99,10 +99,15 @@ _PASS_THROUGH = {
 }
 
 
+# column-preserving execs a NAMING walk may also step through (a real
+# Spark dump's root is often Sort-over-Exchange above the naming agg)
+_NAME_TRANSPARENT = {"SortExec", "ShuffleExchangeExec", "CoalesceExec"}
+
+
 def output_attrs(node: SparkNode) -> List[Tuple[str, str]]:
     """Best-effort [(#id, user name)] for a plan node's output — used
     for the root rename back to user-facing names."""
-    while node.name in _PASS_THROUGH and node.children:
+    while node.name in (_PASS_THROUGH | _NAME_TRANSPARENT) and node.children:
         node = node.child(0)
     key = {
         "ProjectExec": "projectList",

@@ -1,0 +1,498 @@
+"""Generator for the vendored Spark 3.5.1 ``toJSON`` physical-plan
+dumps (spark351_*.json in this directory).
+
+These dumps reproduce the REAL catalyst serialization shape — preorder
+node arrays with child-INDEX fields, ``product-class`` case objects
+for modes/origins/eval modes/join types/build sides, jvmId'ed ExprIds,
+table-qualified attributes, Cast nodes with timeZoneId, date literals
+as days-since-epoch strings, WholeStageCodegen/InputAdapter/
+ColumnarToRow wrappers, and FileSourceScan nodes carrying
+requiredSchema/dataFilters/pushedFilters — so the parser and
+converters are exercised against Spark's actual output encoding, not
+the simplified emulation in tests/spark_fixtures.py (the shape was
+validated against a live Spark 3.5.1 dump for TPC-H q6,
+spark351_q6_plan.json).
+
+Run ``python tests/fixtures/gen_spark351_dumps.py`` to regenerate.
+"""
+
+import datetime
+import json
+import os
+
+X = "org.apache.spark.sql.catalyst.expressions."
+A = "org.apache.spark.sql.catalyst.expressions.aggregate."
+P = "org.apache.spark.sql.execution."
+PHYS = "org.apache.spark.sql.catalyst.plans.physical."
+
+JVM = "a3f18c6d-2b47-4e09-9d45-7c31f8b6e2aa"
+LEGACY = {"product-class": X + "EvalMode$LEGACY$"}
+
+
+def T(cls, children=(), **fields):
+    return {"_cls": cls, "_children": list(children), **fields}
+
+
+def flatten(t):
+    out = []
+
+    def go(n):
+        fields = {k: v for k, v in n.items() if k not in ("_cls", "_children")}
+        out.append({"class": n["_cls"], "num-children": len(n["_children"]), **fields})
+        for c in n["_children"]:
+            go(c)
+
+    go(t)
+    return out
+
+
+def eid(i):
+    return {"product-class": X + "ExprId", "id": i, "jvmId": JVM}
+
+
+def attr(name, i, dtype, table=None):
+    return T(
+        X + "AttributeReference", name=name, dataType=dtype, nullable=True,
+        metadata={}, exprId=eid(i),
+        qualifier=(["spark_catalog", "default", table] if table else []),
+    )
+
+
+def lit(value, dtype):
+    return T(X + "Literal", value=None if value is None else str(value), dataType=dtype)
+
+
+def date_lit(y, m, d):
+    return lit((datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days, "date")
+
+
+def alias(child, name, i):
+    return T(X + "Alias", [child], name=name, exprId=eid(i), qualifier=[],
+             explicitMetadata=None, nonInheritableMetadataKeys=[])
+
+
+def binop(cls, left, right, eval_mode=False):
+    extra = {"evalMode": LEGACY} if eval_mode else {}
+    return T(X + cls, [left, right], left=0, right=1, **extra)
+
+
+def is_not_null(child):
+    return T(X + "IsNotNull", [child], child=0)
+
+
+def cast(child, to):
+    return T(X + "Cast", [child], child=0, dataType=to,
+             timeZoneId="Etc/UTC", evalMode=LEGACY)
+
+
+def and_all(preds):
+    out = preds[0]
+    for p in preds[1:]:
+        out = T(X + "And", [out, p], left=0, right=1)
+    return out
+
+
+def sort_order(child, asc=True):
+    return T(
+        X + "SortOrder", [child], child=0,
+        direction={"product-class": X + ("Ascending$" if asc else "Descending$")},
+        nullOrdering={"product-class": X + ("NullsFirst$" if asc else "NullsLast$")},
+        sameOrderExpressions=[],
+    )
+
+
+def agg_expr(fn, mode, result_id, distinct=False):
+    return T(
+        A + "AggregateExpression", [fn], aggregateFunction=0,
+        mode={"product-class": A + mode + "$"},
+        isDistinct=distinct, filter=None, resultId=eid(result_id),
+    )
+
+
+def sum_(child):
+    return T(A + "Sum", [child], child=0, evalMode=LEGACY)
+
+
+def avg_(child):
+    return T(A + "Average", [child], child=0, evalMode=LEGACY)
+
+
+def count_(child=None):
+    return T(A + "Count", [child or lit(1, "integer")])
+
+
+def wsc(child, stage_id):
+    return T(P + "WholeStageCodegenExec", [child], child=0, codegenStageId=stage_id)
+
+
+def input_adapter(child):
+    return T(P + "InputAdapter", [child], child=0)
+
+
+def col_to_row(child):
+    return T(P + "ColumnarToRowExec", [child], child=0)
+
+
+_SPARK_T = {"date": "date", "integer": "integer", "long": "long", "string": "string"}
+
+
+def scan(table, attrs, data_filters=()):
+    fields = []
+    for a in attrs:
+        dt = a["dataType"]
+        fields.append({
+            "name": a["name"], "type": dt, "nullable": True, "metadata": {},
+        })
+    return T(
+        P + "FileSourceScanExec",
+        relation=None,
+        output=[flatten(a) for a in attrs],
+        requiredSchema={"type": "struct", "fields": fields},
+        partitionFilters=[],
+        optionalBucketSet=None,
+        optionalNumCoalescedBuckets=None,
+        dataFilters=[flatten(f) for f in data_filters],
+        tableIdentifier={
+            "product-class": "org.apache.spark.sql.catalyst.TableIdentifier",
+            "table": table, "database": "default",
+        },
+        disableBucketedScan=False,
+    )
+
+
+def filter_(condition, child):
+    return T(P + "FilterExec", [child], condition=flatten(condition), child=0)
+
+
+def project(plist, child):
+    return T(P + "ProjectExec", [child],
+             projectList=[flatten(p) for p in plist], child=0)
+
+
+def hash_agg(groupings, aggs, child, result=None, offset=0, partial=False,
+             agg_attrs=None):
+    return T(
+        P + "aggregate.HashAggregateExec", [child],
+        requiredChildDistributionExpressions=None if partial else [],
+        isStreaming=False, numShufflePartitions=None,
+        groupingExpressions=[flatten(g) for g in groupings],
+        aggregateExpressions=[flatten(a) for a in aggs],
+        aggregateAttributes=[flatten(a) for a in (agg_attrs or [])],
+        initialInputBufferOffset=offset,
+        resultExpressions=[flatten(r) for r in (result or [])],
+        child=0,
+    )
+
+
+def single_partition():
+    return {"product-class": PHYS + "SinglePartition$"}
+
+
+def hash_partitioning(keys, n):
+    return flatten(T(PHYS + "HashPartitioning", list(keys), numPartitions=n))
+
+
+def range_partitioning(orders, n):
+    return flatten(T(PHYS + "RangePartitioning", list(orders), numPartitions=n))
+
+
+def shuffle(partitioning, child):
+    return T(
+        P + "exchange.ShuffleExchangeExec", [child],
+        outputPartitioning=partitioning, child=0,
+        shuffleOrigin={"product-class": P + "exchange.ENSURE_REQUIREMENTS$"},
+        advisoryPartitionSize=None,
+    )
+
+
+def broadcast(child, keys):
+    return T(
+        P + "exchange.BroadcastExchangeExec", [child],
+        mode={
+            "product-class": P + "joins.HashedRelationBroadcastMode",
+            "key": [flatten(k) for k in keys], "isNullAware": False,
+        },
+        child=0,
+    )
+
+
+def bhj(left_keys, right_keys, join_type, build_left, left, right):
+    return T(
+        P + "joins.BroadcastHashJoinExec", [left, right],
+        leftKeys=[flatten(k) for k in left_keys],
+        rightKeys=[flatten(k) for k in right_keys],
+        joinType={"product-class": "org.apache.spark.sql.catalyst.plans." + join_type + "$"},
+        buildSide={"product-class": P + "joins." + ("BuildLeft$" if build_left else "BuildRight$")},
+        condition=None, left=0, right=1, isNullAwareAntiJoin=False,
+    )
+
+
+def smj(left_keys, right_keys, join_type, left, right):
+    return T(
+        P + "joins.SortMergeJoinExec", [left, right],
+        leftKeys=[flatten(k) for k in left_keys],
+        rightKeys=[flatten(k) for k in right_keys],
+        joinType={"product-class": "org.apache.spark.sql.catalyst.plans." + join_type + "$"},
+        condition=None, left=0, right=1, isSkewJoin=False,
+    )
+
+
+def sort(orders, child, global_=True):
+    return T(P + "SortExec", [child],
+             sortOrder=[flatten(o) for o in orders], child=0,
+             testSpillFrequency=0, **{"global": global_})
+
+
+def take_ordered(n, orders, plist, child):
+    return T(
+        P + "TakeOrderedAndProjectExec", [child], limit=n,
+        sortOrder=[flatten(o) for o in orders],
+        projectList=[flatten(p) for p in plist], child=0, offset=0,
+    )
+
+
+def expand(projections, output, child):
+    return T(
+        P + "ExpandExec", [child],
+        projections=[[flatten(e) for e in proj] for proj in projections],
+        output=[flatten(a) for a in output], child=0,
+    )
+
+
+# ------------------------------------------------------------------ q1
+
+def gen_q1():
+    """TPC-H q1: pruned scan -> filter -> project -> two-stage agg with
+    the avg/sum/count set -> range exchange -> global sort."""
+    li = "lineitem"
+    d122 = "decimal(12,2)"
+    cols = {
+        "l_quantity": (5, d122), "l_extendedprice": (6, d122),
+        "l_discount": (7, d122), "l_tax": (8, d122),
+        "l_returnflag": (9, "string"), "l_linestatus": (10, "string"),
+        "l_shipdate": (11, "date"),
+    }
+    a = {n: attr(n, i, t, li) for n, (i, t) in cols.items()}
+    ship_pred = binop("LessThanOrEqual", a["l_shipdate"], date_lit(1998, 9, 2))
+    sc = scan(li, [a[n] for n in cols], data_filters=[
+        is_not_null(a["l_shipdate"]), ship_pred])
+    f = filter_(and_all([is_not_null(a["l_shipdate"]), ship_pred]),
+                col_to_row(input_adapter(sc)))
+    one = cast(lit(1, "integer"), d122)
+    disc_price = binop("Multiply", a["l_extendedprice"],
+                       binop("Subtract", one, a["l_discount"], True), True)
+    charge = binop("Multiply", disc_price,
+                   binop("Add", cast(lit(1, "integer"), d122), a["l_tax"], True), True)
+    p = project([a["l_returnflag"], a["l_linestatus"], a["l_quantity"],
+                 a["l_extendedprice"],
+                 alias(disc_price, "disc_price", 90),
+                 alias(charge, "charge", 91),
+                 a["l_discount"]], f)
+    dp = attr("disc_price", 90, "decimal(25,4)")
+    ch = attr("charge", 91, "decimal(38,6)")
+    groups = [a["l_returnflag"], a["l_linestatus"]]
+    fns = [
+        ("sum_qty", sum_(a["l_quantity"]), 201),
+        ("sum_base_price", sum_(a["l_extendedprice"]), 202),
+        ("sum_disc_price", sum_(dp), 203),
+        ("sum_charge", sum_(ch), 204),
+        ("avg_qty", avg_(a["l_quantity"]), 205),
+        ("avg_price", avg_(a["l_extendedprice"]), 206),
+        ("avg_disc", avg_(a["l_discount"]), 207),
+        ("count_order", count_(), 208),
+    ]
+    partial = hash_agg(groups, [agg_expr(fn, "Partial", rid) for _, fn, rid in fns],
+                       p, partial=True)
+    ex = shuffle(hash_partitioning(groups, 2), input_adapter(wsc(partial, 1)))
+    results = groups + [
+        alias(attr(name, rid, "decimal(38,6)"), name, 300 + k)
+        for k, (name, _, rid) in enumerate(fns)
+    ]
+    final = hash_agg(groups, [agg_expr(fn, "Final", rid) for _, fn, rid in fns],
+                     input_adapter(ex), result=results)
+    orders = [sort_order(g) for g in groups]
+    ex2 = shuffle(range_partitioning(orders, 2), input_adapter(wsc(final, 2)))
+    return wsc(sort(orders, input_adapter(ex2)), 3)
+
+
+# ------------------------------------------------------------------ q3
+
+def _q3_parts(join_builder):
+    cu = "customer"
+    od = "orders"
+    li = "lineitem"
+    d122 = "decimal(12,2)"
+    c_custkey = attr("c_custkey", 41, "long", cu)
+    c_mkt = attr("c_mktsegment", 42, "string", cu)
+    o_orderkey = attr("o_orderkey", 21, "long", od)
+    o_custkey = attr("o_custkey", 22, "long", od)
+    o_orderdate = attr("o_orderdate", 23, "date", od)
+    o_ship = attr("o_shippriority", 24, "integer", od)
+    l_orderkey = attr("l_orderkey", 1, "long", li)
+    l_price = attr("l_extendedprice", 6, d122, li)
+    l_disc = attr("l_discount", 7, d122, li)
+    l_ship = attr("l_shipdate", 11, "date", li)
+
+    mkt = binop("EqualTo", c_mkt, lit("BUILDING", "string"))
+    cscan = scan(cu, [c_custkey, c_mkt], data_filters=[is_not_null(c_mkt), mkt])
+    cside = project([c_custkey],
+                    filter_(and_all([is_not_null(c_mkt), mkt]),
+                            col_to_row(input_adapter(cscan))))
+    od_pred = binop("LessThan", o_orderdate, date_lit(1995, 3, 15))
+    oscan = scan(od, [o_orderkey, o_custkey, o_orderdate, o_ship],
+                 data_filters=[is_not_null(o_orderdate), od_pred])
+    oside = filter_(and_all([is_not_null(o_orderdate), od_pred]),
+                    col_to_row(input_adapter(oscan)))
+    j1 = join_builder([c_custkey], [o_custkey], cside, oside, stage=1)
+    j1p = project([o_orderkey, o_orderdate, o_ship], j1)
+    l_pred = binop("GreaterThan", l_ship, date_lit(1995, 3, 15))
+    lscan = scan(li, [l_orderkey, l_price, l_disc, l_ship],
+                 data_filters=[is_not_null(l_ship), l_pred])
+    lside = filter_(and_all([is_not_null(l_ship), l_pred]),
+                    col_to_row(input_adapter(lscan)))
+    j2 = join_builder([o_orderkey], [l_orderkey], j1p, lside, stage=2)
+    one = cast(lit(1, "integer"), d122)
+    rev = binop("Multiply", l_price, binop("Subtract", one, l_disc, True), True)
+    p = project([l_orderkey, o_orderdate, o_ship, alias(rev, "rev", 95)], j2)
+    revattr = attr("rev", 95, "decimal(25,4)")
+    groups = [l_orderkey, o_orderdate, o_ship]
+    partial = hash_agg(groups, [agg_expr(sum_(revattr), "Partial", 210)], p,
+                       partial=True)
+    ex = shuffle(hash_partitioning(groups, 2), input_adapter(partial))
+    srev = attr("sum(rev)", 210, "decimal(35,4)")
+    final = hash_agg(
+        groups, [agg_expr(sum_(revattr), "Final", 210)], input_adapter(ex),
+        result=groups + [alias(srev, "revenue", 211)])
+    revenue = attr("revenue", 211, "decimal(35,4)")
+    return take_ordered(
+        10, [sort_order(revenue, asc=False), sort_order(o_orderdate)],
+        [l_orderkey, revenue, o_orderdate, o_ship], final)
+
+
+def gen_q3_bhj():
+    """TPC-H q3 as Spark plans it under the default broadcast
+    threshold: two BuildLeft broadcast hash joins."""
+    def jb(lk, rk, left, right, stage):
+        return bhj(lk, rk, "Inner", True, broadcast(left, lk), right)
+
+    return _q3_parts(jb)
+
+
+def gen_q3_smj():
+    """TPC-H q3 with autoBroadcastJoinThreshold=-1: both joins as
+    exchange -> sort -> SortMergeJoin."""
+    def jb(lk, rk, left, right, stage):
+        ls = sort([sort_order(k) for k in lk],
+                  input_adapter(shuffle(hash_partitioning(lk, 2), left)),
+                  global_=False)
+        rs = sort([sort_order(k) for k in rk],
+                  input_adapter(shuffle(hash_partitioning(rk, 2), right)),
+                  global_=False)
+        return smj(lk, rk, "Inner", ls, rs)
+
+    return _q3_parts(jb)
+
+
+# --------------------------------------------------------- TPC-DS q27
+
+def gen_ds_q27():
+    """TPC-DS q27: demographic slice x date x store x item rollup —
+    ExpandExec carrying Spark's rollup projections (grouped-away
+    columns nulled, spark_grouping_id literal) + two-stage avg."""
+    ss = "store_sales"
+    dd = "date_dim"
+    it = "item"
+    st = "store"
+    cd = "customer_demographics"
+    d72 = "decimal(7,2)"
+    ss_sold = attr("ss_sold_date_sk", 501, "long", ss)
+    ss_item = attr("ss_item_sk", 502, "long", ss)
+    ss_cdemo = attr("ss_cdemo_sk", 503, "long", ss)
+    ss_store = attr("ss_store_sk", 504, "long", ss)
+    ss_q = attr("ss_quantity", 505, "integer", ss)
+    ss_lp = attr("ss_list_price", 506, d72, ss)
+    ss_cp = attr("ss_coupon_amt", 507, d72, ss)
+    ss_sp = attr("ss_sales_price", 508, d72, ss)
+    cd_sk = attr("cd_demo_sk", 511, "long", cd)
+    cd_g = attr("cd_gender", 512, "string", cd)
+    cd_m = attr("cd_marital_status", 513, "string", cd)
+    cd_e = attr("cd_education_status", 514, "string", cd)
+    d_sk = attr("d_date_sk", 521, "long", dd)
+    d_year = attr("d_year", 522, "integer", dd)
+    s_sk = attr("s_store_sk", 531, "long", st)
+    s_state = attr("s_state", 532, "string", st)
+    i_sk = attr("i_item_sk", 541, "long", it)
+    i_id = attr("i_item_id", 542, "string", it)
+
+    cd_pred = and_all([
+        binop("EqualTo", cd_g, lit("M", "string")),
+        binop("EqualTo", cd_m, lit("S", "string")),
+        binop("EqualTo", cd_e, lit("College", "string")),
+    ])
+    cside = project([cd_sk], filter_(cd_pred, col_to_row(input_adapter(
+        scan(cd, [cd_sk, cd_g, cd_m, cd_e])))))
+    d_pred = binop("EqualTo", d_year, lit(2002, "integer"))
+    dside = project([d_sk], filter_(d_pred, col_to_row(input_adapter(
+        scan(dd, [d_sk, d_year])))))
+    sscan = col_to_row(input_adapter(scan(
+        ss, [ss_sold, ss_item, ss_cdemo, ss_store, ss_q, ss_lp, ss_cp, ss_sp])))
+    j = bhj([cd_sk], [ss_cdemo], "Inner", True, broadcast(cside, [cd_sk]), sscan)
+    j = bhj([d_sk], [ss_sold], "Inner", True, broadcast(dside, [d_sk]), j)
+    stside = project([s_sk, s_state], col_to_row(input_adapter(scan(st, [s_sk, s_state]))))
+    j = bhj([s_sk], [ss_store], "Inner", True, broadcast(stside, [s_sk]), j)
+    itside = project([i_sk, i_id], col_to_row(input_adapter(scan(it, [i_sk, i_id]))))
+    j = bhj([i_sk], [ss_item], "Inner", True, broadcast(itside, [i_sk]), j)
+    pre = project([ss_q, ss_lp, ss_cp, ss_sp, i_id, s_state], j)
+
+    gid = attr("spark_grouping_id", 560, "long")
+    out_i = attr("i_item_id", 561, "string")
+    out_s = attr("s_state", 562, "string")
+    projections = [
+        [ss_q, ss_lp, ss_cp, ss_sp, i_id, s_state, lit(0, "long")],
+        [ss_q, ss_lp, ss_cp, ss_sp, i_id, lit(None, "string"), lit(1, "long")],
+        [ss_q, ss_lp, ss_cp, ss_sp, lit(None, "string"), lit(None, "string"),
+         lit(3, "long")],
+    ]
+    ex_node = expand(projections,
+                     [ss_q, ss_lp, ss_cp, ss_sp, out_i, out_s, gid], pre)
+    groups = [out_i, out_s, gid]
+    fns = [
+        ("agg1", avg_(ss_q), 571),
+        ("agg2", avg_(ss_lp), 572),
+        ("agg3", avg_(ss_cp), 573),
+        ("agg4", avg_(ss_sp), 574),
+    ]
+    partial = hash_agg(groups, [agg_expr(fn, "Partial", rid) for _, fn, rid in fns],
+                       ex_node, partial=True)
+    exch = shuffle(hash_partitioning(groups, 2), input_adapter(partial))
+    results = [alias(out_i, "i_item_id", 581), alias(out_s, "s_state", 582),
+               alias(gid, "g_id", 583)] + [
+        alias(attr(name, rid, "double"), name, 590 + k)
+        for k, (name, _, rid) in enumerate(fns)
+    ]
+    final = hash_agg(groups, [agg_expr(fn, "Final", rid) for _, fn, rid in fns],
+                     input_adapter(exch), result=results)
+    out_attrs = [attr("i_item_id", 581, "string"), attr("s_state", 582, "string"),
+                 attr("g_id", 583, "long")] + [
+        attr(name, 590 + k, "double") for k, (name, _, _) in enumerate(fns)]
+    return take_ordered(
+        100, [sort_order(out_attrs[0]), sort_order(out_attrs[1])],
+        out_attrs, final)
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name, gen in (
+        ("spark351_q1_plan.json", gen_q1),
+        ("spark351_q3_bhj_plan.json", gen_q3_bhj),
+        ("spark351_q3_smj_plan.json", gen_q3_smj),
+        ("spark351_ds_q27_rollup_plan.json", gen_ds_q27),
+    ):
+        path = os.path.join(here, name)
+        with open(path, "w") as f:
+            json.dump(flatten(gen()), f)
+        print(name, os.path.getsize(path), "bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1004,3 +1004,26 @@ def test_spark_q35(sess, data):
             assert got[f"sum{j_+1}"][i] == e[3 + 3 * j_], k
     if len(exp) <= 100:
         assert set(keys) == set(exp)
+
+
+def test_spark351_dump_ds_q27_rollup(sess, data):
+    """Real-format TPC-DS q27: ExpandExec carrying Spark's rollup
+    projections (nulled grouped-away columns + spark_grouping_id)."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "spark351_ds_q27_rollup_plan.json")
+    with open(path) as f:
+        js = f.read()
+    assert '"jvmId"' in js and "ExpandExec" in js and "spark_grouping_id" in js
+    got = sess.execute(js)
+    exp = O.oracle_q27(data)
+    assert got["i_item_id"], "no rows"
+    for iid, state, gid, a1, a2, a3, a4 in zip(
+        got["i_item_id"], got["s_state"], got["g_id"],
+        got["agg1"], got["agg2"], got["agg3"], got["agg4"],
+    ):
+        key = (iid, state, gid)
+        assert key in exp, key
+        ea1, ea2, ea3, ea4 = exp[key]
+        assert abs(a1 - ea1) < 1e-9 and (a2, a3, a4) == (ea2, ea3, ea4), key

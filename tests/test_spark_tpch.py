@@ -471,3 +471,58 @@ def test_spark_tpcds_q3_star_join():
     assert exp, "oracle matched no rows"
     _check_brand_report(got, exp, "sum_agg")
     assert got["d_year"] == sorted(got["d_year"])
+
+
+# ------------------------------------------- vendored 3.5.1 dumps (r4)
+
+def _load_dump(name):
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "fixtures", name)
+    with open(path) as f:
+        js = f.read()
+    # the dumps must carry the real-Spark encodings, like the q6 one
+    assert '"jvmId"' in js and '"product-class"' in js
+    return js
+
+
+def test_spark351_dump_q1(sess, data):
+    """Real-format q1: two-stage avg/sum/count set, range-partitioned
+    exchange + global sort above the final aggregate."""
+    js = _load_dump("spark351_q1_plan.json")
+    assert "RangePartitioning" in js and "aggregate.Average" in js
+    got = sess.execute(js)
+    exp = O.oracle_q1(data)
+    keys = list(zip(got["l_returnflag"], got["l_linestatus"]))
+    assert keys == sorted(keys) and set(keys) == set(exp)
+    for i, k in enumerate(keys):
+        e = exp[k]
+        for m in ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
+                  "count_order", "avg_qty", "avg_price", "avg_disc"):
+            assert got[m][i] == e[m], (k, m)
+
+
+def _check_dump_q3(sess, data, name, expect_marker):
+    js = _load_dump(name)
+    assert expect_marker in js
+    got = sess.execute(js)
+    exp = O.oracle_q3(data)
+    rows = list(zip(got["l_orderkey"], got["revenue"],
+                    got["o_orderdate"], got["o_shippriority"]))
+    assert len(rows) == len(exp)
+    assert set((r[0], r[1]) for r in rows) == set((r[0], r[1]) for r in exp)
+    assert [r[1] for r in rows] == sorted((r[1] for r in rows), reverse=True)
+
+
+def test_spark351_dump_q3_bhj(sess, data):
+    """Real-format q3 under the default broadcast threshold: two
+    BuildLeft broadcast hash joins w/ HashedRelationBroadcastMode."""
+    _check_dump_q3(sess, data, "spark351_q3_bhj_plan.json",
+                   "HashedRelationBroadcastMode")
+
+
+def test_spark351_dump_q3_smj(sess, data):
+    """Real-format q3 with broadcasts disabled: exchange -> sort ->
+    SortMergeJoin on both joins."""
+    _check_dump_q3(sess, data, "spark351_q3_smj_plan.json",
+                   "SortMergeJoinExec")
