@@ -187,9 +187,9 @@ def infer_dtype(expr: Expr, schema: Schema) -> DataType:
         return DataType.struct(
             [_Field(nm, infer_dtype(e, schema)) for nm, e in zip(expr.names, expr.exprs)]
         )
-    from .ir import PythonUdf
+    from .ir import PythonUdf, SparkUdfWrapper
 
-    if isinstance(expr, PythonUdf):
+    if isinstance(expr, (PythonUdf, SparkUdfWrapper)):
         return expr.dtype
     raise TypeError(f"cannot infer type of {expr!r}")
 
@@ -837,9 +837,9 @@ def needs_host(expr: Expr) -> bool:
     """Does this tree contain a node only evaluable on host?  ≙ the
     reference's convertExprWithFallback wrapping unconvertible exprs
     into a JVM-callback UDF (NativeConverters.scala:407)."""
-    from .ir import PythonUdf
+    from .ir import PythonUdf, SparkUdfWrapper
 
-    if isinstance(expr, PythonUdf):
+    if isinstance(expr, (PythonUdf, SparkUdfWrapper)):
         return True
     if isinstance(expr, ScalarFunc) and expr.name in HOST_SCALAR_FUNCS:
         return True
@@ -865,6 +865,10 @@ def needs_host(expr: Expr) -> bool:
         children = [c for b in expr.branches for c in b] + ([expr.else_] if expr.else_ is not None else [])
     elif isinstance(expr, ScalarFunc):
         children = expr.args
+    elif isinstance(expr, (GetIndexedField, GetMapValue, GetStructField)):
+        children = [expr.child]
+    elif isinstance(expr, NamedStruct):
+        children = expr.exprs
     return any(needs_host(c) for c in children)
 
 
@@ -875,9 +879,9 @@ def split_host_exprs(exprs: List[Expr]) -> Tuple[List[Expr], List[Tuple[str, Exp
     host_parts: List[Tuple[str, Expr]] = []
 
     def walk(e: Expr) -> Expr:
-        from .ir import PythonUdf
+        from .ir import PythonUdf, SparkUdfWrapper
 
-        if isinstance(e, PythonUdf):
+        if isinstance(e, (PythonUdf, SparkUdfWrapper)):
             name = f"__host_{len(host_parts)}"
             host_parts.append((name, e))
             return Col(name)
@@ -909,6 +913,14 @@ def split_host_exprs(exprs: List[Expr]) -> Tuple[List[Expr], List[Tuple[str, Exp
             return Case([(walk(c), walk(v)) for c, v in e.branches], walk(e.else_) if e.else_ is not None else None)
         if isinstance(e, ScalarFunc):
             return ScalarFunc(e.name, [walk(a) for a in e.args])
+        if isinstance(e, GetIndexedField):
+            return GetIndexedField(walk(e.child), e.index)
+        if isinstance(e, GetMapValue):
+            return GetMapValue(walk(e.child), e.key)
+        if isinstance(e, GetStructField):
+            return GetStructField(walk(e.child), e.name)
+        if isinstance(e, NamedStruct):
+            return NamedStruct(e.names, [walk(x) for x in e.exprs])
         return e
 
     new = [walk(e) for e in exprs]
@@ -922,7 +934,26 @@ def host_eval(expr: Expr, batch) -> Column:
     import re
 
     from ..batch import column_from_numpy, column_from_strings, strings_to_list
-    from .ir import PythonUdf
+    from .ir import PythonUdf, SparkUdfWrapper
+
+    if isinstance(expr, SparkUdfWrapper):
+        # ≙ SparkUDFWrapperExpr: ship the arg batch across the Arrow C
+        # FFI to the registered (stand-in) JVM context.  Wire plans may
+        # bind ARBITRARY converted child exprs (spark_udf_wrapper.rs
+        # binds the converted children), so lower each arg to a column
+        from ..batch import RecordBatch as _RB
+        from ..schema import Field as _Field, Schema as _Schema
+        from ..spark import udf_bridge
+
+        env = {f.name: c for f, c in zip(batch.schema.fields, batch.columns)}
+        arg_cols = [lower(a, batch.schema, env, batch.capacity) for a in expr.args]
+        arg_schema = _Schema([
+            _Field(f"_{i}", infer_dtype(a, batch.schema))
+            for i, a in enumerate(expr.args)
+        ])
+        args = _RB(arg_schema, arg_cols, batch.num_rows)
+        return udf_bridge.evaluate(expr.serialized, args, expr.dtype,
+                                   expr.expr_string)
 
     if isinstance(expr, PythonUdf):
         from ..batch import batch_to_pydict

@@ -199,6 +199,24 @@ class NamedStruct(Expr):
 
 
 @dataclass(eq=False)
+class SparkUdfWrapper(Expr):
+    """The reference's UDF wrapper seam (SparkUDFWrapperContext.scala:
+    37-96, spark_udf_wrapper.rs:45-229): carries the JVM-SERIALIZED
+    Spark expression as OPAQUE bytes; at eval the argument batch
+    crosses the Arrow C FFI to the registered evaluator (the JVM half
+    in the reference; ``spark.udf_bridge`` holds the registry) and the
+    result column crosses back.  Wire-compatible even though no JVM
+    can run in this image — decode always succeeds, evaluation needs
+    an installed evaluator."""
+
+    serialized: bytes
+    args: List[Expr]
+    dtype: "DataType"
+    expr_string: str = ""
+    name: str = "spark_udf"
+
+
+@dataclass(eq=False)
 class PythonUdf(Expr):
     """Host-evaluated python UDF over column args.
 

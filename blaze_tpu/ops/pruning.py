@@ -35,6 +35,7 @@ from ..exprs.ir import (
     Not,
     PythonUdf,
     ScalarFunc,
+    SparkUdfWrapper,
 )
 
 
@@ -64,7 +65,7 @@ def expr_columns(e: Expr) -> Set[str]:
             walk(x.child)
             for v in x.values:
                 walk(v)
-        elif isinstance(x, (ScalarFunc, PythonUdf)):
+        elif isinstance(x, (ScalarFunc, PythonUdf, SparkUdfWrapper)):
             for a in x.args:
                 walk(a)
         elif isinstance(x, GetIndexedField):

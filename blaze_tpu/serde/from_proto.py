@@ -13,7 +13,7 @@ from typing import List, Optional
 from ..exprs.ir import (
     Alias, BinOp, Case, Cast, Col, Expr, GetIndexedField, GetMapValue,
     GetStructField, InList, IsNotNull, IsNull, Like, Lit, NamedStruct, Not,
-    ScalarFunc,
+    ScalarFunc, SparkUdfWrapper,
 )
 from ..schema import DataType, Field, Schema, TypeKind
 from . import plan_pb2 as pb
@@ -131,6 +131,14 @@ def expr_from_proto(n: pb.ExprNode) -> Expr:
     if kind == "named_struct":
         return NamedStruct(
             list(n.named_struct.names), [expr_from_proto(e) for e in n.named_struct.exprs]
+        )
+    if kind == "spark_udf_wrapper":
+        w = n.spark_udf_wrapper
+        return SparkUdfWrapper(
+            bytes(w.serialized),
+            [expr_from_proto(a) for a in w.args],
+            dtype_from_proto(w.dtype),
+            w.expr_string,
         )
     raise NotImplementedError(f"from_proto expr {kind}")
 

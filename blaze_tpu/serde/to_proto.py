@@ -16,7 +16,7 @@ from typing import Optional
 from ..exprs.ir import (
     Alias, BinOp, Case, Cast, Col, Expr, GetIndexedField, GetMapValue,
     GetStructField, InList, IsNotNull, IsNull, Like, Lit, NamedStruct, Not,
-    ScalarFunc,
+    ScalarFunc, SparkUdfWrapper,
 )
 from ..schema import DataType, Field, Schema, TypeKind
 from . import plan_pb2 as pb
@@ -163,6 +163,12 @@ def expr_to_proto(e: Expr) -> pb.ExprNode:
         n.named_struct.names.extend(e.names)
         for a in e.exprs:
             n.named_struct.exprs.add().CopyFrom(expr_to_proto(a))
+    elif isinstance(e, SparkUdfWrapper):
+        n.spark_udf_wrapper.serialized = e.serialized
+        n.spark_udf_wrapper.dtype.CopyFrom(dtype_to_proto(e.dtype))
+        for a in e.args:
+            n.spark_udf_wrapper.args.add().CopyFrom(expr_to_proto(a))
+        n.spark_udf_wrapper.expr_string = e.expr_string
     else:
         raise NotImplementedError(f"to_proto for {type(e).__name__}")
     return n

@@ -1,0 +1,65 @@
+"""The JVM half of the UDF wrapper contract, as a process registry.
+
+≙ reference ``SparkUDFWrapperContext.scala:37-96`` +
+``spark_udf_wrapper.rs:45-229``: the native engine holds the
+JVM-serialized Spark expression as opaque bytes; per batch it EXPORTS
+the bound argument batch through the Arrow C FFI, the JVM context
+evaluates the deserialized expression over it, and the result array
+crosses back through the FFI.
+
+This image has no JVM, so the "JVM context" is a registered evaluator:
+
+- ``register_udf_evaluator(fn)`` installs the stand-in.  ``fn`` gets
+  ``(serialized: bytes, args_ffi_addr: int, args_schema: Schema,
+  out_dtype: DataType)`` — the SAME shape the JNI bridge would hand a
+  ``SparkUDFWrapperContext``: the serialized blob untouched, and the
+  argument batch as an Arrow C ``ArrowArray``/``ArrowSchema`` address
+  (gateway.export_batch_ffi) — and must return the result as an
+  exported single-column batch address.
+- with no evaluator installed, plan DECODE still succeeds (the wire
+  stays compatible); evaluation raises the documented error the
+  reference would raise on a broken JNI env.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..batch import RecordBatch
+from ..schema import DataType, Field, Schema
+
+_EVALUATOR: Optional[Callable] = None
+
+
+def register_udf_evaluator(fn: Optional[Callable]) -> None:
+    """Install (or clear, with None) the process-wide evaluator — the
+    stand-in for the JVM's SparkUDFWrapperContext."""
+    global _EVALUATOR
+    _EVALUATOR = fn
+
+
+def evaluate(serialized: bytes, args_batch: RecordBatch,
+             out_dtype: DataType, expr_string: str = "") -> Column:
+    """One wrapper evaluation: args batch -> Arrow C FFI -> evaluator
+    -> Arrow C FFI -> result column (padded to the batch capacity)."""
+    if _EVALUATOR is None:
+        raise RuntimeError(
+            "SparkUdfWrapper needs a registered evaluator (the JVM half "
+            "of SparkUDFWrapperContext); none installed — "
+            f"expr: {expr_string or '<opaque serialized expression>'}"
+        )
+    from ..gateway import export_batch_ffi, import_batch_ffi
+
+    host = args_batch.to_host()
+    addr = export_batch_ffi(host)
+    out_addr = _EVALUATOR(serialized, addr, host.schema, out_dtype)
+    out_schema = Schema([Field("__udf_out", out_dtype)])
+    out = import_batch_ffi(out_addr, out_schema)
+    assert out.num_rows == args_batch.num_rows, (
+        f"udf evaluator returned {out.num_rows} rows for "
+        f"{args_batch.num_rows} input rows"
+    )
+    # align to the caller's batch capacity (with_capacity pads/shrinks
+    # every buffer, nested children included)
+    out = out.with_capacity(args_batch.capacity)
+    return out.columns[0].to_device()

@@ -1,0 +1,133 @@
+"""Spark-serialized-expression UDF wrapper: the wire seam.
+
+≙ reference ``SparkUDFWrapperContext.scala:37-96`` +
+``spark_udf_wrapper.rs:45-229``: the engine carries the JVM-serialized
+Spark expression as OPAQUE bytes through the plan protobuf; at eval
+the bound argument batch crosses the Arrow C FFI to the JVM context
+and the result array crosses back.  No JVM runs in this image, so the
+tests install a stand-in evaluator at the same seam and assert:
+
+- the proto round trip preserves the serialized blob bit-for-bit
+- evaluation ships args/results through the REAL Arrow C FFI path
+  (gateway export/import — the C structs, not a python shortcut)
+- a TaskDefinition containing the wrapper decodes and executes
+- with no evaluator installed, decode still succeeds (wire compat)
+  and evaluation raises the documented error
+"""
+
+import numpy as np
+import pytest
+
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col
+from blaze_tpu.exprs.ir import SparkUdfWrapper
+from blaze_tpu.ops import MemoryScanExec, ProjectExec
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+from blaze_tpu.spark import udf_bridge
+
+# a stand-in for JavaSerializer output: opaque, non-UTF8, with NULs
+FAKE_SERIALIZED = bytes(range(256)) + b"\xac\xed\x00\x05sr\x00"
+
+SCHEMA = Schema([Field("x", DataType.int64()), Field("y", DataType.int64())])
+
+
+def _plan():
+    data = {"x": [1, 2, None, 4, 5], "y": [10, 20, 30, 40, 50]}
+    scan = MemoryScanExec([[batch_from_pydict(data, SCHEMA)]], SCHEMA)
+    udf = SparkUdfWrapper(
+        FAKE_SERIALIZED, [col("x"), col("y")], DataType.int64(),
+        "jvmexpr(x + y)",
+    )
+    from blaze_tpu.exprs.ir import Alias
+
+    return ProjectExec(scan, [col("x"), Alias(udf, "z")])
+
+
+def _install_add_evaluator(seen):
+    """Evaluator standing where the JVM would: receives the serialized
+    blob + the args as an exported Arrow C array, computes x + y, and
+    returns the result through another FFI export."""
+    from blaze_tpu.gateway import export_batch_ffi, import_batch_ffi
+
+    def evaluate(serialized, args_addr, args_schema, out_dtype):
+        seen.append(bytes(serialized))
+        args = import_batch_ffi(args_addr, args_schema)
+        d = batch_to_pydict(args)
+        # positional args, like the JVM context binds them
+        xs, ys = (d[f.name] for f in args_schema.fields)
+        out = [
+            None if (a is None or b is None) else a + b
+            for a, b in zip(xs, ys)
+        ]
+        out_schema = Schema([Field("__udf_out", out_dtype)])
+        return export_batch_ffi(
+            batch_from_pydict({"__udf_out": out}, out_schema)
+        )
+
+    udf_bridge.register_udf_evaluator(evaluate)
+
+
+def _run(plan):
+    out = {f.name: [] for f in plan.schema.fields}
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            for k in out:
+                out[k].extend(d[k])
+    return out
+
+
+def test_wrapper_proto_roundtrip_preserves_blob():
+    from blaze_tpu.serde.from_proto import expr_from_proto
+    from blaze_tpu.serde.to_proto import expr_to_proto
+
+    udf = SparkUdfWrapper(FAKE_SERIALIZED, [col("x")], DataType.int64(), "f(x)")
+    back = expr_from_proto(expr_to_proto(udf))
+    assert isinstance(back, SparkUdfWrapper)
+    assert back.serialized == FAKE_SERIALIZED  # bit-for-bit
+    assert back.expr_string == "f(x)"
+    assert back.dtype == DataType.int64()
+    assert [a.name for a in back.args] == ["x"]
+
+
+def test_wrapper_eval_crosses_arrow_ffi():
+    seen = []
+    _install_add_evaluator(seen)
+    try:
+        got = _run(_plan())
+    finally:
+        udf_bridge.register_udf_evaluator(None)
+    assert got["z"] == [11, 22, None, 44, 55]
+    assert seen == [FAKE_SERIALIZED]  # blob reached the "JVM" untouched
+
+
+def test_wrapper_through_task_definition():
+    """The wrapper crosses the TaskDefinition protobuf boundary and
+    executes on the decoded plan (the full gateway task path)."""
+    from blaze_tpu.serde.from_proto import run_task
+    from blaze_tpu.serde.to_proto import task_definition
+
+    seen = []
+    _install_add_evaluator(seen)
+    try:
+        td = task_definition(_plan(), "udf_wire", 0, 0)
+        rows = {"x": [], "z": []}
+        for b in run_task(td):
+            d = batch_to_pydict(b)
+            rows["x"].extend(d["x"])
+            rows["z"].extend(d["z"])
+    finally:
+        udf_bridge.register_udf_evaluator(None)
+    assert rows["z"] == [11, 22, None, 44, 55]
+    assert seen == [FAKE_SERIALIZED]
+
+
+def test_wrapper_without_evaluator_decodes_but_refuses_eval():
+    from blaze_tpu.serde.from_proto import run_task
+    from blaze_tpu.serde.to_proto import task_definition
+
+    td = task_definition(_plan(), "udf_wire2", 0, 0)  # decode-compatible
+    with pytest.raises(RuntimeError, match="registered evaluator"):
+        for _ in run_task(td):
+            pass
