@@ -946,14 +946,21 @@ def host_eval(expr: Expr, batch) -> Column:
         from ..spark import udf_bridge
 
         env = {f.name: c for f, c in zip(batch.schema.fields, batch.columns)}
-        arg_cols = [lower(a, batch.schema, env, batch.capacity) for a in expr.args]
+        # args containing host-only subtrees recurse through host_eval
+        # (same routing as the HOST_SCALAR_FUNCS branch below); pure
+        # device subtrees lower eagerly
+        arg_cols = [
+            host_eval(a, batch) if needs_host(a)
+            else lower(a, batch.schema, env, batch.capacity)
+            for a in expr.args
+        ]
         arg_schema = _Schema([
             _Field(f"_{i}", infer_dtype(a, batch.schema))
             for i, a in enumerate(expr.args)
         ])
         args = _RB(arg_schema, arg_cols, batch.num_rows)
         return udf_bridge.evaluate(expr.serialized, args, expr.dtype,
-                                   expr.expr_string)
+                                   expr.expr_string, capacity=batch.capacity)
 
     if isinstance(expr, PythonUdf):
         from ..batch import batch_to_pydict

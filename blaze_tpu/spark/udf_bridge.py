@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-from ..batch import RecordBatch
+from ..batch import Column, RecordBatch
 from ..schema import DataType, Field, Schema
 
 _EVALUATOR: Optional[Callable] = None
@@ -39,9 +39,12 @@ def register_udf_evaluator(fn: Optional[Callable]) -> None:
 
 
 def evaluate(serialized: bytes, args_batch: RecordBatch,
-             out_dtype: DataType, expr_string: str = "") -> Column:
+             out_dtype: DataType, expr_string: str = "",
+             capacity: int = None) -> Column:
     """One wrapper evaluation: args batch -> Arrow C FFI -> evaluator
-    -> Arrow C FFI -> result column (padded to the batch capacity)."""
+    -> Arrow C FFI -> result column, padded to ``capacity`` (the
+    CALLER batch's capacity — a zero-arg wrapper's args batch cannot
+    imply it)."""
     if _EVALUATOR is None:
         raise RuntimeError(
             "SparkUdfWrapper needs a registered evaluator (the JVM half "
@@ -61,5 +64,6 @@ def evaluate(serialized: bytes, args_batch: RecordBatch,
     )
     # align to the caller's batch capacity (with_capacity pads/shrinks
     # every buffer, nested children included)
-    out = out.with_capacity(args_batch.capacity)
+    out = out.with_capacity(capacity if capacity is not None
+                            else args_batch.capacity)
     return out.columns[0].to_device()

@@ -2227,3 +2227,210 @@ def oracle_q64(tables):
         if v2 is not None and v2[0] <= v1[0]:
             out[key] = v1 + v2
     return out
+
+
+# ------------------------------------------- round-4 moderates
+
+
+def oracle_q97(tables):
+    dd = tables["date_dim"]
+    y2000 = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+
+    def pairs(fact, d_c, c_c, i_c):
+        f = tables[fact]
+        return {
+            (int(c), int(i))
+            for d, c, i in zip(f[d_c][0], f[c_c][0], f[i_c][0])
+            if int(d) in y2000
+        }
+
+    ss = pairs("store_sales", "ss_sold_date_sk", "ss_customer_sk", "ss_item_sk")
+    cs = pairs("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk",
+               "cs_item_sk")
+    return (len(ss - cs), len(cs - ss), len(ss & cs))
+
+
+def _oracle_city_tickets(tables, *, dow, cities, hd_ok_fn, amt_c, extra):
+    dd = tables["date_dim"]
+    days = set(dd["d_date_sk"][0][np.isin(dd["d_dow"][0], list(dow))].tolist())
+    st = tables["store"]
+    st_ok = {int(k) for k, c in zip(st["s_store_sk"][0], _sv(st, "s_city"))
+             if c in cities}
+    hd = tables["household_demographics"]
+    hd_ok = {int(k) for k, d, v in zip(hd["hd_demo_sk"][0], hd["hd_dep_count"][0],
+                                       hd["hd_vehicle_count"][0])
+             if hd_ok_fn(int(d), int(v))}
+    ca = tables["customer_address"]
+    city = {int(k): c for k, c in zip(ca["ca_address_sk"][0], _sv(ca, "ca_city"))}
+    ss = tables["store_sales"]
+    cells = {}
+    cols = [ss[c][0] for c in extra]
+    for idx in range(ss["ss_sold_date_sk"][0].shape[0]):
+        if int(ss["ss_sold_date_sk"][0][idx]) not in days:
+            continue
+        if int(ss["ss_store_sk"][0][idx]) not in st_ok:
+            continue
+        if int(ss["ss_hdemo_sk"][0][idx]) not in hd_ok:
+            continue
+        addr = int(ss["ss_addr_sk"][0][idx])
+        if addr not in city:
+            continue
+        key = (int(ss["ss_ticket_number"][0][idx]),
+               int(ss["ss_customer_sk"][0][idx]), city[addr])
+        acc = cells.setdefault(key, [0] * (1 + len(extra)))
+        acc[0] += int(ss[amt_c][0][idx])
+        for k, c in enumerate(cols):
+            acc[1 + k] += int(c[idx])
+    cu = tables["customer"]
+    cust = {int(k): (l, f, int(a)) for k, l, f, a in
+            zip(cu["c_customer_sk"][0], _sv(cu, "c_last_name"),
+                _sv(cu, "c_first_name"), cu["c_current_addr_sk"][0])}
+    out = {}
+    for (tick, csk, bought), vals in cells.items():
+        if csk not in cust:
+            continue
+        last, first, addr = cust[csk]
+        cur = city.get(addr)
+        if cur is None or cur == bought:
+            continue
+        out[(last, first, cur, bought, tick)] = tuple(vals)
+    return out
+
+
+def oracle_q46(tables):
+    return _oracle_city_tickets(
+        tables, dow=(6, 0), cities={"Midway", "Fairview"},
+        hd_ok_fn=lambda d, v: d == 4 or v == 3,
+        amt_c="ss_coupon_amt", extra=["ss_net_profit"],
+    )
+
+
+def oracle_q68(tables):
+    return _oracle_city_tickets(
+        tables, dow=(6, 0), cities={"Midway", "Fairview"},
+        hd_ok_fn=lambda d, v: d == 5 or v == 3,
+        amt_c="ss_ext_sales_price", extra=["ss_ext_list_price"],
+    )
+
+
+def oracle_q79(tables):
+    dd = tables["date_dim"]
+    days = set(dd["d_date_sk"][0][
+        (dd["d_dow"][0] == 1) & (dd["d_year"][0] >= 1998)
+        & (dd["d_year"][0] <= 2000)].tolist())
+    hd = tables["household_demographics"]
+    hd_ok = {int(k) for k, d, v in zip(hd["hd_demo_sk"][0], hd["hd_dep_count"][0],
+                                       hd["hd_vehicle_count"][0])
+             if int(d) == 6 or int(v) > 2}
+    st = tables["store"]
+    s_city = {int(k): c for k, c in zip(st["s_store_sk"][0], _sv(st, "s_city"))}
+    ss = tables["store_sales"]
+    cells = {}
+    for d, h, stk, tick, csk, amt, prof in zip(
+        ss["ss_sold_date_sk"][0], ss["ss_hdemo_sk"][0], ss["ss_store_sk"][0],
+        ss["ss_ticket_number"][0], ss["ss_customer_sk"][0],
+        ss["ss_coupon_amt"][0], ss["ss_net_profit"][0],
+    ):
+        if int(d) not in days or int(h) not in hd_ok or int(stk) not in s_city:
+            continue
+        key = (int(tick), int(csk), s_city[int(stk)])
+        acc = cells.setdefault(key, [0, 0])
+        acc[0] += int(amt)
+        acc[1] += int(prof)
+    cu = tables["customer"]
+    names = {int(k): (l, f) for k, l, f in
+             zip(cu["c_customer_sk"][0], _sv(cu, "c_last_name"),
+                 _sv(cu, "c_first_name"))}
+    out = {}
+    for (tick, csk, city), (amt, prof) in cells.items():
+        if csk not in names:
+            continue
+        last, first = names[csk]
+        out[(last, first, city, tick)] = (amt, prof)
+    return out
+
+
+def _oracle_ship_lag(tables, fact, sold_c, ship_c, wh_c, sm_c, dim_tab,
+                     dim_sk_c, dim_name_c, dim_fk, year):
+    dd = tables["date_dim"]
+    sold_days = {int(k): int(v) for k, v, y in
+                 zip(dd["d_date_sk"][0], dd["d_date"][0], dd["d_year"][0])
+                 if int(y) == year}
+    all_days = dict(zip(dd["d_date_sk"][0].tolist(), dd["d_date"][0].tolist()))
+    wh = tables["warehouse"]
+    wname = {int(k): v for k, v in
+             zip(wh["w_warehouse_sk"][0], _sv(wh, "w_warehouse_name"))}
+    sm = tables["ship_mode"]
+    smt = {int(k): v for k, v in zip(sm["sm_ship_mode_sk"][0], _sv(sm, "sm_type"))}
+    dim = tables[dim_tab]
+    dname = {int(k): v for k, v in
+             zip(dim[dim_sk_c][0], _sv(dim, dim_name_c))}
+    f = tables[fact]
+    out = {}
+    for sd, shd, w, m, dk in zip(f[sold_c][0], f[ship_c][0], f[wh_c][0],
+                                 f[sm_c][0], f[dim_fk][0]):
+        sold = sold_days.get(int(sd))
+        ship = all_days.get(int(shd))
+        if sold is None or ship is None:
+            continue
+        if int(w) not in wname or int(m) not in smt or int(dk) not in dname:
+            continue
+        lag = ship - sold
+        key = (wname[int(w)], smt[int(m)], dname[int(dk)])
+        acc = out.setdefault(key, [0, 0, 0, 0, 0])
+        if lag <= 30:
+            acc[0] += 1
+        elif lag <= 60:
+            acc[1] += 1
+        elif lag <= 90:
+            acc[2] += 1
+        elif lag <= 120:
+            acc[3] += 1
+        else:
+            acc[4] += 1
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def oracle_q62(tables):
+    return _oracle_ship_lag(tables, "web_sales", "ws_sold_date_sk",
+                            "ws_ship_date_sk", "ws_warehouse_sk",
+                            "ws_ship_mode_sk", "web_site", "web_site_sk",
+                            "web_name", "ws_web_site_sk", 2001)
+
+
+def oracle_q99(tables):
+    return _oracle_ship_lag(tables, "catalog_sales", "cs_sold_date_sk",
+                            "cs_ship_date_sk", "cs_warehouse_sk",
+                            "cs_ship_mode_sk", "call_center",
+                            "cc_call_center_sk", "cc_name",
+                            "cs_call_center_sk", 2001)
+
+
+def _oracle_inv_price(tables, fact, item_c):
+    it = tables["item"]
+    win = _win_sks(tables, (2000, 2, 1), (2000, 4, 1))
+    inv = tables["inventory"]
+    stocked = {
+        int(i)
+        for d, i, q in zip(inv["inv_date_sk"][0], inv["inv_item_sk"][0],
+                           inv["inv_quantity_on_hand"][0])
+        if int(d) in win and 100 <= int(q) <= 500
+    }
+    sold = {int(i) for i in tables[fact][item_c][0]}
+    out = set()
+    ids = _sv(it, "i_item_id")
+    descs = _sv(it, "i_item_desc")
+    for k in range(it["i_item_sk"][0].shape[0]):
+        price = int(it["i_current_price"][0][k])
+        sk = int(it["i_item_sk"][0][k])
+        if 3000 <= price <= 6000 and sk in stocked and sk in sold:
+            out.add((ids[k], descs[k], price))
+    return out
+
+
+def oracle_q37(tables):
+    return _oracle_inv_price(tables, "catalog_sales", "cs_item_sk")
+
+
+def oracle_q82(tables):
+    return _oracle_inv_price(tables, "store_sales", "ss_item_sk")

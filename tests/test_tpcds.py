@@ -812,3 +812,88 @@ def test_q64(data, scans):
     }
     assert rows == exp if len(exp) <= 100 else all(exp.get(k) == v for k, v in rows.items())
     assert got["s1"] == sorted(got["s1"], reverse=True)
+
+
+def test_q97(data, scans):
+    got = run(build_query("q97", scans, N_PARTS))
+    so, co, both = O.oracle_q97(data)
+    assert (got["store_only"], got["catalog_only"],
+            got["store_and_catalog"]) == ([so], [co], [both])
+
+
+def _check_city_tickets(got, exp, sum_names):
+    assert exp, "oracle empty"
+    n = len(got["ss_ticket_number"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["c_last_name"][i], got["c_first_name"][i],
+               got["current_city"][i], got["bought_city"][i],
+               got["ss_ticket_number"][i])
+        assert key in exp, key
+        assert tuple(got[c][i] for c in sum_names) == exp[key], key
+    keys = [tuple(got[c][i] for c in
+                  ("c_last_name", "c_first_name", "current_city",
+                   "bought_city", "ss_ticket_number")) for i in range(n)]
+    assert keys == sorted(keys)
+
+
+def test_q46(data, scans):
+    _check_city_tickets(run(build_query("q46", scans, N_PARTS)),
+                        O.oracle_q46(data), ["amt", "sum_ss_net_profit"])
+
+
+def test_q68(data, scans):
+    _check_city_tickets(run(build_query("q68", scans, N_PARTS)),
+                        O.oracle_q68(data), ["amt", "sum_ss_ext_list_price"])
+
+
+def test_q79(data, scans):
+    got = run(build_query("q79", scans, N_PARTS))
+    exp = O.oracle_q79(data)
+    assert exp, "q79 oracle empty"
+    n = len(got["ss_ticket_number"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["c_last_name"][i], got["c_first_name"][i],
+               got["s_city"][i], got["ss_ticket_number"][i])
+        assert key in exp, key
+        assert (got["amt"][i], got["profit"][i]) == exp[key], key
+
+
+def _check_ship_lag(got, exp, dim_name):
+    assert exp, "oracle empty"
+    n = len(got["w_warehouse_name"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["w_warehouse_name"][i], got["sm_type"][i], got[dim_name][i])
+        assert key in exp, key
+        assert tuple(got[b][i] for b in
+                     ("d30", "d60", "d90", "d120", "dmore")) == exp[key], key
+    keys = [(got["w_warehouse_name"][i], got["sm_type"][i], got[dim_name][i])
+            for i in range(n)]
+    assert keys == sorted(keys)
+
+
+def test_q62(data, scans):
+    _check_ship_lag(run(build_query("q62", scans, N_PARTS)),
+                    O.oracle_q62(data), "web_name")
+
+
+def test_q99(data, scans):
+    _check_ship_lag(run(build_query("q99", scans, N_PARTS)),
+                    O.oracle_q99(data), "cc_name")
+
+
+def _check_inv_price(got, exp):
+    assert exp, "oracle empty"
+    rows = set(zip(got["i_item_id"], got["i_item_desc"], got["i_current_price"]))
+    assert rows == exp if len(exp) <= 100 else rows <= exp
+    assert got["i_item_id"] == sorted(got["i_item_id"])
+
+
+def test_q37(data, scans):
+    _check_inv_price(run(build_query("q37", scans, N_PARTS)), O.oracle_q37(data))
+
+
+def test_q82(data, scans):
+    _check_inv_price(run(build_query("q82", scans, N_PARTS)), O.oracle_q82(data))
