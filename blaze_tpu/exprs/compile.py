@@ -945,14 +945,20 @@ def host_eval(expr: Expr, batch) -> Column:
         from ..schema import Field as _Field, Schema as _Schema
         from ..spark import udf_bridge
 
-        env = {f.name: c for f, c in zip(batch.schema.fields, batch.columns)}
-        # args containing host-only subtrees recurse through host_eval
-        # (same routing as the HOST_SCALAR_FUNCS branch below); pure
-        # device subtrees lower eagerly
+        # args containing host-only SUBTREES split the same way
+        # operator projections do: hoist each host node, evaluate it,
+        # inject as a synthetic column, lower the remainder on device
+        dev_args, parts = split_host_exprs(list(expr.args))
+        aug_fields = list(batch.schema.fields)
+        aug_cols = list(batch.columns)
+        for nm, sub in parts:
+            c = host_eval(sub, batch)
+            aug_fields.append(_Field(nm, c.dtype))
+            aug_cols.append(c)
+        aug_schema = _Schema(aug_fields)
+        env = {f.name: c for f, c in zip(aug_fields, aug_cols)}
         arg_cols = [
-            host_eval(a, batch) if needs_host(a)
-            else lower(a, batch.schema, env, batch.capacity)
-            for a in expr.args
+            lower(a, aug_schema, env, batch.capacity) for a in dev_args
         ]
         arg_schema = _Schema([
             _Field(f"_{i}", infer_dtype(a, batch.schema))

@@ -22,7 +22,10 @@ public ORC v1 spec (no pyorc/pyarrow in the image):
   Stripe statistics drive predicate pruning (the stripe granularity
   of the reference's ORC scan pushdown).
 
-Unsupported (gated, not silently wrong): compound types.
+Compound types: LIST of primitive reads (LENGTH stream + child
+PRESENT/DATA, rectangularized to the declared max_elems).
+Unsupported (gated, not silently wrong): maps, structs,
+nested-of-nested, lists in the writer.
 """
 
 from __future__ import annotations
@@ -42,6 +45,7 @@ MAGIC = b"ORC"
 K_BOOLEAN, K_BYTE, K_SHORT, K_INT, K_LONG, K_FLOAT, K_DOUBLE, K_STRING = range(8)
 K_BINARY = 8
 K_TIMESTAMP = 9
+K_LIST = 10
 K_STRUCT = 12
 K_DECIMAL = 14
 K_DATE = 15
@@ -710,6 +714,11 @@ class OrcFileMeta:
     stripes: List[StripeInfo]
     num_rows: int
     compression: int = C_NONE
+    # per top-level field: its column id in the flattened type tree
+    # (flat files: 1..n; a LIST field consumes its child's id too)
+    field_ids: List[int] = None
+    # field name -> element column id (LIST fields only)
+    child_ids: dict = None
 
 
 def _decode_type(b: bytes) -> Tuple[int, List[int], List[str], int, int]:
@@ -786,7 +795,7 @@ def _decode_col_stats(b: bytes):
     return mn, mx, has_null
 
 
-def read_metadata(path: str, string_width: int = 64) -> OrcFileMeta:
+def read_metadata(path: str, list_elems: int = 16, string_width: int = 64) -> OrcFileMeta:
     from .fs import get_fs
 
     with get_fs(path).open(path) as f:
@@ -842,16 +851,30 @@ def read_metadata(path: str, string_width: int = 64) -> OrcFileMeta:
     if kind0 != K_STRUCT:
         raise NotImplementedError("ORC subset: root must be a struct")
     fields = []
-    for name, st in zip(names, subtypes):
-        kind, _, _, precision, scale = _decode_type(types[st])
+    field_ids: List[int] = []
+    child_ids: dict = {}
+
+    def prim_dtype(kind, precision, scale):
         if kind == K_DECIMAL:
-            dt = DataType.decimal(precision or 18, scale)
-        elif kind == K_STRING:
-            dt = DataType.string(string_width)
-        elif kind in _KIND_TO_DTYPE:
-            dt = _KIND_TO_DTYPE[kind]
+            return DataType.decimal(precision or 18, scale)
+        if kind == K_STRING:
+            return DataType.string(string_width)
+        if kind in _KIND_TO_DTYPE:
+            return _KIND_TO_DTYPE[kind]
+        raise NotImplementedError(f"ORC subset: type kind {kind}")
+
+    for name, st in zip(names, subtypes):
+        kind, subs, _, precision, scale = _decode_type(types[st])
+        field_ids.append(st)
+        if kind == K_LIST:
+            # LIST of primitive: the child occupies the next type id
+            ck, _, _, cp, cs = _decode_type(types[subs[0]])
+            if ck in (K_LIST, K_STRUCT, 11):
+                raise NotImplementedError("ORC subset: nested-of-nested")
+            dt = DataType.array(prim_dtype(ck, cp, cs), list_elems)
+            child_ids[name] = subs[0]
         else:
-            raise NotImplementedError(f"ORC subset: type kind {kind}")
+            dt = prim_dtype(kind, precision, scale)
         fields.append(Field(name, dt))
     schema = Schema(fields)
 
@@ -864,10 +887,11 @@ def read_metadata(path: str, string_width: int = 64) -> OrcFileMeta:
     for si, st in enumerate(stripes):
         if si < len(stripe_stats):
             cols = stripe_stats[si]
-            for ci, fld in enumerate(schema.fields, start=1):
+            for ci, fld in zip(field_ids, schema.fields):
                 if ci < len(cols):
                     st.stats[fld.name] = _decode_col_stats(cols[ci])
-    return OrcFileMeta(schema, stripes, num_rows, compression)
+    return OrcFileMeta(schema, stripes, num_rows, compression,
+                       field_ids=field_ids, child_ids=child_ids)
 
 
 S_ROW_INDEX, S_BLOOM_FILTER, S_BLOOM_FILTER_UTF8 = 6, 7, 8
@@ -948,7 +972,8 @@ def read_stripe(
 
     rows = stripe.rows
     out = {}
-    for ci, fld in enumerate(meta.schema.fields, start=1):
+    ids = meta.field_ids or list(range(1, len(meta.schema.fields) + 1))
+    for ci, fld in zip(ids, meta.schema.fields):
         st = per_col.get(ci, {})
         enc = encodings[ci][0] if ci < len(encodings) else E_DIRECT
         dict_size = encodings[ci][1] if ci < len(encodings) else 0
@@ -1014,6 +1039,51 @@ def read_stripe(
                     data[i, : min(L, w)] = np.frombuffer(body, np.uint8, min(L, w), pos)
                     lengths[i] = min(L, w)
                     pos += L
+        elif fld.dtype.kind == TypeKind.ARRAY:
+            # LIST of primitive: LENGTH stream at the list column,
+            # PRESENT+DATA at the child column id; rectangularized to
+            # the declared max_elems (long lists truncate — the padded
+            # layout's documented cap, as for collect_list)
+            et = fld.dtype.elem
+            m = fld.dtype.max_elems
+            cid = (meta.child_ids or {}).get(fld.name, ci + 1)
+            ln = int_decode(dec(ci, S_LENGTH), nvals, False, enc)
+            lengths = np.zeros(rows, np.int32)
+            lengths[validity] = ln.astype(np.int32)
+            total = int(ln.sum())
+            cst = per_col.get(cid, {})
+            cenc = encodings[cid][0] if cid < len(encodings) else E_DIRECT
+            evalid = (
+                _bool_decode(dec(cid, S_PRESENT), total)
+                if S_PRESENT in cst
+                else np.ones(total, bool)
+            )
+            cn = int(evalid.sum())
+            ek = et.kind
+            if ek in (TypeKind.INT16, TypeKind.INT32, TypeKind.INT64,
+                      TypeKind.DATE32, TypeKind.DECIMAL):
+                if ek == TypeKind.DECIMAL:
+                    cvals = _varint_stream_decode(dec(cid, S_DATA), cn)
+                else:
+                    cvals = int_decode(dec(cid, S_DATA), cn, True, cenc)
+            elif ek in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+                cvals = np.frombuffer(dec(cid, S_DATA), et.np_dtype, cn)
+            else:
+                raise NotImplementedError(f"ORC subset: list element {et!r}")
+            flat = np.zeros(total, et.np_dtype)
+            flat[evalid] = cvals.astype(et.np_dtype, copy=False)
+            edata = np.zeros((rows, m), et.np_dtype)
+            evalid2 = np.zeros((rows, m), bool)
+            pos = 0
+            for j, r in enumerate(np.flatnonzero(validity)):
+                L = int(ln[j])
+                k = min(L, m)
+                edata[r, :k] = flat[pos : pos + k]
+                evalid2[r, :k] = evalid[pos : pos + k]
+                pos += L
+            out[fld.name] = (None, validity, np.minimum(lengths, m),
+                             (edata, evalid2))
+            continue
         else:
             raise NotImplementedError(f"ORC subset: {fld.dtype!r}")
         out[fld.name] = (data, validity, lengths)

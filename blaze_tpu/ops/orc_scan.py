@@ -84,6 +84,18 @@ class OrcScanExec(ExecNode):
                 np.zeros(cap, np.bool_),
                 np.zeros(cap, np.int32),
             )
+        if dtype.kind.name == "ARRAY":
+            elem = self._null_column(dtype.elem, cap * dtype.max_elems)
+            elem = Column(
+                dtype.elem,
+                None if elem.data is None else elem.data.reshape(
+                    (cap, dtype.max_elems) + elem.data.shape[1:]),
+                elem.validity.reshape(cap, dtype.max_elems),
+                None if elem.lengths is None else elem.lengths.reshape(
+                    cap, dtype.max_elems),
+            )
+            return Column(dtype, None, np.zeros(cap, np.bool_),
+                          np.zeros(cap, np.int32), (elem,))
         return Column(dtype, np.zeros(cap, dtype.np_dtype), np.zeros(cap, np.bool_))
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
@@ -94,9 +106,14 @@ class OrcScanExec(ExecNode):
                 [f.dtype.string_width for f in self._schema.fields if f.dtype.is_string],
                 default=64,
             )
+            max_elems = max(
+                [f.dtype.max_elems for f in self._schema.fields
+                 if f.dtype.kind.name == "ARRAY"], default=16,
+            )
             for path in files:
                 try:
-                    meta = orc.read_metadata(path, string_width=max_w)
+                    meta = orc.read_metadata(path, list_elems=max_elems,
+                                             string_width=max_w)
                 except Exception:
                     if bool(conf.IGNORE_CORRUPT_FILES.get()):
                         self.metrics.add("skipped_corrupt_files", 1)
@@ -131,6 +148,25 @@ class OrcScanExec(ExecNode):
                         for f in self._schema.fields:
                             if f.name not in raw:
                                 cols.append(self._null_column(f.dtype, cap))
+                                continue
+                            if len(raw[f.name]) == 4:
+                                # LIST column: (None, validity, lengths,
+                                # (elem_data, elem_valid)) from the reader
+                                _, validity, lengths, (ed, ev) = raw[f.name]
+                                m = f.dtype.max_elems
+                                ed2 = np.zeros((cap, m), f.dtype.elem.np_dtype)
+                                ev2 = np.zeros((cap, m), np.bool_)
+                                k = min(m, ed.shape[1])
+                                ed2[: e - s, :k] = ed[s:e, :k].astype(
+                                    f.dtype.elem.np_dtype, copy=False)
+                                ev2[: e - s, :k] = ev[s:e, :k]
+                                elem = Column(f.dtype.elem, ed2, ev2)
+                                cols.append(Column(
+                                    f.dtype, None,
+                                    _pad_1d(validity[s:e], cap),
+                                    _pad_1d(np.minimum(lengths[s:e], m), cap),
+                                    (elem,),
+                                ))
                                 continue
                             data, validity, lengths = raw[f.name]
                             if f.dtype.is_string:

@@ -140,3 +140,44 @@ def test_rlev2_patterns(tmp_path):
     got, _ = _read_ours(path, schema)
     for nm in table.column_names:
         assert got[nm] == table[nm].to_pylist(), nm
+
+
+def test_pyarrow_orc_list_column(tmp_path):
+    """LIST<int64> columns written by pyarrow's ORC writer: LENGTH
+    stream + child PRESENT/DATA decode, incl. null rows, empty lists
+    and null elements."""
+    import random
+
+    rng = random.Random(7)
+    rows = []
+    for i in range(500):
+        r = rng.random()
+        if r < 0.1:
+            rows.append(None)
+        elif r < 0.25:
+            rows.append([])
+        else:
+            rows.append([
+                None if rng.random() < 0.15 else rng.randrange(-10**9, 10**9)
+                for _ in range(rng.randrange(1, 7))
+            ])
+    ids = list(range(500))
+    table = pa.table({
+        "id": pa.array(ids, pa.int64()),
+        "vals": pa.array(rows, pa.list_(pa.int64())),
+    })
+    path = str(tmp_path / "lists.orc")
+    paorc.write_table(table, path)
+
+    schema = Schema([
+        Field("id", DataType.int64()),
+        Field("vals", DataType.array(DataType.int64(), 8)),
+    ])
+    scan = OrcScanExec([[path]], schema, batch_rows=128)
+    got_ids, got_vals = [], []
+    for b in scan.execute(0, TaskContext(0, 1)):
+        d = batch_to_pydict(b)
+        got_ids.extend(d["id"])
+        got_vals.extend(d["vals"])
+    assert got_ids == ids
+    assert got_vals == rows

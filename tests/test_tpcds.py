@@ -887,6 +887,7 @@ def test_q99(data, scans):
 def _check_inv_price(got, exp):
     assert exp, "oracle empty"
     rows = set(zip(got["i_item_id"], got["i_item_desc"], got["i_current_price"]))
+    assert len(rows) == min(len(exp), 100)
     assert rows == exp if len(exp) <= 100 else rows <= exp
     assert got["i_item_id"] == sorted(got["i_item_id"])
 

@@ -131,3 +131,38 @@ def test_wrapper_without_evaluator_decodes_but_refuses_eval():
     with pytest.raises(RuntimeError, match="registered evaluator"):
         for _ in run_task(td):
             pass
+
+
+def test_wrapper_nested_inside_wrapper_arg():
+    """A wrapper whose ARG is another wrapper (host subtree inside an
+    arg expr): both hoist through the split machinery and evaluate
+    through the FFI in dependency order."""
+    from blaze_tpu.batch import batch_from_pydict as bfp
+    from blaze_tpu.exprs.ir import Alias
+    from blaze_tpu.gateway import export_batch_ffi, import_batch_ffi
+
+    def evaluate(serialized, args_addr, args_schema, out_dtype):
+        args = import_batch_ffi(args_addr, args_schema)
+        d = batch_to_pydict(args)
+        cols = [d[f.name] for f in args_schema.fields]
+        out = [
+            None if any(v is None for v in row) else sum(row) + 1
+            for row in zip(*cols)
+        ]
+        out_schema = Schema([Field("__udf_out", out_dtype)])
+        return export_batch_ffi(bfp({"__udf_out": out}, out_schema))
+
+    udf_bridge.register_udf_evaluator(evaluate)
+    try:
+        data = {"x": [1, 2, None], "y": [10, 20, 30]}
+        scan = MemoryScanExec([[batch_from_pydict(data, SCHEMA)]], SCHEMA)
+        inner = SparkUdfWrapper(b"inner", [col("x"), col("y")],
+                                DataType.int64(), "inner(x,y)")
+        outer = SparkUdfWrapper(b"outer", [inner], DataType.int64(),
+                                "outer(inner)")
+        plan = ProjectExec(scan, [Alias(outer, "z")])
+        got = _run(plan)
+    finally:
+        udf_bridge.register_udf_evaluator(None)
+    # inner = x+y+1; outer = inner+1
+    assert got["z"] == [13, 24, None]
