@@ -491,7 +491,9 @@ def test_range_partitioning_plan_global_sort():
     )
     srt = F.sort([F.sort_order(F.attr("l_extendedprice", 2))], ex)
     out = sess.execute(F.flatten(srt))
-    assert out["#2"] == sorted(data["l_extendedprice"])
+    # the root-naming walk now steps through Sort/Exchange to the scan,
+    # so the output carries the user-facing column name
+    assert out["l_extendedprice"] == sorted(data["l_extendedprice"])
 
 
 def test_generate_json_tuple_conversion():
