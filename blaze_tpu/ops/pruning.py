@@ -97,6 +97,11 @@ def _narrow(child, needed: Set[str]):
         # broadcast build side): leave untouched
         return child
     names = [n for n in child.schema.names if n in needed]
+    if not names and child.schema.names:
+        # an all-literal consumer (q28/q90-style scalar projections)
+        # references NO columns, but batches still carry row counts and
+        # capacities through their columns — keep one anchor column
+        names = [child.schema.names[0]]
     if len(names) == len(child.schema.names):
         return child
     if isinstance(child, (ParquetScanExec, OrcScanExec)):

@@ -2434,3 +2434,323 @@ def oracle_q37(tables):
 
 def oracle_q82(tables):
     return _oracle_inv_price(tables, "store_sales", "ss_item_sk")
+
+
+# ------------------------------------------- round-4 batch B
+
+
+def oracle_q41(tables):
+    it = tables["item"]
+    colors = _sv(it, "i_color")
+    units = _sv(it, "i_units")
+    manufs = _sv(it, "i_manufact")
+    ids = _sv(it, "i_item_id")
+    mids = it["i_manufact_id"][0]
+    ok_manufs = set()
+    for c, u, m in zip(colors, units, manufs):
+        if (c in ("powder", "navy") and u in ("Each", "Dozen")) or (
+            c in ("peach", "saddle") and u in ("Case", "Pallet")
+        ):
+            ok_manufs.add(m)
+    return sorted({
+        ids[k] for k in range(len(ids))
+        if 50 <= int(mids[k]) <= 120 and manufs[k] in ok_manufs
+    })
+
+
+def oracle_q4(tables):
+    dd = tables["date_dim"]
+    yr = dict(zip(dd["d_date_sk"][0].tolist(), dd["d_year"][0].tolist()))
+    cu = tables["customer"]
+    info = {int(k): (i, f, l) for k, i, f, l in
+            zip(cu["c_customer_sk"][0], _sv(cu, "c_customer_id"),
+                _sv(cu, "c_first_name"), _sv(cu, "c_last_name"))}
+
+    def totals(fact, d_c, c_c, lp, wc, dc, sp):
+        f = tables[fact]
+        out = {2000: {}, 2001: {}}
+        x = f[lp][0] - f[wc][0] - f[dc][0] + f[sp][0]
+        # engine measure: decimal(10,2)/decimal "2" -> (20,10) exact
+        # HALF_UP; x*10^10/200 == x*5*10^7 exactly
+        m = x.astype(object) * (5 * 10**7)
+        for d, c, v in zip(f[d_c][0], f[c_c][0], m):
+            y = yr.get(int(d))
+            if y in out:
+                out[y][int(c)] = out[y].get(int(c), 0) + int(v)
+        return out
+
+    st = totals("store_sales", "ss_sold_date_sk", "ss_customer_sk",
+                "ss_ext_list_price", "ss_ext_wholesale_cost",
+                "ss_ext_discount_amt", "ss_ext_sales_price")
+    ct = totals("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk",
+                "cs_ext_list_price", "cs_wholesale_cost",
+                "cs_ext_discount_amt", "cs_ext_sales_price")
+    wb = totals("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+                "ws_ext_list_price", "ws_wholesale_cost",
+                "ws_ext_discount_amt", "ws_ext_sales_price")
+    out = set()
+    for sk, attrs in info.items():
+        try:
+            s1, s2 = st[2000][sk], st[2001][sk]
+            c1, c2 = ct[2000][sk], ct[2001][sk]
+            w1, w2 = wb[2000][sk], wb[2001][sk]
+        except KeyError:
+            continue
+        f = 1e10
+        if not (s1 / f > 0 and c1 / f > 0 and w1 / f > 0):
+            continue
+        if (c2 / f) / (c1 / f) > (s2 / f) / (s1 / f) and (
+            (w2 / f) / (w1 / f) > (s2 / f) / (s1 / f)
+        ):
+            out.add(attrs)
+    return out
+
+
+def oracle_q50(tables):
+    dd = tables["date_dim"]
+    dates = dict(zip(dd["d_date_sk"][0].tolist(), dd["d_date"][0].tolist()))
+    aug01 = {int(k) for k, y, m in zip(dd["d_date_sk"][0], dd["d_year"][0],
+                                       dd["d_moy"][0])
+             if int(y) == 2001 and int(m) == 8}
+    sr = tables["store_returns"]
+    rets = {}
+    for i, tk, c, d in zip(sr["sr_item_sk"][0], sr["sr_ticket_number"][0],
+                           sr["sr_customer_sk"][0], sr["sr_returned_date_sk"][0]):
+        if int(d) in aug01:
+            rets.setdefault((int(i), int(tk), int(c)), []).append(int(d))
+    st = tables["store"]
+    sinfo = {int(k): (n, co, stt, z) for k, n, co, stt, z in
+             zip(st["s_store_sk"][0], _sv(st, "s_store_name"),
+                 _sv(st, "s_county"), _sv(st, "s_state"), _sv(st, "s_zip"))}
+    ss = tables["store_sales"]
+    out = {}
+    for i, tk, c, stk, d in zip(ss["ss_item_sk"][0], ss["ss_ticket_number"][0],
+                                ss["ss_customer_sk"][0], ss["ss_store_sk"][0],
+                                ss["ss_sold_date_sk"][0]):
+        ms = rets.get((int(i), int(tk), int(c)))
+        if not ms or int(stk) not in sinfo or int(d) not in dates:
+            continue
+        sold = dates[int(d)]
+        for rd in ms:
+            lag = dates[rd] - sold
+            key = sinfo[int(stk)]
+            acc = out.setdefault(key, [0, 0, 0, 0, 0])
+            if lag <= 30:
+                acc[0] += 1
+            elif lag <= 60:
+                acc[1] += 1
+            elif lag <= 90:
+                acc[2] += 1
+            elif lag <= 120:
+                acc[3] += 1
+            else:
+                acc[4] += 1
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def oracle_q22(tables):
+    dd = tables["date_dim"]
+    y2000 = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    it = tables["item"]
+    iinfo = {int(sk): (i, b, c, cat) for sk, i, b, c, cat in
+             zip(it["i_item_sk"][0], _sv(it, "i_item_id"), _sv(it, "i_brand"),
+                 _sv(it, "i_class"), _sv(it, "i_category"))}
+    inv = tables["inventory"]
+    cells = {}
+    for d, i, q in zip(inv["inv_date_sk"][0], inv["inv_item_sk"][0],
+                       inv["inv_quantity_on_hand"][0]):
+        if int(d) not in y2000 or int(i) not in iinfo:
+            continue
+        dims = iinfo[int(i)]
+        for level in range(4, -1, -1):
+            key = tuple(dims[k] if k < level else None for k in range(4)) + (4 - level,)
+            acc = cells.setdefault(key, [0, 0])
+            acc[0] += int(q)
+            acc[1] += 1
+    # engine avg over int32 -> float64 (sum/count in float)
+    return {k: v[0] / v[1] for k, v in cells.items()}
+
+
+def oracle_q21(tables):
+    import datetime
+
+    pivot = (datetime.date(2000, 3, 11) - datetime.date(1970, 1, 1)).days
+    win = _win_sks(tables, (2000, 2, 10), (2000, 4, 10))
+    dd = tables["date_dim"]
+    dval = dict(zip(dd["d_date_sk"][0].tolist(), dd["d_date"][0].tolist()))
+    it = tables["item"]
+    ids = _sv(it, "i_item_id")
+    ok_items = {int(sk): ids[k] for k, sk in enumerate(it["i_item_sk"][0])
+                if 2000 <= int(it["i_current_price"][0][k]) <= 5000}
+    wh = tables["warehouse"]
+    wname = {int(k): v for k, v in
+             zip(wh["w_warehouse_sk"][0], _sv(wh, "w_warehouse_name"))}
+    inv = tables["inventory"]
+    cells = {}
+    for d, i, w, q in zip(inv["inv_date_sk"][0], inv["inv_item_sk"][0],
+                          inv["inv_warehouse_sk"][0],
+                          inv["inv_quantity_on_hand"][0]):
+        if int(d) not in win or int(i) not in ok_items or int(w) not in wname:
+            continue
+        key = (wname[int(w)], ok_items[int(i)])
+        acc = cells.setdefault(key, [0, 0])
+        if dval[int(d)] < pivot:
+            acc[0] += int(q)
+        else:
+            acc[1] += int(q)
+    out = {}
+    for key, (b, a) in cells.items():
+        if b > 0 and 2.0 / 3.0 <= a / b <= 1.5:
+            out[key] = (b, a)
+    return out
+
+
+# ------------------------------------------- round-4 batch C
+
+
+def oracle_q28(tables):
+    ss = tables["store_sales"]
+    bands = [
+        ("B1", 0, 5, 0, 10, 0, 50),
+        ("B2", 6, 10, 10, 20, 50, 100),
+        ("B3", 11, 15, 20, 30, 100, 150),
+        ("B4", 16, 20, 30, 40, 150, 200),
+        ("B5", 21, 25, 40, 50, 200, 250),
+        ("B6", 26, 30, 50, 60, 250, 300),
+    ]
+    q = ss["ss_quantity"][0]
+    lp = ss["ss_list_price"][0]
+    cp = ss["ss_coupon_amt"][0]
+    wc = ss["ss_wholesale_cost"][0]
+    out = {}
+    for name, q_lo, q_hi, c_lo, c_hi, w_lo, w_hi in bands:
+        m = (q >= q_lo) & (q <= q_hi) & (
+            ((lp >= c_lo * 100) & (lp <= (c_lo + 10) * 100))
+            | ((cp >= w_lo * 100) & (cp <= (w_lo + 1000) * 100))
+            | ((wc >= c_hi * 100) & (wc <= (c_hi + 20) * 100))
+        )
+        vals = lp[m]
+        cnt = int(m.sum())
+        if cnt:
+            total = int(vals.sum())
+            num = total * 10_000
+            qq, r = divmod(num, cnt)
+            avg_unscaled = qq + (1 if 2 * r >= cnt else 0)
+        else:
+            avg_unscaled = None
+        out[name] = (avg_unscaled, cnt, len(set(vals.tolist())))
+    return out
+
+
+def oracle_q90(tables):
+    hd_sel = None  # deps filter not applied in the plan (no ws hdemo)
+    wp = tables["web_page"]
+    pages = {int(k) for k, c in zip(wp["wp_web_page_sk"][0],
+                                    wp["wp_char_count"][0])
+             if 2000 <= int(c) <= 6000}
+    ws = tables["web_sales"]
+
+    def count(lo, hi):
+        n = 0
+        for t_, pg in zip(ws["ws_sold_time_sk"][0], ws["ws_web_page_sk"][0]):
+            if int(pg) in pages and lo * 60 <= int(t_) <= hi * 60 + 59:
+                n += 1
+        return n
+
+    am = count(8, 9)
+    pm = count(19, 20)
+    return am, pm, am / (pm if pm > 0 else 1.0)
+
+
+def oracle_q76(tables):
+    dd = tables["date_dim"]
+    dinfo = {int(k): (int(y), int(q)) for k, y, q in
+             zip(dd["d_date_sk"][0], dd["d_year"][0], dd["d_qoy"][0])}
+    it = tables["item"]
+    cat = {int(k): c for k, c in zip(it["i_item_sk"][0], _sv(it, "i_category"))}
+    out = {}
+
+    def channel(fact, d_c, i_c, null_c, p_c, name):
+        f = tables[fact]
+        for d, i, nc, p in zip(f[d_c][0], f[i_c][0], f[null_c][0], f[p_c][0]):
+            if int(nc) != -1:
+                continue
+            yq = dinfo.get(int(d))
+            if yq is None or int(i) not in cat:
+                continue
+            key = (name, null_c, yq[0], yq[1], cat[int(i)])
+            acc = out.setdefault(key, [0, 0])
+            acc[0] += 1
+            acc[1] += int(p)
+
+    channel("store_sales", "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+            "ss_ext_sales_price", "store")
+    channel("web_sales", "ws_sold_date_sk", "ws_item_sk", "ws_promo_sk",
+            "ws_ext_sales_price", "web")
+    channel("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+            "cs_bill_customer_sk", "cs_ext_sales_price", "catalog")
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def _oracle_returns_above_avg(tables, rtab, r_date, r_cust, r_loc, r_amt,
+                              loc_ok, names=False):
+    dd = tables["date_dim"]
+    y2000 = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    rt = tables[rtab]
+    per = {}
+    for d, c, l, a in zip(rt[r_date][0], rt[r_cust][0], rt[r_loc][0],
+                          rt[r_amt][0]):
+        if int(d) not in y2000 or (loc_ok is not None and int(l) not in loc_ok):
+            continue
+        key = (int(c), int(l))
+        per[key] = per.get(key, 0) + int(a)
+    by_loc = {}
+    for (c, l), v in per.items():
+        by_loc.setdefault(l, []).append(v)
+    # engine avg: decimal(17,2) -> (21,6) HALF_UP
+    avg_u = {}
+    for l, vs in by_loc.items():
+        total = sum(vs)
+        n = len(vs)
+        num = total * 10_000
+        q, r = divmod(num, n)
+        if num < 0:
+            q, r = divmod(-num, n)
+            q = -q - (1 if 2 * r > n else 0)  # not hit: amounts >= 0
+        avg_u[l] = q + (1 if 2 * r >= n else 0)
+    cu = tables["customer"]
+    info = {int(k): (i, f, l) for k, i, f, l in
+            zip(cu["c_customer_sk"][0], _sv(cu, "c_customer_id"),
+                _sv(cu, "c_first_name"), _sv(cu, "c_last_name"))}
+    out = set()
+    for (c, l), v in per.items():
+        if c not in info:
+            continue
+        if v / 100.0 > 1.2 * (avg_u[l] / 1_000_000.0):
+            if names:
+                out.add(info[c] + (v,))
+            else:
+                out.add(info[c][0])
+    return out
+
+
+def oracle_q1(tables):
+    st = tables["store"]
+    tn = set(st["s_store_sk"][0][np.array(_s_eq(st, "s_state", "TN"))].tolist())
+    return _oracle_returns_above_avg(
+        tables, "store_returns", "sr_returned_date_sk", "sr_customer_sk",
+        "sr_store_sk", "sr_return_amt", tn)
+
+
+def oracle_q30(tables):
+    return _oracle_returns_above_avg(
+        tables, "web_returns", "wr_returned_date_sk",
+        "wr_returning_customer_sk", "wr_web_page_sk", "wr_return_amt",
+        None, names=True)
+
+
+def oracle_q81(tables):
+    return _oracle_returns_above_avg(
+        tables, "catalog_returns", "cr_returned_date_sk",
+        "cr_returning_customer_sk", "cr_call_center_sk", "cr_return_amount",
+        None, names=True)

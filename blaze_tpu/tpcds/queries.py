@@ -3299,8 +3299,486 @@ def q82(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     return _inv_price_items(t, n_parts, "store_sales", "ss_item_sk")
 
 
+
+# ------------------------------------------- round-4 batch B
+
+
+def q41(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Distinct items of manufacturers that produce a qualifying
+    color/size/unit combination — the correlated per-manufact EXISTS
+    rewritten as a semi-join on i_manufact.  (Deviation: i_item_id
+    stands in for the spec's i_product_name.)"""
+    combo = (
+        (col("i_color").isin(lit("powder"), lit("navy"))
+         & col("i_units").isin(lit("Each"), lit("Dozen")))
+        | (col("i_color").isin(lit("peach"), lit("saddle"))
+           & col("i_units").isin(lit("Case"), lit("Pallet")))
+    )
+    qual = FilterExec(t["item"], combo)
+    manufacts = two_stage_agg(
+        ProjectExec(qual, [col("i_manufact")]),
+        [GroupingExpr(col("i_manufact"), "i_manufact")], [], n_parts,
+    )
+    i1 = FilterExec(t["item"],
+                    (col("i_manufact_id") >= lit(50)) & (col("i_manufact_id") <= lit(120)))
+    i1 = ProjectExec(i1, [col("i_manufact"), col("i_item_id")])
+    j = broadcast_join(manufacts, i1, [col("i_manufact")], [col("i_manufact")],
+                       JoinType.LEFT_SEMI, build_is_left=False)
+    distinct = two_stage_agg(
+        ProjectExec(j, [col("i_item_id")]),
+        [GroupingExpr(col("i_item_id"), "i_item_id")], [], n_parts,
+    )
+    return single_sorted(distinct, [SortField(col("i_item_id"))], fetch=100)
+
+
+def q4(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """q11's three-channel giant: per-customer yearly profit measure
+    ((ext_list - wholesale - ext_discount + ext_sales) / 2) in all
+    THREE channels; keep customers whose catalog growth beats store
+    growth AND web growth beats store growth.  (Deviation: catalog/web
+    use cs_wholesale_cost/ws_wholesale_cost — this datagen carries no
+    *_ext_wholesale_cost for those channels.)"""
+    f64 = DataType.float64()
+    two = lit("2", DataType.decimal(7, 2))
+
+    def measure(lp, wc, dc, sp):
+        return (col(lp) - col(wc) - col(dc) + col(sp)) / two
+
+    def slice_(fact, date_c, cust_c, cols, m, year, alias, names=False):
+        yt = _year_total(t, n_parts, fact=fact, date_c=date_c, cust_c=cust_c,
+                         fact_cols=cols, measure=m, year=year, names=names)
+        keep = [col("c_customer_sk").alias(f"sk_{alias}"),
+                col("year_total").alias(alias)]
+        if names:
+            keep += [col("c_customer_id"), col("c_first_name"), col("c_last_name")]
+        return ProjectExec(yt, keep)
+
+    ss_cols = ["ss_ext_list_price", "ss_ext_wholesale_cost",
+               "ss_ext_discount_amt", "ss_ext_sales_price"]
+    cs_cols = ["cs_ext_list_price", "cs_wholesale_cost",
+               "cs_ext_discount_amt", "cs_ext_sales_price"]
+    ws_cols = ["ws_ext_list_price", "ws_wholesale_cost",
+               "ws_ext_discount_amt", "ws_ext_sales_price"]
+    ss_m = measure(*ss_cols)
+    cs_m = measure(*cs_cols)
+    ws_m = measure(*ws_cols)
+    s1 = slice_("store_sales", "ss_sold_date_sk", "ss_customer_sk", ss_cols, ss_m, 2000, "s1")
+    s2 = slice_("store_sales", "ss_sold_date_sk", "ss_customer_sk", ss_cols, ss_m, 2001, "s2", names=True)
+    c1 = slice_("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk", cs_cols, cs_m, 2000, "c1")
+    c2 = slice_("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk", cs_cols, cs_m, 2001, "c2")
+    w1 = slice_("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk", ws_cols, ws_m, 2000, "w1")
+    w2 = slice_("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk", ws_cols, ws_m, 2001, "w2")
+    j = broadcast_join(s1, s2, [col("sk_s1")], [col("sk_s2")], JoinType.INNER, build_is_left=True)
+    for b, key in ((c1, "sk_c1"), (c2, "sk_c2"), (w1, "sk_w1"), (w2, "sk_w2")):
+        j = broadcast_join(b, j, [col(key)], [col("sk_s2")], JoinType.INNER, build_is_left=True)
+    s1f, s2f = col("s1").cast(f64), col("s2").cast(f64)
+    c1f, c2f = col("c1").cast(f64), col("c2").cast(f64)
+    w1f, w2f = col("w1").cast(f64), col("w2").cast(f64)
+    f = FilterExec(
+        j,
+        (s1f > lit(0.0)) & (c1f > lit(0.0)) & (w1f > lit(0.0))
+        & ((c2f / c1f) > (s2f / s1f)) & ((w2f / w1f) > (s2f / s1f)),
+    )
+    proj = ProjectExec(f, [col("c_customer_id"), col("c_first_name"),
+                           col("c_last_name")])
+    return single_sorted(proj, [SortField(col("c_customer_id"))], fetch=100)
+
+
+def q50(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Store return-lag pivot: returns booked in Aug 2001 joined to
+    their originating line, bucketed by days-to-return per store."""
+    from ..exprs.ir import Case
+
+    i64 = DataType.int64()
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_item_sk"), col("ss_ticket_number"),
+                      col("ss_customer_sk"), col("ss_store_sk"),
+                      col("ss_sold_date_sk")])
+    sr = ProjectExec(t["store_returns"],
+                     [col("sr_item_sk"), col("sr_ticket_number"),
+                      col("sr_customer_sk"), col("sr_returned_date_sk")])
+    j = shuffle_join(sl, sr,
+                     [col("ss_item_sk"), col("ss_ticket_number"), col("ss_customer_sk")],
+                     [col("sr_item_sk"), col("sr_ticket_number"), col("sr_customer_sk")],
+                     JoinType.INNER, n_parts, build_left=False)
+    d1 = ProjectExec(t["date_dim"], [col("d_date_sk"), col("d_date")])
+    d2f = FilterExec(t["date_dim"],
+                     (col("d_year") == lit(2001)) & (col("d_moy") == lit(8)))
+    d2 = ProjectExec(d2f, [col("d_date_sk").alias("d2_sk"),
+                           col("d_date").alias("ret_date")])
+    j = broadcast_join(d1, j, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(d2, j, [col("d2_sk")], [col("sr_returned_date_sk")], JoinType.INNER, build_is_left=True)
+    st = ProjectExec(t["store"], [col("s_store_sk"), col("s_store_name"),
+                                  col("s_county"), col("s_state"), col("s_zip")])
+    j = broadcast_join(st, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    lag = (col("ret_date").cast(i64) - col("d_date").cast(i64)).alias("lag")
+    base = ProjectExec(j, [col("s_store_name"), col("s_county"), col("s_state"),
+                           col("s_zip"), lag])
+    one, zero = lit(1, i64), lit(0, i64)
+    buckets = [
+        ("d30", Case([(col("lag") <= lit(30, i64), one)], zero)),
+        ("d60", Case([((col("lag") > lit(30, i64)) & (col("lag") <= lit(60, i64)), one)], zero)),
+        ("d90", Case([((col("lag") > lit(60, i64)) & (col("lag") <= lit(90, i64)), one)], zero)),
+        ("d120", Case([((col("lag") > lit(90, i64)) & (col("lag") <= lit(120, i64)), one)], zero)),
+        ("dmore", Case([(col("lag") > lit(120, i64), one)], zero)),
+    ]
+    proj = ProjectExec(
+        base,
+        [col("s_store_name"), col("s_county"), col("s_state"), col("s_zip")]
+        + [e.alias(nm) for nm, e in buckets],
+    )
+    agg = two_stage_agg(
+        proj,
+        [GroupingExpr(col(c), c) for c in
+         ("s_store_name", "s_county", "s_state", "s_zip")],
+        [AggFunction("sum", col(nm), nm) for nm, _ in buckets],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("s_store_name")), SortField(col("s_county")),
+         SortField(col("s_state")), SortField(col("s_zip"))],
+        fetch=100,
+    )
+
+
+def q22(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Average inventory quantity ROLLUP over the product hierarchy
+    (year-2000 snapshots).  (Deviation: i_item_id stands in for
+    i_product_name.)"""
+    from ..exprs.ir import Lit
+    from ..ops import ExpandExec
+
+    dt = FilterExec(t["date_dim"],
+                    (col("d_year") == lit(2000)))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id"),
+                                 col("i_brand"), col("i_class"), col("i_category")])
+    inv = ProjectExec(t["inventory"],
+                      [col("inv_date_sk"), col("inv_item_sk"),
+                       col("inv_quantity_on_hand")])
+    j = broadcast_join(dt_p, inv, [col("d_date_sk")], [col("inv_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(it, j, [col("i_item_sk")], [col("inv_item_sk")], JoinType.INNER, build_is_left=True)
+    s16 = DataType.string(16)
+    s32 = DataType.string(32)
+    dims = [("i_item_id", s16), ("i_brand", s32), ("i_class", s16),
+            ("i_category", s16)]
+    base = ProjectExec(j, [col("inv_quantity_on_hand")] + [col(d[0]) for d in dims])
+    projections = []
+    for level in range(4, -1, -1):
+        row = [col("inv_quantity_on_hand")]
+        for k, (name, dt_) in enumerate(dims):
+            row.append(col(name) if k < level else Lit(None, dt_))
+        row.append(lit(4 - level))
+        projections.append(row)
+    expand = ExpandExec(base, projections,
+                        ["inv_quantity_on_hand"] + [d[0] for d in dims] + ["g_id"])
+    agg = two_stage_agg(
+        expand,
+        [GroupingExpr(col(d[0]), d[0]) for d in dims]
+        + [GroupingExpr(col("g_id"), "g_id")],
+        [AggFunction("avg", col("inv_quantity_on_hand"), "qoh")],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("qoh")), SortField(col("i_item_id")),
+         SortField(col("i_brand")), SortField(col("i_class")),
+         SortField(col("i_category"))],
+        fetch=100,
+    )
+
+
+def q21(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Inventory rebalance check: per (warehouse, item), on-hand sums
+    30 days before vs after 2000-03-11 must stay within [2/3, 3/2]."""
+    import datetime
+
+    from ..exprs.ir import Case
+
+    f64 = DataType.float64()
+    i64 = DataType.int64()
+    pivot = datetime.date(2000, 3, 11)
+    dt = _date_window(t, pivot - datetime.timedelta(days=30),
+                      pivot + datetime.timedelta(days=30), extra=("d_date",))
+    dec = DataType.decimal(7, 2)
+    it = FilterExec(
+        t["item"],
+        (col("i_current_price") >= lit("20", dec))
+        & (col("i_current_price") <= lit("50", dec)),
+    )
+    it_p = ProjectExec(it, [col("i_item_sk"), col("i_item_id")])
+    wh = ProjectExec(t["warehouse"], [col("w_warehouse_sk"), col("w_warehouse_name")])
+    inv = ProjectExec(t["inventory"],
+                      [col("inv_date_sk"), col("inv_item_sk"),
+                       col("inv_warehouse_sk"), col("inv_quantity_on_hand")])
+    j = broadcast_join(dt, inv, [col("d_date_sk")], [col("inv_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(it_p, j, [col("i_item_sk")], [col("inv_item_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(wh, j, [col("w_warehouse_sk")], [col("inv_warehouse_sk")], JoinType.INNER, build_is_left=True)
+    pivot_days = (pivot - datetime.date(1970, 1, 1)).days
+    qoh = col("inv_quantity_on_hand").cast(i64)
+    before = Case([(col("d_date").cast(i64) < lit(pivot_days, i64), qoh)], lit(0, i64))
+    after = Case([(col("d_date").cast(i64) >= lit(pivot_days, i64), qoh)], lit(0, i64))
+    proj = ProjectExec(j, [col("w_warehouse_name"), col("i_item_id"),
+                           before.alias("b"), after.alias("a")])
+    agg = two_stage_agg(
+        proj,
+        [GroupingExpr(col("w_warehouse_name"), "w_warehouse_name"),
+         GroupingExpr(col("i_item_id"), "i_item_id")],
+        [AggFunction("sum", col("b"), "inv_before"),
+         AggFunction("sum", col("a"), "inv_after")],
+        n_parts,
+    )
+    bf, af = col("inv_before").cast(f64), col("inv_after").cast(f64)
+    f = FilterExec(
+        agg,
+        (bf > lit(0.0)) & ((af / bf) >= lit(2.0 / 3.0)) & ((af / bf) <= lit(1.5)),
+    )
+    return single_sorted(
+        f, [SortField(col("w_warehouse_name")), SortField(col("i_item_id"))],
+        fetch=100,
+    )
+
+
+
+# ------------------------------------------- round-4 batch C
+
+
+def q28(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Six store-sales price-band buckets of (avg list price, count,
+    count distinct) cross-joined into one row — each bucket a
+    scalar-subquery trio, the way Spark executes the six subqueries."""
+    from ..tpch.queries import scalar_subquery_row
+
+    bands = [
+        ("B1", 0, 5, 0, 10, 0, 50),
+        ("B2", 6, 10, 10, 20, 50, 100),
+        ("B3", 11, 15, 20, 30, 100, 150),
+        ("B4", 16, 20, 30, 40, 150, 200),
+        ("B5", 21, 25, 40, 50, 200, 250),
+        ("B6", 26, 30, 50, 60, 250, 300),
+    ]
+    dec = DataType.decimal(7, 2)
+    lits = []
+    for name, q_lo, q_hi, c_lo, c_hi, w_lo, w_hi in bands:
+        f = FilterExec(
+            t["store_sales"],
+            (col("ss_quantity") >= lit(q_lo)) & (col("ss_quantity") <= lit(q_hi))
+            & ((col("ss_list_price") >= lit(str(c_lo), dec))
+               & (col("ss_list_price") <= lit(str(c_lo + 10), dec))
+               | (col("ss_coupon_amt") >= lit(str(w_lo), dec))
+               & (col("ss_coupon_amt") <= lit(str(w_lo + 1000), dec))
+               | (col("ss_wholesale_cost") >= lit(str(c_hi), dec))
+               & (col("ss_wholesale_cost") <= lit(str(c_hi + 20), dec))),
+        )
+        lp = ProjectExec(f, [col("ss_list_price")])
+        distinct = two_stage_agg(
+            lp, [GroupingExpr(col("ss_list_price"), "ss_list_price")], [],
+            n_parts,
+        )
+        per_band = two_stage_agg(
+            lp, [],
+            [AggFunction("avg", col("ss_list_price"), f"{name}_lp"),
+             AggFunction("count", col("ss_list_price"), f"{name}_cnt")],
+            n_parts,
+        )
+        dcnt = two_stage_agg(
+            distinct, [], [AggFunction("count_star", None, f"{name}_cntd")],
+            n_parts,
+        )
+        lits.extend(scalar_subquery_row(per_band, [f"{name}_lp", f"{name}_cnt"]))
+        lits.extend(scalar_subquery_row(dcnt, [f"{name}_cntd"]))
+    one_row = two_stage_agg(
+        ProjectExec(t["store"], [col("s_store_sk")]), [],
+        [AggFunction("count_star", None, "ignore")], n_parts,
+    )
+    names = []
+    for name, *_ in bands:
+        names += [f"{name}_lp", f"{name}_cnt", f"{name}_cntd"]
+    return ProjectExec(one_row, list(lits), names)
+
+
+def q90(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """AM/PM web-sales ratio for big web pages: two filtered counts
+    (hour windows x page char counts) divided.  (Deviation: the spec's
+    household-deps filter needs ws_ship_hdemo_sk, absent from this
+    datagen.)"""
+    from ..tpch.queries import scalar_subquery
+
+    f64 = DataType.float64()
+    wp = FilterExec(t["web_page"],
+                    (col("wp_char_count") >= lit(2000))
+                    & (col("wp_char_count") <= lit(6000)))
+    wp_p = ProjectExec(wp, [col("wp_web_page_sk")])
+
+    def half(lo, hi, name):
+        td = FilterExec(t["time_dim"],
+                        (col("t_hour") >= lit(lo)) & (col("t_hour") <= lit(hi)))
+        td_p = ProjectExec(td, [col("t_time_sk")])
+        ws = ProjectExec(t["web_sales"],
+                         [col("ws_sold_time_sk"), col("ws_web_page_sk")])
+        j = broadcast_join(td_p, ws, [col("t_time_sk")], [col("ws_sold_time_sk")], JoinType.INNER, build_is_left=True)
+        j = broadcast_join(wp_p, j, [col("wp_web_page_sk")], [col("ws_web_page_sk")], JoinType.INNER, build_is_left=True)
+        return two_stage_agg(j, [], [AggFunction("count_star", None, name)],
+                             n_parts)
+
+    am = scalar_subquery(half(8, 9, "amc"), "amc")
+    pm = scalar_subquery(half(19, 20, "pmc"), "pmc")
+    one_row = two_stage_agg(
+        ProjectExec(t["web_page"], [col("wp_web_page_sk")]), [],
+        [AggFunction("count_star", None, "ignore")], n_parts,
+    )
+    from ..exprs.ir import Case
+
+    pmf = pm.cast(f64)
+    den = Case([(pmf > lit(0.0), pmf)], lit(1.0))
+    return ProjectExec(one_row, [am.cast(f64), pmf, (am.cast(f64) / den)],
+                       ["am_count", "pm_count", "am_pm_ratio"])
+
+
+def q76(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Sales with MISSING dimension keys per channel/year/category.
+    (Deviation: this datagen writes -1 sentinels for the spec's NULL
+    foreign keys; the predicate tests the sentinel.)"""
+    dt = ProjectExec(t["date_dim"], [col("d_date_sk"), col("d_year"), col("d_qoy")])
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_category")])
+
+    def channel(fact, date_c, item_c, null_c, price_c, name):
+        f = FilterExec(t[fact], col(null_c) == lit(-1, DataType.int64()))
+        sl = ProjectExec(f, [col(date_c), col(item_c), col(price_c)])
+        j = broadcast_join(dt, sl, [col("d_date_sk")], [col(date_c)], JoinType.INNER, build_is_left=True)
+        j = broadcast_join(it, j, [col("i_item_sk")], [col(item_c)], JoinType.INNER, build_is_left=True)
+        return ProjectExec(
+            j,
+            [lit(name, DataType.string(16)), lit(null_c, DataType.string(24)),
+             col("d_year"), col("d_qoy"), col("i_category"),
+             col(price_c).alias("ext_sales_price")],
+            ["channel", "col_name", "d_year", "d_qoy", "i_category",
+             "ext_sales_price"],
+        )
+
+    u = UnionExec([
+        channel("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                "ss_customer_sk", "ss_ext_sales_price", "store"),
+        channel("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                "ws_promo_sk", "ws_ext_sales_price", "web"),
+        channel("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                "cs_bill_customer_sk", "cs_ext_sales_price", "catalog"),
+    ])
+    agg = two_stage_agg(
+        u,
+        [GroupingExpr(col(c), c) for c in
+         ("channel", "col_name", "d_year", "d_qoy", "i_category")],
+        [AggFunction("count_star", None, "sales_cnt"),
+         AggFunction("sum", col("ext_sales_price"), "sales_amt")],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("channel")), SortField(col("col_name")),
+         SortField(col("d_year")), SortField(col("d_qoy")),
+         SortField(col("i_category"))],
+        fetch=100,
+    )
+
+
+def _returns_above_avg(t, n_parts, *, rtab, r_cust, r_amt, r_date, r_loc,
+                       loc_tab=None, loc_sk=None, loc_filter_col=None,
+                       loc_filter_val=None, names=False):
+    """q1/q30/q81 family: per-customer yearly returns per location,
+    kept where the total beats 1.2x the location average, joined back
+    to customer identity.  The correlated per-location average is the
+    classic decorrelation: a location-grouped avg joined on location."""
+    f64 = DataType.float64()
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2000))
+    dt_p = ProjectExec(dt, [col("d_date_sk")])
+    rt = ProjectExec(t[rtab], [col(r_date), col(r_cust), col(r_loc), col(r_amt)])
+    j = broadcast_join(dt_p, rt, [col("d_date_sk")], [col(r_date)], JoinType.INNER, build_is_left=True)
+    if loc_tab is not None:
+        loc = FilterExec(t[loc_tab], col(loc_filter_col) == lit(loc_filter_val))
+        loc_p = ProjectExec(loc, [col(loc_sk)])
+        j = broadcast_join(loc_p, j, [col(loc_sk)], [col(r_loc)], JoinType.INNER, build_is_left=True)
+    per_cust = two_stage_agg(
+        ProjectExec(j, [col(r_cust), col(r_loc), col(r_amt)]),
+        [GroupingExpr(col(r_cust), "ctr_customer_sk"),
+         GroupingExpr(col(r_loc), "ctr_loc_sk")],
+        [AggFunction("sum", col(r_amt), "ctr_total_return")],
+        n_parts,
+    )
+    loc_avg = two_stage_agg(
+        ProjectExec(per_cust, [col("ctr_loc_sk").alias("avg_loc_sk"),
+                               col("ctr_total_return")]),
+        [GroupingExpr(col("avg_loc_sk"), "avg_loc_sk")],
+        [AggFunction("avg", col("ctr_total_return"), "avg_return")],
+        n_parts,
+    )
+    j2 = broadcast_join(loc_avg, per_cust, [col("avg_loc_sk")], [col("ctr_loc_sk")],
+                        JoinType.INNER, build_is_left=True)
+    f = FilterExec(
+        j2,
+        col("ctr_total_return").cast(f64) > lit(1.2) * col("avg_return").cast(f64),
+    )
+    cu_cols = [col("c_customer_sk"), col("c_customer_id")] + (
+        [col("c_first_name"), col("c_last_name")] if names else []
+    )
+    cu = ProjectExec(t["customer"], cu_cols)
+    j3 = broadcast_join(cu, f, [col("c_customer_sk")], [col("ctr_customer_sk")], JoinType.INNER, build_is_left=True)
+    if names:
+        proj = ProjectExec(j3, [col("c_customer_id"), col("c_first_name"),
+                                col("c_last_name"), col("ctr_total_return")])
+        return single_sorted(
+            proj,
+            [SortField(col("c_customer_id")), SortField(col("ctr_total_return"))],
+            fetch=100,
+        )
+    proj = ProjectExec(j3, [col("c_customer_id")])
+    return single_sorted(proj, [SortField(col("c_customer_id"))], fetch=100)
+
+
+def q1(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Customers whose year-2000 STORE returns beat 1.2x their store's
+    per-customer average (TN stores)."""
+    return _returns_above_avg(
+        t, n_parts, rtab="store_returns", r_cust="sr_customer_sk",
+        r_amt="sr_return_amt", r_date="sr_returned_date_sk",
+        r_loc="sr_store_sk", loc_tab="store", loc_sk="s_store_sk",
+        loc_filter_col="s_state", loc_filter_val="TN",
+    )
+
+
+def q30(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """q1's WEB twin, per web page, reporting customer identity.
+    (Deviation: this datagen's web_page has no state column, so no
+    location filter applies.)"""
+    return _returns_above_avg(
+        t, n_parts, rtab="web_returns", r_cust="wr_returning_customer_sk",
+        r_amt="wr_return_amt", r_date="wr_returned_date_sk",
+        r_loc="wr_web_page_sk", names=True,
+    )
+
+
+def q81(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """q1's CATALOG twin, per call center, reporting customer
+    identity."""
+    return _returns_above_avg(
+        t, n_parts, rtab="catalog_returns",
+        r_cust="cr_returning_customer_sk", r_amt="cr_return_amount",
+        r_date="cr_returned_date_sk", r_loc="cr_call_center_sk", names=True,
+    )
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
+    "q1": q1,
     "q3": q3,
+    "q4": q4,
+    "q21": q21,
+    "q22": q22,
+    "q28": q28,
+    "q30": q30,
+    "q41": q41,
+    "q50": q50,
+    "q76": q76,
+    "q81": q81,
+    "q90": q90,
     "q5": q5,
     "q37": q37,
     "q46": q46,
