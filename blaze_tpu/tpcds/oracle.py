@@ -2643,7 +2643,6 @@ def oracle_q28(tables):
 
 
 def oracle_q90(tables):
-    hd_sel = None  # deps filter not applied in the plan (no ws hdemo)
     wp = tables["web_page"]
     pages = {int(k) for k, c in zip(wp["wp_web_page_sk"][0],
                                     wp["wp_char_count"][0])
@@ -2713,11 +2712,12 @@ def _oracle_returns_above_avg(tables, rtab, r_date, r_cust, r_loc, r_amt,
         total = sum(vs)
         n = len(vs)
         num = total * 10_000
-        q, r = divmod(num, n)
-        if num < 0:
+        if num >= 0:
+            q, r = divmod(num, n)
+            avg_u[l] = q + (1 if 2 * r >= n else 0)
+        else:
             q, r = divmod(-num, n)
-            q = -q - (1 if 2 * r > n else 0)  # not hit: amounts >= 0
-        avg_u[l] = q + (1 if 2 * r >= n else 0)
+            avg_u[l] = -(q + (1 if 2 * r >= n else 0))
     cu = tables["customer"]
     info = {int(k): (i, f, l) for k, i, f, l in
             zip(cu["c_customer_sk"][0], _sv(cu, "c_customer_id"),
@@ -2754,3 +2754,214 @@ def oracle_q81(tables):
         tables, "catalog_returns", "cr_returned_date_sk",
         "cr_returning_customer_sk", "cr_call_center_sk", "cr_return_amount",
         None, names=True)
+
+
+# ------------------------------------------- round-4 batch D
+
+
+def _oracle_weekly_pivot(tables, rows_iter):
+    dd = tables["date_dim"]
+    dinfo = {int(k): (int(w), int(dow)) for k, w, dow in
+             zip(dd["d_date_sk"][0], dd["d_week_seq"][0], dd["d_dow"][0])}
+    out = {}
+    counts = {}
+    for key_extra, d, price in rows_iter:
+        wd = dinfo.get(int(d))
+        if wd is None:
+            continue
+        key = key_extra + (wd[0],)
+        acc = out.setdefault(key, [0] * 7)
+        cnt = counts.setdefault(key, [0] * 7)
+        acc[wd[1]] += int(price)
+        cnt[wd[1]] += 1
+    return out, counts
+
+
+def oracle_q2(tables):
+    dd = tables["date_dim"]
+    y1 = set(dd["d_week_seq"][0][dd["d_year"][0] == 2001].tolist())
+    y2 = set(dd["d_week_seq"][0][dd["d_year"][0] == 2002].tolist())
+
+    def rows():
+        for fact, d_c, p_c in (("web_sales", "ws_sold_date_sk", "ws_ext_sales_price"),
+                               ("catalog_sales", "cs_sold_date_sk", "cs_ext_sales_price")):
+            f = tables[fact]
+            for d, p in zip(f[d_c][0], f[p_c][0]):
+                yield (), d, p
+
+    wk, cnts = _oracle_weekly_pivot(tables, rows())
+    out = {}
+    for (w1,), sums1 in wk.items():
+        if w1 not in y1:
+            continue
+        k2 = w1 + 52
+        if k2 not in y2 or (k2,) not in wk:
+            continue
+        sums2 = wk[(k2,)]
+        c1, c2 = cnts[(w1,)], cnts[(k2,)]
+        # engine: empty dow bucket -> NULL sum -> NULL ratio; NULL or
+        # zero denominator -> 1.0 (the Case guard)
+        ratios = tuple(
+            None if n1 == 0 else
+            (a / 100.0) / ((b / 100.0) if (n2 > 0 and b > 0) else 1.0)
+            for a, b, n1, n2 in zip(sums1, sums2, c1, c2)
+        )
+        out[w1] = ratios
+    return out
+
+
+def oracle_q59(tables):
+    dd = tables["date_dim"]
+    y1 = set(dd["d_week_seq"][0][dd["d_year"][0] == 2001].tolist())
+    y2 = set(dd["d_week_seq"][0][dd["d_year"][0] == 2002].tolist())
+    st = tables["store"]
+    sname = {int(k): v for k, v in zip(st["s_store_sk"][0], _sv(st, "s_store_name"))}
+
+    def rows():
+        f = tables["store_sales"]
+        for d, sk, p in zip(f["ss_sold_date_sk"][0], f["ss_store_sk"][0],
+                            f["ss_sales_price"][0]):
+            if int(sk) in sname:
+                yield (int(sk),), d, p
+
+    wk, cnts = _oracle_weekly_pivot(tables, rows())
+    out = {}
+    for (sk, w1), sums1 in wk.items():
+        if w1 not in y1:
+            continue
+        k2 = (sk, w1 + 52)
+        if (w1 + 52) not in y2 or k2 not in wk:
+            continue
+        sums2 = wk[k2]
+        c1, c2 = cnts[(sk, w1)], cnts[k2]
+        ratios = tuple(
+            None if n1 == 0 else
+            (a / 100.0) / ((b / 100.0) if (n2 > 0 and b > 0) else 1.0)
+            for a, b, n1, n2 in zip(sums1, sums2, c1, c2)
+        )
+        out[(sname[sk], w1)] = ratios
+    return out
+
+
+def _oracle_srcandc(tables, vals):
+    dd = tables["date_dim"]
+    apr = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    apr_oct = set(dd["d_date_sk"][0][
+        (dd["d_year"][0] >= 2000) & (dd["d_year"][0] <= 2002)].tolist())
+    ss = tables["store_sales"]
+    sr = tables["store_returns"]
+    cs = tables["catalog_sales"]
+    st = tables["store"]
+    it = tables["item"]
+    sname = {int(k): v for k, v in zip(st["s_store_sk"][0], _sv(st, "s_store_name"))}
+    iinfo = {int(k): (a, b) for k, a, b in
+             zip(it["i_item_sk"][0], _sv(it, "i_item_id"), _sv(it, "i_item_desc"))}
+    rets = {}
+    for idx in range(sr["sr_item_sk"][0].shape[0]):
+        if int(sr["sr_returned_date_sk"][0][idx]) not in apr_oct:
+            continue
+        key = (int(sr["sr_item_sk"][0][idx]), int(sr["sr_ticket_number"][0][idx]))
+        rets.setdefault(key, []).append(idx)
+    cs_by = {}
+    for idx in range(cs["cs_item_sk"][0].shape[0]):
+        if int(cs["cs_sold_date_sk"][0][idx]) not in apr_oct:
+            continue
+        key = (int(cs["cs_bill_customer_sk"][0][idx]), int(cs["cs_item_sk"][0][idx]))
+        cs_by.setdefault(key, []).append(idx)
+    out = {}
+    for idx in range(ss["ss_item_sk"][0].shape[0]):
+        if int(ss["ss_sold_date_sk"][0][idx]) not in apr:
+            continue
+        i = int(ss["ss_item_sk"][0][idx])
+        stk = int(ss["ss_store_sk"][0][idx])
+        if i not in iinfo or stk not in sname:
+            continue
+        for ridx in rets.get((i, int(ss["ss_ticket_number"][0][idx])), ()):
+            for cidx in cs_by.get((int(sr["sr_customer_sk"][0][ridx]), i), ()):
+                key = iinfo[i] + (sname[stk],)
+                acc = out.setdefault(key, [0, 0, 0])
+                a, b, c = vals(ss, sr, cs, idx, ridx, cidx)
+                acc[0] += a
+                acc[1] += b
+                acc[2] += c
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def oracle_q25(tables):
+    return _oracle_srcandc(
+        tables,
+        lambda ss, sr, cs, i, r, c: (int(ss["ss_net_profit"][0][i]),
+                                     int(sr["sr_net_loss"][0][r]),
+                                     int(cs["cs_net_profit"][0][c])))
+
+
+def oracle_q29(tables):
+    return _oracle_srcandc(
+        tables,
+        lambda ss, sr, cs, i, r, c: (int(ss["ss_quantity"][0][i]),
+                                     int(sr["sr_return_quantity"][0][r]),
+                                     int(cs["cs_quantity"][0][c])))
+
+
+def oracle_q91(tables):
+    dd = tables["date_dim"]
+    nov = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    cc = tables["call_center"]
+    ccn = {int(k): v for k, v in zip(cc["cc_call_center_sk"][0], _sv(cc, "cc_name"))}
+    cu = tables["customer"]
+    cinfo = {int(k): (int(cd), int(ad)) for k, cd, ad in
+             zip(cu["c_customer_sk"][0], cu["c_current_cdemo_sk"][0],
+                 cu["c_current_addr_sk"][0])}
+    cdt = tables["customer_demographics"]
+    ms = _sv(cdt, "cd_marital_status")
+    es = _sv(cdt, "cd_education_status")
+    cd_ok = {int(k): (ms[j], es[j]) for j, k in enumerate(cdt["cd_demo_sk"][0])
+             if (ms[j] == "M" and es[j] == "Unknown")
+             or (ms[j] == "W" and es[j] == "Advanced Degree")}
+
+    cr = tables["catalog_returns"]
+    out = {}
+    for d, c, ctr, loss in zip(cr["cr_returned_date_sk"][0],
+                               cr["cr_returning_customer_sk"][0],
+                               cr["cr_call_center_sk"][0],
+                               cr["cr_net_loss"][0]):
+        if int(d) not in nov or int(ctr) not in ccn or int(c) not in cinfo:
+            continue
+        cdsk, adsk = cinfo[int(c)]
+        if cdsk not in cd_ok:
+            continue
+        key = (ccn[int(ctr)],) + cd_ok[cdsk]
+        out[key] = out.get(key, 0) + int(loss)
+    return out
+
+
+def oracle_q45(tables):
+    dd = tables["date_dim"]
+    q2_2000 = {int(k) for k, y, q in zip(dd["d_date_sk"][0], dd["d_year"][0],
+                                         dd["d_qoy"][0])
+               if int(y) == 2000 and int(q) == 2}
+    it = tables["item"]
+    ids = _sv(it, "i_item_id")
+    iid = {int(k): ids[j] for j, k in enumerate(it["i_item_sk"][0])}
+    hot = {ids[j] for j, k in enumerate(it["i_item_sk"][0])
+           if int(k) in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)}
+    cu = tables["customer"]
+    addr = dict(zip(cu["c_customer_sk"][0].tolist(),
+                    cu["c_current_addr_sk"][0].tolist()))
+    ca = tables["customer_address"]
+    cainfo = {int(k): (z, c) for k, z, c in
+              zip(ca["ca_address_sk"][0], _sv(ca, "ca_zip"), _sv(ca, "ca_city"))}
+    zips = {"35000", "35137", "60031", "60062", "60093"}
+    ws = tables["web_sales"]
+    out = {}
+    for d, i, c, p in zip(ws["ws_sold_date_sk"][0], ws["ws_item_sk"][0],
+                          ws["ws_bill_customer_sk"][0], ws["ws_sales_price"][0]):
+        if int(d) not in q2_2000 or int(c) not in addr or int(i) not in iid:
+            continue
+        ainfo = cainfo.get(int(addr[int(c)]))
+        if ainfo is None:
+            continue
+        z, city = ainfo
+        if z[:5] in zips or iid[int(i)] in hot:
+            out[(z, city)] = out.get((z, city), 0) + int(p)
+    return out

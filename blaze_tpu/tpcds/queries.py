@@ -3766,9 +3766,324 @@ def q81(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
         r_date="cr_returned_date_sk", r_loc="cr_call_center_sk", names=True,
     )
 
+
+# ------------------------------------------- round-4 batch D
+
+
+_DOW7 = ("sun", "mon", "tue", "wed", "thu", "fri", "sat")
+
+
+def _dow_ratio_projection(f64):
+    """The 7 per-dow (year1/year2) ratio exprs with the Case guard on
+    NULL/zero denominators — shared by q2/q59."""
+    from ..exprs.ir import Case
+
+    ratios = []
+    for nm in _DOW7:
+        den = col(f"{nm}2").cast(f64)
+        den = Case([(den > lit(0.0), den)], lit(1.0))
+        ratios.append((col(f"{nm}1").cast(f64) / den).alias(f"{nm}_ratio"))
+    return ratios
+
+
+def _weekly_dow_pivot(rows_plan, n_parts, group_cols, price_c):
+    """Group rows by (group_cols) pivoting price sums into 7 dow
+    buckets — the q2/q59 weekly building block (q43's pivot shape)."""
+    from ..exprs.ir import Case
+
+    pivots = [
+        Case([(col("d_dow") == lit(k), col(price_c))], None).alias(f"{nm}_v")
+        for k, nm in enumerate(_DOW7)
+    ]
+    proj = ProjectExec(rows_plan, [col(c) for c in group_cols] + pivots)
+    return two_stage_agg(
+        proj,
+        [GroupingExpr(col(c), c) for c in group_cols],
+        [AggFunction("sum", col(f"{nm}_v"), f"{nm}_sales") for nm in _DOW7],
+        n_parts,
+    )
+
+
+def q2(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Web+catalog weekly day-of-week sales, each 2001 week ratioed
+    against the same week one year on.  (Deviation: this date_dim's
+    week_seq is anchored at the dataset start, so the year offset is
+    52 weeks, not the spec's 53.)"""
+    f64 = DataType.float64()
+    dt = ProjectExec(t["date_dim"],
+                     [col("d_date_sk"), col("d_week_seq"), col("d_dow"),
+                      col("d_year")])
+    branches = []
+    for fact, date_c, price_c in (
+        ("web_sales", "ws_sold_date_sk", "ws_ext_sales_price"),
+        ("catalog_sales", "cs_sold_date_sk", "cs_ext_sales_price"),
+    ):
+        sl = ProjectExec(t[fact], [col(date_c).alias("sold_date_sk"),
+                                   col(price_c).alias("sales_price")])
+        branches.append(sl)
+    u = UnionExec(branches)
+    j = broadcast_join(dt, u, [col("d_date_sk")], [col("sold_date_sk")], JoinType.INNER, build_is_left=True)
+    wk = _weekly_dow_pivot(j, n_parts, ["d_week_seq"], "sales_price")
+
+    y1_weeks = FilterExec(t["date_dim"], col("d_year") == lit(2001))
+    y1_weeks = two_stage_agg(
+        ProjectExec(y1_weeks, [col("d_week_seq").alias("wk1")]),
+        [GroupingExpr(col("wk1"), "wk1")], [], n_parts,
+    )
+    y2_weeks = FilterExec(t["date_dim"], col("d_year") == lit(2002))
+    y2_weeks = two_stage_agg(
+        ProjectExec(y2_weeks, [col("d_week_seq").alias("wk2")]),
+        [GroupingExpr(col("wk2"), "wk2")], [], n_parts,
+    )
+    wk1 = broadcast_join(y1_weeks, wk, [col("wk1")], [col("d_week_seq")],
+                         JoinType.LEFT_SEMI, build_is_left=False)
+    wk1 = ProjectExec(wk1, [col("d_week_seq")] + [
+        col(f"{nm}_sales").alias(f"{nm}1") for nm in _DOW7
+    ])
+    wk2 = broadcast_join(y2_weeks, wk, [col("wk2")], [col("d_week_seq")],
+                         JoinType.LEFT_SEMI, build_is_left=False)
+    wk2 = ProjectExec(wk2, [(col("d_week_seq") - lit(52)).alias("wk_m52")] + [
+        col(f"{nm}_sales").alias(f"{nm}2") for nm in _DOW7
+    ])
+    j2 = shuffle_join(wk1, wk2, [col("d_week_seq")], [col("wk_m52")],
+                      JoinType.INNER, n_parts, build_left=False)
+    proj = ProjectExec(j2, [col("d_week_seq")] + _dow_ratio_projection(f64))
+    return single_sorted(proj, [SortField(col("d_week_seq"))], fetch=100)
+
+
+def q59(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """q2's per-store STORE-channel twin: weekly dow sales per store,
+    each week ratioed against the week 52 later."""
+    f64 = DataType.float64()
+    dt = ProjectExec(t["date_dim"],
+                     [col("d_date_sk"), col("d_week_seq"), col("d_dow")])
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_sold_date_sk"), col("ss_store_sk"),
+                      col("ss_sales_price")])
+    j = broadcast_join(dt, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    wk = _weekly_dow_pivot(j, n_parts, ["ss_store_sk", "d_week_seq"],
+                           "ss_sales_price")
+    st = ProjectExec(t["store"], [col("s_store_sk"), col("s_store_name")])
+    wk = broadcast_join(st, wk, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    y1 = FilterExec(t["date_dim"], col("d_year") == lit(2001))
+    y1 = two_stage_agg(ProjectExec(y1, [col("d_week_seq").alias("wk1")]),
+                       [GroupingExpr(col("wk1"), "wk1")], [], n_parts)
+    wk1 = broadcast_join(y1, wk, [col("wk1")], [col("d_week_seq")],
+                         JoinType.LEFT_SEMI, build_is_left=False)
+    wk1 = ProjectExec(wk1, [col("s_store_name"), col("ss_store_sk"),
+                            col("d_week_seq")] + [
+        col(f"{nm}_sales").alias(f"{nm}1") for nm in _DOW7
+    ])
+    y2 = FilterExec(t["date_dim"], col("d_year") == lit(2002))
+    y2 = two_stage_agg(ProjectExec(y2, [col("d_week_seq").alias("wk2")]),
+                       [GroupingExpr(col("wk2"), "wk2")], [], n_parts)
+    wk2 = broadcast_join(y2, wk, [col("wk2")], [col("d_week_seq")],
+                         JoinType.LEFT_SEMI, build_is_left=False)
+    wk2 = ProjectExec(wk2, [col("ss_store_sk").alias("store2"),
+                            (col("d_week_seq") - lit(52)).alias("wk_m52")] + [
+        col(f"{nm}_sales").alias(f"{nm}2") for nm in _DOW7
+    ])
+    j2 = shuffle_join(wk1, wk2, [col("ss_store_sk"), col("d_week_seq")],
+                      [col("store2"), col("wk_m52")],
+                      JoinType.INNER, n_parts, build_left=False)
+    proj = ProjectExec(j2, [col("s_store_name"), col("d_week_seq")]
+                       + _dow_ratio_projection(f64))
+    return single_sorted(
+        proj, [SortField(col("s_store_name")), SortField(col("d_week_seq"))],
+        fetch=100,
+    )
+
+
+def _sales_returns_catalog(t, n_parts, *, sums, sum_names):
+    """q25/q29 shape: store line sold in year 2000, returned within
+    2000-2002, re-bought from the catalog 2000-2002 by the same
+    customer, per (item, store).  (Deviation: the spec's one-month /
+    six-month windows leave this datagen's uniform triple chain empty
+    at test scales; the year-wide windows keep the three-way
+    provenance join populated.)"""
+    d1 = FilterExec(t["date_dim"], col("d_year") == lit(2000))
+    d1 = ProjectExec(d1, [col("d_date_sk")])
+    d2 = FilterExec(t["date_dim"],
+                    (col("d_year") >= lit(2000)) & (col("d_year") <= lit(2002)))
+    d2 = ProjectExec(d2, [col("d_date_sk").alias("d2_sk")])
+    d3 = FilterExec(t["date_dim"],
+                    (col("d_year") >= lit(2000)) & (col("d_year") <= lit(2002)))
+    d3 = ProjectExec(d3, [col("d_date_sk").alias("d3_sk")])
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_sold_date_sk"), col("ss_item_sk"),
+                      col("ss_ticket_number"), col("ss_customer_sk"),
+                      col("ss_store_sk"), col("ss_net_profit"),
+                      col("ss_quantity")])
+    j = broadcast_join(d1, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    sr = ProjectExec(t["store_returns"],
+                     [col("sr_item_sk"), col("sr_ticket_number"),
+                      col("sr_customer_sk"), col("sr_returned_date_sk"),
+                      col("sr_net_loss"), col("sr_return_quantity")])
+    j = shuffle_join(j, sr,
+                     [col("ss_item_sk"), col("ss_ticket_number")],
+                     [col("sr_item_sk"), col("sr_ticket_number")],
+                     JoinType.INNER, n_parts, build_left=False)
+    j = broadcast_join(d2, j, [col("d2_sk")], [col("sr_returned_date_sk")], JoinType.INNER, build_is_left=True)
+    cs = ProjectExec(t["catalog_sales"],
+                     [col("cs_sold_date_sk"), col("cs_bill_customer_sk"),
+                      col("cs_item_sk"), col("cs_net_profit"),
+                      col("cs_quantity")])
+    j = shuffle_join(j, cs,
+                     [col("sr_customer_sk"), col("sr_item_sk")],
+                     [col("cs_bill_customer_sk"), col("cs_item_sk")],
+                     JoinType.INNER, n_parts, build_left=True)
+    j = broadcast_join(d3, j, [col("d3_sk")], [col("cs_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    st = ProjectExec(t["store"], [col("s_store_sk"), col("s_store_name")])
+    j = broadcast_join(st, j, [col("s_store_sk")], [col("ss_store_sk")], JoinType.INNER, build_is_left=True)
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id"),
+                                 col("i_item_desc")])
+    j = broadcast_join(it, j, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("i_item_id"), "i_item_id"),
+         GroupingExpr(col("i_item_desc"), "i_item_desc"),
+         GroupingExpr(col("s_store_name"), "s_store_name")],
+        [AggFunction("sum", e, n) for e, n in zip(sums, sum_names)],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("i_item_id")), SortField(col("i_item_desc")),
+         SortField(col("s_store_name"))],
+        fetch=100,
+    )
+
+
+def q25(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Sold-returned-rebought profit report per (item, store)."""
+    return _sales_returns_catalog(
+        t, n_parts,
+        sums=[col("ss_net_profit"), col("sr_net_loss"), col("cs_net_profit")],
+        sum_names=["store_sales_profit", "store_returns_loss",
+                   "catalog_sales_profit"],
+    )
+
+
+def q29(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """q25's quantity twin."""
+    i64 = DataType.int64()
+    return _sales_returns_catalog(
+        t, n_parts,
+        sums=[col("ss_quantity").cast(i64), col("sr_return_quantity").cast(i64),
+              col("cs_quantity").cast(i64)],
+        sum_names=["store_sales_quantity", "store_returns_quantity",
+                   "catalog_sales_quantity"],
+    )
+
+
+def q91(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Call-center losses from well-profiled returners: catalog
+    returns of year 2000 by (call center, customer demographic pair).
+    (Deviation: year-wide window, and no gmt-offset filter — the
+    spec's single-month + gmt slice is empty at test scales.)"""
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2000))
+    dt = ProjectExec(dt, [col("d_date_sk")])
+    cr = ProjectExec(t["catalog_returns"],
+                     [col("cr_returned_date_sk"), col("cr_returning_customer_sk"),
+                      col("cr_call_center_sk"), col("cr_net_loss")])
+    j = broadcast_join(dt, cr, [col("d_date_sk")], [col("cr_returned_date_sk")], JoinType.INNER, build_is_left=True)
+    cc = ProjectExec(t["call_center"],
+                     [col("cc_call_center_sk"), col("cc_name")])
+    j = broadcast_join(cc, j, [col("cc_call_center_sk")], [col("cr_call_center_sk")], JoinType.INNER, build_is_left=True)
+    cu = ProjectExec(t["customer"],
+                     [col("c_customer_sk"), col("c_current_cdemo_sk"),
+                      col("c_current_addr_sk")])
+    j = broadcast_join(cu, j, [col("c_customer_sk")], [col("cr_returning_customer_sk")], JoinType.INNER, build_is_left=True)
+    cd = FilterExec(
+        t["customer_demographics"],
+        ((col("cd_marital_status") == lit("M"))
+         & (col("cd_education_status") == lit("Unknown")))
+        | ((col("cd_marital_status") == lit("W"))
+           & (col("cd_education_status") == lit("Advanced Degree"))),
+    )
+    cd = ProjectExec(cd, [col("cd_demo_sk"), col("cd_marital_status"),
+                          col("cd_education_status")])
+    j = broadcast_join(cd, j, [col("cd_demo_sk")], [col("c_current_cdemo_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("cc_name"), "cc_name"),
+         GroupingExpr(col("cd_marital_status"), "cd_marital_status"),
+         GroupingExpr(col("cd_education_status"), "cd_education_status")],
+        [AggFunction("sum", col("cr_net_loss"), "returns_loss")],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("returns_loss"), ascending=False),
+         SortField(col("cc_name"))],
+        fetch=100,
+    )
+
+
+def _collect_column(plan, column):
+    """Driver-side evaluation of a small subplan into a literal list —
+    the IN-subquery sibling of scalar_subquery (the JVM evaluates the
+    subquery; the native side sees literals)."""
+    from ..batch import batch_to_pydict
+    from ..runtime.context import TaskContext
+
+    out = []
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            out.extend(batch_to_pydict(b)[column])
+    return out
+
+
+def q45(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Web revenue by customer geography for zip-listed OR hot-item
+    buyers (the OR of a zip prefix list with an item IN-subquery,
+    evaluated driver-side into literals)."""
+    from ..exprs.ir import func
+
+    dt = FilterExec(t["date_dim"],
+                    (col("d_year") == lit(2000)) & (col("d_qoy") == lit(2)))
+    dt = ProjectExec(dt, [col("d_date_sk")])
+    hot = FilterExec(t["item"], col("i_item_sk").isin(
+        *[lit(v, DataType.int64()) for v in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)]))
+    hot_ids = _collect_column(ProjectExec(hot, [col("i_item_id")]), "i_item_id")
+    ws = ProjectExec(t["web_sales"],
+                     [col("ws_sold_date_sk"), col("ws_item_sk"),
+                      col("ws_bill_customer_sk"), col("ws_sales_price")])
+    j = broadcast_join(dt, ws, [col("d_date_sk")], [col("ws_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    cu = ProjectExec(t["customer"], [col("c_customer_sk"), col("c_current_addr_sk")])
+    j = broadcast_join(cu, j, [col("c_customer_sk")], [col("ws_bill_customer_sk")], JoinType.INNER, build_is_left=True)
+    ca = ProjectExec(t["customer_address"],
+                     [col("ca_address_sk"), col("ca_city"), col("ca_zip")])
+    j = broadcast_join(ca, j, [col("ca_address_sk")], [col("c_current_addr_sk")], JoinType.INNER, build_is_left=True)
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id")])
+    j = broadcast_join(it, j, [col("i_item_sk")], [col("ws_item_sk")], JoinType.INNER, build_is_left=True)
+    zips = ("35000", "35137", "60031", "60062", "60093")
+    pred = func("substring", col("ca_zip"), lit(1), lit(5)).isin(
+        *[lit(z) for z in zips])
+    if hot_ids:
+        pred = pred | col("i_item_id").isin(*[lit(v) for v in hot_ids])
+    f = FilterExec(j, pred)
+    agg = two_stage_agg(
+        f,
+        [GroupingExpr(col("ca_zip"), "ca_zip"),
+         GroupingExpr(col("ca_city"), "ca_city")],
+        [AggFunction("sum", col("ws_sales_price"), "sum_sales")],
+        n_parts,
+    )
+    return single_sorted(
+        agg, [SortField(col("ca_zip")), SortField(col("ca_city"))], fetch=100
+    )
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q1": q1,
+    "q2": q2,
     "q3": q3,
+    "q25": q25,
+    "q29": q29,
+    "q45": q45,
+    "q59": q59,
+    "q91": q91,
     "q4": q4,
     "q21": q21,
     "q22": q22,

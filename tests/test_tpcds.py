@@ -1017,3 +1017,79 @@ def test_q30(data, scans):
 def test_q81(data, scans):
     _check_returns_family(run(build_query("q81", scans, N_PARTS)),
                           O.oracle_q81(data))
+
+
+def _check_weekly_ratios(got, exp, key_cols):
+    assert exp, "oracle empty"
+    n = len(got[key_cols[0]])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = got[key_cols[0]][i] if len(key_cols) == 1 else tuple(
+            got[c][i] for c in key_cols)
+        assert key in exp, key
+        for k, nm in enumerate(("sun", "mon", "tue", "wed", "thu", "fri", "sat")):
+            g, e = got[f"{nm}_ratio"][i], exp[key][k]
+            if e is None:
+                assert g is None, (key, nm)
+            else:
+                assert g is not None and abs(g - e) < 1e-12, (key, nm)
+
+
+def test_q2(data, scans):
+    _check_weekly_ratios(run(build_query("q2", scans, N_PARTS)),
+                         O.oracle_q2(data), ["d_week_seq"])
+
+
+def test_q59(data, scans):
+    _check_weekly_ratios(run(build_query("q59", scans, N_PARTS)),
+                         O.oracle_q59(data), ["s_store_name", "d_week_seq"])
+
+
+def _check_srcandc(got, exp, names):
+    assert exp, "oracle empty"
+    n = len(got["i_item_id"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["i_item_id"][i], got["i_item_desc"][i], got["s_store_name"][i])
+        assert key in exp, key
+        assert tuple(got[c][i] for c in names) == exp[key], key
+
+
+def test_q25(data, scans):
+    _check_srcandc(run(build_query("q25", scans, N_PARTS)), O.oracle_q25(data),
+                   ["store_sales_profit", "store_returns_loss",
+                    "catalog_sales_profit"])
+
+
+def test_q29(data, scans):
+    _check_srcandc(run(build_query("q29", scans, N_PARTS)), O.oracle_q29(data),
+                   ["store_sales_quantity", "store_returns_quantity",
+                    "catalog_sales_quantity"])
+
+
+def test_q91(data, scans):
+    got = run(build_query("q91", scans, N_PARTS))
+    exp = O.oracle_q91(data)
+    assert exp, "q91 oracle empty"
+    n = len(got["cc_name"])
+    assert n == min(len(exp), 100)
+    rows = {
+        (got["cc_name"][i], got["cd_marital_status"][i],
+         got["cd_education_status"][i]): got["returns_loss"][i]
+        for i in range(n)
+    }
+    assert rows == exp if len(exp) <= 100 else all(
+        exp.get(k) == v for k, v in rows.items())
+    assert got["returns_loss"] == sorted(got["returns_loss"], reverse=True)
+
+
+def test_q45(data, scans):
+    got = run(build_query("q45", scans, N_PARTS))
+    exp = O.oracle_q45(data)
+    assert exp, "q45 oracle empty"
+    n = len(got["ca_zip"])
+    assert n == min(len(exp), 100)
+    rows = {(got["ca_zip"][i], got["ca_city"][i]): got["sum_sales"][i]
+            for i in range(n)}
+    assert rows == exp if len(exp) <= 100 else all(
+        exp.get(k) == v for k, v in rows.items())
