@@ -99,6 +99,8 @@ def agg_result_type(fn: str, in_t: Optional[DataType]) -> DataType:
         if in_t.is_decimal:
             return decimal_avg_agg_type(in_t)
         return DataType.float64()
+    if fn in ("stddev_samp", "var_samp"):
+        return DataType.float64()
     if fn in ("collect_list", "collect_set"):
         if fn == "collect_set" and in_t.is_nested:
             # sets of LISTS dedup via (length, validity-flags, value)
@@ -158,6 +160,14 @@ def agg_state_fields(fn: str, in_t: Optional[DataType], name: str) -> List[Field
         ]
     if fn in ("min", "max", "first", "first_ignores_null"):
         return [Field(f"{name}#value", in_t)]
+    if fn in ("stddev_samp", "var_samp"):
+        # (count, sum, sum of squares) in float64 — ≙ the reference's
+        # Arrow variance accumulator (agg/)
+        return [
+            Field(f"{name}#cnt", DataType.int64()),
+            Field(f"{name}#fsum", DataType.float64()),
+            Field(f"{name}#fsumsq", DataType.float64()),
+        ]
     if fn in ("collect_list", "collect_set"):
         return [Field(f"{name}#list", agg_result_type(fn, in_t))]
     raise NotImplementedError(f"agg fn {fn}")
@@ -623,6 +633,8 @@ class AggExec(ExecNode):
                         self._in_types.append(st)
                 elif a.fn in ("collect_list", "collect_set"):
                     self._in_types.append(in_schema.field(f"{a.name}#list").dtype.elem)
+                elif a.fn in ("stddev_samp", "var_samp"):
+                    self._in_types.append(DataType.float64())
                 else:
                     self._in_types.append(in_schema.field(f"{a.name}#value").dtype)
 
@@ -791,6 +803,28 @@ class AggExec(ExecNode):
                     return [_seg_gather_first(v, pick, seg, cap)]
                 vals, valid, has = _seg_first(v.data, v.validity, seg, cap, ignore)
                 return [Column(v.dtype, jnp.where(valid, vals, jnp.zeros((), vals.dtype)), valid)]
+            if a.fn in ("stddev_samp", "var_samp"):
+                ones = jnp.ones(cap, jnp.bool_)
+                if merging:
+                    cc, sc, qc = inputs
+                    cnt = _seg_sum(cc.data, cc.validity, seg, cap)
+                    fs = _seg_sum(sc.data, sc.validity, seg, cap)
+                    fq = _seg_sum(qc.data, qc.validity, seg, cap)
+                else:
+                    v = inputs[0]
+                    f = v.data.astype(jnp.float64)
+                    if v.dtype.is_decimal:
+                        # decimals carry the UNSCALED int64; rescale or
+                        # every moment would be off by 10^scale
+                        f = f / float(10 ** v.dtype.scale)
+                    cnt = _seg_count(v.validity, seg, cap)
+                    fs = _seg_sum(f, v.validity, seg, cap)
+                    fq = _seg_sum(f * f, v.validity, seg, cap)
+                return [
+                    Column(DataType.int64(), cnt, ones),
+                    Column(DataType.float64(), fs, ones),
+                    Column(DataType.float64(), fq, ones),
+                ]
             if a.fn in ("collect_list", "collect_set"):
                 arr_t = state_schema.field(f"{a.name}#list").dtype
                 if seg is None:  # collect keeps the segment machinery
@@ -986,6 +1020,16 @@ class AggExec(ExecNode):
                         out.append(
                             Column(res_t, s.data.astype(jnp.float64) / den.astype(jnp.float64), valid)
                         )
+                elif a.fn in ("stddev_samp", "var_samp"):
+                    cnt = env[f"{a.name}#cnt"].data
+                    fs = env[f"{a.name}#fsum"].data
+                    fq = env[f"{a.name}#fsumsq"].data
+                    nf = cnt.astype(jnp.float64)
+                    den = jnp.where(cnt > 1, nf - 1.0, 1.0)
+                    var = (fq - fs * fs / jnp.where(cnt > 0, nf, 1.0)) / den
+                    var = jnp.maximum(var, 0.0)  # fp cancellation guard
+                    val = jnp.sqrt(var) if a.fn == "stddev_samp" else var
+                    out.append(Column(DataType.float64(), val, cnt > 1))
                 elif a.fn in ("collect_list", "collect_set"):
                     out.append(env[f"{a.name}#list"])
                 else:

@@ -2965,3 +2965,112 @@ def oracle_q45(tables):
         if z[:5] in zips or iid[int(i)] in hot:
             out[(z, city)] = out.get((z, city), 0) + int(p)
     return out
+
+
+# ------------------------------------------- stddev pair
+
+
+def _py_stats(vals):
+    n = len(vals)
+    fs = float(sum(vals))
+    fq = float(sum(v * v for v in vals))
+    mean = fs / n if n else None
+    if n <= 1:
+        return n, mean, None
+    var = (fq - fs * fs / n) / (n - 1)
+    var = max(var, 0.0)
+    return n, mean, var ** 0.5
+
+
+def oracle_q17(tables):
+    # the q25/q29 provenance chain, but collecting raw value LISTS
+    # (count/avg/stddev need the samples, not sums)
+    dd = tables["date_dim"]
+    y2000 = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    y00_02 = set(dd["d_date_sk"][0][
+        (dd["d_year"][0] >= 2000) & (dd["d_year"][0] <= 2002)].tolist())
+    ss = tables["store_sales"]
+    sr = tables["store_returns"]
+    cs = tables["catalog_sales"]
+    st = tables["store"]
+    it = tables["item"]
+    sname = {int(k): v for k, v in zip(st["s_store_sk"][0], _sv(st, "s_store_name"))}
+    iinfo = {int(k): (a, b) for k, a, b in
+             zip(it["i_item_sk"][0], _sv(it, "i_item_id"), _sv(it, "i_item_desc"))}
+    rets = {}
+    for idx in range(sr["sr_item_sk"][0].shape[0]):
+        if int(sr["sr_returned_date_sk"][0][idx]) not in y00_02:
+            continue
+        key = (int(sr["sr_item_sk"][0][idx]), int(sr["sr_ticket_number"][0][idx]))
+        rets.setdefault(key, []).append(idx)
+    cs_by = {}
+    for idx in range(cs["cs_item_sk"][0].shape[0]):
+        if int(cs["cs_sold_date_sk"][0][idx]) not in y00_02:
+            continue
+        key = (int(cs["cs_bill_customer_sk"][0][idx]), int(cs["cs_item_sk"][0][idx]))
+        cs_by.setdefault(key, []).append(idx)
+    rows = {}
+    for idx in range(ss["ss_item_sk"][0].shape[0]):
+        if int(ss["ss_sold_date_sk"][0][idx]) not in y2000:
+            continue
+        i = int(ss["ss_item_sk"][0][idx])
+        stk = int(ss["ss_store_sk"][0][idx])
+        if i not in iinfo or stk not in sname:
+            continue
+        for ridx in rets.get((i, int(ss["ss_ticket_number"][0][idx])), ()):
+            for cidx in cs_by.get((int(sr["sr_customer_sk"][0][ridx]), i), ()):
+                key = iinfo[i] + (sname[stk],)
+                acc = rows.setdefault(key, ([], [], []))
+                acc[0].append(int(ss["ss_quantity"][0][idx]))
+                acc[1].append(int(sr["sr_return_quantity"][0][ridx]))
+                acc[2].append(int(cs["cs_quantity"][0][cidx]))
+    out = {}
+    for key, (a, b, c) in rows.items():
+        stats = []
+        for vals in (a, b, c):
+            n, mean, sd = _py_stats(vals)
+            cov = (sd / mean) if (sd is not None and mean and mean > 0) else None
+            stats.append((n, mean, sd, cov))
+        out[key] = tuple(stats)
+    return out
+
+
+def _oracle_q39_month(tables, moy, thr):
+    dd = tables["date_dim"]
+    days = {int(k) for k, y, m in zip(dd["d_date_sk"][0], dd["d_year"][0],
+                                      dd["d_moy"][0])
+            if int(y) == 2001 and int(m) == moy}
+    wh = tables["warehouse"]
+    wname = {int(k): v for k, v in
+             zip(wh["w_warehouse_sk"][0], _sv(wh, "w_warehouse_name"))}
+    inv = tables["inventory"]
+    vals = {}
+    for d, i, w, q in zip(inv["inv_date_sk"][0], inv["inv_item_sk"][0],
+                          inv["inv_warehouse_sk"][0],
+                          inv["inv_quantity_on_hand"][0]):
+        if int(d) not in days or int(w) not in wname:
+            continue
+        vals.setdefault((wname[int(w)], int(i)), []).append(int(q))
+    out = {}
+    for key, vs in vals.items():
+        n, mean, sd = _py_stats(vs)
+        if sd is None or not mean or mean <= 0:
+            continue
+        cov = sd / mean
+        if cov > thr:
+            out[key] = (mean, cov)
+    return out
+
+
+def oracle_q39(tables, thr1, thr2):
+    m1 = _oracle_q39_month(tables, 1, thr1)
+    m2 = _oracle_q39_month(tables, 2, thr2)
+    return {k: m1[k] + m2[k] for k in m1 if k in m2}
+
+
+def oracle_q39a(tables):
+    return oracle_q39(tables, 0.7, 0.7)
+
+
+def oracle_q39b(tables):
+    return oracle_q39(tables, 0.85, 0.7)

@@ -3894,13 +3894,13 @@ def q59(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
-def _sales_returns_catalog(t, n_parts, *, sums, sum_names):
-    """q25/q29 shape: store line sold in year 2000, returned within
-    2000-2002, re-bought from the catalog 2000-2002 by the same
-    customer, per (item, store).  (Deviation: the spec's one-month /
-    six-month windows leave this datagen's uniform triple chain empty
-    at test scales; the year-wide windows keep the three-way
-    provenance join populated.)"""
+def _srcandc_join(t, n_parts):
+    """The q17/q25/q29 provenance chain: store line sold in year 2000,
+    returned within 2000-2002, re-bought from the catalog 2000-2002 by
+    the same customer, joined to store + item.  (Deviation: the spec's
+    one-month / six-month windows leave this datagen's uniform triple
+    chain empty at test scales; the year-wide windows keep it
+    populated.)"""
     d1 = FilterExec(t["date_dim"], col("d_year") == lit(2000))
     d1 = ProjectExec(d1, [col("d_date_sk")])
     d2 = FilterExec(t["date_dim"],
@@ -3938,6 +3938,12 @@ def _sales_returns_catalog(t, n_parts, *, sums, sum_names):
     it = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id"),
                                  col("i_item_desc")])
     j = broadcast_join(it, j, [col("i_item_sk")], [col("ss_item_sk")], JoinType.INNER, build_is_left=True)
+    return j
+
+
+def _sales_returns_catalog(t, n_parts, *, sums, sum_names):
+    """q25/q29 tail: grouped sums per (item, store)."""
+    j = _srcandc_join(t, n_parts)
     agg = two_stage_agg(
         j,
         [GroupingExpr(col("i_item_id"), "i_item_id"),
@@ -4075,9 +4081,118 @@ def q45(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+
+# ------------------------------------------- stddev pair
+
+
+def q17(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Quantity spread statistics over the sold-returned-rebought
+    chain: count/avg/stddev (+cov) of each leg's quantity per
+    (item, store state).  (Deviation: grouped by s_store_name — this
+    datagen's stores span one state per name anyway.)"""
+    from ..exprs.ir import Case
+
+    f64 = DataType.float64()
+    j = _srcandc_join(t, n_parts)
+    i64 = DataType.int64()
+    qs = [("ss_quantity", "store"), ("sr_return_quantity", "returns"),
+          ("cs_quantity", "catalog")]
+    aggs = []
+    for src, nm in qs:
+        e = col(src).cast(i64)
+        aggs += [
+            AggFunction("count", e, f"{nm}_qty_count"),
+            AggFunction("avg", e, f"{nm}_qty_avg"),
+            AggFunction("stddev_samp", e, f"{nm}_qty_stdev"),
+        ]
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("i_item_id"), "i_item_id"),
+         GroupingExpr(col("i_item_desc"), "i_item_desc"),
+         GroupingExpr(col("s_store_name"), "s_store_name")],
+        aggs, n_parts,
+    )
+    outs = [col("i_item_id"), col("i_item_desc"), col("s_store_name")]
+    for _, nm in qs:
+        avg = col(f"{nm}_qty_avg")
+        sd = col(f"{nm}_qty_stdev")
+        cov = Case([(avg > lit(0.0), sd / avg)], None)
+        outs += [col(f"{nm}_qty_count"), avg, sd, cov.alias(f"{nm}_qty_cov")]
+    proj = ProjectExec(agg, outs)
+    return single_sorted(
+        proj,
+        [SortField(col("i_item_id")), SortField(col("i_item_desc")),
+         SortField(col("s_store_name"))],
+        fetch=100,
+    )
+
+
+def _q39_monthly_cov(t, n_parts, moy):
+    """Per (warehouse, item) inventory cov for one month of 2001."""
+    from ..exprs.ir import Case
+
+    dt = FilterExec(t["date_dim"],
+                    (col("d_year") == lit(2001)) & (col("d_moy") == lit(moy)))
+    dt = ProjectExec(dt, [col("d_date_sk")])
+    inv = ProjectExec(t["inventory"],
+                      [col("inv_date_sk"), col("inv_item_sk"),
+                       col("inv_warehouse_sk"), col("inv_quantity_on_hand")])
+    j = broadcast_join(dt, inv, [col("d_date_sk")], [col("inv_date_sk")], JoinType.INNER, build_is_left=True)
+    wh = ProjectExec(t["warehouse"], [col("w_warehouse_sk"), col("w_warehouse_name")])
+    j = broadcast_join(wh, j, [col("w_warehouse_sk")], [col("inv_warehouse_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j,
+        [GroupingExpr(col("w_warehouse_name"), "w_warehouse_name"),
+         GroupingExpr(col("inv_item_sk"), "inv_item_sk")],
+        [AggFunction("avg", col("inv_quantity_on_hand"), "mean"),
+         AggFunction("stddev_samp", col("inv_quantity_on_hand"), "stdev")],
+        n_parts,
+    )
+    cov = Case([(col("mean") > lit(0.0), col("stdev") / col("mean"))], None)
+    proj = ProjectExec(agg, [col("w_warehouse_name"), col("inv_item_sk"),
+                             col("mean"), cov.alias("cov")])
+    return proj
+
+
+def _q39(t, n_parts, thr1, thr2):
+    m1 = FilterExec(_q39_monthly_cov(t, n_parts, 1), col("cov") > lit(thr1))
+    m2 = FilterExec(_q39_monthly_cov(t, n_parts, 2), col("cov") > lit(thr2))
+    m2 = ProjectExec(m2, [col("w_warehouse_name").alias("w2"),
+                          col("inv_item_sk").alias("i2"),
+                          col("mean").alias("mean2"),
+                          col("cov").alias("cov2")])
+    j = shuffle_join(m1, m2, [col("w_warehouse_name"), col("inv_item_sk")],
+                     [col("w2"), col("i2")], JoinType.INNER, n_parts,
+                     build_left=False)
+    proj = ProjectExec(j, [col("w_warehouse_name"), col("inv_item_sk"),
+                           col("mean"), col("cov"), col("mean2"), col("cov2")])
+    return single_sorted(
+        proj,
+        [SortField(col("w_warehouse_name")), SortField(col("inv_item_sk"))],
+        fetch=100,
+    )
+
+
+def q39a(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """High-variance inventory (cov > 0.7) in BOTH Jan and Feb 2001
+    per (warehouse, item).  (Deviation: the spec's cov > 1 cut is
+    near-empty under this datagen's uniform on-hand draws; 0.7 keeps
+    the month-over-month self-join populated.)"""
+    return _q39(t, n_parts, 0.7, 0.7)
+
+
+def q39b(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """q39a with the January side tightened (cov > 0.85 — the spec's
+    1.5, scaled to this datagen's cov distribution)."""
+    return _q39(t, n_parts, 0.85, 0.7)
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q1": q1,
     "q2": q2,
+    "q17": q17,
+    "q39a": q39a,
+    "q39b": q39b,
     "q3": q3,
     "q25": q25,
     "q29": q29,

@@ -652,3 +652,49 @@ def test_window_last_value_whole_partition():
     got = collect_dict(w)
     assert got["lv"] == [7, 7, 1]
     assert got["l0"] == [5, 7, 1]  # offset 0 = current row
+
+
+def test_stddev_var_samp_two_stage():
+    """stddev_samp/var_samp across the partial->merge split, incl. the
+    n<=1 NULL contract and decimal input rescaling."""
+    import statistics
+
+    from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggFunction, GroupingExpr, MemoryScanExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.schema import DataType, Field, Schema
+    from blaze_tpu.tpch.queries import two_stage_agg
+
+    schema = Schema([Field("g", DataType.int64()),
+                     Field("v", DataType.float64()),
+                     Field("d", DataType.decimal(7, 2))])
+    data = {"g": [0, 0, 0, 1, 1, 2, 2, 3],
+            "v": [1.0, 2.0, 4.0, 5.0, 5.0, 7.0, None, 9.0],
+            "d": [1.50, 2.50, 4.50, 5.00, 5.00, 7.25, None, 9.00]}
+    src = MemoryScanExec(
+        [[batch_from_pydict({k: v[:4] for k, v in data.items()}, schema)],
+         [batch_from_pydict({k: v[4:] for k, v in data.items()}, schema)]],
+        schema)
+    plan = two_stage_agg(
+        src, [GroupingExpr(col("g"), "g")],
+        [AggFunction("stddev_samp", col("v"), "sd"),
+         AggFunction("var_samp", col("v"), "var"),
+         AggFunction("stddev_samp", col("d"), "dsd")],
+        2)
+    got = {}
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            for g, sd, var, dsd in zip(d["g"], d["sd"], d["var"], d["dsd"]):
+                got[g] = (sd, var, dsd)
+    exp = {0: ([1.0, 2.0, 4.0], [1.5, 2.5, 4.5]),
+           1: ([5.0, 5.0], [5.0, 5.0]),
+           2: ([7.0], [7.25]), 3: ([9.0], [9.0])}
+    for g, (vs, ds) in exp.items():
+        if len(vs) <= 1:
+            assert got[g] == (None, None, None), (g, got[g])
+        else:
+            assert abs(got[g][0] - statistics.stdev(vs)) < 1e-12, g
+            assert abs(got[g][1] - statistics.variance(vs)) < 1e-12, g
+            assert abs(got[g][2] - statistics.stdev(ds)) < 1e-12, g

@@ -1093,3 +1093,46 @@ def test_q45(data, scans):
             for i in range(n)}
     assert rows == exp if len(exp) <= 100 else all(
         exp.get(k) == v for k, v in rows.items())
+
+
+def test_q17(data, scans):
+    got = run(build_query("q17", scans, N_PARTS))
+    exp = O.oracle_q17(data)
+    assert exp, "q17 oracle empty"
+    n = len(got["i_item_id"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["i_item_id"][i], got["i_item_desc"][i], got["s_store_name"][i])
+        assert key in exp, key
+        for k, nm in enumerate(("store", "returns", "catalog")):
+            cnt, mean, sd, cov = exp[key][k]
+            assert got[f"{nm}_qty_count"][i] == cnt, (key, nm)
+            assert abs(got[f"{nm}_qty_avg"][i] - mean) < 1e-9, (key, nm)
+            for gv, ev in ((got[f"{nm}_qty_stdev"][i], sd),
+                           (got[f"{nm}_qty_cov"][i], cov)):
+                if ev is None:
+                    assert gv is None, (key, nm)
+                else:
+                    assert gv is not None and abs(gv - ev) < 1e-9, (key, nm)
+
+
+def _check_q39(got, exp):
+    assert exp, "q39 oracle empty"
+    n = len(got["w_warehouse_name"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["w_warehouse_name"][i], got["inv_item_sk"][i])
+        assert key in exp, key
+        m1, c1, m2, c2 = exp[key]
+        assert abs(got["mean"][i] - m1) < 1e-9 and abs(got["cov"][i] - c1) < 1e-9, key
+        assert abs(got["mean2"][i] - m2) < 1e-9 and abs(got["cov2"][i] - c2) < 1e-9, key
+    keys = [(got["w_warehouse_name"][i], got["inv_item_sk"][i]) for i in range(n)]
+    assert keys == sorted(keys)
+
+
+def test_q39a(data, scans):
+    _check_q39(run(build_query("q39a", scans, N_PARTS)), O.oracle_q39a(data))
+
+
+def test_q39b(data, scans):
+    _check_q39(run(build_query("q39b", scans, N_PARTS)), O.oracle_q39b(data))
