@@ -161,12 +161,13 @@ def agg_state_fields(fn: str, in_t: Optional[DataType], name: str) -> List[Field
     if fn in ("min", "max", "first", "first_ignores_null"):
         return [Field(f"{name}#value", in_t)]
     if fn in ("stddev_samp", "var_samp"):
-        # (count, sum, sum of squares) in float64 — ≙ the reference's
-        # Arrow variance accumulator (agg/)
+        # (count, sum, centered M2) in float64 — per-batch deviations
+        # + the Chan parallel-variance merge, cancellation-safe like
+        # the reference's Welford-merging variance accumulator
         return [
             Field(f"{name}#cnt", DataType.int64()),
             Field(f"{name}#fsum", DataType.float64()),
-            Field(f"{name}#fsumsq", DataType.float64()),
+            Field(f"{name}#m2", DataType.float64()),
         ]
     if fn in ("collect_list", "collect_set"):
         return [Field(f"{name}#list", agg_result_type(fn, in_t))]
@@ -806,10 +807,26 @@ class AggExec(ExecNode):
             if a.fn in ("stddev_samp", "var_samp"):
                 ones = jnp.ones(cap, jnp.bool_)
                 if merging:
-                    cc, sc, qc = inputs
+                    # parallel-variance merge in DEVIATION scale:
+                    # M2 = sum(M2_i) + sum(n_i * (mean_i - mean)^2) —
+                    # no large-square cancellation (mean_i - mean is
+                    # deviation-sized), unlike the sum-of-squares form
+                    cc, sc, mc = inputs
                     cnt = _seg_sum(cc.data, cc.validity, seg, cap)
                     fs = _seg_sum(sc.data, sc.validity, seg, cap)
-                    fq = _seg_sum(qc.data, qc.validity, seg, cap)
+                    nf = cnt.astype(jnp.float64)
+                    mean_tot = fs / jnp.where(cnt > 0, nf, 1.0)
+                    if seg is None:
+                        mean_row = mean_tot[0]
+                    elif isinstance(seg, SortedSegs):
+                        mean_row = jnp.take(mean_tot, seg.seg)
+                    else:
+                        mean_row = jnp.take(mean_tot, seg)
+                    nf_i = cc.data.astype(jnp.float64)
+                    mean_i = sc.data / jnp.where(cc.data > 0, nf_i, 1.0)
+                    d = mean_i - mean_row
+                    term = jnp.where(cc.data > 0, nf_i * d * d, 0.0)
+                    m2 = _seg_sum(mc.data + term, mc.validity, seg, cap)
                 else:
                     v = inputs[0]
                     f = v.data.astype(jnp.float64)
@@ -819,11 +836,20 @@ class AggExec(ExecNode):
                         f = f / float(10 ** v.dtype.scale)
                     cnt = _seg_count(v.validity, seg, cap)
                     fs = _seg_sum(f, v.validity, seg, cap)
-                    fq = _seg_sum(f * f, v.validity, seg, cap)
+                    nf = cnt.astype(jnp.float64)
+                    mean = fs / jnp.where(cnt > 0, nf, 1.0)
+                    if seg is None:
+                        mean_row = mean[0]
+                    elif isinstance(seg, SortedSegs):
+                        mean_row = jnp.take(mean, seg.seg)
+                    else:
+                        mean_row = jnp.take(mean, seg)
+                    dev = f - mean_row
+                    m2 = _seg_sum(dev * dev, v.validity, seg, cap)
                 return [
                     Column(DataType.int64(), cnt, ones),
                     Column(DataType.float64(), fs, ones),
-                    Column(DataType.float64(), fq, ones),
+                    Column(DataType.float64(), m2, ones),
                 ]
             if a.fn in ("collect_list", "collect_set"):
                 arr_t = state_schema.field(f"{a.name}#list").dtype
@@ -1022,12 +1048,10 @@ class AggExec(ExecNode):
                         )
                 elif a.fn in ("stddev_samp", "var_samp"):
                     cnt = env[f"{a.name}#cnt"].data
-                    fs = env[f"{a.name}#fsum"].data
-                    fq = env[f"{a.name}#fsumsq"].data
+                    m2 = env[f"{a.name}#m2"].data
                     nf = cnt.astype(jnp.float64)
                     den = jnp.where(cnt > 1, nf - 1.0, 1.0)
-                    var = (fq - fs * fs / jnp.where(cnt > 0, nf, 1.0)) / den
-                    var = jnp.maximum(var, 0.0)  # fp cancellation guard
+                    var = jnp.maximum(m2, 0.0) / den
                     val = jnp.sqrt(var) if a.fn == "stddev_samp" else var
                     out.append(Column(DataType.float64(), val, cnt > 1))
                 elif a.fn in ("collect_list", "collect_set"):

@@ -198,6 +198,7 @@ _AGG_FNS = {
     "Sum": "sum", "Average": "avg", "Min": "min", "Max": "max",
     "First": "first", "CollectList": "collect_list",
     "CollectSet": "collect_set",
+    "StddevSamp": "stddev_samp", "VarianceSamp": "var_samp",
 }
 
 

@@ -3074,3 +3074,170 @@ def oracle_q39a(tables):
 
 def oracle_q39b(tables):
     return oracle_q39(tables, 0.85, 0.7)
+
+
+# ------------------------------------------- round-4 batch E
+
+
+def oracle_q18(tables):
+    dd = tables["date_dim"]
+    y2001 = set(dd["d_date_sk"][0][dd["d_year"][0] == 2001].tolist())
+    cdt = tables["customer_demographics"]
+    g = _sv(cdt, "cd_gender")
+    e = _sv(cdt, "cd_education_status")
+    cd_ok = {int(k): int(dc) for j, (k, dc) in
+             enumerate(zip(cdt["cd_demo_sk"][0], cdt["cd_dep_count"][0]))
+             if g[j] == "F" and e[j] == "College"}
+    cu = tables["customer"]
+    cu_ok = {int(k): (int(a), int(b)) for k, a, b in
+             zip(cu["c_customer_sk"][0], cu["c_current_addr_sk"][0],
+                 cu["c_birth_year"][0]) if 1966 <= int(b) <= 1980}
+    ca = tables["customer_address"]
+    cainfo = {int(k): (co, stt) for k, co, stt in
+              zip(ca["ca_address_sk"][0], _sv(ca, "ca_county"), _sv(ca, "ca_state"))}
+    it = tables["item"]
+    iid = {int(k): v for k, v in zip(it["i_item_sk"][0], _sv(it, "i_item_id"))}
+    cs = tables["catalog_sales"]
+    cells = {}
+    for idx in range(cs["cs_item_sk"][0].shape[0]):
+        if int(cs["cs_sold_date_sk"][0][idx]) not in y2001:
+            continue
+        cdsk = int(cs["cs_bill_cdemo_sk"][0][idx])
+        if cdsk not in cd_ok:
+            continue
+        csk = int(cs["cs_bill_customer_sk"][0][idx])
+        if csk not in cu_ok:
+            continue
+        adsk, byear = cu_ok[csk]
+        if adsk not in cainfo:
+            continue
+        county, state = cainfo[adsk]
+        i = int(cs["cs_item_sk"][0][idx])
+        if i not in iid:
+            continue
+        vals = (int(cs["cs_quantity"][0][idx]),
+                int(cs["cs_list_price"][0][idx]) / 100.0,
+                int(cs["cs_coupon_amt"][0][idx]) / 100.0,
+                int(cs["cs_sales_price"][0][idx]) / 100.0,
+                int(cs["cs_net_profit"][0][idx]) / 100.0,
+                byear, cd_ok[cdsk])
+        dims = (iid[i], county, state)
+        for level in range(3, -1, -1):
+            key = tuple(dims[k] if k < level else None for k in range(3)) + (3 - level,)
+            acc = cells.setdefault(key, [[0.0] * 7, 0])
+            for k in range(7):
+                acc[0][k] += vals[k]
+            acc[1] += 1
+    return {k: tuple(sv / n for sv in sums) for k, (sums, n) in cells.items()}
+
+
+def oracle_q40(tables):
+    import datetime
+
+    pivot = (datetime.date(2000, 3, 11) - datetime.date(1970, 1, 1)).days
+    win = _win_sks(tables, (2000, 2, 10), (2000, 4, 10))
+    dd = tables["date_dim"]
+    dval = dict(zip(dd["d_date_sk"][0].tolist(), dd["d_date"][0].tolist()))
+    it = tables["item"]
+    ids = _sv(it, "i_item_id")
+    ok_items = {int(sk): ids[k] for k, sk in enumerate(it["i_item_sk"][0])
+                if 2000 <= int(it["i_current_price"][0][k]) <= 5000}
+    wh = tables["warehouse"]
+    wstate = {int(k): v for k, v in zip(wh["w_warehouse_sk"][0], _sv(wh, "w_state"))}
+    cr = tables["catalog_returns"]
+    rets = {}
+    for i, o, cash in zip(cr["cr_item_sk"][0], cr["cr_order_number"][0],
+                          cr["cr_refunded_cash"][0]):
+        rets.setdefault((int(i), int(o)), []).append(int(cash))
+    cs = tables["catalog_sales"]
+    cells = {}
+    cnts = {}
+    for d, i, o, w, p in zip(cs["cs_sold_date_sk"][0], cs["cs_item_sk"][0],
+                             cs["cs_order_number"][0], cs["cs_warehouse_sk"][0],
+                             cs["cs_sales_price"][0]):
+        if int(d) not in win or int(i) not in ok_items or int(w) not in wstate:
+            continue
+        key = (wstate[int(w)], ok_items[int(i)])
+        before = dval[int(d)] < pivot
+        ms = rets.get((int(i), int(o)))
+        nets = [int(p) - cash for cash in ms] if ms else [int(p)]
+        acc = cells.setdefault(key, [0, 0])
+        cnt = cnts.setdefault(key, [0, 0])
+        for v in nets:
+            acc[0 if before else 1] += v
+            cnt[0 if before else 1] += 1
+    out = {}
+    for key, (b, a) in cells.items():
+        nb, na = cnts[key]
+        out[key] = (b if nb else None, a if na else None)
+    return out
+
+
+def oracle_q6(tables):
+    it = tables["item"]
+    cats = _sv(it, "i_category")
+    by_cat = {}
+    for c, p in zip(cats, it["i_current_price"][0]):
+        by_cat.setdefault(c, []).append(int(p))
+    # engine avg of decimal(7,2) -> (11,6) HALF_UP
+    cat_avg = {}
+    for c, vs in by_cat.items():
+        num = sum(vs) * 10_000
+        n = len(vs)
+        q, r = divmod(num, n)
+        cat_avg[c] = q + (1 if 2 * r >= n else 0)
+    hot = {int(sk) for sk, c, p in zip(it["i_item_sk"][0], cats,
+                                       it["i_current_price"][0])
+           if int(p) / 100.0 > 1.2 * (cat_avg[c] / 1_000_000.0)}
+    dd = tables["date_dim"]
+    may = {int(k) for k, y, m in zip(dd["d_date_sk"][0], dd["d_year"][0],
+                                     dd["d_moy"][0])
+           if int(y) == 2000 and int(m) == 5}
+    cu = tables["customer"]
+    addr = dict(zip(cu["c_customer_sk"][0].tolist(),
+                    cu["c_current_addr_sk"][0].tolist()))
+    ca = tables["customer_address"]
+    castate = {int(k): v for k, v in zip(ca["ca_address_sk"][0], _sv(ca, "ca_state"))}
+    ss = tables["store_sales"]
+    out = {}
+    for d, i, c in zip(ss["ss_sold_date_sk"][0], ss["ss_item_sk"][0],
+                       ss["ss_customer_sk"][0]):
+        if int(d) not in may or int(i) not in hot or int(c) not in addr:
+            continue
+        stt = castate.get(int(addr[int(c)]))
+        if stt is None:
+            continue
+        out[stt] = out.get(stt, 0) + 1
+    return {k: v for k, v in out.items() if v >= 10}
+
+
+def oracle_q83(tables):
+    dd = tables["date_dim"]
+    y2000 = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    it = tables["item"]
+    iid = {int(k): v for k, v in zip(it["i_item_sk"][0], _sv(it, "i_item_id"))}
+
+    def channel(rtab, r_date, r_item, r_qty):
+        rt = tables[rtab]
+        out = {}
+        for d, i, q in zip(rt[r_date][0], rt[r_item][0], rt[r_qty][0]):
+            if int(d) not in y2000 or int(i) not in iid:
+                continue
+            k = iid[int(i)]
+            out[k] = out.get(k, 0) + int(q)
+        return out
+
+    sr = channel("store_returns", "sr_returned_date_sk", "sr_item_sk",
+                 "sr_return_quantity")
+    cr = channel("catalog_returns", "cr_returned_date_sk", "cr_item_sk",
+                 "cr_return_quantity")
+    wr = channel("web_returns", "wr_returned_date_sk", "wr_item_sk",
+                 "wr_return_quantity")
+    out = {}
+    for k in sr:
+        if k in cr and k in wr:
+            a, b, c = sr[k], cr[k], wr[k]
+            tot = float(a + b + c)
+            out[k] = (a, b, c, a / tot * 100.0, b / tot * 100.0,
+                      c / tot * 100.0, tot / 3.0)
+    return out

@@ -4092,7 +4092,6 @@ def q17(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     datagen's stores span one state per name anyway.)"""
     from ..exprs.ir import Case
 
-    f64 = DataType.float64()
     j = _srcandc_join(t, n_parts)
     i64 = DataType.int64()
     qs = [("ss_quantity", "store"), ("sr_return_quantity", "returns"),
@@ -4187,9 +4186,230 @@ def q39b(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     return _q39(t, n_parts, 0.85, 0.7)
 
 
+
+# ------------------------------------------- round-4 batch E
+
+
+def q18(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Catalog demographic averages ROLLUP over customer geography:
+    avg quantities/prices per (item, county, state) rollup for young
+    buyers' households."""
+    from ..exprs.ir import Lit
+    from ..ops import ExpandExec
+
+    f64 = DataType.float64()
+    i64 = DataType.int64()
+    cd = FilterExec(t["customer_demographics"],
+                    (col("cd_gender") == lit("F"))
+                    & (col("cd_education_status") == lit("College")))
+    cd = ProjectExec(cd, [col("cd_demo_sk"), col("cd_dep_count")])
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2001))
+    dt = ProjectExec(dt, [col("d_date_sk")])
+    cu = FilterExec(t["customer"],
+                    (col("c_birth_year") >= lit(1966)) & (col("c_birth_year") <= lit(1980)))
+    cu = ProjectExec(cu, [col("c_customer_sk"), col("c_current_addr_sk"),
+                          col("c_birth_year")])
+    ca = ProjectExec(t["customer_address"],
+                     [col("ca_address_sk"), col("ca_county"), col("ca_state")])
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id")])
+    cs = ProjectExec(t["catalog_sales"],
+                     [col("cs_sold_date_sk"), col("cs_item_sk"),
+                      col("cs_bill_customer_sk"), col("cs_bill_cdemo_sk"),
+                      col("cs_quantity"), col("cs_list_price"),
+                      col("cs_coupon_amt"), col("cs_sales_price"),
+                      col("cs_net_profit")])
+    j = broadcast_join(dt, cs, [col("d_date_sk")], [col("cs_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(cd, j, [col("cd_demo_sk")], [col("cs_bill_cdemo_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(cu, j, [col("c_customer_sk")], [col("cs_bill_customer_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(ca, j, [col("ca_address_sk")], [col("c_current_addr_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(it, j, [col("i_item_sk")], [col("cs_item_sk")], JoinType.INNER, build_is_left=True)
+    measures = [("cs_quantity", "agg1"), ("cs_list_price", "agg2"),
+                ("cs_coupon_amt", "agg3"), ("cs_sales_price", "agg4"),
+                ("cs_net_profit", "agg5"), ("c_birth_year", "agg6"),
+                ("cd_dep_count", "agg7")]
+    base = ProjectExec(
+        j,
+        [col(src).cast(f64).alias(nm) for src, nm in measures]
+        + [col("i_item_id"), col("ca_county"), col("ca_state")],
+    )
+    s16 = DataType.string(16)
+    s24 = DataType.string(24)
+    s8 = DataType.string(8)
+    dims = [("i_item_id", s16), ("ca_county", s24), ("ca_state", s8)]
+    projections = []
+    for level in range(3, -1, -1):
+        row = [col(nm) for _, nm in measures]
+        for k, (name, dt_) in enumerate(dims):
+            row.append(col(name) if k < level else Lit(None, dt_))
+        row.append(lit(3 - level, i64))
+        projections.append(row)
+    expand = ExpandExec(base, projections,
+                        [nm for _, nm in measures] + [d[0] for d in dims] + ["g_id"])
+    agg = two_stage_agg(
+        expand,
+        [GroupingExpr(col(d[0]), d[0]) for d in dims]
+        + [GroupingExpr(col("g_id"), "g_id")],
+        [AggFunction("avg", col(nm), nm) for _, nm in measures],
+        n_parts,
+    )
+    return single_sorted(
+        agg,
+        [SortField(col("ca_county")), SortField(col("ca_state")),
+         SortField(col("i_item_id")), SortField(col("g_id"))],
+        fetch=100,
+    )
+
+
+def q40(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Catalog sales net of returns by (warehouse state, item), split
+    into before/after the 2000-03-11 pivot (the q21 shape over the
+    sales side, with the line-level cr LEFT join)."""
+    import datetime
+
+    from ..exprs.ir import Case
+
+    i64 = DataType.int64()
+    pivot = datetime.date(2000, 3, 11)
+    pivot_days = (pivot - datetime.date(1970, 1, 1)).days
+    dt = _date_window(t, pivot - datetime.timedelta(days=30),
+                      pivot + datetime.timedelta(days=30), extra=("d_date",))
+    dec = DataType.decimal(7, 2)
+    it = FilterExec(
+        t["item"],
+        (col("i_current_price") >= lit("20", dec))
+        & (col("i_current_price") <= lit("50", dec)),
+    )
+    it = ProjectExec(it, [col("i_item_sk"), col("i_item_id")])
+    wh = ProjectExec(t["warehouse"], [col("w_warehouse_sk"), col("w_state")])
+    cs = ProjectExec(t["catalog_sales"],
+                     [col("cs_sold_date_sk"), col("cs_item_sk"),
+                      col("cs_order_number"), col("cs_warehouse_sk"),
+                      col("cs_sales_price")])
+    j = broadcast_join(dt, cs, [col("d_date_sk")], [col("cs_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(it, j, [col("i_item_sk")], [col("cs_item_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(wh, j, [col("w_warehouse_sk")], [col("cs_warehouse_sk")], JoinType.INNER, build_is_left=True)
+    cr = ProjectExec(t["catalog_returns"],
+                     [col("cr_item_sk"), col("cr_order_number"),
+                      col("cr_refunded_cash")])
+    j = shuffle_join(j, cr, [col("cs_item_sk"), col("cs_order_number")],
+                     [col("cr_item_sk"), col("cr_order_number")],
+                     JoinType.LEFT, n_parts, build_left=False)
+    net = (_d8(col("cs_sales_price")) - _coalesce0(col("cr_refunded_cash")))
+    before = Case([(col("d_date").cast(i64) < lit(pivot_days, i64), net)], None)
+    after = Case([(col("d_date").cast(i64) >= lit(pivot_days, i64), net)], None)
+    proj = ProjectExec(j, [col("w_state"), col("i_item_id"),
+                           before.alias("b"), after.alias("a")])
+    agg = two_stage_agg(
+        proj,
+        [GroupingExpr(col("w_state"), "w_state"),
+         GroupingExpr(col("i_item_id"), "i_item_id")],
+        [AggFunction("sum", col("b"), "sales_before"),
+         AggFunction("sum", col("a"), "sales_after")],
+        n_parts,
+    )
+    return single_sorted(
+        agg, [SortField(col("w_state")), SortField(col("i_item_id"))],
+        fetch=100,
+    )
+
+
+def q6(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Customer states buying items priced over 1.2x their category
+    average in May 2000 (the correlated category-average subquery
+    decorrelated into a grouped-avg join), HAVING >= 10 customers."""
+    f64 = DataType.float64()
+    cat_avg = two_stage_agg(
+        ProjectExec(t["item"], [col("i_category").alias("avg_cat"),
+                                col("i_current_price")]),
+        [GroupingExpr(col("avg_cat"), "avg_cat")],
+        [AggFunction("avg", col("i_current_price"), "cat_avg_price")],
+        n_parts,
+    )
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_category"),
+                                 col("i_current_price")])
+    it = broadcast_join(cat_avg, it, [col("avg_cat")], [col("i_category")], JoinType.INNER, build_is_left=True)
+    it = FilterExec(
+        it,
+        col("i_current_price").cast(f64)
+        > lit(1.2) * col("cat_avg_price").cast(f64),
+    )
+    it = ProjectExec(it, [col("i_item_sk")])
+    dt = FilterExec(t["date_dim"],
+                    (col("d_year") == lit(2000)) & (col("d_moy") == lit(5)))
+    dt = ProjectExec(dt, [col("d_date_sk")])
+    sl = ProjectExec(t["store_sales"],
+                     [col("ss_sold_date_sk"), col("ss_item_sk"),
+                      col("ss_customer_sk")])
+    j = broadcast_join(dt, sl, [col("d_date_sk")], [col("ss_sold_date_sk")], JoinType.INNER, build_is_left=True)
+    j = broadcast_join(it, j, [col("i_item_sk")], [col("ss_item_sk")],
+                       JoinType.LEFT_SEMI, build_is_left=False)
+    cu = ProjectExec(t["customer"], [col("c_customer_sk"), col("c_current_addr_sk")])
+    j = broadcast_join(cu, j, [col("c_customer_sk")], [col("ss_customer_sk")], JoinType.INNER, build_is_left=True)
+    ca = ProjectExec(t["customer_address"], [col("ca_address_sk"), col("ca_state")])
+    j = broadcast_join(ca, j, [col("ca_address_sk")], [col("c_current_addr_sk")], JoinType.INNER, build_is_left=True)
+    agg = two_stage_agg(
+        j, [GroupingExpr(col("ca_state"), "state")],
+        [AggFunction("count_star", None, "cnt")],
+        n_parts,
+    )
+    f = FilterExec(agg, col("cnt") >= lit(10, DataType.int64()))
+    return single_sorted(
+        f, [SortField(col("cnt")), SortField(col("state"))], fetch=100
+    )
+
+
+def q83(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Per-item returns across all three channels in year 2000, each
+    channel's share against the three-channel average."""
+    f64 = DataType.float64()
+    dt = FilterExec(t["date_dim"], col("d_year") == lit(2000))
+    dt = ProjectExec(dt, [col("d_date_sk")])
+    it = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id")])
+
+    def channel(rtab, r_date, r_item, r_qty, nm):
+        rt = ProjectExec(t[rtab], [col(r_date), col(r_item), col(r_qty)])
+        j = broadcast_join(dt, rt, [col("d_date_sk")], [col(r_date)], JoinType.INNER, build_is_left=True)
+        j = broadcast_join(it, j, [col("i_item_sk")], [col(r_item)], JoinType.INNER, build_is_left=True)
+        agg = two_stage_agg(
+            ProjectExec(j, [col("i_item_id").alias(f"{nm}_item_id"),
+                            col(r_qty).cast(DataType.int64()).alias("q")]),
+            [GroupingExpr(col(f"{nm}_item_id"), f"{nm}_item_id")],
+            [AggFunction("sum", col("q"), f"{nm}_qty")],
+            n_parts,
+        )
+        return agg
+
+    sr = channel("store_returns", "sr_returned_date_sk", "sr_item_sk",
+                 "sr_return_quantity", "sr")
+    cr = channel("catalog_returns", "cr_returned_date_sk", "cr_item_sk",
+                 "cr_return_quantity", "cr")
+    wr = channel("web_returns", "wr_returned_date_sk", "wr_item_sk",
+                 "wr_return_quantity", "wr")
+    j = shuffle_join(sr, cr, [col("sr_item_id")], [col("cr_item_id")],
+                     JoinType.INNER, n_parts, build_left=False)
+    j = shuffle_join(j, wr, [col("sr_item_id")], [col("wr_item_id")],
+                     JoinType.INNER, n_parts, build_left=False)
+    total = (col("sr_qty") + col("cr_qty") + col("wr_qty")).cast(f64)
+    third = total / lit(3.0)
+    outs = [col("sr_item_id").alias("item_id"),
+            col("sr_qty"), col("cr_qty"), col("wr_qty")]
+    for nm in ("sr", "cr", "wr"):
+        outs.append(
+            (col(f"{nm}_qty").cast(f64) / total * lit(100.0)).alias(f"{nm}_dev"))
+    outs.append(third.alias("average"))
+    proj = ProjectExec(j, outs)
+    return single_sorted(
+        proj, [SortField(col("item_id")), SortField(col("sr_qty"))], fetch=100
+    )
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q1": q1,
     "q2": q2,
+    "q6": q6,
+    "q18": q18,
+    "q40": q40,
+    "q83": q83,
     "q17": q17,
     "q39a": q39a,
     "q39b": q39b,

@@ -698,3 +698,29 @@ def test_stddev_var_samp_two_stage():
             assert abs(got[g][0] - statistics.stdev(vs)) < 1e-12, g
             assert abs(got[g][1] - statistics.variance(vs)) < 1e-12, g
             assert abs(got[g][2] - statistics.stdev(ds)) < 1e-12, g
+
+
+def test_var_samp_no_catastrophic_cancellation():
+    """Large-magnitude inputs split one-per-state across the merge:
+    the deviation-scale parallel merge must hold the exact answer
+    (the raw sum-of-squares form returns 0 or ~4 here)."""
+    from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggFunction, GroupingExpr, MemoryScanExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.schema import DataType, Field, Schema
+    from blaze_tpu.tpch.queries import two_stage_agg
+
+    schema = Schema([Field("g", DataType.int64()), Field("v", DataType.float64())])
+    src = MemoryScanExec(
+        [[batch_from_pydict({"g": [0], "v": [1e8]}, schema)],
+         [batch_from_pydict({"g": [0], "v": [1e8 + 1]}, schema)]], schema)
+    plan = two_stage_agg(src, [GroupingExpr(col("g"), "g")],
+                         [AggFunction("var_samp", col("v"), "var")], 2)
+    got = None
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            d = batch_to_pydict(b)
+            if d["var"]:
+                got = d["var"][0]
+    assert got is not None and abs(got - 0.5) < 1e-9, got

@@ -1136,3 +1136,54 @@ def test_q39a(data, scans):
 
 def test_q39b(data, scans):
     _check_q39(run(build_query("q39b", scans, N_PARTS)), O.oracle_q39b(data))
+
+
+def test_q18(data, scans):
+    got = run(build_query("q18", scans, N_PARTS))
+    exp = O.oracle_q18(data)
+    assert exp, "q18 oracle empty"
+    n = len(got["i_item_id"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["i_item_id"][i], got["ca_county"][i], got["ca_state"][i],
+               got["g_id"][i])
+        assert key in exp, key
+        for k in range(7):
+            assert abs(got[f"agg{k+1}"][i] - exp[key][k]) < 1e-9, (key, k)
+
+
+def test_q40(data, scans):
+    got = run(build_query("q40", scans, N_PARTS))
+    exp = O.oracle_q40(data)
+    assert exp, "q40 oracle empty"
+    n = len(got["w_state"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["w_state"][i], got["i_item_id"][i])
+        assert key in exp, key
+        assert (got["sales_before"][i], got["sales_after"][i]) == exp[key], key
+
+
+def test_q6(data, scans):
+    got = run(build_query("q6", scans, N_PARTS))
+    exp = O.oracle_q6(data)
+    assert exp, "q6 oracle empty"
+    assert dict(zip(got["state"], got["cnt"])) == exp
+    assert got["cnt"] == sorted(got["cnt"])
+
+
+def test_q83(data, scans):
+    got = run(build_query("q83", scans, N_PARTS))
+    exp = O.oracle_q83(data)
+    assert exp, "q83 oracle empty"
+    n = len(got["item_id"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = got["item_id"][i]
+        assert key in exp, key
+        a, b, c, da, db, dc, avg = exp[key]
+        assert (got["sr_qty"][i], got["cr_qty"][i], got["wr_qty"][i]) == (a, b, c), key
+        assert abs(got["sr_dev"][i] - da) < 1e-9
+        assert abs(got["cr_dev"][i] - db) < 1e-9
+        assert abs(got["wr_dev"][i] - dc) < 1e-9
+        assert abs(got["average"][i] - avg) < 1e-9
