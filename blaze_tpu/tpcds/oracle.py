@@ -3241,3 +3241,56 @@ def oracle_q83(tables):
             out[k] = (a, b, c, a / tot * 100.0, b / tot * 100.0,
                       c / tot * 100.0, tot / 3.0)
     return out
+
+
+def _avg_unscaled(total, n, shift=10_000):
+    """Exact HALF_UP integer mirror of the engine's decimal avg
+    (scale + 4): unscaled-at-scale+4 average of ``total`` over ``n``."""
+    num = total * shift
+    if num >= 0:
+        q, r = divmod(num, n)
+        return q + (1 if 2 * r >= n else 0)
+    q, r = divmod(-num, n)
+    return -(q + (1 if 2 * r >= n else 0))
+
+
+def oracle_q44(tables):
+    ss = tables["store_sales"]
+    per = {}
+    base = []
+    for stk, i, a, p in zip(ss["ss_store_sk"][0], ss["ss_item_sk"][0],
+                            ss["ss_addr_sk"][0], ss["ss_net_profit"][0]):
+        if int(stk) != 4:
+            continue
+        acc = per.setdefault(int(i), [0, 0])
+        acc[0] += int(p)
+        acc[1] += 1
+        if int(a) == -1:
+            base.append(int(p))
+
+    avg_u = _avg_unscaled
+    if not base:
+        return {}
+    thr = avg_u(sum(base), len(base))
+    items = {i: avg_u(tv, n) for i, (tv, n) in per.items()
+             if avg_u(tv, n) / 1e6 > 0.9 * (thr / 1e6)}
+    it = tables["item"]
+    iid = {int(k): v for k, v in zip(it["i_item_sk"][0], _sv(it, "i_item_id"))}
+    asc = sorted(items.items(), key=lambda kv: kv[1])
+    rnk_asc = {}
+    for i, v in asc:
+        r = 1 + sum(1 for _, w in asc if w < v)
+        if r <= 10:
+            rnk_asc.setdefault(r, []).append(i)
+    rnk_desc = {}
+    for i, v in asc:
+        r = 1 + sum(1 for _, w in asc if w > v)
+        if r <= 10:
+            rnk_desc.setdefault(r, []).append(i)
+    out = set()
+    for r, bests in rnk_asc.items():
+        for b in bests:
+            for w in rnk_desc.get(r, ()):
+                if b in iid and w in iid:
+                    out.add((r, iid[b], iid[w]))
+    return out

@@ -4403,6 +4403,72 @@ def q83(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
     )
 
 
+
+def q44(t: Dict[str, ExecNode], n_parts: int) -> ExecNode:
+    """Best/worst items by average net profit at one store, paired by
+    rank: two rank() windows (asc/desc) over per-item averages above
+    90% of the store's null-address baseline, joined on rank.
+    (Deviation: i_item_id stands in for i_product_name; the null
+    ss_addr_sk baseline uses this datagen's -1 sentinel.)"""
+    from ..ops import SortExec, WindowExec, WindowFunction
+    from ..parallel import NativeShuffleExchangeExec, SinglePartitioning
+    from ..tpch.queries import scalar_subquery
+
+    f64 = DataType.float64()
+    i64 = DataType.int64()
+    store = lit(4, i64)
+    base = FilterExec(t["store_sales"], col("ss_store_sk") == store)
+    per_item = two_stage_agg(
+        ProjectExec(base, [col("ss_item_sk"), col("ss_net_profit")]),
+        [GroupingExpr(col("ss_item_sk"), "item_sk")],
+        [AggFunction("avg", col("ss_net_profit"), "rank_col")],
+        n_parts,
+    )
+    null_addr = FilterExec(
+        t["store_sales"],
+        (col("ss_store_sk") == store) & (col("ss_addr_sk") == lit(-1, i64)),
+    )
+    thr_plan = two_stage_agg(
+        ProjectExec(null_addr, [col("ss_net_profit")]), [],
+        [AggFunction("avg", col("ss_net_profit"), "thr")],
+        n_parts,
+    )
+    thr = scalar_subquery(thr_plan, "thr")
+    keep = FilterExec(
+        per_item,
+        col("rank_col").cast(f64) > lit(0.9) * thr.cast(f64),
+    )
+
+    # ONE materialized single-partition exchange shared by both ranked
+    # branches (exchanges memoize their map side per instance)
+    single = NativeShuffleExchangeExec(keep, SinglePartitioning())
+
+    def ranked(asc, alias_i, alias_r):
+        srt = SortExec(single, [SortField(col("rank_col"), ascending=asc)])
+        w = WindowExec(srt, [WindowFunction("rank", "rnk")], [],
+                       [SortField(col("rank_col"), ascending=asc)])
+        f = FilterExec(w, col("rnk") <= lit(10, i64))
+        return ProjectExec(f, [col("item_sk").alias(alias_i),
+                               col("rnk").alias(alias_r)])
+
+    asc = ranked(True, "best_sk", "rnk")
+    desc = ranked(False, "worst_sk", "rnk_d")
+    j = shuffle_join(asc, desc, [col("rnk")], [col("rnk_d")],
+                     JoinType.INNER, n_parts, build_left=False)
+    i1 = ProjectExec(t["item"], [col("i_item_sk"), col("i_item_id").alias("best_name")])
+    j = broadcast_join(i1, j, [col("i_item_sk")], [col("best_sk")], JoinType.INNER, build_is_left=True)
+    i2 = ProjectExec(t["item"], [col("i_item_sk").alias("i2_sk"),
+                                 col("i_item_id").alias("worst_name")])
+    j = broadcast_join(i2, j, [col("i2_sk")], [col("worst_sk")], JoinType.INNER, build_is_left=True)
+    proj = ProjectExec(j, [col("rnk"), col("best_name"), col("worst_name")])
+    return single_sorted(
+        proj,
+        [SortField(col("rnk")), SortField(col("best_name")),
+         SortField(col("worst_name"))],
+        fetch=100,
+    )
+
+
 QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q1": q1,
     "q2": q2,
@@ -4425,6 +4491,7 @@ QUERIES: Dict[str, Callable[[Dict[str, ExecNode], int], ExecNode]] = {
     "q28": q28,
     "q30": q30,
     "q41": q41,
+    "q44": q44,
     "q50": q50,
     "q76": q76,
     "q81": q81,

@@ -1187,3 +1187,13 @@ def test_q83(data, scans):
         assert abs(got["cr_dev"][i] - db) < 1e-9
         assert abs(got["wr_dev"][i] - dc) < 1e-9
         assert abs(got["average"][i] - avg) < 1e-9
+
+
+def test_q44(data, scans):
+    got = run(build_query("q44", scans, N_PARTS))
+    exp = O.oracle_q44(data)
+    assert exp, "q44 oracle empty"
+    rows = set(zip(got["rnk"], got["best_name"], got["worst_name"]))
+    assert len(got["rnk"]) == min(len(exp), 100)
+    assert rows == exp if len(exp) <= 100 else rows <= exp
+    assert got["rnk"] == sorted(got["rnk"])
