@@ -70,7 +70,7 @@ CACHED_RESULT_PATH = os.path.join(
 
 
 def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
-             partial_sink=None) -> dict:
+             partial_sink=None, retries: int = 0) -> dict:
     """Run q06 + q01 through the engine on the already-initialized
     backend; returns the result dict (no printing).
 
@@ -78,7 +78,11 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
     starts — the remote-compile tunnel can drop mid-run (round-4
     postmortem: q06 measured fine, then q01's fresh compile died with
     'Unexpected EOF' and the whole measurement was lost), so each
-    query's numbers are persisted the moment they exist."""
+    query's numbers are persisted the moment they exist.
+
+    ``retries``: per-query retry count — a tunnel flap (UNAVAILABLE /
+    Unexpected EOF) mid-query costs one backoff-and-retry, not the
+    attempt."""
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -131,10 +135,27 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
             once()
         return (time.perf_counter() - t0) / n_iters
 
+    def with_retry(fn):
+        for i in range(retries + 1):
+            try:
+                return fn()
+            except Exception:  # noqa: BLE001 — tunnel drops surface many ways
+                if i == retries:
+                    raise
+                time.sleep(20 * (i + 1))
+
+    def measure_query(build, cols, scale):
+        # stage INSIDE the retry unit: the H2D transfer is the widest
+        # tunnel-flap window, and a flap that kills the connection
+        # leaves staged device buffers dead — each retry restages
+        def attempt():
+            parts, schema, rows = stage(cols, scale)
+            return rows, run_query(build, parts, schema)
+
+        return with_retry(attempt)
+
     q6_cols = ("l_quantity", "l_extendedprice", "l_discount", "l_shipdate")
-    parts6, schema6, rows6 = stage(q6_cols, scale_q6)
-    dt6 = run_query(q6, parts6, schema6)
-    del parts6
+    rows6, dt6 = measure_query(q6, q6_cols, scale_q6)
 
     r6 = rows6 / dt6
     # bytes actually touched by the q06 pipeline per row (5 referenced
@@ -155,8 +176,7 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
 
     q1_cols = ("l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
                "l_discount", "l_tax", "l_shipdate")
-    parts1, schema1, rows1 = stage(q1_cols, scale_q1)
-    dt1 = run_query(q1, parts1, schema1)
+    rows1, dt1 = measure_query(q1, q1_cols, scale_q1)
     r1 = rows1 / dt1
     result["q01_rows_per_sec"] = round(r1, 1)
     result["q01_vs_baseline"] = round(r1 / BLAZE_Q01_ROWS_PER_SEC_PER_NODE, 3)
@@ -166,25 +186,60 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
     return result
 
 
+# one predicate, three consumers: _is_tpu_backend, the probe
+# subprocess snippet, and the child backend tag all derive from it
+_TPU_DEVICE_MARKERS = ("tpu", "axon")
+
+
 def _is_tpu_backend() -> bool:
     import jax
 
     return any(
-        "tpu" in str(d).lower() or "axon" in str(d).lower() for d in jax.devices()
+        any(m in str(d).lower() for m in _TPU_DEVICE_MARKERS)
+        for d in jax.devices()
     )
+
+
+def _tpu_env() -> dict:
+    """Environment for probes and the measurement child: scrub ONLY
+    CPU-forcing values inherited from the parent (a dry-run shell with
+    JAX_PLATFORMS=cpu once made the probe 'succeed' against CPU
+    devices and handed the measurement child a CPU backend).  The real
+    axon env (JAX_PLATFORMS=axon, PALLAS_AXON_POOL_IPS=<ip>) must pass
+    through untouched — sitecustomize registers the axon backend only
+    when POOL_IPS is truthy, so popping live values would permanently
+    blind every probe."""
+    env = dict(os.environ)
+    if env.get("JAX_PLATFORMS", "keep").strip().lower() in ("", "cpu"):
+        env.pop("JAX_PLATFORMS", None)
+    if not env.get("PALLAS_AXON_POOL_IPS", "keep"):
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    if "host_platform_device_count" in env.get("XLA_FLAGS", ""):
+        kept = [t for t in env["XLA_FLAGS"].split()
+                if "host_platform_device_count" not in t]
+        if kept:
+            env["XLA_FLAGS"] = " ".join(kept)
+        else:
+            env.pop("XLA_FLAGS")
+    return env
 
 
 def _probe_once(timeout_s: float) -> bool:
     """One expendable-subprocess probe: a wedged lease HANGS backend
     init, and killing a probe stuck in register() is safe (it holds no
-    lease yet)."""
+    lease yet).  Success requires an actual TPU/axon device — CPU
+    fallback devices must not count."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            [sys.executable, "-c",
+             "import jax; ds=jax.devices(); print('TPUOK' if any("
+             f"m in str(d).lower() for m in {_TPU_DEVICE_MARKERS!r} "
+             "for d in ds) else 'cpuonly')"],
             capture_output=True,
             timeout=timeout_s,
+            env=_tpu_env(),
         )
-        return proc.returncode == 0 and b"ok" in proc.stdout
+        return proc.returncode == 0 and b"TPUOK" in proc.stdout
     except subprocess.TimeoutExpired:
         return False
 
@@ -229,12 +284,72 @@ def _tpu_child(out_path: str) -> None:
                 f.write(json.dumps(result))
             os.replace(ctmp, CACHED_RESULT_PATH)
 
-    publish(_measure(SCALE_Q6, SCALE_Q1, on_tpu=_is_tpu_backend(),
-                     partial_sink=publish))
+    on_tpu = _is_tpu_backend()
+    # Pre-warm BOTH query pipelines end-to-end at tiny scale first
+    # (round-4 postmortem: a tunnel flap during q01's FULL-scale fresh
+    # compile cost the whole attempt; a tiny-scale flap costs seconds
+    # and proves the tunnel before the expensive datagen+compile).
+    try:
+        # no retries here: a flap during warmup should fall straight
+        # through to the main attempt (which retries), not burn the
+        # driver-window budget in backoff sleeps
+        _measure(0.01, 0.01, on_tpu=on_tpu)
+    except Exception:  # noqa: BLE001 — warmup failure: let the real
+        pass  # attempt produce the authoritative error/result
+    publish(_measure(SCALE_Q6, SCALE_Q1, on_tpu=on_tpu,
+                     partial_sink=publish, retries=2))
 
 
 def _smoke(scale: float) -> None:
     print(json.dumps(_measure(scale, scale, on_tpu=_is_tpu_backend())))
+
+
+def _log_summary(entries) -> dict:
+    """Compact provenance: the driver captures only the LAST 2000 chars
+    of stdout, so the emitted line carries a summary; the full
+    probe/watchdog history stays in .bench_probe_log.jsonl and
+    .bench_emitted_full.json (round-4 postmortem: embedded full logs
+    pushed the metric head off the captured tail, BENCH_r04 parsed
+    null)."""
+    # the watchdog journal also holds measuring/measure/exit events —
+    # only probe entries may feed the wedged-or-live ratio
+    entries = [e for e in entries if e.get("event", "probe") == "probe"]
+    if not entries:
+        return {"probes": 0, "ok": 0}
+    oks = [e for e in entries if e.get("ok")]
+    out = {"probes": len(entries), "ok": len(oks),
+           "first": entries[0].get("t"), "last": entries[-1].get("t")}
+    if oks:
+        out["last_ok"] = oks[-1].get("t")
+    return out
+
+
+def _emit(result: dict, probe_log, wd_entries) -> None:
+    """Print the ONE driver-consumed JSON line, guaranteed to fit the
+    driver's 2000-char stdout tail; full logs go to a side file."""
+    full_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench_emitted_full.json"
+    )
+    try:
+        with open(full_path, "w") as f:
+            json.dump(dict(result, probe_log=probe_log,
+                           watchdog_log=wd_entries), f)
+    except Exception:  # noqa: BLE001 — forensics must not block the line
+        pass
+    result = dict(result)
+    result.pop("probe_log", None)
+    result.pop("watchdog_log", None)
+    result["probe_summary"] = _log_summary(probe_log)
+    result["watchdog_summary"] = _log_summary(wd_entries)
+    line = json.dumps(result)
+    if len(line) >= 1500:
+        for key in ("note", "error", "watchdog_summary", "probe_summary"):
+            result.pop(key, None)
+            line = json.dumps(result)
+            if len(line) < 1500:
+                break
+    assert len(line) < 1500, f"bench line too long ({len(line)} chars)"
+    print(line)
 
 
 def _watchdog() -> None:
@@ -286,6 +401,7 @@ def _watchdog() -> None:
             [sys.executable, os.path.abspath(__file__), "--tpu-child",
              CACHED_RESULT_PATH],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=_tpu_env(),
             start_new_session=True,  # NEVER killed: killing a
             # chip-holding process wedges the lease for hours
         )
@@ -348,6 +464,7 @@ def main() -> None:
                 [sys.executable, os.path.abspath(__file__), "--tpu-child", tpu_result_path],
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
+                env=_tpu_env(),
                 start_new_session=True,  # NEVER killed with this parent:
                 # killing a chip-holding process wedges the lease for hours
             )
@@ -385,7 +502,16 @@ def main() -> None:
     if os.path.exists(wd_path):
         try:
             with open(wd_path) as f:
-                wd_log = [json.loads(l) for l in f if l.strip()][-60:]
+                wd_log = [json.loads(l) for l in f if l.strip()]
+            # the journal is append-only across rounds: summarize only
+            # THIS round's window (same bound as the result cache) so
+            # a prior round's live lease can't mask this round's wedge
+            cutoff = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ",
+                time.gmtime(time.time() - float(
+                    os.environ.get("BLAZE_BENCH_CACHE_MAX_AGE_H", "14")) * 3600),
+            )
+            wd_log = [e for e in wd_log if e.get("t", "") >= cutoff]
         except Exception:  # noqa: BLE001
             wd_log = []
 
@@ -395,9 +521,7 @@ def main() -> None:
             tpu_line = json.load(f)
 
     if tpu_line is not None and tpu_line.get("backend") == "tpu":
-        tpu_line["probe_log"] = probe_log
-        tpu_line["watchdog_log"] = wd_log
-        print(json.dumps(tpu_line))
+        _emit(tpu_line, probe_log, wd_log)
         return
 
     # --- cached measurement from earlier in the round (recorded the
@@ -418,8 +542,6 @@ def main() -> None:
         if cached is not None and cached.get("backend") == "tpu":
             cached["cached"] = True
             cached["cache_age_s"] = round(age_s, 1)
-            cached["probe_log"] = probe_log
-            cached["watchdog_log"] = wd_log
             cached["note"] = (
                 f"measured {round(age_s / 3600, 1)}h ago (within this round) "
                 "when the chip lease was live; driver-window probes: "
@@ -429,7 +551,7 @@ def main() -> None:
                     else "succeeded but fresh measurement missed the deadline"
                 )
             )
-            print(json.dumps(cached))
+            _emit(cached, probe_log, wd_log)
             return
 
     # fall back to the CPU child's line (never killed: it holds no chip
@@ -452,9 +574,7 @@ def main() -> None:
         result["note"] = "tpu probe ok but measurement missed the deadline"
     else:
         result["note"] = "tpu_unavailable: all probes failed (wedged chip lease?)"
-    result["probe_log"] = probe_log
-    result["watchdog_log"] = wd_log
-    print(json.dumps(result))
+    _emit(result, probe_log, wd_log)
 
 
 if __name__ == "__main__":
