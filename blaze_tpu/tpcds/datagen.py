@@ -131,10 +131,20 @@ def _date_dim() -> HostTable:
 
 def _time_dim() -> HostTable:
     mins = np.arange(1440, dtype=np.int64)
+    hours = mins // 60
+    # round-5 columns (deterministic, no rng): t_time in seconds since
+    # midnight (q66 slices a BETWEEN range on it); dsdgen's meal-time
+    # buckets (q71 filters breakfast/dinner)
+    meal = np.where(
+        (hours >= 6) & (hours < 9), "breakfast",
+        np.where((hours >= 17) & (hours < 20), "dinner", ""),
+    )
     return {
         "t_time_sk": (mins, None),
-        "t_hour": ((mins // 60).astype(np.int32), None),
+        "t_hour": (hours.astype(np.int32), None),
         "t_minute": ((mins % 60).astype(np.int32), None),
+        "t_time": ((mins * 60).astype(np.int64), None),
+        "t_meal_time": (*_encode_options([str(m) for m in meal], 16),),
     }
 
 
@@ -212,6 +222,8 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "hd_dep_count": ((np.arange(n) % 10).astype(np.int32), None),
             "hd_buy_potential": (bp_data, bp_len),
             "hd_vehicle_count": (((np.arange(n) % 5) - 1).astype(np.int32), None),
+            # round-5 column (deterministic): q84's income-band edge
+            "hd_income_band_sk": ((np.arange(n) % 20 + 1).astype(np.int64), None),
         }
     if name == "customer":
         n = _n_customers(scale)
@@ -231,6 +243,9 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "c_preferred_cust_flag": (pf, pf_len),
             "c_customer_id": (*_encode_options([f"CUST{k:012d}" for k in range(1, n + 1)], 16),),
             "c_birth_year": ((1930 + np.arange(n) % 63).astype(np.int32), None),
+            # round-5 column (new draw strictly after the existing
+            # ones): q84's household-demographics edge
+            "c_current_hdemo_sk": (rng.randint(1, 721, n).astype(np.int64), None),
         }
     if name == "customer_address":
         n = _n_addresses(scale)
@@ -260,6 +275,10 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             # ~1/6 of addresses share each store city so the q46/q68
             # "bought in another city" predicate splits rows both ways
             "ca_city": (*_encode_options([CITIES[(i * 5) % len(CITIES)] for i in range(n)], 16),),
+            # round-5 column (deterministic): ~10% non-US so the q85
+            # ca_country predicate filters real rows
+            "ca_country": (*_encode_options(
+                [("Canada" if i % 10 == 9 else "United States") for i in range(n)], 16),),
         }
     if name == "call_center":
         names = ["NY Metro", "Mid Atlantic", "North Midwest", "Pacific Northwest"]
@@ -364,6 +383,12 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "cs_wholesale_cost": (_money(rng, n, 1, 100), None),
             "cs_ext_list_price": (_money(rng, n, 1, 3000), None),
             "cs_net_paid": (_money(rng, n, 0, 2000), None),
+        })
+        # round-5 columns (new draws strictly after the round-4 ones;
+        # q66 pivots on sold time + net incl. tax, q71 on sold time)
+        out.update({
+            "cs_sold_time_sk": (rng.randint(0, 1440, n).astype(np.int64), None),
+            "cs_net_paid_inc_tax": (_money(rng, n, 0, 2200), None),
         })
         return out
     if name == "web_sales":
@@ -510,6 +535,10 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "w_warehouse_name": (*_encode_options(WAREHOUSE_NAMES, 24),),
             "w_state": (*_encode_options([STATES[i % len(STATES)] for i in range(n)], 8),),
             "w_county": (*_encode_options([COUNTIES[i % len(COUNTIES)] for i in range(n)], 24),),
+            # round-5 columns (deterministic, q66's pivot attributes)
+            "w_warehouse_sq_ft": (((np.arange(n) + 1) * 73065).astype(np.int32), None),
+            "w_city": (*_encode_options([CITIES[i % len(CITIES)] for i in range(n)], 16),),
+            "w_country": (*_encode_options(["United States"] * n, 16),),
         }
     if name == "web_site":
         n = len(WEB_SITE_NAMES)
@@ -530,6 +559,14 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
         return {
             "cp_catalog_page_sk": (np.arange(1, n + 1, dtype=np.int64), None),
             "cp_catalog_page_id": (*_encode_options([f"CPAG{k:08d}" for k in range(1, n + 1)], 16),),
+        }
+    if name == "income_band":
+        # dsdgen's 20 fixed bands: [0..10000], [10001..20000], ...
+        sk = np.arange(1, 21, dtype=np.int64)
+        return {
+            "ib_income_band_sk": (sk, None),
+            "ib_lower_bound": (np.where(sk == 1, 0, (sk - 1) * 10000 + 1).astype(np.int32), None),
+            "ib_upper_bound": ((sk * 10000).astype(np.int32), None),
         }
     if name == "web_page":
         n = 10
@@ -602,6 +639,13 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
             "wr_web_page_sk": (ws["ws_web_page_sk"][0][idx], None),
             "wr_returning_customer_sk": (ws["ws_bill_customer_sk"][0][idx], None),
             "wr_refunded_cash": (_money(rng, n, 0, 250), None),
+            # round-5 columns (new draws strictly after the round-4
+            # ones): the q85 demographics/address/reason edges
+            "wr_fee": (_money(rng, n, 0, 100), None),
+            "wr_refunded_cdemo_sk": (rng.randint(1, _n_cdemo() + 1, n).astype(np.int64), None),
+            "wr_returning_cdemo_sk": (rng.randint(1, _n_cdemo() + 1, n).astype(np.int64), None),
+            "wr_refunded_addr_sk": (rng.randint(1, _n_addresses(scale) + 1, n).astype(np.int64), None),
+            "wr_reason_sk": (rng.randint(1, len(REASON_DESCS) + 1, n).astype(np.int64), None),
         }
     raise KeyError(f"unknown tpcds table {name!r}")
 

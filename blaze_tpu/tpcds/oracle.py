@@ -3294,3 +3294,219 @@ def oracle_q44(tables):
                 if b in iid and w in iid:
                     out.add((r, iid[b], iid[w]))
     return out
+
+
+def oracle_q31(tables):
+    """County web-vs-store quarterly growth.  Returns
+    {county: (web12, store12, web23, store23)} float ratios, mirroring
+    the engine's decimal->f64 cast (unscaled/100) before division."""
+    dd = tables["date_dim"]
+    ca = tables["customer_address"]
+    county = {int(k): v for k, v in
+              zip(ca["ca_address_sk"][0], _sv(ca, "ca_county"))}
+
+    def branch(fact, date_c, addr_c, price_c, qoy):
+        f = tables[fact]
+        dmask = (dd["d_year"][0] == 2000) & (dd["d_qoy"][0] == qoy)
+        dsk = set(dd["d_date_sk"][0][dmask].tolist())
+        out = {}
+        for d, a, p in zip(f[date_c][0], f[addr_c][0], f[price_c][0]):
+            if int(d) in dsk and int(a) in county:
+                c = county[int(a)]
+                out[c] = out.get(c, 0) + int(p)
+        return out
+
+    ss = {q: branch("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+                    "ss_ext_sales_price", q) for q in (1, 2, 3)}
+    ws = {q: branch("web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+                    "ws_ext_sales_price", q) for q in (1, 2, 3)}
+    out = {}
+    for c in ss[1]:
+        if any(c not in ss[q] for q in (2, 3)) or any(
+                c not in ws[q] for q in (1, 2, 3)):
+            continue
+
+        def ratio(b, qa, qb):
+            # np division: a zero denominator yields inf/nan exactly
+            # like the engine's unguarded f64 projection, not a raise
+            return float(np.float64(b[qb][c] / 100.0)
+                         / np.float64(b[qa][c] / 100.0))
+
+        w12, s12 = ratio(ws, 1, 2), ratio(ss, 1, 2)
+        w23, s23 = ratio(ws, 2, 3), ratio(ss, 2, 3)
+        # the engine filter CASE-guards each ratio (NULL when the
+        # denominator sum is 0) and NULL comparisons are false; the
+        # projection is UNGUARDED (inf survives into the output)
+        arm1 = ws[1][c] > 0 and ss[1][c] > 0 and w12 > s12
+        arm2 = ws[2][c] > 0 and ss[2][c] > 0 and w23 > s23
+        if arm1 or arm2:
+            out[c] = (w12, s12, w23, s23)
+    return out
+
+
+def _min_rank(vals):
+    """rank() semantics: ties share the LOWEST position (1-based)."""
+    arr = np.asarray(vals, dtype=np.float64)
+    order = np.sort(arr)
+    return (np.searchsorted(order, arr, side="left") + 1).tolist()
+
+
+def oracle_q49(tables):
+    """Worst return ratios per channel, double-ranked.  Returns the row
+    set {(channel, item_sk, return_ratio, return_rank, currency_rank)}
+    (deviation mirror: return amount filter > 250, see queries.q49)."""
+    dd = tables["date_dim"]
+    dsk = set(dd["d_date_sk"][0][(dd["d_year"][0] == 2001)
+                                 & (dd["d_moy"][0] == 12)].tolist())
+
+    def channel(name, fact, ret, s_item, s_ord, s_qty, s_paid, s_profit,
+                r_item, r_ord, r_qty, r_amt, date_c):
+        f, r = tables[fact], tables[ret]
+        rmap = {}
+        for i in range(r[r_item][0].shape[0]):
+            amt = int(r[r_amt][0][i])
+            if amt / 100.0 > 250.0:
+                key = (int(r[r_ord][0][i]), int(r[r_item][0][i]))
+                rmap.setdefault(key, []).append((int(r[r_qty][0][i]), amt))
+        agg = {}
+        for i in range(f[s_item][0].shape[0]):
+            if int(f[date_c][0][i]) not in dsk:
+                continue
+            if not (int(f[s_profit][0][i]) / 100.0 > 1.0):
+                continue
+            if not (int(f[s_paid][0][i]) / 100.0 > 0.0):
+                continue
+            if not int(f[s_qty][0][i]) > 0:
+                continue
+            key = (int(f[s_ord][0][i]), int(f[s_item][0][i]))
+            for rq, ra in rmap.get(key, ()):
+                a = agg.setdefault(key[1], [0, 0, 0, 0])
+                a[0] += rq
+                a[1] += int(f[s_qty][0][i])
+                a[2] += ra
+                a[3] += int(f[s_paid][0][i])
+        items = sorted(agg)
+        if not items:
+            return set()
+        rr = [agg[i][0] / agg[i][1] for i in items]
+        cr = [(agg[i][2] / 100.0) / (agg[i][3] / 100.0) for i in items]
+        rrank = _min_rank(rr)
+        crank = _min_rank(cr)
+        return {
+            (name, i, rr[k], rrank[k], crank[k])
+            for k, i in enumerate(items)
+            if rrank[k] <= 10 or crank[k] <= 10
+        }
+
+    out = set()
+    out |= channel("web", "web_sales", "web_returns", "ws_item_sk",
+                   "ws_order_number", "ws_quantity", "ws_net_paid",
+                   "ws_net_profit", "wr_item_sk", "wr_order_number",
+                   "wr_return_quantity", "wr_return_amt", "ws_sold_date_sk")
+    out |= channel("catalog", "catalog_sales", "catalog_returns",
+                   "cs_item_sk", "cs_order_number", "cs_quantity",
+                   "cs_net_paid", "cs_net_profit", "cr_item_sk",
+                   "cr_order_number", "cr_return_quantity",
+                   "cr_return_amount", "cs_sold_date_sk")
+    out |= channel("store", "store_sales", "store_returns", "ss_item_sk",
+                   "ss_ticket_number", "ss_quantity", "ss_net_paid",
+                   "ss_net_profit", "sr_item_sk", "sr_ticket_number",
+                   "sr_return_quantity", "sr_return_amt", "ss_sold_date_sk")
+    return out
+
+
+def oracle_q54(tables):
+    """Maternity-buyer revenue segments.  Returns {segment: count},
+    segment = int((revenue_cents/100)/50) mirroring the engine's
+    f64 cast + truncating int cast."""
+    dd = tables["date_dim"]
+    it = tables["item"]
+    i_mask = _s_eq(it, "i_category", "Women")
+    isk = set(it["i_item_sk"][0][i_mask].tolist())
+    dec98 = (dd["d_year"][0] == 1998) & (dd["d_moy"][0] == 12)
+    dsk = set(dd["d_date_sk"][0][dd["d_year"][0] == 1998].tolist())
+    cust = tables["customer"]
+    addr_of = dict(zip(cust["c_customer_sk"][0].tolist(),
+                       cust["c_current_addr_sk"][0].tolist()))
+
+    buyers = set()
+    for fact, date_c, cust_c, item_c in (
+        ("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk", "cs_item_sk"),
+        ("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk", "ws_item_sk"),
+    ):
+        f = tables[fact]
+        for d, c, i in zip(f[date_c][0], f[cust_c][0], f[item_c][0]):
+            if int(d) in dsk and int(i) in isk and int(c) in addr_of:
+                buyers.add(int(c))
+
+    ms = int(dd["d_month_seq"][0][dec98][0])
+    win = (dd["d_month_seq"][0] >= ms + 1) & (dd["d_month_seq"][0] <= ms + 3)
+    wsk = set(dd["d_date_sk"][0][win].tolist())
+
+    ca = tables["customer_address"]
+    ca_loc = {int(k): (cy, st) for k, cy, st in
+              zip(ca["ca_address_sk"][0], _sv(ca, "ca_county"), _sv(ca, "ca_state"))}
+    st_tab = tables["store"]
+    store_locs = {}
+    for cy, stv in zip(_sv(st_tab, "s_county"), _sv(st_tab, "s_state")):
+        store_locs[(cy, stv)] = store_locs.get((cy, stv), 0) + 1
+
+    revenue = {}
+    ss = tables["store_sales"]
+    for d, c, p in zip(ss["ss_sold_date_sk"][0], ss["ss_customer_sk"][0],
+                       ss["ss_ext_sales_price"][0]):
+        c = int(c)
+        if int(d) not in wsk or c not in buyers:
+            continue
+        loc = ca_loc.get(addr_of[c])
+        mult = store_locs.get(loc, 0)
+        if mult:
+            revenue[c] = revenue.get(c, 0) + int(p) * mult
+
+    segs = {}
+    for cents in revenue.values():
+        seg = int((cents / 100.0) / 50.0)
+        segs[seg] = segs.get(seg, 0) + 1
+    return segs
+
+
+def oracle_q58(tables):
+    """Cross-channel items sold evenly in the week of 2000-01-03.
+    Returns {item_id: (ss_rev_cents, ss_dev, cs_rev_cents, cs_dev,
+    ws_rev_cents, ws_dev, average)} mirroring f64 casts."""
+    dd = tables["date_dim"]
+    sel = dd["d_date"][0] == _days(2000, 1, 3)
+    wk = int(dd["d_month_seq"][0][sel][0])
+    dsk = set(dd["d_date_sk"][0][dd["d_month_seq"][0] == wk].tolist())
+    it = tables["item"]
+    iid = {int(k): v for k, v in zip(it["i_item_sk"][0], _sv(it, "i_item_id"))}
+
+    def channel(fact, item_c, date_c, price_c):
+        f = tables[fact]
+        out = {}
+        for d, i, p in zip(f[date_c][0], f[item_c][0], f[price_c][0]):
+            if int(d) in dsk and int(i) in iid:
+                k = iid[int(i)]
+                out[k] = out.get(k, 0) + int(p)
+        return out
+
+    ssr = channel("store_sales", "ss_item_sk", "ss_sold_date_sk", "ss_ext_sales_price")
+    csr = channel("catalog_sales", "cs_item_sk", "cs_sold_date_sk", "cs_ext_sales_price")
+    wsr = channel("web_sales", "ws_item_sk", "ws_sold_date_sk", "ws_ext_sales_price")
+    out = {}
+    for k in ssr:
+        if k not in csr or k not in wsr:
+            continue
+        s, c, w = ssr[k] / 100.0, csr[k] / 100.0, wsr[k] / 100.0
+
+        def near(a, b):
+            return 0.25 * b <= a <= 4.0 * b
+
+        if not (near(s, c) and near(s, w) and near(c, s) and near(c, w)
+                and near(w, s) and near(w, c)):
+            continue
+        total = s + c + w
+        out[k] = (ssr[k], s / total / 3.0 * 100.0,
+                  csr[k], c / total / 3.0 * 100.0,
+                  wsr[k], w / total / 3.0 * 100.0, total / 3.0)
+    return out

@@ -1197,3 +1197,67 @@ def test_q44(data, scans):
     assert len(got["rnk"]) == min(len(exp), 100)
     assert rows == exp if len(exp) <= 100 else rows <= exp
     assert got["rnk"] == sorted(got["rnk"])
+
+
+def test_q31(ticket_data, ticket_scans):
+    got = run(build_query("q31", ticket_scans, N_PARTS))
+    exp = O.oracle_q31(ticket_data)
+    assert exp, "q31 oracle empty"
+    rows = {
+        c: (w12, s12, w23, s23)
+        for c, w12, s12, w23, s23 in zip(
+            got["ca_county"], got["web_q1_q2_increase"],
+            got["store_q1_q2_increase"], got["web_q2_q3_increase"],
+            got["store_q2_q3_increase"])
+    }
+    assert set(rows) == set(exp)
+    for c, vals in rows.items():  # XLA FMA contraction: ULP-level slack
+        assert vals == pytest.approx(exp[c], rel=1e-12), c
+    assert got["d_year"] == [2000] * len(rows)
+    assert got["ca_county"] == sorted(got["ca_county"])
+
+
+def test_q49(ticket_data, ticket_scans):
+    got = run(build_query("q49", ticket_scans, N_PARTS))
+    exp = O.oracle_q49(ticket_data)
+    assert exp, "q49 oracle empty"
+    assert len(exp) <= 100, "q49 fixture outgrew fetch=100; cap the oracle"
+    rows = set(zip(got["channel"], got["item"], got["return_ratio"],
+                   got["return_rank"], got["currency_rank"]))
+    assert rows == exp
+    # ORDER BY channel, return_rank, currency_rank
+    keys = list(zip(got["channel"], got["return_rank"], got["currency_rank"]))
+    assert keys == sorted(keys)
+
+
+def test_q54(ticket_data, ticket_scans):
+    got = run(build_query("q54", ticket_scans, N_PARTS))
+    exp = O.oracle_q54(ticket_data)
+    assert exp, "q54 oracle empty"
+    assert len(exp) <= 100, "q54 fixture outgrew fetch=100; cap the oracle"
+    rows = dict(zip(got["segment"], got["num_customers"]))
+    assert rows == exp
+    assert got["segment_base"] == [s * 50 for s in got["segment"]]
+    assert got["segment"] == sorted(got["segment"])
+
+
+def test_q58(ticket_data, ticket_scans):
+    got = run(build_query("q58", ticket_scans, N_PARTS))
+    exp = O.oracle_q58(ticket_data)
+    assert exp, "q58 oracle empty"
+    assert len(exp) <= 100, "q58 fixture outgrew fetch=100; cap the oracle"
+    rows = {
+        iid: (sr, sd, cr, cd, wr, wd, avg)
+        for iid, sr, sd, cr, cd, wr, wd, avg in zip(
+            got["item_id"], got["ss_item_rev"], got["ss_dev"],
+            got["cs_item_rev"], got["cs_dev"], got["ws_item_rev"],
+            got["ws_dev"], got["average"])
+    }
+    assert set(rows) == set(exp)
+    for iid, (sr, sd, cr, cd, wr, wd, avg) in rows.items():
+        e = exp[iid]
+        assert (sr, cr, wr) == (e[0], e[2], e[4]), iid  # cents exact
+        # XLA FMA contraction: ULP-level slack on derived ratios
+        assert (sd, cd, wd, avg) == pytest.approx(
+            (e[1], e[3], e[5], e[6]), rel=1e-12), iid
+    assert got["item_id"] == sorted(got["item_id"])
