@@ -3510,3 +3510,199 @@ def oracle_q58(tables):
                   csr[k], c / total / 3.0 * 100.0,
                   wsr[k], w / total / 3.0 * 100.0, total / 3.0)
     return out
+
+
+def oracle_q66(tables):
+    """Warehouse monthly pivot over web+catalog.  Returns
+    {w_name: (sq_ft, city, county, state, country, sales12, ratio12,
+    net12)} — cents ints / floats, None for empty buckets, mirroring
+    the engine's NULL pivot sums and channel-ratio float adds."""
+    dd = tables["date_dim"]
+    tm = tables["time_dim"]
+    smt = tables["ship_mode"]
+    wh = tables["warehouse"]
+    moy_by_sk = {int(k): int(m) for k, m in
+                 zip(dd["d_date_sk"][0][dd["d_year"][0] == 2001],
+                     dd["d_moy"][0][dd["d_year"][0] == 2001])}
+    tsel = set(tm["t_time_sk"][0][(tm["t_time"][0] >= 30838)
+                                  & (tm["t_time"][0] <= 30838 + 28800)].tolist())
+    carriers = _sv(smt, "sm_carrier")
+    msel = {int(k) for k, c in zip(smt["sm_ship_mode_sk"][0], carriers)
+            if c in ("DHL", "BARIAN")}
+    wnames, wcities, wcounties, wstates, wcountries = (
+        _sv(wh, c) for c in ("w_warehouse_name", "w_city", "w_county",
+                             "w_state", "w_country"))
+    winfo = {}
+    for i, k in enumerate(wh["w_warehouse_sk"][0]):
+        winfo[int(k)] = (
+            wnames[i], int(wh["w_warehouse_sq_ft"][0][i]),
+            wcities[i], wcounties[i], wstates[i], wcountries[i])
+
+    def channel(fact, wh_c, date_c, time_c, mode_c, qty_c, sales_c, net_c):
+        f = tables[fact]
+        out = {}
+        for i in range(f[wh_c][0].shape[0]):
+            m = moy_by_sk.get(int(f[date_c][0][i]))
+            if m is None or int(f[time_c][0][i]) not in tsel:
+                continue
+            if int(f[mode_c][0][i]) not in msel:
+                continue
+            w = int(f[wh_c][0][i])
+            if w not in winfo:
+                continue
+            qty = int(f[qty_c][0][i])
+            acc = out.setdefault(w, [[None] * 12, [None] * 12])
+            for slot, c in ((0, sales_c), (1, net_c)):
+                v = int(f[c][0][i]) * qty
+                acc[slot][m - 1] = v if acc[slot][m - 1] is None else acc[slot][m - 1] + v
+        return out
+
+    web = channel("web_sales", "ws_warehouse_sk", "ws_sold_date_sk",
+                  "ws_sold_time_sk", "ws_ship_mode_sk", "ws_quantity",
+                  "ws_ext_sales_price", "ws_net_paid")
+    cat = channel("catalog_sales", "cs_warehouse_sk", "cs_sold_date_sk",
+                  "cs_sold_time_sk", "cs_ship_mode_sk", "cs_quantity",
+                  "cs_sales_price", "cs_net_paid_inc_tax")
+    out = {}
+    for w in set(web) | set(cat):
+        name, sq_ft, city, cty, state, country = winfo[w]
+        sales, ratios, nets = [], [], []
+        for m in range(12):
+            svals = [ch[w][0][m] for ch in (web, cat)
+                     if w in ch and ch[w][0][m] is not None]
+            nvals = [ch[w][1][m] for ch in (web, cat)
+                     if w in ch and ch[w][1][m] is not None]
+            sales.append(sum(svals) if svals else None)
+            nets.append(sum(nvals) if nvals else None)
+            rvals = [(v / 100.0) / float(sq_ft) for v in svals]
+            ratios.append(sum(rvals) if rvals else None)
+        out[name] = (sq_ft, city, cty, state, country,
+                     tuple(sales), tuple(ratios), tuple(nets))
+    return out
+
+
+def oracle_q71(tables):
+    """Meal-time brand minutes.  Returns
+    {(brand_id, brand, hour, minute): sum_cents}."""
+    dd = tables["date_dim"]
+    it = tables["item"]
+    tm = tables["time_dim"]
+    dsel = set(dd["d_date_sk"][0][(dd["d_year"][0] == 1999)
+                                  & (dd["d_moy"][0] == 11)].tolist())
+    brands = _sv(it, "i_brand")
+    binfo = {int(k): (int(b), brands[i]) for i, (k, b) in
+             enumerate(zip(it["i_item_sk"][0], it["i_brand_id"][0]))
+             if int(it["i_manager_id"][0][i]) == 1}
+    meal = _sv(tm, "t_meal_time")
+    tinfo = {int(k): (int(h), int(mi)) for k, h, mi, ml in
+             zip(tm["t_time_sk"][0], tm["t_hour"][0], tm["t_minute"][0], meal)
+             if ml in ("breakfast", "dinner")}
+    out = {}
+    for fact, price_c, date_c, item_c, time_c in (
+        ("web_sales", "ws_ext_sales_price", "ws_sold_date_sk",
+         "ws_item_sk", "ws_sold_time_sk"),
+        ("catalog_sales", "cs_ext_sales_price", "cs_sold_date_sk",
+         "cs_item_sk", "cs_sold_time_sk"),
+        ("store_sales", "ss_ext_sales_price", "ss_sold_date_sk",
+         "ss_item_sk", "ss_sold_time_sk"),
+    ):
+        f = tables[fact]
+        for d, i, tk, p in zip(f[date_c][0], f[item_c][0], f[time_c][0],
+                               f[price_c][0]):
+            if int(d) not in dsel or int(i) not in binfo:
+                continue
+            ht = tinfo.get(int(tk))
+            if ht is None:
+                continue
+            bid, b = binfo[int(i)]
+            key = (bid, b, ht[0], ht[1])
+            out[key] = out.get(key, 0) + int(p)
+    return out
+
+
+def oracle_q84(tables):
+    """Midway income-band returners.  Returns the SORTED row list
+    [(customer_id, 'last, first')] with join multiplicity (one row per
+    matching store return), truncated to 100."""
+    ca = tables["customer_address"]
+    midway = set(ca["ca_address_sk"][0][_s_eq(ca, "ca_city", "Midway")].tolist())
+    ib = tables["income_band"]
+    bands = set(ib["ib_income_band_sk"][0][
+        (ib["ib_lower_bound"][0] >= 38128)
+        & (ib["ib_upper_bound"][0] <= 38128 + 50000)].tolist())
+    hd = tables["household_demographics"]
+    hsel = set(hd["hd_demo_sk"][0][np.isin(hd["hd_income_band_sk"][0],
+                                           list(bands))].tolist())
+    sr = tables["store_returns"]
+    ret_by_cdemo = {}
+    for c in sr["sr_cdemo_sk"][0]:
+        c = int(c)
+        ret_by_cdemo[c] = ret_by_cdemo.get(c, 0) + 1
+    cust = tables["customer"]
+    ids = _sv(cust, "c_customer_id")
+    firsts = _sv(cust, "c_first_name")
+    lasts = _sv(cust, "c_last_name")
+    rows = []
+    for i in range(len(ids)):
+        if int(cust["c_current_addr_sk"][0][i]) not in midway:
+            continue
+        if int(cust["c_current_hdemo_sk"][0][i]) not in hsel:
+            continue
+        n = ret_by_cdemo.get(int(cust["c_current_cdemo_sk"][0][i]), 0)
+        rows.extend([(ids[i], f"{lasts[i]}, {firsts[i]}")] * n)
+    rows.sort()
+    return rows[:100]
+
+
+def oracle_q85(tables):
+    """Web-return reason averages under OR'd band triples.  Returns
+    {reason[:20]: (avg_quantity_float, avg_cash_unscaled4,
+    avg_fee_unscaled4)} (deviation mirror: widened bands, see
+    queries.q85)."""
+    dd = tables["date_dim"]
+    ws, wr = tables["web_sales"], tables["web_returns"]
+    cd = tables["customer_demographics"]
+    ca = tables["customer_address"]
+    rs = tables["reason"]
+    y2000 = set(dd["d_date_sk"][0][dd["d_year"][0] == 2000].tolist())
+    ms = _sv(cd, "cd_marital_status")
+    states = _sv(ca, "ca_state")
+    country = _sv(ca, "ca_country")
+    rdesc = _sv(rs, "r_reason_desc")
+    rmap = {int(k): rdesc[i] for i, k in enumerate(rs["r_reason_sk"][0])}
+    smap = {}
+    for i in range(len(ws["ws_item_sk"][0])):
+        key = (int(ws["ws_order_number"][0][i]), int(ws["ws_item_sk"][0][i]))
+        smap.setdefault(key, []).append(i)
+    agg = {}
+    for k in range(len(wr["wr_item_sk"][0])):
+        key = (int(wr["wr_order_number"][0][k]), int(wr["wr_item_sk"][0][k]))
+        for i in smap.get(key, ()):
+            if int(ws["ws_sold_date_sk"][0][i]) not in y2000:
+                continue
+            c1 = int(wr["wr_refunded_cdemo_sk"][0][k]) - 1
+            c2 = int(wr["wr_returning_cdemo_sk"][0][k]) - 1
+            a = int(wr["wr_refunded_addr_sk"][0][k]) - 1
+            price = int(ws["ws_sales_price"][0][i]) / 100.0
+            profit = int(ws["ws_net_profit"][0][i]) / 100.0
+            demo = ((ms[c1] == "M" and ms[c1] == ms[c2] and 0.0 <= price <= 150.0)
+                    or (ms[c1] == "S" and ms[c1] == ms[c2] and 50.0 <= price <= 250.0)
+                    or (ms[c1] == "W" and ms[c1] == ms[c2] and 100.0 <= price <= 300.0))
+            geo = ((country[a] == "United States" and states[a] in ("OH", "TN", "SD")
+                    and -1000.0 <= profit <= 500.0)
+                   or (country[a] == "United States" and states[a] in ("AL", "GA", "SD")
+                       and 0.0 <= profit <= 1500.0)
+                   or (country[a] == "United States" and states[a] in ("TN", "GA", "AL")
+                       and -500.0 <= profit <= 1000.0))
+            if not (demo and geo):
+                continue
+            r = rmap[int(wr["wr_reason_sk"][0][k])]
+            acc = agg.setdefault(r, [0, 0, 0, 0])
+            acc[0] += int(ws["ws_quantity"][0][i])
+            acc[1] += int(wr["wr_refunded_cash"][0][k])
+            acc[2] += int(wr["wr_fee"][0][k])
+            acc[3] += 1
+    return {
+        r[:20]: (tq / n, _avg_unscaled(tc, n), _avg_unscaled(tf, n))
+        for r, (tq, tc, tf, n) in agg.items()
+    }

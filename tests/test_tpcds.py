@@ -1261,3 +1261,64 @@ def test_q58(ticket_data, ticket_scans):
         assert (sd, cd, wd, avg) == pytest.approx(
             (e[1], e[3], e[5], e[6]), rel=1e-12), iid
     assert got["item_id"] == sorted(got["item_id"])
+
+
+def test_q66(data, scans):
+    got = run(build_query("q66", scans, N_PARTS))
+    exp = O.oracle_q66(data)
+    assert exp, "q66 oracle empty"
+    assert got["w_warehouse_name"] == sorted(exp)
+    for i, name in enumerate(got["w_warehouse_name"]):
+        sq_ft, city, cty, state, country, sales, ratios, nets = exp[name]
+        assert (got["w_warehouse_sq_ft"][i], got["w_city"][i],
+                got["w_county"][i], got["w_state"][i],
+                got["w_country"][i]) == (sq_ft, city, cty, state, country)
+        assert got["ship_carriers"][i] == "DHL,BARIAN"
+        assert got["year"][i] == 2001
+        for m, nm in enumerate(
+                ("jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+                 "sep", "oct", "nov", "dec")):
+            assert got[f"{nm}_sales"][i] == sales[m], (name, nm)
+            assert got[f"{nm}_net"][i] == nets[m], (name, nm)
+            g = got[f"{nm}_sales_per_sq_foot"][i]
+            if ratios[m] is None:
+                assert g is None, (name, nm)
+            else:
+                assert g == pytest.approx(ratios[m], rel=1e-12), (name, nm)
+
+
+def test_q71(ticket_data, ticket_scans):
+    got = run(build_query("q71", ticket_scans, N_PARTS))
+    exp = O.oracle_q71(ticket_data)
+    assert exp, "q71 oracle empty"
+    rows = dict(zip(zip(got["brand_id"], got["brand"], got["t_hour"],
+                        got["t_minute"]), got["ext_price"]))
+    assert rows == exp
+    keys = list(zip([-p for p in got["ext_price"]], got["brand_id"]))
+    assert keys == sorted(keys)
+
+
+def test_q84(ticket_data, ticket_scans):
+    got = run(build_query("q84", ticket_scans, N_PARTS))
+    exp = O.oracle_q84(ticket_data)
+    assert exp, "q84 oracle empty"
+    rows = sorted(zip(got["customer_id"], got["customername"]))
+    assert rows == exp
+    assert got["customer_id"] == sorted(got["customer_id"])
+
+
+def test_q85(ticket_data, ticket_scans):
+    got = run(build_query("q85", ticket_scans, N_PARTS))
+    exp = O.oracle_q85(ticket_data)
+    assert exp, "q85 oracle empty"
+    rows = {
+        r: (q, c, f)
+        for r, q, c, f in zip(got["reason"], got["avg_q"], got["avg_cash"],
+                              got["avg_fee"])
+    }
+    assert set(rows) == set(exp)
+    for r, (q, c, f) in rows.items():
+        eq, ec, ef = exp[r]
+        assert q == pytest.approx(eq, rel=1e-12), r
+        assert (c, f) == (ec, ef), r
+    assert got["reason"] == sorted(got["reason"])
