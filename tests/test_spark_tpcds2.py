@@ -1,0 +1,551 @@
+"""TPC-DS differentials through full Spark conversion — round-5 widening.
+
+Extends test_spark_tpcds.py's 13-query slice toward the reference
+gate's breadth (``tpcds-reusable.yml:83-143``): every test here
+authors a catalyst ``toJSON`` physical-plan dump, crosses strategy +
+expression conversion, executes via BOTH the in-process collect path
+and the stage scheduler (TaskDefinition protobuf bytes + shuffle
+files), and validates against the independent numpy oracles.
+
+Dual-shape: each join-bearing plan is parametrized over the broadcast
+shape AND the forced sort-merge shape (``SortMergeJoinExec`` over
+sorted shuffles) — the reference CI runs every query twice, with
+broadcast joins and with ``autoBroadcastJoinThreshold=-1``
+(``tpcds-reusable.yml:123-143``).
+"""
+
+import json
+
+import pytest
+
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.spark import BlazeSparkSession
+from blaze_tpu.tpcds import TPCDS_SCHEMAS
+from blaze_tpu.tpcds import oracle as O
+from blaze_tpu.tpcds.datagen import generate_all
+from blaze_tpu.tpch.datagen import table_to_batches
+
+import spark_fixtures as F
+from test_spark_tpcds import (
+    N_PARTS,
+    a,
+    and_,
+    ar,
+    in_,
+    i32,
+    ne,
+    or_,
+    s,
+    two_stage,
+)
+from test_tpcds import (
+    _check_demo_avgs,
+    _check_ship_lag,
+    _check_ticket_report,
+)
+
+pytestmark = pytest.mark.slow
+
+SCALE = 0.002
+
+_SINCE_CLEAR = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _clear_caches_every_few_tests():
+    """Same jaxlib compiled-program ceiling mitigation as
+    test_tpcds.py — this module's dual-shape matrix compiles a lot of
+    distinct programs."""
+    yield
+    _SINCE_CLEAR["n"] += 1
+    if _SINCE_CLEAR["n"] % 8 == 0:
+        import jax
+
+        from blaze_tpu.ops.joins.broadcast import clear_join_map_cache
+        from blaze_tpu.runtime.kernel_cache import clear_kernel_cache
+
+        clear_kernel_cache()
+        clear_join_map_cache()
+        jax.clear_caches()
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate_all(SCALE)
+
+
+@pytest.fixture(scope="module")
+def sess(data):
+    sess = BlazeSparkSession(default_parallelism=N_PARTS)
+    for name in TPCDS_SCHEMAS:
+        sess.register_table(
+            name,
+            MemoryScanExec(
+                table_to_batches(data[name], TPCDS_SCHEMAS[name], N_PARTS,
+                                 batch_rows=4096),
+                TPCDS_SCHEMAS[name],
+            ),
+        )
+    return sess
+
+
+@pytest.fixture(params=["bhj", "smj"])
+def strategy(request):
+    return request.param
+
+
+def _ss(keys, child):
+    """Sorted shuffle: the child of each forced-SMJ side."""
+    return F.sort([F.sort_order(k) for k in keys],
+                  F.shuffle(F.hash_partitioning(keys, N_PARTS), child),
+                  global_=False)
+
+
+def join(strategy, build, probe, bkeys, pkeys, jt="Inner",
+         build_side="left", condition=None):
+    """Strategy-parameterized equi-join: BroadcastHashJoin with the
+    dimension side broadcast, or the forced-SMJ shape
+    (SortMergeJoin over sorted hash shuffles) that the reference CI's
+    autoBroadcastJoinThreshold=-1 run plans."""
+    if strategy == "bhj":
+        if build_side == "left":
+            return F.bhj(bkeys, pkeys, jt, "left", F.broadcast(build),
+                         probe, condition=condition)
+        return F.bhj(pkeys, bkeys, jt, "right", probe, F.broadcast(build),
+                     condition=condition)
+    if build_side == "left":
+        return F.smj(bkeys, pkeys, jt, _ss(bkeys, build), _ss(pkeys, probe),
+                     condition=condition)
+    return F.smj(pkeys, bkeys, jt, _ss(pkeys, probe), _ss(bkeys, build),
+                 condition=condition)
+
+
+def _execute_both(sess, plan):
+    js = json.dumps(F.flatten(plan))
+    got = sess.execute(js)
+    got_sched = sess.execute_distributed(js)
+    rows = sorted(
+        zip(*got.values()), key=lambda r: tuple((v is None, v) for v in r)
+    ) if got else []
+    rows_sched = sorted(
+        zip(*got_sched.values()), key=lambda r: tuple((v is None, v) for v in r)
+    ) if got_sched else []
+    assert rows == rows_sched, "in-process vs scheduler mismatch"
+    return got
+
+
+# ------------------------------------------------ q7/q26 demographic averages
+
+def _demo_avg_plan(st, fact, cdemo_c, date_c, promo_c, item_c, qty_c,
+                   list_c, coupon_c, sales_c):
+    cd = F.project(
+        [a("cd_demo_sk")],
+        F.filter_(
+            and_(F.binop("EqualTo", a("cd_gender"), s("M")),
+                 F.binop("EqualTo", a("cd_marital_status"), s("S")),
+                 F.binop("EqualTo", a("cd_education_status"), s("College"))),
+            F.scan("customer_demographics",
+                   [a("cd_demo_sk"), a("cd_gender"), a("cd_marital_status"),
+                    a("cd_education_status")]),
+        ),
+    )
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    pr = F.project(
+        [a("p_promo_sk")],
+        F.filter_(
+            or_(F.binop("EqualTo", a("p_channel_email"), s("N")),
+                F.binop("EqualTo", a("p_channel_event"), s("N"))),
+            F.scan("promotion", [a("p_promo_sk"), a("p_channel_email"),
+                                 a("p_channel_event")]),
+        ),
+    )
+    sl = F.scan(fact, [a(cdemo_c), a(date_c), a(promo_c), a(item_c),
+                       a(qty_c), a(list_c), a(coupon_c), a(sales_c)])
+    j = join(st, cd, sl, [a("cd_demo_sk")], [a(cdemo_c)])
+    j = join(st, dt, j, [a("d_date_sk")], [a(date_c)])
+    j = join(st, pr, j, [a("p_promo_sk")], [a(promo_c)])
+    it = F.scan("item", [a("i_item_sk"), a("i_item_id")])
+    j = join(st, it, j, [a("i_item_sk")], [a(item_c)])
+    agg = two_stage(
+        [a("i_item_id")],
+        [(F.avg(a(qty_c)), 501), (F.avg(a(list_c)), 502),
+         (F.avg(a(coupon_c)), 503), (F.avg(a(sales_c)), 504)],
+        j,
+    )
+    return F.take_ordered(
+        100, [F.sort_order(a("i_item_id"))],
+        [a("i_item_id"),
+         F.alias(ar("agg1", 501, "double"), "agg1", 511),
+         F.alias(ar("agg2", 502, "decimal(11,6)"), "agg2", 512),
+         F.alias(ar("agg3", 503, "decimal(11,6)"), "agg3", 513),
+         F.alias(ar("agg4", 504, "decimal(11,6)"), "agg4", 514)],
+        agg,
+    )
+
+
+def test_spark_q7(sess, data, strategy):
+    got = _execute_both(sess, _demo_avg_plan(
+        strategy, "store_sales", "ss_cdemo_sk", "ss_sold_date_sk",
+        "ss_promo_sk", "ss_item_sk", "ss_quantity", "ss_list_price",
+        "ss_coupon_amt", "ss_sales_price"))
+    _check_demo_avgs(got, O.oracle_q7(data))
+
+
+def test_spark_q26(sess, data, strategy):
+    got = _execute_both(sess, _demo_avg_plan(
+        strategy, "catalog_sales", "cs_bill_cdemo_sk", "cs_sold_date_sk",
+        "cs_promo_sk", "cs_item_sk", "cs_quantity", "cs_list_price",
+        "cs_coupon_amt", "cs_sales_price"))
+    _check_demo_avgs(got, O.oracle_q26(data))
+
+
+# ------------------------------------------- q19 star + non-equi zip residual
+
+def test_spark_q19(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_moy"), i32(11)),
+                       F.binop("EqualTo", a("d_year"), i32(1998))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_moy"), a("d_year")])),
+    )
+    it = F.project(
+        [a("i_item_sk"), a("i_brand_id"), a("i_brand"), a("i_manufact_id"),
+         a("i_manufact")],
+        F.filter_(F.binop("EqualTo", a("i_manager_id"), i32(8)),
+                  F.scan("item", [a("i_item_sk"), a("i_brand_id"), a("i_brand"),
+                                  a("i_manufact_id"), a("i_manufact"),
+                                  a("i_manager_id")])),
+    )
+    cust = F.scan("customer", [a("c_customer_sk"), a("c_current_addr_sk")])
+    addr = F.scan("customer_address", [a("ca_address_sk"), a("ca_zip")])
+    st_ = F.scan("store", [a("s_store_sk"), a("s_zip")])
+    sl = F.scan("store_sales", [a("ss_sold_date_sk"), a("ss_item_sk"),
+                                a("ss_customer_sk"), a("ss_store_sk"),
+                                a("ss_ext_sales_price")])
+    j = join(strategy, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    j = join(strategy, cust, j, [a("c_customer_sk")], [a("ss_customer_sk")])
+    j = join(strategy, addr, j, [a("ca_address_sk")], [a("c_current_addr_sk")])
+    j = join(strategy, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    sub = lambda c: F.T(F.X + "Substring", [c, i32(1), i32(5)])
+    j = F.filter_(ne(sub(a("ca_zip")), sub(a("s_zip"))), j)
+    agg = two_stage(
+        [a("i_brand_id"), a("i_brand"), a("i_manufact_id"), a("i_manufact")],
+        [(F.sum_(a("ss_ext_sales_price")), 501)],
+        j,
+    )
+    price = ar("ext_price", 501, "decimal(17,2)")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(price, asc=False), F.sort_order(a("i_brand")),
+         F.sort_order(a("i_brand_id")), F.sort_order(a("i_manufact_id")),
+         F.sort_order(a("i_manufact"))],
+        [F.alias(a("i_brand_id"), "brand_id", 510),
+         F.alias(a("i_brand"), "brand", 511),
+         F.alias(a("i_manufact_id"), "manufact_id", 512),
+         F.alias(a("i_manufact"), "manufact", 513),
+         F.alias(price, "ext_price", 514)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q19(data)
+    assert exp, "q19 oracle empty"
+    rows = {
+        (bid, b, mid, m): v
+        for bid, b, mid, m, v in zip(got["brand_id"], got["brand"],
+                                     got["manufact_id"], got["manufact"],
+                                     got["ext_price"])
+    }
+    if len(exp) <= 100:
+        assert rows == exp
+    else:
+        assert set(rows.items()) <= set(exp.items())
+    assert got["ext_price"] == sorted(got["ext_price"], reverse=True)
+
+
+# ----------------------------------------------- q34/q73 ticket-count reports
+
+def _ticket_plan(st, dom_pred, buy_potentials, cnt_lo, cnt_hi, ratio, orders):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(dom_pred,
+                 in_(a("d_year"), 1999, 2000, 2001, dtype="integer")),
+            F.scan("date_dim", [a("d_date_sk"), a("d_dom"), a("d_year")]),
+        ),
+    )
+    bp = in_(a("hd_buy_potential"), *buy_potentials)
+    ratio_e = F.binop(
+        "GreaterThan",
+        F.binop("Divide", F.cast(a("hd_dep_count"), "double"),
+                F.cast(a("hd_vehicle_count"), "double")),
+        F.lit(ratio, "double"),
+    )
+    hd = F.project(
+        [a("hd_demo_sk")],
+        F.filter_(
+            and_(bp, F.binop("GreaterThan", a("hd_vehicle_count"), i32(0)),
+                 ratio_e),
+            F.scan("household_demographics",
+                   [a("hd_demo_sk"), a("hd_buy_potential"), a("hd_dep_count"),
+                    a("hd_vehicle_count")]),
+        ),
+    )
+    st_ = F.project(
+        [a("s_store_sk")],
+        F.filter_(
+            in_(a("s_county"), "Williamson County", "Franklin Parish",
+                "Bronx County", "Orange County"),
+            F.scan("store", [a("s_store_sk"), a("s_county")]),
+        ),
+    )
+    sl = F.scan("store_sales", [a("ss_sold_date_sk"), a("ss_hdemo_sk"),
+                                a("ss_store_sk"), a("ss_ticket_number"),
+                                a("ss_customer_sk")])
+    j = join(st, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(st, hd, j, [a("hd_demo_sk")], [a("ss_hdemo_sk")])
+    j = join(st, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    agg = two_stage(
+        [a("ss_ticket_number"), a("ss_customer_sk")],
+        [(F.count(), 501)],
+        j,
+    )
+    cnt = ar("cnt", 501, "long")
+    having = F.filter_(
+        and_(F.binop("GreaterThanOrEqual", cnt, F.lit(cnt_lo, "long")),
+             F.binop("LessThanOrEqual", cnt, F.lit(cnt_hi, "long"))),
+        agg,
+    )
+    cust = F.scan("customer", [a("c_customer_sk"), a("c_salutation"),
+                               a("c_first_name"), a("c_last_name"),
+                               a("c_preferred_cust_flag")])
+    j2 = join(st, cust, having, [a("c_customer_sk")], [a("ss_customer_sk")])
+    proj = [a("c_salutation"), a("c_first_name"), a("c_last_name"),
+            a("c_preferred_cust_flag"), a("ss_ticket_number"),
+            a("ss_customer_sk"), F.alias(cnt, "cnt", 510)]
+    single = F.shuffle(F.single_partition(), F.project(proj, j2))
+    return F.sort(orders, single)
+
+
+def test_spark_q34(ticket_sess, ticket_data, strategy):
+    dom = or_(
+        and_(F.binop("GreaterThanOrEqual", a("d_dom"), i32(1)),
+             F.binop("LessThanOrEqual", a("d_dom"), i32(3))),
+        and_(F.binop("GreaterThanOrEqual", a("d_dom"), i32(25)),
+             F.binop("LessThanOrEqual", a("d_dom"), i32(28))),
+    )
+    plan = _ticket_plan(
+        strategy, dom, (">10000", "Unknown"), 15, 20, 1.2,
+        [F.sort_order(a("c_last_name")), F.sort_order(a("c_first_name")),
+         F.sort_order(a("c_salutation")),
+         F.sort_order(a("c_preferred_cust_flag"), asc=False),
+         F.sort_order(a("ss_ticket_number"))],
+    )
+    got = _execute_both(ticket_sess, plan)
+    _check_ticket_report(got, O.oracle_q34(ticket_data))
+
+
+def test_spark_q73(ticket_sess, ticket_data, strategy):
+    dom = and_(F.binop("GreaterThanOrEqual", a("d_dom"), i32(1)),
+               F.binop("LessThanOrEqual", a("d_dom"), i32(2)))
+    plan = _ticket_plan(
+        strategy, dom, (">10000", "Unknown"), 1, 5, 1.0,
+        [F.sort_order(ar("cnt", 510, "long"), asc=False),
+         F.sort_order(a("c_last_name"))],
+    )
+    got = _execute_both(ticket_sess, plan)
+    _check_ticket_report(got, O.oracle_q73(ticket_data))
+
+
+@pytest.fixture(scope="module")
+def ticket_data():
+    return generate_all(0.01)
+
+
+@pytest.fixture(scope="module")
+def ticket_sess(ticket_data):
+    sess = BlazeSparkSession(default_parallelism=N_PARTS)
+    for name in TPCDS_SCHEMAS:
+        sess.register_table(
+            name,
+            MemoryScanExec(
+                table_to_batches(ticket_data[name], TPCDS_SCHEMAS[name],
+                                 N_PARTS, batch_rows=4096),
+                TPCDS_SCHEMAS[name],
+            ),
+        )
+    return sess
+
+
+# --------------------------------------------------------- q43 dow pivot
+
+_DOW = ("sun", "mon", "tue", "wed", "thu", "fri", "sat")
+
+
+def test_spark_q43(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk"), a("d_dow")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_dow"), a("d_year")])),
+    )
+    st_ = F.scan("store", [a("s_store_sk"), a("s_store_name")])
+    sl = F.scan("store_sales", [a("ss_sold_date_sk"), a("ss_store_sk"),
+                                a("ss_sales_price")])
+    j = join(strategy, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(strategy, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    pivots = [
+        F.alias(
+            F.T(F.X + "CaseWhen",
+                [F.binop("EqualTo", a("d_dow"), i32(k)), a("ss_sales_price")]),
+            f"{nm}_v", 520 + k)
+        for k, nm in enumerate(_DOW)
+    ]
+    proj = F.project([a("s_store_name")] + pivots, j)
+    agg = two_stage(
+        [a("s_store_name")],
+        [(F.sum_(ar(f"{nm}_v", 520 + k, "decimal(7,2)")), 501 + k)
+         for k, nm in enumerate(_DOW)],
+        proj,
+    )
+    plan = F.take_ordered(
+        100, [F.sort_order(a("s_store_name"))],
+        [a("s_store_name")]
+        + [F.alias(ar(f"{nm}_sales", 501 + k, "decimal(17,2)"),
+                   f"{nm}_sales", 540 + k)
+           for k, nm in enumerate(_DOW)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q43(data)
+    assert exp, "q43 oracle empty"
+    assert got["s_store_name"] == sorted(got["s_store_name"])
+    for i, nm in enumerate(got["s_store_name"]):
+        for k, d in enumerate(_DOW):
+            assert (got[f"{d}_sales"][i] or 0) == exp[nm][k], (nm, d)
+
+
+# ------------------------------------------------------------ q96 count star
+
+def test_spark_q96(sess, data, strategy):
+    td = F.project(
+        [a("t_time_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("t_hour"), i32(20)),
+                       F.binop("GreaterThanOrEqual", a("t_minute"), i32(30))),
+                  F.scan("time_dim", [a("t_time_sk"), a("t_hour"),
+                                      a("t_minute")])),
+    )
+    hd = F.project(
+        [a("hd_demo_sk")],
+        F.filter_(F.binop("EqualTo", a("hd_dep_count"), i32(7)),
+                  F.scan("household_demographics",
+                         [a("hd_demo_sk"), a("hd_dep_count")])),
+    )
+    st_ = F.project(
+        [a("s_store_sk")],
+        F.filter_(F.binop("EqualTo", a("s_store_name"), s("ese")),
+                  F.scan("store", [a("s_store_sk"), a("s_store_name")])),
+    )
+    sl = F.scan("store_sales", [a("ss_sold_time_sk"), a("ss_hdemo_sk"),
+                                a("ss_store_sk")])
+    j = join(strategy, td, sl, [a("t_time_sk")], [a("ss_sold_time_sk")])
+    j = join(strategy, hd, j, [a("hd_demo_sk")], [a("ss_hdemo_sk")])
+    j = join(strategy, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    plan = two_stage([], [(F.count(), 501)], j,
+                     result=[F.alias(ar("cnt", 501, "long"), "cnt", 510)])
+    got = _execute_both(sess, plan)
+    assert got["cnt"] == [O.oracle_q96(data)]
+
+
+# ---------------------------------------------------- q62/q99 ship-lag pivot
+
+_LAG = ("d30", "d60", "d90", "d120", "dmore")
+
+
+def _ship_lag_plan(st, fact, sold_c, ship_c, wh_c, sm_c, dim_tab, dim_sk,
+                   dim_name, dim_fk):
+    dt = F.project(
+        [a("d_date_sk"), a("d_date")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2001)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_date"),
+                                      a("d_year")])),
+    )
+    d2sk = ar("d_date_sk", 601, "long")
+    d2date = ar("d_date", 602, "date")
+    d2 = F.project(
+        [F.alias(d2sk, "d2_sk", 603), F.alias(d2date, "ship_date", 604)],
+        F.scan("date_dim", [d2sk, d2date]),
+    )
+    wh = F.scan("warehouse", [a("w_warehouse_sk"), a("w_warehouse_name")])
+    sm = F.scan("ship_mode", [a("sm_ship_mode_sk"), a("sm_type")])
+    dim = F.scan(dim_tab, [a(dim_sk), a(dim_name)])
+    sl = F.scan(fact, [a(sold_c), a(ship_c), a(wh_c), a(sm_c), a(dim_fk)])
+    j = join(st, dt, sl, [a("d_date_sk")], [a(sold_c)])
+    j = join(st, d2, j, [ar("d2_sk", 603, "long")], [a(ship_c)])
+    j = join(st, wh, j, [a("w_warehouse_sk")], [a(wh_c)])
+    j = join(st, sm, j, [a("sm_ship_mode_sk")], [a(sm_c)])
+    j = join(st, dim, j, [a(dim_sk)], [a(dim_fk)])
+    lag = F.binop("Subtract",
+                  F.cast(ar("ship_date", 604, "date"), "long"),
+                  F.cast(a("d_date"), "long"))
+    base = F.project(
+        [a("w_warehouse_name"), a("sm_type"), a(dim_name),
+         F.alias(lag, "lag", 610)],
+        j,
+    )
+    lag_a = ar("lag", 610, "long")
+    one, zero = F.lit(1, "long"), F.lit(0, "long")
+
+    def le(n):
+        return F.binop("LessThanOrEqual", lag_a, F.lit(n, "long"))
+
+    def gt(n):
+        return F.binop("GreaterThan", lag_a, F.lit(n, "long"))
+
+    buckets = [
+        F.T(F.X + "CaseWhen", [le(30), one, zero]),
+        F.T(F.X + "CaseWhen", [and_(gt(30), le(60)), one, zero]),
+        F.T(F.X + "CaseWhen", [and_(gt(60), le(90)), one, zero]),
+        F.T(F.X + "CaseWhen", [and_(gt(90), le(120)), one, zero]),
+        F.T(F.X + "CaseWhen", [gt(120), one, zero]),
+    ]
+    proj = F.project(
+        [a("w_warehouse_name"), a("sm_type"), a(dim_name)]
+        + [F.alias(b, nm, 620 + k) for k, (nm, b) in
+           enumerate(zip(_LAG, buckets))],
+        base,
+    )
+    agg = two_stage(
+        [a("w_warehouse_name"), a("sm_type"), a(dim_name)],
+        [(F.sum_(ar(nm, 620 + k, "long")), 501 + k)
+         for k, nm in enumerate(_LAG)],
+        proj,
+    )
+    return F.take_ordered(
+        100,
+        [F.sort_order(a("w_warehouse_name")), F.sort_order(a("sm_type")),
+         F.sort_order(a(dim_name))],
+        [a("w_warehouse_name"), a("sm_type"), a(dim_name)]
+        + [F.alias(ar(nm, 501 + k, "long"), nm, 640 + k)
+           for k, nm in enumerate(_LAG)],
+        agg,
+    )
+
+
+def test_spark_q62(sess, data, strategy):
+    got = _execute_both(sess, _ship_lag_plan(
+        strategy, "web_sales", "ws_sold_date_sk", "ws_ship_date_sk",
+        "ws_warehouse_sk", "ws_ship_mode_sk", "web_site", "web_site_sk",
+        "web_name", "ws_web_site_sk"))
+    _check_ship_lag(got, O.oracle_q62(data), "web_name")
+
+
+def test_spark_q99(sess, data, strategy):
+    got = _execute_both(sess, _ship_lag_plan(
+        strategy, "catalog_sales", "cs_sold_date_sk", "cs_ship_date_sk",
+        "cs_warehouse_sk", "cs_ship_mode_sk", "call_center",
+        "cc_call_center_sk", "cc_name", "cs_call_center_sk"))
+    _check_ship_lag(got, O.oracle_q99(data), "cc_name")
