@@ -98,6 +98,14 @@ class _StrategyContext(ConversionContext):
                 break
         t = dtype or plan.schema.fields[0].dtype
         out = Lit(value, t)
+        if t.is_decimal and value is not None:
+            # batch_to_pydict returns decimals UNSCALED; Lit is logical
+            # (same contract as tpch.queries.scalar_subquery_row) — a
+            # raw int here would inflate the literal by 10^scale
+            from ..serde.from_proto import _RawUnscaled
+
+            out = Lit(0, t)
+            out.value = _RawUnscaled(value)
         self._subquery_memo[id(sub_plan)] = (sub_plan, out)
         return out
 

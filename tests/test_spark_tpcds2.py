@@ -549,3 +549,1501 @@ def test_spark_q99(sess, data, strategy):
         "cs_warehouse_sk", "cs_ship_mode_sk", "call_center",
         "cc_call_center_sk", "cc_name", "cs_call_center_sk"))
     _check_ship_lag(got, O.oracle_q99(data), "cc_name")
+
+
+# ------------------------------------- big-side joins (SHJ under bhj variant)
+
+def big_join(strategy, left, right, lk, rk, jt="Inner", build_side="right",
+             condition=None):
+    """Fact-fact join: ShuffledHashJoin in the broadcast variant (the
+    reference plans large-large equi-joins off the broadcast path too),
+    SortMergeJoin in the forced-SMJ variant."""
+    if strategy == "bhj":
+        return F.shj(
+            lk, rk, jt, build_side,
+            F.shuffle(F.hash_partitioning(lk, N_PARTS), left),
+            F.shuffle(F.hash_partitioning(rk, N_PARTS), right),
+            condition=condition)
+    return F.smj(lk, rk, jt, _ss(lk, left), _ss(rk, right),
+                 condition=condition)
+
+
+# ----------------------------------------------- q25/q29 provenance chain
+
+def _srcandc_plan(st, sums, sum_names, sum_dtype, cast_long):
+    d1 = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    d2sk, d2y = ar("d_date_sk", 601, "long"), ar("d_year", 602, "integer")
+    d2 = F.project(
+        [F.alias(d2sk, "d2_sk", 603)],
+        F.filter_(and_(F.binop("GreaterThanOrEqual", d2y, i32(2000)),
+                       F.binop("LessThanOrEqual", d2y, i32(2002))),
+                  F.scan("date_dim", [d2sk, d2y])),
+    )
+    d3sk, d3y = ar("d_date_sk", 605, "long"), ar("d_year", 606, "integer")
+    d3 = F.project(
+        [F.alias(d3sk, "d3_sk", 607)],
+        F.filter_(and_(F.binop("GreaterThanOrEqual", d3y, i32(2000)),
+                       F.binop("LessThanOrEqual", d3y, i32(2002))),
+                  F.scan("date_dim", [d3sk, d3y])),
+    )
+    sl = F.scan("store_sales",
+                [a("ss_sold_date_sk"), a("ss_item_sk"), a("ss_ticket_number"),
+                 a("ss_customer_sk"), a("ss_store_sk"), a("ss_net_profit"),
+                 a("ss_quantity")])
+    j = join(st, d1, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    sr = F.scan("store_returns",
+                [a("sr_item_sk"), a("sr_ticket_number"), a("sr_customer_sk"),
+                 a("sr_returned_date_sk"), a("sr_net_loss"),
+                 a("sr_return_quantity")])
+    j = big_join(st, j, sr, [a("ss_item_sk"), a("ss_ticket_number")],
+                 [a("sr_item_sk"), a("sr_ticket_number")])
+    j = join(st, d2, j, [ar("d2_sk", 603, "long")], [a("sr_returned_date_sk")])
+    cs = F.scan("catalog_sales",
+                [a("cs_sold_date_sk"), a("cs_bill_customer_sk"),
+                 a("cs_item_sk"), a("cs_net_profit"), a("cs_quantity")])
+    j = big_join(st, j, cs, [a("sr_customer_sk"), a("sr_item_sk")],
+                 [a("cs_bill_customer_sk"), a("cs_item_sk")],
+                 build_side="left")
+    j = join(st, d3, j, [ar("d3_sk", 607, "long")], [a("cs_sold_date_sk")])
+    st_ = F.scan("store", [a("s_store_sk"), a("s_store_name")])
+    j = join(st, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    it = F.scan("item", [a("i_item_sk"), a("i_item_id"), a("i_item_desc")])
+    j = join(st, it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    sum_in = [F.cast(a(c), "long") if cast_long else a(c) for c in sums]
+    agg = two_stage(
+        [a("i_item_id"), a("i_item_desc"), a("s_store_name")],
+        [(F.sum_(e), 501 + k) for k, e in enumerate(sum_in)],
+        j,
+    )
+    return F.take_ordered(
+        100,
+        [F.sort_order(a("i_item_id")), F.sort_order(a("i_item_desc")),
+         F.sort_order(a("s_store_name"))],
+        [a("i_item_id"), a("i_item_desc"), a("s_store_name")]
+        + [F.alias(ar(nm, 501 + k, sum_dtype), nm, 510 + k)
+           for k, nm in enumerate(sum_names)],
+        agg,
+    )
+
+
+def test_spark_q25(sess, data, strategy):
+    got = _execute_both(sess, _srcandc_plan(
+        strategy, ("ss_net_profit", "sr_net_loss", "cs_net_profit"),
+        ("store_sales_profit", "store_returns_loss", "catalog_sales_profit"),
+        "decimal(17,2)", cast_long=False))
+    from test_tpcds import _check_srcandc
+    _check_srcandc(got, O.oracle_q25(data),
+                   ["store_sales_profit", "store_returns_loss",
+                    "catalog_sales_profit"])
+
+
+def test_spark_q29(sess, data, strategy):
+    got = _execute_both(sess, _srcandc_plan(
+        strategy, ("ss_quantity", "sr_return_quantity", "cs_quantity"),
+        ("store_sales_quantity", "store_returns_quantity",
+         "catalog_sales_quantity"),
+        "long", cast_long=True))
+    from test_tpcds import _check_srcandc
+    _check_srcandc(got, O.oracle_q29(data),
+                   ["store_sales_quantity", "store_returns_quantity",
+                    "catalog_sales_quantity"])
+
+
+# ----------------------------------------------- q46/q68 city ticket reports
+
+def _city_ticket_plan(st, hd_pred, amt_c, extra_c, extra_out):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(in_(a("d_dow"), 6, 0, dtype="integer"),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_dow")])),
+    )
+    st_ = F.project(
+        [a("s_store_sk")],
+        F.filter_(in_(a("s_city"), "Midway", "Fairview"),
+                  F.scan("store", [a("s_store_sk"), a("s_city")])),
+    )
+    hd = F.project(
+        [a("hd_demo_sk")],
+        F.filter_(hd_pred,
+                  F.scan("household_demographics",
+                         [a("hd_demo_sk"), a("hd_dep_count"),
+                          a("hd_vehicle_count")])),
+    )
+    ca = F.scan("customer_address", [a("ca_address_sk"), a("ca_city")])
+    sl = F.scan("store_sales",
+                [a("ss_sold_date_sk"), a("ss_store_sk"), a("ss_hdemo_sk"),
+                 a("ss_addr_sk"), a("ss_ticket_number"), a("ss_customer_sk"),
+                 a(amt_c), a(extra_c)])
+    j = join(st, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(st, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    j = join(st, hd, j, [a("hd_demo_sk")], [a("ss_hdemo_sk")])
+    j = join(st, ca, j, [a("ca_address_sk")], [a("ss_addr_sk")])
+    bought = ar("bought_city", 615, "string")
+    proj = F.project(
+        [a("ss_ticket_number"), a("ss_customer_sk"),
+         F.alias(a("ca_city"), "bought_city", 615), a(amt_c), a(extra_c)],
+        j,
+    )
+    agg = two_stage(
+        [a("ss_ticket_number"), a("ss_customer_sk"), bought],
+        [(F.sum_(a(amt_c)), 501), (F.sum_(a(extra_c)), 502)],
+        proj,
+    )
+    cu = F.scan("customer", [a("c_customer_sk"), a("c_last_name"),
+                             a("c_first_name"), a("c_current_addr_sk")])
+    j2 = join(st, cu, agg, [a("c_customer_sk")], [a("ss_customer_sk")])
+    ca2sk, ca2city = ar("ca_address_sk", 611, "long"), ar("ca_city", 612, "string")
+    ca2 = F.project(
+        [F.alias(ca2sk, "cur_addr_sk", 613),
+         F.alias(ca2city, "current_city", 614)],
+        F.scan("customer_address", [ca2sk, ca2city]),
+    )
+    cur_city = ar("current_city", 614, "string")
+    j2 = join(st, ca2, j2, [ar("cur_addr_sk", 613, "long")],
+              [a("c_current_addr_sk")])
+    f = F.filter_(ne(cur_city, bought), j2)
+    amt = ar("amt", 501, "decimal(17,2)")
+    extra = ar("extra", 502, "decimal(17,2)")
+    return F.take_ordered(
+        100,
+        [F.sort_order(a("c_last_name")), F.sort_order(a("c_first_name")),
+         F.sort_order(cur_city), F.sort_order(bought),
+         F.sort_order(a("ss_ticket_number"))],
+        [a("c_last_name"), a("c_first_name"), cur_city, bought,
+         a("ss_ticket_number"), F.alias(amt, "amt", 520),
+         F.alias(extra, extra_out, 521)],
+        f,
+    )
+
+
+def test_spark_q46(sess, data, strategy):
+    from test_tpcds import _check_city_tickets
+    hd_pred = or_(F.binop("EqualTo", a("hd_dep_count"), i32(4)),
+                  F.binop("EqualTo", a("hd_vehicle_count"), i32(3)))
+    got = _execute_both(sess, _city_ticket_plan(
+        strategy, hd_pred, "ss_coupon_amt", "ss_net_profit",
+        "sum_ss_net_profit"))
+    _check_city_tickets(got, O.oracle_q46(data), ["amt", "sum_ss_net_profit"])
+
+
+def test_spark_q68(sess, data, strategy):
+    from test_tpcds import _check_city_tickets
+    hd_pred = or_(F.binop("EqualTo", a("hd_dep_count"), i32(5)),
+                  F.binop("EqualTo", a("hd_vehicle_count"), i32(3)))
+    got = _execute_both(sess, _city_ticket_plan(
+        strategy, hd_pred, "ss_ext_sales_price", "ss_ext_list_price",
+        "sum_ss_ext_list_price"))
+    _check_city_tickets(got, O.oracle_q68(data),
+                        ["amt", "sum_ss_ext_list_price"])
+
+
+# --------------------------------------------------- q79 Monday big-household
+
+def test_spark_q79(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_dow"), i32(1)),
+                       F.binop("GreaterThanOrEqual", a("d_year"), i32(1998)),
+                       F.binop("LessThanOrEqual", a("d_year"), i32(2000))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_dow"), a("d_year")])),
+    )
+    hd = F.project(
+        [a("hd_demo_sk")],
+        F.filter_(or_(F.binop("EqualTo", a("hd_dep_count"), i32(6)),
+                      F.binop("GreaterThan", a("hd_vehicle_count"), i32(2))),
+                  F.scan("household_demographics",
+                         [a("hd_demo_sk"), a("hd_dep_count"),
+                          a("hd_vehicle_count")])),
+    )
+    st_ = F.scan("store", [a("s_store_sk"), a("s_city")])
+    sl = F.scan("store_sales",
+                [a("ss_sold_date_sk"), a("ss_hdemo_sk"), a("ss_store_sk"),
+                 a("ss_ticket_number"), a("ss_customer_sk"),
+                 a("ss_coupon_amt"), a("ss_net_profit")])
+    j = join(strategy, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(strategy, hd, j, [a("hd_demo_sk")], [a("ss_hdemo_sk")])
+    j = join(strategy, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    agg = two_stage(
+        [a("ss_ticket_number"), a("ss_customer_sk"), a("s_city")],
+        [(F.sum_(a("ss_coupon_amt")), 501), (F.sum_(a("ss_net_profit")), 502)],
+        j,
+    )
+    cu = F.scan("customer", [a("c_customer_sk"), a("c_last_name"),
+                             a("c_first_name")])
+    j2 = join(strategy, cu, agg, [a("c_customer_sk")], [a("ss_customer_sk")])
+    amt = ar("amt", 501, "decimal(17,2)")
+    profit = ar("profit", 502, "decimal(17,2)")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("c_last_name")), F.sort_order(a("c_first_name")),
+         F.sort_order(a("s_city")), F.sort_order(profit),
+         F.sort_order(a("ss_ticket_number"))],
+        [a("c_last_name"), a("c_first_name"), a("s_city"),
+         a("ss_ticket_number"), F.alias(amt, "amt", 520),
+         F.alias(profit, "profit", 521)],
+        j2,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q79(data)
+    assert exp, "q79 oracle empty"
+    n = len(got["ss_ticket_number"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["c_last_name"][i], got["c_first_name"][i],
+               got["s_city"][i], got["ss_ticket_number"][i])
+        assert key in exp, key
+        assert (got["amt"][i], got["profit"][i]) == exp[key], key
+
+
+# ------------------------------------------------------ q91 call-center loss
+
+def test_spark_q91(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    cr = F.scan("catalog_returns",
+                [a("cr_returned_date_sk"), a("cr_returning_customer_sk"),
+                 a("cr_call_center_sk"), a("cr_net_loss")])
+    j = join(strategy, dt, cr, [a("d_date_sk")], [a("cr_returned_date_sk")])
+    cc = F.scan("call_center", [a("cc_call_center_sk"), a("cc_name")])
+    j = join(strategy, cc, j, [a("cc_call_center_sk")],
+             [a("cr_call_center_sk")])
+    cu = F.scan("customer", [a("c_customer_sk"), a("c_current_cdemo_sk")])
+    j = join(strategy, cu, j, [a("c_customer_sk")],
+             [a("cr_returning_customer_sk")])
+    cd = F.project(
+        [a("cd_demo_sk"), a("cd_marital_status"), a("cd_education_status")],
+        F.filter_(
+            or_(and_(F.binop("EqualTo", a("cd_marital_status"), s("M")),
+                     F.binop("EqualTo", a("cd_education_status"), s("Unknown"))),
+                and_(F.binop("EqualTo", a("cd_marital_status"), s("W")),
+                     F.binop("EqualTo", a("cd_education_status"),
+                             s("Advanced Degree")))),
+            F.scan("customer_demographics",
+                   [a("cd_demo_sk"), a("cd_marital_status"),
+                    a("cd_education_status")]),
+        ),
+    )
+    j = join(strategy, cd, j, [a("cd_demo_sk")], [a("c_current_cdemo_sk")])
+    agg = two_stage(
+        [a("cc_name"), a("cd_marital_status"), a("cd_education_status")],
+        [(F.sum_(a("cr_net_loss")), 501)],
+        j,
+    )
+    loss = ar("returns_loss", 501, "decimal(17,2)")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(loss, asc=False), F.sort_order(a("cc_name"))],
+        [a("cc_name"), a("cd_marital_status"), a("cd_education_status"),
+         F.alias(loss, "returns_loss", 510)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q91(data)
+    assert exp, "q91 oracle empty"
+    n = len(got["cc_name"])
+    assert n == min(len(exp), 100)
+    rows = {
+        (got["cc_name"][i], got["cd_marital_status"][i],
+         got["cd_education_status"][i]): got["returns_loss"][i]
+        for i in range(n)
+    }
+    if len(exp) <= 100:
+        assert rows == exp
+    else:
+        assert all(exp.get(k) == v for k, v in rows.items())
+    assert got["returns_loss"] == sorted(got["returns_loss"], reverse=True)
+
+
+# ---------------------------------------------- q93 LEFT join + CASE netting
+
+def test_spark_q93(sess, data, strategy):
+    sl = F.scan("store_sales",
+                [a("ss_item_sk"), a("ss_ticket_number"), a("ss_customer_sk"),
+                 a("ss_quantity"), a("ss_sales_price")])
+    sr = F.scan("store_returns",
+                [a("sr_item_sk"), a("sr_ticket_number"), a("sr_reason_sk"),
+                 a("sr_return_quantity")])
+    j = big_join(strategy, sl, sr,
+                 [a("ss_item_sk"), a("ss_ticket_number")],
+                 [a("sr_item_sk"), a("sr_ticket_number")], jt="LeftOuter")
+    reason = F.project(
+        [a("r_reason_sk")],
+        F.filter_(F.binop("EqualTo", a("r_reason_desc"), s("Stopped working")),
+                  F.scan("reason", [a("r_reason_sk"), a("r_reason_desc")])),
+    )
+    j = join(strategy, reason, j, [a("r_reason_sk")], [a("sr_reason_sk")])
+    act = F.T(
+        F.X + "CaseWhen",
+        [F.un("IsNotNull", a("sr_return_quantity")),
+         F.binop("Multiply",
+                 F.cast(F.binop("Subtract", a("ss_quantity"),
+                                a("sr_return_quantity")), "long"),
+                 a("ss_sales_price")),
+         F.binop("Multiply", F.cast(a("ss_quantity"), "long"),
+                 a("ss_sales_price"))],
+    )
+    proj = F.project(
+        [a("ss_customer_sk"), F.alias(act, "act_sales", 520)],
+        j,
+    )
+    agg = two_stage(
+        [a("ss_customer_sk")],
+        [(F.sum_(ar("act_sales", 520, "decimal(17,2)")), 501)],
+        proj,
+    )
+    sumsales = ar("sumsales", 501, "decimal(27,2)")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(sumsales), F.sort_order(a("ss_customer_sk"))],
+        [a("ss_customer_sk"), F.alias(sumsales, "sumsales", 510)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q93(data)
+    assert exp, "q93 oracle empty"
+    rows = dict(zip(got["ss_customer_sk"], got["sumsales"]))
+    assert len(rows) == len(got["ss_customer_sk"])
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+    assert got["sumsales"] == sorted(got["sumsales"])
+
+
+# ------------------------------------------------- q97 FULL-outer overlap
+
+def test_spark_q97(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+
+    def pairs(fact, date_c, cust_c, item_c, pc, pi, cid, iid):
+        sl = F.scan(fact, [a(date_c), a(cust_c), a(item_c)])
+        j = join(strategy, dt, sl, [a("d_date_sk")], [a(date_c)])
+        proj = F.project(
+            [F.alias(a(cust_c), pc, cid), F.alias(a(item_c), pi, iid)], j)
+        return two_stage([ar(pc, cid, "long"), ar(pi, iid, "long")], [], proj)
+
+    ss = pairs("store_sales", "ss_sold_date_sk", "ss_customer_sk",
+               "ss_item_sk", "sc", "si", 620, 621)
+    cs = pairs("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk",
+               "cs_item_sk", "cc", "ci", 622, 623)
+    sc, si = ar("sc", 620, "long"), ar("si", 621, "long")
+    cc, ci = ar("cc", 622, "long"), ar("ci", 623, "long")
+    j = big_join(strategy, ss, cs, [sc, si], [cc, ci], jt="FullOuter")
+    one, zero = F.lit(1, "long"), F.lit(0, "long")
+    flags = F.project(
+        [F.alias(F.T(F.X + "CaseWhen",
+                     [and_(F.un("IsNotNull", sc), F.un("IsNull", cc)), one,
+                      zero]), "store_only", 630),
+         F.alias(F.T(F.X + "CaseWhen",
+                     [and_(F.un("IsNull", sc), F.un("IsNotNull", cc)), one,
+                      zero]), "catalog_only", 631),
+         F.alias(F.T(F.X + "CaseWhen",
+                     [and_(F.un("IsNotNull", sc), F.un("IsNotNull", cc)), one,
+                      zero]), "store_and_catalog", 632)],
+        j,
+    )
+    plan = two_stage(
+        [],
+        [(F.sum_(ar("store_only", 630, "long")), 501),
+         (F.sum_(ar("catalog_only", 631, "long")), 502),
+         (F.sum_(ar("store_and_catalog", 632, "long")), 503)],
+        flags,
+        result=[F.alias(ar("store_only", 501, "long"), "store_only", 510),
+                F.alias(ar("catalog_only", 502, "long"), "catalog_only", 511),
+                F.alias(ar("store_and_catalog", 503, "long"),
+                        "store_and_catalog", 512)],
+    )
+    got = _execute_both(sess, plan)
+    so, co, both = O.oracle_q97(data)
+    assert (got["store_only"], got["catalog_only"],
+            got["store_and_catalog"]) == ([so], [co], [both])
+
+
+# ------------------------------------------------- q65 aggregation over agg
+
+def test_spark_q65(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    sl = F.scan("store_sales",
+                [a("ss_sold_date_sk"), a("ss_store_sk"), a("ss_item_sk"),
+                 a("ss_sales_price")])
+    j = join(strategy, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    per_item = two_stage(
+        [a("ss_store_sk"), a("ss_item_sk")],
+        [(F.sum_(a("ss_sales_price")), 501)],
+        j,
+    )
+    revenue = ar("revenue", 501, "decimal(17,2)")
+    sb = F.project(
+        [F.alias(a("ss_store_sk"), "sb_store_sk", 520), revenue], per_item)
+    per_store = two_stage(
+        [ar("sb_store_sk", 520, "long")],
+        [(F.avg(revenue), 502)],
+        sb,
+    )
+    ave = ar("ave", 502, "decimal(21,6)")
+    jj = join(strategy, per_store, per_item,
+              [ar("sb_store_sk", 520, "long")], [a("ss_store_sk")])
+    low = F.filter_(
+        F.binop("LessThanOrEqual", F.cast(revenue, "double"),
+                F.binop("Multiply", F.cast(ave, "double"),
+                        F.lit(0.1, "double"))),
+        jj,
+    )
+    st_ = F.scan("store", [a("s_store_sk"), a("s_store_name")])
+    it = F.scan("item", [a("i_item_sk"), a("i_item_desc"),
+                         a("i_current_price"), a("i_brand")])
+    out = join(strategy, st_, low, [a("s_store_sk")], [a("ss_store_sk")])
+    out = join(strategy, it, out, [a("i_item_sk")], [a("ss_item_sk")])
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("s_store_name")), F.sort_order(a("i_item_desc"))],
+        [a("s_store_name"), a("i_item_desc"),
+         F.alias(revenue, "revenue", 530), a("i_current_price"), a("i_brand")],
+        out,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q65(data)
+    rows = list(zip(got["s_store_name"], got["i_item_desc"], got["revenue"],
+                    got["i_current_price"], got["i_brand"]))
+    assert rows, "q65 returned no rows"
+    import collections
+    if len(exp) <= 100:
+        assert collections.Counter(rows) == collections.Counter(exp.values())
+    else:
+        assert not (collections.Counter(rows) - collections.Counter(exp.values()))
+    keys = [(r[0], r[1]) for r in rows]
+    assert keys == sorted(keys)
+
+
+# ------------------------------------------------------ q50 return-lag pivot
+
+def test_spark_q50(sess, data, strategy):
+    sl = F.scan("store_sales",
+                [a("ss_item_sk"), a("ss_ticket_number"), a("ss_customer_sk"),
+                 a("ss_store_sk"), a("ss_sold_date_sk")])
+    sr = F.scan("store_returns",
+                [a("sr_item_sk"), a("sr_ticket_number"), a("sr_customer_sk"),
+                 a("sr_returned_date_sk")])
+    j = big_join(strategy, sl, sr,
+                 [a("ss_item_sk"), a("ss_ticket_number"), a("ss_customer_sk")],
+                 [a("sr_item_sk"), a("sr_ticket_number"), a("sr_customer_sk")])
+    d1 = F.scan("date_dim", [a("d_date_sk"), a("d_date")])
+    d2sk = ar("d_date_sk", 601, "long")
+    d2date = ar("d_date", 602, "date")
+    d2y, d2m = ar("d_year", 603, "integer"), ar("d_moy", 604, "integer")
+    d2 = F.project(
+        [F.alias(d2sk, "d2_sk", 605), F.alias(d2date, "ret_date", 606)],
+        F.filter_(and_(F.binop("EqualTo", d2y, i32(2001)),
+                       F.binop("EqualTo", d2m, i32(8))),
+                  F.scan("date_dim", [d2sk, d2date, d2y, d2m])),
+    )
+    j = join(strategy, d1, j, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(strategy, d2, j, [ar("d2_sk", 605, "long")],
+             [a("sr_returned_date_sk")])
+    st_ = F.scan("store", [a("s_store_sk"), a("s_store_name"), a("s_county"),
+                           a("s_state"), a("s_zip")])
+    j = join(strategy, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    lag = F.binop("Subtract", F.cast(ar("ret_date", 606, "date"), "long"),
+                  F.cast(a("d_date"), "long"))
+    base = F.project(
+        [a("s_store_name"), a("s_county"), a("s_state"), a("s_zip"),
+         F.alias(lag, "lag", 610)],
+        j,
+    )
+    lag_a = ar("lag", 610, "long")
+    one, zero = F.lit(1, "long"), F.lit(0, "long")
+
+    def le(n):
+        return F.binop("LessThanOrEqual", lag_a, F.lit(n, "long"))
+
+    def gt(n):
+        return F.binop("GreaterThan", lag_a, F.lit(n, "long"))
+
+    buckets = [
+        F.T(F.X + "CaseWhen", [le(30), one, zero]),
+        F.T(F.X + "CaseWhen", [and_(gt(30), le(60)), one, zero]),
+        F.T(F.X + "CaseWhen", [and_(gt(60), le(90)), one, zero]),
+        F.T(F.X + "CaseWhen", [and_(gt(90), le(120)), one, zero]),
+        F.T(F.X + "CaseWhen", [gt(120), one, zero]),
+    ]
+    proj = F.project(
+        [a("s_store_name"), a("s_county"), a("s_state"), a("s_zip")]
+        + [F.alias(b, nm, 620 + k)
+           for k, (nm, b) in enumerate(zip(_LAG, buckets))],
+        base,
+    )
+    agg = two_stage(
+        [a("s_store_name"), a("s_county"), a("s_state"), a("s_zip")],
+        [(F.sum_(ar(nm, 620 + k, "long")), 501 + k)
+         for k, nm in enumerate(_LAG)],
+        proj,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("s_store_name")), F.sort_order(a("s_county")),
+         F.sort_order(a("s_state")), F.sort_order(a("s_zip"))],
+        [a("s_store_name"), a("s_county"), a("s_state"), a("s_zip")]
+        + [F.alias(ar(nm, 501 + k, "long"), nm, 640 + k)
+           for k, nm in enumerate(_LAG)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q50(data)
+    assert exp, "q50 oracle empty"
+    n = len(got["s_store_name"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["s_store_name"][i], got["s_county"][i], got["s_state"][i],
+               got["s_zip"][i])
+        assert key in exp, key
+        assert tuple(got[b][i] for b in _LAG) == exp[key], key
+
+
+# ------------------------------------------------- q23a/b best-customer CTEs
+
+def _scalar_subquery(subplan, eid):
+    return F.T(F.X + "ScalarSubquery", plan=F.flatten(subplan), exprId=F.eid(eid))
+
+
+def _q23_frequent_items_plan(st):
+    """Items sold >4 times in one (year*12+moy) cell, 1998-2002
+    (mirrors queries._q23_frequent_items: no year slice)."""
+    dt = F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")])
+    sl = F.scan("store_sales", [a("ss_sold_date_sk"), a("ss_item_sk")])
+    j = join(st, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    it = F.scan("item", [a("i_item_sk"), a("i_item_desc")])
+    j = join(st, it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    itemdesc = F.T(F.X + "Substring", [a("i_item_desc"), i32(1), i32(30)])
+    cell = F.binop("Add", F.binop("Multiply", a("d_year"), i32(12)), a("d_moy"))
+    proj = F.project(
+        [a("i_item_sk"), F.alias(itemdesc, "itemdesc", 701),
+         F.alias(cell, "cell", 702)],
+        j,
+    )
+    cells = two_stage(
+        [a("i_item_sk"), ar("itemdesc", 701, "string"),
+         ar("cell", 702, "integer")],
+        [(F.count(), 703)],
+        proj,
+    )
+    hot = F.filter_(
+        F.binop("GreaterThan", ar("cnt", 703, "long"), F.lit(4, "long")),
+        cells,
+    )
+    return two_stage([a("i_item_sk")], [], F.project([a("i_item_sk")], hot))
+
+
+def _q23_best_customers_plan(st):
+    spend = F.binop("Multiply", F.cast(a("ss_quantity"), "long"),
+                    a("ss_sales_price"))
+    sl = F.project(
+        [a("ss_customer_sk"), F.alias(spend, "spend", 710)],
+        F.scan("store_sales", [a("ss_customer_sk"), a("ss_quantity"),
+                               a("ss_sales_price")]),
+    )
+    per_cust = two_stage(
+        [a("ss_customer_sk")],
+        [(F.sum_(ar("spend", 710, "decimal(17,2)")), 711)],
+        sl,
+    )
+    csales = ar("csales", 711, "decimal(27,2)")
+    cmax = two_stage([], [(F.max_(csales), 712)], per_cust,
+                     result=[F.alias(ar("mx", 712, "decimal(27,2)"),
+                                     "tpcds_cmax", 713)])
+    best = F.filter_(
+        F.binop("GreaterThan", F.cast(csales, "double"),
+                F.binop("Multiply", F.lit(0.5, "double"),
+                        F.cast(_scalar_subquery(cmax, 714), "double"))),
+        per_cust,
+    )
+    return F.project([a("ss_customer_sk")], best)
+
+
+def _q23_month_sales_plan(st, fact, date_c, item_c, cust_c, qty_c, price_c,
+                          hot, best, names):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                       F.binop("EqualTo", a("d_moy"), i32(5))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")])),
+    )
+    fc = F.scan(fact, [a(date_c), a(item_c), a(cust_c), a(qty_c), a(price_c)])
+    j = join(st, dt, fc, [a("d_date_sk")], [a(date_c)])
+    j = join(st, hot, j, [a("i_item_sk")], [a(item_c)], jt="LeftSemi",
+             build_side="right")
+    j = join(st, best, j, [a("ss_customer_sk")], [a(cust_c)], jt="LeftSemi",
+             build_side="right")
+    sales = F.binop("Multiply", F.cast(a(qty_c), "long"), a(price_c))
+    if names:
+        cu = F.scan("customer", [a("c_customer_sk"), a("c_last_name"),
+                                 a("c_first_name")])
+        j = join(st, cu, j, [a("c_customer_sk")], [a(cust_c)])
+        return F.project(
+            [a("c_last_name"), a("c_first_name"),
+             F.alias(sales, "sales", 720)], j)
+    return F.project([F.alias(sales, "sales", 720)], j)
+
+
+def _q23_rows_plan(st, names):
+    hot = _q23_frequent_items_plan(st)
+    best = _q23_best_customers_plan(st)
+    return F.union([
+        _q23_month_sales_plan(st, "catalog_sales", "cs_sold_date_sk",
+                              "cs_item_sk", "cs_bill_customer_sk",
+                              "cs_quantity", "cs_list_price", hot, best, names),
+        _q23_month_sales_plan(st, "web_sales", "ws_sold_date_sk",
+                              "ws_item_sk", "ws_bill_customer_sk",
+                              "ws_quantity", "ws_list_price", hot, best, names),
+    ])
+
+
+def test_spark_q23a(sess, data, strategy):
+    rows = _q23_rows_plan(strategy, names=False)
+    plan = two_stage(
+        [], [(F.sum_(ar("sales", 720, "decimal(17,2)")), 501)], rows,
+        result=[F.alias(ar("sum_sales", 501, "decimal(27,2)"),
+                        "sum_sales", 510)],
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q23a(data)
+    assert exp is not None, "q23a oracle empty"
+    assert got["sum_sales"] == [exp]
+
+
+def test_spark_q23b(sess, data, strategy):
+    rows = _q23_rows_plan(strategy, names=True)
+    agg = two_stage(
+        [a("c_last_name"), a("c_first_name")],
+        [(F.sum_(ar("sales", 720, "decimal(17,2)")), 501)],
+        rows,
+    )
+    sales = ar("sales", 501, "decimal(27,2)")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(sales, asc=False), F.sort_order(a("c_last_name")),
+         F.sort_order(a("c_first_name"))],
+        [a("c_last_name"), a("c_first_name"), F.alias(sales, "sales", 510)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q23b(data)
+    assert exp, "q23b oracle empty"
+    rows_g = {
+        (l, f): v for l, f, v in
+        zip(got["c_last_name"], got["c_first_name"], got["sales"])
+    }
+    if len(exp) <= 100:
+        assert rows_g == exp
+    else:
+        assert all(exp.get(k) == v for k, v in rows_g.items())
+    assert got["sales"] == sorted(got["sales"], reverse=True)
+
+
+# ------------------------------------------------- q24a/b returned netpaid
+
+def _q24_ssales_plan(st):
+    sl = F.scan("store_sales",
+                [a("ss_item_sk"), a("ss_ticket_number"), a("ss_store_sk"),
+                 a("ss_customer_sk"), a("ss_net_paid")])
+    sr = F.scan("store_returns", [a("sr_item_sk"), a("sr_ticket_number")])
+    j = big_join(st, sl, sr, [a("ss_item_sk"), a("ss_ticket_number")],
+                 [a("sr_item_sk"), a("sr_ticket_number")])
+    st_ = F.project(
+        [a("s_store_sk"), a("s_store_name"), a("s_county")],
+        F.filter_(F.binop("EqualTo", a("s_market_id"), i32(8)),
+                  F.scan("store", [a("s_store_sk"), a("s_store_name"),
+                                   a("s_county"), a("s_market_id")])),
+    )
+    j = join(st, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    cu = F.scan("customer", [a("c_customer_sk"), a("c_last_name"),
+                             a("c_first_name"), a("c_current_addr_sk")])
+    j = join(st, cu, j, [a("c_customer_sk")], [a("ss_customer_sk")])
+    ca = F.scan("customer_address", [a("ca_address_sk"), a("ca_county")])
+    j = join(st, ca, j, [a("ca_address_sk")], [a("c_current_addr_sk")])
+    j = F.filter_(F.binop("EqualTo", a("ca_county"), a("s_county")), j)
+    it = F.scan("item", [a("i_item_sk"), a("i_color")])
+    j = join(st, it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    return two_stage(
+        [a("c_last_name"), a("c_first_name"), a("s_store_name"), a("i_color")],
+        [(F.sum_(a("ss_net_paid")), 730)],
+        j,
+    )
+
+
+def _q24_plan(st, color):
+    netpaid = ar("netpaid", 730, "decimal(17,2)")
+    avg_all = two_stage(
+        [], [(F.avg(netpaid), 731)], _q24_ssales_plan(st),
+        result=[F.alias(ar("avg_netpaid", 731, "decimal(21,6)"),
+                        "avg_netpaid", 732)],
+    )
+    cells = F.filter_(F.binop("EqualTo", a("i_color"), s(color)),
+                      _q24_ssales_plan(st))
+    agg = two_stage(
+        [a("c_last_name"), a("c_first_name"), a("s_store_name")],
+        [(F.sum_(netpaid), 733)],
+        cells,
+    )
+    paid = ar("paid", 733, "decimal(27,2)")
+    f = F.filter_(
+        F.binop("GreaterThan", F.cast(paid, "double"),
+                F.binop("Multiply", F.lit(0.05, "double"),
+                        F.cast(_scalar_subquery(avg_all, 734), "double"))),
+        agg,
+    )
+    single = F.shuffle(F.single_partition(),
+                       F.project([a("c_last_name"), a("c_first_name"),
+                                  a("s_store_name"),
+                                  F.alias(paid, "paid", 735)], f))
+    return F.sort(
+        [F.sort_order(a("c_last_name")), F.sort_order(a("c_first_name")),
+         F.sort_order(a("s_store_name"))],
+        single,
+    )
+
+
+def _check_q24_rows(got, exp):
+    assert exp, "q24 oracle empty"
+    rows = {
+        (l, f, st_): v for l, f, st_, v in
+        zip(got["c_last_name"], got["c_first_name"], got["s_store_name"],
+            got["paid"])
+    }
+    assert rows == exp
+    keys = list(zip(got["c_last_name"], got["c_first_name"],
+                    got["s_store_name"]))
+    assert keys == sorted(keys)
+
+
+def test_spark_q24a(ticket_sess, ticket_data, strategy):
+    got = _execute_both(ticket_sess, _q24_plan(strategy, "peach"))
+    _check_q24_rows(got, O.oracle_q24a(ticket_data))
+
+
+def test_spark_q24b(ticket_sess, ticket_data, strategy):
+    got = _execute_both(ticket_sess, _q24_plan(strategy, "saddle"))
+    _check_q24_rows(got, O.oracle_q24b(ticket_data))
+
+
+# ------------------------------------------------------- q72 inventory giant
+
+def test_spark_q72(sess, data, strategy):
+    hd = F.project(
+        [a("hd_demo_sk")],
+        F.filter_(F.binop("EqualTo", a("hd_buy_potential"), s(">10000")),
+                  F.scan("household_demographics",
+                         [a("hd_demo_sk"), a("hd_buy_potential")])),
+    )
+    cd = F.project(
+        [a("cd_demo_sk")],
+        F.filter_(F.binop("EqualTo", a("cd_marital_status"), s("D")),
+                  F.scan("customer_demographics",
+                         [a("cd_demo_sk"), a("cd_marital_status")])),
+    )
+    d1 = F.scan("date_dim", [a("d_date_sk"), a("d_date"), a("d_week_seq")])
+    d3sk, d3date = ar("d_date_sk", 601, "long"), ar("d_date", 602, "date")
+    d3 = F.project(
+        [F.alias(d3sk, "d3_date_sk", 603), F.alias(d3date, "d3_date", 604)],
+        F.scan("date_dim", [d3sk, d3date]),
+    )
+    d2sk, d2wk = ar("d_date_sk", 605, "long"), ar("d_week_seq", 606, "integer")
+    d2 = F.project(
+        [F.alias(d2sk, "d2_date_sk", 607), F.alias(d2wk, "d2_week_seq", 608)],
+        F.scan("date_dim", [d2sk, d2wk]),
+    )
+    cs = F.scan("catalog_sales",
+                [a("cs_sold_date_sk"), a("cs_ship_date_sk"), a("cs_item_sk"),
+                 a("cs_bill_cdemo_sk"), a("cs_bill_hdemo_sk"),
+                 a("cs_quantity")])
+    j = join(strategy, hd, cs, [a("hd_demo_sk")], [a("cs_bill_hdemo_sk")])
+    j = join(strategy, cd, j, [a("cd_demo_sk")], [a("cs_bill_cdemo_sk")])
+    j = join(strategy, d1, j, [a("d_date_sk")], [a("cs_sold_date_sk")])
+    j = join(strategy, d3, j, [ar("d3_date_sk", 603, "long")],
+             [a("cs_ship_date_sk")])
+    j = F.filter_(
+        F.binop("GreaterThan", F.cast(ar("d3_date", 604, "date"), "long"),
+                F.binop("Add", F.cast(a("d_date"), "long"),
+                        F.lit(5, "long"))),
+        j,
+    )
+    inv = F.scan("inventory",
+                 [a("inv_date_sk"), a("inv_item_sk"), a("inv_warehouse_sk"),
+                  a("inv_quantity_on_hand")])
+    j = big_join(strategy, j, inv, [a("cs_item_sk")], [a("inv_item_sk")],
+                 build_side="left")
+    j = join(strategy, d2, j, [ar("d2_date_sk", 607, "long")],
+             [a("inv_date_sk")])
+    j = F.filter_(
+        and_(F.binop("EqualTo", ar("d2_week_seq", 608, "integer"),
+                     a("d_week_seq")),
+             F.binop("LessThan", a("inv_quantity_on_hand"),
+                     a("cs_quantity"))),
+        j,
+    )
+    it = F.scan("item", [a("i_item_sk"), a("i_item_desc")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("cs_item_sk")])
+    wh = F.scan("warehouse", [a("w_warehouse_sk"), a("w_warehouse_name")])
+    j = join(strategy, wh, j, [a("w_warehouse_sk")], [a("inv_warehouse_sk")])
+    agg = two_stage(
+        [a("i_item_desc"), a("w_warehouse_name"), a("d_week_seq")],
+        [(F.count(), 501)],
+        j,
+    )
+    no_promo = ar("no_promo", 501, "long")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(no_promo, asc=False), F.sort_order(a("i_item_desc")),
+         F.sort_order(a("w_warehouse_name")), F.sort_order(a("d_week_seq"))],
+        [a("i_item_desc"), a("w_warehouse_name"), a("d_week_seq"),
+         F.alias(no_promo, "no_promo", 510)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q72(data)
+    assert exp, "q72 oracle empty"
+    rows = {
+        (d, w, wk): c for d, w, wk, c in
+        zip(got["i_item_desc"], got["w_warehouse_name"], got["d_week_seq"],
+            got["no_promo"])
+    }
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+    assert got["no_promo"] == sorted(got["no_promo"], reverse=True)
+
+
+# ----------------------------------------------------- q67 rollup-rank giant
+
+def test_spark_q67(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk"), a("d_year"), a("d_qoy"), a("d_moy")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_qoy"),
+                                      a("d_moy")])),
+    )
+    st_ = F.scan("store", [a("s_store_sk"), a("s_store_name")])
+    it = F.scan("item", [a("i_item_sk"), a("i_category"), a("i_class"),
+                         a("i_brand"), a("i_item_id")])
+    sl = F.scan("store_sales",
+                [a("ss_sold_date_sk"), a("ss_store_sk"), a("ss_item_sk"),
+                 a("ss_quantity"), a("ss_sales_price")])
+    j = join(strategy, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(strategy, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    val = F.binop("Multiply", F.cast(a("ss_quantity"), "long"),
+                  a("ss_sales_price"))
+    base = F.project(
+        [a("i_category"), a("i_class"), a("i_brand"), a("i_item_id"),
+         a("d_year"), a("d_qoy"), a("d_moy"), a("s_store_name"),
+         F.alias(val, "val", 700)],
+        j,
+    )
+    dims = [("i_category", "string"), ("i_class", "string"),
+            ("i_brand", "string"), ("i_item_id", "string"),
+            ("d_year", "integer"), ("d_qoy", "integer"),
+            ("d_moy", "integer"), ("s_store_name", "string")]
+    val_a = ar("val", 700, "decimal(17,2)")
+    exp_attrs = [ar(nm, 701 + k, dt_) for k, (nm, dt_) in enumerate(dims)]
+    exp_gid = ar("g_id", 709, "integer")
+    projections = []
+    for level in range(8, -1, -1):
+        row = [val_a]
+        for k, (nm, dt_) in enumerate(dims):
+            row.append(a(nm) if k < level else F.lit(None, dt_))
+        row.append(F.lit(8 - level, "integer"))
+        projections.append(row)
+    expand = F.expand(projections, [val_a] + exp_attrs + [exp_gid], base)
+    agg = two_stage(
+        exp_attrs + [exp_gid],
+        [(F.sum_(val_a), 501)],
+        expand,
+    )
+    sumsales = ar("sumsales", 501, "decimal(27,2)")
+    cat = exp_attrs[0]
+    ex = F.shuffle(F.hash_partitioning([cat], N_PARTS), agg)
+    srt = F.sort([F.sort_order(cat), F.sort_order(sumsales, asc=False)],
+                 ex, global_=False)
+    w = F.window(
+        [F.window_expr(F.rank_fn([F.sort_order(sumsales, asc=False)]),
+                       F.window_spec([cat],
+                                     [F.sort_order(sumsales, asc=False)]),
+                       "rk", 520)],
+        [cat], [F.sort_order(sumsales, asc=False)], srt,
+    )
+    rk = ar("rk", 520, "integer")
+    f = F.filter_(F.binop("LessThanOrEqual", rk, i32(100)), w)
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(cat), F.sort_order(rk),
+         F.sort_order(sumsales, asc=False)],
+        [F.alias(e, nm, 530 + k)
+         for k, (e, (nm, _)) in enumerate(zip(exp_attrs, dims))]
+        + [F.alias(exp_gid, "g_id", 540), F.alias(sumsales, "sumsales", 541),
+           F.alias(rk, "rk", 542)],
+        f,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q67(data)
+    assert exp, "q67 oracle empty"
+    n = len(got["i_category"])
+    assert n == min(len(exp), 100)
+    dim_names = [d[0] for d in dims]
+    for i in range(n):
+        key = tuple(got[d][i] for d in dim_names) + (got["g_id"][i],)
+        assert key in exp, key
+        v, rk_e = exp[key]
+        assert (got["sumsales"][i], got["rk"][i]) == (v, rk_e), key
+    order = [((0, "") if got["i_category"][i] is None
+              else (1, got["i_category"][i]), got["rk"][i]) for i in range(n)]
+    assert order == sorted(order)
+
+
+# ------------------------------------------------- q75 cross-channel YoY
+
+def _q75_channel_plan(st, fact, date_c, item_c, qty_c, amt_c, rtab, r_item_c,
+                      r_key2_c, key2_c, r_qty_c, r_amt_c):
+    dt = F.scan("date_dim", [a("d_date_sk"), a("d_year")])
+    it = F.project(
+        [a("i_item_sk"), a("i_brand_id"), a("i_class_id"), a("i_category_id"),
+         a("i_manufact_id")],
+        F.filter_(F.binop("EqualTo", a("i_category"), s("Books")),
+                  F.scan("item", [a("i_item_sk"), a("i_brand_id"),
+                                  a("i_class_id"), a("i_category_id"),
+                                  a("i_manufact_id"), a("i_category")])),
+    )
+    sl = F.scan(fact, [a(date_c), a(item_c), a(key2_c), a(qty_c), a(amt_c)])
+    j = join(st, dt, sl, [a("d_date_sk")], [a(date_c)])
+    j = join(st, it, j, [a("i_item_sk")], [a(item_c)])
+    ret = F.scan(rtab, [a(r_item_c), a(r_key2_c), a(r_qty_c), a(r_amt_c)])
+    j = big_join(st, j, ret, [a(item_c), a(key2_c)],
+                 [a(r_item_c), a(r_key2_c)], jt="LeftOuter")
+    qty_net = F.binop(
+        "Subtract", F.cast(a(qty_c), "long"),
+        F.T(F.X + "CaseWhen",
+            [F.un("IsNotNull", a(r_qty_c)), F.cast(a(r_qty_c), "long"),
+             F.lit(0, "long")]),
+    )
+    dz = F.lit(0, "decimal(8,2)")
+    amt_net = F.binop(
+        "Subtract", F.binop("Add", a(amt_c), dz),
+        F.T(F.X + "CaseWhen",
+            [F.un("IsNotNull", a(r_amt_c)), F.binop("Add", a(r_amt_c), dz),
+             dz]),
+    )
+    return F.project(
+        [a("d_year"), a("i_brand_id"), a("i_class_id"), a("i_category_id"),
+         a("i_manufact_id"), F.alias(qty_net, "qty", 750),
+         F.alias(amt_net, "amt", 751)],
+        j,
+    )
+
+
+def test_spark_q75(ticket_sess, ticket_data, strategy):
+    rows = F.union([
+        _q75_channel_plan(strategy, "store_sales", "ss_sold_date_sk",
+                          "ss_item_sk", "ss_quantity", "ss_ext_sales_price",
+                          "store_returns", "sr_item_sk", "sr_ticket_number",
+                          "ss_ticket_number", "sr_return_quantity",
+                          "sr_return_amt"),
+        _q75_channel_plan(strategy, "catalog_sales", "cs_sold_date_sk",
+                          "cs_item_sk", "cs_quantity", "cs_ext_sales_price",
+                          "catalog_returns", "cr_item_sk", "cr_order_number",
+                          "cs_order_number", "cr_return_quantity",
+                          "cr_return_amount"),
+        _q75_channel_plan(strategy, "web_sales", "ws_sold_date_sk",
+                          "ws_item_sk", "ws_quantity", "ws_ext_sales_price",
+                          "web_returns", "wr_item_sk", "wr_order_number",
+                          "ws_order_number", "wr_return_quantity",
+                          "wr_return_amt"),
+    ])
+    ids = ["i_brand_id", "i_class_id", "i_category_id", "i_manufact_id"]
+    agg = two_stage(
+        [a("d_year")] + [a(c) for c in ids],
+        [(F.sum_(ar("qty", 750, "long")), 501),
+         (F.sum_(ar("amt", 751, "decimal(18,2)")), 502)],
+        rows,
+    )
+    cnt = ar("sales_cnt", 501, "long")
+    amt = ar("sales_amt", 502, "decimal(28,2)")
+    curr = F.project(
+        [a(c) for c in ids]
+        + [F.alias(cnt, "curr_cnt", 760), F.alias(amt, "curr_amt", 761)],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2002)), agg),
+    )
+    prev = F.project(
+        [F.alias(a(c), f"p_{c}", 770 + k) for k, c in enumerate(ids)]
+        + [F.alias(cnt, "prev_cnt", 762), F.alias(amt, "prev_amt", 763)],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2001)), agg),
+    )
+    j = big_join(strategy, curr, prev, [a(c) for c in ids],
+                 [ar(f"p_{c}", 770 + k, "integer")
+                  for k, c in enumerate(ids)])
+    curr_cnt = ar("curr_cnt", 760, "long")
+    prev_cnt = ar("prev_cnt", 762, "long")
+    curr_amt = ar("curr_amt", 761, "decimal(28,2)")
+    prev_amt = ar("prev_amt", 763, "decimal(28,2)")
+    f = F.filter_(
+        and_(F.binop("GreaterThan", F.cast(prev_cnt, "double"),
+                     F.lit(0.0, "double")),
+             F.binop("LessThan",
+                     F.binop("Divide", F.cast(curr_cnt, "double"),
+                             F.cast(prev_cnt, "double")),
+                     F.lit(0.9, "double"))),
+        j,
+    )
+    cnt_diff = F.binop("Subtract", curr_cnt, prev_cnt)
+    amt_diff = F.binop("Subtract", curr_amt, prev_amt)
+    proj = F.project(
+        [F.alias(F.lit(2001, "integer"), "prev_year", 780),
+         F.alias(F.lit(2002, "integer"), "year", 781)]
+        + [a(c) for c in ids]
+        + [F.alias(cnt_diff, "sales_cnt_diff", 782),
+           F.alias(amt_diff, "sales_amt_diff", 783)],
+        f,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(ar("sales_cnt_diff", 782, "long")),
+         F.sort_order(ar("sales_amt_diff", 783, "decimal(28,2)"))],
+        [ar("prev_year", 780, "integer"), ar("year", 781, "integer")]
+        + [a(c) for c in ids]
+        + [ar("sales_cnt_diff", 782, "long"),
+           ar("sales_amt_diff", 783, "decimal(28,2)")],
+        proj,
+    )
+    got = _execute_both(ticket_sess, plan)
+    exp = O.oracle_q75(ticket_data)
+    assert exp, "q75 oracle empty"
+    rows_g = {
+        (b, c, cat, m): (cd, ad) for b, c, cat, m, cd, ad in
+        zip(got["i_brand_id"], got["i_class_id"], got["i_category_id"],
+            got["i_manufact_id"], got["sales_cnt_diff"],
+            got["sales_amt_diff"])
+    }
+    if len(exp) <= 100:
+        assert rows_g == exp
+    else:
+        assert all(exp.get(k) == v for k, v in rows_g.items())
+    assert got["sales_cnt_diff"] == sorted(got["sales_cnt_diff"])
+    assert all(y == 2002 for y in got["year"])
+
+
+# ------------------------------------------------- q78 channel loyalty
+
+def _q78_channel_plan(st, fact, date_c, item_c, cust_c, qty_c, wc_c, sp_c,
+                      rtab, r_item_c, r_key2_c, key2_c, pre, base_id):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    sl = F.scan(fact, [a(date_c), a(item_c), a(cust_c), a(key2_c), a(qty_c),
+                       a(wc_c), a(sp_c)])
+    j = join(st, dt, sl, [a("d_date_sk")], [a(date_c)])
+    ret = F.scan(rtab, [a(r_item_c), a(r_key2_c)])
+    j = big_join(st, j, ret, [a(item_c), a(key2_c)],
+                 [a(r_item_c), a(r_key2_c)], jt="LeftAnti")
+    proj = F.project(
+        [F.alias(a(item_c), f"{pre}_item_sk", base_id),
+         F.alias(a(cust_c), f"{pre}_customer_sk", base_id + 1),
+         F.alias(F.cast(a(qty_c), "long"), "q", base_id + 2),
+         a(wc_c), a(sp_c)],
+        j,
+    )
+    return two_stage(
+        [ar(f"{pre}_item_sk", base_id, "long"),
+         ar(f"{pre}_customer_sk", base_id + 1, "long")],
+        [(F.sum_(ar("q", base_id + 2, "long")), base_id + 3),
+         (F.sum_(a(wc_c)), base_id + 4), (F.sum_(a(sp_c)), base_id + 5)],
+        proj,
+    )
+
+
+def test_spark_q78(sess, data, strategy):
+    ss = _q78_channel_plan(strategy, "store_sales", "ss_sold_date_sk",
+                           "ss_item_sk", "ss_customer_sk", "ss_quantity",
+                           "ss_wholesale_cost", "ss_sales_price",
+                           "store_returns", "sr_item_sk", "sr_ticket_number",
+                           "ss_ticket_number", "ss", 800)
+    ws = _q78_channel_plan(strategy, "web_sales", "ws_sold_date_sk",
+                           "ws_item_sk", "ws_bill_customer_sk", "ws_quantity",
+                           "ws_wholesale_cost", "ws_sales_price",
+                           "web_returns", "wr_item_sk", "wr_order_number",
+                           "ws_order_number", "ws", 810)
+    cs = _q78_channel_plan(strategy, "catalog_sales", "cs_sold_date_sk",
+                           "cs_item_sk", "cs_bill_customer_sk", "cs_quantity",
+                           "cs_wholesale_cost", "cs_sales_price",
+                           "catalog_returns", "cr_item_sk", "cr_order_number",
+                           "cs_order_number", "cs", 820)
+    ss_i, ss_c = ar("ss_item_sk", 800, "long"), ar("ss_customer_sk", 801, "long")
+    ws_i, ws_c = ar("ws_item_sk", 810, "long"), ar("ws_customer_sk", 811, "long")
+    cs_i, cs_c = ar("cs_item_sk", 820, "long"), ar("cs_customer_sk", 821, "long")
+    ss_qty = ar("ss_qty", 803, "long")
+    ws_qty = ar("ws_qty", 813, "long")
+    cs_qty = ar("cs_qty", 823, "long")
+    j = big_join(strategy, ss, ws, [ss_i, ss_c], [ws_i, ws_c], jt="LeftOuter")
+    j = big_join(strategy, j, cs, [ss_i, ss_c], [cs_i, cs_c], jt="LeftOuter")
+
+    def czero(c):
+        return F.T(F.X + "CaseWhen",
+                   [F.un("IsNotNull", c), c, F.lit(0, "long")])
+
+    f = F.filter_(
+        or_(F.binop("GreaterThan", czero(ws_qty), F.lit(0, "long")),
+            F.binop("GreaterThan", czero(cs_qty), F.lit(0, "long"))),
+        j,
+    )
+    other = F.cast(F.binop("Add", czero(ws_qty), czero(cs_qty)), "double")
+    den = F.T(F.X + "CaseWhen",
+              [F.binop("GreaterThan", other, F.lit(0.0, "double")), other,
+               F.lit(1.0, "double")])
+    ratio = F.binop("Divide", F.cast(ss_qty, "double"), den)
+    other_q = F.binop("Add", czero(ws_qty), czero(cs_qty))
+    proj = F.project(
+        [ss_i, ss_c, ss_qty, ar("ss_wc", 804, "decimal(17,2)"),
+         ar("ss_sp", 805, "decimal(17,2)"),
+         F.alias(ratio, "ratio", 830),
+         F.alias(other_q, "other_chan_qty", 831)],
+        f,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(ss_qty, asc=False), F.sort_order(ss_i),
+         F.sort_order(ss_c)],
+        [ss_i, ss_c, ss_qty, ar("ss_wc", 804, "decimal(17,2)"),
+         ar("ss_sp", 805, "decimal(17,2)"), ar("ratio", 830, "double"),
+         ar("other_chan_qty", 831, "long")],
+        proj,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q78(data)
+    assert exp, "q78 oracle empty"
+    n = len(got["ss_item_sk"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["ss_item_sk"][i], got["ss_customer_sk"][i])
+        assert key in exp, key
+        q, w_, sp_, ratio_e, other_e = exp[key]
+        assert (got["ss_qty"][i], got["ss_wc"][i], got["ss_sp"][i]) == (q, w_, sp_), key
+        assert abs(got["ratio"][i] - ratio_e) < 1e-12, key
+        assert got["other_chan_qty"][i] == other_e, key
+    assert got["ss_qty"] == sorted(got["ss_qty"], reverse=True)
+
+
+# ------------------------------------------------- q14a/b INTERSECT giants
+
+def _q14_cross_items_plan(st):
+    def triples(fact, date_c, item_c):
+        dt = F.project(
+            [a("d_date_sk")],
+            F.filter_(and_(F.binop("GreaterThanOrEqual", a("d_year"), i32(1998)),
+                           F.binop("LessThanOrEqual", a("d_year"), i32(2000))),
+                      F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+        )
+        it = F.scan("item", [a("i_item_sk"), a("i_brand_id"), a("i_class_id"),
+                             a("i_category_id")])
+        sl = F.scan(fact, [a(date_c), a(item_c)])
+        j = join(st, dt, sl, [a("d_date_sk")], [a(date_c)])
+        j = join(st, it, j, [a("i_item_sk")], [a(item_c)])
+        return two_stage(
+            [a("i_brand_id"), a("i_class_id"), a("i_category_id")], [], j)
+
+    ss = triples("store_sales", "ss_sold_date_sk", "ss_item_sk")
+    cs = triples("catalog_sales", "cs_sold_date_sk", "cs_item_sk")
+    ws = triples("web_sales", "ws_sold_date_sk", "ws_item_sk")
+    keys = [a("i_brand_id"), a("i_class_id"), a("i_category_id")]
+    inter = join(st, cs, ss, keys, keys, jt="LeftSemi", build_side="right")
+    inter = join(st, ws, inter, keys, keys, jt="LeftSemi", build_side="right")
+    items = F.scan("item", [a("i_item_sk"), a("i_brand_id"), a("i_class_id"),
+                            a("i_category_id")])
+    hot = join(st, inter, items, keys, keys, jt="LeftSemi", build_side="right")
+    return F.project([a("i_item_sk")], hot)
+
+
+def _q14_avg_sales_plan(st):
+    branches = []
+    for k, (fact, date_c, q_c, p_c) in enumerate((
+        ("store_sales", "ss_sold_date_sk", "ss_quantity", "ss_list_price"),
+        ("catalog_sales", "cs_sold_date_sk", "cs_quantity", "cs_list_price"),
+        ("web_sales", "ws_sold_date_sk", "ws_quantity", "ws_list_price"),
+    )):
+        dt = F.project(
+            [a("d_date_sk")],
+            F.filter_(and_(F.binop("GreaterThanOrEqual", a("d_year"), i32(1998)),
+                           F.binop("LessThanOrEqual", a("d_year"), i32(2000))),
+                      F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+        )
+        sl = F.scan(fact, [a(date_c), a(q_c), a(p_c)])
+        j = join(st, dt, sl, [a("d_date_sk")], [a(date_c)])
+        v = F.binop("Multiply", F.cast(a(q_c), "long"), a(p_c))
+        branches.append(F.project([F.alias(v, "v", 900)], j))
+    return two_stage(
+        [], [(F.avg(ar("v", 900, "decimal(17,2)")), 901)],
+        F.union(branches),
+        result=[F.alias(ar("average_sales", 901, "decimal(21,6)"),
+                        "average_sales", 902)],
+    )
+
+
+def _q14_cells_plan(st, fact, date_c, item_c, q_c, p_c, cross, avg_sub, year):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_year"), i32(year)),
+                       F.binop("EqualTo", a("d_moy"), i32(11))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"),
+                                      a("d_moy")])),
+    )
+    it = F.scan("item", [a("i_item_sk"), a("i_brand_id"), a("i_class_id"),
+                         a("i_category_id")])
+    sl = F.scan(fact, [a(date_c), a(item_c), a(q_c), a(p_c)])
+    j = join(st, dt, sl, [a("d_date_sk")], [a(date_c)])
+    j = join(st, cross, j, [a("i_item_sk")], [a(item_c)], jt="LeftSemi",
+             build_side="right")
+    j = join(st, it, j, [a("i_item_sk")], [a(item_c)])
+    v = F.binop("Multiply", F.cast(a(q_c), "long"), a(p_c))
+    proj = F.project(
+        [a("i_brand_id"), a("i_class_id"), a("i_category_id"),
+         F.alias(v, "v", 910)],
+        j,
+    )
+    agg = two_stage(
+        [a("i_brand_id"), a("i_class_id"), a("i_category_id")],
+        [(F.sum_(ar("v", 910, "decimal(17,2)")), 911), (F.count(), 912)],
+        proj,
+    )
+    return F.filter_(
+        F.binop("GreaterThan",
+                F.cast(ar("sales", 911, "decimal(27,2)"), "double"),
+                F.cast(avg_sub, "double")),
+        agg,
+    )
+
+
+def test_spark_q14a(sess, data, strategy):
+    cross = _q14_cross_items_plan(strategy)
+    avg_plan = _q14_avg_sales_plan(strategy)
+    sales = ar("sales", 911, "decimal(27,2)")
+    number = ar("number_sales", 912, "long")
+    branches = []
+    for k, (name, fact, date_c, item_c, q_c, p_c) in enumerate((
+        ("store", "store_sales", "ss_sold_date_sk", "ss_item_sk",
+         "ss_quantity", "ss_list_price"),
+        ("catalog", "catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+         "cs_quantity", "cs_list_price"),
+        ("web", "web_sales", "ws_sold_date_sk", "ws_item_sk",
+         "ws_quantity", "ws_list_price"),
+    )):
+        cells = _q14_cells_plan(strategy, fact, date_c, item_c, q_c, p_c,
+                                cross, _scalar_subquery(avg_plan, 920 + k),
+                                2002)
+        branches.append(F.project(
+            [F.alias(F.lit(name, "string"), "channel", 930),
+             a("i_brand_id"), a("i_class_id"), a("i_category_id"),
+             sales, number],
+            cells,
+        ))
+    u = F.union(branches)
+    chan = ar("channel", 930, "string")
+    dims = [(chan, "string"), (a("i_brand_id"), "integer"),
+            (a("i_class_id"), "integer"), (a("i_category_id"), "integer")]
+    exp_attrs = [ar(["channel", "i_brand_id", "i_class_id",
+                     "i_category_id"][k], 940 + k, dt_)
+                 for k, (_, dt_) in enumerate(dims)]
+    exp_gid = ar("g_id", 944, "integer")
+    projections = []
+    for level in range(4, -1, -1):
+        row = [sales, number]
+        for k, (e, dt_) in enumerate(dims):
+            row.append(e if k < level else F.lit(None, dt_))
+        row.append(F.lit(4 - level, "integer"))
+        projections.append(row)
+    expand = F.expand(projections, [sales, number] + exp_attrs + [exp_gid], u)
+    agg = two_stage(
+        exp_attrs + [exp_gid],
+        [(F.sum_(sales), 950), (F.sum_(number), 951)],
+        expand,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(e) for e in exp_attrs] + [F.sort_order(exp_gid)],
+        [F.alias(exp_attrs[0], "channel", 960),
+         F.alias(exp_attrs[1], "i_brand_id", 961),
+         F.alias(exp_attrs[2], "i_class_id", 962),
+         F.alias(exp_attrs[3], "i_category_id", 963),
+         F.alias(exp_gid, "g_id", 964),
+         F.alias(ar("sum_sales", 950, "decimal(37,2)"), "sum_sales", 965),
+         F.alias(ar("sum_number_sales", 951, "long"),
+                 "sum_number_sales", 966)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q14a(data)
+    assert exp, "q14a oracle empty"
+    n = len(got["channel"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["channel"][i], got["i_brand_id"][i], got["i_class_id"][i],
+               got["i_category_id"][i])
+        assert key in exp, key
+        assert (got["sum_sales"][i], got["sum_number_sales"][i]) == exp[key], key
+    from test_tpcds import _nf
+    order = [tuple(_nf(got[c][i]) for c in
+                   ("channel", "i_brand_id", "i_class_id", "i_category_id"))
+             for i in range(n)]
+    assert order == sorted(order)
+
+
+def test_spark_q14b(sess, data, strategy):
+    cross = _q14_cross_items_plan(strategy)
+    avg_plan = _q14_avg_sales_plan(strategy)
+    ty = _q14_cells_plan(strategy, "store_sales", "ss_sold_date_sk",
+                         "ss_item_sk", "ss_quantity", "ss_list_price",
+                         cross, _scalar_subquery(avg_plan, 920), 2002)
+    ly = _q14_cells_plan(strategy, "store_sales", "ss_sold_date_sk",
+                         "ss_item_sk", "ss_quantity", "ss_list_price",
+                         cross, _scalar_subquery(avg_plan, 921), 2001)
+    sales = ar("sales", 911, "decimal(27,2)")
+    number = ar("number_sales", 912, "long")
+    ly = F.project(
+        [F.alias(a("i_brand_id"), "l_brand_id", 970),
+         F.alias(a("i_class_id"), "l_class_id", 971),
+         F.alias(a("i_category_id"), "l_category_id", 972),
+         F.alias(sales, "last_sales", 973),
+         F.alias(number, "last_number_sales", 974)],
+        ly,
+    )
+    j = big_join(strategy, ty, ly,
+                 [a("i_brand_id"), a("i_class_id"), a("i_category_id")],
+                 [ar("l_brand_id", 970, "integer"),
+                  ar("l_class_id", 971, "integer"),
+                  ar("l_category_id", 972, "integer")])
+    last_sales = ar("last_sales", 973, "decimal(27,2)")
+    f = F.filter_(
+        F.binop("GreaterThan", F.cast(sales, "double"),
+                F.cast(last_sales, "double")),
+        j,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("i_brand_id")), F.sort_order(a("i_class_id")),
+         F.sort_order(a("i_category_id"))],
+        [a("i_brand_id"), a("i_class_id"), a("i_category_id"),
+         F.alias(sales, "sales", 980), F.alias(number, "number_sales", 981),
+         F.alias(last_sales, "last_sales", 982),
+         F.alias(ar("last_number_sales", 974, "long"),
+                 "last_number_sales", 983)],
+        f,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q14b(data)
+    assert exp, "q14b oracle empty"
+    rows_g = {
+        (b, c, cat): (s_, ns, ls, lns) for b, c, cat, s_, ns, ls, lns in
+        zip(got["i_brand_id"], got["i_class_id"], got["i_category_id"],
+            got["sales"], got["number_sales"], got["last_sales"],
+            got["last_number_sales"])
+    }
+    if len(exp) <= 100:
+        assert rows_g == exp
+    else:
+        assert all(exp.get(k) == v for k, v in rows_g.items())
+
+
+# ------------------------------------------------- q64 cross-year self-join
+
+def _q64_cross_sales_plan(st, year):
+    sl = F.scan("store_sales",
+                [a("ss_item_sk"), a("ss_ticket_number"), a("ss_store_sk"),
+                 a("ss_sold_date_sk"), a("ss_wholesale_cost"),
+                 a("ss_list_price"), a("ss_coupon_amt")])
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(year)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    sl = join(st, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    sr = F.scan("store_returns", [a("sr_item_sk"), a("sr_ticket_number")])
+    j = big_join(st, sl, sr, [a("ss_item_sk"), a("ss_ticket_number")],
+                 [a("sr_item_sk"), a("sr_ticket_number")])
+    it = F.project(
+        [a("i_item_sk"), a("i_item_id")],
+        F.filter_(
+            in_(a("i_color"), "purple", "burlywood", "indian", "spring",
+                "floral", "medium", "peach", "saddle", "navy", "slate"),
+            F.scan("item", [a("i_item_sk"), a("i_item_id"), a("i_color")]),
+        ),
+    )
+    j = join(st, it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    st2 = F.scan("store", [a("s_store_sk"), a("s_store_name"), a("s_zip")])
+    j = join(st, st2, j, [a("s_store_sk")], [a("ss_store_sk")])
+    return two_stage(
+        [a("i_item_id"), a("s_store_name"), a("s_zip")],
+        [(F.count(), 851), (F.sum_(a("ss_wholesale_cost")), 852),
+         (F.sum_(a("ss_list_price")), 853), (F.sum_(a("ss_coupon_amt")), 854)],
+        j,
+    )
+
+
+def test_spark_q64(sess, data, strategy):
+    cnt = ar("cnt", 851, "long")
+    s1 = ar("s1", 852, "decimal(17,2)")
+    s2 = ar("s2", 853, "decimal(17,2)")
+    s3 = ar("s3", 854, "decimal(17,2)")
+    cs1 = _q64_cross_sales_plan(strategy, 2001)
+    cs2 = F.project(
+        [F.alias(a("i_item_id"), "r_item_id", 860),
+         F.alias(a("s_store_name"), "r_store_name", 861),
+         F.alias(a("s_zip"), "r_zip", 862),
+         F.alias(cnt, "cnt2", 863), F.alias(s1, "s1_2", 864),
+         F.alias(s2, "s2_2", 865), F.alias(s3, "s3_2", 866)],
+        _q64_cross_sales_plan(strategy, 2002),
+    )
+    j = big_join(strategy, cs1, cs2,
+                 [a("i_item_id"), a("s_store_name"), a("s_zip")],
+                 [ar("r_item_id", 860, "string"),
+                  ar("r_store_name", 861, "string"),
+                  ar("r_zip", 862, "string")])
+    cnt2 = ar("cnt2", 863, "long")
+    f = F.filter_(F.binop("LessThanOrEqual", cnt2, cnt), j)
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(s1, asc=False), F.sort_order(a("i_item_id")),
+         F.sort_order(a("s_store_name")), F.sort_order(a("s_zip"))],
+        [a("i_item_id"), a("s_store_name"), a("s_zip"),
+         F.alias(cnt, "cnt", 870), F.alias(s1, "s1", 871),
+         F.alias(s2, "s2", 872), F.alias(s3, "s3", 873),
+         F.alias(cnt2, "cnt2", 874),
+         F.alias(ar("s1_2", 864, "decimal(17,2)"), "s1_2", 875),
+         F.alias(ar("s2_2", 865, "decimal(17,2)"), "s2_2", 876),
+         F.alias(ar("s3_2", 866, "decimal(17,2)"), "s3_2", 877)],
+        f,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q64(data)
+    assert exp, "q64 oracle empty"
+    rows_g = {
+        (i, st_, z): (c1, x, y, zz, c2, d, e, f_) for
+        i, st_, z, c1, x, y, zz, c2, d, e, f_ in
+        zip(got["i_item_id"], got["s_store_name"], got["s_zip"], got["cnt"],
+            got["s1"], got["s2"], got["s3"], got["cnt2"], got["s1_2"],
+            got["s2_2"], got["s3_2"])
+    }
+    if len(exp) <= 100:
+        assert rows_g == exp
+    else:
+        assert all(exp.get(k) == v for k, v in rows_g.items())
+    assert got["s1"] == sorted(got["s1"], reverse=True)
