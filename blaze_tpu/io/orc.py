@@ -46,6 +46,7 @@ K_BOOLEAN, K_BYTE, K_SHORT, K_INT, K_LONG, K_FLOAT, K_DOUBLE, K_STRING = range(8
 K_BINARY = 8
 K_TIMESTAMP = 9
 K_LIST = 10
+K_MAP = 11
 K_STRUCT = 12
 K_DECIMAL = 14
 K_DATE = 15
@@ -549,6 +550,32 @@ def _encode_column(
     return streams
 
 
+def _encode_list_column(
+    col_id: int, dtype: DataType, validity: np.ndarray,
+    lengths: np.ndarray, edata: np.ndarray, evalid: np.ndarray,
+) -> List[_Stream]:
+    """LIST of primitive: LENGTH at the list column, flattened child
+    PRESENT/DATA at col_id+1 (the writer's preorder child id)."""
+    if dtype.elem.is_nested or dtype.elem.is_string:
+        raise NotImplementedError(f"ORC subset writer: {dtype!r}")
+    streams: List[_Stream] = []
+    live = validity.astype(bool)
+    if not bool(live.all()):
+        streams.append(_Stream(S_PRESENT, col_id, _bool_encode(validity)))
+    ln = lengths[live].astype(np.int64)
+    streams.append(_Stream(S_LENGTH, col_id, _rlev1_encode(ln, signed=False)))
+    flat_v: List[np.ndarray] = []
+    flat_d: List[np.ndarray] = []
+    for i in np.flatnonzero(live):
+        L = int(lengths[i])
+        flat_v.append(evalid[i, :L])
+        flat_d.append(edata[i, :L])
+    ev = np.concatenate(flat_v) if flat_v else np.zeros(0, bool)
+    ed = np.concatenate(flat_d) if flat_d else np.zeros(0, dtype.elem.np_dtype)
+    streams.extend(_encode_column(col_id + 1, dtype.elem, ed, ev, None))
+    return streams
+
+
 def _col_stats(dtype: DataType, data, validity, lengths) -> "PbWriter":
     w = PbWriter()
     live = validity.astype(bool)
@@ -589,10 +616,20 @@ def write_orc(
     columns: Dict[str, Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]],
     stripe_rows: int = 65536,
 ) -> None:
-    """columns: name -> (data, validity|None, lengths|None for strings)."""
+    """columns: name -> (data, validity|None, lengths|None for strings).
+    ARRAY fields instead take the reader's 4-tuple shape:
+    (None, validity|None, lengths, (elem_data_2d, elem_valid_2d))."""
     any_col = next(iter(columns.values()))
     n = any_col[0].shape[0]
     from .fs import get_fs
+
+    # preorder type ids: root = 0, each ARRAY field consumes two slots
+    field_type_ids: List[int] = []
+    _next = 1
+    for _fld in schema.fields:
+        field_type_ids.append(_next)
+        _next += 2 if _fld.dtype.kind == TypeKind.ARRAY else 1
+    total_type_ids = _next
 
     with get_fs(path).create(path) as f:
         f.write(MAGIC)
@@ -610,7 +647,21 @@ def write_orc(
             root.varint(1, rows)
             root.varint(10, 0)
             stats_msgs.append(root.getvalue())
-            for ci, fld in enumerate(schema.fields, start=1):
+            for ci, fld in zip(field_type_ids, schema.fields):
+                if fld.dtype.kind == TypeKind.ARRAY:
+                    _, validity, lengths, (edata, evalid) = columns[fld.name]
+                    if validity is None:
+                        validity = np.ones(lengths.shape[0], bool)
+                    sl = slice(start, start + rows)
+                    streams.extend(_encode_list_column(
+                        ci, fld.dtype, validity[sl], lengths[sl],
+                        edata[sl], evalid[sl]))
+                    for _ in range(2):  # list + child type slots
+                        cw = PbWriter()
+                        cw.varint(1, int(validity[sl].sum()))
+                        cw.varint(10, 0)
+                        stats_msgs.append(cw.getvalue())
+                    continue
                 data, validity, lengths = columns[fld.name]
                 if validity is None:
                     validity = np.ones(data.shape[0], bool)
@@ -630,7 +681,7 @@ def write_orc(
                 m.varint(2, s.column)
                 m.varint(3, len(s.data))
                 sf.msg(1, m)
-            for _ in range(len(schema.fields) + 1):
+            for _ in range(total_type_ids):
                 enc = PbWriter()
                 enc.varint(1, 0)  # DIRECT
                 sf.msg(2, enc)
@@ -666,18 +717,28 @@ def write_orc(
             ft.msg(3, si)
         root_t = PbWriter()
         root_t.varint(1, K_STRUCT)
-        for i in range(len(schema.fields)):
-            root_t.varint(2, i + 1)
+        for tid in field_type_ids:
+            root_t.varint(2, tid)
         for fld in schema.fields:
             root_t.string(3, fld.name)
         ft.msg(4, root_t)
-        for fld in schema.fields:
+
+        def emit_type(dt: DataType, tid: int) -> None:
             t = PbWriter()
-            t.varint(1, _orc_kind(fld.dtype))
-            if fld.dtype.is_decimal:
-                t.varint(5, fld.dtype.precision)
-                t.varint(6, fld.dtype.scale)
+            if dt.kind == TypeKind.ARRAY:
+                t.varint(1, K_LIST)
+                t.varint(2, tid + 1)
+                ft.msg(4, t)
+                emit_type(dt.elem, tid + 1)
+                return
+            t.varint(1, _orc_kind(dt))
+            if dt.is_decimal:
+                t.varint(5, dt.precision)
+                t.varint(6, dt.scale)
             ft.msg(4, t)
+
+        for tid, fld in zip(field_type_ids, schema.fields):
+            emit_type(fld.dtype, tid)
         ft.varint(6, n)  # numberOfRows
         ft_bytes = ft.getvalue()
         f.write(ft_bytes)
@@ -719,6 +780,9 @@ class OrcFileMeta:
     field_ids: List[int] = None
     # field name -> element column id (LIST fields only)
     child_ids: dict = None
+    # type id -> (kind, subtype ids): the full flattened type tree,
+    # needed to walk MAP/STRUCT/nested-LIST columns
+    type_tree: dict = None
 
 
 def _decode_type(b: bytes) -> Tuple[int, List[int], List[str], int, int]:
@@ -863,18 +927,28 @@ def read_metadata(path: str, list_elems: int = 16, string_width: int = 64) -> Or
             return _KIND_TO_DTYPE[kind]
         raise NotImplementedError(f"ORC subset: type kind {kind}")
 
+    type_tree: dict = {}
+
+    def full_dtype(tid: int) -> DataType:
+        kind, subs, cnames, precision, scale = _decode_type(types[tid])
+        type_tree[tid] = (kind, list(subs))
+        if kind == K_LIST:
+            return DataType.array(full_dtype(subs[0]), list_elems)
+        if kind == K_MAP:
+            return DataType.map(full_dtype(subs[0]), full_dtype(subs[1]),
+                                list_elems)
+        if kind == K_STRUCT:
+            return DataType.struct(
+                [Field(n, full_dtype(s2)) for n, s2 in zip(cnames, subs)])
+        return prim_dtype(kind, precision, scale)
+
     for name, st in zip(names, subtypes):
         kind, subs, _, precision, scale = _decode_type(types[st])
         field_ids.append(st)
-        if kind == K_LIST:
-            # LIST of primitive: the child occupies the next type id
-            ck, _, _, cp, cs = _decode_type(types[subs[0]])
-            if ck in (K_LIST, K_STRUCT, 11):
-                raise NotImplementedError("ORC subset: nested-of-nested")
-            dt = DataType.array(prim_dtype(ck, cp, cs), list_elems)
+        dt = full_dtype(st)
+        if kind == K_LIST and not (dt.elem.is_nested or dt.elem.is_string):
+            # flat LIST keeps the vectorized fast path in read_stripe
             child_ids[name] = subs[0]
-        else:
-            dt = prim_dtype(kind, precision, scale)
         fields.append(Field(name, dt))
     schema = Schema(fields)
 
@@ -891,10 +965,29 @@ def read_metadata(path: str, list_elems: int = 16, string_width: int = 64) -> Or
                 if ci < len(cols):
                     st.stats[fld.name] = _decode_col_stats(cols[ci])
     return OrcFileMeta(schema, stripes, num_rows, compression,
-                       field_ids=field_ids, child_ids=child_ids)
+                       field_ids=field_ids, child_ids=child_ids,
+                       type_tree=type_tree)
 
 
 S_ROW_INDEX, S_BLOOM_FILTER, S_BLOOM_FILTER_UTF8 = 6, 7, 8
+
+
+def _rescale_decimals(vals: np.ndarray, scales: np.ndarray,
+                      declared: int) -> np.ndarray:
+    """Align per-value decimal scales (the SECONDARY stream) to the
+    declared type scale.  Writers normally emit the declared scale for
+    every value, but the spec allows differing ones; a value with MORE
+    fractional digits than the declared type cannot be represented
+    exactly and is gated."""
+    scales = np.asarray(scales[: vals.size], np.int64)
+    if np.all(scales == declared):
+        return vals
+    if int(scales.max(initial=declared)) > declared:
+        raise NotImplementedError(
+            f"ORC subset: decimal value scale {int(scales.max())} exceeds "
+            f"the declared scale {declared}"
+        )
+    return vals * (10 ** (declared - scales)).astype(np.int64)
 
 
 def _varint_stream_decode(raw: bytes, nvals: int) -> np.ndarray:
@@ -970,6 +1063,99 @@ def read_stripe(
             return _rlev2_decode(raw, nvals, signed)
         return _rlev1_decode(raw, nvals, signed)
 
+    tree = meta.type_tree or {}
+
+    def decode_nested(tid: int, dtype: DataType, count: int) -> list:
+        """Recursive python-value decode for compound columns
+        (MAP/STRUCT/nested LIST/list-of-string) — each nesting level
+        carries its own PRESENT stream; children hold one entry per
+        non-null parent slot (LIST/MAP: per element)."""
+        stt = per_col.get(tid, {})
+        encn = encodings[tid][0] if tid < len(encodings) else E_DIRECT
+        dsz = encodings[tid][1] if tid < len(encodings) else 0
+        validity = (
+            _bool_decode(dec(tid, S_PRESENT), count)
+            if S_PRESENT in stt
+            else np.ones(count, bool)
+        )
+        nv = int(validity.sum())
+        k = dtype.kind
+
+        def scatter(vals: list) -> list:
+            it = iter(vals)
+            return [next(it) if ok else None for ok in validity]
+
+        if k == TypeKind.ARRAY:
+            ln = int_decode(dec(tid, S_LENGTH), nv, False, encn)
+            elems = decode_nested(tree[tid][1][0], dtype.elem, int(ln.sum()))
+            vals, pos = [], 0
+            for L in ln:
+                vals.append(elems[pos : pos + int(L)])
+                pos += int(L)
+            return scatter(vals)
+        if k == TypeKind.MAP:
+            ln = int_decode(dec(tid, S_LENGTH), nv, False, encn)
+            total = int(ln.sum())
+            keys = decode_nested(tree[tid][1][0], dtype.key, total)
+            mvals = decode_nested(tree[tid][1][1], dtype.value, total)
+            vals, pos = [], 0
+            for L in ln:
+                vals.append(dict(zip(keys[pos : pos + int(L)],
+                                     mvals[pos : pos + int(L)])))
+                pos += int(L)
+            return scatter(vals)
+        if k == TypeKind.STRUCT:
+            kids = [
+                decode_nested(s2, f2.dtype, nv)
+                for s2, f2 in zip(tree[tid][1], dtype.struct_fields)
+            ]
+            vals = [
+                {f2.name: kid[j] for f2, kid in zip(dtype.struct_fields, kids)}
+                for j in range(nv)
+            ]
+            return scatter(vals)
+        if dtype.is_string:
+            if encn in (E_DICTIONARY, E_DICTIONARY_V2):
+                dlen = int_decode(dec(tid, S_LENGTH), dsz, False, encn)
+                dbody = dec(tid, S_DICTIONARY_DATA)
+                offs = np.concatenate([[0], np.cumsum(dlen)])
+                words = [
+                    bytes(dbody[int(offs[i]) : int(offs[i + 1])]).decode()
+                    for i in range(dsz)
+                ]
+                indices = int_decode(dec(tid, S_DATA), nv, False, encn)
+                return scatter([words[int(i)] for i in indices])
+            ln = int_decode(dec(tid, S_LENGTH), nv, False, encn)
+            body = dec(tid, S_DATA)
+            vals, pos = [], 0
+            for L in ln:
+                vals.append(bytes(body[pos : pos + int(L)]).decode())
+                pos += int(L)
+            return scatter(vals)
+        if k == TypeKind.BOOL:
+            return scatter([bool(v) for v in _bool_decode(dec(tid, S_DATA), nv)])
+        if k == TypeKind.DECIMAL:
+            import decimal as _dec
+
+            unscaled = _varint_stream_decode(dec(tid, S_DATA), nv)
+            unscaled = _rescale_decimals(
+                unscaled, int_decode(dec(tid, S_SECONDARY), nv, True, encn),
+                dtype.scale)
+            q = _dec.Decimal(1).scaleb(-dtype.scale)
+            return scatter([_dec.Decimal(int(v)).scaleb(-dtype.scale)
+                            .quantize(q) for v in unscaled])
+        if k in (TypeKind.INT8,):
+            return scatter([int(v) for v in np.frombuffer(
+                _byte_rle_decode(dec(tid, S_DATA), nv), np.int8)])
+        if k in (TypeKind.INT16, TypeKind.INT32, TypeKind.INT64,
+                 TypeKind.DATE32):
+            return scatter([int(v) for v in
+                            int_decode(dec(tid, S_DATA), nv, True, encn)])
+        if k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+            return scatter([float(v) for v in np.frombuffer(
+                dec(tid, S_DATA), dtype.np_dtype, nv)])
+        raise NotImplementedError(f"ORC subset: nested element {dtype!r}")
+
     rows = stripe.rows
     out = {}
     ids = meta.field_ids or list(range(1, len(meta.schema.fields) + 1))
@@ -977,6 +1163,16 @@ def read_stripe(
         st = per_col.get(ci, {})
         enc = encodings[ci][0] if ci < len(encodings) else E_DIRECT
         dict_size = encodings[ci][1] if ci < len(encodings) else 0
+        if fld.dtype.kind in (TypeKind.MAP, TypeKind.STRUCT) or (
+            fld.dtype.kind == TypeKind.ARRAY
+            and (fld.dtype.elem.is_nested or fld.dtype.elem.is_string)
+        ):
+            # compound columns (maps, structs, nested/str lists):
+            # recursive python-value decode (incl. its own PRESENT);
+            # the scan layer builds the padded nested Column via
+            # column_from_pylist
+            out[fld.name] = ("py", decode_nested(ci, fld.dtype, rows))
+            continue
         validity = (
             _bool_decode(dec(ci, S_PRESENT), rows)
             if S_PRESENT in st
@@ -997,6 +1193,9 @@ def read_stripe(
                    TypeKind.DECIMAL):
             if k == TypeKind.DECIMAL:
                 vals = _varint_stream_decode(dec(ci, S_DATA), nvals)
+                vals = _rescale_decimals(
+                    vals, int_decode(dec(ci, S_SECONDARY), nvals, True, enc),
+                    fld.dtype.scale)
             else:
                 vals = int_decode(dec(ci, S_DATA), nvals, True, enc)
             data = np.zeros(rows, fld.dtype.np_dtype)
@@ -1042,12 +1241,19 @@ def read_stripe(
         elif fld.dtype.kind == TypeKind.ARRAY:
             # LIST of primitive: LENGTH stream at the list column,
             # PRESENT+DATA at the child column id; rectangularized to
-            # the declared max_elems (long lists truncate — the padded
-            # layout's documented cap, as for collect_list)
+            # the declared max_elems
             et = fld.dtype.elem
             m = fld.dtype.max_elems
             cid = (meta.child_ids or {}).get(fld.name, ci + 1)
             ln = int_decode(dec(ci, S_LENGTH), nvals, False, enc)
+            if ln.size and int(ln.max()) > m:
+                # gated, not silently wrong: a list longer than the
+                # padded layout's declared cap cannot be represented
+                raise NotImplementedError(
+                    f"ORC subset: list length {int(ln.max())} exceeds the "
+                    f"declared max_elems {m} for {fld.name!r}; re-read with "
+                    f"a wider ARRAY type"
+                )
             lengths = np.zeros(rows, np.int32)
             lengths[validity] = ln.astype(np.int32)
             total = int(ln.sum())
@@ -1064,6 +1270,10 @@ def read_stripe(
                       TypeKind.DATE32, TypeKind.DECIMAL):
                 if ek == TypeKind.DECIMAL:
                     cvals = _varint_stream_decode(dec(cid, S_DATA), cn)
+                    cvals = _rescale_decimals(
+                        cvals,
+                        int_decode(dec(cid, S_SECONDARY), cn, True, cenc),
+                        et.scale)
                 else:
                     cvals = int_decode(dec(cid, S_DATA), cn, True, cenc)
             elif ek in (TypeKind.FLOAT32, TypeKind.FLOAT64):

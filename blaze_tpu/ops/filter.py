@@ -38,6 +38,7 @@ def compact_columns(cols, keep):
                 taken.data,
                 taken.validity & live,
                 None if taken.lengths is None else jnp.where(live, taken.lengths, 0),
+                taken.children,  # nested columns keep their gathered children
             )
         )
     return tuple(out), count

@@ -149,11 +149,31 @@ class OrcScanExec(ExecNode):
                             if f.name not in raw:
                                 cols.append(self._null_column(f.dtype, cap))
                                 continue
+                            if (len(raw[f.name]) == 2
+                                    and raw[f.name][0] == "py"):
+                                # compound column decoded to python
+                                # values; build the padded nested
+                                # Column through the canonical path
+                                from ..batch import column_from_pylist
+
+                                _, vals = raw[f.name]
+                                cols.append(column_from_pylist(
+                                    f.dtype, list(vals[s:e]), capacity=cap))
+                                continue
                             if len(raw[f.name]) == 4:
                                 # LIST column: (None, validity, lengths,
                                 # (elem_data, elem_valid)) from the reader
                                 _, validity, lengths, (ed, ev) = raw[f.name]
                                 m = f.dtype.max_elems
+                                if int(np.max(lengths[s:e], initial=0)) > m:
+                                    # read_metadata decodes with ONE
+                                    # uniform cap (the widest field);
+                                    # a narrower declared field must
+                                    # gate, not silently truncate
+                                    raise NotImplementedError(
+                                        f"ORC subset: list length "
+                                        f"{int(np.max(lengths[s:e]))} exceeds "
+                                        f"max_elems {m} for {f.name!r}")
                                 ed2 = np.zeros((cap, m), f.dtype.elem.np_dtype)
                                 ev2 = np.zeros((cap, m), np.bool_)
                                 k = min(m, ed.shape[1])
