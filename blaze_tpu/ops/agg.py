@@ -103,18 +103,15 @@ def agg_result_type(fn: str, in_t: Optional[DataType]) -> DataType:
         return DataType.float64()
     if fn in ("collect_list", "collect_set"):
         if fn == "collect_set" and in_t.is_nested:
-            # sets of LISTS dedup via (length, validity-flags, value)
-            # words (_elem_sort_words); deeper nesting has no word
-            # encoding yet
-            if not (
-                in_t.kind == TypeKind.ARRAY
-                and not in_t.elem.is_nested
-                and not in_t.elem.is_string
-                and in_t.max_elems <= 64
-            ):
+            # set dedup encodes elements into equality-preserving
+            # uint64 sort words (_value_words): lists, lists-of-lists,
+            # lists-of-structs and lists-of-strings all encode; MAPs
+            # (no canonical entry order) and >64-wide ARRAY levels
+            # stay gated
+            if not _collect_set_elem_supported(in_t):
                 raise NotImplementedError(
-                    "collect_set over nested elements beyond "
-                    "array-of-primitive (inner arity <= 64)"
+                    f"collect_set over {in_t!r} (MAP elements or an "
+                    "ARRAY level wider than 64)"
                 )
         return DataType.array(in_t, int(conf.COLLECT_MAX_ELEMS.get()))
     return in_t  # min/max/first
@@ -464,6 +461,84 @@ def _canon_float_bits(data):
     return d.view(jnp.int32) if data.dtype == jnp.float32 else f64_raw_bits(d)
 
 
+def _value_words(dtype: DataType, col: Column, live) -> List[jnp.ndarray]:
+    """Recursive equality-preserving uint64 words for values of any
+    supported nesting, each word shaped like ``live`` (the liveness
+    mask at this level).  A trailing element axis is flattened into
+    max_elems separate words, so total key count stays static."""
+    if dtype.kind == TypeKind.ARRAY:
+        m = dtype.max_elems
+        child = col.children[0]
+        words = [jnp.where(live, col.lengths, 0).astype(jnp.uint64)]
+        inner_live = (
+            jnp.arange(m)[(None,) * live.ndim] < col.lengths[..., None]
+        ) & live[..., None]
+        lv = inner_live & child.validity
+        flags = jnp.zeros(live.shape, jnp.uint64)
+        for j in range(m):
+            flags = flags | (lv[..., j].astype(jnp.uint64) << jnp.uint64(j))
+        words.append(flags)
+        for w in _value_words(dtype.elem, child, lv):
+            for j in range(m):
+                words.append(w[..., j])
+        return words
+    if dtype.kind == TypeKind.STRUCT:
+        words = []
+        for f, ch in zip(dtype.struct_fields, col.children):
+            lv = live & ch.validity
+            words.append(lv.astype(jnp.uint64))  # per-field null flag
+            words.extend(_value_words(f.dtype, ch, lv))
+        return words
+    if dtype.is_string:
+        w_ = col.data.shape[-1]
+        nw = (w_ + 7) // 8
+        d = col.data
+        if nw * 8 != w_:
+            pad = [(0, 0)] * (d.ndim - 1) + [(0, nw * 8 - w_)]
+            d = jnp.pad(d, pad)
+        b = d.reshape(live.shape + (nw, 8)).astype(jnp.uint64)
+        words = [jnp.where(live, col.lengths, 0).astype(jnp.uint64)]
+        for k in range(nw):
+            word = b[..., k, 0] << jnp.uint64(56)
+            for j in range(1, 8):
+                word = word | (b[..., k, j] << jnp.uint64(8 * (7 - j)))
+            words.append(jnp.where(live, word, jnp.uint64(0)))
+        return words
+    bits = _canon_float_bits(col.data) if dtype.is_float else col.data
+    bits = bits.astype(jnp.int64).view(jnp.uint64)
+    return [jnp.where(live, bits, jnp.uint64(0))]
+
+
+def _word_count(dtype: DataType) -> int:
+    """Sort words _value_words emits per value (the ARRAY levels
+    multiply: each child word splits into max_elems words)."""
+    if dtype.kind == TypeKind.ARRAY:
+        return 2 + dtype.max_elems * _word_count(dtype.elem)
+    if dtype.kind == TypeKind.STRUCT:
+        return sum(1 + _word_count(f.dtype) for f in dtype.struct_fields)
+    if dtype.is_string:
+        return 1 + (dtype.string_width + 7) // 8
+    return 1
+
+
+def _collect_set_elem_supported(dtype: DataType) -> bool:
+    """Element types the sort-word dedup can encode: primitives,
+    strings, and ARRAY/STRUCT nestings thereof with every ARRAY level
+    <= 64 elements (one flag word per level) and a bounded TOTAL word
+    count (the levels multiply; lax.sort with thousands of operands
+    would blow up compile rather than fail cleanly)."""
+    def ok(t: DataType) -> bool:
+        if t.kind == TypeKind.ARRAY:
+            return t.max_elems <= 64 and ok(t.elem)
+        if t.kind == TypeKind.STRUCT:
+            return all(ok(f.dtype) for f in t.struct_fields)
+        if t.kind in (TypeKind.MAP, TypeKind.OPAQUE):
+            return False  # maps have no canonical entry order
+        return True
+
+    return ok(dtype) and _word_count(dtype) <= 128
+
+
 def _elem_sort_words(elem: Column, within) -> List[jnp.ndarray]:
     """Equality-preserving uint64 sort words along the element axis
     (dead slots first key = 1 so they sort last)."""
@@ -485,26 +560,9 @@ def _elem_sort_words(elem: Column, within) -> List[jnp.ndarray]:
             jnp.where(within, bits.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0))
         )
     elif elem.dtype.is_nested:
-        # ARRAY-of-primitive elements (set of lists): equality =
-        # (length, inner validity flags, zero-masked inner values).
-        # Deeper nesting/structs stay gated at agg_result_type.
-        inner = elem.children[0]
-        im = elem.dtype.max_elems
-        assert im <= 64, "nested collect_set: inner arity beyond flag word"
-        words.append(jnp.where(within, elem.lengths, 0).astype(jnp.uint64))
-        inner_live = (
-            jnp.arange(im)[None, None, :] < elem.lengths[:, :, None]
-        ) & within[:, :, None]
-        live_valid = inner_live & inner.validity
-        flags = jnp.zeros(within.shape, jnp.uint64)
-        for j in range(im):
-            flags = flags | (live_valid[:, :, j].astype(jnp.uint64) << jnp.uint64(j))
-        words.append(flags)
-        bits = (_canon_float_bits(inner.data) if inner.dtype.is_float
-                else inner.data)
-        bits = bits.astype(jnp.int64).view(jnp.uint64)
-        for j in range(im):
-            words.append(jnp.where(live_valid[:, :, j], bits[:, :, j], jnp.uint64(0)))
+        # nested elements (lists, lists-of-lists, lists-of-structs,
+        # lists-of-strings): recursive equality-word encoding
+        words.extend(_value_words(elem.dtype, elem, within))
     else:
         words.append(
             jnp.where(within, elem.data.astype(jnp.int64).view(jnp.uint64), jnp.uint64(0))
@@ -544,20 +602,29 @@ def _dedup_array_state(col: Column) -> Column:
         lengths = jnp.zeros((cap, m), jnp.int32).at[tgt, new_pos].set(g_len, mode="drop")
         out_elem = Column(elem_t, data, ev, lengths)
     elif elem_t.is_nested:
-        # ARRAY-of-primitive elements: permute + scatter the inner
-        # child alongside the per-element lengths/validity
-        inner = elem.children[0]
-        im = elem_t.max_elems
-        g_len = jnp.take_along_axis(elem.lengths, s_idx, axis=1)
-        g_inner = jnp.take_along_axis(inner.data, s_idx[:, :, None], axis=1)
-        g_ival = jnp.take_along_axis(inner.validity, s_idx[:, :, None], axis=1)
-        lengths = jnp.zeros((cap, m), jnp.int32).at[tgt, new_pos].set(g_len, mode="drop")
-        i_data = jnp.zeros((cap, m, im), inner.data.dtype).at[tgt, new_pos].set(
-            g_inner, mode="drop")
-        i_val = jnp.zeros((cap, m, im), jnp.bool_).at[tgt, new_pos].set(
-            g_ival, mode="drop")
-        out_inner = Column(elem_t.elem, i_data, i_val)
-        out_elem = Column(elem_t, None, ev, lengths, (out_inner,))
+        # nested elements: recursive permute (gather by s_idx along the
+        # element axis) + compacting scatter of every buffer level
+        def reorder(c: Column, valid_override=None) -> Column:
+            def move(a):
+                if a is None:
+                    return None
+                ix = s_idx
+                for _ in range(a.ndim - 2):
+                    ix = ix[..., None]
+                g = jnp.take_along_axis(a, ix, axis=1)
+                return jnp.zeros(a.shape, a.dtype).at[tgt, new_pos].set(
+                    g, mode="drop")
+
+            return Column(
+                c.dtype,
+                move(c.data),
+                move(c.validity) if valid_override is None else valid_override,
+                move(c.lengths),
+                None if c.children is None else tuple(
+                    reorder(ch) for ch in c.children),
+            )
+
+        out_elem = reorder(elem, valid_override=ev)
     else:
         g_data = jnp.take_along_axis(elem.data, s_idx, axis=1)
         data = jnp.zeros((cap, m), elem.data.dtype).at[tgt, new_pos].set(g_data, mode="drop")

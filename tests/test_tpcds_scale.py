@@ -18,6 +18,27 @@ from blaze_tpu.tpch.datagen import table_to_batches
 
 pytestmark = pytest.mark.slow
 
+_SINCE_CLEAR = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _clear_caches_every_few_tests():
+    """The scale tier compiles LARGE programs; jaxlib's CPU backend
+    segfaults once enough accumulate in one process (round-3 ceiling).
+    Clear every 3 tests — scale programs are far bigger than the
+    0.002-tier ones that clear every 10."""
+    yield
+    _SINCE_CLEAR["n"] += 1
+    if _SINCE_CLEAR["n"] % 3 == 0:
+        import jax
+
+        from blaze_tpu.ops.joins.broadcast import clear_join_map_cache
+        from blaze_tpu.runtime.kernel_cache import clear_kernel_cache
+
+        clear_kernel_cache()
+        clear_join_map_cache()
+        jax.clear_caches()
+
 SCALE = 0.05  # ~144k store_sales rows: the reference CI's 1 GB regime
 N_PARTS = 4
 BUDGET = 2 << 20  # bytes: far below the working set
@@ -137,3 +158,137 @@ def test_q27_scale_rollup(data, scans):
         assert key in exp, key
         ea1, ea2, ea3, ea4 = exp[key]
         assert abs(a1 - ea1) < 1e-9 and (a2, a3, a4) == (ea2, ea3, ea4), key
+
+
+def test_q14a_scale_intersect_rollup(data, scans):
+    """Cross-channel INTERSECT + scalar subquery + rollup at scale —
+    the heaviest CTE giant in the matrix."""
+    got, _ = run_capped(build_query("q14a", scans, N_PARTS))
+    exp = O.oracle_q14a(data)
+    assert exp, "q14a oracle empty at scale"
+    n = len(got["channel"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["channel"][i], got["i_brand_id"][i], got["i_class_id"][i],
+               got["i_category_id"][i])
+        assert key in exp, key
+        assert (got["sum_sales"][i], got["sum_number_sales"][i]) == exp[key], key
+
+
+def test_q23a_scale_best_customers(data, scans):
+    """Frequent-item x best-customer CTEs (scalar subquery HAVING) at
+    scale."""
+    got, _ = run_capped(build_query("q23a", scans, N_PARTS))
+    exp = O.oracle_q23a(data)
+    # at this scale the 0.5*max spend cut leaves an EMPTY May slice:
+    # the differential asserts the engine agrees it is a NULL sum (not
+    # 0, not a missing row) — the numeric case runs at the 0.002/0.01
+    # tiers (test_tpcds / test_spark_tpcds2)
+    assert got["sum_sales"] == [exp]
+
+
+def test_q64_scale_cross_year(data, scans):
+    """Returned-item self-join across two years at scale."""
+    plan = build_query("q64", scans, N_PARTS)
+    got, spills = run_capped(plan)
+    exp = O.oracle_q64(data)
+    assert exp, "q64 oracle empty at scale"
+    rows = {
+        (i, st, z): (c1, a, b, c, c2, d, e, f) for i, st, z, c1, a, b, c, c2, d, e, f in
+        zip(got["i_item_id"], got["s_store_name"], got["s_zip"], got["cnt"],
+            got["s1"], got["s2"], got["s3"], got["cnt2"], got["s1_2"],
+            got["s2_2"], got["s3_2"])
+    }
+    assert len(rows) == min(len(exp), 100)
+    if len(exp) <= 100:
+        assert rows == exp
+    else:
+        assert all(exp.get(k) == v for k, v in rows.items())
+    # (q64's year-sliced shuffles fit the cap; q67 carries the tier's
+    # must-spill assertion)
+
+
+def test_q72_scale_inventory(data, scans):
+    """Catalog x inventory under-stock join at scale (the widest
+    shuffle in the matrix: inventory is a full item x week cross)."""
+    got, _ = run_capped(build_query("q72", scans, N_PARTS))
+    exp = O.oracle_q72(data)
+    assert exp, "q72 oracle empty at scale"
+    rows = {
+        (d, w, wk): c for d, w, wk, c in
+        zip(got["i_item_desc"], got["w_warehouse_name"], got["d_week_seq"],
+            got["no_promo"])
+    }
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+
+
+def test_q75_scale_yoy(data, scans):
+    """Three-channel net-of-returns YoY at scale."""
+    got, _ = run_capped(build_query("q75", scans, N_PARTS))
+    exp = O.oracle_q75(data)
+    assert exp, "q75 oracle empty at scale"
+    rows = {
+        (b, c, cat, m): (cd, ad) for b, c, cat, m, cd, ad in
+        zip(got["i_brand_id"], got["i_class_id"], got["i_category_id"],
+            got["i_manufact_id"], got["sales_cnt_diff"], got["sales_amt_diff"])
+    }
+    assert len(rows) == min(len(exp), 100)
+    if len(exp) <= 100:
+        assert rows == exp
+    else:
+        assert all(exp.get(k) == v for k, v in rows.items())
+
+
+def test_q78_scale_loyalty(data, scans):
+    """Never-returned (item, customer) LEFT-join chain at scale."""
+    got, _ = run_capped(build_query("q78", scans, N_PARTS))
+    exp = O.oracle_q78(data)
+    assert exp, "q78 oracle empty at scale"
+    n = len(got["ss_item_sk"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["ss_item_sk"][i], got["ss_customer_sk"][i])
+        assert key in exp, key
+        q, w, sp, ratio, other = exp[key]
+        assert (got["ss_qty"][i], got["ss_wc"][i], got["ss_sp"][i]) == (q, w, sp), key
+        assert abs(got["ratio"][i] - ratio) < 1e-12, key
+
+
+def test_q36_scale_rollup_margin(data, scans):
+    """Gross-margin rollup + rank at scale."""
+    from test_tpcds import _check_rollup_margin
+
+    got, _ = run_capped(build_query("q36", scans, N_PARTS))
+    _check_rollup_margin(got, O.oracle_q36(data))
+
+
+def test_q47_scale_window_yoy(data, scans):
+    """lag/lead window YoY at scale."""
+    from test_tpcds import _check_yoy
+
+    got, _ = run_capped(build_query("q47", scans, N_PARTS))
+    _check_yoy(got, O.oracle_q47(data), ("s_store_name", "s_company_name"))
+
+
+def test_q70_scale_geo_rollup(data, scans):
+    """Store-geography rollup (ranked-state semi-join) at scale."""
+    got, _ = run_capped(build_query("q70", scans, N_PARTS))
+    exp = O.oracle_q70(data)
+    assert got["lochierarchy"], "q70 returned no rows at scale"
+    for st, co, loch, total, rank in zip(
+        got["s_state"], got["s_county"], got["lochierarchy"],
+        got["total_sum"], got["rank_within_parent"],
+    ):
+        key = (st, co, loch)
+        assert key in exp, key
+        assert (total, rank) == exp[key], key
+
+
+def test_q97_scale_full_outer(data, scans):
+    """FULL OUTER distinct-pair overlap at scale."""
+    got, _ = run_capped(build_query("q97", scans, N_PARTS))
+    so, co, both = O.oracle_q97(data)
+    assert (got["store_only"], got["catalog_only"],
+            got["store_and_catalog"]) == ([so], [co], [both])
