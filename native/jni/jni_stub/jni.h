@@ -1,0 +1,151 @@
+// Minimal JNI declarations, written from the public JNI specification
+// (Java Native Interface 6.0, function-table layout unchanged since
+// JNI 1.2).  This is NOT Oracle's jni.h: it declares only the subset
+// of types and JNIEnv slots blaze_jni.cc uses, but places every slot
+// at its spec-mandated table index so code compiled against this
+// header is binary-compatible with a real JVM's function table.
+//
+// Purpose (round-4 verdict item #6): the build image carries no JDK,
+// which left the JNI shims permanently uncompiled and untested.  With
+// this header the shims compile on the bare image, and
+// tests/jni_gateway_test.cc drives them end to end against a fake
+// JNINativeInterface_ table standing in for the JVM.
+#ifndef BLAZE_TPU_JNI_STUB_H
+#define BLAZE_TPU_JNI_STUB_H
+
+#include <cstdarg>
+#include <cstdint>
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNICALL
+#define JNI_VERSION_1_8 0x00010008
+
+#define JNI_FALSE 0
+#define JNI_TRUE 1
+
+// release modes for Get/Release<PrimitiveType>ArrayElements
+#define JNI_COMMIT 1
+#define JNI_ABORT 2
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef int8_t jbyte;
+typedef uint8_t jboolean;
+typedef uint16_t jchar;
+typedef int16_t jshort;
+typedef float jfloat;
+typedef double jdouble;
+typedef jint jsize;
+
+struct _jobject;
+typedef _jobject* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jarray jbyteArray;
+typedef jobject jthrowable;
+
+struct _jmethodID;
+typedef _jmethodID* jmethodID;
+struct _jfieldID;
+typedef _jfieldID* jfieldID;
+
+struct JNIEnv_;
+typedef JNIEnv_ JNIEnv;
+
+// Function table: slot indices per the JNI spec (comments give the
+// index).  Unused slots are void* padding so used slots land at the
+// exact ABI offsets.
+struct JNINativeInterface_ {
+  void* reserved0;                                           // 0
+  void* reserved1;                                           // 1
+  void* reserved2;                                           // 2
+  void* reserved3;                                           // 3
+  void* pad4_5[2];                                           // 4-5
+  jclass(JNICALL* FindClass)(JNIEnv*, const char*);          // 6
+  void* pad7_13[7];                                          // 7-13
+  jint(JNICALL* ThrowNew)(JNIEnv*, jclass, const char*);     // 14
+  void* pad15_20[6];                                         // 15-20
+  jobject(JNICALL* NewGlobalRef)(JNIEnv*, jobject);          // 21
+  void(JNICALL* DeleteGlobalRef)(JNIEnv*, jobject);          // 22
+  void* pad23_30[8];                                         // 23-30
+  jclass(JNICALL* GetObjectClass)(JNIEnv*, jobject);         // 31
+  void* pad32[1];                                            // 32
+  jmethodID(JNICALL* GetMethodID)(JNIEnv*, jclass, const char*,
+                                  const char*);              // 33
+  void* pad34[1];                                            // 34
+  jobject(JNICALL* CallObjectMethodV)(JNIEnv*, jobject, jmethodID,
+                                      va_list);              // 35
+  void* pad36_61[26];                                        // 36-61
+  void(JNICALL* CallVoidMethodV)(JNIEnv*, jobject, jmethodID,
+                                 va_list);                   // 62
+  void* pad63_166[104];                                      // 63-166
+  jstring(JNICALL* NewStringUTF)(JNIEnv*, const char*);      // 167
+  void* pad168_170[3];                                       // 168-170
+  jsize(JNICALL* GetArrayLength)(JNIEnv*, jarray);           // 171
+  void* pad172_183[12];                                      // 172-183
+  jbyte*(JNICALL* GetByteArrayElements)(JNIEnv*, jbyteArray,
+                                        jboolean*);          // 184
+  void* pad185_191[7];                                       // 185-191
+  void(JNICALL* ReleaseByteArrayElements)(JNIEnv*, jbyteArray, jbyte*,
+                                          jint);             // 192
+  void* pad193_227[35];                                      // 193-227
+  jboolean(JNICALL* ExceptionCheck)(JNIEnv*);                // 228
+  void* pad229_232[4];                                       // 229-232
+};
+
+// C++ JNIEnv: a pointer to the table plus inline forwarders (the
+// variadic members forward to the *V slots, exactly as Oracle's C++
+// header does).
+struct JNIEnv_ {
+  const JNINativeInterface_* functions;
+
+  jclass FindClass(const char* name) {
+    return functions->FindClass(this, name);
+  }
+  jint ThrowNew(jclass cls, const char* msg) {
+    return functions->ThrowNew(this, cls, msg);
+  }
+  jobject NewGlobalRef(jobject o) { return functions->NewGlobalRef(this, o); }
+  void DeleteGlobalRef(jobject o) { functions->DeleteGlobalRef(this, o); }
+  jclass GetObjectClass(jobject o) {
+    return functions->GetObjectClass(this, o);
+  }
+  jmethodID GetMethodID(jclass c, const char* n, const char* sig) {
+    return functions->GetMethodID(this, c, n, sig);
+  }
+  jobject CallObjectMethod(jobject o, jmethodID m, ...) {
+    va_list args;
+    va_start(args, m);
+    jobject r = functions->CallObjectMethodV(this, o, m, args);
+    va_end(args);
+    return r;
+  }
+  void CallVoidMethod(jobject o, jmethodID m, ...) {
+    va_list args;
+    va_start(args, m);
+    functions->CallVoidMethodV(this, o, m, args);
+    va_end(args);
+  }
+  jstring NewStringUTF(const char* s) {
+    return functions->NewStringUTF(this, s);
+  }
+  jsize GetArrayLength(jarray a) { return functions->GetArrayLength(this, a); }
+  jbyte* GetByteArrayElements(jbyteArray a, jboolean* copied) {
+    return functions->GetByteArrayElements(this, a, copied);
+  }
+  void ReleaseByteArrayElements(jbyteArray a, jbyte* e, jint mode) {
+    functions->ReleaseByteArrayElements(this, a, e, mode);
+  }
+  jboolean ExceptionCheck() { return functions->ExceptionCheck(this); }
+};
+
+// Invocation API: blaze_jni.cc only stores the pointer from
+// JNI_OnLoad, so an opaque struct suffices.
+struct JNIInvokeInterface_;
+struct JavaVM_ {
+  const JNIInvokeInterface_* functions;
+};
+typedef JavaVM_ JavaVM;
+
+#endif  // BLAZE_TPU_JNI_STUB_H
