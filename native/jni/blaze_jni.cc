@@ -15,9 +15,11 @@
 // by native/tests/gateway_test.cc and tests/test_gateway.py without a
 // JVM.  This file only adapts JNI types to that surface.
 //
-// Build: requires jni.h (JDK); gated in CMakeLists.  The driver image
-// carries no JDK, so these shims compile on deployment images only —
-// the logic they wrap is tested here regardless.
+// Build: compiles against a real JDK's jni.h when one is found, else
+// against the vendored spec-layout header (jni/jni_stub/jni.h) — so
+// the shims build on the bare image too, and
+// tests/jni_gateway_test.cc executes them against a fake JVM function
+// table (ctest `jni_gateway`).
 
 #include <jni.h>
 #include <Python.h>

@@ -115,11 +115,18 @@ int32_t bt_arrow_import_string(const struct ArrowSchema* schema,
 // ---- JDK-free gateway core (≙ blaze/src/exec.rs:46-142 + rt.rs:57-215) ----
 // The JNI shims and the test harnesses both drive THIS surface; the
 // "JVM" is whatever registers the callbacks.
+// The gateway FFI batch layout import_batch receives the address of
+// (mirrors blaze_tpu.gateway._FfiBatch — the ONE definition consumers
+// should use)
+typedef struct {
+  int64_t n_cols;
+  struct ArrowSchema* schemas;
+  struct ArrowArray* arrays;
+} bt_ffi_batch;
+
 typedef struct {
   void* user;
-  // receives the address of a gateway FFI batch struct
-  // {int64 n_cols; ArrowSchema* schemas; ArrowArray* arrays}
-  // (blaze_tpu.gateway._FfiBatch) — ≙ wrapper.importBatch(ffiPtr)
+  // receives the address of a bt_ffi_batch — ≙ wrapper.importBatch(ffiPtr)
   void (*import_batch)(void* user, uintptr_t ffi_batch_addr);
   void (*set_error)(void* user, const char* msg);  // ≙ wrapper.setError
 } bt_gateway_callbacks;

@@ -24,13 +24,6 @@
 
 #include "blaze_native.h"
 
-// mirrors blaze_tpu.gateway._FfiBatch
-struct FfiBatch {
-  int64_t n_cols;
-  struct ArrowSchema* schemas;
-  struct ArrowArray* arrays;
-};
-
 struct Captured {
   std::vector<int64_t> y;
   std::vector<uint8_t> y_valid;
@@ -41,7 +34,7 @@ struct Captured {
 
 static void on_import(void* user, uintptr_t addr) {
   auto* cap = (Captured*)user;
-  auto* fb = (FfiBatch*)addr;
+  auto* fb = (bt_ffi_batch*)addr;
   assert(fb->n_cols == 2);
   int64_t n = fb->arrays[0].length;
 

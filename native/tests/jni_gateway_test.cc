@@ -38,13 +38,6 @@ jboolean Java_org_blaze_1tpu_JniBridge_nextBatch(JNIEnv*, jclass, jlong);
 void Java_org_blaze_1tpu_JniBridge_finalizeNative(JNIEnv*, jclass, jlong);
 }
 
-// mirrors blaze_tpu.gateway._FfiBatch
-struct FfiBatch {
-  int64_t n_cols;
-  struct ArrowSchema* schemas;
-  struct ArrowArray* arrays;
-};
-
 // ---- the "JVM": one wrapper object + method handles ----------------------
 
 struct FakeWrapper {
@@ -104,7 +97,7 @@ static jobject fake_CallObjectMethodV(JNIEnv*, jobject, jmethodID m,
 }
 
 static void import_batch(FakeWrapper* w, uintptr_t addr) {
-  auto* fb = (FfiBatch*)addr;
+  auto* fb = (bt_ffi_batch*)addr;
   assert(fb->n_cols == 2);
   int64_t n = fb->arrays[0].length;
 

@@ -1,0 +1,66 @@
+"""The bench driver-line contract: the ONE stdout JSON line must fit
+the driver's 2000-char stdout tail (round-4 postmortem: embedded
+probe/watchdog logs pushed the metric head off the capture and
+BENCH_r04 parsed null)."""
+
+import contextlib
+import io
+import json
+import sys
+
+sys.path.insert(0, ".")  # repo root: bench.py is not a package member
+
+import bench
+
+
+def _emit_line(result, probe_log, wd_log):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench._emit(dict(result), probe_log, wd_log)
+    return buf.getvalue().strip()
+
+
+BASE = {"metric": "tpch_q06_rows_per_sec_per_chip", "value": 1.0,
+        "unit": "rows/s", "vs_baseline": 0.1}
+
+
+def test_line_always_fits_driver_tail():
+    huge_probes = [{"t": f"2026-07-31T{i % 24:02d}:00:00Z", "ok": False}
+                   for i in range(500)]
+    huge_wd = [{"t": "t", "event": "probe", "ok": False}] * 2000
+    line = _emit_line(dict(BASE, note="x" * 3000), huge_probes, huge_wd)
+    assert len(line) < 1500
+    d = json.loads(line)
+    assert d["metric"] == BASE["metric"] and d["value"] == 1.0
+
+
+def test_summary_counts_only_probe_events():
+    s = bench._log_summary([
+        {"t": "a", "event": "probe", "ok": True},
+        {"t": "b", "event": "measuring"},
+        {"t": "c", "event": "measure", "rc": 0},
+        {"t": "d", "event": "probe", "ok": False},
+    ])
+    assert s == {"probes": 2, "ok": 1, "first": "a", "last": "d",
+                 "last_ok": "a"}
+
+
+def test_summary_empty():
+    assert bench._log_summary([]) == {"probes": 0, "ok": 0}
+
+
+def test_tpu_env_scrubs_only_cpu_forcing_values(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setenv("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8 "
+                       "--xla_dump_to=/tmp/d")
+    env = bench._tpu_env()
+    # the REAL axon env must pass through (popping it blinds probes)
+    assert env["JAX_PLATFORMS"] == "axon"
+    assert env["PALLAS_AXON_POOL_IPS"] == "127.0.0.1"
+    assert env["XLA_FLAGS"] == "--xla_dump_to=/tmp/d"
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
+    env = bench._tpu_env()
+    assert "JAX_PLATFORMS" not in env and "PALLAS_AXON_POOL_IPS" not in env
