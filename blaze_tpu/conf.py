@@ -95,6 +95,13 @@ AGG_HASH_SORT_PARTIAL = ConfEntry("spark.blaze.tpu.aggHashSortPartial", True, _b
 # shuffle remains the cross-process / spill path (turn this off to
 # force it, e.g. when a stage's output exceeds HBM).
 EXCHANGE_IN_PROCESS = ConfEntry("spark.blaze.exchange.inProcess", True, _bool)
+# AQE-style dynamic join selection in the stage scheduler (the
+# reference inherits this from Spark AQE): off by default — the
+# scheduler re-plans shuffle joins as broadcast joins when a side's
+# materialized map output is under the threshold
+ADAPTIVE_JOIN_ENABLE = ConfEntry("spark.blaze.enable.adaptiveJoin", False, _bool)
+ADAPTIVE_BROADCAST_THRESHOLD = ConfEntry(
+    "spark.blaze.adaptiveBroadcastThreshold", 10 << 20, int)
 DEVICE_MEMORY_BUDGET = ConfEntry("spark.blaze.tpu.hbmBudget", 8 << 30, int)
 HOST_SPILL_BUDGET = ConfEntry("spark.blaze.tpu.hostSpillBudget", 4 << 30, int)
 MIN_CAPACITY = ConfEntry("spark.blaze.tpu.minBatchCapacity", 1024, int)

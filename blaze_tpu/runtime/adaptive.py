@@ -1,0 +1,182 @@
+"""AQE-style dynamic join selection for the stage scheduler.
+
+≙ the adaptive half the reference inherits from Spark: its ByteBuddy
+interceptors let converted stages live inside AdaptiveSparkPlan, and
+Spark's AQE re-plans a sort-merge/shuffled-hash join as a broadcast
+join when a side's materialized shuffle output turns out small
+(`spark.sql.adaptive.autoBroadcastJoinThreshold`).  Here the stage
+scheduler IS the Spark side, so the same decision runs against the
+LocalShuffleManager's materialized map outputs:
+
+    after the map stages of a join's inputs finish, if one side's
+    total shuffle bytes <= spark.blaze.adaptiveBroadcastThreshold and
+    the join type can build on that side, the reduce-stage plan is
+    rewritten in place: the small side's shuffle reader is re-pointed
+    at ALL of its map outputs (registered replicated, like a broadcast
+    collect) and the join becomes a BroadcastJoinExec; the large side
+    keeps reading its own hash partitions (Spark's "local shuffle
+    reader" — its distribution is unchanged, so downstream
+    co-partitioned aggs stay correct).
+
+Opt-in via spark.blaze.enable.adaptiveJoin (default off)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .. import conf
+from ..ops import ExecNode
+from ..ops.joins import BroadcastJoinExec, HashJoinExec, JoinType, SortMergeJoinExec
+from ..ops.sort import SortExec
+from ..parallel.shuffle import IpcReaderExec, LocalShuffleManager
+
+
+def _shuffle_leaf(node: ExecNode) -> Optional[IpcReaderExec]:
+    """The shuffle reader a join side bottoms out in, looking through
+    the SMJ's sort only — the two shapes the stage splitter emits."""
+    if isinstance(node, SortExec):
+        node = node.children[0]
+    if isinstance(node, IpcReaderExec) and node.resource_id.startswith("shuffle_"):
+        return node
+    return None
+
+
+def _sides(j: ExecNode) -> Tuple[ExecNode, ExecNode, list, list]:
+    """(logical_left, logical_right, left_keys, right_keys)."""
+    if isinstance(j, SortMergeJoinExec):
+        return j.children[0], j.children[1], j.left_keys, j.right_keys
+    assert isinstance(j, HashJoinExec)
+    build, probe = j.children[0], j.children[1]
+    if j.build_is_left:
+        return build, probe, j.build_keys, j.probe_keys
+    return probe, build, j.probe_keys, j.build_keys
+
+
+# Spark's canBuildLeft/canBuildRight: which side may become the
+# broadcast build without changing join semantics
+_BUILD_RIGHT = (JoinType.INNER, JoinType.LEFT, JoinType.LEFT_SEMI,
+                JoinType.LEFT_ANTI)
+_BUILD_LEFT = (JoinType.INNER, JoinType.RIGHT)
+
+
+def apply_adaptive_joins(
+    plan: ExecNode,
+    manager: LocalShuffleManager,
+    n_maps: Dict[int, int],
+    bcast_blocks: Dict[int, list],
+    next_bid: List[int],
+) -> List[dict]:
+    """Rewrite qualifying joins among ``plan``'s DESCENDANTS (parents
+    mutate in place — pass a wrapper to make a root join swappable);
+    registers each swapped side's full map outputs under a fresh
+    broadcast id in ``bcast_blocks``.  Returns one report dict per
+    swap (for metrics/tests)."""
+    threshold = int(conf.ADAPTIVE_BROADCAST_THRESHOLD.get())
+    swaps: List[dict] = []
+
+    def total_bytes(sid: int) -> int:
+        tot = 0
+        for m in range(n_maps.get(sid, 0)):
+            data, _ = manager.map_output_paths(sid, m)
+            if os.path.exists(data):
+                tot += os.path.getsize(data)
+        return tot
+
+    def full_blocks(sid: int) -> list:
+        blocks = []
+        for m in range(n_maps.get(sid, 0)):
+            data, _ = manager.map_output_paths(sid, m)
+            if os.path.exists(data):
+                size = os.path.getsize(data)
+                if size:
+                    blocks.append((data, 0, size))
+        return blocks
+
+    def _drop_smj_sort(other: ExecNode, okeys) -> ExecNode:
+        """The probe side keeps order only the SMJ needed: drop its
+        SortExec when it sorts exactly by the join keys (the shape the
+        stage splitter emits for SMJ inputs — ordering-sensitive
+        consumers above a join carry their own SortExec in this
+        codebase)."""
+        from ..exprs.ir import Col
+
+        if not isinstance(other, SortExec):
+            return other
+        fields = other.fields
+        if len(fields) != len(okeys):
+            return other
+        for f, k in zip(fields, okeys):
+            if not (isinstance(f.expr, Col) and isinstance(k, Col)
+                    and f.expr.name == k.name and f.ascending):
+                return other
+        return other.children[0]
+
+    def try_swap(j: ExecNode) -> Optional[ExecNode]:
+        if not isinstance(j, (HashJoinExec, SortMergeJoinExec)):
+            return None
+        left, right, lkeys, rkeys = _sides(j)
+        jt = j.join_type
+        candidates = []
+        if jt in _BUILD_RIGHT:
+            candidates.append(("right", right, rkeys, left, lkeys))
+        if jt in _BUILD_LEFT:
+            candidates.append(("left", left, lkeys, right, rkeys))
+        # measure every eligible side and broadcast the SMALLEST
+        # (Spark AQE picks min(canBuild sides), not the first)
+        measured = []
+        for side_name, small, skeys, other, okeys in candidates:
+            leaf = _shuffle_leaf(small)
+            if leaf is None:
+                continue
+            sid = int(leaf.resource_id.split("_")[1])
+            if sid not in n_maps:
+                continue  # producing map stage not materialized yet
+            size = total_bytes(sid)
+            if size > threshold:
+                continue
+            measured.append((size, side_name, skeys, other, okeys, sid, leaf))
+        if not measured:
+            return None
+        size, side_name, skeys, other, okeys, sid, leaf = min(
+            measured, key=lambda m: m[0])
+        if isinstance(j, SortMergeJoinExec):
+            other = _drop_smj_sort(other, okeys)
+        bid = next_bid[0]
+        next_bid[0] += 1
+        bcast_blocks[bid] = full_blocks(sid)
+        build = IpcReaderExec(leaf.schema, f"broadcast_{bid}", 1)
+        out = BroadcastJoinExec(
+            build, other, skeys, okeys, jt,
+            build_is_left=(side_name == "left"),
+        )
+        # per-manager cached build, same contract as split_stages
+        out.cached_build_id = f"sched_bcast_{id(manager)}_adaptive_{bid}"
+        swaps.append({
+            "shuffle_id": sid, "bytes": size, "broadcast_id": bid,
+            "side": side_name, "join": type(j).__name__,
+        })
+        return out
+
+    def walk(node: ExecNode) -> None:
+        for i, c in enumerate(list(node.children)):
+            walk(c)
+            repl = try_swap(c)
+            if repl is not None:
+                node.children[i] = repl
+
+    walk(plan)
+    return swaps
+
+
+def maybe_rewrite_stage(stage, manager, n_maps, bcast_blocks, next_bid):
+    """run_stages hook: apply the rewrite to one stage's plan when the
+    flag is on; returns the swap reports."""
+    if not bool(conf.ADAPTIVE_JOIN_ENABLE.get()):
+        return []
+    from .scheduler import _StageRoot
+
+    root = _StageRoot(stage.plan)
+    swaps = apply_adaptive_joins(root, manager, n_maps, bcast_blocks, next_bid)
+    stage.plan = root.children[0]
+    return swaps

@@ -21,6 +21,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from .. import conf
 from ..ops import ExecNode
 from ..parallel.exchange import NativeShuffleExchangeExec
 from ..parallel.shuffle import IpcReaderExec, LocalShuffleManager, ShuffleWriterExec
@@ -272,7 +273,21 @@ def run_stages(
 
     from ..serde.to_proto import STAGED_RIDS
 
+    # AQE-style dynamic join selection (runtime/adaptive.py, opt-in):
+    # adaptive broadcast ids start after the planner-assigned ones
+    adaptive_on = bool(conf.ADAPTIVE_JOIN_ENABLE.get())
+    if adaptive_on:
+        from .adaptive import maybe_rewrite_stage
+
+        next_adaptive_bid = [
+            max((s.broadcast_id for s in stages
+                 if s.broadcast_id is not None), default=-1) + 1
+        ]
+
     for stage in stages:
+        if adaptive_on:
+            maybe_rewrite_stage(stage, manager, n_maps, bcast_blobs,
+                                next_adaptive_bid)
         readers = ipc_readers(stage.plan, "shuffle_")
         breaders = ipc_readers(stage.plan, "broadcast_")
 

@@ -115,9 +115,8 @@ int32_t bt_arrow_import_string(const struct ArrowSchema* schema,
 // ---- JDK-free gateway core (≙ blaze/src/exec.rs:46-142 + rt.rs:57-215) ----
 // The JNI shims and the test harnesses both drive THIS surface; the
 // "JVM" is whatever registers the callbacks.
-// The gateway FFI batch layout import_batch receives the address of
-// (mirrors blaze_tpu.gateway._FfiBatch — the ONE definition consumers
-// should use)
+// The gateway FFI batch layout (mirrors blaze_tpu.gateway._FfiBatch
+// — the ONE definition consumers should use)
 typedef struct {
   int64_t n_cols;
   struct ArrowSchema* schemas;

@@ -1,0 +1,159 @@
+"""AQE-style dynamic join selection (runtime/adaptive.py, opt-in):
+a shuffle join whose one side materializes small re-plans as a
+broadcast join mid-schedule — result equality against the unrewritten
+run is the differential, plan inspection proves the swap happened."""
+
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.exprs import col
+from blaze_tpu.ops import ExecNode, MemoryScanExec
+from blaze_tpu.ops.joins import BroadcastJoinExec, JoinType
+from blaze_tpu.runtime.scheduler import run_stages, split_stages
+from blaze_tpu.schema import DataType, Field, Schema
+from blaze_tpu.tpch.queries import shuffle_join
+
+N_PARTS = 4
+
+
+def _tables():
+    big_schema = Schema([Field("k", DataType.int64()),
+                         Field("v", DataType.int64())])
+    small_schema = Schema([Field("sk", DataType.int64()),
+                           Field("name", DataType.string(8))])
+    big = {"k": [i % 17 for i in range(400)], "v": list(range(400))}
+    small = {"sk": list(range(17)), "name": [f"n{i}" for i in range(17)]}
+
+    def scan(data, schema):
+        rows = len(next(iter(data.values())))
+        per = -(-rows // N_PARTS)
+        parts = [
+            [batch_from_pydict({k: v[p * per:(p + 1) * per]
+                                for k, v in data.items()}, schema)]
+            for p in range(N_PARTS)
+        ]
+        return MemoryScanExec(parts, schema)
+
+    return scan(big, big_schema), scan(small, small_schema)
+
+
+def _collect(stages, manager):
+    out = {}
+    for b in run_stages(stages, manager):
+        d = batch_to_pydict(b)
+        for k, v in d.items():
+            out.setdefault(k, []).extend(v)
+    return out
+
+
+def _rows(out):
+    return sorted(zip(*out.values())) if out else []
+
+
+def _has_broadcast_join(stages):
+    found = []
+
+    def walk(n: ExecNode):
+        if isinstance(n, BroadcastJoinExec):
+            found.append(n)
+        for c in n.children:
+            walk(c)
+
+    for s in stages:
+        walk(s.plan)
+    return bool(found)
+
+
+def _run(jt, build_left, *, enable, threshold=10 << 20):
+    big, small = _tables()
+    if build_left:
+        plan = shuffle_join(small, big, [col("sk")], [col("k")], jt,
+                            N_PARTS, build_left=True)
+    else:
+        plan = shuffle_join(big, small, [col("k")], [col("sk")], jt,
+                            N_PARTS, build_left=False)
+    stages, manager = split_stages(plan)
+    old_e = conf.ADAPTIVE_JOIN_ENABLE.get()
+    old_t = conf.ADAPTIVE_BROADCAST_THRESHOLD.get()
+    conf.ADAPTIVE_JOIN_ENABLE.set(enable)
+    conf.ADAPTIVE_BROADCAST_THRESHOLD.set(threshold)
+    try:
+        out = _collect(stages, manager)
+    finally:
+        conf.ADAPTIVE_JOIN_ENABLE.set(old_e)
+        conf.ADAPTIVE_BROADCAST_THRESHOLD.set(old_t)
+    return out, stages
+
+
+def test_inner_join_swaps_and_matches():
+    base, base_stages = _run(JoinType.INNER, build_left=False, enable=False)
+    assert not _has_broadcast_join(base_stages)
+    got, stages = _run(JoinType.INNER, build_left=False, enable=True)
+    assert _has_broadcast_join(stages), "small side should have swapped"
+    assert _rows(got) == _rows(base)
+    assert len(_rows(got)) == 400
+
+
+def test_left_join_swaps_small_right_side():
+    base, _ = _run(JoinType.LEFT, build_left=False, enable=False)
+    got, stages = _run(JoinType.LEFT, build_left=False, enable=True)
+    assert _has_broadcast_join(stages)
+    assert _rows(got) == _rows(base)
+
+
+def test_full_join_never_swaps():
+    got, stages = _run(JoinType.FULL, build_left=False, enable=True)
+    base, _ = _run(JoinType.FULL, build_left=False, enable=False)
+    assert not _has_broadcast_join(stages)
+    assert _rows(got) == _rows(base)
+
+
+def test_threshold_zero_disables_swap():
+    got, stages = _run(JoinType.INNER, build_left=False, enable=True,
+                       threshold=0)
+    assert not _has_broadcast_join(stages)
+    assert len(_rows(got)) == 400
+
+
+def test_flag_off_is_default():
+    _, stages = _run(JoinType.INNER, build_left=False, enable=False)
+    assert not _has_broadcast_join(stages)
+
+
+def test_smj_swaps_and_drops_sort():
+    from blaze_tpu.ops import SortField, SortExec
+    from blaze_tpu.ops.joins import SortMergeJoinExec
+    from blaze_tpu.parallel import HashPartitioning, NativeShuffleExchangeExec
+
+    big, small = _tables()
+    lex = NativeShuffleExchangeExec(big, HashPartitioning([col("k")], N_PARTS))
+    rex = NativeShuffleExchangeExec(small,
+                                    HashPartitioning([col("sk")], N_PARTS))
+    smj = SortMergeJoinExec(
+        SortExec(lex, [SortField(col("k"))]),
+        SortExec(rex, [SortField(col("sk"))]),
+        [col("k")], [col("sk")], JoinType.INNER,
+    )
+    base_stages, base_mgr = split_stages(smj)
+    base = _collect(base_stages, base_mgr)
+
+    big2, small2 = _tables()
+    lex2 = NativeShuffleExchangeExec(big2, HashPartitioning([col("k")], N_PARTS))
+    rex2 = NativeShuffleExchangeExec(small2,
+                                     HashPartitioning([col("sk")], N_PARTS))
+    smj2 = SortMergeJoinExec(
+        SortExec(lex2, [SortField(col("k"))]),
+        SortExec(rex2, [SortField(col("sk"))]),
+        [col("k")], [col("sk")], JoinType.INNER,
+    )
+    stages, manager = split_stages(smj2)
+    old = conf.ADAPTIVE_JOIN_ENABLE.get()
+    conf.ADAPTIVE_JOIN_ENABLE.set(True)
+    try:
+        got = _collect(stages, manager)
+    finally:
+        conf.ADAPTIVE_JOIN_ENABLE.set(old)
+    assert _has_broadcast_join(stages), "SMJ should re-plan as broadcast"
+    assert sorted(map(tuple, zip(*got.values()))) == sorted(
+        map(tuple, zip(*base.values())))

@@ -6,9 +6,11 @@ BENCH_r04 parsed null)."""
 import contextlib
 import io
 import json
+import os
 import sys
 
-sys.path.insert(0, ".")  # repo root: bench.py is not a package member
+# repo root (cwd-independent): bench.py is not a package member
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench
 
