@@ -22,10 +22,14 @@ public ORC v1 spec (no pyorc/pyarrow in the image):
   Stripe statistics drive predicate pruning (the stripe granularity
   of the reference's ORC scan pushdown).
 
-Compound types: LIST of primitive reads (LENGTH stream + child
-PRESENT/DATA, rectangularized to the declared max_elems).
-Unsupported (gated, not silently wrong): maps, structs,
-nested-of-nested, lists in the writer.
+Compound types: LIST of primitive reads keep a vectorized fast path
+(LENGTH stream + child PRESENT/DATA, rectangularized to the declared
+max_elems); MAP/STRUCT/nested LIST read through a recursive
+python-value decoder.  The writer mirrors the full set: flat columns
+and LIST-of-primitive via numpy tuples, and MAP/STRUCT/nested LIST
+fields as plain python value lists (the same shape the reader's
+compound path returns) through a recursive encoder.  Remaining gates
+(not silently wrong): TIMESTAMP inside compound values, BINARY.
 """
 
 from __future__ import annotations
@@ -576,6 +580,109 @@ def _encode_list_column(
     return streams
 
 
+def _type_size(dt: DataType) -> int:
+    """Number of preorder type-tree slots this type consumes."""
+    if dt.kind == TypeKind.ARRAY:
+        return 1 + _type_size(dt.elem)
+    if dt.kind == TypeKind.MAP:
+        return 1 + _type_size(dt.key) + _type_size(dt.value)
+    if dt.kind == TypeKind.STRUCT:
+        return 1 + sum(_type_size(f.dtype) for f in dt.struct_fields)
+    return 1
+
+
+def _is_compound(dt: DataType) -> bool:
+    """Columns that take the recursive python-value path, on BOTH the
+    writer and reader sides (one predicate so they can never
+    disagree on dispatch): maps, structs, and lists whose elements
+    are nested or strings (flat lists keep the vectorized path)."""
+    return dt.kind in (TypeKind.MAP, TypeKind.STRUCT) or (
+        dt.kind == TypeKind.ARRAY and (dt.elem.is_nested or dt.elem.is_string)
+    )
+
+
+def _encode_pyvalues(
+    col_id: int, dtype: DataType, vals: list,
+    counts: Dict[int, Tuple[int, bool]],
+) -> List[_Stream]:
+    """Recursive encoder for compound columns fed as python values —
+    the exact shape the reader's compound path (`decode_nested`)
+    produces: None for null, list per ARRAY slot, dict per MAP/STRUCT
+    slot.  Mirrors the reader's conventions: PRESENT per nesting
+    level, children carry one entry per non-null parent slot (per
+    element for LIST/MAP)."""
+    streams: List[_Stream] = []
+    validity = np.array([v is not None for v in vals], bool)
+    live = [v for v in vals if v is not None]
+    counts[col_id] = (len(live), len(live) < len(vals))
+    if not bool(validity.all()):
+        streams.append(_Stream(S_PRESENT, col_id, _bool_encode(validity)))
+    k = dtype.kind
+    if k == TypeKind.ARRAY:
+        ln = np.array([len(v) for v in live], np.int64)
+        streams.append(_Stream(S_LENGTH, col_id, _rlev1_encode(ln, signed=False)))
+        streams.extend(_encode_pyvalues(
+            col_id + 1, dtype.elem, [e for v in live for e in v], counts))
+        return streams
+    if k == TypeKind.MAP:
+        ln = np.array([len(v) for v in live], np.int64)
+        streams.append(_Stream(S_LENGTH, col_id, _rlev1_encode(ln, signed=False)))
+        streams.extend(_encode_pyvalues(
+            col_id + 1, dtype.key, [e for v in live for e in v.keys()], counts))
+        streams.extend(_encode_pyvalues(
+            col_id + 1 + _type_size(dtype.key), dtype.value,
+            [e for v in live for e in v.values()], counts))
+        return streams
+    if k == TypeKind.STRUCT:
+        sub = col_id + 1
+        for f in dtype.struct_fields:
+            streams.extend(_encode_pyvalues(
+                sub, f.dtype, [v[f.name] for v in live], counts))
+            sub += _type_size(f.dtype)
+        return streams
+    if dtype.is_string:
+        bodies = [s.encode() if isinstance(s, str) else bytes(s) for s in live]
+        streams.append(_Stream(S_LENGTH, col_id, _rlev1_encode(
+            np.array([len(b) for b in bodies], np.int64), signed=False)))
+        streams.append(_Stream(S_DATA, col_id, b"".join(bodies)))
+        return streams
+    if k == TypeKind.BOOL:
+        streams.append(_Stream(S_DATA, col_id, _bool_encode(
+            np.array([bool(v) for v in live], bool))))
+        return streams
+    if k == TypeKind.DECIMAL:
+        import decimal as _dec
+
+        body = bytearray()
+        for v in live:
+            scaled = _dec.Decimal(v).scaleb(dtype.scale)
+            if scaled != scaled.to_integral_value():
+                # same gate as the reader's _rescale_decimals: a value
+                # with more fractional digits than the declared scale
+                # cannot be represented exactly — never truncate
+                raise NotImplementedError(
+                    f"ORC subset: decimal value {v} exceeds the "
+                    f"declared scale {dtype.scale}")
+            body += _uvarint(_zz(int(scaled)))
+        streams.append(_Stream(S_DATA, col_id, bytes(body)))
+        streams.append(_Stream(S_SECONDARY, col_id, _rlev1_encode(
+            np.full(len(live), dtype.scale, np.int64), signed=True)))
+        return streams
+    if k == TypeKind.INT8:
+        streams.append(_Stream(S_DATA, col_id, _byte_rle_encode(
+            np.array(live, np.int8).tobytes())))
+        return streams
+    if k in (TypeKind.INT16, TypeKind.INT32, TypeKind.INT64, TypeKind.DATE32):
+        streams.append(_Stream(S_DATA, col_id, _rlev1_encode(
+            np.array([int(v) for v in live], np.int64), signed=True)))
+        return streams
+    if k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+        streams.append(_Stream(S_DATA, col_id, np.ascontiguousarray(
+            np.array(live, dtype.np_dtype)).tobytes()))
+        return streams
+    raise NotImplementedError(f"ORC subset writer: compound element {dtype!r}")
+
+
 def _col_stats(dtype: DataType, data, validity, lengths) -> "PbWriter":
     w = PbWriter()
     live = validity.astype(bool)
@@ -617,18 +724,28 @@ def write_orc(
     stripe_rows: int = 65536,
 ) -> None:
     """columns: name -> (data, validity|None, lengths|None for strings).
-    ARRAY fields instead take the reader's 4-tuple shape:
-    (None, validity|None, lengths, (elem_data_2d, elem_valid_2d))."""
-    any_col = next(iter(columns.values()))
-    n = any_col[0].shape[0]
+    ARRAY-of-primitive fields instead take the reader's 4-tuple shape:
+    (None, validity|None, lengths, (elem_data_2d, elem_valid_2d)).
+    MAP/STRUCT/nested-LIST fields take a plain python value list
+    (None/list/dict per row — the reader's compound-path shape)."""
+    any_name = next(iter(columns))
+    any_col = columns[any_name]
+    any_dt = schema.field(any_name).dtype
+    if _is_compound(any_dt):
+        n = len(any_col)
+    elif any_dt.kind == TypeKind.ARRAY:
+        n = any_col[2].shape[0]  # 4-tuple shape: lengths carries rows
+    else:
+        n = any_col[0].shape[0]
     from .fs import get_fs
 
-    # preorder type ids: root = 0, each ARRAY field consumes two slots
+    # preorder type ids: root = 0; compound fields consume one slot per
+    # nested type-tree node
     field_type_ids: List[int] = []
     _next = 1
     for _fld in schema.fields:
         field_type_ids.append(_next)
-        _next += 2 if _fld.dtype.kind == TypeKind.ARRAY else 1
+        _next += _type_size(_fld.dtype)
     total_type_ids = _next
 
     with get_fs(path).create(path) as f:
@@ -648,6 +765,17 @@ def write_orc(
             root.varint(10, 0)
             stats_msgs.append(root.getvalue())
             for ci, fld in zip(field_type_ids, schema.fields):
+                if _is_compound(fld.dtype):
+                    vals = columns[fld.name][start : start + rows]
+                    counts: Dict[int, Tuple[int, bool]] = {}
+                    streams.extend(_encode_pyvalues(ci, fld.dtype, vals, counts))
+                    for slot in range(ci, ci + _type_size(fld.dtype)):
+                        nvals, had_null = counts.get(slot, (0, False))
+                        cw = PbWriter()
+                        cw.varint(1, nvals)
+                        cw.varint(10, 1 if had_null else 0)
+                        stats_msgs.append(cw.getvalue())
+                    continue
                 if fld.dtype.kind == TypeKind.ARRAY:
                     _, validity, lengths, (edata, evalid) = columns[fld.name]
                     if validity is None:
@@ -656,10 +784,22 @@ def write_orc(
                     streams.extend(_encode_list_column(
                         ci, fld.dtype, validity[sl], lengths[sl],
                         edata[sl], evalid[sl]))
-                    for _ in range(2):  # list + child type slots
+                    # truthful per-slot stats (SARG readers prune
+                    # `IS NULL` stripes on hasNull): parent slot =
+                    # live rows; child slot = live elements within
+                    # live rows' lengths
+                    v_sl, ln_sl, ev_sl = validity[sl], lengths[sl], evalid[sl]
+                    within = (np.arange(ev_sl.shape[1])[None, :]
+                              < ln_sl[:, None]) & v_sl[:, None]
+                    live_elems = within & ev_sl
+                    for nvals, had_null in (
+                        (int(v_sl.sum()), not bool(v_sl.all())),
+                        (int(live_elems.sum()),
+                         bool((within & ~ev_sl).any())),
+                    ):
                         cw = PbWriter()
-                        cw.varint(1, int(validity[sl].sum()))
-                        cw.varint(10, 0)
+                        cw.varint(1, nvals)
+                        cw.varint(10, 1 if had_null else 0)
                         stats_msgs.append(cw.getvalue())
                     continue
                 data, validity, lengths = columns[fld.name]
@@ -730,6 +870,29 @@ def write_orc(
                 t.varint(2, tid + 1)
                 ft.msg(4, t)
                 emit_type(dt.elem, tid + 1)
+                return
+            if dt.kind == TypeKind.MAP:
+                t.varint(1, K_MAP)
+                kid, vid = tid + 1, tid + 1 + _type_size(dt.key)
+                t.varint(2, kid)
+                t.varint(2, vid)
+                ft.msg(4, t)
+                emit_type(dt.key, kid)
+                emit_type(dt.value, vid)
+                return
+            if dt.kind == TypeKind.STRUCT:
+                t.varint(1, K_STRUCT)
+                sub = tid + 1
+                for f2 in dt.struct_fields:
+                    t.varint(2, sub)
+                    sub += _type_size(f2.dtype)
+                for f2 in dt.struct_fields:
+                    t.string(3, f2.name)
+                ft.msg(4, t)
+                sub = tid + 1
+                for f2 in dt.struct_fields:
+                    emit_type(f2.dtype, sub)
+                    sub += _type_size(f2.dtype)
                 return
             t.varint(1, _orc_kind(dt))
             if dt.is_decimal:
@@ -1163,10 +1326,7 @@ def read_stripe(
         st = per_col.get(ci, {})
         enc = encodings[ci][0] if ci < len(encodings) else E_DIRECT
         dict_size = encodings[ci][1] if ci < len(encodings) else 0
-        if fld.dtype.kind in (TypeKind.MAP, TypeKind.STRUCT) or (
-            fld.dtype.kind == TypeKind.ARRAY
-            and (fld.dtype.elem.is_nested or fld.dtype.elem.is_string)
-        ):
+        if _is_compound(fld.dtype):
             # compound columns (maps, structs, nested/str lists):
             # recursive python-value decode (incl. its own PRESENT);
             # the scan layer builds the padded nested Column via

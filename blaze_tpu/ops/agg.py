@@ -105,13 +105,16 @@ def agg_result_type(fn: str, in_t: Optional[DataType]) -> DataType:
         if fn == "collect_set" and in_t.is_nested:
             # set dedup encodes elements into equality-preserving
             # uint64 sort words (_value_words): lists, lists-of-lists,
-            # lists-of-structs and lists-of-strings all encode; MAPs
-            # (no canonical entry order) and >64-wide ARRAY levels
-            # stay gated
+            # lists-of-structs and lists-of-strings all encode, any
+            # width (wide ARRAY levels take extra flag words).  MAP
+            # elements are rejected per Spark's own CollectSet rule
+            # ("collect_set() cannot have map type data"); a total
+            # word-count bound keeps lax.sort operand counts sane.
             if not _collect_set_elem_supported(in_t):
                 raise NotImplementedError(
-                    f"collect_set over {in_t!r} (MAP elements or an "
-                    "ARRAY level wider than 64)"
+                    f"collect_set over {in_t!r} (MAP elements are "
+                    "rejected by Spark semantics; or total sort-word "
+                    "count exceeds the 128-word bound)"
                 )
         return DataType.array(in_t, int(conf.COLLECT_MAX_ELEMS.get()))
     return in_t  # min/max/first
@@ -474,10 +477,14 @@ def _value_words(dtype: DataType, col: Column, live) -> List[jnp.ndarray]:
             jnp.arange(m)[(None,) * live.ndim] < col.lengths[..., None]
         ) & live[..., None]
         lv = inner_live & child.validity
-        flags = jnp.zeros(live.shape, jnp.uint64)
-        for j in range(m):
-            flags = flags | (lv[..., j].astype(jnp.uint64) << jnp.uint64(j))
-        words.append(flags)
+        # element-validity flags, 64 bits per word (levels wider than
+        # 64 spill into additional flag words)
+        for base in range(0, m, 64):
+            flags = jnp.zeros(live.shape, jnp.uint64)
+            for j in range(base, min(base + 64, m)):
+                flags = flags | (
+                    lv[..., j].astype(jnp.uint64) << jnp.uint64(j - base))
+            words.append(flags)
         for w in _value_words(dtype.elem, child, lv):
             for j in range(m):
                 words.append(w[..., j])
@@ -513,7 +520,8 @@ def _word_count(dtype: DataType) -> int:
     """Sort words _value_words emits per value (the ARRAY levels
     multiply: each child word splits into max_elems words)."""
     if dtype.kind == TypeKind.ARRAY:
-        return 2 + dtype.max_elems * _word_count(dtype.elem)
+        return 1 + (dtype.max_elems + 63) // 64 + (
+            dtype.max_elems * _word_count(dtype.elem))
     if dtype.kind == TypeKind.STRUCT:
         return sum(1 + _word_count(f.dtype) for f in dtype.struct_fields)
     if dtype.is_string:
@@ -523,17 +531,20 @@ def _word_count(dtype: DataType) -> int:
 
 def _collect_set_elem_supported(dtype: DataType) -> bool:
     """Element types the sort-word dedup can encode: primitives,
-    strings, and ARRAY/STRUCT nestings thereof with every ARRAY level
-    <= 64 elements (one flag word per level) and a bounded TOTAL word
+    strings, and ARRAY/STRUCT nestings thereof (ARRAY levels wider
+    than 64 use extra validity-flag words) with a bounded TOTAL word
     count (the levels multiply; lax.sort with thousands of operands
-    would blow up compile rather than fail cleanly)."""
+    would blow up compile rather than fail cleanly).  MAP elements are
+    rejected because Spark itself rejects them: CollectSet refuses any
+    input type containing a MapType ("collect_set() cannot have map
+    type data"), so the gate IS the reference semantics."""
     def ok(t: DataType) -> bool:
         if t.kind == TypeKind.ARRAY:
-            return t.max_elems <= 64 and ok(t.elem)
+            return ok(t.elem)
         if t.kind == TypeKind.STRUCT:
             return all(ok(f.dtype) for f in t.struct_fields)
         if t.kind in (TypeKind.MAP, TypeKind.OPAQUE):
-            return False  # maps have no canonical entry order
+            return False
         return True
 
     return ok(dtype) and _word_count(dtype) <= 128

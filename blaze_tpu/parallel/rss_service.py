@@ -1,33 +1,56 @@
 """Remote shuffle service: a push/fetch block server + socket client.
 
 ≙ the reference's Celeborn integration
-(``BlazeRssShuffleWriterBase.scala`` / ``CelebornPartitionWriter.write:39`` /
-``BlazeRssShuffleReaderBase``): map tasks PUSH partition-framed
+(``BlazeRssShuffleWriterBase.scala`` / ``CelebornPartitionWriter`` /
+``BlazeCelebornShuffleReader``): map tasks PUSH partition-framed
 compressed batches to the service as they repartition (the RSS takes
 over durability from local ``.data``/``.index`` files); reduce tasks
 FETCH their partition's blocks and stream them through
 ``IpcReaderExec`` like any other shuffle read.
 
-Commit semantics (≙ Celeborn's mapper-end + commit-files barrier):
-pushes land in a per-(shuffle, map) STAGING area; COMMIT atomically
-publishes that map's staged blocks, REPLACING any earlier publication
-by the same map id — so a retried map task's re-push wins and a failed
-attempt's partial pushes are never double-served.  Reducers only ever
-see published blocks, and the FETCH barrier holds until the distinct
-committed map ids reach the expected map count.
+The reference does NOT carry the Celeborn wire protocol in-tree — it
+delegates to ``org.apache.celeborn.client.ShuffleClient`` and its
+integration surface is exactly four calls (CelebornPartitionWriter.
+scala:39-68): ``pushData(shuffleId, mapId, attemptId, partitionId,
+bytes, …)``, ``mapperEnd(shuffleId, mapId, attemptId, numMappers)``,
+``cleanup(shuffleId, mapId, attemptId)``, and the manager's shuffle
+unregistration.  This module implements that client API with the SAME
+semantics over its own framing:
+
+- **Attempts are first-class.**  Speculative execution runs two
+  attempts of one map task CONCURRENTLY under distinct attempt ids;
+  both push, and the FIRST ``mapperEnd`` wins the map id — the losing
+  attempt's commit is a no-op and its staged data is discarded, so a
+  reducer can never observe a mix of two attempts' output (Celeborn
+  filters non-winning attempts at read; we discard at commit).
+- **Commit barrier.**  Reducer fetches hold until the distinct
+  committed map ids reach the expected map count (≙ Celeborn gating
+  reads on the commit-files barrier).
+- **cleanup** discards an attempt's staged pushes without committing
+  (≙ ShuffleClient.cleanup from RssPartitionWriterBase.stop).
+- **unregister** frees every published block of a shuffle
+  (≙ ShuffleManager.unregisterShuffle → lifecycle cleanup).
+- The writer tracks per-partition pushed byte lengths
+  (≙ CelebornPartitionWriter.mapStatusLengths / getPartitionLengthMap).
 
 Wire protocol (length-prefixed, one request per connection state):
 
-    PUSH : u8=1, u32 shuffle_id, u32 map_id, u32 partition,
-           u32 len, bytes -> u8 ack (1)
-    FETCH: u8=2, u32 shuffle_id, u32 partition, u32 expected_maps
-           -> u32 count, count x (u32 len, bytes)
-           (blocks server-side until ``expected_maps`` DISTINCT map ids
-           have COMMITted; 0 = no barrier.  On barrier timeout the
-           reply is count=0xFFFFFFFF, u32 len, error message bytes, so
-           the client sees WHY.)
-    COMMIT: u8=3, u32 shuffle_id, u32 map_id -> u8 ack
-           (one per successful MAP TASK; publishes its staged blocks)
+    PUSH   : u8=1, u32 shuffle_id, u32 map_id, u32 attempt_id,
+             u32 partition, u32 len, bytes -> u8 ack (1)
+    FETCH  : u8=2, u32 shuffle_id, u32 partition, u32 expected_maps
+             -> u32 count, count x (u32 len, bytes)
+             (blocks server-side until ``expected_maps`` DISTINCT map
+             ids have COMMITted; 0 = no barrier.  On barrier timeout
+             the reply is count=0xFFFFFFFF, u32 len, error message
+             bytes, so the client sees WHY.)
+    COMMIT : u8=3, u32 shuffle_id, u32 map_id, u32 attempt_id
+             -> u8: 1 = this attempt WON the map id, 0 = lost (another
+             attempt already ended; its data was discarded)
+             (≙ ShuffleClient.mapperEnd)
+    CLEANUP: u8=4, u32 shuffle_id, u32 map_id, u32 attempt_id -> u8 ack
+             (discard this attempt's staged pushes; ≙ cleanup)
+    UNREG  : u8=5, u32 shuffle_id -> u8 ack
+             (free all published blocks; ≙ unregisterShuffle)
 
 The server is a plain threaded TCP server (host runtime concern — the
 TPU never sees RSS traffic; this is the DCN tier of SURVEY §2.3's
@@ -60,12 +83,13 @@ class RssServer:
     """In-memory block store behind a TCP endpoint."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        # published: (sid, map_id) -> {pid: [bytes]} (committed, immutable)
+        # published: (sid, map_id) -> (attempt_id, {pid: [bytes]})
+        #   committed+immutable; first mapperEnd wins the map id
         # committed: sid -> set of committed map ids
         # (staging is CONNECTION-local: one connection = one map
         # attempt, so a dropped/aborted attempt's pushes vanish with
         # its socket and can never mix into another attempt's commit)
-        published: Dict[Tuple[int, int], Dict[int, List[bytes]]] = {}
+        published: Dict[Tuple[int, int], Tuple[int, Dict[int, List[bytes]]]] = {}
         committed: Dict[int, Set[int]] = {}
         lock = threading.Lock()
         commit_cv = threading.Condition(lock)
@@ -77,8 +101,9 @@ class RssServer:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
-                # this attempt's staged pushes: (sid, mid) -> {pid: [bytes]}
-                staged: Dict[Tuple[int, int], Dict[int, List[bytes]]] = {}
+                # this attempt's staged pushes:
+                # (sid, mid, attempt) -> {pid: [bytes]}
+                staged: Dict[Tuple[int, int, int], Dict[int, List[bytes]]] = {}
                 try:
                     while True:
                         op_raw = sock.recv(1)
@@ -86,11 +111,11 @@ class RssServer:
                             return
                         op = op_raw[0]
                         if op == 1:  # PUSH (staged until COMMIT)
-                            sid, mid, pid, ln = struct.unpack(
-                                "<IIII", _recv_exact(sock, 16)
+                            sid, mid, aid, pid, ln = struct.unpack(
+                                "<IIIII", _recv_exact(sock, 20)
                             )
                             data = _recv_exact(sock, ln)
-                            staged.setdefault((sid, mid), {}).setdefault(
+                            staged.setdefault((sid, mid, aid), {}).setdefault(
                                 pid, []
                             ).append(data)
                             sock.sendall(b"\x01")
@@ -112,7 +137,7 @@ class RssServer:
                                 if ok:
                                     for mid in sorted(committed.get(sid, ())):
                                         blocks.extend(
-                                            published.get((sid, mid), {}).get(pid, ())
+                                            published.get((sid, mid), (0, {}))[1].get(pid, ())
                                         )
                             if not ok:
                                 # error frame: the diagnostic must reach
@@ -129,18 +154,35 @@ class RssServer:
                             for b in blocks:
                                 sock.sendall(struct.pack("<I", len(b)))
                                 sock.sendall(b)
-                        elif op == 3:  # COMMIT (one per successful map task)
-                            sid, mid = struct.unpack("<II", _recv_exact(sock, 8))
+                        elif op == 3:  # COMMIT / mapperEnd
+                            sid, mid, aid = struct.unpack(
+                                "<III", _recv_exact(sock, 12))
                             with commit_cv:
-                                # last attempt wins: REPLACE any earlier
-                                # publication by this map id (a retry's
-                                # blocks must not stack on a failed
-                                # attempt's partial ones)
-                                published[(sid, mid)] = staged.pop(
-                                    (sid, mid), {}
-                                )
-                                committed.setdefault(sid, set()).add(mid)
-                                commit_cv.notify_all()
+                                # FIRST mapperEnd wins the map id
+                                # (≙ Celeborn speculation handling): a
+                                # losing attempt's data is discarded and
+                                # never mixes into the served set
+                                if (sid, mid) in published:
+                                    staged.pop((sid, mid, aid), None)
+                                    won = False
+                                else:
+                                    published[(sid, mid)] = (
+                                        aid, staged.pop((sid, mid, aid), {}))
+                                    committed.setdefault(sid, set()).add(mid)
+                                    commit_cv.notify_all()
+                                    won = True
+                            sock.sendall(b"\x01" if won else b"\x00")
+                        elif op == 4:  # CLEANUP (≙ ShuffleClient.cleanup)
+                            sid, mid, aid = struct.unpack(
+                                "<III", _recv_exact(sock, 12))
+                            staged.pop((sid, mid, aid), None)
+                            sock.sendall(b"\x01")
+                        elif op == 5:  # UNREG (≙ unregisterShuffle)
+                            (sid,) = struct.unpack("<I", _recv_exact(sock, 4))
+                            with commit_cv:
+                                for key in [k for k in published if k[0] == sid]:
+                                    del published[key]
+                                committed.pop(sid, None)
                             sock.sendall(b"\x01")
                         else:
                             raise ConnectionError(f"bad rss opcode {op}")
@@ -172,6 +214,12 @@ class RssServer:
         with self._lock:
             return len(self._committed.get(shuffle_id, ())) >= expected_maps
 
+    def is_registered(self, shuffle_id: int) -> bool:
+        with self._lock:
+            return shuffle_id in self._committed or any(
+                k[0] == shuffle_id for k in self._published
+            )
+
     def __enter__(self) -> "RssServer":
         return self.start()
 
@@ -181,37 +229,73 @@ class RssServer:
 
 class SocketRssWriter(RssPartitionWriterBase):
     """Client half of the push path — what the engine sees behind the
-    resources map (≙ CelebornPartitionWriter).  ``close()`` commits;
-    ``abort()`` closes WITHOUT committing (failed/cancelled attempts
-    must not count toward the reducers' barrier)."""
+    resources map (≙ CelebornPartitionWriter).  ``close()`` issues
+    mapperEnd (first attempt wins; ``self.won`` records the outcome);
+    ``abort()`` cleans up WITHOUT committing (failed/cancelled attempts
+    must not count toward the reducers' barrier).  Per-partition pushed
+    byte lengths are tracked like mapStatusLengths
+    (``partition_lengths`` ≙ getPartitionLengthMap)."""
 
-    def __init__(self, host: str, port: int, shuffle_id: int, map_id: int):
+    def __init__(self, host: str, port: int, shuffle_id: int, map_id: int,
+                 attempt_id: int = 0):
         self.shuffle_id = shuffle_id
         self.map_id = map_id
+        self.attempt_id = attempt_id
+        self.partition_lengths: Dict[int, int] = {}
+        self.won: bool = False
         self._sock = socket.create_connection((host, port))
 
     def write(self, partition_id: int, data: bytes) -> None:
         self._sock.sendall(
             b"\x01" + struct.pack(
-                "<IIII", self.shuffle_id, self.map_id, partition_id, len(data)
+                "<IIIII", self.shuffle_id, self.map_id, self.attempt_id,
+                partition_id, len(data)
             )
         )
         self._sock.sendall(data)
         ack = _recv_exact(self._sock, 1)
         if ack != b"\x01":
             raise ConnectionError("rss push not acknowledged")
+        self.partition_lengths[partition_id] = (
+            self.partition_lengths.get(partition_id, 0) + len(data))
 
     def close(self) -> None:
         try:
             self._sock.sendall(
-                b"\x03" + struct.pack("<II", self.shuffle_id, self.map_id)
+                b"\x03" + struct.pack(
+                    "<III", self.shuffle_id, self.map_id, self.attempt_id)
             )
-            _recv_exact(self._sock, 1)
+            self.won = _recv_exact(self._sock, 1) == b"\x01"
         finally:
             self._sock.close()
 
     def abort(self) -> None:
-        self._sock.close()
+        # explicit cleanup (≙ ShuffleClient.cleanup): the server drops
+        # this attempt's staged pushes even if the connection lingers.
+        # Bounded: abort() runs on FAILURE paths, possibly after a
+        # partial PUSH left the stream desynced (the server would read
+        # the cleanup frame as payload and never reply) — a short
+        # timeout falls through to close(), where connection-local
+        # staging dies with the socket anyway.
+        try:
+            self._sock.settimeout(5.0)
+            self._sock.sendall(
+                b"\x04" + struct.pack(
+                    "<III", self.shuffle_id, self.map_id, self.attempt_id)
+            )
+            _recv_exact(self._sock, 1)
+        except OSError:
+            pass  # dead/desynced socket: staging dies with it anyway
+        finally:
+            self._sock.close()
+
+
+def rss_unregister_shuffle(host: str, port: int, shuffle_id: int) -> None:
+    """Free every published block of a shuffle on the service
+    (≙ ShuffleManager.unregisterShuffle → Celeborn lifecycle cleanup)."""
+    with socket.create_connection((host, port)) as sock:
+        sock.sendall(b"\x05" + struct.pack("<I", shuffle_id))
+        _recv_exact(sock, 1)
 
 
 def rss_fetch_blocks(

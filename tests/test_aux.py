@@ -228,6 +228,70 @@ def test_rss_service_end_to_end():
             assert not (per_part_keys[i] & per_part_keys[j])
 
 
+def test_rss_speculative_attempts_first_mapper_end_wins():
+    """Celeborn semantics: two CONCURRENT attempts of one map push
+    under distinct attempt ids; the FIRST mapperEnd wins the map id,
+    the loser's commit is a no-op and its data is never served — a
+    reducer can never see a mix of attempts (CelebornPartitionWriter
+    pushData/mapperEnd contract)."""
+    from blaze_tpu.parallel.rss_service import (
+        RssServer, SocketRssWriter, rss_fetch_blocks,
+    )
+
+    with RssServer() as server:
+        a0 = SocketRssWriter(server.host, server.port, shuffle_id=21,
+                             map_id=0, attempt_id=0)
+        a1 = SocketRssWriter(server.host, server.port, shuffle_id=21,
+                             map_id=0, attempt_id=1)
+        # both attempts push interleaved (speculation)
+        a0.write(0, b"a0-block1")
+        a1.write(0, b"a1-block1")
+        a0.write(1, b"a0-block2")
+        a1.write(1, b"a1-block2")
+        assert a0.partition_lengths == {0: 9, 1: 9}
+
+        a1.close()  # attempt 1 ends first -> wins
+        a0.close()  # attempt 0 ends second -> no-op loser
+        assert a1.won and not a0.won
+
+        assert rss_fetch_blocks(
+            server.host, server.port, 21, 0, expected_maps=1
+        ) == [b"a1-block1"]
+        assert rss_fetch_blocks(
+            server.host, server.port, 21, 1, expected_maps=1
+        ) == [b"a1-block2"]
+
+
+def test_rss_cleanup_and_unregister():
+    """cleanup discards an attempt's staged pushes (≙ ShuffleClient.
+    cleanup); unregister frees a shuffle's published blocks
+    (≙ unregisterShuffle)."""
+    from blaze_tpu.parallel.rss_service import (
+        RssServer, SocketRssWriter, rss_fetch_blocks,
+        rss_unregister_shuffle,
+    )
+
+    with RssServer() as server:
+        w = SocketRssWriter(server.host, server.port, shuffle_id=31, map_id=0)
+        w.write(0, b"doomed")
+        w.abort()  # cleanup: staged pushes discarded, no commit
+        assert not server.is_committed(31, expected_maps=1)
+
+        w2 = SocketRssWriter(server.host, server.port, shuffle_id=31, map_id=0)
+        w2.write(0, b"kept")
+        w2.close()
+        assert w2.won
+        assert rss_fetch_blocks(
+            server.host, server.port, 31, 0, expected_maps=1) == [b"kept"]
+
+        assert server.is_registered(31)
+        rss_unregister_shuffle(server.host, server.port, 31)
+        assert not server.is_registered(31)
+        # post-unregister fetch with no barrier: nothing served
+        assert rss_fetch_blocks(
+            server.host, server.port, 31, 0, expected_maps=0) == []
+
+
 def test_rss_retry_and_barrier_semantics():
     """Map-attempt retry + fetch barrier: a failed attempt's partial
     pushes are never served (its retry's publication replaces them),

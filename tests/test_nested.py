@@ -461,6 +461,46 @@ def test_collect_set_over_lists_of_structs():
             map(str, exp[g])), (g, got[g])
 
 
+def test_collect_set_over_wide_array_level():
+    """collect_set of ARRAY<int> wider than 64 elements: the element
+    validity flags spill into multiple 64-bit words, and values
+    differing ONLY past element 64 (incl. null-position-only
+    differences) must stay distinct."""
+    from blaze_tpu.schema import DataType
+
+    t = DataType.array(DataType.int64(), 70)
+    base = list(range(70))
+    v_null66 = base[:66] + [None] + base[67:]
+    v_null67 = base[:67] + [None] + base[68:]
+    v_diff69 = base[:69] + [999]
+    rows = [
+        (0, base), (0, base), (0, v_null66), (0, v_null67), (0, v_diff69),
+        (1, base[:65]), (1, base[:65]), (1, base[:66]),
+    ]
+    got = _run_collect_set(rows, t)
+    exp = {}
+    for g, v in rows:
+        if v is not None:
+            exp.setdefault(g, set()).add(_canon(v))
+    assert set(got) == set(exp)
+    for g in exp:
+        assert sorted(map(str, {_canon(e) for e in got[g]})) == sorted(
+            map(str, exp[g])), (g, got[g])
+
+
+def test_collect_set_map_elements_rejected_like_spark():
+    """MAP elements: Spark's CollectSet itself refuses map-typed data,
+    so the gate is reference semantics, not a gap."""
+    import pytest
+
+    from blaze_tpu.ops.agg import agg_result_type
+    from blaze_tpu.schema import DataType
+
+    t = DataType.map(DataType.string(8), DataType.int64(), 4)
+    with pytest.raises(NotImplementedError, match="[Mm]ap"):
+        agg_result_type("collect_set", t)
+
+
 def test_collect_set_over_lists_of_strings():
     """collect_set of ARRAY<string>: byte-packed words inside the list
     encoding."""

@@ -339,6 +339,221 @@ def test_writer_list_column_roundtrip(tmp_path):
     assert pv == expected
 
 
+def test_writer_compound_columns_roundtrip(tmp_path):
+    """MAP/STRUCT/nested-LIST/list-of-string columns written as python
+    value lists (the reader's compound-path shape) read back by BOTH
+    our reader and pyarrow, nulls at every nesting level."""
+    import decimal
+
+    from blaze_tpu.io.orc import write_orc
+
+    rng = np.random.RandomState(11)
+    n = 400
+    dec = DataType.decimal(10, 2)
+    m_vals = [
+        None if rng.rand() < 0.1 else {
+            f"k{j}": (None if rng.rand() < 0.2 else int(rng.randint(-50, 50)))
+            for j in range(rng.randint(0, 4))
+        }
+        for _ in range(n)
+    ]
+    st_vals = [
+        None if rng.rand() < 0.1 else {
+            "a": None if rng.rand() < 0.2 else int(rng.randint(0, 9)),
+            "b": None if rng.rand() < 0.2 else f"s{rng.randint(30)}",
+            "d": None if rng.rand() < 0.2 else decimal.Decimal(
+                int(rng.randint(-9999, 9999))).scaleb(-2),
+        }
+        for _ in range(n)
+    ]
+    nl_vals = [
+        None if rng.rand() < 0.1 else [
+            None if rng.rand() < 0.15 else [
+                None if rng.rand() < 0.2 else int(rng.randint(-99, 99))
+                for _ in range(rng.randint(0, 4))
+            ]
+            for _ in range(rng.randint(0, 4))
+        ]
+        for _ in range(n)
+    ]
+    ls_vals = [
+        None if rng.rand() < 0.1 else [
+            None if rng.rand() < 0.15 else f"w{rng.randint(20)}"
+            for _ in range(rng.randint(0, 5))
+        ]
+        for _ in range(n)
+    ]
+    schema = Schema([
+        Field("m", DataType.map(DataType.string(8), DataType.int64(), 8)),
+        Field("st", DataType.struct([
+            Field("a", DataType.int64()), Field("b", DataType.string(8)),
+            Field("d", dec)])),
+        Field("nl", DataType.array(DataType.array(DataType.int64(), 8), 8)),
+        Field("ls", DataType.array(DataType.string(8), 8)),
+        Field("id", DataType.int64()),
+    ])
+    path = str(tmp_path / "wcompound.orc")
+    write_orc(path, schema, {
+        "m": m_vals, "st": st_vals, "nl": nl_vals, "ls": ls_vals,
+        "id": (np.arange(n, dtype=np.int64), None, None),
+    }, stripe_rows=150)
+
+    # our scan layer (batch-level differential via pydict)
+    scan = OrcScanExec([[path]], schema, batch_rows=128)
+    got = concat_batches([b for b in scan.execute(0, TaskContext(0, 1))])
+    d = batch_to_pydict(got)
+    assert d["m"] == m_vals
+    assert d["nl"] == nl_vals
+    assert d["ls"] == ls_vals
+    assert d["id"] == list(range(n))
+    # the engine's Column convention stores DECIMAL as unscaled ints
+    st_unscaled = [
+        None if v is None else dict(v, d=(
+            None if v["d"] is None else int(v["d"].scaleb(2))))
+        for v in st_vals
+    ]
+    assert d["st"] == st_unscaled
+
+    # pyarrow reads the same file (wire compatibility)
+    t = paorc.read_table(path)
+    assert [None if v is None else dict(v) for v in
+            t.column("m").to_pylist()] == m_vals
+    assert t.column("nl").to_pylist() == nl_vals
+    assert t.column("ls").to_pylist() == ls_vals
+    pa_st = t.column("st").to_pylist()
+    assert pa_st == st_vals
+
+
+def test_writer_array_first_column_and_nested_has_null_stats(tmp_path):
+    """(review findings) A schema whose FIRST column is ARRAY-of-
+    primitive must not crash row counting, and compound stripe stats
+    must report hasNull truthfully for external SARG readers."""
+    from blaze_tpu.io.orc import (
+        PbReader, _type_size, read_metadata, write_orc,
+    )
+
+    n, m = 10, 3
+    lengths = np.full(n, 2, np.int32)
+    edata = np.arange(n * m, dtype=np.int32).reshape(n, m)
+    evalid = np.ones((n, m), bool)
+    schema = Schema([
+        Field("vals", DataType.array(DataType.int32(), m)),
+        Field("nl", DataType.array(DataType.array(DataType.int64(), 4), 4)),
+    ])
+    nl_vals = [[[1, None]], None] * 5
+    path = str(tmp_path / "arrfirst.orc")
+    write_orc(path, schema, {
+        "vals": (None, None, lengths, (edata, evalid)),
+        "nl": nl_vals,
+    })
+    meta = read_metadata(path)
+    assert meta.num_rows == n
+    t = paorc.read_table(path)
+    assert t.column("nl").to_pylist() == nl_vals
+
+    # stripe stats (raw Metadata block): hasNull=true must be recorded
+    # at the nl slots that contain Nones (external SARG readers prune
+    # `IS NULL` stripes on this flag)
+    nl_tid = 1 + _type_size(schema.fields[0].dtype)
+    raw = open(path, "rb").read()
+    ps_len = raw[-1]
+    ps = raw[-1 - ps_len : -1]
+    footer_len = md_len = 0
+    for f_no, _, v in PbReader(ps).fields():
+        if f_no == 1:
+            footer_len = v
+        elif f_no == 5:
+            md_len = v
+    md = raw[-1 - ps_len - footer_len - md_len : -1 - ps_len - footer_len]
+    stripes_stats = []
+    for f_no, _, v in PbReader(md).fields():
+        if f_no == 1:
+            msgs = [vv for f2, _, vv in PbReader(v).fields() if f2 == 1]
+            stripes_stats.append(msgs)
+    assert stripes_stats, "Metadata stripe stats missing"
+    cols = stripes_stats[0]
+    # root(0), vals(1), vals-child(2), nl(3=nl_tid), nl-mid, nl-leaf
+    def has_null(msg):
+        return any(f_no == 10 and val == 1 for f_no, _, val in PbReader(msg).fields())
+
+    assert has_null(cols[nl_tid]), "nl top-level nulls not recorded"
+    assert has_null(cols[nl_tid + 2]), "nl leaf nulls not recorded"
+    assert not has_null(cols[1]), "vals has no nulls"
+
+
+def test_writer_compound_unsupported_element_is_gated(tmp_path):
+    """TIMESTAMP inside a compound value raises, never writes junk."""
+    from blaze_tpu.io.orc import write_orc
+
+    schema = Schema([Field("x", DataType.array(
+        DataType.struct([Field("t", DataType.timestamp())]), 4))])
+    with pytest.raises(NotImplementedError, match="compound element"):
+        write_orc(str(tmp_path / "bad.orc"), schema,
+                  {"x": [[{"t": 1}]]})
+
+
+def test_writer_compound_decimal_finer_than_scale_is_gated(tmp_path):
+    """(review finding) Decimal('1.005') into DECIMAL(10,2) must raise,
+    not silently truncate to 1.00 — the writer mirrors the reader's
+    _rescale_decimals gate."""
+    import decimal
+
+    from blaze_tpu.io.orc import write_orc
+
+    schema = Schema([Field("x", DataType.struct(
+        [Field("d", DataType.decimal(10, 2))]))])
+    with pytest.raises(NotImplementedError, match="declared scale"):
+        write_orc(str(tmp_path / "bad2.orc"), schema,
+                  {"x": [{"d": decimal.Decimal("1.005")}]})
+
+
+def test_writer_flat_list_has_null_stats(tmp_path):
+    """(review finding) ARRAY-of-primitive stripe stats report hasNull
+    truthfully for both the list slot (null rows) and the child slot
+    (null elements), and element counts are element-level."""
+    from blaze_tpu.io.orc import PbReader, write_orc
+
+    n, m = 6, 3
+    validity = np.array([True, False, True, True, True, True])
+    lengths = np.where(validity, 2, 0).astype(np.int32)
+    edata = np.arange(n * m, dtype=np.int32).reshape(n, m)
+    evalid = np.ones((n, m), bool)
+    evalid[2, 1] = False  # one null element inside a live row
+    schema = Schema([Field("vals", DataType.array(DataType.int32(), m))])
+    path = str(tmp_path / "flstats.orc")
+    write_orc(path, schema, {"vals": (None, validity, lengths, (edata, evalid))})
+
+    raw = open(path, "rb").read()
+    ps_len = raw[-1]
+    ps = raw[-1 - ps_len : -1]
+    footer_len = md_len = 0
+    for f_no, _, v in PbReader(ps).fields():
+        if f_no == 1:
+            footer_len = v
+        elif f_no == 5:
+            md_len = v
+    md = raw[-1 - ps_len - footer_len - md_len : -1 - ps_len - footer_len]
+    cols = None
+    for f_no, _, v in PbReader(md).fields():
+        if f_no == 1:
+            cols = [vv for f2, _, vv in PbReader(v).fields() if f2 == 1]
+    assert cols is not None
+
+    def stats(msg):
+        nv = hn = 0
+        for f_no, _, val in PbReader(msg).fields():
+            if f_no == 1:
+                nv = val
+            elif f_no == 10:
+                hn = val
+        return nv, hn
+
+    # slot 1 = list column: 5 live rows, one null row
+    assert stats(cols[1]) == (5, 1)
+    # slot 2 = element column: 5 rows x 2 elems - 1 null elem = 9, hasNull
+    assert stats(cols[2]) == (9, 1)
+
+
 def test_list_exceeding_max_elems_is_gated(tmp_path):
     """A file whose lists exceed the declared ARRAY cap must raise, not
     silently truncate (round-4 advisor, io/orc.py gate policy)."""
