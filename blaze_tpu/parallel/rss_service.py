@@ -91,6 +91,10 @@ class RssServer:
         # its socket and can never mix into another attempt's commit)
         published: Dict[Tuple[int, int], Tuple[int, Dict[int, List[bytes]]]] = {}
         committed: Dict[int, Set[int]] = {}
+        # tombstones: a straggler attempt's COMMIT landing after UNREG
+        # must not resurrect the shuffle (its blocks would leak for the
+        # server's lifetime and could serve stale data on id reuse)
+        dead: Set[int] = set()
         lock = threading.Lock()
         commit_cv = threading.Condition(lock)
         self._published = published
@@ -161,8 +165,10 @@ class RssServer:
                                 # FIRST mapperEnd wins the map id
                                 # (≙ Celeborn speculation handling): a
                                 # losing attempt's data is discarded and
-                                # never mixes into the served set
-                                if (sid, mid) in published:
+                                # never mixes into the served set.
+                                # An unregistered shuffle is a tombstone:
+                                # discard, never resurrect.
+                                if sid in dead or (sid, mid) in published:
                                     staged.pop((sid, mid, aid), None)
                                     won = False
                                 else:
@@ -183,6 +189,7 @@ class RssServer:
                                 for key in [k for k in published if k[0] == sid]:
                                     del published[key]
                                 committed.pop(sid, None)
+                                dead.add(sid)
                             sock.sendall(b"\x01")
                         else:
                             raise ConnectionError(f"bad rss opcode {op}")

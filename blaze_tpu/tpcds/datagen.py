@@ -650,6 +650,24 @@ def generate_table(name: str, scale: float, seed: int = 20011129,
     raise KeyError(f"unknown tpcds table {name!r}")
 
 
+def with_null_fks(table: HostTable, columns) -> HostTable:
+    """Expose a table's -1 foreign-key sentinels as REAL nulls.
+
+    The generator draws NULL foreign keys as -1 (module docstring):
+    join-equivalent for the inner-join query set, but `fk IS NULL`,
+    null-key grouping, and outer-join null-extension semantics differ.
+    This view rewrites the named columns to (data, lengths, validity)
+    with validity = (data != -1) — the SAME underlying draws, so every
+    existing oracle stays byte-identical while null-semantics
+    differentials get honest NULLs end-to-end."""
+    out = dict(table)
+    for c in columns:
+        entry = table[c]
+        data, lengths = entry[0], entry[1]
+        out[c] = (data, lengths, data != np.int64(-1))
+    return out
+
+
 def generate_all(scale: float, seed: int = 20011129) -> Dict[str, HostTable]:
     from .schema import TPCDS_SCHEMAS
 

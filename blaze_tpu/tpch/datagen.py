@@ -103,7 +103,9 @@ def _money(rng, n, lo, hi):
 
 
 HostTable = Dict[str, Tuple[np.ndarray, Optional[np.ndarray]]]
-# column -> (data, lengths|None); validity implied all-true (TPC-H has no nulls)
+# column -> (data, lengths|None) with validity implied all-true (TPC-H
+# has no nulls), or (data, lengths|None, validity) for nullable columns
+# (TPC-DS NULL foreign keys — see tpcds.datagen.with_null_fks)
 
 
 def generate_table(name: str, scale: float, seed: int = 19940204, columns=None) -> HostTable:
@@ -333,20 +335,25 @@ def table_to_batches(
             cap = bucket_capacity(e - s)
             cols = []
             for f in schema.fields:
-                data, lengths = table[f.name]
+                # columns are (data, lengths) or, for nullable columns,
+                # (data, lengths, validity) — TPC-H itself has no nulls
+                # but TPC-DS NULL foreign keys ride this third channel
+                entry = table[f.name]
+                data, lengths = entry[0], entry[1]
+                vsrc = entry[2] if len(entry) > 2 else None
                 if f.dtype.is_string:
                     d = np.zeros((cap, data.shape[1]), np.uint8)
                     d[: e - s] = data[s:e]
                     ln = np.zeros(cap, np.int32)
                     ln[: e - s] = lengths[s:e]
                     validity = np.zeros(cap, np.bool_)
-                    validity[: e - s] = True
+                    validity[: e - s] = True if vsrc is None else vsrc[s:e]
                     cols.append(Column(f.dtype, d, validity, ln))
                 else:
                     d = np.zeros(cap, f.dtype.np_dtype)
                     d[: e - s] = data[s:e].astype(f.dtype.np_dtype, copy=False)
                     validity = np.zeros(cap, np.bool_)
-                    validity[: e - s] = True
+                    validity[: e - s] = True if vsrc is None else vsrc[s:e]
                     cols.append(Column(f.dtype, d, validity))
             b = RecordBatch(schema, cols, e - s)
             batches.append(b.to_device() if device else b)

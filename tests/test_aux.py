@@ -292,6 +292,34 @@ def test_rss_cleanup_and_unregister():
             server.host, server.port, 31, 0, expected_maps=0) == []
 
 
+def test_rss_straggler_commit_after_unregister_is_tombstoned():
+    """(review finding) A straggler attempt's mapperEnd landing AFTER
+    unregisterShuffle must not resurrect the shuffle: its blocks are
+    discarded, the commit reports lost, and the shuffle stays dead."""
+    from blaze_tpu.parallel.rss_service import (
+        RssServer, SocketRssWriter, rss_fetch_blocks,
+        rss_unregister_shuffle,
+    )
+
+    with RssServer() as server:
+        # winner commits; straggler a0 stays connected with staged data
+        a0 = SocketRssWriter(server.host, server.port, shuffle_id=41,
+                             map_id=0, attempt_id=0)
+        a1 = SocketRssWriter(server.host, server.port, shuffle_id=41,
+                             map_id=0, attempt_id=1)
+        a0.write(0, b"straggler")
+        a1.write(0, b"winner")
+        a1.close()
+        assert a1.won
+        rss_unregister_shuffle(server.host, server.port, 41)
+        assert not server.is_registered(41)
+        a0.close()  # straggler's late mapperEnd
+        assert not a0.won
+        assert not server.is_registered(41)
+        assert rss_fetch_blocks(
+            server.host, server.port, 41, 0, expected_maps=0) == []
+
+
 def test_rss_retry_and_barrier_semantics():
     """Map-attempt retry + fetch barrier: a failed attempt's partial
     pushes are never served (its retry's publication replaces them),

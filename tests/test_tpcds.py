@@ -1326,3 +1326,83 @@ def test_q85(ticket_data, ticket_scans):
         assert q == pytest.approx(eq, rel=1e-12), r
         assert (c, f) == (ec, ef), r
     assert got["reason"] == sorted(got["reason"])
+
+
+def test_null_foreign_keys_end_to_end(data):
+    """NULL foreign keys as REAL nulls end-to-end (not -1 sentinels):
+    `IS NULL` filters, a NULL grouping key, LEFT-join null extension,
+    and INNER-join null-key dropping, through full serde + the stage
+    scheduler, vs a numpy oracle honoring NULL semantics.  The base
+    draws are the SAME arrays every other differential uses — only the
+    validity view differs (tpcds.datagen.with_null_fks)."""
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggFunction, FilterExec, GroupingExpr
+    from blaze_tpu.ops.joins.core import JoinType
+    from blaze_tpu.runtime.scheduler import run_stages, split_stages
+    from blaze_tpu.tpcds.datagen import with_null_fks
+    from blaze_tpu.tpch.queries import broadcast_join, two_stage_agg
+
+    ss = with_null_fks(data["store_sales"], ["ss_customer_sk"])
+    fk = ss["ss_customer_sk"][0]
+    valid = ss["ss_customer_sk"][2]
+    assert not valid.all() and valid.any(), "need a mix of null/non-null keys"
+
+    scan = MemoryScanExec(
+        table_to_batches(ss, TPCDS_SCHEMAS["store_sales"], N_PARTS, batch_rows=4096),
+        TPCDS_SCHEMAS["store_sales"],
+    )
+    cust = MemoryScanExec(
+        table_to_batches(data["customer"], TPCDS_SCHEMAS["customer"], 1, batch_rows=65536),
+        TPCDS_SCHEMAS["customer"],
+    )
+
+    def run_sched(plan):
+        stages, manager = split_stages(plan)
+        out = {f.name: [] for f in plan.schema.fields}
+        for b in run_stages(stages, manager):
+            d = batch_to_pydict(b)
+            for k in out:
+                out[k].extend(d[k])
+        return out
+
+    # 1. IS NULL count: -1 sentinels would make this zero
+    got = run_sched(two_stage_agg(
+        FilterExec(scan, col("ss_customer_sk").is_null()),
+        [], [AggFunction("count_star", None, "n")], 1))
+    assert got["n"] == [int((~valid).sum())]
+
+    # 2. GROUP BY the nullable key: exactly one NULL group whose count
+    # equals the null-row count, and every non-null group exact
+    got = run_sched(two_stage_agg(
+        scan, [GroupingExpr(col("ss_customer_sk"), "k")],
+        [AggFunction("count_star", None, "n")], N_PARTS))
+    got_rows = dict(zip(got["k"], got["n"]))
+    exp_rows = {}
+    for v, ok in zip(fk, valid):
+        key = int(v) if ok else None
+        exp_rows[key] = exp_rows.get(key, 0) + 1
+    assert got_rows == exp_rows
+    assert None in got_rows
+
+    # 3. INNER join drops null keys entirely (Spark null-key semantics)
+    j = broadcast_join(cust, scan, [col("c_customer_sk")],
+                       [col("ss_customer_sk")], JoinType.INNER,
+                       build_is_left=False)
+    got = run_sched(two_stage_agg(
+        j, [], [AggFunction("count_star", None, "n")], 1))
+    csk = set(data["customer"]["c_customer_sk"][0].tolist())
+    exp_inner = sum(1 for v, ok in zip(fk, valid) if ok and int(v) in csk)
+    assert got["n"] == [exp_inner]
+
+    # 4. LEFT join null-extends the null-key rows instead of dropping
+    # (build side first: customer broadcasts, store_sales is the
+    # preserved left/probe side)
+    j = broadcast_join(cust, scan, [col("c_customer_sk")],
+                       [col("ss_customer_sk")], JoinType.LEFT,
+                       build_is_left=False)
+    got = run_sched(two_stage_agg(
+        FilterExec(j, col("c_customer_sk").is_null()),
+        [], [AggFunction("count_star", None, "n")], 1))
+    exp_unmatched = sum(
+        1 for v, ok in zip(fk, valid) if not ok or int(v) not in csk)
+    assert got["n"] == [exp_unmatched]
