@@ -109,6 +109,31 @@ def orc_decompress(buf: bytes, kind: int) -> bytes:
     return bytes(out)
 
 
+def orc_compress(data: bytes, kind: int, block: int = 65536) -> bytes:
+    """Writer half of the chunked framing: split into <= ``block``-byte
+    chunks, deflate each, store verbatim (original bit) when
+    compression does not shrink the chunk — the exact format
+    orc_decompress consumes and ORC C++ readers expect."""
+    if kind == C_NONE or not data:
+        return data
+    if kind != C_ZLIB:
+        raise NotImplementedError(f"ORC writer compression kind {kind}")
+    out = bytearray()
+    for pos in range(0, len(data), block):
+        chunk = data[pos : pos + block]
+        co = zlib.compressobj(6, zlib.DEFLATED, -15)
+        comp = co.compress(chunk) + co.flush()
+        if len(comp) < len(chunk):
+            h = len(comp) << 1
+            out += bytes([h & 0xFF, (h >> 8) & 0xFF, (h >> 16) & 0xFF])
+            out += comp
+        else:
+            h = (len(chunk) << 1) | 1
+            out += bytes([h & 0xFF, (h >> 8) & 0xFF, (h >> 16) & 0xFF])
+            out += chunk
+    return bytes(out)
+
+
 # ------------------------------------------------------------- RLE v2
 
 _RLEV2_WIDTHS = [
@@ -722,12 +747,17 @@ def write_orc(
     schema: Schema,
     columns: Dict[str, Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]],
     stripe_rows: int = 65536,
+    compression: str = "none",
 ) -> None:
     """columns: name -> (data, validity|None, lengths|None for strings).
     ARRAY-of-primitive fields instead take the reader's 4-tuple shape:
     (None, validity|None, lengths, (elem_data_2d, elem_valid_2d)).
     MAP/STRUCT/nested-LIST fields take a plain python value list
-    (None/list/dict per row — the reader's compound-path shape)."""
+    (None/list/dict per row — the reader's compound-path shape).
+    ``compression``: "none" or "zlib" (Spark's ORC default) — every
+    stream, stripe footer, Metadata and Footer region gets the chunked
+    [u24 header][deflate block] framing; the PostScript stays raw."""
+    comp_kind = {"none": C_NONE, "zlib": C_ZLIB}[compression]
     any_name = next(iter(columns))
     any_col = columns[any_name]
     any_dt = schema.field(any_name).dtype
@@ -810,22 +840,26 @@ def write_orc(
                 ln = None if lengths is None else lengths[sl]
                 streams.extend(_encode_column(ci, fld.dtype, d, v, ln))
                 stats_msgs.append(_col_stats(fld.dtype, d, v, ln).getvalue())
+            # stream lengths in the stripe footer are the COMPRESSED
+            # on-disk lengths (readers slice the data region by them,
+            # then undo the chunked framing per stream)
+            wire = [orc_compress(s.data, comp_kind) for s in streams]
             data_len = 0
-            for s in streams:
-                f.write(s.data)
-                data_len += len(s.data)
+            for w in wire:
+                f.write(w)
+                data_len += len(w)
             sf = PbWriter()
-            for s in streams:
+            for s, w in zip(streams, wire):
                 m = PbWriter()
                 m.varint(1, s.kind)
                 m.varint(2, s.column)
-                m.varint(3, len(s.data))
+                m.varint(3, len(w))
                 sf.msg(1, m)
             for _ in range(total_type_ids):
                 enc = PbWriter()
                 enc.varint(1, 0)  # DIRECT
                 sf.msg(2, enc)
-            foot = sf.getvalue()
+            foot = orc_compress(sf.getvalue(), comp_kind)
             f.write(foot)
             stripe_infos.append((offset, data_len, len(foot), rows))
             stripe_stats.append(stats_msgs)
@@ -839,7 +873,7 @@ def write_orc(
             for m in msgs:
                 ss.bytes_(1, m)
             md.msg(1, ss)
-        md_bytes = md.getvalue()
+        md_bytes = orc_compress(md.getvalue(), comp_kind)
         f.write(md_bytes)
 
         # Footer
@@ -903,12 +937,12 @@ def write_orc(
         for tid, fld in zip(field_type_ids, schema.fields):
             emit_type(fld.dtype, tid)
         ft.varint(6, n)  # numberOfRows
-        ft_bytes = ft.getvalue()
+        ft_bytes = orc_compress(ft.getvalue(), comp_kind)
         f.write(ft_bytes)
 
         ps = PbWriter()
         ps.varint(1, len(ft_bytes))
-        ps.varint(2, 0)  # CompressionKind NONE
+        ps.varint(2, comp_kind)
         ps.varint(3, 65536)
         ps.bytes_(4, _uvarint(0) + _uvarint(12))  # version [0, 12] packed
         ps.varint(5, len(md_bytes))

@@ -93,8 +93,15 @@ class RssServer:
         committed: Dict[int, Set[int]] = {}
         # tombstones: a straggler attempt's COMMIT landing after UNREG
         # must not resurrect the shuffle (its blocks would leak for the
-        # server's lifetime and could serve stale data on id reuse)
-        dead: Set[int] = set()
+        # server's lifetime and could serve stale data on id reuse).
+        # Bounded FIFO: a tombstone only needs to outlive straggler
+        # connections of its own job, and Spark/Celeborn shuffle ids
+        # are unique within an application — after 1024 newer
+        # unregistrations an id may be legitimately reused.
+        from collections import OrderedDict
+
+        dead: "OrderedDict[int, None]" = OrderedDict()
+        DEAD_CAP = 1024
         lock = threading.Lock()
         commit_cv = threading.Condition(lock)
         self._published = published
@@ -189,7 +196,10 @@ class RssServer:
                                 for key in [k for k in published if k[0] == sid]:
                                     del published[key]
                                 committed.pop(sid, None)
-                                dead.add(sid)
+                                dead[sid] = None
+                                dead.move_to_end(sid)
+                                while len(dead) > DEAD_CAP:
+                                    dead.popitem(last=False)
                             sock.sendall(b"\x01")
                         else:
                             raise ConnectionError(f"bad rss opcode {op}")
