@@ -94,14 +94,24 @@ class RssServer:
         # tombstones: a straggler attempt's COMMIT landing after UNREG
         # must not resurrect the shuffle (its blocks would leak for the
         # server's lifetime and could serve stale data on id reuse).
-        # Bounded FIFO: a tombstone only needs to outlive straggler
-        # connections of its own job, and Spark/Celeborn shuffle ids
-        # are unique within an application — after 1024 newer
-        # unregistrations an id may be legitimately reused.
-        from collections import OrderedDict
+        # Time-bounded: a tombstone only needs to outlive straggler
+        # CONNECTIONS of its own job (seconds-to-minutes), so entries
+        # expire after DEAD_TTL_S — memory stays bounded by the unreg
+        # rate without a count cap that evicts still-live tombstones
+        # under many-shuffle workloads.
+        import time as _time
 
-        dead: "OrderedDict[int, None]" = OrderedDict()
-        DEAD_CAP = 1024
+        dead: Dict[int, float] = {}  # sid -> unregister time
+        DEAD_TTL_S = 3600.0
+
+        def _is_dead(sid: int) -> bool:
+            t = dead.get(sid)
+            return t is not None and _time.monotonic() - t < DEAD_TTL_S
+
+        def _expire_dead() -> None:
+            now = _time.monotonic()
+            for k in [k for k, t in dead.items() if now - t >= DEAD_TTL_S]:
+                del dead[k]
         lock = threading.Lock()
         commit_cv = threading.Condition(lock)
         self._published = published
@@ -175,7 +185,7 @@ class RssServer:
                                 # never mixes into the served set.
                                 # An unregistered shuffle is a tombstone:
                                 # discard, never resurrect.
-                                if sid in dead or (sid, mid) in published:
+                                if _is_dead(sid) or (sid, mid) in published:
                                     staged.pop((sid, mid, aid), None)
                                     won = False
                                 else:
@@ -196,10 +206,8 @@ class RssServer:
                                 for key in [k for k in published if k[0] == sid]:
                                     del published[key]
                                 committed.pop(sid, None)
-                                dead[sid] = None
-                                dead.move_to_end(sid)
-                                while len(dead) > DEAD_CAP:
-                                    dead.popitem(last=False)
+                                dead[sid] = _time.monotonic()
+                                _expire_dead()
                             sock.sendall(b"\x01")
                         else:
                             raise ConnectionError(f"bad rss opcode {op}")

@@ -32,7 +32,9 @@ from ..parallel import (
     RoundRobinPartitioning, SinglePartitioning,
 )
 from ..schema import DataType, Field, Schema
-from .expr_converter import UnsupportedSparkExpr, convert_expr
+from .expr_converter import (
+    UnsupportedSparkExpr, convert_expr, convert_expr_with_fallback,
+)
 from .plan_json import SparkNode, expr_id
 
 
@@ -83,8 +85,8 @@ def _named_expr(n: SparkNode) -> Tuple[Expr, str]:
     if n.name == "Alias":
         eid = expr_id(n.fields.get("exprId"))
         name = f"#{eid}" if eid is not None else n.fields.get("name", "?")
-        return convert_expr(n.children[0]), name
-    e = convert_expr(n)
+        return convert_expr_with_fallback(n.children[0]), name
+    e = convert_expr_with_fallback(n)
     return e, f"_c{id(n) & 0xffff}"
 
 
@@ -190,7 +192,7 @@ def _sort_fields(orders: Sequence[SparkNode]) -> List[SortField]:
         nulls_first = o.string("nullOrdering", "") == "NullsFirst" or (
             "nullOrdering" not in o.fields and asc  # Spark default: nulls first iff asc
         )
-        out.append(SortField(convert_expr(o.children[0]), asc, nulls_first))
+        out.append(SortField(convert_expr_with_fallback(o.children[0]), asc, nulls_first))
     return out
 
 
@@ -214,16 +216,16 @@ def _agg_function(agg_expr: SparkNode) -> AggFunction:
         kids = fn_node.children
         if not kids or (len(kids) == 1 and kids[0].name == "Literal"):
             return AggFunction("count_star", None, name)
-        return AggFunction("count", convert_expr(kids[0]), name)
+        return AggFunction("count", convert_expr_with_fallback(kids[0]), name)
     if cls == "First":
         ignore = fn_node.fields.get("ignoreNulls")
         if ignore is None and len(fn_node.children) > 1:
             lit = fn_node.children[1]
             ignore = str(lit.fields.get("value", "false")).lower() == "true"
         fn = "first_ignores_null" if ignore else "first"
-        return AggFunction(fn, convert_expr(fn_node.children[0]), name)
+        return AggFunction(fn, convert_expr_with_fallback(fn_node.children[0]), name)
     if cls in _AGG_FNS:
-        return AggFunction(_AGG_FNS[cls], convert_expr(fn_node.children[0]), name)
+        return AggFunction(_AGG_FNS[cls], convert_expr_with_fallback(fn_node.children[0]), name)
     raise UnsupportedSparkExec(f"aggregate function {cls}")
 
 
@@ -340,7 +342,7 @@ def _convert_filter(node: SparkNode, ctx: ConversionContext) -> ExecNode:
     cond = node.expr("condition")
     if cond is None:
         raise UnsupportedSparkExec("FilterExec without condition")
-    return FilterExec(child, convert_expr(cond))
+    return FilterExec(child, convert_expr_with_fallback(cond))
 
 
 def _convert_agg(node: SparkNode, ctx: ConversionContext) -> ExecNode:
@@ -397,7 +399,7 @@ def _partitioning(node: SparkNode, ctx: ConversionContext):
         p = node.expr("outputPartitioning")
         if p.name == "HashPartitioning":
             n_out = int(p.fields.get("numPartitions", ctx.default_parallelism))
-            return HashPartitioning([convert_expr(k) for k in p.children], n_out)
+            return HashPartitioning([convert_expr_with_fallback(k) for k in p.children], n_out)
         if p.name == "RangePartitioning":
             from ..parallel import RangePartitioning
 
@@ -434,7 +436,7 @@ def _join_sides(node: SparkNode, ctx: ConversionContext):
     lkeys = [convert_expr(k) for k in node.expr_list("leftKeys")]
     rkeys = [convert_expr(k) for k in node.expr_list("rightKeys")]
     cond = node.fields.get("condition")
-    cond_e = convert_expr(node.expr("condition")) if cond else None
+    cond_e = convert_expr_with_fallback(node.expr("condition")) if cond else None
     return left, right, lkeys, rkeys, cond_e
 
 
@@ -521,7 +523,7 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
                 raise UnsupportedSparkExec(f"{cls} with non-null default")
             functions.append(
                 WindowFunction(
-                    cls.lower(), out_name, convert_expr(wf.children[0]),
+                    cls.lower(), out_name, convert_expr_with_fallback(wf.children[0]),
                     offset=int(off_node.fields.get("value", 1)),
                     ignore_nulls=ignore,
                 )
@@ -539,7 +541,7 @@ def _convert_window(node: SparkNode, ctx: ConversionContext) -> ExecNode:
                 raise UnsupportedSparkExec("nth_value with non-literal n")
             functions.append(
                 WindowFunction(
-                    "nth_value", out_name, convert_expr(wf.children[0]),
+                    "nth_value", out_name, convert_expr_with_fallback(wf.children[0]),
                     offset=int(k.fields.get("value", 1)),
                     whole_partition=whole,
                 )

@@ -7,9 +7,12 @@ Attributes are addressed by exprId — column names in converted plans
 are ``#<id>`` exactly like the reference's bound references, with a
 final rename back to user-facing names at the plan root.
 
-Unconvertible expressions raise :class:`UnsupportedSparkExpr`; the
-strategy layer (``strategy.py``) turns that into per-subtree fallback
-the way ``convertExprWithFallback`` wraps into a JVM-callback UDF.
+Unconvertible expressions raise :class:`UnsupportedSparkExpr`.  When
+the UDF evaluator seam is installed, :func:`convert_expr_with_fallback`
+first wraps the unconvertible expression (or just its one offending
+child) into a ``SparkUdfWrapper`` so the operator stays native — the
+reference's ``convertExprWithFallback`` JVM-callback path; otherwise
+the strategy layer turns the raise into per-subtree host fallback.
 """
 
 from __future__ import annotations
@@ -207,6 +210,12 @@ def convert_expr(node: SparkNode) -> Expr:
     name = node.name
     kids = node.children
 
+    if name == "__WrappedIR":
+        # internal marker from convert_expr_with_fallback: a child
+        # subtree already converted (possibly into a SparkUdfWrapper),
+        # grafted back so the PARENT's dispatch can retry natively —
+        # ≙ the reference's NativeExprWrapper (convertExpr:305)
+        return node.fields["ir"]
     if name == "AttributeReference":
         return Col(_attr_name(node))
     if name == "BoundReference":
@@ -310,3 +319,113 @@ def convert_expr(node: SparkNode) -> Expr:
     if name in _FUNC_CLASSES:
         return ScalarFunc(_FUNC_CLASSES[name], [convert_expr(k) for k in kids])
     raise UnsupportedSparkExpr(f"expression class {node.cls}")
+
+
+# ------------------------------------------- UDF-wrapper expression fallback
+
+def _node_to_flat_json(node: SparkNode) -> List[dict]:
+    """Re-serialize a SparkNode subtree into catalyst's flat preorder
+    ``toJSON`` array (class / num-children / raw constructor fields) —
+    the canonical byte representation this seam uses where the
+    reference Java-serializes the live Expression object
+    (NativeConverters.serializeExpression)."""
+    out: List[dict] = []
+
+    def go(n: SparkNode) -> None:
+        out.append({"class": n.cls, "num-children": len(n.children), **n.fields})
+        for c in n.children:
+            go(c)
+
+    go(node)
+    return out
+
+
+def convert_expr_with_fallback(node: SparkNode) -> Expr:
+    """≙ reference ``convertExpr:305`` + ``convertExprWithFallback:407``
+    with the same 0/1/N-inconvertible-children policy:
+
+    - node converts natively -> done;
+    - exactly ONE child is inconvertible -> wrap just that child
+      (recursively) and retry the node natively over the grafted
+      result — a ``GreaterThan(udf, lit)`` filter keeps its native
+      comparison and only the udf round-trips;
+    - otherwise wrap the WHOLE node: bind every maximal convertible
+      child subtree as a native param (``BoundReference(i)`` in the
+      rebound tree), serialize the rebound catalyst subtree as the
+      opaque blob, and emit ``SparkUdfWrapper`` so the OPERATOR stays
+      native and only this expression crosses the evaluator seam (the
+      JVM half in the reference, ``spark.udf_bridge`` here).
+
+    Wrapping needs two things the reference gets from the live JVM:
+    the expression's return type (taken from the dump's ``dataType``
+    field — present on ScalaUDF/PythonUDF; Hive UDFs compute it
+    lazily and do not dump it) and an installed evaluator.  When
+    either is missing the original UnsupportedSparkExpr propagates
+    and the strategy layer keeps its per-subtree host fallback."""
+    from . import udf_bridge
+
+    try:
+        return convert_expr(node)
+    except UnsupportedSparkExpr:
+        if not udf_bridge.has_evaluator():
+            raise
+        bad = []
+        for c in node.children:
+            try:
+                convert_expr(c)
+            except UnsupportedSparkExpr:
+                bad.append(c)
+        if len(bad) == 1:
+            try:
+                grafted = [
+                    SparkNode("__WrappedIR",
+                              {"ir": convert_expr_with_fallback(c),
+                               "dataType": c.fields.get("dataType")}, [])
+                    if c is bad[0] else c
+                    for c in node.children
+                ]
+                return convert_expr(SparkNode(node.cls, node.fields, grafted))
+            except UnsupportedSparkExpr:
+                pass  # node class itself unsupported: wrap the whole node
+        return _wrap_node(node)
+
+
+def _wrap_node(node: SparkNode) -> Expr:
+    import json as _json
+
+    dt_raw = node.fields.get("dataType")
+    if dt_raw is None:
+        raise UnsupportedSparkExpr(
+            f"expression class {node.cls} (unconvertible and no dataType "
+            "in the dump to wrap it as a SparkUdfWrapper)")
+    out_dtype = convert_data_type(dt_raw)
+    params: List[Expr] = []
+
+    def rebind(n: SparkNode) -> SparkNode:
+        if n.name == "Literal":
+            return n  # literals stay inline (reference does the same)
+        try:
+            ir = convert_expr(n)
+        except UnsupportedSparkExpr:
+            return SparkNode(
+                n.cls, n.fields, [rebind(c) for c in n.children])
+        idx = len(params)
+        params.append(ir)
+        return SparkNode(
+            "org.apache.spark.sql.catalyst.expressions.BoundReference",
+            {"ordinal": idx,
+             "dataType": n.fields.get("dataType", "null"),
+             "nullable": True},
+            [],
+        )
+
+    bound = SparkNode(node.cls, node.fields,
+                      [rebind(c) for c in node.children])
+    from ..exprs.ir import SparkUdfWrapper
+
+    return SparkUdfWrapper(
+        serialized=_json.dumps(_node_to_flat_json(bound)).encode(),
+        args=params,
+        dtype=out_dtype,
+        expr_string=node.name,
+    )

@@ -38,6 +38,12 @@ def register_udf_evaluator(fn: Optional[Callable]) -> None:
     _EVALUATOR = fn
 
 
+def has_evaluator() -> bool:
+    """True when the JVM half is present — the conversion layer only
+    emits SparkUdfWrapper fallbacks it can actually evaluate."""
+    return _EVALUATOR is not None
+
+
 def evaluate(serialized: bytes, args_batch: RecordBatch,
              out_dtype: DataType, expr_string: str = "",
              capacity: int = None) -> Column:

@@ -520,3 +520,91 @@ def test_generate_json_tuple_conversion():
     out = sess.execute(F.flatten(g))
     assert out["#10"] == ["1", "2", None]
     assert out["#11"] == ["x", None, None]
+
+
+# ------------------------------- expression-level UDF wrapper fallback
+
+def test_unconvertible_expr_wraps_as_udf_not_subtree_fallback():
+    """≙ NativeConverters.convertExpr:305/convertExprWithFallback:407:
+    an unconvertible EXPRESSION (here a ScalaUDF) inside a projection
+    or filter binds its convertible children as native params, ships
+    the rebound catalyst subtree as the opaque blob, and the OPERATOR
+    stays native — the session needs no host_fallback at all.  The
+    evaluator (the JVM half) receives args over the Arrow C FFI and
+    the blob it must deserialize; dropping the evaluator restores the
+    per-subtree host fallback path."""
+    import json
+
+    from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+    from blaze_tpu.gateway import export_batch_ffi, import_batch_ffi
+    from blaze_tpu.runtime.scheduler import run_stages, split_stages
+    from blaze_tpu.schema import Field as BField, Schema as BSchema
+    from blaze_tpu.spark import udf_bridge
+    from blaze_tpu.spark.expr_converter import UnsupportedSparkExpr
+
+    sess, data = make_session()
+    blobs = []
+
+    def evaluate(serialized, args_addr, args_schema, out_dtype):
+        # the "JVM": deserialize the rebound expression and interpret
+        # it — the blob is the catalyst subtree with BoundReferences
+        flat = json.loads(bytes(serialized).decode())
+        blobs.append(flat)
+        assert flat[0]["class"].endswith("ScalaUDF")
+        ords = [n["ordinal"] for n in flat if n["class"].endswith("BoundReference")]
+        assert sorted(ords) == list(range(len(args_schema.fields)))
+        args = import_batch_ffi(args_addr, args_schema)
+        d = batch_to_pydict(args)
+        cols = [d[f.name] for f in args_schema.fields]
+        out = [None if (a is None or b is None) else a * 2 + b
+               for a, b in zip(*cols)]
+        out_schema = BSchema([BField("__udf_out", out_dtype)])
+        return export_batch_ffi(batch_from_pydict({"__udf_out": out}, out_schema))
+
+    udf = F.T(
+        "org.apache.spark.sql.catalyst.expressions.ScalaUDF",
+        [F.attr("l_quantity", 1), F.attr("l_discount", 3)],
+        dataType="long", udfName="q2d",
+    )
+    s = F.scan("lineitem", [F.attr("l_quantity", 1),
+                            F.attr("l_extendedprice", 2),
+                            F.attr("l_discount", 3)])
+    f = F.filter_(F.binop("GreaterThan", udf, F.lit(50, "long")), s)
+    pr = F.project([F.alias(udf, "u", 10),
+                    F.alias(F.attr("l_extendedprice", 2), "price", 11)], f)
+    js = json.dumps([dict(x) for x in F.flatten(pr)])
+
+    exp = [
+        (q * 2 + disc, p)
+        for q, p, disc in zip(data["l_quantity"], data["l_extendedprice"],
+                              data["l_discount"])
+        if q * 2 + disc > 50
+    ]
+
+    udf_bridge.register_udf_evaluator(evaluate)
+    try:
+        # no host_fallback: conversion would RAISE if the wrapper
+        # didn't keep the operators native
+        got = sess.execute(js)
+        assert sorted(zip(got["u"], got["price"])) == sorted(exp)
+        assert blobs, "evaluator never saw the serialized blob"
+
+        # same plan across the serde + scheduler boundary (the blob
+        # rides the TaskDefinition protobuf bit-for-bit)
+        stages, manager = split_stages(sess.plan(js))
+        got2 = {"u": [], "price": []}
+        for b in run_stages(stages, manager):
+            d = batch_to_pydict(b)
+            got2["u"].extend(d["u"])
+            got2["price"].extend(d["price"])
+        assert sorted(zip(got2["u"], got2["price"])) == sorted(exp)
+    finally:
+        udf_bridge.register_udf_evaluator(None)
+
+    # without the evaluator the wrapper is not emitted: the session
+    # (which has no host_fallback) surfaces the strategy-layer
+    # unconvertible error — the per-subtree fallback path as before
+    from blaze_tpu.spark.converters import UnsupportedSparkExec
+
+    with pytest.raises(UnsupportedSparkExec, match="unconvertible"):
+        sess.plan(js)
