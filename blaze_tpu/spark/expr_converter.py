@@ -379,8 +379,7 @@ def convert_expr_with_fallback(node: SparkNode) -> Expr:
             try:
                 grafted = [
                     SparkNode("__WrappedIR",
-                              {"ir": convert_expr_with_fallback(c),
-                               "dataType": c.fields.get("dataType")}, [])
+                              {"ir": convert_expr_with_fallback(c)}, [])
                     if c is bad[0] else c
                     for c in node.children
                 ]
@@ -388,6 +387,42 @@ def convert_expr_with_fallback(node: SparkNode) -> Expr:
             except UnsupportedSparkExpr:
                 pass  # node class itself unsupported: wrap the whole node
         return _wrap_node(node)
+
+
+_BOOL_VALUED = {
+    "EqualTo", "EqualNullSafe", "LessThan", "LessThanOrEqual", "GreaterThan",
+    "GreaterThanOrEqual", "And", "Or", "Not", "IsNull", "IsNotNull", "In",
+    "InSet", "Like", "RLike", "StartsWith", "EndsWith", "Contains",
+}
+_ARITH = {"Add", "Subtract", "Multiply", "Divide", "Remainder", "Pmod",
+          "UnaryMinus", "Abs", "PromotePrecision", "CheckOverflow"}
+_TYPE_RANK = ["byte", "short", "integer", "long", "float", "double"]
+
+
+def _dump_type(n: SparkNode):
+    """Best-effort catalyst type of a dump subtree — the reference
+    reads ``p.dataType`` off the live JVM expression when building the
+    wrapper's param BoundReferences; a dump only carries the field on
+    leaf-ish classes (attributes, casts, literals, UDFs), so walk the
+    common compute shapes and return None when truly unknown."""
+    if "dataType" in n.fields:
+        return n.fields["dataType"]
+    name = n.name
+    if name in _BOOL_VALUED:
+        return "boolean"
+    if name in _ARITH and n.children:
+        kid_types = [_dump_type(c) for c in n.children]
+        if any(t is None for t in kid_types):
+            return None
+        # numeric promotion by rank; equal/decimal types pass through
+        # (decimal precision widening is approximated by the child's)
+        ranked = [t for t in kid_types if isinstance(t, str) and t in _TYPE_RANK]
+        if len(ranked) == len(kid_types):
+            return max(ranked, key=_TYPE_RANK.index)
+        return kid_types[0]
+    if name in ("Alias", "Cast", "TryCast") and n.children:
+        return n.fields.get("dataType") or _dump_type(n.children[0])
+    return None
 
 
 def _wrap_node(node: SparkNode) -> Expr:
@@ -411,11 +446,16 @@ def _wrap_node(node: SparkNode) -> Expr:
                 n.cls, n.fields, [rebind(c) for c in n.children])
         idx = len(params)
         params.append(ir)
+        ptype = _dump_type(n)
+        if ptype is None:
+            # a NullType BoundReference would make a real JVM half
+            # evaluate the param as null — refuse instead of lying
+            raise UnsupportedSparkExpr(
+                f"cannot type wrapper param {n.cls} for the serialized "
+                "BoundReference")
         return SparkNode(
             "org.apache.spark.sql.catalyst.expressions.BoundReference",
-            {"ordinal": idx,
-             "dataType": n.fields.get("dataType", "null"),
-             "nullable": True},
+            {"ordinal": idx, "dataType": ptype, "nullable": True},
             [],
         )
 

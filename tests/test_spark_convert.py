@@ -551,8 +551,12 @@ def test_unconvertible_expr_wraps_as_udf_not_subtree_fallback():
         flat = json.loads(bytes(serialized).decode())
         blobs.append(flat)
         assert flat[0]["class"].endswith("ScalaUDF")
-        ords = [n["ordinal"] for n in flat if n["class"].endswith("BoundReference")]
-        assert sorted(ords) == list(range(len(args_schema.fields)))
+        brefs = [n for n in flat if n["class"].endswith("BoundReference")]
+        assert sorted(n["ordinal"] for n in brefs) == list(
+            range(len(args_schema.fields)))
+        # the blob must type every param truthfully — a NullType
+        # BoundReference would make a real JVM evaluate params as null
+        assert all(n["dataType"] == "long" for n in brefs), brefs
         args = import_batch_ffi(args_addr, args_schema)
         d = batch_to_pydict(args)
         cols = [d[f.name] for f in args_schema.fields]
@@ -561,9 +565,12 @@ def test_unconvertible_expr_wraps_as_udf_not_subtree_fallback():
         out_schema = BSchema([BField("__udf_out", out_dtype)])
         return export_batch_ffi(batch_from_pydict({"__udf_out": out}, out_schema))
 
+    # first param is a COMPUTED subtree (Add dumps no dataType field:
+    # the wrapper must derive the BoundReference type, not write null)
     udf = F.T(
         "org.apache.spark.sql.catalyst.expressions.ScalaUDF",
-        [F.attr("l_quantity", 1), F.attr("l_discount", 3)],
+        [F.binop("Add", F.attr("l_quantity", 1), F.attr("l_discount", 3)),
+         F.attr("l_discount", 3)],
         dataType="long", udfName="q2d",
     )
     s = F.scan("lineitem", [F.attr("l_quantity", 1),
@@ -575,10 +582,10 @@ def test_unconvertible_expr_wraps_as_udf_not_subtree_fallback():
     js = json.dumps([dict(x) for x in F.flatten(pr)])
 
     exp = [
-        (q * 2 + disc, p)
+        ((q + disc) * 2 + disc, p)
         for q, p, disc in zip(data["l_quantity"], data["l_extendedprice"],
                               data["l_discount"])
-        if q * 2 + disc > 50
+        if (q + disc) * 2 + disc > 50
     ]
 
     udf_bridge.register_udf_evaluator(evaluate)
