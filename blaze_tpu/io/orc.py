@@ -111,18 +111,25 @@ def orc_decompress(buf: bytes, kind: int) -> bytes:
 
 def orc_compress(data: bytes, kind: int, block: int = 65536) -> bytes:
     """Writer half of the chunked framing: split into <= ``block``-byte
-    chunks, deflate each, store verbatim (original bit) when
-    compression does not shrink the chunk — the exact format
-    orc_decompress consumes and ORC C++ readers expect."""
+    chunks, compress each (zlib raw-deflate or zstd), store verbatim
+    (original bit) when compression does not shrink the chunk — the
+    exact format orc_decompress consumes and ORC C++ readers expect."""
     if kind == C_NONE or not data:
         return data
-    if kind != C_ZLIB:
+    if kind not in (C_ZLIB, C_ZSTD):
         raise NotImplementedError(f"ORC writer compression kind {kind}")
+    if kind == C_ZSTD:
+        import zstandard
+
+        zc = zstandard.ZstdCompressor()
     out = bytearray()
     for pos in range(0, len(data), block):
         chunk = data[pos : pos + block]
-        co = zlib.compressobj(6, zlib.DEFLATED, -15)
-        comp = co.compress(chunk) + co.flush()
+        if kind == C_ZSTD:
+            comp = zc.compress(chunk)
+        else:
+            co = zlib.compressobj(6, zlib.DEFLATED, -15)
+            comp = co.compress(chunk) + co.flush()
         if len(comp) < len(chunk):
             h = len(comp) << 1
             out += bytes([h & 0xFF, (h >> 8) & 0xFF, (h >> 16) & 0xFF])
@@ -754,10 +761,10 @@ def write_orc(
     (None, validity|None, lengths, (elem_data_2d, elem_valid_2d)).
     MAP/STRUCT/nested-LIST fields take a plain python value list
     (None/list/dict per row — the reader's compound-path shape).
-    ``compression``: "none" or "zlib" (Spark's ORC default) — every
-    stream, stripe footer, Metadata and Footer region gets the chunked
-    [u24 header][deflate block] framing; the PostScript stays raw."""
-    comp_kind = {"none": C_NONE, "zlib": C_ZLIB}[compression]
+    ``compression``: "none", "zlib" (Spark's ORC default) or "zstd" —
+    every stream, stripe footer, Metadata and Footer region gets the
+    chunked [u24 header][block] framing; the PostScript stays raw."""
+    comp_kind = {"none": C_NONE, "zlib": C_ZLIB, "zstd": C_ZSTD}[compression]
     any_name = next(iter(columns))
     any_col = columns[any_name]
     any_dt = schema.field(any_name).dtype

@@ -481,9 +481,10 @@ def test_writer_array_first_column_and_nested_has_null_stats(tmp_path):
     assert not has_null(cols[1]), "vals has no nulls"
 
 
-def test_writer_zlib_compression_roundtrip(tmp_path):
-    """compression="zlib" (Spark's ORC default): every region gets the
-    chunked deflate framing; our reader and pyarrow both read it and
+@pytest.mark.parametrize("codec", ["zlib", "zstd"])
+def test_writer_compression_roundtrip(tmp_path, codec):
+    """compression="zlib" (Spark's ORC default) / "zstd": every region
+    gets the chunked framing; our reader and pyarrow both read it and
     the file is materially smaller."""
     import os
 
@@ -499,7 +500,7 @@ def test_writer_zlib_compression_roundtrip(tmp_path):
     cols = {"k": (k, None, None), "m": m_vals}
     pz = str(tmp_path / "z.orc")
     pn = str(tmp_path / "n.orc")
-    write_orc(pz, schema, cols, stripe_rows=1500, compression="zlib")
+    write_orc(pz, schema, cols, stripe_rows=1500, compression=codec)
     write_orc(pn, schema, cols, stripe_rows=1500)
     assert os.path.getsize(pz) < os.path.getsize(pn) // 2
 
