@@ -69,8 +69,82 @@ CACHED_RESULT_PATH = os.path.join(
 )
 
 
+def _estimate_tunnel_bw(timeout_s: float = 300.0) -> float:
+    """H2D bytes/s through the (possibly remote) device path, measured
+    with a ~1 MB staging probe — round-5 postmortem: the tunnel ran at
+    ~30 KB/s (vs round-2's 10-60 MB/s), so the fixed SCALE_Q6=8 staging
+    (1.3 GB) could never complete and the measurement child sat on the
+    lease for hours.  Scales must be sized to the day's tunnel.
+
+    Bounded: a WEDGED tunnel hangs device_put (no exception to catch),
+    so the transfer runs in a daemon thread; on timeout the elapsed
+    time itself upper-bounds the bandwidth and the floor scales apply.
+    At slow tunnels one run suffices (transfer dwarfs the one-off
+    slice compile); when run 1 reads fast, the compile skew matters
+    and a second same-shape run (compile now cached, probe bytes now
+    cheap) gives the accurate number."""
+    import threading
+
+    import jax
+
+    rng = np.random.RandomState(0)
+    a = rng.randint(0, 255, size=1_000_000).astype(np.uint8)
+
+    def one_run(tmo: float):
+        done = {}
+
+        def probe():
+            t0 = time.perf_counter()
+            d = jax.device_put(a)
+            np.asarray(d[:1])  # true sync: D2H forces the H2D to drain
+            done["dt"] = max(time.perf_counter() - t0, 1e-3)
+
+        th = threading.Thread(target=probe, daemon=True)
+        t0 = time.perf_counter()
+        th.start()
+        th.join(tmo)
+        if "dt" not in done:
+            # hung/ultra-slow: elapsed bounds the rate from above
+            return a.nbytes / max(time.perf_counter() - t0, 1e-3), False
+        return a.nbytes / done["dt"], True
+
+    bw, ok = one_run(timeout_s)
+    if ok and bw >= 1e6:
+        bw2, ok2 = one_run(60.0)
+        if ok2:
+            bw = max(bw, bw2)
+    return bw
+
+
+# host bytes staged per scale factor (referenced columns only)
+_BYTES_PER_SF_Q6 = 170e6   # 4 numeric columns
+_BYTES_PER_SF_Q1 = 330e6   # 7 columns incl. two strings
+
+# coarse grid so adapted scales hit the datagen disk cache instead of
+# minting a fresh multi-hundred-MB .npz per bandwidth wiggle
+_SCALE_GRID = (0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 4.0, 8.0)
+
+
+def _quantize_scale(v: float, lo: float) -> float:
+    fit = [s for s in _SCALE_GRID if s <= v]
+    return max(fit[-1] if fit else _SCALE_GRID[0], lo)
+
+
+def _adapt_scales(bw: float) -> tuple:
+    """Largest measurement scales whose staging fits the budget at the
+    observed bandwidth (explicit BLAZE_BENCH_SCALE_* env wins; the
+    driver-window parent passes a deadline-derived budget)."""
+    budget_s = float(os.environ.get("BLAZE_BENCH_STAGE_BUDGET", "480"))
+    s6, s1 = SCALE_Q6, SCALE_Q1
+    if "BLAZE_BENCH_SCALE_Q6" not in os.environ:
+        s6 = min(SCALE_Q6, _quantize_scale(bw * budget_s / _BYTES_PER_SF_Q6, 0.05))
+    if "BLAZE_BENCH_SCALE_Q1" not in os.environ:
+        s1 = min(SCALE_Q1, _quantize_scale(bw * budget_s / _BYTES_PER_SF_Q1 / 2, 0.05))
+    return s6, s1
+
+
 def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
-             partial_sink=None, retries: int = 0) -> dict:
+             partial_sink=None, retries: int = 0, extras: dict = None) -> dict:
     """Run q06 + q01 through the engine on the already-initialized
     backend; returns the result dict (no printing).
 
@@ -213,6 +287,8 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
         "iterations": 3,
         "backend": "tpu" if on_tpu else "cpu",
     }
+    if extras:
+        result.update(extras)
     if partial_sink is not None:
         partial_sink(dict(result))
 
@@ -338,8 +414,19 @@ def _tpu_child(out_path: str) -> None:
         _measure(0.01, 0.01, on_tpu=on_tpu)
     except Exception:  # noqa: BLE001 — warmup failure: let the real
         pass  # attempt produce the authoritative error/result
-    publish(_measure(SCALE_Q6, SCALE_Q1, on_tpu=on_tpu,
-                     partial_sink=publish, retries=2))
+    # size the measurement to the day's tunnel (round-5 postmortem: a
+    # ~30 KB/s tunnel made the fixed 1.3 GB SF8 staging infeasible and
+    # the child sat on the lease for hours without a number)
+    try:
+        bw = _estimate_tunnel_bw() if on_tpu else float("inf")
+    except Exception:  # noqa: BLE001 — a failed probe must not kill
+        bw = float("inf")  # the attempt; fall back to the env scales
+    s6, s1 = _adapt_scales(bw)
+    extras = {}
+    if bw != float("inf"):
+        extras["tunnel_bytes_per_sec"] = round(bw, 1)
+    publish(_measure(s6, s1, on_tpu=on_tpu,
+                     partial_sink=publish, retries=2, extras=extras))
 
 
 def _smoke(scale: float) -> None:
@@ -502,11 +589,17 @@ def main() -> None:
     while time.time() < deadline:
         if tpu_child is None and probe_ok.is_set():
             print("# bench: TPU probe ok, launching measurement child", file=sys.stderr)
+            # the driver-window child's staging must fit what is LEFT
+            # of this window (watchdog children keep the big default —
+            # they have no deadline and bigger scale = better number)
+            child_env = dict(_tpu_env())
+            child_env.setdefault("BLAZE_BENCH_STAGE_BUDGET", str(int(
+                max(120.0, deadline - time.time() - 180.0))))
             tpu_child = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--tpu-child", tpu_result_path],
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,
-                env=_tpu_env(),
+                env=child_env,
                 start_new_session=True,  # NEVER killed with this parent:
                 # killing a chip-holding process wedges the lease for hours
             )
