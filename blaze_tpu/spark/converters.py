@@ -208,6 +208,15 @@ def _agg_function(agg_expr: SparkNode) -> AggFunction:
     """AggregateExpression -> engine AggFunction named #<resultId>
     (resultIds are stable across the partial/final split, which keeps
     the state-column names aligned between the two stages)."""
+    # silently dropping either of these would return plausible wrong
+    # numbers: FILTER (WHERE ...) restricts which rows aggregate, and
+    # isDistinct survives into physical plans when Spark's distinct
+    # rewrite leaves a single distinct group intact — gate so the
+    # strategy layer falls back the subtree instead
+    if agg_expr.fields.get("isDistinct") in (True, "true"):
+        raise UnsupportedSparkExec("distinct aggregate expression")
+    if agg_expr.fields.get("filter") not in (None, "null", []):
+        raise UnsupportedSparkExec("AggregateExpression FILTER clause")
     fn_node = agg_expr.children[0]
     rid = expr_id(agg_expr.fields.get("resultId"))
     name = f"#{rid}" if rid is not None else f"agg_{fn_node.name.lower()}"

@@ -615,3 +615,54 @@ def test_unconvertible_expr_wraps_as_udf_not_subtree_fallback():
 
     with pytest.raises(UnsupportedSparkExec, match="unconvertible"):
         sess.plan(js)
+
+
+def test_agg_filter_and_distinct_are_gated():
+    """AggregateExpression FILTER (WHERE ...) and isDistinct must not
+    silently drop — either gates to subtree fallback (wrong numbers
+    otherwise).  The gate itself is pinned via _agg_function (the
+    strategy layer genericizes the message before sess.plan sees it)."""
+    from blaze_tpu.spark.converters import UnsupportedSparkExec, _agg_function
+    from blaze_tpu.spark.plan_json import _parse_tree
+
+    sess, data = make_session()
+
+    def agg_expr_node(**agg_extra):
+        ae = F.T(
+            "org.apache.spark.sql.catalyst.expressions.aggregate.AggregateExpression",
+            [F.sum_(F.attr("l_quantity", 1))],
+            mode="Partial", resultId=F.eid(20), **agg_extra,
+        )
+        return _parse_tree([dict(x) for x in F.flatten(ae)])
+
+    # gate-level: the specific messages
+    with pytest.raises(UnsupportedSparkExec, match="distinct aggregate"):
+        _agg_function(agg_expr_node(isDistinct=True))
+    with pytest.raises(UnsupportedSparkExec, match="FILTER clause"):
+        _agg_function(agg_expr_node(filter=[dict(x) for x in F.flatten(
+            F.binop("GreaterThan", F.attr("l_quantity", 1), F.lit(5, "long")))]))
+    # plain agg converts
+    assert _agg_function(agg_expr_node()).fn == "sum"
+
+    def agg_plan(**agg_extra):
+        s = F.scan("lineitem", [F.attr("l_quantity", 1)])
+        ae = F.T(
+            "org.apache.spark.sql.catalyst.expressions.aggregate.AggregateExpression",
+            [F.sum_(F.attr("l_quantity", 1))],
+            mode="Partial", resultId=F.eid(20), **agg_extra,
+        )
+        partial = F.T(
+            "org.apache.spark.sql.execution.aggregate.HashAggregateExec",
+            [s], groupingExpressions=[], aggregateExpressions=[[dict(x) for x in F.flatten(ae)]],
+            resultExpressions=[],
+        )
+        return json.dumps([dict(x) for x in F.flatten(partial)])
+
+    with pytest.raises(UnsupportedSparkExec, match="distinct|unconvertible"):
+        sess.plan(agg_plan(isDistinct=True))
+    with pytest.raises(UnsupportedSparkExec, match="FILTER|unconvertible"):
+        sess.plan(agg_plan(
+            isDistinct=False,
+            filter=[dict(x) for x in F.flatten(
+                F.binop("GreaterThan", F.attr("l_quantity", 1), F.lit(5, "long")))],
+        ))
