@@ -7,6 +7,7 @@ ExecutionPlan builder) plus the task entry half of blaze/src/exec.rs
 
 from __future__ import annotations
 
+import logging
 import pickle
 from typing import List, Optional
 
@@ -17,6 +18,8 @@ from ..exprs.ir import (
 )
 from ..schema import DataType, Field, Schema, TypeKind
 from . import plan_pb2 as pb
+
+_log = logging.getLogger("blaze_tpu.task")
 
 
 def dtype_from_proto(t: pb.DataTypeProto) -> DataType:
@@ -391,5 +394,10 @@ def run_task(task_def_bytes: bytes):
     from ..ops.pruning import prune_columns
 
     plan = prune_columns(fuse_stages(plan_from_proto(td.plan)))
+    if _log.isEnabledFor(logging.DEBUG):
+        # ≙ the reference's native plan display at task start
+        # (blaze/src/exec.rs:101-106)
+        _log.debug("task %s partition %d plan:\n%s",
+                   td.task_id, td.partition, plan.tree_string())
     ctx = TaskContext(td.partition, max(plan.num_partitions(), td.partition + 1))
     return plan.execute(td.partition, ctx)

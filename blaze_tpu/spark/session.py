@@ -13,6 +13,7 @@ partition per stage).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional, Union
 
 from ..batch import batch_from_pydict, batch_to_pydict
@@ -22,6 +23,8 @@ from ..schema import Schema
 from .converters import ConversionContext
 from .plan_json import SparkNode, parse_plan_json
 from .strategy import convert_spark_plan
+
+_log = logging.getLogger("blaze_tpu.spark")
 
 
 class BlazeSparkSession:
@@ -80,7 +83,12 @@ class BlazeSparkSession:
             default_parallelism=self.default_parallelism,
             host_fallback=self.host_fallback,
         )
-        return convert_spark_plan(node, ctx)
+        converted = convert_spark_plan(node, ctx)
+        if _log.isEnabledFor(logging.DEBUG):
+            # ≙ the reference's plan dump at conversion
+            # (BlazeSparkSessionExtension.scala:52-61,80-88)
+            _log.debug("converted plan:\n%s", converted.tree_string())
+        return converted
 
     # --------------------------------------------------------- execution
 
