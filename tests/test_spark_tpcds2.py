@@ -2047,3 +2047,203 @@ def test_spark_q64(sess, data, strategy):
     else:
         assert all(exp.get(k) == v for k, v in rows_g.items())
     assert got["s1"] == sorted(got["s1"], reverse=True)
+
+
+# ---------------------- q51: FULL OUTER of two cumulative-window streams
+
+def _q51_chan(strategy, fact, date_c, item_c, price_c, px, b):
+    """One channel's per-item daily running-sum stream: the FIRST
+    running-frame (order-by default RANGE up->CURRENT ROW) window
+    through the conversion layer."""
+    dt = F.project(
+        [a("d_date_sk"), a("d_date")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_date"), a("d_year")])),
+    )
+    sl = F.scan(fact, [a(date_c), a(item_c), a(price_c)])
+    j = join(strategy, dt, sl, [a("d_date_sk")], [a(date_c)])
+    agg = two_stage([a(item_c), a("d_date")], [(F.sum_(a(price_c)), b)], j)
+    sales = ar("sales", b, "decimal(17,2)")
+    ex = F.shuffle(F.hash_partitioning([a(item_c)], N_PARTS), agg)
+    srt = F.sort([F.sort_order(a(item_c)), F.sort_order(a("d_date"))], ex,
+                 global_=False)
+    w = F.window(
+        [F.window_expr(F.window_agg(F.sum_(sales)),
+                       F.window_spec([a(item_c)], [F.sort_order(a("d_date"))]),
+                       "cume", b + 1)],
+        [a(item_c)], [F.sort_order(a("d_date"))], srt,
+    )
+    return F.project(
+        [F.alias(a(item_c), f"{px}_item_sk", b + 2),
+         F.alias(a("d_date"), f"{px}_date", b + 3),
+         F.alias(ar("cume", b + 1, "decimal(27,2)"), f"{px}_cume", b + 4)],
+        w,
+    )
+
+
+def test_spark_q51(sess, data, strategy):
+    web = _q51_chan(strategy, "web_sales", "ws_sold_date_sk", "ws_item_sk",
+                    "ws_sales_price", "w", 9001)
+    store = _q51_chan(strategy, "store_sales", "ss_sold_date_sk", "ss_item_sk",
+                      "ss_sales_price", "s", 9011)
+    wi, wd = ar("w_item_sk", 9003), ar("w_date", 9004, "date")
+    wc = ar("w_cume", 9005, "decimal(27,2)")
+    si, sd = ar("s_item_sk", 9013), ar("s_date", 9014, "date")
+    sc = ar("s_cume", 9015, "decimal(27,2)")
+    j = big_join(strategy, web, store, [wi, wd], [si, sd], jt="FullOuter")
+    item = F.alias(F.T(F.X + "Coalesce", [wi, si]), "item_sk", 9021)
+    dd = F.alias(F.T(F.X + "Coalesce", [wd, sd]), "d_date", 9022)
+    proj = F.project([item, dd, wc, sc], j)
+    item_a, dd_a = ar("item_sk", 9021), ar("d_date", 9022, "date")
+    ex = F.shuffle(F.hash_partitioning([item_a], N_PARTS), proj)
+    srt = F.sort([F.sort_order(item_a), F.sort_order(dd_a)], ex, global_=False)
+    # running maxes carry each channel's cumulative value across the
+    # FULL OUTER join's null gaps
+    w2 = F.window(
+        [F.window_expr(F.window_agg(F.max_(wc)),
+                       F.window_spec([item_a], [F.sort_order(dd_a)]),
+                       "web_cumulative", 9023),
+         F.window_expr(F.window_agg(F.max_(sc)),
+                       F.window_spec([item_a], [F.sort_order(dd_a)]),
+                       "store_cumulative", 9024)],
+        [item_a], [F.sort_order(dd_a)], srt,
+    )
+    wcu = ar("web_cumulative", 9023, "decimal(27,2)")
+    scu = ar("store_cumulative", 9024, "decimal(27,2)")
+    filt = F.filter_(F.binop("GreaterThan", wcu, scu), w2)
+    plan = F.take_ordered(
+        100, [F.sort_order(item_a), F.sort_order(dd_a)],
+        [item_a, dd_a, wcu, scu], filt,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q51(data)
+    assert exp, "q51 oracle empty"
+    n = len(got["item_sk"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["item_sk"][i], got["d_date"][i])
+        assert key in exp, key
+        assert (got["web_cumulative"][i], got["store_cumulative"][i]) == exp[key], key
+    keys = list(zip(got["item_sk"], got["d_date"]))
+    assert keys == sorted(keys)
+    if len(exp) > 100:
+        assert keys == sorted(exp)[:100]
+
+
+# ------------------------- q44: rank-paired best/worst items by profit
+
+def test_spark_q44(sess, data, strategy):
+    """Two rank() windows (asc/desc) over per-item average profit above
+    90% of a scalar-subquery baseline, joined ON THE RANK — the rank
+    self-pairing + second item scan with fresh exprIds exercise window
+    output flowing into join keys through conversion."""
+    store = F.lit(4, "long")
+    scan_cols = [a("ss_item_sk"), a("ss_net_profit"), a("ss_store_sk"),
+                 a("ss_addr_sk")]
+    base = F.project(
+        [a("ss_item_sk"), a("ss_net_profit")],
+        F.filter_(F.binop("EqualTo", a("ss_store_sk"), store),
+                  F.scan("store_sales", scan_cols)),
+    )
+    per_item = two_stage([a("ss_item_sk")],
+                         [(F.avg(a("ss_net_profit")), 9101)], base)
+    rank_col = ar("rank_col", 9101, "decimal(11,6)")
+    null_addr = F.project(
+        [a("ss_net_profit")],
+        F.filter_(and_(F.binop("EqualTo", a("ss_store_sk"), store),
+                       F.binop("EqualTo", a("ss_addr_sk"), F.lit(-1, "long"))),
+                  F.scan("store_sales", scan_cols)),
+    )
+    thr_plan = two_stage([], [(F.avg(a("ss_net_profit")), 9102)], null_addr)
+    keep = F.filter_(
+        F.binop(
+            "GreaterThan", F.cast(rank_col, "double"),
+            F.binop("Multiply", F.lit(0.9, "double"),
+                    F.cast(_scalar_subquery(thr_plan, 9102), "double")),
+        ),
+        per_item,
+    )
+    single = F.shuffle(F.single_partition(), keep)
+
+    def ranked(asc, item_alias, rnk_alias, b):
+        o = [F.sort_order(rank_col, asc=asc)]
+        srt = F.sort(o, single, global_=False)
+        w = F.window(
+            [F.window_expr(F.rank_fn([rank_col]), F.window_spec([], o),
+                           "rnk", b)],
+            [], o, srt,
+        )
+        f = F.filter_(F.binop("LessThanOrEqual", ar("rnk", b),
+                              F.lit(10, "integer")), w)
+        return F.project(
+            [F.alias(a("ss_item_sk"), item_alias, b + 1),
+             F.alias(ar("rnk", b), rnk_alias, b + 2)], f)
+
+    asc = ranked(True, "best_sk", "rnk", 9103)
+    desc = ranked(False, "worst_sk", "rnk_d", 9106)
+    rnk_a, rnkd_a = ar("rnk", 9105, "integer"), ar("rnk_d", 9108, "integer")
+    best_a, worst_a = ar("best_sk", 9104), ar("worst_sk", 9107)
+    j = big_join(strategy, asc, desc, [rnk_a], [rnkd_a])
+    i1 = F.scan("item", [a("i_item_sk"), a("i_item_id")])
+    j = join(strategy, i1, j, [a("i_item_sk")], [best_a])
+    i2sk, i2id = ar("i_item_sk", 9121), ar("i_item_id", 9122, "string")
+    i2 = F.scan("item", [i2sk, i2id])
+    j = join(strategy, i2, j, [i2sk], [worst_a])
+    plan = F.take_ordered(
+        100, [F.sort_order(rnk_a)],
+        [rnk_a, F.alias(a("i_item_id"), "best_name", 9131),
+         F.alias(i2id, "worst_name", 9132)], j)
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q44(data)
+    assert exp, "q44 oracle empty"
+    rows = set(zip(got["rnk"], got["best_name"], got["worst_name"]))
+    assert len(got["rnk"]) == min(len(exp), 100)
+    assert rows == exp if len(exp) <= 100 else rows <= exp
+    assert got["rnk"] == sorted(got["rnk"])
+
+
+# ----------------- q9: five CASE buckets over 15 scalar subqueries
+
+def test_spark_q9(sess, data, strategy):
+    """Fifteen ScalarSubqueries (count/avg/avg per quantity band)
+    inside five CaseWhen branches, projected over the 1-row reason
+    slice — the heaviest driver-side subquery resolution shape in the
+    matrix (≙ SparkScalarSubqueryWrapperExpr evaluation)."""
+    from blaze_tpu.tpcds.queries import Q9_THRESHOLDS
+
+    if strategy == "smj":
+        pytest.skip("no joins in q9: the strategy axis is vacuous")
+
+    def band_plan(lo, hi, agg_fn, rid):
+        band = F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("ss_quantity"), i32(lo)),
+                 F.binop("LessThanOrEqual", a("ss_quantity"), i32(hi))),
+            F.scan("store_sales", [a("ss_quantity"), a("ss_ext_discount_amt"),
+                                   a("ss_net_profit")]),
+        )
+        return two_stage([], [(agg_fn, rid)], band)
+
+    exprs = []
+    for b, thresh in enumerate(Q9_THRESHOLDS):
+        lo, hi = 20 * b + 1, 20 * (b + 1)
+        rid = 9200 + b * 10
+        cnt = _scalar_subquery(band_plan(lo, hi, F.count(), rid), rid)
+        avg_disc = _scalar_subquery(
+            band_plan(lo, hi, F.avg(a("ss_ext_discount_amt")), rid + 1), rid + 1)
+        avg_profit = _scalar_subquery(
+            band_plan(lo, hi, F.avg(a("ss_net_profit")), rid + 2), rid + 2)
+        case = F.T(
+            F.X + "CaseWhen",
+            [F.binop("GreaterThan", cnt, F.lit(thresh, "long")),
+             avg_disc, avg_profit],
+        )
+        exprs.append(F.alias(case, f"bucket{b + 1}", 9300 + b))
+    src = F.filter_(F.binop("EqualTo", a("r_reason_sk"), F.lit(1, "long")),
+                    F.scan("reason", [a("r_reason_sk"), a("r_reason_desc")]))
+    plan = F.project(exprs, src)
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q9(data, Q9_THRESHOLDS)
+    assert len(got["bucket1"]) == 1
+    for b in range(len(Q9_THRESHOLDS)):
+        g = got[f"bucket{b + 1}"][0]
+        assert abs(g - exp[b]) <= 1, (b, g, exp[b])
