@@ -1,0 +1,111 @@
+"""Command-line runner: execute TPC-H / TPC-DS queries through the
+engine from a shell.
+
+≙ the reference's benchmark tooling (``dev/run-tpcds-test`` +
+``tpcds/benchmark-runner`` — spark-submit launchers around the same
+query set, ``tpcds/README.md:1-52``), sized for this engine: datagen
+at the requested scale, plan build, execution either in-process or
+through the stage scheduler (every task crossing TaskDefinition
+protobuf bytes + shuffle files), wall-clock per query, and an optional
+row-count/total printout.
+
+Usage:
+    python -m blaze_tpu tpch q6 q1 --scale 0.05
+    python -m blaze_tpu tpcds q36 --scale 0.002 --parts 4 --scheduler
+    python -m blaze_tpu tpch all --scale 0.01
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _run_suite(suite: str, names, scale: float, n_parts: int,
+               scheduler: bool) -> int:
+    if suite == "tpch":
+        from .tpch import TPCH_SCHEMAS as SCHEMAS
+        from .tpch import build_query
+        from .tpch.datagen import generate_all, table_to_batches
+        from .tpch.queries import QUERIES
+    else:
+        from .tpcds import TPCDS_SCHEMAS as SCHEMAS
+        from .tpcds import build_query, generate_all
+        from .tpcds.queries import QUERIES
+        from .tpch.datagen import table_to_batches
+
+    if names == ["all"]:
+        names = sorted(QUERIES)
+    unknown = [n for n in names if n not in QUERIES]
+    if unknown:
+        print(f"unknown {suite} queries: {', '.join(unknown)} "
+              f"(available: {', '.join(sorted(QUERIES))})", file=sys.stderr)
+        return 2
+
+    t0 = time.perf_counter()
+    data = generate_all(scale)
+    from .ops import MemoryScanExec
+
+    scans = {
+        name: MemoryScanExec(
+            table_to_batches(data[name], SCHEMAS[name], n_parts, batch_rows=65536),
+            SCHEMAS[name],
+        )
+        for name in SCHEMAS
+    }
+    print(f"# datagen scale={scale}: {time.perf_counter() - t0:.2f}s")
+
+    from .runtime.context import TaskContext
+
+    failed = []
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            plan = build_query(name, scans, n_parts)
+            rows = 0
+            if scheduler:
+                from .runtime.scheduler import run_stages, split_stages
+
+                stages, manager = split_stages(plan)
+                for b in run_stages(stages, manager):
+                    rows += b.num_rows
+            else:
+                for p in range(plan.num_partitions()):
+                    for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+                        rows += b.num_rows
+            dt = time.perf_counter() - t0
+            print(f"{suite} {name}: {rows} rows in {dt:.2f}s"
+                  + (" [scheduler]" if scheduler else ""))
+        except Exception as e:  # noqa: BLE001 — report per query, keep going
+            failed.append(name)
+            print(f"{suite} {name}: FAILED {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    if failed:
+        print(f"# {len(failed)} failed: {', '.join(failed)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m blaze_tpu",
+        description="Run TPC-H / TPC-DS queries through the engine.",
+    )
+    ap.add_argument("suite", choices=["tpch", "tpcds"])
+    ap.add_argument("queries", nargs="+",
+                    help="query names (q1, q6, ...) or 'all'")
+    ap.add_argument("--scale", type=float, default=0.01,
+                    help="datagen scale factor (default 0.01)")
+    ap.add_argument("--parts", type=int, default=2,
+                    help="partitions per table (default 2)")
+    ap.add_argument("--scheduler", action="store_true",
+                    help="run through the stage scheduler (TaskDefinition "
+                         "bytes + shuffle files) instead of in-process")
+    args = ap.parse_args(argv)
+    return _run_suite(args.suite, args.queries, args.scale, args.parts,
+                      args.scheduler)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -375,3 +375,24 @@ def test_rss_retry_and_barrier_semantics():
                 assert "1/5 map commits" in str(e)
         finally:
             conf.RSS_FETCH_BARRIER_TIMEOUT.set(120.0)
+
+
+@pytest.mark.slow
+def test_cli_runner_end_to_end(capsys):
+    """python -m blaze_tpu: the benchmark-runner analogue (reference
+    dev/run-tpcds-test + tpcds/benchmark-runner) — runs queries through
+    datagen + plan build + both execution paths, reports per-query
+    wall/rows, and surfaces unknown names."""
+    from blaze_tpu.__main__ import main
+
+    rc = main(["tpch", "q6", "--scale", "0.005"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "tpch q6: 1 rows" in out
+
+    rc = main(["tpcds", "q42", "--scale", "0.002", "--scheduler"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "[scheduler]" in out and "tpcds q42:" in out
+
+    rc = main(["tpch", "nope"])
+    err = capsys.readouterr().err
+    assert rc == 2 and "unknown tpch queries: nope" in err
