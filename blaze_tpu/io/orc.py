@@ -28,8 +28,9 @@ max_elems); MAP/STRUCT/nested LIST read through a recursive
 python-value decoder.  The writer mirrors the full set: flat columns
 and LIST-of-primitive via numpy tuples, and MAP/STRUCT/nested LIST
 fields as plain python value lists (the same shape the reader's
-compound path returns) through a recursive encoder.  Remaining gates
-(not silently wrong): TIMESTAMP inside compound values, BINARY.
+compound path returns) through a recursive encoder.  TIMESTAMP is
+covered at both levels (top-level vectorized + compound py-value,
+int64 unix-µs lane).  Remaining gate (not silently wrong): BINARY.
 """
 
 from __future__ import annotations
@@ -111,12 +112,13 @@ def orc_decompress(buf: bytes, kind: int) -> bytes:
 
 def orc_compress(data: bytes, kind: int, block: int = 65536) -> bytes:
     """Writer half of the chunked framing: split into <= ``block``-byte
-    chunks, compress each (zlib raw-deflate or zstd), store verbatim
-    (original bit) when compression does not shrink the chunk — the
-    exact format orc_decompress consumes and ORC C++ readers expect."""
+    chunks, compress each (zlib raw-deflate, zstd, snappy, or lz4
+    raw-block), store verbatim (original bit) when compression does not
+    shrink the chunk — the exact format orc_decompress consumes and ORC
+    C++ readers expect."""
     if kind == C_NONE or not data:
         return data
-    if kind not in (C_ZLIB, C_ZSTD):
+    if kind not in (C_ZLIB, C_ZSTD, C_SNAPPY, C_LZ4):
         raise NotImplementedError(f"ORC writer compression kind {kind}")
     if kind == C_ZSTD:
         import zstandard
@@ -127,6 +129,14 @@ def orc_compress(data: bytes, kind: int, block: int = 65536) -> bytes:
         chunk = data[pos : pos + block]
         if kind == C_ZSTD:
             comp = zc.compress(chunk)
+        elif kind == C_SNAPPY:
+            from .parquet import _snappy_compress
+
+            comp = _snappy_compress(chunk)
+        elif kind == C_LZ4:
+            from .ipc_compression import lz4_block_compress
+
+            comp = lz4_block_compress(chunk)
         else:
             co = zlib.compressobj(6, zlib.DEFLATED, -15)
             comp = co.compress(chunk) + co.flush()
@@ -712,6 +722,19 @@ def _encode_pyvalues(
         streams.append(_Stream(S_DATA, col_id, np.ascontiguousarray(
             np.array(live, dtype.np_dtype)).tobytes()))
         return streams
+    if k == TypeKind.TIMESTAMP:
+        # values are int64 unix microseconds (the engine's physical
+        # timestamp lane); reuse the top-level encoder's epoch split
+        micros = np.array([int(v) for v in live], np.int64)
+        floor_sec = np.floor_divide(micros, 1_000_000)
+        nanos = (micros - floor_sec * 1_000_000) * 1000
+        tz_sec = np.where((floor_sec < 0) & (nanos > 999_999),
+                          floor_sec + 1, floor_sec)
+        streams.append(_Stream(S_DATA, col_id, _rlev1_encode(
+            tz_sec - ORC_TS_EPOCH, signed=True)))
+        streams.append(_Stream(S_SECONDARY, col_id, _rlev1_encode(
+            _pack_nanos(nanos), signed=False)))
+        return streams
     raise NotImplementedError(f"ORC subset writer: compound element {dtype!r}")
 
 
@@ -761,10 +784,12 @@ def write_orc(
     (None, validity|None, lengths, (elem_data_2d, elem_valid_2d)).
     MAP/STRUCT/nested-LIST fields take a plain python value list
     (None/list/dict per row — the reader's compound-path shape).
-    ``compression``: "none", "zlib" (Spark's ORC default) or "zstd" —
-    every stream, stripe footer, Metadata and Footer region gets the
-    chunked [u24 header][block] framing; the PostScript stays raw."""
-    comp_kind = {"none": C_NONE, "zlib": C_ZLIB, "zstd": C_ZSTD}[compression]
+    ``compression``: "none", "zlib" (Spark's ORC default), "zstd",
+    "snappy", or "lz4" — every stream, stripe footer, Metadata and
+    Footer region gets the chunked [u24 header][block] framing; the
+    PostScript stays raw."""
+    comp_kind = {"none": C_NONE, "zlib": C_ZLIB, "zstd": C_ZSTD,
+                 "snappy": C_SNAPPY, "lz4": C_LZ4}[compression]
     any_name = next(iter(columns))
     any_col = columns[any_name]
     any_dt = schema.field(any_name).dtype
@@ -1358,6 +1383,15 @@ def read_stripe(
         if k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
             return scatter([float(v) for v in np.frombuffer(
                 dec(tid, S_DATA), dtype.np_dtype, nv)])
+        if k == TypeKind.TIMESTAMP:
+            # same stream pair as the top-level branch: DATA = seconds
+            # relative to the 2015 epoch, SECONDARY = packed nanos
+            rel = int_decode(dec(tid, S_DATA), nv, True, encn)
+            nanos = _unpack_nanos(
+                int_decode(dec(tid, S_SECONDARY), nv, False, encn))
+            secs = rel + ORC_TS_EPOCH
+            secs = np.where((secs < 0) & (nanos > 999_999), secs - 1, secs)
+            return scatter([int(v) for v in secs * 1_000_000 + nanos // 1000])
         raise NotImplementedError(f"ORC subset: nested element {dtype!r}")
 
     rows = stripe.rows
@@ -1479,6 +1513,14 @@ def read_stripe(
                     cvals = int_decode(dec(cid, S_DATA), cn, True, cenc)
             elif ek in (TypeKind.FLOAT32, TypeKind.FLOAT64):
                 cvals = np.frombuffer(dec(cid, S_DATA), et.np_dtype, cn)
+            elif ek == TypeKind.TIMESTAMP:
+                rel = int_decode(dec(cid, S_DATA), cn, True, cenc)
+                cnanos = _unpack_nanos(
+                    int_decode(dec(cid, S_SECONDARY), cn, False, cenc))
+                csecs = rel + ORC_TS_EPOCH
+                csecs = np.where((csecs < 0) & (cnanos > 999_999),
+                                 csecs - 1, csecs)
+                cvals = csecs * 1_000_000 + cnanos // 1000
             else:
                 raise NotImplementedError(f"ORC subset: list element {et!r}")
             flat = np.zeros(total, et.np_dtype)
