@@ -502,6 +502,10 @@ def _rlev1_decode(data: bytes, count: int, signed: bool) -> np.ndarray:
             ln = 256 - h
             for _ in range(ln):
                 v = uv()
+                if not signed and v >= 1 << 63:
+                    # unsigned streams can carry wrapped int64 values
+                    # (ORC C++ packs signed pre-epoch nanos as uint64)
+                    v -= 1 << 64
                 out[n] = _unzz(v) if signed else v
                 n += 1
     return out
@@ -545,6 +549,38 @@ def _unpack_nanos(packed: np.ndarray) -> np.ndarray:
     return (base * mult).astype(np.int64)
 
 
+def _encode_ts_streams(micros: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """int64 unix-µs -> (DATA rel-seconds, SECONDARY packed nanos) —
+    the single writer-side split, shared by every TIMESTAMP site.
+
+    Uses the ORC C++ convention (verified against pyarrow's writer):
+    seconds are TRUNC-TOWARD-ZERO unix seconds shifted to the 2015
+    epoch, and nanos carry the SIGNED sub-second remainder (negative
+    for pre-epoch fractions: -1µs -> secs 0, nanos -1000), wrapped to
+    uint64 for the unsigned SECONDARY stream.  The Java writers' form
+    (floor seconds, nanos in [0, 1e9)) is ambiguous in the second
+    before the unix epoch — trunc secs 0 there is indistinguishable
+    from a genuine +0.x value — so the C++ form is the one that
+    roundtrips every value; the reader handles both."""
+    micros = np.asarray(micros, np.int64)
+    secs = np.where(micros < 0, -((-micros) // 1_000_000),
+                    micros // 1_000_000)
+    nanos = (micros - secs * 1_000_000) * 1000
+    return secs - ORC_TS_EPOCH, _pack_nanos(nanos).view(np.uint64)
+
+
+def _decode_ts_micros(rel: np.ndarray, packed: np.ndarray) -> np.ndarray:
+    """(DATA rel-seconds, SECONDARY packed nanos) -> int64 unix-µs —
+    the single reader-side join, shared by every TIMESTAMP site.
+    Handles both writer conventions: signed-remainder nanos (ORC C++)
+    fall through untouched; Java floor-second files carry positive
+    nanos and need the seconds re-floored below zero."""
+    nanos = _unpack_nanos(np.asarray(packed, np.int64))
+    secs = np.asarray(rel, np.int64) + ORC_TS_EPOCH
+    secs = np.where((secs < 0) & (nanos > 999_999), secs - 1, secs)
+    return secs * 1_000_000 + nanos // 1000
+
+
 def _encode_column(
     col_id: int, dtype: DataType, data: np.ndarray, validity: np.ndarray,
     lengths: Optional[np.ndarray],
@@ -572,17 +608,10 @@ def _encode_column(
         streams.append(_Stream(S_SECONDARY, col_id, _rlev1_encode(
             np.full(int(live.sum()), dtype.scale, np.int64), signed=True)))
     elif k == TypeKind.TIMESTAMP:
-        micros = data[live].astype(np.int64)
-        floor_sec = np.floor_divide(micros, 1_000_000)
-        nanos = (micros - floor_sec * 1_000_000) * 1000
-        # ORC stores trunc-toward-zero UNIX seconds (the reader's
-        # "seconds < 0 and nanos" rule re-floors them); the shift to
-        # the 2015 epoch happens after
-        tz_sec = np.where((floor_sec < 0) & (nanos > 999_999), floor_sec + 1, floor_sec)
-        streams.append(_Stream(S_DATA, col_id, _rlev1_encode(
-            tz_sec - ORC_TS_EPOCH, signed=True)))
+        rel, packed = _encode_ts_streams(data[live])
+        streams.append(_Stream(S_DATA, col_id, _rlev1_encode(rel, signed=True)))
         streams.append(_Stream(S_SECONDARY, col_id, _rlev1_encode(
-            _pack_nanos(nanos), signed=False)))
+            packed, signed=False)))
     elif dtype.is_string:
         ln = lengths[live]
         streams.append(_Stream(S_LENGTH, col_id, _rlev1_encode(ln, signed=False)))
@@ -724,16 +753,12 @@ def _encode_pyvalues(
         return streams
     if k == TypeKind.TIMESTAMP:
         # values are int64 unix microseconds (the engine's physical
-        # timestamp lane); reuse the top-level encoder's epoch split
-        micros = np.array([int(v) for v in live], np.int64)
-        floor_sec = np.floor_divide(micros, 1_000_000)
-        nanos = (micros - floor_sec * 1_000_000) * 1000
-        tz_sec = np.where((floor_sec < 0) & (nanos > 999_999),
-                          floor_sec + 1, floor_sec)
-        streams.append(_Stream(S_DATA, col_id, _rlev1_encode(
-            tz_sec - ORC_TS_EPOCH, signed=True)))
+        # timestamp lane)
+        rel, packed = _encode_ts_streams(
+            np.array([int(v) for v in live], np.int64))
+        streams.append(_Stream(S_DATA, col_id, _rlev1_encode(rel, signed=True)))
         streams.append(_Stream(S_SECONDARY, col_id, _rlev1_encode(
-            _pack_nanos(nanos), signed=False)))
+            packed, signed=False)))
         return streams
     raise NotImplementedError(f"ORC subset writer: compound element {dtype!r}")
 
@@ -1384,14 +1409,9 @@ def read_stripe(
             return scatter([float(v) for v in np.frombuffer(
                 dec(tid, S_DATA), dtype.np_dtype, nv)])
         if k == TypeKind.TIMESTAMP:
-            # same stream pair as the top-level branch: DATA = seconds
-            # relative to the 2015 epoch, SECONDARY = packed nanos
-            rel = int_decode(dec(tid, S_DATA), nv, True, encn)
-            nanos = _unpack_nanos(
-                int_decode(dec(tid, S_SECONDARY), nv, False, encn))
-            secs = rel + ORC_TS_EPOCH
-            secs = np.where((secs < 0) & (nanos > 999_999), secs - 1, secs)
-            return scatter([int(v) for v in secs * 1_000_000 + nanos // 1000])
+            return scatter([int(v) for v in _decode_ts_micros(
+                int_decode(dec(tid, S_DATA), nv, True, encn),
+                int_decode(dec(tid, S_SECONDARY), nv, False, encn))])
         raise NotImplementedError(f"ORC subset: nested element {dtype!r}")
 
     rows = stripe.rows
@@ -1436,11 +1456,9 @@ def read_stripe(
             data = np.zeros(rows, fld.dtype.np_dtype)
             data[validity] = vals.astype(fld.dtype.np_dtype)
         elif k == TypeKind.TIMESTAMP:
-            rel = int_decode(dec(ci, S_DATA), nvals, True, enc)
-            nanos = _unpack_nanos(int_decode(dec(ci, S_SECONDARY), nvals, False, enc))
-            secs = rel + ORC_TS_EPOCH  # unix seconds, trunc-toward-zero
-            secs = np.where((secs < 0) & (nanos > 999_999), secs - 1, secs)
-            vals = secs * 1_000_000 + nanos // 1000
+            vals = _decode_ts_micros(
+                int_decode(dec(ci, S_DATA), nvals, True, enc),
+                int_decode(dec(ci, S_SECONDARY), nvals, False, enc))
             data = np.zeros(rows, np.int64)
             data[validity] = vals
         elif k in (TypeKind.FLOAT32, TypeKind.FLOAT64):
@@ -1514,13 +1532,9 @@ def read_stripe(
             elif ek in (TypeKind.FLOAT32, TypeKind.FLOAT64):
                 cvals = np.frombuffer(dec(cid, S_DATA), et.np_dtype, cn)
             elif ek == TypeKind.TIMESTAMP:
-                rel = int_decode(dec(cid, S_DATA), cn, True, cenc)
-                cnanos = _unpack_nanos(
+                cvals = _decode_ts_micros(
+                    int_decode(dec(cid, S_DATA), cn, True, cenc),
                     int_decode(dec(cid, S_SECONDARY), cn, False, cenc))
-                csecs = rel + ORC_TS_EPOCH
-                csecs = np.where((csecs < 0) & (cnanos > 999_999),
-                                 csecs - 1, csecs)
-                cvals = csecs * 1_000_000 + cnanos // 1000
             else:
                 raise NotImplementedError(f"ORC subset: list element {et!r}")
             flat = np.zeros(total, et.np_dtype)

@@ -167,9 +167,14 @@ def test_orc_timestamp_roundtrip(tmp_path):
         1420070399_000_000,      # one second before the ORC epoch
         -123_456_789,            # pre-1970 fractional (trunc-zero secs)
         981_173_106_987_000,     # 2001 with trailing-zero nanos
+        -1,                      # last µs before the unix epoch (the
+                                 # floor-seconds ambiguity boundary)
+        -999_000,                # inside the pre-epoch second
+        -1_500_000,              # fractional below -1s
+        -1_000_000,              # exactly -1s (zero nanos)
         -7_000_000,              # null slot
     ], np.int64)
-    validity = np.array([1, 1, 1, 1, 1, 1, 0], bool)
+    validity = np.array([1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 0], bool)
     path = str(tmp_path / "ts.orc")
     write_orc(path, schema, {"ts": (vals, validity, None)})
     meta = read_metadata(path)
@@ -190,7 +195,8 @@ def test_orc_timestamp_pyarrow_differential(tmp_path):
     from blaze_tpu.io.orc import read_metadata, read_stripe
 
     micros = [1700000000_000_000, 1500000000_500_000, None,
-              1420070400_000_000, 981_173_106_987_654]
+              1420070400_000_000, 981_173_106_987_654,
+              -1, -999_000, -1_500_000, -1_000_000]
     table = pa.table({"ts": pa.array(
         [None if m is None else m for m in micros], pa.timestamp("us"))})
     path = str(tmp_path / "pa_ts.orc")

@@ -540,13 +540,15 @@ def test_writer_compound_timestamp_roundtrip(tmp_path):
     from blaze_tpu.io.orc import write_orc
 
     micros = [0, 1420070400_000_000, 1700000000_123_456,
-              1420070399_000_000, 981_173_106_987_000]
+              1420070399_000_000, 981_173_106_987_000,
+              -1, -999_000, -1_500_000]
     lt_vals = [
         [micros[0], None, micros[2]],
         None,
         [],
         [micros[1], micros[3]],
-        [micros[4]],
+        [micros[4], micros[5]],
+        [micros[6], micros[7]],
     ]
     st_vals = [
         {"t": micros[2], "k": 7},
@@ -554,6 +556,7 @@ def test_writer_compound_timestamp_roundtrip(tmp_path):
         {"t": None, "k": 8},
         {"t": micros[4], "k": None},
         {"t": micros[1], "k": 9},
+        {"t": micros[5], "k": 10},
     ]
     schema = Schema([
         Field("lt", DataType.array(DataType.timestamp(), 4)),
@@ -604,7 +607,8 @@ def test_pyarrow_compound_timestamp_differential(tmp_path):
     the same microsecond values through our compound path."""
     lt_vals = [[1700000000_000_000, None], None, [],
                [1420070400_000_000, 981_173_106_987_654],
-               [1500000000_500_000]]
+               [1500000000_500_000],
+               [-1, -999_000, -1_500_000, -1_000_000]]
     table = pa.table({"lt": pa.array(
         lt_vals, pa.list_(pa.timestamp("us")))})
     path = str(tmp_path / "pa_nts.orc")
