@@ -6,7 +6,8 @@ public parquet-format spec (no pyarrow in the image):
 
 - written files: PAR1 magic, one DATA_PAGE v1 per column chunk per row
   group, PLAIN encoding, RLE/bit-packed definition levels for OPTIONAL
-  columns, UNCOMPRESSED or GZIP pages, thrift-compact FileMetaData with
+  columns, UNCOMPRESSED / GZIP / SNAPPY (Spark's default, pure-python
+  LZ77) / ZSTD / LZ4_RAW pages, thrift-compact FileMetaData with
   min/max statistics per chunk.
 - reader: decodes that subset (plus dictionary-free files other writers
   produce with the same encodings) and prunes row groups with the
@@ -484,7 +485,22 @@ def write_parquet(
             defs = _rle_encode_defs(v)
             values = _plain_encode(fld.dtype, d, v, l)
             payload = struct.pack("<I", len(defs)) + defs + values
-            comp = gzip.compress(payload, 1) if codec == CODEC_GZIP else payload
+            if codec == CODEC_GZIP:
+                comp = gzip.compress(payload, 1)
+            elif codec == CODEC_SNAPPY:  # Spark's parquet default codec
+                comp = _snappy_compress(payload)
+            elif codec == CODEC_ZSTD:
+                import zstandard
+
+                comp = zstandard.ZstdCompressor().compress(payload)
+            elif codec == CODEC_LZ4_RAW:
+                from .ipc_compression import lz4_block_compress
+
+                comp = lz4_block_compress(payload)
+            elif codec == CODEC_UNCOMPRESSED:
+                comp = payload
+            else:
+                raise NotImplementedError(f"parquet writer codec {codec}")
             # min/max over non-null rows
             stats = None
             if v.any():
