@@ -36,10 +36,14 @@ from test_spark_tpcds import (
     ne,
     or_,
     s,
+    distinct,
     two_stage,
 )
 from test_tpcds import (
+    _check_brand_report,
+    _check_class_share,
     _check_demo_avgs,
+    _check_inv_price,
     _check_ship_lag,
     _check_ticket_report,
 )
@@ -2247,3 +2251,194 @@ def test_spark_q9(sess, data, strategy):
     for b in range(len(Q9_THRESHOLDS)):
         g = got[f"bucket{b + 1}"][0]
         assert abs(g - exp[b]) <= 1, (b, g, exp[b])
+
+
+# --------------------------------------- q3 brand report (ticket slice)
+
+def test_spark_q3(ticket_sess, ticket_data, strategy):
+    """Star join + brand rollup (manufact 128 only appears at the 0.01
+    datagen slice, same as test_tpcds.test_q3)."""
+    dt = F.project(
+        [a("d_date_sk"), a("d_year")],
+        F.filter_(F.binop("EqualTo", a("d_moy"), i32(11)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")])),
+    )
+    sales = F.scan("store_sales", [a("ss_sold_date_sk"), a("ss_item_sk"),
+                                   a("ss_ext_sales_price")])
+    it = F.project(
+        [a("i_item_sk"), a("i_brand_id"), a("i_brand")],
+        F.filter_(F.binop("EqualTo", a("i_manufact_id"), i32(128)),
+                  F.scan("item", [a("i_item_sk"), a("i_brand_id"), a("i_brand"),
+                                  a("i_manufact_id")])),
+    )
+    j = join(strategy, dt, sales, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    agg = two_stage([a("d_year"), a("i_brand_id"), a("i_brand")],
+                    [(F.sum_(a("ss_ext_sales_price")), 501)], j)
+    sum_agg = ar("sum_agg", 501, "decimal(17,2)")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("d_year")), F.sort_order(sum_agg, asc=False),
+         F.sort_order(a("i_brand_id"))],
+        [F.alias(a("d_year"), "d_year", 510),
+         F.alias(a("i_brand_id"), "brand_id", 511),
+         F.alias(a("i_brand"), "brand", 512),
+         F.alias(sum_agg, "sum_agg", 513)],
+        agg,
+    )
+    got = _execute_both(ticket_sess, plan)
+    exp = O.oracle_q3(ticket_data)
+    assert exp, "q3 oracle matched no rows"
+    _check_brand_report(got, exp, "sum_agg")
+    assert got["d_year"] == sorted(got["d_year"])
+
+
+# --------------------------- q12/q20 class-share reports (q98's twins)
+
+def _class_share_plan(st, fact, date_c, item_c, price_c):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"),
+                         F.lit("1999-02-22", "date")),
+                 F.binop("LessThanOrEqual", a("d_date"),
+                         F.lit("1999-03-24", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    it = F.project(
+        [a("i_item_sk"), a("i_item_id"), a("i_item_desc"), a("i_category"),
+         a("i_class"), a("i_current_price")],
+        F.filter_(
+            in_(a("i_category"), "Sports", "Books", "Home"),
+            F.scan("item", [a("i_item_sk"), a("i_item_id"), a("i_item_desc"),
+                            a("i_class"), a("i_category"), a("i_current_price")]),
+        ),
+    )
+    sales = F.scan(fact, [a(date_c), a(item_c), a(price_c)])
+    j = join(st, dt, sales, [a("d_date_sk")], [a(date_c)])
+    j = join(st, it, j, [a("i_item_sk")], [a(item_c)])
+    agg = two_stage(
+        [a("i_item_id"), a("i_item_desc"), a("i_category"), a("i_class"),
+         a("i_current_price")],
+        [(F.sum_(a(price_c)), 501)],
+        j,
+    )
+    itemrev = ar("itemrevenue", 501, "decimal(17,2)")
+    single = F.shuffle(F.single_partition(), agg)
+    pre = F.sort([F.sort_order(a("i_class"))], single)
+    w = F.window(
+        [F.window_expr(
+            F.window_agg(F.sum_(itemrev)),
+            F.window_spec([a("i_class")], [], F.window_frame("up", "uf", row=True)),
+            "class_revenue", 502)],
+        [a("i_class")],
+        [],
+        pre,
+    )
+    class_rev = ar("class_revenue", 502, "decimal(27,2)")
+    ratio = F.binop(
+        "Divide",
+        F.binop("Multiply", F.cast(itemrev, "double"), F.lit(100.0, "double")),
+        F.cast(class_rev, "double"),
+    )
+    proj = F.project(
+        [a("i_item_id"), a("i_item_desc"), a("i_category"), a("i_class"),
+         a("i_current_price"), itemrev,
+         F.alias(ratio, "revenueratio", 510)],
+        w,
+    )
+    ratio_o = ar("revenueratio", 510, "double")
+    sorted_ = F.sort(
+        [F.sort_order(a("i_category")), F.sort_order(a("i_class")),
+         F.sort_order(a("i_item_id")), F.sort_order(a("i_item_desc")),
+         F.sort_order(ratio_o)],
+        F.shuffle(F.single_partition(), proj),
+    )
+    return F.project(
+        [F.alias(a("i_item_id"), "i_item_id", 520),
+         F.alias(a("i_item_desc"), "i_item_desc", 521),
+         F.alias(a("i_category"), "i_category", 522),
+         F.alias(a("i_class"), "i_class", 523),
+         F.alias(a("i_current_price"), "i_current_price", 524),
+         F.alias(itemrev, "itemrevenue", 525),
+         F.alias(ratio_o, "revenueratio", 526)],
+        sorted_,
+    )
+
+
+def test_spark_q20(sess, data, strategy):
+    plan = _class_share_plan(strategy, "catalog_sales", "cs_sold_date_sk",
+                             "cs_item_sk", "cs_ext_sales_price")
+    got = _execute_both(sess, plan)
+    _check_class_share(got, O.oracle_q20(data))
+
+
+def test_spark_q12(sess, data, strategy):
+    plan = _class_share_plan(strategy, "web_sales", "ws_sold_date_sk",
+                             "ws_item_sk", "ws_ext_sales_price")
+    got = _execute_both(sess, plan)
+    _check_class_share(got, O.oracle_q12(data))
+
+
+# ------------------------------ q37/q82 inventory price-band items
+
+def _inv_price_plan(st, fact, item_c):
+    """Items in a price band with healthy inventory that also sold in
+    the channel: bcast date window, strategy-shaped item<->inventory
+    join, LEFT SEMI against the fact, grouping-only (DISTINCT) agg."""
+    dec = "decimal(7,2)"
+    it = F.project(
+        [a("i_item_sk"), a("i_item_id"), a("i_item_desc"), a("i_current_price")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("i_current_price"),
+                         F.lit("30", dec)),
+                 F.binop("LessThanOrEqual", a("i_current_price"),
+                         F.lit("60", dec))),
+            F.scan("item", [a("i_item_sk"), a("i_item_id"), a("i_item_desc"),
+                            a("i_current_price")]),
+        ),
+    )
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"),
+                         F.lit("2000-02-01", "date")),
+                 F.binop("LessThan", a("d_date"), F.lit("2000-04-01", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    inv = F.project(
+        [a("inv_date_sk"), a("inv_item_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("inv_quantity_on_hand"), i32(100)),
+                 F.binop("LessThanOrEqual", a("inv_quantity_on_hand"), i32(500))),
+            F.scan("inventory", [a("inv_date_sk"), a("inv_item_sk"),
+                                 a("inv_quantity_on_hand")]),
+        ),
+    )
+    j = join(st, dt, inv, [a("d_date_sk")], [a("inv_date_sk")])
+    j = join(st, it, j, [a("i_item_sk")], [a("inv_item_sk")])
+    sold = F.scan(fact, [a(item_c)])
+    j = join(st, sold, j, [a(item_c)], [a("i_item_sk")], jt="LeftSemi",
+             build_side="right")
+    agg = distinct([a("i_item_id"), a("i_item_desc"), a("i_current_price")], j)
+    return F.take_ordered(
+        100, [F.sort_order(a("i_item_id"))],
+        [F.alias(a("i_item_id"), "i_item_id", 530),
+         F.alias(a("i_item_desc"), "i_item_desc", 531),
+         F.alias(a("i_current_price"), "i_current_price", 532)],
+        agg,
+    )
+
+
+def test_spark_q37(sess, data, strategy):
+    got = _execute_both(sess, _inv_price_plan(strategy, "catalog_sales",
+                                              "cs_item_sk"))
+    _check_inv_price(got, O.oracle_q37(data))
+
+
+def test_spark_q82(sess, data, strategy):
+    got = _execute_both(sess, _inv_price_plan(strategy, "store_sales",
+                                              "ss_item_sk"))
+    _check_inv_price(got, O.oracle_q82(data))
