@@ -2442,3 +2442,107 @@ def test_spark_q82(sess, data, strategy):
     got = _execute_both(sess, _inv_price_plan(strategy, "store_sales",
                                               "ss_item_sk"))
     _check_inv_price(got, O.oracle_q82(data))
+
+
+# ------------------- q32/q92 excess discount (decorrelated per-item avg)
+
+def _excess_discount_plan(st, fact, date_c, item_c, amt_c):
+    from blaze_tpu.tpcds.queries import Q32_MFG_MAX
+
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"),
+                         F.lit("2000-01-27", "date")),
+                 F.binop("LessThanOrEqual", a("d_date"),
+                         F.lit("2000-04-26", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    sl = F.scan(fact, [a(date_c), a(item_c), a(amt_c)])
+    j = join(st, dt, sl, [a("d_date_sk")], [a(date_c)])
+    src = F.project([F.alias(a(item_c), "avg_item_sk", 520), a(amt_c)], j)
+    per_item = two_stage([ar("avg_item_sk", 520, "long")],
+                         [(F.avg(a(amt_c)), 501)], src)
+    avg_amt = ar("avg_amt", 501, "decimal(11,6)")
+    jj = join(st, per_item, j, [ar("avg_item_sk", 520, "long")], [a(item_c)])
+    keep = F.binop(
+        "GreaterThan", F.cast(a(amt_c), "double"),
+        F.binop("Multiply", F.cast(avg_amt, "double"), F.lit(1.3, "double")))
+    f = F.filter_(keep, jj)
+    it_p = F.project(
+        [a("i_item_sk")],
+        F.filter_(F.binop("LessThanOrEqual", a("i_manufact_id"),
+                          i32(Q32_MFG_MAX)),
+                  F.scan("item", [a("i_item_sk"), a("i_manufact_id")])),
+    )
+    f = join(st, it_p, f, [a("i_item_sk")], [a(item_c)], jt="LeftSemi",
+             build_side="right")
+    agg = two_stage([], [(F.sum_(a(amt_c)), 502)], f)
+    return F.project(
+        [F.alias(ar("excess", 502, "decimal(17,2)"), "excess_discount", 530)],
+        agg,
+    )
+
+
+def test_spark_q32(sess, data, strategy):
+    got = _execute_both(sess, _excess_discount_plan(
+        strategy, "catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+        "cs_ext_discount_amt"))
+    exp = O.oracle_q32(data)
+    assert exp is not None, "q32 slice matched no rows"
+    assert got["excess_discount"] == [exp]
+
+
+def test_spark_q92(sess, data, strategy):
+    got = _execute_both(sess, _excess_discount_plan(
+        strategy, "web_sales", "ws_sold_date_sk", "ws_item_sk",
+        "ws_ext_discount_amt"))
+    exp = O.oracle_q92(data)
+    assert exp is not None, "q92 slice matched no rows"
+    assert got["excess_discount"] == [exp]
+
+
+# -------------------------- q15 OR-of-unlike-predicates zip report
+
+def test_spark_q15(sess, data, strategy):
+    from blaze_tpu.tpcds.queries import Q15_ZIPS
+
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_qoy"), i32(2)),
+                       F.binop("EqualTo", a("d_year"), i32(2001))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_qoy"), a("d_year")])),
+    )
+    cust = F.scan("customer", [a("c_customer_sk"), a("c_current_addr_sk")])
+    ca = F.scan("customer_address",
+                [a("ca_address_sk"), a("ca_zip"), a("ca_state")])
+    sl = F.scan("catalog_sales",
+                [a("cs_sold_date_sk"), a("cs_bill_customer_sk"),
+                 a("cs_sales_price")])
+    j = join(strategy, dt, sl, [a("d_date_sk")], [a("cs_sold_date_sk")])
+    j = join(strategy, cust, j, [a("c_customer_sk")], [a("cs_bill_customer_sk")])
+    j = join(strategy, ca, j, [a("ca_address_sk")], [a("c_current_addr_sk")])
+    zip5 = F.T(F.X + "Substring", [a("ca_zip"), i32(1), i32(5)])
+    keep = or_(
+        in_(zip5, *Q15_ZIPS),
+        in_(a("ca_state"), "TN", "GA", "OH"),
+        F.binop("GreaterThan", a("cs_sales_price"),
+                F.lit("250", "decimal(7,2)")),
+    )
+    f = F.filter_(keep, j)
+    agg = two_stage([a("ca_zip")], [(F.sum_(a("cs_sales_price")), 501)], f)
+    plan = F.take_ordered(
+        100, [F.sort_order(a("ca_zip"))],
+        [F.alias(a("ca_zip"), "ca_zip", 510),
+         F.alias(ar("sum_price", 501, "decimal(17,2)"), "sum_price", 511)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q15(data)
+    assert exp, "q15 oracle matched no rows"
+    rows = dict(zip(got["ca_zip"], got["sum_price"]))
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+    assert got["ca_zip"] == sorted(got["ca_zip"])
