@@ -2546,3 +2546,176 @@ def test_spark_q15(sess, data, strategy):
         assert exp.get(k) == v, k
     assert len(rows) == min(len(exp), 100)
     assert got["ca_zip"] == sorted(got["ca_zip"])
+
+
+# ---------------- q88/q90/q61 scalar-subquery cross-join one-row reports
+
+def test_spark_q88(sess, data, strategy):
+    """Eight half-hour store traffic counts: the spec's cross join of
+    eight scalar COUNT subqueries, each a 3-join star under the
+    strategy shape, resolved driver-side."""
+    hd = F.project(
+        [a("hd_demo_sk")],
+        F.filter_(
+            or_(and_(F.binop("EqualTo", a("hd_dep_count"), i32(4)),
+                     F.binop("LessThanOrEqual", a("hd_vehicle_count"), i32(6))),
+                and_(F.binop("EqualTo", a("hd_dep_count"), i32(2)),
+                     F.binop("LessThanOrEqual", a("hd_vehicle_count"), i32(4))),
+                and_(F.binop("EqualTo", a("hd_dep_count"), i32(0)),
+                     F.binop("LessThanOrEqual", a("hd_vehicle_count"), i32(2)))),
+            F.scan("household_demographics",
+                   [a("hd_demo_sk"), a("hd_dep_count"), a("hd_vehicle_count")]),
+        ),
+    )
+    st_p = F.project(
+        [a("s_store_sk")],
+        F.filter_(F.binop("EqualTo", a("s_store_name"), s("ese")),
+                  F.scan("store", [a("s_store_sk"), a("s_store_name")])),
+    )
+    exprs = []
+    for k in range(8):
+        h, half = divmod(k + 17, 2)
+        tpred = (F.binop("GreaterThanOrEqual", a("t_minute"), i32(30)) if half
+                 else F.binop("LessThan", a("t_minute"), i32(30)))
+        td = F.project(
+            [a("t_time_sk")],
+            F.filter_(and_(F.binop("EqualTo", a("t_hour"), i32(h)), tpred),
+                      F.scan("time_dim", [a("t_time_sk"), a("t_hour"),
+                                          a("t_minute")])),
+        )
+        sl = F.scan("store_sales", [a("ss_sold_time_sk"), a("ss_hdemo_sk"),
+                                    a("ss_store_sk")])
+        j = join(strategy, td, sl, [a("t_time_sk")], [a("ss_sold_time_sk")])
+        j = join(strategy, hd, j, [a("hd_demo_sk")], [a("ss_hdemo_sk")])
+        j = join(strategy, st_p, j, [a("s_store_sk")], [a("ss_store_sk")])
+        cnt_plan = two_stage([], [(F.count(), 601 + k)], j)
+        exprs.append(F.alias(
+            _scalar_subquery(cnt_plan, 601 + k),
+            f"h{h}_{30 if half else 0}", 620 + k))
+    src = F.filter_(F.binop("EqualTo", a("r_reason_sk"), F.lit(1, "long")),
+                    F.scan("reason", [a("r_reason_sk")]))
+    got = _execute_both(sess, F.project(exprs, src))
+    exp = O.oracle_q88(data)
+    row = [got[k][0] for k in got]
+    assert row == exp, (row, exp)
+    assert sum(exp) > 0, "q88 slice matched no rows"
+
+
+def test_spark_q90(sess, data, strategy):
+    """AM/PM web-sales count ratio: two scalar subqueries + CaseWhen
+    zero guard."""
+    wp = F.project(
+        [a("wp_web_page_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("wp_char_count"), i32(2000)),
+                 F.binop("LessThanOrEqual", a("wp_char_count"), i32(6000))),
+            F.scan("web_page", [a("wp_web_page_sk"), a("wp_char_count")]),
+        ),
+    )
+
+    def half_count(lo, hi, rid):
+        td = F.project(
+            [a("t_time_sk")],
+            F.filter_(
+                and_(F.binop("GreaterThanOrEqual", a("t_hour"), i32(lo)),
+                     F.binop("LessThanOrEqual", a("t_hour"), i32(hi))),
+                F.scan("time_dim", [a("t_time_sk"), a("t_hour")]),
+            ),
+        )
+        ws = F.scan("web_sales", [a("ws_sold_time_sk"), a("ws_web_page_sk")])
+        j = join(strategy, td, ws, [a("t_time_sk")], [a("ws_sold_time_sk")])
+        j = join(strategy, wp, j, [a("wp_web_page_sk")], [a("ws_web_page_sk")])
+        return _scalar_subquery(two_stage([], [(F.count(), rid)], j), rid)
+
+    am = half_count(8, 9, 651)
+    pm = half_count(19, 20, 652)
+    amf = F.cast(am, "double")
+    pmf = F.cast(pm, "double")
+    den = F.T(F.X + "CaseWhen",
+              [F.binop("GreaterThan", pmf, F.lit(0.0, "double")), pmf,
+               F.lit(1.0, "double")])
+    one_row = two_stage([], [(F.count(), 653)],
+                        F.scan("web_page", [a("wp_web_page_sk")]))
+    plan = F.project(
+        [F.alias(amf, "am_count", 660),
+         F.alias(pmf, "pm_count", 661),
+         F.alias(F.binop("Divide", amf, den), "am_pm_ratio", 662)],
+        one_row,
+    )
+    got = _execute_both(sess, plan)
+    am_e, pm_e, ratio_e = O.oracle_q90(data)
+    assert got["am_count"] == [float(am_e)]
+    assert got["pm_count"] == [float(pm_e)]
+    assert abs(got["am_pm_ratio"][0] - ratio_e) < 1e-12
+
+
+def test_spark_q61(ticket_sess, ticket_data, strategy):
+    """Promotional vs total revenue: two 4/5-join scalar-subquery
+    aggregates (LEFT SEMI address filter inside) and their ratio."""
+    def revenue(with_promo, rid):
+        dt = F.project(
+            [a("d_date_sk")],
+            F.filter_(and_(F.binop("EqualTo", a("d_year"), i32(1998)),
+                           F.binop("EqualTo", a("d_moy"), i32(11))),
+                      F.scan("date_dim", [a("d_date_sk"), a("d_year"),
+                                          a("d_moy")])),
+        )
+        st_p = F.scan("store", [a("s_store_sk")])
+        it = F.project(
+            [a("i_item_sk")],
+            F.filter_(F.binop("EqualTo", a("i_category"), s("Jewelry")),
+                      F.scan("item", [a("i_item_sk"), a("i_category")])),
+        )
+        ca = F.project(
+            [a("ca_address_sk")],
+            F.filter_(F.binop("EqualTo", a("ca_gmt_offset"),
+                              F.lit("-5", "decimal(5,2)")),
+                      F.scan("customer_address",
+                             [a("ca_address_sk"), a("ca_gmt_offset")])),
+        )
+        cust = F.scan("customer", [a("c_customer_sk"), a("c_current_addr_sk")])
+        cust = join(strategy, ca, cust, [a("ca_address_sk")],
+                    [a("c_current_addr_sk")], jt="LeftSemi",
+                    build_side="right")
+        sl = F.scan("store_sales",
+                    [a("ss_sold_date_sk"), a("ss_store_sk"), a("ss_item_sk"),
+                     a("ss_customer_sk"), a("ss_promo_sk"),
+                     a("ss_ext_sales_price")])
+        j = join(strategy, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+        j = join(strategy, st_p, j, [a("s_store_sk")], [a("ss_store_sk")])
+        j = join(strategy, it, j, [a("i_item_sk")], [a("ss_item_sk")])
+        j = join(strategy, cust, j, [a("c_customer_sk")], [a("ss_customer_sk")])
+        if with_promo:
+            pr = F.project(
+                [a("p_promo_sk")],
+                F.filter_(or_(F.binop("EqualTo", a("p_channel_email"), s("Y")),
+                              F.binop("EqualTo", a("p_channel_event"), s("Y"))),
+                          F.scan("promotion", [a("p_promo_sk"),
+                                               a("p_channel_email"),
+                                               a("p_channel_event")])),
+            )
+            j = join(strategy, pr, j, [a("p_promo_sk")], [a("ss_promo_sk")])
+        return _scalar_subquery(
+            two_stage([], [(F.sum_(a("ss_ext_sales_price")), rid)], j), rid)
+
+    promo = revenue(True, 671)
+    total = revenue(False, 672)
+    ratio = F.binop(
+        "Divide",
+        F.binop("Multiply", F.cast(promo, "double"), F.lit(100.0, "double")),
+        F.cast(total, "double"))
+    src = F.filter_(F.binop("EqualTo", a("r_reason_sk"), F.lit(1, "long")),
+                    F.scan("reason", [a("r_reason_sk")]))
+    plan = F.project(
+        [F.alias(promo, "promotions", 680),
+         F.alias(total, "total", 681),
+         F.alias(ratio, "promo_pct", 682)],
+        src,
+    )
+    got = _execute_both(ticket_sess, plan)
+    promo_e, total_e = O.oracle_q61(ticket_data)
+    assert total_e > 0, "q61 slice matched no rows"
+    assert got["promotions"] == [promo_e]
+    assert got["total"] == [total_e]
+    exp_pct = (promo_e / 100.0) * 100.0 / (total_e / 100.0)
+    assert abs(got["promo_pct"][0] - exp_pct) < 1e-9
