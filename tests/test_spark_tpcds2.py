@@ -2719,3 +2719,155 @@ def test_spark_q61(ticket_sess, ticket_data, strategy):
     assert got["total"] == [total_e]
     exp_pct = (promo_e / 100.0) * 100.0 / (total_e / 100.0)
     assert abs(got["promo_pct"][0] - exp_pct) < 1e-9
+
+
+# ----------------------- q41 manufact EXISTS rewritten as semi join
+
+def test_spark_q41(sess, data, strategy):
+    combo = or_(
+        and_(in_(a("i_color"), "powder", "navy"),
+             in_(a("i_units"), "Each", "Dozen")),
+        and_(in_(a("i_color"), "peach", "saddle"),
+             in_(a("i_units"), "Case", "Pallet")),
+    )
+    qual = F.project(
+        [F.alias(a("i_manufact"), "qual_manufact", 690)],
+        F.filter_(combo, F.scan("item", [a("i_manufact"), a("i_color"),
+                                         a("i_units")])),
+    )
+    qm = ar("qual_manufact", 690, "string")
+    manufacts = distinct([qm], qual)
+    i1 = F.project(
+        [a("i_manufact"), a("i_item_id")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("i_manufact_id"), i32(50)),
+                 F.binop("LessThanOrEqual", a("i_manufact_id"), i32(120))),
+            F.scan("item", [a("i_manufact"), a("i_item_id"),
+                            a("i_manufact_id")]),
+        ),
+    )
+    j = join(strategy, manufacts, i1, [qm], [a("i_manufact")], jt="LeftSemi",
+             build_side="right")
+    dis = distinct([a("i_item_id")], F.project([a("i_item_id")], j))
+    plan = F.take_ordered(
+        100, [F.sort_order(a("i_item_id"))],
+        [F.alias(a("i_item_id"), "i_item_id", 695)], dis)
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q41(data)
+    assert exp, "q41 oracle empty"
+    assert got["i_item_id"] == exp[:100]
+
+
+# -------------------- q45 zip-list OR hot-item-subquery web revenue
+
+def test_spark_q45(sess, data, strategy):
+    """The item IN-subquery is evaluated driver-side into literals
+    (the engine's q45 does the same via _collect_column)."""
+    import numpy as np
+
+    hot_sks = (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)
+    ids, lens = data["item"]["i_item_id"]
+    sks = data["item"]["i_item_sk"][0]
+    hot_ids = sorted({
+        bytes(ids[i][:lens[i]]).decode()
+        for i in range(sks.shape[0]) if int(sks[i]) in hot_sks})
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                       F.binop("EqualTo", a("d_qoy"), i32(2))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_qoy")])),
+    )
+    ws = F.scan("web_sales", [a("ws_sold_date_sk"), a("ws_item_sk"),
+                              a("ws_bill_customer_sk"), a("ws_sales_price")])
+    j = join(strategy, dt, ws, [a("d_date_sk")], [a("ws_sold_date_sk")])
+    cu = F.scan("customer", [a("c_customer_sk"), a("c_current_addr_sk")])
+    j = join(strategy, cu, j, [a("c_customer_sk")], [a("ws_bill_customer_sk")])
+    ca = F.scan("customer_address", [a("ca_address_sk"), a("ca_city"),
+                                     a("ca_zip")])
+    j = join(strategy, ca, j, [a("ca_address_sk")], [a("c_current_addr_sk")])
+    it = F.scan("item", [a("i_item_sk"), a("i_item_id")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("ws_item_sk")])
+    zips = ("35000", "35137", "60031", "60062", "60093")
+    zip5 = F.T(F.X + "Substring", [a("ca_zip"), i32(1), i32(5)])
+    pred = in_(zip5, *zips)
+    if hot_ids:
+        pred = or_(pred, in_(a("i_item_id"), *hot_ids))
+    f = F.filter_(pred, j)
+    agg = two_stage([a("ca_zip"), a("ca_city")],
+                    [(F.sum_(a("ws_sales_price")), 501)], f)
+    plan = F.take_ordered(
+        100, [F.sort_order(a("ca_zip")), F.sort_order(a("ca_city"))],
+        [F.alias(a("ca_zip"), "ca_zip", 510),
+         F.alias(a("ca_city"), "ca_city", 511),
+         F.alias(ar("sum_sales", 501, "decimal(17,2)"), "sum_sales", 512)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q45(data)
+    assert exp, "q45 oracle empty"
+    n = len(got["ca_zip"])
+    assert n == min(len(exp), 100)
+    rows = {(got["ca_zip"][i], got["ca_city"][i]): got["sum_sales"][i]
+            for i in range(n)}
+    assert rows == exp if len(exp) <= 100 else all(
+        exp.get(k) == v for k, v in rows.items())
+
+
+# -------------- q76 missing-dimension-key channel union (sentinel FKs)
+
+def test_spark_q76(sess, data, strategy):
+    dt = F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_qoy")])
+    it = F.scan("item", [a("i_item_sk"), a("i_category")])
+
+    def channel(fact, date_c, item_c, null_c, price_c, name):
+        f = F.filter_(F.binop("EqualTo", a(null_c), F.lit(-1, "long")),
+                      F.scan(fact, [a(date_c), a(item_c), a(null_c),
+                                    a(price_c)]))
+        j = join(strategy, dt, f, [a("d_date_sk")], [a(date_c)])
+        j = join(strategy, it, j, [a("i_item_sk")], [a(item_c)])
+        return F.project(
+            [F.alias(F.lit(name, "string"), "channel", 740),
+             F.alias(F.lit(null_c, "string"), "col_name", 741),
+             a("d_year"), a("d_qoy"), a("i_category"),
+             F.alias(a(price_c), "ext_sales_price", 742)],
+            j,
+        )
+
+    u = F.union([
+        channel("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                "ss_customer_sk", "ss_ext_sales_price", "store"),
+        channel("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                "ws_promo_sk", "ws_ext_sales_price", "web"),
+        channel("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                "cs_bill_customer_sk", "cs_ext_sales_price", "catalog"),
+    ])
+    groups = [ar("channel", 740, "string"), ar("col_name", 741, "string"),
+              a("d_year"), a("d_qoy"), a("i_category")]
+    agg = two_stage(
+        groups,
+        [(F.count(), 501), (F.sum_(ar("ext_sales_price", 742,
+                                      "decimal(7,2)")), 502)],
+        u,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(g) for g in groups],
+        [F.alias(ar("channel", 740, "string"), "channel", 750),
+         F.alias(ar("col_name", 741, "string"), "col_name", 751),
+         F.alias(a("d_year"), "d_year", 752),
+         F.alias(a("d_qoy"), "d_qoy", 753),
+         F.alias(a("i_category"), "i_category", 754),
+         F.alias(ar("sales_cnt", 501, "long"), "sales_cnt", 755),
+         F.alias(ar("sales_amt", 502, "decimal(17,2)"), "sales_amt", 756)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q76(data)
+    assert exp, "q76 oracle empty"
+    n = len(got["channel"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["channel"][i], got["col_name"][i], got["d_year"][i],
+               got["d_qoy"][i], got["i_category"][i])
+        assert key in exp, key
+        assert (got["sales_cnt"][i], got["sales_amt"][i]) == exp[key], key
