@@ -2871,3 +2871,108 @@ def test_spark_q76(sess, data, strategy):
                got["d_qoy"][i], got["i_category"][i])
         assert key in exp, key
         assert (got["sales_cnt"][i], got["sales_amt"][i]) == exp[key], key
+
+
+# --------------- q33/q56/q60 three-channel union by filtered item set
+
+def _channel_by_item_plan(st, fact, date_c, item_c, addr_c, price_c, *,
+                          group_col, gdtype, item_filter, year, moy):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_year"), i32(year)),
+                       F.binop("EqualTo", a("d_moy"), i32(moy))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")])),
+    )
+    ca = F.project(
+        [a("ca_address_sk")],
+        F.filter_(F.binop("EqualTo", a("ca_gmt_offset"),
+                          F.lit("-5", "decimal(5,2)")),
+                  F.scan("customer_address",
+                         [a("ca_address_sk"), a("ca_gmt_offset")])),
+    )
+    ids = distinct(
+        [ar("id_set", 760, gdtype)],
+        F.project([F.alias(a(group_col), "id_set", 760)],
+                  F.filter_(item_filter,
+                            F.scan("item", [a(group_col), a("i_category"),
+                                            a("i_color")]))),
+    )
+    it = F.scan("item", [a("i_item_sk"), a(group_col)])
+    it_f = join(st, ids, it, [ar("id_set", 760, gdtype)], [a(group_col)],
+                jt="LeftSemi", build_side="right")
+    sl = F.scan(fact, [a(date_c), a(item_c), a(addr_c), a(price_c)])
+    j = join(st, dt, sl, [a("d_date_sk")], [a(date_c)])
+    j = join(st, ca, j, [a("ca_address_sk")], [a(addr_c)])
+    j = join(st, it_f, j, [a("i_item_sk")], [a(item_c)])
+    return F.project(
+        [a(group_col), F.alias(a(price_c), "sales_price", 761)], j)
+
+
+def _three_channel_union_plan(st, *, group_col, gdtype, item_filter, year,
+                              moy):
+    arms = [
+        _channel_by_item_plan(st, s_, d_, i_, ad, p_, group_col=group_col,
+                              gdtype=gdtype, item_filter=item_filter,
+                              year=year, moy=moy)
+        for s_, d_, i_, ad, p_ in [
+            ("store_sales", "ss_sold_date_sk", "ss_item_sk", "ss_addr_sk",
+             "ss_ext_sales_price"),
+            ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+             "cs_bill_addr_sk", "cs_ext_sales_price"),
+            ("web_sales", "ws_sold_date_sk", "ws_item_sk", "ws_bill_addr_sk",
+             "ws_ext_sales_price"),
+        ]
+    ]
+    u = F.union(arms)
+    agg = two_stage(
+        [a(group_col)],
+        [(F.sum_(ar("sales_price", 761, "decimal(7,2)")), 501)], u)
+    total = ar("total_sales", 501, "decimal(17,2)")
+    return F.take_ordered(
+        100, [F.sort_order(total), F.sort_order(a(group_col))],
+        [F.alias(a(group_col), group_col, 770),
+         F.alias(total, "total_sales", 771)],
+        agg,
+    )
+
+
+def test_spark_q33(sess, data, strategy):
+    plan = _three_channel_union_plan(
+        strategy, group_col="i_manufact_id", gdtype="integer",
+        item_filter=F.binop("EqualTo", a("i_category"), s("Electronics")),
+        year=1998, moy=5)
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q33(data)
+    rows = dict(zip(got["i_manufact_id"], got["total_sales"]))
+    assert rows, "q33 returned no rows"
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+
+
+def test_spark_q56(sess, data, strategy):
+    plan = _three_channel_union_plan(
+        strategy, group_col="i_item_id", gdtype="string",
+        item_filter=in_(a("i_color"), "slate", "blanched", "burnished"),
+        year=2000, moy=2)
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q56(data)
+    rows = dict(zip(got["i_item_id"], got["total_sales"]))
+    assert rows, "q56 returned no rows"
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
+
+
+def test_spark_q60(sess, data, strategy):
+    plan = _three_channel_union_plan(
+        strategy, group_col="i_item_id", gdtype="string",
+        item_filter=F.binop("EqualTo", a("i_category"), s("Music")),
+        year=1999, moy=9)
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q60(data)
+    rows = dict(zip(got["i_item_id"], got["total_sales"]))
+    assert rows, "q60 returned no rows"
+    for k, v in rows.items():
+        assert exp.get(k) == v, k
+    assert len(rows) == min(len(exp), 100)
