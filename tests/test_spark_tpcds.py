@@ -858,14 +858,15 @@ def test_spark_q8(sess, data):
 
 # --------------------------------------- correlated EXISTS family (q10/q35)
 
-def _active_set_plan(sales, date_col, cust_col, out_id):
-    """DISTINCT customer sks of a channel in the (2002, moy 1-4) window."""
+def _active_set_plan(sales, date_col, cust_col, out_id, moy_hi=4):
+    """DISTINCT customer sks of a channel in the (2002, moy 1..hi)
+    window (q10/q35: hi=4; q69: hi=3)."""
     dt = F.project(
         [a("d_date_sk")],
         F.filter_(
             and_(F.binop("EqualTo", a("d_year"), i32(2002)),
                  F.binop("GreaterThanOrEqual", a("d_moy"), i32(1)),
-                 F.binop("LessThanOrEqual", a("d_moy"), i32(4))),
+                 F.binop("LessThanOrEqual", a("d_moy"), i32(moy_hi))),
             F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")]),
         ),
     )
@@ -877,14 +878,14 @@ def _active_set_plan(sales, date_col, cust_col, out_id):
     )
 
 
-def _exists_or_channels_plan(cust, *, negate=False):
+def _exists_or_channels_plan(cust, *, negate=False, moy_hi=4):
     """cust + EXISTS(store) + (web OR catalog) existence flags — the
     LEFT_SEMI + two ExistenceJoin shape Spark plans for correlated
     EXISTS (catalyst appends the exists attrs carried in the join
     type)."""
-    ss = _active_set_plan("store_sales", "ss_sold_date_sk", "ss_customer_sk", 601)
-    ws = _active_set_plan("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk", 602)
-    cs = _active_set_plan("catalog_sales", "cs_sold_date_sk", "cs_ship_customer_sk", 603)
+    ss = _active_set_plan("store_sales", "ss_sold_date_sk", "ss_customer_sk", 601, moy_hi)
+    ws = _active_set_plan("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk", 602, moy_hi)
+    cs = _active_set_plan("catalog_sales", "cs_sold_date_sk", "cs_ship_customer_sk", 603, moy_hi)
     ck = [a("c_customer_sk")]
     j = semi_right(cust, ss, ck, [ar("cust_sk", 601, "long")])
     ex_ws = F.attr("exists", 611, "boolean")
@@ -1004,6 +1005,50 @@ def test_spark_q35(sess, data):
             assert got[f"sum{j_+1}"][i] == e[3 + 3 * j_], k
     if len(exp) <= 100:
         assert set(keys) == set(exp)
+
+
+def test_spark_q69(sess, data):
+    """q10's existence shape with NEGATED flags (NOT EXISTS web AND
+    NOT EXISTS catalog) over state-resident in-store customers."""
+    ca = F.project(
+        [a("ca_address_sk")],
+        F.filter_(in_(a("ca_state"), "TN", "SD", "AL"),
+                  F.scan("customer_address", [a("ca_address_sk"),
+                                              a("ca_state")])),
+    )
+    cust = F.scan(
+        "customer",
+        [a("c_customer_sk"), a("c_current_addr_sk"), a("c_current_cdemo_sk")],
+    )
+    cust = semi_right(cust, ca, [a("c_current_addr_sk")], [a("ca_address_sk")])
+    act = _exists_or_channels_plan(cust, negate=True, moy_hi=3)
+    cd = F.scan(
+        "customer_demographics",
+        [a("cd_demo_sk"), a("cd_gender"), a("cd_marital_status"),
+         a("cd_education_status"), a("cd_purchase_estimate"),
+         a("cd_credit_rating")],
+    )
+    j = bhj_build_left(cd, act, [a("cd_demo_sk")], [a("c_current_cdemo_sk")])
+    group_cols = ["cd_gender", "cd_marital_status", "cd_education_status",
+                  "cd_purchase_estimate", "cd_credit_rating"]
+    agg = two_stage([a(c) for c in group_cols], [(F.count(), 620)], j)
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a(c)) for c in group_cols],
+        [F.alias(a(c), c, 630 + i) for i, c in enumerate(group_cols)]
+        + [F.alias(ar("cnt", 620, "long"), "cnt", 640)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q69(data)
+    keys = list(zip(got["cd_gender"], got["cd_marital_status"],
+                    got["cd_education_status"], got["cd_purchase_estimate"],
+                    got["cd_credit_rating"]))
+    assert keys and len(set(keys)) == len(keys)
+    for k, c in zip(keys, got["cnt"]):
+        assert exp.get(k) == c, k
+    assert len(keys) == min(len(exp), 100)
+    assert keys == sorted(keys)
 
 
 def test_spark351_dump_ds_q27_rollup(sess, data):

@@ -2976,3 +2976,86 @@ def test_spark_q60(sess, data, strategy):
     for k, v in rows.items():
         assert exp.get(k) == v, k
     assert len(rows) == min(len(exp), 100)
+
+
+# ------------------- q13/q48 OR-of-bands star join (ticket slice)
+
+def _q13_source_plan(st):
+    from blaze_tpu.tpcds.queries import Q13_BANDS, Q13_STATE_BANDS
+
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2001)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    st_p = F.scan("store", [a("s_store_sk")])
+    cd_p = F.scan("customer_demographics",
+                  [a("cd_demo_sk"), a("cd_marital_status"),
+                   a("cd_education_status")])
+    hd_p = F.scan("household_demographics",
+                  [a("hd_demo_sk"), a("hd_dep_count")])
+    ca_p = F.scan("customer_address", [a("ca_address_sk"), a("ca_state")])
+    sl = F.scan("store_sales",
+                [a("ss_sold_date_sk"), a("ss_store_sk"), a("ss_cdemo_sk"),
+                 a("ss_hdemo_sk"), a("ss_addr_sk"), a("ss_quantity"),
+                 a("ss_sales_price"), a("ss_ext_sales_price"),
+                 a("ss_ext_discount_amt"), a("ss_net_profit")])
+    j = join(st, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(st, st_p, j, [a("s_store_sk")], [a("ss_store_sk")])
+    j = join(st, cd_p, j, [a("cd_demo_sk")], [a("ss_cdemo_sk")])
+    j = join(st, hd_p, j, [a("hd_demo_sk")], [a("ss_hdemo_sk")])
+    j = join(st, ca_p, j, [a("ca_address_sk")], [a("ss_addr_sk")])
+    dec = "decimal(7,2)"
+    demo = or_(*[
+        and_(F.binop("EqualTo", a("cd_marital_status"), s(ms)),
+             F.binop("EqualTo", a("cd_education_status"), s(ed)),
+             F.binop("GreaterThanOrEqual", a("ss_sales_price"),
+                     F.lit(str(lo), dec)),
+             F.binop("LessThanOrEqual", a("ss_sales_price"),
+                     F.lit(str(hi), dec)),
+             F.binop("EqualTo", a("hd_dep_count"), i32(dep)))
+        for ms, ed, lo, hi, dep in Q13_BANDS])
+    geo = or_(*[
+        and_(in_(a("ca_state"), *states),
+             F.binop("GreaterThanOrEqual", a("ss_net_profit"),
+                     F.lit(str(lo), dec)),
+             F.binop("LessThanOrEqual", a("ss_net_profit"),
+                     F.lit(str(hi), dec)))
+        for states, lo, hi in Q13_STATE_BANDS])
+    return F.filter_(and_(demo, geo), j)
+
+
+def test_spark_q13(ticket_sess, ticket_data, strategy):
+    agg = two_stage(
+        [],
+        [(F.avg(a("ss_quantity")), 501),
+         (F.avg(a("ss_ext_sales_price")), 502),
+         (F.avg(a("ss_ext_discount_amt")), 503),
+         (F.count(), 504)],
+        _q13_source_plan(strategy),
+    )
+    plan = F.project(
+        [F.alias(ar("avg_qty", 501, "double"), "avg_qty", 510),
+         F.alias(ar("avg_ext_sales", 502, "decimal(11,6)"),
+                 "avg_ext_sales", 511),
+         F.alias(ar("avg_ext_disc", 503, "decimal(11,6)"),
+                 "avg_ext_disc", 512),
+         F.alias(ar("cnt", 504, "long"), "cnt", 513)],
+        agg,
+    )
+    got = _execute_both(ticket_sess, plan)
+    exp = O.oracle_q13(ticket_data)
+    assert exp is not None, "q13 bands matched no rows"
+    assert got["cnt"] == [exp["cnt"]]
+    assert abs(got["avg_qty"][0] - exp["avg_qty"]) < 1e-9
+    assert got["avg_ext_sales"] == [exp["avg_ext_sales"]]
+    assert got["avg_ext_disc"] == [exp["avg_ext_disc"]]
+
+
+def test_spark_q48(ticket_sess, ticket_data, strategy):
+    agg = two_stage([], [(F.sum_(a("ss_quantity")), 501)],
+                    _q13_source_plan(strategy))
+    plan = F.project(
+        [F.alias(ar("qty_sum", 501, "long"), "qty_sum", 510)], agg)
+    got = _execute_both(ticket_sess, plan)
+    assert got["qty_sum"] == [O.oracle_q48(ticket_data)]
