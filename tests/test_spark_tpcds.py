@@ -470,6 +470,86 @@ def test_spark_q36(sess, data):
     _check_rollup_margin(got, O.oracle_q36(data))
 
 
+def test_spark_q86(sess, data):
+    """q36's ROLLUP shape over web_sales: single net-paid measure
+    (no denominator), rank within parent by measure DESC."""
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    it = F.scan("item", [a("i_item_sk"), a("i_class"), a("i_category")])
+    sales = F.scan("web_sales", [a("ws_sold_date_sk"), a("ws_item_sk"),
+                                 a("ws_net_paid")])
+    j = bhj_build_left(dt, sales, [a("d_date_sk")], [a("ws_sold_date_sk")])
+    j = bhj_build_left(it, j, [a("i_item_sk")], [a("ws_item_sk")])
+
+    null_s = F.lit(None, "string")
+    exp_cat = ar("i_category", 520, "string")
+    exp_cls = ar("i_class", 521, "string")
+    exp_gid = ar("spark_grouping_id", 522, "integer")
+    vals = [a("ws_net_paid")]
+    expand = F.expand(
+        [
+            vals + [a("i_category"), a("i_class"), F.lit(0, "integer")],
+            vals + [a("i_category"), null_s, F.lit(1, "integer")],
+            vals + [null_s, null_s, F.lit(3, "integer")],
+        ],
+        vals + [exp_cat, exp_cls, exp_gid],
+        j,
+    )
+    agg = two_stage(
+        [exp_cat, exp_cls, exp_gid],
+        [(F.sum_(a("ws_net_paid")), 501)],
+        expand,
+    )
+    loch = F.T(
+        F.X + "CaseWhen",
+        [F.binop("EqualTo", exp_gid, i32(0)), i32(0),
+         F.binop("EqualTo", exp_gid, i32(1)), i32(1),
+         i32(2)],
+    )
+    measure = F.cast(ar("num_sum", 501, "decimal(17,2)"), "double")
+    proj = F.project(
+        [F.alias(exp_cat, "i_category", 540), F.alias(exp_cls, "i_class", 541),
+         F.alias(loch, "lochierarchy", 542), F.alias(measure, "measure", 543)],
+        agg,
+    )
+    cat_o = ar("i_category", 540, "string")
+    cls_o = ar("i_class", 541, "string")
+    loch_o = ar("lochierarchy", 542, "integer")
+    meas_o = ar("measure", 543, "double")
+    parent = F.T(F.X + "CaseWhen",
+                 [F.binop("EqualTo", loch_o, i32(0)), cat_o])
+    single = F.shuffle(F.single_partition(), proj)
+    pre = F.sort(
+        [F.sort_order(loch_o), F.sort_order(parent),
+         F.sort_order(meas_o, asc=False)],
+        single,
+    )
+    w = F.window(
+        [F.window_expr(F.rank_fn([meas_o]),
+                       F.window_spec([loch_o, parent],
+                                     [F.sort_order(meas_o, asc=False)]),
+                       "rank_within_parent", 550)],
+        [loch_o, parent],
+        [F.sort_order(meas_o, asc=False)],
+        pre,
+    )
+    rank_o = ar("rank_within_parent", 550, "integer")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(loch_o, asc=False), F.sort_order(parent),
+         F.sort_order(rank_o)],
+        [F.alias(cat_o, "i_category", 560), F.alias(cls_o, "i_class", 561),
+         F.alias(loch_o, "lochierarchy", 562), F.alias(meas_o, "measure", 563),
+         F.alias(rank_o, "rank_within_parent", 564)],
+        w,
+    )
+    got = _execute_both(sess, plan)
+    _check_rollup_margin(got, O.oracle_q86(data))
+
+
 # -------------------------------------------------- windows (q47/q89/q98)
 
 def test_spark_q47(sess, data):

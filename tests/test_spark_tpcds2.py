@@ -3059,3 +3059,90 @@ def test_spark_q48(ticket_sess, ticket_data, strategy):
         [F.alias(ar("qty_sum", 501, "long"), "qty_sum", 510)], agg)
     got = _execute_both(ticket_sess, plan)
     assert got["qty_sum"] == [O.oracle_q48(ticket_data)]
+
+
+# ------------- q53/q63 manufacturer window-average ratio reports
+
+def _manufact_window_plan(st, group_col, avg_name, order_cols):
+    it = F.project(
+        [a("i_item_sk"), a("i_manufact_id")],
+        F.filter_(
+            or_(and_(in_(a("i_category"), "Books", "Children", "Electronics"),
+                     in_(a("i_class"), "personal", "self-help", "reference")),
+                and_(in_(a("i_category"), "Women", "Music", "Men"),
+                     in_(a("i_class"), "accessories", "classical",
+                         "fragrances"))),
+            F.scan("item", [a("i_item_sk"), a("i_manufact_id"), a("i_class"),
+                            a("i_category")]),
+        ),
+    )
+    dt = F.project(
+        [a("d_date_sk"), a(group_col)],
+        F.filter_(F.T(F.X + "In", [a("d_year"), i32(1999), i32(2000)]),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"),
+                                      a(group_col)])),
+    )
+    st_p = F.scan("store", [a("s_store_sk")])
+    sl = F.scan("store_sales", [a("ss_sold_date_sk"), a("ss_item_sk"),
+                                a("ss_store_sk"), a("ss_sales_price")])
+    j = join(st, it, sl, [a("i_item_sk")], [a("ss_item_sk")])
+    j = join(st, dt, j, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(st, st_p, j, [a("s_store_sk")], [a("ss_store_sk")])
+    agg = two_stage([a("i_manufact_id"), a(group_col)],
+                    [(F.sum_(a("ss_sales_price")), 501)], j)
+    sum_sales = ar("sum_sales", 501, "decimal(17,2)")
+    single = F.shuffle(F.single_partition(), agg)
+    pre = F.sort([F.sort_order(a("i_manufact_id"))], single)
+    w = F.window(
+        [F.window_expr(
+            F.window_agg(F.avg(sum_sales)),
+            F.window_spec([a("i_manufact_id")], [],
+                          F.window_frame("up", "uf", row=True)),
+            avg_name, 502)],
+        [a("i_manufact_id")],
+        [],
+        pre,
+    )
+    avg_a = ar(avg_name, 502, "decimal(21,6)")
+    sum_f = F.cast(sum_sales, "double")
+    avg_f = F.cast(avg_a, "double")
+    ratio = F.T(
+        F.X + "CaseWhen",
+        [F.binop("GreaterThan", avg_f, F.lit(0.0, "double")),
+         F.binop("Divide", F.un("Abs", F.binop("Subtract", sum_f, avg_f)),
+                 avg_f)],
+    )
+    filt = F.filter_(F.binop("GreaterThan", ratio, F.lit(0.1, "double")), w)
+    attr_of = {"i_manufact_id": a("i_manufact_id"), group_col: a(group_col),
+               "sum_sales": sum_sales, avg_name: avg_a}
+    return F.take_ordered(
+        100,
+        [F.sort_order(attr_of[c]) for c in order_cols],
+        [F.alias(a("i_manufact_id"), "i_manufact_id", 510),
+         F.alias(a(group_col), group_col, 511),
+         F.alias(sum_sales, "sum_sales", 512),
+         F.alias(avg_a, avg_name, 513)],
+        filt,
+    )
+
+
+def test_spark_q53(sess, data, strategy):
+    from test_tpcds import _check_manufact_window
+
+    order = ["avg_quarterly_sales", "sum_sales", "i_manufact_id"]
+    plan = _manufact_window_plan(strategy, "d_qoy", "avg_quarterly_sales",
+                                 order)
+    got = _execute_both(sess, plan)
+    _check_manufact_window(got, O.oracle_q53(data), "d_qoy",
+                           "avg_quarterly_sales", order)
+
+
+def test_spark_q63(sess, data, strategy):
+    from test_tpcds import _check_manufact_window
+
+    order = ["i_manufact_id", "avg_monthly_sales", "sum_sales"]
+    plan = _manufact_window_plan(strategy, "d_moy", "avg_monthly_sales",
+                                 order)
+    got = _execute_both(sess, plan)
+    _check_manufact_window(got, O.oracle_q63(data), "d_moy",
+                           "avg_monthly_sales", order)
