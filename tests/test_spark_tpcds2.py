@@ -3146,3 +3146,162 @@ def test_spark_q63(sess, data, strategy):
     got = _execute_both(sess, plan)
     _check_manufact_window(got, O.oracle_q63(data), "d_moy",
                            "avg_monthly_sales", order)
+
+
+# --------------- q21/q40 inventory/sales before-after pivot reports
+
+def test_spark_q21(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk"), a("d_date")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"),
+                         F.lit("2000-02-10", "date")),
+                 F.binop("LessThanOrEqual", a("d_date"),
+                         F.lit("2000-04-10", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    dec = "decimal(7,2)"
+    it = F.project(
+        [a("i_item_sk"), a("i_item_id")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("i_current_price"),
+                         F.lit("20", dec)),
+                 F.binop("LessThanOrEqual", a("i_current_price"),
+                         F.lit("50", dec))),
+            F.scan("item", [a("i_item_sk"), a("i_item_id"),
+                            a("i_current_price")]),
+        ),
+    )
+    wh = F.scan("warehouse", [a("w_warehouse_sk"), a("w_warehouse_name")])
+    inv = F.scan("inventory", [a("inv_date_sk"), a("inv_item_sk"),
+                               a("inv_warehouse_sk"),
+                               a("inv_quantity_on_hand")])
+    j = join(strategy, dt, inv, [a("d_date_sk")], [a("inv_date_sk")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("inv_item_sk")])
+    j = join(strategy, wh, j, [a("w_warehouse_sk")], [a("inv_warehouse_sk")])
+    pivot = F.lit("2000-03-11", "date")
+    qoh = F.cast(a("inv_quantity_on_hand"), "long")
+    zero = F.lit(0, "long")
+    before = F.T(F.X + "CaseWhen",
+                 [F.binop("LessThan", a("d_date"), pivot), qoh, zero])
+    after = F.T(F.X + "CaseWhen",
+                [F.binop("GreaterThanOrEqual", a("d_date"), pivot), qoh, zero])
+    proj = F.project(
+        [a("w_warehouse_name"), a("i_item_id"),
+         F.alias(before, "b", 520), F.alias(after, "a", 521)], j)
+    agg = two_stage(
+        [a("w_warehouse_name"), a("i_item_id")],
+        [(F.sum_(ar("b", 520, "long")), 501),
+         (F.sum_(ar("a", 521, "long")), 502)],
+        proj,
+    )
+    bf = F.cast(ar("inv_before", 501, "long"), "double")
+    af = F.cast(ar("inv_after", 502, "long"), "double")
+    ratio = F.binop("Divide", af, bf)
+    f = F.filter_(
+        and_(F.binop("GreaterThan", bf, F.lit(0.0, "double")),
+             F.binop("GreaterThanOrEqual", ratio,
+                     F.lit(2.0 / 3.0, "double")),
+             F.binop("LessThanOrEqual", ratio, F.lit(1.5, "double"))),
+        agg,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("w_warehouse_name")), F.sort_order(a("i_item_id"))],
+        [F.alias(a("w_warehouse_name"), "w_warehouse_name", 530),
+         F.alias(a("i_item_id"), "i_item_id", 531),
+         F.alias(ar("inv_before", 501, "long"), "inv_before", 532),
+         F.alias(ar("inv_after", 502, "long"), "inv_after", 533)],
+        f,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q21(data)
+    assert exp, "q21 oracle empty"
+    n = len(got["w_warehouse_name"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["w_warehouse_name"][i], got["i_item_id"][i])
+        assert key in exp, key
+        assert (got["inv_before"][i], got["inv_after"][i]) == exp[key], key
+    keys = [(got["w_warehouse_name"][i], got["i_item_id"][i])
+            for i in range(n)]
+    assert keys == sorted(keys)
+
+
+def test_spark_q40(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk"), a("d_date")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"),
+                         F.lit("2000-02-10", "date")),
+                 F.binop("LessThanOrEqual", a("d_date"),
+                         F.lit("2000-04-10", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    dec = "decimal(7,2)"
+    it = F.project(
+        [a("i_item_sk"), a("i_item_id")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("i_current_price"),
+                         F.lit("20", dec)),
+                 F.binop("LessThanOrEqual", a("i_current_price"),
+                         F.lit("50", dec))),
+            F.scan("item", [a("i_item_sk"), a("i_item_id"),
+                            a("i_current_price")]),
+        ),
+    )
+    wh = F.scan("warehouse", [a("w_warehouse_sk"), a("w_state")])
+    cs = F.scan("catalog_sales",
+                [a("cs_sold_date_sk"), a("cs_item_sk"), a("cs_order_number"),
+                 a("cs_warehouse_sk"), a("cs_sales_price")])
+    j = join(strategy, dt, cs, [a("d_date_sk")], [a("cs_sold_date_sk")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("cs_item_sk")])
+    j = join(strategy, wh, j, [a("w_warehouse_sk")], [a("cs_warehouse_sk")])
+    cr = F.scan("catalog_returns", [a("cr_item_sk"), a("cr_order_number"),
+                                    a("cr_refunded_cash")])
+    j = join(strategy, cr, j, [a("cr_item_sk"), a("cr_order_number")],
+             [a("cs_item_sk"), a("cs_order_number")], jt="LeftOuter",
+             build_side="right")
+    dz = F.lit("0", dec)
+    net_sales = F.binop("Add", a("cs_sales_price"), dz)  # decimal(8,2)
+    refund = F.T(
+        F.X + "CaseWhen",
+        [F.un("IsNotNull", a("cr_refunded_cash")),
+         F.binop("Add", a("cr_refunded_cash"), dz),
+         F.binop("Add", dz, dz)],
+    )
+    net = F.binop("Subtract", net_sales, refund)
+    pivot = F.lit("2000-03-11", "date")
+    before = F.T(F.X + "CaseWhen",
+                 [F.binop("LessThan", a("d_date"), pivot), net])
+    after = F.T(F.X + "CaseWhen",
+                [F.binop("GreaterThanOrEqual", a("d_date"), pivot), net])
+    proj = F.project(
+        [a("w_state"), a("i_item_id"),
+         F.alias(before, "b", 520), F.alias(after, "a", 521)], j)
+    agg = two_stage(
+        [a("w_state"), a("i_item_id")],
+        [(F.sum_(ar("b", 520, "decimal(9,2)")), 501),
+         (F.sum_(ar("a", 521, "decimal(9,2)")), 502)],
+        proj,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("w_state")), F.sort_order(a("i_item_id"))],
+        [F.alias(a("w_state"), "w_state", 530),
+         F.alias(a("i_item_id"), "i_item_id", 531),
+         F.alias(ar("sales_before", 501, "decimal(19,2)"), "sales_before", 532),
+         F.alias(ar("sales_after", 502, "decimal(19,2)"), "sales_after", 533)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q40(data)
+    assert exp, "q40 oracle empty"
+    n = len(got["w_state"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["w_state"][i], got["i_item_id"][i])
+        assert key in exp, key
+        assert (got["sales_before"][i], got["sales_after"][i]) == exp[key], key
