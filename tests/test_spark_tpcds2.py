@@ -3305,3 +3305,164 @@ def test_spark_q40(sess, data, strategy):
         key = (got["w_state"][i], got["i_item_id"][i])
         assert key in exp, key
         assert (got["sales_before"][i], got["sales_after"][i]) == exp[key], key
+
+
+# ---------------- q28 six price-band buckets (scalar-subquery trios)
+
+def test_spark_q28(sess, data, strategy):
+    """avg/count/count-distinct per band, each a driver-resolved
+    scalar subquery; the DISTINCT count is a grouping-only agg under a
+    count (the shape Spark plans instead of a distinct aggregate)."""
+    if strategy == "smj":
+        pytest.skip("no joins in q28: the strategy axis is vacuous")
+    bands = [
+        ("B1", 0, 5, 0, 10, 0, 50),
+        ("B2", 6, 10, 10, 20, 50, 100),
+        ("B3", 11, 15, 20, 30, 100, 150),
+        ("B4", 16, 20, 30, 40, 150, 200),
+        ("B5", 21, 25, 40, 50, 200, 250),
+        ("B6", 26, 30, 50, 60, 250, 300),
+    ]
+    dec = "decimal(7,2)"
+    exprs = []
+    rid = 801
+    for bi, (name, q_lo, q_hi, c_lo, c_hi, w_lo, w_hi) in enumerate(bands):
+        pred = and_(
+            F.binop("GreaterThanOrEqual", a("ss_quantity"), i32(q_lo)),
+            F.binop("LessThanOrEqual", a("ss_quantity"), i32(q_hi)),
+            or_(
+                and_(F.binop("GreaterThanOrEqual", a("ss_list_price"),
+                             F.lit(str(c_lo), dec)),
+                     F.binop("LessThanOrEqual", a("ss_list_price"),
+                             F.lit(str(c_lo + 10), dec))),
+                and_(F.binop("GreaterThanOrEqual", a("ss_coupon_amt"),
+                             F.lit(str(w_lo), dec)),
+                     F.binop("LessThanOrEqual", a("ss_coupon_amt"),
+                             F.lit(str(w_lo + 1000), dec))),
+                and_(F.binop("GreaterThanOrEqual", a("ss_wholesale_cost"),
+                             F.lit(str(c_hi), dec)),
+                     F.binop("LessThanOrEqual", a("ss_wholesale_cost"),
+                             F.lit(str(c_hi + 20), dec))),
+            ),
+        )
+        lp = F.project(
+            [a("ss_list_price")],
+            F.filter_(pred, F.scan(
+                "store_sales",
+                [a("ss_quantity"), a("ss_list_price"), a("ss_coupon_amt"),
+                 a("ss_wholesale_cost")])),
+        )
+        avg_sq = _scalar_subquery(
+            two_stage([], [(F.avg(a("ss_list_price")), rid)], lp), rid)
+        cnt_sq = _scalar_subquery(
+            two_stage([], [(F.count(), rid + 1)], lp), rid + 1)
+        dis = distinct([a("ss_list_price")], lp)
+        cntd_sq = _scalar_subquery(
+            two_stage([], [(F.count(), rid + 2)], dis), rid + 2)
+        exprs += [
+            F.alias(avg_sq, f"{name}_lp", 850 + bi * 3),
+            F.alias(cnt_sq, f"{name}_cnt", 851 + bi * 3),
+            F.alias(cntd_sq, f"{name}_cntd", 852 + bi * 3),
+        ]
+        rid += 3
+    src = F.filter_(F.binop("EqualTo", a("r_reason_sk"), F.lit(1, "long")),
+                    F.scan("reason", [a("r_reason_sk")]))
+    got = _execute_both(sess, F.project(exprs, src))
+    exp = O.oracle_q28(data)
+    for name, (avg_u, cnt, cntd) in exp.items():
+        assert got[f"{name}_lp"] == [avg_u], name
+        assert got[f"{name}_cnt"] == [cnt], name
+        assert got[f"{name}_cntd"] == [cntd], name
+
+
+# ------------- q1/q30/q81 returns-above-location-average family
+
+def _returns_above_avg_plan(st, *, rtab, r_cust, r_amt, r_date, r_loc,
+                            loc_tab=None, loc_sk=None, loc_filter_col=None,
+                            loc_filter_val=None, names=False):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    rt = F.scan(rtab, [a(r_date), a(r_cust), a(r_loc), a(r_amt)])
+    j = join(st, dt, rt, [a("d_date_sk")], [a(r_date)])
+    if loc_tab is not None:
+        loc = F.project(
+            [a(loc_sk)],
+            F.filter_(F.binop("EqualTo", a(loc_filter_col),
+                              s(loc_filter_val)),
+                      F.scan(loc_tab, [a(loc_sk), a(loc_filter_col)])),
+        )
+        j = join(st, loc, j, [a(loc_sk)], [a(r_loc)])
+    per_cust = two_stage(
+        [a(r_cust), a(r_loc)], [(F.sum_(a(r_amt)), 501)],
+        F.project([a(r_cust), a(r_loc), a(r_amt)], j))
+    total = ar("ctr_total_return", 501, "decimal(17,2)")
+    loc_avg_src = F.project(
+        [F.alias(a(r_loc), "avg_loc_sk", 520), total], per_cust)
+    loc_avg = two_stage(
+        [ar("avg_loc_sk", 520, "long")], [(F.avg(total), 502)], loc_avg_src)
+    avg_r = ar("avg_return", 502, "decimal(21,6)")
+    j2 = join(st, loc_avg, per_cust, [ar("avg_loc_sk", 520, "long")],
+              [a(r_loc)])
+    f = F.filter_(
+        F.binop("GreaterThan", F.cast(total, "double"),
+                F.binop("Multiply", F.lit(1.2, "double"),
+                        F.cast(avg_r, "double"))),
+        j2,
+    )
+    cu_cols = [a("c_customer_sk"), a("c_customer_id")] + (
+        [a("c_first_name"), a("c_last_name")] if names else [])
+    cu = F.scan("customer", cu_cols)
+    j3 = join(st, cu, f, [a("c_customer_sk")], [a(r_cust)])
+    if names:
+        return F.take_ordered(
+            100,
+            [F.sort_order(a("c_customer_id")), F.sort_order(total)],
+            [F.alias(a("c_customer_id"), "c_customer_id", 530),
+             F.alias(a("c_first_name"), "c_first_name", 531),
+             F.alias(a("c_last_name"), "c_last_name", 532),
+             F.alias(total, "ctr_total_return", 533)],
+            j3,
+        )
+    return F.take_ordered(
+        100, [F.sort_order(a("c_customer_id"))],
+        [F.alias(a("c_customer_id"), "c_customer_id", 530)], j3)
+
+
+def test_spark_q1(sess, data, strategy):
+    plan = _returns_above_avg_plan(
+        strategy, rtab="store_returns", r_cust="sr_customer_sk",
+        r_amt="sr_return_amt", r_date="sr_returned_date_sk",
+        r_loc="sr_store_sk", loc_tab="store", loc_sk="s_store_sk",
+        loc_filter_col="s_state", loc_filter_val="TN")
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q1(data)
+    assert exp, "q1 oracle empty"
+    assert len(got["c_customer_id"]) == min(len(exp), 100)
+    assert set(got["c_customer_id"]) == exp if len(exp) <= 100 else set(
+        got["c_customer_id"]) <= exp
+    assert got["c_customer_id"] == sorted(got["c_customer_id"])
+
+
+def test_spark_q30(sess, data, strategy):
+    from test_tpcds import _check_returns_family
+
+    plan = _returns_above_avg_plan(
+        strategy, rtab="web_returns", r_cust="wr_returning_customer_sk",
+        r_amt="wr_return_amt", r_date="wr_returned_date_sk",
+        r_loc="wr_web_page_sk", names=True)
+    got = _execute_both(sess, plan)
+    _check_returns_family(got, O.oracle_q30(data))
+
+
+def test_spark_q81(sess, data, strategy):
+    from test_tpcds import _check_returns_family
+
+    plan = _returns_above_avg_plan(
+        strategy, rtab="catalog_returns", r_cust="cr_returning_customer_sk",
+        r_amt="cr_return_amount", r_date="cr_returned_date_sk",
+        r_loc="cr_call_center_sk", names=True)
+    got = _execute_both(sess, plan)
+    _check_returns_family(got, O.oracle_q81(data))
