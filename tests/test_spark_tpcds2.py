@@ -574,7 +574,7 @@ def big_join(strategy, left, right, lk, rk, jt="Inner", build_side="right",
 
 # ----------------------------------------------- q25/q29 provenance chain
 
-def _srcandc_plan(st, sums, sum_names, sum_dtype, cast_long):
+def _srcandc_join_plan(st):
     d1 = F.project(
         [a("d_date_sk")],
         F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
@@ -617,6 +617,11 @@ def _srcandc_plan(st, sums, sum_names, sum_dtype, cast_long):
     j = join(st, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
     it = F.scan("item", [a("i_item_sk"), a("i_item_id"), a("i_item_desc")])
     j = join(st, it, j, [a("i_item_sk")], [a("ss_item_sk")])
+    return j
+
+
+def _srcandc_plan(st, sums, sum_names, sum_dtype, cast_long):
+    j = _srcandc_join_plan(st)
     sum_in = [F.cast(a(c), "long") if cast_long else a(c) for c in sums]
     agg = two_stage(
         [a("i_item_id"), a("i_item_desc"), a("s_store_name")],
@@ -3466,3 +3471,118 @@ def test_spark_q81(sess, data, strategy):
         r_loc="cr_call_center_sk", names=True)
     got = _execute_both(sess, plan)
     _check_returns_family(got, O.oracle_q81(data))
+
+
+# ------------------ q17 quantity-spread statistics over the chain
+
+def test_spark_q17(sess, data, strategy):
+    j = _srcandc_join_plan(strategy)
+    qs = [("ss_quantity", "store"), ("sr_return_quantity", "returns"),
+          ("cs_quantity", "catalog")]
+    aggs = []
+    rid = 501
+    for src, nm in qs:
+        e = F.cast(a(src), "long")
+        aggs += [(F.count(e), rid), (F.avg(e), rid + 1),
+                 (F.T(F.A + "StddevSamp", [e]), rid + 2)]
+        rid += 3
+    agg = two_stage(
+        [a("i_item_id"), a("i_item_desc"), a("s_store_name")], aggs, j)
+    outs = [a("i_item_id"), a("i_item_desc"), a("s_store_name")]
+    oid = 530
+    names = []
+    rid = 501
+    for _, nm in qs:
+        cnt = ar(f"{nm}_qty_count", rid, "long")
+        avg = ar(f"{nm}_qty_avg", rid + 1, "double")
+        sd = ar(f"{nm}_qty_stdev", rid + 2, "double")
+        cov = F.T(F.X + "CaseWhen",
+                  [F.binop("GreaterThan", avg, F.lit(0.0, "double")),
+                   F.binop("Divide", sd, avg)])
+        outs += [F.alias(cnt, f"{nm}_qty_count", oid),
+                 F.alias(avg, f"{nm}_qty_avg", oid + 1),
+                 F.alias(sd, f"{nm}_qty_stdev", oid + 2),
+                 F.alias(cov, f"{nm}_qty_cov", oid + 3)]
+        names += [f"{nm}_qty_count", f"{nm}_qty_avg", f"{nm}_qty_stdev",
+                  f"{nm}_qty_cov"]
+        rid += 3
+        oid += 4
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("i_item_id")), F.sort_order(a("i_item_desc")),
+         F.sort_order(a("s_store_name"))],
+        outs,
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q17(data)
+    assert exp, "q17 oracle empty"
+    n = len(got["i_item_id"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["i_item_id"][i], got["i_item_desc"][i],
+               got["s_store_name"][i])
+        assert key in exp, key
+        for k, nm in enumerate(("store", "returns", "catalog")):
+            cnt, mean, sd, cov = exp[key][k]
+            assert got[f"{nm}_qty_count"][i] == cnt, (key, nm)
+            assert abs(got[f"{nm}_qty_avg"][i] - mean) < 1e-9, (key, nm)
+            for gv, ev in ((got[f"{nm}_qty_stdev"][i], sd),
+                           (got[f"{nm}_qty_cov"][i], cov)):
+                if ev is None:
+                    assert gv is None, (key, nm)
+                else:
+                    assert gv is not None and abs(gv - ev) < 1e-9, (key, nm)
+
+
+# ---------------- q22 product-hierarchy inventory ROLLUP (5 levels)
+
+def test_spark_q22(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    it = F.scan("item", [a("i_item_sk"), a("i_item_id"), a("i_brand"),
+                         a("i_class"), a("i_category")])
+    inv = F.scan("inventory", [a("inv_date_sk"), a("inv_item_sk"),
+                               a("inv_quantity_on_hand")])
+    j = join(strategy, dt, inv, [a("d_date_sk")], [a("inv_date_sk")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("inv_item_sk")])
+    dims = ["i_item_id", "i_brand", "i_class", "i_category"]
+    null_s = F.lit(None, "string")
+    exp_dims = [ar(d, 520 + k, "string") for k, d in enumerate(dims)]
+    exp_gid = ar("g_id", 524, "integer")
+    vals = [a("inv_quantity_on_hand")]
+    rows = []
+    for level in range(4, -1, -1):
+        row = list(vals)
+        for k, d in enumerate(dims):
+            row.append(a(d) if k < level else null_s)
+        row.append(F.lit(4 - level, "integer"))
+        rows.append(row)
+    expand = F.expand(rows, vals + exp_dims + [exp_gid], j)
+    agg = two_stage(
+        exp_dims + [exp_gid],
+        [(F.avg(a("inv_quantity_on_hand")), 501)],
+        expand,
+    )
+    qoh = ar("qoh", 501, "double")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(qoh)] + [F.sort_order(d) for d in exp_dims],
+        [F.alias(d, dims[k], 540 + k) for k, d in enumerate(exp_dims)]
+        + [F.alias(exp_gid, "g_id", 544), F.alias(qoh, "qoh", 545)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q22(data)
+    assert exp, "q22 oracle empty"
+    n = len(got["i_item_id"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["i_item_id"][i], got["i_brand"][i], got["i_class"][i],
+               got["i_category"][i], got["g_id"][i])
+        assert key in exp, key
+        assert abs(got["qoh"][i] - exp[key]) < 1e-9, key
+    assert got["qoh"] == sorted(got["qoh"])
