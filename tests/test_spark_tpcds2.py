@@ -3586,3 +3586,143 @@ def test_spark_q22(sess, data, strategy):
         assert key in exp, key
         assert abs(got["qoh"][i] - exp[key]) < 1e-9, key
     assert got["qoh"] == sorted(got["qoh"])
+
+
+# ------------- q94/q95/q16 multi-warehouse ship reports
+
+def _multi_wh_orders_plan(st, fact, order_c, wh_c, base_id):
+    pairs = distinct([a(order_c), a(wh_c)],
+                     F.project([a(order_c), a(wh_c)], F.scan(fact, [a(order_c), a(wh_c)])))
+    per_order = two_stage(
+        [a(order_c)], [(F.count(), base_id)],
+        F.project([a(order_c)], pairs))
+    hot = F.filter_(
+        F.binop("GreaterThan", ar("wh_cnt", base_id, "long"),
+                F.lit(1, "long")),
+        per_order,
+    )
+    return F.project([F.alias(a(order_c), "hot_order", base_id + 1)], hot)
+
+
+def _ship_report_plan(st, rows, order_c, ship_c, profit_c):
+    per_order = two_stage(
+        [a(order_c)],
+        [(F.sum_(a(ship_c)), 551), (F.sum_(a(profit_c)), 552)],
+        rows,
+    )
+    agg = two_stage(
+        [],
+        [(F.count(), 553),
+         (F.sum_(ar("s1", 551, "decimal(17,2)")), 554),
+         (F.sum_(ar("p1", 552, "decimal(17,2)")), 555)],
+        per_order,
+    )
+    return F.project(
+        [F.alias(ar("order_count", 553, "long"), "order_count", 560),
+         F.alias(ar("total_shipping_cost", 554, "decimal(27,2)"),
+                 "total_shipping_cost", 561),
+         F.alias(ar("total_net_profit", 555, "decimal(27,2)"),
+                 "total_net_profit", 562)],
+        agg,
+    )
+
+
+def _q94_shape_plan(st, returns_jt):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"),
+                         F.lit("1999-02-01", "date")),
+                 F.binop("LessThanOrEqual", a("d_date"),
+                         F.lit("1999-12-31", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    ca = F.project(
+        [a("ca_address_sk")],
+        F.filter_(F.binop("EqualTo", a("ca_state"), s("TN")),
+                  F.scan("customer_address", [a("ca_address_sk"),
+                                              a("ca_state")])),
+    )
+    site = F.project(
+        [a("web_site_sk")],
+        F.filter_(F.binop("EqualTo", a("web_company_name"), s("pri")),
+                  F.scan("web_site", [a("web_site_sk"),
+                                      a("web_company_name")])),
+    )
+    ws1 = F.scan("web_sales",
+                 [a("ws_ship_date_sk"), a("ws_ship_addr_sk"),
+                  a("ws_web_site_sk"), a("ws_order_number"),
+                  a("ws_ext_ship_cost"), a("ws_net_profit")])
+    j = join(st, dt, ws1, [a("d_date_sk")], [a("ws_ship_date_sk")])
+    j = join(st, ca, j, [a("ca_address_sk")], [a("ws_ship_addr_sk")])
+    j = join(st, site, j, [a("web_site_sk")], [a("ws_web_site_sk")])
+    hot = _multi_wh_orders_plan(st, "web_sales", "ws_order_number",
+                                "ws_warehouse_sk", 540)
+    j = join(st, hot, j, [ar("hot_order", 541, "long")],
+             [a("ws_order_number")], jt="LeftSemi", build_side="right")
+    wr = F.scan("web_returns", [a("wr_order_number")])
+    j = join(st, wr, j, [a("wr_order_number")], [a("ws_order_number")],
+             jt=returns_jt, build_side="right")
+    return _ship_report_plan(st, j, "ws_order_number", "ws_ext_ship_cost",
+                             "ws_net_profit")
+
+
+def test_spark_q94(sess, data, strategy):
+    from test_tpcds import _check_ship_report
+
+    got = _execute_both(sess, _q94_shape_plan(strategy, "LeftAnti"))
+    _check_ship_report(got, O.oracle_q94(data))
+
+
+def test_spark_q95(sess, data, strategy):
+    from test_tpcds import _check_ship_report
+
+    got = _execute_both(sess, _q94_shape_plan(strategy, "LeftSemi"))
+    _check_ship_report(got, O.oracle_q95(data))
+
+
+def test_spark_q16(sess, data, strategy):
+    from test_tpcds import _check_ship_report
+
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"),
+                         F.lit("2002-02-01", "date")),
+                 F.binop("LessThanOrEqual", a("d_date"),
+                         F.lit("2002-12-31", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    ca = F.project(
+        [a("ca_address_sk")],
+        F.filter_(F.binop("EqualTo", a("ca_state"), s("GA")),
+                  F.scan("customer_address", [a("ca_address_sk"),
+                                              a("ca_state")])),
+    )
+    cc = F.project(
+        [a("cc_call_center_sk")],
+        F.filter_(F.binop("EqualTo", a("cc_county"), s("Williamson County")),
+                  F.scan("call_center", [a("cc_call_center_sk"),
+                                         a("cc_county")])),
+    )
+    cs1 = F.scan("catalog_sales",
+                 [a("cs_ship_date_sk"), a("cs_ship_addr_sk"),
+                  a("cs_call_center_sk"), a("cs_order_number"),
+                  a("cs_ext_ship_cost"), a("cs_net_profit")])
+    j = join(strategy, dt, cs1, [a("d_date_sk")], [a("cs_ship_date_sk")])
+    j = join(strategy, ca, j, [a("ca_address_sk")], [a("cs_ship_addr_sk")])
+    j = join(strategy, cc, j, [a("cc_call_center_sk")],
+             [a("cs_call_center_sk")])
+    hot = _multi_wh_orders_plan(strategy, "catalog_sales", "cs_order_number",
+                                "cs_warehouse_sk", 545)
+    j = join(strategy, hot, j, [ar("hot_order", 546, "long")],
+             [a("cs_order_number")], jt="LeftSemi", build_side="right")
+    cr = F.scan("catalog_returns", [a("cr_order_number")])
+    j = join(strategy, cr, j, [a("cr_order_number")], [a("cs_order_number")],
+             jt="LeftAnti", build_side="right")
+    got = _execute_both(
+        sess, _ship_report_plan(strategy, j, "cs_order_number",
+                                "cs_ext_ship_cost", "cs_net_profit"))
+    _check_ship_report(got, O.oracle_q16(data))
