@@ -3726,3 +3726,146 @@ def test_spark_q16(sess, data, strategy):
         sess, _ship_report_plan(strategy, j, "cs_order_number",
                                 "cs_ext_ship_cost", "cs_net_profit"))
     _check_ship_report(got, O.oracle_q16(data))
+
+
+# -------------------- q2/q59 weekly dow-pivot year-over-year ratios
+
+_DOW7 = ("sun", "mon", "tue", "wed", "thu", "fri", "sat")
+
+
+def _dow_pivot_plan(group_attrs, price_attr, rows, base_rid):
+    """CASE-pivot 7 dow sums grouped by group_attrs (q43's shape)."""
+    pivots = [
+        F.alias(F.T(F.X + "CaseWhen",
+                    [F.binop("EqualTo", a("d_dow"), i32(k)), price_attr]),
+                f"{nm}_v", base_rid + k)
+        for k, nm in enumerate(_DOW7)
+    ]
+    proj = F.project(list(group_attrs) + pivots, rows)
+    return two_stage(
+        list(group_attrs),
+        [(F.sum_(ar(f"{nm}_v", base_rid + k, "decimal(7,2)")),
+          base_rid + 10 + k) for k, nm in enumerate(_DOW7)],
+        proj,
+    )
+
+
+def _week_set_plan(year, out_name, out_id):
+    y = F.filter_(F.binop("EqualTo", a("d_year"), i32(year)),
+                  F.scan("date_dim", [a("d_week_seq"), a("d_year")]))
+    return distinct(
+        [ar(out_name, out_id, "integer")],
+        F.project([F.alias(a("d_week_seq"), out_name, out_id)], y))
+
+
+def _dow_ratios(base_rid, rid2_base, out_base):
+    outs = []
+    for k, nm in enumerate(_DOW7):
+        num = F.cast(ar(f"{nm}1", base_rid + k, "decimal(17,2)"), "double")
+        den = F.cast(ar(f"{nm}2", rid2_base + k, "decimal(17,2)"), "double")
+        den = F.T(F.X + "CaseWhen",
+                  [F.binop("GreaterThan", den, F.lit(0.0, "double")), den,
+                   F.lit(1.0, "double")])
+        outs.append(F.alias(F.binop("Divide", num, den), f"{nm}_ratio",
+                            out_base + k))
+    return outs
+
+
+def test_spark_q2(sess, data, strategy):
+    from test_tpcds import _check_weekly_ratios
+
+    dt = F.scan("date_dim", [a("d_date_sk"), a("d_week_seq"), a("d_dow")])
+    sold = ar("sold_date_sk", 901, "long")
+    price = ar("sales_price", 902, "decimal(7,2)")
+    branches = [
+        F.project([F.alias(a(date_c), "sold_date_sk", 901),
+                   F.alias(a(price_c), "sales_price", 902)],
+                  F.scan(fact, [a(date_c), a(price_c)]))
+        for fact, date_c, price_c in (
+            ("web_sales", "ws_sold_date_sk", "ws_ext_sales_price"),
+            ("catalog_sales", "cs_sold_date_sk", "cs_ext_sales_price"),
+        )
+    ]
+    u = F.union(branches)
+    j = join(strategy, dt, u, [a("d_date_sk")], [sold])
+    wk = _dow_pivot_plan([a("d_week_seq")], price, j, 910)
+    wk1 = join(strategy, _week_set_plan(2001, "wk1", 930), wk,
+               [ar("wk1", 930, "integer")], [a("d_week_seq")],
+               jt="LeftSemi", build_side="right")
+    wk1 = F.project(
+        [a("d_week_seq")] + [
+            F.alias(ar(f"{nm}_sales", 920 + k, "decimal(17,2)"),
+                    f"{nm}1", 940 + k)
+            for k, nm in enumerate(_DOW7)],
+        wk1,
+    )
+    wk2 = join(strategy, _week_set_plan(2002, "wk2", 931), wk,
+               [ar("wk2", 931, "integer")], [a("d_week_seq")],
+               jt="LeftSemi", build_side="right")
+    wk2 = F.project(
+        [F.alias(F.binop("Subtract", a("d_week_seq"), i32(52)),
+                 "wk_m52", 950)] + [
+            F.alias(ar(f"{nm}_sales", 920 + k, "decimal(17,2)"),
+                    f"{nm}2", 951 + k)
+            for k, nm in enumerate(_DOW7)],
+        wk2,
+    )
+    j2 = big_join(strategy, wk1, wk2, [a("d_week_seq")],
+                  [ar("wk_m52", 950, "integer")])
+    plan = F.take_ordered(
+        100, [F.sort_order(a("d_week_seq"))],
+        [F.alias(a("d_week_seq"), "d_week_seq", 970)]
+        + _dow_ratios(940, 951, 971),
+        j2,
+    )
+    got = _execute_both(sess, plan)
+    _check_weekly_ratios(got, O.oracle_q2(data), ["d_week_seq"])
+
+
+def test_spark_q59(sess, data, strategy):
+    from test_tpcds import _check_weekly_ratios
+
+    dt = F.scan("date_dim", [a("d_date_sk"), a("d_week_seq"), a("d_dow")])
+    sl = F.scan("store_sales", [a("ss_sold_date_sk"), a("ss_store_sk"),
+                                a("ss_sales_price")])
+    j = join(strategy, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    wk = _dow_pivot_plan([a("ss_store_sk"), a("d_week_seq")],
+                         a("ss_sales_price"), j, 910)
+    st_ = F.scan("store", [a("s_store_sk"), a("s_store_name")])
+    wk = join(strategy, st_, wk, [a("s_store_sk")], [a("ss_store_sk")])
+    wk1 = join(strategy, _week_set_plan(2001, "wk1", 930), wk,
+               [ar("wk1", 930, "integer")], [a("d_week_seq")],
+               jt="LeftSemi", build_side="right")
+    wk1 = F.project(
+        [a("s_store_name"), a("ss_store_sk"), a("d_week_seq")] + [
+            F.alias(ar(f"{nm}_sales", 920 + k, "decimal(17,2)"),
+                    f"{nm}1", 940 + k)
+            for k, nm in enumerate(_DOW7)],
+        wk1,
+    )
+    wk2 = join(strategy, _week_set_plan(2002, "wk2", 931), wk,
+               [ar("wk2", 931, "integer")], [a("d_week_seq")],
+               jt="LeftSemi", build_side="right")
+    wk2 = F.project(
+        [F.alias(a("ss_store_sk"), "store2", 949),
+         F.alias(F.binop("Subtract", a("d_week_seq"), i32(52)),
+                 "wk_m52", 950)] + [
+            F.alias(ar(f"{nm}_sales", 920 + k, "decimal(17,2)"),
+                    f"{nm}2", 951 + k)
+            for k, nm in enumerate(_DOW7)],
+        wk2,
+    )
+    j2 = big_join(strategy, wk1, wk2,
+                  [a("ss_store_sk"), a("d_week_seq")],
+                  [ar("store2", 949, "long"), ar("wk_m52", 950, "integer")])
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(a("s_store_name")), F.sort_order(a("d_week_seq"))],
+        [F.alias(a("s_store_name"), "s_store_name", 969),
+         F.alias(a("d_week_seq"), "d_week_seq", 970)]
+        + _dow_ratios(940, 951, 971),
+        j2,
+    )
+    got = _execute_both(sess, plan)
+    _check_weekly_ratios(got, O.oracle_q59(data),
+                         ["s_store_name", "d_week_seq"])
