@@ -3869,3 +3869,100 @@ def test_spark_q59(sess, data, strategy):
     got = _execute_both(sess, plan)
     _check_weekly_ratios(got, O.oracle_q59(data),
                          ["s_store_name", "d_week_seq"])
+
+
+# --------------- q74/q11 year-over-year customer growth family
+
+def _yoy_customer_plan(st, *, store_measure, store_cols, web_measure,
+                       web_cols, y1, y2, out_cols, sum_dtype):
+    def slice_(fact, date_c, cust_c, cols, measure, year, base, names=False):
+        dt = F.project(
+            [a("d_date_sk")],
+            F.filter_(F.binop("EqualTo", a("d_year"), i32(year)),
+                      F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+        )
+        fc = F.scan(fact, [a(date_c), a(cust_c)] + [a(c) for c in cols])
+        cust_cols = [a("c_customer_sk")] + (
+            [a("c_customer_id"), a("c_first_name"), a("c_last_name"),
+             a("c_preferred_cust_flag")] if names else [])
+        cu = F.scan("customer", cust_cols)
+        j = join(st, dt, fc, [a("d_date_sk")], [a(date_c)])
+        j = join(st, cu, j, [a("c_customer_sk")], [a(cust_c)])
+        groups = [a("c_customer_sk")] + (
+            [a(c) for c in ("c_customer_id", "c_first_name", "c_last_name",
+                            "c_preferred_cust_flag")] if names else [])
+        yt = two_stage(groups, [(F.sum_(measure), base)], j)
+        keep = [F.alias(a("c_customer_sk"), f"sk{base}", base + 1),
+                F.alias(ar("year_total", base, sum_dtype), f"yt{base}",
+                        base + 2)]
+        if names:
+            keep += [a(c) for c in
+                     ("c_customer_id", "c_first_name", "c_last_name",
+                      "c_preferred_cust_flag")]
+        return F.project(keep, yt)
+
+    s1 = slice_("store_sales", "ss_sold_date_sk", "ss_customer_sk",
+                store_cols, store_measure("ss"), y1, 1000)
+    s2 = slice_("store_sales", "ss_sold_date_sk", "ss_customer_sk",
+                store_cols, store_measure("ss"), y2, 1010, names=True)
+    w1 = slice_("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+                web_cols, web_measure("ws"), y1, 1020)
+    w2 = slice_("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+                web_cols, web_measure("ws"), y2, 1030)
+    sk = lambda b: ar(f"sk{b}", b + 1, "long")
+    yt = lambda b: ar(f"yt{b}", b + 2, sum_dtype)
+    j = join(st, s1, s2, [sk(1000)], [sk(1010)])
+    j = join(st, w1, j, [sk(1020)], [sk(1010)])
+    j = join(st, w2, j, [sk(1030)], [sk(1010)])
+    fl = lambda e: F.cast(e, "double")
+    f = F.filter_(
+        and_(F.binop("GreaterThan", fl(yt(1000)), F.lit(0.0, "double")),
+             F.binop("GreaterThan", fl(yt(1020)), F.lit(0.0, "double")),
+             F.binop("GreaterThan",
+                     F.binop("Divide", fl(yt(1030)), fl(yt(1020))),
+                     F.binop("Divide", fl(yt(1010)), fl(yt(1000))))),
+        j,
+    )
+    return F.take_ordered(
+        100, [F.sort_order(a(out_cols[0]))],
+        [F.alias(a(c), c, 1050 + i) for i, c in enumerate(out_cols)],
+        f,
+    )
+
+
+def test_spark_q74(sess, data, strategy):
+    from test_tpcds import _check_yoy_customer
+
+    plan = _yoy_customer_plan(
+        strategy,
+        store_measure=lambda p: a("ss_net_paid"),
+        store_cols=["ss_net_paid"],
+        web_measure=lambda p: a("ws_net_paid"),
+        web_cols=["ws_net_paid"],
+        y1=1999, y2=2000,
+        out_cols=["c_customer_id", "c_first_name", "c_last_name"],
+        sum_dtype="decimal(17,2)")
+    got = _execute_both(sess, plan)
+    _check_yoy_customer(got, O.oracle_q74(data),
+                        ["c_customer_id", "c_first_name", "c_last_name"])
+
+
+def test_spark_q11(sess, data, strategy):
+    from test_tpcds import _check_yoy_customer
+
+    plan = _yoy_customer_plan(
+        strategy,
+        store_measure=lambda p: F.binop(
+            "Subtract", a("ss_ext_list_price"), a("ss_ext_discount_amt")),
+        store_cols=["ss_ext_list_price", "ss_ext_discount_amt"],
+        web_measure=lambda p: F.binop(
+            "Subtract", a("ws_ext_list_price"), a("ws_ext_discount_amt")),
+        web_cols=["ws_ext_list_price", "ws_ext_discount_amt"],
+        y1=2000, y2=2001,
+        out_cols=["c_customer_id", "c_preferred_cust_flag", "c_first_name",
+                  "c_last_name"],
+        sum_dtype="decimal(18,2)")
+    got = _execute_both(sess, plan)
+    _check_yoy_customer(got, O.oracle_q11(data),
+                        ["c_customer_id", "c_preferred_cust_flag",
+                         "c_first_name", "c_last_name"])
