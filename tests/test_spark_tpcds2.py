@@ -3966,3 +3966,222 @@ def test_spark_q11(sess, data, strategy):
     _check_yoy_customer(got, O.oracle_q11(data),
                         ["c_customer_id", "c_preferred_cust_flag",
                          "c_first_name", "c_last_name"])
+
+
+# ---------------- q18 catalog demographic averages geography rollup
+
+def test_spark_q18(sess, data, strategy):
+    cd = F.project(
+        [a("cd_demo_sk"), a("cd_dep_count")],
+        F.filter_(and_(F.binop("EqualTo", a("cd_gender"), s("F")),
+                       F.binop("EqualTo", a("cd_education_status"),
+                               s("College"))),
+                  F.scan("customer_demographics",
+                         [a("cd_demo_sk"), a("cd_gender"),
+                          a("cd_education_status"), a("cd_dep_count")])),
+    )
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2001)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    cu = F.project(
+        [a("c_customer_sk"), a("c_current_addr_sk"), a("c_birth_year")],
+        F.filter_(and_(F.binop("GreaterThanOrEqual", a("c_birth_year"),
+                               i32(1966)),
+                       F.binop("LessThanOrEqual", a("c_birth_year"),
+                               i32(1980))),
+                  F.scan("customer", [a("c_customer_sk"),
+                                      a("c_current_addr_sk"),
+                                      a("c_birth_year")])),
+    )
+    ca = F.scan("customer_address", [a("ca_address_sk"), a("ca_county"),
+                                     a("ca_state")])
+    it = F.scan("item", [a("i_item_sk"), a("i_item_id")])
+    cs = F.scan("catalog_sales",
+                [a("cs_sold_date_sk"), a("cs_item_sk"),
+                 a("cs_bill_customer_sk"), a("cs_bill_cdemo_sk"),
+                 a("cs_quantity"), a("cs_list_price"), a("cs_coupon_amt"),
+                 a("cs_sales_price"), a("cs_net_profit")])
+    j = join(strategy, dt, cs, [a("d_date_sk")], [a("cs_sold_date_sk")])
+    j = join(strategy, cd, j, [a("cd_demo_sk")], [a("cs_bill_cdemo_sk")])
+    j = join(strategy, cu, j, [a("c_customer_sk")], [a("cs_bill_customer_sk")])
+    j = join(strategy, ca, j, [a("ca_address_sk")], [a("c_current_addr_sk")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("cs_item_sk")])
+    measures = [("cs_quantity", "agg1"), ("cs_list_price", "agg2"),
+                ("cs_coupon_amt", "agg3"), ("cs_sales_price", "agg4"),
+                ("cs_net_profit", "agg5"), ("c_birth_year", "agg6"),
+                ("cd_dep_count", "agg7")]
+    base = F.project(
+        [F.alias(F.cast(a(src), "double"), nm, 1100 + k)
+         for k, (src, nm) in enumerate(measures)]
+        + [a("i_item_id"), a("ca_county"), a("ca_state")],
+        j,
+    )
+    meas_attrs = [ar(nm, 1100 + k, "double")
+                  for k, (_, nm) in enumerate(measures)]
+    dims = ["i_item_id", "ca_county", "ca_state"]
+    null_s = F.lit(None, "string")
+    exp_dims = [ar(d, 1110 + k, "string") for k, d in enumerate(dims)]
+    exp_gid = ar("g_id", 1113, "long")
+    rows = []
+    for level in range(3, -1, -1):
+        row = list(meas_attrs)
+        for k, d in enumerate(dims):
+            row.append(a(d) if k < level else null_s)
+        row.append(F.lit(3 - level, "long"))
+        rows.append(row)
+    expand = F.expand(rows, meas_attrs + exp_dims + [exp_gid], base)
+    agg = two_stage(
+        exp_dims + [exp_gid],
+        [(F.avg(m), 1120 + k) for k, m in enumerate(meas_attrs)],
+        expand,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(exp_dims[1]), F.sort_order(exp_dims[2]),
+         F.sort_order(exp_dims[0]), F.sort_order(exp_gid)],
+        [F.alias(exp_dims[0], "i_item_id", 1130),
+         F.alias(exp_dims[1], "ca_county", 1131),
+         F.alias(exp_dims[2], "ca_state", 1132),
+         F.alias(exp_gid, "g_id", 1133)]
+        + [F.alias(ar(nm, 1120 + k, "double"), nm, 1134 + k)
+           for k, (_, nm) in enumerate(measures)],
+        agg,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q18(data)
+    assert exp, "q18 oracle empty"
+    n = len(got["i_item_id"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = (got["i_item_id"][i], got["ca_county"][i], got["ca_state"][i],
+               got["g_id"][i])
+        assert key in exp, key
+        for k in range(7):
+            assert abs(got[f"agg{k+1}"][i] - exp[key][k]) < 1e-9, (key, k)
+
+
+# ---------------- q83 three-channel return shares
+
+def test_spark_q83(sess, data, strategy):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year")])),
+    )
+    it = F.scan("item", [a("i_item_sk"), a("i_item_id")])
+
+    def channel(rtab, r_date, r_item, r_qty, nm, base):
+        rt = F.scan(rtab, [a(r_date), a(r_item), a(r_qty)])
+        j = join(strategy, dt, rt, [a("d_date_sk")], [a(r_date)])
+        j = join(strategy, it, j, [a("i_item_sk")], [a(r_item)])
+        src = F.project(
+            [F.alias(a("i_item_id"), f"{nm}_item_id", base),
+             F.alias(F.cast(a(r_qty), "long"), "q", base + 1)], j)
+        return two_stage(
+            [ar(f"{nm}_item_id", base, "string")],
+            [(F.sum_(ar("q", base + 1, "long")), base + 2)],
+            src,
+        )
+
+    sr = channel("store_returns", "sr_returned_date_sk", "sr_item_sk",
+                 "sr_return_quantity", "sr", 1200)
+    cr = channel("catalog_returns", "cr_returned_date_sk", "cr_item_sk",
+                 "cr_return_quantity", "cr", 1210)
+    wr = channel("web_returns", "wr_returned_date_sk", "wr_item_sk",
+                 "wr_return_quantity", "wr", 1220)
+    sid = ar("sr_item_id", 1200, "string")
+    j = big_join(strategy, sr, cr, [sid], [ar("cr_item_id", 1210, "string")])
+    j = big_join(strategy, j, wr, [sid], [ar("wr_item_id", 1220, "string")])
+    qty = {nm: ar(f"{nm}_qty", base + 2, "long")
+           for nm, base in (("sr", 1200), ("cr", 1210), ("wr", 1220))}
+    total = F.cast(
+        F.binop("Add", F.binop("Add", qty["sr"], qty["cr"]), qty["wr"]),
+        "double")
+    outs = [F.alias(sid, "item_id", 1230),
+            F.alias(qty["sr"], "sr_qty", 1231),
+            F.alias(qty["cr"], "cr_qty", 1232),
+            F.alias(qty["wr"], "wr_qty", 1233)]
+    for k, nm in enumerate(("sr", "cr", "wr")):
+        outs.append(F.alias(
+            F.binop("Multiply",
+                    F.binop("Divide", F.cast(qty[nm], "double"), total),
+                    F.lit(100.0, "double")),
+            f"{nm}_dev", 1234 + k))
+    outs.append(F.alias(F.binop("Divide", total, F.lit(3.0, "double")),
+                        "average", 1237))
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(sid), F.sort_order(qty["sr"])],
+        outs,
+        j,
+    )
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q83(data)
+    assert exp, "q83 oracle empty"
+    n = len(got["item_id"])
+    assert n == min(len(exp), 100)
+    for i in range(n):
+        key = got["item_id"][i]
+        assert key in exp, key
+        a_, b_, c_, da, db, dc, avg = exp[key]
+        assert (got["sr_qty"][i], got["cr_qty"][i],
+                got["wr_qty"][i]) == (a_, b_, c_), key
+        assert abs(got["sr_dev"][i] - da) < 1e-9
+        assert abs(got["cr_dev"][i] - db) < 1e-9
+        assert abs(got["wr_dev"][i] - dc) < 1e-9
+        assert abs(got["average"][i] - avg) < 1e-9
+
+
+# ---------------- q84 income-band returning customers
+
+def test_spark_q84(ticket_sess, ticket_data, strategy):
+    ca = F.project(
+        [a("ca_address_sk")],
+        F.filter_(F.binop("EqualTo", a("ca_city"), s("Midway")),
+                  F.scan("customer_address", [a("ca_address_sk"),
+                                              a("ca_city")])),
+    )
+    cust = F.scan("customer", [
+        a("c_customer_id"), a("c_first_name"), a("c_last_name"),
+        a("c_current_addr_sk"), a("c_current_cdemo_sk"),
+        a("c_current_hdemo_sk")])
+    j = join(strategy, ca, cust, [a("ca_address_sk")],
+             [a("c_current_addr_sk")])
+    ib = F.project(
+        [a("ib_income_band_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("ib_lower_bound"),
+                         i32(38128)),
+                 F.binop("LessThanOrEqual", a("ib_upper_bound"),
+                         i32(38128 + 50000))),
+            F.scan("income_band", [a("ib_income_band_sk"),
+                                   a("ib_lower_bound"),
+                                   a("ib_upper_bound")])),
+    )
+    hd = F.scan("household_demographics", [a("hd_demo_sk"),
+                                           a("hd_income_band_sk")])
+    hd = join(strategy, ib, hd, [a("ib_income_band_sk")],
+              [a("hd_income_band_sk")])
+    hd = F.project([a("hd_demo_sk")], hd)
+    j = join(strategy, hd, j, [a("hd_demo_sk")], [a("c_current_hdemo_sk")])
+    cd = F.scan("customer_demographics", [a("cd_demo_sk")])
+    j = join(strategy, cd, j, [a("cd_demo_sk")], [a("c_current_cdemo_sk")])
+    sr = F.scan("store_returns", [a("sr_cdemo_sk")])
+    j = big_join(strategy, j, sr, [a("cd_demo_sk")], [a("sr_cdemo_sk")],
+                 build_side="left")
+    name = F.T(F.X + "Concat",
+               [a("c_last_name"), F.lit(", ", "string"), a("c_first_name")])
+    plan = F.take_ordered(
+        100, [F.sort_order(a("c_customer_id"))],
+        [F.alias(a("c_customer_id"), "customer_id", 1250),
+         F.alias(name, "customername", 1251)],
+        j,
+    )
+    got = _execute_both(ticket_sess, plan)
+    exp = O.oracle_q84(ticket_data)
+    assert exp, "q84 oracle empty"
+    rows = sorted(zip(got["customer_id"], got["customername"]))
+    assert rows == exp
+    assert got["customer_id"] == sorted(got["customer_id"])
