@@ -4185,3 +4185,310 @@ def test_spark_q84(ticket_sess, ticket_data, strategy):
     rows = sorted(zip(got["customer_id"], got["customername"]))
     assert rows == exp
     assert got["customer_id"] == sorted(got["customer_id"])
+
+
+# ------------- q57 catalog year-over-year window (q47's twin)
+
+def test_spark_q57(sess, data, strategy):
+    from test_tpcds import _check_yoy
+
+    year = 1999
+    dt = F.project(
+        [a("d_date_sk"), a("d_year"), a("d_moy")],
+        F.filter_(
+            or_(
+                F.binop("EqualTo", a("d_year"), i32(year)),
+                and_(F.binop("EqualTo", a("d_year"), i32(year - 1)),
+                     F.binop("EqualTo", a("d_moy"), i32(12))),
+                and_(F.binop("EqualTo", a("d_year"), i32(year + 1)),
+                     F.binop("EqualTo", a("d_moy"), i32(1))),
+            ),
+            F.scan("date_dim", [a("d_date_sk"), a("d_year"), a("d_moy")]),
+        ),
+    )
+    cc = F.scan("call_center", [a("cc_call_center_sk"), a("cc_name")])
+    it = F.scan("item", [a("i_item_sk"), a("i_brand"), a("i_category")])
+    sales = F.scan(
+        "catalog_sales",
+        [a("cs_sold_date_sk"), a("cs_item_sk"), a("cs_call_center_sk"),
+         a("cs_sales_price")],
+    )
+    j = join(strategy, dt, sales, [a("d_date_sk")], [a("cs_sold_date_sk")])
+    j = join(strategy, cc, j, [a("cc_call_center_sk")],
+             [a("cs_call_center_sk")])
+    j = join(strategy, it, j, [a("i_item_sk")], [a("cs_item_sk")])
+    part = [a("i_category"), a("i_brand"), a("cc_name")]
+    agg = two_stage(
+        part + [a("d_year"), a("d_moy")],
+        [(F.sum_(a("cs_sales_price")), 501)],
+        j,
+    )
+    sum_sales = ar("sum_sales", 501, "decimal(17,2)")
+    single = F.shuffle(F.single_partition(), agg)
+    pre = F.sort(
+        [F.sort_order(p) for p in part]
+        + [F.sort_order(a("d_year")), F.sort_order(a("d_moy"))],
+        single,
+    )
+    w_avg = F.window(
+        [F.window_expr(
+            F.window_agg(F.avg(sum_sales)),
+            F.window_spec(part + [a("d_year")], [],
+                          F.window_frame("up", "uf", row=True)),
+            "avg_monthly_sales", 502)],
+        part + [a("d_year")],
+        [],
+        pre,
+    )
+    orders = [F.sort_order(a("d_year")), F.sort_order(a("d_moy"))]
+    w = F.window(
+        [F.window_expr(F.lag_fn(sum_sales), F.window_spec(part, orders),
+                       "psum", 503),
+         F.window_expr(F.lead_fn(sum_sales), F.window_spec(part, orders),
+                       "nsum", 504)],
+        part,
+        orders,
+        w_avg,
+    )
+    avg_m = ar("avg_monthly_sales", 502, "decimal(11,6)")
+    sum_f = F.cast(sum_sales, "double")
+    avg_f = F.cast(avg_m, "double")
+    filt = F.filter_(
+        and_(
+            F.binop("EqualTo", a("d_year"), i32(year)),
+            F.binop("GreaterThan", avg_m, i32(0)),
+            F.binop(
+                "GreaterThan",
+                F.binop("Divide",
+                        F.un("Abs", F.binop("Subtract", sum_f, avg_f)),
+                        avg_f),
+                F.lit(0.1, "double"),
+            ),
+        ),
+        w,
+    )
+    proj = F.project(
+        [a("i_category"), a("i_brand"), a("cc_name"),
+         a("d_year"), a("d_moy"), sum_sales, avg_m,
+         ar("psum", 503, "decimal(17,2)"), ar("nsum", 504, "decimal(17,2)"),
+         F.alias(F.binop("Subtract", sum_f, avg_f), "delta", 510)],
+        filt,
+    )
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(ar("delta", 510, "double")), F.sort_order(a("d_moy"))],
+        [F.alias(a("i_category"), "i_category", 520),
+         F.alias(a("i_brand"), "i_brand", 521),
+         F.alias(a("cc_name"), "cc_name", 522),
+         F.alias(a("d_year"), "d_year", 524),
+         F.alias(a("d_moy"), "d_moy", 525),
+         F.alias(sum_sales, "sum_sales", 526),
+         F.alias(avg_m, "avg_monthly_sales", 527),
+         F.alias(ar("psum", 503, "decimal(17,2)"), "psum", 528),
+         F.alias(ar("nsum", 504, "decimal(17,2)"), "nsum", 529)],
+        proj,
+    )
+    got = _execute_both(sess, plan)
+    _check_yoy(got, O.oracle_q57(data), ("cc_name",))
+
+
+# ------------- q39a/b inventory cov month-over-month self-join
+
+def _q39_monthly_cov_plan(st, moy, base):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_year"), i32(2001)),
+                       F.binop("EqualTo", a("d_moy"), i32(moy))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"),
+                                      a("d_moy")])),
+    )
+    inv = F.scan("inventory", [a("inv_date_sk"), a("inv_item_sk"),
+                               a("inv_warehouse_sk"),
+                               a("inv_quantity_on_hand")])
+    j = join(st, dt, inv, [a("d_date_sk")], [a("inv_date_sk")])
+    wh = F.scan("warehouse", [a("w_warehouse_sk"), a("w_warehouse_name")])
+    j = join(st, wh, j, [a("w_warehouse_sk")], [a("inv_warehouse_sk")])
+    agg = two_stage(
+        [a("w_warehouse_name"), a("inv_item_sk")],
+        [(F.avg(a("inv_quantity_on_hand")), base),
+         (F.T(F.A + "StddevSamp", [a("inv_quantity_on_hand")]), base + 1)],
+        j,
+    )
+    mean = ar("mean", base, "double")
+    stdev = ar("stdev", base + 1, "double")
+    cov = F.T(F.X + "CaseWhen",
+              [F.binop("GreaterThan", mean, F.lit(0.0, "double")),
+               F.binop("Divide", stdev, mean)])
+    return F.project(
+        [a("w_warehouse_name"), a("inv_item_sk"), mean,
+         F.alias(cov, "cov", base + 2)], agg)
+
+
+def _q39_plan(st, thr1, thr2):
+    m1 = F.filter_(
+        F.binop("GreaterThan", ar("cov", 1302, "double"),
+                F.lit(thr1, "double")),
+        _q39_monthly_cov_plan(st, 1, 1300))
+    m2 = F.filter_(
+        F.binop("GreaterThan", ar("cov", 1312, "double"),
+                F.lit(thr2, "double")),
+        _q39_monthly_cov_plan(st, 2, 1310))
+    m2 = F.project(
+        [F.alias(a("w_warehouse_name"), "w2", 1320),
+         F.alias(a("inv_item_sk"), "i2", 1321),
+         F.alias(ar("mean", 1310, "double"), "mean2", 1322),
+         F.alias(ar("cov", 1312, "double"), "cov2", 1323)],
+        m2,
+    )
+    j = big_join(st, m1, m2, [a("w_warehouse_name"), a("inv_item_sk")],
+                 [ar("w2", 1320, "string"), ar("i2", 1321, "long")])
+    return F.take_ordered(
+        100,
+        [F.sort_order(a("w_warehouse_name")), F.sort_order(a("inv_item_sk"))],
+        [F.alias(a("w_warehouse_name"), "w_warehouse_name", 1330),
+         F.alias(a("inv_item_sk"), "inv_item_sk", 1331),
+         F.alias(ar("mean", 1300, "double"), "mean", 1332),
+         F.alias(ar("cov", 1302, "double"), "cov", 1333),
+         F.alias(ar("mean2", 1322, "double"), "mean2", 1334),
+         F.alias(ar("cov2", 1323, "double"), "cov2", 1335)],
+        j,
+    )
+
+
+def test_spark_q39a(sess, data, strategy):
+    from test_tpcds import _check_q39
+
+    got = _execute_both(sess, _q39_plan(strategy, 0.7, 0.7))
+    _check_q39(got, O.oracle_q39a(data))
+
+
+def test_spark_q39b(sess, data, strategy):
+    from test_tpcds import _check_q39
+
+    got = _execute_both(sess, _q39_plan(strategy, 0.85, 0.7))
+    _check_q39(got, O.oracle_q39b(data))
+
+
+# ------------- q49 worst return ratios double-ranked per channel
+
+def _q49_channel_plan(st, channel, fact, ret, s_item, s_ord, s_qty, s_paid,
+                      s_profit, r_item, r_ord, r_qty, r_amt, date_c, base):
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(and_(F.binop("EqualTo", a("d_year"), i32(2001)),
+                       F.binop("EqualTo", a("d_moy"), i32(12))),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"),
+                                      a("d_moy")])),
+    )
+    sl = F.project(
+        [a(date_c), a(s_item), a(s_ord), a(s_qty), a(s_paid)],
+        F.filter_(
+            and_(F.binop("GreaterThan", F.cast(a(s_profit), "double"),
+                         F.lit(1.0, "double")),
+                 F.binop("GreaterThan", F.cast(a(s_paid), "double"),
+                         F.lit(0.0, "double")),
+                 F.binop("GreaterThan", a(s_qty), i32(0))),
+            F.scan(fact, [a(date_c), a(s_item), a(s_ord), a(s_qty),
+                          a(s_paid), a(s_profit)]),
+        ),
+    )
+    j = join(st, dt, sl, [a("d_date_sk")], [a(date_c)])
+    rt = F.project(
+        [a(r_item), a(r_ord), a(r_qty), a(r_amt)],
+        F.filter_(F.binop("GreaterThan", F.cast(a(r_amt), "double"),
+                          F.lit(250.0, "double")),
+                  F.scan(ret, [a(r_item), a(r_ord), a(r_qty), a(r_amt)])),
+    )
+    j = big_join(st, j, rt, [a(s_ord), a(s_item)], [a(r_ord), a(r_item)])
+    src = F.project(
+        [F.alias(a(s_item), "item", base), a(r_qty), a(s_qty), a(r_amt),
+         a(s_paid)], j)
+    agg = two_stage(
+        [ar("item", base, "long")],
+        [(F.sum_(a(r_qty)), base + 1), (F.sum_(a(s_qty)), base + 2),
+         (F.sum_(a(r_amt)), base + 3), (F.sum_(a(s_paid)), base + 4)],
+        src,
+    )
+    f64 = "double"
+    rr = F.binop("Divide",
+                 F.cast(ar("ret_q", base + 1, "long"), f64),
+                 F.cast(ar("qty", base + 2, "long"), f64))
+    cur = F.binop("Divide",
+                  F.cast(ar("ret_amt", base + 3, "decimal(17,2)"), f64),
+                  F.cast(ar("paid", base + 4, "decimal(17,2)"), f64))
+    ratios = F.project(
+        [ar("item", base, "long"), F.alias(rr, "return_ratio", base + 5),
+         F.alias(cur, "currency_ratio", base + 6)],
+        agg,
+    )
+    rr_a = ar("return_ratio", base + 5, f64)
+    cur_a = ar("currency_ratio", base + 6, f64)
+    single = F.shuffle(F.single_partition(), ratios)
+    s1 = F.sort([F.sort_order(rr_a)], single)
+    w1 = F.window(
+        [F.window_expr(F.rank_fn([rr_a]), F.window_spec([], [F.sort_order(rr_a)]),
+                       "return_rank", base + 7)],
+        [], [F.sort_order(rr_a)], s1)
+    s2 = F.sort([F.sort_order(cur_a)], w1)
+    w2 = F.window(
+        [F.window_expr(F.rank_fn([cur_a]),
+                       F.window_spec([], [F.sort_order(cur_a)]),
+                       "currency_rank", base + 8)],
+        [], [F.sort_order(cur_a)], s2)
+    rrank = ar("return_rank", base + 7, "integer")
+    crank = ar("currency_rank", base + 8, "integer")
+    f = F.filter_(
+        or_(F.binop("LessThanOrEqual", rrank, i32(10)),
+            F.binop("LessThanOrEqual", crank, i32(10))),
+        w2,
+    )
+    # union arms share output exprIds (1400-1404)
+    return F.project(
+        [F.alias(F.lit(channel, "string"), "channel", 1400),
+         F.alias(ar("item", base, "long"), "item", 1401),
+         F.alias(rr_a, "return_ratio", 1402),
+         F.alias(rrank, "return_rank", 1403),
+         F.alias(crank, "currency_rank", 1404)],
+        f,
+    )
+
+
+def test_spark_q49(ticket_sess, ticket_data, strategy):
+    web = _q49_channel_plan(
+        strategy, "web", "web_sales", "web_returns", "ws_item_sk",
+        "ws_order_number", "ws_quantity", "ws_net_paid", "ws_net_profit",
+        "wr_item_sk", "wr_order_number", "wr_return_quantity",
+        "wr_return_amt", "ws_sold_date_sk", 1410)
+    cat = _q49_channel_plan(
+        strategy, "catalog", "catalog_sales", "catalog_returns", "cs_item_sk",
+        "cs_order_number", "cs_quantity", "cs_net_paid", "cs_net_profit",
+        "cr_item_sk", "cr_order_number", "cr_return_quantity",
+        "cr_return_amount", "cs_sold_date_sk", 1430)
+    store = _q49_channel_plan(
+        strategy, "store", "store_sales", "store_returns", "ss_item_sk",
+        "ss_ticket_number", "ss_quantity", "ss_net_paid", "ss_net_profit",
+        "sr_item_sk", "sr_ticket_number", "sr_return_quantity",
+        "sr_return_amt", "ss_sold_date_sk", 1450)
+    u = F.union([web, cat, store])
+    ch = ar("channel", 1400, "string")
+    rrank = ar("return_rank", 1403, "integer")
+    crank = ar("currency_rank", 1404, "integer")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(ch), F.sort_order(rrank), F.sort_order(crank)],
+        [F.alias(ch, "channel", 1470),
+         F.alias(ar("item", 1401, "long"), "item", 1471),
+         F.alias(ar("return_ratio", 1402, "double"), "return_ratio", 1472),
+         F.alias(rrank, "return_rank", 1473),
+         F.alias(crank, "currency_rank", 1474)],
+        u,
+    )
+    got = _execute_both(ticket_sess, plan)
+    exp = O.oracle_q49(ticket_data)
+    assert exp, "q49 oracle empty"
+    rows = set(zip(got["channel"], got["item"], got["return_ratio"],
+                   got["return_rank"], got["currency_rank"]))
+    assert rows == exp
+    keys = list(zip(got["channel"], got["return_rank"],
+                    got["currency_rank"]))
+    assert keys == sorted(keys)
