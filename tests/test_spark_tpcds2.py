@@ -4492,3 +4492,146 @@ def test_spark_q49(ticket_sess, ticket_data, strategy):
     keys = list(zip(got["channel"], got["return_rank"],
                     got["currency_rank"]))
     assert keys == sorted(keys)
+
+
+# ------------------ q5 channel sales/returns/profit ROLLUP
+
+def _channel_report_tail_plan(st, union_plan):
+    """Shared q5-family tail: ROLLUP(channel, id) via Expand + two-stage
+    agg, ORDER BY channel, id NULLS FIRST LIMIT 100.  Union arms must
+    alias (channel 1500, id 1501, sales 1502, returns 1503,
+    profit 1504)."""
+    ch = ar("channel", 1500, "string")
+    idc = ar("id", 1501, "string")
+    sales = ar("sales", 1502, "decimal(8,2)")
+    rets = ar("returns", 1503, "decimal(8,2)")
+    prof = ar("profit", 1504, "decimal(9,2)")
+    null_s = F.lit(None, "string")
+    exp_ch = ar("channel", 1510, "string")
+    exp_id = ar("id", 1511, "string")
+    exp_gid = ar("g_id", 1512, "integer")
+    vals = [sales, rets, prof]
+    expand = F.expand(
+        [
+            vals + [ch, idc, F.lit(0, "integer")],
+            vals + [ch, null_s, F.lit(1, "integer")],
+            vals + [null_s, null_s, F.lit(3, "integer")],
+        ],
+        vals + [exp_ch, exp_id, exp_gid],
+        union_plan,
+    )
+    agg = two_stage(
+        [exp_ch, exp_id, exp_gid],
+        [(F.sum_(sales), 1520), (F.sum_(rets), 1521), (F.sum_(prof), 1522)],
+        expand,
+    )
+    return F.take_ordered(
+        100,
+        [F.sort_order(exp_ch), F.sort_order(exp_id)],
+        [F.alias(exp_ch, "channel", 1530), F.alias(exp_id, "id", 1531),
+         F.alias(ar("sales", 1520, "decimal(18,2)"), "sales", 1532),
+         F.alias(ar("returns", 1521, "decimal(18,2)"), "returns", 1533),
+         F.alias(ar("profit", 1522, "decimal(19,2)"), "profit", 1534)],
+        agg,
+    )
+
+
+def test_spark_q5(sess, data, strategy):
+    from test_tpcds import _check_channel_report
+
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"),
+                         F.lit("2000-08-23", "date")),
+                 F.binop("LessThanOrEqual", a("d_date"),
+                         F.lit("2000-09-05", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    dz = F.lit("0", "decimal(7,2)")
+
+    def d8(e):
+        return F.binop("Add", e, dz)
+
+    def neg(e):
+        return F.binop("Subtract", dz, e)
+
+    def arm(id_expr, sales_e, ret_e, prof_e, src):
+        return F.project(
+            [F.alias(id_expr, "id", 1501), F.alias(sales_e, "sales", 1502),
+             F.alias(ret_e, "returns", 1503), F.alias(prof_e, "profit", 1504)],
+            src,
+        )
+
+    def tag(plan, channel):
+        return F.project(
+            [F.alias(F.lit(channel, "string"), "channel", 1500),
+             ar("id", 1501, "string"), ar("sales", 1502, "decimal(8,2)"),
+             ar("returns", 1503, "decimal(8,2)"),
+             ar("profit", 1504, "decimal(9,2)")],
+            plan,
+        )
+
+    # store channel
+    st_ = F.scan("store", [a("s_store_sk"), a("s_store_name")])
+    sl = F.scan("store_sales", [a("ss_sold_date_sk"), a("ss_store_sk"),
+                                a("ss_ext_sales_price"), a("ss_net_profit")])
+    j = join(strategy, dt, sl, [a("d_date_sk")], [a("ss_sold_date_sk")])
+    j = join(strategy, st_, j, [a("s_store_sk")], [a("ss_store_sk")])
+    s_sales = arm(a("s_store_name"), d8(a("ss_ext_sales_price")), d8(dz),
+                  d8(a("ss_net_profit")), j)
+    sr = F.scan("store_returns", [a("sr_returned_date_sk"), a("sr_store_sk"),
+                                  a("sr_return_amt"), a("sr_net_loss")])
+    jr = join(strategy, dt, sr, [a("d_date_sk")], [a("sr_returned_date_sk")])
+    jr = join(strategy, st_, jr, [a("s_store_sk")], [a("sr_store_sk")])
+    s_ret = arm(a("s_store_name"), d8(dz), d8(a("sr_return_amt")),
+                neg(a("sr_net_loss")), jr)
+    store_rows = tag(F.union([s_sales, s_ret]), "store channel")
+
+    # catalog channel
+    cp = F.scan("catalog_page", [a("cp_catalog_page_sk"),
+                                 a("cp_catalog_page_id")])
+    cl = F.scan("catalog_sales", [a("cs_sold_date_sk"), a("cs_catalog_page_sk"),
+                                  a("cs_ext_sales_price"), a("cs_net_profit")])
+    j = join(strategy, dt, cl, [a("d_date_sk")], [a("cs_sold_date_sk")])
+    j = join(strategy, cp, j, [a("cp_catalog_page_sk")],
+             [a("cs_catalog_page_sk")])
+    c_sales = arm(a("cp_catalog_page_id"), d8(a("cs_ext_sales_price")),
+                  d8(dz), d8(a("cs_net_profit")), j)
+    cr = F.scan("catalog_returns",
+                [a("cr_returned_date_sk"), a("cr_catalog_page_sk"),
+                 a("cr_return_amount"), a("cr_net_loss")])
+    jr = join(strategy, dt, cr, [a("d_date_sk")], [a("cr_returned_date_sk")])
+    jr = join(strategy, cp, jr, [a("cp_catalog_page_sk")],
+              [a("cr_catalog_page_sk")])
+    c_ret = arm(a("cp_catalog_page_id"), d8(dz), d8(a("cr_return_amount")),
+                neg(a("cr_net_loss")), jr)
+    cat_rows = tag(F.union([c_sales, c_ret]), "catalog channel")
+
+    # web channel (returns recover the site via (item, order))
+    wsit = F.scan("web_site", [a("web_site_sk"), a("web_name")])
+    wl = F.scan("web_sales", [a("ws_sold_date_sk"), a("ws_web_site_sk"),
+                              a("ws_ext_sales_price"), a("ws_net_profit")])
+    j = join(strategy, dt, wl, [a("d_date_sk")], [a("ws_sold_date_sk")])
+    j = join(strategy, wsit, j, [a("web_site_sk")], [a("ws_web_site_sk")])
+    w_sales = arm(a("web_name"), d8(a("ws_ext_sales_price")), d8(dz),
+                  d8(a("ws_net_profit")), j)
+    wr = F.scan("web_returns",
+                [a("wr_returned_date_sk"), a("wr_item_sk"),
+                 a("wr_order_number"), a("wr_return_amt"), a("wr_net_loss")])
+    jr = join(strategy, dt, wr, [a("d_date_sk")], [a("wr_returned_date_sk")])
+    ws_keys = F.scan("web_sales", [a("ws_item_sk"), a("ws_order_number"),
+                                   a("ws_web_site_sk")])
+    jr = big_join(strategy, jr, ws_keys,
+                  [a("wr_item_sk"), a("wr_order_number")],
+                  [a("ws_item_sk"), a("ws_order_number")])
+    jr = join(strategy, wsit, jr, [a("web_site_sk")], [a("ws_web_site_sk")])
+    w_ret = arm(a("web_name"), d8(dz), d8(a("wr_return_amt")),
+                neg(a("wr_net_loss")), jr)
+    web_rows = tag(F.union([w_sales, w_ret]), "web channel")
+
+    plan = _channel_report_tail_plan(
+        strategy, F.union([store_rows, cat_rows, web_rows]))
+    got = _execute_both(sess, plan)
+    _check_channel_report(got, O.oracle_q5(data))
