@@ -4635,3 +4635,95 @@ def test_spark_q5(sess, data, strategy):
         strategy, F.union([store_rows, cat_rows, web_rows]))
     got = _execute_both(sess, plan)
     _check_channel_report(got, O.oracle_q5(data))
+
+
+# --------------- q31 county store-vs-web quarterly growth
+
+def test_spark_q31(ticket_sess, ticket_data, strategy):
+    def channel(fact, date_c, addr_c, price_c, qoy, base):
+        dt = F.project(
+            [a("d_date_sk")],
+            F.filter_(and_(F.binop("EqualTo", a("d_year"), i32(2000)),
+                           F.binop("EqualTo", a("d_qoy"), i32(qoy))),
+                      F.scan("date_dim", [a("d_date_sk"), a("d_year"),
+                                          a("d_qoy")])),
+        )
+        sl = F.scan(fact, [a(date_c), a(addr_c), a(price_c)])
+        j = join(strategy, dt, sl, [a("d_date_sk")], [a(date_c)])
+        ca = F.scan("customer_address", [a("ca_address_sk"), a("ca_county")])
+        j = join(strategy, ca, j, [a("ca_address_sk")], [a(addr_c)])
+        src = F.project(
+            [F.alias(a("ca_county"), "county", base), a(price_c)], j)
+        return two_stage(
+            [ar("county", base, "string")],
+            [(F.sum_(a(price_c)), base + 1)], src)
+
+    b = {}
+    for k, (pre, fact, date_c, addr_c, price_c) in enumerate((
+        ("ss1", "store_sales", "ss_sold_date_sk", "ss_addr_sk",
+         "ss_ext_sales_price"),
+        ("ss2", "store_sales", "ss_sold_date_sk", "ss_addr_sk",
+         "ss_ext_sales_price"),
+        ("ss3", "store_sales", "ss_sold_date_sk", "ss_addr_sk",
+         "ss_ext_sales_price"),
+        ("ws1", "web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+         "ws_ext_sales_price"),
+        ("ws2", "web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+         "ws_ext_sales_price"),
+        ("ws3", "web_sales", "ws_sold_date_sk", "ws_bill_addr_sk",
+         "ws_ext_sales_price"),
+    )):
+        b[pre] = (channel(fact, date_c, addr_c, price_c, int(pre[-1]),
+                          1600 + 10 * k), 1600 + 10 * k)
+
+    j, _ = b["ss1"]
+    county = ar("county", 1600, "string")
+    for pre in ("ss2", "ss3", "ws1", "ws2", "ws3"):
+        arm_plan, base = b[pre]
+        j = big_join(strategy, j, arm_plan, [county],
+                     [ar("county", base, "string")])
+    sales = {pre: ar("sales", base + 1, "decimal(17,2)")
+             for pre, (_, base) in b.items()}
+    fl = lambda e: F.cast(e, "double")
+
+    def ratio(num, den):
+        return F.binop("Divide", fl(num), fl(den))
+
+    def guarded(num, den):
+        return F.T(F.X + "CaseWhen",
+                   [F.binop("GreaterThan", fl(den), F.lit(0.0, "double")),
+                    ratio(num, den)])
+
+    web12 = guarded(sales["ws2"], sales["ws1"])
+    store12 = guarded(sales["ss2"], sales["ss1"])
+    web23 = guarded(sales["ws3"], sales["ws2"])
+    store23 = guarded(sales["ss3"], sales["ss2"])
+    f = F.filter_(
+        or_(F.binop("GreaterThan", web12, store12),
+            F.binop("GreaterThan", web23, store23)),
+        j,
+    )
+    plan = F.take_ordered(
+        100, [F.sort_order(county)],
+        [F.alias(county, "ca_county", 1700),
+         F.alias(F.lit(2000, "integer"), "d_year", 1701),
+         F.alias(ratio(sales["ws2"], sales["ws1"]), "web_q1_q2_increase", 1702),
+         F.alias(ratio(sales["ss2"], sales["ss1"]), "store_q1_q2_increase", 1703),
+         F.alias(ratio(sales["ws3"], sales["ws2"]), "web_q2_q3_increase", 1704),
+         F.alias(ratio(sales["ss3"], sales["ss2"]), "store_q2_q3_increase", 1705)],
+        f,
+    )
+    got = _execute_both(ticket_sess, plan)
+    exp = O.oracle_q31(ticket_data)
+    assert exp, "q31 oracle empty"
+    rows = {
+        c: (w12, s12, w23, s23)
+        for c, w12, s12, w23, s23 in zip(
+            got["ca_county"], got["web_q1_q2_increase"],
+            got["store_q1_q2_increase"], got["web_q2_q3_increase"],
+            got["store_q2_q3_increase"])
+    }
+    assert set(rows) == set(exp)
+    for c, vals in rows.items():
+        assert vals == pytest.approx(exp[c], rel=1e-12), c
+    assert got["d_year"] == [2000] * len(rows)
