@@ -1,0 +1,71 @@
+"""Bisect the q1 TPU pathology at the primitive level: time each
+suspect op at the 4M bucket on the real chip.  Append to
+.bench_q1diag.log.  Run detached AFTER .bench_q1diag.py exits."""
+import json
+import time
+
+import numpy as np
+
+LOG = "/root/repo/.bench_q1diag.log"
+
+
+def note(**kw):
+    with open(LOG, "a") as f:
+        f.write(json.dumps({"t": time.strftime("%H:%M:%SZ", time.gmtime()), **kw}) + "\n")
+
+
+note(event="bisect_start")
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+
+N = 1 << 22
+rng = np.random.RandomState(0)
+key_u32 = jnp.asarray(rng.randint(0, 1 << 31, N).astype(np.uint32))
+vals64 = jnp.asarray(rng.randint(0, 1 << 40, N).astype(np.int64))
+flags = jnp.asarray(rng.rand(N) < 0.001)
+idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+np.asarray(key_u32[:1])
+note(event="bisect_staged")
+
+
+def timed(name, fn, *args):
+    try:
+        r = fn(*args)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        r = fn(*args)
+        jax.block_until_ready(r)
+        note(event=name, s=round(time.perf_counter() - t0, 4))
+    except Exception as e:  # noqa: BLE001
+        note(event=name, error=str(e)[:200])
+
+
+row_idx = jnp.arange(N, dtype=jnp.int32)
+
+timed("sort_u32_pair", jax.jit(
+    lambda k: jax.lax.sort((k, row_idx), num_keys=1)), key_u32)
+timed("cumsum_i64", jax.jit(jnp.cumsum), vals64)
+timed("cumsum_i32", jax.jit(lambda v: jnp.cumsum(v.astype(jnp.int32))), vals64)
+
+
+def segscan(vals, flags):
+    def comb(a, b):
+        v1, f1 = a
+        v2, f2 = b
+        return jnp.where(f2, v2, v1 + v2), f1 | f2
+
+    v, _ = jax.lax.associative_scan(comb, (vals, flags))
+    return v
+
+
+timed("assoc_scan_pair", jax.jit(segscan), vals64, flags)
+timed("gather_1col", jax.jit(lambda v, i: jnp.take(v, i)), vals64, idx)
+timed("gather_7col", jax.jit(
+    lambda v, i: tuple(jnp.take(v + k, i) for k in range(7))), vals64, idx)
+timed("sort_variadic8", jax.jit(
+    lambda k: jax.lax.sort((k,) + tuple(vals64 + j for j in range(7)),
+                           num_keys=1)), key_u32)
+timed("where_reduce", jax.jit(lambda v: jnp.sum(jnp.where(v > 0, v, 0))), vals64)
+note(event="bisect_done")

@@ -1,0 +1,34 @@
+"""Scan-free bisect: gathers, cummax, variadic sort at the 4M bucket."""
+import json, time
+import numpy as np
+LOG = "/root/repo/.bench_q1diag.log"
+def note(**kw):
+    with open(LOG, "a") as f:
+        f.write(json.dumps({"t": time.strftime("%H:%M:%SZ", time.gmtime()), **kw}) + "\n")
+note(event="bisect2_start")
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+N = 1 << 22
+rng = np.random.RandomState(0)
+key_u32 = jnp.asarray(rng.randint(0, 1 << 31, N).astype(np.uint32))
+vals64 = jnp.asarray(rng.randint(0, 1 << 40, N).astype(np.int64))
+idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+np.asarray(key_u32[:1])
+note(event="bisect2_staged")
+def timed(name, fn, *args):
+    try:
+        t0 = time.perf_counter()
+        r = fn(*args); jax.block_until_ready(r)
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = fn(*args); jax.block_until_ready(r)
+        note(event=name, s=round(time.perf_counter() - t0, 4), first=round(first, 2))
+    except Exception as e:
+        note(event=name, error=str(e)[:200])
+timed("gather_1col", jax.jit(lambda v, i: jnp.take(v, i)), vals64, idx)
+timed("gather_7col", jax.jit(lambda v, i: tuple(jnp.take(v + k, i) for k in range(7))), vals64, idx)
+timed("cummax_i32", jax.jit(lambda v: jax.lax.cummax(v.astype(jnp.int32))), vals64)
+timed("sort_variadic8", jax.jit(lambda k: jax.lax.sort((k,) + tuple(vals64 + j for j in range(7)), num_keys=1)), key_u32)
+timed("sort_2key", jax.jit(lambda k, v: jax.lax.sort((k, v.astype(jnp.uint64)), num_keys=2)), key_u32, vals64)
+note(event="bisect2_done")

@@ -241,16 +241,28 @@ class SortedSegs:
 
 def _segscan(op, vals, flags):
     """Segmented inclusive scan: at row i, reduce of ``vals`` from i's
-    segment start through i.  Standard segmented-scan monoid over
-    (value, boundary-flag) pairs — an associative_scan, so it lowers to
-    a log-depth tree of vector ops (TPU-fast), not a scatter."""
+    segment start through i.  Hillis-Steele log-depth doubling over the
+    (value, boundary-flag) monoid, built from CONTIGUOUS pad+slice
+    shifts and elementwise ops only.
 
-    def comb(a, b):
-        v1, f1 = a
-        v2, f2 = b
-        return jnp.where(f2, v2, op(v1, v2)), f1 | f2
-
-    v, _ = jax.lax.associative_scan(comb, (vals, flags))
+    Deliberately NOT ``lax.associative_scan``: its recursive even/odd
+    decomposition emits strided slices + interleaves whose Mosaic/TPU
+    compile is pathological — measured on the real chip, ONE
+    associative_scan at the 4M bucket pushed the q1 agg kernel's
+    remote compile past 35 minutes and its execution to ~50 s/call
+    (.bench_q1diag.log, round 5); the doubling form compiles in
+    seconds and runs at HBM speed."""
+    n = vals.shape[0]
+    v, f = vals, flags
+    d = 1
+    while d < n:
+        # shift right by d: element i combines with i-d
+        pv = jnp.concatenate([v[:1].repeat(d, axis=0), v[:-d]])
+        pf = jnp.concatenate([jnp.ones(d, dtype=f.dtype), f[:-d]])
+        keep = f  # a boundary inside (i-d, i] blocks the carry
+        v = jnp.where(keep, v, op(pv, v))
+        f = f | pf
+        d <<= 1
     return v
 
 
@@ -267,9 +279,10 @@ def build_sorted_segs(boundary, s_live) -> SortedSegs:
     ends_mask = s_live & (nxt_boundary | nxt_dead)
     ends_pos = jnp.where(ends_mask, idx, jnp.int32(cap))
     ends = jnp.clip(jax.lax.sort((ends_pos,), num_keys=1)[0], 0, cap - 1)
-    start_at_row = _segscan(
-        jnp.maximum, jnp.where(boundary, idx, jnp.int32(-1)), boundary
-    )
+    # last boundary at-or-before each row == the row's segment start;
+    # boundary indices are monotone, so a PLAIN cummax is exact (no
+    # segmented scan needed — one native TPU op)
+    start_at_row = jax.lax.cummax(jnp.where(boundary, idx, jnp.int32(-1)))
     starts = jnp.clip(jnp.take(start_at_row, ends), 0, cap - 1)
     return SortedSegs(seg=seg, boundary=boundary, starts=starts, ends=ends)
 
