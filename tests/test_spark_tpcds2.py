@@ -4727,3 +4727,103 @@ def test_spark_q31(ticket_sess, ticket_data, strategy):
     for c, vals in rows.items():
         assert vals == pytest.approx(exp[c], rel=1e-12), c
     assert got["d_year"] == [2000] * len(rows)
+
+
+# ----------- q58 cross-channel items sold evenly (month window)
+
+def test_spark_q58(ticket_sess, ticket_data, strategy):
+    wk = distinct(
+        [ar("wk_sel", 1800, "integer")],
+        F.project([F.alias(a("d_month_seq"), "wk_sel", 1800)],
+                  F.filter_(F.binop("EqualTo", a("d_date"),
+                                    F.lit("2000-01-03", "date")),
+                            F.scan("date_dim", [a("d_date"),
+                                                a("d_month_seq")]))),
+    )
+    wk_seq = _scalar_subquery(wk, 1800)
+
+    def channel(fact, item_c, date_c, price_c, base):
+        dd = F.project(
+            [a("d_date_sk")],
+            F.filter_(F.binop("EqualTo", a("d_month_seq"), wk_seq),
+                      F.scan("date_dim", [a("d_date_sk"), a("d_month_seq")])),
+        )
+        sl = F.scan(fact, [a(date_c), a(item_c), a(price_c)])
+        j = join(strategy, dd, sl, [a("d_date_sk")], [a(date_c)])
+        it = F.scan("item", [a("i_item_sk"), a("i_item_id")])
+        j = join(strategy, it, j, [a("i_item_sk")], [a(item_c)])
+        src = F.project(
+            [F.alias(a("i_item_id"), "item_id", base), a(price_c)], j)
+        return two_stage(
+            [ar("item_id", base, "string")],
+            [(F.sum_(a(price_c)), base + 1)], src)
+
+    ss_items = channel("store_sales", "ss_item_sk", "ss_sold_date_sk",
+                       "ss_ext_sales_price", 1810)
+    cs_items = channel("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                       "cs_ext_sales_price", 1820)
+    ws_items = channel("web_sales", "ws_item_sk", "ws_sold_date_sk",
+                       "ws_ext_sales_price", 1830)
+    iid = ar("item_id", 1810, "string")
+    j = big_join(strategy, ss_items, cs_items, [iid],
+                 [ar("item_id", 1820, "string")])
+    j = big_join(strategy, j, ws_items, [iid],
+                 [ar("item_id", 1830, "string")])
+    rev = {p: ar("rev", b + 1, "decimal(17,2)")
+           for p, b in (("ss", 1810), ("cs", 1820), ("ws", 1830))}
+    fl = lambda e: F.cast(e, "double")
+
+    def near(x, y):
+        return and_(
+            F.binop("GreaterThanOrEqual", fl(x),
+                    F.binop("Multiply", F.lit(0.25, "double"), fl(y))),
+            F.binop("LessThanOrEqual", fl(x),
+                    F.binop("Multiply", F.lit(4.0, "double"), fl(y))))
+
+    f = F.filter_(
+        and_(near(rev["ss"], rev["cs"]), near(rev["ss"], rev["ws"]),
+             near(rev["cs"], rev["ss"]), near(rev["cs"], rev["ws"]),
+             near(rev["ws"], rev["ss"]), near(rev["ws"], rev["cs"])),
+        j,
+    )
+    total = F.binop("Add", F.binop("Add", fl(rev["ss"]), fl(rev["cs"])),
+                    fl(rev["ws"]))
+
+    def dev(x):
+        return F.binop(
+            "Multiply",
+            F.binop("Divide", F.binop("Divide", fl(x), total),
+                    F.lit(3.0, "double")),
+            F.lit(100.0, "double"))
+
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(iid), F.sort_order(rev["ss"])],
+        [F.alias(iid, "item_id", 1840),
+         F.alias(rev["ss"], "ss_item_rev", 1841),
+         F.alias(dev(rev["ss"]), "ss_dev", 1842),
+         F.alias(rev["cs"], "cs_item_rev", 1843),
+         F.alias(dev(rev["cs"]), "cs_dev", 1844),
+         F.alias(rev["ws"], "ws_item_rev", 1845),
+         F.alias(dev(rev["ws"]), "ws_dev", 1846),
+         F.alias(F.binop("Divide", total, F.lit(3.0, "double")),
+                 "average", 1847)],
+        f,
+    )
+    got = _execute_both(ticket_sess, plan)
+    exp = O.oracle_q58(ticket_data)
+    assert exp, "q58 oracle empty"
+    rows = {
+        i_: (sr, sd, cr, cd, wr, wd, avg)
+        for i_, sr, sd, cr, cd, wr, wd, avg in zip(
+            got["item_id"], got["ss_item_rev"], got["ss_dev"],
+            got["cs_item_rev"], got["cs_dev"], got["ws_item_rev"],
+            got["ws_dev"], got["average"])
+    }
+    assert set(rows) == set(exp)
+    for i_, (sr, sd, cr, cd, wr, wd, avg) in rows.items():
+        e = exp[i_]
+        assert (sr, cr, wr) == (e[0], e[2], e[4]), i_
+        assert (sd, cd, wd, avg) == pytest.approx(
+            (e[1], e[3], e[5], e[6]), rel=1e-12), i_
+    assert got["item_id"] == sorted(got["item_id"])
