@@ -4827,3 +4827,73 @@ def test_spark_q58(ticket_sess, ticket_data, strategy):
         assert (sd, cd, wd, avg) == pytest.approx(
             (e[1], e[3], e[5], e[6]), rel=1e-12), i_
     assert got["item_id"] == sorted(got["item_id"])
+
+
+# ------------- q71 brand sales by meal-time minute
+
+def test_spark_q71(ticket_sess, ticket_data, strategy):
+    it = F.project(
+        [a("i_item_sk"), a("i_brand_id"), a("i_brand")],
+        F.filter_(F.binop("EqualTo", a("i_manager_id"), i32(1)),
+                  F.scan("item", [a("i_item_sk"), a("i_brand_id"),
+                                  a("i_brand"), a("i_manager_id")])),
+    )
+    parts = []
+    for fact, price_c, date_c, item_c, time_c in (
+        ("web_sales", "ws_ext_sales_price", "ws_sold_date_sk",
+         "ws_item_sk", "ws_sold_time_sk"),
+        ("catalog_sales", "cs_ext_sales_price", "cs_sold_date_sk",
+         "cs_item_sk", "cs_sold_time_sk"),
+        ("store_sales", "ss_ext_sales_price", "ss_sold_date_sk",
+         "ss_item_sk", "ss_sold_time_sk"),
+    ):
+        dt = F.project(
+            [a("d_date_sk")],
+            F.filter_(and_(F.binop("EqualTo", a("d_moy"), i32(11)),
+                           F.binop("EqualTo", a("d_year"), i32(1999))),
+                      F.scan("date_dim", [a("d_date_sk"), a("d_moy"),
+                                          a("d_year")])),
+        )
+        sl = F.project(
+            [F.alias(a(price_c), "ext_price_v", 1900),
+             F.alias(a(date_c), "sold_date_sk", 1901),
+             F.alias(a(item_c), "sold_item_sk", 1902),
+             F.alias(a(time_c), "time_sk", 1903)],
+            F.scan(fact, [a(price_c), a(date_c), a(item_c), a(time_c)]))
+        parts.append(join(strategy, dt, sl, [a("d_date_sk")],
+                          [ar("sold_date_sk", 1901, "long")]))
+    u = F.union(parts)
+    j = join(strategy, it, u, [a("i_item_sk")],
+             [ar("sold_item_sk", 1902, "long")])
+    tm = F.project(
+        [a("t_time_sk"), a("t_hour"), a("t_minute")],
+        F.filter_(or_(F.binop("EqualTo", a("t_meal_time"), s("breakfast")),
+                      F.binop("EqualTo", a("t_meal_time"), s("dinner"))),
+                  F.scan("time_dim", [a("t_time_sk"), a("t_hour"),
+                                      a("t_minute"), a("t_meal_time")])),
+    )
+    j = join(strategy, tm, j, [a("t_time_sk")], [ar("time_sk", 1903, "long")])
+    agg = two_stage(
+        [a("i_brand_id"), a("i_brand"), a("t_hour"), a("t_minute")],
+        [(F.sum_(ar("ext_price_v", 1900, "decimal(7,2)")), 1910)],
+        j,
+    )
+    price = ar("ext_price", 1910, "decimal(17,2)")
+    plan = F.take_ordered(
+        100,
+        [F.sort_order(price, asc=False), F.sort_order(a("i_brand_id"))],
+        [F.alias(a("i_brand_id"), "brand_id", 1920),
+         F.alias(a("i_brand"), "brand", 1921),
+         F.alias(a("t_hour"), "t_hour", 1922),
+         F.alias(a("t_minute"), "t_minute", 1923),
+         F.alias(price, "ext_price", 1924)],
+        agg,
+    )
+    got = _execute_both(ticket_sess, plan)
+    exp = O.oracle_q71(ticket_data)
+    assert exp, "q71 oracle empty"
+    rows = dict(zip(zip(got["brand_id"], got["brand"], got["t_hour"],
+                        got["t_minute"]), got["ext_price"]))
+    assert rows == exp
+    keys = list(zip([-p for p in got["ext_price"]], got["brand_id"]))
+    assert keys == sorted(keys)
