@@ -1,0 +1,77 @@
+"""Bisect INSIDE the q1 partial agg kernel on the real chip."""
+import json, time
+import numpy as np
+LOG = "/root/repo/.bench_q1diag.log"
+def note(**kw):
+    with open(LOG, "a") as f:
+        f.write(json.dumps({"t": time.strftime("%H:%M:%SZ", time.gmtime()), **kw}) + "\n")
+note(event="d4_start")
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import blaze_tpu
+from blaze_tpu.ops.agg import _segscan, build_sorted_segs, _seg_sum
+
+N = 1 << 20
+rng = np.random.RandomState(0)
+key = jnp.asarray(rng.randint(0, 4, N).astype(np.uint32))
+row_idx = jnp.arange(N, dtype=jnp.int32)
+vals = jnp.asarray(rng.randint(0, 1 << 30, N).astype(np.int64))
+live_np = np.ones(N, bool)
+live = jnp.asarray(live_np)
+np.asarray(key[:1])
+note(event="d4_staged")
+
+def timed(name, fn, *args):
+    t0 = time.perf_counter()
+    r = fn(*args); jax.block_until_ready(r)
+    first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r = fn(*args); jax.block_until_ready(r)
+    note(event=name, s=round(time.perf_counter() - t0, 4), first=round(first, 2))
+
+@jax.jit
+def phase_sort(key, live):
+    k = jnp.where(live, key & jnp.uint32(0x7FFFFFFF), jnp.uint32(0xFFFFFFFF))
+    _, s_idx = jax.lax.sort((k, row_idx), num_keys=1)
+    s_live = jnp.take(live, s_idx)
+    prev_idx = jnp.roll(s_idx, 1)
+    changed = (jnp.take(key, s_idx) != jnp.take(key, prev_idx)).at[0].set(True)
+    boundary = s_live & (changed | ~jnp.roll(s_live, 1))
+    boundary = boundary.at[0].set(s_live[0])
+    return boundary, s_live, s_idx
+
+@jax.jit
+def phase_segs(key, live):
+    boundary, s_live, s_idx = phase_sort(key, live)
+    segs = build_sorted_segs(boundary, s_live)
+    return segs.seg, segs.starts, segs.ends
+
+@jax.jit
+def phase_one_sum(key, live, vals):
+    boundary, s_live, s_idx = phase_sort(key, live)
+    segs = build_sorted_segs(boundary, s_live)
+    sv = jnp.take(vals, s_idx)
+    return _seg_sum(sv, s_live, segs, N)
+
+@jax.jit
+def phase_8sums(key, live, vals):
+    boundary, s_live, s_idx = phase_sort(key, live)
+    segs = build_sorted_segs(boundary, s_live)
+    outs = []
+    for k in range(8):
+        sv = jnp.take(vals + k, s_idx)
+        outs.append(_seg_sum(sv, s_live, segs, N))
+    return tuple(outs)
+
+@jax.jit
+def phase_segscan_only(vals, live):
+    flags = jnp.zeros(N, bool).at[0].set(True)
+    return _segscan(jnp.add, vals, flags)
+
+timed("d4_sort_boundary", phase_sort, key, live)
+timed("d4_build_segs", phase_segs, key, live)
+timed("d4_one_sum", phase_one_sum, key, live, vals)
+timed("d4_8sums", phase_8sums, key, live, vals)
+timed("d4_segscan_only", phase_segscan_only, vals, live)
+note(event="d4_done")

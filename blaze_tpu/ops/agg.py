@@ -983,11 +983,9 @@ class AggExec(ExecNode):
                 key = jnp.where(live, h & jnp.uint32(0x7FFFFFFF), jnp.uint32(0xFFFFFFFF))
                 _, s_idx = jax.lax.sort((key, row_idx), num_keys=1)
                 s_live = jnp.take(live, s_idx)
-                prev_idx = jnp.roll(s_idx, 1)
-                changed = jnp.zeros(cap, jnp.bool_)
-                for w in key_words:
-                    changed = changed | (jnp.take(w, s_idx) != jnp.take(w, prev_idx))
-                changed = changed.at[0].set(True)
+                # full key words join the stacked u64 gather below;
+                # boundaries compare sorted words against their roll
+                changed = None
             else:
                 words = [live.astype(jnp.uint64) ^ jnp.uint64(1)] + key_words
                 sorted_ops = jax.lax.sort(tuple(words) + (row_idx,), num_keys=len(words))
@@ -997,6 +995,72 @@ class AggExec(ExecNode):
                 for w in s_words:
                     changed = changed | (w != jnp.roll(w, 1))
                 changed = changed.at[0].set(True)
+
+            # sort every flat payload column with ONE stacked row
+            # gather per dtype group — TPU gathers cost per ROW, not
+            # per element (~131 ms per 1M-row gather on the real chip,
+            # .bench_q1diag.log), so 20 per-column takes collapse into
+            # ~4 matrix takes
+            inputs = partial_inputs(env, schema, cap) if not merging else state_inputs(env)
+            flat_cols = [c for ins in inputs for c in ins] + list(key_cols)
+            groups: Dict = {}
+            if changed is None:  # hash path: key words ride the gather
+                for wi, w in enumerate(key_words):
+                    groups.setdefault(("d", "uint64"), []).append(
+                        (("kw", wi), "kw", w))
+            for ci, c in enumerate(flat_cols):
+                if c.children is not None or c.data.ndim > 2:
+                    continue  # nested: per-column take fallback below
+                groups.setdefault(("v", jnp.bool_.__name__), []).append(
+                    (ci, "validity", c.validity))
+                if c.data.ndim == 1:
+                    groups.setdefault(("d", str(c.data.dtype)), []).append(
+                        (ci, "data", c.data))
+                else:  # (cap, W) u8 string payload: W lanes
+                    for lane in range(c.data.shape[1]):
+                        groups.setdefault(("d", str(c.data.dtype)), []).append(
+                            ((ci, lane), "lane", c.data[:, lane]))
+                if c.lengths is not None:
+                    groups.setdefault(("l", str(c.lengths.dtype)), []).append(
+                        (ci, "lengths", c.lengths))
+            sorted_parts: Dict = {}
+            for _, entries in groups.items():
+                mat = jnp.stack([e[2] for e in entries], axis=1)
+                smat = jnp.take(mat, s_idx, axis=0)
+                for k2, (tag, kind, _) in enumerate(entries):
+                    sorted_parts[(tag, kind)] = smat[:, k2]
+            if changed is None:  # hash path boundary from sorted words
+                changed = jnp.zeros(cap, jnp.bool_)
+                for wi in range(len(key_words)):
+                    sw = sorted_parts[(("kw", wi), "kw")]
+                    changed = changed | (sw != jnp.roll(sw, 1))
+                changed = changed.at[0].set(True)
+
+            sorted_flat: List[Column] = []
+            for ci, c in enumerate(flat_cols):
+                if c.children is not None or c.data.ndim > 2:
+                    g = c.take(s_idx)
+                    sorted_flat.append(Column(
+                        g.dtype, g.data, g.validity & s_live, g.lengths,
+                        g.children))
+                    continue
+                valid = sorted_parts[(ci, "validity")] & s_live
+                if c.data.ndim == 1:
+                    data = sorted_parts[(ci, "data")]
+                else:
+                    data = jnp.stack(
+                        [sorted_parts[((ci, lane), "lane")]
+                         for lane in range(c.data.shape[1])], axis=1)
+                lengths = (sorted_parts[(ci, "lengths")]
+                           if c.lengths is not None else None)
+                sorted_flat.append(Column(c.dtype, data, valid, lengths))
+            n_inputs = sum(len(ins) for ins in inputs)
+            sorted_inputs = []
+            k = 0
+            for ins in inputs:
+                sorted_inputs.append(sorted_flat[k : k + len(ins)])
+                k += len(ins)
+            sorted_keys = sorted_flat[n_inputs:]
             boundary = s_live & (changed | ~jnp.roll(s_live, 1))
             boundary = boundary.at[0].set(s_live[0])
             n_out = jnp.sum(boundary.astype(jnp.int32))
@@ -1005,33 +1069,20 @@ class AggExec(ExecNode):
             else:
                 seg = jnp.clip(jnp.cumsum(boundary.astype(jnp.int32)) - 1, 0, cap - 1)
 
-            # gather agg inputs in sorted order (Column.take recurses
-            # into nested children, e.g. collect ARRAY states)
-            inputs = partial_inputs(env, schema, cap) if not merging else state_inputs(env)
-
-            def sort_col(c: Column) -> Column:
-                g = c.take(s_idx)
-                return Column(g.dtype, g.data, g.validity & s_live, g.lengths, g.children)
-
-            sorted_inputs = [[sort_col(c) for c in ins] for ins in inputs]
+            # agg inputs arrived in sorted order via the stacked
+            # gathers (nested children fell back to take(s_idx))
             state_cols: List[Column] = []
             for a, t, ins in zip(aggs, in_types, sorted_inputs):
                 state_cols.extend(reduce_one(a, t, ins, seg, cap, merging))
 
-            # group key columns: gather at boundary positions
+            # group key columns: already sorted; gather at boundaries
             if use_segscan:
                 b_idx = seg.starts
             else:
                 b_idx = jnp.nonzero(boundary, size=cap, fill_value=0)[0]
             out_live = jnp.arange(cap) < n_out
             group_out: List[Column] = []
-            for kc in key_cols:
-                skc = Column(
-                    kc.dtype,
-                    jnp.take(kc.data, s_idx, axis=0),
-                    jnp.take(kc.validity, s_idx),
-                    None if kc.lengths is None else jnp.take(kc.lengths, s_idx),
-                )
+            for skc in sorted_keys:
                 g = skc.take(b_idx)
                 group_out.append(
                     Column(g.dtype, g.data, g.validity & out_live,
