@@ -4897,3 +4897,142 @@ def test_spark_q71(ticket_sess, ticket_data, strategy):
     assert rows == exp
     keys = list(zip([-p for p in got["ext_price"]], got["brand_id"]))
     assert keys == sorted(keys)
+
+
+# ------------- q66 warehouse monthly sales/net pivot
+
+_Q66_MONTHS = ("jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+               "sep", "oct", "nov", "dec")
+_Q66_KEYS = ("w_warehouse_name", "w_warehouse_sq_ft", "w_city", "w_county",
+             "w_state", "w_country")
+
+
+def _q66_channel_plan(st, fact, wh_c, date_c, time_c, mode_c, qty_c,
+                      sales_c, net_c):
+    dt = F.project(
+        [a("d_date_sk"), a("d_moy")],
+        F.filter_(F.binop("EqualTo", a("d_year"), i32(2001)),
+                  F.scan("date_dim", [a("d_date_sk"), a("d_year"),
+                                      a("d_moy")])),
+    )
+    tm = F.project(
+        [a("t_time_sk")],
+        F.filter_(and_(F.binop("GreaterThanOrEqual", a("t_time"),
+                               F.lit(30838, "long")),
+                       F.binop("LessThanOrEqual", a("t_time"),
+                               F.lit(30838 + 28800, "long"))),
+                  F.scan("time_dim", [a("t_time_sk"), a("t_time")])),
+    )
+    sm = F.project(
+        [a("sm_ship_mode_sk")],
+        F.filter_(in_(a("sm_carrier"), "DHL", "BARIAN"),
+                  F.scan("ship_mode", [a("sm_ship_mode_sk"),
+                                       a("sm_carrier")])),
+    )
+    sl = F.scan(fact, [a(wh_c), a(date_c), a(time_c), a(mode_c), a(qty_c),
+                       a(sales_c), a(net_c)])
+    j = join(st, dt, sl, [a("d_date_sk")], [a(date_c)])
+    j = join(st, tm, j, [a("t_time_sk")], [a(time_c)])
+    j = join(st, sm, j, [a("sm_ship_mode_sk")], [a(mode_c)])
+    wh = F.scan("warehouse", [a("w_warehouse_sk")] + [a(k) for k in _Q66_KEYS])
+    j = join(st, wh, j, [a("w_warehouse_sk")], [a(wh_c)])
+    qdec = F.cast(a(qty_c), "decimal(10,0)")
+    sales = F.binop("Multiply", a(sales_c), qdec)
+    net = F.binop("Multiply", a(net_c), qdec)
+    pivots = []
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        pivots.append(F.alias(
+            F.T(F.X + "CaseWhen",
+                [F.binop("EqualTo", a("d_moy"), i32(m)), sales]),
+            f"{nm}_sales_v", 2000 + m))
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        pivots.append(F.alias(
+            F.T(F.X + "CaseWhen",
+                [F.binop("EqualTo", a("d_moy"), i32(m)), net]),
+            f"{nm}_net_v", 2020 + m))
+    proj = F.project([a(k) for k in _Q66_KEYS] + pivots, j)
+    agg = two_stage(
+        [a(k) for k in _Q66_KEYS],
+        [(F.sum_(ar(f"{nm}_sales_v", 2000 + m, "decimal(18,2)")), 2040 + m)
+         for m, nm in enumerate(_Q66_MONTHS, start=1)]
+        + [(F.sum_(ar(f"{nm}_net_v", 2020 + m, "decimal(18,2)")), 2060 + m)
+           for m, nm in enumerate(_Q66_MONTHS, start=1)],
+        proj,
+    )
+    outs = [a(k) for k in _Q66_KEYS] + [
+        F.alias(F.lit("DHL,BARIAN", "string"), "ship_carriers", 2080),
+        F.alias(F.lit(2001, "integer"), "year", 2081),
+    ]
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        outs.append(F.alias(ar(f"{nm}_sales", 2040 + m, "decimal(28,2)"),
+                            f"{nm}_sales", 2100 + m))
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        outs.append(F.alias(
+            F.binop("Divide",
+                    F.cast(ar(f"{nm}_sales", 2040 + m, "decimal(28,2)"),
+                           "double"),
+                    F.cast(a("w_warehouse_sq_ft"), "double")),
+            f"{nm}_sales_per_sq_foot", 2120 + m))
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        outs.append(F.alias(ar(f"{nm}_net", 2060 + m, "decimal(28,2)"),
+                            f"{nm}_net", 2140 + m))
+    return F.project(outs, agg)
+
+
+def test_spark_q66(sess, data, strategy):
+    web = _q66_channel_plan(
+        strategy, "web_sales", "ws_warehouse_sk", "ws_sold_date_sk",
+        "ws_sold_time_sk", "ws_ship_mode_sk", "ws_quantity",
+        "ws_ext_sales_price", "ws_net_paid")
+    cat = _q66_channel_plan(
+        strategy, "catalog_sales", "cs_warehouse_sk", "cs_sold_date_sk",
+        "cs_sold_time_sk", "cs_ship_mode_sk", "cs_quantity",
+        "cs_sales_price", "cs_net_paid_inc_tax")
+    u = F.union([web, cat])
+    groups = [a(k) for k in _Q66_KEYS] + [
+        ar("ship_carriers", 2080, "string"), ar("year", 2081, "integer")]
+    aggs = []
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        aggs.append((F.sum_(ar(f"{nm}_sales", 2100 + m, "decimal(28,2)")),
+                     2200 + m))
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        aggs.append((F.sum_(
+            ar(f"{nm}_sales_per_sq_foot", 2120 + m, "double")), 2220 + m))
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        aggs.append((F.sum_(ar(f"{nm}_net", 2140 + m, "decimal(28,2)")),
+                     2240 + m))
+    agg = two_stage(groups, aggs, u)
+    outs = [F.alias(a(k), k, 2300 + i) for i, k in enumerate(_Q66_KEYS)]
+    outs += [F.alias(ar("ship_carriers", 2080, "string"), "ship_carriers",
+                     2310),
+             F.alias(ar("year", 2081, "integer"), "year", 2311)]
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        outs.append(F.alias(ar(f"{nm}_sales", 2200 + m, "decimal(38,2)"),
+                            f"{nm}_sales", 2320 + m))
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        outs.append(F.alias(ar(f"{nm}_sales_per_sq_foot", 2220 + m, "double"),
+                            f"{nm}_sales_per_sq_foot", 2340 + m))
+    for m, nm in enumerate(_Q66_MONTHS, start=1):
+        outs.append(F.alias(ar(f"{nm}_net", 2240 + m, "decimal(38,2)"),
+                            f"{nm}_net", 2360 + m))
+    plan = F.take_ordered(
+        100, [F.sort_order(a("w_warehouse_name"))], outs, agg)
+    got = _execute_both(sess, plan)
+    exp = O.oracle_q66(data)
+    assert exp, "q66 oracle empty"
+    assert got["w_warehouse_name"] == sorted(exp)
+    for i, name in enumerate(got["w_warehouse_name"]):
+        sq_ft, city, cty, state, country, sales_e, ratios, nets = exp[name]
+        assert (got["w_warehouse_sq_ft"][i], got["w_city"][i],
+                got["w_county"][i], got["w_state"][i],
+                got["w_country"][i]) == (sq_ft, city, cty, state, country)
+        assert got["ship_carriers"][i] == "DHL,BARIAN"
+        assert got["year"][i] == 2001
+        for m, nm in enumerate(_Q66_MONTHS):
+            assert got[f"{nm}_sales"][i] == sales_e[m], (name, nm)
+            assert got[f"{nm}_net"][i] == nets[m], (name, nm)
+            g = got[f"{nm}_sales_per_sq_foot"][i]
+            if ratios[m] is None:
+                assert g is None, (name, nm)
+            else:
+                assert g == pytest.approx(ratios[m], rel=1e-12), (name, nm)
