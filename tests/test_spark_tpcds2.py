@@ -5036,3 +5036,100 @@ def test_spark_q66(sess, data, strategy):
                 assert g is None, (name, nm)
             else:
                 assert g == pytest.approx(ratios[m], rel=1e-12), (name, nm)
+
+
+# ------------- q80 per-item channel totals net of returns
+
+def test_spark_q80(sess, data, strategy):
+    from test_tpcds import _check_channel_report
+
+    dt = F.project(
+        [a("d_date_sk")],
+        F.filter_(
+            and_(F.binop("GreaterThanOrEqual", a("d_date"),
+                         F.lit("2000-08-03", "date")),
+                 F.binop("LessThanOrEqual", a("d_date"),
+                         F.lit("2000-09-01", "date"))),
+            F.scan("date_dim", [a("d_date_sk"), a("d_date")]),
+        ),
+    )
+    dz = F.lit("0", "decimal(7,2)")
+    it_p = F.project(
+        [a("i_item_sk"), a("i_item_id")],
+        F.filter_(F.binop("GreaterThan", a("i_current_price"),
+                          F.lit("50", "decimal(7,2)")),
+                  F.scan("item", [a("i_item_sk"), a("i_item_id"),
+                                  a("i_current_price")])),
+    )
+    pr_p = F.project(
+        [a("p_promo_sk")],
+        F.filter_(F.binop("EqualTo", a("p_channel_email"), s("N")),
+                  F.scan("promotion", [a("p_promo_sk"),
+                                       a("p_channel_email")])),
+    )
+
+    def d8(e):
+        return F.binop("Add", e, dz)
+
+    def co0(e):
+        return F.T(F.X + "CaseWhen",
+                   [F.un("IsNotNull", e), F.binop("Add", e, dz),
+                    F.binop("Add", dz, dz)])
+
+    def channel(fact, ret, fact_cols, ret_cols, skeys, rkeys, date_c,
+                item_c, promo_c, price_c, profit_c, ramt_c, rloss_c, name):
+        sl = F.scan(fact, [a(c) for c in fact_cols])
+        rt = F.scan(ret, [a(c) for c in ret_cols])
+        j = join(strategy, dt, sl, [a("d_date_sk")], [a(date_c)])
+        j = join(strategy, it_p, j, [a("i_item_sk")], [a(item_c)])
+        j = join(strategy, pr_p, j, [a("p_promo_sk")], [a(promo_c)])
+        j = join(strategy, rt, j, [a(k) for k in rkeys],
+                 [a(k) for k in skeys], jt="LeftOuter", build_side="right")
+        return F.project(
+            [F.alias(F.lit(name, "string"), "channel", 1500),
+             F.alias(a("i_item_id"), "id", 1501),
+             F.alias(d8(a(price_c)), "sales", 1502),
+             F.alias(co0(a(ramt_c)), "returns", 1503),
+             F.alias(F.binop("Subtract", d8(a(profit_c)), co0(a(rloss_c))),
+                     "profit", 1504)],
+            j,
+        )
+
+    store_rows = channel(
+        "store_sales", "store_returns",
+        ["ss_sold_date_sk", "ss_item_sk", "ss_promo_sk", "ss_ticket_number",
+         "ss_ext_sales_price", "ss_net_profit"],
+        ["sr_item_sk", "sr_ticket_number", "sr_return_amt", "sr_net_loss"],
+        ["ss_item_sk", "ss_ticket_number"],
+        ["sr_item_sk", "sr_ticket_number"],
+        "ss_sold_date_sk", "ss_item_sk", "ss_promo_sk",
+        "ss_ext_sales_price", "ss_net_profit", "sr_return_amt",
+        "sr_net_loss", "store channel")
+    cat_rows = channel(
+        "catalog_sales", "catalog_returns",
+        ["cs_sold_date_sk", "cs_item_sk", "cs_promo_sk", "cs_order_number",
+         "cs_ext_sales_price", "cs_net_profit"],
+        ["cr_item_sk", "cr_order_number", "cr_return_amount", "cr_net_loss"],
+        ["cs_item_sk", "cs_order_number"],
+        ["cr_item_sk", "cr_order_number"],
+        "cs_sold_date_sk", "cs_item_sk", "cs_promo_sk",
+        "cs_ext_sales_price", "cs_net_profit", "cr_return_amount",
+        "cr_net_loss", "catalog channel")
+    web_rows = channel(
+        "web_sales", "web_returns",
+        ["ws_sold_date_sk", "ws_item_sk", "ws_promo_sk", "ws_order_number",
+         "ws_ext_sales_price", "ws_net_profit"],
+        ["wr_item_sk", "wr_order_number", "wr_return_amt", "wr_net_loss"],
+        ["ws_item_sk", "ws_order_number"],
+        ["wr_item_sk", "wr_order_number"],
+        "ws_sold_date_sk", "ws_item_sk", "ws_promo_sk",
+        "ws_ext_sales_price", "ws_net_profit", "wr_return_amt",
+        "wr_net_loss", "web channel")
+
+    # q80's id is a string item_id; profit subtracts the loss coalesce,
+    # widening to decimal(9,2) — reuse the q5 rollup tail by aliasing
+    # profit down into the same slot types
+    plan = _channel_report_tail_plan(
+        strategy, F.union([store_rows, cat_rows, web_rows]))
+    got = _execute_both(sess, plan)
+    _check_channel_report(got, O.oracle_q80(data))
