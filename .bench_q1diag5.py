@@ -1,0 +1,56 @@
+"""Per-op metrics breakdown of the q1 pipeline on the real chip."""
+import json, time
+import numpy as np
+LOG = "/root/repo/.bench_q1diag.log"
+def note(**kw):
+    with open(LOG, "a") as f:
+        f.write(json.dumps({"t": time.strftime("%H:%M:%SZ", time.gmtime()), **kw}) + "\n")
+note(event="d5_start")
+import jax
+jax.config.update("jax_enable_x64", True)
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.fusion import fuse_stages
+from blaze_tpu.ops.pruning import prune_columns
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import Schema
+from blaze_tpu.tpch.datagen import generate_table, table_to_batches
+from blaze_tpu.tpch.queries import q1
+from blaze_tpu.tpch.schema import TPCH_SCHEMAS
+
+cols = ("l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+        "l_discount", "l_tax", "l_shipdate")
+table = generate_table("lineitem", 0.1, columns=list(cols))
+schema = Schema([TPCH_SCHEMAS["lineitem"].field(c) for c in cols])
+parts = table_to_batches(table, schema, 1, batch_rows=1 << 22, device=True)
+for b in parts[0]:
+    for c in b.columns:
+        np.asarray(c.data[:1])
+note(event="d5_staged")
+
+def run_with_metrics():
+    scans = {"lineitem": MemoryScanExec(parts, schema)}
+    plan = prune_columns(fuse_stages(q1(scans, 1)))
+    out = []
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            out.append(b)
+    for b in out:
+        np.asarray(b.columns[0].data)
+    return plan
+
+plan = run_with_metrics()   # compile (cached jits from nothing: slow once)
+note(event="d5_compiled")
+t0 = time.perf_counter()
+plan = run_with_metrics()
+note(event="d5_warm_total", s=round(time.perf_counter() - t0, 3))
+
+def walk(n, d=0):
+    vals = {k: round(v, 3) for k, v in sorted(n.metrics.items())
+            if isinstance(v, float) and v > 0.05}
+    note(event="d5_op", op=type(n).__name__, depth=d, m=vals)
+    for c in getattr(n, "children", []):
+        walk(c, d + 1)
+
+walk(plan)
+# wall-clock per phase with manual syncs: partial agg output size etc.
+note(event="d5_done")
