@@ -13,6 +13,15 @@ Usage:
     python -m blaze_tpu tpch q6 q1 --scale 0.05
     python -m blaze_tpu tpcds q36 --scale 0.002 --parts 4 --scheduler
     python -m blaze_tpu tpch all --scale 0.01
+    python -m blaze_tpu --chaos             # seeded fault-injection smoke
+    python -m blaze_tpu tpch q1 --chaos --chaos-seed 42
+
+``--chaos`` is the CI-facing fault-tolerance gate: each query runs
+once fault-free through the stage scheduler, then again under a
+seed-derived random fault schedule (runtime/faults.py sites:
+shuffle fetch/write, task compute) with task retry and fetch-failure
+recovery enabled.  Exit is nonzero on any result mismatch or
+unrecovered failure, and the recovery counters are printed.
 """
 
 from __future__ import annotations
@@ -22,8 +31,12 @@ import sys
 import time
 
 
-def _run_suite(suite: str, names, scale: float, n_parts: int,
-               scheduler: bool) -> int:
+def _load_suite(suite: str, names, scale: float, n_parts: int):
+    """Shared setup for the runner and the chaos gate: resolve the
+    query list ('all' expansion + validation) and build per-table
+    MemoryScanExec scans over generated data.  Returns
+    (build_query, names, scans) or (None, exit_code, None) on a usage
+    error."""
     if suite == "tpch":
         from .tpch import TPCH_SCHEMAS as SCHEMAS
         from .tpch import build_query
@@ -41,7 +54,7 @@ def _run_suite(suite: str, names, scale: float, n_parts: int,
     if unknown:
         print(f"unknown {suite} queries: {', '.join(unknown)} "
               f"(available: {', '.join(sorted(QUERIES))})", file=sys.stderr)
-        return 2
+        return None, 2, None
 
     t0 = time.perf_counter()
     data = generate_all(scale)
@@ -55,6 +68,14 @@ def _run_suite(suite: str, names, scale: float, n_parts: int,
         for name in SCHEMAS
     }
     print(f"# datagen scale={scale}: {time.perf_counter() - t0:.2f}s")
+    return build_query, names, scans
+
+
+def _run_suite(suite: str, names, scale: float, n_parts: int,
+               scheduler: bool) -> int:
+    build_query, names, scans = _load_suite(suite, names, scale, n_parts)
+    if build_query is None:
+        return names
 
     from .runtime.context import TaskContext
 
@@ -87,14 +108,94 @@ def _run_suite(suite: str, names, scale: float, n_parts: int,
     return 0
 
 
+def _rows_via_scheduler(plan):
+    """Run a plan through the stage scheduler and collect its output as
+    a sorted list of row tuples (order-insensitive comparison key)."""
+    from .batch import batch_to_pydict
+    from .runtime.scheduler import run_stages, split_stages
+
+    stages, manager = split_stages(plan)
+    cols = None
+    for b in run_stages(stages, manager):
+        d = batch_to_pydict(b)
+        if cols is None:
+            cols = {k: [] for k in d}
+        for k, v in d.items():
+            cols[k].append(v)
+    if cols is None:
+        return []
+    flat = {k: [x for chunk in v for x in chunk] for k, v in cols.items()}
+    names = sorted(flat)
+    return sorted(zip(*[flat[n] for n in names])) if names else []
+
+
+def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
+               n_faults: int) -> int:
+    """Fault-injection smoke: fault-free run vs seeded-fault run must
+    produce identical rows.  Nonzero exit on mismatch or unrecovered
+    failure (CI gate for the retry/fetch-recovery path)."""
+    from . import conf
+    from .runtime import faults, scheduler
+
+    build_query, names, scans = _load_suite(suite, names, scale, n_parts)
+    if build_query is None:
+        return names
+
+    conf.TASK_RETRY_BACKOFF.set(0.01)  # keep the smoke fast
+    failed = []
+    for i, name in enumerate(names):
+        spec = faults.random_spec(seed + i, n_faults=n_faults)
+        conf.FAULTS_SPEC.set("")
+        faults.reset()
+        try:
+            baseline = _rows_via_scheduler(build_query(name, scans, n_parts))
+        except Exception as e:  # noqa: BLE001
+            print(f"chaos {name}: BASELINE FAILED {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            failed.append(name)
+            continue
+        conf.FAULTS_SPEC.set(spec)
+        faults.reset()
+        try:
+            chaotic = _rows_via_scheduler(build_query(name, scans, n_parts))
+        except Exception as e:  # noqa: BLE001
+            print(f"chaos {name}: UNRECOVERED under spec '{spec}': "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            failed.append(name)
+            continue
+        finally:
+            conf.FAULTS_SPEC.set("")
+            faults.reset()
+        m = scheduler.LAST_RUN_METRICS.metrics if scheduler.LAST_RUN_METRICS else None
+        counters = (
+            f"attempts={m.get('task_attempts')} retries={m.get('task_retries')} "
+            f"fetch_failures={m.get('fetch_failures')} "
+            f"map_reruns={m.get('map_stage_reruns')}" if m else "no metrics"
+        )
+        if chaotic != baseline:
+            print(f"chaos {name}: MISMATCH under spec '{spec}' ({counters})",
+                  file=sys.stderr)
+            failed.append(name)
+        else:
+            print(f"chaos {name}: OK {len(baseline)} rows identical under "
+                  f"spec '{spec}' ({counters})")
+    if failed:
+        print(f"# chaos: {len(failed)} failed: {', '.join(failed)}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m blaze_tpu",
         description="Run TPC-H / TPC-DS queries through the engine.",
     )
-    ap.add_argument("suite", choices=["tpch", "tpcds"])
-    ap.add_argument("queries", nargs="+",
-                    help="query names (q1, q6, ...) or 'all'")
+    ap.add_argument("suite", nargs="?", choices=["tpch", "tpcds"],
+                    default="tpch")
+    ap.add_argument("queries", nargs="*", default=None,
+                    help="query names (q1, q6, ...) or 'all' "
+                         "(default: q6 under --chaos)")
     ap.add_argument("--scale", type=float, default=0.01,
                     help="datagen scale factor (default 0.01)")
     ap.add_argument("--parts", type=int, default=2,
@@ -102,8 +203,22 @@ def main(argv=None) -> int:
     ap.add_argument("--scheduler", action="store_true",
                     help="run through the stage scheduler (TaskDefinition "
                          "bytes + shuffle files) instead of in-process")
+    ap.add_argument("--chaos", action="store_true",
+                    help="fault-injection smoke: run each query fault-free "
+                         "and under a seeded random fault schedule; exit "
+                         "nonzero on result mismatch")
+    ap.add_argument("--chaos-seed", type=int, default=7,
+                    help="seed for the chaos fault schedule (default 7)")
+    ap.add_argument("--chaos-faults", type=int, default=3,
+                    help="faults per scheduled chaos run (default 3)")
     args = ap.parse_args(argv)
-    return _run_suite(args.suite, args.queries, args.scale, args.parts,
+    queries = args.queries or (["q6"] if args.chaos else None)
+    if not queries:
+        ap.error("query names required (or pass --chaos for the default q6)")
+    if args.chaos:
+        return _run_chaos(args.suite, queries, args.scale, args.parts,
+                          args.chaos_seed, args.chaos_faults)
+    return _run_suite(args.suite, queries, args.scale, args.parts,
                       args.scheduler)
 
 

@@ -30,9 +30,12 @@ class ConfEntry:
         self.key = key
         self.default = default
         self._parse = parse
+        self._env_key = (
+            "BLAZE_" + key.replace("spark.blaze.", "").replace(".", "_").upper()
+        )
 
     def get(self) -> Any:
-        env_key = "BLAZE_" + self.key.replace("spark.blaze.", "").replace(".", "_").upper()
+        env_key = self._env_key
         if env_key in os.environ:
             return self._parse(os.environ[env_key])
         with _lock:
@@ -78,6 +81,23 @@ TOKIO_NUM_WORKER_THREADS = ConfEntry("spark.blaze.tokio.num.worker.threads", 2, 
 # (≙ rt.rs sync_channel(1) + tokio stream drive); 0 = synchronous
 PIPELINE_DEPTH = ConfEntry("spark.blaze.pipeline.depth", 2, int)
 RSS_FETCH_BARRIER_TIMEOUT = ConfEntry("spark.blaze.rss.fetchBarrierTimeout", 120.0, float)
+
+# Fault-tolerant stage execution (runtime/retry.py + scheduler loop).
+# ≙ spark.task.maxFailures: total attempts per task, 1 = fail fast.
+TASK_MAX_ATTEMPTS = ConfEntry("spark.blaze.task.maxAttempts", 4, int)
+# first retry delay (seconds); doubles per attempt with deterministic
+# jitter (retry.py RetryPolicy.backoff).  0 disables backoff sleeps.
+TASK_RETRY_BACKOFF = ConfEntry("spark.blaze.task.retryBackoff", 0.1, float)
+# per-task wall-clock budget (seconds), checked between output batches;
+# 0 = unlimited.  A timed-out attempt is retried like any failure.
+TASK_TIMEOUT = ConfEntry("spark.blaze.task.timeout", 0.0, float)
+# fetch-failure recoveries (upstream map-stage regenerations) allowed
+# per fetching task before the failure is terminal
+STAGE_MAX_ATTEMPTS = ConfEntry("spark.blaze.stage.maxAttempts", 4, int)
+# deterministic fault-injection schedule (runtime/faults.py grammar,
+# e.g. "shuffle.fetch@2,task.compute@1@a0"); empty = no injection.
+# Env override BLAZE_FAULTS_SPEC reaches worker subprocesses too.
+FAULTS_SPEC = ConfEntry("spark.blaze.faults.spec", "", str)
 
 # TPU-specific knobs (no reference equivalent).
 ON_DEVICE = ConfEntry("spark.blaze.tpu.onDevice", True, _bool)

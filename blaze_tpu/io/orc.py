@@ -178,6 +178,19 @@ def _unpack_be(data, pos: int, width: int, count: int) -> Tuple[np.ndarray, int]
     return vals.view(np.int64), pos + nbytes
 
 
+def _wrap_u64(v: int) -> int:
+    """Unsigned->signed int64 wrap for "unsigned" RLE streams.
+
+    ORC C++ packs signed values (e.g. pre-epoch packed nanos) into
+    unsigned streams as their two's-complement uint64 image; a python
+    varint/big-endian decode hands back the raw >= 2**63 integer, which
+    overflows an int64 slice-assign.  Every unsigned decode path
+    (RLEv1 literal + run base, RLEv2 SHORT_REPEAT + DELTA base) wraps
+    through here; RLEv2 DIRECT wraps vectorized via _unpack_be's int64
+    view, which is this same reinterpretation."""
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
 def _rlev2_decode(data: bytes, count: int, signed: bool) -> np.ndarray:
     """ORC RLEv2: short-repeat / direct / patched-base / delta runs."""
     out = np.zeros(count, np.int64)
@@ -209,8 +222,7 @@ def _rlev2_decode(data: bytes, count: int, signed: bool) -> np.ndarray:
             run = (b0 & 7) + 3
             v = int.from_bytes(data[pos : pos + width], "big")
             pos += width
-            if signed:
-                v = (v >> 1) ^ -(v & 1)
+            v = (v >> 1) ^ -(v & 1) if signed else _wrap_u64(v)
             out[n : n + run] = v
             n += run
         elif enc == 1:  # DIRECT
@@ -223,6 +235,9 @@ def _rlev2_decode(data: bytes, count: int, signed: bool) -> np.ndarray:
                 vals = ((u >> np.uint64(1)).astype(np.int64)) ^ -(
                     (u & np.uint64(1)).astype(np.int64)
                 )
+            # unsigned: _unpack_be already returned the int64 VIEW of
+            # the packed uint64 bits — the explicit _wrap_u64
+            # reinterpretation, vectorized
             out[n : n + run] = vals
             n += run
         elif enc == 2:  # PATCHED_BASE
@@ -261,7 +276,7 @@ def _rlev2_decode(data: bytes, count: int, signed: bool) -> np.ndarray:
             width = _w_decode((b0 >> 1) & 0x1F, delta=True)
             run = ((b0 & 1) << 8 | data[pos]) + 1
             pos += 1
-            base = sv() if signed else uv()
+            base = sv() if signed else _wrap_u64(uv())
             if run == 1:
                 out[n] = base
                 n += 1
@@ -494,7 +509,7 @@ def _rlev1_decode(data: bytes, count: int, signed: bool) -> np.ndarray:
             delta = struct.unpack_from("<b", data, pos)[0]
             pos += 1
             base = uv()
-            base = _unzz(base) if signed else base
+            base = _unzz(base) if signed else _wrap_u64(base)
             for k in range(ln):
                 out[n] = base + k * delta
                 n += 1
@@ -502,11 +517,7 @@ def _rlev1_decode(data: bytes, count: int, signed: bool) -> np.ndarray:
             ln = 256 - h
             for _ in range(ln):
                 v = uv()
-                if not signed and v >= 1 << 63:
-                    # unsigned streams can carry wrapped int64 values
-                    # (ORC C++ packs signed pre-epoch nanos as uint64)
-                    v -= 1 << 64
-                out[n] = _unzz(v) if signed else v
+                out[n] = _unzz(v) if signed else _wrap_u64(v)
                 n += 1
     return out
 

@@ -469,11 +469,41 @@ class NativeShuffleExchangeExec(ExecNode):
         from .. import conf
 
         def file_stream():
-            self.materialize()
+            from ..runtime.retry import FetchFailedError
+
             n_maps = self.children[0].num_partitions()
-            blocks = self.manager.reduce_blocks(self.shuffle_id, n_maps, partition)
-            ctx.resources.put(f"shuffle_{self.shuffle_id}.{partition}", blocks)
-            yield from self._reader.execute(partition, ctx)
+            # one local fetch-failure recovery tier (the in-process
+            # analogue of the scheduler's map-stage regeneration): a
+            # missing/torn/injected-bad block invalidates this
+            # exchange's map outputs and re-runs its own map tasks once
+            # before the error becomes terminal.  Reads that already
+            # yielded batches can't be retried mid-stream — only a
+            # failure before the first yield recovers here; later ones
+            # propagate to the task-level retry.
+            for recovery in range(2):
+                self.materialize()
+                blocks = self.manager.reduce_blocks(
+                    self.shuffle_id, n_maps, partition
+                )
+                ctx.resources.put(
+                    f"shuffle_{self.shuffle_id}.{partition}", blocks
+                )
+                reader = self._reader.execute(partition, ctx)
+                yielded = False
+                try:
+                    for b in reader:
+                        yielded = True
+                        yield b
+                    return
+                except FetchFailedError:
+                    ctx.resources.discard(
+                        f"shuffle_{self.shuffle_id}.{partition}"
+                    )
+                    if yielded or recovery == 1:
+                        raise
+                    with self._lock:
+                        self.manager.invalidate(self.shuffle_id)
+                        self._materialized = False
 
         if bool(conf.EXCHANGE_IN_PROCESS.get()) and not self._hbm_fallback:
             def inproc_stream():

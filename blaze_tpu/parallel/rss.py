@@ -19,6 +19,7 @@ from ..batch import RecordBatch
 from ..io.batch_serde import serialize_batch
 from ..io.ipc_compression import compress_frame
 from ..ops.base import BatchStream, ExecNode
+from ..runtime import faults
 from ..runtime.context import TaskContext
 from ..schema import Schema
 from .shuffle import (
@@ -136,6 +137,11 @@ class RssShuffleWriterExec(ExecNode):
                             serialize_batch(RecordBatch(self.schema, sl, hi - lo))
                         )
                         with self.metrics.timer("output_io_time"):
+                            faults.hit(
+                                "rss.push",
+                                attempt=ctx.task_attempt_id,
+                                detail=f"{self.writer_resource_id}.{partition}",
+                            )
                             writer.write(pid, payload)
                         self.metrics.add("data_size", len(payload))
             except BaseException:

@@ -40,8 +40,10 @@ from ..exprs.ir import Expr
 from ..io.batch_serde import deserialize_batch, serialize_batch
 from ..io.ipc_compression import IpcFrameReader, IpcFrameWriter, compress_frame
 from ..ops.base import BatchStream, ExecNode
+from ..runtime import faults
 from ..runtime.context import TaskContext
 from ..runtime.memmgr import MemConsumer, Spill, try_new_spill
+from ..runtime.retry import FetchFailedError
 from ..schema import Schema
 
 
@@ -147,11 +149,12 @@ class ShuffleRepartitioner(MemConsumer):
 
     name = "shuffle"
 
-    def __init__(self, schema: Schema, n_out: int, metrics):
+    def __init__(self, schema: Schema, n_out: int, metrics, task_attempt_id: int = 0):
         super().__init__()
         self.schema = schema
         self.n_out = n_out
         self.metrics = metrics
+        self.task_attempt_id = task_attempt_id
         self._buffers: List[List[RecordBatch]] = [[] for _ in range(n_out)]
         self._buffered_bytes = 0
         self._spills: List[Tuple[Spill, List[Tuple[int, int]]]] = []  # (spill, [(pid, nframes)])
@@ -193,14 +196,24 @@ class ShuffleRepartitioner(MemConsumer):
                 return 0
             sp = try_new_spill()
             manifest: List[Tuple[int, int]] = []
-            for pid in range(self.n_out):
-                if not self._buffers[pid]:
-                    continue
-                merged = _host_concat(self._buffers[pid], self.schema)
-                sp.write_frame(serialize_batch(merged))
-                manifest.append((pid, 1))
+            try:
+                for pid in range(self.n_out):
+                    if not self._buffers[pid]:
+                        continue
+                    merged = _host_concat(self._buffers[pid], self.schema)
+                    sp.write_frame(serialize_batch(merged))
+                    manifest.append((pid, 1))
+                sp.complete()
+            except BaseException:
+                # spill-abort: release the partial spill and KEEP the
+                # in-memory buffers (cleared only after complete()
+                # succeeds) so a failed spill never loses rows — the
+                # triggering task fails cleanly and its retry still
+                # sees every inserted batch
+                sp.release()
+                raise
+            for pid, _ in manifest:
                 self._buffers[pid] = []
-            sp.complete()
             self._spills.append((sp, manifest))
             freed = self._buffered_bytes
             self._buffered_bytes = 0
@@ -217,6 +230,7 @@ class ShuffleRepartitioner(MemConsumer):
             return self._write_output_locked(data_path, index_path)
 
     def _write_output_locked(self, data_path: str, index_path: str) -> List[int]:
+        faults.hit("shuffle.write", attempt=self.task_attempt_id, detail=data_path)
         # decode spills back per pid (read once, in insertion order)
         spilled: Dict[int, List[RecordBatch]] = {}
         for sp, manifest in self._spills:
@@ -229,19 +243,37 @@ class ShuffleRepartitioner(MemConsumer):
         lengths: List[int] = []
         offsets = [0]
         codec = str(conf.IO_COMPRESSION_CODEC.get())
-        with open(data_path, "wb") as f:
-            w = IpcFrameWriter(f, codec)
-            for pid in range(self.n_out):
-                start = w.bytes_written
-                parts = spilled.get(pid, []) + self._buffers[pid]
-                if parts:
-                    merged = _host_concat(parts, self.schema)
-                    w.write(serialize_batch(merged))
-                lengths.append(w.bytes_written - start)
-                offsets.append(w.bytes_written)
-        with open(index_path, "wb") as f:
-            for off in offsets:
-                f.write(struct.pack("<Q", off))
+        # commit/abort contract (≙ RssPartitionWriterBase.abort, and
+        # Spark's shuffle IndexShuffleBlockResolver writing tmp files
+        # then renaming): stage both files under .inprogress names and
+        # rename on success — index LAST, since reduce_blocks keys on
+        # index existence.  A failed attempt leaves no committed
+        # output, so its retry can never double-count toward the
+        # reduce barrier and readers never see a torn file.
+        tmp_data, tmp_index = data_path + ".inprogress", index_path + ".inprogress"
+        try:
+            with open(tmp_data, "wb") as f:
+                w = IpcFrameWriter(f, codec)
+                for pid in range(self.n_out):
+                    start = w.bytes_written
+                    parts = spilled.get(pid, []) + self._buffers[pid]
+                    if parts:
+                        merged = _host_concat(parts, self.schema)
+                        w.write(serialize_batch(merged))
+                    lengths.append(w.bytes_written - start)
+                    offsets.append(w.bytes_written)
+            with open(tmp_index, "wb") as f:
+                for off in offsets:
+                    f.write(struct.pack("<Q", off))
+            os.replace(tmp_data, data_path)
+            os.replace(tmp_index, index_path)
+        except BaseException:
+            for p in (tmp_data, tmp_index):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            raise
         return lengths
 
 
@@ -372,7 +404,9 @@ class ShuffleWriterExec(ExecNode):
 
         def stream():
             n_out = self.partitioning.num_partitions
-            rep = ShuffleRepartitioner(self.schema, n_out, self.metrics)
+            rep = ShuffleRepartitioner(
+                self.schema, n_out, self.metrics, ctx.task_attempt_id
+            )
             ctx.mem.register_consumer(rep)
             try:
                 rr = 0
@@ -434,20 +468,35 @@ class IpcReaderExec(ExecNode):
             blocks = ctx.resources.get(f"{self.resource_id}.{partition}")
             for block in blocks:
                 with self.metrics.timer("shuffle_read_total_time"):
+                    faults.hit(
+                        "shuffle.fetch",
+                        attempt=ctx.task_attempt_id,
+                        detail=self.resource_id,
+                    )
                     payloads: List[bytes] = []
-                    if isinstance(block, bytes):
-                        off = 0
-                        while off < len(block):
-                            ln, cid = struct.unpack_from("<IB", block, off)
-                            from ..io.ipc_compression import decompress_frame
+                    try:
+                        if isinstance(block, bytes):
+                            off = 0
+                            while off < len(block):
+                                ln, cid = struct.unpack_from("<IB", block, off)
+                                from ..io.ipc_compression import decompress_frame
 
-                            payloads.append(decompress_frame(block[off : off + 5 + ln]))
-                            off += 5 + ln
-                    else:
-                        path, offset, length = block
-                        with open(path, "rb") as f:
-                            f.seek(offset)
-                            payloads.extend(IpcFrameReader(f, length))
+                                payloads.append(decompress_frame(block[off : off + 5 + ln]))
+                                off += 5 + ln
+                        else:
+                            path, offset, length = block
+                            with open(path, "rb") as f:
+                                f.seek(offset)
+                                payloads.extend(IpcFrameReader(f, length))
+                    except (OSError, struct.error, ValueError, EOFError) as e:
+                        # missing/torn/corrupt block: surface as a
+                        # typed fetch failure so the scheduler knows to
+                        # regenerate the producing map stage rather
+                        # than uselessly re-running this reader against
+                        # the same bad bytes (≙ FetchFailedException)
+                        raise FetchFailedError(
+                            self.resource_id, partition, cause=e
+                        ) from e
                 for p in payloads:
                     b = deserialize_batch(p, self._schema)
                     if b.num_rows:
@@ -468,6 +517,26 @@ class LocalShuffleManager:
     def map_output_paths(self, shuffle_id: int, map_id: int) -> Tuple[str, str]:
         base = os.path.join(self.root, f"shuffle_{shuffle_id}_{map_id}")
         return base + ".data", base + ".index"
+
+    def invalidate(self, shuffle_id: int) -> int:
+        """Drop every map output (and in-progress temp) of a shuffle —
+        the driver's response to a FetchFailedError before re-running
+        the producing map stage (≙ DAGScheduler unregistering a dead
+        executor's map outputs).  Returns files removed."""
+        removed = 0
+        prefix = f"shuffle_{shuffle_id}_"
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        for fn in names:
+            if fn.startswith(prefix):
+                try:
+                    os.unlink(os.path.join(self.root, fn))
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
 
     def reduce_blocks(self, shuffle_id: int, num_maps: int, reduce_id: int) -> List[BlockObject]:
         blocks: List[BlockObject] = []

@@ -1,0 +1,189 @@
+"""Deterministic fault injection for the execution layer.
+
+The reference engine inherits its failure modes from the JVM/Spark
+substrate (task crashes, ``FetchFailedException`` on shuffle reads,
+RSS push errors) and its fault-tolerance tests from Spark's own suite.
+This standalone runtime needs both halves in-tree: the recovery logic
+(runtime/retry.py + the scheduler's attempt loop) and a way to make
+failures REPRODUCIBLE so the recovery tests are deterministic.
+
+Named injection sites are instrumented through :func:`hit`:
+
+==================  ====================================================
+site                instrumented where
+==================  ====================================================
+``shuffle.write``   ShuffleRepartitioner.write_output (map-side commit)
+``shuffle.fetch``   IpcReaderExec block reads (raises FetchFailedError)
+``task.compute``    serde.from_proto.run_task (any task body)
+``rss.push``        RssShuffleWriterExec partition pushes
+``spill.write``     memmgr spill frame encoding
+==================  ====================================================
+
+A *schedule* maps each site to the 1-based hit numbers that must raise,
+optionally gated on the task attempt id, so "fail the 3rd shuffle fetch
+of attempt 0" is expressible and a retried attempt (fresh attempt id)
+passes.  ``spill.write`` is the one site with NO attempt identity (a
+spill may run on another task's thread via the memory manager), so its
+attempt gate always sees 0; rely on the one-shot hit counter there.  The schedule comes from the conf knob
+``spark.blaze.faults.spec`` (env override ``BLAZE_FAULTS_SPEC``, so
+worker subprocesses inherit it) with the grammar::
+
+    spec     := entry ("," entry)*
+    entry    := site "@" hit [ "@a" attempt ]
+    example  := "shuffle.fetch@2,task.compute@1@a0"
+
+Hit counters are per-process.  The schedule is loaded from conf at the
+FIRST :func:`hit` of the process and re-loaded (counters reset) by
+:func:`reset` — set the spec, then call ``reset()``; with no spec the
+disarmed ``hit`` fast path is a single bool check, cheap enough for
+per-frame call sites.  :func:`random_spec` derives a schedule from a
+seed for chaos runs (``python -m blaze_tpu --chaos``).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import conf
+
+SITES = (
+    "shuffle.write",
+    "shuffle.fetch",
+    "task.compute",
+    "rss.push",
+    "spill.write",
+)
+
+
+class InjectedFault(RuntimeError):
+    """An injected failure at a named site (retryable, non-fetch)."""
+
+    def __init__(self, site: str, hit: int, detail: str = ""):
+        self.site = site
+        self.hit = hit
+        super().__init__(
+            f"injected fault at {site} (hit {hit})"
+            + (f": {detail}" if detail else "")
+        )
+
+
+# (site, hit_no, attempt_filter) — attempt_filter None = any attempt
+Rule = Tuple[str, int, Optional[int]]
+
+
+def parse_spec(spec: str) -> List[Rule]:
+    rules: List[Rule] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split("@")
+        if len(parts) not in (2, 3):
+            raise ValueError(f"bad fault spec entry {entry!r}")
+        site, hit = parts[0], int(parts[1])
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} (known: {SITES})")
+        attempt: Optional[int] = None
+        if len(parts) == 3:
+            if not parts[2].startswith("a"):
+                raise ValueError(f"bad attempt filter in {entry!r}")
+            attempt = int(parts[2][1:])
+        rules.append((site, hit, attempt))
+    return rules
+
+
+def format_spec(rules: List[Rule]) -> str:
+    out = []
+    for site, hit, attempt in rules:
+        s = f"{site}@{hit}"
+        if attempt is not None:
+            s += f"@a{attempt}"
+        out.append(s)
+    return ",".join(out)
+
+
+def random_spec(
+    seed: int,
+    n_faults: int = 3,
+    sites: Tuple[str, ...] = ("shuffle.fetch", "task.compute", "shuffle.write"),
+    horizon: int = 8,
+    first_attempt_only: bool = True,
+) -> str:
+    """Seed-derived fault schedule for chaos runs.  Faults are gated to
+    attempt 0 by default so a bounded retry budget always recovers
+    (the schedule tests recovery, not the retry limit)."""
+    rng = random.Random(seed)
+    rules: List[Rule] = []
+    seen: Set[Tuple[str, int]] = set()
+    for _ in range(n_faults):
+        site = sites[rng.randrange(len(sites))]
+        hit = rng.randrange(1, horizon + 1)
+        if (site, hit) in seen:
+            continue
+        seen.add((site, hit))
+        rules.append((site, hit, 0 if first_attempt_only else None))
+    return format_spec(rules)
+
+
+class FaultInjector:
+    """Per-process hit counters against a parsed schedule."""
+
+    def __init__(self, rules: List[Rule]):
+        self._by_site: Dict[str, List[Tuple[int, Optional[int]]]] = {}
+        for site, hit, attempt in rules:
+            self._by_site.setdefault(site, []).append((hit, attempt))
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def hit(self, site: str, attempt: int = 0, detail: str = "") -> None:
+        matches = self._by_site.get(site)
+        if not matches:
+            return
+        with self._lock:
+            n = self._counts.get(site, 0) + 1
+            self._counts[site] = n
+        for hit_no, want_attempt in matches:
+            if n == hit_no and (want_attempt is None or want_attempt == attempt):
+                if site == "shuffle.fetch":
+                    from .retry import FetchFailedError
+
+                    raise FetchFailedError(
+                        detail or "injected", hit=n, injected=True
+                    )
+                raise InjectedFault(site, n, detail)
+
+
+_NOOP = FaultInjector([])
+_active: FaultInjector = _NOOP
+_armed = False
+_loaded = False
+_state_lock = threading.Lock()
+
+
+def _load_from_conf() -> None:
+    global _active, _armed, _loaded
+    spec = str(conf.FAULTS_SPEC.get() or "")
+    with _state_lock:
+        _active = FaultInjector(parse_spec(spec)) if spec else _NOOP
+        _armed = bool(spec)
+        _loaded = True
+
+
+def hit(site: str, attempt: int = 0, detail: str = "") -> None:
+    """Instrumentation point: count one hit at ``site``; raise if the
+    active schedule says this hit fails.  Disarmed (no spec at last
+    load), this is a single bool check — safe on per-frame/per-block
+    hot paths."""
+    if not _loaded:
+        _load_from_conf()  # pick up BLAZE_FAULTS_SPEC in fresh workers
+    if not _armed:
+        return
+    _active.hit(site, attempt, detail)
+
+
+def reset() -> None:
+    """(Re)load the schedule from conf and reset hit counters — call
+    after changing ``spark.blaze.faults.spec``."""
+    _load_from_conf()

@@ -24,6 +24,7 @@ import zlib
 from typing import Dict, List, Optional
 
 from .. import conf
+from . import faults
 
 
 class Spill:
@@ -48,6 +49,7 @@ class Spill:
 
 
 def _encode_frame(payload: bytes, codec: str) -> bytes:
+    faults.hit("spill.write")
     if codec == "zlib":
         comp = zlib.compress(payload, 1)
         return len(comp).to_bytes(4, "little") + b"\x01" + comp

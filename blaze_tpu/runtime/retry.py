@@ -1,0 +1,169 @@
+"""Retry policy + typed failure classification for stage execution.
+
+≙ the fault-recovery contract the reference delegates wholesale to
+Spark (SURVEY §1): ``spark.task.maxFailures`` re-attempts a failed
+task, ``FetchFailedException`` escalates to the DAGScheduler which
+regenerates the producing map stage, and everything else is terminal.
+The scheduler (runtime/scheduler.py) consumes this module's
+classification to pick between those three paths.
+
+Determinism: backoff jitter is derived from (stage, task, attempt) —
+never from wall-clock or a global RNG — so a retried run sleeps the
+same amount every time and fault-injection tests stay reproducible.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, replace
+from typing import Optional
+
+from .. import conf
+
+
+class FetchFailedError(Exception):
+    """A shuffle read failed (missing/corrupt block or injected fault).
+
+    ≙ Spark's FetchFailedException: unlike a plain task failure, the
+    fix is to REGENERATE the upstream map stage that produced the
+    blocks, then re-run the fetching task — re-running the fetch alone
+    would re-read the same bad output.  ``resource_id`` names the
+    shuffle (``shuffle_<id>``) so the scheduler can find the producer.
+    """
+
+    def __init__(
+        self,
+        resource_id: str,
+        partition: int = -1,
+        hit: int = 0,
+        injected: bool = False,
+        cause: Optional[BaseException] = None,
+    ):
+        self.resource_id = resource_id
+        self.partition = partition
+        self.injected = injected
+        super().__init__(
+            f"fetch failed for {resource_id!r}"
+            + (f" partition {partition}" if partition >= 0 else "")
+            + (" [injected]" if injected else "")
+            + (f": {cause}" if cause is not None else "")
+        )
+
+    @property
+    def shuffle_id(self) -> Optional[int]:
+        """Producing shuffle id when the resource is a shuffle read."""
+        if self.resource_id.startswith("shuffle_"):
+            try:
+                return int(self.resource_id.split("_")[1].split(".")[0])
+            except (IndexError, ValueError):
+                return None
+        return None
+
+
+class TaskTimeoutError(Exception):
+    """A task exceeded ``spark.blaze.task.timeout`` seconds (checked
+    cooperatively between output batches).  Retryable."""
+
+
+class TaskRetriesExhausted(RuntimeError):
+    """Terminal: a task failed on every allowed attempt.  Subclasses
+    RuntimeError so callers catching broad runtime failures (and the
+    pre-existing retry tests) keep working; the message names the
+    stage/task/attempts and the final cause chains via ``from``."""
+
+    def __init__(self, stage_id: int, task: int, attempts: int,
+                 last_error: BaseException):
+        self.stage_id = stage_id
+        self.task = task
+        self.attempts = attempts
+        self.last_error = last_error
+        super().__init__(
+            f"task {task} of stage {stage_id} failed after {attempts} "
+            f"attempt(s); last error: {type(last_error).__name__}: {last_error}"
+        )
+
+
+# classification results
+RETRY = "retry"          # re-run this task (fresh attempt)
+FETCH_FAILED = "fetch"   # regenerate the producing map stage first
+FATAL = "fatal"          # propagate immediately, no retry
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception from a task attempt to a recovery action."""
+    if isinstance(exc, FetchFailedError):
+        return FETCH_FAILED
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit,
+                        MemoryError)):
+        return FATAL
+    from .context import TaskCancelled
+
+    if isinstance(exc, TaskCancelled):
+        return FATAL
+    if isinstance(exc, (AssertionError, NotImplementedError)):
+        # plan/engine bugs, not environment flakes: retrying re-runs
+        # the same deterministic failure while hiding the real error
+        # behind a retries-exhausted wrapper
+        return FATAL
+    return RETRY
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Attempt budget + deterministic backoff + cooperative timeout.
+
+    ``max_attempts``  total attempts per task (1 = no retry),
+                      ≙ spark.task.maxFailures.
+    ``backoff_base``  first retry delay in seconds; doubles per attempt.
+    ``backoff_max``   delay ceiling.
+    ``task_timeout``  seconds a task may run (0 = unlimited), checked
+                      between output batches (cooperative — a wedged
+                      kernel can't be preempted from python).
+    ``max_stage_regens``  fetch-failure recoveries allowed per task
+                      before giving up (bounds map-stage regeneration
+                      loops when the producer keeps failing).
+    """
+
+    max_attempts: int = 4
+    backoff_base: float = 0.1
+    backoff_max: float = 5.0
+    task_timeout: float = 0.0
+    max_stage_regens: int = 4
+
+    @classmethod
+    def from_conf(cls) -> "RetryPolicy":
+        return cls(
+            max_attempts=max(1, int(conf.TASK_MAX_ATTEMPTS.get())),
+            backoff_base=float(conf.TASK_RETRY_BACKOFF.get()),
+            task_timeout=float(conf.TASK_TIMEOUT.get()),
+            max_stage_regens=max(1, int(conf.STAGE_MAX_ATTEMPTS.get())),
+        )
+
+    def with_max_attempts(self, n: int) -> "RetryPolicy":
+        return replace(self, max_attempts=max(1, int(n)))
+
+    def backoff(self, stage_id: int, task: int, attempt: int) -> float:
+        """Delay before re-attempting (attempt = the one that FAILED,
+        0-based).  Exponential with deterministic jitter in [0.8, 1.2)
+        keyed on (stage, task, attempt) so concurrent retries of
+        sibling tasks decorrelate without losing reproducibility."""
+        if self.backoff_base <= 0:
+            return 0.0
+        raw = min(self.backoff_max, self.backoff_base * (2.0 ** attempt))
+        h = hashlib.blake2b(
+            f"{stage_id}:{task}:{attempt}".encode(), digest_size=8
+        ).digest()
+        jitter = 0.8 + 0.4 * (int.from_bytes(h, "little") / 2**64)
+        return raw * jitter
+
+    def sleep_before_retry(self, stage_id: int, task: int, attempt: int) -> None:
+        d = self.backoff(stage_id, task, attempt)
+        if d > 0:
+            time.sleep(d)
+
+    def deadline(self) -> Optional[float]:
+        """Monotonic deadline for a task starting now, or None."""
+        if self.task_timeout > 0:
+            return time.monotonic() + self.task_timeout
+        return None

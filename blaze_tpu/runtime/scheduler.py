@@ -18,6 +18,7 @@ would ship to gateway workers.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -26,6 +27,12 @@ from ..ops import ExecNode
 from ..parallel.exchange import NativeShuffleExchangeExec
 from ..parallel.shuffle import IpcReaderExec, LocalShuffleManager, ShuffleWriterExec
 from .context import RESOURCES, TaskContext
+from .metrics import MetricNode
+
+#: scheduler-level MetricNode of the most recent :func:`run_stages`
+#: call (attempt/retry/fetch-failure counters) — read by the chaos CLI
+#: and tests; pass ``metrics=`` to run_stages to own the node instead.
+LAST_RUN_METRICS: Optional[MetricNode] = None
 
 
 @dataclass
@@ -236,22 +243,65 @@ def _compute_range_boundaries(stage: Stage, register_readers, max_rows: int = 1 
 
 
 def run_stages(
-    stages: List[Stage], manager: LocalShuffleManager, max_task_attempts: int = 1
+    stages: List[Stage],
+    manager: LocalShuffleManager,
+    max_task_attempts: Optional[int] = None,
+    metrics: Optional[MetricNode] = None,
 ):
     """Execute all stages in order over the serde boundary; yields the
     result stage's batches.  Before each stage that reads a shuffle,
     register its reduce blocks in the resources map (the
     shuffle-reader half: readIpc -> resourcesMap.put).
 
-    ``max_task_attempts`` > 1 enables task retry (≙ Spark's
-    spark.task.maxFailures — the reference delegates ALL fault
-    recovery to Spark task retry, SURVEY §5): a failed task re-runs
-    from a fresh TaskDefinition decode; shuffle files on disk and
-    re-registered reduce blocks make retries idempotent."""
-    from ..serde.from_proto import run_task
+    Fault tolerance (≙ the Spark recovery tiers the reference inherits,
+    SURVEY §1/§5), driven by :class:`runtime.retry.RetryPolicy` (conf
+    ``spark.blaze.task.*`` knobs; ``max_task_attempts`` overrides the
+    attempt budget for this call):
+
+    - **Task retry.**  A failed attempt discards its staged resources,
+      backs off deterministically, and re-runs from a fresh
+      TaskDefinition decode with a new attempt id.  Shuffle outputs
+      commit by atomic rename (ShuffleRepartitioner.write_output) and
+      reduce blocks re-register per attempt, so retries are idempotent
+      and a failed map attempt never counts toward the reduce barrier.
+      Result stages always STREAM (no buffering); their retry window
+      covers failures before the first output batch — after that the
+      attempt is not replayable and the failure propagates.
+    - **Fetch-failure recovery.**  A ``FetchFailedError`` from a
+      shuffle read names its producing shuffle; the scheduler
+      invalidates that shuffle's map outputs, re-runs just the
+      producing map stage, and then re-runs the fetching task — without
+      consuming its plain-retry budget (bounded by
+      ``spark.blaze.stage.maxAttempts``).
+    - **Terminal errors.**  Exhausted budgets raise
+      :class:`TaskRetriesExhausted` naming the stage/task/attempts with
+      the last cause chained; non-retryable failures (cancellation,
+      assertion/engine bugs) propagate immediately.
+
+    Attempt/retry/fetch-failure counters accumulate on ``metrics``
+    (default: a fresh node published as ``LAST_RUN_METRICS``):
+    ``task_attempts``, ``task_retries``, ``task_timeouts``,
+    ``fetch_failures``, ``map_stage_reruns``."""
+    from ..serde import from_proto
+    from ..serde.to_proto import STAGED_RIDS
+    from .retry import (
+        FETCH_FAILED, RETRY, RetryPolicy, TaskRetriesExhausted,
+        TaskTimeoutError, classify,
+    )
+
+    policy = RetryPolicy.from_conf()
+    if max_task_attempts is not None:
+        policy = policy.with_max_attempts(max_task_attempts)
+    metrics = metrics or MetricNode()
+    global LAST_RUN_METRICS
+    LAST_RUN_METRICS = metrics
+    sched_m = metrics.metrics
 
     n_maps: Dict[int, int] = {}
     bcast_blobs: Dict[int, List[bytes]] = {}
+    map_stage_by_shuffle: Dict[int, Stage] = {
+        s.shuffle_id: s for s in stages if s.kind == "map"
+    }
 
     def ipc_readers(plan: ExecNode, prefix: str) -> List[IpcReaderExec]:
         out: List[IpcReaderExec] = []
@@ -271,23 +321,7 @@ def run_stages(
         walk(plan)
         return out
 
-    from ..serde.to_proto import STAGED_RIDS
-
-    # AQE-style dynamic join selection (runtime/adaptive.py, opt-in):
-    # adaptive broadcast ids start after the planner-assigned ones
-    adaptive_on = bool(conf.ADAPTIVE_JOIN_ENABLE.get())
-    if adaptive_on:
-        from .adaptive import maybe_rewrite_stage
-
-        next_adaptive_bid = [
-            max((s.broadcast_id for s in stages
-                 if s.broadcast_id is not None), default=-1) + 1
-        ]
-
-    for stage in stages:
-        if adaptive_on:
-            maybe_rewrite_stage(stage, manager, n_maps, bcast_blobs,
-                                next_adaptive_bid)
+    def make_registrar(stage: Stage):
         readers = ipc_readers(stage.plan, "shuffle_")
         breaders = ipc_readers(stage.plan, "broadcast_")
 
@@ -305,6 +339,138 @@ def run_stages(
                 keys.append(key)
             return keys
 
+        return register_stage_readers
+
+    def build_attempt_td(stage: Stage, t: int, attempt: int):
+        """Fresh TaskDefinition per attempt (serialization stages fresh
+        one-shot resources); returns (td bytes, staged resource ids) so
+        a failed attempt doesn't leak them."""
+        staged: List[str] = []
+        token = STAGED_RIDS.set(staged)
+        try:
+            _, td = build_task(stage, manager, t, attempt)
+        finally:
+            STAGED_RIDS.reset(token)
+        return td, staged
+
+    def drain(stage: Stage, t: int, it, out: List) -> None:
+        """Collect a task's output, enforcing the cooperative per-task
+        timeout between batches."""
+        deadline = policy.deadline()
+        for b in it:
+            out.append(b)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TaskTimeoutError(
+                    f"task {t} of stage {stage.stage_id} exceeded "
+                    f"{policy.task_timeout}s"
+                )
+
+    def regenerate_map_stage(mstage: Stage) -> None:
+        """Fetch-failure recovery: drop the shuffle's committed map
+        outputs and re-run just the producing map stage (≙ DAGScheduler
+        resubmitting the parent stage on FetchFailed)."""
+        sched_m.add("map_stage_reruns", 1)
+        manager.invalidate(mstage.shuffle_id)
+        run_stage_tasks(mstage)
+        n_maps[mstage.shuffle_id] = mstage.n_tasks
+
+    def handle_failure(stage: Stage, t: int, exc: BaseException,
+                       attempt: int, regens: int):
+        """Classify a failed attempt and perform the recovery
+        bookkeeping; returns the (attempt, regens) counters for the
+        next try, or raises when the failure is terminal."""
+        action = classify(exc)
+        if action == FETCH_FAILED:
+            sched_m.add("fetch_failures", 1)
+            sid = exc.shuffle_id
+            mstage = map_stage_by_shuffle.get(sid) if sid is not None else None
+            if mstage is not None:
+                regens += 1
+                if regens > policy.max_stage_regens:
+                    raise TaskRetriesExhausted(
+                        stage.stage_id, t, attempt + 1, exc
+                    ) from exc
+                regenerate_map_stage(mstage)
+                return attempt, regens  # doesn't consume the retry budget
+            # producer unresolvable (e.g. a broadcast read, whose blobs
+            # re-register from the driver's copy every attempt): a
+            # plain re-run can still succeed, so fall through to RETRY
+            action = RETRY
+        if action == RETRY:
+            attempt += 1
+            if attempt >= policy.max_attempts:
+                raise TaskRetriesExhausted(
+                    stage.stage_id, t, attempt, exc
+                ) from exc
+            sched_m.add("task_retries", 1)
+            if isinstance(exc, TaskTimeoutError):
+                sched_m.add("task_timeouts", 1)
+            policy.sleep_before_retry(stage.stage_id, t, attempt - 1)
+            return attempt, regens
+        raise exc  # FATAL
+
+    def run_task_attempts(stage: Stage, t: int, register) -> List:
+        """One non-result task under the retry policy; returns its
+        (side-effect-only, usually empty) batch list."""
+        attempt = 0
+        regens = 0
+        while True:
+            # (re)register this task's reduce blocks — pops on read, so
+            # every attempt gets a fresh registration (broadcast blobs
+            # re-register too: every task re-reads all source blobs)
+            block_keys = register(t)
+            td, staged = build_attempt_td(stage, t, attempt)
+            sched_m.add("task_attempts", 1)
+            try:
+                batches: List = []
+                drain(stage, t,
+                      from_proto.run_task(td, task_attempt_id=attempt),
+                      batches)
+                return batches
+            except BaseException as exc:
+                for key in staged + block_keys:
+                    RESOURCES.discard(key)
+                attempt, regens = handle_failure(stage, t, exc, attempt, regens)
+
+    def run_result_task(stage: Stage, t: int, register):
+        """Result task: stream batches straight through (buffering
+        would pin the whole partition).  The retry window covers every
+        failure BEFORE the first output batch — which is where fetch
+        failures, decode errors, and (for blocking plans like aggs and
+        sorts) compute failures surface; once a batch has been yielded
+        to the caller the attempt is not replayable and the failure is
+        terminal."""
+        attempt = 0
+        regens = 0
+        while True:
+            block_keys = register(t)
+            td, staged = build_attempt_td(stage, t, attempt)
+            sched_m.add("task_attempts", 1)
+            yielded = False
+            try:
+                deadline = policy.deadline()
+                for b in from_proto.run_task(td, task_attempt_id=attempt):
+                    # deadline checked on the PULLED batch before it is
+                    # surfaced, so a timed-out attempt stays replayable
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise TaskTimeoutError(
+                            f"task {t} of stage {stage.stage_id} exceeded "
+                            f"{policy.task_timeout}s"
+                        )
+                    yielded = True
+                    yield b
+                return
+            except BaseException as exc:
+                for key in staged + block_keys:
+                    RESOURCES.discard(key)
+                if yielded:
+                    raise  # mid-stream: output already delivered
+                attempt, regens = handle_failure(stage, t, exc, attempt, regens)
+
+    def run_stage_tasks(stage: Stage) -> None:
+        """Run every task of a non-result stage (also the fetch-recovery
+        re-run path for map stages)."""
+        register = make_registrar(stage)
         from ..parallel.shuffle import RangePartitioning
 
         part = getattr(stage, "_partitioning", None)
@@ -313,41 +479,43 @@ def run_stages(
             and isinstance(part, RangePartitioning)
             and part.boundaries is None
         ):
-            part.boundaries = _compute_range_boundaries(stage, register_stage_readers)
-        for t in range(stage.n_tasks):
+            # the driver-side sampling pass reads the stage's upstream
+            # shuffles too, so it gets the same retry/fetch-recovery
+            # treatment as a task (t = -1 marks the boundary pass in
+            # terminal errors)
             attempt = 0
+            regens = 0
             while True:
-                # (re)register this task's reduce blocks — pops on
-                # read, so every attempt gets a fresh registration
-                # (broadcast blobs re-register too: every task re-reads
-                # all source blobs via build partition 0)
-                block_keys = register_stage_readers(t)
-                # fresh TaskDefinition per attempt (serialization
-                # stages fresh one-shot resources); track the staged
-                # ids so a failed attempt doesn't leak them
-                staged: List[str] = []
-                token = STAGED_RIDS.set(staged)
                 try:
-                    _, td = build_task(stage, manager, t, attempt)
-                finally:
-                    STAGED_RIDS.reset(token)
-                try:
-                    if stage.kind == "result" and max_task_attempts <= 1:
-                        # no-retry default: stream straight through
-                        # (buffering would pin the whole partition)
-                        yield from run_task(td)
-                        batches = None
-                    else:
-                        batches = list(run_task(td))
+                    part.boundaries = _compute_range_boundaries(stage, register)
                     break
-                except Exception:
-                    for key in staged + block_keys:
-                        RESOURCES.discard(key)
-                    attempt += 1
-                    if attempt >= max_task_attempts:
-                        raise
-            if stage.kind == "result" and batches:
-                yield from batches
+                except BaseException as exc:
+                    attempt, regens = handle_failure(stage, -1, exc,
+                                                     attempt, regens)
+        for t in range(stage.n_tasks):
+            run_task_attempts(stage, t, register)
+
+    # AQE-style dynamic join selection (runtime/adaptive.py, opt-in):
+    # adaptive broadcast ids start after the planner-assigned ones
+    adaptive_on = bool(conf.ADAPTIVE_JOIN_ENABLE.get())
+    if adaptive_on:
+        from .adaptive import maybe_rewrite_stage
+
+        next_adaptive_bid = [
+            max((s.broadcast_id for s in stages
+                 if s.broadcast_id is not None), default=-1) + 1
+        ]
+
+    for stage in stages:
+        if adaptive_on:
+            maybe_rewrite_stage(stage, manager, n_maps, bcast_blobs,
+                                next_adaptive_bid)
+        if stage.kind == "result":
+            register = make_registrar(stage)
+            for t in range(stage.n_tasks):
+                yield from run_result_task(stage, t, register)
+            continue
+        run_stage_tasks(stage)
         if stage.kind == "map":
             n_maps[stage.shuffle_id] = stage.n_tasks
         elif stage.kind == "broadcast":

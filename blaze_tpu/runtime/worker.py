@@ -12,9 +12,21 @@ Job spec (JSON file, path in argv[1]):
 
     {"task_def": "<base64 TaskDefinition bytes>",
      "partition": N,
+     "attempt": 0,
      "shuffle_root": "/dir/shared/across/workers",
      "readers": [{"resource_id": "shuffle_7", "shuffle_id": 7, "n_maps": 3}],
      "output": "/path/result.frames" | null}
+
+Crash-safety contract with the driver: the result file is written to
+``<output>.inprogress`` and renamed into place only after the plan
+drains completely, so a worker that dies mid-task (nonzero exit, OOM
+kill, injected fault) leaves either nothing or a complete file — never
+a silently-truncated frame sequence.  :func:`run_worker_with_retry` is
+the driver half: it spawns the worker, detects nonzero exit / missing
+output, and re-attempts under the task retry policy with a fresh
+attempt id (fault injection via ``BLAZE_FAULTS_SPEC`` reaches the
+worker through the environment; attempt-gated specs — ``@a0`` — make a
+crashed first attempt recover deterministically).
 
 Used by the multi-process testenv suite (tests/test_testenv.py) — the
 repo's analogue of the reference's ``dev/testenv`` pseudo-distributed
@@ -50,6 +62,7 @@ def main(spec_path: str) -> int:
     with open(spec_path) as f:
         spec = json.load(f)
     partition = int(spec["partition"])
+    attempt = int(spec.get("attempt", 0))
     if spec.get("readers"):
         mgr = LocalShuffleManager(spec["shuffle_root"])
         for r in spec["readers"]:
@@ -60,15 +73,92 @@ def main(spec_path: str) -> int:
     td = base64.b64decode(spec["task_def"])
     out_path = spec.get("output")
     if out_path:
-        with open(out_path, "wb") as f:
-            for batch in run_task(td):
+        # write-then-rename: a crashed attempt leaves no final file,
+        # so the driver's partial-output detection is just existence
+        tmp = out_path + ".inprogress"
+        with open(tmp, "wb") as f:
+            for batch in run_task(td, task_attempt_id=attempt):
                 frame = serialize_batch(batch)
                 f.write(struct.pack("<I", len(frame)))
                 f.write(frame)
+        os.replace(tmp, out_path)
     else:
-        for _ in run_task(td):
+        for _ in run_task(td, task_attempt_id=attempt):
             pass
     return 0
+
+
+def run_worker_with_retry(
+    spec: dict,
+    spec_dir: str,
+    tag: str,
+    max_attempts: int | None = None,
+    env: dict | None = None,
+    timeout: float = 300.0,
+):
+    """Driver-side fault-tolerant worker launch (the testenv analogue
+    of the in-process scheduler's task retry loop).
+
+    Spawns ``python -m blaze_tpu.runtime.worker`` on ``spec`` and
+    re-attempts — with a fresh attempt id in the spec, so attempt-gated
+    fault schedules and TaskContext attempt ids stay truthful — when
+    the process exits nonzero OR the promised output file is missing
+    (a worker killed before the atomic rename).  Raises
+    ``TaskRetriesExhausted`` after the budget, naming the last exit
+    status.  Returns the completed attempt number.
+    """
+    import os
+    import subprocess
+
+    from .retry import RetryPolicy, TaskRetriesExhausted
+
+    policy = RetryPolicy.from_conf()
+    if max_attempts is not None:
+        policy = policy.with_max_attempts(max_attempts)
+    run_env = dict(os.environ)
+    if env:
+        run_env.update(env)
+    run_env.setdefault("JAX_PLATFORMS", "cpu")
+
+    last_failure: Exception | None = None
+    for attempt in range(policy.max_attempts):
+        spec_attempt = dict(spec, attempt=attempt)
+        spec_path = os.path.join(spec_dir, f"spec_{tag}_a{attempt}.json")
+        with open(spec_path, "w") as f:
+            json.dump(spec_attempt, f)
+        stderr_tail = ""
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "blaze_tpu.runtime.worker", spec_path],
+                env=run_env,
+                capture_output=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired as te:
+            # a wedged worker is killed by subprocess.run; treat it as
+            # one failed attempt like any crash
+            reason = f"hung past {timeout}s and was killed"
+            if te.stderr:
+                stderr_tail = te.stderr.decode(errors="replace")[-500:]
+        else:
+            out_path = spec.get("output")
+            if proc.returncode == 0 and (not out_path or os.path.exists(out_path)):
+                return attempt
+            reason = (
+                f"exit status {proc.returncode}"
+                if proc.returncode != 0
+                else "worker exited 0 but produced no committed output"
+            )
+            stderr_tail = proc.stderr.decode(errors="replace")[-500:]
+        last_failure = RuntimeError(
+            f"worker attempt {attempt} failed ({reason}): " + stderr_tail
+        )
+        if attempt + 1 < policy.max_attempts:  # no sleep after the last one
+            policy.sleep_before_retry(0, int(spec.get("partition", 0)), attempt)
+    raise TaskRetriesExhausted(
+        0, int(spec.get("partition", 0)), policy.max_attempts,
+        last_failure or RuntimeError("no attempts ran"),
+    )
 
 
 if __name__ == "__main__":

@@ -382,10 +382,13 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
     raise NotImplementedError(f"from_proto node {kind}")
 
 
-def run_task(task_def_bytes: bytes):
+def run_task(task_def_bytes: bytes, task_attempt_id: int = 0):
     """Decode a TaskDefinition and drive its plan for its partition —
     the python mirror of the gateway's callNative entry
-    (≙ blaze/src/exec.rs:46-142)."""
+    (≙ blaze/src/exec.rs:46-142).  ``task_attempt_id`` threads the
+    scheduler's attempt counter into the TaskContext (and the fault
+    injector), so retried attempts are distinguishable at every site."""
+    from ..runtime import faults
     from ..runtime.context import TaskContext
 
     td = pb.TaskDefinition()
@@ -393,11 +396,15 @@ def run_task(task_def_bytes: bytes):
     from ..ops.fusion import fuse_stages
     from ..ops.pruning import prune_columns
 
+    faults.hit("task.compute", attempt=task_attempt_id, detail=td.task_id)
     plan = prune_columns(fuse_stages(plan_from_proto(td.plan)))
     if _log.isEnabledFor(logging.DEBUG):
         # ≙ the reference's native plan display at task start
         # (blaze/src/exec.rs:101-106)
         _log.debug("task %s partition %d plan:\n%s",
                    td.task_id, td.partition, plan.tree_string())
-    ctx = TaskContext(td.partition, max(plan.num_partitions(), td.partition + 1))
+    ctx = TaskContext(
+        td.partition, max(plan.num_partitions(), td.partition + 1),
+        stage_id=td.stage_id, task_attempt_id=task_attempt_id,
+    )
     return plan.execute(td.partition, ctx)

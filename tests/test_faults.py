@@ -1,0 +1,498 @@
+"""Fault-tolerant stage execution under deterministic fault injection.
+
+≙ the recovery tiers the reference inherits from Spark (task retry,
+FetchFailedException -> map-stage regeneration, RSS commit/abort) —
+here proven in-tree with the seeded injection registry
+(runtime/faults.py): every scenario injects a failure at a named site,
+asserts the query recovers to a result identical to the fault-free
+run, and checks the retry/fetch counters in the scheduler metrics.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.batch import batch_from_pydict
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.parallel.shuffle import (
+    HashPartitioning, IpcReaderExec, LocalShuffleManager,
+    ShuffleRepartitioner,
+)
+from blaze_tpu.runtime import faults
+from blaze_tpu.runtime.context import RESOURCES, TaskContext
+from blaze_tpu.runtime.metrics import MetricNode, MetricsSet
+from blaze_tpu.runtime.retry import (
+    FETCH_FAILED, RETRY, FetchFailedError, RetryPolicy, TaskRetriesExhausted,
+    classify,
+)
+from blaze_tpu.runtime.scheduler import run_stages, split_stages
+from blaze_tpu.schema import DataType, Field, Schema
+from blaze_tpu.spark import BlazeSparkSession
+
+import spark_fixtures as F
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Deterministic, sleep-free fault runs; always clear the spec."""
+    conf.FAULTS_SPEC.set("")
+    conf.TASK_RETRY_BACKOFF.set(0.0)
+    faults.reset()
+    yield
+    conf.FAULTS_SPEC.set("")
+    conf.TASK_RETRY_BACKOFF.set(0.1)
+    conf.TASK_TIMEOUT.set(0.0)
+    faults.reset()
+
+
+def _inject(spec: str) -> None:
+    conf.FAULTS_SPEC.set(spec)
+    faults.reset()
+
+
+# ------------------------------------------------------ registry unit tests
+
+def test_spec_parse_format_roundtrip():
+    rules = faults.parse_spec("shuffle.fetch@2,task.compute@1@a0")
+    assert rules == [("shuffle.fetch", 2, None), ("task.compute", 1, 0)]
+    assert faults.parse_spec(faults.format_spec(rules)) == rules
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.parse_spec("bogus.site@1")
+    with pytest.raises(ValueError, match="bad fault spec"):
+        faults.parse_spec("task.compute")
+
+
+def test_random_spec_deterministic():
+    assert faults.random_spec(42) == faults.random_spec(42)
+    assert faults.random_spec(42) != faults.random_spec(43)
+    for site, _, attempt in faults.parse_spec(faults.random_spec(42)):
+        assert site in faults.SITES
+        assert attempt == 0  # recoverable by construction
+
+
+def test_injector_nth_hit_and_attempt_gate():
+    inj = faults.FaultInjector(faults.parse_spec("task.compute@3@a0"))
+    inj.hit("task.compute", attempt=0)
+    inj.hit("task.compute", attempt=0)
+    with pytest.raises(faults.InjectedFault, match="hit 3"):
+        inj.hit("task.compute", attempt=0)
+    # 4th hit (e.g. the retried attempt) passes: single-fire
+    inj.hit("task.compute", attempt=1)
+    # attempt gate: rule for a0 never fires for attempt 1
+    inj2 = faults.FaultInjector(faults.parse_spec("task.compute@1@a0"))
+    inj2.hit("task.compute", attempt=1)  # hit 1, wrong attempt -> no raise
+    inj2.hit("task.compute", attempt=0)  # hit 2 -> rule already passed
+
+
+def test_classify_and_backoff_determinism():
+    assert classify(FetchFailedError("shuffle_3", 0)) == FETCH_FAILED
+    assert classify(RuntimeError("x")) == RETRY
+    assert classify(AssertionError()) == "fatal"
+    assert classify(NotImplementedError()) == "fatal"
+    assert FetchFailedError("shuffle_7", 1).shuffle_id == 7
+    assert FetchFailedError("broadcast_7", 1).shuffle_id is None
+    p = RetryPolicy(max_attempts=4, backoff_base=0.1)
+    assert p.backoff(1, 2, 0) == p.backoff(1, 2, 0)  # deterministic
+    assert p.backoff(1, 2, 1) != p.backoff(1, 2, 0)  # attempt-keyed
+    assert RetryPolicy(backoff_base=0.0).backoff(0, 0, 0) == 0.0
+
+
+def test_ipc_reader_missing_block_raises_fetch_failed():
+    schema = Schema([Field("x", DataType.int64())])
+    reader = IpcReaderExec(schema, "shuffle_9", 1)
+    RESOURCES.put("shuffle_9.0", [("/nonexistent/block.data", 0, 128)])
+    with pytest.raises(FetchFailedError) as ei:
+        list(reader.execute(0, TaskContext(0, 1)))
+    assert ei.value.shuffle_id == 9
+
+
+# ------------------------------------------------- scheduler recovery paths
+
+from test_spark_convert import make_session, q6_like_plan  # noqa: E402
+
+
+def _scheduler_run(sess, plan_json, metrics=None):
+    from blaze_tpu.batch import batch_to_pydict
+
+    plan = sess.plan(plan_json)
+    stages, manager = split_stages(plan)
+    out = {f.name: [] for f in stages[-1].plan.schema.fields}
+    for b in run_stages(stages, manager, metrics=metrics):
+        d = batch_to_pydict(b)
+        for k in out:
+            out[k].extend(d[k])
+    return out, manager
+
+
+def test_recovers_from_task_compute_fault():
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    _inject("task.compute@2@a0")  # crash the 2nd task's first attempt
+    m = MetricNode()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("task_retries") == 1
+    assert m.metrics.get("task_attempts") >= 2
+
+
+def test_recovers_from_shuffle_fetch_fault_by_map_stage_rerun():
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    _inject("shuffle.fetch@1@a0")  # first reduce-side block read fails
+    m = MetricNode()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("fetch_failures") == 1
+    assert m.metrics.get("map_stage_reruns") == 1
+    # the regenerated map stage re-ran its tasks on top of the originals
+    assert m.metrics.get("task_attempts") > 4
+
+
+def test_recovers_from_shuffle_write_fault_without_partial_commit():
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    _inject("shuffle.write@1")  # first map task's commit fails
+    m = MetricNode()
+    got, manager = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("task_retries") == 1
+    # abort left no torn temp files behind in the shuffle root
+    leftovers = [f for f in os.listdir(manager.root) if "inprogress" in f]
+    assert leftovers == []
+
+
+def test_exhausted_retries_surface_site_stage_task():
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    # every attempt of the first task fails (attempt-gated so the hit
+    # counter tracks the retry loop exactly)
+    _inject("task.compute@1@a0,task.compute@2@a1,task.compute@3@a2")
+    plan = sess.plan(plan_json)
+    stages, manager = split_stages(plan)
+    with pytest.raises(TaskRetriesExhausted) as ei:
+        list(run_stages(stages, manager, max_task_attempts=3))
+    msg = str(ei.value)
+    assert "stage 0" in msg and "task 0" in msg and "3 attempt" in msg
+    assert "task.compute" in msg  # terminal error names the failing site
+    assert isinstance(ei.value.__cause__, faults.InjectedFault)
+
+
+def test_range_boundary_pass_recovers_from_fetch_failure():
+    """The driver-side range-boundary sampling pass reads upstream
+    shuffle blocks too; a fetch failure there must trigger the same
+    map-stage regeneration as a task-side failure, not abort the
+    query."""
+    sess, _ = make_session()
+    s = F.scan("lineitem", [F.attr("l_extendedprice", 2)])
+    ex1 = F.shuffle(F.hash_partitioning([F.attr("l_extendedprice", 2)], 3), s)
+    pr = F.project([F.attr("l_extendedprice", 2)], ex1)
+    ex2 = F.shuffle(
+        F.range_partitioning([F.sort_order(F.attr("l_extendedprice", 2))], 3),
+        pr,
+    )
+    srt = F.sort([F.sort_order(F.attr("l_extendedprice", 2))], ex2)
+    plan_json = F.flatten(srt)
+    baseline, _ = _scheduler_run(sess, plan_json)
+    assert baseline["l_extendedprice"] == sorted(baseline["l_extendedprice"])
+
+    _inject("shuffle.fetch@1@a0")  # first fetch = the boundary pass read
+    m = MetricNode()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("fetch_failures") >= 1
+    assert m.metrics.get("map_stage_reruns") >= 1
+
+
+def test_unresolvable_fetch_failure_falls_back_to_plain_retry():
+    """A FetchFailedError whose producer can't be resolved (e.g. a
+    broadcast read) must consume the plain retry budget instead of
+    being instantly terminal — the blobs re-register every attempt, so
+    a re-run can succeed."""
+    from blaze_tpu.serde import from_proto
+
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    real_run_task = from_proto.run_task
+    fails = {"n": 1}
+
+    def flaky(td, **kw):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise FetchFailedError("broadcast_0", 0)
+        return real_run_task(td, **kw)
+
+    from_proto.run_task = flaky
+    try:
+        m = MetricNode()
+        got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    finally:
+        from_proto.run_task = real_run_task
+    assert got == baseline
+    assert fails["n"] == 0
+    assert m.metrics.get("fetch_failures") == 1
+    assert m.metrics.get("task_retries") == 1
+    assert m.metrics.get("map_stage_reruns") == 0
+
+
+def test_task_timeout_is_retried():
+    import time as _time
+
+    from blaze_tpu.serde import from_proto
+
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    conf.TASK_TIMEOUT.set(0.2)
+    real_run_task = from_proto.run_task
+    # the timeout is checked between OUTPUT batches, so drag the result
+    # task (call #4 after the 3 map tasks) — map tasks yield nothing
+    state = {"calls": 0, "dragged": 0}
+
+    def slow_run_task(td, **kw):
+        gen = real_run_task(td, **kw)
+        state["calls"] += 1
+        if state["calls"] == 4:
+            state["dragged"] += 1
+
+            def dragging():
+                for b in gen:
+                    _time.sleep(0.3)  # trip the cooperative deadline
+                    yield b
+
+            return dragging()
+        return gen
+
+    from_proto.run_task = slow_run_task
+    try:
+        m = MetricNode()
+        got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    finally:
+        from_proto.run_task = real_run_task
+    assert got == baseline
+    assert state["dragged"] == 1
+    assert m.metrics.get("task_timeouts") == 1
+
+
+# --------------------------------------------------------- rss commit/abort
+
+def _lineitem_rss_node(writer_rid: str):
+    from blaze_tpu.exprs import col
+    from blaze_tpu.parallel.rss import RssShuffleWriterExec
+
+    rng = np.random.RandomState(11)
+    schema = Schema([
+        Field("l_orderkey", DataType.int64()),
+        Field("l_extendedprice", DataType.int64()),
+    ])
+    data = {
+        "l_orderkey": [int(v) for v in rng.randint(1, 200, 300)],
+        "l_extendedprice": [int(v) for v in rng.randint(100, 9999, 300)],
+    }
+    scan = MemoryScanExec([[batch_from_pydict(data, schema)]], schema)
+    part = HashPartitioning([col("l_orderkey")], 3)
+    return RssShuffleWriterExec(scan, part, writer_rid)
+
+
+def test_rss_push_fault_aborts_then_retry_commits_identically():
+    from blaze_tpu.parallel.rss import LocalRssWriter
+
+    node = _lineitem_rss_node("rss_flt")
+
+    # fault-free reference pushes
+    ref = LocalRssWriter()
+    RESOURCES.put("rss_flt.0", ref)
+    for _ in node.execute(0, TaskContext(0, 1)):
+        pass
+    assert ref.closed and ref.partitions
+
+    # attempt 0 dies mid-push: the writer must ABORT — no partial
+    # pushes may ever count toward the reduce barrier
+    _inject("rss.push@2@a0")
+    w0 = LocalRssWriter()
+    RESOURCES.put("rss_flt.0", w0)
+    with pytest.raises(faults.InjectedFault):
+        for _ in node.execute(0, TaskContext(0, 1, task_attempt_id=0)):
+            pass
+    assert w0.closed and w0.partitions == {}  # aborted, nothing committed
+
+    # retry (fresh attempt id, fresh writer) commits bit-identically
+    w1 = LocalRssWriter()
+    RESOURCES.put("rss_flt.0", w1)
+    for _ in node.execute(0, TaskContext(0, 1, task_attempt_id=1)):
+        pass
+    assert w1.closed
+    assert w1.partitions == ref.partitions
+
+
+# ------------------------------------------------------ spill / write abort
+
+def test_spill_write_fault_aborts_without_losing_rows(tmp_path):
+    schema = Schema([Field("x", DataType.int64())])
+    rep = ShuffleRepartitioner(schema, 1, MetricsSet())
+    n = 1000
+    b = batch_from_pydict({"x": list(range(n))}, schema).to_host()
+    rep.insert_sorted(b, np.array([n]))
+    assert rep._buffered_bytes > 0
+
+    _inject("spill.write@1")
+    with pytest.raises(faults.InjectedFault):
+        rep.spill()
+    # spill-abort: buffers intact, no phantom spill recorded
+    assert rep._buffered_bytes > 0
+    assert rep._spills == []
+
+    _inject("")  # clear; write_output must still see every row
+    data, index = str(tmp_path / "s.data"), str(tmp_path / "s.index")
+    lengths = rep.write_output(data, index)
+    assert sum(lengths) > 0
+    from blaze_tpu.io.batch_serde import deserialize_batch
+    from blaze_tpu.io.ipc_compression import IpcFrameReader
+
+    with open(index, "rb") as f:
+        raw = f.read()
+    offsets = struct.unpack(f"<{len(raw)//8}Q", raw)
+    with open(data, "rb") as f:
+        payloads = list(IpcFrameReader(f, offsets[-1]))
+    rows = sum(deserialize_batch(p, schema).num_rows for p in payloads)
+    assert rows == n
+
+
+def test_shuffle_write_fault_commits_nothing(tmp_path):
+    """A failed map attempt leaves neither .data nor .index, so the
+    reduce barrier (index existence) can never see partial output."""
+    from blaze_tpu.parallel.shuffle import ShuffleWriterExec, SinglePartitioning
+
+    schema = Schema([Field("x", DataType.int64())])
+    scan = MemoryScanExec(
+        [[batch_from_pydict({"x": list(range(64))}, schema)]], schema
+    )
+    manager = LocalShuffleManager(str(tmp_path))
+    data, index = manager.map_output_paths(0, 0)
+    node = ShuffleWriterExec(scan, SinglePartitioning(), data, index)
+
+    _inject("shuffle.write@1")
+    with pytest.raises(faults.InjectedFault):
+        for _ in node.execute(0, TaskContext(0, 1)):
+            pass
+    assert not os.path.exists(data) and not os.path.exists(index)
+    assert manager.reduce_blocks(0, 1, 0) == []  # barrier sees no commit
+
+    _inject("")
+    node2 = ShuffleWriterExec(scan, SinglePartitioning(), data, index)
+    for _ in node2.execute(0, TaskContext(0, 1)):
+        pass
+    assert os.path.exists(data) and os.path.exists(index)
+    assert manager.invalidate(0) >= 2  # fetch-recovery cleanup hook
+
+
+# ------------------------------------------------- TPC-H end-to-end matrix
+
+@pytest.mark.slow
+def test_tpch_q1_bit_identical_under_fault_matrix():
+    """Acceptance: a multi-stage TPC-H query under injected
+    shuffle-fetch failure (upstream map-stage re-run), map-task crash,
+    and shuffle-write failure recovers to results bit-identical to the
+    fault-free run, with the recovery visible in metrics."""
+    from blaze_tpu.batch import batch_to_pydict
+    from blaze_tpu.tpch import TPCH_SCHEMAS, build_query
+    from blaze_tpu.tpch.datagen import generate_all, table_to_batches
+
+    data = generate_all(0.001)
+    scans = {
+        name: MemoryScanExec(
+            table_to_batches(data[name], TPCH_SCHEMAS[name], 2, batch_rows=4096),
+            TPCH_SCHEMAS[name],
+        )
+        for name in TPCH_SCHEMAS
+    }
+
+    def run(metrics=None):
+        plan = build_query("q1", scans, 2)
+        stages, manager = split_stages(plan)
+        out = {f.name: [] for f in stages[-1].plan.schema.fields}
+        for b in run_stages(stages, manager, metrics=metrics):
+            d = batch_to_pydict(b)
+            for k in out:
+                out[k].extend(d[k])
+        return out
+
+    baseline = run()
+    scenarios = {
+        "shuffle.fetch@1@a0": ("fetch_failures", "map_stage_reruns"),
+        "task.compute@2@a0": ("task_retries",),
+        "shuffle.write@1": ("task_retries",),
+    }
+    for spec, counters in scenarios.items():
+        _inject(spec)
+        m = MetricNode()
+        assert run(metrics=m) == baseline, f"mismatch under {spec}"
+        for c in counters:
+            assert m.metrics.get(c) >= 1, f"{c} not counted under {spec}"
+
+
+# ---------------------------------------------------- worker-process retry
+
+@pytest.mark.slow
+def test_worker_process_crash_is_retried(tmp_path):
+    """Testenv tier: a worker process that dies on its first attempt
+    (nonzero exit, no committed output file) is re-launched by the
+    driver with a fresh attempt id and succeeds."""
+    import base64
+
+    from blaze_tpu.io.batch_serde import deserialize_batch
+    from blaze_tpu.ops import ParquetSinkExec
+    from blaze_tpu.runtime.scheduler import build_task
+    from blaze_tpu.runtime.worker import run_worker_with_retry
+
+    schema = Schema([Field("x", DataType.int64())])
+    src = MemoryScanExec(
+        [[batch_from_pydict({"x": list(range(100))}, schema)]], schema
+    )
+    pq = str(tmp_path / "in.parquet")
+    sink = ParquetSinkExec(src, pq)
+    for _ in sink.execute(0, TaskContext(0, 1)):
+        pass
+    pq = sink.written_files[0] if sink.written_files else pq
+
+    from blaze_tpu.ops import ParquetScanExec
+
+    plan = ParquetScanExec([[pq]], schema)
+    stages, manager = split_stages(plan, LocalShuffleManager(str(tmp_path / "sh")))
+    _, td = build_task(stages[-1], manager, 0)
+    out = str(tmp_path / "r.frames")
+    spec = {
+        "task_def": base64.b64encode(td).decode(),
+        "partition": 0,
+        "shuffle_root": manager.root,
+        "readers": [],
+        "output": out,
+    }
+    env = {
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BLAZE_FAULTS_SPEC": "task.compute@1@a0",  # kill the 1st attempt
+        "BLAZE_TASK_RETRYBACKOFF": "0",
+    }
+    winning = run_worker_with_retry(spec, str(tmp_path), "t0",
+                                    max_attempts=3, env=env)
+    assert winning == 1  # first attempt crashed, second committed
+    raw = open(out, "rb").read()
+    vals, off = [], 0
+    while off < len(raw):
+        (ln,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        b = deserialize_batch(raw[off : off + ln], schema)
+        off += ln
+        vals.extend(int(v) for v in np.asarray(b.columns[0].data)[: b.num_rows])
+    assert vals == list(range(100))

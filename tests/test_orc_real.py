@@ -620,6 +620,28 @@ def test_pyarrow_compound_timestamp_differential(tmp_path):
     assert d["lt"] == lt_vals
 
 
+def test_pyarrow_repeated_pre_epoch_timestamp_differential(tmp_path):
+    """(ADVICE r5) >=3 consecutive identical pre-epoch fractional
+    timestamps hit RLEv2 SHORT_REPEAT in the secondary (packed-nanos)
+    stream, whose raw uint64 image of a negative int64 used to raise
+    OverflowError on slice-assign in read_stripe.  Mixed distinct
+    values alongside exercise the DELTA-base wrap too."""
+    cases = [
+        [-1_500_000] * 6,                                   # SHORT_REPEAT
+        [-1_500_000, -2_500_000, -3_500_000, -1, -999_000,  # DELTA/DIRECT
+         -1_500_000, -1_500_000, -1_500_000],
+    ]
+    for i, us_vals in enumerate(cases):
+        table = pa.table({"ts": pa.array(us_vals, pa.timestamp("us"))})
+        path = str(tmp_path / f"pa_preepoch_{i}.orc")
+        paorc.write_table(table, path, compression="zlib")
+        schema = Schema([Field("ts", DataType.timestamp())])
+        scan = OrcScanExec([[path]], schema, batch_rows=4)
+        d = batch_to_pydict(concat_batches(
+            [b for b in scan.execute(0, TaskContext(0, 1))]))
+        assert d["ts"] == us_vals
+
+
 def test_writer_compound_decimal_finer_than_scale_is_gated(tmp_path):
     """(review finding) Decimal('1.005') into DECIMAL(10,2) must raise,
     not silently truncate to 1.00 — the writer mirrors the reader's

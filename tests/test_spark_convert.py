@@ -447,11 +447,11 @@ def test_scheduler_task_retry_recovers():
     real_run_task = from_proto.run_task
     fails = {"n": 2}  # fail the first two task attempts
 
-    def flaky_run_task(td):
+    def flaky_run_task(td, **kw):
         if fails["n"] > 0:
             fails["n"] -= 1
             raise RuntimeError("injected task failure")
-        return real_run_task(td)
+        return real_run_task(td, **kw)
 
     from_proto.run_task = flaky_run_task
     # run_stages resolves run_task at call time through the module
@@ -473,7 +473,7 @@ def test_scheduler_exhausted_retries_raise():
     plan = sess.plan(F.flatten(q6_like_plan()))
     stages, manager = split_stages(plan)
     real_run_task = from_proto.run_task
-    from_proto.run_task = lambda td: (_ for _ in ()).throw(RuntimeError("boom"))
+    from_proto.run_task = lambda td, **kw: (_ for _ in ()).throw(RuntimeError("boom"))
     try:
         with pytest.raises(RuntimeError):
             list(run_stages(stages, manager, max_task_attempts=2))
