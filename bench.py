@@ -162,8 +162,20 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
     jax.config.update("jax_enable_x64", True)
 
     from blaze_tpu.ops import MemoryScanExec
-    from blaze_tpu.ops.fusion import fuse_stages
-    from blaze_tpu.ops.pruning import prune_columns
+    from blaze_tpu.ops.fusion import optimize_plan
+    from blaze_tpu.runtime import dispatch
+    from blaze_tpu.runtime.kernel_cache import (
+        default_cache_dir, enable_persistent_cache,
+    )
+
+    # persistent XLA compile cache: conf/env dir, else the image-wide
+    # default (the SAME directory `--warmup` pre-warms) — a relaunched
+    # measurement child (watchdog stall path) then skips the
+    # multi-minute first compile instead of re-paying it on the chip
+    if not enable_persistent_cache():
+        d = default_cache_dir()
+        os.makedirs(d, exist_ok=True)
+        enable_persistent_cache(d)
     from blaze_tpu.runtime.context import TaskContext
     from blaze_tpu.schema import Schema
     from blaze_tpu.tpch.datagen import generate_table, table_to_batches
@@ -234,7 +246,7 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
             # REBUILD the plan each iteration: exchanges memoize their
             # map side per exec instance
             scans = {"lineitem": MemoryScanExec(parts, schema)}
-            plan = prune_columns(fuse_stages(build(scans, 1)))
+            plan = optimize_plan(build(scans, 1))
             out = []
             for p in range(plan.num_partitions()):
                 for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
@@ -245,11 +257,23 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
                 np.asarray(b.columns[0].data)
             return out
 
-        once()  # compile warmup
+        with dispatch.capture() as cold:
+            once()  # compile warmup
         t0 = time.perf_counter()
-        for _ in range(n_iters):
-            once()
-        return (time.perf_counter() - t0) / n_iters
+        with dispatch.capture() as warm:
+            for _ in range(n_iters):
+                once()
+        dt = (time.perf_counter() - t0) / n_iters
+        # per-iteration warm dispatch count + the cold compile bill:
+        # proves the whole-stage collapse inside the emitted line (and
+        # its cached:true replays) even when the fresh-measurement
+        # window is missed
+        stats = {
+            "dispatch_count": round(warm.get("xla_dispatches", 0) / n_iters, 1),
+            "warm_compiles": warm.get("xla_compiles", 0),
+            "compile_ms": cold.get("compile_ms", 0),
+        }
+        return dt, stats
 
     def with_retry(fn):
         for i in range(retries + 1):
@@ -271,7 +295,7 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
         return with_retry(attempt)
 
     q6_cols = ("l_quantity", "l_extendedprice", "l_discount", "l_shipdate")
-    rows6, dt6 = measure_query(q6, q6_cols, scale_q6)
+    rows6, (dt6, stats6) = measure_query(q6, q6_cols, scale_q6)
 
     r6 = rows6 / dt6
     # bytes actually touched by the q06 pipeline per row (5 referenced
@@ -286,6 +310,12 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
         "scale_q01": scale_q1,
         "iterations": 3,
         "backend": "tpu" if on_tpu else "cpu",
+        "dispatch_count": stats6["dispatch_count"],
+        "compile_ms": stats6["compile_ms"],
+        # nonzero = compiles happened INSIDE the timed loop (shape
+        # drift / stale persistent cache): the throughput number is
+        # then polluted by compile time and must not be trusted
+        "warm_compiles": stats6["warm_compiles"],
     }
     if extras:
         result.update(extras)
@@ -294,10 +324,13 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
 
     q1_cols = ("l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
                "l_discount", "l_tax", "l_shipdate")
-    rows1, dt1 = measure_query(q1, q1_cols, scale_q1)
+    rows1, (dt1, stats1) = measure_query(q1, q1_cols, scale_q1)
     r1 = rows1 / dt1
     result["q01_rows_per_sec"] = round(r1, 1)
     result["q01_vs_baseline"] = round(r1 / BLAZE_Q01_ROWS_PER_SEC_PER_NODE, 3)
+    result["q01_dispatch_count"] = stats1["dispatch_count"]
+    result["q01_compile_ms"] = stats1["compile_ms"]
+    result["q01_warm_compiles"] = stats1["warm_compiles"]
     # freshness marker: measured in THIS run (a cache-merged q01 keeps
     # its ORIGINAL stamp so consumers can tell fresh from carried-over)
     result["q01_measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -388,19 +421,29 @@ def _tpu_child(out_path: str) -> None:
         if prev is not None:
             if (result.get("q01_rows_per_sec") is None
                     and prev.get("q01_rows_per_sec") is not None):
-                result["q01_rows_per_sec"] = prev["q01_rows_per_sec"]
-                result["q01_vs_baseline"] = prev["q01_vs_baseline"]
+                # carry the WHOLE q01 half, dispatch observability
+                # included — a cached:true line must still prove the
+                # dispatch collapse (ISSUE 2 satellite)
+                for k in ("q01_rows_per_sec", "q01_vs_baseline",
+                          "q01_dispatch_count", "q01_compile_ms",
+                          "q01_warm_compiles"):
+                    if k in prev:
+                        result[k] = prev[k]
                 result["q01_measured_at"] = prev.get(
                     "q01_measured_at", prev.get("measured_at"))
             # best-of per half: a relaunched child (stalled-predecessor
             # path) re-measures q06 under whatever tunnel the day has;
-            # a weaker fresh q06 must not clobber a stronger cached one
+            # a weaker fresh q06 must not clobber a stronger cached one.
+            # The dispatch/compile counters travel WITH the half they
+            # measured — pairing prev's throughput with fresh counters
+            # would let a compile-polluted number masquerade as clean
             if (prev.get("backend") == "tpu"
                     and result.get("backend") == "tpu"
                     and prev.get("value", 0) > result.get("value", 0)):
                 for k in ("value", "vs_baseline", "bytes_per_sec",
                           "scale_q06", "tunnel_bytes_per_sec",
-                          "iterations", "measured_at"):
+                          "iterations", "measured_at", "dispatch_count",
+                          "compile_ms", "warm_compiles"):
                     if k in prev:
                         result[k] = prev[k]
         # per-pid tmp names: a watchdog child and a main-window child

@@ -13,15 +13,29 @@ Usage:
     python -m blaze_tpu tpch q6 q1 --scale 0.05
     python -m blaze_tpu tpcds q36 --scale 0.002 --parts 4 --scheduler
     python -m blaze_tpu tpch all --scale 0.01
+    python -m blaze_tpu --warmup            # compile-cache pre-warm + gate
     python -m blaze_tpu --chaos             # seeded fault-injection smoke
     python -m blaze_tpu tpch q1 --chaos --chaos-seed 42
+
+``--warmup`` populates the kernel and persistent XLA compile caches
+(``spark.blaze.xla.cacheDir`` / BLAZE_XLA_CACHEDIR, default
+``~/.cache/blaze_tpu/xla``) by running the listed queries (default q1
+q6) twice, fused + pruned exactly as run_task would, and GATES on the
+warm run: a second pass that triggers any fresh XLA compile exits
+nonzero.  Run once per image so the multi-minute first q01 compile is
+never paid inside a query; CI pairs it with the dispatch-budget
+regression test:
+
+    python -m blaze_tpu --warmup && \
+        pytest tests/test_dispatch_budget.py && python -m blaze_tpu --chaos
 
 ``--chaos`` is the CI-facing fault-tolerance gate: each query runs
 once fault-free through the stage scheduler, then again under a
 seed-derived random fault schedule (runtime/faults.py sites:
 shuffle fetch/write, task compute) with task retry and fetch-failure
 recovery enabled.  Exit is nonzero on any result mismatch or
-unrecovered failure, and the recovery counters are printed.
+unrecovered failure, and the recovery counters are printed (including
+the per-run ``xla_dispatches`` / ``xla_compiles`` observability).
 """
 
 from __future__ import annotations
@@ -129,6 +143,60 @@ def _rows_via_scheduler(plan):
     return sorted(zip(*[flat[n] for n in names])) if names else []
 
 
+def _warmup(suite: str, names, scale: float, n_parts: int,
+            cache_dir: str = "") -> int:
+    """Pre-warm the persistent XLA compile cache and gate on warm-run
+    recompiles (see module docstring)."""
+    import os
+
+    from . import conf
+    from .runtime import dispatch
+    from .runtime.kernel_cache import default_cache_dir, enable_persistent_cache
+
+    cache_dir = cache_dir or str(conf.XLA_CACHE_DIR.get() or "") or default_cache_dir()
+    os.makedirs(cache_dir, exist_ok=True)
+    enabled = enable_persistent_cache(cache_dir)
+    print(f"# warmup: persistent XLA cache "
+          f"{'at ' + cache_dir if enabled else 'DISABLED'}")
+
+    build_query, names, scans = _load_suite(suite, names, scale, n_parts)
+    if build_query is None:
+        return names
+
+    from .ops.fusion import optimize_plan
+    from .runtime.context import TaskContext
+
+    def run_once(name):
+        plan = optimize_plan(build_query(name, scans, n_parts))
+        rows = 0
+        for p in range(plan.num_partitions()):
+            for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+                rows += b.num_rows
+        return rows
+
+    failed = []
+    for name in names:
+        t0 = time.perf_counter()
+        with dispatch.capture() as cold:
+            run_once(name)
+        with dispatch.capture() as warm:
+            run_once(name)
+        dt = time.perf_counter() - t0
+        ok = warm.get("xla_compiles", 0) == 0
+        print(f"warmup {suite} {name}: cold compiles={cold.get('xla_compiles', 0)} "
+              f"({cold.get('compile_ms', 0)} ms), warm "
+              f"dispatches={warm.get('xla_dispatches', 0)} "
+              f"compiles={warm.get('xla_compiles', 0)} [{dt:.2f}s]"
+              + ("" if ok else "  <-- RECOMPILED ON WARM RUN"))
+        if not ok:
+            failed.append(name)
+    if failed:
+        print(f"# warmup: warm-run recompiles in: {', '.join(failed)}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
                n_faults: int) -> int:
     """Fault-injection smoke: fault-free run vs seeded-fault run must
@@ -170,7 +238,9 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
         counters = (
             f"attempts={m.get('task_attempts')} retries={m.get('task_retries')} "
             f"fetch_failures={m.get('fetch_failures')} "
-            f"map_reruns={m.get('map_stage_reruns')}" if m else "no metrics"
+            f"map_reruns={m.get('map_stage_reruns')} "
+            f"dispatches={m.get('xla_dispatches')} "
+            f"compiles={m.get('xla_compiles')}" if m else "no metrics"
         )
         if chaotic != baseline:
             print(f"chaos {name}: MISMATCH under spec '{spec}' ({counters})",
@@ -203,6 +273,15 @@ def main(argv=None) -> int:
     ap.add_argument("--scheduler", action="store_true",
                     help="run through the stage scheduler (TaskDefinition "
                          "bytes + shuffle files) instead of in-process")
+    ap.add_argument("--warmup", action="store_true",
+                    help="populate the kernel + persistent XLA compile "
+                         "caches (spark.blaze.xla.cacheDir) by running the "
+                         "queries twice; exit nonzero if the warm run "
+                         "recompiles anything")
+    ap.add_argument("--xla-cache-dir", default="",
+                    help="persistent XLA compile cache directory for "
+                         "--warmup (default: conf spark.blaze.xla.cacheDir, "
+                         "else ~/.cache/blaze_tpu/xla)")
     ap.add_argument("--chaos", action="store_true",
                     help="fault-injection smoke: run each query fault-free "
                          "and under a seeded random fault schedule; exit "
@@ -212,9 +291,20 @@ def main(argv=None) -> int:
     ap.add_argument("--chaos-faults", type=int, default=3,
                     help="faults per scheduled chaos run (default 3)")
     args = ap.parse_args(argv)
-    queries = args.queries or (["q6"] if args.chaos else None)
+    queries = args.queries or (
+        ["q6"] if args.chaos else ["q1", "q6"] if args.warmup else None
+    )
     if not queries:
-        ap.error("query names required (or pass --chaos for the default q6)")
+        ap.error("query names required (or pass --chaos / --warmup for "
+                 "the defaults)")
+    # persistent compile cache for plain runs too, when configured
+    if not args.warmup:
+        from .runtime.kernel_cache import enable_persistent_cache
+
+        enable_persistent_cache()
+    if args.warmup:
+        return _warmup(args.suite, queries, args.scale, args.parts,
+                       args.xla_cache_dir)
     if args.chaos:
         return _run_chaos(args.suite, queries, args.scale, args.parts,
                           args.chaos_seed, args.chaos_faults)

@@ -630,6 +630,26 @@ def _mask_dead_rows(c: Column, live) -> Column:
     )
 
 
+def head_rows(c: Column, cap: int) -> Column:
+    """First ``cap`` rows of a (compacted) column — TRACE-ONLY helper
+    for programs that shrink an intermediate back to its caller-visible
+    capacity (the fused agg update slices the merged accumulator to the
+    stacked-state bucket).  Recursive over nested children (every
+    buffer leads with the row axis); the caller guarantees rows past
+    its live count are already padding-masked."""
+
+    def h(a):
+        return None if a is None else a[:cap]
+
+    return Column(
+        c.dtype,
+        h(c.data),
+        h(c.validity),
+        h(c.lengths),
+        None if c.children is None else tuple(head_rows(k, cap) for k in c.children),
+    )
+
+
 def slice_rows_device(batch: RecordBatch, lo: int, n: int) -> RecordBatch:
     """Device-side row-range slice ``[lo, lo+n)`` re-padded to its own
     bucket capacity (async — no host transfer).  Used by the in-process

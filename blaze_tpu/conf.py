@@ -99,6 +99,22 @@ STAGE_MAX_ATTEMPTS = ConfEntry("spark.blaze.stage.maxAttempts", 4, int)
 # Env override BLAZE_FAULTS_SPEC reaches worker subprocesses too.
 FAULTS_SPEC = ConfEntry("spark.blaze.faults.spec", "", str)
 
+# Whole-stage program fusion (ops/fusion.py): collapse traceable
+# operator chains / agg pre-filters / final-agg sorts into single XLA
+# programs.  OFF runs every operator as its own dispatch — the
+# correctness fallback the fused-vs-unfused differential tests pin.
+FUSION_ENABLE = ConfEntry("spark.blaze.fusion.enabled", True, _bool)
+# Grouped/scalar aggs fold the per-batch reduce AND the accumulator
+# merge into ONE jitted update program over stacked state (agg.py) —
+# the q01 dispatch collapse.  OFF = reduce + concat + merge as
+# separate programs (the pending-list doubling path).
+FUSED_AGG_UPDATE = ConfEntry("spark.blaze.tpu.fusedAggUpdate", True, _bool)
+# Persistent XLA compilation cache directory (jax_compilation_cache_dir)
+# — empty disables.  Pre-warm once per image with
+# `python -m blaze_tpu --warmup` so the 15-22 min first q01 compile
+# (round 5) is never paid inside a query.  Env: BLAZE_XLA_CACHEDIR.
+XLA_CACHE_DIR = ConfEntry("spark.blaze.xla.cacheDir", "", str)
+
 # TPU-specific knobs (no reference equivalent).
 ON_DEVICE = ConfEntry("spark.blaze.tpu.onDevice", True, _bool)
 # Grouped-agg segment reduces via segmented associative scans + cumsum

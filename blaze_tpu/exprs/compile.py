@@ -872,6 +872,14 @@ def needs_host(expr: Expr) -> bool:
     return any(needs_host(c) for c in children)
 
 
+def device_only(exprs: List[Expr]) -> bool:
+    """True when every tree lowers fully on device — the gate
+    whole-stage fusion applies before folding an expression list (sort
+    keys, absorbed predicates) into a traced program: a host-fallback
+    subtree would need a per-batch host round trip mid-program."""
+    return not any(needs_host(e) for e in exprs)
+
+
 def split_host_exprs(exprs: List[Expr]) -> Tuple[List[Expr], List[Tuple[str, Expr]]]:
     """Replace host-only subtrees with synthetic column refs.  The
     operator evaluates the extracted subtrees on host per batch and

@@ -178,16 +178,18 @@ def _unpack_be(data, pos: int, width: int, count: int) -> Tuple[np.ndarray, int]
     return vals.view(np.int64), pos + nbytes
 
 
-def _wrap_u64(v: int) -> int:
+def _wrap_u64(v):
     """Unsigned->signed int64 wrap for "unsigned" RLE streams.
 
     ORC C++ packs signed values (e.g. pre-epoch packed nanos) into
     unsigned streams as their two's-complement uint64 image; a python
     varint/big-endian decode hands back the raw >= 2**63 integer, which
-    overflows an int64 slice-assign.  Every unsigned decode path
-    (RLEv1 literal + run base, RLEv2 SHORT_REPEAT + DELTA base) wraps
-    through here; RLEv2 DIRECT wraps vectorized via _unpack_be's int64
-    view, which is this same reinterpretation."""
+    overflows an int64 slice-assign.  Every unsigned decode path wraps
+    through here — RLEv1 literal + run base and RLEv2 SHORT_REPEAT +
+    DELTA base as scalars, RLEv2 DIRECT vectorized (a uint64 ndarray
+    image reinterpreted as its two's-complement int64 view)."""
+    if isinstance(v, np.ndarray):
+        return v.astype(np.uint64, copy=False).view(np.int64)
     return v - (1 << 64) if v >= 1 << 63 else v
 
 
@@ -235,9 +237,11 @@ def _rlev2_decode(data: bytes, count: int, signed: bool) -> np.ndarray:
                 vals = ((u >> np.uint64(1)).astype(np.int64)) ^ -(
                     (u & np.uint64(1)).astype(np.int64)
                 )
-            # unsigned: _unpack_be already returned the int64 VIEW of
-            # the packed uint64 bits — the explicit _wrap_u64
-            # reinterpretation, vectorized
+            else:
+                # explicit uint64->int64 wrap through the shared helper
+                # (ADVICE r5: no more relying on numpy's reinterpret
+                # happening implicitly in the slice-assign below)
+                vals = _wrap_u64(vals.view(np.uint64))
             out[n : n + run] = vals
             n += run
         elif enc == 2:  # PATCHED_BASE

@@ -668,9 +668,19 @@ class AggExec(ExecNode):
         initial_input_buffer_offset: int = 0,
         supports_partial_skipping: bool = False,
         pre_filter: Optional[Expr] = None,
+        post_sort: Optional[Sequence] = None,
+        post_fetch: Optional[int] = None,
     ):
         super().__init__([child])
         self.mode = mode
+        # stage fusion may fold a downstream Sort(+Limit) into the
+        # finalize program (FINAL mode emits one blocking batch per
+        # partition, so an in-program key sort over it is exact):
+        # post_sort = SortFields over the OUTPUT schema, post_fetch =
+        # host-side row clamp after the sorted finalize
+        assert post_sort is None or mode == AggMode.FINAL
+        self.post_sort = list(post_sort) if post_sort else None
+        self.post_fetch = post_fetch
         self.groupings = list(groupings)
         # brickhouse names are aliases (≙ agg/mod.rs:84-97 create_agg
         # mapping BrickhouseCollect/BrickhouseCombineUnique)
@@ -758,8 +768,10 @@ class AggExec(ExecNode):
             self._schema = self._state_schema
 
         self._merger: Optional["_StateMerger"] = None
+        self._update_k = None
         from ..exprs.compile import expr_key
         from ..runtime.kernel_cache import cached_kernel, schema_key
+        from .sort import sort_fields_key
 
         kernel_key = (
             "agg", mode.value, schema_key(in_schema), schema_key(self._state_schema),
@@ -769,7 +781,9 @@ class AggExec(ExecNode):
                   for a in self.aggs),
             bool(conf.SEG_SCAN_REDUCE.get()),
             bool(conf.AGG_HASH_SORT_PARTIAL.get()),
+            None if self.post_sort is None else sort_fields_key(self.post_sort),
         )
+        self._kernel_key = kernel_key
         self._grouped_kernel, self._scalar_kernel, self._finalize_kernel = cached_kernel(
             kernel_key, lambda: self._build_kernels(in_schema)
         )
@@ -785,6 +799,8 @@ class AggExec(ExecNode):
         aggs = self.aggs
         mode = self.mode
         pre_filter = self.pre_filter
+        post_sort = self.post_sort
+        out_schema = self._schema
         n_groups_cols = len(groupings)
         state_schema = self._state_schema
         in_types = list(self._in_types)  # NEVER capture self below: the
@@ -1131,7 +1147,7 @@ class AggExec(ExecNode):
             return I.add(*h128, *I.from_i64(lo))
 
         @jax.jit
-        def finalize_kernel(cols: Tuple[Column, ...]):
+        def finalize_kernel(cols: Tuple[Column, ...], num_rows):
             from ..exprs import int128 as I
 
             env = {f.name: c for f, c in zip(state_schema.fields, cols)}
@@ -1200,6 +1216,14 @@ class AggExec(ExecNode):
                     out.append(env[f"{a.name}#list"])
                 else:
                     out.append(env[f"{a.name}#value"])
+            if post_sort is not None:
+                # the fused downstream sort: FINAL emits one blocking
+                # batch, so the key sort runs INSIDE this program —
+                # no extra dispatch, no host round trip between the
+                # final merge and the ordered result
+                from .sort import apply_sort
+
+                out = list(apply_sort(tuple(out), out_schema, post_sort, num_rows))
             return tuple(out)
 
         return grouped_kernel, scalar_kernel, finalize_kernel
@@ -1213,6 +1237,122 @@ class AggExec(ExecNode):
             return RecordBatch(self._state_schema, list(cols), int(n_out))
         cols = self._scalar_kernel(tuple(batch.columns), batch.num_rows)
         return RecordBatch(self._state_schema, list(cols), 1)
+
+    def _update_kernels(self):
+        """(grouped_update, scalar_update): the whole-stage update
+        programs — per input batch, ONE jitted program reduces the
+        batch AND folds it into the stacked accumulator state (the
+        reduce and merge kernels inline into a single XLA executable;
+        the concat between them is traced, not dispatched).  This is
+        the q01 dispatch collapse: the eager path cost one program per
+        reduce plus ~#state-buffers programs per concat+merge cascade.
+
+        grouped_update(acc_cols, acc_n, in_cols, in_n, out_cap) ->
+        (state cols sliced to the STATIC ``out_cap``, true merged group
+        count); when the count exceeds out_cap the caller redoes the
+        batch through the eager reduce+merge, which re-buckets the
+        grown accumulator to a power-of-two capacity.
+        scalar_update(acc_cols, in_cols, in_n) -> 1-row state cols."""
+        if self._update_k is None:
+            from functools import partial
+
+            from ..batch import _concat_device_cols, head_rows
+            from ..runtime import dispatch
+            from ..runtime.kernel_cache import cached_kernel
+
+            twin = _StateMerger.for_agg(self)._twin
+            # raw (uninstrumented) kernels: inlined sub-programs are
+            # not dispatches
+            reduce_g = dispatch.raw(self._grouped_kernel)
+            reduce_s = dispatch.raw(self._scalar_kernel)
+            merge_g = dispatch.raw(twin._grouped_kernel)
+            merge_s = dispatch.raw(twin._scalar_kernel)
+            state_schema = self._state_schema
+
+            def build():
+                @partial(jax.jit, static_argnums=(4,))
+                def grouped_update(acc_cols, acc_n, in_cols, in_n, out_cap):
+                    part_cols, part_n = reduce_g(in_cols, in_n)
+                    cap_a = acc_cols[0].validity.shape[0]
+                    cap_i = part_cols[0].validity.shape[0]
+                    comb = tuple(
+                        _concat_device_cols(
+                            f.dtype, [a, p], [acc_n, part_n], cap_a + cap_i
+                        )
+                        for f, a, p in zip(state_schema.fields, acc_cols, part_cols)
+                    )
+                    merged, m_n = merge_g(comb, acc_n + part_n)
+                    return tuple(head_rows(c, out_cap) for c in merged), m_n
+
+                @jax.jit
+                def scalar_update(acc_cols, in_cols, in_n):
+                    part_cols = reduce_s(in_cols, in_n)
+                    comb = tuple(
+                        _concat_device_cols(f.dtype, [a, p], [1, 1], 2)
+                        for f, a, p in zip(state_schema.fields, acc_cols, part_cols)
+                    )
+                    return merge_s(comb, 2)
+
+                return grouped_update, scalar_update
+
+            self._update_k = cached_kernel(
+                ("agg_update",) + self._kernel_key, build
+            )
+        return self._update_k
+
+    def _fused_update(self, batch: RecordBatch, in_schema: Schema,
+                      consumer: "_AggConsumer") -> bool:
+        """Consume one input batch through the single-program update;
+        returns False when this batch should take the eager
+        pending/doubling path instead (accumulator outgrew one batch
+        bucket: a per-batch full-state re-sort would go quadratic for
+        high-cardinality keys — exactly the shapes partial skipping
+        targets)."""
+        from ..batch import slice_rows_device
+
+        acc = consumer.take_state()
+        if not self.groupings:
+            if acc is None:
+                consumer.set_state(self._reduce_batch(batch, in_schema))
+                return True
+            _, scalar_update = self._update_kernels()
+            cols = scalar_update(
+                tuple(acc.columns), tuple(batch.columns), batch.num_rows
+            )
+            consumer.set_state(RecordBatch(self._state_schema, list(cols), 1))
+            return True
+        if acc is None:
+            # seed: reduce, then shrink the state to its own bucket so
+            # steady-state updates sort acc_cap + batch_cap rows, not
+            # 2x batch_cap (q01: 4 groups -> the min capacity bucket)
+            part = self._reduce_batch(batch, in_schema)
+            cap = bucket_capacity(max(part.num_rows, 1))
+            if cap < part.capacity:
+                part = slice_rows_device(part, 0, part.num_rows)
+            consumer.set_state(part)
+            return True
+        if acc.capacity > batch.capacity:
+            consumer.set_state(acc)  # untouched; eager path takes over
+            return False
+        grouped_update, _ = self._update_kernels()
+        out_cap = acc.capacity
+        cols, m_n = grouped_update(
+            tuple(acc.columns), acc.num_rows,
+            tuple(batch.columns), batch.num_rows, out_cap,
+        )
+        n = int(m_n)  # one-scalar device->host sync per batch
+        if n > out_cap:
+            # merged groups overflow the stacked-state bucket: redo
+            # this batch through the eager reduce+merge (the update is
+            # pure, acc is unchanged) — concat_batches re-buckets the
+            # grown state to a power-of-two capacity, preserving the
+            # shape-bucketing invariant every downstream kernel (and
+            # the persistent compile cache's entry bound) relies on
+            part = self._reduce_batch(batch, in_schema)
+            consumer.set_state(self._merge_states([acc, part]))
+            return True
+        consumer.set_state(RecordBatch(self._state_schema, list(cols), n))
+        return True
 
     def _merge_states(self, states: List[RecordBatch]) -> Optional[RecordBatch]:
         """Associative re-reduce of state batches (merge mode kernel on
@@ -1237,17 +1377,25 @@ class AggExec(ExecNode):
             ctx.mem.register_consumer(consumer)
             in_rows = 0
             skipping = False
+            fused_update = bool(conf.FUSED_AGG_UPDATE.get())
             try:
                 for batch in child_stream:
                     if not ctx.is_task_running():
                         return
-                    with self.metrics.timer("elapsed_compute"):
-                        part = self._reduce_batch(batch, in_schema)
                     in_rows += batch.num_rows
+                    part: Optional[RecordBatch] = None
                     # the consumer OWNS the accumulator: a spill() from
                     # the memory manager atomically moves it out, and a
                     # take_state() here starts a fresh accumulation
                     # (re-merging a spilled state would double-count it)
+                    if fused_update and not skipping:
+                        with self.metrics.timer("elapsed_compute"):
+                            updated = self._fused_update(batch, in_schema, consumer)
+                    else:
+                        updated = False
+                    if not updated:
+                        with self.metrics.timer("elapsed_compute"):
+                            part = self._reduce_batch(batch, in_schema)
                     acc_rows_hint = consumer.state_rows
                     if (
                         self.mode == AggMode.PARTIAL
@@ -1257,10 +1405,14 @@ class AggExec(ExecNode):
                         and bool(conf.ENABLE_PARTIAL_AGG_SKIPPING.get())
                         and in_rows >= int(conf.PARTIAL_AGG_SKIPPING_MIN_ROWS.get())
                     ):
-                        acc_rows = acc_rows_hint + pending_rows + part.num_rows
+                        acc_rows = acc_rows_hint + pending_rows + (
+                            0 if part is None else part.num_rows
+                        )
                         if acc_rows / max(1, in_rows) > float(conf.PARTIAL_AGG_SKIPPING_RATIO.get()):
                             skipping = True
                             self.metrics.add("partial_skipped", 1)
+                    if updated:
+                        continue  # batch already folded into the accumulator
                     if skipping:
                         # stream states through; downstream merge finishes
                         self.metrics.add("output_rows", part.num_rows)
@@ -1302,8 +1454,13 @@ class AggExec(ExecNode):
 
     def _finish(self, state: RecordBatch) -> RecordBatch:
         if self.mode == AggMode.FINAL:
-            cols = self._finalize_kernel(tuple(state.columns))
-            return RecordBatch(self._schema, list(cols), state.num_rows)
+            cols = self._finalize_kernel(tuple(state.columns), state.num_rows)
+            n = state.num_rows
+            if self.post_fetch is not None:
+                # fused Limit/fetch: rows past n are padding after the
+                # in-program post_sort, so a host-side clamp suffices
+                n = min(n, self.post_fetch)
+            return RecordBatch(self._schema, list(cols), n)
         return state
 
 

@@ -37,6 +37,45 @@ class ExecNode:
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         raise NotImplementedError
 
+    # ------------------------------------------------- tracing contract
+    #
+    # Whole-stage program fusion (ops/fusion.py) composes consecutive
+    # unary operators into ONE jitted per-batch program: every operator
+    # boundary otherwise costs an XLA dispatch + a materialized
+    # intermediate, and over a remote/tunneled chip per-program
+    # turnaround (~70-80 ms) dominates the actual math.
+
+    def trace_fn(self):
+        """Pure per-batch transform ``(cols, num_rows) -> (cols,
+        num_rows)`` safe to inline inside an enclosing ``jax.jit``
+        (``num_rows`` may be a traced scalar; all intermediates stay on
+        device), or ``None`` when this operator cannot be traced
+        (blocking, stateful across batches, multi-child, or
+        host-dependent).  The returned closure must capture only
+        schemas / expression IR — never the child subtree (fused
+        programs are cached process-wide, kernel_cache rules apply)."""
+        return None
+
+    def trace_key(self):
+        """Structural cache key for :meth:`trace_fn` (kernel_cache
+        conventions: schema signature + expression keys).  Required
+        non-None whenever trace_fn returns a function."""
+        return None
+
+    @property
+    def trace_changes_count(self) -> bool:
+        """True when the traced transform can change ``num_rows`` (a
+        filter compacts); the fused stage then syncs the one count
+        scalar per batch, exactly like the standalone operator."""
+        return False
+
+    @property
+    def has_kernel(self) -> bool:
+        """False when this operator issues no device program of its own
+        (pure column selects); fusion only builds a combined program
+        when it replaces at least two real kernels."""
+        return True
+
     def num_partitions(self) -> int:
         """Output partitioning degree (propagates from children by
         default)."""

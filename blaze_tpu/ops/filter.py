@@ -75,34 +75,49 @@ class FilterExec(ExecNode):
             proj_exprs = None
             self._schema = in_schema
 
-        def build():
-            @jax.jit
-            def kernel(cols: Tuple[Column, ...], num_rows):
-                n = cols[0].validity.shape[0]
-                env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
-                memo: dict = {}
-                p = lower(pred, schema_aug, env, n, memo)
-                # the live mask is load-bearing: IsNull turns padding-row
-                # invalidity into data=True, so validity alone cannot be
-                # trusted to exclude padding
-                live = jnp.arange(n) < num_rows
-                keep = p.validity & p.data.astype(jnp.bool_) & live
-                if proj_exprs is not None:
-                    out = tuple(lower(e, schema_aug, env, n, memo) for e in proj_exprs)
-                else:
-                    out = cols[:n_in_fields]
-                return compact_columns(out, keep)
+        def body(cols: Tuple[Column, ...], num_rows):
+            n = cols[0].validity.shape[0]
+            env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
+            memo: dict = {}
+            p = lower(pred, schema_aug, env, n, memo)
+            # the live mask is load-bearing: IsNull turns padding-row
+            # invalidity into data=True, so validity alone cannot be
+            # trusted to exclude padding
+            live = jnp.arange(n) < num_rows
+            keep = p.validity & p.data.astype(jnp.bool_) & live
+            if proj_exprs is not None:
+                out = tuple(lower(e, schema_aug, env, n, memo) for e in proj_exprs)
+            else:
+                out = cols[:n_in_fields]
+            return compact_columns(out, keep)
 
-            return kernel
+        self._body = body
+
+        def build():
+            return jax.jit(body)
 
         from ..exprs.compile import expr_key
         from ..runtime.kernel_cache import cached_kernel, schema_key
 
-        self._kernel = cached_kernel(
-            ("filter", schema_key(schema_aug), expr_key(pred),
-             None if proj_exprs is None else tuple(expr_key(e) for e in proj_exprs)),
-            build,
+        self._key = (
+            "filter", schema_key(schema_aug), expr_key(pred),
+            None if proj_exprs is None else tuple(expr_key(e) for e in proj_exprs),
         )
+        self._kernel = cached_kernel(self._key, build)
+
+    # ---------------------------------------------- tracing contract
+
+    def trace_fn(self):
+        # host-fallback predicate subtrees evaluate per batch OUTSIDE
+        # jit; such a filter cannot join a fused program
+        return None if self._host_parts else self._body
+
+    def trace_key(self):
+        return None if self._host_parts else self._key
+
+    @property
+    def trace_changes_count(self) -> bool:
+        return True
 
     @property
     def schema(self) -> Schema:

@@ -1,11 +1,32 @@
-"""Limit — ≙ reference LimitExec (limit_exec.rs:24)."""
+"""Limit — ≙ reference LimitExec (limit_exec.rs:24).
+
+Not traceable (the running ``remaining`` count is host state across
+batches), but stage fusion still absorbs it two ways: a
+``Limit(Sort(FinalAgg))`` chain folds into the agg's finalize program
+(``AggExec.post_fetch``), and :func:`truncate` is the shared host-side
+step both this operator and fused consumers apply — truncating
+``num_rows`` is enough because rows past ``num_rows`` are padding by
+the batch invariant.
+"""
 
 from __future__ import annotations
+
+from typing import Optional, Tuple
 
 from ..batch import RecordBatch
 from ..runtime.context import TaskContext
 from ..schema import Schema
 from .base import BatchStream, ExecNode
+
+
+def truncate(batch: RecordBatch, remaining: int) -> Tuple[Optional[RecordBatch], int]:
+    """Clamp ``batch`` to ``remaining`` rows; returns (batch-or-None,
+    remaining-after).  None means the budget was already exhausted."""
+    if remaining <= 0:
+        return None, 0
+    if batch.num_rows <= remaining:
+        return batch, remaining - batch.num_rows
+    return RecordBatch(batch.schema, batch.columns, remaining), 0
 
 
 class LimitExec(ExecNode):
@@ -23,19 +44,12 @@ class LimitExec(ExecNode):
         def stream():
             remaining = self.limit
             for batch in child_stream:
-                if remaining <= 0:
+                out, remaining = truncate(batch, remaining)
+                if out is None:
                     return
-                if batch.num_rows <= remaining:
-                    remaining -= batch.num_rows
-                    self.metrics.add("output_rows", batch.num_rows)
-                    yield batch
-                else:
-                    # truncating num_rows is enough: rows past num_rows
-                    # are padding by the batch invariant
-                    out = RecordBatch(batch.schema, batch.columns, remaining)
-                    self.metrics.add("output_rows", remaining)
-                    remaining = 0
-                    yield out
+                self.metrics.add("output_rows", out.num_rows)
+                yield out
+                if remaining <= 0:
                     return
 
         return stream()

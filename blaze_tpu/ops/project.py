@@ -61,6 +61,7 @@ class ProjectExec(ExecNode):
             self._select_idx = [in_schema.index(n) for n in picked]
             self._device_exprs, self._host_parts = [], []
             self._in_schema_aug = in_schema
+            self._kernel = None
             return
         # host-fallback subtrees get evaluated per batch outside jit and
         # injected as synthetic columns (≙ SparkUDFWrapperExpr round trip)
@@ -73,31 +74,66 @@ class ProjectExec(ExecNode):
         schema_aug = self._in_schema_aug
         device_exprs = self._device_exprs
 
-        def build():
-            @jax.jit
-            def kernel(cols: Tuple[Column, ...]) -> Tuple[Column, ...]:
-                n = cols[0].validity.shape[0]
-                env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
-                # ONE memo across the output list: each distinct subtree
-                # lowers once (≙ CachedExprsEvaluator)
-                memo: dict = {}
-                return tuple(lower(e, schema_aug, env, n, memo) for e in device_exprs)
+        def body(cols: Tuple[Column, ...]) -> Tuple[Column, ...]:
+            n = cols[0].validity.shape[0]
+            env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
+            # ONE memo across the output list: each distinct subtree
+            # lowers once (≙ CachedExprsEvaluator)
+            memo: dict = {}
+            return tuple(lower(e, schema_aug, env, n, memo) for e in device_exprs)
 
-            return kernel
+        self._body = body
+
+        def build():
+            return jax.jit(body)
 
         from ..exprs.compile import expr_key
         from ..runtime.kernel_cache import cached_kernel, schema_key
 
         # plans are rebuilt per task (from_proto): the kernel must be
         # shared process-wide or every task pays a full XLA recompile
-        self._kernel = cached_kernel(
-            ("project", schema_key(schema_aug), tuple(expr_key(e) for e in device_exprs)),
-            build,
+        self._key = (
+            "project", schema_key(schema_aug), tuple(expr_key(e) for e in device_exprs)
         )
+        self._kernel = cached_kernel(self._key, build)
 
     @property
     def schema(self) -> Schema:
         return self._schema
+
+    # ---------------------------------------------- tracing contract
+
+    def trace_fn(self):
+        if self._select_names is not None:
+            idx = list(self._select_idx)
+
+            def select(cols, num_rows):
+                return tuple(cols[i] for i in idx), num_rows
+
+            return select
+        if self._host_parts:
+            return None
+        body = self._body
+
+        def fn(cols, num_rows):
+            return body(cols), num_rows
+
+        return fn
+
+    def trace_key(self):
+        if self._select_names is not None:
+            from ..runtime.kernel_cache import schema_key
+
+            return ("select", schema_key(self.children[0].schema),
+                    tuple(self._select_idx))
+        return None if self._host_parts else self._key
+
+    @property
+    def has_kernel(self) -> bool:
+        """False for the pure-select fast path (a host list pick: no
+        device program at all) — fused-chain building counts only
+        kernel-bearing operators when deciding whether fusion wins."""
+        return self._select_names is None
 
     def _augmented_cols(self, batch: RecordBatch) -> Tuple[Column, ...]:
         cols = list(batch.columns)

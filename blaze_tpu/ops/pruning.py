@@ -180,6 +180,7 @@ def prune_columns(plan, required: Optional[Set[str]] = None):
             _narrow(child, child_req), plan.mode, plan.groupings, plan.aggs,
             supports_partial_skipping=plan.supports_partial_skipping,
             pre_filter=plan.pre_filter,
+            post_sort=plan.post_sort, post_fetch=plan.post_fetch,
         )
 
     if isinstance(plan, SortExec):

@@ -108,6 +108,32 @@ def sort_indices(
     return out[-1]
 
 
+def apply_sort(
+    cols: Tuple[Column, ...],
+    schema: Schema,
+    fields: Sequence[SortField],
+    num_rows,
+) -> Tuple[Column, ...]:
+    """Sort a column tuple by ``fields`` — TRACE-SHARED body: both
+    SortExec's standalone kernel and fused programs (AggExec's
+    finalize-with-post_sort) inline this, so a sort folded into a
+    bigger program is byte-identical to the standalone operator.
+    ``num_rows`` may be a traced scalar; padding rows sort last."""
+    env = {f.name: c for f, c in zip(schema.fields, cols)}
+    cap = cols[0].validity.shape[0]
+    key_cols = [lower(f.expr, schema, env, cap) for f in fields]
+    idx = sort_indices(key_cols, fields, num_rows)
+    return tuple(c.take(idx) for c in cols)
+
+
+def sort_fields_key(fields: Sequence[SortField]) -> Tuple:
+    """Structural cache-key fragment for a sort-field list
+    (kernel_cache conventions)."""
+    from ..exprs.compile import expr_key
+
+    return tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in fields)
+
+
 def _slice_host_batch(b: RecordBatch, start: int, n: int) -> RecordBatch:
     """Host-side row slice [start, start+n) of a host batch."""
     cap = bucket_capacity(n)
@@ -198,11 +224,7 @@ class SortExec(ExecNode):
         def build():
             @jax.jit
             def kernel(cols: Tuple[Column, ...], num_rows):
-                env = {f.name: c for f, c in zip(in_schema.fields, cols)}
-                cap = cols[0].validity.shape[0]
-                key_cols = [lower(f.expr, in_schema, env, cap) for f in fields_]
-                idx = sort_indices(key_cols, fields_, num_rows)
-                return tuple(c.take(idx) for c in cols)
+                return apply_sort(cols, in_schema, fields_, num_rows)
 
             @jax.jit
             def key_words(cols: Tuple[Column, ...], num_rows):
@@ -216,12 +238,10 @@ class SortExec(ExecNode):
 
             return kernel, key_words
 
-        from ..exprs.compile import expr_key
         from ..runtime.kernel_cache import cached_kernel, schema_key
 
         self._kernel, self._key_words = cached_kernel(
-            ("sort", schema_key(in_schema),
-             tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in fields_)),
+            ("sort", schema_key(in_schema), sort_fields_key(fields_)),
             build,
         )
 

@@ -498,7 +498,17 @@ class IpcReaderExec(ExecNode):
                             self.resource_id, partition, cause=e
                         ) from e
                 for p in payloads:
-                    b = deserialize_batch(p, self._schema)
+                    try:
+                        # decode stays streaming (one payload at a
+                        # time) but INSIDE the fetch guard: a
+                        # committed-but-corrupt block can survive
+                        # decompress and only fail here — still bad
+                        # producer bytes, not a transient compute error
+                        b = deserialize_batch(p, self._schema)
+                    except (struct.error, ValueError, EOFError) as e:
+                        raise FetchFailedError(
+                            self.resource_id, partition, cause=e
+                        ) from e
                     if b.num_rows:
                         self.metrics.add("output_rows", b.num_rows)
                         yield b.to_device()

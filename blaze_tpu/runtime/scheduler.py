@@ -506,16 +506,37 @@ def run_stages(
                  if s.broadcast_id is not None), default=-1) + 1
         ]
 
+    from . import dispatch
+
+    def publish_dispatch(stage: Stage, cap: Dict[str, int]) -> None:
+        """Mirror the stage's XLA dispatch observability
+        (xla_dispatches / xla_compiles / compile_ms / fused_stage_len,
+        runtime.dispatch) into its MetricNode child AND the scheduler
+        totals — the q01 collapse must be measurable in-repo, not only
+        on the leased chip."""
+        snode = metrics.child(stage.stage_id).metrics
+        for k, v in cap.items():
+            if k in dispatch.MAX_GAUGES:
+                snode.set(k, max(snode.get(k), v))
+                sched_m.set(k, max(sched_m.get(k), v))
+            else:
+                snode.add(k, v)
+                sched_m.add(k, v)
+
     for stage in stages:
         if adaptive_on:
             maybe_rewrite_stage(stage, manager, n_maps, bcast_blobs,
                                 next_adaptive_bid)
         if stage.kind == "result":
             register = make_registrar(stage)
-            for t in range(stage.n_tasks):
-                yield from run_result_task(stage, t, register)
+            with dispatch.capture() as cap:
+                for t in range(stage.n_tasks):
+                    yield from run_result_task(stage, t, register)
+            publish_dispatch(stage, cap)
             continue
-        run_stage_tasks(stage)
+        with dispatch.capture() as cap:
+            run_stage_tasks(stage)
+        publish_dispatch(stage, cap)
         if stage.kind == "map":
             n_maps[stage.shuffle_id] = stage.n_tasks
         elif stage.kind == "broadcast":

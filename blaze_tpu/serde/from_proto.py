@@ -393,11 +393,10 @@ def run_task(task_def_bytes: bytes, task_attempt_id: int = 0):
 
     td = pb.TaskDefinition()
     td.ParseFromString(task_def_bytes)
-    from ..ops.fusion import fuse_stages
-    from ..ops.pruning import prune_columns
+    from ..ops.fusion import optimize_plan
 
     faults.hit("task.compute", attempt=task_attempt_id, detail=td.task_id)
-    plan = prune_columns(fuse_stages(plan_from_proto(td.plan)))
+    plan = optimize_plan(plan_from_proto(td.plan))
     if _log.isEnabledFor(logging.DEBUG):
         # ≙ the reference's native plan display at task start
         # (blaze/src/exec.rs:101-106)

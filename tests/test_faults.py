@@ -109,6 +109,23 @@ def test_ipc_reader_missing_block_raises_fetch_failed():
     assert ei.value.shuffle_id == 9
 
 
+def test_ipc_reader_corrupt_payload_raises_fetch_failed():
+    """A committed block whose bytes survive the frame read but fail
+    batch DECODE is still bad producer bytes: it must classify as
+    FETCH_FAILED (regenerate the map stage), not RETRY (re-read the
+    same corrupt file until the budget burns out)."""
+    import struct as _struct
+
+    schema = Schema([Field("x", DataType.int64())])
+    reader = IpcReaderExec(schema, "shuffle_11", 1)
+    garbage = b"\x99" * 32  # valid frame envelope, undecodable payload
+    frame = _struct.pack("<IB", len(garbage), 0) + garbage  # codec 0 = none
+    RESOURCES.put("shuffle_11.0", [frame])
+    with pytest.raises(FetchFailedError) as ei:
+        list(reader.execute(0, TaskContext(0, 1)))
+    assert ei.value.shuffle_id == 11
+
+
 # ------------------------------------------------- scheduler recovery paths
 
 from test_spark_convert import make_session, q6_like_plan  # noqa: E402

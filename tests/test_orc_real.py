@@ -642,6 +642,24 @@ def test_pyarrow_repeated_pre_epoch_timestamp_differential(tmp_path):
         assert d["ts"] == us_vals
 
 
+def test_pyarrow_direct_pre_epoch_timestamp_differential(tmp_path):
+    """(ADVICE r5, last open item) A long run of DISTINCT pre-epoch
+    fractional timestamps keeps the secondary (packed-nanos) stream in
+    RLEv2 DIRECT, whose uint64->int64 wrap is now explicit through the
+    shared _wrap_u64 helper instead of numpy's implicit slice-assign
+    reinterpretation — this pins the vectorized wrap against pyarrow's
+    real writer."""
+    us_vals = [-1_500_000 - 7 * i - (i % 3) for i in range(64)]
+    table = pa.table({"ts": pa.array(us_vals, pa.timestamp("us"))})
+    path = str(tmp_path / "pa_preepoch_direct.orc")
+    paorc.write_table(table, path, compression="zlib")
+    schema = Schema([Field("ts", DataType.timestamp())])
+    scan = OrcScanExec([[path]], schema, batch_rows=16)
+    d = batch_to_pydict(concat_batches(
+        [b for b in scan.execute(0, TaskContext(0, 1))]))
+    assert d["ts"] == us_vals
+
+
 def test_writer_compound_decimal_finer_than_scale_is_gated(tmp_path):
     """(review finding) Decimal('1.005') into DECIMAL(10,2) must raise,
     not silently truncate to 1.00 — the writer mirrors the reader's
