@@ -16,6 +16,15 @@ Usage:
     python -m blaze_tpu --warmup            # compile-cache pre-warm + gate
     python -m blaze_tpu --chaos             # seeded fault-injection smoke
     python -m blaze_tpu tpch q1 --chaos --chaos-seed 42
+    python -m blaze_tpu tpch q1 --scheduler --trace   # write an event log
+    python -m blaze_tpu --report <eventlog.jsonl>     # render the profile
+
+``--trace`` arms the structured event log (runtime/trace.py, conf
+``spark.blaze.trace.enabled`` / ``spark.blaze.eventLog.dir``): each
+query appends lifecycle + kernel-attribution events to its own JSONL
+file, and ``--report`` renders the per-query profile (stage timeline,
+dispatch-floor vs device-compute split, plan-annotated metrics tree,
+recovery timeline).
 
 ``--warmup`` populates the kernel and persistent XLA compile caches
 (``spark.blaze.xla.cacheDir`` / BLAZE_XLA_CACHEDIR, default
@@ -91,27 +100,30 @@ def _run_suite(suite: str, names, scale: float, n_parts: int,
     if build_query is None:
         return names
 
+    from .runtime import trace
     from .runtime.context import TaskContext
 
     failed = []
     for name in names:
         t0 = time.perf_counter()
         try:
-            plan = build_query(name, scans, n_parts)
-            rows = 0
-            if scheduler:
-                from .runtime.scheduler import run_stages, split_stages
+            with trace.query(f"{suite}_{name}") as log_path:
+                plan = build_query(name, scans, n_parts)
+                rows = 0
+                if scheduler:
+                    from .runtime.scheduler import run_stages, split_stages
 
-                stages, manager = split_stages(plan)
-                for b in run_stages(stages, manager):
-                    rows += b.num_rows
-            else:
-                for p in range(plan.num_partitions()):
-                    for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+                    stages, manager = split_stages(plan)
+                    for b in run_stages(stages, manager):
                         rows += b.num_rows
+                else:
+                    for p in range(plan.num_partitions()):
+                        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+                            rows += b.num_rows
             dt = time.perf_counter() - t0
             print(f"{suite} {name}: {rows} rows in {dt:.2f}s"
-                  + (" [scheduler]" if scheduler else ""))
+                  + (" [scheduler]" if scheduler else "")
+                  + (f" [eventlog: {log_path}]" if log_path else ""))
         except Exception as e:  # noqa: BLE001 — report per query, keep going
             failed.append(name)
             print(f"{suite} {name}: FAILED {type(e).__name__}: {e}",
@@ -200,10 +212,13 @@ def _warmup(suite: str, names, scale: float, n_parts: int,
 def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
                n_faults: int) -> int:
     """Fault-injection smoke: fault-free run vs seeded-fault run must
-    produce identical rows.  Nonzero exit on mismatch or unrecovered
-    failure (CI gate for the retry/fetch-recovery path)."""
+    produce identical rows.  The chaotic run is TRACED (event log on),
+    and the recovery story must reconcile: every injected fault paired
+    with a recorded recovery event (task retry or map-stage rerun).
+    Nonzero exit on mismatch, unrecovered failure, or an event log
+    that doesn't reconcile."""
     from . import conf
-    from .runtime import faults, scheduler
+    from .runtime import faults, scheduler, trace, trace_report
 
     build_query, names, scans = _load_suite(suite, names, scale, n_parts)
     if build_query is None:
@@ -224,8 +239,13 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
             continue
         conf.FAULTS_SPEC.set(spec)
         faults.reset()
+        prev_trace = bool(conf.TRACE_ENABLE.get())
+        conf.TRACE_ENABLE.set(True)
+        trace.reset()
+        log_path = None
         try:
-            chaotic = _rows_via_scheduler(build_query(name, scans, n_parts))
+            with trace.query(f"chaos_{suite}_{name}") as log_path:
+                chaotic = _rows_via_scheduler(build_query(name, scans, n_parts))
         except Exception as e:  # noqa: BLE001
             print(f"chaos {name}: UNRECOVERED under spec '{spec}': "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
@@ -234,6 +254,8 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
         finally:
             conf.FAULTS_SPEC.set("")
             faults.reset()
+            conf.TRACE_ENABLE.set(prev_trace)
+            trace.reset()
         m = scheduler.LAST_RUN_METRICS.metrics if scheduler.LAST_RUN_METRICS else None
         counters = (
             f"attempts={m.get('task_attempts')} retries={m.get('task_retries')} "
@@ -242,13 +264,26 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
             f"dispatches={m.get('xla_dispatches')} "
             f"compiles={m.get('xla_compiles')}" if m else "no metrics"
         )
+        # event-log recovery reconciliation: every fault that FIRED
+        # must pair with a recovery event recorded after it
+        rec = trace_report.reconcile_faults(
+            trace.read_events(log_path) if log_path else [])
+        recon = (f"eventlog {rec['injected']} faults / "
+                 f"{rec['recoveries']} recoveries "
+                 + ("reconciled" if rec["reconciled"] else "UNRECONCILED"))
         if chaotic != baseline:
-            print(f"chaos {name}: MISMATCH under spec '{spec}' ({counters})",
+            print(f"chaos {name}: MISMATCH under spec '{spec}' ({counters}; "
+                  f"{recon})", file=sys.stderr)
+            failed.append(name)
+        elif not rec["reconciled"]:
+            print(f"chaos {name}: EVENT LOG UNRECONCILED under spec "
+                  f"'{spec}': {len(rec['unpaired'])} fault(s) without a "
+                  f"recovery event ({counters}; {recon}; log: {log_path})",
                   file=sys.stderr)
             failed.append(name)
         else:
             print(f"chaos {name}: OK {len(baseline)} rows identical under "
-                  f"spec '{spec}' ({counters})")
+                  f"spec '{spec}' ({counters}; {recon})")
     if failed:
         print(f"# chaos: {len(failed)} failed: {', '.join(failed)}",
               file=sys.stderr)
@@ -290,7 +325,43 @@ def main(argv=None) -> int:
                     help="seed for the chaos fault schedule (default 7)")
     ap.add_argument("--chaos-faults", type=int, default=3,
                     help="faults per scheduled chaos run (default 3)")
+    ap.add_argument("--trace", action="store_true",
+                    help="arm the structured event log "
+                         "(spark.blaze.trace.enabled) for this run; each "
+                         "query writes its own JSONL file under "
+                         "spark.blaze.eventLog.dir")
+    ap.add_argument("--event-log-dir", default="",
+                    help="event-log directory for --trace (default: conf "
+                         "spark.blaze.eventLog.dir, else "
+                         "<tmp>/blaze_eventlog)")
+    ap.add_argument("--report", default="",
+                    help="render the per-query profile from a JSONL event "
+                         "log produced by --trace / --chaos and exit")
     args = ap.parse_args(argv)
+    if args.report:
+        from .runtime import trace, trace_report
+
+        try:
+            events = trace.read_events(args.report)
+        except OSError as e:
+            print(f"cannot read event log: {e}", file=sys.stderr)
+            return 2
+        if not events:
+            print(f"no events in {args.report}", file=sys.stderr)
+            return 1
+        print(trace_report.render(events))
+        return 0
+    if args.trace or args.event_log_dir:
+        from . import conf
+        from .runtime import trace
+
+        # --event-log-dir applies on its own too: --chaos arms tracing
+        # itself, and its logs must land where the user pointed
+        if args.trace:
+            conf.TRACE_ENABLE.set(True)
+        if args.event_log_dir:
+            conf.EVENT_LOG_DIR.set(args.event_log_dir)
+        trace.reset()
     queries = args.queries or (
         ["q6"] if args.chaos else ["q1", "q6"] if args.warmup else None
     )

@@ -99,6 +99,17 @@ STAGE_MAX_ATTEMPTS = ConfEntry("spark.blaze.stage.maxAttempts", 4, int)
 # Env override BLAZE_FAULTS_SPEC reaches worker subprocesses too.
 FAULTS_SPEC = ConfEntry("spark.blaze.faults.spec", "", str)
 
+# Query-level tracing + structured event log (runtime/trace.py).
+# OFF (default) keeps the dispatch hot path on the pre-existing code
+# path — no span allocation, no block-until-ready timing per kernel.
+# ON: scheduler/task/operator lifecycle events + per-kernel
+# device/dispatch/compile attribution append to a JSONL event log
+# (≙ Spark's spark.eventLog.enabled + EventLoggingListener).
+TRACE_ENABLE = ConfEntry("spark.blaze.trace.enabled", False, _bool)
+# Event-log directory (≙ spark.eventLog.dir); empty = a blaze_eventlog
+# dir under the system temp dir.  One JSONL file per traced query.
+EVENT_LOG_DIR = ConfEntry("spark.blaze.eventLog.dir", "", str)
+
 # Whole-stage program fusion (ops/fusion.py): collapse traceable
 # operator chains / agg pre-filters / final-agg sorts into single XLA
 # programs.  OFF runs every operator as its own dispatch — the

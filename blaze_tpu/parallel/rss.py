@@ -19,7 +19,7 @@ from ..batch import RecordBatch
 from ..io.batch_serde import serialize_batch
 from ..io.ipc_compression import compress_frame
 from ..ops.base import BatchStream, ExecNode
-from ..runtime import faults
+from ..runtime import faults, trace
 from ..runtime.context import TaskContext
 from ..schema import Schema
 from .shuffle import (
@@ -95,6 +95,8 @@ class RssShuffleWriterExec(ExecNode):
             )
             n_out = self.partitioning.num_partitions
             rr = 0
+            pushed_bytes = 0
+            pushed_blocks = 0
             try:
                 for batch in self.children[0].execute(partition, ctx):
                     if not ctx.is_task_running():
@@ -144,6 +146,8 @@ class RssShuffleWriterExec(ExecNode):
                             )
                             writer.write(pid, payload)
                         self.metrics.add("data_size", len(payload))
+                        pushed_bytes += len(payload)
+                        pushed_blocks += 1
             except BaseException:
                 # failed attempt: close without committing (its retry
                 # will re-push and commit; committing here would let a
@@ -153,6 +157,9 @@ class RssShuffleWriterExec(ExecNode):
             else:
                 writer.flush()
                 writer.close()
+                trace.emit("rss_push", resource=self.writer_resource_id,
+                           partition=partition, bytes=pushed_bytes,
+                           blocks=pushed_blocks)
             return
             yield  # pragma: no cover
 

@@ -40,7 +40,7 @@ from ..exprs.ir import Expr
 from ..io.batch_serde import deserialize_batch, serialize_batch
 from ..io.ipc_compression import IpcFrameReader, IpcFrameWriter, compress_frame
 from ..ops.base import BatchStream, ExecNode
-from ..runtime import faults
+from ..runtime import faults, trace
 from ..runtime.context import TaskContext
 from ..runtime.memmgr import MemConsumer, Spill, try_new_spill
 from ..runtime.retry import FetchFailedError
@@ -274,6 +274,9 @@ class ShuffleRepartitioner(MemConsumer):
                 except OSError:
                     pass
             raise
+        trace.emit("shuffle_write", bytes=sum(lengths),
+                   blocks=sum(1 for ln in lengths if ln),
+                   attempt=self.task_attempt_id, path=data_path)
         return lengths
 
 
@@ -466,54 +469,74 @@ class IpcReaderExec(ExecNode):
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         def stream():
             blocks = ctx.resources.get(f"{self.resource_id}.{partition}")
-            for block in blocks:
-                with self.metrics.timer("shuffle_read_total_time"):
-                    faults.hit(
-                        "shuffle.fetch",
-                        attempt=ctx.task_attempt_id,
-                        detail=self.resource_id,
-                    )
-                    payloads: List[bytes] = []
-                    try:
-                        if isinstance(block, bytes):
-                            off = 0
-                            while off < len(block):
-                                ln, cid = struct.unpack_from("<IB", block, off)
-                                from ..io.ipc_compression import decompress_frame
-
-                                payloads.append(decompress_frame(block[off : off + 5 + ln]))
-                                off += 5 + ln
-                        else:
-                            path, offset, length = block
-                            with open(path, "rb") as f:
-                                f.seek(offset)
-                                payloads.extend(IpcFrameReader(f, length))
-                    except (OSError, struct.error, ValueError, EOFError) as e:
-                        # missing/torn/corrupt block: surface as a
-                        # typed fetch failure so the scheduler knows to
-                        # regenerate the producing map stage rather
-                        # than uselessly re-running this reader against
-                        # the same bad bytes (≙ FetchFailedException)
-                        raise FetchFailedError(
-                            self.resource_id, partition, cause=e
-                        ) from e
-                for p in payloads:
-                    try:
-                        # decode stays streaming (one payload at a
-                        # time) but INSIDE the fetch guard: a
-                        # committed-but-corrupt block can survive
-                        # decompress and only fail here — still bad
-                        # producer bytes, not a transient compute error
-                        b = deserialize_batch(p, self._schema)
-                    except (struct.error, ValueError, EOFError) as e:
-                        raise FetchFailedError(
-                            self.resource_id, partition, cause=e
-                        ) from e
-                    if b.num_rows:
-                        self.metrics.add("output_rows", b.num_rows)
-                        yield b.to_device()
+            fetched = {"bytes": 0, "blocks": 0}
+            try:
+                yield from self._read_blocks(blocks, partition, ctx, fetched)
+            finally:
+                # emitted on ANY exit — a limit above the exchange can
+                # close the stream early, and the successfully-read
+                # blocks counted so far were still fetched
+                if fetched["blocks"]:
+                    trace.emit("shuffle_fetch", resource=self.resource_id,
+                               partition=partition, bytes=fetched["bytes"],
+                               blocks=fetched["blocks"])
 
         return stream()
+
+    def _read_blocks(self, blocks, partition: int, ctx: TaskContext,
+                     fetched: dict) -> BatchStream:
+        for block in blocks:
+            with self.metrics.timer("shuffle_read_total_time"):
+                faults.hit(
+                    "shuffle.fetch",
+                    attempt=ctx.task_attempt_id,
+                    detail=self.resource_id,
+                )
+                payloads: List[bytes] = []
+                try:
+                    if isinstance(block, bytes):
+                        off = 0
+                        while off < len(block):
+                            ln, cid = struct.unpack_from("<IB", block, off)
+                            from ..io.ipc_compression import decompress_frame
+
+                            payloads.append(decompress_frame(block[off : off + 5 + ln]))
+                            off += 5 + ln
+                    else:
+                        path, offset, length = block
+                        with open(path, "rb") as f:
+                            f.seek(offset)
+                            payloads.extend(IpcFrameReader(f, length))
+                except (OSError, struct.error, ValueError, EOFError) as e:
+                    # missing/torn/corrupt block: surface as a
+                    # typed fetch failure so the scheduler knows to
+                    # regenerate the producing map stage rather
+                    # than uselessly re-running this reader against
+                    # the same bad bytes (≙ FetchFailedException)
+                    raise FetchFailedError(
+                        self.resource_id, partition, cause=e
+                    ) from e
+                # counted only once the block's payloads are in hand:
+                # a failed fetch must not report bytes it never read
+                fetched["blocks"] += 1
+                fetched["bytes"] += (
+                    len(block) if isinstance(block, bytes) else block[2]
+                )
+            for p in payloads:
+                try:
+                    # decode stays streaming (one payload at a
+                    # time) but INSIDE the fetch guard: a
+                    # committed-but-corrupt block can survive
+                    # decompress and only fail here — still bad
+                    # producer bytes, not a transient compute error
+                    b = deserialize_batch(p, self._schema)
+                except (struct.error, ValueError, EOFError) as e:
+                    raise FetchFailedError(
+                        self.resource_id, partition, cause=e
+                    ) from e
+                if b.num_rows:
+                    self.metrics.add("output_rows", b.num_rows)
+                    yield b.to_device()
 
 
 class LocalShuffleManager:

@@ -25,6 +25,14 @@ Compiles-in-trace caveat: a jitted kernel called INSIDE another trace
 (the agg update program inlines the reduce + merge kernels) does not
 dispatch — composition sites call the raw function kept on
 ``wrapper.__wrapped__`` so inlined calls are never miscounted.
+
+Tracing integration (runtime/trace.py): while a trace kernel capture
+is active (``trace._KERNEL_TIMING``), every wrapped call additionally
+times the device-side drain with ``jax.block_until_ready`` and lands
+``device_ns`` / ``dispatch_ns`` / ``compile_ns`` on the operator
+kernel label that issued the program.  Disarmed (the default), the
+check is one module-global bool read and the pre-existing non-blocking
+path runs unchanged — asserted structurally by tests/test_trace.py.
 """
 
 from __future__ import annotations
@@ -33,6 +41,8 @@ import contextlib
 import threading
 import time
 from typing import Callable, Dict, Iterator, List
+
+from . import trace
 
 _LOCK = threading.Lock()
 _GLOBAL: Dict[str, int] = {}
@@ -85,12 +95,22 @@ def capture() -> Iterator[Dict[str, int]]:
         yield c
     finally:
         with _LOCK:
-            _CAPTURES.remove(c)
+            # identity removal: list.remove compares dicts by VALUE —
+            # a nested capture holding equal counts (common: a stage
+            # capture inside a query capture that has seen nothing
+            # else) would evict the OUTER dict and silently stop its
+            # accumulation for the rest of the scope
+            for i, d in enumerate(_CAPTURES):
+                if d is c:
+                    del _CAPTURES[i]
+                    break
 
 
-def instrument(fn: Callable) -> Callable:
+def instrument(fn: Callable, label: str = "kernel") -> Callable:
     """Wrap a jitted callable so every call records a dispatch and
-    cache-missing calls record a compile + its wall time.
+    cache-missing calls record a compile + its wall time.  ``label``
+    names the operator kernel (the structural head of its kernel-cache
+    key) for trace attribution.
 
     The raw function stays reachable as ``wrapper.__wrapped__`` for
     in-trace composition (calling the wrapper during tracing would
@@ -99,7 +119,12 @@ def instrument(fn: Callable) -> Callable:
     if size is None:  # not a jit function (host helper): count calls only
         def plain(*a, **k):
             record("xla_dispatches")
-            return fn(*a, **k)
+            if not trace._KERNEL_TIMING:
+                return fn(*a, **k)
+            t0 = time.perf_counter_ns()
+            out = fn(*a, **k)
+            trace.record_kernel(label, 0, time.perf_counter_ns() - t0, 0)
+            return out
 
         plain.__wrapped__ = fn
         return plain
@@ -113,17 +138,49 @@ def instrument(fn: Callable) -> Callable:
     state_lock = threading.Lock()
 
     def wrapper(*a, **k):
-        t0 = time.perf_counter()
+        if not trace._KERNEL_TIMING:  # pre-existing non-blocking path
+            t0 = time.perf_counter()
+            out = fn(*a, **k)
+            after = size()
+            record("xla_dispatches")
+            if after > state["seen"]:
+                with state_lock:
+                    delta = after - state["seen"]
+                    if delta > 0:
+                        state["seen"] = after
+                        record("xla_compiles", delta)
+                        record("compile_ms", int((time.perf_counter() - t0) * 1000))
+            return out
+        # traced: split the call into launch vs device drain.  Async
+        # dispatch returns once the program is enqueued, so the
+        # pre-block wall is host/launch overhead (or the XLA compile,
+        # when this call stepped the jit cache) and the block is the
+        # device execution bill for THIS program — serializing the
+        # device is the cost of attribution, paid only under capture.
+        import jax
+
+        t0 = time.perf_counter_ns()
         out = fn(*a, **k)
+        t1 = time.perf_counter_ns()
         after = size()
         record("xla_dispatches")
+        compiled = False
         if after > state["seen"]:
             with state_lock:
                 delta = after - state["seen"]
                 if delta > 0:
                     state["seen"] = after
+                    compiled = True
                     record("xla_compiles", delta)
-                    record("compile_ms", int((time.perf_counter() - t0) * 1000))
+                    record("compile_ms", int((t1 - t0) / 1e6))
+        jax.block_until_ready(out)
+        t2 = time.perf_counter_ns()
+        trace.record_kernel(
+            label,
+            device_ns=t2 - t1,
+            dispatch_ns=0 if compiled else t1 - t0,
+            compile_ns=t1 - t0 if compiled else 0,
+        )
         return out
 
     wrapper.__wrapped__ = fn

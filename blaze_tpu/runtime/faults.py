@@ -146,6 +146,12 @@ class FaultInjector:
             self._counts[site] = n
         for hit_no, want_attempt in matches:
             if n == hit_no and (want_attempt is None or want_attempt == attempt):
+                # record the injection BEFORE raising so a chaos run's
+                # event log pairs every fault with its recovery event
+                from . import trace
+
+                trace.emit("fault_injected", site=site, hit=n,
+                           attempt=attempt, detail=detail)
                 if site == "shuffle.fetch":
                     from .retry import FetchFailedError
 

@@ -38,28 +38,36 @@ def key_cacheable(key) -> bool:
     return key != "opaque"
 
 
-def _instrumented(built: Any) -> Any:
-    """Wrap the builder's kernel(s) with dispatch/compile counting
-    (runtime.dispatch): builders return one callable or a tuple of
-    them.  Composition sites that inline a kernel inside another trace
-    unwrap via ``dispatch.raw``."""
+def _kernel_label(key) -> str:
+    """Operator attribution label for trace spans: the structural head
+    of the kernel-cache key ("agg", "filter", "fused_stage", ...)."""
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        return key[0]
+    return "kernel"
+
+
+def _instrumented(built: Any, label: str = "kernel") -> Any:
+    """Wrap the builder's kernel(s) with dispatch/compile counting and
+    trace attribution under ``label`` (runtime.dispatch): builders
+    return one callable or a tuple of them.  Composition sites that
+    inline a kernel inside another trace unwrap via ``dispatch.raw``."""
     from .dispatch import instrument
 
     if isinstance(built, tuple):
-        return tuple(instrument(f) if callable(f) else f for f in built)
-    return instrument(built) if callable(built) else built
+        return tuple(instrument(f, label) if callable(f) else f for f in built)
+    return instrument(built, label) if callable(built) else built
 
 
 def cached_kernel(key: tuple, builder: Callable[[], Any]) -> Any:
     """Return the kernel(s) registered under ``key``, building once.
     Keys containing opaque expressions bypass the cache."""
     if not key_cacheable(key):
-        return _instrumented(builder())
+        return _instrumented(builder(), _kernel_label(key))
     with _LOCK:
         hit = _CACHE.get(key)
         if hit is not None:
             return hit
-    built = _instrumented(builder())
+    built = _instrumented(builder(), _kernel_label(key))
     with _LOCK:
         return _CACHE.setdefault(key, built)
 

@@ -184,6 +184,12 @@ class MemManager:
         self._consumers: List[MemConsumer] = []
         self.spill_count = 0
         self.spilled_bytes = 0
+        # high-water mark for the trace gauge: emit only on meaningful
+        # advances (>5% past the last emitted peak), never per update.
+        # Keyed to the active event-log file so each traced query on
+        # this process-global singleton gets its own gauge ramp.
+        self._traced_peak = 0
+        self._traced_log: object = None
 
     @classmethod
     def init(cls, total: Optional[int] = None) -> "MemManager":
@@ -212,8 +218,28 @@ class MemManager:
         return sum(c._mem_used for c in self._consumers)
 
     def _update(self, consumer: MemConsumer, new_used: int) -> None:
+        from . import trace
+
         with self._lock:
             consumer._mem_used = new_used
+            emit_peak = 0
+            # ratchet only while tracing is armed (an untraced run
+            # advancing the peak would mute the gauge for a later
+            # traced run — chaos runs its untraced baseline first),
+            # and restart the ramp whenever the event log rolls to a
+            # new query's file
+            if trace.enabled():
+                log = trace.current_path()
+                if log != self._traced_log:
+                    self._traced_log = log
+                    self._traced_peak = 0
+                used = self._total_used()
+                if used > self._traced_peak * 1.05:
+                    self._traced_peak = used
+                    emit_peak = used
+        if emit_peak:
+            # outside the lock: trace.emit does file IO
+            trace.emit("mem_watermark", used=emit_peak, total=self.total)
         self._maybe_spill()
 
     def _maybe_spill(self) -> None:
@@ -225,6 +251,8 @@ class MemManager:
         # spill outside the lock: consumers re-enter accounting; a
         # concurrent spill of the same victim is benign (its spill()
         # finds no state and returns 0, which we don't count)
+        from . import trace
+
         for v in victims:
             if over <= 0:
                 break
@@ -235,6 +263,7 @@ class MemManager:
                 with self._lock:
                     self.spill_count += 1
                     self.spilled_bytes += freed
+                trace.emit("spill", consumer=v.name, bytes=freed)
             over -= freed
 
 

@@ -5,29 +5,55 @@ JVM side walks into Spark SQL UI metrics.
 native mirror walk (blaze/src/metrics.rs:21-57).  The default metric
 set matches NativeHelper.getDefaultNativeMetrics (NativeHelper.scala:
 92-122): elapsed_compute, output_rows, spill counts/sizes, io times.
+
+Thread safety: operators execute concurrently (exchange map fan-out,
+worker threads, the memory manager spilling one consumer from another
+task's thread), and ``values[name] = values.get(name, 0) + v`` is a
+read-modify-write race under concurrency — both ``MetricsSet`` updates
+and ``MetricNode.child`` growth take a per-instance lock.  The gateway
+metrics-callback seam is unchanged: callbacks still read ``values`` /
+walk ``foreach`` exactly as before.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 
 class MetricsSet:
-    """Counters + timers for one operator instance."""
+    """Counters + timers for one operator instance (thread-safe)."""
 
     def __init__(self):
         self.values: Dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def add(self, name: str, v: int = 1) -> None:
-        self.values[name] = self.values.get(name, 0) + int(v)
+        with self._lock:
+            self.values[name] = self.values.get(name, 0) + int(v)
 
     def set(self, name: str, v: int) -> None:
-        self.values[name] = int(v)
+        with self._lock:
+            self.values[name] = int(v)
 
     def get(self, name: str) -> int:
-        return self.values.get(name, 0)
+        with self._lock:
+            return self.values.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        """Point-in-time copy (trace task_plan events, tests)."""
+        with self._lock:
+            return dict(self.values)
+
+    def merge(self, other: "MetricsSet") -> None:
+        """Fold another set's counters into this one.  Concurrency in
+        the runtime is handled by the per-instance lock (operators
+        share one set across worker threads); this helper is for
+        consumers aggregating sets they collected themselves."""
+        for k, v in other.snapshot().items():
+            self.add(k, v)
 
     @contextmanager
     def timer(self, name: str):
@@ -47,22 +73,24 @@ class MetricNode:
     def __init__(self, metrics: Optional[MetricsSet] = None, children: Optional[List["MetricNode"]] = None):
         self.metrics = metrics or MetricsSet()
         self.children = children or []
+        self._lock = threading.Lock()
 
     def child(self, i: int) -> "MetricNode":
-        while len(self.children) <= i:
-            self.children.append(MetricNode())
-        return self.children[i]
+        with self._lock:
+            while len(self.children) <= i:
+                self.children.append(MetricNode())
+            return self.children[i]
 
     def foreach(self, fn, path=()):
         fn(path, self.metrics)
-        for i, c in enumerate(self.children):
+        for i, c in enumerate(list(self.children)):
             c.foreach(fn, path + (i,))
 
     def flatten(self) -> Dict[str, int]:
         out: Dict[str, int] = {}
 
         def visit(path, ms):
-            for k, v in ms.values.items():
+            for k, v in ms.snapshot().items():
                 out[".".join(map(str, path)) + ":" + k] = v
 
         self.foreach(visit)

@@ -26,6 +26,7 @@ from .. import conf
 from ..ops import ExecNode
 from ..parallel.exchange import NativeShuffleExchangeExec
 from ..parallel.shuffle import IpcReaderExec, LocalShuffleManager, ShuffleWriterExec
+from . import trace
 from .context import RESOURCES, TaskContext
 from .metrics import MetricNode
 
@@ -370,6 +371,8 @@ def run_stages(
         outputs and re-run just the producing map stage (≙ DAGScheduler
         resubmitting the parent stage on FetchFailed)."""
         sched_m.add("map_stage_reruns", 1)
+        trace.emit("map_stage_rerun", stage_id=mstage.stage_id,
+                   shuffle_id=mstage.shuffle_id)
         manager.invalidate(mstage.shuffle_id)
         run_stage_tasks(mstage)
         n_maps[mstage.shuffle_id] = mstage.n_tasks
@@ -382,6 +385,8 @@ def run_stages(
         action = classify(exc)
         if action == FETCH_FAILED:
             sched_m.add("fetch_failures", 1)
+            trace.emit("fetch_failure", stage_id=stage.stage_id, task=t,
+                       shuffle_id=exc.shuffle_id)
             sid = exc.shuffle_id
             mstage = map_stage_by_shuffle.get(sid) if sid is not None else None
             if mstage is not None:
@@ -403,8 +408,12 @@ def run_stages(
                     stage.stage_id, t, attempt, exc
                 ) from exc
             sched_m.add("task_retries", 1)
+            trace.emit("task_retry", stage_id=stage.stage_id, task=t,
+                       attempt=attempt, reason=type(exc).__name__)
             if isinstance(exc, TaskTimeoutError):
                 sched_m.add("task_timeouts", 1)
+                trace.emit("task_timeout", stage_id=stage.stage_id, task=t,
+                           attempt=attempt - 1)
             policy.sleep_before_retry(stage.stage_id, t, attempt - 1)
             return attempt, regens
         raise exc  # FATAL
@@ -421,13 +430,20 @@ def run_stages(
             block_keys = register(t)
             td, staged = build_attempt_td(stage, t, attempt)
             sched_m.add("task_attempts", 1)
+            trace.emit("task_attempt_start", stage_id=stage.stage_id,
+                       task=t, attempt=attempt)
             try:
                 batches: List = []
                 drain(stage, t,
                       from_proto.run_task(td, task_attempt_id=attempt),
                       batches)
+                trace.emit("task_attempt_end", stage_id=stage.stage_id,
+                           task=t, attempt=attempt, status="ok")
                 return batches
             except BaseException as exc:
+                trace.emit("task_attempt_end", stage_id=stage.stage_id,
+                           task=t, attempt=attempt, status="failed",
+                           error=f"{type(exc).__name__}: {exc}"[:300])
                 for key in staged + block_keys:
                     RESOURCES.discard(key)
                 attempt, regens = handle_failure(stage, t, exc, attempt, regens)
@@ -446,6 +462,8 @@ def run_stages(
             block_keys = register(t)
             td, staged = build_attempt_td(stage, t, attempt)
             sched_m.add("task_attempts", 1)
+            trace.emit("task_attempt_start", stage_id=stage.stage_id,
+                       task=t, attempt=attempt)
             yielded = False
             try:
                 deadline = policy.deadline()
@@ -459,8 +477,13 @@ def run_stages(
                         )
                     yielded = True
                     yield b
+                trace.emit("task_attempt_end", stage_id=stage.stage_id,
+                           task=t, attempt=attempt, status="ok")
                 return
             except BaseException as exc:
+                trace.emit("task_attempt_end", stage_id=stage.stage_id,
+                           task=t, attempt=attempt, status="failed",
+                           error=f"{type(exc).__name__}: {exc}"[:300])
                 for key in staged + block_keys:
                     RESOURCES.discard(key)
                 if yielded:
@@ -523,18 +546,53 @@ def run_stages(
                 snode.add(k, v)
                 sched_m.add(k, v)
 
+    import contextlib
+
+    @contextlib.contextmanager
+    def stage_scope(stage: Stage):
+        """Per-stage observability: the dispatch capture every run
+        gets, plus — when tracing is armed — a trace kernel capture
+        (block-until-ready attribution) bracketed by
+        stage_submit/stage_complete events carrying the
+        device/dispatch/compile split and the dispatch counters."""
+        traced = trace.enabled()
+        with contextlib.ExitStack() as stack:
+            kc = stack.enter_context(trace.kernel_capture()) if traced else {}
+            if traced:
+                trace.emit("stage_submit", stage_id=stage.stage_id,
+                           kind=stage.kind, n_tasks=stage.n_tasks,
+                           shuffle_id=stage.shuffle_id)
+            t0 = time.perf_counter_ns()
+            cap = stack.enter_context(dispatch.capture())
+            status = "ok"
+            try:
+                yield cap
+            except BaseException:
+                status = "failed"
+                raise
+            finally:
+                if traced:
+                    trace.emit(
+                        "stage_complete", stage_id=stage.stage_id,
+                        kind=stage.kind, n_tasks=stage.n_tasks,
+                        shuffle_id=stage.shuffle_id, status=status,
+                        wall_ns=time.perf_counter_ns() - t0,
+                        kernels=kc, counters=dict(cap),
+                        **trace.sum_kernels(kc),
+                    )
+
     for stage in stages:
         if adaptive_on:
             maybe_rewrite_stage(stage, manager, n_maps, bcast_blobs,
                                 next_adaptive_bid)
         if stage.kind == "result":
             register = make_registrar(stage)
-            with dispatch.capture() as cap:
+            with stage_scope(stage) as cap:
                 for t in range(stage.n_tasks):
                     yield from run_result_task(stage, t, register)
             publish_dispatch(stage, cap)
             continue
-        with dispatch.capture() as cap:
+        with stage_scope(stage) as cap:
             run_stage_tasks(stage)
         publish_dispatch(stage, cap)
         if stage.kind == "map":

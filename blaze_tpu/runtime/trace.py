@@ -1,0 +1,314 @@
+"""Query-level tracing + structured JSONL event log.
+
+≙ Spark's ``EventLoggingListener`` + SQL-tab timeline, sized for this
+engine: the reference's only observability surface is the MetricNode
+tree walked into Spark SQL UI metrics (MetricNode.scala:21-41,
+metrics.rs:21-57) — flat counters, no timeline, no attribution.  This
+module adds the missing dynamics layer as a span/event stream:
+
+    query -> stage -> task attempt -> operator kernel
+
+Every event is one JSON object per line with ``ts`` (epoch seconds)
+and ``type``; the golden schema lives in ``trace_schema.json`` next to
+this file and `tests/test_trace.py` fails tier-1 on drift.
+
+The split that matters on TPU rides on the kernel events: with tracing
+active, every instrumented jit call (runtime.dispatch wrappers, applied
+centrally in kernel_cache.cached_kernel) is timed as
+
+- ``dispatch_overhead_ns`` — host time to trace/launch the program
+  (async dispatch returns before the device runs),
+- ``device_time_ns``      — block-until-ready drain after the launch,
+- ``compile_ns``          — launch time of calls that triggered a
+  fresh XLA compile (the whole pre-block wall is the compile bill),
+
+attributed to the operator kernel label that issued the program (the
+structural head of its kernel-cache key: "agg", "filter",
+"fused_stage", "shuffle_pids", ...).  Blocking per program serializes
+the device — that is the point of a profile, and the reason tracing is
+OFF by default: the disarmed check is one module-global bool read per
+kernel call (``_KERNEL_TIMING``) and one per lifecycle site
+(``enabled()``), with zero allocation.
+
+Consumers: the stage scheduler emits lifecycle events
+(stage submit/complete, task attempt start/end/retry/timeout,
+fetch-failure -> map-stage rerun), runtime.faults records each injected
+fault, runtime.memmgr contributes watermark gauges + spill events,
+parallel.shuffle / parallel.rss contribute bytes/blocks moved, and
+``python -m blaze_tpu --report <eventlog>`` (runtime/trace_report.py)
+renders the per-query profile.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from .. import conf
+
+# ------------------------------------------------------------- registry
+
+#: every event type this module may emit — MUST stay in lockstep with
+#: trace_schema.json (tests/test_trace.py gates the drift both ways)
+EVENT_TYPES = frozenset({
+    "query_start", "query_end",
+    "stage_submit", "stage_complete",
+    "task_attempt_start", "task_attempt_end",
+    "task_retry", "task_timeout",
+    "fetch_failure", "map_stage_rerun",
+    "task_kernels", "task_plan",
+    "fault_injected",
+    "mem_watermark", "spill",
+    "shuffle_write", "shuffle_fetch", "rss_push",
+})
+
+SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "trace_schema.json")
+
+# --------------------------------------------------------------- state
+
+_lock = threading.Lock()
+#: kernel sinks get their OWN lock: record_kernel runs once per traced
+#: XLA program and must never contend with event-file IO under _lock
+_sink_lock = threading.Lock()
+_loaded = False
+_armed = False          # event-log emission on (conf spark.blaze.trace.enabled)
+_dir = ""               # resolved event-log directory
+_path: Optional[str] = None   # current log file (None = process default)
+_default_path: Optional[str] = None
+_seq = 0                # per-process query-log sequence number
+# one cached append handle for the active log file: per-event
+# open/close would serialize every emitter behind syscalls under _lock
+_file = None            # (path, handle)
+
+_KERNEL_SINKS: List[Dict[str, Dict[str, int]]] = []
+#: read lock-free on the dispatch hot path: True only while at least
+#: one kernel_capture() scope is active (bench profiling or an armed
+#: traced run) — False keeps instrumented kernels on the pre-existing
+#: non-blocking path
+_KERNEL_TIMING = False
+
+# introspection counters for the overhead-gating regression test
+_events_emitted = 0
+_spans_opened = 0
+
+
+def _load() -> None:
+    global _loaded, _armed, _dir
+    with _lock:
+        _armed = bool(conf.TRACE_ENABLE.get())
+        d = str(conf.EVENT_LOG_DIR.get() or "")
+        _dir = d or os.path.join(tempfile.gettempdir(), "blaze_eventlog")
+        _loaded = True
+
+
+def enabled() -> bool:
+    """Event-log emission armed?  Lazily loads conf once; call
+    :func:`reset` after flipping ``spark.blaze.trace.enabled``."""
+    if not _loaded:
+        _load()
+    return _armed
+
+
+def reset() -> None:
+    """(Re)load arming + directory from conf and forget the current log
+    file and counters — call after changing trace conf keys."""
+    global _path, _default_path, _events_emitted, _spans_opened, _seq, _file
+    _load()
+    with _lock:
+        _path = None
+        _default_path = None
+        _events_emitted = 0
+        _spans_opened = 0
+        _seq = 0
+        if _file is not None:
+            _file[1].close()
+            _file = None
+
+
+def counters() -> Dict[str, int]:
+    """Introspection for the gating tests: how many events/spans this
+    process has produced since the last :func:`reset`."""
+    with _lock:
+        return {"events": _events_emitted, "spans": _spans_opened}
+
+
+def log_dir() -> str:
+    if not _loaded:
+        _load()
+    os.makedirs(_dir, exist_ok=True)
+    return _dir
+
+
+def current_path() -> Optional[str]:
+    """The file events are being appended to right now (None when no
+    event has been written and no query span is open)."""
+    return _path or _default_path
+
+
+# ------------------------------------------------------------- emission
+
+def emit(etype: str, **fields: Any) -> None:
+    """Append one event to the active log file.  No-op when tracing is
+    disarmed; unknown event types raise (schema drift must fail loudly,
+    not mint unvalidatable lines)."""
+    if not enabled():
+        return
+    if etype not in EVENT_TYPES:
+        raise ValueError(f"unregistered trace event type {etype!r}")
+    global _events_emitted, _default_path
+    rec = {"ts": time.time(), "type": etype}
+    rec.update(fields)
+    line = json.dumps(rec, default=str)
+    global _file
+    with _lock:
+        path = _path
+        if path is None:
+            if _default_path is None:
+                _default_path = os.path.join(
+                    _dir, f"blaze-{os.getpid()}.jsonl")
+                os.makedirs(_dir, exist_ok=True)
+            path = _default_path
+        if _file is None or _file[0] != path:
+            if _file is not None:
+                _file[1].close()
+            _file = (path, open(path, "a"))
+        _file[1].write(line + "\n")
+        _file[1].flush()  # whole lines reach readers/crash dumps now
+        _events_emitted += 1
+
+
+@contextlib.contextmanager
+def query(query_id: str) -> Iterator[Optional[str]]:
+    """Scope one traced query: opens a fresh JSONL file under the
+    event-log dir, emits query_start/query_end around the body, and
+    yields the file path (None when tracing is disarmed)."""
+    if not enabled():
+        yield None
+        return
+    global _path, _seq, _spans_opened
+    with _lock:
+        _seq += 1
+        safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in query_id)
+        path = os.path.join(_dir, f"{safe}-{os.getpid()}-{_seq}.jsonl")
+        os.makedirs(_dir, exist_ok=True)
+        prev = _path
+        _path = path
+        _spans_opened += 1
+    t0 = time.perf_counter_ns()
+    emit("query_start", query_id=query_id)
+    status = "ok"
+    try:
+        yield path
+    except BaseException:
+        status = "failed"
+        raise
+    finally:
+        emit("query_end", query_id=query_id, status=status,
+             wall_ns=time.perf_counter_ns() - t0)
+        with _lock:
+            _path = prev
+
+
+# -------------------------------------------------- kernel attribution
+
+@contextlib.contextmanager
+def kernel_capture() -> Iterator[Dict[str, Dict[str, int]]]:
+    """Scope that accumulates per-kernel-label cost while active:
+    ``{label: {programs, device_ns, dispatch_ns, compile_ns}}``.
+
+    Activating ANY capture flips instrumented kernels onto the timed
+    block-until-ready path (runtime.dispatch), device-serializing
+    execution for the duration — profiling changes what it measures,
+    the same way Spark's spark.python.profile does.  Nested/concurrent
+    captures each get the full counts (scheduler per stage, run_task
+    per attempt, bench per profile pass)."""
+    global _KERNEL_TIMING
+    sink: Dict[str, Dict[str, int]] = {}
+    with _sink_lock:
+        _KERNEL_SINKS.append(sink)
+        _KERNEL_TIMING = True
+    try:
+        yield sink
+    finally:
+        with _sink_lock:
+            # identity removal: list.remove compares dicts by VALUE,
+            # so a nested capture with equal contents (e.g. two empty
+            # sinks) would evict the outer scope's dict instead
+            for i, s in enumerate(_KERNEL_SINKS):
+                if s is sink:
+                    del _KERNEL_SINKS[i]
+                    break
+            _KERNEL_TIMING = bool(_KERNEL_SINKS)
+
+
+#: bench.py alias: profile one run's kernel split without an event log
+profile_kernels = kernel_capture
+
+
+def record_kernel(label: str, device_ns: int, dispatch_ns: int,
+                  compile_ns: int) -> None:
+    """Dispatch-wrapper callback: land one program's cost on every
+    active capture under its operator kernel label."""
+    with _sink_lock:
+        for sink in _KERNEL_SINKS:
+            agg = sink.get(label)
+            if agg is None:
+                agg = sink[label] = {
+                    "programs": 0, "device_ns": 0,
+                    "dispatch_ns": 0, "compile_ns": 0,
+                }
+            agg["programs"] += 1
+            agg["device_ns"] += int(device_ns)
+            agg["dispatch_ns"] += int(dispatch_ns)
+            agg["compile_ns"] += int(compile_ns)
+
+
+def sum_kernels(sink: Dict[str, Dict[str, int]]) -> Dict[str, int]:
+    """Collapse a kernel capture into the per-span totals the event
+    schema carries."""
+    return {
+        "programs": sum(v["programs"] for v in sink.values()),
+        "device_time_ns": sum(v["device_ns"] for v in sink.values()),
+        "dispatch_overhead_ns": sum(v["dispatch_ns"] for v in sink.values()),
+        "compile_ns": sum(v["compile_ns"] for v in sink.values()),
+    }
+
+
+# ------------------------------------------------------------- reading
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL event log (torn trailing line tolerated — the
+    writer appends whole lines but a crash can truncate the last)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def load_schema() -> Dict[str, Any]:
+    """The golden per-event-type JSON schema (trace_schema.json)."""
+    with open(SCHEMA_PATH) as f:
+        return json.load(f)
+
+
+def plan_tree(plan) -> Dict[str, Any]:
+    """Plan-annotated metrics tree for the ``task_plan`` event: the
+    executed plan instance's per-node MetricsSet snapshots, nested the
+    way MetricNode mirrors the plan (MetricNode.scala:21-41)."""
+    return {
+        "op": plan.name(),
+        "metrics": plan.metrics.snapshot(),
+        "children": [plan_tree(c) for c in plan.children],
+    }

@@ -1,0 +1,244 @@
+"""Render a per-query profile from a structured event log.
+
+``python -m blaze_tpu --report <eventlog>`` — the standalone analogue
+of Spark's history-server SQL tab over an ``EventLoggingListener``
+log: per-stage timeline, the dispatch-floor vs on-chip-compute
+breakdown VERDICT r5 asked to be judgeable in-repo, the plan-annotated
+metrics tree, the shuffle/memory totals, and the retry/fault timeline
+a chaos run leaves behind.
+
+Everything here is a pure function over the parsed event list
+(runtime.trace.read_events), so tests and the chaos reconciliation
+gate consume the same helpers the CLI renders with.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+#: event types that count as RECOVERY for an injected fault: a plain
+#: task re-attempt, or a map-stage rerun after a fetch failure
+RECOVERY_EVENTS = ("task_retry", "map_stage_rerun")
+
+
+def _fmt_s(ns: float) -> str:
+    return f"{ns / 1e9:.3f}s"
+
+
+def _pct(part: float, whole: float) -> str:
+    return f"{100.0 * part / whole:.0f}%" if whole else "-"
+
+
+def by_type(events: List[Dict[str, Any]]) -> Dict[str, List[Dict[str, Any]]]:
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        out.setdefault(e.get("type", "?"), []).append(e)
+    return out
+
+
+def reconcile_faults(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pair every ``fault_injected`` with the first subsequent recovery
+    event (``task_retry`` or ``map_stage_rerun``) in log order — the
+    chaos gate's reconciliation contract: a fault the runtime absorbed
+    silently (no recovery recorded) or a recovery with no cause both
+    break the replayable-recovery story."""
+    pairs: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
+    unpaired: List[Dict[str, Any]] = []
+    used: set = set()
+    for i, e in enumerate(events):
+        if e.get("type") != "fault_injected":
+            continue
+        match: Optional[int] = None
+        for j in range(i + 1, len(events)):
+            if j in used:
+                continue
+            if events[j].get("type") in RECOVERY_EVENTS:
+                match = j
+                break
+        if match is None:
+            unpaired.append(e)
+        else:
+            used.add(match)
+            pairs.append((e, events[match]))
+    recoveries = sum(1 for e in events if e.get("type") in RECOVERY_EVENTS)
+    return {
+        "injected": len(pairs) + len(unpaired),
+        "recoveries": recoveries,
+        "pairs": pairs,
+        "unpaired": unpaired,
+        "reconciled": not unpaired,
+    }
+
+
+def _merge_plan(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Sum two task_plan trees node-by-node (same stage => same plan
+    shape; a rewritten/retried plan that differs structurally keeps the
+    first shape and merges what aligns)."""
+    merged = {
+        "op": a["op"],
+        "metrics": dict(a["metrics"]),
+        "children": [dict(c) for c in a["children"]],
+    }
+    for k, v in b.get("metrics", {}).items():
+        merged["metrics"][k] = merged["metrics"].get(k, 0) + v
+    kids = []
+    for i, c in enumerate(merged["children"]):
+        if i < len(b.get("children", [])):
+            kids.append(_merge_plan(c, b["children"][i]))
+        else:
+            kids.append(c)
+    merged["children"] = kids
+    return merged
+
+
+def _render_plan(node: Dict[str, Any], indent: int, out: List[str]) -> None:
+    metrics = node.get("metrics", {})
+    shown = " ".join(
+        f"{k}={v}" for k, v in sorted(metrics.items())
+        if not k.startswith("_")
+    )
+    out.append("  " * indent + node["op"] + (f"  [{shown}]" if shown else ""))
+    for c in node.get("children", []):
+        _render_plan(c, indent + 1, out)
+
+
+def render(events: List[Dict[str, Any]]) -> str:
+    """The full profile report (plain text)."""
+    if not events:
+        return "empty event log"
+    t = by_type(events)
+    lines: List[str] = []
+    ts0 = min(e["ts"] for e in events if "ts" in e)
+
+    # ---- header
+    queries = [e.get("query_id", "?") for e in t.get("query_start", [])]
+    ends = t.get("query_end", [])
+    wall_ns = sum(e.get("wall_ns", 0) for e in ends)
+    lines.append(
+        f"query: {', '.join(queries) if queries else '(no query span)'}"
+        + (f"  wall {_fmt_s(wall_ns)}" if wall_ns else "")
+        + f"  events {len(events)}"
+    )
+
+    # ---- per-stage timeline + dispatch-floor split
+    completes = sorted(t.get("stage_complete", []),
+                       key=lambda e: e.get("stage_id", 0))
+    submits = {e.get("stage_id"): e for e in t.get("stage_submit", [])}
+    if completes:
+        lines.append("")
+        lines.append("stage timeline (device vs dispatch-floor vs compile):")
+        total = {"wall": 0, "dev": 0, "disp": 0, "comp": 0}
+        for e in completes:
+            sid = e.get("stage_id")
+            sub = submits.get(sid, {})
+            start = sub.get("ts", e["ts"]) - ts0
+            wall = e.get("wall_ns", 0)
+            dev = e.get("device_time_ns", 0)
+            disp = e.get("dispatch_overhead_ns", 0)
+            comp = e.get("compile_ns", 0)
+            total["wall"] += wall
+            total["dev"] += dev
+            total["disp"] += disp
+            total["comp"] += comp
+            lines.append(
+                f"  stage {sid} {e.get('kind', '?'):9s} +{start:7.3f}s "
+                f"wall {_fmt_s(wall):>9s}  tasks {e.get('n_tasks', '?')}  "
+                f"programs {e.get('programs', 0):>4d}  "
+                f"device {_fmt_s(dev)} ({_pct(dev, wall)})  "
+                f"dispatch {_fmt_s(disp)} ({_pct(disp, wall)})  "
+                f"compile {_fmt_s(comp)}"
+                + ("" if e.get("status", "ok") == "ok" else "  <-- FAILED")
+            )
+        unattr = max(0, total["wall"] - total["dev"] - total["disp"] - total["comp"])
+        lines.append(
+            f"  total: device {_pct(total['dev'], total['wall'])}  "
+            f"dispatch-floor {_pct(total['disp'], total['wall'])}  "
+            f"compile {_pct(total['comp'], total['wall'])}  "
+            f"host/other {_pct(unattr, total['wall'])} of "
+            f"{_fmt_s(total['wall'])} stage wall"
+        )
+
+        # per-kernel-label attribution across all stages
+        kernels: Dict[str, Dict[str, int]] = {}
+        for e in completes:
+            for label, v in (e.get("kernels") or {}).items():
+                agg = kernels.setdefault(
+                    label, {"programs": 0, "device_ns": 0,
+                            "dispatch_ns": 0, "compile_ns": 0})
+                for k in agg:
+                    agg[k] += v.get(k, 0)
+        if kernels:
+            lines.append("")
+            lines.append("operator kernels (by device time):")
+            for label, v in sorted(kernels.items(),
+                                   key=lambda kv: -kv[1]["device_ns"]):
+                lines.append(
+                    f"  {label:24s} programs {v['programs']:>5d}  "
+                    f"device {_fmt_s(v['device_ns']):>9s}  "
+                    f"dispatch {_fmt_s(v['dispatch_ns']):>9s}  "
+                    f"compile {_fmt_s(v['compile_ns'])}"
+                )
+
+    # ---- plan-annotated metrics tree (merged per stage)
+    plans: Dict[int, Dict[str, Any]] = {}
+    for e in t.get("task_plan", []):
+        sid = e.get("stage_id", 0)
+        plans[sid] = (
+            _merge_plan(plans[sid], e["plan"]) if sid in plans else e["plan"]
+        )
+    for sid in sorted(plans):
+        lines.append("")
+        lines.append(f"plan (stage {sid}, metrics merged over task attempts):")
+        sub: List[str] = []
+        _render_plan(plans[sid], 1, sub)
+        lines.extend(sub)
+
+    # ---- data movement + memory
+    sw = t.get("shuffle_write", [])
+    sf = t.get("shuffle_fetch", [])
+    rp = t.get("rss_push", [])
+    sp = t.get("spill", [])
+    wm = t.get("mem_watermark", [])
+    if sw or sf or rp or sp or wm:
+        lines.append("")
+        lines.append("data movement / memory:")
+        if sw:
+            lines.append(f"  shuffle write: {sum(e['bytes'] for e in sw)} B "
+                         f"in {sum(e['blocks'] for e in sw)} blocks "
+                         f"({len(sw)} map outputs)")
+        if sf:
+            lines.append(f"  shuffle fetch: {sum(e['bytes'] for e in sf)} B "
+                         f"in {sum(e['blocks'] for e in sf)} blocks "
+                         f"({len(sf)} reads)")
+        if rp:
+            lines.append(f"  rss push:      {sum(e['bytes'] for e in rp)} B "
+                         f"in {sum(e['blocks'] for e in rp)} blocks")
+        if sp:
+            lines.append(f"  spills:        {len(sp)} "
+                         f"({sum(e['bytes'] for e in sp)} B freed)")
+        if wm:
+            peak = max(e["used"] for e in wm)
+            lines.append(f"  mem watermark: peak {peak} B "
+                         f"of {wm[-1].get('total', 0)} B budget")
+
+    # ---- retry / fault timeline
+    timeline_types = {"fault_injected", "fetch_failure", "task_retry",
+                      "task_timeout", "map_stage_rerun"}
+    incidents = [e for e in events if e.get("type") in timeline_types]
+    incidents += [e for e in t.get("task_attempt_end", [])
+                  if e.get("status") == "failed"]
+    incidents.sort(key=lambda e: e.get("ts", 0))
+    if incidents:
+        rec = reconcile_faults(events)
+        lines.append("")
+        lines.append(
+            f"recovery timeline ({rec['injected']} faults injected, "
+            f"{rec['recoveries']} recovery events, "
+            + ("reconciled):" if rec["reconciled"] else "NOT RECONCILED):")
+        )
+        for e in incidents:
+            dt = e.get("ts", ts0) - ts0
+            detail = {k: v for k, v in e.items() if k not in ("ts", "type")}
+            parts = " ".join(f"{k}={v}" for k, v in detail.items())
+            lines.append(f"  +{dt:7.3f}s {e['type']:18s} {parts}")
+    return "\n".join(lines)

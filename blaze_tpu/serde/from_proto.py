@@ -406,4 +406,38 @@ def run_task(task_def_bytes: bytes, task_attempt_id: int = 0):
         td.partition, max(plan.num_partitions(), td.partition + 1),
         stage_id=td.stage_id, task_attempt_id=task_attempt_id,
     )
-    return plan.execute(td.partition, ctx)
+    stream = plan.execute(td.partition, ctx)
+    from ..runtime import trace
+
+    if not trace.enabled():
+        return stream
+    return _traced_task_stream(stream, plan, td, task_attempt_id)
+
+
+def _traced_task_stream(stream, plan, td, attempt: int):
+    """Tracing-armed task drive: a kernel capture attributes every XLA
+    program issued while this attempt runs to its operator label, and
+    on completion the attempt emits its kernel split (``task_kernels``)
+    plus the plan-annotated metrics tree (``task_plan`` — the executed
+    plan instance's per-node MetricsSet, the per-attempt analogue of
+    the MetricNode walk the JVM gateway does)."""
+    import time as _time
+
+    from ..runtime import trace
+
+    t0 = _time.perf_counter_ns()
+    with trace.kernel_capture() as kc:
+        try:
+            yield from stream
+        finally:
+            trace.emit(
+                "task_kernels", task_id=td.task_id, stage_id=td.stage_id,
+                partition=td.partition, attempt=attempt,
+                wall_ns=_time.perf_counter_ns() - t0, kernels=kc,
+                **trace.sum_kernels(kc),
+            )
+            trace.emit(
+                "task_plan", task_id=td.task_id, stage_id=td.stage_id,
+                partition=td.partition, attempt=attempt,
+                plan=trace.plan_tree(plan),
+            )
