@@ -158,7 +158,16 @@ def _rows_via_scheduler(plan):
 def _warmup(suite: str, names, scale: float, n_parts: int,
             cache_dir: str = "") -> int:
     """Pre-warm the persistent XLA compile cache and gate on warm-run
-    recompiles (see module docstring)."""
+    recompiles (see module docstring).  Two passes per query, each run
+    twice (cold + gated warm):
+
+    1. **in-process** — the plan fused/pruned exactly as run_task would;
+    2. **scheduler** — the plan split at its exchanges and driven
+       through real TaskDefinition bytes (``split_stages``/
+       ``run_stages``), so the programs only that path compiles — the
+       per-task ShuffleWriterExec wrap, the tier-5 fused shuffle-write
+       kernels, the IPC reader decode — are warmed too and a
+       scheduler-path warm run sees zero recompiles."""
     import os
 
     from . import conf
@@ -186,22 +195,34 @@ def _warmup(suite: str, names, scale: float, n_parts: int,
                 rows += b.num_rows
         return rows
 
+    def run_scheduler_once(name):
+        from .runtime.scheduler import run_stages, split_stages
+
+        stages, manager = split_stages(build_query(name, scans, n_parts))
+        rows = 0
+        for b in run_stages(stages, manager):
+            rows += b.num_rows
+        return rows
+
     failed = []
     for name in names:
-        t0 = time.perf_counter()
-        with dispatch.capture() as cold:
-            run_once(name)
-        with dispatch.capture() as warm:
-            run_once(name)
-        dt = time.perf_counter() - t0
-        ok = warm.get("xla_compiles", 0) == 0
-        print(f"warmup {suite} {name}: cold compiles={cold.get('xla_compiles', 0)} "
-              f"({cold.get('compile_ms', 0)} ms), warm "
-              f"dispatches={warm.get('xla_dispatches', 0)} "
-              f"compiles={warm.get('xla_compiles', 0)} [{dt:.2f}s]"
-              + ("" if ok else "  <-- RECOMPILED ON WARM RUN"))
-        if not ok:
-            failed.append(name)
+        for path, run in (("in-process", run_once),
+                          ("scheduler", run_scheduler_once)):
+            t0 = time.perf_counter()
+            with dispatch.capture() as cold:
+                run(name)
+            with dispatch.capture() as warm:
+                run(name)
+            dt = time.perf_counter() - t0
+            ok = warm.get("xla_compiles", 0) == 0
+            print(f"warmup {suite} {name} [{path}]: "
+                  f"cold compiles={cold.get('xla_compiles', 0)} "
+                  f"({cold.get('compile_ms', 0)} ms), warm "
+                  f"dispatches={warm.get('xla_dispatches', 0)} "
+                  f"compiles={warm.get('xla_compiles', 0)} [{dt:.2f}s]"
+                  + ("" if ok else "  <-- RECOMPILED ON WARM RUN"))
+            if not ok:
+                failed.append(f"{name}[{path}]")
     if failed:
         print(f"# warmup: warm-run recompiles in: {', '.join(failed)}",
               file=sys.stderr)
@@ -267,7 +288,7 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
         # event-log recovery reconciliation: every fault that FIRED
         # must pair with a recovery event recorded after it
         rec = trace_report.reconcile_faults(
-            trace.read_events(log_path) if log_path else [])
+            trace.read_event_log(log_path) if log_path else [])
         recon = (f"eventlog {rec['injected']} faults / "
                  f"{rec['recoveries']} recoveries "
                  + ("reconciled" if rec["reconciled"] else "UNRECONCILED"))
@@ -342,7 +363,9 @@ def main(argv=None) -> int:
         from .runtime import trace, trace_report
 
         try:
-            events = trace.read_events(args.report)
+            # reads a rotated set too (spark.blaze.eventLog.maxBytes
+            # rollover): <path>.seg1..N then the active file
+            events = trace.read_event_log(args.report)
         except OSError as e:
             print(f"cannot read event log: {e}", file=sys.stderr)
             return 2

@@ -81,6 +81,14 @@ TOKIO_NUM_WORKER_THREADS = ConfEntry("spark.blaze.tokio.num.worker.threads", 2, 
 # (≙ rt.rs sync_channel(1) + tokio stream drive); 0 = synchronous
 PIPELINE_DEPTH = ConfEntry("spark.blaze.pipeline.depth", 2, int)
 RSS_FETCH_BARRIER_TIMEOUT = ConfEntry("spark.blaze.rss.fetchBarrierTimeout", 120.0, float)
+# Double-buffered shuffle write: the map task hands each batch's
+# pid-sorted device output to a host staging thread (device->host
+# transfer + per-pid slicing + memmgr-tracked buffering) while the next
+# batch's program is already dispatched.  Off = the synchronous path.
+SHUFFLE_ASYNC_WRITE = ConfEntry("spark.blaze.shuffle.asyncWrite", True, _bool)
+# Bounded handoff queue depth for the async shuffle writer (device
+# outputs in flight to the host stager; producer blocks when full).
+SHUFFLE_ASYNC_QUEUE_DEPTH = ConfEntry("spark.blaze.shuffle.asyncWrite.queueDepth", 2, int)
 
 # Fault-tolerant stage execution (runtime/retry.py + scheduler loop).
 # ≙ spark.task.maxFailures: total attempts per task, 1 = fail fast.
@@ -109,6 +117,21 @@ TRACE_ENABLE = ConfEntry("spark.blaze.trace.enabled", False, _bool)
 # Event-log directory (≙ spark.eventLog.dir); empty = a blaze_eventlog
 # dir under the system temp dir.  One JSONL file per traced query.
 EVENT_LOG_DIR = ConfEntry("spark.blaze.eventLog.dir", "", str)
+# Size cap per event-log file (bytes): a full file rolls over into a
+# numbered segment (<path>.seg1, .seg2, ...) so long-running services
+# never grow one unbounded JSONL (≙ spark.eventLog.rolling.maxFileSize).
+# 0 = unbounded.  --report reads a rotated set transparently.
+EVENT_LOG_MAX_BYTES = ConfEntry("spark.blaze.eventLog.maxBytes", 0, int)
+# Kernel-attribution sampling: with tracing armed, block-until-ready
+# time every Nth instrumented program instead of all of them (attributed
+# device times are scaled back up by the sampling factor in --report),
+# so attribution is cheap enough to leave on in production.  1 = time
+# every program (the full-fidelity profile default).  Caveat: on a
+# device that truly queues async work, the sampled program's drain also
+# waits out the N-1 unsampled programs queued ahead of it, so the
+# scaled device time is an UPPER BOUND, not an unbiased estimate (the
+# report flags it '~').
+TRACE_SAMPLE_RATE = ConfEntry("spark.blaze.trace.sampleRate", 1, int)
 
 # Whole-stage program fusion (ops/fusion.py): collapse traceable
 # operator chains / agg pre-filters / final-agg sorts into single XLA

@@ -1300,59 +1300,18 @@ class AggExec(ExecNode):
             )
         return self._update_k
 
-    def _fused_update(self, batch: RecordBatch, in_schema: Schema,
-                      consumer: "_AggConsumer") -> bool:
-        """Consume one input batch through the single-program update;
-        returns False when this batch should take the eager
-        pending/doubling path instead (accumulator outgrew one batch
-        bucket: a per-batch full-state re-sort would go quadratic for
-        high-cardinality keys — exactly the shapes partial skipping
-        targets)."""
-        from ..batch import slice_rows_device
-
+    def _fused_scalar_update(self, batch: RecordBatch, in_schema: Schema,
+                             consumer: "_AggConsumer") -> None:
+        """No-groupings fused update: the 1-row state never syncs."""
         acc = consumer.take_state()
-        if not self.groupings:
-            if acc is None:
-                consumer.set_state(self._reduce_batch(batch, in_schema))
-                return True
-            _, scalar_update = self._update_kernels()
-            cols = scalar_update(
-                tuple(acc.columns), tuple(batch.columns), batch.num_rows
-            )
-            consumer.set_state(RecordBatch(self._state_schema, list(cols), 1))
-            return True
         if acc is None:
-            # seed: reduce, then shrink the state to its own bucket so
-            # steady-state updates sort acc_cap + batch_cap rows, not
-            # 2x batch_cap (q01: 4 groups -> the min capacity bucket)
-            part = self._reduce_batch(batch, in_schema)
-            cap = bucket_capacity(max(part.num_rows, 1))
-            if cap < part.capacity:
-                part = slice_rows_device(part, 0, part.num_rows)
-            consumer.set_state(part)
-            return True
-        if acc.capacity > batch.capacity:
-            consumer.set_state(acc)  # untouched; eager path takes over
-            return False
-        grouped_update, _ = self._update_kernels()
-        out_cap = acc.capacity
-        cols, m_n = grouped_update(
-            tuple(acc.columns), acc.num_rows,
-            tuple(batch.columns), batch.num_rows, out_cap,
+            consumer.set_state(self._reduce_batch(batch, in_schema))
+            return
+        _, scalar_update = self._update_kernels()
+        cols = scalar_update(
+            tuple(acc.columns), tuple(batch.columns), batch.num_rows
         )
-        n = int(m_n)  # one-scalar device->host sync per batch
-        if n > out_cap:
-            # merged groups overflow the stacked-state bucket: redo
-            # this batch through the eager reduce+merge (the update is
-            # pure, acc is unchanged) — concat_batches re-buckets the
-            # grown state to a power-of-two capacity, preserving the
-            # shape-bucketing invariant every downstream kernel (and
-            # the persistent compile cache's entry bound) relies on
-            part = self._reduce_batch(batch, in_schema)
-            consumer.set_state(self._merge_states([acc, part]))
-            return True
-        consumer.set_state(RecordBatch(self._state_schema, list(cols), n))
-        return True
+        consumer.set_state(RecordBatch(self._state_schema, list(cols), 1))
 
     def _merge_states(self, states: List[RecordBatch]) -> Optional[RecordBatch]:
         """Associative re-reduce of state batches (merge mode kernel on
@@ -1378,6 +1337,10 @@ class AggExec(ExecNode):
             in_rows = 0
             skipping = False
             fused_update = bool(conf.FUSED_AGG_UPDATE.get())
+            fctx = (
+                _FusedGroupedUpdate(self, consumer, in_schema)
+                if fused_update and self.groupings else None
+            )
             try:
                 for batch in child_stream:
                     if not ctx.is_task_running():
@@ -1390,7 +1353,11 @@ class AggExec(ExecNode):
                     # (re-merging a spilled state would double-count it)
                     if fused_update and not skipping:
                         with self.metrics.timer("elapsed_compute"):
-                            updated = self._fused_update(batch, in_schema, consumer)
+                            if fctx is not None:
+                                updated = fctx.update(batch)
+                            else:
+                                self._fused_scalar_update(batch, in_schema, consumer)
+                                updated = True
                     else:
                         updated = False
                     if not updated:
@@ -1428,6 +1395,8 @@ class AggExec(ExecNode):
                         pending, pending_rows = [], 0
                         consumer.set_state(acc)
                 # finish: merge residue + spills
+                if fctx is not None:
+                    fctx.finish()  # resolve the deferred overflow check
                 final_acc = consumer.take_state()
                 tail = ([final_acc] if final_acc else []) + pending
                 tail += consumer.drain_spills()
@@ -1510,6 +1479,190 @@ def _col(name):
     return Col(name)
 
 
+class _LazyAccState:
+    """Accumulator columns with a DEVICE-RESIDENT occupancy count
+    (``n_dev``: the int32 scalar the update program returned, never
+    fetched on the per-batch path).  ``hint`` is the last host-known
+    count — exact once the deferred overflow check resolved
+    (``pending_check`` False), a stale-by-one heuristic before that
+    (partial-skipping ratio, merge thresholds).  ``materialize()``
+    produces a plain RecordBatch, syncing the scalar only when the
+    check is still outstanding."""
+
+    __slots__ = ("schema", "cols", "n_dev", "hint", "pending_check")
+
+    def __init__(self, schema: Schema, cols, n_dev, hint: int):
+        self.schema = schema
+        self.cols = list(cols)
+        self.n_dev = n_dev
+        self.hint = int(hint)
+        self.pending_check = True
+
+    @property
+    def capacity(self) -> int:
+        return int(self.cols[0].validity.shape[0])
+
+    @property
+    def num_rows(self) -> int:
+        return self.hint
+
+    def memory_size(self) -> int:
+        return RecordBatch(self.schema, self.cols, self.hint).memory_size()
+
+    def materialize(self) -> RecordBatch:
+        n = self.hint if not self.pending_check else int(self.n_dev)
+        return RecordBatch(self.schema, list(self.cols), n)
+
+
+class _FusedGroupedUpdate:
+    """Drives the grouped single-program update with the accumulator
+    count kept device-resident: batch N+1's program is dispatched
+    against batch N's DEVICE count scalar, and N's overflow check
+    (``merged groups > bucket capacity``) syncs only AFTER that
+    dispatch — so the fused path never stalls the dispatch pipeline on
+    a per-batch scalar fetch (over a remote chip the old ``int(m_n)``
+    cost a full RTT between every two update programs).
+
+    Rollback: a detected overflow means the checked state AND the
+    just-dispatched update consuming it are both invalid.  The driver
+    retains the last PROVEN state and the one input batch in flight,
+    and rebuilds both steps through the eager reduce+merge (which
+    re-buckets the grown accumulator) — the pre-existing overflow
+    semantics, paid only when cardinality actually outgrows the bucket.
+
+    Observability (runtime.dispatch counters):
+    ``fused_agg_deferred_syncs`` — post-dispatch count fetches (the
+    happy path), ``fused_agg_stall_syncs`` — fetches that DID gate a
+    dispatch (mode switches; zero on the steady-state path, pinned by
+    tests), ``fused_agg_rollbacks`` — overflow rebuilds."""
+
+    def __init__(self, agg: "AggExec", consumer: "_AggConsumer",
+                 in_schema: Schema):
+        self._agg = agg
+        self._consumer = consumer
+        self._in_schema = in_schema
+        self._good: Optional[Tuple[tuple, int]] = None  # (cols, n) proven
+        # (input state, input batch, produced state, bucket capacity)
+        self._pending = None
+
+    def update(self, batch: RecordBatch) -> bool:
+        """Fold one input batch into the accumulator; False = this
+        batch must take the eager pending/doubling path (accumulator
+        outgrew one batch bucket)."""
+        from ..batch import slice_rows_device
+
+        agg = self._agg
+        consumer = self._consumer
+        st = consumer.take_state_any()
+        if st is None:
+            # seed (or post-spill restart): reduce, shrink to its own
+            # bucket so steady-state updates sort acc_cap + batch_cap
+            # rows, not 2x batch_cap (q01: 4 groups -> min capacity)
+            self._pending = None
+            part = agg._reduce_batch(batch, self._in_schema)
+            cap = bucket_capacity(max(part.num_rows, 1))
+            if cap < part.capacity:
+                part = slice_rows_device(part, 0, part.num_rows)
+            consumer.set_state(part)
+            self._good = (tuple(part.columns), part.num_rows)
+            return True
+        if st.capacity > batch.capacity:
+            resolved = self._resolve_to_batch(st, counter="fused_agg_stall_syncs")
+            if resolved is not None:
+                consumer.set_state(resolved)
+            return False
+        out_cap = st.capacity
+        grouped_update, _ = agg._update_kernels()
+        if isinstance(st, _LazyAccState):
+            acc_cols, acc_n = tuple(st.cols), st.n_dev
+        else:
+            # a plain RecordBatch entering the fused path (the eager
+            # pending-merge interleave, a post-rollback resume) is
+            # proven by construction: it MUST become the rollback base,
+            # or an overflow after the resume would rebuild from a
+            # stale accumulator and silently drop its merged groups
+            self._good = (tuple(st.columns), st.num_rows)
+            acc_cols, acc_n = tuple(st.columns), jnp.int32(st.num_rows)
+        cols, m_n = grouped_update(
+            acc_cols, acc_n, tuple(batch.columns), batch.num_rows, out_cap
+        )
+        good_n = self._good[1] if self._good is not None else out_cap
+        new = _LazyAccState(
+            agg._state_schema, cols, m_n,
+            hint=min(good_n + batch.num_rows, out_cap),
+        )
+        consumer.set_state(new)
+        prev, self._pending = self._pending, (st, batch, new, out_cap)
+        if prev is not None:
+            # deferred: the fetched program precedes the one just
+            # dispatched in device queue order — no pipeline stall
+            self._resolve(prev, counter="fused_agg_deferred_syncs")
+        return True
+
+    def finish(self) -> None:
+        """Resolve the outstanding check before the stream's finish
+        path materializes the state (once per stream, not per batch)."""
+        st = self._consumer.take_state_any()
+        if st is None:
+            self._pending = None
+            return
+        resolved = self._resolve_to_batch(st, counter="fused_agg_finish_syncs")
+        if resolved is not None:
+            self._consumer.set_state(resolved)
+
+    # ----------------------------------------------------- internals
+
+    def _resolve(self, pending, counter: str) -> None:
+        from ..runtime import dispatch
+
+        in_st, in_batch, out_st, out_cap = pending
+        n = int(out_st.n_dev)
+        dispatch.record(counter)
+        if n <= out_cap:
+            out_st.hint = n
+            out_st.pending_check = False
+            self._good = (tuple(out_st.cols), n)
+            return
+        # overflow: rebuild from the last proven state through the
+        # eager reduce+merge (re-buckets to a power-of-two capacity,
+        # preserving the shape-bucketing invariant), replaying the
+        # overflowed input batch AND — when a later update already
+        # consumed the invalid state — the in-flight batch after it
+        dispatch.record("fused_agg_rollbacks")
+        agg = self._agg
+        good_cols, good_n = self._good
+        acc = RecordBatch(agg._state_schema, list(good_cols), good_n)
+        part = agg._reduce_batch(in_batch, self._in_schema)
+        acc = agg._merge_states([acc, part])
+        cur = self._pending
+        if cur is not None and cur[2] is not out_st:
+            part2 = agg._reduce_batch(cur[1], self._in_schema)
+            acc = agg._merge_states([acc, part2])
+        self._pending = None
+        self._good = (tuple(acc.columns), acc.num_rows)
+        self._consumer.set_state(acc)
+
+    def _resolve_to_batch(self, st, counter: str) -> Optional[RecordBatch]:
+        """Resolve ``st`` (the consumer's newest state) into a plain
+        RecordBatch, running the outstanding overflow check first.
+        None = the state ended up in a spill (a rollback re-seats the
+        rebuilt accumulator in the consumer, where a concurrent memmgr
+        spill may legitimately claim it — the final merge then reads
+        it back through drain_spills)."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            self._resolve(pending, counter=counter)
+            if pending[2] is st and pending[2].pending_check:
+                # the check rolled the state back: the consumer holds
+                # the rebuilt accumulator (unless a spill just took it)
+                replaced = self._consumer.take_state_any()
+                assert replaced is None or isinstance(replaced, RecordBatch)
+                return replaced
+        if isinstance(st, _LazyAccState):
+            return st.materialize()
+        return st
+
+
 class _AggConsumer(MemConsumer):
     """OWNS the in-flight accumulator state; on pressure, serializes it
     to a Spill (host-RAM or disk tier) and clears it, so the exec
@@ -1539,12 +1692,26 @@ class _AggConsumer(MemConsumer):
         spill() (MemManager serving another thread's pressure) either
         runs before (state already spilled, returns None here) or after
         set_state() — never both paths on the same state, which would
-        double-count it."""
+        double-count it.  Device-count states resolve to plain batches
+        here (callers on this path need the host row count)."""
+        with self._lock:
+            s, self._state = self._state, None
+        if isinstance(s, _LazyAccState):
+            assert not s.pending_check, (
+                "fused-update state taken with its overflow check "
+                "unresolved (resolve via _FusedGroupedUpdate first)"
+            )
+            s = s.materialize()
+        return s
+
+    def take_state_any(self):
+        """Claim the accumulator WITHOUT materializing: the fused
+        update path keeps the occupancy count device-resident."""
         with self._lock:
             s, self._state = self._state, None
             return s
 
-    def set_state(self, state: RecordBatch) -> None:
+    def set_state(self, state) -> None:
         # state handoff and accounting are atomic w.r.t. spill(): a
         # spill landing between them would otherwise leave mem_used
         # reporting phantom memory after the state was already cleared
@@ -1561,13 +1728,23 @@ class _AggConsumer(MemConsumer):
                 # state would be silently LOST (observed as missing
                 # distinct rows at SF0.1 under a capped budget)
                 return 0
-            state, self._state = self._state, None
+            state = self._state
             if state is None:
                 return 0
+            if isinstance(state, _LazyAccState) and state.pending_check:
+                # the deferred overflow check hasn't resolved: this
+                # state may be invalid, and spilling it would bake the
+                # corruption into the final merge.  It is at most one
+                # batch bucket anyway — let pressure fall on the big
+                # consumers for this one batch.
+                return 0
+            self._state = None
             freed = state.memory_size()
             self.set_mem_used_no_trigger(0)
             self._inflight += 1
         # serialize outside the lock: this thread owns `state` now
+        if isinstance(state, _LazyAccState):
+            state = state.materialize()
         try:
             sp = try_new_spill()
             sp.write_frame(serialize_batch(state))

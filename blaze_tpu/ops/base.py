@@ -70,6 +70,16 @@ class ExecNode:
         return False
 
     @property
+    def trace_requires_buffer(self) -> bool:
+        """True when the traced transform is only exact over the WHOLE
+        partition in one batch (WindowExec: partition segments span
+        batch boundaries).  Fusion then plants a buffering node below
+        the fused program — the same concat-the-partition semantics the
+        operator's own execute uses — instead of applying it per
+        streamed batch."""
+        return False
+
+    @property
     def has_kernel(self) -> bool:
         """False when this operator issues no device program of its own
         (pure column selects); fusion only builds a combined program

@@ -31,6 +31,47 @@ class ExpandExec(ExecNode):
     def schema(self) -> Schema:
         return self._schema
 
+    # ---------------------------------------------- tracing contract
+
+    def trace_fn(self):
+        """One traced transform for ALL projection lists: project the
+        batch P ways, then concatenate the P results with live rows
+        compacted to a prefix (``_concat_device_cols`` over the traced
+        row count) — row multiset identical to the per-projection batch
+        emission, n rows in -> P*n rows out.  Untraceable when any
+        projection has host-fallback subtrees."""
+        fns = [p.trace_fn() for p in self._projects]
+        if any(fn is None for fn in fns):
+            return None
+        from ..batch import _concat_device_cols
+
+        out_schema = self._schema
+        n_proj = len(fns)
+
+        def body(cols, num_rows):
+            cap = cols[0].validity.shape[0]
+            outs = [fn(cols, num_rows)[0] for fn in fns]
+            counts = [num_rows] * n_proj
+            out_cols = tuple(
+                _concat_device_cols(
+                    f.dtype, [o[j] for o in outs], counts, n_proj * cap
+                )
+                for j, f in enumerate(out_schema.fields)
+            )
+            return out_cols, num_rows * n_proj
+
+        return body
+
+    def trace_key(self):
+        keys = tuple(p.trace_key() for p in self._projects)
+        if any(k is None for k in keys):
+            return None
+        return ("expand", keys)
+
+    @property
+    def trace_changes_count(self) -> bool:
+        return True  # n rows -> P*n rows
+
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         def stream():
             # SINGLE child pass, all projections applied per batch:

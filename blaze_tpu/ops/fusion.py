@@ -27,7 +27,19 @@ programs per batch (VERDICT r5).  Four tiers, all gated on
    AFTER column pruning so scan narrowing still sees the original
    operators): consecutive unary operators exposing the
    ``ExecNode.trace_fn`` contract compose into one
-   :class:`FusedStageExec` program per batch.
+   :class:`FusedStageExec` program per batch.  Operators whose traced
+   transform needs the whole partition in one batch
+   (``trace_requires_buffer`` — WindowExec) get a
+   :class:`BufferPartitionExec` planted below the fused program.
+5. **Fused shuffle write** (:func:`fuse_shuffle_write`, run last): when
+   a traceable chain (or nothing) feeds a ``ShuffleWriterExec`` with
+   hash or round-robin partitioning, the chain's transform, the
+   partition-id computation, the pid sort, and the per-partition
+   bincount compose into ONE program per batch
+   (``ShuffleWriterExec.absorb_traceable_chain``) — a shuffle map
+   stage costs ~1 dispatch/batch instead of chain+hash+sort, mirroring
+   the reference's native shuffle writer where map-side compute and
+   partitioning live in one pipeline.
 
 The per-batch agg-update program (reduce + accumulator merge in one
 dispatch) lives in ``ops/agg.py`` (``AggExec._update_kernels``); the
@@ -319,11 +331,45 @@ def _fuse_final_sort(plan):
 
 # -------------------------------------- tier 4: traceable chains
 
+class BufferPartitionExec(ExecNode):
+    """Buffer the child partition's batches and emit them as ONE
+    concatenated batch — the blocking prelude a ``trace_requires_buffer``
+    operator (WindowExec) needs before its traced transform can join a
+    fused program.  Identical semantics to WindowExec's own
+    buffer-then-concat execute, just factored below the fused kernel."""
+
+    def __init__(self, child: ExecNode):
+        super().__init__([child])
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, partition: int, ctx) -> BatchStream:
+        child_stream = self.children[0].execute(partition, ctx)
+
+        def stream():
+            from ..batch import concat_batches
+
+            buffered = [b.to_host() for b in child_stream]
+            if not buffered:
+                return
+            merged = concat_batches(buffered).to_device()
+            self.metrics.add("output_rows", merged.num_rows)
+            yield merged
+
+        return stream()
+
+
 class FusedStageExec(ExecNode):
     """One jitted program per batch for a chain of traceable unary
     operators (``ExecNode.trace_fn`` contract), bottom-up.  All
     intermediates stay on device; the single count scalar syncs only
-    when some fused operator compacts rows."""
+    when some fused operator compacts rows.
+
+    Itself implements the trace contract (the composition of its ops'
+    transforms), so tier 5 can absorb an already-collapsed chain into
+    a fused shuffle-write program without re-walking the originals."""
 
     def __init__(self, child, ops: List):
         super().__init__([child])
@@ -332,7 +378,9 @@ class FusedStageExec(ExecNode):
         self._changes_count = any(op.trace_changes_count for op in self.ops)
         fns = [op.trace_fn() for op in self.ops]
         assert all(fn is not None for fn in fns)
-        keys = tuple(op.trace_key() for op in self.ops)
+        self._fns = fns
+        self._keys = tuple(op.trace_key() for op in self.ops)
+        keys = self._keys
 
         def build():
             import jax
@@ -355,6 +403,26 @@ class FusedStageExec(ExecNode):
     def schema(self):
         return self._schema
 
+    # ------------------------------------------- tracing contract
+
+    def trace_fn(self):
+        fns = self._fns
+
+        def fn(cols, num_rows):
+            n = num_rows
+            for f in fns:
+                cols, n = f(cols, n)
+            return cols, n
+
+        return fn
+
+    def trace_key(self):
+        return ("fused_stage", self._keys)
+
+    @property
+    def trace_changes_count(self) -> bool:
+        return self._changes_count
+
     def name(self) -> str:
         inner = "+".join(type(op).__name__ for op in self.ops)
         return f"FusedStageExec[{inner}]"
@@ -363,6 +431,8 @@ class FusedStageExec(ExecNode):
         child_stream = self.children[0].execute(partition, ctx)
 
         def stream():
+            from ..batch import bucket_capacity
+
             for batch in child_stream:
                 with self.metrics.timer("elapsed_compute"):
                     cols, n_dev = self._kernel(tuple(batch.columns), batch.num_rows)
@@ -371,23 +441,57 @@ class FusedStageExec(ExecNode):
                 if n == 0:
                     continue
                 self.metrics.add("output_rows", n)
-                yield RecordBatch(self._schema, list(cols), n)
+                out = RecordBatch(self._schema, list(cols), n)
+                # expanding ops (generate cap*M, expand cap*P) leave a
+                # non-power-of-two capacity: renormalize so downstream
+                # kernels keep the shape-bucketing invariant (mirrors
+                # GenerateExec's own unfused stream)
+                cap = out.capacity
+                if cap != bucket_capacity(cap):
+                    out = out.with_capacity(bucket_capacity(n))
+                yield out
 
         return stream()
 
 
 def optimize_plan(plan):
     """THE canonical task-plan optimizer composition:
-    ``fuse_stages -> prune_columns -> fuse_traceable_chains`` (order
-    matters: pruning rebuilds known operator types and treats
-    FusedStageExec conservatively, so chain collapse must come last).
-    Every entry point — run_task, bench.py, ``--warmup``, the budget
-    tests — MUST go through this helper: the persistent compile cache
-    pre-warm is only worth anything if warmup compiles exactly the
-    programs production tasks execute."""
+    ``fuse_stages -> prune_columns -> fuse_traceable_chains ->
+    fuse_shuffle_write`` (order matters: pruning rebuilds known
+    operator types and treats FusedStageExec conservatively, so chain
+    collapse must come after it, and the shuffle-write absorption eats
+    the collapsed chain, so it must come last).  Every entry point —
+    run_task, bench.py, ``--warmup``, the budget tests — MUST go
+    through this helper: the persistent compile cache pre-warm is only
+    worth anything if warmup compiles exactly the programs production
+    tasks execute."""
     from .pruning import prune_columns
 
-    return fuse_traceable_chains(prune_columns(fuse_stages(plan)))
+    return fuse_shuffle_write(
+        fuse_traceable_chains(prune_columns(fuse_stages(plan)))
+    )
+
+
+def traceable_chain_from(node):
+    """THE chain-discovery rule every fusion consumer shares (tier 4's
+    collapse and tier 5's shuffle-write absorption must agree on what a
+    chain is): walk down through consecutive unary operators exposing
+    ``trace_fn``, stopping after a ``trace_requires_buffer`` op (a
+    whole-partition transform like window becomes the chain's BOTTOM,
+    fed by a partition-buffering node; anything below it streams per
+    batch and is collapsed separately by the recursive walks).
+    Returns (ops top-down, the node below the chain, buffered?)."""
+    ops_top_down = []
+    cur = node
+    buffered = False
+    while len(cur.children) == 1 and cur.trace_fn() is not None:
+        ops_top_down.append(cur)
+        if cur.trace_requires_buffer:
+            buffered = True
+            cur = cur.children[0]
+            break
+        cur = cur.children[0]
+    return ops_top_down, cur, buffered
 
 
 def fuse_traceable_chains(plan):
@@ -399,21 +503,17 @@ def fuse_traceable_chains(plan):
     if not bool(conf.FUSION_ENABLE.get()):
         return plan
 
-    def chain_from(node):
-        ops_top_down = []
-        cur = node
-        while len(cur.children) == 1 and cur.trace_fn() is not None:
-            ops_top_down.append(cur)
-            cur = cur.children[0]
-        return ops_top_down, cur
+    chain_from = traceable_chain_from
 
     def rewrite(node):
-        ops, bottom = chain_from(node)
+        ops, bottom, buffered = chain_from(node)
         kernels = sum(1 for o in ops if o.has_kernel)
         if len(ops) >= 2 and kernels >= 2:
             from ..runtime import dispatch
 
             dispatch.record_max("fused_stage_len", len(ops))
+            if buffered:
+                bottom = BufferPartitionExec(bottom)
             return FusedStageExec(bottom, list(reversed(ops)))
         return node
 
@@ -424,4 +524,31 @@ def fuse_traceable_chains(plan):
 
     plan = rewrite(plan)
     walk(plan)
+    return plan
+
+
+# -------------------------------------- tier 5: fused shuffle write
+
+def fuse_shuffle_write(plan):
+    """Absorb the traceable chain feeding each hash/round-robin
+    ``ShuffleWriterExec`` into the writer's per-batch program: chain
+    transform + partition-id computation + pid sort + per-partition
+    counts compile into ONE dispatch (see
+    ``ShuffleWriterExec.absorb_traceable_chain``).  Applies after
+    :func:`fuse_traceable_chains`, so the common shape is absorbing a
+    single FusedStageExec (whose trace contract composes its ops)."""
+    if not bool(conf.FUSION_ENABLE.get()):
+        return plan
+    from ..parallel.shuffle import ShuffleWriterExec
+
+    def rewrite(node):
+        if isinstance(node, ShuffleWriterExec):
+            node.absorb_traceable_chain()
+        return node
+
+    def walk(node):
+        for i, c in enumerate(list(node.children)):
+            walk(rewrite(c))
+
+    walk(rewrite(plan))
     return plan

@@ -91,8 +91,12 @@ def json_tuple_generator(fields: Sequence[str]) -> Generator:
     return gen
 
 
-def _build_explode_kernel(child_schema, spec, outer, keep_input, with_pos):
-    @jax.jit
+def _explode_body(child_schema, spec, outer, keep_input, with_pos):
+    """The explode transform as a plain traceable function
+    ``(cols, num_rows) -> (cols, num_rows)`` — jitted standalone by
+    :func:`_build_explode_kernel`, or inlined into a fused-stage /
+    fused-shuffle-write program (trace contract)."""
+
     def kernel(cols: Tuple[Column, ...], num_rows):
         cap = cols[0].validity.shape[0]
         env = {f.name: c for f, c in zip(child_schema.fields, cols)}
@@ -148,6 +152,10 @@ def _build_explode_kernel(child_schema, spec, outer, keep_input, with_pos):
     return kernel
 
 
+def _build_explode_kernel(child_schema, spec, outer, keep_input, with_pos):
+    return jax.jit(_explode_body(child_schema, spec, outer, keep_input, with_pos))
+
+
 class GenerateExec(ExecNode):
     def __init__(
         self,
@@ -200,11 +208,30 @@ class GenerateExec(ExecNode):
         def build():
             return _build_explode_kernel(child_schema, spec, outer, keep_input, with_pos)
 
-        self._native_kernel = cached_kernel(
-            ("generate", schema_key(child_schema), spec.kind, expr_key(spec.expr),
-             outer, keep_input),
-            build,
+        self._key = ("generate", schema_key(child_schema), spec.kind,
+                     expr_key(spec.expr), outer, keep_input)
+        self._native_kernel = cached_kernel(self._key, build)
+
+    # ---------------------------------------------- tracing contract
+
+    def trace_fn(self):
+        """Native explode/pos_explode is a pure per-batch transform
+        (flat emit mask -> cumsum -> compact), so it inlines into fused
+        programs.  The host-generator path (json_tuple, UDTFs) round
+        trips through python and cannot be traced."""
+        if not isinstance(self.generator, NativeGenerator):
+            return None
+        return _explode_body(
+            self.children[0].schema, self.generator, self.outer,
+            self.keep_input, self.generator.kind == "pos_explode",
         )
+
+    def trace_key(self):
+        return self._key if isinstance(self.generator, NativeGenerator) else None
+
+    @property
+    def trace_changes_count(self) -> bool:
+        return True  # one row explodes into lengths[i] rows
 
     def _native_stream(self, partition: int, ctx: TaskContext) -> BatchStream:
         child = self.children[0]

@@ -68,8 +68,12 @@ def _minmax_sentinel(dt, kind: str):
     return jnp.array(info.max if kind == "min" else info.min, dt)
 
 
-def _build_window_kernel(in_schema, functions_, part_by, ord_by):
-    @jax.jit
+def _window_body(in_schema, functions_, part_by, ord_by):
+    """The whole-partition window transform as a plain traceable
+    function over one buffered batch — jitted standalone by
+    :func:`_build_window_kernel`, or inlined into a fused program
+    above a partition-buffering node (trace contract)."""
+
     def kernel(cols: Tuple[Column, ...], num_rows):
         cap = cols[0].validity.shape[0]
         env = {f.name: c for f, c in zip(in_schema.fields, cols)}
@@ -475,6 +479,10 @@ def _build_window_kernel(in_schema, functions_, part_by, ord_by):
     return kernel
 
 
+def _build_window_kernel(in_schema, functions_, part_by, ord_by):
+    return jax.jit(_window_body(in_schema, functions_, part_by, ord_by))
+
+
 class WindowExec(ExecNode):
     def __init__(
         self,
@@ -548,19 +556,47 @@ class WindowExec(ExecNode):
         from ..exprs.compile import expr_key
         from ..runtime.kernel_cache import cached_kernel, schema_key
 
-        self._kernel = cached_kernel(
-            ("window", schema_key(in_schema),
-             tuple((f.kind, f.name, None if f.expr is None else expr_key(f.expr),
-                    f.whole_partition, f.rows_frame, f.offset,
-                    f.ignore_nulls, f.range_frame) for f in functions_),
-             tuple(expr_key(e) for e in part_by),
-             tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in ord_by)),
-            build,
+        self._key = (
+            "window", schema_key(in_schema),
+            tuple((f.kind, f.name, None if f.expr is None else expr_key(f.expr),
+                   f.whole_partition, f.rows_frame, f.offset,
+                   f.ignore_nulls, f.range_frame) for f in functions_),
+            tuple(expr_key(e) for e in part_by),
+            tuple((expr_key(f.expr), f.ascending, f.nulls_first) for f in ord_by),
         )
+        self._kernel = cached_kernel(self._key, build)
 
     @property
     def schema(self) -> Schema:
         return self._schema
+
+    # ---------------------------------------------- tracing contract
+    #
+    # The window kernel is exact only over the WHOLE partition in one
+    # batch (partition/peer segments span batch boundaries), so the
+    # contract advertises trace_requires_buffer: fusion plants a
+    # partition-buffering node below the fused program — the same
+    # buffer-then-concat this operator's own execute performs — and the
+    # kernel composes with downstream traceable ops (e.g. the
+    # partition-id computation of a fused shuffle write) in ONE program.
+
+    def trace_fn(self):
+        body = _window_body(
+            self.children[0].schema, self.functions, self.partition_by,
+            self.order_by,
+        )
+
+        def fn(cols, num_rows):
+            return body(cols, num_rows), num_rows
+
+        return fn
+
+    def trace_key(self):
+        return self._key
+
+    @property
+    def trace_requires_buffer(self) -> bool:
+        return True
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)

@@ -21,6 +21,7 @@ the same file pair).
 from __future__ import annotations
 
 import os
+import queue
 import struct
 import tempfile
 import threading
@@ -93,10 +94,11 @@ class RangePartitioning(Partitioning):
     boundaries: Optional[tuple] = None
 
 
-@partial(jax.jit, static_argnames=("n_out",))
-def _sort_by_pid(cols, pids, n_out, num_rows):
+def _sort_by_pid_body(cols, pids, n_out, num_rows):
     """Sort rows by partition id; returns (sorted cols, counts[n_out],
-    sort permutation)."""
+    sort permutation).  A plain traceable function so the fused
+    shuffle-write program (tier 5) can inline it after the map chain
+    and pid computation; jitted standalone as :func:`_sort_by_pid`."""
     cap = pids.shape[0]
     live = jnp.arange(cap) < num_rows
     key = jnp.where(live, pids.astype(jnp.uint32), jnp.uint32(n_out))
@@ -108,6 +110,9 @@ def _sort_by_pid(cols, pids, n_out, num_rows):
         num_segments=n_out,
     )
     return sorted_cols, counts, sidx
+
+
+_sort_by_pid = partial(jax.jit, static_argnames=("n_out",))(_sort_by_pid_body)
 
 
 def non_opaque_cols(schema: Schema, cols) -> tuple:
@@ -289,15 +294,24 @@ def _host_concat(batches: List[RecordBatch], schema: Schema) -> RecordBatch:
 
 # ------------------------------------------------------------------- execs
 
-def _build_pid_kernels(schema, exprs, n_out):
-    @jax.jit
-    def hash_pids(cols, num_rows):
+def _hash_pids_body(schema, exprs, n_out):
+    """The Spark-exact hash partition-id computation (murmur3 seed42
+    pmod) as a plain traceable body — ONE definition shared by the
+    standalone pid kernel and the tier-5 fused write program, so fused
+    and unfused map tasks can never place a row differently."""
+
+    def pids(cols, num_rows):
         cap = cols[0].validity.shape[0]
         env = {f.name: c for f, c in zip(schema.fields, cols)}
         key_cols = [lower(e, schema, env, cap) for e in exprs]
         return pmod(murmur3_columns(key_cols), n_out)
 
-    
+    return pids
+
+
+def _build_pid_kernels(schema, exprs, n_out):
+    hash_pids = jax.jit(_hash_pids_body(schema, exprs, n_out))
+
     @jax.jit
     def hash_pids_pallas(cols, num_rows):
         # whole pipeline (expr lowering, word-plane split, fused
@@ -318,6 +332,125 @@ def _build_pid_kernels(schema, exprs, n_out):
     return hash_pids, hash_pids_pallas
 
 
+def _build_fused_write_kernel(out_schema, fns, pid_mode, exprs, n_out):
+    """ONE program per map-stage batch (fusion tier 5): the traceable
+    map chain, the partition-id computation, the pid sort, and the
+    per-partition bincount, all in a single XLA executable.  The
+    unfused path pays chain + hash + sort dispatches per batch; over a
+    remote chip each is ~70-80 ms of turnaround.  ``fns`` are the
+    chain's trace transforms bottom->top (may be empty: a bare writer
+    still folds hash+sort into one program); ``pid_mode`` is "hash"
+    (murmur3 pmod over ``exprs``) or "rr" (round-robin, offset passed
+    as a traced arg)."""
+
+    def chain(cols, n):
+        for fn in fns:
+            cols, n = fn(cols, n)
+        return cols, n
+
+    if pid_mode == "hash":
+        pid_body = _hash_pids_body(out_schema, exprs, n_out)
+
+        @jax.jit
+        def kernel(cols, num_rows):
+            cols, n = chain(cols, num_rows)
+            pids = pid_body(cols, n)
+            sorted_cols, counts, _ = _sort_by_pid_body(tuple(cols), pids, n_out, n)
+            return sorted_cols, counts
+
+        return kernel
+
+    @jax.jit
+    def rr_kernel(cols, num_rows, rr):
+        cols, n = chain(cols, num_rows)
+        cap = cols[0].validity.shape[0]
+        pids = (jnp.arange(cap, dtype=jnp.int32) + rr) % n_out
+        sorted_cols, counts, _ = _sort_by_pid_body(tuple(cols), pids, n_out, n)
+        # next batch's offset stays DEVICE-RESIDENT (the post-chain
+        # live count is a traced scalar): syncing it per batch would
+        # stall the dispatch loop one RTT between programs
+        next_rr = (rr + jnp.int32(n)) % jnp.int32(n_out)
+        return sorted_cols, counts, next_rr
+
+    return rr_kernel
+
+
+def _insert_host(rep: "ShuffleRepartitioner", schema: Schema, item) -> None:
+    """Stage one batch's pid-sorted device output into the
+    repartitioner: device->host transfer, per-pid slicing, buffering
+    under memmgr accounting.  ``item`` = (cols, counts, num_rows);
+    num_rows None means "resolve from counts" (the fused write path:
+    the live row count after the fused chain IS the counts total)."""
+    cols, counts, n = item
+    counts = np.asarray(counts)
+    if n is None:
+        n = int(counts.sum())
+    host = RecordBatch(schema, list(cols), n).to_host()
+    rep.insert_sorted(host, counts)
+
+
+class _AsyncInserter:
+    """Double-buffered shuffle write (conf
+    ``spark.blaze.shuffle.asyncWrite``): batch N's device output is
+    transferred/sliced/buffered on this thread while batch N+1's
+    program is already dispatched on the caller's.  Bounded queue
+    (``...asyncWrite.queueDepth``) so device outputs in flight stay
+    capped; staging errors surface on the producer at the next put()
+    or at close().  The repartitioner's own lock makes insert_sorted
+    safe against concurrent memmgr spills, so commit-by-rename
+    semantics in write_output are untouched."""
+
+    _DONE = object()
+
+    def __init__(self, rep: "ShuffleRepartitioner", schema: Schema,
+                 depth: int, metrics):
+        self._rep = rep
+        self._schema = schema
+        self._metrics = metrics
+        self._q: "queue.Queue" = queue.Queue(max(1, depth))
+        self._errs: List[BaseException] = []
+        self._aborted = False
+        self._thread = threading.Thread(
+            target=self._drain, name="shuffle-async-insert", daemon=True
+        )
+        self._thread.start()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _AsyncInserter._DONE:
+                return
+            if self._errs or self._aborted:
+                continue  # task failing/cancelled: discard, don't stage
+            try:
+                with self._metrics.timer("shuffle_host_stage_time"):
+                    _insert_host(self._rep, self._schema, item)
+            except BaseException as e:  # noqa: BLE001 — surfaced to producer
+                self._errs.append(e)
+
+    def put(self, item) -> None:
+        if self._errs:
+            raise self._errs[0]
+        self._q.put(item)
+
+    def close(self) -> None:
+        """Flush and join; re-raises any staging error — MUST happen
+        before write_output so every inserted batch reaches the file."""
+        self._q.put(self._DONE)
+        self._thread.join()
+        if self._errs:
+            raise self._errs[0]
+
+    def abort(self) -> None:
+        """Failure/cancellation teardown: stop the stager without
+        raising (the original error is already propagating) and skip
+        still-queued batches — their transfers would feed a
+        repartitioner whose output is being discarded."""
+        self._aborted = True
+        self._q.put(self._DONE)  # worker always drains, so this returns
+        self._thread.join()
+
+
 class ShuffleWriterExec(ExecNode):
     """Runs the child and writes this map task's partitioned output.
     ≙ shuffle_writer_exec.rs:52-186 (Single vs Sort repartitioner
@@ -330,6 +463,10 @@ class ShuffleWriterExec(ExecNode):
         self.data_path = data_path
         self.index_path = index_path
         self.partition_lengths: Optional[List[int]] = None
+        # fusion tier 5 (absorb_traceable_chain): one program per batch
+        # covering chain + pids + pid-sort + counts
+        self._fused_write = None
+        self._out_schema: Optional[Schema] = None
         if isinstance(partitioning, HashPartitioning):
             from ..batch import split_opaque_indexes
 
@@ -393,7 +530,67 @@ class ShuffleWriterExec(ExecNode):
 
     @property
     def schema(self) -> Schema:
-        return self.children[0].schema
+        # after tier-5 absorption the chain nodes are gone from the
+        # tree; the writer's output schema stays the CHAIN's output
+        return self._out_schema if self._out_schema is not None else self.children[0].schema
+
+    # ------------------------------------- tier-5 fused shuffle write
+
+    def absorb_traceable_chain(self) -> None:
+        """Fold the traceable chain feeding this writer (often one
+        FusedStageExec — its trace contract composes its ops) plus the
+        partition-id computation, pid sort, and per-partition counts
+        into ONE cached program per batch (``ops.fusion`` tier 5).
+        Applies to hash and round-robin partitioning over >1 output
+        partitions with no opaque (host-only) columns; range
+        partitioning keys through driver-computed boundaries and
+        single-partition writes move nothing worth fusing.  Idempotent;
+        a no-op when the gate fails (the per-kernel path below runs
+        unchanged — the fallback the differential tests pin)."""
+        from ..batch import split_opaque_indexes
+
+        if self._fused_write is not None:
+            return
+        part = self.partitioning
+        n_out = part.num_partitions
+        if not isinstance(part, (HashPartitioning, RoundRobinPartitioning)) or n_out <= 1:
+            return
+        from ..ops.fusion import traceable_chain_from
+
+        ops, cur, buffered = traceable_chain_from(self.children[0])
+        out_schema = self.children[0].schema
+        bottom = cur if ops else self.children[0]
+        if (
+            split_opaque_indexes(out_schema)[1]
+            or split_opaque_indexes(bottom.schema)[1]
+        ):
+            return  # opaque python columns never enter jitted programs
+
+        from ..exprs.compile import expr_key
+        from ..runtime.kernel_cache import cached_kernel, schema_key
+
+        fns = [op.trace_fn() for op in reversed(ops)]  # bottom -> top
+        keys = tuple(op.trace_key() for op in reversed(ops))
+        if isinstance(part, HashPartitioning):
+            exprs = list(part.exprs)
+            key = ("fused_shuffle_write", "hash", schema_key(out_schema),
+                   keys, tuple(expr_key(e) for e in exprs), n_out)
+            builder = lambda: _build_fused_write_kernel(  # noqa: E731
+                out_schema, fns, "hash", exprs, n_out)
+        else:
+            key = ("fused_shuffle_write", "rr", schema_key(out_schema),
+                   keys, n_out)
+            builder = lambda: _build_fused_write_kernel(  # noqa: E731
+                out_schema, fns, "rr", None, n_out)
+        self._fused_write = cached_kernel(key, builder)
+        self._out_schema = out_schema
+        if ops:
+            from ..ops.fusion import BufferPartitionExec
+
+            self.children[0] = BufferPartitionExec(cur) if buffered else cur
+            from ..runtime import dispatch
+
+            dispatch.record_max("fused_stage_len", len(ops) + 1)
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         if (
@@ -407,37 +604,69 @@ class ShuffleWriterExec(ExecNode):
 
         def stream():
             n_out = self.partitioning.num_partitions
+            out_schema = self.schema
             rep = ShuffleRepartitioner(
-                self.schema, n_out, self.metrics, ctx.task_attempt_id
+                out_schema, n_out, self.metrics, ctx.task_attempt_id
             )
             ctx.mem.register_consumer(rep)
+            inserter: Optional[_AsyncInserter] = None
             try:
+                if bool(conf.SHUFFLE_ASYNC_WRITE.get()):
+                    inserter = _AsyncInserter(
+                        rep, out_schema,
+                        int(conf.SHUFFLE_ASYNC_QUEUE_DEPTH.get()), self.metrics,
+                    )
                 rr = 0
+                rr_dev = jnp.int32(0)  # fused RR offset, device-resident
                 for batch in self.children[0].execute(partition, ctx):
                     if not ctx.is_task_running():
                         return
-                    with self.metrics.timer("elapsed_compute"):
-                        if isinstance(self.partitioning, HashPartitioning) and n_out > 1:
-                            pids = self._hash_pids(
-                                non_opaque_cols(self.schema, batch.columns),
-                                batch.num_rows,
+                    if self._fused_write is not None:
+                        # tier 5: ONE program returns the chain output
+                        # already pid-sorted plus per-pid counts
+                        with self.metrics.timer("elapsed_compute"):
+                            if isinstance(self.partitioning, RoundRobinPartitioning):
+                                sorted_cols, counts, rr_dev = self._fused_write(
+                                    tuple(batch.columns), batch.num_rows, rr_dev
+                                )
+                            else:
+                                sorted_cols, counts = self._fused_write(
+                                    tuple(batch.columns), batch.num_rows
+                                )
+                        item = (list(sorted_cols), counts, None)
+                    else:
+                        with self.metrics.timer("elapsed_compute"):
+                            if isinstance(self.partitioning, HashPartitioning) and n_out > 1:
+                                pids = self._hash_pids(
+                                    non_opaque_cols(out_schema, batch.columns),
+                                    batch.num_rows,
+                                )
+                            elif isinstance(self.partitioning, RangePartitioning) and n_out > 1:
+                                pids = self._range_pids(batch.columns, batch.num_rows)
+                            elif isinstance(self.partitioning, RoundRobinPartitioning) and n_out > 1:
+                                pids = (jnp.arange(batch.capacity, dtype=jnp.int32) + rr) % n_out
+                                rr = (rr + batch.num_rows) % n_out
+                            else:
+                                pids = jnp.zeros(batch.capacity, jnp.int32)
+                            sorted_cols, counts = sort_cols_by_pid(
+                                out_schema, batch.columns, pids, n_out, batch.num_rows
                             )
-                        elif isinstance(self.partitioning, RangePartitioning) and n_out > 1:
-                            pids = self._range_pids(batch.columns, batch.num_rows)
-                        elif isinstance(self.partitioning, RoundRobinPartitioning) and n_out > 1:
-                            pids = (jnp.arange(batch.capacity, dtype=jnp.int32) + rr) % n_out
-                            rr = (rr + batch.num_rows) % n_out
-                        else:
-                            pids = jnp.zeros(batch.capacity, jnp.int32)
-                        sorted_cols, counts = sort_cols_by_pid(
-                            self.schema, batch.columns, pids, n_out, batch.num_rows
-                        )
-                    host = RecordBatch(self.schema, list(sorted_cols), batch.num_rows).to_host()
-                    rep.insert_sorted(host, np.asarray(counts))
+                        item = (list(sorted_cols), counts, batch.num_rows)
+                    if inserter is not None:
+                        # overlap: host staging of batch N runs on the
+                        # inserter thread while batch N+1 dispatches
+                        inserter.put(item)
+                    else:
+                        _insert_host(rep, out_schema, item)
+                if inserter is not None:
+                    inserter.close()
+                    inserter = None
                 with self.metrics.timer("output_io_time"):
                     self.partition_lengths = rep.write_output(self.data_path, self.index_path)
                 self.metrics.add("data_size", sum(self.partition_lengths))
             finally:
+                if inserter is not None:
+                    inserter.abort()
                 ctx.mem.unregister_consumer(rep)
             return
             yield  # pragma: no cover — empty stream marker

@@ -157,6 +157,10 @@ def instrument(fn: Callable, label: str = "kernel") -> Callable:
         # when this call stepped the jit cache) and the block is the
         # device execution bill for THIS program — serializing the
         # device is the cost of attribution, paid only under capture.
+        # Under spark.blaze.trace.sampleRate=N only every Nth program
+        # pays the block (trace.sample_kernel); unsampled calls still
+        # count and still attribute their launch overhead, and the
+        # report scales device time back up by programs/timed.
         import jax
 
         t0 = time.perf_counter_ns()
@@ -173,13 +177,18 @@ def instrument(fn: Callable, label: str = "kernel") -> Callable:
                     compiled = True
                     record("xla_compiles", delta)
                     record("compile_ms", int((t1 - t0) / 1e6))
-        jax.block_until_ready(out)
-        t2 = time.perf_counter_ns()
+        timed = trace.sample_kernel()
+        if timed:
+            jax.block_until_ready(out)
+            device_ns = time.perf_counter_ns() - t1
+        else:
+            device_ns = 0
         trace.record_kernel(
             label,
-            device_ns=t2 - t1,
+            device_ns=device_ns,
             dispatch_ns=0 if compiled else t1 - t0,
             compile_ns=t1 - t0 if compiled else 0,
+            timed=timed,
         )
         return out
 

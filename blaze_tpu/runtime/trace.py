@@ -92,17 +92,31 @@ _KERNEL_SINKS: List[Dict[str, Dict[str, int]]] = []
 #: non-blocking path
 _KERNEL_TIMING = False
 
+#: kernel-attribution sampling (conf spark.blaze.trace.sampleRate):
+#: block-until-ready-time every Nth instrumented program instead of all
+#: of them, so attribution is cheap enough to leave armed in production
+_sample_rate = 1
+_sample_counter = 0
+_sample_lock = threading.Lock()
+
+#: per-path rollover segment counters for the size-capped event log
+#: (conf spark.blaze.eventLog.maxBytes)
+_segments: Dict[str, int] = {}
+_max_bytes = 0
+
 # introspection counters for the overhead-gating regression test
 _events_emitted = 0
 _spans_opened = 0
 
 
 def _load() -> None:
-    global _loaded, _armed, _dir
+    global _loaded, _armed, _dir, _sample_rate, _max_bytes
     with _lock:
         _armed = bool(conf.TRACE_ENABLE.get())
         d = str(conf.EVENT_LOG_DIR.get() or "")
         _dir = d or os.path.join(tempfile.gettempdir(), "blaze_eventlog")
+        _sample_rate = max(1, int(conf.TRACE_SAMPLE_RATE.get()))
+        _max_bytes = max(0, int(conf.EVENT_LOG_MAX_BYTES.get()))
         _loaded = True
 
 
@@ -118,6 +132,7 @@ def reset() -> None:
     """(Re)load arming + directory from conf and forget the current log
     file and counters — call after changing trace conf keys."""
     global _path, _default_path, _events_emitted, _spans_opened, _seq, _file
+    global _sample_counter
     _load()
     with _lock:
         _path = None
@@ -125,9 +140,12 @@ def reset() -> None:
         _events_emitted = 0
         _spans_opened = 0
         _seq = 0
+        _segments.clear()
         if _file is not None:
             _file[1].close()
             _file = None
+    with _sample_lock:
+        _sample_counter = 0
 
 
 def counters() -> Dict[str, int]:
@@ -180,6 +198,25 @@ def emit(etype: str, **fields: Any) -> None:
         _file[1].write(line + "\n")
         _file[1].flush()  # whole lines reach readers/crash dumps now
         _events_emitted += 1
+        # size-capped rollover (spark.blaze.eventLog.maxBytes): the
+        # full file becomes the next numbered segment and the base
+        # path reopens fresh, so the active file never grows unbounded
+        # and read_event_log() reassembles the set in order
+        if _max_bytes > 0 and _file[1].tell() >= _max_bytes:
+            _file[1].close()
+            _file = None
+            # never clobber a segment from an earlier life of this
+            # path (reset() clears the in-memory counter but the same
+            # query_id + pid regenerates the same file name): probe
+            # past any .segN already on disk before renaming
+            k = _segments.get(path, 0) + 1
+            while os.path.exists(f"{path}.seg{k}"):
+                k += 1
+            _segments[path] = k
+            try:
+                os.replace(path, f"{path}.seg{k}")
+            except OSError:
+                pass  # rollover is best-effort; appending continues
 
 
 @contextlib.contextmanager
@@ -250,30 +287,61 @@ def kernel_capture() -> Iterator[Dict[str, Dict[str, int]]]:
 profile_kernels = kernel_capture
 
 
+def sample_kernel() -> bool:
+    """Should THIS instrumented program pay the block-until-ready
+    device timing?  True for every call at sampleRate=1 (the default
+    full-fidelity profile); at N>1 true for every Nth program, so an
+    armed production trace costs one device serialization per N
+    programs instead of per program."""
+    rate = _sample_rate
+    if rate <= 1:
+        return True
+    global _sample_counter
+    with _sample_lock:
+        _sample_counter += 1
+        return _sample_counter % rate == 1
+
+
 def record_kernel(label: str, device_ns: int, dispatch_ns: int,
-                  compile_ns: int) -> None:
+                  compile_ns: int, timed: bool = True) -> None:
     """Dispatch-wrapper callback: land one program's cost on every
-    active capture under its operator kernel label."""
+    active capture under its operator kernel label.  ``timed`` False =
+    a sampled-out program (launch overhead attributed, device drain
+    not measured); consumers scale device time by programs/timed."""
     with _sink_lock:
         for sink in _KERNEL_SINKS:
             agg = sink.get(label)
             if agg is None:
                 agg = sink[label] = {
                     "programs": 0, "device_ns": 0,
-                    "dispatch_ns": 0, "compile_ns": 0,
+                    "dispatch_ns": 0, "compile_ns": 0, "timed": 0,
                 }
             agg["programs"] += 1
             agg["device_ns"] += int(device_ns)
             agg["dispatch_ns"] += int(dispatch_ns)
             agg["compile_ns"] += int(compile_ns)
+            agg["timed"] += 1 if timed else 0
+
+
+def scaled_device_ns(v: Dict[str, int]) -> int:
+    """A kernel entry's device time scaled back up by the sampling
+    factor (programs/timed) — the estimate ``--report`` renders and
+    span totals carry.  Entries with no timed program contribute 0.
+    On a genuinely async device the sampled drain also waits out
+    unsampled programs queued ahead of it, so this is an UPPER BOUND
+    on true device time, not an unbiased estimate."""
+    timed = v.get("timed", v.get("programs", 0))
+    if not timed:
+        return 0
+    return int(round(v["device_ns"] * (v["programs"] / timed)))
 
 
 def sum_kernels(sink: Dict[str, Dict[str, int]]) -> Dict[str, int]:
     """Collapse a kernel capture into the per-span totals the event
-    schema carries."""
+    schema carries (device time scaled by the sampling factor)."""
     return {
         "programs": sum(v["programs"] for v in sink.values()),
-        "device_time_ns": sum(v["device_ns"] for v in sink.values()),
+        "device_time_ns": sum(scaled_device_ns(v) for v in sink.values()),
         "dispatch_overhead_ns": sum(v["dispatch_ns"] for v in sink.values()),
         "compile_ns": sum(v["compile_ns"] for v in sink.values()),
     }
@@ -294,6 +362,27 @@ def read_events(path: str) -> List[Dict[str, Any]]:
                 out.append(json.loads(line))
             except json.JSONDecodeError:
                 continue
+    return out
+
+
+def read_event_log(path: str) -> List[Dict[str, Any]]:
+    """Read a possibly ROTATED event log: the numbered segments a
+    size-capped log rolled over (<path>.seg1, .seg2, ... oldest first)
+    followed by the active file.  A log that never rotated reads
+    exactly like :func:`read_events` (including OSError on a missing
+    path)."""
+    segs: List[str] = []
+    k = 1
+    while os.path.exists(f"{path}.seg{k}"):
+        segs.append(f"{path}.seg{k}")
+        k += 1
+    if not segs:
+        return read_events(path)
+    out: List[Dict[str, Any]] = []
+    for seg in segs:
+        out.extend(read_events(seg))
+    if os.path.exists(path):
+        out.extend(read_events(path))
     return out
 
 

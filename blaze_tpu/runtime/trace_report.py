@@ -158,25 +158,38 @@ def render(events: List[Dict[str, Any]]) -> str:
             f"{_fmt_s(total['wall'])} stage wall"
         )
 
-        # per-kernel-label attribution across all stages
+        # per-kernel-label attribution across all stages.  Sampled
+        # captures (spark.blaze.trace.sampleRate > 1) timed only every
+        # Nth program: device time scales back up by programs/timed
+        # (trace.scaled_device_ns), flagged with '~' as an estimate.
+        from . import trace as _trace
+
         kernels: Dict[str, Dict[str, int]] = {}
         for e in completes:
             for label, v in (e.get("kernels") or {}).items():
                 agg = kernels.setdefault(
                     label, {"programs": 0, "device_ns": 0,
-                            "dispatch_ns": 0, "compile_ns": 0})
+                            "dispatch_ns": 0, "compile_ns": 0, "timed": 0})
                 for k in agg:
-                    agg[k] += v.get(k, 0)
+                    if k == "timed":
+                        agg[k] += v.get("timed", v.get("programs", 0))
+                    else:
+                        agg[k] += v.get(k, 0)
         if kernels:
             lines.append("")
             lines.append("operator kernels (by device time):")
-            for label, v in sorted(kernels.items(),
-                                   key=lambda kv: -kv[1]["device_ns"]):
+            for label, v in sorted(
+                    kernels.items(),
+                    key=lambda kv: -_trace.scaled_device_ns(kv[1])):
+                sampled = v["timed"] < v["programs"]
+                dev = _trace.scaled_device_ns(v)
                 lines.append(
                     f"  {label:24s} programs {v['programs']:>5d}  "
-                    f"device {_fmt_s(v['device_ns']):>9s}  "
+                    f"device {('~' if sampled else '') + _fmt_s(dev):>9s}  "
                     f"dispatch {_fmt_s(v['dispatch_ns']):>9s}  "
                     f"compile {_fmt_s(v['compile_ns'])}"
+                    + (f"  (timed {v['timed']}/{v['programs']})"
+                       if sampled else "")
                 )
 
     # ---- plan-annotated metrics tree (merged per stage)

@@ -3,15 +3,27 @@
 1. **Dispatch budget**: warm TPC-H q01 must execute in <= 8 XLA
    dispatches per input batch with ZERO recompiles on the second run —
    the q01 collapse (ISSUE 2) that future PRs must not silently
-   re-fragment.
+   re-fragment.  A warm hash-shuffle MAP stage over a traceable chain
+   through the stage scheduler must execute <= 2 dispatches per batch
+   (ISSUE 4's fused shuffle write), also with zero warm recompiles.
 2. **Fused-vs-unfused differential**: every tier-1 sample query must
    produce identical results with ``spark.blaze.fusion.enabled=false``
-   (the per-operator fallback path stays correct).
+   (the per-operator fallback path stays correct) — including
+   generate/expand/window chains and the fused shuffle write, whose
+   ``.data``/``.index`` output (spill path included) must be
+   byte-identical to the unfused writer's.
 3. **Observability plumbing**: the scheduler MetricNode carries the
    ``xla_dispatches`` / ``xla_compiles`` / ``compile_ms`` /
    ``fused_stage_len`` counters per stage.
+4. **Deferred agg count**: the fused agg update keeps its accumulator
+   occupancy count device-resident — zero scalar syncs gate a dispatch
+   on the warm q01 steady state (``fused_agg_stall_syncs``).
 """
 
+import os
+import tempfile
+
+import numpy as np
 import pytest
 
 from blaze_tpu import conf
@@ -173,6 +185,349 @@ def test_fused_update_overflow_falls_back_to_eager(data):
         for k, s in zip(d["k"], d["s#sum"]):
             seen[k] = seen.get(k, 0) + s
     assert len(seen) == n and all(v == 3 for v in seen.values())
+
+
+def test_fused_update_rollback_after_eager_interleave_exact():
+    """Regression: when the fused path resumes from a state the EAGER
+    pending-merge built (a plain RecordBatch), that state must become
+    the overflow-rollback base — rebuilding from the pre-merge
+    accumulator silently dropped the eager-merged groups (9000 of
+    14000 keys surviving in the repro)."""
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops import AggExec, AggFunction, AggMode, GroupingExpr
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("k", DataType.int64()), Field("v", DataType.int64())])
+
+    def mk(keys):
+        return batch_from_pydict({"k": list(keys), "v": [3] * len(keys)}, schema)
+
+    # seed 2000 distinct (cap 2048); five cap-1024 batches force the
+    # stall path then the eager pending merge (5000 rows >= 4096); a
+    # cap-8192 batch resumes the fused path and overflows it
+    batches = [mk(range(0, 2000))]
+    base = 2000
+    for _ in range(5):
+        batches.append(mk(range(base, base + 1000)))
+        base += 1000
+    batches.append(mk(range(base, base + 7000)))
+    base += 7000
+
+    scan = MemoryScanExec([batches], schema)
+    agg = AggExec(scan, AggMode.PARTIAL, [GroupingExpr(col("k"), "k")],
+                  [AggFunction("sum", col("v"), "s")])
+    seen = {}
+    with dispatch.capture() as cap:
+        for b in agg.execute(0, TaskContext(0, 1)):
+            d = batch_to_pydict(b)
+            for k, s in zip(d["k"], d["s#sum"]):
+                seen[k] = seen.get(k, 0) + s
+    assert cap.get("fused_agg_rollbacks", 0) >= 1, (
+        f"scenario no longer reaches the resumed-overflow rollback: {cap}")
+    assert len(seen) == base and all(v == 3 for v in seen.values())
+
+
+def test_fused_agg_update_no_per_batch_stall(data):
+    """The warm q01 fused update never blocks a dispatch on the
+    accumulator count: the occupancy scalar stays device-resident, its
+    overflow check resolves AFTER the next batch's program is already
+    in the device queue (``fused_agg_deferred_syncs``), and no batch
+    forces a pre-dispatch fetch or an overflow rollback."""
+    _run(_optimized("q1", data))  # warm the kernels
+    with dispatch.capture() as warm:
+        _run(_optimized("q1", data))
+    assert warm.get("fused_agg_deferred_syncs", 0) > 0, warm
+    assert warm.get("fused_agg_stall_syncs", 0) == 0, warm
+    assert warm.get("fused_agg_rollbacks", 0) == 0, warm
+
+
+# ------------------------------------ fused shuffle write (tier 5)
+
+
+def _shuffle_chain_plan(data, n_parts=1):
+    """lineitem scan -> filter -> compute projection: the traceable map
+    chain a hash shuffle write absorbs."""
+    from blaze_tpu.exprs import col
+    from blaze_tpu.exprs.ir import Alias, BinOp, Lit
+    from blaze_tpu.ops.filter import FilterExec
+    from blaze_tpu.ops.project import ProjectExec
+    from blaze_tpu.schema import DataType
+
+    scan = _scans(data, batch_rows=2048, n_parts=n_parts)["lineitem"]
+    f = FilterExec(scan, BinOp(">", col("l_quantity"),
+                               Lit(10.0, DataType.float64())))
+    return ProjectExec(
+        f,
+        [col("l_orderkey"),
+         Alias(BinOp("+", col("l_linenumber"), Lit(1, DataType.int32())), "ln1"),
+         col("l_returnflag")],
+        ["l_orderkey", "ln1", "l_returnflag"],
+    )
+
+
+def _write_shuffle(data, n_out=4, budget=None):
+    """Run one optimized ShuffleWriterExec map task; returns the
+    committed (.data bytes, .index bytes, partition_lengths,
+    spill_count)."""
+    from blaze_tpu.exprs import col
+    from blaze_tpu.parallel.shuffle import HashPartitioning, ShuffleWriterExec
+    from blaze_tpu.runtime.memmgr import MemManager
+
+    d = tempfile.mkdtemp(prefix="blaze_fused_write_")
+    data_path, index_path = os.path.join(d, "m.data"), os.path.join(d, "m.index")
+    writer = optimize_plan(ShuffleWriterExec(
+        _shuffle_chain_plan(data), HashPartitioning([col("l_orderkey")], n_out),
+        data_path, index_path,
+    ))
+    if budget is not None:
+        MemManager._global = None
+        MemManager.init(budget)
+    try:
+        list(writer.execute(0, TaskContext(0, 1)))
+    finally:
+        if budget is not None:
+            MemManager._global = None
+            MemManager.init(int(conf.HOST_SPILL_BUDGET.get()))
+    with open(data_path, "rb") as f:
+        blob = f.read()
+    with open(index_path, "rb") as f:
+        idx = f.read()
+    spills = writer.metrics.get("spill_count")
+    lengths = writer.partition_lengths
+    return blob, idx, lengths, spills
+
+
+def test_fused_shuffle_write_byte_identical(data):
+    """Tier 5 differential: hash pids, per-partition counts, and the
+    committed .data/.index pair are byte-identical between the fused
+    one-program writer and the unfused chain+hash+sort path."""
+    blob_f, idx_f, lengths_f, _ = _write_shuffle(data)
+    conf.FUSION_ENABLE.set(False)
+    try:
+        blob_u, idx_u, lengths_u, _ = _write_shuffle(data)
+    finally:
+        conf.FUSION_ENABLE.set(True)
+    assert lengths_f == lengths_u
+    assert blob_f == blob_u and idx_f == idx_u
+
+
+def test_fused_shuffle_write_spill_path_byte_identical(data):
+    """The spill path (memory pressure mid-map) commits the same bytes
+    fused and unfused — the async double-buffered writer preserves
+    insertion order and the commit-by-rename contract."""
+    blob_f, idx_f, _, spills_f = _write_shuffle(data, budget=60_000)
+    assert spills_f > 0, "budget too high to force the spill path"
+    conf.FUSION_ENABLE.set(False)
+    try:
+        blob_u, idx_u, _, spills_u = _write_shuffle(data, budget=60_000)
+    finally:
+        conf.FUSION_ENABLE.set(True)
+    assert spills_u > 0
+    assert blob_f == blob_u and idx_f == idx_u
+
+
+def test_fused_shuffle_write_sync_writer_byte_identical(data):
+    """spark.blaze.shuffle.asyncWrite=false (the synchronous staging
+    path) commits identical bytes."""
+    blob_a, idx_a, _, _ = _write_shuffle(data)
+    conf.SHUFFLE_ASYNC_WRITE.set(False)
+    try:
+        blob_s, idx_s, _, _ = _write_shuffle(data)
+    finally:
+        conf.SHUFFLE_ASYNC_WRITE.set(True)
+    assert blob_a == blob_s and idx_a == idx_s
+
+
+def test_shuffle_map_stage_warm_dispatch_budget(data):
+    """A warm hash-shuffle map stage over a traceable chain, through
+    the stage scheduler (TaskDefinition bytes), executes <= 2 XLA
+    dispatches per input batch with zero warm recompiles — the
+    ISSUE 4 acceptance criterion (one fused chain+pids+sort+counts
+    program per batch, plus slack for per-task constants)."""
+    from blaze_tpu.exprs import col
+    from blaze_tpu.parallel import HashPartitioning, NativeShuffleExchangeExec
+    from blaze_tpu.runtime.metrics import MetricNode
+    from blaze_tpu.runtime.scheduler import run_stages, split_stages
+
+    n_parts = 2
+    n_rows = len(data["lineitem"]["l_quantity"][0])
+    batch_rows = 2048
+    # map tasks see ceil(rows_in_part / batch_rows) batches each
+    per_part = (n_rows + n_parts - 1) // n_parts
+    n_batches = n_parts * ((per_part + batch_rows - 1) // batch_rows)
+    assert n_batches >= 4
+
+    def run_once():
+        plan = NativeShuffleExchangeExec(
+            _shuffle_chain_plan(data, n_parts=n_parts),
+            HashPartitioning([col("l_orderkey")], 3),
+        )
+        stages, manager = split_stages(plan)
+        node = MetricNode()
+        rows = 0
+        for b in run_stages(stages, manager, metrics=node):
+            rows += b.num_rows
+        assert rows > 0
+        return node
+
+    run_once()  # cold: compiles allowed
+    node = run_once()
+    map_stage = node.child(0).metrics
+    assert map_stage.get("xla_compiles") == 0, "warm map stage recompiled"
+    per_batch = map_stage.get("xla_dispatches") / n_batches
+    assert per_batch <= 2, (
+        f"warm map stage issued {map_stage.get('xla_dispatches')} dispatches "
+        f"over {n_batches} batches ({per_batch:.2f}/batch > 2)")
+
+
+# --------------------------- generate / expand / window chains
+
+
+def _rows_of(plan):
+    return _rows(_run(plan))
+
+
+def test_fused_vs_unfused_generate_chain():
+    """explode -> filter -> compute projection collapses into one
+    FusedStageExec program; fusion off must match row-for-row."""
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.exprs.ir import Alias, BinOp, Lit
+    from blaze_tpu.ops.filter import FilterExec
+    from blaze_tpu.ops.fusion import FusedStageExec
+    from blaze_tpu.ops.generate import GenerateExec, NativeGenerator
+    from blaze_tpu.ops.project import ProjectExec
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    arr_t = DataType.array(DataType.int64(), 4)
+    schema = Schema([Field("k", DataType.int64()), Field("xs", arr_t)])
+    rows = {"k": list(range(40)),
+            "xs": [[i, i + 1, i + 2][: (i % 4)] or None for i in range(40)]}
+
+    def plan():
+        scan = MemoryScanExec([[batch_from_pydict(rows, schema)]], schema)
+        g = GenerateExec(scan, NativeGenerator("explode", col("xs")), [col("xs")])
+        f = FilterExec(g, BinOp(">", col("col"), Lit(5, DataType.int64())))
+        return optimize_plan(ProjectExec(
+            f, [col("k"), Alias(BinOp("+", col("col"), Lit(1, DataType.int64())), "c1")],
+            ["k", "c1"]))
+
+    fused_plan = plan()
+    assert isinstance(fused_plan, FusedStageExec), fused_plan.tree_string()
+    fused = _rows_of(fused_plan)
+    assert fused
+    conf.FUSION_ENABLE.set(False)
+    try:
+        unfused = _rows_of(plan())
+    finally:
+        conf.FUSION_ENABLE.set(True)
+    assert fused == unfused
+
+
+def test_fused_vs_unfused_expand_chain():
+    """expand (grouping-sets style projections) -> filter fuses into
+    one program emitting all P projections compacted to a prefix."""
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.exprs.ir import BinOp, Lit
+    from blaze_tpu.ops.expand import ExpandExec
+    from blaze_tpu.ops.filter import FilterExec
+    from blaze_tpu.ops.fusion import FusedStageExec
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("k", DataType.int64())])
+    rows = {"k": list(range(50))}
+
+    def plan():
+        scan = MemoryScanExec([[batch_from_pydict(rows, schema)]], schema)
+        e = ExpandExec(
+            scan,
+            [[col("k"), Lit(0, DataType.int64())],
+             [BinOp("*", col("k"), Lit(2, DataType.int64())), Lit(1, DataType.int64())]],
+            ["v", "tag"],
+        )
+        return optimize_plan(
+            FilterExec(e, BinOp(">", col("v"), Lit(10, DataType.int64()))))
+
+    fused_plan = plan()
+    assert isinstance(fused_plan, FusedStageExec), fused_plan.tree_string()
+    fused = _rows_of(fused_plan)
+    assert fused
+    conf.FUSION_ENABLE.set(False)
+    try:
+        unfused = _rows_of(plan())
+    finally:
+        conf.FUSION_ENABLE.set(True)
+    assert fused == unfused
+
+
+def test_fused_vs_unfused_window_shuffle_write():
+    """A window map-side feeding a hash shuffle write: the writer
+    absorbs the window kernel (partition-buffered bottom) + pids +
+    sort into one program; files byte-identical to the unfused path."""
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.ops.sort import SortField
+    from blaze_tpu.ops.window import WindowExec, WindowFunction
+    from blaze_tpu.parallel.shuffle import HashPartitioning, ShuffleWriterExec
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("g", DataType.int64()), Field("v", DataType.int64())])
+    rows = {"g": sorted(i % 5 for i in range(200)),
+            "v": [i * 7 % 13 for i in range(200)]}
+
+    def write():
+        d = tempfile.mkdtemp(prefix="blaze_window_write_")
+        data_path, index_path = os.path.join(d, "m.data"), os.path.join(d, "m.index")
+        scan = MemoryScanExec([[batch_from_pydict(rows, schema)]], schema)
+        w = WindowExec(scan, [WindowFunction("row_number", "rn")],
+                       [col("g")], [SortField(col("v"), True, True)])
+        writer = optimize_plan(ShuffleWriterExec(
+            w, HashPartitioning([col("g")], 3), data_path, index_path))
+        list(writer.execute(0, TaskContext(0, 1)))
+        with open(data_path, "rb") as f:
+            blob = f.read()
+        with open(index_path, "rb") as f:
+            idx = f.read()
+        return blob, idx, writer
+
+    blob_f, idx_f, writer = write()
+    assert writer._fused_write is not None, "window chain not absorbed"
+    conf.FUSION_ENABLE.set(False)
+    try:
+        blob_u, idx_u, writer_u = write()
+        assert writer_u._fused_write is None
+    finally:
+        conf.FUSION_ENABLE.set(True)
+    assert blob_f == blob_u and idx_f == idx_u
+
+
+def test_fused_vs_unfused_round_robin_write(data):
+    """Round-robin partitioning fuses too (pids from a traced offset);
+    byte-identical to the unfused arange/sort path."""
+    from blaze_tpu.parallel.shuffle import RoundRobinPartitioning, ShuffleWriterExec
+
+    def write():
+        d = tempfile.mkdtemp(prefix="blaze_rr_write_")
+        data_path, index_path = os.path.join(d, "m.data"), os.path.join(d, "m.index")
+        writer = optimize_plan(ShuffleWriterExec(
+            _shuffle_chain_plan(data), RoundRobinPartitioning(3),
+            data_path, index_path))
+        list(writer.execute(0, TaskContext(0, 1)))
+        with open(data_path, "rb") as f:
+            blob = f.read()
+        with open(index_path, "rb") as f:
+            idx = f.read()
+        return blob, idx
+
+    blob_f, idx_f = write()
+    conf.FUSION_ENABLE.set(False)
+    try:
+        blob_u, idx_u = write()
+    finally:
+        conf.FUSION_ENABLE.set(True)
+    assert blob_f == blob_u and idx_f == idx_u
 
 
 def test_scheduler_stage_dispatch_counters(data):

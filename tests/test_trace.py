@@ -419,3 +419,139 @@ def test_metrics_merge():
     b.add("bytes", 7)
     a.merge(b)
     assert a.snapshot() == {"rows": 5, "bytes": 7}
+
+
+# ------------------------------------- 7. sampling + log rotation
+
+def test_trace_sample_rate_times_every_nth_program(data, tmp_path):
+    """spark.blaze.trace.sampleRate=N: with tracing armed, only every
+    Nth instrumented program pays the block-until-ready device drain;
+    unsampled calls still count programs and launch overhead, and
+    sum_kernels scales the device total by programs/timed."""
+    from blaze_tpu.ops.fusion import optimize_plan
+    from blaze_tpu.runtime.context import TaskContext
+
+    def run_once():
+        plan = optimize_plan(build_query("q6", _scans(data, 1, 8192), 1))
+        for p in range(plan.num_partitions()):
+            for _ in plan.execute(p, TaskContext(p, plan.num_partitions())):
+                pass
+
+    run_once()  # warm: compiles out of the way
+    conf.TRACE_SAMPLE_RATE.set(4)
+    trace.reset()
+    try:
+        with trace.kernel_capture() as kc:
+            run_once()
+    finally:
+        conf.TRACE_SAMPLE_RATE.set(1)
+        trace.reset()
+    programs = sum(v["programs"] for v in kc.values())
+    timed = sum(v["timed"] for v in kc.values())
+    assert programs > 4
+    assert 0 < timed < programs, (programs, timed)
+    # scaling: the span total estimates full-fidelity device time
+    raw = sum(v["device_ns"] for v in kc.values())
+    scaled = trace.sum_kernels(kc)["device_time_ns"]
+    assert scaled >= raw
+    # the per-label scaler round-trips programs/timed
+    for v in kc.values():
+        if v["timed"]:
+            assert trace.scaled_device_ns(v) >= v["device_ns"]
+
+
+def test_trace_sample_rate_one_times_everything(data, tmp_path):
+    """The default sampleRate=1 keeps full-fidelity attribution:
+    every program timed (the pre-existing contract)."""
+    from blaze_tpu.ops.fusion import optimize_plan
+    from blaze_tpu.runtime.context import TaskContext
+
+    plan = optimize_plan(build_query("q6", _scans(data, 1, 8192), 1))
+    trace.reset()
+    with trace.kernel_capture() as kc:
+        for p in range(plan.num_partitions()):
+            for _ in plan.execute(p, TaskContext(p, plan.num_partitions())):
+                pass
+    for label, v in kc.items():
+        assert v["timed"] == v["programs"], (label, v)
+
+
+def test_event_log_rotation_and_rotated_report(tmp_path):
+    """spark.blaze.eventLog.maxBytes: the active file rolls over into
+    numbered segments; read_event_log reassembles the set in emission
+    order and --report renders from it."""
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    conf.EVENT_LOG_MAX_BYTES.set(1500)
+    trace.reset()
+    try:
+        with trace.query("rotation_check") as path:
+            for i in range(200):
+                trace.emit("mem_watermark", used=i, total=4096)
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        conf.EVENT_LOG_MAX_BYTES.set(0)
+        trace.reset()
+    segs = sorted(p for p in os.listdir(tmp_path) if ".seg" in p)
+    assert segs, "no rollover segments despite the 1.5 KB cap"
+    for seg in segs:
+        assert os.path.getsize(os.path.join(tmp_path, seg)) >= 1500
+    events = trace.read_event_log(path)
+    watermarks = [e for e in events if e["type"] == "mem_watermark"]
+    assert len(watermarks) == 200
+    # emission order survives the segment stitching
+    assert [e["used"] for e in watermarks] == list(range(200))
+    # the active (last) file stays under the cap + one event of slack
+    assert os.path.getsize(path) < 1500 + 200
+    # the CLI renders the rotated set
+    from blaze_tpu.__main__ import main
+
+    assert main(["--report", path]) == 0
+
+
+def test_event_log_no_rotation_by_default(tmp_path):
+    """maxBytes=0 (default): one unbounded file, no segments."""
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    trace.reset()
+    try:
+        with trace.query("no_rotation") as path:
+            for i in range(50):
+                trace.emit("mem_watermark", used=i, total=4096)
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+    assert not [p for p in os.listdir(tmp_path) if ".seg" in p]
+    assert trace.read_event_log(path) == trace.read_events(path)
+
+
+def test_event_log_rotation_never_clobbers_prior_segments(tmp_path):
+    """Regression: reset() clears the in-memory segment counter while
+    the same query_id + pid regenerates the same log path — the next
+    rollover must probe past .segN files already on disk instead of
+    os.replace()ing over run 1's first segment."""
+    def run_once():
+        conf.TRACE_ENABLE.set(True)
+        conf.EVENT_LOG_DIR.set(str(tmp_path))
+        conf.EVENT_LOG_MAX_BYTES.set(1000)
+        trace.reset()
+        try:
+            with trace.query("clobber_check") as path:
+                for i in range(60):
+                    trace.emit("mem_watermark", used=i, total=4096)
+        finally:
+            conf.TRACE_ENABLE.set(False)
+            conf.EVENT_LOG_DIR.set("")
+            conf.EVENT_LOG_MAX_BYTES.set(0)
+            trace.reset()
+        return path
+
+    p1 = run_once()
+    p2 = run_once()
+    assert p1 == p2, "repro requires the regenerated path to collide"
+    watermarks = [e for e in trace.read_event_log(p1)
+                  if e["type"] == "mem_watermark"]
+    assert len(watermarks) == 120, (
+        f"rollover clobbered earlier segments: {len(watermarks)}/120 events")
