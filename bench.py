@@ -143,6 +143,16 @@ def _adapt_scales(bw: float) -> tuple:
     return s6, s1
 
 
+def _profile_sample_rate() -> int:
+    """The kernel-attribution sampling the profile pass ran under
+    (spark.blaze.trace.sampleRate) — stamped into the emitted line so
+    a scaled device-time estimate is never mistaken for a measured
+    one."""
+    from blaze_tpu import conf
+
+    return max(1, int(conf.TRACE_SAMPLE_RATE.get()))
+
+
 def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
              partial_sink=None, retries: int = 0, extras: dict = None) -> dict:
     """Run q06 + q01 through the engine on the already-initialized
@@ -289,6 +299,12 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
             stats["device_time_s"] = round(k["device_time_ns"] / 1e9, 4)
             stats["dispatch_overhead_s"] = round(
                 k["dispatch_overhead_ns"] / 1e9, 4)
+            # provenance: how many programs actually paid the
+            # block-until-ready drain (< programs when a sampleRate is
+            # set — device_time_s is then a scaled estimate, and a
+            # judge must know before trusting MFU from the line)
+            stats["timed"] = sum(
+                v.get("timed", v["programs"]) for v in prof.values())
         except Exception:  # noqa: BLE001 — the profile pass is
             pass  # optional: a tunnel flap here must not discard the
             # ALREADY-COMPLETED throughput measurement above (the line
@@ -330,6 +346,12 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
         "scale_q01": scale_q1,
         "iterations": 3,
         "backend": "tpu" if on_tpu else "cpu",
+        # profile provenance: what HARDWARE and what SAMPLING produced
+        # the device_time_s / dispatch_overhead_s split in this line,
+        # so real-chip and CPU-fallback numbers are distinguishable
+        # from the artifact itself (VERDICT r5 next-steps #7)
+        "device_kind": str(jax.devices()[0])[:80],
+        "trace_sample_rate": _profile_sample_rate(),
         "dispatch_count": stats6["dispatch_count"],
         "compile_ms": stats6["compile_ms"],
         # nonzero = compiles happened INSIDE the timed loop (shape
@@ -339,7 +361,7 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
     }
     # dispatch-floor profile of one warm iteration (VERDICT r5 #7) —
     # absent when the optional profile pass failed (tunnel flap)
-    for k in ("programs", "device_time_s", "dispatch_overhead_s"):
+    for k in ("programs", "device_time_s", "dispatch_overhead_s", "timed"):
         if k in stats6:
             result[k] = stats6[k]
     if extras:
@@ -358,9 +380,17 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
     result["q01_warm_compiles"] = stats1["warm_compiles"]
     for src, dst in (("programs", "q01_programs"),
                      ("device_time_s", "q01_device_time_s"),
-                     ("dispatch_overhead_s", "q01_dispatch_overhead_s")):
+                     ("dispatch_overhead_s", "q01_dispatch_overhead_s"),
+                     ("timed", "q01_timed")):
         if src in stats1:
             result[dst] = stats1[src]
+    # per-half provenance: best-of can pair a CACHED q06 (whose
+    # device_kind/trace_sample_rate win the top-level stamps) with a
+    # freshly measured q01 under different hardware/sampling — each
+    # half must be self-identifying or a scaled q01 estimate reads as
+    # fully measured
+    result["q01_device_kind"] = result["device_kind"]
+    result["q01_trace_sample_rate"] = result["trace_sample_rate"]
     # freshness marker: measured in THIS run (a cache-merged q01 keeps
     # its ORIGINAL stamp so consumers can tell fresh from carried-over)
     result["q01_measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -373,16 +403,21 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
 _Q01_CARRY_KEYS = (
     "q01_rows_per_sec", "q01_vs_baseline", "q01_dispatch_count",
     "q01_compile_ms", "q01_warm_compiles", "q01_programs",
-    "q01_device_time_s", "q01_dispatch_overhead_s",
+    "q01_device_time_s", "q01_dispatch_overhead_s", "q01_timed",
+    "q01_device_kind", "q01_trace_sample_rate",
 )
 # the q06 half, kept together under best-of selection — pairing one
 # run's throughput with another run's counters would let a
-# compile-polluted number masquerade as clean
+# compile-polluted number masquerade as clean.  Profile provenance
+# (device_kind / trace_sample_rate / timed) travels WITH the winning
+# half: its device_time_s is only judgeable against the hardware and
+# sampling that produced it.
 _Q06_BEST_OF_KEYS = (
     "value", "vs_baseline", "bytes_per_sec", "scale_q06",
     "tunnel_bytes_per_sec", "iterations", "measured_at",
     "dispatch_count", "compile_ms", "warm_compiles", "programs",
-    "device_time_s", "dispatch_overhead_s",
+    "device_time_s", "dispatch_overhead_s", "timed",
+    "device_kind", "trace_sample_rate",
 )
 
 

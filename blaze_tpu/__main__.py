@@ -18,6 +18,20 @@ Usage:
     python -m blaze_tpu tpch q1 --chaos --chaos-seed 42
     python -m blaze_tpu tpch q1 --scheduler --trace   # write an event log
     python -m blaze_tpu --report <eventlog.jsonl>     # render the profile
+    python -m blaze_tpu --report <log> --json out.json  # + JSON profile
+    python -m blaze_tpu --serve [--monitor-port N]    # metrics service
+    python -m blaze_tpu tpch q1 --scheduler --monitor # live-registry run
+    python -m blaze_tpu --watch [URL|PORT]            # live progress table
+
+``--serve`` / ``--monitor`` arm the live monitoring subsystem
+(runtime/monitor.py, conf ``spark.blaze.monitor.enabled`` /
+``.port`` / ``.heartbeatMs``): a background HTTP server exposes
+``/metrics`` (Prometheus text exposition from the scheduler MetricNode
+tree + dispatch counters) and ``/queries`` (per-query -> per-stage live
+state fed by progress heartbeats), and ``--watch`` polls ``/queries``
+into a refreshing console table.  Bare ``--serve`` runs the service in
+the foreground until interrupted; with queries it serves for the
+duration of the run.
 
 ``--trace`` arms the structured event log (runtime/trace.py, conf
 ``spark.blaze.trace.enabled`` / ``spark.blaze.eventLog.dir``): each
@@ -100,14 +114,18 @@ def _run_suite(suite: str, names, scale: float, n_parts: int,
     if build_query is None:
         return names
 
-    from .runtime import trace
-    from .runtime.context import TaskContext
+    from .runtime import monitor
 
     failed = []
     for name in names:
         t0 = time.perf_counter()
         try:
-            with trace.query(f"{suite}_{name}") as log_path:
+            # combined span: trace event log (when traced) + live
+            # registry entry (when the monitor is armed)
+            with monitor.query_span(
+                    f"{suite}_{name}",
+                    mode="scheduler" if scheduler else "in-process",
+            ) as log_path:
                 plan = build_query(name, scans, n_parts)
                 rows = 0
                 if scheduler:
@@ -117,9 +135,12 @@ def _run_suite(suite: str, names, scale: float, n_parts: int,
                     for b in run_stages(stages, manager):
                         rows += b.num_rows
                 else:
-                    for p in range(plan.num_partitions()):
-                        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
-                            rows += b.num_rows
+                    # in-process path: same query -> stage span shape
+                    # as the scheduler path (one result stage)
+                    tally: list = []
+                    monitor.drive_result_stage(
+                        plan, lambda b: tally.append(b.num_rows))
+                    rows = sum(tally)
             dt = time.perf_counter() - t0
             print(f"{suite} {name}: {rows} rows in {dt:.2f}s"
                   + (" [scheduler]" if scheduler else "")
@@ -239,7 +260,7 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
     Nonzero exit on mismatch, unrecovered failure, or an event log
     that doesn't reconcile."""
     from . import conf
-    from .runtime import faults, scheduler, trace, trace_report
+    from .runtime import faults, monitor, scheduler, trace, trace_report
 
     build_query, names, scans = _load_suite(suite, names, scale, n_parts)
     if build_query is None:
@@ -265,7 +286,8 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
         trace.reset()
         log_path = None
         try:
-            with trace.query(f"chaos_{suite}_{name}") as log_path:
+            with monitor.query_span(f"chaos_{suite}_{name}",
+                                    mode="scheduler") as log_path:
                 chaotic = _rows_via_scheduler(build_query(name, scans, n_parts))
         except Exception as e:  # noqa: BLE001
             print(f"chaos {name}: UNRECOVERED under spec '{spec}': "
@@ -310,6 +332,79 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
               file=sys.stderr)
         return 1
     return 0
+
+
+def _serve_forever() -> int:
+    """Bare ``--serve``: keep the already-started monitor service in
+    the foreground until interrupted, then shut down cleanly."""
+    print("# monitor: serving until interrupted (Ctrl-C to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rc = _shutdown_monitor_checked()
+    return rc
+
+
+def _shutdown_monitor_checked() -> int:
+    """Stop the monitor server and verify nothing leaked: a long-lived
+    background service must never wedge process exit (nonzero when a
+    blaze-monitor thread survives shutdown)."""
+    from .runtime import monitor
+
+    monitor.shutdown_server()
+    leaked = monitor.monitor_threads()
+    if leaked:
+        print("# monitor: THREAD LEAK after shutdown: "
+              + ", ".join(t.name for t in leaked), file=sys.stderr)
+        return 1
+    return 0
+
+
+def _watch(target: str, interval: float, polls: int) -> int:
+    """``--watch``: poll a running monitor's /queries endpoint and
+    render a refreshing stage-progress table."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from . import conf
+    from .runtime import monitor
+
+    if target == "default":
+        url = f"http://127.0.0.1:{int(conf.MONITOR_PORT.get())}"
+    elif target.isdigit():
+        url = f"http://127.0.0.1:{target}"
+    else:
+        url = target.rstrip("/")
+    done = 0
+    try:
+        while True:
+            try:
+                with urllib.request.urlopen(url + "/queries", timeout=5) as r:
+                    snap = _json.load(r)
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                if done:
+                    # the server WAS reachable: a monitored run shuts
+                    # its service down at end-of-run — that is a
+                    # normal end of the watch, not a failure
+                    print(f"watch: monitor at {url} gone "
+                          "(run finished?)", file=sys.stderr)
+                    return 0
+                print(f"watch: cannot reach {url}/queries: {e}",
+                      file=sys.stderr)
+                return 1
+            # clear + home, then one frame (plain append when piped)
+            prefix = "\x1b[2J\x1b[H" if sys.stdout.isatty() else ""
+            print(prefix + monitor.render_watch(snap, url), flush=True)
+            done += 1
+            if polls and done >= polls:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def main(argv=None) -> int:
@@ -358,7 +453,38 @@ def main(argv=None) -> int:
     ap.add_argument("--report", default="",
                     help="render the per-query profile from a JSONL event "
                          "log produced by --trace / --chaos and exit")
+    ap.add_argument("--json", default="", metavar="PATH",
+                    help="with --report: also write the full profile as "
+                         "one JSON document (stage timeline, dispatch-floor "
+                         "split, kernel table, recovery pairing) to PATH "
+                         "('-' = stdout instead of the text rendering)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the live monitoring HTTP service "
+                         "(/metrics Prometheus text, /queries JSON); bare "
+                         "--serve serves in the foreground until "
+                         "interrupted, with queries it serves for the "
+                         "duration of the run")
+    ap.add_argument("--monitor", action="store_true",
+                    help="arm the live query monitor "
+                         "(spark.blaze.monitor.enabled) for this run: "
+                         "registry + background HTTP server; asserts a "
+                         "clean, thread-leak-free shutdown afterwards")
+    ap.add_argument("--monitor-port", type=int, default=None,
+                    help="monitor HTTP port (default: conf "
+                         "spark.blaze.monitor.port; 0 = ephemeral)")
+    ap.add_argument("--watch", nargs="?", const="default", default=None,
+                    metavar="URL|PORT",
+                    help="poll a running monitor's /queries and render a "
+                         "refreshing stage-progress table (default "
+                         "http://127.0.0.1:<spark.blaze.monitor.port>)")
+    ap.add_argument("--watch-interval", type=float, default=1.0,
+                    help="--watch poll interval in seconds (default 1.0)")
+    ap.add_argument("--watch-polls", type=int, default=0,
+                    help="--watch: stop after N polls (0 = until ^C)")
     args = ap.parse_args(argv)
+    if args.json and not args.report:
+        ap.error("--json requires --report (it mirrors the rendered "
+                 "profile as JSON)")
     if args.report:
         from .runtime import trace, trace_report
 
@@ -372,8 +498,26 @@ def main(argv=None) -> int:
         if not events:
             print(f"no events in {args.report}", file=sys.stderr)
             return 1
+        if args.json:
+            import json as _json
+
+            doc = trace_report.render_json(events)
+            if args.json == "-":
+                print(_json.dumps(doc, indent=2, default=str))
+                return 0
+            with open(args.json, "w") as f:
+                _json.dump(doc, f, indent=2, default=str)
+            print(f"# json profile: {args.json}")
         print(trace_report.render(events))
         return 0
+    if args.watch is not None:
+        if args.monitor_port is not None:
+            # the default watch target honors an explicit port (this
+            # branch returns before the --serve/--monitor conf wiring)
+            from . import conf
+
+            conf.MONITOR_PORT.set(args.monitor_port)
+        return _watch(args.watch, args.watch_interval, args.watch_polls)
     if args.trace or args.event_log_dir:
         from . import conf
         from .runtime import trace
@@ -385,25 +529,55 @@ def main(argv=None) -> int:
         if args.event_log_dir:
             conf.EVENT_LOG_DIR.set(args.event_log_dir)
         trace.reset()
+    monitor_armed = args.serve or args.monitor
+    if monitor_armed:
+        from . import conf
+        from .runtime import monitor
+
+        conf.MONITOR_ENABLE.set(True)
+        if args.monitor_port is not None:
+            conf.MONITOR_PORT.set(args.monitor_port)
+        monitor.reset()
+        srv = monitor.ensure_server()
+        if srv is not None:
+            print(f"# monitor: {srv.url}/metrics  {srv.url}/queries")
+        else:
+            # the registry still runs (a later --watch of another
+            # process won't see us, but the run must not die for its
+            # own observability)
+            print("# monitor: registry armed, server unavailable",
+                  file=sys.stderr)
     queries = args.queries or (
         ["q6"] if args.chaos else ["q1", "q6"] if args.warmup else None
     )
     if not queries:
-        ap.error("query names required (or pass --chaos / --warmup for "
-                 "the defaults)")
+        if args.serve:
+            return _serve_forever()
+        ap.error("query names required (or pass --chaos / --warmup / "
+                 "--serve for the defaults)")
     # persistent compile cache for plain runs too, when configured
     if not args.warmup:
         from .runtime.kernel_cache import enable_persistent_cache
 
         enable_persistent_cache()
-    if args.warmup:
-        return _warmup(args.suite, queries, args.scale, args.parts,
-                       args.xla_cache_dir)
-    if args.chaos:
-        return _run_chaos(args.suite, queries, args.scale, args.parts,
-                          args.chaos_seed, args.chaos_faults)
-    return _run_suite(args.suite, queries, args.scale, args.parts,
-                      args.scheduler)
+    rc = 0
+    try:
+        if args.warmup:
+            rc = _warmup(args.suite, queries, args.scale, args.parts,
+                         args.xla_cache_dir)
+        elif args.chaos:
+            rc = _run_chaos(args.suite, queries, args.scale, args.parts,
+                            args.chaos_seed, args.chaos_faults)
+        else:
+            rc = _run_suite(args.suite, queries, args.scale, args.parts,
+                            args.scheduler)
+    finally:
+        # every monitored mode guards the long-lived service: shutdown
+        # must not leak a thread or wedge process exit, and a leak is
+        # an exit-code failure, not a stderr footnote
+        if monitor_armed:
+            rc = _shutdown_monitor_checked() or rc
+    return rc
 
 
 if __name__ == "__main__":

@@ -133,6 +133,24 @@ EVENT_LOG_MAX_BYTES = ConfEntry("spark.blaze.eventLog.maxBytes", 0, int)
 # report flags it '~').
 TRACE_SAMPLE_RATE = ConfEntry("spark.blaze.trace.sampleRate", 1, int)
 
+# Live query monitoring (runtime/monitor.py).  OFF (default): no HTTP
+# server, no background thread, and the heartbeat path is a structural
+# no-op exactly like spark.blaze.trace.enabled=false.  ON: an in-process
+# registry tracks per-query -> per-stage live state and a background
+# HTTP server exposes /metrics (Prometheus text exposition rendered
+# from the scheduler MetricNode tree + dispatch counters) and /queries
+# (JSON live state) — ≙ the reference's metrics plumbed into the LIVE
+# Spark UI while the query runs, not only post-hoc (SURVEY).
+MONITOR_ENABLE = ConfEntry("spark.blaze.monitor.enabled", False, _bool)
+# Port for the monitor HTTP server; 0 = pick a free ephemeral port
+# (the bound port is logged and available via monitor.server_port()).
+MONITOR_PORT = ConfEntry("spark.blaze.monitor.port", 4048, int)
+# Progress-heartbeat cadence (ms): the scheduler and run_task emit
+# stage_progress / task_heartbeat events at most this often, into the
+# event log (when tracing is armed) and the live registry (when the
+# monitor is armed).  Smaller = fresher /queries, more events.
+MONITOR_HEARTBEAT_MS = ConfEntry("spark.blaze.monitor.heartbeatMs", 1000, int)
+
 # Whole-stage program fusion (ops/fusion.py): collapse traceable
 # operator chains / agg pre-filters / final-agg sorts into single XLA
 # programs.  OFF runs every operator as its own dispatch — the

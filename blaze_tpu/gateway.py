@@ -9,11 +9,107 @@ Arrow-Java's C Data interface.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes as C
-from typing import Dict, List, Tuple
+import itertools
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from . import native
 from .batch import RecordBatch
+
+#: the enclosing gateway query's shared stage progress (per thread):
+#: task_span reuses it so a multi-task drive produces ONE
+#: stage_submit/stage_complete pair, exactly like the scheduler
+_gw_tls = threading.local()
+
+
+@contextlib.contextmanager
+def query_span(query_id: str, n_tasks: int = 1) -> Iterator[Optional[str]]:
+    """Gateway-side query span: the JNI entry wraps one native query's
+    task drives in this so the FFI execution mode produces the same
+    query -> stage -> kernel span tree (event log when tracing is
+    armed) and live-registry entry (/queries when the monitor is
+    armed) as the scheduler and session paths.  Opens ONE ``result``
+    stage span covering all of the query's task drives (``n_tasks``
+    when known up front); :func:`task_span` nests inside it.  Yields
+    the event-log path (None when tracing is disarmed)."""
+    from .runtime import monitor
+
+    with monitor.query_span(query_id, mode="gateway") as log_path:
+        with monitor.stage_span(0, "result", n_tasks) as progress:
+            prev = getattr(_gw_tls, "progress", None)
+            prev_seq = getattr(_gw_tls, "task_seq", None)
+            _gw_tls.progress = progress
+            _gw_tls.task_seq = itertools.count()
+            try:
+                yield log_path
+            finally:
+                _gw_tls.progress = prev
+                _gw_tls.task_seq = prev_seq
+
+
+@contextlib.contextmanager
+def task_span(task_id: str, partition: Optional[int] = None,
+              n_tasks: int = 1):
+    """Span for one FFI-driven task (the ``bt_gateway_call_native``
+    batch loop): task-attempt events bracketing the export stream plus
+    the task's identity landed in the live registry — the same shape
+    the scheduler emits, so a gateway log renders identically.  Inside
+    a :func:`query_span` the enclosing stage span is shared (one
+    stage_submit/complete pair per query, never per task); a bare
+    task_span opens its own single-task stage.  When ``partition`` is
+    omitted, each task under the query span gets the next index in
+    sequence — the registry keys tasks by partition, so a shared
+    default would collapse a multi-task drive into one entry.
+    Structural no-op when tracing and the monitor are both
+    disarmed."""
+    from .runtime import monitor, trace
+
+    if partition is None:
+        seq = getattr(_gw_tls, "task_seq", None)
+        partition = next(seq) if seq is not None else 0
+    traced = trace.enabled()
+    if traced:
+        trace.emit("task_attempt_start", stage_id=0, task=partition,
+                   attempt=0)
+    status = "ok"
+    shared = getattr(_gw_tls, "progress", None)
+    progress = shared
+    rows0 = batches0 = 0
+    if shared is not None and shared.armed:
+        rows0, batches0 = shared.rows, shared.batches
+    try:
+        if shared is not None:
+            yield shared
+            if shared.armed:
+                shared.task_done()
+        else:
+            with monitor.stage_span(0, "result", n_tasks) as progress:
+                # publish the own stage's progress so export_batch_ffi
+                # feeds it, exactly as under an enclosing query_span
+                _gw_tls.progress = progress
+                try:
+                    yield progress
+                finally:
+                    _gw_tls.progress = None
+                # inside the span: the stage's final flush must see
+                # this task counted, or /queries reads a completed
+                # drive as stuck at 0/n tasks
+                if progress.armed:
+                    progress.task_done()
+    except BaseException:
+        status = "failed"
+        raise
+    finally:
+        if traced:
+            trace.emit("task_attempt_end", stage_id=0, task=partition,
+                       attempt=0, status=status)
+        if progress is not None and progress.armed and monitor.enabled():
+            monitor.task_beat(
+                0, partition, 0, rows=progress.rows - rows0,
+                batches=progress.batches - batches0,
+                progress_rows=progress.rows - rows0, task_id=task_id)
 
 
 class _FfiBatch(C.Structure):
@@ -30,7 +126,12 @@ _live: Dict[int, Tuple] = {}
 
 def export_batch_ffi(batch: RecordBatch) -> int:
     """Export a batch's columns (primitives AND strings) through the
-    Arrow C ABI; returns the address of an _FfiBatch struct."""
+    Arrow C ABI; returns the address of an _FfiBatch struct.
+
+    Every export inside an active gateway span counts toward its
+    stage progress; callers exporting intermediates rather than query
+    output (udf_bridge's UDF round-trip) wrap the export in
+    :func:`suppressed_span_progress`."""
     lib = native._load()
     assert lib is not None, "native runtime required for FFI export"
     b = batch.to_host()
@@ -56,7 +157,40 @@ def export_batch_ffi(batch: RecordBatch) -> int:
     fb = _FfiBatch(n, schemas, arrays)
     addr = C.addressof(fb)
     _live[addr] = (fb, schemas, arrays, keep)
+    # the JVM consumer's progress is otherwise invisible: batches
+    # crossing the Arrow C ABI feed the ACTIVE gateway span's stage
+    # progress.  Only query output counts — suppressed_span_progress
+    # scopes exports of other payloads (UDF round-trips), or they
+    # would mint phantom rows in the registry.
+    _count_span_progress(b)
     return addr
+
+
+def _count_span_progress(batch: RecordBatch) -> None:
+    """Feed one exported batch into the active gateway span's stage
+    progress (no-op outside a span or disarmed)."""
+    sp = getattr(_gw_tls, "progress", None)
+    if sp is not None and sp.armed:
+        sp.add_batch(batch)
+
+
+@contextlib.contextmanager
+def suppressed_span_progress() -> Iterator[None]:
+    """No export made inside this scope counts as query output.
+
+    UDF evaluation runs mid-drive — inside an active gateway span —
+    and BOTH halves of its FFI round-trip are intermediates: the
+    argument batch udf_bridge ships out, and the result batch the
+    registered evaluator exports back through the same
+    :func:`export_batch_ffi`.  Only the final query output crossing
+    the ABI may count, or a UDF projection over N rows reports ~2N
+    live rows."""
+    prev = getattr(_gw_tls, "progress", None)
+    _gw_tls.progress = None
+    try:
+        yield
+    finally:
+        _gw_tls.progress = prev
 
 
 def import_batch_ffi(addr: int, schema) -> RecordBatch:

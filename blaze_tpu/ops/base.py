@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Iterator, List, Optional, Sequence
 
 from ..batch import RecordBatch
+from ..runtime import monitor
 from ..runtime.context import TaskContext
 from ..runtime.metrics import MetricsSet
 from ..schema import Schema
@@ -96,6 +97,11 @@ class ExecNode:
     def _count_output(self, stream: BatchStream) -> BatchStream:
         for b in stream:
             self.metrics.add("output_rows", b.num_rows)
+            # heartbeat hookpoint: a task whose plan never yields to
+            # the driver (map stages feed the shuffle writer) still
+            # beats from inside the operator drive; one thread-local
+            # read when no instrumented task is active
+            monitor.tick()
             yield b
 
     def name(self) -> str:

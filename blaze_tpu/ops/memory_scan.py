@@ -34,10 +34,16 @@ class MemoryScanExec(ExecNode):
         return max(1, len(self._partitions))
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
+        from ..runtime import monitor
+
         def stream():
             if partition < len(self._partitions):
                 for b in self._partitions[partition]:
                     self.metrics.add("output_rows", b.num_rows)
+                    # heartbeat hookpoint: every plan bottoms out in a
+                    # scan, so a task beats per source batch even when
+                    # fused operators above yield nothing to the driver
+                    monitor.tick()
                     yield b.to_device()
 
         return stream()

@@ -41,6 +41,7 @@ from ..exprs.ir import Expr
 from ..io.batch_serde import deserialize_batch, serialize_batch
 from ..io.ipc_compression import IpcFrameReader, IpcFrameWriter, compress_frame
 from ..ops.base import BatchStream, ExecNode
+from ..runtime import monitor
 from ..runtime import faults, trace
 from ..runtime.context import TaskContext
 from ..runtime.memmgr import MemConsumer, Spill, try_new_spill
@@ -621,6 +622,9 @@ class ShuffleWriterExec(ExecNode):
                 for batch in self.children[0].execute(partition, ctx):
                     if not ctx.is_task_running():
                         return
+                    # heartbeat hookpoint: the map task's write loop is
+                    # the longest driver-invisible stretch of a query
+                    monitor.tick()
                     if self._fused_write is not None:
                         # tier 5: ONE program returns the chain output
                         # already pid-sorted plus per-pid counts

@@ -13,14 +13,39 @@ read-modify-write race under concurrency — both ``MetricsSet`` updates
 and ``MetricNode.child`` growth take a per-instance lock.  The gateway
 metrics-callback seam is unchanged: callbacks still read ``values`` /
 walk ``foreach`` exactly as before.
+
+Metric NAMES are API: dashboards scrape them from the monitor's
+``/metrics`` endpoint and the JVM side maps them into SQLMetrics, so
+every name the tree may contain is pinned by the golden registry
+``metric_names.json`` next to this file (:func:`load_metric_names`) —
+tier-1 gates the drift both ways, mirroring the ``trace_schema.json``
+pattern for event shapes.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
+
+METRIC_NAMES_PATH = os.path.join(
+    os.path.dirname(__file__), "metric_names.json")
+
+
+def load_metric_names() -> Dict[str, List[str]]:
+    """The golden metric-name registry, grouped by producer
+    (operator_metrics / scheduler_counters / dispatch_counters)."""
+    with open(METRIC_NAMES_PATH) as f:
+        return json.load(f)
+
+
+def registered_metric_names() -> Set[str]:
+    """Flat union of every registered counter/gauge name."""
+    reg = load_metric_names()
+    return {n for k, names in reg.items() if k != "title" for n in names}
 
 
 class MetricsSet:

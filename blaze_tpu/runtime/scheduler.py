@@ -26,7 +26,7 @@ from .. import conf
 from ..ops import ExecNode
 from ..parallel.exchange import NativeShuffleExchangeExec
 from ..parallel.shuffle import IpcReaderExec, LocalShuffleManager, ShuffleWriterExec
-from . import trace
+from . import monitor, trace
 from .context import RESOURCES, TaskContext
 from .metrics import MetricNode
 
@@ -354,12 +354,14 @@ def run_stages(
             STAGED_RIDS.reset(token)
         return td, staged
 
-    def drain(stage: Stage, t: int, it, out: List) -> None:
+    def drain(stage: Stage, t: int, it, out: List, progress) -> None:
         """Collect a task's output, enforcing the cooperative per-task
-        timeout between batches."""
+        timeout between batches; driver-observed batches feed the
+        heartbeat-gated stage progress."""
         deadline = policy.deadline()
         for b in it:
             out.append(b)
+            progress.add_batch(b)
             if deadline is not None and time.monotonic() > deadline:
                 raise TaskTimeoutError(
                     f"task {t} of stage {stage.stage_id} exceeded "
@@ -418,7 +420,7 @@ def run_stages(
             return attempt, regens
         raise exc  # FATAL
 
-    def run_task_attempts(stage: Stage, t: int, register) -> List:
+    def run_task_attempts(stage: Stage, t: int, register, progress) -> List:
         """One non-result task under the retry policy; returns its
         (side-effect-only, usually empty) batch list."""
         attempt = 0
@@ -432,15 +434,25 @@ def run_stages(
             sched_m.add("task_attempts", 1)
             trace.emit("task_attempt_start", stage_id=stage.stage_id,
                        task=t, attempt=attempt)
+            # progress is cumulative across the stage: a failed
+            # attempt's partial batches must be rolled back or the
+            # retry re-counts them (rows double exactly in the failure
+            # scenarios the monitor exists to make trustworthy)
+            mark = progress.mark()
             try:
                 batches: List = []
                 drain(stage, t,
                       from_proto.run_task(td, task_attempt_id=attempt),
-                      batches)
+                      batches, progress)
                 trace.emit("task_attempt_end", stage_id=stage.stage_id,
                            task=t, attempt=attempt, status="ok")
                 return batches
             except BaseException as exc:
+                progress.rollback(mark)
+                # the failed attempt's registry heartbeat goes with it:
+                # a fast retry may never beat again, and a stale
+                # entry's rows would inflate task_rows forever
+                monitor.task_discard(stage.stage_id, t)
                 trace.emit("task_attempt_end", stage_id=stage.stage_id,
                            task=t, attempt=attempt, status="failed",
                            error=f"{type(exc).__name__}: {exc}"[:300])
@@ -448,7 +460,7 @@ def run_stages(
                     RESOURCES.discard(key)
                 attempt, regens = handle_failure(stage, t, exc, attempt, regens)
 
-    def run_result_task(stage: Stage, t: int, register):
+    def run_result_task(stage: Stage, t: int, register, progress):
         """Result task: stream batches straight through (buffering
         would pin the whole partition).  The retry window covers every
         failure BEFORE the first output batch — which is where fetch
@@ -476,6 +488,7 @@ def run_stages(
                             f"{policy.task_timeout}s"
                         )
                     yielded = True
+                    progress.add_batch(b)
                     yield b
                 trace.emit("task_attempt_end", stage_id=stage.stage_id,
                            task=t, attempt=attempt, status="ok")
@@ -488,11 +501,21 @@ def run_stages(
                     RESOURCES.discard(key)
                 if yielded:
                     raise  # mid-stream: output already delivered
+                # pre-first-batch failure: replayable, so the failed
+                # attempt's heartbeat entry must not outlive it
+                monitor.task_discard(stage.stage_id, t)
                 attempt, regens = handle_failure(stage, t, exc, attempt, regens)
 
-    def run_stage_tasks(stage: Stage) -> None:
+    def run_stage_tasks(stage: Stage, progress=None) -> None:
         """Run every task of a non-result stage (also the fetch-recovery
         re-run path for map stages)."""
+        own_progress = progress is None
+        if own_progress:
+            # fetch-recovery rerun: runs INSIDE the fetching stage's
+            # scope, so the re-run map stage gets its own progress and
+            # its heartbeats land under its own stage id
+            progress = monitor.StageProgress(
+                stage.stage_id, stage.kind, stage.n_tasks, attempts=sched_m)
         register = make_registrar(stage)
         from ..parallel.shuffle import RangePartitioning
 
@@ -516,7 +539,10 @@ def run_stages(
                     attempt, regens = handle_failure(stage, -1, exc,
                                                      attempt, regens)
         for t in range(stage.n_tasks):
-            run_task_attempts(stage, t, register)
+            run_task_attempts(stage, t, register, progress)
+            progress.task_done()
+        if own_progress:
+            progress.flush(force=True)
 
     # AQE-style dynamic join selection (runtime/adaptive.py, opt-in):
     # adaptive broadcast ids start after the planner-assigned ones
@@ -546,40 +572,21 @@ def run_stages(
                 snode.add(k, v)
                 sched_m.add(k, v)
 
-    import contextlib
-
-    @contextlib.contextmanager
     def stage_scope(stage: Stage):
-        """Per-stage observability: the dispatch capture every run
-        gets, plus — when tracing is armed — a trace kernel capture
-        (block-until-ready attribution) bracketed by
+        """Per-stage observability (monitor.stage_span): the dispatch
+        capture every run gets, plus — when tracing is armed — a trace
+        kernel capture (block-until-ready attribution) bracketed by
         stage_submit/stage_complete events carrying the
-        device/dispatch/compile split and the dispatch counters."""
-        traced = trace.enabled()
-        with contextlib.ExitStack() as stack:
-            kc = stack.enter_context(trace.kernel_capture()) if traced else {}
-            if traced:
-                trace.emit("stage_submit", stage_id=stage.stage_id,
-                           kind=stage.kind, n_tasks=stage.n_tasks,
-                           shuffle_id=stage.shuffle_id)
-            t0 = time.perf_counter_ns()
-            cap = stack.enter_context(dispatch.capture())
-            status = "ok"
-            try:
-                yield cap
-            except BaseException:
-                status = "failed"
-                raise
-            finally:
-                if traced:
-                    trace.emit(
-                        "stage_complete", stage_id=stage.stage_id,
-                        kind=stage.kind, n_tasks=stage.n_tasks,
-                        shuffle_id=stage.shuffle_id, status=status,
-                        wall_ns=time.perf_counter_ns() - t0,
-                        kernels=kc, counters=dict(cap),
-                        **trace.sum_kernels(kc),
-                    )
+        device/dispatch/compile split and the dispatch counters, plus —
+        when the live monitor is armed — the registry stage lifecycle.
+        Yields a StageProgress that heartbeats driver-observed batches
+        (stage_progress events + /queries live state)."""
+        return monitor.stage_span(stage.stage_id, stage.kind, stage.n_tasks,
+                                  shuffle_id=stage.shuffle_id,
+                                  attempts=sched_m,
+                                  # the MetricNode publishes dispatch
+                                  # counters even with observability off
+                                  capture_dispatch=True)
 
     for stage in stages:
         if adaptive_on:
@@ -587,14 +594,15 @@ def run_stages(
                                 next_adaptive_bid)
         if stage.kind == "result":
             register = make_registrar(stage)
-            with stage_scope(stage) as cap:
+            with stage_scope(stage) as progress:
                 for t in range(stage.n_tasks):
-                    yield from run_result_task(stage, t, register)
-            publish_dispatch(stage, cap)
+                    yield from run_result_task(stage, t, register, progress)
+                    progress.task_done()
+            publish_dispatch(stage, progress.counters)
             continue
-        with stage_scope(stage) as cap:
-            run_stage_tasks(stage)
-        publish_dispatch(stage, cap)
+        with stage_scope(stage) as progress:
+            run_stage_tasks(stage, progress)
+        publish_dispatch(stage, progress.counters)
         if stage.kind == "map":
             n_maps[stage.shuffle_id] = stage.n_tasks
         elif stage.kind == "broadcast":

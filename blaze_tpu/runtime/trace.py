@@ -62,6 +62,7 @@ EVENT_TYPES = frozenset({
     "task_retry", "task_timeout",
     "fetch_failure", "map_stage_rerun",
     "task_kernels", "task_plan",
+    "stage_progress", "task_heartbeat",
     "fault_injected",
     "mem_watermark", "spill",
     "shuffle_write", "shuffle_fetch", "rss_push",

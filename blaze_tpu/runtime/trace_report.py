@@ -102,6 +102,152 @@ def _render_plan(node: Dict[str, Any], indent: int, out: List[str]) -> None:
         _render_plan(c, indent + 1, out)
 
 
+def _stage_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-stage timeline entries shared by the text and JSON reports
+    (one dict per stage_complete, submit-aligned start offset)."""
+    t = by_type(events)
+    ts0 = min((e["ts"] for e in events if "ts" in e), default=0.0)
+    completes = sorted(t.get("stage_complete", []),
+                      key=lambda e: e.get("stage_id", 0))
+    submits = {e.get("stage_id"): e for e in t.get("stage_submit", [])}
+    out = []
+    for e in completes:
+        sid = e.get("stage_id")
+        sub = submits.get(sid, {})
+        out.append({
+            "stage_id": sid,
+            "kind": e.get("kind"),
+            "n_tasks": e.get("n_tasks"),
+            "status": e.get("status", "ok"),
+            "start_s": round(sub.get("ts", e["ts"]) - ts0, 6),
+            "wall_ns": e.get("wall_ns", 0),
+            "programs": e.get("programs", 0),
+            "device_time_ns": e.get("device_time_ns", 0),
+            "dispatch_overhead_ns": e.get("dispatch_overhead_ns", 0),
+            "compile_ns": e.get("compile_ns", 0),
+            "counters": e.get("counters") or {},
+        })
+    return out
+
+
+def _kernel_rows(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, int]]:
+    """Per-kernel-label totals across all stage_complete events (the
+    operator-kernel table, sampling-aware)."""
+    kernels: Dict[str, Dict[str, int]] = {}
+    for e in by_type(events).get("stage_complete", []):
+        for label, v in (e.get("kernels") or {}).items():
+            agg = kernels.setdefault(
+                label, {"programs": 0, "device_ns": 0,
+                        "dispatch_ns": 0, "compile_ns": 0, "timed": 0})
+            for k in agg:
+                if k == "timed":
+                    agg[k] += v.get("timed", v.get("programs", 0))
+                else:
+                    agg[k] += v.get(k, 0)
+    return kernels
+
+
+def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The full profile as one JSON document (``--report --json``) —
+    the dashboard-facing mirror of :func:`render`: stage timeline,
+    dispatch-floor split, per-kernel table, plan-annotated metrics
+    trees, data movement/memory totals, and the fault/recovery
+    pairing.  Top-level keys are pinned by a golden-keys tier-1 test;
+    add keys freely, never rename or remove."""
+    from . import trace as _trace
+
+    t = by_type(events)
+    ts0 = min((e["ts"] for e in events if "ts" in e), default=0.0)
+    ends = t.get("query_end", [])
+    query = {
+        "ids": [e.get("query_id", "?") for e in t.get("query_start", [])],
+        "status": [e.get("status", "ok") for e in ends],
+        "wall_ns": sum(e.get("wall_ns", 0) for e in ends),
+    }
+
+    stages = _stage_rows(events)
+    total = {"wall_ns": sum(s["wall_ns"] for s in stages),
+             "device_time_ns": sum(s["device_time_ns"] for s in stages),
+             "dispatch_overhead_ns": sum(s["dispatch_overhead_ns"]
+                                         for s in stages),
+             "compile_ns": sum(s["compile_ns"] for s in stages)}
+
+    kernels = {}
+    for label, v in _kernel_rows(events).items():
+        kernels[label] = dict(
+            v,
+            device_ns_scaled=_trace.scaled_device_ns(v),
+            sampled=v["timed"] < v["programs"],
+        )
+
+    plans: Dict[str, Any] = {}
+    for e in t.get("task_plan", []):
+        sid = str(e.get("stage_id", 0))
+        plans[sid] = (
+            _merge_plan(plans[sid], e["plan"]) if sid in plans else e["plan"]
+        )
+
+    sw = t.get("shuffle_write", [])
+    sf = t.get("shuffle_fetch", [])
+    rp = t.get("rss_push", [])
+    sp = t.get("spill", [])
+    wm = t.get("mem_watermark", [])
+    data_movement = {
+        "shuffle_write": {"bytes": sum(e["bytes"] for e in sw),
+                          "blocks": sum(e["blocks"] for e in sw),
+                          "outputs": len(sw)},
+        "shuffle_fetch": {"bytes": sum(e["bytes"] for e in sf),
+                          "blocks": sum(e["blocks"] for e in sf),
+                          "reads": len(sf)},
+        "rss_push": {"bytes": sum(e["bytes"] for e in rp),
+                     "blocks": sum(e["blocks"] for e in rp)},
+        "spills": {"count": len(sp),
+                   "bytes": sum(e["bytes"] for e in sp)},
+    }
+    memory = {
+        "peak_bytes": max((e["used"] for e in wm), default=0),
+        "budget_bytes": wm[-1].get("total", 0) if wm else 0,
+    }
+
+    rec = reconcile_faults(events)
+    timeline_types = {"fault_injected", "fetch_failure", "task_retry",
+                      "task_timeout", "map_stage_rerun"}
+    incidents = sorted(
+        [e for e in events if e.get("type") in timeline_types]
+        + [e for e in t.get("task_attempt_end", [])
+           if e.get("status") == "failed"],
+        key=lambda e: e.get("ts", 0))
+    recovery = {
+        "injected": rec["injected"],
+        "recoveries": rec["recoveries"],
+        "reconciled": rec["reconciled"],
+        "unpaired": rec["unpaired"],
+        "incidents": [dict(e, offset_s=round(e.get("ts", ts0) - ts0, 6))
+                      for e in incidents],
+    }
+
+    hb = t.get("task_heartbeat", [])
+    prog = t.get("stage_progress", [])
+    progress = {
+        "stage_progress_events": len(prog),
+        "task_heartbeats": len(hb),
+        "last_stage_progress": prog[-1] if prog else None,
+    }
+
+    return {
+        "query": query,
+        "events": len(events),
+        "stages": stages,
+        "totals": total,
+        "kernels": kernels,
+        "plans": plans,
+        "data_movement": data_movement,
+        "memory": memory,
+        "recovery": recovery,
+        "progress": progress,
+    }
+
+
 def render(events: List[Dict[str, Any]]) -> str:
     """The full profile report (plain text)."""
     if not events:

@@ -28,6 +28,13 @@ attempt id (fault injection via ``BLAZE_FAULTS_SPEC`` reaches the
 worker through the environment; attempt-gated specs — ``@a0`` — make a
 crashed first attempt recover deterministically).
 
+Observability: with ``BLAZE_TRACE_ENABLED`` in the environment the
+worker's ``run_task`` stream emits ``task_heartbeat`` events into the
+worker's own event log (runtime/trace.py default path).  The LIVE
+monitor (runtime/monitor.py) is deliberately disarmed in workers — the
+driver owns the registry and the /metrics server; a task subprocess
+has nobody to serve.
+
 Used by the multi-process testenv suite (tests/test_testenv.py) — the
 repo's analogue of the reference's ``dev/testenv`` pseudo-distributed
 sandbox (SURVEY §4 tier 3).
@@ -57,7 +64,20 @@ def main(spec_path: str) -> int:
     from ..io.batch_serde import serialize_batch
     from ..parallel.shuffle import LocalShuffleManager
     from ..serde.from_proto import run_task
+    from . import monitor
     from .context import RESOURCES
+
+    # one process = one task attempt: the DRIVER owns the live monitor
+    # (registry + /metrics server); a task subprocess inheriting
+    # BLAZE_MONITOR_ENABLED must not pay the registry path for a
+    # registry nobody serves.  Tracing is unaffected: with
+    # BLAZE_TRACE_ENABLED set, run_task's instrumented stream still
+    # heartbeats task progress into this worker's own event log.
+    os.environ.pop("BLAZE_MONITOR_ENABLED", None)
+    from .. import conf
+
+    conf.MONITOR_ENABLE.set(False)
+    monitor.reset()
 
     with open(spec_path) as f:
         spec = json.load(f)

@@ -407,37 +407,105 @@ def run_task(task_def_bytes: bytes, task_attempt_id: int = 0):
         stage_id=td.stage_id, task_attempt_id=task_attempt_id,
     )
     stream = plan.execute(td.partition, ctx)
-    from ..runtime import trace
+    from ..runtime import monitor, trace
 
-    if not trace.enabled():
+    if not trace.enabled() and not monitor.enabled():
         return stream
-    return _traced_task_stream(stream, plan, td, task_attempt_id)
+    return _instrumented_task_stream(stream, plan, td, task_attempt_id)
 
 
-def _traced_task_stream(stream, plan, td, attempt: int):
-    """Tracing-armed task drive: a kernel capture attributes every XLA
-    program issued while this attempt runs to its operator label, and
-    on completion the attempt emits its kernel split (``task_kernels``)
-    plus the plan-annotated metrics tree (``task_plan`` — the executed
-    plan instance's per-node MetricsSet, the per-attempt analogue of
-    the MetricNode walk the JVM gateway does)."""
+def _instrumented_task_stream(stream, plan, td, attempt: int):
+    """Observability-armed task drive.  With tracing armed, a kernel
+    capture attributes every XLA program issued while this attempt runs
+    to its operator label, and on completion the attempt emits its
+    kernel split (``task_kernels``) plus the plan-annotated metrics
+    tree (``task_plan`` — the executed plan instance's per-node
+    MetricsSet, the per-attempt analogue of the MetricNode walk the JVM
+    gateway does).  With tracing OR the live monitor armed, the stream
+    additionally heartbeats: at most once per
+    ``spark.blaze.monitor.heartbeatMs`` a ``task_heartbeat`` event
+    (event log) / registry beat (/queries) carries rows-so-far plus an
+    incremental snapshot of the plan root's MetricsSet, so a slow task
+    is visibly alive mid-flight.  Monitor-only arming deliberately
+    skips the kernel capture — that would flip the block-until-ready
+    timing path and serialize the device just to watch progress."""
+    import contextlib as _contextlib
     import time as _time
 
-    from ..runtime import trace
+    from ..runtime import monitor, trace
 
+    traced = trace.enabled()
+    mon = monitor.enabled()
     t0 = _time.perf_counter_ns()
-    with trace.kernel_capture() as kc:
+    rows = 0
+    batches = 0
+
+    def _tree_metrics(node, out, max_rows):
+        for k, v in node.metrics.snapshot().items():
+            if isinstance(v, int):
+                out[k] = out.get(k, 0) + v
+                if k == "output_rows":
+                    max_rows = max(max_rows, v)
+        for c in node.children:
+            max_rows = _tree_metrics(c, out, max_rows)
+        return max_rows
+
+    def beat() -> None:
+        # incremental MetricsSet snapshot SUMMED over the plan tree
+        # (per-operator rows/timers so far) — output_rows there counts
+        # every operator boundary, so the chain-depth-independent live
+        # row count is progress_rows: the widest single node's rows
+        metrics: dict = {}
+        progress_rows = _tree_metrics(plan, metrics, 0)
+        now = _time.perf_counter_ns()
+        if traced:
+            trace.emit(
+                "task_heartbeat", task_id=td.task_id, stage_id=td.stage_id,
+                partition=td.partition, attempt=attempt, rows=rows,
+                batches=batches, elapsed_ns=now - t0,
+                progress_rows=progress_rows, metrics=metrics,
+            )
+        if mon:
+            monitor.task_beat(td.stage_id, td.partition, attempt,
+                              rows=rows, batches=batches, metrics=metrics,
+                              progress_rows=progress_rows,
+                              task_id=td.task_id)
+
+    kc_scope = trace.kernel_capture() if traced else _contextlib.nullcontext({})
+    # the beat fires from monitor.tick() — called per operator output
+    # batch inside the plan drive (ops/base._count_output), so a map
+    # task that yields nothing to the driver still heartbeats — and
+    # from the driver-side loop below for result streams.  The beat
+    # state is active ONLY while the plan drive runs (inside next()),
+    # never across a yield: an abandoned half-consumed stream must not
+    # leave a stale callback cross-attributing this task's beats into
+    # the next query on the consumer's thread.
+    beat_state = monitor.new_task_beat(beat)
+    with kc_scope as kc:
         try:
-            yield from stream
+            it = iter(stream)
+            while True:
+                prev = monitor.activate_beat(beat_state)
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+                finally:
+                    monitor.deactivate_beat(prev)
+                rows += b.num_rows
+                batches += 1
+                beat_state.tick()
+                yield b
         finally:
-            trace.emit(
-                "task_kernels", task_id=td.task_id, stage_id=td.stage_id,
-                partition=td.partition, attempt=attempt,
-                wall_ns=_time.perf_counter_ns() - t0, kernels=kc,
-                **trace.sum_kernels(kc),
-            )
-            trace.emit(
-                "task_plan", task_id=td.task_id, stage_id=td.stage_id,
-                partition=td.partition, attempt=attempt,
-                plan=trace.plan_tree(plan),
-            )
+            if traced:
+                trace.emit(
+                    "task_kernels", task_id=td.task_id, stage_id=td.stage_id,
+                    partition=td.partition, attempt=attempt,
+                    wall_ns=_time.perf_counter_ns() - t0, kernels=kc,
+                    **trace.sum_kernels(kc),
+                )
+                trace.emit(
+                    "task_plan", task_id=td.task_id, stage_id=td.stage_id,
+                    partition=td.partition, attempt=attempt,
+                    plan=trace.plan_tree(plan),
+                )

@@ -13,18 +13,21 @@ partition per stage).
 
 from __future__ import annotations
 
+import itertools
 import logging
 from typing import Any, Callable, Dict, List, Optional, Union
 
 from ..batch import batch_from_pydict, batch_to_pydict
 from ..ops import ExecNode, MemoryScanExec
-from ..runtime.context import TaskContext
 from ..schema import Schema
 from .converters import ConversionContext
 from .plan_json import SparkNode, parse_plan_json
 from .strategy import convert_spark_plan
 
 _log = logging.getLogger("blaze_tpu.spark")
+
+#: process-wide sequence for generated query ids (span/registry labels)
+_QUERY_SEQ = itertools.count(1)
 
 
 class BlazeSparkSession:
@@ -92,17 +95,33 @@ class BlazeSparkSession:
 
     # --------------------------------------------------------- execution
 
-    def execute(self, plan_json: Union[str, list, SparkNode]) -> Dict[str, List[Any]]:
+    def execute(
+        self,
+        plan_json: Union[str, list, SparkNode],
+        query_id: Optional[str] = None,
+    ) -> Dict[str, List[Any]]:
         """Convert and run to completion, collecting all partitions
-        (driver-side collect; ≙ executeNativePlan + row iterator)."""
+        (driver-side collect; ≙ executeNativePlan + row iterator).
+
+        The non-scheduler path opens the SAME query -> stage -> kernel
+        spans the scheduler path produces (one ``result`` stage over
+        all partitions): with tracing armed the run leaves an event log
+        ``--report`` renders identically to a scheduler run, and with
+        the live monitor armed it is observable mid-flight via
+        ``/queries`` — both structural no-ops when disarmed."""
+        from ..runtime import monitor
+
         plan = self.plan(plan_json)
+        query_id = query_id or f"session_execute_{next(_QUERY_SEQ)}"
         out: Dict[str, List[Any]] = {f.name: [] for f in plan.schema.fields}
-        for p in range(plan.num_partitions()):
-            ctx = TaskContext(p, plan.num_partitions())
-            for b in plan.execute(p, ctx):
-                d = batch_to_pydict(b)
-                for k in out:
-                    out[k].extend(d[k])
+
+        def collect(b) -> None:
+            d = batch_to_pydict(b)
+            for k in out:
+                out[k].extend(d[k])
+
+        with monitor.query_span(query_id, mode="in-process"):
+            monitor.drive_result_stage(plan, collect)
         return out
 
     def task_definitions(
@@ -120,20 +139,27 @@ class BlazeSparkSession:
         return [stage_task_definitions(s, manager) for s in stages]
 
     def execute_distributed(
-        self, plan_json: Union[str, list, SparkNode]
+        self,
+        plan_json: Union[str, list, SparkNode],
+        query_id: Optional[str] = None,
     ) -> Dict[str, List[Any]]:
         """Run through the stage scheduler: every task crosses the
         TaskDefinition protobuf boundary and every exchange goes
         through shuffle files — the full multi-process data path,
-        driven in one process (≙ dev/testenv pseudo-distributed)."""
+        driven in one process (≙ dev/testenv pseudo-distributed).
+        Wrapped in the same query span as :meth:`execute`; per-stage
+        spans come from the scheduler itself."""
+        from ..runtime import monitor
         from ..runtime.scheduler import run_stages, split_stages
 
         plan = self.plan(plan_json)
+        query_id = query_id or f"session_distributed_{next(_QUERY_SEQ)}"
         stages, manager = split_stages(plan)
         schema = stages[-1].plan.schema
         out: Dict[str, List[Any]] = {f.name: [] for f in schema.fields}
-        for b in run_stages(stages, manager):
-            d = batch_to_pydict(b)
-            for k in out:
-                out[k].extend(d[k])
+        with monitor.query_span(query_id, mode="scheduler"):
+            for b in run_stages(stages, manager):
+                d = batch_to_pydict(b)
+                for k in out:
+                    out[k].extend(d[k])
         return out

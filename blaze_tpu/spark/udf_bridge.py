@@ -57,13 +57,18 @@ def evaluate(serialized: bytes, args_batch: RecordBatch,
             "of SparkUDFWrapperContext); none installed — "
             f"expr: {expr_string or '<opaque serialized expression>'}"
         )
-    from ..gateway import export_batch_ffi, import_batch_ffi
+    from ..gateway import (export_batch_ffi, import_batch_ffi,
+                           suppressed_span_progress)
 
     host = args_batch.to_host()
-    addr = export_batch_ffi(host)
-    out_addr = _EVALUATOR(serialized, addr, host.schema, out_dtype)
-    out_schema = Schema([Field("__udf_out", out_dtype)])
-    out = import_batch_ffi(out_addr, out_schema)
+    # the whole round-trip is intermediates, not query output: neither
+    # the argument batch shipped out nor the result batch the
+    # evaluator exports back may count as stage progress
+    with suppressed_span_progress():
+        addr = export_batch_ffi(host)
+        out_addr = _EVALUATOR(serialized, addr, host.schema, out_dtype)
+        out_schema = Schema([Field("__udf_out", out_dtype)])
+        out = import_batch_ffi(out_addr, out_schema)
     assert out.num_rows == args_batch.num_rows, (
         f"udf evaluator returned {out.num_rows} rows for "
         f"{args_batch.num_rows} input rows"

@@ -61,6 +61,8 @@ def test_merge_cached_carries_whole_q01_half():
             "q01_dispatch_count": 1.2, "q01_compile_ms": 30,
             "q01_warm_compiles": 0, "q01_programs": 9,
             "q01_device_time_s": 0.8, "q01_dispatch_overhead_s": 0.1,
+            "q01_timed": 9,
+            "q01_device_kind": "TPU v4", "q01_trace_sample_rate": 1,
             "q01_measured_at": "2026-08-01T00:00:00Z"}
     fresh = {"backend": "tpu", "value": 2.0,
              "measured_at": "2026-08-02T00:00:00Z"}
@@ -80,13 +82,15 @@ def test_merge_cached_best_of_q06_keeps_profile_with_its_half():
     prev = {"backend": "tpu", "value": 10.0, "vs_baseline": 1.0,
             "dispatch_count": 1.0, "compile_ms": 100, "warm_compiles": 0,
             "programs": 3, "device_time_s": 0.5,
-            "dispatch_overhead_s": 0.05,
+            "dispatch_overhead_s": 0.05, "timed": 3,
+            "device_kind": "TPU v4", "trace_sample_rate": 1,
             "measured_at": "2026-08-01T00:00:00Z",
             "q01_rows_per_sec": 5.0}
     fresh = {"backend": "tpu", "value": 4.0, "vs_baseline": 0.4,
              "dispatch_count": 9.0, "compile_ms": 5, "warm_compiles": 2,
              "programs": 40, "device_time_s": 0.1,
-             "dispatch_overhead_s": 0.9,
+             "dispatch_overhead_s": 0.9, "timed": 10,
+             "device_kind": "cpu:0", "trace_sample_rate": 4,
              "measured_at": "2026-08-02T00:00:00Z",
              "q01_rows_per_sec": 6.0}
     merged = bench._merge_cached(fresh, prev)
@@ -96,6 +100,11 @@ def test_merge_cached_best_of_q06_keeps_profile_with_its_half():
     assert merged["dispatch_overhead_s"] == 0.05
     assert merged["warm_compiles"] == 0
     assert merged["measured_at"] == "2026-08-01T00:00:00Z"
+    # provenance travels WITH the winning half: its device_time_s is
+    # only judgeable against the hardware/sampling that produced it
+    assert merged["timed"] == 3
+    assert merged["device_kind"] == "TPU v4"
+    assert merged["trace_sample_rate"] == 1
     # q01 was freshly measured: it stays fresh
     assert merged["q01_rows_per_sec"] == 6.0
 
@@ -109,13 +118,18 @@ def test_merge_cached_old_format_winner_drops_fresh_profile_keys():
             "measured_at": "2026-08-01T00:00:00Z"}
     fresh = {"backend": "tpu", "value": 4.0, "vs_baseline": 0.4,
              "programs": 40, "device_time_s": 0.1,
-             "dispatch_overhead_s": 0.9,
+             "dispatch_overhead_s": 0.9, "timed": 40,
+             "device_kind": "cpu:0", "trace_sample_rate": 1,
              "measured_at": "2026-08-02T00:00:00Z"}
     merged = bench._merge_cached(fresh, prev)
     assert merged["value"] == 10.0
     assert "programs" not in merged
     assert "device_time_s" not in merged
     assert "dispatch_overhead_s" not in merged
+    # fresh provenance must not describe the cached winner's numbers
+    assert "timed" not in merged
+    assert "device_kind" not in merged
+    assert "trace_sample_rate" not in merged
 
 
 def test_merge_cached_non_tpu_prev_never_wins_best_of():

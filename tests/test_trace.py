@@ -301,6 +301,16 @@ def _synthetic_events():
                                 "children": [{"op": "MemoryScanExec",
                                               "metrics": {},
                                               "children": []}]}}),
+        ("stage_progress", {"stage_id": 0, "kind": "map", "rows": 100,
+                            "bytes": 4096, "batches": 2, "tasks_done": 1,
+                            "n_tasks": 2, "elapsed_ns": 7,
+                            "counters": {"xla_dispatches": 3},
+                            "attempts": {"task_attempts": 1}}),
+        ("task_heartbeat", {"task_id": "task_0_0", "stage_id": 0,
+                            "partition": 0, "attempt": 0, "rows": 10,
+                            "batches": 1, "elapsed_ns": 5,
+                            "progress_rows": 10,
+                            "metrics": {"output_rows": 10}}),
         ("fault_injected", {"site": "shuffle.fetch", "hit": 2,
                             "attempt": 0, "detail": "shuffle_0"}),
         ("mem_watermark", {"used": 1024, "total": 4096}),
