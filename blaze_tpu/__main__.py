@@ -14,7 +14,9 @@ Usage:
     python -m blaze_tpu tpcds q36 --scale 0.002 --parts 4 --scheduler
     python -m blaze_tpu tpch all --scale 0.01
     python -m blaze_tpu --warmup            # compile-cache pre-warm + gate
+    python -m blaze_tpu --lint              # static analysis; nonzero on finding
     python -m blaze_tpu --chaos             # seeded fault-injection smoke
+                                            #  (+ plan verifier + lock-order armed)
     python -m blaze_tpu tpch q1 --chaos --chaos-seed 42
     python -m blaze_tpu tpch q1 --scheduler --trace   # write an event log
     python -m blaze_tpu --report <eventlog.jsonl>     # render the profile
@@ -251,22 +253,101 @@ def _warmup(suite: str, names, scale: float, n_parts: int,
     return 0
 
 
+def _run_lint() -> int:
+    """``--lint``: run every static-analysis pass (analysis/) and exit
+    nonzero on any finding.
+
+    1. AST lint over the package: trace purity, stray ``jax.jit``,
+       emit-under-lock, static lock-order — waivers applied
+       (``analysis/lint_waivers.json``).
+    2. Conf-name golden-registry drift (``runtime/conf_names.json``),
+       two-way plus the README conf-table completeness check.
+    3. Plan verifier over the whole TPC-H + TPC-DS query corpus,
+       fusion enabled AND disabled (plan build over schema-only scans
+       — no datagen, no execution)."""
+    from . import conf
+    from .analysis import lint as lint_mod
+    from .analysis.plan_verify import verify_plan
+    from .ops import MemoryScanExec
+    from .ops.fusion import optimize_plan
+
+    findings = list(lint_mod.lint_package())
+    n_plans = 0
+    prev_fusion = bool(conf.FUSION_ENABLE.get())
+    try:
+        for suite in ("tpch", "tpcds"):
+            if suite == "tpch":
+                from .tpch import TPCH_SCHEMAS as SCHEMAS
+                from .tpch import build_query
+                from .tpch.queries import QUERIES
+            else:
+                from .tpcds import TPCDS_SCHEMAS as SCHEMAS
+                from .tpcds import build_query
+                from .tpcds.queries import QUERIES
+            scans = {n: MemoryScanExec([[], []], SCHEMAS[n]) for n in SCHEMAS}
+            for name in sorted(QUERIES):
+                for fused in (True, False):
+                    conf.FUSION_ENABLE.set(fused)
+                    tag = f"{suite} {name} fusion={'on' if fused else 'off'}"
+                    try:
+                        plan = optimize_plan(build_query(name, scans, 2))
+                    except Exception as e:  # noqa: BLE001 — surface as finding
+                        findings.append(lint_mod.Finding(
+                            "plan.build", f"{suite}/{name}", 0, tag,
+                            f"plan build failed: {type(e).__name__}: {e}"))
+                        continue
+                    n_plans += 1
+                    for f in verify_plan(plan):
+                        findings.append(lint_mod.Finding(
+                            f.rule, f"{suite}/{name}", 0, tag,
+                            f"{f.path} ({f.node}): {f.message}"))
+    finally:
+        conf.FUSION_ENABLE.set(prev_fusion)
+    for f in findings:
+        print(repr(f), file=sys.stderr)
+    status = f"{len(findings)} finding(s)" if findings else "clean"
+    print(f"# lint: {status} — AST rules + conf registry + "
+          f"{n_plans} verified plans (fused+unfused), "
+          f"{len(lint_mod.load_waivers())} pinned waiver(s)")
+    return 1 if findings else 0
+
+
 def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
                n_faults: int) -> int:
     """Fault-injection smoke: fault-free run vs seeded-fault run must
     produce identical rows.  The chaotic run is TRACED (event log on),
     and the recovery story must reconcile: every injected fault paired
     with a recorded recovery event (task retry or map-stage rerun).
-    Nonzero exit on mismatch, unrecovered failure, or an event log
-    that doesn't reconcile."""
+    The plan verifier (spark.blaze.verify.plan) and the runtime
+    lock-order assertion (spark.blaze.verify.locks) are both FORCED ON
+    for the whole smoke — a plan invariant break or an inverted lock
+    acquisition fails the run.  Nonzero exit on mismatch, unrecovered
+    failure, an unreconciled event log, or either verifier firing."""
     from . import conf
-    from .runtime import faults, monitor, scheduler, trace, trace_report
+    from .analysis import locks as lock_verify
 
     build_query, names, scans = _load_suite(suite, names, scale, n_parts)
     if build_query is None:
         return names
 
     conf.TASK_RETRY_BACKOFF.set(0.01)  # keep the smoke fast
+    conf.VERIFY_PLAN.set(True)
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    try:
+        return _chaos_loop(suite, names, scans, build_query, n_parts, seed,
+                           n_faults)
+    finally:
+        conf.VERIFY_PLAN.set(False)
+        conf.VERIFY_LOCKS.set(False)
+        lock_verify.refresh()
+
+
+def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
+                n_faults) -> int:
+    from . import conf
+    from .runtime import faults, monitor, scheduler, trace, trace_report
+
     failed = []
     for i, name in enumerate(names):
         spec = faults.random_spec(seed + i, n_faults=n_faults)
@@ -433,10 +514,18 @@ def main(argv=None) -> int:
                     help="persistent XLA compile cache directory for "
                          "--warmup (default: conf spark.blaze.xla.cacheDir, "
                          "else ~/.cache/blaze_tpu/xla)")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the static-analysis passes (blaze_tpu/analysis/)"
+                         ": AST lint (trace purity, stray jax.jit, "
+                         "emit-under-lock, lock order), conf-registry drift, "
+                         "and the plan verifier over every TPC-H/TPC-DS plan "
+                         "fused+unfused; exit nonzero on any finding")
     ap.add_argument("--chaos", action="store_true",
                     help="fault-injection smoke: run each query fault-free "
-                         "and under a seeded random fault schedule; exit "
-                         "nonzero on result mismatch")
+                         "and under a seeded random fault schedule, with the "
+                         "plan verifier and runtime lock-order assertion "
+                         "armed; exit nonzero on result mismatch or either "
+                         "verifier firing")
     ap.add_argument("--chaos-seed", type=int, default=7,
                     help="seed for the chaos fault schedule (default 7)")
     ap.add_argument("--chaos-faults", type=int, default=3,
@@ -485,6 +574,8 @@ def main(argv=None) -> int:
     if args.json and not args.report:
         ap.error("--json requires --report (it mirrors the rendered "
                  "profile as JSON)")
+    if args.lint:
+        return _run_lint()
     if args.report:
         from .runtime import trace, trace_report
 

@@ -1,0 +1,26 @@
+"""Static analysis & verification: mechanical checkers for the
+invariants the last four PRs enforced by convention and review.
+
+Three passes, all runnable via ``python -m blaze_tpu --lint`` (nonzero
+exit on any finding) and as tier-1 tests (tests/test_analysis.py):
+
+- :mod:`plan_verify` — a rule-based structural checker run over every
+  physical plan after ``ops/fusion.optimize_plan`` and before
+  execution (conf ``spark.blaze.verify.plan``, forced on in tests and
+  ``--chaos``): schema propagation at every edge, partitioning/
+  ordering prerequisites, and the fusion invariants.
+- :mod:`lint` — AST rules over the package source: trace purity (no
+  host sync or wall-clock reads inside traced kernel bodies), no
+  ``jax.jit`` outside ``kernel_cache.cached_kernel`` registration, no
+  ``trace.emit``/``record_kernel`` while holding a lock other than the
+  sink lock, plus the conf-name golden-registry drift gates.  A pinned
+  waiver file (``lint_waivers.json``) records deliberate exceptions —
+  it can only shrink.
+- :mod:`locks` — a declared lock hierarchy for the monitor server,
+  the shuffle staging path, and the kernel-cache/trace/dispatch locks,
+  enforced statically (AST pass over nested acquisitions) and at
+  runtime (conf ``spark.blaze.verify.locks``, armed in ``--chaos`` and
+  the monitor/fault suites).
+"""
+
+from .lint import Finding  # noqa: F401

@@ -1,0 +1,655 @@
+"""Jit-safety / trace-purity / conf-registry linter: AST rules over the
+package source.
+
+The invariant classes PR 2-5 shipped review fixes for, as mechanical
+rules (ids are stable API — the waiver file and tests key on them):
+
+- ``purity.host-sync`` — no host synchronization inside traced kernel
+  bodies: ``np.asarray``/``np.array``, ``.block_until_ready``,
+  ``jax.device_get``, ``.item()``, and ``int()``/``float()`` coercion
+  of non-constant values.  A host sync inside ``trace_fn`` / a
+  ``_build_*`` kernel body / a ``*_body`` transform stalls the fused
+  dispatch loop one RTT per batch — the exact pathology fusion exists
+  to remove — or breaks tracing outright under ``jax.jit``.
+- ``purity.wall-clock`` — no wall-clock reads (``time.*``,
+  ``datetime.now``) inside traced scopes: a clock read at trace time
+  bakes ONE timestamp into the cached program.
+- ``jit.uncached`` — no ``jax.jit`` outside a builder registered
+  through ``kernel_cache.cached_kernel``: a stray jit bypasses the
+  dispatch/compile counters AND the persistent compile cache, so its
+  programs are invisible to ``--report`` and recompile per process.
+- ``lock.emit-under-lock`` — no ``trace.emit``/``record_kernel`` call
+  (direct, or through up to three levels of helpers) while holding a
+  lock other than the kernel-sink lock: event emission does file IO,
+  and holding an operator/module lock across it is the PR 3 deadlock
+  class.
+- ``conf.unregistered`` / ``conf.stale`` / ``conf.undeclared`` /
+  ``conf.undocumented`` — the ``spark.blaze.*`` golden-registry drift
+  gates (``runtime/conf_names.json``), two-way plus a README
+  conf-table completeness check, mirroring ``metric_names.json``.
+
+**Traced scopes** are: functions decorated with ``jax.jit`` (bare,
+``partial(jax.jit, ...)``), functions named ``*_body``, and functions
+nested inside a ``trace_fn`` method — the three shapes every kernel in
+the package uses.  Builder preambles (the ``build()`` closures) run
+once on the host and are NOT traced scopes.
+
+Deliberate exceptions live in ``lint_waivers.json`` next to this file,
+each keyed (rule, file suffix, symbol) with a one-line justification;
+tests pin the waiver set so it can only shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+WAIVER_PATH = os.path.join(os.path.dirname(__file__), "lint_waivers.json")
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "symbol", "message")
+
+    def __init__(self, rule: str, path: str, line: int, symbol: str,
+                 message: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.symbol = symbol
+        self.message = message
+
+    def __repr__(self) -> str:
+        return f"[{self.rule}] {self.path}:{self.line} ({self.symbol}): {self.message}"
+
+
+def package_root() -> str:
+    """blaze_tpu package directory (the lint target)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def python_files(root: str) -> List[str]:
+    out: List[str] = []
+    for dirpath, _, files in os.walk(root):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+ParsedFile = Tuple[str, str, ast.AST]
+
+
+def parse_package(root: str) -> List[ParsedFile]:
+    """Read + ``ast.parse`` every source file under ``root`` ONCE:
+    ``(path, source, tree)``.  Every pass shares this list through
+    :func:`lint_package` instead of re-reading the package per rule;
+    files that fail to parse are skipped (as each pass always did)."""
+    out: List[ParsedFile] = []
+    for path in python_files(root):
+        with open(path) as f:
+            src = f.read()
+        try:
+            out.append((path, src, ast.parse(src)))
+        except SyntaxError:
+            continue
+    return out
+
+
+# ------------------------------------------------------------- helpers
+
+def _func_name(fn: ast.expr) -> str:
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _dotted(fn: ast.expr) -> str:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); '' otherwise."""
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return f"{fn.value.id}.{fn.attr}"
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _is_jax_jit(e: ast.expr) -> bool:
+    """jax.jit, partial(jax.jit, ...), functools.partial(jax.jit, ...)."""
+    if _dotted(e) == "jax.jit":
+        return True
+    if isinstance(e, ast.Call):
+        if _func_name(e.func) == "partial" and e.args \
+                and _dotted(e.args[0]) == "jax.jit":
+            return True
+        return _is_jax_jit(e.func)
+    return False
+
+
+class _Scoped(ast.NodeVisitor):
+    """Base visitor tracking the qualname stack of Class/Function defs."""
+
+    def __init__(self) -> None:
+        self.stack: List[ast.AST] = []
+
+    def qualname(self) -> str:
+        names = [getattr(n, "name", "?") for n in self.stack]
+        return ".".join(names) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node) -> None:
+        self.stack.append(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+# ----------------------------------------------- rule: trace purity
+
+_TRACED_NAME = re.compile(r"(^|_)body$")
+_WALL_CLOCK = {"time", "monotonic", "monotonic_ns", "perf_counter",
+               "perf_counter_ns", "process_time", "process_time_ns",
+               "thread_time", "now"}
+_NP_NAMES = {"np", "numpy", "onp"}
+
+
+def _in_traced_scope(stack: Sequence[ast.AST]) -> Optional[str]:
+    """Name of the innermost traced scope the stack sits in, or None.
+    Traced: jax.jit-decorated defs, ``*_body`` defs, and defs nested
+    inside a ``trace_fn`` method."""
+    traced = None
+    for node in stack:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        name = node.name
+        if name == "trace_fn":
+            traced = name
+        elif traced and name != "trace_fn":
+            traced = name  # closure inside trace_fn
+        if _TRACED_NAME.search(name):
+            traced = name
+        if any(_is_jax_jit(d) for d in node.decorator_list):
+            traced = name
+    return traced
+
+
+def _expr_mentions_shape(e: ast.expr) -> bool:
+    return any(isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                           "size", "dtype")
+               for n in ast.walk(e))
+
+
+class _PurityVisitor(_Scoped):
+    def __init__(self, rel: str, findings: List[Finding]):
+        super().__init__()
+        self.rel = rel
+        self.findings = findings
+
+    def _flag(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            rule, self.rel, node.lineno, self.qualname(), msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        traced = _in_traced_scope(self.stack)
+        if traced:
+            fn = node.func
+            dotted = _dotted(fn)
+            name = _func_name(fn)
+            if isinstance(fn, ast.Attribute) and fn.attr in ("asarray", "array",
+                                                             "frombuffer") \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in _NP_NAMES:
+                self._flag("purity.host-sync", node,
+                           f"{dotted} pulls device values to host inside "
+                           f"traced scope {traced!r}")
+            elif name == "block_until_ready" or dotted == "jax.device_get":
+                self._flag("purity.host-sync", node,
+                           f"{dotted or name} synchronizes the device inside "
+                           f"traced scope {traced!r}")
+            elif name == "item" and isinstance(fn, ast.Attribute) \
+                    and not node.args:
+                self._flag("purity.host-sync", node,
+                           f".item() syncs a device scalar inside traced "
+                           f"scope {traced!r}")
+            elif name in ("int", "float") and isinstance(fn, ast.Name) \
+                    and node.args:
+                arg = node.args[0]
+                if not isinstance(arg, ast.Constant) \
+                        and not _expr_mentions_shape(arg):
+                    self._flag("purity.host-sync", node,
+                               f"{name}() coerces a (possibly device) value "
+                               f"to host inside traced scope {traced!r} — "
+                               f"static shapes are exempt via .shape")
+            elif isinstance(fn, ast.Attribute) \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in ("time", "datetime") \
+                    and fn.attr in _WALL_CLOCK:
+                self._flag("purity.wall-clock", node,
+                           f"{dotted} reads the wall clock inside traced "
+                           f"scope {traced!r} — the value is baked into the "
+                           f"cached program")
+        self.generic_visit(node)
+
+
+# ---------------------------------------------- rule: uncached jax.jit
+
+def _lambda_callees(b: ast.Lambda) -> Set[str]:
+    return {nm for n in ast.walk(b) if isinstance(n, ast.Call)
+            for nm in [_func_name(n.func)] if nm}
+
+
+def _builder_seed_names(tree: ast.AST) -> Set[str]:
+    """Function/class names passed to (or called from a lambda passed
+    to) ``cached_kernel`` in one module.  A Name argument that is
+    itself a local ``builder = lambda: _build_x(...)`` binding resolves
+    through the lambda to ``_build_x``."""
+    out: Set[str] = set()
+    arg_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _func_name(node.func) == "cached_kernel"
+                and len(node.args) >= 2):
+            continue
+        b = node.args[1]
+        if isinstance(b, ast.Name):
+            out.add(b.id)
+            arg_names.add(b.id)
+        elif isinstance(b, ast.Lambda):
+            out |= _lambda_callees(b)
+    if arg_names:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Lambda):
+                if any(isinstance(t, ast.Name) and t.id in arg_names
+                       for t in node.targets):
+                    out |= _lambda_callees(node.value)
+    return out
+
+
+def _jit_holder_names(tree: ast.AST) -> Set[str]:
+    """Names of functions/classes whose subtree contains a ``jax.jit``
+    reference — the only names the builder closure may expand into
+    (expanding through arbitrary simple names like ``add`` would mark
+    the whole package and blind the rule)."""
+    out: Set[str] = set()
+
+    class V(_Scoped):
+        def visit_Attribute(self, node: ast.Attribute) -> None:
+            if _dotted(node) == "jax.jit":
+                for s in self.stack:
+                    nm = getattr(s, "name", None)
+                    if nm:
+                        out.add(nm)
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+def _callee_name(fn: ast.expr) -> str:
+    """Callee simple name, restricted to shapes that plausibly name a
+    module-level function or method: ``f(...)``, ``mod.f(...)``,
+    ``self.f(...)`` — deep attribute chains (``self._f.flush()``,
+    ``_file[1].flush()``) are file-like objects, and matching them by
+    simple name manufactures collisions."""
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return fn.attr
+    return ""
+
+
+def _call_graph(tree: ast.AST, class_level: bool = False) -> Dict[str, Set[str]]:
+    """function name -> simple names it calls (one module).  With
+    ``class_level``, calls made inside methods are also attributed to
+    the enclosing class name (the jit rule marks whole classes
+    registered as builders; the emit rule must NOT — a constructor
+    does not emit just because a sibling method does)."""
+    graph: Dict[str, Set[str]] = {}
+
+    class V(_Scoped):
+        def visit_Call(self, node: ast.Call) -> None:
+            callee = _callee_name(node.func)
+            if callee and self.stack:
+                if class_level:
+                    owners = [getattr(s, "name", None) for s in self.stack]
+                else:
+                    owners = [s.name for s in self.stack[-1:]
+                              if isinstance(s, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))]
+                for nm in owners:
+                    if nm:
+                        graph.setdefault(nm, set()).add(callee)
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return graph
+
+
+def lint_uncached_jit(root: Optional[str] = None,
+                      parsed: Optional[List[ParsedFile]] = None) -> List[Finding]:
+    """``jit.uncached``: every ``jax.jit`` must sit (transitively)
+    inside a builder registered through ``cached_kernel`` — package-wide
+    seed + transitive closure over per-module call graphs, matched by
+    simple name (builders cross modules: shuffle registers
+    exchange's ``_build_range_kernels``)."""
+    root = root or package_root()
+    if parsed is None:
+        parsed = parse_package(root)
+    trees: List[Tuple[str, ast.AST]] = [(p, t) for p, _, t in parsed]
+    marked: Set[str] = set()
+    holders: Set[str] = set()
+    graphs: List[Dict[str, Set[str]]] = []
+    for _, tree in trees:
+        marked |= _builder_seed_names(tree)
+        holders |= _jit_holder_names(tree)
+        graphs.append(_call_graph(tree, class_level=True))
+    # transitive closure RESTRICTED to jit-holding callees: a kernel
+    # helper a marked builder calls is itself build-time code (runs
+    # once, host-side; its jits are registered through the builder's
+    # return value).  Expanding through arbitrary names would mark the
+    # package wholesale and blind the rule.
+    changed = True
+    while changed:
+        changed = False
+        for g in graphs:
+            for name in list(marked):
+                for callee in g.get(name, ()):
+                    if callee in holders and callee not in marked:
+                        marked.add(callee)
+                        changed = True
+    findings: List[Finding] = []
+    pkg_parent = os.path.dirname(root)
+    for path, tree in trees:
+        rel = os.path.relpath(path, pkg_parent)
+
+        class V(_Scoped):
+            def _check(self, node: ast.AST) -> None:
+                if any(getattr(s, "name", None) in marked for s in self.stack):
+                    return
+                findings.append(Finding(
+                    "jit.uncached", rel, node.lineno, self.qualname(),
+                    "jax.jit outside a kernel_cache.cached_kernel builder "
+                    "— bypasses dispatch counters and the persistent "
+                    "compile cache"))
+
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                if _dotted(node) == "jax.jit":
+                    self._check(node)
+                self.generic_visit(node)
+
+        V().visit(tree)
+    return findings
+
+
+# ------------------------------------------ rule: emit under a lock
+
+_SINK_LOCKS = {"_sink_lock"}
+_EMITTERS0 = {"emit", "record_kernel"}
+
+
+def _lockish(e: ast.expr) -> Optional[str]:
+    if isinstance(e, ast.Name) and "lock" in e.id.lower():
+        return e.id
+    if isinstance(e, ast.Attribute) and "lock" in e.attr.lower():
+        return e.attr
+    return None
+
+
+def _direct_emitters(trees: Sequence[Tuple[str, ast.AST]]) -> Set[str]:
+    """Names of functions that directly call emit/record_kernel,
+    closed over three helper levels (simple-name resolution over plain
+    ``f()`` / ``mod.f()`` / ``self.f()`` calls — deep attribute chains
+    like file handles don't manufacture collisions)."""
+    level0: Set[str] = set(_EMITTERS0)
+    graphs = [(_call_graph(t)) for _, t in trees]
+    marked = set(level0)
+    # three hops: spill -> write_frame -> _encode_frame -> hit reaches
+    # emit at depth 3 (the live spill-path instance)
+    for _ in range(3):
+        new: Set[str] = set()
+        for g in graphs:
+            for name, callees in g.items():
+                if name not in marked and callees & marked:
+                    new.add(name)
+        if not new:
+            break
+        marked |= new
+    return marked
+
+
+def lint_emit_under_lock(root: Optional[str] = None,
+                         parsed: Optional[List[ParsedFile]] = None) -> List[Finding]:
+    root = root or package_root()
+    if parsed is None:
+        parsed = parse_package(root)
+    trees: List[Tuple[str, ast.AST]] = [(p, t) for p, _, t in parsed]
+    emitters = _direct_emitters(trees)
+    findings: List[Finding] = []
+    pkg_parent = os.path.dirname(root)
+    for path, tree in trees:
+        rel = os.path.relpath(path, pkg_parent)
+        if rel.endswith(os.path.join("analysis", "lint.py")):
+            continue  # this module's own rule tables
+
+        class V(_Scoped):
+            def __init__(self) -> None:
+                super().__init__()
+                self.locks: List[str] = []
+
+            def visit_With(self, node: ast.With) -> None:
+                names = [n for n in (_lockish(i.context_expr)
+                                     for i in node.items) if n]
+                names = [n for n in names if n not in _SINK_LOCKS]
+                self.locks.extend(names)
+                self.generic_visit(node)
+                for _ in names:
+                    self.locks.pop()
+
+            def visit_FunctionDef(self, node) -> None:
+                # a nested def's body runs later, on an unknown stack
+                self.stack.append(node)
+                saved, self.locks = self.locks, []
+                for child in ast.iter_child_nodes(node):
+                    self.visit(child)
+                self.locks = saved
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(self, node: ast.Call) -> None:
+                if self.locks:
+                    callee = _callee_name(node.func)
+                    if callee in emitters:
+                        findings.append(Finding(
+                            "lock.emit-under-lock", rel, node.lineno,
+                            self.qualname(),
+                            f"{callee}() reached while holding lock(s) "
+                            f"{self.locks} — event emission does file IO; "
+                            f"only the kernel-sink lock may be held "
+                            f"(the PR 3 deadlock class)"))
+                self.generic_visit(node)
+
+        V().visit(tree)
+    return findings
+
+
+# ------------------------------------------------ conf registry drift
+
+CONF_LITERAL = re.compile(r"spark\.blaze(?:\.[A-Za-z0-9_*]+)*\.?")
+
+
+def conf_registry_path() -> str:
+    # single-sourced from conf.py (lazy: conf imports analysis.locks
+    # at module load, so a top-level import here would cycle)
+    from ..conf import CONF_NAMES_PATH
+
+    return CONF_NAMES_PATH
+
+
+def load_conf_registry() -> Dict:
+    with open(conf_registry_path()) as f:
+        return json.load(f)
+
+
+def _source_conf_literals(root: str,
+                          parsed: Optional[List[ParsedFile]] = None,
+                          ) -> List[Tuple[str, int, str]]:
+    """Every spark.blaze.* literal in package source (+ bench.py):
+    (relpath, line, literal).  Docstrings and help text count — a
+    typo'd conf name in docs misleads exactly like one in code."""
+    out: List[Tuple[str, int, str]] = []
+    pkg_parent = os.path.dirname(root)
+    if parsed is not None:
+        files = [(p, src) for p, src, _ in parsed]
+    else:
+        files = []
+        for path in python_files(root):
+            with open(path) as f:
+                files.append((path, f.read()))
+    bench = os.path.join(pkg_parent, "bench.py")
+    if os.path.exists(bench):
+        with open(bench) as f:
+            files.append((bench, f.read()))
+    for path, src in files:
+        rel = os.path.relpath(path, pkg_parent)
+        for i, line in enumerate(src.splitlines(), start=1):
+            for m in CONF_LITERAL.finditer(line):
+                out.append((rel, i, m.group(0)))
+    return out
+
+
+def _literal_resolves(lit: str, keys: Set[str], prefixes: Sequence[str]) -> bool:
+    lit = lit.rstrip("*")
+    if lit in keys or lit in ("spark.blaze", "spark.blaze."):
+        return True  # the bare family root names the namespace itself
+    if lit.endswith("."):
+        # a sentence-ending period rides the regex match: the exact
+        # key minus the dot must resolve too
+        return lit[:-1] in keys \
+            or any(k.startswith(lit) for k in keys) \
+            or any(p.startswith(lit) or lit.startswith(p) for p in prefixes)
+    return any(lit.startswith(p) for p in prefixes)
+
+
+def _declared_conf_keys() -> Set[str]:
+    """Keys declared as ConfEntry("...") literals in conf.py (AST)."""
+    conf_py = os.path.join(package_root(), "conf.py")
+    with open(conf_py) as f:
+        tree = ast.parse(f.read())
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _func_name(node.func) == "ConfEntry" \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.add(node.args[0].value)
+    return out
+
+
+def lint_conf_registry(root: Optional[str] = None,
+                       readme: Optional[str] = None,
+                       parsed: Optional[List[ParsedFile]] = None) -> List[Finding]:
+    """The two-way conf drift gate + README completeness:
+
+    - ``conf.unregistered`` — a spark.blaze.* literal in source that is
+      neither a registered key nor covered by a registered dynamic
+      prefix (new knob or typo);
+    - ``conf.undeclared``  — a registered key with no ConfEntry
+      declaration in conf.py (registry drift);
+    - ``conf.stale``       — a ConfEntry key missing from the registry;
+    - ``conf.undocumented`` — a registered spark.blaze key absent from
+      the README conf table.
+    """
+    root = root or package_root()
+    reg = load_conf_registry()
+    keys: Set[str] = set(reg.get("keys", []))
+    prefixes: List[str] = list(reg.get("dynamic_prefixes", []))
+    findings: List[Finding] = []
+    seen_bad: Set[Tuple[str, str]] = set()
+    for rel, line, lit in _source_conf_literals(root, parsed):
+        if not _literal_resolves(lit, keys, prefixes):
+            if (rel, lit) in seen_bad:
+                continue
+            seen_bad.add((rel, lit))
+            findings.append(Finding(
+                "conf.unregistered", rel, line, lit,
+                f"conf literal {lit!r} is not in runtime/conf_names.json "
+                f"(new knob: declare it in conf.py AND register it; "
+                f"typo: fix the reference)"))
+    declared = _declared_conf_keys()
+    for k in sorted(keys - declared):
+        findings.append(Finding(
+            "conf.undeclared", "blaze_tpu/runtime/conf_names.json", 1, k,
+            f"registered conf {k!r} has no ConfEntry declaration in "
+            f"conf.py"))
+    for k in sorted(k for k in declared - keys if k.startswith("spark.")):
+        findings.append(Finding(
+            "conf.stale", "blaze_tpu/conf.py", 1, k,
+            f"ConfEntry {k!r} is not registered in "
+            f"runtime/conf_names.json"))
+    readme = readme or os.path.join(os.path.dirname(package_root()), "README.md")
+    if os.path.exists(readme):
+        with open(readme) as f:
+            text = f.read()
+        for k in sorted(k for k in keys if k.startswith("spark.blaze.")):
+            if k not in text:
+                findings.append(Finding(
+                    "conf.undocumented", "README.md", 1, k,
+                    f"registered conf {k!r} missing from the README "
+                    f"configuration table"))
+    return findings
+
+
+# ---------------------------------------------------- waivers + driver
+
+def load_waivers() -> List[Dict[str, str]]:
+    with open(WAIVER_PATH) as f:
+        return json.load(f)["waivers"]
+
+
+def _waived(f: Finding, waivers: Sequence[Dict[str, str]]) -> bool:
+    for w in waivers:
+        if w["rule"] == f.rule and f.path.endswith(w["file"]) \
+                and fnmatch.fnmatch(f.symbol, w["symbol"]):
+            return True
+    return False
+
+
+def lint_purity(root: Optional[str] = None,
+                parsed: Optional[List[ParsedFile]] = None) -> List[Finding]:
+    root = root or package_root()
+    findings: List[Finding] = []
+    pkg_parent = os.path.dirname(root)
+    for path, _, tree in (parsed if parsed is not None
+                          else parse_package(root)):
+        _PurityVisitor(os.path.relpath(path, pkg_parent), findings).visit(tree)
+    return findings
+
+
+def lint_package(root: Optional[str] = None,
+                 apply_waivers: bool = True) -> List[Finding]:
+    """Every AST rule + the conf drift gate + the static lock-order
+    pass, waivers applied.  The ``--lint`` CLI and tier-1 run this."""
+    from .locks import lint_lock_order
+
+    root = root or package_root()
+    parsed = parse_package(root)
+    findings = (
+        lint_purity(root, parsed)
+        + lint_uncached_jit(root, parsed)
+        + lint_emit_under_lock(root, parsed)
+        + lint_lock_order(root, parsed)
+        + lint_conf_registry(root, parsed=parsed)
+    )
+    if apply_waivers:
+        waivers = load_waivers()
+        findings = [f for f in findings if not _waived(f, waivers)]
+    return findings

@@ -1,0 +1,409 @@
+"""Plan verifier: rule-based structural checks over physical plans.
+
+≙ the reference's safety story — the JVM plan rewriter only emits
+native subtrees it can prove valid (BlazeConverters validates every
+child before conversion); our reproduction grew five fusion tiers and
+a scheduler that rebuilds plans per task, so the invariants the
+rewrites rely on get checked HERE, mechanically, after every
+``ops/fusion.optimize_plan`` and before execution (conf
+``spark.blaze.verify.plan`` — forced on in tests and ``--chaos``).
+
+Rules (ids are stable API — tests and waivers key on them):
+
+- ``schema.edge``       — expression/column references at every
+  parent→child edge resolve against the child's output schema (a
+  rewrite that re-parents an operator without remapping its
+  expressions produces wrong answers, not errors, on name collisions).
+- ``schema.union``      — UnionExec children agree on arity and dtypes.
+- ``dist.final-agg``    — a FINAL aggregation is fed by a hash
+  exchange on (a subset of) its group keys, a single-partition
+  subtree, or an upstream shuffle read; grouped FINAL over a
+  multi-partition child with no exchange silently under-merges.
+- ``dist.final-scalar`` — an ungrouped FINAL aggregation sees exactly
+  one partition.
+- ``order.smj``         — each SortMergeJoin child is downstream of a
+  sort (SortExec or a fused ``post_sort`` finalize) whose key prefix
+  covers the join keys (prefix compared structurally via expr_key;
+  relaxed to "some sort exists" once the walk crosses a renaming op).
+- ``order.window``      — WindowExec is downstream of SOME sort (the
+  builders sort by varying prefixes of partition/order keys).
+- ``fusion.buffer-bottom`` — a fused chain containing a
+  ``trace_requires_buffer`` op has that op at the BOTTOM and a
+  BufferPartitionExec planted below the fused program.
+- ``fusion.writer-schema`` — a tier-5 fused ShuffleWriterExec retains
+  ``_out_schema`` after chain absorption (the chain nodes left the
+  tree; losing the schema mis-slices every staged batch).
+- ``fusion.trace-key``  — every operator exposing ``trace_fn`` has a
+  non-None, hashable, structurally pure ``trace_key`` (no
+  memory-address components — an identity-keyed fused program would
+  recompile per task and bypass the persistent cache).
+
+Each finding carries the rule id and the offending node's PATH from
+the root (``root.child[0].child[1] FusedStageExec[...]``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..ops.base import ExecNode
+
+
+class PlanFinding:
+    __slots__ = ("rule", "path", "node", "message")
+
+    def __init__(self, rule: str, path: str, node: str, message: str):
+        self.rule = rule
+        self.path = path
+        self.node = node
+        self.message = message
+
+    def __repr__(self) -> str:
+        return f"[{self.rule}] at {self.path} ({self.node}): {self.message}"
+
+
+class PlanVerificationError(AssertionError):
+    """Raised (verify armed) when a plan fails structural checks."""
+
+    def __init__(self, findings: Sequence[PlanFinding]):
+        self.findings = list(findings)
+        lines = "\n  ".join(repr(f) for f in findings)
+        super().__init__(
+            f"plan verification failed ({len(findings)} finding(s)):\n  {lines}")
+
+
+def _expr_key(e) -> object:
+    from ..exprs.compile import expr_key
+
+    return expr_key(e)
+
+
+# ------------------------------------------------------ per-rule checks
+
+def _node_label(node: ExecNode) -> str:
+    try:
+        return node.name()
+    except Exception:  # noqa: BLE001 — a broken name() must not mask findings
+        return type(node).__name__
+
+
+def _check_schema_edge(node: ExecNode, path: str, out: List[PlanFinding]) -> None:
+    """Expression references resolve against child output schemas."""
+    from ..ops.agg import AggExec, AggMode
+    from ..ops.filter import FilterExec
+    from ..ops.project import ProjectExec
+    from ..ops.pruning import expr_columns
+    from ..ops.sort import SortExec
+    from ..ops.window import WindowExec
+    from ..parallel.exchange import NativeShuffleExchangeExec
+    from ..parallel.shuffle import HashPartitioning, ShuffleWriterExec
+
+    def resolve(exprs, schema, what: str) -> None:
+        names = set(schema.names)
+        for e in exprs:
+            if e is None:
+                continue
+            missing = expr_columns(e) - names
+            if missing:
+                out.append(PlanFinding(
+                    "schema.edge", path, _node_label(node),
+                    f"{what} references column(s) {sorted(missing)} "
+                    f"absent from child schema {sorted(names)}"))
+
+    if isinstance(node, FilterExec):
+        child = node.children[0].schema
+        resolve([node.predicate], child, "filter predicate")
+        if node.project is not None:
+            resolve(node.project[0], child, "fused projection")
+    elif isinstance(node, ProjectExec):
+        resolve(node.exprs, node.children[0].schema, "projection")
+    elif isinstance(node, SortExec):
+        resolve([f.expr for f in node.fields], node.children[0].schema,
+                "sort key")
+    elif isinstance(node, WindowExec):
+        child = node.children[0].schema
+        resolve(node.partition_by, child, "window partition key")
+        resolve([f.expr for f in node.order_by], child, "window order key")
+    elif isinstance(node, AggExec):
+        child = node.children[0].schema
+        resolve([g.expr for g in node.groupings], child, "grouping key")
+        if node.mode == AggMode.PARTIAL:
+            # merge modes reconstruct from state columns; their
+            # AggFunction.expr still names PARTIAL-input columns
+            resolve([a.expr for a in node.aggs], child, "aggregate input")
+            resolve([node.pre_filter], child, "fused pre-filter")
+        if node.post_sort:
+            resolve([f.expr for f in node.post_sort], node.schema,
+                    "fused post_sort key")
+    elif isinstance(node, NativeShuffleExchangeExec):
+        part = node.partitioning
+        if isinstance(part, HashPartitioning):
+            resolve(part.exprs, node.children[0].schema, "hash partition key")
+    elif isinstance(node, ShuffleWriterExec):
+        part = node.partitioning
+        if isinstance(part, HashPartitioning):
+            # after tier-5 absorption the pid exprs evaluate over the
+            # CHAIN output (writer.schema), not the tree child
+            resolve(part.exprs, node.schema, "shuffle-write partition key")
+
+
+def _check_union(node: ExecNode, path: str, out: List[PlanFinding]) -> None:
+    from ..ops.union import UnionExec
+
+    if not isinstance(node, UnionExec) or not node.children:
+        return
+    first = node.children[0].schema
+    sig0 = [f.dtype for f in first.fields]
+    for i, c in enumerate(node.children[1:], start=1):
+        sig = [f.dtype for f in c.schema.fields]
+        if len(sig) != len(sig0):
+            out.append(PlanFinding(
+                "schema.union", path, _node_label(node),
+                f"child {i} has {len(sig)} columns, child 0 has {len(sig0)}"))
+        elif sig != sig0:
+            out.append(PlanFinding(
+                "schema.union", path, _node_label(node),
+                f"child {i} dtypes {sig} != child 0 dtypes {sig0}"))
+
+
+def _passthrough(node: ExecNode) -> Optional[bool]:
+    """Declared contract: ``preserves_ordering`` ops pass the
+    prerequisite walks through; the bool is whether crossing them
+    invalidates structural key matching (projections/renames/fused
+    chains relabel columns, so only the RELAXED "a sort exists" check
+    holds beyond them)."""
+    from ..ops.fusion import FusedStageExec
+    from ..ops.project import ProjectExec
+    from ..ops.rename import RenameColumnsExec
+
+    if not node.preserves_ordering or len(node.children) != 1:
+        return None
+    return isinstance(node, (ProjectExec, RenameColumnsExec, FusedStageExec))
+
+
+def _walk_to_provider(child: ExecNode):
+    """Walk down through order-preserving unary ops; returns
+    (terminal node, provider keys or None, relaxed?)."""
+    relaxed = False
+    cur = child
+    while True:
+        keys = tuple(cur.provided_ordering())
+        if keys:
+            return cur, keys, relaxed
+        p = _passthrough(cur)
+        if p is None or not cur.children:
+            return cur, None, relaxed
+        relaxed = relaxed or p
+        cur = cur.children[0]
+
+
+def _check_ordering(node: ExecNode, path: str, out: List[PlanFinding]) -> None:
+    """Declared contract: ``required_child_orderings`` (SMJ join keys,
+    window's relaxed marker) against what each child subtree
+    establishes."""
+    from ..ops.window import WindowExec
+    from ..parallel.shuffle import IpcReaderExec
+
+    requirements = node.required_child_orderings()
+    rule = "order.window" if isinstance(node, WindowExec) else "order.smj"
+    for i, want_keys in enumerate(requirements):
+        if want_keys is None:
+            continue
+        child = node.children[i]
+        side = f"child {i}"
+        terminal, keys, relaxed = _walk_to_provider(child)
+        if keys is not None:
+            if relaxed or not want_keys:
+                continue  # some sort exists; keys not comparable/required
+            # ORDERED prefix, direction included: rows sorted (b, a)
+            # are not sorted (a, b), and a DESC child breaks an
+            # ascending streaming merge just like a dropped sort
+            prefix = keys[: len(want_keys)]
+            if prefix != tuple(want_keys):
+                out.append(PlanFinding(
+                    rule, path, _node_label(node),
+                    f"{side} is sorted on {keys} but requires its key "
+                    f"prefix to equal {tuple(want_keys)} (key order and "
+                    f"direction both matter to a streaming merge)"))
+            continue
+        if isinstance(terminal, IpcReaderExec):
+            continue  # ordering established upstream of the stage split
+        if not terminal.children:
+            # a LEAF source: its row order is the caller's contract
+            # (hand-built plans feed pre-sorted scans) — the rule
+            # targets REWRITES dropping a sort above an exchange,
+            # where order is provably destroyed
+            continue
+        out.append(PlanFinding(
+            rule, path, _node_label(node),
+            f"{side} is not downstream of a sort (walk ended at "
+            f"{_node_label(terminal)}, which destroys/replaces row "
+            f"order)"))
+
+
+def _check_final_agg(node: ExecNode, path: str, out: List[PlanFinding]) -> None:
+    """Declared contract: ``required_child_distribution`` (a grouped
+    FINAL agg's hash co-partitioning), plus the ungrouped-FINAL
+    single-partition prerequisite."""
+    from ..ops.agg import AggExec, AggMode
+    from ..parallel.shuffle import HashPartitioning, IpcReaderExec
+
+    required = node.required_child_distribution()
+    scalar_final = (isinstance(node, AggExec) and node.mode == AggMode.FINAL
+                    and not node.groupings)
+    if required is None and not scalar_final:
+        return
+    child = node.children[0]
+    try:
+        n_parts = child.num_partitions()
+    except Exception:  # noqa: BLE001 — broken partition count = own finding
+        out.append(PlanFinding(
+            "dist.final-agg", path, _node_label(node),
+            "child num_partitions() raised"))
+        return
+    if n_parts == 1:
+        return  # everything co-located: any distribution is exact
+    if scalar_final:
+        out.append(PlanFinding(
+            "dist.final-scalar", path, _node_label(node),
+            f"ungrouped FINAL aggregation over {n_parts} partitions "
+            f"(a dropped single-partition exchange)"))
+        return
+    _, group_keys = required
+    cur = child
+    while True:
+        part = getattr(cur, "partitioning", None)
+        if part is not None:
+            if isinstance(part, HashPartitioning):
+                hash_keys = {_expr_key(e) for e in part.exprs}
+                if not hash_keys <= group_keys:
+                    out.append(PlanFinding(
+                        "dist.final-agg", path, _node_label(node),
+                        f"hash exchange keys {sorted(map(str, hash_keys - group_keys))} "
+                        f"are not a subset of the FINAL group keys — rows of "
+                        f"one group can land in different partitions"))
+                return
+            out.append(PlanFinding(
+                "dist.final-agg", path, _node_label(node),
+                f"feeding exchange partitioning is "
+                f"{type(part).__name__}, not hash on the group keys"))
+            return
+        if isinstance(cur, IpcReaderExec):
+            return  # clustered by the upstream map stage's writer
+        # walk through any unary op that keeps the partition count: no
+        # unary op re-routes rows between partitions (only exchanges
+        # do, and those carry .partitioning, handled above) — this is
+        # a DISTRIBUTION walk, deliberately not the ordering
+        # _passthrough (a SortExec between the exchange and the agg
+        # destroys order but preserves co-partitioning)
+        if len(cur.children) != 1 \
+                or cur.children[0].num_partitions() != n_parts:
+            out.append(PlanFinding(
+                "dist.final-agg", path, _node_label(node),
+                f"grouped FINAL aggregation over {n_parts} partitions "
+                f"with no exchange on its group keys (walk ended at "
+                f"{_node_label(cur)}) — a dropped exchange silently "
+                f"under-merges groups"))
+            return
+        cur = cur.children[0]
+
+
+def _check_fusion(node: ExecNode, path: str, out: List[PlanFinding]) -> None:
+    from ..ops.fusion import BufferPartitionExec, FusedStageExec
+    from ..parallel.shuffle import ShuffleWriterExec
+
+    if isinstance(node, FusedStageExec):
+        buffered = [op for op in node.ops if op.trace_requires_buffer]
+        if buffered:
+            if node.ops[0] is not buffered[0] or len(buffered) > 1:
+                out.append(PlanFinding(
+                    "fusion.buffer-bottom", path, _node_label(node),
+                    f"whole-partition op(s) "
+                    f"{[type(o).__name__ for o in buffered]} must be the "
+                    f"single BOTTOM of the fused chain"))
+            if not isinstance(node.children[0], BufferPartitionExec):
+                out.append(PlanFinding(
+                    "fusion.buffer-bottom", path, _node_label(node),
+                    f"chain contains whole-partition op "
+                    f"{type(buffered[0]).__name__} but the fused program "
+                    f"streams per batch (child is "
+                    f"{_node_label(node.children[0])}, not "
+                    f"BufferPartitionExec)"))
+    if isinstance(node, ShuffleWriterExec) and node._fused_write is not None:
+        if node._out_schema is None:
+            out.append(PlanFinding(
+                "fusion.writer-schema", path, _node_label(node),
+                "tier-5 fused writer lost _out_schema after chain "
+                "absorption — staged batches would be sliced against "
+                "the wrong layout"))
+
+
+def _key_is_pure(key) -> bool:
+    """A trace/cache key is structurally pure when it hashes and its
+    repr carries no memory addresses (an object captured by identity
+    would key a process-wide cache per instance)."""
+    try:
+        hash(key)
+    except TypeError:
+        return False
+    return " at 0x" not in repr(key)
+
+
+def _check_trace_contract(node: ExecNode, path: str,
+                          out: List[PlanFinding]) -> None:
+    try:
+        fn = node.trace_fn()
+    except Exception:  # noqa: BLE001 — a raising trace_fn is not traceable
+        return
+    if fn is None:
+        return
+    key = node.trace_key()
+    if key is None:
+        out.append(PlanFinding(
+            "fusion.trace-key", path, _node_label(node),
+            "trace_fn is not None but trace_key() is None — fusion "
+            "would cache the composed program under a partial key"))
+        return
+    if not _key_is_pure(key):
+        out.append(PlanFinding(
+            "fusion.trace-key", path, _node_label(node),
+            f"trace_key is not structurally pure (unhashable or "
+            f"identity-bearing): {key!r} — two builds of the same plan "
+            f"would compile two programs"))
+
+
+# ------------------------------------------------------------- driver
+
+_CHECKS = (
+    _check_schema_edge,
+    _check_union,
+    _check_ordering,
+    _check_final_agg,
+    _check_fusion,
+    _check_trace_contract,
+)
+
+
+def verify_plan(plan: ExecNode) -> List[PlanFinding]:
+    """Run every rule over the plan; returns findings (empty = valid)."""
+    out: List[PlanFinding] = []
+
+    def walk(node: ExecNode, path: str) -> None:
+        for check in _CHECKS:
+            check(node, path, out)
+        for i, c in enumerate(node.children):
+            walk(c, f"{path}.child[{i}]")
+
+    walk(plan, "root")
+    return out
+
+
+def verify_or_raise(plan: ExecNode) -> ExecNode:
+    """The execution hookpoint (``ops/fusion.optimize_plan`` calls this
+    when conf ``spark.blaze.verify.plan`` is armed): raises
+    :class:`PlanVerificationError` on any finding, else returns the
+    plan unchanged."""
+    findings = verify_plan(plan)
+    if findings:
+        raise PlanVerificationError(findings)
+    return plan

@@ -14,10 +14,13 @@ the knob has a reference equivalent.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Any, Callable, Dict
 
-_lock = threading.Lock()
+from .analysis.locks import make_lock
+
+# innermost lock of the declared hierarchy (analysis/locks.py): every
+# subsystem reads conf while holding its own locks, never vice versa
+_lock = make_lock("conf.store")
 _values: Dict[str, Any] = {}
 
 
@@ -194,6 +197,20 @@ DEVICE_MEMORY_BUDGET = ConfEntry("spark.blaze.tpu.hbmBudget", 8 << 30, int)
 HOST_SPILL_BUDGET = ConfEntry("spark.blaze.tpu.hostSpillBudget", 4 << 30, int)
 MIN_CAPACITY = ConfEntry("spark.blaze.tpu.minBatchCapacity", 1024, int)
 
+# Static analysis & verification (blaze_tpu/analysis/).
+# Plan verifier: run the rule-based structural checker
+# (analysis/plan_verify.py — schema edges, partitioning/ordering
+# prerequisites, fusion invariants) over every physical plan after
+# ops/fusion.optimize_plan and before execution.  Off by default on
+# the production hot path; FORCED ON in tests (conftest) and --chaos.
+VERIFY_PLAN = ConfEntry("spark.blaze.verify.plan", False, _bool)
+# Runtime lock-order assertion (analysis/locks.py): while armed, every
+# acquisition of a hierarchy lock asserts strictly inward order and
+# raises LockOrderError on inversion — the would-be deadlock surfaces
+# deterministically instead of as a rare hang.  Armed in --chaos and
+# the monitor/fault test suites; disarmed cost is one bool read.
+VERIFY_LOCKS = ConfEntry("spark.blaze.verify.locks", False, _bool)
+
 # Per-operator enable flags, ≙ BlazeConverters.scala:82-120
 # (spark.blaze.enable.scan / .project / .filter / ...).
 _OP_FLAGS: Dict[str, ConfEntry] = {}
@@ -205,6 +222,40 @@ def op_enabled(name: str) -> bool:
         entry = ConfEntry(f"spark.blaze.enable.{name}", True, _bool)
         _OP_FLAGS[name] = entry
     return entry.get()
+
+
+CONF_NAMES_PATH = os.path.join(
+    os.path.dirname(__file__), "runtime", "conf_names.json")
+
+
+def load_conf_names() -> Dict[str, Any]:
+    """The golden conf-name registry (runtime/conf_names.json,
+    mirroring metric_names.json): every ``spark.blaze.*`` key this
+    engine reads, plus the dynamic per-operator prefix.  Conf KEYS are
+    API — deployment configs and docs reference them by string, so a
+    silent rename strands every existing setting.  The drift is gated
+    both ways by analysis/lint.py (``conf.*`` rules) in tier-1."""
+    import json
+
+    with open(CONF_NAMES_PATH) as f:
+        return json.load(f)
+
+
+def registered_conf_keys() -> set:
+    """Flat set of every registered conf key."""
+    return set(load_conf_names().get("keys", []))
+
+
+def declared_entries() -> Dict[str, "ConfEntry"]:
+    """Every module-level ConfEntry declared here, by key (the live
+    half the registry mirrors; op_enabled's dynamic family is covered
+    by the registry's ``dynamic_prefixes``)."""
+    import sys
+
+    mod = sys.modules[__name__]
+    return {
+        v.key: v for v in vars(mod).values() if isinstance(v, ConfEntry)
+    }
 
 
 def set_conf(key: str, value: Any) -> None:

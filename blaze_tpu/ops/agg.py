@@ -792,6 +792,30 @@ class AggExec(ExecNode):
     def schema(self) -> Schema:
         return self._schema
 
+    # ------------------------------------- static-analysis contract
+
+    def required_child_distribution(self):
+        """A grouped FINAL agg needs every row of a group co-located:
+        its feeding exchange must hash on (a subset of) the group keys
+        (analysis/plan_verify.py rule ``dist.final-agg``); ungrouped
+        FINAL needs exactly one partition (``dist.final-scalar``)."""
+        if self.mode != AggMode.FINAL or not self.groupings:
+            return None
+        from ..exprs.compile import expr_key
+
+        return ("hash", frozenset(expr_key(g.expr) for g in self.groupings))
+
+    def provided_ordering(self):
+        """A fused ``post_sort`` finalize satisfies downstream
+        sort-consumers exactly like the SortExec it absorbed —
+        ``(expr_key, ascending)`` entries, direction included."""
+        if not self.post_sort:
+            return ()
+        from ..exprs.compile import expr_key
+
+        return tuple((expr_key(f.expr), bool(f.ascending))
+                     for f in self.post_sort)
+
     # -------------------------------------------------------- kernels
 
     def _build_kernels(self, in_schema: Schema):

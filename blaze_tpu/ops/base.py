@@ -87,6 +87,40 @@ class ExecNode:
         when it replaces at least two real kernels."""
         return True
 
+    # ------------------------------------- static-analysis contract
+    #
+    # Declarations the plan verifier (analysis/plan_verify.py, conf
+    # spark.blaze.verify.plan) checks over every optimized plan: the
+    # rewrite tiers rely on these prerequisites holding, and a rewrite
+    # that breaks one produces wrong ANSWERS, not errors.
+
+    def required_child_distribution(self):
+        """None, or ``("hash", frozenset(expr_keys))``: the child
+        subtree must deliver co-partitioning on these keys (a FINAL
+        grouped agg needs every row of a group in one partition) —
+        rule ``dist.final-agg``."""
+        return None
+
+    def required_child_orderings(self):
+        """Per-child ordering prerequisite: None (no requirement) or a
+        tuple of expr_keys the child stream must be key-sorted on
+        (prefix match; the EMPTY tuple means 'must be downstream of
+        some sort', the relaxed form) — rules ``order.*``."""
+        return [None] * len(self.children)
+
+    def provided_ordering(self):
+        """expr_keys this node's OUTPUT is sorted on (() = none):
+        SortExec declares its fields, a FINAL agg its fused
+        ``post_sort``."""
+        return ()
+
+    @property
+    def preserves_ordering(self) -> bool:
+        """True when this unary op passes its child's sort order
+        through (filters compact in order; sorts/aggs/exchanges
+        destroy or replace it)."""
+        return False
+
     def num_partitions(self) -> int:
         """Output partitioning degree (propagates from children by
         default)."""

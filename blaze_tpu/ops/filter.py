@@ -120,6 +120,10 @@ class FilterExec(ExecNode):
         return True
 
     @property
+    def preserves_ordering(self) -> bool:
+        return True  # compaction keeps relative row order
+
+    @property
     def schema(self) -> Schema:
         return self._schema
 

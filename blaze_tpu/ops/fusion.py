@@ -345,6 +345,10 @@ class BufferPartitionExec(ExecNode):
     def schema(self):
         return self.children[0].schema
 
+    @property
+    def preserves_ordering(self) -> bool:
+        return True  # concat of the ordered stream, in order
+
     def execute(self, partition: int, ctx) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)
 
@@ -423,6 +427,13 @@ class FusedStageExec(ExecNode):
     def trace_changes_count(self) -> bool:
         return self._changes_count
 
+    @property
+    def preserves_ordering(self) -> bool:
+        # every traceable op is a per-row/in-order transform; columns
+        # may be renamed by fused projections, so the verifier
+        # downgrades key matching past a fused chain
+        return True
+
     def name(self) -> str:
         inner = "+".join(type(op).__name__ for op in self.ops)
         return f"FusedStageExec[{inner}]"
@@ -464,12 +475,24 @@ def optimize_plan(plan):
     run_task, bench.py, ``--warmup``, the budget tests — MUST go
     through this helper: the persistent compile cache pre-warm is only
     worth anything if warmup compiles exactly the programs production
-    tasks execute."""
+    tasks execute.
+
+    With conf ``spark.blaze.verify.plan`` armed (forced on in tests
+    and ``--chaos``), the OPTIMIZED plan runs through the structural
+    plan verifier (analysis/plan_verify.py) before execution — this is
+    THE choke point every execution path crosses, so a rewrite tier
+    that breaks a schema/distribution/ordering/fusion invariant fails
+    loudly here instead of producing wrong answers downstream."""
     from .pruning import prune_columns
 
-    return fuse_shuffle_write(
+    plan = fuse_shuffle_write(
         fuse_traceable_chains(prune_columns(fuse_stages(plan)))
     )
+    if bool(conf.VERIFY_PLAN.get()):
+        from ..analysis.plan_verify import verify_or_raise
+
+        verify_or_raise(plan)
+    return plan
 
 
 def traceable_chain_from(node):

@@ -229,6 +229,17 @@ class SortMergeJoinExec(ExecNode):
     def num_partitions(self) -> int:
         return self.children[0].num_partitions()
 
+    def required_child_orderings(self):
+        """Static-analysis contract: the streaming merge is only
+        correct over inputs key-sorted ASCENDING in join-key order —
+        each child must be downstream of a sort whose ``(expr_key,
+        ascending)`` prefix equals the join keys
+        (analysis/plan_verify.py rule ``order.smj``)."""
+        from ...exprs.compile import expr_key
+
+        return [tuple((expr_key(e), True) for e in self.left_keys),
+                tuple((expr_key(e), True) for e in self.right_keys)]
+
     # ------------------------------------------------------- emission
 
     def _emit_entry(self, batch: RecordBatch, matched_rows: np.ndarray) -> Optional[RecordBatch]:

@@ -38,6 +38,10 @@ class LimitExec(ExecNode):
     def schema(self) -> Schema:
         return self.children[0].schema
 
+    @property
+    def preserves_ordering(self) -> bool:
+        return True  # a prefix of an ordered stream stays ordered
+
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)
 

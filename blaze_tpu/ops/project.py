@@ -101,6 +101,11 @@ class ProjectExec(ExecNode):
     def schema(self) -> Schema:
         return self._schema
 
+    @property
+    def preserves_ordering(self) -> bool:
+        return True  # per-row transform; order untouched (columns may
+        # be renamed, so the verifier downgrades key matching past it)
+
     # ---------------------------------------------- tracing contract
 
     def trace_fn(self):

@@ -23,6 +23,10 @@ class RenameColumnsExec(ExecNode):
     def schema(self) -> Schema:
         return self._schema
 
+    @property
+    def preserves_ordering(self) -> bool:
+        return True  # pure relabel; rows untouched
+
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)
 

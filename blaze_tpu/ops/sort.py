@@ -249,6 +249,16 @@ class SortExec(ExecNode):
     def schema(self) -> Schema:
         return self.children[0].schema
 
+    def provided_ordering(self):
+        """Static-analysis contract: downstream sort-consumers (SMJ,
+        window) are satisfied by this node's key order.  Each entry is
+        ``(expr_key, ascending)`` — direction is part of the order a
+        streaming merge relies on."""
+        from ..exprs.compile import expr_key
+
+        return tuple((expr_key(f.expr), bool(f.ascending))
+                     for f in self.fields)
+
     def name(self) -> str:
         k = f", fetch={self.fetch}" if self.fetch is not None else ""
         return f"SortExec({len(self.fields)} keys{k})"

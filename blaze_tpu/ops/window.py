@@ -598,6 +598,21 @@ class WindowExec(ExecNode):
     def trace_requires_buffer(self) -> bool:
         return True
 
+    def required_child_orderings(self):
+        """Static-analysis contract: the segment kernels assume the
+        partition/order layout an upstream sort established.  Relaxed
+        form (empty tuple) — the builders sort by varying prefixes of
+        (partition_by, order_by), so the verifier only requires that
+        SOME sort is downstream (rule ``order.window``)."""
+        return [()]
+
+    @property
+    def preserves_ordering(self) -> bool:
+        # window APPENDS value columns over the buffered partition;
+        # row order is untouched, so a stacked window (tpcds q47/q57)
+        # still sees the sort below its sibling
+        return True
+
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)
 

@@ -113,7 +113,30 @@ def _sort_by_pid_body(cols, pids, n_out, num_rows):
     return sorted_cols, counts, sidx
 
 
-_sort_by_pid = partial(jax.jit, static_argnames=("n_out",))(_sort_by_pid_body)
+def _build_pid_sort_kernel():
+    return partial(jax.jit, static_argnames=("n_out",))(_sort_by_pid_body)
+
+
+_PID_SORT_KERNEL = None
+
+
+def _sort_by_pid(cols, pids, n_out, num_rows):
+    """The standalone (unfused) pid sort, registered through
+    kernel_cache so its dispatches/compiles are counted and it rides
+    the persistent compile cache like every other kernel (a bare
+    module-level ``jax.jit`` is invisible to both — the
+    ``jit.uncached`` lint rule now pins this).  Memoized at module
+    level after the first resolution: the key is constant, and
+    re-resolving through the process-wide registry lock per batch
+    would serialize concurrent map tasks on it."""
+    global _PID_SORT_KERNEL
+    kernel = _PID_SORT_KERNEL
+    if kernel is None:
+        from ..runtime.kernel_cache import cached_kernel
+
+        kernel = _PID_SORT_KERNEL = cached_kernel(
+            ("shuffle_pid_sort",), _build_pid_sort_kernel)
+    return kernel(cols, pids, n_out=n_out, num_rows=num_rows)
 
 
 def non_opaque_cols(schema: Schema, cols) -> tuple:
@@ -161,10 +184,15 @@ class ShuffleRepartitioner(MemConsumer):
         self.n_out = n_out
         self.metrics = metrics
         self.task_attempt_id = task_attempt_id
+        from ..analysis.locks import make_lock
+
         self._buffers: List[List[RecordBatch]] = [[] for _ in range(n_out)]
         self._buffered_bytes = 0
         self._spills: List[Tuple[Spill, List[Tuple[int, int]]]] = []  # (spill, [(pid, nframes)])
-        self._lock = threading.Lock()
+        # the lock the async stager, map-task producer, and the memory
+        # manager's cross-thread spills share — ranked in the declared
+        # hierarchy (analysis/locks.py) OUTSIDE memmgr/metrics/trace
+        self._lock = make_lock("shuffle.repartitioner")
 
     def insert_sorted(self, sorted_batch_host: RecordBatch, counts: np.ndarray) -> None:
         """Append per-pid slices of a pid-sorted host batch.
@@ -223,7 +251,14 @@ class ShuffleRepartitioner(MemConsumer):
             self._spills.append((sp, manifest))
             freed = self._buffered_bytes
             self._buffered_bytes = 0
-            self.update_mem_used(0)
+            # no-trigger accounting while our own lock is held: the
+            # full update_mem_used would run the watermark check, which
+            # spills OTHER consumers while we hold this one's lock —
+            # consumer-lock -> consumer-lock is a deadlock cycle with a
+            # concurrent spill running the opposite direction (the
+            # lock-order checker, analysis/locks.py, flags exactly
+            # this).  Usage only DECREASED, so no check is owed anyway.
+            self.set_mem_used_no_trigger(0)
             self.metrics.add("spill_count", 1)
             self.metrics.add("spilled_bytes", freed)
             return freed
@@ -231,12 +266,20 @@ class ShuffleRepartitioner(MemConsumer):
     def write_output(self, data_path: str, index_path: str) -> List[int]:
         """Merge memory + spills per pid into .data/.index.  Returns
         partition lengths.  Holds the lock across the whole drain so a
-        late memory-manager spill cannot move buffers out mid-write."""
+        late memory-manager spill cannot move buffers out mid-write.
+        The fault-injection site and the shuffle_write trace event both
+        live OUTSIDE the lock: emission does file IO and can raise, and
+        holding an operator lock across either is the PR 3 deadlock
+        class the ``lock.emit-under-lock`` lint rule pins."""
+        faults.hit("shuffle.write", attempt=self.task_attempt_id, detail=data_path)
         with self._lock:
-            return self._write_output_locked(data_path, index_path)
+            lengths = self._write_output_locked(data_path, index_path)
+        trace.emit("shuffle_write", bytes=sum(lengths),
+                   blocks=sum(1 for ln in lengths if ln),
+                   attempt=self.task_attempt_id, path=data_path)
+        return lengths
 
     def _write_output_locked(self, data_path: str, index_path: str) -> List[int]:
-        faults.hit("shuffle.write", attempt=self.task_attempt_id, detail=data_path)
         # decode spills back per pid (read once, in insertion order)
         spilled: Dict[int, List[RecordBatch]] = {}
         for sp, manifest in self._spills:
@@ -280,9 +323,6 @@ class ShuffleRepartitioner(MemConsumer):
                 except OSError:
                     pass
             raise
-        trace.emit("shuffle_write", bytes=sum(lengths),
-                   blocks=sum(1 for ln in lengths if ln),
-                   attempt=self.task_attempt_id, path=data_path)
         return lengths
 
 

@@ -38,13 +38,14 @@ path runs unchanged — asserted structurally by tests/test_trace.py.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Callable, Dict, Iterator, List
 
+from ..analysis.locks import make_lock
 from . import trace
+from .metrics import _remove_by_identity
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("dispatch.counters")
 _GLOBAL: Dict[str, int] = {}
 _CAPTURES: List[Dict[str, int]] = []
 
@@ -95,15 +96,13 @@ def capture() -> Iterator[Dict[str, int]]:
         yield c
     finally:
         with _LOCK:
-            # identity removal: list.remove compares dicts by VALUE —
-            # a nested capture holding equal counts (common: a stage
-            # capture inside a query capture that has seen nothing
-            # else) would evict the OUTER dict and silently stop its
-            # accumulation for the rest of the scope
-            for i, d in enumerate(_CAPTURES):
-                if d is c:
-                    del _CAPTURES[i]
-                    break
+            # identity removal (metrics._remove_by_identity — the ONE
+            # shared definition): list.remove compares dicts by VALUE,
+            # so a nested capture holding equal counts (common: a
+            # stage capture inside a query capture that has seen
+            # nothing else) would evict the OUTER dict and silently
+            # stop its accumulation for the rest of the scope
+            _remove_by_identity(_CAPTURES, c)
 
 
 def instrument(fn: Callable, label: str = "kernel") -> Callable:
@@ -135,7 +134,7 @@ def instrument(fn: Callable, label: str = "kernel") -> Callable:
     # step, but only the first to claim it records the compile —
     # otherwise xla_compiles/compile_ms over-count by the thread count
     state = {"seen": size()}
-    state_lock = threading.Lock()
+    state_lock = make_lock("dispatch.kernel_state")
 
     def wrapper(*a, **k):
         if not trace._KERNEL_TIMING:  # pre-existing non-blocking path

@@ -17,13 +17,13 @@ only schemas, expression IR, and static parameters.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, Tuple
 
+from ..analysis.locks import make_lock
 from ..schema import Schema
 
 _CACHE: Dict[tuple, Any] = {}
-_LOCK = threading.Lock()
+_LOCK = make_lock("kernel_cache.registry")
 
 
 def schema_key(schema: Schema) -> Tuple:

@@ -178,9 +178,11 @@ class MemManager:
     _global_lock = threading.Lock()
 
     def __init__(self, total: int, watermark: float = 0.9):
+        from ..analysis.locks import make_lock
+
         self.total = total
         self.watermark = watermark
-        self._lock = threading.Lock()
+        self._lock = make_lock("memmgr.manager")
         self._consumers: List[MemConsumer] = []
         self.spill_count = 0
         self.spilled_bytes = 0

@@ -26,13 +26,29 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Set
 
+from ..analysis.locks import make_lock
+
 METRIC_NAMES_PATH = os.path.join(
     os.path.dirname(__file__), "metric_names.json")
+
+
+def _remove_by_identity(items: list, obj: object) -> bool:
+    """Remove ``obj`` from ``items`` comparing by IDENTITY, not
+    equality — THE shared helper for capture/sink/scope lists (the
+    PR 3 bug class, now one definition): ``list.remove`` compares by
+    VALUE, so a nested scope holding an EQUAL-content entry (two empty
+    capture dicts, two equal counter snapshots) would evict the OUTER
+    scope's entry and silently stop its accumulation.  Returns True
+    when found."""
+    for i, x in enumerate(items):
+        if x is obj:
+            del items[i]
+            return True
+    return False
 
 
 def load_metric_names() -> Dict[str, List[str]]:
@@ -53,7 +69,7 @@ class MetricsSet:
 
     def __init__(self):
         self.values: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.set")
 
     def add(self, name: str, v: int = 1) -> None:
         with self._lock:
@@ -98,7 +114,7 @@ class MetricNode:
     def __init__(self, metrics: Optional[MetricsSet] = None, children: Optional[List["MetricNode"]] = None):
         self.metrics = metrics or MetricsSet()
         self.children = children or []
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.node")
 
     def child(self, i: int) -> "MetricNode":
         with self._lock:

@@ -49,11 +49,12 @@ from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, Optional
 
 from .. import conf
+from ..analysis.locks import make_lock
 from . import trace
 
 # --------------------------------------------------------------- state
 
-_lock = threading.Lock()
+_lock = make_lock("monitor.registry")
 _loaded = False
 _armed = False
 _hb_ns = 1_000_000_000
@@ -903,7 +904,7 @@ class MonitorServer:
 
 
 _SERVER: Optional[MonitorServer] = None
-_server_lock = threading.Lock()
+_server_lock = make_lock("monitor.server")
 
 
 def ensure_server() -> Optional[MonitorServer]:

@@ -50,6 +50,8 @@ import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from .. import conf
+from ..analysis.locks import make_lock
+from .metrics import _remove_by_identity
 
 # ------------------------------------------------------------- registry
 
@@ -72,10 +74,12 @@ SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "trace_schema.json")
 
 # --------------------------------------------------------------- state
 
-_lock = threading.Lock()
+_lock = make_lock("trace.log")
 #: kernel sinks get their OWN lock: record_kernel runs once per traced
 #: XLA program and must never contend with event-file IO under _lock
-_sink_lock = threading.Lock()
+#: (it is the ONE lock events may be recorded under — the
+#: lock.emit-under-lock lint rule exempts it by name)
+_sink_lock = make_lock("trace.sink")
 _loaded = False
 _armed = False          # event-log emission on (conf spark.blaze.trace.enabled)
 _dir = ""               # resolved event-log directory
@@ -98,7 +102,7 @@ _KERNEL_TIMING = False
 #: of them, so attribution is cheap enough to leave armed in production
 _sample_rate = 1
 _sample_counter = 0
-_sample_lock = threading.Lock()
+_sample_lock = make_lock("trace.sample")
 
 #: per-path rollover segment counters for the size-capped event log
 #: (conf spark.blaze.eventLog.maxBytes)
@@ -274,13 +278,11 @@ def kernel_capture() -> Iterator[Dict[str, Dict[str, int]]]:
         yield sink
     finally:
         with _sink_lock:
-            # identity removal: list.remove compares dicts by VALUE,
+            # identity removal (metrics._remove_by_identity — the ONE
+            # shared definition): list.remove compares dicts by VALUE,
             # so a nested capture with equal contents (e.g. two empty
             # sinks) would evict the outer scope's dict instead
-            for i, s in enumerate(_KERNEL_SINKS):
-                if s is sink:
-                    del _KERNEL_SINKS[i]
-                    break
+            _remove_by_identity(_KERNEL_SINKS, sink)
             _KERNEL_TIMING = bool(_KERNEL_SINKS)
 
 

@@ -51,6 +51,15 @@ _ensure_native_built()
 
 import pytest
 
+# Per the static-analysis contract (ISSUE 6): the plan verifier runs
+# over every optimized plan in EVERY test — any plan a test executes
+# through optimize_plan/run_task that breaks a structural invariant
+# (schema edge, distribution/ordering prerequisite, fusion invariant)
+# fails loudly here instead of producing wrong answers.
+from blaze_tpu import conf as _blaze_conf  # noqa: E402
+
+_blaze_conf.VERIFY_PLAN.set(True)
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_compiled_caches_between_modules():

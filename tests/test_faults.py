@@ -35,6 +35,21 @@ from blaze_tpu.spark import BlazeSparkSession
 import spark_fixtures as F
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_assertions():
+    """The fault suite drives retries/reruns across spill, shuffle,
+    and staging threads — the module runs with the runtime lock-order
+    assertion armed (analysis/locks.py), so an inverted acquisition
+    raises LockOrderError here instead of deadlocking rarely."""
+    from blaze_tpu.analysis import locks as lock_verify
+
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    yield
+    conf.VERIFY_LOCKS.set(False)
+    lock_verify.refresh()
+
+
 @pytest.fixture(autouse=True)
 def _clean_faults():
     """Deterministic, sleep-free fault runs; always clear the spec."""

@@ -48,6 +48,22 @@ from blaze_tpu.tpch.datagen import generate_all, table_to_batches
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_assertions():
+    """The monitor suite exercises every background-thread subsystem
+    (HTTP handler threads, heartbeat TLS, scheduler fan-out), so the
+    whole module runs with the runtime lock-order assertion armed
+    (analysis/locks.py): an inverted acquisition raises LockOrderError
+    in the test instead of deadlocking rarely in production."""
+    from blaze_tpu.analysis import locks as lock_verify
+
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    yield
+    conf.VERIFY_LOCKS.set(False)
+    lock_verify.refresh()
+
+
 @pytest.fixture(scope="module")
 def data():
     return generate_all(0.02)
