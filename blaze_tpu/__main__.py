@@ -18,6 +18,8 @@ Usage:
     python -m blaze_tpu --chaos             # seeded fault-injection smoke
                                             #  (+ plan verifier + lock-order armed)
     python -m blaze_tpu tpch q1 --chaos --chaos-seed 42
+    python -m blaze_tpu --chaos-seeds 3    # seeded sweep; seed 1 also arms
+                                           #  speculation vs. a straggler
     python -m blaze_tpu tpch q1 --scheduler --trace   # write an event log
     python -m blaze_tpu --report <eventlog.jsonl>     # render the profile
     python -m blaze_tpu --report <log> --json out.json  # + JSON profile
@@ -313,18 +315,26 @@ def _run_lint() -> int:
 
 
 def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
-               n_faults: int) -> int:
+               n_faults: int, speculate: bool = False) -> int:
     """Fault-injection smoke: fault-free run vs seeded-fault run must
     produce identical rows.  The chaotic run is TRACED (event log on),
     and the recovery story must reconcile: every injected fault paired
-    with a recorded recovery event (task retry or map-stage rerun).
-    The plan verifier (spark.blaze.verify.plan) and the runtime
-    lock-order assertion (spark.blaze.verify.locks) are both FORCED ON
-    for the whole smoke — a plan invariant break or an inverted lock
-    acquisition fails the run.  Nonzero exit on mismatch, unrecovered
-    failure, an unreconciled event log, or either verifier firing."""
+    with a recorded recovery event (task retry or map-stage rerun),
+    and every ``speculative_attempt_start`` paired with a ``_won`` /
+    ``_lost`` resolution.  The plan verifier (spark.blaze.verify.plan)
+    and the runtime lock-order assertion (spark.blaze.verify.locks)
+    are both FORCED ON for the whole smoke — a plan invariant break or
+    an inverted lock acquisition fails the run.
+
+    ``speculate`` additionally ARMS speculation (duration + wedge
+    triggers, fast heartbeat cadence) and seeds a deterministic
+    STRAGGLER (``slow<ms>`` latency entry) into the fault schedule, so
+    the smoke exercises the backup-attempt race, not just crash
+    recovery.  Nonzero exit on mismatch, unrecovered failure, an
+    unreconciled event log, or either verifier firing."""
     from . import conf
     from .analysis import locks as lock_verify
+    from .runtime import monitor
 
     build_query, names, scans = _load_suite(suite, names, scale, n_parts)
     if build_query is None:
@@ -334,23 +344,44 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
     conf.VERIFY_PLAN.set(True)
     conf.VERIFY_LOCKS.set(True)
     lock_verify.refresh()
+    spec_knobs = (conf.SPECULATION_ENABLE, conf.SPECULATION_MULTIPLIER,
+                  conf.SPECULATION_QUANTILE, conf.SPECULATION_MIN_RUNTIME,
+                  conf.SPECULATION_WEDGE_MS, conf.MONITOR_HEARTBEAT_MS)
+    prev = [k.get() for k in spec_knobs]
+    if speculate:
+        conf.SPECULATION_ENABLE.set(True)
+        conf.SPECULATION_MULTIPLIER.set(1.2)
+        conf.SPECULATION_QUANTILE.set(0.25)
+        conf.SPECULATION_MIN_RUNTIME.set(0.05)
+        conf.SPECULATION_WEDGE_MS.set(250)
+        # wedge detection needs beats faster than the wedge threshold
+        conf.MONITOR_HEARTBEAT_MS.set(50)
+        monitor.reset()
     try:
         return _chaos_loop(suite, names, scans, build_query, n_parts, seed,
-                           n_faults)
+                           n_faults, speculate)
     finally:
         conf.VERIFY_PLAN.set(False)
         conf.VERIFY_LOCKS.set(False)
         lock_verify.refresh()
+        if speculate:
+            # restore EVERY knob the smoke touched, symmetrically —
+            # a later in-process run must not inherit the smoke's
+            # aggressive thresholds
+            for k, v in zip(spec_knobs, prev):
+                k.set(v)
+            monitor.reset()
 
 
 def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
-                n_faults) -> int:
+                n_faults, speculate=False) -> int:
     from . import conf
     from .runtime import faults, monitor, scheduler, trace, trace_report
 
     failed = []
     for i, name in enumerate(names):
-        spec = faults.random_spec(seed + i, n_faults=n_faults)
+        spec = faults.random_spec(seed + i, n_faults=n_faults,
+                                  n_stragglers=1 if speculate else 0)
         conf.FAULTS_SPEC.set("")
         faults.reset()
         try:
@@ -385,16 +416,25 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
             f"attempts={m.get('task_attempts')} retries={m.get('task_retries')} "
             f"fetch_failures={m.get('fetch_failures')} "
             f"map_reruns={m.get('map_stage_reruns')} "
+            f"map_tasks_rerun={m.get('map_tasks_rerun')} "
+            f"speculative={m.get('speculative_attempts')}"
+            f"/won={m.get('speculative_won')} "
             f"dispatches={m.get('xla_dispatches')} "
             f"compiles={m.get('xla_compiles')}" if m else "no metrics"
         )
-        # event-log recovery reconciliation: every fault that FIRED
-        # must pair with a recovery event recorded after it
-        rec = trace_report.reconcile_faults(
-            trace.read_event_log(log_path) if log_path else [])
+        # event-log reconciliation: every fault that FIRED must pair
+        # with a recovery event recorded after it, and every
+        # speculative attempt must resolve won-or-lost
+        events = trace.read_event_log(log_path) if log_path else []
+        rec = trace_report.reconcile_faults(events)
+        spc = trace_report.reconcile_speculation(events)
         recon = (f"eventlog {rec['injected']} faults / "
                  f"{rec['recoveries']} recoveries "
-                 + ("reconciled" if rec["reconciled"] else "UNRECONCILED"))
+                 + ("reconciled" if rec["reconciled"] else "UNRECONCILED")
+                 + f"; {spc['speculated']} speculated "
+                 f"({spc['won']} won / {spc['lost']} lost) "
+                 + ("reconciled" if spc["reconciled"] else "UNRECONCILED"))
+        leaked = [t for t in _live_attempt_threads()]
         if chaotic != baseline:
             print(f"chaos {name}: MISMATCH under spec '{spec}' ({counters}; "
                   f"{recon})", file=sys.stderr)
@@ -405,6 +445,16 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
                   f"recovery event ({counters}; {recon}; log: {log_path})",
                   file=sys.stderr)
             failed.append(name)
+        elif not spc["reconciled"]:
+            print(f"chaos {name}: SPECULATION UNRECONCILED under spec "
+                  f"'{spec}': {len(spc['unpaired'])} backup(s) without a "
+                  f"won/lost resolution ({counters}; {recon}; "
+                  f"log: {log_path})", file=sys.stderr)
+            failed.append(name)
+        elif leaked:
+            print(f"chaos {name}: ATTEMPT THREAD LEAK under spec '{spec}': "
+                  + ", ".join(t.name for t in leaked), file=sys.stderr)
+            failed.append(name)
         else:
             print(f"chaos {name}: OK {len(baseline)} rows identical under "
                   f"spec '{spec}' ({counters}; {recon})")
@@ -413,6 +463,15 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
               file=sys.stderr)
         return 1
     return 0
+
+
+def _live_attempt_threads():
+    """Attempt-runner threads still alive after a run — the speculation
+    leak gate (a cancelled loser must exit cooperatively)."""
+    import threading
+
+    return [t for t in threading.enumerate()
+            if t.name.startswith("blaze-attempt-") and t.is_alive()]
 
 
 def _serve_forever() -> int:
@@ -530,6 +589,14 @@ def main(argv=None) -> int:
                     help="seed for the chaos fault schedule (default 7)")
     ap.add_argument("--chaos-faults", type=int, default=3,
                     help="faults per scheduled chaos run (default 3)")
+    ap.add_argument("--chaos-seeds", type=int, default=0, metavar="N",
+                    help="sweep mode: run the chaos smoke N times with "
+                         "seeds chaos-seed..chaos-seed+N-1 (implies "
+                         "--chaos); the FIRST seed additionally arms "
+                         "speculation with an injected straggler, so the "
+                         "backup-attempt race is exercised in every sweep; "
+                         "nonzero exit on any mismatch or unreconciled "
+                         "event log")
     ap.add_argument("--trace", action="store_true",
                     help="arm the structured event log "
                          "(spark.blaze.trace.enabled) for this run; each "
@@ -574,6 +641,8 @@ def main(argv=None) -> int:
     if args.json and not args.report:
         ap.error("--json requires --report (it mirrors the rendered "
                  "profile as JSON)")
+    if args.chaos_seeds:
+        args.chaos = True
     if args.lint:
         return _run_lint()
     if args.report:
@@ -656,6 +725,17 @@ def main(argv=None) -> int:
         if args.warmup:
             rc = _warmup(args.suite, queries, args.scale, args.parts,
                          args.xla_cache_dir)
+        elif args.chaos_seeds:
+            # seed sweep: N independent schedules; the first also arms
+            # speculation against an injected straggler
+            rc = 0
+            for k in range(args.chaos_seeds):
+                print(f"# chaos sweep {k + 1}/{args.chaos_seeds} "
+                      f"(seed {args.chaos_seed + k}"
+                      + (", speculation armed)" if k == 0 else ")"))
+                rc = _run_chaos(args.suite, queries, args.scale, args.parts,
+                                args.chaos_seed + k, args.chaos_faults,
+                                speculate=(k == 0)) or rc
         elif args.chaos:
             rc = _run_chaos(args.suite, queries, args.scale, args.parts,
                             args.chaos_seed, args.chaos_faults)

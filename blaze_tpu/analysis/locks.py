@@ -60,6 +60,8 @@ HIERARCHY: Tuple[str, ...] = (
     "monitor.server",        # server lifecycle (ensure/shutdown)
     "shuffle.repartitioner", # per-map-task staged partition buffers
     "monitor.registry",      # live query registry
+    "monitor.progress",      # per-stage progress counters (leaf: held
+                             # only for arithmetic, emission is outside)
     "memmgr.manager",        # host-staging budget accounting
     "metrics.node",          # MetricNode tree growth
     "metrics.set",           # per-operator counters

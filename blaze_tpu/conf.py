@@ -105,6 +105,41 @@ TASK_TIMEOUT = ConfEntry("spark.blaze.task.timeout", 0.0, float)
 # fetch-failure recoveries (upstream map-stage regenerations) allowed
 # per fetching task before the failure is terminal
 STAGE_MAX_ATTEMPTS = ConfEntry("spark.blaze.stage.maxAttempts", 4, int)
+# Concurrent tasks per non-result stage in the scheduler (1 = the
+# strictly serial pre-speculation behavior, which keeps fault-injection
+# hit ordering deterministic; speculation/wedge detection force the
+# concurrent attempt runner regardless).
+STAGE_TASK_CONCURRENCY = ConfEntry("spark.blaze.stage.taskConcurrency", 1, int)
+# Heartbeat-age wedge detection on the plain (non-speculative) retry
+# path, in ms: a task whose monitor heartbeat age exceeds this is
+# cancelled cooperatively and RETRIED like a timeout — covering the
+# blind spot where the cooperative drain deadline only fires between
+# driver-observed batches, so a task wedged inside its first batch
+# would hang forever.  0 = off.  Must exceed
+# spark.blaze.monitor.heartbeatMs or healthy tasks look wedged.
+TASK_WEDGE_MS = ConfEntry("spark.blaze.task.wedgeMs", 0, int)
+
+# Speculative execution (runtime/speculation.py, ≙ spark.speculation):
+# once a quantile of a stage's tasks have finished, a task running
+# longer than multiplier x their median runtime (or whose heartbeat age
+# crosses wedgeMs) gets ONE backup attempt racing it through the
+# attempt-id commit seams (atomic-rename shuffle commit / RSS
+# close-abort); first completion wins, the loser is cancelled
+# cooperatively and its progress/heartbeat state rolled back.
+SPECULATION_ENABLE = ConfEntry("spark.blaze.speculation.enabled", False, _bool)
+# backup launches when runtime > multiplier x median(completed sibling
+# durations) — ≙ spark.speculation.multiplier
+SPECULATION_MULTIPLIER = ConfEntry("spark.blaze.speculation.multiplier", 1.5, float)
+# fraction of the stage's tasks that must have completed before
+# duration-based speculation engages — ≙ spark.speculation.quantile
+SPECULATION_QUANTILE = ConfEntry("spark.blaze.speculation.quantile", 0.75, float)
+# minimum runtime (seconds) before a task may be speculated — keeps
+# short tasks from ever paying the backup cost
+SPECULATION_MIN_RUNTIME = ConfEntry("spark.blaze.speculation.minRuntime", 0.1, float)
+# heartbeat-age wedge trigger for speculation, in ms: a running task
+# whose last beat is older than this gets its backup immediately,
+# without waiting for the duration quantile (0 = duration-only)
+SPECULATION_WEDGE_MS = ConfEntry("spark.blaze.speculation.wedgeMs", 0, int)
 # deterministic fault-injection schedule (runtime/faults.py grammar,
 # e.g. "shuffle.fetch@2,task.compute@1@a0"); empty = no injection.
 # Env override BLAZE_FAULTS_SPEC reaches worker subprocesses too.

@@ -38,7 +38,11 @@ def _collect_child_batch(child: ExecNode, partitions, ctx: TaskContext) -> Recor
 
     batches: List[RecordBatch] = []
     for p in partitions:
-        for b in child.execute(p, TaskContext(p, child.num_partitions())):
+        # the child drives under a DERIVED context: the task's
+        # resources view must reach the broadcast reader (an
+        # attempt-scoped registration is invisible to the global map)
+        # and cancellation must propagate into the drain
+        for b in child.execute(p, ctx.child_context(p, child.num_partitions())):
             if not ctx.is_task_running():
                 raise TaskCancelled("broadcast build drain cancelled")
             batches.append(b)

@@ -37,6 +37,11 @@ class IpcWriterExec(ExecNode):
             frames: List[bytes] = []
             for b in self.children[0].execute(partition, ctx):
                 frames.append(compress_frame(serialize_batch(b)))
+            if not ctx.is_task_running():
+                # cancelled (a speculative loser): the child's drain
+                # stopped early, so the frames are PARTIAL — publishing
+                # them would overwrite the winner's complete blob
+                return
             ctx.resources.put(f"{self.resource_id}.{partition}", b"".join(frames))
             return
             yield  # pragma: no cover

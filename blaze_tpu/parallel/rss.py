@@ -19,7 +19,7 @@ from ..batch import RecordBatch
 from ..io.batch_serde import serialize_batch
 from ..io.ipc_compression import compress_frame
 from ..ops.base import BatchStream, ExecNode
-from ..runtime import faults, trace
+from ..runtime import faults, monitor, trace
 from ..runtime.context import TaskContext
 from ..schema import Schema
 from .shuffle import (
@@ -100,9 +100,13 @@ class RssShuffleWriterExec(ExecNode):
             try:
                 for batch in self.children[0].execute(partition, ctx):
                     if not ctx.is_task_running():
-                        # cancelled: do NOT commit a partial push set
+                        # cancelled (e.g. a speculative LOSER): do NOT
+                        # commit a partial push set
                         writer.abort()
                         return
+                    # heartbeat hookpoint: the RSS push loop is as
+                    # driver-invisible as the local shuffle write loop
+                    monitor.tick()
                     with self.metrics.timer("elapsed_compute"):
                         if isinstance(self.partitioning, HashPartitioning) and n_out > 1:
                             pids = self._file_twin._hash_pids(
@@ -155,6 +159,12 @@ class RssShuffleWriterExec(ExecNode):
                 writer.abort()
                 raise
             else:
+                if not ctx.is_task_running():
+                    # cancelled with a cooperatively early-exiting
+                    # child: the in-loop check never ran, and closing
+                    # would COMMIT a partial push set
+                    writer.abort()
+                    return
                 writer.flush()
                 writer.close()
                 trace.emit("rss_push", resource=self.writer_resource_id,

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import os
 import queue
+import re
 import struct
 import tempfile
 import threading
@@ -298,8 +299,15 @@ class ShuffleRepartitioner(MemConsumer):
         # rename on success — index LAST, since reduce_blocks keys on
         # index existence.  A failed attempt leaves no committed
         # output, so its retry can never double-count toward the
-        # reduce barrier and readers never see a torn file.
-        tmp_data, tmp_index = data_path + ".inprogress", index_path + ".inprogress"
+        # reduce barrier and readers never see a torn file.  The temp
+        # names are ATTEMPT-QUALIFIED: a speculative backup racing the
+        # original writes the same final paths, and a shared temp name
+        # would let one attempt's abort unlink the other's staging
+        # mid-write — with unique temps the two atomic renames commute
+        # (first commit wins; the loser re-replaces with byte-identical
+        # content or is cancelled before reaching here).
+        suffix = f".inprogress.a{self.task_attempt_id}"
+        tmp_data, tmp_index = data_path + suffix, index_path + suffix
         try:
             with open(tmp_data, "wb") as f:
                 w = IpcFrameWriter(f, codec)
@@ -705,6 +713,13 @@ class ShuffleWriterExec(ExecNode):
                 if inserter is not None:
                     inserter.close()
                     inserter = None
+                if not ctx.is_task_running():
+                    # cancelled (a speculative loser): a cooperatively
+                    # exiting CHILD yields nothing, so the per-batch
+                    # check above never fires — committing here would
+                    # overwrite the winner's committed output with an
+                    # empty/partial one (chaos-sweep-found)
+                    return
                 with self.metrics.timer("output_io_time"):
                     self.partition_lengths = rep.write_output(self.data_path, self.index_path)
                 self.metrics.add("data_size", sum(self.partition_lengths))
@@ -719,6 +734,20 @@ class ShuffleWriterExec(ExecNode):
 
 
 BlockObject = Union[bytes, Tuple[str, int, int]]  # bytes | (path, offset, length)
+
+_MAP_FILE_RE = re.compile(r"shuffle_\d+_(\d+)\.data$")
+
+
+def block_map_id(block: "BlockObject") -> Optional[int]:
+    """The producing MAP TASK id of a file-backed shuffle block (parsed
+    from the ``shuffle_<sid>_<mapid>.data`` naming contract of
+    :class:`LocalShuffleManager`), or None for in-memory blocks — the
+    attribution that lets a fetch failure name exactly which map
+    outputs to regenerate instead of re-running the whole stage."""
+    if isinstance(block, bytes):
+        return None
+    m = _MAP_FILE_RE.search(os.path.basename(block[0]))
+    return int(m.group(1)) if m else None
 
 
 class IpcReaderExec(ExecNode):
@@ -785,9 +814,13 @@ class IpcReaderExec(ExecNode):
                     # typed fetch failure so the scheduler knows to
                     # regenerate the producing map stage rather
                     # than uselessly re-running this reader against
-                    # the same bad bytes (≙ FetchFailedException)
+                    # the same bad bytes (≙ FetchFailedException);
+                    # the block path names the producing map task, so
+                    # recovery can re-run JUST that one
+                    mid = block_map_id(block)
                     raise FetchFailedError(
-                        self.resource_id, partition, cause=e
+                        self.resource_id, partition, cause=e,
+                        map_ids=None if mid is None else [mid],
                     ) from e
                 # counted only once the block's payloads are in hand:
                 # a failed fetch must not report bytes it never read
@@ -804,8 +837,10 @@ class IpcReaderExec(ExecNode):
                     # producer bytes, not a transient compute error
                     b = deserialize_batch(p, self._schema)
                 except (struct.error, ValueError, EOFError) as e:
+                    mid = block_map_id(block)
                     raise FetchFailedError(
-                        self.resource_id, partition, cause=e
+                        self.resource_id, partition, cause=e,
+                        map_ids=None if mid is None else [mid],
                     ) from e
                 if b.num_rows:
                     self.metrics.add("output_rows", b.num_rows)
@@ -824,19 +859,27 @@ class LocalShuffleManager:
         base = os.path.join(self.root, f"shuffle_{shuffle_id}_{map_id}")
         return base + ".data", base + ".index"
 
-    def invalidate(self, shuffle_id: int) -> int:
-        """Drop every map output (and in-progress temp) of a shuffle —
-        the driver's response to a FetchFailedError before re-running
-        the producing map stage (≙ DAGScheduler unregistering a dead
-        executor's map outputs).  Returns files removed."""
+    def invalidate(self, shuffle_id: int,
+                   map_ids: Optional[Sequence[int]] = None) -> int:
+        """Drop map outputs (and in-progress temps) of a shuffle — the
+        driver's response to a FetchFailedError before re-running the
+        producing map stage (≙ DAGScheduler unregistering a dead
+        executor's map outputs).  ``map_ids`` restricts the drop to
+        those map tasks' outputs (partial re-run: only the missing
+        producers are regenerated, the surviving outputs keep feeding
+        the reduce barrier).  Returns files removed."""
         removed = 0
-        prefix = f"shuffle_{shuffle_id}_"
+        if map_ids is not None:
+            prefixes = tuple(
+                f"shuffle_{shuffle_id}_{m}." for m in map_ids)
+        else:
+            prefixes = (f"shuffle_{shuffle_id}_",)
         try:
             names = os.listdir(self.root)
         except OSError:
             return 0
         for fn in names:
-            if fn.startswith(prefix):
+            if fn.startswith(prefixes):
                 try:
                     os.unlink(os.path.join(self.root, fn))
                     removed += 1

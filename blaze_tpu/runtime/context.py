@@ -46,6 +46,36 @@ class ResourcesMap:
 RESOURCES = ResourcesMap()
 
 
+class ScopedResources:
+    """Per-attempt view over a :class:`ResourcesMap`: lookups of the
+    keys in ``remap`` are redirected to attempt-scoped names, so two
+    CONCURRENT attempts of the same task (a speculative backup racing
+    its original) never steal each other's one-shot registrations —
+    the registrar stages each attempt's blocks under a scoped key and
+    hands the attempt this view.  Keys outside the remap (operator
+    side-channel puts, broadcast blob publication) pass through to the
+    base map untouched."""
+
+    def __init__(self, base: ResourcesMap, remap: Dict[str, str]):
+        self._base = base
+        self._remap = remap
+
+    def _key(self, key: str) -> str:
+        return self._remap.get(key, key)
+
+    def put(self, key: str, value: Any) -> None:
+        self._base.put(self._key(key), value)
+
+    def get(self, key: str) -> Any:
+        return self._base.get(self._key(key))
+
+    def peek(self, key: str) -> Any:
+        return self._base.peek(self._key(key))
+
+    def discard(self, key: str) -> None:
+        self._base.discard(self._key(key))
+
+
 class TaskCancelled(Exception):
     """Raised where silent early-exit would poison a cached/partial
     result (e.g. a broadcast build drain)."""
@@ -61,6 +91,8 @@ class TaskContext:
         metrics: Optional[MetricNode] = None,
         stage_id: int = 0,
         task_attempt_id: int = 0,
+        resources: Optional[Any] = None,
+        cancel_event: Optional[threading.Event] = None,
     ):
         self.partition = partition
         self.num_partitions = num_partitions
@@ -68,9 +100,27 @@ class TaskContext:
         self.stage_id = stage_id
         self.task_attempt_id = task_attempt_id
         self.mem = MemManager.get()
-        self.resources = RESOURCES
-        self._cancelled = threading.Event()
+        # a ScopedResources view for concurrent attempts of one task;
+        # the process-global map otherwise
+        self.resources = resources if resources is not None else RESOURCES
+        # shared with the scheduler for speculative races: the driver
+        # cancels the losing attempt through this event
+        self._cancelled = cancel_event or threading.Event()
         self._on_complete: list[Callable[[], None]] = []
+
+    def child_context(self, partition: int,
+                      num_partitions: int = 1) -> "TaskContext":
+        """A context for driving a CHILD subtree inside this task (e.g.
+        the broadcast-side build drain): shares this task's resources
+        view and cancellation event, so attempt-scoped registrations
+        and cooperative cancellation propagate through
+        operator-internal drives instead of silently detaching to the
+        process-global map."""
+        return TaskContext(
+            partition, num_partitions, stage_id=self.stage_id,
+            task_attempt_id=self.task_attempt_id,
+            resources=self.resources, cancel_event=self._cancelled,
+        )
 
     def is_task_running(self) -> bool:
         """≙ JniBridge.isTaskRunning — cancelled tasks exit quietly."""

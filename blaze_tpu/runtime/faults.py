@@ -24,13 +24,21 @@ optionally gated on the task attempt id, so "fail the 3rd shuffle fetch
 of attempt 0" is expressible and a retried attempt (fresh attempt id)
 passes.  ``spill.write`` is the one site with NO attempt identity (a
 spill may run on another task's thread via the memory manager), so its
-attempt gate always sees 0; rely on the one-shot hit counter there.  The schedule comes from the conf knob
-``spark.blaze.faults.spec`` (env override ``BLAZE_FAULTS_SPEC``, so
-worker subprocesses inherit it) with the grammar::
+attempt gate always sees 0; rely on the one-shot hit counter there.
+
+An entry may instead inject *latency*: a ``slow<ms>`` suffix makes the
+matching hit SLEEP that many milliseconds and return normally instead
+of raising — a deterministic straggler for speculation/wedge tests and
+``--chaos`` (a ``straggler_injected`` event is emitted so chaos runs
+can pair stragglers with the speculative attempts they provoke).
+
+The schedule comes from the conf knob ``spark.blaze.faults.spec`` (env
+override ``BLAZE_FAULTS_SPEC``, so worker subprocesses inherit it) with
+the grammar::
 
     spec     := entry ("," entry)*
-    entry    := site "@" hit [ "@a" attempt ]
-    example  := "shuffle.fetch@2,task.compute@1@a0"
+    entry    := site "@" hit [ "@a" attempt ] [ "@slow" ms ]
+    example  := "shuffle.fetch@2,task.compute@1@a0,shuffle.write@1@a0@slow500"
 
 Hit counters are per-process.  The schedule is loaded from conf at the
 FIRST :func:`hit` of the process and re-loaded (counters reset) by
@@ -44,6 +52,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from .. import conf
@@ -69,8 +78,9 @@ class InjectedFault(RuntimeError):
         )
 
 
-# (site, hit_no, attempt_filter) — attempt_filter None = any attempt
-Rule = Tuple[str, int, Optional[int]]
+# (site, hit_no, attempt_filter, slow_ms) — attempt_filter None = any
+# attempt; slow_ms None = raise, otherwise sleep that long and return
+Rule = Tuple[str, int, Optional[int], Optional[int]]
 
 
 def parse_spec(spec: str) -> List[Rule]:
@@ -80,26 +90,36 @@ def parse_spec(spec: str) -> List[Rule]:
         if not entry:
             continue
         parts = entry.split("@")
-        if len(parts) not in (2, 3):
+        if len(parts) < 2:
             raise ValueError(f"bad fault spec entry {entry!r}")
         site, hit = parts[0], int(parts[1])
         if site not in SITES:
             raise ValueError(f"unknown fault site {site!r} (known: {SITES})")
         attempt: Optional[int] = None
-        if len(parts) == 3:
-            if not parts[2].startswith("a"):
-                raise ValueError(f"bad attempt filter in {entry!r}")
-            attempt = int(parts[2][1:])
-        rules.append((site, hit, attempt))
+        slow_ms: Optional[int] = None
+        for mod in parts[2:]:
+            if mod.startswith("slow"):
+                if slow_ms is not None:
+                    raise ValueError(f"duplicate slow modifier in {entry!r}")
+                slow_ms = int(mod[4:])
+            elif mod.startswith("a"):
+                if attempt is not None:
+                    raise ValueError(f"duplicate attempt filter in {entry!r}")
+                attempt = int(mod[1:])
+            else:
+                raise ValueError(f"bad modifier {mod!r} in {entry!r}")
+        rules.append((site, hit, attempt, slow_ms))
     return rules
 
 
 def format_spec(rules: List[Rule]) -> str:
     out = []
-    for site, hit, attempt in rules:
+    for site, hit, attempt, slow_ms in rules:
         s = f"{site}@{hit}"
         if attempt is not None:
             s += f"@a{attempt}"
+        if slow_ms is not None:
+            s += f"@slow{slow_ms}"
         out.append(s)
     return ",".join(out)
 
@@ -110,10 +130,20 @@ def random_spec(
     sites: Tuple[str, ...] = ("shuffle.fetch", "task.compute", "shuffle.write"),
     horizon: int = 8,
     first_attempt_only: bool = True,
+    n_stragglers: int = 0,
+    straggler_ms: Tuple[int, int] = (250, 600),
 ) -> str:
     """Seed-derived fault schedule for chaos runs.  Faults are gated to
     attempt 0 by default so a bounded retry budget always recovers
-    (the schedule tests recovery, not the retry limit)."""
+    (the schedule tests recovery, not the retry limit).
+
+    ``n_stragglers`` appends that many latency entries (``slow<ms>``
+    with seeded ms in ``straggler_ms``) — the deterministic provocation
+    the chaos speculation scenario races against.  Straggler entries
+    are NOT attempt-gated (a crash rule earlier in the schedule may
+    already have consumed attempt 0): the one-shot hit counter still
+    guarantees the delay is paid exactly once, so whichever attempt
+    draws it straggles and the race resolves the other way."""
     rng = random.Random(seed)
     rules: List[Rule] = []
     seen: Set[Tuple[str, int]] = set()
@@ -123,7 +153,22 @@ def random_spec(
         if (site, hit) in seen:
             continue
         seen.add((site, hit))
-        rules.append((site, hit, 0 if first_attempt_only else None))
+        rules.append((site, hit, 0 if first_attempt_only else None, None))
+    straggler_sites = ("task.compute", "shuffle.write")
+    for _ in range(n_stragglers):
+        # REDRAW on collision with a crash rule (the sites overlap):
+        # a silently dropped straggler would make the chaos sweep's
+        # speculation-armed seed a vacuous pass
+        for _ in range(16):
+            site = straggler_sites[rng.randrange(len(straggler_sites))]
+            hit = rng.randrange(1, horizon + 1)
+            if (site, hit) not in seen:
+                break
+        else:
+            continue
+        seen.add((site, hit))
+        ms = rng.randrange(straggler_ms[0], straggler_ms[1] + 1)
+        rules.append((site, hit, None, ms))
     return format_spec(rules)
 
 
@@ -131,9 +176,9 @@ class FaultInjector:
     """Per-process hit counters against a parsed schedule."""
 
     def __init__(self, rules: List[Rule]):
-        self._by_site: Dict[str, List[Tuple[int, Optional[int]]]] = {}
-        for site, hit, attempt in rules:
-            self._by_site.setdefault(site, []).append((hit, attempt))
+        self._by_site: Dict[str, List[Tuple[int, Optional[int], Optional[int]]]] = {}
+        for site, hit, attempt, slow_ms in rules:
+            self._by_site.setdefault(site, []).append((hit, attempt, slow_ms))
         self._counts: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -144,12 +189,19 @@ class FaultInjector:
         with self._lock:
             n = self._counts.get(site, 0) + 1
             self._counts[site] = n
-        for hit_no, want_attempt in matches:
+        for hit_no, want_attempt, slow_ms in matches:
             if n == hit_no and (want_attempt is None or want_attempt == attempt):
-                # record the injection BEFORE raising so a chaos run's
-                # event log pairs every fault with its recovery event
+                # record the injection BEFORE raising/sleeping so a
+                # chaos run's event log pairs every fault with its
+                # recovery (and every straggler with its speculation)
                 from . import trace
 
+                if slow_ms is not None:
+                    trace.emit("straggler_injected", site=site, hit=n,
+                               attempt=attempt, slow_ms=slow_ms,
+                               detail=detail)
+                    time.sleep(slow_ms / 1000.0)
+                    return
                 trace.emit("fault_injected", site=site, hit=n,
                            attempt=attempt, detail=detail)
                 if site == "shuffle.fetch":
@@ -178,10 +230,10 @@ def _load_from_conf() -> None:
 
 
 def hit(site: str, attempt: int = 0, detail: str = "") -> None:
-    """Instrumentation point: count one hit at ``site``; raise if the
-    active schedule says this hit fails.  Disarmed (no spec at last
-    load), this is a single bool check — safe on per-frame/per-block
-    hot paths."""
+    """Instrumentation point: count one hit at ``site``; raise (or
+    sleep, for a ``slow`` rule) if the active schedule says this hit
+    fires.  Disarmed (no spec at last load), this is a single bool
+    check — safe on per-frame/per-block hot paths."""
     if not _loaded:
         _load_from_conf()  # pick up BLAZE_FAULTS_SPEC in fresh workers
     if not _armed:

@@ -76,7 +76,9 @@ _CURRENT: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
 #: scheduler-level recovery counters mirrored into the query entry on
 #: every heartbeat (the /queries retry/fetch-failure tallies)
 SCHED_COUNTERS = ("task_attempts", "task_retries", "task_timeouts",
-                  "fetch_failures", "map_stage_reruns")
+                  "fetch_failures", "map_stage_reruns", "map_tasks_rerun",
+                  "speculative_attempts", "speculative_won",
+                  "speculative_lost")
 
 
 def _load() -> None:
@@ -311,12 +313,18 @@ def task_beat(stage_id: int, partition: int, attempt: int, *, rows: int,
         _bump()
 
 
-def task_discard(stage_id: int, partition: int) -> None:
+def task_discard(stage_id: int, partition: int,
+                 attempt: Optional[int] = None) -> None:
     """Drop a task's heartbeat entry — the failed-attempt counterpart
     of :meth:`StageProgress.rollback`: a retry faster than the
     heartbeat interval never beats again, so the failed attempt's
     rows would otherwise inflate ``task_rows`` (and everything
-    rendered from it) forever."""
+    rendered from it) forever.
+
+    ``attempt`` (when given) drops the entry only if IT wrote the
+    current beat — a speculative loser must roll back its own state
+    without erasing the winner's, and both attempts share the
+    partition-keyed registry slot."""
     if not enabled():
         return
     with _lock:
@@ -326,6 +334,11 @@ def task_discard(stage_id: int, partition: int) -> None:
         st = q["stages"].get(stage_id)
         if st is None:
             return
+        entry = st["tasks"].get(str(partition))
+        if entry is None:
+            return
+        if attempt is not None and entry.get("attempt") != attempt:
+            return  # another attempt's (the winner's) beat: keep it
         st["tasks"].pop(str(partition), None)
         _bump()
 
@@ -484,11 +497,19 @@ class StageProgress:
     most once per ``spark.blaze.monitor.heartbeatMs``.  Fully
     disarmed, ``add_batch``/``task_done`` return after one attribute
     read and :meth:`flush` is never reached — the structural no-op
-    contract the poisoned-emit gate pins."""
+    contract the poisoned-emit gate pins.
+
+    Counter mutation is lock-guarded once armed: the speculative
+    attempt runner drives a stage's tasks from worker threads, and a
+    racy read-modify-write would lose exactly the increments the
+    loser-rollback accounting needs to be exact.  Emission (event log
+    + registry) always happens OUTSIDE the lock — the
+    ``lock.emit-under-lock`` deadlock class."""
 
     __slots__ = ("armed", "traced", "mon", "stage_id", "kind", "n_tasks",
                  "counters", "rows", "bytes", "batches", "tasks_done",
-                 "_attempts", "_t0", "_interval", "_next", "_dirty")
+                 "_attempts", "_t0", "_interval", "_next", "_dirty",
+                 "_plock")
 
     def __init__(self, stage_id: int, kind: Optional[str], n_tasks: int,
                  counters: Optional[Dict[str, int]] = None, attempts=None):
@@ -510,38 +531,47 @@ class StageProgress:
         self._t0 = time.monotonic_ns()
         self._next = self._t0 + self._interval
         self._dirty = False
+        self._plock = make_lock("monitor.progress")
 
     def add_batch(self, batch) -> None:
         """One driver-observed output batch; flushes when a heartbeat
         interval has elapsed."""
         if not self.armed:
             return
-        self.rows += batch.num_rows
-        self.batches += 1
-        for c in batch.columns:
-            self.bytes += getattr(c.data, "nbytes", 0)
-        self._dirty = True
-        now = time.monotonic_ns()
-        if now >= self._next:
+        nbytes = sum(getattr(c.data, "nbytes", 0) for c in batch.columns)
+        with self._plock:
+            self.rows += batch.num_rows
+            self.batches += 1
+            self.bytes += nbytes
+            self._dirty = True
+            now = time.monotonic_ns()
+            due = now >= self._next
+        if due:
             self.flush(now)
 
     def task_done(self) -> None:
         if not self.armed:
             return
-        self.tasks_done += 1
-        self._dirty = True
-        now = time.monotonic_ns()
-        if now >= self._next:
+        with self._plock:
+            self.tasks_done += 1
+            self._dirty = True
+            now = time.monotonic_ns()
+            due = now >= self._next
+        if due:
             self.flush(now)
 
     def mark(self):
         """Checkpoint the batch-fed totals before a task attempt, so a
         failed attempt's partial output can be :meth:`rollback`-ed —
         progress is cumulative across the stage and a retry would
-        otherwise re-count the failed attempt's batches."""
+        otherwise re-count the failed attempt's batches.  Only valid
+        on the SERIAL attempt path: with concurrent attempts running,
+        absolute totals include sibling progress — use
+        :class:`AttemptProgress`/:meth:`discard` there."""
         if not self.armed:
             return None
-        return (self.rows, self.bytes, self.batches)
+        with self._plock:
+            return (self.rows, self.bytes, self.batches)
 
     def rollback(self, mark) -> None:
         """Undo batch-fed progress since ``mark`` (a failed attempt);
@@ -549,18 +579,36 @@ class StageProgress:
         way.  The next flush carries the corrected numbers."""
         if not self.armed or mark is None:
             return
-        self.rows, self.bytes, self.batches = mark
-        self._dirty = True
+        with self._plock:
+            self.rows, self.bytes, self.batches = mark
+            self._dirty = True
+
+    def discard(self, rows: int, bytes_: int, batches: int) -> None:
+        """Subtract one attempt's exact contribution (a failed or
+        losing attempt under the concurrent runner) — the
+        concurrency-safe counterpart of :meth:`rollback`."""
+        if not self.armed:
+            return
+        with self._plock:
+            self.rows -= rows
+            self.bytes -= bytes_
+            self.batches -= batches
+            self._dirty = True
 
     def flush(self, now: Optional[int] = None, force: bool = False) -> None:
         """Emit one heartbeat (event log + registry).  ``force`` emits
         even when nothing changed since the last flush — the final
         stage-close flush, so a stage's last state always lands."""
-        if not self.armed or not (self._dirty or force):
+        if not self.armed:
             return
-        now = now or time.monotonic_ns()
-        self._next = now + self._interval
-        self._dirty = False
+        with self._plock:
+            if not (self._dirty or force):
+                return
+            now = now or time.monotonic_ns()
+            self._next = now + self._interval
+            self._dirty = False
+            rows, bytes_, batches = self.rows, self.bytes, self.batches
+            tasks_done = self.tasks_done
         # None (no dispatch capture, e.g. the map-rerun path) must stay
         # None: an empty dict would CLOBBER the counters the original
         # stage span recorded in the registry
@@ -573,8 +621,8 @@ class StageProgress:
         if self.traced:
             fields = dict(
                 stage_id=self.stage_id, kind=self.kind or "result",
-                rows=self.rows, bytes=self.bytes, batches=self.batches,
-                tasks_done=self.tasks_done, n_tasks=self.n_tasks,
+                rows=rows, bytes=bytes_, batches=batches,
+                tasks_done=tasks_done, n_tasks=self.n_tasks,
                 elapsed_ns=now - self._t0, attempts=attempts,
             )
             if cap is not None:
@@ -582,10 +630,40 @@ class StageProgress:
             trace.emit("stage_progress", **fields)
         if self.mon:
             stage_progress_update(
-                self.stage_id, rows=self.rows, bytes_=self.bytes,
-                batches=self.batches, tasks_done=self.tasks_done,
+                self.stage_id, rows=rows, bytes_=bytes_,
+                batches=batches, tasks_done=tasks_done,
                 counters=cap, attempts=attempts or None,
             )
+
+
+class AttemptProgress:
+    """Per-attempt delta view over a shared :class:`StageProgress`:
+    forwards every batch and remembers this attempt's exact
+    contribution, so a failed (or speculatively LOSING) attempt can be
+    discarded without clobbering what concurrent sibling tasks and
+    attempts added in the meantime — mark/rollback by absolute totals
+    is only correct when attempts run strictly serially."""
+
+    __slots__ = ("_p", "rows", "bytes", "batches")
+
+    def __init__(self, progress: StageProgress):
+        self._p = progress
+        self.rows = 0
+        self.bytes = 0
+        self.batches = 0
+
+    def add_batch(self, batch) -> None:
+        if self._p.armed:
+            self.rows += batch.num_rows
+            self.batches += 1
+            self.bytes += sum(
+                getattr(c.data, "nbytes", 0) for c in batch.columns)
+        self._p.add_batch(batch)
+
+    def discard(self) -> None:
+        """Roll this attempt's contribution back out of the stage."""
+        self._p.discard(self.rows, self.bytes, self.batches)
+        self.rows = self.bytes = self.batches = 0
 
 
 def drive_result_stage(plan, on_batch) -> None:

@@ -17,7 +17,7 @@ from __future__ import annotations
 import hashlib
 import time
 from dataclasses import dataclass, replace
-from typing import Optional
+from typing import List, Optional
 
 from .. import conf
 
@@ -30,6 +30,12 @@ class FetchFailedError(Exception):
     blocks, then re-run the fetching task — re-running the fetch alone
     would re-read the same bad output.  ``resource_id`` names the
     shuffle (``shuffle_<id>``) so the scheduler can find the producer.
+
+    ``map_ids`` (when known) names the exact map tasks whose output is
+    missing/corrupt, so recovery can re-run ONLY those instead of the
+    whole map stage (partial map re-run, ≙ the DAGScheduler
+    regenerating just the lost map outputs); ``None`` means unknown —
+    regenerate everything.
     """
 
     def __init__(
@@ -39,13 +45,16 @@ class FetchFailedError(Exception):
         hit: int = 0,
         injected: bool = False,
         cause: Optional[BaseException] = None,
+        map_ids: Optional[List[int]] = None,
     ):
         self.resource_id = resource_id
         self.partition = partition
         self.injected = injected
+        self.map_ids = sorted(set(map_ids)) if map_ids else None
         super().__init__(
             f"fetch failed for {resource_id!r}"
             + (f" partition {partition}" if partition >= 0 else "")
+            + (f" map_ids {self.map_ids}" if self.map_ids else "")
             + (" [injected]" if injected else "")
             + (f": {cause}" if cause is not None else "")
         )
@@ -64,6 +73,16 @@ class FetchFailedError(Exception):
 class TaskTimeoutError(Exception):
     """A task exceeded ``spark.blaze.task.timeout`` seconds (checked
     cooperatively between output batches).  Retryable."""
+
+
+class TaskWedgedError(TaskTimeoutError):
+    """A task's monitor heartbeat age exceeded the wedge threshold
+    (``spark.blaze.task.wedgeMs`` / ``spark.blaze.speculation.wedgeMs``)
+    — it stopped making observable progress INSIDE a batch, where the
+    cooperative drain deadline can never fire.  Subclasses
+    TaskTimeoutError so classification and the timeout counters treat a
+    wedge as the timeout flavor it is; the retry reason string still
+    names the wedge."""
 
 
 class TaskRetriesExhausted(RuntimeError):

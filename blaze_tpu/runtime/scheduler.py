@@ -27,8 +27,9 @@ from ..ops import ExecNode
 from ..parallel.exchange import NativeShuffleExchangeExec
 from ..parallel.shuffle import IpcReaderExec, LocalShuffleManager, ShuffleWriterExec
 from . import monitor, trace
-from .context import RESOURCES, TaskContext
+from .context import RESOURCES, ScopedResources, TaskContext
 from .metrics import MetricNode
+from .speculation import SpeculationPolicy, StageTaskRunner
 
 #: scheduler-level MetricNode of the most recent :func:`run_stages`
 #: call (attempt/retry/fetch-failure counters) — read by the chaos CLI
@@ -279,10 +280,25 @@ def run_stages(
       the last cause chained; non-retryable failures (cancellation,
       assertion/engine bugs) propagate immediately.
 
+    - **Partial map re-runs.**  When the fetch failure names the exact
+      missing producers (``FetchFailedError.map_ids``, parsed from the
+      block path), only THOSE map tasks regenerate —
+      ``map_tasks_rerun`` counts them, strictly less than ``n_tasks``
+      on a partial recovery.
+    - **Speculation / wedge detection** (runtime/speculation.py, conf
+      ``spark.blaze.speculation.*`` / ``spark.blaze.task.wedgeMs``):
+      non-result stages run under a concurrent attempt runner that
+      races a backup attempt against stragglers (first commit wins
+      through the attempt-id seams; the loser is cancelled and rolled
+      back) and retries heartbeat-wedged tasks the cooperative drain
+      deadline can never see.
+
     Attempt/retry/fetch-failure counters accumulate on ``metrics``
     (default: a fresh node published as ``LAST_RUN_METRICS``):
     ``task_attempts``, ``task_retries``, ``task_timeouts``,
-    ``fetch_failures``, ``map_stage_reruns``."""
+    ``fetch_failures``, ``map_stage_reruns``, ``map_tasks_rerun``,
+    ``speculative_attempts``, ``speculative_won``,
+    ``speculative_lost``."""
     from ..serde import from_proto
     from ..serde.to_proto import STAGED_RIDS
     from .retry import (
@@ -326,19 +342,31 @@ def run_stages(
         readers = ipc_readers(stage.plan, "shuffle_")
         breaders = ipc_readers(stage.plan, "broadcast_")
 
-        def register_stage_readers(t: int) -> List[str]:
-            keys = []
+        def register_stage_readers(t: int, scope: Optional[str] = None):
+            """Stage this task's reduce blocks / broadcast blobs.
+            Returns ``(stored_keys, remap)``: with a ``scope`` the
+            resources land under scope-suffixed keys and ``remap``
+            translates the plan's key to them (via ScopedResources),
+            so CONCURRENT attempts of one task never pop each other's
+            one-shot registrations."""
+            keys: List[str] = []
+            remap: Dict[str, str] = {}
+
+            def stage_key(key: str, value) -> None:
+                stored = key + scope if scope else key
+                RESOURCES.put(stored, value)
+                keys.append(stored)
+                if scope:
+                    remap[key] = stored
+
             for node in readers:
                 sid = int(node.resource_id.split("_")[1])
-                key = f"{node.resource_id}.{t}"
-                RESOURCES.put(key, manager.reduce_blocks(sid, n_maps[sid], t))
-                keys.append(key)
+                stage_key(f"{node.resource_id}.{t}",
+                          manager.reduce_blocks(sid, n_maps[sid], t))
             for node in breaders:
                 bid = int(node.resource_id.split("_")[1])
-                key = f"{node.resource_id}.0"
-                RESOURCES.put(key, list(bcast_blobs[bid]))
-                keys.append(key)
-            return keys
+                stage_key(f"{node.resource_id}.0", list(bcast_blobs[bid]))
+            return keys, remap
 
         return register_stage_readers
 
@@ -368,22 +396,39 @@ def run_stages(
                     f"{policy.task_timeout}s"
                 )
 
-    def regenerate_map_stage(mstage: Stage) -> None:
-        """Fetch-failure recovery: drop the shuffle's committed map
-        outputs and re-run just the producing map stage (≙ DAGScheduler
-        resubmitting the parent stage on FetchFailed)."""
+    def regenerate_map_stage(mstage: Stage,
+                             map_ids: Optional[List[int]] = None) -> None:
+        """Fetch-failure recovery: drop the shuffle's lost map outputs
+        and re-run the producing map stage (≙ DAGScheduler resubmitting
+        the parent stage on FetchFailed).  When the failure names the
+        exact missing producers (``map_ids``), only THOSE map tasks
+        re-run — a partial re-run that leaves the surviving outputs
+        committed (``map_tasks_rerun`` counts the re-run tasks, so a
+        partial recovery is visibly cheaper than ``n_tasks``)."""
+        tasks = None
+        if map_ids:
+            tasks = sorted(m for m in set(map_ids)
+                           if 0 <= m < mstage.n_tasks)
+            if len(tasks) >= mstage.n_tasks or not tasks:
+                tasks = None  # degenerate subset: full rerun
         sched_m.add("map_stage_reruns", 1)
+        sched_m.add("map_tasks_rerun",
+                    len(tasks) if tasks is not None else mstage.n_tasks)
         trace.emit("map_stage_rerun", stage_id=mstage.stage_id,
-                   shuffle_id=mstage.shuffle_id)
-        manager.invalidate(mstage.shuffle_id)
-        run_stage_tasks(mstage)
+                   shuffle_id=mstage.shuffle_id, map_ids=tasks)
+        manager.invalidate(mstage.shuffle_id, map_ids=tasks)
+        run_stage_tasks(mstage, tasks=tasks)
         n_maps[mstage.shuffle_id] = mstage.n_tasks
 
     def handle_failure(stage: Stage, t: int, exc: BaseException,
-                       attempt: int, regens: int):
+                       attempt: int, regens: int, sleep: bool = True):
         """Classify a failed attempt and perform the recovery
         bookkeeping; returns the (attempt, regens) counters for the
-        next try, or raises when the failure is terminal."""
+        next try, or raises when the failure is terminal.  With
+        ``sleep=False`` (the concurrent runner) the backoff is NOT
+        slept here — the return grows to (attempt, regens, delay_s)
+        and the caller schedules the relaunch, so one flaky task's
+        backoff never stalls the whole stage's polling loop."""
         action = classify(exc)
         if action == FETCH_FAILED:
             sched_m.add("fetch_failures", 1)
@@ -397,8 +442,9 @@ def run_stages(
                     raise TaskRetriesExhausted(
                         stage.stage_id, t, attempt + 1, exc
                     ) from exc
-                regenerate_map_stage(mstage)
-                return attempt, regens  # doesn't consume the retry budget
+                regenerate_map_stage(mstage, map_ids=exc.map_ids)
+                # doesn't consume the retry budget
+                return (attempt, regens) if sleep else (attempt, regens, 0.0)
             # producer unresolvable (e.g. a broadcast read, whose blobs
             # re-register from the driver's copy every attempt): a
             # plain re-run can still succeed, so fall through to RETRY
@@ -416,48 +462,79 @@ def run_stages(
                 sched_m.add("task_timeouts", 1)
                 trace.emit("task_timeout", stage_id=stage.stage_id, task=t,
                            attempt=attempt - 1)
-            policy.sleep_before_retry(stage.stage_id, t, attempt - 1)
-            return attempt, regens
+            if sleep:
+                policy.sleep_before_retry(stage.stage_id, t, attempt - 1)
+                return attempt, regens
+            return attempt, regens, policy.backoff(stage.stage_id, t,
+                                                   attempt - 1)
         raise exc  # FATAL
 
+    def attempt_once(stage: Stage, t: int, attempt: int, register,
+                     progress, scope: Optional[str] = None,
+                     cancel_event=None, on_beat=None) -> List:
+        """ONE attempt of a non-result task, end to end: (re)register
+        this attempt's reduce blocks (pops on read, so every attempt
+        stages afresh; broadcast blobs re-register too), decode a fresh
+        TaskDefinition, drive it, and on failure roll back everything
+        the attempt touched (progress delta, registry heartbeat, staged
+        resources) before re-raising — shared verbatim by the serial
+        retry loop and the concurrent/speculative runner, which passes
+        a ``scope`` so racing attempts read through attempt-scoped
+        resource keys, plus the cancel event and wedge-clock beat."""
+        block_keys, remap = register(t, scope)
+        td, staged = build_attempt_td(stage, t, attempt)
+        sched_m.add("task_attempts", 1)
+        trace.emit("task_attempt_start", stage_id=stage.stage_id,
+                   task=t, attempt=attempt)
+        # progress is cumulative across the stage: a failed attempt's
+        # partial batches must be rolled back or the retry re-counts
+        # them — tracked as a per-attempt DELTA so concurrent sibling
+        # attempts' progress survives the rollback
+        delta = monitor.AttemptProgress(progress)
+        resources = ScopedResources(RESOURCES, remap) if remap else None
+        try:
+            batches: List = []
+            drain(stage, t,
+                  from_proto.run_task(td, task_attempt_id=attempt,
+                                      resources=resources,
+                                      cancel_event=cancel_event,
+                                      on_beat=on_beat),
+                  batches, delta)
+            if cancel_event is not None and cancel_event.is_set():
+                # a cancelled LOSER exits cleanly without consuming
+                # its one-shot registrations — drop them (pop-if-
+                # present, so partially-consumed sets are fine) or a
+                # long-lived speculating process accumulates dead
+                # block/blob entries in the resources map forever
+                for key in staged + block_keys:
+                    RESOURCES.discard(key)
+            trace.emit("task_attempt_end", stage_id=stage.stage_id,
+                       task=t, attempt=attempt, status="ok")
+            return batches
+        except BaseException as exc:
+            delta.discard()
+            # the failed attempt's registry heartbeat goes with it:
+            # a fast retry may never beat again, and a stale
+            # entry's rows would inflate task_rows forever (attempt-
+            # keyed so a concurrent winner's beat is never erased)
+            monitor.task_discard(stage.stage_id, t, attempt=attempt)
+            trace.emit("task_attempt_end", stage_id=stage.stage_id,
+                       task=t, attempt=attempt, status="failed",
+                       error=f"{type(exc).__name__}: {exc}"[:300])
+            for key in staged + block_keys:
+                RESOURCES.discard(key)
+            raise
+
     def run_task_attempts(stage: Stage, t: int, register, progress) -> List:
-        """One non-result task under the retry policy; returns its
-        (side-effect-only, usually empty) batch list."""
+        """One non-result task under the retry policy (the serial
+        path); returns its (side-effect-only, usually empty) batch
+        list."""
         attempt = 0
         regens = 0
         while True:
-            # (re)register this task's reduce blocks — pops on read, so
-            # every attempt gets a fresh registration (broadcast blobs
-            # re-register too: every task re-reads all source blobs)
-            block_keys = register(t)
-            td, staged = build_attempt_td(stage, t, attempt)
-            sched_m.add("task_attempts", 1)
-            trace.emit("task_attempt_start", stage_id=stage.stage_id,
-                       task=t, attempt=attempt)
-            # progress is cumulative across the stage: a failed
-            # attempt's partial batches must be rolled back or the
-            # retry re-counts them (rows double exactly in the failure
-            # scenarios the monitor exists to make trustworthy)
-            mark = progress.mark()
             try:
-                batches: List = []
-                drain(stage, t,
-                      from_proto.run_task(td, task_attempt_id=attempt),
-                      batches, progress)
-                trace.emit("task_attempt_end", stage_id=stage.stage_id,
-                           task=t, attempt=attempt, status="ok")
-                return batches
+                return attempt_once(stage, t, attempt, register, progress)
             except BaseException as exc:
-                progress.rollback(mark)
-                # the failed attempt's registry heartbeat goes with it:
-                # a fast retry may never beat again, and a stale
-                # entry's rows would inflate task_rows forever
-                monitor.task_discard(stage.stage_id, t)
-                trace.emit("task_attempt_end", stage_id=stage.stage_id,
-                           task=t, attempt=attempt, status="failed",
-                           error=f"{type(exc).__name__}: {exc}"[:300])
-                for key in staged + block_keys:
-                    RESOURCES.discard(key)
                 attempt, regens = handle_failure(stage, t, exc, attempt, regens)
 
     def run_result_task(stage: Stage, t: int, register, progress):
@@ -471,7 +548,7 @@ def run_stages(
         attempt = 0
         regens = 0
         while True:
-            block_keys = register(t)
+            block_keys, _ = register(t)
             td, staged = build_attempt_td(stage, t, attempt)
             sched_m.add("task_attempts", 1)
             trace.emit("task_attempt_start", stage_id=stage.stage_id,
@@ -503,12 +580,19 @@ def run_stages(
                     raise  # mid-stream: output already delivered
                 # pre-first-batch failure: replayable, so the failed
                 # attempt's heartbeat entry must not outlive it
-                monitor.task_discard(stage.stage_id, t)
+                monitor.task_discard(stage.stage_id, t, attempt=attempt)
                 attempt, regens = handle_failure(stage, t, exc, attempt, regens)
 
-    def run_stage_tasks(stage: Stage, progress=None) -> None:
-        """Run every task of a non-result stage (also the fetch-recovery
-        re-run path for map stages)."""
+    def run_stage_tasks(stage: Stage, progress=None,
+                        tasks: Optional[List[int]] = None) -> None:
+        """Run tasks of a non-result stage (also the fetch-recovery
+        re-run path for map stages; ``tasks`` restricts a partial
+        re-run to the missing map ids).  With speculation, wedge
+        detection, or ``spark.blaze.stage.taskConcurrency`` > 1 armed,
+        the tasks run under the concurrent attempt runner
+        (runtime/speculation.py); otherwise strictly serially — the
+        deterministic default the fault-injection hit ordering relies
+        on."""
         own_progress = progress is None
         if own_progress:
             # fetch-recovery rerun: runs INSIDE the fetching stage's
@@ -538,9 +622,25 @@ def run_stages(
                 except BaseException as exc:
                     attempt, regens = handle_failure(stage, -1, exc,
                                                      attempt, regens)
-        for t in range(stage.n_tasks):
-            run_task_attempts(stage, t, register, progress)
-            progress.task_done()
+        task_list = list(tasks) if tasks is not None \
+            else list(range(stage.n_tasks))
+        pol = SpeculationPolicy.from_conf()
+        if pol.runner_needed():
+            runner = StageTaskRunner(
+                stage.stage_id, stage.kind, task_list, pol,
+                attempt_fn=lambda t, a, scope, cancel, beat: attempt_once(
+                    stage, t, a, register, progress,
+                    scope=scope, cancel_event=cancel, on_beat=beat),
+                # sleep=False: the runner schedules the backoff itself
+                # so its polling loop keeps resolving sibling tasks
+                on_failure=lambda t, exc, a, r: handle_failure(
+                    stage, t, exc, a, r, sleep=False),
+                progress=progress, metrics=sched_m)
+            runner.run()
+        else:
+            for t in task_list:
+                run_task_attempts(stage, t, register, progress)
+                progress.task_done()
         if own_progress:
             progress.flush(force=True)
 

@@ -1,0 +1,483 @@
+"""Speculative task attempts + wedge detection for the stage scheduler.
+
+The tail-tolerance half of fault tolerance (Dean & Barroso, *The Tail
+at Scale*; MapReduce/Spark backup tasks): retry recovers from tasks
+that FAIL, but a task that merely *straggles* — slow hardware, a lost
+remote dispatch, a wedged kernel — holds the whole stage's p99 hostage
+without ever raising.  This module gives the scheduler:
+
+- a **concurrent attempt runner** (:class:`StageTaskRunner`): a
+  non-result stage's tasks run on a small worker-thread pool instead of
+  strictly serially (conf ``spark.blaze.stage.taskConcurrency``; the
+  serial path remains the default, which keeps fault-injection hit
+  ordering deterministic);
+- **speculation** (conf ``spark.blaze.speculation.*``): once a quantile
+  of the stage's tasks have finished, a task running longer than
+  ``multiplier`` x their median runtime — or whose heartbeat age
+  crosses ``wedgeMs`` — gets ONE backup attempt racing it.  First
+  successful completion wins through the existing attempt-id commit
+  seams (atomic-rename shuffle commit, RSS ``close()``/``abort()``);
+  the loser is cancelled cooperatively and its progress/heartbeat
+  state rolled back exactly (``AttemptProgress.discard`` +
+  ``monitor.task_discard``), so /queries and the event log never count
+  a row twice;
+- **wedge-triggered retry** (conf ``spark.blaze.task.wedgeMs``): with
+  speculation off, a task whose heartbeat age crosses the threshold is
+  cancelled and RETRIED like a timeout — covering the blind spot where
+  the cooperative drain deadline only fires between driver-observed
+  batches, so a task wedged inside its first batch (invisible to
+  ``drain``) was previously unrecoverable.
+
+Every attempt in the concurrent runner reads its one-shot resource
+registrations through a per-attempt ``ScopedResources`` view, so
+concurrent attempts of the same task can never steal each other's
+reduce blocks.  Speculative attempts take ids from
+:data:`SPEC_ATTEMPT_BASE` upward — a distinct numbering from the
+primary's retry counter, which also keeps ``@a0``-gated fault/straggler
+injections from re-firing on the backup.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import math
+import statistics
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from .. import conf
+from . import monitor, trace
+from .retry import FATAL, TaskWedgedError, classify
+
+#: attempt ids for speculative backups start here — far above any
+#: plausible spark.blaze.task.maxAttempts, so primary retry ids and
+#: backup ids can never collide in commit paths keyed on attempt id
+SPEC_ATTEMPT_BASE = 100
+
+#: how long to wait for a cancelled loser to exit cooperatively before
+#: abandoning its thread (it still exits on its own; the stage-end
+#: join below reaps it, and the --chaos/tier-1 leak gates would flag
+#: a truly immortal one)
+_LOSER_JOIN_S = 5.0
+
+
+@dataclass(frozen=True)
+class SpeculationPolicy:
+    """Parsed speculation/wedge/concurrency knobs for one stage run."""
+
+    enabled: bool = False
+    multiplier: float = 1.5
+    quantile: float = 0.75
+    min_runtime: float = 0.1
+    wedge_ms: int = 0
+    task_wedge_ms: int = 0
+    concurrency: int = 1
+
+    @classmethod
+    def from_conf(cls) -> "SpeculationPolicy":
+        return cls(
+            enabled=bool(conf.SPECULATION_ENABLE.get()),
+            multiplier=max(1.0, float(conf.SPECULATION_MULTIPLIER.get())),
+            quantile=min(1.0, max(0.0, float(conf.SPECULATION_QUANTILE.get()))),
+            min_runtime=max(0.0, float(conf.SPECULATION_MIN_RUNTIME.get())),
+            wedge_ms=max(0, int(conf.SPECULATION_WEDGE_MS.get())),
+            task_wedge_ms=max(0, int(conf.TASK_WEDGE_MS.get())),
+            concurrency=max(1, int(conf.STAGE_TASK_CONCURRENCY.get())),
+        )
+
+    def runner_needed(self) -> bool:
+        """Whether the stage needs the concurrent attempt runner at
+        all — the serial loop stays bit-for-bit identical otherwise."""
+        return (self.enabled or self.task_wedge_ms > 0
+                or self.concurrency > 1)
+
+    def quantile_met(self, n_done: int, n_tasks: int) -> bool:
+        return n_done >= max(1, math.ceil(self.quantile * n_tasks))
+
+    def should_speculate(self, runtime_s: float,
+                         done_durations: List[float],
+                         n_tasks: int) -> bool:
+        """Duration trigger: slow relative to completed siblings."""
+        if not self.enabled or not done_durations:
+            return False
+        if not self.quantile_met(len(done_durations), n_tasks):
+            return False
+        if runtime_s < self.min_runtime:
+            return False
+        return runtime_s > self.multiplier * statistics.median(done_durations)
+
+    def is_spec_wedged(self, beat_age_s: float) -> bool:
+        """Wedge trigger for speculation (heartbeat age)."""
+        return (self.enabled and self.wedge_ms > 0
+                and beat_age_s * 1000.0 > self.wedge_ms)
+
+    def is_retry_wedged(self, beat_age_s: float) -> bool:
+        """Wedge trigger for the plain retry path."""
+        return (self.task_wedge_ms > 0
+                and beat_age_s * 1000.0 > self.task_wedge_ms)
+
+
+class _Attempt:
+    """One running attempt of one task, on its own worker thread."""
+
+    __slots__ = ("task", "attempt_id", "speculative", "cancel", "thread",
+                 "started", "last_beat", "done", "error", "ok",
+                 "abandoned")
+
+    def __init__(self, task: int, attempt_id: int, speculative: bool):
+        self.task = task
+        self.attempt_id = attempt_id
+        self.speculative = speculative
+        self.cancel = threading.Event()
+        self.thread: Optional[threading.Thread] = None
+        self.started = time.monotonic()
+        self.last_beat = self.started
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.ok = False
+        self.abandoned = False
+
+    def beat(self) -> None:
+        self.last_beat = time.monotonic()
+
+    def beat_age(self, now: float) -> float:
+        return now - max(self.started, self.last_beat)
+
+    def runtime(self, now: float) -> float:
+        return now - self.started
+
+
+class _TaskState:
+    """Driver-side state of one task under the runner."""
+
+    __slots__ = ("task", "attempt_no", "regens", "primary", "backup",
+                 "pending_error", "finished", "speculated",
+                 "relaunch_at")
+
+    def __init__(self, task: int):
+        self.task = task
+        self.attempt_no = 0       # primary retry counter
+        self.regens = 0
+        self.primary: Optional[_Attempt] = None
+        self.backup: Optional[_Attempt] = None
+        self.pending_error: Optional[BaseException] = None
+        self.finished = False
+        self.speculated = False   # one backup per task, ever
+        #: monotonic time a backoff-deferred relaunch becomes due
+        #: (None = no relaunch pending)
+        self.relaunch_at: Optional[float] = None
+
+
+class StageTaskRunner:
+    """Drives one stage's tasks concurrently with speculation/wedge
+    handling.  The scheduler supplies the attempt body and the failure
+    classifier as closures, so retry semantics (budget, backoff,
+    fetch-failure map-stage regeneration) stay single-sourced in
+    ``run_stages``.
+
+    ``attempt_fn(t, attempt_id, scope, cancel_event, on_beat)`` runs
+    ONE attempt to completion (raises on failure); ``on_failure(t, exc,
+    attempt, regens) -> (attempt, regens)`` performs the recovery
+    bookkeeping or raises when terminal (the scheduler's
+    ``handle_failure``).
+    """
+
+    def __init__(self, stage_id: int, kind: str, tasks: List[int],
+                 policy: SpeculationPolicy,
+                 attempt_fn: Callable, on_failure: Callable,
+                 progress, metrics) -> None:
+        self.stage_id = stage_id
+        self.kind = kind
+        self.tasks = list(tasks)
+        self.policy = policy
+        self.attempt_fn = attempt_fn
+        self.on_failure = on_failure
+        self.progress = progress
+        self.metrics = metrics
+        self.durations: List[float] = []   # successful task durations
+        self._abandoned: List[_Attempt] = []
+
+    # ------------------------------------------------------ attempts
+
+    def _spawn(self, state: _TaskState, attempt_id: int,
+               speculative: bool) -> _Attempt:
+        att = _Attempt(state.task, attempt_id, speculative)
+        scope = f"#s{state.task}a{attempt_id}"
+
+        def body() -> None:
+            try:
+                self.attempt_fn(state.task, attempt_id, scope,
+                                att.cancel, att.beat)
+                att.ok = True
+            except BaseException as exc:  # noqa: BLE001 — driver classifies
+                att.error = exc
+            finally:
+                att.done.set()
+
+        # run in a COPY of the driver's context: the monitor registry
+        # attaches beats/progress to the current query via a
+        # ContextVar, and a bare Thread starts with an empty context —
+        # the attempt's heartbeats would silently detach from /queries
+        cctx = contextvars.copy_context()
+        att.thread = threading.Thread(
+            target=cctx.run, args=(body,), daemon=True,
+            name=f"blaze-attempt-{self.stage_id}-{state.task}-a{attempt_id}")
+        att.thread.start()
+        return att
+
+    def _reap_loser(self, state: _TaskState, loser: _Attempt) -> None:
+        """Cancel a losing/wedged attempt, wait for its cooperative
+        exit, and roll its observable state back: the winner's commit
+        already stands, so everything the loser touched (registry
+        heartbeat entry) must go.  Progress deltas are discarded by
+        the attempt body itself on failure; a loser that COMPLETED
+        produced no driver-visible batches on map/broadcast stages,
+        and its committed output is byte-identical to the winner's."""
+        loser.cancel.set()
+        loser.thread.join(timeout=_LOSER_JOIN_S)
+        if loser.thread.is_alive():
+            # wedged past cooperation: reaped at stage end; its scoped
+            # resource registrations keep it isolated meanwhile
+            loser.abandoned = True
+            self._abandoned.append(loser)
+            return
+        # joined: no later beat can resurrect the entry we drop here
+        monitor.task_discard(self.stage_id, state.task,
+                             attempt=loser.attempt_id)
+
+    def _resolve_speculation(self, state: _TaskState,
+                             winner: _Attempt) -> None:
+        """A task with a live backup finished: emit won/lost, reap the
+        loser, and record the race outcome."""
+        backup = state.backup
+        primary = state.primary
+        if backup is None:
+            return
+        if winner is backup:
+            self.metrics.add("speculative_won", 1)
+            trace.emit("speculative_attempt_won", stage_id=self.stage_id,
+                       task=state.task, attempt=backup.attempt_id)
+            loser = primary
+        else:
+            self.metrics.add("speculative_lost", 1)
+            trace.emit("speculative_attempt_lost", stage_id=self.stage_id,
+                       task=state.task, attempt=backup.attempt_id)
+            loser = backup
+        if loser is not None:
+            if loser.done.is_set():
+                # already finished (both resolved in one poll window):
+                # nothing to cancel, but its registry beat entry —
+                # if it wrote the slot last — still goes
+                monitor.task_discard(self.stage_id, state.task,
+                                     attempt=loser.attempt_id)
+            else:
+                self._reap_loser(state, loser)
+        state.backup = None
+
+    def _launch_backup(self, state: _TaskState, reason: str) -> None:
+        state.speculated = True
+        attempt_id = SPEC_ATTEMPT_BASE + state.attempt_no
+        self.metrics.add("speculative_attempts", 1)
+        trace.emit("speculative_attempt_start", stage_id=self.stage_id,
+                   task=state.task, attempt=attempt_id, reason=reason)
+        state.backup = self._spawn(state, attempt_id, speculative=True)
+
+    # -------------------------------------------------------- driving
+
+    def _finish_task(self, state: _TaskState, winner: _Attempt) -> None:
+        self.durations.append(winner.runtime(time.monotonic()))
+        self._resolve_speculation(state, winner)
+        state.finished = True
+        self.progress.task_done()
+
+    def _handle_primary_failure(self, state: _TaskState,
+                                exc: BaseException) -> None:
+        """Primary attempt failed with no backup to hope for: run the
+        scheduler's recovery bookkeeping (may raise terminal) and
+        relaunch — immediately, or deferred by the backoff delay the
+        policy returns (slept by the POLL LOOP's cadence, not inline,
+        so one flaky task's backoff never stalls sibling resolution)."""
+        state.primary = None
+        state.attempt_no, state.regens, delay = self.on_failure(
+            state.task, exc, state.attempt_no, state.regens)
+        if delay > 0:
+            state.relaunch_at = time.monotonic() + delay
+        else:
+            state.primary = self._spawn(state, state.attempt_no,
+                                        speculative=False)
+
+    def _check_one(self, state: _TaskState, now: float) -> None:
+        primary, backup = state.primary, state.backup
+
+        # backoff-deferred relaunch come due
+        if state.relaunch_at is not None and primary is None:
+            if now < state.relaunch_at:
+                return
+            state.relaunch_at = None
+            state.primary = self._spawn(state, state.attempt_no,
+                                        speculative=False)
+            return
+
+        # resolve completions (backup first: if both finished in one
+        # poll window, the commit seams make either order safe — the
+        # outputs are byte-identical — but preferring the backup keeps
+        # the won/lost accounting deterministic in tests where the
+        # straggling primary is known-slower)
+        for att in (backup, primary):
+            if att is None or not att.done.is_set() or state.finished:
+                continue
+            # a cancelled attempt that exited cleanly is a reaped
+            # loser, never a winner — it may not have committed
+            if att.ok and not att.cancel.is_set():
+                self._finish_task(state, att)
+                return
+        if state.finished:
+            return
+
+        # failed attempts
+        if backup is not None and backup.done.is_set() and not backup.ok:
+            # a failed backup never consumes the primary's retry
+            # budget — it was a bet, not an attempt the task owed
+            self.metrics.add("speculative_lost", 1)
+            trace.emit("speculative_attempt_lost", stage_id=self.stage_id,
+                       task=state.task, attempt=backup.attempt_id)
+            monitor.task_discard(self.stage_id, state.task,
+                                 attempt=backup.attempt_id)
+            state.backup = None
+        if primary is not None and primary.done.is_set() and not primary.ok:
+            exc = primary.error
+            if state.backup is not None:
+                if classify(exc) == FATAL:
+                    raise exc  # engine bug/interrupt: no race saves it
+                # retryable with a live backup: hold the error, the
+                # backup may win the task anyway
+                state.pending_error = exc
+                state.primary = None
+            else:
+                self._handle_primary_failure(state, exc)
+            return
+        if (state.primary is None and state.backup is None
+                and state.pending_error is not None):
+            exc, state.pending_error = state.pending_error, None
+            self._handle_primary_failure(state, exc)
+            return
+
+        # a backup running ALONE (its primary already failed) can wedge
+        # too — with task.wedgeMs armed it gets the same cancel+fail
+        # treatment, resolving lost so the race stays reconciled, and
+        # the pending-error path relaunches the primary
+        backup = state.backup
+        if (state.primary is None and backup is not None
+                and not backup.done.is_set()
+                and self.policy.is_retry_wedged(backup.beat_age(now))):
+            self.metrics.add("speculative_lost", 1)
+            trace.emit("speculative_attempt_lost", stage_id=self.stage_id,
+                       task=state.task, attempt=backup.attempt_id)
+            self._reap_loser(state, backup)
+            state.backup = None
+            return
+
+        # a SYSTEMIC wedge (hung device, stuck IO) can stall primary
+        # AND backup at once — the race can never resolve itself, so
+        # with task.wedgeMs armed both are reaped and the task retried
+        primary, backup = state.primary, state.backup
+        if (primary is not None and backup is not None
+                and not primary.done.is_set() and not backup.done.is_set()
+                and self.policy.is_retry_wedged(primary.beat_age(now))
+                and self.policy.is_retry_wedged(backup.beat_age(now))):
+            self.metrics.add("speculative_lost", 1)
+            trace.emit("speculative_attempt_lost", stage_id=self.stage_id,
+                       task=state.task, attempt=backup.attempt_id)
+            self._reap_loser(state, backup)
+            state.backup = None
+            self._reap_loser(state, primary)
+            state.primary = None
+            self._handle_primary_failure(state, TaskWedgedError(
+                f"task {state.task} of stage {self.stage_id}: primary and "
+                f"backup heartbeat ages both exceeded "
+                f"{self.policy.task_wedge_ms}ms"))
+            return
+
+        # stragglers/wedges (primary still running, no live backup)
+        if primary is None or primary.done.is_set() \
+                or state.backup is not None:
+            return
+        age = primary.beat_age(now)
+        can_speculate = (not state.speculated and self.policy.enabled
+                         and self.kind != "result")
+        if can_speculate and self.policy.is_spec_wedged(age):
+            self._launch_backup(state, "wedged")
+        elif can_speculate and self.policy.should_speculate(
+                primary.runtime(now), self.durations, len(self.tasks)):
+            self._launch_backup(state, "slow")
+        elif self.policy.is_retry_wedged(age):
+            # wedge-triggered retry: cancel and fail the attempt as
+            # the timeout it behaviorally is.  This fires whenever
+            # task.wedgeMs is armed and speculation CANNOT act on the
+            # wedge instead (disabled, backup already spent, result
+            # stage, or speculation's own wedge trigger off) — a
+            # wedged task must never hang the stage just because
+            # speculation was enabled.
+            self._reap_loser(state, primary)
+            state.primary = None
+            self._handle_primary_failure(state, TaskWedgedError(
+                f"task {state.task} of stage {self.stage_id} heartbeat "
+                f"age exceeded {self.policy.task_wedge_ms}ms"))
+
+    def run(self) -> None:
+        states = [_TaskState(t) for t in self.tasks]
+        pending = list(states)
+        running: List[_TaskState] = []
+        poll_ms = [self.policy.wedge_ms, self.policy.task_wedge_ms]
+        # capped at 50ms: the wait below watches ONE attempt's done
+        # event, so the poll cadence bounds how late any OTHER
+        # attempt's completion (or a deferred relaunch) is noticed —
+        # a large wedge threshold must not inflate that latency
+        poll_s = min([max(5, m) / 4000.0 for m in poll_ms if m > 0]
+                     + [0.05])
+        try:
+            while pending or running:
+                while pending and len(running) < self.policy.concurrency:
+                    st = pending.pop(0)
+                    st.primary = self._spawn(st, st.attempt_no,
+                                             speculative=False)
+                    running.append(st)
+                now = time.monotonic()
+                for st in list(running):
+                    self._check_one(st, now)
+                    if st.finished:
+                        running.remove(st)
+                if running:
+                    # wake as soon as anything resolves, bounded by the
+                    # wedge-poll cadence
+                    attempts = [a for st in running
+                                for a in (st.primary, st.backup)
+                                if a is not None]
+                    if attempts and not any(a.done.is_set()
+                                            for a in attempts):
+                        attempts[0].done.wait(poll_s)
+                    elif not attempts:
+                        # every running task is backoff-deferred: pace
+                        # the loop instead of busy-spinning to the due
+                        # time
+                        time.sleep(poll_s)
+        except BaseException:
+            # terminal: cancel every in-flight attempt cooperatively
+            # before propagating, so no thread outlives the stage
+            for st in running:
+                for att in (st.primary, st.backup):
+                    if att is not None and att.thread is not None:
+                        att.cancel.set()
+            for st in running:
+                for att in (st.primary, st.backup):
+                    if att is not None and att.thread is not None:
+                        att.thread.join(timeout=_LOSER_JOIN_S)
+            raise
+        finally:
+            for att in self._abandoned:
+                att.thread.join(timeout=_LOSER_JOIN_S)
+                if not att.thread.is_alive():
+                    monitor.task_discard(self.stage_id, att.task,
+                                         attempt=att.attempt_id)

@@ -63,9 +63,11 @@ EVENT_TYPES = frozenset({
     "task_attempt_start", "task_attempt_end",
     "task_retry", "task_timeout",
     "fetch_failure", "map_stage_rerun",
+    "speculative_attempt_start",
+    "speculative_attempt_won", "speculative_attempt_lost",
     "task_kernels", "task_plan",
     "stage_progress", "task_heartbeat",
-    "fault_injected",
+    "fault_injected", "straggler_injected",
     "mem_watermark", "spill",
     "shuffle_write", "shuffle_fetch", "rss_push",
 })

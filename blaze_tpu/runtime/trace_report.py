@@ -70,6 +70,46 @@ def reconcile_faults(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def reconcile_speculation(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pair every ``speculative_attempt_start`` with a subsequent
+    ``speculative_attempt_won`` / ``_lost`` for the same (stage, task,
+    attempt) — the chaos gate's speculation contract: a backup that
+    was launched but never resolved means a leaked race (its thread,
+    its progress rollback, or its commit arbitration never finished).
+    A log with no speculation events reconciles trivially."""
+    outcomes = ("speculative_attempt_won", "speculative_attempt_lost")
+    unpaired: List[Dict[str, Any]] = []
+    pairs: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
+    for i, e in enumerate(events):
+        if e.get("type") != "speculative_attempt_start":
+            continue
+        key = (e.get("stage_id"), e.get("task"), e.get("attempt"))
+        match: Optional[Dict[str, Any]] = None
+        for j in range(i + 1, len(events)):
+            f = events[j]
+            if f.get("type") in outcomes and (
+                    f.get("stage_id"), f.get("task"),
+                    f.get("attempt")) == key:
+                match = f
+                break
+        if match is None:
+            unpaired.append(e)
+        else:
+            pairs.append((e, match))
+    won = sum(1 for e in events
+              if e.get("type") == "speculative_attempt_won")
+    lost = sum(1 for e in events
+               if e.get("type") == "speculative_attempt_lost")
+    return {
+        "speculated": len(pairs) + len(unpaired),
+        "won": won,
+        "lost": lost,
+        "pairs": pairs,
+        "unpaired": unpaired,
+        "reconciled": not unpaired,
+    }
+
+
 def _merge_plan(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
     """Sum two task_plan trees node-by-node (same stage => same plan
     shape; a rewritten/retried plan that differs structurally keeps the
@@ -210,8 +250,10 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
     rec = reconcile_faults(events)
-    timeline_types = {"fault_injected", "fetch_failure", "task_retry",
-                      "task_timeout", "map_stage_rerun"}
+    timeline_types = {"fault_injected", "straggler_injected",
+                      "fetch_failure", "task_retry", "task_timeout",
+                      "map_stage_rerun", "speculative_attempt_start",
+                      "speculative_attempt_won", "speculative_attempt_lost"}
     incidents = sorted(
         [e for e in events if e.get("type") in timeline_types]
         + [e for e in t.get("task_attempt_end", [])
@@ -381,8 +423,10 @@ def render(events: List[Dict[str, Any]]) -> str:
                          f"of {wm[-1].get('total', 0)} B budget")
 
     # ---- retry / fault timeline
-    timeline_types = {"fault_injected", "fetch_failure", "task_retry",
-                      "task_timeout", "map_stage_rerun"}
+    timeline_types = {"fault_injected", "straggler_injected",
+                      "fetch_failure", "task_retry", "task_timeout",
+                      "map_stage_rerun", "speculative_attempt_start",
+                      "speculative_attempt_won", "speculative_attempt_lost"}
     incidents = [e for e in events if e.get("type") in timeline_types]
     incidents += [e for e in t.get("task_attempt_end", [])
                   if e.get("status") == "failed"]

@@ -382,12 +382,21 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
     raise NotImplementedError(f"from_proto node {kind}")
 
 
-def run_task(task_def_bytes: bytes, task_attempt_id: int = 0):
+def run_task(task_def_bytes: bytes, task_attempt_id: int = 0,
+             resources=None, cancel_event=None, on_beat=None):
     """Decode a TaskDefinition and drive its plan for its partition —
     the python mirror of the gateway's callNative entry
     (≙ blaze/src/exec.rs:46-142).  ``task_attempt_id`` threads the
     scheduler's attempt counter into the TaskContext (and the fault
-    injector), so retried attempts are distinguishable at every site."""
+    injector), so retried attempts are distinguishable at every site.
+
+    Speculation/wedge plumbing (runtime/speculation.py): ``resources``
+    swaps in a per-attempt ScopedResources view so concurrent attempts
+    of one task never steal each other's one-shot registrations,
+    ``cancel_event`` lets the driver cancel a losing attempt
+    cooperatively, and ``on_beat`` is a liveness callback fired at the
+    heartbeat cadence from inside the plan drive — the wedge detector's
+    clock, armed even when tracing and the monitor are off."""
     from ..runtime import faults
     from ..runtime.context import TaskContext
 
@@ -405,16 +414,18 @@ def run_task(task_def_bytes: bytes, task_attempt_id: int = 0):
     ctx = TaskContext(
         td.partition, max(plan.num_partitions(), td.partition + 1),
         stage_id=td.stage_id, task_attempt_id=task_attempt_id,
+        resources=resources, cancel_event=cancel_event,
     )
     stream = plan.execute(td.partition, ctx)
     from ..runtime import monitor, trace
 
-    if not trace.enabled() and not monitor.enabled():
+    if not trace.enabled() and not monitor.enabled() and on_beat is None:
         return stream
-    return _instrumented_task_stream(stream, plan, td, task_attempt_id)
+    return _instrumented_task_stream(stream, plan, td, task_attempt_id,
+                                     on_beat=on_beat)
 
 
-def _instrumented_task_stream(stream, plan, td, attempt: int):
+def _instrumented_task_stream(stream, plan, td, attempt: int, on_beat=None):
     """Observability-armed task drive.  With tracing armed, a kernel
     capture attributes every XLA program issued while this attempt runs
     to its operator label, and on completion the attempt emits its
@@ -455,6 +466,10 @@ def _instrumented_task_stream(stream, plan, td, attempt: int):
         # (per-operator rows/timers so far) — output_rows there counts
         # every operator boundary, so the chain-depth-independent live
         # row count is progress_rows: the widest single node's rows
+        if on_beat is not None:
+            on_beat()
+        if not traced and not mon:
+            return  # wedge-clock-only arming: no snapshot walk owed
         metrics: dict = {}
         progress_rows = _tree_metrics(plan, metrics, 0)
         now = _time.perf_counter_ns()

@@ -362,6 +362,14 @@ def _convert_agg(node: SparkNode, ctx: ConversionContext) -> ExecNode:
     for g in node.expr_list("groupingExpressions"):
         e, n = _named_expr(g)
         groupings.append(GroupingExpr(e, n))
+    if not agg_exprs and not groupings:
+        # a DISTINCT stage has groupings; a global agg has aggregate
+        # expressions; BOTH empty only happens when a degraded dump
+        # nulled the field — converting would mint a zero-column agg
+        # that silently produces nothing (fuzz-pinned)
+        raise UnsupportedSparkExec(
+            f"{node.name} with neither grouping nor aggregate "
+            f"expressions (gutted dump field?)")
     aggs = [_agg_function(a) for a in agg_exprs]
     if mode is _COMPLETE:
         partial = AggExec(child, AggMode.PARTIAL, groupings, aggs)
@@ -383,6 +391,14 @@ def _convert_agg(node: SparkNode, ctx: ConversionContext) -> ExecNode:
             supports_partial_skipping=(mode == AggMode.PARTIAL and bool(aggs)),
         )
     if mode in (AggMode.FINAL,):
+        if ("resultExpressions" in node.fields
+                and node.fields["resultExpressions"] is None):
+            # required in catalyst; null only happens in a degraded
+            # dump — converting anyway would silently drop the result
+            # projection and rename (fuzz-pinned)
+            raise UnsupportedSparkExec(
+                f"{node.name} FINAL with resultExpressions degraded "
+                f"to null")
         res = node.expr_list("resultExpressions")
         if res:
             exprs, names = [], []

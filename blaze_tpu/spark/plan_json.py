@@ -34,6 +34,16 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Union
 
 
+class CatalystParseError(ValueError):
+    """A catalyst ``toJSON`` dump that cannot be parsed/converted into
+    a plan — the TYPED rejection the dump-ingestion seam guarantees: a
+    malformed, truncated, or semantically gutted dump either produces
+    an equivalent plan or raises THIS (or an Unsupported* fallback
+    signal), never an arbitrary crash and never a silently wrong plan.
+    Subclasses ValueError so pre-existing callers catching the parser's
+    historical ValueError keep working."""
+
+
 @dataclass
 class SparkNode:
     """One catalyst tree node: plan operator or expression."""
@@ -98,7 +108,8 @@ def _parse_tree(flat: List[Dict[str, Any]]) -> SparkNode:
     def build() -> SparkNode:
         nonlocal pos
         if pos >= len(flat):
-            raise ValueError("malformed catalyst JSON: truncated node array")
+            raise CatalystParseError(
+                "malformed catalyst JSON: truncated node array")
         obj = flat[pos]
         pos += 1
         n_children = int(obj.get("num-children", 0))
@@ -112,7 +123,7 @@ def _parse_tree(flat: List[Dict[str, Any]]) -> SparkNode:
 
     root = build()
     if pos != len(flat):
-        raise ValueError(
+        raise CatalystParseError(
             f"malformed catalyst JSON: consumed {pos} of {len(flat)} nodes"
         )
     return root
@@ -123,5 +134,6 @@ def parse_plan_json(text: Union[str, List[Dict[str, Any]]]) -> SparkNode:
     into a :class:`SparkNode` tree."""
     flat = json.loads(text) if isinstance(text, str) else text
     if not isinstance(flat, list) or not flat:
-        raise ValueError("catalyst toJSON must be a non-empty JSON array")
+        raise CatalystParseError(
+            "catalyst toJSON must be a non-empty JSON array")
     return _parse_tree(flat)

@@ -145,12 +145,22 @@ def convert_spark_plan(
     per node) and memoizes per subquery plan."""
     from .expr_converter import SUBQUERY_RESOLVER
 
+    from .plan_json import CatalystParseError
+
     forced: Set[int] = set()
     for _ in range(16):  # fixpoint ≙ removeInefficientConverts loop
         sctx = _StrategyContext(ctx, forced)
         token = SUBQUERY_RESOLVER.set(sctx._resolve_subquery)
         try:
             plan = sctx.convert(root)
+        except (KeyError, TypeError, AttributeError, IndexError) as e:
+            # a converter tripping over a gutted/degraded dump field is
+            # a PARSE failure of the ingested JSON, not an engine
+            # crash: surface it typed so callers at the Spark seam can
+            # reject the dump (the fuzz suite pins this contract)
+            raise CatalystParseError(
+                f"catalyst dump rejected during conversion: "
+                f"{type(e).__name__}: {e}") from e
         finally:
             SUBQUERY_RESOLVER.reset(token)
         added = _inefficient_converts(root, sctx.tags, forced)

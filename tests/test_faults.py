@@ -10,6 +10,7 @@ run, and checks the retry/fetch counters in the scheduler metrics.
 
 import os
 import struct
+import time
 
 import numpy as np
 import pytest
@@ -71,21 +72,56 @@ def _inject(spec: str) -> None:
 # ------------------------------------------------------ registry unit tests
 
 def test_spec_parse_format_roundtrip():
-    rules = faults.parse_spec("shuffle.fetch@2,task.compute@1@a0")
-    assert rules == [("shuffle.fetch", 2, None), ("task.compute", 1, 0)]
+    rules = faults.parse_spec(
+        "shuffle.fetch@2,task.compute@1@a0,shuffle.write@1@a0@slow250")
+    assert rules == [("shuffle.fetch", 2, None, None),
+                     ("task.compute", 1, 0, None),
+                     ("shuffle.write", 1, 0, 250)]
     assert faults.parse_spec(faults.format_spec(rules)) == rules
+    # modifier order is free: slow before attempt parses the same
+    assert faults.parse_spec("shuffle.write@1@slow250@a0") == \
+        [("shuffle.write", 1, 0, 250)]
     with pytest.raises(ValueError, match="unknown fault site"):
         faults.parse_spec("bogus.site@1")
     with pytest.raises(ValueError, match="bad fault spec"):
         faults.parse_spec("task.compute")
+    with pytest.raises(ValueError, match="bad modifier"):
+        faults.parse_spec("task.compute@1@x3")
+    with pytest.raises(ValueError, match="duplicate slow"):
+        faults.parse_spec("task.compute@1@slow5@slow6")
 
 
 def test_random_spec_deterministic():
     assert faults.random_spec(42) == faults.random_spec(42)
     assert faults.random_spec(42) != faults.random_spec(43)
-    for site, _, attempt in faults.parse_spec(faults.random_spec(42)):
+    for site, _, attempt, slow_ms in faults.parse_spec(faults.random_spec(42)):
         assert site in faults.SITES
         assert attempt == 0  # recoverable by construction
+        assert slow_ms is None
+    # straggler entries: seeded latency, ungated (the one-shot hit
+    # counter guarantees the delay is paid exactly once either way)
+    spec = faults.random_spec(42, n_stragglers=2)
+    assert spec == faults.random_spec(42, n_stragglers=2)
+    slows = [r for r in faults.parse_spec(spec) if r[3] is not None]
+    assert slows and all(a is None for _, _, a, _ in slows)
+    assert all(250 <= ms <= 600 for _, _, _, ms in slows)
+
+
+def test_straggler_rule_sleeps_instead_of_raising():
+    import time as _time
+
+    inj = faults.FaultInjector(faults.parse_spec("task.compute@1@a0@slow80"))
+    t0 = _time.monotonic()
+    inj.hit("task.compute", attempt=0)  # matching hit: sleeps, no raise
+    assert _time.monotonic() - t0 >= 0.07
+    t0 = _time.monotonic()
+    inj.hit("task.compute", attempt=0)  # hit 2: rule already passed
+    assert _time.monotonic() - t0 < 0.05
+    # attempt-gated: a backup attempt (different id) never pays it
+    inj2 = faults.FaultInjector(faults.parse_spec("task.compute@1@a0@slow80"))
+    t0 = _time.monotonic()
+    inj2.hit("task.compute", attempt=100)
+    assert _time.monotonic() - t0 < 0.05
 
 
 def test_injector_nth_hit_and_attempt_gate():
@@ -366,6 +402,75 @@ def test_rss_push_fault_aborts_then_retry_commits_identically():
         pass
     assert w1.closed
     assert w1.partitions == ref.partitions
+
+
+def test_rss_concurrent_attempt_race_single_committed_writer():
+    """Speculation race through the RSS attempt-id seam: two concurrent
+    attempts of the SAME map task push through RssPartitionWriterBase
+    (each reading its writer through an attempt-scoped resource view,
+    exactly as the speculative runner stages them), the straggling
+    loser is cancelled and ``abort()``s, and the reduce side sees
+    exactly ONE committed attempt, byte-identical to an undisturbed
+    run."""
+    import threading as _threading
+
+    from blaze_tpu.exprs import col
+    from blaze_tpu.parallel.rss import LocalRssWriter, RssShuffleWriterExec
+    from blaze_tpu.parallel.shuffle import HashPartitioning
+    from blaze_tpu.runtime.context import ScopedResources
+
+    rng = np.random.RandomState(23)
+    schema = Schema([
+        Field("l_orderkey", DataType.int64()),
+        Field("l_extendedprice", DataType.int64()),
+    ])
+    # several batches so the loser hits a cancellation checkpoint
+    # between pushes (cancellation is cooperative, per batch)
+    batches = [
+        batch_from_pydict({
+            "l_orderkey": [int(v) for v in rng.randint(1, 200, 100)],
+            "l_extendedprice": [int(v) for v in rng.randint(100, 9999, 100)],
+        }, schema)
+        for _ in range(4)
+    ]
+    scan = MemoryScanExec([list(batches)], schema)
+    node = RssShuffleWriterExec(
+        scan, HashPartitioning([col("l_orderkey")], 3), "rss_race")
+
+    def drive(ctx):
+        for _ in node.execute(0, ctx):
+            pass
+
+    # undisturbed reference commit
+    ref = LocalRssWriter()
+    RESOURCES.put("rss_race.0", ref)
+    drive(TaskContext(0, 1))
+    assert ref.closed and ref.partitions
+
+    # attempt 0 straggles on its first push; attempt 100 (speculative
+    # id range) runs clean — each pops its OWN scoped registration
+    _inject("rss.push@1@a0@slow400")
+    w0, w1 = LocalRssWriter(), LocalRssWriter()
+    RESOURCES.put("rss_race.0#a0", w0)
+    RESOURCES.put("rss_race.0#a100", w1)
+    cancel0 = _threading.Event()
+    ctx0 = TaskContext(0, 1, task_attempt_id=0, cancel_event=cancel0,
+                       resources=ScopedResources(
+                           RESOURCES, {"rss_race.0": "rss_race.0#a0"}))
+    ctx1 = TaskContext(0, 1, task_attempt_id=100,
+                       resources=ScopedResources(
+                           RESOURCES, {"rss_race.0": "rss_race.0#a100"}))
+    t0 = _threading.Thread(target=drive, args=(ctx0,), daemon=True)
+    t0.start()
+    time.sleep(0.05)          # let the loser enter its straggling push
+    drive(ctx1)               # the backup races past it and commits
+    assert w1.closed and w1.partitions == ref.partitions
+    cancel0.set()             # first commit won: cancel the loser
+    t0.join(timeout=10)
+    assert not t0.is_alive()
+    # loser aborted: closed WITHOUT committing — nothing of its partial
+    # push set may ever reach the reduce barrier
+    assert w0.closed and w0.partitions == {}
 
 
 # ------------------------------------------------------ spill / write abort

@@ -1,0 +1,165 @@
+"""Catalyst-dump parser fuzzing over the LIVE Spark 3.5.1 q6 dump.
+
+The Spark seam's contract (ROADMAP item 5): a ``toJSON`` dump mutated
+the ways real-world serialization drift mutates it — field-order
+shuffles, optionals degraded to null, unknown/extra fields — must
+either parse+convert to a plan EQUIVALENT to the unmutated one, or be
+rejected with a TYPED parse error (``CatalystParseError`` /
+``Unsupported*``) — never an arbitrary crash (KeyError/AttributeError
+escaping the seam) and never a silently different plan.
+"""
+
+import copy
+import json
+import os
+import random
+
+import pytest
+
+from blaze_tpu.spark import BlazeSparkSession
+from blaze_tpu.spark.converters import UnsupportedSparkExec
+from blaze_tpu.spark.expr_converter import UnsupportedSparkExpr
+from blaze_tpu.spark.plan_json import CatalystParseError, parse_plan_json
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "spark351_q6_plan.json")
+
+#: the full typed-rejection surface of the dump-ingestion seam; every
+#: other exception type escaping session.plan() is a crash (= failure)
+TYPED_ERRORS = (CatalystParseError, UnsupportedSparkExec,
+                UnsupportedSparkExpr, NotImplementedError)
+
+
+def _load_flat():
+    with open(FIXTURE) as f:
+        return json.load(f)
+
+
+def _session():
+    """Schema-only catalog (no datagen): the fuzz contract is about
+    plan construction, not execution."""
+    from blaze_tpu.ops import MemoryScanExec
+    from blaze_tpu.tpch import TPCH_SCHEMAS
+
+    sess = BlazeSparkSession(default_parallelism=2)
+    sess.register_table(
+        "lineitem", MemoryScanExec([[], []], TPCH_SCHEMAS["lineitem"]))
+    return sess
+
+
+def _plan_fingerprint(sess, flat):
+    """Structural identity of the converted plan (tree shape + schema;
+    the 'equivalent plan' comparator)."""
+    plan = sess.plan(parse_plan_json(copy.deepcopy(flat)))
+    return plan.tree_string(), tuple(
+        (f.name, str(f.dtype)) for f in plan.schema.fields)
+
+
+def _assert_equivalent_or_typed_error(sess, baseline, mutated, what):
+    try:
+        got = _plan_fingerprint(sess, mutated)
+    except TYPED_ERRORS:
+        return  # typed rejection: acceptable outcome
+    except Exception as e:  # noqa: BLE001 — the contract under test
+        pytest.fail(f"{what}: untyped crash {type(e).__name__}: {e}")
+    assert got == baseline, f"{what}: silently different plan"
+
+
+def test_fixture_parses_and_converts():
+    sess = _session()
+    tree, schema = _plan_fingerprint(sess, _load_flat())
+    assert "AggExec" in tree
+    assert [n for n, _ in schema] == ["revenue"]
+
+
+def test_field_order_shuffles_parse_equivalently():
+    """Catalyst's jsonValue emits constructor-parameter order; nothing
+    in the contract promises it.  Re-ordering every node object's keys
+    (several seeds) must not change the plan."""
+    sess = _session()
+    flat = _load_flat()
+    baseline = _plan_fingerprint(sess, flat)
+    for seed in range(5):
+        rng = random.Random(seed)
+        shuffled = []
+        for obj in copy.deepcopy(flat):
+            keys = list(obj)
+            rng.shuffle(keys)
+            shuffled.append({k: obj[k] for k in keys})
+        _assert_equivalent_or_typed_error(
+            sess, baseline, shuffled, f"key-shuffle seed {seed}")
+        # a shuffle is benign BY CONSTRUCTION: it must actually parse
+        assert _plan_fingerprint(sess, shuffled) == baseline
+
+
+def test_unknown_fields_are_ignored():
+    """A newer Spark minor adding constructor params must not break
+    ingestion of otherwise-identical dumps."""
+    sess = _session()
+    flat = _load_flat()
+    baseline = _plan_fingerprint(sess, flat)
+    mutated = copy.deepcopy(flat)
+    for i, obj in enumerate(mutated):
+        obj[f"__future_param_{i}"] = {"product-class": "x.y.New$", "n": i}
+        obj["__another"] = None
+    assert _plan_fingerprint(sess, mutated) == baseline
+
+
+def test_nulled_fields_equivalent_or_typed_error():
+    """Field-by-field null degradation (catalyst emits null for every
+    type its serializer cannot encode): each single-field null must
+    yield an equivalent plan or a typed rejection — never a crash,
+    never a silently different plan."""
+    sess = _session()
+    flat = _load_flat()
+    baseline = _plan_fingerprint(sess, flat)
+    checked = 0
+    for i, obj in enumerate(flat):
+        for key in obj:
+            if key in ("class", "num-children") or obj[key] is None:
+                continue
+            mutated = copy.deepcopy(flat)
+            mutated[i][key] = None
+            _assert_equivalent_or_typed_error(
+                sess, baseline, mutated,
+                f"null {obj['class'].rsplit('.', 1)[-1]}[{i}].{key}")
+            checked += 1
+    assert checked > 30  # the dump really was swept field-by-field
+
+
+def test_truncated_and_structural_damage_is_typed():
+    """Structural damage — truncated node array, surplus nodes, child
+    counts pointing past the end — must raise the typed parse error."""
+    sess = _session()
+    flat = _load_flat()
+    with pytest.raises(CatalystParseError):
+        parse_plan_json(copy.deepcopy(flat)[:-1])      # truncated
+    with pytest.raises(CatalystParseError):
+        parse_plan_json(copy.deepcopy(flat) + [dict(flat[-1])])  # surplus
+    broken = copy.deepcopy(flat)
+    broken[0]["num-children"] = 7
+    with pytest.raises(CatalystParseError):
+        parse_plan_json(broken)
+    with pytest.raises(CatalystParseError):
+        parse_plan_json([])
+    # nested expression arrays get the same treatment through convert
+    gutted = copy.deepcopy(flat)
+    for obj in gutted:
+        if obj["class"].endswith("FilterExec"):
+            obj["condition"] = obj["condition"][:2]    # torn expr tree
+    _assert_equivalent_or_typed_error(
+        sess, _plan_fingerprint(sess, flat), gutted, "torn condition")
+
+
+def test_class_name_damage_is_typed_or_fallback():
+    """Unknown plan/expression classes: either the strategy's typed
+    Unsupported signal (no host fallback registered here) or a parse
+    rejection — not a crash."""
+    sess = _session()
+    flat = _load_flat()
+    baseline = _plan_fingerprint(sess, flat)
+    for i in range(len(flat)):
+        mutated = copy.deepcopy(flat)
+        mutated[i]["class"] = "org.apache.spark.sql.execution.NotARealExec"
+        _assert_equivalent_or_typed_error(
+            sess, baseline, mutated, f"class rename node {i}")

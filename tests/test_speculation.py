@@ -1,0 +1,479 @@
+"""Tail-latency robustness: speculative attempts, wedge detection, and
+partial map-stage re-runs.
+
+The retry machinery (test_faults.py) proves recovery from tasks that
+FAIL; this suite proves recovery from tasks that merely STRAGGLE — the
+injected-latency ``slow<ms>`` fault entries (runtime/faults.py) are the
+deterministic stand-in for a slow host/wedged kernel, and every
+scenario asserts the query's results stay identical to the undisturbed
+run while the recovery is visible in the scheduler counters, the event
+log, and the live registry.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.runtime import faults, monitor, trace
+from blaze_tpu.runtime.metrics import MetricNode
+from blaze_tpu.runtime.scheduler import run_stages, split_stages
+from blaze_tpu.runtime.speculation import (
+    SPEC_ATTEMPT_BASE, SpeculationPolicy,
+)
+
+import spark_fixtures as F
+from test_spark_convert import make_session, q6_like_plan  # noqa: E402
+
+
+def _attempt_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("blaze-attempt-") and t.is_alive()]
+
+
+@pytest.fixture(autouse=True)
+def _clean_speculation():
+    """Every scenario starts disarmed and leaves nothing armed or
+    running; a leaked attempt thread fails the NEXT test too, which is
+    exactly the point."""
+    conf.FAULTS_SPEC.set("")
+    conf.TASK_RETRY_BACKOFF.set(0.0)
+    faults.reset()
+    yield
+    conf.FAULTS_SPEC.set("")
+    conf.TASK_RETRY_BACKOFF.set(0.1)
+    conf.SPECULATION_ENABLE.set(False)
+    conf.SPECULATION_MULTIPLIER.set(1.5)
+    conf.SPECULATION_QUANTILE.set(0.75)
+    conf.SPECULATION_MIN_RUNTIME.set(0.1)
+    conf.SPECULATION_WEDGE_MS.set(0)
+    conf.TASK_WEDGE_MS.set(0)
+    conf.STAGE_TASK_CONCURRENCY.set(1)
+    conf.MONITOR_HEARTBEAT_MS.set(1000)
+    faults.reset()
+    monitor.reset()
+    deadline = time.monotonic() + 10
+    while _attempt_threads() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert _attempt_threads() == [], "attempt runner leaked threads"
+
+
+def _scheduler_run(sess, plan_json, metrics=None):
+    from blaze_tpu.batch import batch_to_pydict
+
+    plan = sess.plan(plan_json)
+    stages, manager = split_stages(plan)
+    out = {f.name: [] for f in stages[-1].plan.schema.fields}
+    for b in run_stages(stages, manager, metrics=metrics):
+        d = batch_to_pydict(b)
+        for k in out:
+            out[k].extend(d[k])
+    return out, manager
+
+
+def _inject(spec: str) -> None:
+    conf.FAULTS_SPEC.set(spec)
+    faults.reset()
+
+
+# ------------------------------------------------------- policy units
+
+def test_policy_triggers():
+    p = SpeculationPolicy(enabled=True, multiplier=2.0, quantile=0.5,
+                          min_runtime=0.1, wedge_ms=200)
+    # quantile: 2 of 4 must be done
+    assert not p.should_speculate(10.0, [1.0], 4)
+    assert p.should_speculate(2.5, [1.0, 1.2], 4)      # > 2 x median
+    assert not p.should_speculate(1.9, [1.0, 1.2], 4)  # under multiplier
+    assert not p.should_speculate(0.05, [0.01, 0.01], 4)  # min runtime
+    assert p.is_spec_wedged(0.25) and not p.is_spec_wedged(0.15)
+    off = SpeculationPolicy()
+    assert not off.runner_needed()
+    assert not off.should_speculate(100.0, [0.1, 0.1], 2)
+    # each arming route forces the concurrent runner
+    assert SpeculationPolicy(enabled=True).runner_needed()
+    assert SpeculationPolicy(task_wedge_ms=100).runner_needed()
+    assert SpeculationPolicy(concurrency=2).runner_needed()
+
+
+def test_policy_from_conf_clamps():
+    conf.SPECULATION_ENABLE.set(True)
+    conf.SPECULATION_MULTIPLIER.set(0.3)   # < 1 would speculate on noise
+    conf.SPECULATION_QUANTILE.set(7.0)
+    conf.STAGE_TASK_CONCURRENCY.set(0)
+    p = SpeculationPolicy.from_conf()
+    assert p.enabled and p.multiplier == 1.0 and p.quantile == 1.0
+    assert p.concurrency == 1
+
+
+# ------------------------------------- concurrent runner, no disturbance
+
+def test_concurrent_runner_matches_serial_results():
+    """taskConcurrency > 1 alone (no speculation, no faults) must be a
+    pure scheduling change: identical rows, no extra attempts."""
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    conf.STAGE_TASK_CONCURRENCY.set(3)
+    m = MetricNode()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("speculative_attempts") == 0
+    assert m.metrics.get("task_retries") == 0
+    # 3 map + 1 result task, one attempt each
+    assert m.metrics.get("task_attempts") == 4
+
+
+def test_concurrent_runner_broadcast_stage():
+    """Regression (found by the concurrent TPC-H sweep): the broadcast
+    build drains its child under a DERIVED TaskContext — a fresh one
+    detaches from the attempt's ScopedResources view, so every task of
+    a broadcast-consuming stage failed with 'resource broadcast_0.0
+    not found' under the concurrent runner."""
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    sess, data = make_session()
+    dim_schema = Schema([
+        Field("d_key", DataType.int64()),
+        Field("d_name", DataType.string(16)),
+    ])
+    sess.register_table(
+        "dim",
+        {"d_key": list(range(10)), "d_name": [f"name{i}" for i in range(10)]},
+        dim_schema,
+    )
+    fact = F.scan("lineitem", [F.attr("l_quantity", 1), F.attr("l_discount", 3)])
+    dim = F.broadcast(F.scan("dim", [F.attr("d_key", 5), F.attr("d_name", 6)]))
+    join = F.bhj([F.attr("l_discount", 3)], [F.attr("d_key", 5)],
+                 "Inner", "right", fact, dim)
+    plan_json = F.flatten(
+        F.project([F.attr("l_quantity", 1), F.attr("d_name", 6)], join))
+    baseline, _ = _scheduler_run(sess, plan_json)
+    assert len(baseline["l_quantity"]) == len(data["l_quantity"])
+
+    conf.STAGE_TASK_CONCURRENCY.set(3)
+    got, _ = _scheduler_run(sess, plan_json)
+    assert got == baseline
+
+
+def test_concurrent_runner_still_retries_faults():
+    """The retry/fetch-recovery contract survives the concurrent
+    runner: an injected crash is retried (through the runner's
+    DEFERRED backoff — the poll loop schedules the relaunch instead
+    of sleeping inline), results identical."""
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    conf.STAGE_TASK_CONCURRENCY.set(3)
+    conf.TASK_RETRY_BACKOFF.set(0.05)  # nonzero: exercises relaunch_at
+    _inject("task.compute@2@a0")
+    m = MetricNode()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("task_retries") == 1
+
+
+# ------------------------------------------------- speculative attempts
+
+def _arm_speculation(wedge_ms=0, multiplier=1.2, quantile=0.25,
+                     min_runtime=0.02, heartbeat_ms=25, concurrency=1):
+    conf.SPECULATION_ENABLE.set(True)
+    conf.SPECULATION_MULTIPLIER.set(multiplier)
+    conf.SPECULATION_QUANTILE.set(quantile)
+    conf.SPECULATION_MIN_RUNTIME.set(min_runtime)
+    conf.SPECULATION_WEDGE_MS.set(wedge_ms)
+    conf.MONITOR_HEARTBEAT_MS.set(heartbeat_ms)
+    conf.STAGE_TASK_CONCURRENCY.set(concurrency)
+    monitor.reset()
+
+
+def test_speculative_attempt_wins_duration_trigger():
+    """Acceptance core: a seeded straggler makes one map task slow
+    relative to its completed siblings; the backup attempt races it
+    through the atomic-rename commit seam, wins, and the results are
+    byte-identical to the undisturbed run."""
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    # all 3 map tasks in flight; the LAST map-side commit sleeps 800ms,
+    # so two siblings complete fast and the duration trigger fires
+    _arm_speculation(wedge_ms=0, concurrency=3)
+    _inject("shuffle.write@3@slow800")
+    m = MetricNode()
+    t0 = time.monotonic()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("speculative_attempts") == 1
+    assert m.metrics.get("speculative_won") == 1
+    assert m.metrics.get("speculative_lost") == 0
+    # the whole query finished without serially waiting out the
+    # straggler's sleep on the critical path... the loser is reaped in
+    # the background, bounded by its own sleep
+    assert time.monotonic() - t0 < 10
+
+
+def test_speculative_attempt_wins_wedge_trigger():
+    """A task wedged INSIDE its first batch of work (no driver-visible
+    output, no drain deadline) is caught by heartbeat age and raced."""
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    # duration trigger effectively off (multiplier huge, quantile 1.0);
+    # only the wedge path can launch the backup
+    _arm_speculation(wedge_ms=150, multiplier=1000.0, quantile=1.0)
+    _inject("shuffle.write@1@slow700")
+    m = MetricNode()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("speculative_attempts") == 1
+    assert m.metrics.get("speculative_won") == 1
+
+
+def test_speculation_events_reconcile_and_registry_rolls_back(tmp_path):
+    """The observability half of the acceptance gate: with tracing and
+    the live monitor armed, the speculative race leaves a reconciled
+    event log (every start paired with won/lost), the loser's registry
+    heartbeat state is rolled back (no inflated /queries rows), and no
+    attempt thread outlives the run."""
+    sess, data = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    _arm_speculation(wedge_ms=150, multiplier=1000.0, quantile=1.0)
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    conf.MONITOR_ENABLE.set(True)
+    trace.reset()
+    monitor.reset()
+    _inject("shuffle.write@1@slow700")
+    m = MetricNode()
+    try:
+        with monitor.query_span("spec_q", mode="scheduler") as log_path:
+            got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        conf.MONITOR_ENABLE.set(False)
+        trace.reset()
+
+    assert got == baseline
+    assert m.metrics.get("speculative_won") == 1
+
+    from blaze_tpu.runtime import trace_report
+
+    events = trace.read_event_log(log_path)
+    spc = trace_report.reconcile_speculation(events)
+    assert spc["speculated"] == 1 and spc["won"] == 1
+    assert spc["reconciled"], spc["unpaired"]
+    starts = [e for e in events
+              if e["type"] == "speculative_attempt_start"]
+    assert starts[0]["reason"] == "wedged"
+    assert starts[0]["attempt"] >= SPEC_ATTEMPT_BASE
+    # straggler provocation is on the record too
+    assert any(e["type"] == "straggler_injected" for e in events)
+
+    # registry: the run really landed (attempt threads carry the query
+    # context), and no task entry carries the LOSER's rows on top of
+    # the winner's — per-partition live rows stay bounded by the source
+    snap = monitor.snapshot()
+    q = next(q for q in snap["queries"] if q["query_id"] == "spec_q")
+    assert q["status"] == "ok" and q["stages"]
+    map_st = next(st for st in q["stages"] if st["kind"] == "map")
+    assert map_st["tasks_done"] == map_st["n_tasks"] == 3
+    n_rows = len(data["l_quantity"])
+    for st in q["stages"]:
+        assert st["task_rows"] <= n_rows
+        for p, entry in st["tasks"].items():
+            assert entry["rows"] <= n_rows
+
+
+# ------------------------------------------------- wedge-triggered retry
+
+def test_wedged_task_is_failed_and_retried_without_speculation():
+    """Satellite: the drain deadline only fires between driver-observed
+    batches, so a task wedged inside its first batch was invisible to
+    the retry machinery.  With spark.blaze.task.wedgeMs armed (and
+    speculation OFF), heartbeat age fails and retries it."""
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    conf.TASK_WEDGE_MS.set(150)
+    conf.MONITOR_HEARTBEAT_MS.set(25)
+    monitor.reset()
+    # the sleep sits at the map-side COMMIT: the task yields nothing to
+    # the driver, so no cooperative deadline could ever see it
+    _inject("shuffle.write@1@slow700")
+    m = MetricNode()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("task_timeouts") >= 1   # the wedge, as a timeout
+    assert m.metrics.get("task_retries") >= 1
+    assert m.metrics.get("speculative_attempts") == 0
+
+
+def test_task_wedge_still_fires_with_speculation_enabled():
+    """Review-found regression: with speculation ENABLED but unable to
+    act on a wedge (speculation.wedgeMs=0 and the duration trigger
+    unreachable), an armed spark.blaze.task.wedgeMs must still cancel
+    and retry the wedged task — otherwise enabling speculation
+    silently DISABLED wedge recovery and a wedged task hung the stage
+    forever."""
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    _arm_speculation(wedge_ms=0, multiplier=1000.0, quantile=1.0)
+    conf.TASK_WEDGE_MS.set(150)
+    _inject("shuffle.write@1@slow700")
+    m = MetricNode()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("task_timeouts") >= 1
+    assert m.metrics.get("task_retries") >= 1
+    assert m.metrics.get("speculative_attempts") == 0
+
+
+# --------------------------------------------------- partial map re-runs
+
+def test_partial_rerun_only_missing_map_ids():
+    """Acceptance: a fetch failure naming one lost map output re-runs
+    ONLY that map task — map_tasks_rerun strictly less than the map
+    stage's n_tasks — with reduce output unchanged."""
+    from blaze_tpu.batch import batch_to_pydict
+    from blaze_tpu.serde import from_proto
+
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    plan = sess.plan(plan_json)
+    stages, manager = split_stages(plan)
+    n_map_tasks = stages[0].n_tasks
+    assert n_map_tasks == 3
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    plan = sess.plan(plan_json)
+    stages, manager = split_stages(plan)
+    lost_data, _lost_index = manager.map_output_paths(
+        stages[0].shuffle_id, 1)
+    real_run_task = from_proto.run_task
+    state = {"calls": 0, "deleted": False}
+
+    def losing(td, **kw):
+        state["calls"] += 1
+        if state["calls"] == n_map_tasks + 1 and not state["deleted"]:
+            # first reduce task: its blocks are registered — now the
+            # committed output of map task 1 vanishes (≙ an executor
+            # dying between stages); the read must name map id 1
+            os.unlink(lost_data)
+            state["deleted"] = True
+        return real_run_task(td, **kw)
+
+    m = MetricNode()
+    from_proto.run_task = losing
+    try:
+        out = {f.name: [] for f in stages[-1].plan.schema.fields}
+        for b in run_stages(stages, manager, metrics=m):
+            d = batch_to_pydict(b)
+            for k in out:
+                out[k].extend(d[k])
+    finally:
+        from_proto.run_task = real_run_task
+    assert state["deleted"]
+    assert out == baseline
+    assert m.metrics.get("fetch_failures") == 1
+    assert m.metrics.get("map_stage_reruns") == 1
+    # THE partial-rerun proof: one missing map id => one task re-run
+    assert m.metrics.get("map_tasks_rerun") == 1
+    assert m.metrics.get("map_tasks_rerun") < n_map_tasks
+    # 3 maps + 1 rerun + 2 reduce attempts (failed + retried)
+    assert m.metrics.get("task_attempts") == 6
+
+
+def test_injected_fetch_fault_still_reruns_whole_stage():
+    """An INJECTED fetch failure carries no map ids (the producer is
+    fine; the read was poisoned) — recovery falls back to the full
+    map-stage rerun, counted as all n_tasks."""
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_run(sess, plan_json)
+
+    _inject("shuffle.fetch@1@a0")
+    m = MetricNode()
+    got, _ = _scheduler_run(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("map_stage_reruns") == 1
+    assert m.metrics.get("map_tasks_rerun") == 3
+
+
+def test_cancelled_attempt_never_commits_over_winner(tmp_path):
+    """Chaos-sweep-found regression: a cancelled attempt whose CHILD
+    exits cooperatively (yielding zero batches) used to sail past the
+    per-batch cancellation check straight into write_output and
+    overwrite the winner's committed shuffle file with an EMPTY one.
+    The commit itself must be cancellation-guarded."""
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.ops import MemoryScanExec
+    from blaze_tpu.parallel.shuffle import (
+        LocalShuffleManager, ShuffleWriterExec, SinglePartitioning,
+    )
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("x", DataType.int64())])
+    manager = LocalShuffleManager(str(tmp_path))
+    data_p, index_p = manager.map_output_paths(0, 0)
+
+    # the winner's commit
+    full = MemoryScanExec(
+        [[batch_from_pydict({"x": list(range(64))}, schema)]], schema)
+    for _ in ShuffleWriterExec(full, SinglePartitioning(),
+                               data_p, index_p).execute(0, TaskContext(0, 1)):
+        pass
+    winner = (open(data_p, "rb").read(), open(index_p, "rb").read())
+    assert len(winner[0]) > 0
+
+    # the loser: already cancelled, child yields nothing (the
+    # cooperative early exit every blocking op performs)
+    cancelled = threading.Event()
+    cancelled.set()
+    empty = MemoryScanExec([[]], schema)
+    for _ in ShuffleWriterExec(empty, SinglePartitioning(),
+                               data_p, index_p).execute(
+            0, TaskContext(0, 1, cancel_event=cancelled)):
+        pass
+    assert (open(data_p, "rb").read(), open(index_p, "rb").read()) == winner
+
+    # a legitimately EMPTY, uncancelled task still commits (the reduce
+    # barrier keys on index existence)
+    d2, i2 = manager.map_output_paths(0, 1)
+    for _ in ShuffleWriterExec(empty, SinglePartitioning(),
+                               d2, i2).execute(0, TaskContext(0, 1)):
+        pass
+    assert os.path.exists(i2)
+
+
+def test_invalidate_map_ids_subset(tmp_path):
+    from blaze_tpu.parallel.shuffle import LocalShuffleManager, block_map_id
+
+    mgr = LocalShuffleManager(str(tmp_path))
+    for m_id in range(3):
+        for p in mgr.map_output_paths(5, m_id):
+            open(p, "wb").write(b"x")
+    # partial: only map 1's pair goes
+    assert mgr.invalidate(5, map_ids=[1]) == 2
+    left = sorted(os.listdir(tmp_path))
+    assert not any("_1." in f for f in left) and len(left) == 4
+    # full: the rest
+    assert mgr.invalidate(5) == 4
+    assert os.listdir(tmp_path) == []
+    # the block -> producing-map-id attribution the reader relies on
+    data, _ = mgr.map_output_paths(7, 2)
+    assert block_map_id((data, 0, 10)) == 2
+    assert block_map_id(b"inmemory") is None
+    assert block_map_id(("/odd/name.bin", 0, 1)) is None

@@ -285,7 +285,14 @@ def _synthetic_events():
                         "reason": "InjectedFault"}),
         ("task_timeout", {"stage_id": 0, "task": 0, "attempt": 0}),
         ("fetch_failure", {"stage_id": 1, "task": 0, "shuffle_id": 0}),
-        ("map_stage_rerun", {"stage_id": 0, "shuffle_id": 0}),
+        ("map_stage_rerun", {"stage_id": 0, "shuffle_id": 0,
+                             "map_ids": [1]}),
+        ("speculative_attempt_start", {"stage_id": 0, "task": 1,
+                                       "attempt": 100, "reason": "slow"}),
+        ("speculative_attempt_won", {"stage_id": 0, "task": 1,
+                                     "attempt": 100}),
+        ("speculative_attempt_lost", {"stage_id": 0, "task": 2,
+                                      "attempt": 101}),
         ("task_kernels", {"task_id": "task_0_0", "stage_id": 0,
                           "partition": 0, "attempt": 0, "wall_ns": 9,
                           "programs": 1, "device_time_ns": 4,
@@ -313,6 +320,9 @@ def _synthetic_events():
                             "metrics": {"output_rows": 10}}),
         ("fault_injected", {"site": "shuffle.fetch", "hit": 2,
                             "attempt": 0, "detail": "shuffle_0"}),
+        ("straggler_injected", {"site": "shuffle.write", "hit": 1,
+                                "attempt": 0, "slow_ms": 400,
+                                "detail": "/tmp/x.data"}),
         ("mem_watermark", {"used": 1024, "total": 4096}),
         ("spill", {"consumer": "shuffle", "bytes": 512}),
         ("shuffle_write", {"bytes": 100, "blocks": 2, "attempt": 0,
