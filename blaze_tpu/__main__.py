@@ -15,8 +15,10 @@ Usage:
     python -m blaze_tpu tpch all --scale 0.01
     python -m blaze_tpu --warmup            # compile-cache pre-warm + gate
     python -m blaze_tpu --lint              # static analysis; nonzero on finding
+    python -m blaze_tpu --lint --json -     # + machine-readable findings
     python -m blaze_tpu --chaos             # seeded fault-injection smoke
-                                            #  (+ plan verifier + lock-order armed)
+                                            #  (+ plan verifier + lock-order
+                                            #   + lockset checker armed)
     python -m blaze_tpu tpch q1 --chaos --chaos-seed 42
     python -m blaze_tpu --chaos-seeds 3    # seeded sweep; seed 1 also arms
                                            #  speculation vs. a straggler
@@ -255,25 +257,32 @@ def _warmup(suite: str, names, scale: float, n_parts: int,
     return 0
 
 
-def _run_lint() -> int:
+def _run_lint(json_path: str = "") -> int:
     """``--lint``: run every static-analysis pass (analysis/) and exit
-    nonzero on any finding.
+    nonzero on any unwaived finding.
 
     1. AST lint over the package: trace purity, stray ``jax.jit``,
-       emit-under-lock, static lock-order — waivers applied
+       emit-under-lock, static lock-order, guarded-by lock coverage +
+       resource lifecycle — waivers applied
        (``analysis/lint_waivers.json``).
     2. Conf-name golden-registry drift (``runtime/conf_names.json``),
        two-way plus the README conf-table completeness check.
     3. Plan verifier over the whole TPC-H + TPC-DS query corpus,
        fusion enabled AND disabled (plan build over schema-only scans
-       — no datagen, no execution)."""
+       — no datagen, no execution).
+
+    ``--json <path|->`` additionally writes the findings as one JSON
+    document — rule id, path, line, symbol, message, waived flag, plus
+    a summary block — with golden-pinned keys like ``--report --json``,
+    so CI and the chaos sweep can diff lint runs mechanically (waived
+    findings are reported and marked but never affect the exit code)."""
     from . import conf
     from .analysis import lint as lint_mod
     from .analysis.plan_verify import verify_plan
     from .ops import MemoryScanExec
     from .ops.fusion import optimize_plan
 
-    findings = list(lint_mod.lint_package())
+    pairs = lint_mod.findings_with_waivers()
     n_plans = 0
     prev_fusion = bool(conf.FUSION_ENABLE.get())
     try:
@@ -294,23 +303,40 @@ def _run_lint() -> int:
                     try:
                         plan = optimize_plan(build_query(name, scans, 2))
                     except Exception as e:  # noqa: BLE001 — surface as finding
-                        findings.append(lint_mod.Finding(
+                        pairs.append((lint_mod.Finding(
                             "plan.build", f"{suite}/{name}", 0, tag,
-                            f"plan build failed: {type(e).__name__}: {e}"))
+                            f"plan build failed: {type(e).__name__}: {e}"),
+                            False))
                         continue
                     n_plans += 1
                     for f in verify_plan(plan):
-                        findings.append(lint_mod.Finding(
+                        pairs.append((lint_mod.Finding(
                             f.rule, f"{suite}/{name}", 0, tag,
-                            f"{f.path} ({f.node}): {f.message}"))
+                            f"{f.path} ({f.node}): {f.message}"), False))
     finally:
         conf.FUSION_ENABLE.set(prev_fusion)
+    findings = [f for f, waived in pairs if not waived]
     for f in findings:
         print(repr(f), file=sys.stderr)
     status = f"{len(findings)} finding(s)" if findings else "clean"
-    print(f"# lint: {status} — AST rules + conf registry + "
-          f"{n_plans} verified plans (fused+unfused), "
-          f"{len(lint_mod.load_waivers())} pinned waiver(s)")
+    status_line = (f"# lint: {status} — AST rules + conf registry + "
+                   f"{n_plans} verified plans (fused+unfused), "
+                   f"{len(lint_mod.load_waivers())} pinned waiver(s)")
+    if json_path:
+        import json as _json
+
+        doc = lint_mod.lint_json_doc(pairs, plans_verified=n_plans)
+        if json_path == "-":
+            # stdout is the PARSEABLE document and nothing else (same
+            # contract as --report --json -): the status line moves to
+            # stderr so `--lint --json - | jq` works as advertised
+            print(_json.dumps(doc, indent=2))
+            print(status_line, file=sys.stderr)
+            return 1 if findings else 0
+        with open(json_path, "w") as f:
+            _json.dump(doc, f, indent=2)
+        print(f"# json findings: {json_path}")
+    print(status_line)
     return 1 if findings else 0
 
 
@@ -330,11 +356,16 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
     triggers, fast heartbeat cadence) and seeds a deterministic
     STRAGGLER (``slow<ms>`` latency entry) into the fault schedule, so
     the smoke exercises the backup-attempt race, not just crash
-    recovery.  Nonzero exit on mismatch, unrecovered failure, an
-    unreconciled event log, or either verifier firing."""
+    recovery.  The Eraser-style lockset checker
+    (``spark.blaze.verify.lockset``, runtime/lockset.py) is armed for
+    the whole smoke alongside the other two verifiers: a guarded
+    attribute touched off-lock from a second thread raises a
+    deterministic ``LocksetViolation`` that fails the run.  Nonzero
+    exit on mismatch, unrecovered failure, an unreconciled event log,
+    or ANY verifier firing."""
     from . import conf
     from .analysis import locks as lock_verify
-    from .runtime import monitor
+    from .runtime import lockset, monitor
 
     build_query, names, scans = _load_suite(suite, names, scale, n_parts)
     if build_query is None:
@@ -344,6 +375,8 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
     conf.VERIFY_PLAN.set(True)
     conf.VERIFY_LOCKS.set(True)
     lock_verify.refresh()
+    conf.VERIFY_LOCKSET.set(True)
+    lockset.refresh()
     spec_knobs = (conf.SPECULATION_ENABLE, conf.SPECULATION_MULTIPLIER,
                   conf.SPECULATION_QUANTILE, conf.SPECULATION_MIN_RUNTIME,
                   conf.SPECULATION_WEDGE_MS, conf.MONITOR_HEARTBEAT_MS)
@@ -364,6 +397,8 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
         conf.VERIFY_PLAN.set(False)
         conf.VERIFY_LOCKS.set(False)
         lock_verify.refresh()
+        conf.VERIFY_LOCKSET.set(False)
+        lockset.refresh()
         if speculate:
             # restore EVERY knob the smoke touched, symmetrically —
             # a later in-process run must not inherit the smoke's
@@ -376,7 +411,7 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
 def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
                 n_faults, speculate=False) -> int:
     from . import conf
-    from .runtime import faults, monitor, scheduler, trace, trace_report
+    from .runtime import faults, lockset, monitor, scheduler, trace, trace_report
 
     failed = []
     for i, name in enumerate(names):
@@ -393,6 +428,11 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
             continue
         conf.FAULTS_SPEC.set(spec)
         faults.reset()
+        # per-query lockset window: the checked-access tally and the
+        # reported-violation list judge THIS chaotic run, not the
+        # sweep so far (a later query's armed-but-never-exercised
+        # checker must be visible as lockset_checked=0)
+        lockset.reset()
         prev_trace = bool(conf.TRACE_ENABLE.get())
         conf.TRACE_ENABLE.set(True)
         trace.reset()
@@ -412,6 +452,12 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
             conf.TRACE_ENABLE.set(prev_trace)
             trace.reset()
         m = scheduler.LAST_RUN_METRICS.metrics if scheduler.LAST_RUN_METRICS else None
+        # mirror the lockset checker's access tally into the run's
+        # counters: a chaos line showing 0 checked accesses means the
+        # checker was armed but never exercised — visibly useless
+        checked = lockset.counters()["checked_accesses"]
+        if m is not None:
+            m.set("lockset_checked_accesses", checked)
         counters = (
             f"attempts={m.get('task_attempts')} retries={m.get('task_retries')} "
             f"fetch_failures={m.get('fetch_failures')} "
@@ -420,7 +466,8 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
             f"speculative={m.get('speculative_attempts')}"
             f"/won={m.get('speculative_won')} "
             f"dispatches={m.get('xla_dispatches')} "
-            f"compiles={m.get('xla_compiles')}" if m else "no metrics"
+            f"compiles={m.get('xla_compiles')} "
+            f"lockset_checked={checked}" if m else "no metrics"
         )
         # event-log reconciliation: every fault that FIRED must pair
         # with a recovery event recorded after it, and every
@@ -435,7 +482,15 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
                  f"({spc['won']} won / {spc['lost']} lost) "
                  + ("reconciled" if spc["reconciled"] else "UNRECONCILED"))
         leaked = [t for t in _live_attempt_threads()]
-        if chaotic != baseline:
+        # a LocksetViolation may have been swallowed en route (monitor
+        # handler 500s, operator blanket-excepts) — the recorded list
+        # fails the run regardless of where the raise died
+        races = lockset.reported()
+        if races:
+            print(f"chaos {name}: LOCKSET VIOLATION under spec '{spec}': "
+                  + "; ".join(races), file=sys.stderr)
+            failed.append(name)
+        elif chaotic != baseline:
             print(f"chaos {name}: MISMATCH under spec '{spec}' ({counters}; "
                   f"{recon})", file=sys.stderr)
             failed.append(name)
@@ -613,7 +668,10 @@ def main(argv=None) -> int:
                     help="with --report: also write the full profile as "
                          "one JSON document (stage timeline, dispatch-floor "
                          "split, kernel table, recovery pairing) to PATH "
-                         "('-' = stdout instead of the text rendering)")
+                         "('-' = stdout instead of the text rendering); "
+                         "with --lint: write the findings as one JSON "
+                         "document (rule id, path, line, symbol, waived "
+                         "flag + summary) so CI can diff lint runs")
     ap.add_argument("--serve", action="store_true",
                     help="run the live monitoring HTTP service "
                          "(/metrics Prometheus text, /queries JSON); bare "
@@ -638,13 +696,13 @@ def main(argv=None) -> int:
     ap.add_argument("--watch-polls", type=int, default=0,
                     help="--watch: stop after N polls (0 = until ^C)")
     args = ap.parse_args(argv)
-    if args.json and not args.report:
-        ap.error("--json requires --report (it mirrors the rendered "
-                 "profile as JSON)")
+    if args.json and not (args.report or args.lint):
+        ap.error("--json requires --report (profile as JSON) or --lint "
+                 "(findings as JSON)")
     if args.chaos_seeds:
         args.chaos = True
     if args.lint:
-        return _run_lint()
+        return _run_lint(args.json)
     if args.report:
         from .runtime import trace, trace_report
 

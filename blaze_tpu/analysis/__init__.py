@@ -1,8 +1,9 @@
 """Static analysis & verification: mechanical checkers for the
-invariants the last four PRs enforced by convention and review.
+invariants the last five PRs enforced by convention and review.
 
-Three passes, all runnable via ``python -m blaze_tpu --lint`` (nonzero
-exit on any finding) and as tier-1 tests (tests/test_analysis.py):
+Four passes, all runnable via ``python -m blaze_tpu --lint`` (nonzero
+exit on any finding; ``--json`` for machine-readable findings) and as
+tier-1 tests (tests/test_analysis.py, tests/test_guarded.py):
 
 - :mod:`plan_verify` — a rule-based structural checker run over every
   physical plan after ``ops/fusion.optimize_plan`` and before
@@ -21,6 +22,13 @@ exit on any finding) and as tier-1 tests (tests/test_analysis.py):
   enforced statically (AST pass over nested acquisitions) and at
   runtime (conf ``spark.blaze.verify.locks``, armed in ``--chaos`` and
   the monitor/fault suites).
+- :mod:`guarded` — lock COVERAGE over declared shared state
+  (``GUARDED_BY``/``GUARDED_REFS``/``LOCK_FREE`` annotations next to
+  the state): off-lock access, mutable-reference escape from critical
+  sections, and acquire/release lifecycle asymmetry — complemented at
+  runtime by the Eraser-style lockset checker
+  (``runtime/lockset.py``, conf ``spark.blaze.verify.lockset``, armed
+  in ``--chaos``/``--chaos-seeds``).
 """
 
 from .lint import Finding  # noqa: F401

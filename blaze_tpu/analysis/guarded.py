@@ -1,0 +1,536 @@
+"""Guarded-by checker: static lock-COVERAGE analysis over declared
+shared state, in the style of Clang thread-safety annotations.
+
+PR 6's lock framework (analysis/locks.py) checks lock *ordering*; this
+module closes the complementary class that dominated PR 7's review
+rounds — shared mutable state touched off-lock, or rolled back on one
+control-flow path but not another.  Modules DECLARE which lock guards
+which state, next to the state itself:
+
+- **class attributes**: a class-body literal
+  ``GUARDED_BY = {"rows": "monitor.progress", ...}`` declares that
+  every ``self.rows`` access in the class must hold that hierarchy
+  lock;
+- **module globals**: a module-level ``GUARDED_BY = {...}`` literal
+  declares the same for bare-name reads/writes of the globals in the
+  declaring module;
+- ``GUARDED_REFS = ("_buffers", ...)`` names the MUTABLE-CONTAINER
+  subset of the declared attributes, which the escape rule watches
+  (returning a guarded int snapshot is fine; returning the guarded
+  dict itself leaks a mutable reference out of the critical section);
+- ``LOCK_FREE = {"last_beat": "<why the race is benign>"}`` documents
+  audited deliberately-unlocked state, so "no declaration" always
+  means "nobody has thought about it" rather than "it's fine".
+
+Rules (ids are stable API, waivable via ``lint_waivers.json`` exactly
+like the lint.py rules):
+
+- ``guard.unlocked`` — a read/write of a declared-guarded attribute
+  (``self.<attr>`` in the declaring class, bare global in the
+  declaring module) that is neither lexically under ``with`` on the
+  declared lock nor inside a function reachable within three helper
+  hops from such a critical section (the same widening budget as the
+  ``lock.emit-under-lock`` rule), ``__init__``-phase writes exempt
+  (the object is not shared yet — the Clang exemption).
+- ``guard.escape`` — a ``return``/``yield`` lexically inside ``with``
+  on the declared lock whose value is a BARE reference to a
+  ``GUARDED_REFS`` attribute (directly or through tuple/list
+  packing): the mutable guarded object escapes the critical section.
+  Wrapping calls (``dict(x)``, ``x.copy()``, ``len(x)``) are the safe
+  pattern and are not flagged.
+- ``guard.lifecycle`` — acquire/release asymmetry on the registered
+  resource pairs (:data:`LIFECYCLE_PAIRS`): a function that calls the
+  acquire side must release on exception paths too, i.e. carry the
+  matching release inside a ``finally`` block or exception handler.
+- ``guard.decl`` — a malformed declaration: non-literal map, a lock
+  name missing from the hierarchy, or ``GUARDED_REFS`` naming an
+  undeclared attribute.
+
+The pass is deliberately scoped to accesses it can PROVE are the
+declared state (``self.X`` in the declaring class, the bare global in
+the declaring module): matching arbitrary ``obj.X`` by attribute name
+would drown the rule in lookalikes.  Everything outside that scope —
+cross-object access, dynamic dispatch, callbacks on foreign threads —
+is covered at runtime by the Eraser-style lockset checker
+(runtime/lockset.py), armed in ``--chaos``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .locks import RANK, _lock_name_bindings, _with_lock_name
+
+#: function-local resource acquire/release pairs the lifecycle rule
+#: enforces: (acquire simple name, release simple names, what it is).
+#: Cross-function lifecycles (a server started here, stopped there)
+#: are out of scope by design — register only pairs whose contract is
+#: release-in-the-same-function.
+LIFECYCLE_PAIRS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("register_consumer", ("unregister_consumer",),
+     "memmgr consumer registration"),
+    ("_AsyncInserter", ("close", "abort"),
+     "async shuffle stager thread"),
+    ("activate_beat", ("deactivate_beat",),
+     "heartbeat TLS activation"),
+)
+
+_INIT_EXEMPT = {"__init__", "__new__", "__post_init__", "__set_name__"}
+
+
+class GuardDecls:
+    """Parsed declarations of one module."""
+
+    __slots__ = ("module_guards", "module_refs", "class_guards",
+                 "class_refs", "findings")
+
+    def __init__(self) -> None:
+        self.module_guards: Dict[str, str] = {}
+        self.module_refs: Set[str] = set()
+        self.class_guards: Dict[str, Dict[str, str]] = {}
+        self.class_refs: Dict[str, Set[str]] = {}
+        self.findings: List = []
+
+
+def _literal_str_dict(node: ast.expr) -> Optional[Dict[str, str]]:
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, str] = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant) and isinstance(v.value, str)):
+            return None
+        out[k.value] = v.value
+    return out
+
+
+def _literal_str_seq(node: ast.expr) -> Optional[Tuple[str, ...]]:
+    if not isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return None
+    out: List[str] = []
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.append(e.value)
+    return tuple(out)
+
+
+def collect_decls(rel: str, tree: ast.AST) -> GuardDecls:
+    """GUARDED_BY / GUARDED_REFS / LOCK_FREE declarations of one
+    module, plus ``guard.decl`` findings for malformed ones."""
+    from .lint import Finding
+
+    decls = GuardDecls()
+
+    def handle(scope: Optional[str], stmt: ast.stmt) -> None:
+        # both plain and type-annotated assignment spellings declare
+        # (an AnnAssign silently ignored would disable the whole pass
+        # for the scope with no finding)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            tgt = stmt.target
+        else:
+            return
+        if not isinstance(tgt, ast.Name):
+            return
+        sym = scope or "<module>"
+        if tgt.id == "GUARDED_BY":
+            m = _literal_str_dict(stmt.value)
+            if m is None:
+                decls.findings.append(Finding(
+                    "guard.decl", rel, stmt.lineno, sym,
+                    "GUARDED_BY must be a literal {attr: lock} dict of "
+                    "string constants"))
+                return
+            bad = sorted(v for v in m.values() if v not in RANK)
+            if bad:
+                decls.findings.append(Finding(
+                    "guard.decl", rel, stmt.lineno, sym,
+                    f"GUARDED_BY names lock(s) {bad} not declared in the "
+                    f"hierarchy (analysis/locks.py HIERARCHY)"))
+                return
+            if scope is None:
+                decls.module_guards.update(m)
+            else:
+                decls.class_guards.setdefault(scope, {}).update(m)
+        elif tgt.id == "GUARDED_REFS":
+            seq = _literal_str_seq(stmt.value)
+            if seq is None:
+                decls.findings.append(Finding(
+                    "guard.decl", rel, stmt.lineno, sym,
+                    "GUARDED_REFS must be a literal tuple/list of string "
+                    "constants"))
+                return
+            if scope is None:
+                decls.module_refs.update(seq)
+            else:
+                decls.class_refs.setdefault(scope, set()).update(seq)
+        elif tgt.id == "LOCK_FREE":
+            if _literal_str_dict(stmt.value) is None:
+                decls.findings.append(Finding(
+                    "guard.decl", rel, stmt.lineno, sym,
+                    "LOCK_FREE must be a literal {attr: reason} dict of "
+                    "string constants"))
+
+    for stmt in getattr(tree, "body", []):
+        handle(None, stmt)
+        if isinstance(stmt, ast.ClassDef):
+            for s in stmt.body:
+                handle(stmt.name, s)
+
+    # refs must name declared attributes, or the escape rule silently
+    # watches nothing
+    for cls, refs in decls.class_refs.items():
+        unknown = sorted(refs - set(decls.class_guards.get(cls, {})))
+        if unknown:
+            decls.findings.append(Finding(
+                "guard.decl", rel, 1, cls,
+                f"GUARDED_REFS entries {unknown} are not declared in "
+                f"GUARDED_BY"))
+    unknown = sorted(decls.module_refs - set(decls.module_guards))
+    if unknown:
+        decls.findings.append(Finding(
+            "guard.decl", rel, 1, "<module>",
+            f"GUARDED_REFS entries {unknown} are not declared in "
+            f"GUARDED_BY"))
+    return decls
+
+
+def _class_lock_bindings(cls: ast.ClassDef) -> Dict[str, str]:
+    """``self.<tail> = make_lock("name")`` bindings INSIDE one class —
+    overriding the module-level map, which drops tails that are
+    ambiguous across classes (two classes both naming ``self._lock``)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not (isinstance(call, ast.Call) and call.args
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            continue
+        fn = call.func
+        fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if fn_name != "make_lock" or call.args[0].value not in RANK:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute):
+                out[tgt.attr] = call.args[0].value
+    return out
+
+
+def _critical_functions(tree: ast.AST, bindings: Dict[str, str],
+                        graph: Optional[Dict[str, Set[str]]] = None,
+                        ) -> Dict[str, Set[str]]:
+    """lock name -> function simple names that run WITH the lock held:
+    functions invoked lexically inside a ``with <lock>:`` block,
+    widened three helper hops through the module call graph (the same
+    budget as the emit-under-lock rule).  An over-approximation by
+    design — a critical helper also called unlocked is the dynamic
+    checker's case, and the static pass must never false-positive on
+    the annotated codebase.  ``graph`` lets the caller share one
+    ``_call_graph(tree)`` across all declaring scopes of a module —
+    only the with-lock-name walk depends on the per-class bindings."""
+    from .lint import _call_graph, _callee_name
+
+    crit: Dict[str, Set[str]] = {}
+
+    class V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.held: List[str] = []
+
+        def visit_With(self, node: ast.With) -> None:
+            names = [n for n in (_with_lock_name(i, bindings)
+                                 for i in node.items) if n]
+            self.held.extend(names)
+            self.generic_visit(node)
+            for _ in names:
+                self.held.pop()
+
+        def visit_FunctionDef(self, node) -> None:
+            saved, self.held = self.held, []
+            self.generic_visit(node)
+            self.held = saved
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if self.held:
+                callee = _callee_name(node.func)
+                if callee:
+                    for lock in self.held:
+                        crit.setdefault(lock, set()).add(callee)
+            self.generic_visit(node)
+
+    V().visit(tree)
+    if graph is None:
+        graph = _call_graph(tree)
+    for _ in range(3):
+        changed = False
+        for lock, names in crit.items():
+            for name in list(names):
+                for callee in graph.get(name, ()):
+                    if callee not in names:
+                        names.add(callee)
+                        changed = True
+        if not changed:
+            break
+    return crit
+
+
+class _AccessChecker(ast.NodeVisitor):
+    """Shared walker for the unlocked + escape rules over one scope
+    (one declaring class, or the module for global declarations)."""
+
+    def __init__(self, rel: str, guards: Dict[str, str], refs: Set[str],
+                 bindings: Dict[str, str], crit: Dict[str, Set[str]],
+                 findings: List, scope_name: str, self_based: bool):
+        self.rel = rel
+        self.guards = guards
+        self.refs = refs
+        self.bindings = bindings
+        self.crit = crit
+        self.findings = findings
+        self.scope_name = scope_name
+        #: True: match ``self.<attr>``; False: match bare global names
+        self.self_based = self_based
+        self.held: List[str] = []
+        self.funcs: List[str] = []
+
+    # ------------------------------------------------------- helpers
+
+    def _qual(self) -> str:
+        parts = ([self.scope_name] if self.scope_name != "<module>" else []) \
+            + self.funcs
+        return ".".join(parts) or "<module>"
+
+    def _guarded_name(self, node: ast.expr) -> Optional[str]:
+        if self.self_based:
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and node.attr in self.guards:
+                return node.attr
+            return None
+        if isinstance(node, ast.Name) and node.id in self.guards:
+            return node.id
+        return None
+
+    def _ok_without_with(self, lock: str) -> bool:
+        if not self.funcs:
+            # module top level runs at import time, single-threaded
+            return not self.self_based
+        if self.funcs[0] in _INIT_EXEMPT:
+            return self.self_based  # construction: not shared yet
+        return any(f in self.crit.get(lock, ()) for f in self.funcs)
+
+    # -------------------------------------------------------- visits
+
+    def visit_With(self, node: ast.With) -> None:
+        names = [n for n in (_with_lock_name(i, self.bindings)
+                             for i in node.items) if n]
+        self.held.extend(names)
+        self.generic_visit(node)
+        for _ in names:
+            self.held.pop()
+
+    def visit_FunctionDef(self, node) -> None:
+        self.funcs.append(node.name)
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+        self.funcs.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_access(self, node: ast.expr) -> None:
+        attr = self._guarded_name(node)
+        if attr is None:
+            return
+        lock = self.guards[attr]
+        if lock in self.held or self._ok_without_with(lock):
+            return
+        self.findings.append(_finding(
+            "guard.unlocked", self.rel, node.lineno, self._qual(),
+            f"access of guarded {'attribute self.' if self.self_based else 'global '}"
+            f"{attr} (guarded by {lock!r}) outside the lock — hold "
+            f"`with <{lock}>:` or route through a critical helper"))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.self_based:
+            self._check_access(node)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if not self.self_based:
+            self._check_access(node)
+
+    # escape rule: bare guarded-ref in a return/yield under the lock
+
+    def _escaped_ref(self, value: Optional[ast.expr]) -> Optional[str]:
+        if value is None:
+            return None
+        stack = [value]
+        while stack:
+            e = stack.pop()
+            attr = self._guarded_name(e)
+            if attr is not None and attr in self.refs:
+                return attr
+            if isinstance(e, (ast.Tuple, ast.List)):
+                stack.extend(e.elts)
+            # anything else (a Call like dict(x)/x.copy(), a subscript
+            # x[i], arithmetic) yields a new/derived object — safe
+        return None
+
+    def _check_escape(self, node, kind: str) -> None:
+        attr = self._escaped_ref(node.value)
+        if attr is None:
+            return
+        lock = self.guards[attr]
+        if lock not in self.held:
+            return  # escapes only matter out of the critical section
+        self.findings.append(_finding(
+            "guard.escape", self.rel, node.lineno, self._qual(),
+            f"{kind} of guarded mutable {attr} escapes the "
+            f"`with <{lock}>:` critical section — return a copy/"
+            f"snapshot instead of the guarded reference"))
+
+    def visit_Return(self, node: ast.Return) -> None:
+        self._check_escape(node, "return")
+        self.generic_visit(node)
+
+    def visit_Yield(self, node: ast.Yield) -> None:
+        self._check_escape(node, "yield")
+        self.generic_visit(node)
+
+
+def _finding(rule: str, rel: str, line: int, symbol: str, message: str):
+    from .lint import Finding
+
+    return Finding(rule, rel, line, symbol, message)
+
+
+def lint_guarded_module(rel: str, tree: ast.AST) -> List:
+    """All guarded-by rules over one parsed module."""
+    decls = collect_decls(rel, tree)
+    findings: List = list(decls.findings)
+    if not (decls.module_guards or decls.class_guards):
+        return findings
+    from .lint import _call_graph
+
+    mod_bindings = _lock_name_bindings(tree)
+    graph = _call_graph(tree)  # shared across every declaring scope
+
+    if decls.module_guards:
+        crit = _critical_functions(tree, mod_bindings, graph)
+        _AccessChecker(rel, decls.module_guards, decls.module_refs,
+                       mod_bindings, crit, findings, "<module>",
+                       self_based=False).visit(tree)
+    for stmt in getattr(tree, "body", []):
+        if not isinstance(stmt, ast.ClassDef):
+            continue
+        guards = decls.class_guards.get(stmt.name)
+        if not guards:
+            continue
+        bindings = dict(mod_bindings)
+        bindings.update(_class_lock_bindings(stmt))
+        crit = _critical_functions(tree, bindings, graph)
+        checker = _AccessChecker(rel, guards,
+                                 decls.class_refs.get(stmt.name, set()),
+                                 bindings, crit, findings, stmt.name,
+                                 self_based=True)
+        for s in stmt.body:
+            checker.visit(s)
+    return findings
+
+
+def lint_lifecycle_module(rel: str, tree: ast.AST) -> List:
+    """``guard.lifecycle`` over one parsed module: every function that
+    calls an acquire side of :data:`LIFECYCLE_PAIRS` must carry a
+    matching release inside a ``finally`` block or exception handler —
+    an acquire whose release only sits on the happy path leaks the
+    resource on the exception path (the PR 7 review class: spans,
+    stager threads, beat TLS)."""
+    from .lint import Finding, _func_name
+
+    findings: List = []
+    acquires = {a: (rel_names, what) for a, rel_names, what in LIFECYCLE_PAIRS}
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node) -> None:
+            calls: Dict[str, int] = {}
+            protected: Set[str] = set()
+            defines: Set[str] = set()
+
+            # handler/finally subtrees are the "protected" regions: a
+            # release there runs on the exception path too — tracked
+            # through arbitrarily nested compound statements (with/
+            # while/for/if around an inner try)
+            def scan(n: ast.AST, in_protected: bool) -> None:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defines.add(n.name)
+                    return  # nested defs run on their own paths
+                if isinstance(n, ast.Call):
+                    name = _func_name(n.func)
+                    if name in acquires and name not in calls:
+                        calls[name] = n.lineno
+                    if in_protected:
+                        protected.add(name)
+                if isinstance(n, ast.Try):
+                    for c in n.body:
+                        scan(c, in_protected)
+                    for h in n.handlers:
+                        for c in h.body:
+                            scan(c, True)
+                    for c in n.orelse:
+                        scan(c, in_protected)
+                    for c in n.finalbody:
+                        scan(c, True)
+                    return
+                for c in ast.iter_child_nodes(n):
+                    scan(c, in_protected)
+
+            for s in node.body:
+                scan(s, False)
+
+            for name, line in calls.items():
+                rel_names, what = acquires[name]
+                if name in defines:
+                    continue  # the module defining the pair itself
+                if not (set(rel_names) & protected):
+                    findings.append(Finding(
+                        "guard.lifecycle", rel, line,
+                        node.name,
+                        f"{name}() ({what}) acquired without "
+                        f"{'/'.join(rel_names)} on the exception path — "
+                        f"release in a finally: block (or handler) so a "
+                        f"failure cannot leak it"))
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    V().visit(tree)
+    return findings
+
+
+def lint_guarded(root: Optional[str] = None, parsed=None) -> List:
+    """The guarded-by + lifecycle passes over the whole package — run
+    by ``--lint`` and ``lint_package`` alongside the PR 6 rules."""
+    from .lint import package_root, parse_package
+
+    root = root or package_root()
+    findings: List = []
+    pkg_parent = os.path.dirname(root)
+    for path, _, tree in (parsed if parsed is not None
+                          else parse_package(root)):
+        rel = os.path.relpath(path, pkg_parent)
+        if rel.endswith(os.path.join("analysis", "guarded.py")):
+            continue  # this module's own rule tables
+        findings.extend(lint_guarded_module(rel, tree))
+        findings.extend(lint_lifecycle_module(rel, tree))
+    return findings

@@ -637,7 +637,9 @@ def lint_purity(root: Optional[str] = None,
 def lint_package(root: Optional[str] = None,
                  apply_waivers: bool = True) -> List[Finding]:
     """Every AST rule + the conf drift gate + the static lock-order
-    pass, waivers applied.  The ``--lint`` CLI and tier-1 run this."""
+    pass + the guarded-by/lifecycle passes, waivers applied.  The
+    ``--lint`` CLI and tier-1 run this."""
+    from .guarded import lint_guarded
     from .locks import lint_lock_order
 
     root = root or package_root()
@@ -647,9 +649,55 @@ def lint_package(root: Optional[str] = None,
         + lint_uncached_jit(root, parsed)
         + lint_emit_under_lock(root, parsed)
         + lint_lock_order(root, parsed)
+        + lint_guarded(root, parsed)
         + lint_conf_registry(root, parsed=parsed)
     )
     if apply_waivers:
         waivers = load_waivers()
         findings = [f for f in findings if not _waived(f, waivers)]
     return findings
+
+
+# ------------------------------------------------- machine-readable out
+
+#: golden key sets for the ``--lint --json`` document — pinned by
+#: tests/test_guarded.py the way --report --json keys are pinned, so
+#: CI consumers diffing lint runs never chase silent shape drift
+LINT_JSON_TOP_KEYS = ("findings", "summary")
+LINT_JSON_FINDING_KEYS = ("rule", "path", "line", "symbol", "message",
+                          "waived")
+LINT_JSON_SUMMARY_KEYS = ("total", "waived", "unwaived", "plans_verified",
+                          "waivers_pinned")
+
+
+def findings_with_waivers(root: Optional[str] = None
+                          ) -> List[Tuple[Finding, bool]]:
+    """Every finding of :func:`lint_package` WITH its waived flag —
+    the ``--lint --json`` source (waived findings are reported, marked,
+    and excluded from the exit code)."""
+    waivers = load_waivers()
+    return [(f, _waived(f, waivers))
+            for f in lint_package(root, apply_waivers=False)]
+
+
+def lint_json_doc(pairs: Sequence[Tuple[Finding, bool]],
+                  plans_verified: int = 0) -> Dict:
+    """The machine-readable lint document (``--lint --json``): one
+    entry per finding carrying rule id, location, and the waived flag,
+    plus a summary block.  Key sets are golden-pinned."""
+    findings = [
+        {"rule": f.rule, "path": f.path, "line": f.line,
+         "symbol": f.symbol, "message": f.message, "waived": waived}
+        for f, waived in pairs
+    ]
+    n_waived = sum(1 for _, w in pairs if w)
+    return {
+        "findings": findings,
+        "summary": {
+            "total": len(pairs),
+            "waived": n_waived,
+            "unwaived": len(pairs) - n_waived,
+            "plans_verified": plans_verified,
+            "waivers_pinned": len(load_waivers()),
+        },
+    }

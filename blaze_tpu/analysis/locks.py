@@ -71,12 +71,20 @@ HIERARCHY: Tuple[str, ...] = (
     "trace.log",             # event-log file IO
     "trace.sink",            # kernel-attribution sinks
     "trace.sample",          # sampling counter
-    "conf.store",            # conf key/value store (innermost)
+    "conf.store",            # conf key/value store
+    "lockset.state",         # dynamic lockset-checker table (innermost:
+                             # guarded accesses record while holding
+                             # ANY of the locks above)
 )
 
 RANK: Dict[str, int] = {name: i for i, name in enumerate(HIERARCHY)}
 
 _ARMED = False
+#: held-stack tracking WITHOUT order assertions — armed by the dynamic
+#: lockset checker (runtime/lockset.py), which needs to read the
+#: per-thread held lockset at each guarded access even when the
+#: lock-order assertion itself is off
+_TRACK = False
 _tls = threading.local()
 
 
@@ -120,9 +128,9 @@ class OrderedLock:
         return stack
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        if _ARMED:
+        if _ARMED or _TRACK:
             stack = self._held_stack()
-            if stack and any(h.rank >= self.rank for h in stack):
+            if _ARMED and stack and any(h.rank >= self.rank for h in stack):
                 raise LockOrderError(self.name, [h.name for h in stack])
             got = self._inner.acquire(blocking, timeout)
             if got:
@@ -189,6 +197,19 @@ def refresh() -> None:
     from .. import conf
 
     arm(bool(conf.VERIFY_LOCKS.get()))
+
+
+def set_tracking(on: bool) -> None:
+    """Flip held-stack tracking WITHOUT the order assertion — the
+    dynamic lockset checker (runtime/lockset.py) arms this so
+    :func:`held_names` is populated even when ``verify.locks`` is off.
+    Same quiescent-point caveat as :func:`arm`; the calling thread's
+    stack is reset, other threads' stacks drain as their scopes exit.
+    Release pops unconditionally either way, so flipping tracking off
+    can never strand an entry."""
+    global _TRACK
+    _TRACK = on
+    _tls.held = []
 
 
 def held_names() -> List[str]:

@@ -18,10 +18,15 @@ from typing import Any, Callable, Dict
 
 from .analysis.locks import make_lock
 
-# innermost lock of the declared hierarchy (analysis/locks.py): every
-# subsystem reads conf while holding its own locks, never vice versa
+# innermost subsystem lock of the declared hierarchy (analysis/locks.py):
+# every subsystem reads conf while holding its own locks, never vice versa
 _lock = make_lock("conf.store")
 _values: Dict[str, Any] = {}
+
+# guarded-by declaration (analysis/guarded.py): the live conf store is
+# read from every subsystem's threads and written by the gateway/tests
+GUARDED_BY = {"_values": "conf.store"}
+GUARDED_REFS = ("_values",)
 
 
 class ConfEntry:
@@ -245,6 +250,15 @@ VERIFY_PLAN = ConfEntry("spark.blaze.verify.plan", False, _bool)
 # deterministically instead of as a rare hang.  Armed in --chaos and
 # the monitor/fault test suites; disarmed cost is one bool read.
 VERIFY_LOCKS = ConfEntry("spark.blaze.verify.locks", False, _bool)
+# Eraser-style dynamic lockset checker (runtime/lockset.py): while
+# armed, every instrumented guarded-state access records the thread's
+# held lockset, and a per-(object, attribute) empty intersection after
+# the state is seen from >=2 threads raises LocksetViolation — the
+# data race the static guarded-by pass (analysis/guarded.py) cannot
+# see through dynamic dispatch surfaces deterministically.  Armed in
+# --chaos / --chaos-seeds and the concurrency suites; disarmed cost is
+# one bool read per instrumented access.
+VERIFY_LOCKSET = ConfEntry("spark.blaze.verify.lockset", False, _bool)
 
 # Per-operator enable flags, ≙ BlazeConverters.scala:82-120
 # (spark.blaze.enable.scan / .project / .filter / ...).

@@ -44,6 +44,7 @@ from ..batch import Column, RecordBatch, bucket_capacity, concat_batches
 from ..exprs.compile import infer_dtype, lower
 from ..exprs.ir import Expr
 from ..io.batch_serde import deserialize_batch, serialize_batch
+from ..runtime import faults
 from ..runtime.context import TaskContext
 from ..runtime.memmgr import MemConsumer, MemManager, Spill, try_new_spill
 from ..schema import (
@@ -1745,6 +1746,9 @@ class _AggConsumer(MemConsumer):
         self.trigger_spill_check()
 
     def spill(self) -> int:
+        # fault probe at the spill entry, outside the state lock (see
+        # _SortState.spill)
+        faults.hit("spill.write")
         with self._lock:
             if self._closed:
                 # finish() is draining: a spill landing now would

@@ -29,6 +29,7 @@ from ...batch import RecordBatch, concat_batches
 from ...exprs.compile import lower
 from ...exprs.ir import Expr
 from ...io.batch_serde import deserialize_batch, serialize_batch
+from ...runtime import faults
 from ...runtime.context import TaskContext
 from ...runtime.memmgr import MemConsumer, Spill, try_new_spill
 from ...schema import Schema
@@ -117,6 +118,10 @@ class _Window(MemConsumer):
         self.trigger_spill_check()
 
     def spill(self) -> int:
+        # fault probe at the spill entry, outside the window lock (see
+        # ShuffleRepartitioner.spill) — this is what retired the
+        # _Window.spill emit-under-lock waiver
+        faults.hit("spill.write")
         with self._lock:
             freed = 0
             for e in self.entries:

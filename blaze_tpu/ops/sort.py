@@ -36,6 +36,7 @@ from ..batch import Column, RecordBatch, _pad_1d, bucket_capacity, concat_batche
 from ..exprs.compile import lower
 from ..exprs.ir import Expr
 from ..io.batch_serde import deserialize_batch, serialize_batch
+from ..runtime import faults
 from ..runtime.context import TaskContext
 from ..runtime.memmgr import MemConsumer, Spill, try_new_spill
 from ..schema import Schema
@@ -195,6 +196,10 @@ class _SortState(MemConsumer):
             return list(self.buffered), list(self.spills)
 
     def spill(self) -> int:
+        # fault probe at the spill entry, outside the state lock (the
+        # probe's trace emission must never ride inside a critical
+        # section — the lock.emit-under-lock class)
+        faults.hit("spill.write")
         with self._lock:
             if self._frozen or not self.buffered:
                 return 0

@@ -43,7 +43,7 @@ from ..io.batch_serde import deserialize_batch, serialize_batch
 from ..io.ipc_compression import IpcFrameReader, IpcFrameWriter, compress_frame
 from ..ops.base import BatchStream, ExecNode
 from ..runtime import monitor
-from ..runtime import faults, trace
+from ..runtime import faults, lockset, trace
 from ..runtime.context import TaskContext
 from ..runtime.memmgr import MemConsumer, Spill, try_new_spill
 from ..runtime.retry import FetchFailedError
@@ -179,6 +179,14 @@ class ShuffleRepartitioner(MemConsumer):
 
     name = "shuffle"
 
+    #: guarded-by declaration (analysis/guarded.py): the async stager,
+    #: the map-task producer, and the memory manager's cross-thread
+    #: spills all mutate the staged buffers
+    GUARDED_BY = {"_buffers": "shuffle.repartitioner",
+                  "_buffered_bytes": "shuffle.repartitioner",
+                  "_spills": "shuffle.repartitioner"}
+    GUARDED_REFS = ("_buffers", "_spills")
+
     def __init__(self, schema: Schema, n_out: int, metrics, task_attempt_id: int = 0):
         super().__init__()
         self.schema = schema
@@ -215,6 +223,7 @@ class ShuffleRepartitioner(MemConsumer):
         offsets = np.concatenate([[0], np.cumsum(counts)])
         cols = sorted_batch_host.columns
         with self._lock:
+            lockset.check(self, "_buffers", "_buffered_bytes")
             for pid in range(self.n_out):
                 lo, hi = int(offsets[pid]), int(offsets[pid + 1])
                 if hi == lo:
@@ -226,7 +235,14 @@ class ShuffleRepartitioner(MemConsumer):
         self.update_mem_used(buffered)
 
     def spill(self) -> int:
+        # the spill.write fault probe fires BEFORE the consumer lock:
+        # an injected spill failure still aborts cleanly (rows kept,
+        # task retries), and the probe's trace emission no longer rides
+        # three helper hops inside the critical section (the
+        # lock.emit-under-lock waiver this used to need is gone)
+        faults.hit("spill.write")
         with self._lock:
+            lockset.check(self, "_buffers", "_buffered_bytes", "_spills")
             if self._buffered_bytes == 0:
                 return 0
             sp = try_new_spill()
@@ -274,6 +290,7 @@ class ShuffleRepartitioner(MemConsumer):
         class the ``lock.emit-under-lock`` lint rule pins."""
         faults.hit("shuffle.write", attempt=self.task_attempt_id, detail=data_path)
         with self._lock:
+            lockset.check(self, "_buffers", "_buffered_bytes", "_spills")
             lengths = self._write_output_locked(data_path, index_path)
         trace.emit("shuffle_write", bytes=sum(lengths),
                    blocks=sum(1 for ln in lengths if ln),
@@ -450,6 +467,17 @@ class _AsyncInserter:
     semantics in write_output are untouched."""
 
     _DONE = object()
+
+    #: audited deliberately-unlocked state (analysis/guarded.py): one
+    #: writer each, reader tolerates staleness by a bounded window
+    LOCK_FREE = {
+        "_errs": "appended only by the stager thread; the producer's "
+                 "racy emptiness read delays surfacing by at most one "
+                 "put(), and close() re-checks after the join barrier",
+        "_aborted": "written only by the producer in abort(); the "
+                    "stager's racy read can at worst stage one batch "
+                    "into a repartitioner whose output is discarded",
+    }
 
     def __init__(self, rep: "ShuffleRepartitioner", schema: Schema,
                  depth: int, metrics):

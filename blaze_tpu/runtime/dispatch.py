@@ -42,17 +42,26 @@ import time
 from typing import Callable, Dict, Iterator, List
 
 from ..analysis.locks import make_lock
-from . import trace
+from . import lockset, trace
 from .metrics import _remove_by_identity
 
 _LOCK = make_lock("dispatch.counters")
 _GLOBAL: Dict[str, int] = {}
 _CAPTURES: List[Dict[str, int]] = []
+_TALLY = lockset.module_guard(__name__)
+
+#: guarded-by declaration (analysis/guarded.py): every kernel call on
+#: every thread lands here, and capture registration races the
+#: recording hot path
+GUARDED_BY = {"_GLOBAL": "dispatch.counters",
+              "_CAPTURES": "dispatch.counters"}
+GUARDED_REFS = ("_GLOBAL", "_CAPTURES")
 
 
 def record(name: str, v: int = 1) -> None:
     """Add ``v`` under ``name`` globally and in every active capture."""
     with _LOCK:
+        lockset.check(_TALLY, "_GLOBAL", "_CAPTURES")
         _GLOBAL[name] = _GLOBAL.get(name, 0) + int(v)
         for c in _CAPTURES:
             c[name] = c.get(name, 0) + int(v)
@@ -63,6 +72,7 @@ def record_max(name: str, v: int) -> None:
     a structure (longest fused-chain length) rather than an event
     count, so per-task plan rebuilds don't inflate them."""
     with _LOCK:
+        lockset.check(_TALLY, "_GLOBAL", "_CAPTURES")
         _GLOBAL[name] = max(_GLOBAL.get(name, 0), int(v))
         for c in _CAPTURES:
             c[name] = max(c.get(name, 0), int(v))
@@ -91,6 +101,7 @@ def capture() -> Iterator[Dict[str, int]]:
     captures per stage while bench captures per query)."""
     c: Dict[str, int] = {}
     with _LOCK:
+        lockset.check(_TALLY, "_CAPTURES")
         _CAPTURES.append(c)
     try:
         yield c

@@ -16,7 +16,8 @@ site                instrumented where
 ``shuffle.fetch``   IpcReaderExec block reads (raises FetchFailedError)
 ``task.compute``    serde.from_proto.run_task (any task body)
 ``rss.push``        RssShuffleWriterExec partition pushes
-``spill.write``     memmgr spill frame encoding
+``spill.write``     consumer spill() entry points (shuffle/sort/agg/
+                    smj windows), probed OUTSIDE their state locks
 ==================  ====================================================
 
 A *schedule* maps each site to the 1-based hit numbers that must raise,

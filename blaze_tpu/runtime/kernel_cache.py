@@ -21,9 +21,17 @@ from typing import Any, Callable, Dict, Tuple
 
 from ..analysis.locks import make_lock
 from ..schema import Schema
+from . import lockset
 
 _CACHE: Dict[tuple, Any] = {}
 _LOCK = make_lock("kernel_cache.registry")
+_REG = lockset.module_guard(__name__)
+
+#: guarded-by declaration (analysis/guarded.py): concurrent map tasks
+#: cold-hit the same kernels (exchange fan-out) — registry growth must
+#: hold the lock
+GUARDED_BY = {"_CACHE": "kernel_cache.registry"}
+GUARDED_REFS = ("_CACHE",)
 
 
 def schema_key(schema: Schema) -> Tuple:
@@ -64,11 +72,13 @@ def cached_kernel(key: tuple, builder: Callable[[], Any]) -> Any:
     if not key_cacheable(key):
         return _instrumented(builder(), _kernel_label(key))
     with _LOCK:
+        lockset.check(_REG, "_CACHE")
         hit = _CACHE.get(key)
         if hit is not None:
             return hit
     built = _instrumented(builder(), _kernel_label(key))
     with _LOCK:
+        lockset.check(_REG, "_CACHE")
         return _CACHE.setdefault(key, built)
 
 

@@ -1,0 +1,203 @@
+"""Eraser-style dynamic lockset checker for guarded shared state.
+
+The static guarded-by pass (analysis/guarded.py) proves lock coverage
+for the accesses it can SEE — ``self.<attr>`` inside the declaring
+class, module globals inside the declaring module.  Everything it
+can't see through (dynamic dispatch, cross-object access, callbacks
+fired from another subsystem's thread) is this module's job, the
+classic complement (Savage et al., *Eraser*): at each instrumented
+guarded access, record the set of hierarchy locks the accessing thread
+holds; per (object, attribute), once the attribute has been touched by
+a second thread, intersect the held sets — and raise a deterministic
+:class:`LocksetViolation` at the FIRST access that empties the
+intersection, instead of letting the race corrupt state once per
+thousand runs.
+
+Arming (conf ``spark.blaze.verify.lockset``, forced on in ``--chaos``
+/ ``--chaos-seeds`` and the concurrency suites) also flips the
+held-stack tracking in ``analysis.locks`` (:func:`locks.set_tracking`)
+so ``make_lock`` locks record acquisition even when the lock-ORDER
+assertion is off.  Disarmed — the default — every :func:`check` call
+returns after one module-global bool read, the same structural-no-op
+contract as ``trace.enabled()`` and the order checker.
+
+Single-owner init is exempt exactly as in Eraser: while only one
+thread has ever touched the attribute, nothing is intersected
+(unlocked construction is fine); the candidate lockset starts at the
+SECOND thread's access.  ``id()`` reuse after GC is detected by type
+mismatch and resets the entry; the table is bounded and best-effort —
+the checker exists to surface races deterministically in armed runs,
+not to be a proof.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, FrozenSet, Optional, Set, Tuple
+
+from ..analysis import locks as _locks
+from ..analysis.locks import make_lock
+
+_ARMED = False
+_loaded = False
+_lock = make_lock("lockset.state")
+_ACCESS: Dict[Tuple[int, str], "_Entry"] = {}
+# dict-as-set (subscript-assign, not .add()): a raised violation
+# suppresses re-raises for the same (object, attribute) so the first
+# failure surfaces cleanly instead of cascading across threads.  The
+# VALUE is the human-readable description — :func:`reported` exposes it
+# so gates (--chaos) still fail when the raise itself was swallowed by
+# an intermediate handler (e.g. the monitor HTTP handler's blanket
+# except turns any render error into a 500)
+_reported: Dict[Tuple[int, str], str] = {}
+_checked = 0
+#: best-effort bound on the tracked-variable table: guarded state is a
+#: handful of long-lived registries/accumulators per process, so the
+#: cap exists only to keep a pathological run from growing unbounded
+_MAX_TRACKED = 1 << 16
+
+
+class LocksetViolation(AssertionError):
+    """A guarded attribute was accessed from >=2 threads with no lock
+    in common — the race the guarded-by declaration exists to forbid."""
+
+    def __init__(self, owner_desc: str, attr: str, held: FrozenSet[str],
+                 n_threads: int):
+        self.owner_desc = owner_desc
+        self.attr = attr
+        self.held = set(held)
+        super().__init__(
+            f"lockset violation: {owner_desc}.{attr} has been accessed "
+            f"from {n_threads} threads and the common lockset is now "
+            f"EMPTY (this access holds {sorted(held) or 'no locks'}) — "
+            f"the guarded-by declaration requires one common lock on "
+            f"every access")
+
+
+class _Entry:
+    __slots__ = ("type_name", "lockset", "threads")
+
+    def __init__(self, type_name: str, tid: int):
+        self.type_name = type_name
+        #: None while in the single-owner (init) phase; a frozenset of
+        #: lock names once shared
+        self.lockset: Optional[FrozenSet[str]] = None
+        self.threads: Set[int] = {tid}
+
+
+class _ModuleGuard:
+    """Owner sentinel for module-level guarded globals — gives the
+    violation message a module name instead of a bare ``dict``."""
+
+    __slots__ = ("module",)
+
+    def __init__(self, module: str):
+        self.module = module
+
+
+def module_guard(module: str) -> _ModuleGuard:
+    return _ModuleGuard(module)
+
+
+def _owner_desc(owner: Any) -> str:
+    if isinstance(owner, _ModuleGuard):
+        return owner.module
+    return type(owner).__name__
+
+
+def armed() -> bool:
+    if not _loaded:
+        refresh()
+    return _ARMED
+
+
+def arm(on: bool) -> None:
+    """Directly flip the checker (tests); :func:`refresh` reads conf.
+    Arming also flips the held-stack tracking in ``analysis.locks`` and
+    clears the access table, so each armed window judges only its own
+    accesses.  Flip at quiescent points (same caveat as locks.arm)."""
+    global _ARMED, _loaded, _checked
+    _locks.set_tracking(on)
+    with _lock:
+        _ACCESS.clear()
+        _reported.clear()
+        _checked = 0
+    _ARMED = on
+    _loaded = True
+
+
+def refresh() -> None:
+    """(Re)load arming from conf ``spark.blaze.verify.lockset`` — the
+    chaos CLI and the concurrency suites call this after setting it.
+    Lazy import: conf creates its own lock through analysis.locks."""
+    from .. import conf
+
+    arm(bool(conf.VERIFY_LOCKSET.get()))
+
+
+def reset() -> None:
+    """Clear the access table and counters without changing arming."""
+    global _checked
+    with _lock:
+        _ACCESS.clear()
+        _reported.clear()
+        _checked = 0
+
+
+def counters() -> Dict[str, int]:
+    """Introspection: instrumented accesses recorded while armed
+    (``lockset_checked_accesses`` in the chaos counters) and live
+    tracked (object, attribute) pairs."""
+    with _lock:
+        return {"checked_accesses": _checked, "tracked": len(_ACCESS)}
+
+
+def reported() -> list:
+    """Descriptions of every violation detected since the last
+    :func:`arm`/:func:`reset` — non-empty even when the raised
+    :class:`LocksetViolation` was swallowed by an intermediate handler
+    (a monitor HTTP 500, an operator's blanket except): gates check
+    THIS, not just propagation."""
+    with _lock:
+        return list(_reported.values())
+
+
+def check(owner: Any, *attrs: str) -> None:
+    """THE instrumentation hookpoint: call at a guarded access, while
+    holding whatever locks the access holds (typically just inside the
+    critical section).  Disarmed cost: one module-global bool read."""
+    if not _ARMED:
+        return
+    _record(owner, attrs)
+
+
+def _record(owner: Any, attrs: Tuple[str, ...]) -> None:
+    global _checked
+    # the held set is computed BEFORE taking the checker's own state
+    # lock, so "lockset.state" never pollutes a candidate set
+    held = frozenset(_locks.held_names())
+    tid = threading.get_ident()
+    tname = type(owner).__name__
+    oid = id(owner)
+    with _lock:
+        _checked += len(attrs)
+        if len(_ACCESS) > _MAX_TRACKED:
+            _ACCESS.clear()  # best-effort: restart the table
+        for attr in attrs:
+            key = (oid, attr)
+            e = _ACCESS.get(key)
+            if e is None or e.type_name != tname:
+                # first sight (or id() reuse after GC): single-owner
+                # phase, nothing to intersect yet
+                _ACCESS[key] = _Entry(tname, tid)
+                continue
+            e.threads.add(tid)
+            if len(e.threads) < 2:
+                continue  # still exclusive to the first thread
+            e.lockset = held if e.lockset is None else e.lockset & held
+            if not e.lockset and key not in _reported:
+                v = LocksetViolation(_owner_desc(owner), attr, held,
+                                     len(e.threads))
+                _reported[key] = str(v)
+                del _ACCESS[key]
+                raise v

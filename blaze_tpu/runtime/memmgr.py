@@ -24,7 +24,7 @@ import zlib
 from typing import Dict, List, Optional
 
 from .. import conf
-from . import faults
+from . import lockset
 
 
 class Spill:
@@ -49,7 +49,12 @@ class Spill:
 
 
 def _encode_frame(payload: bytes, codec: str) -> bytes:
-    faults.hit("spill.write")
+    # NOTE: the spill.write fault probe lives at the consumer spill()
+    # entry points (shuffle/sort/agg/smj), OUTSIDE their state locks —
+    # probing here put a trace emission (fault_injected) three helper
+    # hops inside every spill critical section, which is exactly the
+    # lock.emit-under-lock class the linter pins (the two waivers that
+    # covered it are gone)
     if codec == "zlib":
         comp = zlib.compress(payload, 1)
         return len(comp).to_bytes(4, "little") + b"\x01" + comp
@@ -131,6 +136,12 @@ class MemConsumer:
 
     name: str = "consumer"
 
+    #: guarded-by declaration (analysis/guarded.py): the manager reads
+    #: every consumer's usage from OTHER tasks' threads when picking
+    #: spill victims.  The unmanaged branches (manager None = consumer
+    #: not registered, thread-private) are waived in lint_waivers.json.
+    GUARDED_BY = {"_mem_used": "memmgr.manager"}
+
     def __init__(self):
         self._mem_used = 0
         self._manager: Optional["MemManager"] = None
@@ -154,6 +165,7 @@ class MemConsumer:
         mgr = self._manager
         if mgr is not None:
             with mgr._lock:
+                lockset.check(self, "_mem_used")
                 self._mem_used = new_used
         else:
             self._mem_used = new_used
@@ -176,6 +188,15 @@ class MemManager:
 
     _global: Optional["MemManager"] = None
     _global_lock = threading.Lock()
+
+    #: the consumer list and spill tallies are mutated under the
+    #: watermark checks of concurrent tasks
+    GUARDED_BY = {"_consumers": "memmgr.manager",
+                  "spill_count": "memmgr.manager",
+                  "spilled_bytes": "memmgr.manager",
+                  "_traced_peak": "memmgr.manager",
+                  "_traced_log": "memmgr.manager"}
+    GUARDED_REFS = ("_consumers",)
 
     def __init__(self, total: int, watermark: float = 0.9):
         from ..analysis.locks import make_lock
@@ -207,22 +228,36 @@ class MemManager:
 
     def register_consumer(self, consumer: MemConsumer) -> None:
         with self._lock:
+            lockset.check(self, "_consumers")
             consumer._manager = self
             self._consumers.append(consumer)
 
     def unregister_consumer(self, consumer: MemConsumer) -> None:
         with self._lock:
+            lockset.check(self, "_consumers")
             consumer._manager = None
             if consumer in self._consumers:
                 self._consumers.remove(consumer)
 
     def _total_used(self) -> int:
+        # caller holds self._lock (the guarded-by pass verifies: every
+        # call site is inside a `with <memmgr.manager>:` span)
         return sum(c._mem_used for c in self._consumers)
+
+    def total_used(self) -> int:
+        """Locked read of the tracked usage — the public counterpart
+        of ``_total_used`` for off-lock callers (try_new_spill's tier
+        decision previously read the consumer list bare, a guarded-by
+        finding)."""
+        with self._lock:
+            return self._total_used()
 
     def _update(self, consumer: MemConsumer, new_used: int) -> None:
         from . import trace
 
         with self._lock:
+            lockset.check(self, "_consumers")
+            lockset.check(consumer, "_mem_used")
             consumer._mem_used = new_used
             emit_peak = 0
             # ratchet only while tracing is armed (an untraced run
@@ -249,20 +284,28 @@ class MemManager:
             over = self._total_used() - int(self.total * self.watermark)
             if over <= 0:
                 return
-            victims = sorted(self._consumers, key=lambda c: -c._mem_used)
+            # snapshot (consumer, usage) pairs under the lock: the old
+            # bare `v._mem_used == 0` re-read in the loop below raced
+            # concurrent accounting off-lock (guarded-by finding); a
+            # stale snapshot is benign — spilling an already-drained
+            # victim finds no state and returns 0
+            victims = sorted(
+                ((c, c._mem_used) for c in self._consumers),
+                key=lambda cu: -cu[1])
         # spill outside the lock: consumers re-enter accounting; a
         # concurrent spill of the same victim is benign (its spill()
         # finds no state and returns 0, which we don't count)
         from . import trace
 
-        for v in victims:
+        for v, used in victims:
             if over <= 0:
                 break
-            if v._mem_used == 0:
+            if used == 0:
                 continue
             freed = v.spill()
             if freed > 0:
                 with self._lock:
+                    lockset.check(self, "spill_count", "spilled_bytes")
                     self.spill_count += 1
                     self.spilled_bytes += freed
                 trace.emit("spill", consumer=v.name, bytes=freed)
@@ -275,6 +318,6 @@ def try_new_spill(codec: Optional[str] = None) -> Spill:
     (memmgr/spill.rs:65-80)."""
     codec = codec or str(conf.SPILL_COMPRESSION_CODEC.get())
     mgr = MemManager.get()
-    if mgr._total_used() < mgr.total // 2:
+    if mgr.total_used() < mgr.total // 2:
         return HostMemSpill(codec)
     return FileSpill(codec)

@@ -31,6 +31,7 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional, Set
 
 from ..analysis.locks import make_lock
+from . import lockset
 
 METRIC_NAMES_PATH = os.path.join(
     os.path.dirname(__file__), "metric_names.json")
@@ -67,25 +68,35 @@ def registered_metric_names() -> Set[str]:
 class MetricsSet:
     """Counters + timers for one operator instance (thread-safe)."""
 
+    #: guarded-by declaration (analysis/guarded.py): operators share
+    #: one set across worker threads, and values[name] = get + v is a
+    #: read-modify-write race off-lock
+    GUARDED_BY = {"values": "metrics.set"}
+    GUARDED_REFS = ("values",)
+
     def __init__(self):
         self.values: Dict[str, int] = {}
         self._lock = make_lock("metrics.set")
 
     def add(self, name: str, v: int = 1) -> None:
         with self._lock:
+            lockset.check(self, "values")
             self.values[name] = self.values.get(name, 0) + int(v)
 
     def set(self, name: str, v: int) -> None:
         with self._lock:
+            lockset.check(self, "values")
             self.values[name] = int(v)
 
     def get(self, name: str) -> int:
         with self._lock:
+            lockset.check(self, "values")
             return self.values.get(name, 0)
 
     def snapshot(self) -> Dict[str, int]:
         """Point-in-time copy (trace task_plan events, tests)."""
         with self._lock:
+            lockset.check(self, "values")
             return dict(self.values)
 
     def merge(self, other: "MetricsSet") -> None:
@@ -111,6 +122,11 @@ class MetricNode:
     gateway registers a callback per node to push values into
     SQLMetrics; standalone runs just read the tree."""
 
+    #: children grow concurrently (exchange fan-out tasks descending
+    #: into fresh stage nodes) — list append/len is guarded
+    GUARDED_BY = {"children": "metrics.node"}
+    GUARDED_REFS = ("children",)
+
     def __init__(self, metrics: Optional[MetricsSet] = None, children: Optional[List["MetricNode"]] = None):
         self.metrics = metrics or MetricsSet()
         self.children = children or []
@@ -118,13 +134,21 @@ class MetricNode:
 
     def child(self, i: int) -> "MetricNode":
         with self._lock:
+            lockset.check(self, "children")
             while len(self.children) <= i:
                 self.children.append(MetricNode())
             return self.children[i]
 
     def foreach(self, fn, path=()):
+        # the child-list snapshot is taken under the lock (a concurrent
+        # child() append mid-iteration raced the bare list read); fn
+        # runs OUTSIDE it — callbacks may emit, and holding a lock
+        # across emission is the emit-under-lock class
+        with self._lock:
+            lockset.check(self, "children")
+            kids = list(self.children)
         fn(path, self.metrics)
-        for i, c in enumerate(list(self.children)):
+        for i, c in enumerate(kids):
             c.foreach(fn, path + (i,))
 
     def flatten(self) -> Dict[str, int]:

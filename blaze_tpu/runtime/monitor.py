@@ -50,11 +50,21 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from .. import conf
 from ..analysis.locks import make_lock
-from . import trace
+from . import lockset, trace
 
 # --------------------------------------------------------------- state
 
 _lock = make_lock("monitor.registry")
+_REG = lockset.module_guard(__name__)
+
+#: guarded-by declaration (analysis/guarded.py): the live registry is
+#: written from query/attempt threads and read by monitor handler
+#: threads; _armed/_hb_ns/_loaded are load-once config reads and stay
+#: undeclared like trace._armed
+GUARDED_BY = {"_QUERIES": "monitor.registry",
+              "_updates": "monitor.registry",
+              "_seq": "monitor.registry"}
+GUARDED_REFS = ("_QUERIES",)
 _loaded = False
 _armed = False
 _hb_ns = 1_000_000_000
@@ -141,6 +151,7 @@ def _copy_counters(cap: Optional[Dict[str, int]]) -> Dict[str, int]:
 
 def _bump() -> None:
     global _updates
+    lockset.check(_REG, "_QUERIES", "_updates")
     _updates += 1  # caller holds _lock
 
 
@@ -370,6 +381,7 @@ def snapshot() -> Dict[str, Any]:
     now = time.monotonic_ns()
     queries: List[Dict[str, Any]] = []
     with _lock:
+        lockset.check(_REG, "_QUERIES")
         for q in _QUERIES.values():
             end = q["t_end"] or now
             stages = []
@@ -511,6 +523,16 @@ class StageProgress:
                  "_attempts", "_t0", "_interval", "_next", "_dirty",
                  "_plock")
 
+    #: guarded-by declaration (analysis/guarded.py): the speculative
+    #: attempt runner mutates these from worker threads; the PR 7
+    #: review class this whole subsystem exists to close
+    GUARDED_BY = {"rows": "monitor.progress",
+                  "bytes": "monitor.progress",
+                  "batches": "monitor.progress",
+                  "tasks_done": "monitor.progress",
+                  "_dirty": "monitor.progress",
+                  "_next": "monitor.progress"}
+
     def __init__(self, stage_id: int, kind: Optional[str], n_tasks: int,
                  counters: Optional[Dict[str, int]] = None, attempts=None):
         self.traced = trace.enabled()
@@ -540,6 +562,7 @@ class StageProgress:
             return
         nbytes = sum(getattr(c.data, "nbytes", 0) for c in batch.columns)
         with self._plock:
+            lockset.check(self, "rows", "bytes", "batches")
             self.rows += batch.num_rows
             self.batches += 1
             self.bytes += nbytes
@@ -553,6 +576,7 @@ class StageProgress:
         if not self.armed:
             return
         with self._plock:
+            lockset.check(self, "tasks_done")
             self.tasks_done += 1
             self._dirty = True
             now = time.monotonic_ns()
@@ -571,6 +595,7 @@ class StageProgress:
         if not self.armed:
             return None
         with self._plock:
+            lockset.check(self, "rows", "bytes", "batches")
             return (self.rows, self.bytes, self.batches)
 
     def rollback(self, mark) -> None:
@@ -580,6 +605,7 @@ class StageProgress:
         if not self.armed or mark is None:
             return
         with self._plock:
+            lockset.check(self, "rows", "bytes", "batches")
             self.rows, self.bytes, self.batches = mark
             self._dirty = True
 
@@ -590,6 +616,7 @@ class StageProgress:
         if not self.armed:
             return
         with self._plock:
+            lockset.check(self, "rows", "bytes", "batches")
             self.rows -= rows
             self.bytes -= bytes_
             self.batches -= batches
@@ -602,6 +629,7 @@ class StageProgress:
         if not self.armed:
             return
         with self._plock:
+            lockset.check(self, "rows", "bytes", "batches", "tasks_done")
             if not (self._dirty or force):
                 return
             now = now or time.monotonic_ns()
@@ -645,6 +673,14 @@ class AttemptProgress:
     is only correct when attempts run strictly serially."""
 
     __slots__ = ("_p", "rows", "bytes", "batches")
+
+    #: audited deliberately-unlocked (analysis/guarded.py): the delta
+    #: fields belong to ONE attempt, and every touch (add_batch while
+    #: draining, discard on failure/loss) happens on that attempt's own
+    #: thread — the shared totals behind them are the guarded state
+    LOCK_FREE = {"rows": "single-owner attempt thread",
+                 "bytes": "single-owner attempt thread",
+                 "batches": "single-owner attempt thread"}
 
     def __init__(self, progress: StageProgress):
         self._p = progress

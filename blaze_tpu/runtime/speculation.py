@@ -126,6 +126,23 @@ class _Attempt:
                  "started", "last_beat", "done", "error", "ok",
                  "abandoned")
 
+    #: audited deliberately-unlocked state (analysis/guarded.py
+    #: LOCK_FREE declaration — "no declaration" must always mean
+    #: "unaudited", not "fine"): each field has ONE writer, and the
+    #: cross-thread reads tolerate the race by construction
+    LOCK_FREE = {
+        "last_beat": "written only by the attempt thread (beat); the "
+                     "driver poll's racy read is a monotonic float "
+                     "whose staleness is bounded by one poll period — "
+                     "at worst a wedge fires one cycle late",
+        "ok": "written by the attempt thread strictly BEFORE done.set()"
+              "; the driver reads it only after done.is_set() — the "
+              "Event is the happens-before edge",
+        "error": "same single-writer + done-Event publication as ok",
+        "abandoned": "driver-only field (set/read on the poll loop "
+                     "thread)",
+    }
+
     def __init__(self, task: int, attempt_id: int, speculative: bool):
         self.task = task
         self.attempt_id = attempt_id

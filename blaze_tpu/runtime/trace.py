@@ -51,6 +51,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from .. import conf
 from ..analysis.locks import make_lock
+from . import lockset
 from .metrics import _remove_by_identity
 
 # ------------------------------------------------------------- registry
@@ -115,6 +116,35 @@ _max_bytes = 0
 _events_emitted = 0
 _spans_opened = 0
 
+_LOG = lockset.module_guard(__name__)
+
+#: guarded-by declaration (analysis/guarded.py): the event-log file
+#: state is shared by every emitting thread; _armed/_dir/_sample_rate/
+#: _max_bytes are load-once config reads (off-lock by design, like the
+#: _KERNEL_TIMING hot-path bool) and stay undeclared
+GUARDED_BY = {"_file": "trace.log",
+              "_path": "trace.log",
+              "_default_path": "trace.log",
+              "_seq": "trace.log",
+              "_segments": "trace.log",
+              "_events_emitted": "trace.log",
+              "_spans_opened": "trace.log",
+              "_KERNEL_SINKS": "trace.sink",
+              "_sample_counter": "trace.sample"}
+GUARDED_REFS = ("_segments", "_KERNEL_SINKS")
+LOCK_FREE = {
+    "_current_path": "derived single-reference pointer, atomically "
+                     "swapped under trace.log at every _path/"
+                     "_default_path write site; the bare read cannot "
+                     "tear, and locking it would queue memmgr's "
+                     "per-batch accounting (which reads it while "
+                     "holding memmgr.manager) behind event-file IO",
+}
+
+#: _path or _default_path, maintained at every write site — the value
+#: current_path() serves without taking the log lock
+_current_path: Optional[str] = None
+
 
 def _load() -> None:
     global _loaded, _armed, _dir, _sample_rate, _max_bytes
@@ -139,11 +169,12 @@ def reset() -> None:
     """(Re)load arming + directory from conf and forget the current log
     file and counters — call after changing trace conf keys."""
     global _path, _default_path, _events_emitted, _spans_opened, _seq, _file
-    global _sample_counter
+    global _sample_counter, _current_path
     _load()
     with _lock:
         _path = None
         _default_path = None
+        _current_path = None
         _events_emitted = 0
         _spans_opened = 0
         _seq = 0
@@ -171,8 +202,12 @@ def log_dir() -> str:
 
 def current_path() -> Optional[str]:
     """The file events are being appended to right now (None when no
-    event has been written and no query span is open)."""
-    return _path or _default_path
+    event has been written and no query span is open).  Served from a
+    derived single-reference pointer swapped under the log lock at
+    every write site (LOCK_FREE-declared): callers include memmgr's
+    per-batch accounting while holding memmgr.manager, and taking the
+    log lock here would queue that hot path behind event-file IO."""
+    return _current_path
 
 
 # ------------------------------------------------------------- emission
@@ -185,17 +220,20 @@ def emit(etype: str, **fields: Any) -> None:
         return
     if etype not in EVENT_TYPES:
         raise ValueError(f"unregistered trace event type {etype!r}")
-    global _events_emitted, _default_path
+    global _events_emitted, _default_path, _current_path
     rec = {"ts": time.time(), "type": etype}
     rec.update(fields)
     line = json.dumps(rec, default=str)
     global _file
     with _lock:
+        lockset.check(_LOG, "_file", "_path", "_default_path",
+                      "_events_emitted", "_segments")
         path = _path
         if path is None:
             if _default_path is None:
                 _default_path = os.path.join(
                     _dir, f"blaze-{os.getpid()}.jsonl")
+                _current_path = _path or _default_path
                 os.makedirs(_dir, exist_ok=True)
             path = _default_path
         if _file is None or _file[0] != path:
@@ -234,14 +272,16 @@ def query(query_id: str) -> Iterator[Optional[str]]:
     if not enabled():
         yield None
         return
-    global _path, _seq, _spans_opened
+    global _path, _seq, _spans_opened, _current_path
     with _lock:
+        lockset.check(_LOG, "_path", "_seq", "_spans_opened")
         _seq += 1
         safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in query_id)
         path = os.path.join(_dir, f"{safe}-{os.getpid()}-{_seq}.jsonl")
         os.makedirs(_dir, exist_ok=True)
         prev = _path
         _path = path
+        _current_path = _path or _default_path
         _spans_opened += 1
     t0 = time.perf_counter_ns()
     emit("query_start", query_id=query_id)
@@ -256,6 +296,7 @@ def query(query_id: str) -> Iterator[Optional[str]]:
              wall_ns=time.perf_counter_ns() - t0)
         with _lock:
             _path = prev
+            _current_path = _path or _default_path
 
 
 # -------------------------------------------------- kernel attribution
@@ -274,6 +315,7 @@ def kernel_capture() -> Iterator[Dict[str, Dict[str, int]]]:
     global _KERNEL_TIMING
     sink: Dict[str, Dict[str, int]] = {}
     with _sink_lock:
+        lockset.check(_LOG, "_KERNEL_SINKS")
         _KERNEL_SINKS.append(sink)
         _KERNEL_TIMING = True
     try:
@@ -303,6 +345,7 @@ def sample_kernel() -> bool:
         return True
     global _sample_counter
     with _sample_lock:
+        lockset.check(_LOG, "_sample_counter")
         _sample_counter += 1
         return _sample_counter % rate == 1
 
@@ -314,6 +357,7 @@ def record_kernel(label: str, device_ns: int, dispatch_ns: int,
     a sampled-out program (launch overhead attributed, device drain
     not measured); consumers scale device time by programs/timed."""
     with _sink_lock:
+        lockset.check(_LOG, "_KERNEL_SINKS")
         for sink in _KERNEL_SINKS:
             agg = sink.get(label)
             if agg is None:

@@ -1,0 +1,183 @@
+"""Emitter-vs-catalyst drift gate (ROADMAP item 5, second half of the
+r5 next #6 ask — the fuzz suite covers parser ROBUSTNESS; this covers
+interface DRIFT).
+
+The conversion layer's contract is the field names/structure catalyst's
+``TreeNode.toJSON`` emits.  Both sides can silently rename:
+
+- OUR side: a converter starts (or stops) reading a field — the
+  mechanical extraction over ``spark/plan_json.py`` +
+  ``spark/converters.py`` must match the golden manifest
+  (``spark/catalyst_manifest.json``), so every change to the consumed
+  surface is a conscious manifest edit;
+- SPARK's side: a catalyst serialization rename would make the live
+  dump stop carrying a field a converter relies on — the manifest's
+  per-class required fields are diffed against the REAL Spark 3.5.1 q6
+  dump's observed shape, so refreshing the fixture against a drifted
+  Spark fails tier-1 instead of producing a wrong plan.
+"""
+
+import ast
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPARK_DIR = os.path.join(REPO, "blaze_tpu", "spark")
+MANIFEST_PATH = os.path.join(SPARK_DIR, "catalyst_manifest.json")
+Q6_DUMP = os.path.join(REPO, "tests", "fixtures", "spark351_q6_plan.json")
+
+#: the two modules whose dump consumption the manifest pins — the
+#: parser and the per-operator converters (expr_converter reads the
+#: same SparkNode accessors; its literals ride the same manifest once
+#: it is added here, consciously)
+CONSUMER_MODULES = ("plan_json.py", "converters.py")
+
+
+def load_manifest():
+    with open(MANIFEST_PATH) as f:
+        return json.load(f)
+
+
+def extract_consumed_fields():
+    """Every catalyst field-name literal the consumer modules read:
+    first args of the SparkNode accessors (``.expr()``/``.expr_list()``
+    /``.string()``) and dict ``.get()``s, plus string subscripts on
+    lowercase receivers (``obj["class"]``, ``node.fields["x"]`` —
+    uppercase receivers are typing generics like ``List["SparkNode"]``
+    and are not dump reads)."""
+    out = set()
+    for fname in CONSUMER_MODULES:
+        with open(os.path.join(SPARK_DIR, fname)) as f:
+            tree = ast.parse(f.read())
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("get", "expr", "expr_list", "string") \
+                    and n.args and isinstance(n.args[0], ast.Constant) \
+                    and isinstance(n.args[0].value, str):
+                out.add(n.args[0].value)
+            if isinstance(n, ast.Subscript) \
+                    and isinstance(n.slice, ast.Constant) \
+                    and isinstance(n.slice.value, str):
+                recv = n.value
+                if isinstance(recv, ast.Attribute):
+                    recv_name = recv.attr
+                elif isinstance(recv, ast.Name):
+                    recv_name = recv.id
+                else:
+                    continue
+                if recv_name[:1].islower():
+                    out.add(n.slice.value)
+    return out
+
+
+def walk_dump_nodes(value, out):
+    """Collect {short class name: [set of keys per node]} over the
+    whole dump, including nested expression arrays inside field
+    values."""
+    if isinstance(value, dict):
+        if "class" in value:
+            out.setdefault(value["class"].rsplit(".", 1)[-1], []).append(
+                set(value.keys()))
+        for v in value.values():
+            walk_dump_nodes(v, out)
+    elif isinstance(value, list):
+        for v in value:
+            walk_dump_nodes(v, out)
+
+
+def missing_required_fields(dump, required):
+    """[(class, node index, missing fields)] for every dump node of a
+    manifest-listed class lacking a required field."""
+    nodes = {}
+    walk_dump_nodes(dump, nodes)
+    missing = []
+    for cls, req in required.items():
+        for i, keys in enumerate(nodes.get(cls, [])):
+            lost = sorted(set(req) - keys)
+            if lost:
+                missing.append((cls, i, lost))
+    return missing
+
+
+# ------------------------------------------------- our-side drift gate
+
+def test_consumed_fields_match_manifest():
+    """Way 1: the conversion layer's consumed-field surface == the
+    manifest, both directions — a converter reading a NEW field (or a
+    typo'd one) fails until the manifest is consciously updated, and a
+    field nothing reads anymore leaves a stale manifest entry that
+    fails the other way."""
+    manifest = load_manifest()
+    declared = set(manifest["consumed_fields"])
+    live = extract_consumed_fields()
+    new = sorted(live - declared)
+    assert not new, (
+        f"conversion layer consumes catalyst fields not in "
+        f"spark/catalyst_manifest.json (new consumption or typo): {new}")
+    stale = sorted(declared - live)
+    assert not stale, (
+        f"manifest declares consumed fields nothing reads anymore "
+        f"(renamed without updating the manifest?): {stale}")
+
+
+def test_required_fields_are_consumed():
+    """Internal consistency: every per-class required field is part of
+    the consumed surface (or structural) — a required field nothing
+    reads would gate the dump on dead weight."""
+    manifest = load_manifest()
+    consumed = set(manifest["consumed_fields"]) | set(manifest["structural"])
+    for cls, req in manifest["required_node_fields"].items():
+        extra = sorted(set(req) - consumed)
+        assert not extra, f"{cls}: required fields not consumed: {extra}"
+
+
+# ----------------------------------------------- spark-side drift gate
+
+def test_live_q6_dump_carries_required_fields():
+    """Way 2: the live Spark 3.5.1 q6 dump carries, for every class
+    the manifest lists, every field the matching converter relies on —
+    refreshing the fixture against a Spark whose serialization renamed
+    one fails HERE instead of converting a wrong plan."""
+    with open(Q6_DUMP) as f:
+        dump = json.load(f)
+    manifest = load_manifest()
+    missing = missing_required_fields(dump, manifest["required_node_fields"])
+    assert not missing, (
+        f"live q6 dump nodes lost converter-required fields "
+        f"(catalyst serialization drift): {missing}")
+    # structural keys hold on every node in the dump
+    nodes = {}
+    walk_dump_nodes(dump, nodes)
+    assert nodes, "q6 dump parsed to no class-bearing nodes"
+    for cls, per_node in nodes.items():
+        for keys in per_node:
+            assert "num-children" in keys, (cls, sorted(keys))
+
+
+def test_drift_detection_actually_fires():
+    """The gate's own negative: renaming a field in a COPY of the live
+    dump (catalyst-side rename simulation) is detected."""
+    with open(Q6_DUMP) as f:
+        dump = json.load(f)
+    mutated = json.loads(
+        json.dumps(dump).replace('"condition"', '"filterCondition"'))
+    manifest = load_manifest()
+    missing = missing_required_fields(mutated,
+                                      manifest["required_node_fields"])
+    assert any(cls == "FilterExec" and "condition" in lost
+               for cls, _, lost in missing), missing
+
+
+def test_manifest_classes_present_in_dump():
+    """The fixture exercises the manifest: every class with required
+    fields that q6's plan shape can carry is actually present (q6 is
+    scan -> filter -> project -> partial agg -> exchange -> final agg),
+    so the spark-side gate is not vacuously green."""
+    with open(Q6_DUMP) as f:
+        dump = json.load(f)
+    nodes = {}
+    walk_dump_nodes(dump, nodes)
+    for cls in ("FileSourceScanExec", "FilterExec", "ProjectExec",
+                "HashAggregateExec", "ShuffleExchangeExec",
+                "AggregateExpression", "AttributeReference", "Literal"):
+        assert cls in nodes, f"q6 dump lost class {cls}"

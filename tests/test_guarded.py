@@ -1,0 +1,496 @@
+"""Guarded-by race detector (ISSUE 8): static lock-coverage pass
+(analysis/guarded.py) + Eraser-style runtime lockset checker
+(runtime/lockset.py).
+
+1. **Seeded static negatives**: each guard.* rule catches a
+   deliberately broken temp module, pinned by rule id + location.
+2. **Both halves on ONE seed**: the same off-lock mutation is caught
+   statically (guard.unlocked with the rule id) AND dynamically (a
+   deterministic LocksetViolation from the armed checker driven by a
+   second thread) — the acceptance criterion.
+3. **Lockset semantics**: single-owner init exemption, lock-covered
+   accesses stay quiet, violation suppression after first report,
+   disarmed structural no-op.
+4. **Deterministic two-thread interleavings** over the PR 7 seams the
+   checker guards: speculation loser-rollback vs winner-commit
+   (AttemptProgress.discard racing StageProgress.add_batch) and
+   _AsyncInserter abort vs put — barrier-driven so the schedule is
+   reproducible, each asserting the armed checker stays QUIET and the
+   accounting is exact.
+5. **--lint --json**: golden-pinned document keys.
+"""
+
+import importlib.util
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.analysis import guarded, lint
+from blaze_tpu.analysis import locks as alocks
+from blaze_tpu.batch import batch_from_pydict
+from blaze_tpu.runtime import lockset, monitor
+from blaze_tpu.schema import DataType, Field, Schema
+
+
+def _write_pkg(tmp_path, name, source):
+    pkg = tmp_path / name
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(source)
+    return str(pkg)
+
+
+@pytest.fixture
+def armed_lockset():
+    lockset.arm(True)
+    try:
+        yield
+    finally:
+        lockset.arm(False)
+
+
+# ------------------------------------------- 1. seeded static negatives
+
+SEED_UNLOCKED_CLASS = """\
+from blaze_tpu.analysis.locks import make_lock
+from blaze_tpu.runtime import lockset
+
+
+class Counter:
+    GUARDED_BY = {"count": "metrics.set"}
+
+    def __init__(self):
+        self._lock = make_lock("metrics.set")
+        self.count = 0
+
+    def safe_bump(self):
+        with self._lock:
+            lockset.check(self, "count")
+            self.count += 1
+
+    def helper_bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self.count += 1          # critical helper: called under the lock
+
+    def racy_bump(self):
+        lockset.check(self, "count")
+        self.count += 1          # OFF-LOCK: guard.unlocked
+"""
+
+
+def test_seeded_unlocked_class_attribute(tmp_path):
+    root = _write_pkg(tmp_path, "pkg_guard", SEED_UNLOCKED_CLASS)
+    findings = [f for f in guarded.lint_guarded(root)
+                if f.rule == "guard.unlocked"]
+    assert len(findings) == 1, findings
+    f = findings[0]
+    assert f.symbol == "Counter.racy_bump"
+    assert "metrics.set" in f.message
+    # location pins the mutation line, not the method header
+    assert "self.count += 1" in SEED_UNLOCKED_CLASS.splitlines()[f.line - 1]
+
+
+def test_seeded_unlocked_module_global(tmp_path):
+    root = _write_pkg(tmp_path, "pkg_guard_mod", """\
+from typing import Dict
+from blaze_tpu.analysis.locks import make_lock
+
+# type-ANNOTATED declaration spelling: must be honored, not silently
+# skipped (review finding)
+GUARDED_BY: Dict[str, str] = {"_TABLE": "kernel_cache.registry"}
+GUARDED_REFS = ("_TABLE",)
+_lock = make_lock("kernel_cache.registry")
+_TABLE = {}
+
+def safe_put(k, v):
+    with _lock:
+        _TABLE[k] = v
+
+def racy_put(k, v):
+    _TABLE[k] = v               # OFF-LOCK: guard.unlocked
+""")
+    findings = [f for f in guarded.lint_guarded(root)
+                if f.rule == "guard.unlocked"]
+    assert len(findings) == 1, findings
+    assert findings[0].symbol == "racy_put"
+    assert "kernel_cache.registry" in findings[0].message
+
+
+def test_seeded_escape_of_guarded_ref(tmp_path):
+    root = _write_pkg(tmp_path, "pkg_escape", """\
+from blaze_tpu.analysis.locks import make_lock
+
+
+class Registry:
+    GUARDED_BY = {"entries": "monitor.registry",
+                  "n": "monitor.registry"}
+    GUARDED_REFS = ("entries",)
+
+    def __init__(self):
+        self._lock = make_lock("monitor.registry")
+        self.entries = {}
+        self.n = 0
+
+    def snapshot_ok(self):
+        with self._lock:
+            return dict(self.entries)   # copy: fine
+
+    def count_ok(self):
+        with self._lock:
+            return self.n               # immutable int, not in REFS
+
+    def leak(self):
+        with self._lock:
+            return self.entries         # guard.escape
+
+    def leak_tuple(self):
+        with self._lock:
+            return (self.n, self.entries)  # guard.escape via packing
+""")
+    findings = [f for f in guarded.lint_guarded(root)
+                if f.rule == "guard.escape"]
+    assert {f.symbol for f in findings} == {"Registry.leak",
+                                            "Registry.leak_tuple"}, findings
+    assert all("entries" in f.message for f in findings)
+
+
+def test_seeded_lifecycle_asymmetry(tmp_path):
+    root = _write_pkg(tmp_path, "pkg_life", """\
+def leaky(mem, consumer, batches):
+    mem.register_consumer(consumer)      # guard.lifecycle: no finally
+    for b in batches:
+        consumer.add(b)
+    mem.unregister_consumer(consumer)    # happy path only
+
+def sound(mem, consumer, batches):
+    mem.register_consumer(consumer)
+    try:
+        for b in batches:
+            consumer.add(b)
+    finally:
+        mem.unregister_consumer(consumer)
+""")
+    findings = [f for f in guarded.lint_guarded(root)
+                if f.rule == "guard.lifecycle"]
+    assert len(findings) == 1, findings
+    assert findings[0].symbol == "leaky"
+    assert "unregister_consumer" in findings[0].message
+
+
+def test_seeded_bad_declaration(tmp_path):
+    root = _write_pkg(tmp_path, "pkg_decl", """\
+class A:
+    GUARDED_BY = {"x": "not.a.real.lock"}
+
+class B:
+    GUARDED_BY = {"x": "conf.store"}
+    GUARDED_REFS = ("y",)
+""")
+    findings = [f for f in guarded.lint_guarded(root)
+                if f.rule == "guard.decl"]
+    assert {f.symbol for f in findings} == {"A", "B"}, findings
+    assert any("not.a.real.lock" in f.message for f in findings)
+    assert any("GUARDED_REFS" in f.message for f in findings)
+
+
+def test_real_package_guarded_clean():
+    """The annotated codebase passes its own gate (modulo the pinned
+    MemConsumer waiver — exactly what lint_package applies)."""
+    raw = guarded.lint_guarded()
+    waivers = lint.load_waivers()
+    left = [f for f in raw if not lint._waived(f, waivers)]
+    assert left == [], left
+    # the waiver is LIVE (pins test_waiver_file_entries_still_needed)
+    assert any(f.rule == "guard.unlocked"
+               and f.path.endswith("runtime/memmgr.py") for f in raw)
+
+
+# --------------------------- 2. both halves catch the same seeded race
+
+def test_seeded_race_caught_by_both_halves(tmp_path, armed_lockset):
+    """THE acceptance criterion: one seeded module whose guarded
+    attribute is mutated off-lock — the static pass names the rule id
+    and line, and DRIVING it from a second thread raises a
+    deterministic LocksetViolation from the armed runtime checker."""
+    root = _write_pkg(tmp_path, "pkg_both", SEED_UNLOCKED_CLASS)
+
+    # static half: rule id + location
+    findings = [f for f in guarded.lint_guarded(root)
+                if f.rule == "guard.unlocked"]
+    assert len(findings) == 1 and findings[0].symbol == "Counter.racy_bump"
+
+    # dynamic half: import the SAME module and race it deterministically
+    spec = importlib.util.spec_from_file_location(
+        "pkg_both_mod", str(tmp_path / "pkg_both" / "mod.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    c = mod.Counter()
+    first_done = threading.Event()
+    errs = []
+
+    def t1():
+        try:
+            c.safe_bump()        # thread 1 establishes the lockset {L}
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+        first_done.set()
+
+    t = threading.Thread(target=t1)
+    t.start()
+    t.join(5)
+    assert first_done.is_set() and not errs
+    # second thread (this one), OFF-lock: the intersection empties HERE
+    with pytest.raises(lockset.LocksetViolation, match="Counter.count"):
+        c.racy_bump()
+
+
+# -------------------------------------------- 3. lockset semantics
+
+def test_lockset_quiet_when_covered(armed_lockset):
+    class Obj:
+        pass
+
+    lk = alocks.make_lock("metrics.set")
+    o = Obj()
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(50):
+                with lk:
+                    lockset.check(o, "x")
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    assert not errs
+    assert lockset.counters()["checked_accesses"] >= 200
+
+
+def test_lockset_single_owner_init_exempt(armed_lockset):
+    """Unlocked single-thread construction never trips the checker —
+    the Eraser exclusive phase."""
+    class Obj:
+        pass
+
+    o = Obj()
+    for _ in range(10):
+        lockset.check(o, "x")    # same thread, no locks: exempt
+    assert lockset.counters()["checked_accesses"] >= 10
+
+
+def test_lockset_reports_once_per_variable(armed_lockset):
+    class Obj:
+        pass
+
+    lk = alocks.make_lock("metrics.set")
+    o = Obj()
+    done = threading.Event()
+
+    def t1():
+        with lk:
+            lockset.check(o, "x")
+        done.set()
+
+    threading.Thread(target=t1).start()
+    assert done.wait(5)
+    with pytest.raises(lockset.LocksetViolation):
+        lockset.check(o, "x")
+    # suppressed after the first report: chaos runs surface ONE failure
+    lockset.check(o, "x")
+
+
+def test_lockset_disarmed_is_structural_noop():
+    lockset.arm(False)
+    lockset.reset()
+
+    class Obj:
+        pass
+
+    o = Obj()
+    for _ in range(100):
+        lockset.check(o, "x")
+    assert lockset.counters() == {"checked_accesses": 0, "tracked": 0}
+
+
+def test_conf_key_registered_and_refresh_path():
+    assert "spark.blaze.verify.lockset" in conf.registered_conf_keys()
+    prev = conf.VERIFY_LOCKSET.get()
+    try:
+        conf.VERIFY_LOCKSET.set(True)
+        lockset.refresh()
+        assert lockset.armed()
+    finally:
+        conf.VERIFY_LOCKSET.set(prev)
+        lockset.refresh()
+        assert lockset.armed() == bool(prev)
+
+
+# ------------------- 4. deterministic two-thread interleaving tests
+
+def _mk_batch(n=8):
+    schema = Schema([Field("x", DataType.int64())])
+    return batch_from_pydict({"x": list(range(n))}, schema)
+
+
+@pytest.fixture
+def armed_monitor():
+    prev = conf.MONITOR_ENABLE.get()
+    conf.MONITOR_ENABLE.set(True)
+    monitor.reset()
+    lockset.arm(True)
+    alocks.arm(True)  # order assertion too: the seams must hold both
+    try:
+        yield
+    finally:
+        alocks.arm(False)
+        lockset.arm(False)
+        conf.MONITOR_ENABLE.set(prev)
+        monitor.reset()
+
+
+def test_interleaved_loser_rollback_vs_winner_commit(armed_monitor):
+    """The speculation seam the checker guards: a losing attempt's
+    AttemptProgress.discard racing the winner's add_batch/task_done on
+    the SHARED StageProgress, schedule pinned by barriers.  The armed
+    lockset + lock-order checkers stay quiet and the loser's delta is
+    rolled back exactly."""
+    b = _mk_batch(8)
+    errs = []
+    with monitor.query("t_interleave_spec"):
+        sp = monitor.StageProgress(0, "map", 2)
+        start = threading.Barrier(2, timeout=10)
+        loser_fed = threading.Barrier(2, timeout=10)
+
+        def winner():
+            try:
+                start.wait()
+                sp.add_batch(b)
+                loser_fed.wait()     # loser has added its batches now
+                sp.add_batch(b)
+                sp.task_done()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        def loser():
+            try:
+                delta = monitor.AttemptProgress(sp)
+                start.wait()
+                delta.add_batch(b)
+                delta.add_batch(b)
+                loser_fed.wait()
+                delta.discard()      # rollback races the winner's commit
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=winner), threading.Thread(target=loser)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert not errs, errs
+        # exact accounting: only the winner's two batches survive
+        assert (sp.rows, sp.batches, sp.tasks_done) == (16, 2, 1)
+    assert lockset.counters()["checked_accesses"] > 0
+
+
+def test_interleaved_async_inserter_abort_vs_put(armed_monitor,
+                                                 monkeypatch):
+    """The stager seam: abort() races queued put()s, schedule pinned
+    exactly — the stager is GATED inside staging item A while the
+    producer queues B and C, then abort lands, then the gate opens.
+    Armed checkers stay quiet; exactly A reaches the repartitioner
+    (B/C discarded by the abort) and every thread joins."""
+    from blaze_tpu.parallel import shuffle as shuffle_mod
+    from blaze_tpu.parallel.shuffle import ShuffleRepartitioner, _AsyncInserter
+    from blaze_tpu.runtime.metrics import MetricsSet
+
+    gate = threading.Event()
+    first_staging = threading.Event()
+    real_insert = shuffle_mod._insert_host
+    staged = []
+
+    def gated_insert(rep, schema, item):
+        if not staged:
+            first_staging.set()
+            assert gate.wait(10)
+        staged.append(item)
+        real_insert(rep, schema, item)
+
+    monkeypatch.setattr(shuffle_mod, "_insert_host", gated_insert)
+
+    schema = Schema([Field("x", DataType.int64())])
+    rep = ShuffleRepartitioner(schema, 1, MetricsSet())
+    ins = _AsyncInserter(rep, schema, depth=2, metrics=MetricsSet())
+    b = _mk_batch(4).to_host()
+    item = (list(b.columns), np.array([4]), 4)
+    errs = []
+    queued = threading.Event()
+
+    def producer():
+        try:
+            ins.put(item)            # A: stager picks it up, blocks
+            assert first_staging.wait(10)
+            ins.put(item)            # B, C: sit in the bounded queue
+            ins.put(item)
+            queued.set()
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+            queued.set()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    assert queued.wait(10)
+
+    def aborter():
+        ins.abort()                  # races the gated stager; must
+        # discard B/C and join once the gate opens
+
+    at = threading.Thread(target=aborter)
+    at.start()
+    gate.set()
+    at.join(10)
+    t.join(10)
+    assert not t.is_alive() and not at.is_alive()
+    assert not errs, errs
+    assert not ins._thread.is_alive()
+    # exactly A was staged; the queued B/C were discarded by the abort
+    assert len(staged) == 1
+    with rep._lock:
+        assert sum(len(bl) for bl in rep._buffers) == 1
+    assert lockset.counters()["checked_accesses"] > 0
+
+
+# ------------------------------------------------ 5. --lint --json
+
+def test_lint_json_doc_golden_keys(tmp_path):
+    """The machine-readable findings document: golden-pinned key sets
+    (rule/path/line/symbol/message/waived + summary), waived findings
+    marked but present — what CI diffs between lint runs."""
+    root = _write_pkg(tmp_path, "pkg_json", SEED_UNLOCKED_CLASS)
+    found = guarded.lint_guarded(root)
+    assert found
+    # one unwaived + one waived entry, so both renderings are pinned
+    pairs = [(f, False) for f in found] + [(found[0], True)]
+    doc = lint.lint_json_doc(pairs, plans_verified=7)
+    assert tuple(doc) == lint.LINT_JSON_TOP_KEYS
+    for entry in doc["findings"]:
+        assert tuple(entry) == lint.LINT_JSON_FINDING_KEYS
+    assert tuple(doc["summary"]) == lint.LINT_JSON_SUMMARY_KEYS
+    assert doc["summary"]["total"] == len(pairs)
+    assert doc["summary"]["plans_verified"] == 7
+    assert doc["summary"]["waived"] + doc["summary"]["unwaived"] \
+        == doc["summary"]["total"]
+    assert any(e["waived"] for e in doc["findings"])
+    json.dumps(doc)  # the document is pure JSON
+    # (the real package's document being clean modulo waivers is
+    # test_lint_clean_on_head's job — lint_package is the same source)
