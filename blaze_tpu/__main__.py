@@ -341,7 +341,8 @@ def _run_lint(json_path: str = "") -> int:
 
 
 def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
-               n_faults: int, speculate: bool = False) -> int:
+               n_faults: int, speculate: bool = False,
+               inject_oom: bool = False, loaded=None) -> int:
     """Fault-injection smoke: fault-free run vs seeded-fault run must
     produce identical rows.  The chaotic run is TRACED (event log on),
     and the recovery story must reconcile: every injected fault paired
@@ -356,7 +357,10 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
     triggers, fast heartbeat cadence) and seeds a deterministic
     STRAGGLER (``slow<ms>`` latency entry) into the fault schedule, so
     the smoke exercises the backup-attempt race, not just crash
-    recovery.  The Eraser-style lockset checker
+    recovery.  ``inject_oom`` seeds a ``kernel.dispatch@<hit>@oom``
+    entry — a mid-query device-memory exhaustion the degradation
+    ladder (runtime/oom.py) must absorb with byte-identical results,
+    every ``kind=oom`` fault pairing with an ``oom_recovery`` event.  The Eraser-style lockset checker
     (``spark.blaze.verify.lockset``, runtime/lockset.py) is armed for
     the whole smoke alongside the other two verifiers: a guarded
     attribute touched off-lock from a second thread raises a
@@ -367,7 +371,11 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
     from .analysis import locks as lock_verify
     from .runtime import lockset, monitor
 
-    build_query, names, scans = _load_suite(suite, names, scale, n_parts)
+    # ``loaded`` = a (build_query, names, scans) the sweep resolved
+    # once up front — datagen does not depend on the seed, so N seeds
+    # share one pass instead of regenerating per arm
+    build_query, names, scans = loaded or _load_suite(
+        suite, names, scale, n_parts)
     if build_query is None:
         return names
 
@@ -392,7 +400,7 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
         monitor.reset()
     try:
         return _chaos_loop(suite, names, scans, build_query, n_parts, seed,
-                           n_faults, speculate)
+                           n_faults, speculate, inject_oom)
     finally:
         conf.VERIFY_PLAN.set(False)
         conf.VERIFY_LOCKS.set(False)
@@ -409,14 +417,15 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
 
 
 def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
-                n_faults, speculate=False) -> int:
+                n_faults, speculate=False, inject_oom=False) -> int:
     from . import conf
     from .runtime import faults, lockset, monitor, scheduler, trace, trace_report
 
     failed = []
     for i, name in enumerate(names):
         spec = faults.random_spec(seed + i, n_faults=n_faults,
-                                  n_stragglers=1 if speculate else 0)
+                                  n_stragglers=1 if speculate else 0,
+                                  n_ooms=1 if inject_oom else 0)
         conf.FAULTS_SPEC.set("")
         faults.reset()
         try:
@@ -465,6 +474,9 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
             f"map_tasks_rerun={m.get('map_tasks_rerun')} "
             f"speculative={m.get('speculative_attempts')}"
             f"/won={m.get('speculative_won')} "
+            f"oom={m.get('oom_recoveries')}"
+            f"/{m.get('batch_downshifts')}"
+            f"/{m.get('eager_fallbacks')} "
             f"dispatches={m.get('xla_dispatches')} "
             f"compiles={m.get('xla_compiles')} "
             f"lockset_checked={checked}" if m else "no metrics"
@@ -518,6 +530,139 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
               file=sys.stderr)
         return 1
     return 0
+
+
+def _run_cancel_storm(suite, names, scans, build_query, n_parts,
+                      seed) -> int:
+    """Cancel-storm chaos arm: run each query through the scheduler on
+    a worker thread, fire ``cancel_query`` at a seeded random moment —
+    landing at whatever stage frontier the query has reached — and
+    assert EXACT reconciliation: the caller gets
+    ``QueryCancelledError`` (or the query legitimately finished before
+    the cancel landed), every ``query_cancel_requested`` pairs with a
+    terminal ``query_cancelled`` in the event log, and nothing leaks —
+    no ``blaze-attempt-*`` thread, no ``.inprogress`` shuffle temp, no
+    ``blaze_spill_*`` file."""
+    import glob
+    import os
+    import random
+    import tempfile
+    import threading
+
+    from . import conf
+    from .runtime import trace, trace_report
+    from .runtime import monitor
+    from .runtime.context import QueryCancelledError, cancel_query
+
+    from .runtime import faults
+
+    rng = random.Random(seed * 7919 + 13)
+    rc = 0
+    for name in names:
+        qid = f"storm_{suite}_{name}_{seed}"
+        prev_trace = bool(conf.TRACE_ENABLE.get())
+        conf.TRACE_ENABLE.set(True)
+        trace.reset()
+        # seed deterministic stragglers so the query is reliably still
+        # in flight when the cancel fires — a warm q6 otherwise
+        # finishes before any humanly-chosen delay (a vacuous storm)
+        slow = rng.randrange(300, 700)
+        conf.FAULTS_SPEC.set(
+            f"task.compute@1@slow{slow},task.compute@3@slow{slow}")
+        faults.reset()
+        spill_glob = os.path.join(tempfile.gettempdir(), "blaze_spill_*")
+        spills_before = set(glob.glob(spill_glob))
+        state: dict = {}
+
+        def run():
+            try:
+                with monitor.query_span(qid, mode="scheduler") as lp:
+                    state["log"] = lp
+                    from .runtime.scheduler import run_stages, split_stages
+
+                    stages, mgr = split_stages(
+                        build_query(name, scans, n_parts))
+                    state["root"] = mgr.root
+                    rows = 0
+                    for b in run_stages(stages, mgr):
+                        rows += b.num_rows
+                    state["rows"] = rows
+            except BaseException as e:  # noqa: BLE001 — judged below
+                state["exc"] = e
+
+        t = threading.Thread(target=run, name="blaze-storm-query",
+                             daemon=True)
+        problems = []
+        try:
+            t.start()
+            time.sleep(rng.uniform(0.02, 0.25))
+            accepted = False
+            for _ in range(400):
+                if cancel_query(qid):
+                    accepted = True
+                    break
+                if not t.is_alive():
+                    break
+                time.sleep(0.005)
+            t.join(60)
+            if t.is_alive():
+                problems.append("query thread did not exit after the cancel")
+            exc = state.get("exc")
+            if exc is not None and not isinstance(exc, QueryCancelledError):
+                problems.append(
+                    f"wrong terminal error {type(exc).__name__}: {exc}")
+            if exc is None and "rows" not in state:
+                problems.append("query neither produced rows nor raised")
+            events = trace.read_event_log(state["log"]) \
+                if state.get("log") else []
+            cxl = trace_report.reconcile_cancellation(events)
+            if not cxl["reconciled"]:
+                problems.append(
+                    f"{len(cxl['unpaired'])} cancel request(s) without a "
+                    f"terminal query_cancelled event")
+            if isinstance(exc, QueryCancelledError) \
+                    and cxl["cancelled"] == 0:
+                problems.append(
+                    "cancelled query left no query_cancelled event")
+            if accepted and cxl["requested"] == 0:
+                # the scope took the cancel: even a query that finished
+                # before noticing must leave the request on the record
+                problems.append("accepted cancel left no "
+                                "query_cancel_requested event")
+            leaked = _live_attempt_threads()
+            if leaked:
+                problems.append("leaked attempt threads: "
+                                + ", ".join(x.name for x in leaked))
+            root = state.get("root")
+            if root and os.path.isdir(root):
+                orphans = [f for f in os.listdir(root)
+                           if ".inprogress" in f]
+                if orphans:
+                    problems.append(f"orphaned shuffle temps: {orphans[:4]}")
+            leaked_spills = sorted(
+                set(glob.glob(spill_glob)) - spills_before)
+            if leaked_spills:
+                problems.append(f"leaked spill files: {leaked_spills[:4]}")
+        finally:
+            # restore EVEN when a check raises: a leaked straggler
+            # schedule or forced-on tracing would poison every later
+            # arm with misleading cascade failures
+            conf.FAULTS_SPEC.set("")
+            faults.reset()
+            conf.TRACE_ENABLE.set(prev_trace)
+            trace.reset()
+        if problems:
+            print(f"cancel-storm {name} (seed {seed}): "
+                  + "; ".join(problems), file=sys.stderr)
+            rc = 1
+        else:
+            outcome = ("cancelled mid-flight"
+                       if isinstance(exc, QueryCancelledError)
+                       else "finished before the cancel landed")
+            print(f"cancel-storm {name} (seed {seed}): OK ({outcome}; "
+                  f"{cxl['requested']} requested / {cxl['cancelled']} "
+                  f"terminal)")
+    return rc
 
 
 def _live_attempt_threads():
@@ -648,10 +793,13 @@ def main(argv=None) -> int:
                     help="sweep mode: run the chaos smoke N times with "
                          "seeds chaos-seed..chaos-seed+N-1 (implies "
                          "--chaos); the FIRST seed additionally arms "
-                         "speculation with an injected straggler, so the "
-                         "backup-attempt race is exercised in every sweep; "
-                         "nonzero exit on any mismatch or unreconciled "
-                         "event log")
+                         "speculation with an injected straggler, the "
+                         "SECOND injects a mid-query device OOM the "
+                         "degradation ladder must absorb, and every seed "
+                         "ends with a cancel-storm arm (seeded random "
+                         "cancel at a random stage frontier); nonzero exit "
+                         "on any mismatch, unreconciled event log, leaked "
+                         "thread, or orphaned temp/spill file")
     ap.add_argument("--trace", action="store_true",
                     help="arm the structured event log "
                          "(spark.blaze.trace.enabled) for this run; each "
@@ -785,15 +933,30 @@ def main(argv=None) -> int:
                          args.xla_cache_dir)
         elif args.chaos_seeds:
             # seed sweep: N independent schedules; the first also arms
-            # speculation against an injected straggler
+            # speculation against an injected straggler, the second
+            # injects a mid-query device OOM the degradation ladder
+            # must absorb, and EVERY seed ends with a cancel-storm arm
+            # (a seeded random cancel at a random stage frontier).
+            # Datagen is seed-independent: resolve the suite ONCE and
+            # share it across every seed's arms.
+            loaded = _load_suite(args.suite, queries, args.scale,
+                                 args.parts)
+            bq, qnames, scans = loaded
+            if bq is None:
+                return qnames
             rc = 0
             for k in range(args.chaos_seeds):
+                arm = (", speculation armed)" if k == 0 else
+                       ", oom injection armed)" if k == 1 else ")")
                 print(f"# chaos sweep {k + 1}/{args.chaos_seeds} "
-                      f"(seed {args.chaos_seed + k}"
-                      + (", speculation armed)" if k == 0 else ")"))
+                      f"(seed {args.chaos_seed + k}" + arm)
                 rc = _run_chaos(args.suite, queries, args.scale, args.parts,
                                 args.chaos_seed + k, args.chaos_faults,
-                                speculate=(k == 0)) or rc
+                                speculate=(k == 0),
+                                inject_oom=(k == 1), loaded=loaded) or rc
+                rc = _run_cancel_storm(args.suite, qnames, scans, bq,
+                                       args.parts,
+                                       args.chaos_seed + k) or rc
         elif args.chaos:
             rc = _run_chaos(args.suite, queries, args.scale, args.parts,
                             args.chaos_seed, args.chaos_faults)

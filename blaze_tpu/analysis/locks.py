@@ -58,6 +58,10 @@ from typing import Dict, List, Optional, Tuple
 #:   under — the lint rule in analysis/lint.py pins that.
 HIERARCHY: Tuple[str, ...] = (
     "monitor.server",        # server lifecycle (ensure/shutdown)
+    "context.cancel",        # query CancelScope registry + fan-out set
+                             # (held only for set/dict mutation; the
+                             # trace emission a cancel produces happens
+                             # after release)
     "shuffle.repartitioner", # per-map-task staged partition buffers
     "monitor.registry",      # live query registry
     "monitor.progress",      # per-stage progress counters (leaf: held

@@ -115,6 +115,14 @@ STAGE_MAX_ATTEMPTS = ConfEntry("spark.blaze.stage.maxAttempts", 4, int)
 # hit ordering deterministic; speculation/wedge detection force the
 # concurrent attempt runner regardless).
 STAGE_TASK_CONCURRENCY = ConfEntry("spark.blaze.stage.taskConcurrency", 1, int)
+# Per-QUERY wall-clock budget (ms), enforced by the query CancelScope
+# (runtime/context.py): every cooperative checkpoint (scheduler drain,
+# result-batch pull, attempt launch, the concurrent runner's poll
+# loop) checks the deadline, and expiry cancels every live attempt and
+# raises QueryDeadlineError carrying the stage/task frontier.  The
+# per-TASK half of the clock is spark.blaze.task.timeout /
+# spark.blaze.task.wedgeMs; this is the per-query half.  0 = unlimited.
+QUERY_TIMEOUT_MS = ConfEntry("spark.blaze.query.timeoutMs", 0, int)
 # Heartbeat-age wedge detection on the plain (non-speculative) retry
 # path, in ms: a task whose monitor heartbeat age exceeds this is
 # cancelled cooperatively and RETRIED like a timeout — covering the
@@ -149,6 +157,14 @@ SPECULATION_WEDGE_MS = ConfEntry("spark.blaze.speculation.wedgeMs", 0, int)
 # e.g. "shuffle.fetch@2,task.compute@1@a0"); empty = no injection.
 # Env override BLAZE_FAULTS_SPEC reaches worker subprocesses too.
 FAULTS_SPEC = ConfEntry("spark.blaze.faults.spec", "", str)
+
+# Graceful degradation under device memory pressure (runtime/oom.py):
+# an XLA RESOURCE_EXHAUSTED caught at the dispatch choke point first
+# sheds host-staging pressure (memmgr force-spill) and retries; a
+# fused-stage program that still OOMs halves its batch and re-runs,
+# recursively up to this many times, before falling back to the eager
+# per-operator path — only then does the attempt fail (retryable).
+OOM_MAX_DOWNSHIFTS = ConfEntry("spark.blaze.oom.maxDownshifts", 2, int)
 
 # Query-level tracing + structured event log (runtime/trace.py).
 # OFF (default) keeps the dispatch hot path on the pre-existing code

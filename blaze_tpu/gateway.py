@@ -24,6 +24,18 @@ from .batch import RecordBatch
 _gw_tls = threading.local()
 
 
+def cancel_query(query_id: str) -> bool:
+    """Gateway-side query kill switch (≙ the JNI ``cancelTasks``
+    callback a Spark UI kill reaches the native engine through):
+    cancels the named query's :class:`runtime.context.CancelScope`, so
+    an FFI drive inside :func:`query_span` stops at its next
+    cooperative checkpoint and surfaces ``QueryCancelledError`` to the
+    JVM caller.  Returns whether a live query accepted the request."""
+    from .runtime.context import cancel_query as _cancel
+
+    return _cancel(query_id)
+
+
 @contextlib.contextmanager
 def query_span(query_id: str, n_tasks: int = 1) -> Iterator[Optional[str]]:
     """Gateway-side query span: the JNI entry wraps one native query's
@@ -131,7 +143,17 @@ def export_batch_ffi(batch: RecordBatch) -> int:
     Every export inside an active gateway span counts toward its
     stage progress; callers exporting intermediates rather than query
     output (udf_bridge's UDF round-trip) wrap the export in
-    :func:`suppressed_span_progress`."""
+    :func:`suppressed_span_progress`.  Each export is also the FFI
+    drive's cooperative cancellation checkpoint: a
+    :func:`cancel_query` against the enclosing query span raises the
+    typed ``QueryCancelledError`` into the JVM caller here, between
+    batches — without it the gateway path would accept the cancel but
+    deliver every batch anyway."""
+    from .runtime.context import current_cancel_scope
+
+    scope = current_cancel_scope()
+    if scope is not None:
+        scope.check()
     lib = native._load()
     assert lib is not None, "native runtime required for FFI export"
     b = batch.to_host()

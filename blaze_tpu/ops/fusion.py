@@ -402,6 +402,13 @@ class FusedStageExec(ExecNode):
 
         self._kernel = cached_kernel(("fused_stage", keys), build)
         self.metrics.set("fused_stage_len", len(self.ops))
+        #: OOM degradation (runtime/oom.py): halving a batch is only
+        #: sound for per-row streaming transforms — a whole-partition
+        #: op (trace_requires_buffer, e.g. window) must see its batch
+        #: intact, so such chains skip rung 2 and go straight to eager
+        self._downshift_ok = not any(
+            getattr(op, "trace_requires_buffer", False) for op in self.ops)
+        self._eager_kernels = None  # built lazily, only if rung 3 fires
 
     @property
     def schema(self):
@@ -438,6 +445,63 @@ class FusedStageExec(ExecNode):
         inner = "+".join(type(op).__name__ for op in self.ops)
         return f"FusedStageExec[{inner}]"
 
+    def _eager_run(self, batch):
+        """Rung 3 of the OOM ladder: the chain's per-operator programs,
+        one dispatch each (the pre-fusion path) — every intermediate is
+        materialized separately, so peak program memory drops to the
+        single-op footprint.  Kernels are cached under the op's own
+        trace key and built only the first time the rung fires."""
+        if self._eager_kernels is None:
+            from ..runtime.oom import build_eager_kernels
+
+            self._eager_kernels = build_eager_kernels(
+                [(op.trace_key(), fn)
+                 for op, fn in zip(self.ops, self._fns)])
+        cols, n = tuple(batch.columns), batch.num_rows
+        for kernel in self._eager_kernels:
+            cols, n = kernel(cols, n)
+        return cols, n
+
+    def _degradable_results(self, batch, depth: int):
+        """Run one batch through the fused program, walking rungs 2-3
+        of the OOM degradation ladder (rung 1 — force-spill + one
+        retry — already ran inside the instrumented kernel,
+        runtime/dispatch._oom_call).  Yields ``(cols, n)`` per
+        surviving piece with the live count already RESOLVED: the
+        one-scalar sync (when a fused op compacts) happens inside the
+        try, so a RESOURCE_EXHAUSTED that async dispatch only surfaces
+        at the first consumption point is still caught by the ladder —
+        and inside the caller's ``elapsed_compute`` timer, so the
+        device bill stays attributed.  A non-compacting chain's OOM
+        can still surface further downstream (the next host transfer);
+        that path fails the attempt and retries, the pre-ladder
+        behavior."""
+        from ..runtime import oom as _oom
+
+        try:
+            cols, n_dev = self._kernel(tuple(batch.columns), batch.num_rows)
+            n = int(n_dev) if self._changes_count else batch.num_rows
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if not _oom.is_resource_exhausted(exc):
+                raise
+            if (self._downshift_ok and depth < _oom.max_downshifts()
+                    and batch.num_rows > 1):
+                _oom.record_downshift("fused_stage", batch.num_rows,
+                                      depth + 1)
+                for piece in _oom.split_batch(batch):
+                    yield from self._degradable_results(piece, depth + 1)
+                return
+            _oom.record_eager_fallback("fused_stage")
+            try:
+                cols, n_dev = self._eager_run(batch)
+                n = int(n_dev) if self._changes_count else batch.num_rows
+            except Exception as exc2:  # noqa: BLE001
+                if _oom.is_resource_exhausted(exc2):
+                    # ladder exhausted: genuine pressure, retryable
+                    raise _oom.DeviceOomError(self.name(), exc2) from exc2
+                raise
+        yield cols, n
+
     def execute(self, partition: int, ctx) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)
 
@@ -446,21 +510,20 @@ class FusedStageExec(ExecNode):
 
             for batch in child_stream:
                 with self.metrics.timer("elapsed_compute"):
-                    cols, n_dev = self._kernel(tuple(batch.columns), batch.num_rows)
-                    # one-scalar sync, only when a fused op compacts
-                    n = int(n_dev) if self._changes_count else batch.num_rows
-                if n == 0:
-                    continue
-                self.metrics.add("output_rows", n)
-                out = RecordBatch(self._schema, list(cols), n)
-                # expanding ops (generate cap*M, expand cap*P) leave a
-                # non-power-of-two capacity: renormalize so downstream
-                # kernels keep the shape-bucketing invariant (mirrors
-                # GenerateExec's own unfused stream)
-                cap = out.capacity
-                if cap != bucket_capacity(cap):
-                    out = out.with_capacity(bucket_capacity(n))
-                yield out
+                    pieces = list(self._degradable_results(batch, 0))
+                for cols, n in pieces:
+                    if n == 0:
+                        continue
+                    self.metrics.add("output_rows", n)
+                    out = RecordBatch(self._schema, list(cols), n)
+                    # expanding ops (generate cap*M, expand cap*P)
+                    # leave a non-power-of-two capacity: renormalize so
+                    # downstream kernels keep the shape-bucketing
+                    # invariant (mirrors GenerateExec's unfused stream)
+                    cap = out.capacity
+                    if cap != bucket_capacity(cap):
+                        out = out.with_capacity(bucket_capacity(n))
+                    yield out
 
         return stream()
 

@@ -280,6 +280,25 @@ class ShuffleRepartitioner(MemConsumer):
             self.metrics.add("spilled_bytes", freed)
             return freed
 
+    def release(self) -> None:
+        """Teardown for an attempt that will NOT commit (failed,
+        cancelled, or a speculative loser): drop the staged buffers and
+        release every spill this repartitioner still holds.  Spill
+        files were previously reclaimed only when ``write_output``
+        drained them — a cancelled attempt's ``blaze_spill_*`` temp
+        files survived until process exit (the cancellation resource
+        leak).  Idempotent; a no-op after a successful commit."""
+        with self._lock:
+            lockset.check(self, "_buffers", "_buffered_bytes", "_spills")
+            spills, self._spills = self._spills, []
+            self._buffers = [[] for _ in range(self.n_out)]
+            self._buffered_bytes = 0
+            # no-trigger accounting under our own lock, same contract
+            # as spill(): usage only decreases, no watermark check owed
+            self.set_mem_used_no_trigger(0)
+        for sp, _ in spills:
+            sp.release()
+
     def write_output(self, data_path: str, index_path: str) -> List[int]:
         """Merge memory + spills per pid into .data/.index.  Returns
         partition lengths.  Holds the lock across the whole drain so a
@@ -307,6 +326,7 @@ class ShuffleRepartitioner(MemConsumer):
                     assert frame is not None
                     spilled.setdefault(pid, []).append(deserialize_batch(frame, self.schema))
             sp.release()
+        self._spills = []  # drained: the teardown release() owes nothing
         lengths: List[int] = []
         offsets = [0]
         codec = str(conf.IO_COMPRESSION_CODEC.get())
@@ -543,6 +563,9 @@ class ShuffleWriterExec(ExecNode):
         # fusion tier 5 (absorb_traceable_chain): one program per batch
         # covering chain + pids + pid-sort + counts
         self._fused_write = None
+        self._fused_fns: List = []
+        self._fused_fn_keys: tuple = ()
+        self._eager_chain = None  # per-op fallback kernels (OOM rung 3)
         self._out_schema: Optional[Schema] = None
         if isinstance(partitioning, HashPartitioning):
             from ..batch import split_opaque_indexes
@@ -660,6 +683,8 @@ class ShuffleWriterExec(ExecNode):
             builder = lambda: _build_fused_write_kernel(  # noqa: E731
                 out_schema, fns, "rr", None, n_out)
         self._fused_write = cached_kernel(key, builder)
+        self._fused_fns = fns
+        self._fused_fn_keys = keys
         self._out_schema = out_schema
         if ops:
             from ..ops.fusion import BufferPartitionExec
@@ -668,6 +693,22 @@ class ShuffleWriterExec(ExecNode):
             from ..runtime import dispatch
 
             dispatch.record_max("fused_stage_len", len(ops) + 1)
+
+    def _degraded_chain(self, cols, num_rows):
+        """Apply the absorbed map chain as per-operator programs — the
+        OOM ladder's eager rung for the tier-5 fused write (the fused
+        program is gone, but the chain's TRANSFORMS must still apply or
+        the fallback would write untransformed rows).  Returns
+        ``(cols, n)`` with the live count synced to host (the unfused
+        pid path needs it as a plain int)."""
+        if self._eager_chain is None:
+            from ..runtime.oom import build_eager_kernels
+
+            self._eager_chain = build_eager_kernels(
+                list(zip(self._fused_fn_keys, self._fused_fns)))
+        for kernel in self._eager_chain:
+            cols, num_rows = kernel(cols, num_rows)
+        return list(cols), int(num_rows)
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         if (
@@ -680,6 +721,8 @@ class ShuffleWriterExec(ExecNode):
             )
 
         def stream():
+            from ..runtime import oom as _oom
+
             n_out = self.partitioning.num_partitions
             out_schema = self.schema
             rep = ShuffleRepartitioner(
@@ -687,6 +730,7 @@ class ShuffleWriterExec(ExecNode):
             )
             ctx.mem.register_consumer(rep)
             inserter: Optional[_AsyncInserter] = None
+            committed = False
             try:
                 if bool(conf.SHUFFLE_ASYNC_WRITE.get()):
                     inserter = _AsyncInserter(
@@ -695,43 +739,66 @@ class ShuffleWriterExec(ExecNode):
                     )
                 rr = 0
                 rr_dev = jnp.int32(0)  # fused RR offset, device-resident
+                use_fused = self._fused_write is not None
                 for batch in self.children[0].execute(partition, ctx):
                     if not ctx.is_task_running():
                         return
                     # heartbeat hookpoint: the map task's write loop is
                     # the longest driver-invisible stretch of a query
                     monitor.tick()
-                    if self._fused_write is not None:
+                    item = None
+                    if use_fused:
                         # tier 5: ONE program returns the chain output
                         # already pid-sorted plus per-pid counts
+                        try:
+                            with self.metrics.timer("elapsed_compute"):
+                                if isinstance(self.partitioning, RoundRobinPartitioning):
+                                    sorted_cols, counts, rr_dev = self._fused_write(
+                                        tuple(batch.columns), batch.num_rows, rr_dev
+                                    )
+                                else:
+                                    sorted_cols, counts = self._fused_write(
+                                        tuple(batch.columns), batch.num_rows
+                                    )
+                            item = (list(sorted_cols), counts, None)
+                        except Exception as exc:  # noqa: BLE001
+                            if not _oom.is_resource_exhausted(exc):
+                                raise
+                            # OOM ladder (spill+retry already ran at the
+                            # dispatch choke point): decompose to the
+                            # per-kernel path for the REST of the stream
+                            _oom.record_eager_fallback("fused_shuffle_write")
+                            use_fused = False
+                            if isinstance(self.partitioning,
+                                          RoundRobinPartitioning):
+                                # resync the device-resident offset so
+                                # the host-side path continues exactly
+                                rr = int(rr_dev)
+                    if item is None:
                         with self.metrics.timer("elapsed_compute"):
-                            if isinstance(self.partitioning, RoundRobinPartitioning):
-                                sorted_cols, counts, rr_dev = self._fused_write(
-                                    tuple(batch.columns), batch.num_rows, rr_dev
-                                )
-                            else:
-                                sorted_cols, counts = self._fused_write(
-                                    tuple(batch.columns), batch.num_rows
-                                )
-                        item = (list(sorted_cols), counts, None)
-                    else:
-                        with self.metrics.timer("elapsed_compute"):
+                            cols, n = list(batch.columns), batch.num_rows
+                            if self._fused_write is not None:
+                                # the absorbed chain's transforms still
+                                # apply, one program per op
+                                cols, n = self._degraded_chain(
+                                    tuple(cols), n)
+                            cap = cols[0].validity.shape[0] if cols \
+                                else batch.capacity
                             if isinstance(self.partitioning, HashPartitioning) and n_out > 1:
                                 pids = self._hash_pids(
-                                    non_opaque_cols(out_schema, batch.columns),
-                                    batch.num_rows,
+                                    non_opaque_cols(out_schema, cols), n,
                                 )
                             elif isinstance(self.partitioning, RangePartitioning) and n_out > 1:
-                                pids = self._range_pids(batch.columns, batch.num_rows)
+                                pids = self._range_pids(cols, n)
                             elif isinstance(self.partitioning, RoundRobinPartitioning) and n_out > 1:
-                                pids = (jnp.arange(batch.capacity, dtype=jnp.int32) + rr) % n_out
-                                rr = (rr + batch.num_rows) % n_out
+                                pids = (jnp.arange(cap, dtype=jnp.int32) + rr) % n_out
+                                rr = (rr + n) % n_out
                             else:
-                                pids = jnp.zeros(batch.capacity, jnp.int32)
+                                pids = jnp.zeros(cap, jnp.int32)
                             sorted_cols, counts = sort_cols_by_pid(
-                                out_schema, batch.columns, pids, n_out, batch.num_rows
+                                out_schema, cols, pids, n_out, n
                             )
-                        item = (list(sorted_cols), counts, batch.num_rows)
+                        item = (list(sorted_cols), counts, n)
                     if inserter is not None:
                         # overlap: host staging of batch N runs on the
                         # inserter thread while batch N+1 dispatches
@@ -751,9 +818,16 @@ class ShuffleWriterExec(ExecNode):
                 with self.metrics.timer("output_io_time"):
                     self.partition_lengths = rep.write_output(self.data_path, self.index_path)
                 self.metrics.add("data_size", sum(self.partition_lengths))
+                committed = True
             finally:
                 if inserter is not None:
                     inserter.abort()
+                if not committed:
+                    # failed OR cancelled attempt: reclaim the staged
+                    # buffers and any spill FILES now — they were
+                    # previously only reclaimed at process exit (the
+                    # cancellation resource leak)
+                    rep.release()
                 ctx.mem.unregister_consumer(rep)
             return
             yield  # pragma: no cover — empty stream marker
@@ -913,6 +987,41 @@ class LocalShuffleManager:
                     removed += 1
                 except OSError:
                     pass
+        return removed
+
+    def sweep_inprogress(self, shuffle_id: Optional[int] = None,
+                         map_id: Optional[int] = None,
+                         attempt: Optional[int] = None) -> int:
+        """Remove attempt-qualified ``.inprogress`` staging temps — the
+        rollback half of the commit-by-rename contract: a failed or
+        cancelled attempt's own except-handler normally unlinks them,
+        but an ABANDONED attempt (wedged past cooperation, killed
+        worker) leaves its temps behind, and they were previously only
+        reclaimed at process exit.  The scheduler sweeps a specific
+        (shuffle, map, attempt) in each attempt's rollback path and
+        everything on query cancellation.  Returns files removed."""
+        if shuffle_id is None:
+            prefix = "shuffle_"
+        elif map_id is None:
+            prefix = f"shuffle_{shuffle_id}_"
+        else:
+            prefix = f"shuffle_{shuffle_id}_{map_id}."
+        asuffix = f".inprogress.a{attempt}" if attempt is not None else None
+        removed = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        for fn in names:
+            if not fn.startswith(prefix) or ".inprogress" not in fn:
+                continue
+            if asuffix is not None and not fn.endswith(asuffix):
+                continue
+            try:
+                os.unlink(os.path.join(self.root, fn))
+                removed += 1
+            except OSError:
+                pass
         return removed
 
     def reduce_blocks(self, shuffle_id: int, num_maps: int, reduce_id: int) -> List[BlockObject]:

@@ -7,9 +7,14 @@ the per-task NativeExecutionRuntime state (blaze/src/rt.rs:48-98).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import threading
-from typing import Any, Callable, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Set, Tuple
 
+from ..analysis.locks import make_lock
+from . import lockset
 from .memmgr import MemManager
 from .metrics import MetricNode
 
@@ -79,6 +84,267 @@ class ScopedResources:
 class TaskCancelled(Exception):
     """Raised where silent early-exit would poison a cached/partial
     result (e.g. a broadcast build drain)."""
+
+
+class QueryCancelledError(RuntimeError):
+    """Terminal: the QUERY was cancelled (HTTP ``POST
+    /queries/<id>/cancel``, the session/gateway ``cancel(query_id)``
+    API, or a chaos cancel-storm arm).  Non-retryable per
+    ``retry.classify`` — re-running a task the user killed would
+    resurrect the query one attempt at a time."""
+
+    def __init__(self, query_id: str, reason: str = "cancel",
+                 stage_id: Optional[int] = None,
+                 task: Optional[int] = None):
+        self.query_id = query_id
+        self.reason = reason
+        self.stage_id = stage_id
+        self.task = task
+        at = ""
+        if stage_id is not None:
+            at = f" at stage {stage_id}" + (
+                f" task {task}" if task is not None else "")
+        super().__init__(f"query {query_id!r} cancelled ({reason}){at}")
+
+
+class QueryDeadlineError(QueryCancelledError):
+    """Terminal: the query exceeded ``spark.blaze.query.timeoutMs``.
+    Subclasses :class:`QueryCancelledError` — a deadline IS a cancel,
+    just one the clock requested — and carries the stage/task frontier
+    the query had reached when the expiry was observed."""
+
+    def __init__(self, query_id: str, timeout_ms: int,
+                 stage_id: Optional[int] = None,
+                 task: Optional[int] = None):
+        super().__init__(query_id, reason="deadline",
+                         stage_id=stage_id, task=task)
+        self.timeout_ms = timeout_ms
+        at = ""
+        if stage_id is not None:
+            at = f"; frontier: stage {stage_id}" + (
+                f" task {task}" if task is not None else "")
+        self.args = (f"query {query_id!r} exceeded its deadline "
+                     f"({timeout_ms}ms){at}",)
+
+
+class CancelScope:
+    """Per-query cancellation + deadline scope — the query-level half
+    of the recovery ladder (the task-level half is retry/speculation/
+    wedge detection, PR 1/7).  One scope wraps one query execution
+    (``monitor.query_span`` opens it); it fans a cancel out into every
+    live task attempt's ``cancel_event`` (the existing cooperative
+    seams in the shuffle/RSS/broadcast writers and the speculation
+    runner), and every cooperative checkpoint calls :meth:`check`,
+    which also enforces ``spark.blaze.query.timeoutMs``.
+
+    First cancel wins: the reason ("cancel" | "deadline") is recorded
+    once, and every later :meth:`check` raises the matching typed
+    error."""
+
+    #: guarded-by declaration (analysis/guarded.py): the fan-out set is
+    #: mutated by the driver (attach/detach per attempt) and read by
+    #: whichever thread fires the cancel (monitor HTTP handler, chaos
+    #: storm timer, a deadline checkpoint)
+    GUARDED_BY = {"_children": "context.cancel",
+                  "_closed": "context.cancel"}
+    GUARDED_REFS = ("_children",)
+    #: audited deliberately-unlocked state (LOCK_FREE so "no
+    #: declaration" keeps meaning "unaudited")
+    LOCK_FREE = {
+        "reason": "written exactly once (inside cancel(), under the "
+                  "scope lock, strictly BEFORE event.set()); bare "
+                  "readers act on it only after is_set() — the Event "
+                  "is the happens-before edge",
+        "frontier": "written only by checkpoint threads observing an "
+                    "already-cancelled scope; concurrent checkpoints "
+                    "race benignly — any observed (stage, task) is a "
+                    "valid frontier for the error message",
+        "deadline": "written once in __init__, read-only afterwards",
+        "timeout_ms": "written once in __init__, read-only afterwards",
+    }
+
+    def __init__(self, query_id: str, timeout_ms: int = 0):
+        self.query_id = query_id
+        self.timeout_ms = max(0, int(timeout_ms or 0))
+        self.deadline: Optional[float] = (
+            time.monotonic() + self.timeout_ms / 1000.0
+            if self.timeout_ms > 0 else None)
+        #: the event serial task attempts share as their cancel_event;
+        #: concurrent attempts get their own events ATTACHED instead
+        self.event = threading.Event()
+        self.reason: Optional[str] = None
+        self.frontier: Tuple[Optional[int], Optional[int]] = (None, None)
+        self._lock = make_lock("context.cancel")
+        self._children: Set[threading.Event] = set()
+        self._closed = False
+
+    # ------------------------------------------------------- transitions
+
+    def cancel(self, reason: str = "cancel") -> bool:
+        """Request cancellation; returns True on the FIRST transition
+        (later calls are idempotent no-ops, and a CLOSED scope — the
+        query already finished — refuses).  Sets the scope event and
+        every attached attempt event, so all live attempts of the
+        query exit at their next cooperative check."""
+        with self._lock:
+            lockset.check(self, "_children", "_closed")
+            if self.reason is not None or self._closed:
+                return False
+            self.reason = reason
+            kids = tuple(self._children)
+        self.event.set()
+        for ev in kids:
+            ev.set()
+        return True
+
+    def close(self) -> bool:
+        """Scope exit: refuse any LATER cancel and report atomically
+        whether one landed before the close — the emission decision
+        and the last-moment cancel serialize on the scope lock, so an
+        accepted cancel can never miss its trace events and a cancel
+        that lost the race is refused (cancel_query returns False)
+        instead of silently dropped."""
+        with self._lock:
+            lockset.check(self, "_children", "_closed")
+            self._closed = True
+            return self.reason is not None
+
+    def attach(self, event: threading.Event) -> None:
+        """Fan this scope's cancellation into ``event`` (a concurrent
+        attempt's private cancel event); already-cancelled scopes set
+        it immediately."""
+        with self._lock:
+            lockset.check(self, "_children")
+            self._children.add(event)
+            fired = self.reason is not None
+        if fired:
+            event.set()
+
+    def detach(self, event: threading.Event) -> None:
+        with self._lock:
+            lockset.check(self, "_children")
+            self._children.discard(event)
+
+    # ------------------------------------------------------ checkpoints
+
+    @property
+    def cancelled(self) -> bool:
+        return self.event.is_set()
+
+    def check(self, stage_id: Optional[int] = None,
+              task: Optional[int] = None) -> None:
+        """Cooperative checkpoint: enforce the deadline and raise the
+        typed terminal error once the scope is cancelled.  Called from
+        the scheduler's drain loops, the result-batch pull, the
+        concurrent runner's poll cycle, and the in-process result
+        drive; disarmed cost is one Event read (+ one clock read with
+        a deadline armed)."""
+        if (self.reason is None and self.deadline is not None
+                and time.monotonic() > self.deadline):
+            self.cancel(reason="deadline")
+        if self.event.is_set():
+            self.raise_cancelled(stage_id, task)
+
+    def raise_cancelled(self, stage_id: Optional[int] = None,
+                        task: Optional[int] = None) -> None:
+        if self.frontier == (None, None) and stage_id is not None:
+            self.frontier = (stage_id, task)
+        fs, ft = self.frontier
+        if (self.reason or "cancel") == "deadline":
+            raise QueryDeadlineError(self.query_id, self.timeout_ms,
+                                     stage_id=fs, task=ft)
+        raise QueryCancelledError(self.query_id, reason=self.reason
+                                  or "cancel", stage_id=fs, task=ft)
+
+
+# ------------------------------------------------- scope registry + API
+
+_scope_lock = make_lock("context.cancel")
+_SCOPES: Dict[str, CancelScope] = {}
+_CTX = lockset.module_guard(__name__)
+
+#: guarded-by declaration (analysis/guarded.py): the registry is
+#: written by query threads (scope open/close) and read by cancel
+#: requesters on monitor handler / timer threads
+GUARDED_BY = {"_SCOPES": "context.cancel"}
+GUARDED_REFS = ("_SCOPES",)
+
+#: the scope cooperative checkpoints read — a ContextVar so concurrent
+#: queries on different threads never observe each other's scope
+_CURRENT_SCOPE: "contextvars.ContextVar[Optional[CancelScope]]" = \
+    contextvars.ContextVar("blaze_cancel_scope", default=None)
+
+
+def current_cancel_scope() -> Optional[CancelScope]:
+    return _CURRENT_SCOPE.get()
+
+
+@contextlib.contextmanager
+def cancel_scope(query_id: str,
+                 timeout_ms: Optional[int] = None) -> Iterator[CancelScope]:
+    """Scope one query's cancellation/deadline state: registers a
+    :class:`CancelScope` under ``query_id`` (so ``POST
+    /queries/<id>/cancel`` and :func:`cancel_query` can reach it) and
+    installs it as the ambient scope checkpoints read.  A query that
+    WAS cancelled leaves the paired ``query_cancel_requested`` /
+    ``query_cancelled`` events on the record at scope exit — both from
+    the query's own thread, after every attempt has unwound, so the
+    pair is always ordered and a cancelled query can never leave a
+    request without its terminal event (the chaos reconciliation
+    contract; a query that never exits shows up in the thread-leak
+    gate instead).  ``timeout_ms`` defaults to conf
+    ``spark.blaze.query.timeoutMs``."""
+    from .. import conf
+
+    if timeout_ms is None:
+        timeout_ms = int(conf.QUERY_TIMEOUT_MS.get())
+    scope = CancelScope(query_id, timeout_ms)
+    with _scope_lock:
+        lockset.check(_CTX, "_SCOPES")
+        _SCOPES[query_id] = scope
+    token = _CURRENT_SCOPE.set(scope)
+    try:
+        yield scope
+    finally:
+        _CURRENT_SCOPE.reset(token)
+        with _scope_lock:
+            lockset.check(_CTX, "_SCOPES")
+            if _SCOPES.get(query_id) is scope:
+                del _SCOPES[query_id]
+        # close() is the emission decision AND the refusal point for
+        # any later cancel, atomically on the scope lock — a canceller
+        # that already looked the scope up but loses the race to here
+        # gets False back from cancel() instead of an accepted request
+        # whose events were silently skipped
+        if scope.close():
+            from . import trace
+
+            fs, ft = scope.frontier
+            reason = scope.reason or "cancel"
+            trace.emit("query_cancel_requested", query_id=query_id,
+                       reason=reason)
+            trace.emit("query_cancelled", query_id=query_id,
+                       reason=reason, stage_id=fs, task=ft)
+
+
+def cancel_query(query_id: str, reason: str = "cancel") -> bool:
+    """Cancel a live query by id — the one entry point the monitor's
+    ``POST /queries/<id>/cancel``, the session/gateway ``cancel`` API,
+    and the chaos cancel-storm arm all share.  Returns True when a
+    live scope accepted the request (idempotently: a repeat cancel of
+    the same query is still True), False when no such query is
+    running."""
+    with _scope_lock:
+        lockset.check(_CTX, "_SCOPES")
+        scope = _SCOPES.get(query_id)
+    if scope is None:
+        return False
+    if scope.cancel(reason):
+        return True
+    # not the first transition: accepted iff a cancel already landed —
+    # a scope that CLOSED un-cancelled in the lookup window refuses
+    # (the query finished; there is nothing left to cancel)
+    return scope.cancelled
 
 
 class TaskContext:

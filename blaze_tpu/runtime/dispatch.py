@@ -116,6 +116,33 @@ def capture() -> Iterator[Dict[str, int]]:
             _remove_by_identity(_CAPTURES, c)
 
 
+def _oom_call(fn: Callable, label: str, *a, **k):
+    """Run one instrumented program launch under the device-OOM
+    recovery guard (rung 1 of the degradation ladder, runtime/oom.py):
+    a ``RESOURCE_EXHAUSTED`` failure force-spills every memmgr-tracked
+    consumer and re-runs the program ONCE; a second exhaustion
+    propagates to the operator-level rungs (batch downshift, eager
+    fallback).  The ``kernel.dispatch`` fault site is probed inside
+    the guard, so an injected ``@oom`` rule exercises exactly this
+    path.  The no-fault, no-OOM cost is one disarmed ``faults.hit``
+    bool read and one try frame."""
+    from . import faults
+
+    try:
+        faults.hit("kernel.dispatch", detail=label)
+        return fn(*a, **k)
+    except Exception as exc:  # noqa: BLE001 — classified below
+        from . import oom
+
+        if not oom.is_resource_exhausted(exc):
+            raise
+        oom.recover_spill(label)
+    # retry outside the handler: a second RESOURCE_EXHAUSTED must reach
+    # the caller's downshift/eager rungs, not recurse into spilling
+    faults.hit("kernel.dispatch", detail=label)
+    return fn(*a, **k)
+
+
 def instrument(fn: Callable, label: str = "kernel") -> Callable:
     """Wrap a jitted callable so every call records a dispatch and
     cache-missing calls record a compile + its wall time.  ``label``
@@ -150,7 +177,7 @@ def instrument(fn: Callable, label: str = "kernel") -> Callable:
     def wrapper(*a, **k):
         if not trace._KERNEL_TIMING:  # pre-existing non-blocking path
             t0 = time.perf_counter()
-            out = fn(*a, **k)
+            out = _oom_call(fn, label, *a, **k)
             after = size()
             record("xla_dispatches")
             if after > state["seen"]:
@@ -174,7 +201,7 @@ def instrument(fn: Callable, label: str = "kernel") -> Callable:
         import jax
 
         t0 = time.perf_counter_ns()
-        out = fn(*a, **k)
+        out = _oom_call(fn, label, *a, **k)
         t1 = time.perf_counter_ns()
         after = size()
         record("xla_dispatches")

@@ -18,6 +18,9 @@ site                instrumented where
 ``rss.push``        RssShuffleWriterExec partition pushes
 ``spill.write``     consumer spill() entry points (shuffle/sort/agg/
                     smj windows), probed OUTSIDE their state locks
+``kernel.dispatch`` every instrumented XLA program launch
+                    (runtime/dispatch.py), inside the OOM-recovery
+                    guard — the ``@oom`` modifier's natural site
 ==================  ====================================================
 
 A *schedule* maps each site to the 1-based hit numbers that must raise,
@@ -38,8 +41,13 @@ override ``BLAZE_FAULTS_SPEC``, so worker subprocesses inherit it) with
 the grammar::
 
     spec     := entry ("," entry)*
-    entry    := site "@" hit [ "@a" attempt ] [ "@slow" ms ]
-    example  := "shuffle.fetch@2,task.compute@1@a0,shuffle.write@1@a0@slow500"
+    entry    := site "@" hit [ "@a" attempt ] [ "@slow" ms | "@oom" ]
+    example  := "shuffle.fetch@2,task.compute@1@a0,kernel.dispatch@3@oom"
+
+An ``@oom`` entry raises :class:`InjectedOom` — a stand-in for XLA's
+``RESOURCE_EXHAUSTED`` that the degradation ladder (runtime/oom.py)
+must absorb: spill, batch downshift, eager fallback — making the
+ladder deterministically testable without exhausting a real device.
 
 Hit counters are per-process.  The schedule is loaded from conf at the
 FIRST :func:`hit` of the process and re-loaded (counters reset) by
@@ -64,6 +72,11 @@ SITES = (
     "task.compute",
     "rss.push",
     "spill.write",
+    # every instrumented XLA program launch (runtime/dispatch.py
+    # _oom_call): like spill.write it has NO attempt identity — a
+    # kernel may run on the async stager or a sibling attempt's
+    # thread — so rely on the one-shot hit counter
+    "kernel.dispatch",
 )
 
 
@@ -79,9 +92,24 @@ class InjectedFault(RuntimeError):
         )
 
 
-# (site, hit_no, attempt_filter, slow_ms) — attempt_filter None = any
-# attempt; slow_ms None = raise, otherwise sleep that long and return
-Rule = Tuple[str, int, Optional[int], Optional[int]]
+class InjectedOom(InjectedFault):
+    """An injected device-memory exhaustion (the ``@oom`` modifier):
+    the message carries the XLA status string so
+    ``runtime.oom.is_resource_exhausted`` classifies it exactly like a
+    real allocator failure and the degradation ladder — not the retry
+    loop — absorbs it."""
+
+    def __init__(self, site: str, hit: int, detail: str = ""):
+        super().__init__(site, hit, detail)
+        self.args = (
+            f"RESOURCE_EXHAUSTED: injected device OOM at {site} "
+            f"(hit {hit})" + (f": {detail}" if detail else ""),)
+
+
+# (site, hit_no, attempt_filter, slow_ms, oom) — attempt_filter None =
+# any attempt; slow_ms None = raise, otherwise sleep that long and
+# return; oom True = raise InjectedOom instead of InjectedFault
+Rule = Tuple[str, int, Optional[int], Optional[int], bool]
 
 
 def parse_spec(spec: str) -> List[Rule]:
@@ -98,8 +126,13 @@ def parse_spec(spec: str) -> List[Rule]:
             raise ValueError(f"unknown fault site {site!r} (known: {SITES})")
         attempt: Optional[int] = None
         slow_ms: Optional[int] = None
+        oom = False
         for mod in parts[2:]:
-            if mod.startswith("slow"):
+            if mod == "oom":
+                if oom:
+                    raise ValueError(f"duplicate oom modifier in {entry!r}")
+                oom = True
+            elif mod.startswith("slow"):
                 if slow_ms is not None:
                     raise ValueError(f"duplicate slow modifier in {entry!r}")
                 slow_ms = int(mod[4:])
@@ -109,18 +142,23 @@ def parse_spec(spec: str) -> List[Rule]:
                 attempt = int(mod[1:])
             else:
                 raise ValueError(f"bad modifier {mod!r} in {entry!r}")
-        rules.append((site, hit, attempt, slow_ms))
+        if oom and slow_ms is not None:
+            raise ValueError(
+                f"oom and slow modifiers are exclusive in {entry!r}")
+        rules.append((site, hit, attempt, slow_ms, oom))
     return rules
 
 
 def format_spec(rules: List[Rule]) -> str:
     out = []
-    for site, hit, attempt, slow_ms in rules:
+    for site, hit, attempt, slow_ms, oom in rules:
         s = f"{site}@{hit}"
         if attempt is not None:
             s += f"@a{attempt}"
         if slow_ms is not None:
             s += f"@slow{slow_ms}"
+        if oom:
+            s += "@oom"
         out.append(s)
     return ",".join(out)
 
@@ -133,6 +171,8 @@ def random_spec(
     first_attempt_only: bool = True,
     n_stragglers: int = 0,
     straggler_ms: Tuple[int, int] = (250, 600),
+    n_ooms: int = 0,
+    oom_horizon: int = 12,
 ) -> str:
     """Seed-derived fault schedule for chaos runs.  Faults are gated to
     attempt 0 by default so a bounded retry budget always recovers
@@ -144,7 +184,12 @@ def random_spec(
     are NOT attempt-gated (a crash rule earlier in the schedule may
     already have consumed attempt 0): the one-shot hit counter still
     guarantees the delay is paid exactly once, so whichever attempt
-    draws it straggles and the race resolves the other way."""
+    draws it straggles and the race resolves the other way.
+
+    ``n_ooms`` appends that many ``kernel.dispatch@<hit>@oom`` entries
+    (seeded hit in ``1..oom_horizon``): a mid-query device-OOM the
+    degradation ladder (runtime/oom.py) must absorb without the run's
+    result changing — the injected-OOM chaos arm."""
     rng = random.Random(seed)
     rules: List[Rule] = []
     seen: Set[Tuple[str, int]] = set()
@@ -154,7 +199,8 @@ def random_spec(
         if (site, hit) in seen:
             continue
         seen.add((site, hit))
-        rules.append((site, hit, 0 if first_attempt_only else None, None))
+        rules.append((site, hit, 0 if first_attempt_only else None, None,
+                      False))
     straggler_sites = ("task.compute", "shuffle.write")
     for _ in range(n_stragglers):
         # REDRAW on collision with a crash rule (the sites overlap):
@@ -169,7 +215,18 @@ def random_spec(
             continue
         seen.add((site, hit))
         ms = rng.randrange(straggler_ms[0], straggler_ms[1] + 1)
-        rules.append((site, hit, None, ms))
+        rules.append((site, hit, None, ms, False))
+    for _ in range(n_ooms):
+        # kernel.dispatch is its own hit-counter namespace, so OOM
+        # entries can never collide with the crash/straggler sites
+        for _ in range(16):
+            hit = rng.randrange(1, oom_horizon + 1)
+            if ("kernel.dispatch", hit) not in seen:
+                break
+        else:
+            continue
+        seen.add(("kernel.dispatch", hit))
+        rules.append(("kernel.dispatch", hit, None, None, True))
     return format_spec(rules)
 
 
@@ -177,9 +234,11 @@ class FaultInjector:
     """Per-process hit counters against a parsed schedule."""
 
     def __init__(self, rules: List[Rule]):
-        self._by_site: Dict[str, List[Tuple[int, Optional[int], Optional[int]]]] = {}
-        for site, hit, attempt, slow_ms in rules:
-            self._by_site.setdefault(site, []).append((hit, attempt, slow_ms))
+        self._by_site: Dict[
+            str, List[Tuple[int, Optional[int], Optional[int], bool]]] = {}
+        for site, hit, attempt, slow_ms, oom in rules:
+            self._by_site.setdefault(site, []).append(
+                (hit, attempt, slow_ms, oom))
         self._counts: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -190,7 +249,7 @@ class FaultInjector:
         with self._lock:
             n = self._counts.get(site, 0) + 1
             self._counts[site] = n
-        for hit_no, want_attempt, slow_ms in matches:
+        for hit_no, want_attempt, slow_ms, oom in matches:
             if n == hit_no and (want_attempt is None or want_attempt == attempt):
                 # record the injection BEFORE raising/sleeping so a
                 # chaos run's event log pairs every fault with its
@@ -203,6 +262,13 @@ class FaultInjector:
                                detail=detail)
                     time.sleep(slow_ms / 1000.0)
                     return
+                if oom:
+                    # kind=oom: the reconciliation gate pairs this with
+                    # an oom_recovery (the degradation ladder) instead
+                    # of a task retry
+                    trace.emit("fault_injected", site=site, hit=n,
+                               attempt=attempt, detail=detail, kind="oom")
+                    raise InjectedOom(site, n, detail)
                 trace.emit("fault_injected", site=site, hit=n,
                            attempt=attempt, detail=detail)
                 if site == "shuffle.fetch":

@@ -292,11 +292,27 @@ class MemManager:
             victims = sorted(
                 ((c, c._mem_used) for c in self._consumers),
                 key=lambda cu: -cu[1])
+        self._drain_victims(victims, over)
+
+    def force_spill(self) -> int:
+        """Spill EVERY tracked consumer regardless of watermark —
+        rung 1 of the device-OOM degradation ladder (runtime/oom.py):
+        a ``RESOURCE_EXHAUSTED`` program is about to re-run, and the
+        host-staging state consumers hold is the shrinkable half of
+        what the next transfer ships.  Returns bytes freed."""
+        with self._lock:
+            victims = sorted(
+                ((c, c._mem_used) for c in self._consumers),
+                key=lambda cu: -cu[1])
+        return self._drain_victims(victims, float("inf"))
+
+    def _drain_victims(self, victims, over) -> int:
         # spill outside the lock: consumers re-enter accounting; a
         # concurrent spill of the same victim is benign (its spill()
         # finds no state and returns 0, which we don't count)
         from . import trace
 
+        freed_total = 0
         for v, used in victims:
             if over <= 0:
                 break
@@ -310,6 +326,8 @@ class MemManager:
                     self.spilled_bytes += freed
                 trace.emit("spill", consumer=v.name, bytes=freed)
             over -= freed
+            freed_total += freed
+        return freed_total
 
 
 def try_new_spill(codec: Optional[str] = None) -> Spill:

@@ -172,6 +172,21 @@ def _new_stage(stage_id: int, kind: Optional[str], n_tasks: int,
     }
 
 
+def _terminal_status(exc: Optional[BaseException]) -> str:
+    """Registry terminal status for a query exit: ``done`` /
+    ``cancelled`` / ``deadline_exceeded`` / ``failed`` — the statuses
+    ``/queries`` and ``--watch`` surface."""
+    from .context import QueryCancelledError, QueryDeadlineError
+
+    if exc is None:
+        return "done"
+    if isinstance(exc, QueryDeadlineError):
+        return "deadline_exceeded"
+    if isinstance(exc, QueryCancelledError):
+        return "cancelled"
+    return "failed"
+
+
 @contextlib.contextmanager
 def query(query_id: str, mode: str = "in-process") -> Iterator[Optional[str]]:
     """Scope one monitored query in the live registry; yields the
@@ -198,11 +213,11 @@ def query(query_id: str, mode: str = "in-process") -> Iterator[Optional[str]]:
         }
         _bump()
     token = _CURRENT.set(key)
-    status = "ok"
+    status = "done"
     try:
         yield key
-    except BaseException:
-        status = "failed"
+    except BaseException as exc:
+        status = _terminal_status(exc)
         raise
     finally:
         _CURRENT.reset(token)
@@ -215,15 +230,22 @@ def query(query_id: str, mode: str = "in-process") -> Iterator[Optional[str]]:
 
 
 @contextlib.contextmanager
-def query_span(query_id: str, mode: str = "in-process") -> Iterator[Optional[str]]:
-    """Combined trace + monitor query scope: the event-log span
-    (``trace.query``) and the live-registry entry open/close together —
-    the one scope every execution entry point (CLI suite runner,
-    ``session.execute``, the gateway) wraps a query in.  Yields the
-    event-log path (None when tracing is disarmed)."""
+def query_span(query_id: str, mode: str = "in-process",
+               timeout_ms: Optional[int] = None) -> Iterator[Optional[str]]:
+    """Combined trace + monitor + cancellation query scope: the
+    event-log span (``trace.query``), the per-query
+    :class:`context.CancelScope` (cancellation + the
+    ``spark.blaze.query.timeoutMs`` deadline), and the live-registry
+    entry open/close together — the one scope every execution entry
+    point (CLI suite runner, ``session.execute``, the gateway) wraps a
+    query in.  Yields the event-log path (None when tracing is
+    disarmed)."""
+    from .context import cancel_scope
+
     with trace.query(query_id) as log_path:
-        with query(query_id, mode=mode):
-            yield log_path
+        with cancel_scope(query_id, timeout_ms=timeout_ms):
+            with query(query_id, mode=mode):
+                yield log_path
 
 
 def stage_started(stage_id: int, kind: Optional[str], n_tasks: int) -> None:
@@ -708,15 +730,25 @@ def drive_result_stage(plan, on_batch) -> None:
     choreography of ``session.execute`` and the CLI suite runner, so
     the progress contract cannot drift between entry points.  A
     callback rather than a generator on purpose: a span held across
-    yields would stay open whenever a consumer abandons the stream."""
-    from .context import TaskContext
+    yields would stay open whenever a consumer abandons the stream.
+    Runs under the ambient :class:`context.CancelScope` (when one is
+    open): tasks see the scope's cancel event cooperatively and every
+    pulled batch is a cancellation/deadline checkpoint."""
+    from .context import TaskContext, current_cancel_scope
 
+    scope = current_cancel_scope()
     n = plan.num_partitions()
     with stage_span(0, "result", n) as progress:
         for p in range(n):
-            for b in plan.execute(p, TaskContext(p, n)):
+            ctx = TaskContext(
+                p, n, cancel_event=scope.event if scope is not None else None)
+            for b in plan.execute(p, ctx):
+                if scope is not None:
+                    scope.check(0, p)
                 progress.add_batch(b)
                 on_batch(b)
+            if scope is not None:
+                scope.check(0, p)
             progress.task_done()
 
 
@@ -893,6 +925,16 @@ def render_prometheus() -> str:
             doc.add("blaze_query_stage_bytes", st["bytes"], sl, mtype="gauge")
             doc.add("blaze_query_stage_tasks_done", st["tasks_done"], sl,
                     mtype="gauge")
+            # degradation-ladder counters (runtime/oom.py): exported
+            # only when the ladder fired — and, like elapsed, they
+            # FREEZE at the final value once the query finishes (the
+            # heartbeat-age rule: nothing exported here climbs forever
+            # on a finished query)
+            for k in ("oom_recoveries", "batch_downshifts",
+                      "eager_fallbacks"):
+                v = st["counters"].get(k, 0)
+                if v:
+                    doc.add(f"blaze_query_stage_{k}", v, sl, mtype="gauge")
     doc.add("blaze_mem_used_bytes", snap["memory"]["used"], mtype="gauge")
     doc.add("blaze_mem_total_bytes", snap["memory"]["total"], mtype="gauge")
     return doc.render()
@@ -932,7 +974,8 @@ class MonitorServer:
                     elif path in ("/", "/healthz"):
                         body = json.dumps({
                             "status": "ok",
-                            "endpoints": ["/metrics", "/queries", "/healthz"],
+                            "endpoints": ["/metrics", "/queries", "/healthz",
+                                          "POST /queries/<id>/cancel"],
                         }).encode()
                         ctype = "application/json"
                     else:
@@ -944,6 +987,34 @@ class MonitorServer:
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802 — http.server contract
+                """``POST /queries/<id>/cancel`` — the HTTP half of the
+                query kill switch (≙ the Spark UI's kill link): routes
+                to ``context.cancel_query``, which fans out into every
+                live task attempt's cancel event.  The query itself
+                returns to ITS caller as QueryCancelledError; this
+                endpoint only acknowledges the request."""
+                path = self.path.split("?", 1)[0]
+                m = re.match(r"^/queries/([^/]+)/cancel$", path)
+                if m is None:
+                    self.send_error(404)
+                    return
+                from .context import cancel_query
+
+                try:
+                    accepted = cancel_query(m.group(1))
+                except Exception as e:  # noqa: BLE001 — 500, not a dead thread
+                    self.send_error(500, explain=f"{type(e).__name__}: {e}")
+                    return
+                body = json.dumps({
+                    "query_id": m.group(1), "cancelled": accepted,
+                }).encode()
+                self.send_response(200 if accepted else 404)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -1112,6 +1183,15 @@ def render_watch(snap: Dict[str, Any], url: str = "") -> str:
                     "fetch_failures {fetch_failures}").format(
                 **{k: att.get(k, 0) for k in (
                     "task_attempts", "task_retries", "fetch_failures")})
+        # the degradation-ladder story, when it fired: what shed
+        # memory pressure and how far down the ladder the query went
+        deg = {k: sum(st["counters"].get(k, 0) for st in q["stages"])
+               for k in ("oom_recoveries", "batch_downshifts",
+                         "eager_fallbacks")}
+        if any(deg.values()):
+            tail += (f"  oom {deg['oom_recoveries']} spill"
+                     f"/{deg['batch_downshifts']} downshift"
+                     f"/{deg['eager_fallbacks']} eager")
         lines.append(
             f"{q['query_id']} [{q['mode']}] {q['status'].upper():7s} "
             f"{q['elapsed_s']:.1f}s  beat {q['heartbeat_age_s']:.1f}s ago"

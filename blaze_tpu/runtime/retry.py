@@ -116,9 +116,11 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit,
                         MemoryError)):
         return FATAL
-    from .context import TaskCancelled
+    from .context import QueryCancelledError, TaskCancelled
 
-    if isinstance(exc, TaskCancelled):
+    if isinstance(exc, (TaskCancelled, QueryCancelledError)):
+        # a cancelled/deadline-expired QUERY must not be resurrected
+        # one task retry at a time
         return FATAL
     if isinstance(exc, (AssertionError, NotImplementedError)):
         # plan/engine bugs, not environment flakes: retrying re-runs

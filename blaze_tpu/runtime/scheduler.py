@@ -27,7 +27,10 @@ from ..ops import ExecNode
 from ..parallel.exchange import NativeShuffleExchangeExec
 from ..parallel.shuffle import IpcReaderExec, LocalShuffleManager, ShuffleWriterExec
 from . import monitor, trace
-from .context import RESOURCES, ScopedResources, TaskContext
+from .context import (
+    RESOURCES, QueryCancelledError, ScopedResources, TaskContext,
+    current_cancel_scope,
+)
 from .metrics import MetricNode
 from .speculation import SpeculationPolicy, StageTaskRunner
 
@@ -177,7 +180,8 @@ def stage_task_definitions(
     return [build_task(stage, manager, t)[1] for t in range(stage.n_tasks)]
 
 
-def _compute_range_boundaries(stage: Stage, register_readers, max_rows: int = 1 << 16):
+def _compute_range_boundaries(stage: Stage, register_readers,
+                              max_rows: int = 1 << 16, scope=None):
     """Driver-side boundary pass for a range-partitioned map stage
     (≙ Spark's RangePartitioner sample job): run the stage's plan once,
     extract sort-key ORDER WORDS, and pick the (n_out-1) lexicographic
@@ -205,9 +209,12 @@ def _compute_range_boundaries(stage: Stage, register_readers, max_rows: int = 1 
     task_quota = max(1024, max_rows // max(1, stage.n_tasks))
     for t in range(stage.n_tasks):
         register_readers(t)
-        ctx = TaskContext(t, stage.n_tasks)
+        ctx = TaskContext(t, stage.n_tasks,
+                          cancel_event=scope.event if scope else None)
         task_rows = 0
         for b in stage.plan.execute(t, ctx):
+            if scope is not None:
+                scope.check(stage.stage_id, t)
             words = key_words(tuple(b.columns), b.num_rows)
             for i, w in enumerate(words):
                 if len(per_word) <= i:
@@ -313,6 +320,12 @@ def run_stages(
     global LAST_RUN_METRICS
     LAST_RUN_METRICS = metrics
     sched_m = metrics.metrics
+    # query-level cancellation + deadline (context.CancelScope, opened
+    # by monitor.query_span): every cooperative checkpoint below calls
+    # scope.check, serial attempts share the scope event as their
+    # cancel_event, and concurrent attempts attach their own events —
+    # a cancel mid-stage reaches ALL live attempts
+    scope = current_cancel_scope()
 
     n_maps: Dict[int, int] = {}
     bcast_blobs: Dict[int, List[bytes]] = {}
@@ -385,9 +398,12 @@ def run_stages(
     def drain(stage: Stage, t: int, it, out: List, progress) -> None:
         """Collect a task's output, enforcing the cooperative per-task
         timeout between batches; driver-observed batches feed the
-        heartbeat-gated stage progress."""
+        heartbeat-gated stage progress.  Every pulled batch is also a
+        query-cancellation/deadline checkpoint."""
         deadline = policy.deadline()
         for b in it:
+            if scope is not None:
+                scope.check(stage.stage_id, t)
             out.append(b)
             progress.add_batch(b)
             if deadline is not None and time.monotonic() > deadline:
@@ -470,7 +486,7 @@ def run_stages(
         raise exc  # FATAL
 
     def attempt_once(stage: Stage, t: int, attempt: int, register,
-                     progress, scope: Optional[str] = None,
+                     progress, res_scope: Optional[str] = None,
                      cancel_event=None, on_beat=None) -> List:
         """ONE attempt of a non-result task, end to end: (re)register
         this attempt's reduce blocks (pops on read, so every attempt
@@ -479,9 +495,14 @@ def run_stages(
         the attempt touched (progress delta, registry heartbeat, staged
         resources) before re-raising — shared verbatim by the serial
         retry loop and the concurrent/speculative runner, which passes
-        a ``scope`` so racing attempts read through attempt-scoped
+        a ``res_scope`` so racing attempts read through attempt-scoped
         resource keys, plus the cancel event and wedge-clock beat."""
-        block_keys, remap = register(t, scope)
+        if cancel_event is None and scope is not None:
+            # serial attempts share the query CancelScope's event
+            # directly, so a cancel reaches the in-flight plan drive
+            # (the shuffle/RSS/broadcast writers' cooperative seams)
+            cancel_event = scope.event
+        block_keys, remap = register(t, res_scope)
         td, staged = build_attempt_td(stage, t, attempt)
         sched_m.add("task_attempts", 1)
         trace.emit("task_attempt_start", stage_id=stage.stage_id,
@@ -508,6 +529,11 @@ def run_stages(
                 # block/blob entries in the resources map forever
                 for key in staged + block_keys:
                     RESOURCES.discard(key)
+                if scope is not None and scope.cancelled:
+                    # a QUERY cancel (not a speculation race): the
+                    # attempt resolves as cancelled through the
+                    # rollback path below, never as "ok"
+                    scope.raise_cancelled(stage.stage_id, t)
             trace.emit("task_attempt_end", stage_id=stage.stage_id,
                        task=t, attempt=attempt, status="ok")
             return batches
@@ -523,6 +549,13 @@ def run_stages(
                        error=f"{type(exc).__name__}: {exc}"[:300])
             for key in staged + block_keys:
                 RESOURCES.discard(key)
+            if stage.kind == "map":
+                # rollback path reclaims the attempt's .inprogress
+                # staging temps NOW (they were previously reclaimed
+                # only at process exit — the cancellation leak); the
+                # commit-by-rename contract means a committed winner's
+                # final files are untouched
+                manager.sweep_inprogress(stage.shuffle_id, t, attempt)
             raise
 
     def run_task_attempts(stage: Stage, t: int, register, progress) -> List:
@@ -532,6 +565,8 @@ def run_stages(
         attempt = 0
         regens = 0
         while True:
+            if scope is not None:
+                scope.check(stage.stage_id, t)
             try:
                 return attempt_once(stage, t, attempt, register, progress)
             except BaseException as exc:
@@ -548,6 +583,8 @@ def run_stages(
         attempt = 0
         regens = 0
         while True:
+            if scope is not None:
+                scope.check(stage.stage_id, t)
             block_keys, _ = register(t)
             td, staged = build_attempt_td(stage, t, attempt)
             sched_m.add("task_attempts", 1)
@@ -556,7 +593,13 @@ def run_stages(
             yielded = False
             try:
                 deadline = policy.deadline()
-                for b in from_proto.run_task(td, task_attempt_id=attempt):
+                for b in from_proto.run_task(
+                        td, task_attempt_id=attempt,
+                        cancel_event=scope.event if scope else None):
+                    # the pulled batch is a cancellation checkpoint
+                    # BEFORE it is surfaced to the caller
+                    if scope is not None:
+                        scope.check(stage.stage_id, t)
                     # deadline checked on the PULLED batch before it is
                     # surfaced, so a timed-out attempt stays replayable
                     if deadline is not None and time.monotonic() > deadline:
@@ -567,6 +610,14 @@ def run_stages(
                     yielded = True
                     progress.add_batch(b)
                     yield b
+                if scope is not None:
+                    # a cancelled operator STOPS yielding instead of
+                    # raising (the cooperative seams), so a cancel that
+                    # lands during the final drain would otherwise end
+                    # the loop quietly and return a silently TRUNCATED
+                    # result as "ok" — the post-loop checkpoint turns
+                    # it into the typed terminal error
+                    scope.check(stage.stage_id, t)
                 trace.emit("task_attempt_end", stage_id=stage.stage_id,
                            task=t, attempt=attempt, status="ok")
                 return
@@ -617,7 +668,8 @@ def run_stages(
             regens = 0
             while True:
                 try:
-                    part.boundaries = _compute_range_boundaries(stage, register)
+                    part.boundaries = _compute_range_boundaries(
+                        stage, register, scope=scope)
                     break
                 except BaseException as exc:
                     attempt, regens = handle_failure(stage, -1, exc,
@@ -628,9 +680,9 @@ def run_stages(
         if pol.runner_needed():
             runner = StageTaskRunner(
                 stage.stage_id, stage.kind, task_list, pol,
-                attempt_fn=lambda t, a, scope, cancel, beat: attempt_once(
+                attempt_fn=lambda t, a, rscope, cancel, beat: attempt_once(
                     stage, t, a, register, progress,
-                    scope=scope, cancel_event=cancel, on_beat=beat),
+                    res_scope=rscope, cancel_event=cancel, on_beat=beat),
                 # sleep=False: the runner schedules the backoff itself
                 # so its polling loop keeps resolving sibling tasks
                 on_failure=lambda t, exc, a, r: handle_failure(
@@ -688,27 +740,43 @@ def run_stages(
                                   # counters even with observability off
                                   capture_dispatch=True)
 
-    for stage in stages:
-        if adaptive_on:
-            maybe_rewrite_stage(stage, manager, n_maps, bcast_blobs,
-                                next_adaptive_bid)
-        if stage.kind == "result":
-            register = make_registrar(stage)
+    try:
+        for stage in stages:
+            if scope is not None:
+                # between-stage checkpoint: a cancel that landed while
+                # no task was draining still stops the query here
+                scope.check(stage.stage_id)
+            if adaptive_on:
+                maybe_rewrite_stage(stage, manager, n_maps, bcast_blobs,
+                                    next_adaptive_bid)
+            if stage.kind == "result":
+                register = make_registrar(stage)
+                with stage_scope(stage) as progress:
+                    for t in range(stage.n_tasks):
+                        yield from run_result_task(stage, t, register,
+                                                   progress)
+                        progress.task_done()
+                publish_dispatch(stage, progress.counters)
+                continue
             with stage_scope(stage) as progress:
-                for t in range(stage.n_tasks):
-                    yield from run_result_task(stage, t, register, progress)
-                    progress.task_done()
+                run_stage_tasks(stage, progress)
             publish_dispatch(stage, progress.counters)
-            continue
-        with stage_scope(stage) as progress:
-            run_stage_tasks(stage, progress)
-        publish_dispatch(stage, progress.counters)
-        if stage.kind == "map":
-            n_maps[stage.shuffle_id] = stage.n_tasks
-        elif stage.kind == "broadcast":
-            # collect the per-partition blobs the IpcWriterExec tasks
-            # registered; downstream tasks get them re-registered each
-            bcast_blobs[stage.broadcast_id] = [
-                RESOURCES.get(f"broadcast_{stage.broadcast_id}.{p}")
-                for p in range(stage.n_tasks)
-            ]
+            if stage.kind == "map":
+                n_maps[stage.shuffle_id] = stage.n_tasks
+            elif stage.kind == "broadcast":
+                # collect the per-partition blobs the IpcWriterExec tasks
+                # registered; downstream tasks get them re-registered each
+                bcast_blobs[stage.broadcast_id] = [
+                    RESOURCES.get(f"broadcast_{stage.broadcast_id}.{p}")
+                    for p in range(stage.n_tasks)
+                ]
+    except QueryCancelledError:
+        # query-level rollback: every live attempt has already been
+        # cancelled/joined on the way out (the runner's terminal path,
+        # the serial attempt's own rollback); what remains is the
+        # on-disk debris no attempt-level handler owns — abandoned
+        # attempts' .inprogress staging temps.  Committed shuffle
+        # outputs are left for the manager's normal lifecycle (they
+        # are shared, possibly by a concurrent re-run).
+        manager.sweep_inprogress()
+        raise

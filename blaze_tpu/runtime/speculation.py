@@ -49,6 +49,7 @@ from typing import Callable, List, Optional
 
 from .. import conf
 from . import monitor, trace
+from .context import current_cancel_scope
 from .retry import FATAL, TaskWedgedError, classify
 
 #: attempt ids for speculative backups start here — far above any
@@ -215,6 +216,13 @@ class StageTaskRunner:
         self.metrics = metrics
         self.durations: List[float] = []   # successful task durations
         self._abandoned: List[_Attempt] = []
+        # query-level cancellation (context.CancelScope): each spawned
+        # attempt's private cancel event is ATTACHED to the scope so a
+        # query cancel reaches every live attempt at once; the poll
+        # loop is the driver-side checkpoint.  Captured at construction
+        # (the runner runs on the driver thread that owns the scope).
+        self._scope = current_cancel_scope()
+        self._attached: List[threading.Event] = []
 
     # ------------------------------------------------------ attempts
 
@@ -241,6 +249,9 @@ class StageTaskRunner:
         att.thread = threading.Thread(
             target=cctx.run, args=(body,), daemon=True,
             name=f"blaze-attempt-{self.stage_id}-{state.task}-a{attempt_id}")
+        if self._scope is not None:
+            self._scope.attach(att.cancel)
+            self._attached.append(att.cancel)
         att.thread.start()
         return att
 
@@ -456,6 +467,12 @@ class StageTaskRunner:
                      + [0.05])
         try:
             while pending or running:
+                if self._scope is not None:
+                    # query-cancel/deadline checkpoint: raises the
+                    # typed error, and the terminal path below cancels
+                    # + joins every in-flight attempt before it
+                    # propagates
+                    self._scope.check(self.stage_id)
                 while pending and len(running) < self.policy.concurrency:
                     st = pending.pop(0)
                     st.primary = self._spawn(st, st.attempt_no,
@@ -498,3 +515,10 @@ class StageTaskRunner:
                 if not att.thread.is_alive():
                     monitor.task_discard(self.stage_id, att.task,
                                          attempt=att.attempt_id)
+            if self._scope is not None:
+                # the scope outlives this stage: detach every event we
+                # attached or a long-lived service's scope set grows by
+                # one per attempt forever
+                for ev in self._attached:
+                    self._scope.detach(ev)
+                self._attached.clear()

@@ -60,6 +60,7 @@ from .metrics import _remove_by_identity
 #: trace_schema.json (tests/test_trace.py gates the drift both ways)
 EVENT_TYPES = frozenset({
     "query_start", "query_end",
+    "query_cancel_requested", "query_cancelled",
     "stage_submit", "stage_complete",
     "task_attempt_start", "task_attempt_end",
     "task_retry", "task_timeout",
@@ -69,6 +70,7 @@ EVENT_TYPES = frozenset({
     "task_kernels", "task_plan",
     "stage_progress", "task_heartbeat",
     "fault_injected", "straggler_injected",
+    "oom_recovery",
     "mem_watermark", "spill",
     "shuffle_write", "shuffle_fetch", "rss_push",
 })
@@ -288,8 +290,13 @@ def query(query_id: str) -> Iterator[Optional[str]]:
     status = "ok"
     try:
         yield path
-    except BaseException:
-        status = "failed"
+    except BaseException as exc:
+        from .context import QueryCancelledError, QueryDeadlineError
+
+        status = ("deadline_exceeded"
+                  if isinstance(exc, QueryDeadlineError) else
+                  "cancelled" if isinstance(exc, QueryCancelledError)
+                  else "failed")
         raise
     finally:
         emit("query_end", query_id=query_id, status=status,

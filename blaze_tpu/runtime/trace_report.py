@@ -20,6 +20,48 @@ from typing import Any, Dict, List, Optional, Tuple
 #: task re-attempt, or a map-stage rerun after a fetch failure
 RECOVERY_EVENTS = ("task_retry", "map_stage_rerun")
 
+#: recovery candidates for an injected OOM (``@oom`` faults carry
+#: ``kind: "oom"``): the degradation ladder's own event first — an OOM
+#: the ladder absorbed never produces a retry — with the retry events
+#: still counting for a ladder-exhausted attempt that re-ran
+OOM_RECOVERY_EVENTS = ("oom_recovery",) + RECOVERY_EVENTS
+
+#: incident event types the recovery timeline shows — ONE definition
+#: for the text report and the JSON profile, so a new event type can
+#: never appear in one rendering and silently miss the other
+TIMELINE_TYPES = frozenset({
+    "fault_injected", "straggler_injected",
+    "fetch_failure", "task_retry", "task_timeout",
+    "map_stage_rerun", "speculative_attempt_start",
+    "speculative_attempt_won", "speculative_attempt_lost",
+    "oom_recovery", "query_cancel_requested", "query_cancelled",
+})
+
+
+def _pair_requests(events, is_request, accept):
+    """Greedy forward pairing shared by every reconciliation gate:
+    each request event matches the FIRST later unconsumed event
+    ``accept`` approves.  Returns (pairs, unpaired)."""
+    pairs: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
+    unpaired: List[Dict[str, Any]] = []
+    used: set = set()
+    for i, e in enumerate(events):
+        if not is_request(e):
+            continue
+        match: Optional[int] = None
+        for j in range(i + 1, len(events)):
+            if j in used:
+                continue
+            if accept(e, events[j]):
+                match = j
+                break
+        if match is None:
+            unpaired.append(e)
+        else:
+            used.add(match)
+            pairs.append((e, events[match]))
+    return pairs, unpaired
+
 
 def _fmt_s(ns: float) -> str:
     return f"{ns / 1e9:.3f}s"
@@ -42,25 +84,14 @@ def reconcile_faults(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     chaos gate's reconciliation contract: a fault the runtime absorbed
     silently (no recovery recorded) or a recovery with no cause both
     break the replayable-recovery story."""
-    pairs: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
-    unpaired: List[Dict[str, Any]] = []
-    used: set = set()
-    for i, e in enumerate(events):
-        if e.get("type") != "fault_injected":
-            continue
-        match: Optional[int] = None
-        for j in range(i + 1, len(events)):
-            if j in used:
-                continue
-            if events[j].get("type") in RECOVERY_EVENTS:
-                match = j
-                break
-        if match is None:
-            unpaired.append(e)
-        else:
-            used.add(match)
-            pairs.append((e, events[match]))
-    recoveries = sum(1 for e in events if e.get("type") in RECOVERY_EVENTS)
+    pairs, unpaired = _pair_requests(
+        events,
+        lambda e: e.get("type") == "fault_injected",
+        lambda e, f: f.get("type") in (
+            OOM_RECOVERY_EVENTS if e.get("kind") == "oom"
+            else RECOVERY_EVENTS))
+    recoveries = sum(1 for e in events
+                     if e.get("type") in OOM_RECOVERY_EVENTS)
     return {
         "injected": len(pairs) + len(unpaired),
         "recoveries": recoveries,
@@ -78,24 +109,14 @@ def reconcile_speculation(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     its progress rollback, or its commit arbitration never finished).
     A log with no speculation events reconciles trivially."""
     outcomes = ("speculative_attempt_won", "speculative_attempt_lost")
-    unpaired: List[Dict[str, Any]] = []
-    pairs: List[Tuple[Dict[str, Any], Dict[str, Any]]] = []
-    for i, e in enumerate(events):
-        if e.get("type") != "speculative_attempt_start":
-            continue
-        key = (e.get("stage_id"), e.get("task"), e.get("attempt"))
-        match: Optional[Dict[str, Any]] = None
-        for j in range(i + 1, len(events)):
-            f = events[j]
-            if f.get("type") in outcomes and (
-                    f.get("stage_id"), f.get("task"),
-                    f.get("attempt")) == key:
-                match = f
-                break
-        if match is None:
-            unpaired.append(e)
-        else:
-            pairs.append((e, match))
+
+    def key(e):
+        return (e.get("stage_id"), e.get("task"), e.get("attempt"))
+
+    pairs, unpaired = _pair_requests(
+        events,
+        lambda e: e.get("type") == "speculative_attempt_start",
+        lambda e, f: f.get("type") in outcomes and key(f) == key(e))
     won = sum(1 for e in events
               if e.get("type") == "speculative_attempt_won")
     lost = sum(1 for e in events
@@ -104,6 +125,29 @@ def reconcile_speculation(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "speculated": len(pairs) + len(unpaired),
         "won": won,
         "lost": lost,
+        "pairs": pairs,
+        "unpaired": unpaired,
+        "reconciled": not unpaired,
+    }
+
+
+def reconcile_cancellation(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pair every ``query_cancel_requested`` with a subsequent
+    ``query_cancelled`` for the same query id — the cancel-storm gate's
+    contract: a requested cancel whose query never reached a terminal
+    ``query_cancelled`` means the scope leaked (attempts still running,
+    resources still registered) or the request was silently dropped.
+    A log with no cancel events reconciles trivially."""
+    pairs, unpaired = _pair_requests(
+        events,
+        lambda e: e.get("type") == "query_cancel_requested",
+        lambda e, f: (f.get("type") == "query_cancelled"
+                      and f.get("query_id") == e.get("query_id")))
+    cancelled = sum(1 for e in events
+                    if e.get("type") == "query_cancelled")
+    return {
+        "requested": len(pairs) + len(unpaired),
+        "cancelled": cancelled,
         "pairs": pairs,
         "unpaired": unpaired,
         "reconciled": not unpaired,
@@ -250,15 +294,14 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
     rec = reconcile_faults(events)
-    timeline_types = {"fault_injected", "straggler_injected",
-                      "fetch_failure", "task_retry", "task_timeout",
-                      "map_stage_rerun", "speculative_attempt_start",
-                      "speculative_attempt_won", "speculative_attempt_lost"}
+    timeline_types = TIMELINE_TYPES
     incidents = sorted(
         [e for e in events if e.get("type") in timeline_types]
         + [e for e in t.get("task_attempt_end", [])
            if e.get("status") == "failed"],
         key=lambda e: e.get("ts", 0))
+    oom_events = t.get("oom_recovery", [])
+    cxl = reconcile_cancellation(events)
     recovery = {
         "injected": rec["injected"],
         "recoveries": rec["recoveries"],
@@ -266,6 +309,19 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "unpaired": rec["unpaired"],
         "incidents": [dict(e, offset_s=round(e.get("ts", ts0) - ts0, 6))
                       for e in incidents],
+        # the degradation ladder's story: what shed pressure and how
+        "oom": {
+            "recoveries": len(oom_events),
+            "by_action": {a: sum(1 for e in oom_events
+                                 if e.get("action") == a)
+                          for a in ("spill", "downshift", "eager")},
+        },
+        # cancel-request <-> terminal-cancel pairing (cancel storms)
+        "cancellation": {
+            "requested": cxl["requested"],
+            "cancelled": cxl["cancelled"],
+            "reconciled": cxl["reconciled"],
+        },
     }
 
     hb = t.get("task_heartbeat", [])
@@ -423,10 +479,7 @@ def render(events: List[Dict[str, Any]]) -> str:
                          f"of {wm[-1].get('total', 0)} B budget")
 
     # ---- retry / fault timeline
-    timeline_types = {"fault_injected", "straggler_injected",
-                      "fetch_failure", "task_retry", "task_timeout",
-                      "map_stage_rerun", "speculative_attempt_start",
-                      "speculative_attempt_won", "speculative_attempt_lost"}
+    timeline_types = TIMELINE_TYPES
     incidents = [e for e in events if e.get("type") in timeline_types]
     incidents += [e for e in t.get("task_attempt_end", [])
                   if e.get("status") == "failed"]
@@ -439,6 +492,21 @@ def render(events: List[Dict[str, Any]]) -> str:
             f"{rec['recoveries']} recovery events, "
             + ("reconciled):" if rec["reconciled"] else "NOT RECONCILED):")
         )
+        oom_events = t.get("oom_recovery", [])
+        if oom_events:
+            by_action = {a: sum(1 for e in oom_events
+                                if e.get("action") == a)
+                         for a in ("spill", "downshift", "eager")}
+            lines.append(
+                "  degradation ladder: "
+                + ", ".join(f"{v} {k}" for k, v in by_action.items() if v))
+        cxl = reconcile_cancellation(events)
+        if cxl["requested"] or cxl["cancelled"]:
+            lines.append(
+                f"  cancellation: {cxl['requested']} requested / "
+                f"{cxl['cancelled']} terminal "
+                + ("(reconciled)" if cxl["reconciled"]
+                   else "(NOT RECONCILED)"))
         for e in incidents:
             dt = e.get("ts", ts0) - ts0
             detail = {k: v for k, v in e.items() if k not in ("ts", "type")}

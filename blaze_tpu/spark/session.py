@@ -124,6 +124,17 @@ class BlazeSparkSession:
             monitor.drive_result_stage(plan, collect)
         return out
 
+    def cancel(self, query_id: str) -> bool:
+        """Cancel a live query by the id :meth:`execute` /
+        :meth:`execute_distributed` was given (or generated) — ≙ the
+        Spark UI kill link / ``SparkContext.cancelJobGroup``.  The
+        cancelled call raises :class:`runtime.context.
+        QueryCancelledError` to ITS caller; this returns whether a
+        live query accepted the request."""
+        from ..runtime.context import cancel_query
+
+        return cancel_query(query_id)
+
     def task_definitions(
         self, plan_json: Union[str, list, SparkNode]
     ) -> List[List[bytes]]:

@@ -74,13 +74,13 @@ def _inject(spec: str) -> None:
 def test_spec_parse_format_roundtrip():
     rules = faults.parse_spec(
         "shuffle.fetch@2,task.compute@1@a0,shuffle.write@1@a0@slow250")
-    assert rules == [("shuffle.fetch", 2, None, None),
-                     ("task.compute", 1, 0, None),
-                     ("shuffle.write", 1, 0, 250)]
+    assert rules == [("shuffle.fetch", 2, None, None, False),
+                     ("task.compute", 1, 0, None, False),
+                     ("shuffle.write", 1, 0, 250, False)]
     assert faults.parse_spec(faults.format_spec(rules)) == rules
     # modifier order is free: slow before attempt parses the same
     assert faults.parse_spec("shuffle.write@1@slow250@a0") == \
-        [("shuffle.write", 1, 0, 250)]
+        [("shuffle.write", 1, 0, 250, False)]
     with pytest.raises(ValueError, match="unknown fault site"):
         faults.parse_spec("bogus.site@1")
     with pytest.raises(ValueError, match="bad fault spec"):
@@ -94,17 +94,18 @@ def test_spec_parse_format_roundtrip():
 def test_random_spec_deterministic():
     assert faults.random_spec(42) == faults.random_spec(42)
     assert faults.random_spec(42) != faults.random_spec(43)
-    for site, _, attempt, slow_ms in faults.parse_spec(faults.random_spec(42)):
+    for site, _, attempt, slow_ms, oom in faults.parse_spec(
+            faults.random_spec(42)):
         assert site in faults.SITES
         assert attempt == 0  # recoverable by construction
-        assert slow_ms is None
+        assert slow_ms is None and not oom
     # straggler entries: seeded latency, ungated (the one-shot hit
     # counter guarantees the delay is paid exactly once either way)
     spec = faults.random_spec(42, n_stragglers=2)
     assert spec == faults.random_spec(42, n_stragglers=2)
     slows = [r for r in faults.parse_spec(spec) if r[3] is not None]
-    assert slows and all(a is None for _, _, a, _ in slows)
-    assert all(250 <= ms <= 600 for _, _, _, ms in slows)
+    assert slows and all(a is None for _, _, a, _, _ in slows)
+    assert all(250 <= ms <= 600 for _, _, _, ms, _ in slows)
 
 
 def test_straggler_rule_sleeps_instead_of_raising():

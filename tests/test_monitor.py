@@ -232,7 +232,7 @@ def test_queries_endpoint_scheduler_run(data, armed_monitor):
     _, _, body = _get(srv.url, "/queries")
     snap = json.loads(body)
     q = next(q for q in snap["queries"] if q["query_id"] == "mon_q1")
-    assert q["status"] == "ok" and q["mode"] == "scheduler"
+    assert q["status"] == "done" and q["mode"] == "scheduler"
     assert q["attempts"].get("task_attempts", 0) >= 3
     kinds = {s["kind"] for s in q["stages"]}
     assert "map" in kinds and "result" in kinds

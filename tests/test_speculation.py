@@ -283,7 +283,7 @@ def test_speculation_events_reconcile_and_registry_rolls_back(tmp_path):
     # the winner's — per-partition live rows stay bounded by the source
     snap = monitor.snapshot()
     q = next(q for q in snap["queries"] if q["query_id"] == "spec_q")
-    assert q["status"] == "ok" and q["stages"]
+    assert q["status"] == "done" and q["stages"]
     map_st = next(st for st in q["stages"] if st["kind"] == "map")
     assert map_st["tasks_done"] == map_st["n_tasks"] == 3
     n_rows = len(data["l_quantity"])

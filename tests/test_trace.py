@@ -318,6 +318,11 @@ def _synthetic_events():
                             "batches": 1, "elapsed_ns": 5,
                             "progress_rows": 10,
                             "metrics": {"output_rows": 10}}),
+        ("query_cancel_requested", {"query_id": "q", "reason": "cancel"}),
+        ("query_cancelled", {"query_id": "q", "reason": "deadline",
+                             "stage_id": 1, "task": 0}),
+        ("oom_recovery", {"label": "fused_stage", "action": "downshift",
+                          "rows": 4096, "depth": 1}),
         ("fault_injected", {"site": "shuffle.fetch", "hit": 2,
                             "attempt": 0, "detail": "shuffle_0"}),
         ("straggler_injected", {"site": "shuffle.write", "hit": 1,
