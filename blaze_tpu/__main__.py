@@ -665,6 +665,275 @@ def _run_cancel_storm(suite, names, scans, build_query, n_parts,
     return rc
 
 
+def _run_service(suite: str, names, scale: float, n_parts: int,
+                 pools: str = "") -> int:
+    """``--service``: run the multi-tenant query service
+    (runtime/service.py) over the loaded suite.
+
+    With query names, every listed query is SUBMITTED concurrently
+    (round-robin across the ``--pools`` list, sessions cycling) and
+    the per-query outcomes print as they drain — admission sheds
+    surface as typed rejections, not hangs.  Bare ``--service`` serves
+    until interrupted: the monitor server's ``POST /service/submit``
+    endpoint accepts ``{"query": ..., "pool": ..., "session": ...}``
+    submissions against the loaded suite and answers 429 when shed."""
+    from . import conf
+    from .runtime import service
+    from .runtime.context import QueryCancelledError
+
+    submit_names = list(names) if names else []
+    build_query, all_names, scans = _load_suite(
+        suite, names or ["all"], scale, n_parts)
+    if build_query is None:
+        return all_names
+    pool_names = ["default"]
+    if pools:
+        pool_names = []
+        for ent in pools.split(","):
+            pname, _, w = ent.strip().partition(":")
+            if not pname:
+                continue
+            pool_names.append(pname)
+            if w:
+                conf.set_conf(
+                    f"spark.blaze.service.pool.{pname}.weight", float(w))
+    svc = service.QueryService().start()
+    service.set_http_builders(
+        {n: (lambda n=n: build_query(n, scans, n_parts))
+         for n in all_names})
+    rc = 0
+    try:
+        if not submit_names:
+            print(f"# service: {len(all_names)} queries loaded, "
+                  f"POST /service/submit to run them "
+                  f"(pools: {', '.join(pool_names)})")
+            rc = _serve_forever()
+        else:
+            handles = []
+            for i, name in enumerate(submit_names):
+                pool = pool_names[i % len(pool_names)]
+                try:
+                    handles.append(svc.submit(
+                        name,
+                        build=lambda n=name: build_query(n, scans, n_parts),
+                        pool=pool, session=f"cli-{i % 4}"))
+                except service.QueryRejectedError as e:
+                    print(f"service {name}: REJECTED ({e.reason})",
+                          file=sys.stderr)
+                    rc = 1
+            for h in handles:
+                t0 = time.perf_counter()
+                try:
+                    rows = sum(b.num_rows for b in h.result())
+                    print(f"service {h.query_id} [pool={h.pool}]: "
+                          f"{rows} rows "
+                          f"in {time.perf_counter() - t0:.2f}s")
+                except QueryCancelledError as e:
+                    print(f"service {h.query_id}: CANCELLED ({e.reason})",
+                          file=sys.stderr)
+                    rc = 1
+                except Exception as e:  # noqa: BLE001 — per query
+                    print(f"service {h.query_id}: FAILED "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+                    rc = 1
+            st = svc.stats()
+            shares = {n: round(p["charged_ns"] / 1e9, 2)
+                      for n, p in st["pools"].items()}
+            print(f"# service: {st['counters']}  lease-seconds {shares}")
+    finally:
+        svc.shutdown()
+        leaked = service.service_threads()
+        if leaked:
+            # the leak gate must land in the exit code, so NO return
+            # inside the try above (a `return` there would capture rc
+            # before this assignment)
+            print("# service: THREAD LEAK after shutdown: "
+                  + ", ".join(t.name for t in leaked), file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def _run_admission_storm(suite, names, scans, build_query, n_parts,
+                         seed) -> int:
+    """Admission-storm chaos arm: a BURST of concurrent submissions
+    past ``maxQueued`` — seeded stragglers keeping queries in flight,
+    one mid-flight cancel at a seeded moment — asserting the admission
+    contract end to end: every submission ends accepted-and-terminal
+    or typed-rejected (never a hang), completed results match the
+    fault-free baseline, no pool is starved of lease time, and nothing
+    leaks (``blaze-*`` threads, spill files, ``.inprogress`` shuffle
+    temps).  Lockset + lock-order checkers are armed for the whole arm
+    — the service's new shared state runs under the PR 8 gates."""
+    import glob
+    import os
+    import random
+    import tempfile
+    import threading
+
+    from . import conf
+    from .analysis import locks as lock_verify
+    from .runtime import faults, lockset, monitor, service
+    from .runtime.context import QueryCancelledError, cancel_query
+
+    rng = random.Random(seed * 104729 + 7)
+    name = names[0]
+    knobs = (conf.SERVICE_MAX_CONCURRENT, conf.SERVICE_MAX_QUEUED,
+             conf.SERVICE_QUEUE_TIMEOUT_MS, conf.MONITOR_ENABLE)
+    prev = [k.get() for k in knobs]
+    pool_keys = ("spark.blaze.service.pool.storm_a.weight",
+                 "spark.blaze.service.pool.storm_b.weight")
+    prev_pools = [conf.get_conf(k) for k in pool_keys]
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    conf.VERIFY_LOCKSET.set(True)
+    lockset.refresh()
+    lockset.reset()
+    problems = []
+    svc = None
+    spill_glob = os.path.join(tempfile.gettempdir(), "blaze_spill_*")
+    shuffle_glob = os.path.join(tempfile.gettempdir(), "blaze_shuffle_*")
+    spills_before = set(glob.glob(spill_glob))
+    roots_before = set(glob.glob(shuffle_glob))
+    n_subs = 8
+    n_rejected = 0
+    cancelled_id = None
+    try:
+        baseline = _rows_via_scheduler(build_query(name, scans, n_parts))
+        conf.SERVICE_MAX_CONCURRENT.set(2)
+        conf.SERVICE_MAX_QUEUED.set(2)
+        conf.SERVICE_QUEUE_TIMEOUT_MS.set(0)
+        conf.MONITOR_ENABLE.set(True)
+        conf.set_conf("spark.blaze.service.pool.storm_a.weight", 3.0)
+        conf.set_conf("spark.blaze.service.pool.storm_b.weight", 1.0)
+        monitor.reset()
+        slow = rng.randrange(120, 350)
+        conf.FAULTS_SPEC.set(
+            f"task.compute@2@slow{slow},task.compute@6@slow{slow}")
+        faults.reset()
+        svc = service.QueryService().start()
+        outcomes = [None] * n_subs          # "rejected" | handle
+        accepted = []
+        accepted_lock = threading.Lock()
+
+        def submitter(i: int) -> None:
+            pool = "storm_a" if i % 2 == 0 else "storm_b"
+            try:
+                h = svc.submit(f"storm{i}", pool=pool, session=f"s{i % 4}",
+                               build=lambda: build_query(name, scans,
+                                                         n_parts))
+            except service.QueryRejectedError:
+                outcomes[i] = "rejected"
+                return
+            outcomes[i] = h
+            with accepted_lock:
+                accepted.append(h)
+
+        threads = [threading.Thread(target=submitter, args=(i,),
+                                    name=f"blaze-storm-submit-{i}",
+                                    daemon=True) for i in range(n_subs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        # one mid-flight cancel at a seeded moment, at whatever stage
+        # frontier the victim has reached
+        time.sleep(rng.uniform(0.01, 0.15))
+        with accepted_lock:
+            victims = list(accepted)
+        cancelled_id = None
+        if victims:
+            victim = victims[rng.randrange(len(victims))]
+            if cancel_query(victim.exec_id):
+                cancelled_id = victim.exec_id
+        # drain EVERY accepted handle: terminal or bust (the no-hang
+        # contract; 120s is far past any straggler schedule)
+        for h in victims:
+            rows = None
+            try:
+                rows = sum(b.num_rows for b in h.result(timeout=120))
+            except QueryCancelledError:
+                pass
+            except service.QueryRejectedError:
+                pass
+            except Exception as e:  # noqa: BLE001 — judged below
+                problems.append(f"{h.exec_id}: unexpected terminal "
+                                f"{type(e).__name__}: {e}")
+            if h.status not in service.TERMINAL_STATES:
+                problems.append(f"{h.exec_id}: non-terminal status "
+                                f"{h.status!r} after drain")
+            if h.status == "done" and rows != len(baseline):
+                problems.append(
+                    f"{h.exec_id}: {rows} rows != baseline {len(baseline)}")
+        n_rejected = sum(1 for o in outcomes if o == "rejected")
+        if any(o is None for o in outcomes):
+            problems.append("a submitter thread never resolved")
+        if n_rejected == 0:
+            problems.append(
+                "no submission was shed past maxQueued — the storm "
+                "never exercised admission control")
+        if cancelled_id is not None:
+            victim = next(h for h in victims if h.exec_id == cancelled_id)
+            if victim.status not in ("cancelled", "done"):
+                problems.append(
+                    f"cancelled query ended {victim.status!r} (expected "
+                    f"cancelled, or done when it won the race)")
+        # fairness: both pools completed work and neither was starved
+        # of lease time (the tolerance-band fairness assertion lives in
+        # the soak test, where the workload is controlled)
+        shares = svc.gate.shares()
+        for pname in ("storm_a", "storm_b"):
+            p = shares.get(pname)
+            if any(h.pool == pname and h.status == "done" for h in victims) \
+                    and (p is None or p["charged_ns"] <= 0):
+                problems.append(f"pool {pname} completed queries but was "
+                                f"never granted lease time")
+        races = lockset.reported()
+        if races:
+            problems.append("lockset violation(s): " + "; ".join(races))
+    except Exception as e:  # noqa: BLE001 — the arm must report, not die
+        problems.append(f"storm arm crashed: {type(e).__name__}: {e}")
+    finally:
+        if svc is not None:
+            svc.shutdown()
+        conf.FAULTS_SPEC.set("")
+        faults.reset()
+        for k, v in zip(knobs, prev):
+            k.set(v)
+        # the storm pool weights too (a stored None reads back as the
+        # defaults through the `or` guards) — the knob-leak class an
+        # earlier review round fixed in _run_chaos
+        for k, v in zip(pool_keys, prev_pools):
+            conf.set_conf(k, v)
+        monitor.reset()
+        conf.VERIFY_LOCKS.set(False)
+        lock_verify.refresh()
+        conf.VERIFY_LOCKSET.set(False)
+        lockset.refresh()
+    leaked = [t.name for t in service.service_threads()] \
+        + [t.name for t in _live_attempt_threads()]
+    if leaked:
+        problems.append("leaked threads: " + ", ".join(leaked))
+    leaked_spills = sorted(set(glob.glob(spill_glob)) - spills_before)
+    if leaked_spills:
+        problems.append(f"leaked spill files: {leaked_spills[:4]}")
+    orphans = []
+    for root in sorted(set(glob.glob(shuffle_glob)) - roots_before):
+        if os.path.isdir(root):
+            orphans += [os.path.join(root, f) for f in os.listdir(root)
+                        if ".inprogress" in f]
+    if orphans:
+        problems.append(f"orphaned shuffle temps: {orphans[:4]}")
+    if problems:
+        print(f"admission-storm {name} (seed {seed}): "
+              + "; ".join(problems), file=sys.stderr)
+        return 1
+    print(f"admission-storm {name} (seed {seed}): OK "
+          f"({n_subs - n_rejected} accepted+terminal / {n_rejected} "
+          f"typed-rejected"
+          + (", 1 mid-flight cancel)" if cancelled_id else ")"))
+    return 0
+
+
 def _live_attempt_threads():
     """Attempt-runner threads still alive after a run — the speculation
     leak gate (a cancelled loser must exit cooperatively)."""
@@ -797,9 +1066,13 @@ def main(argv=None) -> int:
                          "SECOND injects a mid-query device OOM the "
                          "degradation ladder must absorb, and every seed "
                          "ends with a cancel-storm arm (seeded random "
-                         "cancel at a random stage frontier); nonzero exit "
-                         "on any mismatch, unreconciled event log, leaked "
-                         "thread, or orphaned temp/spill file")
+                         "cancel at a random stage frontier) plus an "
+                         "admission-storm arm (a concurrent submission "
+                         "burst past the service queue bound with seeded "
+                         "stragglers and one mid-flight cancel); nonzero "
+                         "exit on any mismatch, unreconciled event log, "
+                         "hung or untyped submission, leaked thread, or "
+                         "orphaned temp/spill file")
     ap.add_argument("--trace", action="store_true",
                     help="arm the structured event log "
                          "(spark.blaze.trace.enabled) for this run; each "
@@ -820,6 +1093,20 @@ def main(argv=None) -> int:
                          "with --lint: write the findings as one JSON "
                          "document (rule id, path, line, symbol, waived "
                          "flag + summary) so CI can diff lint runs")
+    ap.add_argument("--service", action="store_true",
+                    help="run the multi-tenant query service "
+                         "(runtime/service.py: admission control, "
+                         "fair-share pools, per-pool quotas, "
+                         "backpressure, supervision) over the loaded "
+                         "suite; with query names they are submitted "
+                         "concurrently round-robin across --pools, bare "
+                         "--service serves POST /service/submit until "
+                         "interrupted (429 on shed)")
+    ap.add_argument("--pools", default="",
+                    help="with --service: comma list of pool[:weight] "
+                         "fair-share pools submissions round-robin "
+                         "across (default one 'default' pool), e.g. "
+                         "'etl:3,adhoc:1'")
     ap.add_argument("--serve", action="store_true",
                     help="run the live monitoring HTTP service "
                          "(/metrics Prometheus text, /queries JSON); bare "
@@ -895,7 +1182,7 @@ def main(argv=None) -> int:
         if args.event_log_dir:
             conf.EVENT_LOG_DIR.set(args.event_log_dir)
         trace.reset()
-    monitor_armed = args.serve or args.monitor
+    monitor_armed = args.serve or args.monitor or args.service
     if monitor_armed:
         from . import conf
         from .runtime import monitor
@@ -916,6 +1203,15 @@ def main(argv=None) -> int:
     queries = args.queries or (
         ["q6"] if args.chaos else ["q1", "q6"] if args.warmup else None
     )
+    if args.service:
+        try:
+            rc = _run_service(args.suite, args.queries, args.scale,
+                              args.parts, pools=args.pools)
+        finally:
+            # the monitor server hosts the service endpoints: its
+            # shutdown/leak gate folds into the exit code here too
+            leak_rc = _shutdown_monitor_checked()
+        return rc or leak_rc
     if not queries:
         if args.serve:
             return _serve_forever()
@@ -957,6 +1253,9 @@ def main(argv=None) -> int:
                 rc = _run_cancel_storm(args.suite, qnames, scans, bq,
                                        args.parts,
                                        args.chaos_seed + k) or rc
+                rc = _run_admission_storm(args.suite, qnames, scans, bq,
+                                          args.parts,
+                                          args.chaos_seed + k) or rc
         elif args.chaos:
             rc = _run_chaos(args.suite, queries, args.scale, args.parts,
                             args.chaos_seed, args.chaos_faults)

@@ -58,6 +58,13 @@ from typing import Dict, List, Optional, Tuple
 #:   under — the lint rule in analysis/lint.py pins that.
 HIERARCHY: Tuple[str, ...] = (
     "monitor.server",        # server lifecycle (ensure/shutdown)
+    "service.state",         # query-service admission queue + registry
+                             # (held for queue/dict mutation only;
+                             # query spans, cancels, and emission all
+                             # happen after release)
+    "service.gate",          # fair-share device-lease DRR state (held
+                             # for grant bookkeeping; waiters block on
+                             # their Events OUTSIDE it)
     "context.cancel",        # query CancelScope registry + fan-out set
                              # (held only for set/dict mutation; the
                              # trace emission a cancel produces happens

@@ -192,6 +192,34 @@ EVENT_LOG_MAX_BYTES = ConfEntry("spark.blaze.eventLog.maxBytes", 0, int)
 # report flags it '~').
 TRACE_SAMPLE_RATE = ConfEntry("spark.blaze.trace.sampleRate", 1, int)
 
+# Multi-tenant query service (runtime/service.py): admission control,
+# fair-share scheduling, per-pool quotas, backpressure, supervision.
+# Queries RUNNING concurrently once admitted (each interleaves its
+# stages through the one-device-lease fair-share gate below).
+SERVICE_MAX_CONCURRENT = ConfEntry("spark.blaze.service.maxConcurrent", 2, int)
+# Submissions waiting for a run slot beyond the running set; PAST this
+# bound a submission is SHED with a typed retryable QueryRejectedError
+# (HTTP 429 on the service endpoint) instead of accepted-and-wedged.
+SERVICE_MAX_QUEUED = ConfEntry("spark.blaze.service.maxQueued", 16, int)
+# A QUEUED submission still waiting after this long is shed with
+# QueryRejectedError(reason="queue_timeout") — bounded queueing delay
+# instead of unbounded head-of-line blocking.  0 = wait forever.
+SERVICE_QUEUE_TIMEOUT_MS = ConfEntry("spark.blaze.service.queueTimeoutMs", 0, int)
+# Supervisor wedge reaping: a RUNNING service query whose monitor
+# heartbeat age exceeds this is cancelled (reason="wedged") — the
+# query-level analogue of spark.blaze.task.wedgeMs, read from the live
+# registry's heartbeat-age signal (needs the monitor armed).  0 = off.
+SERVICE_WEDGE_MS = ConfEntry("spark.blaze.service.wedgeMs", 0, int)
+# Bounded result handoff between a service query's worker (producer)
+# and the submitter consuming QueryHandle.batches(): a slow consumer
+# BLOCKS the producer (which releases its device-lease turn first)
+# instead of ballooning host buffers — the exchange backpressure.
+SERVICE_RESULT_QUEUE_DEPTH = ConfEntry("spark.blaze.service.resultQueueDepth", 8, int)
+# Per-pool knobs ride the registered dynamic prefix
+# spark.blaze.service.pool.<name>.weight (fair-share weight, default 1)
+# and spark.blaze.service.pool.<name>.quota (host-staging bytes budget,
+# 0/unset = unlimited) — read via get_conf, like spark.blaze.enable.*.
+
 # Live query monitoring (runtime/monitor.py).  OFF (default): no HTTP
 # server, no background thread, and the heartbeat path is a structural
 # no-op exactly like spark.blaze.trace.enabled=false.  ON: an in-process
@@ -209,6 +237,21 @@ MONITOR_PORT = ConfEntry("spark.blaze.monitor.port", 4048, int)
 # event log (when tracing is armed) and the live registry (when the
 # monitor is armed).  Smaller = fresher /queries, more events.
 MONITOR_HEARTBEAT_MS = ConfEntry("spark.blaze.monitor.heartbeatMs", 1000, int)
+# Historical retention beyond the in-memory last-64 ring: when set,
+# every FINISHED query's registry summary is appended to a JSONL
+# history file under this directory (size-capped rollover like the
+# event log), and /queries?all=1 serves the merged history.  Empty =
+# in-memory ring only (the pre-existing behavior).
+MONITOR_HISTORY_DIR = ConfEntry("spark.blaze.monitor.historyDir", "", str)
+# Size cap (bytes) per history file before it rolls into a numbered
+# .segN segment (same rollover contract as spark.blaze.eventLog.maxBytes).
+MONITOR_HISTORY_MAX_BYTES = ConfEntry("spark.blaze.monitor.historyMaxBytes", 4 << 20, int)
+# Push exporter: "host:port" arms a best-effort statsd UDP push loop
+# (gauge lines derived from the same rendering as /metrics, pushed
+# every heartbeat interval) so ops without a Prometheus scraper still
+# get the numbers.  Empty (default) = structural no-op: no socket, no
+# thread.
+MONITOR_STATSD = ConfEntry("spark.blaze.monitor.statsd", "", str)
 
 # Whole-stage program fusion (ops/fusion.py): collapse traceable
 # operator chains / agg pre-filters / final-agg sorts into single XLA

@@ -23,7 +23,7 @@ Opt-in via spark.blaze.enable.adaptiveJoin (default off)."""
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import conf
 from ..ops import ExecNode
@@ -65,7 +65,7 @@ def apply_adaptive_joins(
     manager: LocalShuffleManager,
     n_maps: Dict[int, int],
     bcast_blocks: Dict[int, list],
-    next_bid: List[int],
+    alloc_bid: Callable[[], int],
 ) -> List[dict]:
     """Rewrite qualifying joins among ``plan``'s DESCENDANTS (parents
     mutate in place — pass a wrapper to make a root join swappable);
@@ -142,8 +142,7 @@ def apply_adaptive_joins(
             measured, key=lambda m: m[0])
         if isinstance(j, SortMergeJoinExec):
             other = _drop_smj_sort(other, okeys)
-        bid = next_bid[0]
-        next_bid[0] += 1
+        bid = alloc_bid()
         bcast_blocks[bid] = full_blocks(sid)
         build = IpcReaderExec(leaf.schema, f"broadcast_{bid}", 1)
         out = BroadcastJoinExec(
@@ -169,7 +168,7 @@ def apply_adaptive_joins(
     return swaps
 
 
-def maybe_rewrite_stage(stage, manager, n_maps, bcast_blocks, next_bid):
+def maybe_rewrite_stage(stage, manager, n_maps, bcast_blocks, alloc_bid):
     """run_stages hook: apply the rewrite to one stage's plan when the
     flag is on; returns the swap reports."""
     if not bool(conf.ADAPTIVE_JOIN_ENABLE.get()):
@@ -177,6 +176,6 @@ def maybe_rewrite_stage(stage, manager, n_maps, bcast_blocks, next_bid):
     from .scheduler import _StageRoot
 
     root = _StageRoot(stage.plan)
-    swaps = apply_adaptive_joins(root, manager, n_maps, bcast_blocks, next_bid)
+    swaps = apply_adaptive_joins(root, manager, n_maps, bcast_blocks, alloc_bid)
     stage.plan = root.children[0]
     return swaps

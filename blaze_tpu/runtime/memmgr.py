@@ -16,15 +16,63 @@ interface with framed compressed blocks.
 
 from __future__ import annotations
 
+import contextvars
 import io
 import os
 import tempfile
 import threading
 import zlib
-from typing import Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import conf
 from . import lockset
+
+#: per-query OWNER attribution for consumers (the multi-tenant service,
+#: runtime/service.py): consumers registered while an owner scope is
+#: active are stamped with its tag, so per-pool quota enforcement can
+#: meter and spill ONE query's host-staging state without touching a
+#: neighbor's.  A ContextVar so attempt threads (spawned under
+#: contextvars.copy_context) inherit their query's tag.
+_OWNER: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("blaze_mem_owner", default=None)
+
+#: quota hook installed by the active query service (None = disarmed,
+#: one module-global read per accounting update).  Called with the
+#: CONSUMER's stamped owner tag, not the calling thread's ContextVar —
+#: accounting can run on the async shuffle stager or a spilling
+#: neighbor's thread, where the ambient owner is absent or WRONG.
+_QUOTA_HOOK: Optional[Callable[[Tuple[str, str]], None]] = None
+
+LOCK_FREE = {
+    "_QUOTA_HOOK": "single reference swapped by the service's "
+                   "install/uninstall at quiescent points; readers "
+                   "snapshot it into a local before calling",
+}
+
+
+def set_owner_tag(tag: Optional[Tuple[str, str]]):
+    """Set the (query_key, pool) owner tag consumers registered on this
+    thread/context will carry; returns the token for ``reset_owner``."""
+    return _OWNER.set(tag)
+
+
+def reset_owner(token) -> None:
+    _OWNER.reset(token)
+
+
+def current_owner() -> Optional[Tuple[str, str]]:
+    return _OWNER.get()
+
+
+def set_quota_hook(fn: Optional[Callable[[Tuple[str, str]], None]]) -> None:
+    """Install (or clear, with None) the per-query quota check the
+    active service runs after every accounting update whose consumer
+    carries an owner tag (passed as the argument).  The hook runs on
+    the updating thread, holding NO memmgr lock — it may take the
+    manager lock itself (usage read, owner-filtered spill) and cancel
+    the owning query's scope."""
+    global _QUOTA_HOOK
+    _QUOTA_HOOK = fn
 
 
 class Spill:
@@ -140,10 +188,12 @@ class MemConsumer:
     #: every consumer's usage from OTHER tasks' threads when picking
     #: spill victims.  The unmanaged branches (manager None = consumer
     #: not registered, thread-private) are waived in lint_waivers.json.
-    GUARDED_BY = {"_mem_used": "memmgr.manager"}
+    GUARDED_BY = {"_mem_used": "memmgr.manager",
+                  "_owner": "memmgr.manager"}
 
     def __init__(self):
         self._mem_used = 0
+        self._owner: Optional[Tuple[str, str]] = None
         self._manager: Optional["MemManager"] = None
 
     @property
@@ -227,9 +277,11 @@ class MemManager:
         return cls.init()
 
     def register_consumer(self, consumer: MemConsumer) -> None:
+        owner = _OWNER.get()  # read before the lock: one ContextVar get
         with self._lock:
             lockset.check(self, "_consumers")
             consumer._manager = self
+            consumer._owner = owner
             self._consumers.append(consumer)
 
     def unregister_consumer(self, consumer: MemConsumer) -> None:
@@ -252,6 +304,26 @@ class MemManager:
         with self._lock:
             return self._total_used()
 
+    def used_by_owner(self, owner: Tuple[str, str]) -> int:
+        """Tracked usage attributed to ONE owner tag (a service query)
+        — what per-pool quota enforcement meters."""
+        with self._lock:
+            lockset.check(self, "_consumers")
+            return sum(c._mem_used for c in self._consumers
+                       if c._owner == owner)
+
+    def used_by_pools(self) -> Dict[str, int]:
+        """Tracked usage grouped by owner POOL (the /metrics per-pool
+        memory gauges; untagged consumers are omitted)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            lockset.check(self, "_consumers")
+            for c in self._consumers:
+                if c._owner is not None:
+                    pool = c._owner[1]
+                    out[pool] = out.get(pool, 0) + c._mem_used
+        return out
+
     def _update(self, consumer: MemConsumer, new_used: int) -> None:
         from . import trace
 
@@ -259,6 +331,7 @@ class MemManager:
             lockset.check(self, "_consumers")
             lockset.check(consumer, "_mem_used")
             consumer._mem_used = new_used
+            owner = consumer._owner
             emit_peak = 0
             # ratchet only while tracing is armed (an untraced run
             # advancing the peak would mute the gauge for a later
@@ -278,6 +351,13 @@ class MemManager:
             # outside the lock: trace.emit does file IO
             trace.emit("mem_watermark", used=emit_peak, total=self.total)
         self._maybe_spill()
+        # per-pool quota enforcement (runtime/service.py): runs on the
+        # updating thread, holding no memmgr lock, only for accounting
+        # updates an owner tag attributes to a service query.  Disarmed
+        # (no service) this is one module-global read.
+        hook = _QUOTA_HOOK
+        if hook is not None and owner is not None:
+            hook(owner)
 
     def _maybe_spill(self) -> None:
         with self._lock:
@@ -294,15 +374,18 @@ class MemManager:
                 key=lambda cu: -cu[1])
         self._drain_victims(victims, over)
 
-    def force_spill(self) -> int:
+    def force_spill(self, owner: Optional[Tuple[str, str]] = None) -> int:
         """Spill EVERY tracked consumer regardless of watermark —
         rung 1 of the device-OOM degradation ladder (runtime/oom.py):
         a ``RESOURCE_EXHAUSTED`` program is about to re-run, and the
         host-staging state consumers hold is the shrinkable half of
-        what the next transfer ships.  Returns bytes freed."""
+        what the next transfer ships.  With ``owner``, only THAT
+        query's consumers spill (per-pool quota enforcement must never
+        shed a neighbor's state).  Returns bytes freed."""
         with self._lock:
             victims = sorted(
-                ((c, c._mem_used) for c in self._consumers),
+                ((c, c._mem_used) for c in self._consumers
+                 if owner is None or c._owner == owner),
                 key=lambda cu: -cu[1])
         return self._drain_victims(victims, float("inf"))
 

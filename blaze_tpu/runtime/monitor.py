@@ -42,6 +42,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import json
+import os
 import re
 import threading
 import time
@@ -68,6 +69,9 @@ GUARDED_REFS = ("_QUERIES",)
 _loaded = False
 _armed = False
 _hb_ns = 1_000_000_000
+_history_dir = ""            # conf spark.blaze.monitor.historyDir
+_history_max = 0             # conf spark.blaze.monitor.historyMaxBytes
+_statsd = ""                 # conf spark.blaze.monitor.statsd host:port
 _updates = 0                 # introspection: registry writes since reset
 _seq = 0                     # unique registry keys for repeated query ids
 
@@ -92,10 +96,13 @@ SCHED_COUNTERS = ("task_attempts", "task_retries", "task_timeouts",
 
 
 def _load() -> None:
-    global _loaded, _armed, _hb_ns
+    global _loaded, _armed, _hb_ns, _history_dir, _history_max, _statsd
     with _lock:
         _armed = bool(conf.MONITOR_ENABLE.get())
         _hb_ns = max(1, int(conf.MONITOR_HEARTBEAT_MS.get())) * 1_000_000
+        _history_dir = str(conf.MONITOR_HISTORY_DIR.get() or "")
+        _history_max = max(0, int(conf.MONITOR_HISTORY_MAX_BYTES.get()))
+        _statsd = str(conf.MONITOR_STATSD.get() or "")
         _loaded = True
 
 
@@ -188,11 +195,15 @@ def _terminal_status(exc: Optional[BaseException]) -> str:
 
 
 @contextlib.contextmanager
-def query(query_id: str, mode: str = "in-process") -> Iterator[Optional[str]]:
+def query(query_id: str, mode: str = "in-process",
+          pool: Optional[str] = None,
+          session: Optional[str] = None) -> Iterator[Optional[str]]:
     """Scope one monitored query in the live registry; yields the
     registry key (None when the monitor is disarmed).  Progress and
     heartbeat writes made while the scope is active (same thread /
-    context) attach to this query."""
+    context) attach to this query.  ``pool``/``session`` are the
+    multi-tenant service's fair-scheduler labels — surfaced in
+    ``/queries`` and the per-pool gauges."""
     if not enabled():
         yield None
         return
@@ -200,7 +211,11 @@ def query(query_id: str, mode: str = "in-process") -> Iterator[Optional[str]]:
     now = time.monotonic_ns()
     with _lock:
         _seq += 1
-        key = f"{query_id}#{_seq}"
+        # pid-qualified: the key also dedups the persisted history
+        # against the live ring in /queries?all=1, and a bare per-
+        # process sequence would collide with a PAST run's entry
+        # (every process restarts at #1)
+        key = f"{query_id}#{os.getpid()}-{_seq}"
         # evict the oldest FINISHED entries past the cap (running ones
         # are live state the /queries consumer is watching)
         done = [k for k, q in _QUERIES.items() if q["status"] != "running"]
@@ -208,6 +223,7 @@ def query(query_id: str, mode: str = "in-process") -> Iterator[Optional[str]]:
             _QUERIES.pop(done.pop(0), None)
         _QUERIES[key] = {
             "query_id": query_id, "mode": mode, "status": "running",
+            "pool": pool, "session": session,
             "started_at": time.time(), "t0": now, "t_end": None,
             "last_beat": now, "attempts": {}, "mem_peak": 0, "stages": {},
         }
@@ -221,30 +237,39 @@ def query(query_id: str, mode: str = "in-process") -> Iterator[Optional[str]]:
         raise
     finally:
         _CURRENT.reset(token)
+        summary = None
         with _lock:
             q = _QUERIES.get(key)
             if q is not None:
                 q["status"] = status
                 q["t_end"] = time.monotonic_ns()
                 _bump()
+                if _history_dir:
+                    summary = _render_query(key, q, q["t_end"])
+        if summary is not None:
+            # file IO strictly OUTSIDE the registry lock
+            _history_append(summary)
 
 
 @contextlib.contextmanager
 def query_span(query_id: str, mode: str = "in-process",
-               timeout_ms: Optional[int] = None) -> Iterator[Optional[str]]:
+               timeout_ms: Optional[int] = None,
+               pool: Optional[str] = None,
+               session: Optional[str] = None) -> Iterator[Optional[str]]:
     """Combined trace + monitor + cancellation query scope: the
     event-log span (``trace.query``), the per-query
     :class:`context.CancelScope` (cancellation + the
     ``spark.blaze.query.timeoutMs`` deadline), and the live-registry
     entry open/close together — the one scope every execution entry
-    point (CLI suite runner, ``session.execute``, the gateway) wraps a
+    point (CLI suite runner, ``session.execute``, the gateway, the
+    multi-tenant service with its ``pool``/``session`` labels) wraps a
     query in.  Yields the event-log path (None when tracing is
     disarmed)."""
     from .context import cancel_scope
 
     with trace.query(query_id) as log_path:
         with cancel_scope(query_id, timeout_ms=timeout_ms):
-            with query(query_id, mode=mode):
+            with query(query_id, mode=mode, pool=pool, session=session):
                 yield log_path
 
 
@@ -317,7 +342,8 @@ def stage_progress_update(stage_id: int, *, rows: int, bytes_: int,
 def task_beat(stage_id: int, partition: int, attempt: int, *, rows: int,
               batches: int, metrics: Optional[Dict[str, int]] = None,
               progress_rows: int = 0,
-              task_id: Optional[str] = None) -> None:
+              task_id: Optional[str] = None,
+              device_ns: int = 0, dispatch_ns: int = 0) -> None:
     """Land one task heartbeat (from ``run_task``'s instrumented
     stream) in the registry: per-task rows plus freshness, so a stage
     whose tasks are alive-but-slow is distinguishable from a wedged
@@ -339,6 +365,10 @@ def task_beat(stage_id: int, partition: int, attempt: int, *, rows: int,
         st["tasks"][str(partition)] = {
             "attempt": attempt, "rows": rows, "batches": batches,
             "progress_rows": progress_rows, "task_id": task_id,
+            # the PR 3 kernel-sink split for THIS task's attempt so
+            # far (device compute vs dispatch overhead) — populated
+            # only while tracing is armed (the sinks exist then)
+            "device_ns": device_ns, "dispatch_ns": dispatch_ns,
             "last_beat": now, "metrics": dict(metrics or {}),
         }
         st["last_beat"] = now
@@ -395,66 +425,214 @@ def _mem_total() -> int:
     return mm.total if mm is not None else 0
 
 
-def snapshot() -> Dict[str, Any]:
+def _render_query(key: str, q: Dict[str, Any], now: int) -> Dict[str, Any]:
+    """One query entry rendered for /queries (caller holds _lock) —
+    also the summary shape the JSONL history persists, so
+    ``/queries?all=1`` serves past-the-ring queries identically."""
+    end = q["t_end"] or now
+    stages = []
+    for sid in sorted(q["stages"]):
+        st = q["stages"][sid]
+        s_end = st["t_end"] or now
+        # a map task yields nothing to the driver, so its live
+        # row count is the heartbeat's progress_rows (widest
+        # single plan node — the tree-summed output_rows would
+        # be inflated by the operator-chain depth)
+        task_rows = {
+            p: max(t["rows"], t.get("progress_rows", 0))
+            for p, t in st["tasks"].items()
+        }
+        stages.append({
+            "stage_id": sid,
+            "kind": st["kind"],
+            "status": st["status"],
+            "n_tasks": st["n_tasks"],
+            "tasks_done": st["tasks_done"],
+            "rows": st["rows"],
+            "bytes": st["bytes"],
+            "batches": st["batches"],
+            "task_rows": sum(task_rows.values()),
+            # per-task kernel split (PR 3 sinks, surfaced per beat):
+            # where a stage's wall went — device compute vs dispatch
+            "device_ns": sum(t.get("device_ns", 0)
+                             for t in st["tasks"].values()),
+            "dispatch_ns": sum(t.get("dispatch_ns", 0)
+                               for t in st["tasks"].values()),
+            "tasks": {p: {"attempt": t["attempt"],
+                          "task_id": t.get("task_id"),
+                          "rows": task_rows[p],
+                          "batches": t["batches"],
+                          "device_ns": t.get("device_ns", 0),
+                          "dispatch_ns": t.get("dispatch_ns", 0),
+                          "heartbeat_age_s": round(
+                              (now - t["last_beat"]) / 1e9, 3)}
+                      for p, t in st["tasks"].items()},
+            "counters": dict(st["counters"]),
+            "elapsed_s": round((s_end - st["t0"]) / 1e9, 3),
+            "heartbeat_age_s": round((now - st["last_beat"]) / 1e9, 3),
+        })
+    return {
+        "key": key,
+        "query_id": q["query_id"],
+        "mode": q["mode"],
+        "pool": q.get("pool"),
+        "session": q.get("session"),
+        "status": q["status"],
+        "started_at": q["started_at"],
+        "elapsed_s": round((end - q["t0"]) / 1e9, 3),
+        "heartbeat_age_s": round((now - q["last_beat"]) / 1e9, 3),
+        "attempts": dict(q["attempts"]),
+        "mem_peak_bytes": q["mem_peak"],
+        "stages": stages,
+    }
+
+
+def snapshot(include_history: bool = False) -> Dict[str, Any]:
     """The /queries JSON document: every registered query with its
     per-stage live state.  Times are seconds; ``heartbeat_age_s`` is
     the wedge detector (a running stage whose age keeps growing is
-    stuck, one whose rows keep moving is just slow)."""
+    stuck, one whose rows keep moving is just slow).
+    ``include_history`` (``/queries?all=1``) prepends the persisted
+    JSONL history (``spark.blaze.monitor.historyDir``) — finished
+    queries beyond the in-memory last-64 ring, oldest first, deduped
+    against entries still in the ring."""
     now = time.monotonic_ns()
     queries: List[Dict[str, Any]] = []
     with _lock:
         lockset.check(_REG, "_QUERIES")
-        for q in _QUERIES.values():
-            end = q["t_end"] or now
-            stages = []
-            for sid in sorted(q["stages"]):
-                st = q["stages"][sid]
-                s_end = st["t_end"] or now
-                # a map task yields nothing to the driver, so its live
-                # row count is the heartbeat's progress_rows (widest
-                # single plan node — the tree-summed output_rows would
-                # be inflated by the operator-chain depth)
-                task_rows = {
-                    p: max(t["rows"], t.get("progress_rows", 0))
-                    for p, t in st["tasks"].items()
-                }
-                stages.append({
-                    "stage_id": sid,
-                    "kind": st["kind"],
-                    "status": st["status"],
-                    "n_tasks": st["n_tasks"],
-                    "tasks_done": st["tasks_done"],
-                    "rows": st["rows"],
-                    "bytes": st["bytes"],
-                    "batches": st["batches"],
-                    "task_rows": sum(task_rows.values()),
-                    "tasks": {p: {"attempt": t["attempt"],
-                                  "task_id": t.get("task_id"),
-                                  "rows": task_rows[p],
-                                  "batches": t["batches"],
-                                  "heartbeat_age_s": round(
-                                      (now - t["last_beat"]) / 1e9, 3)}
-                              for p, t in st["tasks"].items()},
-                    "counters": dict(st["counters"]),
-                    "elapsed_s": round((s_end - st["t0"]) / 1e9, 3),
-                    "heartbeat_age_s": round((now - st["last_beat"]) / 1e9, 3),
-                })
-            queries.append({
-                "query_id": q["query_id"],
-                "mode": q["mode"],
-                "status": q["status"],
-                "started_at": q["started_at"],
-                "elapsed_s": round((end - q["t0"]) / 1e9, 3),
-                "heartbeat_age_s": round((now - q["last_beat"]) / 1e9, 3),
-                "attempts": dict(q["attempts"]),
-                "mem_peak_bytes": q["mem_peak"],
-                "stages": stages,
-            })
-    return {
+        live_keys = set(_QUERIES)
+        for key, q in _QUERIES.items():
+            queries.append(_render_query(key, q, now))
+    if include_history:
+        hist = [h for h in read_history() if h.get("key") not in live_keys]
+        queries = hist + queries
+    doc = {
         "ts": time.time(),
         "queries": queries,
         "memory": {"used": _mem_used(), "total": _mem_total()},
     }
+    svc = _service_stats()
+    if svc is not None:
+        doc["service"] = svc
+    return doc
+
+
+def _service_stats() -> Optional[Dict[str, Any]]:
+    """The active query service's admission/pool stats (None when no
+    service is running) — merged into /queries and /metrics."""
+    from . import service as service_mod
+
+    svc = service_mod.active_service()
+    return svc.stats() if svc is not None else None
+
+
+def query_alive() -> None:
+    """Liveness-only beat for the CURRENT query (no stage/task data):
+    waits that are healthy by construction — blocking in the
+    fair-share gate for a DRR turn, a paused-lease backpressure wait
+    on a slow consumer — refresh the registry heartbeat through this,
+    so the supervisor's wedge reaper never cancels a query for doing
+    exactly what fair-share scheduling or backpressure intends."""
+    if not enabled():
+        return
+    now = time.monotonic_ns()
+    with _lock:
+        q = _current_entry()
+        if q is not None:
+            q["last_beat"] = now
+            _bump()
+
+
+def heartbeat_ages() -> Dict[str, float]:
+    """Heartbeat age (seconds) per RUNNING query id — the service
+    supervisor's wedge-reaping signal."""
+    now = time.monotonic_ns()
+    with _lock:
+        lockset.check(_REG, "_QUERIES")
+        return {q["query_id"]: (now - q["last_beat"]) / 1e9
+                for q in _QUERIES.values() if q["status"] == "running"}
+
+
+# ----------------------------------------------------- history (JSONL)
+
+def history_path() -> Optional[str]:
+    """The JSONL file THIS process appends finished-query summaries to
+    (None when spark.blaze.monitor.historyDir is unset)."""
+    if not _loaded:
+        _load()
+    if not _history_dir:
+        return None
+    return os.path.join(_history_dir, f"history-{os.getpid()}.jsonl")
+
+
+def _history_append(summary: Dict[str, Any]) -> None:
+    """Append one finished-query summary, with the same size-capped
+    ``.segN`` rollover contract as the event log — best-effort: the
+    history must never take down the workload it records."""
+    path = history_path()
+    if path is None:
+        return
+    try:
+        os.makedirs(_history_dir, exist_ok=True)
+        line = json.dumps(summary, default=str)
+        with open(path, "a") as f:
+            f.write(line + "\n")
+            size = f.tell()
+        if _history_max > 0 and size >= _history_max:
+            k = 1
+            while os.path.exists(f"{path}.seg{k}"):
+                k += 1
+            os.replace(path, f"{path}.seg{k}")
+    except OSError:
+        pass
+
+
+def read_history() -> List[Dict[str, Any]]:
+    """Every persisted summary in the history dir (all processes'
+    files, rotated segments first), oldest first per file."""
+    import glob
+
+    if not _loaded:
+        _load()
+    if not _history_dir or not os.path.isdir(_history_dir):
+        return []
+    out: List[Dict[str, Any]] = []
+    def seg_no(path: str) -> int:
+        try:
+            return int(path.rsplit(".seg", 1)[1])
+        except (IndexError, ValueError):
+            return 0
+
+    bases = sorted(glob.glob(os.path.join(_history_dir, "history-*.jsonl")))
+    seen = set(bases)
+    segs = sorted(glob.glob(os.path.join(_history_dir,
+                                         "history-*.jsonl.seg*")),
+                  key=lambda p: (p.rsplit(".seg", 1)[0], seg_no(p)))
+    for base in bases:
+        ordered = [s for s in segs if s.startswith(base + ".seg")] + [base]
+        for path in ordered:
+            seen.add(path)
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            out.append(json.loads(line))
+                        except ValueError:
+                            continue
+            except OSError:
+                continue
+    # orphan segments whose base already rolled away entirely
+    for path in segs:
+        if path not in seen:
+            try:
+                with open(path) as f:
+                    out.extend(json.loads(ln) for ln in f if ln.strip())
+            except (OSError, ValueError):
+                continue
+    return out
 
 
 # ----------------------------------------------------- task heartbeats
@@ -937,6 +1115,41 @@ def render_prometheus() -> str:
                     doc.add(f"blaze_query_stage_{k}", v, sl, mtype="gauge")
     doc.add("blaze_mem_used_bytes", snap["memory"]["used"], mtype="gauge")
     doc.add("blaze_mem_total_bytes", snap["memory"]["total"], mtype="gauge")
+    # multi-tenant service (runtime/service.py): admission counters +
+    # per-pool gauges, so a dashboard sees shedding and fair-share
+    # drift without scraping /queries
+    svc = snap.get("service")
+    if svc:
+        # depth gauges named apart from the cumulative queries_*
+        # counters below — a duplicate bare family name would make
+        # Prometheus reject the whole scrape
+        doc.add("blaze_service_running", svc["running"], mtype="gauge")
+        doc.add("blaze_service_queued", svc["queued"], mtype="gauge")
+        for k, v in sorted(svc.get("counters", {}).items()):
+            doc.add(f"blaze_service_{k}", v)
+        from .memmgr import MemManager
+
+        mm = MemManager._global
+        pool_mem = mm.used_by_pools() if mm is not None else {}
+        for name, p in sorted(svc.get("pools", {}).items()):
+            pl = {"pool": name}
+            doc.add("blaze_service_pool_weight", p["weight"], pl,
+                    mtype="gauge")
+            doc.add("blaze_service_pool_running", p["running"], pl,
+                    mtype="gauge")
+            doc.add("blaze_service_pool_queued", p["queued"], pl,
+                    mtype="gauge")
+            doc.add("blaze_service_pool_waiting_turns", p["waiting"], pl,
+                    mtype="gauge")
+            doc.add("blaze_service_pool_lease_seconds",
+                    round(p["charged_ns"] / 1e9, 6), pl, mtype="counter")
+            doc.add("blaze_service_pool_contended_lease_seconds",
+                    round(p["contended_ns"] / 1e9, 6), pl, mtype="counter")
+            if p.get("quota"):
+                doc.add("blaze_service_pool_quota_bytes", p["quota"], pl,
+                        mtype="gauge")
+            doc.add("blaze_service_pool_mem_used_bytes",
+                    pool_mem.get(name, 0), pl, mtype="gauge")
     return doc.render()
 
 
@@ -963,19 +1176,26 @@ class MonitorServer:
             # wedge a handler thread past the shutdown join
 
             def do_GET(self):  # noqa: N802 — http.server contract
-                path = self.path.split("?", 1)[0]
+                path, _, query_s = self.path.partition("?")
                 try:
                     if path == "/metrics":
                         body = render_prometheus().encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
                     elif path == "/queries":
-                        body = json.dumps(snapshot()).encode()
+                        # ?all=1 merges the persisted JSONL history
+                        # (spark.blaze.monitor.historyDir) — finished
+                        # queries beyond the in-memory last-64 ring
+                        include_all = "all=1" in query_s.split("&")
+                        body = json.dumps(
+                            snapshot(include_history=include_all)).encode()
                         ctype = "application/json"
                     elif path in ("/", "/healthz"):
                         body = json.dumps({
                             "status": "ok",
-                            "endpoints": ["/metrics", "/queries", "/healthz",
-                                          "POST /queries/<id>/cancel"],
+                            "endpoints": ["/metrics", "/queries",
+                                          "/queries?all=1", "/healthz",
+                                          "POST /queries/<id>/cancel",
+                                          "POST /service/submit"],
                         }).encode()
                         ctype = "application/json"
                     else:
@@ -997,8 +1217,33 @@ class MonitorServer:
                 to ``context.cancel_query``, which fans out into every
                 live task attempt's cancel event.  The query itself
                 returns to ITS caller as QueryCancelledError; this
-                endpoint only acknowledges the request."""
+                endpoint only acknowledges the request.
+
+                ``POST /service/submit`` — the multi-tenant service
+                endpoint (runtime/service.py): body ``{"query": ...,
+                "pool": ..., "session": ...}`` runs through admission;
+                a shed submission answers **429** with the typed
+                retryable rejection, a completed one answers 200 with
+                the row count."""
                 path = self.path.split("?", 1)[0]
+                if path == "/service/submit":
+                    from . import service as service_mod
+
+                    try:
+                        n = int(self.headers.get("Content-Length", 0) or 0)
+                        doc = json.loads(self.rfile.read(n) or b"{}")
+                        status, out = service_mod.http_submit(doc)
+                    except Exception as e:  # noqa: BLE001 — 500, not
+                        # a dead handler thread
+                        status, out = 500, {
+                            "error": f"{type(e).__name__}: {e}"}
+                    body = json.dumps(out).encode()
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 m = re.match(r"^/queries/([^/]+)/cancel$", path)
                 if m is None:
                     self.send_error(404)
@@ -1088,7 +1333,92 @@ class MonitorServer:
         self._httpd.server_close()
 
 
+# ------------------------------------------------- statsd push exporter
+
+_PROM_SAMPLE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$')
+_LABEL_VAL = re.compile(r'[a-zA-Z0-9_:]+="([^"]*)"')
+
+
+def render_statsd_lines() -> List[str]:
+    """The /metrics rendering converted to statsd gauge lines
+    (``name[.label-values]:value|g``) — one source of numbers, two
+    transports, so the push loop can never drift from the scrape."""
+    out: List[str] = []
+    for line in render_prometheus().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_SAMPLE.match(line)
+        if m is None:
+            continue
+        name, _, labels, value = m.groups()
+        if labels:
+            for v in _LABEL_VAL.findall(labels):
+                name += "." + re.sub(r"[^a-zA-Z0-9_\-]", "_", v)
+        out.append(f"{name}:{value}|g")
+    return out
+
+
+class _StatsdPusher:
+    """Best-effort UDP push loop (``spark.blaze.monitor.statsd`` =
+    ``host:port``): every heartbeat interval the /metrics numbers go
+    out as statsd gauges on a ``blaze-monitor-statsd`` daemon thread.
+    UDP and fire-and-forget by design — a dead collector costs
+    nothing, and the workload never blocks on its own telemetry."""
+
+    def __init__(self, target: str):
+        import socket
+
+        host, _, port = target.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="blaze-monitor-statsd")
+        self.pushes = 0  # introspection (single-writer loop thread)
+
+    def start(self) -> "_StatsdPusher":
+        self._thread.start()
+        return self
+
+    def _push_once(self) -> None:
+        lines = render_statsd_lines()
+        # batch into ~1400-byte datagrams (classic statsd MTU etiquette)
+        buf: List[str] = []
+        size = 0
+        for ln in lines:
+            if size + len(ln) + 1 > 1400 and buf:
+                self._send("\n".join(buf))
+                buf, size = [], 0
+            buf.append(ln)
+            size += len(ln) + 1
+        if buf:
+            self._send("\n".join(buf))
+        self.pushes += 1
+
+    def _send(self, payload: str) -> None:
+        try:
+            self._sock.sendto(payload.encode(), self._addr)
+        except OSError:
+            pass  # best-effort: never surface into the workload
+
+    def _loop(self) -> None:
+        interval = heartbeat_ns() / 1e9
+        while not self._stop.wait(interval):
+            try:
+                self._push_once()
+            except Exception:  # noqa: BLE001 — telemetry must not die
+                pass
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._sock.close()
+
+
 _SERVER: Optional[MonitorServer] = None
+_STATSD_PUSHER: Optional[_StatsdPusher] = None
 _server_lock = make_lock("monitor.server")
 
 
@@ -1101,10 +1431,16 @@ def ensure_server() -> Optional[MonitorServer]:
     failure even then leaves the run unmonitored-but-alive (None)."""
     import sys
 
-    global _SERVER
+    global _SERVER, _STATSD_PUSHER
     if not enabled():
         return None
     with _server_lock:
+        if _STATSD_PUSHER is None and _statsd:
+            try:
+                _STATSD_PUSHER = _StatsdPusher(_statsd).start()
+            except (OSError, ValueError) as e:
+                print(f"# monitor: statsd target {_statsd!r} unusable: {e}",
+                      file=sys.stderr)
         if _SERVER is None:
             port = int(conf.MONITOR_PORT.get())
             try:
@@ -1131,11 +1467,15 @@ def server_port() -> Optional[int]:
 
 
 def shutdown_server() -> None:
-    """Stop the background server (no-op when none is running); after
-    return no ``blaze-monitor`` thread is alive."""
-    global _SERVER
+    """Stop the background server and the statsd push loop (no-op when
+    none is running); after return no ``blaze-monitor`` thread is
+    alive."""
+    global _SERVER, _STATSD_PUSHER
     with _server_lock:
         srv, _SERVER = _SERVER, None
+        pusher, _STATSD_PUSHER = _STATSD_PUSHER, None
+    if pusher is not None:
+        pusher.shutdown()
     if srv is not None:
         srv.shutdown()
 
@@ -1171,6 +1511,21 @@ def render_watch(snap: Dict[str, Any], url: str = "") -> str:
         head += (f"  mem {_human_bytes(mem.get('used', 0))}"
                  f"/{_human_bytes(mem['total'])}")
     lines.append(head)
+    svc = snap.get("service")
+    if svc:
+        c = svc.get("counters", {})
+        lines.append(
+            f"service: {svc['running']}/{svc['max_concurrent']} running, "
+            f"{svc['queued']}/{svc['max_queued']} queued  "
+            f"admitted {c.get('queries_admitted', 0)} "
+            f"rejected {c.get('queries_rejected', 0)} "
+            f"quota_cancelled {c.get('queries_quota_cancelled', 0)}")
+        for name, p in sorted(svc.get("pools", {}).items()):
+            lines.append(
+                f"  pool {name:12s} w={p['weight']:<4g} "
+                f"run {p['running']} queued {p['queued']} "
+                f"lease {p['charged_ns'] / 1e9:.2f}s "
+                f"(contended {p['contended_ns'] / 1e9:.2f}s)")
     if not queries:
         lines.append("  (no queries registered yet)")
         return "\n".join(lines)
@@ -1192,22 +1547,31 @@ def render_watch(snap: Dict[str, Any], url: str = "") -> str:
             tail += (f"  oom {deg['oom_recoveries']} spill"
                      f"/{deg['batch_downshifts']} downshift"
                      f"/{deg['eager_fallbacks']} eager")
+        tenant = f" pool={q['pool']}" if q.get("pool") else ""
+        tenant += f" session={q['session']}" if q.get("session") else ""
         lines.append(
-            f"{q['query_id']} [{q['mode']}] {q['status'].upper():7s} "
+            f"{q['query_id']} [{q['mode']}{tenant}] "
+            f"{q['status'].upper():7s} "
             f"{q['elapsed_s']:.1f}s  beat {q['heartbeat_age_s']:.1f}s ago"
             + tail)
         if not q["stages"]:
             continue
         lines.append(f"  {'stage':>5s} {'kind':9s} {'tasks':>7s} "
                      f"{'rows':>12s} {'bytes':>10s} {'programs':>8s} "
+                     f"{'dev/disp':>11s} "
                      f"{'elapsed':>8s} {'beat':>6s}  status")
         for st in q["stages"]:
             rows = max(st["rows"], st.get("task_rows", 0))
+            # the per-task kernel split summed over the stage's beats:
+            # device compute vs dispatch overhead (0/0 when untraced)
+            split = (f"{st.get('device_ns', 0) / 1e6:.0f}"
+                     f"/{st.get('dispatch_ns', 0) / 1e6:.0f}ms")
             lines.append(
                 f"  {st['stage_id']:>5d} {str(st['kind'] or '?'):9s} "
                 f"{st['tasks_done']}/{st['n_tasks']:<5d} "
                 f"{rows:>12,d} {_human_bytes(st['bytes']):>10s} "
                 f"{st['counters'].get('xla_dispatches', 0):>8d} "
+                f"{split:>11s} "
                 f"{st['elapsed_s']:>7.1f}s {st['heartbeat_age_s']:>5.1f}s"
                 f"  {st['status']}")
     return "\n".join(lines)

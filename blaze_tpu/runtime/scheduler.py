@@ -18,6 +18,8 @@ would ship to gateway workers.
 
 from __future__ import annotations
 
+import contextlib
+import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -38,6 +40,19 @@ from .speculation import SpeculationPolicy, StageTaskRunner
 #: call (attempt/retry/fetch-failure counters) — read by the chaos CLI
 #: and tests; pass ``metrics=`` to run_stages to own the node instead.
 LAST_RUN_METRICS: Optional[MetricNode] = None
+
+#: process-global broadcast-id allocator: broadcast resources live in
+#: the process-wide RESOURCES map under ``broadcast_<bid>`` keys, so
+#: ids minted per-plan (the pre-service behavior: every split started
+#: at 0) would collide the moment two queries run concurrently through
+#: the multi-tenant service — one query's reduce tasks would consume a
+#: neighbor's blobs.  itertools.count is GIL-atomic.
+_broadcast_ids = itertools.count()
+
+
+def next_broadcast_id() -> int:
+    """A process-unique broadcast id (split_stages + adaptive joins)."""
+    return next(_broadcast_ids)
 
 
 @dataclass
@@ -79,7 +94,6 @@ def split_stages(
     manager = manager or LocalShuffleManager()
     stages: List[Stage] = []
     wrapper = _StageRoot(root)
-    next_bid = [0]
 
     def walk(node: ExecNode) -> List[int]:
         deps: List[int] = []
@@ -91,8 +105,7 @@ def split_stages(
                 # and the consumer re-reads them replicated through an
                 # IpcReaderExec the scheduler re-registers per task
                 child_deps = walk(c.children[0])
-                bid = next_bid[0]
-                next_bid[0] += 1
+                bid = next_broadcast_id()
                 src = c.children[0]
                 st = Stage(
                     stage_id=len(stages),
@@ -326,6 +339,14 @@ def run_stages(
     # cancel_event, and concurrent attempts attach their own events —
     # a cancel mid-stage reaches ALL live attempts
     scope = current_cancel_scope()
+    # multi-tenant fair-share lease (runtime/service.py): under the
+    # query service every stage executes inside a deficit-round-robin
+    # turn on the one device lease, so concurrent queries interleave
+    # stage-by-stage instead of racing the device; outside the service
+    # this is one ContextVar read and every turn is a no-op
+    from .service import current_lease
+
+    lease = current_lease()
 
     n_maps: Dict[int, int] = {}
     bcast_blobs: Dict[int, List[bytes]] = {}
@@ -697,15 +718,12 @@ def run_stages(
             progress.flush(force=True)
 
     # AQE-style dynamic join selection (runtime/adaptive.py, opt-in):
-    # adaptive broadcast ids start after the planner-assigned ones
+    # adaptive broadcast ids come from the same process-global
+    # allocator as split_stages, so concurrent service queries can
+    # never mint colliding broadcast resource keys
     adaptive_on = bool(conf.ADAPTIVE_JOIN_ENABLE.get())
     if adaptive_on:
         from .adaptive import maybe_rewrite_stage
-
-        next_adaptive_bid = [
-            max((s.broadcast_id for s in stages
-                 if s.broadcast_id is not None), default=-1) + 1
-        ]
 
     from . import dispatch
 
@@ -748,18 +766,35 @@ def run_stages(
                 scope.check(stage.stage_id)
             if adaptive_on:
                 maybe_rewrite_stage(stage, manager, n_maps, bcast_blobs,
-                                    next_adaptive_bid)
+                                    next_broadcast_id)
             if stage.kind == "result":
                 register = make_registrar(stage)
-                with stage_scope(stage) as progress:
-                    for t in range(stage.n_tasks):
-                        yield from run_result_task(stage, t, register,
-                                                   progress)
-                        progress.task_done()
+                # the lease turn covers COMPUTE only: it is paused
+                # around every yield to the consumer, so a slow
+                # consumer backpressures its own producer while the
+                # device lease serves other tenants — never held
+                # across a wait the consumer controls
+                turn = lease.acquire() if lease is not None else None
+                try:
+                    with stage_scope(stage) as progress:
+                        for t in range(stage.n_tasks):
+                            for b in run_result_task(stage, t, register,
+                                                     progress):
+                                if turn is not None:
+                                    lease.pause(turn)
+                                yield b
+                                if turn is not None:
+                                    lease.resume(turn)
+                            progress.task_done()
+                finally:
+                    if turn is not None:
+                        lease.release(turn)
                 publish_dispatch(stage, progress.counters)
                 continue
-            with stage_scope(stage) as progress:
-                run_stage_tasks(stage, progress)
+            with (lease.stage_turn() if lease is not None
+                  else contextlib.nullcontext()):
+                with stage_scope(stage) as progress:
+                    run_stage_tasks(stage, progress)
             publish_dispatch(stage, progress.counters)
             if stage.kind == "map":
                 n_maps[stage.shuffle_id] = stage.n_tasks

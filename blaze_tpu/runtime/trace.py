@@ -379,6 +379,16 @@ def record_kernel(label: str, device_ns: int, dispatch_ns: int,
             agg["timed"] += 1 if timed else 0
 
 
+def snapshot_kernels(sink: Dict[str, Dict[str, int]]) -> Dict[str, Dict[str, int]]:
+    """Locked copy of a LIVE kernel capture — mid-flight consumers
+    (the task heartbeat surfacing device/dispatch splits in /queries)
+    must not iterate a dict the async stager or a sibling attempt is
+    concurrently growing under ``_sink_lock``."""
+    with _sink_lock:
+        lockset.check(_LOG, "_KERNEL_SINKS")
+        return {k: dict(v) for k, v in sink.items()}
+
+
 def scaled_device_ns(v: Dict[str, int]) -> int:
     """A kernel entry's device time scaled back up by the sampling
     factor (programs/timed) — the estimate ``--report`` renders and

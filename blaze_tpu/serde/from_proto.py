@@ -473,18 +473,30 @@ def _instrumented_task_stream(stream, plan, td, attempt: int, on_beat=None):
         metrics: dict = {}
         progress_rows = _tree_metrics(plan, metrics, 0)
         now = _time.perf_counter_ns()
+        # the PR 3 kernel-sink split for this attempt so far — where
+        # the task's wall is going (device compute vs dispatch
+        # overhead), live in /queries and the heartbeat event; only
+        # tracing arms the capture, so monitor-only runs report 0/0
+        # rather than paying the block-until-ready path
+        device_ns = dispatch_ns = 0
+        if traced and kc:
+            split = trace.sum_kernels(trace.snapshot_kernels(kc))
+            device_ns = split["device_time_ns"]
+            dispatch_ns = split["dispatch_overhead_ns"]
         if traced:
             trace.emit(
                 "task_heartbeat", task_id=td.task_id, stage_id=td.stage_id,
                 partition=td.partition, attempt=attempt, rows=rows,
                 batches=batches, elapsed_ns=now - t0,
                 progress_rows=progress_rows, metrics=metrics,
+                device_ns=device_ns, dispatch_ns=dispatch_ns,
             )
         if mon:
             monitor.task_beat(td.stage_id, td.partition, attempt,
                               rows=rows, batches=batches, metrics=metrics,
                               progress_rows=progress_rows,
-                              task_id=td.task_id)
+                              task_id=td.task_id,
+                              device_ns=device_ns, dispatch_ns=dispatch_ns)
 
     kc_scope = trace.kernel_capture() if traced else _contextlib.nullcontext({})
     # the beat fires from monitor.tick() — called per operator output
@@ -511,6 +523,14 @@ def _instrumented_task_stream(stream, plan, td, attempt: int, on_beat=None):
                 batches += 1
                 beat_state.tick()
                 yield b
+            if mon:
+                # FINAL beat, interval-ungated: a task faster than the
+                # heartbeat period would otherwise never land its rows
+                # or kernel split in the registry at all (a failed
+                # attempt's entry is discarded by the scheduler's
+                # rollback hook right after this unwinds, so only the
+                # completed drive beats here)
+                beat()
         finally:
             if traced:
                 trace.emit(
