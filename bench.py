@@ -292,8 +292,24 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
         from blaze_tpu.runtime import trace
 
         try:
-            with trace.profile_kernels() as prof:
-                once()
+            # provenance: the profiled iteration runs under a minted
+            # W3C trace context, and the line stamps its
+            # trace_id/query_id — so a BENCH artifact's perf numbers
+            # are traceable to the exact event-log/OTLP segments the
+            # profiled run produced when tracing was armed (ROADMAP
+            # item 4's provenance chain, like the device_kind stamp)
+            bench_qid = f"bench_{os.getpid()}_{int(time.time())}"
+            bench_tid = trace.new_trace_id()
+            tok = trace.set_trace_context(
+                bench_tid, trace.span_id_for(bench_tid,
+                                             f"query:{bench_qid}"))
+            try:
+                with trace.profile_kernels() as prof:
+                    once()
+            finally:
+                trace.reset_trace_context(tok)
+            stats["trace_id"] = bench_tid
+            stats["query_id"] = bench_qid
             k = trace.sum_kernels(prof)
             stats["programs"] = k["programs"]
             stats["device_time_s"] = round(k["device_time_ns"] / 1e9, 4)
@@ -361,7 +377,8 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
     }
     # dispatch-floor profile of one warm iteration (VERDICT r5 #7) —
     # absent when the optional profile pass failed (tunnel flap)
-    for k in ("programs", "device_time_s", "dispatch_overhead_s", "timed"):
+    for k in ("programs", "device_time_s", "dispatch_overhead_s", "timed",
+              "trace_id", "query_id"):
         if k in stats6:
             result[k] = stats6[k]
     if extras:
@@ -381,7 +398,9 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
     for src, dst in (("programs", "q01_programs"),
                      ("device_time_s", "q01_device_time_s"),
                      ("dispatch_overhead_s", "q01_dispatch_overhead_s"),
-                     ("timed", "q01_timed")):
+                     ("timed", "q01_timed"),
+                     ("trace_id", "q01_trace_id"),
+                     ("query_id", "q01_query_id")):
         if src in stats1:
             result[dst] = stats1[src]
     # per-half provenance: best-of can pair a CACHED q06 (whose
@@ -405,6 +424,7 @@ _Q01_CARRY_KEYS = (
     "q01_compile_ms", "q01_warm_compiles", "q01_programs",
     "q01_device_time_s", "q01_dispatch_overhead_s", "q01_timed",
     "q01_device_kind", "q01_trace_sample_rate",
+    "q01_trace_id", "q01_query_id",
 )
 # the q06 half, kept together under best-of selection — pairing one
 # run's throughput with another run's counters would let a
@@ -418,6 +438,7 @@ _Q06_BEST_OF_KEYS = (
     "dispatch_count", "compile_ms", "warm_compiles", "programs",
     "device_time_s", "dispatch_overhead_s", "timed",
     "device_kind", "trace_sample_rate",
+    "trace_id", "query_id",
 )
 
 

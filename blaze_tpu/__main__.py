@@ -367,9 +367,11 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
     deterministic ``LocksetViolation`` that fails the run.  Nonzero
     exit on mismatch, unrecovered failure, an unreconciled event log,
     or ANY verifier firing."""
+    import tempfile
+
     from . import conf
     from .analysis import locks as lock_verify
-    from .runtime import lockset, monitor
+    from .runtime import lockset, monitor, otel
 
     # ``loaded`` = a (build_query, names, scans) the sweep resolved
     # once up front — datagen does not depend on the seed, so N seeds
@@ -385,6 +387,21 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
     lock_verify.refresh()
     conf.VERIFY_LOCKSET.set(True)
     lockset.refresh()
+    # telemetry arms for the whole smoke: OTLP export to a scratch dir
+    # (endpoint at a dead port so the pusher spins up, fails fast, and
+    # must still shut down leak-free) + the monitor REGISTRY (no
+    # server) so latency histograms record every chaotic run — gated
+    # by _check_chaos_telemetry after the loop
+    otel_knobs = (conf.OTEL_ENABLE, conf.OTEL_DIR, conf.OTEL_ENDPOINT,
+                  conf.MONITOR_ENABLE)
+    prev_otel = [k.get() for k in otel_knobs]
+    otel_dir = tempfile.mkdtemp(prefix="blaze_otel_chaos_")
+    conf.OTEL_ENABLE.set(True)
+    conf.OTEL_DIR.set(otel_dir)
+    conf.OTEL_ENDPOINT.set("http://127.0.0.1:9/v1/traces")
+    otel.reset()
+    conf.MONITOR_ENABLE.set(True)
+    monitor.reset()
     spec_knobs = (conf.SPECULATION_ENABLE, conf.SPECULATION_MULTIPLIER,
                   conf.SPECULATION_QUANTILE, conf.SPECULATION_MIN_RUNTIME,
                   conf.SPECULATION_WEDGE_MS, conf.MONITOR_HEARTBEAT_MS)
@@ -399,8 +416,9 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
         conf.MONITOR_HEARTBEAT_MS.set(50)
         monitor.reset()
     try:
-        return _chaos_loop(suite, names, scans, build_query, n_parts, seed,
-                           n_faults, speculate, inject_oom)
+        rc = _chaos_loop(suite, names, scans, build_query, n_parts, seed,
+                         n_faults, speculate, inject_oom)
+        return _check_chaos_telemetry(suite, names, otel_dir) or rc
     finally:
         conf.VERIFY_PLAN.set(False)
         conf.VERIFY_LOCKS.set(False)
@@ -413,7 +431,13 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
             # aggressive thresholds
             for k, v in zip(spec_knobs, prev):
                 k.set(v)
-            monitor.reset()
+        # telemetry knobs restore even when a gate raises (the
+        # knob-leak class): pusher down first, then conf, then reset
+        otel.shutdown_pusher()
+        for k, v in zip(otel_knobs, prev_otel):
+            k.set(v)
+        otel.reset()
+        monitor.reset()
 
 
 def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
@@ -529,6 +553,61 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
         print(f"# chaos: {len(failed)} failed: {', '.join(failed)}",
               file=sys.stderr)
         return 1
+    return 0
+
+
+def _check_chaos_telemetry(suite, names, otel_dir: str) -> int:
+    """--chaos telemetry gate: every chaotic query exported ONE OTLP
+    document whose spans all carry a single trace id, the query-latency
+    histogram recorded every chaotic run, and the OTLP pusher + the
+    histogram path leaked no thread (the statsd/monitor leak gates'
+    OTLP sibling).  Lockset quietness rides the per-query check the
+    chaos loop already does — the histogram and export paths run under
+    the armed checker the whole smoke."""
+    import glob
+    import json as _json
+    import os
+
+    from .runtime import monitor, otel
+
+    problems = []
+    for name in names:
+        pat = os.path.join(otel_dir, f"chaos_{suite}_{name}-*-spans.json")
+        files = sorted(glob.glob(pat))
+        if not files:
+            problems.append(f"{name}: no OTLP export under {otel_dir}")
+            continue
+        try:
+            with open(files[-1]) as f:
+                doc = _json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append(f"{name}: unreadable OTLP export: {e}")
+            continue
+        spans = otel.span_index(doc)
+        tids = {s.get("traceId") for s in spans}
+        if not spans:
+            problems.append(f"{name}: OTLP export has no spans")
+        elif len(tids) != 1:
+            problems.append(
+                f"{name}: {len(tids)} trace ids in one export "
+                f"(cross-process reconciliation broken)")
+    hists = {h["name"]: h for h in monitor.histograms_snapshot()}
+    lat = hists.get("blaze_query_latency_seconds")
+    lat_count = 0 if lat is None else lat["count"]
+    if lat_count < len(names):
+        problems.append(f"query-latency histogram missed runs "
+                        f"({lat_count}/{len(names)})")
+    otel.shutdown_pusher()
+    leaked = otel.otel_threads()
+    if leaked:
+        problems.append("otel thread leak after shutdown: "
+                        + ", ".join(t.name for t in leaked))
+    if problems:
+        print("# chaos telemetry: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    print(f"# chaos telemetry: OK ({len(names)} single-trace OTLP "
+          f"export(s), latency histogram count {lat_count}, pusher "
+          f"shut down clean)")
     return 0
 
 
@@ -957,6 +1036,20 @@ def _serve_forever() -> int:
     return rc
 
 
+def _shutdown_otel_checked() -> int:
+    """Stop the OTLP push loop and verify nothing leaked — the
+    ``--otel`` sibling of the monitor shutdown gate."""
+    from .runtime import otel
+
+    otel.shutdown_pusher()
+    leaked = otel.otel_threads()
+    if leaked:
+        print("# otel: THREAD LEAK after shutdown: "
+              + ", ".join(t.name for t in leaked), file=sys.stderr)
+        return 1
+    return 0
+
+
 def _shutdown_monitor_checked() -> int:
     """Stop the monitor server and verify nothing leaked: a long-lived
     background service must never wedge process exit (nonzero when a
@@ -1084,7 +1177,27 @@ def main(argv=None) -> int:
                          "<tmp>/blaze_eventlog)")
     ap.add_argument("--report", default="",
                     help="render the per-query profile from a JSONL event "
-                         "log produced by --trace / --chaos and exit")
+                         "log produced by --trace / --chaos and exit; a "
+                         "DIRECTORY merges every *.jsonl segment in it "
+                         "(driver + worker-subprocess logs reconciled by "
+                         "their shared trace id) into one report")
+    ap.add_argument("--flame", default="", metavar="PATH",
+                    help="with --report: also write the query's flame "
+                         "profile as collapsed-stack lines ('-' = stdout) "
+                         "consumable by flamegraph.pl / speedscope — "
+                         "kernel device/dispatch/compile splits per stage "
+                         "plus the plan-node tree weighted by "
+                         "elapsed_compute")
+    ap.add_argument("--otel", action="store_true",
+                    help="arm OTLP span export (spark.blaze.otel.enabled; "
+                         "implies --trace): each query's event log exports "
+                         "as an OTLP/JSON span tree to the file sink "
+                         "(spark.blaze.otel.dir) and, when an endpoint is "
+                         "set, the blaze-otel-push loop")
+    ap.add_argument("--otel-endpoint", default="", metavar="URL",
+                    help="with --otel: best-effort OTLP/HTTP collector "
+                         "endpoint (spark.blaze.otel.endpoint, e.g. "
+                         "http://localhost:4318/v1/traces)")
     ap.add_argument("--json", default="", metavar="PATH",
                     help="with --report: also write the full profile as "
                          "one JSON document (stage timeline, dispatch-floor "
@@ -1138,19 +1251,51 @@ def main(argv=None) -> int:
         args.chaos = True
     if args.lint:
         return _run_lint(args.json)
+    if args.flame and not args.report:
+        ap.error("--flame requires --report (flame profile from an "
+                 "event log)")
     if args.report:
+        import os as _os
+
         from .runtime import trace, trace_report
 
         try:
-            # reads a rotated set too (spark.blaze.eventLog.maxBytes
-            # rollover): <path>.seg1..N then the active file
-            events = trace.read_event_log(args.report)
+            if _os.path.isdir(args.report):
+                # a DIRECTORY of segments: the driver's per-query log
+                # plus worker subprocesses' own logs, reconciled into
+                # one time-ordered stream (shared trace id = join key)
+                events = trace_report.merge_event_logs(
+                    trace_report.event_log_files(args.report))
+            else:
+                # reads a rotated set too (spark.blaze.eventLog.maxBytes
+                # rollover): <path>.seg1..N then the active file
+                events = trace.read_event_log(args.report)
         except OSError as e:
             print(f"cannot read event log: {e}", file=sys.stderr)
             return 2
         if not events:
             print(f"no events in {args.report}", file=sys.stderr)
             return 1
+        if args.flame == "-" and args.json == "-":
+            ap.error("--flame - and --json - both claim stdout; "
+                     "write at least one to a file")
+        if args.json and args.json != "-":
+            # the JSON profile lands BEFORE a streaming flame exit, so
+            # `--flame - --json out.json` produces both artifacts
+            import json as _json
+
+            with open(args.json, "w") as f:
+                _json.dump(trace_report.render_json(events), f, indent=2,
+                           default=str)
+            print(f"# json profile: {args.json}", file=sys.stderr)
+            args.json = ""
+        if args.flame:
+            n = trace_report.write_flame(events, args.flame)
+            if args.flame == "-":
+                # stdout is the PARSEABLE collapsed-stack stream and
+                # nothing else (the --json - contract)
+                return 0
+            print(f"# flame profile: {args.flame} ({n} stacks)")
         if args.json:
             import json as _json
 
@@ -1171,17 +1316,28 @@ def main(argv=None) -> int:
 
             conf.MONITOR_PORT.set(args.monitor_port)
         return _watch(args.watch, args.watch_interval, args.watch_polls)
-    if args.trace or args.event_log_dir:
+    if args.trace or args.event_log_dir or args.otel or args.otel_endpoint:
         from . import conf
         from .runtime import trace
 
         # --event-log-dir applies on its own too: --chaos arms tracing
         # itself, and its logs must land where the user pointed
-        if args.trace:
+        if args.trace or args.otel or args.otel_endpoint:
+            # OTLP export converts the event log: --otel (and a bare
+            # --otel-endpoint) implies --trace — otherwise every query
+            # span yields no log and the export is silently empty
             conf.TRACE_ENABLE.set(True)
         if args.event_log_dir:
             conf.EVENT_LOG_DIR.set(args.event_log_dir)
         trace.reset()
+    if args.otel or args.otel_endpoint:
+        from . import conf
+        from .runtime import otel
+
+        conf.OTEL_ENABLE.set(True)
+        if args.otel_endpoint:
+            conf.OTEL_ENDPOINT.set(args.otel_endpoint)
+        otel.reset()
     monitor_armed = args.serve or args.monitor or args.service
     if monitor_armed:
         from . import conf
@@ -1266,6 +1422,8 @@ def main(argv=None) -> int:
         # every monitored mode guards the long-lived service: shutdown
         # must not leak a thread or wedge process exit, and a leak is
         # an exit-code failure, not a stderr footnote
+        if args.otel or args.otel_endpoint:
+            rc = _shutdown_otel_checked() or rc
         if monitor_armed:
             rc = _shutdown_monitor_checked() or rc
     return rc

@@ -73,6 +73,11 @@ HIERARCHY: Tuple[str, ...] = (
     "monitor.registry",      # live query registry
     "monitor.progress",      # per-stage progress counters (leaf: held
                              # only for arithmetic, emission is outside)
+    "otel.state",            # OTLP export queue + pusher lifecycle
+                             # (held for list/slot mutation only; the
+                             # HTTP POST and file IO happen outside)
+    "monitor.hist",          # latency histograms + statsd timer queue
+                             # (held for bucket arithmetic only)
     "memmgr.manager",        # host-staging budget accounting
     "metrics.node",          # MetricNode tree growth
     "metrics.set",           # per-operator counters

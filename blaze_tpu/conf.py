@@ -192,6 +192,27 @@ EVENT_LOG_MAX_BYTES = ConfEntry("spark.blaze.eventLog.maxBytes", 0, int)
 # report flags it '~').
 TRACE_SAMPLE_RATE = ConfEntry("spark.blaze.trace.sampleRate", 1, int)
 
+# OpenTelemetry export (runtime/otel.py): map each traced query's
+# event log onto an OTLP/JSON span tree (query -> stage -> task ->
+# kernel, one W3C trace id end to end) at query-span exit.  OFF
+# (default) is a structural no-op exactly like trace.enabled: one bool
+# read at span exit, no conversion, no file, no thread.  Requires
+# tracing armed (the event log is the source).
+OTEL_ENABLE = ConfEntry("spark.blaze.otel.enabled", False, _bool)
+# File sink directory for the exported OTLP/JSON documents (one
+# <query>-<pid>-spans.json per traced query); empty = a blaze_otel dir
+# under the system temp dir.
+OTEL_DIR = ConfEntry("spark.blaze.otel.dir", "", str)
+# Best-effort OTLP/HTTP push target (e.g. an OpenTelemetry collector's
+# http://host:4318/v1/traces): when set, exported span documents are
+# also queued to a daemon push loop (blaze-otel-push, next to the
+# statsd pusher) that POSTs them with a short timeout — a dead
+# collector costs nothing and never blocks the workload.  Empty
+# (default) = file sink only, no socket, no thread.
+OTEL_ENDPOINT = ConfEntry("spark.blaze.otel.endpoint", "", str)
+# Push-loop flush cadence (ms) for the OTLP HTTP exporter.
+OTEL_FLUSH_MS = ConfEntry("spark.blaze.otel.flushMs", 1000, int)
+
 # Multi-tenant query service (runtime/service.py): admission control,
 # fair-share scheduling, per-pool quotas, backpressure, supervision.
 # Queries RUNNING concurrently once admitted (each interleaves its

@@ -37,7 +37,8 @@ def cancel_query(query_id: str) -> bool:
 
 
 @contextlib.contextmanager
-def query_span(query_id: str, n_tasks: int = 1) -> Iterator[Optional[str]]:
+def query_span(query_id: str, n_tasks: int = 1,
+               traceparent: Optional[str] = None) -> Iterator[Optional[str]]:
     """Gateway-side query span: the JNI entry wraps one native query's
     task drives in this so the FFI execution mode produces the same
     query -> stage -> kernel span tree (event log when tracing is
@@ -45,10 +46,15 @@ def query_span(query_id: str, n_tasks: int = 1) -> Iterator[Optional[str]]:
     armed) as the scheduler and session paths.  Opens ONE ``result``
     stage span covering all of the query's task drives (``n_tasks``
     when known up front); :func:`task_span` nests inside it.  Yields
-    the event-log path (None when tracing is disarmed)."""
-    from .runtime import monitor
+    the event-log path (None when tracing is disarmed).  ``traceparent``
+    (a W3C header value from the JVM side, e.g. the Spark listener's
+    own OpenTelemetry context) continues the caller's trace."""
+    from .runtime import monitor, trace
 
-    with monitor.query_span(query_id, mode="gateway") as log_path:
+    ctx = trace.parse_traceparent(traceparent) if traceparent else None
+    with monitor.query_span(query_id, mode="gateway",
+                            trace_id=ctx[0] if ctx else None,
+                            parent_span=ctx[1] if ctx else None) as log_path:
         with monitor.stage_span(0, "result", n_tasks) as progress:
             prev = getattr(_gw_tls, "progress", None)
             prev_seq = getattr(_gw_tls, "task_seq", None)

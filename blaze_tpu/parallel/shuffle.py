@@ -507,8 +507,17 @@ class _AsyncInserter:
         self._q: "queue.Queue" = queue.Queue(max(1, depth))
         self._errs: List[BaseException] = []
         self._aborted = False
+        # the stager runs under a COPY of the creating task's context
+        # (like the speculation runner's attempt threads): the memmgr
+        # accounting it lands — mem_watermark/spill trace events, the
+        # owner-tag quota hook — attributes to the owning query's
+        # trace id and monitor entry instead of a context-less thread
+        import contextvars
+
+        ctx = contextvars.copy_context()
         self._thread = threading.Thread(
-            target=self._drain, name="shuffle-async-insert", daemon=True
+            target=lambda: ctx.run(self._drain),
+            name="shuffle-async-insert", daemon=True
         )
         self._thread.start()
 

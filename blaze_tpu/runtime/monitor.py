@@ -51,7 +51,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from .. import conf
 from ..analysis.locks import make_lock
-from . import lockset, trace
+from . import lockset, otel, trace
 
 # --------------------------------------------------------------- state
 
@@ -61,11 +61,15 @@ _REG = lockset.module_guard(__name__)
 #: guarded-by declaration (analysis/guarded.py): the live registry is
 #: written from query/attempt threads and read by monitor handler
 #: threads; _armed/_hb_ns/_loaded are load-once config reads and stay
-#: undeclared like trace._armed
+#: undeclared like trace._armed.  The histogram registry and statsd
+#: timer queue live under their own leaf lock (monitor.hist) so a
+#: span-exit observation never contends with registry reads.
 GUARDED_BY = {"_QUERIES": "monitor.registry",
               "_updates": "monitor.registry",
-              "_seq": "monitor.registry"}
-GUARDED_REFS = ("_QUERIES",)
+              "_seq": "monitor.registry",
+              "_HISTOGRAMS": "monitor.hist",
+              "_TIMERS": "monitor.hist"}
+GUARDED_REFS = ("_QUERIES", "_HISTOGRAMS", "_TIMERS")
 _loaded = False
 _armed = False
 _hb_ns = 1_000_000_000
@@ -132,6 +136,10 @@ def reset() -> None:
         _QUERIES.clear()
         _updates = 0
         _seq = 0
+    with _hist_lock:
+        lockset.check(_REG, "_HISTOGRAMS", "_TIMERS")
+        _HISTOGRAMS.clear()
+        _TIMERS.clear()
 
 
 def counters() -> Dict[str, int]:
@@ -139,6 +147,193 @@ def counters() -> Dict[str, int]:
     since the last :func:`reset`."""
     with _lock:
         return {"updates": _updates, "queries": len(_QUERIES)}
+
+
+# ------------------------------------------- histograms + exemplars
+
+#: cumulative-bucket upper bounds (seconds) shared by every latency
+#: histogram — wide enough for sub-ms CPU test queries and minute-long
+#: chip queries alike (+Inf is implicit)
+HIST_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+               0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+#: every histogram family /metrics may export — registered in
+#: metric_names.json (the drift gates cover them like any counter)
+HISTOGRAM_NAMES = (
+    "blaze_query_latency_seconds",
+    "blaze_admission_wait_seconds",
+    "blaze_stage_wall_seconds",
+    "blaze_program_device_seconds",
+    "blaze_program_dispatch_seconds",
+)
+
+
+class Histogram:
+    """One cumulative-bucket histogram with per-bucket exemplars.
+
+    Rendered into ``/metrics`` in OpenMetrics style: ``_bucket{le=}``
+    samples (each carrying the latest exemplar's trace id, so a bad
+    bucket links straight to its distributed trace), ``_sum``, and
+    ``_count``.  Observation is a few adds under the leaf lock
+    ``monitor.hist`` — cheap enough for every query/stage span exit."""
+
+    __slots__ = ("name", "bounds", "counts", "sum", "count", "vmax",
+                 "exemplars", "_hlock")
+
+    #: guarded-by declaration (analysis/guarded.py): observed from
+    #: query worker threads, rendered by monitor handler threads
+    GUARDED_BY = {"counts": "monitor.hist",
+                  "sum": "monitor.hist",
+                  "count": "monitor.hist",
+                  "vmax": "monitor.hist",
+                  "exemplars": "monitor.hist"}
+    GUARDED_REFS = ("counts", "exemplars")
+
+    def __init__(self, name: str, bounds=HIST_BOUNDS):
+        self.name = name
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self.vmax = 0.0
+        #: per-bucket latest exemplar: {bucket index: (trace_id, value, ts)}
+        self.exemplars: Dict[int, tuple] = {}
+        self._hlock = make_lock("monitor.hist")
+
+    def _bucket(self, value: float) -> int:
+        for i, b in enumerate(self.bounds):
+            if value <= b:
+                return i
+        return len(self.bounds)
+
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
+        value = max(0.0, float(value))
+        i = self._bucket(value)
+        with self._hlock:
+            lockset.check(self, "counts", "sum", "count", "vmax",
+                          "exemplars")
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+            if value > self.vmax:
+                self.vmax = value
+            if trace_id:
+                self.exemplars[i] = (trace_id, value, time.time())
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Locked copy for rendering/tests: cumulative bucket counts
+        keyed by upper bound (``inf`` last), sum/count, exemplars."""
+        with self._hlock:
+            lockset.check(self, "counts", "sum", "count", "vmax",
+                          "exemplars")
+            counts = list(self.counts)
+            out = {"name": self.name, "sum": self.sum,
+                   "count": self.count, "max": self.vmax,
+                   "exemplars": dict(self.exemplars)}
+        cum = 0
+        buckets = []
+        for i, b in enumerate(self.bounds):
+            cum += counts[i]
+            buckets.append((b, cum))
+        buckets.append((float("inf"), cum + counts[-1]))
+        out["buckets"] = buckets
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (the upper bound of the
+        bucket the q-th sample falls in; the +Inf bucket reports the
+        max observed value) — what /queries and --watch surface as
+        p50/p95/p99."""
+        with self._hlock:
+            lockset.check(self, "counts", "count", "vmax")
+            total = self.count
+            if total == 0:
+                return 0.0
+            target = q * total
+            cum = 0
+            for i, b in enumerate(self.bounds):
+                cum += self.counts[i]
+                if cum >= target:
+                    return b
+            return self.vmax
+
+
+_hist_lock = make_lock("monitor.hist")
+_HISTOGRAMS: "OrderedDict[str, Histogram]" = OrderedDict()
+
+#: recent statsd ``|ms`` timer samples (name, ms) — drained by
+#: render_statsd_lines so each sample pushes exactly once; bounded so
+#: a push loop that died never grows it unbounded
+_TIMERS: List[tuple] = []
+_MAX_TIMERS = 512
+
+
+def _histogram(name: str) -> Histogram:
+    with _hist_lock:
+        lockset.check(_REG, "_HISTOGRAMS")
+        h = _HISTOGRAMS.get(name)
+        if h is None:
+            h = _HISTOGRAMS[name] = Histogram(name)
+    return h
+
+
+def observe_hist(name: str, value: float,
+                 trace_id: Optional[str] = None) -> None:
+    """Land one sample (seconds) in a named histogram, with the trace
+    id as its bucket exemplar.  Structural no-op when the monitor is
+    disarmed — one bool read, like every hot-path entry here."""
+    if not enabled():
+        return
+    _histogram(name).observe(value, trace_id=trace_id)
+
+
+def record_timer(name: str, ms: float) -> None:
+    """Queue one statsd ``|ms`` timer sample for the next push —
+    latency as an EVENT stream (statsd timers aggregate server-side),
+    next to the gauge lines derived from /metrics."""
+    if not enabled():
+        return
+    with _hist_lock:
+        lockset.check(_REG, "_TIMERS")
+        if len(_TIMERS) >= _MAX_TIMERS:
+            _TIMERS.pop(0)
+        _TIMERS.append((name, float(ms)))
+
+
+def drain_timers() -> List[tuple]:
+    """Take the queued timer samples (the statsd renderer's drain)."""
+    with _hist_lock:
+        lockset.check(_REG, "_TIMERS")
+        out = list(_TIMERS)
+        _TIMERS.clear()
+    return out
+
+
+def histograms_snapshot() -> List[Dict[str, Any]]:
+    """Every live histogram's snapshot, registration order (render,
+    /queries latency block, tests)."""
+    with _hist_lock:
+        lockset.check(_REG, "_HISTOGRAMS")
+        hists = list(_HISTOGRAMS.values())
+    return [h.snapshot() for h in hists]
+
+
+def latency_summary() -> Dict[str, Dict[str, float]]:
+    """p50/p95/p99 + count per histogram family — the /queries
+    ``latency`` block and the --watch percentile line."""
+    with _hist_lock:
+        lockset.check(_REG, "_HISTOGRAMS")
+        hists = list(_HISTOGRAMS.values())
+    out: Dict[str, Dict[str, float]] = {}
+    for h in hists:
+        snap = h.snapshot()
+        if not snap["count"]:
+            continue
+        out[h.name] = {"count": snap["count"],
+                       "p50": h.quantile(0.50),
+                       "p95": h.quantile(0.95),
+                       "p99": h.quantile(0.99)}
+    return out
 
 
 def _copy_counters(cap: Optional[Dict[str, int]]) -> Dict[str, int]:
@@ -255,7 +450,9 @@ def query(query_id: str, mode: str = "in-process",
 def query_span(query_id: str, mode: str = "in-process",
                timeout_ms: Optional[int] = None,
                pool: Optional[str] = None,
-               session: Optional[str] = None) -> Iterator[Optional[str]]:
+               session: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               parent_span: Optional[str] = None) -> Iterator[Optional[str]]:
     """Combined trace + monitor + cancellation query scope: the
     event-log span (``trace.query``), the per-query
     :class:`context.CancelScope` (cancellation + the
@@ -264,13 +461,40 @@ def query_span(query_id: str, mode: str = "in-process",
     point (CLI suite runner, ``session.execute``, the gateway, the
     multi-tenant service with its ``pool``/``session`` labels) wraps a
     query in.  Yields the event-log path (None when tracing is
-    disarmed)."""
+    disarmed).
+
+    ``trace_id``/``parent_span`` continue an upstream W3C trace (a
+    ``traceparent`` header on the service endpoint, an explicit caller
+    id); omitted, the trace span mints a fresh trace id.  At span exit
+    the query's latency lands in the ``blaze_query_latency_seconds``
+    histogram (exemplar = the trace id, so a bad bucket links to its
+    trace) and — when ``spark.blaze.otel.enabled`` is armed — the
+    finished event log exports as an OTLP/JSON span tree
+    (runtime/otel.py)."""
     from .context import cancel_scope
 
-    with trace.query(query_id) as log_path:
-        with cancel_scope(query_id, timeout_ms=timeout_ms):
-            with query(query_id, mode=mode, pool=pool, session=session):
-                yield log_path
+    t0 = time.perf_counter_ns()
+    log_path = None
+    tid = trace_id
+    try:
+        with trace.query(query_id, trace_id=trace_id,
+                         parent_span_id=parent_span) as log_path:
+            if tid is None:
+                ctx = trace.current_trace_context()
+                tid = ctx[0] if ctx is not None else None
+            with cancel_scope(query_id, timeout_ms=timeout_ms):
+                with query(query_id, mode=mode, pool=pool,
+                           session=session):
+                    yield log_path
+    finally:
+        if enabled():
+            dt = (time.perf_counter_ns() - t0) / 1e9
+            observe_hist("blaze_query_latency_seconds", dt, trace_id=tid)
+            record_timer("blaze_query_latency_ms", dt * 1e3)
+        if otel.enabled() and log_path is not None:
+            # the event log is complete here (query_end emitted by the
+            # trace span's own finally): convert + sink, best-effort
+            otel.export_query(query_id, log_path)
 
 
 def stage_started(stage_id: int, kind: Optional[str], n_tasks: int) -> None:
@@ -343,7 +567,8 @@ def task_beat(stage_id: int, partition: int, attempt: int, *, rows: int,
               batches: int, metrics: Optional[Dict[str, int]] = None,
               progress_rows: int = 0,
               task_id: Optional[str] = None,
-              device_ns: int = 0, dispatch_ns: int = 0) -> None:
+              device_ns: int = 0, dispatch_ns: int = 0,
+              kernels: Optional[Dict[str, Dict[str, int]]] = None) -> None:
     """Land one task heartbeat (from ``run_task``'s instrumented
     stream) in the registry: per-task rows plus freshness, so a stage
     whose tasks are alive-but-slow is distinguishable from a wedged
@@ -369,6 +594,9 @@ def task_beat(stage_id: int, partition: int, attempt: int, *, rows: int,
             # far (device compute vs dispatch overhead) — populated
             # only while tracing is armed (the sinks exist then)
             "device_ns": device_ns, "dispatch_ns": dispatch_ns,
+            # the full per-label sink snapshot when the caller has one
+            # (traced runs) — the flame-profile endpoint's source
+            "kernels": {k: dict(v) for k, v in (kernels or {}).items()},
             "last_beat": now, "metrics": dict(metrics or {}),
         }
         st["last_beat"] = now
@@ -510,6 +738,10 @@ def snapshot(include_history: bool = False) -> Dict[str, Any]:
         "ts": time.time(),
         "queries": queries,
         "memory": {"used": _mem_used(), "total": _mem_total()},
+        # tail latency at a glance: p50/p95/p99 per histogram family
+        # (query latency, admission wait, stage wall, per-program
+        # device/dispatch) — the /metrics histograms' summary view
+        "latency": latency_summary(),
     }
     svc = _service_stats()
     if svc is not None:
@@ -551,6 +783,46 @@ def heartbeat_ages() -> Dict[str, float]:
         lockset.check(_REG, "_QUERIES")
         return {q["query_id"]: (now - q["last_beat"]) / 1e9
                 for q in _QUERIES.values() if q["status"] == "running"}
+
+
+def render_profile(key_or_id: str) -> Optional[str]:
+    """One query's flame profile as COLLAPSED-STACK text (the
+    ``flamegraph.pl`` / speedscope input format: ``frame;frame;frame
+    <value>`` per line, value = microseconds) aggregated from the
+    per-task kernel-sink beats — served by ``/queries/<id>/profile``.
+    Matches a registry key exactly, else the LATEST entry for a query
+    id.  None when unknown; empty profile (untraced run: the beats
+    carry no kernel sinks) renders a comment line so the consumer can
+    tell "no such query" from "no kernel data"."""
+    with _lock:
+        lockset.check(_REG, "_QUERIES")
+        entry = _QUERIES.get(key_or_id)
+        if entry is None:
+            for q in _QUERIES.values():
+                if q["query_id"] == key_or_id:
+                    entry = q  # insertion order: the LAST match wins
+        if entry is None:
+            return None
+        qid = entry["query_id"]
+        agg: Dict[tuple, int] = {}
+        for sid in sorted(entry["stages"]):
+            st = entry["stages"][sid]
+            for t in st["tasks"].values():
+                for label, v in (t.get("kernels") or {}).items():
+                    for part, ns in (
+                            ("device", trace.scaled_device_ns(v)),
+                            ("dispatch", v.get("dispatch_ns", 0)),
+                            ("compile", v.get("compile_ns", 0))):
+                        k = (sid, st["kind"] or "?", label, part)
+                        agg[k] = agg.get(k, 0) + ns
+    lines = [
+        f"{qid};stage_{sid}_{kind};{label};{part} {max(1, ns // 1000)}"
+        for (sid, kind, label, part), ns in sorted(agg.items()) if ns > 0
+    ]
+    if not lines:
+        return (f"# no kernel data for {qid!r} — flame profiles need "
+                f"tracing armed (spark.blaze.trace.enabled)\n")
+    return "\n".join(lines) + "\n"
 
 
 # ----------------------------------------------------- history (JSONL)
@@ -979,17 +1251,76 @@ def stage_span(stage_id: int, kind: str, n_tasks: int,
             raise
         finally:
             progress.flush(force=True)
+            wall_ns = time.perf_counter_ns() - t0
             if traced:
                 trace.emit(
                     "stage_complete", stage_id=stage_id, kind=kind,
                     n_tasks=n_tasks, shuffle_id=shuffle_id, status=status,
-                    wall_ns=time.perf_counter_ns() - t0,
+                    wall_ns=wall_ns,
                     kernels=kc, counters=_copy_counters(cap),
                     **trace.sum_kernels(kc),
                 )
             if mon:
                 stage_finished(stage_id, status,
                                counters=_copy_counters(cap))
+                ctx = trace.current_trace_context()
+                tid = ctx[0] if ctx is not None else None
+                observe_hist("blaze_stage_wall_seconds", wall_ns / 1e9,
+                             trace_id=tid)
+                # per-program device/dispatch distributions: one sample
+                # per kernel label = that label's mean per-program cost
+                # this stage (the tail of THESE is the dispatch-floor
+                # story items 3-4 will be judged against)
+                for v in trace.snapshot_kernels(kc).values() if traced \
+                        else ():
+                    n = max(1, v.get("programs", 0))
+                    observe_hist("blaze_program_device_seconds",
+                                 trace.scaled_device_ns(v) / n / 1e9,
+                                 trace_id=tid)
+                    observe_hist("blaze_program_dispatch_seconds",
+                                 v.get("dispatch_ns", 0) / n / 1e9,
+                                 trace_id=tid)
+
+
+# ------------------------------------------------------------ healthz
+
+#: golden-pinned keys of the /healthz ``service`` admission block —
+#: load balancers key drain decisions on these (tests/test_telemetry.py
+#: gates the shape both ways; add keys freely, never rename)
+HEALTHZ_SERVICE_KEYS = ("running", "queued", "max_concurrent",
+                        "max_queued", "shed_total", "quota_cancelled",
+                        "accepting")
+
+
+def healthz_doc() -> Dict[str, Any]:
+    """The /healthz response body.  With an active query service the
+    ``service`` block carries the admission state — queue depth,
+    running count, cumulative shed totals, and an ``accepting`` verdict
+    — so a load balancer can drain a saturated node BEFORE submissions
+    start bouncing off 429s."""
+    doc: Dict[str, Any] = {
+        "status": "ok",
+        "endpoints": ["/metrics", "/queries", "/queries?all=1",
+                      "/queries/<id>/profile", "/healthz",
+                      "POST /queries/<id>/cancel",
+                      "POST /service/submit"],
+    }
+    svc = _service_stats()
+    if svc is not None:
+        counters = svc.get("counters", {})
+        doc["service"] = {
+            "running": svc["running"],
+            "queued": svc["queued"],
+            "max_concurrent": svc["max_concurrent"],
+            "max_queued": svc["max_queued"],
+            "shed_total": counters.get("queries_rejected", 0),
+            "quota_cancelled": counters.get("queries_quota_cancelled", 0),
+            # a node with free run slots OR queue headroom still admits;
+            # False = the next submission sheds with a 429
+            "accepting": (svc["running"] < svc["max_concurrent"]
+                          or svc["queued"] < svc["max_queued"]),
+        }
+    return doc
 
 
 # --------------------------------------------------- prometheus render
@@ -1047,10 +1378,17 @@ class _PromDoc:
         return "\n".join(lines) + "\n"
 
 
-def render_prometheus() -> str:
+def render_prometheus(openmetrics: bool = False) -> str:
     """/metrics: the scheduler MetricNode tree of the most recent run,
     the process-global dispatch counters, and the live registry, as
-    Prometheus text exposition format."""
+    Prometheus text exposition format.
+
+    ``openmetrics`` renders the OpenMetrics dialect instead: histogram
+    buckets carry their trace-id **exemplars** and the body ends with
+    ``# EOF``.  Exemplar syntax is OpenMetrics-ONLY — a classic
+    text-format (0.0.4) scrape that met a ``#`` after the sample value
+    would reject the ENTIRE scrape, so the server negotiates via the
+    Accept header and the default stays exemplar-free."""
     from . import dispatch, scheduler
 
     doc = _PromDoc()
@@ -1115,6 +1453,9 @@ def render_prometheus() -> str:
                     doc.add(f"blaze_query_stage_{k}", v, sl, mtype="gauge")
     doc.add("blaze_mem_used_bytes", snap["memory"]["used"], mtype="gauge")
     doc.add("blaze_mem_total_bytes", snap["memory"]["total"], mtype="gauge")
+    hist_text = _render_histograms(exemplars=openmetrics)
+    if openmetrics:
+        hist_text += "# EOF\n"
     # multi-tenant service (runtime/service.py): admission counters +
     # per-pool gauges, so a dashboard sees shedding and fair-share
     # drift without scraping /queries
@@ -1150,7 +1491,30 @@ def render_prometheus() -> str:
                         mtype="gauge")
             doc.add("blaze_service_pool_mem_used_bytes",
                     pool_mem.get(name, 0), pl, mtype="gauge")
-    return doc.render()
+    return doc.render() + hist_text
+
+
+def _render_histograms(exemplars: bool = False) -> str:
+    """The latency histograms as text exposition: cumulative
+    ``_bucket{le=}`` samples plus ``_sum``/``_count``.  With
+    ``exemplars`` (the OpenMetrics dialect) each bucket carries its
+    latest exemplar's ``trace_id``, so a bad bucket links straight to
+    the distributed trace that landed in it."""
+    lines: List[str] = []
+    for snap in histograms_snapshot():
+        name = snap["name"]
+        lines.append(f"# TYPE {name} histogram")
+        for i, (bound, cum) in enumerate(snap["buckets"]):
+            le = "+Inf" if bound == float("inf") else format(bound, "g")
+            line = f'{name}_bucket{{le="{le}"}} {cum}'
+            ex = snap["exemplars"].get(i)
+            if exemplars and ex is not None:
+                tid, val, ts = ex
+                line += f' # {{trace_id="{tid}"}} {val:.6g} {ts:.3f}'
+            lines.append(line)
+        lines.append(f"{name}_sum {snap['sum']:.6g}")
+        lines.append(f"{name}_count {snap['count']}")
+    return ("\n".join(lines) + "\n") if lines else ""
 
 
 # ----------------------------------------------------------- the server
@@ -1177,10 +1541,18 @@ class MonitorServer:
 
             def do_GET(self):  # noqa: N802 — http.server contract
                 path, _, query_s = self.path.partition("?")
+                prof = re.match(r"^/queries/([^/]+)/profile$", path)
                 try:
                     if path == "/metrics":
-                        body = render_prometheus().encode()
-                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                        # content negotiation: exemplars are an
+                        # OpenMetrics-only syntax — a 0.0.4 scraper
+                        # that met one would reject the whole scrape
+                        om = "application/openmetrics-text" in \
+                            (self.headers.get("Accept") or "")
+                        body = render_prometheus(openmetrics=om).encode()
+                        ctype = ("application/openmetrics-text; "
+                                 "version=1.0.0; charset=utf-8" if om else
+                                 "text/plain; version=0.0.4; charset=utf-8")
                     elif path == "/queries":
                         # ?all=1 merges the persisted JSONL history
                         # (spark.blaze.monitor.historyDir) — finished
@@ -1189,14 +1561,17 @@ class MonitorServer:
                         body = json.dumps(
                             snapshot(include_history=include_all)).encode()
                         ctype = "application/json"
+                    elif prof is not None:
+                        # collapsed-stack flame profile of one query
+                        # (consumable by flamegraph.pl / speedscope)
+                        text = render_profile(prof.group(1))
+                        if text is None:
+                            self.send_error(404)
+                            return
+                        body = text.encode()
+                        ctype = "text/plain; charset=utf-8"
                     elif path in ("/", "/healthz"):
-                        body = json.dumps({
-                            "status": "ok",
-                            "endpoints": ["/metrics", "/queries",
-                                          "/queries?all=1", "/healthz",
-                                          "POST /queries/<id>/cancel",
-                                          "POST /service/submit"],
-                        }).encode()
+                        body = json.dumps(healthz_doc()).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)
@@ -1232,6 +1607,12 @@ class MonitorServer:
                     try:
                         n = int(self.headers.get("Content-Length", 0) or 0)
                         doc = json.loads(self.rfile.read(n) or b"{}")
+                        # W3C trace-context propagation: a traceparent
+                        # HEADER continues the caller's trace (the
+                        # body key wins when both are present)
+                        tp = self.headers.get("traceparent", "")
+                        if tp and not doc.get("traceparent"):
+                            doc["traceparent"] = tp
                         status, out = service_mod.http_submit(doc)
                     except Exception as e:  # noqa: BLE001 — 500, not
                         # a dead handler thread
@@ -1343,7 +1724,12 @@ _LABEL_VAL = re.compile(r'[a-zA-Z0-9_:]+="([^"]*)"')
 def render_statsd_lines() -> List[str]:
     """The /metrics rendering converted to statsd gauge lines
     (``name[.label-values]:value|g``) — one source of numbers, two
-    transports, so the push loop can never drift from the scrape."""
+    transports, so the push loop can never drift from the scrape —
+    plus the queued ``|ms`` TIMER samples (query latency, admission
+    queue wait): statsd timers aggregate into percentiles server-side,
+    so each recorded sample is DRAINED here and pushes exactly once.
+    Histogram ``_bucket`` series stay off the gauge lines (the timer
+    events are their statsd-native transport)."""
     out: List[str] = []
     for line in render_prometheus().splitlines():
         if not line or line.startswith("#"):
@@ -1352,10 +1738,14 @@ def render_statsd_lines() -> List[str]:
         if m is None:
             continue
         name, _, labels, value = m.groups()
+        if name.endswith("_bucket"):
+            continue
         if labels:
             for v in _LABEL_VAL.findall(labels):
                 name += "." + re.sub(r"[^a-zA-Z0-9_\-]", "_", v)
         out.append(f"{name}:{value}|g")
+    for name, ms in drain_timers():
+        out.append(f"{name}:{round(ms, 3)}|ms")
     return out
 
 
@@ -1511,6 +1901,11 @@ def render_watch(snap: Dict[str, Any], url: str = "") -> str:
         head += (f"  mem {_human_bytes(mem.get('used', 0))}"
                  f"/{_human_bytes(mem['total'])}")
     lines.append(head)
+    lat = (snap.get("latency") or {}).get("blaze_query_latency_seconds")
+    if lat:
+        lines.append(
+            f"latency: p50 {lat['p50']:.3g}s  p95 {lat['p95']:.3g}s  "
+            f"p99 {lat['p99']:.3g}s  ({lat['count']} queries)")
     svc = snap.get("service")
     if svc:
         c = svc.get("counters", {})

@@ -1,0 +1,524 @@
+"""OpenTelemetry (OTLP/JSON) span export for traced queries.
+
+≙ the export half of the reference engine's metrics story: Blaze
+plumbs native metrics back into the Spark UI (PAPER §metrics); this
+engine's PR 3 event log and PR 5/11 monitor are the in-process half,
+and this module is the standards-facing half — each traced query's
+JSONL event log is mapped onto an **OTLP/JSON span tree**
+
+    query -> stage -> task attempt -> operator kernel
+
+carrying one W3C trace id end to end (runtime/trace.py trace context),
+so a Jaeger/Tempo/any-OTLP collector renders the same profile
+``--report`` does, stitched across the driver, worker subprocesses,
+and the multi-tenant service.
+
+Two sinks, both best-effort:
+
+- **file sink** — one ``<query>-<pid>-spans.json`` OTLP/JSON document
+  per traced query under ``spark.blaze.otel.dir``;
+- **HTTP push** — ``spark.blaze.otel.endpoint`` (an OTLP/HTTP
+  collector's ``/v1/traces``) arms a ``blaze-otel-push`` daemon loop
+  next to the statsd pusher: exported documents queue (bounded) and
+  POST with a short timeout; a dead collector costs nothing and the
+  workload never blocks on its own telemetry.
+
+Span ids are DETERMINISTIC (``trace.span_id_for``): the driver and a
+worker subprocess derive identical stage/task span ids from the shared
+trace id, so independently-written event-log segments convert into one
+parent-linked tree with no cross-process handshake.
+
+Disarmed (``spark.blaze.otel.enabled=false``, the default) the module
+is a structural no-op exactly like ``trace.enabled()``: the query-span
+exit hook is one bool read, no conversion, no file, no thread — pinned
+by the poisoned-export gate in tests/test_otel.py.
+
+The exported key shape is API (collectors and dashboards parse it):
+the golden registry ``otel_schema.json`` next to this file pins it,
+and tests/test_otel.py gates the drift both ways like
+``trace_schema.json``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional
+
+from .. import conf
+from ..analysis.locks import make_lock
+from . import lockset, trace
+
+SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "otel_schema.json")
+
+#: golden OTLP/JSON key sets — MUST stay in lockstep with
+#: otel_schema.json (tests/test_otel.py gates the drift both ways);
+#: add keys freely, never rename or remove
+OTLP_TOP_KEYS = ("resourceSpans",)
+OTLP_RESOURCE_SPAN_KEYS = ("resource", "scopeSpans")
+OTLP_SCOPE_SPAN_KEYS = ("scope", "spans")
+OTLP_SPAN_KEYS = ("traceId", "spanId", "parentSpanId", "name", "kind",
+                  "startTimeUnixNano", "endTimeUnixNano", "status",
+                  "attributes")
+OTLP_STATUS_KEYS = ("code",)
+OTLP_ATTRIBUTE_KEYS = ("key", "value")
+
+SCOPE_NAME = "blaze_tpu.runtime.trace"
+
+#: OTLP span status codes (STATUS_CODE_* in the OTLP proto)
+STATUS_OK = 1
+STATUS_ERROR = 2
+
+# --------------------------------------------------------------- state
+
+_lock = make_lock("otel.state")
+_OTEL = lockset.module_guard(__name__)
+
+#: guarded-by declaration (analysis/guarded.py): the export queue and
+#: pusher slot are shared between query threads (export at span exit)
+#: and the push loop; _armed/_endpoint/_dir/_flush_ns are load-once
+#: config reads and stay undeclared like trace._armed
+GUARDED_BY = {"_QUEUE": "otel.state",
+              "_PUSHER": "otel.state",
+              "_exports": "otel.state"}
+GUARDED_REFS = ("_QUEUE",)
+
+_loaded = False
+_armed = False
+_endpoint = ""
+_dir = ""
+_flush_ns = 1_000_000_000
+#: bounded push queue: a dead collector must cost memory O(1), not
+#: O(queries) — oldest documents drop first
+_QUEUE: List[Dict[str, Any]] = []
+_MAX_QUEUE = 64
+_PUSHER: Optional["_OtelPusher"] = None
+_exports = 0  # introspection for the structural no-op gate
+
+
+def _load() -> None:
+    global _loaded, _armed, _endpoint, _dir, _flush_ns
+    with _lock:
+        _armed = bool(conf.OTEL_ENABLE.get())
+        _endpoint = str(conf.OTEL_ENDPOINT.get() or "")
+        d = str(conf.OTEL_DIR.get() or "")
+        _dir = d or os.path.join(tempfile.gettempdir(), "blaze_otel")
+        _flush_ns = max(1, int(conf.OTEL_FLUSH_MS.get())) * 1_000_000
+        _loaded = True
+
+
+def enabled() -> bool:
+    """OTLP export armed (conf ``spark.blaze.otel.enabled``)?  Lazily
+    loads conf once; call :func:`reset` after flipping it."""
+    if not _loaded:
+        _load()
+    return _armed
+
+
+def reset() -> None:
+    """(Re)load arming/endpoint/dir from conf, clear the push queue,
+    and stop any running pusher — call after changing
+    ``spark.blaze.otel.*`` keys."""
+    global _exports
+    shutdown_pusher()
+    _load()
+    with _lock:
+        lockset.check(_OTEL, "_QUEUE", "_exports")
+        _QUEUE.clear()
+        _exports = 0
+
+
+def counters() -> Dict[str, int]:
+    """Introspection for the structural no-op gate: exports since the
+    last :func:`reset` (+ the pusher's push/error tallies)."""
+    with _lock:
+        lockset.check(_OTEL, "_exports", "_QUEUE", "_PUSHER")
+        out = {"exports": _exports, "queued": len(_QUEUE)}
+        pusher = _PUSHER
+    out["pushes"] = pusher.pushes if pusher is not None else 0
+    out["push_errors"] = pusher.errors if pusher is not None else 0
+    return out
+
+
+def export_dir() -> str:
+    if not _loaded:
+        _load()
+    return _dir
+
+
+# ---------------------------------------------------- OTLP conversion
+
+def _attr(key: str, value: Any) -> Dict[str, Any]:
+    """One OTLP KeyValue (ints as strings per the OTLP/JSON mapping)."""
+    if isinstance(value, bool):
+        val: Dict[str, Any] = {"boolValue": value}
+    elif isinstance(value, int):
+        val = {"intValue": str(value)}
+    elif isinstance(value, float):
+        val = {"doubleValue": value}
+    else:
+        val = {"stringValue": str(value)}
+    return {"key": key, "value": val}
+
+
+def _span(tid: str, span_id: str, parent: Optional[str], name: str,
+          start_ns: float, end_ns: float,
+          attrs: Optional[Dict[str, Any]] = None,
+          status_code: int = STATUS_OK,
+          message: str = "") -> Dict[str, Any]:
+    status: Dict[str, Any] = {"code": int(status_code)}
+    if message:
+        status["message"] = message
+    return {
+        "traceId": tid,
+        "spanId": span_id,
+        "parentSpanId": parent or "",
+        "name": name,
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(int(start_ns)),
+        "endTimeUnixNano": str(int(max(start_ns, end_ns))),
+        "status": status,
+        "attributes": [_attr(k, v) for k, v in (attrs or {}).items()],
+    }
+
+
+def _fallback_trace_id(query_id: str) -> str:
+    """Deterministic 32-hex trace id for a pre-trace-context log (no
+    ``trace_id`` on its events) — old segments still export."""
+    return hashlib.sha256(f"query:{query_id}".encode()).hexdigest()[:32]
+
+
+def events_to_otlp(events: List[Dict[str, Any]],
+                   service_name: str = "blaze-tpu") -> Dict[str, Any]:
+    """Map a parsed event list (one query's log, or several processes'
+    segments merged by ``trace_report.merge_event_logs``) onto one
+    OTLP/JSON document.  Pure function: tests and both sinks share it.
+
+    Spans built: a root span per ``query_start``/``query_end`` pair,
+    a stage span per ``stage_complete`` (submit-aligned start), a task
+    span per ``task_attempt_start``/``end`` pair — plus, for worker
+    segments that carry only ``task_kernels`` (the subprocess never
+    sees the scheduler's attempt events), a task span derived from the
+    kernel event's wall time — and a kernel span per stage-level
+    kernel label (duration = its attributed device+dispatch+compile
+    time, flagged ``blaze.synthetic_timing`` since kernel events carry
+    durations, not timestamps)."""
+    from .trace_report import by_type as _by_type
+
+    by_type = _by_type(events)
+    last_ts = max((e.get("ts", 0.0) for e in events), default=0.0)
+    spans: List[Dict[str, Any]] = []
+
+    # ---- query root spans
+    known_tids: List[str] = []
+    #: trace id -> query root span id (the structural parent of stage
+    #: and orphan-task spans; built once — a per-span scan of the
+    #: growing span list would make conversion O(spans^2))
+    query_roots: Dict[str, str] = {}
+    ends = list(by_type.get("query_end", []))
+    for e in by_type.get("query_start", []):
+        qid = e.get("query_id", "?")
+        tid = e.get("trace_id") or _fallback_trace_id(qid)
+        if tid not in known_tids:
+            known_tids.append(tid)
+        end = None
+        for x in ends:
+            if x.get("query_id") == qid and \
+                    (x.get("trace_id") or _fallback_trace_id(qid)) == tid:
+                end = x
+                break
+        if end is not None:
+            ends.remove(end)
+        status = (end or {}).get("status", "ok")
+        query_roots.setdefault(
+            tid, trace.span_id_for(tid, f"query:{qid}"))
+        attrs = {"blaze.query_id": qid, "blaze.status": status}
+        if end is not None and "wall_ns" in end:
+            attrs["blaze.wall_ns"] = end["wall_ns"]
+        spans.append(_span(
+            tid, trace.span_id_for(tid, f"query:{qid}"),
+            e.get("parent_span_id"), f"query:{qid}",
+            e.get("ts", 0.0) * 1e9,
+            (end.get("ts", last_ts) if end else last_ts) * 1e9,
+            attrs=attrs,
+            status_code=STATUS_OK if status == "ok" else STATUS_ERROR,
+            message="" if status == "ok" else status))
+
+    def event_tid(e: Dict[str, Any]) -> Optional[str]:
+        """The trace an event belongs to: its own trace_id, else the
+        log's single query (a pre-context segment)."""
+        tid = e.get("trace_id")
+        if tid is None and len(known_tids) == 1:
+            tid = known_tids[0]
+        return tid
+
+    # ---- stage spans (+ per-label kernel spans)
+    submits = {(event_tid(e), e.get("stage_id")): e
+               for e in by_type.get("stage_submit", [])}
+    for e in by_type.get("stage_complete", []):
+        tid = event_tid(e)
+        if tid is None:
+            continue
+        sid = e.get("stage_id", 0)
+        sub = submits.get((tid, sid))
+        end_ns = e.get("ts", last_ts) * 1e9
+        start_ns = (sub["ts"] * 1e9 if sub is not None
+                    else end_ns - e.get("wall_ns", 0))
+        stage_span_id = trace.span_id_for(tid, f"stage:{sid}")
+        status = e.get("status", "ok")
+        spans.append(_span(
+            tid, stage_span_id, query_roots.get(tid, ""),
+            f"stage:{sid}", start_ns, end_ns,
+            attrs={"blaze.kind": e.get("kind", "?"),
+                   "blaze.n_tasks": e.get("n_tasks", 0),
+                   "blaze.programs": e.get("programs", 0),
+                   "blaze.device_time_ns": e.get("device_time_ns", 0),
+                   "blaze.dispatch_overhead_ns":
+                       e.get("dispatch_overhead_ns", 0),
+                   "blaze.compile_ns": e.get("compile_ns", 0)},
+            status_code=STATUS_OK if status == "ok" else STATUS_ERROR,
+            message="" if status == "ok" else status))
+        for label, v in (e.get("kernels") or {}).items():
+            dur = (trace.scaled_device_ns(v) + v.get("dispatch_ns", 0)
+                   + v.get("compile_ns", 0))
+            spans.append(_span(
+                tid, trace.span_id_for(tid, f"stage:{sid}/kernel:{label}"),
+                stage_span_id, f"kernel:{label}",
+                start_ns, start_ns + dur,
+                attrs={"blaze.programs": v.get("programs", 0),
+                       "blaze.device_ns": v.get("device_ns", 0),
+                       "blaze.dispatch_ns": v.get("dispatch_ns", 0),
+                       "blaze.compile_ns": v.get("compile_ns", 0),
+                       # kernel events carry attributed DURATIONS, not
+                       # timestamps: the span's placement is synthetic
+                       "blaze.synthetic_timing": True}))
+
+    # ---- task spans: attempt pairs first, then worker-only kernels
+    stage_span_ids = {s["spanId"] for s in spans
+                      if s["name"].startswith("stage:")}
+
+    def task_parent(tid: str, stage_id) -> str:
+        """A task's structural parent: its stage span — falling back to
+        the query root when this log carries no stage events (a worker
+        segment converted alone, or a driver that died pre-stage), so
+        the tree never dangles."""
+        sid = trace.span_id_for(tid, f"stage:{stage_id}")
+        return sid if sid in stage_span_ids else query_roots.get(tid, "")
+
+    seen_tasks = set()
+    task_ends = {}
+    for e in by_type.get("task_attempt_end", []):
+        task_ends[(event_tid(e), e.get("stage_id"), e.get("task"),
+                   e.get("attempt"))] = e
+    for e in by_type.get("task_attempt_start", []):
+        tid = event_tid(e)
+        if tid is None:
+            continue
+        key = (tid, e.get("stage_id"), e.get("task"), e.get("attempt"))
+        seen_tasks.add(key)
+        end = task_ends.get(key)
+        status = (end or {}).get("status", "ok")
+        name = f"task:{key[1]}.{key[2]}#a{key[3]}"
+        spans.append(_span(
+            tid, trace.span_id_for(tid, name),
+            task_parent(tid, key[1]), name,
+            e.get("ts", 0.0) * 1e9,
+            (end.get("ts", last_ts) if end else last_ts) * 1e9,
+            attrs={"blaze.attempt": e.get("attempt", 0)},
+            status_code=STATUS_OK if status == "ok" else STATUS_ERROR,
+            message=(end or {}).get("error", "") if status != "ok" else ""))
+    for e in by_type.get("task_kernels", []):
+        tid = event_tid(e)
+        if tid is None:
+            continue
+        key = (tid, e.get("stage_id"), e.get("partition"),
+               e.get("attempt"))
+        if key in seen_tasks:
+            continue  # the driver's attempt pair already covers it
+        seen_tasks.add(key)
+        end_ns = e.get("ts", last_ts) * 1e9
+        name = f"task:{key[1]}.{key[2]}#a{key[3]}"
+        spans.append(_span(
+            tid, trace.span_id_for(tid, name),
+            task_parent(tid, key[1]), name,
+            end_ns - e.get("wall_ns", 0), end_ns,
+            attrs={"blaze.attempt": e.get("attempt", 0),
+                   "blaze.programs": e.get("programs", 0),
+                   "blaze.device_time_ns": e.get("device_time_ns", 0),
+                   "blaze.dispatch_overhead_ns":
+                       e.get("dispatch_overhead_ns", 0),
+                   "blaze.process": "worker"}))
+
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": [
+                _attr("service.name", service_name),
+                _attr("process.pid", os.getpid()),
+            ]},
+            "scopeSpans": [{
+                "scope": {"name": SCOPE_NAME, "version": "1"},
+                "spans": spans,
+            }],
+        }],
+    }
+
+
+def span_index(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Flat span list out of an OTLP document (test/report helper)."""
+    out: List[Dict[str, Any]] = []
+    for rs in doc.get("resourceSpans", []):
+        for ss in rs.get("scopeSpans", []):
+            out.extend(ss.get("spans", []))
+    return out
+
+
+def load_schema() -> Dict[str, Any]:
+    """The golden OTLP key schema (otel_schema.json)."""
+    with open(SCHEMA_PATH) as f:
+        return json.load(f)
+
+
+# --------------------------------------------------------------- sinks
+
+def export_query(query_id: str, log_path: str) -> Optional[str]:
+    """Convert one finished query's event log to OTLP/JSON, write the
+    file sink, and (when an endpoint is configured) queue the HTTP
+    push.  Called by ``monitor.query_span`` at span exit; best-effort
+    end to end — telemetry must never take down the workload it
+    records.  Returns the sink path (None when disarmed or nothing
+    exported)."""
+    if not enabled():
+        return None
+    try:
+        events = trace.read_event_log(log_path)
+    except OSError:
+        return None
+    if not events:
+        return None
+    doc = events_to_otlp(events)
+    path: Optional[str] = None
+    try:
+        os.makedirs(_dir, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in query_id)
+        path = os.path.join(_dir, f"{safe}-{os.getpid()}-spans.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    except OSError:
+        path = None
+    global _exports
+    want_pusher = False
+    with _lock:
+        lockset.check(_OTEL, "_exports", "_QUEUE", "_PUSHER")
+        _exports += 1
+        if _endpoint:
+            while len(_QUEUE) >= _MAX_QUEUE:
+                _QUEUE.pop(0)
+            _QUEUE.append(doc)
+            want_pusher = _PUSHER is None
+    if want_pusher:
+        _ensure_pusher()
+    return path
+
+
+def drain_queue() -> List[Dict[str, Any]]:
+    """Take every queued document (the pusher's — and tests' — drain)."""
+    with _lock:
+        lockset.check(_OTEL, "_QUEUE")
+        docs = list(_QUEUE)
+        _QUEUE.clear()
+    return docs
+
+
+class _OtelPusher:
+    """Best-effort OTLP/HTTP push loop (``spark.blaze.otel.endpoint``):
+    every flush interval the queued span documents POST to the
+    collector from a ``blaze-otel-push`` daemon thread with a short
+    timeout.  Fire-and-forget by design, like the statsd pusher — a
+    dead collector costs one connection failure per flush."""
+
+    #: audited deliberately-unlocked (analysis/guarded.py): tallies are
+    #: written only by the single loop thread; readers tolerate a
+    #: one-tick-stale value
+    LOCK_FREE = {"pushes": "single-writer loop thread",
+                 "errors": "single-writer loop thread"}
+
+    def __init__(self, endpoint: str, flush_ns: int):
+        self._endpoint = endpoint
+        self._interval = flush_ns / 1e9
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="blaze-otel-push")
+        self.pushes = 0
+        self.errors = 0
+
+    def start(self) -> "_OtelPusher":
+        self._thread.start()
+        return self
+
+    def _post(self, doc: Dict[str, Any]) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._endpoint, data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=2) as r:
+                r.read()
+            self.pushes += 1
+        except OSError:
+            self.errors += 1  # best-effort: never surface to the workload
+
+    def _flush_once(self) -> None:
+        for doc in drain_queue():
+            if self._stop.is_set():
+                return
+            self._post(doc)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._flush_once()
+            except Exception:  # noqa: BLE001 — telemetry must not die
+                pass
+        # final drain so a clean shutdown doesn't strand queued spans
+        try:
+            self._flush_once()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def _ensure_pusher() -> None:
+    global _PUSHER
+    start: Optional[_OtelPusher] = None
+    with _lock:
+        lockset.check(_OTEL, "_PUSHER")
+        if _PUSHER is None and _endpoint:
+            _PUSHER = start = _OtelPusher(_endpoint, _flush_ns)
+    if start is not None:
+        start.start()
+
+
+def shutdown_pusher() -> None:
+    """Stop the push loop (no-op when none is running); after return
+    no ``blaze-otel`` thread is alive."""
+    global _PUSHER
+    with _lock:
+        lockset.check(_OTEL, "_PUSHER")
+        pusher, _PUSHER = _PUSHER, None
+    if pusher is not None:
+        pusher.shutdown()
+
+
+def otel_threads() -> List[threading.Thread]:
+    """Live threads owned by this module — the chaos gate's leak
+    detector (empty after :func:`shutdown_pusher`)."""
+    return [t for t in threading.enumerate()
+            if t.name.startswith("blaze-otel") and t.is_alive()]

@@ -193,6 +193,45 @@ def stage_task_definitions(
     return [build_task(stage, manager, t)[1] for t in range(stage.n_tasks)]
 
 
+def worker_task_spec(
+    stage: Stage,
+    manager: LocalShuffleManager,
+    t: int,
+    attempt: int = 0,
+    n_maps: Optional[Dict[int, int]] = None,
+    output: Optional[str] = None,
+) -> Dict[str, object]:
+    """The ``runtime/worker.py`` job spec for ONE task of a stage —
+    the driver half of the multi-process path (testenv suites,
+    :func:`worker.run_worker_with_retry`): TaskDefinition bytes, the
+    shared shuffle root, this partition's reduce-block readers
+    (``n_maps`` = committed map counts per upstream shuffle id), the
+    result-frame output path for non-map stages, and — when a traced
+    query span is open — the driver's W3C ``traceparent``, so every
+    event the worker subprocess emits into its OWN log carries the
+    driver's trace id and ``--report`` / the OTLP export reconcile the
+    segments into one trace."""
+    import base64
+
+    _, td = build_task(stage, manager, t, attempt)
+    readers = [
+        {"resource_id": f"shuffle_{sid}", "shuffle_id": sid, "n_maps": nm}
+        for sid, nm in sorted((n_maps or {}).items())
+    ]
+    spec: Dict[str, object] = {
+        "task_def": base64.b64encode(td).decode(),
+        "partition": t,
+        "attempt": attempt,
+        "shuffle_root": manager.root,
+        "readers": readers,
+        "output": output,
+    }
+    tp = trace.current_traceparent()
+    if tp:
+        spec["traceparent"] = tp
+    return spec
+
+
 def _compute_range_boundaries(stage: Stage, register_readers,
                               max_rows: int = 1 << 16, scope=None):
     """Driver-side boundary pass for a range-partitioned map stage

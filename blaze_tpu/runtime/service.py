@@ -61,7 +61,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from .. import conf
 from ..analysis.locks import make_lock
-from . import lockset, memmgr, monitor
+from . import lockset, memmgr, monitor, trace
 from .context import QueryCancelledError, cancel_query, current_cancel_scope
 from .metrics import MetricsSet
 
@@ -382,11 +382,16 @@ class QueryHandle:
     streaming variant."""
 
     def __init__(self, query_id: str, exec_id: str, pool: str,
-                 session: str, depth: int):
+                 session: str, depth: int, trace_id: str = ""):
         self.query_id = query_id
         self.exec_id = exec_id
         self.pool = pool
         self.session = session
+        #: the query's W3C trace id — from the submitter's
+        #: ``traceparent`` (HTTP header / submit kwarg) or minted at
+        #: admission, so the queue-wait histogram's exemplar and every
+        #: span of the eventual execution share one id
+        self.trace_id = trace_id
         self.submitted_at = time.monotonic()
         self.status = _QUEUED
         self.error: Optional[BaseException] = None
@@ -488,10 +493,11 @@ class _Submission:
     """Driver-side record of one submitted query (service-lock state)."""
 
     __slots__ = ("handle", "build", "timeout_ms", "quota", "quota_spills",
-                 "quota_cancelled", "started_at")
+                 "quota_cancelled", "started_at", "parent_span")
 
     def __init__(self, handle: QueryHandle, build: Callable,
-                 timeout_ms: Optional[int], quota: int):
+                 timeout_ms: Optional[int], quota: int,
+                 parent_span: Optional[str] = None):
         self.handle = handle
         self.build = build
         self.timeout_ms = timeout_ms
@@ -499,6 +505,7 @@ class _Submission:
         self.quota_spills = 0
         self.quota_cancelled = False
         self.started_at: Optional[float] = None
+        self.parent_span = parent_span  # upstream traceparent span id
 
 
 # ------------------------------------------------------------ service
@@ -595,14 +602,25 @@ class QueryService:
 
     def submit(self, query_id: str, build: Callable,
                pool: str = DEFAULT_POOL, session: str = "",
-               timeout_ms: Optional[int] = None) -> QueryHandle:
+               timeout_ms: Optional[int] = None,
+               traceparent: Optional[str] = None) -> QueryHandle:
         """Submit one query (``build`` runs on the worker thread and
         returns the plan).  Admits into a run slot or the bounded
         queue; PAST the bound it raises :class:`QueryRejectedError`
-        synchronously — shed, not accepted-and-wedged."""
+        synchronously — shed, not accepted-and-wedged.
+
+        ``traceparent`` (the W3C header value the HTTP endpoint
+        forwards) continues the SUBMITTER's trace: the query's event
+        log, OTLP spans, and histogram exemplars all carry its trace
+        id, with the exported root span parented under the caller's
+        span.  Omitted (or malformed), a fresh trace id is minted at
+        admission so even the queue wait is traceable."""
         pool = pool or DEFAULT_POOL
         quota = int(conf.get_conf(
             f"spark.blaze.service.pool.{pool}.quota", 0) or 0)
+        ctx = trace.parse_traceparent(traceparent) if traceparent else None
+        trace_id = ctx[0] if ctx is not None else trace.new_trace_id()
+        parent_span = ctx[1] if ctx is not None else None
         with self._lock:
             lockset.check(self, "_queued", "_running", "_subs", "_seq")
             if self._closed:
@@ -612,8 +630,9 @@ class QueryService:
             exec_id = query_id if query_id not in self._subs \
                 else f"{query_id}~{self._seq}"
             handle = QueryHandle(query_id, exec_id, pool, session,
-                                 self.result_depth)
-            sub = _Submission(handle, build, timeout_ms, quota)
+                                 self.result_depth, trace_id=trace_id)
+            sub = _Submission(handle, build, timeout_ms, quota,
+                              parent_span=parent_span)
             self._subs[exec_id] = sub
             if len(self._running) < self.max_concurrent:
                 self._running[exec_id] = sub
@@ -684,6 +703,15 @@ class QueryService:
     def _run_query(self, exec_id: str, sub: _Submission) -> None:
         h = sub.handle
         h.status = _RUNNING
+        # admission queue wait: submission -> run-slot grant, with the
+        # query's trace id as the histogram exemplar (a bad tail bucket
+        # links to the trace of the query that waited) and a statsd
+        # ``|ms`` timer sample next to it
+        waited = max(0.0, (sub.started_at or time.monotonic())
+                     - h.submitted_at)
+        monitor.observe_hist("blaze_admission_wait_seconds", waited,
+                             trace_id=h.trace_id)
+        monitor.record_timer("blaze_admission_wait_ms", waited * 1e3)
         lease = Lease(self.gate, h.pool)
         lease_token = _LEASE.set(lease)
         owner_token = memmgr.set_owner_tag((exec_id, h.pool))
@@ -691,7 +719,9 @@ class QueryService:
         try:
             with monitor.query_span(exec_id, mode="service", pool=h.pool,
                                     session=h.session,
-                                    timeout_ms=sub.timeout_ms):
+                                    timeout_ms=sub.timeout_ms,
+                                    trace_id=h.trace_id,
+                                    parent_span=sub.parent_span):
                 scope = current_cancel_scope()
                 lease.scope = scope
                 plan = sub.build()
@@ -947,9 +977,14 @@ def http_submit(doc: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
     pool = str(doc.get("pool", DEFAULT_POOL) or DEFAULT_POOL)
     session = str(doc.get("session", ""))
     timeout_ms = doc.get("timeout_ms")
+    # W3C trace-context: the monitor handler forwards the submitter's
+    # ``traceparent`` header into the doc, so an HTTP submission's
+    # whole execution joins the caller's distributed trace
+    traceparent = str(doc.get("traceparent", "") or "")
     try:
         handle = svc.submit(name, build, pool=pool, session=session,
-                            timeout_ms=timeout_ms)
+                            timeout_ms=timeout_ms,
+                            traceparent=traceparent or None)
         rows = sum(b.num_rows for b in handle.result())
     except QueryRejectedError as e:
         return e.http_status, {"error": str(e), "reason": e.reason,
@@ -959,4 +994,5 @@ def http_submit(doc: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
     except Exception as e:  # noqa: BLE001 — typed to the HTTP caller
         return 500, {"error": f"{type(e).__name__}: {e}"}
     return 200, {"query": name, "query_id": handle.exec_id, "pool": pool,
-                 "session": session, "rows": rows, "status": handle.status}
+                 "session": session, "rows": rows, "status": handle.status,
+                 "trace_id": handle.trace_id}

@@ -42,12 +42,16 @@ renders the per-query profile.
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import hashlib
 import json
 import os
+import re
 import tempfile
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+import uuid
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from .. import conf
 from ..analysis.locks import make_lock
@@ -147,6 +151,79 @@ LOCK_FREE = {
 #: current_path() serves without taking the log lock
 _current_path: Optional[str] = None
 
+# ------------------------------------------- trace context (W3C style)
+
+#: the distributed-tracing identity every event this context emits
+#: carries: ``(trace_id, span_id)`` — a 32-hex W3C trace id minted
+#: once per query (or accepted from an upstream ``traceparent``) and
+#: the current span's 16-hex id.  A ContextVar so concurrent service
+#: queries on different threads never cross-attribute, and the
+#: speculation runner's ``contextvars.copy_context`` attempt threads
+#: inherit it for free.
+_TRACE_CTX: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("blaze_trace_ctx", default=None)
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def new_trace_id() -> str:
+    """A fresh 32-hex W3C trace id."""
+    return uuid.uuid4().hex
+
+
+def span_id_for(trace_id: str, path: str) -> str:
+    """Deterministic 16-hex span id for a span ``path`` (e.g.
+    ``query:q6`` / ``stage:0`` / ``task:0.1#a0``) within a trace.
+    Deterministic ON PURPOSE: the driver and a worker subprocess
+    derive identical span ids from the shared trace id, so the OTLP
+    conversion of independently-written event-log segments reassembles
+    into ONE parent-linked tree without any cross-process id
+    handshake."""
+    return hashlib.sha256(f"{trace_id}/{path}".encode()).hexdigest()[:16]
+
+
+def current_trace_context() -> Optional[Tuple[str, str]]:
+    """``(trace_id, span_id)`` of the query running on this context
+    (None outside a traced query span)."""
+    return _TRACE_CTX.get()
+
+
+def set_trace_context(trace_id: str, span_id: str):
+    """Install an explicit trace context (worker subprocesses restore
+    the driver's from ``BLAZE_TRACEPARENT``); returns the reset
+    token."""
+    return _TRACE_CTX.set((trace_id, span_id))
+
+
+def reset_trace_context(token) -> None:
+    _TRACE_CTX.reset(token)
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """W3C ``traceparent`` header value (version 00, sampled)."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def current_traceparent() -> Optional[str]:
+    """The ambient trace context as a ``traceparent`` header value —
+    what the driver hands a worker subprocess (env) or a client sends
+    ``POST /service/submit`` (header)."""
+    ctx = _TRACE_CTX.get()
+    if ctx is None:
+        return None
+    return format_traceparent(ctx[0], ctx[1])
+
+
+def parse_traceparent(value: str) -> Optional[Tuple[str, str]]:
+    """``(trace_id, parent_span_id)`` from a W3C ``traceparent`` header
+    value; None when malformed (a bad header must degrade to a fresh
+    trace, never kill the submission)."""
+    m = _TRACEPARENT_RE.match(value.strip().lower()) if value else None
+    if m is None:
+        return None
+    return m.group(1), m.group(2)
+
 
 def _load() -> None:
     global _loaded, _armed, _dir, _sample_rate, _max_bytes
@@ -224,6 +301,13 @@ def emit(etype: str, **fields: Any) -> None:
         raise ValueError(f"unregistered trace event type {etype!r}")
     global _events_emitted, _default_path, _current_path
     rec = {"ts": time.time(), "type": etype}
+    # every event carries the ambient W3C trace id (when a traced
+    # query span is open on this context), so driver, worker
+    # subprocess, and service segments of one query stitch into a
+    # single trace — the cross-process reconciliation key
+    ctx = _TRACE_CTX.get()
+    if ctx is not None and "trace_id" not in fields:
+        rec["trace_id"] = ctx[0]
     rec.update(fields)
     line = json.dumps(rec, default=str)
     global _file
@@ -267,13 +351,26 @@ def emit(etype: str, **fields: Any) -> None:
 
 
 @contextlib.contextmanager
-def query(query_id: str) -> Iterator[Optional[str]]:
+def query(query_id: str, trace_id: Optional[str] = None,
+          parent_span_id: Optional[str] = None) -> Iterator[Optional[str]]:
     """Scope one traced query: opens a fresh JSONL file under the
     event-log dir, emits query_start/query_end around the body, and
-    yields the file path (None when tracing is disarmed)."""
+    yields the file path (None when tracing is disarmed).
+
+    ``trace_id`` continues an upstream trace (a ``traceparent`` header
+    on the service endpoint, a driver's context in a worker); omitted,
+    a fresh W3C trace id is minted.  Either way the context is
+    installed for the scope's duration, so EVERY event emitted under
+    it — scheduler lifecycle, task heartbeats, shuffle/memory events —
+    carries the same ``trace_id``.  ``parent_span_id`` (from the same
+    traceparent) links the exported OTLP root span under the caller's
+    span."""
     if not enabled():
         yield None
         return
+    trace_id = trace_id or new_trace_id()
+    ctx_token = _TRACE_CTX.set(
+        (trace_id, span_id_for(trace_id, f"query:{query_id}")))
     global _path, _seq, _spans_opened, _current_path
     with _lock:
         lockset.check(_LOG, "_path", "_seq", "_spans_opened")
@@ -286,7 +383,11 @@ def query(query_id: str) -> Iterator[Optional[str]]:
         _current_path = _path or _default_path
         _spans_opened += 1
     t0 = time.perf_counter_ns()
-    emit("query_start", query_id=query_id)
+    if parent_span_id:
+        emit("query_start", query_id=query_id,
+             parent_span_id=parent_span_id)
+    else:
+        emit("query_start", query_id=query_id)
     status = "ok"
     try:
         yield path
@@ -301,6 +402,7 @@ def query(query_id: str) -> Iterator[Optional[str]]:
     finally:
         emit("query_end", query_id=query_id, status=status,
              wall_ns=time.perf_counter_ns() - t0)
+        _TRACE_CTX.reset(ctx_token)
         with _lock:
             _path = prev
             _current_path = _path or _default_path

@@ -78,6 +78,113 @@ def by_type(events: List[Dict[str, Any]]) -> Dict[str, List[Dict[str, Any]]]:
     return out
 
 
+# ------------------------------------------- cross-process log merging
+
+def event_log_files(directory: str) -> List[str]:
+    """The event-log segments under ``directory``: every ``*.jsonl``
+    base file, sorted (rotated ``.segN`` pieces ride along through
+    ``read_event_log``, so they are NOT listed separately)."""
+    import glob
+    import os
+
+    return sorted(glob.glob(os.path.join(directory, "*.jsonl")))
+
+
+def merge_event_logs(paths: List[str],
+                     trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Reconcile several processes' event-log segments — the driver's
+    per-query log plus each worker subprocess's own default log — into
+    ONE time-ordered event list.  The shared W3C ``trace_id`` (minted
+    by the driver's query span, threaded into workers via
+    ``BLAZE_TRACEPARENT``) is the join key: pass ``trace_id`` to keep
+    only that query's events (events WITHOUT a trace id — memory
+    watermarks from an untraced helper, pre-context segments — are
+    kept only when no filter is given).  Sort is stable, so same-
+    timestamp events keep their per-file order."""
+    from . import trace as _trace
+
+    events: List[Dict[str, Any]] = []
+    for p in paths:
+        try:
+            events.extend(_trace.read_event_log(p))
+        except OSError:
+            continue
+    if trace_id is not None:
+        events = [e for e in events if e.get("trace_id") == trace_id]
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events
+
+
+# ----------------------------------------------------- flame profiles
+
+def collapsed_stacks(events: List[Dict[str, Any]]) -> List[str]:
+    """The query's device-time profile as COLLAPSED-STACK lines
+    (``frame;frame;frame <value>``, value = microseconds) — the input
+    format of ``flamegraph.pl`` / speedscope / any standard flamegraph
+    tooling (``--report --flame <path>`` writes it).
+
+    Two stack families, both rooted at the query id:
+
+    - ``<query>;stage_<id>_<kind>;<label>;device|dispatch|compile`` —
+      the PR 3 kernel sinks aggregated per stage and operator-kernel
+      label: where the wall went, split hardware-side;
+    - ``<query>;stage_<id>;plan;<op path>`` — the plan-node tree
+      weighted by each node's own ``elapsed_compute``, so the flame
+      also answers WHICH operator in the plan burned the time."""
+    t = by_type(events)
+    qid = next((e.get("query_id", "?") for e in t.get("query_start", [])),
+               "query")
+    agg: Dict[str, int] = {}
+
+    def add(stack: str, ns: int) -> None:
+        if ns > 0:
+            agg[stack] = agg.get(stack, 0) + ns
+
+    from . import trace as _trace
+
+    for e in t.get("stage_complete", []):
+        sid = e.get("stage_id", 0)
+        kind = e.get("kind", "?")
+        for label, v in (e.get("kernels") or {}).items():
+            base = f"{qid};stage_{sid}_{kind};{label}"
+            add(base + ";device", _trace.scaled_device_ns(v))
+            add(base + ";dispatch", v.get("dispatch_ns", 0))
+            add(base + ";compile", v.get("compile_ns", 0))
+
+    plans: Dict[int, Dict[str, Any]] = {}
+    for e in t.get("task_plan", []):
+        sid = e.get("stage_id", 0)
+        plans[sid] = (_merge_plan(plans[sid], e["plan"])
+                      if sid in plans else e["plan"])
+
+    def walk(node: Dict[str, Any], path: str, sid: int) -> None:
+        frame = f"{path};{node.get('op', '?')}"
+        add(frame, int(node.get("metrics", {}).get("elapsed_compute", 0)))
+        for c in node.get("children", []):
+            walk(c, frame, sid)
+
+    for sid, plan in sorted(plans.items()):
+        walk(plan, f"{qid};stage_{sid};plan", sid)
+
+    return [f"{stack} {max(1, ns // 1000)}"
+            for stack, ns in sorted(agg.items())]
+
+
+def write_flame(events: List[Dict[str, Any]], path: str) -> int:
+    """Write the collapsed-stack profile to ``path`` (``-`` = stdout);
+    returns the number of stack lines."""
+    import sys
+
+    lines = collapsed_stacks(events)
+    text = "\n".join(lines) + ("\n" if lines else "")
+    if path == "-":
+        sys.stdout.write(text)
+    else:
+        with open(path, "w") as f:
+            f.write(text)
+    return len(lines)
+
+
 def reconcile_faults(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Pair every ``fault_injected`` with the first subsequent recovery
     event (``task_retry`` or ``map_stage_rerun``) in log order — the
@@ -247,6 +354,11 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "ids": [e.get("query_id", "?") for e in t.get("query_start", [])],
         "status": [e.get("status", "ok") for e in ends],
         "wall_ns": sum(e.get("wall_ns", 0) for e in ends),
+        # the distributed-trace join key (one per query span; a merged
+        # driver+worker log shows each query's segments under ONE id)
+        "trace_ids": sorted({e.get("trace_id")
+                             for e in t.get("query_start", [])
+                             if e.get("trace_id")}),
     }
 
     stages = _stage_rows(events)
@@ -358,10 +470,13 @@ def render(events: List[Dict[str, Any]]) -> str:
     queries = [e.get("query_id", "?") for e in t.get("query_start", [])]
     ends = t.get("query_end", [])
     wall_ns = sum(e.get("wall_ns", 0) for e in ends)
+    tids = sorted({e.get("trace_id") for e in t.get("query_start", [])
+                   if e.get("trace_id")})
     lines.append(
         f"query: {', '.join(queries) if queries else '(no query span)'}"
         + (f"  wall {_fmt_s(wall_ns)}" if wall_ns else "")
         + f"  events {len(events)}"
+        + (f"  trace {', '.join(tids)}" if tids else "")
     )
 
     # ---- per-stage timeline + dispatch-floor split

@@ -83,6 +83,21 @@ def main(spec_path: str) -> int:
         spec = json.load(f)
     partition = int(spec["partition"])
     attempt = int(spec.get("attempt", 0))
+    # cross-process trace-context propagation: the driver's W3C
+    # traceparent (spec key, or BLAZE_TRACEPARENT in the environment —
+    # run_worker_with_retry sets it) restores the SAME trace id in this
+    # subprocess, so the heartbeat/kernel events landing in the
+    # worker's own event log reconcile with the driver's segments into
+    # one distributed trace (trace_report.merge_event_logs, the OTLP
+    # export).  A malformed value degrades to an uncorrelated log,
+    # never a dead worker.
+    from . import trace
+
+    tp = str(spec.get("traceparent")
+             or os.environ.get("BLAZE_TRACEPARENT", "") or "")
+    ctx = trace.parse_traceparent(tp) if tp else None
+    if ctx is not None:
+        trace.set_trace_context(*ctx)
     if spec.get("readers"):
         mgr = LocalShuffleManager(spec["shuffle_root"])
         for r in spec["readers"]:
@@ -139,6 +154,14 @@ def run_worker_with_retry(
     if env:
         run_env.update(env)
     run_env.setdefault("JAX_PLATFORMS", "cpu")
+    # thread the driver's trace context into the worker (spec key wins,
+    # then the driver's ambient traced-query span) so every attempt's
+    # subprocess events carry the same trace id
+    from . import trace
+
+    tp = str(spec.get("traceparent") or "") or trace.current_traceparent()
+    if tp:
+        run_env.setdefault("BLAZE_TRACEPARENT", tp)
 
     last_failure: Exception | None = None
     for attempt in range(policy.max_attempts):

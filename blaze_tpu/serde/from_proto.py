@@ -479,8 +479,10 @@ def _instrumented_task_stream(stream, plan, td, attempt: int, on_beat=None):
         # tracing arms the capture, so monitor-only runs report 0/0
         # rather than paying the block-until-ready path
         device_ns = dispatch_ns = 0
+        ksnap = None
         if traced and kc:
-            split = trace.sum_kernels(trace.snapshot_kernels(kc))
+            ksnap = trace.snapshot_kernels(kc)
+            split = trace.sum_kernels(ksnap)
             device_ns = split["device_time_ns"]
             dispatch_ns = split["dispatch_overhead_ns"]
         if traced:
@@ -496,7 +498,10 @@ def _instrumented_task_stream(stream, plan, td, attempt: int, on_beat=None):
                               rows=rows, batches=batches, metrics=metrics,
                               progress_rows=progress_rows,
                               task_id=td.task_id,
-                              device_ns=device_ns, dispatch_ns=dispatch_ns)
+                              device_ns=device_ns, dispatch_ns=dispatch_ns,
+                              # per-label sink snapshot: the live flame
+                              # profile's source (/queries/<id>/profile)
+                              kernels=ksnap)
 
     kc_scope = trace.kernel_capture() if traced else _contextlib.nullcontext({})
     # the beat fires from monitor.tick() — called per operator output

@@ -99,6 +99,7 @@ class BlazeSparkSession:
         self,
         plan_json: Union[str, list, SparkNode],
         query_id: Optional[str] = None,
+        traceparent: Optional[str] = None,
     ) -> Dict[str, List[Any]]:
         """Convert and run to completion, collecting all partitions
         (driver-side collect; ≙ executeNativePlan + row iterator).
@@ -108,8 +109,12 @@ class BlazeSparkSession:
         all partitions): with tracing armed the run leaves an event log
         ``--report`` renders identically to a scheduler run, and with
         the live monitor armed it is observable mid-flight via
-        ``/queries`` — both structural no-ops when disarmed."""
-        from ..runtime import monitor
+        ``/queries`` — both structural no-ops when disarmed.
+
+        ``traceparent`` (a W3C header value) continues the caller's
+        distributed trace — the embedding JVM gateway forwards the
+        Spark job's trace context through here."""
+        from ..runtime import monitor, trace
 
         plan = self.plan(plan_json)
         query_id = query_id or f"session_execute_{next(_QUERY_SEQ)}"
@@ -120,7 +125,10 @@ class BlazeSparkSession:
             for k in out:
                 out[k].extend(d[k])
 
-        with monitor.query_span(query_id, mode="in-process"):
+        ctx = trace.parse_traceparent(traceparent) if traceparent else None
+        with monitor.query_span(query_id, mode="in-process",
+                                trace_id=ctx[0] if ctx else None,
+                                parent_span=ctx[1] if ctx else None):
             monitor.drive_result_stage(plan, collect)
         return out
 
@@ -153,6 +161,7 @@ class BlazeSparkSession:
         self,
         plan_json: Union[str, list, SparkNode],
         query_id: Optional[str] = None,
+        traceparent: Optional[str] = None,
     ) -> Dict[str, List[Any]]:
         """Run through the stage scheduler: every task crosses the
         TaskDefinition protobuf boundary and every exchange goes
@@ -160,7 +169,7 @@ class BlazeSparkSession:
         driven in one process (≙ dev/testenv pseudo-distributed).
         Wrapped in the same query span as :meth:`execute`; per-stage
         spans come from the scheduler itself."""
-        from ..runtime import monitor
+        from ..runtime import monitor, trace
         from ..runtime.scheduler import run_stages, split_stages
 
         plan = self.plan(plan_json)
@@ -168,7 +177,10 @@ class BlazeSparkSession:
         stages, manager = split_stages(plan)
         schema = stages[-1].plan.schema
         out: Dict[str, List[Any]] = {f.name: [] for f in schema.fields}
-        with monitor.query_span(query_id, mode="scheduler"):
+        ctx = trace.parse_traceparent(traceparent) if traceparent else None
+        with monitor.query_span(query_id, mode="scheduler",
+                                trace_id=ctx[0] if ctx else None,
+                                parent_span=ctx[1] if ctx else None):
             for b in run_stages(stages, manager):
                 d = batch_to_pydict(b)
                 for k in out:

@@ -63,6 +63,7 @@ def test_merge_cached_carries_whole_q01_half():
             "q01_device_time_s": 0.8, "q01_dispatch_overhead_s": 0.1,
             "q01_timed": 9,
             "q01_device_kind": "TPU v4", "q01_trace_sample_rate": 1,
+            "q01_trace_id": "a" * 32, "q01_query_id": "bench_1_1",
             "q01_measured_at": "2026-08-01T00:00:00Z"}
     fresh = {"backend": "tpu", "value": 2.0,
              "measured_at": "2026-08-02T00:00:00Z"}

@@ -111,7 +111,10 @@ _PROM_SAMPLE = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                      # metric name
     r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\n]*\""          # first label
     r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\n]*\")*\})?"     # more labels
-    r" -?[0-9.eE+Na-n]+( [0-9]+)?$")                   # value [timestamp]
+    r" -?[0-9.eE+\-Na-n]+( [0-9]+)?"                   # value [timestamp]
+    # OpenMetrics exemplar on histogram buckets: " # {labels} value [ts]"
+    r"( # \{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\n]*\"\}"
+    r" -?[0-9.eE+-]+( [0-9.]+)?)?$")
 _PROM_COMMENT = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
 
 
@@ -275,9 +278,15 @@ def test_metrics_endpoint_renders_scheduler_tree(data, armed_monitor):
     stage_samples = [s for f, ss in fams.items() if f.startswith("blaze_stage_")
                      for s in ss]
     assert any('stage="' in s for s in stage_samples)
-    # every rendered scheduler/stage/dispatch name is a registered one
+    # every rendered scheduler/stage/dispatch name is a registered one;
+    # histogram families are registered under their FULL name (plus the
+    # _bucket/_sum/_count sample suffixes the exposition format adds)
     registered = registered_metric_names()
+    hist_fams = {n + suffix for n in registered
+                 for suffix in ("", "_bucket", "_sum", "_count")}
     for fam in fams:
+        if fam in hist_fams:
+            continue
         for prefix in ("blaze_scheduler_", "blaze_stage_"):
             if fam.startswith(prefix):
                 assert fam[len(prefix):] in registered, fam
@@ -865,19 +874,24 @@ def test_heartbeat_cadence_is_bounded(data, tmp_path):
 def _source_metric_literals():
     """Every metric-name string literal in blaze_tpu source: first-arg
     literals of MetricsSet.add/set/timer and dispatch.record/record_max
-    (+ counter= kwargs)."""
+    (+ counter= kwargs), plus the histogram/timer observation sites
+    (observe_hist / record_timer) that carry full family names."""
     names = set()
+    hist_re = re.compile(
+        r'(?:observe_hist|record_timer)\(\s*"([a-z][a-z_0-9]*)"')
     pkg = os.path.join(REPO, "blaze_tpu")
     for root, _, files in os.walk(pkg):
         for fname in files:
             if not fname.endswith(".py"):
                 continue
+            with open(os.path.join(root, fname)) as f:
+                src = f.read()
+            for m in hist_re.finditer(src):
+                names.add(m.group(1))
             if fname == "monitor.py":
                 # its _PromDoc.add calls carry derived FAMILY names
                 # (blaze_query_*...), not tree metric names
                 continue
-            with open(os.path.join(root, fname)) as f:
-                src = f.read()
             for m in re.finditer(
                     r'(?:\.(?:add|set|timer)\(|record\(|record_max\(|counter=)'
                     r'\s*"([a-z][a-z_0-9]*)"', src):

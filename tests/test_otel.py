@@ -1,0 +1,520 @@
+"""Distributed tracing & OTLP export (tier-1, CPU backend).
+
+1. **Trace context**: W3C traceparent mint/parse/format round-trip,
+   and a traced scheduler run whose EVERY event carries one trace id.
+2. **OTLP golden keys**: otel_schema.json pinned both ways — against
+   the ``OTLP_*`` constants AND a document generated from a real run
+   (the ``trace_schema.json`` pattern for the export shape).
+3. **Span tree**: query → stage → task → kernel spans, deterministic
+   ids, parent links all resolving, error status on failed queries.
+4. **Cross-process propagation** (acceptance): a worker-subprocess
+   segment and a service HTTP submission (``traceparent`` header) both
+   share the driver/submitter's trace id; ``merge_event_logs``
+   reconciles driver + worker segments into one tree.
+5. **Sinks**: file sink per query, HTTP pusher delivers to a live
+   collector and shuts down leak-free; disarmed = structural no-op
+   (poisoned conversion, like the trace-off gate).
+6. **Flame profiles**: collapsed-stack writer format + CLI.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.runtime import monitor, otel, trace, trace_report
+from blaze_tpu.runtime.scheduler import (
+    run_stages, split_stages, worker_task_spec,
+)
+from blaze_tpu.tpch import TPCH_SCHEMAS, build_query
+from blaze_tpu.tpch.datagen import generate_all, table_to_batches
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate_all(0.01)
+
+
+def _scans(data, n_parts=2):
+    return {
+        name: MemoryScanExec(
+            table_to_batches(data[name], TPCH_SCHEMAS[name], n_parts,
+                             batch_rows=16384),
+            TPCH_SCHEMAS[name],
+        )
+        for name in TPCH_SCHEMAS
+    }
+
+
+@pytest.fixture
+def armed(tmp_path):
+    """Tracing + OTLP file sink armed; everything restored after."""
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path / "ev"))
+    trace.reset()
+    conf.OTEL_ENABLE.set(True)
+    conf.OTEL_DIR.set(str(tmp_path / "otel"))
+    otel.reset()
+    try:
+        yield tmp_path
+    finally:
+        otel.shutdown_pusher()
+        conf.OTEL_ENABLE.set(False)
+        conf.OTEL_DIR.set("")
+        conf.OTEL_ENDPOINT.set("")
+        otel.reset()
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+        assert otel.otel_threads() == []
+
+
+def _run_q6(data, query_id="otel_q6"):
+    with monitor.query_span(query_id, mode="scheduler") as log_path:
+        stages, mgr = split_stages(build_query("q6", _scans(data), 2))
+        rows = sum(b.num_rows for b in run_stages(stages, mgr))
+    assert rows > 0
+    return log_path
+
+
+# ------------------------------------------------- 1. trace context
+
+def test_traceparent_roundtrip():
+    tid = trace.new_trace_id()
+    sid = trace.span_id_for(tid, "query:q6")
+    tp = trace.format_traceparent(tid, sid)
+    assert trace.parse_traceparent(tp) == (tid, sid)
+    # span ids are deterministic (the cross-process reassembly key)
+    assert trace.span_id_for(tid, "query:q6") == sid
+    assert trace.span_id_for(tid, "stage:0") != sid
+
+
+@pytest.mark.parametrize("bad", [
+    "", "garbage", "00-short-span-01",
+    "00-" + "g" * 32 + "-" + "0" * 16 + "-01",   # non-hex trace id
+    "00-" + "0" * 32 + "-" + "0" * 8 + "-01",    # short span id
+])
+def test_malformed_traceparent_degrades_to_none(bad):
+    assert trace.parse_traceparent(bad) is None
+
+
+def test_every_event_carries_one_trace_id(data, armed):
+    log_path = _run_q6(data, "tid_q6")
+    events = trace.read_event_log(log_path)
+    assert events
+    tids = {e.get("trace_id") for e in events}
+    assert len(tids) == 1 and None not in tids, (
+        f"events without the query's trace id: "
+        f"{sorted({e['type'] for e in events if 'trace_id' not in e})}")
+
+
+def test_explicit_trace_id_and_parent_span_honored(data, armed):
+    tid = trace.new_trace_id()
+    parent = trace.span_id_for(tid, "caller")
+    with monitor.query_span("tid_explicit", mode="scheduler",
+                            trace_id=tid, parent_span=parent) as lp:
+        stages, mgr = split_stages(build_query("q6", _scans(data), 2))
+        assert sum(b.num_rows for b in run_stages(stages, mgr)) > 0
+    events = trace.read_event_log(lp)
+    assert {e.get("trace_id") for e in events} == {tid}
+    start = next(e for e in events if e["type"] == "query_start")
+    assert start["parent_span_id"] == parent
+    # the exported root span links under the caller's span
+    doc = otel.events_to_otlp(events)
+    root = next(s for s in otel.span_index(doc)
+                if s["name"] == "query:tid_explicit")
+    assert root["traceId"] == tid
+    assert root["parentSpanId"] == parent
+
+
+# ------------------------------------------------- 2. golden OTLP keys
+
+def test_otlp_schema_pins_constants_two_way():
+    schema = otel.load_schema()
+    pairs = {
+        "top_level": otel.OTLP_TOP_KEYS,
+        "resource_span": otel.OTLP_RESOURCE_SPAN_KEYS,
+        "scope_span": otel.OTLP_SCOPE_SPAN_KEYS,
+        "span": otel.OTLP_SPAN_KEYS,
+        "status": otel.OTLP_STATUS_KEYS,
+        "attribute": otel.OTLP_ATTRIBUTE_KEYS,
+    }
+    # registry and constants in lockstep, BOTH ways: a key added to one
+    # without the other is drift
+    for name, const in pairs.items():
+        assert list(const) == schema[name], name
+    assert set(schema) - {"title"} == set(pairs)
+
+
+def test_generated_document_matches_golden_keys(data, armed):
+    events = trace.read_event_log(_run_q6(data, "golden_q6"))
+    doc = otel.events_to_otlp(events)
+    assert set(doc) == set(otel.OTLP_TOP_KEYS)
+    for rs in doc["resourceSpans"]:
+        assert set(otel.OTLP_RESOURCE_SPAN_KEYS) <= set(rs)
+        for ss in rs["scopeSpans"]:
+            assert set(otel.OTLP_SCOPE_SPAN_KEYS) <= set(ss)
+            for s in ss["spans"]:
+                # spans carry EXACTLY the golden keys — the export
+                # side of the two-way gate
+                assert set(s) == set(otel.OTLP_SPAN_KEYS), s["name"]
+                assert set(otel.OTLP_STATUS_KEYS) <= set(s["status"])
+                for a in s["attributes"]:
+                    assert set(a) == set(otel.OTLP_ATTRIBUTE_KEYS)
+    json.dumps(doc)  # serializable as-is
+
+
+# ------------------------------------------------- 3. span tree shape
+
+def test_span_tree_query_stage_task_kernel(data, armed):
+    events = trace.read_event_log(_run_q6(data, "tree_q6"))
+    spans = otel.span_index(otel.events_to_otlp(events))
+    assert len({s["traceId"] for s in spans}) == 1
+    kinds = {s["name"].split(":")[0] for s in spans}
+    assert {"query", "stage", "task", "kernel"} <= kinds
+    by_id = {s["spanId"]: s for s in spans}
+    roots = [s for s in spans if not s["parentSpanId"]]
+    assert [s["name"] for s in roots] == ["query:tree_q6"]
+    for s in spans:
+        if s["parentSpanId"]:
+            assert s["parentSpanId"] in by_id, (s["name"], "dangling")
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+        assert s["status"]["code"] == otel.STATUS_OK
+    # kernel spans hang off stage spans; task spans too
+    for s in spans:
+        kind = s["name"].split(":")[0]
+        if kind in ("kernel", "task"):
+            assert by_id[s["parentSpanId"]]["name"].startswith("stage:")
+
+
+def test_failed_query_exports_error_status(armed):
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.runtime import faults
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("v", DataType.int64())])
+    plan = MemoryScanExec(
+        [[batch_from_pydict({"v": [1, 2, 3]}, schema)]], schema)
+    conf.FAULTS_SPEC.set("task.compute@1")
+    conf.TASK_MAX_ATTEMPTS.set(1)  # first failure is terminal
+    faults.reset()
+    try:
+        with pytest.raises(Exception):
+            with monitor.query_span("err_q", mode="scheduler") as lp:
+                stages, mgr = split_stages(plan)
+                list(run_stages(stages, mgr))
+    finally:
+        conf.FAULTS_SPEC.set("")
+        conf.TASK_MAX_ATTEMPTS.set(4)
+        faults.reset()
+    events = trace.read_event_log(lp)
+    root = next(s for s in otel.span_index(otel.events_to_otlp(events))
+                if s["name"] == "query:err_q")
+    assert root["status"]["code"] == otel.STATUS_ERROR
+
+
+# -------------------------------------- 4. cross-process propagation
+
+def test_worker_task_spec_carries_ambient_traceparent(data, armed):
+    stages, mgr = split_stages(build_query("q6", _scans(data), 2))
+    stage = stages[-1]
+    # outside a traced span: no traceparent key
+    spec = worker_task_spec(stage, mgr, 0)
+    assert "traceparent" not in spec
+    with trace.query("spec_q") :
+        ctx = trace.current_trace_context()
+        spec = worker_task_spec(stage, mgr, 0, output="/tmp/out.frames")
+    assert trace.parse_traceparent(spec["traceparent"])[0] == ctx[0]
+    assert spec["partition"] == 0 and spec["shuffle_root"] == mgr.root
+
+
+def test_restored_context_attributes_worker_side_events(tmp_path, armed):
+    """The worker mechanism, in-process: run_task under a context
+    restored from a traceparent (what worker.main does) emits events
+    carrying the DRIVER's trace id into this segment's log."""
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.runtime.scheduler import build_task
+    from blaze_tpu.schema import DataType, Field, Schema
+    from blaze_tpu.serde.from_proto import run_task
+
+    schema = Schema([Field("x", DataType.int64())])
+    src = MemoryScanExec(
+        [[batch_from_pydict({"x": list(range(50))}, schema)]], schema)
+    stages, mgr = split_stages(src)
+    driver_tid = trace.new_trace_id()
+    tp = trace.format_traceparent(
+        driver_tid, trace.span_id_for(driver_tid, "query:w"))
+    parsed = trace.parse_traceparent(tp)
+    tok = trace.set_trace_context(*parsed)
+    try:
+        _, td = build_task(stages[-1], mgr, 0)
+        for _ in run_task(td):
+            pass
+    finally:
+        trace.reset_trace_context(tok)
+    # the worker-side events (task_kernels/task_plan in the default
+    # log) carry the driver's trace id
+    default_log = os.path.join(trace.log_dir(),
+                               f"blaze-{os.getpid()}.jsonl")
+    events = [e for e in trace.read_event_log(default_log)
+              if e.get("trace_id") == driver_tid]
+    assert {"task_kernels", "task_plan"} <= {e["type"] for e in events}
+
+
+@pytest.mark.slow
+def test_worker_subprocess_shares_driver_trace_id(tmp_path, armed, data):
+    """THE cross-process acceptance: a real worker SUBPROCESS run under
+    the driver's traceparent writes its own event-log segment whose
+    events carry the driver's trace id; merge_event_logs reconciles
+    driver + worker segments, and the OTLP conversion of the merged
+    stream stays a single parent-linked trace."""
+    import struct
+
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.ops import ParquetScanExec, ParquetSinkExec
+    from blaze_tpu.runtime.context import TaskContext
+    from blaze_tpu.runtime.worker import run_worker_with_retry
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("x", DataType.int64())])
+    src = MemoryScanExec(
+        [[batch_from_pydict({"x": list(range(64))}, schema)]], schema)
+    pq = str(tmp_path / "in.parquet")
+    sink = ParquetSinkExec(src, pq)
+    for _ in sink.execute(0, TaskContext(0, 1)):
+        pass
+    pq = sink.written_files[0] if sink.written_files else pq
+    plan = ParquetScanExec([[pq]], schema)
+
+    worker_logs = str(tmp_path / "wlogs")
+    with monitor.query_span("xproc_q", mode="scheduler") as driver_log:
+        from blaze_tpu.parallel.shuffle import LocalShuffleManager
+
+        stages, mgr = split_stages(
+            plan, LocalShuffleManager(str(tmp_path / "sh")))
+        driver_tid = trace.current_trace_context()[0]
+        spec = worker_task_spec(stages[-1], mgr, 0,
+                                output=str(tmp_path / "r.frames"))
+        assert trace.parse_traceparent(spec["traceparent"])[0] == driver_tid
+        run_worker_with_retry(
+            spec, str(tmp_path), "xp0", max_attempts=2,
+            env={"PYTHONPATH": REPO,
+                 "BLAZE_TRACE_ENABLED": "1",
+                 "BLAZE_EVENTLOG_DIR": worker_logs})
+    assert os.path.exists(str(tmp_path / "r.frames"))
+    wfiles = trace_report.event_log_files(worker_logs)
+    assert wfiles, "worker wrote no event-log segment"
+    worker_events = trace_report.merge_event_logs(wfiles)
+    w_tids = {e.get("trace_id") for e in worker_events}
+    assert w_tids == {driver_tid}, w_tids
+
+    merged = trace_report.merge_event_logs(
+        [driver_log] + wfiles, trace_id=driver_tid)
+    assert {e.get("trace_id") for e in merged} == {driver_tid}
+    assert merged == sorted(merged, key=lambda e: e.get("ts", 0.0))
+    spans = otel.span_index(otel.events_to_otlp(merged))
+    assert {s["traceId"] for s in spans} == {driver_tid}
+    # the worker's task span exists and parents under a driver stage
+    names = {s["name"] for s in spans}
+    assert any(n.startswith("task:") for n in names)
+    by_id = {s["spanId"]: s for s in spans}
+    for s in spans:
+        if s["parentSpanId"]:
+            assert s["parentSpanId"] in by_id, (s["name"], "dangling")
+    # struct import used: keep the linter honest about the frames file
+    raw = open(str(tmp_path / "r.frames"), "rb").read()
+    (ln,) = struct.unpack_from("<I", raw, 0)
+    assert ln > 0
+
+
+def test_service_http_submission_shares_submitter_trace(data, armed):
+    """THE service acceptance: an HTTP submission with a standard
+    ``traceparent`` header yields an execution whose event log, OTLP
+    export, and /metrics histogram exemplar all resolve to the
+    SUBMITTER's trace id (response echoes it)."""
+    from blaze_tpu.runtime import service
+
+    conf.MONITOR_ENABLE.set(True)
+    conf.MONITOR_PORT.set(0)
+    monitor.reset()
+    svc = None
+    try:
+        srv = monitor.ensure_server()
+        svc = service.QueryService().start()
+        scans = _scans(data)
+        service.set_http_builders(
+            {"q6": lambda: build_query("q6", scans, 2)})
+        tid = trace.new_trace_id()
+        parent = trace.span_id_for(tid, "submitter")
+        req = urllib.request.Request(
+            srv.url + "/service/submit",
+            data=json.dumps({"query": "q6", "pool": "etl"}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": trace.format_traceparent(tid, parent)})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+            assert r.status == 200
+        assert out["rows"] > 0
+        assert out["trace_id"] == tid
+
+        # the execution's event log carries the submitter's trace id
+        logs = trace_report.event_log_files(str(armed / "ev"))
+        events = trace_report.merge_event_logs(logs, trace_id=tid)
+        assert events, "no events under the submitter's trace id"
+        start = next(e for e in events if e["type"] == "query_start")
+        assert start["parent_span_id"] == parent
+
+        # the OTLP export is a single tree under that id
+        sink_files = [f for f in os.listdir(str(armed / "otel"))
+                      if f.endswith("-spans.json")]
+        assert sink_files
+        doc = json.load(open(os.path.join(str(armed / "otel"),
+                                          sink_files[-1])))
+        spans = otel.span_index(doc)
+        assert {s["traceId"] for s in spans} == {tid}
+        root = next(s for s in spans if s["name"].startswith("query:"))
+        assert root["parentSpanId"] == parent
+
+        # /metrics histograms expose an exemplar resolving to the trace
+        # (OpenMetrics dialect — exemplar syntax is negotiated)
+        mreq = urllib.request.Request(
+            srv.url + "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(mreq, timeout=10) as r:
+            prom = r.read().decode()
+        assert f'trace_id="{tid}"' in prom
+    finally:
+        if svc is not None:
+            svc.shutdown()
+        monitor.shutdown_server()
+        conf.MONITOR_ENABLE.set(False)
+        conf.MONITOR_PORT.set(4048)
+        monitor.reset()
+
+
+# --------------------------------------------------------- 5. sinks
+
+def test_file_sink_written_per_query(data, armed):
+    _run_q6(data, "sink_q6")
+    files = [f for f in os.listdir(str(armed / "otel"))
+             if f.startswith("sink_q6-")]
+    assert len(files) == 1
+    doc = json.load(open(os.path.join(str(armed / "otel"), files[0])))
+    assert otel.span_index(doc)
+    assert otel.counters()["exports"] >= 1
+
+
+def test_pusher_delivers_and_shuts_down_clean(data, tmp_path):
+    """A live mini-collector receives the POSTed OTLP document; the
+    pusher thread dies with shutdown (the leak gate --chaos also
+    runs)."""
+    import http.server
+
+    received = []
+    done = threading.Event()
+
+    class Collector(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            done.set()
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Collector)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path / "ev"))
+    trace.reset()
+    conf.OTEL_ENABLE.set(True)
+    conf.OTEL_DIR.set(str(tmp_path / "otel"))
+    conf.OTEL_ENDPOINT.set(
+        f"http://127.0.0.1:{httpd.server_address[1]}/v1/traces")
+    conf.OTEL_FLUSH_MS.set(25)
+    otel.reset()
+    try:
+        _run_q6(data, "push_q6")
+        assert done.wait(10), "collector never received a push"
+        spans = otel.span_index(received[0])
+        assert any(s["name"] == "query:push_q6" for s in spans)
+    finally:
+        otel.shutdown_pusher()
+        assert otel.otel_threads() == []
+        httpd.shutdown()
+        httpd.server_close()
+        conf.OTEL_ENABLE.set(False)
+        conf.OTEL_DIR.set("")
+        conf.OTEL_ENDPOINT.set("")
+        conf.OTEL_FLUSH_MS.set(1000)
+        otel.reset()
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+
+
+def test_disarmed_export_is_structural_noop(data, tmp_path, monkeypatch):
+    """With spark.blaze.otel.enabled=false (the default) the span-exit
+    hook never reaches conversion, sinks, or the pusher — poisoned
+    like the trace-off gate."""
+    def poisoned(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("otel path reached while disarmed")
+
+    conf.OTEL_ENABLE.set(False)
+    otel.reset()
+    monkeypatch.setattr(otel, "events_to_otlp", poisoned)
+    monkeypatch.setattr(otel, "_ensure_pusher", poisoned)
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    trace.reset()
+    try:
+        _run_q6(data, "noop_q6")
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+    assert otel.counters()["exports"] == 0
+
+
+# ------------------------------------------------- 6. flame profiles
+
+def test_collapsed_stacks_format_and_writer(data, armed, tmp_path, capsys):
+    events = trace.read_event_log(_run_q6(data, "flame_q6"))
+    lines = trace_report.collapsed_stacks(events)
+    assert lines
+    for ln in lines:
+        stack, _, val = ln.rpartition(" ")
+        assert int(val) >= 1
+        assert stack.startswith("flame_q6;")
+        assert ";" in stack
+    # both families present: kernel splits and the plan-node tree
+    assert any(";device" in ln for ln in lines)
+    assert any(";plan;" in ln for ln in lines)
+    out = str(tmp_path / "flame.txt")
+    n = trace_report.write_flame(events, out)
+    assert n == len(lines)
+    assert open(out).read().splitlines() == lines
+
+
+def test_cli_report_flame_and_directory_merge(data, armed, capsys):
+    import blaze_tpu.__main__ as cli
+
+    log_path = _run_q6(data, "cli_q6")
+    ev_dir = os.path.dirname(log_path)
+    rc = cli.main(["--report", ev_dir, "--flame", "-"])
+    assert rc == 0
+    outp = capsys.readouterr().out
+    assert "cli_q6;" in outp
+    rc = cli.main(["--report", log_path])
+    assert rc == 0
+    assert "trace " in capsys.readouterr().out  # header shows trace id

@@ -1,0 +1,370 @@
+"""Latency histograms, exemplars, healthz admission state, statsd
+timers, and flame-profile endpoint (tier-1, CPU backend).
+
+1. **Histogram correctness** (acceptance): bucket counts vs recorded
+   samples, sum/count, exemplar trace-id round-trip, quantiles.
+2. **/metrics rendering**: OpenMetrics-style histogram families whose
+   bucket exemplars resolve to the run's trace id.
+3. **/queries + --watch**: p50/p95/p99 latency block.
+4. **/healthz**: golden-pinned service admission block (queue depth,
+   running count, shed totals) — the load-balancer drain signal.
+5. **statsd**: ``|ms`` timer lines for query latency and queue wait,
+   drained once per render, buckets kept off the gauge lines.
+6. **Flame endpoint**: ``/queries/<id>/profile`` collapsed stacks.
+7. **Overhead**: disarmed = structural no-op (poisoned observe);
+   armed recording bounded by a budget test.
+"""
+
+import json
+import math
+import time
+import urllib.request
+
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.runtime import monitor, trace
+from blaze_tpu.runtime.scheduler import run_stages, split_stages
+from blaze_tpu.tpch import TPCH_SCHEMAS, build_query
+from blaze_tpu.tpch.datagen import generate_all, table_to_batches
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lock_order_assertions():
+    """Histogram observation runs from query/stage span exits across
+    worker threads — the whole module runs under the armed lock-order
+    assertion like test_monitor.py."""
+    from blaze_tpu.analysis import locks as lock_verify
+
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    yield
+    conf.VERIFY_LOCKS.set(False)
+    lock_verify.refresh()
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate_all(0.01)
+
+
+def _scans(data, n_parts=2):
+    return {
+        name: MemoryScanExec(
+            table_to_batches(data[name], TPCH_SCHEMAS[name], n_parts,
+                             batch_rows=16384),
+            TPCH_SCHEMAS[name],
+        )
+        for name in TPCH_SCHEMAS
+    }
+
+
+@pytest.fixture
+def armed_monitor():
+    conf.MONITOR_ENABLE.set(True)
+    conf.MONITOR_PORT.set(0)
+    conf.MONITOR_HEARTBEAT_MS.set(1)
+    monitor.reset()
+    try:
+        yield monitor
+    finally:
+        monitor.shutdown_server()
+        conf.MONITOR_ENABLE.set(False)
+        conf.MONITOR_PORT.set(4048)
+        conf.MONITOR_HEARTBEAT_MS.set(1000)
+        monitor.reset()
+        assert monitor.monitor_threads() == []
+
+
+def _get(url, path):
+    with urllib.request.urlopen(url + path, timeout=10) as r:
+        return r.status, r.read()
+
+
+# ------------------------------------------- 1. histogram correctness
+
+def test_bucket_counts_match_recorded_samples():
+    h = monitor.Histogram("t", bounds=(0.01, 0.1, 1.0))
+    samples = [0.005, 0.01, 0.02, 0.09, 0.5, 2.0, 7.0]
+    for v in samples:
+        h.observe(v)
+    snap = h.snapshot()
+    # cumulative counts per upper bound, computed independently
+    expected = []
+    for b in (0.01, 0.1, 1.0, math.inf):
+        expected.append((b, sum(1 for v in samples if v <= b)))
+    assert snap["buckets"] == expected
+    assert snap["count"] == len(samples)
+    assert abs(snap["sum"] - sum(samples)) < 1e-9
+    assert snap["max"] == 7.0
+
+
+def test_exemplar_trace_id_roundtrip():
+    h = monitor.Histogram("t", bounds=(0.01, 0.1, 1.0))
+    h.observe(0.05, trace_id="a" * 32)   # bucket index 1 (le=0.1)
+    h.observe(5.0, trace_id="b" * 32)    # +Inf bucket (index 3)
+    h.observe(0.06)                      # no trace id: exemplar kept
+    snap = h.snapshot()
+    assert snap["exemplars"][1][0] == "a" * 32
+    assert abs(snap["exemplars"][1][1] - 0.05) < 1e-9
+    assert snap["exemplars"][3][0] == "b" * 32
+    # the newest exemplar WITH a trace id wins its bucket
+    h.observe(0.07, trace_id="c" * 32)
+    assert h.snapshot()["exemplars"][1][0] == "c" * 32
+
+
+def test_quantile_estimates():
+    h = monitor.Histogram("t", bounds=(0.01, 0.1, 1.0, 10.0))
+    for _ in range(90):
+        h.observe(0.05)       # le=0.1
+    for _ in range(9):
+        h.observe(0.5)        # le=1.0
+    h.observe(5.0)            # le=10.0
+    assert h.quantile(0.5) == 0.1
+    assert h.quantile(0.95) == 1.0
+    assert h.quantile(0.999) == 10.0
+    assert monitor.Histogram("e").quantile(0.5) == 0.0
+
+
+# --------------------------------- 2. /metrics rendering + exemplars
+
+def test_metrics_histograms_with_exemplar_resolving_to_trace(
+        data, armed_monitor, tmp_path):
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    trace.reset()
+    try:
+        with monitor.query_span("hist_q6", mode="scheduler") as lp:
+            stages, mgr = split_stages(build_query("q6", _scans(data), 2))
+            assert sum(b.num_rows for b in run_stages(stages, mgr)) > 0
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+    run_tid = {e.get("trace_id") for e in trace.read_event_log(lp)}.pop()
+    srv = monitor.ensure_server()
+    # classic 0.0.4 scrape: histograms render WITHOUT exemplar syntax
+    # (a 0.0.4 parser meeting one would reject the whole scrape)
+    _, body = _get(srv.url, "/metrics")
+    assert " # {" not in body.decode()
+    # OpenMetrics negotiation via Accept: exemplars + # EOF terminator
+    req = urllib.request.Request(
+        srv.url + "/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert "openmetrics-text" in r.headers.get("Content-Type", "")
+        body = r.read()
+    prom = body.decode()
+    assert prom.endswith("# EOF\n")
+    for fam in ("blaze_query_latency_seconds",
+                "blaze_stage_wall_seconds",
+                "blaze_program_device_seconds",
+                "blaze_program_dispatch_seconds"):
+        assert f"# TYPE {fam} histogram" in prom, fam
+        assert f'{fam}_bucket{{le="+Inf"}}' in prom, fam
+        assert f"{fam}_sum" in prom and f"{fam}_count" in prom, fam
+    # the exemplar resolves to THE trace id of the run that landed it
+    assert f'trace_id="{run_tid}"' in prom
+    # bucket conservation: +Inf cumulative count == _count
+    for line in prom.splitlines():
+        if line.startswith('blaze_query_latency_seconds_bucket{le="+Inf"}'):
+            inf_count = int(line.split("}")[1].split("#")[0].strip())
+        if line.startswith("blaze_query_latency_seconds_count"):
+            assert int(line.split()[1]) == inf_count
+
+
+# --------------------------------------- 3. /queries + --watch tails
+
+def test_queries_latency_block_and_watch(data, armed_monitor):
+    for i in range(3):
+        with monitor.query_span(f"lat_q{i}", mode="in-process"):
+            with monitor.stage_span(0, "result", 1):
+                time.sleep(0.002)
+    snap = monitor.snapshot()
+    lat = snap["latency"]["blaze_query_latency_seconds"]
+    assert lat["count"] == 3
+    assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"]
+    assert "blaze_stage_wall_seconds" in snap["latency"]
+    frame = monitor.render_watch(snap)
+    assert "latency: p50" in frame and "(3 queries)" in frame
+
+
+# ------------------------------------------------ 4. /healthz golden
+
+def test_healthz_service_admission_block_golden_keys(data, armed_monitor):
+    from blaze_tpu.runtime import service
+
+    # without a service: no block (liveness only)
+    srv = monitor.ensure_server()
+    _, body = _get(srv.url, "/healthz")
+    assert "service" not in json.loads(body)
+
+    prev = conf.SERVICE_MAX_CONCURRENT.get(), conf.SERVICE_MAX_QUEUED.get()
+    conf.SERVICE_MAX_CONCURRENT.set(1)
+    conf.SERVICE_MAX_QUEUED.set(0)
+    svc = service.QueryService().start()
+    try:
+        _, body = _get(srv.url, "/healthz")
+        doc = json.loads(body)
+        # golden shape, BOTH ways: keys are API for load balancers
+        assert set(doc["service"]) == set(monitor.HEALTHZ_SERVICE_KEYS)
+        assert doc["service"]["accepting"] is True
+        assert doc["service"]["shed_total"] == 0
+        # saturate: a shed submission shows up in the drain signal
+        scans = _scans(data)
+        import threading
+
+        release = threading.Event()
+
+        def build():
+            release.wait(10)
+            return build_query("q6", scans, 2)
+
+        h = svc.submit("block_q", build=build)
+        with pytest.raises(service.QueryRejectedError):
+            svc.submit("shed_q", build=lambda: build_query(
+                "q6", scans, 2))
+        _, body = _get(srv.url, "/healthz")
+        doc = json.loads(body)
+        assert doc["service"]["accepting"] is False
+        assert doc["service"]["shed_total"] == 1
+        assert doc["service"]["running"] == 1
+        release.set()
+        h.result(timeout=60)
+    finally:
+        svc.shutdown()
+        conf.SERVICE_MAX_CONCURRENT.set(prev[0])
+        conf.SERVICE_MAX_QUEUED.set(prev[1])
+
+
+# ------------------------------------------------- 5. statsd timers
+
+def test_statsd_ms_timer_lines_drain_once(data, armed_monitor):
+    from blaze_tpu.runtime import service
+
+    svc = service.QueryService().start()
+    try:
+        scans = _scans(data)
+        h = svc.submit("stats_q6",
+                       build=lambda: build_query("q6", scans, 2))
+        assert sum(b.num_rows for b in h.result(timeout=60)) > 0
+    finally:
+        svc.shutdown()
+    lines = monitor.render_statsd_lines()
+    ms = [ln for ln in lines if ln.endswith("|ms")]
+    names = {ln.split(":")[0] for ln in ms}
+    assert "blaze_query_latency_ms" in names
+    assert "blaze_admission_wait_ms" in names
+    # histogram buckets stay off the gauge transport
+    assert not any("_bucket" in ln for ln in lines)
+    # timers are EVENTS: drained, so the next render pushes none twice
+    again = monitor.render_statsd_lines()
+    assert not any(ln.endswith("|ms") for ln in again)
+
+
+def test_statsd_pusher_carries_timer_lines(armed_monitor):
+    """The push loop sends whatever render_statsd_lines yields —
+    including the |ms samples — in bounded datagrams."""
+    import socket
+
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5)
+    with monitor.query_span("push_t", mode="in-process"):
+        pass
+    pusher = monitor._StatsdPusher(f"127.0.0.1:{rx.getsockname()[1]}")
+    try:
+        pusher._push_once()
+        payload = b""
+        try:
+            while True:
+                rx.settimeout(0.5)
+                payload += rx.recv(65536) + b"\n"
+        except socket.timeout:
+            pass
+        assert b"blaze_query_latency_ms:" in payload
+        assert b"|ms" in payload
+    finally:
+        pusher._sock.close()
+        rx.close()
+
+
+# ------------------------------------------- 6. flame endpoint
+
+def test_profile_endpoint_serves_collapsed_stacks(
+        data, armed_monitor, tmp_path):
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    trace.reset()
+    try:
+        with monitor.query_span("prof_q6", mode="scheduler"):
+            stages, mgr = split_stages(build_query("q6", _scans(data), 2))
+            assert sum(b.num_rows for b in run_stages(stages, mgr)) > 0
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+    srv = monitor.ensure_server()
+    status, body = _get(srv.url, "/queries/prof_q6/profile")
+    assert status == 200
+    lines = body.decode().splitlines()
+    assert lines and all(" " in ln for ln in lines)
+    stack, _, val = lines[0].rpartition(" ")
+    assert stack.startswith("prof_q6;stage_")
+    assert int(val) >= 1
+    # unknown query -> 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv.url, "/queries/no_such/profile")
+    assert ei.value.code == 404
+
+
+def test_profile_endpoint_untraced_explains(data, armed_monitor):
+    with monitor.query_span("prof_plain", mode="in-process"):
+        with monitor.stage_span(0, "result", 1):
+            pass
+    srv = monitor.ensure_server()
+    status, body = _get(srv.url, "/queries/prof_plain/profile")
+    assert status == 200
+    assert b"no kernel data" in body
+
+
+# ----------------------------------------------- 7. overhead contract
+
+def test_disarmed_histogram_recording_is_structural_noop(
+        data, monkeypatch):
+    """Monitor off (the default): query/stage spans never reach the
+    histogram, timer queue, or exemplar paths — poisoned like the
+    monitor-off gate."""
+    def poisoned(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("histogram path reached while disarmed")
+
+    assert not monitor.enabled()
+    monkeypatch.setattr(monitor.Histogram, "observe", poisoned)
+    monkeypatch.setattr(monitor, "_histogram", poisoned)
+    monkeypatch.setattr(monitor, "drain_timers", poisoned)
+    with monitor.query_span("noop_q", mode="scheduler"):
+        stages, mgr = split_stages(build_query("q6", _scans(data), 2))
+        assert sum(b.num_rows for b in run_stages(stages, mgr)) > 0
+    with monitor._hist_lock:
+        assert not monitor._TIMERS
+
+
+def test_armed_recording_overhead_budget(armed_monitor):
+    """Tier-1 budget: armed histogram observation is a few dict/list
+    ops under a leaf lock — 10k observations with exemplars must stay
+    far under a second (generous bound; a regression to per-sample IO
+    or rendering would blow it by orders of magnitude)."""
+    tid = "f" * 32
+    t0 = time.perf_counter()
+    for i in range(10_000):
+        monitor.observe_hist("blaze_query_latency_seconds",
+                             (i % 100) / 1000.0, trace_id=tid)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"10k observations took {dt:.3f}s"
+    snap = {h["name"]: h for h in monitor.histograms_snapshot()}
+    assert snap["blaze_query_latency_seconds"]["count"] == 10_000
+    # rendering the full exposition with histograms stays bounded too
+    t0 = time.perf_counter()
+    monitor.render_prometheus()
+    assert time.perf_counter() - t0 < 1.0
