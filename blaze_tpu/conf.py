@@ -158,6 +158,32 @@ SPECULATION_WEDGE_MS = ConfEntry("spark.blaze.speculation.wedgeMs", 0, int)
 # Env override BLAZE_FAULTS_SPEC reaches worker subprocesses too.
 FAULTS_SPEC = ConfEntry("spark.blaze.faults.spec", "", str)
 
+# End-to-end data integrity (runtime/integrity.py): checksum algorithm
+# stamped on every framed block that crosses a process or disk boundary
+# (shuffle map outputs, spill frames, RSS pushes, broadcast blobs,
+# worker result frames) and verified at every read boundary — a
+# mismatch raises typed BlockCorruptionError and rides the existing
+# recovery ladder (fetch-failure map rerun / task retry / quarantine).
+# Values: "crc32" (zlib-backed, C speed — the default), "crc32c"
+# (Castagnoli, byte-interoperable with hardware CRC32C, pure-python
+# table), "xxh32" (the LZ4-frame hash), "off" (no stamping, no
+# verification).  Checksums are host-side over already-staged bytes:
+# no device syncs, so the warm dispatch budget is untouched.
+IO_CHECKSUM = ConfEntry("spark.blaze.io.checksum", "crc32", str)
+# Orphan sweep on startup: a LocalShuffleManager re-opened over an
+# EXISTING root (a restarted driver / a worker joining a shared root)
+# reclaims `.inprogress` staging temps and blaze_spill_ files older
+# than this many seconds — debris of a crashed prior process that
+# would otherwise leak the dead run's disk.  0 disables the sweep.
+ORPHAN_SWEEP_AGE = ConfEntry("spark.blaze.shuffle.orphanSweepAgeSec", 1800, int)
+# Disk-pressure ladder (runtime/diskmgr.py): ENOSPC/EIO during a spill
+# or shuffle write first RECLAIMS reclaimable disk — stale
+# `.inprogress` temps and orphaned spill files older than this many
+# seconds in the registered shuffle roots and the spill temp dir —
+# before retrying the write, falling back to host RAM (bounded by the
+# memmgr quota), or raising typed retryable DiskExhaustedError.
+DISK_RECLAIM_AGE = ConfEntry("spark.blaze.disk.reclaimAgeSec", 300, int)
+
 # Graceful degradation under device memory pressure (runtime/oom.py):
 # an XLA RESOURCE_EXHAUSTED caught at the dispatch choke point first
 # sheds host-staging pressure (memmgr force-spill) and retries; a

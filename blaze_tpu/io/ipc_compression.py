@@ -10,15 +10,36 @@ store-mode blocks, spec-valid and readable by any lz4 tool) — plus
 zlib and raw for internal spill frames.  One codec byte after the
 length keeps frames self-describing (the reference relies on both
 sides reading the same conf instead).
+
+Integrity (runtime/integrity.py, conf ``spark.blaze.io.checksum``):
+a frame written with ``checksum=<algo id>`` sets the codec byte's high
+bit and appends a 5-byte trailer ``[u8 algo][u32 sum]`` over the
+STORED bytes —
+
+    plain:       [u32 len][u8 codec][stored]
+    checksummed: [u32 len][u8 codec|0x80][stored][u8 algo][u32 sum]
+
+``len`` stays the stored-byte length either way, so offset arithmetic
+is uniform (:func:`frame_span`).  Frame STREAMS written as one unit
+(worker result files, broadcast blobs) may end with a BLOCK TRAILER
+frame (codec ``0x7E``) carrying the frame count and the XOR of the
+frame checksums, so truncation of whole frames is detectable too.
+Every reader here verifies flagged frames and raises typed
+``BlockCorruptionError`` on mismatch; unstamped streams read exactly
+as before.
 """
 
 from __future__ import annotations
 
 import struct
 import zlib
-from typing import BinaryIO, Dict, Iterator, Optional
+from typing import BinaryIO, Dict, Iterator, Optional, Tuple
 
 from .. import conf
+from ..runtime.integrity import (
+    CHECKSUM_FLAG, TRAILER_LEN, BlockCorruptionError,
+    frame_algo, frame_trailer, verify_bytes,
+)
 
 TARGET_BLOCK = 4 << 20
 
@@ -26,6 +47,10 @@ CODEC_RAW = 0
 CODEC_ZLIB = 1
 CODEC_ZSTD = 2
 CODEC_LZ4 = 3
+
+#: codec byte of a BLOCK-TRAILER frame: its 9-byte payload is
+#: [u32 frame_count][u8 algo][u32 xor-of-frame-checksums]
+CODEC_BLOCK_TRAILER = 0x7E
 
 ZSTD_LEVEL = 1  # ≙ reference ZSTD_LEVEL
 
@@ -84,14 +109,19 @@ def lz4_block_compress(src: bytes) -> bytes:
     return bytes(out)
 
 
-def lz4_frame_compress(payload: bytes) -> bytes:
+def lz4_frame_compress(payload: bytes, checksums: bool = False) -> bytes:
     """LZ4 Frame writer: independent blocks, greedy-compressed (stored
-    verbatim when compression does not help), no checksums.  Readable
-    by any LZ4 frame reader (lz4_flex, pyarrow, lz4 CLI)."""
+    verbatim when compression does not help).  With ``checksums`` the
+    frame carries the spec's xxh32 BLOCK checksums (one per block, over
+    the stored block bytes) and the CONTENT checksum after the EndMark
+    — what the reference's lz4_flex encoder emits.  Readable by any
+    LZ4 frame reader (lz4_flex, pyarrow, lz4 CLI)."""
     out = bytearray()
     out += struct.pack("<I", _LZ4_MAGIC)
-    # FLG: version=01, block independence=1, no checksums/content size
-    out.append(0b0110_0000)
+    # FLG: version=01, block independence=1; +block checksum (bit 4)
+    # and content checksum (bit 2) when requested
+    flg = 0b0110_0000 | (0b0001_0100 if checksums else 0)
+    out.append(flg)
     # BD: block max size 4MB (code 7)
     out.append(7 << 4)
     # HC byte: (xxh32(FLG..BD) >> 8) & 0xFF
@@ -104,11 +134,16 @@ def lz4_frame_compress(payload: bytes) -> bytes:
         comp = lz4_block_compress(chunk)
         if len(comp) < len(chunk):
             out += struct.pack("<I", len(comp))
-            out += comp
+            block = comp
         else:
             out += struct.pack("<I", len(chunk) | 0x80000000)  # stored
-            out += chunk
+            block = chunk
+        out += block
+        if checksums:
+            out += struct.pack("<I", _xxh32(block))
     out += struct.pack("<I", 0)  # EndMark
+    if checksums:
+        out += struct.pack("<I", _xxh32(payload))
     return bytes(out)
 
 
@@ -199,7 +234,11 @@ def lz4_block_decompress(src: bytes, history: Optional[bytearray] = None) -> byt
 
 def lz4_frame_decompress(src: bytes) -> bytes:
     """LZ4 Frame reader: compressed + uncompressed blocks, linked or
-    independent, dictionary-ID header skipped, checksums not verified."""
+    independent, dictionary-ID header skipped.  Header, block, and
+    content checksums ARE verified when the frame carries them (the
+    reader previously documented "checksums not verified" — silently
+    trusting exactly the bytes the checksums exist to protect); a
+    mismatch raises typed :class:`BlockCorruptionError`."""
     (magic,) = struct.unpack_from("<I", src, 0)
     if magic != _LZ4_MAGIC:
         raise ValueError("not an LZ4 frame")
@@ -207,11 +246,18 @@ def lz4_frame_decompress(src: bytes) -> bytes:
     pos = 6  # magic + FLG + BD
     block_checksum = (flg >> 4) & 1
     content_size = (flg >> 3) & 1
+    content_checksum = (flg >> 2) & 1
     dict_id = flg & 1
     if content_size:
         pos += 8
     if dict_id:
         pos += 4
+    # HC byte: second byte of xxh32 over the descriptor (FLG..dictID)
+    want_hc = (_xxh32(src[4:pos]) >> 8) & 0xFF
+    if src[pos] != want_hc:
+        raise BlockCorruptionError(
+            "lz4.frame", "header checksum (HC byte) mismatch",
+            expected=want_hc, got=src[pos])
     pos += 1  # HC byte
     out = bytearray()
     while True:
@@ -224,13 +270,26 @@ def lz4_frame_decompress(src: bytes) -> bytes:
         block = src[pos : pos + bsize]
         pos += bsize
         if block_checksum:
+            (want,) = struct.unpack_from("<I", src, pos)
             pos += 4
+            got = _xxh32(block)
+            if got != want:
+                raise BlockCorruptionError(
+                    "lz4.frame", "block checksum mismatch",
+                    expected=want, got=got)
         if uncompressed:
             out += block
         else:
             # linked blocks reference previous output: decode with the
             # running buffer as history (appended in place)
             lz4_block_decompress(block, history=out)
+    if content_checksum:
+        (want,) = struct.unpack_from("<I", src, pos)
+        got = _xxh32(bytes(out))
+        if got != want:
+            raise BlockCorruptionError(
+                "lz4.frame", "content checksum mismatch",
+                expected=want, got=got)
     return bytes(out)
 
 
@@ -244,23 +303,57 @@ def _codec_id(name: str) -> int:
     }.get(name, CODEC_ZLIB)
 
 
-def compress_frame(payload: bytes, codec: Optional[str] = None) -> bytes:
+def compress_frame(payload: bytes, codec: Optional[str] = None,
+                   checksum_algo: Optional[int] = None) -> bytes:
+    """One framed block.  ``checksum_algo`` (an ``integrity`` algo id;
+    None = unstamped — the pre-integrity wire format, still what bare
+    callers and the native codec speak) sets the codec byte's checksum
+    flag and appends the per-frame trailer over the stored bytes."""
     cid = _codec_id(codec or str(conf.IO_COMPRESSION_CODEC.get()))
+    stored = payload
+    out_cid = CODEC_RAW
     if cid == CODEC_ZSTD:
         import zstandard
 
         comp = zstandard.ZstdCompressor(level=ZSTD_LEVEL).compress(payload)
         if len(comp) < len(payload):
-            return struct.pack("<IB", len(comp), CODEC_ZSTD) + comp
+            stored, out_cid = comp, CODEC_ZSTD
     elif cid == CODEC_LZ4:
         comp = lz4_frame_compress(payload)
         if len(comp) < len(payload):
-            return struct.pack("<IB", len(comp), CODEC_LZ4) + comp
+            stored, out_cid = comp, CODEC_LZ4
     elif cid == CODEC_ZLIB:
         comp = zlib.compress(payload, 1)
         if len(comp) < len(payload):
-            return struct.pack("<IB", len(comp), CODEC_ZLIB) + comp
-    return struct.pack("<IB", len(payload), CODEC_RAW) + payload
+            stored, out_cid = comp, CODEC_ZLIB
+    if checksum_algo is None:
+        return struct.pack("<IB", len(stored), out_cid) + stored
+    return (struct.pack("<IB", len(stored), out_cid | CHECKSUM_FLAG)
+            + stored + frame_trailer(stored, checksum_algo))
+
+
+def block_trailer(frame_count: int, checksum_xor: int,
+                  algo: int) -> bytes:
+    """The end-of-block trailer FRAME for a stream written as one unit
+    (worker result files, broadcast blobs): frame count + the XOR of
+    the member frames' checksums, so truncation of WHOLE frames —
+    which per-frame trailers cannot see — is detectable."""
+    payload = struct.pack("<IBI", frame_count, algo,
+                          checksum_xor & 0xFFFFFFFF)
+    return struct.pack("<IB", len(payload), CODEC_BLOCK_TRAILER) + payload
+
+
+def frame_span(buf: bytes, off: int) -> Tuple[int, int, int, int]:
+    """Parse one frame header at ``off``: returns ``(cid, stored_start,
+    stored_len, next_off)``; ``cid`` keeps the checksum flag bit, and
+    ``next_off`` includes the trailer when flagged — the ONE
+    offset-arithmetic definition every blob walker shares."""
+    ln, cid = struct.unpack_from("<IB", buf, off)
+    start = off + 5
+    nxt = start + ln
+    if cid & CHECKSUM_FLAG:
+        nxt += TRAILER_LEN
+    return cid, start, ln, nxt
 
 
 def _decode(cid: int, payload: bytes) -> bytes:
@@ -278,21 +371,95 @@ def _decode(cid: int, payload: bytes) -> bytes:
     return payload
 
 
-def decompress_frame(frame: bytes) -> bytes:
+def decompress_frame(frame: bytes, site: str = "frame",
+                     path: Optional[str] = None) -> bytes:
+    """Decode ONE frame (with or without a checksum trailer); flagged
+    frames verify their stored bytes first and raise typed
+    :class:`BlockCorruptionError` on mismatch."""
     ln, cid = struct.unpack_from("<IB", frame, 0)
-    return _decode(cid, frame[5 : 5 + ln])
+    stored = frame[5 : 5 + ln]
+    if cid & CHECKSUM_FLAG:
+        verify_bytes(stored, frame[5 + ln : 5 + ln + TRAILER_LEN],
+                     site, path=path)
+        cid &= ~CHECKSUM_FLAG
+    return _decode(cid, stored)
+
+
+def _verify_block_trailer(stored: bytes, count: int, xor: int,
+                          site: str, path: Optional[str]) -> None:
+    """Check a BLOCK-TRAILER frame's payload against the frames seen
+    so far (count + checksum XOR, algo-tagged)."""
+    if len(stored) != 9:
+        raise BlockCorruptionError(site, "torn block trailer", path=path)
+    want_count, algo, want_xor = struct.unpack("<IBI", stored)
+    if want_count != count:
+        raise BlockCorruptionError(
+            site, f"block trailer frame count {want_count} != {count} read",
+            path=path)
+    # the XOR check only binds when the frames were checksummed with
+    # the same algorithm (xor of their trailers' sums)
+    if algo and want_xor != (xor & 0xFFFFFFFF):
+        raise BlockCorruptionError(site, "block trailer checksum mismatch",
+                                   path=path, expected=want_xor,
+                                   got=xor & 0xFFFFFFFF, algo=algo)
+
+
+def iter_blob_frames(blob: bytes, site: str = "block",
+                     path: Optional[str] = None) -> Iterator[bytes]:
+    """Decode every frame of an in-memory blob (a shuffle bytes block,
+    an RSS fetch, a broadcast payload): verifies flagged frames,
+    consumes and checks a block trailer when present, and raises typed
+    :class:`BlockCorruptionError` on any mismatch.  The shared walker
+    behind every ``while off < len(blob)`` loop that used to hand-roll
+    the 5-byte header arithmetic (and would torn-read a checksummed
+    frame)."""
+    from ..runtime.integrity import enabled
+
+    armed = enabled()  # resolved ONCE per blob, not per frame
+    off = 0
+    count = 0
+    xor = 0
+    saw_trailer = False
+    while off < len(blob):
+        cid, start, ln, nxt = frame_span(blob, off)
+        stored = blob[start : start + ln]
+        if len(stored) < ln:
+            raise BlockCorruptionError(site, "torn frame", path=path)
+        if (cid & ~CHECKSUM_FLAG) == CODEC_BLOCK_TRAILER:
+            _verify_block_trailer(stored, count, xor, site, path)
+            saw_trailer = True
+            off = nxt
+            continue
+        if saw_trailer:
+            raise BlockCorruptionError(
+                site, "frames after the block trailer", path=path)
+        if cid & CHECKSUM_FLAG:
+            trailer = blob[start + ln : start + ln + TRAILER_LEN]
+            verify_bytes(stored, trailer, site, path=path, armed=armed)
+            if len(trailer) == TRAILER_LEN:
+                xor ^= struct.unpack("<BI", trailer)[1]
+        count += 1
+        off = nxt
+        yield _decode(cid & ~CHECKSUM_FLAG, stored)
 
 
 class IpcFrameWriter:
-    """Accumulates payloads into frames on a binary stream."""
+    """Accumulates payloads into frames on a binary stream.  With the
+    integrity layer armed (conf ``spark.blaze.io.checksum``) every
+    frame carries the per-frame checksum trailer; pass
+    ``checksum_algo`` explicitly to override (None in the conf-off
+    case keeps the pre-integrity format)."""
 
-    def __init__(self, f: BinaryIO, codec: Optional[str] = None):
+    def __init__(self, f: BinaryIO, codec: Optional[str] = None,
+                 checksum_algo: Optional[int] = ...):
         self._f = f
         self._codec = codec
+        self._algo = frame_algo() if checksum_algo is ... else checksum_algo
         self.bytes_written = 0
 
     def write(self, payload: bytes) -> int:
-        frame = compress_frame(payload, self._codec)
+        frame = compress_frame(payload, self._codec,
+                               checksum_algo=self._algo)
         self._f.write(frame)
         self.bytes_written += len(frame)
         return len(frame)
@@ -300,13 +467,28 @@ class IpcFrameWriter:
 
 class IpcFrameReader:
     """Iterates frames from a binary stream (bounded by ``limit`` bytes
-    when reading a file segment)."""
+    when reading a file segment).  Flagged frames verify their stored
+    bytes (typed :class:`BlockCorruptionError` on mismatch); a block
+    trailer, when the stream carries one, is checked and consumed."""
 
-    def __init__(self, f: BinaryIO, limit: Optional[int] = None):
+    def __init__(self, f: BinaryIO, limit: Optional[int] = None,
+                 site: str = "frame", path: Optional[str] = None):
         self._f = f
         self._remaining = limit
+        self._site = site
+        self._path = path
+        # resolved ONCE per stream: frame verification must not pay a
+        # conf-store read per frame on the hot shuffle-read path
+        self._armed = None  # lazy: streams may be built before reads
 
     def __iter__(self) -> Iterator[bytes]:
+        from ..runtime.integrity import enabled
+
+        if self._armed is None:
+            self._armed = enabled()
+        count = 0
+        xor = 0
+        saw_trailer = False
         while True:
             if self._remaining is not None and self._remaining <= 0:
                 return
@@ -314,7 +496,27 @@ class IpcFrameReader:
             if len(hdr) < 5:
                 return
             ln, cid = struct.unpack("<IB", hdr)
-            payload = self._f.read(ln)
+            stored = self._f.read(ln)
+            consumed = 5 + ln
+            trailer = b""
+            if cid & CHECKSUM_FLAG:
+                trailer = self._f.read(TRAILER_LEN)
+                consumed += TRAILER_LEN
             if self._remaining is not None:
-                self._remaining -= 5 + ln
-            yield _decode(cid, payload)
+                self._remaining -= consumed
+            if (cid & ~CHECKSUM_FLAG) == CODEC_BLOCK_TRAILER:
+                _verify_block_trailer(stored, count, xor, self._site,
+                                      self._path)
+                saw_trailer = True
+                continue
+            if saw_trailer:
+                raise BlockCorruptionError(
+                    self._site, "frames after the block trailer",
+                    path=self._path)
+            if cid & CHECKSUM_FLAG:
+                verify_bytes(stored, trailer, self._site, path=self._path,
+                             armed=self._armed)
+                if len(trailer) == TRAILER_LEN:
+                    xor ^= struct.unpack("<BI", trailer)[1]
+            count += 1
+            yield _decode(cid & ~CHECKSUM_FLAG, stored)

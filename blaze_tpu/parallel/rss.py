@@ -19,7 +19,7 @@ from ..batch import RecordBatch
 from ..io.batch_serde import serialize_batch
 from ..io.ipc_compression import compress_frame
 from ..ops.base import BatchStream, ExecNode
-from ..runtime import faults, monitor, trace
+from ..runtime import faults, integrity, monitor, trace
 from ..runtime.context import TaskContext
 from ..schema import Schema
 from .shuffle import (
@@ -139,9 +139,22 @@ class RssShuffleWriterExec(ExecNode):
                             )
                             for c in host.columns
                         ]
+                        # integrity: the pushed frame carries the
+                        # per-frame checksum trailer, so the reduce
+                        # side's verified read — not the RSS — is what
+                        # vouches for the bytes
                         payload = compress_frame(
-                            serialize_batch(RecordBatch(self.schema, sl, hi - lo))
+                            serialize_batch(RecordBatch(self.schema, sl, hi - lo)),
+                            checksum_algo=integrity.frame_algo(),
                         )
+                        if faults.corrupt(
+                                "rss.push",
+                                attempt=ctx.task_attempt_id,
+                                detail=f"{self.writer_resource_id}.{partition}"):
+                            # @corrupt: post-checksum bit-rot in
+                            # transit — the reducer must detect it
+                            payload = integrity.flip_byte(
+                                payload, 5 + max(0, (len(payload) - 10) // 2))
                         with self.metrics.timer("output_io_time"):
                             faults.hit(
                                 "rss.push",

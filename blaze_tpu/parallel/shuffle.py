@@ -40,11 +40,15 @@ from ..exprs.compile import lower
 from ..exprs.hash import murmur3_columns, pmod
 from ..exprs.ir import Expr
 from ..io.batch_serde import deserialize_batch, serialize_batch
-from ..io.ipc_compression import IpcFrameReader, IpcFrameWriter, compress_frame
+from ..io.ipc_compression import (
+    IpcFrameReader, IpcFrameWriter, compress_frame, iter_blob_frames,
+)
 from ..ops.base import BatchStream, ExecNode
 from ..runtime import monitor
-from ..runtime import faults, lockset, trace
+from ..runtime import diskmgr, faults, integrity, lockset, trace
 from ..runtime.context import TaskContext
+from ..runtime.diskmgr import DiskExhaustedError
+from ..runtime.integrity import BlockCorruptionError
 from ..runtime.memmgr import MemConsumer, Spill, try_new_spill
 from ..runtime.retry import FetchFailedError
 from ..schema import Schema
@@ -198,6 +202,10 @@ class ShuffleRepartitioner(MemConsumer):
         self._buffers: List[List[RecordBatch]] = [[] for _ in range(n_out)]
         self._buffered_bytes = 0
         self._spills: List[Tuple[Spill, List[Tuple[int, int]]]] = []  # (spill, [(pid, nframes)])
+        # commit replayability marker for _commit_with_recovery: True
+        # once write_output has consumed spill frames (written only by
+        # the committing task's own thread)
+        self._commit_drained = False
         # the lock the async stager, map-task producer, and the memory
         # manager's cross-thread spills share — ranked in the declared
         # hierarchy (analysis/locks.py) OUTSIDE memmgr/metrics/trace
@@ -239,13 +247,28 @@ class ShuffleRepartitioner(MemConsumer):
         # an injected spill failure still aborts cleanly (rows kept,
         # task retries), and the probe's trace emission no longer rides
         # three helper hops inside the critical section (the
-        # lock.emit-under-lock waiver this used to need is gone)
+        # lock.emit-under-lock waiver this used to need is gone).  The
+        # @corrupt probe likewise fires out here (it emits when it
+        # matches); the flip itself is armed on the Spill and applied
+        # post-encode inside.  The probe only counts when there is
+        # observably SOMETHING to spill — memmgr documents that a
+        # concurrent spill of an already-drained victim "finds no
+        # state and returns 0", and such a benign empty call must not
+        # consume (and vacuously emit) a corruption rule whose hit
+        # number means "the Nth spill that wrote frames".  The locked
+        # peek is stale only against that same benign concurrent drain.
         faults.hit("spill.write")
+        with self._lock:
+            lockset.check(self, "_buffered_bytes")
+            has_rows = self._buffered_bytes > 0
+        corrupt_next = has_rows and faults.corrupt("spill.write")
         with self._lock:
             lockset.check(self, "_buffers", "_buffered_bytes", "_spills")
             if self._buffered_bytes == 0:
                 return 0
             sp = try_new_spill()
+            if corrupt_next:
+                sp.corrupt_next_frame()
             manifest: List[Tuple[int, int]] = []
             try:
                 for pid in range(self.n_out):
@@ -303,20 +326,61 @@ class ShuffleRepartitioner(MemConsumer):
         """Merge memory + spills per pid into .data/.index.  Returns
         partition lengths.  Holds the lock across the whole drain so a
         late memory-manager spill cannot move buffers out mid-write.
-        The fault-injection site and the shuffle_write trace event both
-        live OUTSIDE the lock: emission does file IO and can raise, and
-        holding an operator lock across either is the PR 3 deadlock
-        class the ``lock.emit-under-lock`` lint rule pins."""
+        The fault-injection sites and every trace emission live OUTSIDE
+        the lock: emission does file IO and can raise, and holding an
+        operator lock across either is the PR 3 deadlock class the
+        ``lock.emit-under-lock`` lint rule pins.
+
+        Disk-pressure ladder: the spills are drained into memory ONCE
+        (:meth:`_drain_spills_locked`), so an ``ENOSPC``/``EIO`` from
+        the file write can safely reclaim stale staging debris and
+        retry the file half without losing spilled rows; a second
+        failure escalates to typed retryable ``DiskExhaustedError``
+        (the task retry rebuilds everything)."""
+        self._commit_drained = False
         faults.hit("shuffle.write", attempt=self.task_attempt_id, detail=data_path)
+        recovered = False
         with self._lock:
             lockset.check(self, "_buffers", "_buffered_bytes", "_spills")
-            lengths = self._write_output_locked(data_path, index_path)
+            self._commit_drained = True  # spill frames consumed below:
+            # a failure past this point is not replayable in-place
+            spilled = self._drain_spills_locked()
+            try:
+                lengths = self._write_files(spilled, data_path, index_path)
+            except OSError as e:
+                if not diskmgr.is_disk_pressure(e):
+                    raise
+                # rung 2, reclaim + one retry (emission-free under the
+                # lock; the recovery event lands after release below)
+                diskmgr.reclaim(extra_roots=[os.path.dirname(data_path)
+                                             or "."])
+                try:
+                    lengths = self._write_files(spilled, data_path,
+                                                index_path)
+                    recovered = True
+                except OSError as e2:
+                    if not diskmgr.is_disk_pressure(e2):
+                        raise
+                    raise DiskExhaustedError("shuffle.write", e2) from e2
+        if recovered:
+            diskmgr.record_recovery()
+            trace.emit("disk_pressure", action="retry",
+                       site="shuffle.write", detail=data_path)
+        if faults.corrupt("shuffle.write", attempt=self.task_attempt_id,
+                          detail=data_path):
+            # @corrupt: post-commit bit-rot on the COMMITTED data file
+            # — the reduce-side checksum verification, not this writer,
+            # must catch it (zero silent wrong results).  Probed AFTER
+            # the rename so the hit number means "the Nth block that
+            # actually committed" (a failed commit never consumes — or
+            # vacuously emits — a corruption rule).
+            integrity.flip_byte_in_file(data_path)
         trace.emit("shuffle_write", bytes=sum(lengths),
                    blocks=sum(1 for ln in lengths if ln),
                    attempt=self.task_attempt_id, path=data_path)
         return lengths
 
-    def _write_output_locked(self, data_path: str, index_path: str) -> List[int]:
+    def _drain_spills_locked(self) -> Dict[int, List[RecordBatch]]:
         # decode spills back per pid (read once, in insertion order)
         spilled: Dict[int, List[RecordBatch]] = {}
         for sp, manifest in self._spills:
@@ -327,6 +391,10 @@ class ShuffleRepartitioner(MemConsumer):
                     spilled.setdefault(pid, []).append(deserialize_batch(frame, self.schema))
             sp.release()
         self._spills = []  # drained: the teardown release() owes nothing
+        return spilled
+
+    def _write_files(self, spilled: Dict[int, List[RecordBatch]],
+                     data_path: str, index_path: str) -> List[int]:
         lengths: List[int] = []
         offsets = [0]
         codec = str(conf.IO_COMPRESSION_CODEC.get())
@@ -376,6 +444,57 @@ def _host_concat(batches: List[RecordBatch], schema: Schema) -> RecordBatch:
         b = batches[0]
         return b
     return concat_batches(batches).to_host()
+
+
+def _commit_with_recovery(rep: "ShuffleRepartitioner", data_path: str,
+                          index_path: str) -> List[int]:
+    """Drive the map-output commit with the storage-failure handlers
+    that must live OUTSIDE the repartitioner lock:
+
+    - a corrupt SPILL frame surfacing during the drain
+      (``BlockCorruptionError``) is counted and leaves a
+      ``block_corruption`` event before propagating — the task retry
+      rebuilds the consumer's state from its (still-buffered) input;
+    - disk pressure raised BEFORE any spill was drained (the
+      ``shuffle.write@N@enospc`` entry probe fires at write_output's
+      first line) reclaims, records the recovery, and retries the
+      whole commit once — nothing was consumed, so the retry sees
+      every row.  Mid-write pressure is handled INSIDE write_output
+      (drain-once + file-half retry) and escalates as the typed
+      ``DiskExhaustedError``, which is deliberately NOT retried here.
+    """
+    from ..runtime import dispatch
+
+    try:
+        # the corruption accounting wraps BOTH commit attempts: a
+        # corrupt spill frame surfacing inside the disk-retry path
+        # (sibling except clauses don't catch each other) must still
+        # be counted and leave its detection event
+        return _commit_with_disk_retry(rep, data_path, index_path)
+    except BlockCorruptionError as e:
+        dispatch.record("corruption_detected")
+        trace.emit("block_corruption", site="spill.read",
+                   path=e.path, detail=str(e)[:300],
+                   attempt=rep.task_attempt_id)
+        raise
+
+
+def _commit_with_disk_retry(rep: "ShuffleRepartitioner", data_path: str,
+                            index_path: str) -> List[int]:
+    try:
+        return rep.write_output(data_path, index_path)
+    except OSError as e:
+        if not diskmgr.is_disk_pressure(e) \
+                or getattr(rep, "_commit_drained", True):
+            # not pressure, or the commit already consumed its spill
+            # frames: an in-place retry would silently drop them —
+            # escalate to the task retry, which rebuilds everything
+            raise
+        diskmgr.reclaim(extra_roots=[os.path.dirname(data_path) or "."])
+        diskmgr.record_recovery()
+        trace.emit("disk_pressure", action="retry", site="shuffle.write",
+                   detail=data_path)
+        return rep.write_output(data_path, index_path)
 
 
 # ------------------------------------------------------------------- execs
@@ -825,7 +944,8 @@ class ShuffleWriterExec(ExecNode):
                     # empty/partial one (chaos-sweep-found)
                     return
                 with self.metrics.timer("output_io_time"):
-                    self.partition_lengths = rep.write_output(self.data_path, self.index_path)
+                    self.partition_lengths = _commit_with_recovery(
+                        rep, self.data_path, self.index_path)
                 self.metrics.add("data_size", sum(self.partition_lengths))
                 committed = True
             finally:
@@ -896,6 +1016,37 @@ class IpcReaderExec(ExecNode):
 
         return stream()
 
+    def _fetch_failed(self, block, partition: int,
+                      e: BaseException) -> FetchFailedError:
+        """Wrap bad producer bytes as the typed fetch failure, with the
+        integrity bookkeeping: a checksum-verified corruption counts
+        ``corruption_detected`` and leaves a ``block_corruption``
+        event; a file-backed block that has now failed TWICE at the
+        same path is QUARANTINED (renamed ``.corrupt``, kept for
+        forensics, its ``.index`` dropped) so recovery regenerates it
+        in full instead of a third identical failure."""
+        from ..runtime import dispatch
+
+        mid = block_map_id(block)
+        path = None if isinstance(block, bytes) else block[0]
+        site = ("broadcast.fetch"
+                if self.resource_id.startswith("broadcast_")
+                else "shuffle.fetch")
+        if isinstance(e, BlockCorruptionError):
+            dispatch.record("corruption_detected")
+            quarantined = False
+            if path is not None and integrity.note_corruption(path) >= 2:
+                quarantined = integrity.quarantine(path) is not None
+                if quarantined:
+                    dispatch.record("blocks_quarantined")
+            trace.emit("block_corruption", site=site,
+                       resource=self.resource_id, path=path,
+                       detail=str(e)[:300], quarantined=quarantined)
+        return FetchFailedError(
+            self.resource_id, partition, cause=e,
+            map_ids=None if mid is None else [mid],
+        )
+
     def _read_blocks(self, blocks, partition: int, ctx: TaskContext,
                      fetched: dict) -> BatchStream:
         for block in blocks:
@@ -908,18 +1059,18 @@ class IpcReaderExec(ExecNode):
                 payloads: List[bytes] = []
                 try:
                     if isinstance(block, bytes):
-                        off = 0
-                        while off < len(block):
-                            ln, cid = struct.unpack_from("<IB", block, off)
-                            from ..io.ipc_compression import decompress_frame
-
-                            payloads.append(decompress_frame(block[off : off + 5 + ln]))
-                            off += 5 + ln
+                        # the shared verified walker: flagged frames
+                        # checksum-verify, a block trailer (broadcast
+                        # blobs carry one) is checked and consumed
+                        payloads.extend(iter_blob_frames(
+                            block, site=self.resource_id))
                     else:
                         path, offset, length = block
                         with open(path, "rb") as f:
                             f.seek(offset)
-                            payloads.extend(IpcFrameReader(f, length))
+                            payloads.extend(IpcFrameReader(
+                                f, length, site=self.resource_id,
+                                path=path))
                 except (OSError, struct.error, ValueError, EOFError) as e:
                     # missing/torn/corrupt block: surface as a
                     # typed fetch failure so the scheduler knows to
@@ -928,11 +1079,7 @@ class IpcReaderExec(ExecNode):
                     # the same bad bytes (≙ FetchFailedException);
                     # the block path names the producing map task, so
                     # recovery can re-run JUST that one
-                    mid = block_map_id(block)
-                    raise FetchFailedError(
-                        self.resource_id, partition, cause=e,
-                        map_ids=None if mid is None else [mid],
-                    ) from e
+                    raise self._fetch_failed(block, partition, e) from e
                 # counted only once the block's payloads are in hand:
                 # a failed fetch must not report bytes it never read
                 fetched["blocks"] += 1
@@ -948,11 +1095,7 @@ class IpcReaderExec(ExecNode):
                     # producer bytes, not a transient compute error
                     b = deserialize_batch(p, self._schema)
                 except (struct.error, ValueError, EOFError) as e:
-                    mid = block_map_id(block)
-                    raise FetchFailedError(
-                        self.resource_id, partition, cause=e,
-                        map_ids=None if mid is None else [mid],
-                    ) from e
+                    raise self._fetch_failed(block, partition, e) from e
                 if b.num_rows:
                     self.metrics.add("output_rows", b.num_rows)
                     yield b.to_device()
@@ -963,8 +1106,49 @@ class LocalShuffleManager:
     analogue of BlazeShuffleManager + IndexShuffleBlockResolver."""
 
     def __init__(self, root: Optional[str] = None):
+        fresh = root is None
         self.root = root or tempfile.mkdtemp(prefix="blaze_shuffle_")
+        pre_existing = not fresh and os.path.isdir(self.root)
         os.makedirs(self.root, exist_ok=True)
+        # the disk-pressure ladder's reclaim sweeps registered roots
+        diskmgr.register_root(self.root)
+        if pre_existing:
+            # orphan sweep on startup: a manager re-opened over an
+            # EXISTING root (restarted driver, worker joining a shared
+            # root) reclaims a crashed prior process's debris —
+            # age-gated so a LIVE neighbor's staging temps survive
+            self.sweep_orphans()
+
+    def sweep_orphans(self, max_age_s: Optional[float] = None) -> int:
+        """Age-gated startup reclamation: stale ``.inprogress`` staging
+        temps under this root plus orphaned ``blaze_spill_`` files in
+        the spill temp dir (conf ``spark.blaze.shuffle.orphanSweepAgeSec``;
+        0 disables).  Quarantined ``.corrupt`` files are forensic
+        evidence and always survive.  Returns files removed."""
+        age = float(conf.ORPHAN_SWEEP_AGE.get()) if max_age_s is None \
+            else max_age_s
+        if age <= 0:
+            return 0
+        import time as _time
+
+        cutoff = _time.time() - age
+        removed = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        for fn in names:
+            if ".inprogress" not in fn or fn.endswith(".corrupt"):
+                continue
+            path = os.path.join(self.root, fn)
+            try:
+                if os.path.getmtime(path) <= cutoff:
+                    os.unlink(path)
+                    removed += 1
+            except OSError:
+                continue
+        removed += diskmgr.sweep_stale_spills(age)
+        return removed
 
     def map_output_paths(self, shuffle_id: int, map_id: int) -> Tuple[str, str]:
         base = os.path.join(self.root, f"shuffle_{shuffle_id}_{map_id}")
@@ -978,7 +1162,8 @@ class LocalShuffleManager:
         executor's map outputs).  ``map_ids`` restricts the drop to
         those map tasks' outputs (partial re-run: only the missing
         producers are regenerated, the surviving outputs keep feeding
-        the reduce barrier).  Returns files removed."""
+        the reduce barrier).  Quarantined ``.corrupt`` files are kept
+        for forensics.  Returns files removed."""
         removed = 0
         if map_ids is not None:
             prefixes = tuple(
@@ -990,7 +1175,7 @@ class LocalShuffleManager:
         except OSError:
             return 0
         for fn in names:
-            if fn.startswith(prefixes):
+            if fn.startswith(prefixes) and not fn.endswith(".corrupt"):
                 try:
                     os.unlink(os.path.join(self.root, fn))
                     removed += 1
@@ -1022,7 +1207,8 @@ class LocalShuffleManager:
         except OSError:
             return 0
         for fn in names:
-            if not fn.startswith(prefix) or ".inprogress" not in fn:
+            if not fn.startswith(prefix) or ".inprogress" not in fn \
+                    or fn.endswith(".corrupt"):
                 continue
             if asuffix is not None and not fn.endswith(asuffix):
                 continue

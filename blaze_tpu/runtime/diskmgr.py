@@ -1,0 +1,195 @@
+"""Graceful degradation under DISK pressure.
+
+The device-OOM ladder (runtime/oom.py) turned ``RESOURCE_EXHAUSTED``
+from a query killer into a degradation rung; this module is its
+disk-side counterpart.  Before it, an ``ENOSPC`` mid-spill or
+mid-shuffle-write was just an abort — at production scale a full disk
+is routine, and most of what fills it is OUR OWN reclaimable debris
+(abandoned attempts' ``.inprogress`` staging temps, a crashed
+process's ``blaze_spill_`` files).
+
+The ladder, walked by the spill and shuffle-write paths on
+``ENOSPC``/``EDQUOT``/``EIO``:
+
+1. **Victim re-selection** (``memmgr._drain_victims``): a spill victim
+   whose disk write fails is skipped and the NEXT victim tried — it
+   may spill to host RAM or a different mount, and one full disk must
+   not fail an unrelated task's accounting update.
+2. **Reclaim** (:func:`reclaim`): age-gated sweep of stale
+   ``.inprogress`` temps in every registered shuffle root plus
+   orphaned ``blaze_spill_`` files in the spill temp dir — then the
+   write retries once.
+3. **In-memory eager fallback**: a file spill that still cannot reach
+   disk migrates into host RAM, bounded by the memmgr quota (the
+   budget the spill was shedding toward is still enforced — this rung
+   trades watermark headroom for progress).
+4. **Typed retryable failure** (:class:`DiskExhaustedError`):
+   classified RETRY, so the attempt loop re-runs the task — by then
+   pressure may have subsided, and the failure names the site instead
+   of surfacing as a raw ``OSError``.
+
+Every recovery records ``disk_pressure_recoveries``
+(:func:`runtime.dispatch.record` -> stage captures -> MetricNode ->
+``/metrics``); the ``disk_pressure`` trace event is emitted by callers
+OUTSIDE their locks (the ``lock.emit-under-lock`` class) and rendered
+in ``--report``'s recovery timeline.  The faults grammar's ``@enospc``
+modifier (e.g. ``shuffle.write@1@enospc``) injects
+:class:`runtime.faults.InjectedDiskFull` — a real ``OSError`` carrying
+``errno.ENOSPC`` — making the whole ladder deterministically testable
+without filling a real disk.
+"""
+
+from __future__ import annotations
+
+import errno
+import glob
+import os
+import tempfile
+import time
+from typing import List, Optional, Set
+
+from ..analysis.locks import make_lock
+from . import lockset
+
+#: errnos the ladder treats as disk pressure: out of space/quota, or
+#: an IO error on the write path (a dying disk looks like pressure to
+#: the retry ladder — the task retry may land on healthier storage)
+DISK_ERRNOS = frozenset({errno.ENOSPC, errno.EDQUOT, errno.EIO})
+
+
+class DiskExhaustedError(RuntimeError):
+    """The disk-pressure ladder is exhausted: reclaim freed nothing
+    usable and the in-memory fallback is over the memmgr quota.
+    Retryable (``retry.classify`` -> RETRY): pressure may have subsided
+    by the re-attempt, and the typed error names the site instead of a
+    raw ``OSError`` burning the budget anonymously."""
+
+    def __init__(self, site: str, cause: Optional[BaseException] = None):
+        self.site = site
+        super().__init__(
+            f"disk exhausted at {site} after the degradation ladder "
+            f"(victim re-selection, reclaim, in-memory fallback)"
+            + (f": {cause}" if cause is not None else ""))
+
+
+def is_disk_pressure(exc: BaseException) -> bool:
+    """Is this exception a disk-side pressure failure the ladder should
+    absorb?  True for ``OSError`` with an ENOSPC/EDQUOT/EIO errno
+    (including the fault injector's :class:`faults.InjectedDiskFull`
+    stand-in).  :class:`DiskExhaustedError` itself is NOT pressure —
+    the ladder already ran; re-absorbing it would loop."""
+    return isinstance(exc, OSError) and exc.errno in DISK_ERRNOS
+
+
+# ------------------------------------------------------ reclaim state
+
+_LOCK = make_lock("diskmgr.state")
+_TALLY = lockset.module_guard(__name__)
+
+#: shuffle roots whose stale staging temps reclaim may sweep — every
+#: LocalShuffleManager registers its root on construction
+_ROOTS: Set[str] = set()
+
+#: guarded-by declaration (analysis/guarded.py): registration comes
+#: from manager construction on any thread, reclaim from whichever
+#: spill/write path hit disk pressure
+GUARDED_BY = {"_ROOTS": "diskmgr.state"}
+GUARDED_REFS = ("_ROOTS",)
+
+
+def register_root(root: str) -> None:
+    global _ROOTS
+    with _LOCK:
+        lockset.check(_TALLY, "_ROOTS")
+        # |= rather than .add(): the emit-under-lock rule's simple-name
+        # closure marks every function NAMED like an emitter helper,
+        # and set.add collides with MetricsSet.add
+        _ROOTS |= {root}
+
+
+def registered_roots() -> List[str]:
+    with _LOCK:
+        lockset.check(_TALLY, "_ROOTS")
+        return sorted(_ROOTS)
+
+
+def _reclaim_age() -> float:
+    from .. import conf
+
+    return max(0.0, float(conf.DISK_RECLAIM_AGE.get()))
+
+
+def sweep_stale_spills(max_age_s: Optional[float] = None) -> int:
+    """Unlink orphaned ``blaze_spill_`` temp files older than the age
+    gate — debris of a crashed prior process (a LIVE process's spill
+    files are recent and survive the gate).  Returns files removed."""
+    age = _reclaim_age() if max_age_s is None else max_age_s
+    cutoff = time.time() - age
+    removed = 0
+    for path in glob.glob(
+            os.path.join(tempfile.gettempdir(), "blaze_spill_*")):
+        try:
+            if os.path.getmtime(path) <= cutoff:
+                os.unlink(path)
+                removed += 1
+        except OSError:
+            continue
+    return removed
+
+
+def _sweep_stale_inprogress(root: str, cutoff: float) -> int:
+    removed = 0
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    for fn in names:
+        if ".inprogress" not in fn or fn.endswith(".corrupt"):
+            continue
+        path = os.path.join(root, fn)
+        try:
+            if os.path.getmtime(path) <= cutoff:
+                os.unlink(path)
+                removed += 1
+        except OSError:
+            continue
+    return removed
+
+
+def reclaim(max_age_s: Optional[float] = None,
+            extra_roots: Optional[List[str]] = None) -> int:
+    """Ladder rung 2: free reclaimable disk — stale ``.inprogress``
+    staging temps in every registered shuffle root (plus
+    ``extra_roots``) and aged orphan spill files.  Age-gated
+    (``spark.blaze.disk.reclaimAgeSec``) so a LIVE attempt's staging
+    temps are never swept out from under it.  Returns files removed;
+    callers retry their write once when anything was freed (and may
+    retry regardless — the failed allocation itself was rolled back).
+
+    Deliberately emission-free: reclaim runs inside spill/write
+    critical sections (consumer locks held), where event emission is
+    the PR 3 deadlock class.  Callers record the ``disk_pressure``
+    event after their locks release."""
+    age = _reclaim_age() if max_age_s is None else max_age_s
+    cutoff = time.time() - age
+    removed = 0
+    for root in registered_roots() + list(extra_roots or ()):
+        removed += _sweep_stale_inprogress(root, cutoff)
+    removed += sweep_stale_spills(age)
+    return removed
+
+
+def record_recovery() -> None:
+    """Count one disk-pressure recovery (rung-agnostic counter; the
+    paired ``disk_pressure`` trace event carries the action and is
+    emitted by the caller outside its locks)."""
+    from . import dispatch
+
+    dispatch.record("disk_pressure_recoveries")
+
+
+def reset() -> None:
+    """Forget registered roots (tests)."""
+    with _LOCK:
+        lockset.check(_TALLY, "_ROOTS")
+        _ROOTS.clear()

@@ -41,13 +41,30 @@ override ``BLAZE_FAULTS_SPEC``, so worker subprocesses inherit it) with
 the grammar::
 
     spec     := entry ("," entry)*
-    entry    := site "@" hit [ "@a" attempt ] [ "@slow" ms | "@oom" ]
+    entry    := site "@" hit [ "@a" attempt ]
+                [ "@slow" ms | "@oom" | "@corrupt" | "@enospc" ]
     example  := "shuffle.fetch@2,task.compute@1@a0,kernel.dispatch@3@oom"
 
 An ``@oom`` entry raises :class:`InjectedOom` — a stand-in for XLA's
 ``RESOURCE_EXHAUSTED`` that the degradation ladder (runtime/oom.py)
 must absorb: spill, batch downshift, eager fallback — making the
 ladder deterministically testable without exhausting a real device.
+
+An ``@enospc`` entry raises :class:`InjectedDiskFull` — a real
+``OSError`` carrying ``errno.ENOSPC`` — so the DISK-pressure ladder
+(runtime/diskmgr.py: reclaim, in-memory fallback, typed retryable
+``DiskExhaustedError``) is deterministically testable without filling
+a disk.
+
+A ``@corrupt`` entry injects POST-COMMIT bit-rot instead of raising:
+write sites probe :func:`corrupt` after their bytes are staged/
+committed, and a matching rule makes the probe return True — the site
+then flips a payload byte (``runtime.integrity.flip_byte``), so the
+read boundary's checksum verification — not the write path — must
+catch it (the zero-silent-wrong-results contract the corruption-storm
+chaos arm asserts).  Corrupt rules count on their OWN per-site hit
+counter (the Nth corruption OPPORTUNITY, i.e. the Nth committed block
+at the site), independent of the raise-probe counter.
 
 Hit counters are per-process.  The schedule is loaded from conf at the
 FIRST :func:`hit` of the process and re-loaded (counters reset) by
@@ -77,6 +94,12 @@ SITES = (
     # kernel may run on the async stager or a sibling attempt's
     # thread — so rely on the one-shot hit counter
     "kernel.dispatch",
+    # broadcast blob collection (parallel/broadcast.py IpcWriterExec /
+    # collect_ipc) — crash and @corrupt injectable
+    "broadcast.write",
+    # worker result-frame commit (runtime/worker.py) — @corrupt flips
+    # a committed result byte the DRIVER's verification must catch
+    "worker.result",
 )
 
 
@@ -106,10 +129,31 @@ class InjectedOom(InjectedFault):
             f"(hit {hit})" + (f": {detail}" if detail else ""),)
 
 
-# (site, hit_no, attempt_filter, slow_ms, oom) — attempt_filter None =
+class InjectedDiskFull(OSError):
+    """An injected ``ENOSPC`` (the ``@enospc`` modifier): a REAL
+    ``OSError`` with ``errno.ENOSPC``, so ``diskmgr.is_disk_pressure``
+    classifies it exactly like the allocator failure it stands in for
+    and the disk-pressure ladder — not the bare retry loop — absorbs
+    it."""
+
+    def __init__(self, site: str, hit: int, detail: str = ""):
+        import errno
+
+        super().__init__(
+            errno.ENOSPC,
+            f"injected ENOSPC at {site} (hit {hit})"
+            + (f": {detail}" if detail else ""))
+        self.site = site
+        self.hit = hit
+
+
+# (site, hit_no, attempt_filter, slow_ms, kind) — attempt_filter None =
 # any attempt; slow_ms None = raise, otherwise sleep that long and
-# return; oom True = raise InjectedOom instead of InjectedFault
-Rule = Tuple[str, int, Optional[int], Optional[int], bool]
+# return.  ``kind`` keeps the historical oom-bool shape (False = plain
+# InjectedFault, True = InjectedOom) and grows the string kinds
+# "corrupt" (post-commit byte flip via the :func:`corrupt` probe) and
+# "enospc" (InjectedDiskFull at the raise probe).
+Rule = Tuple[str, int, Optional[int], Optional[int], object]
 
 
 def parse_spec(spec: str) -> List[Rule]:
@@ -126,12 +170,18 @@ def parse_spec(spec: str) -> List[Rule]:
             raise ValueError(f"unknown fault site {site!r} (known: {SITES})")
         attempt: Optional[int] = None
         slow_ms: Optional[int] = None
-        oom = False
+        kind: object = False
         for mod in parts[2:]:
             if mod == "oom":
-                if oom:
-                    raise ValueError(f"duplicate oom modifier in {entry!r}")
-                oom = True
+                if kind is not False:
+                    raise ValueError(
+                        f"duplicate/conflicting kind modifier in {entry!r}")
+                kind = True
+            elif mod in ("corrupt", "enospc"):
+                if kind is not False:
+                    raise ValueError(
+                        f"duplicate/conflicting kind modifier in {entry!r}")
+                kind = mod
             elif mod.startswith("slow"):
                 if slow_ms is not None:
                     raise ValueError(f"duplicate slow modifier in {entry!r}")
@@ -142,23 +192,25 @@ def parse_spec(spec: str) -> List[Rule]:
                 attempt = int(mod[1:])
             else:
                 raise ValueError(f"bad modifier {mod!r} in {entry!r}")
-        if oom and slow_ms is not None:
+        if kind is not False and slow_ms is not None:
             raise ValueError(
-                f"oom and slow modifiers are exclusive in {entry!r}")
-        rules.append((site, hit, attempt, slow_ms, oom))
+                f"kind and slow modifiers are exclusive in {entry!r}")
+        rules.append((site, hit, attempt, slow_ms, kind))
     return rules
 
 
 def format_spec(rules: List[Rule]) -> str:
     out = []
-    for site, hit, attempt, slow_ms, oom in rules:
+    for site, hit, attempt, slow_ms, kind in rules:
         s = f"{site}@{hit}"
         if attempt is not None:
             s += f"@a{attempt}"
         if slow_ms is not None:
             s += f"@slow{slow_ms}"
-        if oom:
+        if kind is True:
             s += "@oom"
+        elif kind:
+            s += f"@{kind}"
         out.append(s)
     return ",".join(out)
 
@@ -234,11 +286,20 @@ class FaultInjector:
     """Per-process hit counters against a parsed schedule."""
 
     def __init__(self, rules: List[Rule]):
+        # raise-probe rules (plain/oom/enospc/slow) and corrupt-probe
+        # rules keyed apart: the two probes count independently — a
+        # corrupt rule's hit number means "the Nth committed block at
+        # the site", not "the Nth raise-probe pass"
         self._by_site: Dict[
-            str, List[Tuple[int, Optional[int], Optional[int], bool]]] = {}
-        for site, hit, attempt, slow_ms, oom in rules:
-            self._by_site.setdefault(site, []).append(
-                (hit, attempt, slow_ms, oom))
+            str, List[Tuple[int, Optional[int], Optional[int], object]]] = {}
+        self._corrupt_by_site: Dict[str, List[Tuple[int, Optional[int]]]] = {}
+        for site, hit, attempt, slow_ms, kind in rules:
+            if kind == "corrupt":
+                self._corrupt_by_site.setdefault(site, []).append(
+                    (hit, attempt))
+            else:
+                self._by_site.setdefault(site, []).append(
+                    (hit, attempt, slow_ms, kind))
         self._counts: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -249,7 +310,7 @@ class FaultInjector:
         with self._lock:
             n = self._counts.get(site, 0) + 1
             self._counts[site] = n
-        for hit_no, want_attempt, slow_ms, oom in matches:
+        for hit_no, want_attempt, slow_ms, kind in matches:
             if n == hit_no and (want_attempt is None or want_attempt == attempt):
                 # record the injection BEFORE raising/sleeping so a
                 # chaos run's event log pairs every fault with its
@@ -262,13 +323,21 @@ class FaultInjector:
                                detail=detail)
                     time.sleep(slow_ms / 1000.0)
                     return
-                if oom:
+                if kind is True:
                     # kind=oom: the reconciliation gate pairs this with
                     # an oom_recovery (the degradation ladder) instead
                     # of a task retry
                     trace.emit("fault_injected", site=site, hit=n,
                                attempt=attempt, detail=detail, kind="oom")
                     raise InjectedOom(site, n, detail)
+                if kind == "enospc":
+                    # kind=enospc: pairs with a disk_pressure recovery
+                    # (the disk ladder) or a plain retry when the
+                    # ladder escalated to the typed retryable error
+                    trace.emit("fault_injected", site=site, hit=n,
+                               attempt=attempt, detail=detail,
+                               kind="enospc")
+                    raise InjectedDiskFull(site, n, detail)
                 trace.emit("fault_injected", site=site, hit=n,
                            attempt=attempt, detail=detail)
                 if site == "shuffle.fetch":
@@ -278,6 +347,30 @@ class FaultInjector:
                         detail or "injected", hit=n, injected=True
                     )
                 raise InjectedFault(site, n, detail)
+
+    def corrupt(self, site: str, attempt: int = 0, detail: str = "") -> bool:
+        """The POST-COMMIT corruption probe: count one corruption
+        opportunity at ``site`` and return True when an ``@corrupt``
+        rule fires — the call site then flips a committed byte.  Emits
+        ``fault_injected`` with ``kind="corrupt"`` so the storm gate
+        can pair the injection with its ``block_corruption`` detection
+        and recovery.  Call OUTSIDE any state lock (emission)."""
+        matches = self._corrupt_by_site.get(site)
+        if not matches:
+            return False
+        key = site + "#corrupt"
+        with self._lock:
+            n = self._counts.get(key, 0) + 1
+            self._counts[key] = n
+        for hit_no, want_attempt in matches:
+            if n == hit_no and (want_attempt is None
+                                or want_attempt == attempt):
+                from . import trace
+
+                trace.emit("fault_injected", site=site, hit=n,
+                           attempt=attempt, detail=detail, kind="corrupt")
+                return True
+        return False
 
 
 _NOOP = FaultInjector([])
@@ -306,6 +399,19 @@ def hit(site: str, attempt: int = 0, detail: str = "") -> None:
     if not _armed:
         return
     _active.hit(site, attempt, detail)
+
+
+def corrupt(site: str, attempt: int = 0, detail: str = "") -> bool:
+    """Post-commit corruption probe (the ``@corrupt`` modifier): True
+    when the schedule says the Nth committed block at ``site`` must be
+    bit-flipped.  Disarmed this is a single bool check.  Must be
+    called OUTSIDE state locks — a firing probe emits the
+    ``fault_injected`` event."""
+    if not _loaded:
+        _load_from_conf()
+    if not _armed:
+        return False
+    return _active.corrupt(site, attempt, detail)
 
 
 def reset() -> None:

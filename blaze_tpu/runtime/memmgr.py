@@ -25,7 +25,8 @@ import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import conf
-from . import lockset
+from . import diskmgr, integrity, lockset
+from .diskmgr import DiskExhaustedError
 
 #: per-query OWNER attribution for consumers (the multi-tenant service,
 #: runtime/service.py): consumers registered while an owner scope is
@@ -78,8 +79,16 @@ def set_quota_hook(fn: Optional[Callable[[Tuple[str, str]], None]]) -> None:
 class Spill:
     """One spill unit: sequence of frames written once, read once.
     Frame format: [u32 len][u8 codec][payload] — same framing idea as
-    the reference's ipc_compression (common/ipc_compression.rs:30-77).
+    the reference's ipc_compression (common/ipc_compression.rs:30-77),
+    plus the integrity layer's per-frame checksum trailer (codec high
+    bit + [u8 algo][u32 sum] over the stored bytes) when
+    ``spark.blaze.io.checksum`` is armed: a spilled frame re-read with
+    flipped bits raises typed ``BlockCorruptionError`` instead of
+    silently feeding wrong rows back into the query, and the owning
+    task's retry rebuilds the consumer's state.
     """
+
+    _corrupt_next = False  # @corrupt fault modifier: flip the next frame
 
     def write_frame(self, payload: bytes) -> None:
         raise NotImplementedError
@@ -95,29 +104,73 @@ class Spill:
 
     size: int = 0
 
+    def corrupt_next_frame(self) -> None:
+        """Arm post-encode corruption of the NEXT written frame (the
+        ``spill.write@N@corrupt`` fault modifier).  The probe that set
+        this ran OUTSIDE the consumer's lock (its trace emission must
+        never ride inside a spill critical section); the flip itself is
+        pure byte arithmetic and safe anywhere."""
+        self._corrupt_next = True
 
-def _encode_frame(payload: bytes, codec: str) -> bytes:
+    def _maybe_corrupt(self, frame: bytes) -> bytes:
+        if not self._corrupt_next:
+            return frame
+        self._corrupt_next = False
+        # flip INSIDE the stored payload (past the 5-byte header), so
+        # the frame still parses and the checksum — not the framing —
+        # is what catches it, like real bit-rot on a committed write
+        return integrity.flip_byte(frame, 5 + max(0, (len(frame) - 10) // 2))
+
+
+def _encode_frame(payload: bytes, codec: str,
+                  algo: Optional[int] = ...) -> bytes:
     # NOTE: the spill.write fault probe lives at the consumer spill()
     # entry points (shuffle/sort/agg/smj), OUTSIDE their state locks —
     # probing here put a trace emission (fault_injected) three helper
     # hops inside every spill critical section, which is exactly the
     # lock.emit-under-lock class the linter pins (the two waivers that
-    # covered it are gone)
+    # covered it are gone).  ``algo`` is resolved ONCE per Spill by the
+    # caller (a conf-store read per frame would serialize concurrent
+    # spillers on the conf lock).
     if codec == "zlib":
         comp = zlib.compress(payload, 1)
-        return len(comp).to_bytes(4, "little") + b"\x01" + comp
-    return len(payload).to_bytes(4, "little") + b"\x00" + payload
+        cid = 1
+    else:
+        comp = payload
+        cid = 0
+    if algo is ...:
+        algo = integrity.frame_algo()
+    if algo is None:
+        return len(comp).to_bytes(4, "little") + bytes([cid]) + comp
+    return (len(comp).to_bytes(4, "little")
+            + bytes([cid | integrity.CHECKSUM_FLAG]) + comp
+            + integrity.frame_trailer(comp, algo))
 
 
-def _read_frame_from(f) -> Optional[bytes]:
+def _read_frame_from(f, path: Optional[str] = None,
+                     armed: Optional[bool] = None) -> Optional[bytes]:
     hdr = f.read(5)
     if len(hdr) < 5:
         return None
     ln = int.from_bytes(hdr[:4], "little")
     codec = hdr[4]
     payload = f.read(ln)
+    if len(payload) < ln:
+        raise integrity.BlockCorruptionError("spill.read", "torn frame",
+                                             path=path)
+    if codec & integrity.CHECKSUM_FLAG:
+        integrity.verify_bytes(payload, f.read(integrity.TRAILER_LEN),
+                               "spill.read", path=path, armed=armed)
+        codec &= ~integrity.CHECKSUM_FLAG
     if codec == 1:
-        payload = zlib.decompress(payload)
+        try:
+            payload = zlib.decompress(payload)
+        except zlib.error as e:
+            # undetectable via framing alone: surface as the typed
+            # corruption the retry ladder classifies, not a raw codec
+            # error
+            raise integrity.BlockCorruptionError(
+                "spill.read", f"zlib: {e}", path=path) from e
     return payload
 
 
@@ -129,9 +182,12 @@ class HostMemSpill(Spill):
         self._buf = io.BytesIO()
         self._codec = codec
         self._read: Optional[io.BytesIO] = None
+        # conf resolved once per spill, not per frame (hot path)
+        self._algo = integrity.frame_algo()
 
     def write_frame(self, payload: bytes) -> None:
-        self._buf.write(_encode_frame(payload, self._codec))
+        self._buf.write(self._maybe_corrupt(
+            _encode_frame(payload, self._codec, self._algo)))
         self.size = self._buf.tell()
 
     def complete(self) -> None:
@@ -140,7 +196,7 @@ class HostMemSpill(Spill):
 
     def read_frame(self) -> Optional[bytes]:
         assert self._read is not None, "complete() before reading"
-        return _read_frame_from(self._read)
+        return _read_frame_from(self._read, armed=self._algo is not None)
 
     def release(self) -> None:
         self._buf = io.BytesIO()
@@ -149,25 +205,107 @@ class HostMemSpill(Spill):
 
 
 class FileSpill(Spill):
-    """Disk-backed spill (≙ FileSpill on a tempfile)."""
+    """Disk-backed spill (≙ FileSpill on a tempfile), with the
+    disk-pressure ladder (runtime/diskmgr.py) on the write path: an
+    ``ENOSPC``/``EIO`` mid-frame rolls back the partial write, RECLAIMS
+    stale staging debris and retries once, then migrates the spill into
+    host RAM (bounded by the memmgr quota) before giving up with typed
+    retryable :class:`DiskExhaustedError`.  Recoveries count
+    ``disk_pressure_recoveries``; the ladder is deliberately
+    emission-free — write_frame runs inside consumer locks, where event
+    emission is the PR 3 deadlock class."""
 
     def __init__(self, codec: str, dir: Optional[str] = None):
         fd, self.path = tempfile.mkstemp(prefix="blaze_spill_", dir=dir)
         self._f = os.fdopen(fd, "w+b")
         self._codec = codec
+        self._mem: Optional[io.BytesIO] = None  # host-RAM fallback tier
+        # conf resolved once per spill, not per frame (hot path)
+        self._algo = integrity.frame_algo()
+
+    def _rollback_partial(self) -> None:
+        """Drop a torn partial frame so a retried/migrated write never
+        leaves garbage between committed frames."""
+        try:
+            self._f.seek(self.size)
+            self._f.truncate()
+        except OSError:
+            pass
+
+    def _migrate_to_memory(self, site: str,
+                           cause: BaseException) -> None:
+        """Ladder rung 3: continue this spill in host RAM when the
+        memmgr budget still has room for it — the spill was shedding
+        toward that budget, so the bound it enforces survives."""
+        mgr = MemManager.get()
+        if mgr.total_used() + self.size >= mgr.total:
+            raise DiskExhaustedError(site, cause) from cause
+        try:
+            self._f.seek(0)
+            data = self._f.read(self.size)
+        except OSError:
+            raise DiskExhaustedError(site, cause) from cause
+        mem = io.BytesIO()
+        mem.write(data)
+        self._mem = mem
+        try:
+            self._f.close()
+        finally:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+        diskmgr.record_recovery()
 
     def write_frame(self, payload: bytes) -> None:
-        self._f.write(_encode_frame(payload, self._codec))
+        frame = self._maybe_corrupt(
+            _encode_frame(payload, self._codec, self._algo))
+        if self._mem is not None:
+            self._mem.write(frame)
+            self.size = self._mem.tell()
+            return
+        try:
+            self._f.write(frame)
+        except OSError as e:
+            if not diskmgr.is_disk_pressure(e):
+                raise
+            self._rollback_partial()
+            recovered = False
+            if diskmgr.reclaim() > 0:
+                try:
+                    self._f.write(frame)
+                    recovered = True
+                except OSError as e2:
+                    if not diskmgr.is_disk_pressure(e2):
+                        raise
+                    self._rollback_partial()
+            if recovered:
+                diskmgr.record_recovery()
+            else:
+                self._migrate_to_memory("spill.write", e)
+                self._mem.write(frame)
+                self.size = self._mem.tell()
+                return
         self.size = self._f.tell()
 
     def complete(self) -> None:
+        if self._mem is not None:
+            self._mem.seek(0)
+            return
         self._f.flush()
         self._f.seek(0)
 
     def read_frame(self) -> Optional[bytes]:
-        return _read_frame_from(self._f)
+        armed = self._algo is not None
+        if self._mem is not None:
+            return _read_frame_from(self._mem, armed=armed)
+        return _read_frame_from(self._f, path=self.path, armed=armed)
 
     def release(self) -> None:
+        if self._mem is not None:
+            self._mem = None
+            self.size = 0
+            return
         try:
             self._f.close()
         finally:
@@ -401,7 +539,24 @@ class MemManager:
                 break
             if used == 0:
                 continue
-            freed = v.spill()
+            try:
+                freed = v.spill()
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not (diskmgr.is_disk_pressure(e)
+                        or isinstance(e, DiskExhaustedError)):
+                    raise
+                # disk-pressure ladder rung 1, victim RE-SELECTION: one
+                # full disk under one victim's spill must not fail the
+                # unrelated task whose accounting update triggered this
+                # sweep — the victim keeps its rows (spill-abort
+                # contract) and the NEXT victim may reach host RAM or a
+                # different mount.  No lock is held here, so the event
+                # emission is safe.
+                diskmgr.record_recovery()
+                trace.emit("disk_pressure", action="victim_reselect",
+                           site="spill.write", consumer=v.name,
+                           detail=f"{type(e).__name__}: {e}"[:200])
+                continue
             if freed > 0:
                 with self._lock:
                     lockset.check(self, "spill_count", "spilled_bytes")
@@ -416,9 +571,29 @@ class MemManager:
 def try_new_spill(codec: Optional[str] = None) -> Spill:
     """Host-RAM spill if the budget allows, else a temp file — the
     reference's OnHeapSpill-else-FileSpill decision
-    (memmgr/spill.rs:65-80)."""
+    (memmgr/spill.rs:65-80).  Temp-file CREATION failing with disk
+    pressure walks the ladder: reclaim + retry, then the in-memory
+    eager fallback while the budget has ANY headroom, then typed
+    retryable :class:`DiskExhaustedError` (emission-free — callers may
+    hold their state locks)."""
     codec = codec or str(conf.SPILL_COMPRESSION_CODEC.get())
     mgr = MemManager.get()
     if mgr.total_used() < mgr.total // 2:
         return HostMemSpill(codec)
-    return FileSpill(codec)
+    try:
+        return FileSpill(codec)
+    except OSError as e:
+        if not diskmgr.is_disk_pressure(e):
+            raise
+        if diskmgr.reclaim() > 0:
+            try:
+                sp = FileSpill(codec)
+                diskmgr.record_recovery()
+                return sp
+            except OSError as e2:
+                if not diskmgr.is_disk_pressure(e2):
+                    raise
+        if mgr.total_used() < mgr.total:
+            diskmgr.record_recovery()
+            return HostMemSpill(codec)
+        raise DiskExhaustedError("spill.create", e) from e

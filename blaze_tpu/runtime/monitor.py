@@ -875,6 +875,31 @@ def read_history() -> List[Dict[str, Any]]:
         except (IndexError, ValueError):
             return 0
 
+    import logging
+
+    def read_lines(path: str) -> None:
+        """One tolerant line reader for base files AND orphan
+        segments: a torn final line (crash mid-append) is skipped with
+        a warning instead of aborting the file — a post-crash
+        ``/queries?all=1`` must still render every summary the history
+        did capture.  (The orphan branch previously stopped at the
+        first bad line, silently dropping the rest of the file.)"""
+        try:
+            with open(path) as f:
+                for i, line in enumerate(f, start=1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        logging.getLogger(__name__).warning(
+                            "skipping torn/unparseable history line "
+                            "%s:%d (crash mid-append?)", path, i)
+                        continue
+        except OSError:
+            return
+
     bases = sorted(glob.glob(os.path.join(_history_dir, "history-*.jsonl")))
     seen = set(bases)
     segs = sorted(glob.glob(os.path.join(_history_dir,
@@ -884,26 +909,11 @@ def read_history() -> List[Dict[str, Any]]:
         ordered = [s for s in segs if s.startswith(base + ".seg")] + [base]
         for path in ordered:
             seen.add(path)
-            try:
-                with open(path) as f:
-                    for line in f:
-                        line = line.strip()
-                        if not line:
-                            continue
-                        try:
-                            out.append(json.loads(line))
-                        except ValueError:
-                            continue
-            except OSError:
-                continue
+            read_lines(path)
     # orphan segments whose base already rolled away entirely
     for path in segs:
         if path not in seen:
-            try:
-                with open(path) as f:
-                    out.extend(json.loads(ln) for ln in f if ln.strip())
-            except (OSError, ValueError):
-                continue
+            read_lines(path)
     return out
 
 
@@ -1441,13 +1451,15 @@ def render_prometheus(openmetrics: bool = False) -> str:
             doc.add("blaze_query_stage_bytes", st["bytes"], sl, mtype="gauge")
             doc.add("blaze_query_stage_tasks_done", st["tasks_done"], sl,
                     mtype="gauge")
-            # degradation-ladder counters (runtime/oom.py): exported
-            # only when the ladder fired — and, like elapsed, they
-            # FREEZE at the final value once the query finishes (the
-            # heartbeat-age rule: nothing exported here climbs forever
-            # on a finished query)
+            # degradation-ladder + integrity counters (runtime/oom.py,
+            # runtime/integrity.py, runtime/diskmgr.py): exported only
+            # when they fired — and, like elapsed, they FREEZE at the
+            # final value once the query finishes (the heartbeat-age
+            # rule: nothing exported here climbs forever on a finished
+            # query)
             for k in ("oom_recoveries", "batch_downshifts",
-                      "eager_fallbacks"):
+                      "eager_fallbacks", "corruption_detected",
+                      "blocks_quarantined", "disk_pressure_recoveries"):
                 v = st["counters"].get(k, 0)
                 if v:
                     doc.add(f"blaze_query_stage_{k}", v, sl, mtype="gauge")
@@ -1937,11 +1949,22 @@ def render_watch(snap: Dict[str, Any], url: str = "") -> str:
         # memory pressure and how far down the ladder the query went
         deg = {k: sum(st["counters"].get(k, 0) for st in q["stages"])
                for k in ("oom_recoveries", "batch_downshifts",
-                         "eager_fallbacks")}
-        if any(deg.values()):
+                         "eager_fallbacks", "corruption_detected",
+                         "blocks_quarantined",
+                         "disk_pressure_recoveries")}
+        if any(deg[k] for k in ("oom_recoveries", "batch_downshifts",
+                                "eager_fallbacks")):
             tail += (f"  oom {deg['oom_recoveries']} spill"
                      f"/{deg['batch_downshifts']} downshift"
                      f"/{deg['eager_fallbacks']} eager")
+        # the data-integrity story, when it fired: detections,
+        # quarantines, disk-pressure ladder recoveries
+        if any(deg[k] for k in ("corruption_detected",
+                                "blocks_quarantined",
+                                "disk_pressure_recoveries")):
+            tail += (f"  integrity {deg['corruption_detected']} corrupt"
+                     f"/{deg['blocks_quarantined']} quarantined"
+                     f"/{deg['disk_pressure_recoveries']} disk")
         tenant = f" pool={q['pool']}" if q.get("pool") else ""
         tenant += f" session={q['session']}" if q.get("session") else ""
         lines.append(

@@ -69,6 +69,19 @@ class FetchFailedError(Exception):
                 return None
         return None
 
+    @property
+    def broadcast_id(self) -> Optional[int]:
+        """Producing broadcast id when the resource is a broadcast
+        blob read — a corrupt blob must REGENERATE the producing
+        broadcast stage (re-registering the driver's cached copy would
+        re-read the same bad bytes forever)."""
+        if self.resource_id.startswith("broadcast_"):
+            try:
+                return int(self.resource_id.split("_")[1].split(".")[0])
+            except (IndexError, ValueError):
+                return None
+        return None
+
 
 class TaskTimeoutError(Exception):
     """A task exceeded ``spark.blaze.task.timeout`` seconds (checked
@@ -127,6 +140,19 @@ def classify(exc: BaseException) -> str:
         # the same deterministic failure while hiding the real error
         # behind a retries-exhausted wrapper
         return FATAL
+    # explicit (though RETRY is the default) for the storage-failure
+    # ladder's typed errors, so the contract is visible here:
+    # - BlockCorruptionError outside a shuffle read (a corrupt SPILL
+    #   frame, a corrupt worker result): the owning consumer's state is
+    #   rebuilt by a fresh attempt — RETRY.  (Inside a shuffle read the
+    #   reader has already wrapped it in FetchFailedError above.)
+    # - DiskExhaustedError: the disk-pressure ladder ran out of rungs;
+    #   pressure may have subsided by the re-attempt — RETRY.
+    from .diskmgr import DiskExhaustedError
+    from .integrity import BlockCorruptionError
+
+    if isinstance(exc, (BlockCorruptionError, DiskExhaustedError)):
+        return RETRY
     return RETRY
 
 

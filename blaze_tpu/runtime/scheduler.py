@@ -392,6 +392,9 @@ def run_stages(
     map_stage_by_shuffle: Dict[int, Stage] = {
         s.shuffle_id: s for s in stages if s.kind == "map"
     }
+    bcast_stage_by_id: Dict[int, Stage] = {
+        s.broadcast_id: s for s in stages if s.kind == "broadcast"
+    }
 
     def ipc_readers(plan: ExecNode, prefix: str) -> List[IpcReaderExec]:
         out: List[IpcReaderExec] = []
@@ -496,6 +499,24 @@ def run_stages(
         run_stage_tasks(mstage, tasks=tasks)
         n_maps[mstage.shuffle_id] = mstage.n_tasks
 
+    def regenerate_broadcast_stage(bstage: Stage) -> None:
+        """Fetch-failure recovery for a CORRUPT broadcast blob: re-run
+        the producing broadcast stage and re-collect its blobs.  The
+        driver's cached copy is the corrupt artifact itself, so —
+        unlike the pre-integrity fallback that re-registered the same
+        bytes and burned the retry budget on identical failures — the
+        producer must regenerate."""
+        sched_m.add("map_stage_reruns", 1)
+        sched_m.add("map_tasks_rerun", bstage.n_tasks)
+        trace.emit("map_stage_rerun", stage_id=bstage.stage_id,
+                   shuffle_id=-1, broadcast_id=bstage.broadcast_id,
+                   map_ids=None)
+        run_stage_tasks(bstage)
+        bcast_blobs[bstage.broadcast_id] = [
+            RESOURCES.get(f"broadcast_{bstage.broadcast_id}.{p}")
+            for p in range(bstage.n_tasks)
+        ]
+
     def handle_failure(stage: Stage, t: int, exc: BaseException,
                        attempt: int, regens: int, sleep: bool = True):
         """Classify a failed attempt and perform the recovery
@@ -521,9 +542,23 @@ def run_stages(
                 regenerate_map_stage(mstage, map_ids=exc.map_ids)
                 # doesn't consume the retry budget
                 return (attempt, regens) if sleep else (attempt, regens, 0.0)
-            # producer unresolvable (e.g. a broadcast read, whose blobs
-            # re-register from the driver's copy every attempt): a
-            # plain re-run can still succeed, so fall through to RETRY
+            bid = getattr(exc, "broadcast_id", None)
+            bstage = bcast_stage_by_id.get(bid) if bid is not None else None
+            if bstage is not None:
+                # a corrupt broadcast blob: re-registering the driver's
+                # cached copy would re-read the same bad bytes — the
+                # producing broadcast stage regenerates instead (same
+                # regen budget as map-stage recovery)
+                regens += 1
+                if regens > policy.max_stage_regens:
+                    raise TaskRetriesExhausted(
+                        stage.stage_id, t, attempt + 1, exc
+                    ) from exc
+                regenerate_broadcast_stage(bstage)
+                return (attempt, regens) if sleep else (attempt, regens, 0.0)
+            # producer unresolvable (an in-process broadcast read with
+            # no owning stage): a plain re-run can still succeed, so
+            # fall through to RETRY
             action = RETRY
         if action == RETRY:
             attempt += 1

@@ -75,6 +75,7 @@ EVENT_TYPES = frozenset({
     "stage_progress", "task_heartbeat",
     "fault_injected", "straggler_injected",
     "oom_recovery",
+    "block_corruption", "disk_pressure",
     "mem_watermark", "spill",
     "shuffle_write", "shuffle_fetch", "rss_push",
 })
@@ -376,8 +377,16 @@ def query(query_id: str, trace_id: Optional[str] = None,
         lockset.check(_LOG, "_path", "_seq", "_spans_opened")
         _seq += 1
         safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in query_id)
-        path = os.path.join(_dir, f"{safe}-{os.getpid()}-{_seq}.jsonl")
         os.makedirs(_dir, exist_ok=True)
+        # never REUSE an existing file: reset() zeroes the sequence
+        # counter, so a repeated query id after a reset (chaos sweeps
+        # re-arming tracing per seed) would otherwise APPEND to the
+        # previous run's log — two trace ids in one file, a torn
+        # reconciliation for both runs
+        path = os.path.join(_dir, f"{safe}-{os.getpid()}-{_seq}.jsonl")
+        while os.path.exists(path):
+            _seq += 1
+            path = os.path.join(_dir, f"{safe}-{os.getpid()}-{_seq}.jsonl")
         prev = _path
         _path = path
         _current_path = _path or _default_path
@@ -518,17 +527,24 @@ def sum_kernels(sink: Dict[str, Dict[str, int]]) -> Dict[str, int]:
 # ------------------------------------------------------------- reading
 
 def read_events(path: str) -> List[Dict[str, Any]]:
-    """Parse a JSONL event log (torn trailing line tolerated — the
-    writer appends whole lines but a crash can truncate the last)."""
+    """Parse a JSONL event log.  A torn line (a crash mid-append — the
+    writer flushes whole lines, but the filesystem makes no promise)
+    is SKIPPED with a warning instead of raising, so a post-crash
+    ``--report`` still renders everything the log did capture."""
+    import logging
+
     out: List[Dict[str, Any]] = []
     with open(path) as f:
-        for line in f:
+        for i, line in enumerate(f, start=1):
             line = line.strip()
             if not line:
                 continue
             try:
                 out.append(json.loads(line))
             except json.JSONDecodeError:
+                logging.getLogger(__name__).warning(
+                    "skipping torn/unparseable event-log line %s:%d "
+                    "(crash mid-append?)", path, i)
                 continue
     return out
 

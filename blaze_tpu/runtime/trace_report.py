@@ -26,6 +26,17 @@ RECOVERY_EVENTS = ("task_retry", "map_stage_rerun")
 #: still counting for a ladder-exhausted attempt that re-ran
 OOM_RECOVERY_EVENTS = ("oom_recovery",) + RECOVERY_EVENTS
 
+#: recovery candidates for an injected CORRUPTION (``@corrupt`` faults
+#: carry ``kind: "corrupt"``): the read boundary's typed DETECTION
+#: event first (zero silent wrong results means the flip must be
+#: SEEN), with the retry/rerun events covering the recovery itself
+CORRUPTION_RECOVERY_EVENTS = ("block_corruption",) + RECOVERY_EVENTS
+
+#: recovery candidates for an injected ENOSPC (``kind: "enospc"``):
+#: the disk-pressure ladder's own event when a rung absorbed it, the
+#: retry events when it escalated to the typed retryable error
+DISK_RECOVERY_EVENTS = ("disk_pressure",) + RECOVERY_EVENTS
+
 #: incident event types the recovery timeline shows — ONE definition
 #: for the text report and the JSON profile, so a new event type can
 #: never appear in one rendering and silently miss the other
@@ -34,7 +45,8 @@ TIMELINE_TYPES = frozenset({
     "fetch_failure", "task_retry", "task_timeout",
     "map_stage_rerun", "speculative_attempt_start",
     "speculative_attempt_won", "speculative_attempt_lost",
-    "oom_recovery", "query_cancel_requested", "query_cancelled",
+    "oom_recovery", "block_corruption", "disk_pressure",
+    "query_cancel_requested", "query_cancelled",
 })
 
 
@@ -191,14 +203,18 @@ def reconcile_faults(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     chaos gate's reconciliation contract: a fault the runtime absorbed
     silently (no recovery recorded) or a recovery with no cause both
     break the replayable-recovery story."""
+    by_kind = {"oom": OOM_RECOVERY_EVENTS,
+               "corrupt": CORRUPTION_RECOVERY_EVENTS,
+               "enospc": DISK_RECOVERY_EVENTS}
     pairs, unpaired = _pair_requests(
         events,
         lambda e: e.get("type") == "fault_injected",
-        lambda e, f: f.get("type") in (
-            OOM_RECOVERY_EVENTS if e.get("kind") == "oom"
-            else RECOVERY_EVENTS))
+        lambda e, f: f.get("type") in by_kind.get(e.get("kind"),
+                                                  RECOVERY_EVENTS))
+    recovery_types = set(OOM_RECOVERY_EVENTS) | {"block_corruption",
+                                                 "disk_pressure"}
     recoveries = sum(1 for e in events
-                     if e.get("type") in OOM_RECOVERY_EVENTS)
+                     if e.get("type") in recovery_types)
     return {
         "injected": len(pairs) + len(unpaired),
         "recoveries": recoveries,
@@ -434,6 +450,21 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "cancelled": cxl["cancelled"],
             "reconciled": cxl["reconciled"],
         },
+        # the data-integrity story: detections, quarantines, and the
+        # disk-pressure ladder's rung usage
+        "integrity": {
+            "corruption_detected": len(t.get("block_corruption", [])),
+            "blocks_quarantined": sum(
+                1 for e in t.get("block_corruption", [])
+                if e.get("quarantined")),
+            "disk_pressure_recoveries": len(t.get("disk_pressure", [])),
+            "disk_by_action": {
+                a: sum(1 for e in t.get("disk_pressure", [])
+                       if e.get("action") == a)
+                for a in ("victim_reselect", "reclaim", "retry",
+                          "host_fallback", "exhausted")
+            },
+        },
     }
 
     hb = t.get("task_heartbeat", [])
@@ -615,6 +646,18 @@ def render(events: List[Dict[str, Any]]) -> str:
             lines.append(
                 "  degradation ladder: "
                 + ", ".join(f"{v} {k}" for k, v in by_action.items() if v))
+        bc = t.get("block_corruption", [])
+        dp = t.get("disk_pressure", [])
+        if bc or dp:
+            q = sum(1 for e in bc if e.get("quarantined"))
+            disk = {a: sum(1 for e in dp if e.get("action") == a)
+                    for a in ("victim_reselect", "reclaim", "retry",
+                              "host_fallback", "exhausted")}
+            lines.append(
+                f"  integrity: {len(bc)} corruption(s) detected"
+                + (f", {q} quarantined" if q else "")
+                + (", disk ladder: " + ", ".join(
+                    f"{v} {k}" for k, v in disk.items() if v) if dp else ""))
         cxl = reconcile_cancellation(events)
         if cxl["requested"] or cxl["cancelled"]:
             lines.append(

@@ -109,18 +109,67 @@ def main(spec_path: str) -> int:
     out_path = spec.get("output")
     if out_path:
         # write-then-rename: a crashed attempt leaves no final file,
-        # so the driver's partial-output detection is just existence
+        # so the driver's partial-output detection is just existence.
+        # Frames are standard checksummed IPC frames (codec raw +
+        # per-frame trailer, conf spark.blaze.io.checksum) closed by a
+        # block trailer, so the DRIVER verifies the committed bytes
+        # (verify_result_file) before trusting them — rename alone
+        # proves completeness, not integrity.
+        from . import faults, integrity
+        from ..io.ipc_compression import block_trailer, compress_frame
+
+        algo = integrity.frame_algo()
         tmp = out_path + ".inprogress"
+        count = 0
+        xor = 0
         with open(tmp, "wb") as f:
             for batch in run_task(td, task_attempt_id=attempt):
-                frame = serialize_batch(batch)
-                f.write(struct.pack("<I", len(frame)))
+                frame = compress_frame(serialize_batch(batch),
+                                       codec="raw", checksum_algo=algo)
+                if algo is not None:
+                    xor ^= struct.unpack("<BI", frame[-5:])[1]
                 f.write(frame)
+                count += 1
+            if algo is not None:
+                f.write(block_trailer(count, xor, algo))
+        if faults.corrupt("worker.result", attempt=attempt,
+                          detail=out_path):
+            # @corrupt: post-write bit-rot on the committed result —
+            # the driver's verification, not this worker, must catch it
+            integrity.flip_byte_in_file(tmp)
         os.replace(tmp, out_path)
     else:
         for _ in run_task(td, task_attempt_id=attempt):
             pass
     return 0
+
+
+def read_result_frames(path: str, schema=None):
+    """Read a worker's committed result file: yields decoded serde
+    frame payloads (or deserialized batches when ``schema`` is given),
+    verifying per-frame checksums and the block trailer — typed
+    ``BlockCorruptionError`` on any mismatch.  The ONE reader the
+    driver, the testenv suites, and :func:`verify_result_file`
+    share."""
+    from ..io.batch_serde import deserialize_batch
+    from ..io.ipc_compression import IpcFrameReader
+
+    with open(path, "rb") as f:
+        for payload in IpcFrameReader(f, site="worker.result", path=path):
+            yield deserialize_batch(payload, schema) if schema is not None \
+                else payload
+
+
+def verify_result_file(path: str) -> int:
+    """Driver-side integrity gate on a committed worker result: walk
+    every frame (checksums + block trailer) without keeping payloads.
+    Returns the frame count; raises ``BlockCorruptionError`` on
+    corruption — the caller treats it as a failed attempt and retries
+    with fresh output."""
+    n = 0
+    for _ in read_result_frames(path):
+        n += 1
+    return n
 
 
 def run_worker_with_retry(
@@ -186,13 +235,36 @@ def run_worker_with_retry(
         else:
             out_path = spec.get("output")
             if proc.returncode == 0 and (not out_path or os.path.exists(out_path)):
-                return attempt
-            reason = (
-                f"exit status {proc.returncode}"
-                if proc.returncode != 0
-                else "worker exited 0 but produced no committed output"
-            )
-            stderr_tail = proc.stderr.decode(errors="replace")[-500:]
+                if not out_path:
+                    return attempt
+                # the committed file exists — but rename proves only
+                # COMPLETENESS.  Verify the bytes (per-frame checksums
+                # + block trailer) before trusting them: a corrupt
+                # result is a failed attempt, not a silent wrong answer
+                from . import dispatch, trace
+                from .integrity import BlockCorruptionError
+
+                try:
+                    verify_result_file(out_path)
+                    return attempt
+                except BlockCorruptionError as e:
+                    dispatch.record("corruption_detected")
+                    trace.emit("block_corruption", site="worker.result",
+                               path=out_path, detail=str(e)[:300])
+                    try:
+                        os.unlink(out_path)  # never serve corrupt bytes
+                    except OSError:
+                        pass
+                    reason = ("committed output failed checksum "
+                              f"verification: {e}")
+                    stderr_tail = ""
+            else:
+                reason = (
+                    f"exit status {proc.returncode}"
+                    if proc.returncode != 0
+                    else "worker exited 0 but produced no committed output"
+                )
+                stderr_tail = proc.stderr.decode(errors="replace")[-500:]
         last_failure = RuntimeError(
             f"worker attempt {attempt} failed ({reason}): " + stderr_tail
         )

@@ -625,12 +625,9 @@ def test_worker_process_crash_is_retried(tmp_path):
     winning = run_worker_with_retry(spec, str(tmp_path), "t0",
                                     max_attempts=3, env=env)
     assert winning == 1  # first attempt crashed, second committed
-    raw = open(out, "rb").read()
-    vals, off = [], 0
-    while off < len(raw):
-        (ln,) = struct.unpack_from("<I", raw, off)
-        off += 4
-        b = deserialize_batch(raw[off : off + ln], schema)
-        off += ln
+    from blaze_tpu.runtime.worker import read_result_frames
+
+    vals = []
+    for b in read_result_frames(out, schema):
         vals.extend(int(v) for v in np.asarray(b.columns[0].data)[: b.num_rows])
     assert vals == list(range(100))

@@ -1,0 +1,615 @@
+"""End-to-end data integrity & storage-failure resilience.
+
+The corruption matrix: one seeded ``@corrupt`` injection per site
+(shuffle map output, spill frame, RSS push, broadcast blob, worker
+result), each asserting DETECTION (typed error + ``block_corruption``
+event + ``corruption_detected`` counter), recovery through the
+existing ladder to byte-identical results, and the paired
+``fault_injected``/``block_corruption`` events.  Plus the
+disk-pressure ladder (``@enospc`` injection, reclaim, in-memory
+fallback, victim re-selection, typed ``DiskExhaustedError``), the
+quarantine policy, the LZ4 frame-checksum satellite, torn-JSONL
+tolerance, and the startup orphan sweep.
+"""
+
+import errno
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.batch import batch_from_pydict, batch_to_pydict
+from blaze_tpu.io import ipc_compression as ic
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.parallel.shuffle import (
+    HashPartitioning, IpcReaderExec, LocalShuffleManager,
+    ShuffleRepartitioner, ShuffleWriterExec, SinglePartitioning,
+)
+from blaze_tpu.runtime import diskmgr, dispatch, faults, integrity, trace
+from blaze_tpu.runtime.context import RESOURCES, TaskContext
+from blaze_tpu.runtime.diskmgr import DiskExhaustedError
+from blaze_tpu.runtime.integrity import BlockCorruptionError
+from blaze_tpu.runtime.metrics import MetricNode, MetricsSet
+from blaze_tpu.runtime.retry import RETRY, FetchFailedError, classify
+from blaze_tpu.runtime.scheduler import run_stages, split_stages
+from blaze_tpu.schema import DataType, Field, Schema
+
+import spark_fixtures as F
+from test_spark_convert import make_session, q6_like_plan
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Deterministic, sleep-free runs; always clear injected state."""
+    conf.FAULTS_SPEC.set("")
+    conf.TASK_RETRY_BACKOFF.set(0.0)
+    faults.reset()
+    integrity.reset()
+    yield
+    conf.FAULTS_SPEC.set("")
+    conf.TASK_RETRY_BACKOFF.set(0.1)
+    conf.IO_CHECKSUM.set("crc32")
+    faults.reset()
+    integrity.reset()
+
+
+def _inject(spec: str) -> None:
+    conf.FAULTS_SPEC.set(spec)
+    faults.reset()
+
+
+def _scheduler_rows(sess, plan_json, metrics=None, manager=None):
+    plan = sess.plan(plan_json)
+    stages, manager = split_stages(plan, manager)
+    out = {f.name: [] for f in stages[-1].plan.schema.fields}
+    for b in run_stages(stages, manager, metrics=metrics):
+        d = batch_to_pydict(b)
+        for k in out:
+            out[k].extend(d[k])
+    return out, manager
+
+
+# ------------------------------------------------- frame checksum unit
+
+def test_frame_checksum_roundtrip_and_detection_all_algos():
+    payload = b"the quick brown fox " * 500
+    for name in ("crc32", "crc32c", "xxh32"):
+        conf.IO_CHECKSUM.set(name)
+        algo = integrity.frame_algo()
+        assert algo is not None
+        frame = ic.compress_frame(payload, checksum_algo=algo)
+        assert ic.decompress_frame(frame) == payload
+        bad = integrity.flip_byte(frame, 5 + len(frame) // 2)
+        with pytest.raises(BlockCorruptionError):
+            ic.decompress_frame(bad)
+    # off: no trailer stamped, plain frames verify-free
+    conf.IO_CHECKSUM.set("off")
+    assert integrity.frame_algo() is None
+    # unknown algorithm names fail loudly, never silently disable
+    conf.IO_CHECKSUM.set("md5")
+    with pytest.raises(ValueError, match="io.checksum"):
+        integrity.frame_algo()
+
+
+def test_crc32c_known_check_value():
+    # the CRC32C check value from RFC 3720 / every hardware impl
+    assert integrity.crc32c(b"123456789") == 0xE3069283
+
+
+def test_corrupt_trailer_algo_byte_cannot_disarm_verification():
+    """A flagged frame whose trailer algo byte was itself corrupted
+    (to 0 = 'off', or to an unknown id) must raise the TYPED error —
+    writers never stamp algo-0 trailers, so treating it as
+    'unverified' would let one bit flip defeat the whole layer."""
+    payload = b"payload" * 64
+    frame = ic.compress_frame(payload, checksum_algo=integrity.ALGO_CRC32)
+    # trailer = last 5 bytes: [algo][u32 sum]; zero the algo byte
+    off = len(frame) - 5
+    for bad_algo in (0x00, 0x55):
+        bad = frame[:off] + bytes([bad_algo]) + frame[off + 1:]
+        with pytest.raises(BlockCorruptionError):
+            ic.decompress_frame(bad)
+
+
+def test_unstamped_frames_still_read():
+    """Back-compat: a pre-integrity (unflagged) frame reads exactly as
+    before even with verification armed."""
+    payload = b"legacy bytes" * 10
+    frame = ic.compress_frame(payload)  # no checksum_algo
+    assert ic.decompress_frame(frame) == payload
+    assert list(ic.iter_blob_frames(frame)) == [payload]
+
+
+def test_block_trailer_detects_whole_frame_truncation():
+    algo = integrity.frame_algo()
+    frames, xor = [], 0
+    for p in (b"aaa" * 40, b"bb" * 99):
+        fr = ic.compress_frame(p, checksum_algo=algo)
+        xor ^= struct.unpack("<BI", fr[-5:])[1]
+        frames.append(fr)
+    blob = b"".join(frames) + ic.block_trailer(2, xor, algo)
+    assert list(ic.iter_blob_frames(blob)) == [b"aaa" * 40, b"bb" * 99]
+    # drop a WHOLE frame: per-frame checksums can't see it, the
+    # trailer's count/XOR must
+    with pytest.raises(BlockCorruptionError, match="frame count"):
+        list(ic.iter_blob_frames(frames[0] + ic.block_trailer(2, xor, algo)))
+
+
+# -------------------------------------------------- LZ4 satellite
+
+def test_lz4_frame_checksums_roundtrip_and_flip():
+    payload = (b"Repetitive lz4 content for block compression. " * 300
+               + bytes(range(256)))
+    frame = ic.lz4_frame_compress(payload, checksums=True)
+    assert ic.lz4_frame_decompress(frame) == payload
+    # flipped bit inside a block -> typed block-checksum failure
+    with pytest.raises(BlockCorruptionError):
+        ic.lz4_frame_decompress(integrity.flip_byte(frame, len(frame) // 2))
+    # flipped header descriptor -> HC byte failure
+    with pytest.raises((BlockCorruptionError, ValueError)):
+        ic.lz4_frame_decompress(integrity.flip_byte(frame, 4))
+    # checksum-free frames still decode (and cannot detect)
+    plain = ic.lz4_frame_compress(payload)
+    assert ic.lz4_frame_decompress(plain) == payload
+
+
+def test_lz4_content_checksum_catches_stored_block_swap():
+    """Differential: corrupt a STORED (uncompressed) block in a way
+    the framing can't see — only the content checksum can."""
+    payload = bytes(np.random.RandomState(3).randint(0, 256, 4096,
+                                                     dtype=np.uint8))
+    frame = bytearray(ic.lz4_frame_compress(payload, checksums=True))
+    # stored block starts after magic+FLG+BD+HC+blocksize = 4+1+1+1+4
+    frame[12] ^= 0x01
+    with pytest.raises(BlockCorruptionError):
+        ic.lz4_frame_decompress(bytes(frame))
+
+
+# ----------------------------------------------- spill frame integrity
+
+def test_spill_frame_corruption_detected(tmp_path):
+    from blaze_tpu.runtime.memmgr import FileSpill, HostMemSpill
+
+    for sp in (FileSpill("zlib", dir=str(tmp_path)), HostMemSpill("zlib")):
+        sp.write_frame(b"good" * 100)
+        sp.corrupt_next_frame()
+        sp.write_frame(b"evil" * 100)
+        sp.complete()
+        assert sp.read_frame() == b"good" * 100
+        with pytest.raises(BlockCorruptionError):
+            sp.read_frame()
+        sp.release()
+
+
+def test_spill_corruption_classified_retry():
+    assert classify(BlockCorruptionError("spill.read")) == RETRY
+    assert classify(DiskExhaustedError("spill.write")) == RETRY
+
+
+# ------------------------------------------------- disk-pressure ladder
+
+def test_file_spill_enospc_migrates_to_host_ram(tmp_path):
+    from blaze_tpu.runtime.memmgr import FileSpill
+
+    sp = FileSpill("zlib", dir=str(tmp_path))
+    sp.write_frame(b"on-disk" * 50)
+    path = sp.path
+    real_write = sp._f.write
+    fails = {"n": 1}
+
+    def flaky_write(b):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError(errno.ENOSPC, "disk full")
+        return real_write(b)
+
+    before = dispatch.counters().get("disk_pressure_recoveries", 0)
+    sp._f.write = flaky_write
+    sp.write_frame(b"in-ram" * 50)  # ladder: reclaim -> migrate to RAM
+    assert sp._mem is not None
+    assert not os.path.exists(path)  # file tier released on migration
+    sp.write_frame(b"more" * 10)
+    sp.complete()
+    assert sp.read_frame() == b"on-disk" * 50
+    assert sp.read_frame() == b"in-ram" * 50
+    assert sp.read_frame() == b"more" * 10
+    assert sp.read_frame() is None
+    sp.release()
+    assert dispatch.counters().get("disk_pressure_recoveries", 0) > before
+
+
+def test_try_new_spill_disk_ladder(monkeypatch, tmp_path):
+    import blaze_tpu.runtime.memmgr as memmgr_mod
+
+    class FakeMgr:
+        total = 100
+
+        def total_used(self):
+            return 60  # past total//2: file tier selected
+
+    monkeypatch.setattr(memmgr_mod.MemManager, "get",
+                        classmethod(lambda cls: FakeMgr()))
+
+    def no_disk(*a, **k):
+        raise OSError(errno.ENOSPC, "disk full")
+
+    monkeypatch.setattr(memmgr_mod.tempfile, "mkstemp", no_disk)
+    # headroom left -> in-memory eager fallback
+    sp = memmgr_mod.try_new_spill("zlib")
+    assert isinstance(sp, memmgr_mod.HostMemSpill)
+
+    FakeMgr.total_used = lambda self: 100  # quota exhausted
+    with pytest.raises(DiskExhaustedError):
+        memmgr_mod.try_new_spill("zlib")
+
+
+def test_drain_victims_reselects_on_disk_pressure():
+    from blaze_tpu.runtime.memmgr import MemConsumer, MemManager
+
+    mgr = MemManager(1000, watermark=0.5)
+
+    class Victim(MemConsumer):
+        def __init__(self, name, fail):
+            super().__init__()
+            self.name = name
+            self.fail = fail
+            self.spilled = False
+
+        def spill(self):
+            if self.fail:
+                raise faults.InjectedDiskFull("spill.write", 1)
+            self.spilled = True
+            freed = self._mem_used
+            self.set_mem_used_no_trigger(0)
+            return freed
+
+    bad = Victim("bad", fail=True)
+    good = Victim("good", fail=False)
+    mgr.register_consumer(bad)
+    mgr.register_consumer(good)
+    bad._mem_used = 600
+    good._mem_used = 400
+    before = dispatch.counters().get("disk_pressure_recoveries", 0)
+    mgr._maybe_spill()  # bad victim's disk failure must not propagate
+    assert good.spilled
+    assert dispatch.counters().get("disk_pressure_recoveries", 0) > before
+
+
+def test_shuffle_write_enospc_recovers_in_place():
+    """The ``@enospc`` injection at the commit probe: reclaim + retry
+    commits identically, counting a disk-pressure recovery."""
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_rows(sess, plan_json)
+    _inject("shuffle.write@1@enospc")
+    m = MetricNode()
+    got, _ = _scheduler_rows(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("disk_pressure_recoveries") >= 1
+    # the in-place retry means no task retry was needed
+    assert m.metrics.get("fetch_failures") == 0
+
+
+# ------------------------------------------ corruption matrix: shuffle
+
+def test_shuffle_block_corruption_detected_and_recovered():
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_rows(sess, plan_json)
+    _inject("shuffle.write@1@corrupt")
+    prev_trace = bool(conf.TRACE_ENABLE.get())
+    conf.TRACE_ENABLE.set(True)
+    trace.reset()
+    try:
+        from blaze_tpu.runtime import monitor
+
+        with monitor.query_span("integrity_shuffle") as log_path:
+            m = MetricNode()
+            got, _ = _scheduler_rows(sess, plan_json, metrics=m)
+    finally:
+        conf.TRACE_ENABLE.set(prev_trace)
+        trace.reset()
+    assert got == baseline  # byte-identical after recovery
+    assert m.metrics.get("corruption_detected") >= 1
+    assert m.metrics.get("fetch_failures") >= 1
+    assert m.metrics.get("map_stage_reruns") >= 1
+    events = trace.read_event_log(log_path)
+    injected = [e for e in events if e.get("type") == "fault_injected"
+                and e.get("kind") == "corrupt"]
+    detected = [e for e in events if e.get("type") == "block_corruption"]
+    assert injected and detected
+    from blaze_tpu.runtime import trace_report
+
+    rec = trace_report.reconcile_faults(events)
+    assert rec["reconciled"], rec["unpaired"]
+
+
+def test_shuffle_corruption_twice_quarantines_and_regenerates():
+    """A re-fetched block failing twice at the same path is renamed
+    ``.corrupt`` (kept for forensics), its index dropped, and FULL
+    regeneration recovers to identical results."""
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_rows(sess, plan_json)
+    # corrupt map task 0's commit AND its first regeneration (probe
+    # hits: t0=1, t1=2, t2=3, rerun-t0=4) -> path fails twice
+    _inject("shuffle.write@1@corrupt,shuffle.write@4@corrupt")
+    m = MetricNode()
+    got, manager = _scheduler_rows(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("blocks_quarantined") >= 1
+    quarantined = [f for f in os.listdir(manager.root)
+                   if f.endswith(".corrupt")]
+    assert quarantined, "forensic .corrupt file missing"
+    # quarantined files survive invalidate (forensics) and never feed
+    # the reduce barrier again
+    sid = int(quarantined[0].split("_")[1])
+    manager.invalidate(sid)
+    assert [f for f in os.listdir(manager.root)
+            if f.endswith(".corrupt")] == quarantined
+
+
+# ---------------------------------------------- corruption matrix: spill
+
+def test_spill_corruption_recovered_by_task_retry(monkeypatch):
+    """Every staged batch is force-spilled, so the ``spill.write``
+    corruption site deterministically has frames to flip; the corrupt
+    frame surfaces at the commit drain as a typed error and the TASK
+    RETRY rebuilds the repartitioner's state to identical results."""
+    import blaze_tpu.parallel.shuffle as sh
+
+    orig_insert = sh._insert_host
+
+    def insert_and_spill(rep, schema, item):
+        orig_insert(rep, schema, item)
+        rep.spill()
+
+    monkeypatch.setattr(sh, "_insert_host", insert_and_spill)
+    sess, _ = make_session()
+    plan_json = F.flatten(q6_like_plan())
+    baseline, _ = _scheduler_rows(sess, plan_json)
+    _inject("spill.write@1@corrupt")
+    m = MetricNode()
+    got, _ = _scheduler_rows(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("corruption_detected") >= 1
+    assert m.metrics.get("task_retries") >= 1
+
+
+# ------------------------------------------------ corruption matrix: rss
+
+def test_rss_push_corruption_detected_at_reduce():
+    from blaze_tpu.exprs.ir import col
+    from blaze_tpu.parallel.rss import LocalRssWriter, RssShuffleWriterExec
+
+    schema = Schema([Field("k", DataType.int64()),
+                     Field("v", DataType.int64())])
+    n = 200
+    src = MemoryScanExec(
+        [[batch_from_pydict({"k": list(range(n)), "v": list(range(n))},
+                            schema)]], schema)
+
+    def push(tag):
+        writer = LocalRssWriter()
+        RESOURCES.put(f"rss_int_{tag}.0", writer)
+        ex = RssShuffleWriterExec(src, HashPartitioning([col("k")], 2),
+                                  f"rss_int_{tag}")
+        list(ex.execute(0, TaskContext(0, 1)))
+        return writer
+
+    ref = push("ref")
+    _inject("rss.push@1@corrupt")
+    bad = push("bad")
+    _inject("")
+    # the corrupted push differs from the clean one ONLY in the flip
+    assert sorted(ref.partitions) == sorted(bad.partitions)
+    # reduce side: the verified read detects the flip as a typed fetch
+    # failure naming the RSS resource
+    corrupt_blocks = [b"".join(bad.partitions[p])
+                      for p in sorted(bad.partitions)]
+    RESOURCES.put("rss_read_int.0", corrupt_blocks)
+    reader = IpcReaderExec(schema, "rss_read_int", 1)
+    with pytest.raises(FetchFailedError):
+        list(reader.execute(0, TaskContext(0, 1)))
+    # clean pushes decode fine through the same path
+    clean_blocks = [b"".join(ref.partitions[p])
+                    for p in sorted(ref.partitions)]
+    RESOURCES.put("rss_read_int.1", clean_blocks)
+    reader2 = IpcReaderExec(schema, "rss_read_int", 2)
+    rows = sum(b.num_rows for b in reader2.execute(1, TaskContext(1, 2)))
+    assert rows == n
+
+
+# ------------------------------------- corruption matrix: broadcast
+
+def test_broadcast_corruption_regenerates_producing_stage():
+    sess, data = make_session()
+    dim_schema = Schema([
+        Field("d_key", DataType.int64()),
+        Field("d_name", DataType.string(16)),
+    ])
+    sess.register_table(
+        "dim",
+        {"d_key": list(range(10)), "d_name": [f"name{i}" for i in range(10)]},
+        dim_schema,
+    )
+    fact = F.scan("lineitem", [F.attr("l_quantity", 1), F.attr("l_discount", 3)])
+    dim = F.broadcast(F.scan("dim", [F.attr("d_key", 5), F.attr("d_name", 6)]))
+    join = F.bhj([F.attr("l_discount", 3)], [F.attr("d_key", 5)],
+                 "Inner", "right", fact, dim)
+    pr = F.project([F.attr("l_quantity", 1), F.attr("d_name", 6)], join)
+    plan_json = F.flatten(pr)
+    baseline, _ = _scheduler_rows(sess, plan_json)
+    assert len(baseline["l_quantity"]) == len(data["l_quantity"])
+    _inject("broadcast.write@1@corrupt")
+    m = MetricNode()
+    got, _ = _scheduler_rows(sess, plan_json, metrics=m)
+    assert got == baseline
+    assert m.metrics.get("corruption_detected") >= 1
+    assert m.metrics.get("fetch_failures") >= 1
+    # recovery REGENERATED the producing broadcast stage (re-reading
+    # the driver's cached corrupt blob would never converge)
+    assert m.metrics.get("map_stage_reruns") >= 1
+
+
+def test_fetch_failed_broadcast_id_property():
+    assert FetchFailedError("broadcast_7", 0).broadcast_id == 7
+    assert FetchFailedError("broadcast_7", 0).shuffle_id is None
+    assert FetchFailedError("shuffle_3", 0).broadcast_id is None
+
+
+# --------------------------------- corruption matrix: worker result
+
+@pytest.mark.slow
+def test_worker_result_corruption_detected_and_retried(tmp_path):
+    """Testenv tier: a worker whose COMMITTED result frames carry a
+    flipped byte is caught by the driver's verification and re-run
+    with a fresh attempt; the final frames verify and match."""
+    import base64
+
+    from blaze_tpu.ops import ParquetScanExec, ParquetSinkExec
+    from blaze_tpu.runtime.scheduler import build_task
+    from blaze_tpu.runtime.worker import (
+        read_result_frames, run_worker_with_retry,
+    )
+
+    schema = Schema([Field("x", DataType.int64())])
+    src = MemoryScanExec(
+        [[batch_from_pydict({"x": list(range(100))}, schema)]], schema)
+    pq = str(tmp_path / "in.parquet")
+    sink = ParquetSinkExec(src, pq)
+    for _ in sink.execute(0, TaskContext(0, 1)):
+        pass
+    pq = sink.written_files[0] if sink.written_files else pq
+    plan = ParquetScanExec([[pq]], schema)
+    stages, manager = split_stages(
+        plan, LocalShuffleManager(str(tmp_path / "sh")))
+    _, td = build_task(stages[-1], manager, 0)
+    out = str(tmp_path / "r.frames")
+    spec = {
+        "task_def": base64.b64encode(td).decode(),
+        "partition": 0,
+        "shuffle_root": manager.root,
+        "readers": [],
+        "output": out,
+    }
+    env = {
+        "PYTHONPATH": os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+        # flip a committed result byte on the FIRST attempt only
+        "BLAZE_FAULTS_SPEC": "worker.result@1@corrupt@a0",
+        "BLAZE_TASK_RETRYBACKOFF": "0",
+    }
+    winning = run_worker_with_retry(spec, str(tmp_path), "t0",
+                                    max_attempts=3, env=env)
+    assert winning == 1  # attempt 0's corrupt output was rejected
+    vals = []
+    for b in read_result_frames(out, schema):
+        vals.extend(int(v) for v in
+                    np.asarray(b.columns[0].data)[: b.num_rows])
+    assert vals == list(range(100))
+
+
+# ------------------------------------------------ torn-JSONL tolerance
+
+def test_read_events_tolerates_torn_final_line(tmp_path, caplog):
+    import json as _json
+    import logging
+
+    p = str(tmp_path / "log.jsonl")
+    with open(p, "w") as f:
+        f.write(_json.dumps({"ts": 1.0, "type": "query_start",
+                             "query_id": "q"}) + "\n")
+        f.write('{"ts": 2.0, "type": "query_en')  # crash mid-append
+    with caplog.at_level(logging.WARNING):
+        events = trace.read_events(p)
+    assert [e["type"] for e in events] == ["query_start"]
+    assert any("torn" in r.message for r in caplog.records)
+
+
+def test_read_history_tolerates_torn_lines(tmp_path, caplog, monkeypatch):
+    import json as _json
+    import logging
+
+    from blaze_tpu.runtime import monitor
+
+    hist = tmp_path / "hist"
+    hist.mkdir()
+    good = {"key": "q1", "status": "done"}
+    with open(hist / "history-1.jsonl", "w") as f:
+        f.write(_json.dumps(good) + "\n")
+        f.write('{"key": "q2", "sta')  # torn final line
+    # an ORPHAN segment with a torn MIDDLE line: everything after it
+    # must still be read (the old reader stopped at the first bad line)
+    with open(hist / "history-0.jsonl.seg1", "w") as f:
+        f.write(_json.dumps({"key": "q0"}) + "\n")
+        f.write('{"torn' + "\n")
+        f.write(_json.dumps({"key": "q3"}) + "\n")
+    conf.MONITOR_HISTORY_DIR.set(str(hist))
+    monitor.reset()
+    try:
+        with caplog.at_level(logging.WARNING):
+            out = monitor.read_history()
+    finally:
+        conf.MONITOR_HISTORY_DIR.set("")
+        monitor.reset()
+    keys = {e.get("key") for e in out}
+    assert {"q1", "q0", "q3"} <= keys
+    assert any("torn" in r.message for r in caplog.records)
+
+
+# ------------------------------------------------- orphan sweep
+
+def test_orphan_sweep_on_startup(tmp_path):
+    root = tmp_path / "shuffle"
+    root.mkdir()
+    stale = root / "shuffle_0_0.data.inprogress.a0"
+    stale.write_bytes(b"dead run debris")
+    fresh = root / "shuffle_0_1.data.inprogress.a0"
+    fresh.write_bytes(b"live attempt")
+    committed = root / "shuffle_0_2.data"
+    committed.write_bytes(b"committed")
+    quarantined = root / "shuffle_0_3.data.corrupt"
+    quarantined.write_bytes(b"forensics")
+    old = time.time() - 7200
+    os.utime(stale, (old, old))
+    os.utime(quarantined, (old, old))
+    mgr = LocalShuffleManager(str(root))  # sweep runs on re-open
+    names = set(os.listdir(mgr.root))
+    assert stale.name not in names          # dead debris reclaimed
+    assert fresh.name in names              # age gate protects live temps
+    assert committed.name in names          # committed outputs untouched
+    assert quarantined.name in names        # forensics kept
+
+
+def test_sweep_stale_spills_age_gated(tmp_path, monkeypatch):
+    monkeypatch.setattr(diskmgr.tempfile, "gettempdir",
+                        lambda: str(tmp_path))
+    stale = tmp_path / "blaze_spill_dead"
+    stale.write_bytes(b"x" * 128)
+    old = time.time() - 7200
+    os.utime(stale, (old, old))
+    fresh = tmp_path / "blaze_spill_live"
+    fresh.write_bytes(b"y")
+    removed = diskmgr.sweep_stale_spills(3600)
+    assert removed == 1
+    assert not stale.exists() and fresh.exists()
+
+
+# ------------------------------------------------ quarantine unit
+
+def test_quarantine_renames_and_drops_index(tmp_path):
+    data = tmp_path / "shuffle_5_0.data"
+    index = tmp_path / "shuffle_5_0.index"
+    data.write_bytes(b"bad bytes")
+    index.write_bytes(b"\x00" * 16)
+    assert integrity.note_corruption(str(data)) == 1
+    assert integrity.note_corruption(str(data)) == 2
+    q = integrity.quarantine(str(data))
+    assert q == str(data) + ".corrupt"
+    assert os.path.exists(q) and not data.exists() and not index.exists()
+    # counters reset for the path: a regenerated file starts clean
+    assert integrity.note_corruption(str(data)) == 1

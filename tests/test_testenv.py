@@ -139,13 +139,11 @@ def test_multi_process_two_stage_query(tmp_path):
 
     got = []
     out_schema = stages[-1].plan.schema
+    from blaze_tpu.runtime.worker import read_result_frames
+
     for path in results:
-        raw = open(path, "rb").read()
-        off = 0
-        while off < len(raw):
-            (ln,) = struct.unpack_from("<I", raw, off)
-            off += 4
-            b = deserialize_batch(raw[off : off + ln], out_schema)
-            off += ln
+        # the shared verified reader: per-frame checksums + the block
+        # trailer (result files are standard checksummed IPC frames)
+        for b in read_result_frames(path, out_schema):
             got.extend(batch_to_pydict(b)[out_schema.names[0]])
     assert got == [expected]

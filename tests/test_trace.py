@@ -328,6 +328,13 @@ def _synthetic_events():
         ("straggler_injected", {"site": "shuffle.write", "hit": 1,
                                 "attempt": 0, "slow_ms": 400,
                                 "detail": "/tmp/x.data"}),
+        ("block_corruption", {"site": "shuffle.fetch",
+                              "resource": "shuffle_0",
+                              "path": "/tmp/shuffle_0_1.data",
+                              "detail": "crc32 mismatch",
+                              "quarantined": True}),
+        ("disk_pressure", {"action": "retry", "site": "shuffle.write",
+                           "detail": "/tmp/shuffle_0_1.data"}),
         ("mem_watermark", {"used": 1024, "total": 4096}),
         ("spill", {"consumer": "shuffle", "bytes": 512}),
         ("shuffle_write", {"bytes": 100, "blocks": 2, "attempt": 0,
@@ -553,10 +560,14 @@ def test_event_log_no_rotation_by_default(tmp_path):
 
 
 def test_event_log_rotation_never_clobbers_prior_segments(tmp_path):
-    """Regression: reset() clears the in-memory segment counter while
-    the same query_id + pid regenerates the same log path — the next
-    rollover must probe past .segN files already on disk instead of
-    os.replace()ing over run 1's first segment."""
+    """Regression, twice over: reset() clears the in-memory sequence
+    AND segment counters while the same query_id + pid regenerates the
+    same log name.  The span allocator now probes past files already
+    on disk, so a re-run gets a FRESH file — the stronger contract: no
+    clobbered segments AND no two runs (two trace ids) appended into
+    one log, which tore the OTLP single-trace-per-export invariant on
+    every chaos sweep past seed 1.  Both runs' events must survive in
+    full, each in its own file set."""
     def run_once():
         conf.TRACE_ENABLE.set(True)
         conf.EVENT_LOG_DIR.set(str(tmp_path))
@@ -575,8 +586,14 @@ def test_event_log_rotation_never_clobbers_prior_segments(tmp_path):
 
     p1 = run_once()
     p2 = run_once()
-    assert p1 == p2, "repro requires the regenerated path to collide"
-    watermarks = [e for e in trace.read_event_log(p1)
-                  if e["type"] == "mem_watermark"]
-    assert len(watermarks) == 120, (
-        f"rollover clobbered earlier segments: {len(watermarks)}/120 events")
+    assert p1 != p2, (
+        "a re-run after reset() must get a fresh log file, never "
+        "append a second trace into the first run's")
+    for p in (p1, p2):
+        events = trace.read_event_log(p)
+        watermarks = [e for e in events if e["type"] == "mem_watermark"]
+        assert len(watermarks) == 60, (
+            f"rollover clobbered earlier segments: "
+            f"{len(watermarks)}/60 events in {p}")
+        # exactly ONE trace id per file — the OTLP export invariant
+        assert len({e["trace_id"] for e in events if "trace_id" in e}) == 1
