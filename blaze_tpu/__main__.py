@@ -16,6 +16,9 @@ Usage:
     python -m blaze_tpu --warmup            # compile-cache pre-warm + gate
     python -m blaze_tpu --lint              # static analysis; nonzero on finding
     python -m blaze_tpu --lint --json -     # + machine-readable findings
+    python -m blaze_tpu tpch q1 --explain   # EXPLAIN ANALYZE (runtime/perf.py)
+    python -m blaze_tpu --perfcheck         # perf-baseline gate; nonzero on drift
+    python -m blaze_tpu --perfcheck --update  # re-pin baselines with provenance
     python -m blaze_tpu --chaos             # seeded fault-injection smoke
                                             #  (+ plan verifier + lock-order
                                             #   + lockset checker armed)
@@ -74,7 +77,8 @@ import sys
 import time
 
 
-def _load_suite(suite: str, names, scale: float, n_parts: int):
+def _load_suite(suite: str, names, scale: float, n_parts: int,
+                batch_rows: int = 65536):
     """Shared setup for the runner and the chaos gate: resolve the
     query list ('all' expansion + validation) and build per-table
     MemoryScanExec scans over generated data.  Returns
@@ -105,12 +109,16 @@ def _load_suite(suite: str, names, scale: float, n_parts: int):
 
     scans = {
         name: MemoryScanExec(
-            table_to_batches(data[name], SCHEMAS[name], n_parts, batch_rows=65536),
+            table_to_batches(data[name], SCHEMAS[name], n_parts,
+                             batch_rows=batch_rows),
             SCHEMAS[name],
         )
         for name in SCHEMAS
     }
-    print(f"# datagen scale={scale}: {time.perf_counter() - t0:.2f}s")
+    # stderr: --explain/--perfcheck promise a parseable stdout under
+    # --json -, and the line is operator chatter either way
+    print(f"# datagen scale={scale}: {time.perf_counter() - t0:.2f}s",
+          file=sys.stderr)
     return build_query, names, scans
 
 
@@ -256,6 +264,201 @@ def _warmup(suite: str, names, scale: float, n_parts: int,
         print(f"# warmup: warm-run recompiles in: {', '.join(failed)}",
               file=sys.stderr)
         return 1
+    return 0
+
+
+def _run_explain(suite: str, names, scale: float, n_parts: int,
+                 json_path: str = "") -> int:
+    """``--explain``: EXPLAIN ANALYZE.  Each query is WARMED first
+    (cold compiles and cache population stay out of the profile), then
+    run once more through the stage scheduler with tracing + the perf
+    estimator armed, and the metric-annotated plan (runtime/perf.py:
+    per-node rows/bytes/batches, own-time %-of-wall, fused-chain
+    markers, per-kernel roofline, bound classification) renders from
+    the event log.  ``--json`` writes the golden-pinned explain
+    document(s) instead of / alongside the text."""
+    import json as _json
+    import tempfile
+
+    from . import conf
+    from .runtime import perf, trace
+    from .runtime.kernel_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    # smaller batches than the runner default: the profile is about
+    # the per-batch steady state, and at one giant batch per partition
+    # the per-task fixed overhead (proto decode, plan build) would
+    # dominate what the plan nodes can attribute
+    build_query, names, scans = _load_suite(suite, names, scale, n_parts,
+                                            batch_rows=4096)
+    if build_query is None:
+        return names
+    prev_trace = bool(conf.TRACE_ENABLE.get())
+    prev_dir = conf.EVENT_LOG_DIR.get()
+    # the command's whole point is the roofline table: force the
+    # estimator armed for the profiled run even when the operator's
+    # conf/env disarmed it (the run_perfcheck contract) — a bytes~0 /
+    # bound=unknown explain with no hint why is worse than overriding
+    # a knob for one measurement
+    perf.force(True)
+    log_dir = tempfile.mkdtemp(prefix="blaze_explain_")
+    docs = {}
+    failed = []
+    try:
+        for name in names:
+            try:
+                # warm pass: compiles + kernel/XLA caches populated
+                # OUTSIDE the profiled run, so the explain shows the
+                # steady state
+                _rows_via_scheduler(build_query(name, scans, n_parts))
+                conf.TRACE_ENABLE.set(True)
+                conf.EVENT_LOG_DIR.set(log_dir)
+                trace.reset()
+                try:
+                    with trace.query(f"{suite}_{name}") as log_path:
+                        _rows_via_scheduler(
+                            build_query(name, scans, n_parts))
+                finally:
+                    conf.TRACE_ENABLE.set(prev_trace)
+                    conf.EVENT_LOG_DIR.set(prev_dir)
+                    trace.reset()
+                if log_path is None:
+                    # conf.set(True) lost to an env override
+                    # (ConfEntry: env > set) — say so instead of
+                    # crashing on read_event_log(None)
+                    raise RuntimeError(
+                        "tracing did not arm (a BLAZE_TRACE_ENABLED "
+                        "env override?) — --explain needs the event "
+                        "log of the profiled run")
+                events = trace.read_event_log(log_path)
+            except Exception as e:  # noqa: BLE001 — report per query
+                failed.append(name)
+                print(f"explain {suite} {name}: FAILED "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                continue
+            docs[name] = perf.explain_doc(events)
+            if json_path != "-":
+                print(perf.render_explain(events, doc=docs[name]))
+                print()
+    finally:
+        perf.reset()  # force(True) ends here; conf/env resume control
+        # the scratch event logs served their purpose the moment the
+        # documents were built — leaving one mkdtemp per invocation in
+        # /tmp is exactly the litter the chaos arms gate against
+        import shutil
+
+        shutil.rmtree(log_dir, ignore_errors=True)
+    if json_path:
+        # shape keyed on what was REQUESTED, not what survived: one
+        # query = its bare doc ({} when it failed), several = the
+        # {name: doc} map (failed entries absent) — a consumer's
+        # parse never depends on which queries happened to fail, and
+        # stdout always carries one parseable document
+        out = (docs if len(names) > 1
+               else docs.get(names[0], {}) if names else {})
+        if json_path == "-":
+            # stdout is the PARSEABLE document and nothing else (the
+            # --report --json - contract)
+            print(_json.dumps(out, indent=2, default=str))
+        else:
+            with open(json_path, "w") as f:
+                _json.dump(out, f, indent=2, default=str)
+            print(f"# explain json: {json_path}")
+    return 1 if failed else 0
+
+
+def _run_perfcheck(update: bool, inflate: float,
+                   json_path: str = "") -> int:
+    """``--perfcheck``: the perf-baseline regression gate
+    (runtime/perf.py over runtime/perf_baselines.json) — nonzero on
+    warm-dispatch/program/recompile/bound drift outside
+    ``spark.blaze.perf.tolerance``; ``--update`` re-pins the registry
+    with provenance; ``--perfcheck-inflate N`` is the gate's self-test
+    hook (a seeded N-x dispatch inflation MUST fail)."""
+    import json as _json
+
+    from .runtime import perf
+    from .runtime.kernel_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    # --json -: stdout is the PARSEABLE document and nothing else, so
+    # the per-query progress lines move to stderr (the --lint contract)
+    out = print if json_path != "-" else (
+        lambda *a, **k: print(*a, file=sys.stderr, **k))
+    rc, doc = perf.run_perfcheck(update=update, inflate=inflate, out=out)
+    for p in doc["problems"]:
+        print(f"perfcheck DRIFT: {p}", file=sys.stderr)
+    status = ("re-pinned" if update
+              else "clean" if rc == 0
+              else f"{len(doc['problems'])} drift finding(s)")
+    status_line = (f"# perfcheck: {status} — {len(doc['queries'])} "
+                   f"queries vs {doc['baselines']} "
+                   f"(tolerance {doc['tolerance']:.0%}, "
+                   f"device {doc['device_kind']})")
+    if json_path:
+        if json_path == "-":
+            print(_json.dumps(doc, indent=2, default=str))
+            print(status_line, file=sys.stderr)
+            return rc
+        with open(json_path, "w") as f:
+            _json.dump(doc, f, indent=2, default=str)
+        print(f"# perfcheck json: {json_path}")
+    print(status_line)
+    return rc
+
+
+def _check_perf_gate() -> int:
+    """``--chaos`` structural gate for the perf estimator (the
+    poisoned-emit pattern): DISARMED
+    (``spark.blaze.perf.estimates=false``) the dispatch choke point
+    must never enter the estimator — asserted by poisoning
+    ``perf._estimate`` and driving a real instrumented call under an
+    active kernel capture — and RE-ARMED the same call must land
+    nonzero bytes/flops estimates in the sink.  Keeps the one-bool-read
+    disarmed-cost contract honest the way the trace gate does for
+    ``spark.blaze.trace.enabled``."""
+    import numpy as np
+
+    from .runtime import dispatch, perf, trace
+
+    problems = []
+    fn = dispatch.instrument(lambda x: x * 1.0, "perfgate")
+    x = np.arange(1024, dtype=np.float64)
+    orig = perf._estimate
+
+    def poisoned(*a, **k):  # pragma: no cover — failure path
+        raise AssertionError("estimator entered while disarmed")
+
+    # perf.force, not conf.set: a BLAZE_PERF_ESTIMATES env override
+    # wins over conf by ConfEntry design and would otherwise flip
+    # either phase of this gate into a spurious failure on a healthy
+    # build
+    try:
+        perf.force(False)
+        perf._estimate = poisoned
+        try:
+            with trace.kernel_capture() as sink:
+                fn(x)
+        except AssertionError as e:
+            problems.append(str(e))
+        if any(v.get("bytes_est", 0) for v in sink.values()):
+            problems.append("disarmed estimator still recorded bytes")
+        perf._estimate = orig
+        perf.force(True)
+        with trace.kernel_capture() as sink:
+            fn(x)
+        est = sum(v.get("bytes_est", 0) for v in sink.values())
+        if est <= 0:
+            problems.append("armed estimator recorded no bytes for a "
+                            "real program")
+    finally:
+        perf._estimate = orig
+        perf.reset()  # conf/env resume control
+    if problems:
+        print("# chaos perf gate: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    print("# chaos perf gate: OK (poisoned estimator never entered "
+          "disarmed; armed call recorded estimates)")
     return 0
 
 
@@ -418,8 +621,11 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
         conf.MONITOR_HEARTBEAT_MS.set(50)
         monitor.reset()
     try:
+        # perfcheck-machinery structural gate: the estimator's
+        # disarmed/armed contract holds even while nothing measures
+        rc = _check_perf_gate()
         rc = _chaos_loop(suite, names, scans, build_query, n_parts, seed,
-                         n_faults, speculate, inject_oom)
+                         n_faults, speculate, inject_oom) or rc
         return _check_chaos_telemetry(suite, names, otel_dir) or rc
     finally:
         conf.VERIFY_PLAN.set(False)
@@ -1307,6 +1513,30 @@ def main(argv=None) -> int:
                     help="persistent XLA compile cache directory for "
                          "--warmup (default: conf spark.blaze.xla.cacheDir, "
                          "else ~/.cache/blaze_tpu/xla)")
+    ap.add_argument("--explain", action="store_true",
+                    help="EXPLAIN ANALYZE: warm each query, re-run it "
+                         "traced through the stage scheduler, and render "
+                         "the metric-annotated plan (per-node rows/bytes/"
+                         "batches + %% of query wall, fused-chain markers, "
+                         "per-kernel roofline, dispatch/memory/compute "
+                         "bound classification); --json writes the "
+                         "golden-pinned explain document")
+    ap.add_argument("--perfcheck", action="store_true",
+                    help="perf-baseline regression gate: measure the "
+                         "TPC-H slice pinned in runtime/perf_baselines.json "
+                         "(warm dispatches, programs, recompiles, bound "
+                         "class) and exit nonzero on drift outside "
+                         "spark.blaze.perf.tolerance")
+    ap.add_argument("--update", action="store_true",
+                    help="with --perfcheck: re-pin the baseline registry "
+                         "from fresh measurements, stamped with provenance "
+                         "(device kind, scale, pinned_at)")
+    ap.add_argument("--perfcheck-inflate", type=float, default=1.0,
+                    metavar="N",
+                    help="with --perfcheck: multiply measured dispatch/"
+                         "program counts by N before the check — the "
+                         "gate's self-test hook (N=2 must fail nonzero, "
+                         "proving drift detection fires)")
     ap.add_argument("--lint", action="store_true",
                     help="run the static-analysis passes (blaze_tpu/analysis/)"
                          ": AST lint (trace purity, stray jax.jit, "
@@ -1422,13 +1652,25 @@ def main(argv=None) -> int:
     ap.add_argument("--watch-polls", type=int, default=0,
                     help="--watch: stop after N polls (0 = until ^C)")
     args = ap.parse_args(argv)
-    if args.json and not (args.report or args.lint):
-        ap.error("--json requires --report (profile as JSON) or --lint "
-                 "(findings as JSON)")
+    if args.json and not (args.report or args.lint or args.explain
+                          or args.perfcheck):
+        ap.error("--json requires --report (profile as JSON), --lint "
+                 "(findings as JSON), --explain (explain document), or "
+                 "--perfcheck (measurement document)")
+    if args.update and not args.perfcheck:
+        ap.error("--update requires --perfcheck (re-pin the baseline "
+                 "registry)")
+    if args.update and args.perfcheck_inflate != 1.0:
+        ap.error("--perfcheck-inflate is a self-test hook and cannot be "
+                 "combined with --update (it would pin falsified counts "
+                 "as the golden baselines)")
     if args.chaos_seeds:
         args.chaos = True
     if args.lint:
         return _run_lint(args.json)
+    if args.perfcheck:
+        return _run_perfcheck(args.update, args.perfcheck_inflate,
+                              args.json)
     if args.flame and not args.report:
         ap.error("--flame requires --report (flame profile from an "
                  "event log)")
@@ -1535,8 +1777,12 @@ def main(argv=None) -> int:
             print("# monitor: registry armed, server unavailable",
                   file=sys.stderr)
     queries = args.queries or (
-        ["q6"] if args.chaos else ["q1", "q6"] if args.warmup else None
+        ["q6"] if args.chaos else ["q1", "q6"] if args.warmup
+        else ["q1"] if args.explain else None
     )
+    if args.explain:
+        return _run_explain(args.suite, queries, args.scale, args.parts,
+                            args.json)
     if args.service:
         try:
             rc = _run_service(args.suite, args.queries, args.scale,

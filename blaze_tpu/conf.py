@@ -343,6 +343,27 @@ DEVICE_MEMORY_BUDGET = ConfEntry("spark.blaze.tpu.hbmBudget", 8 << 30, int)
 HOST_SPILL_BUDGET = ConfEntry("spark.blaze.tpu.hostSpillBudget", 4 << 30, int)
 MIN_CAPACITY = ConfEntry("spark.blaze.tpu.minBatchCapacity", 1024, int)
 
+# Performance introspection (runtime/perf.py): EXPLAIN ANALYZE,
+# per-kernel roofline/MFU attribution, and the perf-baseline gate.
+# Bytes-moved / flops estimation at the dispatch choke point — armed it
+# runs ONLY while a trace kernel capture is active (the same scope that
+# pays block-until-ready timing); disarmed it is one module-global bool
+# read per traced call, exactly the spark.blaze.trace.enabled contract,
+# and the untraced hot path never sees it at all.
+PERF_ESTIMATES = ConfEntry("spark.blaze.perf.estimates", True, _bool)
+# Relative drift tolerance for `--perfcheck` against the golden
+# baseline registry (runtime/perf_baselines.json): warm dispatches /
+# programs outside baseline*(1±tolerance) fail the gate.  0 (the
+# default) defers to the registry's own pinned ``tolerance`` field.
+PERF_TOLERANCE = ConfEntry("spark.blaze.perf.tolerance", 0.0, float)
+# Override path for the perf-baseline registry (empty = the packaged
+# runtime/perf_baselines.json) — tests and `--perfcheck --update`
+# round-trips point this at a scratch copy.
+PERF_BASELINES = ConfEntry("spark.blaze.perf.baselines", "", str)
+# Override path for the per-device-kind peak table (empty = the
+# packaged runtime/device_peaks.json).
+PERF_PEAKS = ConfEntry("spark.blaze.perf.peaks", "", str)
+
 # Static analysis & verification (blaze_tpu/analysis/).
 # Plan verifier: run the rule-based structural checker
 # (analysis/plan_verify.py — schema edges, partitioning/ordering

@@ -1407,7 +1407,7 @@ class AggExec(ExecNode):
                         continue  # batch already folded into the accumulator
                     if skipping:
                         # stream states through; downstream merge finishes
-                        self.metrics.add("output_rows", part.num_rows)
+                        self._record_batch(part)
                         yield part
                         continue
                     pending.append(part)
@@ -1428,7 +1428,7 @@ class AggExec(ExecNode):
                 final_state = self._merge_states(tail) if tail else None
                 if final_state is not None and final_state.num_rows > 0:
                     out = self._finish(final_state)
-                    self.metrics.add("output_rows", out.num_rows)
+                    self._record_batch(out)
                     yield out
                 elif not self.groupings:
                     # empty input, global agg still emits one row
@@ -1439,7 +1439,7 @@ class AggExec(ExecNode):
                     )
                     part = self._reduce_batch(empty.to_device(), in_schema)
                     out = self._finish(part)
-                    self.metrics.add("output_rows", out.num_rows)
+                    self._record_batch(out)
                     yield out
             finally:
                 ctx.mem.unregister_consumer(consumer)

@@ -128,9 +128,20 @@ class ExecNode:
             return self.children[0].num_partitions()
         return 1
 
+    def _record_batch(self, b) -> None:
+        """Land one output batch's rows/bytes/batches on this node's
+        MetricsSet — the per-node annotation EXPLAIN ANALYZE
+        (runtime/perf.py) renders.  ``nbytes`` is an attribute read
+        per column buffer, never a device sync."""
+        self.metrics.add("output_rows", b.num_rows)
+        self.metrics.add("output_batches")
+        self.metrics.add(
+            "output_bytes",
+            sum(getattr(c.data, "nbytes", 0) for c in b.columns))
+
     def _count_output(self, stream: BatchStream) -> BatchStream:
         for b in stream:
-            self.metrics.add("output_rows", b.num_rows)
+            self._record_batch(b)
             # heartbeat hookpoint: a task whose plan never yields to
             # the driver (map stages feed the shuffle writer) still
             # beats from inside the operator drive; one thread-local

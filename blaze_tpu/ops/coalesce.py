@@ -34,7 +34,7 @@ class CoalesceBatchesExec(ExecNode):
             buffered = 0
             for b in child_stream:
                 if b.num_rows >= self.target_rows and not buf:
-                    self.metrics.add("output_rows", b.num_rows)
+                    self._record_batch(b)
                     yield b
                     continue
                 buf.append(b)
@@ -42,11 +42,11 @@ class CoalesceBatchesExec(ExecNode):
                 if buffered >= self.target_rows:
                     out = concat_batches(buf)
                     buf, buffered = [], 0
-                    self.metrics.add("output_rows", out.num_rows)
+                    self._record_batch(out)
                     yield out
             if buf:
                 out = concat_batches(buf) if len(buf) > 1 else buf[0]
-                self.metrics.add("output_rows", out.num_rows)
+                self._record_batch(out)
                 yield out
 
         return stream()

@@ -81,7 +81,7 @@ class ExpandExec(ExecNode):
             for b in self.children[0].execute(partition, ctx):
                 for proj in self._projects:
                     out = proj.project_batch(b)
-                    self.metrics.add("output_rows", out.num_rows)
+                    self._record_batch(out)
                     yield out
 
         return stream()

@@ -141,7 +141,7 @@ class FilterExec(ExecNode):
                 if n == 0:
                     continue
                 out = RecordBatch(self.schema, list(out_cols), n)
-                self.metrics.add("output_rows", n)
+                self._record_batch(out)
                 yield out
 
         return stream()

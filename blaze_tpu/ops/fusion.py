@@ -359,7 +359,7 @@ class BufferPartitionExec(ExecNode):
             if not buffered:
                 return
             merged = concat_batches(buffered).to_device()
-            self.metrics.add("output_rows", merged.num_rows)
+            self._record_batch(merged)
             yield merged
 
         return stream()
@@ -514,7 +514,6 @@ class FusedStageExec(ExecNode):
                 for cols, n in pieces:
                     if n == 0:
                         continue
-                    self.metrics.add("output_rows", n)
                     out = RecordBatch(self._schema, list(cols), n)
                     # expanding ops (generate cap*M, expand cap*P)
                     # leave a non-power-of-two capacity: renormalize so
@@ -523,6 +522,7 @@ class FusedStageExec(ExecNode):
                     cap = out.capacity
                     if cap != bucket_capacity(cap):
                         out = out.with_capacity(bucket_capacity(n))
+                    self._record_batch(out)
                     yield out
 
         return stream()

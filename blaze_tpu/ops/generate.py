@@ -251,7 +251,7 @@ class GenerateExec(ExecNode):
                 tight = bucket_capacity(n)
                 if tight != out.capacity:
                     out = out.with_capacity(tight)
-                self.metrics.add("output_rows", n)
+                self._record_batch(out)
                 yield out
 
         return stream()
@@ -284,7 +284,7 @@ class GenerateExec(ExecNode):
                 if n == 0:
                     continue
                 out = batch_from_pydict(out_rows, self._schema)
-                self.metrics.add("output_rows", out.num_rows)
+                self._record_batch(out)
                 yield out
 
         return stream()

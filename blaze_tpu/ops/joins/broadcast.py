@@ -237,7 +237,7 @@ class BroadcastJoinExec(ExecNode):
                 with self.metrics.timer("probe_time"):
                     out = self._joiner.probe_batch(jmap, batch, state)
                 if out is not None and out.num_rows:
-                    self.metrics.add("output_rows", out.num_rows)
+                    self._record_batch(out)
                     yield out
             # build-preserved sides are only correct when this executor
             # sees every probe partition (standalone runs); Spark-mode
@@ -245,7 +245,7 @@ class BroadcastJoinExec(ExecNode):
             if partition == self.num_partitions() - 1 or self.num_partitions() == 1:
                 tail = self._joiner.finish(jmap, state)
                 if tail is not None:
-                    self.metrics.add("output_rows", tail.num_rows)
+                    self._record_batch(tail)
                     yield tail
 
         return stream()

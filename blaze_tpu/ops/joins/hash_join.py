@@ -65,11 +65,11 @@ class HashJoinExec(ExecNode):
                 with self.metrics.timer("probe_time"):
                     out = self._joiner.probe_batch(jmap, batch, state)
                 if out is not None and out.num_rows:
-                    self.metrics.add("output_rows", out.num_rows)
+                    self._record_batch(out)
                     yield out
             tail = self._joiner.finish(jmap, state)
             if tail is not None:
-                self.metrics.add("output_rows", tail.num_rows)
+                self._record_batch(tail)
                 yield tail
 
         return stream()

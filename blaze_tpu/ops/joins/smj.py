@@ -290,7 +290,7 @@ class SortMergeJoinExec(ExecNode):
                         if self._build_preserved:
                             tail = self._emit_entry(e.batch, e.matched)
                             if tail is not None and tail.num_rows:
-                                self.metrics.add("output_rows", tail.num_rows)
+                                self._record_batch(tail)
                                 yield tail
                     # pull right batches overlapping this probe range
                     while not right_done and (
@@ -323,7 +323,7 @@ class SortMergeJoinExec(ExecNode):
                     if st.matched_build is not None:
                         window.fold_matched(np.asarray(st.matched_build))
                     if out is not None and out.num_rows:
-                        self.metrics.add("output_rows", out.num_rows)
+                        self._record_batch(out)
                         yield out
                 # probe exhausted: flush the window atomically
                 for b, m in window.take_all(reload=self._build_preserved):
@@ -331,7 +331,7 @@ class SortMergeJoinExec(ExecNode):
                         continue
                     tail = self._emit_entry(b, m)
                     if tail is not None and tail.num_rows:
-                        self.metrics.add("output_rows", tail.num_rows)
+                        self._record_batch(tail)
                         yield tail
                 # ...and every never-pulled right batch (all unmatched)
                 if self._build_preserved:
@@ -343,7 +343,7 @@ class SortMergeJoinExec(ExecNode):
                             continue
                         tail = self._emit_entry(rb, np.zeros(rb.num_rows, np.bool_))
                         if tail is not None and tail.num_rows:
-                            self.metrics.add("output_rows", tail.num_rows)
+                            self._record_batch(tail)
                             yield tail
             finally:
                 ctx.mem.unregister_consumer(window)

@@ -51,7 +51,7 @@ class LimitExec(ExecNode):
                 out, remaining = truncate(batch, remaining)
                 if out is None:
                     return
-                self.metrics.add("output_rows", out.num_rows)
+                self._record_batch(out)
                 yield out
                 if remaining <= 0:
                     return

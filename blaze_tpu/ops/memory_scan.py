@@ -39,11 +39,17 @@ class MemoryScanExec(ExecNode):
         def stream():
             if partition < len(self._partitions):
                 for b in self._partitions[partition]:
-                    self.metrics.add("output_rows", b.num_rows)
+                    # device staging is the scan's own work: timing it
+                    # lets EXPLAIN ANALYZE attribute the H2D/layout
+                    # cost to this node instead of leaving it as
+                    # unattributed task wall
+                    with self.metrics.timer("input_io_time"):
+                        out = b.to_device()
+                    self._record_batch(out)
                     # heartbeat hookpoint: every plan bottoms out in a
                     # scan, so a task beats per source batch even when
                     # fused operators above yield nothing to the driver
                     monitor.tick()
-                    yield b.to_device()
+                    yield out
 
         return stream()

@@ -169,7 +169,7 @@ class ObjectAggExec(ExecNode):
                     else:
                         data[f"{u.name}#state"].append(acc)
             out = batch_from_pydict(data, self._schema)
-            self.metrics.add("output_rows", out.num_rows)
+            self._record_batch(out)
             yield out
 
         return stream()

@@ -216,7 +216,7 @@ class OrcScanExec(ExecNode):
                                     )
                                 )
                         b = RecordBatch(self._schema, cols, e - s)
-                        self.metrics.add("output_rows", b.num_rows)
+                        self._record_batch(b)
                         yield b.to_device()
 
         from ..runtime.pipeline import maybe_pipelined

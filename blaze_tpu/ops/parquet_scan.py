@@ -219,7 +219,7 @@ class ParquetScanExec(ExecNode):
                                     )
                                 )
                             b = RecordBatch(self._schema, sl, e - s)
-                            self.metrics.add("output_rows", b.num_rows)
+                            self._record_batch(b)
                             yield b.to_device()
 
         from ..runtime.pipeline import maybe_pipelined

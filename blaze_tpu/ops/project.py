@@ -162,7 +162,7 @@ class ProjectExec(ExecNode):
             for batch in child_stream:
                 with self.metrics.timer("elapsed_compute"):
                     out = self.project_batch(batch)
-                self.metrics.add("output_rows", out.num_rows)
+                self._record_batch(out)
                 yield out
 
         return stream()

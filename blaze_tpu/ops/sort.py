@@ -435,14 +435,14 @@ class SortExec(ExecNode):
                     with self.metrics.timer("sort_time"):
                         merged = concat_batches(buffered)
                         out = self._sorted_batch(merged.to_device(), self.fetch)
-                    self.metrics.add("output_rows", out.num_rows)
+                    self._record_batch(out)
                     yield out
                     return
                 sources = [self._spill_chunks(sp, self.schema) for sp in spills]
                 if buffered:
                     sources.append(self._mem_run_chunks(buffered))
                 for out in self._merge(sources, self.fetch, ctx):
-                    self.metrics.add("output_rows", out.num_rows)
+                    self._record_batch(out)
                     yield out
             finally:
                 for sp in state.freeze()[1]:

@@ -31,7 +31,7 @@ class UnionExec(ExecNode):
             for child in self.children:
                 if partition < child.num_partitions():
                     for b in child.execute(partition, ctx):
-                        self.metrics.add("output_rows", b.num_rows)
+                        self._record_batch(b)
                         yield b
 
         return stream()

@@ -624,7 +624,7 @@ class WindowExec(ExecNode):
             with self.metrics.timer("elapsed_compute"):
                 cols = self._kernel(tuple(merged.columns), merged.num_rows)
             out = RecordBatch(self._schema, list(cols), merged.num_rows)
-            self.metrics.add("output_rows", out.num_rows)
+            self._record_batch(out)
             yield out
 
         return stream()

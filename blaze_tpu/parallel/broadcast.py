@@ -117,7 +117,7 @@ class BroadcastExchangeExec(ExecNode):
                 for payload in iter_blob_frames(blob, site="broadcast"):
                     b = deserialize_batch(payload, self.schema)
                     if b.num_rows:
-                        self.metrics.add("output_rows", b.num_rows)
+                        self._record_batch(b)
                         yield b.to_device()
 
         return stream()

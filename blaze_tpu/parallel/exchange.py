@@ -534,7 +534,7 @@ class NativeShuffleExchangeExec(ExecNode):
                 # stay on disk).  The HBM retention for the plan's
                 # lifetime is the documented cost of this path.
                 for b in outputs[partition]:
-                    self.metrics.add("output_rows", b.num_rows)
+                    self._record_batch(b)
                     yield b
 
             return inproc_stream()

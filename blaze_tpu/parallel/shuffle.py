@@ -1097,7 +1097,7 @@ class IpcReaderExec(ExecNode):
                 except (struct.error, ValueError, EOFError) as e:
                     raise self._fetch_failed(block, partition, e) from e
                 if b.num_rows:
-                    self.metrics.add("output_rows", b.num_rows)
+                    self._record_batch(b)
                     yield b.to_device()
 
 

@@ -42,7 +42,7 @@ import time
 from typing import Callable, Dict, Iterator, List
 
 from ..analysis.locks import make_lock
-from . import lockset, trace
+from . import lockset, perf, trace
 from .metrics import _remove_by_identity
 
 _LOCK = make_lock("dispatch.counters")
@@ -160,7 +160,13 @@ def instrument(fn: Callable, label: str = "kernel") -> Callable:
                 return fn(*a, **k)
             t0 = time.perf_counter_ns()
             out = fn(*a, **k)
-            trace.record_kernel(label, 0, time.perf_counter_ns() - t0, 0)
+            bytes_est = flops_est = 0
+            if perf._ARMED:  # one bool read disarmed (perf contract)
+                bytes_est, flops_est = perf._estimate(a, k, out)
+                record("hbm_bytes_est", bytes_est)
+                record("flops_est", flops_est)
+            trace.record_kernel(label, 0, time.perf_counter_ns() - t0, 0,
+                                bytes_est=bytes_est, flops_est=flops_est)
             return out
 
         plain.__wrapped__ = fn
@@ -220,12 +226,23 @@ def instrument(fn: Callable, label: str = "kernel") -> Callable:
             device_ns = time.perf_counter_ns() - t1
         else:
             device_ns = 0
+        # bytes-moved / flops estimates for the roofline attribution
+        # (runtime/perf.py) — computed only under an active kernel
+        # capture, and only when the estimator is armed: disarmed cost
+        # is this one module-global bool read, like _KERNEL_TIMING
+        bytes_est = flops_est = 0
+        if perf._ARMED:
+            bytes_est, flops_est = perf._estimate(a, k, out)
+            record("hbm_bytes_est", bytes_est)
+            record("flops_est", flops_est)
         trace.record_kernel(
             label,
             device_ns=device_ns,
             dispatch_ns=0 if compiled else t1 - t0,
             compile_ns=t1 - t0 if compiled else 0,
             timed=timed,
+            bytes_est=bytes_est,
+            flops_est=flops_est,
         )
         return out
 

@@ -485,6 +485,10 @@ def query_span(query_id: str, mode: str = "in-process",
             with cancel_scope(query_id, timeout_ms=timeout_ms):
                 with query(query_id, mode=mode, pool=pool,
                            session=session):
+                    # the registry remembers where THIS query's event
+                    # log landed so /queries/<id>/explain can render
+                    # EXPLAIN ANALYZE from it after the run
+                    set_query_eventlog(log_path)
                     yield log_path
     finally:
         if enabled():
@@ -495,6 +499,19 @@ def query_span(query_id: str, mode: str = "in-process",
             # the event log is complete here (query_end emitted by the
             # trace span's own finally): convert + sink, best-effort
             otel.export_query(query_id, log_path)
+
+
+def set_query_eventlog(path: Optional[str]) -> None:
+    """Record the CURRENT query's event-log path in its registry entry
+    (no-op when untraced or disarmed) — the ``/queries/<id>/explain``
+    endpoint's source."""
+    if path is None or not enabled():
+        return
+    with _lock:
+        q = _current_entry()
+        if q is not None:
+            q["eventlog"] = path
+            _bump()
 
 
 def stage_started(stage_id: int, kind: Optional[str], n_tasks: int) -> None:
@@ -670,6 +687,15 @@ def _render_query(key: str, q: Dict[str, Any], now: int) -> Dict[str, Any]:
             p: max(t["rows"], t.get("progress_rows", 0))
             for p, t in st["tasks"].items()
         }
+        # roofline numerators summed over the stage's task beats
+        # (perf-estimator fields in each kernel-sink snapshot; 0 when
+        # untraced or the estimator is disarmed)
+        bytes_est = sum(k.get("bytes_est", 0)
+                        for t in st["tasks"].values()
+                        for k in (t.get("kernels") or {}).values())
+        flops_est = sum(k.get("flops_est", 0)
+                        for t in st["tasks"].values()
+                        for k in (t.get("kernels") or {}).values())
         stages.append({
             "stage_id": sid,
             "kind": st["kind"],
@@ -686,6 +712,8 @@ def _render_query(key: str, q: Dict[str, Any], now: int) -> Dict[str, Any]:
                              for t in st["tasks"].values()),
             "dispatch_ns": sum(t.get("dispatch_ns", 0)
                                for t in st["tasks"].values()),
+            "bytes_est": bytes_est,
+            "flops_est": flops_est,
             "tasks": {p: {"attempt": t["attempt"],
                           "task_id": t.get("task_id"),
                           "rows": task_rows[p],
@@ -711,6 +739,9 @@ def _render_query(key: str, q: Dict[str, Any], now: int) -> Dict[str, Any]:
         "heartbeat_age_s": round((now - q["last_beat"]) / 1e9, 3),
         "attempts": dict(q["attempts"]),
         "mem_peak_bytes": q["mem_peak"],
+        # where this query's event log landed (traced runs) — the
+        # /queries/<id>/explain source; null when untraced
+        "eventlog": q.get("eventlog"),
         "stages": stages,
     }
 
@@ -823,6 +854,37 @@ def render_profile(key_or_id: str) -> Optional[str]:
         return (f"# no kernel data for {qid!r} — flame profiles need "
                 f"tracing armed (spark.blaze.trace.enabled)\n")
     return "\n".join(lines) + "\n"
+
+
+def render_explain_for(key_or_id: str) -> Optional[str]:
+    """One query's EXPLAIN ANALYZE text (runtime/perf.py) rendered
+    from the event log its registry entry points at — served by
+    ``/queries/<id>/explain``.  Matches a registry key exactly, else
+    the LATEST entry for a query id.  None when unknown (the endpoint
+    404s); an untraced run renders a comment line so the consumer can
+    tell "no such query" from "no event log"."""
+    with _lock:
+        lockset.check(_REG, "_QUERIES")
+        entry = _QUERIES.get(key_or_id)
+        if entry is None:
+            for q in _QUERIES.values():
+                if q["query_id"] == key_or_id:
+                    entry = q  # insertion order: the LAST match wins
+        if entry is None:
+            return None
+        qid = entry["query_id"]
+        log_path = entry.get("eventlog")
+    # file IO + rendering strictly OUTSIDE the registry lock
+    if not log_path:
+        return (f"# no event log for {qid!r} — EXPLAIN ANALYZE needs "
+                f"tracing armed (spark.blaze.trace.enabled)\n")
+    try:
+        events = trace.read_event_log(log_path)
+    except OSError as e:
+        return f"# event log for {qid!r} unreadable: {e}\n"
+    from . import perf
+
+    return perf.render_explain(events) + "\n"
 
 
 # ----------------------------------------------------- history (JSONL)
@@ -1311,7 +1373,8 @@ def healthz_doc() -> Dict[str, Any]:
     doc: Dict[str, Any] = {
         "status": "ok",
         "endpoints": ["/metrics", "/queries", "/queries?all=1",
-                      "/queries/<id>/profile", "/healthz",
+                      "/queries/<id>/profile",
+                      "/queries/<id>/explain", "/healthz",
                       "POST /queries/<id>/cancel",
                       "POST /service/submit"],
     }
@@ -1428,10 +1491,33 @@ def render_prometheus(openmetrics: bool = False) -> str:
     # Prometheus — export the latest run only (history lives in
     # /queries)
     latest = {q["query_id"]: q for q in snap["queries"]}
+    peaks = None
     for q in latest.values():
         labels = {"query": q["query_id"]}
         doc.add("blaze_query_elapsed_seconds", q["elapsed_s"], labels,
                 mtype="gauge")
+        # roofline gauges (runtime/perf.py): hbm_util / mfu_est / bound
+        # per query from the task beats' kernel-sink estimates —
+        # exported only for traced runs with the estimator armed
+        # (bytes/flops stay 0 otherwise, and a zero-estimate query
+        # exports nothing rather than a misleading 0% series)
+        b = sum(st.get("bytes_est", 0) for st in q["stages"])
+        fl = sum(st.get("flops_est", 0) for st in q["stages"])
+        if b or fl:
+            from . import perf
+
+            if peaks is None:
+                peaks = perf.peaks_for(perf.current_device_kind())
+            cls = perf.classify(
+                sum(st.get("device_ns", 0) for st in q["stages"]),
+                sum(st.get("dispatch_ns", 0) for st in q["stages"]),
+                b, fl, peaks)
+            doc.add("blaze_query_hbm_util", cls["hbm_util"], labels,
+                    mtype="gauge")
+            doc.add("blaze_query_mfu_est", cls["mfu_est"], labels,
+                    mtype="gauge")
+            doc.add("blaze_query_bound", 1,
+                    dict(labels, bound=cls["bound"]), mtype="gauge")
         # the wedge-detector gauge: only meaningful while the query
         # runs — a finished query's last_beat is frozen, so its age
         # would climb forever and alert on every normal completion
@@ -1554,6 +1640,7 @@ class MonitorServer:
             def do_GET(self):  # noqa: N802 — http.server contract
                 path, _, query_s = self.path.partition("?")
                 prof = re.match(r"^/queries/([^/]+)/profile$", path)
+                expl = re.match(r"^/queries/([^/]+)/explain$", path)
                 try:
                     if path == "/metrics":
                         # content negotiation: exemplars are an
@@ -1577,6 +1664,15 @@ class MonitorServer:
                         # collapsed-stack flame profile of one query
                         # (consumable by flamegraph.pl / speedscope)
                         text = render_profile(prof.group(1))
+                        if text is None:
+                            self.send_error(404)
+                            return
+                        body = text.encode()
+                        ctype = "text/plain; charset=utf-8"
+                    elif expl is not None:
+                        # EXPLAIN ANALYZE of one query's traced run
+                        # (runtime/perf.py over its event log)
+                        text = render_explain_for(expl.group(1))
                         if text is None:
                             self.send_error(404)
                             return

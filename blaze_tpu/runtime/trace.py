@@ -392,11 +392,17 @@ def query(query_id: str, trace_id: Optional[str] = None,
         _current_path = _path or _default_path
         _spans_opened += 1
     t0 = time.perf_counter_ns()
+    # the device kind the query's programs will run on, stamped into
+    # the log: an event log analyzed OFFLINE (another machine, a CI
+    # box) must be judged against the roofline of the hardware that
+    # RAN it, not the analyzer's (runtime/perf.py prefers this stamp)
+    from . import perf as _perf
+
+    fields: Dict[str, Any] = {"query_id": query_id,
+                              "device_kind": _perf.current_device_kind()}
     if parent_span_id:
-        emit("query_start", query_id=query_id,
-             parent_span_id=parent_span_id)
-    else:
-        emit("query_start", query_id=query_id)
+        fields["parent_span_id"] = parent_span_id
+    emit("query_start", **fields)
     status = "ok"
     try:
         yield path
@@ -431,6 +437,14 @@ def kernel_capture() -> Iterator[Dict[str, Dict[str, int]]]:
     captures each get the full counts (scheduler per stage, run_task
     per attempt, bench per profile pass)."""
     global _KERNEL_TIMING
+    # the perf estimator only ever runs under an active capture, and
+    # dispatch reads its _ARMED bool directly for hot-path cheapness:
+    # capture entry is therefore the choke point that must resolve the
+    # lazy conf load, or spark.blaze.perf.estimates=false would be
+    # silently ignored on every production traced path
+    from . import perf as _perf
+
+    _perf.enabled()
     sink: Dict[str, Dict[str, int]] = {}
     with _sink_lock:
         lockset.check(_LOG, "_KERNEL_SINKS")
@@ -469,11 +483,16 @@ def sample_kernel() -> bool:
 
 
 def record_kernel(label: str, device_ns: int, dispatch_ns: int,
-                  compile_ns: int, timed: bool = True) -> None:
+                  compile_ns: int, timed: bool = True,
+                  bytes_est: int = 0, flops_est: int = 0) -> None:
     """Dispatch-wrapper callback: land one program's cost on every
     active capture under its operator kernel label.  ``timed`` False =
     a sampled-out program (launch overhead attributed, device drain
-    not measured); consumers scale device time by programs/timed."""
+    not measured); consumers scale device time by programs/timed.
+    ``bytes_est``/``flops_est`` are the perf estimator's bytes-moved /
+    flops guesses for the program (runtime/perf.py — 0 when the
+    estimator is disarmed), the roofline numerators ``--report`` and
+    ``--explain`` judge against the device peak table."""
     with _sink_lock:
         lockset.check(_LOG, "_KERNEL_SINKS")
         for sink in _KERNEL_SINKS:
@@ -482,12 +501,15 @@ def record_kernel(label: str, device_ns: int, dispatch_ns: int,
                 agg = sink[label] = {
                     "programs": 0, "device_ns": 0,
                     "dispatch_ns": 0, "compile_ns": 0, "timed": 0,
+                    "bytes_est": 0, "flops_est": 0,
                 }
             agg["programs"] += 1
             agg["device_ns"] += int(device_ns)
             agg["dispatch_ns"] += int(dispatch_ns)
             agg["compile_ns"] += int(compile_ns)
             agg["timed"] += 1 if timed else 0
+            agg["bytes_est"] += int(bytes_est)
+            agg["flops_est"] += int(flops_est)
 
 
 def snapshot_kernels(sink: Dict[str, Dict[str, int]]) -> Dict[str, Dict[str, int]]:
@@ -521,6 +543,9 @@ def sum_kernels(sink: Dict[str, Dict[str, int]]) -> Dict[str, int]:
         "device_time_ns": sum(scaled_device_ns(v) for v in sink.values()),
         "dispatch_overhead_ns": sum(v["dispatch_ns"] for v in sink.values()),
         "compile_ns": sum(v["compile_ns"] for v in sink.values()),
+        # roofline numerators (runtime/perf.py estimator; 0 disarmed)
+        "hbm_bytes_est": sum(v.get("bytes_est", 0) for v in sink.values()),
+        "flops_est": sum(v.get("flops_est", 0) for v in sink.values()),
     }
 
 

@@ -339,13 +339,16 @@ def _stage_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
 
 def _kernel_rows(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, int]]:
     """Per-kernel-label totals across all stage_complete events (the
-    operator-kernel table, sampling-aware)."""
+    operator-kernel table, sampling-aware; ``bytes_est``/``flops_est``
+    are the perf estimator's roofline numerators, 0 in pre-estimator
+    logs)."""
     kernels: Dict[str, Dict[str, int]] = {}
     for e in by_type(events).get("stage_complete", []):
         for label, v in (e.get("kernels") or {}).items():
             agg = kernels.setdefault(
                 label, {"programs": 0, "device_ns": 0,
-                        "dispatch_ns": 0, "compile_ns": 0, "timed": 0})
+                        "dispatch_ns": 0, "compile_ns": 0, "timed": 0,
+                        "bytes_est": 0, "flops_est": 0})
             for k in agg:
                 if k == "timed":
                     agg[k] += v.get("timed", v.get("programs", 0))
@@ -363,12 +366,18 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     add keys freely, never rename or remove."""
     from . import trace as _trace
 
+    from . import perf
+
     t = by_type(events)
     ts0 = min((e["ts"] for e in events if "ts" in e), default=0.0)
     ends = t.get("query_end", [])
     query = {
         "ids": [e.get("query_id", "?") for e in t.get("query_start", [])],
         "status": [e.get("status", "ok") for e in ends],
+        # the one-word verdict consumers branch on: done / failed /
+        # cancelled / deadline_exceeded / incomplete (no terminal
+        # event at all — crash mid-run or a live log read early)
+        "terminal_status": perf.terminal_status(events),
         "wall_ns": sum(e.get("wall_ns", 0) for e in ends),
         # the distributed-trace join key (one per query span; a merged
         # driver+worker log shows each query's segments under ONE id)
@@ -384,12 +393,21 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                                          for s in stages),
              "compile_ns": sum(s["compile_ns"] for s in stages)}
 
+    rows = _kernel_rows(events)
+    # one aggregation pass feeds both the kernel table and the query
+    # perf section; the peak table resolves once, against the log's
+    # own device_kind stamp (offline analysis judges the hardware
+    # that RAN the log, not the analyzer's)
+    qperf = perf.query_perf(events, kernels=rows)
+    peaks = qperf["peak"]
     kernels = {}
-    for label, v in _kernel_rows(events).items():
+    for label, v in rows.items():
         kernels[label] = dict(
             v,
             device_ns_scaled=_trace.scaled_device_ns(v),
             sampled=v["timed"] < v["programs"],
+            # per-kernel roofline judgment (hbm_util / mfu_est / bound)
+            **perf.kernel_perf(v, peaks),
         )
 
     plans: Dict[str, Any] = {}
@@ -486,16 +504,23 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "memory": memory,
         "recovery": recovery,
         "progress": progress,
+        # the whole-query roofline judgment (runtime/perf.py): bytes/
+        # flops estimates vs the device peak table -> hbm_util /
+        # mfu_est / bound classification — the measurement ROADMAP
+        # items 3-4 judge batch-size autotuning and bench artifacts by
+        "perf": qperf,
     }
 
 
 def render(events: List[Dict[str, Any]]) -> str:
     """The full profile report (plain text)."""
+    from . import perf
+
     if not events:
         return "empty event log"
     t = by_type(events)
     lines: List[str] = []
-    ts0 = min(e["ts"] for e in events if "ts" in e)
+    ts0 = min((e["ts"] for e in events if "ts" in e), default=0.0)
 
     # ---- header
     queries = [e.get("query_id", "?") for e in t.get("query_start", [])]
@@ -503,12 +528,24 @@ def render(events: List[Dict[str, Any]]) -> str:
     wall_ns = sum(e.get("wall_ns", 0) for e in ends)
     tids = sorted({e.get("trace_id") for e in t.get("query_start", [])
                    if e.get("trace_id")})
+    status = perf.terminal_status(events)
     lines.append(
         f"query: {', '.join(queries) if queries else '(no query span)'}"
+        + f"  status {status.upper()}"
         + (f"  wall {_fmt_s(wall_ns)}" if wall_ns else "")
         + f"  events {len(events)}"
         + (f"  trace {', '.join(tids)}" if tids else "")
     )
+    if status != "done":
+        # explicit terminal-status banner: a profile over a query that
+        # ended failed / cancelled / deadline_exceeded (or whose log
+        # has no terminal event at all) must SAY so up front — the
+        # numbers below cover only what ran before the terminal event
+        lines.append(
+            f"*** query terminal status: {status.upper()} — partial "
+            f"profile (metrics cover only what ran"
+            + (" before the terminal event) ***" if status != "incomplete"
+               else "; no query_end event in this log) ***"))
 
     # ---- per-stage timeline + dispatch-floor split
     completes = sorted(t.get("stage_complete", []),
@@ -548,23 +585,29 @@ def render(events: List[Dict[str, Any]]) -> str:
             f"{_fmt_s(total['wall'])} stage wall"
         )
 
+        # the whole-query roofline judgment (runtime/perf.py): are we
+        # limited by the per-program launch floor, the HBM roof, or
+        # the flops roof — and how far under the hardware we sit.
+        # One aggregation pass shared with the kernel table below.
+        krows = _kernel_rows(events)
+        qp = perf.query_perf(events, kernels=krows)
+        if qp["programs"]:
+            lines.append(
+                f"  perf: {qp['bound']}  "
+                f"hbm_util {100 * qp['hbm_util']:.2f}%  "
+                f"mfu_est {100 * qp['mfu_est']:.4f}%  "
+                f"(bytes~{qp['hbm_bytes_est']:,}, "
+                f"flops~{qp['flops_est']:,}; peaks "
+                f"{qp['peak']['device']}: {qp['peak']['hbm_gbps']:g} GB/s, "
+                f"{qp['peak']['tflops']:g} TF)")
+
         # per-kernel-label attribution across all stages.  Sampled
         # captures (spark.blaze.trace.sampleRate > 1) timed only every
         # Nth program: device time scales back up by programs/timed
         # (trace.scaled_device_ns), flagged with '~' as an estimate.
         from . import trace as _trace
 
-        kernels: Dict[str, Dict[str, int]] = {}
-        for e in completes:
-            for label, v in (e.get("kernels") or {}).items():
-                agg = kernels.setdefault(
-                    label, {"programs": 0, "device_ns": 0,
-                            "dispatch_ns": 0, "compile_ns": 0, "timed": 0})
-                for k in agg:
-                    if k == "timed":
-                        agg[k] += v.get("timed", v.get("programs", 0))
-                    else:
-                        agg[k] += v.get(k, 0)
+        kernels = krows
         if kernels:
             lines.append("")
             lines.append("operator kernels (by device time):")
@@ -573,11 +616,13 @@ def render(events: List[Dict[str, Any]]) -> str:
                     key=lambda kv: -_trace.scaled_device_ns(kv[1])):
                 sampled = v["timed"] < v["programs"]
                 dev = _trace.scaled_device_ns(v)
+                kp = perf.kernel_perf(v, qp["peak"])
                 lines.append(
                     f"  {label:24s} programs {v['programs']:>5d}  "
                     f"device {('~' if sampled else '') + _fmt_s(dev):>9s}  "
                     f"dispatch {_fmt_s(v['dispatch_ns']):>9s}  "
-                    f"compile {_fmt_s(v['compile_ns'])}"
+                    f"compile {_fmt_s(v['compile_ns'])}  "
+                    f"hbm {100 * kp['hbm_util']:.2f}%  {kp['bound']}"
                     + (f"  (timed {v['timed']}/{v['programs']})"
                        if sampled else "")
                 )

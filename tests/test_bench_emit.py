@@ -62,6 +62,11 @@ def test_merge_cached_carries_whole_q01_half():
             "q01_warm_compiles": 0, "q01_programs": 9,
             "q01_device_time_s": 0.8, "q01_dispatch_overhead_s": 0.1,
             "q01_timed": 9,
+            # the roofline half (runtime/perf.py): provenance travels
+            # WITH the carried q01 — a bound class judged on one
+            # device must not describe another run's numbers
+            "q01_hbm_bytes_est": 123456, "q01_hbm_util": 0.02,
+            "q01_mfu_est": 0.001, "q01_bound": "dispatch-bound",
             "q01_device_kind": "TPU v4", "q01_trace_sample_rate": 1,
             "q01_trace_id": "a" * 32, "q01_query_id": "bench_1_1",
             "q01_measured_at": "2026-08-01T00:00:00Z"}
@@ -84,6 +89,8 @@ def test_merge_cached_best_of_q06_keeps_profile_with_its_half():
             "dispatch_count": 1.0, "compile_ms": 100, "warm_compiles": 0,
             "programs": 3, "device_time_s": 0.5,
             "dispatch_overhead_s": 0.05, "timed": 3,
+            "hbm_bytes_est": 999, "hbm_util": 0.5, "mfu_est": 0.1,
+            "bound": "memory-bound",
             "device_kind": "TPU v4", "trace_sample_rate": 1,
             "measured_at": "2026-08-01T00:00:00Z",
             "q01_rows_per_sec": 5.0}
@@ -91,6 +98,8 @@ def test_merge_cached_best_of_q06_keeps_profile_with_its_half():
              "dispatch_count": 9.0, "compile_ms": 5, "warm_compiles": 2,
              "programs": 40, "device_time_s": 0.1,
              "dispatch_overhead_s": 0.9, "timed": 10,
+             "hbm_bytes_est": 111, "hbm_util": 0.01, "mfu_est": 0.001,
+             "bound": "dispatch-bound",
              "device_kind": "cpu:0", "trace_sample_rate": 4,
              "measured_at": "2026-08-02T00:00:00Z",
              "q01_rows_per_sec": 6.0}
@@ -106,6 +115,13 @@ def test_merge_cached_best_of_q06_keeps_profile_with_its_half():
     assert merged["timed"] == 3
     assert merged["device_kind"] == "TPU v4"
     assert merged["trace_sample_rate"] == 1
+    # the roofline judgment is PART of the winning half: pairing the
+    # cached throughput with the fresh run's bound class would claim
+    # a memory-bound number was dispatch-bound
+    assert merged["hbm_bytes_est"] == 999
+    assert merged["hbm_util"] == 0.5
+    assert merged["mfu_est"] == 0.1
+    assert merged["bound"] == "memory-bound"
     # q01 was freshly measured: it stays fresh
     assert merged["q01_rows_per_sec"] == 6.0
 
@@ -120,6 +136,8 @@ def test_merge_cached_old_format_winner_drops_fresh_profile_keys():
     fresh = {"backend": "tpu", "value": 4.0, "vs_baseline": 0.4,
              "programs": 40, "device_time_s": 0.1,
              "dispatch_overhead_s": 0.9, "timed": 40,
+             "hbm_bytes_est": 111, "hbm_util": 0.01, "mfu_est": 0.001,
+             "bound": "dispatch-bound",
              "device_kind": "cpu:0", "trace_sample_rate": 1,
              "measured_at": "2026-08-02T00:00:00Z"}
     merged = bench._merge_cached(fresh, prev)
@@ -131,6 +149,12 @@ def test_merge_cached_old_format_winner_drops_fresh_profile_keys():
     assert "timed" not in merged
     assert "device_kind" not in merged
     assert "trace_sample_rate" not in merged
+    # ...nor may the fresh roofline judgment (an old-format winner has
+    # no bound class: better absent than somebody else's)
+    assert "hbm_bytes_est" not in merged
+    assert "hbm_util" not in merged
+    assert "mfu_est" not in merged
+    assert "bound" not in merged
 
 
 def test_merge_cached_non_tpu_prev_never_wins_best_of():

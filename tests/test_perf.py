@@ -1,0 +1,750 @@
+"""Performance introspection layer (tier-1, CPU backend) —
+runtime/perf.py: EXPLAIN ANALYZE, roofline/MFU attribution, and the
+perf-baseline regression gate.
+
+1. **EXPLAIN ANALYZE** (acceptance): a real warm TPC-H q01 run through
+   the stage scheduler yields an explain tree that attributes >= 80%
+   of the query wall to plan nodes, with per-node rows/bytes/batches
+   populated and reconciling against the driver-observed output.
+2. **Roofline math**: classify() unit-checked against a synthetic peak
+   table (hbm_util / mfu_est / ridge-point bound selection), peak-table
+   matching (longest substring, default fallback), and the estimator's
+   pytree walk over real Column batches.
+3. **Bound differentials**: q01/q06 classify dispatch-bound with
+   hbm_util < 10% on this backend (the VERDICT r5 observation,
+   reproduced mechanically); collapsing an unfused run's program count
+   to the fused run's under the remote chip's per-program floor flips
+   dispatch-bound -> memory-or-compute-bound.
+4. **Perf-baseline gate**: --perfcheck machinery passes on HEAD over
+   the TPC-H slice, FIRES on a seeded 2x dispatch inflation, and
+   --perfcheck --update round-trips (re-pin then clean).
+5. **Estimator cost contract**: disarmed, the dispatch choke point
+   never enters the estimator (poisoned — one bool read, the
+   trace.enabled pattern); armed, a real program records nonzero
+   bytes/flops.
+6. **Monitor endpoint**: /queries/<id>/explain serves the rendered
+   explain for a traced run, a comment for an untraced one, 404 for an
+   unknown query.
+7. **Terminal-status rendering**: --report (text + JSON) renders
+   cleanly — explicit status banner, no KeyError — over event logs of
+   queries that ended failed / cancelled / deadline_exceeded, and over
+   a truncated log with no terminal event at all.
+8. **Golden pins**: EXPLAIN_JSON_KEYS / PERFCHECK_JSON_KEYS top-level
+   shapes, and the --report --json ``perf`` section.
+"""
+
+import json
+import shutil
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.runtime import dispatch, monitor, perf, trace, trace_report
+from blaze_tpu.runtime.context import (
+    QueryCancelledError, QueryDeadlineError,
+)
+from blaze_tpu.runtime.scheduler import run_stages, split_stages
+from blaze_tpu.tpch import TPCH_SCHEMAS, build_query
+from blaze_tpu.tpch.datagen import generate_all, table_to_batches
+
+SCALE = 0.01
+BATCH_ROWS = 4096
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate_all(SCALE)
+
+
+def _scans(data, n_parts=1, batch_rows=BATCH_ROWS):
+    return {
+        name: MemoryScanExec(
+            table_to_batches(data[name], TPCH_SCHEMAS[name], n_parts,
+                             batch_rows=batch_rows),
+            TPCH_SCHEMAS[name],
+        )
+        for name in TPCH_SCHEMAS
+    }
+
+
+def _run_scheduler(data, q, n_parts=1, batch_rows=BATCH_ROWS):
+    stages, manager = split_stages(
+        build_query(q, _scans(data, n_parts, batch_rows), n_parts))
+    return sum(b.num_rows for b in run_stages(stages, manager))
+
+
+def _traced_run(data, q, tmp_path, query_id=None, warm_runs=1,
+                batch_rows=None):
+    """Warm ``q`` through the scheduler, then run it once more traced;
+    returns the event list of the traced (warm) run.  The default
+    batch size (2048) keeps the per-batch program loop long enough
+    that the dispatch floor dominates decisively on the CPU backend —
+    the same regime the real chip's ~70 ms per-program turnaround puts
+    every batch size in (VERDICT r5)."""
+    batch_rows = batch_rows or 2048
+    for _ in range(warm_runs):
+        _run_scheduler(data, q, batch_rows=batch_rows)
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    trace.reset()
+    perf.reset()
+    try:
+        with trace.query(query_id or f"perf_{q}") as path:
+            rows = _run_scheduler(data, q, batch_rows=batch_rows)
+        assert rows > 0 and path is not None
+        return trace.read_events(path)
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+
+
+# ------------------------------------------------- 1. EXPLAIN ANALYZE
+
+@pytest.fixture(scope="module")
+def q1_events(data, tmp_path_factory):
+    return _traced_run(data, "q1",
+                       tmp_path_factory.mktemp("explain_q1"))
+
+
+@pytest.fixture(scope="module")
+def q6_events(data, tmp_path_factory):
+    return _traced_run(data, "q6",
+                       tmp_path_factory.mktemp("explain_q6"))
+
+
+def test_explain_q1_attributes_80pct_of_wall(q1_events):
+    """Acceptance: the metric-annotated plan attributes >= 80% of a
+    warm q01's query wall to plan nodes (the PR 3 reconciliation bar,
+    applied to the explain tree)."""
+    doc = perf.explain_doc(q1_events)
+    assert doc["status"] == "done"
+    assert doc["wall_ns"] > 0
+    assert doc["attributed_pct"] >= 80.0, (
+        f"only {doc['attributed_pct']}% of query wall attributed to "
+        f"plan nodes")
+
+
+def test_explain_q1_node_annotations_reconcile(q1_events):
+    """Per-node rows/bytes/batches annotations are real: the scan node
+    carries the full lineitem row count over > 1 batch with > 0 bytes,
+    and row counts shrink monotonically through the aggregation."""
+    doc = perf.explain_doc(q1_events)
+    stage0 = next(s for s in doc["stages"] if s["stage_id"] == 0)
+    assert stage0["plan"] is not None
+
+    nodes = []
+
+    def walk(n):
+        nodes.append(n)
+        for c in n["children"]:
+            walk(c)
+
+    walk(stage0["plan"])
+    scan = next(n for n in nodes if n["op"] == "MemoryScanExec")
+    assert scan["rows"] > 10_000          # the q01 lineitem scan
+    assert scan["batches"] > 1
+    assert scan["bytes"] > scan["rows"]   # > 1 byte per row, trivially
+    agg = next(n for n in nodes if n["op"].startswith("AggExec"))
+    assert 0 < agg["rows"] < scan["rows"]
+    # own-time attribution present on the compute-carrying node
+    assert agg["own_ns"] > 0
+
+
+def test_explain_render_text(q1_events):
+    text = perf.render_explain(q1_events)
+    assert "EXPLAIN ANALYZE" in text
+    assert "status=DONE" in text
+    assert "MemoryScanExec" in text and "AggExec" in text
+    assert "rows=" in text and "bytes=" in text and "batches=" in text
+    assert "hbm_util=" in text and "mfu_est=" in text
+
+
+def test_explain_fused_chain_marker(tmp_path):
+    """A traceable chain that fuses into a FusedStageExec (the
+    explode -> filter -> computed-projection chain the dispatch-budget
+    suite pins as fusing) shows the fused-chain marker — op name,
+    ``fused`` flag, and chain length — in its explain tree."""
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.exprs import col
+    from blaze_tpu.exprs.ir import Alias, BinOp, Lit
+    from blaze_tpu.ops.filter import FilterExec
+    from blaze_tpu.ops.generate import GenerateExec, NativeGenerator
+    from blaze_tpu.ops.project import ProjectExec
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    arr_t = DataType.array(DataType.int64(), 4)
+    schema = Schema([Field("k", DataType.int64()), Field("xs", arr_t)])
+    rows = {"k": list(range(40)),
+            "xs": [[i, i + 1, i + 2][: (i % 4)] or None
+                   for i in range(40)]}
+
+    def plan():
+        scan = MemoryScanExec([[batch_from_pydict(rows, schema)]], schema)
+        g = GenerateExec(scan, NativeGenerator("explode", col("xs")),
+                         [col("xs")])
+        f = FilterExec(g, BinOp(">", col("col"),
+                                Lit(5, DataType.int64())))
+        return ProjectExec(
+            f, [col("k"), Alias(BinOp("+", col("col"),
+                                      Lit(1, DataType.int64())), "c1")],
+            ["k", "c1"])
+
+    def run():
+        stages, mgr = split_stages(plan())
+        return sum(b.num_rows for b in run_stages(stages, mgr))
+
+    run()
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    trace.reset()
+    try:
+        with trace.query("fused_chain") as path:
+            assert run() > 0
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+    events = trace.read_events(path)
+    doc = perf.explain_doc(events)
+    nodes = []
+
+    def walk(n):
+        nodes.append(n)
+        for c in n["children"]:
+            walk(c)
+
+    for s in doc["stages"]:
+        if s["plan"]:
+            walk(s["plan"])
+    fused = [n for n in nodes if n.get("fused")]
+    assert fused, [n["op"] for n in nodes]
+    assert fused[0]["fused_ops"] >= 2
+    assert "[fused" in perf.render_explain(events)
+
+
+def test_explain_json_golden_keys(q1_events):
+    """The --explain --json shape is API: pinned top-level keys (add
+    freely, never rename), JSON-serializable as-is."""
+    doc = perf.explain_doc(q1_events)
+    assert set(perf.EXPLAIN_JSON_KEYS) <= set(doc)
+    for st in doc["stages"]:
+        assert {"stage_id", "kind", "status", "wall_ns", "pct_of_query",
+                "plan"} <= set(st)
+    assert doc["kernels"], "no kernel table"
+    for v in doc["kernels"].values():
+        assert {"programs", "hbm_util", "mfu_est", "bound"} <= set(v)
+    json.dumps(doc)
+
+
+# ------------------------------------------------- 2. roofline units
+
+SYNTH_PEAKS = {"hbm_gbps": 100.0, "tflops": 1.0, "device": "synth"}
+
+
+def test_classify_units_memory_bound():
+    """1 s of device time moving 50 GB at a 100 GB/s roof = 50% HBM
+    utilization; 0.1 Tflop at a 1 TF roof = 10% MFU; intensity 0.002
+    flop/byte is far under the ridge (10) -> memory-bound."""
+    out = perf.classify(device_ns=1_000_000_000, dispatch_ns=0,
+                        bytes_est=50_000_000_000,
+                        flops_est=100_000_000_000, peaks=SYNTH_PEAKS)
+    assert out["hbm_util"] == pytest.approx(0.5)
+    assert out["mfu_est"] == pytest.approx(0.1)
+    assert out["bound"] == "memory-bound"
+
+
+def test_classify_units_compute_bound():
+    """Intensity above the ridge point (flops/bytes > peak_flops/
+    peak_bw = 10) with device time dominating -> compute-bound."""
+    out = perf.classify(device_ns=1_000_000_000, dispatch_ns=0,
+                        bytes_est=1_000_000_000,
+                        flops_est=500_000_000_000, peaks=SYNTH_PEAKS)
+    assert out["intensity"] == pytest.approx(500.0)
+    assert out["bound"] == "compute-bound"
+    assert out["mfu_est"] == pytest.approx(0.5)
+
+
+def test_classify_dispatch_bound_and_unknown():
+    out = perf.classify(device_ns=1_000, dispatch_ns=1_000_000,
+                        bytes_est=100, flops_est=100, peaks=SYNTH_PEAKS)
+    assert out["bound"] == "dispatch-bound"
+    # utilization over the ATTRIBUTED wall: a chip idling between
+    # programs must not flatter itself with a device-seconds-only
+    # denominator
+    assert out["hbm_util"] < 0.01
+    empty = perf.classify(0, 0, 0, 0, SYNTH_PEAKS)
+    assert empty["bound"] == "unknown"
+    assert empty["hbm_util"] == 0.0
+
+
+def test_peaks_for_matching():
+    table = {"default": {"hbm_gbps": 1.0, "tflops": 1.0},
+             "devices": {"v5": {"hbm_gbps": 2.0, "tflops": 2.0},
+                         "v5e": {"hbm_gbps": 3.0, "tflops": 3.0}}}
+    # longest substring wins; matching is case-insensitive
+    assert perf.peaks_for("TPU V5E chip 0", table)["hbm_gbps"] == 3.0
+    assert perf.peaks_for("tpu v5 pod", table)["hbm_gbps"] == 2.0
+    # unmatched falls back to default, stamped as such
+    e = perf.peaks_for("TFRT_CPU_0", table)
+    assert e["hbm_gbps"] == 1.0 and e["device"] == "default"
+
+
+def test_estimator_counts_column_pytree_buffers():
+    """The estimator must see through the engine's registered pytrees
+    (batch.Column): a real column's data+validity buffers count, not
+    an opaque 0."""
+    from blaze_tpu.batch import batch_from_pydict
+    from blaze_tpu.schema import DataType, Field, Schema
+
+    schema = Schema([Field("x", DataType.int64())])
+    b = batch_from_pydict({"x": list(range(1000))}, schema)
+    nbytes, flops = perf._estimate((tuple(b.columns), b.num_rows), {},
+                                   None)
+    assert nbytes >= 8 * 1000  # at least the int64 data buffer
+    assert flops >= 1000
+
+
+# ------------------------------------------- 3. bound differentials
+
+def test_q1_q6_dispatch_bound_under_10pct_hbm(q1_events, q6_events):
+    """Acceptance (VERDICT r5 reproduced mechanically): warm q01/q06
+    classify dispatch-bound with hbm_util < 10%.  The judgment is made
+    from REAL measured per-query totals (programs, bytes, flops,
+    device time) under the target chip's measured ~70 ms per-program
+    dispatch floor and v5e peaks — the hardware the VERDICT observed.
+    The CPU host's own python-call dispatch split swings 2-3x with CI
+    load (both directions), so asserting on it would test the host's
+    scheduler, not the engine; the floor model is load-invariant while
+    still grounded in this run's measured program counts and bytes.
+    The measured run must still show the floor is REAL here too: a
+    substantial dispatch share and single-digit HBM utilization."""
+    floor_ns = 70_000_000  # per-program turnaround through the tunnel
+    for events in (q1_events, q6_events):
+        qp = perf.query_perf(events, device_kind="cpu")
+        # measured on this host: far under the memory roof, and the
+        # launch floor is a visible fraction of the attributed wall
+        assert qp["hbm_util"] < 0.10, qp
+        assert qp["dispatch_ns"] > 0.15 * (qp["dispatch_ns"]
+                                           + qp["device_ns"]), qp
+        # the chip-model judgment --report would render on the v5e:
+        # same programs/bytes/flops, the measured per-program floor
+        chip = perf.classify(qp["device_ns"],
+                             qp["programs"] * floor_ns,
+                             qp["hbm_bytes_est"], qp["flops_est"],
+                             perf.peaks_for("v5e"))
+        assert chip["bound"] == "dispatch-bound", chip
+        assert chip["hbm_util"] < 0.10, chip
+
+
+def test_fusion_collapse_flips_bound_class(q1_events):
+    """The differential the gate exists to catch, over REAL measured
+    q01 totals: at the measured (fused) split the query is
+    dispatch-bound; multiplying the dispatch bill by the pre-fusion
+    program blowup (~20x, the VERDICT r5 ~100-programs-per-batch
+    pathology vs ~1 warm) keeps it decisively dispatch-bound, while
+    collapsing the dispatch bill 20x FURTHER (fusing past the
+    boundary, ROADMAP item 3) flips the classification to
+    memory-or-compute-bound — same bytes, same device work: fusion
+    removes launches, not arithmetic."""
+    totals = perf.sum_kernel_rows(trace_report._kernel_rows(q1_events))
+    assert totals["programs"] > 0 and totals["bytes_est"] > 0
+    peaks = perf.peaks_for("cpu")
+    # the pre-fusion pathology: ~20x the measured dispatch bill (the
+    # VERDICT ~100-programs-per-batch blowup vs ~1 warm) must read
+    # decisively dispatch-bound whatever this host's load did to the
+    # measured split...
+    unfused = perf.classify(totals["device_ns"],
+                            totals["dispatch_ns"] * 20,
+                            totals["bytes_est"], totals["flops_est"],
+                            peaks)
+    assert unfused["bound"] == "dispatch-bound"
+    # ...and collapsing the bill 20x below the measured split (fusing
+    # past the boundary, ROADMAP item 3) must flip the class: device
+    # work now dominates, same bytes, same arithmetic
+    collapsed = perf.classify(totals["device_ns"],
+                              totals["dispatch_ns"] // 20,
+                              totals["bytes_est"], totals["flops_est"],
+                              peaks)
+    assert collapsed["bound"] in ("memory-bound", "compute-bound")
+
+
+def test_unfused_run_issues_more_programs(data, tmp_path):
+    """Ground the differential's premise in a real run: fusion OFF
+    issues strictly more programs for the same q06 work."""
+    fused = perf.sum_kernel_rows(trace_report._kernel_rows(
+        _traced_run(data, "q6", tmp_path, query_id="diff_fused")))
+    conf.FUSION_ENABLE.set(False)
+    try:
+        unfused = perf.sum_kernel_rows(trace_report._kernel_rows(
+            _traced_run(data, "q6", tmp_path, query_id="diff_unfused")))
+    finally:
+        conf.FUSION_ENABLE.set(True)
+    assert unfused["programs"] > fused["programs"]
+    assert unfused["bytes_est"] > 0 and fused["bytes_est"] > 0
+
+
+def test_query_perf_prefers_log_device_stamp():
+    """An event log analyzed OFFLINE is judged against the roof of the
+    hardware that RAN it (the query_start ``device_kind`` stamp), not
+    the analyzing host's — a v5e log on a CPU box must use v5e peaks."""
+    events = [
+        {"ts": 1.0, "type": "query_start", "query_id": "q",
+         "device_kind": "TPU v5e chip 0"},
+        {"ts": 2.0, "type": "stage_complete", "stage_id": 0,
+         "kind": "map", "n_tasks": 1, "status": "ok", "wall_ns": 10,
+         "programs": 1, "device_time_ns": 5, "dispatch_overhead_ns": 1,
+         "compile_ns": 0,
+         "kernels": {"agg": {"programs": 1, "device_ns": 5,
+                             "dispatch_ns": 1, "compile_ns": 0,
+                             "timed": 1, "bytes_est": 100,
+                             "flops_est": 10}}},
+        {"ts": 3.0, "type": "query_end", "query_id": "q",
+         "status": "ok", "wall_ns": 10},
+    ]
+    qp = perf.query_perf(events)
+    assert qp["device_kind"] == "TPU v5e chip 0"
+    assert qp["peak"]["device"] == "v5e"
+    assert perf.explain_doc(events)["perf"]["peak"]["device"] == "v5e"
+    # a pre-stamp log falls back to the analyzing process's device
+    legacy = [dict(e) for e in events]
+    legacy[0].pop("device_kind")
+    assert perf.query_perf(legacy)["device_kind"] \
+        == perf.current_device_kind()
+
+
+def test_real_log_carries_device_stamp(q1_events):
+    assert perf.device_kind_from_events(q1_events)
+
+
+# --------------------------------------------- 4. perf-baseline gate
+
+@pytest.fixture(scope="module")
+def perfcheck_result():
+    """ONE real measurement sweep shared by the gate tests (tier-1
+    budget: the sweep is 5 warm queries at pinned scale)."""
+    rc, doc = perf.run_perfcheck()
+    return rc, doc
+
+
+def test_perfcheck_clean_on_head(perfcheck_result):
+    """Acceptance: --perfcheck passes on HEAD over the TPC-H slice."""
+    rc, doc = perfcheck_result
+    assert rc == 0, doc["problems"]
+    assert doc["ok"] is True
+    assert len(doc["queries"]) >= 5
+    for name, m in doc["queries"].items():
+        assert m["warm_compiles"] == 0, (name, m)
+
+
+def test_perfcheck_json_golden_keys(perfcheck_result):
+    _, doc = perfcheck_result
+    assert set(perf.PERFCHECK_JSON_KEYS) <= set(doc)
+    for m in doc["queries"].values():
+        assert {"warm_dispatches", "dispatches_per_batch", "programs",
+                "warm_compiles", "bound", "hbm_util", "mfu_est"} <= set(m)
+    json.dumps(doc)
+
+
+def test_perfcheck_fires_on_seeded_dispatch_inflation(perfcheck_result):
+    """Acceptance: a seeded 2x dispatch inflation is DETECTED — drift
+    detection actually fires, it is not a tautology."""
+    _, doc = perfcheck_result
+    registry = perf.load_baselines()
+    # same resolution run_perfcheck uses: conf override when nonzero,
+    # else the registry's pinned tolerance
+    tolerance = (float(conf.PERF_TOLERANCE.get())
+                 or float(registry.get("tolerance", 0.25)))
+    fired = 0
+    for name, base in registry["queries"].items():
+        measured = dict(doc["queries"][name])
+        measured["warm_dispatches"] *= 2
+        measured["programs"] *= 2
+        problems = perf.check_query(name, measured, base, tolerance)
+        assert problems, f"{name}: 2x inflation not detected"
+        fired += len(problems)
+    assert fired >= len(registry["queries"])
+
+
+def test_perfcheck_improvement_also_drifts():
+    """Drift is two-sided: a silent improvement must be re-pinned, not
+    absorbed (the registry stays meaningful)."""
+    base = {"warm_dispatches": 100, "programs": 100, "warm_compiles": 0,
+            "bound": "dispatch-bound"}
+    measured = {"warm_dispatches": 50, "programs": 50, "warm_compiles": 0,
+                "bound": "dispatch-bound", "device_ns": 1,
+                "dispatch_ns": 100}
+    problems = perf.check_query("qx", measured, base, 0.25)
+    assert problems and "improved" in problems[0]
+
+
+def test_perfcheck_bound_flip_borderline_is_noise():
+    """A bound-class flip across a borderline dispatch/device split
+    (within 3x either way) is measurement noise, not drift — a loaded
+    CI host legitimately swings the CPU backend's split 2-3x, while a
+    dispatch-floor re-fragmentation moves it an order of magnitude."""
+    base = {"warm_dispatches": 10, "programs": 10, "warm_compiles": 0,
+            "bound": "dispatch-bound"}
+    for dev, disp in ((100, 90), (100, 49), (100, 290)):
+        noisy = {"warm_dispatches": 10, "programs": 10,
+                 "warm_compiles": 0, "bound": "memory-bound",
+                 "device_ns": dev, "dispatch_ns": disp}
+        assert perf.check_query("qx", noisy, base, 0.25) == [], (dev, disp)
+    decisive = {"warm_dispatches": 10, "programs": 10,
+                "warm_compiles": 0, "bound": "memory-bound",
+                "device_ns": 1000, "dispatch_ns": 10}
+    problems = perf.check_query("qx", decisive, base, 0.25)
+    assert problems and "flipped" in problems[0]
+
+
+def test_perfcheck_rejects_update_plus_inflate():
+    """The self-test hook must never be able to pin falsified counts
+    as golden baselines."""
+    with pytest.raises(ValueError, match="self-test"):
+        perf.run_perfcheck(update=True, inflate=2.0)
+
+
+def test_perfcheck_update_roundtrip(tmp_path, monkeypatch):
+    """--perfcheck --update re-pins the registry (with provenance) and
+    a subsequent check against the re-pinned registry is clean — the
+    round-trip, run against canned measurements so it stays fast."""
+    reg_path = tmp_path / "baselines.json"
+    shutil.copy(perf.BASELINES_PATH, reg_path)
+    canned = {"rows": 1, "warm_dispatches": 999, "dispatches_per_batch":
+              9.9, "programs": 999, "warm_compiles": 0,
+              "device_ns": 10, "dispatch_ns": 100,
+              "hbm_bytes_est": 1000, "flops_est": 100,
+              "hbm_util": 0.01, "mfu_est": 0.001,
+              "bound": "dispatch-bound"}
+    monkeypatch.setattr(perf, "measure_query",
+                        lambda *a, **k: dict(canned))
+    rc, _ = perf.run_perfcheck(update=True, registry_path=str(reg_path))
+    assert rc == 0
+    pinned = perf.load_baselines(str(reg_path))
+    assert pinned["queries"]["q1"]["warm_dispatches"] == 999
+    assert pinned["provenance"]["pinned_at"]
+    assert pinned["provenance"]["device_kind"]
+    # the re-pinned registry is immediately clean against the same
+    # measurements...
+    rc, doc = perf.run_perfcheck(registry_path=str(reg_path))
+    assert rc == 0, doc["problems"]
+    # ...and still fires on inflation against the new pins
+    rc, doc = perf.run_perfcheck(registry_path=str(reg_path), inflate=2.0)
+    assert rc == 1 and doc["problems"]
+
+
+# ------------------------------------- 5. estimator cost contract
+
+def test_disarmed_estimator_never_entered(monkeypatch):
+    """spark.blaze.perf.estimates=false keeps the traced dispatch path
+    out of the estimator entirely (poisoned — a single call would
+    raise), exactly the trace.enabled structural-no-op pattern."""
+    import jax
+
+    fn = dispatch.instrument(jax.jit(lambda x: x + 1), "perfgate_t")
+    x = np.arange(512)
+    conf.PERF_ESTIMATES.set(False)
+    perf.reset()
+    try:
+        assert perf.enabled() is False
+
+        def poisoned(*a, **k):  # pragma: no cover — failure path
+            raise AssertionError("estimator entered while disarmed")
+
+        with monkeypatch.context() as m:
+            m.setattr(perf, "_estimate", poisoned)
+            with trace.kernel_capture() as sink:
+                fn(x)
+        assert sum(v.get("bytes_est", 0) for v in sink.values()) == 0
+    finally:
+        conf.PERF_ESTIMATES.set(True)
+        perf.reset()
+    # re-armed: the same call records nonzero estimates
+    with trace.kernel_capture() as sink:
+        fn(x)
+    assert sum(v.get("bytes_est", 0) for v in sink.values()) >= x.nbytes
+    assert sum(v.get("flops_est", 0) for v in sink.values()) >= x.size
+
+
+def test_force_overrides_conf_and_env(monkeypatch):
+    """perf.force(True) must win over BOTH conf and the env override
+    (ConfEntry gives env precedence over .set, so the measurement
+    surfaces that JUDGE estimates cannot force-arm through conf);
+    reset() hands control back."""
+    monkeypatch.setenv("BLAZE_PERF_ESTIMATES", "false")
+    perf.reset()
+    try:
+        assert perf.enabled() is False
+        perf.force(True)
+        assert perf._ARMED is True and perf.enabled() is True
+        perf.reset()
+        assert perf.enabled() is False
+    finally:
+        monkeypatch.delenv("BLAZE_PERF_ESTIMATES")
+        perf.reset()
+
+
+def test_untraced_path_records_no_estimates():
+    """Without a kernel capture the estimator is never consulted at
+    all — the untraced hot path is untouched (counters only)."""
+    import jax
+
+    fn = dispatch.instrument(jax.jit(lambda x: x * 2), "perfgate_u")
+    with dispatch.capture() as cap:
+        fn(np.arange(64))
+    assert cap.get("xla_dispatches") == 1
+    assert cap.get("hbm_bytes_est", 0) == 0
+
+
+def test_chaos_perf_gate_passes():
+    """The --chaos structural gate for the estimator contract."""
+    from blaze_tpu.__main__ import _check_perf_gate
+
+    assert _check_perf_gate() == 0
+
+
+# --------------------------------------------- 6. monitor endpoint
+
+def test_monitor_explain_endpoint(data, tmp_path):
+    conf.MONITOR_ENABLE.set(True)
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    monitor.reset()
+    trace.reset()
+    srv = None
+    try:
+        srv = monitor.MonitorServer(0).start()
+        with monitor.query_span("explain_ep_q6", mode="scheduler"):
+            assert _run_scheduler(data, "q6") > 0
+        # untraced run alongside: explain must answer with a comment,
+        # not a 500
+        conf.TRACE_ENABLE.set(False)
+        trace.reset()
+        with monitor.query_span("explain_ep_untraced"):
+            pass
+        with urllib.request.urlopen(
+                f"{srv.url}/queries/explain_ep_q6/explain", timeout=10) as r:
+            body = r.read().decode()
+        assert "EXPLAIN ANALYZE" in body
+        assert "explain_ep_q6" in body
+        with urllib.request.urlopen(
+                f"{srv.url}/queries/explain_ep_untraced/explain",
+                timeout=10) as r:
+            body = r.read().decode()
+        assert body.startswith("#") and "tracing" in body
+        # the endpoint is discoverable + the registry carries the log
+        with urllib.request.urlopen(f"{srv.url}/healthz", timeout=10) as r:
+            hz = json.load(r)
+        assert "/queries/<id>/explain" in hz["endpoints"]
+        with urllib.request.urlopen(f"{srv.url}/queries", timeout=10) as r:
+            snap = json.load(r)
+        entry = next(q for q in snap["queries"]
+                     if q["query_id"] == "explain_ep_q6")
+        assert entry["eventlog"]
+        # roofline gauges exported for the traced query
+        with urllib.request.urlopen(f"{srv.url}/metrics", timeout=10) as r:
+            metrics = r.read().decode()
+        assert 'blaze_query_hbm_util{query="explain_ep_q6"}' in metrics
+        assert 'blaze_query_bound{query="explain_ep_q6"' in metrics
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        conf.MONITOR_ENABLE.set(False)
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        monitor.reset()
+        trace.reset()
+
+
+def test_monitor_explain_404_on_unknown(data):
+    conf.MONITOR_ENABLE.set(True)
+    monitor.reset()
+    srv = None
+    try:
+        srv = monitor.MonitorServer(0).start()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{srv.url}/queries/no_such_query/explain", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        conf.MONITOR_ENABLE.set(False)
+        monitor.reset()
+
+
+# ------------------------------- 7. terminal-status report rendering
+
+def _terminal_events(data, tmp_path, exc, query_id):
+    """A REAL partial event log: stage 0 completes, then the query
+    dies with ``exc`` — the shape a cancelled/failed/deadline-exceeded
+    chaos run leaves behind."""
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path))
+    trace.reset()
+    try:
+        with pytest.raises(type(exc)):
+            with trace.query(query_id) as path:
+                _run_scheduler(data, "q6")  # real stage/task events
+                raise exc
+        return trace.read_events(path)
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+
+
+@pytest.mark.parametrize("exc,status", [
+    (QueryCancelledError("t", reason="cancel"), "cancelled"),
+    (QueryDeadlineError("t", timeout_ms=5), "deadline_exceeded"),
+    (RuntimeError("boom"), "failed"),
+])
+def test_report_renders_terminal_statuses(data, tmp_path, exc, status):
+    """--report over a query that did NOT end done: explicit status
+    banner, no KeyError, JSON terminal_status populated (regression:
+    the renderer was only ever exercised on done runs)."""
+    events = _terminal_events(data, tmp_path, exc,
+                              f"term_{status}")
+    text = trace_report.render(events)
+    assert status.upper() in text
+    assert "partial profile" in text
+    doc = trace_report.render_json(events)
+    assert doc["query"]["terminal_status"] == status
+    json.dumps(doc, default=str)
+    # the explain surface degrades identically
+    edoc = perf.explain_doc(events)
+    assert edoc["status"] == status
+    assert status.upper() in perf.render_explain(events)
+
+
+def test_report_renders_truncated_log(data, tmp_path):
+    """A log with NO terminal event (crash mid-run / live read): both
+    renderers still work and say INCOMPLETE."""
+    events = _terminal_events(data, tmp_path, RuntimeError("x"),
+                              "term_trunc")
+    truncated = [e for e in events if e.get("type") != "query_end"]
+    text = trace_report.render(truncated)
+    assert "INCOMPLETE" in text
+    doc = trace_report.render_json(truncated)
+    assert doc["query"]["terminal_status"] == "incomplete"
+    assert perf.explain_doc(truncated)["status"] == "incomplete"
+
+
+def test_report_json_has_perf_section(q1_events):
+    """--report --json carries the roofline judgment: golden 'perf'
+    top-level key plus per-kernel hbm_util/mfu_est/bound fields."""
+    doc = trace_report.render_json(q1_events)
+    assert "perf" in doc
+    p = doc["perf"]
+    assert {"programs", "hbm_util", "mfu_est", "bound",
+            "hbm_bytes_est", "flops_est", "device_kind"} <= set(p)
+    assert p["programs"] > 0
+    assert p["hbm_bytes_est"] > 0
+    for v in doc["kernels"].values():
+        assert {"bytes_est", "flops_est", "hbm_util", "bound"} <= set(v)
+    # the text rendering carries the same judgment
+    assert "perf:" in trace_report.render(q1_events)
