@@ -16,6 +16,7 @@ Usage:
     python -m blaze_tpu --warmup            # compile-cache pre-warm + gate
     python -m blaze_tpu --lint              # static analysis; nonzero on finding
     python -m blaze_tpu --lint --json -     # + machine-readable findings
+    python -m blaze_tpu --lint --sarif -    # + SARIF 2.1.0 for code-scanning
     python -m blaze_tpu tpch q1 --explain   # EXPLAIN ANALYZE (runtime/perf.py)
     python -m blaze_tpu --perfcheck         # perf-baseline gate; nonzero on drift
     python -m blaze_tpu --perfcheck --update  # re-pin baselines with provenance
@@ -462,7 +463,7 @@ def _check_perf_gate() -> int:
     return 0
 
 
-def _run_lint(json_path: str = "") -> int:
+def _run_lint(json_path: str = "", sarif_path: str = "") -> int:
     """``--lint``: run every static-analysis pass (analysis/) and exit
     nonzero on any unwaived finding.
 
@@ -480,7 +481,14 @@ def _run_lint(json_path: str = "") -> int:
     document — rule id, path, line, symbol, message, waived flag, plus
     a summary block — with golden-pinned keys like ``--report --json``,
     so CI and the chaos sweep can diff lint runs mechanically (waived
-    findings are reported and marked but never affect the exit code)."""
+    findings are reported and marked but never affect the exit code).
+
+    ``--sarif <path|->`` writes the same findings as one SARIF 2.1.0
+    document (golden-pinned keys, ``lint.SARIF_*``) so GitHub
+    code-scanning — or any SARIF viewer — annotates them inline on the
+    diff; waived findings ride as level ``note`` with an ``inSource``
+    suppression carrying the pinned justification.  ``-`` keeps stdout
+    pure SARIF exactly like ``--json -``."""
     from . import conf
     from .analysis import lint as lint_mod
     from .analysis.plan_verify import verify_plan
@@ -527,6 +535,19 @@ def _run_lint(json_path: str = "") -> int:
     status_line = (f"# lint: {status} — AST rules + conf registry + "
                    f"{n_plans} verified plans (fused+unfused), "
                    f"{len(lint_mod.load_waivers())} pinned waiver(s)")
+    stream_stdout = "-" in (json_path, sarif_path)
+    if sarif_path:
+        import json as _json
+
+        sarif = lint_mod.sarif_doc(pairs)
+        if sarif_path == "-":
+            # stdout is the PARSEABLE SARIF document and nothing else
+            print(_json.dumps(sarif, indent=2))
+        else:
+            with open(sarif_path, "w") as f:
+                _json.dump(sarif, f, indent=2)
+            print(f"# sarif findings: {sarif_path}",
+                  file=sys.stderr if stream_stdout else sys.stdout)
     if json_path:
         import json as _json
 
@@ -536,12 +557,12 @@ def _run_lint(json_path: str = "") -> int:
             # contract as --report --json -): the status line moves to
             # stderr so `--lint --json - | jq` works as advertised
             print(_json.dumps(doc, indent=2))
-            print(status_line, file=sys.stderr)
-            return 1 if findings else 0
-        with open(json_path, "w") as f:
-            _json.dump(doc, f, indent=2)
-        print(f"# json findings: {json_path}")
-    print(status_line)
+        else:
+            with open(json_path, "w") as f:
+                _json.dump(doc, f, indent=2)
+            print(f"# json findings: {json_path}",
+                  file=sys.stderr if stream_stdout else sys.stdout)
+    print(status_line, file=sys.stderr if stream_stdout else sys.stdout)
     return 1 if findings else 0
 
 
@@ -576,7 +597,7 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
 
     from . import conf
     from .analysis import locks as lock_verify
-    from .runtime import lockset, monitor, otel
+    from .runtime import errors, ledger, lockset, monitor, otel
 
     # ``loaded`` = a (build_query, names, scans) the sweep resolved
     # once up front — datagen does not depend on the seed, so N seeds
@@ -592,6 +613,15 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
     lock_verify.refresh()
     conf.VERIFY_LOCKSET.set(True)
     lockset.refresh()
+    # the error-escape recorder + per-query resource ledger arm for
+    # the whole smoke (one knob: spark.blaze.verify.errors) — a
+    # FATAL-class error absorbed at an audited broad-except site, or a
+    # spill/.inprogress/scoped/lease resource still live at query end,
+    # fails the run via the same record-then-raise gates as
+    # lockset.reported()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
     # telemetry arms for the whole smoke: OTLP export to a scratch dir
     # (endpoint at a dead port so the pusher spins up, fails fast, and
     # must still shut down leak-free) + the monitor REGISTRY (no
@@ -633,6 +663,9 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
         lock_verify.refresh()
         conf.VERIFY_LOCKSET.set(False)
         lockset.refresh()
+        conf.VERIFY_ERRORS.set(False)
+        errors.refresh()
+        ledger.refresh()
         if speculate:
             # restore EVERY knob the smoke touched, symmetrically —
             # a later in-process run must not inherit the smoke's
@@ -650,8 +683,11 @@ def _run_chaos(suite: str, names, scale: float, n_parts: int, seed: int,
 
 def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
                 n_faults, speculate=False, inject_oom=False) -> int:
+    import glob
+
     from . import conf
-    from .runtime import faults, lockset, monitor, scheduler, trace, trace_report
+    from .runtime import (errors, faults, ledger, lockset, monitor,
+                          scheduler, trace, trace_report)
 
     failed = []
     for i, name in enumerate(names):
@@ -672,8 +708,15 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
         # per-query lockset window: the checked-access tally and the
         # reported-violation list judge THIS chaotic run, not the
         # sweep so far (a later query's armed-but-never-exercised
-        # checker must be visible as lockset_checked=0)
+        # checker must be visible as lockset_checked=0).  The escape
+        # record and the resource ledger reset on the same cadence.
         lockset.reset()
+        errors.reset()
+        ledger.reset()
+        # filesystem half of the leak oracle judges only THIS run: a
+        # stale blaze_spill_* file from an earlier crashed process (or
+        # a concurrent suite on the same tempdir) is not our leak
+        spills_before = set(glob.glob(ledger.spill_glob()))
         prev_trace = bool(conf.TRACE_ENABLE.get())
         conf.TRACE_ENABLE.set(True)
         trace.reset()
@@ -695,10 +738,16 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
         m = scheduler.LAST_RUN_METRICS.metrics if scheduler.LAST_RUN_METRICS else None
         # mirror the lockset checker's access tally into the run's
         # counters: a chaos line showing 0 checked accesses means the
-        # checker was armed but never exercised — visibly useless
+        # checker was armed but never exercised — visibly useless.
+        # The error-escape and ledger tallies mirror the same way.
         checked = lockset.counters()["checked_accesses"]
+        esc = errors.counters()
+        led = ledger.counters()
         if m is not None:
             m.set("lockset_checked_accesses", checked)
+            m.set("error_escapes_recorded", esc["recorded_escapes"])
+            m.set("ledger_tracked_resources", led["acquired"])
+            m.set("ledger_leaked_resources", led["leaks"])
         counters = (
             f"attempts={m.get('task_attempts')} retries={m.get('task_retries')} "
             f"fetch_failures={m.get('fetch_failures')} "
@@ -711,7 +760,9 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
             f"/{m.get('eager_fallbacks')} "
             f"dispatches={m.get('xla_dispatches')} "
             f"compiles={m.get('xla_compiles')} "
-            f"lockset_checked={checked}" if m else "no metrics"
+            f"lockset_checked={checked} "
+            f"ledger={led['acquired']}/{led['released']}" if m
+            else "no metrics"
         )
         # event-log reconciliation: every fault that FIRED must pair
         # with a recovery event recorded after it, and every
@@ -725,14 +776,24 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
                  + f"; {spc['speculated']} speculated "
                  f"({spc['won']} won / {spc['lost']} lost) "
                  + ("reconciled" if spc["reconciled"] else "UNRECONCILED"))
-        leaked = [t for t in _live_attempt_threads()]
+        # ONE leak oracle (runtime/ledger.py) for attempt threads +
+        # recorded resource leaks + this run's spill files, replacing
+        # the hand-rolled sweeps
+        leak_problems = ledger.leak_audit(spills_before=spills_before)
         # a LocksetViolation may have been swallowed en route (monitor
         # handler 500s, operator blanket-excepts) — the recorded list
-        # fails the run regardless of where the raise died
+        # fails the run regardless of where the raise died.  Same
+        # contract for a FATAL-class error absorbed at an audited
+        # broad-except site (errors.escapes()).
         races = lockset.reported()
+        escaped = errors.escapes()
         if races:
             print(f"chaos {name}: LOCKSET VIOLATION under spec '{spec}': "
                   + "; ".join(races), file=sys.stderr)
+            failed.append(name)
+        elif escaped:
+            print(f"chaos {name}: FATAL-CLASS ERROR ESCAPE under spec "
+                  f"'{spec}': " + "; ".join(escaped), file=sys.stderr)
             failed.append(name)
         elif chaotic != baseline:
             print(f"chaos {name}: MISMATCH under spec '{spec}' ({counters}; "
@@ -750,9 +811,9 @@ def _chaos_loop(suite, names, scans, build_query, n_parts, seed,
                   f"won/lost resolution ({counters}; {recon}; "
                   f"log: {log_path})", file=sys.stderr)
             failed.append(name)
-        elif leaked:
-            print(f"chaos {name}: ATTEMPT THREAD LEAK under spec '{spec}': "
-                  + ", ".join(t.name for t in leaked), file=sys.stderr)
+        elif leak_problems:
+            print(f"chaos {name}: RESOURCE LEAK under spec '{spec}': "
+                  + "; ".join(leak_problems), file=sys.stderr)
             failed.append(name)
         else:
             print(f"chaos {name}: OK {len(baseline)} rows identical under "
@@ -831,124 +892,133 @@ def _run_cancel_storm(suite, names, scans, build_query, n_parts,
     no ``blaze-attempt-*`` thread, no ``.inprogress`` shuffle temp, no
     ``blaze_spill_*`` file."""
     import glob
-    import os
     import random
-    import tempfile
     import threading
 
     from . import conf
     from .runtime import trace, trace_report
-    from .runtime import monitor
+    from .runtime import ledger, monitor
     from .runtime.context import QueryCancelledError, cancel_query
 
     from .runtime import faults
 
+    from .runtime import errors
+
     rng = random.Random(seed * 7919 + 13)
     rc = 0
-    for name in names:
-        qid = f"storm_{suite}_{name}_{seed}"
-        prev_trace = bool(conf.TRACE_ENABLE.get())
-        conf.TRACE_ENABLE.set(True)
-        trace.reset()
-        # seed deterministic stragglers so the query is reliably still
-        # in flight when the cancel fires — a warm q6 otherwise
-        # finishes before any humanly-chosen delay (a vacuous storm)
-        slow = rng.randrange(300, 700)
-        conf.FAULTS_SPEC.set(
-            f"task.compute@1@slow{slow},task.compute@3@slow{slow}")
-        faults.reset()
-        spill_glob = os.path.join(tempfile.gettempdir(), "blaze_spill_*")
-        spills_before = set(glob.glob(spill_glob))
-        state: dict = {}
-
-        def run():
-            try:
-                with monitor.query_span(qid, mode="scheduler") as lp:
-                    state["log"] = lp
-                    from .runtime.scheduler import run_stages, split_stages
-
-                    stages, mgr = split_stages(
-                        build_query(name, scans, n_parts))
-                    state["root"] = mgr.root
-                    rows = 0
-                    for b in run_stages(stages, mgr):
-                        rows += b.num_rows
-                    state["rows"] = rows
-            except BaseException as e:  # noqa: BLE001 — judged below
-                state["exc"] = e
-
-        t = threading.Thread(target=run, name="blaze-storm-query",
-                             daemon=True)
-        problems = []
-        try:
-            t.start()
-            time.sleep(rng.uniform(0.02, 0.25))
-            accepted = False
-            for _ in range(400):
-                if cancel_query(qid):
-                    accepted = True
-                    break
-                if not t.is_alive():
-                    break
-                time.sleep(0.005)
-            t.join(60)
-            if t.is_alive():
-                problems.append("query thread did not exit after the cancel")
-            exc = state.get("exc")
-            if exc is not None and not isinstance(exc, QueryCancelledError):
-                problems.append(
-                    f"wrong terminal error {type(exc).__name__}: {exc}")
-            if exc is None and "rows" not in state:
-                problems.append("query neither produced rows nor raised")
-            events = trace.read_event_log(state["log"]) \
-                if state.get("log") else []
-            cxl = trace_report.reconcile_cancellation(events)
-            if not cxl["reconciled"]:
-                problems.append(
-                    f"{len(cxl['unpaired'])} cancel request(s) without a "
-                    f"terminal query_cancelled event")
-            if isinstance(exc, QueryCancelledError) \
-                    and cxl["cancelled"] == 0:
-                problems.append(
-                    "cancelled query left no query_cancelled event")
-            if accepted and cxl["requested"] == 0:
-                # the scope took the cancel: even a query that finished
-                # before noticing must leave the request on the record
-                problems.append("accepted cancel left no "
-                                "query_cancel_requested event")
-            leaked = _live_attempt_threads()
-            if leaked:
-                problems.append("leaked attempt threads: "
-                                + ", ".join(x.name for x in leaked))
-            root = state.get("root")
-            if root and os.path.isdir(root):
-                orphans = [f for f in os.listdir(root)
-                           if ".inprogress" in f]
-                if orphans:
-                    problems.append(f"orphaned shuffle temps: {orphans[:4]}")
-            leaked_spills = sorted(
-                set(glob.glob(spill_glob)) - spills_before)
-            if leaked_spills:
-                problems.append(f"leaked spill files: {leaked_spills[:4]}")
-        finally:
-            # restore EVEN when a check raises: a leaked straggler
-            # schedule or forced-on tracing would poison every later
-            # arm with misleading cascade failures
-            conf.FAULTS_SPEC.set("")
-            faults.reset()
-            conf.TRACE_ENABLE.set(prev_trace)
+    # the escape recorder + resource ledger judge every storm arm too
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
+    try:
+        for name in names:
+            qid = f"storm_{suite}_{name}_{seed}"
+            prev_trace = bool(conf.TRACE_ENABLE.get())
+            conf.TRACE_ENABLE.set(True)
             trace.reset()
-        if problems:
-            print(f"cancel-storm {name} (seed {seed}): "
-                  + "; ".join(problems), file=sys.stderr)
-            rc = 1
-        else:
-            outcome = ("cancelled mid-flight"
-                       if isinstance(exc, QueryCancelledError)
-                       else "finished before the cancel landed")
-            print(f"cancel-storm {name} (seed {seed}): OK ({outcome}; "
-                  f"{cxl['requested']} requested / {cxl['cancelled']} "
-                  f"terminal)")
+            errors.reset()
+            ledger.reset()
+            # seed deterministic stragglers so the query is reliably still
+            # in flight when the cancel fires — a warm q6 otherwise
+            # finishes before any humanly-chosen delay (a vacuous storm)
+            slow = rng.randrange(300, 700)
+            conf.FAULTS_SPEC.set(
+                f"task.compute@1@slow{slow},task.compute@3@slow{slow}")
+            faults.reset()
+            spills_before = set(glob.glob(ledger.spill_glob()))
+            state: dict = {}
+
+            def run():
+                try:
+                    with monitor.query_span(qid, mode="scheduler") as lp:
+                        state["log"] = lp
+                        from .runtime.scheduler import run_stages, split_stages
+
+                        stages, mgr = split_stages(
+                            build_query(name, scans, n_parts))
+                        state["root"] = mgr.root
+                        rows = 0
+                        for b in run_stages(stages, mgr):
+                            rows += b.num_rows
+                        state["rows"] = rows
+                except BaseException as e:  # noqa: BLE001 — judged below
+                    state["exc"] = e
+
+            t = threading.Thread(target=run, name="blaze-storm-query",
+                                 daemon=True)
+            problems = []
+            try:
+                t.start()
+                time.sleep(rng.uniform(0.02, 0.25))
+                accepted = False
+                for _ in range(400):
+                    if cancel_query(qid):
+                        accepted = True
+                        break
+                    if not t.is_alive():
+                        break
+                    time.sleep(0.005)
+                t.join(60)
+                if t.is_alive():
+                    problems.append("query thread did not exit after the cancel")
+                exc = state.get("exc")
+                if exc is not None and not isinstance(exc, QueryCancelledError):
+                    problems.append(
+                        f"wrong terminal error {type(exc).__name__}: {exc}")
+                if exc is None and "rows" not in state:
+                    problems.append("query neither produced rows nor raised")
+                events = trace.read_event_log(state["log"]) \
+                    if state.get("log") else []
+                cxl = trace_report.reconcile_cancellation(events)
+                if not cxl["reconciled"]:
+                    problems.append(
+                        f"{len(cxl['unpaired'])} cancel request(s) without a "
+                        f"terminal query_cancelled event")
+                if isinstance(exc, QueryCancelledError) \
+                        and cxl["cancelled"] == 0:
+                    problems.append(
+                        "cancelled query left no query_cancelled event")
+                if accepted and cxl["requested"] == 0:
+                    # the scope took the cancel: even a query that finished
+                    # before noticing must leave the request on the record
+                    problems.append("accepted cancel left no "
+                                    "query_cancel_requested event")
+                # the ONE leak oracle (runtime/ledger.py): attempt
+                # threads + ledger record + spill/.inprogress filesystem
+                # sweeps, shared with --chaos, the other storm arms, and
+                # tests/test_lifecycle.py
+                problems += ledger.leak_audit(shuffle_root=state.get("root"),
+                                              spills_before=spills_before)
+                escaped = errors.escapes()
+                if escaped:
+                    problems.append("FATAL-class error escape(s): "
+                                    + "; ".join(escaped))
+            finally:
+                # restore EVEN when a check raises: a leaked straggler
+                # schedule or forced-on tracing would poison every later
+                # arm with misleading cascade failures
+                conf.FAULTS_SPEC.set("")
+                faults.reset()
+                conf.TRACE_ENABLE.set(prev_trace)
+                trace.reset()
+            if problems:
+                print(f"cancel-storm {name} (seed {seed}): "
+                      + "; ".join(problems), file=sys.stderr)
+                rc = 1
+            else:
+                outcome = ("cancelled mid-flight"
+                           if isinstance(exc, QueryCancelledError)
+                           else "finished before the cancel landed")
+                print(f"cancel-storm {name} (seed {seed}): OK ({outcome}; "
+                      f"{cxl['requested']} requested / {cxl['cancelled']} "
+                      f"terminal)")
+    finally:
+        # disarm even when a check raises (the knob-leak
+        # class): a later in-process run must not inherit
+        # an armed recorder full of this storm's record
+        conf.VERIFY_ERRORS.set(False)
+        errors.refresh()
+        ledger.refresh()
     return rc
 
 
@@ -1059,7 +1129,7 @@ def _run_admission_storm(suite, names, scans, build_query, n_parts,
 
     from . import conf
     from .analysis import locks as lock_verify
-    from .runtime import faults, lockset, monitor, service
+    from .runtime import errors, faults, ledger, lockset, monitor, service
     from .runtime.context import QueryCancelledError, cancel_query
 
     rng = random.Random(seed * 104729 + 7)
@@ -1075,141 +1145,149 @@ def _run_admission_storm(suite, names, scans, build_query, n_parts,
     conf.VERIFY_LOCKSET.set(True)
     lockset.refresh()
     lockset.reset()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
     problems = []
     svc = None
-    spill_glob = os.path.join(tempfile.gettempdir(), "blaze_spill_*")
     shuffle_glob = os.path.join(tempfile.gettempdir(), "blaze_shuffle_*")
-    spills_before = set(glob.glob(spill_glob))
+    spills_before = set(glob.glob(ledger.spill_glob()))
     roots_before = set(glob.glob(shuffle_glob))
     n_subs = 8
     n_rejected = 0
     cancelled_id = None
     try:
-        baseline = _rows_via_scheduler(build_query(name, scans, n_parts))
-        conf.SERVICE_MAX_CONCURRENT.set(2)
-        conf.SERVICE_MAX_QUEUED.set(2)
-        conf.SERVICE_QUEUE_TIMEOUT_MS.set(0)
-        conf.MONITOR_ENABLE.set(True)
-        conf.set_conf("spark.blaze.service.pool.storm_a.weight", 3.0)
-        conf.set_conf("spark.blaze.service.pool.storm_b.weight", 1.0)
-        monitor.reset()
-        slow = rng.randrange(120, 350)
-        conf.FAULTS_SPEC.set(
-            f"task.compute@2@slow{slow},task.compute@6@slow{slow}")
-        faults.reset()
-        svc = service.QueryService().start()
-        outcomes = [None] * n_subs          # "rejected" | handle
-        accepted = []
-        accepted_lock = threading.Lock()
+        try:
+            baseline = _rows_via_scheduler(build_query(name, scans, n_parts))
+            conf.SERVICE_MAX_CONCURRENT.set(2)
+            conf.SERVICE_MAX_QUEUED.set(2)
+            conf.SERVICE_QUEUE_TIMEOUT_MS.set(0)
+            conf.MONITOR_ENABLE.set(True)
+            conf.set_conf("spark.blaze.service.pool.storm_a.weight", 3.0)
+            conf.set_conf("spark.blaze.service.pool.storm_b.weight", 1.0)
+            monitor.reset()
+            slow = rng.randrange(120, 350)
+            conf.FAULTS_SPEC.set(
+                f"task.compute@2@slow{slow},task.compute@6@slow{slow}")
+            faults.reset()
+            svc = service.QueryService().start()
+            outcomes = [None] * n_subs          # "rejected" | handle
+            accepted = []
+            accepted_lock = threading.Lock()
 
-        def submitter(i: int) -> None:
-            pool = "storm_a" if i % 2 == 0 else "storm_b"
-            try:
-                h = svc.submit(f"storm{i}", pool=pool, session=f"s{i % 4}",
-                               build=lambda: build_query(name, scans,
-                                                         n_parts))
-            except service.QueryRejectedError:
-                outcomes[i] = "rejected"
-                return
-            outcomes[i] = h
+            def submitter(i: int) -> None:
+                pool = "storm_a" if i % 2 == 0 else "storm_b"
+                try:
+                    h = svc.submit(f"storm{i}", pool=pool, session=f"s{i % 4}",
+                                   build=lambda: build_query(name, scans,
+                                                             n_parts))
+                except service.QueryRejectedError:
+                    outcomes[i] = "rejected"
+                    return
+                outcomes[i] = h
+                with accepted_lock:
+                    accepted.append(h)
+
+            threads = [threading.Thread(target=submitter, args=(i,),
+                                        name=f"blaze-storm-submit-{i}",
+                                        daemon=True) for i in range(n_subs)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            # one mid-flight cancel at a seeded moment, at whatever stage
+            # frontier the victim has reached
+            time.sleep(rng.uniform(0.01, 0.15))
             with accepted_lock:
-                accepted.append(h)
-
-        threads = [threading.Thread(target=submitter, args=(i,),
-                                    name=f"blaze-storm-submit-{i}",
-                                    daemon=True) for i in range(n_subs)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(10)
-        # one mid-flight cancel at a seeded moment, at whatever stage
-        # frontier the victim has reached
-        time.sleep(rng.uniform(0.01, 0.15))
-        with accepted_lock:
-            victims = list(accepted)
-        cancelled_id = None
-        if victims:
-            victim = victims[rng.randrange(len(victims))]
-            if cancel_query(victim.exec_id):
-                cancelled_id = victim.exec_id
-        # drain EVERY accepted handle: terminal or bust (the no-hang
-        # contract; 120s is far past any straggler schedule)
-        for h in victims:
-            rows = None
-            try:
-                rows = sum(b.num_rows for b in h.result(timeout=120))
-            except QueryCancelledError:
-                pass
-            except service.QueryRejectedError:
-                pass
-            except Exception as e:  # noqa: BLE001 — judged below
-                problems.append(f"{h.exec_id}: unexpected terminal "
-                                f"{type(e).__name__}: {e}")
-            if h.status not in service.TERMINAL_STATES:
-                problems.append(f"{h.exec_id}: non-terminal status "
-                                f"{h.status!r} after drain")
-            if h.status == "done" and rows != len(baseline):
+                victims = list(accepted)
+            cancelled_id = None
+            if victims:
+                victim = victims[rng.randrange(len(victims))]
+                if cancel_query(victim.exec_id):
+                    cancelled_id = victim.exec_id
+            # drain EVERY accepted handle: terminal or bust (the no-hang
+            # contract; 120s is far past any straggler schedule)
+            for h in victims:
+                rows = None
+                try:
+                    rows = sum(b.num_rows for b in h.result(timeout=120))
+                except QueryCancelledError:
+                    pass
+                except service.QueryRejectedError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — judged below
+                    problems.append(f"{h.exec_id}: unexpected terminal "
+                                    f"{type(e).__name__}: {e}")
+                if h.status not in service.TERMINAL_STATES:
+                    problems.append(f"{h.exec_id}: non-terminal status "
+                                    f"{h.status!r} after drain")
+                if h.status == "done" and rows != len(baseline):
+                    problems.append(
+                        f"{h.exec_id}: {rows} rows != baseline {len(baseline)}")
+            n_rejected = sum(1 for o in outcomes if o == "rejected")
+            if any(o is None for o in outcomes):
+                problems.append("a submitter thread never resolved")
+            if n_rejected == 0:
                 problems.append(
-                    f"{h.exec_id}: {rows} rows != baseline {len(baseline)}")
-        n_rejected = sum(1 for o in outcomes if o == "rejected")
-        if any(o is None for o in outcomes):
-            problems.append("a submitter thread never resolved")
-        if n_rejected == 0:
-            problems.append(
-                "no submission was shed past maxQueued — the storm "
-                "never exercised admission control")
-        if cancelled_id is not None:
-            victim = next(h for h in victims if h.exec_id == cancelled_id)
-            if victim.status not in ("cancelled", "done"):
-                problems.append(
-                    f"cancelled query ended {victim.status!r} (expected "
-                    f"cancelled, or done when it won the race)")
-        # fairness: both pools completed work and neither was starved
-        # of lease time (the tolerance-band fairness assertion lives in
-        # the soak test, where the workload is controlled)
-        shares = svc.gate.shares()
-        for pname in ("storm_a", "storm_b"):
-            p = shares.get(pname)
-            if any(h.pool == pname and h.status == "done" for h in victims) \
-                    and (p is None or p["charged_ns"] <= 0):
-                problems.append(f"pool {pname} completed queries but was "
-                                f"never granted lease time")
-        races = lockset.reported()
-        if races:
-            problems.append("lockset violation(s): " + "; ".join(races))
-    except Exception as e:  # noqa: BLE001 — the arm must report, not die
-        problems.append(f"storm arm crashed: {type(e).__name__}: {e}")
+                    "no submission was shed past maxQueued — the storm "
+                    "never exercised admission control")
+            if cancelled_id is not None:
+                victim = next(h for h in victims if h.exec_id == cancelled_id)
+                if victim.status not in ("cancelled", "done"):
+                    problems.append(
+                        f"cancelled query ended {victim.status!r} (expected "
+                        f"cancelled, or done when it won the race)")
+            # fairness: both pools completed work and neither was starved
+            # of lease time (the tolerance-band fairness assertion lives in
+            # the soak test, where the workload is controlled)
+            shares = svc.gate.shares()
+            for pname in ("storm_a", "storm_b"):
+                p = shares.get(pname)
+                if any(h.pool == pname and h.status == "done" for h in victims) \
+                        and (p is None or p["charged_ns"] <= 0):
+                    problems.append(f"pool {pname} completed queries but was "
+                                    f"never granted lease time")
+            races = lockset.reported()
+            if races:
+                problems.append("lockset violation(s): " + "; ".join(races))
+            escaped = errors.escapes()
+            if escaped:
+                problems.append("FATAL-class error escape(s): "
+                                + "; ".join(escaped))
+        except Exception as e:  # noqa: BLE001 — the arm must report, not die
+            problems.append(f"storm arm crashed: {type(e).__name__}: {e}")
+        finally:
+            if svc is not None:
+                svc.shutdown()
+            conf.FAULTS_SPEC.set("")
+            faults.reset()
+            for k, v in zip(knobs, prev):
+                k.set(v)
+            # the storm pool weights too (a stored None reads back as the
+            # defaults through the `or` guards) — the knob-leak class an
+            # earlier review round fixed in _run_chaos
+            for k, v in zip(pool_keys, prev_pools):
+                conf.set_conf(k, v)
+            monitor.reset()
+            conf.VERIFY_LOCKS.set(False)
+            lock_verify.refresh()
+            conf.VERIFY_LOCKSET.set(False)
+            lockset.refresh()
+        leaked = [t.name for t in service.service_threads()]
+        if leaked:
+            problems.append("leaked threads: " + ", ".join(leaked))
+        # the ONE leak oracle: attempt threads + ledger record + spill and
+        # .inprogress filesystem sweeps across every root the burst made
+        problems += ledger.leak_audit(
+            shuffle_root=sorted(set(glob.glob(shuffle_glob)) - roots_before),
+            spills_before=spills_before)
     finally:
-        if svc is not None:
-            svc.shutdown()
-        conf.FAULTS_SPEC.set("")
-        faults.reset()
-        for k, v in zip(knobs, prev):
-            k.set(v)
-        # the storm pool weights too (a stored None reads back as the
-        # defaults through the `or` guards) — the knob-leak class an
-        # earlier review round fixed in _run_chaos
-        for k, v in zip(pool_keys, prev_pools):
-            conf.set_conf(k, v)
-        monitor.reset()
-        conf.VERIFY_LOCKS.set(False)
-        lock_verify.refresh()
-        conf.VERIFY_LOCKSET.set(False)
-        lockset.refresh()
-    leaked = [t.name for t in service.service_threads()] \
-        + [t.name for t in _live_attempt_threads()]
-    if leaked:
-        problems.append("leaked threads: " + ", ".join(leaked))
-    leaked_spills = sorted(set(glob.glob(spill_glob)) - spills_before)
-    if leaked_spills:
-        problems.append(f"leaked spill files: {leaked_spills[:4]}")
-    orphans = []
-    for root in sorted(set(glob.glob(shuffle_glob)) - roots_before):
-        if os.path.isdir(root):
-            orphans += [os.path.join(root, f) for f in os.listdir(root)
-                        if ".inprogress" in f]
-    if orphans:
-        problems.append(f"orphaned shuffle temps: {orphans[:4]}")
+        # disarm even when shutdown/restore or the audit raises
+        # (the knob-leak class): a later in-process run must not
+        # inherit an armed recorder full of this storm's record
+        conf.VERIFY_ERRORS.set(False)
+        errors.refresh()
+        ledger.refresh()
     if problems:
         print(f"admission-storm {name} (seed {seed}): "
               + "; ".join(problems), file=sys.stderr)
@@ -1241,7 +1319,7 @@ def _run_corruption_storm(suite, names, scans, build_query, n_parts,
 
     from . import conf
     from .analysis import locks as lock_verify
-    from .runtime import faults, integrity, lockset, monitor
+    from .runtime import errors, faults, integrity, ledger, lockset, monitor
     from .runtime import scheduler, trace, trace_report
 
     import blaze_tpu.parallel.shuffle as sh
@@ -1256,9 +1334,13 @@ def _run_corruption_storm(suite, names, scans, build_query, n_parts,
     conf.VERIFY_LOCKSET.set(True)
     lockset.refresh()
     lockset.reset()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
     integrity.reset()
     problems = []
     root = None
+    spills_before = set(glob.glob(ledger.spill_glob()))
     # force a shuffle spill per staged batch: at smoke scale the
     # shuffle moves only aggregated partials (bytes), so the memmgr
     # watermark would never trip and the spill.write corruption site
@@ -1349,22 +1431,16 @@ def _run_corruption_storm(suite, names, scans, build_query, n_parts,
         races = lockset.reported()
         if races:
             problems.append("lockset violation(s): " + "; ".join(races))
-        leaked = _live_attempt_threads()
-        if leaked:
-            problems.append("leaked attempt threads: "
-                            + ", ".join(t.name for t in leaked))
-        if root and os.path.isdir(root):
-            temps = [f for f in os.listdir(root) if ".inprogress" in f]
-            if temps:
-                problems.append(f"orphaned shuffle temps: {temps[:4]}")
-            quarantined = [f for f in os.listdir(root)
-                           if f.endswith(".corrupt")]
-            n_q = 0 if m is None else m.get("blocks_quarantined")
-            if len(quarantined) != n_q:
-                problems.append(
-                    f"{len(quarantined)} .corrupt file(s) on disk but "
-                    f"blocks_quarantined={n_q} — a quarantine happened "
-                    f"off the record (or a counter lied)")
+        escaped = errors.escapes()
+        if escaped:
+            problems.append("FATAL-class error escape(s): "
+                            + "; ".join(escaped))
+        # the ONE leak oracle (threads + ledger + filesystem sweeps)
+        # with the .corrupt-quarantine accounting folded in
+        problems += ledger.leak_audit(
+            shuffle_root=root, spills_before=spills_before,
+            corrupt_expected=(0 if m is None
+                              else m.get("blocks_quarantined")))
     except Exception as e:  # noqa: BLE001 — the arm must report, not die
         problems.append(f"storm arm crashed: {type(e).__name__}: {e}")
     finally:
@@ -1380,6 +1456,9 @@ def _run_corruption_storm(suite, names, scans, build_query, n_parts,
         lock_verify.refresh()
         conf.VERIFY_LOCKSET.set(False)
         lockset.refresh()
+        conf.VERIFY_ERRORS.set(False)
+        errors.refresh()
+        ledger.refresh()
     if problems:
         print(f"corruption-storm {name} (seed {seed}): "
               + "; ".join(problems), file=sys.stderr)
@@ -1392,12 +1471,13 @@ def _run_corruption_storm(suite, names, scans, build_query, n_parts,
 
 
 def _live_attempt_threads():
-    """Attempt-runner threads still alive after a run — the speculation
-    leak gate (a cancelled loser must exit cooperatively)."""
-    import threading
+    """Attempt-runner threads still alive after a run — kept as a thin
+    alias of the shared leak oracle's thread check
+    (``ledger.attempt_threads``) for external callers; the chaos arms
+    now go through :func:`ledger.leak_audit` directly."""
+    from .runtime import ledger
 
-    return [t for t in threading.enumerate()
-            if t.name.startswith("blaze-attempt-") and t.is_alive()]
+    return ledger.attempt_threads()
 
 
 def _serve_forever() -> int:
@@ -1614,6 +1694,12 @@ def main(argv=None) -> int:
                          "with --lint: write the findings as one JSON "
                          "document (rule id, path, line, symbol, waived "
                          "flag + summary) so CI can diff lint runs")
+    ap.add_argument("--sarif", default="", metavar="PATH",
+                    help="with --lint: also write the findings as one "
+                         "SARIF 2.1.0 document ('-' = stdout, pure like "
+                         "--json -) for GitHub code-scanning / any SARIF "
+                         "viewer — waived findings ride as suppressed "
+                         "notes with their pinned justifications")
     ap.add_argument("--service", action="store_true",
                     help="run the multi-tenant query service "
                          "(runtime/service.py: admission control, "
@@ -1657,6 +1743,11 @@ def main(argv=None) -> int:
         ap.error("--json requires --report (profile as JSON), --lint "
                  "(findings as JSON), --explain (explain document), or "
                  "--perfcheck (measurement document)")
+    if args.sarif and not args.lint:
+        ap.error("--sarif requires --lint (findings as SARIF)")
+    if args.sarif == "-" and args.json == "-":
+        ap.error("--sarif - and --json - both claim stdout; write at "
+                 "least one to a file")
     if args.update and not args.perfcheck:
         ap.error("--update requires --perfcheck (re-pin the baseline "
                  "registry)")
@@ -1667,7 +1758,7 @@ def main(argv=None) -> int:
     if args.chaos_seeds:
         args.chaos = True
     if args.lint:
-        return _run_lint(args.json)
+        return _run_lint(args.json, args.sarif)
     if args.perfcheck:
         return _run_perfcheck(args.update, args.perfcheck_inflate,
                               args.json)

@@ -1,0 +1,742 @@
+"""Exception-flow & resource-lifecycle static analysis.
+
+The defect class that dominated the PR 9/13 review rounds — a
+cancelled loser overwriting a winner's committed shuffle file, spill
+files leaked on non-commit exits, a ``LocksetViolation`` swallowed by a
+blanket ``except`` en route to the chaos gate — as mechanical AST
+rules, run by ``python -m blaze_tpu --lint`` next to the PR 6/8 passes
+(ids are stable API; the waiver file and tests key on them):
+
+- ``error.untyped`` — typed-error registry drift, gated two ways
+  against ``runtime/error_names.json``: every exception class the
+  package DEFINES must be registered (with its ``retry.classify``
+  disposition), and raise sites on data-plane paths (``runtime/``,
+  ``parallel/``, ``ops/``, ``io/``) must not raise the untyped
+  catch-all spellings (``Exception``/``BaseException``/bare
+  ``RuntimeError``) — an untyped error is invisible to the recovery
+  ladder and to catch sites that key on class.
+- ``error.stale`` — the reverse direction: a registry entry whose
+  class no longer exists in source (or moved modules, or carries a
+  malformed disposition).
+- ``except.swallow`` — an over-broad handler (``except Exception`` /
+  ``BaseException`` / bare, or a superclass catch like
+  ``RuntimeError``/``AssertionError``/``ValueError``) that can absorb
+  a FATAL-class CONTROL-FLOW error — ``QueryCancelledError``,
+  ``QueryDeadlineError``, ``LocksetViolation``, ``LockOrderError``,
+  ``BlockCorruptionError`` — without re-raising, routing through
+  ``retry.classify``, or registering the absorption with the runtime
+  audit (``errors.absorbed``).  Routing through up to three helper
+  hops is recognized (the PR 6 emit-under-lock widening budget);
+  an earlier, targeted handler of the same ``try`` that intercepts a
+  fatal class removes it from what the broad arm can absorb.
+- ``resource.path-leak`` — the interprocedural extension of PR 8's
+  ``guard.lifecycle``: the declared acquire/release pairs
+  (:data:`RESOURCE_PAIRS` — spill units, attempt-staged resources,
+  memmgr registrations, the async stager, heartbeat TLS, device-lease
+  turns) must reach a release/commit/abort on every exception exit
+  edge — in the acquiring function itself (a ``finally`` block,
+  exception handler, or ``with``-statement), or in a caller within
+  three reverse hops (ownership transfer: ``try_new_spill`` returns
+  the spill; the consumer's handler releases it).
+- ``commit.guard`` — every commit-by-rename site (an ``os.replace`` /
+  ``os.rename`` in a function that stages ``.inprogress`` temps) must
+  be reachable from a cancellation-checked commit guard
+  (``is_task_running`` / ``.cancelled`` / a cancel-event ``is_set``)
+  within four caller hops — the PR 7 empty-file-overwrite class,
+  previously protected only by per-site review memory.
+
+Scope notes: ``__main__.py`` is excluded from ``except.swallow`` (the
+top-level CLI reporter — every exception it catches terminates in a
+per-query failure report and a nonzero exit, which IS the routing),
+and ``analysis/`` is excluded throughout (the checkers' own rule
+tables).  ``.inprogress`` temp lifecycles are enforced by
+``commit.guard`` statically and by the runtime ledger
+(``runtime/ledger.py``) dynamically — their open/unlink pairs have no
+stable callable name for :data:`RESOURCE_PAIRS`.  Deliberate
+exceptions live in ``lint_waivers.json`` exactly like the other
+passes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: FATAL-class control-flow errors a blanket except must never absorb,
+#: mapped to the builtin superclass spellings that can catch them
+#: (mirrors the ``control: true`` entries of error_names.json — the
+#: registry test pins the mirror)
+FATAL_CONTROL: Dict[str, Tuple[str, ...]] = {
+    "QueryCancelledError": ("RuntimeError",),
+    "QueryDeadlineError": ("RuntimeError", "QueryCancelledError"),
+    "TaskCancelled": (),
+    "LocksetViolation": ("AssertionError",),
+    "LockOrderError": ("AssertionError",),
+    "BlockCorruptionError": ("ValueError",),
+}
+
+#: handler type names that are over-broad (can catch at least one
+#: fatal control class without naming it)
+_BROAD_ALL = ("Exception", "BaseException")
+
+#: data-plane path prefixes for the raise-site half of error.untyped
+DATA_PLANE = ("blaze_tpu/runtime/", "blaze_tpu/parallel/",
+              "blaze_tpu/ops/", "blaze_tpu/io/")
+
+#: the untyped catch-all raise spellings flagged on data-plane paths
+_UNTYPED_RAISES = {"Exception", "BaseException", "RuntimeError"}
+
+#: builtin exception names used to recognize exception ClassDefs
+_BUILTIN_EXC = {
+    "Exception", "BaseException", "RuntimeError", "ValueError",
+    "TypeError", "OSError", "IOError", "KeyError", "IndexError",
+    "AssertionError", "ArithmeticError", "NotImplementedError",
+    "StopIteration", "LookupError", "AttributeError",
+}
+
+#: acquire/release pairs the path-leak rule enforces interprocedurally
+#: (acquire simple name, release simple names, what it is).  The PR 8
+#: same-function pairs ride along so their interprocedural shapes are
+#: covered too; same-function violations still surface first as
+#: ``guard.lifecycle``.
+RESOURCE_PAIRS: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("try_new_spill", ("release",), "spill unit (file or host RAM)"),
+    ("FileSpill", ("release",), "disk spill file"),
+    ("build_attempt_td", ("discard",), "attempt-staged one-shot resources"),
+    ("register_consumer", ("unregister_consumer",),
+     "memmgr consumer registration"),
+    ("_AsyncInserter", ("close", "abort"), "async shuffle stager thread"),
+    ("activate_beat", ("deactivate_beat",), "heartbeat TLS activation"),
+    ("acquire_turn", ("release", "pause"), "fair-share device-lease turn"),
+)
+
+#: predicates that mark a function as a cancellation-checked commit
+#: guard (the commit.guard rule)
+_GUARD_CALL_ATTRS = {"is_task_running", "raise_cancelled"}
+
+#: names whose call in a handler body counts as routing the exception
+#: (directly; helpers are closed over the call graph): classify routes
+#: into the recovery ladder, reraise_control re-raises the fatal
+#: family before a benign fallback, absorbed registers a DELIBERATE
+#: absorption with the runtime audit (runtime/errors.py)
+_ROUTING_CALLS = {"classify", "absorbed", "reraise_control"}
+
+
+def _finding(rule: str, rel: str, line: int, symbol: str, message: str):
+    from .lint import Finding
+
+    return Finding(rule, rel, line, symbol, message)
+
+
+def _excluded(rel: str) -> bool:
+    sep = rel.replace(os.sep, "/")
+    return "/analysis/" in sep or sep.endswith("analysis")
+
+
+# --------------------------------------------------------- call graphs
+
+def _package_graph(parsed) -> Dict[str, Set[str]]:
+    """Union of per-module simple-name call graphs (the jit rule's
+    cross-module matching: helpers cross modules, and a same-name
+    merge is an over-approximation in the safe direction)."""
+    from .lint import _call_graph
+
+    graph: Dict[str, Set[str]] = {}
+    for _, _, tree in parsed:
+        for name, callees in _call_graph(tree).items():
+            graph.setdefault(name, set()).update(callees)
+    return graph
+
+
+def _reverse(graph: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+    rev: Dict[str, Set[str]] = {}
+    for caller, callees in graph.items():
+        for callee in callees:
+            rev.setdefault(callee, set()).add(caller)
+    return rev
+
+
+def _widen(seed: Set[str], rev: Dict[str, Set[str]], hops: int = 3) -> Set[str]:
+    """Close ``seed`` over up-to-``hops`` reverse edges (callers of
+    members join) — the emit-under-lock widening budget."""
+    out = set(seed)
+    frontier = set(seed)
+    for _ in range(hops):
+        nxt: Set[str] = set()
+        for name in frontier:
+            for caller in rev.get(name, ()):
+                if caller not in out:
+                    out.add(caller)
+                    nxt.add(caller)
+        if not nxt:
+            break
+        frontier = nxt
+    return out
+
+
+# ----------------------------------------------- rule: error.untyped
+
+def _exception_classes(parsed) -> Dict[str, Tuple[str, int, str]]:
+    """Every exception class the package defines:
+    name -> (relpath, line, module_dotted).  Recognized by base-name
+    fixpoint: a base that is a builtin exception name or an
+    already-recognized package exception class."""
+    classes: Dict[str, Tuple[str, int, str, Tuple[str, ...]]] = {}
+    for rel, _, tree in parsed:
+        mod = rel[:-3].replace(os.sep, ".").replace("/", ".")
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = tuple(
+                b.id if isinstance(b, ast.Name) else b.attr
+                for b in node.bases
+                if isinstance(b, (ast.Name, ast.Attribute)))
+            classes[node.name] = (rel, node.lineno, mod, bases)
+    known: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, (_, _, _, bases) in classes.items():
+            if name in known:
+                continue
+            if any(b in _BUILTIN_EXC or b in known for b in bases):
+                known.add(name)
+                changed = True
+    return {n: classes[n][:3] for n in known}
+
+
+def lint_error_registry(root: Optional[str] = None, parsed=None,
+                        registry: Optional[Dict] = None) -> List:
+    """``error.untyped`` / ``error.stale``: the typed-error registry
+    drift gate plus the untyped-raise check on data-plane paths.
+    ``registry`` overrides the packaged ``error_names.json`` (tests)."""
+    from .lint import _dotted, _func_name, package_root, parse_package
+
+    root = root or package_root()
+    if parsed is None:
+        parsed = parse_package(root)
+    pkg_parent = os.path.dirname(root)
+    parsed_rel = [(os.path.relpath(p, pkg_parent), s, t)
+                  for p, s, t in parsed]
+    if registry is None:
+        from ..runtime.errors import load_error_names
+
+        registry = load_error_names()
+    reg: Dict[str, Dict] = dict(registry.get("classes", {}))
+    findings: List = []
+    # class DEFINITIONS are collected package-wide including analysis/
+    # (the verifier error classes live there); only raise-site and
+    # swallow checks exclude the checkers' own rule tables
+    defined = _exception_classes(parsed_rel)
+
+    # source -> registry: every defined exception class is registered
+    for name, (rel, line, mod) in sorted(defined.items()):
+        if name not in reg:
+            findings.append(_finding(
+                "error.untyped", rel, line, name,
+                f"typed error class {name!r} is not registered in "
+                f"runtime/error_names.json — register it with its "
+                f"retry.classify disposition (retry|fetch|fatal) so "
+                f"the recovery ladder and catch sites can key on it"))
+
+    # registry -> source: every entry resolves, in the right module,
+    # with a well-formed disposition
+    reg_rel = "blaze_tpu/runtime/error_names.json"
+    for name, entry in sorted(reg.items()):
+        disp = entry.get("disposition")
+        if disp not in ("retry", "fetch", "fatal"):
+            findings.append(_finding(
+                "error.stale", reg_rel, 1, name,
+                f"registry entry {name!r} carries malformed disposition "
+                f"{disp!r} (must be retry|fetch|fatal)"))
+        if name not in defined:
+            findings.append(_finding(
+                "error.stale", reg_rel, 1, name,
+                f"registry entry {name!r} has no matching class "
+                f"definition in the package — stale entry or silent "
+                f"rename"))
+            continue
+        _, _, mod = defined[name]
+        want = str(entry.get("module", ""))
+        if want and mod != want:
+            findings.append(_finding(
+                "error.stale", reg_rel, 1, name,
+                f"registry entry {name!r} names module {want!r} but the "
+                f"class is defined in {mod!r}"))
+
+    # raise sites on data-plane paths: no untyped catch-all raises
+    for rel, _, tree in parsed_rel:
+        posix = rel.replace(os.sep, "/")
+        if not posix.startswith(DATA_PLANE) or _excluded(rel):
+            continue
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.funcs: List[str] = []
+
+            def visit_FunctionDef(self, node) -> None:
+                self.funcs.append(node.name)
+                self.generic_visit(node)
+                self.funcs.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_ClassDef = visit_FunctionDef
+
+            def visit_Raise(self, node: ast.Raise) -> None:
+                exc = node.exc
+                name = ""
+                if isinstance(exc, ast.Call):
+                    name = _func_name(exc.func) or _dotted(exc.func)
+                elif isinstance(exc, (ast.Name, ast.Attribute)):
+                    name = _func_name(exc) or _dotted(exc)
+                if name in _UNTYPED_RAISES:
+                    findings.append(_finding(
+                        "error.untyped", rel, node.lineno,
+                        ".".join(self.funcs) or "<module>",
+                        f"raise {name}(...) on a data-plane path — "
+                        f"raise a class registered in "
+                        f"runtime/error_names.json so retry.classify "
+                        f"and typed catch sites can route it"))
+                self.generic_visit(node)
+
+        V().visit(tree)
+    return findings
+
+
+# --------------------------------------------- rule: except.swallow
+
+def _handler_types(h: ast.ExceptHandler) -> Optional[List[str]]:
+    """Caught type names of one handler (None = bare ``except:``)."""
+    t = h.type
+    if t is None:
+        return None
+    out: List[str] = []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+    return out
+
+
+def _absorbable(type_names: Optional[List[str]]) -> Set[str]:
+    """Fatal control classes a handler with these type names can catch
+    WITHOUT naming them.  A targeted catch is deliberate handling —
+    both of the named class and of its registered fatal SUBCLASSES
+    (``except QueryCancelledError`` deliberately handles the whole
+    cancel family, deadline included); only a BUILTIN superclass
+    spelling (``RuntimeError``, ``AssertionError``, ``ValueError``)
+    absorbs blind."""
+    if type_names is None:
+        return set(FATAL_CONTROL)
+    out: Set[str] = set()
+    for t in type_names:
+        if t in _BROAD_ALL:
+            return set(FATAL_CONTROL)
+        if t in FATAL_CONTROL:
+            continue  # targeted: covers the family deliberately
+        for fatal, supers in FATAL_CONTROL.items():
+            if t in supers:
+                out.add(fatal)
+    return out
+
+
+def _intercepted(type_names: Optional[List[str]]) -> Set[str]:
+    """Fatal control classes an EARLIER handler removes from what a
+    later broad arm can see — by naming the class itself or a
+    superclass spelling of it."""
+    if type_names is None:
+        return set(FATAL_CONTROL)
+    out: Set[str] = set()
+    for t in type_names:
+        if t in _BROAD_ALL:
+            return set(FATAL_CONTROL)
+        for fatal, supers in FATAL_CONTROL.items():
+            if t == fatal or t in supers:
+                out.add(fatal)
+    return out
+
+
+def _routing_helpers(parsed) -> Set[str]:
+    """Function names that ROUTE an exception onward: contain a
+    ``raise`` statement, or call ``retry.classify`` / the
+    ``errors.absorbed`` audit — closed three helper hops up the
+    package call graph (a handler calling ``handle_failure`` which
+    calls ``classify`` is routed)."""
+    from .lint import _callee_name
+
+    seed: Set[str] = set()
+
+    for _, _, tree in parsed:
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.funcs: List = []
+
+            def visit_FunctionDef(self, node) -> None:
+                self.funcs.append(node)
+                self.generic_visit(node)
+                self.funcs.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Raise(self, node: ast.Raise) -> None:
+                if self.funcs:
+                    seed.add(self.funcs[-1].name)
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                if self.funcs and _callee_name(node.func) in _ROUTING_CALLS:
+                    seed.add(self.funcs[-1].name)
+                self.generic_visit(node)
+
+        V().visit(tree)
+    return _widen(seed, _reverse(_package_graph(parsed)))
+
+
+def _handler_routes(h: ast.ExceptHandler, routing: Set[str]) -> bool:
+    """True when the handler body re-raises, routes through classify/
+    the audit, or calls a routing helper — nested defs excluded (they
+    run later, on their own paths)."""
+    from .lint import _callee_name
+
+    def scan(n: ast.AST) -> bool:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return False
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call):
+            callee = _callee_name(n.func)
+            if callee in _ROUTING_CALLS or callee in routing:
+                return True
+        return any(scan(c) for c in ast.iter_child_nodes(n))
+
+    return any(scan(stmt) for stmt in h.body)
+
+
+def lint_except_swallow(root: Optional[str] = None, parsed=None) -> List:
+    """``except.swallow`` over the package (``__main__`` and
+    ``analysis/`` excluded — see module docstring)."""
+    from .lint import package_root, parse_package
+
+    root = root or package_root()
+    if parsed is None:
+        parsed = parse_package(root)
+    pkg_parent = os.path.dirname(root)
+    parsed_rel = [(os.path.relpath(p, pkg_parent), s, t)
+                  for p, s, t in parsed]
+    routing = _routing_helpers([pt for pt in parsed_rel
+                                if not _excluded(pt[0])])
+    findings: List = []
+    for rel, _, tree in parsed_rel:
+        posix = rel.replace(os.sep, "/")
+        if _excluded(rel) or posix.endswith("__main__.py"):
+            continue
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.funcs: List[str] = []
+
+            def visit_FunctionDef(self, node) -> None:
+                self.funcs.append(node.name)
+                self.generic_visit(node)
+                self.funcs.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_ClassDef = visit_FunctionDef
+
+            def visit_Try(self, node: ast.Try) -> None:
+                handled: Set[str] = set()
+                for h in node.handlers:
+                    types = _handler_types(h)
+                    can_absorb = _absorbable(types) - handled
+                    handled |= _intercepted(types)
+                    if not can_absorb:
+                        continue
+                    if _handler_routes(h, routing):
+                        continue
+                    spelled = ("bare except" if types is None
+                               else f"except {'/'.join(types)}")
+                    findings.append(_finding(
+                        "except.swallow", rel, h.lineno,
+                        ".".join(self.funcs) or "<module>",
+                        f"{spelled} can absorb FATAL-class "
+                        f"{sorted(can_absorb)} without re-raising, "
+                        f"routing through retry.classify, or "
+                        f"registering the absorption with "
+                        f"errors.absorbed(...) — a swallowed "
+                        f"control-flow error disappears from the "
+                        f"recovery ladder and the chaos gates"))
+                self.generic_visit(node)
+
+        V().visit(tree)
+    return findings
+
+
+# ------------------------------------------ rule: resource.path-leak
+
+def _protected_releases(tree: ast.AST) -> Dict[str, Set[str]]:
+    """function name -> release simple names reached in a PROTECTED
+    region of it (finally/handler body, or a ``with`` body — a context
+    manager's __exit__ runs on the exception edge)."""
+    from .lint import _func_name
+
+    out: Dict[str, Set[str]] = {}
+    release_names = {r for _, rels, _ in RESOURCE_PAIRS for r in rels}
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node) -> None:
+            got: Set[str] = set()
+
+            def scan(n: ast.AST, protected: bool) -> None:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    return
+                if protected and isinstance(n, ast.Call):
+                    name = _func_name(n.func)
+                    if name in release_names:
+                        got.add(name)
+                if isinstance(n, ast.Try):
+                    for c in n.body:
+                        scan(c, protected)
+                    for hh in n.handlers:
+                        for c in hh.body:
+                            scan(c, True)
+                    for c in n.orelse:
+                        scan(c, protected)
+                    for c in n.finalbody:
+                        scan(c, True)
+                    return
+                if isinstance(n, ast.With):
+                    # the with BODY is protected for releases made by
+                    # the context managers; a release call lexically
+                    # under `with closing(x)`-style managers is the
+                    # caller's convention — treat the with items'
+                    # context expressions as protected releases
+                    for item in n.items:
+                        scan(item.context_expr, True)
+                    for c in n.body:
+                        scan(c, protected)
+                    return
+                for c in ast.iter_child_nodes(n):
+                    scan(c, protected)
+
+            for s in node.body:
+                scan(s, False)
+            if got:
+                out.setdefault(node.name, set()).update(got)
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    V().visit(tree)
+    return out
+
+
+def lint_path_leak(root: Optional[str] = None, parsed=None) -> List:
+    """``resource.path-leak``: every :data:`RESOURCE_PAIRS` acquire
+    must reach a protected release in the acquiring function or a
+    caller within three reverse hops (ownership transfer)."""
+    from .lint import _func_name, package_root, parse_package
+
+    root = root or package_root()
+    if parsed is None:
+        parsed = parse_package(root)
+    pkg_parent = os.path.dirname(root)
+    parsed_rel = [(os.path.relpath(p, pkg_parent), s, t)
+                  for p, s, t in parsed if not _excluded(
+                      os.path.relpath(p, pkg_parent))]
+    # package-wide: function -> protected releases, and reverse calls
+    protected: Dict[str, Set[str]] = {}
+    for _, _, tree in parsed_rel:
+        for name, rels in _protected_releases(tree).items():
+            protected.setdefault(name, set()).update(rels)
+    rev = _reverse(_package_graph(parsed_rel))
+
+    def satisfied(fn: str, rel_names: Tuple[str, ...]) -> bool:
+        names = {fn}
+        frontier = {fn}
+        for _ in range(4):  # self + three reverse hops
+            if any(protected.get(n, set()) & set(rel_names)
+                   for n in frontier):
+                return True
+            nxt: Set[str] = set()
+            for n in frontier:
+                nxt |= rev.get(n, set()) - names
+            if not nxt:
+                return False
+            names |= nxt
+            frontier = nxt
+        return False
+
+    findings: List = []
+    acquires = {a: (rels, what) for a, rels, what in RESOURCE_PAIRS}
+    for rel, _, tree in parsed_rel:
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.funcs: List[str] = []
+                self.defined: Set[str] = set()
+
+            def visit_FunctionDef(self, node) -> None:
+                self.funcs.append(node.name)
+                self.generic_visit(node)
+                self.funcs.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_ClassDef = visit_FunctionDef
+
+            def visit_With(self, node: ast.With) -> None:
+                # `with acquire(...)` IS the protected release (the
+                # context-manager protocol); skip the context exprs
+                for c in node.body:
+                    self.visit(c)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                name = _func_name(node.func)
+                if name in acquires and self.funcs:
+                    fn = self.funcs[-1]
+                    scope_names = set(self.funcs)
+                    if name in scope_names or fn == name:
+                        pass  # the pair's own definition module
+                    else:
+                        rels, what = acquires[name]
+                        if not satisfied(fn, rels):
+                            findings.append(_finding(
+                                "resource.path-leak", rel, node.lineno,
+                                ".".join(self.funcs),
+                                f"{name}() ({what}) acquired without "
+                                f"{'/'.join(rels)} reachable on the "
+                                f"exception path (checked this "
+                                f"function and 3 caller hops) — "
+                                f"release in a finally:/handler, a "
+                                f"with-statement, or a caller that "
+                                f"owns the cleanup"))
+                self.generic_visit(node)
+
+        V().visit(tree)
+    return findings
+
+
+# --------------------------------------------- rule: commit.guard
+
+def _has_inprogress_constant(fn: ast.AST) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and "inprogress" in n.value:
+            return True
+    return False
+
+
+def _has_guard_predicate(fn: ast.AST) -> bool:
+    """A cancellation check: ``*.is_task_running()``, a ``.cancelled``
+    read, ``scope.raise_cancelled``, or ``<cancel-ish>.is_set()``."""
+    for n in ast.walk(fn):
+        if isinstance(n, ast.FunctionDef) and n is not fn:
+            continue
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr in _GUARD_CALL_ATTRS:
+                return True
+            if n.func.attr == "is_set":
+                base = n.func.value
+                spelled = ast.dump(base)
+                if "cancel" in spelled.lower():
+                    return True
+        if isinstance(n, ast.Attribute) and n.attr == "cancelled":
+            return True
+    return False
+
+
+def lint_commit_guard(root: Optional[str] = None, parsed=None) -> List:
+    """``commit.guard``: commit-by-rename sites (``os.replace`` /
+    ``os.rename`` in functions staging ``.inprogress`` temps) must be
+    reachable from a cancellation-checked guard within three hops."""
+    from .lint import _dotted, package_root, parse_package
+
+    root = root or package_root()
+    if parsed is None:
+        parsed = parse_package(root)
+    pkg_parent = os.path.dirname(root)
+    parsed_rel = [(os.path.relpath(p, pkg_parent), s, t)
+                  for p, s, t in parsed if not _excluded(
+                      os.path.relpath(p, pkg_parent))]
+    # functions containing a guard predicate, widened 3 reverse hops
+    # DOWN the call chain: a guard in the caller covers the commit in
+    # the callee (execute -> _commit_with_recovery -> write_output ->
+    # _write_files)
+    guards: Set[str] = set()
+    for _, _, tree in parsed_rel:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _has_guard_predicate(node):
+                guards.add(node.name)
+    graph = _package_graph(parsed_rel)
+    # forward widening: a function CALLED (transitively, <=4 hops) by a
+    # guard-holding function is covered — the deepest real chain is
+    # writer-stream -> _commit_with_recovery -> _commit_with_disk_retry
+    # -> write_output -> _write_files
+    covered = set(guards)
+    frontier = set(guards)
+    for _ in range(4):
+        nxt: Set[str] = set()
+        for name in frontier:
+            for callee in graph.get(name, ()):
+                if callee not in covered:
+                    covered.add(callee)
+                    nxt.add(callee)
+        if not nxt:
+            break
+        frontier = nxt
+
+    findings: List = []
+    for rel, _, tree in parsed_rel:
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.funcs: List = []
+
+            def visit_FunctionDef(self, node) -> None:
+                self.funcs.append(node)
+                self.generic_visit(node)
+                self.funcs.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(self, node: ast.Call) -> None:
+                if _dotted(node.func) in ("os.replace", "os.rename") \
+                        and self.funcs:
+                    fn = self.funcs[-1]
+                    if _has_inprogress_constant(fn) \
+                            and fn.name not in covered:
+                        findings.append(_finding(
+                            "commit.guard", rel, node.lineno,
+                            ".".join(f.name for f in self.funcs),
+                            f"commit-by-rename of an .inprogress "
+                            f"staging temp in {fn.name!r} is not "
+                            f"reachable from a cancellation-checked "
+                            f"commit guard (is_task_running / "
+                            f".cancelled / cancel-event is_set within "
+                            f"4 caller hops) — a cancelled loser can "
+                            f"overwrite a winner's committed output "
+                            f"(the PR 7 empty-file class)"))
+                self.generic_visit(node)
+
+        V().visit(tree)
+    return findings
+
+
+# ------------------------------------------------------------- driver
+
+def lint_errflow(root: Optional[str] = None, parsed=None) -> List:
+    """All exception-flow & resource-lifecycle passes — run by
+    ``--lint`` / ``lint_package`` alongside the PR 6/8 rules."""
+    from .lint import package_root, parse_package
+
+    root = root or package_root()
+    if parsed is None:
+        parsed = parse_package(root)
+    return (lint_error_registry(root, parsed)
+            + lint_except_swallow(root, parsed)
+            + lint_path_leak(root, parsed)
+            + lint_commit_guard(root, parsed))

@@ -639,6 +639,7 @@ def lint_package(root: Optional[str] = None,
     """Every AST rule + the conf drift gate + the static lock-order
     pass + the guarded-by/lifecycle passes, waivers applied.  The
     ``--lint`` CLI and tier-1 run this."""
+    from .errflow import lint_errflow
     from .guarded import lint_guarded
     from .locks import lint_lock_order
 
@@ -650,6 +651,7 @@ def lint_package(root: Optional[str] = None,
         + lint_emit_under_lock(root, parsed)
         + lint_lock_order(root, parsed)
         + lint_guarded(root, parsed)
+        + lint_errflow(root, parsed)
         + lint_conf_registry(root, parsed=parsed)
     )
     if apply_waivers:
@@ -700,4 +702,77 @@ def lint_json_doc(pairs: Sequence[Tuple[Finding, bool]],
             "plans_verified": plans_verified,
             "waivers_pinned": len(load_waivers()),
         },
+    }
+
+
+# ------------------------------------------------------ SARIF 2.1.0 out
+
+#: golden key sets for the ``--lint --sarif`` document, pinned exactly
+#: like the LINT_JSON_* sets: CI uploads this to GitHub code-scanning
+#: (or any SARIF 2.1.0 viewer), which annotates findings inline on the
+#: PR diff — silent shape drift would break every consumer at once
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+SARIF_TOP_KEYS = ("$schema", "version", "runs")
+SARIF_RUN_KEYS = ("tool", "results")
+SARIF_RESULT_KEYS = ("ruleId", "level", "message", "locations",
+                     "suppressions")
+
+
+def sarif_doc(pairs: Sequence[Tuple[Finding, bool]]) -> Dict:
+    """The findings as one SARIF 2.1.0 document (``--lint --sarif``).
+    Waived findings are reported at level ``note`` with an ``inSource``
+    suppression carrying the pinned justification, so a code-scanning
+    upload shows them greyed out instead of failing the run — the same
+    reported-but-excluded contract as ``--json``'s ``waived`` flag.
+    Rule metadata (one entry per distinct rule id, with the first
+    finding's message as its short description) rides in
+    ``tool.driver.rules`` so viewers can group by rule."""
+    waivers = load_waivers()
+
+    def justification(f: Finding) -> str:
+        for w in waivers:
+            if w["rule"] == f.rule and f.path.endswith(w["file"]) \
+                    and fnmatch.fnmatch(f.symbol, w["symbol"]):
+                return w.get("reason", "")
+        return ""
+
+    rules: Dict[str, Dict] = {}
+    results = []
+    for f, waived in pairs:
+        if f.rule not in rules:
+            rules[f.rule] = {
+                "id": f.rule,
+                "shortDescription": {"text": f.message[:200]},
+            }
+        results.append({
+            "ruleId": f.rule,
+            "level": "note" if waived else "error",
+            "message": {"text": f"{f.symbol}: {f.message}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path.replace(os.sep, "/")},
+                    "region": {"startLine": max(1, int(f.line))},
+                },
+            }],
+            "suppressions": ([{
+                "kind": "inSource",
+                "justification": justification(f),
+            }] if waived else []),
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "blaze-tpu-lint",
+                    "informationUri":
+                        "https://github.com/dixingxing0/blaze",
+                    "rules": [rules[r] for r in sorted(rules)],
+                },
+            },
+            "results": results,
+        }],
     }

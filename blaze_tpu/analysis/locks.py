@@ -95,6 +95,14 @@ HIERARCHY: Tuple[str, ...] = (
     "trace.sink",            # kernel-attribution sinks
     "trace.sample",          # sampling counter
     "conf.store",            # conf key/value store
+    "errors.state",          # error-escape audit record (held for list
+                             # append only; absorbed() is called from
+                             # handler threads holding none of the
+                             # locks above)
+    "ledger.state",          # resource-ledger live table (innermost of
+                             # the audit pair: acquire/release fire
+                             # inside spill/shuffle critical sections,
+                             # so every operator lock ranks outside it)
     "lockset.state",         # dynamic lockset-checker table (innermost:
                              # guarded accesses record while holding
                              # ANY of the locks above)

@@ -386,6 +386,16 @@ VERIFY_LOCKS = ConfEntry("spark.blaze.verify.locks", False, _bool)
 # --chaos / --chaos-seeds and the concurrency suites; disarmed cost is
 # one bool read per instrumented access.
 VERIFY_LOCKSET = ConfEntry("spark.blaze.verify.lockset", False, _bool)
+# Error-escape recorder + per-query resource ledger (runtime/errors.py
+# + runtime/ledger.py): while armed, every AUDITED broad-except site
+# records a FATAL-class control-flow error it absorbs (the escape
+# survives the swallow — lockset.reported()-style gate), and every
+# tracked resource (spill files, .inprogress shuffle temps, scoped
+# resource registrations, device-lease turns) must be released by
+# query end or the leak is recorded and fails the run.  Armed in
+# --chaos / --chaos-seeds and the faults/lifecycle/service suites;
+# disarmed cost is one bool read per hook.
+VERIFY_ERRORS = ConfEntry("spark.blaze.verify.errors", False, _bool)
 
 # Per-operator enable flags, ≙ BlazeConverters.scala:82-120
 # (spark.blaze.enable.scan / .project / .filter / ...).

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..runtime.errors import reraise_control
+
 
 def _x32():
     """Trace pallas calls with x64 OFF.
@@ -38,7 +40,8 @@ def _x32():
         from jax._src.config import enable_x64
 
         return enable_x64(False)
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — version probe
+        reraise_control(e)
         return contextlib.nullcontext()
 
 LANES = 128
@@ -66,14 +69,16 @@ def available() -> bool:
         return True
     try:
         return jax.default_backend() == "tpu"
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — backend probe
+        reraise_control(e)
         return False
 
 
 def _interpret() -> bool:
     try:
         return jax.default_backend() != "tpu"
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — backend probe
+        reraise_control(e)
         return True
 
 

@@ -1775,8 +1775,15 @@ class _AggConsumer(MemConsumer):
             state = state.materialize()
         try:
             sp = try_new_spill()
-            sp.write_frame(serialize_batch(state))
-            sp.complete()
+            try:
+                sp.write_frame(serialize_batch(state))
+                sp.complete()
+            except BaseException:
+                # never leak the spill's temp file on a failed write
+                # (the task retry rebuilds the accumulator state, but
+                # the blaze_spill_* file would survive to process exit)
+                sp.release()
+                raise
             with self._quiesced:
                 self._spills.append(sp)
         finally:

@@ -128,8 +128,14 @@ class _Window(MemConsumer):
                 if e.batch is None:
                     continue
                 sp = try_new_spill()
-                sp.write_frame(serialize_batch(e.batch))
-                sp.complete()
+                try:
+                    sp.write_frame(serialize_batch(e.batch))
+                    sp.complete()
+                except BaseException:
+                    # keep the entry's in-memory batch (spill-abort
+                    # contract) and never leak the temp file
+                    sp.release()
+                    raise
                 e.spill = sp
                 e.batch = None
                 freed += e.mem

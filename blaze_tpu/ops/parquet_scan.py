@@ -22,6 +22,7 @@ from ..exprs.compile import infer_lit_dtype
 from ..exprs.ir import BinOp, Col, Expr, Lit
 from ..io import parquet as pq
 from ..runtime.context import TaskContext
+from ..runtime.errors import reraise_control
 from ..schema import DataType, Schema, TypeKind
 from .base import BatchStream, ExecNode
 
@@ -85,7 +86,8 @@ def _maybe_match(chunk: pq.ChunkMeta, dtype: DataType, op: str, lit_v) -> bool:
         else:
             lo = pq._stat_value(dtype, chunk.min_value)
             hi = pq._stat_value(dtype, chunk.max_value)
-    except (struct.error, ValueError):
+    except (struct.error, ValueError) as e:
+        reraise_control(e)
         return True
     try:
         if op == "<":

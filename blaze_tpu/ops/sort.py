@@ -285,11 +285,21 @@ class SortExec(ExecNode):
         host = run.to_host()
         sp = try_new_spill()
         bs = int(conf.BATCH_SIZE.get())
-        for start in range(0, run.num_rows, bs):
-            n = min(bs, run.num_rows - start)
-            chunk = _slice_host_batch(host, start, n)
-            sp.write_frame(_encode_chunk(chunk, words_all[start : start + n]))
-        sp.complete()
+        try:
+            for start in range(0, run.num_rows, bs):
+                n = min(bs, run.num_rows - start)
+                chunk = _slice_host_batch(host, start, n)
+                sp.write_frame(
+                    _encode_chunk(chunk, words_all[start : start + n]))
+            sp.complete()
+        except BaseException:
+            # a failed run write must not leak the spill's temp file:
+            # the task fails/retries, but the blaze_spill_* file used
+            # to survive until process exit (resource.path-leak class,
+            # surfaced by analysis/errflow.py; the shuffle
+            # repartitioner's spill-abort already did this)
+            sp.release()
+            raise
         self.metrics.add("spill_count", 1)
         self.metrics.add("spilled_bytes", sp.size)
         return sp

@@ -17,6 +17,7 @@ from typing import List, Optional
 
 from ..ops.base import BatchStream, ExecNode
 from ..runtime.context import RESOURCES, TaskContext
+from ..runtime.errors import reraise_control
 from ..runtime.metrics import MetricNode
 from ..schema import Schema
 from .shuffle import (
@@ -45,8 +46,8 @@ def _ensure_deep_thread_stacks() -> None:
         if not _STACK_DEEPENED:
             try:
                 threading.stack_size(64 << 20)
-            except (ValueError, RuntimeError):
-                pass
+            except (ValueError, RuntimeError) as e:
+                reraise_control(e)
             _STACK_DEEPENED = True
 
 

@@ -45,7 +45,7 @@ from ..io.ipc_compression import (
 )
 from ..ops.base import BatchStream, ExecNode
 from ..runtime import monitor
-from ..runtime import diskmgr, faults, integrity, lockset, trace
+from ..runtime import diskmgr, faults, integrity, ledger, lockset, trace
 from ..runtime.context import TaskContext
 from ..runtime.diskmgr import DiskExhaustedError
 from ..runtime.integrity import BlockCorruptionError
@@ -413,6 +413,12 @@ class ShuffleRepartitioner(MemConsumer):
         # content or is cancelled before reaching here).
         suffix = f".inprogress.a{self.task_attempt_id}"
         tmp_data, tmp_index = data_path + suffix, index_path + suffix
+        # resource-ledger tracking (runtime/ledger.py): both staging
+        # temps must be GONE by the end of this function — renamed into
+        # place on commit, unlinked on abort — so the finally releases
+        # unconditionally and a leak shows up at query end instead
+        ledger.acquire("inprogress", tmp_data)
+        ledger.acquire("inprogress", tmp_index)
         try:
             with open(tmp_data, "wb") as f:
                 w = IpcFrameWriter(f, codec)
@@ -436,6 +442,9 @@ class ShuffleRepartitioner(MemConsumer):
                 except OSError:
                     pass
             raise
+        finally:
+            ledger.release("inprogress", tmp_data)
+            ledger.release("inprogress", tmp_index)
         return lengths
 
 
@@ -748,6 +757,9 @@ class ShuffleWriterExec(ExecNode):
             except NotImplementedError:
                 self._pallas_pids = False  # e.g. string keys: expected, quiet
             except Exception as e:  # import/lowering failures: warn once
+                from ..runtime.errors import reraise_control
+
+                reraise_control(e)
                 self._pallas_pids = False
                 import logging
 

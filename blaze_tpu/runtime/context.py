@@ -14,7 +14,7 @@ import time
 from typing import Any, Callable, Dict, Iterator, Optional, Set, Tuple
 
 from ..analysis.locks import make_lock
-from . import lockset
+from . import ledger, lockset
 from .memmgr import MemManager
 from .metrics import MetricNode
 
@@ -29,6 +29,11 @@ class ResourcesMap:
         self._map: Dict[str, Any] = {}
 
     def put(self, key: str, value: Any) -> None:
+        # resource-ledger tracking (runtime/ledger.py, one bool read
+        # disarmed): a staged registration must be consumed (get) or
+        # rolled back (discard) before its query ends — the hook sits
+        # OUTSIDE the map's own lock (the ledger has its own rank)
+        ledger.acquire("scoped", key)
         with self._lock:
             self._map[key] = value
 
@@ -36,7 +41,9 @@ class ResourcesMap:
         with self._lock:
             if key not in self._map:
                 raise KeyError(f"resource {key!r} not found")
-            return self._map.pop(key)
+            value = self._map.pop(key)
+        ledger.release("scoped", key)
+        return value
 
     def peek(self, key: str) -> Any:
         with self._lock:
@@ -46,6 +53,7 @@ class ResourcesMap:
         """Drop a staged resource if present (failed-attempt cleanup)."""
         with self._lock:
             self._map.pop(key, None)
+        ledger.release("scoped", key)
 
 
 RESOURCES = ResourcesMap()

@@ -1,0 +1,231 @@
+"""Typed-error registry + runtime error-escape audit.
+
+The static half of the exception-flow contract lives in
+``analysis/errflow.py`` (``error.untyped`` gates every data-plane raise
+against the golden registry ``runtime/error_names.json``;
+``except.swallow`` gates every over-broad catch).  This module is the
+shared registry loader plus the RUNTIME half: conf
+``spark.blaze.verify.errors`` (armed in ``--chaos`` / ``--chaos-seeds``
+and the faults/lifecycle/service suites, one module-global bool read
+disarmed — the ``trace.enabled()`` contract) flips an escape recorder
+that every AUDITED broad-except site calls via :func:`absorbed`.  A
+FATAL-class control-flow error (``QueryCancelledError``,
+``LocksetViolation``, ``BlockCorruptionError``, ...) absorbed at such a
+site — a monitor handler turning it into a 500, a telemetry loop
+eating it — is recorded and fails the armed run through
+:func:`escapes`, the same record-then-raise gate as
+``lockset.reported()``: the record survives no matter where the raise
+itself died.
+
+The registry also backs ``retry.classify``: every registered class
+carries an explicit disposition (``retry`` | ``fetch`` | ``fatal``),
+and :func:`classify_explicit` resolves the most-derived registered
+match — tier-1 (tests/test_errflow.py) pins that NO registered class
+ever falls through to the default retry arm, and the dispositions are
+gated two ways against the source by the lint pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..analysis.locks import make_lock
+
+ERROR_NAMES_PATH = os.path.join(os.path.dirname(__file__),
+                                "error_names.json")
+
+_ARMED = False
+_loaded = False
+_lock = make_lock("errors.state")
+#: recorded escape descriptions (survives swallowed raises — the gate)
+_escapes: List[str] = []
+_absorbed_checked = 0
+
+GUARDED_BY = {"_escapes": "errors.state",
+              "_absorbed_checked": "errors.state"}
+GUARDED_REFS = ("_escapes",)
+LOCK_FREE = {
+    "_ARMED": "single bool flipped at quiescent points (arm/refresh); "
+              "readers see a stale value for at most one access",
+    "_loaded": "same one-shot latch pattern as lockset._loaded",
+    "_REGISTRY_CACHE": "single reference swapped under the GIL by the "
+                       "first loader; re-loading is idempotent",
+    "_RESOLVED": "same idempotent-populate pattern: resolve() of one "
+                 "name is deterministic, a racing double-import "
+                 "stores the same class object",
+    "_CONTROL_CACHE": "single tuple swapped once after first "
+                      "resolution; rebuilt identically on a race",
+}
+
+_REGISTRY_CACHE: Optional[Dict[str, Dict[str, Any]]] = None
+_RESOLVED: Dict[str, Optional[type]] = {}
+_CONTROL_CACHE: Optional[Tuple[type, ...]] = None
+
+
+# ----------------------------------------------------------- registry
+
+def load_error_names() -> Dict[str, Any]:
+    """The golden typed-error registry (``runtime/error_names.json``,
+    mirroring ``conf_names.json``/``metric_names.json``): every
+    exception class the engine defines on its data-plane/runtime
+    paths, with its ``retry.classify`` disposition and recovery rung.
+    Gated two ways against the source by ``analysis/errflow.py``."""
+    with open(ERROR_NAMES_PATH) as f:
+        return json.load(f)
+
+
+def registered_errors() -> Dict[str, Dict[str, Any]]:
+    """name -> registry entry, cached (the registry is a packaged
+    golden file; tests that edit it go through their own copies)."""
+    global _REGISTRY_CACHE
+    reg = _REGISTRY_CACHE
+    if reg is None:
+        reg = _REGISTRY_CACHE = dict(load_error_names().get("classes", {}))
+    return reg
+
+
+def resolve(name: str) -> Optional[type]:
+    """Import-and-cache the class a registry entry names (None when
+    the module/attribute is missing — the stale gate reports that)."""
+    if name in _RESOLVED:
+        return _RESOLVED[name]
+    entry = registered_errors().get(name)
+    cls: Optional[type] = None
+    if entry is not None:
+        import importlib
+
+        try:
+            mod = importlib.import_module(entry["module"])
+            obj = getattr(mod, name, None)
+            if isinstance(obj, type) and issubclass(obj, BaseException):
+                cls = obj
+        except ImportError:
+            cls = None
+    _RESOLVED[name] = cls
+    return cls
+
+
+def classify_explicit(exc: BaseException) -> Optional[str]:
+    """Disposition of the MOST-DERIVED registered class ``exc`` is an
+    instance of, or None for unregistered exceptions (the caller's
+    default arm).  ``retry.classify`` consults this first, so a
+    registered class never silently falls through to the default —
+    the completeness tier-1 gate pins exactly that."""
+    best: Optional[Tuple[int, str]] = None
+    for name, entry in registered_errors().items():
+        cls = resolve(name)
+        if cls is None or not isinstance(exc, cls):
+            continue
+        depth = len(cls.__mro__)
+        if best is None or depth > best[0]:
+            best = (depth, str(entry.get("disposition", "retry")))
+    return best[1] if best is not None else None
+
+
+def fatal_control_classes() -> Tuple[type, ...]:
+    """The resolved ``control: true`` classes — the FATAL-or-recovery
+    control-flow errors a blanket except must never absorb (the
+    ``except.swallow`` static rule names the same set)."""
+    global _CONTROL_CACHE
+    cached = _CONTROL_CACHE
+    if cached is None:
+        cached = _CONTROL_CACHE = tuple(
+            c for name, entry in registered_errors().items()
+            if entry.get("control")
+            for c in (resolve(name),) if c is not None)
+    return cached
+
+
+def is_fatal_control(exc: BaseException) -> bool:
+    return isinstance(exc, fatal_control_classes())
+
+
+def reraise_control(exc: BaseException) -> None:
+    """Correctness guard for degrade-and-continue handlers: a broad
+    ``except`` whose INTENT is a benign fallback (a feature probe
+    failed, a torn history line is tolerated, an estimator must not
+    die) calls this first — a FATAL-class control-flow error is
+    re-raised instead of being absorbed into the fallback, and
+    everything else returns to the handler.  Always on (one isinstance
+    against a cached class tuple): this is the fix for the
+    ``except.swallow`` class, not an audit — audited DELIBERATE
+    absorptions (HTTP 500 mapping, telemetry loops) use
+    :func:`absorbed` instead."""
+    if is_fatal_control(exc):
+        raise exc
+
+
+# ------------------------------------------------- escape recorder
+
+def armed() -> bool:
+    if not _loaded:
+        refresh()
+    return _ARMED
+
+
+def arm(on: bool) -> None:
+    """Directly flip the recorder (tests); :func:`refresh` reads conf.
+    Arming clears the record so each armed window judges only its own
+    absorptions — the ``lockset.arm`` contract."""
+    global _ARMED, _loaded, _absorbed_checked
+    with _lock:
+        _escapes.clear()
+        _absorbed_checked = 0
+    _ARMED = on
+    _loaded = True
+
+
+def refresh() -> None:
+    """(Re)load arming from conf ``spark.blaze.verify.errors`` — the
+    chaos CLI and the faults/lifecycle/service suites call this after
+    setting it.  Lazy import: conf creates its lock through
+    analysis.locks, which this module also imports."""
+    from .. import conf
+
+    arm(bool(conf.VERIFY_ERRORS.get()))
+
+
+def reset() -> None:
+    """Clear the escape record without changing arming."""
+    global _absorbed_checked
+    with _lock:
+        _escapes.clear()
+        _absorbed_checked = 0
+
+
+def absorbed(exc: BaseException, site: str) -> None:
+    """THE audited-swallow hookpoint: call from a broad except handler
+    that intends to absorb ``exc`` (map it to an HTTP status, log and
+    continue a telemetry loop).  Disarmed cost: one module-global bool
+    read.  Armed, a FATAL-class control-flow error is recorded as an
+    ESCAPE — the run's gate (``--chaos``, the suites) fails on a
+    non-empty :func:`escapes` even though the handler went on to
+    swallow the raise, exactly like ``lockset.reported()``."""
+    global _absorbed_checked
+    if not _ARMED:
+        return
+    fatal = is_fatal_control(exc)
+    with _lock:
+        _absorbed_checked += 1
+        if fatal:
+            _escapes.append(
+                f"{site}: absorbed FATAL-class "
+                f"{type(exc).__name__}: {exc}"[:300])
+
+
+def escapes() -> List[str]:
+    """Descriptions of every FATAL-class absorption recorded since the
+    last :func:`arm`/:func:`reset` — non-empty even when the error
+    itself was swallowed into a 500 or a dropped telemetry push."""
+    with _lock:
+        return list(_escapes)
+
+
+def counters() -> Dict[str, int]:
+    """Introspection for the chaos counters line: audited-site calls
+    observed while armed, and recorded escapes."""
+    with _lock:
+        return {"absorbed_checked": _absorbed_checked,
+                "recorded_escapes": len(_escapes)}

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, Tuple
 from ..analysis.locks import make_lock
 from ..schema import Schema
 from . import lockset
+from .errors import reraise_control
 
 _CACHE: Dict[tuple, Any] = {}
 _LOCK = make_lock("kernel_cache.registry")
@@ -118,8 +119,8 @@ def enable_persistent_cache(path: str = "") -> bool:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     try:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:  # noqa: BLE001 — knob renamed across jax versions
-        pass
+    except Exception as e:  # noqa: BLE001 — knob renamed across jax versions
+        reraise_control(e)
     _PERSISTENT_DIR[0] = path
     return True
 

@@ -1,0 +1,262 @@
+"""Per-query resource ledger: the runtime half of the
+resource-lifecycle contract.
+
+The static half (``analysis/errflow.py`` ``resource.path-leak`` +
+``commit.guard``, ``analysis/guarded.py`` ``guard.lifecycle``) proves
+that acquire/release pairs it can SEE reach a release on exception
+paths.  Everything it can't see — a leak through dynamic dispatch, a
+rollback path that misses one category, a commit raced by a cancel —
+is this module's job: while armed (conf ``spark.blaze.verify.errors``,
+shared with the error-escape recorder in ``runtime/errors.py``; forced
+on in ``--chaos`` / ``--chaos-seeds`` and the lifecycle/service
+suites), every tracked resource acquisition records the category, key,
+and the OWNING query (read from the ambient
+``context.current_cancel_scope()``, which attempt threads and the
+async stager inherit through ``contextvars.copy_context``), and
+``monitor.query_span`` asserts the owner's ledger is EMPTY at query
+end — a live entry is recorded as a leak that fails the armed run via
+:func:`leaks`, the ``lockset.reported()`` record-then-raise contract.
+
+Tracked categories (the four hand-rolled chaos leak sweeps this
+replaces, consolidated through :func:`leak_audit`):
+
+- ``spill``       — ``blaze_spill_*`` temp files (``memmgr.FileSpill``)
+- ``inprogress``  — ``.inprogress`` shuffle staging temps
+  (``ShuffleRepartitioner._write_files``)
+- ``scoped``      — one-shot resource registrations
+  (``context.ResourcesMap`` put/get/discard)
+- ``lease``       — fair-share device-lease turns
+  (``service.FairShareGate`` acquire/release)
+
+Disarmed — the default — every hook is one module-global bool read,
+the ``trace.enabled()`` structural-no-op contract.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..analysis.locks import make_lock
+
+CATEGORIES = ("spill", "inprogress", "scoped", "lease")
+
+_ARMED = False
+_loaded = False
+_lock = make_lock("ledger.state")
+#: (category, key) -> owner query id ("" when acquired outside any
+#: query scope — never asserted, but visible in live())
+_LIVE: Dict[Tuple[str, str], str] = {}
+_leaks: List[str] = []
+_acquired = 0
+_released = 0
+
+GUARDED_BY = {"_LIVE": "ledger.state", "_leaks": "ledger.state",
+              "_acquired": "ledger.state", "_released": "ledger.state"}
+GUARDED_REFS = ("_LIVE", "_leaks")
+LOCK_FREE = {
+    "_ARMED": "single bool flipped at quiescent points (arm/refresh); "
+              "readers see a stale value for at most one access",
+    "_loaded": "same one-shot latch pattern as lockset._loaded",
+}
+
+
+def _owner() -> str:
+    """The owning query id of the calling context (the ambient
+    CancelScope every entry point opens), or "" outside any query."""
+    # lazy import: context imports this module at load
+    from .context import current_cancel_scope
+
+    scope = current_cancel_scope()
+    return scope.query_id if scope is not None else ""
+
+
+def armed() -> bool:
+    if not _loaded:
+        refresh()
+    return _ARMED
+
+
+def arm(on: bool) -> None:
+    """Directly flip the ledger (tests); :func:`refresh` reads conf.
+    Arming clears the table so each armed window judges only its own
+    acquisitions (resources acquired disarmed are untracked, and their
+    later release is a no-op pop)."""
+    global _ARMED, _loaded, _acquired, _released
+    with _lock:
+        _LIVE.clear()
+        _leaks.clear()
+        _acquired = 0
+        _released = 0
+    _ARMED = on
+    _loaded = True
+
+
+def refresh() -> None:
+    """(Re)load arming from conf ``spark.blaze.verify.errors`` — the
+    error-escape recorder and the ledger are one audit subsystem under
+    one knob.  Lazy import (conf builds its lock through
+    analysis.locks)."""
+    from .. import conf
+
+    arm(bool(conf.VERIFY_ERRORS.get()))
+
+
+def reset() -> None:
+    """Clear the table and the leak record without changing arming."""
+    global _acquired, _released
+    with _lock:
+        _LIVE.clear()
+        _leaks.clear()
+        _acquired = 0
+        _released = 0
+
+
+def acquire(category: str, key: str) -> None:
+    """Record a live resource (disarmed cost: one bool read).  Called
+    at the acquisition site — FileSpill creation, ``.inprogress`` temp
+    staging, a resources-map put, a lease grant — while the acquiring
+    query's scope is ambient."""
+    global _acquired
+    if not _ARMED:
+        return
+    owner = _owner()
+    with _lock:
+        _LIVE[(category, str(key))] = owner
+        _acquired += 1
+
+
+def release(category: str, key: str) -> None:
+    """Record the matching release/commit/abort (idempotent: releasing
+    an untracked or already-released key is a no-op, so disarmed-era
+    acquisitions and double-release rollback paths never misfire)."""
+    global _released
+    if not _ARMED:
+        return
+    with _lock:
+        if _LIVE.pop((category, str(key)), None) is not None:
+            _released += 1
+
+
+def query_end(query_id: str) -> List[str]:
+    """THE query-end assertion: every resource the query still owns is
+    recorded as a leak (and dropped from the live table so one leak is
+    reported once).  Called from ``monitor.query_span`` exit, after the
+    cancel scope closed and every attempt unwound; returns the new
+    leak descriptions (empty on the healthy path)."""
+    if not _ARMED or not query_id:
+        return []
+    fresh: List[str] = []
+    with _lock:
+        for (cat, key), owner in list(_LIVE.items()):
+            if owner == query_id:
+                del _LIVE[(cat, key)]
+                fresh.append(
+                    f"query {query_id!r} ended with live {cat} "
+                    f"resource {key!r}")
+        _leaks.extend(fresh)
+    return fresh
+
+
+def leaks() -> List[str]:
+    """Every leak recorded since the last :func:`arm`/:func:`reset` —
+    the armed run's gate reads this (record-then-raise: the record
+    survives whatever swallowed the query's own error)."""
+    with _lock:
+        return list(_leaks)
+
+
+def live(category: Optional[str] = None) -> Dict[str, str]:
+    """Snapshot of live entries (``"category:key" -> owner``),
+    optionally filtered — introspection for tests and the audit."""
+    with _lock:
+        return {f"{c}:{k}": o for (c, k), o in _LIVE.items()
+                if category is None or c == category}
+
+
+def counters() -> Dict[str, int]:
+    """Introspection for the chaos counters line."""
+    with _lock:
+        return {"acquired": _acquired, "released": _released,
+                "live": len(_LIVE), "leaks": len(_leaks)}
+
+
+# ------------------------------------------------------ the leak oracle
+
+def attempt_threads() -> List[threading.Thread]:
+    """Live ``blaze-attempt-*`` runner threads — the speculation leak
+    signal every chaos arm checks (a cancelled loser must exit
+    cooperatively)."""
+    return [t for t in threading.enumerate()
+            if t.name.startswith("blaze-attempt-") and t.is_alive()]
+
+
+def spill_glob() -> str:
+    """The on-disk spill pattern the filesystem half of the audit
+    sweeps (``FileSpill`` temp naming contract)."""
+    return os.path.join(tempfile.gettempdir(), "blaze_spill_*")
+
+
+def leak_audit(shuffle_root=None,
+               spills_before: Optional[set] = None,
+               corrupt_expected: Optional[int] = None) -> List[str]:
+    """ONE leak oracle for ``--chaos``, every ``--chaos-seeds`` storm
+    arm, and the lifecycle tests — replacing the four copy-pasted
+    sweeps (threads / spill files / ``.inprogress`` temps / ``.corrupt``
+    accounting).  Returns problem descriptions (empty = clean):
+
+    - live ``blaze-attempt-*`` threads;
+    - ledger leaks recorded at query end (armed runs), plus any entry
+      still live with a non-empty owner (a query that never reached
+      its span exit);
+    - ``blaze_spill_*`` files on disk beyond ``spills_before`` (the
+      filesystem belt-and-braces — catches disarmed runs too);
+    - ``.inprogress`` staging temps under ``shuffle_root`` (one path
+      or an iterable of paths — the admission storm sweeps every
+      root the burst created);
+    - with ``corrupt_expected``, the ``.corrupt`` quarantine count
+      across the roots must MATCH it (a quarantine off the record,
+      or a counter that lied).
+    """
+    problems: List[str] = []
+    threads = attempt_threads()
+    if threads:
+        problems.append("leaked attempt threads: "
+                        + ", ".join(t.name for t in threads))
+    recorded = leaks()
+    if recorded:
+        problems.append("resource-ledger leaks: " + "; ".join(recorded))
+    with _lock:
+        owned = [f"{c}:{k} (owner {o!r})"
+                 for (c, k), o in _LIVE.items() if o]
+    if owned:
+        problems.append("resources still live past their query: "
+                        + ", ".join(sorted(owned)[:4]))
+    leaked_spills = sorted(set(glob.glob(spill_glob()))
+                           - (spills_before or set()))
+    if leaked_spills:
+        problems.append(f"leaked spill files: {leaked_spills[:4]}")
+    roots = ([shuffle_root] if isinstance(shuffle_root, str)
+             else list(shuffle_root or ()))
+    temps: List[str] = []
+    quarantined: List[str] = []
+    for root in roots:
+        if not root or not os.path.isdir(root):
+            continue
+        for f in os.listdir(root):
+            if ".inprogress" in f:
+                temps.append(f)
+            if f.endswith(".corrupt"):
+                quarantined.append(f)
+    if temps:
+        problems.append(f"orphaned shuffle temps: {sorted(temps)[:4]}")
+    if corrupt_expected is not None and roots \
+            and len(quarantined) != corrupt_expected:
+        problems.append(
+            f"{len(quarantined)} .corrupt file(s) on disk but "
+            f"blocks_quarantined={corrupt_expected} — a quarantine "
+            f"happened off the record (or a counter lied)")
+    return problems

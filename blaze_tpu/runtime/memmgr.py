@@ -25,7 +25,7 @@ import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .. import conf
-from . import diskmgr, integrity, lockset
+from . import diskmgr, integrity, ledger, lockset
 from .diskmgr import DiskExhaustedError
 
 #: per-query OWNER attribution for consumers (the multi-tenant service,
@@ -222,6 +222,9 @@ class FileSpill(Spill):
         self._mem: Optional[io.BytesIO] = None  # host-RAM fallback tier
         # conf resolved once per spill, not per frame (hot path)
         self._algo = integrity.frame_algo()
+        # resource-ledger tracking (one bool read disarmed): the file
+        # must be unlinked by release()/migration before query end
+        ledger.acquire("spill", self.path)
 
     def _rollback_partial(self) -> None:
         """Drop a torn partial frame so a retried/migrated write never
@@ -255,6 +258,7 @@ class FileSpill(Spill):
                 os.unlink(self.path)
             except OSError:
                 pass
+            ledger.release("spill", self.path)
         diskmgr.record_recovery()
 
     def write_frame(self, payload: bytes) -> None:
@@ -313,6 +317,7 @@ class FileSpill(Spill):
                 os.unlink(self.path)
             except OSError:
                 pass
+            ledger.release("spill", self.path)
 
 
 class MemConsumer:

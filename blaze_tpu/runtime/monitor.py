@@ -51,7 +51,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from .. import conf
 from ..analysis.locks import make_lock
-from . import lockset, otel, trace
+from . import errors, ledger, lockset, otel, trace
 
 # --------------------------------------------------------------- state
 
@@ -344,7 +344,8 @@ def _copy_counters(cap: Optional[Dict[str, int]]) -> Dict[str, int]:
     for _ in range(4):
         try:
             return dict(cap)
-        except RuntimeError:  # "dictionary changed size during iteration"
+        except RuntimeError as e:  # "dictionary changed size ..."
+            errors.reraise_control(e)  # never eat a cancel/violation
             continue
     return {}
 
@@ -372,6 +373,29 @@ def _new_stage(stage_id: int, kind: Optional[str], n_tasks: int,
         "rows": 0, "bytes": 0, "batches": 0, "tasks_done": 0,
         "counters": {}, "last_beat": now, "tasks": {},
     }
+
+
+def http_status_for(exc: BaseException) -> int:
+    """Typed-error -> HTTP status mapping, shared by the monitor
+    handler and the service submit endpoint: a rejection is **429**
+    (retryable — back off and resubmit), a cancelled query **409**
+    (conflict: the resource's lifecycle ended it), a deadline expiry
+    **504**, anything else **500** (the response body carries the
+    typed class name either way).  Replaces the uniform 500 the
+    handler's blanket except used to answer for every failure."""
+    from .context import QueryCancelledError, QueryDeadlineError
+
+    try:
+        from .service import QueryRejectedError
+    except ImportError:  # pragma: no cover — service always importable
+        QueryRejectedError = ()  # type: ignore[assignment]
+    if QueryRejectedError and isinstance(exc, QueryRejectedError):
+        return 429
+    if isinstance(exc, QueryDeadlineError):
+        return 504
+    if isinstance(exc, QueryCancelledError):
+        return 409
+    return 500
 
 
 def _terminal_status(exc: Optional[BaseException]) -> str:
@@ -491,6 +515,13 @@ def query_span(query_id: str, mode: str = "in-process",
                     set_query_eventlog(log_path)
                     yield log_path
     finally:
+        # the per-query resource-ledger assertion (runtime/ledger.py,
+        # armed via spark.blaze.verify.errors): every spill file,
+        # .inprogress temp, scoped registration, and lease turn the
+        # query acquired must be gone by now — a live entry is
+        # recorded as a leak that fails the armed run's gate.  One
+        # bool read disarmed.
+        ledger.query_end(query_id)
         if enabled():
             dt = (time.perf_counter_ns() - t0) / 1e9
             observe_hist("blaze_query_latency_seconds", dt, trace_id=tid)
@@ -934,7 +965,8 @@ def read_history() -> List[Dict[str, Any]]:
     def seg_no(path: str) -> int:
         try:
             return int(path.rsplit(".seg", 1)[1])
-        except (IndexError, ValueError):
+        except (IndexError, ValueError) as e:
+            errors.reraise_control(e)
             return 0
 
     import logging
@@ -954,7 +986,8 @@ def read_history() -> List[Dict[str, Any]]:
                         continue
                     try:
                         out.append(json.loads(line))
-                    except ValueError:
+                    except ValueError as e:
+                        errors.reraise_control(e)
                         logging.getLogger(__name__).warning(
                             "skipping torn/unparseable history line "
                             "%s:%d (crash mid-append?)", path, i)
@@ -1685,8 +1718,17 @@ class MonitorServer:
                         self.send_error(404)
                         return
                 except Exception as e:  # noqa: BLE001 — a render bug
-                    # must surface as a 500, not kill the server thread
-                    self.send_error(500, explain=f"{type(e).__name__}: {e}")
+                    # must surface as an error response, not kill the
+                    # server thread.  REGISTERED audited swallow site:
+                    # an armed run (spark.blaze.verify.errors) records
+                    # a FATAL-class error absorbed here (the PR 8
+                    # LocksetViolation-into-500 class) and fails the
+                    # chaos gate even though the response below goes
+                    # out; typed lifecycle errors map to their real
+                    # statuses instead of a uniform 500
+                    errors.absorbed(e, site="monitor.handler.get")
+                    self.send_error(http_status_for(e),
+                                    explain=f"{type(e).__name__}: {e}")
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -1722,9 +1764,11 @@ class MonitorServer:
                         if tp and not doc.get("traceparent"):
                             doc["traceparent"] = tp
                         status, out = service_mod.http_submit(doc)
-                    except Exception as e:  # noqa: BLE001 — 500, not
-                        # a dead handler thread
-                        status, out = 500, {
+                    except Exception as e:  # noqa: BLE001 — typed
+                        # status, not a dead handler thread (audited
+                        # swallow site; class name in the body)
+                        errors.absorbed(e, site="monitor.handler.submit")
+                        status, out = http_status_for(e), {
                             "error": f"{type(e).__name__}: {e}"}
                     body = json.dumps(out).encode()
                     self.send_response(status)
@@ -1741,8 +1785,11 @@ class MonitorServer:
 
                 try:
                     accepted = cancel_query(m.group(1))
-                except Exception as e:  # noqa: BLE001 — 500, not a dead thread
-                    self.send_error(500, explain=f"{type(e).__name__}: {e}")
+                except Exception as e:  # noqa: BLE001 — typed status,
+                    # not a dead thread (audited swallow site)
+                    errors.absorbed(e, site="monitor.handler.cancel")
+                    self.send_error(http_status_for(e),
+                                    explain=f"{type(e).__name__}: {e}")
                     return
                 body = json.dumps({
                     "query_id": m.group(1), "cancelled": accepted,
@@ -1906,8 +1953,10 @@ class _StatsdPusher:
         while not self._stop.wait(interval):
             try:
                 self._push_once()
-            except Exception:  # noqa: BLE001 — telemetry must not die
-                pass
+            except Exception as e:  # noqa: BLE001 — telemetry must not
+                # die; the AUDITED swallow: an armed run records a
+                # FATAL-class error absorbed here and fails the gate
+                errors.absorbed(e, site="monitor.statsd")
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -1937,6 +1986,7 @@ def ensure_server() -> Optional[MonitorServer]:
             try:
                 _STATSD_PUSHER = _StatsdPusher(_statsd).start()
             except (OSError, ValueError) as e:
+                errors.reraise_control(e)
                 print(f"# monitor: statsd target {_statsd!r} unusable: {e}",
                       file=sys.stderr)
         if _SERVER is None:

@@ -50,6 +50,7 @@ from typing import Any, Dict, List, Optional
 
 from .. import conf
 from ..analysis.locks import make_lock
+from . import errors as _errors
 from . import lockset, trace
 
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "otel_schema.json")
@@ -482,13 +483,15 @@ class _OtelPusher:
         while not self._stop.wait(self._interval):
             try:
                 self._flush_once()
-            except Exception:  # noqa: BLE001 — telemetry must not die
-                pass
+            except Exception as e:  # noqa: BLE001 — telemetry must not
+                # die (audited swallow: armed runs record FATAL-class
+                # absorptions and fail the chaos gate)
+                _errors.absorbed(e, site="otel.push")
         # final drain so a clean shutdown doesn't strand queued spans
         try:
             self._flush_once()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — audited swallow
+            _errors.absorbed(e, site="otel.push.final")
 
     def shutdown(self) -> None:
         self._stop.set()

@@ -65,6 +65,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import conf
+from .errors import reraise_control
 
 PEAKS_PATH = os.path.join(os.path.dirname(__file__), "device_peaks.json")
 BASELINES_PATH = os.path.join(
@@ -153,7 +154,9 @@ def _estimate(args: tuple, kwargs: dict, out: Any) -> Tuple[int, int]:
         from jax import tree_util
 
         leaves = tree_util.tree_leaves((args, kwargs, out))
-    except Exception:  # noqa: BLE001 — estimation must never kill a run
+    except Exception as e:  # noqa: BLE001 — estimation must never kill
+        # a run (but a control-flow error is not the estimator's to eat)
+        reraise_control(e)
         leaves = []
         _walk_leaves(args, leaves)
         _walk_leaves(kwargs, leaves)
@@ -227,7 +230,8 @@ def current_device_kind() -> str:
             import jax
 
             _device_kind_cache.append(str(jax.devices()[0])[:80])
-        except Exception:  # noqa: BLE001 — introspection must not die
+        except Exception as e:  # noqa: BLE001 — introspection must not die
+            reraise_control(e)
             _device_kind_cache.append("unknown")
     return _device_kind_cache[0]
 

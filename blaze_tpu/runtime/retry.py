@@ -65,7 +65,10 @@ class FetchFailedError(Exception):
         if self.resource_id.startswith("shuffle_"):
             try:
                 return int(self.resource_id.split("_")[1].split(".")[0])
-            except (IndexError, ValueError):
+            except (IndexError, ValueError) as e:
+                from .errors import reraise_control
+
+                reraise_control(e)
                 return None
         return None
 
@@ -78,7 +81,10 @@ class FetchFailedError(Exception):
         if self.resource_id.startswith("broadcast_"):
             try:
                 return int(self.resource_id.split("_")[1].split(".")[0])
-            except (IndexError, ValueError):
+            except (IndexError, ValueError) as e:
+                from .errors import reraise_control
+
+                reraise_control(e)
                 return None
         return None
 
@@ -122,37 +128,54 @@ FETCH_FAILED = "fetch"   # regenerate the producing map stage first
 FATAL = "fatal"          # propagate immediately, no retry
 
 
+#: classify() results for the dispositions the registry spells
+_DISPOSITIONS = {"retry": RETRY, "fetch": FETCH_FAILED, "fatal": FATAL}
+
+
 def classify(exc: BaseException) -> str:
-    """Map an exception from a task attempt to a recovery action."""
-    if isinstance(exc, FetchFailedError):
-        return FETCH_FAILED
+    """Map an exception from a task attempt to a recovery action.
+
+    Every ENGINE-DEFINED error class resolves through the golden
+    typed-error registry (``runtime/error_names.json``, loaded via
+    ``runtime/errors.py``) — most-derived registered match wins, so a
+    registered class NEVER falls through to the default arm (tier-1
+    pins the completeness: tests/test_errflow.py asserts every
+    registry entry classifies explicitly to its pinned disposition).
+    Notable registry-carried contracts:
+
+    - ``FetchFailedError`` -> FETCH (regenerate the producer first);
+    - ``QueryCancelledError``/``TaskCancelled`` -> FATAL (a cancelled
+      query must not be resurrected one task retry at a time);
+    - ``BlockCorruptionError`` outside a shuffle read (corrupt SPILL
+      frame, corrupt worker result) -> RETRY — a fresh attempt
+      rebuilds the consumer's state (inside a shuffle read the reader
+      has already wrapped it in FetchFailedError, which matches its
+      own FETCH entry);
+    - ``TaskRetriesExhausted``/``CatalystParseError`` -> FATAL — both
+      previously fell through to the default RETRY arm (surfaced by
+      the registry-completeness gate): re-running an already-exhausted
+      task or re-parsing a deterministically-malformed plan loops the
+      same failure while hiding the real error.
+
+    Unregistered exceptions keep the pre-registry rules: process
+    control flow and engine bugs are FATAL, everything else RETRY."""
+    from .errors import classify_explicit
+
+    explicit = classify_explicit(exc)
+    if explicit is not None:
+        # a registered class whose disposition string is unrecognized
+        # (a registry typo that slipped past the error.stale lint)
+        # fails FATAL rather than retrying forever: propagating the
+        # real error surfaces the bad entry immediately
+        return _DISPOSITIONS.get(explicit, FATAL)
     if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit,
                         MemoryError)):
-        return FATAL
-    from .context import QueryCancelledError, TaskCancelled
-
-    if isinstance(exc, (TaskCancelled, QueryCancelledError)):
-        # a cancelled/deadline-expired QUERY must not be resurrected
-        # one task retry at a time
         return FATAL
     if isinstance(exc, (AssertionError, NotImplementedError)):
         # plan/engine bugs, not environment flakes: retrying re-runs
         # the same deterministic failure while hiding the real error
         # behind a retries-exhausted wrapper
         return FATAL
-    # explicit (though RETRY is the default) for the storage-failure
-    # ladder's typed errors, so the contract is visible here:
-    # - BlockCorruptionError outside a shuffle read (a corrupt SPILL
-    #   frame, a corrupt worker result): the owning consumer's state is
-    #   rebuilt by a fresh attempt — RETRY.  (Inside a shuffle read the
-    #   reader has already wrapped it in FetchFailedError above.)
-    # - DiskExhaustedError: the disk-pressure ladder ran out of rungs;
-    #   pressure may have subsided by the re-attempt — RETRY.
-    from .diskmgr import DiskExhaustedError
-    from .integrity import BlockCorruptionError
-
-    if isinstance(exc, (BlockCorruptionError, DiskExhaustedError)):
-        return RETRY
     return RETRY
 
 

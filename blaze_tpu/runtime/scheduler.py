@@ -848,7 +848,7 @@ def run_stages(
                 # consumer backpressures its own producer while the
                 # device lease serves other tenants — never held
                 # across a wait the consumer controls
-                turn = lease.acquire() if lease is not None else None
+                turn = lease.acquire_turn() if lease is not None else None
                 try:
                     with stage_scope(stage) as progress:
                         for t in range(stage.n_tasks):

@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import itertools
 import queue
 import threading
 import time
@@ -61,8 +62,9 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from .. import conf
 from ..analysis.locks import make_lock
-from . import lockset, memmgr, monitor, trace
-from .context import QueryCancelledError, cancel_query, current_cancel_scope
+from . import errors, ledger, lockset, memmgr, monitor, trace
+from .context import (QueryCancelledError, cancel_query,
+                      current_cancel_scope)
 from .metrics import MetricsSet
 
 DEFAULT_POOL = "default"
@@ -125,15 +127,21 @@ class _Waiter:
 
 
 class Turn:
-    """One granted device-lease turn (held while a stage executes)."""
+    """One granted device-lease turn (held while a stage executes).
+    ``token`` is the resource-ledger key (runtime/ledger.py): minted at
+    grant, released with the turn, and TRANSFERRED by resume() so a
+    paused-and-resumed logical turn stays one tracked lease."""
 
-    __slots__ = ("pool", "t0", "contended", "held")
+    __slots__ = ("pool", "t0", "contended", "held", "token")
+
+    _seq = itertools.count(1)
 
     def __init__(self, pool: str, contended: bool):
         self.pool = pool
         self.t0 = time.monotonic_ns()
         self.contended = contended
         self.held = True
+        self.token = f"lease-{pool}-{next(Turn._seq)}"
 
 
 class FairShareGate:
@@ -256,7 +264,9 @@ class FairShareGate:
                 else:
                     w.abandoned = True
             raise
-        return Turn(pool, w.contended)
+        turn = Turn(pool, w.contended)
+        ledger.acquire("lease", turn.token)
+        return turn
 
     def release(self, turn: Turn) -> None:
         """Charge the turn's wall time against its pool and free the
@@ -264,6 +274,7 @@ class FairShareGate:
         if not turn.held:
             return
         turn.held = False
+        ledger.release("lease", turn.token)
         elapsed = time.monotonic_ns() - turn.t0
         with self._lock:
             lockset.check(self, "_pools", "_free")
@@ -284,10 +295,14 @@ class FairShareGate:
         self.release(turn)
 
     def resume(self, turn: Turn, scope=None) -> None:
-        """Re-acquire the lease after :meth:`pause` (fresh DRR wait)."""
+        """Re-acquire the lease after :meth:`pause` (fresh DRR wait).
+        The fresh grant's ledger token transfers onto the logical turn
+        (the fresh Turn object is discarded) so the lease stays one
+        tracked resource across pause/resume cycles."""
         fresh = self.acquire(turn.pool, scope=scope)
         turn.t0 = fresh.t0
         turn.contended = fresh.contended
+        turn.token = fresh.token
         turn.held = True
 
     @contextlib.contextmanager
@@ -333,7 +348,11 @@ class Lease:
         with self.gate.turn(self.pool, scope=self.scope) as t:
             yield t
 
-    def acquire(self) -> Turn:
+    def acquire_turn(self) -> Turn:
+        # named distinctly from the bare lock/gate acquires so the
+        # resource.path-leak pair table (analysis/errflow.py) can key
+        # on it: every acquire_turn() must reach release()/pause() on
+        # the exception path
         return self.gate.acquire(self.pool, scope=self.scope)
 
     def pause(self, turn: Turn) -> None:
@@ -729,6 +748,11 @@ class QueryService:
         except QueryCancelledError as exc:
             status, error = _CANCELLED, exc
         except BaseException as exc:  # noqa: BLE001 — typed to the caller
+            # audited broad arm: the error is DELIVERED typed through
+            # h._finish/result(), but an armed run also records any
+            # FATAL-class control error landing here so the chaos gate
+            # sees it even if the submitter never drains the handle
+            errors.absorbed(exc, site="service.run_query")
             status, error = _FAILED, exc
         finally:
             _LEASE.reset(lease_token)
@@ -962,8 +986,9 @@ def set_http_builders(builders: Dict[str, Callable]) -> None:
 def http_submit(doc: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
     """``POST /service/submit`` body -> (HTTP status, response JSON).
     Admission sheds map to **429** (retryable, the whole point of the
-    typed rejection); a cancelled query maps to 499, anything else to
-    500.  Runs on the monitor's per-connection handler thread, so a
+    typed rejection); a deadline expiry to **504**, a cancelled query
+    to **409**, anything else to 500 with the typed class name in the
+    body.  Runs on the monitor's per-connection handler thread, so a
     long query blocks only its own submitter."""
     svc = active_service()
     if svc is None:
@@ -990,9 +1015,20 @@ def http_submit(doc: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         return e.http_status, {"error": str(e), "reason": e.reason,
                                "retryable": True}
     except QueryCancelledError as e:
-        return 499, {"error": str(e), "reason": e.reason}
+        # the ONE shared typed-error mapping (monitor.http_status_for):
+        # a deadline expiry answers 504, a cancel 409 (conflict — the
+        # query's lifecycle ended it), never the nonstandard 499 this
+        # used to answer
+        return monitor.http_status_for(e), {
+            "error": str(e), "reason": e.reason,
+            "class": type(e).__name__}
     except Exception as e:  # noqa: BLE001 — typed to the HTTP caller
-        return 500, {"error": f"{type(e).__name__}: {e}"}
+        # audited swallow: the typed class name rides the body, and an
+        # armed run records any FATAL-class absorption
+        errors.absorbed(e, site="service.http_submit")
+        return monitor.http_status_for(e), {
+            "error": f"{type(e).__name__}: {e}",
+            "class": type(e).__name__}
     return 200, {"query": name, "query_id": handle.exec_id, "pool": pool,
                  "session": session, "rows": rows, "status": handle.status,
                  "trace_id": handle.trace_id}

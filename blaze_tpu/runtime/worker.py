@@ -119,19 +119,36 @@ def main(spec_path: str) -> int:
         from ..io.ipc_compression import block_trailer, compress_frame
 
         algo = integrity.frame_algo()
-        tmp = out_path + ".inprogress"
+        # ATTEMPT-QUALIFIED temp (the shuffle writers' contract, was a
+        # bare .inprogress): a wedge-respawned attempt racing a
+        # not-yet-dead predecessor process no longer interleaves writes
+        # into ONE shared temp — with checksums off that interleaving
+        # committed silently torn frames.  Surfaced by the commit.guard
+        # / resource-ledger audit (analysis/errflow.py).
+        tmp = out_path + f".inprogress.a{attempt}"
         count = 0
         xor = 0
-        with open(tmp, "wb") as f:
-            for batch in run_task(td, task_attempt_id=attempt):
-                frame = compress_frame(serialize_batch(batch),
-                                       codec="raw", checksum_algo=algo)
+        try:
+            with open(tmp, "wb") as f:
+                for batch in run_task(td, task_attempt_id=attempt):
+                    frame = compress_frame(serialize_batch(batch),
+                                           codec="raw", checksum_algo=algo)
+                    if algo is not None:
+                        xor ^= struct.unpack("<BI", frame[-5:])[1]
+                    f.write(frame)
+                    count += 1
                 if algo is not None:
-                    xor ^= struct.unpack("<BI", frame[-5:])[1]
-                f.write(frame)
-                count += 1
-            if algo is not None:
-                f.write(block_trailer(count, xor, algo))
+                    f.write(block_trailer(count, xor, algo))
+        except BaseException:
+            # a failed attempt's temp used to survive until the
+            # age-gated orphan sweep (resource.path-leak class): the
+            # driver only checks the FINAL path, so unlink the staging
+            # debris before the nonzero exit propagates
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         if faults.corrupt("worker.result", attempt=attempt,
                           detail=out_path):
             # @corrupt: post-write bit-rot on the committed result —
@@ -268,6 +285,19 @@ def run_worker_with_retry(
         last_failure = RuntimeError(
             f"worker attempt {attempt} failed ({reason}): " + stderr_tail
         )
+        # a KILLED worker (timeout, OOM kill) could not run its own
+        # temp cleanup: sweep the attempt's .inprogress staging debris
+        # driver-side before the next attempt (the worker-side unlink
+        # covers clean failures; this covers the crash edge)
+        out_path = spec.get("output")
+        if out_path:
+            import glob
+
+            for stale in glob.glob(out_path + ".inprogress*"):
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
         if attempt + 1 < policy.max_attempts:  # no sleep after the last one
             policy.sleep_before_retry(0, int(spec.get("partition", 0)), attempt)
     raise TaskRetriesExhausted(

@@ -35,6 +35,7 @@ from ..schema import DataType, Field, Schema
 from .expr_converter import (
     UnsupportedSparkExpr, convert_expr, convert_expr_with_fallback,
 )
+from ..runtime.errors import reraise_control
 from .plan_json import SparkNode, expr_id
 
 
@@ -158,7 +159,8 @@ def _existence_name(node: SparkNode) -> Optional[str]:
     if isinstance(v, dict) and v.get("exists") is not None:
         try:
             a = _parse_sub(v["exists"])
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — optional-field probe
+            reraise_control(e)
             return None
         eid = expr_id(a.fields.get("exprId"))
         if eid is not None:

@@ -26,6 +26,7 @@ from ..exprs.ir import (
     GetStructField, InList, IsNotNull, IsNull, Like, Lit, NamedStruct, Not,
     ScalarFunc,
 )
+from ..runtime.errors import reraise_control
 from ..schema import DataType
 from .plan_json import SparkNode, expr_id
 
@@ -127,7 +128,8 @@ def _convert_literal(node: SparkNode) -> Lit:
         # days-since-epoch int or ISO string
         try:
             v = int(v)
-        except (TypeError, ValueError):
+        except (TypeError, ValueError) as e:
+            reraise_control(e)
             import datetime
 
             v = datetime.date.fromisoformat(str(v))

@@ -692,6 +692,21 @@ PINNED_WAIVERS = {
     # the unmanaged (manager-None) branches touch a consumer no other
     # thread can reach; the managed branches all lock
     ("guard.unlocked", "runtime/memmgr.py", "MemConsumer.*"),
+    # PR 15 (exception-flow passes, analysis/errflow.py): transports
+    # that statically look like swallows but deliver the error onward
+    # (the speculation attempt record, the async stager's deferred
+    # surfacing), per-row value-parse handlers where nothing inside
+    # the try can raise a control-flow/integrity error, and the worker
+    # subprocess commit (no cancellation concept; attempt-qualified,
+    # driver-verified)
+    ("except.swallow", "runtime/speculation.py",
+     "StageTaskRunner._spawn.body"),
+    ("except.swallow", "parallel/shuffle.py", "_AsyncInserter._drain"),
+    ("except.swallow", "ops/generate.py", "json_tuple_generator.gen"),
+    ("except.swallow", "exprs/functions.py", "_to_date"),
+    ("except.swallow", "exprs/json_path.py", "get_json_object"),
+    ("except.swallow", "exprs/json_path.py", "parse_json"),
+    ("commit.guard", "runtime/worker.py", "main"),
 }
 
 

@@ -41,14 +41,33 @@ def _lock_order_assertions():
     """The fault suite drives retries/reruns across spill, shuffle,
     and staging threads — the module runs with the runtime lock-order
     assertion armed (analysis/locks.py), so an inverted acquisition
-    raises LockOrderError here instead of deadlocking rarely."""
+    raises LockOrderError here instead of deadlocking rarely, AND with
+    the error-escape recorder + resource ledger armed
+    (spark.blaze.verify.errors): a FATAL-class error absorbed at an
+    audited broad-except site, or a resource still live at query end,
+    fails the module instead of vanishing into a recovery path."""
     from blaze_tpu.analysis import locks as lock_verify
+    from blaze_tpu.runtime import errors, ledger
 
     conf.VERIFY_LOCKS.set(True)
     lock_verify.refresh()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
     yield
+    escaped = errors.escapes()
+    leaked = ledger.leaks()
     conf.VERIFY_LOCKS.set(False)
     lock_verify.refresh()
+    conf.VERIFY_ERRORS.set(False)
+    errors.refresh()
+    ledger.refresh()
+    assert escaped == [], (
+        "FATAL-class error absorbed at an audited site during the "
+        "fault suite: " + "; ".join(escaped))
+    assert leaked == [], (
+        "resource-ledger leaks during the fault suite: "
+        + "; ".join(leaked))
 
 
 @pytest.fixture(autouse=True)

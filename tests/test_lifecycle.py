@@ -35,7 +35,8 @@ import numpy as np
 import pytest
 
 from blaze_tpu import conf
-from blaze_tpu.runtime import dispatch, faults, monitor, oom, trace
+from blaze_tpu.runtime import (dispatch, errors, faults, ledger, monitor,
+                               oom, trace)
 from blaze_tpu.runtime.context import (
     CancelScope, QueryCancelledError, QueryDeadlineError, cancel_query,
     cancel_scope, current_cancel_scope,
@@ -47,20 +48,37 @@ import spark_fixtures as F  # noqa: E402
 from test_spark_convert import make_session, q6_like_plan  # noqa: E402
 
 
-def _attempt_threads():
-    return [t for t in threading.enumerate()
-            if t.name.startswith("blaze-attempt-") and t.is_alive()]
+# the one leak oracle (runtime/ledger.py) — the hand-rolled sweep this
+# suite used to carry moved there (ISSUE 15 consolidation)
+_attempt_threads = ledger.attempt_threads
 
 
 @pytest.fixture(autouse=True)
 def _clean_lifecycle():
     """Every scenario starts with no faults, no deadline, the default
-    ladder depth, and leaves nothing armed, registered, or running."""
+    ladder depth, and leaves nothing armed, registered, or running.
+    The whole suite runs with the error-escape recorder AND the
+    per-query resource ledger armed (spark.blaze.verify.errors): a
+    FATAL-class error absorbed at an audited broad-except site, or a
+    spill/temp/registration/lease still live at query end, fails the
+    test that caused it."""
     conf.FAULTS_SPEC.set("")
     conf.TASK_RETRY_BACKOFF.set(0.0)
     conf.QUERY_TIMEOUT_MS.set(0)
     faults.reset()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
     yield
+    escaped = errors.escapes()
+    leaked = ledger.leaks()
+    conf.VERIFY_ERRORS.set(False)
+    errors.refresh()
+    ledger.refresh()
+    assert escaped == [], (
+        "FATAL-class error absorbed at an audited site: "
+        + "; ".join(escaped))
+    assert leaked == [], "resource-ledger leaks: " + "; ".join(leaked)
     conf.FAULTS_SPEC.set("")
     conf.TASK_RETRY_BACKOFF.set(0.1)
     conf.QUERY_TIMEOUT_MS.set(0)
@@ -469,8 +487,7 @@ def test_external_cancel_mid_query_reconciles():
     monitor.reset()
     conf.FAULTS_SPEC.set(_slow_spec())
     faults.reset()
-    spill_glob = os.path.join(tempfile.gettempdir(), "blaze_spill_*")
-    spills_before = set(glob.glob(spill_glob))
+    spills_before = set(glob.glob(ledger.spill_glob()))
     state = {}
 
     def run():
@@ -511,10 +528,10 @@ def test_external_cancel_mid_query_reconciles():
     assert cxl["reconciled"]
     end = next(e for e in events if e["type"] == "query_end")
     assert end["status"] == "cancelled"
-    # zero leaks: threads, shuffle temps, spill files
-    assert _attempt_threads() == []
-    assert not any(".inprogress" in f for f in os.listdir(state["root"]))
-    assert set(glob.glob(spill_glob)) - spills_before == set()
+    # zero leaks: threads, shuffle temps, spill files, ledger — the
+    # one oracle (runtime/ledger.py) the chaos arms share
+    assert ledger.leak_audit(shuffle_root=state["root"],
+                             spills_before=spills_before) == []
 
 
 def test_query_deadline_end_to_end():

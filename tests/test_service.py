@@ -58,14 +58,24 @@ def _armed_checkers():
     """The whole suite runs under the runtime lock-order assertion AND
     the Eraser-style lockset checker: the service's new shared state
     (admission queue, DRR gate, owner tags) is exactly the concurrency
-    seam the PR 8 machinery exists to gate."""
+    seam the PR 8 machinery exists to gate.  The error-escape recorder
+    and resource ledger (spark.blaze.verify.errors) ride along: a
+    FATAL-class error absorbed at an audited handler site or a leaked
+    lease/spill/temp fails the module."""
     from blaze_tpu.analysis import locks as lock_verify
+
+    from blaze_tpu.runtime import errors, ledger
 
     conf.VERIFY_LOCKS.set(True)
     lock_verify.refresh()
     conf.VERIFY_LOCKSET.set(True)
     lockset.refresh()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
     yield
+    escaped = errors.escapes()
+    leaked = ledger.leaks()
     assert lockset.reported() == [], (
         "lockset violations during the service suite: "
         + "; ".join(lockset.reported()))
@@ -73,6 +83,15 @@ def _armed_checkers():
     lock_verify.refresh()
     conf.VERIFY_LOCKSET.set(False)
     lockset.refresh()
+    conf.VERIFY_ERRORS.set(False)
+    errors.refresh()
+    ledger.refresh()
+    assert escaped == [], (
+        "FATAL-class error absorbed at an audited site during the "
+        "service suite: " + "; ".join(escaped))
+    assert leaked == [], (
+        "resource-ledger leaks during the service suite: "
+        + "; ".join(leaked))
 
 
 @pytest.fixture
