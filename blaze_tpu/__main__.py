@@ -170,17 +170,19 @@ def _run_suite(suite: str, names, scale: float, n_parts: int,
     return 0
 
 
-def _rows_via_scheduler(plan, manager=None):
+def _rows_via_scheduler(plan, manager=None, pool=None):
     """Run a plan through the stage scheduler and collect its output as
     a sorted list of row tuples (order-insensitive comparison key).
     Pass ``manager`` to keep a handle on the shuffle root (the
-    corruption storm inspects it for temps/quarantine files)."""
+    corruption storm inspects it for temps/quarantine files) and
+    ``pool`` to bind map stages to a worker-host pool (the worker-kill
+    storm)."""
     from .batch import batch_to_pydict
     from .runtime.scheduler import run_stages, split_stages
 
     stages, manager = split_stages(plan, manager)
     cols = None
-    for b in run_stages(stages, manager):
+    for b in run_stages(stages, manager, pool=pool):
         d = batch_to_pydict(b)
         if cols is None:
             cols = {k: [] for k in d}
@@ -1470,6 +1472,237 @@ def _run_corruption_storm(suite, names, scans, build_query, n_parts,
     return 0
 
 
+def _run_worker_kill_storm(suite, seed) -> int:
+    """Worker-kill storm chaos arm: a two-stage hash query runs on an
+    elastic worker-host pool whose processes carry a seeded
+    ``worker.task@N@kill`` schedule — every pooled worker SIGKILLs
+    itself partway through the map stage, exercising the full
+    lost-worker ladder: liveness/exit detection, invalidation of the
+    dead worker's committed map outputs, partial re-run on survivors
+    (never the whole stage), blacklisting of repeat offenders, and —
+    once every slot is dead or blacklisted — degradation to in-process
+    execution.  Gates: rows byte-identical to the fault-free in-process
+    baseline, at least one worker actually died (vacuous-arm guard),
+    the ``worker_lost`` counter and event log agree, re-runs stay
+    partial, blacklist/degradation counters reconcile with their
+    events and the pool's own state, the lockset checker and the
+    error-escape recorder stay quiet, and the leak oracle finds no
+    residue (no pool thread, no ledger entry, no temp).
+
+    The suite's smoke plans scan driver-process memory (not shippable
+    to a pooled worker), so the arm generates its own small parquet
+    lineitem and builds the canonical scan -> filter -> project ->
+    partial agg -> hash exchange -> final agg split over it: 4 map
+    tasks over 2 pooled workers, kill at each process's SECOND job —
+    every death loses exactly one committed map output."""
+    import glob
+    import random
+    import tempfile
+
+    from . import conf
+    from .analysis import locks as lock_verify
+    from .batch import batch_from_pydict
+    from .exprs import col, lit
+    from .ops import (
+        AggExec, AggFunction, AggMode, FilterExec, GroupingExpr,
+        MemoryScanExec, ParquetScanExec, ParquetSinkExec, ProjectExec,
+    )
+    from .parallel import HashPartitioning, NativeShuffleExchangeExec
+    from .parallel.shuffle import LocalShuffleManager
+    from .runtime import dispatch, errors, faults, ledger, lockset, monitor
+    from .runtime import scheduler, trace
+    from .runtime.context import TaskContext
+    from .runtime.hostpool import HostPool
+    from .schema import DataType, Field, Schema
+
+    rng = random.Random(seed * 74699 + 11)
+    schema = Schema([
+        Field("q", DataType.int64()),
+        Field("p", DataType.int64()),
+        Field("d", DataType.int64()),
+    ])
+    prev_trace = bool(conf.TRACE_ENABLE.get())
+    prev_backoff = conf.TASK_RETRY_BACKOFF.get()
+    prev_task_att = conf.TASK_MAX_ATTEMPTS.get()
+    prev_stage_att = conf.STAGE_MAX_ATTEMPTS.get()
+    prev_maxfail = conf.HOST_BLACKLIST_MAX_FAILURES.get()
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    conf.VERIFY_LOCKSET.set(True)
+    lockset.refresh()
+    lockset.reset()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
+    problems = []
+    root = None
+    spills_before = set(glob.glob(ledger.spill_glob()))
+    try:
+        conf.FAULTS_SPEC.set("")
+        faults.reset()
+        conf.TASK_RETRY_BACKOFF.set(0.01)
+        # deep retry/regen budgets: a respawned slot carries a FRESH
+        # per-process fault counter, so it dies again at its own second
+        # job — the blacklist ladder (maxFailures deaths per slot, then
+        # degradation) is what bounds the storm, and the budgets must
+        # not fire first
+        conf.TASK_MAX_ATTEMPTS.set(8)
+        conf.STAGE_MAX_ATTEMPTS.set(8)
+        # seeded ladder depth: maxFailures=1 blacklists on the first
+        # death (2 deaths to collapse), 2 tolerates one respawn per
+        # slot (up to 4 deaths)
+        maxfail = 1 + rng.randrange(2)
+        conf.HOST_BLACKLIST_MAX_FAILURES.set(maxfail)
+        with tempfile.TemporaryDirectory(prefix="blaze_killstorm_") as td:
+            data_rng = random.Random(13)
+            files = []
+            for i in range(4):
+                d = {
+                    "q": [data_rng.randrange(1, 50) for _ in range(90)],
+                    "p": [data_rng.randrange(100, 10000) for _ in range(90)],
+                    "d": [data_rng.randrange(0, 10) for _ in range(90)],
+                }
+                src = MemoryScanExec([[batch_from_pydict(d, schema)]],
+                                     schema)
+                sink = ParquetSinkExec(src, f"{td}/lineitem_{i}.parquet")
+                for _ in sink.execute(0, TaskContext(0, 1)):
+                    pass
+                files.append(sink.written_files[0])
+
+            def build_plan():
+                scan = ParquetScanExec([[f] for f in files], schema)
+                f = FilterExec(scan, col("q") < lit(24))
+                pr = ProjectExec(
+                    f, [col("q"), (col("p") * col("d")).alias("rev")])
+                aggs = [AggFunction("sum", col("rev"), "revenue")]
+                partial = AggExec(pr, AggMode.PARTIAL,
+                                  [GroupingExpr(col("q"), "q")], aggs,
+                                  supports_partial_skipping=True)
+                ex = NativeShuffleExchangeExec(
+                    partial, HashPartitioning([col("q")], 2))
+                return AggExec(ex, AggMode.FINAL,
+                               [GroupingExpr(col("q"), "q")], aggs)
+
+            baseline = _rows_via_scheduler(build_plan())
+            # the kill schedule rides the POOL WORKERS' env only — the
+            # driver's own spec stays empty (a driver probing
+            # worker.task would kill the query, not a worker).  A map
+            # job probes the site once at job start (the writer plan
+            # yields no batches), so @2@kill means: survive the first
+            # job (one committed map output), die starting the second.
+            kill_spec = "worker.task@2@kill"
+            conf.TRACE_ENABLE.set(True)
+            trace.reset()
+            log_path = None
+            disp_before = dispatch.counters()
+            blacklisted_final, degraded_final = [], False
+            try:
+                mgr = LocalShuffleManager()
+                root = mgr.root
+                with monitor.query_span(f"worker_kill_{suite}",
+                                        mode="scheduler") as log_path:
+                    with HostPool(
+                            2, env={"BLAZE_FAULTS_SPEC": kill_spec},
+                    ) as pool:
+                        chaotic = _rows_via_scheduler(
+                            build_plan(), manager=mgr, pool=pool)
+                        blacklisted_final = pool.blacklisted()
+                        degraded_final = pool.degraded()
+            except Exception as e:  # noqa: BLE001 — the arm reports
+                problems.append(f"UNRECOVERED under '{kill_spec}': "
+                                f"{type(e).__name__}: {e}")
+                chaotic = None
+        m = scheduler.LAST_RUN_METRICS.metrics \
+            if scheduler.LAST_RUN_METRICS else None
+        events = trace.read_event_log(log_path) if log_path else []
+        lost_events = [e for e in events if e.get("type") == "worker_lost"]
+        bl_events = [e for e in events
+                     if e.get("type") == "worker_blacklisted"]
+        deg_events = [e for e in events if e.get("type") == "pool_degraded"]
+        disp_after = dispatch.counters()
+
+        def delta(key):
+            return disp_after.get(key, 0) - disp_before.get(key, 0)
+
+        if chaotic is not None and chaotic != baseline:
+            problems.append(f"SILENT MISMATCH under '{kill_spec}' "
+                            f"({len(chaotic)} vs {len(baseline)} rows)")
+        if not lost_events:
+            problems.append("no pooled worker died — the storm never "
+                            "exercised the lost-worker ladder "
+                            "(vacuous arm)")
+        if m is not None and m.get("worker_lost") != len(lost_events):
+            problems.append(
+                f"worker_lost counter ({m.get('worker_lost')}) disagrees "
+                f"with the event log ({len(lost_events)} event(s))")
+        lost_maps = sum(e.get("lost_maps", 0) for e in lost_events)
+        if lost_maps and m is not None:
+            reruns = m.get("map_stage_reruns") or 0
+            tasks_rerun = m.get("map_tasks_rerun") or 0
+            if reruns == 0:
+                problems.append("committed map outputs were lost but no "
+                                "map-stage regeneration ran")
+            # PARTIAL re-runs: each regeneration re-ran strictly fewer
+            # tasks than the 4-task stage, i.e. only the dead worker's
+            # outputs, never the whole map stage
+            if tasks_rerun >= 4 * max(reruns, 1):
+                problems.append(
+                    f"regeneration re-ran the FULL stage "
+                    f"({tasks_rerun} task(s) over {reruns} rerun(s)) — "
+                    f"the partial-rerun path did not engage")
+        if delta("workers_blacklisted") != len(bl_events) \
+                or len(blacklisted_final) != len(bl_events):
+            problems.append(
+                f"blacklist accounting disagrees: counter delta "
+                f"{delta('workers_blacklisted')}, {len(bl_events)} "
+                f"event(s), pool reported {blacklisted_final}")
+        if delta("pool_degraded") != len(deg_events) \
+                or degraded_final != bool(deg_events):
+            problems.append(
+                f"degradation accounting disagrees: counter delta "
+                f"{delta('pool_degraded')}, {len(deg_events)} event(s), "
+                f"pool degraded={degraded_final}")
+        races = lockset.reported()
+        if races:
+            problems.append("lockset violation(s): " + "; ".join(races))
+        escaped = errors.escapes()
+        if escaped:
+            problems.append("FATAL-class error escape(s): "
+                            + "; ".join(escaped))
+        # the ONE leak oracle: pool reader threads, ledger worker
+        # entries, shuffle temps, spills
+        problems += ledger.leak_audit(shuffle_root=root,
+                                      spills_before=spills_before)
+    except Exception as e:  # noqa: BLE001 — the arm must report, not die
+        problems.append(f"storm arm crashed: {type(e).__name__}: {e}")
+    finally:
+        conf.FAULTS_SPEC.set("")
+        faults.reset()
+        conf.TRACE_ENABLE.set(prev_trace)
+        trace.reset()
+        conf.TASK_RETRY_BACKOFF.set(prev_backoff)
+        conf.TASK_MAX_ATTEMPTS.set(prev_task_att)
+        conf.STAGE_MAX_ATTEMPTS.set(prev_stage_att)
+        conf.HOST_BLACKLIST_MAX_FAILURES.set(prev_maxfail)
+        conf.VERIFY_LOCKS.set(False)
+        lock_verify.refresh()
+        conf.VERIFY_LOCKSET.set(False)
+        lockset.refresh()
+        conf.VERIFY_ERRORS.set(False)
+        errors.refresh()
+        ledger.refresh()
+    if problems:
+        print(f"worker-kill-storm (seed {seed}): " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    print(f"worker-kill-storm (seed {seed}): OK ({len(lost_events)} "
+          f"worker(s) lost, {lost_maps} map output(s) re-run, "
+          f"{len(bl_events)} blacklisted, "
+          f"{'degraded to local' if degraded_final else 'pool survived'}, "
+          f"rows identical)")
+    return 0
+
+
 def _live_attempt_threads():
     """Attempt-runner threads still alive after a run — kept as a thin
     alias of the shared leak oracle's thread check
@@ -1649,11 +1882,16 @@ def main(argv=None) -> int:
                          "on shuffle/spill blocks + @enospc disk-full "
                          "under a spill-forcing budget, asserting zero "
                          "silent wrong results and every corruption "
-                         "detected+recovered); nonzero "
+                         "detected+recovered) plus a worker-kill-storm "
+                         "arm (pooled worker processes SIGKILLed "
+                         "mid-stage by a seeded @kill schedule, "
+                         "asserting partial re-run of only the dead "
+                         "worker's map outputs, blacklisting, and "
+                         "degradation to in-process execution); nonzero "
                          "exit on any mismatch, unreconciled event log, "
                          "hung or untyped submission, leaked thread, "
-                         "undetected corruption, or "
-                         "orphaned temp/spill file")
+                         "undetected corruption, unrecovered worker "
+                         "loss, or orphaned temp/spill file")
     ap.add_argument("--trace", action="store_true",
                     help="arm the structured event log "
                          "(spark.blaze.trace.enabled) for this run; each "
@@ -1930,6 +2168,8 @@ def main(argv=None) -> int:
                 rc = _run_corruption_storm(args.suite, qnames, scans, bq,
                                            args.parts,
                                            args.chaos_seed + k) or rc
+                rc = _run_worker_kill_storm(args.suite,
+                                            args.chaos_seed + k) or rc
         elif args.chaos:
             rc = _run_chaos(args.suite, queries, args.scale, args.parts,
                             args.chaos_seed, args.chaos_faults)

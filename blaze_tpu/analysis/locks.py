@@ -69,6 +69,15 @@ HIERARCHY: Tuple[str, ...] = (
                              # (held only for set/dict mutation; the
                              # trace emission a cancel produces happens
                              # after release)
+    "hostpool.state",        # worker-host pool slot table: liveness,
+                             # blacklist tallies, map-output ownership
+                             # (held for dict/slot mutation only —
+                             # spawn/kill syscalls, frame IO waits, and
+                             # all trace emission happen after release;
+                             # ranks inside context.cancel so a cancel
+                             # checkpoint may consult pool state, and
+                             # outside monitor.registry/ledger.state
+                             # whose accounting hooks it calls)
     "shuffle.repartitioner", # per-map-task staged partition buffers
     "monitor.registry",      # live query registry
     "monitor.progress",      # per-stage progress counters (leaf: held

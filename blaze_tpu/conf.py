@@ -158,6 +158,29 @@ SPECULATION_WEDGE_MS = ConfEntry("spark.blaze.speculation.wedgeMs", 0, int)
 # Env override BLAZE_FAULTS_SPEC reaches worker subprocesses too.
 FAULTS_SPEC = ConfEntry("spark.blaze.faults.spec", "", str)
 
+# Elastic worker-host pool (runtime/hostpool.py): persistent
+# worker.py --serve processes the scheduler binds map tasks to.
+# Number of pooled workers; 0 = pool disabled, everything in-process.
+POOL_WORKERS = ConfEntry("spark.blaze.pool.workers", 0, int)
+# pooled-worker heartbeat interval (ms) on the serve protocol's stdout
+# frame stream — the liveness signal hostpool.heartbeat_ages() reads
+# (same age mechanism as spark.blaze.monitor.heartbeatMs)
+POOL_HEARTBEAT_MS = ConfEntry("spark.blaze.pool.heartbeatMs", 50, int)
+# heartbeat silence (ms) past which a READY pooled worker is declared
+# lost and its map outputs invalidated for partial rerun.  Must exceed
+# spark.blaze.pool.heartbeatMs by a healthy margin.
+POOL_LIVENESS_TIMEOUT_MS = ConfEntry(
+    "spark.blaze.pool.livenessTimeoutMs", 10000, int)
+# worker-slot failures inside the decay window before the slot is
+# BLACKLISTED (no respawn) — ≙ spark.blacklist.* node blacklisting
+HOST_BLACKLIST_MAX_FAILURES = ConfEntry(
+    "spark.blaze.host.blacklist.maxFailures", 2, int)
+# sliding decay window (seconds) for blacklist failure counts; a
+# blacklisted slot is re-admitted once its count decays below the
+# threshold — ≙ spark.blacklist.timeout
+HOST_BLACKLIST_DECAY_SEC = ConfEntry(
+    "spark.blaze.host.blacklist.decaySec", 60.0, float)
+
 # End-to-end data integrity (runtime/integrity.py): checksum algorithm
 # stamped on every framed block that crosses a process or disk boundary
 # (shuffle map outputs, spill frames, RSS pushes, broadcast blobs,

@@ -42,7 +42,7 @@ the grammar::
 
     spec     := entry ("," entry)*
     entry    := site "@" hit [ "@a" attempt ]
-                [ "@slow" ms | "@oom" | "@corrupt" | "@enospc" ]
+                [ "@slow" ms | "@oom" | "@corrupt" | "@enospc" | "@kill" ]
     example  := "shuffle.fetch@2,task.compute@1@a0,kernel.dispatch@3@oom"
 
 An ``@oom`` entry raises :class:`InjectedOom` — a stand-in for XLA's
@@ -55,6 +55,15 @@ An ``@enospc`` entry raises :class:`InjectedDiskFull` — a real
 (runtime/diskmgr.py: reclaim, in-memory fallback, typed retryable
 ``DiskExhaustedError``) is deterministically testable without filling
 a disk.
+
+A ``@kill`` entry SIGKILLs the current process at the matching hit —
+the hard executor-death mode (preemption, OOM-killer) the host pool's
+liveness/recovery machinery must absorb.  It is meant for the
+``worker.task`` site (or any site probed inside a POOLED worker, via
+the worker's own ``BLAZE_FAULTS_SPEC`` env): delivered to the driver
+process it would kill the query outright, so kill specs are armed on
+worker envs only.  The ``fault_injected`` event (kind="kill") is
+flushed before the signal since SIGKILL gives no cleanup window.
 
 A ``@corrupt`` entry injects POST-COMMIT bit-rot instead of raising:
 write sites probe :func:`corrupt` after their bytes are staged/
@@ -100,6 +109,11 @@ SITES = (
     # worker result-frame commit (runtime/worker.py) — @corrupt flips
     # a committed result byte the DRIVER's verification must catch
     "worker.result",
+    # worker job execution (runtime/worker.py _execute_spec): probed at
+    # job start and per yielded batch INSIDE the worker process — the
+    # ``@kill`` modifier's natural site (SIGKILL mid-map / mid-fetch in
+    # a pooled worker; the driver must recover via WorkerLostError)
+    "worker.task",
 )
 
 
@@ -177,7 +191,7 @@ def parse_spec(spec: str) -> List[Rule]:
                     raise ValueError(
                         f"duplicate/conflicting kind modifier in {entry!r}")
                 kind = True
-            elif mod in ("corrupt", "enospc"):
+            elif mod in ("corrupt", "enospc", "kill"):
                 if kind is not False:
                     raise ValueError(
                         f"duplicate/conflicting kind modifier in {entry!r}")
@@ -330,6 +344,20 @@ class FaultInjector:
                     trace.emit("fault_injected", site=site, hit=n,
                                attempt=attempt, detail=detail, kind="oom")
                     raise InjectedOom(site, n, detail)
+                if kind == "kill":
+                    # kind=kill: SIGKILL the CURRENT process — the
+                    # hard worker-death the host pool's liveness layer
+                    # must detect and recover from.  The event goes
+                    # out first (emit flushes whole lines; SIGKILL
+                    # gives no cleanup window) so the storm gate can
+                    # pair the kill with its worker_lost recovery.
+                    import os
+                    import signal
+
+                    trace.emit("fault_injected", site=site, hit=n,
+                               attempt=attempt, detail=detail,
+                               kind="kill")
+                    os.kill(os.getpid(), signal.SIGKILL)
                 if kind == "enospc":
                     # kind=enospc: pairs with a disk_pressure recovery
                     # (the disk ladder) or a plain retry when the

@@ -1,0 +1,541 @@
+"""Elastic worker-host pool: placement-aware binding, liveness, lost-
+worker recovery, and host blacklisting.
+
+≙ the executor-loss half of the reference's recovery split (PAPER.md
+layer map): Spark binds tasks to long-lived executors, notices an
+executor dying (heartbeat loss, exit status), invalidates the map
+outputs that died with it, and resubmits ONLY the lost partitions on
+the surviving executors — repeat offenders land on the node blacklist.
+This module is the driver half of ``worker.py --serve``: a pool of
+persistent worker PROCESSES the scheduler can bind map tasks to.
+
+Wire protocol (the PR 13 checksummed frame format, raw-codec JSON):
+the driver writes framed job specs (``scheduler.worker_task_spec``
+dicts + a ``job_id``) to the worker's stdin; the worker replies on
+stdout with ``ready``, periodic ``hb`` heartbeats every
+``spark.blaze.pool.heartbeatMs``, and a ``done`` record per job.  A
+failed job carries its TYPED identity (class name, ``retry.classify``
+disposition, FetchFailedError's resource/map-id fields), so
+:meth:`HostPool.run_task` re-raises a REAL typed error — never a bare
+exit status.  ``BLAZE_TRACEPARENT`` (+ the per-job spec key) carries
+the driver's trace context into every worker segment.
+
+Liveness rides the same heartbeat-age mechanism as the monitor
+registry (``monitor.heartbeat_ages``): the reader thread stamps
+``last_beat`` on every frame, and :meth:`heartbeat_ages` exposes the
+per-worker age in the registry's shape.  A worker is declared LOST on
+heartbeat silence past ``spark.blaze.pool.livenessTimeoutMs``, nonzero
+exit, or SIGKILL (stdout EOF) — :class:`WorkerLostError` then carries
+the dead worker's committed map outputs (``lost_outputs``) so the
+scheduler re-runs exactly those via the ``FetchFailedError.map_ids``
+partial-rerun path.  A slot accumulating
+``spark.blaze.host.blacklist.maxFailures`` failures inside the
+``spark.blaze.host.blacklist.decaySec`` decay window is BLACKLISTED
+(no respawn; re-admitted once the window decays); with every slot dead
+or blacklisted the pool DEGRADES — :meth:`placement` returns None and
+the scheduler falls back to in-process execution instead of failing
+the query.
+
+Locking: all pool state (slot table, map-output ownership, failure
+tallies, blacklist, rotor) mutates under the declared hierarchy lock
+``hostpool.state`` — held for dict/slot mutation only.  Process
+spawn/kill syscalls, frame IO waits, ledger accounting, and ALL trace
+emission happen after release.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import conf
+from ..analysis.locks import make_lock
+from . import ledger, lockset, trace
+from .worker import terminate_process_group
+
+
+class WorkerLostError(Exception):
+    """A pooled worker died while bound to a task: heartbeat silence,
+    nonzero exit, or SIGKILL (stdout EOF mid-job).  ``lost_outputs``
+    maps ``shuffle_id -> sorted map ids`` whose committed outputs the
+    dead worker owned — the scheduler invalidates and re-runs exactly
+    those on survivors (the existing partial-rerun path), then retries
+    the interrupted task itself.  Registered disposition: retry."""
+
+    def __init__(self, worker: str, reason: str,
+                 lost_outputs: Optional[Dict[int, List[int]]] = None):
+        self.worker = worker
+        self.reason = reason
+        self.lost_outputs: Dict[int, List[int]] = {
+            int(sid): sorted(mids)
+            for sid, mids in (lost_outputs or {}).items() if mids
+        }
+        super().__init__(
+            f"pooled worker {worker!r} lost ({reason})"
+            + (f"; owned map outputs {self.lost_outputs}"
+               if self.lost_outputs else "")
+        )
+
+
+class WorkerTaskError(RuntimeError):
+    """A pooled worker's job failed with a RETRY-classified error —
+    reconstructed driver-side from the worker's serialized typed reply
+    (class name + message); the worker itself is still healthy."""
+
+    def __init__(self, error_type: str, message: str):
+        self.error_type = error_type
+        super().__init__(f"worker task failed [{error_type}]: {message}")
+
+
+class WorkerTaskFatalError(RuntimeError):
+    """A worker failure whose worker-side ``retry.classify`` said
+    FATAL: re-running it re-fails deterministically, so the driver
+    propagates instead of burning retry budget.  Registered
+    disposition: fatal."""
+
+    def __init__(self, error_type: str, message: str):
+        self.error_type = error_type
+        super().__init__(f"fatal worker failure [{error_type}]: {message}")
+
+
+class _Worker:
+    """One pool slot's live process: the Popen handle, its framed-reply
+    reader thread, and the liveness stamps that thread maintains."""
+
+    LOCK_FREE = {
+        "last_beat": "single monotonic-ns store by the reader thread, "
+                     "single read by the waiter/ages snapshot; "
+                     "staleness is bounded by one heartbeat interval",
+        "ready": "one-shot False->True latch set by the reader thread",
+        "eof": "one-shot False->True latch set by the reader thread "
+               "before the queue sentinel that publishes it",
+    }
+
+    def __init__(self, name: str, proc: subprocess.Popen, ledger_key: str):
+        self.name = name
+        self.proc = proc
+        self.ledger_key = ledger_key
+        self.replies: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self.last_beat = time.monotonic_ns()
+        self.ready = False
+        self.eof = False
+        self.thread: Optional[threading.Thread] = None
+
+
+class HostPool:
+    """A pool of persistent ``worker.py --serve`` processes the
+    scheduler binds map tasks to (``run_stages(..., pool=)``)."""
+
+    GUARDED_BY = {
+        "_slots": "hostpool.state",
+        "_map_outputs": "hostpool.state",
+        "_failures": "hostpool.state",
+        "_blacklisted": "hostpool.state",
+        "_rr": "hostpool.state",
+        "_job_seq": "hostpool.state",
+        "_degraded": "hostpool.state",
+        "_closed": "hostpool.state",
+    }
+    GUARDED_REFS = ("_slots", "_map_outputs", "_failures", "_blacklisted")
+
+    def __init__(self, n_workers: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None):
+        self._n = int(n_workers if n_workers is not None
+                      else conf.POOL_WORKERS.get())
+        self._env = dict(env or {})
+        self._hb_ms = int(conf.POOL_HEARTBEAT_MS.get())
+        self._liveness_ms = int(conf.POOL_LIVENESS_TIMEOUT_MS.get())
+        self._max_failures = int(conf.HOST_BLACKLIST_MAX_FAILURES.get())
+        self._decay_s = float(conf.HOST_BLACKLIST_DECAY_SEC.get())
+        self._names: Tuple[str, ...] = tuple(
+            f"w{i}" for i in range(max(0, self._n)))
+        self._lock = make_lock("hostpool.state")
+        self._slots: Dict[str, _Worker] = {}
+        self._map_outputs: Dict[str, Dict[int, Set[int]]] = {}
+        self._failures: Dict[str, List[float]] = {}
+        self._blacklisted: Set[str] = set()
+        self._rr = 0
+        self._job_seq = 0
+        self._degraded = False
+        self._closed = False
+        for name in self._names:
+            self._ensure_spawned(name)
+
+    # ------------------------------------------------------- lifecycle
+
+    def _spawn(self, name: str) -> _Worker:
+        """Start one ``--serve`` worker in its OWN process group (a
+        lost-worker kill or a cancel reaps it and any children in one
+        signal) and attach the framed-reply reader thread."""
+        env = dict(os.environ)
+        env.update(self._env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["BLAZE_POOL_HEARTBEATMS"] = str(self._hb_ms)
+        # the pool may run from a test/tool cwd where the package is
+        # not importable by default
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        prior = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = (pkg_parent + os.pathsep + prior) if prior \
+            else pkg_parent
+        tp = trace.current_traceparent()
+        if tp:
+            env["BLAZE_TRACEPARENT"] = tp
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "blaze_tpu.runtime.worker", "--serve"],
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            start_new_session=True,
+        )
+        ledger_key = f"pool_worker:{name}:{proc.pid}"
+        ledger.acquire("scoped", ledger_key)
+        w = _Worker(name, proc, ledger_key)
+        t = threading.Thread(target=self._read_loop, args=(w,),
+                             name=f"blaze-pool-read-{name}", daemon=True)
+        w.thread = t
+        t.start()
+        return w
+
+    def _ensure_spawned(self, name: str) -> None:
+        """Spawn a slot's worker if the slot is empty (initial spawn,
+        respawn after a non-blacklisting loss, blacklist re-admission
+        after decay)."""
+        with self._lock:
+            lockset.check(self, "_slots", "_blacklisted", "_closed")
+            if (self._closed or name in self._slots
+                    or name in self._blacklisted):
+                return
+        w = self._spawn(name)  # syscall outside the lock
+        stale = None
+        with self._lock:
+            lockset.check(self, "_slots", "_closed")
+            if self._closed or name in self._slots:
+                stale = w  # lost the race / closing: reap it below
+            else:
+                self._slots[name] = w
+        if stale is not None:
+            terminate_process_group(stale.proc)
+            ledger.release("scoped", stale.ledger_key)
+            if stale.thread is not None:
+                stale.thread.join(timeout=2.0)
+
+    def _read_loop(self, w: _Worker) -> None:
+        """Per-worker reader: every frame stamps liveness; ``done``
+        replies queue for the waiter.  EOF (worker exit, SIGKILL, torn
+        frame at death) publishes a None sentinel so a blocked waiter
+        wakes immediately."""
+        from ..io.ipc_compression import IpcFrameReader
+        from .integrity import BlockCorruptionError
+
+        try:
+            for payload in IpcFrameReader(w.proc.stdout, site="pool.frame"):
+                try:
+                    msg = json.loads(payload.decode())
+                except (UnicodeDecodeError, json.JSONDecodeError):
+                    continue
+                w.last_beat = time.monotonic_ns()
+                t = msg.get("t")
+                if t == "ready":
+                    w.ready = True
+                elif t == "done":
+                    w.replies.put(msg)
+        except (BlockCorruptionError, OSError):
+            # DELIBERATE targeted catch: a SIGKILLed worker tears its
+            # final frame mid-write (checksum mismatch / truncated
+            # stream / closed pipe).  The death itself is reported by
+            # the sentinel below + the waiter's liveness checks —
+            # nothing to salvage here, and it must NOT count as an
+            # error escape during the worker-kill chaos storms.
+            pass
+        w.eof = True
+        w.replies.put(None)
+
+    def close(self) -> None:
+        """Shut the pool down: polite ``shutdown`` frames, bounded
+        waits, then process-group kills.  Releases every slot's ledger
+        entry and joins the reader threads — a closed pool leaves zero
+        ``blaze-pool-*`` threads and zero ledger residue (the chaos
+        leak oracle checks both)."""
+        with self._lock:
+            lockset.check(self, "_slots", "_closed")
+            if self._closed:
+                return
+            self._closed = True
+            slots = list(self._slots.values())
+            self._slots.clear()
+        from ..io.ipc_compression import compress_frame
+        from .integrity import frame_algo
+
+        bye = compress_frame(json.dumps({"t": "shutdown"}).encode(),
+                             codec="raw", checksum_algo=frame_algo())
+        for w in slots:
+            try:
+                w.proc.stdin.write(bye)
+                w.proc.stdin.flush()
+                w.proc.stdin.close()
+            except (BrokenPipeError, OSError):
+                pass
+        for w in slots:
+            try:
+                w.proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                terminate_process_group(w.proc)
+            ledger.release("scoped", w.ledger_key)
+            if w.thread is not None:
+                w.thread.join(timeout=2.0)
+
+    def __enter__(self) -> "HostPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------- placement
+
+    def placement(self, stage_id: int, t: int) -> Optional[str]:
+        """Deterministic round-robin task->worker binding over live,
+        non-blacklisted slots.  Decayed blacklist entries are
+        re-admitted (and respawned) here.  Returns None when every
+        slot is dead or blacklisted — the pool is DEGRADED and the
+        caller executes in-process instead of failing the query."""
+        respawn: List[str] = []
+        newly_degraded = False
+        chosen: Optional[str] = None
+        with self._lock:
+            lockset.check(self, "_slots", "_blacklisted", "_failures",
+                          "_rr", "_degraded", "_closed")
+            if self._closed or not self._names:
+                return None
+            now = time.monotonic()
+            for name in sorted(self._blacklisted):
+                fails = [ts for ts in self._failures.get(name, [])
+                         if now - ts <= self._decay_s]
+                self._failures[name] = fails
+                if len(fails) < self._max_failures:
+                    self._blacklisted.discard(name)  # decayed: re-admit
+            live = [n for n in self._names if n not in self._blacklisted]
+            if not live:
+                if not self._degraded:
+                    self._degraded = True
+                    newly_degraded = True
+            else:
+                self._degraded = False
+                chosen = live[self._rr % len(live)]
+                self._rr += 1
+                respawn = [n for n in live if n not in self._slots]
+        if newly_degraded:
+            from . import dispatch
+
+            dispatch.record("pool_degraded")
+            trace.emit("pool_degraded", stage_id=stage_id, task=t,
+                       reason="all workers dead or blacklisted")
+        for name in respawn:
+            self._ensure_spawned(name)
+        return chosen
+
+    def degraded(self) -> bool:
+        with self._lock:
+            lockset.check(self, "_degraded")
+            return self._degraded
+
+    def heartbeat_ages(self) -> Dict[str, float]:
+        """Heartbeat age (seconds) per live worker — the pool's
+        liveness signal, same shape as ``monitor.heartbeat_ages()``."""
+        now = time.monotonic_ns()
+        with self._lock:
+            lockset.check(self, "_slots")
+            return {n: (now - w.last_beat) / 1e9
+                    for n, w in self._slots.items()}
+
+    # ------------------------------------------------------- bookkeeping
+
+    def note_map_output(self, worker: str, shuffle_id: int,
+                        map_id: int) -> None:
+        """Record that ``worker`` committed map output ``map_id`` of
+        shuffle ``shuffle_id`` — the ownership table a later
+        :class:`WorkerLostError` drains into ``lost_outputs``."""
+        with self._lock:
+            lockset.check(self, "_map_outputs")
+            self._map_outputs.setdefault(worker, {}).setdefault(
+                int(shuffle_id), set()).add(int(map_id))
+
+    def owned_map_outputs(self) -> int:
+        """Total committed map outputs currently owned by live pooled
+        workers (introspection/tests)."""
+        with self._lock:
+            lockset.check(self, "_map_outputs")
+            return sum(len(mids) for per in self._map_outputs.values()
+                       for mids in per.values())
+
+    def lost_counts(self) -> Dict[str, int]:
+        """Decayed failure count per slot (introspection/tests)."""
+        now = time.monotonic()
+        with self._lock:
+            lockset.check(self, "_failures")
+            return {n: len([ts for ts in f if now - ts <= self._decay_s])
+                    for n, f in self._failures.items()}
+
+    def blacklisted(self) -> List[str]:
+        with self._lock:
+            lockset.check(self, "_blacklisted")
+            return sorted(self._blacklisted)
+
+    # ------------------------------------------------------- execution
+
+    def run_task(self, spec: dict, worker: str,
+                 timeout: float = 300.0) -> None:
+        """Run ONE job spec on ``worker`` and wait for its ``done``
+        reply, watching liveness the whole way: nonzero exit, stdout
+        EOF (SIGKILL), or heartbeat silence past
+        ``spark.blaze.pool.livenessTimeoutMs`` raises
+        :class:`WorkerLostError` carrying the slot's committed map
+        outputs.  A FAILED job (worker healthy) re-raises the typed
+        error the worker serialized.  The wait loop is a cooperative
+        cancel checkpoint: a cancelled query kills the bound worker
+        (it cannot see the driver's scope event) without charging the
+        slot a blacklist failure."""
+        from ..io.ipc_compression import compress_frame
+        from .context import current_cancel_scope
+        from .integrity import frame_algo
+
+        with self._lock:
+            lockset.check(self, "_slots", "_job_seq")
+            w = self._slots.get(worker)
+            self._job_seq += 1
+            job_id = self._job_seq
+        if w is None or w.eof or w.proc.poll() is not None:
+            self._worker_lost(worker, "worker dead before dispatch")
+        job = dict(spec, job_id=job_id)
+        frame = compress_frame(json.dumps(job).encode(), codec="raw",
+                               checksum_algo=frame_algo())
+        try:
+            w.proc.stdin.write(frame)
+            w.proc.stdin.flush()
+        except (BrokenPipeError, OSError):
+            self._worker_lost(worker, "stdin pipe broken (worker exited)")
+        scope = current_cancel_scope()
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                reply = w.replies.get(timeout=0.05)
+            except queue.Empty:
+                reply = False  # no frame this tick: run the checks
+            if reply is False:
+                if scope is not None and scope.cancelled:
+                    # driver-initiated kill, not a slot failure
+                    self._kill_for_cancel(worker)
+                    scope.raise_cancelled()
+                rc = w.proc.poll()
+                if rc is not None and w.eof:
+                    self._worker_lost(
+                        worker,
+                        f"killed by signal {-rc}" if rc < 0
+                        else f"exit status {rc}")
+                age_ms = (time.monotonic_ns() - w.last_beat) / 1e6
+                if w.ready and age_ms > self._liveness_ms:
+                    self._worker_lost(
+                        worker, f"heartbeat silent for {age_ms:.0f}ms")
+                if time.monotonic() > deadline:
+                    self._worker_lost(worker, f"job hung past {timeout}s")
+                continue
+            if reply is None:  # reader EOF sentinel
+                rc = w.proc.poll()
+                self._worker_lost(
+                    worker,
+                    f"killed by signal {-rc}" if rc is not None and rc < 0
+                    else f"stdout closed (exit {rc})")
+            if reply.get("job_id") != job_id:
+                continue  # stale reply from an abandoned earlier job
+            break
+        if reply.get("status") == "ok":
+            return
+        raise self._rebuild_error(reply)
+
+    def _rebuild_error(self, reply: dict) -> BaseException:
+        """Reconstruct the TYPED driver-side error from a worker's
+        serialized failure reply — a real ``FetchFailedError`` (with
+        map_ids, so the partial-rerun path engages), the typed cancel
+        error, or the registered retry/fatal wrappers."""
+        et = str(reply.get("error_type") or "Exception")
+        msg = str(reply.get("message") or "")
+        if reply.get("resource_id"):
+            from .retry import FetchFailedError
+
+            return FetchFailedError(
+                str(reply["resource_id"]),
+                partition=int(reply.get("partition", -1)),
+                map_ids=reply.get("map_ids"),
+                cause=WorkerTaskError(et, msg),
+            )
+        if et == "QueryCancelledError":
+            from .context import QueryCancelledError
+
+            return QueryCancelledError(
+                str(reply.get("query_id") or "worker"),
+                reason=str(reply.get("reason") or "cancel"))
+        if reply.get("disposition") == "fatal":
+            return WorkerTaskFatalError(et, msg)
+        return WorkerTaskError(et, msg)
+
+    # ------------------------------------------------------- loss path
+
+    def _kill_for_cancel(self, name: str) -> None:
+        """Cancel checkpoint kill: reap the bound worker WITHOUT
+        charging its slot a blacklist failure (the driver chose to
+        kill it); the slot respawns on the next placement."""
+        with self._lock:
+            lockset.check(self, "_slots", "_map_outputs")
+            w = self._slots.pop(name, None)
+            self._map_outputs.pop(name, None)
+        if w is not None:
+            terminate_process_group(w.proc)
+            ledger.release("scoped", w.ledger_key)
+            if w.thread is not None:
+                w.thread.join(timeout=2.0)
+        from . import dispatch
+
+        dispatch.record("worker_kills")
+
+    def _worker_lost(self, name: str, reason: str) -> None:
+        """Declare a slot's worker DEAD: reap the process, drain its
+        map-output ownership into a :class:`WorkerLostError`, charge
+        the slot one decayed failure, blacklist it at the threshold
+        (else respawn), and raise.  Never returns."""
+        with self._lock:
+            lockset.check(self, "_slots", "_map_outputs", "_failures",
+                          "_blacklisted")
+            w = self._slots.pop(name, None)
+            lost = self._map_outputs.pop(name, {})
+            now = time.monotonic()
+            fails = [ts for ts in self._failures.get(name, [])
+                     if now - ts <= self._decay_s]
+            fails.append(now)
+            self._failures[name] = fails
+            n_fails = len(fails)
+            blacklist = n_fails >= self._max_failures
+            if blacklist:
+                self._blacklisted.add(name)
+        # syscalls, ledger accounting, and emission OUTSIDE the lock
+        if w is not None:
+            terminate_process_group(w.proc)
+            ledger.release("scoped", w.ledger_key)
+            if w.thread is not None:
+                w.thread.join(timeout=2.0)
+        if blacklist:
+            from . import dispatch
+
+            dispatch.record("workers_blacklisted")
+            trace.emit("worker_blacklisted", worker=name,
+                       failures=n_fails, reason=reason)
+        else:
+            self._ensure_spawned(name)
+        raise WorkerLostError(
+            name, reason,
+            lost_outputs={sid: sorted(mids) for sid, mids in lost.items()})

@@ -308,6 +308,7 @@ def run_stages(
     manager: LocalShuffleManager,
     max_task_attempts: Optional[int] = None,
     metrics: Optional[MetricNode] = None,
+    pool=None,
 ):
     """Execute all stages in order over the serde boundary; yields the
     result stage's batches.  Before each stage that reads a shuffle,
@@ -352,11 +353,21 @@ def run_stages(
       back) and retries heartbeat-wedged tasks the cooperative drain
       deadline can never see.
 
+    - **Pooled placement / lost-worker recovery** (``pool``, a
+      :class:`runtime.hostpool.HostPool`): eligible map tasks bind to
+      persistent worker processes round-robin; a worker death
+      (heartbeat silence, nonzero exit, SIGKILL) raises
+      :class:`WorkerLostError` carrying the dead worker's committed
+      map outputs, which regenerate through the SAME partial-rerun
+      path before the interrupted task retries on a survivor — and
+      with every worker dead or blacklisted the stage degrades to
+      in-process execution instead of failing.
+
     Attempt/retry/fetch-failure counters accumulate on ``metrics``
     (default: a fresh node published as ``LAST_RUN_METRICS``):
     ``task_attempts``, ``task_retries``, ``task_timeouts``,
     ``fetch_failures``, ``map_stage_reruns``, ``map_tasks_rerun``,
-    ``speculative_attempts``, ``speculative_won``,
+    ``worker_lost``, ``speculative_attempts``, ``speculative_won``,
     ``speculative_lost``."""
     from ..serde import from_proto
     from ..serde.to_proto import STAGED_RIDS
@@ -526,6 +537,40 @@ def run_stages(
         slept here — the return grows to (attempt, regens, delay_s)
         and the caller schedules the relaunch, so one flaky task's
         backoff never stalls the whole stage's polling loop."""
+        from .hostpool import WorkerLostError
+
+        if isinstance(exc, WorkerLostError) and exc.lost_outputs:
+            # a pooled worker died owning committed map outputs: they
+            # must regenerate NOW through the partial-rerun path —
+            # reduce_blocks silently SKIPS missing index files, so
+            # deferring the invalidation to an eventual fetch would
+            # silently drop the dead worker's rows from every
+            # downstream reduce.  The interrupted task itself then
+            # falls through to its registered RETRY disposition and
+            # re-runs on a survivor (or in-process once the pool
+            # degrades).
+            trace.emit("worker_lost", worker=exc.worker,
+                       reason=exc.reason, stage_id=stage.stage_id,
+                       task=max(t, 0),
+                       lost_maps=sum(len(m)
+                                     for m in exc.lost_outputs.values()))
+            sched_m.add("worker_lost", 1)
+            for sid in sorted(exc.lost_outputs):
+                mstage = map_stage_by_shuffle.get(sid)
+                if mstage is None:
+                    continue
+                regens += 1
+                if regens > policy.max_stage_regens:
+                    raise TaskRetriesExhausted(
+                        stage.stage_id, t, attempt + 1, exc
+                    ) from exc
+                regenerate_map_stage(mstage,
+                                     map_ids=exc.lost_outputs[sid])
+        elif isinstance(exc, WorkerLostError):
+            trace.emit("worker_lost", worker=exc.worker,
+                       reason=exc.reason, stage_id=stage.stage_id,
+                       task=max(t, 0), lost_maps=0)
+            sched_m.add("worker_lost", 1)
         action = classify(exc)
         if action == FETCH_FAILED:
             sched_m.add("fetch_failures", 1)
@@ -653,16 +698,81 @@ def run_stages(
                 manager.sweep_inprogress(stage.shuffle_id, t, attempt)
             raise
 
+    def pool_eligible(stage: Stage) -> bool:
+        """A stage the worker pool may host: map stages whose plans
+        read only the SHARED shuffle root (no broadcast-blob readers —
+        those live in the driver's resources map; driver-staged
+        serialization resources are caught per-build below)."""
+        return (pool is not None and stage.kind == "map"
+                and not ipc_readers(stage.plan, "broadcast_"))
+
+    def pooled_attempt_once(stage: Stage, t: int, attempt: int,
+                            worker: str) -> bool:
+        """ONE attempt of a map task on a POOLED worker.  Returns
+        False when the TaskDefinition cannot ship — building it staged
+        driver-process resources (e.g. a memory-scan plan), which a
+        worker in ANOTHER process can never read — so the caller falls
+        back to the local path.  On success the worker has committed
+        the map output into the shared shuffle root through the same
+        atomic-rename seam as a local attempt, and the pool records
+        the worker's ownership for lost-worker recovery."""
+        staged: List[str] = []
+        token = STAGED_RIDS.set(staged)
+        try:
+            plan_sids = sorted(
+                int(node.resource_id.split("_")[1])
+                for node in ipc_readers(stage.plan, "shuffle_"))
+            spec = worker_task_spec(
+                stage, manager, t, attempt,
+                n_maps={sid: n_maps[sid] for sid in plan_sids})
+        finally:
+            STAGED_RIDS.reset(token)
+        if staged:
+            for key in staged:
+                RESOURCES.discard(key)
+            return False
+        sched_m.add("task_attempts", 1)
+        trace.emit("task_attempt_start", stage_id=stage.stage_id,
+                   task=t, attempt=attempt)
+        try:
+            pool.run_task(spec, worker)
+        except BaseException as exc:
+            trace.emit("task_attempt_end", stage_id=stage.stage_id,
+                       task=t, attempt=attempt, status="failed",
+                       error=f"{type(exc).__name__}: {exc}"[:300])
+            # the dead/failed attempt's staging temps are reclaimed
+            # NOW, exactly like the local rollback path
+            manager.sweep_inprogress(stage.shuffle_id, t, attempt)
+            raise
+        pool.note_map_output(worker, stage.shuffle_id, t)
+        trace.emit("task_attempt_end", stage_id=stage.stage_id,
+                   task=t, attempt=attempt, status="ok")
+        return True
+
     def run_task_attempts(stage: Stage, t: int, register, progress) -> List:
         """One non-result task under the retry policy (the serial
         path); returns its (side-effect-only, usually empty) batch
-        list."""
+        list.  With a worker pool attached, eligible map tasks bind to
+        a pooled worker first (placement-aware binding); a degraded
+        pool (placement None) or an unshippable plan falls back to the
+        in-process path — the query never fails for lack of
+        workers."""
         attempt = 0
         regens = 0
+        can_pool = pool_eligible(stage)
         while True:
             if scope is not None:
                 scope.check(stage.stage_id, t)
             try:
+                if can_pool:
+                    worker = pool.placement(stage.stage_id, t)
+                    if worker is not None:
+                        if pooled_attempt_once(stage, t, attempt, worker):
+                            return []
+                        # unshippable plan: local from here on, same
+                        # attempt id (nothing ran yet)
+                        can_pool = False
+                        continue
                 return attempt_once(stage, t, attempt, register, progress)
             except BaseException as exc:
                 attempt, regens = handle_failure(stage, t, exc, attempt, regens)

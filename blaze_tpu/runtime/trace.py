@@ -74,6 +74,7 @@ EVENT_TYPES = frozenset({
     "task_kernels", "task_plan",
     "stage_progress", "task_heartbeat",
     "fault_injected", "straggler_injected",
+    "worker_lost", "worker_blacklisted", "pool_degraded",
     "oom_recovery",
     "block_corruption", "disk_pressure",
     "mem_watermark", "spill",

@@ -38,6 +38,20 @@ has nobody to serve.
 Used by the multi-process testenv suite (tests/test_testenv.py) — the
 repo's analogue of the reference's ``dev/testenv`` pseudo-distributed
 sandbox (SURVEY §4 tier 3).
+
+Pooled mode: ``python -m blaze_tpu.runtime.worker --serve`` turns the
+one-shot worker into a LONG-LIVED pool member (runtime/hostpool.py is
+the driver half).  Job specs arrive as checksummed IPC frames (the
+PR 13 wire format: ``io/ipc_compression.py`` raw-codec frames with the
+per-frame trailer) carrying JSON on stdin; replies — ``ready``,
+periodic ``hb`` heartbeats at ``spark.blaze.pool.heartbeatMs``, and
+per-job ``done`` records — go back the same way on stdout.  A failed
+job serializes its TYPED identity (class name, ``retry.classify``
+disposition, and FetchFailedError's resource/map-id fields) so the
+driver reconstructs a real typed error instead of guessing from an
+exit status; the process keeps serving.  fd 1 is re-pointed at stderr
+once the protocol stream is claimed, so stray library prints can never
+corrupt the frame stream.
 """
 
 from __future__ import annotations
@@ -48,7 +62,10 @@ import struct
 import sys
 
 
-def main(spec_path: str) -> int:
+def _configure_worker_process() -> None:
+    """One-time worker-process setup shared by the one-shot and
+    ``--serve`` modes: JAX platform config, live-monitor disarm, and
+    trace-context restore from ``BLAZE_TRACEPARENT``."""
     import os
 
     import jax
@@ -61,11 +78,7 @@ def main(spec_path: str) -> int:
     )
     jax.config.update("jax_enable_x64", True)
 
-    from ..io.batch_serde import serialize_batch
-    from ..parallel.shuffle import LocalShuffleManager
-    from ..serde.from_proto import run_task
     from . import monitor
-    from .context import RESOURCES
 
     # one process = one task attempt: the DRIVER owns the live monitor
     # (registry + /metrics server); a task subprocess inheriting
@@ -79,85 +92,282 @@ def main(spec_path: str) -> int:
     conf.MONITOR_ENABLE.set(False)
     monitor.reset()
 
-    with open(spec_path) as f:
-        spec = json.load(f)
-    partition = int(spec["partition"])
-    attempt = int(spec.get("attempt", 0))
     # cross-process trace-context propagation: the driver's W3C
-    # traceparent (spec key, or BLAZE_TRACEPARENT in the environment —
-    # run_worker_with_retry sets it) restores the SAME trace id in this
-    # subprocess, so the heartbeat/kernel events landing in the
-    # worker's own event log reconcile with the driver's segments into
-    # one distributed trace (trace_report.merge_event_logs, the OTLP
-    # export).  A malformed value degrades to an uncorrelated log,
-    # never a dead worker.
+    # traceparent (BLAZE_TRACEPARENT — run_worker_with_retry and the
+    # host pool set it; a job spec's own key wins later) restores the
+    # SAME trace id in this subprocess, so the heartbeat/kernel events
+    # landing in the worker's own event log reconcile with the
+    # driver's segments into one distributed trace
+    # (trace_report.merge_event_logs, the OTLP export).  A malformed
+    # value degrades to an uncorrelated log, never a dead worker.
     from . import trace
 
-    tp = str(spec.get("traceparent")
-             or os.environ.get("BLAZE_TRACEPARENT", "") or "")
+    tp = str(os.environ.get("BLAZE_TRACEPARENT", "") or "")
     ctx = trace.parse_traceparent(tp) if tp else None
     if ctx is not None:
         trace.set_trace_context(*ctx)
+
+
+def _execute_spec(spec: dict) -> None:
+    """Run ONE job spec to completion in this process: register the
+    reduce-block readers, decode the TaskDefinition, drive the plan,
+    and (result stages) commit the output frames by atomic rename.
+    Shared by the one-shot :func:`main` and the pooled :func:`serve`
+    loop.  The ``worker.task`` fault site is probed at job start and
+    per output batch — the ``@kill`` modifier's home turf."""
+    import os
+
+    from ..io.batch_serde import serialize_batch
+    from ..parallel.shuffle import LocalShuffleManager
+    from ..serde.from_proto import run_task
+    from . import faults
+    from .context import RESOURCES, current_cancel_scope
+
+    partition = int(spec["partition"])
+    attempt = int(spec.get("attempt", 0))
+    tp = str(spec.get("traceparent") or "")
+    if tp:
+        from . import trace
+
+        ctx = trace.parse_traceparent(tp)
+        if ctx is not None:
+            trace.set_trace_context(*ctx)
+    faults.hit("worker.task", attempt=attempt, detail=f"p{partition}")
+    staged_keys = []
     if spec.get("readers"):
         mgr = LocalShuffleManager(spec["shuffle_root"])
         for r in spec["readers"]:
+            key = f"{r['resource_id']}.{partition}"
             RESOURCES.put(
-                f"{r['resource_id']}.{partition}",
+                key,
                 mgr.reduce_blocks(int(r["shuffle_id"]), int(r["n_maps"]), partition),
             )
+            staged_keys.append(key)
     td = base64.b64decode(spec["task_def"])
     out_path = spec.get("output")
-    if out_path:
-        # write-then-rename: a crashed attempt leaves no final file,
-        # so the driver's partial-output detection is just existence.
-        # Frames are standard checksummed IPC frames (codec raw +
-        # per-frame trailer, conf spark.blaze.io.checksum) closed by a
-        # block trailer, so the DRIVER verifies the committed bytes
-        # (verify_result_file) before trusting them — rename alone
-        # proves completeness, not integrity.
-        from . import faults, integrity
-        from ..io.ipc_compression import block_trailer, compress_frame
+    try:
+        if out_path:
+            # write-then-rename: a crashed attempt leaves no final
+            # file, so the driver's partial-output detection is just
+            # existence.  Frames are standard checksummed IPC frames
+            # (codec raw + per-frame trailer, conf
+            # spark.blaze.io.checksum) closed by a block trailer, so
+            # the DRIVER verifies the committed bytes
+            # (verify_result_file) before trusting them — rename alone
+            # proves completeness, not integrity.
+            from . import integrity
+            from ..io.ipc_compression import block_trailer, compress_frame
 
-        algo = integrity.frame_algo()
-        # ATTEMPT-QUALIFIED temp (the shuffle writers' contract, was a
-        # bare .inprogress): a wedge-respawned attempt racing a
-        # not-yet-dead predecessor process no longer interleaves writes
-        # into ONE shared temp — with checksums off that interleaving
-        # committed silently torn frames.  Surfaced by the commit.guard
-        # / resource-ledger audit (analysis/errflow.py).
-        tmp = out_path + f".inprogress.a{attempt}"
-        count = 0
-        xor = 0
-        try:
-            with open(tmp, "wb") as f:
-                for batch in run_task(td, task_attempt_id=attempt):
-                    frame = compress_frame(serialize_batch(batch),
-                                           codec="raw", checksum_algo=algo)
-                    if algo is not None:
-                        xor ^= struct.unpack("<BI", frame[-5:])[1]
-                    f.write(frame)
-                    count += 1
-                if algo is not None:
-                    f.write(block_trailer(count, xor, algo))
-        except BaseException:
-            # a failed attempt's temp used to survive until the
-            # age-gated orphan sweep (resource.path-leak class): the
-            # driver only checks the FINAL path, so unlink the staging
-            # debris before the nonzero exit propagates
+            algo = integrity.frame_algo()
+            # ATTEMPT-QUALIFIED temp (the shuffle writers' contract,
+            # was a bare .inprogress): a wedge-respawned attempt racing
+            # a not-yet-dead predecessor process no longer interleaves
+            # writes into ONE shared temp — with checksums off that
+            # interleaving committed silently torn frames.  Surfaced by
+            # the commit.guard / resource-ledger audit
+            # (analysis/errflow.py).
+            tmp = out_path + f".inprogress.a{attempt}"
+            count = 0
+            xor = 0
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-        if faults.corrupt("worker.result", attempt=attempt,
-                          detail=out_path):
-            # @corrupt: post-write bit-rot on the committed result —
-            # the driver's verification, not this worker, must catch it
-            integrity.flip_byte_in_file(tmp)
-        os.replace(tmp, out_path)
-    else:
-        for _ in run_task(td, task_attempt_id=attempt):
+                with open(tmp, "wb") as f:
+                    for batch in run_task(td, task_attempt_id=attempt):
+                        faults.hit("worker.task", attempt=attempt,
+                                   detail=f"p{partition}#batch")
+                        frame = compress_frame(serialize_batch(batch),
+                                               codec="raw",
+                                               checksum_algo=algo)
+                        if algo is not None:
+                            xor ^= struct.unpack("<BI", frame[-5:])[1]
+                        f.write(frame)
+                        count += 1
+                    if algo is not None:
+                        f.write(block_trailer(count, xor, algo))
+            except BaseException:
+                # a failed attempt's temp used to survive until the
+                # age-gated orphan sweep (resource.path-leak class):
+                # the driver only checks the FINAL path, so unlink the
+                # staging debris before the failure propagates
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            if faults.corrupt("worker.result", attempt=attempt,
+                              detail=out_path):
+                # @corrupt: post-write bit-rot on the committed result
+                # — the driver's verification, not this worker, must
+                # catch it
+                integrity.flip_byte_in_file(tmp)
+            # commit guard: a cancel landing between the drain loop and
+            # the rename must not promote the loser's temp over output
+            # a winner may re-commit — raise here and the BaseException
+            # arm below unlinks the staging debris instead.  In a
+            # subprocess the ambient scope is absent (the driver kills
+            # the process group at its own cancel checkpoint); this
+            # covers in-process callers and keeps the rename behind a
+            # cancellation check.
+            scope = current_cancel_scope()
+            if scope is not None:
+                scope.raise_cancelled()
+            os.replace(tmp, out_path)
+        else:
+            for _ in run_task(td, task_attempt_id=attempt):
+                faults.hit("worker.task", attempt=attempt,
+                           detail=f"p{partition}#batch")
+    except BaseException:
+        # a failed job must not leave its reader registrations staged:
+        # a long-lived serve worker re-registers the same keys on the
+        # retried job (RESOURCES.get pops, so only the FAILED path
+        # leaks them)
+        for key in staged_keys:
+            RESOURCES.discard(key)
+        raise
+
+
+def _describe_error(exc: BaseException) -> dict:
+    """Serialize a job failure's TYPED identity for the driver: class
+    name, ``retry.classify`` disposition, message, and — for
+    ``FetchFailedError`` — the resource/partition/map-id fields the
+    partial-rerun path needs to rebuild a REAL fetch failure on the
+    driver side.  ``QueryCancelledError`` carries its query id/reason
+    so a cancelled worker job round-trips as the same terminal error."""
+    from .context import QueryCancelledError
+    from .retry import FetchFailedError, classify
+
+    d = {
+        "error_type": type(exc).__name__,
+        "disposition": classify(exc),
+        "message": str(exc)[:500],
+    }
+    if isinstance(exc, FetchFailedError):
+        d["resource_id"] = exc.resource_id
+        d["partition"] = exc.partition
+        if exc.map_ids is not None:
+            d["map_ids"] = list(exc.map_ids)
+    if isinstance(exc, QueryCancelledError):
+        d["query_id"] = exc.query_id
+        d["reason"] = getattr(exc, "reason", "cancel")
+    return d
+
+
+def exit_record_path(spec_path: str) -> str:
+    return spec_path + ".exit.json"
+
+
+def _write_exit_record(spec_path: str, exc: BaseException) -> None:
+    """Persist the one-shot worker's typed failure next to its spec so
+    the driver (:func:`run_worker_with_retry`) can route the exit
+    through ``retry.classify`` instead of blindly re-spawning — the
+    FATAL-respawn fix: a ``QueryCancelledError`` serialized back from
+    the worker must not burn retry attempts resurrecting a cancelled
+    query.  Write-then-rename so the driver never reads a torn
+    record; best-effort (a worker that cannot write still exits
+    nonzero and the driver falls back to exit-status classing)."""
+    import os
+
+    tmp = exit_record_path(spec_path) + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(_describe_error(exc), f)
+        os.replace(tmp, exit_record_path(spec_path))
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
             pass
+
+
+def read_exit_record(spec_path: str) -> dict | None:
+    """Driver side: the worker's typed exit record, or None when the
+    worker died without writing one (SIGKILL, crash before the except
+    handler)."""
+    try:
+        with open(exit_record_path(spec_path)) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def main(spec_path: str) -> int:
+    _configure_worker_process()
+    with open(spec_path) as f:
+        spec = json.load(f)
+    try:
+        _execute_spec(spec)
+    except BaseException as e:
+        _write_exit_record(spec_path, e)
+        raise
+    return 0
+
+
+def serve() -> int:
+    """Long-lived pooled-worker loop (driven by runtime/hostpool.py):
+    read framed JSON job specs from stdin, execute each via
+    :func:`_execute_spec`, and reply with framed JSON ``done`` records
+    — a failed job serializes its typed identity and the process KEEPS
+    SERVING.  A daemon heartbeat thread emits ``hb`` frames every
+    ``spark.blaze.pool.heartbeatMs`` so the driver's liveness layer
+    distinguishes a busy worker from a dead one.  EOF on stdin (or a
+    ``shutdown`` message) ends the loop."""
+    import os
+    import threading
+
+    _configure_worker_process()
+
+    from .. import conf
+    from ..io.ipc_compression import IpcFrameReader, compress_frame
+    from . import integrity
+
+    # claim the REAL stdout fd for the framed protocol and re-point
+    # fd 1 at stderr: a stray print from any library would otherwise
+    # land mid-frame and corrupt the stream
+    proto = os.fdopen(os.dup(1), "wb", buffering=0)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    algo = integrity.frame_algo()
+    wlock = threading.Lock()
+
+    def send(obj: dict) -> None:
+        frame = compress_frame(json.dumps(obj).encode(), codec="raw",
+                               checksum_algo=algo)
+        with wlock:
+            proto.write(frame)
+
+    hb_s = max(0.005, int(conf.POOL_HEARTBEAT_MS.get()) / 1000.0)
+    stop = threading.Event()
+
+    def _beat() -> None:
+        while not stop.wait(hb_s):
+            try:
+                send({"t": "hb", "pid": os.getpid()})
+            except OSError:
+                return  # driver went away; the job loop sees EOF too
+
+    threading.Thread(target=_beat, daemon=True,
+                     name=f"blaze-pool-beat-{os.getpid()}").start()
+    send({"t": "ready", "pid": os.getpid()})
+    try:
+        for payload in IpcFrameReader(sys.stdin.buffer, site="pool.frame"):
+            msg = json.loads(payload.decode())
+            if msg.get("t") == "shutdown":
+                break
+            job_id = msg.get("job_id")
+            try:
+                _execute_spec(msg)
+            except BaseException as e:
+                reply = {"t": "done", "job_id": job_id, "status": "error"}
+                reply.update(_describe_error(e))
+                send(reply)
+                if isinstance(e, (KeyboardInterrupt, SystemExit,
+                                  GeneratorExit)):
+                    raise
+            else:
+                send({"t": "done", "job_id": job_id, "status": "ok"})
+    finally:
+        stop.set()
     return 0
 
 
@@ -200,18 +410,38 @@ def run_worker_with_retry(
     """Driver-side fault-tolerant worker launch (the testenv analogue
     of the in-process scheduler's task retry loop).
 
-    Spawns ``python -m blaze_tpu.runtime.worker`` on ``spec`` and
-    re-attempts — with a fresh attempt id in the spec, so attempt-gated
-    fault schedules and TaskContext attempt ids stay truthful — when
-    the process exits nonzero OR the promised output file is missing
-    (a worker killed before the atomic rename).  Raises
-    ``TaskRetriesExhausted`` after the budget, naming the last exit
-    status.  Returns the completed attempt number.
+    Spawns ``python -m blaze_tpu.runtime.worker`` on ``spec`` (in its
+    OWN process group) and re-attempts — with a fresh attempt id in the
+    spec, so attempt-gated fault schedules and TaskContext attempt ids
+    stay truthful — when the process exits nonzero OR the promised
+    output file is missing (a worker killed before the atomic rename).
+    Raises ``TaskRetriesExhausted`` after the budget, naming the last
+    exit status.  Returns the completed attempt number.
+
+    Cancellation: the poll loop is a cooperative checkpoint on the
+    ambient :class:`CancelScope` — a cancelled query TERMINATES the
+    worker's process group (SIGTERM, then SIGKILL), sweeps its
+    ``.inprogress.a<N>`` staging temp, accounts the kill
+    (``worker_kills`` dispatch counter + resource ledger), and raises
+    the typed cancel error.  Previously the driver blocked in
+    ``subprocess.run`` and a cancelled query's worker computed to
+    completion.
+
+    Typed exits: a worker that fails CLEANLY writes a
+    ``<spec>.exit.json`` record (class name + ``retry.classify``
+    disposition); a FATAL-classified record (e.g. a
+    ``QueryCancelledError`` serialized back from the worker) raises
+    immediately instead of burning the retry budget re-running a
+    deterministic terminal failure.
     """
+    import glob
     import os
     import subprocess
+    import time as _time
 
-    from .retry import RetryPolicy, TaskRetriesExhausted
+    from . import dispatch, ledger, trace
+    from .context import QueryCancelledError, current_cancel_scope
+    from .retry import FATAL, RetryPolicy, TaskRetriesExhausted
 
     policy = RetryPolicy.from_conf()
     if max_attempts is not None:
@@ -223,11 +453,23 @@ def run_worker_with_retry(
     # thread the driver's trace context into the worker (spec key wins,
     # then the driver's ambient traced-query span) so every attempt's
     # subprocess events carry the same trace id
-    from . import trace
-
     tp = str(spec.get("traceparent") or "") or trace.current_traceparent()
     if tp:
         run_env.setdefault("BLAZE_TRACEPARENT", tp)
+
+    out_path = spec.get("output")
+
+    def _sweep_inprogress() -> None:
+        # a KILLED worker (cancel, timeout, OOM kill) could not run its
+        # own temp cleanup: sweep the attempt's .inprogress staging
+        # debris driver-side (the worker-side unlink covers clean
+        # failures; this covers the crash edge)
+        if out_path:
+            for stale in glob.glob(out_path + ".inprogress*"):
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
 
     last_failure: Exception | None = None
     for attempt in range(policy.max_attempts):
@@ -236,29 +478,59 @@ def run_worker_with_retry(
         with open(spec_path, "w") as f:
             json.dump(spec_attempt, f)
         stderr_tail = ""
+        reason = None
+        scope = current_cancel_scope()
+        # start_new_session: the worker leads its own process group so
+        # a cancel kills it AND any children it spawned in one signal
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "blaze_tpu.runtime.worker", spec_path],
+            env=run_env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            start_new_session=True,
+        )
+        proc_key = f"worker_proc:{tag}:a{attempt}"
+        ledger.acquire("scoped", proc_key)
+        deadline = _time.monotonic() + timeout
         try:
-            proc = subprocess.run(
-                [sys.executable, "-m", "blaze_tpu.runtime.worker", spec_path],
-                env=run_env,
-                capture_output=True,
-                timeout=timeout,
-            )
-        except subprocess.TimeoutExpired as te:
-            # a wedged worker is killed by subprocess.run; treat it as
-            # one failed attempt like any crash
-            reason = f"hung past {timeout}s and was killed"
-            if te.stderr:
-                stderr_tail = te.stderr.decode(errors="replace")[-500:]
-        else:
-            out_path = spec.get("output")
-            if proc.returncode == 0 and (not out_path or os.path.exists(out_path)):
+            while True:
+                try:
+                    # communicate (not wait) drains the pipes, so a
+                    # chatty worker can never deadlock on a full pipe
+                    _, stderr_b = proc.communicate(timeout=0.05)
+                    stderr_tail = (stderr_b or b"").decode(
+                        errors="replace")[-500:]
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+                if scope is not None and scope.cancelled:
+                    # the cancel checkpoint: the subprocess cannot see
+                    # the driver's scope event, so reach it by signal
+                    terminate_process_group(proc)
+                    proc.communicate()
+                    _sweep_inprogress()
+                    dispatch.record("worker_kills")
+                    scope.raise_cancelled()
+                if _time.monotonic() > deadline:
+                    # a wedged worker: kill the group, count one
+                    # failed attempt like any crash
+                    terminate_process_group(proc)
+                    _, stderr_b = proc.communicate()
+                    stderr_tail = (stderr_b or b"").decode(
+                        errors="replace")[-500:]
+                    reason = f"hung past {timeout}s and was killed"
+                    break
+        finally:
+            ledger.release("scoped", proc_key)
+        if reason is None:
+            if proc.returncode == 0 and (not out_path
+                                         or os.path.exists(out_path)):
                 if not out_path:
                     return attempt
                 # the committed file exists — but rename proves only
                 # COMPLETENESS.  Verify the bytes (per-frame checksums
                 # + block trailer) before trusting them: a corrupt
                 # result is a failed attempt, not a silent wrong answer
-                from . import dispatch, trace
                 from .integrity import BlockCorruptionError
 
                 try:
@@ -281,23 +553,27 @@ def run_worker_with_retry(
                     if proc.returncode != 0
                     else "worker exited 0 but produced no committed output"
                 )
-                stderr_tail = proc.stderr.decode(errors="replace")[-500:]
+                if proc.returncode != 0:
+                    # route the worker's TYPED exit through the
+                    # classifier before deciding to re-spawn: a
+                    # FATAL-classified failure re-runs deterministically
+                    # and must propagate, not retry
+                    rec = read_exit_record(spec_path)
+                    if rec and rec.get("disposition") == FATAL:
+                        _sweep_inprogress()
+                        if rec.get("error_type") == "QueryCancelledError":
+                            raise QueryCancelledError(
+                                str(rec.get("query_id") or "worker"),
+                                reason=str(rec.get("reason") or "cancel"))
+                        from .hostpool import WorkerTaskFatalError
+
+                        raise WorkerTaskFatalError(
+                            str(rec.get("error_type") or "Exception"),
+                            str(rec.get("message") or ""))
         last_failure = RuntimeError(
             f"worker attempt {attempt} failed ({reason}): " + stderr_tail
         )
-        # a KILLED worker (timeout, OOM kill) could not run its own
-        # temp cleanup: sweep the attempt's .inprogress staging debris
-        # driver-side before the next attempt (the worker-side unlink
-        # covers clean failures; this covers the crash edge)
-        out_path = spec.get("output")
-        if out_path:
-            import glob
-
-            for stale in glob.glob(out_path + ".inprogress*"):
-                try:
-                    os.unlink(stale)
-                except OSError:
-                    pass
+        _sweep_inprogress()
         if attempt + 1 < policy.max_attempts:  # no sleep after the last one
             policy.sleep_before_retry(0, int(spec.get("partition", 0)), attempt)
     raise TaskRetriesExhausted(
@@ -306,5 +582,35 @@ def run_worker_with_retry(
     )
 
 
+def terminate_process_group(proc) -> None:
+    """Terminate a worker subprocess and everything in its process
+    group: SIGTERM first (a clean shutdown window), escalate to
+    SIGKILL if the group is still alive half a second later.  Safe on
+    an already-dead process."""
+    import os
+    import signal
+    import subprocess
+
+    try:
+        pgid = os.getpgid(proc.pid)
+    except (OSError, ProcessLookupError):
+        pgid = None
+    for sig in (signal.SIGTERM, signal.SIGKILL):
+        try:
+            if pgid is not None:
+                os.killpg(pgid, sig)
+            else:
+                proc.send_signal(sig)
+        except (OSError, ProcessLookupError):
+            return
+        try:
+            proc.wait(timeout=0.5)
+            return
+        except subprocess.TimeoutExpired:
+            continue
+
+
 if __name__ == "__main__":
+    if sys.argv[1:] and sys.argv[1] == "--serve":
+        sys.exit(serve())
     sys.exit(main(sys.argv[1]))

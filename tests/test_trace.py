@@ -328,6 +328,12 @@ def _synthetic_events():
         ("straggler_injected", {"site": "shuffle.write", "hit": 1,
                                 "attempt": 0, "slow_ms": 400,
                                 "detail": "/tmp/x.data"}),
+        ("worker_lost", {"worker": "w0", "reason": "killed by signal 9",
+                         "stage_id": 0, "task": 2, "lost_maps": 1}),
+        ("worker_blacklisted", {"worker": "w0", "failures": 2,
+                                "reason": "heartbeat silent for 1200ms"}),
+        ("pool_degraded", {"reason": "all workers dead or blacklisted",
+                           "stage_id": 0, "task": 2}),
         ("block_corruption", {"site": "shuffle.fetch",
                               "resource": "shuffle_0",
                               "path": "/tmp/shuffle_0_1.data",
