@@ -244,8 +244,29 @@ def _warmup(suite: str, names, scale: float, n_parts: int,
             rows += b.num_rows
         return rows
 
+    from .runtime import querycache
+
     failed = []
+    unstable = []
+    digests = set()
+    approx = 0
     for name in names:
+        # plan-cache prewarm + fingerprint-stability gate: fingerprint
+        # the plan across two INDEPENDENT builds — the serving path
+        # keys program reuse and result caching on this digest, so a
+        # build-to-build wobble (iteration-order leak, id() in a key)
+        # would make both cache levels silently useless
+        fps = [querycache.plan_fingerprint(
+            optimize_plan(build_query(name, scans, n_parts)))
+            for _ in range(2)]
+        a, b = fps
+        if (a is None) != (b is None) or (
+                a is not None and (a.digest != b.digest
+                                   or a.exact != b.exact)):
+            unstable.append(name)
+        elif a is not None:
+            digests.add(a.digest)
+            approx += 0 if a.exact else 1
         for path, run in (("in-process", run_once),
                           ("scheduler", run_scheduler_once)):
             t0 = time.perf_counter()
@@ -255,14 +276,23 @@ def _warmup(suite: str, names, scale: float, n_parts: int,
                 run(name)
             dt = time.perf_counter() - t0
             ok = warm.get("xla_compiles", 0) == 0
+            fp_tag = "" if a is None else f" fp={a.digest[:12]}"
             print(f"warmup {suite} {name} [{path}]: "
                   f"cold compiles={cold.get('xla_compiles', 0)} "
                   f"({cold.get('compile_ms', 0)} ms), warm "
                   f"dispatches={warm.get('xla_dispatches', 0)} "
-                  f"compiles={warm.get('xla_compiles', 0)} [{dt:.2f}s]"
+                  f"compiles={warm.get('xla_compiles', 0)}{fp_tag} "
+                  f"[{dt:.2f}s]"
                   + ("" if ok else "  <-- RECOMPILED ON WARM RUN"))
             if not ok:
                 failed.append(f"{name}[{path}]")
+    print(f"# warmup: plan cache primed: {len(digests)} distinct "
+          f"fingerprints ({approx} approximate), "
+          f"{querycache.plan_cache_stats()['distinct_plans']} plans seen")
+    if unstable:
+        print(f"# warmup: UNSTABLE fingerprints (digest differs across "
+              f"two builds): {', '.join(unstable)}", file=sys.stderr)
+        return 1
     if failed:
         print(f"# warmup: warm-run recompiles in: {', '.join(failed)}",
               file=sys.stderr)
@@ -1703,6 +1733,263 @@ def _run_worker_kill_storm(suite, seed) -> int:
     return 0
 
 
+def _run_cache_storm(suite, names, scans, build_query, n_parts,
+                     seed) -> int:
+    """Cache-storm chaos arm: concurrent IDENTICAL and literal-SHIFTED
+    submissions against one serving table, with a seeded mid-storm
+    source mutation racing the second wave — asserting the result
+    cache's integrity contract end to end: every completed query is
+    byte-identical to an UNCACHED baseline for some epoch the query
+    could have observed, post-mutation queries never see pre-mutation
+    rows, every admission resolves as exactly one result-cache hit or
+    miss (hits + misses == submissions), hits never take a lease turn,
+    and nothing leaks.  Lockset + lock-order + error-escape checkers
+    are armed for the whole arm; the shared leak oracle sweeps after.
+
+    The arm builds its own MemoryScan-backed table (the suite scans
+    are shared across seeds and must not be mutated); the suite args
+    are accepted for wiring symmetry with the other storm arms."""
+    import glob
+    import os
+    import random
+    import tempfile
+    import threading
+
+    from . import conf
+    from .analysis import locks as lock_verify
+    from .batch import batch_from_pydict, batch_to_pydict
+    from .exprs import col, lit
+    from .ops.filter import FilterExec
+    from .ops.memory_scan import MemoryScanExec
+    from .ops.project import ProjectExec
+    from .runtime import (dispatch, errors, ledger, lockset, monitor,
+                          querycache, service)
+    from .schema import DataType, Field, Schema
+
+    schema = Schema([Field("k", DataType.int64()),
+                     Field("v", DataType.float64())])
+    rng = random.Random(seed * 92821 + 11)
+    knobs = (conf.SERVICE_MAX_CONCURRENT, conf.SERVICE_MAX_QUEUED,
+             conf.SERVICE_QUEUE_TIMEOUT_MS, conf.MONITOR_ENABLE)
+    prev = [k.get() for k in knobs]
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    conf.VERIFY_LOCKSET.set(True)
+    lockset.refresh()
+    lockset.reset()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
+    problems = []
+    svc = None
+    shuffle_glob = os.path.join(tempfile.gettempdir(), "blaze_shuffle_*")
+    spills_before = set(glob.glob(ledger.spill_glob()))
+    roots_before = set(glob.glob(shuffle_glob))
+    n_subs = 0
+    n_hits = n_misses = 0
+    try:
+        try:
+            querycache.reset_for_tests()
+            # one serving table, two partitions — the mutation appends
+            # to a SINGLE seeded partition, so a racing scan observes
+            # either the old or the new table, never a torn mixture
+            n_rows = 400
+            half = n_rows // 2
+            table = MemoryScanExec([
+                [batch_from_pydict({
+                    "k": list(range(p * half, p * half + half)),
+                    "v": [rng.uniform(0.0, 10.0) for _ in range(half)],
+                }, schema)] for p in range(2)])
+
+            def build_plan(thresh):
+                f = FilterExec(table, col("v") > lit(float(thresh)))
+                return ProjectExec(f, [col("k"), col("v") * lit(2.0)],
+                                   ["k", "v2"])
+
+            # identical + literal-shifted: two slot values, each
+            # submitted repeatedly — same fingerprint digest, distinct
+            # result-cache keys
+            threshes = (2.0, 7.0)
+            base_old = {t: _rows_via_scheduler(build_plan(t))
+                        for t in threshes}
+            conf.SERVICE_MAX_CONCURRENT.set(2)
+            conf.SERVICE_MAX_QUEUED.set(32)
+            conf.SERVICE_QUEUE_TIMEOUT_MS.set(0)
+            conf.MONITOR_ENABLE.set(True)
+            monitor.reset()
+            svc = service.QueryService().start()
+            c0 = dict(dispatch.counters())
+
+            def rows_of(batches):
+                cols = None
+                for b in batches:
+                    d = batch_to_pydict(b)
+                    if cols is None:
+                        cols = {c: [] for c in d}
+                    for c, vals in d.items():
+                        cols[c].extend(vals)
+                if cols is None:
+                    return []
+                ns = sorted(cols)
+                return sorted(zip(*[cols[c] for c in ns])) if ns else []
+
+            def submit_wave(tag, mutate_at=None):
+                """One concurrent burst: 3 identical submissions per
+                slot value, rng-shuffled; optionally fire the source
+                mutation from a seeded delay mid-wave."""
+                order = [t for t in threshes for _ in range(3)]
+                rng.shuffle(order)
+                handles = [None] * len(order)
+
+                def submitter(i, t):
+                    handles[i] = svc.submit(
+                        f"cache-{tag}-{i}",
+                        build=lambda _t=t: build_plan(_t))
+
+                ts = [threading.Thread(target=submitter, args=(i, t),
+                                       name=f"blaze-cache-submit-{i}",
+                                       daemon=True)
+                      for i, t in enumerate(order)]
+                mut = None
+                if mutate_at is not None:
+                    part = rng.randrange(2)
+
+                    def mutator():
+                        time.sleep(mutate_at)
+                        table.append(part, batch_from_pydict(
+                            {"k": [n_rows, n_rows + 1],
+                             "v": [9.5, 9.75]}, schema))
+                    mut = threading.Thread(target=mutator,
+                                           name="blaze-cache-mutator",
+                                           daemon=True)
+                    mut.start()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(30)
+                if mut is not None:
+                    mut.join(30)
+                return list(zip(order, handles))
+
+            def drain(pairs, allowed_by_thresh, tag):
+                for t, h in pairs:
+                    if h is None:
+                        problems.append(f"{tag}: a submitter never "
+                                        f"resolved (thresh {t})")
+                        continue
+                    try:
+                        got = rows_of(h.result(timeout=120))
+                    except Exception as e:  # noqa: BLE001 — judged here
+                        problems.append(f"{tag} {h.exec_id}: "
+                                        f"{type(e).__name__}: {e}")
+                        continue
+                    if got not in allowed_by_thresh[t]:
+                        problems.append(
+                            f"{tag} {h.exec_id}: rows diverge from every "
+                            f"admissible uncached baseline for thresh {t} "
+                            f"({len(got)} rows)")
+
+            # wave 1: all against the epoch-0 table — exact baseline
+            w1 = submit_wave("w1")
+            n_subs += len(w1)
+            drain(w1, {t: (base_old[t],) for t in threshes}, "wave1")
+            # sequential repeats: entries are resident now, so these
+            # MUST be result-cache hits served with zero lease turns
+            hits_before = dict(dispatch.counters()).get(
+                "result_cache_hits", 0)
+            for t in threshes:
+                h = svc.submit(f"cache-repeat-{t}",
+                               build=lambda _t=t: build_plan(_t))
+                n_subs += 1
+                got = rows_of(h.result(timeout=120))
+                if got != base_old[t]:
+                    problems.append(f"repeat thresh {t}: cached rows "
+                                    f"diverge from uncached baseline")
+            hits_now = dict(dispatch.counters()).get(
+                "result_cache_hits", 0)
+            if hits_now - hits_before != len(threshes):
+                problems.append(
+                    f"warm identical repeats produced "
+                    f"{hits_now - hits_before} result-cache hits "
+                    f"(expected {len(threshes)})")
+            # wave 2: the seeded mutation races the burst — a query
+            # may observe either epoch, but must match ONE of them
+            w2 = submit_wave("w2", mutate_at=rng.uniform(0.0, 0.05))
+            n_subs += len(w2)
+            base_new = {t: _rows_via_scheduler(build_plan(t))
+                        for t in threshes}
+            drain(w2, {t: (base_old[t], base_new[t]) for t in threshes},
+                  "wave2")
+            # post-mutation queries must NEVER see pre-mutation rows:
+            # the appended keys are filter-visible at both slot values
+            for t in threshes:
+                h = svc.submit(f"cache-post-{t}",
+                               build=lambda _t=t: build_plan(_t))
+                n_subs += 1
+                got = rows_of(h.result(timeout=120))
+                if got != base_new[t]:
+                    problems.append(
+                        f"STALE RESULT: post-mutation thresh {t} served "
+                        f"{len(got)} rows != epoch-{table.epoch} "
+                        f"baseline {len(base_new[t])}")
+            cf = dict(dispatch.counters())
+            n_hits = cf.get("result_cache_hits", 0) \
+                - c0.get("result_cache_hits", 0)
+            n_misses = cf.get("result_cache_misses", 0) \
+                - c0.get("result_cache_misses", 0)
+            if n_hits + n_misses != n_subs:
+                problems.append(
+                    f"cache accounting leak: {n_hits} hits + {n_misses} "
+                    f"misses != {n_subs} submissions")
+            if cf.get("result_cache_invalidations", 0) \
+                    <= c0.get("result_cache_invalidations", 0):
+                problems.append("the source mutation never invalidated "
+                                "a cached result")
+            turns = svc.stats()["counters"].get("cache_hit_lease_turns", 0)
+            if turns:
+                problems.append(f"cache hits took {turns} fair-share "
+                                f"lease turn(s) (must be served "
+                                f"off-device, before admission)")
+            races = lockset.reported()
+            if races:
+                problems.append("lockset violation(s): " + "; ".join(races))
+            escaped = errors.escapes()
+            if escaped:
+                problems.append("FATAL-class error escape(s): "
+                                + "; ".join(escaped))
+        except Exception as e:  # noqa: BLE001 — the arm must report, not die
+            problems.append(f"cache storm crashed: {type(e).__name__}: {e}")
+        finally:
+            if svc is not None:
+                svc.shutdown()
+            for k, v in zip(knobs, prev):
+                k.set(v)
+            monitor.reset()
+            querycache.reset_for_tests()
+            conf.VERIFY_LOCKS.set(False)
+            lock_verify.refresh()
+            conf.VERIFY_LOCKSET.set(False)
+            lockset.refresh()
+        leaked = [t.name for t in service.service_threads()]
+        if leaked:
+            problems.append("leaked threads: " + ", ".join(leaked))
+        problems += ledger.leak_audit(
+            shuffle_root=sorted(set(glob.glob(shuffle_glob)) - roots_before),
+            spills_before=spills_before)
+    finally:
+        conf.VERIFY_ERRORS.set(False)
+        errors.refresh()
+        ledger.refresh()
+    if problems:
+        print(f"cache-storm (seed {seed}): " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    print(f"cache-storm (seed {seed}): OK ({n_subs} submissions = "
+          f"{n_hits} result-cache hit(s) + {n_misses} miss(es), "
+          f"1 mid-storm mutation, 0 stale rows, 0 hit lease turns)")
+    return 0
+
+
 def _live_attempt_threads():
     """Attempt-runner threads still alive after a run — kept as a thin
     alias of the shared leak oracle's thread check
@@ -1887,11 +2174,18 @@ def main(argv=None) -> int:
                          "mid-stage by a seeded @kill schedule, "
                          "asserting partial re-run of only the dead "
                          "worker's map outputs, blacklisting, and "
-                         "degradation to in-process execution); nonzero "
+                         "degradation to in-process execution) plus a "
+                         "cache-storm arm (concurrent identical + "
+                         "literal-shifted submissions with a seeded "
+                         "mid-storm source mutation, asserting "
+                         "byte-identical results vs an uncached "
+                         "baseline, hits + misses == submissions, and "
+                         "zero lease turns on hits); nonzero "
                          "exit on any mismatch, unreconciled event log, "
                          "hung or untyped submission, leaked thread, "
                          "undetected corruption, unrecovered worker "
-                         "loss, or orphaned temp/spill file")
+                         "loss, stale cached result, or orphaned "
+                         "temp/spill file")
     ap.add_argument("--trace", action="store_true",
                     help="arm the structured event log "
                          "(spark.blaze.trace.enabled) for this run; each "
@@ -2140,10 +2434,12 @@ def main(argv=None) -> int:
             # seed sweep: N independent schedules; the first also arms
             # speculation against an injected straggler, the second
             # injects a mid-query device OOM the degradation ladder
-            # must absorb, and EVERY seed ends with a cancel-storm arm
-            # (a seeded random cancel at a random stage frontier).
-            # Datagen is seed-independent: resolve the suite ONCE and
-            # share it across every seed's arms.
+            # must absorb, and EVERY seed ends with the storm battery:
+            # cancel, admission, corruption, worker-kill, and cache
+            # (concurrent identical/literal-shifted submissions racing
+            # a seeded source mutation).  Datagen is seed-independent:
+            # resolve the suite ONCE and share it across every seed's
+            # arms.
             loaded = _load_suite(args.suite, queries, args.scale,
                                  args.parts)
             bq, qnames, scans = loaded
@@ -2170,6 +2466,9 @@ def main(argv=None) -> int:
                                            args.chaos_seed + k) or rc
                 rc = _run_worker_kill_storm(args.suite,
                                             args.chaos_seed + k) or rc
+                rc = _run_cache_storm(args.suite, qnames, scans, bq,
+                                      args.parts,
+                                      args.chaos_seed + k) or rc
         elif args.chaos:
             rc = _run_chaos(args.suite, queries, args.scale, args.parts,
                             args.chaos_seed, args.chaos_faults)

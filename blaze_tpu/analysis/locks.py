@@ -78,6 +78,14 @@ HIERARCHY: Tuple[str, ...] = (
                              # checkpoint may consult pool state, and
                              # outside monitor.registry/ledger.state
                              # whose accounting hooks it calls)
+    "querycache.state",      # result-cache LRU map + byte accounting
+                             # (held for dict/LRU mutation, entry
+                             # spill/promote serde — spill streams are
+                             # one-shot cursors, so readers must never
+                             # interleave — and set_mem_used_no_trigger
+                             # [memmgr.manager, diskmgr.state and
+                             # ledger.state all rank inside]; trace
+                             # emission happens outside)
     "shuffle.repartitioner", # per-map-task staged partition buffers
     "monitor.registry",      # live query registry
     "monitor.progress",      # per-stage progress counters (leaf: held

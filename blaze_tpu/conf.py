@@ -290,6 +290,28 @@ SERVICE_RESULT_QUEUE_DEPTH = ConfEntry("spark.blaze.service.resultQueueDepth", 8
 # and spark.blaze.service.pool.<name>.quota (host-staging bytes budget,
 # 0/unset = unlimited) — read via get_conf, like spark.blaze.enable.*.
 
+# Serving-scale cache hierarchy (runtime/querycache.py).  Level 1,
+# the PLAN cache: literal leaves canonicalize into slots
+# (exprs.compile.slotify_literals) so parameter-shifted variants of one
+# query shape share one plan fingerprint and ONE compiled fused program
+# — the slot values ride as traced kernel arguments.  Off: literals
+# bake into kernel keys again (every shifted variant recompiles).
+CACHE_PLAN_ENABLED = ConfEntry("spark.blaze.cache.plan.enabled", True, _bool)
+# Level 2, the RESULT cache: the service memoizes final result batches
+# keyed by (plan fingerprint, slot values, source version); a hit is
+# served host-side WITHOUT taking a fair-share device-lease turn.  Any
+# source append/rewrite changes the version and invalidates exactly
+# the dependent entries.
+CACHE_RESULT_ENABLED = ConfEntry("spark.blaze.cache.result.enabled", True, _bool)
+# Byte budget for cached result batches (LRU evicts past it), tracked
+# through the memmgr as an UNOWNED consumer — watermark pressure spills
+# cold entries down the diskmgr ladder, never a quota neighbor's memory.
+CACHE_RESULT_MAX_BYTES = ConfEntry("spark.blaze.cache.result.maxBytes", 64 << 20, int)
+# Per-entry cap: a single query result larger than this is never
+# admitted (one giant result must not evict the whole working set).
+CACHE_RESULT_MAX_ENTRY_BYTES = ConfEntry(
+    "spark.blaze.cache.result.maxEntryBytes", 8 << 20, int)
+
 # Live query monitoring (runtime/monitor.py).  OFF (default): no HTTP
 # server, no background thread, and the heartbeat path is a structural
 # no-op exactly like spark.blaze.trace.enabled=false.  ON: an in-process

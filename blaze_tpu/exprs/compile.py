@@ -55,6 +55,7 @@ from .ir import (
     NamedStruct,
     Not,
     ScalarFunc,
+    Slot,
 )
 
 _RANK = {
@@ -131,6 +132,8 @@ def infer_dtype(expr: Expr, schema: Schema) -> DataType:
         return infer_dtype(expr.child, schema)
     if isinstance(expr, Lit):
         return infer_lit_dtype(expr.value, expr.dtype)
+    if isinstance(expr, Slot):
+        return expr.dtype
     if isinstance(expr, Cast):
         return expr.to
     if isinstance(expr, (IsNull, IsNotNull, Not, InList, Like)):
@@ -487,6 +490,9 @@ def expr_key(e: Expr):
         return ("col", e.name)
     if isinstance(e, Lit):
         return ("lit", repr(e.value), e.dtype)
+    if isinstance(e, Slot):
+        # the whole point of slots: shifted literal VALUES share a key
+        return ("slot", e.index, e.dtype)
     if isinstance(e, Alias):
         return expr_key(e.child)
     if isinstance(e, BinOp):
@@ -573,6 +579,106 @@ def fold_literals(e: Expr) -> Expr:
     return e
 
 
+# ------------------------------------------------- literal slotification
+
+def _slot_physical(value, dtype: DataType):
+    """The traced scalar a slotified literal ships: EXACTLY the device
+    value :func:`_lit_column` would bake for (value, dtype), as a numpy
+    scalar so the jit argument dtype is pinned host-side (a python int
+    would retrace on the int32/int64 weak-type boundary)."""
+    if dtype.is_decimal:
+        if isinstance(value, str):
+            from decimal import Decimal
+
+            unscaled = int(Decimal(value).scaleb(dtype.scale).to_integral_value())
+        elif isinstance(value, float):
+            unscaled = int(round(value * 10**dtype.scale))
+        else:
+            unscaled = int(value) * 10**dtype.scale
+        return np.int64(unscaled)
+    if dtype.kind == TypeKind.DATE32:
+        if isinstance(value, str):
+            value = datetime.date.fromisoformat(value)
+        if isinstance(value, datetime.date):
+            value = (value - datetime.date(1970, 1, 1)).days
+        return np.int32(int(value))
+    return np.asarray(value, dtype.np_dtype)[()]
+
+
+def slot_eligible(e: Expr) -> bool:
+    """Literal leaves that may become slots: scalar numerics, decimals
+    and dates.  Excluded: nulls and bools (both drive TRACE-TIME
+    short-circuits — `_lit_bool`, validity folding — so their value is
+    plan structure, not data), strings/binary (their width is part of
+    the column SHAPE) and nested values."""
+    if not isinstance(e, Lit) or e.value is None or isinstance(e.value, bool):
+        return False
+    dtype = infer_lit_dtype(e.value, e.dtype)
+    return not (dtype.is_string or dtype.is_nested
+                or dtype.kind in (TypeKind.NULL, TypeKind.BOOL))
+
+
+def slotify_literals(exprs: List[Optional[Expr]], start: int = 0):
+    """Rewrite eligible ``Lit`` leaves into :class:`Slot` nodes so
+    parameter-shifted variants of one expression shape share one
+    structural key (and therefore one compiled program).  Returns
+    ``(new_exprs, slot_values)`` where ``slot_values`` are the numpy
+    scalars to pass as the operator's ``trace_slots()`` tail, in slot
+    index order (indices begin at ``start``).  The input trees are not
+    mutated — callers keep the original exprs for plan rewrites,
+    pruning, and scan pushdown."""
+    from .functions import STRUCTURAL_LIT_ARGS as structural
+
+    _EMPTY: frozenset = frozenset()
+    values: List = []
+
+    def walk(e: Optional[Expr]) -> Optional[Expr]:
+        if e is None:
+            return None
+        if isinstance(e, Lit):
+            if not slot_eligible(e):
+                return e
+            dtype = infer_lit_dtype(e.value, e.dtype)
+            values.append(_slot_physical(e.value, dtype))
+            return Slot(start + len(values) - 1, dtype)
+        if isinstance(e, Alias):
+            return Alias(walk(e.child), e.name)
+        if isinstance(e, BinOp):
+            return BinOp(e.op, walk(e.left), walk(e.right))
+        if isinstance(e, Not):
+            return Not(walk(e.child))
+        if isinstance(e, IsNull):
+            return IsNull(walk(e.child))
+        if isinstance(e, IsNotNull):
+            return IsNotNull(walk(e.child))
+        if isinstance(e, Cast):
+            return Cast(walk(e.child), e.to)
+        if isinstance(e, Case):
+            return Case([(walk(c), walk(v)) for c, v in e.branches],
+                        None if e.else_ is None else walk(e.else_))
+        if isinstance(e, InList):
+            return InList(walk(e.child), [walk(v) for v in e.values],
+                          e.negated)
+        if isinstance(e, Like):
+            return Like(walk(e.child), e.pattern, e.negated)
+        if isinstance(e, ScalarFunc):
+            # structural literal args (decimal precision/scale, slice
+            # bounds, pad widths) are read with ``.value`` at trace
+            # time — they must stay ``Lit``, never become Slots
+            keep = structural.get(e.name, _EMPTY)
+            return ScalarFunc(e.name, [a if i in keep else walk(a)
+                                       for i, a in enumerate(e.args)])
+        if isinstance(e, GetIndexedField):
+            return GetIndexedField(walk(e.child), e.index)
+        if isinstance(e, GetStructField):
+            return GetStructField(walk(e.child), e.name)
+        # PythonUdf/SparkUdfWrapper (host-evaluated), NamedStruct,
+        # GetMapValue, Col: leave as-is — their literals stay baked
+        return e
+
+    return [walk(e) for e in exprs], tuple(values)
+
+
 # counts _lower_node invocations (CSE effectiveness; tests assert on it)
 LOWER_STATS = {"nodes": 0}
 
@@ -610,6 +716,16 @@ def _lower_node(expr: Expr, schema: Schema, cols: Dict[str, Column], n: int, mem
         return lower(expr.child, schema, cols, n, memo)
     if isinstance(expr, Lit):
         return _lit_column(expr.value, infer_lit_dtype(expr.value, expr.dtype), n)
+    if isinstance(expr, Slot):
+        slots = cols.get("__slots__")
+        if slots is None:
+            raise KeyError(
+                "slotified expression lowered without a '__slots__' "
+                "environment entry — the owning operator must pass its "
+                "trace_slots() values through the column env")
+        return Column(expr.dtype,
+                      jnp.full(n, slots[expr.index], expr.dtype.np_dtype),
+                      jnp.ones(n, jnp.bool_))
     if isinstance(expr, Cast):
         return lower_cast(lower(expr.child, schema, cols, n, memo), expr.to)
     if isinstance(expr, Not):

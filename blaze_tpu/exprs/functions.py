@@ -23,6 +23,25 @@ from .ir import Expr, Lit, ScalarFunc
 _REGISTRY: Dict[str, Callable] = {}
 _TYPES: Dict[str, Callable] = {}
 
+# Argument positions whose literal value is PLAN STRUCTURE, not data:
+# the type-inference or lowering fn reads ``.value`` at trace time
+# (output dtype/width, decimal precision/scale, device slice bounds).
+# ``slotify_literals`` must leave these as ``Lit`` — a parameter
+# ``Slot`` here would crash inference or silently change the output
+# schema between parameter-shifted variants.
+STRUCTURAL_LIT_ARGS: Dict[str, frozenset] = {
+    "substring": frozenset({1, 2}),      # pos/len: slice + width
+    "round": frozenset({1}),             # scale: output decimal type
+    "make_decimal": frozenset({1, 2}),   # precision/scale: output type
+    "check_overflow": frozenset({1, 2}), # precision/scale: output type
+    "lpad": frozenset({1}),              # pad length: output width
+    "rpad": frozenset({1}),
+    "left": frozenset({1}),              # take length: output width
+    "right": frozenset({1}),
+    "space": frozenset({0}),             # count: output width
+    "repeat": frozenset({1}),
+}
+
 
 def register(name: str, infer: Callable):
     def deco(fn):

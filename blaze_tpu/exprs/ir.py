@@ -90,6 +90,20 @@ class Lit(Expr):
 
 
 @dataclass(eq=False)
+class Slot(Expr):
+    """A parameterized literal: position ``index`` in an operator's
+    slot-value vector, carrying the dtype the literal would have
+    lowered with.  The plan-fingerprint cache (runtime/querycache.py)
+    rewrites eligible ``Lit`` leaves into slots so ``WHERE price > 5``
+    and ``WHERE price > 9`` share one expression key and one compiled
+    program — the concrete values ride as traced kernel arguments
+    (the op's ``trace_slots()`` tail), never as baked constants."""
+
+    index: int
+    dtype: DataType
+
+
+@dataclass(eq=False)
 class Alias(Expr):
     child: Expr
     name: str

@@ -63,6 +63,19 @@ class ExecNode:
         non-None whenever trace_fn returns a function."""
         return None
 
+    def trace_slots(self) -> tuple:
+        """Slot values (numpy scalars) for this operator's slotified
+        literals (exprs.compile.slotify_literals) — the parameters that
+        let `WHERE price > 5` and `WHERE price > 9` share one compiled
+        program.  CONTRACT: when non-empty, the transform returned by
+        :meth:`trace_fn` expects exactly ``len(trace_slots())`` traced
+        scalars appended at the TAIL of its ``cols`` tuple (after the
+        schema columns) and slices them off itself; callers — the
+        standalone execute, FusedStageExec, the fused shuffle write,
+        and the eager OOM rung — append the values per call.  The
+        values are DATA, never part of :meth:`trace_key`."""
+        return ()
+
     @property
     def trace_changes_count(self) -> bool:
         """True when the traced transform can change ``num_rows`` (a

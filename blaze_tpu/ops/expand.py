@@ -47,10 +47,22 @@ class ExpandExec(ExecNode):
 
         out_schema = self._schema
         n_proj = len(fns)
+        # slots-as-cols-tail contract (ops/base.py): each projection's
+        # slotified literals arrive flattened at this transform's tail;
+        # deal each inner fn its own group
+        slot_counts = tuple(len(p.trace_slots()) for p in self._projects)
+        n_slots = sum(slot_counts)
 
         def body(cols, num_rows):
+            cols = tuple(cols)
+            slots = cols[len(cols) - n_slots:] if n_slots else ()
+            cols = cols[:len(cols) - n_slots] if n_slots else cols
             cap = cols[0].validity.shape[0]
-            outs = [fn(cols, num_rows)[0] for fn in fns]
+            outs = []
+            i = 0
+            for fn, cnt in zip(fns, slot_counts):
+                outs.append(fn(cols + slots[i:i + cnt], num_rows)[0])
+                i += cnt
             counts = [num_rows] * n_proj
             out_cols = tuple(
                 _concat_device_cols(
@@ -67,6 +79,9 @@ class ExpandExec(ExecNode):
         if any(k is None for k in keys):
             return None
         return ("expand", keys)
+
+    def trace_slots(self) -> tuple:
+        return tuple(v for p in self._projects for v in p.trace_slots())
 
     @property
     def trace_changes_count(self) -> bool:

@@ -66,6 +66,7 @@ class FilterExec(ExecNode):
         schema_aug = self._in_schema_aug
         pred = self._device_pred
         n_in_fields = len(in_schema.fields)
+        n_fields = len(schema_aug.fields)
         if project is not None:
             proj_exprs, proj_names = project
             self._schema = Schema(
@@ -75,9 +76,32 @@ class FilterExec(ExecNode):
             proj_exprs = None
             self._schema = in_schema
 
+        # plan-fingerprint program reuse (runtime/querycache.py):
+        # canonicalize literal leaves into Slot nodes so parameter-
+        # shifted variants of this predicate share one kernel-cache key
+        # and one compiled program; the values travel as traced scalars
+        # appended to the cols tail (trace_slots contract, ops/base.py).
+        # `self.predicate` keeps the ORIGINAL literals — plan rewrites,
+        # pruning and scan pushdown read it, not the kernel form.
+        from .. import conf
+        from ..exprs.compile import slotify_literals
+
+        if bool(conf.CACHE_PLAN_ENABLED.get()):
+            slotified, self._slot_args = slotify_literals(
+                [pred] + (proj_exprs if proj_exprs is not None else []))
+            pred = slotified[0]
+            if proj_exprs is not None:
+                proj_exprs = slotified[1:]
+        else:
+            self._slot_args = ()
+
         def body(cols: Tuple[Column, ...], num_rows):
+            slots = tuple(cols[n_fields:])
+            cols = tuple(cols[:n_fields])
             n = cols[0].validity.shape[0]
             env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
+            if slots:
+                env["__slots__"] = slots
             memo: dict = {}
             p = lower(pred, schema_aug, env, n, memo)
             # the live mask is load-bearing: IsNull turns padding-row
@@ -115,6 +139,9 @@ class FilterExec(ExecNode):
     def trace_key(self):
         return None if self._host_parts else self._key
 
+    def trace_slots(self) -> tuple:
+        return self._slot_args
+
     @property
     def trace_changes_count(self) -> bool:
         return True
@@ -136,7 +163,8 @@ class FilterExec(ExecNode):
                     cols = list(batch.columns)
                     for _, sub in self._host_parts:
                         cols.append(host_eval(sub, batch))
-                    out_cols, count = self._kernel(tuple(cols), batch.num_rows)
+                    out_cols, count = self._kernel(
+                        tuple(cols) + self._slot_args, batch.num_rows)
                     n = int(count)  # one-scalar device->host sync
                 if n == 0:
                     continue

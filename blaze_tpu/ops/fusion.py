@@ -385,15 +385,31 @@ class FusedStageExec(ExecNode):
         self._fns = fns
         self._keys = tuple(op.trace_key() for op in self.ops)
         keys = self._keys
+        # slots-as-cols-tail contract (ops/base.py trace_slots): the
+        # fused program takes the CONCATENATION of every op's slot
+        # values appended after the input columns and deals each op its
+        # own group; the per-op counts are static (part of the chain's
+        # structure), only the VALUES are traced, so parameter-shifted
+        # chains reuse this one compiled program.
+        self._slot_counts = tuple(len(op.trace_slots()) for op in self.ops)
+        self._slot_args = tuple(
+            v for op in self.ops for v in op.trace_slots())
+        slot_counts = self._slot_counts
+        n_slots = len(self._slot_args)
 
         def build():
             import jax
 
             @jax.jit
             def kernel(cols, num_rows):
+                cols = tuple(cols)
+                slots = cols[len(cols) - n_slots:] if n_slots else ()
+                cols = cols[:len(cols) - n_slots] if n_slots else cols
                 n = num_rows
-                for fn in fns:
-                    cols, n = fn(cols, n)
+                i = 0
+                for fn, cnt in zip(fns, slot_counts):
+                    cols, n = fn(tuple(cols) + slots[i:i + cnt], n)
+                    i += cnt
                 return cols, n
 
             return kernel
@@ -418,17 +434,30 @@ class FusedStageExec(ExecNode):
 
     def trace_fn(self):
         fns = self._fns
+        slot_counts = self._slot_counts
+        n_slots = len(self._slot_args)
 
         def fn(cols, num_rows):
+            cols = tuple(cols)
+            slots = cols[len(cols) - n_slots:] if n_slots else ()
+            cols = cols[:len(cols) - n_slots] if n_slots else cols
             n = num_rows
-            for f in fns:
-                cols, n = f(cols, n)
+            i = 0
+            for f, cnt in zip(fns, slot_counts):
+                cols, n = f(tuple(cols) + slots[i:i + cnt], n)
+                i += cnt
             return cols, n
 
         return fn
 
     def trace_key(self):
         return ("fused_stage", self._keys)
+
+    def trace_slots(self) -> tuple:
+        # the chain's flattened slot vector, in op order — an enclosing
+        # consumer (the fused shuffle write) appends these exactly like
+        # any single op's slots
+        return self._slot_args
 
     @property
     def trace_changes_count(self) -> bool:
@@ -458,8 +487,8 @@ class FusedStageExec(ExecNode):
                 [(op.trace_key(), fn)
                  for op, fn in zip(self.ops, self._fns)])
         cols, n = tuple(batch.columns), batch.num_rows
-        for kernel in self._eager_kernels:
-            cols, n = kernel(cols, n)
+        for kernel, op in zip(self._eager_kernels, self.ops):
+            cols, n = kernel(tuple(cols) + op.trace_slots(), n)
         return cols, n
 
     def _degradable_results(self, batch, depth: int):
@@ -479,7 +508,8 @@ class FusedStageExec(ExecNode):
         from ..runtime import oom as _oom
 
         try:
-            cols, n_dev = self._kernel(tuple(batch.columns), batch.num_rows)
+            cols, n_dev = self._kernel(
+                tuple(batch.columns) + self._slot_args, batch.num_rows)
             n = int(n_dev) if self._changes_count else batch.num_rows
         except Exception as exc:  # noqa: BLE001 — classified below
             if not _oom.is_resource_exhausted(exc):
@@ -555,6 +585,14 @@ def optimize_plan(plan):
         from ..analysis.plan_verify import verify_or_raise
 
         verify_or_raise(plan)
+    # Level-1 plan-cache bookkeeping (runtime/querycache.py): every
+    # execution path crosses this choke point, so the fingerprint tally
+    # here is THE ground truth for compiled-program reuse — a hit means
+    # this plan structure's programs (parameter shifts included, via
+    # literal slots) are already in the kernel cache
+    from ..runtime.querycache import record_plan
+
+    record_plan(plan)
     return plan
 
 

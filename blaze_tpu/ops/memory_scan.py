@@ -8,12 +8,18 @@ when batches are handed over pre-staged.
 
 from __future__ import annotations
 
+import itertools
 from typing import List, Optional, Sequence
 
 from ..batch import RecordBatch
 from ..runtime.context import TaskContext
 from ..schema import Schema
 from .base import BatchStream, ExecNode
+
+#: process-global id source for memory tables — a fresh MemoryScanExec
+#: is a fresh SOURCE for result-cache versioning (querycache), so two
+#: scans over coincidentally-equal data never share cached results
+_source_ids = itertools.count(1)
 
 
 class MemoryScanExec(ExecNode):
@@ -25,6 +31,12 @@ class MemoryScanExec(ExecNode):
             assert first is not None, "schema required for empty MemoryScanExec"
             schema = first.schema
         self._schema = schema
+        # result-cache source version (runtime/querycache.py): the
+        # (source_id, epoch) pair is this table's data identity — any
+        # mutation bumps the epoch, invalidating exactly the cached
+        # results derived from it
+        self.source_id: int = next(_source_ids)
+        self.epoch: int = 0
 
     @property
     def schema(self) -> Schema:
@@ -32,6 +44,26 @@ class MemoryScanExec(ExecNode):
 
     def num_partitions(self) -> int:
         return max(1, len(self._partitions))
+
+    # --------------------------------------------- table mutation API
+    #
+    # serving-mode tables mutate between queries (appends, compaction
+    # rewrites); both paths bump the epoch so the result cache drops
+    # dependent entries instead of serving stale rows.
+
+    def append(self, partition: int, batch: RecordBatch) -> None:
+        """Append one batch to ``partition`` (extending the partition
+        list for a new partition index) and bump the source epoch."""
+        while len(self._partitions) <= partition:
+            self._partitions.append([])
+        self._partitions[partition].append(batch)
+        self.epoch += 1
+
+    def replace(self, partitions: Sequence[Sequence[RecordBatch]]) -> None:
+        """Replace the table's contents wholesale (a compaction or
+        rewrite) and bump the source epoch."""
+        self._partitions = [list(p) for p in partitions]
+        self.epoch += 1
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         from ..runtime import monitor

@@ -62,6 +62,7 @@ class ProjectExec(ExecNode):
             self._device_exprs, self._host_parts = [], []
             self._in_schema_aug = in_schema
             self._kernel = None
+            self._slot_args = ()
             return
         # host-fallback subtrees get evaluated per batch outside jit and
         # injected as synthetic columns (≙ SparkUDFWrapperExpr round trip)
@@ -73,10 +74,27 @@ class ProjectExec(ExecNode):
 
         schema_aug = self._in_schema_aug
         device_exprs = self._device_exprs
+        n_fields = len(schema_aug.fields)
+
+        # plan-fingerprint program reuse (runtime/querycache.py): Slot
+        # out literal leaves so `price * 0.9` and `price * 0.8` share a
+        # kernel-cache key; `self.exprs` keeps the ORIGINAL literals —
+        # pruning and plan rewrites read those, not the kernel form.
+        from .. import conf
+        from ..exprs.compile import slotify_literals
+
+        if bool(conf.CACHE_PLAN_ENABLED.get()):
+            device_exprs, self._slot_args = slotify_literals(device_exprs)
+        else:
+            self._slot_args = ()
 
         def body(cols: Tuple[Column, ...]) -> Tuple[Column, ...]:
+            slots = tuple(cols[n_fields:])
+            cols = tuple(cols[:n_fields])
             n = cols[0].validity.shape[0]
             env = {f.name: c for f, c in zip(schema_aug.fields, cols)}
+            if slots:
+                env["__slots__"] = slots
             # ONE memo across the output list: each distinct subtree
             # lowers once (≙ CachedExprsEvaluator)
             memo: dict = {}
@@ -133,6 +151,9 @@ class ProjectExec(ExecNode):
                     tuple(self._select_idx))
         return None if self._host_parts else self._key
 
+    def trace_slots(self) -> tuple:
+        return self._slot_args
+
     @property
     def has_kernel(self) -> bool:
         """False for the pure-select fast path (a host list pick: no
@@ -152,7 +173,7 @@ class ProjectExec(ExecNode):
             return RecordBatch(
                 self._schema, [batch.columns[i] for i in self._select_idx], batch.num_rows
             )
-        out_cols = self._kernel(self._augmented_cols(batch))
+        out_cols = self._kernel(self._augmented_cols(batch) + self._slot_args)
         return RecordBatch(self._schema, list(out_cols), batch.num_rows)
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:

@@ -546,7 +546,8 @@ def _build_pid_kernels(schema, exprs, n_out):
     return hash_pids, hash_pids_pallas
 
 
-def _build_fused_write_kernel(out_schema, fns, pid_mode, exprs, n_out):
+def _build_fused_write_kernel(out_schema, fns, pid_mode, exprs, n_out,
+                              slot_counts=()):
     """ONE program per map-stage batch (fusion tier 5): the traceable
     map chain, the partition-id computation, the pid sort, and the
     per-partition bincount, all in a single XLA executable.  The
@@ -555,11 +556,21 @@ def _build_fused_write_kernel(out_schema, fns, pid_mode, exprs, n_out):
     chain's trace transforms bottom->top (may be empty: a bare writer
     still folds hash+sort into one program); ``pid_mode`` is "hash"
     (murmur3 pmod over ``exprs``) or "rr" (round-robin, offset passed
-    as a traced arg)."""
+    as a traced arg).  ``slot_counts`` gives each fn's slotified-
+    literal count (trace_slots contract, ops/base.py): the caller
+    appends the flattened slot values after the input columns and the
+    chain deals each transform its own group, so parameter-shifted
+    chains reuse this one program."""
+    n_slots = sum(slot_counts)
 
     def chain(cols, n):
-        for fn in fns:
-            cols, n = fn(cols, n)
+        cols = tuple(cols)
+        slots = cols[len(cols) - n_slots:] if n_slots else ()
+        cols = cols[:len(cols) - n_slots] if n_slots else cols
+        i = 0
+        for fn, cnt in zip(fns, slot_counts):
+            cols, n = fn(tuple(cols) + slots[i:i + cnt], n)
+            i += cnt
         return cols, n
 
     if pid_mode == "hash":
@@ -702,6 +713,8 @@ class ShuffleWriterExec(ExecNode):
         self._fused_write = None
         self._fused_fns: List = []
         self._fused_fn_keys: tuple = ()
+        self._fused_slot_args: tuple = ()   # flattened, chain order
+        self._fused_slot_groups: tuple = ()  # per-op, for the eager rung
         self._eager_chain = None  # per-op fallback kernels (OOM rung 3)
         self._out_schema: Optional[Schema] = None
         if isinstance(partitioning, HashPartitioning):
@@ -811,20 +824,27 @@ class ShuffleWriterExec(ExecNode):
 
         fns = [op.trace_fn() for op in reversed(ops)]  # bottom -> top
         keys = tuple(op.trace_key() for op in reversed(ops))
+        # slot structure is a function of the op keys (slotified expr
+        # keys pin where every slot sits), so caching on `keys` alone
+        # stays sound; only the VALUES differ across shifted variants
+        slot_groups = tuple(op.trace_slots() for op in reversed(ops))
+        slot_counts = tuple(len(g) for g in slot_groups)
         if isinstance(part, HashPartitioning):
             exprs = list(part.exprs)
             key = ("fused_shuffle_write", "hash", schema_key(out_schema),
                    keys, tuple(expr_key(e) for e in exprs), n_out)
             builder = lambda: _build_fused_write_kernel(  # noqa: E731
-                out_schema, fns, "hash", exprs, n_out)
+                out_schema, fns, "hash", exprs, n_out, slot_counts)
         else:
             key = ("fused_shuffle_write", "rr", schema_key(out_schema),
                    keys, n_out)
             builder = lambda: _build_fused_write_kernel(  # noqa: E731
-                out_schema, fns, "rr", None, n_out)
+                out_schema, fns, "rr", None, n_out, slot_counts)
         self._fused_write = cached_kernel(key, builder)
         self._fused_fns = fns
         self._fused_fn_keys = keys
+        self._fused_slot_args = tuple(v for g in slot_groups for v in g)
+        self._fused_slot_groups = slot_groups
         self._out_schema = out_schema
         if ops:
             from ..ops.fusion import BufferPartitionExec
@@ -846,8 +866,9 @@ class ShuffleWriterExec(ExecNode):
 
             self._eager_chain = build_eager_kernels(
                 list(zip(self._fused_fn_keys, self._fused_fns)))
-        for kernel in self._eager_chain:
-            cols, num_rows = kernel(cols, num_rows)
+        for kernel, slots in zip(self._eager_chain,
+                                 self._fused_slot_groups):
+            cols, num_rows = kernel(tuple(cols) + slots, num_rows)
         return list(cols), int(num_rows)
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
@@ -894,11 +915,13 @@ class ShuffleWriterExec(ExecNode):
                             with self.metrics.timer("elapsed_compute"):
                                 if isinstance(self.partitioning, RoundRobinPartitioning):
                                     sorted_cols, counts, rr_dev = self._fused_write(
-                                        tuple(batch.columns), batch.num_rows, rr_dev
+                                        tuple(batch.columns) + self._fused_slot_args,
+                                        batch.num_rows, rr_dev
                                     )
                                 else:
                                     sorted_cols, counts = self._fused_write(
-                                        tuple(batch.columns), batch.num_rows
+                                        tuple(batch.columns) + self._fused_slot_args,
+                                        batch.num_rows
                                     )
                             item = (list(sorted_cols), counts, None)
                         except Exception as exc:  # noqa: BLE001

@@ -2073,6 +2073,18 @@ def render_watch(snap: Dict[str, Any], url: str = "") -> str:
             f"admitted {c.get('queries_admitted', 0)} "
             f"rejected {c.get('queries_rejected', 0)} "
             f"quota_cancelled {c.get('queries_quota_cancelled', 0)}")
+        cache = svc.get("cache")
+        if cache:
+            cc = cache.get("counters", {})
+            res = cache.get("result", {})
+            lines.append(
+                f"cache: plan {cc.get('plan_cache_hits', 0)} hit"
+                f"/{cc.get('plan_cache_misses', 0)} miss  "
+                f"result {cc.get('result_cache_hits', 0)} hit"
+                f"/{cc.get('result_cache_misses', 0)} miss"
+                f"/{cc.get('result_cache_invalidations', 0)} inval  "
+                f"{res.get('entries', 0)} entries "
+                f"{_human_bytes(res.get('total_bytes', 0))}")
         for name, p in sorted(svc.get("pools", {}).items()):
             lines.append(
                 f"  pool {name:12s} w={p['weight']:<4g} "

@@ -375,7 +375,8 @@ def query_perf(events: List[Dict[str, Any]],
 #: --json`` shape — add keys freely, never rename; tests/test_perf.py
 #: gates it like the ``--report --json`` pins)
 EXPLAIN_JSON_KEYS = ("query_id", "status", "wall_ns", "attributed_ns",
-                     "attributed_pct", "stages", "kernels", "perf")
+                     "attributed_pct", "stages", "kernels", "perf",
+                     "cache")
 
 
 def _node_own_ns(metrics: Dict[str, Any]) -> int:
@@ -483,6 +484,30 @@ def explain_doc(events: List[Dict[str, Any]],
         "stages": stages,
         "kernels": kernels,
         "perf": query_perf(events, device_kind=peaks_kind, kernels=rows),
+        "cache": _cache_doc(t),
+    }
+
+
+def _cache_doc(t: Dict[str, List[Dict[str, Any]]]) -> Dict[str, int]:
+    """The query-cache story from this run's plan_cache/result_cache
+    trace events (runtime/querycache.py): program-reuse hits at the
+    optimize_plan choke point and result-cache traffic, including the
+    bytes a hit served off-device."""
+
+    def count(evs, action):
+        return sum(1 for e in evs if e.get("action") == action)
+
+    pc = t.get("plan_cache", [])
+    rc = t.get("result_cache", [])
+    return {
+        "plan_hits": count(pc, "hit"),
+        "plan_misses": count(pc, "miss"),
+        "result_hits": count(rc, "hit"),
+        "result_misses": count(rc, "miss"),
+        "result_stores": count(rc, "store"),
+        "result_invalidations": count(rc, "invalidate"),
+        "result_hit_bytes": sum(e.get("bytes", 0) for e in rc
+                                if e.get("action") == "hit"),
     }
 
 
@@ -536,6 +561,16 @@ def render_explain(events: List[Dict[str, Any]],
         f"mfu_est={100 * p['mfu_est']:.4f}%  "
         f"(peaks: {p['peak']['device']}, "
         f"{p['peak']['hbm_gbps']:g} GB/s, {p['peak']['tflops']:g} TF)")
+    cd = doc.get("cache") or {}
+    if any(cd.values()):
+        line = (f"cache: plan {cd['plan_hits']} hit"
+                f"/{cd['plan_misses']} miss  "
+                f"result {cd['result_hits']} hit"
+                f"/{cd['result_misses']} miss"
+                f"/{cd['result_invalidations']} inval")
+        if cd["result_hit_bytes"]:
+            line += f"  served {cd['result_hit_bytes']:,}B off-device"
+        lines.append(line)
     for st in doc["stages"]:
         lines.append("")
         lines.append(

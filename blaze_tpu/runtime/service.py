@@ -62,7 +62,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from .. import conf
 from ..analysis.locks import make_lock
-from . import errors, ledger, lockset, memmgr, monitor, trace
+from . import errors, ledger, lockset, memmgr, monitor, querycache, trace
 from .context import (QueryCancelledError, cancel_query,
                       current_cancel_scope)
 from .metrics import MetricsSet
@@ -336,15 +336,21 @@ class Lease:
     every stage execution in a turn — queries not running under a
     service see ``None`` and pay one ContextVar read."""
 
-    __slots__ = ("gate", "pool", "scope")
+    __slots__ = ("gate", "pool", "scope", "turns")
 
     def __init__(self, gate: FairShareGate, pool: str, scope=None):
         self.gate = gate
         self.pool = pool
         self.scope = scope
+        # device-lease turns taken under this lease: the cache-hit
+        # path is judged by this staying 0 (a hit is served off-device
+        # BEFORE the gate, so the soak's ``cache_hit_lease_turns``
+        # assertion has a per-query witness, not just a global counter)
+        self.turns = 0
 
     @contextlib.contextmanager
     def stage_turn(self) -> Iterator[Turn]:
+        self.turns += 1
         with self.gate.turn(self.pool, scope=self.scope) as t:
             yield t
 
@@ -353,6 +359,7 @@ class Lease:
         # resource.path-leak pair table (analysis/errflow.py) can key
         # on it: every acquire_turn() must reach release()/pause() on
         # the exception path
+        self.turns += 1
         return self.gate.acquire(self.pool, scope=self.scope)
 
     def pause(self, turn: Turn) -> None:
@@ -744,7 +751,32 @@ class QueryService:
                 scope = current_cancel_scope()
                 lease.scope = scope
                 plan = sub.build()
-                self._runner(plan, lambda b: h._put(b, scope))
+                fp = querycache.plan_fingerprint(plan)
+                cached = (querycache.result_cache().lookup(fp)
+                          if fp is not None else None)
+                if cached is not None:
+                    # admission-integrated hit: the result cache is
+                    # consulted BEFORE any FairShareGate device-lease
+                    # turn — the hit is served off-device and the
+                    # lease's turn count (0) is published so the soak
+                    # can assert a hit never took a DRR turn
+                    self.metrics.add("queries_cache_hits", 1)
+                    for b in cached:
+                        h._put(b, scope)
+                    self.metrics.add("cache_hit_lease_turns",
+                                     lease.turns)
+                else:
+                    tee = querycache.ResultTee(fp)
+
+                    def _emit(b, _tee=tee, _scope=scope):
+                        _tee.add(b)
+                        h._put(b, _scope)
+
+                    self._runner(plan, _emit)
+                    # clean completion only — the except arms below
+                    # never reach this line, so a cancelled/failed
+                    # query's partial tee is dropped, never stored
+                    tee.commit()
         except QueryCancelledError as exc:
             status, error = _CANCELLED, exc
         except BaseException as exc:  # noqa: BLE001 — typed to the caller
@@ -899,6 +931,7 @@ class QueryService:
             "max_queued": self.max_queued,
             "counters": self.metrics.snapshot(),
             "pools": pools,
+            "cache": querycache.cache_stats(),
         }
 
     def live_queries(self) -> int:

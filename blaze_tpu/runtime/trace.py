@@ -79,6 +79,7 @@ EVENT_TYPES = frozenset({
     "block_corruption", "disk_pressure",
     "mem_watermark", "spill",
     "shuffle_write", "shuffle_fetch", "rss_push",
+    "plan_cache", "result_cache",
 })
 
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "trace_schema.json")

@@ -69,6 +69,8 @@ def test_merge_cached_carries_whole_q01_half():
             "q01_mfu_est": 0.001, "q01_bound": "dispatch-bound",
             "q01_device_kind": "TPU v4", "q01_trace_sample_rate": 1,
             "q01_trace_id": "a" * 32, "q01_query_id": "bench_1_1",
+            "q01_cache_miss_s": 0.9, "q01_cache_hit_s": 0.0004,
+            "cache": {"q01": {"hit_speedup": 2250.0, "fp": "ab12cd34ef56"}},
             "q01_measured_at": "2026-08-01T00:00:00Z"}
     fresh = {"backend": "tpu", "value": 2.0,
              "measured_at": "2026-08-02T00:00:00Z"}
@@ -76,6 +78,8 @@ def test_merge_cached_carries_whole_q01_half():
     for k in bench._Q01_CARRY_KEYS:
         assert merged[k] == prev[k], k
     assert merged["q01_measured_at"] == "2026-08-01T00:00:00Z"
+    # the q01 cache-provenance subblock travels with the carried half
+    assert merged["cache"]["q01"] == prev["cache"]["q01"]
     # fresh q06 is stronger: its half (incl. profile keys) stays fresh
     assert merged["value"] == 2.0
     assert merged["measured_at"] == "2026-08-02T00:00:00Z"
@@ -155,6 +159,39 @@ def test_merge_cached_old_format_winner_drops_fresh_profile_keys():
     assert "hbm_util" not in merged
     assert "mfu_est" not in merged
     assert "bound" not in merged
+
+
+def test_merge_cached_cache_block_travels_per_half():
+    """The ``cache`` provenance block is split per half: a cached q06
+    winner brings ITS hit/miss split (or drops the fresh one when the
+    old line predates the block), while a freshly measured q01 keeps
+    its own subblock untouched."""
+    prev = {"backend": "tpu", "value": 10.0,
+            "q06_cache_miss_s": 0.5, "q06_cache_hit_s": 0.0002,
+            "cache": {"q06": {"hit_speedup": 2500.0, "fp": "aa" * 6}},
+            "q01_rows_per_sec": 5.0,
+            "measured_at": "2026-08-01T00:00:00Z"}
+    fresh = {"backend": "tpu", "value": 4.0,
+             "q06_cache_miss_s": 0.1, "q06_cache_hit_s": 0.01,
+             "q01_cache_miss_s": 0.3, "q01_cache_hit_s": 0.0003,
+             "cache": {"q06": {"hit_speedup": 10.0, "fp": "bb" * 6},
+                       "q01": {"hit_speedup": 1000.0, "fp": "cc" * 6}},
+             "q01_rows_per_sec": 6.0,
+             "measured_at": "2026-08-02T00:00:00Z"}
+    merged = bench._merge_cached(fresh, prev)
+    assert merged["q06_cache_miss_s"] == 0.5
+    assert merged["q06_cache_hit_s"] == 0.0002
+    assert merged["cache"]["q06"] == prev["cache"]["q06"]
+    # q01 was freshly measured: its cache story stays fresh
+    assert merged["cache"]["q01"] == fresh["cache"]["q01"]
+    assert merged["q01_cache_hit_s"] == 0.0003
+    # an old-format winner (no cache block) drops the fresh q06 story
+    old_prev = {"backend": "tpu", "value": 10.0, "q01_rows_per_sec": 5.0,
+                "measured_at": "2026-08-01T00:00:00Z"}
+    merged = bench._merge_cached(dict(fresh), old_prev)
+    assert "q06_cache_miss_s" not in merged
+    assert "q06" not in merged["cache"]
+    assert merged["cache"]["q01"] == fresh["cache"]["q01"]
 
 
 def test_merge_cached_non_tpu_prev_never_wins_best_of():

@@ -252,6 +252,45 @@ def _soak(n_per_pool: int, rows: int, task_sleep_s: float):
         assert counters["queries_admitted"] == len(handles)
         assert counters.get("queries_queued", 0) > 0, (
             "the soak never exercised the queue")
+        # the main soak used a FRESH MemoryScanExec per submission, so
+        # every query was a (stored) result-cache miss by construction
+        assert counters.get("queries_cache_hits", 0) == 0
+        # ---- result cache: resubmitting over the SAME source must be
+        # served off-device — cache-hit-rate > 0 and ZERO DRR lease
+        # turns on the hit path (runtime/querycache.py)
+        rng = np.random.RandomState(99)
+        shared = {}
+        for pool in weights:
+            b = batch_from_pydict(
+                {"k": rng.randint(0, 50, 500).tolist(),
+                 "v": rng.randint(0, 1000, 500).tolist()}, SCHEMA)
+            shared[pool] = MemoryScanExec([[b], [b]], SCHEMA)
+
+        def run_shared(tag, pool):
+            h = svc.submit(
+                f"cache_{tag}_{pool}", pool=pool,
+                build=lambda s=shared[pool]: NativeShuffleExchangeExec(
+                    s, HashPartitioning([Col("k")], 2)))
+            rows = _sorted_rows(h.result(timeout=120))
+            assert h.status == "done"
+            return rows
+
+        miss_rows = {p: run_shared("miss", p) for p in weights}
+        before = dict(svc.stats()["counters"])
+        hit_rows = {p: run_shared("hit", p) for p in weights}
+        counters = svc.stats()["counters"]
+        hits = (counters.get("queries_cache_hits", 0)
+                - before.get("queries_cache_hits", 0))
+        assert hits == len(weights), (
+            f"expected every repeated submission to hit, got {hits}")
+        # a hit never takes a device-lease turn: the per-lease turn
+        # counter published at hit time must have summed to zero
+        assert counters.get("cache_hit_lease_turns", 0) == 0, counters
+        # cached results are served byte-identical to the fresh run
+        assert hit_rows == miss_rows
+        cache = svc.stats()["cache"]
+        assert cache["result"]["entries"] >= len(weights)
+        assert cache["counters"]["result_cache_hits"] >= len(weights)
     finally:
         svc.shutdown()
     _assert_no_service_leaks(spills_before)

@@ -349,6 +349,9 @@ def _synthetic_events():
                            "bytes": 100, "blocks": 2}),
         ("rss_push", {"resource": "rss_0", "partition": 0, "bytes": 7,
                       "blocks": 1}),
+        ("plan_cache", {"action": "hit", "fingerprint": "ab12" * 8}),
+        ("result_cache", {"action": "invalidate",
+                          "fingerprint": "cd34" * 8, "bytes": 2048}),
     ]
 
 
