@@ -1733,6 +1733,220 @@ def _run_worker_kill_storm(suite, seed) -> int:
     return 0
 
 
+def _run_slo_storm(suite, seed, make_bundle=False) -> int:
+    """SLO burn-rate storm chaos arm: a pool with a deliberately tight
+    latency objective (``spark.blaze.slo.pool.etl.latencyP99Ms``, 2s
+    accounting window) takes a burst of seeded straggler queries
+    (``task.compute@N@slow<ms>`` injection) and the burn-rate evaluator
+    must FIRE ``slo_alert_firing`` during the storm; after the faults
+    clear and fast queries age the stragglers out of the slow window,
+    the alert must RESOLVE (with the flap-suppression hold) — and the
+    event log must reconcile: every firing paired with its resolve
+    (``trace_report.reconcile_slo_alerts``), the dispatch counters
+    agreeing with the events.  Gates: lockset checker and error-escape
+    recorder quiet, the leak oracle clean, zero ``blaze-*`` threads
+    left.  With ``make_bundle`` the arm finishes by writing an incident
+    debug bundle, verifying its checksummed manifest, and re-rendering
+    the profile OFFLINE from the bundle's copied logs alone."""
+    import glob
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from . import conf
+    from .analysis import locks as lock_verify
+    from .batch import batch_from_pydict
+    from .exprs import col, lit
+    from .ops import MemoryScanExec, ProjectExec
+    from .runtime import (
+        bundle, dispatch, errors, faults, ledger, lockset, monitor, slo,
+        trace, trace_report,
+    )
+    from .schema import DataType, Field, Schema
+
+    rng = random.Random(seed * 52009 + 29)
+    prev_trace = bool(conf.TRACE_ENABLE.get())
+    prev_logdir = conf.EVENT_LOG_DIR.get()
+    prev_slo = bool(conf.SLO_ENABLE.get())
+    prev_eval_ms = conf.SLO_EVAL_INTERVAL_MS.get()
+    prev_hold = conf.SLO_RESOLVE_HOLD_EVALS.get()
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    conf.VERIFY_LOCKSET.set(True)
+    lockset.refresh()
+    lockset.reset()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
+    problems = []
+    spills_before = set(glob.glob(ledger.spill_glob()))
+    n_storm = 8
+    fired_events = resolved_events = 0
+    schema = Schema([Field("x", DataType.int64())])
+
+    def build_plan():
+        src = MemoryScanExec(
+            [[batch_from_pydict({"x": list(range(64))}, schema)]], schema)
+        return ProjectExec(src, [(col("x") * lit(3)).alias("y")])
+
+    try:
+        disp_before = dispatch.counters()
+        with tempfile.TemporaryDirectory(prefix="blaze_slostorm_") as td:
+            conf.TRACE_ENABLE.set(True)
+            conf.EVENT_LOG_DIR.set(td)
+            trace.reset()
+            conf.SLO_ENABLE.set(True)
+            # evaluate essentially every observation, resolve after 2
+            # consecutive clean evals (the flap-suppression hold)
+            conf.SLO_EVAL_INTERVAL_MS.set(10)
+            conf.SLO_FIRE_BURN_RATE.set(1.0)
+            conf.SLO_RESOLVE_HOLD_EVALS.set(2)
+            # the tight objective: stragglers sleep slow_ms, the p99
+            # target sits at a quarter of that — every storm query is
+            # a violation; the 2s window bounds how long the burn
+            # lingers after recovery
+            slow_ms = 80 + rng.randrange(60)
+            conf.set_conf("spark.blaze.slo.pool.etl.latencyP99Ms",
+                          slow_ms / 4.0)
+            conf.set_conf("spark.blaze.slo.pool.etl.targetWindowSec", 2.0)
+            slo.reset()
+            # phase 1 — the storm: every storm query's single task hits
+            # a seeded straggler injection and blows the objective
+            conf.FAULTS_SPEC.set(",".join(
+                f"task.compute@{i}@slow{slow_ms}"
+                for i in range(1, n_storm + 1)))
+            faults.reset()
+            for i in range(n_storm):
+                with monitor.query_span(f"slo_storm_{suite}_{i}",
+                                        mode="scheduler", pool="etl"):
+                    _rows_via_scheduler(build_plan())
+            storm_doc = slo.doc()
+            storm_firing = any(
+                s["firing"]
+                for p in storm_doc["pools"].values()
+                for s in p["slos"].values())
+            if not storm_firing:
+                problems.append(
+                    f"storm of {n_storm} stragglers ({slow_ms}ms vs "
+                    f"{slow_ms / 4.0:.0f}ms p99) never fired the "
+                    "burn-rate alert (vacuous arm)")
+            # phase 2 — recovery: clear the faults and run fast
+            # queries until the stragglers age out of the slow window
+            # and the hold releases the alert
+            conf.FAULTS_SPEC.set("")
+            faults.reset()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                with monitor.query_span(
+                        f"slo_recover_{suite}", mode="scheduler",
+                        pool="etl"):
+                    pass
+                slo.evaluate(force=True)
+                d = slo.doc()
+                if not any(s["firing"]
+                           for p in d["pools"].values()
+                           for s in p["slos"].values()):
+                    break
+                time.sleep(0.05)
+            else:
+                problems.append(
+                    "alert still firing 10s after the faults cleared "
+                    "(resolve path never engaged)")
+            disp_after = dispatch.counters()
+            events = trace_report.merge_event_logs(
+                trace_report.event_log_files(td))
+            stragglers = [e for e in events
+                          if e.get("type") == "straggler_injected"]
+            if not stragglers:
+                problems.append("no straggler_injected events — the "
+                                "storm injected nothing (vacuous arm)")
+            recon = trace_report.reconcile_slo_alerts(events)
+            fired_events = recon["fired"]
+            resolved_events = recon["resolved"]
+            if not fired_events:
+                problems.append("no slo_alert_firing event in the log")
+            if recon["still_firing"] or not recon["reconciled"]:
+                problems.append(
+                    f"slo alert pairing broken: {fired_events} fired / "
+                    f"{resolved_events} resolved, "
+                    f"{len(recon['still_firing'])} still firing, "
+                    f"{len(recon['orphan_resolves'])} orphan resolve(s)")
+
+            def delta(key):
+                return disp_after.get(key, 0) - disp_before.get(key, 0)
+
+            if delta("slo_alerts_fired") != fired_events \
+                    or delta("slo_alerts_resolved") != resolved_events:
+                problems.append(
+                    f"slo counters disagree with the event log: fired "
+                    f"{delta('slo_alerts_fired')}/{fired_events}, "
+                    f"resolved {delta('slo_alerts_resolved')}"
+                    f"/{resolved_events}")
+            if make_bundle:
+                # end-of-incident snapshot: checksummed manifest, then
+                # prove the bundle re-renders OFFLINE from its own
+                # copied logs (no access to the live log dir)
+                bdir = tempfile.mkdtemp(prefix="blaze_slo_bundle_")
+                try:
+                    manifest = bundle.write_bundle(
+                        bdir, query_id=f"slo_storm_{suite}_0")
+                    problems += bundle.verify_bundle(bdir)
+                    if not any(n.endswith(".jsonl")
+                               for n in manifest["members"]):
+                        problems.append(
+                            "bundle carries no event-log member")
+                    off = trace_report.merge_event_logs(
+                        trace_report.event_log_files(bdir))
+                    text = trace_report.render(off)
+                    if "slo alerts" not in text:
+                        problems.append("offline re-render of the "
+                                        "bundle lacks the slo section")
+                finally:
+                    shutil.rmtree(bdir, ignore_errors=True)
+        races = lockset.reported()
+        if races:
+            problems.append("lockset violation(s): " + "; ".join(races))
+        escaped = errors.escapes()
+        if escaped:
+            problems.append("FATAL-class error escape(s): "
+                            + "; ".join(escaped))
+        problems += ledger.leak_audit(spills_before=spills_before)
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith("blaze-")]
+        if leaked:
+            problems.append(f"leaked blaze-* thread(s): {leaked}")
+    except Exception as e:  # noqa: BLE001 — the arm must report, not die
+        problems.append(f"storm arm crashed: {type(e).__name__}: {e}")
+    finally:
+        conf.FAULTS_SPEC.set("")
+        faults.reset()
+        conf.TRACE_ENABLE.set(prev_trace)
+        conf.EVENT_LOG_DIR.set(prev_logdir)
+        trace.reset()
+        conf.SLO_ENABLE.set(prev_slo)
+        conf.SLO_EVAL_INTERVAL_MS.set(prev_eval_ms)
+        conf.SLO_RESOLVE_HOLD_EVALS.set(prev_hold)
+        conf.set_conf("spark.blaze.slo.pool.etl.latencyP99Ms", None)
+        conf.set_conf("spark.blaze.slo.pool.etl.targetWindowSec", None)
+        slo.reset()
+        conf.VERIFY_LOCKS.set(False)
+        lock_verify.refresh()
+        conf.VERIFY_LOCKSET.set(False)
+        lockset.refresh()
+        conf.VERIFY_ERRORS.set(False)
+        errors.refresh()
+        ledger.refresh()
+    if problems:
+        print(f"slo-storm (seed {seed}): " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    print(f"slo-storm (seed {seed}): OK ({fired_events} alert(s) fired, "
+          f"{resolved_events} resolved, reconciled"
+          + (", bundle verified" if make_bundle else "") + ")")
+    return 0
+
+
 def _run_cache_storm(suite, names, scans, build_query, n_parts,
                      seed) -> int:
     """Cache-storm chaos arm: concurrent IDENTICAL and literal-SHIFTED
@@ -2043,9 +2257,13 @@ def _shutdown_monitor_checked() -> int:
     return 0
 
 
-def _watch(target: str, interval: float, polls: int) -> int:
+def _watch(target: str, interval: float, polls: int,
+           json_out: str = "") -> int:
     """``--watch``: poll a running monitor's /queries endpoint and
-    render a refreshing stage-progress table."""
+    render a refreshing stage-progress table.  With ``--json`` each
+    poll emits the raw snapshot document as ONE JSON line instead —
+    ``-`` keeps stdout pure JSON (status chatter moves to stderr), a
+    path appends JSONL."""
     import json as _json
     import urllib.error
     import urllib.request
@@ -2076,9 +2294,20 @@ def _watch(target: str, interval: float, polls: int) -> int:
                 print(f"watch: cannot reach {url}/queries: {e}",
                       file=sys.stderr)
                 return 1
-            # clear + home, then one frame (plain append when piped)
-            prefix = "\x1b[2J\x1b[H" if sys.stdout.isatty() else ""
-            print(prefix + monitor.render_watch(snap, url), flush=True)
+            if json_out:
+                # machine-readable mode: the /queries document (which
+                # carries the workers/pool/slo blocks too) verbatim,
+                # one JSON line per poll
+                line = _json.dumps(snap, default=str)
+                if json_out == "-":
+                    print(line, flush=True)
+                else:
+                    with open(json_out, "a") as f:
+                        f.write(line + "\n")
+            else:
+                # clear + home, then one frame (plain append when piped)
+                prefix = "\x1b[2J\x1b[H" if sys.stdout.isatty() else ""
+                print(prefix + monitor.render_watch(snap, url), flush=True)
             done += 1
             if polls and done >= polls:
                 return 0
@@ -2180,7 +2409,13 @@ def main(argv=None) -> int:
                          "mid-storm source mutation, asserting "
                          "byte-identical results vs an uncached "
                          "baseline, hits + misses == submissions, and "
-                         "zero lease turns on hits); nonzero "
+                         "zero lease turns on hits) plus an slo-storm "
+                         "arm (seeded stragglers against a tight "
+                         "per-pool burn-rate objective, asserting the "
+                         "alert fires during the storm, resolves after "
+                         "recovery, and reconciles in the event log; "
+                         "the first seed also writes and verifies an "
+                         "incident debug bundle); nonzero "
                          "exit on any mismatch, unreconciled event log, "
                          "hung or untyped submission, leaked thread, "
                          "undetected corruption, unrecovered worker "
@@ -2208,6 +2443,14 @@ def main(argv=None) -> int:
                          "kernel device/dispatch/compile splits per stage "
                          "plus the plan-node tree weighted by "
                          "elapsed_compute")
+    ap.add_argument("--debug-bundle", default="", metavar="DIR",
+                    help="write an incident debug bundle into DIR after "
+                         "the run (implies --trace and arms the monitor "
+                         "registry): every event-log segment, metrics "
+                         "text, redacted conf dump, queries/workers/slo "
+                         "documents, EXPLAIN + flame stacks, and the "
+                         "verification ledgers, all checksummed in a "
+                         "manifest; re-render offline with --report DIR")
     ap.add_argument("--otel", action="store_true",
                     help="arm OTLP span export (spark.blaze.otel.enabled; "
                          "implies --trace): each query's event log exports "
@@ -2225,7 +2468,10 @@ def main(argv=None) -> int:
                          "('-' = stdout instead of the text rendering); "
                          "with --lint: write the findings as one JSON "
                          "document (rule id, path, line, symbol, waived "
-                         "flag + summary) so CI can diff lint runs")
+                         "flag + summary) so CI can diff lint runs; "
+                         "with --watch: emit one JSON snapshot per poll "
+                         "('-' = stdout stays pure JSONL) instead of the "
+                         "rendered table")
     ap.add_argument("--sarif", default="", metavar="PATH",
                     help="with --lint: also write the findings as one "
                          "SARIF 2.1.0 document ('-' = stdout, pure like "
@@ -2271,10 +2517,11 @@ def main(argv=None) -> int:
                     help="--watch: stop after N polls (0 = until ^C)")
     args = ap.parse_args(argv)
     if args.json and not (args.report or args.lint or args.explain
-                          or args.perfcheck):
+                          or args.perfcheck or args.watch is not None):
         ap.error("--json requires --report (profile as JSON), --lint "
-                 "(findings as JSON), --explain (explain document), or "
-                 "--perfcheck (measurement document)")
+                 "(findings as JSON), --explain (explain document), "
+                 "--perfcheck (measurement document), or --watch "
+                 "(one snapshot per poll)")
     if args.sarif and not args.lint:
         ap.error("--sarif requires --lint (findings as SARIF)")
     if args.sarif == "-" and args.json == "-":
@@ -2358,14 +2605,16 @@ def main(argv=None) -> int:
             from . import conf
 
             conf.MONITOR_PORT.set(args.monitor_port)
-        return _watch(args.watch, args.watch_interval, args.watch_polls)
-    if args.trace or args.event_log_dir or args.otel or args.otel_endpoint:
+        return _watch(args.watch, args.watch_interval, args.watch_polls,
+                      json_out=args.json)
+    if (args.trace or args.event_log_dir or args.otel
+            or args.otel_endpoint or args.debug_bundle):
         from . import conf
         from .runtime import trace
 
         # --event-log-dir applies on its own too: --chaos arms tracing
         # itself, and its logs must land where the user pointed
-        if args.trace or args.otel or args.otel_endpoint:
+        if args.trace or args.otel or args.otel_endpoint or args.debug_bundle:
             # OTLP export converts the event log: --otel (and a bare
             # --otel-endpoint) implies --trace — otherwise every query
             # span yields no log and the export is silently empty
@@ -2381,7 +2630,10 @@ def main(argv=None) -> int:
         if args.otel_endpoint:
             conf.OTEL_ENDPOINT.set(args.otel_endpoint)
         otel.reset()
-    monitor_armed = args.serve or args.monitor or args.service
+    # --debug-bundle needs the registry live: the bundle's queries /
+    # workers / explain / flame members all read the monitor
+    monitor_armed = (args.serve or args.monitor or args.service
+                     or bool(args.debug_bundle))
     if monitor_armed:
         from . import conf
         from .runtime import monitor
@@ -2435,9 +2687,12 @@ def main(argv=None) -> int:
             # speculation against an injected straggler, the second
             # injects a mid-query device OOM the degradation ladder
             # must absorb, and EVERY seed ends with the storm battery:
-            # cancel, admission, corruption, worker-kill, and cache
+            # cancel, admission, corruption, worker-kill, cache
             # (concurrent identical/literal-shifted submissions racing
-            # a seeded source mutation).  Datagen is seed-independent:
+            # a seeded source mutation), and slo (seeded stragglers
+            # against a tight burn-rate objective; the first seed also
+            # writes + verifies an incident debug bundle).  Datagen is
+            # seed-independent:
             # resolve the suite ONCE and share it across every seed's
             # arms.
             loaded = _load_suite(args.suite, queries, args.scale,
@@ -2469,6 +2724,8 @@ def main(argv=None) -> int:
                 rc = _run_cache_storm(args.suite, qnames, scans, bq,
                                       args.parts,
                                       args.chaos_seed + k) or rc
+                rc = _run_slo_storm(args.suite, args.chaos_seed + k,
+                                    make_bundle=(k == 0)) or rc
         elif args.chaos:
             rc = _run_chaos(args.suite, queries, args.scale, args.parts,
                             args.chaos_seed, args.chaos_faults)
@@ -2476,6 +2733,27 @@ def main(argv=None) -> int:
             rc = _run_suite(args.suite, queries, args.scale, args.parts,
                             args.scheduler)
     finally:
+        # the incident bundle snapshots LIVE state — write it before
+        # the monitor/otel teardown clears the registries (and write
+        # it even when the run raised: a crash IS the incident)
+        if args.debug_bundle:
+            from .runtime import bundle as bundle_mod
+
+            try:
+                manifest = bundle_mod.write_bundle(args.debug_bundle)
+                vb = bundle_mod.verify_bundle(args.debug_bundle)
+            except OSError as e:
+                print(f"# debug bundle FAILED: {e}", file=sys.stderr)
+                rc = rc or 1
+            else:
+                if vb:
+                    print("# debug bundle FAILED verification: "
+                          + "; ".join(vb), file=sys.stderr)
+                    rc = rc or 1
+                else:
+                    print(f"# debug bundle: {args.debug_bundle} "
+                          f"({len(manifest['members'])} members, "
+                          f"verified)")
         # every monitored mode guards the long-lived service: shutdown
         # must not leak a thread or wedge process exit, and a leak is
         # an exit-code failure, not a stderr footnote

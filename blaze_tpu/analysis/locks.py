@@ -88,6 +88,11 @@ HIERARCHY: Tuple[str, ...] = (
                              # emission happens outside)
     "shuffle.repartitioner", # per-map-task staged partition buffers
     "monitor.registry",      # live query registry
+    "monitor.workers",       # per-worker telemetry registry folded by
+                             # hostpool reader threads + pool aggregate
+                             # (held for dict arithmetic only; hostpool
+                             # calls in AFTER releasing hostpool.state,
+                             # and emission happens outside)
     "monitor.progress",      # per-stage progress counters (leaf: held
                              # only for arithmetic, emission is outside)
     "otel.state",            # OTLP export queue + pusher lifecycle
@@ -95,6 +100,12 @@ HIERARCHY: Tuple[str, ...] = (
                              # HTTP POST and file IO happen outside)
     "monitor.hist",          # latency histograms + statsd timer queue
                              # (held for bucket arithmetic only)
+    "slo.state",             # per-pool SLO sample rings + alert table
+                             # (held for ring/dict arithmetic and the
+                             # conf.store objective reads ranked
+                             # inside; alert trace emission and the
+                             # dispatch counter bumps happen strictly
+                             # after release)
     "memmgr.manager",        # host-staging budget accounting
     "metrics.node",          # MetricNode tree growth
     "metrics.set",           # per-operator counters

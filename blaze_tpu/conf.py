@@ -345,6 +345,38 @@ MONITOR_HISTORY_MAX_BYTES = ConfEntry("spark.blaze.monitor.historyMaxBytes", 4 <
 # thread.
 MONITOR_STATSD = ConfEntry("spark.blaze.monitor.statsd", "", str)
 
+# SLO layer (runtime/slo.py): per-pool latency/error objectives
+# declared as dynamic conf keys
+# (spark.blaze.slo.pool.<name>.latencyP99Ms / .errorRate /
+# .targetWindowSec) evaluated as MULTI-WINDOW BURN RATES over the
+# observed per-pool latency/error stream — the SRE-workbook alerting
+# shape: fire only when BOTH the fast and the slow window burn the
+# error budget faster than the threshold, resolve only after the burn
+# stays below it for a hold count (flap suppression).  Disarmed
+# (default) the whole layer is a structural no-op: one bool read per
+# query end, no state, no thread.
+SLO_ENABLE = ConfEntry("spark.blaze.slo.enabled", False, _bool)
+# Minimum interval (ms) between burn-rate evaluations — observe() and
+# the /slo + /metrics render paths drive evaluation opportunistically
+# (no background thread); this throttles the work, not the data.
+SLO_EVAL_INTERVAL_MS = ConfEntry("spark.blaze.slo.evalIntervalMs", 200, int)
+# Burn-rate threshold: an alert FIRES when both windows consume error
+# budget at >= this multiple of the sustainable rate (1.0 = exactly
+# exhausting the budget over the target window).
+SLO_FIRE_BURN_RATE = ConfEntry("spark.blaze.slo.fireBurnRate", 1.0, float)
+# Consecutive below-threshold evaluations required before a firing
+# alert RESOLVES — the flap suppressor.
+SLO_RESOLVE_HOLD_EVALS = ConfEntry("spark.blaze.slo.resolveHoldEvals", 2, int)
+
+# Incident debug bundles (runtime/bundle.py, `--debug-bundle <dir>` /
+# POST /queries/<id>/bundle): conf keys whose NAME matches any of
+# these comma-separated lowercase substrings have their VALUE redacted
+# in the bundle's conf dump (secrets never leave the host in a
+# forensics snapshot).
+BUNDLE_REDACT = ConfEntry(
+    "spark.blaze.bundle.redactPatterns",
+    "password,secret,token,credential,key.material", str)
+
 # Whole-stage program fusion (ops/fusion.py): collapse traceable
 # operator chains / agg pre-filters / final-agg sorts into single XLA
 # programs.  OFF runs every operator as its own dispatch — the
@@ -493,6 +525,15 @@ def set_conf(key: str, value: Any) -> None:
     """Entry point for the gateway / tests to inject Spark conf values."""
     with _lock:
         _values[key] = value
+
+
+def all_values() -> Dict[str, Any]:
+    """Every explicitly-set conf value (static AND dynamic keys) — the
+    debug bundle's conf dump source: declared entries cover defaults,
+    but only this store knows the dynamic key families (per-pool SLO
+    objectives, op toggles) an incident was running with."""
+    with _lock:
+        return dict(_values)
 
 
 def get_conf(key: str, default: Any = None) -> Any:

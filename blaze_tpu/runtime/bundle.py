@@ -1,0 +1,254 @@
+"""Incident debug bundles: one checksummed directory per incident.
+
+When an SLO alert fires at 3am, the on-call's first problem is not
+analysis — it is COLLECTION: the driver event log, each worker's own
+log segments, the /metrics text, the conf the run was actually using,
+the EXPLAIN output, flame stacks, and the verification ledgers all
+live in different places, and half of them vanish when the process
+exits.  :func:`write_bundle` snapshots all of it into one directory:
+
+- every event-log segment (driver + the worker logs the fleet
+  telemetry reported) copied to the bundle ROOT as ``*.jsonl`` — so
+  ``python -m blaze_tpu --report <bundle-dir>`` re-renders the full
+  merged profile OFFLINE with no access to the original host;
+- ``metrics.txt`` (the Prometheus rendering), ``conf.json`` (the
+  declared entries + every dynamically-set key, values REDACTED when
+  the key matches ``spark.blaze.bundle.redactPatterns``),
+  ``queries.json`` / ``workers.json`` / ``slo.json`` /
+  ``history.json`` (the live documents), ``ledger.json`` /
+  ``lockset.json`` / ``errors.json`` (the verification state),
+  ``explain.txt`` + ``flame.txt`` for the incident query, and any
+  OTLP span documents the otel file sink wrote;
+- ``manifest.json``, written LAST, checksums every member
+  (runtime/integrity.py CRC32) — :func:`verify_bundle` re-checksums,
+  so a truncated copy or a bit-rotted archive is detected instead of
+  silently mis-analyzed.
+
+Collection is BEST-EFFORT per member — an incident bundle that fails
+because one source was mid-rotation would be useless exactly when it
+is needed — but the manifest lists only what actually landed, and
+every skipped member is recorded under ``"skipped"`` so absence is
+visible, never silent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import conf
+from . import errors, integrity, ledger, lockset, trace
+
+#: manifest schema version (bump on layout changes so an old offline
+#: verifier fails loudly instead of mis-reading a new bundle)
+MANIFEST_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+
+def _redact_patterns() -> List[str]:
+    raw = str(conf.BUNDLE_REDACT.get() or "")
+    return [p.strip().lower() for p in raw.split(",") if p.strip()]
+
+
+def redact_conf(values: Dict[str, Any],
+                patterns: Optional[List[str]] = None) -> Dict[str, Any]:
+    """The conf dump with secret-looking VALUES masked: a key matching
+    any redact pattern (substring, case-insensitive, ``.`` in the
+    pattern matches literally) keeps its name — the on-call needs to
+    know the key WAS set — but its value becomes ``***``."""
+    pats = _redact_patterns() if patterns is None else patterns
+    out: Dict[str, Any] = {}
+    for k, v in values.items():
+        kl = k.lower()
+        if any(p in kl for p in pats):
+            out[k] = "***"
+        else:
+            out[k] = v
+    return out
+
+
+def _conf_dump() -> Dict[str, Any]:
+    values: Dict[str, Any] = {}
+    for key, entry in sorted(conf.declared_entries().items()):
+        values[key] = entry.get()
+    # dynamic families (slo pools, op toggles) only the store knows
+    for key, v in sorted(conf.all_values().items()):
+        values.setdefault(key, v)
+    return redact_conf(values)
+
+
+def _copy_event_logs(outdir: str) -> List[str]:
+    """Every reachable event-log segment — the driver log dir's
+    ``*.jsonl`` files plus the worker logs fleet telemetry reported —
+    copied into the bundle root (rotated ``.segN`` pieces ride along,
+    same contract as ``trace.read_event_log``).  Returns the copied
+    relpaths."""
+    from . import monitor, trace_report
+
+    sources: List[str] = []
+    d = trace.log_dir()
+    if d and os.path.isdir(d):
+        sources.extend(trace_report.event_log_files(d))
+    for p in monitor.worker_eventlogs():
+        if p not in sources:
+            sources.append(p)
+    copied: List[str] = []
+    seen: set = set()
+    for src in sources:
+        # the base file plus its rotation segments (foo.jsonl.seg1 ...)
+        pieces = [src]
+        i = 1
+        while os.path.exists(f"{src}.seg{i}"):
+            pieces.append(f"{src}.seg{i}")
+            i += 1
+        for piece in pieces:
+            base = os.path.basename(piece)
+            if base in seen:
+                # two processes with colliding basenames: disambiguate
+                base = f"{len(seen)}-{base}"
+            try:
+                shutil.copy2(piece, os.path.join(outdir, base))
+            except OSError:
+                continue
+            seen.add(base)
+            copied.append(base)
+    return copied
+
+
+def _copy_otel_spans(outdir: str) -> List[str]:
+    from . import otel
+
+    if not otel.enabled():
+        return []
+    d = otel.export_dir()
+    if not d or not os.path.isdir(d):
+        return []
+    copied: List[str] = []
+    for name in sorted(os.listdir(d)):
+        if not name.endswith("-spans.json"):
+            continue
+        try:
+            shutil.copy2(os.path.join(d, name), os.path.join(outdir, name))
+        except OSError:
+            continue
+        copied.append(name)
+    return copied
+
+
+def write_bundle(outdir: str,
+                 query_id: Optional[str] = None) -> Dict[str, Any]:
+    """Snapshot the incident state into ``outdir`` and return the
+    manifest (also written as its last member).  ``query_id`` scopes
+    the EXPLAIN/flame members to one query; omitted, they cover the
+    freshest registered query."""
+    from . import monitor, trace_report
+
+    os.makedirs(outdir, exist_ok=True)
+    members: List[str] = []
+    skipped: Dict[str, str] = {}
+
+    def _text(name: str, render) -> None:
+        try:
+            body = render()
+        except Exception as e:  # noqa: BLE001 — best-effort member;
+            # the skip is RECORDED in the manifest, and an armed run
+            # still audits the absorbed error (never a silent hole)
+            errors.absorbed(e, site=f"bundle.{name}")
+            skipped[name] = f"{type(e).__name__}: {e}"
+            return
+        if body is None:
+            skipped[name] = "unavailable"
+            return
+        with open(os.path.join(outdir, name), "w") as f:
+            f.write(body)
+        members.append(name)
+
+    def _doc(name: str, build) -> None:
+        _text(name, lambda: json.dumps(build(), indent=2, sort_keys=True,
+                                       default=str))
+
+    members.extend(_copy_event_logs(outdir))
+    members.extend(_copy_otel_spans(outdir))
+    _text("metrics.txt", monitor.render_prometheus)
+    _doc("conf.json", _conf_dump)
+    _doc("queries.json", monitor.snapshot)
+    _doc("history.json", monitor.read_history)
+    _doc("ledger.json", lambda: {"live": ledger.live(),
+                                 "leaks": ledger.leaks()})
+    _doc("lockset.json", lambda: {"counters": lockset.counters(),
+                                  "reported": lockset.reported()})
+    _doc("errors.json", lambda: {"escapes": errors.escapes(),
+                                 "counters": errors.counters()})
+    wdoc = monitor.workers_snapshot()
+    if wdoc is not None:
+        _doc("workers.json", lambda: wdoc)
+    from . import slo as slo_mod
+
+    if slo_mod.enabled():
+        _doc("slo.json", slo_mod.doc)
+    # incident-query renderings: EXPLAIN + collapsed flame stacks from
+    # the freshest (or named) registered query's event log
+    qid = query_id
+    if qid is None:
+        snap = monitor.snapshot()
+        if snap["queries"]:
+            qid = snap["queries"][-1]["query_id"]
+    if qid is not None:
+        _text("explain.txt", lambda: monitor.render_explain_for(qid))
+        _text("flame.txt", lambda: monitor.render_profile(qid))
+
+    manifest: Dict[str, Any] = {
+        "version": MANIFEST_VERSION,
+        "created_at": time.time(),
+        "query_id": qid,
+        "algo": "crc32",
+        "members": {},
+        "skipped": skipped,
+    }
+    for name in sorted(members):
+        with open(os.path.join(outdir, name), "rb") as f:
+            data = f.read()
+        manifest["members"][name] = {
+            "bytes": len(data),
+            "crc": integrity.checksum(data, integrity.ALGO_CRC32),
+        }
+    with open(os.path.join(outdir, MANIFEST_NAME), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    return manifest
+
+
+def verify_bundle(bundle_dir: str) -> List[str]:
+    """Re-checksum every manifest member; returns the problems (empty
+    list = intact).  A missing manifest is itself a problem — an
+    unverifiable bundle must never pass silently."""
+    problems: List[str] = []
+    mpath = os.path.join(bundle_dir, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        return [f"manifest unreadable: {type(e).__name__}: {e}"]
+    if manifest.get("version") != MANIFEST_VERSION:
+        problems.append(
+            f"manifest version {manifest.get('version')!r} != "
+            f"{MANIFEST_VERSION}")
+    for name, meta in sorted(manifest.get("members", {}).items()):
+        path = os.path.join(bundle_dir, name)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            problems.append(f"missing member: {name}")
+            continue
+        if len(data) != meta.get("bytes"):
+            problems.append(
+                f"size mismatch: {name} ({len(data)} != {meta['bytes']})")
+            continue
+        crc = integrity.checksum(data, integrity.ALGO_CRC32)
+        if crc != meta.get("crc"):
+            problems.append(
+                f"checksum mismatch: {name} ({crc} != {meta['crc']})")
+    return problems

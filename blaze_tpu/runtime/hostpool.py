@@ -139,6 +139,7 @@ class HostPool:
         "_blacklisted": "hostpool.state",
         "_rr": "hostpool.state",
         "_job_seq": "hostpool.state",
+        "_lost_total": "hostpool.state",
         "_degraded": "hostpool.state",
         "_closed": "hostpool.state",
     }
@@ -162,8 +163,15 @@ class HostPool:
         self._blacklisted: Set[str] = set()
         self._rr = 0
         self._job_seq = 0
+        self._lost_total = 0
         self._degraded = False
         self._closed = False
+        # fleet observability: the monitor's /workers + /healthz pool
+        # block read this pool's live stats through a weakref (pull
+        # model — the pool never blocks on the registry)
+        from . import monitor
+
+        monitor.register_pool(self)
         for name in self._names:
             self._ensure_spawned(name)
 
@@ -187,6 +195,12 @@ class HostPool:
         tp = trace.current_traceparent()
         if tp:
             env["BLAZE_TRACEPARENT"] = tp
+        # a traced driver arms tracing in its workers too, pointed at
+        # the SAME event-log directory, so ``--report <dir>`` merges
+        # the worker segments without any copying (explicit env wins)
+        if trace.enabled():
+            env.setdefault("BLAZE_TRACE_ENABLED", "1")
+            env.setdefault("BLAZE_EVENTLOG_DIR", trace.log_dir())
         proc = subprocess.Popen(
             [sys.executable, "-m", "blaze_tpu.runtime.worker", "--serve"],
             env=env,
@@ -196,6 +210,9 @@ class HostPool:
         )
         ledger_key = f"pool_worker:{name}:{proc.pid}"
         ledger.acquire("scoped", ledger_key)
+        from . import monitor
+
+        monitor.worker_register(name, proc.pid)
         w = _Worker(name, proc, ledger_key)
         t = threading.Thread(target=self._read_loop, args=(w,),
                              name=f"blaze-pool-read-{name}", daemon=True)
@@ -230,9 +247,19 @@ class HostPool:
         """Per-worker reader: every frame stamps liveness; ``done``
         replies queue for the waiter.  EOF (worker exit, SIGKILL, torn
         frame at death) publishes a None sentinel so a blocked waiter
-        wakes immediately."""
+        wakes immediately.
+
+        Telemetry folding: frames stamped with the worker payload
+        protocol (``v`` == worker.TELEMETRY_VERSION + a ``tm`` delta
+        dict) fold into the monitor's per-worker registry; a ``done``
+        frame carrying one also lands a ``worker_telemetry`` trace
+        event.  Unversioned frames (an OLD worker binary, or a worker
+        with nothing new to report) fold nothing — liveness and job
+        routing never depended on the payload."""
         from ..io.ipc_compression import IpcFrameReader
+        from . import monitor
         from .integrity import BlockCorruptionError
+        from .worker import TELEMETRY_VERSION
 
         try:
             for payload in IpcFrameReader(w.proc.stdout, site="pool.frame"):
@@ -242,6 +269,18 @@ class HostPool:
                     continue
                 w.last_beat = time.monotonic_ns()
                 t = msg.get("t")
+                tm = msg.get("tm")
+                if (msg.get("v") == TELEMETRY_VERSION
+                        and isinstance(tm, dict)):
+                    monitor.worker_beat(w.name, msg.get("pid"), tm)
+                    if t == "done":
+                        fields = {
+                            k: tm[k] for k in
+                            ("jobs_ok", "jobs_failed", "rows", "bytes",
+                             "device_ns", "dispatch_ns", "compile_ns",
+                             "mem_peak", "eventlog") if k in tm}
+                        trace.emit("worker_telemetry", worker=w.name,
+                                   pid=int(msg.get("pid") or 0), **fields)
                 if t == "ready":
                     w.ready = True
                 elif t == "done":
@@ -306,6 +345,7 @@ class HostPool:
         slot is dead or blacklisted — the pool is DEGRADED and the
         caller executes in-process instead of failing the query."""
         respawn: List[str] = []
+        readmitted: List[str] = []
         newly_degraded = False
         chosen: Optional[str] = None
         with self._lock:
@@ -320,6 +360,7 @@ class HostPool:
                 self._failures[name] = fails
                 if len(fails) < self._max_failures:
                     self._blacklisted.discard(name)  # decayed: re-admit
+                    readmitted.append(name)
             live = [n for n in self._names if n not in self._blacklisted]
             if not live:
                 if not self._degraded:
@@ -336,6 +377,11 @@ class HostPool:
             dispatch.record("pool_degraded")
             trace.emit("pool_degraded", stage_id=stage_id, task=t,
                        reason="all workers dead or blacklisted")
+        if readmitted:
+            from . import monitor
+
+            for name in readmitted:
+                monitor.worker_status(name, blacklisted=False)
         for name in respawn:
             self._ensure_spawned(name)
         return chosen
@@ -344,6 +390,24 @@ class HostPool:
         with self._lock:
             lockset.check(self, "_degraded")
             return self._degraded
+
+    def stats(self) -> Dict[str, int]:
+        """The pool-level health block ``/healthz`` and ``/workers``
+        serve: configured size, live/blacklisted slot counts, total
+        losses, degraded flag.  Shape pinned by
+        ``monitor.HEALTHZ_POOL_KEYS``."""
+        with self._lock:
+            lockset.check(self, "_slots", "_blacklisted", "_lost_total",
+                          "_degraded")
+            live = sum(1 for w in self._slots.values()
+                       if not w.eof and w.proc.poll() is None)
+            return {
+                "workers": len(self._names),
+                "live": live,
+                "lost": self._lost_total,
+                "blacklisted": len(self._blacklisted),
+                "degraded": bool(self._degraded),
+            }
 
     def heartbeat_ages(self) -> Dict[str, float]:
         """Heartbeat age (seconds) per live worker — the pool's
@@ -499,9 +563,10 @@ class HostPool:
             ledger.release("scoped", w.ledger_key)
             if w.thread is not None:
                 w.thread.join(timeout=2.0)
-        from . import dispatch
+        from . import dispatch, monitor
 
         dispatch.record("worker_kills")
+        monitor.worker_status(name, alive=False)
 
     def _worker_lost(self, name: str, reason: str) -> None:
         """Declare a slot's worker DEAD: reap the process, drain its
@@ -522,12 +587,17 @@ class HostPool:
             blacklist = n_fails >= self._max_failures
             if blacklist:
                 self._blacklisted.add(name)
+            self._lost_total += 1
         # syscalls, ledger accounting, and emission OUTSIDE the lock
         if w is not None:
             terminate_process_group(w.proc)
             ledger.release("scoped", w.ledger_key)
             if w.thread is not None:
                 w.thread.join(timeout=2.0)
+        from . import monitor
+
+        monitor.worker_status(name, alive=False, lost_inc=1,
+                              blacklisted=blacklist)
         if blacklist:
             from . import dispatch
 

@@ -46,12 +46,13 @@ import os
 import re
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, Optional
 
 from .. import conf
 from ..analysis.locks import make_lock
-from . import errors, ledger, lockset, otel, trace
+from . import errors, ledger, lockset, otel, slo, trace
 
 # --------------------------------------------------------------- state
 
@@ -68,8 +69,10 @@ GUARDED_BY = {"_QUERIES": "monitor.registry",
               "_updates": "monitor.registry",
               "_seq": "monitor.registry",
               "_HISTOGRAMS": "monitor.hist",
-              "_TIMERS": "monitor.hist"}
-GUARDED_REFS = ("_QUERIES", "_HISTOGRAMS", "_TIMERS")
+              "_TIMERS": "monitor.hist",
+              "_WORKERS": "monitor.workers",
+              "_POOL_REF": "monitor.workers"}
+GUARDED_REFS = ("_QUERIES", "_HISTOGRAMS", "_TIMERS", "_WORKERS")
 _loaded = False
 _armed = False
 _hb_ns = 1_000_000_000
@@ -97,6 +100,25 @@ SCHED_COUNTERS = ("task_attempts", "task_retries", "task_timeouts",
                   "fetch_failures", "map_stage_reruns", "map_tasks_rerun",
                   "speculative_attempts", "speculative_won",
                   "speculative_lost")
+
+#: per-worker fleet telemetry folded from the hostpool's framed hb/done
+#: payloads (runtime/worker.py TELEMETRY_VERSION) — its own LEAF lock
+#: so pool reader threads folding beats never contend with registry
+#: reads, and hostpool may fold while holding hostpool.state (which
+#: ranks outside every monitor lock)
+_workers_lock = make_lock("monitor.workers")
+_WORKERS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+_MAX_WORKERS = 64
+
+#: weakref to the registered HostPool — a PULL model: /workers and
+#: /healthz read pool.stats() on demand instead of the pool pushing,
+#: so a dead pool simply vanishes from the docs (no unregister path to
+#: forget)
+_POOL_REF: Optional["weakref.ref"] = None
+
+#: additive telemetry-delta fields a worker beat may carry
+WORKER_TM_FIELDS = ("rows", "bytes", "jobs_ok", "jobs_failed",
+                    "device_ns", "dispatch_ns", "compile_ns")
 
 
 def _load() -> None:
@@ -130,7 +152,7 @@ def heartbeat_ns() -> int:
 def reset() -> None:
     """(Re)load arming + cadence from conf and clear the registry —
     call after changing ``spark.blaze.monitor.*`` keys."""
-    global _updates, _seq
+    global _updates, _seq, _POOL_REF
     _load()
     with _lock:
         _QUERIES.clear()
@@ -140,6 +162,10 @@ def reset() -> None:
         lockset.check(_REG, "_HISTOGRAMS", "_TIMERS")
         _HISTOGRAMS.clear()
         _TIMERS.clear()
+    with _workers_lock:
+        lockset.check(_REG, "_WORKERS")
+        _WORKERS.clear()
+        _POOL_REF = None
 
 
 def counters() -> Dict[str, int]:
@@ -500,6 +526,7 @@ def query_span(query_id: str, mode: str = "in-process",
     t0 = time.perf_counter_ns()
     log_path = None
     tid = trace_id
+    ok = True
     try:
         with trace.query(query_id, trace_id=trace_id,
                          parent_span_id=parent_span) as log_path:
@@ -514,6 +541,10 @@ def query_span(query_id: str, mode: str = "in-process",
                     # EXPLAIN ANALYZE from it after the run
                     set_query_eventlog(log_path)
                     yield log_path
+    except BaseException:
+        # SLO error accounting only — the failure propagates untouched
+        ok = False
+        raise
     finally:
         # the per-query resource-ledger assertion (runtime/ledger.py,
         # armed via spark.blaze.verify.errors): every spill file,
@@ -526,6 +557,10 @@ def query_span(query_id: str, mode: str = "in-process",
             dt = (time.perf_counter_ns() - t0) / 1e9
             observe_hist("blaze_query_latency_seconds", dt, trace_id=tid)
             record_timer("blaze_query_latency_ms", dt * 1e3)
+        # per-pool SLO accounting (runtime/slo.py): every span exit is
+        # one sample — latency + ok/failed — judged against the pool's
+        # conf-declared burn-rate objectives.  One bool read disarmed.
+        slo.observe(pool, (time.perf_counter_ns() - t0) / 1e9, ok)
         if otel.enabled() and log_path is not None:
             # the event log is complete here (query_end emitted by the
             # trace span's own finally): convert + sink, best-effort
@@ -808,6 +843,20 @@ def snapshot(include_history: bool = False) -> Dict[str, Any]:
     svc = _service_stats()
     if svc is not None:
         doc["service"] = svc
+    # fleet telemetry: per-worker folded beats + pool aggregate (only
+    # when a pool registered or telemetry arrived — a single-process
+    # run's /queries document is unchanged)
+    wdoc = workers_snapshot()
+    if wdoc is not None:
+        doc["workers"] = wdoc["workers"]
+        if "pool" in wdoc:
+            doc["pool"] = wdoc["pool"]
+    # per-pool SLO burn state (armed runs only; drives an evaluation
+    # first so a scrape never serves stale alert state)
+    if slo.enabled():
+        sdoc = slo.doc()
+        if sdoc.get("pools"):
+            doc["slo"] = sdoc["pools"]
     return doc
 
 
@@ -845,6 +894,157 @@ def heartbeat_ages() -> Dict[str, float]:
         lockset.check(_REG, "_QUERIES")
         return {q["query_id"]: (now - q["last_beat"]) / 1e9
                 for q in _QUERIES.values() if q["status"] == "running"}
+
+
+# ------------------------------------------------------ fleet telemetry
+
+def _new_worker(name: str) -> Dict[str, Any]:
+    e: Dict[str, Any] = {"name": name, "pid": 0, "alive": True,
+                         "blacklisted": False, "spawns": 0, "lost": 0,
+                         "last_beat_ns": 0, "mem_peak": 0,
+                         "eventlogs": [], "counters": {}}
+    for k in WORKER_TM_FIELDS:
+        e[k] = 0
+    return e
+
+
+def register_pool(pool: Any) -> None:
+    """Remember the live HostPool (weakly) so /workers, /healthz and
+    /metrics can pull ``pool.stats()`` on demand.  Ungated: storing a
+    weakref costs nothing disarmed, and a pool created BEFORE the
+    monitor is armed still shows up afterwards."""
+    global _POOL_REF
+    ref = weakref.ref(pool)
+    with _workers_lock:
+        lockset.check(_REG, "_WORKERS")
+        _POOL_REF = ref
+
+
+def worker_register(name: str, pid: Any) -> None:
+    """A pool slot spawned (or respawned) a worker process — open its
+    telemetry entry.  Entries are keyed by SLOT name, so counters
+    accumulate across respawns and ``spawns`` counts the incarnations."""
+    if not enabled():
+        return
+    now = time.monotonic_ns()
+    with _workers_lock:
+        lockset.check(_REG, "_WORKERS")
+        e = _WORKERS.get(name)
+        if e is None:
+            e = _WORKERS[name] = _new_worker(name)
+            # evict oldest DEAD entries past the cap — live slots stay
+            while len(_WORKERS) > _MAX_WORKERS:
+                victim = next((k for k, v in _WORKERS.items()
+                               if not v["alive"]), None)
+                if victim is None:
+                    break
+                del _WORKERS[victim]
+        e["pid"] = int(pid or 0)
+        e["alive"] = True
+        e["spawns"] += 1
+        e["last_beat_ns"] = now
+
+
+def worker_beat(name: str, pid: Any, tm: Dict[str, Any]) -> None:
+    """Fold one hb/done telemetry delta into the worker's entry (the
+    hostpool reader thread calls this per versioned frame).  Deltas are
+    ADDITIVE except ``mem_peak`` (a high-water mark, folded with max)
+    and ``eventlog`` (a path set — segment rotation appends)."""
+    if not enabled():
+        return
+    now = time.monotonic_ns()
+    with _workers_lock:
+        lockset.check(_REG, "_WORKERS")
+        e = _WORKERS.get(name)
+        if e is None:
+            e = _WORKERS[name] = _new_worker(name)
+        e["alive"] = True
+        if pid:
+            e["pid"] = int(pid)
+        e["last_beat_ns"] = now
+        for k in WORKER_TM_FIELDS:
+            if k in tm:
+                e[k] += int(tm[k])
+        for ck, cv in (tm.get("counters") or {}).items():
+            e["counters"][ck] = e["counters"].get(ck, 0) + int(cv)
+        if "mem_peak" in tm:
+            e["mem_peak"] = max(e["mem_peak"], int(tm["mem_peak"]))
+        log = tm.get("eventlog")
+        if log and log not in e["eventlogs"]:
+            e["eventlogs"].append(log)
+
+
+def worker_status(name: str, alive: Optional[bool] = None,
+                  blacklisted: Optional[bool] = None,
+                  lost_inc: int = 0) -> None:
+    """Lifecycle flips from the pool: loss (``alive=False`` +
+    ``lost_inc``), blacklisting, and decay re-admission
+    (``blacklisted=False``)."""
+    if not enabled():
+        return
+    with _workers_lock:
+        lockset.check(_REG, "_WORKERS")
+        e = _WORKERS.get(name)
+        if e is None:
+            e = _WORKERS[name] = _new_worker(name)
+        if alive is not None:
+            e["alive"] = bool(alive)
+        if blacklisted is not None:
+            e["blacklisted"] = bool(blacklisted)
+        e["lost"] += int(lost_inc)
+
+
+def pool_stats() -> Optional[Dict[str, Any]]:
+    """The registered pool's live/lost/blacklisted/degraded stats (None
+    when no pool is registered or it has been collected).  Acquires
+    hostpool.state via ``pool.stats()`` — hostpool.state ranks OUTSIDE
+    every monitor lock, so this must be (and is) called while holding
+    none of them."""
+    with _workers_lock:
+        lockset.check(_REG, "_WORKERS")
+        ref = _POOL_REF
+    pool = ref() if ref is not None else None
+    if pool is None:
+        return None
+    return pool.stats()
+
+
+def workers_snapshot() -> Optional[Dict[str, Any]]:
+    """The /workers JSON document: per-worker folded telemetry rows +
+    the pool aggregate (None when no pool registered AND no telemetry
+    arrived — the endpoint 404s instead of serving an empty fleet)."""
+    pstats = pool_stats()  # BEFORE _workers_lock: takes hostpool.state
+    now = time.monotonic_ns()
+    rows: List[Dict[str, Any]] = []
+    with _workers_lock:
+        lockset.check(_REG, "_WORKERS")
+        for e in _WORKERS.values():
+            d = dict(e, counters=dict(e["counters"]),
+                     eventlogs=list(e["eventlogs"]))
+            beat = d.pop("last_beat_ns")
+            d["heartbeat_age_s"] = (round((now - beat) / 1e9, 3)
+                                    if e["alive"] and beat else None)
+            rows.append(d)
+    if pstats is None and not rows:
+        return None
+    doc: Dict[str, Any] = {"workers": rows}
+    if pstats is not None:
+        doc["pool"] = pstats
+    return doc
+
+
+def worker_eventlogs() -> List[str]:
+    """Every distinct worker event-log path the fleet reported —
+    ``--report <dir>`` and the debug bundle merge these segments next
+    to the driver's own log."""
+    with _workers_lock:
+        lockset.check(_REG, "_WORKERS")
+        out: List[str] = []
+        for e in _WORKERS.values():
+            for p in e["eventlogs"]:
+                if p not in out:
+                    out.append(p)
+        return out
 
 
 def render_profile(key_or_id: str) -> Optional[str]:
@@ -1396,19 +1596,31 @@ HEALTHZ_SERVICE_KEYS = ("running", "queued", "max_concurrent",
                         "max_queued", "shed_total", "quota_cancelled",
                         "accepting")
 
+#: golden-pinned keys of the /healthz ``pool`` fleet block — the
+#: worker-host aggregate a load balancer or autoscaler keys on (same
+#: two-way gate discipline as the service block: add freely, never
+#: rename)
+HEALTHZ_POOL_KEYS = ("workers", "live", "lost", "blacklisted",
+                     "degraded")
+
 
 def healthz_doc() -> Dict[str, Any]:
     """The /healthz response body.  With an active query service the
     ``service`` block carries the admission state — queue depth,
     running count, cumulative shed totals, and an ``accepting`` verdict
     — so a load balancer can drain a saturated node BEFORE submissions
-    start bouncing off 429s."""
+    start bouncing off 429s.  With a registered worker-host pool the
+    ``pool`` block carries the fleet aggregate (live/lost/blacklisted
+    counts + the degraded flag), so an autoscaler sees capacity erosion
+    before queries start straggling."""
     doc: Dict[str, Any] = {
         "status": "ok",
         "endpoints": ["/metrics", "/queries", "/queries?all=1",
                       "/queries/<id>/profile",
                       "/queries/<id>/explain", "/healthz",
+                      "/workers", "/slo",
                       "POST /queries/<id>/cancel",
+                      "POST /queries/<id>/bundle",
                       "POST /service/submit"],
     }
     svc = _service_stats()
@@ -1425,6 +1637,15 @@ def healthz_doc() -> Dict[str, Any]:
             # False = the next submission sheds with a 429
             "accepting": (svc["running"] < svc["max_concurrent"]
                           or svc["queued"] < svc["max_queued"]),
+        }
+    pstats = pool_stats()
+    if pstats is not None:
+        doc["pool"] = {
+            "workers": pstats["workers"],
+            "live": pstats["live"],
+            "lost": pstats["lost"],
+            "blacklisted": pstats["blacklisted"],
+            "degraded": bool(pstats["degraded"]),
         }
     return doc
 
@@ -1622,6 +1843,53 @@ def render_prometheus(openmetrics: bool = False) -> str:
                         mtype="gauge")
             doc.add("blaze_service_pool_mem_used_bytes",
                     pool_mem.get(name, 0), pl, mtype="gauge")
+    # fleet telemetry (runtime/hostpool.py framed hb/done payloads):
+    # one series per worker SLOT — counters accumulate across respawns,
+    # ns splits export as seconds like every other duration family
+    for w in snap.get("workers", ()):
+        wl = {"worker": w["name"]}
+        doc.add("blaze_worker_jobs_ok", w["jobs_ok"], wl, mtype="gauge")
+        doc.add("blaze_worker_jobs_failed", w["jobs_failed"], wl,
+                mtype="gauge")
+        doc.add("blaze_worker_rows_total", w["rows"], wl, mtype="gauge")
+        doc.add("blaze_worker_bytes_total", w["bytes"], wl, mtype="gauge")
+        doc.add("blaze_worker_device_seconds",
+                round(w["device_ns"] / 1e9, 6), wl, mtype="gauge")
+        doc.add("blaze_worker_dispatch_seconds",
+                round(w["dispatch_ns"] / 1e9, 6), wl, mtype="gauge")
+        doc.add("blaze_worker_compile_seconds",
+                round(w["compile_ns"] / 1e9, 6), wl, mtype="gauge")
+        doc.add("blaze_worker_mem_peak_bytes", w["mem_peak"], wl,
+                mtype="gauge")
+        # the heartbeat-age rule again: a lost worker's last beat is
+        # frozen, so its age would climb forever — live workers only
+        if w.get("heartbeat_age_s") is not None:
+            doc.add("blaze_worker_heartbeat_age_seconds",
+                    w["heartbeat_age_s"], wl, mtype="gauge")
+        doc.add("blaze_worker_blacklisted", int(w["blacklisted"]), wl,
+                mtype="gauge")
+    pstats = snap.get("pool")
+    if pstats:
+        doc.add("blaze_pool_workers", pstats["workers"], mtype="gauge")
+        doc.add("blaze_pool_live_workers", pstats["live"], mtype="gauge")
+        doc.add("blaze_pool_lost_workers", pstats["lost"], mtype="gauge")
+        doc.add("blaze_pool_blacklisted_workers", pstats["blacklisted"],
+                mtype="gauge")
+        doc.add("blaze_pool_degraded", int(pstats["degraded"]),
+                mtype="gauge")
+    # SLO burn state (runtime/slo.py): labels pool + slo kind, so one
+    # alert rule (`blaze_slo_alert_firing > 0`) covers every objective
+    for pname, pdoc in sorted((snap.get("slo") or {}).items()):
+        for kind, s in sorted(pdoc.get("slos", {}).items()):
+            sl = {"pool": pname, "slo": kind}
+            doc.add("blaze_slo_burn_rate_fast",
+                    round(s["burn_fast"], 6), sl, mtype="gauge")
+            doc.add("blaze_slo_burn_rate_slow",
+                    round(s["burn_slow"], 6), sl, mtype="gauge")
+            doc.add("blaze_slo_alert_firing", int(s["firing"]), sl,
+                    mtype="gauge")
+            doc.add("blaze_slo_budget_remaining",
+                    round(s["budget_remaining"], 6), sl, mtype="gauge")
     return doc.render() + hist_text
 
 
@@ -1711,6 +1979,21 @@ class MonitorServer:
                             return
                         body = text.encode()
                         ctype = "text/plain; charset=utf-8"
+                    elif path == "/workers":
+                        # the fleet document: per-worker folded
+                        # telemetry + pool aggregate (404 when no pool
+                        # ever registered — nothing to observe)
+                        wdoc = workers_snapshot()
+                        if wdoc is None:
+                            self.send_error(404)
+                            return
+                        body = json.dumps(wdoc).encode()
+                        ctype = "application/json"
+                    elif path == "/slo":
+                        # burn-rate state per pool objective (drives an
+                        # evaluation first — never stale alert state)
+                        body = json.dumps(slo.doc()).encode()
+                        ctype = "application/json"
                     elif path in ("/", "/healthz"):
                         body = json.dumps(healthz_doc()).encode()
                         ctype = "application/json"
@@ -1772,6 +2055,44 @@ class MonitorServer:
                             "error": f"{type(e).__name__}: {e}"}
                     body = json.dumps(out).encode()
                     self.send_response(status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                mb = re.match(r"^/queries/([^/]+)/bundle$", path)
+                if mb is not None:
+                    # incident debug bundle for one query: body may
+                    # carry {"dir": ...}; default is a fresh tempdir.
+                    # The handler snapshots, checksums, and answers
+                    # with the manifest summary — offline rendering is
+                    # `python -m blaze_tpu --report <dir>`.
+                    from . import bundle as bundle_mod
+
+                    try:
+                        n = int(self.headers.get("Content-Length", 0) or 0)
+                        doc = json.loads(self.rfile.read(n) or b"{}")
+                        outdir = doc.get("dir") or ""
+                        if not outdir:
+                            import tempfile
+
+                            outdir = tempfile.mkdtemp(
+                                prefix="blaze-bundle-")
+                        manifest = bundle_mod.write_bundle(
+                            outdir, query_id=mb.group(1))
+                    except Exception as e:  # noqa: BLE001 — typed
+                        # status, not a dead thread (audited swallow
+                        # site)
+                        errors.absorbed(e, site="monitor.handler.bundle")
+                        self.send_error(http_status_for(e),
+                                        explain=f"{type(e).__name__}: {e}")
+                        return
+                    body = json.dumps({
+                        "dir": outdir,
+                        "members": sorted(manifest["members"]),
+                        "algo": manifest["algo"],
+                    }).encode()
+                    self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
@@ -2091,6 +2412,37 @@ def render_watch(snap: Dict[str, Any], url: str = "") -> str:
                 f"run {p['running']} queued {p['queued']} "
                 f"lease {p['charged_ns'] / 1e9:.2f}s "
                 f"(contended {p['contended_ns'] / 1e9:.2f}s)")
+    # the fleet story: pool aggregate + one line per worker slot with
+    # its folded telemetry (rows/bytes, the kernel dev/disp split, the
+    # heartbeat age a wedged worker shows growing)
+    pool = snap.get("pool")
+    if pool:
+        lines.append(
+            f"fleet: {pool['live']}/{pool['workers']} live  "
+            f"lost {pool['lost']}  blacklisted {pool['blacklisted']}"
+            + ("  DEGRADED" if pool.get("degraded") else ""))
+    for w in snap.get("workers", ()):
+        if w["blacklisted"]:
+            state = "blacklist"
+        else:
+            state = "live" if w["alive"] else "lost"
+        age = w.get("heartbeat_age_s")
+        beat = f"beat {age:.1f}s" if age is not None else "beat --"
+        lines.append(
+            f"  worker {w['name']:>8s} [{state:9s}] {beat:>11s}  "
+            f"jobs {w['jobs_ok']}+{w['jobs_failed']}f  "
+            f"rows {w['rows']:,d} {_human_bytes(w['bytes'])}  "
+            f"dev/disp {w['device_ns'] / 1e6:.0f}"
+            f"/{w['dispatch_ns'] / 1e6:.0f}ms")
+    # the SLO story: burn rates per pool objective, FIRING in caps the
+    # way --watch flags every other incident state
+    for pname, pdoc in sorted((snap.get("slo") or {}).items()):
+        for kind, s in sorted(pdoc.get("slos", {}).items()):
+            mark = "FIRING" if s["firing"] else "ok"
+            lines.append(
+                f"slo {pname}/{kind}: {mark}  "
+                f"burn fast {s['burn_fast']:.2f} slow {s['burn_slow']:.2f}"
+                f"  budget {s['budget_remaining'] * 100:.0f}%")
     if not queries:
         lines.append("  (no queries registered yet)")
         return "\n".join(lines)

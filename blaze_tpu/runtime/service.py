@@ -588,6 +588,15 @@ class QueryService:
         (monitor rendering + HTTP submit), and start the supervisor."""
         memmgr.set_quota_hook(self._quota_check)
         _set_active(self)
+        # pre-register every conf-declared SLO pool (runtime/slo.py)
+        # so a zero-traffic pool still shows its objectives in /slo
+        from . import slo
+
+        for key in conf.all_values():
+            if key.startswith("spark.blaze.slo.pool."):
+                rest = key[len("spark.blaze.slo.pool."):]
+                if "." in rest:
+                    slo.register_pool(rest.rsplit(".", 1)[0])
         self._supervisor = threading.Thread(
             target=self._supervise, daemon=True,
             name="blaze-service-supervisor")

@@ -1,0 +1,319 @@
+"""Per-pool SLO objectives evaluated as multi-window burn rates.
+
+The service (PR 11) meters per-pool latency and errors and PR 12's
+histograms export them, but nothing JUDGES them: a straggler storm
+that blows a pool's p99 is visible only to a human reading
+``--watch``.  This module closes that gap with the SRE-workbook
+alerting shape:
+
+- **Objectives** are conf-declared per pool via the dynamic key family
+  ``spark.blaze.slo.pool.<name>.latencyP99Ms`` (p99 latency target,
+  implied 1% violation budget), ``.errorRate`` (failed-query budget as
+  a fraction), and ``.targetWindowSec`` (the budget's accounting
+  window, default 3600).  A pool with neither latency nor error
+  objective has no SLO and costs nothing.
+- **Burn rate** = (observed bad fraction) / (budgeted bad fraction): a
+  burn of 1.0 consumes exactly the whole budget over the target
+  window; 10 consumes it 10x too fast.  Evaluated over TWO windows —
+  the slow window (the target window itself) and a fast window
+  (window/12, the workbook's 1h:5m ratio) — an alert FIRES only when
+  BOTH burn at >= ``spark.blaze.slo.fireBurnRate``: the fast window
+  gives detection latency, the slow window keeps a brief blip from
+  paging.
+- **Flap suppression**: a firing alert RESOLVES only after the burn
+  stays below threshold for ``spark.blaze.slo.resolveHoldEvals``
+  consecutive evaluations.
+- Transitions emit paired ``slo_alert_firing`` / ``slo_alert_resolved``
+  trace events (reconciled by ``trace_report.reconcile_slo_alerts``)
+  and bump the ``slo_alerts_fired`` / ``slo_alerts_resolved`` dispatch
+  counters; live state is served by ``/slo``, ``blaze_slo_*`` gauges,
+  and a ``--watch`` line.
+
+There is NO background thread: ``observe`` (called from every
+``monitor.query_span`` exit with the span's pool) and the ``/slo`` /
+``/metrics`` render paths drive :func:`evaluate` opportunistically,
+throttled by ``spark.blaze.slo.evalIntervalMs``.  Disarmed (the
+default) the module is a structural no-op exactly like
+``trace.enabled()``: one bool read per query end, no state, no lock.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from .. import conf
+from ..analysis.locks import make_lock
+from . import dispatch, lockset, trace
+
+# --------------------------------------------------------------- state
+
+_lock = make_lock("slo.state")
+_REG = lockset.module_guard(__name__)
+
+#: guarded-by declaration (analysis/guarded.py): samples arrive from
+#: query threads, evaluation runs on whichever thread trips the
+#: interval, and /slo handler threads read the alert table;
+#: _armed/_loaded and the cached knobs are load-once config reads and
+#: stay undeclared like trace._armed.
+GUARDED_BY = {"_SAMPLES": "slo.state",
+              "_ALERTS": "slo.state",
+              "_POOLS": "slo.state",
+              "_last_eval_ns": "slo.state"}
+GUARDED_REFS = ("_SAMPLES", "_ALERTS", "_POOLS")
+
+_loaded = False
+_armed = False
+_eval_interval_ns = 200_000_000
+_fire_burn = 1.0
+_hold_evals = 2
+
+#: implied violation budget of a p99 latency objective: 1% of queries
+#: may exceed the target (that is what "p99 <= X" means)
+LATENCY_BUDGET = 0.01
+
+#: per-pool observation ring: (monotonic_ns, latency_s, ok) — pruned
+#: past the pool's slow window on every append, hard-capped so a
+#: misconfigured giant window can never grow unbounded
+_SAMPLES: Dict[str, Deque[Tuple[int, float, bool]]] = {}
+_MAX_SAMPLES = 4096
+
+#: alert state per (pool, slo-kind): firing flag, fire timestamp,
+#: consecutive below-threshold evaluations, last burn numbers
+_ALERTS: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+#: pools we evaluate: every pool ever observed plus explicit
+#: registrations from the service's pool table
+_POOLS: Dict[str, bool] = {}
+
+_last_eval_ns = 0
+
+
+def _load() -> None:
+    global _loaded, _armed, _eval_interval_ns, _fire_burn, _hold_evals
+    with _lock:
+        _armed = bool(conf.SLO_ENABLE.get())
+        _eval_interval_ns = max(
+            0, int(conf.SLO_EVAL_INTERVAL_MS.get())) * 1_000_000
+        _fire_burn = float(conf.SLO_FIRE_BURN_RATE.get())
+        _hold_evals = max(1, int(conf.SLO_RESOLVE_HOLD_EVALS.get()))
+        _loaded = True
+
+
+def enabled() -> bool:
+    """SLO-layer arming (conf ``spark.blaze.slo.enabled``).  Lazily
+    loads conf once; call :func:`reset` after flipping it."""
+    if not _loaded:
+        _load()
+    return _armed
+
+
+def reset() -> None:
+    """(Re)load arming + knobs from conf and clear all observation and
+    alert state — call after changing ``spark.blaze.slo.*`` keys."""
+    global _last_eval_ns
+    _load()
+    with _lock:
+        lockset.check(_REG, "_SAMPLES", "_ALERTS", "_POOLS")
+        _SAMPLES.clear()
+        _ALERTS.clear()
+        _POOLS.clear()
+        _last_eval_ns = 0
+
+
+def register_pool(name: str) -> None:
+    """Pre-register a pool for evaluation (the service calls this for
+    every conf-declared pool so a pool with zero traffic still shows
+    its objectives in ``/slo``)."""
+    if not enabled():
+        return
+    with _lock:
+        lockset.check(_REG, "_POOLS")
+        _POOLS[str(name)] = True
+
+
+def objectives(pool: str) -> Optional[Dict[str, float]]:
+    """The conf-declared objectives for ``pool``, or None when the
+    pool has no SLO (neither a latency nor an error objective set)."""
+    lat = conf.get_conf(f"spark.blaze.slo.pool.{pool}.latencyP99Ms")
+    err = conf.get_conf(f"spark.blaze.slo.pool.{pool}.errorRate")
+    if lat is None and err is None:
+        return None
+    win = conf.get_conf(f"spark.blaze.slo.pool.{pool}.targetWindowSec")
+    out: Dict[str, float] = {
+        "window_sec": float(win) if win is not None else 3600.0}
+    if lat is not None:
+        out["latency_p99_ms"] = float(lat)
+    if err is not None:
+        out["error_rate"] = float(err)
+    return out
+
+
+def burn_rate(bad: int, total: int, budget: float) -> float:
+    """The burn rate of a window: observed bad fraction over budgeted
+    bad fraction.  0.0 on an empty window (no evidence is not a
+    violation) and on a zero/negative budget (objective disabled)."""
+    if total <= 0 or budget <= 0.0:
+        return 0.0
+    return (bad / total) / budget
+
+
+def fast_window_sec(window_sec: float) -> float:
+    """The fast detection window for a slow window: the SRE workbook's
+    1h-vs-5m ratio (window/12), floored so a pathologically small
+    target window still integrates more than one sample."""
+    return max(window_sec / 12.0, 0.05)
+
+
+def _window_counts(samples: Deque[Tuple[int, float, bool]],
+                   now_ns: int, window_s: float,
+                   lat_ms: Optional[float]) -> Tuple[int, int, int]:
+    """(total, latency violations, errors) within the window."""
+    cut = now_ns - int(window_s * 1e9)
+    total = bad_lat = bad_err = 0
+    for (t, lat_s, ok) in samples:
+        if t < cut:
+            continue
+        total += 1
+        if lat_ms is not None and lat_s * 1000.0 > lat_ms:
+            bad_lat += 1
+        if not ok:
+            bad_err += 1
+    return total, bad_lat, bad_err
+
+
+def observe(pool: Optional[str], latency_s: float, ok: bool) -> None:
+    """Record one finished query for ``pool`` (None = "default") and
+    opportunistically evaluate.  Called from every
+    ``monitor.query_span`` exit — one bool read when disarmed."""
+    if not enabled():
+        return
+    name = str(pool) if pool else "default"
+    now = time.monotonic_ns()
+    with _lock:
+        lockset.check(_REG, "_SAMPLES", "_POOLS")
+        _POOLS[name] = True
+        ring = _SAMPLES.get(name)
+        if ring is None:
+            ring = _SAMPLES[name] = deque(maxlen=_MAX_SAMPLES)
+        ring.append((now, float(latency_s), bool(ok)))
+        obj = objectives(name)
+        if obj is not None:
+            cut = now - int(obj["window_sec"] * 1e9)
+            while ring and ring[0][0] < cut:
+                ring.popleft()
+    evaluate()
+
+
+def evaluate(force: bool = False) -> List[Dict[str, Any]]:
+    """Run one burn-rate evaluation pass over every known pool (at
+    most once per ``spark.blaze.slo.evalIntervalMs`` unless forced)
+    and return the state transitions it produced.  Transition events
+    are emitted strictly AFTER the state lock is released."""
+    if not enabled():
+        return []
+    global _last_eval_ns
+    now = time.monotonic_ns()
+    transitions: List[Dict[str, Any]] = []
+    with _lock:
+        lockset.check(_REG, "_SAMPLES", "_ALERTS", "_POOLS")
+        if not force and now - _last_eval_ns < _eval_interval_ns:
+            return []
+        _last_eval_ns = now
+        for name in sorted(_POOLS):
+            obj = objectives(name)
+            if obj is None:
+                continue
+            ring = _SAMPLES.get(name) or ()
+            win = obj["window_sec"]
+            fast = fast_window_sec(win)
+            t_slow, lat_slow, err_slow = _window_counts(
+                ring, now, win, obj.get("latency_p99_ms"))
+            t_fast, lat_fast, err_fast = _window_counts(
+                ring, now, fast, obj.get("latency_p99_ms"))
+            kinds = []
+            if "latency_p99_ms" in obj:
+                kinds.append((
+                    "latency", obj["latency_p99_ms"], LATENCY_BUDGET,
+                    burn_rate(lat_fast, t_fast, LATENCY_BUDGET),
+                    burn_rate(lat_slow, t_slow, LATENCY_BUDGET)))
+            if "error_rate" in obj:
+                kinds.append((
+                    "error_rate", obj["error_rate"], obj["error_rate"],
+                    burn_rate(err_fast, t_fast, obj["error_rate"]),
+                    burn_rate(err_slow, t_slow, obj["error_rate"])))
+            for kind, target, budget, b_fast, b_slow in kinds:
+                st = _ALERTS.setdefault(
+                    (name, kind),
+                    {"firing": False, "fired_at_ns": 0, "below": 0,
+                     "burn_fast": 0.0, "burn_slow": 0.0})
+                st["burn_fast"] = b_fast
+                st["burn_slow"] = b_slow
+                over = b_fast >= _fire_burn and b_slow >= _fire_burn
+                if not st["firing"] and over:
+                    st["firing"] = True
+                    st["fired_at_ns"] = now
+                    st["below"] = 0
+                    transitions.append({
+                        "event": "slo_alert_firing", "pool": name,
+                        "slo": kind, "burn_fast": round(b_fast, 4),
+                        "burn_slow": round(b_slow, 4),
+                        "window_sec": win, "objective": target,
+                        "threshold": _fire_burn})
+                elif st["firing"] and not over:
+                    st["below"] += 1
+                    if st["below"] >= _hold_evals:
+                        st["firing"] = False
+                        fired_for = (now - st["fired_at_ns"]) / 1e9
+                        st["fired_at_ns"] = 0
+                        st["below"] = 0
+                        transitions.append({
+                            "event": "slo_alert_resolved", "pool": name,
+                            "slo": kind, "burn_fast": round(b_fast, 4),
+                            "burn_slow": round(b_slow, 4),
+                            "fired_for_s": round(fired_for, 3)})
+                elif st["firing"]:
+                    st["below"] = 0
+    for t in transitions:
+        fields = {k: v for k, v in t.items() if k != "event"}
+        trace.emit(t["event"], **fields)
+        if t["event"] == "slo_alert_firing":
+            dispatch.record("slo_alerts_fired")
+        else:
+            dispatch.record("slo_alerts_resolved")
+    return transitions
+
+
+def doc() -> Dict[str, Any]:
+    """The ``/slo`` document: per-pool objectives, per-SLO burn rates
+    and alert state, sample counts.  Drives an evaluation pass first
+    so a scrape always sees fresh numbers."""
+    evaluate()
+    out: Dict[str, Any] = {"enabled": enabled(), "pools": {}}
+    if not enabled():
+        return out
+    with _lock:
+        lockset.check(_REG, "_SAMPLES", "_ALERTS", "_POOLS")
+        for name in sorted(_POOLS):
+            obj = objectives(name)
+            entry: Dict[str, Any] = {
+                "objectives": obj,
+                "samples": len(_SAMPLES.get(name) or ()),
+                "slos": {},
+            }
+            for (pool, kind), st in _ALERTS.items():
+                if pool != name:
+                    continue
+                entry["slos"][kind] = {
+                    "firing": st["firing"],
+                    "burn_fast": round(st["burn_fast"], 4),
+                    "burn_slow": round(st["burn_slow"], 4),
+                    # fraction of the slow window's error budget left
+                    # (1 - burn, floored at 0): the gauge dashboards
+                    # page on before the alert does
+                    "budget_remaining": round(
+                        max(0.0, 1.0 - st["burn_slow"]), 4),
+                }
+            out["pools"][name] = entry
+    return out

@@ -75,6 +75,8 @@ EVENT_TYPES = frozenset({
     "stage_progress", "task_heartbeat",
     "fault_injected", "straggler_injected",
     "worker_lost", "worker_blacklisted", "pool_degraded",
+    "worker_telemetry",
+    "slo_alert_firing", "slo_alert_resolved",
     "oom_recovery",
     "block_corruption", "disk_pressure",
     "mem_watermark", "spill",

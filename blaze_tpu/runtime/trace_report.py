@@ -47,6 +47,7 @@ TIMELINE_TYPES = frozenset({
     "speculative_attempt_won", "speculative_attempt_lost",
     "oom_recovery", "block_corruption", "disk_pressure",
     "query_cancel_requested", "query_cancelled",
+    "slo_alert_firing", "slo_alert_resolved",
 })
 
 
@@ -277,6 +278,34 @@ def reconcile_cancellation(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def reconcile_slo_alerts(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pair every ``slo_alert_firing`` with a subsequent
+    ``slo_alert_resolved`` for the same (pool, slo) — the slo-storm
+    gate's contract.  A firing with no resolve is a legitimate TERMINAL
+    state (the incident outlived the log) but it is reported under
+    ``still_firing``, never silently dropped; a resolve with no prior
+    firing is a pairing bug and fails reconciliation.  A log with no
+    SLO events reconciles trivially."""
+    pairs, still_firing = _pair_requests(
+        events,
+        lambda e: e.get("type") == "slo_alert_firing",
+        lambda e, f: (f.get("type") == "slo_alert_resolved"
+                      and f.get("pool") == e.get("pool")
+                      and f.get("slo") == e.get("slo")))
+    resolves = [e for e in events
+                if e.get("type") == "slo_alert_resolved"]
+    paired = {id(f) for _, f in pairs}
+    orphan_resolves = [e for e in resolves if id(e) not in paired]
+    return {
+        "fired": len(pairs) + len(still_firing),
+        "resolved": len(resolves),
+        "pairs": pairs,
+        "still_firing": still_firing,
+        "orphan_resolves": orphan_resolves,
+        "reconciled": not orphan_resolves,
+    }
+
+
 def _merge_plan(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
     """Sum two task_plan trees node-by-node (same stage => same plan
     shape; a rewritten/retried plan that differs structurally keeps the
@@ -448,6 +477,7 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         key=lambda e: e.get("ts", 0))
     oom_events = t.get("oom_recovery", [])
     cxl = reconcile_cancellation(events)
+    slo_rec = reconcile_slo_alerts(events)
     recovery = {
         "injected": rec["injected"],
         "recoveries": rec["recoveries"],
@@ -467,6 +497,13 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "requested": cxl["requested"],
             "cancelled": cxl["cancelled"],
             "reconciled": cxl["reconciled"],
+        },
+        # SLO firing <-> resolve pairing (burn-rate alert storms)
+        "slo_alerts": {
+            "fired": slo_rec["fired"],
+            "resolved": slo_rec["resolved"],
+            "still_firing": len(slo_rec["still_firing"]),
+            "reconciled": slo_rec["reconciled"],
         },
         # the data-integrity story: detections, quarantines, and the
         # disk-pressure ladder's rung usage
@@ -493,6 +530,21 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "last_stage_progress": prog[-1] if prog else None,
     }
 
+    # per-worker fleet totals summed from the driver-side
+    # worker_telemetry events (emitted per versioned done frame) — the
+    # offline mirror of the live /workers document
+    workers: Dict[str, Dict[str, int]] = {}
+    for e in t.get("worker_telemetry", []):
+        w = workers.setdefault(e.get("worker", "?"), {
+            "telemetry_events": 0, "rows": 0, "bytes": 0, "jobs_ok": 0,
+            "jobs_failed": 0, "device_ns": 0, "dispatch_ns": 0,
+            "compile_ns": 0, "mem_peak": 0})
+        w["telemetry_events"] += 1
+        for k in ("rows", "bytes", "jobs_ok", "jobs_failed",
+                  "device_ns", "dispatch_ns", "compile_ns"):
+            w[k] += int(e.get(k, 0) or 0)
+        w["mem_peak"] = max(w["mem_peak"], int(e.get("mem_peak", 0) or 0))
+
     return {
         "query": query,
         "events": len(events),
@@ -504,6 +556,7 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "memory": memory,
         "recovery": recovery,
         "progress": progress,
+        "workers": workers,
         # the whole-query roofline judgment (runtime/perf.py): bytes/
         # flops estimates vs the device peak table -> hbm_util /
         # mfu_est / bound classification — the measurement ROADMAP
@@ -669,6 +722,27 @@ def render(events: List[Dict[str, Any]]) -> str:
             lines.append(f"  mem watermark: peak {peak} B "
                          f"of {wm[-1].get('total', 0)} B budget")
 
+    # ---- worker fleet (merged driver+worker logs: the offline mirror
+    # of the live /workers document, summed from worker_telemetry)
+    wt = t.get("worker_telemetry", [])
+    if wt:
+        fleet: Dict[str, Dict[str, int]] = {}
+        for e in wt:
+            w = fleet.setdefault(e.get("worker", "?"), {
+                "rows": 0, "bytes": 0, "jobs_ok": 0, "jobs_failed": 0,
+                "device_ns": 0, "dispatch_ns": 0})
+            for k in w:
+                w[k] += int(e.get(k, 0) or 0)
+        lines.append("")
+        lines.append(f"worker fleet ({len(fleet)} workers):")
+        for name in sorted(fleet):
+            w = fleet[name]
+            lines.append(
+                f"  {name:>8s}  jobs {w['jobs_ok']}+{w['jobs_failed']}f  "
+                f"rows {w['rows']:,d}  {w['bytes']} B  "
+                f"dev/disp {w['device_ns'] / 1e6:.0f}"
+                f"/{w['dispatch_ns'] / 1e6:.0f}ms")
+
     # ---- retry / fault timeline
     timeline_types = TIMELINE_TYPES
     incidents = [e for e in events if e.get("type") in timeline_types]
@@ -710,6 +784,15 @@ def render(events: List[Dict[str, Any]]) -> str:
                 f"{cxl['cancelled']} terminal "
                 + ("(reconciled)" if cxl["reconciled"]
                    else "(NOT RECONCILED)"))
+        slo_rec = reconcile_slo_alerts(events)
+        if slo_rec["fired"] or slo_rec["resolved"]:
+            lines.append(
+                f"  slo alerts: {slo_rec['fired']} fired / "
+                f"{slo_rec['resolved']} resolved"
+                + (f", {len(slo_rec['still_firing'])} still firing"
+                   if slo_rec["still_firing"] else "")
+                + (" (reconciled)" if slo_rec["reconciled"]
+                   else " (NOT RECONCILED)"))
         for e in incidents:
             dt = e.get("ts", ts0) - ts0
             detail = {k: v for k, v in e.items() if k not in ("ts", "type")}

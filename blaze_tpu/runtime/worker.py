@@ -108,13 +108,16 @@ def _configure_worker_process() -> None:
         trace.set_trace_context(*ctx)
 
 
-def _execute_spec(spec: dict) -> None:
+def _execute_spec(spec: dict) -> dict:
     """Run ONE job spec to completion in this process: register the
     reduce-block readers, decode the TaskDefinition, drive the plan,
     and (result stages) commit the output frames by atomic rename.
     Shared by the one-shot :func:`main` and the pooled :func:`serve`
     loop.  The ``worker.task`` fault site is probed at job start and
-    per output batch — the ``@kill`` modifier's home turf."""
+    per output batch — the ``@kill`` modifier's home turf.  Returns
+    the job's output tallies (``rows`` produced, serialized result
+    ``bytes``) — the pooled serve loop folds them into the telemetry
+    payloads its heartbeats carry back to the driver."""
     import os
 
     from ..io.batch_serde import serialize_batch
@@ -145,6 +148,8 @@ def _execute_spec(spec: dict) -> None:
             staged_keys.append(key)
     td = base64.b64decode(spec["task_def"])
     out_path = spec.get("output")
+    rows = 0
+    out_bytes = 0
     try:
         if out_path:
             # write-then-rename: a crashed attempt leaves no final
@@ -181,6 +186,8 @@ def _execute_spec(spec: dict) -> None:
                             xor ^= struct.unpack("<BI", frame[-5:])[1]
                         f.write(frame)
                         count += 1
+                        rows += int(getattr(batch, "num_rows", 0) or 0)
+                        out_bytes += len(frame)
                     if algo is not None:
                         f.write(block_trailer(count, xor, algo))
             except BaseException:
@@ -212,9 +219,10 @@ def _execute_spec(spec: dict) -> None:
                 scope.raise_cancelled()
             os.replace(tmp, out_path)
         else:
-            for _ in run_task(td, task_attempt_id=attempt):
+            for batch in run_task(td, task_attempt_id=attempt):
                 faults.hit("worker.task", attempt=attempt,
                            detail=f"p{partition}#batch")
+                rows += int(getattr(batch, "num_rows", 0) or 0)
     except BaseException:
         # a failed job must not leave its reader registrations staged:
         # a long-lived serve worker re-registers the same keys on the
@@ -223,6 +231,7 @@ def _execute_spec(spec: dict) -> None:
         for key in staged_keys:
             RESOURCES.discard(key)
         raise
+    return {"rows": rows, "bytes": out_bytes}
 
 
 def _describe_error(exc: BaseException) -> dict:
@@ -302,6 +311,14 @@ def main(spec_path: str) -> int:
     return 0
 
 
+#: telemetry payload protocol version the serve loop speaks: ``hb`` /
+#: ``done`` frames carrying telemetry stamp ``"v": TELEMETRY_VERSION``
+#: next to the ``"tm"`` delta dict.  The driver folds only versions it
+#: knows; an OLD worker sending bare payload-free frames (no ``v``)
+#: still interops — liveness and job routing never depended on ``tm``.
+TELEMETRY_VERSION = 1
+
+
 def serve() -> int:
     """Long-lived pooled-worker loop (driven by runtime/hostpool.py):
     read framed JSON job specs from stdin, execute each via
@@ -310,7 +327,16 @@ def serve() -> int:
     SERVING.  A daemon heartbeat thread emits ``hb`` frames every
     ``spark.blaze.pool.heartbeatMs`` so the driver's liveness layer
     distinguishes a busy worker from a dead one.  EOF on stdin (or a
-    ``shutdown`` message) ends the loop."""
+    ``shutdown`` message) ends the loop.
+
+    Telemetry: every ``hb``/``done`` frame carries an INCREMENTAL
+    payload (``v``/``tm`` keys — dispatch-counter deltas, rows/bytes
+    produced, jobs ok/failed, kernel device/dispatch/compile splits
+    when tracing is armed, the mem watermark, and this worker's
+    event-log path) so the driver's monitor registry aggregates the
+    fleet without a second channel.  A frame whose delta is empty is
+    sent in the OLD payload-free shape — the version-gate path an old
+    worker binary exercises permanently."""
     import os
     import threading
 
@@ -318,7 +344,8 @@ def serve() -> int:
 
     from .. import conf
     from ..io.ipc_compression import IpcFrameReader, compress_frame
-    from . import integrity
+    from . import dispatch, integrity, trace
+    from . import monitor as _monitor
 
     # claim the REAL stdout fd for the framed protocol and re-point
     # fd 1 at stderr: a stray print from any library would otherwise
@@ -336,13 +363,64 @@ def serve() -> int:
         with wlock:
             proto.write(frame)
 
+    # --- incremental telemetry state: cumulative tallies plus the
+    # last-SENT snapshot; each frame carries only the delta, so the
+    # driver folds additively and a dropped worker loses at most one
+    # heartbeat's worth.  mem_peak rides as an absolute (driver keeps
+    # the max); the event-log path rides once per change.
+    tlock = threading.Lock()
+    tally = {"rows": 0, "bytes": 0, "jobs_ok": 0, "jobs_failed": 0,
+             "device_ns": 0, "dispatch_ns": 0, "compile_ns": 0}
+    sent = dict(tally)
+    sent_counters: dict = {}
+    sent_mem = -1
+    sent_log = ""
+
+    def _telemetry() -> dict | None:
+        """The incremental ``tm`` payload since the last frame that
+        carried one, or None when nothing changed (the frame then goes
+        out in the old payload-free shape)."""
+        nonlocal sent, sent_counters, sent_mem, sent_log
+        cur = dispatch.counters()
+        mem = _monitor._mem_used()
+        log = trace.current_path() or ""
+        with tlock:
+            tm: dict = {}
+            dc = {k: v - sent_counters.get(k, 0) for k, v in cur.items()
+                  if v - sent_counters.get(k, 0)}
+            if dc:
+                tm["counters"] = dc
+            for k in tally:
+                d = tally[k] - sent[k]
+                if d:
+                    tm[k] = d
+            if mem != sent_mem:
+                tm["mem_peak"] = mem
+            if log and log != sent_log:
+                tm["eventlog"] = log
+            if not tm:
+                return None
+            sent = dict(tally)
+            sent_counters = dict(cur)
+            sent_mem = mem
+            if log:
+                sent_log = log
+            return tm
+
+    def _stamp(msg: dict) -> dict:
+        tm = _telemetry()
+        if tm is not None:
+            msg["v"] = TELEMETRY_VERSION
+            msg["tm"] = tm
+        return msg
+
     hb_s = max(0.005, int(conf.POOL_HEARTBEAT_MS.get()) / 1000.0)
     stop = threading.Event()
 
     def _beat() -> None:
         while not stop.wait(hb_s):
             try:
-                send({"t": "hb", "pid": os.getpid()})
+                send(_stamp({"t": "hb", "pid": os.getpid()}))
             except OSError:
                 return  # driver went away; the job loop sees EOF too
 
@@ -356,16 +434,38 @@ def serve() -> int:
                 break
             job_id = msg.get("job_id")
             try:
-                _execute_spec(msg)
+                # kernel split attribution only when tracing is armed:
+                # an active capture device-serializes execution (the
+                # stage_span contract), so the untraced pool stays on
+                # the async path
+                if trace.enabled():
+                    with trace.kernel_capture() as sink:
+                        out = _execute_spec(msg)
+                    ksum = trace.sum_kernels(sink)
+                else:
+                    out = _execute_spec(msg)
+                    ksum = None
             except BaseException as e:
-                reply = {"t": "done", "job_id": job_id, "status": "error"}
+                with tlock:
+                    tally["jobs_failed"] += 1
+                reply = _stamp({"t": "done", "job_id": job_id,
+                                "status": "error", "pid": os.getpid()})
                 reply.update(_describe_error(e))
                 send(reply)
                 if isinstance(e, (KeyboardInterrupt, SystemExit,
                                   GeneratorExit)):
                     raise
             else:
-                send({"t": "done", "job_id": job_id, "status": "ok"})
+                with tlock:
+                    tally["jobs_ok"] += 1
+                    tally["rows"] += int(out.get("rows", 0))
+                    tally["bytes"] += int(out.get("bytes", 0))
+                    if ksum is not None:
+                        tally["device_ns"] += ksum["device_time_ns"]
+                        tally["dispatch_ns"] += ksum["dispatch_overhead_ns"]
+                        tally["compile_ns"] += ksum["compile_ns"]
+                send(_stamp({"t": "done", "job_id": job_id, "status": "ok",
+                             "pid": os.getpid()}))
     finally:
         stop.set()
     return 0

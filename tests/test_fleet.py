@@ -1,0 +1,324 @@
+"""Fleet observability: worker telemetry aggregation (runtime/worker.py
+frames -> hostpool fold -> monitor fleet registry), per-pool SLO
+burn-rate alerts (runtime/slo.py), and incident debug bundles
+(runtime/bundle.py).
+
+The reconcile contract under test: every telemetry delta a pooled
+worker reports rides its job's ``done`` frame, so the driver's fleet
+registry (``/workers``), the ``worker_telemetry`` event log, and the
+pool's own commit ledger must all agree — three independent fold paths
+of the same frames.  The SLO layer is pure burn-rate math over a
+sample ring, so its fire/hold/resolve transitions are unit-testable
+without sleeping through real windows.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.runtime import bundle, monitor, slo, trace, trace_report
+from blaze_tpu.runtime.hostpool import HostPool
+
+import spark_fixtures as F  # noqa: F401 — test_hostpool helpers need it
+from test_hostpool import _run, _two_stage_plan, _write_parquet_inputs
+
+POOL = "fleet_t"
+
+
+@pytest.fixture
+def armed_monitor():
+    conf.MONITOR_ENABLE.set(True)
+    conf.MONITOR_PORT.set(0)
+    monitor.reset()
+    try:
+        yield monitor
+    finally:
+        monitor.shutdown_server()
+        conf.MONITOR_ENABLE.set(False)
+        conf.MONITOR_PORT.set(4048)
+        monitor.reset()
+        assert monitor.monitor_threads() == []
+
+
+@pytest.fixture
+def armed_slo():
+    """SLO layer armed with a permissive eval throttle so ONLY the
+    test's forced evaluations advance the alert state machine (the
+    first observe() still runs one opportunistic pass)."""
+    conf.SLO_ENABLE.set(True)
+    conf.SLO_EVAL_INTERVAL_MS.set(60_000)
+    conf.SLO_RESOLVE_HOLD_EVALS.set(2)
+    conf.set_conf(f"spark.blaze.slo.pool.{POOL}.errorRate", 0.5)
+    conf.set_conf(f"spark.blaze.slo.pool.{POOL}.targetWindowSec", 30.0)
+    slo.reset()
+    try:
+        yield slo
+    finally:
+        conf.SLO_ENABLE.set(False)
+        conf.SLO_EVAL_INTERVAL_MS.set(200)
+        conf.SLO_RESOLVE_HOLD_EVALS.set(2)
+        conf.set_conf(f"spark.blaze.slo.pool.{POOL}.errorRate", None)
+        conf.set_conf(f"spark.blaze.slo.pool.{POOL}.targetWindowSec", None)
+        slo.reset()
+
+
+# --------------------------------------------- 1. burn-rate math
+
+def test_burn_rate_math():
+    # burn = observed bad fraction / budgeted bad fraction
+    assert slo.burn_rate(1, 100, 0.01) == pytest.approx(1.0)
+    assert slo.burn_rate(5, 100, 0.01) == pytest.approx(5.0)
+    assert slo.burn_rate(1, 4, 0.5) == pytest.approx(0.5)
+    # no evidence is not a violation; zero budget = objective disabled
+    assert slo.burn_rate(0, 0, 0.01) == 0.0
+    assert slo.burn_rate(3, 10, 0.0) == 0.0
+
+
+def test_fast_window_is_slow_over_12_with_floor():
+    assert slo.fast_window_sec(3600.0) == pytest.approx(300.0)
+    assert slo.fast_window_sec(60.0) == pytest.approx(5.0)
+    # pathologically small target windows still integrate >1 sample
+    assert slo.fast_window_sec(0.1) == pytest.approx(0.05)
+
+
+def test_slo_disabled_is_structural_noop():
+    conf.SLO_ENABLE.set(False)
+    slo.reset()
+    slo.observe(POOL, 9.9, ok=False)
+    assert slo.evaluate(force=True) == []
+    doc = slo.doc()
+    assert doc["enabled"] is False
+    assert doc["pools"] == {}
+
+
+def test_alert_fires_on_both_windows_and_resolve_holds(armed_slo):
+    """Fire: both the fast and slow windows burn past the threshold.
+    Resolve: FLAP-SUPPRESSED — the alert must stay below the threshold
+    for resolveHoldEvals consecutive evaluations before clearing."""
+    def _state():
+        return slo.doc()["pools"][POOL]["slos"]["error_rate"]
+
+    slo.observe(POOL, 0.01, ok=False)
+    slo.observe(POOL, 0.01, ok=False)
+    slo.evaluate(force=True)
+    st = _state()
+    assert st["firing"] is True
+    assert st["burn_fast"] >= 1.0 and st["burn_slow"] >= 1.0
+    # recovery traffic dilutes the bad fraction below the budget ...
+    for _ in range(6):
+        slo.observe(POOL, 0.01, ok=True)
+    slo.evaluate(force=True)   # below #1: held, still firing
+    assert _state()["firing"] is True
+    slo.evaluate(force=True)   # below #2: resolves
+    st = _state()
+    assert st["firing"] is False
+    assert st["burn_fast"] < 1.0
+
+
+def test_pool_with_no_objectives_never_alerts(armed_slo):
+    slo.observe("no_slo_pool", 99.0, ok=False)
+    assert slo.evaluate(force=True) == []
+    pdoc = slo.doc()["pools"]["no_slo_pool"]
+    assert pdoc["objectives"] is None
+    assert pdoc["slos"] == {}
+
+
+# ----------------------------------- 2. alert event reconciliation
+
+def _ev(etype, **fields):
+    return {"ts": 1.0, "type": etype, **fields}
+
+
+def test_reconcile_slo_alerts_pairs_and_terminal_firing():
+    events = [
+        _ev("slo_alert_firing", pool="etl", slo="latency"),
+        _ev("slo_alert_resolved", pool="etl", slo="latency"),
+        _ev("slo_alert_firing", pool="etl", slo="error_rate"),
+    ]
+    rec = trace_report.reconcile_slo_alerts(events)
+    assert rec["fired"] == 2 and rec["resolved"] == 1
+    # an alert still firing at end-of-log is a legitimate terminal
+    # state (the incident is ongoing) — reported, not an error
+    assert [(e["pool"], e["slo"]) for e in rec["still_firing"]] == \
+        [("etl", "error_rate")]
+    assert rec["reconciled"] is True
+
+
+def test_reconcile_slo_alerts_orphan_resolve_fails():
+    # a resolve with no prior firing means the pairing is broken
+    rec = trace_report.reconcile_slo_alerts(
+        [_ev("slo_alert_resolved", pool="etl", slo="latency")])
+    assert [(e["pool"], e["slo"]) for e in rec["orphan_resolves"]] == \
+        [("etl", "latency")]
+    assert rec["reconciled"] is False
+
+
+# ------------------------------- 3. two-worker telemetry reconcile
+
+def test_two_worker_telemetry_reconciles_with_driver(
+        tmp_path, armed_monitor):
+    """TWO pooled workers run the map stage with tracing armed; the
+    fleet registry (``/workers``), the merged ``worker_telemetry``
+    event log, and the pool's commit ledger must agree on the totals
+    — three independent fold paths of the same done frames."""
+    conf.TRACE_ENABLE.set(True)
+    conf.EVENT_LOG_DIR.set(str(tmp_path / "evlog"))
+    trace.reset()
+    try:
+        files, _data = _write_parquet_inputs(tmp_path)
+        sess, plan_json = _two_stage_plan(files)
+        with monitor.query_span("fleet_reconcile", mode="scheduler"):
+            with HostPool(2) as pool:
+                _run(sess, plan_json, tmp_path / "shuffle_pool",
+                     pool=pool)
+                owned = pool.owned_map_outputs()
+                snap = monitor.workers_snapshot()
+                url = monitor.ensure_server().url
+                with urllib.request.urlopen(url + "/workers",
+                                            timeout=10) as r:
+                    via_http = json.loads(r.read())
+    finally:
+        conf.TRACE_ENABLE.set(False)
+        conf.EVENT_LOG_DIR.set("")
+        trace.reset()
+
+    assert owned == 3
+    rows = snap["workers"]
+    assert len(rows) == 2 and {w["name"] for w in rows} == {"w0", "w1"}
+    assert snap["pool"]["workers"] == 2 and not snap["pool"]["degraded"]
+    # registry vs pool commit ledger: every pooled map task is one ok
+    # job on exactly one worker
+    assert sum(w["jobs_ok"] for w in rows) == owned
+    assert sum(w["jobs_failed"] for w in rows) == 0
+    # traced run: the kernel capture split rode the telemetry
+    assert sum(w["device_ns"] for w in rows) > 0
+    # registry vs event log: per-field sums match exactly
+    events = trace_report.merge_event_logs(
+        trace_report.event_log_files(str(tmp_path / "evlog")))
+    wt = [e for e in events if e["type"] == "worker_telemetry"]
+    assert len(wt) == owned
+    for field in monitor.WORKER_TM_FIELDS:
+        ev_sum = sum(int(e.get(field, 0) or 0) for e in wt)
+        assert ev_sum == sum(w[field] for w in rows), field
+    # the HTTP endpoint serves the same registry document
+    assert {w["name"]: w["jobs_ok"] for w in via_http["workers"]} == \
+        {w["name"]: w["jobs_ok"] for w in rows}
+    # trace_report's offline fleet section folds the same events
+    rep = trace_report.render_json(events)
+    assert set(rep["workers"]) == {"w0", "w1"}
+    assert sum(w["jobs_ok"] for w in rep["workers"].values()) == owned
+
+
+def test_workers_endpoint_404_without_fleet(armed_monitor):
+    url = monitor.ensure_server().url
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(url + "/workers", timeout=10)
+    assert ei.value.code == 404
+
+
+# ------------------------------------------ 4. monitor surfaces
+
+class _FakePool:
+    def stats(self):
+        return {"workers": 2, "live": 1, "lost": 1,
+                "blacklisted": 0, "degraded": False}
+
+
+def test_healthz_pool_block_golden_keys(armed_monitor):
+    pool = _FakePool()
+    monitor.register_pool(pool)
+    doc = monitor.healthz_doc()
+    assert set(doc["pool"]) == set(monitor.HEALTHZ_POOL_KEYS)
+    for ep in ("/workers", "/slo", "POST /queries/<id>/bundle"):
+        assert ep in doc["endpoints"]
+
+
+def test_statsd_lines_carry_fleet_and_slo_gauges(armed_monitor,
+                                                 armed_slo):
+    monitor.worker_register("w0", 4242)
+    monitor.worker_beat("w0", 4242, {"jobs_ok": 3, "rows": 100,
+                                     "bytes": 2048, "device_ns": 500})
+    slo.observe(POOL, 0.01, ok=False)
+    slo.observe(POOL, 0.01, ok=False)
+    slo.evaluate(force=True)
+    lines = monitor.render_statsd_lines()
+    # label values ride as dotted name suffixes (blaze_worker_jobs_ok.w0)
+    names = {ln.split(":", 1)[0] for ln in lines}
+    for family in ("blaze_worker_jobs_ok", "blaze_worker_rows_total",
+                   "blaze_slo_burn_rate_fast", "blaze_slo_alert_firing"):
+        assert any(n.startswith(family) for n in names), family
+    # histogram buckets never ride the gauge transport
+    assert not any("_bucket" in ln for ln in lines)
+
+
+def test_watch_json_mode_emits_pure_jsonl(armed_monitor, capsys):
+    from blaze_tpu.__main__ import _watch
+
+    with monitor.query_span("watch_json_q", mode="in-process"):
+        pass
+    url = monitor.ensure_server().url
+    assert _watch(url, 0.01, 2, json_out="-") == 0
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert len(lines) == 2
+    for ln in lines:  # every stdout line parses: pure JSONL
+        doc = json.loads(ln)
+        assert any(q["query_id"] == "watch_json_q"
+                   for q in doc["queries"])
+
+
+# ------------------------------------------- 5. debug bundles
+
+def test_bundle_write_verify_and_corruption(tmp_path, armed_monitor):
+    with monitor.query_span("bundle_q", mode="in-process"):
+        pass
+    out = str(tmp_path / "bundle")
+    manifest = bundle.write_bundle(out, query_id="bundle_q")
+    assert manifest["algo"] == "crc32"
+    for member in ("metrics.txt", "conf.json", "queries.json",
+                   "history.json", "ledger.json", "lockset.json",
+                   "errors.json"):
+        assert member in manifest["members"], member
+        assert os.path.exists(os.path.join(out, member))
+    assert bundle.verify_bundle(out) == []
+    # corruption negative: flip one byte in one member -> detected
+    from blaze_tpu.runtime.integrity import flip_byte_in_file
+
+    flip_byte_in_file(os.path.join(out, "metrics.txt"), 3)
+    problems = bundle.verify_bundle(out)
+    assert any("checksum mismatch: metrics.txt" in p for p in problems)
+    # a deleted member is a different, equally loud problem
+    os.unlink(os.path.join(out, "conf.json"))
+    assert any("missing member: conf.json" in p
+               for p in bundle.verify_bundle(out))
+
+
+def test_bundle_records_skipped_members(tmp_path, armed_monitor,
+                                        monkeypatch):
+    def _boom():
+        raise RuntimeError("mid-rotation")
+
+    monkeypatch.setattr(monitor, "render_prometheus", _boom)
+    out = str(tmp_path / "bundle_skip")
+    manifest = bundle.write_bundle(out)
+    # best-effort: the member is absent but its absence is RECORDED
+    assert "metrics.txt" not in manifest["members"]
+    assert "mid-rotation" in manifest["skipped"]["metrics.txt"]
+    assert bundle.verify_bundle(out) == []
+
+
+def test_redact_conf_masks_values_keeps_keys():
+    values = {"spark.ssl.keyPassword": "hunter2",
+              "spark.blaze.api.token": "abc",
+              "spark.blaze.scale": 2}
+    red = bundle.redact_conf(values, patterns=["password", "token"])
+    # the on-call sees WHICH keys were set, never the secrets
+    assert red["spark.ssl.keyPassword"] == "***"
+    assert red["spark.blaze.api.token"] == "***"
+    assert red["spark.blaze.scale"] == 2
+    # the conf-declared default patterns cover the usual suspects
+    assert bundle.redact_conf({"a.secret.b": "x"})["a.secret.b"] == "***"

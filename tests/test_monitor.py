@@ -890,7 +890,13 @@ def _source_metric_literals():
                 names.add(m.group(1))
             if fname == "monitor.py":
                 # its _PromDoc.add calls carry derived FAMILY names
-                # (blaze_query_*...), not tree metric names
+                # (blaze_query_*...), not tree metric names — EXCEPT
+                # the fleet/SLO gauge families, which are registered
+                # verbatim (worker_gauges / pool_gauges / slo_gauges)
+                for m in re.finditer(
+                        r'\.add\(\s*"(blaze_(?:worker|pool|slo)_'
+                        r'[a-z_0-9]*)"', src):
+                    names.add(m.group(1))
                 continue
             for m in re.finditer(
                     r'(?:\.(?:add|set|timer)\(|record\(|record_max\(|counter=)'

@@ -352,6 +352,18 @@ def _synthetic_events():
         ("plan_cache", {"action": "hit", "fingerprint": "ab12" * 8}),
         ("result_cache", {"action": "invalidate",
                           "fingerprint": "cd34" * 8, "bytes": 2048}),
+        ("worker_telemetry", {"worker": "w0", "pid": 4242, "jobs_ok": 3,
+                              "jobs_failed": 1, "rows": 640, "bytes": 5120,
+                              "device_ns": 900, "dispatch_ns": 300,
+                              "compile_ns": 0, "mem_peak": 1 << 20,
+                              "eventlog": "/tmp/w0.jsonl"}),
+        ("slo_alert_firing", {"pool": "etl", "slo": "latency",
+                              "burn_fast": 14.4, "burn_slow": 6.0,
+                              "window_sec": 3600.0, "objective": 0.99,
+                              "threshold": 250.0}),
+        ("slo_alert_resolved", {"pool": "etl", "slo": "latency",
+                                "burn_fast": 0.0, "burn_slow": 0.5,
+                                "fired_for_s": 12.5}),
     ]
 
 
