@@ -71,6 +71,11 @@ PARQUET_FILTER_PUSHDOWN = ConfEntry("spark.blaze.parquet.enable.pageFiltering", 
 # TPU-only: hand-written pallas kernels for hot loops (kernels/); the
 # pure-XLA path is always kept as fallback
 PALLAS_ENABLE = ConfEntry("spark.blaze.tpu.pallas.enable", True, _bool)
+# hash-join probe inner loop as a fused pallas lookup (counting
+# searchsorted over the sorted build table): work is probes x table,
+# so it only engages for small build sides — default OFF until TPU
+# profiles justify it; tier-1 exercises it via interpret mode
+PALLAS_JOIN_PROBE = ConfEntry("spark.blaze.tpu.pallas.joinProbe", False, _bool)
 INPUT_BATCH_STATISTICS = ConfEntry("spark.blaze.inputBatchStatistics", False, _bool)
 UDF_WRAPPER_NUM_THREADS = ConfEntry("spark.blaze.udfWrapperNumThreads", 1, int)
 # pickled UDF/UDTF payloads in TaskDefinitions execute arbitrary code at
@@ -420,6 +425,45 @@ DEVICE_MEMORY_BUDGET = ConfEntry("spark.blaze.tpu.hbmBudget", 8 << 30, int)
 HOST_SPILL_BUDGET = ConfEntry("spark.blaze.tpu.hostSpillBudget", 4 << 30, int)
 MIN_CAPACITY = ConfEntry("spark.blaze.tpu.minBatchCapacity", 1024, int)
 
+# Dispatch-driven batch autotuning (runtime/dispatch.py controller):
+# while a trace kernel capture is active, the per-kernel device_ns /
+# dispatch_ns split feeds a controller that GROWS the agg input
+# coalescing bucket (powers of the step factor, bounded below/above)
+# until the device share of warm kernel time crosses the target —
+# the dispatch floor amortizes over more rows per program.  Memory
+# pressure (an OOM-ladder rung firing) pushes the bucket back down
+# and caps re-growth below the rows that exhausted the device.  OFF
+# (default) the whole controller is a structural no-op: decisions are
+# only made under the same capture scope that already pays
+# block-until-ready timing, so the untraced hot path never sees it.
+BATCH_AUTOTUNE = ConfEntry("spark.blaze.tpu.batchAutotune", False, _bool)
+# Coalescing-bucket bounds (rows) and growth step for the controller.
+# The floor doubles as the starting target; the ceiling bounds device
+# residency of one coalesced bucket.
+BATCH_AUTOTUNE_MIN_ROWS = ConfEntry(
+    "spark.blaze.tpu.batchAutotune.minRows", 8192, int)
+BATCH_AUTOTUNE_MAX_ROWS = ConfEntry(
+    "spark.blaze.tpu.batchAutotune.maxRows", 262144, int)
+BATCH_AUTOTUNE_STEP = ConfEntry("spark.blaze.tpu.batchAutotune.step", 4, int)
+# Warm device share (device_ns / (device_ns + dispatch_ns)) the
+# controller grows toward; past it the workload classifies
+# majority-device and growth stops.
+BATCH_AUTOTUNE_TARGET_SHARE = ConfEntry(
+    "spark.blaze.tpu.batchAutotune.deviceShareTarget", 0.5, float)
+# Timed-kernel observations aggregated per growth decision (smooths
+# single-program jitter without starving convergence at test scale).
+BATCH_AUTOTUNE_WINDOW = ConfEntry(
+    "spark.blaze.tpu.batchAutotune.window", 4, int)
+# Donate fused-shuffle-write input buffers to XLA (jax.jit
+# donate_argnums): the consumed batch's device buffers are reused for
+# the program's outputs instead of holding both alive.  Only
+# engine-produced single-consumer batches (RecordBatch.consumable) are
+# ever donated; scan/cache-owned batches never are.  A donating
+# program that hits a REAL device OOM forfeits the in-place retry
+# rungs (its inputs are already dead) and surfaces the retryable
+# task-level error instead.
+DONATE_BUFFERS = ConfEntry("spark.blaze.tpu.donateBuffers", False, _bool)
+
 # Performance introspection (runtime/perf.py): EXPLAIN ANALYZE,
 # per-kernel roofline/MFU attribution, and the perf-baseline gate.
 # Bytes-moved / flops estimation at the dispatch choke point — armed it
@@ -440,6 +484,11 @@ PERF_BASELINES = ConfEntry("spark.blaze.perf.baselines", "", str)
 # Override path for the per-device-kind peak table (empty = the
 # packaged runtime/device_peaks.json).
 PERF_PEAKS = ConfEntry("spark.blaze.perf.peaks", "", str)
+# bench.py stale-cache guard: a carried cached q01/q06 half whose
+# ``measured_at`` stamp is older than this many days is DROPPED from
+# the merge (re-measured) instead of silently re-emitted — BENCH_r05
+# shipped a q01 number stamped six days stale.  0 = never expire.
+BENCH_MAX_CACHE_AGE_DAYS = ConfEntry("spark.blaze.bench.maxCacheAgeDays", 3, int)
 
 # Static analysis & verification (blaze_tpu/analysis/).
 # Plan verifier: run the rule-based structural checker

@@ -694,6 +694,11 @@ class AggExec(ExecNode):
         # collapsed into this kernel; rows failing it never aggregate)
         self.pre_filter = pre_filter
         self.supports_partial_skipping = supports_partial_skipping
+        # tier-5 blocking-boundary fusion (shuffle write absorbing this
+        # FINAL agg's finalize as its chain bottom): when set, _finish
+        # emits the RAW state batch and the writer's fused program
+        # applies the finalize — no finalized intermediate batch
+        self.emit_state = False
 
         in_schema = child.schema
         # input value types of each agg (for PARTIAL: from expr; for
@@ -1352,6 +1357,18 @@ class AggExec(ExecNode):
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         child_stream = self.children[0].execute(partition, ctx)
         in_schema = self.children[0].schema
+        # batch autotuning: the agg update is the dispatch-floor hot
+        # loop (q01 grouped / q06 scalar both land here after tier-1
+        # filter/project absorption), so the controller's coalescing
+        # bucket applies to ITS input stream — one update program per
+        # bucket instead of one per scan batch
+        from ..runtime import dispatch as _dispatch
+
+        if _dispatch.autotune_enabled():
+            from ..batch import coalesce_stream
+
+            child_stream = coalesce_stream(
+                child_stream, _dispatch.autotune_target_rows)
 
         def stream():
             merger = _StateMerger.for_agg(self)
@@ -1448,13 +1465,21 @@ class AggExec(ExecNode):
 
     def _finish(self, state: RecordBatch) -> RecordBatch:
         if self.mode == AggMode.FINAL:
+            if self.emit_state:
+                # boundary fusion: the downstream fused shuffle write
+                # owns the finalize (absorb_traceable_chain) — hand it
+                # the raw state, single-consumer so donation-eligible
+                state.consumable = True
+                return state
             cols = self._finalize_kernel(tuple(state.columns), state.num_rows)
             n = state.num_rows
             if self.post_fetch is not None:
                 # fused Limit/fetch: rows past n are padding after the
                 # in-program post_sort, so a host-side clamp suffices
                 n = min(n, self.post_fetch)
-            return RecordBatch(self._schema, list(cols), n)
+            out = RecordBatch(self._schema, list(cols), n)
+            out.consumable = True  # fresh finalize output, single consumer
+            return out
         return state
 
 

@@ -140,8 +140,25 @@ def _key_hash(cols: Sequence[Column]) -> jnp.ndarray:
     return jnp.where(all_valid, h, _SENTINEL)
 
 
-def probe_counts(jmap_keys, probe_keys):
-    """(lo, counts) of candidate ranges per probe row."""
+def probe_counts(jmap_keys, probe_keys, use_pallas: bool = False):
+    """(lo, counts) of candidate ranges per probe row.
+
+    ``use_pallas`` routes the two searchsorted dispatches through the
+    fused pallas counting-lookup kernel (kernels/pallas_ops.py) — a
+    trace-time constant (the Joiner cache key carries it), applied only
+    when the build table fits the kernel's all-pairs work bound.  Any
+    lowering failure falls back to the XLA path at trace time."""
+    if use_pallas:
+        from ...kernels import pallas_ops
+        from ...runtime.errors import reraise_control
+
+        if jmap_keys.shape[0] <= pallas_ops.SORTED_LOOKUP_MAX_TABLE:
+            try:
+                lo, hi = pallas_ops.sorted_lookup(jmap_keys, probe_keys)
+                is_sent = probe_keys == _SENTINEL
+                return lo, jnp.where(is_sent, 0, hi - lo)
+            except Exception as e:  # noqa: BLE001 — XLA path is exact
+                reraise_control(e)
     lo = jnp.searchsorted(jmap_keys, probe_keys, side="left")
     hi = jnp.searchsorted(jmap_keys, probe_keys, side="right")
     is_sent = probe_keys == _SENTINEL
@@ -210,16 +227,32 @@ def cached_joiner(
     from ...exprs.compile import expr_key
     from ...runtime.kernel_cache import cached_kernel, schema_key
 
+    use_pallas = _pallas_probe_enabled()
     key = (
         "joiner", schema_key(probe_schema), schema_key(build_schema),
         tuple(expr_key(e) for e in probe_key_exprs),
         tuple(expr_key(e) for e in build_key_exprs),
         join_type.value, probe_is_left, existence_col,
+        ("pallas",) if use_pallas else (),
     )
     return cached_kernel(key, lambda: Joiner(
         probe_schema, build_schema, probe_key_exprs, build_key_exprs,
-        join_type, probe_is_left, existence_col,
+        join_type, probe_is_left, existence_col, use_pallas=use_pallas,
     ))
+
+
+def _pallas_probe_enabled() -> bool:
+    """Backend-probe gate for the pallas probe lookup: both pallas
+    confs on AND the kernels runnable (real TPU, or tests forcing
+    interpret mode)."""
+    from ... import conf
+
+    if not (bool(conf.PALLAS_ENABLE.get())
+            and bool(conf.PALLAS_JOIN_PROBE.get())):
+        return False
+    from ...kernels import pallas_ops
+
+    return pallas_ops.available()
 
 
 class JoinerState:
@@ -245,7 +278,9 @@ class Joiner:
         join_type: JoinType,
         probe_is_left: bool,
         existence_col: str = "exists#0",
+        use_pallas: bool = False,
     ):
+        self.use_pallas = use_pallas
         self.probe_schema = probe_schema
         self.build_schema = build_schema
         self.probe_keys = list(probe_key_exprs)
@@ -293,7 +328,7 @@ class Joiner:
             key_cols = [lower(e, probe_schema, env, cap) for e in probe_keys]
             live = jnp.arange(cap) < num_rows
             pkeys = jnp.where(live, _key_hash(key_cols), _SENTINEL)
-            _, counts = probe_counts(jmap_keys, pkeys)
+            _, counts = probe_counts(jmap_keys, pkeys, use_pallas=use_pallas)
             return jnp.sum(counts)
 
         self._candidate_kernel = candidate_kernel
@@ -308,7 +343,8 @@ class Joiner:
             live = jnp.arange(cap) < probe_rows
             pkeys = jnp.where(live, _key_hash(probe_key_cols), _SENTINEL)
 
-            lo, counts = probe_counts(jmap.sorted_keys, pkeys)
+            lo, counts = probe_counts(jmap.sorted_keys, pkeys,
+                                      use_pallas=use_pallas)
             p_idx, b_pos, pair_live = expand_pairs(lo, counts, out_cap)
             b_idx = jnp.take(jmap.sorted_rows, jnp.clip(b_pos, 0, jmap.sorted_rows.shape[0] - 1))
 

@@ -547,7 +547,7 @@ def _build_pid_kernels(schema, exprs, n_out):
 
 
 def _build_fused_write_kernel(out_schema, fns, pid_mode, exprs, n_out,
-                              slot_counts=()):
+                              slot_counts=(), donate=False):
     """ONE program per map-stage batch (fusion tier 5): the traceable
     map chain, the partition-id computation, the pid sort, and the
     per-partition bincount, all in a single XLA executable.  The
@@ -555,12 +555,24 @@ def _build_fused_write_kernel(out_schema, fns, pid_mode, exprs, n_out,
     remote chip each is ~70-80 ms of turnaround.  ``fns`` are the
     chain's trace transforms bottom->top (may be empty: a bare writer
     still folds hash+sort into one program); ``pid_mode`` is "hash"
-    (murmur3 pmod over ``exprs``) or "rr" (round-robin, offset passed
-    as a traced arg).  ``slot_counts`` gives each fn's slotified-
-    literal count (trace_slots contract, ops/base.py): the caller
-    appends the flattened slot values after the input columns and the
-    chain deals each transform its own group, so parameter-shifted
-    chains reuse this one program."""
+    (murmur3 pmod over ``exprs``), "rr" (round-robin, offset passed as
+    a traced arg), or "range" (boundary bsearch; ``exprs`` carries the
+    SortFields and the driver-computed boundary word arrays arrive as
+    TRACED args, so shifted boundaries reuse the compiled program).
+    ``slot_counts`` gives each fn's slotified-literal count
+    (trace_slots contract, ops/base.py): the caller appends the
+    flattened slot values after the input columns and the chain deals
+    each transform its own group, so parameter-shifted chains reuse
+    this one program.
+
+    ``donate=True`` builds the donated variant: the same program, but
+    the batch columns move to their OWN leading argument (the slot
+    group follows separately, never donated — its values are reused
+    across batches) and XLA may alias their buffers for the outputs.
+    The caller gates per batch on ``RecordBatch.consumable``; after a
+    donated launch the inputs are DEAD, which is why the dispatch
+    choke point refuses in-place OOM retries for it
+    (``_oom_call``'s ``_donating`` seam)."""
     n_slots = sum(slot_counts)
 
     def chain(cols, n):
@@ -573,20 +585,52 @@ def _build_fused_write_kernel(out_schema, fns, pid_mode, exprs, n_out,
             i += cnt
         return cols, n
 
+    def _finish(kernel):
+        if donate:
+            kernel._donating = True
+        return kernel
+
     if pid_mode == "hash":
         pid_body = _hash_pids_body(out_schema, exprs, n_out)
 
-        @jax.jit
-        def kernel(cols, num_rows):
+        def body(cols, num_rows):
             cols, n = chain(cols, num_rows)
             pids = pid_body(cols, n)
             sorted_cols, counts, _ = _sort_by_pid_body(tuple(cols), pids, n_out, n)
             return sorted_cols, counts
 
-        return kernel
+        if donate:
+            @partial(jax.jit, donate_argnums=(0,))
+            def kernel(cols, slots, num_rows):
+                return body(tuple(cols) + tuple(slots), num_rows)
+        else:
+            kernel = jax.jit(body)
+        return _finish(kernel)
 
-    @jax.jit
-    def rr_kernel(cols, num_rows, rr):
+    if pid_mode == "range":
+        from .exchange import _build_range_kernels
+
+        # plain @jax.jit kernels: nested jit inlines into THIS program
+        # (the instrumented copies on the writer instance serve the
+        # unfused/degraded path and would count phantom dispatches)
+        key_words, _, pids_fn = _build_range_kernels(out_schema, exprs, n_out)
+
+        def range_body(cols, num_rows, boundaries):
+            cols, n = chain(cols, num_rows)
+            words = key_words(tuple(cols), n)
+            pids = pids_fn(words, boundaries)
+            sorted_cols, counts, _ = _sort_by_pid_body(tuple(cols), pids, n_out, n)
+            return sorted_cols, counts
+
+        if donate:
+            @partial(jax.jit, donate_argnums=(0,))
+            def kernel(cols, slots, num_rows, boundaries):
+                return range_body(tuple(cols) + tuple(slots), num_rows, boundaries)
+        else:
+            kernel = jax.jit(range_body)
+        return _finish(kernel)
+
+    def rr_body(cols, num_rows, rr):
         cols, n = chain(cols, num_rows)
         cap = cols[0].validity.shape[0]
         pids = (jnp.arange(cap, dtype=jnp.int32) + rr) % n_out
@@ -597,7 +641,13 @@ def _build_fused_write_kernel(out_schema, fns, pid_mode, exprs, n_out,
         next_rr = (rr + jnp.int32(n)) % jnp.int32(n_out)
         return sorted_cols, counts, next_rr
 
-    return rr_kernel
+    if donate:
+        @partial(jax.jit, donate_argnums=(0,))
+        def rr_kernel(cols, slots, num_rows, rr):
+            return rr_body(tuple(cols) + tuple(slots), num_rows, rr)
+    else:
+        rr_kernel = jax.jit(rr_body)
+    return _finish(rr_kernel)
 
 
 def _insert_host(rep: "ShuffleRepartitioner", schema: Schema, item) -> None:
@@ -711,6 +761,8 @@ class ShuffleWriterExec(ExecNode):
         # fusion tier 5 (absorb_traceable_chain): one program per batch
         # covering chain + pids + pid-sort + counts
         self._fused_write = None
+        self._fused_write_donate = None  # donated twin, built on demand
+        self._donate_builder = None
         self._fused_fns: List = []
         self._fused_fn_keys: tuple = ()
         self._fused_slot_args: tuple = ()   # flattened, chain order
@@ -753,10 +805,12 @@ class ShuffleWriterExec(ExecNode):
                 ),
             )
 
-    def _range_pids(self, cols, num_rows):
+    def _range_pids(self, cols, num_rows, boundaries):
+        """``boundaries`` are the stream-hoisted device arrays (one
+        ``jnp.asarray`` per stream, not per batch — the per-batch
+        conversion re-staged the boundary words on every dispatch)."""
         key_words, _, pids_fn = self._range_kernels
         words = key_words(tuple(cols), num_rows)
-        boundaries = tuple(jnp.asarray(b) for b in self.partitioning.boundaries)
         return pids_fn(words, boundaries)
 
     def _hash_pids(self, cols, num_rows):
@@ -794,19 +848,31 @@ class ShuffleWriterExec(ExecNode):
         FusedStageExec — its trace contract composes its ops) plus the
         partition-id computation, pid sort, and per-partition counts
         into ONE cached program per batch (``ops.fusion`` tier 5).
-        Applies to hash and round-robin partitioning over >1 output
-        partitions with no opaque (host-only) columns; range
-        partitioning keys through driver-computed boundaries and
-        single-partition writes move nothing worth fusing.  Idempotent;
-        a no-op when the gate fails (the per-kernel path below runs
-        unchanged — the fallback the differential tests pin)."""
+        Applies to hash, round-robin, and range partitioning over >1
+        output partitions with no opaque (host-only) columns (range
+        passes the driver-computed boundary words as TRACED args);
+        single-partition writes move nothing worth fusing.
+
+        Blocking-boundary fusion: when the node under the chain is a
+        FINAL agg (with no fused fetch clamp), its finalize program
+        becomes the chain's BOTTOM transform — the agg then emits its
+        RAW state batch (``emit_state``) and the finalize, the map
+        chain, the pids, and the pid sort all run as the ONE per-batch
+        program, with no intermediate finalized batch crossing the
+        host boundary.  Idempotent; a no-op when the gate fails (the
+        per-kernel path below runs unchanged — the fallback the
+        differential tests pin)."""
         from ..batch import split_opaque_indexes
 
         if self._fused_write is not None:
             return
         part = self.partitioning
         n_out = part.num_partitions
-        if not isinstance(part, (HashPartitioning, RoundRobinPartitioning)) or n_out <= 1:
+        if (
+            not isinstance(part, (HashPartitioning, RoundRobinPartitioning,
+                                  RangePartitioning))
+            or n_out <= 1
+        ):
             return
         from ..ops.fusion import traceable_chain_from
 
@@ -829,17 +895,57 @@ class ShuffleWriterExec(ExecNode):
         # stays sound; only the VALUES differ across shifted variants
         slot_groups = tuple(op.trace_slots() for op in reversed(ops))
         slot_counts = tuple(len(g) for g in slot_groups)
+
+        from ..ops.agg import AggExec, AggMode
+
+        agg = None
+        if (
+            isinstance(bottom, AggExec)
+            and bottom.mode == AggMode.FINAL
+            and bottom.post_fetch is None
+            and not split_opaque_indexes(bottom._state_schema)[1]
+        ):
+            # the finalize (with any fused post_sort inside it) joins
+            # the chain as its bottom transform over the STATE schema;
+            # pid exprs still evaluate over the chain OUTPUT schema
+            agg = bottom
+            from ..runtime import dispatch as _dispatch
+
+            fin_raw = _dispatch.raw(agg._finalize_kernel)
+            fns = [lambda cols, n, _f=fin_raw: (_f(cols, n), n)] + fns
+            keys = (("agg_finalize",) + agg._kernel_key,) + keys
+            slot_groups = ((),) + slot_groups
+            slot_counts = (0,) + slot_counts
+
         if isinstance(part, HashPartitioning):
             exprs = list(part.exprs)
             key = ("fused_shuffle_write", "hash", schema_key(out_schema),
                    keys, tuple(expr_key(e) for e in exprs), n_out)
-            builder = lambda: _build_fused_write_kernel(  # noqa: E731
-                out_schema, fns, "hash", exprs, n_out, slot_counts)
+            mode, pid_arg = "hash", exprs
+        elif isinstance(part, RangePartitioning):
+            fields = list(part.fields)
+            key = ("fused_shuffle_write", "range", schema_key(out_schema),
+                   keys,
+                   tuple((expr_key(f.expr), f.ascending, f.nulls_first)
+                         for f in fields),
+                   n_out)
+            mode, pid_arg = "range", fields
         else:
             key = ("fused_shuffle_write", "rr", schema_key(out_schema),
                    keys, n_out)
-            builder = lambda: _build_fused_write_kernel(  # noqa: E731
-                out_schema, fns, "rr", None, n_out, slot_counts)
+            mode, pid_arg = "rr", None
+        builder = lambda: _build_fused_write_kernel(  # noqa: E731
+            out_schema, fns, mode, pid_arg, n_out, slot_counts)
+        # donated twin (spark.blaze.tpu.donateBuffers): built lazily at
+        # execute() time so a conf flip after planning still applies
+        self._donate_builder = (
+            key + ("donate",),
+            lambda: _build_fused_write_kernel(
+                out_schema, fns, mode, pid_arg, n_out, slot_counts,
+                donate=True),
+        )
+        if agg is not None:
+            agg.emit_state = True
         self._fused_write = cached_kernel(key, builder)
         self._fused_fns = fns
         self._fused_fn_keys = keys
@@ -882,7 +988,10 @@ class ShuffleWriterExec(ExecNode):
             )
 
         def stream():
+            from ..batch import DeviceRing
+            from ..runtime import dispatch as _dispatch
             from ..runtime import oom as _oom
+            from ..runtime.kernel_cache import cached_kernel
 
             n_out = self.partitioning.num_partitions
             out_schema = self.schema
@@ -891,6 +1000,7 @@ class ShuffleWriterExec(ExecNode):
             )
             ctx.mem.register_consumer(rep)
             inserter: Optional[_AsyncInserter] = None
+            ring: Optional[DeviceRing] = None
             committed = False
             try:
                 if bool(conf.SHUFFLE_ASYNC_WRITE.get()):
@@ -898,9 +1008,29 @@ class ShuffleWriterExec(ExecNode):
                         rep, out_schema,
                         int(conf.SHUFFLE_ASYNC_QUEUE_DEPTH.get()), self.metrics,
                     )
+                    # two-slot device staging ring: batch N's pid-sorted
+                    # output stays device-resident while batch N+1's
+                    # program dispatches; only then does N's host
+                    # transfer start on the inserter thread
+                    ring = DeviceRing()
                 rr = 0
                 rr_dev = jnp.int32(0)  # fused RR offset, device-resident
                 use_fused = self._fused_write is not None
+                # stream-hoisted per-batch invariants: boundary device
+                # arrays and the donation conf are resolved ONCE here,
+                # not inside the dispatch loop
+                boundaries_dev = None
+                if (
+                    isinstance(self.partitioning, RangePartitioning)
+                    and self.partitioning.boundaries is not None
+                ):
+                    boundaries_dev = tuple(
+                        jnp.asarray(b) for b in self.partitioning.boundaries)
+                use_donate = bool(conf.DONATE_BUFFERS.get())
+                if use_donate and use_fused and self._fused_write_donate is None \
+                        and self._donate_builder is not None:
+                    dkey, dbuilder = self._donate_builder
+                    self._fused_write_donate = cached_kernel(dkey, dbuilder)
                 for batch in self.children[0].execute(partition, ctx):
                     if not ctx.is_task_running():
                         return
@@ -911,12 +1041,38 @@ class ShuffleWriterExec(ExecNode):
                     if use_fused:
                         # tier 5: ONE program returns the chain output
                         # already pid-sorted plus per-pid counts
+                        donating = (
+                            use_donate and batch.consumable
+                            and self._fused_write_donate is not None
+                        )
                         try:
                             with self.metrics.timer("elapsed_compute"):
-                                if isinstance(self.partitioning, RoundRobinPartitioning):
+                                part_t = self.partitioning
+                                if donating:
+                                    fw = self._fused_write_donate
+                                    cols_arg = tuple(batch.columns)
+                                    if isinstance(part_t, RoundRobinPartitioning):
+                                        sorted_cols, counts, rr_dev = fw(
+                                            cols_arg, self._fused_slot_args,
+                                            batch.num_rows, rr_dev)
+                                    elif isinstance(part_t, RangePartitioning):
+                                        sorted_cols, counts = fw(
+                                            cols_arg, self._fused_slot_args,
+                                            batch.num_rows, boundaries_dev)
+                                    else:
+                                        sorted_cols, counts = fw(
+                                            cols_arg, self._fused_slot_args,
+                                            batch.num_rows)
+                                    _dispatch.record("donated_buffers")
+                                elif isinstance(part_t, RoundRobinPartitioning):
                                     sorted_cols, counts, rr_dev = self._fused_write(
                                         tuple(batch.columns) + self._fused_slot_args,
                                         batch.num_rows, rr_dev
+                                    )
+                                elif isinstance(part_t, RangePartitioning):
+                                    sorted_cols, counts = self._fused_write(
+                                        tuple(batch.columns) + self._fused_slot_args,
+                                        batch.num_rows, boundaries_dev
                                     )
                                 else:
                                     sorted_cols, counts = self._fused_write(
@@ -926,6 +1082,11 @@ class ShuffleWriterExec(ExecNode):
                             item = (list(sorted_cols), counts, None)
                         except Exception as exc:  # noqa: BLE001
                             if not _oom.is_resource_exhausted(exc):
+                                # a donated launch's REAL exhaustion
+                                # surfaces as DeviceOomError (inputs may
+                                # be dead — the attempt must regenerate
+                                # them), which classifies NON-absorbable
+                                # and propagates here
                                 raise
                             # OOM ladder (spill+retry already ran at the
                             # dispatch choke point): decompose to the
@@ -952,7 +1113,11 @@ class ShuffleWriterExec(ExecNode):
                                     non_opaque_cols(out_schema, cols), n,
                                 )
                             elif isinstance(self.partitioning, RangePartitioning) and n_out > 1:
-                                pids = self._range_pids(cols, n)
+                                if boundaries_dev is None:
+                                    boundaries_dev = tuple(
+                                        jnp.asarray(b)
+                                        for b in self.partitioning.boundaries)
+                                pids = self._range_pids(cols, n, boundaries_dev)
                             elif isinstance(self.partitioning, RoundRobinPartitioning) and n_out > 1:
                                 pids = (jnp.arange(cap, dtype=jnp.int32) + rr) % n_out
                                 rr = (rr + n) % n_out
@@ -964,11 +1129,17 @@ class ShuffleWriterExec(ExecNode):
                         item = (list(sorted_cols), counts, n)
                     if inserter is not None:
                         # overlap: host staging of batch N runs on the
-                        # inserter thread while batch N+1 dispatches
-                        inserter.put(item)
+                        # inserter thread while batch N+1 dispatches;
+                        # the ring holds the newest output device-side
+                        # so the NEXT program is enqueued before this
+                        # one's transfer begins
+                        for due in ring.put(item):
+                            inserter.put(due)
                     else:
                         _insert_host(rep, out_schema, item)
                 if inserter is not None:
+                    for due in ring.flush():
+                        inserter.put(due)
                     inserter.close()
                     inserter = None
                 if not ctx.is_task_running():
@@ -985,6 +1156,11 @@ class ShuffleWriterExec(ExecNode):
                 committed = True
             finally:
                 if inserter is not None:
+                    # cancel/failure mid-ring: the ringed device outputs
+                    # feed a repartitioner being discarded — drop them
+                    # instead of staging (chaos cancel-storm arm)
+                    if ring is not None:
+                        ring.drop()
                     inserter.abort()
                 if not committed:
                     # failed OR cancelled attempt: reclaim the staged

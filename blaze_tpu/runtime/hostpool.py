@@ -185,6 +185,15 @@ class HostPool:
         env.update(self._env)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env["BLAZE_POOL_HEARTBEATMS"] = str(self._hb_ms)
+        # workers inherit the driver's persistent XLA cache dir
+        # (spark.blaze.xla.cacheDir → its env alias), so a cache primed
+        # by ``--warmup`` serves pooled cold compiles as cache loads;
+        # an explicit env (the caller's or this pool's) wins
+        from .. import conf
+
+        cache_dir = str(conf.XLA_CACHE_DIR.get() or "")
+        if cache_dir:
+            env.setdefault("BLAZE_XLA_CACHEDIR", cache_dir)
         # the pool may run from a test/tool cwd where the package is
         # not importable by default
         pkg_parent = os.path.dirname(os.path.dirname(

@@ -72,6 +72,11 @@ def is_resource_exhausted(exc: BaseException) -> bool:
     ``MemoryError`` stays out: retry.classify treats it as FATAL."""
     if isinstance(exc, MemoryError):
         return False
+    if isinstance(exc, DeviceOomError):
+        # the ladder's own terminal verdict: the message embeds the
+        # cause's RESOURCE_EXHAUSTED text, but re-absorbing it would
+        # re-run a batch whose donated inputs may already be deleted
+        return False
     s = str(exc)
     return "RESOURCE_EXHAUSTED" in s or "Resource exhausted" in s
 
@@ -94,6 +99,7 @@ def recover_spill(label: str) -> int:
 
     freed = MemManager.get().force_spill()
     dispatch.record("oom_recoveries")
+    dispatch.autotune_memory_pushback(label)
     trace.emit("oom_recovery", label=label, action="spill",
                freed_bytes=freed)
     return freed
@@ -104,6 +110,7 @@ def record_downshift(label: str, rows: int, depth: int) -> None:
     from . import dispatch, trace
 
     dispatch.record("batch_downshifts")
+    dispatch.autotune_memory_pushback(label)
     trace.emit("oom_recovery", label=label, action="downshift",
                rows=rows, depth=depth)
 
@@ -114,6 +121,7 @@ def record_eager_fallback(label: str) -> None:
     from . import dispatch, trace
 
     dispatch.record("eager_fallbacks")
+    dispatch.autotune_memory_pushback(label)
     trace.emit("oom_recovery", label=label, action="eager")
 
 

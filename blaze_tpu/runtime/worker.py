@@ -92,6 +92,15 @@ def _configure_worker_process() -> None:
     conf.MONITOR_ENABLE.set(False)
     monitor.reset()
 
+    # cross-process compile-cache inheritance: the host pool forwards
+    # the driver's cache dir as BLAZE_XLA_CACHEDIR (the env alias of
+    # spark.blaze.xla.cacheDir), so a cache primed by ``--warmup``
+    # serves this process's cold compiles as deserializations instead
+    # of fresh XLA compiles.  No-op when nothing is configured.
+    from .kernel_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     # cross-process trace-context propagation: the driver's W3C
     # traceparent (BLAZE_TRACEPARENT — run_worker_with_retry and the
     # host pool set it; a job spec's own key wins later) restores the

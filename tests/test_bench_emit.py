@@ -12,7 +12,19 @@ import sys
 # repo root (cwd-independent): bench.py is not a package member
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import calendar
+import time as _time
+
 import bench
+
+
+def _ts(stamp):
+    return calendar.timegm(_time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ"))
+
+
+#: pinned "wall clock" for merge tests — the hardcoded 2026-08-01/02
+#: provenance stamps must stay inside the stale-cache window forever
+_NOW = _ts("2026-08-02T12:00:00Z")
 
 
 def _emit_line(result, probe_log, wd_log):
@@ -61,7 +73,7 @@ def test_merge_cached_carries_whole_q01_half():
             "q01_dispatch_count": 1.2, "q01_compile_ms": 30,
             "q01_warm_compiles": 0, "q01_programs": 9,
             "q01_device_time_s": 0.8, "q01_dispatch_overhead_s": 0.1,
-            "q01_timed": 9,
+            "q01_device_share": 0.89, "q01_timed": 9,
             # the roofline half (runtime/perf.py): provenance travels
             # WITH the carried q01 — a bound class judged on one
             # device must not describe another run's numbers
@@ -74,7 +86,7 @@ def test_merge_cached_carries_whole_q01_half():
             "q01_measured_at": "2026-08-01T00:00:00Z"}
     fresh = {"backend": "tpu", "value": 2.0,
              "measured_at": "2026-08-02T00:00:00Z"}
-    merged = bench._merge_cached(fresh, prev)
+    merged = bench._merge_cached(fresh, prev, now=_NOW)
     for k in bench._Q01_CARRY_KEYS:
         assert merged[k] == prev[k], k
     assert merged["q01_measured_at"] == "2026-08-01T00:00:00Z"
@@ -107,7 +119,7 @@ def test_merge_cached_best_of_q06_keeps_profile_with_its_half():
              "device_kind": "cpu:0", "trace_sample_rate": 4,
              "measured_at": "2026-08-02T00:00:00Z",
              "q01_rows_per_sec": 6.0}
-    merged = bench._merge_cached(fresh, prev)
+    merged = bench._merge_cached(fresh, prev, now=_NOW)
     assert merged["value"] == 10.0
     assert merged["programs"] == 3
     assert merged["device_time_s"] == 0.5
@@ -144,7 +156,7 @@ def test_merge_cached_old_format_winner_drops_fresh_profile_keys():
              "bound": "dispatch-bound",
              "device_kind": "cpu:0", "trace_sample_rate": 1,
              "measured_at": "2026-08-02T00:00:00Z"}
-    merged = bench._merge_cached(fresh, prev)
+    merged = bench._merge_cached(fresh, prev, now=_NOW)
     assert merged["value"] == 10.0
     assert "programs" not in merged
     assert "device_time_s" not in merged
@@ -178,7 +190,7 @@ def test_merge_cached_cache_block_travels_per_half():
                        "q01": {"hit_speedup": 1000.0, "fp": "cc" * 6}},
              "q01_rows_per_sec": 6.0,
              "measured_at": "2026-08-02T00:00:00Z"}
-    merged = bench._merge_cached(fresh, prev)
+    merged = bench._merge_cached(fresh, prev, now=_NOW)
     assert merged["q06_cache_miss_s"] == 0.5
     assert merged["q06_cache_hit_s"] == 0.0002
     assert merged["cache"]["q06"] == prev["cache"]["q06"]
@@ -188,7 +200,7 @@ def test_merge_cached_cache_block_travels_per_half():
     # an old-format winner (no cache block) drops the fresh q06 story
     old_prev = {"backend": "tpu", "value": 10.0, "q01_rows_per_sec": 5.0,
                 "measured_at": "2026-08-01T00:00:00Z"}
-    merged = bench._merge_cached(dict(fresh), old_prev)
+    merged = bench._merge_cached(dict(fresh), old_prev, now=_NOW)
     assert "q06_cache_miss_s" not in merged
     assert "q06" not in merged["cache"]
     assert merged["cache"]["q01"] == fresh["cache"]["q01"]
@@ -200,7 +212,7 @@ def test_merge_cached_non_tpu_prev_never_wins_best_of():
     # written by tpu children, so prev is tpu in practice)
     prev = {"backend": "cpu", "value": 99.0, "q01_rows_per_sec": 1.0}
     fresh = {"backend": "tpu", "value": 2.0}
-    merged = bench._merge_cached(fresh, prev)
+    merged = bench._merge_cached(fresh, prev, max_age_days=0)
     assert merged["value"] == 2.0
     assert merged["q01_rows_per_sec"] == 1.0
 
@@ -237,3 +249,77 @@ def test_tpu_env_scrubs_only_cpu_forcing_values(monkeypatch):
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
     env = bench._tpu_env()
     assert "JAX_PLATFORMS" not in env and "PALLAS_AXON_POOL_IPS" not in env
+
+
+# --------------------------- stale-cache guard (PR 19 satellite)
+
+
+def test_merge_cached_drops_stale_q01_half():
+    """A carried half older than spark.blaze.bench.maxCacheAgeDays is
+    refused — the kernels it measured predate too many engine changes
+    to caption a fresh line — and the refusal is recorded."""
+    prev = {"backend": "tpu", "value": 1.0,
+            "measured_at": "2026-08-01T00:00:00Z",
+            "q01_rows_per_sec": 5.0,
+            "q01_measured_at": "2026-07-20T00:00:00Z"}
+    fresh = {"backend": "tpu", "value": 2.0,
+             "measured_at": "2026-08-02T00:00:00Z"}
+    merged = bench._merge_cached(fresh, prev, max_age_days=3, now=_NOW)
+    assert merged.get("q01_rows_per_sec") is None
+    assert "q01_measured_at" not in merged
+    assert merged["cache_stale_dropped"] == ["q01"]
+
+
+def test_merge_cached_stale_q06_winner_loses_best_of():
+    """A stronger-but-stale cached q06 must NOT win best-of: the fresh
+    (weaker) number stands and gets re-measured on its own merits."""
+    prev = {"backend": "tpu", "value": 10.0,
+            "measured_at": "2026-07-20T00:00:00Z"}
+    fresh = {"backend": "tpu", "value": 4.0,
+             "measured_at": "2026-08-02T00:00:00Z"}
+    merged = bench._merge_cached(fresh, prev, max_age_days=3, now=_NOW)
+    assert merged["value"] == 4.0
+    assert merged["measured_at"] == "2026-08-02T00:00:00Z"
+    assert merged["cache_stale_dropped"] == ["q06"]
+
+
+def test_merge_cached_age_guard_zero_disables():
+    prev = {"backend": "tpu", "value": 10.0,
+            "measured_at": "1999-01-01T00:00:00Z",
+            "q01_rows_per_sec": 5.0,
+            "q01_measured_at": "1999-01-01T00:00:00Z"}
+    fresh = {"backend": "tpu", "value": 4.0}
+    merged = bench._merge_cached(fresh, prev, max_age_days=0, now=_NOW)
+    assert merged["value"] == 10.0
+    assert merged["q01_rows_per_sec"] == 5.0
+    assert "cache_stale_dropped" not in merged
+
+
+def test_merge_cached_unparseable_stamp_counts_as_stale():
+    # a half that cannot PROVE its age is not carried
+    assert bench._stale(None, 3, _NOW)
+    assert bench._stale("not-a-date", 3, _NOW)
+    assert not bench._stale("2026-08-02T00:00:00Z", 3, _NOW)
+    prev = {"backend": "tpu", "value": 1.0, "q01_rows_per_sec": 5.0}
+    fresh = {"backend": "tpu", "value": 2.0}
+    merged = bench._merge_cached(fresh, prev, max_age_days=3, now=_NOW)
+    assert merged.get("q01_rows_per_sec") is None
+    assert merged["cache_stale_dropped"] == ["q01"]
+
+
+def test_merge_cached_device_share_travels_with_half():
+    """qNN_device_share (the majority-device headline) is part of each
+    half's profile and must carry/drop WITH that half."""
+    assert "q01_device_share" in bench._Q01_CARRY_KEYS
+    assert "q06_device_share" in bench._Q06_BEST_OF_KEYS
+    prev = {"backend": "tpu", "value": 10.0,
+            "measured_at": "2026-08-01T00:00:00Z",
+            "q06_device_share": 0.82,
+            "q01_rows_per_sec": 5.0,
+            "q01_device_share": 0.64,
+            "q01_measured_at": "2026-08-01T00:00:00Z"}
+    fresh = {"backend": "tpu", "value": 4.0, "q06_device_share": 0.2,
+             "measured_at": "2026-08-02T00:00:00Z"}
+    merged = bench._merge_cached(fresh, prev, now=_NOW)
+    assert merged["q06_device_share"] == 0.82  # cached winner's share
+    assert merged["q01_device_share"] == 0.64  # carried with the half

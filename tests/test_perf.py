@@ -483,20 +483,29 @@ def test_perfcheck_improvement_also_drifts():
 
 
 def test_perfcheck_bound_flip_borderline_is_noise():
-    """A bound-class flip across a borderline dispatch/device split
-    (within 3x either way) is measurement noise, not drift — a loaded
-    CI host legitimately swings the CPU backend's split 2-3x, while a
-    dispatch-floor re-fragmentation moves it an order of magnitude."""
+    """A bound-class flip across a borderline dispatch/device split —
+    within 10x either way, or with neither side past the absolute
+    magnitude floor — is measurement noise, not drift: a loaded CI
+    host swings the CPU backend's split 4-8x and collapses a small
+    query's device reading to near zero (q6 at perfcheck scale:
+    device 0.14 ms vs dispatch 8.8 ms under full-suite load), while a
+    dispatch-floor re-fragmentation moves the ratio over an order of
+    magnitude AND the dispatch wall into the hundreds of ms."""
     base = {"warm_dispatches": 10, "programs": 10, "warm_compiles": 0,
             "bound": "dispatch-bound"}
-    for dev, disp in ((100, 90), (100, 49), (100, 290)):
+    ms = 1_000_000
+    for dev, disp in ((100 * ms, 90 * ms), (100 * ms, 11 * ms),
+                      (100 * ms, 950 * ms),
+                      # decisive RATIO but under the magnitude floor —
+                      # the real q6 full-suite-load reading
+                      (138589, 8841526)):
         noisy = {"warm_dispatches": 10, "programs": 10,
                  "warm_compiles": 0, "bound": "memory-bound",
                  "device_ns": dev, "dispatch_ns": disp}
         assert perf.check_query("qx", noisy, base, 0.25) == [], (dev, disp)
     decisive = {"warm_dispatches": 10, "programs": 10,
                 "warm_compiles": 0, "bound": "memory-bound",
-                "device_ns": 1000, "dispatch_ns": 10}
+                "device_ns": 1000 * ms, "dispatch_ns": 10 * ms}
     problems = perf.check_query("qx", decisive, base, 0.25)
     assert problems and "flipped" in problems[0]
 
