@@ -268,6 +268,15 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
                 np.asarray(b.columns[0].data)
             return out
 
+        # drop estimator registrations an earlier calibration pass may
+        # have left behind — this query's drift flush below must report
+        # ONLY its own plans (runtime/stats.py)
+        try:
+            from blaze_tpu.runtime import stats as rtstats
+
+            rtstats.discard_pending()
+        except Exception:  # noqa: BLE001 — optional, like the
+            pass  # profile pass below
         with dispatch.capture() as cold:
             once()  # compile warmup
         t0 = time.perf_counter()
@@ -351,6 +360,23 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
             pass  # optional: a tunnel flap here must not discard the
             # ALREADY-COMPLETED throughput measurement above (the line
             # simply ships without the profile keys)
+        # runtime-stats drift (runtime/stats.py): flush the estimator
+        # registrations the warmup/timed/profiled iterations
+        # accumulated, so the emitted line carries estimate quality
+        # (qNN_qerror_max / qNN_skew_ratio) next to the throughput it
+        # rode on — a regression in cardinality estimation shows up in
+        # the same artifact as a regression in rows/s
+        try:
+            from blaze_tpu.runtime import stats as rtstats
+
+            s = rtstats.flush(stats.get("query_id", "bench"))
+            if s is not None:
+                if s.get("qerror_max") is not None:
+                    stats["qerror_max"] = s["qerror_max"]
+                if s.get("skew_ratio") is not None:
+                    stats["skew_ratio"] = s["skew_ratio"]
+        except Exception:  # noqa: BLE001 — optional pass, same rule
+            pass  # as the profile pass above
         # result-cache split (runtime/querycache.py): one warm MISS
         # iteration (fingerprint + execute + store) vs one HIT served
         # from the result cache — the serving-path claim ("a repeated
@@ -397,6 +423,15 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
                 stats["cache_fp"] = fp.digest[:12]
         except Exception:  # noqa: BLE001 — optional pass, same rule
             pass  # as the profile pass above
+        # the cache split re-ran optimize_plan: drop ITS estimator
+        # registrations so the NEXT query's drift flush only reports
+        # its own plans
+        try:
+            from blaze_tpu.runtime import stats as rtstats
+
+            rtstats.discard_pending()
+        except Exception:  # noqa: BLE001 — optional pass, same rule
+            pass
         return dt, stats
 
     def with_retry(fn):
@@ -456,6 +491,12 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
             result[k] = stats6[k]
     if "device_share" in stats6:
         result["q06_device_share"] = stats6["device_share"]
+    # estimate-drift headline per half (runtime/stats.py): how far the
+    # planner's cardinality estimates were from this run's actuals
+    if "qerror_max" in stats6:
+        result["q06_qerror_max"] = stats6["qerror_max"]
+    if "skew_ratio" in stats6:
+        result["q06_skew_ratio"] = stats6["skew_ratio"]
     if "cache_hit_s" in stats6:
         result["q06_cache_miss_s"] = stats6["cache_miss_s"]
         result["q06_cache_hit_s"] = stats6["cache_hit_s"]
@@ -483,7 +524,9 @@ def _measure(scale_q6: float, scale_q1: float, on_tpu: bool,
                      ("mfu_est", "q01_mfu_est"),
                      ("bound", "q01_bound"),
                      ("trace_id", "q01_trace_id"),
-                     ("query_id", "q01_query_id")):
+                     ("query_id", "q01_query_id"),
+                     ("qerror_max", "q01_qerror_max"),
+                     ("skew_ratio", "q01_skew_ratio")):
         if src in stats1:
             result[dst] = stats1[src]
     # per-half provenance: best-of can pair a CACHED q06 (whose
@@ -526,6 +569,7 @@ _Q01_CARRY_KEYS = (
     "q01_hbm_bytes_est", "q01_hbm_util", "q01_mfu_est", "q01_bound",
     "q01_device_kind", "q01_trace_sample_rate",
     "q01_trace_id", "q01_query_id",
+    "q01_qerror_max", "q01_skew_ratio",
     "q01_cache_miss_s", "q01_cache_hit_s",
 )
 # the q06 half, kept together under best-of selection — pairing one
@@ -542,6 +586,7 @@ _Q06_BEST_OF_KEYS = (
     "hbm_bytes_est", "hbm_util", "mfu_est", "bound",
     "device_kind", "trace_sample_rate",
     "trace_id", "query_id",
+    "q06_qerror_max", "q06_skew_ratio",
     "q06_cache_miss_s", "q06_cache_hit_s",
 )
 

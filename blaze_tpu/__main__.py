@@ -371,7 +371,7 @@ def _run_explain(suite: str, names, scale: float, n_parts: int,
     import tempfile
 
     from . import conf
-    from .runtime import perf, trace
+    from .runtime import monitor, perf, stats, trace
     from .runtime.kernel_cache import enable_persistent_cache
 
     enable_persistent_cache()
@@ -401,11 +401,22 @@ def _run_explain(suite: str, names, scale: float, n_parts: int,
                 # OUTSIDE the profiled run, so the explain shows the
                 # steady state
                 _rows_via_scheduler(build_query(name, scans, n_parts))
+                # the warm pass registered its plans with the stats
+                # observatory too — drop them so the flush at the
+                # profiled span's exit describes ONLY the traced run
+                stats.discard_pending()
                 conf.TRACE_ENABLE.set(True)
                 conf.EVENT_LOG_DIR.set(log_dir)
                 trace.reset()
                 try:
-                    with trace.query(f"{suite}_{name}") as log_path:
+                    # the full query span (trace + monitor + cancel
+                    # scope), not a bare trace.query: the runtime-stats
+                    # flush at span exit stamps est-vs-actual drift
+                    # into THIS event log and persists the actuals for
+                    # the next run's warm estimates
+                    with monitor.query_span(
+                            f"{suite}_{name}",
+                            mode="explain") as log_path:
                         _rows_via_scheduler(
                             build_query(name, scans, n_parts))
                 finally:
@@ -2269,6 +2280,179 @@ def _run_cache_storm(suite, names, scans, build_query, n_parts,
     return 0
 
 
+def _run_skew_storm(suite, seed) -> int:
+    """Skew-storm chaos arm: a seeded zipf-skewed hash exchange (~80%
+    of rows sharing ONE hot key) through the stage scheduler with the
+    runtime-stats observatory armed — asserting the skew detector end
+    to end: exactly one ``stats_skew_detected`` event fires, it names
+    the hot partition id (computed up front from the same murmur3 pmod
+    the exchange uses), the stats registry's findings reconcile with
+    the event log, the stats store commits without ``.inprogress``
+    litter, and the lockset / error-escape / leak oracles stay quiet.
+
+    The arm builds its own skewed MemoryScan table (suite data is
+    deliberately well-distributed); the suite arg is accepted for
+    wiring symmetry with the other storm arms."""
+    import glob
+    import os
+    import random
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from . import conf
+    from .analysis import locks as lock_verify
+    from .batch import batch_from_pydict, column_from_numpy
+    from .exprs import col
+    from .exprs.hash import murmur3_columns, pmod
+    from .ops.memory_scan import MemoryScanExec
+    from .parallel.exchange import NativeShuffleExchangeExec
+    from .parallel.shuffle import HashPartitioning
+    from .runtime import errors, ledger, lockset, monitor, stats, trace
+    from .schema import DataType, Field, Schema
+
+    rng = random.Random(seed * 48271 + 3)
+    knobs = (conf.STATS_ENABLED, conf.STATS_SKETCHES,
+             conf.STATS_STORE_ENABLED, conf.STATS_STORE_DIR,
+             conf.STATS_SKEW_RATIO, conf.STATS_SKEW_MIN_ROWS,
+             conf.TRACE_ENABLE, conf.EVENT_LOG_DIR, conf.MONITOR_ENABLE)
+    prev = [k.get() for k in knobs]
+    conf.VERIFY_LOCKS.set(True)
+    lock_verify.refresh()
+    conf.VERIFY_LOCKSET.set(True)
+    lockset.refresh()
+    lockset.reset()
+    conf.VERIFY_ERRORS.set(True)
+    errors.refresh()
+    ledger.refresh()
+    problems = []
+    shuffle_glob = os.path.join(tempfile.gettempdir(), "blaze_shuffle_*")
+    spills_before = set(glob.glob(ledger.spill_glob()))
+    roots_before = set(glob.glob(shuffle_glob))
+    store_dir = tempfile.mkdtemp(prefix="blaze_skew_store_")
+    log_dir = tempfile.mkdtemp(prefix="blaze_skew_log_")
+    hot_pid = -1
+    try:
+        try:
+            conf.STATS_ENABLED.set(True)
+            conf.STATS_SKETCHES.set(True)
+            conf.STATS_STORE_ENABLED.set(True)
+            conf.STATS_STORE_DIR.set(store_dir)
+            conf.STATS_SKEW_RATIO.set(3.0)
+            conf.STATS_SKEW_MIN_ROWS.set(256)
+            conf.TRACE_ENABLE.set(True)
+            conf.EVENT_LOG_DIR.set(log_dir)
+            conf.MONITOR_ENABLE.set(True)
+            stats.refresh()
+            stats.reset()
+            trace.reset()
+            monitor.reset()
+
+            # the seeded zipf-ish table: ~80% of rows share ONE hot
+            # key, the rest spread over a 2^20 key space — hashed into
+            # 8 partitions this MUST trip the detector, and the hot
+            # partition id is computable up front from the same
+            # murmur3(seed42) pmod the exchange runs
+            n_out = 8
+            n_rows = 8192
+            hot_key = rng.randrange(1 << 20)
+            keys = [hot_key if rng.random() < 0.8
+                    else rng.randrange(1 << 20) for _ in range(n_rows)]
+            schema = Schema([Field("k", DataType.int64()),
+                             Field("v", DataType.float64())])
+            quarter = n_rows // 4
+            table = MemoryScanExec([
+                [batch_from_pydict({
+                    "k": keys[p * quarter:(p + 1) * quarter],
+                    "v": [rng.uniform(0.0, 1.0) for _ in range(quarter)],
+                }, schema)] for p in range(4)])
+            kcol = column_from_numpy(
+                DataType.int64(), np.array([hot_key], np.int64))
+            hot_pid = int(np.asarray(
+                pmod(murmur3_columns([kcol.to_device()]), n_out))[0])
+
+            with monitor.query_span(f"skew-storm-{seed}",
+                                    mode="chaos") as log_path:
+                _rows_via_scheduler(NativeShuffleExchangeExec(
+                    table, HashPartitioning([col("k")], n_out)))
+            if log_path is None:
+                raise RuntimeError(
+                    "tracing did not arm (a BLAZE_TRACE_ENABLED env "
+                    "override?) — the skew storm judges the event log")
+            events = trace.read_event_log(log_path)
+            skews = [e for e in events
+                     if e.get("type") == "stats_skew_detected"]
+            if len(skews) != 1:
+                problems.append(
+                    f"expected exactly 1 stats_skew_detected event, "
+                    f"got {len(skews)}")
+            else:
+                ev = skews[0]
+                if ev.get("partition") != hot_pid:
+                    problems.append(
+                        f"skew event names partition "
+                        f"{ev.get('partition')}, expected hot "
+                        f"partition {hot_pid}")
+                if ev.get("ratio", 0.0) < 3.0:
+                    problems.append(
+                        f"skew ratio {ev.get('ratio')} below the "
+                        f"3.0 threshold that fired it")
+            # the registry's findings must reconcile with the event
+            # log — same findings, same hot partitions, same rows
+            summary = stats.last_query_stats() or {}
+            reg = summary.get("findings", [])
+            if [(f.get("partition"), f.get("rows")) for f in reg] != \
+                    [(e.get("partition"), e.get("rows")) for e in skews]:
+                problems.append(
+                    f"stats registry findings ({len(reg)}) diverge "
+                    f"from the event log ({len(skews)})")
+            if not any(e.get("type") == "stats_persisted"
+                       for e in events):
+                problems.append("no stats_persisted event — the exact "
+                                "map-stage plan never reached the store")
+            stray = [p for p in os.listdir(store_dir)
+                     if not p.endswith(".json")]
+            if stray:
+                problems.append("stats store litter: " + ", ".join(stray))
+            races = lockset.reported()
+            if races:
+                problems.append("lockset violation(s): " + "; ".join(races))
+            escaped = errors.escapes()
+            if escaped:
+                problems.append("FATAL-class error escape(s): "
+                                + "; ".join(escaped))
+        except Exception as e:  # noqa: BLE001 — the arm must report, not die
+            problems.append(f"skew storm crashed: {type(e).__name__}: {e}")
+        finally:
+            for k, v in zip(knobs, prev):
+                k.set(v)
+            stats.refresh()
+            stats.reset()
+            trace.reset()
+            monitor.reset()
+            conf.VERIFY_LOCKS.set(False)
+            lock_verify.refresh()
+            conf.VERIFY_LOCKSET.set(False)
+            lockset.refresh()
+        problems += ledger.leak_audit(
+            shuffle_root=sorted(set(glob.glob(shuffle_glob)) - roots_before),
+            spills_before=spills_before)
+    finally:
+        conf.VERIFY_ERRORS.set(False)
+        errors.refresh()
+        ledger.refresh()
+        shutil.rmtree(store_dir, ignore_errors=True)
+        shutil.rmtree(log_dir, ignore_errors=True)
+    if problems:
+        print(f"skew-storm (seed {seed}): " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    print(f"skew-storm (seed {seed}): OK (1 skew finding, hot partition "
+          f"{hot_pid}, registry == event log, store + ledger clean)")
+    return 0
+
+
 def _live_attempt_threads():
     """Attempt-runner threads still alive after a run — kept as a thin
     alias of the shared leak oracle's thread check
@@ -2480,7 +2664,13 @@ def main(argv=None) -> int:
                          "alert fires during the storm, resolves after "
                          "recovery, and reconciles in the event log; "
                          "the first seed also writes and verifies an "
-                         "incident debug bundle); nonzero "
+                         "incident debug bundle) plus a skew-storm arm "
+                         "(a seeded zipf-skewed hash exchange with the "
+                         "runtime-stats observatory armed, asserting "
+                         "exactly one stats_skew_detected event naming "
+                         "the precomputed hot partition, registry == "
+                         "event-log reconciliation, and a clean stats "
+                         "store commit); nonzero "
                          "exit on any mismatch, unreconciled event log, "
                          "hung or untyped submission, leaked thread, "
                          "undetected corruption, unrecovered worker "
@@ -2791,6 +2981,8 @@ def main(argv=None) -> int:
                                       args.chaos_seed + k) or rc
                 rc = _run_slo_storm(args.suite, args.chaos_seed + k,
                                     make_bundle=(k == 0)) or rc
+                rc = _run_skew_storm(args.suite,
+                                     args.chaos_seed + k) or rc
         elif args.chaos:
             rc = _run_chaos(args.suite, queries, args.scale, args.parts,
                             args.chaos_seed, args.chaos_faults)

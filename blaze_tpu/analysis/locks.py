@@ -95,6 +95,12 @@ HIERARCHY: Tuple[str, ...] = (
                              # and emission happens outside)
     "monitor.progress",      # per-stage progress counters (leaf: held
                              # only for arithmetic, emission is outside)
+    "stats.registry",        # runtime-stats live plan registry +
+                             # per-exchange histograms + HLL merges
+                             # (held for dict/array arithmetic only;
+                             # flush drains under it, then all trace
+                             # emission, metric bumps, and store IO
+                             # happen strictly after release)
     "otel.state",            # OTLP export queue + pusher lifecycle
                              # (held for list/slot mutation only; the
                              # HTTP POST and file IO happen outside)

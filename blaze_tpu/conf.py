@@ -490,6 +490,29 @@ PERF_PEAKS = ConfEntry("spark.blaze.perf.peaks", "", str)
 # shipped a q01 number stamped six days stale.  0 = never expire.
 BENCH_MAX_CACHE_AGE_DAYS = ConfEntry("spark.blaze.bench.maxCacheAgeDays", 3, int)
 
+# Runtime statistics observatory (runtime/stats.py): cardinality
+# estimates stamped at optimize_plan, per-partition exchange
+# histograms, Q-error drift reporting, and partition-skew findings.
+# Disarmed cost is one module-global bool read per hook (the
+# trace.enabled() contract).
+STATS_ENABLED = ConfEntry("spark.blaze.stats.enabled", True, _bool)
+# Per-group-key NDV HyperLogLog sketches on agg output streams —
+# separately gated: updating a sketch reads column values back to the
+# host, which the counter-only stats path never does.
+STATS_SKETCHES = ConfEntry("spark.blaze.stats.sketches", False, _bool)
+# Persistent stats store keyed by the plan fingerprint digest,
+# versioned by source versions exactly like the result cache: observed
+# actuals written at query-span exit, consulted by the estimator on
+# the next run so warm estimates converge on actuals.
+STATS_STORE_ENABLED = ConfEntry("spark.blaze.stats.store.enabled", True, _bool)
+# Store directory (empty = <tmpdir>/blaze-stats-<uid>).
+STATS_STORE_DIR = ConfEntry("spark.blaze.stats.store.dir", "", str)
+# A partition is a skew finding when its rows are at least skewRatio x
+# the median partition AND at least skewMinRows absolute — the floor
+# keeps toy exchanges from alerting on noise.
+STATS_SKEW_RATIO = ConfEntry("spark.blaze.stats.skewRatio", 4.0, float)
+STATS_SKEW_MIN_ROWS = ConfEntry("spark.blaze.stats.skewMinRows", 4096, int)
+
 # Static analysis & verification (blaze_tpu/analysis/).
 # Plan verifier: run the rule-based structural checker
 # (analysis/plan_verify.py — schema edges, partitioning/ordering

@@ -1461,7 +1461,18 @@ class AggExec(ExecNode):
             finally:
                 ctx.mem.unregister_consumer(consumer)
 
-        return stream()
+        out_stream = stream()
+        # per-group-key NDV sketching (runtime/stats.py, behind
+        # spark.blaze.stats.sketches): the output layout puts the
+        # grouping keys first, so the sketch hashes exactly those
+        # columns.  Disarmed cost is the one sketches_enabled() read.
+        if self.groupings:
+            from ..runtime import stats as _stats
+
+            if _stats.sketches_enabled():
+                out_stream = _stats.sketch_stream(
+                    self, len(self.groupings), out_stream)
+        return out_stream
 
     def _finish(self, state: RecordBatch) -> RecordBatch:
         if self.mode == AggMode.FINAL:

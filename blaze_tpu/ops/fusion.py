@@ -592,7 +592,16 @@ def optimize_plan(plan):
     # literal slots) are already in the kernel cache
     from ..runtime.querycache import record_plan
 
-    record_plan(plan)
+    fp = record_plan(plan)
+    # Runtime-stats estimator (runtime/stats.py): stamp est_rows /
+    # est_bytes onto the optimized plan (persisted actuals for this
+    # fingerprint replace the cold estimates) and register the
+    # instance for actuals collection at query-span flush.  Disarmed
+    # cost is the one enabled() bool read.
+    from ..runtime import stats as _stats
+
+    if _stats.enabled():
+        _stats.annotate(plan, fp)
     return plan
 
 

@@ -350,6 +350,21 @@ class NativeShuffleExchangeExec(ExecNode):
         else:
             out = _split_pending(pending, n_out)
         self._inproc_outputs = out
+        self._note_stats(out)
+
+    def _note_stats(self, out: List[List]) -> None:
+        """Per-partition rows/bytes histogram for the runtime-stats
+        skew scan (runtime/stats.py) — counter arithmetic only, no
+        host sync (memory_size reads buffer shapes, not data)."""
+        from ..runtime import stats as _stats
+
+        if not _stats.enabled():
+            return
+        _stats.note_exchange(
+            f"shuffle_{self.shuffle_id}",
+            f"{self.name()}[{type(self.partitioning).__name__}]",
+            [sum(b.num_rows for b in part) for part in out],
+            [sum(b.memory_size() for b in part) for part in out])
 
     def materialize(self) -> None:
         """Run all map tasks once (the stage boundary)."""
@@ -465,6 +480,7 @@ class NativeShuffleExchangeExec(ExecNode):
             del batches, per_batch_words
             out = _split_pending(pending, n_out)
         self._inproc_outputs = out
+        self._note_stats(out)
 
     def execute(self, partition: int, ctx: TaskContext) -> BatchStream:
         from .. import conf

@@ -188,8 +188,9 @@ class ShuffleRepartitioner(MemConsumer):
     #: spills all mutate the staged buffers
     GUARDED_BY = {"_buffers": "shuffle.repartitioner",
                   "_buffered_bytes": "shuffle.repartitioner",
-                  "_spills": "shuffle.repartitioner"}
-    GUARDED_REFS = ("_buffers", "_spills")
+                  "_spills": "shuffle.repartitioner",
+                  "_part_rows": "shuffle.repartitioner"}
+    GUARDED_REFS = ("_buffers", "_spills", "_part_rows")
 
     def __init__(self, schema: Schema, n_out: int, metrics, task_attempt_id: int = 0):
         super().__init__()
@@ -201,6 +202,9 @@ class ShuffleRepartitioner(MemConsumer):
 
         self._buffers: List[List[RecordBatch]] = [[] for _ in range(n_out)]
         self._buffered_bytes = 0
+        # per-partition row tally across the whole map task (spills
+        # included) — the runtime-stats skew histogram's raw input
+        self._part_rows = np.zeros(n_out, dtype=np.int64)
         self._spills: List[Tuple[Spill, List[Tuple[int, int]]]] = []  # (spill, [(pid, nframes)])
         # commit replayability marker for _commit_with_recovery: True
         # once write_output has consumed spill frames (written only by
@@ -231,7 +235,7 @@ class ShuffleRepartitioner(MemConsumer):
         offsets = np.concatenate([[0], np.cumsum(counts)])
         cols = sorted_batch_host.columns
         with self._lock:
-            lockset.check(self, "_buffers", "_buffered_bytes")
+            lockset.check(self, "_buffers", "_buffered_bytes", "_part_rows")
             for pid in range(self.n_out):
                 lo, hi = int(offsets[pid]), int(offsets[pid + 1])
                 if hi == lo:
@@ -239,6 +243,7 @@ class ShuffleRepartitioner(MemConsumer):
                 b = RecordBatch(self.schema, [slice_col(c, lo, hi) for c in cols], hi - lo)
                 self._buffers[pid].append(b)
                 self._buffered_bytes += b.memory_size()
+                self._part_rows[pid] += hi - lo
             buffered = self._buffered_bytes
         self.update_mem_used(buffered)
 
@@ -302,6 +307,14 @@ class ShuffleRepartitioner(MemConsumer):
             self.metrics.add("spill_count", 1)
             self.metrics.add("spilled_bytes", freed)
             return freed
+
+    def partition_rows(self) -> np.ndarray:
+        """Per-partition row tally for the whole map task (spills
+        included) — consumed by the runtime-stats skew histogram after
+        a successful commit."""
+        with self._lock:
+            lockset.check(self, "_part_rows")
+            return self._part_rows.copy()
 
     def release(self) -> None:
         """Teardown for an attempt that will NOT commit (failed,
@@ -1154,6 +1167,17 @@ class ShuffleWriterExec(ExecNode):
                         rep, self.data_path, self.index_path)
                 self.metrics.add("data_size", sum(self.partition_lengths))
                 committed = True
+                # per-partition histogram for the runtime-stats skew
+                # scan: all map tasks of one shuffle fold into one
+                # histogram keyed off the map-output path
+                from ..runtime import stats as _stats
+
+                if _stats.enabled():
+                    _stats.note_exchange(
+                        _stats.exchange_key(self.data_path),
+                        f"{self.name()}"
+                        f"[{type(self.partitioning).__name__}]",
+                        rep.partition_rows(), self.partition_lengths)
             finally:
                 if inserter is not None:
                     # cancel/failure mid-ring: the ringed device outputs

@@ -540,7 +540,15 @@ def query_span(query_id: str, mode: str = "in-process",
                     # log landed so /queries/<id>/explain can render
                     # EXPLAIN ANALYZE from it after the run
                     set_query_eventlog(log_path)
-                    yield log_path
+                    try:
+                        yield log_path
+                    finally:
+                        # runtime-stats flush INSIDE the trace +
+                        # monitor scopes: the stats events land in
+                        # this query's event log, and the qerror/skew
+                        # stamps reach the registry entry BEFORE the
+                        # history summary renders at query() exit
+                        _flush_stats(query_id)
     except BaseException:
         # SLO error accounting only — the failure propagates untouched
         ok = False
@@ -565,6 +573,39 @@ def query_span(query_id: str, mode: str = "in-process",
             # the event log is complete here (query_end emitted by the
             # trace span's own finally): convert + sink, best-effort
             otel.export_query(query_id, log_path)
+
+
+def _flush_stats(query_id: str) -> None:
+    """Best-effort runtime-stats flush at query-span exit
+    (runtime/stats.py): drift + skew findings must never fail the
+    query they describe.  One bool read disarmed."""
+    from . import stats as _stats
+
+    if not _stats.enabled():
+        return
+    try:
+        _stats.flush(query_id)
+    except Exception as e:  # noqa: BLE001 — stats are observability;
+        # a flush failure must not turn a finished query into an error
+        errors.reraise_control(e)
+
+
+def note_query_stats(qerror_max: Optional[float],
+                     skew_ratio: Optional[float]) -> None:
+    """Stamp the CURRENT query's registry entry with the flushed
+    runtime-stats summary (no-op when disarmed or outside a query
+    scope) — surfaced in ``/queries``, the history JSONL, the
+    per-query Prometheus gauges, and ``--watch``."""
+    if not enabled():
+        return
+    with _lock:
+        q = _current_entry()
+        if q is not None:
+            if qerror_max is not None:
+                q["qerror_max"] = qerror_max
+            if skew_ratio is not None:
+                q["skew_ratio"] = skew_ratio
+            _bump()
 
 
 def set_query_eventlog(path: Optional[str]) -> None:
@@ -793,6 +834,21 @@ def _render_query(key: str, q: Dict[str, Any], now: int) -> Dict[str, Any]:
             "elapsed_s": round((s_end - st["t0"]) / 1e9, 3),
             "heartbeat_age_s": round((now - st["last_beat"]) / 1e9, 3),
         })
+    # roofline verdict over the whole query (same classifier the
+    # per-query Prometheus gauges use) — only when the perf-estimator
+    # numerators actually landed, so an untraced run claims no bound
+    bound = None
+    q_bytes = sum(st["bytes_est"] for st in stages)
+    q_flops = sum(st["flops_est"] for st in stages)
+    if q_bytes or q_flops:
+        from . import perf
+
+        cls = perf.classify(
+            sum(st["device_ns"] for st in stages),
+            sum(st["dispatch_ns"] for st in stages),
+            q_bytes, q_flops,
+            perf.peaks_for(perf.current_device_kind()))
+        bound = cls["bound"]
     return {
         "key": key,
         "query_id": q["query_id"],
@@ -805,6 +861,12 @@ def _render_query(key: str, q: Dict[str, Any], now: int) -> Dict[str, Any]:
         "heartbeat_age_s": round((now - q["last_beat"]) / 1e9, 3),
         "attempts": dict(q["attempts"]),
         "mem_peak_bytes": q["mem_peak"],
+        # runtime-stats drift summary (runtime/stats.py flush at
+        # query-span exit); null when the observatory is disarmed or
+        # the query predates it
+        "qerror_max": q.get("qerror_max"),
+        "skew_ratio": q.get("skew_ratio"),
+        "bound": bound,
         # where this query's event log landed (traced runs) — the
         # /queries/<id>/explain source; null when untraced
         "eventlog": q.get("eventlog"),
@@ -857,6 +919,14 @@ def snapshot(include_history: bool = False) -> Dict[str, Any]:
         sdoc = slo.doc()
         if sdoc.get("pools"):
             doc["slo"] = sdoc["pools"]
+    # runtime-stats observatory (runtime/stats.py): the last flushed
+    # drift summary + recent skew findings, so /queries and --watch
+    # readers see estimate quality next to the live queries.  One bool
+    # read disarmed.
+    from . import stats as _stats
+
+    if _stats.enabled():
+        doc["stats"] = _stats.snapshot()
     return doc
 
 
@@ -1750,6 +1820,15 @@ def render_prometheus(openmetrics: bool = False) -> str:
         labels = {"query": q["query_id"]}
         doc.add("blaze_query_elapsed_seconds", q["elapsed_s"], labels,
                 mtype="gauge")
+        # runtime-stats drift gauges (runtime/stats.py): exported only
+        # for queries the observatory actually flushed — a query with
+        # no estimates exports nothing rather than a misleading 0
+        if q.get("qerror_max") is not None:
+            doc.add("blaze_query_qerror_max", q["qerror_max"], labels,
+                    mtype="gauge")
+        if q.get("skew_ratio") is not None:
+            doc.add("blaze_stage_skew_ratio", q["skew_ratio"], labels,
+                    mtype="gauge")
         # roofline gauges (runtime/perf.py): hbm_util / mfu_est / bound
         # per query from the task beats' kernel-sink estimates —
         # exported only for traced runs with the estimator armed
@@ -1993,6 +2072,13 @@ class MonitorServer:
                         # burn-rate state per pool objective (drives an
                         # evaluation first — never stale alert state)
                         body = json.dumps(slo.doc()).encode()
+                        ctype = "application/json"
+                    elif path == "/stats":
+                        # runtime-stats observatory: last drift summary
+                        # + recent skew findings (runtime/stats.py)
+                        from . import stats as _stats
+
+                        body = json.dumps(_stats.snapshot()).encode()
                         ctype = "application/json"
                     elif path in ("/", "/healthz"):
                         body = json.dumps(healthz_doc()).encode()
@@ -2443,6 +2529,15 @@ def render_watch(snap: Dict[str, Any], url: str = "") -> str:
                 f"slo {pname}/{kind}: {mark}  "
                 f"burn fast {s['burn_fast']:.2f} slow {s['burn_slow']:.2f}"
                 f"  budget {s['budget_remaining'] * 100:.0f}%")
+    # the drift story: recent skew findings from the runtime-stats
+    # observatory, hot partition named so the fix is actionable
+    stats_doc = snap.get("stats")
+    if stats_doc:
+        for f in list(stats_doc.get("findings") or ())[-3:]:
+            lines.append(
+                f"skew {f['exchange']} p{f['partition']}: "
+                f"{f['rows']:,d} rows {f['ratio']:.1f}x median "
+                f"({f['partitions']} partitions, {f['op']})")
     if not queries:
         lines.append("  (no queries registered yet)")
         return "\n".join(lines)
@@ -2475,6 +2570,15 @@ def render_watch(snap: Dict[str, Any], url: str = "") -> str:
             tail += (f"  integrity {deg['corruption_detected']} corrupt"
                      f"/{deg['blocks_quarantined']} quarantined"
                      f"/{deg['disk_pressure_recoveries']} disk")
+        # the estimate-quality story, when the observatory flushed it:
+        # worst per-node Q-error, hottest-partition skew ratio, and
+        # the roofline verdict
+        if q.get("qerror_max") is not None:
+            tail += f"  Q-err {q['qerror_max']:.2f}"
+        if q.get("skew_ratio") is not None:
+            tail += f" skew {q['skew_ratio']:.1f}x"
+        if q.get("bound"):
+            tail += f" {q['bound']}-bound"
         tenant = f" pool={q['pool']}" if q.get("pool") else ""
         tenant += f" session={q['session']}" if q.get("session") else ""
         lines.append(

@@ -392,7 +392,7 @@ def query_perf(events: List[Dict[str, Any]],
 #: gates it like the ``--report --json`` pins)
 EXPLAIN_JSON_KEYS = ("query_id", "status", "wall_ns", "attributed_ns",
                      "attributed_pct", "stages", "kernels", "perf",
-                     "cache", "autotune")
+                     "cache", "autotune", "stats")
 
 
 def _node_own_ns(metrics: Dict[str, Any]) -> int:
@@ -417,6 +417,17 @@ def _annotate_node(node: Dict[str, Any], wall_ns: int) -> Dict[str, Any]:
     }
     if fused and "[" in op:
         out["fused_ops"] = op.count("+") + 1
+    # cardinality-estimator stamps (runtime/stats.py at optimize_plan):
+    # estimate vs the actual above, Q-error = max(est/act, act/est) —
+    # absent on nodes the estimator could not reach (IpcReader inputs)
+    est = m.get("est_rows")
+    if est is not None:
+        est = int(est)
+        out["est_rows"] = est
+        out["est_bytes"] = int(m.get("est_bytes", 0))
+        if est > 0 and out["rows"] > 0:
+            out["q_error"] = round(max(est / out["rows"],
+                                       out["rows"] / est), 3)
     return out
 
 
@@ -502,6 +513,36 @@ def explain_doc(events: List[Dict[str, Any]],
         "perf": query_perf(events, device_kind=peaks_kind, kernels=rows),
         "cache": _cache_doc(t),
         "autotune": _autotune_doc(t),
+        "stats": _stats_doc(t, stages),
+    }
+
+
+def _stats_doc(t: Dict[str, List[Dict[str, Any]]],
+               stages: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The runtime-statistics story for one traced run: worst per-node
+    Q-error over the annotated plans, this run's skew findings
+    (``stats_skew_detected`` events), and the stats-store traffic
+    (``stats_reused`` / ``stats_persisted``)."""
+    qerrs: List[float] = []
+
+    def walk(n: Dict[str, Any]) -> None:
+        if n.get("q_error") is not None:
+            qerrs.append(n["q_error"])
+        for c in n.get("children", []):
+            walk(c)
+
+    for st in stages:
+        if st.get("plan") is not None:
+            walk(st["plan"])
+    skew = [{k: e.get(k) for k in ("exchange", "op", "partition",
+                                   "rows", "ratio", "partitions")}
+            for e in t.get("stats_skew_detected", [])]
+    return {
+        "qerror_max": max(qerrs) if qerrs else None,
+        "nodes_estimated": len(qerrs),
+        "skew": skew,
+        "reused": len(t.get("stats_reused", [])),
+        "persisted": len(t.get("stats_persisted", [])),
     }
 
 
@@ -552,6 +593,10 @@ def _render_node(node: Dict[str, Any], indent: int,
         marks.append(f"[fused x{n}]" if n else "[fused]")
     ann = (f"rows={node['rows']:,} bytes={node['bytes']:,} "
            f"batches={node['batches']}")
+    if node.get("est_rows") is not None:
+        ann += f" est={node['est_rows']:,}"
+        if node.get("q_error") is not None:
+            ann += f" Q-err={node['q_error']:.2f}"
     if node["own_ns"]:
         ann += (f" own={_fmt_ns(node['own_ns'])}"
                 f" ({node['pct_of_query']:.1f}% of query)")
@@ -605,6 +650,22 @@ def render_explain(events: List[Dict[str, Any]],
         if cd["result_hit_bytes"]:
             line += f"  served {cd['result_hit_bytes']:,}B off-device"
         lines.append(line)
+    sd = doc.get("stats") or {}
+    if sd.get("qerror_max") is not None or sd.get("skew"):
+        if sd.get("qerror_max") is not None:
+            line = (f"stats: Q-err max {sd['qerror_max']:.2f} over "
+                    f"{sd['nodes_estimated']} estimated node"
+                    f"{'s' if sd['nodes_estimated'] != 1 else ''}")
+            if sd.get("reused"):
+                line += f"  (warm: reused {sd['reused']} stored plan)"
+            if sd.get("persisted"):
+                line += f"  (persisted {sd['persisted']})"
+            lines.append(line)
+        for f in sd.get("skew", []):
+            lines.append(
+                f"  !! skew {f['exchange']} p{f['partition']}: "
+                f"{f['rows']:,} rows {f['ratio']:.1f}x median of "
+                f"{f['partitions']} partitions ({f['op']})")
     for st in doc["stages"]:
         lines.append("")
         lines.append(

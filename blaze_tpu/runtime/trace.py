@@ -82,6 +82,7 @@ EVENT_TYPES = frozenset({
     "mem_watermark", "spill",
     "shuffle_write", "shuffle_fetch", "rss_push",
     "plan_cache", "result_cache",
+    "stats_skew_detected", "stats_persisted", "stats_reused",
 })
 
 SCHEMA_PATH = os.path.join(os.path.dirname(__file__), "trace_schema.json")

@@ -316,7 +316,14 @@ def _merge_plan(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
         "children": [dict(c) for c in a["children"]],
     }
     for k, v in b.get("metrics", {}).items():
-        merged["metrics"][k] = merged["metrics"].get(k, 0) + v
+        if k.startswith("est_"):
+            # estimator stamps (runtime/stats.py) are per-PLAN, not
+            # per-task: every task of the stage carries the same
+            # stamp, so summing would scale the estimate by the task
+            # count — take the max instead
+            merged["metrics"][k] = max(merged["metrics"].get(k, 0), v)
+        else:
+            merged["metrics"][k] = merged["metrics"].get(k, 0) + v
     kids = []
     for i, c in enumerate(merged["children"]):
         if i < len(b.get("children", [])):
@@ -325,6 +332,36 @@ def _merge_plan(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
             kids.append(c)
     merged["children"] = kids
     return merged
+
+
+def _stats_section(t: Dict[str, List[Dict[str, Any]]],
+                   plans: Dict[Any, Dict[str, Any]]) -> Dict[str, Any]:
+    """The runtime-statistics story (runtime/stats.py) for one traced
+    run, shared by the text and JSON reports: worst per-node Q-error
+    from the estimator stamps riding the merged plan metrics, this
+    run's skew findings, and the stats-store traffic."""
+    qerrs: List[float] = []
+
+    def walk(n: Dict[str, Any]) -> None:
+        m = n.get("metrics", {})
+        est, act = m.get("est_rows", 0), m.get("output_rows", 0)
+        if est > 0 and act > 0:
+            qerrs.append(round(max(est / act, act / est), 3))
+        for c in n.get("children", []):
+            walk(c)
+
+    for p in plans.values():
+        walk(p)
+    findings = [{k: e.get(k) for k in ("exchange", "op", "partition",
+                                       "rows", "ratio", "partitions")}
+                for e in t.get("stats_skew_detected", [])]
+    return {
+        "qerror_max": max(qerrs) if qerrs else None,
+        "nodes_estimated": len(qerrs),
+        "skew": findings,
+        "reused": len(t.get("stats_reused", [])),
+        "persisted": len(t.get("stats_persisted", [])),
+    }
 
 
 def _render_plan(node: Dict[str, Any], indent: int, out: List[str]) -> None:
@@ -562,6 +599,9 @@ def render_json(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         # mfu_est / bound classification — the measurement ROADMAP
         # items 3-4 judge batch-size autotuning and bench artifacts by
         "perf": qperf,
+        # the runtime-stats drift story (runtime/stats.py): worst
+        # per-node Q-error, skew findings, stats-store traffic
+        "stats": _stats_section(t, plans),
     }
 
 
@@ -693,6 +733,26 @@ def render(events: List[Dict[str, Any]]) -> str:
         sub: List[str] = []
         _render_plan(plans[sid], 1, sub)
         lines.extend(sub)
+
+    # ---- runtime stats / drift (estimator stamps + skew findings)
+    sd = _stats_section(t, plans)
+    if sd["qerror_max"] is not None or sd["skew"]:
+        lines.append("")
+        lines.append("runtime stats / drift:")
+        if sd["qerror_max"] is not None:
+            line = (f"  Q-err max {sd['qerror_max']:.2f} over "
+                    f"{sd['nodes_estimated']} estimated node"
+                    f"{'s' if sd['nodes_estimated'] != 1 else ''}")
+            if sd["reused"]:
+                line += f"  (warm: reused {sd['reused']} stored plan)"
+            if sd["persisted"]:
+                line += f"  (persisted {sd['persisted']})"
+            lines.append(line)
+        for f in sd["skew"]:
+            lines.append(
+                f"  !! skew {f['exchange']} p{f['partition']}: "
+                f"{f['rows']:,} rows {f['ratio']:.1f}x median of "
+                f"{f['partitions']} partitions ({f['op']})")
 
     # ---- data movement + memory
     sw = t.get("shuffle_write", [])

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import logging
 import pickle
+import re
 from typing import List, Optional
 
 from ..exprs.ir import (
@@ -187,8 +188,20 @@ def plan_from_proto(n: pb.PhysicalPlanNode):
 
     kind = n.WhichOneof("node")
     if kind == "memory_scan":
-        parts = RESOURCES.get(n.memory_scan.resource_id)
-        return MemoryScanExec(parts, schema_from_proto(n.memory_scan.schema))
+        rid = n.memory_scan.resource_id
+        parts = RESOURCES.get(rid)
+        scan = MemoryScanExec(parts, schema_from_proto(n.memory_scan.schema))
+        # re-adopt the ORIGINAL table's source identity from the rid
+        # (serde/to_proto.py encodes s<source_id>e<epoch>): a rebuilt
+        # scan is the SAME data source, not a fresh one — without this
+        # every task of a stage would mint its own source id, split
+        # the stage's plan fingerprint per task, and scatter the stats
+        # store's actuals across per-task entries
+        m = re.match(r"memscan_s(\d+)e(\d+)_", rid)
+        if m:
+            scan.source_id = int(m.group(1))
+            scan.epoch = int(m.group(2))
+        return scan
     if kind in ("parquet_scan", "orc_scan"):
         s = n.parquet_scan if kind == "parquet_scan" else n.orc_scan
         pred = None

@@ -236,7 +236,14 @@ def plan_to_proto(node) -> pb.PhysicalPlanNode:
         # serialized once per task (N tasks = N gets).  A serialized
         # plan that is never executed strands its entry until process
         # exit — callers (scheduler) serialize exactly what they run.
-        rid = f"memscan_{id(node)}_{next(_memscan_rids)}"
+        # the s<source_id>e<epoch> segment carries the table's data
+        # identity (querycache source versioning) across the serde
+        # boundary: every task rebuild of this scan re-adopts the
+        # ORIGINAL source id + epoch (serde/from_proto.py parses it
+        # back), so all tasks of a stage share one plan fingerprint
+        # and the stats store folds their actuals into one entry
+        rid = (f"memscan_s{node.source_id}e{node.epoch}"
+               f"_{id(node)}_{next(_memscan_rids)}")
         RESOURCES.put(rid, node._partitions)
         staged = STAGED_RIDS.get()
         if staged is not None:

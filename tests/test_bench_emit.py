@@ -81,6 +81,8 @@ def test_merge_cached_carries_whole_q01_half():
             "q01_mfu_est": 0.001, "q01_bound": "dispatch-bound",
             "q01_device_kind": "TPU v4", "q01_trace_sample_rate": 1,
             "q01_trace_id": "a" * 32, "q01_query_id": "bench_1_1",
+            # drift headline (runtime/stats.py) travels with the half
+            "q01_qerror_max": 4.2, "q01_skew_ratio": 1.5,
             "q01_cache_miss_s": 0.9, "q01_cache_hit_s": 0.0004,
             "cache": {"q01": {"hit_speedup": 2250.0, "fp": "ab12cd34ef56"}},
             "q01_measured_at": "2026-08-01T00:00:00Z"}

@@ -892,9 +892,11 @@ def _source_metric_literals():
                 # its _PromDoc.add calls carry derived FAMILY names
                 # (blaze_query_*...), not tree metric names — EXCEPT
                 # the fleet/SLO gauge families, which are registered
-                # verbatim (worker_gauges / pool_gauges / slo_gauges)
+                # verbatim (worker_gauges / pool_gauges / slo_gauges),
+                # and the runtime-stats drift gauges (stats_gauges)
                 for m in re.finditer(
-                        r'\.add\(\s*"(blaze_(?:worker|pool|slo)_'
+                        r'\.add\(\s*"(blaze_(?:worker|pool|slo|'
+                        r'query_qerror|stage_skew)_'
                         r'[a-z_0-9]*)"', src):
                     names.add(m.group(1))
                 continue

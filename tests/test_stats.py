@@ -1,0 +1,377 @@
+"""Runtime statistics observatory (runtime/stats.py):
+
+1. **Estimator units** — cold cardinality estimates from MemoryScan
+   lengths with the documented default selectivities (filter x0.25,
+   grouped agg x0.1, scalar agg -> 1 row), stamped as
+   ``est_rows``/``est_bytes`` in every node's MetricsSet.
+2. **Q-error math** — ``max(est/act, act/est)``, None on an
+   unobserved side.
+3. **HyperLogLog** — accuracy within the p=12 error envelope, merge =
+   union, JSON round-trip, corrupt register list rejected.
+4. **Skew histograms** — per-partition exchange histograms accumulate
+   across map tasks; flush names the hot partition iff BOTH the ratio
+   and min-rows gates pass.
+5. **Store** — persist/reuse across two real processes (the warm
+   process's estimates converge on the cold process's actuals),
+   stale-source and corrupt-entry invalidation, FATAL retry class.
+6. **Disarmed** — structural no-op: a poisoned sketch hook proves the
+   disarmed agg path never touches sketch state.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from blaze_tpu import conf
+from blaze_tpu.batch import batch_from_pydict
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.exprs.ir import Col
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.agg import AggExec, AggFunction, AggMode, GroupingExpr
+from blaze_tpu.ops.filter import FilterExec
+from blaze_tpu.ops.fusion import optimize_plan
+from blaze_tpu.ops.project import ProjectExec
+from blaze_tpu.runtime import dispatch, retry, stats
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+
+SCHEMA = Schema([Field("k", DataType.int64()),
+                 Field("v", DataType.float64())])
+
+
+@pytest.fixture(autouse=True)
+def _armed(tmp_path):
+    """Arm stats with an isolated store dir; restore defaults after."""
+    conf.STATS_ENABLED.set(True)
+    conf.STATS_STORE_ENABLED.set(True)
+    conf.STATS_STORE_DIR.set(str(tmp_path / "store"))
+    stats.reset()
+    yield
+    conf.STATS_ENABLED.set(True)
+    conf.STATS_SKETCHES.set(False)
+    conf.STATS_STORE_ENABLED.set(True)
+    conf.STATS_STORE_DIR.set("")
+    conf.STATS_SKEW_RATIO.set(4.0)
+    conf.STATS_SKEW_MIN_ROWS.set(4096)
+    stats.reset()
+
+
+def _batch(n=400, seed=3, n_keys=50):
+    rng = np.random.RandomState(seed)
+    return batch_from_pydict(
+        {"k": rng.randint(0, n_keys, n).tolist(),
+         "v": rng.rand(n).round(3).tolist()}, SCHEMA)
+
+
+def _run(plan):
+    out = []
+    for p in range(plan.num_partitions()):
+        for b in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            out.append(b)
+            np.asarray(b.columns[0].data)
+    return out
+
+
+def _est(node):
+    return node.metrics.snapshot().get("est_rows")
+
+
+# --------------------------------------------------- 1. estimator units
+
+def test_estimator_stamps_scan_filter_project():
+    scan = MemoryScanExec([[_batch(400)]], SCHEMA)
+    f = FilterExec(scan, col("v") > lit(0.5))
+    plan = ProjectExec(f, [col("k").alias("k")])
+    stats.annotate(plan, None)
+    assert _est(scan) == 400                       # exact: scan length
+    assert _est(f) == 100                          # 400 * 0.25
+    assert _est(plan) == 100                       # pass-through
+    assert scan.metrics.snapshot()["est_bytes"] > 0
+
+
+def test_estimator_agg_selectivities():
+    scan = MemoryScanExec([[_batch(400)]], SCHEMA)
+    grouped = AggExec(scan, AggMode.PARTIAL,
+                      [GroupingExpr(Col("k"), "k")],
+                      [AggFunction("sum", Col("v"), "s")])
+    stats.annotate(grouped, None)
+    assert _est(grouped) == 40                     # 400 * 0.1
+
+    scan2 = MemoryScanExec([[_batch(400)]], SCHEMA)
+    scalar = AggExec(scan2, AggMode.PARTIAL, [],
+                     [AggFunction("sum", Col("v"), "s")])
+    stats.annotate(scalar, None)
+    assert _est(scalar) == 1                       # global agg: one row
+
+
+def test_estimator_disarmed_never_stamps():
+    conf.STATS_ENABLED.set(False)
+    stats.refresh()
+    scan = MemoryScanExec([[_batch(64)]], SCHEMA)
+    stats.annotate(scan, None)
+    assert _est(scan) is None
+
+
+# ------------------------------------------------------ 2. Q-error math
+
+def test_q_error_math():
+    assert stats.q_error(10, 10) == 1.0
+    assert stats.q_error(5, 20) == 4.0
+    assert stats.q_error(20, 5) == 4.0             # symmetric
+    assert stats.q_error(0, 5) is None             # unobserved side
+    assert stats.q_error(5, 0) is None
+
+
+# -------------------------------------------------------------- 3. HLL
+
+def test_hll_accuracy_and_merge():
+    n = 60_000
+    h = stats._mix64(np.arange(1, n + 1, dtype=np.uint64))
+    hll = stats.HyperLogLog()
+    hll.update_hashed(h)
+    est = hll.estimate()
+    # p=12 standard error ~1.6%; 10% is > 6 sigma
+    assert abs(est - n) / n < 0.10
+
+    a, b = stats.HyperLogLog(), stats.HyperLogLog()
+    a.update_hashed(h[: n // 2])
+    b.update_hashed(h[n // 3:])                    # overlapping halves
+    a.merge(b)
+    merged = a.estimate()
+    assert abs(merged - n) / n < 0.10              # merge == union
+
+
+def test_hll_json_roundtrip_and_corrupt_registers():
+    hll = stats.HyperLogLog()
+    hll.update_hashed(stats._mix64(np.arange(1, 5000, dtype=np.uint64)))
+    back = stats.HyperLogLog.from_list(hll.to_list())
+    assert back.estimate() == hll.estimate()
+    with pytest.raises(stats.StatsStoreCorruptError):
+        stats.HyperLogLog.from_list([0, 1, 2])     # wrong register count
+
+
+# --------------------------------------------- 4. skew histograms
+
+def test_skew_finding_names_hot_partition():
+    conf.STATS_SKEW_RATIO.set(3.0)
+    conf.STATS_SKEW_MIN_ROWS.set(100)
+    stats.refresh()
+    # two map tasks of the same shuffle fold into ONE histogram
+    stats.note_exchange("shuffle_9", "ShuffleWriterExec",
+                        [2500, 10, 12, 8], [20000, 80, 96, 64])
+    stats.note_exchange("shuffle_9", "ShuffleWriterExec",
+                        [2500, 10, 12, 8], [20000, 80, 96, 64])
+    summary = stats.flush("skewq")
+    assert summary["skew_ratio"] > 3.0
+    assert len(summary["findings"]) == 1
+    f = summary["findings"][0]
+    assert f["exchange"] == "shuffle_9"
+    assert f["partition"] == 0                     # the seeded hot slot
+    assert f["rows"] == 5000
+    assert f["partitions"] == 4
+    # the registry surface serves the same finding
+    assert stats.recent_findings()[-1]["partition"] == 0
+
+
+def test_skew_gates_min_rows_and_ratio():
+    conf.STATS_SKEW_RATIO.set(3.0)
+    conf.STATS_SKEW_MIN_ROWS.set(100)
+    stats.refresh()
+    # hot partition below the min-rows floor: ratio alone is not enough
+    stats.note_exchange("shuffle_1", "op", [50, 2, 2, 2], [400, 16, 16, 16])
+    s = stats.flush("small")
+    assert s["findings"] == [] and s["skew_ratio"] > 3.0
+    # balanced exchange: no finding either
+    stats.note_exchange("shuffle_2", "op", [500, 480, 510, 505], [1] * 4)
+    s = stats.flush("balanced")
+    assert s["findings"] == []
+
+
+def test_exchange_key_merges_map_outputs():
+    assert stats.exchange_key("/tmp/x/shuffle_3_7.data") == "shuffle_3"
+    assert stats.exchange_key("/tmp/x/shuffle_3_11.data") == "shuffle_3"
+
+
+# ------------------------------------------------------------ 5. store
+
+_ROUNDTRIP = """
+import json, sys
+import numpy as np
+from blaze_tpu import conf
+from blaze_tpu.batch import batch_from_pydict
+from blaze_tpu.exprs import col, lit
+from blaze_tpu.ops import MemoryScanExec
+from blaze_tpu.ops.filter import FilterExec
+from blaze_tpu.ops.fusion import optimize_plan
+from blaze_tpu.runtime import dispatch, stats
+from blaze_tpu.runtime.context import TaskContext
+from blaze_tpu.schema import DataType, Field, Schema
+
+conf.STATS_ENABLED.set(True)
+conf.STATS_STORE_ENABLED.set(True)
+conf.STATS_STORE_DIR.set(sys.argv[1])
+stats.reset()
+schema = Schema([Field("k", DataType.int64()),
+                 Field("v", DataType.float64())])
+rng = np.random.RandomState(7)
+b = batch_from_pydict({"k": rng.randint(0, 50, 512).tolist(),
+                       "v": rng.rand(512).round(3).tolist()}, schema)
+scan = MemoryScanExec([[b]], schema)
+with dispatch.capture() as caps:
+    plan = optimize_plan(FilterExec(scan, col("v") > lit(0.5)))
+    for p in range(plan.num_partitions()):
+        for out in plan.execute(p, TaskContext(p, plan.num_partitions())):
+            np.asarray(out.columns[0].data)
+summary = stats.flush("roundtrip")
+print(json.dumps({"summary": summary,
+                  "hits": caps.get("stats_store_hits", 0)}))
+"""
+
+
+def _run_roundtrip(script_path, store_dir):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(__file__)))
+    out = subprocess.run(
+        [sys.executable, script_path, store_dir],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_store_roundtrip_across_two_processes(tmp_path):
+    """Cold process persists observed actuals; a SECOND process with
+    the identical workload reuses them — its estimates converge on the
+    cold run's truth (Q-error collapses to 1.0)."""
+    script = tmp_path / "roundtrip.py"
+    script.write_text(_ROUNDTRIP)
+    store = str(tmp_path / "store2")
+    cold = _run_roundtrip(str(script), store)
+    assert cold["summary"]["persisted"] >= 1
+    assert cold["summary"]["qerror_max"] > 1.5     # x0.25 guess vs ~50%
+    assert cold["hits"] == 0
+    warm = _run_roundtrip(str(script), store)
+    assert warm["hits"] >= 1                       # stats_store_hits
+    assert warm["summary"]["qerror_max"] is not None
+    assert warm["summary"]["qerror_max"] <= 1.001  # converged on actuals
+    assert warm["summary"]["qerror_max"] < cold["summary"]["qerror_max"]
+
+
+def _fp(digest, sources):
+    return types.SimpleNamespace(digest=digest, exact=True, sources=sources)
+
+
+def test_store_stale_source_invalidation():
+    digest = "ab" * 32
+    assert stats._store_write(digest, (("mem", "1", 0),), {"1": 100},
+                              {"0": {"op": "MemoryScanExec",
+                                     "rows": 5, "bytes": 40}})
+    stats.reset()  # the write primed the cache; force a real file read
+    with dispatch.capture() as caps:
+        rec = stats._store_lookup(_fp(digest, (("mem", "1", 0),)),
+                                  {"1": 100})
+    assert rec is not None and rec["nodes"]["0"]["rows"] == 5
+    assert caps.get("stats_store_hits") == 1
+
+    stats.reset()  # drop the per-process store cache, keep the file
+    # source epoch bumped (MemoryScan replace): entry must NOT serve
+    with dispatch.capture() as caps:
+        rec = stats._store_lookup(_fp(digest, (("mem", "1", 1),)),
+                                  {"1": 100})
+    assert rec is None
+    assert caps.get("stats_store_invalidations") == 1
+    assert not os.path.exists(stats.store_path(digest))  # dropped
+
+
+def test_store_mem_rows_mismatch_invalidates():
+    digest = "cd" * 32
+    assert stats._store_write(digest, (("mem", "1", 0),), {"1": 100},
+                              {"0": {"op": "X", "rows": 5, "bytes": 40}})
+    stats.reset()
+    with dispatch.capture() as caps:
+        rec = stats._store_lookup(_fp(digest, (("mem", "1", 0),)),
+                                  {"1": 999})      # scan grew in place
+    assert rec is None
+    assert caps.get("stats_store_invalidations") == 1
+
+
+def test_store_corrupt_entry_dropped_and_fatal_class():
+    digest = "ef" * 32
+    os.makedirs(stats.store_dir(), exist_ok=True)
+    with open(stats.store_path(digest), "w") as f:
+        f.write("{not json")
+    with dispatch.capture() as caps:
+        rec = stats._store_lookup(_fp(digest, ()), {})
+    assert rec is None
+    assert caps.get("stats_store_invalidations") == 1
+    assert not os.path.exists(stats.store_path(digest))
+    # the error class itself is FATAL for the retry ladder: a corrupt
+    # artifact must never be retried into
+    assert retry.classify(stats.StatsStoreCorruptError("x")) == retry.FATAL
+
+
+def test_flush_persists_and_warm_overlay_in_process():
+    """Same-process store round-trip through the real optimize_plan
+    choke point: flush persists, a rebuilt identical plan's estimates
+    are the stored actuals."""
+    scan = MemoryScanExec([[_batch(512, seed=11)]], SCHEMA)
+    plan = optimize_plan(FilterExec(scan, col("v") > lit(0.25)))
+    _run(plan)
+    s = stats.flush("inproc")
+    assert s["persisted"] >= 1
+    # SAME served scan instance (same source id + epoch => same
+    # fingerprint digest — the repeated-query shape the store keys on)
+    plan2 = optimize_plan(FilterExec(scan, col("v") > lit(0.25)))
+    _run(plan2)
+    s2 = stats.flush("inproc2")
+    assert s2["qerror_max"] is not None
+    assert s2["qerror_max"] <= 1.001
+    stats.discard_pending()
+
+
+# --------------------------------------------------------- 6. disarmed
+
+def test_disarmed_agg_never_touches_poisoned_sketch(monkeypatch):
+    """Structural no-op: stats AND sketches disarmed — a grouped agg
+    executes end to end with the sketch hash function poisoned, so any
+    touch of the sketch path would explode."""
+    conf.STATS_ENABLED.set(False)
+    conf.STATS_SKETCHES.set(True)                  # sketches need ARMED too
+    stats.refresh()
+    monkeypatch.setattr(stats, "group_key_hash",
+                        lambda *a, **k: pytest.fail("sketch path entered"))
+    plan = optimize_plan(
+        AggExec(MemoryScanExec([[_batch(256)]], SCHEMA), AggMode.PARTIAL,
+                [GroupingExpr(Col("k"), "k")],
+                [AggFunction("sum", Col("v"), "s")]))
+    out = _run(plan)
+    assert sum(b.num_rows for b in out) > 0
+    assert getattr(plan, "_stats_hll", None) is None
+    assert stats.flush("disarmed") is None         # flush is a no-op too
+
+
+def test_armed_sketch_ndv_reaches_store():
+    conf.STATS_SKETCHES.set(True)
+    stats.refresh()
+    assert stats.sketches_enabled()
+    plan = optimize_plan(
+        AggExec(MemoryScanExec([[_batch(512, n_keys=40)]], SCHEMA),
+                AggMode.PARTIAL,
+                [GroupingExpr(Col("k"), "k")],
+                [AggFunction("sum", Col("v"), "s")]))
+    _run(plan)
+    s = stats.flush("sketched")
+    assert s["persisted"] >= 1
+    # the persisted agg node carries the NDV estimate + registers
+    entries = [json.load(open(os.path.join(stats.store_dir(), fn)))
+               for fn in os.listdir(stats.store_dir())
+               if fn.endswith(".json")]
+    ndvs = [rec["nodes"][p]["ndv"] for rec in entries
+            for p in rec["nodes"] if "ndv" in rec["nodes"][p]]
+    assert ndvs, "no NDV sketch persisted"
+    assert abs(ndvs[0] - 40) <= 4                  # ~40 distinct keys

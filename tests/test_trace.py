@@ -366,6 +366,13 @@ def _synthetic_events():
         ("slo_alert_resolved", {"pool": "etl", "slo": "latency",
                                 "burn_fast": 0.0, "burn_slow": 0.5,
                                 "fired_for_s": 12.5}),
+        ("stats_skew_detected", {"exchange": "shuffle_0",
+                                 "op": "ShuffleWriterExec[HashPartitioning]",
+                                 "partition": 3, "rows": 9000,
+                                 "bytes": 72000, "ratio": 6.5,
+                                 "partitions": 8}),
+        ("stats_persisted", {"fingerprint": "ab" * 32, "nodes": 4}),
+        ("stats_reused", {"fingerprint": "ab" * 32, "nodes": 4}),
     ]
 
 
